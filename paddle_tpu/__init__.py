@@ -1,0 +1,78 @@
+"""paddle_tpu — a TPU-native deep-learning framework with PaddlePaddle's API.
+
+Compute path: JAX/XLA (MXU-shaped, bf16-first) + Pallas kernels for fused hot
+ops. Runtime: eager autograd tape over jit-cached XLA executables; blessed
+paths (hapi Model, static Executor, jit.to_static) compile whole steps into
+single XLA programs.
+
+Usage: `import paddle_tpu as paddle` — the namespace mirrors `paddle.*`.
+"""
+from __future__ import annotations
+
+import jax as _jax
+
+# int64/float64 parity with the reference (TPU models stay f32/bf16; f64 is
+# for CPU-hosted numerics tests only).
+_jax.config.update("jax_enable_x64", True)
+
+from .core import dtype as _dtype_mod
+from .core.dtype import (  # noqa: F401
+    bfloat16, bool, complex64, complex128, dtype, finfo, float16, float32,
+    float64, get_default_dtype, iinfo, int8, int16, int32, int64,
+    set_default_dtype, uint8,
+)
+from .core.tensor import Tensor  # noqa: F401
+from .core import autograd as _autograd
+from .core.autograd import no_grad, enable_grad, set_grad_enabled, is_grad_enabled  # noqa: F401
+from .core.autograd import grad  # noqa: F401
+
+from . import tensor as tensor  # noqa: F401
+from .tensor import _register_methods as _rm
+
+_rm()
+
+from .tensor import *  # noqa: F401,F403
+from .tensor import to_tensor  # noqa: F401
+
+from .framework import (  # noqa: F401
+    disable_static, enable_static, in_dynamic_mode, in_dygraph_mode, seed,
+    get_rng_state, set_rng_state,
+)
+from .framework.random import get_cuda_rng_state, set_cuda_rng_state  # noqa: F401
+
+from . import fft  # noqa: F401
+from . import autograd  # noqa: F401
+
+__version__ = "0.1.0"
+
+
+def is_compiled_with_cuda():
+    return False
+
+
+def is_compiled_with_xpu():
+    return False
+
+
+def is_compiled_with_rocm():
+    return False
+
+
+def is_compiled_with_npu():
+    return False
+
+
+def is_compiled_with_tpu():
+    return True
+
+
+def is_compiled_with_cinn():
+    return False
+
+
+def is_compiled_with_mkldnn():
+    return False
+
+
+def is_compiled_with_distribute():
+    return True

@@ -1,0 +1,78 @@
+"""paddle.autograd namespace (reference: python/paddle/autograd)."""
+from __future__ import annotations
+
+from .core.autograd import grad, no_grad, enable_grad, set_grad_enabled, is_grad_enabled  # noqa: F401
+from .core.autograd import run_backward as _run_backward
+
+
+def backward(tensors, grad_tensors=None, retain_graph=False):
+    """paddle.autograd.backward."""
+    if not isinstance(tensors, (list, tuple)):
+        tensors = [tensors]
+    if grad_tensors is not None and not isinstance(grad_tensors, (list, tuple)):
+        grad_tensors = [grad_tensors]
+    _run_backward(list(tensors), grad_tensors, retain_graph=retain_graph)
+
+
+class PyLayer:
+    """Custom-autograd layer (reference: python/paddle/autograd/py_layer.py).
+
+    Subclass with static forward(ctx, *args) / backward(ctx, *grads).
+    """
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        from .core.autograd import GradNode, is_grad_enabled
+        from .core.tensor import Tensor
+        import jax
+        import jax.numpy as jnp
+
+        ctx = PyLayerContext()
+        out = cls.forward(ctx, *args, **kwargs)
+        single = not isinstance(out, (list, tuple))
+        outs = [out] if single else list(out)
+        diff_inputs = [a for a in args if isinstance(a, Tensor)
+                       and not a.stop_gradient]
+        if is_grad_enabled() and diff_inputs:
+            structs = [jax.ShapeDtypeStruct(tuple(o.shape), o._value.dtype)
+                       for o in outs]
+            treedef = jax.tree_util.tree_structure(tuple(range(len(outs))))
+
+            def pullback(cots):
+                cots = [Tensor(c) for c in cots]
+                gin = cls.backward(ctx, *cots) if len(cots) > 1 else \
+                    cls.backward(ctx, cots[0])
+                gin = gin if isinstance(gin, (list, tuple)) else (gin,)
+                return tuple(g._value if isinstance(g, Tensor) else g
+                             for g in gin)
+
+            node = GradNode(pullback, None, diff_inputs, treedef, structs,
+                            cls.__name__)
+            # PyLayer pullbacks are opaque: no create_graph support
+            for i, o in enumerate(outs):
+                o.stop_gradient = False
+                o._node, o._out_idx = node, i
+        return out if single else tuple(outs)
+
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *args):
+        raise NotImplementedError
+
+
+class PyLayerContext:
+    def __init__(self):
+        self._saved = ()
+
+    def save_for_backward(self, *tensors):
+        self._saved = tensors
+
+    @property
+    def saved_tensor(self):
+        return self._saved
+
+    def saved_tensors(self):
+        return self._saved

@@ -1,0 +1,289 @@
+"""Reverse-mode autograd tape over jax.vjp.
+
+Reference design: paddle/fluid/eager/grad_node_info.* + fluid/imperative/tracer.*
+record a GradNode per traced op and walk the node graph on `loss.backward()`.
+
+TPU-native design: every eager op runs through `apply(fn, *args)`. When grad
+is required, the op's forward runs under `jax.vjp`, which both executes the
+(jit-cached) XLA computation and captures residuals; the returned pullback is
+itself an XLA-backed callable, stored on a `GradNode`. `backward()` walks the
+node DAG in reverse topological order, invoking pullbacks and accumulating
+cotangents — the exact GradNode walk of the reference, but every node is a
+compiled XLA program. For `create_graph` (higher-order grad), the node also
+keeps its pure forward closure; the vjp is re-derived *through* `apply` so
+the backward pass itself is recorded on the tape — jax.vjp composes, giving
+arbitrary-order gradients.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .tensor import Tensor
+
+__all__ = [
+    "apply", "no_grad", "enable_grad", "set_grad_enabled", "is_grad_enabled",
+    "run_backward", "grad", "GradNode",
+]
+
+
+class _GradState(threading.local):
+    enabled = True
+
+
+_state = _GradState()
+
+
+def is_grad_enabled():
+    return _state.enabled
+
+
+def set_grad_enabled(mode: bool):
+    _state.enabled = bool(mode)
+    return _GradGuard(mode)
+
+
+class _GradGuard(contextlib.ContextDecorator):
+    """Context manager + decorator (paddle.no_grad works as both)."""
+
+    def __init__(self, mode):
+        self._mode = mode
+
+    def __enter__(self):
+        self._prev = _state.enabled
+        _state.enabled = self._mode
+        return self
+
+    def __exit__(self, *exc):
+        _state.enabled = self._prev
+        return False
+
+
+def no_grad(func=None):
+    g = _GradGuard(False)
+    return g(func) if callable(func) else g
+
+
+def enable_grad(func=None):
+    g = _GradGuard(True)
+    return g(func) if callable(func) else g
+
+
+class GradNode:
+    __slots__ = ("pullback", "closed", "inputs", "out_treedef", "out_structs", "name")
+
+    def __init__(self, pullback, closed, inputs, out_treedef, out_structs, name):
+        self.pullback = pullback      # residual-holding pullback (first-order)
+        self.closed = closed          # pure fn of diff inputs (create_graph path)
+        self.inputs = inputs          # differentiable input Tensors
+        self.out_treedef = out_treedef
+        self.out_structs = out_structs  # ShapeDtypeStruct per output leaf
+        self.name = name
+
+
+def _is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+def apply(fn, *args, **kwargs):
+    """Run `fn` (a pure jnp/lax function) over args, unwrapping Tensors and
+    recording a GradNode when any differentiable Tensor participates."""
+    flat, treedef = jax.tree_util.tree_flatten((args, kwargs), is_leaf=_is_tensor)
+    vals = [a._value if _is_tensor(a) else a for a in flat]
+    diff_pos = (
+        [i for i, a in enumerate(flat)
+         if _is_tensor(a) and not a.stop_gradient
+         and jnp.issubdtype(a._value.dtype, jnp.inexact)]
+        if _state.enabled else []
+    )
+
+    def closed(*dvals):
+        v = list(vals)
+        for i, dv in zip(diff_pos, dvals):
+            v[i] = dv
+        a, kw = jax.tree_util.tree_unflatten(treedef, v)
+        return fn(*a, **kw)
+
+    if not diff_pos:
+        out = closed()
+        return jax.tree_util.tree_map(lambda leaf: Tensor(leaf), out)
+
+    out, pullback = jax.vjp(closed, *[vals[i] for i in diff_pos])
+    out_leaves, out_treedef = jax.tree_util.tree_flatten(out)
+    structs = [jax.ShapeDtypeStruct(o.shape, o.dtype) for o in out_leaves]
+    node = GradNode(pullback, closed, [flat[i] for i in diff_pos], out_treedef,
+                    structs, getattr(fn, "__name__", "op"))
+    wrapped = []
+    for i, leaf in enumerate(out_leaves):
+        t = Tensor(leaf, stop_gradient=not jnp.issubdtype(leaf.dtype, jnp.inexact))
+        if not t.stop_gradient:
+            t._node, t._out_idx = node, i
+        wrapped.append(t)
+    return jax.tree_util.tree_unflatten(out_treedef, wrapped)
+
+
+def _raw(x):
+    return x._value if isinstance(x, Tensor) else x
+
+
+def _zero_cot(struct):
+    if jnp.issubdtype(struct.dtype, jnp.inexact):
+        return jnp.zeros(struct.shape, struct.dtype)
+    return np.zeros(struct.shape, jax.dtypes.float0)
+
+
+def _topo_nodes(roots):
+    """Reverse topological order of GradNodes reachable from root tensors
+    (iterative DFS — graphs can be thousands of nodes deep)."""
+    order, perm = [], set()
+    stack = [(n, False) for t in roots if (n := t._node) is not None]
+    on_stack = set()
+    while stack:
+        node, processed = stack.pop()
+        if processed:
+            perm.add(id(node))
+            order.append(node)
+            continue
+        if id(node) in perm or id(node) in on_stack:
+            continue
+        on_stack.add(id(node))
+        stack.append((node, True))
+        for t in node.inputs:
+            if t._node is not None and id(t._node) not in perm:
+                stack.append((t._node, False))
+    return order[::-1]  # consumers first
+
+
+def _add_cot(prev, new, create_graph):
+    if prev is None:
+        return new
+    if create_graph:
+        return apply(jnp.add, prev, new)
+    return prev + new
+
+
+def run_backward(tensors, grad_tensors=None, retain_graph=False,
+                 create_graph=False, inputs=None, accumulate=True,
+                 allow_unused=True):
+    """Engine shared by Tensor.backward and paddle.grad.
+
+    In create_graph mode every cotangent is a live Tensor and pullbacks are
+    re-derived through `apply`, so the backward computation lands on the tape.
+    """
+    if grad_tensors is None:
+        grad_tensors = [None] * len(tensors)
+    cots = {}  # (id(node), out_idx) -> cotangent (raw array | Tensor if create_graph)
+    for t, g in zip(tensors, grad_tensors):
+        if t._node is None and t.stop_gradient:
+            raise RuntimeError(
+                f"Tensor {t.name} has no grad graph; backward requires a "
+                "tensor computed from inputs with stop_gradient=False")
+        if g is None:
+            if t.size != 1:
+                raise RuntimeError(
+                    "grad can be implicitly created only for scalar outputs")
+            g = jnp.ones(t._value.shape, t._value.dtype)
+        if create_graph and not isinstance(g, Tensor):
+            g = Tensor(g)
+        elif not create_graph:
+            g = _raw(g)
+        if t._node is None:
+            _accum_leaf(t, g)
+        else:
+            key = (id(t._node), t._out_idx)
+            cots[key] = _add_cot(cots.get(key), g, create_graph)
+
+    input_grads = {id(t): None for t in (inputs or [])}
+    input_set = set(input_grads)
+
+    for node in _topo_nodes(tensors):
+        keyed = [(id(node), i) for i in range(len(node.out_structs))]
+        if not any(k in cots for k in keyed):
+            continue
+        cot_leaves = [cots.pop(k, None) for k in keyed]
+        cot_leaves = [
+            c if c is not None else _zero_cot(s)
+            for c, s in zip(cot_leaves, node.out_structs)
+        ]
+        if node.pullback is None and node.closed is None:
+            raise RuntimeError(
+                "Trying to backward through the graph a second time: "
+                "set retain_graph=True if you need to.")
+        if create_graph:
+            closed = node.closed
+            treedef = node.out_treedef
+
+            def vjp_call(cot_leaves, *prims, _closed=closed, _td=treedef):
+                cot = jax.tree_util.tree_unflatten(_td, list(cot_leaves))
+                _, pull = jax.vjp(_closed, *prims)
+                return pull(cot)
+
+            in_cots = apply(vjp_call, tuple(cot_leaves), *node.inputs)
+            in_cots = tuple(in_cots) if isinstance(in_cots, (list, tuple)) else (in_cots,)
+        else:
+            cot_tree = jax.tree_util.tree_unflatten(node.out_treedef, cot_leaves)
+            in_cots = node.pullback(cot_tree)
+        for t, c in zip(node.inputs, in_cots):
+            cv = _raw(c)
+            if cv is None or (hasattr(cv, "dtype") and cv.dtype == jax.dtypes.float0):
+                continue
+            if t._node is not None:
+                key = (id(t._node), t._out_idx)
+                cots[key] = _add_cot(cots.get(key), c if create_graph else cv,
+                                     create_graph)
+                if id(t) in input_set:
+                    input_grads[id(t)] = _add_cot(
+                        input_grads[id(t)], c if create_graph else cv, create_graph)
+            else:
+                if id(t) in input_set:
+                    input_grads[id(t)] = _add_cot(
+                        input_grads[id(t)], c if create_graph else cv, create_graph)
+                if accumulate:
+                    _accum_leaf(t, cv)
+        if not retain_graph and not create_graph:
+            node.pullback = None
+            node.closed = None
+    if inputs is not None:
+        out = []
+        for t in inputs:
+            g = input_grads[id(t)]
+            if g is None and not allow_unused:
+                raise RuntimeError(f"input {t.name} unused in graph "
+                                   "(set allow_unused=True to allow)")
+            if g is not None and not isinstance(g, Tensor):
+                g = Tensor(g)
+            out.append(g)
+        return out
+
+
+def _accum_leaf(t, g):
+    if t.stop_gradient:
+        return
+    g = _raw(g)
+    if t._grad is None:
+        t._grad = Tensor(g)
+    else:
+        t._grad = Tensor(_raw(t._grad) + g)
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
+         create_graph=False, only_inputs=True, allow_unused=False,
+         no_grad_vars=None):
+    """paddle.grad — compute grads of outputs wrt inputs without touching .grad.
+
+    Reference: python/paddle/fluid/dygraph/base.py::grad.
+    """
+    outputs = [outputs] if isinstance(outputs, Tensor) else list(outputs)
+    inputs = [inputs] if isinstance(inputs, Tensor) else list(inputs)
+    if grad_outputs is not None and isinstance(grad_outputs, Tensor):
+        grad_outputs = [grad_outputs]
+    if retain_graph is None:
+        retain_graph = create_graph
+    return run_backward(
+        outputs, grad_outputs, retain_graph=retain_graph,
+        create_graph=create_graph, inputs=inputs, accumulate=False,
+        allow_unused=allow_unused)

@@ -1,0 +1,148 @@
+"""Dtype system for paddle_tpu.
+
+Mirrors the reference dtype surface (paddle/phi/common/data_type.h,
+python/paddle/framework/dtype.py) but maps onto jnp dtypes. TPU-first:
+bfloat16 is a first-class dtype; float64 is supported for CPU-hosted tests
+(jax x64 enabled at package import) but discouraged on TPU.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = [
+    "dtype", "uint8", "int8", "int16", "int32", "int64",
+    "float16", "bfloat16", "float32", "float64",
+    "complex64", "complex128", "bool",
+    "convert_dtype", "to_jax_dtype", "is_floating_dtype", "is_integer_dtype",
+    "get_default_dtype", "set_default_dtype", "iinfo", "finfo",
+]
+
+
+class dtype:
+    """Paddle-style dtype handle wrapping a numpy/jnp dtype."""
+
+    __slots__ = ("name", "np_dtype")
+    _registry: dict = {}
+
+    def __init__(self, name: str, np_dtype):
+        self.name = name
+        self.np_dtype = np.dtype(np_dtype)
+        dtype._registry[name] = self
+
+    def __repr__(self):
+        return f"paddle.{self.name}"
+
+    def __hash__(self):
+        return hash(self.name)
+
+    def __eq__(self, other):
+        if isinstance(other, dtype):
+            return self.name == other.name
+        try:
+            return self.np_dtype == np.dtype(_name_of(other))
+        except TypeError:
+            return NotImplemented
+
+    @property
+    def is_floating_point(self):
+        return self.name in ("float16", "bfloat16", "float32", "float64")
+
+    @property
+    def is_complex(self):
+        return self.name in ("complex64", "complex128")
+
+
+def _name_of(d) -> str:
+    if isinstance(d, dtype):
+        return d.name
+    if isinstance(d, str):
+        # paddle accepts 'float32', 'FP32' style handled by callers
+        return d
+    return np.dtype(d).name
+
+
+uint8 = dtype("uint8", np.uint8)
+int8 = dtype("int8", np.int8)
+int16 = dtype("int16", np.int16)
+int32 = dtype("int32", np.int32)
+int64 = dtype("int64", np.int64)
+float16 = dtype("float16", np.float16)
+bfloat16 = dtype("bfloat16", jnp.bfloat16)
+float32 = dtype("float32", np.float32)
+float64 = dtype("float64", np.float64)
+complex64 = dtype("complex64", np.complex64)
+complex128 = dtype("complex128", np.complex128)
+bool = dtype("bool", np.bool_)  # noqa: A001 - mirrors paddle.bool
+
+_ALIASES = {
+    "float": "float32", "double": "float64", "half": "float16",
+    "int": "int32", "long": "int64", "bool_": "bool",
+    "bfloat16": "bfloat16",
+}
+
+
+def convert_dtype(d) -> str:
+    """Normalize any dtype-like to its canonical string name."""
+    if d is None:
+        return get_default_dtype()
+    if isinstance(d, dtype):
+        return d.name
+    if isinstance(d, str):
+        name = _ALIASES.get(d, d)
+        if name not in dtype._registry:
+            raise TypeError(f"Unsupported dtype: {d!r}")
+        return name
+    if d is jnp.bfloat16 or (hasattr(d, "name") and getattr(d, "name", "") == "bfloat16"):
+        return "bfloat16"
+    return np.dtype(d).name
+
+
+def to_paddle_dtype(d) -> dtype:
+    return dtype._registry[convert_dtype(d)]
+
+
+def to_jax_dtype(d):
+    name = convert_dtype(d)
+    return {"bfloat16": jnp.bfloat16}.get(name) or np.dtype(name)
+
+
+def is_floating_dtype(d) -> bool:
+    return convert_dtype(d) in ("float16", "bfloat16", "float32", "float64")
+
+
+def is_integer_dtype(d) -> bool:
+    return convert_dtype(d) in ("uint8", "int8", "int16", "int32", "int64")
+
+
+_default_dtype = "float32"
+
+
+def set_default_dtype(d):
+    global _default_dtype
+    name = convert_dtype(d)
+    if name not in ("float16", "bfloat16", "float32", "float64"):
+        raise TypeError("set_default_dtype only accepts floating dtypes")
+    _default_dtype = name
+
+
+def get_default_dtype() -> str:
+    return _default_dtype
+
+
+class iinfo:
+    def __init__(self, d):
+        info = np.iinfo(np.dtype(convert_dtype(d)))
+        self.min, self.max, self.bits, self.dtype = info.min, info.max, info.bits, convert_dtype(d)
+
+
+class finfo:
+    def __init__(self, d):
+        info = jnp.finfo(to_jax_dtype(d))
+        self.min = float(info.min)
+        self.max = float(info.max)
+        self.eps = float(info.eps)
+        self.tiny = float(info.tiny)
+        self.smallest_normal = float(info.tiny)
+        self.bits = info.bits
+        self.dtype = convert_dtype(d)

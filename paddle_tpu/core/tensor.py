@@ -1,0 +1,299 @@
+"""paddle_tpu Tensor: a Paddle-style eager tensor backed by a jax.Array.
+
+Reference: paddle/fluid/eager (eager Tensor / VarBase) + phi/core/dense_tensor.h.
+TPU-native design: the payload is an HBM-resident `jax.Array` (async-dispatched
+XLA buffer). Autograd metadata (`stop_gradient`, creator node) lives on the
+Python wrapper; the value itself stays pure/functional so the same object
+flows through jit-traced code (Tensor is a registered pytree whose single
+leaf is the payload).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import dtype as dtypes
+
+__all__ = ["Tensor", "to_tensor"]
+
+_tensor_count = 0
+
+
+class Tensor:
+    __slots__ = (
+        "_value", "stop_gradient", "_grad", "_node", "_out_idx",
+        "name", "persistable", "__weakref__",
+    )
+
+    # populated by paddle_tpu.tensor._register_methods at package import
+    _method_names = ()
+
+    def __init__(self, value, stop_gradient=True, name=None):
+        global _tensor_count
+        if isinstance(value, Tensor):
+            value = value._value
+        if not isinstance(value, jax.Array):
+            value = jnp.asarray(value)
+        self._value = value
+        self.stop_gradient = stop_gradient
+        self._grad = None
+        self._node = None
+        self._out_idx = 0
+        if name is None:
+            name = f"generated_tensor_{_tensor_count}"
+            _tensor_count += 1
+        self.name = name
+        self.persistable = False
+
+    # ---- basic properties ------------------------------------------------
+    @property
+    def shape(self):
+        return list(self._value.shape)
+
+    @property
+    def ndim(self):
+        return self._value.ndim
+
+    dim = ndim
+
+    @property
+    def size(self):
+        return int(np.prod(self._value.shape)) if self._value.shape else 1
+
+    @property
+    def dtype(self):
+        return dtypes.to_paddle_dtype(self._value.dtype)
+
+    @property
+    def place(self):
+        from ..device import _place_of
+
+        return _place_of(self._value)
+
+    @property
+    def is_leaf(self):
+        return self._node is None
+
+    @property
+    def grad(self):
+        return self._grad
+
+    @grad.setter
+    def grad(self, g):
+        self._grad = g
+
+    @property
+    def T(self):
+        from .. import tensor as T
+
+        return T.transpose(self, list(range(self.ndim))[::-1])
+
+    @property
+    def mT(self):
+        from .. import tensor as T
+
+        perm = list(range(self.ndim))
+        perm[-2], perm[-1] = perm[-1], perm[-2]
+        return T.transpose(self, perm)
+
+    @property
+    def real(self):
+        from .. import tensor as T
+
+        return T.real(self)
+
+    @property
+    def imag(self):
+        from .. import tensor as T
+
+        return T.imag(self)
+
+    # ---- conversion ------------------------------------------------------
+    def numpy(self):
+        return np.asarray(self._value)
+
+    def __array__(self, dtype=None):
+        a = np.asarray(self._value)
+        return a.astype(dtype) if dtype is not None else a
+
+    def item(self, *args):
+        return self._value.item(*args)
+
+    def tolist(self):
+        return np.asarray(self._value).tolist()
+
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of a 0-d tensor")
+        return self._value.shape[0]
+
+    def __bool__(self):
+        return bool(self._value)
+
+    def __int__(self):
+        return int(self._value)
+
+    def __float__(self):
+        return float(self._value)
+
+    def __index__(self):
+        return int(self._value)
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    def __hash__(self):
+        return id(self)
+
+    def __format__(self, spec):
+        if self.ndim == 0:
+            return format(self.item(), spec)
+        return str(self)
+
+    def __repr__(self):
+        g = "" if self.stop_gradient else ", stop_gradient=False"
+        return (
+            f"Tensor(shape={self.shape}, dtype={self.dtype.name}{g},\n"
+            f"       {np.array2string(np.asarray(self._value), prefix='       ')})"
+        )
+
+    __str__ = __repr__
+
+    # ---- autograd --------------------------------------------------------
+    def backward(self, grad_tensor=None, retain_graph=False):
+        from .autograd import run_backward
+
+        run_backward([self], [grad_tensor], retain_graph=retain_graph)
+
+    def detach(self):
+        t = Tensor(self._value, stop_gradient=True, name=self.name)
+        return t
+
+    def detach_(self):
+        self._node = None
+        self.stop_gradient = True
+        return self
+
+    def clone(self):
+        from .autograd import apply
+
+        return apply(lambda x: x + jnp.zeros((), x.dtype), self)
+
+    def clear_grad(self):
+        self._grad = None
+
+    clear_gradient = clear_grad
+
+    def zero_(self):
+        self._value = jnp.zeros_like(self._value)
+        return self
+
+    def fill_(self, v):
+        self._value = jnp.full_like(self._value, v)
+        return self
+
+    def set_value(self, v):
+        """In-place value replacement (optimizer updates, load_state_dict)."""
+        if isinstance(v, Tensor):
+            v = v._value
+        v = jnp.asarray(v)
+        if tuple(v.shape) != tuple(self._value.shape):
+            raise ValueError(
+                f"set_value shape mismatch: {v.shape} vs {self._value.shape}")
+        self._value = v.astype(self._value.dtype)
+        return self
+
+    def get_tensor(self):  # LoDTensor compat
+        return self
+
+    def value(self):
+        return self
+
+    # ---- device movement (XLA manages placement; these are thin) ---------
+    def cpu(self):
+        return Tensor(jax.device_get(self._value), self.stop_gradient, self.name)
+
+    def cuda(self, *a, **k):  # compat: CUDA name maps to the accelerator
+        return self
+
+    def tpu(self):
+        return self
+
+    def pin_memory(self):
+        return self
+
+    def to(self, *args, **kwargs):
+        from .. import tensor as T
+
+        dt = kwargs.get("dtype")
+        for a in args:
+            if isinstance(a, (str, dtypes.dtype)) and not str(a).startswith(("cpu", "gpu", "tpu", "xpu")):
+                dt = a
+        if dt is not None:
+            return T.cast(self, dt)
+        return self
+
+    def astype(self, dt):
+        from .. import tensor as T
+
+        return T.cast(self, dt)
+
+    # ---- indexing --------------------------------------------------------
+    def __getitem__(self, idx):
+        from .autograd import apply
+
+        idx = _unwrap_index(idx)
+        return apply(lambda x: x[idx], self)
+
+    def __setitem__(self, idx, v):
+        idx = _unwrap_index(idx)
+        if isinstance(v, Tensor):
+            v = v._value
+        self._value = self._value.at[idx].set(v)
+
+    def __getattr__(self, name):
+        raise AttributeError(f"'Tensor' object has no attribute {name!r}")
+
+
+def _unwrap_index(idx):
+    if isinstance(idx, Tensor):
+        return idx._value
+    if isinstance(idx, tuple):
+        return tuple(_unwrap_index(i) for i in idx)
+    if isinstance(idx, list):
+        return jnp.asarray([_unwrap_index(i) for i in idx])
+    return idx
+
+
+def _tensor_flatten(t):
+    return (t._value,), t.stop_gradient
+
+
+def _tensor_unflatten(aux, children):
+    return Tensor(children[0], stop_gradient=aux)
+
+
+jax.tree_util.register_pytree_node(Tensor, _tensor_flatten, _tensor_unflatten)
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True):
+    """paddle.to_tensor — create an eager Tensor on the accelerator."""
+    if isinstance(data, Tensor):
+        v = data._value
+        if dtype is not None:
+            v = v.astype(dtypes.to_jax_dtype(dtype))
+        return Tensor(v, stop_gradient=stop_gradient)
+    if dtype is None:
+        if isinstance(data, np.ndarray):
+            v = jnp.asarray(data)
+        else:
+            arr = np.asarray(data)
+            if arr.dtype == np.float64:
+                # paddle default: python floats land in the default dtype
+                arr = arr.astype(dtypes.to_jax_dtype(dtypes.get_default_dtype()))
+            v = jnp.asarray(arr)
+    else:
+        v = jnp.asarray(np.asarray(data)).astype(dtypes.to_jax_dtype(dtype))
+    return Tensor(v, stop_gradient=stop_gradient)

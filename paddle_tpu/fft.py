@@ -1,0 +1,99 @@
+"""paddle.fft (reference: python/paddle/fft.py) over jnp.fft (XLA FFT HLO)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .core.autograd import apply
+
+__all__ = [
+    "fft", "ifft", "rfft", "irfft", "hfft", "ihfft",
+    "fft2", "ifft2", "rfft2", "irfft2", "hfft2", "ihfft2",
+    "fftn", "ifftn", "rfftn", "irfftn", "hfftn", "ihfftn",
+    "fftfreq", "rfftfreq", "fftshift", "ifftshift",
+]
+
+
+def _norm(norm):
+    return norm if norm in ("ortho", "forward") else "backward"
+
+
+def _mk1(jfn):
+    def f(x, n=None, axis=-1, norm="backward", name=None):
+        return apply(lambda v: jfn(v, n=n, axis=axis, norm=_norm(norm)), x)
+    f.__name__ = jfn.__name__
+    return f
+
+
+def _mk2(jfn):
+    def f(x, s=None, axes=(-2, -1), norm="backward", name=None):
+        return apply(lambda v: jfn(v, s=s, axes=tuple(axes), norm=_norm(norm)), x)
+    f.__name__ = jfn.__name__
+    return f
+
+
+def _mkn(jfn):
+    def f(x, s=None, axes=None, norm="backward", name=None):
+        ax = tuple(axes) if axes is not None else None
+        return apply(lambda v: jfn(v, s=s, axes=ax, norm=_norm(norm)), x)
+    f.__name__ = jfn.__name__
+    return f
+
+
+fft = _mk1(jnp.fft.fft)
+ifft = _mk1(jnp.fft.ifft)
+rfft = _mk1(jnp.fft.rfft)
+irfft = _mk1(jnp.fft.irfft)
+hfft = _mk1(jnp.fft.hfft)
+ihfft = _mk1(jnp.fft.ihfft)
+fft2 = _mk2(jnp.fft.fft2)
+ifft2 = _mk2(jnp.fft.ifft2)
+rfft2 = _mk2(jnp.fft.rfft2)
+irfft2 = _mk2(jnp.fft.irfft2)
+fftn = _mkn(jnp.fft.fftn)
+ifftn = _mkn(jnp.fft.ifftn)
+rfftn = _mkn(jnp.fft.rfftn)
+irfftn = _mkn(jnp.fft.irfftn)
+
+
+def hfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return apply(lambda v: jnp.fft.hfft2(v, s=s, axes=tuple(axes),
+                                         norm=_norm(norm)), x)
+
+
+def ihfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return apply(lambda v: jnp.fft.ihfft2(v, s=s, axes=tuple(axes),
+                                          norm=_norm(norm)), x)
+
+
+def hfftn(x, s=None, axes=None, norm="backward", name=None):
+    ax = tuple(axes) if axes is not None else None
+    return apply(lambda v: jnp.fft.hfftn(v, s=s, axes=ax, norm=_norm(norm)), x)
+
+
+def ihfftn(x, s=None, axes=None, norm="backward", name=None):
+    ax = tuple(axes) if axes is not None else None
+    return apply(lambda v: jnp.fft.ihfftn(v, s=s, axes=ax, norm=_norm(norm)), x)
+
+
+def fftfreq(n, d=1.0, dtype=None, name=None):
+    from .core.tensor import Tensor
+    from .core import dtype as dtypes
+
+    return Tensor(jnp.fft.fftfreq(n, d).astype(
+        dtypes.to_jax_dtype(dtype or dtypes.get_default_dtype())))
+
+
+def rfftfreq(n, d=1.0, dtype=None, name=None):
+    from .core.tensor import Tensor
+    from .core import dtype as dtypes
+
+    return Tensor(jnp.fft.rfftfreq(n, d).astype(
+        dtypes.to_jax_dtype(dtype or dtypes.get_default_dtype())))
+
+
+def fftshift(x, axes=None, name=None):
+    return apply(lambda v: jnp.fft.fftshift(v, axes), x)
+
+
+def ifftshift(x, axes=None, name=None):
+    return apply(lambda v: jnp.fft.ifftshift(v, axes), x)

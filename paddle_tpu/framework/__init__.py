@@ -1,0 +1,9 @@
+"""paddle.framework equivalents: RNG, mode, ParamAttr, io."""
+from __future__ import annotations
+
+from . import random  # noqa: F401
+from .mode import (  # noqa: F401
+    disable_static, enable_static, in_dygraph_mode, in_dynamic_mode,
+    in_static_mode,
+)
+from .random import seed, get_rng_state, set_rng_state  # noqa: F401

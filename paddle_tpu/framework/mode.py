@@ -1,0 +1,30 @@
+"""Dygraph/static mode switch (reference: fluid/framework.py enable_static &
+in_dygraph_mode). Both modes lower to XLA here; static mode routes ops into a
+deferred-trace Program instead of eager dispatch."""
+from __future__ import annotations
+
+__all__ = ["in_dynamic_mode", "in_dygraph_mode", "enable_static",
+           "disable_static", "in_static_mode"]
+
+_static_mode = False
+
+
+def in_dynamic_mode():
+    return not _static_mode
+
+
+in_dygraph_mode = in_dynamic_mode
+
+
+def in_static_mode():
+    return _static_mode
+
+
+def enable_static():
+    global _static_mode
+    _static_mode = True
+
+
+def disable_static():
+    global _static_mode
+    _static_mode = False

@@ -1,0 +1,72 @@
+"""Functional PRNG for paddle_tpu.
+
+Reference: paddle/fluid/framework/generator.cc + phi/core/generator.h keep
+per-device mutable generator state. TPU-native design: a splittable JAX PRNG
+key store. Eager ops draw fresh subkeys from a global key; jit-traced code
+(hapi Model / static Executor / jit.to_static) installs a *traced* key scope
+so randomness is a pure function of the step key — bit-reproducible and
+side-effect free under XLA.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import jax
+
+__all__ = ["seed", "next_key", "get_rng_state", "set_rng_state", "key_scope", "default_seed"]
+
+default_seed = 0
+
+
+class _KeyStore(threading.local):
+    def __init__(self):
+        self.key = jax.random.PRNGKey(default_seed)
+        self.scopes = []  # stack of [key] single-element lists (mutable cells)
+
+
+_store = _KeyStore()
+
+
+def seed(s: int):
+    """paddle.seed — reset the global generator. Returns a Generator-like handle."""
+    _store.key = jax.random.PRNGKey(int(s))
+    return _store
+
+
+def next_key():
+    """Draw a fresh subkey. Inside a key_scope (traced code), split from the
+    scope's key so the draw is a pure function of the scope seed."""
+    if _store.scopes:
+        cell = _store.scopes[-1]
+        cell[0], sub = jax.random.split(cell[0])
+        return sub
+    _store.key, sub = jax.random.split(_store.key)
+    return sub
+
+
+@contextlib.contextmanager
+def key_scope(key):
+    """Install a traced PRNG key; all next_key() draws derive from it."""
+    cell = [key]
+    _store.scopes.append(cell)
+    try:
+        yield cell
+    finally:
+        _store.scopes.pop()
+
+
+def get_rng_state():
+    return [_store.key]
+
+
+def set_rng_state(state):
+    _store.key = state[0]
+
+
+def get_cuda_rng_state():  # compat alias — single generator on TPU
+    return get_rng_state()
+
+
+def set_cuda_rng_state(state):
+    set_rng_state(state)

@@ -1,0 +1,95 @@
+"""paddle.tensor namespace: op functions + Tensor method registration.
+
+Mirrors the reference pattern (python/paddle/tensor/__init__.py binds the
+function namespace onto the eager Tensor via monkey-patch at import time).
+"""
+from __future__ import annotations
+
+from . import attribute, creation, einsum as _einsum_mod, linalg, logic
+from . import manipulation, math, random, search, stat
+from .attribute import *  # noqa: F401,F403
+from .creation import *  # noqa: F401,F403
+from .einsum import einsum  # noqa: F401
+from .linalg import *  # noqa: F401,F403
+from .logic import *  # noqa: F401,F403
+from .manipulation import *  # noqa: F401,F403
+from .math import *  # noqa: F401,F403
+from .random import *  # noqa: F401,F403
+from .search import *  # noqa: F401,F403
+from .stat import *  # noqa: F401,F403
+
+from ..core.tensor import Tensor, to_tensor  # noqa: F401
+
+_MODULES = [math, manipulation, logic, search, stat, linalg, creation,
+            attribute, random]
+
+# names that are Tensor methods in paddle (first arg = self)
+_NON_METHODS = {
+    "to_tensor", "zeros", "ones", "full", "empty", "eye", "arange", "linspace",
+    "logspace", "meshgrid", "tril_indices", "triu_indices", "assign",
+    "uniform", "normal", "gauss", "randn", "rand", "randint", "randperm",
+    "standard_normal", "standard_gamma", "binomial", "broadcast_shape",
+    "is_tensor", "one_hot", "vander", "polar", "complex", "scatter_nd",
+    "einsum", "sum_list",
+}
+
+
+def _register_methods(cls=Tensor):
+    for mod in _MODULES:
+        for name in getattr(mod, "__all__", []):
+            if name in _NON_METHODS or name.startswith("_"):
+                continue
+            fn = getattr(mod, name)
+            if callable(fn) and not hasattr(cls, name):
+                setattr(cls, name, fn)
+
+    # ---- arithmetic dunders ----------------------------------------------
+    def _coerce(other):
+        return other
+
+    cls.__add__ = lambda s, o: math.add(s, _coerce(o))
+    cls.__radd__ = lambda s, o: math.add(s, _coerce(o))
+    cls.__sub__ = lambda s, o: math.subtract(s, _coerce(o))
+    cls.__rsub__ = lambda s, o: math.subtract(to_tensor(o) if not isinstance(o, Tensor) else o, s)
+    cls.__mul__ = lambda s, o: math.multiply(s, _coerce(o))
+    cls.__rmul__ = lambda s, o: math.multiply(s, _coerce(o))
+    cls.__truediv__ = lambda s, o: math.divide(s, _coerce(o))
+    cls.__rtruediv__ = lambda s, o: math.divide(to_tensor(o) if not isinstance(o, Tensor) else o, s)
+    cls.__floordiv__ = lambda s, o: math.floor_divide(s, _coerce(o))
+    cls.__rfloordiv__ = lambda s, o: math.floor_divide(to_tensor(o), s)
+    cls.__mod__ = lambda s, o: math.remainder(s, _coerce(o))
+    cls.__rmod__ = lambda s, o: math.remainder(to_tensor(o), s)
+    cls.__pow__ = lambda s, o: math.pow(s, _coerce(o))
+    cls.__rpow__ = lambda s, o: math.pow(to_tensor(o) if not isinstance(o, Tensor) else o, s)
+    cls.__neg__ = lambda s: math.neg(s)
+    cls.__abs__ = lambda s: math.abs(s)
+    cls.__matmul__ = lambda s, o: linalg.matmul(s, o)
+    cls.__rmatmul__ = lambda s, o: linalg.matmul(to_tensor(o), s)
+    cls.__invert__ = lambda s: logic.logical_not(s) if s._value.dtype == bool \
+        else logic.bitwise_not(s)
+    cls.__and__ = lambda s, o: logic.logical_and(s, o) if s._value.dtype == bool \
+        else logic.bitwise_and(s, _coerce(o))
+    cls.__or__ = lambda s, o: logic.logical_or(s, o) if s._value.dtype == bool \
+        else logic.bitwise_or(s, _coerce(o))
+    cls.__xor__ = lambda s, o: logic.logical_xor(s, o) if s._value.dtype == bool \
+        else logic.bitwise_xor(s, _coerce(o))
+    cls.__lshift__ = lambda s, o: logic.bitwise_left_shift(s, _coerce(o))
+    cls.__rshift__ = lambda s, o: logic.bitwise_right_shift(s, _coerce(o))
+    cls.__eq__ = lambda s, o: logic.equal(s, _coerce(o))
+    cls.__ne__ = lambda s, o: logic.not_equal(s, _coerce(o))
+    cls.__lt__ = lambda s, o: logic.less_than(s, _coerce(o))
+    cls.__le__ = lambda s, o: logic.less_equal(s, _coerce(o))
+    cls.__gt__ = lambda s, o: logic.greater_than(s, _coerce(o))
+    cls.__ge__ = lambda s, o: logic.greater_equal(s, _coerce(o))
+    cls.__hash__ = lambda s: id(s)
+
+    # a few paddle method spellings
+    cls.mean = stat.mean
+    cls.var = stat.var
+    cls.std = stat.std
+    cls.matmul = linalg.matmul
+    cls.norm = linalg.norm
+    cls.dot = math.dot
+    cls.mm = math.mm
+    cls.bmm = math.bmm
+    cls.numel_ = manipulation.numel

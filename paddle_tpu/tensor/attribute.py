@@ -1,0 +1,27 @@
+"""Tensor attribute helpers (reference: python/paddle/tensor/attribute.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+__all__ = ["shape", "is_complex", "is_floating_point", "is_integer", "rank",
+           "real", "imag"]
+
+from .manipulation import rank, real, imag  # noqa: F401
+
+
+def shape(input, name=None):  # noqa: A002
+    return Tensor(jnp.asarray(input.shape, jnp.int32))
+
+
+def is_complex(x):
+    return jnp.issubdtype(x._value.dtype, jnp.complexfloating)
+
+
+def is_floating_point(x):
+    return jnp.issubdtype(x._value.dtype, jnp.floating)
+
+
+def is_integer(x):
+    return jnp.issubdtype(x._value.dtype, jnp.integer)

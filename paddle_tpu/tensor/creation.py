@@ -1,0 +1,192 @@
+"""Tensor creation ops (reference: python/paddle/tensor/creation.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dtype as dtypes
+from ..core.autograd import apply
+from ..core.tensor import Tensor, to_tensor
+
+__all__ = [
+    "to_tensor", "zeros", "ones", "full", "empty", "zeros_like", "ones_like",
+    "full_like", "empty_like", "eye", "arange", "linspace", "logspace",
+    "meshgrid", "diag", "diagflat", "diag_embed", "tril", "triu", "assign",
+    "clone", "complex", "tril_indices", "triu_indices", "polar", "cauchy_",
+    "vander", "one_hot",
+]
+
+
+def _jd(d):
+    return dtypes.to_jax_dtype(d if d is not None else dtypes.get_default_dtype())
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in np.asarray(shape._value))
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(s._value) if isinstance(s, Tensor) else int(s) for s in shape)
+
+
+def zeros(shape, dtype=None, name=None):
+    return Tensor(jnp.zeros(_shape(shape), _jd(dtype)))
+
+
+def ones(shape, dtype=None, name=None):
+    return Tensor(jnp.ones(_shape(shape), _jd(dtype)))
+
+
+def full(shape, fill_value, dtype=None, name=None):
+    if isinstance(fill_value, Tensor):
+        fill_value = fill_value.item()
+    if dtype is None and isinstance(fill_value, (bool, int)):
+        dtype = "bool" if isinstance(fill_value, bool) else "int64"
+    return Tensor(jnp.full(_shape(shape), fill_value, _jd(dtype)))
+
+
+def empty(shape, dtype=None, name=None):
+    return zeros(shape, dtype)
+
+
+def zeros_like(x, dtype=None, name=None):
+    return Tensor(jnp.zeros(x._value.shape, _jd(dtype) if dtype else x._value.dtype))
+
+
+def ones_like(x, dtype=None, name=None):
+    return Tensor(jnp.ones(x._value.shape, _jd(dtype) if dtype else x._value.dtype))
+
+
+def full_like(x, fill_value, dtype=None, name=None):
+    return Tensor(jnp.full(x._value.shape, fill_value,
+                           _jd(dtype) if dtype else x._value.dtype))
+
+
+def empty_like(x, dtype=None, name=None):
+    return zeros_like(x, dtype)
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None):
+    return Tensor(jnp.eye(num_rows, num_columns, dtype=_jd(dtype)))
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    def _v(x):
+        return x.item() if isinstance(x, Tensor) else x
+    start, end, step = _v(start), _v(end), _v(step)
+    if end is None:
+        start, end = 0, start
+    if dtype is None:
+        dtype = "int64" if all(
+            isinstance(v, (int, np.integer)) for v in (start, end, step)) else None
+    return Tensor(jnp.arange(start, end, step, _jd(dtype)))
+
+
+def linspace(start, stop, num, dtype=None, name=None):
+    def _v(x):
+        return x.item() if isinstance(x, Tensor) else x
+    return Tensor(jnp.linspace(_v(start), _v(stop), int(_v(num)), dtype=_jd(dtype)))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None):
+    def _v(x):
+        return x.item() if isinstance(x, Tensor) else x
+    return Tensor(jnp.logspace(_v(start), _v(stop), int(_v(num)), base=_v(base),
+                               dtype=_jd(dtype)))
+
+
+def meshgrid(*args, **kwargs):
+    if len(args) == 1 and isinstance(args[0], (list, tuple)):
+        args = tuple(args[0])
+    outs = apply(lambda *xs: jnp.meshgrid(*xs, indexing="ij"), *args)
+    return list(outs)
+
+
+def diag(x, offset=0, padding_value=0, name=None):
+    def _diag(v):
+        if v.ndim == 1 and padding_value != 0:
+            n = v.shape[0] + abs(offset)
+            base = jnp.full((n, n), padding_value, v.dtype)
+            return base + jnp.diag(v - 0, offset) - jnp.diag(
+                jnp.full(v.shape, padding_value, v.dtype), offset)
+        return jnp.diag(v, offset)
+    return apply(_diag, x)
+
+
+def diagflat(x, offset=0, name=None):
+    return apply(lambda v: jnp.diagflat(v, offset), x)
+
+
+def diag_embed(x, offset=0, dim1=-2, dim2=-1, name=None):
+    def _f(v):
+        out = jnp.zeros(v.shape + (v.shape[-1] + abs(offset),), v.dtype)
+        idx = jnp.arange(v.shape[-1])
+        rows = idx + max(-offset, 0)
+        cols = idx + max(offset, 0)
+        out = jnp.zeros(v.shape[:-1] + (v.shape[-1] + abs(offset),
+                                        v.shape[-1] + abs(offset)), v.dtype)
+        out = out.at[..., rows, cols].set(v)
+        return jnp.moveaxis(jnp.moveaxis(out, -2, dim1), -1, dim2) \
+            if (dim1, dim2) != (-2, -1) else out
+    return apply(_f, x)
+
+
+def tril(x, diagonal=0, name=None):
+    return apply(lambda v: jnp.tril(v, diagonal), x)
+
+
+def triu(x, diagonal=0, name=None):
+    return apply(lambda v: jnp.triu(v, diagonal), x)
+
+
+def tril_indices(row, col=None, offset=0, dtype="int64"):
+    col = row if col is None else col
+    r, c = np.tril_indices(row, offset, col)
+    return Tensor(jnp.asarray(np.stack([r, c]), dtypes.to_jax_dtype(dtype)))
+
+
+def triu_indices(row, col=None, offset=0, dtype="int64"):
+    col = row if col is None else col
+    r, c = np.triu_indices(row, offset, col)
+    return Tensor(jnp.asarray(np.stack([r, c]), dtypes.to_jax_dtype(dtype)))
+
+
+def assign(x, output=None):
+    v = x._value if isinstance(x, Tensor) else jnp.asarray(np.asarray(x))
+    if output is not None:
+        output._value = v
+        return output
+    return apply(lambda a: a + jnp.zeros((), a.dtype), x) if isinstance(x, Tensor) else Tensor(v)
+
+
+def clone(x, name=None):
+    return x.clone()
+
+
+def complex(real, imag, name=None):  # noqa: A001
+    return apply(jnp.complex64 if False else (lambda r, i: r + 1j * i), real, imag)
+
+
+def polar(abs, angle, name=None):  # noqa: A002
+    return apply(lambda a, t: a * jnp.exp(1j * t.astype(jnp.complex64)), abs, angle)
+
+
+def cauchy_(x, loc=0, scale=1, name=None):
+    from ..framework import random as rnd
+    import jax
+
+    u = jax.random.uniform(rnd.next_key(), x._value.shape, jnp.float32,
+                           1e-7, 1 - 1e-7)
+    x._value = (loc + scale * jnp.tan(jnp.pi * (u - 0.5))).astype(x._value.dtype)
+    return x
+
+
+def vander(x, n=None, increasing=False, name=None):
+    return apply(lambda v: jnp.vander(v, n, increasing=increasing), x)
+
+
+def one_hot(x, num_classes, name=None):
+    import jax.nn as jnn
+
+    return apply(lambda v: jnn.one_hot(v, num_classes,
+                                       dtype=_jd(dtypes.get_default_dtype())), x)

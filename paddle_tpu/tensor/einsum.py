@@ -1,0 +1,14 @@
+"""einsum (reference: python/paddle/tensor/einsum.py) → jnp.einsum (MXU-lowered)."""
+from __future__ import annotations
+
+from ..core.autograd import apply
+
+__all__ = ["einsum"]
+
+import jax.numpy as jnp
+
+
+def einsum(equation, *operands):
+    if len(operands) == 1 and isinstance(operands[0], (list, tuple)):
+        operands = tuple(operands[0])
+    return apply(lambda *ops: jnp.einsum(equation, *ops), *operands)

@@ -1,0 +1,293 @@
+"""Linear algebra ops (reference: python/paddle/tensor/linalg.py + linalg.py).
+
+matmul is THE op on TPU: it lowers straight to MXU systolic-array tiles.
+Decompositions (svd/qr/eig/…) lower to XLA's CPU/TPU linalg custom calls via
+jnp.linalg / lax.linalg.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.autograd import apply
+from ..core.tensor import Tensor
+
+__all__ = [
+    "matmul", "norm", "vector_norm", "matrix_norm", "cholesky", "inv", "det",
+    "slogdet", "svd", "svdvals", "qr", "eig", "eigh", "eigvals", "eigvalsh",
+    "lstsq", "solve", "triangular_solve", "cholesky_solve", "lu", "lu_unpack",
+    "matrix_power", "matrix_rank", "pinv", "cross", "dist", "histogram",
+    "bincount", "mv", "multi_dot", "cond", "cdist", "householder_product",
+    "matrix_exp", "ormqr", "pca_lowrank",
+]
+
+from .stat import histogram, bincount  # noqa: F401  (paddle.linalg re-exports)
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    def _mm(a, b):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2) if a.ndim > 1 else a
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2) if b.ndim > 1 else b
+        return jnp.matmul(a, b)
+    return apply(_mm, x, y)
+
+
+def norm(x, p=None, axis=None, keepdim=False, name=None):
+    def _f(v):
+        ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+        if p is None:
+            if ax is None or isinstance(ax, tuple) and len(ax) == 2:
+                return jnp.linalg.norm(v, "fro" if (ax is not None or v.ndim == 2)
+                                       else None, axis=ax, keepdims=keepdim) \
+                    if ax is not None else jnp.sqrt(jnp.sum(v * v))
+            return jnp.linalg.norm(v, 2, axis=ax, keepdims=keepdim)
+        if p == "fro":
+            return jnp.linalg.norm(v, "fro", axis=ax, keepdims=keepdim) \
+                if ax is not None else jnp.sqrt(jnp.sum(v * v))
+        if p == "nuc":
+            return jnp.linalg.norm(v, "nuc", axis=ax, keepdims=keepdim)
+        if p == float("inf"):
+            return jnp.max(jnp.abs(v), axis=ax, keepdims=keepdim) if not (
+                isinstance(ax, tuple) and len(ax) == 2) else \
+                jnp.linalg.norm(v, p, axis=ax, keepdims=keepdim)
+        if p == float("-inf"):
+            return jnp.min(jnp.abs(v), axis=ax, keepdims=keepdim) if not (
+                isinstance(ax, tuple) and len(ax) == 2) else \
+                jnp.linalg.norm(v, p, axis=ax, keepdims=keepdim)
+        if ax is None:
+            return jnp.sum(jnp.abs(v) ** p) ** (1.0 / p)
+        return jnp.sum(jnp.abs(v) ** p, axis=ax, keepdims=keepdim) ** (1.0 / p)
+    return apply(_f, x)
+
+
+def vector_norm(x, p=2.0, axis=None, keepdim=False, name=None):
+    def _f(v):
+        ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+        if p == float("inf"):
+            return jnp.max(jnp.abs(v), axis=ax, keepdims=keepdim)
+        if p == float("-inf"):
+            return jnp.min(jnp.abs(v), axis=ax, keepdims=keepdim)
+        if p == 0:
+            return jnp.sum((v != 0).astype(v.dtype), axis=ax, keepdims=keepdim)
+        return jnp.sum(jnp.abs(v) ** p, axis=ax, keepdims=keepdim) ** (1.0 / p)
+    return apply(_f, x)
+
+
+def matrix_norm(x, p="fro", axis=(-2, -1), keepdim=False, name=None):
+    return apply(lambda v: jnp.linalg.norm(v, p, axis=tuple(axis),
+                                           keepdims=keepdim), x)
+
+
+def cholesky(x, upper=False, name=None):
+    def _f(v):
+        c = jnp.linalg.cholesky(v)
+        return jnp.swapaxes(c, -1, -2).conj() if upper else c
+    return apply(_f, x)
+
+
+def inv(x, name=None):
+    return apply(jnp.linalg.inv, x)
+
+
+def det(x, name=None):
+    return apply(jnp.linalg.det, x)
+
+
+def slogdet(x, name=None):
+    def _f(v):
+        s, ld = jnp.linalg.slogdet(v)
+        return jnp.stack([s, ld]) if v.ndim == 2 else jnp.stack([s, ld])
+    return apply(_f, x)
+
+
+def svd(x, full_matrices=False, name=None):
+    return apply(lambda v: jnp.linalg.svd(v, full_matrices=full_matrices), x)
+
+
+def svdvals(x, name=None):
+    return apply(lambda v: jnp.linalg.svd(v, compute_uv=False), x)
+
+
+def qr(x, mode="reduced", name=None):
+    return apply(lambda v: jnp.linalg.qr(v, mode=mode), x)
+
+
+def eig(x, name=None):
+    v = np.asarray(x._value)  # general eig: CPU path (XLA TPU lacks geev)
+    w, vec = np.linalg.eig(v)
+    return Tensor(jnp.asarray(w)), Tensor(jnp.asarray(vec))
+
+
+def eigvals(x, name=None):
+    w = np.linalg.eigvals(np.asarray(x._value))
+    return Tensor(jnp.asarray(w))
+
+
+def eigh(x, UPLO="L", name=None):
+    return apply(lambda v: jnp.linalg.eigh(v, UPLO=UPLO), x)
+
+
+def eigvalsh(x, UPLO="L", name=None):
+    return apply(lambda v: jnp.linalg.eigvalsh(v, UPLO=UPLO), x)
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    def _f(a, b):
+        sol, res, rk, sv = jnp.linalg.lstsq(a, b, rcond=rcond)
+        return sol, res, rk, sv
+    return apply(_f, x, y)
+
+
+def solve(x, y, name=None):
+    return apply(jnp.linalg.solve, x, y)
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False,
+                     name=None):
+    def _f(a, b):
+        return jax.scipy.linalg.solve_triangular(
+            a, b, trans=1 if transpose else 0, lower=not upper,
+            unit_diagonal=unitriangular)
+    return apply(_f, x, y)
+
+
+def cholesky_solve(x, y, upper=False, name=None):
+    def _f(b, c):
+        return jax.scipy.linalg.cho_solve((c, not upper), b)
+    return apply(_f, x, y)
+
+
+def lu(x, pivot=True, get_infos=False, name=None):
+    def _f(v):
+        lu_, piv = jax.scipy.linalg.lu_factor(v)
+        return lu_, (piv + 1).astype(jnp.int32)
+    out = apply(_f, x)
+    if get_infos:
+        return out[0], out[1], Tensor(jnp.zeros((), jnp.int32))
+    return out
+
+
+def lu_unpack(x, y, unpack_ludata=True, unpack_pivots=True, name=None):
+    def _f(lu_, piv):
+        n = lu_.shape[-2]
+        L = jnp.tril(lu_, -1) + jnp.eye(n, lu_.shape[-1], dtype=lu_.dtype)
+        L = L[..., :, :min(lu_.shape[-2:])] if lu_.shape[-2] > lu_.shape[-1] else L
+        U = jnp.triu(lu_)[..., :min(lu_.shape[-2:]), :]
+        perm = jnp.arange(n)
+        for i in range(piv.shape[-1]):
+            j = piv[..., i] - 1
+            pi, pj = perm[i], perm[j]
+            perm = perm.at[i].set(pj).at[j].set(pi)
+        P = jnp.eye(n, dtype=lu_.dtype)[perm].T
+        return P, L, U
+    return apply(_f, x, y)
+
+
+def matrix_power(x, n, name=None):
+    return apply(lambda v: jnp.linalg.matrix_power(v, n), x)
+
+
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    tv = tol._value if isinstance(tol, Tensor) else tol
+
+    def _f(v):
+        if hermitian:
+            s = jnp.abs(jnp.linalg.eigvalsh(v))
+            t = tv if tv is not None else jnp.max(s, -1) * v.shape[-1] * \
+                jnp.finfo(v.dtype).eps
+            return jnp.sum(s > jnp.expand_dims(jnp.asarray(t), -1) if jnp.ndim(t)
+                           else s > t, axis=-1).astype(jnp.int64)
+        return jnp.linalg.matrix_rank(v, rtol=None if tv is None else tv).astype(jnp.int64)
+    return apply(_f, x)
+
+
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    rv = rcond._value if isinstance(rcond, Tensor) else rcond
+    return apply(lambda v: jnp.linalg.pinv(v, rtol=rv, hermitian=hermitian), x)
+
+
+def cross(x, y, axis=9, name=None):
+    def _f(a, b):
+        ax = axis
+        if ax == 9:
+            ax = next((i for i, s in enumerate(a.shape) if s == 3), -1)
+        return jnp.cross(a, b, axis=ax)
+    return apply(_f, x, y)
+
+
+def dist(x, y, p=2, name=None):
+    def _f(a, b):
+        d = jnp.abs(a - b)
+        if p == 0:
+            return jnp.sum(d != 0).astype(a.dtype)
+        if p == float("inf"):
+            return jnp.max(d)
+        if p == float("-inf"):
+            return jnp.min(d)
+        return jnp.sum(d ** p) ** (1.0 / p)
+    return apply(_f, x, y)
+
+
+def cdist(x, y, p=2.0, compute_mode="use_mm_for_euclid_dist_if_necessary",
+          name=None):
+    def _f(a, b):
+        d = jnp.abs(a[..., :, None, :] - b[..., None, :, :])
+        if p == float("inf"):
+            return jnp.max(d, -1)
+        if p == 0:
+            return jnp.sum((d != 0).astype(a.dtype), -1)
+        return jnp.sum(d ** p, -1) ** (1.0 / p)
+    return apply(_f, x, y)
+
+
+def mv(x, vec, name=None):
+    return apply(jnp.matmul, x, vec)
+
+
+def multi_dot(x, name=None):
+    return apply(lambda xs: jnp.linalg.multi_dot(xs), list(x))
+
+
+def cond(x, p=None, name=None):
+    return apply(lambda v: jnp.linalg.cond(v, p), x)
+
+
+def matrix_exp(x, name=None):
+    return apply(jax.scipy.linalg.expm, x)
+
+
+def householder_product(x, tau, name=None):
+    def _f(a, t):
+        m, n = a.shape[-2], a.shape[-1]
+        eye = jnp.eye(m, dtype=a.dtype)
+        q = jnp.broadcast_to(eye, a.shape[:-2] + (m, m)).copy() if a.ndim > 2 \
+            else eye
+        for k in range(t.shape[-1] - 1, -1, -1):
+            v = a[..., :, k]
+            v = jnp.where(jnp.arange(m) < k, 0.0, v)
+            v = v.at[..., k].set(1.0)
+            tk = t[..., k]
+            vv = v[..., :, None] * v[..., None, :]
+            q = q - tk[..., None, None] * (vv @ q)
+        return q[..., :, :n]
+    return apply(_f, x, tau)
+
+
+def ormqr(x, tau, other, left=True, transpose=False, name=None):
+    q = householder_product(x, tau)
+    from . import math as M
+
+    qm = q if not transpose else q.mT
+    return M.mm(qm, other) if left else M.mm(other, qm)
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    def _f(v):
+        k = q if q is not None else min(6, *v.shape[-2:])
+        a = v - jnp.mean(v, -2, keepdims=True) if center else v
+        u, s, vt = jnp.linalg.svd(a, full_matrices=False)
+        return u[..., :k], s[..., :k], jnp.swapaxes(vt, -1, -2)[..., :k]
+    return apply(_f, x)

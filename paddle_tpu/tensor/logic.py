@@ -1,0 +1,92 @@
+"""Comparison / logical / bitwise ops (reference: python/paddle/tensor/logic.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.autograd import apply
+from ..core.tensor import Tensor
+
+__all__ = [
+    "equal", "not_equal", "greater_than", "greater_equal", "less_than",
+    "less_equal", "logical_and", "logical_or", "logical_xor", "logical_not",
+    "bitwise_and", "bitwise_or", "bitwise_xor", "bitwise_not",
+    "bitwise_left_shift", "bitwise_right_shift", "is_empty", "is_tensor",
+    "isclose", "allclose", "equal_all", "greater", "less",
+]
+
+
+def _cmp(jfn):
+    def f(x, y, name=None):
+        return apply(jfn, x, y)
+    f.__name__ = jfn.__name__
+    return f
+
+
+equal = _cmp(jnp.equal)
+not_equal = _cmp(jnp.not_equal)
+greater_than = _cmp(jnp.greater)
+greater_equal = _cmp(jnp.greater_equal)
+less_than = _cmp(jnp.less)
+less_equal = _cmp(jnp.less_equal)
+greater = greater_than
+less = less_than
+
+
+def logical_and(x, y, out=None, name=None):
+    return apply(jnp.logical_and, x, y)
+
+
+def logical_or(x, y, out=None, name=None):
+    return apply(jnp.logical_or, x, y)
+
+
+def logical_xor(x, y, out=None, name=None):
+    return apply(jnp.logical_xor, x, y)
+
+
+def logical_not(x, out=None, name=None):
+    return apply(jnp.logical_not, x)
+
+
+bitwise_and = _cmp(jnp.bitwise_and)
+bitwise_or = _cmp(jnp.bitwise_or)
+bitwise_xor = _cmp(jnp.bitwise_xor)
+
+
+def bitwise_not(x, out=None, name=None):
+    return apply(jnp.bitwise_not, x)
+
+
+bitwise_left_shift = _cmp(jnp.left_shift)
+bitwise_right_shift = _cmp(jnp.right_shift)
+
+
+def is_empty(x, name=None):
+    return Tensor(jnp.asarray(x.size == 0))
+
+
+def is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+def isclose(x, y, rtol=1e-5, atol=1e-8, equal_nan=False, name=None):
+    return apply(lambda a, b: jnp.isclose(a, b, rtol=rtol, atol=atol,
+                                          equal_nan=equal_nan), x, y)
+
+
+def allclose(x, y, rtol=1e-5, atol=1e-8, equal_nan=False, name=None):
+    return apply(lambda a, b: jnp.allclose(a, b, rtol=rtol, atol=atol,
+                                           equal_nan=equal_nan), x, y)
+
+
+def equal_all(x, y, name=None):
+    return apply(lambda a, b: jnp.asarray(
+        a.shape == b.shape and bool_like(jnp.all(a == b))), x, y) \
+        if False else Tensor(jnp.asarray(
+            tuple(x._value.shape) == tuple(y._value.shape)
+            and bool(jnp.all(x._value == y._value))))
+
+
+def bool_like(v):
+    return v

@@ -1,0 +1,561 @@
+"""Shape/layout manipulation ops (reference: python/paddle/tensor/manipulation.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dtype as dtypes
+from ..core.autograd import apply
+from ..core.tensor import Tensor
+
+__all__ = [
+    "reshape", "reshape_", "transpose", "flatten", "squeeze", "squeeze_",
+    "unsqueeze", "unsqueeze_", "concat", "stack", "split", "vsplit", "hsplit",
+    "dsplit", "tensor_split", "chunk", "tile", "expand", "expand_as",
+    "broadcast_to", "broadcast_tensors", "flip", "rot90", "roll", "gather",
+    "gather_nd", "scatter", "scatter_", "scatter_nd", "scatter_nd_add",
+    "index_select", "index_sample", "index_add", "index_put", "masked_select",
+    "masked_fill", "masked_scatter", "slice", "strided_slice", "unbind",
+    "unique", "unique_consecutive", "unstack", "shard_index",
+    "repeat_interleave", "reverse", "moveaxis", "as_complex", "as_real",
+    "cast", "crop", "fill_diagonal_", "put_along_axis", "take_along_axis",
+    "tensordot", "t", "real", "imag", "numel", "rank", "view", "view_as",
+    "atleast_1d", "atleast_2d", "atleast_3d", "select_scatter", "diagonal",
+    "diagonal_scatter", "flatten_", "pad",
+]
+
+
+def _int(v):
+    return int(v._value) if isinstance(v, Tensor) else int(v)
+
+
+def _shape_arg(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in np.asarray(shape._value))
+    return tuple(_int(s) for s in shape)
+
+
+def reshape(x, shape, name=None):
+    shape = _shape_arg(shape)
+    return apply(lambda v: jnp.reshape(v, shape), x)
+
+
+def reshape_(x, shape, name=None):
+    out = reshape(x, shape)
+    x._value, x._node, x._out_idx = out._value, out._node, out._out_idx
+    x.stop_gradient = out.stop_gradient
+    return x
+
+
+view = reshape
+
+
+def view_as(x, other, name=None):
+    return reshape(x, other.shape)
+
+
+def transpose(x, perm, name=None):
+    perm = [_int(p) for p in perm]
+    return apply(lambda v: jnp.transpose(v, perm), x)
+
+
+def t(input, name=None):  # noqa: A002
+    def _t(v):
+        return v.T if v.ndim >= 2 else v
+    return apply(_t, input)
+
+
+def moveaxis(x, source, destination, name=None):
+    return apply(lambda v: jnp.moveaxis(v, source, destination), x)
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    def _f(v):
+        nd = v.ndim
+        s = start_axis % nd if nd else 0
+        e = stop_axis % nd if nd else 0
+        new_shape = v.shape[:s] + (-1,) + v.shape[e + 1:]
+        return jnp.reshape(v, new_shape)
+    return apply(_f, x)
+
+
+def flatten_(x, start_axis=0, stop_axis=-1, name=None):
+    out = flatten(x, start_axis, stop_axis)
+    x._value, x._node, x._out_idx = out._value, out._node, out._out_idx
+    x.stop_gradient = out.stop_gradient
+    return x
+
+
+def squeeze(x, axis=None, name=None):
+    def _f(v):
+        if axis is None:
+            return jnp.squeeze(v)
+        axes = axis if isinstance(axis, (list, tuple)) else [axis]
+        axes = tuple(a % v.ndim for a in axes if v.shape[a % v.ndim] == 1)
+        return jnp.squeeze(v, axes) if axes else v
+    return apply(_f, x)
+
+
+def squeeze_(x, axis=None, name=None):
+    out = squeeze(x, axis)
+    x._value, x._node, x._out_idx = out._value, out._node, out._out_idx
+    x.stop_gradient = out.stop_gradient
+    return x
+
+
+def unsqueeze(x, axis, name=None):
+    axes = axis if isinstance(axis, (list, tuple)) else [axis]
+    axes = [_int(a) for a in axes]
+
+    def _f(v):
+        out = v
+        for a in sorted(axes):
+            out = jnp.expand_dims(out, a)
+        return out
+    return apply(_f, x)
+
+
+def unsqueeze_(x, axis, name=None):
+    out = unsqueeze(x, axis)
+    x._value, x._node, x._out_idx = out._value, out._node, out._out_idx
+    x.stop_gradient = out.stop_gradient
+    return x
+
+
+def concat(x, axis=0, name=None):
+    axis = _int(axis)
+    return apply(lambda xs: jnp.concatenate(xs, axis=axis), list(x))
+
+
+def stack(x, axis=0, name=None):
+    return apply(lambda xs: jnp.stack(xs, axis=axis), list(x))
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    axis = _int(axis)
+    dim = x._value.shape[axis]
+    if isinstance(num_or_sections, int):
+        sections = [dim // num_or_sections] * num_or_sections
+    else:
+        sections = [_int(s) for s in num_or_sections]
+        n_unknown = builtins_sum(1 for s in sections if s in (-1,))
+        if n_unknown:
+            known = builtins_sum(s for s in sections if s != -1)
+            sections = [s if s != -1 else dim - known for s in sections]
+    offsets = np.cumsum([0] + sections)
+
+    def _f(v):
+        return tuple(jax.lax.slice_in_dim(v, int(offsets[i]), int(offsets[i + 1]),
+                                          axis=axis) for i in range(len(sections)))
+    return list(apply(_f, x))
+
+
+def builtins_sum(it):
+    import builtins
+
+    return builtins.sum(it)
+
+
+def tensor_split(x, num_or_indices, axis=0, name=None):
+    axis = _int(axis)
+    if isinstance(num_or_indices, int):
+        return list(apply(lambda v: tuple(jnp.array_split(v, num_or_indices, axis)), x))
+    idx = [_int(i) for i in num_or_indices]
+    return list(apply(lambda v: tuple(jnp.split(v, idx, axis)), x))
+
+
+def vsplit(x, num_or_indices, name=None):
+    return tensor_split(x, num_or_indices, 0)
+
+
+def hsplit(x, num_or_indices, name=None):
+    return tensor_split(x, num_or_indices, 1)
+
+
+def dsplit(x, num_or_indices, name=None):
+    return tensor_split(x, num_or_indices, 2)
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, chunks, axis)
+
+
+def tile(x, repeat_times, name=None):
+    reps = _shape_arg(repeat_times)
+    return apply(lambda v: jnp.tile(v, reps), x)
+
+
+def expand(x, shape, name=None):
+    shape = _shape_arg(shape)
+
+    def _f(v):
+        tgt = list(shape)
+        off = len(tgt) - v.ndim
+        for i in range(v.ndim):
+            if tgt[off + i] == -1:
+                tgt[off + i] = v.shape[i]
+        return jnp.broadcast_to(v, tuple(tgt))
+    return apply(_f, x)
+
+
+def expand_as(x, y, name=None):
+    return expand(x, y.shape)
+
+
+def broadcast_to(x, shape, name=None):
+    return expand(x, shape)
+
+
+def broadcast_tensors(input, name=None):  # noqa: A002
+    return list(apply(lambda xs: jnp.broadcast_arrays(*xs), list(input)))
+
+
+def flip(x, axis, name=None):
+    axes = axis if isinstance(axis, (list, tuple)) else [axis]
+    return apply(lambda v: jnp.flip(v, tuple(axes)), x)
+
+
+reverse = flip
+
+
+def rot90(x, k=1, axes=(0, 1), name=None):
+    return apply(lambda v: jnp.rot90(v, k, axes), x)
+
+
+def roll(x, shifts, axis=None, name=None):
+    return apply(lambda v: jnp.roll(v, shifts, axis), x)
+
+
+def gather(x, index, axis=0, name=None):
+    axis = _int(axis)
+
+    def _f(v, idx):
+        return jnp.take(v, idx.ravel() if idx.ndim > 1 else idx, axis=axis)
+    return apply(_f, x, index)
+
+
+def gather_nd(x, index, name=None):
+    def _f(v, idx):
+        k = idx.shape[-1]
+        return v[tuple(jnp.moveaxis(idx, -1, 0))] if k == v.ndim else \
+            v[tuple(jnp.moveaxis(idx, -1, 0))]
+    return apply(_f, x, index)
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    def _f(v, idx, upd):
+        if overwrite:
+            return v.at[idx].set(upd)
+        base = v.at[idx].set(jnp.zeros_like(upd))
+        return base.at[idx].add(upd)
+    return apply(_f, x, index, updates)
+
+
+def scatter_(x, index, updates, overwrite=True, name=None):
+    out = scatter(x, index, updates, overwrite)
+    x._value, x._node, x._out_idx = out._value, out._node, out._out_idx
+    x.stop_gradient = out.stop_gradient
+    return x
+
+
+def scatter_nd(index, updates, shape, name=None):
+    shape = _shape_arg(shape)
+
+    def _f(idx, upd):
+        z = jnp.zeros(shape, upd.dtype)
+        return z.at[tuple(jnp.moveaxis(idx, -1, 0))].add(upd)
+    return apply(_f, index, updates)
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    def _f(v, idx, upd):
+        return v.at[tuple(jnp.moveaxis(idx, -1, 0))].add(upd)
+    return apply(_f, x, index, updates)
+
+
+def index_select(x, index, axis=0, name=None):
+    return apply(lambda v, idx: jnp.take(v, idx, axis=_int(axis)), x, index)
+
+
+def index_sample(x, index, name=None):
+    return apply(lambda v, idx: jnp.take_along_axis(v, idx, axis=1), x, index)
+
+
+def index_add(x, index, axis, value, name=None):
+    def _f(v, idx, val):
+        return v.at[(slice(None),) * (axis % v.ndim) + (idx,)].add(val) \
+            if axis % v.ndim else v.at[idx].add(val)
+    return apply(_f, x, index, value)
+
+
+def index_put(x, indices, value, accumulate=False, name=None):
+    def _f(v, idx, val):
+        idx = tuple(i for i in idx)
+        return v.at[idx].add(val) if accumulate else v.at[idx].set(val)
+    return apply(_f, x, tuple(indices), value)
+
+
+def masked_select(x, mask, name=None):
+    # data-dependent output shape: eager-only (same restriction as reference
+    # static mode, which emits a dynamic-shape op)
+    v = np.asarray(x._value)
+    m = np.asarray(mask._value)
+    return apply(lambda a: a[np.broadcast_to(m, a.shape)], x)
+
+
+def masked_fill(x, mask, value, name=None):
+    return apply(lambda v, m, val: jnp.where(m, val, v), x, mask, value)
+
+
+def masked_scatter(x, mask, value, name=None):
+    v = np.asarray(x._value)
+    m = np.broadcast_to(np.asarray(mask._value), v.shape)
+    n = int(m.sum())
+
+    def _f(a, val):
+        flat_idx = jnp.nonzero(jnp.asarray(m).ravel(), size=n)[0]
+        return a.ravel().at[flat_idx].set(val.ravel()[:n]).reshape(a.shape)
+    return apply(_f, x, value)
+
+
+def slice(input, axes, starts, ends, name=None):  # noqa: A002
+    starts = [_int(s) for s in starts]
+    ends = [_int(e) for e in ends]
+
+    def _f(v):
+        sl = [builtins_slice(None)] * v.ndim
+        for a, s, e in zip(axes, starts, ends):
+            sl[a] = builtins_slice(s, e)
+        return v[tuple(sl)]
+    return apply(_f, input)
+
+
+def builtins_slice(*a):
+    import builtins
+
+    return builtins.slice(*a)
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    def _f(v):
+        sl = [builtins_slice(None)] * v.ndim
+        for a, s, e, st in zip(axes, starts, ends, strides):
+            sl[a] = builtins_slice(_int(s), _int(e), _int(st))
+        return v[tuple(sl)]
+    return apply(_f, x)
+
+
+def unbind(input, axis=0, name=None):  # noqa: A002
+    n = input._value.shape[axis]
+    return list(apply(lambda v: tuple(
+        jnp.squeeze(s, axis) for s in jnp.split(v, n, axis)), input))
+
+
+def unstack(x, axis=0, num=None, name=None):
+    return unbind(x, axis)
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False,
+           axis=None, dtype="int64", name=None):
+    v = np.asarray(x._value)
+    res = np.unique(v, return_index=return_index, return_inverse=return_inverse,
+                    return_counts=return_counts, axis=axis)
+    if not isinstance(res, tuple):
+        res = (res,)
+    out = [Tensor(jnp.asarray(r)) for r in res]
+    return out[0] if len(out) == 1 else tuple(out)
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None,
+                       dtype="int64", name=None):
+    v = np.asarray(x._value)
+    if axis is None:
+        v = v.ravel()
+        keep = np.concatenate([[True], v[1:] != v[:-1]])
+    else:
+        d = (np.abs(np.diff(v, axis=axis)).reshape(v.shape[axis] - 1, -1).sum(1)
+             if v.shape[axis] > 1 else np.array([]))
+        keep = np.concatenate([[True], d != 0])
+    idx = np.nonzero(keep)[0]
+    outs = [Tensor(jnp.asarray(np.take(v, idx, axis=axis if axis is not None else 0)))]
+    if return_inverse:
+        inv = np.cumsum(keep) - 1
+        outs.append(Tensor(jnp.asarray(inv.astype(np.int64))))
+    if return_counts:
+        counts = np.diff(np.append(idx, len(keep)))
+        outs.append(Tensor(jnp.asarray(counts.astype(np.int64))))
+    return outs[0] if len(outs) == 1 else tuple(outs)
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):  # noqa: A002
+    size = index_num // nshards
+
+    def _f(v):
+        in_shard = (v // size) == shard_id
+        return jnp.where(in_shard, v % size, ignore_value)
+    return apply(_f, input)
+
+
+def repeat_interleave(x, repeats, axis=None, name=None):
+    if isinstance(repeats, Tensor):
+        reps = np.asarray(repeats._value)
+        total = int(reps.sum())
+        return apply(lambda v: jnp.repeat(v, jnp.asarray(reps), axis=axis,
+                                          total_repeat_length=total), x)
+    return apply(lambda v: jnp.repeat(v, repeats, axis=axis), x)
+
+
+def as_complex(x, name=None):
+    return apply(lambda v: jax.lax.complex(v[..., 0], v[..., 1]), x)
+
+
+def as_real(x, name=None):
+    return apply(lambda v: jnp.stack([jnp.real(v), jnp.imag(v)], -1), x)
+
+
+def cast(x, dtype):
+    jd = dtypes.to_jax_dtype(dtype)
+
+    def _cast(v):
+        return v.astype(jd)
+    return apply(_cast, x)
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    shape = _shape_arg(shape)
+    offsets = [0] * len(shape) if offsets is None else [_int(o) for o in offsets]
+
+    def _f(v):
+        sl = tuple(builtins_slice(o, o + (s if s != -1 else v.shape[i] - o))
+                   for i, (o, s) in enumerate(zip(offsets, shape)))
+        return v[sl]
+    return apply(_f, x)
+
+
+def fill_diagonal_(x, value, offset=0, wrap=False, name=None):
+    v = x._value
+    n = builtins_min(v.shape[-2:]) if v.ndim >= 2 else 0
+    idx = jnp.arange(n - (offset if offset > 0 else 0))
+    x._value = v.at[..., idx + builtins_max(-offset, 0),
+                    idx + builtins_max(offset, 0)].set(value)
+    return x
+
+
+def builtins_min(it):
+    import builtins
+
+    return builtins.min(it)
+
+
+def builtins_max(*a):
+    import builtins
+
+    return builtins.max(*a)
+
+
+def put_along_axis(arr, indices, values, axis, reduce="assign", include_self=True,
+                   broadcast=True, name=None):
+    def _f(v, idx, val):
+        val = jnp.broadcast_to(val, idx.shape) if broadcast else val
+        if reduce == "add":
+            return jnp.put_along_axis(v, idx, val, axis=axis, inplace=False) \
+                if False else _put_add(v, idx, val, axis)
+        if reduce == "multiply" or reduce == "mul":
+            return _put_mul(v, idx, val, axis)
+        return jnp.put_along_axis(v, idx, val, axis=axis, inplace=False)
+    return apply(_f, arr, indices, values)
+
+
+def _along_axis_index(v, idx, axis):
+    axis = axis % v.ndim
+    ix = list(jnp.meshgrid(*[jnp.arange(s) for s in idx.shape], indexing="ij"))
+    ix[axis] = idx
+    return tuple(ix)
+
+
+def _put_add(v, idx, val, axis):
+    return v.at[_along_axis_index(v, idx, axis)].add(val)
+
+
+def _put_mul(v, idx, val, axis):
+    return v.at[_along_axis_index(v, idx, axis)].multiply(val)
+
+
+def take_along_axis(arr, indices, axis, broadcast=True, name=None):
+    def _f(v, idx):
+        if broadcast:
+            tgt = list(v.shape)
+            tgt[axis % v.ndim] = idx.shape[axis % v.ndim]
+            idx = jnp.broadcast_to(idx, tuple(tgt))
+        return jnp.take_along_axis(v, idx, axis=axis)
+    return apply(_f, arr, indices)
+
+
+def tensordot(x, y, axes=2, name=None):
+    if isinstance(axes, Tensor):
+        axes = np.asarray(axes._value).tolist()
+    return apply(lambda a, b: jnp.tensordot(a, b, axes), x, y)
+
+
+def real(x, name=None):
+    return apply(jnp.real, x)
+
+
+def imag(x, name=None):
+    return apply(jnp.imag, x)
+
+
+def numel(x, name=None):
+    return Tensor(jnp.asarray(x.size, jnp.int64))
+
+
+def rank(input, name=None):  # noqa: A002
+    return Tensor(jnp.asarray(input.ndim, jnp.int32))
+
+
+def atleast_1d(*inputs, name=None):
+    out = [apply(jnp.atleast_1d, x) for x in inputs]
+    return out[0] if len(out) == 1 else out
+
+
+def atleast_2d(*inputs, name=None):
+    out = [apply(jnp.atleast_2d, x) for x in inputs]
+    return out[0] if len(out) == 1 else out
+
+
+def atleast_3d(*inputs, name=None):
+    out = [apply(jnp.atleast_3d, x) for x in inputs]
+    return out[0] if len(out) == 1 else out
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    return apply(lambda v: jnp.diagonal(v, offset, axis1, axis2), x)
+
+
+def diagonal_scatter(x, y, offset=0, axis1=0, axis2=1, name=None):
+    def _f(v, src):
+        vm = jnp.moveaxis(jnp.moveaxis(v, axis1, -2), -1 if axis2 == axis1 else axis2, -1) \
+            if (axis1, axis2) != (0, 1) or v.ndim != 2 else v
+        n = src.shape[-1]
+        i = jnp.arange(n)
+        out = v.at[..., i + builtins_max(-offset, 0),
+                   i + builtins_max(offset, 0)].set(src) if (axis1 % v.ndim, axis2 % v.ndim) == (v.ndim - 2, v.ndim - 1) or v.ndim == 2 else None
+        if out is None:
+            raise NotImplementedError("diagonal_scatter on non-trailing axes")
+        return out
+    return apply(_f, x, y)
+
+
+def select_scatter(x, values, axis, index, name=None):
+    def _f(v, val):
+        sl = [builtins_slice(None)] * v.ndim
+        sl[axis % v.ndim] = index
+        return v.at[tuple(sl)].set(val)
+    return apply(_f, x, values)
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):  # noqa: A002
+    from ..nn import functional as F
+
+    return F.pad(x, pad, mode=mode, value=value, data_format=data_format)

@@ -1,0 +1,443 @@
+"""Math ops (reference: python/paddle/tensor/math.py, ~120 functions).
+
+Every op is a thin paddle-signature shim over a pure jnp/lax function routed
+through the autograd tape (`core.autograd.apply`). XLA jit-caches each
+op+shape+dtype combination, so eager dispatch replays compiled executables.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dtype as dtypes
+from ..core.autograd import apply
+from ..core.tensor import Tensor
+
+__all__ = [
+    "add", "subtract", "multiply", "divide", "floor_divide", "remainder",
+    "mod", "floor_mod", "pow", "scale", "sqrt", "rsqrt", "exp", "expm1",
+    "log", "log2", "log10", "log1p", "abs", "ceil", "floor", "round", "trunc",
+    "sin", "cos", "tan", "asin", "acos", "atan", "sinh", "cosh", "tanh",
+    "asinh", "acosh", "atanh", "atan2", "square", "sign", "sgn", "reciprocal",
+    "maximum", "minimum", "fmax", "fmin", "max", "min", "amax", "amin",
+    "sum", "nansum", "prod", "cumsum", "cumprod", "cummax", "cummin",
+    "logcumsumexp", "logsumexp", "clip", "isnan", "isinf", "isfinite",
+    "add_n", "stanh", "multiplex", "inner", "outer", "dot", "mm", "bmm",
+    "addmm", "kron", "gcd", "lcm", "erf", "erfinv", "lgamma", "digamma",
+    "neg", "lerp", "rad2deg", "deg2rad", "diff", "angle", "frac", "heaviside",
+    "trapezoid", "cumulative_trapezoid", "take", "increment", "multiply_",
+    "add_", "subtract_", "clip_", "scale_", "exp_", "sqrt_", "rsqrt_",
+    "reciprocal_", "round_", "ceil_", "floor_", "tanh_", "nan_to_num",
+    "count_nonzero", "broadcast_shape", "log_normal_", "hypot", "ldexp",
+    "copysign", "signbit", "isposinf", "isneginf", "isreal", "combinations",
+    "frexp", "i0", "i0e", "i1", "i1e", "polygamma", "gammaln", "gammainc",
+    "gammaincc", "sinc", "nextafter", "logaddexp",
+]
+
+
+def _axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, Tensor):
+        a = np.asarray(axis._value)
+        return tuple(int(v) for v in a.ravel()) if a.ndim else int(a)
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+def _binop(jfn):
+    def f(x, y, name=None):
+        return apply(jfn, x, y)
+    f.__name__ = jfn.__name__
+    return f
+
+
+def _unop(jfn):
+    def f(x, name=None):
+        return apply(jfn, x)
+    f.__name__ = jfn.__name__
+    return f
+
+
+add = _binop(jnp.add)
+subtract = _binop(jnp.subtract)
+multiply = _binop(jnp.multiply)
+maximum = _binop(jnp.maximum)
+minimum = _binop(jnp.minimum)
+fmax = _binop(jnp.fmax)
+fmin = _binop(jnp.fmin)
+atan2 = _binop(jnp.arctan2)
+kron = _binop(jnp.kron)
+gcd = _binop(jnp.gcd)
+lcm = _binop(jnp.lcm)
+heaviside = _binop(jnp.heaviside)
+hypot = _binop(jnp.hypot)
+ldexp = _binop(jnp.ldexp)
+copysign = _binop(jnp.copysign)
+nextafter = _binop(jnp.nextafter)
+logaddexp = _binop(jnp.logaddexp)
+
+sqrt = _unop(jnp.sqrt)
+rsqrt = _unop(jax.lax.rsqrt)
+exp = _unop(jnp.exp)
+expm1 = _unop(jnp.expm1)
+log = _unop(jnp.log)
+log2 = _unop(jnp.log2)
+log10 = _unop(jnp.log10)
+log1p = _unop(jnp.log1p)
+abs = _unop(jnp.abs)  # noqa: A001
+ceil = _unop(jnp.ceil)
+floor = _unop(jnp.floor)
+round = _unop(jnp.round)  # noqa: A001
+trunc = _unop(jnp.trunc)
+sin = _unop(jnp.sin)
+cos = _unop(jnp.cos)
+tan = _unop(jnp.tan)
+asin = _unop(jnp.arcsin)
+acos = _unop(jnp.arccos)
+atan = _unop(jnp.arctan)
+sinh = _unop(jnp.sinh)
+cosh = _unop(jnp.cosh)
+tanh = _unop(jnp.tanh)
+asinh = _unop(jnp.arcsinh)
+acosh = _unop(jnp.arccosh)
+atanh = _unop(jnp.arctanh)
+square = _unop(jnp.square)
+sign = _unop(jnp.sign)
+reciprocal = _unop(jnp.reciprocal)
+isnan = _unop(jnp.isnan)
+isinf = _unop(jnp.isinf)
+isfinite = _unop(jnp.isfinite)
+neg = _unop(jnp.negative)
+rad2deg = _unop(jnp.rad2deg)
+deg2rad = _unop(jnp.deg2rad)
+angle = _unop(jnp.angle)
+erf = _unop(jax.scipy.special.erf)
+erfinv = _unop(jax.scipy.special.erfinv)
+lgamma = _unop(jax.scipy.special.gammaln)
+gammaln = _unop(jax.scipy.special.gammaln)
+digamma = _unop(jax.scipy.special.digamma)
+i0 = _unop(jax.scipy.special.i0)
+i0e = _unop(jax.scipy.special.i0e)
+i1 = _unop(jax.scipy.special.i1)
+i1e = _unop(jax.scipy.special.i1e)
+sinc = _unop(jnp.sinc)
+signbit = _unop(jnp.signbit)
+isposinf = _unop(jnp.isposinf)
+isneginf = _unop(jnp.isneginf)
+isreal = _unop(jnp.isreal)
+
+
+def divide(x, y, name=None):
+    def _div(a, b):
+        if jnp.issubdtype(jnp.result_type(a, b), jnp.integer):
+            return jnp.floor_divide(a, b)
+        return jnp.true_divide(a, b)
+    return apply(_div, x, y)
+
+
+def floor_divide(x, y, name=None):
+    return apply(jnp.floor_divide, x, y)
+
+
+def remainder(x, y, name=None):
+    return apply(jnp.remainder, x, y)
+
+
+mod = remainder
+floor_mod = remainder
+
+
+def pow(x, y, name=None):  # noqa: A001
+    return apply(jnp.power, x, y)
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    s = scale._value if isinstance(scale, Tensor) else scale
+
+    def _scale(v):
+        out = v * s + bias if bias_after_scale else (v + bias) * s
+        return out.astype(v.dtype)
+    return apply(_scale, x)
+
+
+def sgn(x, name=None):
+    def _sgn(v):
+        if jnp.issubdtype(v.dtype, jnp.complexfloating):
+            m = jnp.abs(v)
+            return jnp.where(m == 0, 0, v / jnp.where(m == 0, 1, m))
+        return jnp.sign(v)
+    return apply(_sgn, x)
+
+
+def max(x, axis=None, keepdim=False, name=None):  # noqa: A001
+    return apply(lambda v: jnp.max(v, axis=_axis(axis), keepdims=keepdim), x)
+
+
+def min(x, axis=None, keepdim=False, name=None):  # noqa: A001
+    return apply(lambda v: jnp.min(v, axis=_axis(axis), keepdims=keepdim), x)
+
+
+amax = max
+amin = min
+
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):  # noqa: A001
+    jd = dtypes.to_jax_dtype(dtype) if dtype is not None else None
+    return apply(lambda v: jnp.sum(v, axis=_axis(axis), dtype=jd,
+                                   keepdims=keepdim), x)
+
+
+def nansum(x, axis=None, dtype=None, keepdim=False, name=None):
+    jd = dtypes.to_jax_dtype(dtype) if dtype is not None else None
+    return apply(lambda v: jnp.nansum(v, axis=_axis(axis), dtype=jd,
+                                      keepdims=keepdim), x)
+
+
+def prod(x, axis=None, keepdim=False, dtype=None, name=None):
+    jd = dtypes.to_jax_dtype(dtype) if dtype is not None else None
+    return apply(lambda v: jnp.prod(v, axis=_axis(axis), dtype=jd,
+                                    keepdims=keepdim), x)
+
+
+def cumsum(x, axis=None, dtype=None, name=None):
+    jd = dtypes.to_jax_dtype(dtype) if dtype is not None else None
+    return apply(lambda v: jnp.cumsum(v.ravel() if axis is None else v,
+                                      axis=None if axis is None else _axis(axis),
+                                      dtype=jd), x)
+
+
+def cumprod(x, dim=None, dtype=None, name=None):
+    jd = dtypes.to_jax_dtype(dtype) if dtype is not None else None
+    return apply(lambda v: jnp.cumprod(v, axis=_axis(dim), dtype=jd), x)
+
+
+def cummax(x, axis=None, dtype="int64", name=None):
+    def _f(v):
+        a = _axis(axis)
+        vv = v.ravel() if a is None else v
+        a = 0 if a is None else a
+        out = jax.lax.cummax(vv, axis=a)
+        idx = jnp.broadcast_to(jnp.arange(vv.shape[a]).reshape(
+            [-1 if i == (a % vv.ndim) else 1 for i in range(vv.ndim)]), vv.shape)
+        # index of running max: argmax over prefix — use cummax of (value, idx)
+        eq = vv == out
+        run_idx = jax.lax.cummax(jnp.where(eq, idx, -1), axis=a)
+        return out, run_idx.astype(dtypes.to_jax_dtype(dtype))
+    return apply(_f, x)
+
+
+def cummin(x, axis=None, dtype="int64", name=None):
+    def _f(v):
+        a = _axis(axis)
+        vv = v.ravel() if a is None else v
+        a = 0 if a is None else a
+        out = jax.lax.cummin(vv, axis=a)
+        idx = jnp.broadcast_to(jnp.arange(vv.shape[a]).reshape(
+            [-1 if i == (a % vv.ndim) else 1 for i in range(vv.ndim)]), vv.shape)
+        eq = vv == out
+        run_idx = jax.lax.cummax(jnp.where(eq, idx, -1), axis=a)
+        return out, run_idx.astype(dtypes.to_jax_dtype(dtype))
+    return apply(_f, x)
+
+
+def logcumsumexp(x, axis=None, dtype=None, name=None):
+    def _f(v):
+        a = _axis(axis)
+        vv = v.ravel() if a is None else v
+        a = 0 if a is None else a
+        return jax.lax.associative_scan(jnp.logaddexp, vv, axis=a)
+    return apply(_f, x)
+
+
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    return apply(lambda v: jax.scipy.special.logsumexp(
+        v, axis=_axis(axis), keepdims=keepdim), x)
+
+
+def clip(x, min=None, max=None, name=None):  # noqa: A002
+    lo = min._value if isinstance(min, Tensor) else min
+    hi = max._value if isinstance(max, Tensor) else max
+    return apply(lambda v: jnp.clip(v, lo, hi), x)
+
+
+def add_n(inputs, name=None):
+    if isinstance(inputs, Tensor):
+        inputs = [inputs]
+    return apply(lambda xs: sum_list(xs), list(inputs))
+
+
+def sum_list(xs):
+    out = xs[0]
+    for v in xs[1:]:
+        out = out + v
+    return out
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return apply(lambda v: scale_b * jnp.tanh(scale_a * v), x)
+
+
+def multiplex(inputs, index, name=None):
+    return apply(lambda xs, idx: jnp.stack(xs, 0)[
+        idx.ravel().astype(jnp.int32), jnp.arange(xs[0].shape[0])],
+        list(inputs), index)
+
+
+def inner(x, y, name=None):
+    return apply(jnp.inner, x, y)
+
+
+def outer(x, y, name=None):
+    return apply(lambda a, b: jnp.outer(a, b), x, y)
+
+
+def dot(x, y, name=None):
+    return apply(lambda a, b: jnp.sum(a * b, axis=-1), x, y)
+
+
+def mm(input, mat2, name=None):  # noqa: A002
+    return apply(jnp.matmul, input, mat2)
+
+
+def bmm(x, y, name=None):
+    return apply(jnp.matmul, x, y)
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):  # noqa: A002
+    return apply(lambda i, a, b: beta * i + alpha * (a @ b), input, x, y)
+
+
+def lerp(x, y, weight, name=None):
+    return apply(lambda a, b, w: a + w * (b - a), x, y, weight)
+
+
+def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
+    def _f(v, pre, app):
+        return jnp.diff(v, n=n, axis=axis, prepend=pre, append=app)
+    return apply(_f, x, prepend, append)
+
+
+def frac(x, name=None):
+    return apply(lambda v: v - jnp.trunc(v), x)
+
+
+def trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    def _f(yv, xv):
+        return jnp.trapezoid(yv, xv, dx=1.0 if dx is None else dx, axis=axis)
+    return apply(_f, y, x)
+
+
+def cumulative_trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    def _f(yv, xv):
+        d = dx if dx is not None else 1.0
+        sl1 = [slice(None)] * yv.ndim
+        sl2 = [slice(None)] * yv.ndim
+        sl1[axis] = slice(1, None)
+        sl2[axis] = slice(None, -1)
+        if xv is not None:
+            d = jnp.diff(xv, axis=axis) if xv.ndim > 1 else jnp.diff(xv)
+            if xv.ndim == 1:
+                shape = [1] * yv.ndim
+                shape[axis] = -1
+                d = d.reshape(shape)
+        avg = (yv[tuple(sl1)] + yv[tuple(sl2)]) / 2.0
+        return jnp.cumsum(avg * d, axis=axis)
+    return apply(_f, y, x)
+
+
+def take(x, index, mode="raise", name=None):
+    def _f(v, idx):
+        flat = v.ravel()
+        i = idx.ravel()
+        n = flat.shape[0]
+        if mode == "wrap":
+            i = i % n
+        elif mode == "clip":
+            i = jnp.clip(i, 0, n - 1)
+        else:
+            i = jnp.where(i < 0, i + n, i)
+        return flat[i].reshape(idx.shape)
+    return apply(_f, x, index)
+
+
+def increment(x, value=1.0, name=None):
+    x._value = x._value + value
+    return x
+
+
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
+    return apply(lambda v: jnp.nan_to_num(v, nan=nan, posinf=posinf,
+                                          neginf=neginf), x)
+
+
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    return apply(lambda v: jnp.count_nonzero(v, axis=_axis(axis),
+                                             keepdims=keepdim).astype(jnp.int64), x)
+
+
+def broadcast_shape(x_shape, y_shape):
+    return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+def polygamma(x, n, name=None):
+    return apply(lambda v: jax.scipy.special.polygamma(n, v), x)
+
+
+def gammainc(x, y, name=None):
+    return apply(jax.scipy.special.gammainc, x, y)
+
+
+def gammaincc(x, y, name=None):
+    return apply(jax.scipy.special.gammaincc, x, y)
+
+
+def frexp(x, name=None):
+    return apply(jnp.frexp, x)
+
+
+def combinations(x, r=2, with_replacement=False, name=None):
+    import itertools
+
+    n = x._value.shape[0]
+    gen = itertools.combinations_with_replacement if with_replacement \
+        else itertools.combinations
+    idx = np.array(list(gen(range(n), r)), dtype=np.int32).reshape(-1, r)
+    return apply(lambda v: v[idx], x)
+
+
+def log_normal_(x, mean=1.0, std=2.0, name=None):
+    from ..framework import random as rnd
+
+    g = jax.random.normal(rnd.next_key(), x._value.shape, jnp.float32)
+    x._value = jnp.exp(mean + std * g).astype(x._value.dtype)
+    return x
+
+
+# ---- in-place variants (eager convenience; value replacement) -------------
+def _inplace(fn):
+    def f(x, *args, **kwargs):
+        out = fn(x, *args, **kwargs)
+        x._value = out._value
+        x._node, x._out_idx = out._node, out._out_idx
+        x.stop_gradient = out.stop_gradient
+        return x
+    f.__name__ = fn.__name__ + "_"
+    return f
+
+
+add_ = _inplace(add)
+subtract_ = _inplace(subtract)
+multiply_ = _inplace(multiply)
+clip_ = _inplace(clip)
+scale_ = _inplace(scale)
+exp_ = _inplace(exp)
+sqrt_ = _inplace(sqrt)
+rsqrt_ = _inplace(rsqrt)
+reciprocal_ = _inplace(reciprocal)
+round_ = _inplace(round)
+ceil_ = _inplace(ceil)
+floor_ = _inplace(floor)
+tanh_ = _inplace(tanh)

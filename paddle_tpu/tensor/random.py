@@ -1,0 +1,166 @@
+"""Random ops (reference: python/paddle/tensor/random.py).
+
+All draws go through the functional PRNG (framework/random.py): eager calls
+split the global key; jit-traced code (hapi/static/jit.to_static) sees draws
+derived from a per-step scope key, keeping compiled programs pure.
+TPU note: jax.random lowers to the on-chip PRNG (threefry) — vectorized,
+reproducible, no host round-trip.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dtype as dtypes
+from ..core.autograd import apply
+from ..core.tensor import Tensor
+from ..framework import random as rnd
+
+__all__ = [
+    "uniform", "uniform_", "normal", "normal_", "gauss", "randn", "rand",
+    "randint", "randint_like", "randperm", "multinomial", "bernoulli",
+    "bernoulli_", "poisson", "standard_normal", "standard_gamma",
+    "exponential_", "binomial", "randn_like", "rand_like",
+]
+
+
+def _jd(d):
+    return dtypes.to_jax_dtype(d if d is not None else dtypes.get_default_dtype())
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in np.asarray(shape._value))
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(s._value) if isinstance(s, Tensor) else int(s) for s in shape)
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):  # noqa: A002
+    key = jax.random.PRNGKey(seed) if seed else rnd.next_key()
+    lo = min._value if isinstance(min, Tensor) else min
+    hi = max._value if isinstance(max, Tensor) else max
+    return Tensor(jax.random.uniform(key, _shape(shape), _jd(dtype), lo, hi))
+
+
+def uniform_(x, min=-1.0, max=1.0, seed=0, name=None):  # noqa: A002
+    x._value = jax.random.uniform(
+        jax.random.PRNGKey(seed) if seed else rnd.next_key(),
+        x._value.shape, x._value.dtype, min, max)
+    return x
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m = mean._value if isinstance(mean, Tensor) else mean
+        s = std._value if isinstance(std, Tensor) else std
+        out_shape = jnp.broadcast_shapes(
+            jnp.shape(m), jnp.shape(s)) if shape is None else _shape(shape)
+        g = jax.random.normal(rnd.next_key(), out_shape,
+                              _jd(dtypes.get_default_dtype()))
+        return Tensor(m + s * g)
+    out_shape = _shape(shape) if shape is not None else ()
+    g = jax.random.normal(rnd.next_key(), out_shape, _jd(None))
+    return Tensor(mean + std * g)
+
+
+gauss = normal
+
+
+def normal_(x, mean=0.0, std=1.0, name=None):
+    g = jax.random.normal(rnd.next_key(), x._value.shape, jnp.float32)
+    x._value = (mean + std * g).astype(x._value.dtype)
+    return x
+
+
+def standard_normal(shape, dtype=None, name=None):
+    return Tensor(jax.random.normal(rnd.next_key(), _shape(shape), _jd(dtype)))
+
+
+def randn(shape, dtype=None, name=None):
+    return standard_normal(shape, dtype)
+
+
+def randn_like(x, dtype=None, name=None):
+    d = _jd(dtype) if dtype is not None else x._value.dtype
+    return Tensor(jax.random.normal(rnd.next_key(), x._value.shape, d))
+
+
+def rand(shape, dtype=None, name=None):
+    return Tensor(jax.random.uniform(rnd.next_key(), _shape(shape), _jd(dtype)))
+
+
+def rand_like(x, dtype=None, name=None):
+    d = _jd(dtype) if dtype is not None else x._value.dtype
+    return Tensor(jax.random.uniform(rnd.next_key(), x._value.shape, d))
+
+
+def randint(low=0, high=None, shape=(1,), dtype="int64", name=None):
+    if high is None:
+        low, high = 0, low
+    return Tensor(jax.random.randint(rnd.next_key(), _shape(shape), low, high,
+                                     dtypes.to_jax_dtype(dtype)))
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None):
+    if high is None:
+        low, high = 0, low
+    d = dtypes.to_jax_dtype(dtype) if dtype is not None else x._value.dtype
+    return Tensor(jax.random.randint(rnd.next_key(), x._value.shape, low, high, d))
+
+
+def randperm(n, dtype="int64", name=None):
+    return Tensor(jax.random.permutation(rnd.next_key(), n).astype(
+        dtypes.to_jax_dtype(dtype)))
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    key = rnd.next_key()
+
+    def _f(v):
+        logp = jnp.log(v / jnp.sum(v, -1, keepdims=True))
+        if replacement:
+            return jax.random.categorical(key, logp, axis=-1,
+                                          shape=(num_samples,) + v.shape[:-1]
+                                          ).swapaxes(0, -1) if v.ndim > 1 else \
+                jax.random.categorical(key, logp, shape=(num_samples,))
+        # without replacement: gumbel top-k
+        g = jax.random.gumbel(key, v.shape)
+        return jax.lax.top_k(logp + g, num_samples)[1]
+    out = apply(lambda v: _f(v).astype(jnp.int64), x)
+    out.stop_gradient = True
+    return out
+
+
+def bernoulli(x, name=None):
+    key = rnd.next_key()
+    return Tensor(jax.random.bernoulli(key, x._value).astype(x._value.dtype))
+
+
+def bernoulli_(x, p=0.5, name=None):
+    x._value = jax.random.bernoulli(rnd.next_key(), p, x._value.shape).astype(
+        x._value.dtype)
+    return x
+
+
+def poisson(x, name=None):
+    return Tensor(jax.random.poisson(rnd.next_key(), x._value).astype(
+        x._value.dtype))
+
+
+def binomial(count, prob, name=None):
+    c = count._value if isinstance(count, Tensor) else count
+    p = prob._value if isinstance(prob, Tensor) else prob
+    return Tensor(jax.random.binomial(rnd.next_key(), c, p).astype(jnp.int64))
+
+
+def standard_gamma(x, name=None):
+    return Tensor(jax.random.gamma(rnd.next_key(), x._value).astype(
+        x._value.dtype))
+
+
+def exponential_(x, lam=1.0, name=None):
+    e = jax.random.exponential(rnd.next_key(), x._value.shape, jnp.float32)
+    x._value = (e / lam).astype(x._value.dtype)
+    return x

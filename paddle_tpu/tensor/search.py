@@ -1,0 +1,135 @@
+"""Search/sort ops (reference: python/paddle/tensor/search.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dtype as dtypes
+from ..core.autograd import apply
+from ..core.tensor import Tensor
+
+__all__ = [
+    "argmax", "argmin", "argsort", "sort", "topk", "where", "nonzero",
+    "kthvalue", "mode", "searchsorted", "index_select", "masked_select",
+    "bucketize",
+]
+
+from .manipulation import index_select, masked_select  # re-export (paddle puts them here too)
+
+
+def _axis(a):
+    return int(a._value) if isinstance(a, Tensor) else (None if a is None else int(a))
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    jd = dtypes.to_jax_dtype(dtype)
+    return apply(lambda v: jnp.argmax(v, axis=_axis(axis),
+                                      keepdims=keepdim).astype(jd), x)
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    jd = dtypes.to_jax_dtype(dtype)
+    return apply(lambda v: jnp.argmin(v, axis=_axis(axis),
+                                      keepdims=keepdim).astype(jd), x)
+
+
+def argsort(x, axis=-1, descending=False, stable=False, name=None):
+    def _f(v):
+        idx = jnp.argsort(v, axis=_axis(axis), stable=True)
+        return jnp.flip(idx, axis=_axis(axis)) if descending else idx
+    return apply(_f, x)
+
+
+def sort(x, axis=-1, descending=False, stable=False, name=None):
+    def _f(v):
+        out = jnp.sort(v, axis=_axis(axis), stable=True)
+        return jnp.flip(out, axis=_axis(axis)) if descending else out
+    return apply(_f, x)
+
+
+def topk(x, k, axis=None, largest=True, sorted=True, name=None):  # noqa: A002
+    import jax
+
+    k = int(k._value) if isinstance(k, Tensor) else int(k)
+
+    def _f(v):
+        a = _axis(axis)
+        a = v.ndim - 1 if a is None else a % v.ndim
+        vm = jnp.moveaxis(v, a, -1)
+        if largest:
+            vals, idx = jax.lax.top_k(vm, k)
+        else:
+            vals, idx = jax.lax.top_k(-vm, k)
+            vals = -vals
+        return (jnp.moveaxis(vals, -1, a),
+                jnp.moveaxis(idx, -1, a).astype(jnp.int64))
+    return apply(_f, x)
+
+
+def where(condition, x=None, y=None, name=None):
+    if x is None and y is None:
+        return nonzero(condition, as_tuple=True)
+    return apply(lambda c, a, b: jnp.where(c, a, b), condition, x, y)
+
+
+def nonzero(x, as_tuple=False):
+    # data-dependent shape: eager-only, mirrors dynamic-shape op in reference
+    v = np.asarray(x._value)
+    nz = np.nonzero(v)
+    if as_tuple:
+        return tuple(Tensor(jnp.asarray(i[:, None].astype(np.int64))) for i in nz)
+    return Tensor(jnp.asarray(np.stack(nz, -1).astype(np.int64))) if nz[0].size \
+        else Tensor(jnp.zeros((0, v.ndim), jnp.int64))
+
+
+def kthvalue(x, k, axis=None, keepdim=False, name=None):
+    def _f(v):
+        a = v.ndim - 1 if axis is None else _axis(axis) % v.ndim
+        s = jnp.sort(v, axis=a)
+        si = jnp.argsort(v, axis=a)
+        vals = jnp.take(s, k - 1, axis=a)
+        idx = jnp.take(si, k - 1, axis=a)
+        if keepdim:
+            vals, idx = jnp.expand_dims(vals, a), jnp.expand_dims(idx, a)
+        return vals, idx.astype(jnp.int64)
+    return apply(_f, x)
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    v = np.asarray(x._value)
+    a = _axis(axis) % v.ndim
+    vm = np.moveaxis(v, a, -1)
+    flat = vm.reshape(-1, vm.shape[-1])
+    vals = np.empty(flat.shape[0], v.dtype)
+    idxs = np.empty(flat.shape[0], np.int64)
+    for i, row in enumerate(flat):
+        uniq, counts = np.unique(row, return_counts=True)
+        best = uniq[len(uniq) - 1 - np.argmax(counts[::-1])]
+        vals[i] = best
+        idxs[i] = np.where(row == best)[0][-1]
+    out_shape = vm.shape[:-1]
+    vals, idxs = vals.reshape(out_shape), idxs.reshape(out_shape)
+    if keepdim:
+        vals, idxs = np.expand_dims(vals, a), np.expand_dims(idxs, a)
+    return Tensor(jnp.asarray(vals)), Tensor(jnp.asarray(idxs))
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False, name=None):
+    side = "right" if right else "left"
+    jd = jnp.int32 if out_int32 else jnp.int64
+
+    def _f(seq, val):
+        if seq.ndim == 1:
+            return jnp.searchsorted(seq, val, side=side).astype(jd)
+        import jax
+
+        flat_seq = seq.reshape(-1, seq.shape[-1])
+        flat_val = val.reshape(-1, val.shape[-1])
+        out = jax.vmap(lambda s, q: jnp.searchsorted(s, q, side=side))(
+            flat_seq, flat_val)
+        return out.reshape(val.shape).astype(jd)
+    return apply(_f, sorted_sequence, values)
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    return searchsorted(sorted_sequence, x, out_int32, right)

@@ -1,0 +1,126 @@
+"""Statistics ops (reference: python/paddle/tensor/stat.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.autograd import apply
+from ..core.tensor import Tensor
+
+__all__ = ["mean", "std", "var", "median", "nanmedian", "nanmean", "quantile",
+           "nanquantile", "numel", "histogram", "histogramdd", "bincount",
+           "corrcoef", "cov"]
+
+from .manipulation import numel  # noqa: F401  (paddle exposes numel here too)
+
+
+def _axis(a):
+    if a is None:
+        return None
+    if isinstance(a, (list, tuple)):
+        return tuple(int(x) for x in a)
+    return int(a)
+
+
+def mean(x, axis=None, keepdim=False, name=None):
+    return apply(lambda v: jnp.mean(v, axis=_axis(axis), keepdims=keepdim), x)
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return apply(lambda v: jnp.std(v, axis=_axis(axis), ddof=1 if unbiased else 0,
+                                   keepdims=keepdim), x)
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return apply(lambda v: jnp.var(v, axis=_axis(axis), ddof=1 if unbiased else 0,
+                                   keepdims=keepdim), x)
+
+
+def median(x, axis=None, keepdim=False, mode="avg", name=None):
+    def _f(v):
+        if mode == "avg":
+            return jnp.median(v, axis=_axis(axis), keepdims=keepdim)
+        a = _axis(axis)
+        if a is None:
+            flat = v.ravel()
+            n = flat.shape[0]
+            s = jnp.sort(flat)
+            si = jnp.argsort(flat)
+            return s[(n - 1) // 2], si[(n - 1) // 2].astype(jnp.int64)
+        s = jnp.sort(v, axis=a)
+        si = jnp.argsort(v, axis=a)
+        k = (v.shape[a] - 1) // 2
+        vals = jnp.take(s, k, axis=a)
+        idx = jnp.take(si, k, axis=a).astype(jnp.int64)
+        if keepdim:
+            vals, idx = jnp.expand_dims(vals, a), jnp.expand_dims(idx, a)
+        return vals, idx
+    return apply(_f, x)
+
+
+def nanmedian(x, axis=None, keepdim=False, mode="avg", name=None):
+    return apply(lambda v: jnp.nanmedian(v, axis=_axis(axis), keepdims=keepdim), x)
+
+
+def nanmean(x, axis=None, keepdim=False, name=None):
+    return apply(lambda v: jnp.nanmean(v, axis=_axis(axis), keepdims=keepdim), x)
+
+
+def quantile(x, q, axis=None, keepdim=False, interpolation="linear", name=None):
+    qv = q._value if isinstance(q, Tensor) else jnp.asarray(q)
+    return apply(lambda v: jnp.quantile(v, qv, axis=_axis(axis), keepdims=keepdim,
+                                        method=interpolation), x)
+
+
+def nanquantile(x, q, axis=None, keepdim=False, interpolation="linear", name=None):
+    qv = q._value if isinstance(q, Tensor) else jnp.asarray(q)
+    return apply(lambda v: jnp.nanquantile(v, qv, axis=_axis(axis),
+                                           keepdims=keepdim,
+                                           method=interpolation), x)
+
+
+def histogram(input, bins=100, min=0, max=0, weight=None, density=False,  # noqa: A002
+              name=None):
+    v = np.asarray(input._value)
+    lo, hi = (float(min), float(max))
+    if lo == 0 and hi == 0:
+        lo, hi = float(v.min()), float(v.max())
+    w = np.asarray(weight._value) if weight is not None else None
+    hist, _ = np.histogram(v, bins=bins, range=(lo, hi), weights=w,
+                           density=density)
+    return Tensor(jnp.asarray(hist if density or w is not None
+                              else hist.astype(np.int64)))
+
+
+def histogramdd(x, bins=10, ranges=None, density=False, weights=None, name=None):
+    v = np.asarray(x._value)
+    w = np.asarray(weights._value) if weights is not None else None
+    hist, edges = np.histogramdd(v, bins=bins, range=ranges, density=density,
+                                 weights=w)
+    return Tensor(jnp.asarray(hist)), [Tensor(jnp.asarray(e)) for e in edges]
+
+
+def bincount(x, weights=None, minlength=0, name=None):
+    v = np.asarray(x._value)
+    length = int(builtins_max(v.max(initial=-1) + 1, minlength))
+
+    def _f(xs, w):
+        return jnp.bincount(xs, w, length=length)
+    return apply(_f, x, weights)
+
+
+def builtins_max(*a):
+    import builtins
+
+    return builtins.max(*a)
+
+
+def corrcoef(x, rowvar=True, name=None):
+    return apply(lambda v: jnp.corrcoef(v, rowvar=rowvar), x)
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    def _f(v, fw, aw):
+        return jnp.cov(v, rowvar=rowvar, ddof=1 if ddof else 0, fweights=fw,
+                       aweights=aw)
+    return apply(_f, x, fweights, aweights)

@@ -1,0 +1,30 @@
+"""Test harness: CPU-hosted virtual 8-device mesh (SURVEY §4).
+
+The image's sitecustomize imports jax and registers the axon TPU plugin at
+interpreter start, so JAX_PLATFORMS in os.environ is already baked into
+jax.config by the time conftest runs — override via jax.config.update before
+any backend initializes.
+"""
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _seeded():
+    import paddle_tpu as paddle
+
+    paddle.seed(102)
+    np.random.seed(102)
+    yield

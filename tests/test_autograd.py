@@ -1,0 +1,177 @@
+"""Autograd tape tests (reference: unittests test_imperative_*.py,
+test_grad.py, test_double_grad.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def t(a, sg=False):
+    return paddle.to_tensor(a, stop_gradient=sg)
+
+
+def test_backward_simple():
+    x = t([1.0, 2.0, 3.0])
+    y = (x * x).sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2, 4, 6])
+
+
+def test_chain_and_branching():
+    x = t([[1.0, 2.0], [3.0, 4.0]])
+    a = x * 2
+    b = x + 1
+    y = (a * b).sum()
+    y.backward()
+    # d/dx [2x(x+1)] = 4x + 2
+    np.testing.assert_allclose(x.grad.numpy(), 4 * x.numpy() + 2)
+
+
+def test_grad_accumulation_and_clear():
+    x = t([1.0, 2.0])
+    x.sum().backward()
+    x.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2, 2])
+    x.clear_grad()
+    assert x.grad is None
+
+
+def test_no_grad_context_and_decorator():
+    x = t([1.0])
+    with paddle.no_grad():
+        y = x * 2
+    assert y.stop_gradient
+
+    @paddle.no_grad()
+    def f(v):
+        return v * 3
+
+    assert f(x).stop_gradient
+    y2 = x * 2
+    assert not y2.stop_gradient
+
+
+def test_stop_gradient_blocks():
+    x = t([1.0, 2.0])
+    y = t([3.0, 4.0], sg=True)
+    out = (x * y).sum()
+    out.backward()
+    np.testing.assert_allclose(x.grad.numpy(), y.numpy())
+    assert y.grad is None
+
+
+def test_detach():
+    x = t([2.0])
+    y = x * 3
+    z = y.detach() * 2
+    assert z.stop_gradient
+    (y * 1.0).backward()
+    np.testing.assert_allclose(x.grad.numpy(), [3.0])
+
+
+def test_paddle_grad():
+    x = t([3.0])
+    y = x * x
+    (gx,) = paddle.grad(y, x)
+    np.testing.assert_allclose(gx.numpy(), [6.0])
+    assert x.grad is None  # paddle.grad must not touch .grad
+
+
+def test_double_grad():
+    x = t(2.0)
+    y = x ** 4
+    (g1,) = paddle.grad(y, x, create_graph=True)
+    np.testing.assert_allclose(g1.numpy(), 32.0)  # 4x^3
+    (g2,) = paddle.grad(g1, x, create_graph=True)
+    np.testing.assert_allclose(g2.numpy(), 48.0)  # 12x^2
+    (g3,) = paddle.grad(g2, x)
+    np.testing.assert_allclose(g3.numpy(), 48.0)  # 24x
+
+
+def test_retain_graph():
+    x = t([1.0, 2.0])
+    y = (x * x).sum()
+    y.backward(retain_graph=True)
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [4.0, 8.0])
+    z = (x * x).sum()
+    z.backward()
+    with pytest.raises(RuntimeError):
+        z.backward()
+
+
+def test_backward_with_grad_tensor():
+    x = t([[1.0, 2.0]])
+    y = x * 3
+    y.backward(paddle.to_tensor([[1.0, 10.0]]))
+    np.testing.assert_allclose(x.grad.numpy(), [[3.0, 30.0]])
+
+
+def test_multi_output_op_grad():
+    x = t(np.arange(6, dtype=np.float32).reshape(2, 3))
+    a, b, c = paddle.split(x, 3, axis=1)
+    (a.sum() + 2 * b.sum() + 3 * c.sum()).backward()
+    np.testing.assert_allclose(x.grad.numpy(), [[1, 2, 3], [1, 2, 3]])
+    vals, idx = paddle.topk(x, 2, axis=1)
+    vals.sum().backward()
+    # topk picks columns 2,1 per row
+    assert x.grad is not None
+
+
+def test_matmul_grad_vs_jax():
+    import jax
+
+    a = np.random.rand(3, 4).astype(np.float32)
+    b = np.random.rand(4, 2).astype(np.float32)
+    xa, xb = t(a), t(b)
+    paddle.matmul(xa, xb).sum().backward()
+    ga = jax.grad(lambda u: (u @ b).sum())(a)
+    gb = jax.grad(lambda u: (a @ u).sum())(b)
+    np.testing.assert_allclose(xa.grad.numpy(), ga, rtol=1e-5)
+    np.testing.assert_allclose(xb.grad.numpy(), gb, rtol=1e-5)
+
+
+def test_getitem_grad():
+    x = t(np.ones((4, 4), np.float32))
+    y = x[1:3, :2].sum()
+    y.backward()
+    ex = np.zeros((4, 4))
+    ex[1:3, :2] = 1
+    np.testing.assert_allclose(x.grad.numpy(), ex)
+
+
+def test_broadcast_grad():
+    x = t(np.ones((3, 1), np.float32))
+    y = t(np.ones((1, 4), np.float32))
+    (x + y).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), np.full((3, 1), 4.0))
+    np.testing.assert_allclose(y.grad.numpy(), np.full((1, 4), 3.0))
+
+
+def test_pylayer():
+    from paddle_tpu.autograd import PyLayer
+
+    class Cube(PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            ctx.save_for_backward(x)
+            return x * x * x
+
+        @staticmethod
+        def backward(ctx, gy):
+            (x,) = ctx.saved_tensor
+            return gy * 3 * x.detach() * x.detach()
+
+    x = t(2.0)
+    y = Cube.apply(x)
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), 12.0)
+
+
+def test_deep_graph():
+    x = t(1.0)
+    y = x
+    for _ in range(300):
+        y = y * 1.01
+    y.backward()
+    assert x.grad is not None

@@ -1,0 +1,233 @@
+"""Op numerics vs numpy (reference test model: OpTest in
+python/paddle/fluid/tests/unittests/op_test.py — fwd vs numpy, grad vs
+analytic/numeric)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def t(a, sg=True):
+    return paddle.to_tensor(a, stop_gradient=sg)
+
+
+def test_elementwise_binary():
+    a = np.random.rand(3, 4).astype(np.float32) + 0.5
+    b = np.random.rand(3, 4).astype(np.float32) + 0.5
+    for pf, nf in [
+        (paddle.add, np.add), (paddle.subtract, np.subtract),
+        (paddle.multiply, np.multiply), (paddle.divide, np.divide),
+        (paddle.maximum, np.maximum), (paddle.minimum, np.minimum),
+        (paddle.pow, np.power), (paddle.atan2, np.arctan2),
+        (paddle.remainder, np.remainder),
+    ]:
+        np.testing.assert_allclose(pf(t(a), t(b)).numpy(), nf(a, b), rtol=1e-5)
+
+
+def test_unary():
+    a = np.random.rand(3, 4).astype(np.float32) * 0.8 + 0.1
+    for pf, nf in [
+        (paddle.sqrt, np.sqrt), (paddle.exp, np.exp), (paddle.log, np.log),
+        (paddle.sin, np.sin), (paddle.cos, np.cos), (paddle.tanh, np.tanh),
+        (paddle.abs, np.abs), (paddle.floor, np.floor), (paddle.ceil, np.ceil),
+        (paddle.square, np.square), (paddle.log1p, np.log1p),
+        (paddle.expm1, np.expm1), (paddle.asin, np.arcsin),
+        (paddle.acos, np.arccos), (paddle.atan, np.arctan),
+    ]:
+        np.testing.assert_allclose(pf(t(a)).numpy(), nf(a), rtol=1e-3, atol=1e-5)
+
+
+def test_reductions():
+    a = np.random.rand(2, 3, 4).astype(np.float32)
+    np.testing.assert_allclose(paddle.sum(t(a)).numpy(), a.sum(), rtol=1e-5)
+    np.testing.assert_allclose(paddle.sum(t(a), axis=1).numpy(), a.sum(1), rtol=1e-5)
+    np.testing.assert_allclose(
+        paddle.sum(t(a), axis=[0, 2], keepdim=True).numpy(),
+        a.sum((0, 2), keepdims=True), rtol=1e-5)
+    np.testing.assert_allclose(paddle.mean(t(a), axis=1).numpy(), a.mean(1), rtol=1e-5)
+    np.testing.assert_allclose(paddle.max(t(a), axis=2).numpy(), a.max(2), rtol=1e-5)
+    np.testing.assert_allclose(paddle.min(t(a)).numpy(), a.min(), rtol=1e-5)
+    np.testing.assert_allclose(paddle.prod(t(a), axis=0).numpy(), a.prod(0), rtol=1e-5)
+    np.testing.assert_allclose(paddle.logsumexp(t(a), axis=1).numpy(),
+                               np.log(np.exp(a).sum(1)), rtol=1e-4)
+    np.testing.assert_allclose(paddle.std(t(a)).numpy(), a.std(ddof=1), rtol=1e-5)
+    np.testing.assert_allclose(paddle.var(t(a), unbiased=False).numpy(),
+                               a.var(), rtol=1e-5)
+
+
+def test_matmul_family():
+    a = np.random.rand(3, 4).astype(np.float32)
+    b = np.random.rand(4, 5).astype(np.float32)
+    np.testing.assert_allclose(paddle.matmul(t(a), t(b)).numpy(), a @ b, rtol=1e-5)
+    np.testing.assert_allclose(
+        paddle.matmul(t(a.T), t(b), transpose_x=True).numpy(), a @ b, rtol=1e-5)
+    c = np.random.rand(2, 3, 4).astype(np.float32)
+    d = np.random.rand(2, 4, 5).astype(np.float32)
+    np.testing.assert_allclose(paddle.bmm(t(c), t(d)).numpy(), c @ d, rtol=1e-5)
+    v = np.random.rand(4).astype(np.float32)
+    np.testing.assert_allclose(paddle.mv(t(a), t(v)).numpy(), a @ v, rtol=1e-5)
+    np.testing.assert_allclose(paddle.dot(t(v), t(v)).numpy(), v @ v, rtol=1e-5)
+
+
+def test_manipulation():
+    a = np.random.rand(2, 3, 4).astype(np.float32)
+    assert paddle.reshape(t(a), [4, 6]).shape == [4, 6]
+    assert paddle.reshape(t(a), [-1, 4]).shape == [6, 4]
+    assert paddle.transpose(t(a), [2, 0, 1]).shape == [4, 2, 3]
+    assert paddle.flatten(t(a), 1).shape == [2, 12]
+    assert paddle.unsqueeze(t(a), [0, 2]).shape == [1, 2, 1, 3, 4]
+    assert paddle.squeeze(paddle.unsqueeze(t(a), 0), 0).shape == [2, 3, 4]
+    parts = paddle.split(t(a), 3, axis=1)
+    assert len(parts) == 3 and parts[0].shape == [2, 1, 4]
+    parts = paddle.split(t(a), [1, -1], axis=1)
+    assert parts[1].shape == [2, 2, 4]
+    cc = paddle.concat([t(a), t(a)], axis=2)
+    assert cc.shape == [2, 3, 8]
+    st = paddle.stack([t(a), t(a)], axis=0)
+    assert st.shape == [2, 2, 3, 4]
+    np.testing.assert_allclose(paddle.flip(t(a), [1]).numpy(), a[:, ::-1], rtol=0)
+    np.testing.assert_allclose(paddle.tile(t(a), [1, 2, 1]).numpy(),
+                               np.tile(a, (1, 2, 1)))
+    np.testing.assert_allclose(paddle.expand(t(np.ones((1, 3), np.float32)),
+                                             [4, 3]).numpy(), np.ones((4, 3)))
+    np.testing.assert_allclose(paddle.roll(t(a), 1, 0).numpy(), np.roll(a, 1, 0))
+
+
+def test_gather_scatter():
+    a = np.random.rand(5, 4).astype(np.float32)
+    idx = np.array([0, 2, 4])
+    np.testing.assert_allclose(paddle.gather(t(a), t(idx)).numpy(), a[idx])
+    np.testing.assert_allclose(paddle.index_select(t(a), t(idx), 0).numpy(), a[idx])
+    upd = np.ones((3, 4), np.float32)
+    out = paddle.scatter(t(a), t(idx), t(upd))
+    ex = a.copy()
+    ex[idx] = 1
+    np.testing.assert_allclose(out.numpy(), ex)
+    ta = paddle.take_along_axis(t(a), t(np.zeros((5, 1), np.int64)), 1)
+    np.testing.assert_allclose(ta.numpy(), a[:, :1])
+
+
+def test_logic_search():
+    a = np.array([[1.0, 5.0, 3.0], [2.0, 0.0, 6.0]], np.float32)
+    assert paddle.argmax(t(a)).item() == 5
+    np.testing.assert_array_equal(paddle.argmax(t(a), 1).numpy(), [1, 2])
+    np.testing.assert_array_equal(paddle.argsort(t(a), 1).numpy(),
+                                  np.argsort(a, 1))
+    vals, idx = paddle.topk(t(a), 2, axis=1)
+    np.testing.assert_allclose(vals.numpy(), np.sort(a, 1)[:, ::-1][:, :2])
+    w = paddle.where(t(a) > 2, t(a), paddle.zeros_like(t(a)))
+    np.testing.assert_allclose(w.numpy(), np.where(a > 2, a, 0))
+    nz = paddle.nonzero(t(np.array([0, 1, 0, 2])))
+    np.testing.assert_array_equal(nz.numpy(), [[1], [3]])
+    assert bool(paddle.allclose(t(a), t(a)))
+    assert paddle.equal_all(t(a), t(a)).item()
+    np.testing.assert_array_equal(paddle.sort(t(a), 1).numpy(), np.sort(a, 1))
+
+
+def test_creation():
+    assert paddle.zeros([2, 3]).numpy().sum() == 0
+    assert paddle.ones([2, 3], "int32").dtype == paddle.int32
+    np.testing.assert_array_equal(paddle.full([2], 7).numpy(), [7, 7])
+    np.testing.assert_array_equal(paddle.arange(2, 8, 2).numpy(), [2, 4, 6])
+    assert paddle.eye(3).numpy().trace() == 3
+    np.testing.assert_array_equal(
+        paddle.tril(t(np.ones((3, 3), np.float32))).numpy(), np.tril(np.ones((3, 3))))
+    g = paddle.meshgrid(paddle.arange(2), paddle.arange(3))
+    assert g[0].shape == [2, 3]
+    oh = paddle.one_hot(t(np.array([0, 2])), 3)
+    np.testing.assert_allclose(oh.numpy(), [[1, 0, 0], [0, 0, 1]])
+
+
+def test_linalg():
+    a = np.random.rand(4, 4).astype(np.float32) + np.eye(4, dtype=np.float32) * 4
+    np.testing.assert_allclose(paddle.linalg.inv(t(a)).numpy(), np.linalg.inv(a),
+                               rtol=1e-3, atol=1e-4)
+    np.testing.assert_allclose(paddle.linalg.det(t(a)).numpy(), np.linalg.det(a),
+                               rtol=1e-4)
+    sym = (a + a.T) / 2
+    w, v = paddle.linalg.eigh(t(sym))
+    wn = np.linalg.eigvalsh(sym)
+    np.testing.assert_allclose(w.numpy(), wn, rtol=1e-4, atol=1e-4)
+    u, s, vt = paddle.linalg.svd(t(a))
+    np.testing.assert_allclose(s.numpy(), np.linalg.svd(a)[1], rtol=1e-4)
+    c = paddle.linalg.cholesky(t(sym + np.eye(4, dtype=np.float32) * 4))
+    np.testing.assert_allclose(
+        (c @ c.T).numpy(), sym + np.eye(4) * 4, rtol=1e-3, atol=1e-4)
+    b = np.random.rand(4, 2).astype(np.float32)
+    np.testing.assert_allclose(paddle.linalg.solve(t(a), t(b)).numpy(),
+                               np.linalg.solve(a, b), rtol=1e-3, atol=1e-4)
+    np.testing.assert_allclose(
+        paddle.linalg.norm(t(b)).numpy(), np.linalg.norm(b), rtol=1e-5)
+    np.testing.assert_allclose(
+        paddle.linalg.matrix_power(t(a), 3).numpy(),
+        np.linalg.matrix_power(a, 3), rtol=1e-3)
+
+
+def test_fft():
+    a = np.random.rand(8).astype(np.float32)
+    np.testing.assert_allclose(paddle.fft.fft(t(a)).numpy(), np.fft.fft(a),
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(paddle.fft.rfft(t(a)).numpy(), np.fft.rfft(a),
+                               rtol=1e-4, atol=1e-4)
+    b = np.random.rand(4, 4).astype(np.float32)
+    np.testing.assert_allclose(paddle.fft.fft2(t(b)).numpy(), np.fft.fft2(b),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_einsum_cast_clip():
+    a = np.random.rand(3, 4).astype(np.float32)
+    b = np.random.rand(4, 5).astype(np.float32)
+    np.testing.assert_allclose(paddle.einsum("ij,jk->ik", t(a), t(b)).numpy(),
+                               a @ b, rtol=1e-5)
+    assert paddle.cast(t(a), "int32").dtype == paddle.int32
+    assert t(a).astype("float64").dtype == paddle.float64
+    np.testing.assert_allclose(paddle.clip(t(a), 0.2, 0.8).numpy(),
+                               np.clip(a, 0.2, 0.8))
+
+
+def test_dunders_and_methods():
+    a = paddle.to_tensor([1.0, 2.0, 3.0])
+    np.testing.assert_allclose((a + 1).numpy(), [2, 3, 4])
+    np.testing.assert_allclose((2 * a).numpy(), [2, 4, 6])
+    np.testing.assert_allclose((1 / a).numpy(), [1, 0.5, 1 / 3], rtol=1e-6)
+    np.testing.assert_allclose((a ** 2).numpy(), [1, 4, 9])
+    np.testing.assert_allclose((-a).numpy(), [-1, -2, -3])
+    np.testing.assert_allclose((a - 1).numpy(), [0, 1, 2])
+    assert (a > 1.5).numpy().tolist() == [False, True, True]
+    assert a.sum().item() == 6
+    assert a.mean().item() == 2
+    assert a.reshape([3, 1]).shape == [3, 1]
+    assert a[1].item() == 2
+    assert a[1:].shape == [2]
+    b = paddle.to_tensor([[1.0, 2.0], [3.0, 4.0]])
+    assert b.T.shape == [2, 2]
+    np.testing.assert_allclose(b.T.numpy(), [[1, 3], [2, 4]])
+    assert len(b) == 2
+    assert b.ndim == 2 and b.size == 4
+    assert paddle.to_tensor(True).dtype == paddle.bool
+
+
+def test_random_reproducible():
+    paddle.seed(7)
+    a = paddle.randn([4, 4])
+    paddle.seed(7)
+    b = paddle.randn([4, 4])
+    np.testing.assert_array_equal(a.numpy(), b.numpy())
+    u = paddle.uniform([1000], min=0, max=1)
+    assert 0 <= float(u.min()) and float(u.max()) <= 1
+    assert abs(float(u.mean()) - 0.5) < 0.05
+    r = paddle.randint(0, 10, [100])
+    assert r.dtype == paddle.int64 and int(r.max()) < 10
+    p = paddle.randperm(10)
+    assert sorted(p.numpy().tolist()) == list(range(10))
+
+
+def test_stat():
+    a = np.random.rand(3, 5).astype(np.float32)
+    np.testing.assert_allclose(paddle.median(t(a)).numpy(), np.median(a), rtol=1e-6)
+    np.testing.assert_allclose(paddle.quantile(t(a), 0.3, axis=1).numpy(),
+                               np.quantile(a, 0.3, axis=1), rtol=1e-5)
+    x = np.array([0, 1, 1, 3], np.int64)
+    np.testing.assert_array_equal(paddle.bincount(t(x)).numpy(), np.bincount(x))
+    u = paddle.unique(t(np.array([3, 1, 2, 1])))
+    np.testing.assert_array_equal(u.numpy(), [1, 2, 3])

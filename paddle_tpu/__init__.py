@@ -42,6 +42,12 @@ from .framework.random import get_cuda_rng_state, set_cuda_rng_state  # noqa: F4
 
 from . import fft  # noqa: F401
 from . import autograd  # noqa: F401
+from . import nn  # noqa: F401
+from . import optimizer  # noqa: F401
+from . import amp  # noqa: F401
+from . import regularizer  # noqa: F401
+from .framework.param_attr import ParamAttr  # noqa: F401
+from .nn.layer.layers import create_parameter  # noqa: F401
 
 __version__ = "0.1.0"
 

@@ -1,0 +1,210 @@
+"""AMP (reference: python/paddle/amp + fluid/dygraph/amp).
+
+TPU-native: bf16 is the native mixed-precision dtype (MXU computes bf16×bf16
+→f32); requests for float16 map to bfloat16 by default (fp16 is emulated on
+TPU). Dynamic loss scaling is kept for API parity — with bf16 it is
+mathematically inert (same exponent range as f32) but harmless.
+
+auto_cast works by op-name interception in the eager dispatcher
+(core.autograd.apply consults _amp_state): white-list ops run in the low
+dtype, black-list ops in f32 — the same two-list design as the reference's
+fluid/dygraph/amp/auto_cast.py.
+"""
+from __future__ import annotations
+
+import contextlib
+
+import jax.numpy as jnp
+
+from ..core import dtype as dtypes
+from ..core.tensor import Tensor
+from ..core import autograd as _ag
+
+__all__ = ["auto_cast", "amp_guard", "GradScaler", "decorate",
+           "WHITE_LIST", "BLACK_LIST"]
+
+WHITE_LIST = {
+    "matmul", "mm", "bmm", "conv1d", "conv2d", "conv3d", "linear", "einsum",
+    "conv1d_transpose", "conv2d_transpose", "conv3d_transpose",
+}
+# ops that must stay f32 for numerics
+BLACK_LIST = {
+    "exp", "log", "log2", "log10", "log1p", "logsumexp", "softmax",
+    "log_softmax", "cross_entropy", "mean", "sum", "pow", "square",
+    "reciprocal", "rsqrt", "norm", "cosh", "sinh",
+}
+
+
+class _AmpState:
+    enabled = False
+    level = "O1"
+    dtype = jnp.bfloat16
+    custom_white = set()
+    custom_black = set()
+
+
+_state = _AmpState()
+
+
+def _amp_active():
+    return _state.enabled
+
+
+def _amp_cast_args(fn_name, vals):
+    """Called from core.autograd.apply: cast float32 arrays per AMP policy."""
+    low = _state.dtype
+    in_white = fn_name in WHITE_LIST or fn_name in _state.custom_white
+    in_black = fn_name in BLACK_LIST or fn_name in _state.custom_black
+    if _state.level == "O2":
+        target = jnp.float32 if in_black else low
+    else:
+        if in_black:  # black wins (custom black overrides default white)
+            target = jnp.float32
+        elif in_white:
+            target = low
+        else:
+            return vals
+    out = []
+    for v in vals:
+        if hasattr(v, "dtype") and v.dtype in (jnp.float32, jnp.bfloat16,
+                                               jnp.float16) \
+                and v.dtype != target:
+            out.append(v.astype(target))
+        else:
+            out.append(v)
+    return out
+
+
+_ag._amp_hook = (_amp_active, _amp_cast_args)
+
+
+@contextlib.contextmanager
+def auto_cast(enable=True, custom_white_list=None, custom_black_list=None,
+              level="O1", dtype="float16", use_promote=True):
+    """paddle.amp.auto_cast. dtype float16 maps to bfloat16 on TPU."""
+    name = dtypes.convert_dtype(dtype)
+    low = jnp.bfloat16 if name in ("float16", "bfloat16") else jnp.float16
+    prev = (_state.enabled, _state.level, _state.dtype, _state.custom_white,
+            _state.custom_black)
+    _state.enabled = bool(enable)
+    _state.level = level
+    _state.dtype = low
+    _state.custom_white = set(custom_white_list or ())
+    _state.custom_black = set(custom_black_list or ())
+    try:
+        yield
+    finally:
+        (_state.enabled, _state.level, _state.dtype, _state.custom_white,
+         _state.custom_black) = prev
+
+
+amp_guard = auto_cast
+
+
+def decorate(models, optimizers=None, level="O2", dtype="float16",
+             master_weight=None, save_dtype=None):
+    """paddle.amp.decorate: cast model params to the AMP dtype (O2)."""
+    target = "bfloat16" if dtypes.convert_dtype(dtype) in (
+        "float16", "bfloat16") else dtype
+    single = not isinstance(models, (list, tuple))
+    ms = [models] if single else list(models)
+    if level == "O2":
+        for mdl in ms:
+            mdl.astype(target)
+    if optimizers is None:
+        return models
+    return models, optimizers
+
+
+class GradScaler:
+    """Dynamic loss scaling (reference: python/paddle/amp/grad_scaler.py)."""
+
+    def __init__(self, enable=True, init_loss_scaling=65536.0,
+                 incr_ratio=2.0, decr_ratio=0.5, incr_every_n_steps=2000,
+                 decr_every_n_nan_or_inf=1, use_dynamic_loss_scaling=True):
+        self._enable = enable
+        self._scale = float(init_loss_scaling)
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._incr_every = incr_every_n_steps
+        self._decr_every = decr_every_n_nan_or_inf
+        self._dynamic = use_dynamic_loss_scaling
+        self._good_steps = 0
+        self._bad_steps = 0
+        self._found_inf = False
+
+    def scale(self, loss):
+        if not self._enable:
+            return loss
+        return loss * self._scale
+
+    def unscale_(self, optimizer):
+        if not self._enable:
+            return
+        inv = 1.0 / self._scale
+        bad = None  # device-side flag; ONE host sync at the end
+        for p in optimizer._param_list:
+            if p._grad is not None:
+                g = p._grad._value * inv
+                p._grad._value = g
+                nf = jnp.any(~jnp.isfinite(g))
+                bad = nf if bad is None else (bad | nf)
+        self._found_inf = bool(bad) if bad is not None else False
+        self._unscaled = True
+
+    def step(self, optimizer):
+        if not self._enable:
+            optimizer.step()
+            return
+        if not getattr(self, "_unscaled", False):
+            self.unscale_(optimizer)
+        if not self._found_inf:
+            optimizer.step()
+        self._unscaled = False
+
+    def update(self):
+        if not (self._enable and self._dynamic):
+            return
+        if self._found_inf:
+            self._bad_steps += 1
+            self._good_steps = 0
+            if self._bad_steps >= self._decr_every:
+                self._scale = max(self._scale * self._decr_ratio, 1.0)
+                self._bad_steps = 0
+        else:
+            self._good_steps += 1
+            self._bad_steps = 0
+            if self._good_steps >= self._incr_every:
+                self._scale *= self._incr_ratio
+                self._good_steps = 0
+
+    def minimize(self, optimizer, scaled_loss):
+        # reference pattern: scaled.backward() already ran; minimize only
+        # unscales + steps + updates the scale
+        self.step(optimizer)
+        self.update()
+        optimizer.clear_grad()
+
+    def is_enable(self):
+        return self._enable
+
+    def is_use_dynamic_loss_scaling(self):
+        return self._dynamic
+
+    def get_init_loss_scaling(self):
+        return self._scale
+
+    def set_init_loss_scaling(self, v):
+        self._scale = float(v)
+
+    def state_dict(self):
+        return {"scale": self._scale, "incr_ratio": self._incr_ratio,
+                "decr_ratio": self._decr_ratio,
+                "incr_every_n_steps": self._incr_every,
+                "decr_every_n_nan_or_inf": self._decr_every,
+                "good_steps": self._good_steps, "bad_steps": self._bad_steps}
+
+    def load_state_dict(self, sd):
+        self._scale = sd.get("scale", self._scale)
+        self._good_steps = sd.get("good_steps", 0)
+        self._bad_steps = sd.get("bad_steps", 0)

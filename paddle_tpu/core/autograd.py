@@ -37,6 +37,9 @@ class _GradState(threading.local):
 
 _state = _GradState()
 
+# (is_active_fn, cast_fn) installed by paddle_tpu.amp at import
+_amp_hook = None
+
 
 def is_grad_enabled():
     return _state.enabled
@@ -94,6 +97,8 @@ def apply(fn, *args, **kwargs):
     recording a GradNode when any differentiable Tensor participates."""
     flat, treedef = jax.tree_util.tree_flatten((args, kwargs), is_leaf=_is_tensor)
     vals = [a._value if _is_tensor(a) else a for a in flat]
+    if _amp_hook is not None and _amp_hook[0]():
+        vals = _amp_hook[1](getattr(fn, "__name__", ""), vals)
     diff_pos = (
         [i for i, a in enumerate(flat)
          if _is_tensor(a) and not a.stop_gradient
@@ -232,6 +237,14 @@ def run_backward(tensors, grad_tensors=None, retain_graph=False,
             if cv is None or (hasattr(cv, "dtype") and cv.dtype == jax.dtypes.float0):
                 continue
             if t._node is not None:
+                # AMP can upcast an op's input (e.g. bf16 -> f32 for a
+                # black-list op); the producer's pullback needs a cotangent
+                # of its own output dtype
+                want = t._node.out_structs[t._out_idx].dtype
+                if cv.dtype != want:
+                    cv = cv.astype(want)
+                    c = Tensor(cv) if not isinstance(c, Tensor) else \
+                        apply(lambda v: v.astype(want), c)
                 key = (id(t._node), t._out_idx)
                 cots[key] = _add_cot(cots.get(key), c if create_graph else cv,
                                      create_graph)

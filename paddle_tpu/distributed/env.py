@@ -1,0 +1,58 @@
+"""Distributed environment: mesh bookkeeping + multi-host init.
+
+Reference: paddle/fluid/imperative/nccl_context + distributed/collective env.
+TPU-native: the "process group" is a jax.sharding.Mesh; collectives are XLA
+ops over its named axes (ICI within a slice, DCN across hosts).
+"""
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+__all__ = ["get_mesh", "set_mesh", "current_mesh_axes", "world_size", "rank",
+           "init_distributed_env"]
+
+_mesh = None
+
+
+def set_mesh(mesh):
+    global _mesh
+    _mesh = mesh
+
+
+def get_mesh():
+    return _mesh
+
+
+def current_mesh_axes():
+    """Names of mesh axes live in the current trace (inside shard_map)."""
+    try:
+        from jax.core import get_axis_env  # may vary across jax versions
+    except ImportError:
+        get_axis_env = None
+    axes = []
+    for name in ("dp", "tp", "pp", "sp", "ep", "mp"):
+        try:
+            jax.lax.axis_index(name)
+            axes.append(name)
+        except (NameError, Exception):  # noqa: BLE001 - axis not bound
+            continue
+    return tuple(axes)
+
+
+def world_size():
+    return jax.device_count()
+
+
+def rank():
+    return jax.process_index()
+
+
+def init_distributed_env(coordinator_address=None, num_processes=None,
+                         process_id=None):
+    """Multi-host bring-up: wraps jax.distributed.initialize (DCN rendezvous).
+    Single-host (tests, one v5e slice) is a no-op."""
+    if num_processes is not None and num_processes > 1:
+        jax.distributed.initialize(coordinator_address, num_processes,
+                                   process_id)
+    return world_size()

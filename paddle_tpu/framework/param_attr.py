@@ -1,0 +1,60 @@
+"""ParamAttr + regularizers (reference: python/paddle/fluid/param_attr.py,
+python/paddle/regularizer.py)."""
+from __future__ import annotations
+
+__all__ = ["ParamAttr", "L1Decay", "L2Decay"]
+
+
+class L1Decay:
+    def __init__(self, coeff=0.0):
+        self.coeff = coeff
+
+    def __call__(self, param):
+        from .. import tensor as T
+
+        return T.sum(T.abs(param)) * self.coeff
+
+    def grad_term(self, value):
+        """Regularization gradient added to param grad (lazy form)."""
+        import jax.numpy as jnp
+
+        return self.coeff * jnp.sign(value)
+
+
+class L2Decay:
+    def __init__(self, coeff=0.0):
+        self.coeff = coeff
+
+    def __call__(self, param):
+        from .. import tensor as T
+
+        return T.sum(param * param) * (0.5 * self.coeff)
+
+    def grad_term(self, value):
+        return self.coeff * value
+
+
+class ParamAttr:
+    def __init__(self, name=None, initializer=None, learning_rate=1.0,
+                 regularizer=None, trainable=True, do_model_average=True,
+                 need_clip=True):
+        self.name = name
+        self.initializer = initializer
+        self.learning_rate = learning_rate
+        self.regularizer = regularizer
+        self.trainable = trainable
+        self.do_model_average = do_model_average
+        self.need_clip = need_clip
+
+    @staticmethod
+    def _to_attr(attr):
+        if attr is None:
+            return ParamAttr()
+        if isinstance(attr, ParamAttr):
+            return attr
+        if isinstance(attr, str):
+            return ParamAttr(name=attr)
+        if attr is False:
+            return False
+        # an Initializer instance
+        return ParamAttr(initializer=attr)

@@ -1,0 +1,101 @@
+"""Gradient clipping (reference: python/paddle/fluid/clip.py).
+
+ClipGradByGlobalNorm computes one fused global norm over all grads — a single
+XLA reduction when run inside the jitted optimizer step.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.autograd import apply
+from ..core.tensor import Tensor
+
+__all__ = ["ClipGradBase", "ClipGradByValue", "ClipGradByNorm",
+           "ClipGradByGlobalNorm"]
+
+
+class ClipGradBase:
+    def __call__(self, params_grads):
+        return self._dygraph_clip(params_grads)
+
+
+class ClipGradByValue(ClipGradBase):
+    def __init__(self, max, min=None):  # noqa: A002
+        self.max = float(max)
+        self.min = float(-max if min is None else min)
+
+    def _dygraph_clip(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None or not getattr(p, "need_clip", True):
+                out.append((p, g))
+                continue
+            out.append((p, apply(lambda v: jnp.clip(v, self.min, self.max), g)))
+        return out
+
+    def clip_values(self, grads_dict):
+        return {k: jnp.clip(v, self.min, self.max)
+                for k, v in grads_dict.items()}
+
+
+class ClipGradByNorm(ClipGradBase):
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def _dygraph_clip(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None or not getattr(p, "need_clip", True):
+                out.append((p, g))
+                continue
+
+            def _f(v):
+                n = jnp.sqrt(jnp.sum(v * v))
+                return jnp.where(n > self.clip_norm,
+                                 v * (self.clip_norm / jnp.maximum(n, 1e-12)),
+                                 v)
+            out.append((p, apply(_f, g)))
+        return out
+
+    def clip_values(self, grads_dict):
+        out = {}
+        for k, v in grads_dict.items():
+            n = jnp.sqrt(jnp.sum(v * v))
+            out[k] = jnp.where(n > self.clip_norm,
+                               v * (self.clip_norm / jnp.maximum(n, 1e-12)), v)
+        return out
+
+
+class ClipGradByGlobalNorm(ClipGradBase):
+    def __init__(self, clip_norm, group_name="default_group",
+                 auto_skip_clip=False):
+        self.clip_norm = float(clip_norm)
+        self.group_name = group_name
+
+    def _dygraph_clip(self, params_grads):
+        gs = [g for p, g in params_grads
+              if g is not None and getattr(p, "need_clip", True)]
+        if not gs:
+            return params_grads
+
+        def _gn(*vals):
+            return jnp.sqrt(sum(jnp.sum(v.astype(jnp.float32) ** 2)
+                                for v in vals))
+        gnorm = apply(_gn, *gs)
+        scale = apply(
+            lambda n: jnp.minimum(self.clip_norm / jnp.maximum(n, 1e-12), 1.0),
+            gnorm)
+        out = []
+        for p, g in params_grads:
+            if g is None or not getattr(p, "need_clip", True):
+                out.append((p, g))
+            else:
+                out.append((p, apply(lambda v, s: v * s.astype(v.dtype),
+                                     g, scale)))
+        return out
+
+    def clip_values(self, grads_dict):
+        gnorm = jnp.sqrt(sum(jnp.sum(v.astype(jnp.float32) ** 2)
+                             for v in grads_dict.values()))
+        scale = jnp.minimum(self.clip_norm / jnp.maximum(gnorm, 1e-12), 1.0)
+        return {k: v * scale.astype(v.dtype) for k, v in grads_dict.items()}

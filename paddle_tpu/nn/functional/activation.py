@@ -1,0 +1,224 @@
+"""Activation functionals (reference: python/paddle/nn/functional/activation.py).
+
+All lower to XLA elementwise HLO — fused into neighbouring matmuls by XLA on
+TPU, so none of these need custom kernels.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.autograd import apply
+
+__all__ = [
+    "relu", "relu_", "relu6", "leaky_relu", "prelu", "elu", "elu_", "celu",
+    "selu", "gelu", "sigmoid", "log_sigmoid", "hardshrink", "hardsigmoid",
+    "hardswish", "hardtanh", "maxout", "mish", "softplus", "softshrink",
+    "softsign", "swish", "silu", "tanh", "tanh_", "tanhshrink",
+    "thresholded_relu", "softmax", "softmax_", "log_softmax", "glu",
+    "gumbel_softmax", "rrelu",
+]
+
+
+def relu(x, name=None):
+    return apply(jax.nn.relu, x)
+
+
+def relu_(x, name=None):
+    out = relu(x)
+    x._value, x._node, x._out_idx = out._value, out._node, out._out_idx
+    x.stop_gradient = out.stop_gradient
+    return x
+
+
+def relu6(x, name=None):
+    return apply(jax.nn.relu6, x)
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return apply(lambda v: jax.nn.leaky_relu(v, negative_slope), x)
+
+
+def prelu(x, weight, data_format="NCHW", name=None):
+    def _f(v, w):
+        if w.size == 1:
+            return jnp.where(v >= 0, v, w.reshape(()) * v)
+        ch_axis = 1 if data_format == "NCHW" else v.ndim - 1
+        shape = [1] * v.ndim
+        shape[ch_axis] = -1
+        return jnp.where(v >= 0, v, w.reshape(shape) * v)
+    return apply(_f, x, weight)
+
+
+def elu(x, alpha=1.0, name=None):
+    return apply(lambda v: jax.nn.elu(v, alpha), x)
+
+
+def elu_(x, alpha=1.0, name=None):
+    out = elu(x, alpha)
+    x._value, x._node, x._out_idx = out._value, out._node, out._out_idx
+    x.stop_gradient = out.stop_gradient
+    return x
+
+
+def celu(x, alpha=1.0, name=None):
+    return apply(lambda v: jax.nn.celu(v, alpha), x)
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
+    return apply(lambda v: scale * jnp.where(v > 0, v, alpha * jnp.expm1(v)), x)
+
+
+def gelu(x, approximate=False, name=None):
+    return apply(lambda v: jax.nn.gelu(v, approximate=approximate), x)
+
+
+def sigmoid(x, name=None):
+    return apply(jax.nn.sigmoid, x)
+
+
+def log_sigmoid(x, name=None):
+    return apply(jax.nn.log_sigmoid, x)
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    return apply(lambda v: jnp.where(jnp.abs(v) > threshold, v, 0.0), x)
+
+
+def hardsigmoid(x, slope=0.1666667, offset=0.5, name=None):
+    return apply(lambda v: jnp.clip(slope * v + offset, 0.0, 1.0), x)
+
+
+def hardswish(x, name=None):
+    return apply(lambda v: v * jnp.clip(v + 3.0, 0.0, 6.0) / 6.0, x)
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):  # noqa: A002
+    return apply(lambda v: jnp.clip(v, min, max), x)
+
+
+def maxout(x, groups, axis=1, name=None):
+    def _f(v):
+        ax = axis % v.ndim
+        c = v.shape[ax]
+        new_shape = v.shape[:ax] + (groups, c // groups) + v.shape[ax + 1:]
+        return jnp.max(v.reshape(new_shape), axis=ax)
+    return apply(_f, x)
+
+
+def mish(x, name=None):
+    return apply(lambda v: v * jnp.tanh(jax.nn.softplus(v)), x)
+
+
+def softplus(x, beta=1.0, threshold=20.0, name=None):
+    return apply(lambda v: jnp.where(
+        beta * v > threshold, v, jnp.log1p(jnp.exp(beta * v)) / beta), x)
+
+
+def softshrink(x, threshold=0.5, name=None):
+    return apply(lambda v: jnp.where(v > threshold, v - threshold,
+                                     jnp.where(v < -threshold, v + threshold,
+                                               0.0)), x)
+
+
+def softsign(x, name=None):
+    return apply(lambda v: v / (1.0 + jnp.abs(v)), x)
+
+
+def swish(x, name=None):
+    return apply(jax.nn.silu, x)
+
+
+silu = swish
+
+
+def tanh(x, name=None):
+    return apply(jnp.tanh, x)
+
+
+def tanh_(x, name=None):
+    out = tanh(x)
+    x._value, x._node, x._out_idx = out._value, out._node, out._out_idx
+    x.stop_gradient = out.stop_gradient
+    return x
+
+
+def tanhshrink(x, name=None):
+    return apply(lambda v: v - jnp.tanh(v), x)
+
+
+def thresholded_relu(x, threshold=1.0, value=0.0, name=None):
+    return apply(lambda v: jnp.where(v > threshold, v, value), x)
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    from ...core import dtype as dtypes
+
+    jd = dtypes.to_jax_dtype(dtype) if dtype is not None else None
+
+    def _f(v):
+        if jd is not None:
+            v = v.astype(jd)
+        return jax.nn.softmax(v, axis=axis)
+    _f.__name__ = "softmax"  # AMP black-list key
+    return apply(_f, x)
+
+
+def softmax_(x, axis=-1, dtype=None, name=None):
+    out = softmax(x, axis, dtype)
+    x._value, x._node, x._out_idx = out._value, out._node, out._out_idx
+    x.stop_gradient = out.stop_gradient
+    return x
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    from ...core import dtype as dtypes
+
+    jd = dtypes.to_jax_dtype(dtype) if dtype is not None else None
+
+    def _f(v):
+        if jd is not None:
+            v = v.astype(jd)
+        return jax.nn.log_softmax(v, axis=axis)
+    _f.__name__ = "log_softmax"  # AMP black-list key
+    return apply(_f, x)
+
+
+def glu(x, axis=-1, name=None):
+    return apply(lambda v: jax.nn.glu(v, axis=axis), x)
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    from ...framework import random as rnd
+
+    key = rnd.next_key()
+
+    def _f(v):
+        g = jax.random.gumbel(key, v.shape, v.dtype)
+        y = jax.nn.softmax((v + g) / temperature, axis=axis)
+        if hard:
+            idx = jnp.argmax(y, axis=axis, keepdims=True)
+            onehot = jnp.zeros_like(y).at[
+                _axis_index(y, idx, axis)].set(1.0)
+            y = jax.lax.stop_gradient(onehot - y) + y
+        return y
+    return apply(_f, x)
+
+
+def _axis_index(y, idx, axis):
+    ix = list(jnp.meshgrid(*[jnp.arange(s) for s in idx.shape], indexing="ij"))
+    ix[axis % y.ndim] = idx
+    return tuple(ix)
+
+
+def rrelu(x, lower=0.125, upper=0.333333, training=True, name=None):
+    from ...framework import random as rnd
+
+    if not training:
+        return apply(lambda v: jnp.where(v >= 0, v, (lower + upper) / 2 * v), x)
+    key = rnd.next_key()
+
+    def _f(v):
+        a = jax.random.uniform(key, v.shape, v.dtype, lower, upper)
+        return jnp.where(v >= 0, v, a * v)
+    return apply(_f, x)

@@ -1,0 +1,94 @@
+"""Attention functionals.
+
+`scaled_dot_product_attention` mirrors the reference fused attention
+(paddle incubate fused_transformer / nn.functional) but dispatches to the
+Pallas TPU flash-attention kernel (ops/pallas/flash_attention.py) when the
+shapes allow, else to the XLA softmax composition (which XLA still fuses
+well on TPU).
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ...core.autograd import apply
+from ...core.tensor import Tensor
+from ...framework import random as rnd
+
+__all__ = ["scaled_dot_product_attention", "_attention_core"]
+
+# populated by ops.pallas.flash_attention at import (avoids hard dep)
+_flash_attention_fn = None
+
+
+def _use_flash(q_shape, head_dim, mask, dropout):
+    if _flash_attention_fn is None or dropout:
+        return False
+    if jax.default_backend() != "tpu":
+        return False
+    # pallas kernel wants seq multiple of block and head_dim multiple of 128
+    b, h, s, d = q_shape
+    return s >= 256 and s % 128 == 0 and d % 128 == 0 and mask in (
+        None, "causal")
+
+
+def _xla_attention(q, k, v, mask, dropout_p, key, is_causal, training=True):
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    if is_causal:
+        ql, kl = logits.shape[-2], logits.shape[-1]
+        causal = jnp.tril(jnp.ones((ql, kl), bool), kl - ql)
+        logits = jnp.where(causal, logits, jnp.asarray(-1e30, logits.dtype))
+    elif mask is not None:
+        if mask.dtype == jnp.bool_:
+            logits = jnp.where(mask, logits, jnp.asarray(-1e30, logits.dtype))
+        else:
+            logits = logits + mask
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(q.dtype)
+    if dropout_p and training:
+        keep = jax.random.bernoulli(key, 1.0 - dropout_p, probs.shape)
+        probs = jnp.where(keep, probs / (1.0 - dropout_p), 0.0)
+    out = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+    return out, probs
+
+
+def _attention_core(q, k, v, attn_mask, dropout_p, need_weights=False,
+                    is_causal=False, training=True):
+    """q,k,v: [batch, heads, seq, head_dim] Tensors."""
+    key = rnd.next_key() if dropout_p else None
+    use_flash = _use_flash(tuple(q.shape), q.shape[-1],
+                           "causal" if is_causal else
+                           (None if attn_mask is None else "mask"),
+                           dropout_p) and not need_weights
+    if use_flash:
+        out = _flash_attention_fn(q, k, v, is_causal)
+        return out, None
+
+    def _f(qv, kv, vv, mv):
+        out, probs = _xla_attention(qv, kv, vv, mv, dropout_p, key, is_causal,
+                                    training)
+        return (out, probs) if need_weights else out
+    res = apply(_f, q, k, v, attn_mask)
+    if need_weights:
+        return res[0], res[1]
+    return res, None
+
+
+def scaled_dot_product_attention(query, key, value, attn_mask=None,
+                                 dropout_p=0.0, is_causal=False,
+                                 training=True, name=None):
+    """paddle.nn.functional.scaled_dot_product_attention.
+
+    Inputs are [batch, seq, heads, head_dim] (paddle layout); internally
+    transposed to [b,h,s,d].
+    """
+    from ... import tensor as T
+
+    q = T.transpose(query, [0, 2, 1, 3])
+    k = T.transpose(key, [0, 2, 1, 3])
+    v = T.transpose(value, [0, 2, 1, 3])
+    out, _ = _attention_core(q, k, v, attn_mask, dropout_p,
+                             is_causal=is_causal, training=training)
+    return T.transpose(out, [0, 2, 1, 3])

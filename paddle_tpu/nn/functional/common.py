@@ -1,0 +1,410 @@
+"""Common functionals: linear, embedding, dropout, pad, interpolate, etc.
+(reference: python/paddle/nn/functional/common.py + input.py).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.autograd import apply, is_grad_enabled
+from ...core.tensor import Tensor
+from ...framework import random as rnd
+
+__all__ = [
+    "linear", "embedding", "one_hot", "dropout", "dropout2d", "dropout3d",
+    "alpha_dropout", "pad", "zeropad2d", "interpolate", "upsample",
+    "cosine_similarity", "pixel_shuffle", "pixel_unshuffle", "channel_shuffle",
+    "unfold", "fold", "label_smooth", "sequence_mask", "bilinear",
+    "class_center_sample", "temporal_shift",
+]
+
+
+def linear(x, weight, bias=None, name=None):
+    """y = x @ W + b, W shape [in, out] (paddle layout). Lowers to one MXU
+    matmul + fused bias add."""
+    def _f(v, w, b):
+        out = v @ w
+        return out + b if b is not None else out
+    _f.__name__ = "linear"  # AMP white-list key
+    return apply(_f, x, weight, bias)
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    def _f(idx, w):
+        out = jnp.take(w, idx, axis=0)
+        if padding_idx is not None:
+            mask = (idx == padding_idx)[..., None]
+            out = jnp.where(mask, 0.0, out)
+        return out
+    return apply(_f, x, weight)
+
+
+def one_hot(x, num_classes, name=None):
+    from ...core import dtype as dtypes
+
+    return apply(lambda v: jax.nn.one_hot(
+        v, num_classes, dtype=dtypes.to_jax_dtype(dtypes.get_default_dtype())), x)
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",
+            name=None):
+    if not training:
+        if mode == "downscale_in_infer" and p > 0:
+            # train kept values unscaled, so inference scales by (1-p)
+            return apply(lambda v: v * (1.0 - p), x)
+        return x.clone() if hasattr(x, "clone") else x
+    if p == 0:
+        return x.clone() if hasattr(x, "clone") else x
+    if p == 1:
+        return apply(lambda v: jnp.zeros_like(v), x)
+    key = rnd.next_key()
+
+    def _f(v):
+        shape = list(v.shape)
+        if axis is not None:
+            axes = [axis] if isinstance(axis, int) else list(axis)
+            shape = [s if i in [a % v.ndim for a in axes] else 1
+                     for i, s in enumerate(v.shape)]
+        keep = jax.random.bernoulli(key, 1.0 - p, tuple(shape))
+        if mode == "upscale_in_train":
+            return jnp.where(keep, v / (1.0 - p), 0.0).astype(v.dtype)
+        return jnp.where(keep, v, 0.0).astype(v.dtype)
+    return apply(_f, x)
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    ch_axis = 1 if data_format == "NCHW" else 3
+    keep_axes = (0, ch_axis)
+    if not training or p == 0:
+        return x
+    key = rnd.next_key()
+
+    def _f(v):
+        shape = tuple(s if i in keep_axes else 1 for i, s in enumerate(v.shape))
+        keep = jax.random.bernoulli(key, 1.0 - p, shape)
+        return jnp.where(keep, v / (1.0 - p), 0.0).astype(v.dtype)
+    return apply(_f, x)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    ch_axis = 1 if data_format == "NCDHW" else 4
+    if not training or p == 0:
+        return x
+    key = rnd.next_key()
+
+    def _f(v):
+        shape = tuple(s if i in (0, ch_axis) else 1
+                      for i, s in enumerate(v.shape))
+        keep = jax.random.bernoulli(key, 1.0 - p, shape)
+        return jnp.where(keep, v / (1.0 - p), 0.0).astype(v.dtype)
+    return apply(_f, x)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    if not training or p == 0:
+        return x
+    key = rnd.next_key()
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+
+    def _f(v):
+        keep = jax.random.bernoulli(key, 1.0 - p, v.shape)
+        a = (1.0 / np.sqrt((alpha_p ** 2 * p + 1) * (1 - p))) if p < 1 else 0.0
+        b = -a * alpha_p * p
+        return (a * jnp.where(keep, v, alpha_p) + b).astype(v.dtype)
+    return apply(_f, x)
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):  # noqa: A002
+    if isinstance(pad, Tensor):
+        pad = [int(p) for p in np.asarray(pad._value)]
+    pad = [int(p) for p in pad]
+    jmode = {"constant": "constant", "reflect": "reflect",
+             "replicate": "edge", "circular": "wrap"}[mode]
+
+    def _f(v):
+        nd = v.ndim
+        if len(pad) == 2 * nd:
+            # full-rank paddle format: [dim0_lo, dim0_hi, ...]? paddle uses
+            # per-dim pairs in dim order for this case
+            pairs = [(pad[2 * i], pad[2 * i + 1]) for i in range(nd)]
+        else:
+            # spatial-only, reversed order (last dim first), NCHW-family
+            n_spatial = len(pad) // 2
+            pairs = [(0, 0)] * nd
+            channel_last = data_format in ("NHWC", "NLC", "NDHWC")
+            spatial_start = 1 if channel_last else 2
+            for i in range(n_spatial):
+                dim = spatial_start + n_spatial - 1 - i
+                pairs[dim] = (pad[2 * i], pad[2 * i + 1])
+        if jmode == "constant":
+            return jnp.pad(v, pairs, mode="constant", constant_values=value)
+        return jnp.pad(v, pairs, mode=jmode)
+    return apply(_f, x)
+
+
+def zeropad2d(x, padding, data_format="NCHW", name=None):
+    return pad(x, padding, mode="constant", value=0.0, data_format=data_format)
+
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest",
+                align_corners=False, align_mode=0, data_format="NCHW",
+                name=None):
+    mode = mode.lower()
+    channel_last = data_format in ("NHWC", "NDHWC", "NWC", "NLC")
+
+    def _out_spatial(in_spatial):
+        if size is not None:
+            s = size
+            if isinstance(s, Tensor):
+                s = [int(v) for v in np.asarray(s._value)]
+            if isinstance(s, (int, np.integer)):
+                s = [int(s)] * len(in_spatial)
+            return tuple(int(v._value) if isinstance(v, Tensor) else int(v)
+                         for v in s)
+        sf = scale_factor
+        if isinstance(sf, Tensor):
+            sf = np.asarray(sf._value).tolist()
+        if isinstance(sf, (int, float)):
+            sf = [sf] * len(in_spatial)
+        return tuple(int(in_spatial[i] * float(sf[i]))
+                     for i in range(len(in_spatial)))
+
+    def _f(v):
+        nd = v.ndim
+        n_sp = nd - 2
+        sp_axes = tuple(range(1, nd - 1)) if channel_last else \
+            tuple(range(2, nd))
+        in_spatial = tuple(v.shape[a] for a in sp_axes)
+        out_spatial = _out_spatial(in_spatial)
+        if mode == "nearest":
+            out = v
+            for i, ax in enumerate(sp_axes):
+                idx = (jnp.arange(out_spatial[i]) * in_spatial[i]
+                       // out_spatial[i]).astype(jnp.int32)
+                out = jnp.take(out, idx, axis=ax)
+            return out
+        if mode in ("bilinear", "linear", "trilinear", "bicubic"):
+            method = {"bilinear": "linear", "linear": "linear",
+                      "trilinear": "linear", "bicubic": "cubic"}[mode]
+            # jax.image.resize operates on chosen axes via full-shape spec
+            new_shape = list(v.shape)
+            for i, ax in enumerate(sp_axes):
+                new_shape[ax] = out_spatial[i]
+            if align_corners:
+                # emulate align_corners with explicit gather-based linear interp
+                out = v
+                for i, ax in enumerate(sp_axes):
+                    o = out_spatial[i]
+                    s_in = in_spatial[i]
+                    if o == 1 or s_in == 1:
+                        idx = jnp.zeros((o,), jnp.float32)
+                    else:
+                        idx = jnp.arange(o, dtype=jnp.float32) * (s_in - 1) / (o - 1)
+                    lo = jnp.floor(idx).astype(jnp.int32)
+                    hi = jnp.minimum(lo + 1, s_in - 1)
+                    w_hi = (idx - lo).astype(v.dtype)
+                    a = jnp.take(out, lo, axis=ax)
+                    b = jnp.take(out, hi, axis=ax)
+                    shape = [1] * out.ndim
+                    shape[ax] = -1
+                    out = a * (1 - w_hi.reshape(shape)) + b * w_hi.reshape(shape)
+                return out
+            return jax.image.resize(v, tuple(new_shape), method=method)
+        if mode == "area":
+            out = v
+            for i, ax in enumerate(sp_axes):
+                s_in, o = in_spatial[i], out_spatial[i]
+                if s_in % o == 0:
+                    k = s_in // o
+                    shp = out.shape[:ax] + (o, k) + out.shape[ax + 1:]
+                    out = jnp.mean(out.reshape(shp), axis=ax + 1)
+                else:
+                    new_shape = list(out.shape)
+                    new_shape[ax] = o
+                    out = jax.image.resize(out, tuple(new_shape), "linear")
+            return out
+        raise ValueError(f"unsupported interpolate mode {mode}")
+    return apply(_f, x)
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest",
+             align_corners=False, align_mode=0, data_format="NCHW", name=None):
+    return interpolate(x, size, scale_factor, mode, align_corners, align_mode,
+                       data_format)
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8, name=None):
+    def _f(a, b):
+        dot = jnp.sum(a * b, axis=axis)
+        na = jnp.sqrt(jnp.sum(a * a, axis=axis))
+        nb = jnp.sqrt(jnp.sum(b * b, axis=axis))
+        return dot / jnp.maximum(na * nb, eps)
+    return apply(_f, x1, x2)
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    r = upscale_factor
+
+    def _f(v):
+        if data_format == "NCHW":
+            b, c, h, w = v.shape
+            out = v.reshape(b, c // (r * r), r, r, h, w)
+            out = out.transpose(0, 1, 4, 2, 5, 3)
+            return out.reshape(b, c // (r * r), h * r, w * r)
+        b, h, w, c = v.shape
+        out = v.reshape(b, h, w, r, r, c // (r * r))
+        out = out.transpose(0, 1, 3, 2, 4, 5)
+        return out.reshape(b, h * r, w * r, c // (r * r))
+    return apply(_f, x)
+
+
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
+    r = downscale_factor
+
+    def _f(v):
+        if data_format == "NCHW":
+            b, c, h, w = v.shape
+            out = v.reshape(b, c, h // r, r, w // r, r)
+            out = out.transpose(0, 1, 3, 5, 2, 4)
+            return out.reshape(b, c * r * r, h // r, w // r)
+        b, h, w, c = v.shape
+        out = v.reshape(b, h // r, r, w // r, r, c)
+        out = out.transpose(0, 1, 3, 2, 4, 5)
+        return out.reshape(b, h // r, w // r, c * r * r)
+    return apply(_f, x)
+
+
+def channel_shuffle(x, groups, data_format="NCHW", name=None):
+    def _f(v):
+        if data_format == "NCHW":
+            b, c, h, w = v.shape
+            return v.reshape(b, groups, c // groups, h, w).swapaxes(1, 2) \
+                .reshape(b, c, h, w)
+        b, h, w, c = v.shape
+        return v.reshape(b, h, w, groups, c // groups).swapaxes(3, 4) \
+            .reshape(b, h, w, c)
+    return apply(_f, x)
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    def _pair(v):
+        return (v, v) if isinstance(v, int) else tuple(v)
+    k = _pair(kernel_sizes)
+    s = _pair(strides)
+    p = _pair(paddings) if not (isinstance(paddings, (list, tuple))
+                                and len(paddings) == 4) else tuple(paddings)
+    d = _pair(dilations)
+    if len(p) == 2:
+        p4 = (p[0], p[0], p[1], p[1])
+    else:
+        p4 = tuple(p)
+
+    def _f(v):
+        b, c, h, w = v.shape
+        vp = jnp.pad(v, [(0, 0), (0, 0), (p4[0], p4[1]), (p4[2], p4[3])])
+        patches = jax.lax.conv_general_dilated_patches(
+            vp, filter_shape=k, window_strides=s, padding=[(0, 0), (0, 0)],
+            rhs_dilation=d, dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        # patches: [b, c*k0*k1, L_h, L_w] → [b, c*k0*k1, L]
+        return patches.reshape(b, patches.shape[1], -1)
+    return apply(_f, x)
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1,
+         name=None):
+    def _pair(v):
+        return (v, v) if isinstance(v, int) else tuple(v)
+    out_hw = _pair(output_sizes)
+    k = _pair(kernel_sizes)
+    s = _pair(strides)
+    p = _pair(paddings)
+    d = _pair(dilations)
+
+    def _f(v):
+        b, ckk, L = v.shape
+        c = ckk // (k[0] * k[1])
+        h_pad = out_hw[0] + 2 * p[0]
+        w_pad = out_hw[1] + 2 * p[1]
+        lh = (h_pad - d[0] * (k[0] - 1) - 1) // s[0] + 1
+        lw = (w_pad - d[1] * (k[1] - 1) - 1) // s[1] + 1
+        vv = v.reshape(b, c, k[0], k[1], lh, lw)
+        out = jnp.zeros((b, c, h_pad, w_pad), v.dtype)
+        for i in range(k[0]):
+            for j in range(k[1]):
+                hi = i * d[0]
+                wj = j * d[1]
+                out = out.at[:, :, hi:hi + lh * s[0]:s[0],
+                             wj:wj + lw * s[1]:s[1]].add(vv[:, :, i, j])
+        return out[:, :, p[0]:p[0] + out_hw[0], p[1]:p[1] + out_hw[1]]
+    return apply(_f, x)
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    def _f(lab, prior):
+        k = lab.shape[-1]
+        if prior is not None:
+            return (1 - epsilon) * lab + epsilon * prior
+        return (1 - epsilon) * lab + epsilon / k
+    return apply(_f, label, prior_dist)
+
+
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    from ...core import dtype as dtypes
+
+    ml = maxlen
+    if isinstance(ml, Tensor):
+        ml = int(ml._value)
+    if ml is None:
+        ml = int(np.asarray(x._value).max())
+
+    def _f(v):
+        r = jnp.arange(ml)
+        return (r < v[..., None]).astype(dtypes.to_jax_dtype(dtype))
+    return apply(_f, x)
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    def _f(a, b, w, bi):
+        # w: [out, in1, in2]
+        out = jnp.einsum("bi,oij,bj->bo", a, w, b)
+        if bi is not None:
+            out = out + bi
+        return out
+    return apply(_f, x1, x2, weight, bias)
+
+
+def class_center_sample(label, num_classes, num_samples, group=None):
+    # simplified: returns remapped labels + sampled class centers
+    lab = np.asarray(label._value)
+    pos = np.unique(lab)
+    extra = num_samples - len(pos)
+    if extra > 0:
+        rest = np.setdiff1d(np.arange(num_classes), pos)
+        sel = np.random.permutation(rest)[:extra]
+        sampled = np.sort(np.concatenate([pos, sel]))
+    else:
+        sampled = pos
+    remap = {c: i for i, c in enumerate(sampled)}
+    new_lab = np.vectorize(lambda c: remap.get(c, -1))(lab)
+    return (Tensor(jnp.asarray(new_lab.astype(lab.dtype))),
+            Tensor(jnp.asarray(sampled.astype(lab.dtype))))
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW",
+                   name=None):
+    def _f(v):
+        nt, c, h, w = v.shape
+        n = nt // seg_num
+        vv = v.reshape(n, seg_num, c, h, w)
+        fold_c = int(c * shift_ratio)
+        left = jnp.concatenate(
+            [vv[:, 1:, :fold_c], jnp.zeros_like(vv[:, :1, :fold_c])], axis=1)
+        right = jnp.concatenate(
+            [jnp.zeros_like(vv[:, :1, fold_c:2 * fold_c]),
+             vv[:, :-1, fold_c:2 * fold_c]], axis=1)
+        rest = vv[:, :, 2 * fold_c:]
+        return jnp.concatenate([left, right, rest], axis=2).reshape(nt, c, h, w)
+    return apply(_f, x)

@@ -1,0 +1,162 @@
+"""Convolution functionals (reference: python/paddle/nn/functional/conv.py).
+
+TPU-native: all convs lower to `lax.conv_general_dilated`, the HLO conv that
+XLA tiles onto the MXU. The public API keeps Paddle's NCHW default; XLA
+re-lays-out internally (NHWC is the TPU-native layout — pass
+data_format='NHWC' to skip the transposes on the hot path).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.autograd import apply
+
+__all__ = ["conv1d", "conv2d", "conv3d", "conv1d_transpose",
+           "conv2d_transpose", "conv3d_transpose"]
+
+
+def _norm_tuple(v, n):
+    if isinstance(v, (int, np.integer)):
+        return (int(v),) * n
+    return tuple(int(x) for x in v)
+
+
+def _norm_padding(padding, n):
+    """Returns lax-style [(lo,hi)]*n or a string."""
+    if isinstance(padding, str):
+        return padding.upper()
+    if isinstance(padding, (int, np.integer)):
+        return [(int(padding), int(padding))] * n
+    pad = list(padding)
+    if len(pad) == n and all(isinstance(p, (int, np.integer)) for p in pad):
+        return [(int(p), int(p)) for p in pad]
+    if len(pad) == 2 * n:
+        return [(int(pad[2 * i]), int(pad[2 * i + 1])) for i in range(n)]
+    if all(isinstance(p, (list, tuple)) for p in pad):
+        # paddle allows [[0,0],[0,0],[h0,h1],[w0,w1]] incl. batch/channel dims
+        if len(pad) == n + 2:
+            pad = pad[2:]
+        return [(int(p[0]), int(p[1])) for p in pad]
+    raise ValueError(f"bad padding: {padding!r}")
+
+
+def _conv(x, weight, bias, stride, padding, dilation, groups, n,
+          data_format):
+    stride = _norm_tuple(stride, n)
+    dilation = _norm_tuple(dilation, n)
+    pad = _norm_padding(padding, n)
+    channel_last = data_format in ("NHWC", "NLC", "NDHWC")
+    spatial = "".join("DHW"[3 - n:][i] for i in range(n))
+    if channel_last:
+        lhs_spec = "N" + spatial + "C"
+    else:
+        lhs_spec = "NC" + spatial
+    rhs_spec = "OI" + spatial
+    out_spec = lhs_spec
+    dn = jax.lax.conv_dimension_numbers(
+        (1,) * (n + 2), (1,) * (n + 2), (lhs_spec, rhs_spec, out_spec))
+
+    def _f(v, w, b):
+        out = jax.lax.conv_general_dilated(
+            v, w, window_strides=stride, padding=pad,
+            rhs_dilation=dilation, dimension_numbers=dn,
+            feature_group_count=groups,
+            preferred_element_type=None)
+        if b is not None:
+            bshape = [1] * out.ndim
+            bshape[out.ndim - 1 if channel_last else 1] = -1
+            out = out + b.reshape(bshape)
+        return out
+    _f.__name__ = f"conv{n}d"  # AMP white-list key
+    return apply(_f, x, weight, bias)
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 1,
+                 "NLC" if data_format == "NLC" else "NCL")
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 2,
+                 data_format)
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 3,
+                 data_format)
+
+
+def _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                    dilation, groups, n, data_format, output_size):
+    stride = _norm_tuple(stride, n)
+    dilation = _norm_tuple(dilation, n)
+    out_pad = _norm_tuple(output_padding, n)
+    pad = _norm_padding(padding, n)
+    channel_last = data_format in ("NHWC", "NLC", "NDHWC")
+    spatial = "".join("DHW"[3 - n:][i] for i in range(n))
+    lhs_spec = ("N" + spatial + "C") if channel_last else ("NC" + spatial)
+    # paddle transpose-conv weight layout: [in_channels, out_channels//groups, *k]
+    rhs_spec = "IO" + spatial
+    dn = jax.lax.conv_dimension_numbers(
+        (1,) * (n + 2), (1,) * (n + 2), (lhs_spec, rhs_spec, lhs_spec))
+
+    if isinstance(pad, str):
+        lax_pad = pad
+    else:
+        # grad-of-conv padding: k_eff-1-p on each side, + output_padding on high
+        lax_pad = []
+        k_spatial = weight._value.shape[2:]
+        for i in range(n):
+            k_eff = (k_spatial[i] - 1) * dilation[i] + 1
+            lo, hi = pad[i]
+            lax_pad.append((k_eff - 1 - lo, k_eff - 1 - hi + out_pad[i]))
+
+    def _g(v, w, b):
+        # grad-of-conv formulation: weight [I, O/g, *k] → per-group OI conv
+        # weight (g*O_g, I_g, *k), spatially flipped, then lhs-dilated conv.
+        i_ch = w.shape[0]
+        w_g = w.reshape((groups, i_ch // groups) + w.shape[1:])
+        w_g = jnp.flip(w_g, axis=tuple(range(3, 3 + n)))
+        w_g = jnp.swapaxes(w_g, 1, 2)  # (g, O_g, I_g, *k)
+        w2 = w_g.reshape((groups * w.shape[1], i_ch // groups) + w.shape[2:])
+        dn2 = jax.lax.conv_dimension_numbers(
+            (1,) * (n + 2), (1,) * (n + 2),
+            (lhs_spec, "OI" + spatial, lhs_spec))
+        out = jax.lax.conv_general_dilated(
+            v, w2, window_strides=(1,) * n, padding=lax_pad,
+            lhs_dilation=stride, rhs_dilation=dilation,
+            dimension_numbers=dn2, feature_group_count=groups)
+        if b is not None:
+            bshape = [1] * out.ndim
+            bshape[out.ndim - 1 if channel_last else 1] = -1
+            out = out + b.reshape(bshape)
+        return out
+
+    _g.__name__ = f"conv{n}d_transpose"  # AMP white-list key
+    return apply(_g, x, weight, bias)
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1, output_size=None,
+                     data_format="NCL", name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, 1, data_format, output_size)
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1, output_size=None,
+                     data_format="NCHW", name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, 2, data_format, output_size)
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1, output_size=None,
+                     data_format="NCDHW", name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, 3, data_format, output_size)

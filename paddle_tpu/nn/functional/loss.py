@@ -1,0 +1,400 @@
+"""Loss functionals (reference: python/paddle/nn/functional/loss.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.autograd import apply
+from ...core.tensor import Tensor
+
+__all__ = [
+    "cross_entropy", "softmax_with_cross_entropy", "mse_loss", "l1_loss",
+    "nll_loss", "binary_cross_entropy", "binary_cross_entropy_with_logits",
+    "kl_div", "smooth_l1_loss", "margin_ranking_loss", "ctc_loss",
+    "hinge_embedding_loss", "cosine_embedding_loss", "triplet_margin_loss",
+    "triplet_margin_with_distance_loss", "soft_margin_loss",
+    "multi_label_soft_margin_loss", "poisson_nll_loss", "gaussian_nll_loss",
+    "log_loss", "square_error_cost", "sigmoid_focal_loss", "dice_loss",
+    "npair_loss", "mse", "multi_margin_loss",
+]
+
+
+def _reduce(v, reduction):
+    if reduction == "mean":
+        return jnp.mean(v)
+    if reduction == "sum":
+        return jnp.sum(v)
+    return v
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100,  # noqa: A002
+                  reduction="mean", soft_label=False, axis=-1,
+                  use_softmax=True, label_smoothing=0.0, name=None):
+    def _f(logits, lab, w):
+        if use_softmax:
+            logp = jax.nn.log_softmax(logits, axis=axis)
+        else:
+            logp = jnp.log(jnp.clip(logits, 1e-15, 1.0))
+        k = logits.shape[axis]
+        if soft_label or (lab.ndim == logits.ndim and lab.shape == logits.shape):
+            sl = lab
+            if label_smoothing > 0:
+                sl = sl * (1 - label_smoothing) + label_smoothing / k
+            loss = -jnp.sum(sl * logp, axis=axis)
+            if w is not None:
+                wt = jnp.sum(sl * w, axis=axis)
+                loss = loss * wt
+            return _reduce(loss, reduction)
+        lab_i = lab
+        if lab_i.ndim == logits.ndim and lab_i.shape[axis] == 1:
+            lab_i = jnp.squeeze(lab_i, axis)
+        lab_i = lab_i.astype(jnp.int32)
+        valid = lab_i != ignore_index
+        safe = jnp.where(valid, lab_i, 0)
+        picked = jnp.take_along_axis(
+            logp, jnp.expand_dims(safe, axis), axis=axis).squeeze(axis)
+        if label_smoothing > 0:
+            mean_logp = jnp.mean(logp, axis=axis)
+            picked = (1 - label_smoothing) * picked + label_smoothing * mean_logp
+        loss = -picked
+        if w is not None:
+            wt = jnp.take(w, safe)
+            loss = loss * wt
+        loss = jnp.where(valid, loss, 0.0)
+        if reduction == "mean":
+            if w is not None:
+                denom = jnp.sum(jnp.where(valid, jnp.take(w, safe), 0.0))
+            else:
+                denom = jnp.maximum(jnp.sum(valid), 1)
+            return jnp.sum(loss) / denom
+        if reduction == "sum":
+            return jnp.sum(loss)
+        return loss
+    _f.__name__ = "cross_entropy"  # AMP black-list key
+    return apply(_f, input, label, weight)
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False,
+                               ignore_index=-100, numeric_stable_mode=True,
+                               return_softmax=False, axis=-1):
+    loss = cross_entropy(logits, label, soft_label=soft_label,
+                         ignore_index=ignore_index, reduction="none",
+                         axis=axis)
+    from .activation import softmax as _softmax
+
+    loss = loss.unsqueeze(axis) if not soft_label else loss
+    if return_softmax:
+        return loss, _softmax(logits, axis=axis)
+    return loss
+
+
+def mse_loss(input, label, reduction="mean", name=None):  # noqa: A002
+    return apply(lambda a, b: _reduce((a - b) ** 2, reduction), input, label)
+
+
+mse = mse_loss
+
+
+def square_error_cost(input, label):  # noqa: A002
+    return apply(lambda a, b: (a - b) ** 2, input, label)
+
+
+def l1_loss(input, label, reduction="mean", name=None):  # noqa: A002
+    return apply(lambda a, b: _reduce(jnp.abs(a - b), reduction), input, label)
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean",  # noqa: A002
+             name=None):
+    def _f(logp, lab, w):
+        lab_i = lab.astype(jnp.int32)
+        valid = lab_i != ignore_index
+        safe = jnp.where(valid, lab_i, 0)
+        if logp.ndim > 2:
+            # [N, C, d1...] → move C last
+            p = jnp.moveaxis(logp, 1, -1)
+            picked = jnp.take_along_axis(p, safe[..., None], axis=-1)[..., 0]
+        else:
+            picked = jnp.take_along_axis(logp, safe[:, None], axis=1)[:, 0]
+        loss = -picked
+        if w is not None:
+            wt = jnp.take(w, safe)
+            loss = loss * wt
+        loss = jnp.where(valid, loss, 0.0)
+        if reduction == "mean":
+            denom = jnp.sum(jnp.take(w, safe) * valid) if w is not None else \
+                jnp.maximum(jnp.sum(valid), 1)
+            return jnp.sum(loss) / denom
+        return _reduce(loss, reduction)
+    return apply(_f, input, label, weight)
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean",  # noqa: A002
+                         name=None):
+    def _f(p, y, w):
+        p = jnp.clip(p, 1e-12, 1 - 1e-12)
+        loss = -(y * jnp.log(p) + (1 - y) * jnp.log(1 - p))
+        if w is not None:
+            loss = loss * w
+        return _reduce(loss, reduction)
+    return apply(_f, input, label, weight)
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None,
+                                     reduction="mean", pos_weight=None,
+                                     name=None):
+    def _f(z, y, w, pw):
+        neg_abs = -jnp.abs(z)
+        # stable: max(z,0) - z*y + log(1+exp(-|z|)), with pos_weight on the
+        # positive term
+        if pw is not None:
+            log_sig = jax.nn.log_sigmoid(z)
+            log_sig_neg = jax.nn.log_sigmoid(-z)
+            loss = -(pw * y * log_sig + (1 - y) * log_sig_neg)
+        else:
+            loss = jnp.maximum(z, 0) - z * y + jnp.log1p(jnp.exp(neg_abs))
+        if w is not None:
+            loss = loss * w
+        return _reduce(loss, reduction)
+    return apply(_f, logit, label, weight, pos_weight)
+
+
+def kl_div(input, label, reduction="mean", log_target=False, name=None):  # noqa: A002
+    def _f(logp, y):
+        if log_target:
+            loss = jnp.exp(y) * (y - logp)
+        else:
+            safe_y = jnp.where(y > 0, y, 1.0)
+            loss = jnp.where(y > 0, y * (jnp.log(safe_y) - logp), 0.0)
+        if reduction == "batchmean":
+            return jnp.sum(loss) / logp.shape[0]
+        return _reduce(loss, reduction)
+    return apply(_f, input, label)
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):  # noqa: A002
+    def _f(a, b):
+        d = jnp.abs(a - b)
+        loss = jnp.where(d < delta, 0.5 * d * d / delta, d - 0.5 * delta)
+        # paddle multiplies by delta (huber normalization)
+        loss = loss * delta
+        return _reduce(loss, reduction)
+    return apply(_f, input, label)
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean",  # noqa: A002
+                        name=None):
+    def _f(a, b, y):
+        loss = jnp.maximum(-y * (a - b) + margin, 0.0)
+        return _reduce(loss, reduction)
+    return apply(_f, input, other, label)
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False):
+    """CTC via the standard log-alpha forward recursion, vectorized over batch
+    with a lax.scan over time (reference: phi/kernels warpctc)."""
+    def _f(lp, lab, in_len, lab_len):
+        # lp: [T, B, C] log-softmaxed already? paddle expects logits after
+        # log_softmax; assume log-probs
+        T, B, C = lp.shape
+        S = lab.shape[1]
+        # extended label seq with blanks: length 2S+1
+        ext = jnp.full((B, 2 * S + 1), blank, lab.dtype)
+        ext = ext.at[:, 1::2].set(lab)
+        ext_len = 2 * lab_len + 1
+        neg_inf = jnp.asarray(-1e30, lp.dtype)
+
+        def get_lp(t_lp, idx):
+            return jnp.take_along_axis(t_lp, idx, axis=1)
+
+        # init alpha at t=0
+        alpha0 = jnp.full((B, 2 * S + 1), neg_inf)
+        alpha0 = alpha0.at[:, 0].set(lp[0, jnp.arange(B), blank])
+        first_lab = jnp.where(S > 0, ext[:, 1], blank)
+        alpha0 = alpha0.at[:, 1].set(lp[0, jnp.arange(B), first_lab])
+
+        same_as_prev2 = jnp.concatenate(
+            [jnp.ones((B, 2), bool), ext[:, 2:] == ext[:, :-2]], axis=1)
+
+        def step(alpha, t_lp):
+            a_prev = alpha
+            a_shift1 = jnp.concatenate(
+                [jnp.full((B, 1), neg_inf), alpha[:, :-1]], axis=1)
+            a_shift2 = jnp.concatenate(
+                [jnp.full((B, 2), neg_inf), alpha[:, :-2]], axis=1)
+            a_shift2 = jnp.where(same_as_prev2, neg_inf, a_shift2)
+            m = jnp.maximum(jnp.maximum(a_prev, a_shift1), a_shift2)
+            m_safe = jnp.where(m == neg_inf, 0.0, m)
+            summed = (jnp.exp(a_prev - m_safe) + jnp.exp(a_shift1 - m_safe)
+                      + jnp.exp(a_shift2 - m_safe))
+            new = m_safe + jnp.log(
+                jnp.where(m == neg_inf, 1.0, summed)) + get_lp(t_lp, ext)
+            new = jnp.where(m == neg_inf, neg_inf, new)
+            return new, None
+
+        # time-mask: for t >= in_len keep alpha unchanged
+        def masked_step(carry, inp):
+            alpha, t = carry
+            t_lp = inp
+            new, _ = step(alpha, t_lp)
+            keep = (t < in_len)[:, None]
+            return (jnp.where(keep, new, alpha), t + 1), None
+
+        (alphaT, _), _ = jax.lax.scan(masked_step, (alpha0, jnp.ones((), jnp.int32)),
+                                      lp[1:])
+        idx_last = jnp.maximum(ext_len - 1, 0)
+        idx_prev = jnp.maximum(ext_len - 2, 0)
+        aL = jnp.take_along_axis(alphaT, idx_last[:, None], axis=1)[:, 0]
+        aP = jnp.take_along_axis(alphaT, idx_prev[:, None], axis=1)[:, 0]
+        m = jnp.maximum(aL, aP)
+        ll = m + jnp.log(jnp.exp(aL - m) + jnp.exp(aP - m))
+        loss = -ll
+        if reduction == "mean":
+            return jnp.mean(loss / lab_len.astype(lp.dtype).clip(1))
+        return _reduce(loss, reduction)
+    return apply(_f, log_probs, labels, input_lengths, label_lengths)
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean",  # noqa: A002
+                         name=None):
+    def _f(a, y):
+        loss = jnp.where(y == 1, a, jnp.maximum(margin - a, 0.0))
+        return _reduce(loss, reduction)
+    return apply(_f, input, label)
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0.0, reduction="mean",
+                          name=None):
+    def _f(a, b, y):
+        cos = jnp.sum(a * b, -1) / jnp.maximum(
+            jnp.linalg.norm(a, axis=-1) * jnp.linalg.norm(b, axis=-1), 1e-12)
+        loss = jnp.where(y == 1, 1 - cos, jnp.maximum(cos - margin, 0.0))
+        return _reduce(loss, reduction)
+    return apply(_f, input1, input2, label)
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0,  # noqa: A002
+                        epsilon=1e-6, swap=False, reduction="mean", name=None):
+    def _f(a, pos, neg):
+        def dist(u, v):
+            return jnp.sum(jnp.abs(u - v + epsilon) ** p, -1) ** (1.0 / p)
+        dp = dist(a, pos)
+        dn = dist(a, neg)
+        if swap:
+            dn = jnp.minimum(dn, dist(pos, neg))
+        loss = jnp.maximum(dp - dn + margin, 0.0)
+        return _reduce(loss, reduction)
+    return apply(_f, input, positive, negative)
+
+
+def triplet_margin_with_distance_loss(input, positive, negative,  # noqa: A002
+                                      distance_function=None, margin=1.0,
+                                      swap=False, reduction="mean", name=None):
+    if distance_function is None:
+        return triplet_margin_loss(input, positive, negative, margin=margin,
+                                   swap=swap, reduction=reduction)
+    dp = distance_function(input, positive)
+    dn = distance_function(input, negative)
+    if swap:
+        dpn = distance_function(positive, negative)
+        dn = apply(jnp.minimum, dn, dpn)
+    return apply(lambda a, b: _reduce(jnp.maximum(a - b + margin, 0.0),
+                                      reduction), dp, dn)
+
+
+def soft_margin_loss(input, label, reduction="mean", name=None):  # noqa: A002
+    def _f(a, y):
+        return _reduce(jnp.log1p(jnp.exp(-y * a)), reduction)
+    return apply(_f, input, label)
+
+
+def multi_label_soft_margin_loss(input, label, weight=None, reduction="mean",  # noqa: A002
+                                 name=None):
+    def _f(a, y, w):
+        loss = -(y * jax.nn.log_sigmoid(a) + (1 - y) * jax.nn.log_sigmoid(-a))
+        if w is not None:
+            loss = loss * w
+        return _reduce(jnp.mean(loss, -1), reduction)
+    return apply(_f, input, label, weight)
+
+
+def multi_margin_loss(input, label, p=1, margin=1.0, weight=None,  # noqa: A002
+                      reduction="mean", name=None):
+    def _f(a, y, w):
+        n, c = a.shape
+        correct = jnp.take_along_axis(a, y[:, None].astype(jnp.int32), 1)
+        m = jnp.maximum(margin - correct + a, 0.0) ** p
+        if w is not None:
+            m = m * jnp.take(w, y.astype(jnp.int32))[:, None]
+        mask = jax.nn.one_hot(y, c, dtype=a.dtype)
+        loss = jnp.sum(m * (1 - mask), -1) / c
+        return _reduce(loss, reduction)
+    return apply(_f, input, label, weight)
+
+
+def poisson_nll_loss(input, label, log_input=True, full=False, epsilon=1e-8,  # noqa: A002
+                     reduction="mean", name=None):
+    def _f(a, y):
+        if log_input:
+            loss = jnp.exp(a) - y * a
+        else:
+            loss = a - y * jnp.log(a + epsilon)
+        if full:
+            stirling = y * jnp.log(y) - y + 0.5 * jnp.log(2 * jnp.pi * y)
+            loss = loss + jnp.where(y > 1, stirling, 0.0)
+        return _reduce(loss, reduction)
+    return apply(_f, input, label)
+
+
+def gaussian_nll_loss(input, label, variance, full=False, epsilon=1e-6,  # noqa: A002
+                      reduction="mean", name=None):
+    def _f(mu, y, var):
+        var = jnp.maximum(var, epsilon)
+        loss = 0.5 * (jnp.log(var) + (y - mu) ** 2 / var)
+        if full:
+            loss = loss + 0.5 * jnp.log(jnp.asarray(2 * jnp.pi, mu.dtype))
+        return _reduce(loss, reduction)
+    return apply(_f, input, label, variance)
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):  # noqa: A002
+    def _f(p, y):
+        return -(y * jnp.log(p + epsilon) + (1 - y) * jnp.log(1 - p + epsilon))
+    return apply(_f, input, label)
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                       reduction="sum", name=None):
+    def _f(z, y, norm):
+        p = jax.nn.sigmoid(z)
+        ce = jnp.maximum(z, 0) - z * y + jnp.log1p(jnp.exp(-jnp.abs(z)))
+        p_t = p * y + (1 - p) * (1 - y)
+        a_t = alpha * y + (1 - alpha) * (1 - y)
+        loss = a_t * ((1 - p_t) ** gamma) * ce
+        if norm is not None:
+            loss = loss / norm
+        return _reduce(loss, reduction)
+    return apply(_f, logit, label, normalizer)
+
+
+def dice_loss(input, label, epsilon=1e-5, name=None):  # noqa: A002
+    def _f(p, y):
+        yh = jax.nn.one_hot(y.squeeze(-1), p.shape[-1], dtype=p.dtype)
+        red = tuple(range(1, p.ndim))
+        inter = jnp.sum(p * yh, red)
+        union = jnp.sum(p, red) + jnp.sum(yh, red)
+        return jnp.mean(1 - (2 * inter + epsilon) / (union + epsilon))
+    return apply(_f, input, label)
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    def _f(a, p, lab):
+        sim = a @ p.T
+        y = (lab[:, None] == lab[None, :]).astype(a.dtype)
+        y = y / jnp.sum(y, -1, keepdims=True)
+        ce_r = -jnp.sum(y * jax.nn.log_softmax(sim, -1), -1)
+        ce_c = -jnp.sum(y * jax.nn.log_softmax(sim.T, -1), -1)
+        l2 = jnp.mean(jnp.sum(a * a, -1) + jnp.sum(p * p, -1))
+        return jnp.mean((ce_r + ce_c) / 2) + l2_reg * l2 * 0.25
+    return apply(_f, anchor, positive, labels)

@@ -1,0 +1,147 @@
+"""Normalization functionals (reference: python/paddle/nn/functional/norm.py).
+
+batch_norm carries running stats as explicit tensors (functional style);
+SyncBatchNorm's cross-replica mean/var is a psum over the mesh axis — see
+nn/layer/norm.py.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.autograd import apply
+from ...core.tensor import Tensor
+
+__all__ = ["batch_norm", "layer_norm", "instance_norm", "group_norm",
+           "local_response_norm", "normalize"]
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None,
+               training=False, momentum=0.9, epsilon=1e-5,
+               data_format="NCHW", use_global_stats=None, name=None):
+    channel_last = data_format in ("NHWC", "NLC", "NDHWC")
+    use_global = (not training) if use_global_stats is None else use_global_stats
+
+    def _f(v, rm, rv, w, b):
+        ch_axis = v.ndim - 1 if channel_last else 1
+        red_axes = tuple(i for i in range(v.ndim) if i != ch_axis)
+        if use_global:
+            mean, var = rm, rv
+        else:
+            mean = jnp.mean(v, red_axes)
+            var = jnp.var(v, red_axes)
+        shape = [1] * v.ndim
+        shape[ch_axis] = -1
+        out = (v - mean.reshape(shape)) * jax.lax.rsqrt(
+            var.reshape(shape) + epsilon)
+        if w is not None:
+            out = out * w.reshape(shape)
+        if b is not None:
+            out = out + b.reshape(shape)
+        return out, mean, var
+
+    out, batch_mean, batch_var = apply(_f, x, running_mean, running_var,
+                                       weight, bias)
+    if training and not use_global and running_mean is not None:
+        # side-effecting buffer update; under jit tracing these writes hold
+        # tracers and are harvested by Layer.functional_call as outputs
+        running_mean._value = (momentum * running_mean._value
+                               + (1 - momentum) * batch_mean._value)
+        running_var._value = (momentum * running_var._value
+                              + (1 - momentum) * batch_var._value)
+    return out
+
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5,
+               name=None):
+    if isinstance(normalized_shape, int):
+        normalized_shape = (normalized_shape,)
+    n = len(tuple(normalized_shape))
+
+    def _f(v, w, b):
+        axes = tuple(range(v.ndim - n, v.ndim))
+        mean = jnp.mean(v, axes, keepdims=True)
+        var = jnp.var(v, axes, keepdims=True)
+        out = (v - mean) * jax.lax.rsqrt(var + epsilon)
+        if w is not None:
+            out = out * w
+        if b is not None:
+            out = out + b
+        return out
+    return apply(_f, x, weight, bias)
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None,
+                  bias=None, use_input_stats=True, momentum=0.9, eps=1e-5,
+                  data_format="NCHW", name=None):
+    def _f(v, w, b):
+        red_axes = tuple(range(2, v.ndim))
+        mean = jnp.mean(v, red_axes, keepdims=True)
+        var = jnp.var(v, red_axes, keepdims=True)
+        out = (v - mean) * jax.lax.rsqrt(var + eps)
+        if w is not None:
+            shape = [1, -1] + [1] * (v.ndim - 2)
+            out = out * w.reshape(shape)
+        if b is not None:
+            shape = [1, -1] + [1] * (v.ndim - 2)
+            out = out + b.reshape(shape)
+        return out
+    return apply(_f, x, weight, bias)
+
+
+def group_norm(x, num_groups, epsilon=1e-5, weight=None, bias=None,
+               data_format="NCHW", name=None):
+    channel_last = data_format in ("NHWC", "NLC", "NDHWC")
+
+    def _f(v, w, b):
+        ch_axis = v.ndim - 1 if channel_last else 1
+        c = v.shape[ch_axis]
+        if channel_last:
+            new_shape = v.shape[:-1] + (num_groups, c // num_groups)
+            g = v.reshape(new_shape)
+            axes = tuple(range(1, v.ndim - 1)) + (v.ndim,)
+            mean = jnp.mean(g, axes, keepdims=True)
+            var = jnp.var(g, axes, keepdims=True)
+            out = ((g - mean) * jax.lax.rsqrt(var + epsilon)).reshape(v.shape)
+            shape = [1] * (v.ndim - 1) + [-1]
+        else:
+            new_shape = (v.shape[0], num_groups, c // num_groups) + v.shape[2:]
+            g = v.reshape(new_shape)
+            axes = tuple(range(2, v.ndim + 1))
+            mean = jnp.mean(g, axes, keepdims=True)
+            var = jnp.var(g, axes, keepdims=True)
+            out = ((g - mean) * jax.lax.rsqrt(var + epsilon)).reshape(v.shape)
+            shape = [1, -1] + [1] * (v.ndim - 2)
+        if w is not None:
+            out = out * w.reshape(shape)
+        if b is not None:
+            out = out + b.reshape(shape)
+        return out
+    return apply(_f, x, weight, bias)
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0,
+                        data_format="NCHW", name=None):
+    def _f(v):
+        sq = v * v
+        ch_axis = 1 if data_format.startswith("NC") else v.ndim - 1
+        half = size // 2
+        pads = [(0, 0)] * v.ndim
+        pads[ch_axis] = (half, size - half - 1)
+        padded = jnp.pad(sq, pads)
+        dims = [1] * v.ndim
+        dims[ch_axis] = size
+        s = jax.lax.reduce_window(padded, 0.0, jax.lax.add, tuple(dims),
+                                  (1,) * v.ndim, [(0, 0)] * v.ndim)
+        return v / jnp.power(k + alpha * s, beta)
+    return apply(_f, x)
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    def _f(v):
+        if p == 2:
+            n = jnp.sqrt(jnp.sum(v * v, axis=axis, keepdims=True))
+        else:
+            n = jnp.sum(jnp.abs(v) ** p, axis=axis, keepdims=True) ** (1.0 / p)
+        return v / jnp.maximum(n, epsilon)
+    return apply(_f, x)

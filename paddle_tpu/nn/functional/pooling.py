@@ -1,0 +1,290 @@
+"""Pooling functionals (reference: python/paddle/nn/functional/pooling.py).
+
+TPU-native: pooling = `lax.reduce_window` (XLA ReduceWindow HLO); adaptive
+pooling decomposes into reshape+mean when the input divides evenly, else a
+gather-based window loop.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.autograd import apply
+
+__all__ = [
+    "avg_pool1d", "avg_pool2d", "avg_pool3d", "max_pool1d", "max_pool2d",
+    "max_pool3d", "adaptive_avg_pool1d", "adaptive_avg_pool2d",
+    "adaptive_avg_pool3d", "adaptive_max_pool1d", "adaptive_max_pool2d",
+    "adaptive_max_pool3d", "lp_pool1d", "lp_pool2d", "max_unpool1d",
+    "max_unpool2d", "max_unpool3d",
+]
+
+
+def _norm_tuple(v, n):
+    if isinstance(v, (int, np.integer)):
+        return (int(v),) * n
+    return tuple(int(x) for x in v)
+
+
+def _norm_pad(padding, n):
+    if isinstance(padding, str):
+        return padding.upper()
+    if isinstance(padding, (int, np.integer)):
+        return [(int(padding),) * 2] * n
+    pad = list(padding)
+    if len(pad) == n and all(isinstance(p, (int, np.integer)) for p in pad):
+        return [(int(p), int(p)) for p in pad]
+    if len(pad) == 2 * n:
+        return [(int(pad[2 * i]), int(pad[2 * i + 1])) for i in range(n)]
+    if all(isinstance(p, (list, tuple)) for p in pad):
+        if len(pad) == n + 2:
+            pad = pad[2:]
+        return [(int(p[0]), int(p[1])) for p in pad]
+    raise ValueError(f"bad padding {padding!r}")
+
+
+def _pool(x, kernel, stride, padding, n, reducer, init, ceil_mode,
+          channel_last, count_include_pad=True, norm_avg=False):
+    kernel = _norm_tuple(kernel, n)
+    stride = _norm_tuple(stride if stride is not None else kernel, n)
+    pad = _norm_pad(padding, n)
+
+    def _f(v):
+        if channel_last:
+            dims = (1,) + kernel + (1,)
+            strides = (1,) + stride + (1,)
+            pads = [(0, 0)] + (pad if not isinstance(pad, str) else pad) + [(0, 0)] \
+                if not isinstance(pad, str) else pad
+        else:
+            dims = (1, 1) + kernel
+            strides = (1, 1) + stride
+            pads = [(0, 0), (0, 0)] + pad if not isinstance(pad, str) else pad
+        if isinstance(pads, str):
+            pads = jax.lax.padtype_to_pads(v.shape, dims, strides, pads)
+        out = jax.lax.reduce_window(v, init, reducer, dims, strides, pads)
+        if norm_avg:
+            if count_include_pad:
+                denom = float(np.prod(kernel))
+                out = out / denom
+            else:
+                ones = jnp.ones(v.shape, v.dtype)
+                cnt = jax.lax.reduce_window(ones, 0.0, jax.lax.add, dims,
+                                            strides, pads)
+                out = out / cnt
+        return out
+    return apply(_f, x)
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, name=None):
+    return _pool(x, kernel_size, stride, padding, 1, jax.lax.add, 0.0,
+                 ceil_mode, False, count_include_pad=not exclusive,
+                 norm_avg=True)
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCHW",
+               name=None):
+    return _pool(x, kernel_size, stride, padding, 2, jax.lax.add, 0.0,
+                 ceil_mode, data_format == "NHWC",
+                 count_include_pad=not exclusive, norm_avg=True)
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCDHW",
+               name=None):
+    return _pool(x, kernel_size, stride, padding, 3, jax.lax.add, 0.0,
+                 ceil_mode, data_format == "NDHWC",
+                 count_include_pad=not exclusive, norm_avg=True)
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, name=None):
+    out = _pool(x, kernel_size, stride, padding, 1, jax.lax.max, -jnp.inf,
+                ceil_mode, False)
+    if return_mask:
+        return out, _max_mask(x, out, kernel_size, stride, padding, 1)
+    return out
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCHW", name=None):
+    out = _pool(x, kernel_size, stride, padding, 2, jax.lax.max, -jnp.inf,
+                ceil_mode, data_format == "NHWC")
+    if return_mask:
+        return out, _max_mask(x, out, kernel_size, stride, padding, 2)
+    return out
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCDHW", name=None):
+    out = _pool(x, kernel_size, stride, padding, 3, jax.lax.max, -jnp.inf,
+                ceil_mode, data_format == "NDHWC")
+    if return_mask:
+        return out, _max_mask(x, out, kernel_size, stride, padding, 3)
+    return out
+
+
+def _max_mask(x, out, kernel, stride, padding, n):
+    """Flat spatial argmax indices per output window (paddle return_mask)."""
+    kernel = _norm_tuple(kernel, n)
+    stride = _norm_tuple(stride if stride is not None else kernel, n)
+    pad = _norm_pad(padding, n)
+
+    def _f(v):
+        spatial = v.shape[2:]
+        flat_idx = jnp.arange(int(np.prod(spatial)), dtype=jnp.int32).reshape(
+            spatial)
+        idx_b = jnp.broadcast_to(flat_idx, v.shape).astype(jnp.float32)
+        dims = (1, 1) + kernel
+        strides = (1, 1) + stride
+        pads = [(0, 0), (0, 0)] + pad
+
+        def red(acc, cur):
+            av, ai = acc
+            cv, ci = cur
+            take_cur = cv > av
+            return (jnp.where(take_cur, cv, av), jnp.where(take_cur, ci, ai))
+
+        neg = jnp.asarray(-jnp.inf, v.dtype)
+        vals, idxs = jax.lax.reduce_window(
+            (v, idx_b), (neg, jnp.asarray(-1.0, jnp.float32)), red,
+            dims, strides, pads)
+        return idxs.astype(jnp.int64)
+    return apply(_f, x)
+
+
+def _adaptive_starts(in_size, out_size):
+    i = np.arange(out_size)
+    starts = np.floor(i * in_size / out_size).astype(int)
+    ends = np.ceil((i + 1) * in_size / out_size).astype(int)
+    return starts, ends
+
+
+def _adaptive_pool(x, output_size, n, mode, channel_last=False):
+    if isinstance(output_size, (int, np.integer)):
+        output_size = (int(output_size),) * n
+    output_size = tuple(
+        int(o) if o is not None else None for o in output_size)
+
+    def _f(v):
+        spatial_off = 1 if channel_last else 2
+        in_spatial = v.shape[spatial_off:spatial_off + n] if not channel_last \
+            else v.shape[1:1 + n]
+        outs = tuple(o if o is not None else s
+                     for o, s in zip(output_size, in_spatial))
+        if all(s % o == 0 for s, o in zip(in_spatial, outs)):
+            # even split: reshape + reduce (XLA-friendly, no gathers)
+            new_shape = list(v.shape[:spatial_off])
+            red_axes = []
+            for i, (s, o) in enumerate(zip(in_spatial, outs)):
+                new_shape += [o, s // o]
+                red_axes.append(spatial_off + 2 * i + 1)
+            if channel_last:
+                new_shape += [v.shape[-1]]
+            r = v.reshape(new_shape)
+            return jnp.mean(r, axis=tuple(red_axes)) if mode == "avg" \
+                else jnp.max(r, axis=tuple(red_axes))
+        # uneven: per-output-position slices (unrolled; sizes are static)
+        out = v
+        for i, (s, o) in enumerate(zip(in_spatial, outs)):
+            ax = spatial_off + i
+            starts, ends = _adaptive_starts(s, o)
+            pieces = []
+            for st, en in zip(starts, ends):
+                sl = jax.lax.slice_in_dim(out, int(st), int(en), axis=ax)
+                red = jnp.mean(sl, axis=ax, keepdims=True) if mode == "avg" \
+                    else jnp.max(sl, axis=ax, keepdims=True)
+                pieces.append(red)
+            out = jnp.concatenate(pieces, axis=ax)
+        return out
+    return apply(_f, x)
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    return _adaptive_pool(x, output_size, 1, "avg")
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    return _adaptive_pool(x, output_size, 2, "avg", data_format == "NHWC")
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    return _adaptive_pool(x, output_size, 3, "avg", data_format == "NDHWC")
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    out = _adaptive_pool(x, output_size, 1, "max")
+    return (out, None) if return_mask else out
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    out = _adaptive_pool(x, output_size, 2, "max")
+    return (out, None) if return_mask else out
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    out = _adaptive_pool(x, output_size, 3, "max")
+    return (out, None) if return_mask else out
+
+
+def lp_pool1d(x, norm_type, kernel_size, stride=None, padding=0,
+              ceil_mode=False, data_format="NCL", name=None):
+    p = float(norm_type)
+    from ...core.autograd import apply as _apply
+
+    powed = _apply(lambda v: jnp.power(jnp.abs(v), p), x)
+    pooled = _pool(powed, kernel_size, stride, padding, 1, jax.lax.add, 0.0,
+                   ceil_mode, False)
+    return _apply(lambda v: jnp.power(v, 1.0 / p), pooled)
+
+
+def lp_pool2d(x, norm_type, kernel_size, stride=None, padding=0,
+              ceil_mode=False, data_format="NCHW", name=None):
+    p = float(norm_type)
+    from ...core.autograd import apply as _apply
+
+    powed = _apply(lambda v: jnp.power(jnp.abs(v), p), x)
+    pooled = _pool(powed, kernel_size, stride, padding, 2, jax.lax.add, 0.0,
+                   ceil_mode, data_format == "NHWC")
+    return _apply(lambda v: jnp.power(v, 1.0 / p), pooled)
+
+
+def _max_unpool(x, indices, kernel_size, stride, padding, output_size, n):
+    def _f(v, idx):
+        batch, ch = v.shape[0], v.shape[1]
+        in_spatial = v.shape[2:]
+        if output_size is not None:
+            out_spatial = tuple(output_size)[-n:]
+        else:
+            k = _norm_tuple(kernel_size, n)
+            s = _norm_tuple(stride if stride is not None else kernel_size, n)
+            p = _norm_tuple(padding, n)
+            out_spatial = tuple(
+                (in_spatial[i] - 1) * s[i] - 2 * p[i] + k[i] for i in range(n))
+        flat_len = int(np.prod(out_spatial))
+        flat = jnp.zeros((batch, ch, flat_len), v.dtype)
+        vf = v.reshape(batch, ch, -1)
+        idxf = idx.reshape(batch, ch, -1)
+        flat = flat.at[
+            jnp.arange(batch)[:, None, None],
+            jnp.arange(ch)[None, :, None],
+            idxf].set(vf)
+        return flat.reshape((batch, ch) + out_spatial)
+    return apply(_f, x, indices)
+
+
+def max_unpool1d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCL", output_size=None, name=None):
+    return _max_unpool(x, indices, kernel_size, stride, padding, output_size, 1)
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+    return _max_unpool(x, indices, kernel_size, stride, padding, output_size, 2)
+
+
+def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCDHW", output_size=None, name=None):
+    return _max_unpool(x, indices, kernel_size, stride, padding, output_size, 3)

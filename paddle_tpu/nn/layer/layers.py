@@ -1,0 +1,402 @@
+"""nn.Layer base class (reference: python/paddle/fluid/dygraph/layers.py).
+
+Holds Parameters (trainable Tensors), buffers, sublayers, hooks; provides
+state_dict round-trip and train/eval mode. TPU-native addition:
+`functional_call(params, buffers, *inputs)` runs forward with swapped-in
+(possibly traced) values and harvests buffer mutations — the bridge from the
+stateful Paddle API to jit-compiled pure train steps (hapi/static/jit).
+"""
+from __future__ import annotations
+
+import collections
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core import dtype as dtypes
+from ...core.tensor import Tensor
+from ...framework import random as rnd
+from ...framework.param_attr import ParamAttr
+from .. import initializer as I
+
+__all__ = ["Layer", "Parameter", "create_parameter"]
+
+
+class Parameter(Tensor):
+    """Trainable tensor (reference: fluid/framework.py Parameter)."""
+
+    __slots__ = ("trainable", "optimize_attr", "regularizer", "need_clip",
+                 "is_distributed", "_param_attrs")
+
+    def __init__(self, value, trainable=True, name=None):
+        super().__init__(value, stop_gradient=not trainable, name=name)
+        self.trainable = trainable
+        self.optimize_attr = {"learning_rate": 1.0}
+        self.regularizer = None
+        self.need_clip = True
+        self.is_distributed = False
+        self.persistable = True
+
+    @property
+    def is_parameter(self):
+        return True
+
+
+def _param_flatten(p):
+    return (p._value,), p.trainable
+
+
+def _param_unflatten(aux, children):
+    return Parameter(children[0], trainable=aux)
+
+
+jax.tree_util.register_pytree_node(Parameter, _param_flatten, _param_unflatten)
+
+
+def create_parameter(shape, dtype=None, name=None, attr=None,
+                     is_bias=False, default_initializer=None):
+    """paddle.create_parameter (reference: python/paddle/tensor/creation.py)."""
+    attr = ParamAttr._to_attr(attr)
+    if attr is False:
+        return None
+    dtype = dtypes.to_jax_dtype(dtype or dtypes.get_default_dtype())
+    init = attr.initializer or default_initializer
+    if init is None:
+        init = I.Constant(0.0) if is_bias else I.XavierNormal()
+    value = init(tuple(int(s) for s in shape), dtype, rnd.next_key())
+    p = Parameter(value, trainable=attr.trainable, name=attr.name or name)
+    p.optimize_attr["learning_rate"] = attr.learning_rate
+    p.regularizer = attr.regularizer
+    p.need_clip = attr.need_clip
+    return p
+
+
+class HookRemoveHelper:
+    _next_id = 0
+
+    def __init__(self, hooks):
+        self._hooks = hooks
+        self._id = HookRemoveHelper._next_id
+        HookRemoveHelper._next_id += 1
+
+    def remove(self):
+        self._hooks.pop(self._id, None)
+
+
+class Layer:
+    def __init__(self, name_scope=None, dtype="float32"):
+        self.training = True
+        self._dtype = dtype
+        self._full_name = name_scope or type(self).__name__.lower()
+        self._parameters = collections.OrderedDict()
+        self._buffers = collections.OrderedDict()
+        self._non_persistable_buffer_names = set()
+        self._sub_layers = collections.OrderedDict()
+        self._forward_pre_hooks = collections.OrderedDict()
+        self._forward_post_hooks = collections.OrderedDict()
+        self._casted_dtype = None
+
+    # ---- construction helpers -------------------------------------------
+    def create_parameter(self, shape, attr=None, dtype=None, is_bias=False,
+                         default_initializer=None):
+        if attr is False:
+            return None
+        return create_parameter(shape, dtype or self._dtype, attr=attr,
+                                is_bias=is_bias,
+                                default_initializer=default_initializer)
+
+    def create_variable(self, name=None, persistable=False, dtype=None):
+        d = dtypes.to_jax_dtype(dtype or self._dtype)
+        return Tensor(jnp.zeros((), d), name=name)
+
+    def add_parameter(self, name, parameter):
+        if parameter is not None and not isinstance(parameter, Parameter):
+            raise TypeError("add_parameter expects a Parameter")
+        self._parameters[name] = parameter
+        self.__dict__.pop(name, None)
+        return parameter
+
+    def add_sublayer(self, name, sublayer):
+        self._sub_layers[str(name)] = sublayer
+        return sublayer
+
+    def register_buffer(self, name, tensor, persistable=True):
+        self._buffers[name] = tensor
+        if not persistable:
+            self._non_persistable_buffer_names.add(name)
+        else:
+            self._non_persistable_buffer_names.discard(name)
+        return tensor
+
+    # ---- attribute magic -------------------------------------------------
+    def __setattr__(self, name, value):
+        params = self.__dict__.get("_parameters")
+        layers = self.__dict__.get("_sub_layers")
+        buffers = self.__dict__.get("_buffers")
+        if isinstance(value, Parameter):
+            if params is None:
+                raise RuntimeError("call Layer.__init__ before assigning params")
+            params[name] = value
+            buffers.pop(name, None) if buffers else None
+            layers.pop(name, None) if layers else None
+            self.__dict__.pop(name, None)
+        elif isinstance(value, Layer):
+            if layers is None:
+                raise RuntimeError("call Layer.__init__ before assigning layers")
+            layers[name] = value
+            self.__dict__.pop(name, None)
+        elif params is not None and name in params:
+            if value is None:
+                params[name] = None
+            else:
+                raise TypeError(f"cannot assign non-Parameter to param {name}")
+        elif buffers is not None and name in buffers:
+            buffers[name] = value
+        else:
+            object.__setattr__(self, name, value)
+
+    def __getattr__(self, name):
+        for store in ("_parameters", "_buffers", "_sub_layers"):
+            d = self.__dict__.get(store)
+            if d is not None and name in d:
+                return d[name]
+        raise AttributeError(
+            f"'{type(self).__name__}' object has no attribute {name!r}")
+
+    def __delattr__(self, name):
+        for store in ("_parameters", "_buffers", "_sub_layers"):
+            d = self.__dict__.get(store)
+            if d is not None and name in d:
+                del d[name]
+                return
+        object.__delattr__(self, name)
+
+    def __dir__(self):
+        return list(super().__dir__()) + list(self._parameters) + \
+            list(self._buffers) + list(self._sub_layers)
+
+    # ---- traversal -------------------------------------------------------
+    def named_parameters(self, prefix="", include_sublayers=True):
+        seen = set()
+        for layer_prefix, layer in (
+                self.named_sublayers(prefix=prefix, include_self=True)
+                if include_sublayers else [(prefix, self)]):
+            for name, p in layer._parameters.items():
+                if p is None or id(p) in seen:
+                    continue
+                seen.add(id(p))
+                yield (layer_prefix + "." + name if layer_prefix else name), p
+
+    def parameters(self, include_sublayers=True):
+        return [p for _, p in self.named_parameters(
+            include_sublayers=include_sublayers)]
+
+    def named_sublayers(self, prefix="", include_self=False, layers_set=None):
+        if layers_set is None:
+            layers_set = set()
+        if id(self) in layers_set:
+            return
+        layers_set.add(id(self))
+        if include_self:
+            yield prefix, self
+        for name, sub in self._sub_layers.items():
+            if sub is None:
+                continue
+            sub_prefix = prefix + "." + name if prefix else name
+            yield from sub.named_sublayers(prefix=sub_prefix,
+                                           include_self=True,
+                                           layers_set=layers_set)
+
+    def sublayers(self, include_self=False):
+        return [l for _, l in self.named_sublayers(include_self=include_self)]
+
+    def children(self):
+        for _, l in self.named_children():
+            yield l
+
+    def named_children(self):
+        for name, l in self._sub_layers.items():
+            if l is not None:
+                yield name, l
+
+    def named_buffers(self, prefix="", include_sublayers=True):
+        seen = set()
+        for layer_prefix, layer in (
+                self.named_sublayers(prefix=prefix, include_self=True)
+                if include_sublayers else [(prefix, self)]):
+            for name, b in layer._buffers.items():
+                if b is None or id(b) in seen:
+                    continue
+                seen.add(id(b))
+                yield (layer_prefix + "." + name if layer_prefix else name), b
+
+    def buffers(self, include_sublayers=True):
+        return [b for _, b in self.named_buffers(
+            include_sublayers=include_sublayers)]
+
+    def apply(self, fn):
+        for l in self.sublayers(include_self=True):
+            fn(l)
+        return self
+
+    def full_name(self):
+        return self._full_name
+
+    # ---- state dict ------------------------------------------------------
+    def state_dict(self, destination=None, include_sublayers=True,
+                   structured_name_prefix="", use_hook=True):
+        dest = destination if destination is not None else collections.OrderedDict()
+        for name, p in self.named_parameters(
+                include_sublayers=include_sublayers):
+            dest[structured_name_prefix + name] = p
+        for name, b in self.named_buffers(
+                include_sublayers=include_sublayers):
+            if _buffer_persistable(self, name):
+                dest[structured_name_prefix + name] = b
+        return dest
+
+    def set_state_dict(self, state_dict, use_structured_name=True):
+        own = self.state_dict()
+        missing, unexpected = [], []
+        for k, v in state_dict.items():
+            if k not in own:
+                unexpected.append(k)
+                continue
+            tgt = own[k]
+            val = v._value if isinstance(v, Tensor) else jnp.asarray(np.asarray(v))
+            if tuple(val.shape) != tuple(tgt._value.shape):
+                raise ValueError(
+                    f"shape mismatch for {k}: {val.shape} vs {tgt._value.shape}")
+            tgt._value = val.astype(tgt._value.dtype)
+        for k in own:
+            if k not in state_dict:
+                missing.append(k)
+        return missing, unexpected
+
+    set_dict = set_state_dict
+    load_dict = set_state_dict
+
+    # ---- modes -----------------------------------------------------------
+    def train(self):
+        for l in self.sublayers(include_self=True):
+            l.training = True
+        return self
+
+    def eval(self):
+        for l in self.sublayers(include_self=True):
+            l.training = False
+        return self
+
+    # ---- dtype/device movement ------------------------------------------
+    def to(self, device=None, dtype=None, blocking=None):
+        if dtype is not None:
+            self._cast_to(dtypes.to_jax_dtype(dtype))
+        return self
+
+    def astype(self, dtype):
+        self._cast_to(dtypes.to_jax_dtype(dtype))
+        return self
+
+    def float(self):
+        return self.astype("float32")
+
+    def half(self):
+        return self.astype("float16")
+
+    def bfloat16(self):
+        return self.astype("bfloat16")
+
+    def _cast_to(self, jd):
+        for p in self.parameters():
+            if jnp.issubdtype(p._value.dtype, jnp.floating):
+                p._value = p._value.astype(jd)
+        for b in self.buffers():
+            if isinstance(b, Tensor) and jnp.issubdtype(
+                    b._value.dtype, jnp.floating):
+                b._value = b._value.astype(jd)
+
+    def clear_gradients(self):
+        for p in self.parameters():
+            p.clear_grad()
+
+    # ---- hooks -----------------------------------------------------------
+    def register_forward_pre_hook(self, hook):
+        helper = HookRemoveHelper(self._forward_pre_hooks)
+        self._forward_pre_hooks[helper._id] = hook
+        return helper
+
+    def register_forward_post_hook(self, hook):
+        helper = HookRemoveHelper(self._forward_post_hooks)
+        self._forward_post_hooks[helper._id] = hook
+        return helper
+
+    # ---- call ------------------------------------------------------------
+    def __call__(self, *inputs, **kwargs):
+        for hook in self._forward_pre_hooks.values():
+            out = hook(self, inputs)
+            if out is not None:
+                inputs = out if isinstance(out, tuple) else (out,)
+        outputs = self.forward(*inputs, **kwargs)
+        for hook in self._forward_post_hooks.values():
+            out = hook(self, inputs, outputs)
+            if out is not None:
+                outputs = out
+        return outputs
+
+    def forward(self, *inputs, **kwargs):
+        raise NotImplementedError
+
+    def extra_repr(self):
+        return ""
+
+    def __repr__(self):
+        extra = self.extra_repr()
+        lines = []
+        for name, sub in self._sub_layers.items():
+            sub_repr = repr(sub).split("\n")
+            sub_repr = "\n  ".join(sub_repr)
+            lines.append(f"({name}): {sub_repr}")
+        main = type(self).__name__ + "(" + extra
+        if lines:
+            main += "\n  " + "\n  ".join(lines) + "\n"
+        return main + ")"
+
+    # ---- functional bridge (TPU blessed path) ---------------------------
+    def functional_call(self, params_and_buffers, *inputs, **kwargs):
+        """Run forward with tensor values swapped in from a flat dict
+        {structured_name: array}. Returns (outputs, new_buffer_values).
+
+        Used by hapi/jit/static to trace the layer into a pure XLA function:
+        parameters become function inputs, buffer mutations (BN running
+        stats) become extra outputs.
+        """
+        own_p = dict(self.named_parameters())
+        own_b = {n: b for n, b in self.named_buffers()
+                 if isinstance(b, Tensor)}
+        saved = {}
+        targets = {**own_p, **own_b}
+        for k, v in params_and_buffers.items():
+            t = targets.get(k)
+            if t is None:
+                continue
+            saved[k] = (t, t._value, t.stop_gradient)
+            t._value = v._value if isinstance(v, Tensor) else v
+        try:
+            out = self(*inputs, **kwargs)
+            new_buffers = {n: own_b[n]._value for n in own_b}
+        finally:
+            for k, (t, old, sg) in saved.items():
+                t._value = old
+                t.stop_gradient = sg
+        return out, new_buffers
+
+
+def _buffer_persistable(layer, qual_name):
+    parts = qual_name.split(".")
+    l = layer
+    for p in parts[:-1]:
+        l = l._sub_layers.get(p)
+        if l is None:
+            return True
+    return parts[-1] not in l._non_persistable_buffer_names

@@ -1,0 +1,199 @@
+"""Pooling layers (reference: python/paddle/nn/layer/pooling.py)."""
+from __future__ import annotations
+
+from .. import functional as F
+from .layers import Layer
+
+__all__ = ["AvgPool1D", "AvgPool2D", "AvgPool3D", "MaxPool1D", "MaxPool2D",
+           "MaxPool3D", "AdaptiveAvgPool1D", "AdaptiveAvgPool2D",
+           "AdaptiveAvgPool3D", "AdaptiveMaxPool1D", "AdaptiveMaxPool2D",
+           "AdaptiveMaxPool3D", "MaxUnPool1D", "MaxUnPool2D", "MaxUnPool3D",
+           "LPPool1D", "LPPool2D"]
+
+
+class _Pool(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0, **kw):
+        super().__init__()
+        self.kernel_size = kernel_size
+        self.stride = stride
+        self.padding = padding
+        self.kw = kw
+
+    def extra_repr(self):
+        return (f"kernel_size={self.kernel_size}, stride={self.stride}, "
+                f"padding={self.padding}")
+
+
+class AvgPool1D(_Pool):
+    def __init__(self, kernel_size, stride=None, padding=0, exclusive=True,
+                 ceil_mode=False, name=None):
+        super().__init__(kernel_size, stride, padding, exclusive=exclusive,
+                         ceil_mode=ceil_mode)
+
+    def forward(self, x):
+        return F.avg_pool1d(x, self.kernel_size, self.stride, self.padding,
+                            **self.kw)
+
+
+class AvgPool2D(_Pool):
+    def __init__(self, kernel_size, stride=None, padding=0, ceil_mode=False,
+                 exclusive=True, divisor_override=None, data_format="NCHW",
+                 name=None):
+        super().__init__(kernel_size, stride, padding, ceil_mode=ceil_mode,
+                         exclusive=exclusive, data_format=data_format)
+
+    def forward(self, x):
+        return F.avg_pool2d(x, self.kernel_size, self.stride, self.padding,
+                            **self.kw)
+
+
+class AvgPool3D(_Pool):
+    def __init__(self, kernel_size, stride=None, padding=0, ceil_mode=False,
+                 exclusive=True, divisor_override=None, data_format="NCDHW",
+                 name=None):
+        super().__init__(kernel_size, stride, padding, ceil_mode=ceil_mode,
+                         exclusive=exclusive, data_format=data_format)
+
+    def forward(self, x):
+        return F.avg_pool3d(x, self.kernel_size, self.stride, self.padding,
+                            **self.kw)
+
+
+class MaxPool1D(_Pool):
+    def __init__(self, kernel_size, stride=None, padding=0, return_mask=False,
+                 ceil_mode=False, name=None):
+        super().__init__(kernel_size, stride, padding, return_mask=return_mask,
+                         ceil_mode=ceil_mode)
+
+    def forward(self, x):
+        return F.max_pool1d(x, self.kernel_size, self.stride, self.padding,
+                            **self.kw)
+
+
+class MaxPool2D(_Pool):
+    def __init__(self, kernel_size, stride=None, padding=0, return_mask=False,
+                 ceil_mode=False, data_format="NCHW", name=None):
+        super().__init__(kernel_size, stride, padding, return_mask=return_mask,
+                         ceil_mode=ceil_mode, data_format=data_format)
+
+    def forward(self, x):
+        return F.max_pool2d(x, self.kernel_size, self.stride, self.padding,
+                            **self.kw)
+
+
+class MaxPool3D(_Pool):
+    def __init__(self, kernel_size, stride=None, padding=0, return_mask=False,
+                 ceil_mode=False, data_format="NCDHW", name=None):
+        super().__init__(kernel_size, stride, padding, return_mask=return_mask,
+                         ceil_mode=ceil_mode, data_format=data_format)
+
+    def forward(self, x):
+        return F.max_pool3d(x, self.kernel_size, self.stride, self.padding,
+                            **self.kw)
+
+
+class _AdaptivePool(Layer):
+    def __init__(self, output_size, **kw):
+        super().__init__()
+        self.output_size = output_size
+        self.kw = kw
+
+    def extra_repr(self):
+        return f"output_size={self.output_size}"
+
+
+class AdaptiveAvgPool1D(_AdaptivePool):
+    def forward(self, x):
+        return F.adaptive_avg_pool1d(x, self.output_size)
+
+
+class AdaptiveAvgPool2D(_AdaptivePool):
+    def __init__(self, output_size, data_format="NCHW", name=None):
+        super().__init__(output_size, data_format=data_format)
+
+    def forward(self, x):
+        return F.adaptive_avg_pool2d(x, self.output_size, **self.kw)
+
+
+class AdaptiveAvgPool3D(_AdaptivePool):
+    def __init__(self, output_size, data_format="NCDHW", name=None):
+        super().__init__(output_size, data_format=data_format)
+
+    def forward(self, x):
+        return F.adaptive_avg_pool3d(x, self.output_size, **self.kw)
+
+
+class AdaptiveMaxPool1D(_AdaptivePool):
+    def __init__(self, output_size, return_mask=False, name=None):
+        super().__init__(output_size, return_mask=return_mask)
+
+    def forward(self, x):
+        return F.adaptive_max_pool1d(x, self.output_size, **self.kw)
+
+
+class AdaptiveMaxPool2D(_AdaptivePool):
+    def __init__(self, output_size, return_mask=False, name=None):
+        super().__init__(output_size, return_mask=return_mask)
+
+    def forward(self, x):
+        return F.adaptive_max_pool2d(x, self.output_size, **self.kw)
+
+
+class AdaptiveMaxPool3D(_AdaptivePool):
+    def __init__(self, output_size, return_mask=False, name=None):
+        super().__init__(output_size, return_mask=return_mask)
+
+    def forward(self, x):
+        return F.adaptive_max_pool3d(x, self.output_size, **self.kw)
+
+
+class MaxUnPool1D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NCL", output_size=None, name=None):
+        super().__init__()
+        self.args = (kernel_size, stride, padding, data_format, output_size)
+
+    def forward(self, x, indices):
+        return F.max_unpool1d(x, indices, *self.args)
+
+
+class MaxUnPool2D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+        super().__init__()
+        self.args = (kernel_size, stride, padding, data_format, output_size)
+
+    def forward(self, x, indices):
+        return F.max_unpool2d(x, indices, *self.args)
+
+
+class MaxUnPool3D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NCDHW", output_size=None, name=None):
+        super().__init__()
+        self.args = (kernel_size, stride, padding, data_format, output_size)
+
+    def forward(self, x, indices):
+        return F.max_unpool3d(x, indices, *self.args)
+
+
+class LPPool1D(Layer):
+    def __init__(self, norm_type, kernel_size, stride=None, padding=0,
+                 ceil_mode=False, data_format="NCL", name=None):
+        super().__init__()
+        self.args = (norm_type, kernel_size, stride, padding, ceil_mode,
+                     data_format)
+
+    def forward(self, x):
+        return F.lp_pool1d(x, *self.args)
+
+
+class LPPool2D(Layer):
+    def __init__(self, norm_type, kernel_size, stride=None, padding=0,
+                 ceil_mode=False, data_format="NCHW", name=None):
+        super().__init__()
+        self.args = (norm_type, kernel_size, stride, padding, ceil_mode,
+                     data_format)
+
+    def forward(self, x):
+        return F.lp_pool2d(x, *self.args)

@@ -1,0 +1,384 @@
+"""RNN layers (reference: python/paddle/nn/layer/rnn.py).
+
+TPU-native: the whole time loop is ONE `lax.scan` inside a single tape op —
+XLA compiles the recurrence once regardless of sequence length (no Python
+per-step dispatch), and grads flow through the scan's built-in vjp.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ...core.autograd import apply
+from ...core.tensor import Tensor
+from .. import initializer as I
+from .layers import Layer
+
+__all__ = ["RNNCellBase", "SimpleRNNCell", "LSTMCell", "GRUCell", "RNN",
+           "BiRNN", "SimpleRNN", "LSTM", "GRU"]
+
+
+class RNNCellBase(Layer):
+    def get_initial_states(self, batch_ref, shape=None, dtype=None,
+                           init_value=0.0, batch_dim_idx=0):
+        batch = batch_ref.shape[batch_dim_idx]
+        from ... import tensor as T
+
+        st = self.state_shape
+        if isinstance(st[0], (list, tuple)):
+            return tuple(T.full([batch] + list(s), init_value) for s in st)
+        return T.full([batch] + list(st), init_value)
+
+
+def _uniform_std(hidden_size):
+    return I.Uniform(-1.0 / math.sqrt(hidden_size), 1.0 / math.sqrt(hidden_size))
+
+
+class SimpleRNNCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.activation = activation
+        init = _uniform_std(hidden_size)
+        self.weight_ih = self.create_parameter(
+            [hidden_size, input_size], attr=weight_ih_attr,
+            default_initializer=init)
+        self.weight_hh = self.create_parameter(
+            [hidden_size, hidden_size], attr=weight_hh_attr,
+            default_initializer=init)
+        self.bias_ih = self.create_parameter(
+            [hidden_size], attr=bias_ih_attr, is_bias=True,
+            default_initializer=init)
+        self.bias_hh = self.create_parameter(
+            [hidden_size], attr=bias_hh_attr, is_bias=True,
+            default_initializer=init)
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        act = jnp.tanh if self.activation == "tanh" else jax.nn.relu
+
+        def _f(x, h, wi, wh, bi, bh):
+            z = x @ wi.T + bi + h @ wh.T + bh
+            return act(z)
+        h = apply(_f, inputs, states, self.weight_ih, self.weight_hh,
+                  self.bias_ih, self.bias_hh)
+        return h, h
+
+
+class LSTMCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 proj_size=None, name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        init = _uniform_std(hidden_size)
+        self.weight_ih = self.create_parameter(
+            [4 * hidden_size, input_size], attr=weight_ih_attr,
+            default_initializer=init)
+        self.weight_hh = self.create_parameter(
+            [4 * hidden_size, hidden_size], attr=weight_hh_attr,
+            default_initializer=init)
+        self.bias_ih = self.create_parameter(
+            [4 * hidden_size], attr=bias_ih_attr, is_bias=True,
+            default_initializer=init)
+        self.bias_hh = self.create_parameter(
+            [4 * hidden_size], attr=bias_hh_attr, is_bias=True,
+            default_initializer=init)
+
+    @property
+    def state_shape(self):
+        return ((self.hidden_size,), (self.hidden_size,))
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        h, c = states
+
+        def _f(x, h0, c0, wi, wh, bi, bh):
+            z = x @ wi.T + bi + h0 @ wh.T + bh
+            i, f, g, o = jnp.split(z, 4, axis=-1)
+            i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+            g = jnp.tanh(g)
+            c1 = f * c0 + i * g
+            h1 = o * jnp.tanh(c1)
+            return h1, c1
+        h1, c1 = apply(_f, inputs, h, c, self.weight_ih, self.weight_hh,
+                       self.bias_ih, self.bias_hh)
+        return h1, (h1, c1)
+
+
+class GRUCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        init = _uniform_std(hidden_size)
+        self.weight_ih = self.create_parameter(
+            [3 * hidden_size, input_size], attr=weight_ih_attr,
+            default_initializer=init)
+        self.weight_hh = self.create_parameter(
+            [3 * hidden_size, hidden_size], attr=weight_hh_attr,
+            default_initializer=init)
+        self.bias_ih = self.create_parameter(
+            [3 * hidden_size], attr=bias_ih_attr, is_bias=True,
+            default_initializer=init)
+        self.bias_hh = self.create_parameter(
+            [3 * hidden_size], attr=bias_hh_attr, is_bias=True,
+            default_initializer=init)
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+
+        def _f(x, h0, wi, wh, bi, bh):
+            xz = x @ wi.T + bi
+            hz = h0 @ wh.T + bh
+            xr, xu, xc = jnp.split(xz, 3, -1)
+            hr, hu, hc = jnp.split(hz, 3, -1)
+            r = jax.nn.sigmoid(xr + hr)
+            u = jax.nn.sigmoid(xu + hu)
+            c = jnp.tanh(xc + r * hc)
+            return u * h0 + (1 - u) * c
+        h = apply(_f, inputs, states, self.weight_ih, self.weight_hh,
+                  self.bias_ih, self.bias_hh)
+        return h, h
+
+
+def _cell_scan_fn(cell):
+    """Pure scan body for a cell type, operating on raw arrays."""
+    if isinstance(cell, LSTMCell):
+        def body(ws, state, x):
+            wi, wh, bi, bh = ws
+            h0, c0 = state
+            z = x @ wi.T + bi + h0 @ wh.T + bh
+            i, f, g, o = jnp.split(z, 4, axis=-1)
+            i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+            g = jnp.tanh(g)
+            c1 = f * c0 + i * g
+            h1 = o * jnp.tanh(c1)
+            return (h1, c1), h1
+        return body
+    if isinstance(cell, GRUCell):
+        def body(ws, state, x):
+            wi, wh, bi, bh = ws
+            (h0,) = state
+            xz = x @ wi.T + bi
+            hz = h0 @ wh.T + bh
+            xr, xu, xc = jnp.split(xz, 3, -1)
+            hr, hu, hc = jnp.split(hz, 3, -1)
+            r = jax.nn.sigmoid(xr + hr)
+            u = jax.nn.sigmoid(xu + hu)
+            c = jnp.tanh(xc + r * hc)
+            h1 = u * h0 + (1 - u) * c
+            return (h1,), h1
+        return body
+    act = jnp.tanh if getattr(cell, "activation", "tanh") == "tanh" \
+        else jax.nn.relu
+
+    def body(ws, state, x):
+        wi, wh, bi, bh = ws
+        (h0,) = state
+        h1 = act(x @ wi.T + bi + h0 @ wh.T + bh)
+        return (h1,), h1
+    return body
+
+
+class RNN(Layer):
+    """Wraps a cell into a full-sequence scan (reference: nn/layer/rnn.py::RNN)."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        cell = self.cell
+        body = _cell_scan_fn(cell)
+        is_lstm = isinstance(cell, LSTMCell)
+        time_major = self.time_major
+        reverse = self.is_reverse
+
+        if initial_states is None:
+            batch_axis = 1 if time_major else 0
+            batch = inputs.shape[batch_axis]
+            n_states = 2 if is_lstm else 1
+            zeros = [jnp.zeros((batch, cell.hidden_size),
+                               inputs._value.dtype) for _ in range(n_states)]
+            init = tuple(Tensor(z) for z in zeros)
+        else:
+            init = initial_states if isinstance(initial_states, (tuple, list)) \
+                else (initial_states,)
+
+        def _f(x, *args):
+            n_states = 2 if is_lstm else 1
+            states = tuple(args[:n_states])
+            wi, wh, bi, bh = args[n_states:]
+            xs = x if time_major else jnp.swapaxes(x, 0, 1)
+            if reverse:
+                xs = jnp.flip(xs, 0)
+
+            def step(carry, xt):
+                new, out = body((wi, wh, bi, bh), carry, xt)
+                return new, out
+            final, outs = jax.lax.scan(step, states, xs)
+            if reverse:
+                outs = jnp.flip(outs, 0)
+            if not time_major:
+                outs = jnp.swapaxes(outs, 0, 1)
+            return (outs,) + final
+
+        res = apply(_f, inputs, *init, cell.weight_ih, cell.weight_hh,
+                    cell.bias_ih, cell.bias_hh)
+        outs = res[0]
+        final = res[1:]
+        final_states = (final[0], final[1]) if is_lstm else final[0]
+        return outs, final_states
+
+
+class BiRNN(Layer):
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.rnn_fw = RNN(cell_fw, False, time_major)
+        self.rnn_bw = RNN(cell_bw, True, time_major)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ... import tensor as T
+
+        st_fw = st_bw = None
+        if initial_states is not None:
+            st_fw, st_bw = initial_states
+        out_fw, fs_fw = self.rnn_fw(inputs, st_fw)
+        out_bw, fs_bw = self.rnn_bw(inputs, st_bw)
+        return T.concat([out_fw, out_bw], axis=-1), (fs_fw, fs_bw)
+
+
+class _RNNBase(Layer):
+    def __init__(self, mode, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh", weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        self.mode = mode
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.direction = direction
+        self.time_major = time_major
+        self.dropout = dropout
+        bidirect = direction in ("bidirect", "bidirectional")
+        self.num_directions = 2 if bidirect else 1
+        kw = dict(weight_ih_attr=weight_ih_attr, weight_hh_attr=weight_hh_attr,
+                  bias_ih_attr=bias_ih_attr, bias_hh_attr=bias_hh_attr)
+
+        def make_cell(in_size):
+            if mode == "LSTM":
+                return LSTMCell(in_size, hidden_size, **kw)
+            if mode == "GRU":
+                return GRUCell(in_size, hidden_size, **kw)
+            return SimpleRNNCell(in_size, hidden_size, activation, **kw)
+
+        from .container import LayerList
+
+        self.rnns = LayerList()
+        for layer in range(num_layers):
+            in_size = input_size if layer == 0 else \
+                hidden_size * self.num_directions
+            if bidirect:
+                self.rnns.append(BiRNN(make_cell(in_size), make_cell(in_size),
+                                       time_major))
+            else:
+                self.rnns.append(RNN(make_cell(in_size),
+                                     time_major=time_major))
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from .. import functional as F
+
+        out = inputs
+        finals = []
+        for i, rnn in enumerate(self.rnns):
+            st = None
+            if initial_states is not None:
+                st = self._layer_states(initial_states, i)
+            out, fs = rnn(out, st)
+            finals.append(fs)
+            if self.dropout > 0 and i < self.num_layers - 1:
+                out = F.dropout(out, self.dropout, training=self.training)
+        return out, self._pack_finals(finals)
+
+    def _layer_states(self, initial_states, i):
+        from ... import tensor as T
+
+        # states: [num_layers*num_directions, batch, hidden]
+        if self.mode == "LSTM":
+            h, c = initial_states
+            if self.num_directions == 2:
+                return ((h[2 * i], c[2 * i]), (h[2 * i + 1], c[2 * i + 1]))
+            return (h[i], c[i])
+        h = initial_states
+        if self.num_directions == 2:
+            return (h[2 * i], h[2 * i + 1])
+        return h[i]
+
+    def _pack_finals(self, finals):
+        from ... import tensor as T
+
+        if self.mode == "LSTM":
+            hs, cs = [], []
+            for fs in finals:
+                if self.num_directions == 2:
+                    (h_f, c_f), (h_b, c_b) = fs
+                    hs += [h_f, h_b]
+                    cs += [c_f, c_b]
+                else:
+                    h, c = fs
+                    hs.append(h)
+                    cs.append(c)
+            return T.stack(hs, 0), T.stack(cs, 0)
+        hs = []
+        for fs in finals:
+            if self.num_directions == 2:
+                h_f, h_b = fs
+                hs += [h_f, h_b]
+            else:
+                hs.append(fs)
+        return T.stack(hs, 0)
+
+
+class SimpleRNN(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh", **kw):
+        super().__init__("RNN", input_size, hidden_size, num_layers,
+                         direction, time_major, dropout, activation, **kw)
+
+
+class LSTM(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0, **kw):
+        super().__init__("LSTM", input_size, hidden_size, num_layers,
+                         direction, time_major, dropout, **kw)
+
+
+class GRU(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0, **kw):
+        super().__init__("GRU", input_size, hidden_size, num_layers,
+                         direction, time_major, dropout, **kw)

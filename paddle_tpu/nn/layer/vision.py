@@ -1,0 +1,61 @@
+"""Vision layers (reference: python/paddle/nn/layer/vision.py) + distance."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...core.autograd import apply
+from .. import functional as F
+from .layers import Layer
+
+__all__ = ["PixelShuffle", "PixelUnshuffle", "ChannelShuffle",
+           "PairwiseDistance"]
+
+
+class PixelShuffle(Layer):
+    def __init__(self, upscale_factor, data_format="NCHW", name=None):
+        super().__init__()
+        self._factor = upscale_factor
+        self._data_format = data_format
+
+    def forward(self, x):
+        return F.pixel_shuffle(x, self._factor, self._data_format)
+
+
+class PixelUnshuffle(Layer):
+    def __init__(self, downscale_factor, data_format="NCHW", name=None):
+        super().__init__()
+        self._factor = downscale_factor
+        self._data_format = data_format
+
+    def forward(self, x):
+        return F.pixel_unshuffle(x, self._factor, self._data_format)
+
+
+class ChannelShuffle(Layer):
+    def __init__(self, groups, data_format="NCHW", name=None):
+        super().__init__()
+        self._groups = groups
+        self._data_format = data_format
+
+    def forward(self, x):
+        return F.channel_shuffle(x, self._groups, self._data_format)
+
+
+class PairwiseDistance(Layer):
+    """reference: python/paddle/nn/layer/distance.py"""
+
+    def __init__(self, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+        super().__init__()
+        self.p = p
+        self.epsilon = epsilon
+        self.keepdim = keepdim
+
+    def forward(self, x, y):
+        p, eps, keepdim = self.p, self.epsilon, self.keepdim
+
+        def _f(a, b):
+            d = a - b + eps
+            if p == float("inf"):
+                return jnp.max(jnp.abs(d), -1, keepdims=keepdim)
+            return jnp.sum(jnp.abs(d) ** p, -1, keepdims=keepdim) ** (1.0 / p)
+        return apply(_f, x, y)

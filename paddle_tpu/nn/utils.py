@@ -1,0 +1,166 @@
+"""nn.utils (reference: python/paddle/nn/utils/*): weight_norm, spectral_norm,
+clip_grad helpers, vector<->parameters."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.autograd import apply, no_grad
+from ..core.tensor import Tensor
+
+__all__ = ["weight_norm", "remove_weight_norm", "spectral_norm",
+           "clip_grad_norm_", "clip_grad_value_", "parameters_to_vector",
+           "vector_to_parameters"]
+
+
+def _norm_except(w, dim):
+    if dim is None:
+        return jnp.sqrt(jnp.sum(w * w))
+    axes = tuple(i for i in range(w.ndim) if i != dim)
+    return jnp.sqrt(jnp.sum(w * w, axes, keepdims=True))
+
+
+def weight_norm(layer, name="weight", dim=0):
+    """Reparameterize weight = g * v/||v|| via a forward-pre-hook
+    (reference: nn/utils/weight_norm_hook.py)."""
+    from .layer.layers import Parameter
+
+    w = getattr(layer, name)
+    if dim is None:
+        g_val = jnp.sqrt(jnp.sum(w._value ** 2))
+    else:
+        g_val = _norm_except(w._value, dim)
+    g = Parameter(g_val)
+    v = Parameter(w._value)
+    del layer._parameters[name]
+    layer.add_parameter(name + "_g", g)
+    layer.add_parameter(name + "_v", v)
+
+    def _compute(l):
+        def _f(gv, vv):
+            return gv * vv / jnp.maximum(_norm_except(vv, dim), 1e-12)
+        return apply(_f, getattr(l, name + "_g"), getattr(l, name + "_v"))
+
+    def hook(l, inputs):
+        computed = _compute(l)
+        object.__setattr__(l, name, computed)
+        return None
+
+    handle = layer.register_forward_pre_hook(hook)
+    layer._weight_norm_handle = handle
+    layer._weight_norm_cfg = (name, dim)
+    object.__setattr__(layer, name, _compute(layer))
+    return layer
+
+
+def remove_weight_norm(layer, name="weight"):
+    from .layer.layers import Parameter
+
+    handle = getattr(layer, "_weight_norm_handle", None)
+    if handle is not None:
+        handle.remove()
+    g = layer._parameters.pop(name + "_g")
+    v = layer._parameters.pop(name + "_v")
+    _, dim = getattr(layer, "_weight_norm_cfg", (name, 0))
+    w_val = g._value * v._value / np.maximum(
+        np.asarray(_norm_except(v._value, dim)), 1e-12)
+    if hasattr(layer, name):
+        try:
+            object.__delattr__(layer, name)
+        except AttributeError:
+            pass
+    layer.add_parameter(name, Parameter(jnp.asarray(w_val)))
+    return layer
+
+
+def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12,
+                  dim=None):
+    """Spectral normalization hook (reference: nn/utils/spectral_norm_hook.py)."""
+    from ..framework import random as rnd
+    from .layer.layers import Parameter
+    import jax
+
+    if dim is None:
+        dim = 0
+    w = getattr(layer, name)
+    h = w.shape[dim]
+    w_mat = np.moveaxis(np.asarray(w._value), dim, 0).reshape(h, -1)
+    u = Tensor(jax.random.normal(rnd.next_key(), (h,)))
+    v = Tensor(jax.random.normal(rnd.next_key(), (w_mat.shape[1],)))
+    orig = Parameter(w._value)
+    del layer._parameters[name]
+    layer.add_parameter(name + "_orig", orig)
+    layer.register_buffer(name + "_u", u, persistable=False)
+    layer.register_buffer(name + "_v", v, persistable=False)
+
+    def _compute(l):
+        def _f(wv, uv, vv):
+            wm = jnp.moveaxis(wv, dim, 0).reshape(wv.shape[dim], -1)
+            for _ in range(n_power_iterations):
+                vv = wm.T @ uv
+                vv = vv / jnp.maximum(jnp.linalg.norm(vv), eps)
+                uv = wm @ vv
+                uv = uv / jnp.maximum(jnp.linalg.norm(uv), eps)
+            sigma = uv @ wm @ vv
+            return wv / sigma, uv, vv
+        out, nu, nv = apply(_f, getattr(l, name + "_orig"),
+                            l._buffers[name + "_u"], l._buffers[name + "_v"])
+        l._buffers[name + "_u"]._value = nu._value
+        l._buffers[name + "_v"]._value = nv._value
+        return out
+
+    def hook(l, inputs):
+        object.__setattr__(l, name, _compute(l))
+        return None
+
+    layer.register_forward_pre_hook(hook)
+    object.__setattr__(layer, name, _compute(layer))
+    return layer
+
+
+@no_grad()
+def clip_grad_norm_(parameters, max_norm, norm_type=2.0,
+                    error_if_nonfinite=False):
+    if isinstance(parameters, Tensor):
+        parameters = [parameters]
+    grads = [p.grad for p in parameters if p.grad is not None]
+    if not grads:
+        return Tensor(jnp.zeros(()))
+    if norm_type == float("inf"):
+        total = jnp.max(jnp.stack([jnp.max(jnp.abs(g._value))
+                                   for g in grads]))
+    else:
+        total = jnp.sum(jnp.stack(
+            [jnp.sum(jnp.abs(g._value) ** norm_type) for g in grads])) ** \
+            (1.0 / norm_type)
+    if error_if_nonfinite and not bool(jnp.isfinite(total)):
+        raise RuntimeError("non-finite grad norm")
+    scale = jnp.minimum(max_norm / (total + 1e-6), 1.0)
+    for p in parameters:
+        if p.grad is not None:
+            p.grad._value = p.grad._value * scale.astype(p.grad._value.dtype)
+    return Tensor(total)
+
+
+@no_grad()
+def clip_grad_value_(parameters, clip_value):
+    if isinstance(parameters, Tensor):
+        parameters = [parameters]
+    for p in parameters:
+        if p.grad is not None:
+            p.grad._value = jnp.clip(p.grad._value, -clip_value, clip_value)
+
+
+def parameters_to_vector(parameters, name=None):
+    from .. import tensor as T
+
+    return T.concat([T.reshape(p, [-1]) for p in parameters], axis=0)
+
+
+def vector_to_parameters(vec, parameters, name=None):
+    offset = 0
+    for p in parameters:
+        n = p.size
+        p._value = vec._value[offset:offset + n].reshape(p._value.shape) \
+            .astype(p._value.dtype)
+        offset += n

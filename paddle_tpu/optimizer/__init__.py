@@ -1,0 +1,299 @@
+"""paddle.optimizer (reference: python/paddle/optimizer/*).
+
+Each optimizer = a pure update rule fused into one jitted multi-tensor step
+(see optimizer.py). Numerics mirror the reference PHI kernels (e.g.
+phi/kernels/*/adam_kernel*).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from . import lr  # noqa: F401
+from .lr import LRScheduler  # noqa: F401
+from .optimizer import Optimizer
+
+__all__ = ["Optimizer", "SGD", "Momentum", "Adagrad", "Adadelta", "RMSProp",
+           "Adam", "AdamW", "Adamax", "Lamb", "LBFGS", "lr"]
+
+
+class SGD(Optimizer):
+    def _update_rule(self, v, g, s, lr, m, static=None):
+        return v - (lr * m) * g, s
+
+
+class Momentum(Optimizer):
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._momentum = momentum
+        self._use_nesterov = use_nesterov
+
+    def _init_state(self, p):
+        return {"velocity": jnp.zeros_like(p._value)}
+
+    def _update_rule(self, v, g, s, lr, m, static=None):
+        mu = self._momentum
+        vel = mu * s["velocity"] + g
+        if self._use_nesterov:
+            new_v = v - (lr * m) * (g + mu * vel)
+        else:
+            new_v = v - (lr * m) * vel
+        return new_v, {"velocity": vel}
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, parameters=None,
+                 weight_decay=None, grad_clip=None,
+                 initial_accumulator_value=0.0, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._epsilon = epsilon
+        self._init_acc = initial_accumulator_value
+
+    def _init_state(self, p):
+        return {"moment": jnp.full_like(p._value, self._init_acc)}
+
+    def _update_rule(self, v, g, s, lr, m, static=None):
+        mom = s["moment"] + g * g
+        new_v = v - (lr * m) * g / (jnp.sqrt(mom) + self._epsilon)
+        return new_v, {"moment": mom}
+
+
+class Adadelta(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._epsilon = epsilon
+        self._rho = rho
+
+    def _init_state(self, p):
+        return {"avg_sq_grad": jnp.zeros_like(p._value),
+                "avg_sq_update": jnp.zeros_like(p._value)}
+
+    def _update_rule(self, v, g, s, lr, m, static=None):
+        rho, eps = self._rho, self._epsilon
+        asg = rho * s["avg_sq_grad"] + (1 - rho) * g * g
+        update = -jnp.sqrt(s["avg_sq_update"] + eps) / jnp.sqrt(asg + eps) * g
+        asu = rho * s["avg_sq_update"] + (1 - rho) * update * update
+        return v + (lr * m) * update, {"avg_sq_grad": asg,
+                                       "avg_sq_update": asu}
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._rho = rho
+        self._epsilon = epsilon
+        self._momentum = momentum
+        self._centered = centered
+
+    def _init_state(self, p):
+        st = {"mean_square": jnp.zeros_like(p._value),
+              "momentum": jnp.zeros_like(p._value)}
+        if self._centered:
+            st["mean_grad"] = jnp.zeros_like(p._value)
+        return st
+
+    def _update_rule(self, v, g, s, lr, m, static=None):
+        rho, eps, mu = self._rho, self._epsilon, self._momentum
+        ms = rho * s["mean_square"] + (1 - rho) * g * g
+        new_s = {"mean_square": ms}
+        if self._centered:
+            mg = rho * s["mean_grad"] + (1 - rho) * g
+            denom = jnp.sqrt(ms - mg * mg + eps)
+            new_s["mean_grad"] = mg
+        else:
+            denom = jnp.sqrt(ms + eps)
+        mom = mu * s["momentum"] + (lr * m) * g / denom
+        new_s["momentum"] = mom
+        return v - mom, new_s
+
+
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, lazy_mode=False, multi_precision=False,
+                 use_multi_tensor=False, name=None, amsgrad=False):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+
+    def _init_state(self, p):
+        return {"moment1": jnp.zeros_like(p._value),
+                "moment2": jnp.zeros_like(p._value),
+                "beta1_pow": jnp.ones((), jnp.float32),
+                "beta2_pow": jnp.ones((), jnp.float32)}
+
+    def _update_rule(self, v, g, s, lr, m, static=None):
+        b1, b2, eps = self._beta1, self._beta2, self._epsilon
+        b1p = s["beta1_pow"] * b1
+        b2p = s["beta2_pow"] * b2
+        m1 = b1 * s["moment1"] + (1 - b1) * g
+        m2 = b2 * s["moment2"] + (1 - b2) * g * g
+        lr_t = (lr * m) * jnp.sqrt(1 - b2p) / (1 - b1p)
+        new_v = v - lr_t.astype(v.dtype) * m1 / (
+            jnp.sqrt(m2) + eps * jnp.sqrt(1 - b2p).astype(v.dtype))
+        return new_v, {"moment1": m1, "moment2": m2, "beta1_pow": b1p,
+                       "beta2_pow": b2p}
+
+
+class AdamW(Adam):
+    """Decoupled weight decay (reference: python/paddle/optimizer/adamw.py)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=0.01,
+                 lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
+                 lazy_mode=False, multi_precision=False, name=None,
+                 amsgrad=False):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         None, grad_clip, name=name)
+        self._coeff = float(weight_decay) if not hasattr(
+            weight_decay, "coeff") else weight_decay.coeff
+        self._apply_decay_fn = apply_decay_param_fun
+        self._decay_skip = set()
+        if apply_decay_param_fun is not None and parameters is not None:
+            for p in self._parameter_list:
+                if not apply_decay_param_fun(p.name):
+                    self._decay_skip.add(id(p))
+
+    def _wd_coeff(self, p):
+        return 0.0  # decoupled: not folded into grads
+
+    def _param_static(self, p):
+        if self._apply_decay_fn is not None:
+            return bool(self._apply_decay_fn(p.name))
+        return True
+
+    def _update_rule(self, v, g, s, lr, m, static=None):
+        if static is None or static:
+            v = v * (1.0 - (lr * m) * self._coeff).astype(v.dtype)
+        return super()._update_rule(v, g, s, lr, m)
+
+
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+
+    def _init_state(self, p):
+        return {"moment": jnp.zeros_like(p._value),
+                "inf_norm": jnp.zeros_like(p._value),
+                "beta1_pow": jnp.ones((), jnp.float32)}
+
+    def _update_rule(self, v, g, s, lr, m, static=None):
+        b1, b2, eps = self._beta1, self._beta2, self._epsilon
+        b1p = s["beta1_pow"] * b1
+        mom = b1 * s["moment"] + (1 - b1) * g
+        inf = jnp.maximum(b2 * s["inf_norm"], jnp.abs(g) + eps)
+        new_v = v - ((lr * m) / (1 - b1p)).astype(v.dtype) * mom / inf
+        return new_v, {"moment": mom, "inf_norm": inf, "beta1_pow": b1p}
+
+
+class Lamb(Optimizer):
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01,
+                 beta1=0.9, beta2=0.999, epsilon=1e-6, parameters=None,
+                 grad_clip=None, exclude_from_weight_decay_fn=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, name)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+        self._lamb_wd = lamb_weight_decay
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _init_state(self, p):
+        return {"moment1": jnp.zeros_like(p._value),
+                "moment2": jnp.zeros_like(p._value),
+                "beta1_pow": jnp.ones((), jnp.float32),
+                "beta2_pow": jnp.ones((), jnp.float32)}
+
+    def _update_rule(self, v, g, s, lr, m, static=None):
+        b1, b2, eps, wd = self._beta1, self._beta2, self._epsilon, self._lamb_wd
+        b1p = s["beta1_pow"] * b1
+        b2p = s["beta2_pow"] * b2
+        m1 = b1 * s["moment1"] + (1 - b1) * g
+        m2 = b2 * s["moment2"] + (1 - b2) * g * g
+        m1h = m1 / (1 - b1p)
+        m2h = m2 / (1 - b2p)
+        r = m1h / (jnp.sqrt(m2h) + eps) + wd * v
+        w_norm = jnp.sqrt(jnp.sum(v.astype(jnp.float32) ** 2))
+        r_norm = jnp.sqrt(jnp.sum(r.astype(jnp.float32) ** 2))
+        ratio = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+        new_v = v - (lr * m * ratio).astype(v.dtype) * r
+        return new_v, {"moment1": m1, "moment2": m2, "beta1_pow": b1p,
+                       "beta2_pow": b2p}
+
+
+class LBFGS(Optimizer):
+    """Minimal LBFGS (reference: incubate/optimizer/lbfgs.py): closure-based."""
+
+    def __init__(self, learning_rate=1.0, max_iter=20, history_size=100,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 tolerance_grad=1e-7, tolerance_change=1e-9,
+                 line_search_fn=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._max_iter = max_iter
+        self._history = []
+        self._prev_flat_g = None
+        self._prev_flat_x = None
+        self._hist_size = history_size
+
+    def step(self, closure=None):
+        import jax
+
+        if closure is not None:
+            closure()
+        params = [p for p in self._param_list
+                  if not p.stop_gradient and p._grad is not None]
+        if not params:
+            return
+        flat_g = jnp.concatenate([p._grad._value.ravel().astype(jnp.float32)
+                                  for p in params])
+        flat_x = jnp.concatenate([p._value.ravel().astype(jnp.float32)
+                                  for p in params])
+        if self._prev_flat_g is not None:
+            sk = flat_x - self._prev_flat_x
+            yk = flat_g - self._prev_flat_g
+            if float(sk @ yk) > 1e-10:
+                self._history.append((sk, yk))
+                if len(self._history) > self._hist_size:
+                    self._history.pop(0)
+        q = flat_g
+        alphas = []
+        for sk, yk in reversed(self._history):
+            rho = 1.0 / (sk @ yk)
+            a = rho * (sk @ q)
+            q = q - a * yk
+            alphas.append((a, rho, sk, yk))
+        if self._history:
+            sk, yk = self._history[-1]
+            q = q * ((sk @ yk) / (yk @ yk))
+        for a, rho, sk, yk in reversed(alphas):
+            b = rho * (yk @ q)
+            q = q + (a - b) * sk
+        direction = -q
+        self._prev_flat_g, self._prev_flat_x = flat_g, flat_x
+        lr = self.get_lr()
+        new_flat = flat_x + lr * direction
+        off = 0
+        for p in params:
+            n = p.size
+            p._value = new_flat[off:off + n].reshape(p._value.shape).astype(
+                p._value.dtype)
+            off += n
+        self._global_step += 1

@@ -1,0 +1,265 @@
+"""Optimizer base (reference: python/paddle/optimizer/optimizer.py).
+
+TPU-native: every optimizer defines a pure per-param update rule; `step()`
+runs ONE jitted multi-tensor update over all params/grads/states (buffer-
+donated, so XLA updates in place in HBM) — the analogue of the reference's
+fused/multi_tensor kernels, but compiler-scheduled.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..nn.clip import ClipGradBase
+from .lr import LRScheduler
+
+__all__ = ["Optimizer"]
+
+
+class Optimizer:
+    _state_names = ()  # per-param state slot names
+
+    def __init__(self, learning_rate=0.001, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None,
+                 multi_precision=False, **kwargs):
+        if parameters is not None:
+            parameters = list(parameters)
+            if parameters and isinstance(parameters[0], dict):
+                # param groups: flatten; per-group learning_rate acts as a
+                # multiplier on the base lr (stored in optimize_attr, same
+                # mechanism as ParamAttr.learning_rate), per-group
+                # weight_decay overrides the optimizer-level one.
+                self._param_groups = parameters
+                flat = []
+                for g in parameters:
+                    g_lr = g.get("learning_rate")
+                    g_wd = g.get("weight_decay")
+                    for p in g["params"]:
+                        if g_lr is not None:
+                            p.optimize_attr["learning_rate"] = float(g_lr)
+                        if g_wd is not None:
+                            from ..framework.param_attr import L2Decay
+
+                            p.regularizer = g_wd if hasattr(g_wd, "coeff") \
+                                else L2Decay(float(g_wd))
+                        flat.append(p)
+                parameters = flat
+            else:
+                self._param_groups = None
+        self._parameter_list = parameters
+        self._learning_rate = learning_rate
+        self._weight_decay = weight_decay
+        self._grad_clip = grad_clip
+        self._accumulators = {}   # param id -> {slot: jnp array}
+        self._global_step = 0
+        self._step_fn_cache = {}
+        self._name = name or type(self).__name__
+
+    # ---- lr ------------------------------------------------------------
+    def get_lr(self):
+        lr = self._learning_rate
+        if isinstance(lr, LRScheduler):
+            return float(lr())
+        return float(lr)
+
+    def set_lr(self, value):
+        if isinstance(self._learning_rate, LRScheduler):
+            raise RuntimeError(
+                "can't set_lr when learning rate is an LRScheduler")
+        self._learning_rate = float(value)
+
+    def set_lr_scheduler(self, scheduler):
+        self._learning_rate = scheduler
+
+    # ---- state ---------------------------------------------------------
+    def _init_state(self, p):
+        """Returns dict of state arrays for one param. Override."""
+        return {}
+
+    def _states_for(self, p):
+        st = self._accumulators.get(id(p))
+        if st is None:
+            st = self._init_state(p)
+            self._accumulators[id(p)] = st
+        return st
+
+    def _update_rule(self, value, grad, state, lr, lr_mult, static=None):
+        """Pure: (value, grad, state dict, lr scalar) -> (new_value, new_state).
+        Override per optimizer. `static` carries trace-time per-param options
+        from _param_static (e.g. AdamW decay exclusion)."""
+        raise NotImplementedError
+
+    def _param_static(self, p):
+        """Static per-param options baked into the fused step at trace time."""
+        return None
+
+    # ---- regularization -------------------------------------------------
+    def _wd_coeff(self, p):
+        """L2-style decay folded into grads (non-decoupled optimizers)."""
+        reg = getattr(p, "regularizer", None)
+        if reg is not None:
+            from ..framework.param_attr import L2Decay
+
+            return reg.coeff if isinstance(reg, L2Decay) else 0.0
+        wd = self._weight_decay
+        if wd is None:
+            return 0.0
+        if isinstance(wd, float) or isinstance(wd, int):
+            return float(wd)
+        from ..framework.param_attr import L2Decay
+
+        if isinstance(wd, L2Decay):
+            return wd.coeff
+        return 0.0
+
+    def _l1_coeff(self, p):
+        from ..framework.param_attr import L1Decay
+
+        reg = getattr(p, "regularizer", None)
+        if isinstance(reg, L1Decay):
+            return reg.coeff
+        if isinstance(self._weight_decay, L1Decay):
+            return self._weight_decay.coeff
+        return 0.0
+
+    # ---- the fused step -------------------------------------------------
+    def _build_step_fn(self, n, lr_mults, wd_coeffs, l1_coeffs, clip,
+                       need_clip_flags, statics):
+        rule = self._update_rule
+
+        def fused(values, states, grads, lr):
+            # fold regularization into grads
+            gs = []
+            for g, v, wd, l1 in zip(grads, values, wd_coeffs, l1_coeffs):
+                if wd:
+                    g = g + wd * v
+                if l1:
+                    g = g + l1 * jnp.sign(v)
+                gs.append(g)
+            if clip is not None:
+                clipped = clip.clip_values(
+                    {i: g for i, (g, f) in enumerate(zip(gs, need_clip_flags))
+                     if f})
+                gs = [clipped.get(i, g) if need_clip_flags[i] else g
+                      for i, g in enumerate(gs)]
+            new_vals, new_states = [], []
+            for v, s, g, m, st in zip(values, states, gs, lr_mults, statics):
+                nv, ns = rule(v, g, s, lr, m, st)
+                new_vals.append(nv.astype(v.dtype))
+                new_states.append(ns)
+            return new_vals, new_states
+
+        return jax.jit(fused, donate_argnums=(0, 1))
+
+    @property
+    def _param_list(self):
+        if self._parameter_list is None:
+            raise RuntimeError(
+                "Optimizer created without parameters; pass parameters= or "
+                "use minimize(loss, parameters=...)")
+        return self._parameter_list
+
+    def step(self):
+        params = [p for p in self._param_list
+                  if not p.stop_gradient and p._grad is not None
+                  and getattr(p, "trainable", True)]
+        if not params:
+            return
+        key = tuple(id(p) for p in params)
+        entry = self._step_fn_cache.get(key)
+        if entry is None:
+            lr_mults = tuple(
+                float(getattr(p, "optimize_attr", {}).get("learning_rate", 1.0))
+                for p in params)
+            wd = tuple(self._wd_coeff(p) for p in params)
+            l1 = tuple(self._l1_coeff(p) for p in params)
+            flags = tuple(bool(getattr(p, "need_clip", True)) for p in params)
+            statics = tuple(self._param_static(p) for p in params)
+            clip = self._grad_clip if isinstance(self._grad_clip,
+                                                 ClipGradBase) else None
+            fn = self._build_step_fn(len(params), lr_mults, wd, l1, clip,
+                                     flags, statics)
+            entry = fn
+            self._step_fn_cache[key] = entry
+        values = [p._value for p in params]
+        states = [self._states_for(p) for p in params]
+        grads = [p._grad._value.astype(p._value.dtype) for p in params]
+        lr = jnp.asarray(self.get_lr(), jnp.float32)
+        new_vals, new_states = entry(values, states, grads, lr)
+        for p, nv, ns in zip(params, new_vals, new_states):
+            p._value = nv
+            self._accumulators[id(p)] = ns
+        self._global_step += 1
+
+    def clear_grad(self, set_to_zero=True):
+        for p in self._param_list:
+            p.clear_grad()
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        loss.backward()
+        self.step()
+        return None, [(p, p.grad) for p in self._param_list]
+
+    # ---- state dict ------------------------------------------------------
+    def state_dict(self):
+        sd = {}
+        for i, p in enumerate(self._param_list):
+            st = self._accumulators.get(id(p))
+            if st:
+                for k, v in st.items():
+                    sd[f"{p.name}_{k}"] = Tensor(v)
+        sd["global_step"] = self._global_step
+        if isinstance(self._learning_rate, LRScheduler):
+            sd["LR_Scheduler"] = self._learning_rate.state_dict()
+        return sd
+
+    def set_state_dict(self, state_dict):
+        for p in self._param_list:
+            st = self._states_for(p)
+            new = {}
+            for k in st:
+                key = f"{p.name}_{k}"
+                if key in state_dict:
+                    v = state_dict[key]
+                    new[k] = v._value if isinstance(v, Tensor) else jnp.asarray(v)
+                else:
+                    new[k] = st[k]
+            self._accumulators[id(p)] = new
+        self._global_step = int(state_dict.get("global_step", 0))
+        if "LR_Scheduler" in state_dict and isinstance(self._learning_rate,
+                                                       LRScheduler):
+            self._learning_rate.set_state_dict(state_dict["LR_Scheduler"])
+
+    load_state_dict = set_state_dict
+
+    # functional access for hapi's fully-jitted train step ----------------
+    def functional_update(self, values_tree, grads_tree, states_tree, lr,
+                          lr_mult=1.0):
+        """Pure pytree update used by hapi Model: maps the update rule over
+        matching pytrees. states_tree: dict name->state dict."""
+        leaves_v, treedef = jax.tree_util.tree_flatten(values_tree)
+        leaves_g = treedef.flatten_up_to(grads_tree)
+        leaves_s = [states_tree[i] for i in range(len(leaves_v))]
+        new_v, new_s = [], []
+        for v, g, s in zip(leaves_v, leaves_g, leaves_s):
+            nv, ns = self._update_rule(v, g.astype(v.dtype), s, lr, lr_mult,
+                                       None)
+            new_v.append(nv.astype(v.dtype))
+            new_s.append(ns)
+        return jax.tree_util.tree_unflatten(treedef, new_v), \
+            {i: s for i, s in enumerate(new_s)}
+
+    def functional_init_states(self, values_tree):
+        leaves, _ = jax.tree_util.tree_flatten(values_tree)
+        return {i: self._init_state_value(v) for i, v in enumerate(leaves)}
+
+    def _init_state_value(self, value):
+        p = Tensor(value)
+        return self._init_state(p)

@@ -11,4 +11,8 @@ import jax.numpy as jnp
 def einsum(equation, *operands):
     if len(operands) == 1 and isinstance(operands[0], (list, tuple)):
         operands = tuple(operands[0])
-    return apply(lambda *ops: jnp.einsum(equation, *ops), *operands)
+
+    def _e(*ops):
+        return jnp.einsum(equation, *ops)
+    _e.__name__ = "einsum"  # AMP white-list key
+    return apply(_e, *operands)

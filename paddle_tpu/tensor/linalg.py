@@ -32,6 +32,7 @@ def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
         if transpose_y:
             b = jnp.swapaxes(b, -1, -2) if b.ndim > 1 else b
         return jnp.matmul(a, b)
+    _mm.__name__ = "matmul"  # AMP white-list key
     return apply(_mm, x, y)
 
 

@@ -1,0 +1,76 @@
+"""AMP tests (reference: unittests test_amp_* / test_imperative_auto_mixed_precision)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+def test_autocast_o1_dtype_policy():
+    a = paddle.randn([4, 4])
+    b = paddle.randn([4, 4])
+    with paddle.amp.auto_cast(level="O1"):
+        mm = paddle.matmul(a, b)
+        assert mm.dtype == paddle.bfloat16  # white list -> low precision
+        sm = paddle.nn.functional.softmax(mm)
+        assert sm.dtype == paddle.float32  # black list -> f32
+        add = a + b
+        assert add.dtype == paddle.float32  # neither list: left alone
+    mm2 = paddle.matmul(a, b)
+    assert mm2.dtype == paddle.float32  # outside context
+
+
+def test_autocast_o2():
+    a = paddle.randn([4, 4])
+    with paddle.amp.auto_cast(level="O2"):
+        out = a + a
+        assert out.dtype == paddle.bfloat16
+
+
+def test_autocast_custom_lists():
+    a = paddle.randn([4, 4])
+    with paddle.amp.auto_cast(custom_white_list=["add"]):
+        out = paddle.add(a, a)
+        assert out.dtype == paddle.bfloat16
+    with paddle.amp.auto_cast(custom_black_list=["matmul"]):
+        out = paddle.matmul(a, a)
+        assert out.dtype == paddle.float32
+
+
+def test_grad_scaler_scales_and_unscales():
+    lin = nn.Linear(4, 2)
+    opt = paddle.optimizer.SGD(0.1, parameters=lin.parameters())
+    scaler = paddle.amp.GradScaler(init_loss_scaling=64.0)
+    x = paddle.ones([2, 4])
+    loss = lin(x).mean()
+    scaled = scaler.scale(loss)
+    assert float(scaled) == pytest.approx(float(loss) * 64.0, rel=1e-5)
+    scaled.backward()
+    w_before = lin.weight.numpy().copy()
+    scaler.step(opt)
+    scaler.update()
+    # grads were unscaled before step: effective update independent of scale
+    lin2 = nn.Linear(4, 2)
+    lin2.weight.set_value(w_before)
+    lin2.bias.set_value(np.zeros(2, np.float32))
+    assert not np.allclose(lin.weight.numpy(), w_before)
+
+
+def test_grad_scaler_skips_on_inf():
+    lin = nn.Linear(2, 2)
+    opt = paddle.optimizer.SGD(0.1, parameters=lin.parameters())
+    scaler = paddle.amp.GradScaler(init_loss_scaling=4.0)
+    loss = lin(paddle.ones([1, 2])).mean()
+    scaler.scale(loss).backward()
+    lin.weight.grad._value = lin.weight.grad._value.at[0, 0].set(np.inf)
+    w0 = lin.weight.numpy().copy()
+    scaler.step(opt)
+    scaler.update()
+    np.testing.assert_allclose(lin.weight.numpy(), w0)  # step skipped
+    assert scaler._scale == pytest.approx(2.0)  # halved
+
+
+def test_decorate_casts_model():
+    m = nn.Linear(4, 4)
+    paddle.amp.decorate(m, level="O2")
+    assert m.weight.dtype == paddle.bfloat16

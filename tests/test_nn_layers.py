@@ -1,0 +1,391 @@
+"""Layer tests (reference model: unittests test_layers.py + per-layer tests).
+Numerics checked against torch (CPU) where formulas are nontrivial."""
+import numpy as np
+import pytest
+import torch
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+def t(a, sg=True):
+    return paddle.to_tensor(a, stop_gradient=sg)
+
+
+def test_linear_numerics_and_grad():
+    w = np.random.rand(4, 3).astype(np.float32)
+    b = np.random.rand(3).astype(np.float32)
+    x = np.random.rand(2, 4).astype(np.float32)
+    lin = nn.Linear(4, 3)
+    lin.weight.set_value(w)
+    lin.bias.set_value(b)
+    out = lin(t(x))
+    np.testing.assert_allclose(out.numpy(), x @ w + b, rtol=1e-5)
+    out.sum().backward()
+    np.testing.assert_allclose(lin.weight.grad.numpy(),
+                               x.T @ np.ones((2, 3), np.float32), rtol=1e-5)
+
+
+def test_conv2d_vs_torch():
+    w = np.random.rand(6, 3, 3, 3).astype(np.float32)
+    x = np.random.rand(2, 3, 8, 8).astype(np.float32)
+    for stride, padding, dilation in [(1, 0, 1), (2, 1, 1), (1, 2, 2)]:
+        out = F.conv2d(t(x), t(w), stride=stride, padding=padding,
+                       dilation=dilation)
+        ref = torch.nn.functional.conv2d(
+            torch.tensor(x), torch.tensor(w), stride=stride, padding=padding,
+            dilation=dilation)
+        np.testing.assert_allclose(out.numpy(), ref.numpy(), rtol=1e-4,
+                                   atol=1e-5)
+
+
+def test_conv2d_groups_and_1d3d():
+    x = np.random.rand(2, 4, 8, 8).astype(np.float32)
+    w = np.random.rand(8, 2, 3, 3).astype(np.float32)
+    out = F.conv2d(t(x), t(w), groups=2)
+    ref = torch.nn.functional.conv2d(torch.tensor(x), torch.tensor(w), groups=2)
+    np.testing.assert_allclose(out.numpy(), ref.numpy(), rtol=1e-4, atol=1e-5)
+    x1 = np.random.rand(2, 3, 16).astype(np.float32)
+    w1 = np.random.rand(5, 3, 3).astype(np.float32)
+    np.testing.assert_allclose(
+        F.conv1d(t(x1), t(w1), padding=1).numpy(),
+        torch.nn.functional.conv1d(torch.tensor(x1), torch.tensor(w1),
+                                   padding=1).numpy(), rtol=1e-4, atol=1e-5)
+    x3 = np.random.rand(1, 2, 4, 4, 4).astype(np.float32)
+    w3 = np.random.rand(3, 2, 2, 2, 2).astype(np.float32)
+    np.testing.assert_allclose(
+        F.conv3d(t(x3), t(w3)).numpy(),
+        torch.nn.functional.conv3d(torch.tensor(x3),
+                                   torch.tensor(w3)).numpy(),
+        rtol=1e-4, atol=1e-5)
+
+
+def test_conv_transpose_vs_torch():
+    x = np.random.rand(2, 4, 5, 5).astype(np.float32)
+    w = np.random.rand(4, 6, 3, 3).astype(np.float32)
+    out = F.conv2d_transpose(t(x), t(w), stride=2, padding=1, output_padding=1)
+    ref = torch.nn.functional.conv_transpose2d(
+        torch.tensor(x), torch.tensor(w), stride=2, padding=1,
+        output_padding=1)
+    np.testing.assert_allclose(out.numpy(), ref.numpy(), rtol=1e-4, atol=1e-5)
+
+
+def test_batchnorm_train_eval():
+    x = np.random.rand(8, 3, 4, 4).astype(np.float32)
+    bn = nn.BatchNorm2D(3, momentum=0.9)
+    tbn = torch.nn.BatchNorm2d(3, momentum=0.1)  # torch momentum = 1 - paddle
+    bn.train()
+    out = bn(t(x))
+    tout = tbn(torch.tensor(x))
+    np.testing.assert_allclose(out.numpy(), tout.detach().numpy(), rtol=1e-4,
+                               atol=1e-5)
+    np.testing.assert_allclose(bn._mean.numpy(),
+                               tbn.running_mean.numpy(), rtol=1e-3, atol=1e-5)
+    bn.eval()
+    out_e = bn(t(x))
+    tbn.eval()
+    tout_e = tbn(torch.tensor(x))
+    np.testing.assert_allclose(out_e.numpy(), tout_e.detach().numpy(),
+                               rtol=1e-3, atol=1e-4)
+
+
+def test_layernorm_groupnorm_instancenorm():
+    x = np.random.rand(2, 6, 4).astype(np.float32)
+    ln = nn.LayerNorm(4)
+    tln = torch.nn.LayerNorm(4)
+    np.testing.assert_allclose(ln(t(x)).numpy(),
+                               tln(torch.tensor(x)).detach().numpy(),
+                               rtol=1e-4, atol=1e-5)
+    xg = np.random.rand(2, 6, 4, 4).astype(np.float32)
+    gn = nn.GroupNorm(3, 6)
+    tgn = torch.nn.GroupNorm(3, 6)
+    np.testing.assert_allclose(gn(t(xg)).numpy(),
+                               tgn(torch.tensor(xg)).detach().numpy(),
+                               rtol=1e-4, atol=1e-5)
+    inn = nn.InstanceNorm2D(6)
+    tin = torch.nn.InstanceNorm2d(6, affine=True)
+    np.testing.assert_allclose(inn(t(xg)).numpy(),
+                               tin(torch.tensor(xg)).detach().numpy(),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_pooling_vs_torch():
+    x = np.random.rand(2, 3, 9, 9).astype(np.float32)
+    np.testing.assert_allclose(
+        F.max_pool2d(t(x), 3, 2, 1).numpy(),
+        torch.nn.functional.max_pool2d(torch.tensor(x), 3, 2, 1).numpy())
+    np.testing.assert_allclose(
+        F.avg_pool2d(t(x), 3, 2, 1).numpy(),
+        torch.nn.functional.avg_pool2d(torch.tensor(x), 3, 2, 1,
+                                       count_include_pad=False).numpy(),
+        rtol=1e-5)
+    np.testing.assert_allclose(
+        F.adaptive_avg_pool2d(t(x), 5).numpy(),
+        torch.nn.functional.adaptive_avg_pool2d(torch.tensor(x), 5).numpy(),
+        rtol=1e-5, atol=1e-6)
+    out, mask = F.max_pool2d(t(x), 3, 3, return_mask=True)
+    tout, tmask = torch.nn.functional.max_pool2d(torch.tensor(x), 3, 3,
+                                                 return_indices=True)
+    np.testing.assert_allclose(out.numpy(), tout.numpy())
+    np.testing.assert_array_equal(mask.numpy(), tmask.numpy())
+
+
+def test_activations_vs_torch():
+    x = np.random.randn(4, 5).astype(np.float32)
+    pairs = [
+        (F.relu, torch.nn.functional.relu),
+        (F.gelu, lambda v: torch.nn.functional.gelu(v)),
+        (F.sigmoid, torch.sigmoid),
+        (F.silu, torch.nn.functional.silu),
+        (F.mish, torch.nn.functional.mish),
+        (F.softplus, torch.nn.functional.softplus),
+        (F.elu, torch.nn.functional.elu),
+        (F.selu, torch.nn.functional.selu),
+        (F.hardswish, torch.nn.functional.hardswish),
+        (F.log_sigmoid, torch.nn.functional.logsigmoid),
+        (F.softsign, torch.nn.functional.softsign),
+        (F.tanhshrink, torch.nn.functional.tanhshrink),
+    ]
+    for pf, tf in pairs:
+        np.testing.assert_allclose(pf(t(x)).numpy(),
+                                   tf(torch.tensor(x)).numpy(), rtol=1e-4,
+                                   atol=1e-5)
+    np.testing.assert_allclose(
+        F.softmax(t(x)).numpy(),
+        torch.nn.functional.softmax(torch.tensor(x), -1).numpy(), rtol=1e-5,
+        atol=1e-6)
+
+
+def test_losses_vs_torch():
+    logits = np.random.randn(6, 4).astype(np.float32)
+    labels = np.random.randint(0, 4, 6)
+    np.testing.assert_allclose(
+        F.cross_entropy(t(logits), t(labels)).numpy(),
+        torch.nn.functional.cross_entropy(torch.tensor(logits),
+                                          torch.tensor(labels)).numpy(),
+        rtol=1e-5)
+    p = 1 / (1 + np.exp(-logits))
+    y = (np.random.rand(6, 4) > 0.5).astype(np.float32)
+    np.testing.assert_allclose(
+        F.binary_cross_entropy(t(p), t(y)).numpy(),
+        torch.nn.functional.binary_cross_entropy(torch.tensor(p),
+                                                 torch.tensor(y)).numpy(),
+        rtol=1e-4)
+    np.testing.assert_allclose(
+        F.binary_cross_entropy_with_logits(t(logits), t(y)).numpy(),
+        torch.nn.functional.binary_cross_entropy_with_logits(
+            torch.tensor(logits), torch.tensor(y)).numpy(), rtol=1e-5)
+    a = np.random.rand(6, 4).astype(np.float32)
+    b = np.random.rand(6, 4).astype(np.float32)
+    np.testing.assert_allclose(F.mse_loss(t(a), t(b)).numpy(),
+                               ((a - b) ** 2).mean(), rtol=1e-6)
+    np.testing.assert_allclose(F.l1_loss(t(a), t(b)).numpy(),
+                               np.abs(a - b).mean(), rtol=1e-6)
+    logp = np.log(np.random.rand(6, 4).astype(np.float32) + 0.1)
+    tgt = np.random.rand(6, 4).astype(np.float32)
+    np.testing.assert_allclose(
+        F.kl_div(t(logp), t(tgt), reduction="batchmean").numpy(),
+        torch.nn.functional.kl_div(torch.tensor(logp), torch.tensor(tgt),
+                                   reduction="batchmean").numpy(), rtol=1e-4)
+    np.testing.assert_allclose(
+        F.smooth_l1_loss(t(a), t(b)).numpy(),
+        torch.nn.functional.smooth_l1_loss(torch.tensor(a),
+                                           torch.tensor(b)).numpy(),
+        rtol=1e-4)
+
+
+def test_ce_ignore_index_and_soft():
+    logits = np.random.randn(5, 3).astype(np.float32)
+    labels = np.array([0, 1, -100, 2, -100])
+    np.testing.assert_allclose(
+        F.cross_entropy(t(logits), t(labels), ignore_index=-100).numpy(),
+        torch.nn.functional.cross_entropy(
+            torch.tensor(logits), torch.tensor(labels),
+            ignore_index=-100).numpy(), rtol=1e-5)
+    soft = np.random.rand(5, 3).astype(np.float32)
+    soft /= soft.sum(1, keepdims=True)
+    np.testing.assert_allclose(
+        F.cross_entropy(t(logits), t(soft), soft_label=True).numpy(),
+        torch.nn.functional.cross_entropy(torch.tensor(logits),
+                                          torch.tensor(soft)).numpy(),
+        rtol=1e-5)
+
+
+def test_embedding_dropout():
+    emb = nn.Embedding(10, 4, padding_idx=0)
+    idx = t(np.array([[1, 2, 0]]))
+    out = emb(idx)
+    assert out.shape == [1, 3, 4]
+    np.testing.assert_allclose(out.numpy()[0, 2], np.zeros(4))
+    drop = nn.Dropout(0.5)
+    drop.eval()
+    x = paddle.ones([10, 10])
+    np.testing.assert_allclose(drop(x).numpy(), np.ones((10, 10)))
+    drop.train()
+    y = drop(x)
+    kept = (y.numpy() != 0)
+    assert 0.2 < kept.mean() < 0.8
+    np.testing.assert_allclose(y.numpy()[kept], 2.0)
+
+
+def test_rnn_lstm_gru_vs_torch():
+    x = np.random.rand(2, 5, 3).astype(np.float32)
+    for mode, pcls, tcls in [("LSTM", nn.LSTM, torch.nn.LSTM),
+                             ("GRU", nn.GRU, torch.nn.GRU),
+                             ("RNN", nn.SimpleRNN, torch.nn.RNN)]:
+        prnn = pcls(3, 4)
+        trnn = tcls(3, 4, batch_first=True)
+        cell = prnn.rnns[0].cell
+        sd = {"weight_ih_l0": cell.weight_ih, "weight_hh_l0": cell.weight_hh,
+              "bias_ih_l0": cell.bias_ih, "bias_hh_l0": cell.bias_hh}
+        for k, v in sd.items():
+            getattr(trnn, k).data = torch.tensor(v.numpy())
+        pout, _ = prnn(t(x))
+        tout, _ = trnn(torch.tensor(x))
+        np.testing.assert_allclose(pout.numpy(), tout.detach().numpy(),
+                                   rtol=1e-4, atol=1e-5,
+                                   err_msg=f"mode {mode}")
+
+
+def test_transformer_shapes_and_masks():
+    m = nn.Transformer(d_model=16, nhead=4, num_encoder_layers=2,
+                       num_decoder_layers=2, dim_feedforward=32)
+    m.eval()
+    src = paddle.randn([2, 6, 16])
+    tgt = paddle.randn([2, 4, 16])
+    out = m(src, tgt)
+    assert out.shape == [2, 4, 16]
+    mask = m.generate_square_subsequent_mask(4)
+    assert mask.shape == [4, 4]
+    out2 = m(src, tgt, tgt_mask=mask)
+    assert out2.shape == [2, 4, 16]
+
+
+def test_mha_self_attention_parity():
+    # our MHA vs torch with same weights
+    embed, heads = 8, 2
+    mha = nn.MultiHeadAttention(embed, heads)
+    mha.eval()
+    x = np.random.rand(2, 5, embed).astype(np.float32)
+    tm = torch.nn.MultiheadAttention(embed, heads, batch_first=True)
+    wq = mha.q_proj.weight.numpy()
+    wk = mha.k_proj.weight.numpy()
+    wv = mha.v_proj.weight.numpy()
+    in_w = np.concatenate([wq.T, wk.T, wv.T], 0)
+    in_b = np.concatenate([mha.q_proj.bias.numpy(), mha.k_proj.bias.numpy(),
+                           mha.v_proj.bias.numpy()])
+    tm.in_proj_weight.data = torch.tensor(in_w)
+    tm.in_proj_bias.data = torch.tensor(in_b)
+    tm.out_proj.weight.data = torch.tensor(mha.out_proj.weight.numpy().T)
+    tm.out_proj.bias.data = torch.tensor(mha.out_proj.bias.numpy())
+    pout = mha(t(x))
+    tout, _ = tm(torch.tensor(x), torch.tensor(x), torch.tensor(x))
+    np.testing.assert_allclose(pout.numpy(), tout.detach().numpy(), rtol=1e-3,
+                               atol=1e-5)
+
+
+def test_containers():
+    seq = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    x = paddle.randn([3, 4])
+    assert seq(x).shape == [3, 2]
+    assert len(seq) == 3
+    ll = nn.LayerList([nn.Linear(2, 2) for _ in range(3)])
+    ll.append(nn.Linear(2, 2))
+    assert len(ll) == 4
+    assert len(list(ll.parameters())) == 8
+    pl = nn.ParameterList([nn.Parameter(paddle.randn([2])._value)
+                           for _ in range(2)])
+    assert len(pl) == 2
+    ld = nn.LayerDict({"a": nn.Linear(2, 2)})
+    ld["b"] = nn.ReLU()
+    assert "b" in ld and len(ld) == 2
+
+
+def test_state_dict_roundtrip():
+    m1 = nn.Sequential(nn.Linear(4, 8), nn.BatchNorm1D(8), nn.Linear(8, 2))
+    m2 = nn.Sequential(nn.Linear(4, 8), nn.BatchNorm1D(8), nn.Linear(8, 2))
+    x = paddle.randn([3, 4])
+    m1.train()
+    m1(x)  # update BN stats
+    m2.set_state_dict(m1.state_dict())
+    m1.eval()
+    m2.eval()
+    np.testing.assert_allclose(m1(x).numpy(), m2(x).numpy(), rtol=1e-6)
+
+
+def test_hooks():
+    lin = nn.Linear(2, 2)
+    calls = []
+    h1 = lin.register_forward_pre_hook(lambda l, inp: calls.append("pre"))
+    h2 = lin.register_forward_post_hook(
+        lambda l, inp, out: calls.append("post"))
+    lin(paddle.randn([1, 2]))
+    assert calls == ["pre", "post"]
+    h1.remove()
+    h2.remove()
+    lin(paddle.randn([1, 2]))
+    assert calls == ["pre", "post"]
+
+
+def test_weight_norm_spectral_norm():
+    from paddle_tpu.nn.utils import remove_weight_norm, weight_norm
+
+    lin = nn.Linear(4, 3)
+    w0 = lin.weight.numpy()
+    weight_norm(lin, dim=0)
+    assert "weight_g" in dict(lin.named_parameters())
+    x = np.random.rand(2, 4).astype(np.float32)
+    out = lin(t(x))
+    np.testing.assert_allclose(out.numpy(), x @ w0 + lin.bias.numpy(),
+                               rtol=1e-4, atol=1e-5)
+    remove_weight_norm(lin)
+    out2 = lin(t(x))
+    np.testing.assert_allclose(out2.numpy(), out.numpy(), rtol=1e-4, atol=1e-5)
+
+
+def test_clip_grad():
+    lin = nn.Linear(4, 4)
+    (lin(paddle.ones([8, 4])) * 100).sum().backward()
+    from paddle_tpu.nn.utils import clip_grad_norm_
+
+    total = clip_grad_norm_(lin.parameters(), 1.0)
+    gn = np.sqrt(sum((p.grad.numpy() ** 2).sum() for p in lin.parameters()))
+    assert gn < 1.01
+
+
+def test_initializers():
+    from paddle_tpu.nn import initializer as I
+
+    lin = nn.Linear(100, 50, weight_attr=paddle.ParamAttr(
+        initializer=I.KaimingNormal()))
+    std = lin.weight.numpy().std()
+    assert 0.1 < std < 0.2  # sqrt(2/100) ~ 0.141
+    c = nn.Linear(4, 4, weight_attr=paddle.ParamAttr(
+        initializer=I.Constant(0.5)))
+    np.testing.assert_allclose(c.weight.numpy(), 0.5)
+    o = I.Orthogonal()(np.zeros((4, 4)).shape, np.float32, None) \
+        if False else None
+    u = nn.Linear(10, 10, weight_attr=paddle.ParamAttr(
+        initializer=I.Uniform(-0.1, 0.1)))
+    assert np.abs(u.weight.numpy()).max() <= 0.1
+
+
+def test_pixel_shuffle_pad_upsample():
+    x = np.random.rand(1, 4, 3, 3).astype(np.float32)
+    out = F.pixel_shuffle(t(x), 2)
+    ref = torch.nn.functional.pixel_shuffle(torch.tensor(x), 2)
+    np.testing.assert_allclose(out.numpy(), ref.numpy())
+    xp = np.random.rand(1, 2, 3, 3).astype(np.float32)
+    out = F.pad(t(xp), [1, 1, 2, 2], value=7.0)
+    assert out.shape == [1, 2, 7, 5]
+    assert out.numpy()[0, 0, 0, 0] == 7.0
+    up = F.interpolate(t(xp), scale_factor=2, mode="nearest")
+    tup = torch.nn.functional.interpolate(torch.tensor(xp), scale_factor=2,
+                                          mode="nearest")
+    np.testing.assert_allclose(up.numpy(), tup.numpy())
+    upb = F.interpolate(t(xp), size=[6, 6], mode="bilinear")
+    tupb = torch.nn.functional.interpolate(torch.tensor(xp), (6, 6),
+                                           mode="bilinear")
+    np.testing.assert_allclose(upb.numpy(), tupb.numpy(), rtol=1e-4,
+                               atol=1e-5)

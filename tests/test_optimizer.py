@@ -1,0 +1,235 @@
+"""Optimizer + lr scheduler tests (reference: unittests test_sgd_op.py,
+test_adam_op.py, test_lr_scheduler.py). Numerics vs torch.optim."""
+import numpy as np
+import pytest
+import torch
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+def _problem():
+    w0 = np.random.rand(5, 3).astype(np.float32)
+    x0 = np.random.rand(10, 5).astype(np.float32)
+    y0 = np.random.rand(10, 3).astype(np.float32)
+    return w0, x0, y0
+
+
+def _run_pair(p_opt_fn, t_opt_fn, steps=8, tol=1e-5):
+    w0, x0, y0 = _problem()
+    lin = nn.Linear(5, 3, bias_attr=False)
+    lin.weight.set_value(w0)
+    popt = p_opt_fn(lin.parameters())
+    tw = torch.nn.Parameter(torch.tensor(w0))
+    topt = t_opt_fn([tw])
+    tx, ty = torch.tensor(x0), torch.tensor(y0)
+    for _ in range(steps):
+        loss = ((lin(paddle.to_tensor(x0)) - paddle.to_tensor(y0)) ** 2).mean()
+        loss.backward()
+        popt.step()
+        popt.clear_grad()
+        topt.zero_grad()
+        tl = ((tx @ tw - ty) ** 2).mean()
+        tl.backward()
+        topt.step()
+    np.testing.assert_allclose(lin.weight.numpy(), tw.detach().numpy(),
+                               rtol=tol, atol=tol)
+
+
+def test_sgd():
+    _run_pair(lambda p: paddle.optimizer.SGD(0.1, parameters=p),
+              lambda p: torch.optim.SGD(p, lr=0.1))
+
+
+def test_momentum():
+    _run_pair(lambda p: paddle.optimizer.Momentum(0.1, 0.9, parameters=p),
+              lambda p: torch.optim.SGD(p, lr=0.1, momentum=0.9))
+
+
+def test_momentum_nesterov():
+    _run_pair(
+        lambda p: paddle.optimizer.Momentum(0.05, 0.9, parameters=p,
+                                            use_nesterov=True),
+        lambda p: torch.optim.SGD(p, lr=0.05, momentum=0.9, nesterov=True))
+
+
+def test_adam():
+    _run_pair(lambda p: paddle.optimizer.Adam(0.01, parameters=p),
+              lambda p: torch.optim.Adam(p, lr=0.01))
+
+
+def test_adamw():
+    _run_pair(lambda p: paddle.optimizer.AdamW(0.01, parameters=p,
+                                               weight_decay=0.05),
+              lambda p: torch.optim.AdamW(p, lr=0.01, weight_decay=0.05))
+
+
+def test_adagrad():
+    _run_pair(lambda p: paddle.optimizer.Adagrad(0.05, parameters=p),
+              lambda p: torch.optim.Adagrad(p, lr=0.05, eps=1e-6), tol=1e-4)
+
+
+def test_adamax():
+    _run_pair(lambda p: paddle.optimizer.Adamax(0.01, parameters=p),
+              lambda p: torch.optim.Adamax(p, lr=0.01), tol=1e-4)
+
+
+def test_rmsprop():
+    _run_pair(
+        lambda p: paddle.optimizer.RMSProp(0.01, rho=0.9, epsilon=1e-8,
+                                           parameters=p),
+        lambda p: torch.optim.RMSprop(p, lr=0.01, alpha=0.9, eps=1e-8),
+        tol=2e-3)  # eps placement differs (inside vs outside sqrt)
+
+
+def test_adadelta_decreases_loss():
+    w0, x0, y0 = _problem()
+    lin = nn.Linear(5, 3, bias_attr=False)
+    lin.weight.set_value(w0)
+    opt = paddle.optimizer.Adadelta(1.0, parameters=lin.parameters())
+    losses = []
+    for _ in range(20):
+        loss = ((lin(paddle.to_tensor(x0)) - paddle.to_tensor(y0)) ** 2).mean()
+        losses.append(float(loss))
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    assert losses[-1] < losses[0]
+
+
+def test_lamb_decreases_loss():
+    w0, x0, y0 = _problem()
+    lin = nn.Linear(5, 3, bias_attr=False)
+    lin.weight.set_value(w0)
+    opt = paddle.optimizer.Lamb(0.01, parameters=lin.parameters())
+    losses = []
+    for _ in range(15):
+        loss = ((lin(paddle.to_tensor(x0)) - paddle.to_tensor(y0)) ** 2).mean()
+        losses.append(float(loss))
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    assert losses[-1] < losses[0]
+
+
+def test_weight_decay_and_clip():
+    w0, x0, y0 = _problem()
+    lin = nn.Linear(5, 3, bias_attr=False)
+    lin.weight.set_value(w0)
+    opt = paddle.optimizer.SGD(
+        0.1, parameters=lin.parameters(),
+        weight_decay=paddle.regularizer.L2Decay(0.1),
+        grad_clip=nn.ClipGradByGlobalNorm(0.5))
+    loss = ((lin(paddle.to_tensor(x0)) - paddle.to_tensor(y0)) ** 2).mean()
+    loss.backward()
+    g = lin.weight.grad.numpy()
+    opt.step()
+    # manual: clipped (g + 0.1 w), lr 0.1
+    reg = g + 0.1 * w0
+    n = np.sqrt((reg ** 2).sum())
+    if n > 0.5:
+        reg = reg * 0.5 / n
+    np.testing.assert_allclose(lin.weight.numpy(), w0 - 0.1 * reg, rtol=1e-4,
+                               atol=1e-6)
+
+
+def test_optimizer_state_dict_roundtrip():
+    w0, x0, y0 = _problem()
+    lin = nn.Linear(5, 3, bias_attr=False)
+    lin.weight.set_value(w0)
+    opt = paddle.optimizer.Adam(0.01, parameters=lin.parameters())
+    for _ in range(3):
+        loss = ((lin(paddle.to_tensor(x0)) - paddle.to_tensor(y0)) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    sd = opt.state_dict()
+    opt2 = paddle.optimizer.Adam(0.01, parameters=lin.parameters())
+    opt2.set_state_dict(sd)
+    m1 = opt._accumulators[id(lin.weight)]["moment1"]
+    m2 = opt2._accumulators[id(lin.weight)]["moment1"]
+    np.testing.assert_allclose(np.asarray(m1), np.asarray(m2))
+
+
+def test_set_lr_and_get_lr():
+    lin = nn.Linear(2, 2)
+    opt = paddle.optimizer.SGD(0.1, parameters=lin.parameters())
+    assert opt.get_lr() == pytest.approx(0.1)
+    opt.set_lr(0.05)
+    assert opt.get_lr() == pytest.approx(0.05)
+
+
+LR_CASES = [
+    ("StepDecay", lambda: paddle.optimizer.lr.StepDecay(0.1, 2, 0.5),
+     [0.1, 0.1, 0.05, 0.05, 0.025]),
+    ("MultiStepDecay",
+     lambda: paddle.optimizer.lr.MultiStepDecay(0.1, [2, 4], 0.1),
+     [0.1, 0.1, 0.01, 0.01, 0.001]),
+    ("ExponentialDecay",
+     lambda: paddle.optimizer.lr.ExponentialDecay(1.0, 0.5),
+     [1.0, 0.5, 0.25, 0.125, 0.0625]),
+    ("InverseTimeDecay",
+     lambda: paddle.optimizer.lr.InverseTimeDecay(1.0, 1.0),
+     [1.0, 0.5, 1 / 3, 0.25, 0.2]),
+    ("PiecewiseDecay",
+     lambda: paddle.optimizer.lr.PiecewiseDecay([2, 4], [1.0, 0.5, 0.1]),
+     [1.0, 1.0, 0.5, 0.5, 0.1]),
+]
+
+
+@pytest.mark.parametrize("name,mk,expected", LR_CASES)
+def test_lr_schedules(name, mk, expected):
+    sch = mk()
+    got = []
+    for _ in expected:
+        got.append(sch())
+        sch.step()
+    np.testing.assert_allclose(got, expected, rtol=1e-6)
+
+
+def test_cosine_and_poly_and_noam():
+    import math
+
+    sch = paddle.optimizer.lr.CosineAnnealingDecay(1.0, 10)
+    vals = []
+    for _ in range(11):
+        vals.append(sch())
+        sch.step()
+    assert vals[0] == pytest.approx(1.0)
+    assert vals[10] == pytest.approx(0.0, abs=1e-6)
+    assert vals[5] == pytest.approx(0.5, abs=1e-6)
+
+    p = paddle.optimizer.lr.PolynomialDecay(1.0, 10, end_lr=0.0, power=1.0)
+    v = []
+    for _ in range(11):
+        v.append(p())
+        p.step()
+    np.testing.assert_allclose(v, [1 - i / 10 for i in range(11)], atol=1e-6)
+
+    n = paddle.optimizer.lr.NoamDecay(d_model=512, warmup_steps=10,
+                                      learning_rate=1.0)
+    seq = []
+    for _ in range(20):
+        seq.append(n())
+        n.step()
+    peak = max(seq)
+    assert seq.index(peak) in (9, 10)
+
+
+def test_linear_warmup_wraps_scheduler():
+    inner = paddle.optimizer.lr.StepDecay(0.1, 5, 0.5)
+    sch = paddle.optimizer.lr.LinearWarmup(inner, warmup_steps=4,
+                                           start_lr=0.0, end_lr=0.1)
+    vals = [sch()]
+    for _ in range(5):
+        sch.step()
+        vals.append(sch())
+    np.testing.assert_allclose(vals[:4], [0.0, 0.025, 0.05, 0.075], atol=1e-6)
+    assert vals[4] == pytest.approx(0.1)
+
+
+def test_reduce_on_plateau():
+    sch = paddle.optimizer.lr.ReduceOnPlateau(1.0, patience=1, factor=0.5)
+    for loss in [1.0, 0.9, 0.9, 0.9, 0.9]:
+        sch.step(loss)
+    assert sch() < 1.0

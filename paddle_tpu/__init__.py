@@ -48,6 +48,13 @@ from . import amp  # noqa: F401
 from . import regularizer  # noqa: F401
 from .framework.param_attr import ParamAttr  # noqa: F401
 from .nn.layer.layers import create_parameter  # noqa: F401
+from . import io  # noqa: F401
+from . import metric  # noqa: F401
+from . import hapi  # noqa: F401
+from .hapi import Model, callbacks, summary  # noqa: F401
+from .framework.io import load, save  # noqa: F401
+from . import vision  # noqa: F401
+from . import text  # noqa: F401
 
 __version__ = "0.1.0"
 

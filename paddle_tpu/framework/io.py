@@ -1,0 +1,60 @@
+"""paddle.save / paddle.load (reference: python/paddle/framework/io.py).
+
+State dicts are pickled with numpy payloads (portable, mmap-friendly);
+Tensors rehydrate onto the default device lazily at first use.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+
+import numpy as np
+
+from ..core.tensor import Tensor
+
+__all__ = ["save", "load"]
+
+
+def _to_storable(obj):
+    if isinstance(obj, Tensor):
+        return _TensorPayload(np.asarray(obj._value), obj.stop_gradient)
+    if isinstance(obj, dict):
+        return {k: _to_storable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = type(obj)
+        return t(_to_storable(v) for v in obj)
+    return obj
+
+
+class _TensorPayload:
+    __slots__ = ("array", "stop_gradient")
+
+    def __init__(self, array, stop_gradient):
+        self.array = array
+        self.stop_gradient = stop_gradient
+
+
+def _from_storable(obj, return_numpy=False):
+    if isinstance(obj, _TensorPayload):
+        return obj.array if return_numpy else Tensor(
+            obj.array, stop_gradient=obj.stop_gradient)
+    if isinstance(obj, dict):
+        return {k: _from_storable(v, return_numpy) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = type(obj)
+        return t(_from_storable(v, return_numpy) for v in obj)
+    return obj
+
+
+def save(obj, path, protocol=4, **configs):
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_to_storable(obj), f, protocol=protocol)
+
+
+def load(path, return_numpy=False, **configs):
+    with open(path, "rb") as f:
+        obj = pickle.load(f)
+    return _from_storable(obj, return_numpy)

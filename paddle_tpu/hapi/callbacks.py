@@ -1,0 +1,301 @@
+"""hapi callbacks (reference: python/paddle/hapi/callbacks.py)."""
+from __future__ import annotations
+
+import numbers
+import os
+import time
+
+import numpy as np
+
+__all__ = ["Callback", "CallbackList", "ProgBarLogger", "ModelCheckpoint",
+           "LRScheduler", "EarlyStopping", "VisualDL", "ReduceLROnPlateau",
+           "config_callbacks"]
+
+
+class Callback:
+    def __init__(self):
+        self.model = None
+        self.params = {}
+
+    def set_params(self, params):
+        self.params = params
+
+    def set_model(self, model):
+        self.model = model
+
+    def on_train_begin(self, logs=None):
+        pass
+
+    def on_train_end(self, logs=None):
+        pass
+
+    def on_eval_begin(self, logs=None):
+        pass
+
+    def on_eval_end(self, logs=None):
+        pass
+
+    def on_predict_begin(self, logs=None):
+        pass
+
+    def on_predict_end(self, logs=None):
+        pass
+
+    def on_epoch_begin(self, epoch, logs=None):
+        pass
+
+    def on_epoch_end(self, epoch, logs=None):
+        pass
+
+    def on_train_batch_begin(self, step, logs=None):
+        pass
+
+    def on_train_batch_end(self, step, logs=None):
+        pass
+
+    def on_eval_batch_begin(self, step, logs=None):
+        pass
+
+    def on_eval_batch_end(self, step, logs=None):
+        pass
+
+
+class CallbackList:
+    def __init__(self, callbacks):
+        self.callbacks = list(callbacks)
+
+    def append(self, cbk):
+        self.callbacks.append(cbk)
+
+    def set_params(self, params):
+        for c in self.callbacks:
+            c.set_params(params)
+
+    def set_model(self, model):
+        for c in self.callbacks:
+            c.set_model(model)
+
+    def _call(self, name, *args):
+        for c in self.callbacks:
+            getattr(c, name)(*args)
+
+    def on_begin(self, mode, logs=None):
+        self._call(f"on_{mode}_begin", logs)
+
+    def on_end(self, mode, logs=None):
+        self._call(f"on_{mode}_end", logs)
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self._call("on_epoch_begin", epoch, logs)
+
+    def on_epoch_end(self, epoch, logs=None):
+        self._call("on_epoch_end", epoch, logs)
+
+    def on_batch_begin(self, mode, step, logs=None):
+        self._call(f"on_{mode}_batch_begin", step, logs)
+
+    def on_batch_end(self, mode, step, logs=None):
+        self._call(f"on_{mode}_batch_end", step, logs)
+
+
+class ProgBarLogger(Callback):
+    def __init__(self, log_freq=1, verbose=2):
+        super().__init__()
+        self.log_freq = log_freq
+        self.verbose = verbose
+        self.epochs = None
+        self.steps = None
+        self._t0 = self._step_t0 = time.time()
+
+    def on_train_begin(self, logs=None):
+        self.epochs = self.params.get("epochs")
+        self.steps = self.params.get("steps")
+        self._t0 = time.time()
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self.epoch = epoch
+        self._step_t0 = time.time()
+        if self.verbose and self.epochs:
+            print(f"Epoch {epoch + 1}/{self.epochs}")
+
+    def on_train_batch_end(self, step, logs=None):
+        if self.verbose == 0 or (step + 1) % self.log_freq:
+            return
+        self._print("step", step, logs)
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self.verbose:
+            self._print("epoch end, step", logs.get("step", 0), logs)
+
+    def on_eval_end(self, logs=None):
+        if self.verbose and logs:
+            self._print("eval done, step", logs.get("step", 0), logs)
+
+    def _print(self, prefix, step, logs):
+        items = []
+        for k, v in (logs or {}).items():
+            if k in ("step", "batch_size"):
+                continue
+            if isinstance(v, numbers.Number):
+                items.append(f"{k}: {v:.4f}")
+            elif isinstance(v, (list, tuple)) and v and \
+                    isinstance(v[0], numbers.Number):
+                items.append(f"{k}: " + "/".join(f"{x:.4f}" for x in v))
+        total = f"/{self.steps}" if self.steps else ""
+        dt = (time.time() - self._step_t0) / max(step + 1, 1)
+        print(f"{prefix} {step + 1}{total} - " + " - ".join(items) +
+              f" - {dt * 1000:.0f}ms/step")
+
+
+class ModelCheckpoint(Callback):
+    def __init__(self, save_freq=1, save_dir=None):
+        super().__init__()
+        self.save_freq = save_freq
+        self.save_dir = save_dir
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self.save_dir and (epoch + 1) % self.save_freq == 0:
+            path = os.path.join(self.save_dir, str(epoch))
+            self.model.save(path)
+
+    def on_train_end(self, logs=None):
+        if self.save_dir:
+            self.model.save(os.path.join(self.save_dir, "final"))
+
+
+class LRScheduler(Callback):
+    def __init__(self, by_step=True, by_epoch=False):
+        super().__init__()
+        self.by_step = by_step
+        self.by_epoch = by_epoch
+
+    def _sched(self):
+        opt = getattr(self.model, "_optimizer", None)
+        lr = getattr(opt, "_learning_rate", None)
+        return lr if hasattr(lr, "step") and not isinstance(lr, float) \
+            else None
+
+    def on_train_batch_end(self, step, logs=None):
+        if self.by_step:
+            s = self._sched()
+            if s is not None:
+                s.step()
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self.by_epoch:
+            s = self._sched()
+            if s is not None:
+                s.step()
+
+
+class EarlyStopping(Callback):
+    def __init__(self, monitor="loss", mode="auto", patience=0, verbose=1,
+                 min_delta=0, baseline=None, save_best_model=True):
+        super().__init__()
+        self.monitor = monitor
+        self.patience = patience
+        self.verbose = verbose
+        self.min_delta = abs(min_delta)
+        self.baseline = baseline
+        self.save_best_model = save_best_model
+        if mode == "max" or (mode == "auto" and "acc" in monitor):
+            self.better = lambda a, b: a > b + self.min_delta
+            self.best = -np.inf
+        else:
+            self.better = lambda a, b: a < b - self.min_delta
+            self.best = np.inf
+        if baseline is not None:
+            # reference semantics: improvement must beat the baseline
+            self.best = baseline
+        self.wait = 0
+
+    def on_epoch_end(self, epoch, logs=None):
+        logs = logs or {}
+        cur = logs.get("eval_" + self.monitor, logs.get(self.monitor))
+        if cur is None:
+            return
+        if isinstance(cur, (list, tuple)):
+            cur = cur[0]
+        if self.better(cur, self.best):
+            self.best = cur
+            self.wait = 0
+        else:
+            self.wait += 1
+            if self.wait > self.patience:
+                self.model.stop_training = True
+                if self.verbose:
+                    print(f"Epoch {epoch}: early stopping (best "
+                          f"{self.monitor}={self.best:.5f})")
+
+
+class ReduceLROnPlateau(Callback):
+    def __init__(self, monitor="loss", factor=0.1, patience=10, verbose=1,
+                 mode="auto", min_delta=1e-4, cooldown=0, min_lr=0):
+        super().__init__()
+        self.monitor = monitor
+        from ..optimizer.lr import ReduceOnPlateau as _Sched
+
+        self._mk = lambda lr: _Sched(lr, mode="min" if mode != "max" else
+                                     "max", factor=factor, patience=patience,
+                                     threshold=min_delta, cooldown=cooldown,
+                                     min_lr=min_lr, verbose=verbose)
+        self._sched = None
+
+    def on_epoch_end(self, epoch, logs=None):
+        logs = logs or {}
+        cur = logs.get("eval_" + self.monitor, logs.get(self.monitor))
+        if cur is None:
+            return
+        opt = self.model._optimizer
+        if self._sched is None:
+            self._sched = self._mk(opt.get_lr())
+        self._sched.step(cur)
+        if not hasattr(opt._learning_rate, "step"):
+            opt.set_lr(self._sched())
+
+
+class VisualDL(Callback):
+    """Scalar logger (reference integrates visualdl; here: jsonl fallback
+    consumable by tensorboard importers)."""
+
+    def __init__(self, log_dir="vdl_log"):
+        super().__init__()
+        self.log_dir = log_dir
+        self._f = None
+        self._step = 0
+
+    def on_train_begin(self, logs=None):
+        os.makedirs(self.log_dir, exist_ok=True)
+        import json  # noqa: F401
+
+        self._f = open(os.path.join(self.log_dir, "scalars.jsonl"), "a")
+
+    def on_train_batch_end(self, step, logs=None):
+        import json
+
+        self._step += 1
+        rec = {k: float(v) for k, v in (logs or {}).items()
+               if isinstance(v, numbers.Number)}
+        rec["global_step"] = self._step
+        self._f.write(json.dumps(rec) + "\n")
+
+    def on_train_end(self, logs=None):
+        if self._f:
+            self._f.close()
+
+
+def config_callbacks(callbacks=None, model=None, batch_size=None, epochs=None,
+                     steps=None, log_freq=2, verbose=2, save_freq=1,
+                     save_dir=None, metrics=None, mode="train"):
+    cbks = list(callbacks) if callbacks else []
+    if not any(isinstance(c, ProgBarLogger) for c in cbks) and verbose:
+        cbks = [ProgBarLogger(log_freq, verbose=verbose)] + cbks
+    if not any(isinstance(c, LRScheduler) for c in cbks):
+        pass  # epoch-wise scheduler stepping handled by Model.fit
+    if save_dir and not any(isinstance(c, ModelCheckpoint) for c in cbks):
+        cbks = cbks + [ModelCheckpoint(save_freq, save_dir)]
+    cl = CallbackList(cbks)
+    cl.set_model(model)
+    cl.set_params({"batch_size": batch_size, "epochs": epochs, "steps": steps,
+                   "verbose": verbose, "metrics": metrics or []})
+    return cl

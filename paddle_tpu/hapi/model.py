@@ -1,0 +1,461 @@
+"""paddle.Model high-level API (reference: python/paddle/hapi/model.py).
+
+TPU-native core: `_JitStepEngine` compiles the ENTIRE train step — forward,
+loss, backward, optimizer update, buffer (BN stat) updates — into one XLA
+program with donated buffers. Eager Python touches the device once per step
+to feed the batch; everything else stays in HBM. This is the path that gives
+TPU parity/win over the reference's op-by-op dygraph step (SURVEY §3).
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import autograd as _ag
+from ..core.tensor import Tensor
+from ..framework import random as rnd
+from ..framework.io import load as _load
+from ..framework.io import save as _save
+from ..io import DataLoader, Dataset
+from ..metric import Metric
+from .callbacks import CallbackList, config_callbacks
+
+__all__ = ["Model"]
+
+
+def _to_list(x):
+    if x is None:
+        return []
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+def _as_tensors(batch):
+    if isinstance(batch, (list, tuple)):
+        return [b if isinstance(b, Tensor) else Tensor(np.asarray(b))
+                for b in batch]
+    return [batch if isinstance(batch, Tensor) else Tensor(np.asarray(batch))]
+
+
+class _JitStepEngine:
+    """Compiles train/eval/predict steps over the network's param pytree."""
+
+    def __init__(self, model):
+        self.model = model
+        self._train_fn = None
+        self._eval_fn = None
+        self._opt_states = None
+
+    # -- pure functions ----------------------------------------------------
+    def _forward_loss(self, param_vals, buf_vals, xs, ys, key, training):
+        net = self.model.network
+        loss_fn = self.model._loss
+        amp_level = self.model._amp_level
+        net_training = net.training
+        for l in net.sublayers(include_self=True):
+            l.training = training
+        try:
+            with rnd.key_scope(key), _ag.no_grad():
+                ctx = None
+                if amp_level:
+                    from .. import amp as amp_mod
+
+                    ctx = amp_mod.auto_cast(level=amp_level)
+                    ctx.__enter__()
+                try:
+                    xs_t = [Tensor(x) for x in xs]
+                    out, new_bufs = net.functional_call(
+                        {k: Tensor(v) for k, v in {**param_vals,
+                                                   **buf_vals}.items()},
+                        *xs_t)
+                finally:
+                    if ctx is not None:
+                        ctx.__exit__(None, None, None)
+                outs = out if isinstance(out, (list, tuple)) else [out]
+                loss = None
+                if loss_fn is not None and ys is not None:
+                    ys_t = [Tensor(y) for y in ys]
+                    loss = loss_fn(*outs, *ys_t)
+                    if isinstance(loss, (list, tuple)):
+                        from .. import tensor as T
+
+                        loss = T.add_n([l for l in loss])
+        finally:
+            for l in net.sublayers(include_self=True):
+                l.training = net_training
+        loss_raw = loss._value.astype(jnp.float32) if loss is not None else None
+        outs_raw = [o._value for o in outs]
+        return loss_raw, outs_raw, new_bufs
+
+    def _build_train(self):
+        opt = self.model._optimizer
+        engine = self
+
+        meta = opt.param_meta({k: p for k, p in
+                               self.model.network.named_parameters()
+                               if not p.stop_gradient})
+        clip = getattr(opt, "_grad_clip", None)
+
+        def step(param_vals, opt_states, buf_vals, xs, ys, lr, key):
+            def loss_of(pv):
+                loss, outs, new_bufs = engine._forward_loss(
+                    pv, buf_vals, xs, ys, key, training=True)
+                return loss, (outs, new_bufs)
+            (loss, (outs, new_bufs)), grads = jax.value_and_grad(
+                loss_of, has_aux=True)(param_vals)
+            new_params, new_states = opt.functional_update(
+                param_vals, grads, opt_states, lr, meta=meta, clip=clip)
+            return new_params, new_states, new_bufs, loss, outs
+
+        # donate params + opt states (large, rewritten in place by XLA);
+        # buf_vals must NOT be donated: it also carries non-trainable params
+        # whose arrays live on after the step
+        return jax.jit(step, donate_argnums=(0, 1))
+
+    def _build_eval(self):
+        engine = self
+
+        def step(param_vals, buf_vals, xs, ys, key):
+            loss, outs, _ = engine._forward_loss(param_vals, buf_vals, xs, ys,
+                                                 key, training=False)
+            return loss, outs
+
+        return jax.jit(step)
+
+    # -- mutable state sync ------------------------------------------------
+    def _param_dict(self):
+        return {k: p._value for k, p in self.model.network.named_parameters()
+                if not p.stop_gradient}
+
+    def _buf_dict(self):
+        d = {k: p._value for k, p in self.model.network.named_parameters()
+             if p.stop_gradient}
+        for k, b in self.model.network.named_buffers():
+            if isinstance(b, Tensor):
+                d[k] = b._value
+        return d
+
+    def _write_back(self, new_params, new_bufs):
+        net = self.model.network
+        params = dict(net.named_parameters())
+        for k, v in new_params.items():
+            params[k]._value = v
+        bufs = {k: b for k, b in net.named_buffers() if isinstance(b, Tensor)}
+        for k, v in new_bufs.items():
+            tgt = bufs.get(k)
+            if tgt is None:
+                tgt = params.get(k)
+            if tgt is not None:
+                tgt._value = v
+
+    def train_batch(self, xs, ys):
+        if self._train_fn is None:
+            self._train_fn = self._build_train()
+        params = self._param_dict()
+        if self._opt_states is None:
+            self._opt_states = self.model._optimizer.functional_init_states(
+                params)
+        bufs = self._buf_dict()
+        lr = jnp.asarray(self.model._optimizer.get_lr(), jnp.float32)
+        key = rnd.next_key()
+        new_params, self._opt_states, new_bufs, loss, outs = self._train_fn(
+            params, self._opt_states, bufs, xs, ys, lr, key)
+        self._write_back(new_params, new_bufs)
+        return loss, outs
+
+    def eval_batch(self, xs, ys):
+        if self._eval_fn is None:
+            self._eval_fn = self._build_eval()
+        loss, outs = self._eval_fn(self._param_dict(), self._buf_dict(), xs,
+                                   ys, rnd.next_key())
+        return loss, outs
+
+
+class Model:
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self._inputs = inputs
+        self._labels = labels
+        self._optimizer = None
+        self._loss = None
+        self._metrics = []
+        self._amp_level = None
+        self._engine = _JitStepEngine(self)
+        self.stop_training = False
+
+    # ---- setup -----------------------------------------------------------
+    def prepare(self, optimizer=None, loss=None, metrics=None,
+                amp_configs=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        ms = _to_list(metrics)
+        for m in ms:
+            if not isinstance(m, Metric):
+                raise TypeError(f"metric {m} must be paddle.metric.Metric")
+        self._metrics = ms
+        if amp_configs is not None:
+            if isinstance(amp_configs, str):
+                self._amp_level = amp_configs
+            elif isinstance(amp_configs, dict):
+                self._amp_level = amp_configs.get("level", "O1")
+        return self
+
+    # ---- single-batch APIs ----------------------------------------------
+    def train_batch(self, inputs, labels=None, update=True):
+        xs = [t._value if isinstance(t, Tensor) else jnp.asarray(np.asarray(t))
+              for t in _as_tensors(inputs)]
+        ys = None
+        if labels is not None:
+            ys = [t._value if isinstance(t, Tensor)
+                  else jnp.asarray(np.asarray(t)) for t in _as_tensors(labels)]
+        loss, outs = self._engine.train_batch(xs, ys)
+        metrics = self._update_metrics(outs, labels)
+        return self._loss_out(loss, metrics)
+
+    def eval_batch(self, inputs, labels=None):
+        xs = [t._value if isinstance(t, Tensor) else jnp.asarray(np.asarray(t))
+              for t in _as_tensors(inputs)]
+        ys = None
+        if labels is not None:
+            ys = [t._value if isinstance(t, Tensor)
+                  else jnp.asarray(np.asarray(t)) for t in _as_tensors(labels)]
+        loss, outs = self._engine.eval_batch(xs, ys)
+        metrics = self._update_metrics(outs, labels)
+        return self._loss_out(loss, metrics)
+
+    def predict_batch(self, inputs):
+        xs = [t._value if isinstance(t, Tensor) else jnp.asarray(np.asarray(t))
+              for t in _as_tensors(inputs)]
+        _, outs = self._engine.eval_batch(xs, None)
+        return [Tensor(o) for o in outs]
+
+    def _update_metrics(self, outs, labels):
+        res = []
+        if not self._metrics or labels is None:
+            return res
+        outs_t = [Tensor(o) for o in outs]
+        labels_t = _as_tensors(labels)
+        for m in self._metrics:
+            c = m.compute(*outs_t, *labels_t)
+            r = m.update(*(c if isinstance(c, (list, tuple)) else [c]))
+            res.append(r)
+        return res
+
+    def _loss_out(self, loss, metrics):
+        losses = [float(loss)] if loss is not None else []
+        if self._metrics and metrics:
+            return losses, metrics
+        return losses
+
+    # ---- fit/evaluate/predict -------------------------------------------
+    def _make_loader(self, data, batch_size, shuffle, num_workers, drop_last):
+        if data is None:
+            return None
+        if isinstance(data, DataLoader):
+            return data
+        if isinstance(data, Dataset):
+            return DataLoader(data, batch_size=batch_size, shuffle=shuffle,
+                              num_workers=num_workers, drop_last=drop_last)
+        # (x, y) arrays
+        arrays = [np.asarray(d) for d in _to_list(data)]
+        ds = _NumpyDataset(arrays)
+        return DataLoader(ds, batch_size=batch_size, shuffle=shuffle,
+                          num_workers=num_workers, drop_last=drop_last)
+
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
+            drop_last=False, shuffle=True, num_workers=0, callbacks=None,
+            accumulate_grad_batches=1, num_iters=None):
+        loader = self._make_loader(train_data, batch_size, shuffle,
+                                   num_workers, drop_last)
+        eval_loader = self._make_loader(eval_data, batch_size, False,
+                                        num_workers, False)
+        try:
+            steps = len(loader)
+        except TypeError:
+            steps = None
+        cbks = config_callbacks(callbacks, model=self, epochs=epochs,
+                                steps=steps, log_freq=log_freq,
+                                save_freq=save_freq, save_dir=save_dir,
+                                verbose=verbose,
+                                metrics=self._metrics_name())
+        from .callbacks import LRScheduler as _LRCb
+
+        # if the user installed an LRScheduler callback, it owns stepping
+        user_steps_lr = any(isinstance(c, _LRCb) for c in cbks.callbacks)
+        cbks.on_begin("train")
+        self.stop_training = False
+        it = 0
+        for epoch in range(epochs):
+            if self.stop_training:
+                break
+            cbks.on_epoch_begin(epoch)
+            self._reset_metrics()
+            logs = {}
+            for step, batch in enumerate(loader):
+                cbks.on_batch_begin("train", step, logs)
+                xs, ys = self._split_batch(batch)
+                res = self.train_batch(xs, ys)
+                logs = self._res_to_logs(res, step, batch_size)
+                cbks.on_batch_end("train", step, logs)
+                it += 1
+                if num_iters is not None and it >= num_iters:
+                    self.stop_training = True
+                    break
+            sch = self._optimizer._learning_rate
+            if hasattr(sch, "step") and not isinstance(sch, float) and \
+                    not user_steps_lr:
+                from ..optimizer.lr import ReduceOnPlateau
+
+                if not isinstance(sch, ReduceOnPlateau):
+                    sch.step()
+            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
+                eval_logs = self._run_eval(eval_loader, cbks, batch_size)
+                logs.update({"eval_" + k: v for k, v in eval_logs.items()})
+            cbks.on_epoch_end(epoch, logs)
+        cbks.on_end("train", logs)
+        return self
+
+    def _run_eval(self, loader, cbks, batch_size):
+        self._reset_metrics()
+        cbks.on_begin("eval")
+        logs = {}
+        for step, batch in enumerate(loader):
+            cbks.on_batch_begin("eval", step, logs)
+            xs, ys = self._split_batch(batch)
+            res = self.eval_batch(xs, ys)
+            logs = self._res_to_logs(res, step, batch_size)
+            cbks.on_batch_end("eval", step, logs)
+        cbks.on_end("eval", logs)
+        return logs
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None):
+        loader = self._make_loader(eval_data, batch_size, False, num_workers,
+                                   False)
+        try:
+            steps = len(loader)
+        except TypeError:
+            steps = None
+        cbks = config_callbacks(callbacks, model=self, epochs=1,
+                                steps=steps, log_freq=log_freq,
+                                verbose=verbose,
+                                metrics=self._metrics_name())
+        logs = self._run_eval(loader, cbks, batch_size)
+        out = {}
+        if "loss" in logs:
+            out["loss"] = logs["loss"]
+        for m in self._metrics:
+            for n, v in zip(_to_list(m.name()), _to_list(m.accumulate())):
+                out[n] = v
+        return out
+
+    def predict(self, test_data, batch_size=1, num_workers=0,
+                stack_outputs=False, verbose=1, callbacks=None):
+        loader = self._make_loader(test_data, batch_size, False, num_workers,
+                                   False)
+        outputs = []
+        for batch in loader:
+            xs, _ = self._split_batch(batch, has_label=False)
+            outs = self.predict_batch(xs)
+            outputs.append([o.numpy() for o in outs])
+        n_out = len(outputs[0])
+        per_out = [[b[i] for b in outputs] for i in range(n_out)]
+        if stack_outputs:
+            per_out = [np.concatenate(o, axis=0) for o in per_out]
+        return per_out
+
+    def _forward_arity(self):
+        import inspect
+
+        try:
+            sig = inspect.signature(self.network.forward)
+        except (TypeError, ValueError):
+            return 1
+        n = 0
+        for p in sig.parameters.values():
+            if p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD) and \
+                    p.default is p.empty:
+                n += 1
+            elif p.kind == p.VAR_POSITIONAL:
+                return None  # *args: take everything
+        return n
+
+    def _split_batch(self, batch, has_label=True):
+        batch = batch if isinstance(batch, (list, tuple)) else [batch]
+        n_in = len(_to_list(self._inputs))
+        if not n_in:
+            arity = self._forward_arity()
+            n_in = len(batch) if arity is None else min(arity, len(batch))
+        xs = list(batch[:n_in])
+        ys = list(batch[n_in:]) or None
+        return xs, ys
+
+    def _res_to_logs(self, res, step, batch_size):
+        logs = {"step": step, "batch_size": batch_size}
+        if isinstance(res, tuple):
+            losses, metrics = res
+        else:
+            losses, metrics = res, []
+        if losses:
+            logs["loss"] = losses[0] if len(losses) == 1 else losses
+        for m, r in zip(self._metrics, metrics):
+            for n, v in zip(_to_list(m.name()), _to_list(r)):
+                logs[n] = float(v)
+        return logs
+
+    def _metrics_name(self):
+        out = ["loss"]
+        for m in self._metrics:
+            out.extend(_to_list(m.name()))
+        return out
+
+    def _reset_metrics(self):
+        for m in self._metrics:
+            m.reset()
+
+    # ---- persistence -----------------------------------------------------
+    def save(self, path, training=True):
+        _save(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            sd = self._optimizer.state_dict()
+            if self._engine._opt_states is not None:
+                sd["_jit_states"] = {
+                    str(k): {kk: np.asarray(vv) for kk, vv in v.items()}
+                    for k, v in self._engine._opt_states.items()}
+            _save(sd, path + ".pdopt")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        self.network.set_state_dict(_load(path + ".pdparams"))
+        opt_path = path + ".pdopt"
+        if not reset_optimizer and os.path.exists(opt_path) and \
+                self._optimizer is not None:
+            sd = _load(opt_path)
+            jit_states = sd.pop("_jit_states", None)
+            self._optimizer.set_state_dict(sd)
+            if jit_states is not None:
+                self._engine._opt_states = {
+                    int(k): {kk: jnp.asarray(vv) for kk, vv in v.items()}
+                    for k, v in jit_states.items()}
+        return self
+
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters()
+
+    def summary(self, input_size=None, dtype=None):
+        from .summary import summary as _summary
+
+        return _summary(self.network, input_size, dtypes=dtype)
+
+
+class _NumpyDataset(Dataset):
+    def __init__(self, arrays):
+        self.arrays = arrays
+
+    def __len__(self):
+        return len(self.arrays[0])
+
+    def __getitem__(self, i):
+        return tuple(a[i] for a in self.arrays)

@@ -1,0 +1,60 @@
+"""Model summary (reference: python/paddle/hapi/model_summary.py)."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.tensor import Tensor
+
+__all__ = ["summary"]
+
+
+def summary(net, input_size=None, dtypes=None, input=None):  # noqa: A002
+    """Prints per-layer output shapes + param counts; returns totals."""
+    from .. import tensor as T
+
+    hooks = []
+    rows = []
+
+    def mk_hook(name):
+        def hook(layer, inputs, outputs):
+            outs = outputs if isinstance(outputs, (list, tuple)) else \
+                [outputs]
+            shapes = [list(o.shape) for o in outs if isinstance(o, Tensor)]
+            n_params = sum(p.size for p in layer._parameters.values()
+                           if p is not None)
+            rows.append((name, type(layer).__name__, shapes, n_params))
+        return hook
+
+    for name, layer in net.named_sublayers():
+        if not layer._sub_layers:  # leaves only
+            hooks.append(layer.register_forward_post_hook(mk_hook(name)))
+
+    if input is not None:
+        x = input
+        net(*x) if isinstance(x, (list, tuple)) else net(x)
+    else:
+        sizes = input_size if isinstance(input_size, list) and \
+            isinstance(input_size[0], (list, tuple)) else [input_size]
+        xs = [T.zeros(list(s), dtype=d) for s, d in
+              zip(sizes, (dtypes if isinstance(dtypes, (list, tuple))
+                          else [dtypes] * len(sizes)))]
+        net(*xs)
+    for h in hooks:
+        h.remove()
+
+    total = sum(p.size for p in net.parameters())
+    trainable = sum(p.size for p in net.parameters()
+                    if not p.stop_gradient)
+    line = "-" * 80
+    print(line)
+    print(f"{'Layer (type)':<38}{'Output Shape':<26}{'Param #':>14}")
+    print(line)
+    for name, tname, shapes, n in rows:
+        shape_s = str(shapes[0]) if shapes else "-"
+        print(f"{name + ' (' + tname + ')':<38}{shape_s:<26}{n:>14,}")
+    print(line)
+    print(f"Total params: {total:,}")
+    print(f"Trainable params: {trainable:,}")
+    print(f"Non-trainable params: {total - trainable:,}")
+    print(line)
+    return {"total_params": total, "trainable_params": trainable}

@@ -1,0 +1,188 @@
+"""DataLoader (reference: python/paddle/fluid/dataloader/dataloader_iter.py).
+
+TPU-native input pipeline: worker THREADS (numpy ops release the GIL) fill a
+bounded prefetch queue so host-side batch assembly overlaps device compute;
+the device transfer itself is async under XLA.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from .dataset import IterableDataset
+from .sampler import BatchSampler
+
+__all__ = ["DataLoader", "default_collate_fn", "get_worker_info"]
+
+_worker_info = threading.local()
+
+
+class _WorkerError:
+    def __init__(self, exc):
+        self.exc = exc
+
+
+class WorkerInfo:
+    def __init__(self, id, num_workers, dataset):  # noqa: A002
+        self.id = id
+        self.num_workers = num_workers
+        self.dataset = dataset
+
+
+def get_worker_info():
+    return getattr(_worker_info, "info", None)
+
+
+def default_collate_fn(batch):
+    sample = batch[0]
+    if isinstance(sample, Tensor):
+        from .. import tensor as T
+
+        return T.stack(batch, axis=0)
+    if isinstance(sample, np.ndarray):
+        return Tensor(np.stack(batch))
+    if isinstance(sample, (int, float, np.integer, np.floating)):
+        return Tensor(np.asarray(batch))
+    if isinstance(sample, (list, tuple)):
+        return tuple(default_collate_fn([b[i] for b in batch])
+                     for i in range(len(sample)))
+    if isinstance(sample, dict):
+        return {k: default_collate_fn([b[k] for b in batch]) for k in sample}
+    return batch
+
+
+class DataLoader:
+    def __init__(self, dataset, feed_list=None, places=None,
+                 return_list=True, batch_sampler=None, batch_size=1,
+                 shuffle=False, drop_last=False, collate_fn=None,
+                 num_workers=0, use_buffer_reader=True, prefetch_factor=2,
+                 use_shared_memory=True, timeout=0, worker_init_fn=None,
+                 persistent_workers=False):
+        self.dataset = dataset
+        self.collate_fn = collate_fn or default_collate_fn
+        self.num_workers = max(0, int(num_workers))
+        self.prefetch_factor = prefetch_factor
+        self.worker_init_fn = worker_init_fn
+        self.timeout = timeout
+        self._iterable = isinstance(dataset, IterableDataset)
+        if self._iterable:
+            self.batch_sampler = None
+            self.batch_size = batch_size
+            self.drop_last = drop_last
+        elif batch_sampler is not None:
+            self.batch_sampler = batch_sampler
+            self.batch_size = batch_sampler.batch_size
+        else:
+            if batch_size is None:
+                self.batch_sampler = None
+                self.batch_size = None
+            else:
+                self.batch_sampler = BatchSampler(
+                    dataset, shuffle=shuffle, batch_size=batch_size,
+                    drop_last=drop_last)
+                self.batch_size = batch_size
+
+    def __len__(self):
+        if self._iterable:
+            raise TypeError("length of IterableDataset-backed loader unknown")
+        if self.batch_sampler is None:
+            return len(self.dataset)
+        return len(self.batch_sampler)
+
+    # ---- iteration -------------------------------------------------------
+    def _fetch(self, indices):
+        return self.collate_fn([self.dataset[i] for i in indices])
+
+    def _iter_single(self):
+        if self._iterable:
+            batch = []
+            for item in self.dataset:
+                batch.append(item)
+                if len(batch) == self.batch_size:
+                    yield self.collate_fn(batch)
+                    batch = []
+            if batch and not self.drop_last:
+                yield self.collate_fn(batch)
+            return
+        if self.batch_sampler is None:  # no auto-batching
+            for i in range(len(self.dataset)):
+                yield self.dataset[i]
+            return
+        for indices in self.batch_sampler:
+            yield self._fetch(indices)
+
+    def _iter_workers(self):
+        """Thread pool keeps `num_workers * prefetch_factor` batches staged."""
+        task_q: queue.Queue = queue.Queue()
+        out: dict = {}
+        done = object()
+        lock = threading.Lock()
+        cond = threading.Condition(lock)
+        n_tasks = 0
+        for n_tasks, indices in enumerate(self.batch_sampler):
+            task_q.put((n_tasks, indices))
+        total = task_q.qsize()
+        stop = threading.Event()
+        max_ahead = max(2, self.num_workers * self.prefetch_factor)
+        next_to_yield = [0]
+
+        def worker(wid):
+            _worker_info.info = WorkerInfo(wid, self.num_workers, self.dataset)
+            if self.worker_init_fn:
+                self.worker_init_fn(wid)
+            while not stop.is_set():
+                try:
+                    i, indices = task_q.get_nowait()
+                except queue.Empty:
+                    return
+                with cond:
+                    while i - next_to_yield[0] >= max_ahead and \
+                            not stop.is_set():
+                        cond.wait(0.05)
+                if stop.is_set():
+                    return
+                try:
+                    batch = self._fetch(indices)
+                except BaseException as e:  # propagate to the consumer
+                    batch = _WorkerError(e)
+                with cond:
+                    out[i] = batch
+                    cond.notify_all()
+
+        threads = [threading.Thread(target=worker, args=(w,), daemon=True)
+                   for w in range(self.num_workers)]
+        for t in threads:
+            t.start()
+        import time as _time
+
+        deadline = None
+        try:
+            for i in range(total):
+                with cond:
+                    if self.timeout:
+                        deadline = _time.time() + self.timeout
+                    while i not in out:
+                        cond.wait(0.1)
+                        if deadline is not None and _time.time() > deadline:
+                            raise TimeoutError(
+                                f"DataLoader worker timed out after "
+                                f"{self.timeout}s waiting for batch {i}")
+                    batch = out.pop(i)
+                    next_to_yield[0] = i + 1
+                    cond.notify_all()
+                if isinstance(batch, _WorkerError):
+                    raise batch.exc
+                yield batch
+        finally:
+            stop.set()
+            with cond:
+                cond.notify_all()
+
+    def __iter__(self):
+        if self.num_workers > 0 and not self._iterable \
+                and self.batch_sampler is not None:
+            return self._iter_workers()
+        return self._iter_single()

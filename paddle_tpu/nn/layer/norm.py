@@ -35,8 +35,10 @@ class _BatchNormBase(Layer):
             default_initializer=I.Constant(1.0))
         self.bias = self.create_parameter([num_features], attr=bias_attr,
                                           is_bias=True)
-        self.register_buffer("_mean", Tensor(jnp.zeros(num_features)))
-        self.register_buffer("_variance", Tensor(jnp.ones(num_features)))
+        self.register_buffer("_mean", Tensor(jnp.zeros(num_features,
+                                                       jnp.float32)))
+        self.register_buffer("_variance", Tensor(jnp.ones(num_features,
+                                                          jnp.float32)))
 
     def forward(self, x):
         return F.batch_norm(x, self._mean, self._variance, self.weight,

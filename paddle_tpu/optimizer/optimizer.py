@@ -240,17 +240,45 @@ class Optimizer:
     load_state_dict = set_state_dict
 
     # functional access for hapi's fully-jitted train step ----------------
+    def param_meta(self, named_params):
+        """Static per-param options for the functional path, keyed like the
+        values tree: {name: (wd, l1, lr_mult, need_clip, static)}."""
+        return {
+            name: (self._wd_coeff(p), self._l1_coeff(p),
+                   float(getattr(p, "optimize_attr", {}).get(
+                       "learning_rate", 1.0)),
+                   bool(getattr(p, "need_clip", True)),
+                   self._param_static(p))
+            for name, p in named_params.items()
+        }
+
     def functional_update(self, values_tree, grads_tree, states_tree, lr,
-                          lr_mult=1.0):
-        """Pure pytree update used by hapi Model: maps the update rule over
-        matching pytrees. states_tree: dict name->state dict."""
+                          meta=None, clip=None):
+        """Pure pytree update used by hapi Model — applies the SAME
+        regularization-fold -> clip -> rule sequence as the fused step()."""
         leaves_v, treedef = jax.tree_util.tree_flatten(values_tree)
         leaves_g = treedef.flatten_up_to(grads_tree)
+        metas = treedef.flatten_up_to(meta) if meta is not None else \
+            [(0.0, 0.0, 1.0, True, None)] * len(leaves_v)
         leaves_s = [states_tree[i] for i in range(len(leaves_v))]
+        gs = []
+        for v, g, (wd, l1, _, _, _) in zip(leaves_v, leaves_g, metas):
+            g = g.astype(v.dtype)
+            if wd:
+                g = g + wd * v
+            if l1:
+                g = g + l1 * jnp.sign(v)
+            gs.append(g)
+        if clip is not None:
+            flags = [m[3] for m in metas]
+            clipped = clip.clip_values(
+                {i: g for i, (g, f) in enumerate(zip(gs, flags)) if f})
+            gs = [clipped.get(i, g) if flags[i] else g
+                  for i, g in enumerate(gs)]
         new_v, new_s = [], []
-        for v, g, s in zip(leaves_v, leaves_g, leaves_s):
-            nv, ns = self._update_rule(v, g.astype(v.dtype), s, lr, lr_mult,
-                                       None)
+        for v, g, s, (_, _, mult, _, static) in zip(leaves_v, gs, leaves_s,
+                                                    metas):
+            nv, ns = self._update_rule(v, g, s, lr, mult, static)
             new_v.append(nv.astype(v.dtype))
             new_s.append(ns)
         return jax.tree_util.tree_unflatten(treedef, new_v), \

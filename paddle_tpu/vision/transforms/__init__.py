@@ -1,0 +1,358 @@
+"""Vision transforms (reference: python/paddle/vision/transforms/*).
+
+Operate on numpy CHW float arrays (the loader's host-side format) so the
+input pipeline stays off-device until one async transfer per batch.
+"""
+from __future__ import annotations
+
+import numbers
+
+import numpy as np
+
+__all__ = ["Compose", "ToTensor", "Normalize", "Resize", "CenterCrop",
+           "RandomCrop", "RandomHorizontalFlip", "RandomVerticalFlip",
+           "Transpose", "BrightnessTransform", "ContrastTransform",
+           "SaturationTransform", "HueTransform", "ColorJitter", "Pad",
+           "RandomRotation", "Grayscale", "RandomResizedCrop",
+           "normalize", "resize", "to_tensor", "hflip", "vflip", "crop",
+           "center_crop"]
+
+
+def _chw(img):
+    img = np.asarray(img)
+    if img.ndim == 2:
+        img = img[None]
+    elif img.ndim == 3 and img.shape[-1] in (1, 3, 4) and \
+            img.shape[0] not in (1, 3, 4):
+        img = img.transpose(2, 0, 1)
+    return img.astype(np.float32)
+
+
+class Compose:
+    def __init__(self, transforms):
+        self.transforms = list(transforms)
+
+    def __call__(self, img):
+        for t in self.transforms:
+            img = t(img)
+        return img
+
+
+class BaseTransform:
+    def __init__(self, keys=None):
+        self.keys = keys
+
+    def __call__(self, img):
+        return self._apply_image(img)
+
+
+class ToTensor(BaseTransform):
+    def __init__(self, data_format="CHW", keys=None):
+        super().__init__(keys)
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        img = np.asarray(img)
+        if img.dtype == np.uint8:
+            img = img.astype(np.float32) / 255.0
+        img = _chw(img)
+        if self.data_format == "HWC":
+            img = img.transpose(1, 2, 0)
+        return img
+
+
+to_tensor = ToTensor()
+
+
+class Normalize(BaseTransform):
+    def __init__(self, mean=0.0, std=1.0, data_format="CHW", to_rgb=False,
+                 keys=None):
+        super().__init__(keys)
+        self.mean = np.asarray(mean, np.float32).reshape(-1, 1, 1)
+        self.std = np.asarray(std, np.float32).reshape(-1, 1, 1)
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        img = _chw(img)
+        return (img - self.mean) / self.std
+
+
+def normalize(img, mean, std, data_format="CHW", to_rgb=False):
+    return Normalize(mean, std, data_format, to_rgb)(img)
+
+
+def _resize_np(img, size):
+    """Nearest+linear resize on CHW numpy, no PIL dependency."""
+    c, h, w = img.shape
+    if isinstance(size, numbers.Number):
+        if h < w:
+            oh, ow = int(size), int(size * w / h)
+        else:
+            oh, ow = int(size * h / w), int(size)
+    else:
+        oh, ow = size
+    ys = np.clip((np.arange(oh) + 0.5) * h / oh - 0.5, 0, h - 1)
+    xs = np.clip((np.arange(ow) + 0.5) * w / ow - 0.5, 0, w - 1)
+    y0 = np.floor(ys).astype(int)
+    x0 = np.floor(xs).astype(int)
+    y1 = np.minimum(y0 + 1, h - 1)
+    x1 = np.minimum(x0 + 1, w - 1)
+    wy = (ys - y0)[None, :, None]
+    wx = (xs - x0)[None, None, :]
+    a = img[:, y0][:, :, x0]
+    b = img[:, y0][:, :, x1]
+    cc = img[:, y1][:, :, x0]
+    d = img[:, y1][:, :, x1]
+    return (a * (1 - wy) * (1 - wx) + b * (1 - wy) * wx
+            + cc * wy * (1 - wx) + d * wy * wx).astype(img.dtype)
+
+
+class Resize(BaseTransform):
+    def __init__(self, size, interpolation="bilinear", keys=None):
+        super().__init__(keys)
+        self.size = size
+
+    def _apply_image(self, img):
+        return _resize_np(_chw(img), self.size)
+
+
+def resize(img, size, interpolation="bilinear"):
+    return Resize(size, interpolation)(img)
+
+
+def crop(img, top, left, height, width):
+    return _chw(img)[:, top:top + height, left:left + width]
+
+
+def center_crop(img, output_size):
+    img = _chw(img)
+    if isinstance(output_size, numbers.Number):
+        output_size = (int(output_size), int(output_size))
+    th, tw = output_size
+    h, w = img.shape[1:]
+    top = max((h - th) // 2, 0)
+    left = max((w - tw) // 2, 0)
+    return crop(img, top, left, th, tw)
+
+
+class CenterCrop(BaseTransform):
+    def __init__(self, size, keys=None):
+        super().__init__(keys)
+        self.size = size
+
+    def _apply_image(self, img):
+        return center_crop(img, self.size)
+
+
+class RandomCrop(BaseTransform):
+    def __init__(self, size, padding=None, pad_if_needed=False, fill=0,
+                 padding_mode="constant", keys=None):
+        super().__init__(keys)
+        self.size = (size, size) if isinstance(size, numbers.Number) else size
+        self.padding = padding
+
+    def _apply_image(self, img):
+        img = _chw(img)
+        if self.padding:
+            p = self.padding
+            p = (p, p) if isinstance(p, numbers.Number) else p
+            img = np.pad(img, [(0, 0), (p[1], p[1]), (p[0], p[0])])
+        h, w = img.shape[1:]
+        th, tw = self.size
+        top = np.random.randint(0, max(h - th, 0) + 1)
+        left = np.random.randint(0, max(w - tw, 0) + 1)
+        return crop(img, top, left, th, tw)
+
+
+class RandomResizedCrop(BaseTransform):
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3),
+                 interpolation="bilinear", keys=None):
+        super().__init__(keys)
+        self.size = (size, size) if isinstance(size, numbers.Number) else size
+        self.scale = scale
+        self.ratio = ratio
+
+    def _apply_image(self, img):
+        img = _chw(img)
+        c, h, w = img.shape
+        area = h * w
+        for _ in range(10):
+            target = np.random.uniform(*self.scale) * area
+            ar = np.exp(np.random.uniform(np.log(self.ratio[0]),
+                                          np.log(self.ratio[1])))
+            cw = int(round(np.sqrt(target * ar)))
+            ch = int(round(np.sqrt(target / ar)))
+            if 0 < cw <= w and 0 < ch <= h:
+                top = np.random.randint(0, h - ch + 1)
+                left = np.random.randint(0, w - cw + 1)
+                return _resize_np(crop(img, top, left, ch, cw), self.size)
+        return _resize_np(center_crop(img, min(h, w)), self.size)
+
+
+def hflip(img):
+    return _chw(img)[:, :, ::-1].copy()
+
+
+def vflip(img):
+    return _chw(img)[:, ::-1].copy()
+
+
+class RandomHorizontalFlip(BaseTransform):
+    def __init__(self, prob=0.5, keys=None):
+        super().__init__(keys)
+        self.prob = prob
+
+    def _apply_image(self, img):
+        if np.random.rand() < self.prob:
+            return hflip(img)
+        return _chw(img)
+
+
+class RandomVerticalFlip(BaseTransform):
+    def __init__(self, prob=0.5, keys=None):
+        super().__init__(keys)
+        self.prob = prob
+
+    def _apply_image(self, img):
+        if np.random.rand() < self.prob:
+            return vflip(img)
+        return _chw(img)
+
+
+class Transpose(BaseTransform):
+    def __init__(self, order=(2, 0, 1), keys=None):
+        super().__init__(keys)
+        self.order = order
+
+    def _apply_image(self, img):
+        return np.asarray(img).transpose(self.order)
+
+
+class BrightnessTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        self.value = value
+
+    def _apply_image(self, img):
+        f = np.random.uniform(max(0, 1 - self.value), 1 + self.value)
+        return np.clip(_chw(img) * f, 0, 1)
+
+
+class ContrastTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        self.value = value
+
+    def _apply_image(self, img):
+        img = _chw(img)
+        f = np.random.uniform(max(0, 1 - self.value), 1 + self.value)
+        mean = img.mean()
+        return np.clip((img - mean) * f + mean, 0, 1)
+
+
+class SaturationTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        self.value = value
+
+    def _apply_image(self, img):
+        img = _chw(img)
+        f = np.random.uniform(max(0, 1 - self.value), 1 + self.value)
+        gray = img.mean(0, keepdims=True)
+        return np.clip((img - gray) * f + gray, 0, 1)
+
+
+class HueTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        self.value = value
+
+    def _apply_image(self, img):
+        # cheap hue emulation: channel roll-mix
+        img = _chw(img)
+        if img.shape[0] != 3:
+            return img
+        f = np.random.uniform(-self.value, self.value)
+        rolled = np.roll(img, 1, axis=0)
+        return np.clip(img * (1 - abs(f)) + rolled * abs(f), 0, 1)
+
+
+class ColorJitter(BaseTransform):
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0,
+                 keys=None):
+        super().__init__(keys)
+        self.ts = []
+        if brightness:
+            self.ts.append(BrightnessTransform(brightness))
+        if contrast:
+            self.ts.append(ContrastTransform(contrast))
+        if saturation:
+            self.ts.append(SaturationTransform(saturation))
+        if hue:
+            self.ts.append(HueTransform(hue))
+
+    def _apply_image(self, img):
+        for t in np.random.permutation(self.ts).tolist():
+            img = t(img)
+        return img
+
+
+class Pad(BaseTransform):
+    def __init__(self, padding, fill=0, padding_mode="constant", keys=None):
+        super().__init__(keys)
+        p = padding
+        if isinstance(p, numbers.Number):
+            p = (p, p, p, p)
+        elif len(p) == 2:
+            p = (p[0], p[1], p[0], p[1])
+        self.padding = p
+        self.fill = fill
+        self.mode = padding_mode
+
+    def _apply_image(self, img):
+        img = _chw(img)
+        l, t, r, b = self.padding
+        if self.mode == "constant":
+            return np.pad(img, [(0, 0), (t, b), (l, r)],
+                          constant_values=self.fill)
+        mode = {"reflect": "reflect", "edge": "edge",
+                "symmetric": "symmetric"}[self.mode]
+        return np.pad(img, [(0, 0), (t, b), (l, r)], mode=mode)
+
+
+class RandomRotation(BaseTransform):
+    def __init__(self, degrees, interpolation="nearest", expand=False,
+                 center=None, fill=0, keys=None):
+        super().__init__(keys)
+        if isinstance(degrees, numbers.Number):
+            degrees = (-degrees, degrees)
+        self.degrees = degrees
+
+    def _apply_image(self, img):
+        img = _chw(img)
+        angle = np.deg2rad(np.random.uniform(*self.degrees))
+        c, h, w = img.shape
+        cy, cx = (h - 1) / 2, (w - 1) / 2
+        yy, xx = np.mgrid[0:h, 0:w]
+        ys = cy + (yy - cy) * np.cos(angle) - (xx - cx) * np.sin(angle)
+        xs = cx + (yy - cy) * np.sin(angle) + (xx - cx) * np.cos(angle)
+        yi = np.clip(np.round(ys).astype(int), 0, h - 1)
+        xi = np.clip(np.round(xs).astype(int), 0, w - 1)
+        out = img[:, yi, xi]
+        mask = (ys < 0) | (ys > h - 1) | (xs < 0) | (xs > w - 1)
+        out[:, mask] = 0
+        return out
+
+
+class Grayscale(BaseTransform):
+    def __init__(self, num_output_channels=1, keys=None):
+        super().__init__(keys)
+        self.n = num_output_channels
+
+    def _apply_image(self, img):
+        img = _chw(img)
+        if img.shape[0] == 3:
+            g = (0.2989 * img[0] + 0.587 * img[1] + 0.114 * img[2])[None]
+        else:
+            g = img[:1]
+        return np.repeat(g, self.n, 0) if self.n > 1 else g

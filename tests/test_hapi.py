@@ -1,0 +1,160 @@
+"""hapi Model tests (reference: unittests test_model.py) + metrics."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.metric import Accuracy, Auc, Precision, Recall
+from paddle_tpu.vision.datasets import MNIST
+from paddle_tpu.vision.models import LeNet
+
+
+def test_lenet_fit_reaches_loss_threshold():
+    paddle.seed(0)
+    model = paddle.Model(LeNet())
+    model.prepare(paddle.optimizer.Adam(0.001,
+                                        parameters=model.parameters()),
+                  nn.CrossEntropyLoss(), Accuracy())
+    train = MNIST(mode="train")
+    model.fit(train, epochs=2, batch_size=64, verbose=0)
+    res = model.evaluate(MNIST(mode="test"), batch_size=64, verbose=0)
+    assert res["loss"] < 0.5
+    assert res["acc"] > 0.9
+
+
+def test_fit_with_numpy_arrays_and_predict():
+    paddle.seed(0)
+    x = np.random.rand(128, 4).astype(np.float32)
+    w = np.random.rand(4, 1).astype(np.float32)
+    y = (x @ w).astype(np.float32)
+    net = nn.Linear(4, 1)
+    model = paddle.Model(net)
+    model.prepare(paddle.optimizer.Adam(0.05, parameters=net.parameters()),
+                  nn.MSELoss())
+    model.fit([x, y], epochs=60, batch_size=32, verbose=0)
+    res = model.evaluate([x, y], batch_size=64, verbose=0)
+    assert res["loss"] < 1e-2
+    preds = model.predict([x], batch_size=64, stack_outputs=True)
+    assert preds[0].shape == (128, 1)
+    np.testing.assert_allclose(preds[0], y, atol=0.3)
+
+
+def test_train_eval_batch_api():
+    net = nn.Linear(3, 2)
+    model = paddle.Model(net)
+    model.prepare(paddle.optimizer.SGD(0.1, parameters=net.parameters()),
+                  nn.CrossEntropyLoss(), Accuracy())
+    x = np.random.rand(8, 3).astype(np.float32)
+    y = np.random.randint(0, 2, (8,))
+    loss1, m1 = model.train_batch([x], [y])
+    loss2, m2 = model.train_batch([x], [y])
+    assert loss2[0] < loss1[0] + 1.0  # training progresses / no blowup
+    eloss, em = model.eval_batch([x], [y])
+    assert isinstance(eloss[0], float)
+
+
+def test_bn_buffers_update_in_jitted_fit():
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(4, 8), nn.BatchNorm1D(8), nn.ReLU(),
+                        nn.Linear(8, 2))
+    model = paddle.Model(net)
+    model.prepare(paddle.optimizer.SGD(0.05, parameters=net.parameters()),
+                  nn.CrossEntropyLoss())
+    x = np.random.rand(64, 4).astype(np.float32) + 3.0  # mean ~3.5
+    y = np.random.randint(0, 2, (64,))
+    bn = net[1]
+    mean_before = bn._mean.numpy().copy()
+    model.fit([x, y], epochs=3, batch_size=16, verbose=0)
+    mean_after = bn._mean.numpy()
+    assert not np.allclose(mean_before, mean_after)
+    assert np.all(np.isfinite(mean_after))
+
+
+def test_callbacks_early_stopping_and_checkpoint(tmp_path):
+    paddle.seed(0)
+    x = np.random.rand(64, 4).astype(np.float32)
+    y = np.random.randint(0, 2, (64,))
+    net = nn.Linear(4, 2)
+    model = paddle.Model(net)
+    model.prepare(paddle.optimizer.SGD(0.0, parameters=net.parameters()),
+                  nn.CrossEntropyLoss())
+    es = paddle.callbacks.EarlyStopping(monitor="loss", patience=1,
+                                        save_best_model=False, verbose=0)
+    model.fit([x, y], eval_data=[x, y], epochs=10, batch_size=32, verbose=0,
+              callbacks=[es])
+    assert model.stop_training  # lr=0 -> no improvement -> stopped early
+    save_dir = str(tmp_path / "ckpts")
+    model2 = paddle.Model(nn.Linear(4, 2))
+    model2.prepare(paddle.optimizer.SGD(0.1,
+                                        parameters=model2.parameters()),
+                   nn.CrossEntropyLoss())
+    model2.fit([x, y], epochs=2, batch_size=32, verbose=0, save_dir=save_dir)
+    assert os.path.exists(os.path.join(save_dir, "final.pdparams"))
+    assert os.path.exists(os.path.join(save_dir, "1.pdparams"))
+
+
+def test_model_save_load_roundtrip(tmp_path):
+    net = LeNet()
+    model = paddle.Model(net)
+    model.prepare(paddle.optimizer.Adam(0.001, parameters=net.parameters()),
+                  nn.CrossEntropyLoss(), Accuracy())
+    x = np.random.rand(8, 1, 28, 28).astype(np.float32)
+    y = np.random.randint(0, 10, (8,))
+    model.train_batch([x], [y])
+    path = str(tmp_path / "model")
+    model.save(path)
+    m2 = paddle.Model(LeNet())
+    m2.prepare(paddle.optimizer.Adam(0.001, parameters=m2.parameters()),
+               nn.CrossEntropyLoss(), Accuracy())
+    m2.load(path)
+    p1 = model.predict_batch([x])[0].numpy()
+    p2 = m2.predict_batch([x])[0].numpy()
+    np.testing.assert_allclose(p1, p2, rtol=1e-5, atol=1e-6)
+
+
+def test_summary():
+    model = paddle.Model(LeNet())
+    info = model.summary((1, 1, 28, 28))
+    assert info["total_params"] == 61610  # LeNet param count
+
+
+def test_accuracy_metric():
+    acc = Accuracy(topk=(1, 2))
+    pred = paddle.to_tensor(np.array([[0.1, 0.9, 0.0], [0.8, 0.05, 0.15]],
+                                     np.float32))
+    label = paddle.to_tensor(np.array([[1], [2]]))
+    c = acc.compute(pred, label)
+    acc.update(c)
+    top1, top2 = acc.accumulate()
+    assert top1 == pytest.approx(0.5)
+    assert top2 == pytest.approx(1.0)
+    assert acc.name() == ["acc_top1", "acc_top2"]
+
+
+def test_precision_recall_auc():
+    prec = Precision()
+    rec = Recall()
+    preds = np.array([0.9, 0.8, 0.2, 0.6], np.float32)
+    labels = np.array([1, 0, 1, 1])
+    prec.update(preds, labels)
+    rec.update(preds, labels)
+    assert prec.accumulate() == pytest.approx(2 / 3)
+    assert rec.accumulate() == pytest.approx(2 / 3)
+    auc = Auc()
+    probs = np.stack([1 - preds, preds], -1)
+    auc.update(probs, labels)
+    assert 0.0 <= auc.accumulate() <= 1.0
+
+
+def test_lr_scheduler_steps_per_epoch_in_fit():
+    x = np.random.rand(32, 2).astype(np.float32)
+    y = np.random.randint(0, 2, (32,))
+    net = nn.Linear(2, 2)
+    sch = paddle.optimizer.lr.StepDecay(0.1, step_size=1, gamma=0.5)
+    opt = paddle.optimizer.SGD(sch, parameters=net.parameters())
+    model = paddle.Model(net)
+    model.prepare(opt, nn.CrossEntropyLoss())
+    model.fit([x, y], epochs=3, batch_size=16, verbose=0)
+    assert opt.get_lr() == pytest.approx(0.1 * 0.5 ** 3)

@@ -1,0 +1,119 @@
+"""io tests (reference: unittests test_dataloader_*, test_batch_sampler)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.io import (
+    BatchSampler, ChainDataset, ConcatDataset, DataLoader, Dataset,
+    DistributedBatchSampler, IterableDataset, RandomSampler, SequenceSampler,
+    Subset, TensorDataset, WeightedRandomSampler, random_split,
+)
+
+
+class RangeDataset(Dataset):
+    def __init__(self, n):
+        self.n = n
+
+    def __len__(self):
+        return self.n
+
+    def __getitem__(self, i):
+        return np.asarray([i, i * 2], np.float32), np.asarray(i % 3, np.int64)
+
+
+def test_dataloader_basic():
+    dl = DataLoader(RangeDataset(10), batch_size=4)
+    batches = list(dl)
+    assert len(batches) == 3
+    x, y = batches[0]
+    assert x.shape == [4, 2] and y.shape == [4]
+    assert batches[-1][0].shape == [2, 2]  # remainder kept
+    dl2 = DataLoader(RangeDataset(10), batch_size=4, drop_last=True)
+    assert len(list(dl2)) == 2
+
+
+def test_dataloader_shuffle_and_workers():
+    ds = RangeDataset(64)
+    dl = DataLoader(ds, batch_size=8, shuffle=True, num_workers=2)
+    seen = []
+    for x, y in dl:
+        seen.extend(x.numpy()[:, 0].astype(int).tolist())
+    assert sorted(seen) == list(range(64))
+    assert seen != list(range(64))  # shuffled
+
+
+def test_dataloader_custom_collate():
+    def collate(batch):
+        xs = np.stack([b[0] for b in batch])
+        return paddle.to_tensor(xs.sum())
+    dl = DataLoader(RangeDataset(4), batch_size=4, collate_fn=collate)
+    (out,) = list(dl)
+    assert out.ndim == 0
+
+
+def test_iterable_dataset():
+    class It(IterableDataset):
+        def __iter__(self):
+            for i in range(7):
+                yield np.asarray([i], np.float32)
+    dl = DataLoader(It(), batch_size=3)
+    shapes = [b.shape for b in dl]
+    assert shapes == [[3, 1], [3, 1], [1, 1]]
+
+
+def test_tensor_dataset_and_ops():
+    x = paddle.randn([10, 3])
+    y = paddle.arange(10)
+    ds = TensorDataset([x, y])
+    assert len(ds) == 10
+    a, b = ds[3]
+    assert a.shape == [3] and int(b) == 3
+    sub = Subset(ds, [1, 3, 5])
+    assert len(sub) == 3
+    parts = random_split(ds, [7, 3])
+    assert len(parts[0]) == 7 and len(parts[1]) == 3
+    parts_f = random_split(ds, [0.5, 0.5])
+    assert len(parts_f[0]) + len(parts_f[1]) == 10
+    cat = ConcatDataset([RangeDataset(3), RangeDataset(4)])
+    assert len(cat) == 7
+    np.testing.assert_allclose(cat[5][0], [2, 4])
+
+
+def test_samplers():
+    ds = RangeDataset(10)
+    assert list(SequenceSampler(ds)) == list(range(10))
+    rs = list(RandomSampler(ds))
+    assert sorted(rs) == list(range(10))
+    ws = list(WeightedRandomSampler([0.1, 0.9], 100))
+    assert 0 < sum(ws) < 100  # mostly index 1
+    bs = BatchSampler(ds, batch_size=3)
+    assert [len(b) for b in bs] == [3, 3, 3, 1]
+    assert len(bs) == 4
+
+
+def test_distributed_batch_sampler():
+    ds = RangeDataset(16)
+    seen = []
+    for rank in range(4):
+        s = DistributedBatchSampler(ds, batch_size=2, num_replicas=4,
+                                    rank=rank)
+        for b in s:
+            seen.extend(b)
+    assert sorted(seen) == list(range(16))
+    s0 = DistributedBatchSampler(ds, batch_size=2, num_replicas=4, rank=0,
+                                 shuffle=True)
+    s0.set_epoch(1)
+    assert len(list(s0)) == 2
+
+
+def test_save_load_roundtrip(tmp_path):
+    sd = {"w": paddle.randn([3, 3]), "nested": {"b": paddle.ones([2])},
+          "step": 7}
+    p = str(tmp_path / "ckpt.pdparams")
+    paddle.save(sd, p)
+    back = paddle.load(p)
+    np.testing.assert_allclose(back["w"].numpy(), sd["w"].numpy())
+    np.testing.assert_allclose(back["nested"]["b"].numpy(), [1, 1])
+    assert back["step"] == 7
+    back_np = paddle.load(p, return_numpy=True)
+    assert isinstance(back_np["w"], np.ndarray)

@@ -55,6 +55,14 @@ from .hapi import Model, callbacks, summary  # noqa: F401
 from .framework.io import load, save  # noqa: F401
 from . import vision  # noqa: F401
 from . import text  # noqa: F401
+from . import static  # noqa: F401
+from . import jit  # noqa: F401
+from . import device  # noqa: F401
+from .device import (  # noqa: F401
+    CPUPlace, CUDAPinnedPlace, CUDAPlace, TPUPlace, XPUPlace, get_device,
+    set_device,
+)
+from .static.program import InputSpec  # noqa: F401
 
 __version__ = "0.1.0"
 

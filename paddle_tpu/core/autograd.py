@@ -40,6 +40,10 @@ _state = _GradState()
 # (is_active_fn, cast_fn) installed by paddle_tpu.amp at import
 _amp_hook = None
 
+# active static-graph recorder (paddle_tpu.static) — when set, apply()
+# additionally records each op into the current Program
+_static_recorder = None
+
 
 def is_grad_enabled():
     return _state.enabled
@@ -112,6 +116,12 @@ def apply(fn, *args, **kwargs):
             v[i] = dv
         a, kw = jax.tree_util.tree_unflatten(treedef, v)
         return fn(*a, **kw)
+
+    if _static_recorder is not None:
+        out = closed()
+        out_t = jax.tree_util.tree_map(lambda leaf: Tensor(leaf), out)
+        _static_recorder.record_op(fn, flat, treedef, out_t)
+        return out_t
 
     if not diff_pos:
         out = closed()

@@ -1,0 +1,164 @@
+"""paddle.device (reference: python/paddle/device/__init__.py).
+
+TPUPlace is the accelerator; CUDAPlace aliases to it so reference code runs
+unchanged. Streams/events map onto XLA async dispatch: ops enqueue
+immediately, `synchronize()` blocks on all in-flight work.
+"""
+from __future__ import annotations
+
+import jax
+
+__all__ = ["TPUPlace", "CPUPlace", "CUDAPlace", "CUDAPinnedPlace", "XPUPlace",
+           "set_device", "get_device", "get_all_device_type",
+           "get_available_device", "is_compiled_with_cuda", "synchronize",
+           "cuda", "device_count"]
+
+
+class _Place:
+    def __init__(self, device_id=0):
+        self.device_id = device_id
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self.device_id})"
+
+    def __eq__(self, other):
+        return type(self) is type(other) and self.device_id == \
+            getattr(other, "device_id", None)
+
+
+class TPUPlace(_Place):
+    pass
+
+
+class CPUPlace(_Place):
+    def __init__(self):
+        super().__init__(0)
+
+    def __repr__(self):
+        return "CPUPlace"
+
+
+class CUDAPlace(TPUPlace):
+    """Compat alias: reference code asking for CUDA gets the TPU."""
+
+
+class CUDAPinnedPlace(CPUPlace):
+    pass
+
+
+class XPUPlace(TPUPlace):
+    pass
+
+
+_current = None
+
+
+def _accel_platform():
+    try:
+        return jax.devices()[0].platform
+    except RuntimeError:
+        return "cpu"
+
+
+def set_device(device):
+    """paddle.device.set_device('tpu'|'cpu'|'gpu'|'tpu:0'...)."""
+    global _current
+    name = device.split(":")[0]
+    if name in ("tpu", "gpu", "cuda", "xpu"):
+        _current = device
+        return TPUPlace(int(device.split(":")[1]) if ":" in device else 0)
+    if name == "cpu":
+        _current = "cpu"
+        jax.config.update("jax_default_device", jax.devices("cpu")[0])
+        return CPUPlace()
+    raise ValueError(f"unknown device {device!r}")
+
+
+def get_device():
+    if _current is not None:
+        return _current
+    plat = _accel_platform()
+    return f"{plat}:0" if plat != "cpu" else "cpu"
+
+
+def get_all_device_type():
+    return sorted({d.platform for d in jax.devices()} | {"cpu"})
+
+
+def get_available_device():
+    return [f"{d.platform}:{d.id}" for d in jax.devices()]
+
+
+def device_count():
+    return jax.device_count()
+
+
+def is_compiled_with_cuda():
+    return False
+
+
+def synchronize(device=None):
+    """Block until all async XLA work completes (stream sync analogue)."""
+    for d in jax.live_arrays():
+        try:
+            d.block_until_ready()
+        except Exception:  # noqa: BLE001 - deleted/donated arrays
+            pass
+
+
+class _CudaNS:
+    """paddle.device.cuda compat namespace."""
+
+    @staticmethod
+    def device_count():
+        return jax.device_count()
+
+    @staticmethod
+    def synchronize(device=None):
+        synchronize()
+
+    @staticmethod
+    def max_memory_allocated(device=None):
+        from ..runtime.memory import max_memory_allocated
+
+        return max_memory_allocated()
+
+    @staticmethod
+    def memory_allocated(device=None):
+        from ..runtime.memory import memory_allocated
+
+        return memory_allocated()
+
+    @staticmethod
+    def empty_cache():
+        pass
+
+    class Stream:
+        def __init__(self, device=None, priority=2):
+            pass
+
+        def synchronize(self):
+            synchronize()
+
+    class Event:
+        def __init__(self, enable_timing=False, blocking=False):
+            pass
+
+        def record(self, stream=None):
+            pass
+
+        def synchronize(self):
+            synchronize()
+
+
+cuda = _CudaNS()
+
+
+def _place_of(value):
+    try:
+        dev = value.devices().pop() if hasattr(value, "devices") else None
+    except Exception:  # noqa: BLE001
+        dev = None
+    if dev is not None and dev.platform != "cpu":
+        return TPUPlace(dev.id)
+    return CPUPlace()

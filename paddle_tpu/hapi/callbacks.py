@@ -219,6 +219,9 @@ class EarlyStopping(Callback):
         if self.better(cur, self.best):
             self.best = cur
             self.wait = 0
+            if self.save_best_model and self.params.get("save_dir"):
+                self.model.save(os.path.join(self.params["save_dir"],
+                                             "best_model"))
         else:
             self.wait += 1
             if self.wait > self.patience:
@@ -297,5 +300,6 @@ def config_callbacks(callbacks=None, model=None, batch_size=None, epochs=None,
     cl = CallbackList(cbks)
     cl.set_model(model)
     cl.set_params({"batch_size": batch_size, "epochs": epochs, "steps": steps,
-                   "verbose": verbose, "metrics": metrics or []})
+                   "verbose": verbose, "metrics": metrics or [],
+                   "save_dir": save_dir})
     return cl

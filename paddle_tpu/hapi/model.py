@@ -45,16 +45,20 @@ class _JitStepEngine:
     def __init__(self, model):
         self.model = model
         self._train_fn = None
+        self._grad_fn = None
+        self._apply_fn = None
         self._eval_fn = None
         self._opt_states = None
+        self._accum_grads = None
 
     # -- pure functions ----------------------------------------------------
     def _forward_loss(self, param_vals, buf_vals, xs, ys, key, training):
         net = self.model.network
         loss_fn = self.model._loss
         amp_level = self.model._amp_level
-        net_training = net.training
-        for l in net.sublayers(include_self=True):
+        layers = net.sublayers(include_self=True)
+        saved_flags = [l.training for l in layers]
+        for l in layers:
             l.training = training
         try:
             with rnd.key_scope(key), _ag.no_grad():
@@ -83,8 +87,8 @@ class _JitStepEngine:
 
                         loss = T.add_n([l for l in loss])
         finally:
-            for l in net.sublayers(include_self=True):
-                l.training = net_training
+            for l, flag in zip(layers, saved_flags):
+                l.training = flag
         loss_raw = loss._value.astype(jnp.float32) if loss is not None else None
         outs_raw = [o._value for o in outs]
         return loss_raw, outs_raw, new_bufs
@@ -113,6 +117,33 @@ class _JitStepEngine:
         # buf_vals must NOT be donated: it also carries non-trainable params
         # whose arrays live on after the step
         return jax.jit(step, donate_argnums=(0, 1))
+
+    def _build_grad(self):
+        engine = self
+
+        def step(param_vals, buf_vals, xs, ys, key):
+            def loss_of(pv):
+                loss, outs, new_bufs = engine._forward_loss(
+                    pv, buf_vals, xs, ys, key, training=True)
+                return loss, (outs, new_bufs)
+            (loss, (outs, new_bufs)), grads = jax.value_and_grad(
+                loss_of, has_aux=True)(param_vals)
+            return grads, loss, outs, new_bufs
+
+        return jax.jit(step)
+
+    def _build_apply(self):
+        opt = self.model._optimizer
+        meta = opt.param_meta({k: p for k, p in
+                               self.model.network.named_parameters()
+                               if not p.stop_gradient})
+        clip = getattr(opt, "_grad_clip", None)
+
+        def apply_step(param_vals, opt_states, grads, lr):
+            return opt.functional_update(param_vals, grads, opt_states, lr,
+                                         meta=meta, clip=clip)
+
+        return jax.jit(apply_step, donate_argnums=(0, 1))
 
     def _build_eval(self):
         engine = self
@@ -150,9 +181,7 @@ class _JitStepEngine:
             if tgt is not None:
                 tgt._value = v
 
-    def train_batch(self, xs, ys):
-        if self._train_fn is None:
-            self._train_fn = self._build_train()
+    def train_batch(self, xs, ys, update=True):
         params = self._param_dict()
         if self._opt_states is None:
             self._opt_states = self.model._optimizer.functional_init_states(
@@ -160,9 +189,33 @@ class _JitStepEngine:
         bufs = self._buf_dict()
         lr = jnp.asarray(self.model._optimizer.get_lr(), jnp.float32)
         key = rnd.next_key()
-        new_params, self._opt_states, new_bufs, loss, outs = self._train_fn(
-            params, self._opt_states, bufs, xs, ys, lr, key)
-        self._write_back(new_params, new_bufs)
+        if update and self._accum_grads is None:
+            # fast path: one fused XLA program
+            if self._train_fn is None:
+                self._train_fn = self._build_train()
+            new_params, self._opt_states, new_bufs, loss, outs = \
+                self._train_fn(params, self._opt_states, bufs, xs, ys, lr,
+                               key)
+            self._write_back(new_params, new_bufs)
+            return loss, outs
+        # accumulation path: grads computed now, applied on the update call
+        if self._grad_fn is None:
+            self._grad_fn = self._build_grad()
+        grads, loss, outs, new_bufs = self._grad_fn(params, bufs, xs, ys, key)
+        if self._accum_grads is None:
+            self._accum_grads = grads
+        else:
+            self._accum_grads = jax.tree_util.tree_map(
+                jnp.add, self._accum_grads, grads)
+        if update:
+            if self._apply_fn is None:
+                self._apply_fn = self._build_apply()
+            new_params, self._opt_states = self._apply_fn(
+                params, self._opt_states, self._accum_grads, lr)
+            self._accum_grads = None
+            self._write_back(new_params, new_bufs)
+        else:
+            self._write_back({}, new_bufs)
         return loss, outs
 
     def eval_batch(self, xs, ys):
@@ -210,7 +263,7 @@ class Model:
         if labels is not None:
             ys = [t._value if isinstance(t, Tensor)
                   else jnp.asarray(np.asarray(t)) for t in _as_tensors(labels)]
-        loss, outs = self._engine.train_batch(xs, ys)
+        loss, outs = self._engine.train_batch(xs, ys, update=update)
         metrics = self._update_metrics(outs, labels)
         return self._loss_out(loss, metrics)
 
@@ -288,6 +341,8 @@ class Model:
         cbks.on_begin("train")
         self.stop_training = False
         it = 0
+        logs = {}
+        acc_k = max(1, int(accumulate_grad_batches))
         for epoch in range(epochs):
             if self.stop_training:
                 break
@@ -297,7 +352,8 @@ class Model:
             for step, batch in enumerate(loader):
                 cbks.on_batch_begin("train", step, logs)
                 xs, ys = self._split_batch(batch)
-                res = self.train_batch(xs, ys)
+                res = self.train_batch(xs, ys,
+                                       update=(step + 1) % acc_k == 0)
                 logs = self._res_to_logs(res, step, batch_size)
                 cbks.on_batch_end("train", step, logs)
                 it += 1

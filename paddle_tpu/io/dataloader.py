@@ -128,11 +128,18 @@ class DataLoader:
         stop = threading.Event()
         max_ahead = max(2, self.num_workers * self.prefetch_factor)
         next_to_yield = [0]
+        init_err = [None]
 
         def worker(wid):
             _worker_info.info = WorkerInfo(wid, self.num_workers, self.dataset)
             if self.worker_init_fn:
-                self.worker_init_fn(wid)
+                try:
+                    self.worker_init_fn(wid)
+                except BaseException as e:
+                    with cond:
+                        init_err[0] = e
+                        cond.notify_all()
+                    return
             while not stop.is_set():
                 try:
                     i, indices = task_q.get_nowait()
@@ -165,6 +172,8 @@ class DataLoader:
                     if self.timeout:
                         deadline = _time.time() + self.timeout
                     while i not in out:
+                        if init_err[0] is not None:
+                            raise init_err[0]
                         cond.wait(0.1)
                         if deadline is not None and _time.time() > deadline:
                             raise TimeoutError(

@@ -1,0 +1,228 @@
+"""paddle.jit (reference: python/paddle/jit/api.py).
+
+to_static: wraps a Layer/function so calls run as ONE jit-compiled XLA
+program (per input-shape signature) — the dygraph-to-static translator's
+job, done by tracing instead of AST transforms (XLA is the graph).
+
+jit.save / jit.load: serialize via jax.export (StableHLO bytes) + params, so
+a saved model reloads WITHOUT the original Python class — the analogue of
+the reference's TranslatedLayer over a saved ProgramDesc.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import autograd as _ag
+from ..core.tensor import Tensor
+from ..framework import random as rnd
+from ..framework.io import load as _pload
+from ..framework.io import save as _psave
+from ..nn.layer.layers import Layer
+from ..static.program import InputSpec
+
+__all__ = ["to_static", "not_to_static", "save", "load", "TranslatedLayer",
+           "enable_to_static", "ignore_module"]
+
+_to_static_enabled = True
+
+
+def enable_to_static(flag=True):
+    global _to_static_enabled
+    _to_static_enabled = flag
+
+
+def not_to_static(fn=None):
+    if fn is None:
+        return lambda f: f
+    return fn
+
+
+def ignore_module(modules):
+    pass
+
+
+class StaticFunction:
+    """Callable wrapper compiling the target per input signature."""
+
+    def __init__(self, target, input_spec=None):
+        self._target = target
+        self._input_spec = input_spec
+        self._layer = target if isinstance(target, Layer) else None
+        self._cache = {}
+
+    @property
+    def parameters(self):
+        return self._layer.parameters() if self._layer else []
+
+    def _pure(self, training):
+        layer = self._layer
+        target = self._target
+
+        def fn(param_vals, buf_vals, key, *arg_vals):
+            with rnd.key_scope(key), _ag.no_grad():
+                if layer is not None:
+                    prev = [l.training for l in
+                            layer.sublayers(include_self=True)]
+                    for l in layer.sublayers(include_self=True):
+                        l.training = training
+                    try:
+                        out, new_bufs = layer.functional_call(
+                            {k: Tensor(v) for k, v in
+                             {**param_vals, **buf_vals}.items()},
+                            *[Tensor(a) for a in arg_vals])
+                    finally:
+                        for l, t in zip(layer.sublayers(include_self=True),
+                                        prev):
+                            l.training = t
+                else:
+                    out = target(*[Tensor(a) for a in arg_vals])
+                    new_bufs = {}
+            outs = jax.tree_util.tree_map(
+                lambda t: t._value if isinstance(t, Tensor) else t, out,
+                is_leaf=lambda t: isinstance(t, Tensor))
+            return outs, new_bufs
+        return fn
+
+    def _vals(self):
+        if self._layer is None:
+            return {}, {}
+        params = {k: p._value for k, p in self._layer.named_parameters()}
+        bufs = {k: b._value for k, b in self._layer.named_buffers()
+                if isinstance(b, Tensor)}
+        return params, bufs
+
+    def __call__(self, *args):
+        if not _to_static_enabled:
+            return self._target(*args)
+        arg_vals = tuple(
+            a._value if isinstance(a, Tensor) else jnp.asarray(np.asarray(a))
+            for a in args)
+        training = bool(self._layer.training) if self._layer else False
+        sig = (tuple((v.shape, str(v.dtype)) for v in arg_vals), training)
+        entry = self._cache.get(sig)
+        if entry is None:
+            entry = jax.jit(self._pure(training))
+            self._cache[sig] = entry
+        params, bufs = self._vals()
+        outs, new_bufs = entry(params, bufs, rnd.next_key(), *arg_vals)
+        if self._layer is not None and new_bufs:
+            all_named = dict(self._layer.named_buffers())
+            for k, v in new_bufs.items():
+                if k in all_named and isinstance(all_named[k], Tensor):
+                    all_named[k]._value = v
+        return jax.tree_util.tree_map(Tensor, outs)
+
+    # used by jit.save
+    def _exportable(self, arg_structs):
+        params, bufs = self._vals()
+        pure = self._pure(training=False)
+
+        def fwd(param_vals, *arg_vals):
+            outs, _ = pure(param_vals, bufs, jax.random.PRNGKey(0), *arg_vals)
+            return outs
+        return fwd, params
+
+
+def to_static(function=None, input_spec=None, build_strategy=None,
+              backend=None, **kwargs):
+    def decorate(target):
+        if isinstance(target, Layer):
+            return StaticFunction(target, input_spec)
+        sf = StaticFunction(target, input_spec)
+        import functools
+
+        functools.update_wrapper(sf, target, updated=[])
+        return sf
+    if function is not None:
+        return decorate(function)
+    return decorate
+
+
+def _specs_from(input_spec, layer):
+    """Dynamic dims (-1/None) become jax.export symbolic dims so the saved
+    StableHLO accepts any batch size."""
+    from jax import export as jexport
+
+    specs = []
+    n_sym = 0
+    for s in input_spec:
+        if isinstance(s, Tensor):
+            specs.append(jax.ShapeDtypeStruct(tuple(s.shape), s._value.dtype))
+            continue
+        if not isinstance(s, InputSpec):
+            raise TypeError(f"input_spec entries must be InputSpec/Tensor, "
+                            f"got {type(s)}")
+        from ..core import dtype as dtypes
+
+        shape = []
+        for d in s.shape:
+            if d in (-1, None):
+                (sym,) = jexport.symbolic_shape(f"_d{n_sym}")
+                n_sym += 1
+                shape.append(sym)
+            else:
+                shape.append(int(d))
+        specs.append(jax.ShapeDtypeStruct(tuple(shape),
+                                          dtypes.to_jax_dtype(s.dtype)))
+    return specs
+
+
+def save(layer, path, input_spec=None, **configs):
+    """jit.save: params + StableHLO export (reference: jit.save writes
+    ProgramDesc + params)."""
+    from jax import export as jexport
+
+    sf = layer if isinstance(layer, StaticFunction) else StaticFunction(layer)
+    if input_spec is None:
+        raise ValueError("jit.save requires input_spec on this backend")
+    structs = _specs_from(input_spec, layer)
+    fwd, params = sf._exportable(structs)
+    param_structs = {k: jax.ShapeDtypeStruct(v.shape, v.dtype)
+                     for k, v in params.items()}
+    exported = jexport.export(jax.jit(fwd))(param_structs, *structs)
+    blob = exported.serialize()
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path + ".pdmodel", "wb") as f:
+        f.write(blob)
+    _psave({k: Tensor(v) for k, v in params.items()}, path + ".pdiparams")
+    meta = {"in_shapes": [(list(s.shape), str(s.dtype)) for s in structs]}
+    with open(path + ".pdmodel.meta", "wb") as f:
+        pickle.dump(meta, f)
+
+
+class TranslatedLayer(Layer):
+    """Inference layer rebuilt from serialized StableHLO + params
+    (reference: fluid/dygraph/io.py TranslatedLayer)."""
+
+    def __init__(self, exported, params):
+        super().__init__()
+        self._exported = exported
+        self._params = params
+
+    def forward(self, *args):
+        arg_vals = [a._value if isinstance(a, Tensor)
+                    else jnp.asarray(np.asarray(a)) for a in args]
+        outs = self._exported.call(self._params, *arg_vals)
+        return jax.tree_util.tree_map(Tensor, outs)
+
+    def eval(self):
+        return self
+
+    def train(self):
+        raise RuntimeError("TranslatedLayer is inference-only")
+
+
+def load(path, **configs):
+    from jax import export as jexport
+
+    with open(path + ".pdmodel", "rb") as f:
+        exported = jexport.deserialize(bytearray(f.read()))
+    params = {k: v._value for k, v in _pload(path + ".pdiparams").items()}
+    return TranslatedLayer(exported, params)

@@ -137,11 +137,10 @@ class Auc(Metric):
             p = p[:, 1]  # prob of positive class
         idx = np.minimum((p * self.num_thresholds).astype(int),
                          self.num_thresholds)
-        for i, lab in zip(idx, l):
-            if lab:
-                self._stat_pos[i] += 1
-            else:
-                self._stat_neg[i] += 1
+        n_bins = self.num_thresholds + 1
+        pos_mask = l.astype(bool)
+        self._stat_pos += np.bincount(idx[pos_mask], minlength=n_bins)
+        self._stat_neg += np.bincount(idx[~pos_mask], minlength=n_bins)
 
     def reset(self):
         self._stat_pos = np.zeros(self.num_thresholds + 1)

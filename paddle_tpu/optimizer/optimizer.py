@@ -203,6 +203,16 @@ class Optimizer:
 
     def minimize(self, loss, startup_program=None, parameters=None,
                  no_grad_set=None):
+        from ..framework.mode import in_static_mode
+
+        if in_static_mode():
+            # record into the program; Executor folds backward+update into
+            # the jitted whole-program replay
+            from ..static.program import default_main_program
+
+            prog = default_main_program()
+            prog.minimize_records.append((self, loss))
+            return None, [(p, None) for p in prog.all_parameters()]
         loss.backward()
         self.step()
         return None, [(p, p.grad) for p in self._param_list]

@@ -1,0 +1,37 @@
+"""Memory stats (reference: fluid/memory allocator stats; paddle.device.cuda
+memory API). The XLA arena owns HBM; these report what it exposes."""
+from __future__ import annotations
+
+import jax
+
+__all__ = ["memory_allocated", "max_memory_allocated", "memory_reserved",
+           "max_memory_reserved", "memory_stats"]
+
+
+def _stats(device=None):
+    try:
+        d = jax.devices()[0] if device is None else device
+        return d.memory_stats() or {}
+    except Exception:  # noqa: BLE001 - CPU backend has no stats
+        return {}
+
+
+def memory_allocated(device=None):
+    return int(_stats(device).get("bytes_in_use", 0))
+
+
+def max_memory_allocated(device=None):
+    return int(_stats(device).get("peak_bytes_in_use", 0))
+
+
+def memory_reserved(device=None):
+    s = _stats(device)
+    return int(s.get("bytes_reserved", s.get("bytes_limit", 0)))
+
+
+def max_memory_reserved(device=None):
+    return int(_stats(device).get("bytes_limit", 0))
+
+
+def memory_stats(device=None):
+    return dict(_stats(device))

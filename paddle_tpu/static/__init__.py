@@ -1,0 +1,38 @@
+"""paddle.static (reference: python/paddle/static/__init__.py)."""
+from . import nn  # noqa: F401
+from .executor import (  # noqa: F401
+    BuildStrategy, CompiledProgram, ExecutionStrategy, Executor, global_scope,
+    scope_guard,
+)
+from .program import (  # noqa: F401
+    InputSpec, Program, Variable, data, default_main_program,
+    default_startup_program, name_scope, program_guard,
+)
+
+
+def cpu_places(device_count=None):
+    from ..device import CPUPlace
+
+    return [CPUPlace()]
+
+
+def cuda_places(device_ids=None):  # maps to the accelerator on this build
+    from ..device import TPUPlace
+
+    return [TPUPlace(0)]
+
+
+def save(program, model_path, protocol=4):
+    from ..framework.io import save as _save
+
+    params = {p.name: p for p in program.all_parameters()}
+    _save(params, model_path + ".pdparams")
+
+
+def load(program, model_path, executor=None, var_list=None):
+    from ..framework.io import load as _load
+
+    params = _load(model_path + ".pdparams")
+    for p in program.all_parameters():
+        if p.name in params:
+            p.set_value(params[p.name])

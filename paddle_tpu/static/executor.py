@@ -1,0 +1,154 @@
+"""Executor (reference: python/paddle/fluid/executor.py).
+
+run(program, feed, fetch_list) jit-compiles the recorded graph once per feed
+shape signature and replays it as a single XLA program. Training programs
+(optimizer.minimize recorded) carry functional optimizer state inside the
+Executor and donate param buffers.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from .program import Program, default_main_program
+
+__all__ = ["Executor", "global_scope", "scope_guard", "CompiledProgram",
+           "BuildStrategy", "ExecutionStrategy"]
+
+
+class _Scope:
+    def __init__(self):
+        self.vars = {}
+
+    def find_var(self, name):
+        return self.vars.get(name)
+
+    def var(self, name):
+        return self.vars.setdefault(name, _ScopeVar(name))
+
+
+class _ScopeVar:
+    def __init__(self, name):
+        self.name = name
+        self.value = None
+
+    def get_tensor(self):
+        return self.value
+
+
+_scope = _Scope()
+
+
+def global_scope():
+    return _scope
+
+
+import contextlib
+
+
+@contextlib.contextmanager
+def scope_guard(scope):
+    global _scope
+    prev = _scope
+    _scope = scope
+    try:
+        yield
+    finally:
+        _scope = prev
+
+
+class BuildStrategy:
+    def __init__(self):
+        self.memory_optimize = True
+        self.enable_inplace = True
+        self.fuse_all_optimizer_ops = True  # XLA fuses by construction
+
+
+class ExecutionStrategy:
+    def __init__(self):
+        self.num_threads = 1
+        self.num_iteration_per_drop_scope = 100
+
+
+class CompiledProgram:
+    """reference: fluid/compiler.py — here programs always compile whole, so
+    this is a thin marker carrying build strategies."""
+
+    def __init__(self, program, build_strategy=None):
+        self.program = program
+        self.build_strategy = build_strategy or BuildStrategy()
+
+    def with_data_parallel(self, loss_name=None, build_strategy=None,
+                           exec_strategy=None, places=None):
+        return self
+
+
+class Executor:
+    def __init__(self, place=None):
+        self.place = place
+        self._cache = {}      # (prog id, shape sig, fetch sig, train) -> fn
+        self._opt_states = {}  # prog id -> functional opt states
+        self._ran_startup = False
+
+    def run(self, program=None, feed=None, fetch_list=None, feed_var_name="feed",
+            fetch_var_name="fetch", scope=None, return_numpy=True,
+            use_program_cache=True):
+        if isinstance(program, CompiledProgram):
+            program = program.program
+        program = program or default_main_program()
+        feed = feed or {}
+        fetch_list = fetch_list or []
+        feed_vals = {}
+        for name, v in program.feed_vars.items():
+            if name not in feed:
+                raise ValueError(f"missing feed for static.data {name!r}")
+            fv = feed[name]
+            fv = fv._value if isinstance(fv, Tensor) else jnp.asarray(
+                np.asarray(fv))
+            feed_vals[name] = fv
+        fetch_ids = []
+        fetch_tensors = []
+        for f in fetch_list:
+            t = f
+            if isinstance(t, str):
+                raise TypeError("fetch by name unsupported; pass the Tensor")
+            fetch_ids.append(id(t))
+            fetch_tensors.append(t)
+
+        train = bool(program.minimize_records)
+        sig = (id(program),
+               tuple((n, tuple(v.shape), str(v.dtype))
+                     for n, v in sorted(feed_vals.items())),
+               tuple(fetch_ids), train)
+        entry = self._cache.get(sig)
+        if entry is None:
+            raw = program.build_fn(fetch_ids, train=train)
+            if train:
+                entry = jax.jit(raw, donate_argnums=(0, 2))
+            else:
+                entry = jax.jit(raw)
+            self._cache[sig] = entry
+
+        param_vals = {p.name: p._value for p in program.param_ids.values()}
+        if train:
+            optimizer, _ = program.minimize_records[0]
+            states = self._opt_states.get(id(program))
+            if states is None:
+                states = optimizer.functional_init_states(param_vals)
+            lr = jnp.asarray(optimizer.get_lr(), jnp.float32)
+            fetches, new_params, new_states = entry(param_vals, feed_vals,
+                                                    states, lr)
+            self._opt_states[id(program)] = new_states
+            for p in program.param_ids.values():
+                p._value = new_params[p.name]
+            optimizer._global_step += 1
+        else:
+            fetches, _, _ = entry(param_vals, feed_vals)
+        if return_numpy:
+            return [np.asarray(f) for f in fetches]
+        return [Tensor(f) for f in fetches]
+
+    def close(self):
+        self._cache.clear()

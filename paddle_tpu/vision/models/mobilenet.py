@@ -1,0 +1,263 @@
+"""MobileNet V1/V2/V3 (reference: python/paddle/vision/models/mobilenetv1.py,
+mobilenetv2.py, mobilenetv3.py)."""
+from __future__ import annotations
+
+from ... import nn
+
+__all__ = ["MobileNetV1", "mobilenet_v1", "MobileNetV2", "mobilenet_v2",
+           "MobileNetV3Small", "MobileNetV3Large", "mobilenet_v3_small",
+           "mobilenet_v3_large"]
+
+
+def _make_divisible(v, divisor=8, min_value=None):
+    min_value = min_value or divisor
+    new_v = max(min_value, int(v + divisor / 2) // divisor * divisor)
+    if new_v < 0.9 * v:
+        new_v += divisor
+    return new_v
+
+
+class _ConvBNReLU(nn.Sequential):
+    def __init__(self, in_c, out_c, k=3, stride=1, groups=1,
+                 act=nn.ReLU):
+        pad = (k - 1) // 2
+        layers = [nn.Conv2D(in_c, out_c, k, stride, pad, groups=groups,
+                            bias_attr=False), nn.BatchNorm2D(out_c)]
+        if act is not None:
+            layers.append(act())
+        super().__init__(*layers)
+
+
+class MobileNetV1(nn.Layer):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+
+        def c(ch):
+            return max(int(ch * scale), 8)
+
+        def dw_sep(in_c, out_c, stride):
+            return nn.Sequential(
+                _ConvBNReLU(in_c, in_c, 3, stride, groups=in_c),
+                _ConvBNReLU(in_c, out_c, 1))
+
+        self.features = nn.Sequential(
+            _ConvBNReLU(3, c(32), 3, 2),
+            dw_sep(c(32), c(64), 1),
+            dw_sep(c(64), c(128), 2), dw_sep(c(128), c(128), 1),
+            dw_sep(c(128), c(256), 2), dw_sep(c(256), c(256), 1),
+            dw_sep(c(256), c(512), 2),
+            *[dw_sep(c(512), c(512), 1) for _ in range(5)],
+            dw_sep(c(512), c(1024), 2), dw_sep(c(1024), c(1024), 1))
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.fc = nn.Linear(c(1024), num_classes)
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            from ... import tensor as T
+
+            x = self.fc(T.flatten(x, 1))
+        return x
+
+
+class InvertedResidual(nn.Layer):
+    def __init__(self, inp, oup, stride, expand_ratio):
+        super().__init__()
+        hidden = int(round(inp * expand_ratio))
+        self.use_res = stride == 1 and inp == oup
+        layers = []
+        if expand_ratio != 1:
+            layers.append(_ConvBNReLU(inp, hidden, 1, act=nn.ReLU6))
+        layers += [
+            _ConvBNReLU(hidden, hidden, 3, stride, groups=hidden,
+                        act=nn.ReLU6),
+            nn.Conv2D(hidden, oup, 1, bias_attr=False),
+            nn.BatchNorm2D(oup)]
+        self.conv = nn.Sequential(*layers)
+
+    def forward(self, x):
+        out = self.conv(x)
+        return x + out if self.use_res else out
+
+
+class MobileNetV2(nn.Layer):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        cfg = [(1, 16, 1, 1), (6, 24, 2, 2), (6, 32, 3, 2), (6, 64, 4, 2),
+               (6, 96, 3, 1), (6, 160, 3, 2), (6, 320, 1, 1)]
+        in_c = _make_divisible(32 * scale)
+        features = [_ConvBNReLU(3, in_c, 3, 2, act=nn.ReLU6)]
+        for t, ch, n, s in cfg:
+            out_c = _make_divisible(ch * scale)
+            for i in range(n):
+                features.append(InvertedResidual(in_c, out_c,
+                                                 s if i == 0 else 1, t))
+                in_c = out_c
+        last = _make_divisible(1280 * max(1.0, scale))
+        features.append(_ConvBNReLU(in_c, last, 1, act=nn.ReLU6))
+        self.features = nn.Sequential(*features)
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.classifier = nn.Sequential(nn.Dropout(0.2),
+                                            nn.Linear(last, num_classes))
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            from ... import tensor as T
+
+            x = self.classifier(T.flatten(x, 1))
+        return x
+
+
+class _SqueezeExcite(nn.Layer):
+    def __init__(self, ch, squeeze=4):
+        super().__init__()
+        mid = _make_divisible(ch // squeeze)
+        self.pool = nn.AdaptiveAvgPool2D(1)
+        self.fc1 = nn.Conv2D(ch, mid, 1)
+        self.fc2 = nn.Conv2D(mid, ch, 1)
+
+    def forward(self, x):
+        s = self.pool(x)
+        s = nn.functional.relu(self.fc1(s))
+        s = nn.functional.hardsigmoid(self.fc2(s), slope=0.2, offset=0.5)
+        return x * s
+
+
+class _V3Block(nn.Layer):
+    def __init__(self, in_c, mid_c, out_c, k, stride, use_se, act):
+        super().__init__()
+        self.use_res = stride == 1 and in_c == out_c
+        act_layer = nn.Hardswish if act == "hardswish" else nn.ReLU
+        layers = []
+        if mid_c != in_c:
+            layers.append(_ConvBNReLU(in_c, mid_c, 1, act=act_layer))
+        layers.append(_ConvBNReLU(mid_c, mid_c, k, stride, groups=mid_c,
+                                  act=act_layer))
+        self.pre = nn.Sequential(*layers)
+        self.se = _SqueezeExcite(mid_c) if use_se else None
+        self.post = nn.Sequential(
+            nn.Conv2D(mid_c, out_c, 1, bias_attr=False),
+            nn.BatchNorm2D(out_c))
+
+    def forward(self, x):
+        out = self.pre(x)
+        if self.se is not None:
+            out = self.se(out)
+        out = self.post(out)
+        return x + out if self.use_res else out
+
+
+_V3_LARGE = [
+    # k, mid, out, se, act, stride
+    (3, 16, 16, False, "relu", 1),
+    (3, 64, 24, False, "relu", 2),
+    (3, 72, 24, False, "relu", 1),
+    (5, 72, 40, True, "relu", 2),
+    (5, 120, 40, True, "relu", 1),
+    (5, 120, 40, True, "relu", 1),
+    (3, 240, 80, False, "hardswish", 2),
+    (3, 200, 80, False, "hardswish", 1),
+    (3, 184, 80, False, "hardswish", 1),
+    (3, 184, 80, False, "hardswish", 1),
+    (3, 480, 112, True, "hardswish", 1),
+    (3, 672, 112, True, "hardswish", 1),
+    (5, 672, 160, True, "hardswish", 2),
+    (5, 960, 160, True, "hardswish", 1),
+    (5, 960, 160, True, "hardswish", 1),
+]
+
+_V3_SMALL = [
+    (3, 16, 16, True, "relu", 2),
+    (3, 72, 24, False, "relu", 2),
+    (3, 88, 24, False, "relu", 1),
+    (5, 96, 40, True, "hardswish", 2),
+    (5, 240, 40, True, "hardswish", 1),
+    (5, 240, 40, True, "hardswish", 1),
+    (5, 120, 48, True, "hardswish", 1),
+    (5, 144, 48, True, "hardswish", 1),
+    (5, 288, 96, True, "hardswish", 2),
+    (5, 576, 96, True, "hardswish", 1),
+    (5, 576, 96, True, "hardswish", 1),
+]
+
+
+class _MobileNetV3(nn.Layer):
+    def __init__(self, cfg, last_c, scale=1.0, num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        in_c = _make_divisible(16 * scale)
+        layers = [_ConvBNReLU(3, in_c, 3, 2, act=nn.Hardswish)]
+        for k, mid, out, se, act, s in cfg:
+            mid_c = _make_divisible(mid * scale)
+            out_c = _make_divisible(out * scale)
+            layers.append(_V3Block(in_c, mid_c, out_c, k, s, se, act))
+            in_c = out_c
+        last_conv = _make_divisible(cfg[-1][1] * scale)
+        layers.append(_ConvBNReLU(in_c, last_conv, 1, act=nn.Hardswish))
+        self.features = nn.Sequential(*layers)
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.classifier = nn.Sequential(
+                nn.Linear(last_conv, last_c), nn.Hardswish(),
+                nn.Dropout(0.2), nn.Linear(last_c, num_classes))
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            from ... import tensor as T
+
+            x = self.classifier(T.flatten(x, 1))
+        return x
+
+
+class MobileNetV3Large(_MobileNetV3):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__(_V3_LARGE, 1280, scale, num_classes, with_pool)
+
+
+class MobileNetV3Small(_MobileNetV3):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__(_V3_SMALL, 1024, scale, num_classes, with_pool)
+
+
+def _no_pretrained(pretrained):
+    if pretrained:
+        raise NotImplementedError("pretrained weights unavailable offline")
+
+
+def mobilenet_v1(pretrained=False, scale=1.0, **kwargs):
+    _no_pretrained(pretrained)
+    return MobileNetV1(scale=scale, **kwargs)
+
+
+def mobilenet_v2(pretrained=False, scale=1.0, **kwargs):
+    _no_pretrained(pretrained)
+    return MobileNetV2(scale=scale, **kwargs)
+
+
+def mobilenet_v3_small(pretrained=False, scale=1.0, **kwargs):
+    _no_pretrained(pretrained)
+    return MobileNetV3Small(scale=scale, **kwargs)
+
+
+def mobilenet_v3_large(pretrained=False, scale=1.0, **kwargs):
+    _no_pretrained(pretrained)
+    return MobileNetV3Large(scale=scale, **kwargs)

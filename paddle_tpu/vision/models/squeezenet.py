@@ -1,0 +1,199 @@
+"""SqueezeNet + ShuffleNetV2 (reference: python/paddle/vision/models/
+squeezenet.py, shufflenetv2.py)."""
+from __future__ import annotations
+
+from ... import nn
+
+__all__ = ["SqueezeNet", "squeezenet1_0", "squeezenet1_1", "ShuffleNetV2",
+           "shufflenet_v2_x0_25", "shufflenet_v2_x0_33", "shufflenet_v2_x0_5",
+           "shufflenet_v2_x1_0", "shufflenet_v2_x1_5", "shufflenet_v2_x2_0",
+           "shufflenet_v2_swish"]
+
+
+class _Fire(nn.Layer):
+    def __init__(self, in_c, squeeze_c, e1_c, e3_c):
+        super().__init__()
+        self.squeeze = nn.Conv2D(in_c, squeeze_c, 1)
+        self.expand1 = nn.Conv2D(squeeze_c, e1_c, 1)
+        self.expand3 = nn.Conv2D(squeeze_c, e3_c, 3, padding=1)
+
+    def forward(self, x):
+        from ... import tensor as T
+
+        s = nn.functional.relu(self.squeeze(x))
+        return T.concat([nn.functional.relu(self.expand1(s)),
+                         nn.functional.relu(self.expand3(s))], axis=1)
+
+
+class SqueezeNet(nn.Layer):
+    def __init__(self, version="1.0", num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        if version == "1.0":
+            self.features = nn.Sequential(
+                nn.Conv2D(3, 96, 7, stride=2), nn.ReLU(),
+                nn.MaxPool2D(3, 2, ceil_mode=True),
+                _Fire(96, 16, 64, 64), _Fire(128, 16, 64, 64),
+                _Fire(128, 32, 128, 128),
+                nn.MaxPool2D(3, 2, ceil_mode=True),
+                _Fire(256, 32, 128, 128), _Fire(256, 48, 192, 192),
+                _Fire(384, 48, 192, 192), _Fire(384, 64, 256, 256),
+                nn.MaxPool2D(3, 2, ceil_mode=True),
+                _Fire(512, 64, 256, 256))
+        else:
+            self.features = nn.Sequential(
+                nn.Conv2D(3, 64, 3, stride=2), nn.ReLU(),
+                nn.MaxPool2D(3, 2, ceil_mode=True),
+                _Fire(64, 16, 64, 64), _Fire(128, 16, 64, 64),
+                nn.MaxPool2D(3, 2, ceil_mode=True),
+                _Fire(128, 32, 128, 128), _Fire(256, 32, 128, 128),
+                nn.MaxPool2D(3, 2, ceil_mode=True),
+                _Fire(256, 48, 192, 192), _Fire(384, 48, 192, 192),
+                _Fire(384, 64, 256, 256), _Fire(512, 64, 256, 256))
+        if num_classes > 0:
+            self.classifier = nn.Sequential(
+                nn.Dropout(0.5), nn.Conv2D(512, num_classes, 1), nn.ReLU(),
+                nn.AdaptiveAvgPool2D(1))
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.num_classes > 0:
+            from ... import tensor as T
+
+            x = self.classifier(x)
+            x = T.flatten(x, 1)
+        return x
+
+
+def squeezenet1_0(pretrained=False, **kwargs):
+    if pretrained:
+        raise NotImplementedError("pretrained weights unavailable offline")
+    return SqueezeNet("1.0", **kwargs)
+
+
+def squeezenet1_1(pretrained=False, **kwargs):
+    if pretrained:
+        raise NotImplementedError("pretrained weights unavailable offline")
+    return SqueezeNet("1.1", **kwargs)
+
+
+class _ShuffleUnit(nn.Layer):
+    def __init__(self, in_c, out_c, stride, act="relu"):
+        super().__init__()
+        self.stride = stride
+        branch_c = out_c // 2
+        act_layer = nn.Swish if act == "swish" else nn.ReLU
+        if stride == 2:
+            self.branch1 = nn.Sequential(
+                nn.Conv2D(in_c, in_c, 3, stride=2, padding=1, groups=in_c,
+                          bias_attr=False),
+                nn.BatchNorm2D(in_c),
+                nn.Conv2D(in_c, branch_c, 1, bias_attr=False),
+                nn.BatchNorm2D(branch_c), act_layer())
+            b2_in = in_c
+        else:
+            self.branch1 = None
+            b2_in = in_c // 2
+        self.branch2 = nn.Sequential(
+            nn.Conv2D(b2_in, branch_c, 1, bias_attr=False),
+            nn.BatchNorm2D(branch_c), act_layer(),
+            nn.Conv2D(branch_c, branch_c, 3, stride=stride, padding=1,
+                      groups=branch_c, bias_attr=False),
+            nn.BatchNorm2D(branch_c),
+            nn.Conv2D(branch_c, branch_c, 1, bias_attr=False),
+            nn.BatchNorm2D(branch_c), act_layer())
+
+    def forward(self, x):
+        from ... import tensor as T
+
+        if self.stride == 1:
+            x1, x2 = T.split(x, 2, axis=1)
+            out = T.concat([x1, self.branch2(x2)], axis=1)
+        else:
+            out = T.concat([self.branch1(x), self.branch2(x)], axis=1)
+        return nn.functional.channel_shuffle(out, 2)
+
+
+class ShuffleNetV2(nn.Layer):
+    def __init__(self, scale=1.0, act="relu", num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        stage_repeats = [4, 8, 4]
+        channels = {0.25: [24, 24, 48, 96, 512],
+                    0.33: [24, 32, 64, 128, 512],
+                    0.5: [24, 48, 96, 192, 1024],
+                    1.0: [24, 116, 232, 464, 1024],
+                    1.5: [24, 176, 352, 704, 1024],
+                    2.0: [24, 244, 488, 976, 2048]}[scale]
+        act_layer = nn.Swish if act == "swish" else nn.ReLU
+        self.conv1 = nn.Sequential(
+            nn.Conv2D(3, channels[0], 3, stride=2, padding=1,
+                      bias_attr=False),
+            nn.BatchNorm2D(channels[0]), act_layer())
+        self.maxpool = nn.MaxPool2D(3, 2, 1)
+        stages = []
+        in_c = channels[0]
+        for i, reps in enumerate(stage_repeats):
+            out_c = channels[i + 1]
+            units = [_ShuffleUnit(in_c, out_c, 2, act)]
+            for _ in range(reps - 1):
+                units.append(_ShuffleUnit(out_c, out_c, 1, act))
+            stages.append(nn.Sequential(*units))
+            in_c = out_c
+        self.stages = nn.Sequential(*stages)
+        self.conv_last = nn.Sequential(
+            nn.Conv2D(in_c, channels[-1], 1, bias_attr=False),
+            nn.BatchNorm2D(channels[-1]), act_layer())
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.fc = nn.Linear(channels[-1], num_classes)
+
+    def forward(self, x):
+        x = self.maxpool(self.conv1(x))
+        x = self.stages(x)
+        x = self.conv_last(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            from ... import tensor as T
+
+            x = self.fc(T.flatten(x, 1))
+        return x
+
+
+def _shufflenet(scale, act="relu", pretrained=False, **kwargs):
+    if pretrained:
+        raise NotImplementedError("pretrained weights unavailable offline")
+    return ShuffleNetV2(scale=scale, act=act, **kwargs)
+
+
+def shufflenet_v2_x0_25(pretrained=False, **kwargs):
+    return _shufflenet(0.25, pretrained=pretrained, **kwargs)
+
+
+def shufflenet_v2_x0_33(pretrained=False, **kwargs):
+    return _shufflenet(0.33, pretrained=pretrained, **kwargs)
+
+
+def shufflenet_v2_x0_5(pretrained=False, **kwargs):
+    return _shufflenet(0.5, pretrained=pretrained, **kwargs)
+
+
+def shufflenet_v2_x1_0(pretrained=False, **kwargs):
+    return _shufflenet(1.0, pretrained=pretrained, **kwargs)
+
+
+def shufflenet_v2_x1_5(pretrained=False, **kwargs):
+    return _shufflenet(1.5, pretrained=pretrained, **kwargs)
+
+
+def shufflenet_v2_x2_0(pretrained=False, **kwargs):
+    return _shufflenet(2.0, pretrained=pretrained, **kwargs)
+
+
+def shufflenet_v2_swish(pretrained=False, **kwargs):
+    return _shufflenet(1.0, act="swish", pretrained=pretrained, **kwargs)

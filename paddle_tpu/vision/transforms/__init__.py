@@ -68,12 +68,15 @@ class Normalize(BaseTransform):
     def __init__(self, mean=0.0, std=1.0, data_format="CHW", to_rgb=False,
                  keys=None):
         super().__init__(keys)
-        self.mean = np.asarray(mean, np.float32).reshape(-1, 1, 1)
-        self.std = np.asarray(std, np.float32).reshape(-1, 1, 1)
         self.data_format = data_format
+        shape = (-1, 1, 1) if data_format == "CHW" else (1, 1, -1)
+        self.mean = np.asarray(mean, np.float32).reshape(shape)
+        self.std = np.asarray(std, np.float32).reshape(shape)
 
     def _apply_image(self, img):
-        img = _chw(img)
+        img = np.asarray(img, np.float32)
+        if self.data_format == "CHW":
+            img = _chw(img)
         return (img - self.mean) / self.std
 
 

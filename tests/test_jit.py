@@ -1,0 +1,82 @@
+"""paddle.jit tests (reference: unittests test_jit_save_load.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+def test_to_static_layer_parity():
+    net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    net.eval()
+    x = paddle.randn([3, 4])
+    eager = net(x).numpy()
+    st = paddle.jit.to_static(net)
+    np.testing.assert_allclose(st(x).numpy(), eager, rtol=1e-5, atol=1e-6)
+    # second call hits the jit cache
+    np.testing.assert_allclose(st(x).numpy(), eager, rtol=1e-5, atol=1e-6)
+
+
+def test_to_static_function_decorator():
+    @paddle.jit.to_static
+    def f(a, b):
+        return a * b + 1
+
+    out = f(paddle.ones([2, 2]), paddle.full([2, 2], 3.0))
+    np.testing.assert_allclose(out.numpy(), np.full((2, 2), 4.0))
+
+
+def test_to_static_respects_training_mode():
+    net = nn.Sequential(nn.Linear(4, 4), nn.Dropout(0.5))
+    st = paddle.jit.to_static(net)
+    x = paddle.ones([8, 4])
+    net.eval()
+    o1 = st(x).numpy()
+    o2 = st(x).numpy()
+    np.testing.assert_allclose(o1, o2)
+    net.train()
+    o3 = st(x).numpy()
+    assert (o3 == 0).any()  # dropout active
+
+
+def test_jit_save_load_roundtrip(tmp_path):
+    paddle.seed(5)
+    net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    net.eval()
+    path = str(tmp_path / "m")
+    paddle.jit.save(net, path,
+                    input_spec=[paddle.InputSpec([-1, 4], "float32")])
+    loaded = paddle.jit.load(path)
+    for bs in (1, 6):
+        x = paddle.randn([bs, 4])
+        np.testing.assert_allclose(loaded(x).numpy(), net(x).numpy(),
+                                   rtol=1e-5, atol=1e-6)
+    with pytest.raises(RuntimeError):
+        loaded.train()
+
+
+def test_jit_save_load_bn_uses_eval_stats(tmp_path):
+    net = nn.Sequential(nn.Linear(4, 4), nn.BatchNorm1D(4))
+    net.train()
+    for _ in range(3):
+        net(paddle.randn([16, 4]))  # accumulate running stats
+    net.eval()
+    x = paddle.randn([5, 4])
+    path = str(tmp_path / "bn")
+    paddle.jit.save(net, path,
+                    input_spec=[paddle.InputSpec([-1, 4], "float32")])
+    loaded = paddle.jit.load(path)
+    np.testing.assert_allclose(loaded(x).numpy(), net(x).numpy(), rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_enable_to_static_toggle():
+    net = nn.Linear(2, 2)
+    st = paddle.jit.to_static(net)
+    x = paddle.randn([1, 2])
+    paddle.jit.enable_to_static(False)
+    try:
+        out = st(x)  # falls through to eager
+        assert out.shape == [1, 2]
+    finally:
+        paddle.jit.enable_to_static(True)

@@ -1,0 +1,126 @@
+"""Static graph tests (reference: unittests test_executor_*, program tests)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+@pytest.fixture(autouse=True)
+def _dynamic_after():
+    yield
+    paddle.disable_static()
+
+
+def _build_regression():
+    main = paddle.static.Program()
+    startup = paddle.static.Program()
+    with paddle.static.program_guard(main, startup):
+        x = paddle.static.data("x", [-1, 4], "float32")
+        y = paddle.static.data("y", [-1, 1], "float32")
+        lin1 = nn.Linear(4, 8)
+        lin2 = nn.Linear(8, 1)
+        pred = lin2(nn.functional.relu(lin1(x)))
+        loss = nn.functional.mse_loss(pred, y)
+    return main, x, y, pred, loss
+
+
+def test_program_capture_and_infer_run():
+    paddle.enable_static()
+    main, x, y, pred, loss = _build_regression()
+    assert main.num_ops() > 0
+    assert len(main.all_parameters()) == 4
+    exe = paddle.static.Executor()
+    xs = np.random.rand(16, 4).astype(np.float32)
+    ys = np.random.rand(16, 1).astype(np.float32)
+    pv, lv = exe.run(main, feed={"x": xs, "y": ys}, fetch_list=[pred, loss])
+    assert pv.shape == (16, 1)
+    assert lv.shape == ()
+
+
+def test_static_training_converges():
+    paddle.enable_static()
+    paddle.seed(7)
+    main = paddle.static.Program()
+    with paddle.static.program_guard(main):
+        x = paddle.static.data("x", [-1, 4], "float32")
+        y = paddle.static.data("y", [-1, 1], "float32")
+        pred = nn.Linear(4, 1)(x)
+        loss = nn.functional.mse_loss(pred, y)
+        paddle.optimizer.Adam(0.05).minimize(loss)
+    exe = paddle.static.Executor()
+    xs = np.random.rand(64, 4).astype(np.float32)
+    w = np.random.rand(4, 1).astype(np.float32)
+    ys = xs @ w
+    first = None
+    for i in range(150):
+        (lv,) = exe.run(main, feed={"x": xs, "y": ys}, fetch_list=[loss])
+        first = first if first is not None else lv
+    assert lv < first * 0.05
+    assert lv < 1e-2
+
+
+def test_dygraph_static_parity():
+    """Same model, same weights: static Executor must match eager forward."""
+    paddle.seed(3)
+    w = np.random.rand(4, 3).astype(np.float32)
+    b = np.random.rand(3).astype(np.float32)
+    xs = np.random.rand(8, 4).astype(np.float32)
+
+    lin_dy = nn.Linear(4, 3)
+    lin_dy.weight.set_value(w)
+    lin_dy.bias.set_value(b)
+    eager_out = nn.functional.softmax(lin_dy(paddle.to_tensor(xs))).numpy()
+
+    paddle.enable_static()
+    main = paddle.static.Program()
+    with paddle.static.program_guard(main):
+        x = paddle.static.data("x", [-1, 4], "float32")
+        lin_st = nn.Linear(4, 3)
+        lin_st.weight.set_value(w)
+        lin_st.bias.set_value(b)
+        out = nn.functional.softmax(lin_st(x))
+    exe = paddle.static.Executor()
+    (static_out,) = exe.run(main, feed={"x": xs}, fetch_list=[out])
+    np.testing.assert_allclose(eager_out, static_out, rtol=1e-5, atol=1e-6)
+
+
+def test_static_batch_size_respecialization():
+    paddle.enable_static()
+    main = paddle.static.Program()
+    with paddle.static.program_guard(main):
+        x = paddle.static.data("x", [-1, 2], "float32")
+        out = nn.Linear(2, 2)(x)
+    exe = paddle.static.Executor()
+    for bs in (4, 9, 1):
+        (ov,) = exe.run(main, feed={"x": np.zeros((bs, 2), np.float32)},
+                        fetch_list=[out])
+        assert ov.shape == (bs, 2)
+
+
+def test_static_nn_fc():
+    paddle.enable_static()
+    main = paddle.static.Program()
+    with paddle.static.program_guard(main):
+        x = paddle.static.data("x", [-1, 6], "float32")
+        out = paddle.static.nn.fc(x, 4, activation="relu")
+    exe = paddle.static.Executor()
+    (ov,) = exe.run(main, feed={"x": np.random.rand(3, 6).astype(np.float32)},
+                    fetch_list=[out])
+    assert ov.shape == (3, 4)
+    assert (ov >= 0).all()
+
+
+def test_static_save_load(tmp_path):
+    paddle.enable_static()
+    main = paddle.static.Program()
+    with paddle.static.program_guard(main):
+        x = paddle.static.data("x", [-1, 3], "float32")
+        out = nn.Linear(3, 2)(x)
+    p = main.all_parameters()[0]
+    orig = np.asarray(p._value).copy()
+    path = str(tmp_path / "st")
+    paddle.static.save(main, path)
+    p.set_value(np.zeros_like(orig))
+    paddle.static.load(main, path)
+    np.testing.assert_allclose(np.asarray(p._value), orig)

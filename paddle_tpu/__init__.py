@@ -55,6 +55,8 @@ from .hapi import Model, callbacks, summary  # noqa: F401
 from .framework.io import load, save  # noqa: F401
 from . import vision  # noqa: F401
 from . import text  # noqa: F401
+from . import models  # noqa: F401
+from . import ops  # noqa: F401
 from . import static  # noqa: F401
 from . import jit  # noqa: F401
 from . import device  # noqa: F401

@@ -1,0 +1,48 @@
+"""Sharding annotation helpers for model code.
+
+The megatron-style sharding recipe (SURVEY §3): weights/activations carry
+PartitionSpecs over the global Mesh; XLA GSPMD inserts the collectives.
+`annotate` is a no-op in eager mode or when no mesh is installed, so model
+code is written once and runs single-chip or multi-chip unchanged.
+"""
+from __future__ import annotations
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec
+
+from ..core.tensor import Tensor
+from .env import get_mesh
+
+__all__ = ["annotate", "PartitionSpec"]
+
+
+def annotate(x, *spec):
+    """Attach a sharding constraint over mesh axes (names not present on the
+    current mesh degrade to None => replicated along that dim)."""
+    mesh = get_mesh()
+    if mesh is None:
+        return x
+    names = mesh.axis_names
+    clean = []
+    for s in spec:
+        if s is None or s in names:
+            clean.append(s)
+        elif isinstance(s, (tuple, list)):
+            keep = tuple(a for a in s if a in names)
+            clean.append(keep if keep else None)
+        else:
+            clean.append(None)
+    p = PartitionSpec(*clean)
+
+    def _c(v):
+        if isinstance(v, jax.core.Tracer):
+            return jax.lax.with_sharding_constraint(v, NamedSharding(mesh, p))
+        return v
+
+    if isinstance(x, Tensor):
+        from ..core.autograd import apply
+
+        if isinstance(x._value, jax.core.Tracer):
+            return apply(_c, x)
+        return x
+    return _c(x)

@@ -1,0 +1,9 @@
+"""Flagship transformer model families (reference: fleet GPT/BERT patterns)."""
+from .bert import (  # noqa: F401
+    BertConfig, BertForMaskedLM, BertForPretraining,
+    BertForSequenceClassification, BertModel, bert_base, bert_large,
+)
+from .gpt import (  # noqa: F401
+    GPTConfig, GPTForCausalLM, GPTModel, gpt2_345m, gpt2_large, gpt2_medium,
+    gpt2_small,
+)

@@ -1,0 +1,236 @@
+"""BERT model family — flagship encoder LM.
+
+Reference capability: the BERT used by the reference ecosystem (PaddleNLP
+pattern; fleet unit tests): post-LN transformer encoder, MLM + NSP heads.
+
+TPU-native: flash attention when no padding mask is supplied; megatron
+sharding annotations on qkv/ffn; bf16-friendly.
+"""
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+
+from .. import nn
+from ..distributed.shard_utils import annotate
+from ..nn.functional.attention import _attention_core
+
+__all__ = ["BertConfig", "BertModel", "BertForPretraining",
+           "BertForMaskedLM", "BertForSequenceClassification", "bert_base",
+           "bert_large"]
+
+
+def _attr(init):
+    from ..framework.param_attr import ParamAttr
+
+    return ParamAttr(initializer=init)
+
+
+class BertConfig:
+    def __init__(self, vocab_size=30522, hidden_size=768, num_layers=12,
+                 num_heads=12, intermediate_size=3072, max_position=512,
+                 type_vocab_size=2, dropout=0.1, attention_dropout=0.1,
+                 layer_norm_eps=1e-12, initializer_range=0.02,
+                 pad_token_id=0):
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.num_heads = num_heads
+        self.intermediate_size = intermediate_size
+        self.max_position = max_position
+        self.type_vocab_size = type_vocab_size
+        self.dropout = dropout
+        self.attention_dropout = attention_dropout
+        self.layer_norm_eps = layer_norm_eps
+        self.initializer_range = initializer_range
+        self.pad_token_id = pad_token_id
+
+
+class BertEmbeddings(nn.Layer):
+    def __init__(self, c):
+        super().__init__()
+        init = nn.initializer.Normal(0.0, c.initializer_range)
+        self.word_embeddings = nn.Embedding(c.vocab_size, c.hidden_size,
+                                            weight_attr=_attr(init))
+        self.position_embeddings = nn.Embedding(c.max_position, c.hidden_size,
+                                                weight_attr=_attr(init))
+        self.token_type_embeddings = nn.Embedding(c.type_vocab_size,
+                                                  c.hidden_size,
+                                                  weight_attr=_attr(init))
+        self.layer_norm = nn.LayerNorm(c.hidden_size, c.layer_norm_eps)
+        self.dropout = nn.Dropout(c.dropout)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None):
+        from .. import tensor as T
+
+        b, s = input_ids.shape
+        if position_ids is None:
+            position_ids = T.expand(
+                T.unsqueeze(T.arange(s, dtype="int64"), 0), [b, s])
+        if token_type_ids is None:
+            token_type_ids = T.zeros([b, s], "int64")
+        x = (self.word_embeddings(input_ids)
+             + self.position_embeddings(position_ids)
+             + self.token_type_embeddings(token_type_ids))
+        return self.dropout(self.layer_norm(x))
+
+
+class BertSelfAttention(nn.Layer):
+    def __init__(self, c):
+        super().__init__()
+        init = nn.initializer.Normal(0.0, c.initializer_range)
+        h = c.hidden_size
+        self.num_heads = c.num_heads
+        self.head_dim = h // c.num_heads
+        self.qkv_proj = nn.Linear(h, 3 * h, weight_attr=_attr(init))
+        self.out_proj = nn.Linear(h, h, weight_attr=_attr(init))
+        self.attention_dropout = c.attention_dropout
+        self.dropout = nn.Dropout(c.dropout)
+        self.layer_norm = nn.LayerNorm(h, c.layer_norm_eps)
+
+    def forward(self, x, attn_mask=None):
+        from .. import tensor as T
+
+        b, s, h = x.shape
+        qkv = self.qkv_proj(x)
+        qkv = annotate(qkv, "dp", None, "tp")
+        qkv = T.reshape(qkv, [b, s, 3, self.num_heads, self.head_dim])
+        qkv = T.transpose(qkv, [2, 0, 3, 1, 4])
+        q, k, v = T.unbind(qkv, 0)
+        drop = self.attention_dropout if self.training else 0.0
+        out, _ = _attention_core(q, k, v, attn_mask, drop,
+                                 training=self.training)
+        out = T.reshape(T.transpose(out, [0, 2, 1, 3]), [b, s, h])
+        out = self.out_proj(out)
+        out = annotate(out, "dp", None, None)
+        # post-LN (reference bert layout)
+        return self.layer_norm(x + self.dropout(out))
+
+
+class BertLayer(nn.Layer):
+    def __init__(self, c):
+        super().__init__()
+        init = nn.initializer.Normal(0.0, c.initializer_range)
+        self.attention = BertSelfAttention(c)
+        self.fc_in = nn.Linear(c.hidden_size, c.intermediate_size,
+                               weight_attr=_attr(init))
+        self.fc_out = nn.Linear(c.intermediate_size, c.hidden_size,
+                                weight_attr=_attr(init))
+        self.dropout = nn.Dropout(c.dropout)
+        self.layer_norm = nn.LayerNorm(c.hidden_size, c.layer_norm_eps)
+
+    def forward(self, x, attn_mask=None):
+        x = self.attention(x, attn_mask)
+        h = self.fc_in(x)
+        h = annotate(h, "dp", None, "tp")
+        h = nn.functional.gelu(h)
+        h = self.fc_out(h)
+        return self.layer_norm(x + self.dropout(h))
+
+
+class BertModel(nn.Layer):
+    def __init__(self, config=None, **kwargs):
+        super().__init__()
+        self.config = config or BertConfig(**kwargs)
+        c = self.config
+        self.embeddings = BertEmbeddings(c)
+        self.encoder = nn.LayerList([BertLayer(c) for _ in range(c.num_layers)])
+        init = nn.initializer.Normal(0.0, c.initializer_range)
+        self.pooler = nn.Linear(c.hidden_size, c.hidden_size,
+                                weight_attr=_attr(init))
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attention_mask=None):
+        from .. import tensor as T
+
+        if attention_mask is not None and attention_mask.ndim == 2:
+            # [b, s] 1/0 mask -> additive [b, 1, 1, s]
+            am = T.unsqueeze(attention_mask, [1, 2])
+            attention_mask = (1.0 - T.cast(am, "float32")) * -1e30
+        x = self.embeddings(input_ids, token_type_ids, position_ids)
+        x = annotate(x, "dp", None, None)
+        for layer in self.encoder:
+            x = layer(x, attention_mask)
+        pooled = nn.functional.tanh(self.pooler(x[:, 0]))
+        return x, pooled
+
+
+class BertForPretraining(nn.Layer):
+    """MLM + NSP heads (reference: BertForPretraining)."""
+
+    def __init__(self, config=None, **kwargs):
+        super().__init__()
+        self.bert = BertModel(config, **kwargs)
+        c = self.bert.config
+        init = nn.initializer.Normal(0.0, c.initializer_range)
+        self.transform = nn.Linear(c.hidden_size, c.hidden_size,
+                                   weight_attr=_attr(init))
+        self.transform_ln = nn.LayerNorm(c.hidden_size, c.layer_norm_eps)
+        self.decoder_bias = self.create_parameter(
+            [c.vocab_size], is_bias=True)
+        self.seq_relationship = nn.Linear(c.hidden_size, 2,
+                                          weight_attr=_attr(init))
+
+    @property
+    def config(self):
+        return self.bert.config
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None,
+                masked_lm_labels=None, next_sentence_labels=None):
+        from .. import tensor as T
+
+        hidden, pooled = self.bert(input_ids, token_type_ids,
+                                   attention_mask=attention_mask)
+        h = self.transform_ln(nn.functional.gelu(self.transform(hidden)))
+        logits = T.matmul(h, self.bert.embeddings.word_embeddings.weight,
+                          transpose_y=True) + self.decoder_bias
+        nsp = self.seq_relationship(pooled)
+        if masked_lm_labels is not None:
+            mlm_loss = nn.functional.cross_entropy(
+                T.reshape(logits, [-1, logits.shape[-1]]),
+                T.reshape(masked_lm_labels, [-1]), ignore_index=-100)
+            loss = mlm_loss
+            if next_sentence_labels is not None:
+                loss = loss + nn.functional.cross_entropy(
+                    nsp, T.reshape(next_sentence_labels, [-1]))
+            return loss
+        return logits, nsp
+
+
+class BertForMaskedLM(BertForPretraining):
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None,
+                labels=None):
+        out = super().forward(input_ids, token_type_ids, attention_mask,
+                              masked_lm_labels=labels)
+        if labels is not None:
+            return out
+        return out[0]
+
+
+class BertForSequenceClassification(nn.Layer):
+    def __init__(self, config=None, num_classes=2, **kwargs):
+        super().__init__()
+        self.bert = BertModel(config, **kwargs)
+        c = self.bert.config
+        self.dropout = nn.Dropout(c.dropout)
+        self.classifier = nn.Linear(c.hidden_size, num_classes)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None,
+                labels=None):
+        _, pooled = self.bert(input_ids, token_type_ids,
+                              attention_mask=attention_mask)
+        logits = self.classifier(self.dropout(pooled))
+        if labels is not None:
+            return nn.functional.cross_entropy(logits, labels)
+        return logits
+
+
+def bert_base(**kw):
+    return BertConfig(hidden_size=768, num_layers=12, num_heads=12,
+                      intermediate_size=3072, **kw)
+
+
+def bert_large(**kw):
+    return BertConfig(hidden_size=1024, num_layers=24, num_heads=16,
+                      intermediate_size=4096, **kw)

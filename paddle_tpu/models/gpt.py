@@ -1,0 +1,256 @@
+"""GPT model family — flagship decoder LM.
+
+Reference capability: the fleet GPT used across the reference's hybrid-
+parallel unit tests (python/paddle/fluid/tests/unittests/collective/fleet
+gpt models + PaddleNLP GPT pattern): pre-LN transformer decoder, tied
+embeddings, fused qkv.
+
+TPU-native design: bf16-first weights option, Pallas flash attention
+(causal) on the hot path, megatron sharding annotations — qkv/ffn-in
+column-split on 'tp', proj/ffn-out row-split on 'tp', activations sharded
+['dp', 'sp', None] — so the same module is the single-chip model AND the
+tp/pp/dp-sharded model under a mesh.
+"""
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+
+from .. import nn
+from ..distributed.shard_utils import annotate
+from ..nn.functional.attention import _attention_core
+
+__all__ = ["GPTConfig", "GPTModel", "GPTForCausalLM", "gpt2_small",
+           "gpt2_medium", "gpt2_345m", "gpt2_large"]
+
+
+class GPTConfig:
+    def __init__(self, vocab_size=50304, hidden_size=768, num_layers=12,
+                 num_heads=12, intermediate_size=None, max_position=1024,
+                 dropout=0.1, layer_norm_eps=1e-5, initializer_range=0.02,
+                 use_flash=True):
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.num_heads = num_heads
+        self.intermediate_size = intermediate_size or 4 * hidden_size
+        self.max_position = max_position
+        self.dropout = dropout
+        self.layer_norm_eps = layer_norm_eps
+        self.initializer_range = initializer_range
+        self.use_flash = use_flash
+
+
+class GPTAttention(nn.Layer):
+    """Fused-QKV causal self-attention (column/row parallel layout)."""
+
+    def __init__(self, config):
+        super().__init__()
+        h = config.hidden_size
+        self.num_heads = config.num_heads
+        self.head_dim = h // config.num_heads
+        init = nn.initializer.Normal(0.0, config.initializer_range)
+        attr = lambda: None
+        self.qkv_proj = nn.Linear(
+            h, 3 * h, weight_attr=_attr(init), bias_attr=_attr(
+                nn.initializer.Constant(0.0)))
+        self.out_proj = nn.Linear(
+            h, h, weight_attr=_attr(init), bias_attr=_attr(
+                nn.initializer.Constant(0.0)))
+        self.dropout = config.dropout
+        self.use_flash = config.use_flash
+
+    def forward(self, x, cache=None):
+        from .. import tensor as T
+
+        b, s, h = x.shape
+        qkv = self.qkv_proj(x)                       # [b, s, 3h] (tp column)
+        qkv = annotate(qkv, "dp", None, "tp")
+        qkv = T.reshape(qkv, [b, s, 3, self.num_heads, self.head_dim])
+        qkv = T.transpose(qkv, [2, 0, 3, 1, 4])      # [3, b, nh, s, hd]
+        q, k, v = T.unbind(qkv, 0)
+        if cache is not None:
+            k = T.concat([cache[0], k], axis=2)
+            v = T.concat([cache[1], v], axis=2)
+            new_cache = (k, v)
+            causal = False  # single-token decode attends to full prefix
+        else:
+            new_cache = None
+            causal = True
+        drop = self.dropout if self.training else 0.0
+        out, _ = _attention_core(q, k, v, None, drop, is_causal=causal,
+                                 training=self.training)
+        out = T.reshape(T.transpose(out, [0, 2, 1, 3]), [b, s, h])
+        out = self.out_proj(out)                     # tp row -> psum by XLA
+        out = annotate(out, "dp", None, None)
+        return (out, new_cache) if cache is not None else out
+
+
+def _attr(init):
+    from ..framework.param_attr import ParamAttr
+
+    return ParamAttr(initializer=init)
+
+
+class GPTMLP(nn.Layer):
+    def __init__(self, config):
+        super().__init__()
+        init = nn.initializer.Normal(0.0, config.initializer_range)
+        self.fc_in = nn.Linear(config.hidden_size, config.intermediate_size,
+                               weight_attr=_attr(init),
+                               bias_attr=_attr(nn.initializer.Constant(0.0)))
+        self.fc_out = nn.Linear(config.intermediate_size, config.hidden_size,
+                                weight_attr=_attr(init),
+                                bias_attr=_attr(nn.initializer.Constant(0.0)))
+        self.dropout = nn.Dropout(config.dropout)
+
+    def forward(self, x):
+        h = self.fc_in(x)                            # tp column
+        h = annotate(h, "dp", None, "tp")
+        h = nn.functional.gelu(h, approximate=True)
+        h = self.fc_out(h)                           # tp row
+        return self.dropout(h)
+
+
+class GPTBlock(nn.Layer):
+    """Pre-LN decoder block."""
+
+    def __init__(self, config):
+        super().__init__()
+        self.ln_1 = nn.LayerNorm(config.hidden_size, config.layer_norm_eps)
+        self.attn = GPTAttention(config)
+        self.ln_2 = nn.LayerNorm(config.hidden_size, config.layer_norm_eps)
+        self.mlp = GPTMLP(config)
+        self.dropout = nn.Dropout(config.dropout)
+
+    def forward(self, x, cache=None):
+        if cache is not None:
+            a, new_cache = self.attn(self.ln_1(x), cache)
+            x = x + self.dropout(a)
+            x = x + self.mlp(self.ln_2(x))
+            return x, new_cache
+        x = x + self.dropout(self.attn(self.ln_1(x)))
+        x = x + self.mlp(self.ln_2(x))
+        return x
+
+
+class GPTModel(nn.Layer):
+    def __init__(self, config=None, **kwargs):
+        super().__init__()
+        self.config = config or GPTConfig(**kwargs)
+        c = self.config
+        init = nn.initializer.Normal(0.0, c.initializer_range)
+        self.wte = nn.Embedding(c.vocab_size, c.hidden_size,
+                                weight_attr=_attr(init))
+        self.wpe = nn.Embedding(c.max_position, c.hidden_size,
+                                weight_attr=_attr(init))
+        self.drop = nn.Dropout(c.dropout)
+        self.h = nn.LayerList([GPTBlock(c) for _ in range(c.num_layers)])
+        self.ln_f = nn.LayerNorm(c.hidden_size, c.layer_norm_eps)
+
+    def forward(self, input_ids, position_ids=None, caches=None):
+        from .. import tensor as T
+
+        b, s = input_ids.shape
+        past = 0
+        if caches is not None and caches[0] is not None:
+            past = caches[0][0].shape[2]
+        if position_ids is None:
+            position_ids = T.expand(
+                T.unsqueeze(T.arange(past, past + s, dtype="int64"), 0),
+                [b, s])
+        x = self.wte(input_ids) + self.wpe(position_ids)
+        x = annotate(x, "dp", None, None)
+        x = self.drop(x)
+        new_caches = [] if caches is not None else None
+        for i, block in enumerate(self.h):
+            if caches is not None:
+                x, nc = block(x, caches[i] if caches[i] is not None
+                              else _empty_cache(x, self.config))
+                new_caches.append(nc)
+            else:
+                x = block(x)
+        x = self.ln_f(x)
+        return (x, new_caches) if caches is not None else x
+
+
+def _empty_cache(x, c):
+    from .. import tensor as T
+
+    b = x.shape[0]
+    hd = c.hidden_size // c.num_heads
+    z = T.zeros([b, c.num_heads, 0, hd], x.dtype.name)
+    return (z, z)
+
+
+class GPTForCausalLM(nn.Layer):
+    """LM head tied to wte (reference: GPTForPretraining)."""
+
+    def __init__(self, config=None, **kwargs):
+        super().__init__()
+        self.gpt = GPTModel(config, **kwargs)
+
+    @property
+    def config(self):
+        return self.gpt.config
+
+    def forward(self, input_ids, position_ids=None, labels=None):
+        from .. import tensor as T
+
+        hidden = self.gpt(input_ids, position_ids)
+        logits = T.matmul(hidden, self.gpt.wte.weight, transpose_y=True)
+        if labels is not None:
+            loss = nn.functional.cross_entropy(
+                T.reshape(logits, [-1, logits.shape[-1]]),
+                T.reshape(labels, [-1]))
+            return loss
+        return logits
+
+    def generate(self, input_ids, max_new_tokens=20, temperature=1.0,
+                 top_k=None):
+        """Greedy/top-k sampling with KV cache."""
+        from .. import tensor as T
+        from ..core.autograd import no_grad
+
+        with no_grad():
+            caches = [None] * len(self.gpt.h)
+            ids = input_ids
+            hidden, caches = self.gpt(ids, caches=caches)
+            for _ in range(max_new_tokens):
+                logits = T.matmul(hidden[:, -1:], self.gpt.wte.weight,
+                                  transpose_y=True)[:, 0]
+                if temperature != 1.0:
+                    logits = logits / temperature
+                if top_k:
+                    vals, _ = T.topk(logits, top_k)
+                    logits = T.where(logits < vals[:, -1:],
+                                     T.full_like(logits, -1e30), logits)
+                    probs = nn.functional.softmax(logits, -1)
+                    nxt = T.multinomial(probs, 1)
+                else:
+                    nxt = T.unsqueeze(T.argmax(logits, -1), -1)
+                ids = T.concat([ids, nxt], axis=1)
+                hidden, caches = self.gpt(nxt, caches=caches)
+            return ids
+
+
+def gpt2_small(**kw):
+    return GPTForCausalLM(GPTConfig(hidden_size=768, num_layers=12,
+                                    num_heads=12, **kw))
+
+
+def gpt2_medium(**kw):
+    return GPTForCausalLM(GPTConfig(hidden_size=1024, num_layers=24,
+                                    num_heads=16, **kw))
+
+
+def gpt2_345m(**kw):
+    """The reference fleet benchmark config (345M)."""
+    return GPTForCausalLM(GPTConfig(hidden_size=1024, num_layers=24,
+                                    num_heads=16, **kw))
+
+
+def gpt2_large(**kw):
+    return GPTForCausalLM(GPTConfig(hidden_size=1280, num_layers=36,
+                                    num_heads=20, **kw))

@@ -1,0 +1,3 @@
+"""Pallas TPU kernels (reference: handwritten CUDA kernels in
+phi/kernels/gpu + fluid/operators/fused)."""
+from . import flash_attention  # noqa: F401  (registers attention fast path)

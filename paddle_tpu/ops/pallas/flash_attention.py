@@ -1,0 +1,273 @@
+"""Flash attention for TPU (Pallas).
+
+Reference capability: the CUDA fused attention ops under
+paddle/fluid/operators/fused (fused_attention_op.cu, fmha) and incubate
+softmax_mask_fuse — rebuilt TPU-native: an online-softmax tiled kernel that
+keeps the (seq x seq) score matrix out of HBM, with a flash backward pass.
+
+Layout: [batch*heads, seq, head_dim]; fp32 accumulation on the MXU
+(preferred_element_type), bf16-friendly inputs. Causal masking skips whole
+k-blocks past the diagonal. Kernels trace under jax.enable_x64(False):
+the framework enables x64 globally for dtype parity, but Mosaic lowering
+wants i32 index arithmetic.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu  # noqa: F401
+
+__all__ = ["flash_attention", "flash_attention_raw"]
+
+DEFAULT_BLOCK_Q = 128
+DEFAULT_BLOCK_K = 128
+NEG_INF = -1e30
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_q, block_k,
+                seq_k, causal, sm_scale):
+    q = q_ref[0].astype(jnp.float32) * sm_scale          # (bq, d)
+    qi = pl.program_id(1)
+    m0 = jnp.full((block_q, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q, 1), jnp.float32)
+    acc0 = jnp.zeros(q.shape, jnp.float32)
+
+    if causal:
+        # process only blocks up to (and including) the diagonal
+        n_iter = (qi * block_q) // block_k + pl.cdiv(block_q, block_k)
+    else:
+        n_iter = seq_k // block_k
+
+    def body(j, carry):
+        m, l, acc = carry
+        k = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            rows = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            cols = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(rows >= cols, s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=1, keepdims=True)
+        acc_new = acc * alpha + jax.lax.dot(
+            p.astype(v.dtype), v, preferred_element_type=jnp.float32)
+        return m_new, l_new, acc_new
+
+    m, l, acc = jax.lax.fori_loop(0, n_iter, body, (m0, l0, acc0))
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    o_ref[0] = (acc / l_safe).astype(o_ref.dtype)
+    lse_ref[0, 0, pl.ds(qi * block_q, block_q)] = (m + jnp.log(l_safe))[:, 0]
+
+
+def _bwd_kv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                   dk_ref, dv_ref, *, block_q, block_k, seq_q, causal,
+                   sm_scale):
+    k = k_ref[0].astype(jnp.float32)                      # (bk, d)
+    v = v_ref[0].astype(jnp.float32)
+    ki = pl.program_id(1)
+    dk0 = jnp.zeros(k.shape, jnp.float32)
+    dv0 = jnp.zeros(v.shape, jnp.float32)
+
+    n_q = seq_q // block_q
+    start = (ki * block_k) // block_q if causal else 0
+
+    def body(j, carry):
+        dk, dv = carry
+        q = q_ref[0, pl.ds(j * block_q, block_q), :].astype(jnp.float32)
+        do = do_ref[0, pl.ds(j * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, 0, pl.ds(j * block_q, block_q)][:, None]
+        delta = delta_ref[0, 0, pl.ds(j * block_q, block_q)][:, None]
+        s = jax.lax.dot_general(q * sm_scale, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            rows = j * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            cols = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(rows >= cols, s, NEG_INF)
+        p = jnp.exp(s - lse)                               # (bq, bk)
+        dv = dv + jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
+                                      preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * sm_scale
+        dk = dk + jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
+                                      preferred_element_type=jnp.float32)
+        return dk, dv
+
+    dk, dv = jax.lax.fori_loop(start, n_q, body, (dk0, dv0))
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _bwd_q_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
+                  block_q, block_k, seq_k, causal, sm_scale):
+    q = q_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)
+    qi = pl.program_id(1)
+    lse = lse_ref[0, 0, pl.ds(qi * block_q, block_q)][:, None]
+    delta = delta_ref[0, 0, pl.ds(qi * block_q, block_q)][:, None]
+    dq0 = jnp.zeros(q.shape, jnp.float32)
+    if causal:
+        n_iter = (qi * block_q) // block_k + pl.cdiv(block_q, block_k)
+    else:
+        n_iter = seq_k // block_k
+
+    def body(j, carry):
+        dq = carry
+        k = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q * sm_scale, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            rows = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            cols = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(rows >= cols, s, NEG_INF)
+        p = jnp.exp(s - lse)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * sm_scale
+        dq = dq + jax.lax.dot(ds, k, preferred_element_type=jnp.float32)
+        return dq
+
+    dq = jax.lax.fori_loop(0, n_iter, body, dq0)
+    dq_ref[0] = dq.astype(dq_ref.dtype)
+
+
+def _fwd(q, k, v, causal, sm_scale, block_q, block_k):
+    bh, seq_q, d = q.shape
+    _, seq_k, _ = k.shape
+    with jax.enable_x64(False):
+        o, lse = pl.pallas_call(
+            functools.partial(_fwd_kernel, block_q=block_q, block_k=block_k,
+                              seq_k=seq_k, causal=causal, sm_scale=sm_scale),
+            grid=(bh, seq_q // block_q),
+            in_specs=[
+                pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+                pl.BlockSpec((1, seq_k, d), lambda b, i: (b, 0, 0)),
+                pl.BlockSpec((1, seq_k, d), lambda b, i: (b, 0, 0)),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+                pl.BlockSpec((1, 1, seq_q), lambda b, i: (b, 0, 0)),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct(q.shape, q.dtype),
+                jax.ShapeDtypeStruct((bh, 1, seq_q), jnp.float32),
+            ],
+        )(q, k, v)
+    return o, lse
+
+
+def _bwd(q, k, v, o, lse, do, causal, sm_scale, block_q, block_k):
+    bh, seq_q, d = q.shape
+    _, seq_k, _ = k.shape
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=-1)[:, None, :]
+    with jax.enable_x64(False):
+        dk, dv = pl.pallas_call(
+            functools.partial(_bwd_kv_kernel, block_q=block_q,
+                              block_k=block_k, seq_q=seq_q, causal=causal,
+                              sm_scale=sm_scale),
+            grid=(bh, seq_k // block_k),
+            in_specs=[
+                pl.BlockSpec((1, seq_q, d), lambda b, i: (b, 0, 0)),
+                pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0)),
+                pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0)),
+                pl.BlockSpec((1, seq_q, d), lambda b, i: (b, 0, 0)),
+                pl.BlockSpec((1, 1, seq_q), lambda b, i: (b, 0, 0)),
+                pl.BlockSpec((1, 1, seq_q), lambda b, i: (b, 0, 0)),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0)),
+                pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0)),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct(k.shape, k.dtype),
+                jax.ShapeDtypeStruct(v.shape, v.dtype),
+            ],
+        )(q, k, v, do, lse, delta)
+        dq = pl.pallas_call(
+            functools.partial(_bwd_q_kernel, block_q=block_q,
+                              block_k=block_k, seq_k=seq_k, causal=causal,
+                              sm_scale=sm_scale),
+            grid=(bh, seq_q // block_q),
+            in_specs=[
+                pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+                pl.BlockSpec((1, seq_k, d), lambda b, i: (b, 0, 0)),
+                pl.BlockSpec((1, seq_k, d), lambda b, i: (b, 0, 0)),
+                pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+                pl.BlockSpec((1, 1, seq_q), lambda b, i: (b, 0, 0)),
+                pl.BlockSpec((1, 1, seq_q), lambda b, i: (b, 0, 0)),
+            ],
+            out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        )(q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def flash_attention_raw(q, k, v, causal=False, sm_scale=None,
+                        block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K):
+    """q,k,v: [batch*heads, seq, head_dim] arrays."""
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    o, _ = _fwd(q, k, v, causal, sm_scale, block_q, block_k)
+    return o
+
+
+def _raw_fwd(q, k, v, causal, sm_scale, block_q, block_k):
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    o, lse = _fwd(q, k, v, causal, sm_scale, block_q, block_k)
+    return o, (q, k, v, o, lse)
+
+
+def _raw_bwd(causal, sm_scale, block_q, block_k, res, do):
+    q, k, v, o, lse = res
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    dq, dk, dv = _bwd(q, k, v, o, lse, do, causal, sm_scale, block_q, block_k)
+    return dq, dk, dv
+
+
+flash_attention_raw.defvjp(_raw_fwd, _raw_bwd)
+
+
+def flash_attention(q, k, v, causal=False, sm_scale=None):
+    """Paddle-facing entry: q,k,v Tensors [batch, heads, seq, head_dim]."""
+    from ...core.autograd import apply
+
+    def _f(qv, kv, vv):
+        b, h, s, d = qv.shape
+        sk = kv.shape[2]
+        out = flash_attention_raw(
+            qv.reshape(b * h, s, d), kv.reshape(b * h, sk, d),
+            vv.reshape(b * h, sk, d), causal, sm_scale)
+        return out.reshape(b, h, s, d)
+    _f.__name__ = "flash_attention"
+    return apply(_f, q, k, v)
+
+
+def _register():
+    """Install as the attention fast path (nn/functional/attention.py)."""
+    from ...nn.functional import attention as A
+
+    def dispatch(q, k, v, is_causal):
+        return flash_attention(q, k, v, causal=is_causal)
+
+    A._flash_attention_fn = dispatch
+
+
+_register()

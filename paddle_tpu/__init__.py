@@ -38,9 +38,11 @@ from .framework import (  # noqa: F401
     disable_static, enable_static, in_dynamic_mode, in_dygraph_mode, seed,
     get_rng_state, set_rng_state,
 )
+from .framework.debug import check_numerics, set_printoptions  # noqa: F401
 from .framework.random import get_cuda_rng_state, set_cuda_rng_state  # noqa: F401
 
 from . import fft  # noqa: F401
+from . import linalg  # noqa: F401
 from . import autograd  # noqa: F401
 from . import nn  # noqa: F401
 from . import optimizer  # noqa: F401
@@ -52,6 +54,7 @@ from . import io  # noqa: F401
 from . import metric  # noqa: F401
 from . import hapi  # noqa: F401
 from .hapi import Model, callbacks, summary  # noqa: F401
+from .hapi.flops import flops  # noqa: F401
 from .framework.io import load, save  # noqa: F401
 from . import vision  # noqa: F401
 from . import text  # noqa: F401

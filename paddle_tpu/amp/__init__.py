@@ -33,6 +33,8 @@ BLACK_LIST = {
     "log_softmax", "cross_entropy", "mean", "sum", "pow", "square",
     "reciprocal", "rsqrt", "norm", "cosh", "sinh",
 }
+# ops AMP must never touch: in-place value writes keep the target's dtype
+EXEMPT_LIST = {"set_value"}
 
 
 class _AmpState:
@@ -52,6 +54,8 @@ def _amp_active():
 
 def _amp_cast_args(fn_name, vals):
     """Called from core.autograd.apply: cast float32 arrays per AMP policy."""
+    if fn_name in EXEMPT_LIST:
+        return vals
     low = _state.dtype
     in_white = fn_name in WHITE_LIST or fn_name in _state.custom_white
     in_black = fn_name in BLACK_LIST or fn_name in _state.custom_black
@@ -101,16 +105,32 @@ def auto_cast(enable=True, custom_white_list=None, custom_black_list=None,
 amp_guard = auto_cast
 
 
+def _is_norm_layer(layer):
+    from ..nn.layer import norm as _norm
+
+    return isinstance(layer, (_norm._BatchNormBase, _norm.LayerNorm,
+                              _norm.GroupNorm, _norm._InstanceNormBase))
+
+
 def decorate(models, optimizers=None, level="O2", dtype="float16",
              master_weight=None, save_dtype=None):
-    """paddle.amp.decorate: cast model params to the AMP dtype (O2)."""
+    """paddle.amp.decorate: cast model params to the AMP dtype (O2).
+
+    Norm layers (BatchNorm*/SyncBatchNorm/LayerNorm/GroupNorm/InstanceNorm*)
+    and their buffers stay float32 — bf16 running-stat accumulation
+    (momentum ~0.9 of small deltas) loses precision; the reference's
+    amp_decorate keeps them f32 for the same reason.
+    """
     target = "bfloat16" if dtypes.convert_dtype(dtype) in (
         "float16", "bfloat16") else dtype
     single = not isinstance(models, (list, tuple))
     ms = [models] if single else list(models)
     if level == "O2":
+        jd = dtypes.to_jax_dtype(target)
         for mdl in ms:
-            mdl.astype(target)
+            for layer in mdl.sublayers(include_self=True):
+                if not _is_norm_layer(layer):
+                    layer._cast_to(jd, include_sublayers=False)
     if optimizers is None:
         return models
     return models, optimizers

@@ -44,6 +44,9 @@ _amp_hook = None
 # additionally records each op into the current Program
 _static_recorder = None
 
+# (name, out_leaves) hook installed by framework.debug enable_check_nan_inf
+_post_op_hook = None
+
 
 def is_grad_enabled():
     return _state.enabled
@@ -125,10 +128,15 @@ def apply(fn, *args, **kwargs):
 
     if not diff_pos:
         out = closed()
+        if _post_op_hook is not None:
+            _post_op_hook(getattr(fn, "__name__", "op"),
+                          jax.tree_util.tree_leaves(out))
         return jax.tree_util.tree_map(lambda leaf: Tensor(leaf), out)
 
     out, pullback = jax.vjp(closed, *[vals[i] for i in diff_pos])
     out_leaves, out_treedef = jax.tree_util.tree_flatten(out)
+    if _post_op_hook is not None:
+        _post_op_hook(getattr(fn, "__name__", "op"), out_leaves)
     structs = [jax.ShapeDtypeStruct(o.shape, o.dtype) for o in out_leaves]
     node = GradNode(pullback, closed, [flat[i] for i in diff_pos], out_treedef,
                     structs, getattr(fn, "__name__", "op"))

@@ -168,6 +168,14 @@ class Tensor:
         run_backward([self], [grad_tensor], retain_graph=retain_graph)
 
     def detach(self):
+        """New Tensor sharing this tensor's buffer, outside the grad graph.
+
+        Donation caveat: the fused hapi/optimizer steps donate parameter
+        buffers to XLA (jit donate_argnums), which invalidates the donated
+        jax.Array after the step. A detached alias of a *parameter* taken
+        before such a step must be materialized (`.numpy()` / `.clone()`)
+        if it needs to outlive the step.
+        """
         t = Tensor(self._value, stop_gradient=True, name=self.name)
         return t
 
@@ -249,6 +257,31 @@ class Tensor:
 
     def __setitem__(self, idx, v):
         idx = _unwrap_index(idx)
+        if self._node is not None:
+            # This tensor was produced by a tracked op: record the scatter on
+            # the tape (reference set_value semantics) so later backward sees
+            # the post-assignment value, then rebind self to the new node.
+            from .autograd import apply, is_grad_enabled
+
+            if is_grad_enabled():
+                vt = v if isinstance(v, Tensor) else Tensor(jnp.asarray(v))
+                # snapshot the pre-assignment tensor (still pointing at the
+                # producing node) so the recorded scatter consumes it rather
+                # than the rebound self
+                prev = Tensor(self._value, stop_gradient=self.stop_gradient)
+                prev._node, prev._out_idx = self._node, self._out_idx
+
+                def _set(x, val):
+                    return x.at[idx].set(val.astype(x.dtype))
+                _set.__name__ = "set_value"
+                out = apply(_set, prev, vt)
+                self._value = out._value
+                self._node, self._out_idx = out._node, out._out_idx
+                return
+            # grad disabled: the recorded producer no longer describes this
+            # value — detach rather than leave a stale node that would
+            # backprop the pre-assignment slice
+            self._node = None
         if isinstance(v, Tensor):
             v = v._value
         self._value = self._value.at[idx].set(v)

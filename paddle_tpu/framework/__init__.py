@@ -7,3 +7,7 @@ from .mode import (  # noqa: F401
     in_static_mode,
 )
 from .random import seed, get_rng_state, set_rng_state  # noqa: F401
+from .debug import (  # noqa: F401
+    check_numerics, disable_check_nan_inf, enable_check_nan_inf,
+    set_printoptions,
+)

@@ -1,0 +1,118 @@
+"""Debug utilities (reference: python/paddle/fluid/framework.py
+set_printoptions + the FLAGS_check_nan_inf nan/inf checker in
+paddle/fluid/framework/details/nan_inf_utils).
+
+TPU-native: printoptions map onto numpy's (Tensor.__repr__ renders via
+numpy). nan/inf checking is an *eager-path* tool: enable_check_nan_inf
+checks every concrete op output, and check_numerics checks concrete
+tensors immediately. Inside jitted programs values are abstract Tracers,
+so per-op checking cannot run there — check fetched step outputs (loss)
+instead, which the GradScaler inf-skip path already does on the blessed
+training loop.
+"""
+from __future__ import annotations
+
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["set_printoptions", "check_numerics", "enable_check_nan_inf",
+           "disable_check_nan_inf"]
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    """paddle.set_printoptions — controls Tensor repr formatting (Tensor
+    repr renders through numpy, so these map onto numpy's printoptions)."""
+    kw = {}
+    if precision is not None:
+        kw["precision"] = int(precision)
+    if threshold is not None:
+        kw["threshold"] = int(threshold)
+    if edgeitems is not None:
+        kw["edgeitems"] = int(edgeitems)
+    if linewidth is not None:
+        kw["linewidth"] = int(linewidth)
+    if sci_mode is not None:
+        kw["suppress"] = not sci_mode
+    np.set_printoptions(**kw)
+
+
+_warned_no_callback = False
+
+
+def check_numerics(x, message="", name=None):
+    """Raise when x contains nan/inf.
+
+    Eager tensors are checked immediately. Inside a trace, the check lowers
+    to a host callback where the platform supports host send/recv (CPU); on
+    platforms without host callbacks (the axon TPU plugin) the traced check
+    is a documented no-op — check eagerly, or on fetched outputs, there.
+    """
+    from ..core.tensor import Tensor
+
+    v = x._value if isinstance(x, Tensor) else x
+    if not jnp.issubdtype(v.dtype, jnp.inexact):
+        return x
+    if isinstance(v, jax.core.Tracer):
+        if jax.default_backend() == "cpu":
+            bad = jnp.logical_not(jnp.all(jnp.isfinite(v)))
+            jax.debug.callback(_raise_if, bad, message or "check_numerics")
+        else:
+            global _warned_no_callback
+            if not _warned_no_callback:
+                _warned_no_callback = True
+                warnings.warn(
+                    "check_numerics inside jit is a no-op on this backend "
+                    "(no host-callback support); check eagerly instead")
+        return x
+    if not bool(jnp.all(jnp.isfinite(v))):
+        n_nan = int(jnp.sum(jnp.isnan(v)))
+        n_inf = int(jnp.sum(jnp.isinf(v)))
+        raise FloatingPointError(
+            f"check_numerics failed{': ' + message if message else ''} "
+            f"({n_nan} nan, {n_inf} inf in tensor of shape {tuple(v.shape)})")
+    return x
+
+
+def _raise_if(bad, message):
+    if bool(bad):
+        raise FloatingPointError(f"check_numerics failed: {message}")
+
+
+_nan_inf_enabled = False
+
+
+def enable_check_nan_inf():
+    """FLAGS_check_nan_inf equivalent: every *eager* op output is checked.
+
+    Ops running inside a jit trace produce abstract Tracers and are skipped
+    — check the step's fetched outputs there instead.
+    """
+    global _nan_inf_enabled
+    from ..core import autograd as _ag
+
+    _nan_inf_enabled = True
+    if getattr(_ag, "_post_op_hook", None) is None:
+        _ag._post_op_hook = _check_hook
+
+
+def disable_check_nan_inf():
+    global _nan_inf_enabled
+    from ..core import autograd as _ag
+
+    _nan_inf_enabled = False
+    _ag._post_op_hook = None
+
+
+def _check_hook(name, out_vals):
+    if not _nan_inf_enabled:
+        return
+    for v in out_vals:
+        if hasattr(v, "dtype") and jnp.issubdtype(v.dtype, jnp.inexact) \
+                and not isinstance(v, jax.core.Tracer):
+            if not bool(jnp.all(jnp.isfinite(v))):
+                raise FloatingPointError(
+                    f"nan/inf detected in output of op '{name}'")

@@ -1,7 +1,9 @@
 """paddle.save / paddle.load (reference: python/paddle/framework/io.py).
 
-State dicts are pickled with numpy payloads (portable, mmap-friendly);
-Tensors rehydrate onto the default device lazily at first use.
+Tensors are pickled as plain numpy ndarrays — the reference's
+_build_saved_state_dict format — so checkpoints interchange with the
+reference framework in both directions. On load, ndarray payloads rehydrate
+to Tensors unless return_numpy=True.
 """
 from __future__ import annotations
 
@@ -17,7 +19,7 @@ __all__ = ["save", "load"]
 
 def _to_storable(obj):
     if isinstance(obj, Tensor):
-        return _TensorPayload(np.asarray(obj._value), obj.stop_gradient)
+        return np.asarray(obj._value)
     if isinstance(obj, dict):
         return {k: _to_storable(v) for k, v in obj.items()}
     if isinstance(obj, (list, tuple)):
@@ -27,6 +29,8 @@ def _to_storable(obj):
 
 
 class _TensorPayload:
+    """Round-1 payload class, kept so old checkpoints still unpickle."""
+
     __slots__ = ("array", "stop_gradient")
 
     def __init__(self, array, stop_gradient):
@@ -38,6 +42,8 @@ def _from_storable(obj, return_numpy=False):
     if isinstance(obj, _TensorPayload):
         return obj.array if return_numpy else Tensor(
             obj.array, stop_gradient=obj.stop_gradient)
+    if isinstance(obj, np.ndarray):
+        return obj if return_numpy else Tensor(obj)
     if isinstance(obj, dict):
         return {k: _from_storable(v, return_numpy) for k, v in obj.items()}
     if isinstance(obj, (list, tuple)):
@@ -47,6 +53,12 @@ def _from_storable(obj, return_numpy=False):
 
 
 def save(obj, path, protocol=4, **configs):
+    """Pickle `obj` with Tensors lowered to numpy ndarrays.
+
+    Like the reference format, trainability flags are not serialized:
+    tensors load back with default stop_gradient=True, and state dicts get
+    their flags from the receiving layer's set_state_dict.
+    """
     d = os.path.dirname(path)
     if d:
         os.makedirs(d, exist_ok=True)

@@ -6,3 +6,4 @@ from .callbacks import (  # noqa: F401
 )
 from .model import Model  # noqa: F401
 from .summary import summary  # noqa: F401
+from .flops import flops  # noqa: F401

@@ -1,0 +1,170 @@
+"""paddle.flops — per-layer FLOP counting.
+
+Reference: python/paddle/hapi/dynamic_flops.py:25 (flops/dynamic_flops) —
+same counting formulas (convNd = out_numel * (Cin/groups * prod(k) + bias),
+linear = in_features * out_numel, eval-mode BN = 2 * numel, …) driven by
+forward-post hooks over leaf layers. An XLA-precise alternative is exposed
+as `hlo_flops` (compiled-program cost analysis), which the reference has no
+equivalent of.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["flops", "hlo_flops"]
+
+
+def _numel(shape):
+    n = 1
+    for s in shape:
+        n *= int(s)
+    return n
+
+
+def _count_convnd(m, x, y):
+    kernel_ops = _numel(m.weight.shape[2:])
+    bias_ops = 1 if getattr(m, "bias", None) is not None else 0
+    in_ch = x[0].shape[1]
+    groups = getattr(m, "_groups", 1)
+    return _numel(y.shape) * (in_ch / groups * kernel_ops + bias_ops)
+
+
+def _count_linear(m, x, y):
+    return m.weight.shape[0] * _numel(y.shape)
+
+
+def _count_bn(m, x, y):
+    return 0 if m.training else 2 * _numel(x[0].shape)
+
+
+def _count_leaky_relu(m, x, y):
+    return _numel(x[0].shape)
+
+
+def _count_avgpool(m, x, y):
+    return _numel(y.shape)
+
+
+def _count_adap_avgpool(m, x, y):
+    kernel = np.array(x[0].shape[2:]) // np.array(y.shape[2:])
+    return (int(np.prod(kernel)) + 1) * _numel(y.shape)
+
+
+def _count_zero(m, x, y):
+    return 0
+
+
+def _register_hooks():
+    from .. import nn
+
+    return {
+        nn.Conv1D: _count_convnd,
+        nn.Conv2D: _count_convnd,
+        nn.Conv3D: _count_convnd,
+        nn.Conv1DTranspose: _count_convnd,
+        nn.Conv2DTranspose: _count_convnd,
+        nn.Conv3DTranspose: _count_convnd,
+        nn.BatchNorm1D: _count_bn,
+        nn.BatchNorm2D: _count_bn,
+        nn.BatchNorm3D: _count_bn,
+        nn.BatchNorm: _count_bn,
+        nn.ReLU: _count_zero,
+        nn.ReLU6: _count_zero,
+        nn.LeakyReLU: _count_leaky_relu,
+        nn.Linear: _count_linear,
+        nn.Dropout: _count_zero,
+        nn.AvgPool1D: _count_avgpool,
+        nn.AvgPool2D: _count_avgpool,
+        nn.AvgPool3D: _count_avgpool,
+        nn.AdaptiveAvgPool1D: _count_adap_avgpool,
+        nn.AdaptiveAvgPool2D: _count_adap_avgpool,
+        nn.AdaptiveAvgPool3D: _count_adap_avgpool,
+    }
+
+
+def flops(net, input_size, custom_ops=None, print_detail=False):
+    """Count FLOPs of `net` on a synthetic input of `input_size`.
+
+    Returns the total (int). print_detail renders a per-layer table.
+    """
+    from .. import tensor as T
+    from ..core.autograd import no_grad
+    from ..nn.layer.layers import Layer
+
+    if not isinstance(net, Layer):
+        from ..static import Program
+
+        if isinstance(net, Program):
+            raise NotImplementedError(
+                "static Program flops: trace the program's layer instead")
+        return -1
+
+    table = _register_hooks()
+    if custom_ops:
+        table.update(custom_ops)
+
+    rows = []
+    total = {"ops": 0, "params": 0}
+    handles = []
+    counted_params = set()  # layer ids — a reused layer's params count once
+
+    def add_hook(m):
+        if list(m.children()):
+            return
+        fn = table.get(type(m))
+
+        def post(layer, inp, out, _fn=fn):
+            inp = inp if isinstance(inp, (list, tuple)) else (inp,)
+            out0 = out[0] if isinstance(out, (list, tuple)) else out
+            ops = int(abs(_fn(layer, inp, out0))) if _fn is not None else 0
+            params = sum(_numel(p.shape) for p in layer.parameters())
+            rows.append((layer.full_name() if hasattr(layer, "full_name")
+                         else type(layer).__name__,
+                         list(inp[0].shape), list(out0.shape), params, ops))
+            total["ops"] += ops
+            if id(layer) not in counted_params:
+                counted_params.add(id(layer))
+                total["params"] += params
+
+        handles.append(m.register_forward_post_hook(post))
+
+    layers = net.sublayers(include_self=True)
+    saved_modes = [l.training for l in layers]
+    net.eval()
+    net.apply(add_hook)
+    try:
+        with no_grad():
+            net(T.randn(list(input_size)))
+    finally:
+        for h in handles:
+            h.remove()
+        for l, flag in zip(layers, saved_modes):
+            l.training = flag
+
+    if print_detail:
+        hdr = ("Layer Name", "Input Shape", "Output Shape", "Params", "Flops")
+        widths = [max(len(str(r[i])) for r in rows + [hdr])
+                  for i in range(5)]
+        line = "+" + "+".join("-" * (w + 2) for w in widths) + "+"
+        print(line)
+        print("|" + "|".join(f" {str(h):^{w}} " for h, w in zip(hdr, widths))
+              + "|")
+        print(line)
+        for r in rows:
+            print("|" + "|".join(f" {str(c):^{w}} "
+                                 for c, w in zip(r, widths)) + "|")
+        print(line)
+        print(f"Total Flops: {total['ops']}     "
+              f"Total Params: {total['params']}")
+    return total["ops"]
+
+
+def hlo_flops(fn, *example_args):
+    """XLA-exact FLOPs: compile `fn` and read the HLO cost analysis."""
+    import jax
+
+    compiled = jax.jit(fn).lower(*example_args).compile()
+    cost = compiled.cost_analysis()
+    if isinstance(cost, (list, tuple)):
+        cost = cost[0]
+    return int(cost.get("flops", -1)) if cost else -1

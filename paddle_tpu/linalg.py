@@ -1,0 +1,4 @@
+"""paddle.linalg namespace (reference: python/paddle/linalg.py — a re-export
+surface over tensor/linalg)."""
+from .tensor.linalg import *  # noqa: F401,F403
+from .tensor.linalg import __all__  # noqa: F401

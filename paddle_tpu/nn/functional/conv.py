@@ -105,6 +105,35 @@ def _conv_transpose(x, weight, bias, stride, padding, output_padding,
     dn = jax.lax.conv_dimension_numbers(
         (1,) * (n + 2), (1,) * (n + 2), (lhs_spec, rhs_spec, lhs_spec))
 
+    if output_size is not None:
+        # output_size and output_padding are mutually exclusive (reference
+        # python/paddle/nn/functional/conv.py conv2d_transpose); derive the
+        # extra high-side padding from the requested spatial output shape.
+        if any(out_pad):
+            raise ValueError(
+                "output_padding and output_size can not be both set")
+        if isinstance(pad, str):
+            raise ValueError(
+                "output_size requires explicit int padding, got "
+                f"padding={pad!r}")
+        size = [int(s) for s in (
+            output_size if isinstance(output_size, (list, tuple))
+            else [output_size] * n)]
+        x_spatial = (x.shape[1:1 + n] if channel_last else x.shape[2:2 + n])
+        k_spatial = weight._value.shape[2:]
+        derived = []
+        for i in range(n):
+            k_eff = (k_spatial[i] - 1) * dilation[i] + 1
+            lo, hi = pad[i]
+            base = (x_spatial[i] - 1) * stride[i] - lo - hi + k_eff
+            extra = size[i] - base
+            if not 0 <= extra < stride[i]:
+                raise ValueError(
+                    f"output_size[{i}]={size[i]} out of the valid range "
+                    f"[{base}, {base + stride[i]})")
+            derived.append(extra)
+        out_pad = tuple(derived)
+
     if isinstance(pad, str):
         lax_pad = pad
     else:

@@ -307,11 +307,11 @@ class Layer:
     def bfloat16(self):
         return self.astype("bfloat16")
 
-    def _cast_to(self, jd):
-        for p in self.parameters():
+    def _cast_to(self, jd, include_sublayers=True):
+        for p in self.parameters(include_sublayers=include_sublayers):
             if jnp.issubdtype(p._value.dtype, jnp.floating):
                 p._value = p._value.astype(jd)
-        for b in self.buffers():
+        for b in self.buffers(include_sublayers=include_sublayers):
             if isinstance(b, Tensor) and jnp.issubdtype(
                     b._value.dtype, jnp.floating):
                 b._value = b._value.astype(jd)

@@ -153,6 +153,12 @@ class Optimizer:
                 new_states.append(ns)
             return new_vals, new_states
 
+        # Donation contract: params + opt states are donated to XLA so the
+        # update rewrites HBM in place. Any alias of the pre-step param
+        # arrays (Tensor.detach() taken earlier, retained residuals for a
+        # second backward of a freed graph) is invalidated by step(); callers
+        # holding such aliases must materialize them first (see
+        # Tensor.detach docstring).
         return jax.jit(fused, donate_argnums=(0, 1))
 
     @property

@@ -1,0 +1,225 @@
+"""Real-chip throughput bench (SURVEY §6 / BASELINE.json configs).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...details}
+
+Headline metric: BERT-base MLM tokens/sec/chip (AMP O2 bf16, whole-step
+jit with donated buffers). Details carry ResNet50 static-Executor
+imgs/sec, LeNet Model.fit imgs/sec, and the flash-attention A/B.
+vs_baseline is the ratio against BASELINE.json's published numbers when
+present (1.0 otherwise — round 1 published none).
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import numpy as np
+
+# persistent XLA compile cache: BERT-base/ResNet50 compiles are minutes on
+# the tunneled chip; cache them across bench runs/rounds
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                      os.path.join(os.path.dirname(__file__) or ".",
+                                   ".jax_cache"))
+
+
+def _sync(x):
+    """Force materialization: np.asarray round-trips through the host, the
+    only sync the axon tunnel honors (block_until_ready returns early)."""
+    return np.asarray(jax.tree_util.tree_leaves(x)[0])
+
+
+import jax  # noqa: E402
+
+
+def bench_bert(batch=16, seq=128, steps=30, warmup=5):
+    """BERT-base MLM, AMP O2 (bf16 weights, f32 norms), fused jitted step."""
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+    from paddle_tpu.core.tensor import Tensor
+    from paddle_tpu.models.bert import BertConfig, BertForMaskedLM
+
+    paddle.seed(0)
+    cfg = BertConfig(dropout=0.0, attention_dropout=0.0)  # base config
+    model = BertForMaskedLM(cfg)
+    paddle.amp.decorate(model, level="O2")  # bf16 weights, norms f32
+    model.eval()  # dropout off; stats frozen (MLM has no BN)
+
+    params = {k: p._value for k, p in model.named_parameters()
+              if not p.stop_gradient}
+    opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                 parameters=model.parameters())
+    meta = opt.param_meta({k: p for k, p in model.named_parameters()
+                           if not p.stop_gradient})
+    states = opt.functional_init_states(params)
+
+    def step(pv, st, ids, labels):
+        def loss_of(p):
+            out, _ = model.functional_call(
+                {k: Tensor(v) for k, v in p.items()},
+                Tensor(ids), None, None, Tensor(labels))
+            loss = out[0] if isinstance(out, (list, tuple)) else out
+            return loss._value.astype(jnp.float32)
+        loss, grads = jax.value_and_grad(loss_of)(pv)
+        new_p, new_s = opt.functional_update(pv, grads, st, jnp.float32(1e-4),
+                                             meta=meta)
+        return new_p, new_s, loss
+
+    jit_step = jax.jit(step, donate_argnums=(0, 1))
+    rng = np.random.RandomState(0)
+    ids = jnp.asarray(rng.randint(0, cfg.vocab_size, (batch, seq)))
+    labels = jnp.asarray(rng.randint(0, cfg.vocab_size, (batch, seq)))
+
+    lowered = jit_step.lower(params, states, ids, labels)
+    compiled = lowered.compile()
+    f64_free = "f64[" not in compiled.as_text()
+
+    for _ in range(warmup):
+        params, states, loss = jit_step(params, states, ids, labels)
+    _sync(loss)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        params, states, loss = jit_step(params, states, ids, labels)
+    _sync(loss)
+    dt = time.perf_counter() - t0
+    return {
+        "bert_tokens_per_sec": steps * batch * seq / dt,
+        "bert_step_ms": dt / steps * 1e3,
+        "bert_loss": float(loss),
+        "f64_free": f64_free,
+    }
+
+
+def bench_resnet50(batch=64, steps=20, warmup=3):
+    """ResNet50 static-graph Executor (single-device fp32)."""
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    from paddle_tpu.vision.models import resnet50
+
+    paddle.seed(0)
+    paddle.enable_static()
+    try:
+        main = paddle.static.Program()
+        with paddle.static.program_guard(main):
+            x = paddle.static.data("x", [batch, 3, 224, 224], "float32")
+            y = paddle.static.data("y", [batch], "int64")
+            logits = resnet50(num_classes=100)(x)
+            loss = nn.functional.cross_entropy(logits, y)
+            paddle.optimizer.Momentum(learning_rate=0.1,
+                                      momentum=0.9).minimize(loss)
+        exe = paddle.static.Executor()
+        rng = np.random.RandomState(0)
+        xs = rng.randn(batch, 3, 224, 224).astype(np.float32)
+        ys = rng.randint(0, 100, batch).astype(np.int64)
+        for _ in range(warmup):
+            (lv,) = exe.run(main, feed={"x": xs, "y": ys},
+                            fetch_list=[loss])
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            (lv,) = exe.run(main, feed={"x": xs, "y": ys},
+                            fetch_list=[loss])
+        dt = time.perf_counter() - t0
+    finally:
+        paddle.disable_static()
+    return {"resnet50_imgs_per_sec": steps * batch / dt,
+            "resnet50_step_ms": dt / steps * 1e3}
+
+
+def bench_lenet(batch=256, steps=30, warmup=3):
+    """LeNet dygraph Model.fit path (whole-step-jitted train_batch)."""
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    from paddle_tpu.vision.models import LeNet
+
+    paddle.seed(0)
+    model = paddle.Model(LeNet())
+    model.prepare(paddle.optimizer.Adam(parameters=model.parameters()),
+                  nn.CrossEntropyLoss())
+    rng = np.random.RandomState(0)
+    xs = rng.randn(batch, 1, 28, 28).astype(np.float32)
+    ys = rng.randint(0, 10, (batch, 1)).astype(np.int64)
+    for _ in range(warmup):
+        model.train_batch([xs], [ys])
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        model.train_batch([xs], [ys])
+    dt = time.perf_counter() - t0
+    return {"lenet_imgs_per_sec": steps * batch / dt}
+
+
+def bench_flash_attention(batch=4, heads=12, seq=512, dim=64, iters=50):
+    """Pallas flash attention vs XLA softmax attention, fwd+bwd."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.nn.functional.attention import _xla_attention
+    from paddle_tpu.ops.pallas.flash_attention import flash_attention_raw
+
+    rng = np.random.RandomState(0)
+    shape = (batch * heads, seq, dim)
+    q, k, v = (jnp.asarray(rng.randn(*shape).astype(np.float32))
+               for _ in range(3))
+
+    def xla_loss(q, k, v):
+        out, _ = _xla_attention(q[None], k[None], v[None], None, 0.0, None,
+                                True)
+        return (out ** 2).mean()
+
+    def flash_loss(q, k, v):
+        return (flash_attention_raw(q, k, v, True) ** 2).mean()
+
+    res = {}
+    for name, fn in [("xla", xla_loss), ("flash", flash_loss)]:
+        try:
+            g = jax.jit(jax.grad(fn, argnums=(0, 1, 2)))
+            _sync(g(q, k, v))
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                out = g(q, k, v)
+            _sync(out)
+            res[f"attn_{name}_ms"] = (time.perf_counter() - t0) / iters * 1e3
+        except Exception as e:  # noqa: BLE001
+            res[f"attn_{name}_ms"] = None
+            res[f"attn_{name}_error"] = str(e)[:200]
+    return res
+
+
+def main():
+    import jax
+
+    details = {"backend": jax.default_backend(),
+               "device_count": jax.device_count()}
+    for bench in (bench_bert, bench_resnet50, bench_lenet,
+                  bench_flash_attention):
+        try:
+            details.update(bench())
+        except Exception as e:  # noqa: BLE001
+            details[bench.__name__ + "_error"] = str(e)[:300]
+
+    value = details.get("bert_tokens_per_sec")
+    baseline = 1.0
+    try:
+        with open(os.path.join(os.path.dirname(__file__) or ".",
+                               "BASELINE.json")) as f:
+            published = json.load(f).get("published", {})
+        ref = published.get("bert_tokens_per_sec")
+        if value and ref:
+            baseline = value / ref
+    except (OSError, ValueError):
+        pass
+
+    print(json.dumps({
+        "metric": "BERT-base MLM tokens/sec/chip (AMP O2 bf16)",
+        "value": round(value, 1) if value else None,
+        "unit": "tokens/sec",
+        "vs_baseline": round(baseline, 3),
+        **{k: (round(v, 2) if isinstance(v, float) else v)
+           for k, v in details.items()},
+    }))
+
+
+if __name__ == "__main__":
+    main()

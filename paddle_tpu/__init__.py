@@ -62,6 +62,9 @@ from . import models  # noqa: F401
 from . import ops  # noqa: F401
 from . import static  # noqa: F401
 from . import jit  # noqa: F401
+from . import distributed  # noqa: F401
+from . import inference  # noqa: F401
+from . import profiler  # noqa: F401
 from . import device  # noqa: F401
 from .device import (  # noqa: F401
     CPUPlace, CUDAPinnedPlace, CUDAPlace, TPUPlace, XPUPlace, get_device,

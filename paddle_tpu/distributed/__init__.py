@@ -1,0 +1,52 @@
+"""paddle.distributed — TPU-native distributed training.
+
+Reference surface: python/paddle/distributed (collective.py, parallel.py,
+fleet/, sharding/, spawn). TPU-native substrate: one jax.sharding.Mesh,
+XLA ICI/DCN collectives, GSPMD-inserted communication; see collective.py
+for the two-regime (traced shard_map / eager rank-stacked) design.
+"""
+from __future__ import annotations
+
+from .env import (  # noqa: F401
+    get_mesh, init_distributed_env, set_mesh, world_mesh,
+)
+from .collective import (  # noqa: F401
+    ProcessGroup, ReduceOp, all_gather, all_gather_object, all_reduce,
+    alltoall, alltoall_single, barrier, broadcast, destroy_process_group,
+    get_group, get_rank, get_world_size, init_process_group, irecv,
+    is_initialized, isend, new_group, p2p_permute, recv, reduce, scatter,
+    send, wait,
+)
+from .parallel import DataParallel, ParallelEnv, init_parallel_env  # noqa: F401
+from .shard_utils import annotate, PartitionSpec  # noqa: F401
+from . import fleet  # noqa: F401
+from .fleet import mp_layers  # noqa: F401
+from .mp_layers import (  # noqa: F401
+    ColumnParallelLinear, ParallelCrossEntropy, RowParallelLinear,
+    VocabParallelEmbedding,
+)
+from . import sharding  # noqa: F401
+from .sharding import group_sharded_parallel, shard_params_and_opt  # noqa: F401
+from . import pipeline  # noqa: F401
+from .pipeline import LayerDesc, PipelineLayer, pipeline_forward  # noqa: F401
+from . import moe  # noqa: F401
+from .moe import MoELayer  # noqa: F401
+from . import sequence_parallel  # noqa: F401
+from .sequence_parallel import ring_attention, split_sequence  # noqa: F401
+from . import elastic  # noqa: F401
+
+
+def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):
+    """Reference spawn forks one process per device; under single-controller
+    SPMD the program already spans every device, so spawn runs `func` once
+    (rank 0) after bringing up the parallel env."""
+    init_parallel_env()
+    func(*args)
+
+
+def get_backend():
+    return "xla"
+
+
+def is_available():
+    return True

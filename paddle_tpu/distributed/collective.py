@@ -1,0 +1,526 @@
+"""Collective communication API.
+
+Reference: python/paddle/distributed/collective.py (all_reduce:580,
+all_gather, broadcast, scatter, reduce, alltoall, send/recv, barrier,
+new_group) — NCCL rings driven per-process.
+
+TPU-native design — two regimes, one API:
+
+1. **Traced SPMD regime** (the compiled hot path): inside `shard_map` over a
+   mesh axis, a tensor is the *rank-local block* and every collective lowers
+   to the XLA ICI op with the group's axis name (`psum`, `all_gather`,
+   `all_to_all`, `ppermute`). All higher-level parallelism (DataParallel,
+   fleet TP/PP/MoE) rides this path under whole-step jit.
+
+2. **Eager host-driven regime** (parity/testing): single-controller JAX has
+   no per-process eager state, so a "per-rank tensor" is embedded rank-major:
+   leading axis = group size, one slice per rank. Eager collectives run a
+   real jitted shard_map program over the group's devices, so the same XLA
+   collective executes on the same interconnect — the embedding is in the
+   data layout only. Tensors whose leading dim != group size are rejected
+   with a pointer to this doc.
+
+send/recv are point-to-point: traced regime uses ppermute; eager pairs them
+through an in-process mailbox (single-controller has one ambient rank).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..core.autograd import apply
+from ..core.tensor import Tensor
+from . import env as _env
+
+__all__ = [
+    "ReduceOp", "ProcessGroup", "new_group", "get_group", "is_initialized",
+    "init_process_group", "destroy_process_group", "all_reduce", "all_gather",
+    "all_gather_object", "broadcast", "reduce", "scatter", "alltoall",
+    "alltoall_single", "send", "recv", "isend", "irecv", "barrier", "wait",
+    "get_rank", "get_world_size",
+]
+
+
+class ReduceOp:
+    SUM = 0
+    MAX = 1
+    MIN = 2
+    PROD = 3
+    AVG = 4
+
+
+class ProcessGroup:
+    """A communicator: a set of devices + the mesh axes collectives run over.
+
+    `axes` is the axis name (or tuple of names) used in the traced regime;
+    `_flat_mesh` is a private 1-D mesh over the group's devices used to
+    execute eager collectives.
+    """
+
+    _next_gid = 0
+
+    def __init__(self, devices, axes=None, ranks=None):
+        self.id = ProcessGroup._next_gid
+        ProcessGroup._next_gid += 1
+        self._devices = list(devices)
+        self.nranks = len(self._devices)
+        self.ranks = list(ranks) if ranks is not None else \
+            list(range(self.nranks))
+        self._axis = f"_pg{self.id}"
+        self._flat_mesh = Mesh(np.array(self._devices), (self._axis,))
+        self._explicit_axes = axes
+
+    @property
+    def axes(self):
+        """Axis name(s) for the traced regime. Explicit axes (fleet groups
+        bound to a mesh axis) win; otherwise resolve to whatever axes the
+        enclosing shard_map bound (the world group spans them all)."""
+        if self._explicit_axes is not None:
+            return self._explicit_axes
+        bound = _bound_axes()
+        if bound:
+            return bound if len(bound) > 1 else bound[0]
+        return self._axis
+
+    @property
+    def rank(self):
+        return 0  # single-controller ambient rank
+
+    @property
+    def world_size(self):
+        return self.nranks
+
+    def get_group_rank(self, global_rank):
+        return self.ranks.index(global_rank) if global_rank in self.ranks \
+            else -1
+
+    def _require_member(self, global_rank, what):
+        r = self.get_group_rank(global_rank)
+        if r < 0:
+            raise ValueError(
+                f"{what} rank {global_rank} is not a member of {self!r} "
+                f"(ranks={self.ranks})")
+        return r
+
+    def __repr__(self):
+        return f"ProcessGroup(id={self.id}, nranks={self.nranks})"
+
+
+_default_group = None
+_mailbox = {}  # (group_id, src, dst) -> [values]  — eager send/recv pairing
+
+
+def init_process_group(backend=None, world_size=None, rank=None, **kw):
+    """torch-style alias used by some reference-adjacent code."""
+    return _get_default_group()
+
+
+def _get_default_group():
+    global _default_group
+    if _default_group is None:
+        _default_group = ProcessGroup(jax.devices())
+    return _default_group
+
+
+def is_initialized():
+    return _default_group is not None
+
+
+def destroy_process_group(group=None):
+    global _default_group
+    if group is None or group is _default_group:
+        _default_group = None
+
+
+def get_group(gid=0):
+    return _get_default_group()
+
+
+def new_group(ranks=None, backend=None, timeout=None):
+    """Sub-communicator over the listed global ranks (device indices)."""
+    devs = jax.devices()
+    if ranks is None:
+        ranks = list(range(len(devs)))
+    return ProcessGroup([devs[r] for r in ranks], ranks=ranks)
+
+
+def get_rank(group=None):
+    return _env.rank()
+
+
+def get_world_size(group=None):
+    if group is not None:
+        return group.nranks
+    return _env.world_size()
+
+
+# ---------------------------------------------------------------------------
+# regime plumbing
+# ---------------------------------------------------------------------------
+
+def _is_traced(v):
+    return isinstance(v, jax.core.Tracer)
+
+
+from .env import bound_axes as _bound_axes  # noqa: E402
+
+
+def _group_of(group):
+    return group if group is not None else _get_default_group()
+
+
+def _check_stacked(v, g, opname):
+    if v.shape and v.shape[0] == g.nranks:
+        return
+    raise ValueError(
+        f"eager {opname}: expected a rank-stacked tensor with leading axis "
+        f"== group size ({g.nranks}), got shape {tuple(v.shape)}. "
+        "Single-controller eager collectives embed per-rank values "
+        "rank-major; inside shard_map pass the rank-local block instead "
+        "(see paddle_tpu.distributed.collective docstring).")
+
+
+@functools.lru_cache(maxsize=None)
+def _eager_prog(gid, opname, axis, mesh, in_specs, out_specs, static):
+    """jit-compiled shard_map program for an eager collective."""
+    fn = _EAGER_BODIES[opname]
+    body = functools.partial(fn, axis=axis, static=static)
+    return jax.jit(shard_map(body, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=False))
+
+
+def _run_eager(g, opname, vals, in_specs, out_specs, static=()):
+    prog = _eager_prog(g.id, opname, g._axis, g._flat_mesh,
+                       in_specs, out_specs, static)
+    return prog(*vals)
+
+
+# eager bodies: operate on the rank-local block (leading dim 1)
+def _body_all_reduce(x, *, axis, static):
+    (op,) = static
+    return _reduce_block(x, axis, op)
+
+
+def _reduce_block(x, axis, op):
+    if op == ReduceOp.SUM:
+        return jax.lax.psum(x, axis)
+    if op == ReduceOp.MAX:
+        return jax.lax.pmax(x, axis)
+    if op == ReduceOp.MIN:
+        return jax.lax.pmin(x, axis)
+    if op == ReduceOp.AVG:
+        return jax.lax.pmean(x, axis)
+    if op == ReduceOp.PROD:
+        g = jax.lax.all_gather(x, axis, axis=0)  # (n, 1, ...)
+        return jnp.prod(g, axis=0)
+    raise ValueError(f"unknown ReduceOp {op}")
+
+
+def _body_all_gather(x, *, axis, static):
+    return jax.lax.all_gather(x[0], axis, axis=0)[None]  # (1, n, ...)
+
+
+def _body_broadcast(x, *, axis, static):
+    (src,) = static
+    idx = jax.lax.axis_index(axis)
+    masked = jnp.where(idx == src, x, jnp.zeros_like(x))
+    return jax.lax.psum(masked, axis)
+
+
+def _body_reduce(x, *, axis, static):
+    src_op, dst = static
+    red = _reduce_block(x, axis, src_op)
+    idx = jax.lax.axis_index(axis)
+    return jnp.where(idx == dst, red, x)
+
+
+def _body_scatter(stacked, *, axis, static):
+    # stacked: full (n, ...) list replicated; each rank takes its row
+    # (keepdims=True keeps the leading rank-block dim of size 1)
+    idx = jax.lax.axis_index(axis)
+    return jax.lax.dynamic_index_in_dim(stacked, idx, axis=0)
+
+
+def _body_alltoall(x, *, axis, static):
+    # x: (1, n, ...) per rank — one slice addressed to each peer
+    out = jax.lax.all_to_all(x, axis, split_axis=1, concat_axis=0)  # (n,1,..)
+    return jnp.swapaxes(out, 0, 1)  # (1, n, ...)
+
+
+_EAGER_BODIES = {
+    "all_reduce": _body_all_reduce,
+    "all_gather": _body_all_gather,
+    "broadcast": _body_broadcast,
+    "reduce": _body_reduce,
+    "scatter": _body_scatter,
+    "alltoall": _body_alltoall,
+}
+
+
+# ---------------------------------------------------------------------------
+# public collectives
+# ---------------------------------------------------------------------------
+
+def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True,
+               use_calc_stream=False):
+    """In-place across-rank reduction. Returns the tensor (reference
+    returns None eagerly but the tensor is mutated; we do both)."""
+    g = _group_of(group)
+    v = tensor._value if isinstance(tensor, Tensor) else tensor
+    if _is_traced(v):
+        out = apply(lambda x: _reduce_block(x, g.axes, op), tensor)
+        return out
+    _check_stacked(v, g, "all_reduce")
+    spec = P(g._axis)
+    res = _run_eager(g, "all_reduce", (v,), (spec,), spec, (op,))
+    if isinstance(tensor, Tensor):
+        tensor._value = res
+        return tensor
+    return res
+
+
+def all_gather(tensor_list, tensor, group=None, sync_op=True):
+    """Gather each rank's tensor; extends tensor_list with nranks Tensors.
+
+    Traced: returns the concatenated gather of the rank-local block.
+    """
+    g = _group_of(group)
+    v = tensor._value if isinstance(tensor, Tensor) else tensor
+    if _is_traced(v):
+        return apply(lambda x: jax.lax.all_gather(x, g.axes, axis=0,
+                                                  tiled=True), tensor)
+    _check_stacked(v, g, "all_gather")
+    res = _run_eager(g, "all_gather", (v,), (P(g._axis),),
+                     P(g._axis, None))  # (n, n, ...)
+    rows = res[0]
+    if tensor_list is not None:
+        tensor_list.extend(Tensor(rows[i]) for i in range(g.nranks))
+    return Tensor(rows)
+
+
+def all_gather_object(object_list, obj, group=None):
+    """Gather picklable objects (single-controller: every rank holds obj)."""
+    g = _group_of(group)
+    object_list.extend([obj] * g.nranks)
+
+
+def broadcast(tensor, src=0, group=None, sync_op=True):
+    g = _group_of(group)
+    v = tensor._value if isinstance(tensor, Tensor) else tensor
+    src = g._require_member(src, "broadcast src") if group is not None \
+        else src
+    if _is_traced(v):
+        def _b(x):
+            idx = jax.lax.axis_index(g.axes)
+            masked = jnp.where(idx == src, x, jnp.zeros_like(x))
+            return jax.lax.psum(masked, g.axes)
+        return apply(_b, tensor)
+    _check_stacked(v, g, "broadcast")
+    spec = P(g._axis)
+    res = _run_eager(g, "broadcast", (v,), (spec,), spec, (src,))
+    if isinstance(tensor, Tensor):
+        tensor._value = res
+        return tensor
+    return res
+
+
+def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
+    g = _group_of(group)
+    v = tensor._value if isinstance(tensor, Tensor) else tensor
+    dst = g._require_member(dst, "reduce dst") if group is not None else dst
+    if _is_traced(v):
+        # every rank computes the reduction; non-dst ranks keep theirs
+        def _r(x):
+            red = _reduce_block(x, g.axes, op)
+            idx = jax.lax.axis_index(g.axes)
+            return jnp.where(idx == dst, red, x)
+        return apply(_r, tensor)
+    _check_stacked(v, g, "reduce")
+    spec = P(g._axis)
+    res = _run_eager(g, "reduce", (v,), (spec,), spec, (op, dst))
+    if isinstance(tensor, Tensor):
+        tensor._value = res
+        return tensor
+    return res
+
+
+def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    """Rank i receives tensor_list[i] (held by src). Eager: tensor gets the
+    rank-stacked result; traced: block receives its slice of the stacked
+    src tensor."""
+    g = _group_of(group)
+    source = None  # keep the caller's Tensor so the tape stays connected
+    if tensor_list is not None:
+        first = tensor_list[0]
+        if _is_traced(first._value if isinstance(first, Tensor) else first):
+            def _s_list(*ts):
+                full = jnp.stack(ts)
+                idx = jax.lax.axis_index(g.axes)
+                return jax.lax.dynamic_index_in_dim(full, idx, axis=0,
+                                                    keepdims=False)
+            return apply(_s_list, *tensor_list)
+        stacked = jnp.stack([t._value if isinstance(t, Tensor) else t
+                             for t in tensor_list])
+    else:
+        source = tensor
+        stacked = tensor._value if isinstance(tensor, Tensor) else tensor
+    if _is_traced(stacked):
+        def _s(full):
+            idx = jax.lax.axis_index(g.axes)
+            return jax.lax.dynamic_index_in_dim(full, idx, axis=0,
+                                                keepdims=False)
+        return apply(_s, source if isinstance(source, Tensor)
+                     else Tensor(stacked))
+    if stacked.shape[0] != g.nranks:
+        raise ValueError(
+            f"scatter: need {g.nranks} tensors, got {stacked.shape[0]}")
+    res = _run_eager(g, "scatter", (stacked,), (P(None),), P(g._axis))
+    if isinstance(tensor, Tensor):
+        tensor._value = res
+        return tensor
+    return Tensor(res)
+
+
+def alltoall(in_tensor_list, out_tensor_list=None, group=None, sync_op=True):
+    """out[j] on rank i = in[i] on rank j (the rank-axis transpose).
+
+    Traced: pass the block (n, ...) of per-peer slices. Eager: pass the
+    stacked (n, n, ...) tensor or a list of n per-rank tensors each (n, ...).
+    """
+    g = _group_of(group)
+    if isinstance(in_tensor_list, (list, tuple)):
+        first = in_tensor_list[0]
+        fv = first._value if isinstance(first, Tensor) else first
+        if _is_traced(fv):
+            # traced: list of per-peer tensors -> stack, all_to_all, unstack
+            def _a2a(*xs):
+                x = jnp.stack(xs)  # (n, ...)
+                out = jax.lax.all_to_all(x, g.axes, split_axis=0,
+                                         concat_axis=0, tiled=True)
+                return tuple(out[i] for i in range(len(xs)))
+            return list(apply(_a2a, *in_tensor_list))
+        stacked = jnp.stack([t._value if isinstance(t, Tensor) else t
+                             for t in in_tensor_list], axis=1)  # (n, n, ...)
+    else:
+        stacked = in_tensor_list._value if isinstance(in_tensor_list, Tensor) \
+            else in_tensor_list
+        if _is_traced(stacked):
+            return apply(lambda x: jax.lax.all_to_all(
+                x, g.axes, split_axis=0, concat_axis=0, tiled=True),
+                in_tensor_list)
+    if stacked.shape[0] != g.nranks or stacked.shape[1] != g.nranks:
+        raise ValueError(
+            f"eager alltoall: expected (n, n, ...) with n={g.nranks}, got "
+            f"{tuple(stacked.shape)}")
+    res = _run_eager(g, "alltoall", (stacked,), (P(g._axis),),
+                     P(g._axis))  # (n, n, ...) transposed on rank axes
+    if out_tensor_list is not None:
+        out_tensor_list.extend(Tensor(res[i]) for i in range(g.nranks))
+    return Tensor(res)
+
+
+def alltoall_single(in_tensor, out_tensor=None, in_split_sizes=None,
+                    out_split_sizes=None, group=None, sync_op=True):
+    g = _group_of(group)
+    v = in_tensor._value if isinstance(in_tensor, Tensor) else in_tensor
+    if in_split_sizes is not None or out_split_sizes is not None:
+        raise NotImplementedError(
+            "uneven alltoall splits are not supported (XLA all_to_all is "
+            "even-split); pad to equal splits")
+    if _is_traced(v):
+        return apply(lambda x: jax.lax.all_to_all(
+            x, g.axes, split_axis=0, concat_axis=0, tiled=True), in_tensor)
+    # eager: stacked (n, L, ...) where L = n*chunk; reshape to (n,n,chunk,...)
+    n = g.nranks
+    if len(v.shape) < 2 or v.shape[1] % n != 0:
+        raise ValueError(
+            f"alltoall_single: per-rank length {v.shape[1:2]} must divide "
+            f"by group size {n}")
+    chunk = v.shape[1] // n
+    stacked = v.reshape((n, n, chunk) + v.shape[2:])
+    res = _run_eager(g, "alltoall", (stacked,), (P(g._axis),), P(g._axis))
+    res = res.reshape((n, n * chunk) + v.shape[2:])
+    if isinstance(out_tensor, Tensor):
+        out_tensor._value = res
+        return out_tensor
+    return Tensor(res)
+
+
+def send(tensor, dst=0, group=None, sync_op=True):
+    """Point-to-point send. Traced: use ppermute via `p2p_permute` or the
+    pipeline helpers; eager: pairs with a matching recv through the
+    in-process mailbox (ambient rank is 0 under single-controller)."""
+    g = _group_of(group)
+    v = tensor._value if isinstance(tensor, Tensor) else tensor
+    if _is_traced(v):
+        raise RuntimeError(
+            "send() inside a trace: use p2p_permute(x, perm) / the pipeline "
+            "schedule — XLA point-to-point is collective-permute, both ends "
+            "participate in one op")
+    _mailbox.setdefault((g.id, get_rank(), dst), []).append(jnp.asarray(v))
+
+
+def recv(tensor, src=0, group=None, sync_op=True):
+    g = _group_of(group)
+    box = _mailbox.get((g.id, src, get_rank()))
+    if not box:
+        raise RuntimeError(
+            f"recv: no message pending from rank {src} (single-controller "
+            "eager send/recv pair through an in-process mailbox; the "
+            "matching send must run first)")
+    val = box.pop(0)
+    if isinstance(tensor, Tensor):
+        tensor._value = val.astype(tensor._value.dtype)
+        return tensor
+    return Tensor(val)
+
+
+class _Work:
+    def __init__(self):
+        pass
+
+    def wait(self):
+        return True
+
+    def is_completed(self):
+        return True
+
+
+def isend(tensor, dst=0, group=None):
+    send(tensor, dst, group)
+    return _Work()
+
+
+def irecv(tensor, src=0, group=None):
+    recv(tensor, src, group)
+    return _Work()
+
+
+def p2p_permute(x, perm, group=None):
+    """Traced-regime point-to-point: lax.ppermute over the group axis.
+    perm: list of (src_rank, dst_rank) pairs."""
+    g = _group_of(group)
+    if isinstance(x, Tensor):
+        return apply(lambda v: jax.lax.ppermute(v, g.axes, perm), x)
+    return jax.lax.ppermute(x, g.axes, perm)
+
+
+def barrier(group=None):
+    """Synchronize: a tiny psum over the group, blocked on host."""
+    g = _group_of(group)
+    one = jnp.ones((g.nranks,), jnp.int32)
+    res = _run_eager(g, "all_reduce", (one,), (P(g._axis),), P(g._axis),
+                     (ReduceOp.SUM,))
+    jax.block_until_ready(res)
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    v = tensor._value if isinstance(tensor, Tensor) else tensor
+    if not _is_traced(v):
+        jax.block_until_ready(v)
+    return tensor

@@ -1,0 +1,105 @@
+"""Elastic training: failure detection + checkpoint auto-resume.
+
+Reference: python/paddle/distributed/fleet/elastic/manager.py — an etcd-
+backed watchdog that watches trainer heartbeats and relaunches dead ranks.
+
+TPU-native: a single-controller slice fails as a unit (a chip loss kills
+the XLA client), so elasticity = (1) a heartbeat file/callback watchdog
+that detects a hung step loop, and (2) periodic sharded checkpoints
+(io/checkpoint.py) + `resume()` that restores the newest complete one.
+The kill-and-resume path is what the reference's relaunch gives you, minus
+the process manager (the TPU scheduler owns process lifecycles).
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+__all__ = ["ElasticManager", "heartbeat", "latest_checkpoint"]
+
+
+def heartbeat(path, step, payload=None):
+    """Atomically record liveness + progress (watchdogs poll this file)."""
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump({"step": int(step), "time": time.time(),
+                   **(payload or {})}, f)
+    os.replace(tmp, path)
+
+
+def latest_checkpoint(ckpt_dir):
+    """Newest complete checkpoint step in ckpt_dir (orbax layout), or None."""
+    if not os.path.isdir(ckpt_dir):
+        return None
+    steps = []
+    for name in os.listdir(ckpt_dir):
+        p = os.path.join(ckpt_dir, name)
+        if name.isdigit() and os.path.isdir(p) and not os.path.exists(
+                os.path.join(p, ".incomplete")):
+            steps.append(int(name))
+    return max(steps) if steps else None
+
+
+class ElasticManager:
+    """Watchdog + auto-resume driver.
+
+    Usage:
+        em = ElasticManager(ckpt_dir, timeout=300)
+        start = em.resume(restore_fn)      # restore newest ckpt, or 0
+        em.start_watchdog(on_stall=...)    # background liveness monitor
+        for step in range(start, n):
+            ...train...
+            em.tick(step)                  # heartbeat (+ periodic save)
+    """
+
+    def __init__(self, ckpt_dir, timeout=300.0, save_interval=100,
+                 save_fn=None):
+        self.ckpt_dir = ckpt_dir
+        self.timeout = timeout
+        self.save_interval = save_interval
+        self.save_fn = save_fn
+        self._hb_path = os.path.join(ckpt_dir, "heartbeat.json")
+        self._watch = None
+        self._stop = threading.Event()
+        self.stalled = False
+        os.makedirs(ckpt_dir, exist_ok=True)
+
+    def tick(self, step):
+        heartbeat(self._hb_path, step)
+        if self.save_fn is not None and self.save_interval and \
+                step > 0 and step % self.save_interval == 0:
+            self.save_fn(step)
+
+    def resume(self, restore_fn):
+        """Restore the newest complete checkpoint; returns the step to
+        continue from (0 when starting fresh)."""
+        step = latest_checkpoint(self.ckpt_dir)
+        if step is None:
+            return 0
+        restore_fn(step)
+        return step + 1
+
+    def start_watchdog(self, on_stall=None, poll=5.0):
+        def _watch():
+            while not self._stop.wait(poll):
+                try:
+                    with open(self._hb_path) as f:
+                        hb = json.load(f)
+                    age = time.time() - hb.get("time", 0)
+                except (OSError, ValueError):
+                    continue
+                if age > self.timeout:
+                    self.stalled = True
+                    if on_stall is not None:
+                        on_stall(hb)
+                    return
+
+        self._watch = threading.Thread(target=_watch, daemon=True)
+        self._watch.start()
+
+    def stop(self):
+        self._stop.set()
+        if self._watch is not None:
+            self._watch.join(timeout=2)

@@ -1,21 +1,34 @@
 """Distributed environment: mesh bookkeeping + multi-host init.
 
-Reference: paddle/fluid/imperative/nccl_context + distributed/collective env.
-TPU-native: the "process group" is a jax.sharding.Mesh; collectives are XLA
-ops over its named axes (ICI within a slice, DCN across hosts).
+Reference: paddle/fluid/imperative/nccl_context + the env side of
+python/paddle/distributed/collective.py. TPU-native: the "communicator" is a
+jax.sharding.Mesh; collectives are XLA ops over its named axes (ICI within a
+slice, DCN across hosts via jax.distributed).
 """
 from __future__ import annotations
 
 import jax
 import numpy as np
 
-__all__ = ["get_mesh", "set_mesh", "current_mesh_axes", "world_size", "rank",
-           "init_distributed_env"]
+__all__ = ["get_mesh", "set_mesh", "world_mesh", "world_size", "rank",
+           "init_distributed_env", "bound_axes"]
+
+
+def bound_axes():
+    """Axis names bound by the enclosing shard_map trace (empty outside)."""
+    try:
+        from jax._src.core import get_axis_env
+
+        return tuple(get_axis_env().axis_sizes.keys())
+    except Exception:  # API drift across jax versions
+        return ()
 
 _mesh = None
 
 
 def set_mesh(mesh):
+    """Install the global device mesh all sharding annotations resolve
+    against (fleet.init builds a hybrid dp/tp/pp mesh and installs it)."""
     global _mesh
     _mesh = mesh
 
@@ -24,20 +37,9 @@ def get_mesh():
     return _mesh
 
 
-def current_mesh_axes():
-    """Names of mesh axes live in the current trace (inside shard_map)."""
-    try:
-        from jax.core import get_axis_env  # may vary across jax versions
-    except ImportError:
-        get_axis_env = None
-    axes = []
-    for name in ("dp", "tp", "pp", "sp", "ep", "mp"):
-        try:
-            jax.lax.axis_index(name)
-            axes.append(name)
-        except (NameError, Exception):  # noqa: BLE001 - axis not bound
-            continue
-    return tuple(axes)
+def world_mesh(axis_name="dp"):
+    """1-D mesh over every device — the default data-parallel world."""
+    return jax.sharding.Mesh(np.array(jax.devices()), (axis_name,))
 
 
 def world_size():

@@ -1,0 +1,200 @@
+"""paddle.distributed.fleet — hybrid-parallel orchestration.
+
+Reference: python/paddle/distributed/fleet/base/ (fleet.init,
+DistributedStrategy, role makers) + meta_parallel/ (HybridCommunicateGroup
+over NCCL groups).
+
+TPU-native: `fleet.init(strategy)` turns the strategy's hybrid_configs
+(dp/mp/pp/sharding degrees) into ONE jax.sharding.Mesh with axes
+('dp','pp','tp') — tp innermost so tensor-parallel collectives ride the
+fastest ICI hops — and installs it as the global mesh. Every "communication
+group" of the reference becomes a mesh axis; distributed_model /
+distributed_optimizer apply the sharding wrappers (DataParallel, ZeRO).
+"""
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from .. import env as _env
+from ..collective import get_rank, get_world_size, new_group
+from . import base  # noqa: F401
+from .base import DistributedStrategy  # noqa: F401
+
+__all__ = ["init", "DistributedStrategy", "distributed_model",
+           "distributed_optimizer", "get_hybrid_communicate_group",
+           "HybridCommunicateGroup", "worker_num", "worker_index",
+           "is_first_worker", "barrier_worker", "stop_worker", "init_worker",
+           "mp_layers"]
+
+_fleet_state = {"strategy": None, "hcg": None, "initialized": False}
+
+
+def init(role_maker=None, is_collective=True, strategy=None, log_level="INFO"):
+    """Build + install the hybrid mesh from strategy.hybrid_configs.
+
+    Reference: fleet/base/fleet_base.py::init — prepares role maker and
+    NCCL communicators per parallel group.
+    """
+    if strategy is None:
+        strategy = DistributedStrategy()
+    hc = strategy.hybrid_configs
+    n = jax.device_count()
+    mp = int(hc.get("mp_degree", 1))
+    pp = int(hc.get("pp_degree", 1))
+    sharding = int(hc.get("sharding_degree", 1))
+    dp = int(hc.get("dp_degree", -1))
+    if dp in (-1, 0):
+        dp = max(1, n // (mp * pp))
+    used = dp * mp * pp
+    if used > n:
+        raise ValueError(
+            f"hybrid degrees dp={dp} x mp={mp} x pp={pp} = {used} exceed "
+            f"device count {n}")
+    devices = np.array(jax.devices()[:used]).reshape(dp, pp, mp)
+    mesh = Mesh(devices, ("dp", "pp", "tp"))
+    _env.set_mesh(mesh)
+    _fleet_state.update(strategy=strategy, initialized=True,
+                        hcg=HybridCommunicateGroup(mesh, sharding))
+    return fleet
+
+
+def get_hybrid_communicate_group():
+    return _fleet_state["hcg"]
+
+
+class HybridCommunicateGroup:
+    """Topology view over the hybrid mesh (reference:
+    fleet/base/topology.py::HybridCommunicateGroup)."""
+
+    def __init__(self, mesh, sharding_degree=1):
+        self._mesh = mesh
+        self._sharding_degree = sharding_degree
+        # rank-0's communicator per axis, built once: correct devices (the
+        # mesh slice along that axis) + explicit axis name so traced
+        # collectives reduce over exactly that axis
+        from ..collective import ProcessGroup
+
+        devs = mesh.devices  # ndarray (dp, pp, tp)
+        self._groups = {
+            "dp": ProcessGroup(list(devs[:, 0, 0]), axes="dp",
+                               ranks=[d.id for d in devs[:, 0, 0]]),
+            "pp": ProcessGroup(list(devs[0, :, 0]), axes="pp",
+                               ranks=[d.id for d in devs[0, :, 0]]),
+            "tp": ProcessGroup(list(devs[0, 0, :]), axes="tp",
+                               ranks=[d.id for d in devs[0, 0, :]]),
+        }
+
+    @property
+    def mesh(self):
+        return self._mesh
+
+    @property
+    def nranks(self):
+        return int(np.prod(list(self._mesh.shape.values())))
+
+    # single-controller: the ambient process sees rank 0 of every axis
+    def get_data_parallel_rank(self):
+        return 0
+
+    def get_model_parallel_rank(self):
+        return 0
+
+    def get_stage_id(self):
+        return 0
+
+    def get_data_parallel_world_size(self):
+        return self._mesh.shape["dp"]
+
+    def get_model_parallel_world_size(self):
+        return self._mesh.shape["tp"]
+
+    def get_pipe_parallel_world_size(self):
+        return self._mesh.shape["pp"]
+
+    def get_sharding_parallel_world_size(self):
+        return self._sharding_degree
+
+    def get_data_parallel_group(self):
+        return self._groups["dp"]
+
+    def get_model_parallel_group(self):
+        return self._groups["tp"]
+
+    def get_pipe_parallel_group(self):
+        return self._groups["pp"]
+
+    def get_model_parallel_group_src_rank(self):
+        return 0
+
+    def topology(self):
+        return dict(self._mesh.shape)
+
+
+def distributed_model(model):
+    """Wrap for the active strategy (reference fleet_base.distributed_model).
+
+    dp>1: DataParallel input sharding. tp/pp weights: the model's own
+    sharding annotations + mp_layers resolve against the installed mesh.
+    """
+    from ..parallel import DataParallel
+
+    mesh = _env.get_mesh()
+    if mesh is not None and "dp" in mesh.axis_names and \
+            mesh.shape["dp"] > 1:
+        return DataParallel(model)
+    return model
+
+
+def distributed_optimizer(optimizer, strategy=None):
+    """Apply the strategy's sharding level to the optimizer state
+    (reference fleet_base.distributed_optimizer)."""
+    strategy = strategy or _fleet_state["strategy"]
+    hcg = _fleet_state["hcg"]
+    if strategy is not None and hcg is not None and \
+            hcg.get_sharding_parallel_world_size() > 1:
+        from ..sharding import group_sharded_parallel
+
+        class _Dummy:
+            def parameters(self):
+                return []
+        group_sharded_parallel(_Dummy(), optimizer, level="os_g")
+    return optimizer
+
+
+def worker_num():
+    return get_world_size()
+
+
+def worker_index():
+    return get_rank()
+
+
+def is_first_worker():
+    return get_rank() == 0
+
+
+def barrier_worker():
+    from ..collective import barrier
+
+    barrier()
+
+
+def init_worker():
+    pass
+
+
+def stop_worker():
+    pass
+
+
+# namespace-style access: fleet.init(...) then fleet.distributed_model(...)
+import sys as _sys
+
+fleet = _sys.modules[__name__]
+
+from .. import mp_layers  # noqa: F401,E402  (fleet.meta_parallel surface)
+from ..mp_layers import (  # noqa: F401,E402
+    ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding,
+)

@@ -1,0 +1,49 @@
+"""DistributedStrategy (reference: python/paddle/distributed/fleet/base/
+distributed_strategy.py — a protobuf-backed bag of strategy knobs).
+
+TPU-native: a plain attribute bag; the knobs that map onto XLA behavior
+(hybrid degrees, amp, recompute, gradient merge) are honored by fleet.init /
+distributed_model / the hapi engine, the rest are accepted for parity.
+"""
+from __future__ import annotations
+
+__all__ = ["DistributedStrategy"]
+
+
+class DistributedStrategy:
+    def __init__(self):
+        self.hybrid_configs = {
+            "dp_degree": -1,
+            "mp_degree": 1,
+            "pp_degree": 1,
+            "sharding_degree": 1,
+        }
+        self.amp = False
+        self.amp_configs = {"init_loss_scaling": 65536.0, "use_pure_fp16":
+                            False, "custom_white_list": [],
+                            "custom_black_list": []}
+        self.recompute = False
+        self.recompute_configs = {"checkpoints": []}
+        self.sharding = False
+        self.sharding_configs = {}
+        self.gradient_merge = False
+        self.gradient_merge_configs = {"k_steps": 1, "avg": True}
+        self.pipeline = False
+        self.pipeline_configs = {"accumulate_steps": 1,
+                                 "micro_batch_size": 1}
+        self.tensor_parallel = False
+        self.tensor_parallel_configs = {"tensor_parallel_degree": 1}
+        self.lamb = False
+        self.lars = False
+        self.dgc = False
+        self.localsgd = False
+        self.fp16_allreduce = False
+        self.find_unused_parameters = False
+        self.fuse_grad_size_in_MB = 32
+        self.nccl_comm_num = 1
+
+    def __repr__(self):
+        lines = ["DistributedStrategy:"]
+        for k, v in sorted(self.__dict__.items()):
+            lines.append(f"  {k}: {v}")
+        return "\n".join(lines)

@@ -1,0 +1,159 @@
+"""Mixture-of-Experts with expert parallelism.
+
+Reference: python/paddle/incubate/distributed/models/moe/moe_layer.py —
+top-k gating + a hand-rolled all_to_all dispatch of token buffers to expert
+ranks (grad_clip'd gate, capacity dropping).
+
+TPU-native (GShard recipe): dispatch/combine are dense einsums against a
+[tokens, experts, capacity] one-hot tensor; expert weights are stacked on a
+leading E axis sharded over the 'ep' mesh axis, and GSPMD turns the
+dispatch einsum into the all_to_all over ICI. Capacity-dropping keeps
+shapes static for XLA. Math (including the auxiliary load-balancing loss)
+is tested against a per-token loop reference.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.autograd import apply
+from ..core.tensor import Tensor
+from ..nn.layer.layers import Layer
+from ..nn import initializer as I
+from . import env as _env
+from .shard_utils import constrain_value
+
+__all__ = ["MoELayer", "top_k_gating", "moe_forward"]
+
+
+def top_k_gating(logits, top_k, capacity):
+    """GShard top-k gating with capacity. logits [T, E] ->
+    (combine [T, E, C], dispatch [T, E, C] bool, aux_loss)."""
+    T, E = logits.shape
+    probs = jax.nn.softmax(logits, axis=-1)
+
+    gate_weights = []
+    masks = []
+    remaining = probs
+    for _ in range(top_k):
+        idx = jnp.argmax(remaining, axis=-1)              # [T]
+        onehot = jax.nn.one_hot(idx, E, dtype=probs.dtype)
+        gate_weights.append((remaining * onehot).sum(-1))  # [T]
+        masks.append(onehot)
+        remaining = remaining * (1.0 - onehot)
+
+    # aux load-balancing loss (Switch/GShard): E * sum_e fraction_e * prob_e
+    me = probs.mean(axis=0)                               # [E]
+    ce = masks[0].mean(axis=0)                            # [E]
+    aux_loss = (me * ce).sum() * E
+
+    combine = jnp.zeros((T, E, capacity), probs.dtype)
+    dispatch = jnp.zeros((T, E, capacity), bool)
+    # running per-expert fill across the k choices (priority: k then token)
+    fill = jnp.zeros((E,), jnp.int32)
+    for k in range(top_k):
+        onehot = masks[k]                                 # [T, E]
+        pos_in_e = jnp.cumsum(onehot, axis=0) - 1.0 + fill[None, :]
+        pos = (pos_in_e * onehot).sum(-1).astype(jnp.int32)  # [T]
+        within = pos < capacity
+        w = gate_weights[k] * within                      # drop overflow
+        oh_pos = jax.nn.one_hot(pos, capacity, dtype=probs.dtype)  # [T, C]
+        combine = combine + w[:, None, None] * onehot[:, :, None] * \
+            oh_pos[:, None, :]
+        dispatch = dispatch | (combine > 0.0)
+        fill = fill + onehot.sum(0).astype(jnp.int32)
+    return combine, dispatch, aux_loss
+
+
+def moe_forward(x2d, gate_w, expert_fn, expert_params, top_k,
+                capacity_factor, ep_axis=None):
+    """x2d [T, d] -> ([T, d], aux_loss). expert_params leaves: [E, ...]."""
+    T, d = x2d.shape
+    E = gate_w.shape[-1]
+    capacity = max(1, math.ceil(T * capacity_factor * top_k / E))
+    logits = x2d @ gate_w                                  # [T, E]
+    combine, dispatch, aux = top_k_gating(logits, top_k, capacity)
+    # dispatch: [E, C, d] expert input buffers (GSPMD: all_to_all over ep)
+    expert_in = jnp.einsum("tec,td->ecd", dispatch.astype(x2d.dtype), x2d)
+    if ep_axis:
+        expert_in = constrain_value(expert_in, ep_axis, None, None)
+    expert_out = jax.vmap(expert_fn)(expert_params, expert_in)  # [E, C, d]
+    if ep_axis:
+        expert_out = constrain_value(expert_out, ep_axis, None, None)
+    y = jnp.einsum("tec,ecd->td", combine, expert_out)
+    return y, aux
+
+
+class MoELayer(Layer):
+    """Top-k gated expert MLPs (reference MoELayer API).
+
+    Expert weights are one stacked parameter per matrix ([E, ...]), placed
+    over the 'ep' axis when a mesh is installed.
+    """
+
+    def __init__(self, d_model, d_hidden, num_experts, top_k=2,
+                 capacity_factor=1.5, gate=None, group=None, name=None):
+        super().__init__()
+        self.d_model = d_model
+        self.d_hidden = d_hidden
+        self.num_experts = num_experts
+        self.top_k = top_k
+        self.capacity_factor = capacity_factor
+        init = I.XavierNormal()
+        self.gate_weight = self.create_parameter(
+            [d_model, num_experts],
+            default_initializer=I.Normal(0.0, 0.02))
+        self.w1 = self.create_parameter([num_experts, d_model, d_hidden],
+                                        default_initializer=init)
+        self.b1 = self.create_parameter([num_experts, d_hidden],
+                                        is_bias=True)
+        self.w2 = self.create_parameter([num_experts, d_hidden, d_model],
+                                        default_initializer=init)
+        self.b2 = self.create_parameter([num_experts, d_model], is_bias=True)
+        self._shard_experts()
+        self.aux_loss = None
+
+    def _shard_experts(self):
+        mesh = _env.get_mesh()
+        ax = None
+        if mesh is not None:
+            for cand in ("ep", "tp", "mp"):
+                if cand in mesh.axis_names:
+                    ax = cand
+                    break
+        self._ep_axis = ax
+        if ax is None:
+            return
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        for p in (self.w1, self.b1, self.w2, self.b2):
+            spec = (ax,) + (None,) * (p._value.ndim - 1)
+            try:
+                p._value = jax.device_put(
+                    p._value, NamedSharding(mesh, P(*spec)))
+            except ValueError:
+                pass
+
+    def forward(self, x):
+        shape = x.shape
+        top_k, cf, ep = self.top_k, self.capacity_factor, self._ep_axis
+
+        def _f(xv, gw, w1, b1, w2, b2):
+            x2d = xv.reshape(-1, xv.shape[-1])
+
+            def expert_fn(params, h):
+                pw1, pb1, pw2, pb2 = params
+                return jnp.tanh(h @ pw1 + pb1) @ pw2 + pb2
+
+            y, aux = moe_forward(x2d, gw, expert_fn, (w1, b1, w2, b2),
+                                 top_k, cf, ep_axis=ep)
+            return y.reshape(xv.shape), aux
+
+        _f.__name__ = "moe"
+        out, aux = apply(_f, x, self.gate_weight, self.w1, self.b1,
+                         self.w2, self.b2)
+        self.aux_loss = aux
+        return out

@@ -1,0 +1,142 @@
+"""init_parallel_env + DataParallel.
+
+Reference: python/paddle/distributed/parallel.py (init_parallel_env) and
+python/paddle/fluid/dygraph/parallel.py:413 (DataParallel — per-parameter
+grad allreduce over NCCL rings, comm-buffer coalescing, no_sync).
+
+TPU-native: data parallelism is a *sharding*, not a wrapper protocol. The
+global batch is sharded over the 'dp' mesh axis, parameters stay replicated,
+and XLA GSPMD inserts one fused gradient all-reduce over ICI during the
+backward of the (jitted or eager) step — the compiler does the coalescing
+the reference hand-rolls with comm buffers. DataParallel therefore only
+(1) ensures a mesh exists, (2) constrains inputs onto the dp axis, and
+(3) keeps the reference API (scale_loss, no_sync, state_dict passthrough).
+"""
+from __future__ import annotations
+
+import contextlib
+
+import jax
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..core.tensor import Tensor
+from ..nn.layer.layers import Layer
+from . import env as _env
+from .collective import _get_default_group, get_rank, get_world_size
+
+__all__ = ["init_parallel_env", "ParallelEnv", "DataParallel"]
+
+
+class ParallelEnv:
+    """Reference: python/paddle/fluid/dygraph/parallel.py ParallelEnv."""
+
+    @property
+    def rank(self):
+        return _env.rank()
+
+    @property
+    def local_rank(self):
+        return _env.rank()
+
+    @property
+    def world_size(self):
+        return _env.world_size()
+
+    @property
+    def nranks(self):
+        return _env.world_size()
+
+    @property
+    def device_id(self):
+        return 0
+
+    @property
+    def dev_id(self):
+        return 0
+
+    @property
+    def current_endpoint(self):
+        return "127.0.0.1:0"
+
+    @property
+    def trainer_endpoints(self):
+        return ["127.0.0.1:0"]
+
+
+def init_parallel_env():
+    """Bring up the data-parallel world: installs a 1-D 'dp' mesh over all
+    devices (if no mesh is installed yet) and creates the default group.
+
+    Reference: python/paddle/distributed/parallel.py init_parallel_env —
+    which spawns NCCL communicators; here the mesh IS the communicator.
+    """
+    if _env.get_mesh() is None:
+        _env.set_mesh(_env.world_mesh("dp"))
+    _get_default_group()
+    return ParallelEnv()
+
+
+def _dp_sharding(mesh, ndim):
+    spec = P(*(("dp",) + (None,) * (ndim - 1)))
+    return NamedSharding(mesh, spec)
+
+
+class DataParallel(Layer):
+    """Wraps a Layer for data-parallel training over the 'dp' mesh axis.
+
+    Inputs' leading (batch) dim is sharded over 'dp'; parameters remain
+    replicated; gradient synchronization is XLA's all-reduce, inserted
+    automatically — so `no_sync` is semantically a no-op (grads over the
+    global batch are always consistent) and is kept for API parity with
+    gradient-accumulation loops.
+    """
+
+    def __init__(self, layers, strategy=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1, find_unused_parameters=False,
+                 group=None):
+        super().__init__()
+        self._layers = layers
+        self._group = group
+        mesh = _env.get_mesh()
+        if mesh is None or "dp" not in mesh.axis_names:
+            init_parallel_env()
+
+    def forward(self, *inputs, **kwargs):
+        mesh = _env.get_mesh()
+        if mesh is not None and "dp" in mesh.axis_names \
+                and mesh.shape["dp"] > 1:
+            inputs = tuple(self._shard_input(x, mesh) for x in inputs)
+            kwargs = {k: self._shard_input(v, mesh)
+                      for k, v in kwargs.items()}
+        return self._layers(*inputs, **kwargs)
+
+    def _shard_input(self, x, mesh):
+        if not isinstance(x, Tensor):
+            return x
+        v = x._value
+        if isinstance(v, jax.core.Tracer):
+            from .shard_utils import annotate
+
+            return annotate(x, "dp", *([None] * (v.ndim - 1)))
+        if v.ndim == 0 or v.shape[0] % mesh.shape["dp"] != 0:
+            return x
+        x._value = jax.device_put(v, _dp_sharding(mesh, v.ndim))
+        return x
+
+    def scale_loss(self, loss):
+        """Reference scales loss by 1/nranks before backward when grads are
+        summed; XLA's mean-over-global-batch already averages, so this is
+        identity (kept for API parity)."""
+        return loss
+
+    @contextlib.contextmanager
+    def no_sync(self):
+        yield
+
+    # passthrough surface
+    def state_dict(self, *a, **kw):
+        return self._layers.state_dict(*a, **kw)
+
+    def set_state_dict(self, sd, *a, **kw):
+        return self._layers.set_state_dict(sd, *a, **kw)

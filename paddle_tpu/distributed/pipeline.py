@@ -1,0 +1,219 @@
+"""Pipeline parallelism — GPipe microbatch schedule over the 'pp' mesh axis.
+
+Reference: python/paddle/distributed/fleet/meta_parallel/pipeline_parallel.py
+:30 (PipelineParallel, 1F1B at :170) + pp_layers/PipelineLayer — explicit
+p2p send/recv of activations between stage processes, hand-scheduled
+forward/backward interleaving.
+
+TPU-native: the schedule is ONE jitted SPMD program. Stage parameters are
+stacked on a leading axis sharded over 'pp' (each device holds its stage),
+activations rotate between neighbor devices with `lax.ppermute` (XLA
+collective-permute rides ICI), and the M+S-1 pipeline ticks run under
+`lax.scan`. Backward is jax.grad through the scan — XLA schedules it as the
+reverse pipeline (1F1B-style overlap falls out of compiler scheduling of
+the unrolled collective-permute DAG, rather than a hand-written
+interleaving).
+
+The homogeneous-trunk contract: stage_fn(stage_params, h) -> h with a fixed
+activation shape — embedding/head live outside the pipeline (standard JAX
+pipelining practice; the reference's PipelineLayer segments an nn.Sequential
+the same way for its transformer trunk).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..nn.layer.layers import Layer
+from . import env as _env
+
+__all__ = ["pipeline_forward", "microbatch", "unmicrobatch", "PipelineLayer",
+           "LayerDesc", "stack_stage_params"]
+
+
+def microbatch(x, num_micro):
+    """[B, ...] -> [M, B//M, ...]"""
+    b = x.shape[0]
+    if b % num_micro != 0:
+        raise ValueError(f"batch {b} not divisible by num_micro {num_micro}")
+    return x.reshape((num_micro, b // num_micro) + x.shape[1:])
+
+
+def unmicrobatch(x):
+    return x.reshape((x.shape[0] * x.shape[1],) + x.shape[2:])
+
+
+def stack_stage_params(stage_trees):
+    """List of per-stage parameter pytrees (same structure) -> one pytree
+    stacked on a leading stage axis."""
+    return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *stage_trees)
+
+
+def pipeline_forward(stage_fn, stacked_params, mb_inputs, mesh=None,
+                     axis="pp"):
+    """Run the GPipe schedule: mb_inputs [M, mb, ...] through S stages.
+
+    stacked_params: pytree, leading axis = S (sharded over `axis`).
+    Returns [M, mb, ...] last-stage outputs (replicated).
+    Differentiable; jit-compatible (call under jit for the real path).
+
+    On a hybrid mesh (dp/tp axes besides pp) the shard_map is manual over
+    `axis` only — GSPMD keeps auto-sharding the dp/tp dims of activations
+    and stage params inside each pipeline stage.
+    """
+    mesh = mesh or _env.get_mesh()
+    if mesh is None:
+        raise RuntimeError("pipeline_forward needs a mesh with a 'pp' axis")
+    S = mesh.shape[axis]
+    M = mb_inputs.shape[0]
+    manual = {axis} if len(mesh.axis_names) > 1 else frozenset()
+
+    def block(params, mbs):
+        # params leaves: [1, ...] (this rank's stage); mbs: [M, mb, ...]
+        p_local = jax.tree_util.tree_map(lambda v: v[0], params)
+        s = jax.lax.axis_index(axis)
+        h0 = jnp.zeros_like(mbs[0])
+        outs0 = jnp.zeros_like(mbs)
+
+        def tick(carry, t):
+            h_recv, outs = carry
+            # stage 0 injects microbatch t; others use the received act
+            mb_idx = jnp.clip(t, 0, M - 1)
+            x_in = jnp.where(s == 0,
+                             jax.lax.dynamic_index_in_dim(
+                                 mbs, mb_idx, 0, keepdims=False),
+                             h_recv)
+            y = stage_fn(p_local, x_in)
+            # last stage writes finished microbatch m = t - (S-1)
+            m = t - (S - 1)
+            valid = jnp.logical_and(s == S - 1,
+                                    jnp.logical_and(m >= 0, m < M))
+            outs = jax.lax.cond(
+                valid,
+                lambda o: jax.lax.dynamic_update_index_in_dim(
+                    o, y, jnp.clip(m, 0, M - 1), 0),
+                lambda o: o, outs)
+            # rotate activations one stage forward
+            h_next = jax.lax.ppermute(
+                y, axis, [(i, i + 1) for i in range(S - 1)])
+            return (h_next, outs), None
+
+        (_, outs), _ = jax.lax.scan(tick, (h0, outs0),
+                                    jnp.arange(M + S - 1))
+        # broadcast last stage's buffer to every rank
+        outs = jax.lax.psum(
+            jnp.where(s == S - 1, outs, jnp.zeros_like(outs)), axis)
+        return outs
+
+    in_specs = (jax.tree_util.tree_map(lambda _: P(axis), stacked_params),
+                P(*([None] * mb_inputs.ndim)))
+    kw = {"axis_names": manual} if manual else {}
+    fn = shard_map(block, mesh=mesh, in_specs=in_specs,
+                   out_specs=P(*([None] * mb_inputs.ndim)), check_vma=False,
+                   **kw)
+    return fn(stacked_params, mb_inputs)
+
+
+class LayerDesc:
+    """Deferred layer construction (reference pp_layers.LayerDesc)."""
+
+    def __init__(self, layer_cls, *args, **kwargs):
+        self.layer_cls = layer_cls
+        self.args = args
+        self.kwargs = kwargs
+
+    def build_layer(self):
+        return self.layer_cls(*self.args, **self.kwargs)
+
+
+class PipelineLayer(Layer):
+    """Segments a layer list into pipeline stages (reference
+    pp_layers.PipelineLayer).
+
+    forward() runs the stages sequentially — correct everywhere, and under
+    a mesh each stage's parameters are placed on its 'pp' slice. The
+    jitted schedule for homogeneous trunks is `pipeline_forward`; use
+    `trunk_stage_fn()` + `stacked_trunk_params()` to drive it.
+    """
+
+    def __init__(self, layers=None, num_stages=None, topology=None,
+                 loss_fn=None, seg_method="uniform", recompute_interval=0,
+                 num_virtual_pipeline_stages=None):
+        super().__init__()
+        descs = list(layers or [])
+        built = [d.build_layer() if isinstance(d, LayerDesc) else d
+                 for d in descs]
+        mesh = _env.get_mesh()
+        if num_stages is None:
+            num_stages = mesh.shape["pp"] if mesh is not None and \
+                "pp" in mesh.axis_names else 1
+        self._num_stages = num_stages
+        self._loss_fn = loss_fn
+        from ..nn.layer.container import LayerList
+
+        self.funcs = LayerList(built)
+        # uniform segmentation: stage boundaries over the layer list
+        n = len(built)
+        bounds = [round(i * n / num_stages) for i in range(num_stages + 1)]
+        self._segments = [list(range(bounds[i], bounds[i + 1]))
+                          for i in range(num_stages)]
+
+    @property
+    def num_stages(self):
+        return self._num_stages
+
+    def get_stage_layers(self, stage):
+        return [self.funcs[i] for i in self._segments[stage]]
+
+    def forward(self, x):
+        for layer in self.funcs:
+            x = layer(x)
+        return x
+
+    # -- jitted-schedule bridge (homogeneous trunks) ----------------------
+    def _stage_param_tree(self, stage):
+        tree = {}
+        for j, layer in enumerate(self.get_stage_layers(stage)):
+            for name, p in layer.named_parameters():
+                tree[f"{j}.{name}"] = p._value
+        return tree
+
+    def stacked_trunk_params(self):
+        """Per-stage parameter trees stacked on a leading stage axis —
+        the `stacked_params` input of pipeline_forward. Requires every
+        stage to have the same layer architecture."""
+        trees = [self._stage_param_tree(s) for s in range(self._num_stages)]
+        keys = set(trees[0])
+        for s, t in enumerate(trees[1:], 1):
+            if set(t) != keys or any(t[k].shape != trees[0][k].shape
+                                     for k in keys):
+                raise ValueError(
+                    f"stage {s} differs from stage 0 in structure/shapes — "
+                    "the jitted pipeline schedule needs a homogeneous trunk "
+                    "(keep embedding/head outside the PipelineLayer)")
+        return stack_stage_params(trees)
+
+    def trunk_stage_fn(self):
+        """stage_fn(params_tree, h) for pipeline_forward: applies one
+        stage's layers with parameters swapped in (stage-0 architecture,
+        any stage's weights)."""
+        from ..core.tensor import Tensor
+
+        layers = self.get_stage_layers(0)
+
+        def stage_fn(params, h):
+            x = Tensor(h)
+            for j, layer in enumerate(layers):
+                prefix = f"{j}."
+                sub = {k[len(prefix):]: Tensor(v)
+                       for k, v in params.items() if k.startswith(prefix)}
+                out, _ = layer.functional_call(sub, x)
+                x = out if not isinstance(out, (list, tuple)) else out[0]
+            return x._value
+
+        return stage_fn

@@ -1,0 +1,87 @@
+"""Sequence/context parallelism — long-context training over the 'sp' axis.
+
+Reference: fleet's sequence-parallel utils (ScatterOp/GatherOp splitting
+activations on the sequence dim across the mp group) — here generalized to
+context parallelism with exact ring attention.
+
+TPU-native: activations are sharded on the sequence dim via sharding
+constraints (GSPMD moves them); attention over the full sequence runs as
+ring attention (ops/pallas/ring_attention.py) inside shard_map, rotating
+k/v over ICI. `sequence_parallel_attention` is the drop-in attention for
+sp-sharded [b, h, s, d] tensors.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+from ..core.autograd import apply
+from ..core.tensor import Tensor
+from ..ops.pallas.ring_attention import ring_attention_local
+from . import env as _env
+from .shard_utils import annotate
+
+__all__ = ["split_sequence", "gather_sequence",
+           "sequence_parallel_attention", "ring_attention"]
+
+
+def _sp_axis(mesh):
+    for a in ("sp", "tp", "mp"):
+        if a in mesh.axis_names:
+            return a
+    return None
+
+
+def split_sequence(x, seq_dim=1):
+    """Constrain activation sharding: sequence dim over 'sp' (reference
+    ScatterOp — GSPMD inserts the scatter)."""
+    spec = [None] * len(x.shape)
+    spec[seq_dim] = "sp"
+    return annotate(x, *spec)
+
+
+def gather_sequence(x, seq_dim=1):
+    """Replicate the sequence dim again (reference GatherOp)."""
+    return annotate(x, *([None] * len(x.shape)))
+
+
+def ring_attention(q, k, v, mesh=None, axis=None, causal=False,
+                   sm_scale=None):
+    """Exact attention for [b, h, s, d] with s sharded over the sp ring.
+
+    Accepts Tensors or arrays; runs the shard_map ring schedule over
+    `mesh` (default: the installed global mesh).
+    """
+    mesh = mesh or _env.get_mesh()
+    if mesh is None:
+        raise RuntimeError("ring_attention needs a mesh with an sp/tp axis")
+    ax = axis or _sp_axis(mesh)
+    spec = P(None, None, ax, None)
+
+    def _ring(qv, kv, vv):
+        fn = shard_map(
+            lambda a, b, c: ring_attention_local(
+                a, b, c, axis=ax, causal=causal, sm_scale=sm_scale),
+            mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+            check_vma=False)
+        return fn(qv, kv, vv)
+
+    _ring.__name__ = "ring_attention"
+    if isinstance(q, Tensor):
+        return apply(_ring, q, k, v)
+    return _ring(q, k, v)
+
+
+def sequence_parallel_attention(q, k, v, causal=False):
+    """Attention for sp-sharded inputs: ring attention when a mesh with an
+    sp axis is installed, plain attention otherwise."""
+    mesh = _env.get_mesh()
+    if mesh is not None and _sp_axis(mesh) is not None and \
+            mesh.shape[_sp_axis(mesh)] > 1:
+        return ring_attention(q, k, v, mesh=mesh, causal=causal)
+    from ..nn.functional.attention import _attention_core
+
+    out, _ = _attention_core(q, k, v, None, 0.0, is_causal=causal)
+    return out

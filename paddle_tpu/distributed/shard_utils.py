@@ -13,16 +13,11 @@ from jax.sharding import NamedSharding, PartitionSpec
 from ..core.tensor import Tensor
 from .env import get_mesh
 
-__all__ = ["annotate", "PartitionSpec"]
+__all__ = ["annotate", "constrain_value", "PartitionSpec"]
 
 
-def annotate(x, *spec):
-    """Attach a sharding constraint over mesh axes (names not present on the
-    current mesh degrade to None => replicated along that dim)."""
-    mesh = get_mesh()
-    if mesh is None:
-        return x
-    names = mesh.axis_names
+def _clean_spec(spec, names):
+    """Drop axis names not present on the mesh (degrade to replicated)."""
     clean = []
     for s in spec:
         if s is None or s in names:
@@ -32,7 +27,26 @@ def annotate(x, *spec):
             clean.append(keep if keep else None)
         else:
             clean.append(None)
-    p = PartitionSpec(*clean)
+    return PartitionSpec(*clean)
+
+
+def constrain_value(v, *spec):
+    """with_sharding_constraint on a raw traced array (no-op when no mesh
+    is installed or the value is concrete)."""
+    mesh = get_mesh()
+    if mesh is None or not isinstance(v, jax.core.Tracer):
+        return v
+    p = _clean_spec(spec, mesh.axis_names)
+    return jax.lax.with_sharding_constraint(v, NamedSharding(mesh, p))
+
+
+def annotate(x, *spec):
+    """Attach a sharding constraint over mesh axes (names not present on the
+    current mesh degrade to None => replicated along that dim)."""
+    mesh = get_mesh()
+    if mesh is None:
+        return x
+    p = _clean_spec(spec, mesh.axis_names)
 
     def _c(v):
         if isinstance(v, jax.core.Tracer):

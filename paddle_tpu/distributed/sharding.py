@@ -1,0 +1,133 @@
+"""ZeRO sharding (stage 1/2/3).
+
+Reference: python/paddle/distributed/fleet/meta_parallel/sharding/
+sharding_stage2.py / sharding_stage3.py and the
+python/paddle/distributed/sharding/group_sharded.py `group_sharded_parallel`
+API — per-rank parameter/grad/opt-state partitions with hand-scheduled
+broadcast/reduce ops.
+
+TPU-native: ZeRO *is a sharding*. Optimizer state (stage 1), gradients
+(stage 2) and parameters (stage 3) are placed with NamedShardings over the
+'dp' mesh axis; XLA GSPMD schedules the all-gather (param use) and
+reduce-scatter (grad update) that the reference hand-rolls. The jitted
+train step keeps the placements via donated buffers, so per-device HBM
+holds 1/dp of the sharded state — the memory saving is real, and the
+communication schedule is the compiler's (overlapped with compute).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..core.tensor import Tensor
+from . import env as _env
+
+__all__ = ["group_sharded_parallel", "shard_params_and_opt", "zero_sharding",
+           "save_group_sharded_model"]
+
+
+def zero_sharding(shape, mesh, axis="dp"):
+    """NamedSharding partitioning the largest divisible dim over `axis`
+    (replicated when nothing divides — small scalars stay replicated)."""
+    n = mesh.shape[axis]
+    best = None
+    for i, s in enumerate(shape):
+        if s % n == 0 and (best is None or s > shape[best]):
+            best = i
+    spec = [None] * len(shape)
+    if best is not None:
+        spec[best] = axis
+    return NamedSharding(mesh, P(*spec))
+
+
+def shard_params_and_opt(tree, mesh=None, axis="dp"):
+    """device_put every array leaf of `tree` with its ZeRO sharding."""
+    mesh = mesh or _env.get_mesh()
+    if mesh is None or axis not in mesh.axis_names:
+        return tree
+
+    def _place(v):
+        if isinstance(v, Tensor):
+            v._value = jax.device_put(
+                v._value, zero_sharding(v._value.shape, mesh, axis))
+            return v
+        if hasattr(v, "shape"):
+            return jax.device_put(v, zero_sharding(v.shape, mesh, axis))
+        return v
+
+    return jax.tree_util.tree_map(_place, tree)
+
+
+def group_sharded_parallel(model, optimizer, level="os_g", scaler=None,
+                           group=None, offload=False, sync_buffers=False,
+                           buffer_max_size=2 ** 23, segment_size=2 ** 20,
+                           sync_comm=False):
+    """Reference API (python/paddle/distributed/sharding/group_sharded.py):
+    level 'os' = stage1 (optimizer state sharded), 'os_g' = stage2
+    (+gradient shards), 'p_g_os' = stage3 (+parameter shards).
+
+    Stage 2's gradient sharding has no eager buffer here: gradients exist
+    only inside the jitted step, where GSPMD reduce-scatters them straight
+    into the sharded optimizer update — same memory/communication shape,
+    compiler-scheduled.
+    """
+    if level not in ("os", "os_g", "p_g_os"):
+        raise ValueError(f"level must be os|os_g|p_g_os, got {level!r}")
+    mesh = _env.get_mesh()
+    if mesh is None:
+        from .parallel import init_parallel_env
+
+        init_parallel_env()
+        mesh = _env.get_mesh()
+    axis = "dp" if "dp" in mesh.axis_names else mesh.axis_names[0]
+
+    if level == "p_g_os":
+        for p in model.parameters():
+            p._value = jax.device_put(
+                p._value, zero_sharding(p._value.shape, mesh, axis))
+
+    # wrap the optimizer's state factories so every state buffer lands
+    # dp-sharded; the jitted step (donated args) keeps the placement
+    orig_functional = optimizer.functional_init_states
+
+    def sharded_init_states(values_tree):
+        states = orig_functional(values_tree)
+        return jax.tree_util.tree_map(
+            lambda v: jax.device_put(
+                v, zero_sharding(v.shape, mesh, axis))
+            if hasattr(v, "shape") and getattr(v, "ndim", 0) > 0 else v,
+            states)
+
+    optimizer.functional_init_states = sharded_init_states
+
+    orig_init_state = optimizer._init_state
+
+    def sharded_init_state(p):
+        st = orig_init_state(p)
+        out = {}
+        for k, v in st.items():
+            if hasattr(v, "shape") and getattr(v, "ndim", 0) > 0:
+                out[k] = jax.device_put(
+                    v, zero_sharding(v.shape, mesh, axis))
+            else:
+                out[k] = v
+        return out
+
+    optimizer._init_state = sharded_init_state
+
+    if scaler is not None:
+        return model, optimizer, scaler
+    return model, optimizer
+
+
+def save_group_sharded_model(model, output, optimizer=None):
+    """Reference: gathers shards and saves on rank 0. Single-controller
+    arrays are already global — plain save."""
+    from ..framework.io import save
+
+    save(model.state_dict(), output + ".pdparams" if not
+         output.endswith(".pdparams") else output)
+    if optimizer is not None:
+        save(optimizer.state_dict(), output + ".pdopt")

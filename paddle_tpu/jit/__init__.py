@@ -192,7 +192,9 @@ def save(layer, path, input_spec=None, **configs):
     with open(path + ".pdmodel", "wb") as f:
         f.write(blob)
     _psave({k: Tensor(v) for k, v in params.items()}, path + ".pdiparams")
-    meta = {"in_shapes": [(list(s.shape), str(s.dtype)) for s in structs]}
+    meta = {"in_shapes": [([int(d) if isinstance(d, int) else str(d)
+                            for d in s.shape], str(s.dtype))
+                          for s in structs]}
     with open(path + ".pdmodel.meta", "wb") as f:
         pickle.dump(meta, f)
 

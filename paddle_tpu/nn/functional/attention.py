@@ -28,9 +28,10 @@ def _use_flash(q_shape, head_dim, mask, dropout):
         return False
     if jax.default_backend() != "tpu":
         return False
-    # pallas kernel wants seq multiple of block and head_dim multiple of 128
+    # pallas kernel wants seq a multiple of the 128 block and a lane-aligned
+    # head_dim (64 covers BERT/GPT heads; Mosaic tiles minor dims of 64)
     b, h, s, d = q_shape
-    return s >= 256 and s % 128 == 0 and d % 128 == 0 and mask in (
+    return s >= 128 and s % 128 == 0 and d % 64 == 0 and mask in (
         None, "causal")
 
 

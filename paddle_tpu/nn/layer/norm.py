@@ -90,9 +90,9 @@ class SyncBatchNorm(_BatchNormBase):
     fused into the step."""
 
     def forward(self, x):
-        from ...distributed.env import current_mesh_axes
+        from ...distributed.env import bound_axes
 
-        axis = "dp" if "dp" in current_mesh_axes() else None
+        axis = "dp" if "dp" in bound_axes() else None
         if axis is None or not self.training:
             return super().forward(x)
         mean_t, var_t = self._mean, self._variance

@@ -1,0 +1,229 @@
+"""paddle.profiler.
+
+Reference: python/paddle/profiler/profiler.py:33 — Profiler with
+ProfilerTarget/ProfilerState, make_scheduler, RecordEvent annotations,
+chrome-trace export.
+
+TPU-native: wraps jax.profiler — traces carry XLA device timelines
+(per-op HBM/MXU activity) viewable in TensorBoard/Perfetto, strictly more
+detail than the reference's chrome trace. RecordEvent lowers to
+jax.profiler.TraceAnnotation so user spans land on the same timeline.
+"""
+from __future__ import annotations
+
+import enum
+import os
+import time
+import warnings
+
+import jax
+
+__all__ = ["Profiler", "ProfilerTarget", "ProfilerState", "RecordEvent",
+           "make_scheduler", "export_chrome_tracing", "load_profiler_result",
+           "SummaryView"]
+
+
+class ProfilerTarget(enum.Enum):
+    CPU = 0
+    GPU = 1
+    TPU = 2
+
+
+class ProfilerState(enum.Enum):
+    CLOSED = 0
+    READY = 1
+    RECORD = 2
+    RECORD_AND_RETURN = 3
+
+
+class SummaryView(enum.Enum):
+    DeviceView = 0
+    OverView = 1
+    ModelView = 2
+    DistributedView = 3
+    KernelView = 4
+    OperatorView = 5
+    MemoryView = 6
+
+
+def make_scheduler(closed=0, ready=0, record=1, repeat=0, skip_first=0):
+    """Step-state scheduler (reference make_scheduler signature)."""
+    cycle = closed + ready + record
+
+    def scheduler(step):
+        if step < skip_first:
+            return ProfilerState.CLOSED
+        s = step - skip_first
+        if repeat and s >= repeat * cycle:
+            return ProfilerState.CLOSED
+        pos = s % cycle if cycle else 0
+        if pos < closed:
+            return ProfilerState.CLOSED
+        if pos < closed + ready:
+            return ProfilerState.READY
+        if pos == cycle - 1:
+            return ProfilerState.RECORD_AND_RETURN
+        return ProfilerState.RECORD
+
+    return scheduler
+
+
+class RecordEvent:
+    """User span on the profiler timeline (reference RecordEvent)."""
+
+    def __init__(self, name, event_type=None):
+        self.name = name
+        self._ann = None
+        self.begin_time = None
+        self.end_time = None
+
+    def begin(self):
+        self.begin_time = time.time()
+        try:
+            self._ann = jax.profiler.TraceAnnotation(self.name)
+            self._ann.__enter__()
+        except Exception:  # profiling unavailable on this backend
+            self._ann = None
+
+    def end(self):
+        self.end_time = time.time()
+        if self._ann is not None:
+            self._ann.__exit__(None, None, None)
+            self._ann = None
+
+    def __enter__(self):
+        self.begin()
+        return self
+
+    def __exit__(self, *exc):
+        self.end()
+        return False
+
+
+class Profiler:
+    """paddle.profiler.Profiler over jax.profiler traces.
+
+    on_trace_ready receives the profiler after each RECORD_AND_RETURN step;
+    the trace directory holds the TensorBoard/Perfetto artifacts.
+    """
+
+    def __init__(self, targets=None, scheduler=None, on_trace_ready=None,
+                 timer_only=False, record_shapes=False, profile_memory=False,
+                 with_flops=False):
+        if callable(scheduler):
+            self._scheduler = scheduler
+        elif isinstance(scheduler, (tuple, list)) and len(scheduler) == 2:
+            lo, hi = scheduler
+            self._scheduler = make_scheduler(closed=lo, ready=0,
+                                             record=hi - lo, repeat=1)
+        else:
+            self._scheduler = None  # record from start() to stop()
+        self._on_trace_ready = on_trace_ready
+        self._timer_only = timer_only
+        self._dir = None
+        self._recording = False
+        self._step = 0
+        self._step_times = []
+        self._last_step_t = None
+        self.current_state = ProfilerState.CLOSED
+
+    # -- trace control -----------------------------------------------------
+    def _trace_dir(self):
+        if self._dir is None:
+            self._dir = os.path.join(
+                os.environ.get("PADDLE_PROFILER_DIR", "profiler_log"),
+                time.strftime("%Y%m%d_%H%M%S"))
+            os.makedirs(self._dir, exist_ok=True)
+        return self._dir
+
+    def _start_trace(self):
+        if self._recording or self._timer_only:
+            return
+        try:
+            jax.profiler.start_trace(self._trace_dir())
+            self._recording = True
+        except Exception as e:  # noqa: BLE001 — backend without profiling
+            warnings.warn(f"jax.profiler trace unavailable: {e}")
+
+    def _stop_trace(self):
+        if not self._recording:
+            return
+        try:
+            jax.profiler.stop_trace()
+        finally:
+            self._recording = False
+
+    def start(self):
+        self.current_state = ProfilerState.RECORD
+        self._last_step_t = time.time()
+        if self._scheduler is None:
+            self._start_trace()
+
+    def stop(self):
+        self._stop_trace()
+        self.current_state = ProfilerState.CLOSED
+        if self._on_trace_ready is not None:
+            self._on_trace_ready(self)
+
+    def step(self, num_samples=None):
+        now = time.time()
+        if self._last_step_t is not None:
+            self._step_times.append(now - self._last_step_t)
+        self._last_step_t = now
+        self._step += 1
+        if self._scheduler is None:
+            return
+        state = self._scheduler(self._step)
+        prev = self.current_state
+        self.current_state = state
+        if state in (ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN) \
+                and prev in (ProfilerState.CLOSED, ProfilerState.READY):
+            self._start_trace()
+        if state == ProfilerState.RECORD_AND_RETURN or (
+                state == ProfilerState.CLOSED and self._recording):
+            self._stop_trace()
+            if self._on_trace_ready is not None:
+                self._on_trace_ready(self)
+
+    def step_info(self, unit=None):
+        if not self._step_times:
+            return "step: n/a"
+        avg = sum(self._step_times) / len(self._step_times)
+        return (f"step {self._step}: avg {avg * 1e3:.2f} ms "
+                f"({1.0 / avg:.2f} steps/s)")
+
+    def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
+                time_unit="ms", views=None):
+        print(self.step_info())
+        if self._dir:
+            print(f"trace artifacts: {self._dir}")
+
+    def export(self, path=None, format="json"):
+        """The jax trace directory holds the exported artifacts."""
+        return self._dir
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+
+def export_chrome_tracing(dir_name, worker_name=None):
+    """on_trace_ready factory (reference export_chrome_tracing): points the
+    trace directory at dir_name."""
+    os.makedirs(dir_name, exist_ok=True)
+
+    def handler(prof):
+        if prof._dir is None:
+            prof._dir = dir_name
+        return prof._dir
+
+    return handler
+
+
+def load_profiler_result(filename):
+    raise NotImplementedError(
+        "load back traces with TensorBoard/Perfetto from the trace dir")

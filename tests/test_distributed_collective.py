@@ -1,0 +1,178 @@
+"""Collectives on the 8-device CPU mesh (SURVEY §4 test_distributed_*).
+
+Eager regime: rank-stacked tensors (leading axis = rank). Traced regime:
+rank-local blocks inside shard_map.
+"""
+import jax
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+
+N = 8
+
+
+@pytest.fixture(autouse=True)
+def _env():
+    dist.init_parallel_env()
+    yield
+    dist.set_mesh(None)
+
+
+def _stack(fn=float):
+    return paddle.to_tensor(
+        np.arange(N, dtype=np.float32).reshape(N, 1))
+
+
+def test_all_reduce_sum():
+    x = _stack()
+    out = dist.all_reduce(x)
+    np.testing.assert_allclose(np.asarray(x._value), np.full((N, 1), 28.0))
+    assert out is x  # in-place
+
+
+def test_all_reduce_ops():
+    for op, expect in [(dist.ReduceOp.MAX, 7.0), (dist.ReduceOp.MIN, 0.0),
+                       (dist.ReduceOp.AVG, 3.5)]:
+        x = _stack()
+        dist.all_reduce(x, op=op)
+        np.testing.assert_allclose(np.asarray(x._value),
+                                   np.full((N, 1), expect))
+    x = paddle.to_tensor(np.full((N, 1), 2.0, np.float32))
+    dist.all_reduce(x, op=dist.ReduceOp.PROD)
+    np.testing.assert_allclose(np.asarray(x._value), np.full((N, 1), 256.0))
+
+
+def test_all_reduce_rejects_unstacked():
+    with pytest.raises(ValueError, match="rank-stacked"):
+        dist.all_reduce(paddle.to_tensor(np.ones(3, np.float32)))
+
+
+def test_all_gather():
+    out = []
+    dist.all_gather(out, _stack())
+    assert len(out) == N
+    for i, t in enumerate(out):
+        assert float(t._value[0]) == float(i)
+
+
+def test_broadcast():
+    x = _stack()
+    dist.broadcast(x, src=5)
+    np.testing.assert_allclose(np.asarray(x._value), np.full((N, 1), 5.0))
+
+
+def test_reduce():
+    x = _stack()
+    dist.reduce(x, dst=2)
+    expect = np.arange(N, dtype=np.float32).reshape(N, 1)
+    expect[2] = 28.0
+    np.testing.assert_allclose(np.asarray(x._value), expect)
+
+
+def test_scatter():
+    t = paddle.zeros([N, 2])
+    dist.scatter(t, [paddle.to_tensor(np.full(2, float(i), np.float32))
+                     for i in range(N)], src=0)
+    np.testing.assert_allclose(np.asarray(t._value),
+                               np.repeat(np.arange(float(N))[:, None], 2, 1))
+
+
+def test_alltoall():
+    inp = paddle.to_tensor(np.arange(N * N, dtype=np.float32)
+                           .reshape(N, N, 1))
+    res = dist.alltoall(inp)
+    np.testing.assert_allclose(
+        np.asarray(res._value)[:, :, 0],
+        np.arange(N * N).reshape(N, N).T)
+
+
+def test_alltoall_single():
+    v = paddle.to_tensor(np.arange(N * N, dtype=np.float32).reshape(N, N))
+    o = dist.alltoall_single(v)
+    np.testing.assert_allclose(np.asarray(o._value),
+                               np.arange(N * N).reshape(N, N).T)
+
+
+def test_send_recv_mailbox():
+    dist.send(paddle.to_tensor(np.ones(3, np.float32) * 5), dst=0)
+    r = paddle.zeros([3])
+    dist.recv(r, src=0)
+    np.testing.assert_allclose(np.asarray(r._value), np.full(3, 5.0))
+    with pytest.raises(RuntimeError, match="no message"):
+        dist.recv(paddle.zeros([3]), src=3)
+
+
+def test_barrier_and_wait():
+    dist.barrier()
+    dist.wait(paddle.ones([2]))
+
+
+def test_new_group_subset():
+    g = dist.new_group([0, 2, 4, 6])
+    assert g.nranks == 4
+    assert g.get_group_rank(4) == 2
+    x = paddle.to_tensor(np.ones((4, 3), np.float32))
+    dist.all_reduce(x, group=g)
+    np.testing.assert_allclose(np.asarray(x._value), np.full((4, 3), 4.0))
+
+
+def test_rank_world_size():
+    assert dist.get_rank() == 0
+    assert dist.get_world_size() == N
+    assert dist.get_world_size(dist.new_group([0, 1])) == 2
+
+
+def test_traced_collectives_in_shard_map():
+    mesh = dist.get_mesh()
+
+    def red(x):
+        return dist.all_reduce(paddle.Tensor(x))._value
+
+    y = jax.shard_map(red, mesh=mesh, in_specs=P("dp"), out_specs=P("dp"),
+                      check_vma=False)(np.arange(N, dtype=np.float32))
+    np.testing.assert_allclose(np.asarray(y), np.full(N, 28.0))
+
+    def gather(x):
+        return dist.all_gather(None, paddle.Tensor(x))._value
+
+    y = jax.shard_map(gather, mesh=mesh, in_specs=P("dp"), out_specs=P(None),
+                      check_vma=False)(np.arange(N, dtype=np.float32))
+    np.testing.assert_allclose(np.asarray(y), np.arange(N))
+
+    def a2a(x):
+        return dist.alltoall(paddle.Tensor(x))._value
+
+    y = jax.shard_map(a2a, mesh=mesh, in_specs=P("dp"), out_specs=P("dp"),
+                      check_vma=False)(
+        np.arange(N * N, dtype=np.float32).reshape(N * N, 1))
+    np.testing.assert_allclose(np.asarray(y).reshape(N, N),
+                               np.arange(N * N).reshape(N, N).T)
+
+    def perm(x):
+        t = dist.p2p_permute(paddle.Tensor(x),
+                             [(i, (i + 1) % N) for i in range(N)])
+        return t._value
+
+    y = jax.shard_map(perm, mesh=mesh, in_specs=P("dp"), out_specs=P("dp"),
+                      check_vma=False)(np.arange(N, dtype=np.float32))
+    np.testing.assert_allclose(np.asarray(y), np.roll(np.arange(N), 1))
+
+
+def test_traced_all_reduce_differentiable():
+    mesh = dist.get_mesh()
+
+    def loss_fn(x):
+        def body(v):
+            s = dist.all_reduce(paddle.Tensor(v))._value
+            return (s ** 2).sum()
+        per = jax.shard_map(body, mesh=mesh, in_specs=P("dp"),
+                            out_specs=P(), check_vma=False)(x)
+        return per
+
+    x = np.arange(N, dtype=np.float32)
+    g = jax.grad(loss_fn)(x)
+    # out_specs=P() takes one replica: loss = (sum x)^2 -> grad = 2 sum(x)
+    np.testing.assert_allclose(np.asarray(g), np.full(N, 2.0 * 28.0))

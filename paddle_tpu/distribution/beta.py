@@ -1,0 +1,68 @@
+"""Beta distribution.
+
+Reference: python/paddle/distribution/beta.py (Beta(alpha, beta) as an
+ExponentialFamily).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.scipy.special import betaln, gammaln
+
+from .distribution import _param, _value, _wrap
+from .exponential_family import ExponentialFamily
+
+__all__ = ["Beta"]
+
+
+class Beta(ExponentialFamily):
+    def __init__(self, alpha, beta):
+        self.alpha = _param(alpha)
+        self.beta = _param(beta)
+        b = jnp.broadcast_shapes(self.alpha.shape, self.beta.shape)
+        super().__init__(batch_shape=b)
+
+    @property
+    def mean(self):
+        return _wrap(jnp.broadcast_to(
+            self.alpha / (self.alpha + self.beta), self.batch_shape))
+
+    @property
+    def variance(self):
+        s = self.alpha + self.beta
+        return _wrap(jnp.broadcast_to(
+            self.alpha * self.beta / (s ** 2 * (s + 1)), self.batch_shape))
+
+    def log_prob(self, value):
+        v = _value(value)
+        return _wrap((self.alpha - 1) * jnp.log(v)
+                     + (self.beta - 1) * jnp.log1p(-v)
+                     - betaln(self.alpha, self.beta))
+
+    def sample(self, shape=()):
+        return self.rsample(shape)
+
+    def rsample(self, shape=()):
+        out = self._extend_shape(shape)
+        return _wrap(jax.random.beta(self._key(), self.alpha, self.beta, out))
+
+    def entropy(self):
+        a, b = self.alpha, self.beta
+        dg = jax.scipy.special.digamma
+        h = (betaln(a, b) - (a - 1) * dg(a) - (b - 1) * dg(b)
+             + (a + b - 2) * dg(a + b))
+        return _wrap(jnp.broadcast_to(h, self.batch_shape))
+
+    @property
+    def _natural_parameters(self):
+        return (self.alpha, self.beta)
+
+    def _log_normalizer(self, x, y):
+        return gammaln(x) + gammaln(y) - gammaln(x + y)
+
+    @property
+    def _mean_carrier_measure(self):
+        # E[log h(x)] for h(x) = 1/(x(1-x)) under natural params (α, β)
+        dg = jax.scipy.special.digamma
+        a, b = self.alpha, self.beta
+        return 2 * dg(a + b) - dg(a) - dg(b)

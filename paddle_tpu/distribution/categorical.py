@@ -1,0 +1,57 @@
+"""Categorical distribution.
+
+Reference: python/paddle/distribution/categorical.py (Categorical(logits)
+where `logits` are unnormalized probabilities — normalized by their sum, not
+softmax, matching the reference semantics).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .distribution import Distribution, _param, _value, _wrap
+
+__all__ = ["Categorical"]
+
+
+class Categorical(Distribution):
+    def __init__(self, logits, name=None):
+        self.logits = _param(logits)
+        super().__init__(batch_shape=self.logits.shape[:-1])
+
+    @property
+    def _probs(self):
+        return self.logits / self.logits.sum(-1, keepdims=True)
+
+    def sample(self, shape=()):
+        shape = tuple(shape) if not isinstance(shape, int) else (shape,)
+        n = 1
+        for s in shape:
+            n *= s
+        logp = jnp.log(self._probs)
+        draws = jax.random.categorical(self._key(), logp, axis=-1,
+                                       shape=(n,) + self.batch_shape)
+        return _wrap(draws.reshape(shape + self.batch_shape))
+
+    def entropy(self):
+        p = self._probs
+        logp = jnp.log(jnp.where(p > 0, p, 1.0))
+        return _wrap(-(p * logp).sum(-1))
+
+    def probs(self, value):
+        v = _value(value).astype(jnp.int32)
+        p = self._probs
+        if not self.batch_shape:
+            return _wrap(p[v])
+        return _wrap(jnp.take_along_axis(p, v[..., None], axis=-1)
+                     .squeeze(-1))
+
+    def log_prob(self, value):
+        return _wrap(jnp.log(self.probs(value)._value))
+
+    def kl_divergence(self, other):
+        p = self._probs
+        q = other._probs
+        logp = jnp.log(jnp.where(p > 0, p, 1.0))
+        logq = jnp.log(q)
+        return _wrap((p * (logp - logq)).sum(-1))

@@ -1,0 +1,73 @@
+"""Dirichlet distribution.
+
+Reference: python/paddle/distribution/dirichlet.py (Dirichlet(concentration)
+as an ExponentialFamily; event_shape is the trailing axis).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.scipy.special import digamma, gammaln
+
+from .distribution import _param, _value, _wrap
+from .exponential_family import ExponentialFamily
+
+__all__ = ["Dirichlet"]
+
+
+class Dirichlet(ExponentialFamily):
+    def __init__(self, concentration):
+        self.concentration = _param(concentration)
+        if self.concentration.ndim < 1:
+            raise ValueError(
+                "concentration must be at least one-dimensional")
+        super().__init__(batch_shape=self.concentration.shape[:-1],
+                         event_shape=self.concentration.shape[-1:])
+
+    @property
+    def mean(self):
+        return _wrap(self.concentration
+                     / self.concentration.sum(-1, keepdims=True))
+
+    @property
+    def variance(self):
+        a = self.concentration
+        a0 = a.sum(-1, keepdims=True)
+        return _wrap(a * (a0 - a) / (a0 ** 2 * (a0 + 1)))
+
+    def sample(self, shape=()):
+        return self.rsample(shape)
+
+    def rsample(self, shape=()):
+        shape = tuple(shape)
+        out = shape + self.batch_shape
+        return _wrap(jax.random.dirichlet(self._key(), self.concentration,
+                                          out))
+
+    def log_prob(self, value):
+        v = _value(value)
+        a = self.concentration
+        return _wrap(((a - 1) * jnp.log(v)).sum(-1)
+                     + gammaln(a.sum(-1)) - gammaln(a).sum(-1))
+
+    def entropy(self):
+        a = self.concentration
+        a0 = a.sum(-1)
+        k = a.shape[-1]
+        log_b = gammaln(a).sum(-1) - gammaln(a0)
+        return _wrap(log_b + (a0 - k) * digamma(a0)
+                     - ((a - 1) * digamma(a)).sum(-1))
+
+    @property
+    def _natural_parameters(self):
+        return (self.concentration,)
+
+    def _log_normalizer(self, x):
+        return gammaln(x).sum(-1) - gammaln(x.sum(-1))
+
+    @property
+    def _mean_carrier_measure(self):
+        # E[log h(x)] for h(x) = ∏ 1/x_i under natural params α
+        a = self.concentration
+        a0 = a.sum(-1)
+        return (digamma(a0)[..., None] - digamma(a)).sum(-1)

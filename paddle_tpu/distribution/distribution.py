@@ -1,0 +1,99 @@
+"""Distribution base class.
+
+Reference: python/paddle/distribution/distribution.py:40 (Distribution with
+batch_shape/event_shape, sample/entropy/log_prob/probs/kl_divergence).
+TPU-native design: parameters are held as jnp arrays; every method is a pure
+jnp computation (jit/vmap/grad-compatible), sampling draws a subkey from the
+functional PRNG store (framework/random.py) so it is reproducible under
+paddle.seed and traceable under a key_scope.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..framework import random as rnd
+
+__all__ = ["Distribution"]
+
+
+def _param(x, dtype=None):
+    """Coerce a ctor argument (Tensor | ndarray | scalar | list) to jnp."""
+    if isinstance(x, Tensor):
+        v = x._value
+    else:
+        v = jnp.asarray(x)
+    if jnp.issubdtype(v.dtype, jnp.integer) or v.dtype == jnp.bool_:
+        v = v.astype(dtype or jnp.float32)
+    elif dtype is not None:
+        v = v.astype(dtype)
+    return v
+
+
+def _value(x):
+    return x._value if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def _wrap(v):
+    return Tensor(v)
+
+
+class Distribution:
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = tuple(
+            batch_shape.shape if isinstance(batch_shape, Tensor)
+            else batch_shape)
+        self._event_shape = tuple(
+            event_shape.shape if isinstance(event_shape, Tensor)
+            else event_shape)
+
+    @property
+    def batch_shape(self):
+        return self._batch_shape
+
+    @property
+    def event_shape(self):
+        return self._event_shape
+
+    @property
+    def mean(self):
+        raise NotImplementedError
+
+    @property
+    def variance(self):
+        raise NotImplementedError
+
+    def sample(self, shape=()):
+        raise NotImplementedError
+
+    def rsample(self, shape=()):
+        raise NotImplementedError
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        """Probability density/mass at `value` (exp of log_prob by default)."""
+        return _wrap(jnp.exp(self.log_prob(value)._value))
+
+    def probs(self, value):
+        return self.prob(value)
+
+    def kl_divergence(self, other):
+        from .kl import kl_divergence
+
+        return kl_divergence(self, other)
+
+    # ---- helpers ---------------------------------------------------------
+    def _extend_shape(self, sample_shape):
+        if isinstance(sample_shape, Tensor):
+            sample_shape = tuple(int(s) for s in np.asarray(sample_shape._value))
+        return tuple(sample_shape) + self.batch_shape + self.event_shape
+
+    @staticmethod
+    def _key():
+        return rnd.next_key()

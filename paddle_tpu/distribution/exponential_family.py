@@ -1,0 +1,42 @@
+"""ExponentialFamily base: entropy and KL via the log-normalizer.
+
+Reference: python/paddle/distribution/exponential_family.py:50 computes
+entropy with the Bregman-divergence trick, differentiating the log normalizer
+w.r.t. the natural parameters via the autograd tape. TPU-native design: the
+gradient is taken with jax.grad on the pure `_log_normalizer` — no tape,
+fully jittable.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .distribution import Distribution, _wrap
+
+__all__ = ["ExponentialFamily"]
+
+
+class ExponentialFamily(Distribution):
+    @property
+    def _natural_parameters(self):
+        raise NotImplementedError
+
+    def _log_normalizer(self, *natural_params):
+        raise NotImplementedError
+
+    @property
+    def _mean_carrier_measure(self):
+        raise NotImplementedError
+
+    def entropy(self):
+        """H = -<carrier> + A(θ) - Σ θ_i · ∇_i A(θ)  (Bregman identity)."""
+        nat = [jnp.asarray(p) for p in self._natural_parameters]
+
+        def log_norm_sum(*ps):
+            return self._log_normalizer(*ps).sum()
+
+        grads = jax.grad(log_norm_sum, argnums=tuple(range(len(nat))))(*nat)
+        ent = -self._mean_carrier_measure + self._log_normalizer(*nat)
+        for p, g in zip(nat, grads):
+            ent = ent - p * g
+        return _wrap(ent)

@@ -1,0 +1,48 @@
+"""Independent: reinterpret batch dims of a base distribution as event dims.
+
+Reference: python/paddle/distribution/independent.py.
+"""
+from __future__ import annotations
+
+from .distribution import Distribution, _wrap
+
+__all__ = ["Independent"]
+
+
+def _sum_rightmost(x, n):
+    return x.sum(tuple(range(x.ndim - n, x.ndim))) if n > 0 else x
+
+
+class Independent(Distribution):
+    def __init__(self, base, reinterpreted_batch_rank):
+        if not (0 < reinterpreted_batch_rank <= len(base.batch_shape)):
+            raise ValueError(
+                "reinterpreted_batch_rank must be in (0, len(batch_shape)]")
+        self._base = base
+        self._reinterpreted_batch_rank = int(reinterpreted_batch_rank)
+        shape = base.batch_shape + base.event_shape
+        n_event = len(base.event_shape) + self._reinterpreted_batch_rank
+        super().__init__(batch_shape=shape[:len(shape) - n_event],
+                         event_shape=shape[len(shape) - n_event:])
+
+    @property
+    def mean(self):
+        return self._base.mean
+
+    @property
+    def variance(self):
+        return self._base.variance
+
+    def sample(self, shape=()):
+        return self._base.sample(shape)
+
+    def rsample(self, shape=()):
+        return self._base.rsample(shape)
+
+    def log_prob(self, value):
+        return _wrap(_sum_rightmost(self._base.log_prob(value)._value,
+                                    self._reinterpreted_batch_rank))
+
+    def entropy(self):
+        return _wrap(_sum_rightmost(self._base.entropy()._value,
+                                    self._reinterpreted_batch_rank))

@@ -1,0 +1,101 @@
+"""KL divergence dispatch.
+
+Reference: python/paddle/distribution/kl.py (register_kl decorator with
+most-derived-match dispatch; _kl_expfamily_expfamily via Bregman divergence).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .beta import Beta
+from .categorical import Categorical
+from .dirichlet import Dirichlet
+from .distribution import _wrap
+from .exponential_family import ExponentialFamily
+from .normal import Normal
+from .uniform import Uniform
+
+__all__ = ["kl_divergence", "register_kl"]
+
+_REGISTRY = {}
+
+
+def register_kl(cls_p, cls_q):
+    def decorator(fn):
+        _REGISTRY[(cls_p, cls_q)] = fn
+        return fn
+
+    return decorator
+
+
+def _dispatch(cls_p, cls_q):
+    matches = [(p, q) for (p, q) in _REGISTRY
+               if issubclass(cls_p, p) and issubclass(cls_q, q)]
+    if not matches:
+        raise NotImplementedError(
+            f"no KL registered between {cls_p.__name__} and {cls_q.__name__}")
+
+    def total_order(pair):
+        # most-derived match wins: fewer MRO hops = better
+        return (cls_p.__mro__.index(pair[0]), cls_q.__mro__.index(pair[1]))
+
+    return _REGISTRY[min(matches, key=total_order)]
+
+
+def kl_divergence(p, q):
+    return _dispatch(type(p), type(q))(p, q)
+
+
+@register_kl(Normal, Normal)
+def _kl_normal_normal(p, q):
+    return p.kl_divergence(q)
+
+
+@register_kl(Uniform, Uniform)
+def _kl_uniform_uniform(p, q):
+    # KL finite only when support(p) ⊆ support(q)
+    ratio = (q.high - q.low) / (p.high - p.low)
+    inside = (q.low <= p.low) & (p.high <= q.high)
+    return _wrap(jnp.where(inside, jnp.log(ratio), jnp.inf))
+
+
+@register_kl(Categorical, Categorical)
+def _kl_categorical_categorical(p, q):
+    return p.kl_divergence(q)
+
+
+@register_kl(ExponentialFamily, ExponentialFamily)
+def _kl_expfamily_expfamily(p, q):
+    """KL(p||q) = A_q(θ_q) − A_p(θ_p) − ⟨θ_q − θ_p, ∇A_p(θ_p)⟩ for a shared
+    sufficient statistic — gradients via jax.grad on the log normalizers."""
+    if type(p) is not type(q):
+        raise NotImplementedError(
+            "exponential-family KL requires matching families")
+    p_nat = [jnp.asarray(t) for t in p._natural_parameters]
+    q_nat = [jnp.asarray(t) for t in q._natural_parameters]
+    p_nat = [jnp.broadcast_to(a, jnp.broadcast_shapes(a.shape, b.shape))
+             for a, b in zip(p_nat, q_nat)]
+    q_nat = [jnp.broadcast_to(b, a.shape) for a, b in zip(p_nat, q_nat)]
+
+    grads = jax.grad(lambda *ps: p._log_normalizer(*ps).sum(),
+                     argnums=tuple(range(len(p_nat))))(*p_nat)
+    kl = q._log_normalizer(*q_nat) - p._log_normalizer(*p_nat)
+    for pp, qq, g in zip(p_nat, q_nat, grads):
+        term = (pp - qq) * g
+        # event-axis parameters (e.g. Dirichlet concentration) reduce over
+        # the event axis; scalar-parameter families don't
+        if term.ndim > kl.ndim:
+            term = term.sum(tuple(range(kl.ndim, term.ndim)))
+        kl = kl + term
+    return _wrap(kl)
+
+
+@register_kl(Beta, Beta)
+def _kl_beta_beta(p, q):
+    return _kl_expfamily_expfamily(p, q)
+
+
+@register_kl(Dirichlet, Dirichlet)
+def _kl_dirichlet_dirichlet(p, q):
+    return _kl_expfamily_expfamily(p, q)

@@ -1,0 +1,69 @@
+"""Multinomial distribution.
+
+Reference: python/paddle/distribution/multinomial.py
+(Multinomial(total_count, probs)). Sampling draws `total_count` categorical
+indices with one fused jax.random.categorical call and histograms them with a
+one-hot matmul — an MXU-friendly formulation; total_count is static so the
+whole path jits.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.scipy.special import gammaln
+
+from .categorical import Categorical
+from .distribution import Distribution, _param, _value, _wrap
+
+__all__ = ["Multinomial"]
+
+
+class Multinomial(Distribution):
+    def __init__(self, total_count, probs):
+        if int(total_count) < 1:
+            raise ValueError("total_count must be >= 1")
+        self.total_count = int(total_count)
+        self.probs = _param(probs)
+        self.probs = self.probs / self.probs.sum(-1, keepdims=True)
+        super().__init__(batch_shape=self.probs.shape[:-1],
+                         event_shape=self.probs.shape[-1:])
+
+    @property
+    def mean(self):
+        return _wrap(self.total_count * self.probs)
+
+    @property
+    def variance(self):
+        return _wrap(self.total_count * self.probs * (1 - self.probs))
+
+    def log_prob(self, value):
+        v = _value(value).astype(self.probs.dtype)
+        logp = jnp.log(jnp.where(self.probs > 0, self.probs, 1.0))
+        return _wrap(gammaln(jnp.asarray(self.total_count + 1.0))
+                     - gammaln(v + 1).sum(-1) + (v * logp).sum(-1))
+
+    def sample(self, shape=()):
+        shape = tuple(shape)
+        k = self.probs.shape[-1]
+        n = self.total_count
+        draws = jax.random.categorical(
+            self._key(), jnp.log(self.probs), axis=-1,
+            shape=(n,) + shape + self.batch_shape)
+        counts = jax.nn.one_hot(draws, k, dtype=self.probs.dtype).sum(0)
+        return _wrap(counts)
+
+    def entropy(self):
+        """n·H(p) − lgamma(n+1) + Σ_i E_{x~Binom(n,p_i)}[lgamma(x+1)],
+        the exact decomposition the reference uses
+        (multinomial.py entropy via the binomial pmf over the support)."""
+        n = self.total_count
+        p = self.probs
+        cat_h = Categorical(p).entropy()._value
+        support = jnp.arange(1, n + 1, dtype=p.dtype)
+        support = support.reshape((-1,) + (1,) * p.ndim)
+        log_pmf = (gammaln(jnp.asarray(n + 1.0))
+                   - gammaln(support + 1) - gammaln(n - support + 1)
+                   + support * jnp.log(jnp.where(p > 0, p, 1.0))
+                   + (n - support) * jnp.log1p(-jnp.where(p < 1, p, 0.0)))
+        corr = (jnp.exp(log_pmf) * gammaln(support + 1)).sum((0, -1))
+        return _wrap(n * cat_h - gammaln(jnp.asarray(n + 1.0)) + corr)

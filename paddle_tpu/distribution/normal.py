@@ -1,0 +1,72 @@
+"""Normal distribution.
+
+Reference: python/paddle/distribution/normal.py:30 (Normal(loc, scale) with
+sample/entropy/log_prob/probs/kl_divergence).
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from .distribution import Distribution, _param, _value, _wrap
+
+__all__ = ["Normal"]
+
+_HALF_LOG_2PI = 0.5 * math.log(2.0 * math.pi)
+
+
+class Normal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _param(loc)
+        self.scale = _param(scale)
+        b = jnp.broadcast_shapes(self.loc.shape, self.scale.shape)
+        super().__init__(batch_shape=b)
+
+    @property
+    def mean(self):
+        return _wrap(jnp.broadcast_to(self.loc, self.batch_shape))
+
+    @property
+    def variance(self):
+        return _wrap(jnp.broadcast_to(self.scale ** 2, self.batch_shape))
+
+    @property
+    def stddev(self):
+        return _wrap(jnp.broadcast_to(self.scale, self.batch_shape))
+
+    def sample(self, shape=(), seed=0):
+        return self.rsample(shape)
+
+    def rsample(self, shape=()):
+        out = self._extend_shape(shape)
+        eps = jax.random.normal(self._key(), out, self.loc.dtype)
+        return _wrap(self.loc + self.scale * eps)
+
+    def entropy(self):
+        h = 0.5 + _HALF_LOG_2PI + jnp.log(self.scale)
+        return _wrap(jnp.broadcast_to(h, self.batch_shape))
+
+    def log_prob(self, value):
+        v = _value(value)
+        var = self.scale ** 2
+        return _wrap(-((v - self.loc) ** 2) / (2 * var)
+                     - jnp.log(self.scale) - _HALF_LOG_2PI)
+
+    def cdf(self, value):
+        v = _value(value)
+        return _wrap(0.5 * (1 + jax.scipy.special.erf(
+            (v - self.loc) / (self.scale * math.sqrt(2.0)))))
+
+    def icdf(self, value):
+        v = _value(value)
+        return _wrap(self.loc + self.scale * math.sqrt(2.0)
+                     * jax.scipy.special.erfinv(2 * v - 1))
+
+    def kl_divergence(self, other):
+        if isinstance(other, Normal):
+            var_ratio = (self.scale / other.scale) ** 2
+            t1 = ((self.loc - other.loc) / other.scale) ** 2
+            return _wrap(0.5 * (var_ratio + t1 - 1 - jnp.log(var_ratio)))
+        return super().kl_divergence(other)

@@ -1,0 +1,335 @@
+"""Bijective (and injective) transforms for TransformedDistribution.
+
+Reference: python/paddle/distribution/transform.py:59 (Transform with
+forward/inverse/forward_log_det_jacobian and the 13-transform zoo).
+TPU-native design: each transform is a pair of pure jnp maps plus an
+analytic log-det; everything composes under jit/vmap/grad.
+"""
+from __future__ import annotations
+
+import enum
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from .distribution import _value, _wrap
+
+__all__ = [
+    "Transform", "AbsTransform", "AffineTransform", "ChainTransform",
+    "ExpTransform", "IndependentTransform", "PowerTransform",
+    "ReshapeTransform", "SigmoidTransform", "SoftmaxTransform",
+    "StackTransform", "StickBreakingTransform", "TanhTransform",
+]
+
+
+class Type(enum.Enum):
+    BIJECTION = "bijection"
+    INJECTION = "injection"
+    SURJECTION = "surjection"
+    OTHER = "other"
+
+    @classmethod
+    def is_injective(cls, t):
+        return t in (cls.BIJECTION, cls.INJECTION)
+
+
+class Transform:
+    _type = Type.BIJECTION
+    # number of event dims the transform consumes/produces
+    domain_event_dim = 0
+    codomain_event_dim = 0
+
+    @classmethod
+    def _is_injective(cls):
+        return Type.is_injective(cls._type)
+
+    def __call__(self, x):
+        from .transformed_distribution import TransformedDistribution
+
+        if isinstance(x, (Tensor, jax.Array)):
+            return self.forward(x)
+        if isinstance(x, Transform):
+            return ChainTransform([self, x])
+        return TransformedDistribution(x, [self])
+
+    def forward(self, x):
+        return _wrap(self._forward(_value(x)))
+
+    def inverse(self, y):
+        return _wrap(self._inverse(_value(y)))
+
+    def forward_log_det_jacobian(self, x):
+        return _wrap(self._forward_log_det_jacobian(_value(x)))
+
+    def inverse_log_det_jacobian(self, y):
+        v = _value(y)
+        return _wrap(-self._forward_log_det_jacobian(self._inverse(v)))
+
+    def forward_shape(self, shape):
+        return tuple(shape)
+
+    def inverse_shape(self, shape):
+        return tuple(shape)
+
+    # subclass hooks --------------------------------------------------------
+    def _forward(self, x):
+        raise NotImplementedError
+
+    def _inverse(self, y):
+        raise NotImplementedError
+
+    def _forward_log_det_jacobian(self, x):
+        raise NotImplementedError
+
+
+class AbsTransform(Transform):
+    _type = Type.SURJECTION
+
+    def _forward(self, x):
+        return jnp.abs(x)
+
+    def _inverse(self, y):
+        return y  # principal (non-negative) branch
+
+
+class AffineTransform(Transform):
+    def __init__(self, loc, scale):
+        self.loc = _value(loc)
+        self.scale = _value(scale)
+
+    def _forward(self, x):
+        return self.loc + self.scale * x
+
+    def _inverse(self, y):
+        return (y - self.loc) / self.scale
+
+    def _forward_log_det_jacobian(self, x):
+        return jnp.broadcast_to(jnp.log(jnp.abs(self.scale)), x.shape)
+
+
+class ChainTransform(Transform):
+    def __init__(self, transforms):
+        self.transforms = list(transforms)
+        self.domain_event_dim = max(
+            (t.domain_event_dim for t in self.transforms), default=0)
+        self.codomain_event_dim = max(
+            (t.codomain_event_dim for t in self.transforms), default=0)
+
+    def _is_injective(self):
+        return all(t._is_injective() for t in self.transforms)
+
+    def _forward(self, x):
+        for t in self.transforms:
+            x = t._forward(x)
+        return x
+
+    def _inverse(self, y):
+        for t in reversed(self.transforms):
+            y = t._inverse(y)
+        return y
+
+    def _forward_log_det_jacobian(self, x):
+        total = 0.0
+        for t in self.transforms:
+            ld = t._forward_log_det_jacobian(x)
+            # reduce per-transform extra event axes so terms sum at the
+            # chain's batch rank
+            total = total + _sum_rightmost(
+                ld, self.domain_event_dim - t.domain_event_dim)
+            x = t._forward(x)
+        return total
+
+    def forward_shape(self, shape):
+        for t in self.transforms:
+            shape = t.forward_shape(shape)
+        return shape
+
+    def inverse_shape(self, shape):
+        for t in reversed(self.transforms):
+            shape = t.inverse_shape(shape)
+        return shape
+
+
+def _sum_rightmost(x, n):
+    return x.sum(tuple(range(x.ndim - n, x.ndim))) if n > 0 else x
+
+
+class ExpTransform(Transform):
+    def _forward(self, x):
+        return jnp.exp(x)
+
+    def _inverse(self, y):
+        return jnp.log(y)
+
+    def _forward_log_det_jacobian(self, x):
+        return x
+
+
+class IndependentTransform(Transform):
+    def __init__(self, base, reinterpreted_batch_rank):
+        self.base = base
+        self.reinterpreted_batch_rank = int(reinterpreted_batch_rank)
+        self.domain_event_dim = (base.domain_event_dim
+                                 + self.reinterpreted_batch_rank)
+        self.codomain_event_dim = (base.codomain_event_dim
+                                   + self.reinterpreted_batch_rank)
+
+    def _is_injective(self):
+        return self.base._is_injective()
+
+    def _forward(self, x):
+        return self.base._forward(x)
+
+    def _inverse(self, y):
+        return self.base._inverse(y)
+
+    def _forward_log_det_jacobian(self, x):
+        return _sum_rightmost(self.base._forward_log_det_jacobian(x),
+                              self.reinterpreted_batch_rank)
+
+
+class PowerTransform(Transform):
+    def __init__(self, power):
+        self.power = _value(power)
+
+    def _forward(self, x):
+        return jnp.power(x, self.power)
+
+    def _inverse(self, y):
+        return jnp.power(y, 1.0 / self.power)
+
+    def _forward_log_det_jacobian(self, x):
+        return jnp.log(jnp.abs(self.power * jnp.power(x, self.power - 1)))
+
+
+class ReshapeTransform(Transform):
+    def __init__(self, in_event_shape, out_event_shape):
+        self.in_event_shape = tuple(in_event_shape)
+        self.out_event_shape = tuple(out_event_shape)
+        if (math.prod(self.in_event_shape)
+                != math.prod(self.out_event_shape)):
+            raise ValueError("in/out event sizes must match")
+        self.domain_event_dim = len(self.in_event_shape)
+        self.codomain_event_dim = len(self.out_event_shape)
+
+    def _forward(self, x):
+        lead = x.shape[:x.ndim - len(self.in_event_shape)]
+        return x.reshape(lead + self.out_event_shape)
+
+    def _inverse(self, y):
+        lead = y.shape[:y.ndim - len(self.out_event_shape)]
+        return y.reshape(lead + self.in_event_shape)
+
+    def _forward_log_det_jacobian(self, x):
+        lead = x.shape[:x.ndim - len(self.in_event_shape)]
+        return jnp.zeros(lead, x.dtype)
+
+    def forward_shape(self, shape):
+        n = len(self.in_event_shape)
+        return tuple(shape[:len(shape) - n]) + self.out_event_shape
+
+    def inverse_shape(self, shape):
+        n = len(self.out_event_shape)
+        return tuple(shape[:len(shape) - n]) + self.in_event_shape
+
+
+class SigmoidTransform(Transform):
+    def _forward(self, x):
+        return jax.nn.sigmoid(x)
+
+    def _inverse(self, y):
+        return jnp.log(y) - jnp.log1p(-y)
+
+    def _forward_log_det_jacobian(self, x):
+        return -jax.nn.softplus(-x) - jax.nn.softplus(x)
+
+
+class SoftmaxTransform(Transform):
+    _type = Type.OTHER
+    domain_event_dim = 1
+    codomain_event_dim = 1
+
+    def _forward(self, x):
+        return jax.nn.softmax(x, axis=-1)
+
+    def _inverse(self, y):
+        return jnp.log(y)
+
+
+class StackTransform(Transform):
+    def __init__(self, transforms, axis=0):
+        self.transforms = list(transforms)
+        self.axis = int(axis)
+
+    def _is_injective(self):
+        return all(t._is_injective() for t in self.transforms)
+
+    def _split(self, x):
+        return [jnp.squeeze(s, self.axis) for s in
+                jnp.split(x, len(self.transforms), axis=self.axis)]
+
+    def _forward(self, x):
+        return jnp.stack([t._forward(s) for t, s in
+                          zip(self.transforms, self._split(x))], self.axis)
+
+    def _inverse(self, y):
+        return jnp.stack([t._inverse(s) for t, s in
+                          zip(self.transforms, self._split(y))], self.axis)
+
+    def _forward_log_det_jacobian(self, x):
+        return jnp.stack([t._forward_log_det_jacobian(s) for t, s in
+                          zip(self.transforms, self._split(x))], self.axis)
+
+
+class StickBreakingTransform(Transform):
+    """R^k -> open (k+1)-simplex via stick breaking."""
+
+    _type = Type.BIJECTION
+    domain_event_dim = 1
+    codomain_event_dim = 1
+
+    def _forward(self, x):
+        k = x.shape[-1]
+        offset = jnp.arange(k, 0, -1, dtype=x.dtype)
+        z = jax.nn.sigmoid(x - jnp.log(offset))
+        z1m_cumprod = jnp.cumprod(1 - z, axis=-1)
+        pad = jnp.ones(x.shape[:-1] + (1,), x.dtype)
+        return (jnp.concatenate([z, pad], -1)
+                * jnp.concatenate([pad, z1m_cumprod], -1))
+
+    def _inverse(self, y):
+        y_crop = y[..., :-1]
+        k = y_crop.shape[-1]
+        offset = jnp.arange(k, 0, -1, dtype=y.dtype)
+        # logit of each stick fraction: z_i = y_i / (1 - Σ_{j<=i-1} y_j),
+        # and 1 - z_i leaves exactly 1 - Σ_{j<=i} y_j of the stick
+        sf = 1 - jnp.cumsum(y_crop, axis=-1)
+        return jnp.log(y_crop) - jnp.log(sf) + jnp.log(offset)
+
+    def _forward_log_det_jacobian(self, x):
+        # Jacobian is lower triangular: ∂y_i/∂x_i = y_i (1 − z_i)
+        y = self._forward(x)
+        k = x.shape[-1]
+        offset = jnp.arange(k, 0, -1, dtype=x.dtype)
+        z = jax.nn.sigmoid(x - jnp.log(offset))
+        return (jnp.log(y[..., :-1]) + jnp.log1p(-z)).sum(-1)
+
+    def forward_shape(self, shape):
+        return tuple(shape[:-1]) + (shape[-1] + 1,)
+
+    def inverse_shape(self, shape):
+        return tuple(shape[:-1]) + (shape[-1] - 1,)
+
+
+class TanhTransform(Transform):
+    def _forward(self, x):
+        return jnp.tanh(x)
+
+    def _inverse(self, y):
+        return jnp.arctanh(y)
+
+    def _forward_log_det_jacobian(self, x):
+        # log(1 - tanh^2 x) = 2(log2 - x - softplus(-2x)), numerically stable
+        return 2.0 * (math.log(2.0) - x - jax.nn.softplus(-2.0 * x))

@@ -1,0 +1,64 @@
+"""TransformedDistribution: push a base distribution through transforms.
+
+Reference: python/paddle/distribution/transformed_distribution.py.
+"""
+from __future__ import annotations
+
+from .distribution import Distribution, _value, _wrap
+from .transform import ChainTransform, Transform
+
+__all__ = ["TransformedDistribution"]
+
+
+class TransformedDistribution(Distribution):
+    def __init__(self, base, transforms):
+        if isinstance(transforms, Transform):
+            transforms = [transforms]
+        if not all(isinstance(t, Transform) for t in transforms):
+            raise TypeError("transforms must be Transform instances")
+        self._base = base
+        self._transforms = list(transforms)
+        chain = ChainTransform(self._transforms)
+        base_shape = base.batch_shape + base.event_shape
+        out_shape = chain.forward_shape(base_shape)
+        event_rank = max(chain.codomain_event_dim, len(base.event_shape))
+        super().__init__(
+            batch_shape=out_shape[:len(out_shape) - event_rank],
+            event_shape=out_shape[len(out_shape) - event_rank:])
+
+    @property
+    def transforms(self):
+        return self._transforms
+
+    def sample(self, shape=()):
+        x = self._base.sample(shape)._value
+        for t in self._transforms:
+            x = t._forward(x)
+        return _wrap(x)
+
+    def rsample(self, shape=()):
+        x = self._base.rsample(shape)._value
+        for t in self._transforms:
+            x = t._forward(x)
+        return _wrap(x)
+
+    def log_prob(self, value):
+        """Change of variables: log p(y) = log p(x) − Σ log|det J_t(x_t)|."""
+        y = _value(value)
+        log_det = 0.0
+        event_rank = len(self.event_shape)
+        for t in reversed(self._transforms):
+            x = t._inverse(y)
+            ld = t._forward_log_det_jacobian(x)
+            n = event_rank - t.codomain_event_dim
+            if n > 0:
+                ld = ld.sum(tuple(range(ld.ndim - n, ld.ndim)))
+            log_det = log_det + ld
+            y = x
+            event_rank = (event_rank - t.codomain_event_dim
+                          + t.domain_event_dim)
+        base_lp = self._base.log_prob(_wrap(y))._value
+        n = event_rank - len(self._base.event_shape)
+        if n > 0:
+            base_lp = base_lp.sum(tuple(range(base_lp.ndim - n, base_lp.ndim)))
+        return _wrap(base_lp - log_det)

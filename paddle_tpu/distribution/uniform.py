@@ -1,0 +1,57 @@
+"""Uniform distribution.
+
+Reference: python/paddle/distribution/uniform.py (Uniform(low, high)).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .distribution import Distribution, _param, _value, _wrap
+
+__all__ = ["Uniform"]
+
+
+class Uniform(Distribution):
+    def __init__(self, low, high, name=None):
+        self.low = _param(low)
+        self.high = _param(high)
+        b = jnp.broadcast_shapes(self.low.shape, self.high.shape)
+        super().__init__(batch_shape=b)
+
+    @property
+    def mean(self):
+        return _wrap(jnp.broadcast_to((self.low + self.high) / 2,
+                                      self.batch_shape))
+
+    @property
+    def variance(self):
+        return _wrap(jnp.broadcast_to((self.high - self.low) ** 2 / 12,
+                                      self.batch_shape))
+
+    def sample(self, shape=(), seed=0):
+        return self.rsample(shape)
+
+    def rsample(self, shape=()):
+        out = self._extend_shape(shape)
+        u = jax.random.uniform(self._key(), out, self.low.dtype)
+        return _wrap(self.low + (self.high - self.low) * u)
+
+    def log_prob(self, value):
+        v = _value(value)
+        inside = (v >= self.low) & (v < self.high)
+        lp = -jnp.log(self.high - self.low)
+        return _wrap(jnp.where(inside, lp, -jnp.inf))
+
+    def cdf(self, value):
+        v = _value(value)
+        return _wrap(jnp.clip((v - self.low) / (self.high - self.low), 0, 1))
+
+    def entropy(self):
+        return _wrap(jnp.broadcast_to(jnp.log(self.high - self.low),
+                                      self.batch_shape))
+
+    def kl_divergence(self, other):
+        from .kl import kl_divergence
+
+        return kl_divergence(self, other)

@@ -65,6 +65,7 @@ from . import jit  # noqa: F401
 from . import distributed  # noqa: F401
 from . import inference  # noqa: F401
 from . import distribution  # noqa: F401
+from . import sparse  # noqa: F401
 from . import profiler  # noqa: F401
 from . import device  # noqa: F401
 from .device import (  # noqa: F401

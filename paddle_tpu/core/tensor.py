@@ -113,6 +113,13 @@ class Tensor:
     def numpy(self):
         return np.asarray(self._value)
 
+    def to_sparse_coo(self, sparse_dim):
+        """Dense -> SparseCooTensor (reference
+        fluid/dygraph/varbase_patch_methods.py:895)."""
+        from ..sparse.creation import to_sparse_coo
+
+        return to_sparse_coo(self, sparse_dim)
+
     def __array__(self, dtype=None):
         a = np.asarray(self._value)
         return a.astype(dtype) if dtype is not None else a

@@ -40,9 +40,9 @@ class Categorical(Distribution):
 
     def probs(self, value):
         v = _value(value).astype(jnp.int32)
-        p = self._probs
-        if not self.batch_shape:
-            return _wrap(p[v])
+        # broadcast so sample dims on `value` (e.g. scoring d.sample((n,)))
+        # line up with the batch dims of the parameters
+        p = jnp.broadcast_to(self._probs, v.shape + self._probs.shape[-1:])
         return _wrap(jnp.take_along_axis(p, v[..., None], axis=-1)
                      .squeeze(-1))
 

@@ -39,6 +39,11 @@ def _wrap(v):
     return Tensor(v)
 
 
+def _sum_rightmost(x, n):
+    """Reduce the trailing `n` axes (event-axis reduction helper)."""
+    return x.sum(tuple(range(x.ndim - n, x.ndim))) if n > 0 else x
+
+
 class Distribution:
     def __init__(self, batch_shape=(), event_shape=()):
         self._batch_shape = tuple(

@@ -31,6 +31,10 @@ class ExponentialFamily(Distribution):
     def entropy(self):
         """H = -<carrier> + A(θ) - Σ θ_i · ∇_i A(θ)  (Bregman identity)."""
         nat = [jnp.asarray(p) for p in self._natural_parameters]
+        # broadcast shared scalar parameters to the full batch first, else
+        # jax.grad sums their per-batch gradients into one number
+        common = jnp.broadcast_shapes(*(p.shape for p in nat)) if nat else ()
+        nat = [jnp.broadcast_to(p, common) for p in nat]
 
         def log_norm_sum(*ps):
             return self._log_normalizer(*ps).sum()
@@ -38,5 +42,10 @@ class ExponentialFamily(Distribution):
         grads = jax.grad(log_norm_sum, argnums=tuple(range(len(nat))))(*nat)
         ent = -self._mean_carrier_measure + self._log_normalizer(*nat)
         for p, g in zip(nat, grads):
-            ent = ent - p * g
+            term = p * g
+            # event-axis parameters (e.g. Dirichlet concentration) reduce
+            # over the event axis down to the entropy's batch rank
+            if term.ndim > ent.ndim:
+                term = term.sum(tuple(range(ent.ndim, term.ndim)))
+            ent = ent - term
         return _wrap(ent)

@@ -4,13 +4,9 @@ Reference: python/paddle/distribution/independent.py.
 """
 from __future__ import annotations
 
-from .distribution import Distribution, _wrap
+from .distribution import Distribution, _sum_rightmost, _wrap
 
 __all__ = ["Independent"]
-
-
-def _sum_rightmost(x, n):
-    return x.sum(tuple(range(x.ndim - n, x.ndim))) if n > 0 else x
 
 
 class Independent(Distribution):

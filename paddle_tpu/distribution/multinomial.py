@@ -65,5 +65,8 @@ class Multinomial(Distribution):
                    - gammaln(support + 1) - gammaln(n - support + 1)
                    + support * jnp.log(jnp.where(p > 0, p, 1.0))
                    + (n - support) * jnp.log1p(-jnp.where(p < 1, p, 0.0)))
-        corr = (jnp.exp(log_pmf) * gammaln(support + 1)).sum((0, -1))
+        # a zero-probability category contributes pmf 0 for every k >= 1 —
+        # the masked log above would otherwise leave log C(n,k) behind
+        pmf = jnp.where(p > 0, jnp.exp(log_pmf), 0.0)
+        corr = (pmf * gammaln(support + 1)).sum((0, -1))
         return _wrap(n * cat_h - gammaln(jnp.asarray(n + 1.0)) + corr)

@@ -14,7 +14,7 @@ import jax
 import jax.numpy as jnp
 
 from ..core.tensor import Tensor
-from .distribution import _value, _wrap
+from .distribution import _sum_rightmost, _value, _wrap
 
 __all__ = [
     "Transform", "AbsTransform", "AffineTransform", "ChainTransform",
@@ -46,13 +46,14 @@ class Transform:
         return Type.is_injective(cls._type)
 
     def __call__(self, x):
+        from .distribution import Distribution
         from .transformed_distribution import TransformedDistribution
 
-        if isinstance(x, (Tensor, jax.Array)):
-            return self.forward(x)
+        if isinstance(x, Distribution):
+            return TransformedDistribution(x, [self])
         if isinstance(x, Transform):
-            return ChainTransform([self, x])
-        return TransformedDistribution(x, [self])
+            return ChainTransform([x, self])  # composition: x applies first
+        return self.forward(x)  # Tensor / ndarray / scalar / list
 
     def forward(self, x):
         return _wrap(self._forward(_value(x)))
@@ -150,10 +151,6 @@ class ChainTransform(Transform):
         for t in reversed(self.transforms):
             shape = t.inverse_shape(shape)
         return shape
-
-
-def _sum_rightmost(x, n):
-    return x.sum(tuple(range(x.ndim - n, x.ndim))) if n > 0 else x
 
 
 class ExpTransform(Transform):

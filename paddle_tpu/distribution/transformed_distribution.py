@@ -4,7 +4,7 @@ Reference: python/paddle/distribution/transformed_distribution.py.
 """
 from __future__ import annotations
 
-from .distribution import Distribution, _value, _wrap
+from .distribution import Distribution, _sum_rightmost, _value, _wrap
 from .transform import ChainTransform, Transform
 
 __all__ = ["TransformedDistribution"]
@@ -50,15 +50,12 @@ class TransformedDistribution(Distribution):
         for t in reversed(self._transforms):
             x = t._inverse(y)
             ld = t._forward_log_det_jacobian(x)
-            n = event_rank - t.codomain_event_dim
-            if n > 0:
-                ld = ld.sum(tuple(range(ld.ndim - n, ld.ndim)))
-            log_det = log_det + ld
+            log_det = log_det + _sum_rightmost(
+                ld, event_rank - t.codomain_event_dim)
             y = x
             event_rank = (event_rank - t.codomain_event_dim
                           + t.domain_event_dim)
         base_lp = self._base.log_prob(_wrap(y))._value
-        n = event_rank - len(self._base.event_shape)
-        if n > 0:
-            base_lp = base_lp.sum(tuple(range(base_lp.ndim - n, base_lp.ndim)))
+        base_lp = _sum_rightmost(
+            base_lp, event_rank - len(self._base.event_shape))
         return _wrap(base_lp - log_det)

@@ -50,8 +50,3 @@ class Uniform(Distribution):
     def entropy(self):
         return _wrap(jnp.broadcast_to(jnp.log(self.high - self.low),
                                       self.batch_shape))
-
-    def kl_divergence(self, other):
-        from .kl import kl_divergence
-
-        return kl_divergence(self, other)

@@ -1,4 +1,7 @@
 """paddle.io (reference: python/paddle/io/__init__.py)."""
+from .checkpoint import (  # noqa: F401
+    CheckpointManager, abstract_state, load_checkpoint, save_checkpoint,
+)
 from .dataloader import DataLoader, default_collate_fn, get_worker_info  # noqa: F401
 from .dataset import (  # noqa: F401
     ChainDataset, ComposeDataset, ConcatDataset, Dataset, IterableDataset,

@@ -1,0 +1,128 @@
+"""Sharded, async checkpointing (orbax-backed).
+
+Reference capability: python/paddle/distributed/fleet/utils/fs.py +
+fleet checkpoint saving and paddle.save on sharded state
+(python/paddle/framework/io.py). TPU-native design: checkpoints are orbax
+PyTree checkpoints — each jax.Array leaf is written per-shard (OCDBT), so a
+dp/tp/pp-sharded train state saves and restores without gathering to one
+host; `async_save` overlaps serialization with the next train steps.
+Restore takes an abstract target (jax.eval_shape-style) carrying
+NamedShardings, so arrays come back resident on the right devices.
+
+Layout matches distributed/elastic.py's `latest_checkpoint`: one numbered
+subdirectory per step under the root.
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+import numpy as np
+
+from ..core.tensor import Tensor
+
+__all__ = ["CheckpointManager", "save_checkpoint", "load_checkpoint",
+           "abstract_state"]
+
+
+def _unwrap(tree):
+    return jax.tree_util.tree_map(
+        lambda x: x._value if isinstance(x, Tensor) else x, tree,
+        is_leaf=lambda x: isinstance(x, Tensor))
+
+
+def abstract_state(tree, mesh=None, spec_fn=None):
+    """Build the abstract restore target: ShapeDtypeStructs carrying each
+    leaf's sharding (or one derived from spec_fn(path_leaf) on `mesh`)."""
+    from jax.sharding import NamedSharding
+
+    def to_abstract(x):
+        if isinstance(x, Tensor):
+            x = x._value
+        if isinstance(x, jax.Array):
+            sharding = x.sharding
+            if mesh is not None and spec_fn is not None:
+                sharding = NamedSharding(mesh, spec_fn(x))
+            return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=sharding)
+        x = np.asarray(x)
+        return jax.ShapeDtypeStruct(x.shape, x.dtype)
+
+    return jax.tree_util.tree_map(to_abstract, tree,
+                                  is_leaf=lambda x: isinstance(x, Tensor))
+
+
+class CheckpointManager:
+    """Step-numbered async sharded checkpoints with retention.
+
+    Usage:
+        mngr = CheckpointManager(dir, max_to_keep=3)
+        mngr.save(step, {"params": params, "opt": opt_state})   # async
+        state = mngr.restore(target=abstract_state(live_state))
+    """
+
+    def __init__(self, directory, max_to_keep=5, async_save=True,
+                 save_interval_steps=1):
+        import orbax.checkpoint as ocp
+
+        self._ocp = ocp
+        self.directory = os.path.abspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        self._mngr = ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep,
+                save_interval_steps=save_interval_steps,
+                enable_async_checkpointing=async_save,
+            ))
+
+    def save(self, step, state, force=False):
+        """Queue an async sharded save of `state` (pytree of Tensors/arrays).
+        Returns True if the save was accepted (interval/retention policy)."""
+        return self._mngr.save(
+            int(step), args=self._ocp.args.StandardSave(_unwrap(state)),
+            force=force)
+
+    def restore(self, step=None, target=None):
+        """Restore `step` (newest if None). With `target` (from
+        abstract_state), leaves restore sharded onto their devices."""
+        if step is None:
+            step = self.latest_step()
+        if step is None:
+            raise FileNotFoundError(
+                f"no complete checkpoint under {self.directory}")
+        args = (self._ocp.args.StandardRestore(target)
+                if target is not None else None)
+        return self._mngr.restore(int(step), args=args)
+
+    def latest_step(self):
+        return self._mngr.latest_step()
+
+    def all_steps(self):
+        return sorted(self._mngr.all_steps())
+
+    def wait(self):
+        """Block until queued async saves are durable on disk."""
+        self._mngr.wait_until_finished()
+
+    def close(self):
+        self._mngr.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def save_checkpoint(directory, step, state, async_save=False):
+    """One-shot sharded save of `state` at `step` under `directory`."""
+    with CheckpointManager(directory, max_to_keep=None,
+                           async_save=async_save) as m:
+        m.save(step, state, force=True)
+        m.wait()
+
+
+def load_checkpoint(directory, step=None, target=None):
+    """One-shot restore (newest step if None)."""
+    with CheckpointManager(directory) as m:
+        return m.restore(step, target=target)

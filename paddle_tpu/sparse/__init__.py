@@ -1,0 +1,16 @@
+"""paddle.sparse — BCOO/BCSR-backed sparse tensors.
+
+Reference: python/paddle/sparse/__init__.py (sparse_coo_tensor,
+sparse_csr_tensor, ReLU).
+"""
+from . import functional  # noqa: F401
+from .creation import (  # noqa: F401
+    SparseCooTensor, SparseCsrTensor, sparse_coo_tensor, sparse_csr_tensor,
+    to_sparse_coo,
+)
+from .functional import masked_matmul, matmul, relu  # noqa: F401
+from .layer import ReLU  # noqa: F401
+
+__all__ = ["sparse_coo_tensor", "sparse_csr_tensor", "ReLU",
+           "SparseCooTensor", "SparseCsrTensor",
+           "relu", "matmul", "masked_matmul"]

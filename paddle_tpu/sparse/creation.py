@@ -1,0 +1,154 @@
+"""Sparse tensor creation.
+
+Reference: python/paddle/sparse/creation.py:42 (sparse_coo_tensor) and :115
+(sparse_csr_tensor). TPU-native design: payloads are
+jax.experimental.sparse BCOO/BCSR arrays — XLA-compilable sparse formats
+whose matmuls lower to gather + MXU dot_general, so sparse compute stays on
+device instead of a host scatter loop.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import sparse as jsparse
+
+from ..core import dtype as dtypes
+from ..core.tensor import Tensor
+
+__all__ = ["sparse_coo_tensor", "sparse_csr_tensor"]
+
+
+def _as_jnp(x, dtype=None):
+    if isinstance(x, Tensor):
+        x = x._value
+    v = jnp.asarray(x)
+    if dtype is not None:
+        v = v.astype(dtypes.to_jax_dtype(dtype))
+    return v
+
+
+def _infer_dense_shape(indices, values):
+    lo = tuple(int(d) + 1 for d in np.asarray(indices.max(axis=1)))
+    return lo + tuple(values.shape[1:])
+
+
+class SparseCooTensor:
+    """COO sparse tensor: [sparse_dim, nnz] indices + [nnz, ...] values."""
+
+    def __init__(self, bcoo):
+        self._bcoo = bcoo
+
+    @property
+    def shape(self):
+        return list(self._bcoo.shape)
+
+    @property
+    def dtype(self):
+        return dtypes.to_paddle_dtype(self._bcoo.dtype)
+
+    def indices(self):
+        return Tensor(self._bcoo.indices.T)  # paddle layout [ndim, nnz]
+
+    def values(self):
+        return Tensor(self._bcoo.data)
+
+    def nnz(self):
+        return int(self._bcoo.nse)
+
+    def to_dense(self):
+        return Tensor(self._bcoo.todense())
+
+    def coalesce(self):
+        return SparseCooTensor(self._bcoo.sum_duplicates())
+
+    def is_sparse_coo(self):
+        return True
+
+    def is_sparse_csr(self):
+        return False
+
+    def to_sparse_csr(self):
+        return SparseCsrTensor(jsparse.BCSR.from_bcoo(self._bcoo))
+
+    def __repr__(self):
+        return (f"SparseCooTensor(shape={self.shape}, nnz={self.nnz()}, "
+                f"dtype={self.dtype})")
+
+
+class SparseCsrTensor:
+    """CSR sparse tensor: crows/cols/values (2D, or batched 3D)."""
+
+    def __init__(self, bcsr):
+        self._bcsr = bcsr
+
+    @property
+    def shape(self):
+        return list(self._bcsr.shape)
+
+    @property
+    def dtype(self):
+        return dtypes.to_paddle_dtype(self._bcsr.dtype)
+
+    def crows(self):
+        return Tensor(self._bcsr.indptr)
+
+    def cols(self):
+        return Tensor(self._bcsr.indices)
+
+    def values(self):
+        return Tensor(self._bcsr.data)
+
+    def nnz(self):
+        return int(self._bcsr.nse)
+
+    def to_dense(self):
+        return Tensor(self._bcsr.todense())
+
+    def is_sparse_coo(self):
+        return False
+
+    def is_sparse_csr(self):
+        return True
+
+    def to_sparse_coo(self, sparse_dim=None):
+        return SparseCooTensor(self._bcsr.to_bcoo())
+
+    def __repr__(self):
+        return (f"SparseCsrTensor(shape={self.shape}, nnz={self.nnz()}, "
+                f"dtype={self.dtype})")
+
+
+def sparse_coo_tensor(indices, values, shape=None, dtype=None, place=None,
+                      stop_gradient=True):
+    idx = _as_jnp(indices)
+    if idx.dtype not in (jnp.int32, jnp.int64):
+        idx = idx.astype(jnp.int32)
+    vals = _as_jnp(values, dtype)
+    if idx.ndim != 2:
+        raise ValueError("indices must be [sparse_dim, nnz]")
+    if shape is None:
+        shape = _infer_dense_shape(idx, vals)
+    bcoo = jsparse.BCOO((vals, idx.T), shape=tuple(int(s) for s in shape))
+    return SparseCooTensor(bcoo)
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None, place=None,
+                      stop_gradient=True):
+    crows = _as_jnp(crows)
+    cols = _as_jnp(cols)
+    vals = _as_jnp(values, dtype)
+    shape = tuple(int(s) for s in shape)
+    if crows.dtype not in (jnp.int32, jnp.int64):
+        crows = crows.astype(jnp.int32)
+    if cols.dtype not in (jnp.int32, jnp.int64):
+        cols = cols.astype(jnp.int32)
+    bcsr = jsparse.BCSR((vals, cols, crows), shape=shape)
+    return SparseCsrTensor(bcsr)
+
+
+def to_sparse_coo(dense, sparse_dim):
+    """Dense Tensor -> SparseCooTensor with `sparse_dim` leading sparse axes."""
+    v = dense._value if isinstance(dense, Tensor) else jnp.asarray(dense)
+    n_dense = v.ndim - int(sparse_dim)
+    bcoo = jsparse.BCOO.fromdense(v, n_dense=n_dense)
+    return SparseCooTensor(bcoo)

@@ -1,0 +1,61 @@
+"""paddle.sparse.functional.
+
+Reference: python/paddle/sparse/functional/activation.py:20 (relu). Extended
+with matmul/masked_matmul mirroring the phi sparse kernel capability
+(paddle/phi/kernels/sparse/) — on TPU these lower through BCOO dot_general
+so the dense side rides the MXU.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax.experimental import sparse as jsparse
+
+from ...core.tensor import Tensor
+from ..creation import SparseCooTensor, SparseCsrTensor
+
+__all__ = ["relu", "matmul", "masked_matmul"]
+
+
+def _map_values(x, fn):
+    if isinstance(x, SparseCooTensor):
+        b = x._bcoo
+        return SparseCooTensor(jsparse.BCOO((fn(b.data), b.indices),
+                                            shape=b.shape))
+    if isinstance(x, SparseCsrTensor):
+        b = x._bcsr
+        return SparseCsrTensor(jsparse.BCSR((fn(b.data), b.indices, b.indptr),
+                                            shape=b.shape))
+    raise TypeError(f"expected sparse tensor, got {type(x)}")
+
+
+def relu(x, name=None):
+    """Elementwise relu on the stored values (zeros stay zero)."""
+    return _map_values(x, lambda v: jnp.maximum(v, 0))
+
+
+def matmul(x, y, name=None):
+    """Sparse @ dense -> dense. x: SparseCoo/CsrTensor, y: dense Tensor."""
+    yv = y._value if isinstance(y, Tensor) else jnp.asarray(y)
+    if isinstance(x, SparseCsrTensor):
+        x = x.to_sparse_coo()
+    if not isinstance(x, SparseCooTensor):
+        raise TypeError(f"expected sparse lhs, got {type(x)}")
+    return Tensor(x._bcoo @ yv)
+
+
+def masked_matmul(x, y, mask, name=None):
+    """(dense @ dense) sampled at mask's sparsity pattern (SDDMM)."""
+    xv = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+    yv = y._value if isinstance(y, Tensor) else jnp.asarray(y)
+    as_csr = isinstance(mask, SparseCsrTensor)
+    if as_csr:
+        mask = mask.to_sparse_coo()
+    if not isinstance(mask, SparseCooTensor):
+        raise TypeError(f"expected sparse mask, got {type(mask)}")
+    bcoo = mask._bcoo
+    data = jsparse.bcoo_dot_general_sampled(
+        xv, yv, bcoo.indices,
+        dimension_numbers=(((xv.ndim - 1,), (yv.ndim - 2,)), ((), ())))
+    out = SparseCooTensor(jsparse.BCOO((data, bcoo.indices),
+                                       shape=bcoo.shape))
+    return out.to_sparse_csr() if as_csr else out

@@ -1,0 +1,15 @@
+"""paddle.sparse.layer.
+
+Reference: python/paddle/sparse/layer/activation.py (ReLU).
+"""
+from __future__ import annotations
+
+from ...nn.layer.layers import Layer
+from .. import functional as F
+
+__all__ = ["ReLU"]
+
+
+class ReLU(Layer):
+    def forward(self, x):
+        return F.relu(x)

@@ -1,0 +1,127 @@
+"""Sharded checkpoint round-trip + elastic kill-and-resume (VERDICT item 10).
+
+Reference capability: fleet sharded checkpoints +
+distributed/fleet/elastic/manager.py auto-resume.
+"""
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed.elastic import ElasticManager, latest_checkpoint
+from paddle_tpu.io.checkpoint import (
+    CheckpointManager, abstract_state, load_checkpoint, save_checkpoint,
+)
+
+
+@pytest.fixture
+def mesh():
+    devs = np.array(jax.devices()[:8]).reshape(4, 2)
+    return Mesh(devs, ("dp", "tp"))
+
+
+def _sharded_state(mesh):
+    rng = np.random.RandomState(0)
+    w = jax.device_put(jnp.asarray(rng.randn(8, 16).astype(np.float32)),
+                       NamedSharding(mesh, P("dp", "tp")))
+    b = jax.device_put(jnp.asarray(rng.randn(16).astype(np.float32)),
+                       NamedSharding(mesh, P("tp")))
+    return {"params": {"w": w, "b": b}, "step": jnp.int32(7)}
+
+
+def test_sharded_roundtrip(tmp_path, mesh):
+    state = _sharded_state(mesh)
+    save_checkpoint(str(tmp_path / "ckpt"), 0, state)
+    restored = load_checkpoint(str(tmp_path / "ckpt"), 0,
+                               target=abstract_state(state))
+    np.testing.assert_array_equal(np.asarray(restored["params"]["w"]),
+                                  np.asarray(state["params"]["w"]))
+    # restored arrays carry the original NamedSharding: 4x2 shards of (2, 8)
+    shard_shapes = {s.data.shape for s in restored["params"]["w"].addressable_shards}
+    assert shard_shapes == {(2, 8)}
+    assert restored["params"]["b"].sharding.is_equivalent_to(
+        state["params"]["b"].sharding, 1)
+
+
+def test_restore_with_different_sharding(tmp_path, mesh):
+    """Resharding on restore: save dp-sharded, restore tp-sharded."""
+    state = _sharded_state(mesh)
+    save_checkpoint(str(tmp_path / "c"), 3, state)
+    target = abstract_state(state)
+    target["params"]["w"] = jax.ShapeDtypeStruct(
+        (8, 16), jnp.float32, sharding=NamedSharding(mesh, P(None, "tp")))
+    restored = load_checkpoint(str(tmp_path / "c"), target=target)
+    np.testing.assert_array_equal(np.asarray(restored["params"]["w"]),
+                                  np.asarray(state["params"]["w"]))
+    assert {s.data.shape for s in
+            restored["params"]["w"].addressable_shards} == {(8, 8)}
+
+
+def test_manager_async_retention(tmp_path, mesh):
+    state = _sharded_state(mesh)
+    with CheckpointManager(str(tmp_path / "m"), max_to_keep=2,
+                           async_save=True) as m:
+        for step in range(5):
+            state = {**state, "step": jnp.int32(step)}
+            assert m.save(step, state, force=True)
+        m.wait()
+        assert m.latest_step() == 4
+        assert m.all_steps() == [3, 4]  # max_to_keep pruned the rest
+        restored = m.restore(target=abstract_state(state))
+    assert int(restored["step"]) == 4
+
+
+def test_elastic_kill_and_resume(tmp_path, mesh):
+    """Train, 'die' mid-run, come back, resume from newest checkpoint."""
+    ckpt_dir = str(tmp_path / "elastic")
+
+    def run(until_step, resume=True):
+        """One trainer lifetime; returns (last_step, final_w)."""
+        state = _sharded_state(mesh)
+        m = CheckpointManager(ckpt_dir, max_to_keep=3, async_save=False)
+        em = ElasticManager(ckpt_dir, timeout=9999, save_interval=2,
+                            save_fn=lambda s: m.save(s, state, force=True))
+        holder = {}
+
+        def restore(step):
+            holder.update(m.restore(step, target=abstract_state(state)))
+
+        start = em.resume(restore) if resume else 0
+        if holder:
+            state = {"params": holder["params"], "step": holder["step"]}
+        w = state["params"]["w"]
+        step = start
+        for step in range(start, until_step):
+            w = w + 1.0  # "training"
+            state = {"params": {"w": w, "b": state["params"]["b"]},
+                     "step": jnp.int32(step)}
+            em.tick(step)
+        m.wait()
+        m.close()
+        return step, state["params"]["w"]
+
+    # first lifetime: reaches step 5, last complete checkpoint at step 4
+    run(6, resume=False)
+    assert latest_checkpoint(ckpt_dir) == 4
+    # second lifetime resumes at 5 and continues to 9
+    last, w = run(10)
+    assert last == 9
+    # w was checkpointed at step 4 (after 5 increments), then 5 more: 10
+    np.testing.assert_allclose(np.asarray(w)[0, 0],
+                               np.asarray(_sharded_state(mesh)["params"]["w"])[0, 0] + 10)
+
+
+def test_elastic_watchdog_detects_stall(tmp_path):
+    em = ElasticManager(str(tmp_path / "wd"), timeout=0.2)
+    em.tick(0)
+    stalls = []
+    em.start_watchdog(on_stall=stalls.append, poll=0.1)
+    import time
+
+    time.sleep(0.8)
+    em.stop()
+    assert em.stalled and stalls and stalls[0]["step"] == 0

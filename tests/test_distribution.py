@@ -104,6 +104,13 @@ class TestCategorical:
         np.testing.assert_allclose(lp, np.log([0.5, 0.75]), rtol=1e-5)
         assert npv(d.sample((5,))).shape == (5, 2)
 
+    def test_batched_scores_own_samples(self):
+        d = Categorical(np.array([[1.0, 1.0], [1.0, 3.0]]))
+        s = d.sample((5,))
+        lp = npv(d.log_prob(s))
+        assert lp.shape == (5, 2)
+        assert np.all(lp <= 0)
+
 
 class TestBeta:
     def test_log_prob_entropy_moments(self):
@@ -128,6 +135,16 @@ class TestBeta:
         bregman = npv(ExponentialFamily.entropy(d))
         closed = st.beta([2.0, 3.0], [5.0, 0.5]).entropy()
         np.testing.assert_allclose(bregman, closed, rtol=1e-4)
+        # shared scalar param broadcast across a batched one
+        d2 = Beta(2.0, np.array([1.0, 2.0, 3.0]))
+        np.testing.assert_allclose(npv(ExponentialFamily.entropy(d2)),
+                                   st.beta(2.0, [1.0, 2.0, 3.0]).entropy(),
+                                   rtol=1e-4)
+        # event-axis params reduce to batch rank (scalar here)
+        d3 = Dirichlet(np.array([2.0, 3.0, 4.0]))
+        np.testing.assert_allclose(
+            npv(ExponentialFamily.entropy(d3)),
+            st.dirichlet([2.0, 3.0, 4.0]).entropy(), rtol=1e-4)
 
     def test_kl_vs_scipy_mc(self):
         p, q = Beta(2.0, 3.0), Beta(4.0, 2.0)
@@ -191,6 +208,12 @@ class TestMultinomial:
         np.testing.assert_allclose(
             npv(d.entropy()),
             st.multinomial(10, [0.2, 0.3, 0.5]).entropy(), rtol=1e-4)
+
+    def test_entropy_zero_prob_category(self):
+        d = Multinomial(10, np.array([0.5, 0.5, 0.0]))
+        np.testing.assert_allclose(
+            npv(d.entropy()),
+            st.multinomial(10, [0.5, 0.5]).entropy(), rtol=1e-4)
 
     def test_sample(self):
         d = Multinomial(20, np.array([0.25, 0.75]))
@@ -261,6 +284,16 @@ class TestTransforms:
                                    st.lognorm(s=0.8,
                                               scale=np.exp(0.3)).mean(),
                                    rtol=0.05)
+
+    def test_call_coerces_raw_values(self):
+        t = ExpTransform()
+        np.testing.assert_allclose(npv(t(np.array([0.0, 1.0]))),
+                                   [1.0, np.e], rtol=1e-6)
+        d = t(Normal(0.0, 1.0))
+        assert isinstance(d, TransformedDistribution)
+        chained = t(AffineTransform(0.0, 2.0))
+        np.testing.assert_allclose(npv(chained(np.array([1.0]))),
+                                   np.exp(2.0), rtol=1e-6)
 
     def test_chain(self):
         t = ChainTransform([AffineTransform(1.0, 2.0), ExpTransform()])

@@ -1,0 +1,103 @@
+"""paddle.sparse tests (reference: python/paddle/sparse + phi sparse kernels)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_sparse_coo_create_roundtrip():
+    indices = [[0, 1, 2], [1, 2, 0]]
+    values = [1.0, 2.0, 3.0]
+    s = paddle.sparse.sparse_coo_tensor(indices, values, shape=[3, 3])
+    dense = np.zeros((3, 3), np.float32)
+    dense[0, 1], dense[1, 2], dense[2, 0] = 1, 2, 3
+    np.testing.assert_allclose(s.to_dense().numpy(), dense)
+    assert s.nnz() == 3
+    np.testing.assert_allclose(np.asarray(s.indices()._value), indices)
+    np.testing.assert_allclose(np.asarray(s.values()._value), values)
+    assert s.is_sparse_coo() and not s.is_sparse_csr()
+
+
+def test_sparse_coo_infer_shape():
+    s = paddle.sparse.sparse_coo_tensor([[0, 2], [1, 3]], [5.0, 7.0])
+    assert s.shape == [3, 4]
+
+
+def test_sparse_csr_create_roundtrip():
+    crows = [0, 2, 3, 5]
+    cols = [1, 3, 2, 0, 1]
+    values = [1.0, 2.0, 3.0, 4.0, 5.0]
+    s = paddle.sparse.sparse_csr_tensor(crows, cols, values, [3, 4])
+    dense = np.zeros((3, 4), np.float32)
+    dense[0, 1], dense[0, 3], dense[1, 2] = 1, 2, 3
+    dense[2, 0], dense[2, 1] = 4, 5
+    np.testing.assert_allclose(s.to_dense().numpy(), dense)
+    np.testing.assert_allclose(np.asarray(s.crows()._value), crows)
+    assert s.is_sparse_csr()
+
+
+def test_dense_to_sparse_and_back():
+    x = paddle.to_tensor(np.array([[0.0, 1.0], [2.0, 0.0]], np.float32))
+    s = x.to_sparse_coo(2)
+    assert s.nnz() == 2
+    np.testing.assert_allclose(s.to_dense().numpy(), x.numpy())
+    csr = s.to_sparse_csr()
+    np.testing.assert_allclose(csr.to_dense().numpy(), x.numpy())
+    back = csr.to_sparse_coo(2)
+    np.testing.assert_allclose(back.to_dense().numpy(), x.numpy())
+
+
+def test_sparse_relu():
+    x = paddle.to_tensor(np.array([[0.0, -1.0], [2.0, -3.0]], np.float32))
+    s = paddle.sparse.relu(x.to_sparse_coo(2))
+    np.testing.assert_allclose(s.to_dense().numpy(),
+                               np.maximum(x.numpy(), 0))
+    layer = paddle.sparse.ReLU()
+    s2 = layer(x.to_sparse_coo(2))
+    np.testing.assert_allclose(s2.to_dense().numpy(),
+                               np.maximum(x.numpy(), 0))
+
+
+def test_sparse_matmul():
+    rng = np.random.RandomState(0)
+    dense = rng.randn(8, 6).astype(np.float32)
+    dense[dense < 0.5] = 0.0  # ~70% sparse
+    y = rng.randn(6, 4).astype(np.float32)
+    s = paddle.to_tensor(dense).to_sparse_coo(2)
+    out = paddle.sparse.matmul(s, paddle.to_tensor(y))
+    np.testing.assert_allclose(out.numpy(), dense @ y, rtol=1e-5)
+    # CSR path
+    out2 = paddle.sparse.matmul(
+        paddle.to_tensor(dense).to_sparse_coo(2).to_sparse_csr(),
+        paddle.to_tensor(y))
+    np.testing.assert_allclose(out2.numpy(), dense @ y, rtol=1e-5)
+
+
+def test_masked_matmul():
+    rng = np.random.RandomState(1)
+    x = rng.randn(5, 3).astype(np.float32)
+    y = rng.randn(3, 5).astype(np.float32)
+    mask_dense = (rng.rand(5, 5) < 0.4).astype(np.float32)
+    mask = paddle.to_tensor(mask_dense).to_sparse_coo(2)
+    out = paddle.sparse.masked_matmul(paddle.to_tensor(x),
+                                      paddle.to_tensor(y), mask)
+    expect = (x @ y) * mask_dense
+    np.testing.assert_allclose(out.to_dense().numpy(), expect, rtol=1e-5)
+
+
+def test_sparse_matmul_grad():
+    """Sparse values participate in jax autodiff (BCOO is a pytree)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import sparse as jsparse
+
+    dense = np.array([[1.0, 0.0], [0.0, 2.0]], np.float32)
+    bcoo = jsparse.BCOO.fromdense(jnp.asarray(dense))
+    y = jnp.ones((2, 2), jnp.float32)
+
+    def loss(data):
+        m = jsparse.BCOO((data, bcoo.indices), shape=bcoo.shape)
+        return (m @ y).sum()
+
+    g = jax.grad(loss)(bcoo.data)
+    np.testing.assert_allclose(np.asarray(g), [2.0, 2.0])

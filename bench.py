@@ -187,13 +187,48 @@ def bench_flash_attention(batch=4, heads=12, seq=512, dim=64, iters=50):
     return res
 
 
+def bench_dataloader(n=512, batch=64, shape=(3, 224, 224), epochs=3):
+    """Input pipeline A/B: thread-prefetch DataLoader vs the C++ staging
+    ring (csrc/staging_pool.cpp) — imgs/sec of collate+transfer."""
+    import paddle_tpu as paddle
+
+    class SynthDataset(paddle.io.Dataset):
+        rng = np.random.RandomState(0)
+        base = rng.randn(32, *shape).astype(np.float32)
+
+        def __len__(self):
+            return n
+
+        def __getitem__(self, i):
+            # simulate decode/augment work: flip + normalize
+            img = self.base[i % 32]
+            img = img[..., ::-1] * (1.0 / 255.0) - 0.5
+            return np.ascontiguousarray(img), np.int64(i % 10)
+
+    res = {}
+    for name, kw in [("threads", {}), ("staging", {"use_staging_pool": True})]:
+        loader = paddle.io.DataLoader(SynthDataset(), batch_size=batch,
+                                      num_workers=4, **kw)
+        for x, _ in loader:  # warm (compile/allocate/pool build)
+            pass
+        t0 = time.perf_counter()
+        count = 0
+        for _ in range(epochs):
+            for x, _ in loader:
+                count += int(x.shape[0])
+        _sync(x._value)
+        res[f"dataloader_{name}_imgs_per_sec"] = count / (
+            time.perf_counter() - t0)
+    return res
+
+
 def main():
     import jax
 
     details = {"backend": jax.default_backend(),
                "device_count": jax.device_count()}
     for bench in (bench_bert, bench_resnet50, bench_lenet,
-                  bench_flash_attention):
+                  bench_flash_attention, bench_dataloader):
         try:
             details.update(bench())
         except Exception as e:  # noqa: BLE001

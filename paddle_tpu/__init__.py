@@ -66,6 +66,7 @@ from . import distributed  # noqa: F401
 from . import inference  # noqa: F401
 from . import distribution  # noqa: F401
 from . import sparse  # noqa: F401
+from . import utils  # noqa: F401
 from . import profiler  # noqa: F401
 from . import device  # noqa: F401
 from .device import (  # noqa: F401

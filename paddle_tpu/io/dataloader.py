@@ -25,6 +25,30 @@ class _WorkerError:
         self.exc = exc
 
 
+class _Staged:
+    """Marker for a batch parked in the C++ staging pool."""
+
+    def __init__(self, slot, meta, treedef):
+        self.slot = slot
+        self.meta = meta
+        self.treedef = treedef
+
+
+def _numpy_collate(batch):
+    """default_collate_fn variant that keeps leaves as numpy (stageable)."""
+    sample = batch[0]
+    if isinstance(sample, np.ndarray):
+        return np.stack(batch)
+    if isinstance(sample, (int, float, np.integer, np.floating)):
+        return np.asarray(batch)
+    if isinstance(sample, (list, tuple)):
+        return tuple(_numpy_collate([b[i] for b in batch])
+                     for i in range(len(sample)))
+    if isinstance(sample, dict):
+        return {k: _numpy_collate([b[k] for b in batch]) for k in sample}
+    return None  # not stageable (Tensors / arbitrary objects)
+
+
 class WorkerInfo:
     def __init__(self, id, num_workers, dataset):  # noqa: A002
         self.id = id
@@ -60,13 +84,19 @@ class DataLoader:
                  shuffle=False, drop_last=False, collate_fn=None,
                  num_workers=0, use_buffer_reader=True, prefetch_factor=2,
                  use_shared_memory=True, timeout=0, worker_init_fn=None,
-                 persistent_workers=False):
+                 persistent_workers=False, use_staging_pool=False):
         self.dataset = dataset
         self.collate_fn = collate_fn or default_collate_fn
         self.num_workers = max(0, int(num_workers))
         self.prefetch_factor = prefetch_factor
         self.worker_init_fn = worker_init_fn
         self.timeout = timeout
+        # route batches through the C++ staging ring (csrc/staging_pool.cpp);
+        # only applies with worker threads + the default (numpy-able) collate
+        self.use_staging_pool = (bool(use_staging_pool)
+                                 and collate_fn is None)
+        self._pool = None
+        self._pool_lock = threading.Lock()
         self._iterable = isinstance(dataset, IterableDataset)
         if self._iterable:
             self.batch_sampler = None
@@ -95,6 +125,64 @@ class DataLoader:
     # ---- iteration -------------------------------------------------------
     def _fetch(self, indices):
         return self.collate_fn([self.dataset[i] for i in indices])
+
+    @property
+    def _window(self):
+        """Prefetch depth; also the staging-ring size (their equality is
+        load-bearing: n_slots >= max_ahead keeps the pipeline live)."""
+        return max(2, self.num_workers * self.prefetch_factor)
+
+    def _fetch_staged(self, indices):
+        """Collate to numpy and park the batch in the staging ring.
+        Falls back to the normal path for unstageable/oversized batches."""
+        import jax
+
+        items = [self.dataset[i] for i in indices]  # fetched exactly once
+        batch = _numpy_collate(items)
+        leaves, treedef = (jax.tree_util.tree_flatten(
+            batch, is_leaf=lambda x: x is None) if batch is not None
+            else ([None], None))
+        if not all(isinstance(a, np.ndarray) for a in leaves):
+            return self.collate_fn(items)
+        need = sum((a.nbytes + 63) // 64 * 64 for a in leaves)
+        # size the ring from the NOMINAL batch size, not whichever (possibly
+        # ragged, out-of-order) batch happens to arrive first
+        nominal = need * max(1, self.batch_size or 1) / max(1, len(indices))
+        pool = self._ensure_pool(nominal)
+        if pool is None or need > pool.slot_bytes:
+            return self.collate_fn(items)
+        slot = pool.acquire_write()
+        if slot < 0:
+            return self.collate_fn(items)
+        meta = pool.write_arrays(slot, leaves)
+        return _Staged(slot, meta, treedef)
+
+    def _ensure_pool(self, nominal_batch_bytes):
+        from ..runtime.staging import StagingPool
+
+        with self._pool_lock:
+            if self._pool is None:
+                slot_bytes = int(nominal_batch_bytes * 1.25) + 64
+                try:
+                    self._pool = StagingPool(self._window, slot_bytes)
+                except (MemoryError, RuntimeError):
+                    self.use_staging_pool = False
+            return self._pool
+
+    def _unstage(self, staged):
+        """Device-put the slot's views, then recycle the slot."""
+        import jax
+        import jax.numpy as jnp
+
+        views = self._pool.view_arrays(staged.slot, staged.meta)
+        # copy=True: the CPU backend would otherwise zero-copy ALIAS the
+        # aligned slot memory, which is recycled right below
+        tensors = [Tensor(jnp.array(v, copy=True)) for v in views]
+        # make sure the host->device copies consumed the buffer before the
+        # slot can be reused
+        jax.block_until_ready([t._value for t in tensors])
+        self._pool.release(staged.slot)
+        return jax.tree_util.tree_unflatten(staged.treedef, tensors)
 
     def _iter_single(self):
         if self._iterable:
@@ -126,7 +214,7 @@ class DataLoader:
             task_q.put((n_tasks, indices))
         total = task_q.qsize()
         stop = threading.Event()
-        max_ahead = max(2, self.num_workers * self.prefetch_factor)
+        max_ahead = self._window
         next_to_yield = [0]
         init_err = [None]
 
@@ -152,10 +240,18 @@ class DataLoader:
                 if stop.is_set():
                     return
                 try:
-                    batch = self._fetch(indices)
+                    batch = (self._fetch_staged(indices)
+                             if self.use_staging_pool
+                             else self._fetch(indices))
                 except BaseException as e:  # propagate to the consumer
                     batch = _WorkerError(e)
                 with cond:
+                    if stop.is_set():
+                        # consumer already drained `out`; recycle rather
+                        # than stage into the abandoned dict (slot leak)
+                        if isinstance(batch, _Staged):
+                            self._pool.release(batch.slot)
+                        return
                     out[i] = batch
                     cond.notify_all()
 
@@ -184,10 +280,18 @@ class DataLoader:
                     cond.notify_all()
                 if isinstance(batch, _WorkerError):
                     raise batch.exc
+                if isinstance(batch, _Staged):
+                    batch = self._unstage(batch)
                 yield batch
         finally:
-            stop.set()
+            stop.set()  # set BEFORE taking cond: workers re-check under it
             with cond:
+                # recycle slots of batches that were staged but never
+                # yielded (early break) so the pool survives re-iteration
+                for b in out.values():
+                    if isinstance(b, _Staged):
+                        self._pool.release(b.slot)
+                out.clear()
                 cond.notify_all()
 
     def __iter__(self):

@@ -1,0 +1,130 @@
+"""Host staging pool (Python side of csrc/staging_pool.cpp).
+
+Reference capability: fluid/operators/reader/buffered_reader.cc — pinned
+staging buffers between the data pipeline and the device. Workers memcpy
+collated numpy batches into fixed 64-byte-aligned C++ slots (the ctypes call
+releases the GIL, so copies parallelize across workers); the consumer wraps
+each slot zero-copy with np.frombuffer and hands it to jax.device_put.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import threading
+
+import numpy as np
+
+__all__ = ["StagingPool", "staging_lib"]
+
+_lib = None
+_lib_lock = threading.Lock()
+
+
+def staging_lib():
+    """Build (cached) and load csrc/staging_pool.cpp via cpp_extension."""
+    global _lib
+    with _lib_lock:
+        if _lib is None:
+            from ..utils.cpp_extension import load
+
+            src = os.path.join(os.path.dirname(__file__), "..", "..",
+                               "csrc", "staging_pool.cpp")
+            lib = load("staging_pool", [os.path.normpath(src)])
+            lib.sp_create.restype = ctypes.c_void_p
+            lib.sp_create.argtypes = [ctypes.c_int, ctypes.c_size_t]
+            lib.sp_destroy.argtypes = [ctypes.c_void_p]
+            lib.sp_slot_bytes.restype = ctypes.c_size_t
+            lib.sp_slot_bytes.argtypes = [ctypes.c_void_p]
+            lib.sp_num_slots.restype = ctypes.c_int
+            lib.sp_num_slots.argtypes = [ctypes.c_void_p]
+            lib.sp_acquire_write.restype = ctypes.c_int
+            lib.sp_acquire_write.argtypes = [ctypes.c_void_p, ctypes.c_int]
+            lib.sp_slot_ptr.restype = ctypes.c_void_p
+            lib.sp_slot_ptr.argtypes = [ctypes.c_void_p, ctypes.c_int]
+            lib.sp_copy_in.restype = ctypes.c_int
+            lib.sp_copy_in.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                                       ctypes.c_size_t, ctypes.c_void_p,
+                                       ctypes.c_size_t]
+            lib.sp_commit.argtypes = [ctypes.c_void_p, ctypes.c_int]
+            lib.sp_acquire_read.restype = ctypes.c_int
+            lib.sp_acquire_read.argtypes = [ctypes.c_void_p, ctypes.c_int]
+            lib.sp_release.argtypes = [ctypes.c_void_p, ctypes.c_int]
+            _lib = lib
+    return _lib
+
+
+def _align(n, a=64):
+    return (n + a - 1) // a * a
+
+
+class StagingPool:
+    """Fixed ring of aligned host slots; free/ready FIFO lives in C++."""
+
+    def __init__(self, n_slots, slot_bytes):
+        self._lib = staging_lib()
+        self._pool = self._lib.sp_create(int(n_slots), int(slot_bytes))
+        if not self._pool:
+            raise MemoryError(
+                f"staging pool alloc failed ({n_slots} x {slot_bytes} B)")
+        self.n_slots = int(n_slots)
+        self.slot_bytes = int(slot_bytes)
+
+    # -- producer side ------------------------------------------------------
+    def acquire_write(self, timeout_ms=-1):
+        return self._lib.sp_acquire_write(self._pool, int(timeout_ms))
+
+    def write_arrays(self, slot, arrays):
+        """memcpy each ndarray into the slot (GIL-free); returns the offset
+        metadata [(offset, shape, dtype), ...] needed to view them back."""
+        meta = []
+        offset = 0
+        for a in arrays:
+            a = np.ascontiguousarray(a)
+            if offset + a.nbytes > self.slot_bytes:
+                raise ValueError(
+                    f"batch ({offset + a.nbytes} B) exceeds slot "
+                    f"({self.slot_bytes} B)")
+            rc = self._lib.sp_copy_in(self._pool, slot, offset,
+                                      a.ctypes.data, a.nbytes)
+            if rc != 0:
+                raise RuntimeError("sp_copy_in failed")
+            meta.append((offset, a.shape, a.dtype))
+            offset = _align(offset + a.nbytes)
+        return meta
+
+    def stage(self, arrays, timeout_ms=-1):
+        """acquire_write + write + commit; returns (slot, meta) or None."""
+        slot = self.acquire_write(timeout_ms)
+        if slot < 0:
+            return None
+        meta = self.write_arrays(slot, arrays)
+        self._lib.sp_commit(self._pool, slot)
+        return slot, meta
+
+    # -- consumer side ------------------------------------------------------
+    def acquire_read(self, timeout_ms=-1):
+        return self._lib.sp_acquire_read(self._pool, int(timeout_ms))
+
+    def view_arrays(self, slot, meta):
+        """Zero-copy np views of the staged arrays (valid until release)."""
+        base = self._lib.sp_slot_ptr(self._pool, slot)
+        views = []
+        for offset, shape, dtype in meta:
+            nbytes = int(np.prod(shape)) * np.dtype(dtype).itemsize
+            buf = (ctypes.c_char * nbytes).from_address(base + offset)
+            views.append(np.frombuffer(buf, dtype=dtype).reshape(shape))
+        return views
+
+    def release(self, slot):
+        self._lib.sp_release(self._pool, slot)
+
+    def close(self):
+        if self._pool:
+            self._lib.sp_destroy(self._pool)
+            self._pool = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:  # interpreter teardown
+            pass

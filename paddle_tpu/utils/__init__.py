@@ -1,0 +1,13 @@
+"""paddle.utils (reference: python/paddle/utils/__init__.py)."""
+from . import cpp_extension  # noqa: F401
+
+__all__ = ["cpp_extension", "try_import"]
+
+
+def try_import(module_name, err_msg=None):
+    import importlib
+
+    try:
+        return importlib.import_module(module_name)
+    except ImportError as e:
+        raise ImportError(err_msg or f"please install {module_name}") from e

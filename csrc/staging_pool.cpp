@@ -21,6 +21,7 @@
 #include <cstring>
 #include <deque>
 #include <mutex>
+#include <thread>
 #include <vector>
 
 namespace {
@@ -33,15 +34,23 @@ struct StagingPool {
   std::mutex mu;
   std::condition_variable free_cv;
   std::condition_variable ready_cv;
-  std::condition_variable drain_cv;  // sp_destroy waits for waiters here
-  int waiters = 0;
+  // counts threads anywhere inside sp_acquire_* — incremented BEFORE the
+  // mutex is taken, so sp_destroy seeing 0 after `closed` means no thread
+  // can still touch the pool (callers must not start new calls after
+  // destroy; the Python wrapper nulls its handle first)
+  std::atomic<int> inflight{0};
   bool closed = false;
+};
+
+struct InflightGuard {
+  std::atomic<int>& c;
+  explicit InflightGuard(std::atomic<int>& c) : c(c) { c.fetch_add(1); }
+  ~InflightGuard() { c.fetch_sub(1); }
 };
 
 bool wait_pop(StagingPool* p, std::deque<int>& q, std::condition_variable& cv,
               int timeout_ms, int* out) {
   std::unique_lock<std::mutex> lk(p->mu);
-  ++p->waiters;
   auto ready = [&] { return !q.empty() || p->closed; };
   bool ok = true;
   if (timeout_ms < 0) {
@@ -49,8 +58,6 @@ bool wait_pop(StagingPool* p, std::deque<int>& q, std::condition_variable& cv,
   } else {
     ok = cv.wait_for(lk, std::chrono::milliseconds(timeout_ms), ready);
   }
-  --p->waiters;
-  if (p->closed && p->waiters == 0) p->drain_cv.notify_all();
   if (!ok || q.empty()) return false;  // timeout or closed
   *out = q.front();
   q.pop_front();
@@ -83,13 +90,16 @@ void sp_destroy(void* pool) {
   auto* p = static_cast<StagingPool*>(pool);
   if (!p) return;
   {
-    // wake every waiter and wait for them to leave the mutex/deques
-    // before freeing — otherwise woken waiters touch freed memory
-    std::unique_lock<std::mutex> lk(p->mu);
+    std::lock_guard<std::mutex> lk(p->mu);
     p->closed = true;
     p->free_cv.notify_all();
     p->ready_cv.notify_all();
-    p->drain_cv.wait(lk, [&] { return p->waiters == 0; });
+  }
+  // wait for every thread already inside sp_acquire_* (counted before it
+  // takes the mutex) to leave before freeing — otherwise woken waiters
+  // touch freed memory
+  while (p->inflight.load() > 0) {
+    std::this_thread::sleep_for(std::chrono::milliseconds(1));
   }
   for (void* b : p->slots) free(b);
   delete p;
@@ -106,6 +116,7 @@ int sp_num_slots(void* pool) {
 // Returns a free slot id to fill, or -1 on timeout/closed.
 int sp_acquire_write(void* pool, int timeout_ms) {
   auto* p = static_cast<StagingPool*>(pool);
+  InflightGuard g(p->inflight);
   int slot = -1;
   return wait_pop(p, p->free_q, p->free_cv, timeout_ms, &slot) ? slot : -1;
 }
@@ -136,6 +147,7 @@ void sp_commit(void* pool, int slot) {
 // Returns the oldest committed slot, or -1 on timeout/closed.
 int sp_acquire_read(void* pool, int timeout_ms) {
   auto* p = static_cast<StagingPool*>(pool);
+  InflightGuard g(p->inflight);
   int slot = -1;
   return wait_pop(p, p->ready_q, p->ready_cv, timeout_ms, &slot) ? slot : -1;
 }

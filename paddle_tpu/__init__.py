@@ -67,6 +67,8 @@ from . import inference  # noqa: F401
 from . import distribution  # noqa: F401
 from . import sparse  # noqa: F401
 from . import utils  # noqa: F401
+from . import incubate  # noqa: F401
+from . import onnx  # noqa: F401
 from . import profiler  # noqa: F401
 from . import device  # noqa: F401
 from .device import (  # noqa: F401

@@ -1,0 +1,13 @@
+"""paddle.incubate (reference: python/paddle/incubate/__init__.py)."""
+from . import nn  # noqa: F401
+from .operators import (  # noqa: F401
+    graph_send_recv, segment_max, segment_mean, segment_min, segment_sum,
+    softmax_mask_fuse, softmax_mask_fuse_upper_triangle,
+)
+from .optimizer import LookAhead, ModelAverage  # noqa: F401
+
+__all__ = [
+    "LookAhead", "ModelAverage", "softmax_mask_fuse_upper_triangle",
+    "softmax_mask_fuse", "graph_send_recv", "segment_sum", "segment_mean",
+    "segment_max", "segment_min",
+]

@@ -1,0 +1,100 @@
+"""incubate operators: fused softmax-mask, segment reduce, graph ops.
+
+Reference: python/paddle/incubate/operators/softmax_mask_fuse.py:23,
+incubate/tensor/math.py:23 (segment_*), incubate/operators/
+graph_send_recv.py:22. TPU-native: jnp compositions through the autograd
+tape; XLA fuses mask+softmax, and segment reductions use jax.ops.segment_*
+(sorted scatter-add lowering).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.autograd import apply
+
+__all__ = ["softmax_mask_fuse", "softmax_mask_fuse_upper_triangle",
+           "segment_sum", "segment_mean", "segment_max", "segment_min",
+           "graph_send_recv"]
+
+
+def softmax_mask_fuse(x, mask, name=None):
+    """softmax(x + mask) over the last axis (one fused XLA computation)."""
+    return apply(lambda a, m: jax.nn.softmax(a + m, axis=-1), x, mask)
+
+
+def softmax_mask_fuse_upper_triangle(x):
+    """softmax with the causal (upper-triangular) mask applied, for
+    [batch, heads, seq_q, seq_k] attention scores."""
+
+    def _f(a):
+        s_q, s_k = a.shape[-2], a.shape[-1]
+        causal = jnp.tril(jnp.ones((s_q, s_k), bool))
+        neg = jnp.asarray(jnp.finfo(a.dtype).min, a.dtype)
+        return jax.nn.softmax(jnp.where(causal, a, neg), axis=-1)
+
+    return apply(_f, x)
+
+
+def _num_segments(segment_ids, out_size):
+    if out_size is not None:
+        return int(out_size)
+    ids = segment_ids._value if hasattr(segment_ids, "_value") else segment_ids
+    return int(jnp.max(ids)) + 1 if ids.size else 0
+
+
+def segment_sum(data, segment_ids, name=None):
+    n = _num_segments(segment_ids, None)
+    return apply(lambda d, i: jax.ops.segment_sum(d, i, num_segments=n),
+                 data, segment_ids)
+
+
+def segment_mean(data, segment_ids, name=None):
+    n = _num_segments(segment_ids, None)
+
+    def _f(d, i):
+        s = jax.ops.segment_sum(d, i, num_segments=n)
+        c = jax.ops.segment_sum(jnp.ones(i.shape, d.dtype), i,
+                                num_segments=n)
+        return s / jnp.maximum(c, 1)[(...,) + (None,) * (d.ndim - 1)]
+
+    return apply(_f, data, segment_ids)
+
+
+def segment_max(data, segment_ids, name=None):
+    n = _num_segments(segment_ids, None)
+    return apply(lambda d, i: jax.ops.segment_max(d, i, num_segments=n),
+                 data, segment_ids)
+
+
+def segment_min(data, segment_ids, name=None):
+    n = _num_segments(segment_ids, None)
+    return apply(lambda d, i: jax.ops.segment_min(d, i, num_segments=n),
+                 data, segment_ids)
+
+
+def graph_send_recv(x, src_index, dst_index, pool_type="sum", out_size=None,
+                    name=None):
+    """Gather x[src], scatter-reduce onto dst (message passing primitive)."""
+    pool_type = pool_type.lower()
+    if pool_type not in ("sum", "mean", "max", "min"):
+        raise ValueError(f"unsupported pool_type {pool_type}")
+    xv = x._value if hasattr(x, "_value") else jnp.asarray(x)
+    n = int(out_size) if out_size is not None else xv.shape[0]
+    red = {"sum": jax.ops.segment_sum, "max": jax.ops.segment_max,
+           "min": jax.ops.segment_min}.get(pool_type)
+
+    def _f(xx, src, dst):
+        msgs = jnp.take(xx, src, axis=0)
+        if pool_type == "mean":
+            s = jax.ops.segment_sum(msgs, dst, num_segments=n)
+            c = jax.ops.segment_sum(jnp.ones(dst.shape, xx.dtype), dst,
+                                    num_segments=n)
+            return s / jnp.maximum(c, 1)[(...,) + (None,) * (xx.ndim - 1)]
+        out = red(msgs, dst, num_segments=n)
+        if pool_type in ("max", "min"):
+            # empty segments come back +-inf; the reference zeros them
+            out = jnp.where(jnp.isinf(out), jnp.zeros_like(out), out)
+        return out
+
+    return apply(_f, x, src_index, dst_index)

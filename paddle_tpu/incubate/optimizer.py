@@ -1,0 +1,157 @@
+"""incubate optimizers: LookAhead, ModelAverage.
+
+Reference: python/paddle/incubate/optimizer/lookahead.py (slow/fast weights,
+slow += alpha*(fast-slow) every k steps) and modelaverage.py (windowed
+parameter averaging with apply()/restore()).
+"""
+from __future__ import annotations
+
+import contextlib
+
+import jax.numpy as jnp
+
+from ..optimizer.optimizer import Optimizer
+
+__all__ = ["LookAhead", "ModelAverage"]
+
+
+class LookAhead(Optimizer):
+    def __init__(self, inner_optimizer, alpha=0.5, k=5, name=None):
+        if inner_optimizer is None:
+            raise ValueError("inner optimizer cannot be None")
+        assert 0.0 <= alpha <= 1.0 and k >= 1
+        self.inner_optimizer = inner_optimizer
+        self.alpha = float(alpha)
+        self.k = int(k)
+        super().__init__(
+            learning_rate=alpha,
+            parameters=inner_optimizer._parameter_list, name=name)
+        self._slow = {}   # param id -> slow weight array
+        self._k_step = 0
+
+    def step(self):
+        self.inner_optimizer.step()
+        self._k_step += 1
+        if self._k_step % self.k:
+            return
+        for p in self._param_list:
+            if p.stop_gradient:
+                continue
+            slow = self._slow.get(id(p))
+            if slow is None:
+                # first sync: slow weights start at the pre-LookAhead value
+                slow = p._value
+            slow = slow + self.alpha * (p._value - slow)
+            self._slow[id(p)] = slow
+            p._value = slow
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        loss.backward()
+        self.step()
+        return None, [(p, p.grad) for p in self._param_list]
+
+    def clear_grad(self, set_to_zero=True):
+        self.inner_optimizer.clear_grad(set_to_zero)
+
+    clear_gradients = clear_grad
+
+    def get_lr(self):
+        return self.inner_optimizer.get_lr()
+
+    def state_dict(self):
+        sd = self.inner_optimizer.state_dict()
+        sd["lookahead_k_step"] = self._k_step
+        return sd
+
+    def set_state_dict(self, sd):
+        self._k_step = int(sd.pop("lookahead_k_step", 0))
+        self.inner_optimizer.set_state_dict(sd)
+
+
+class ModelAverage(Optimizer):
+    """Trailing-window parameter average, matching the reference's
+    average_accumulates recurrence (fluid/operators/average_accumulates_op.h):
+    sum_1 accumulates each step; every 16384 updates it folds into sum_2
+    (precision); when num_accumulates reaches the dynamic window
+    min(max_average_window, num_updates * rate) (and >= min_average_window),
+    sum_3 <- sum_1 + sum_2 and the recent sums restart. The average is
+    (sum_1 + sum_2 + sum_3) / (num_accumulates + old_num_accumulates).
+    """
+
+    _MAX_FOLD = 16384
+
+    def __init__(self, average_window_rate, parameters=None,
+                 min_average_window=10000, max_average_window=10000,
+                 name=None):
+        super().__init__(learning_rate=0.0, parameters=parameters, name=name)
+        self.avg_window_rate = float(average_window_rate)
+        self.min_average_window = int(min_average_window)
+        self.max_average_window = int(max_average_window)
+        self._sum_1 = {}
+        self._sum_2 = {}
+        self._sum_3 = {}
+        self._num_updates = 0
+        self._num_accumulates = 0
+        self._old_num_accumulates = 0
+        self._backup = None
+
+    def step(self):
+        """Accumulate the current parameter values into the window."""
+        self._num_updates += 1
+        self._num_accumulates += 1
+        for p in self._param_list:
+            if p.stop_gradient:
+                continue
+            acc = self._sum_1.get(id(p))
+            self._sum_1[id(p)] = p._value if acc is None else acc + p._value
+        if self._num_updates % self._MAX_FOLD == 0:
+            for k, v in self._sum_1.items():
+                self._sum_2[k] = v + self._sum_2.get(k, 0)
+            self._sum_1 = {}
+        window = min(self.max_average_window,
+                     self._num_updates * self.avg_window_rate)
+        if (self._num_accumulates >= self.min_average_window
+                and self._num_accumulates >= window):
+            self._sum_3 = {
+                k: self._sum_1.get(k, 0) + self._sum_2.get(k, 0)
+                for k in set(self._sum_1) | set(self._sum_2)}
+            self._sum_1 = {}
+            self._sum_2 = {}
+            self._old_num_accumulates = self._num_accumulates
+            self._num_accumulates = 0
+        self._global_step += 1
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        self.step()
+        return None, []
+
+    @contextlib.contextmanager
+    def apply(self, executor=None, need_restore=True):
+        """Swap in the averaged parameters (context manager)."""
+        total = self._num_accumulates + self._old_num_accumulates
+        if total == 0:
+            yield
+            return
+        self._backup = {}
+        for p in self._param_list:
+            if p.stop_gradient:
+                continue
+            s = (self._sum_1.get(id(p), 0) + self._sum_2.get(id(p), 0)
+                 + self._sum_3.get(id(p), 0))
+            self._backup[id(p)] = p._value
+            p._value = (s / total).astype(p._value.dtype)
+        try:
+            yield
+        finally:
+            if need_restore:
+                self.restore()
+
+    def restore(self, executor=None):
+        if self._backup is None:
+            return
+        for p in self._param_list:
+            if id(p) in self._backup:
+                p._value = self._backup[id(p)]
+        self._backup = None

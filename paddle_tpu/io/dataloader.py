@@ -137,6 +137,8 @@ class DataLoader:
         Falls back to the normal path for unstageable/oversized batches."""
         import jax
 
+        from ..runtime.staging import _align
+
         items = [self.dataset[i] for i in indices]  # fetched exactly once
         batch = _numpy_collate(items)
         leaves, treedef = (jax.tree_util.tree_flatten(
@@ -144,7 +146,7 @@ class DataLoader:
             else ([None], None))
         if not all(isinstance(a, np.ndarray) for a in leaves):
             return self.collate_fn(items)
-        need = sum((a.nbytes + 63) // 64 * 64 for a in leaves)
+        need = sum(_align(a.nbytes) for a in leaves)
         # size the ring from the NOMINAL batch size, not whichever (possibly
         # ragged, out-of-order) batch happens to arrive first
         nominal = need * max(1, self.batch_size or 1) / max(1, len(indices))
@@ -165,22 +167,23 @@ class DataLoader:
                 slot_bytes = int(nominal_batch_bytes * 1.25) + 64
                 try:
                     self._pool = StagingPool(self._window, slot_bytes)
-                except (MemoryError, RuntimeError):
+                except Exception:
+                    # no g++, csrc missing from an installed wheel, alloc
+                    # failure, ... — staging is an optimization, fall back
                     self.use_staging_pool = False
             return self._pool
 
     def _unstage(self, staged):
         """Device-put the slot's views, then recycle the slot."""
         import jax
-        import jax.numpy as jnp
 
         views = self._pool.view_arrays(staged.slot, staged.meta)
-        # copy=True: the CPU backend would otherwise zero-copy ALIAS the
-        # aligned slot memory, which is recycled right below
-        tensors = [Tensor(jnp.array(v, copy=True)) for v in views]
-        # make sure the host->device copies consumed the buffer before the
-        # slot can be reused
-        jax.block_until_ready([t._value for t in tensors])
+        # synchronous host copy before releasing: the CPU backend zero-copy
+        # ALIASES aligned buffers, and block_until_ready can return early on
+        # the axon tunnel — np.array is the only release barrier that holds
+        # on every backend. The copy runs at memcpy speed on slot-aligned
+        # memory and is what the device transfer consumes asynchronously.
+        tensors = [Tensor(np.array(v)) for v in views]
         self._pool.release(staged.slot)
         return jax.tree_util.tree_unflatten(staged.treedef, tensors)
 

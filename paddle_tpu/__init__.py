@@ -72,12 +72,48 @@ from . import onnx  # noqa: F401
 from . import profiler  # noqa: F401
 from . import device  # noqa: F401
 from .device import (  # noqa: F401
-    CPUPlace, CUDAPinnedPlace, CUDAPlace, TPUPlace, XPUPlace, get_device,
-    set_device,
+    CPUPlace, CUDAPinnedPlace, CUDAPlace, NPUPlace, TPUPlace, XPUPlace,
+    get_device, set_device,
 )
+from .distributed.parallel import DataParallel  # noqa: F401
 from .static.program import InputSpec  # noqa: F401
 
 __version__ = "0.1.0"
+
+_FLAGS = {}
+
+
+def set_flags(flags):
+    """paddle.set_flags — gflags shim; XLA owns runtime tuning on TPU, so
+    flags are recorded for get_flags symmetry only."""
+    _FLAGS.update(flags)
+
+
+def get_flags(flags):
+    if isinstance(flags, str):
+        flags = [flags]
+    return {f: _FLAGS.get(f) for f in flags}
+
+
+def disable_signal_handler():
+    """No-op: the reference installs C++ fatal-signal dumpers; the JAX
+    runtime doesn't hook signals in the first place."""
+
+
+def batch(reader, batch_size, drop_last=False):
+    """Legacy reader decorator (reference fluid/io.py batch)."""
+
+    def batched():
+        buf = []
+        for item in reader():
+            buf.append(item)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+
+    return batched
 
 
 def is_compiled_with_cuda():

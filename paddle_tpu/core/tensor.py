@@ -325,6 +325,10 @@ def to_tensor(data, dtype=None, place=None, stop_gradient=True):
         if dtype is not None:
             v = v.astype(dtypes.to_jax_dtype(dtype))
         return Tensor(v, stop_gradient=stop_gradient)
+    if isinstance(data, jax.Array):  # includes tracers inside jit
+        v = data if dtype is None \
+            else data.astype(dtypes.to_jax_dtype(dtype))
+        return Tensor(v, stop_gradient=stop_gradient)
     if dtype is None:
         if isinstance(data, np.ndarray):
             v = jnp.asarray(data)

@@ -9,6 +9,7 @@ from __future__ import annotations
 import jax
 
 __all__ = ["TPUPlace", "CPUPlace", "CUDAPlace", "CUDAPinnedPlace", "XPUPlace",
+           "NPUPlace",
            "set_device", "get_device", "get_all_device_type",
            "get_available_device", "is_compiled_with_cuda", "synchronize",
            "cuda", "device_count"]
@@ -47,6 +48,10 @@ class CUDAPinnedPlace(CPUPlace):
 
 
 class XPUPlace(TPUPlace):
+    pass
+
+
+class NPUPlace(TPUPlace):
     pass
 
 

@@ -54,9 +54,14 @@ def _param_unflatten(aux, children):
 jax.tree_util.register_pytree_node(Parameter, _param_flatten, _param_unflatten)
 
 
+_param_creation_guard = None  # set by static.nn while tracing a branch
+
+
 def create_parameter(shape, dtype=None, name=None, attr=None,
                      is_bias=False, default_initializer=None):
     """paddle.create_parameter (reference: python/paddle/tensor/creation.py)."""
+    if _param_creation_guard is not None:
+        raise RuntimeError(_param_creation_guard)
     attr = ParamAttr._to_attr(attr)
     if attr is False:
         return None

@@ -1,10 +1,219 @@
-"""paddle.static.nn (reference: python/paddle/static/nn/common.py):
-layer-creating functions for program building."""
+"""paddle.static.nn (reference: python/paddle/static/nn/common.py +
+control_flow.py): layer-creating functions and structured control flow for
+program building.
+
+TPU-native control flow: cond/case/switch_case/while_loop lower to
+lax.cond/lax.switch/lax.while_loop — compiled control flow inside the one
+XLA program, not host branching. Branch callables run with the tape and the
+static recorder suspended (the whole construct records as a single traced
+op); while_loop threads state explicitly via loop_vars, exactly the shape
+XLA wants. Legacy LoD sequence_* ops are intentionally absent (the
+reference is retiring LoD; use dense ragged patterns instead).
+"""
 from __future__ import annotations
 
-from .. import nn as _nn
+import jax
+from jax import lax
 
-__all__ = ["fc", "conv2d", "batch_norm", "embedding"]
+from .. import nn as _nn
+from ..core.tensor import Tensor
+
+__all__ = ["fc", "conv2d", "batch_norm", "embedding",
+           "cond", "case", "switch_case", "while_loop",
+           "layer_norm", "group_norm", "instance_norm", "spectral_norm",
+           "data_norm", "prelu", "conv2d_transpose", "conv3d",
+           "conv3d_transpose", "bilinear_tensor_product", "deform_conv2d",
+           "row_conv", "py_func"]
+
+
+def _is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+def _suspended(fn, args=()):
+    """Run a user branch callable with tape + static recorder off, returning
+    a pytree of raw jnp values. Closure Tensors are handled by the callers:
+    _closure_tensors lifts them to op inputs and _rebound swaps in the
+    traced values while the branch runs."""
+    from ..core import autograd as ag
+    from ..nn.layer import layers as _layers
+
+    old = ag._static_recorder
+    ag._static_recorder = None
+    old_guard = getattr(_layers, "_param_creation_guard", None)
+    # a Layer built INSIDE a branch would re-initialize on every replay and
+    # never reach the program/optimizer — fail loudly instead of silently
+    _layers._param_creation_guard = (
+        "creating parameters inside a static.nn control-flow branch is not "
+        "supported: build layers outside and call them from the branch")
+    try:
+        with ag.no_grad():
+            out = fn(*[Tensor(a) for a in args])
+    finally:
+        ag._static_recorder = old
+        _layers._param_creation_guard = old_guard
+    return jax.tree_util.tree_map(
+        lambda t: t._value if _is_tensor(t) else t, out,
+        is_leaf=_is_tensor)
+
+
+def _as_pred(v):
+    return v.reshape(()).astype(bool)
+
+
+def _closure_tensors(*fns):
+    """Tensors a branch callable closes over — lifted to explicit op inputs
+    so static-program replay rebinds them (they'd otherwise be baked as
+    record-time constants) and jit tracing sees real dataflow.
+
+    Closure cells, defaults, and directly-loaded globals are inspected;
+    Tensors reached through object attributes (e.g. bound methods reading
+    self.weight) or nested containers beyond one level are NOT lifted and
+    stay baked at trace time — pass them through lambda closures or
+    loop_vars instead."""
+    seen = {}
+    for fn in fns:
+        cells = list(getattr(fn, "__closure__", None) or ())
+        vals = [c.cell_contents for c in cells
+                if c.cell_contents is not None] \
+            + list(getattr(fn, "__defaults__", None) or ())
+        # module-level branch fns reach Tensors as globals, not cells:
+        # co_names is the exact set of global names the bytecode loads
+        code = getattr(fn, "__code__", None)
+        g = getattr(fn, "__globals__", None)
+        if code is not None and g is not None:
+            vals += [g[n] for n in code.co_names if n in g]
+        for v in vals:
+            items = v if isinstance(v, (list, tuple)) else \
+                v.values() if isinstance(v, dict) else [v]
+            for item in items:
+                if _is_tensor(item) and id(item) not in seen:
+                    seen[id(item)] = item
+    return list(seen.values())
+
+
+class _rebound:
+    """Temporarily swap dep Tensors' payloads for traced values."""
+
+    def __init__(self, deps, vals):
+        self.deps = deps
+        self.vals = vals
+
+    def __enter__(self):
+        self.saved = [t._value for t in self.deps]
+        for t, v in zip(self.deps, self.vals):
+            t._value = v
+
+    def __exit__(self, *exc):
+        for t, v in zip(self.deps, self.saved):
+            t._value = v
+
+
+def cond(pred, true_fn=None, false_fn=None, name=None):
+    """lax.cond over the two branch callables (reference
+    static/nn/control_flow.py cond)."""
+    from ..core.autograd import apply
+
+    deps = _closure_tensors(true_fn, false_fn)
+
+    def _f(p, *dep_vals):
+        with _rebound(deps, dep_vals):
+            return lax.cond(_as_pred(p), lambda: _suspended(true_fn),
+                            lambda: _suspended(false_fn))
+
+    _f.__name__ = "cond"
+    return apply(_f, pred, *deps)
+
+
+def case(pred_fn_pairs, default=None, name=None):
+    """First-match-wins chain of conds."""
+    if default is None:
+        default = pred_fn_pairs[-1][1]
+        pred_fn_pairs = pred_fn_pairs[:-1]
+    from ..core.autograd import apply
+
+    deps = _closure_tensors(default, *[f for _, f in pred_fn_pairs])
+    n_pred = len(pred_fn_pairs)
+
+    def _f(*args):
+        preds, dep_vals = args[:n_pred], args[n_pred:]
+        with _rebound(deps, dep_vals):
+            out = _suspended(default)
+            # fold from the last pair so the FIRST true predicate wins
+            for i in range(len(preds) - 1, -1, -1):
+                fn = pred_fn_pairs[i][1]
+                prev = out
+                out = lax.cond(_as_pred(preds[i]),
+                               lambda fn=fn: _suspended(fn),
+                               lambda prev=prev: prev)
+        return out
+
+    _f.__name__ = "case"
+    return apply(_f, *[p for p, _ in pred_fn_pairs], *deps)
+
+
+def switch_case(branch_index, branch_fns, default=None, name=None):
+    """lax.switch over indexed branches."""
+    from ..core.autograd import apply
+
+    if isinstance(branch_fns, dict):
+        items = sorted(branch_fns.items())
+    else:
+        items = [(i, f) for i, f in (branch_fns if isinstance(
+            branch_fns[0], (tuple, list)) else enumerate(branch_fns))]
+    keys = [int(k) for k, _ in items]
+    fns = [f for _, f in items]
+    if default is None:
+        default = fns[-1]
+
+    deps = _closure_tensors(default, *fns)
+
+    def _f(idx, *dep_vals):
+        import jax.numpy as jnp
+
+        idx = idx.reshape(()).astype(jnp.int32)
+        # map arbitrary keys onto dense lax.switch positions; unmatched
+        # indices take the default branch (last position)
+        pos = len(fns)
+        for i, k in enumerate(keys):
+            pos = jnp.where(idx == k, i, pos)
+        with _rebound(deps, dep_vals):
+            return lax.switch(pos, [(lambda f=f: _suspended(f))
+                                    for f in fns]
+                              + [lambda: _suspended(default)])
+
+    _f.__name__ = "switch_case"
+    return apply(_f, branch_index, *deps)
+
+
+def while_loop(cond, body, loop_vars, is_test=False, name=None):  # noqa: A002
+    """lax.while_loop with explicitly threaded loop_vars (reference
+    static/nn/control_flow.py while_loop). Fully replay-correct: all loop
+    state flows through loop_vars. Reverse-mode AD through a dynamic while
+    is not supported by XLA — for differentiable loops use a
+    static-trip-count construct (e.g. unrolled Python loop or lax.scan via
+    nn.RNN), same constraint the TPU compiler imposes everywhere."""
+    from ..core.autograd import apply
+
+    deps = _closure_tensors(cond, body)
+    n_loop = len(loop_vars)
+
+    def _f(*args):
+        vals, dep_vals = args[:n_loop], args[n_loop:]
+
+        def c(vs):
+            return _as_pred(_suspended(cond, vs))
+
+        def b(vs):
+            out = _suspended(body, vs)
+            return tuple(out) if isinstance(out, (list, tuple)) else (out,)
+
+        with _rebound(deps, dep_vals):
+            return lax.while_loop(c, b, tuple(vals))
+
+    _f.__name__ = "while_loop"
+    out = apply(_f, *loop_vars, *deps)
+    return list(out) if isinstance(out, tuple) else out
 
 
 def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
@@ -58,3 +267,219 @@ def embedding(input, size, is_sparse=False, padding_idx=None,  # noqa: A002
     layer = _nn.Embedding(size[0], size[1], padding_idx=padding_idx,
                           weight_attr=param_attr)
     return layer(input)
+
+
+def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,  # noqa: A002
+               epsilon=1e-5, param_attr=None, bias_attr=None, act=None,
+               name=None):
+    import math as _m
+
+    n = int(_m.prod([s for s in input.shape[begin_norm_axis:]]))
+    layer = _nn.LayerNorm(n if n > 0 else 1, epsilon=epsilon,
+                          weight_attr=param_attr if scale else False,
+                          bias_attr=bias_attr if shift else False)
+    from .. import tensor as T
+
+    flat = T.reshape(input, list(input.shape[:begin_norm_axis]) + [n])
+    out = T.reshape(layer(flat), input.shape)
+    return getattr(_nn.functional, act)(out) if act else out
+
+
+def group_norm(input, groups, epsilon=1e-5, param_attr=None,  # noqa: A002
+               bias_attr=None, act=None, data_layout="NCHW", name=None):
+    ch = input.shape[1] if data_layout == "NCHW" else input.shape[-1]
+    layer = _nn.GroupNorm(groups, ch, epsilon=epsilon,
+                          weight_attr=param_attr, bias_attr=bias_attr,
+                          data_format=data_layout)
+    out = layer(input)
+    return getattr(_nn.functional, act)(out) if act else out
+
+
+def instance_norm(input, epsilon=1e-5, param_attr=None, bias_attr=None,  # noqa: A002
+                  name=None):
+    layer = _nn.InstanceNorm2D(input.shape[1], epsilon=epsilon,
+                               weight_attr=param_attr, bias_attr=bias_attr)
+    return layer(input)
+
+
+def data_norm(input, act=None, epsilon=1e-5, param_attr=None,  # noqa: A002
+              data_layout="NCHW", in_place=False, name=None,
+              moving_mean_name=None, moving_variance_name=None,
+              do_model_average_for_mean_and_var=True, slot_dim=-1,
+              sync_stats=False, summary_decay=0.9999999,
+              enable_scale_and_shift=False):
+    """BatchNorm without the learned affine by default (reference
+    static/nn/common.py data_norm)."""
+    ch = input.shape[1] if data_layout == "NCHW" else input.shape[-1]
+    layer = _nn.BatchNorm(ch if ch > 0 else 1, epsilon=epsilon,
+                          param_attr=param_attr if enable_scale_and_shift
+                          else False,
+                          bias_attr=None if enable_scale_and_shift
+                          else False,
+                          data_layout=data_layout)
+    out = layer(input)
+    return getattr(_nn.functional, act)(out) if act else out
+
+
+def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
+    """W / sigma_max(W) by power iteration (reference static/nn
+    spectral_norm op semantics, stateless)."""
+    from ..core.autograd import apply
+    import jax.numpy as jnp
+
+    def _f(w):
+        mat = jnp.moveaxis(w, dim, 0).reshape(w.shape[dim], -1)
+        u = jnp.ones((mat.shape[0],), mat.dtype) / (mat.shape[0] ** 0.5)
+        v = None
+        for _ in range(max(1, power_iters)):
+            v = mat.T @ u
+            v = v / (jnp.linalg.norm(v) + eps)
+            u = mat @ v
+            u = u / (jnp.linalg.norm(u) + eps)
+        sigma = u @ mat @ v
+        return w / (sigma + eps)
+
+    _f.__name__ = "spectral_norm"
+    return apply(_f, weight)
+
+
+def prelu(x, mode, param_attr=None, data_format="NCHW", name=None):
+    ch = 1 if mode == "all" else (
+        x.shape[1] if data_format == "NCHW" else x.shape[-1])
+    if mode == "element":
+        import math as _m
+
+        ch = int(_m.prod([s for s in x.shape[1:]]))
+    layer = _nn.PReLU(num_parameters=ch, weight_attr=param_attr,
+                      data_format=data_format)
+    return layer(x)
+
+
+def _derive_transpose_filter(filter_size, output_size, in_spatial, stride,
+                             padding, n):
+    """filter_size from output_size (reference conv2d_transpose contract):
+    k = out - (in - 1)*stride + 2*pad."""
+    if filter_size is not None:
+        return filter_size
+    if output_size is None:
+        raise ValueError("either filter_size or output_size is required")
+    outs = [output_size] * n if isinstance(output_size, int) \
+        else list(output_size)
+    strides = [stride] * n if isinstance(stride, int) else list(stride)
+    pads = [padding] * n if isinstance(padding, int) else list(padding)
+    return [outs[i] - (in_spatial[i] - 1) * strides[i] + 2 * pads[i]
+            for i in range(n)]
+
+
+def conv2d_transpose(input, num_filters, output_size=None, filter_size=None,  # noqa: A002
+                     padding=0, stride=1, dilation=1, groups=1,
+                     param_attr=None, bias_attr=None, use_cudnn=True,
+                     act=None, name=None, data_format="NCHW"):
+    in_ch = input.shape[1] if data_format == "NCHW" else input.shape[-1]
+    spatial = input.shape[2:] if data_format == "NCHW" else input.shape[1:-1]
+    filter_size = _derive_transpose_filter(filter_size, output_size,
+                                           spatial, stride, padding, 2)
+    layer = _nn.Conv2DTranspose(in_ch, num_filters, filter_size,
+                                stride=stride, padding=padding,
+                                dilation=dilation, groups=groups,
+                                weight_attr=param_attr, bias_attr=bias_attr,
+                                data_format=data_format)
+    out = layer(input, output_size=output_size)
+    return getattr(_nn.functional, act)(out) if act else out
+
+
+def conv3d(input, num_filters, filter_size, stride=1, padding=0,  # noqa: A002
+           dilation=1, groups=1, param_attr=None, bias_attr=None,
+           use_cudnn=True, act=None, name=None, data_format="NCDHW"):
+    in_ch = input.shape[1] if data_format == "NCDHW" else input.shape[-1]
+    layer = _nn.Conv3D(in_ch, num_filters, filter_size, stride=stride,
+                       padding=padding, dilation=dilation, groups=groups,
+                       weight_attr=param_attr, bias_attr=bias_attr,
+                       data_format=data_format)
+    out = layer(input)
+    return getattr(_nn.functional, act)(out) if act else out
+
+
+def conv3d_transpose(input, num_filters, output_size=None, filter_size=None,  # noqa: A002
+                     padding=0, stride=1, dilation=1, groups=1,
+                     param_attr=None, bias_attr=None, use_cudnn=True,
+                     act=None, name=None, data_format="NCDHW"):
+    in_ch = input.shape[1] if data_format == "NCDHW" else input.shape[-1]
+    spatial = input.shape[2:] if data_format == "NCDHW" \
+        else input.shape[1:-1]
+    filter_size = _derive_transpose_filter(filter_size, output_size,
+                                           spatial, stride, padding, 3)
+    layer = _nn.Conv3DTranspose(in_ch, num_filters, filter_size,
+                                stride=stride, padding=padding,
+                                dilation=dilation, groups=groups,
+                                weight_attr=param_attr, bias_attr=bias_attr,
+                                data_format=data_format)
+    out = layer(input, output_size=output_size)
+    return getattr(_nn.functional, act)(out) if act else out
+
+
+def bilinear_tensor_product(x, y, size, act=None, name=None,
+                            param_attr=None, bias_attr=None):
+    layer = _nn.Bilinear(x.shape[-1], y.shape[-1], size,
+                         weight_attr=param_attr, bias_attr=bias_attr)
+    out = layer(x, y)
+    return getattr(_nn.functional, act)(out) if act else out
+
+
+def deform_conv2d(x, offset, mask, num_filters, filter_size, stride=1,
+                  padding=0, dilation=1, groups=1, deformable_groups=1,
+                  im2col_step=1, weight_attr=None, bias_attr=None,
+                  name=None):
+    from ..vision.ops import DeformConv2D
+
+    layer = DeformConv2D(x.shape[1], num_filters, filter_size,
+                         stride=stride, padding=padding, dilation=dilation,
+                         deformable_groups=deformable_groups, groups=groups,
+                         weight_attr=weight_attr, bias_attr=bias_attr)
+    return layer(x, offset, mask)
+
+
+def row_conv(input, future_context_size, param_attr=None, act=None):  # noqa: A002
+    """Lookahead row convolution (Deep Speech 2): y[t] = sum_{i<=k} w_i *
+    x[t+i], implemented as a depthwise temporal conv."""
+    from ..core.autograd import apply as _apply
+    from ..nn.layer.layers import create_parameter
+    import jax.numpy as jnp
+
+    k = future_context_size
+    d = input.shape[-1]
+    w = create_parameter([k + 1, d], "float32", attr=param_attr,
+                         default_initializer=_nn.initializer.Constant(0.1))
+
+    def _f(xv, wv):
+        pads = [(0, 0)] * xv.ndim
+        pads[-2] = (0, k)
+        xp = jnp.pad(xv, pads)
+        t = xv.shape[-2]
+        out = 0.0
+        for i in range(k + 1):
+            out = out + xp[..., i:i + t, :] * wv[i]
+        return out
+
+    _f.__name__ = "row_conv"
+    out = _apply(_f, input, w)
+    return getattr(_nn.functional, act)(out) if act else out
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    """Host-Python op inside the program (reference static/nn py_func),
+    bridged with jax.pure_callback via utils.custom_op."""
+    import numpy as _np
+
+    from ..utils.custom_op import register_custom_op
+
+    outs = out if isinstance(out, (list, tuple)) else (out,)
+    shapes = tuple((tuple(o.shape), _np.dtype(str(o.numpy().dtype)))
+                   for o in outs)
+
+    op = register_custom_op(
+        getattr(func, "__name__", "py_func"), func,
+        infer_shape=lambda *a: shapes if len(shapes) > 1 else shapes[0],
+        backward=backward_func)
+    xs = x if isinstance(x, (list, tuple)) else (x,)
+    return op(*xs)

@@ -23,6 +23,7 @@ __all__ = [
     "maximum", "minimum", "fmax", "fmin", "max", "min", "amax", "amin",
     "sum", "nansum", "prod", "cumsum", "cumprod", "cummax", "cummin",
     "logcumsumexp", "logsumexp", "clip", "isnan", "isinf", "isfinite",
+    "all", "any", "conj", "logit", "renorm", "trace",
     "add_n", "stanh", "multiplex", "inner", "outer", "dot", "mm", "bmm",
     "addmm", "kron", "gcd", "lcm", "erf", "erfinv", "lgamma", "digamma",
     "neg", "lerp", "rad2deg", "deg2rad", "diff", "angle", "frac", "heaviside",
@@ -441,3 +442,44 @@ round_ = _inplace(round)
 ceil_ = _inplace(ceil)
 floor_ = _inplace(floor)
 tanh_ = _inplace(tanh)
+
+
+def all(x, axis=None, keepdim=False, name=None):  # noqa: A001
+    return apply(lambda v: jnp.all(v, axis=_axis(axis), keepdims=keepdim), x)
+
+
+def any(x, axis=None, keepdim=False, name=None):  # noqa: A001
+    return apply(lambda v: jnp.any(v, axis=_axis(axis), keepdims=keepdim), x)
+
+
+def conj(x, name=None):
+    return apply(jnp.conj, x)
+
+
+def logit(x, eps=None, name=None):
+    def _f(v):
+        if eps is not None:
+            v = jnp.clip(v, eps, 1.0 - eps)
+        return jnp.log(v) - jnp.log1p(-v)
+
+    _f.__name__ = "logit"
+    return apply(_f, x)
+
+
+def renorm(x, p, axis, max_norm, name=None):
+    """Scale each slice along `axis` whose p-norm exceeds max_norm down to
+    max_norm (reference tensor/math.py renorm)."""
+
+    def _f(v):
+        dims = tuple(d for d in range(v.ndim) if d != axis % v.ndim)
+        norm = jnp.sum(jnp.abs(v) ** p, axis=dims, keepdims=True) ** (1.0 / p)
+        factor = jnp.where(norm > max_norm, max_norm / (norm + 1e-7), 1.0)
+        return v * factor
+
+    _f.__name__ = "renorm"
+    return apply(_f, x)
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    return apply(lambda v: jnp.trace(v, offset=offset, axis1=axis1,
+                                     axis2=axis2), x)

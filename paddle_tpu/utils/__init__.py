@@ -1,7 +1,8 @@
 """paddle.utils (reference: python/paddle/utils/__init__.py)."""
 from . import cpp_extension  # noqa: F401
+from .custom_op import CustomOp, register_custom_op  # noqa: F401
 
-__all__ = ["cpp_extension", "try_import"]
+__all__ = ["cpp_extension", "try_import", "register_custom_op", "CustomOp"]
 
 
 def try_import(module_name, err_msg=None):

@@ -1,0 +1,107 @@
+"""Custom-op bridge: host C++/Python kernels as traced ops.
+
+Reference: paddle/extension.h + python/paddle/utils/cpp_extension — custom
+C++ ops registered into the op library, usable from dygraph and static
+graph. TPU-native design: the kernel stays a host function (typically a
+ctypes call into a cpp_extension .so); `jax.pure_callback` splices it into
+the XLA program so it works under jit/vmap and inside hapi/static whole-step
+programs, and an optional backward kernel is attached with jax.custom_vjp so
+the op participates in the autograd tape.
+
+Host callbacks do not run on the TPU — use this for ops that are genuinely
+host-side (IO, CPU-only libraries, custom C++ data transforms), not for hot
+compute (write a Pallas kernel for that).
+"""
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+from ..core.autograd import apply
+from ..core.tensor import Tensor
+
+__all__ = ["register_custom_op", "CustomOp"]
+
+
+def _as_structs(shapes_dtypes):
+    out = [jax.ShapeDtypeStruct(tuple(s), np.dtype(d))
+           for s, d in shapes_dtypes]
+    return out[0] if len(out) == 1 else tuple(out)
+
+
+def _is_shape_dtype(sd):
+    """One (shape, dtype) pair — incl. scalar shape () — vs a tuple of
+    pairs for multi-output ops."""
+    return (isinstance(sd, (tuple, list)) and len(sd) == 2
+            and isinstance(sd[0], (tuple, list))
+            and all(isinstance(i, (int, np.integer)) for i in sd[0])
+            and not isinstance(sd[1], (tuple, list)))
+
+
+class CustomOp:
+    """A host kernel exposed as a Paddle-style traced op."""
+
+    def __init__(self, name, forward, infer_shape, backward=None,
+                 vectorized=False):
+        self.name = name
+        self._n_out = None
+
+        def np_fwd(*arrays):
+            res = forward(*[np.asarray(a) for a in arrays])
+            return res if isinstance(res, tuple) else np.asarray(res)
+
+        def jax_fn(*args):
+            sd = infer_shape(*[(a.shape, a.dtype) for a in args])
+            structs = _as_structs([sd] if _is_shape_dtype(sd) else sd)
+            return jax.pure_callback(np_fwd, structs, *args,
+                                     vmap_method="sequential")
+
+        if backward is not None:
+            def np_bwd(*arrays):
+                res = backward(*[np.asarray(a) for a in arrays])
+                return res if isinstance(res, tuple) else np.asarray(res)
+
+            @jax.custom_vjp
+            def op(*args):
+                return jax_fn(*args)
+
+            def fwd(*args):
+                return jax_fn(*args), args
+
+            def bwd(residual, ct):
+                # input cotangents have the inputs' shapes/dtypes; multi-
+                # output cotangents are passed as separate leading args
+                structs = tuple(
+                    jax.ShapeDtypeStruct(a.shape, a.dtype) for a in residual)
+                cts = jax.tree_util.tree_leaves(ct)
+                grads = jax.pure_callback(
+                    np_bwd, structs[0] if len(structs) == 1 else structs,
+                    *cts, *residual, vmap_method="sequential")
+                return grads if isinstance(grads, tuple) else (grads,)
+
+            op.defvjp(fwd, bwd)
+            self._jax_fn = op
+        else:
+            self._jax_fn = jax_fn
+        self._jax_fn.__name__ = name
+
+    def __call__(self, *args):
+        """Eager/tape entry: accepts Tensors, records a GradNode."""
+        return apply(self._jax_fn, *args)
+
+    @property
+    def jax_fn(self):
+        """Raw jax-level function for direct use inside jitted code."""
+        return self._jax_fn
+
+
+def register_custom_op(name, forward, infer_shape, backward=None):
+    """Build a CustomOp.
+
+    forward(*np_arrays) -> np array (or tuple): the host kernel — usually a
+        thin wrapper over a ctypes call into a cpp_extension library.
+    infer_shape(*(shape, dtype)) -> (shape, dtype) (or tuple of them).
+    backward(*cotangents, *inputs) -> grads w.r.t. each input (optional);
+        one leading cotangent argument per forward output.
+    """
+    return CustomOp(name, forward, infer_shape, backward=backward)

@@ -1,5 +1,6 @@
 """paddle.vision (reference: python/paddle/vision/__init__.py)."""
 from . import datasets  # noqa: F401
 from . import models  # noqa: F401
+from . import ops  # noqa: F401
 from . import transforms  # noqa: F401
 from .models import *  # noqa: F401,F403

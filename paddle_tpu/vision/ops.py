@@ -1,0 +1,548 @@
+"""paddle.vision.ops — detection operators.
+
+Reference: python/paddle/vision/ops.py (yolo_box:253, deform_conv2d:430,
+psroi_pool:918, roi_pool:1033, roi_align:1160, nms:1376, read_file,
+decode_jpeg). TPU-native design: deform_conv2d and yolo_box are fully
+vectorized jnp (jittable, differentiable — bilinear sampling via gathers,
+the contraction rides the MXU). RoI ops loop over rois in Python with
+vectorized per-roi math (detection postprocessing is host-driven in the
+reference too: dynamic roi counts don't belong inside an XLA program), and
+nms is eager greedy suppression.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.autograd import apply
+from ..core.tensor import Tensor
+from ..nn.layer.layers import Layer
+
+__all__ = [
+    "yolo_loss", "yolo_box", "deform_conv2d", "DeformConv2D",
+    "read_file", "decode_jpeg", "roi_pool", "RoIPool", "psroi_pool",
+    "PSRoIPool", "roi_align", "RoIAlign", "nms",
+]
+
+
+def _val(x):
+    return x._value if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+# ---------------------------------------------------------------------------
+# deformable convolution (v1: mask=None, v2: modulated)
+# ---------------------------------------------------------------------------
+def _bilinear_sample(img, py, px):
+    """img [C, H, W]; py/px [...]: bilinear values with zero padding."""
+    C, H, W = img.shape
+    y0 = jnp.floor(py)
+    x0 = jnp.floor(px)
+    wy1 = py - y0
+    wx1 = px - x0
+    vals = 0.0
+    for dy, wy in ((0, 1 - wy1), (1, wy1)):
+        for dx, wx in ((0, 1 - wx1), (1, wx1)):
+            yy = y0 + dy
+            xx = x0 + dx
+            inb = (yy >= 0) & (yy < H) & (xx >= 0) & (xx < W)
+            yi = jnp.clip(yy, 0, H - 1).astype(jnp.int32)
+            xi = jnp.clip(xx, 0, W - 1).astype(jnp.int32)
+            v = img[:, yi, xi]                       # [C, ...]
+            vals = vals + v * (wy * wx * inb)[None]
+    return vals
+
+
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                  dilation=1, deformable_groups=1, groups=1, mask=None,
+                  name=None):
+    """y(p) = sum_k w_k * x(p + p_k + dp_k) * dm_k (reference vision/ops.py:430)."""
+    stride = (stride, stride) if isinstance(stride, int) else tuple(stride)
+    padding = (padding, padding) if isinstance(padding, int) \
+        else tuple(padding)
+    dilation = (dilation, dilation) if isinstance(dilation, int) \
+        else tuple(dilation)
+
+    def _f(xv, off, w, m, b):
+        N, Cin, H, W = xv.shape
+        Cout, Cin_g, kh, kw = w.shape
+        Hout = (H + 2 * padding[0] - dilation[0] * (kh - 1) - 1) \
+            // stride[0] + 1
+        Wout = (W + 2 * padding[1] - dilation[1] * (kw - 1) - 1) \
+            // stride[1] + 1
+        dg = deformable_groups
+        # base sampling grid per kernel tap: [kh*kw, Hout, Wout]
+        oy = jnp.arange(Hout) * stride[0] - padding[0]
+        ox = jnp.arange(Wout) * stride[1] - padding[1]
+        ky = jnp.arange(kh) * dilation[0]
+        kx = jnp.arange(kw) * dilation[1]
+        base_y = (oy[None, :, None] + ky[:, None, None])[:, None]  # kh,1,Ho,1
+        base_x = (ox[None, None, :] + kx[:, None, None])[None]     # 1,kw,1,Wo
+        base_y = jnp.broadcast_to(base_y, (kh, kw, Hout, Wout))
+        base_x = jnp.broadcast_to(base_x, (kh, kw, Hout, Wout))
+        off = off.reshape(N, dg, kh, kw, 2, Hout, Wout)
+        py = base_y[None, None] + off[:, :, :, :, 0]   # [N,dg,kh,kw,Ho,Wo]
+        px = base_x[None, None] + off[:, :, :, :, 1]
+        m = (jnp.ones((N, dg, kh, kw, Hout, Wout), xv.dtype) if m is None
+             else m.reshape(N, dg, kh, kw, Hout, Wout))
+
+        cg = Cin // dg  # channels per deformable group
+
+        def sample_image(img, py_i, px_i, m_i):
+            # img [Cin,H,W]; py_i/m_i [dg,kh,kw,Ho,Wo]
+            def per_group(g_img, g_py, g_px, g_m):
+                return _bilinear_sample(g_img, g_py, g_px) * g_m[None]
+
+            v = jax.vmap(per_group)(img.reshape(dg, cg, H, W),
+                                    py_i, px_i, m_i)
+            return v.reshape(Cin, kh, kw, Hout, Wout)
+
+        cols = jax.vmap(sample_image)(xv, py, px, m)  # [N,Cin,kh,kw,Ho,Wo]
+        # grouped contraction on the MXU
+        cols = cols.reshape(N, groups, Cin // groups, kh, kw, Hout, Wout)
+        w = w.reshape(groups, Cout // groups, Cin_g, kh, kw)
+        out = jnp.einsum("ngiabcd,goiab->ngocd", cols, w)
+        out = out.reshape(N, Cout, Hout, Wout)
+        if b is not None:
+            out = out + b[None, :, None, None]
+        return out
+
+    extras = []
+    if mask is not None:
+        extras.append(mask)
+    if bias is not None:
+        extras.append(bias)
+
+    def op(xv, off, w, *rest):
+        rest = list(rest)
+        m = rest.pop(0) if mask is not None else None
+        b = rest.pop(0) if bias is not None else None
+        return _f(xv, off, w, m, b)
+
+    op.__name__ = "deform_conv2d"
+    return apply(op, x, offset, weight, *extras)
+
+
+class DeformConv2D(Layer):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, deformable_groups=1, groups=1,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        ks = (kernel_size, kernel_size) if isinstance(kernel_size, int) \
+            else tuple(kernel_size)
+        self._stride = stride
+        self._padding = padding
+        self._dilation = dilation
+        self._deformable_groups = deformable_groups
+        self._groups = groups
+        from ..nn.initializer import XavierUniform
+
+        self.weight = self.create_parameter(
+            [out_channels, in_channels // groups, *ks], attr=weight_attr,
+            default_initializer=XavierUniform())
+        self.bias = None if bias_attr is False else self.create_parameter(
+            [out_channels], attr=bias_attr, is_bias=True)
+
+    def forward(self, x, offset, mask=None):
+        return deform_conv2d(x, offset, self.weight, self.bias,
+                             stride=self._stride, padding=self._padding,
+                             dilation=self._dilation,
+                             deformable_groups=self._deformable_groups,
+                             groups=self._groups, mask=mask)
+
+
+# ---------------------------------------------------------------------------
+# yolo
+# ---------------------------------------------------------------------------
+def yolo_box(x, img_size, anchors, class_num, conf_thresh,
+             downsample_ratio, clip_bbox=True, name=None, scale_x_y=1.0,
+             iou_aware=False, iou_aware_factor=0.5):
+    """Decode YOLOv3 head output to boxes+scores (vision/ops.py:253)."""
+    xv = _val(x).astype(jnp.float32)
+    img = _val(img_size).astype(jnp.float32)          # [N, 2] (h, w)
+    an = np.asarray(anchors, np.float32).reshape(-1, 2)
+    na = len(an)
+    N, C, H, W = xv.shape
+    if iou_aware:
+        ioup = jax.nn.sigmoid(xv[:, :na].reshape(N, na, 1, H, W))
+        xv = xv[:, na:]
+    xv = xv.reshape(N, na, 5 + class_num, H, W)
+    gx = jnp.arange(W, dtype=jnp.float32)[None, None, None, :]
+    gy = jnp.arange(H, dtype=jnp.float32)[None, None, :, None]
+    bx = (jax.nn.sigmoid(xv[:, :, 0]) * scale_x_y
+          - 0.5 * (scale_x_y - 1.0) + gx) / W
+    by = (jax.nn.sigmoid(xv[:, :, 1]) * scale_x_y
+          - 0.5 * (scale_x_y - 1.0) + gy) / H
+    input_h = downsample_ratio * H
+    input_w = downsample_ratio * W
+    bw = jnp.exp(xv[:, :, 2]) * an[None, :, 0, None, None] / input_w
+    bh = jnp.exp(xv[:, :, 3]) * an[None, :, 1, None, None] / input_h
+    conf = jax.nn.sigmoid(xv[:, :, 4])
+    if iou_aware:
+        conf = conf ** (1 - iou_aware_factor) \
+            * ioup[:, :, 0] ** iou_aware_factor
+    probs = jax.nn.sigmoid(xv[:, :, 5:]) * conf[:, :, None]
+
+    img_h = img[:, 0][:, None, None, None]
+    img_w = img[:, 1][:, None, None, None]
+    x1 = (bx - bw / 2) * img_w
+    y1 = (by - bh / 2) * img_h
+    x2 = (bx + bw / 2) * img_w
+    y2 = (by + bh / 2) * img_h
+    if clip_bbox:
+        x1 = jnp.clip(x1, 0)
+        y1 = jnp.clip(y1, 0)
+        x2 = jnp.minimum(x2, img_w - 1)
+        y2 = jnp.minimum(y2, img_h - 1)
+    keep = (conf > conf_thresh).astype(jnp.float32)
+    boxes = jnp.stack([x1, y1, x2, y2], axis=-1) * keep[..., None]
+    scores = probs * keep[:, :, None]
+    boxes = boxes.reshape(N, na * H * W, 4)           # [N,na,H,W,4] flat
+    scores = scores.transpose(0, 1, 3, 4, 2).reshape(N, na * H * W,
+                                                     class_num)
+    return Tensor(boxes), Tensor(scores)
+
+
+def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+              ignore_thresh, downsample_ratio, gt_score=None,
+              use_label_smooth=True, name=None, scale_x_y=1.0):
+    """YOLOv3 loss (vision/ops.py yolo_loss). Vectorized anchor/cell
+    assignment via one-hot masks; returns per-image loss [N]."""
+    xv = _val(x).astype(jnp.float32)
+    gtb = _val(gt_box).astype(jnp.float32)            # [N, B, 4] xywh (rel)
+    gtl = _val(gt_label).astype(jnp.int32)            # [N, B]
+    an_all = np.asarray(anchors, np.float32).reshape(-1, 2)
+    mask_idx = np.asarray(anchor_mask, np.int64)
+    an = an_all[mask_idx]
+    na = len(mask_idx)
+    N, C, H, W = xv.shape
+    xv = xv.reshape(N, na, 5 + class_num, H, W)
+    input_w = downsample_ratio * W
+    input_h = downsample_ratio * H
+
+    # decode predicted boxes (relative units) for the ignore mask
+    gx = jnp.arange(W, dtype=jnp.float32)[None, None, None, :]
+    gy = jnp.arange(H, dtype=jnp.float32)[None, None, :, None]
+    px = (jax.nn.sigmoid(xv[:, :, 0]) + gx) / W
+    py = (jax.nn.sigmoid(xv[:, :, 1]) + gy) / H
+    pw = jnp.exp(xv[:, :, 2]) * an[None, :, 0, None, None] / input_w
+    ph = jnp.exp(xv[:, :, 3]) * an[None, :, 1, None, None] / input_h
+
+    valid = (gtb[..., 2] > 0) & (gtb[..., 3] > 0)     # [N, B]
+
+    def iou_xywh(x1, y1, w1, h1, x2, y2, w2, h2):
+        l1, r1 = x1 - w1 / 2, x1 + w1 / 2
+        t1, b1 = y1 - h1 / 2, y1 + h1 / 2
+        l2, r2 = x2 - w2 / 2, x2 + w2 / 2
+        t2, b2 = y2 - h2 / 2, y2 + h2 / 2
+        iw = jnp.clip(jnp.minimum(r1, r2) - jnp.maximum(l1, l2), 0)
+        ih = jnp.clip(jnp.minimum(b1, b2) - jnp.maximum(t1, t2), 0)
+        inter = iw * ih
+        union = w1 * h1 + w2 * h2 - inter
+        return inter / jnp.maximum(union, 1e-10)
+
+    # ignore mask: pred boxes overlapping any gt above thresh aren't negatives
+    iou_all = iou_xywh(
+        px[..., None], py[..., None], pw[..., None], ph[..., None],
+        gtb[:, None, None, None, :, 0], gtb[:, None, None, None, :, 1],
+        gtb[:, None, None, None, :, 2], gtb[:, None, None, None, :, 3])
+    iou_all = jnp.where(valid[:, None, None, None, :], iou_all, 0.0)
+    ignore = (iou_all.max(-1) > ignore_thresh)        # [N,na,H,W]
+
+    # responsible anchor (over the FULL anchor set) + cell per gt
+    gw_in = gtb[..., 2] * input_w
+    gh_in = gtb[..., 3] * input_h
+    iou_an = iou_xywh(0.0, 0.0, gw_in[..., None], gh_in[..., None],
+                      0.0, 0.0, an_all[None, None, :, 0],
+                      an_all[None, None, :, 1])       # [N,B,num_anchors]
+    best = jnp.argmax(iou_an, axis=-1)                # [N, B]
+    # position of best anchor inside this head's mask (-1 if elsewhere)
+    in_mask = jnp.zeros_like(best) - 1
+    for pos, a_idx in enumerate(mask_idx):
+        in_mask = jnp.where(best == a_idx, pos, in_mask)
+    ci = jnp.clip((gtb[..., 0] * W).astype(jnp.int32), 0, W - 1)
+    cj = jnp.clip((gtb[..., 1] * H).astype(jnp.int32), 0, H - 1)
+    resp = valid & (in_mask >= 0)                     # [N, B]
+
+    # scatter gt targets onto the [na, H, W] grid via one-hot products
+    oh_a = jax.nn.one_hot(jnp.clip(in_mask, 0), na)   # [N,B,na]
+    oh_y = jax.nn.one_hot(cj, H)
+    oh_x = jax.nn.one_hot(ci, W)
+    sel = (oh_a[:, :, :, None, None] * oh_y[:, :, None, :, None]
+           * oh_x[:, :, None, None, :]) \
+        * resp[:, :, None, None, None]                # [N,B,na,H,W]
+    obj = sel.max(1)                                  # [N,na,H,W]
+
+    tx = gtb[..., 0] * W - ci
+    ty = gtb[..., 1] * H - cj
+    an_w = an_all[:, 0][mask_idx][None, None] / input_w
+    an_h = an_all[:, 1][mask_idx][None, None] / input_h
+    aw_per_gt = jnp.take(an_all[:, 0], best, axis=0) / input_w
+    ah_per_gt = jnp.take(an_all[:, 1], best, axis=0) / input_h
+    tw = jnp.log(jnp.maximum(gtb[..., 2] / jnp.maximum(aw_per_gt, 1e-9),
+                             1e-9))
+    th = jnp.log(jnp.maximum(gtb[..., 3] / jnp.maximum(ah_per_gt, 1e-9),
+                             1e-9))
+    box_scale = 2.0 - gtb[..., 2] * gtb[..., 3]
+    score = (jnp.ones_like(tx) if gt_score is None
+             else _val(gt_score).astype(jnp.float32))
+    wgt = score * box_scale                           # [N, B]
+
+    def bce(logit, target):
+        return jnp.maximum(logit, 0) - logit * target \
+            + jnp.log1p(jnp.exp(-jnp.abs(logit)))
+
+    def scat(v):  # [N,B] -> [N,na,H,W]
+        return (sel * v[:, :, None, None, None]).sum(1)
+
+    loss_xy = (bce(xv[:, :, 0], scat(tx)) * scat(wgt)
+               + bce(xv[:, :, 1], scat(ty)) * scat(wgt)) * obj
+    loss_wh = ((xv[:, :, 2] - scat(tw)) ** 2
+               + (xv[:, :, 3] - scat(th)) ** 2) * scat(wgt) * 0.5 * obj
+    smooth = 1.0 / class_num if use_label_smooth else 0.0
+    tcls = jax.nn.one_hot(gtl, class_num) * (1 - smooth) \
+        + smooth / max(class_num - 1, 1) * (1 - jax.nn.one_hot(gtl,
+                                                               class_num))
+    cls_target = jnp.einsum("nbahw,nbc->nachw", sel, tcls)
+    loss_cls = (bce(xv[:, :, 5:], cls_target)
+                * obj[:, :, None]).sum((1, 2, 3, 4))
+    obj_loss = bce(xv[:, :, 4], obj)
+    loss_obj = (obj_loss * obj).sum((1, 2, 3)) \
+        + (obj_loss * (1 - obj) * (1 - ignore)).sum((1, 2, 3))
+    total = (loss_xy.sum((1, 2, 3)) + loss_wh.sum((1, 2, 3))
+             + loss_obj + loss_cls)
+    return Tensor(total)
+
+
+# ---------------------------------------------------------------------------
+# RoI ops (eager: roi counts are data-dependent, host-driven postprocessing)
+# ---------------------------------------------------------------------------
+def _split_rois(boxes, boxes_num):
+    bn = [int(v) for v in np.asarray(_val(boxes_num))]
+    img_idx = np.repeat(np.arange(len(bn)), bn)
+    return _val(boxes), img_idx
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True, name=None):
+    """Mask R-CNN RoIAlign (vision/ops.py:1160): average of bilinear
+    samples per bin; adaptive sample count when sampling_ratio=-1."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = output_size
+    xv = _val(x)
+    rois, img_idx = _split_rois(boxes, boxes_num)
+    off = 0.5 if aligned else 0.0
+    outs = []
+    for r in range(rois.shape[0]):
+        x1, y1, x2, y2 = [float(v) for v in np.asarray(rois[r])]
+        img = xv[int(img_idx[r])]
+        rx = x1 * spatial_scale - off
+        ry = y1 * spatial_scale - off
+        rw = x2 * spatial_scale - off - rx
+        rh = y2 * spatial_scale - off - ry
+        if not aligned:
+            rw = max(rw, 1.0)
+            rh = max(rh, 1.0)
+        bin_h = rh / ph
+        bin_w = rw / pw
+        gy = sampling_ratio if sampling_ratio > 0 \
+            else max(1, math.ceil(rh / ph))
+        gx = sampling_ratio if sampling_ratio > 0 \
+            else max(1, math.ceil(rw / pw))
+        sy = ry + (jnp.arange(ph)[:, None] + (jnp.arange(gy) + 0.5)[None]
+                   / gy) * bin_h                      # [ph, gy]
+        sx = rx + (jnp.arange(pw)[:, None] + (jnp.arange(gx) + 0.5)[None]
+                   / gx) * bin_w                      # [pw, gx]
+        py = jnp.broadcast_to(sy[:, None, :, None], (ph, pw, gy, gx))
+        px = jnp.broadcast_to(sx[None, :, None, :], (ph, pw, gy, gx))
+        vals = _bilinear_sample(img, py, px)          # [C, ph, pw, gy, gx]
+        outs.append(vals.mean((-1, -2)))
+    out = jnp.stack(outs) if outs else jnp.zeros(
+        (0, xv.shape[1], ph, pw), xv.dtype)
+    return Tensor(out)
+
+
+def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0, name=None):
+    """Quantized max pooling per RoI bin (vision/ops.py:1033)."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = output_size
+    xv = _val(x)
+    H, W = xv.shape[-2:]
+    rois, img_idx = _split_rois(boxes, boxes_num)
+    outs = []
+    for r in range(rois.shape[0]):
+        x1, y1, x2, y2 = [float(v) for v in np.asarray(rois[r])]
+        img = xv[int(img_idx[r])]
+        rx1 = int(round(x1 * spatial_scale))
+        ry1 = int(round(y1 * spatial_scale))
+        rx2 = int(round(x2 * spatial_scale))
+        ry2 = int(round(y2 * spatial_scale))
+        rh = max(ry2 - ry1 + 1, 1)
+        rw = max(rx2 - rx1 + 1, 1)
+        bins = []
+        for i in range(ph):
+            hs = min(max(ry1 + int(np.floor(i * rh / ph)), 0), H)
+            he = min(max(ry1 + int(np.ceil((i + 1) * rh / ph)), 0), H)
+            row = []
+            for j in range(pw):
+                ws = min(max(rx1 + int(np.floor(j * rw / pw)), 0), W)
+                we = min(max(rx1 + int(np.ceil((j + 1) * rw / pw)), 0), W)
+                if he > hs and we > ws:
+                    row.append(img[:, hs:he, ws:we].max((-1, -2)))
+                else:
+                    row.append(jnp.zeros(img.shape[0], img.dtype))
+            bins.append(jnp.stack(row, -1))
+        outs.append(jnp.stack(bins, -2))
+    out = jnp.stack(outs) if outs else jnp.zeros(
+        (0, xv.shape[1], ph, pw), xv.dtype)
+    return Tensor(out)
+
+
+def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+               name=None):
+    """Position-sensitive RoI average pooling (vision/ops.py:918):
+    channel block (i,j) feeds output bin (i,j)."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = output_size
+    xv = _val(x)
+    C, H, W = xv.shape[1:]
+    assert C % (ph * pw) == 0, "channels must be divisible by ph*pw"
+    co = C // (ph * pw)
+    rois, img_idx = _split_rois(boxes, boxes_num)
+    outs = []
+    for r in range(rois.shape[0]):
+        x1, y1, x2, y2 = [float(v) for v in np.asarray(rois[r])]
+        # reference layout: channel (c*ph + i)*pw + j -> [co, ph, pw] blocks
+        img = xv[int(img_idx[r])].reshape(co, ph, pw, H, W)
+        rx1 = round(x1 * spatial_scale)
+        ry1 = round(y1 * spatial_scale)
+        rw = max(round(x2 * spatial_scale) - rx1, 0.1)
+        rh = max(round(y2 * spatial_scale) - ry1, 0.1)
+        out = jnp.zeros((co, ph, pw), xv.dtype)
+        for i in range(ph):
+            hs = min(max(int(np.floor(ry1 + i * rh / ph)), 0), H)
+            he = min(max(int(np.ceil(ry1 + (i + 1) * rh / ph)), 0), H)
+            for j in range(pw):
+                ws = min(max(int(np.floor(rx1 + j * rw / pw)), 0), W)
+                we = min(max(int(np.ceil(rx1 + (j + 1) * rw / pw)), 0), W)
+                if he > hs and we > ws:
+                    out = out.at[:, i, j].set(
+                        img[:, i, j, hs:he, ws:we].mean((-1, -2)))
+        outs.append(out)
+    out = jnp.stack(outs) if outs else jnp.zeros((0, co, ph, pw), xv.dtype)
+    return Tensor(out)
+
+
+class RoIAlign(Layer):
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self._output_size = output_size
+        self._spatial_scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num, aligned=True):
+        return roi_align(x, boxes, boxes_num, self._output_size,
+                         self._spatial_scale, aligned=aligned)
+
+
+class RoIPool(Layer):
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self._output_size = output_size
+        self._spatial_scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num):
+        return roi_pool(x, boxes, boxes_num, self._output_size,
+                        self._spatial_scale)
+
+
+class PSRoIPool(Layer):
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self._output_size = output_size
+        self._spatial_scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num):
+        return psroi_pool(x, boxes, boxes_num, self._output_size,
+                          self._spatial_scale)
+
+
+# ---------------------------------------------------------------------------
+# nms
+# ---------------------------------------------------------------------------
+def _iou_matrix(b):
+    area = (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
+    lt = np.maximum(b[:, None, :2], b[None, :, :2])
+    rb = np.minimum(b[:, None, 2:], b[None, :, 2:])
+    wh = np.clip(rb - lt, 0, None)
+    inter = wh[..., 0] * wh[..., 1]
+    return inter / np.maximum(area[:, None] + area[None] - inter, 1e-10)
+
+
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
+        categories=None, top_k=None):
+    """Greedy (optionally per-category) NMS; returns kept indices sorted by
+    score (vision/ops.py:1376)."""
+    b = np.asarray(_val(boxes), np.float32)
+    n = b.shape[0]
+    sc = (np.asarray(_val(scores), np.float32) if scores is not None
+          else None)
+
+    def greedy(idxs):
+        order = idxs if sc is None else idxs[np.argsort(-sc[idxs])]
+        iou = _iou_matrix(b[order])  # subset only: O(k^2), not O(n^2)
+        keep = []
+        alive = np.ones(len(order), bool)
+        for i in range(len(order)):
+            if not alive[i]:
+                continue
+            keep.append(order[i])
+            alive &= ~(iou[i] > iou_threshold) \
+                | (np.arange(len(order)) <= i)
+        return np.asarray(keep, np.int64)
+
+    if category_idxs is None:
+        kept = greedy(np.arange(n))
+    else:
+        cats = np.asarray(_val(category_idxs))
+        parts = [greedy(np.nonzero(cats == c)[0]) for c in categories]
+        kept = np.concatenate([p for p in parts if len(p)]) \
+            if parts else np.zeros(0, np.int64)
+        if sc is not None and len(kept):
+            kept = kept[np.argsort(-sc[kept])]
+    if top_k is not None:
+        kept = kept[:top_k]
+    return Tensor(jnp.asarray(kept))
+
+
+# ---------------------------------------------------------------------------
+# file io
+# ---------------------------------------------------------------------------
+def read_file(filename, name=None):
+    with open(filename, "rb") as f:
+        data = f.read()
+    return Tensor(jnp.frombuffer(data, dtype=jnp.uint8))
+
+
+def decode_jpeg(x, mode="unchanged", name=None):
+    """JPEG bytes -> [C, H, W] uint8 (PIL-backed host decode)."""
+    import io
+
+    from PIL import Image
+
+    raw = bytes(np.asarray(_val(x), np.uint8))
+    img = Image.open(io.BytesIO(raw))
+    if mode == "gray":
+        img = img.convert("L")
+    elif mode == "rgb":
+        img = img.convert("RGB")
+    arr = np.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[None]
+    else:
+        arr = arr.transpose(2, 0, 1)
+    return Tensor(jnp.asarray(arr))

@@ -1,0 +1,180 @@
+"""paddle.static.nn control flow + layer builders.
+
+Reference: python/paddle/static/nn/control_flow.py (cond, case,
+switch_case, while_loop) and static/nn/common.py.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.static import nn as snn
+
+
+class TestControlFlow:
+    def test_cond(self):
+        x = paddle.to_tensor(3.0)
+        out = snn.cond(x < 5.0, lambda: x * 2, lambda: x - 1)
+        assert float(out) == 6.0
+        out = snn.cond(x > 5.0, lambda: x * 2, lambda: x - 1)
+        assert float(out) == 2.0
+
+    def test_cond_multi_output(self):
+        x = paddle.to_tensor(2.0)
+        a, b = snn.cond(x < 5.0, lambda: (x + 1, x + 2),
+                        lambda: (x - 1, x - 2))
+        assert (float(a), float(b)) == (3.0, 4.0)
+
+    def test_cond_under_jit(self):
+        import jax
+        import jax.numpy as jnp
+
+        def f(v):
+            t = paddle.to_tensor(v)
+            return snn.cond(t < 0, lambda: -t, lambda: t)._value
+
+        g = jax.jit(f)
+        assert float(g(jnp.float32(-3.0))) == 3.0
+        assert float(g(jnp.float32(4.0))) == 4.0
+
+    def test_case_first_match_wins(self):
+        x = paddle.to_tensor(1.0)
+        out = snn.case([(x < 2, lambda: paddle.to_tensor(10.0)),
+                        (x < 3, lambda: paddle.to_tensor(20.0))],
+                       default=lambda: paddle.to_tensor(30.0))
+        assert float(out) == 10.0
+        x2 = paddle.to_tensor(2.5)
+        out = snn.case([(x2 < 2, lambda: paddle.to_tensor(10.0)),
+                        (x2 < 3, lambda: paddle.to_tensor(20.0))],
+                       default=lambda: paddle.to_tensor(30.0))
+        assert float(out) == 20.0
+        x3 = paddle.to_tensor(9.0)
+        out = snn.case([(x3 < 2, lambda: paddle.to_tensor(10.0)),
+                        (x3 < 3, lambda: paddle.to_tensor(20.0))],
+                       default=lambda: paddle.to_tensor(30.0))
+        assert float(out) == 30.0
+
+    def test_switch_case(self):
+        idx = paddle.to_tensor(np.int32(1))
+        out = snn.switch_case(idx, {
+            0: lambda: paddle.to_tensor(0.0),
+            1: lambda: paddle.to_tensor(11.0),
+            7: lambda: paddle.to_tensor(77.0)},
+            default=lambda: paddle.to_tensor(-1.0))
+        assert float(out) == 11.0
+        out = snn.switch_case(paddle.to_tensor(np.int32(5)), {
+            0: lambda: paddle.to_tensor(0.0),
+            1: lambda: paddle.to_tensor(11.0)},
+            default=lambda: paddle.to_tensor(-1.0))
+        assert float(out) == -1.0
+
+    def test_while_loop(self):
+        i = paddle.to_tensor(np.int64(0))
+        s = paddle.to_tensor(0.0)
+        i_out, s_out = snn.while_loop(
+            lambda i, s: i < 10,
+            lambda i, s: [i + 1, s + paddle.cast(i, "float32")],
+            [i, s])
+        assert int(i_out) == 10
+        assert float(s_out) == 45.0
+
+    def test_while_loop_under_jit(self):
+        """while_loop compiles as lax.while_loop inside one XLA program."""
+        import jax
+        import jax.numpy as jnp
+
+        def f(x0):
+            with paddle.no_grad():
+                _, out = snn.while_loop(
+                    lambda i, v: i < 3,
+                    lambda i, v: [i + 1, v * 2.0],
+                    [paddle.to_tensor(np.int32(0)), paddle.to_tensor(x0)])
+            return out._value
+
+        assert float(jax.jit(f)(jnp.float32(1.5))) == 12.0  # 1.5 * 2^3
+
+
+class TestLayerBuilders:
+    def test_layer_norm_group_norm(self):
+        paddle.seed(0)
+        x = paddle.randn([2, 6, 4, 4])
+        out = snn.layer_norm(x, begin_norm_axis=1)
+        np.testing.assert_allclose(out.numpy().mean((1, 2, 3)), 0.0,
+                                   atol=1e-5)
+        out = snn.group_norm(x, groups=3)
+        assert out.shape == [2, 6, 4, 4]
+
+    def test_conv_transpose_and_3d(self):
+        paddle.seed(1)
+        x = paddle.randn([1, 3, 8, 8])
+        out = snn.conv2d_transpose(x, 5, filter_size=2, stride=2)
+        assert out.shape == [1, 5, 16, 16]
+        v = paddle.randn([1, 2, 4, 8, 8])
+        out = snn.conv3d(v, 4, filter_size=3, padding=1)
+        assert out.shape == [1, 4, 4, 8, 8]
+        out = snn.conv3d_transpose(v, 4, filter_size=2, stride=2)
+        assert out.shape == [1, 4, 8, 16, 16]
+
+    def test_bilinear_prelu_rowconv(self):
+        paddle.seed(2)
+        x = paddle.randn([4, 5])
+        y = paddle.randn([4, 7])
+        out = snn.bilinear_tensor_product(x, y, size=3)
+        assert out.shape == [4, 3]
+        img = paddle.randn([2, 3, 4, 4])
+        assert snn.prelu(img, "channel").shape == [2, 3, 4, 4]
+        seq = paddle.to_tensor(np.ones((2, 5, 3), np.float32))
+        out = snn.row_conv(seq, future_context_size=2)
+        # interior steps see full context: sum of 3 taps * 0.1 each
+        np.testing.assert_allclose(out.numpy()[:, 0], 0.3, rtol=1e-5)
+
+    def test_spectral_norm(self):
+        paddle.seed(5)
+        w = paddle.randn([6, 4])
+        out = snn.spectral_norm(w, power_iters=20)
+        s = np.linalg.svd(out.numpy(), compute_uv=False)[0]
+        np.testing.assert_allclose(s, 1.0, rtol=1e-3)
+
+    def test_conv_transpose_from_output_size(self):
+        x = paddle.randn([1, 3, 8, 8])
+        out = snn.conv2d_transpose(x, 5, output_size=[16, 16], stride=2)
+        assert out.shape == [1, 5, 16, 16]
+
+    def test_param_creation_in_branch_raises(self):
+        x = paddle.to_tensor(np.ones(4, np.float32))
+        with pytest.raises(RuntimeError, match="control-flow branch"):
+            snn.cond(x.sum() > 0, lambda: snn.fc(x.reshape([1, 4]), 3),
+                     lambda: x)
+
+    def test_py_func_scalar_output(self):
+        x = paddle.to_tensor(np.arange(4, dtype=np.float32))
+        op = paddle.utils.register_custom_op(
+            "host_mean", lambda a: np.float32(np.mean(a)),
+            infer_shape=lambda a: ((), "float32"))
+        assert float(op(x)._value) == 1.5
+
+    def test_py_func(self):
+        def host_sq(a):
+            return np.asarray(a) ** 2
+
+        x = paddle.to_tensor(np.arange(4, dtype=np.float32))
+        out_spec = paddle.to_tensor(np.zeros(4, np.float32))
+        out = snn.py_func(host_sq, x, out_spec)
+        np.testing.assert_allclose(out.numpy(), [0, 1, 4, 9])
+
+    def test_static_program_with_cond(self):
+        """Control flow records into a static Program and replays."""
+        paddle.enable_static()
+        try:
+            main = paddle.static.Program()
+            with paddle.static.program_guard(main):
+                x = paddle.static.data("x", [4], "float32")
+                y = snn.cond(x.sum() > 0, lambda: x * 2.0, lambda: x - 1.0)
+            exe = paddle.static.Executor()
+            (pos,) = exe.run(main, feed={"x": np.ones(4, np.float32)},
+                             fetch_list=[y])
+            np.testing.assert_allclose(pos, 2.0)
+            (neg,) = exe.run(main, feed={"x": -np.ones(4, np.float32)},
+                             fetch_list=[y])
+            np.testing.assert_allclose(neg, -2.0)
+        finally:
+            paddle.disable_static()

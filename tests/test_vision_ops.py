@@ -1,0 +1,178 @@
+"""paddle.vision.ops tests (reference: python/paddle/vision/ops.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import ops
+
+
+def test_nms_basic():
+    boxes = np.array([[0, 0, 10, 10], [1, 1, 11, 11], [50, 50, 60, 60]],
+                     np.float32)
+    scores = np.array([0.9, 0.8, 0.7], np.float32)
+    kept = ops.nms(paddle.to_tensor(boxes), 0.5,
+                   scores=paddle.to_tensor(scores))
+    np.testing.assert_array_equal(np.asarray(kept._value), [0, 2])
+
+
+def test_nms_categories_topk():
+    boxes = np.array([[0, 0, 10, 10], [1, 1, 11, 11], [0, 0, 10, 10]],
+                     np.float32)
+    scores = np.array([0.9, 0.8, 0.95], np.float32)
+    cats = np.array([0, 0, 1])
+    kept = ops.nms(paddle.to_tensor(boxes), 0.5,
+                   scores=paddle.to_tensor(scores),
+                   category_idxs=paddle.to_tensor(cats), categories=[0, 1],
+                   top_k=2)
+    # per-category: cat0 keeps box0 (suppresses box1), cat1 keeps box2;
+    # global score order -> [2, 0]
+    np.testing.assert_array_equal(np.asarray(kept._value), [2, 0])
+
+
+def test_roi_align_uniform_feature():
+    """On a constant feature map every bin averages to that constant."""
+    x = paddle.to_tensor(np.full((1, 3, 16, 16), 7.0, np.float32))
+    boxes = paddle.to_tensor(np.array([[2.0, 2.0, 10.0, 10.0]], np.float32))
+    out = ops.roi_align(x, boxes, paddle.to_tensor(np.array([1])), 4)
+    assert out.shape == [1, 3, 4, 4]
+    np.testing.assert_allclose(out.numpy(), 7.0, rtol=1e-6)
+
+
+def test_roi_align_linear_gradient_field():
+    """Bilinear sampling of f(y,x)=x is exact: bin centers reproduce x."""
+    W = 16
+    grid = np.broadcast_to(np.arange(W, dtype=np.float32), (W, W))
+    x = paddle.to_tensor(grid[None, None])
+    boxes = paddle.to_tensor(np.array([[4.0, 4.0, 12.0, 12.0]], np.float32))
+    out = ops.roi_align(x, boxes, paddle.to_tensor(np.array([1])), 2,
+                        sampling_ratio=2, aligned=False)
+    # roi [4,12): bins of width 4, sample points at x=4+{1,3} and 8+{1,3}
+    np.testing.assert_allclose(out.numpy()[0, 0, 0], [6.0, 10.0], rtol=1e-5)
+
+
+def test_roi_pool_max():
+    feat = np.zeros((1, 1, 8, 8), np.float32)
+    feat[0, 0, 2, 2] = 5.0
+    feat[0, 0, 6, 6] = 9.0
+    x = paddle.to_tensor(feat)
+    boxes = paddle.to_tensor(np.array([[0.0, 0.0, 7.0, 7.0]], np.float32))
+    out = ops.roi_pool(x, boxes, paddle.to_tensor(np.array([1])), 2)
+    np.testing.assert_allclose(out.numpy()[0, 0], [[5.0, 0.0], [0.0, 9.0]])
+
+
+def test_psroi_pool_position_sensitive():
+    ph = pw = 2
+    co = 2
+    # reference layout: channel (c*ph + i)*pw + j
+    feat = np.stack([np.full((8, 8), float(i)) for i in range(co * ph * pw)])
+    x = paddle.to_tensor(feat[None].astype(np.float32))
+    boxes = paddle.to_tensor(np.array([[0.0, 0.0, 8.0, 8.0]], np.float32))
+    out = ops.psroi_pool(x, boxes, paddle.to_tensor(np.array([1])), 2)
+    # output channel c, bin (i,j) reads input channel (c*ph+i)*pw+j
+    np.testing.assert_allclose(out.numpy()[0, 0], [[0.0, 1.0], [2.0, 3.0]])
+    np.testing.assert_allclose(out.numpy()[0, 1], [[4.0, 5.0], [6.0, 7.0]])
+
+
+def test_deform_conv2d_zero_offset_matches_conv():
+    """Zero offsets + ones mask reduce deformable conv to plain conv."""
+    paddle.seed(0)
+    rng = np.random.RandomState(0)
+    x = rng.randn(2, 4, 10, 10).astype(np.float32)
+    w = rng.randn(6, 4, 3, 3).astype(np.float32)
+    offset = np.zeros((2, 2 * 1 * 9, 8, 8), np.float32)
+    out = ops.deform_conv2d(paddle.to_tensor(x), paddle.to_tensor(offset),
+                            paddle.to_tensor(w))
+    ref = paddle.nn.functional.conv2d(paddle.to_tensor(x),
+                                      paddle.to_tensor(w))
+    np.testing.assert_allclose(out.numpy(), ref.numpy(), rtol=1e-4,
+                               atol=1e-4)
+
+
+def test_deform_conv2d_mask_and_grad():
+    paddle.seed(1)
+    rng = np.random.RandomState(1)
+    x = paddle.to_tensor(rng.randn(1, 2, 6, 6).astype(np.float32))
+    x.stop_gradient = False
+    w = paddle.to_tensor(rng.randn(3, 2, 3, 3).astype(np.float32) * 0.1)
+    w.stop_gradient = False
+    offset = paddle.to_tensor(
+        rng.randn(1, 18, 4, 4).astype(np.float32) * 0.1)
+    offset.stop_gradient = False
+    mask = paddle.to_tensor(
+        np.full((1, 9, 4, 4), 0.5, np.float32))
+    out = ops.deform_conv2d(x, offset, w, mask=mask)
+    assert out.shape == [1, 3, 4, 4]
+    out.sum().backward()
+    assert x.grad is not None and w.grad is not None \
+        and offset.grad is not None
+    # half mask == half output
+    out2 = ops.deform_conv2d(x, offset, w,
+                             mask=paddle.to_tensor(
+                                 np.ones((1, 9, 4, 4), np.float32)))
+    np.testing.assert_allclose(out.numpy() * 2, out2.numpy(), rtol=1e-4)
+
+
+def test_deform_conv2d_layer():
+    layer = ops.DeformConv2D(4, 8, 3, padding=1)
+    x = paddle.randn([2, 4, 8, 8])
+    offset = paddle.zeros([2, 18, 8, 8])
+    out = layer(x, offset)
+    assert out.shape == [2, 8, 8, 8]
+
+
+def test_yolo_box_decode():
+    np.random.seed(0)
+    na, cls, H = 2, 3, 4
+    x = np.zeros((1, na * (5 + cls), H, H), np.float32)
+    boxes, scores = ops.yolo_box(
+        paddle.to_tensor(x),
+        paddle.to_tensor(np.array([[128, 128]], np.int32)),
+        anchors=[10, 13, 16, 30], class_num=cls, conf_thresh=0.4,
+        downsample_ratio=32)
+    assert boxes.shape == [1, na * H * H, 4]
+    assert scores.shape == [1, na * H * H, cls]
+    # zero logits: sigmoid=0.5 > thresh; center of cell(0,0) at 0.5/4
+    b0 = boxes.numpy()[0, 0]
+    assert abs((b0[0] + b0[2]) / 2 - 128 * 0.5 / 4) < 1e-3
+    # w = exp(0)*anchor_w/input_w = 10/128 (relative) -> 10 px
+    assert abs((b0[2] - b0[0]) - 10.0) < 1e-3
+
+
+def test_yolo_box_conf_thresh_zeroes():
+    x = np.full((1, 1 * 5, 2, 2), -10.0, np.float32)  # conf ~ 0
+    boxes, scores = ops.yolo_box(
+        paddle.to_tensor(x), paddle.to_tensor(np.array([[64, 64]])),
+        anchors=[10, 13], class_num=0, conf_thresh=0.5,
+        downsample_ratio=32)
+    np.testing.assert_allclose(boxes.numpy(), 0.0)
+
+
+def test_yolo_loss_runs_and_grads():
+    np.random.seed(2)
+    na, cls, H = 3, 5, 8
+    x = paddle.to_tensor(
+        np.random.randn(2, na * (5 + cls), H, H).astype(np.float32) * 0.1)
+    x.stop_gradient = False
+    gt_box = paddle.to_tensor(np.array(
+        [[[0.5, 0.5, 0.3, 0.4], [0.2, 0.2, 0.1, 0.1]],
+         [[0.7, 0.3, 0.2, 0.2], [0.0, 0.0, 0.0, 0.0]]], np.float32))
+    gt_label = paddle.to_tensor(np.array([[1, 2], [3, 0]]))
+    loss = ops.yolo_loss(x, gt_box, gt_label,
+                         anchors=[10, 13, 16, 30, 33, 23],
+                         anchor_mask=[0, 1, 2], class_num=cls,
+                         ignore_thresh=0.7, downsample_ratio=32)
+    assert loss.shape == [2]
+    assert np.all(np.isfinite(loss.numpy())) and np.all(loss.numpy() > 0)
+
+
+def test_read_file_decode_jpeg(tmp_path):
+    from PIL import Image
+
+    img = Image.fromarray(
+        (np.random.RandomState(0).rand(10, 12, 3) * 255).astype(np.uint8))
+    p = tmp_path / "t.jpg"
+    img.save(p)
+    raw = ops.read_file(str(p))
+    assert raw._value.dtype == np.uint8
+    decoded = ops.decode_jpeg(raw)
+    assert decoded.shape == [3, 10, 12]

@@ -249,22 +249,37 @@ def main():
         except Exception as e:  # noqa: BLE001
             details[bench.__name__ + "_error"] = str(e)[:300]
 
-    value = details.get("bert_tokens_per_sec")
+    # headline = BERT; fall back to the next real number on tunnel flakes.
+    # If nothing measured, keep the documented BERT label with value null.
+    candidates = [
+        ("bert_tokens_per_sec",
+         "BERT-base MLM tokens/sec/chip (AMP O2 bf16)", "tokens/sec"),
+        ("resnet50_imgs_per_sec",
+         "ResNet50 train imgs/sec/chip (static Executor, fp32)", "imgs/sec"),
+        ("lenet_imgs_per_sec", "LeNet Model.fit imgs/sec/chip", "imgs/sec"),
+    ]
+    ref_key, metric, unit = candidates[0]
+    value = None
+    for key, m, u in candidates:
+        if details.get(key):
+            ref_key, metric, unit = key, m, u
+            value = details[key]
+            break
     baseline = 1.0
     try:
         with open(os.path.join(os.path.dirname(__file__) or ".",
                                "BASELINE.json")) as f:
             published = json.load(f).get("published", {})
-        ref = published.get("bert_tokens_per_sec")
+        ref = published.get(ref_key)
         if value and ref:
             baseline = value / ref
     except (OSError, ValueError):
         pass
 
     print(json.dumps({
-        "metric": "BERT-base MLM tokens/sec/chip (AMP O2 bf16)",
+        "metric": metric,
         "value": round(value, 1) if value else None,
-        "unit": "tokens/sec",
+        "unit": unit,
         "vs_baseline": round(baseline, 3),
         **{k: (round(v, 2) if isinstance(v, float) else v)
            for k, v in details.items()},

@@ -1,0 +1,139 @@
+"""Beam-search decoding (reference: python/paddle/nn/decode.py —
+BeamSearchDecoder + dynamic_decode over an RNN cell).
+
+TPU-native design: the decode loop is a host loop over jitted steps (eager
+parity with the reference's dygraph path); every step is pure jnp —
+top-(beam) over the flattened [batch, beam*vocab] scores, state gather by
+beam indices, finished-beam freezing — and the final back-trace uses
+functional.gather_tree.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from .layer.layers import Layer
+
+__all__ = ["BeamSearchDecoder", "dynamic_decode"]
+
+
+def _val(x):
+    return x._value if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+class BeamSearchDecoder:
+    """Wraps an RNN cell into a beam-search step function.
+
+    cell(step_input, states) -> (output, new_states); `output_fn` maps cell
+    output to vocab logits; `embedding_fn` maps token ids to step inputs.
+    """
+
+    def __init__(self, cell, start_token, end_token, beam_size,
+                 embedding_fn=None, output_fn=None):
+        self.cell = cell
+        self.start_token = int(start_token)
+        self.end_token = int(end_token)
+        self.beam_size = int(beam_size)
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+
+    # -- helpers -----------------------------------------------------------
+    def _merge(self, x):
+        """[batch, beam, ...] -> [batch*beam, ...]."""
+        v = _val(x)
+        return v.reshape((-1,) + v.shape[2:])
+
+    def _split(self, x, batch):
+        v = _val(x)
+        return v.reshape((batch, self.beam_size) + v.shape[1:])
+
+    def initialize(self, initial_cell_states):
+        """Tile encoder states across beams; first input is start_token."""
+        states = jax.tree_util.tree_map(
+            lambda s: jnp.repeat(_val(s), self.beam_size, axis=0),
+            initial_cell_states, is_leaf=lambda s: isinstance(s, Tensor))
+        batch = jax.tree_util.tree_leaves(states)[0].shape[0] \
+            // self.beam_size
+        ids = jnp.full((batch, self.beam_size), self.start_token, jnp.int64)
+        # beam 0 active, the rest start at -inf so step 1 expands one beam
+        log_probs = jnp.tile(
+            jnp.asarray([0.0] + [-1e9] * (self.beam_size - 1)), (batch, 1))
+        finished = jnp.zeros((batch, self.beam_size), bool)
+        return ids, states, log_probs, finished
+
+    def step(self, ids, states, log_probs, finished):
+        batch = ids.shape[0]
+        inputs = ids.reshape(-1)
+        if self.embedding_fn is not None:
+            inputs = self.embedding_fn(Tensor(inputs))
+        out, new_states = self.cell(
+            inputs if isinstance(inputs, Tensor) else Tensor(inputs),
+            jax.tree_util.tree_map(Tensor, states))
+        logits = self.output_fn(out) if self.output_fn is not None else out
+        logp = jax.nn.log_softmax(_val(logits), axis=-1)   # [b*beam, V]
+        V = logp.shape[-1]
+        logp = logp.reshape(batch, self.beam_size, V)
+        # finished beams only extend with end_token at zero cost
+        frozen = jnp.full((V,), -1e9).at[self.end_token].set(0.0)
+        logp = jnp.where(finished[..., None], frozen[None, None, :], logp)
+        total = log_probs[..., None] + logp                # [b, beam, V]
+        flat = total.reshape(batch, -1)
+        top_scores, top_idx = jax.lax.top_k(flat, self.beam_size)
+        parent = (top_idx // V).astype(jnp.int64)          # [b, beam]
+        token = (top_idx % V).astype(jnp.int64)
+
+        def gather_state(s):
+            s = _val(s).reshape((batch, self.beam_size) + _val(s).shape[1:])
+            g = jnp.take_along_axis(
+                s, parent.reshape(parent.shape + (1,) * (s.ndim - 2)),
+                axis=1)
+            return g.reshape((batch * self.beam_size,) + s.shape[2:])
+
+        new_states = jax.tree_util.tree_map(
+            gather_state, new_states,
+            is_leaf=lambda x: isinstance(x, Tensor))
+        new_finished = jnp.take_along_axis(finished, parent, axis=1) \
+            | (token == self.end_token)
+        return token, parent, new_states, top_scores, new_finished
+
+
+def dynamic_decode(decoder, inits=None, max_step_num=None, output_time_major=False,
+                   impute_finished=False, is_test=False, return_length=False,
+                   **kwargs):
+    """Run `decoder` until every beam is finished or max_step_num
+    (reference nn/decode.py dynamic_decode). Returns (ids, scores) with ids
+    [batch, beam, time] (or time-major), plus lengths when requested."""
+    from .functional.vision import gather_tree
+
+    # None = decode until every beam emits end_token (reference semantics)
+    ids, states, log_probs, finished = decoder.initialize(inits)
+    batch = ids.shape[0]
+    step_ids = []
+    parents = []
+    lengths = jnp.zeros((batch, decoder.beam_size), jnp.int64)
+    step = 0
+    while max_step_num is None or step < int(max_step_num):
+        token, parent, states, log_probs, new_finished = decoder.step(
+            ids, states, log_probs, finished)
+        step_ids.append(token)
+        parents.append(parent)
+        # each output slot continues its PARENT's trajectory — gather the
+        # parent's length/finished before extending
+        parent_len = jnp.take_along_axis(lengths, parent, axis=1)
+        parent_fin = jnp.take_along_axis(finished, parent, axis=1)
+        lengths = parent_len + (~parent_fin).astype(jnp.int64)
+        ids, finished = token, new_finished
+        step += 1
+        if bool(np.asarray(finished.all())):
+            break
+    ids_t = jnp.stack(step_ids)                            # [T, b, beam]
+    parents_t = jnp.stack(parents)
+    seqs = gather_tree(Tensor(ids_t), Tensor(parents_t))._value
+    scores = log_probs
+    out = seqs if output_time_major else jnp.transpose(seqs, (1, 2, 0))
+    rets = (Tensor(out), Tensor(scores))
+    if return_length:
+        rets = rets + (Tensor(lengths),)
+    return rets

@@ -16,7 +16,8 @@ __all__ = [
     "triplet_margin_with_distance_loss", "soft_margin_loss",
     "multi_label_soft_margin_loss", "poisson_nll_loss", "gaussian_nll_loss",
     "log_loss", "square_error_cost", "sigmoid_focal_loss", "dice_loss",
-    "npair_loss", "mse", "multi_margin_loss",
+    "npair_loss", "mse", "multi_margin_loss", "hsigmoid_loss",
+    "margin_cross_entropy",
 ]
 
 
@@ -398,3 +399,99 @@ def npair_loss(anchor, positive, labels, l2_reg=0.002):
         l2 = jnp.mean(jnp.sum(a * a, -1) + jnp.sum(p * p, -1))
         return jnp.mean((ce_r + ce_c) / 2) + l2_reg * l2 * 0.25
     return apply(_f, anchor, positive, labels)
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,  # noqa: A002
+                  path_table=None, path_code=None, is_sparse=False,
+                  name=None):
+    """Hierarchical sigmoid loss (reference nn/functional/loss.py:325).
+
+    Default tree: the complete binary heap over 2*num_classes-1 nodes
+    (leaf for class c at heap index c + num_classes - 1; internal node i
+    owns weight row i). Custom trees pass path_table/path_code, -1 padded.
+    """
+    import math
+
+    C = int(num_classes)
+
+    def _f(x, lab, w, b, table, code):
+        n = x.shape[0]
+        if table is None:
+            # derive root->leaf paths from the heap numbering: walking up
+            # from leaf lab + C - 1; child parity gives the sigmoid code
+            depth = max(1, math.ceil(math.log2(max(2, C))))
+            node = lab + (C - 1)
+            steps = []
+            for _ in range(depth):
+                parent = (node - 1) // 2
+                is_right = (node % 2) == 0
+                valid = node > 0
+                steps.append((jnp.where(valid, parent, -1),
+                              jnp.where(valid, is_right, False), valid))
+                node = jnp.where(valid, parent, node)
+            table = jnp.stack([s[0] for s in reversed(steps)], -1)  # [N,L]
+            code = jnp.stack([s[1] for s in reversed(steps)], -1)
+        else:
+            table = table.astype(jnp.int32)
+            code = code.astype(bool)
+        mask = table >= 0
+        safe = jnp.where(mask, table, 0)
+        wp = jnp.take(w, safe, axis=0)                    # [N, L, F]
+        logits = jnp.einsum("nlf,nf->nl", wp, x)
+        if b is not None:
+            logits = logits + jnp.take(b.reshape(-1), safe, axis=0)
+        # BCE-with-logits against the path code, padded steps masked out
+        target = code.astype(logits.dtype)
+        per = jnp.maximum(logits, 0) - logits * target \
+            + jnp.log1p(jnp.exp(-jnp.abs(logits)))
+        return (per * mask).sum(-1, keepdims=True)
+
+    args = [input, label, weight]
+    extra = []
+    if bias is not None:
+        extra.append(bias)
+    if path_table is not None:
+        extra += [path_table, path_code]
+
+    def op(x, lab, w, *rest):
+        rest = list(rest)
+        b = rest.pop(0) if bias is not None else None
+        t = rest.pop(0) if path_table is not None else None
+        c = rest.pop(0) if path_table is not None else None
+        return _f(x, lab, w, b, t, c)
+
+    op.__name__ = "hsigmoid_loss"
+    return apply(op, *args, *extra)
+
+
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5,
+                         margin3=0.0, scale=64.0, group=None,
+                         return_softmax=False, reduction="mean"):
+    """ArcFace-family margin softmax (reference nn/functional/loss.py:1137):
+    target cosine -> cos(m1*theta + m2) - m3, all scaled by s."""
+    if group not in (None, False):
+        raise NotImplementedError(
+            "class-sharded (model-parallel) margin_cross_entropy is not "
+            "supported; gather the class dimension or use "
+            "mp_layers.ParallelCrossEntropy")
+
+    def _f(cosine, lab):
+        n, c = cosine.shape
+        oh = jax.nn.one_hot(lab, c, dtype=cosine.dtype)
+        target_cos = (cosine * oh).sum(-1)
+        theta = jnp.arccos(jnp.clip(target_cos, -1.0 + 1e-7, 1.0 - 1e-7))
+        modified = jnp.cos(margin1 * theta + margin2) - margin3
+        adjusted = cosine * (1 - oh) + modified[:, None] * oh
+        z = adjusted * scale
+        logp = jax.nn.log_softmax(z, axis=-1)
+        loss = -(logp * oh).sum(-1, keepdims=True)
+        sm = jnp.exp(logp)
+        if reduction == "mean":
+            loss = loss.mean()
+        elif reduction == "sum":
+            loss = loss.sum()
+        return loss, sm
+
+    out = apply(lambda a, b: _f(a, b), logits, label)
+    loss, sm = out
+    return (loss, sm) if return_softmax else loss

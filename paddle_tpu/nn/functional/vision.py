@@ -1,0 +1,215 @@
+"""Vision/extension functionals: affine_grid, grid_sample, diag_embed,
+gather_tree, sparse_attention.
+
+Reference: python/paddle/nn/functional/vision.py:28 (affine_grid), :122
+(grid_sample), extension.py:30 (diag_embed), extension.py (gather_tree),
+sparse_attention.py:23. All pure-jnp gathers — jit/vmap/grad-ready; the
+sparse_attention CSR pattern materializes as a boolean mask inside one XLA
+program (TPU long-sequence sparsity is served by the Pallas flash/ring
+kernels instead of block-sparse CSR kernels).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.autograd import apply
+
+__all__ = ["affine_grid", "grid_sample", "diag_embed", "gather_tree",
+           "sparse_attention"]
+
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    """theta [N,2,3] + out [N,C,H,W] -> grid [N,H,W,2] (or the 3D analog)."""
+    if hasattr(out_shape, "_value"):
+        import numpy as np
+
+        out_shape = [int(v) for v in np.asarray(out_shape._value)]
+    out_shape = [int(s) for s in out_shape]
+
+    def _axis_coords(n):
+        if align_corners:
+            return jnp.linspace(-1.0, 1.0, n) if n > 1 \
+                else jnp.zeros((1,))
+        step = 2.0 / n
+        return -1.0 + step / 2 + step * jnp.arange(n)
+
+    def _f(th):
+        if len(out_shape) == 4:
+            _, _, H, W = out_shape
+            xs = _axis_coords(W)
+            ys = _axis_coords(H)
+            ones = jnp.ones((H, W))
+            base = jnp.stack([jnp.broadcast_to(xs[None, :], (H, W)),
+                              jnp.broadcast_to(ys[:, None], (H, W)),
+                              ones], axis=-1)              # [H,W,3]
+            return jnp.einsum("hwk,nck->nhwc", base, th)   # [N,H,W,2]
+        _, _, D, H, W = out_shape
+        xs = _axis_coords(W)
+        ys = _axis_coords(H)
+        zs = _axis_coords(D)
+        base = jnp.stack([
+            jnp.broadcast_to(xs[None, None, :], (D, H, W)),
+            jnp.broadcast_to(ys[None, :, None], (D, H, W)),
+            jnp.broadcast_to(zs[:, None, None], (D, H, W)),
+            jnp.ones((D, H, W))], axis=-1)                 # [D,H,W,4]
+        return jnp.einsum("dhwk,nck->ndhwc", base, th)     # [N,D,H,W,3]
+
+    _f.__name__ = "affine_grid"
+    return apply(_f, theta)
+
+
+def _unnormalize(coord, size, align_corners):
+    if align_corners:
+        return (coord + 1.0) / 2.0 * (size - 1)
+    return ((coord + 1.0) * size - 1.0) / 2.0
+
+
+def _reflect(ix, size, align_corners):
+    # reflect into the valid range (torch/paddle reflection semantics)
+    if align_corners:
+        span = 2 * (size - 1)
+        if span == 0:
+            return jnp.zeros_like(ix)
+        ix = jnp.abs(ix) % span
+        return jnp.where(ix > size - 1, span - ix, ix)
+    span = 2 * size
+    ix = jnp.abs(ix + 0.5) % span
+    ix = jnp.where(ix > size, span - ix, ix) - 0.5
+    return jnp.clip(ix, 0, size - 1)
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True, name=None):
+    """Sample x [N,C,H,W] at grid [N,Ho,Wo,2] ((x,y) in [-1,1])."""
+    if mode not in ("bilinear", "nearest"):
+        raise ValueError(f"unsupported mode {mode}")
+    if padding_mode not in ("zeros", "border", "reflection"):
+        raise ValueError(f"unsupported padding_mode {padding_mode}")
+
+    def _f(xv, gv):
+        N, C, H, W = xv.shape
+        gx = _unnormalize(gv[..., 0], W, align_corners)
+        gy = _unnormalize(gv[..., 1], H, align_corners)
+        if padding_mode == "reflection":
+            gx = _reflect(gx, W, align_corners)
+            gy = _reflect(gy, H, align_corners)
+
+        def sample_one(img, ix, iy):
+            # img [C,H,W]; ix/iy [Ho,Wo]
+            def fetch(yy, xx):
+                inb = (yy >= 0) & (yy < H) & (xx >= 0) & (xx < W)
+                yc = jnp.clip(yy, 0, H - 1).astype(jnp.int32)
+                xc = jnp.clip(xx, 0, W - 1).astype(jnp.int32)
+                v = img[:, yc, xc]                    # [C,Ho,Wo]
+                if padding_mode == "zeros":
+                    v = v * inb[None]
+                return v
+
+            if mode == "nearest":
+                return fetch(jnp.round(iy), jnp.round(ix))
+            x0 = jnp.floor(ix)
+            y0 = jnp.floor(iy)
+            wx1 = ix - x0
+            wy1 = iy - y0
+            out = 0.0
+            for dy, wy in ((0, 1 - wy1), (1, wy1)):
+                for dx, wx in ((0, 1 - wx1), (1, wx1)):
+                    out = out + fetch(y0 + dy, x0 + dx) * (wy * wx)[None]
+            return out
+
+        if padding_mode == "border":
+            gx = jnp.clip(gx, 0, W - 1)
+            gy = jnp.clip(gy, 0, H - 1)
+        return jax.vmap(sample_one)(xv, gx, gy)
+
+    _f.__name__ = "grid_sample"
+    return apply(_f, x, grid)
+
+
+def diag_embed(input, offset=0, dim1=-2, dim2=-1, name=None):  # noqa: A002
+    """Batched vectors -> batched matrices with the vector on a diagonal."""
+
+    def _f(v):
+        n = v.shape[-1]
+        m = n + abs(offset)
+        rows = jnp.arange(n) + max(-offset, 0)
+        cols = jnp.arange(n) + max(offset, 0)
+        out = jnp.zeros(v.shape[:-1] + (m, m), v.dtype)
+        out = out.at[..., rows, cols].set(v)
+        nd = out.ndim
+        d1 = dim1 % nd
+        d2 = dim2 % nd
+        order = [i for i in range(nd) if i not in (nd - 2, nd - 1)]
+        first, second = (nd - 2, nd - 1)
+        if d2 < d1:
+            first, second = second, first
+            d1, d2 = d2, d1
+        order.insert(d1, first)
+        order.insert(d2, second)
+        return jnp.transpose(out, order)
+
+    _f.__name__ = "diag_embed"
+    return apply(_f, input)
+
+
+def gather_tree(ids, parents):
+    """Back-trace beam-search parent pointers (reference extension.py
+    gather_tree): ids/parents [max_time, batch, beam] -> full sequences."""
+
+    def _f(idv, parv):
+        T = idv.shape[0]
+        last_beams = jnp.arange(idv.shape[-1])[None, :]    # [1, beam]
+        last_beams = jnp.broadcast_to(last_beams, idv.shape[1:])
+
+        def step(beams, t):
+            tok = jnp.take_along_axis(idv[t], beams, axis=-1)
+            prev = jnp.take_along_axis(parv[t], beams, axis=-1)
+            return prev, tok
+
+        _, toks = jax.lax.scan(step, last_beams, jnp.arange(T - 1, -1, -1))
+        return toks[::-1]
+
+    _f.__name__ = "gather_tree"
+    return apply(_f, ids, parents)
+
+
+def sparse_attention(query, key, value, sparse_csr_offset,
+                     sparse_csr_columns, key_padding_mask=None,
+                     attn_mask=None, name=None):
+    """softmax(QK^T/sqrt(d) restricted to the CSR pattern) V.
+
+    query/key/value: [N, H, S, D]; offset: [N, H, S+1]; columns: [N, H, nnz].
+    """
+
+    def _f(q, k, v, off, cols, kpm, am):
+        N, H, S, D = q.shape
+        nnz = cols.shape[-1]
+
+        def build_mask(off_h, cols_h):
+            counts = off_h[1:] - off_h[:-1]                # [S]
+            rows = jnp.repeat(jnp.arange(S), counts,
+                              total_repeat_length=nnz)
+            return jnp.zeros((S, S), bool).at[rows, cols_h].set(True)
+
+        mask = jax.vmap(jax.vmap(build_mask))(off, cols)   # [N,H,S,S]
+        scale = 1.0 / (D ** 0.5)
+        s = jnp.einsum("nhqd,nhkd->nhqk", q, k) * scale
+        neg = jnp.asarray(jnp.finfo(s.dtype).min, s.dtype)
+        s = jnp.where(mask, s, neg)
+        if kpm is not None:   # [N, S] 1 = keep, 0 = masked (reference)
+            s = jnp.where(kpm[:, None, None, :].astype(bool), s, neg)
+        if am is not None:    # [N, H, S, S] same indicator semantics
+            s = jnp.where(am.astype(bool), s, neg)
+        p = jax.nn.softmax(s, axis=-1)
+        p = jnp.where(mask, p, 0.0)  # rows with empty patterns -> all zero
+        return jnp.einsum("nhqk,nhkd->nhqd", p, v)
+
+    _f.__name__ = "sparse_attention"
+    args = [query, key, value, sparse_csr_offset, sparse_csr_columns]
+    return apply(lambda q, k, v, o, c: _f(q, k, v, o, c,
+                                          None if key_padding_mask is None
+                                          else key_padding_mask._value,
+                                          None if attn_mask is None
+                                          else attn_mask._value),
+                 *args)

@@ -9,7 +9,7 @@ __all__ = [
     "ReLU", "ReLU6", "LeakyReLU", "PReLU", "ELU", "CELU", "SELU", "GELU",
     "Sigmoid", "LogSigmoid", "Hardshrink", "Hardsigmoid", "Hardswish",
     "Hardtanh", "Mish", "Softplus", "Softshrink", "Softsign", "Swish",
-    "SiLU", "Tanh", "Tanhshrink", "ThresholdedReLU", "Softmax", "LogSoftmax",
+    "SiLU", "Silu", "Tanh", "Tanhshrink", "ThresholdedReLU", "Softmax", "LogSoftmax",
     "Maxout", "RReLU", "GLU",
 ]
 
@@ -59,6 +59,9 @@ class Swish(_Act):
 
 class SiLU(_Act):
     _fn = staticmethod(F.silu)
+
+
+Silu = SiLU  # reference export name (nn/__init__.py)
 
 
 class Mish(_Act):

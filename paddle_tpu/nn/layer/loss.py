@@ -10,7 +10,7 @@ __all__ = [
     "CTCLoss", "HingeEmbeddingLoss", "CosineEmbeddingLoss",
     "TripletMarginLoss", "TripletMarginWithDistanceLoss", "SoftMarginLoss",
     "MultiLabelSoftMarginLoss", "PoissonNLLLoss", "GaussianNLLLoss",
-    "MultiMarginLoss",
+    "MultiMarginLoss", "HSigmoidLoss",
 ]
 
 
@@ -226,3 +226,35 @@ class GaussianNLLLoss(Layer):
 
     def forward(self, input, label, variance):  # noqa: A002
         return F.gaussian_nll_loss(input, label, variance, *self.args)
+
+
+class HSigmoidLoss(Layer):
+    """Hierarchical sigmoid loss layer (reference nn/layer/loss.py
+    HSigmoidLoss over the hsigmoid op)."""
+
+    def __init__(self, feature_size, num_classes, weight_attr=None,
+                 bias_attr=None, is_custom=False, is_sparse=False,
+                 name=None):
+        super().__init__()
+        if num_classes < 2:
+            raise ValueError("num_classes must be >= 2")
+        self._num_classes = num_classes
+        self._is_custom = is_custom
+        from ..initializer import Uniform
+
+        import math
+
+        bound = math.sqrt(1.0 / feature_size)
+        self.weight = self.create_parameter(
+            [num_classes - 1, feature_size], attr=weight_attr,
+            default_initializer=Uniform(-bound, bound))
+        self.bias = self.create_parameter(
+            [num_classes - 1], attr=bias_attr, is_bias=True,
+            default_initializer=Uniform(-bound, bound))
+
+    def forward(self, input, label, path_table=None, path_code=None):  # noqa: A002
+        from .. import functional as F
+
+        return F.hsigmoid_loss(input, label, self._num_classes, self.weight,
+                               self.bias, path_table=path_table,
+                               path_code=path_code)

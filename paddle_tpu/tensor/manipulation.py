@@ -19,7 +19,8 @@ __all__ = [
     "masked_fill", "masked_scatter", "slice", "strided_slice", "unbind",
     "unique", "unique_consecutive", "unstack", "shard_index",
     "repeat_interleave", "reverse", "moveaxis", "as_complex", "as_real",
-    "cast", "crop", "fill_diagonal_", "put_along_axis", "take_along_axis",
+    "cast", "crop", "fill_diagonal_", "put_along_axis", "put_along_axis_",
+    "take_along_axis",
     "tensordot", "t", "real", "imag", "numel", "rank", "view", "view_as",
     "atleast_1d", "atleast_2d", "atleast_3d", "select_scatter", "diagonal",
     "diagonal_scatter", "flatten_", "pad",
@@ -559,3 +560,13 @@ def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):  # n
     from ..nn import functional as F
 
     return F.pad(x, pad, mode=mode, value=value, data_format=data_format)
+
+
+def put_along_axis_(arr, indices, values, axis, reduce="assign",  # noqa: A002
+                    include_self=True, broadcast=True, name=None):
+    from .math import _inplace
+
+    return _inplace(put_along_axis)(arr, indices, values, axis,
+                                    reduce=reduce,
+                                    include_self=include_self,
+                                    broadcast=broadcast)

@@ -24,6 +24,7 @@ __all__ = [
     "sum", "nansum", "prod", "cumsum", "cumprod", "cummax", "cummin",
     "logcumsumexp", "logsumexp", "clip", "isnan", "isinf", "isfinite",
     "all", "any", "conj", "logit", "renorm", "trace",
+    "erfinv_", "lerp_", "inverse",
     "add_n", "stanh", "multiplex", "inner", "outer", "dot", "mm", "bmm",
     "addmm", "kron", "gcd", "lcm", "erf", "erfinv", "lgamma", "digamma",
     "neg", "lerp", "rad2deg", "deg2rad", "diff", "angle", "frac", "heaviside",
@@ -483,3 +484,13 @@ def renorm(x, p, axis, max_norm, name=None):
 def trace(x, offset=0, axis1=0, axis2=1, name=None):
     return apply(lambda v: jnp.trace(v, offset=offset, axis1=axis1,
                                      axis2=axis2), x)
+
+
+erfinv_ = _inplace(erfinv)
+lerp_ = _inplace(lerp)
+
+
+def inverse(x, name=None):
+    from .linalg import inv
+
+    return inv(x)

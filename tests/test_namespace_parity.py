@@ -13,12 +13,28 @@ _WAIVED = {
 }
 
 
+def _ref_exports(path):
+    src = open(path).read()
+    return set(re.findall(r"^\s+'([A-Za-z_0-9]+)',?\s*(?:#.*)?$", src, re.M))
+
+
 def test_reference_top_level_exports_present():
-    src = open("/root/reference/python/paddle/__init__.py").read()
-    ref = set(re.findall(r"^\s+'([A-Za-z_0-9]+)',?\s*$", src, re.M))
+    ref = _ref_exports("/root/reference/python/paddle/__init__.py")
     missing = sorted(n for n in ref
                      if n not in _WAIVED and not hasattr(paddle, n))
     assert not missing, f"top-level API gaps vs reference: {missing}"
+
+
+@pytest.mark.parametrize("mod,path", [
+    (paddle.nn, "/root/reference/python/paddle/nn/__init__.py"),
+    (paddle.nn.functional,
+     "/root/reference/python/paddle/nn/functional/__init__.py"),
+    (paddle.tensor, "/root/reference/python/paddle/tensor/__init__.py"),
+], ids=["nn", "nn.functional", "tensor"])
+def test_submodule_exports_present(mod, path):
+    ref = _ref_exports(path)
+    missing = sorted(n for n in ref if not hasattr(mod, n))
+    assert not missing, f"{mod.__name__} gaps vs reference: {missing}"
 
 
 def test_new_ops():

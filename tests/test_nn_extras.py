@@ -1,0 +1,243 @@
+"""New nn functionals/layers: affine_grid, grid_sample, diag_embed,
+gather_tree, sparse_attention, hsigmoid_loss, margin_cross_entropy,
+Silu, HSigmoidLoss, BeamSearchDecoder/dynamic_decode, inplace tensor ops.
+
+Reference: python/paddle/nn/functional/{vision,extension,loss,
+sparse_attention}.py, nn/decode.py.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+
+F = nn.functional
+
+
+class TestAffineGridSample:
+    def test_identity_affine_grid_sample(self):
+        paddle.seed(0)
+        x = paddle.randn([2, 3, 8, 8])
+        theta = paddle.to_tensor(
+            np.tile(np.array([[1.0, 0, 0], [0, 1.0, 0]], np.float32),
+                    (2, 1, 1)))
+        grid = F.affine_grid(theta, [2, 3, 8, 8], align_corners=True)
+        assert grid.shape == [2, 8, 8, 2]
+        out = F.grid_sample(x, grid, align_corners=True)
+        np.testing.assert_allclose(out.numpy(), x.numpy(), rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_affine_grid_translation(self):
+        # shift by one pixel in x (normalized 2/(W-1) with align_corners)
+        W = 5
+        theta = paddle.to_tensor(np.array(
+            [[[1.0, 0, 2.0 / (W - 1)], [0, 1.0, 0]]], np.float32))
+        x = paddle.to_tensor(
+            np.arange(W * W, dtype=np.float32).reshape(1, 1, W, W))
+        grid = F.affine_grid(theta, [1, 1, W, W], align_corners=True)
+        out = F.grid_sample(x, grid, align_corners=True)
+        np.testing.assert_allclose(out.numpy()[0, 0, :, :-1],
+                                   x.numpy()[0, 0, :, 1:], atol=1e-4)
+
+    def test_grid_sample_modes(self):
+        x = paddle.to_tensor(np.array([[[[0.0, 10.0], [20.0, 30.0]]]],
+                                      np.float32))
+        # sample exactly at the center: bilinear avg of the 4 corners
+        grid = paddle.to_tensor(np.zeros((1, 1, 1, 2), np.float32))
+        out = F.grid_sample(x, grid, align_corners=True)
+        np.testing.assert_allclose(out.numpy().ravel(), [15.0], atol=1e-5)
+        # out of range with zeros padding -> 0, with border -> edge value
+        far = paddle.to_tensor(np.full((1, 1, 1, 2), 5.0, np.float32))
+        z = F.grid_sample(x, far, padding_mode="zeros")
+        np.testing.assert_allclose(z.numpy().ravel(), [0.0], atol=1e-6)
+        b = F.grid_sample(x, far, padding_mode="border")
+        np.testing.assert_allclose(b.numpy().ravel(), [30.0], atol=1e-5)
+
+    def test_grid_sample_grad(self):
+        x = paddle.randn([1, 2, 4, 4])
+        x.stop_gradient = False
+        grid = paddle.to_tensor(
+            np.random.RandomState(0).uniform(-1, 1, (1, 3, 3, 2))
+            .astype(np.float32))
+        grid.stop_gradient = False
+        out = F.grid_sample(x, grid)
+        out.sum().backward()
+        assert x.grad is not None and grid.grad is not None
+
+
+def test_diag_embed():
+    v = paddle.to_tensor(np.array([[1.0, 2.0], [3.0, 4.0]], np.float32))
+    out = F.diag_embed(v)
+    assert out.shape == [2, 2, 2]
+    np.testing.assert_allclose(out.numpy()[0], [[1, 0], [0, 2]])
+    off = F.diag_embed(v, offset=1)
+    assert off.shape == [2, 3, 3]
+    np.testing.assert_allclose(off.numpy()[1],
+                               [[0, 3, 0], [0, 0, 4], [0, 0, 0]])
+
+
+def test_gather_tree():
+    # example from the reference docstring
+    ids = paddle.to_tensor(np.array(
+        [[[2, 2], [6, 1]], [[3, 9], [6, 1]], [[0, 1], [9, 0]]]))
+    parents = paddle.to_tensor(np.array(
+        [[[0, 0], [1, 1]], [[1, 0], [1, 0]], [[0, 0], [0, 1]]]))
+    out = F.gather_tree(ids, parents)
+    np.testing.assert_array_equal(
+        np.asarray(out._value),
+        [[[2, 2], [1, 6]], [[3, 3], [6, 1]], [[0, 1], [9, 0]]])
+
+
+def test_sparse_attention_matches_masked_dense():
+    rng = np.random.RandomState(0)
+    N, H, S, D = 1, 2, 4, 8
+    q, k, v = [rng.randn(N, H, S, D).astype(np.float32) for _ in range(3)]
+    # full pattern -> must equal ordinary attention
+    offset = np.tile(np.arange(0, (S + 1) * S, S, dtype=np.int32),
+                     (N, H, 1))
+    cols = np.tile(np.tile(np.arange(S, dtype=np.int32), S), (N, H, 1))
+    out = F.sparse_attention(paddle.to_tensor(q), paddle.to_tensor(k),
+                             paddle.to_tensor(v), paddle.to_tensor(offset),
+                             paddle.to_tensor(cols))
+    att = np.einsum("nhqd,nhkd->nhqk", q, k) / np.sqrt(D)
+    att = np.exp(att - att.max(-1, keepdims=True))
+    att /= att.sum(-1, keepdims=True)
+    expect = np.einsum("nhqk,nhkd->nhqd", att, v)
+    np.testing.assert_allclose(out.numpy(), expect, rtol=1e-4, atol=1e-5)
+
+    # banded pattern (diagonal only) -> each row returns its own value row
+    offset = np.tile(np.arange(S + 1, dtype=np.int32), (N, H, 1))
+    cols = np.tile(np.arange(S, dtype=np.int32), (N, H, 1))
+    out = F.sparse_attention(paddle.to_tensor(q), paddle.to_tensor(k),
+                             paddle.to_tensor(v), paddle.to_tensor(offset),
+                             paddle.to_tensor(cols))
+    np.testing.assert_allclose(out.numpy(), v, rtol=1e-5)
+
+    # attn_mask is a 0=masked indicator (reference semantics): full CSR
+    # pattern + mask allowing only the diagonal == diagonal-only result
+    full_off = np.tile(np.arange(0, (S + 1) * S, S, dtype=np.int32),
+                       (N, H, 1))
+    full_cols = np.tile(np.tile(np.arange(S, dtype=np.int32), S),
+                        (N, H, 1))
+    am = np.broadcast_to(np.eye(S, dtype=np.float32), (N, H, S, S)).copy()
+    out_m = F.sparse_attention(
+        paddle.to_tensor(q), paddle.to_tensor(k), paddle.to_tensor(v),
+        paddle.to_tensor(full_off), paddle.to_tensor(full_cols),
+        attn_mask=paddle.to_tensor(am))
+    np.testing.assert_allclose(out_m.numpy(), v, rtol=1e-5)
+
+
+class TestHSigmoid:
+    def test_loss_shape_and_grad(self):
+        paddle.seed(3)
+        x = paddle.randn([6, 16])
+        x.stop_gradient = False
+        label = paddle.to_tensor(np.array([0, 1, 2, 3, 4, 5]))
+        layer = nn.HSigmoidLoss(16, 8)
+        loss = layer(x, label)
+        assert loss.shape == [6, 1]
+        assert np.all(np.isfinite(loss.numpy())) and np.all(
+            loss.numpy() > 0)
+        loss.sum().backward()
+        assert x.grad is not None and layer.weight.grad is not None
+
+    def test_training_separates_classes(self):
+        paddle.seed(4)
+        rng = np.random.RandomState(0)
+        centers = rng.randn(4, 8).astype(np.float32) * 3
+        xs = np.concatenate([centers[i] + 0.1 * rng.randn(16, 8)
+                             for i in range(4)]).astype(np.float32)
+        ys = np.repeat(np.arange(4), 16)
+        layer = nn.HSigmoidLoss(8, 4)
+        opt = paddle.optimizer.Adam(learning_rate=0.1,
+                                    parameters=layer.parameters())
+        first = None
+        for _ in range(30):
+            loss = layer(paddle.to_tensor(xs),
+                         paddle.to_tensor(ys)).mean()
+            if first is None:
+                first = float(loss)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        assert float(loss) < first * 0.3, (first, float(loss))
+
+    def test_custom_path(self):
+        x = paddle.randn([2, 8])
+        label = paddle.to_tensor(np.array([0, 1]))
+        table = paddle.to_tensor(np.array([[0, 1, -1], [0, 2, 3]]))
+        code = paddle.to_tensor(np.array([[1, 0, 0], [0, 1, 1]]))
+        layer = nn.HSigmoidLoss(8, 5, is_custom=True)
+        loss = layer(x, label, path_table=table, path_code=code)
+        assert loss.shape == [2, 1]
+
+
+def test_margin_cross_entropy():
+    paddle.seed(5)
+    rng = np.random.RandomState(0)
+    feats = rng.randn(8, 16).astype(np.float32)
+    feats /= np.linalg.norm(feats, axis=1, keepdims=True)
+    w = rng.randn(16, 10).astype(np.float32)
+    w /= np.linalg.norm(w, axis=0, keepdims=True)
+    cos = feats @ w
+    label = rng.randint(0, 10, 8)
+    loss, sm = F.margin_cross_entropy(
+        paddle.to_tensor(cos), paddle.to_tensor(label),
+        return_softmax=True, reduction="mean")
+    assert np.isfinite(float(loss))
+    np.testing.assert_allclose(sm.numpy().sum(-1), 1.0, rtol=1e-5)
+    # margin increases the loss vs plain scaled CE
+    plain = F.margin_cross_entropy(
+        paddle.to_tensor(cos), paddle.to_tensor(label),
+        margin1=1.0, margin2=0.0, margin3=0.0)
+    assert float(loss) > float(plain)
+
+
+def test_beam_search_decode():
+    """Greedy-equivalent check: a cell whose logits always prefer one token
+    chain; beam search must recover it and stop at end_token."""
+    paddle.seed(6)
+    V, B, beam = 7, 2, 3
+
+    class FixedCell(nn.Layer):
+        def forward(self, ids, states):
+            # state counts steps; prefer token (step+1), then end at 4
+            step = states
+            logits = np.full((ids.shape[0], V), -5.0, np.float32)
+            nxt = min(int(np.asarray(step._value)[0]) + 1, 4)
+            logits[:, nxt] = 5.0
+            return paddle.to_tensor(logits), paddle.to_tensor(
+                step._value + 1)
+
+    dec = paddle.nn.BeamSearchDecoder(FixedCell(), start_token=0,
+                                      end_token=4, beam_size=beam)
+    init = paddle.to_tensor(np.zeros(B, np.int64))  # per-batch; tiled inside
+    ids, scores = paddle.nn.dynamic_decode(dec, init, max_step_num=8)
+    seq = np.asarray(ids._value)[0, 0]
+    # best beam decodes 1, 2, 3, 4(end)
+    np.testing.assert_array_equal(seq[:4], [1, 2, 3, 4])
+    assert scores.shape == [B, beam]
+
+
+def test_inplace_tensor_ops():
+    x = paddle.to_tensor(np.array([0.5, -0.2], np.float32))
+    import scipy.special as sp
+
+    expect = sp.erfinv(x.numpy())
+    x.erfinv_()
+    np.testing.assert_allclose(x.numpy(), expect, rtol=1e-5)
+
+    a = paddle.to_tensor(np.zeros(3, np.float32))
+    b = paddle.to_tensor(np.ones(3, np.float32))
+    a.lerp_(b, 0.25)
+    np.testing.assert_allclose(a.numpy(), 0.25)
+
+    arr = paddle.to_tensor(np.zeros((2, 3), np.float32))
+    idx = paddle.to_tensor(np.array([[0], [2]]))
+    arr.put_along_axis_(idx, paddle.to_tensor(7.0), axis=1)
+    np.testing.assert_allclose(arr.numpy(),
+                               [[7, 0, 0], [0, 0, 7]])
+
+    m = paddle.to_tensor(np.array([[4.0, 0.0], [0.0, 2.0]], np.float32))
+    np.testing.assert_allclose(paddle.inverse(m).numpy(),
+                               [[0.25, 0], [0, 0.5]], rtol=1e-6)

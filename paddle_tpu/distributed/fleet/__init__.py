@@ -198,3 +198,67 @@ from .. import mp_layers  # noqa: F401,E402  (fleet.meta_parallel surface)
 from ..mp_layers import (  # noqa: F401,E402
     ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding,
 )
+
+from .base import (  # noqa: F401,E402
+    MultiSlotDataGenerator, MultiSlotStringDataGenerator,
+    PaddleCloudRoleMaker, Role, UserDefinedRoleMaker, UtilBase,
+)
+
+class Fleet:
+    """Reference fleet/base/fleet_base.py Fleet — the stateful facade.
+    The module itself is the singleton; this class delegates so code
+    written against `Fleet()` keeps working."""
+
+    def init(self, role_maker=None, is_collective=True, strategy=None,
+             log_level="INFO"):
+        return init(role_maker, is_collective, strategy, log_level)
+
+    def distributed_model(self, model):
+        return distributed_model(model)
+
+    def distributed_optimizer(self, optimizer, strategy=None):
+        return distributed_optimizer(optimizer, strategy)
+
+    def worker_num(self):
+        return worker_num()
+
+    def worker_index(self):
+        return worker_index()
+
+    def is_first_worker(self):
+        return is_first_worker()
+
+    def barrier_worker(self):
+        return barrier_worker()
+
+    @property
+    def util(self):
+        return util
+
+
+class CommunicateTopology:
+    """Reference fleet/base/topology.py CommunicateTopology: named
+    parallel axes with per-axis degrees."""
+
+    def __init__(self, hybrid_group_names=("data", "pipe", "sharding",
+                                           "model"),
+                 dims=(1, 1, 1, 1)):
+        self._names = list(hybrid_group_names)
+        self._dims = [int(d) for d in dims]
+
+    def get_hybrid_group_names(self):
+        return self._names
+
+    def get_dim(self, axis_name):
+        return self._dims[self._names.index(axis_name)]
+
+    get_dim_size = get_dim
+
+    def world_size(self):
+        out = 1
+        for d in self._dims:
+            out *= d
+        return out
+
+
+util = UtilBase()

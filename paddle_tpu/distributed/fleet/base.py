@@ -47,3 +47,101 @@ class DistributedStrategy:
         for k, v in sorted(self.__dict__.items()):
             lines.append(f"  {k}: {v}")
         return "\n".join(lines)
+
+
+class Role:
+    """Reference fleet/base/role_maker.py Role enum."""
+
+    WORKER = 1
+    SERVER = 2
+    HETER_WORKER = 3
+    ALL = 4
+
+
+class _RoleMakerBase:
+    """Single-controller TPU slice: every process is a collective worker."""
+
+    def __init__(self, is_collective=True, **kwargs):
+        self._is_collective = is_collective
+
+    def _worker_num(self):
+        import jax
+
+        return jax.process_count()
+
+    def _worker_index(self):
+        import jax
+
+        return jax.process_index()
+
+    def _is_first_worker(self):
+        return self._worker_index() == 0
+
+    def _role(self):
+        return Role.WORKER
+
+
+class PaddleCloudRoleMaker(_RoleMakerBase):
+    """Reference: parses cloud env vars for rank info; jax.distributed
+    already carries coordinator/rank, so this reads the live runtime."""
+
+
+class UserDefinedRoleMaker(_RoleMakerBase):
+    def __init__(self, is_collective=True, init_gloo=False, current_id=0,
+                 role=Role.WORKER, worker_endpoints=None, server_endpoints=None,
+                 **kwargs):
+        super().__init__(is_collective=is_collective)
+        self._current_id = current_id
+        self._user_role = role
+
+    def _worker_index(self):
+        return self._current_id
+
+    def _role(self):
+        return self._user_role
+
+
+class UtilBase:
+    """Reference fleet/utils/fs interface subset: collective helpers usable
+    from user scripts (fleet.util)."""
+
+    def all_reduce(self, input, mode="sum", comm_world="worker"):  # noqa: A002
+        import numpy as np
+
+        from ..collective import ReduceOp, all_reduce as _ar
+        from ...core.tensor import Tensor
+
+        op = {"sum": ReduceOp.SUM, "max": ReduceOp.MAX,
+              "min": ReduceOp.MIN}[mode.lower()]
+        t = input if isinstance(input, Tensor) else Tensor(np.asarray(input))
+        return np.asarray(_ar(t, op=op)._value)
+
+    def barrier(self, comm_world="worker"):
+        from ..collective import barrier as _b
+
+        _b()
+
+    def all_gather(self, input, comm_world="worker"):  # noqa: A002
+        import numpy as np
+
+        from ..collective import all_gather as _ag
+        from ...core.tensor import Tensor
+
+        out = []
+        _ag(out, Tensor(np.asarray(input)))
+        return [np.asarray(t._value) for t in out]
+
+
+class MultiSlotDataGenerator:
+    """Reference fleet data_generator for slot-based PS training; the PS
+    storey doesn't exist on TPU — kept as a parse-only shim so scripts
+    importing it keep working."""
+
+    def generate_sample(self, line):
+        raise NotImplementedError(
+            "override generate_sample; parameter-server ingestion is not "
+            "part of the TPU build")
+
+
+class MultiSlotStringDataGenerator(MultiSlotDataGenerator):
+    pass

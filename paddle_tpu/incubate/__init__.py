@@ -1,6 +1,7 @@
 """paddle.incubate (reference: python/paddle/incubate/__init__.py)."""
 from . import nn  # noqa: F401
 from .operators import (  # noqa: F401
+    graph_khop_sampler, graph_reindex, graph_sample_neighbors,
     graph_send_recv, segment_max, segment_mean, segment_min, segment_sum,
     softmax_mask_fuse, softmax_mask_fuse_upper_triangle,
 )
@@ -8,6 +9,7 @@ from .optimizer import LookAhead, ModelAverage  # noqa: F401
 
 __all__ = [
     "LookAhead", "ModelAverage", "softmax_mask_fuse_upper_triangle",
-    "softmax_mask_fuse", "graph_send_recv", "segment_sum", "segment_mean",
+    "softmax_mask_fuse", "graph_send_recv", "graph_sample_neighbors",
+    "graph_reindex", "graph_khop_sampler", "segment_sum", "segment_mean",
     "segment_max", "segment_min",
 ]

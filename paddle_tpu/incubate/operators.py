@@ -15,7 +15,8 @@ from ..core.autograd import apply
 
 __all__ = ["softmax_mask_fuse", "softmax_mask_fuse_upper_triangle",
            "segment_sum", "segment_mean", "segment_max", "segment_min",
-           "graph_send_recv"]
+           "graph_send_recv", "graph_sample_neighbors", "graph_reindex",
+           "graph_khop_sampler"]
 
 
 def softmax_mask_fuse(x, mask, name=None):
@@ -98,3 +99,109 @@ def graph_send_recv(x, src_index, dst_index, pool_type="sum", out_size=None,
         return out
 
     return apply(_f, x, src_index, dst_index)
+
+
+def _np_vals(*xs):
+    import numpy as np
+
+    return [None if x is None else
+            np.asarray(x._value if hasattr(x, "_value") else x)
+            for x in xs]
+
+
+def graph_sample_neighbors(row, colptr, input_nodes, eids=None,
+                           perm_buffer=None, sample_size=-1,
+                           return_eids=False, flag_perm_buffer=False,
+                           name=None):
+    """Sample up to `sample_size` neighbors per input node from a CSC graph
+    (reference incubate/operators/graph_sample_neighbors.py:23). Host-side:
+    output size is data-dependent, which XLA cannot express — same reason
+    the reference runs it on dedicated kernels outside the graph."""
+    import numpy as np
+
+    from ..core.tensor import Tensor
+
+    rowv, colv, nodes, eidv = _np_vals(row, colptr, input_nodes, eids)
+    # stochastic across calls, reproducible under paddle.seed: derive the
+    # host RNG from the functional PRNG stream
+    from ..framework import random as _rnd
+
+    seed = int(jax.random.randint(_rnd.next_key(), (), 0, 2 ** 31 - 1))
+    rng = np.random.default_rng(seed)
+    out_n, out_c, out_e = [], [], []
+    for n in nodes.ravel():
+        lo, hi = int(colv[n]), int(colv[n + 1])
+        neigh = rowv[lo:hi]
+        ids = np.arange(lo, hi)
+        if 0 <= sample_size < len(neigh):
+            pick = rng.choice(len(neigh), size=sample_size, replace=False)
+            neigh = neigh[pick]
+            ids = ids[pick]
+        out_n.append(neigh)
+        out_c.append(len(neigh))
+        out_e.append(eidv[ids] if eidv is not None else ids)
+    neighbors = Tensor(jnp.asarray(np.concatenate(out_n) if out_n
+                                   else np.zeros(0, rowv.dtype)))
+    count = Tensor(jnp.asarray(np.asarray(out_c, np.int32)))
+    if return_eids:
+        return neighbors, count, Tensor(
+            jnp.asarray(np.concatenate(out_e) if out_e
+                        else np.zeros(0, np.int64)))
+    return neighbors, count
+
+
+def graph_reindex(x, neighbors, count, value_buffer=None, index_buffer=None,
+                  flag_buffer_hashtable=False, name=None):
+    """Reindex (input nodes + sampled neighbors) to contiguous local ids
+    (reference incubate/operators/graph_reindex.py:23)."""
+    import numpy as np
+
+    from ..core.tensor import Tensor
+
+    xv, nv, cv = _np_vals(x, neighbors, count)
+    order = {}
+    for n in xv.ravel():
+        order.setdefault(int(n), len(order))
+    for n in nv.ravel():
+        order.setdefault(int(n), len(order))
+    out_nodes = np.fromiter(order.keys(), dtype=xv.dtype, count=len(order))
+    reindex_src = np.asarray([order[int(n)] for n in nv.ravel()],
+                             dtype=np.int64)
+    # duplicate seeds (normal in khop's concatenated frontiers) must map to
+    # the SAME local id — repeat the deduped id, not the seed position
+    dst = np.repeat(np.asarray([order[int(n)] for n in xv.ravel()],
+                               dtype=np.int64), cv.ravel())
+    return (Tensor(jnp.asarray(reindex_src)), Tensor(jnp.asarray(dst)),
+            Tensor(jnp.asarray(out_nodes)))
+
+
+def graph_khop_sampler(row, colptr, input_nodes, sample_sizes,
+                       sorted_eids=None, return_eids=False, name=None):
+    """Multi-hop sampling + subgraph reindex (reference
+    incubate/operators/graph_khop_sampler.py:23)."""
+    import numpy as np
+
+    from ..core.tensor import Tensor
+
+    frontier = input_nodes
+    all_neigh, all_cnt, all_eids, all_src_nodes = [], [], [], []
+    for k in sample_sizes:
+        res = graph_sample_neighbors(row, colptr, frontier,
+                                     sample_size=int(k), return_eids=True)
+        neigh, cnt, eids = res
+        all_neigh.append(np.asarray(neigh._value))
+        all_cnt.append(np.asarray(cnt._value))
+        all_eids.append(np.asarray(eids._value))
+        all_src_nodes.append(
+            np.asarray(frontier._value if hasattr(frontier, "_value")
+                       else frontier).ravel())
+        frontier = Tensor(neigh._value)
+    neighbors = Tensor(jnp.asarray(np.concatenate(all_neigh)))
+    counts = Tensor(jnp.asarray(np.concatenate(all_cnt)))
+    seeds = Tensor(jnp.asarray(np.concatenate(all_src_nodes)))
+    edge_src, edge_dst, sample_index = graph_reindex(seeds, neighbors,
+                                                     counts)
+    if return_eids:
+        return (edge_src, edge_dst, sample_index, None,
+                Tensor(jnp.asarray(np.concatenate(all_eids))))
+    return edge_src, edge_dst, sample_index, None

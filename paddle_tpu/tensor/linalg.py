@@ -19,7 +19,7 @@ __all__ = [
     "lstsq", "solve", "triangular_solve", "cholesky_solve", "lu", "lu_unpack",
     "matrix_power", "matrix_rank", "pinv", "cross", "dist", "histogram",
     "bincount", "mv", "multi_dot", "cond", "cdist", "householder_product",
-    "matrix_exp", "ormqr", "pca_lowrank",
+    "matrix_exp", "ormqr", "pca_lowrank", "cov",
 ]
 
 from .stat import histogram, bincount  # noqa: F401  (paddle.linalg re-exports)
@@ -292,3 +292,14 @@ def pca_lowrank(x, q=None, center=True, niter=2, name=None):
         u, s, vt = jnp.linalg.svd(a, full_matrices=False)
         return u[..., :k], s[..., :k], jnp.swapaxes(vt, -1, -2)[..., :k]
     return apply(_f, x)
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    """Covariance matrix (reference tensor/linalg.py cov)."""
+
+    def _f(v, fw, aw):
+        return jnp.cov(v, rowvar=rowvar, ddof=1 if ddof else 0,
+                       fweights=fw, aweights=aw)
+
+    _f.__name__ = "cov"
+    return apply(_f, x, fweights, aweights)

@@ -238,7 +238,7 @@ class BrightnessTransform(BaseTransform):
 
     def _apply_image(self, img):
         f = np.random.uniform(max(0, 1 - self.value), 1 + self.value)
-        return np.clip(_chw(img) * f, 0, 1)
+        return adjust_brightness(img, f)
 
 
 class ContrastTransform(BaseTransform):
@@ -247,10 +247,8 @@ class ContrastTransform(BaseTransform):
         self.value = value
 
     def _apply_image(self, img):
-        img = _chw(img)
         f = np.random.uniform(max(0, 1 - self.value), 1 + self.value)
-        mean = img.mean()
-        return np.clip((img - mean) * f + mean, 0, 1)
+        return adjust_contrast(img, f)
 
 
 class SaturationTransform(BaseTransform):
@@ -271,13 +269,8 @@ class HueTransform(BaseTransform):
         self.value = value
 
     def _apply_image(self, img):
-        # cheap hue emulation: channel roll-mix
-        img = _chw(img)
-        if img.shape[0] != 3:
-            return img
         f = np.random.uniform(-self.value, self.value)
-        rolled = np.roll(img, 1, axis=0)
-        return np.clip(img * (1 - abs(f)) + rolled * abs(f), 0, 1)
+        return adjust_hue(img, f)
 
 
 class ColorJitter(BaseTransform):
@@ -359,3 +352,58 @@ class Grayscale(BaseTransform):
         else:
             g = img[:1]
         return np.repeat(g, self.n, 0) if self.n > 1 else g
+
+
+# ---- functional transforms (reference vision/transforms/functional.py) ----
+def adjust_brightness(img, brightness_factor):
+    return np.clip(_chw(img) * brightness_factor, 0, 1)
+
+
+def adjust_contrast(img, contrast_factor):
+    img = _chw(img)
+    mean = img.mean()
+    return np.clip((img - mean) * contrast_factor + mean, 0, 1)
+
+
+def adjust_hue(img, hue_factor):
+    """hue_factor in [-0.5, 0.5]; same channel roll-mix emulation as
+    HueTransform."""
+    img = _chw(img)
+    if img.shape[0] != 3:
+        return img
+    rolled = np.roll(img, 1, axis=0)
+    return np.clip(img * (1 - abs(hue_factor)) + rolled * abs(hue_factor),
+                   0, 1)
+
+
+def pad(img, padding, fill=0, padding_mode="constant"):
+    return Pad(padding, fill, padding_mode)._apply_image(img)
+
+
+def rotate(img, angle, interpolation="nearest", expand=False, center=None,
+           fill=0):
+    """Deterministic rotation by `angle` degrees (nearest sampling)."""
+    img = _chw(img)
+    rad = np.deg2rad(angle)
+    c, h, w = img.shape
+    if center is None:
+        cy, cx = (h - 1) / 2, (w - 1) / 2
+    else:
+        cx, cy = center
+    yy, xx = np.mgrid[0:h, 0:w]
+    ys = cy + (yy - cy) * np.cos(rad) - (xx - cx) * np.sin(rad)
+    xs = cx + (yy - cy) * np.sin(rad) + (xx - cx) * np.cos(rad)
+    yi = np.clip(np.round(ys).astype(int), 0, h - 1)
+    xi = np.clip(np.round(xs).astype(int), 0, w - 1)
+    out = img[:, yi, xi].copy()
+    mask = (ys < 0) | (ys > h - 1) | (xs < 0) | (xs > w - 1)
+    out[:, mask] = fill
+    return out
+
+
+def to_grayscale(img, num_output_channels=1):
+    return Grayscale(num_output_channels)._apply_image(img)
+
+
+__all__ += ["adjust_brightness", "adjust_contrast", "adjust_hue", "pad",
+            "rotate", "to_grayscale"]

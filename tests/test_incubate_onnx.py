@@ -137,6 +137,32 @@ def test_graph_send_recv():
                                np.maximum(x.numpy()[0], x.numpy()[2]))
 
 
+def test_graph_sampling_ops():
+    # CSC graph: node 0 <- {1, 2}, node 1 <- {0}, node 2 <- {0, 1}
+    row = paddle.to_tensor(np.array([1, 2, 0, 0, 1]))
+    colptr = paddle.to_tensor(np.array([0, 2, 3, 5]))
+    nodes = paddle.to_tensor(np.array([0, 2]))
+    neigh, cnt = paddle.incubate.graph_sample_neighbors(row, colptr, nodes)
+    np.testing.assert_array_equal(np.asarray(cnt._value), [2, 2])
+    np.testing.assert_array_equal(np.asarray(neigh._value), [1, 2, 0, 1])
+    # bounded sampling
+    n2, c2 = paddle.incubate.graph_sample_neighbors(row, colptr, nodes,
+                                                    sample_size=1)
+    np.testing.assert_array_equal(np.asarray(c2._value), [1, 1])
+    # reindex: seeds [0, 2] + neighbors [1, 2, 0, 1]
+    src, dst, out_nodes = paddle.incubate.graph_reindex(nodes, neigh, cnt)
+    nv = np.asarray(out_nodes._value)
+    np.testing.assert_array_equal(nv[:2], [0, 2])  # seeds first
+    np.testing.assert_array_equal(np.asarray(dst._value), [0, 0, 1, 1])
+    # local src ids map back to the original neighbor ids
+    np.testing.assert_array_equal(nv[np.asarray(src._value)],
+                                  np.asarray(neigh._value))
+    # khop: two hops of size 1
+    es, ed, idx, _ = paddle.incubate.graph_khop_sampler(
+        row, colptr, nodes, [1, 1])
+    assert np.asarray(es._value).shape == (4,)
+
+
 def test_lookahead():
     paddle.seed(5)
     lin = nn.Linear(4, 4)

@@ -30,7 +30,26 @@ def test_reference_top_level_exports_present():
     (paddle.nn.functional,
      "/root/reference/python/paddle/nn/functional/__init__.py"),
     (paddle.tensor, "/root/reference/python/paddle/tensor/__init__.py"),
-], ids=["nn", "nn.functional", "tensor"])
+    (paddle.io, "/root/reference/python/paddle/io/__init__.py"),
+    (paddle.vision.datasets,
+     "/root/reference/python/paddle/vision/datasets/__init__.py"),
+    (paddle.vision.transforms,
+     "/root/reference/python/paddle/vision/transforms/__init__.py"),
+    (paddle.metric, "/root/reference/python/paddle/metric/__init__.py"),
+    (paddle.jit, "/root/reference/python/paddle/jit/__init__.py"),
+    (paddle.optimizer,
+     "/root/reference/python/paddle/optimizer/__init__.py"),
+    (paddle.static, "/root/reference/python/paddle/static/__init__.py"),
+    (paddle.linalg, "/root/reference/python/paddle/linalg.py"),
+    (paddle.fft, "/root/reference/python/paddle/fft.py"),
+    (paddle.distribution,
+     "/root/reference/python/paddle/distribution/__init__.py"),
+    (paddle.sparse, "/root/reference/python/paddle/sparse/__init__.py"),
+    (paddle.incubate,
+     "/root/reference/python/paddle/incubate/__init__.py"),
+], ids=["nn", "nn.functional", "tensor", "io", "vision.datasets",
+        "vision.transforms", "metric", "jit", "optimizer", "static",
+        "linalg", "fft", "distribution", "sparse", "incubate"])
 def test_submodule_exports_present(mod, path):
     ref = _ref_exports(path)
     missing = sorted(n for n in ref if not hasattr(mod, n))

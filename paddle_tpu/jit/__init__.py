@@ -50,9 +50,15 @@ class StaticFunction:
     """Callable wrapper compiling the target per input signature."""
 
     def __init__(self, target, input_spec=None):
+        self._layer = target if isinstance(target, Layer) else None
+        if self._layer is None and callable(target):
+            # dy2static: rewrite tensor-predicate if/while into lax control
+            # flow so one traced program covers every branch
+            from .dy2static import convert_to_static
+
+            target = convert_to_static(target)
         self._target = target
         self._input_spec = input_spec
-        self._layer = target if isinstance(target, Layer) else None
         self._cache = {}
 
     @property
@@ -231,15 +237,16 @@ def load(path, **configs):
 
 
 def set_verbosity(level=0, also_to_stdout=False):
-    """Reference jit/dy2static logging verbosity — recorded only (the
-    tracer here has no transpilation passes to log)."""
+    """Reference jit/dy2static logging verbosity (recorded; the dy2static
+    pass here is a single AST transform, see jit/dy2static.py)."""
     global _verbosity
     _verbosity = int(level)
 
 
 def set_code_level(level=100, also_to_stdout=False):
-    """Reference: prints transformed code of each dy2static pass. The
-    tracer does no source transforms, so this records the level only."""
+    """Reference: prints transformed code of each dy2static pass; with
+    level > 0 the converted source of subsequently-wrapped functions is
+    printed once."""
     global _code_level
     _code_level = int(level)
 

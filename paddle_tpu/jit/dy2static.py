@@ -1,0 +1,333 @@
+"""Dygraph-to-static AST conversion (subset).
+
+Reference: python/paddle/jit/dy2static/program_translator.py + the
+convert_ifelse / convert_while_loop transformers in jit/dy2static/
+convert_operators.py. The reference rewrites Python control flow whose
+predicate is a Tensor into cond/while ops so one static program serves all
+branches; under plain tracing such code raises TracerBoolConversionError.
+
+This implements the load-bearing subset:
+  * `if`/`elif`/`else` with tensor predicates  -> lax.cond via
+    static.nn.cond, with assigned-name join analysis
+  * `while` with tensor predicates             -> lax.while_loop via
+    static.nn.while_loop, body-assigned names as loop carries
+Python-valued predicates keep exact eager semantics (runtime dispatch).
+Statements a structured XLA region cannot express (return/break/continue
+inside the branch, `global`/`nonlocal`) leave the statement untransformed.
+"""
+from __future__ import annotations
+
+import ast
+import functools
+import inspect
+import textwrap
+
+__all__ = ["convert_to_static", "convert_cond", "convert_while"]
+
+_HELPER = "__paddle_jst"
+
+
+def _assigned_names(nodes):
+    """Names bound by simple assignments in a statement list (recursive)."""
+    out = []
+
+    class V(ast.NodeVisitor):
+        def visit_Name(self, node):
+            if isinstance(node.ctx, (ast.Store,)):
+                if node.id not in out:
+                    out.append(node.id)
+
+        def visit_FunctionDef(self, node):  # don't descend into nested defs
+            pass
+
+        visit_AsyncFunctionDef = visit_FunctionDef
+
+        def visit_AugAssign(self, node):
+            if isinstance(node.target, ast.Name) \
+                    and node.target.id not in out:
+                out.append(node.target.id)
+            self.generic_visit(node)
+
+    v = V()
+    for n in nodes:
+        v.visit(n)
+    return out
+
+
+def _has_escape(nodes):
+    """return/break/continue/global/nonlocal anywhere in the block
+    (nested function bodies excluded — they are their own scope)?"""
+    found = [False]
+
+    class V(ast.NodeVisitor):
+        def visit_Return(self, node):
+            found[0] = True
+
+        def visit_Break(self, node):
+            found[0] = True
+
+        def visit_Continue(self, node):
+            found[0] = True
+
+        def visit_Global(self, node):
+            found[0] = True
+
+        def visit_Nonlocal(self, node):
+            found[0] = True
+
+        def visit_FunctionDef(self, node):
+            pass  # don't descend
+
+        visit_AsyncFunctionDef = visit_FunctionDef
+        visit_Lambda = visit_FunctionDef
+
+    v = V()
+    for n in nodes:
+        v.visit(n)
+    return found[0]
+
+
+def _prestate(names):
+    """`(HELPER.get(lambda: a), HELPER.get(lambda: b))` — current values of
+    the join names, UNDEF where a name is not yet bound (body-local
+    temporaries, branch-introduced names)."""
+    def one(n):
+        return ast.Call(
+            func=ast.Attribute(value=ast.Name(id=_HELPER, ctx=ast.Load()),
+                               attr="get", ctx=ast.Load()),
+            args=[ast.Lambda(
+                args=ast.arguments(posonlyargs=[], args=[], kwonlyargs=[],
+                                   kw_defaults=[], defaults=[]),
+                body=ast.Name(id=n, ctx=ast.Load()))],
+            keywords=[])
+
+    return ast.Tuple(elts=[one(n) for n in names], ctx=ast.Load())
+
+
+class _ControlFlowTransformer(ast.NodeTransformer):
+    def __init__(self):
+        self._n = 0
+
+    def _uid(self):
+        self._n += 1
+        return self._n
+
+    # -- if / elif / else ---------------------------------------------------
+    def visit_If(self, node):
+        self.generic_visit(node)  # inner blocks first (handles elif chains)
+        if _has_escape(node.body) or _has_escape(node.orelse):
+            return node
+        names = _assigned_names(node.body + node.orelse)
+        uid = self._uid()
+        tname, fname = f"__jst_true_{uid}", f"__jst_false_{uid}"
+        ret = ast.Return(value=ast.Tuple(
+            elts=[ast.Name(id=n, ctx=ast.Load()) for n in names],
+            ctx=ast.Load()))
+        # branches take the join names as parameters so read-then-write
+        # (`y = y + 1`) sees the pre-branch value
+        params = ast.arguments(
+            posonlyargs=[], args=[ast.arg(arg=n) for n in names],
+            kwonlyargs=[], kw_defaults=[], defaults=[])
+
+        def branch(fn_name, body):
+            return ast.FunctionDef(
+                name=fn_name, args=params,
+                body=(body or [ast.Pass()]) + [ret],
+                decorator_list=[])
+
+        call = ast.Call(
+            func=ast.Attribute(value=ast.Name(id=_HELPER, ctx=ast.Load()),
+                               attr="cond", ctx=ast.Load()),
+            args=[node.test, ast.Name(id=tname, ctx=ast.Load()),
+                  ast.Name(id=fname, ctx=ast.Load()),
+                  _prestate(names)], keywords=[])
+        assign = (ast.Assign(
+            targets=[ast.Tuple(
+                elts=[ast.Name(id=n, ctx=ast.Store()) for n in names],
+                ctx=ast.Store())], value=call)
+            if names else ast.Expr(value=call))
+        return [branch(tname, node.body), branch(fname, node.orelse),
+                assign]
+
+    # -- while --------------------------------------------------------------
+    def visit_While(self, node):
+        self.generic_visit(node)
+        if _has_escape(node.body) or node.orelse:
+            return node
+        names = _assigned_names(node.body)
+        if not names:
+            return node
+        uid = self._uid()
+        cname, bname = f"__jst_wcond_{uid}", f"__jst_wbody_{uid}"
+        params = ast.arguments(
+            posonlyargs=[],
+            args=[ast.arg(arg=n) for n in names],
+            kwonlyargs=[], kw_defaults=[], defaults=[])
+        cond_fn = ast.FunctionDef(
+            name=cname, args=params,
+            body=[ast.Return(value=node.test)], decorator_list=[])
+        body_fn = ast.FunctionDef(
+            name=bname, args=params,
+            body=node.body + [ast.Return(value=ast.Tuple(
+                elts=[ast.Name(id=n, ctx=ast.Load()) for n in names],
+                ctx=ast.Load()))],
+            decorator_list=[])
+        call = ast.Call(
+            func=ast.Attribute(value=ast.Name(id=_HELPER, ctx=ast.Load()),
+                               attr="while_loop", ctx=ast.Load()),
+            args=[ast.Name(id=cname, ctx=ast.Load()),
+                  ast.Name(id=bname, ctx=ast.Load()),
+                  _prestate(names)],
+            keywords=[])
+        assign = ast.Assign(
+            targets=[ast.Tuple(
+                elts=[ast.Name(id=n, ctx=ast.Store()) for n in names],
+                ctx=ast.Store())], value=call)
+        return [cond_fn, body_fn, assign]
+
+
+# ---- runtime dispatch helpers ---------------------------------------------
+class _Undefined:
+    """Placeholder for a join/carry name with no pre-statement binding
+    (mirrors the reference's UndefinedVar): using it in tensor math raises
+    naturally; assigning over it is the normal case."""
+
+    def __repr__(self):
+        return "<dy2static undefined>"
+
+
+UNDEF = _Undefined()
+
+
+def _get(thunk):
+    try:
+        return thunk()
+    except NameError:  # includes free-variable-before-assignment
+        return UNDEF
+
+
+def _is_tensor_pred(pred):
+    from ..core.tensor import Tensor
+
+    return isinstance(pred, Tensor)
+
+
+def convert_cond(pred, true_fn, false_fn, prestate=()):
+    if _is_tensor_pred(pred):
+        import jax
+
+        from ..static.nn import cond as _cond
+
+        if isinstance(pred._value, jax.core.Tracer) or \
+                _in_static_mode():
+            return _cond(pred, lambda: true_fn(*prestate),
+                         lambda: false_fn(*prestate))
+        pred = bool(pred._value)  # concrete eager value: exact semantics
+    return true_fn(*prestate) if pred else false_fn(*prestate)
+
+
+def convert_while(cond_fn, body_fn, loop_vars):
+    probe = cond_fn(*loop_vars)
+    if _is_tensor_pred(probe):
+        import jax
+
+        if isinstance(probe._value, jax.core.Tracer) or _in_static_mode():
+            from ..static.nn import while_loop as _wl
+
+            # body-local temporaries (UNDEF before the loop) are not loop
+            # state — XLA can't carry them. They're recomputed inside the
+            # body each iteration and stay UNDEF afterwards (using one
+            # post-loop raises, loudly, instead of silently mis-tracing).
+            live = [i for i, v in enumerate(loop_vars) if v is not UNDEF]
+            if len(live) < len(loop_vars):
+                def expand(vals_live):
+                    full = [UNDEF] * len(loop_vars)
+                    for i, v in zip(live, vals_live):
+                        full[i] = v
+                    return full
+
+                def c2(*vals_live):
+                    return cond_fn(*expand(vals_live))
+
+                def b2(*vals_live):
+                    res = body_fn(*expand(vals_live))
+                    return [res[i] for i in live]
+
+                out_live = _wl(c2, b2, [loop_vars[i] for i in live])
+                return tuple(expand(list(out_live)))
+            out = _wl(cond_fn, body_fn, list(loop_vars))
+            return tuple(out)
+        # concrete eager: plain python loop
+        vals = tuple(loop_vars)
+        while bool(cond_fn(*vals)._value):
+            vals = tuple(body_fn(*vals))
+        return vals
+    vals = tuple(loop_vars)
+    while cond_fn(*vals):
+        vals = tuple(body_fn(*vals))
+    return vals
+
+
+class _Helper:
+    cond = staticmethod(convert_cond)
+    while_loop = staticmethod(convert_while)
+    get = staticmethod(_get)
+    UNDEF = UNDEF
+
+
+class _Scope(dict):
+    """Globals for the re-exec'd function: writes stay local (the module's
+    own binding of the function name must not be touched), reads fall
+    through LIVE to the original globals and closure cells — later
+    rebindings in the enclosing scope keep working (LOAD_GLOBAL honors
+    dict-subclass __missing__)."""
+
+    def __init__(self, base, cells):
+        super().__init__()
+        self._base = base
+        self._cells = cells  # name -> cell
+
+    def __missing__(self, key):
+        if key in self._cells:
+            return self._cells[key].cell_contents
+        return self._base[key]
+
+
+def convert_to_static(fn):
+    """Rewrite tensor-predicate control flow in `fn`; returns the original
+    callable untouched when the source is unavailable or unsupported
+    (bound methods, builtins, exec-defined functions, escape statements)."""
+    if inspect.ismethod(fn) or not inspect.isfunction(fn):
+        # re-exec'ing a bound method would drop its `self` binding
+        return fn
+    try:
+        src = textwrap.dedent(inspect.getsource(fn))
+        tree = ast.parse(src)
+        fdef = tree.body[0]
+        # strip decorators (@to_static would recurse infinitely)
+        fdef.decorator_list = []
+        new = _ControlFlowTransformer().visit(tree)
+        ast.fix_missing_locations(new)
+        from . import _code_level
+
+        if _code_level > 0:
+            print(f"--- dy2static: {fn.__name__} ---")
+            print(ast.unparse(new))
+        code = compile(new, filename=f"<dy2static {fn.__name__}>",
+                       mode="exec")
+        cells = dict(zip(fn.__code__.co_freevars, fn.__closure__ or ()))
+        scope = _Scope(fn.__globals__, cells)
+        scope[_HELPER] = _Helper
+        exec(code, scope)  # noqa: S102 — compiling our own transform
+        out = scope[fn.__name__]
+        out = functools.wraps(fn)(out)
+        out.__wrapped_by_dy2static__ = True
+        return out
+    except (OSError, TypeError, SyntaxError, KeyError):
+        return fn
+
+
+def _in_static_mode():
+    from ..framework.mode import in_static_mode
+
+    return in_static_mode()

@@ -1,0 +1,10 @@
+"""Helper module for dy2static live-globals test."""
+SCALE = 1.0
+
+
+def scaled(x):
+    if x.sum() > 0:
+        y = x * SCALE
+    else:
+        y = x
+    return y

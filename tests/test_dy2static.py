@@ -1,0 +1,201 @@
+"""dygraph-to-static control-flow conversion (reference
+jit/dy2static/program_translator.py + convert_operators.py).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.jit.dy2static import convert_to_static
+
+
+class TestIfConversion:
+    def test_tensor_predicate_if(self):
+        @paddle.jit.to_static
+        def f(x):
+            if x.sum() > 0:
+                y = x * 2.0
+            else:
+                y = x - 1.0
+            return y
+
+        pos = f(paddle.to_tensor(np.ones(4, np.float32)))
+        np.testing.assert_allclose(pos.numpy(), 2.0)
+        neg = f(paddle.to_tensor(-np.ones(4, np.float32)))
+        np.testing.assert_allclose(neg.numpy(), -2.0)
+
+    def test_elif_chain(self):
+        @paddle.jit.to_static
+        def f(x):
+            s = x.sum()
+            if s > 10.0:
+                out = x * 3.0
+            elif s > 0.0:
+                out = x * 2.0
+            else:
+                out = x * 0.0
+            return out
+
+        big = f(paddle.to_tensor(np.full(4, 5.0, np.float32)))
+        np.testing.assert_allclose(big.numpy(), 15.0)
+        small = f(paddle.to_tensor(np.full(4, 0.5, np.float32)))
+        np.testing.assert_allclose(small.numpy(), 1.0)
+        neg = f(paddle.to_tensor(np.full(4, -1.0, np.float32)))
+        np.testing.assert_allclose(neg.numpy(), 0.0)
+
+    def test_python_predicate_keeps_eager_semantics(self):
+        calls = []
+
+        def g(x, flag):
+            if flag:  # plain python bool: no tracing of the dead branch
+                calls.append("t")
+                return x + 1.0
+            calls.append("f")
+            return x - 1.0
+
+        conv = convert_to_static(g)
+        out = conv(paddle.to_tensor(np.zeros(2, np.float32)), True)
+        np.testing.assert_allclose(out.numpy(), 1.0)
+        # converted only the outcome, not both branches
+        assert calls == ["t"]
+
+    def test_if_reads_outer_var(self):
+        @paddle.jit.to_static
+        def f(x):
+            base = x + 10.0
+            if x.sum() > 0:
+                y = base * 1.0
+            else:
+                y = base * -1.0
+            return y
+
+        out = f(paddle.to_tensor(np.ones(2, np.float32)))
+        np.testing.assert_allclose(out.numpy(), 11.0)
+
+
+class TestWhileConversion:
+    def test_tensor_while(self):
+        @paddle.jit.to_static
+        def f(x):
+            i = paddle.to_tensor(np.int32(0))
+            while i < 5:
+                x = x * 2.0
+                i = i + 1
+            return x
+
+        out = f(paddle.to_tensor(np.ones(3, np.float32)))
+        np.testing.assert_allclose(out.numpy(), 32.0)
+
+    def test_python_while_untouched(self):
+        def g(x, n):
+            k = 0
+            while k < n:
+                x = x + 1.0
+                k += 1
+            return x
+
+        conv = convert_to_static(g)
+        out = conv(paddle.to_tensor(np.zeros(2, np.float32)), 3)
+        np.testing.assert_allclose(out.numpy(), 3.0)
+
+
+class TestReviewRegressions:
+    def test_read_then_write_in_branch(self):
+        @paddle.jit.to_static
+        def f(x):
+            y = x * 0.0
+            if x.sum() > 0:
+                y = y + 1.0
+            else:
+                y = y - 1.0
+            return y
+
+        np.testing.assert_allclose(
+            f(paddle.to_tensor(np.ones(2, np.float32))).numpy(), 1.0)
+        np.testing.assert_allclose(
+            f(paddle.to_tensor(-np.ones(2, np.float32))).numpy(), -1.0)
+
+    def test_read_then_write_python_pred(self):
+        def g(x, flag):
+            y = x + 1.0
+            if flag:
+                y = y * 10.0
+            return y
+
+        conv = convert_to_static(g)
+        np.testing.assert_allclose(
+            conv(paddle.to_tensor(np.ones(2, np.float32)), True).numpy(),
+            20.0)
+
+    def test_bound_method_not_broken(self):
+        class M(paddle.nn.Layer):
+            def forward(self, x):
+                return x * 2.0
+
+        m = M()
+        out, traced = paddle.jit.TracedLayer.trace(
+            m, [paddle.to_tensor(np.ones(2, np.float32))])
+        np.testing.assert_allclose(out.numpy(), 2.0)
+        np.testing.assert_allclose(
+            traced(paddle.to_tensor(np.full(2, 3.0, np.float32))).numpy(),
+            6.0)
+
+    def test_while_with_body_local_temporary(self):
+        @paddle.jit.to_static
+        def w(x):
+            while x.sum() < 20.0:
+                tmp = x * 2.0
+                x = tmp + 1.0
+            return x
+
+        out = w(paddle.to_tensor(np.ones(2, np.float32)))
+        assert float(out.numpy().sum()) >= 20.0
+
+    def test_return_after_nested_def_not_transformed(self):
+        def g(x):
+            if x is not None:  # python predicate, block has nested def
+                def inner():
+                    return 1
+
+                return x + inner()
+            return x
+
+        conv = convert_to_static(g)
+        np.testing.assert_allclose(
+            conv(paddle.to_tensor(np.zeros(2, np.float32))).numpy(), 1.0)
+
+    def test_live_global_rebinding(self):
+        import tests._dy2s_helper as helper
+
+        conv = convert_to_static(helper.scaled)
+        helper.SCALE = 2.0
+        np.testing.assert_allclose(
+            conv(paddle.to_tensor(np.ones(2, np.float32))).numpy(), 2.0)
+        helper.SCALE = 5.0  # converted fn must see the new binding
+        np.testing.assert_allclose(
+            conv(paddle.to_tensor(np.ones(2, np.float32))).numpy(), 5.0)
+
+
+class TestFallbacks:
+    def test_return_inside_branch_left_alone(self):
+        def g(x):
+            if True:  # static python predicate with early return
+                return x + 1.0
+            return x
+
+        conv = convert_to_static(g)
+        out = conv(paddle.to_tensor(np.zeros(2, np.float32)))
+        np.testing.assert_allclose(out.numpy(), 1.0)
+
+    def test_closure_function_converts(self):
+        scale = paddle.to_tensor(np.float32(3.0))
+
+        def g(x):
+            if x.sum() > 0:
+                y = x * scale
+            else:
+                y = x
+            return y
+
+        conv = paddle.jit.to_static(g)
+        out = conv(paddle.to_tensor(np.ones(2, np.float32)))
+        np.testing.assert_allclose(out.numpy(), 3.0)

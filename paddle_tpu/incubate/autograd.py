@@ -1,0 +1,163 @@
+"""incubate.autograd: functional differentiation (vjp, jvp, Jacobian,
+Hessian).
+
+Reference: python/paddle/incubate/autograd/__init__.py over
+autograd/functional.py:22 (vjp), :79 (jvp), :698 (jacobian), :1133
+(hessian). TPU-native: direct composition of jax.vjp/jvp/jacrev/hessian —
+each call is one traced XLA program, no tape walking.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+__all__ = ["vjp", "jvp", "Jacobian", "Hessian", "jacobian", "hessian"]
+
+
+def _unwrap(x):
+    if isinstance(x, (list, tuple)):
+        return [t._value if isinstance(t, Tensor) else jnp.asarray(t)
+                for t in x]
+    return [x._value if isinstance(x, Tensor) else jnp.asarray(x)]
+
+
+def _wrap_like(vals, template):
+    out = [Tensor(v) for v in vals]
+    if isinstance(template, (list, tuple)):
+        return out
+    return out[0]
+
+
+def _pure(func):
+    def f(*vals):
+        out = func(*[Tensor(v) for v in vals])
+        if isinstance(out, (list, tuple)):
+            return tuple(o._value for o in out)
+        return out._value
+
+    return f
+
+
+def vjp(func, xs, v=None):
+    """Returns (func(xs), vjp(v)) — cotangents w.r.t. xs."""
+    vals = _unwrap(xs)
+    f = _pure(func)
+    out, pullback = jax.vjp(f, *vals)
+    if v is None:
+        cot = jax.tree_util.tree_map(jnp.ones_like, out)
+    else:
+        cv = _unwrap(v)
+        cot = tuple(cv) if isinstance(out, tuple) else cv[0]
+    grads = pullback(cot)
+    outs = ([Tensor(o) for o in out] if isinstance(out, tuple)
+            else Tensor(out))
+    return outs, _wrap_like(list(grads), xs)
+
+
+def jvp(func, xs, v=None):
+    """Returns (func(xs), jvp along v) — forward-mode tangents."""
+    vals = _unwrap(xs)
+    f = _pure(func)
+    if v is None:
+        tangents = tuple(jnp.ones_like(a) for a in vals)
+    else:
+        tangents = tuple(_unwrap(v))
+    out, tangent_out = jax.jvp(f, tuple(vals), tangents)
+    outs = ([Tensor(o) for o in out] if isinstance(out, tuple)
+            else Tensor(out))
+    touts = ([Tensor(t) for t in tangent_out]
+             if isinstance(tangent_out, tuple) else Tensor(tangent_out))
+    return outs, touts
+
+
+class Jacobian:
+    """Lazy Jacobian d func / d xs, indexable like the reference
+    (J[:], J[i, j]); computed once with jax.jacrev (reverse mode rides the
+    same vjp machinery the tape uses)."""
+
+    def __init__(self, func, xs, is_batched=False):
+        if is_batched:
+            raise NotImplementedError(
+                "batched Jacobians are not supported; vmap the unbatched "
+                "Jacobian instead")
+        import math
+
+        vals = _unwrap(xs)
+        f = _pure(func)
+        out_struct = jax.eval_shape(f, *vals)
+        if isinstance(out_struct, tuple):
+            raise NotImplementedError(
+                "multi-output Jacobian is not supported; stack/concat the "
+                "outputs into one tensor")
+        out_size = math.prod(out_struct.shape)
+        jacs = jax.jacrev(f, argnums=tuple(range(len(vals))))(*vals)
+        # argnums as a tuple always yields a tuple of blocks; flatten each
+        # to [out_size, in_size] and stack inputs on the column axis — the
+        # reference's 2-D Jacobian view
+        self._jac = jnp.concatenate(
+            [j.reshape(out_size, -1) for j in jacs], axis=-1)
+
+    @property
+    def shape(self):
+        return list(self._jac.shape)
+
+    def __getitem__(self, idx):
+        return Tensor(self._jac[idx])
+
+    def numpy(self):
+        import numpy as np
+
+        return np.asarray(self._jac)
+
+
+class Hessian:
+    """Hessian of a scalar-output func w.r.t. xs (reference Hessian)."""
+
+    def __init__(self, func, xs, is_batched=False):
+        if is_batched:
+            raise NotImplementedError(
+                "batched Hessians are not supported; vmap the unbatched "
+                "Hessian instead")
+        vals = _unwrap(xs)
+        f = _pure(func)
+
+        def scalar(*a):
+            out = f(*a)
+            return out.reshape(()) if hasattr(out, "reshape") else out
+
+        if len(vals) == 1:
+            h = jax.hessian(scalar)(vals[0])
+            n = vals[0].size
+            h = h.reshape(n, n)
+        else:
+            h = jax.hessian(scalar, argnums=tuple(range(len(vals))))(*vals)
+            rows = []
+            for i in range(len(vals)):
+                row = [h[i][j].reshape(vals[i].size, vals[j].size)
+                       for j in range(len(vals))]
+                rows.append(jnp.concatenate(row, axis=1))
+            h = jnp.concatenate(rows, axis=0)
+        self._h = h
+
+    @property
+    def shape(self):
+        return list(self._h.shape)
+
+    def __getitem__(self, idx):
+        return Tensor(self._h[idx])
+
+    def numpy(self):
+        import numpy as np
+
+        return np.asarray(self._h)
+
+
+def jacobian(func, inputs, create_graph=False, allow_unused=False):
+    """paddle.autograd.functional.jacobian-style eager helper."""
+    return Jacobian(func, inputs)[:]
+
+
+def hessian(func, inputs, create_graph=False, allow_unused=False):
+    return Hessian(func, inputs)[:]

@@ -1,0 +1,46 @@
+"""incubate.autograd functional differentiation (reference
+python/paddle/incubate/autograd/__init__.py over autograd/functional.py).
+"""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.incubate.autograd import Hessian, Jacobian, jvp, vjp
+
+
+def test_vjp():
+    x = paddle.to_tensor(np.array([1.0, 2.0, 3.0], np.float32))
+    out, g = vjp(lambda v: (v ** 3).sum(), x)
+    np.testing.assert_allclose(float(out._value), 36.0)
+    np.testing.assert_allclose(g.numpy(), 3 * x.numpy() ** 2)
+    # explicit cotangent
+    _, g2 = vjp(lambda v: v * 2.0, x,
+                paddle.to_tensor(np.array([1.0, 0.0, 0.0], np.float32)))
+    np.testing.assert_allclose(g2.numpy(), [2.0, 0.0, 0.0])
+
+
+def test_jvp():
+    x = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+    _, t = jvp(lambda v: v ** 2,
+               x, paddle.to_tensor(np.array([1.0, 1.0], np.float32)))
+    np.testing.assert_allclose(t.numpy(), 2 * x.numpy())
+
+
+def test_jacobian():
+    x = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+    J = Jacobian(lambda v: v ** 2, x)
+    assert J.shape == [2, 2]
+    np.testing.assert_allclose(J[:].numpy(), np.diag([2.0, 4.0]))
+    np.testing.assert_allclose(J[0, 1].numpy(), 0.0)
+    # multi-input: columns concatenate per input
+    y = paddle.to_tensor(np.array([3.0, 4.0], np.float32))
+    J2 = Jacobian(lambda a, b: a * b, [x, y])
+    assert J2.shape == [2, 4]
+    np.testing.assert_allclose(J2[:].numpy()[:, :2], np.diag(y.numpy()))
+    np.testing.assert_allclose(J2[:].numpy()[:, 2:], np.diag(x.numpy()))
+
+
+def test_hessian():
+    x = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+    H = Hessian(lambda v: (v ** 3).sum(), x)
+    assert H.shape == [2, 2]
+    np.testing.assert_allclose(H[:].numpy(), np.diag(6 * x.numpy()))

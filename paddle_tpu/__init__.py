@@ -10,6 +10,7 @@ Usage: `import paddle_tpu as paddle` — the namespace mirrors `paddle.*`.
 from __future__ import annotations
 
 import jax as _jax
+import numpy as _np
 
 # int64/float64 parity with the reference (TPU models stay f32/bf16; f64 is
 # for CPU-hosted numerics tests only).
@@ -70,6 +71,7 @@ from . import utils  # noqa: F401
 from . import incubate  # noqa: F401
 from . import onnx  # noqa: F401
 from . import profiler  # noqa: F401
+from . import signal  # noqa: F401
 from . import device  # noqa: F401
 from .device import (  # noqa: F401
     CPUPlace, CUDAPinnedPlace, CUDAPlace, NPUPlace, TPUPlace, XPUPlace,
@@ -146,3 +148,27 @@ def is_compiled_with_mkldnn():
 
 def is_compiled_with_distribute():
     return True
+
+
+def tolist(x):
+    """paddle.tolist (reference: tensor/manipulation.py:254) — alias of
+    Tensor.tolist."""
+    return x.tolist() if hasattr(x, "tolist") else list(x)
+
+
+def check_shape(shape):
+    """Validate a shape argument before creation ops (reference:
+    fluid/layers/utils.py:373)."""
+    if hasattr(shape, "_value") or hasattr(shape, "dtype"):
+        return  # shape-as-tensor: dtype validated at trace time
+    for ele in shape:
+        if hasattr(ele, "_value"):
+            continue
+        if not isinstance(ele, (int, _np.integer)):
+            raise TypeError(
+                "All elements in ``shape`` must be integers when it's a "
+                "list or tuple")
+        if ele < 0:
+            raise ValueError(
+                "All elements in ``shape`` must be positive when it's a "
+                "list or tuple")

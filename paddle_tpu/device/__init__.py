@@ -8,7 +8,8 @@ from __future__ import annotations
 
 import jax
 
-__all__ = ["TPUPlace", "CPUPlace", "CUDAPlace", "CUDAPinnedPlace", "XPUPlace",
+__all__ = ["IPUPlace", "MLUPlace",
+           "TPUPlace", "CPUPlace", "CUDAPlace", "CUDAPinnedPlace", "XPUPlace",
            "NPUPlace",
            "set_device", "get_device", "get_all_device_type",
            "get_available_device", "is_compiled_with_cuda", "synchronize",
@@ -167,3 +168,50 @@ def _place_of(value):
     if dev is not None and dev.platform != "cpu":
         return TPUPlace(dev.id)
     return CPUPlace()
+
+
+class IPUPlace(_Place):
+    def __init__(self):
+        super().__init__("ipu", 0)
+
+
+class MLUPlace(TPUPlace):
+    def __init__(self, dev_id=0):
+        super().__init__(dev_id)
+
+
+def is_compiled_with_rocm():
+    return False
+
+
+def is_compiled_with_xpu():
+    return False
+
+
+def is_compiled_with_npu():
+    return False
+
+
+def is_compiled_with_ipu():
+    return False
+
+
+def is_compiled_with_mlu():
+    return False
+
+
+def is_compiled_with_cinn():
+    # the XLA compiler plays CINN's role on TPU
+    return False
+
+
+def get_cudnn_version():
+    return None
+
+
+def get_all_custom_device_type():
+    return []
+
+
+def get_available_custom_device():
+    return []

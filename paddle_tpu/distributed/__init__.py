@@ -17,13 +17,20 @@ from .collective import (  # noqa: F401
     is_initialized, isend, new_group, p2p_permute, recv, reduce, scatter,
     send, wait,
 )
-from .parallel import DataParallel, ParallelEnv, init_parallel_env  # noqa: F401
+from .parallel import (  # noqa: F401
+    DataParallel, ParallelEnv, ParallelMode, init_parallel_env,
+)
+from .entry_attr import (  # noqa: F401
+    CountFilterEntry, ProbabilityEntry, ShowClickEntry,
+)
+from .ps_dataset import InMemoryDataset, QueueDataset  # noqa: F401
+from . import launch  # noqa: F401
 from .shard_utils import annotate, PartitionSpec  # noqa: F401
 from . import fleet  # noqa: F401
 from .fleet import mp_layers  # noqa: F401
 from .mp_layers import (  # noqa: F401
     ColumnParallelLinear, ParallelCrossEntropy, RowParallelLinear,
-    VocabParallelEmbedding,
+    VocabParallelEmbedding, split,
 )
 from . import sharding  # noqa: F401
 from .sharding import group_sharded_parallel, shard_params_and_opt  # noqa: F401
@@ -50,3 +57,28 @@ def get_backend():
 
 def is_available():
     return True
+
+
+def gloo_init_parallel_env(rank_id, rank_num, server_endpoint):
+    """Reference parallel_with_gloo.py: bring up a CPU-side gloo ring for
+    pre-device coordination. Single-controller JAX coordinates through the
+    jax.distributed service instead; multi-host init happens lazily in
+    init_distributed_env, so this only records the rendezvous endpoint."""
+    import os
+    os.environ["PADDLE_TRAINER_ID"] = str(rank_id)
+    os.environ["PADDLE_TRAINERS_NUM"] = str(rank_num)
+    os.environ.setdefault("MASTER_ADDR", server_endpoint.split(":")[0])
+    if ":" in server_endpoint:
+        os.environ.setdefault("MASTER_PORT", server_endpoint.split(":")[1])
+
+
+def gloo_barrier():
+    """CPU barrier. With a live mesh this is the collective barrier; before
+    initialization it is a no-op (one controller, nothing to wait for)."""
+    from .collective import barrier, is_initialized
+    if is_initialized():
+        barrier()
+
+
+def gloo_release():
+    """Release the CPU coordination ring (held by jax.distributed here)."""

@@ -1,0 +1,67 @@
+"""paddle.distributed.launch (reference: python/paddle/distributed/launch)
+— the `python -m paddle.distributed.launch train.py` entrypoint.
+
+The reference forks one worker process per GPU and wires NCCL rendezvous
+env vars. A TPU program is single-controller SPMD: one Python process per
+host already drives every local chip, and multi-host jobs are launched by
+the TPU scheduler with one identical process per host. So launch here:
+
+1. parses the reference CLI (``--devices``, ``--nnodes``, ``--master``,
+   ``--rank``, ``--job_id``) for drop-in compatibility,
+2. exports the coordinator env (PADDLE_TRAINER_ID et al.),
+3. calls ``jax.distributed.initialize`` when multi-host, and
+4. runs the training script once in-process (no fork).
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import runpy
+import sys
+
+__all__ = ["launch", "main"]
+
+
+def _parse(argv):
+    p = argparse.ArgumentParser(
+        prog="paddle.distributed.launch", add_help=False)
+    p.add_argument("--devices", "--gpus", "--xpus", "--npus", default=None)
+    p.add_argument("--nnodes", type=str, default="1")
+    p.add_argument("--nproc_per_node", type=int, default=None)
+    p.add_argument("--master", default=None)
+    p.add_argument("--rank", type=int, default=-1)
+    p.add_argument("--job_id", default="default")
+    p.add_argument("--log_dir", default="log")
+    p.add_argument("--run_mode", default=None)
+    p.add_argument("--backend", default=None)
+    p.add_argument("training_script", nargs="?")
+    p.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    return p.parse_args(argv)
+
+
+def launch(argv=None):
+    args = _parse(sys.argv[1:] if argv is None else argv)
+    nnodes = int(str(args.nnodes).split(":")[0] or 1)
+    node_rank = max(args.rank, 0)
+    os.environ.setdefault("PADDLE_TRAINER_ID", str(node_rank))
+    os.environ.setdefault("PADDLE_TRAINERS_NUM", str(nnodes))
+    if args.master:
+        os.environ.setdefault("MASTER_ADDR", args.master.split(":")[0])
+        if ":" in args.master:
+            os.environ.setdefault("MASTER_PORT", args.master.split(":")[1])
+    if nnodes > 1:
+        import jax
+        jax.distributed.initialize(
+            coordinator_address=args.master,
+            num_processes=nnodes,
+            process_id=node_rank)
+    if not args.training_script:
+        raise SystemExit("launch: no training script given")
+    sys.argv = [args.training_script] + list(args.training_script_args)
+    if args.training_script.endswith(".py"):
+        runpy.run_path(args.training_script, run_name="__main__")
+    else:  # module form: -m style target
+        runpy.run_module(args.training_script, run_name="__main__")
+
+
+main = launch

@@ -149,3 +149,52 @@ class ParallelCrossEntropy(Layer):
         logits = annotate(input, *([None] * (len(input.shape) - 1)), "tp")
         return F.cross_entropy(logits, label, reduction="none",
                                ignore_index=self._ignore_index)
+
+
+def split(x, size, operation, axis=0, num_partitions=1, gather_out=True,
+          weight_attr=None, bias_attr=None, name=None):
+    """paddle.distributed.split (reference: distributed/collective.py:1481).
+
+    Builds the weight of `operation` ('linear'|'embedding') tp-sharded and
+    runs the computation in parallel. The reference materialises only the
+    local (size/num_partitions) shard per rank; here the full logical
+    weight carries a NamedSharding over the tp axis, so each device's HBM
+    still holds 1/num_partitions of it while the API stays rank-oblivious.
+    num_partitions is validated against the installed mesh's tp axis.
+    """
+    if not isinstance(size, (list, tuple)) or len(size) != 2:
+        raise AssertionError(
+            "size of paddle.distributed.split must be a 2-element list/tuple")
+    if operation not in ("linear", "embedding"):
+        raise AssertionError(
+            "operation of paddle.distributed.split must be linear|embedding")
+    mesh = _env.get_mesh()
+    if mesh is not None:
+        ax = _tp_axis(mesh)
+        if ax is not None and num_partitions not in (1, mesh.shape[ax]):
+            raise ValueError(
+                f"num_partitions={num_partitions} does not match mesh tp "
+                f"axis size {mesh.shape[ax]}")
+    if operation == "embedding":
+        if axis != 0:
+            raise AssertionError(
+                "embedding split supports axis=0 (vocab dim) only")
+        layer = VocabParallelEmbedding(size[0], size[1],
+                                       weight_attr=weight_attr, name=name)
+        return layer(x)
+    if axis == 0:
+        layer = RowParallelLinear(
+            size[0], size[1], weight_attr=weight_attr,
+            has_bias=bias_attr is not False, input_is_parallel=True,
+            name=name)
+        if layer.bias is not None and bias_attr is not None \
+                and bias_attr is not False:
+            layer.bias.param_attr = bias_attr
+        return layer(x)
+    if axis == 1:
+        layer = ColumnParallelLinear(
+            size[0], size[1], weight_attr=weight_attr,
+            has_bias=bias_attr is not False, gather_output=gather_out,
+            name=name)
+        return layer(x)
+    raise AssertionError("axis of paddle.distributed.split must be 0 or 1")

@@ -140,3 +140,12 @@ class DataParallel(Layer):
 
     def set_state_dict(self, sd, *a, **kw):
         return self._layers.set_state_dict(sd, *a, **kw)
+
+
+class ParallelMode:
+    """Parallelism taxonomy (reference: fleet/base/topology.py:29). The
+    values map onto mesh axes here: dp / tp / pp / dp-sharded(ZeRO)."""
+    DATA_PARALLEL = 0
+    TENSOR_PARALLEL = 1
+    PIPELINE_PARALLEL = 2
+    SHARDING_PARALLEL = 3

@@ -1,0 +1,175 @@
+"""File-fed training datasets (reference: python/paddle/distributed/fleet/
+dataset/dataset.py:27 DatasetBase, :341 InMemoryDataset, QueueDataset).
+
+The reference streams slot-format text files through a C++ DataFeed into
+the parameter-server trainer. TPU-native equivalent: parse the same
+slot-per-line text format on the host into numpy batches sized for the
+device step; `InMemoryDataset` materialises and (optionally globally)
+shuffles in RAM, `QueueDataset` streams file-by-file. Both iterate
+dicts of {var_name: np.ndarray} consumable by Executor.run feeds.
+"""
+from __future__ import annotations
+
+import glob as _glob
+import random
+
+import numpy as np
+
+__all__ = ["InMemoryDataset", "QueueDataset"]
+
+
+class DatasetBase:
+    def __init__(self):
+        self._batch_size = 1
+        self._thread_num = 1
+        self._filelist = []
+        self._use_vars = []      # [(name, dtype, shape_per_sample)]
+        self._pipe_command = "cat"
+        self._input_type = 0
+
+    def init(self, batch_size=1, thread_num=1, use_var=[], pipe_command="cat",
+             input_type=0, fs_name="", fs_ugi="", download_cmd="cat",
+             **kwargs):
+        self._batch_size = batch_size
+        self._thread_num = max(1, thread_num)
+        self._pipe_command = pipe_command
+        self._input_type = input_type
+        self._set_use_var(use_var)
+
+    def set_filelist(self, filelist):
+        """List of data files; globs are expanded."""
+        out = []
+        for f in filelist:
+            hit = sorted(_glob.glob(f))
+            out.extend(hit if hit else [f])
+        self._filelist = out
+
+    def _set_batch_size(self, batch_size):
+        self._batch_size = batch_size
+
+    def _set_thread(self, thread_num):
+        self._thread_num = max(1, thread_num)
+
+    def _set_pipe_command(self, pipe_command):
+        self._pipe_command = pipe_command
+
+    def _set_use_var(self, var_list):
+        self._use_vars = []
+        for v in var_list:
+            name = getattr(v, "name", str(v))
+            dtype = str(getattr(v, "dtype", "int64")).replace("paddle.", "")
+            shape = [int(s) for s in getattr(v, "shape", [1])[1:] if s != -1]
+            self._use_vars.append((name, dtype, shape or [1]))
+
+    # --- slot-format parsing -------------------------------------------
+    # line := (<slot_size> <v0> <v1> ...)+ one group per use_var, the
+    # reference's "slot" text format produced by DataGenerator.
+    def _parse_line(self, line):
+        toks = line.split()
+        sample, i = [], 0
+        for name, dtype, shape in self._use_vars:
+            n = int(toks[i]); i += 1
+            vals = toks[i:i + n]; i += n
+            np_dtype = np.int64 if "int" in dtype else np.float32
+            arr = np.asarray([np_dtype(float(t)) for t in vals],
+                             dtype=np_dtype)
+            want = int(np.prod(shape))
+            if arr.size < want:
+                arr = np.pad(arr, (0, want - arr.size))
+            sample.append(arr[:want].reshape(shape))
+        return sample
+
+    def _iter_file(self, path):
+        with open(path) as fh:
+            for line in fh:
+                line = line.strip()
+                if line:
+                    yield self._parse_line(line)
+
+    def _batches(self, samples):
+        buf = []
+        for s in samples:
+            buf.append(s)
+            if len(buf) == self._batch_size:
+                yield self._collate(buf)
+                buf = []
+        if buf:
+            yield self._collate(buf)
+
+    def _collate(self, buf):
+        return {name: np.stack([s[j] for s in buf])
+                for j, (name, _, _) in enumerate(self._use_vars)}
+
+
+class QueueDataset(DatasetBase):
+    """Streaming dataset: batches flow file-by-file, nothing is retained."""
+
+    def __iter__(self):
+        def gen():
+            for path in self._filelist:
+                yield from self._iter_file(path)
+        return self._batches(gen())
+
+
+class InMemoryDataset(DatasetBase):
+    """Load-then-train dataset with in-RAM shuffling (reference :341)."""
+
+    def __init__(self):
+        super().__init__()
+        self._memory = []
+        self._queue_num = None
+        self._parse_ins_id = False
+
+    def _init_distributed_settings(self, **kwargs):
+        pass  # PS-specific fleet_send knobs: no PS tier on TPU
+
+    def update_settings(self, **kwargs):
+        for k, v in kwargs.items():
+            if k == "batch_size":
+                self._batch_size = v
+            elif k == "thread_num":
+                self._thread_num = v
+            elif k == "use_var":
+                self._set_use_var(v)
+
+    def load_into_memory(self):
+        self._memory = []
+        for path in self._filelist:
+            self._memory.extend(self._iter_file(path))
+
+    def preload_into_memory(self, thread_num=None):
+        self.load_into_memory()
+
+    def wait_preload_done(self):
+        pass
+
+    def local_shuffle(self):
+        random.Random(0).shuffle(self._memory)
+
+    def global_shuffle(self, fleet=None, thread_num=12):
+        # single-controller SPMD: the global view IS the local view
+        self.local_shuffle()
+
+    def get_memory_data_size(self, fleet=None):
+        return len(self._memory)
+
+    def get_shuffle_data_size(self, fleet=None):
+        return len(self._memory)
+
+    def release_memory(self):
+        self._memory = []
+
+    def slots_shuffle(self, slots):
+        idx = {name: j for j, (name, _, _) in enumerate(self._use_vars)}
+        rng = random.Random(0)
+        for slot in slots:
+            j = idx.get(slot)
+            if j is None:
+                continue
+            col = [s[j] for s in self._memory]
+            rng.shuffle(col)
+            for s, v in zip(self._memory, col):
+                s[j] = v
+
+    def __iter__(self):
+        return self._batches(iter(self._memory))

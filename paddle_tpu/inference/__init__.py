@@ -195,3 +195,46 @@ def get_version():
     import paddle_tpu
 
     return paddle_tpu.__version__
+
+
+class DataType:
+    """Predictor tensor dtypes (reference paddle_infer_declare.h)."""
+    FLOAT32 = 0
+    INT64 = 1
+    INT32 = 2
+    UINT8 = 3
+    INT8 = 4
+    FLOAT16 = 5
+    BFLOAT16 = 6
+
+
+def get_num_bytes_of_data_type(dtype):
+    return {DataType.FLOAT32: 4, DataType.INT64: 8, DataType.INT32: 4,
+            DataType.UINT8: 1, DataType.INT8: 1, DataType.FLOAT16: 2,
+            DataType.BFLOAT16: 2}[dtype]
+
+
+class PredictorPool:
+    """A pool of Predictors sharing one compiled executable (reference
+    paddle_inference_api.h PredictorPool). XLA executables are reentrant, so
+    the clones share the AOT artifact and differ only in binding state."""
+
+    def __init__(self, config, size=1):
+        self._preds = [create_predictor(config) for _ in range(max(1, size))]
+
+    def retrive(self, idx):  # reference spells it 'retrive'
+        return self._preds[idx]
+
+    retrieve = retrive
+
+
+def get_trt_compile_version():
+    return (0, 0, 0)  # no TensorRT tier on TPU; XLA AOT serves this role
+
+
+def get_trt_runtime_version():
+    return (0, 0, 0)
+
+
+__all__ += ["DataType", "PredictorPool", "get_num_bytes_of_data_type",
+            "get_trt_compile_version", "get_trt_runtime_version"]

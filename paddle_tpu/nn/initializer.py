@@ -15,7 +15,7 @@ import numpy as np
 __all__ = [
     "Initializer", "Constant", "Normal", "TruncatedNormal", "Uniform",
     "XavierNormal", "XavierUniform", "KaimingNormal", "KaimingUniform",
-    "Assign", "Dirac", "Orthogonal", "calculate_gain", "set_global_initializer",
+    "Assign", "Bilinear", "Dirac", "Orthogonal", "calculate_gain", "set_global_initializer",
 ]
 
 _global_weight_init = None
@@ -162,6 +162,28 @@ class Dirac(Initializer):
         for g in range(self.groups):
             for i in range(min(og, in_c)):
                 w[(g * og + i, i) + center] = 1.0
+        return jnp.asarray(w, dtype)
+
+
+class Bilinear(Initializer):
+    """Bilinear-interpolation kernel for transposed-conv upsampling
+    (reference: fluid/initializer.py:778 BilinearInitializer). Weight must
+    be 4-D [C_out, C_in, K, K]; every (K, K) slice gets the same separable
+    triangle kernel, so a channel-wise Conv2DTranspose becomes exact
+    bilinear upsampling."""
+
+    def __call__(self, shape, dtype, key):
+        if len(shape) != 4:
+            raise ValueError("Bilinear initializer requires a 4-D weight")
+        if shape[2] != shape[3]:
+            raise ValueError("Bilinear initializer requires square kernels")
+        k = shape[2]
+        factor = (k + 1) // 2
+        center = factor - 1.0 if k % 2 == 1 else factor - 0.5
+        og = np.arange(k, dtype=np.float64)
+        tri = 1.0 - np.abs(og - center) / factor        # [k]
+        kern = np.outer(tri, tri).astype(np.float32)    # [k, k]
+        w = np.broadcast_to(kern, shape).copy()
         return jnp.asarray(w, dtype)
 
 

@@ -227,3 +227,26 @@ def export_chrome_tracing(dir_name, worker_name=None):
 def load_profiler_result(filename):
     raise NotImplementedError(
         "load back traces with TensorBoard/Perfetto from the trace dir")
+
+
+class SortedKeys(enum.Enum):
+    """Summary-table sort keys (reference: profiler_statistic.py:34). The
+    device columns read TPU times from the jax trace."""
+    CPUTotal = 0
+    CPUAvg = 1
+    CPUMax = 2
+    CPUMin = 3
+    GPUTotal = 4
+    GPUAvg = 5
+    GPUMax = 6
+    GPUMin = 7
+
+
+def export_protobuf(dir_name, worker_name=None):
+    """on_trace_ready factory (reference profiler.py:205). The jax profiler
+    already writes protobuf (.xplane.pb) into the trace directory, so this
+    is export_chrome_tracing with the same destination contract."""
+    return export_chrome_tracing(dir_name, worker_name)
+
+
+__all__ += ["SortedKeys", "export_protobuf"]

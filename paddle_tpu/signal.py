@@ -1,0 +1,158 @@
+"""paddle.signal — STFT family (reference: python/paddle/signal.py).
+
+TPU-native design: frames are gathered with a static index grid (one XLA
+gather, MXU-friendly batched FFT over the frame axis); overlap-add is a
+single scatter-add. Everything is shape-static so the whole pipeline fuses
+under jit.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .core.autograd import apply
+
+__all__ = ["stft", "istft", "frame", "overlap_add"]
+
+
+def _frame_val(v, frame_length, hop_length, axis):
+    # the literal axis value picks the layout (reference: axis=0 puts
+    # frames leading even on 1-D input, axis=-1 puts them trailing)
+    if axis == 0:
+        seq = v.shape[0]
+        n_frames = 1 + (seq - frame_length) // hop_length
+        idx = (hop_length * jnp.arange(n_frames)[:, None]
+               + jnp.arange(frame_length)[None, :])           # [nf, fl]
+        return v[idx]                                         # [nf, fl, ...]
+    if axis in (-1, v.ndim - 1):
+        seq = v.shape[-1]
+        n_frames = 1 + (seq - frame_length) // hop_length
+        idx = (jnp.arange(frame_length)[:, None]
+               + hop_length * jnp.arange(n_frames)[None, :])  # [fl, nf]
+        return v[..., idx]                                    # [..., fl, nf]
+    raise ValueError(f"frame: axis must be 0 or -1, got {axis}")
+
+
+def frame(x, frame_length, hop_length, axis=-1, name=None):
+    """Slice input into (overlapping) frames along `axis` (0 or -1)."""
+    if frame_length <= 0 or hop_length <= 0:
+        raise ValueError("frame_length and hop_length must be positive")
+    return apply(lambda v: _frame_val(v, frame_length, hop_length, axis), x)
+
+
+def _overlap_add_val(v, hop_length, axis):
+    if axis == 0:
+        nf, fl = v.shape[0], v.shape[1]
+        out_len = (nf - 1) * hop_length + fl
+        pos = (hop_length * jnp.arange(nf)[:, None]
+               + jnp.arange(fl)[None, :]).reshape(-1)
+        vals = v.reshape((nf * fl,) + v.shape[2:])
+        out = jnp.zeros((out_len,) + v.shape[2:], v.dtype)
+        return out.at[pos].add(vals)
+    if axis in (-1, v.ndim - 1):
+        fl, nf = v.shape[-2], v.shape[-1]
+        out_len = (nf - 1) * hop_length + fl
+        pos = (jnp.arange(fl)[:, None]
+               + hop_length * jnp.arange(nf)[None, :]).reshape(-1)
+        vals = v.reshape(v.shape[:-2] + (fl * nf,))
+        out = jnp.zeros(v.shape[:-2] + (out_len,), v.dtype)
+        return out.at[..., pos].add(vals)
+    raise ValueError(f"overlap_add: axis must be 0 or -1, got {axis}")
+
+
+def overlap_add(x, hop_length, axis=-1, name=None):
+    """Reconstruct a signal from framed slices by summing overlaps."""
+    if hop_length <= 0:
+        raise ValueError("hop_length must be positive")
+    return apply(lambda v: _overlap_add_val(v, hop_length, axis), x)
+
+
+def _prep_window(window, win_length, n_fft, dtype):
+    if window is None:
+        w = jnp.ones((win_length,), dtype)
+    else:
+        w = window._value if hasattr(window, "_value") else jnp.asarray(window)
+        if w.shape != (win_length,):
+            raise ValueError(
+                f"window must have shape [{win_length}], got {list(w.shape)}")
+    if win_length < n_fft:  # center-pad to n_fft
+        lpad = (n_fft - win_length) // 2
+        w = jnp.pad(w, (lpad, n_fft - win_length - lpad))
+    return w
+
+
+def stft(x, n_fft, hop_length=None, win_length=None, window=None,
+         center=True, pad_mode="reflect", normalized=False, onesided=True,
+         name=None):
+    """STFT of a real/complex signal `[..., seq_len]` ->
+    `[..., n_fft//2+1 | n_fft, num_frames]` complex."""
+    hop_length = int(n_fft // 4) if hop_length is None else hop_length
+    win_length = n_fft if win_length is None else win_length
+    if not 0 < win_length <= n_fft:
+        raise ValueError(f"win_length must be in (0, {n_fft}]")
+
+    def _stft(v, w):
+        is_cplx = jnp.issubdtype(v.dtype, jnp.complexfloating)
+        if onesided and is_cplx:
+            raise ValueError("onesided must be False for complex input")
+        squeeze = v.ndim == 1
+        if squeeze:
+            v = v[None]
+        if center:
+            pad = n_fft // 2
+            v = jnp.pad(v, [(0, 0)] * (v.ndim - 1) + [(pad, pad)],
+                        mode=pad_mode)
+        frames = _frame_val(v, n_fft, hop_length, -1)   # [..., n_fft, nf]
+        frames = frames * w[:, None].astype(frames.dtype)
+        if onesided and not is_cplx:
+            spec = jnp.fft.rfft(frames, n=n_fft, axis=-2)
+        else:
+            spec = jnp.fft.fft(frames, n=n_fft, axis=-2)
+        if normalized:
+            spec = spec * (n_fft ** -0.5)
+        return spec[0] if squeeze else spec
+
+    return apply(_stft, x, _prep_window(window, win_length, n_fft,
+                                        jnp.float32))
+
+
+def istft(x, n_fft, hop_length=None, win_length=None, window=None,
+          center=True, normalized=False, onesided=True, length=None,
+          return_complex=False, name=None):
+    """Least-squares inverse of `stft`: `[..., freq, num_frames]` complex ->
+    `[..., seq_len]`."""
+    hop_length = int(n_fft // 4) if hop_length is None else hop_length
+    win_length = n_fft if win_length is None else win_length
+    if return_complex and onesided:
+        raise ValueError("return_complex requires onesided=False")
+
+    def _istft(v, w):
+        squeeze = v.ndim == 2
+        if squeeze:
+            v = v[None]
+        n_frames = v.shape[-1]
+        if normalized:
+            v = v * (n_fft ** 0.5)
+        if onesided:
+            frames = jnp.fft.irfft(v, n=n_fft, axis=-2)
+        elif return_complex:
+            frames = jnp.fft.ifft(v, n=n_fft, axis=-2)
+        else:
+            frames = jnp.fft.ifft(v, n=n_fft, axis=-2).real
+        frames = frames * w[:, None].astype(frames.dtype)
+        y = _overlap_add_val(frames, hop_length, -1)
+        env = _overlap_add_val(
+            jnp.broadcast_to((w * w)[:, None], (n_fft, n_frames)),
+            hop_length, -1)
+        y = y / jnp.where(jnp.abs(env) > 1e-11, env, 1.0).astype(y.dtype)
+        expected = (n_frames - 1) * hop_length + n_fft
+        start = n_fft // 2 if center else 0
+        out_len = (length if length is not None
+                   else expected - 2 * start)
+        y = y[..., start:start + out_len]
+        if y.shape[-1] < out_len:
+            y = jnp.pad(y, [(0, 0)] * (y.ndim - 1)
+                        + [(0, out_len - y.shape[-1])])
+        return y[0] if squeeze else y
+
+    return apply(_istft, x, _prep_window(window, win_length, n_fft,
+                                         jnp.float32))

@@ -6,7 +6,7 @@ import numpy as np
 
 from ..io import Dataset
 
-__all__ = ["Imdb", "Imikolov", "Movielens", "UCIHousing", "WMT14", "WMT16",
+__all__ = ["Conll05st", "Imdb", "Imikolov", "Movielens", "UCIHousing", "WMT14", "WMT16",
            "ViterbiDecoder", "viterbi_decode"]
 
 
@@ -70,6 +70,39 @@ class UCIHousing(Dataset):
 
     def __getitem__(self, idx):
         return self.x[idx], self.y[idx]
+
+
+class Conll05st(_SyntheticTextDataset):
+    """CoNLL-2005 SRL dataset (reference: text/datasets/conll05.py).
+    Synthetic fallback: returns the reference's 9-field sample layout
+    (word_ids, 6 predicate-context slots, mark_ids, label_ids)."""
+    VOCAB = 4000
+    SEQ = 30
+    N_LABELS = 67
+
+    def __init__(self, data_file=None, word_dict_file=None,
+                 verb_dict_file=None, target_dict_file=None, emb_file=None,
+                 mode="train", download=True):
+        super().__init__(mode)
+
+    def __getitem__(self, idx):
+        rng = np.random.RandomState(self._seed + idx)
+        words = rng.randint(1, self.VOCAB, self.SEQ).astype(np.int64)
+        ctxs = [rng.randint(1, self.VOCAB, self.SEQ).astype(np.int64)
+                for _ in range(6)]
+        mark = (rng.rand(self.SEQ) < 0.1).astype(np.int64)
+        labels = rng.randint(0, self.N_LABELS, self.SEQ).astype(np.int64)
+        return (words, *ctxs, mark, labels)
+
+    def get_dict(self):
+        word = {f"w{i}": i for i in range(self.VOCAB)}
+        verb = {f"v{i}": i for i in range(50)}
+        label = {f"l{i}": i for i in range(self.N_LABELS)}
+        return word, verb, label
+
+    def get_embedding(self):
+        return np.random.RandomState(7).rand(self.VOCAB, 32).astype(
+            np.float32)
 
 
 class WMT14(_SyntheticTextDataset):

@@ -12,3 +12,62 @@ def try_import(module_name, err_msg=None):
         return importlib.import_module(module_name)
     except ImportError as e:
         raise ImportError(err_msg or f"please install {module_name}") from e
+
+
+def deprecated(update_to="", since="", reason="", level=0):
+    """Decorator marking an API deprecated (reference: utils/deprecated.py).
+    level 0 = docstring note only, 1 = warn on call, 2 = raise."""
+    import functools
+    import warnings
+
+    def wrap(fn):
+        note = (f"Deprecated since {since or 'unknown'}. {reason} "
+                f"{'Use ' + update_to + ' instead.' if update_to else ''}")
+        if fn.__doc__:
+            fn.__doc__ = note + "\n\n" + fn.__doc__
+        else:
+            fn.__doc__ = note
+
+        @functools.wraps(fn)
+        def inner(*args, **kwargs):
+            if level == 2:
+                raise RuntimeError(f"{fn.__name__}: {note}")
+            if level == 1:
+                warnings.warn(note, DeprecationWarning, stacklevel=2)
+            return fn(*args, **kwargs)
+        return inner
+    return wrap
+
+
+def require_version(min_version, max_version=None):
+    """Check the installed framework version is inside [min, max]."""
+    from .. import __version__
+
+    def key(v):
+        return [int(x) for x in str(v).replace("-", ".").split(".")
+                if x.isdigit()][:3]
+
+    cur = key(__version__)
+    if key(min_version) > cur:
+        raise Exception(
+            f"version {__version__} is older than required {min_version}")
+    if max_version is not None and key(max_version) < cur:
+        raise Exception(
+            f"version {__version__} is newer than allowed {max_version}")
+    return True
+
+
+def run_check():
+    """Smoke-test the install: run one fused matmul on the attached device
+    (reference utils/install_check.py trains a tiny net)."""
+    import jax
+    import jax.numpy as jnp
+    x = jnp.ones((8, 8), jnp.float32)
+    y = jax.jit(lambda a: (a @ a).sum())(x)
+    assert float(y) == 8.0 * 8.0 * 8.0
+    plat = jax.devices()[0].platform
+    print(f"PaddleTPU works well on 1 {plat} device.")
+    return True
+
+
+__all__ += ["deprecated", "require_version", "run_check"]

@@ -1,0 +1,48 @@
+"""Image IO backend switch (reference: python/paddle/vision/image.py:23).
+
+Backends: 'pil' (default) and 'cv2'. Decoding runs on host CPU; arrays are
+staged to HBM by the DataLoader, so the backend choice only affects host
+decode throughput.
+"""
+from __future__ import annotations
+
+__all__ = ["set_image_backend", "get_image_backend", "image_load"]
+
+_image_backend = "pil"
+
+
+def set_image_backend(backend):
+    global _image_backend
+    if backend not in ("pil", "cv2", "tensor"):
+        raise ValueError(
+            f"Expected backend are one of ['pil', 'cv2', 'tensor'], "
+            f"but got {backend}")
+    _image_backend = backend
+
+
+def get_image_backend():
+    return _image_backend
+
+
+def image_load(path, backend=None):
+    """Load an image as PIL.Image ('pil') or np.ndarray HWC-BGR ('cv2')."""
+    backend = backend or _image_backend
+    if backend not in ("pil", "cv2", "tensor"):
+        raise ValueError(
+            f"Expected backend are one of ['pil', 'cv2', 'tensor'], "
+            f"but got {backend}")
+    if backend == "cv2":
+        import numpy as np
+        try:
+            import cv2
+            return cv2.imread(path)
+        except ImportError:
+            from PIL import Image
+            return np.asarray(Image.open(path))[..., ::-1].copy()
+    from PIL import Image
+    img = Image.open(path)
+    if backend == "tensor":
+        import numpy as np
+        from ..core.tensor import Tensor
+        return Tensor(np.asarray(img))
+    return img

@@ -1,0 +1,174 @@
+"""paddle.signal: stft/istft/frame/overlap_add numerics (vs torch) and
+autograd; plus the round-2 API-parity additions (distributed entries,
+PS datasets, split, launch parsing, device/utils shims)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.signal import frame, istft, overlap_add, stft
+
+
+def test_frame_overlap_add_roundtrip_axis0():
+    x = paddle.to_tensor(np.arange(10.0, dtype=np.float32))
+    f = frame(x, 4, 2, axis=0)           # frames leading: [nf, fl]
+    assert list(f.shape) == [4, 4]
+    np.testing.assert_allclose(np.asarray(f._value)[1], [2, 3, 4, 5])
+    ola = overlap_add(f, 2, axis=0)
+    # per-sample frame coverage counts
+    expect = np.asarray(x._value) * np.array(
+        [1, 1, 2, 2, 2, 2, 2, 2, 1, 1], np.float32)
+    np.testing.assert_allclose(np.asarray(ola._value), expect, rtol=1e-6)
+    # trailing layout: [fl, nf] framing round-trips the same way
+    ft = frame(x, 4, 2, axis=-1)
+    assert list(ft.shape) == [4, 4]
+    np.testing.assert_allclose(np.asarray(ft._value)[:, 1], [2, 3, 4, 5])
+    np.testing.assert_allclose(
+        np.asarray(overlap_add(ft, 2, axis=-1)._value), expect, rtol=1e-6)
+
+
+def test_stft_matches_torch():
+    torch = pytest.importorskip("torch")
+    rng = np.random.RandomState(0)
+    x = rng.randn(2, 2000).astype(np.float32)
+    w = (np.hanning(129)[:-1]).astype(np.float32)
+    got = stft(paddle.to_tensor(x), n_fft=128, window=paddle.to_tensor(w))
+    ref = torch.stft(torch.tensor(x), 128, window=torch.tensor(w),
+                     return_complex=True).numpy()
+    np.testing.assert_allclose(np.asarray(got._value), ref, atol=2e-4)
+
+
+def test_istft_roundtrip_and_length():
+    rng = np.random.RandomState(1)
+    x = rng.randn(3, 1500).astype(np.float32)
+    w = (np.hanning(129)[:-1]).astype(np.float32)
+    spec = stft(paddle.to_tensor(x), n_fft=128, window=paddle.to_tensor(w))
+    back = istft(spec, n_fft=128, window=paddle.to_tensor(w), length=1500)
+    np.testing.assert_allclose(np.asarray(back._value), x, atol=1e-4)
+    short = istft(spec, n_fft=128, window=paddle.to_tensor(w))
+    assert short.shape[-1] == (spec.shape[-1] - 1) * 32 + 128 - 128
+
+
+def test_stft_complex_and_onesided_flag():
+    rng = np.random.RandomState(2)
+    xc = (rng.randn(1, 512) + 1j * rng.randn(1, 512)).astype(np.complex64)
+    spec = stft(paddle.to_tensor(xc), n_fft=64, onesided=False)
+    assert list(spec.shape) == [1, 64, 33]  # center pad adds n_fft frames
+    with pytest.raises(ValueError):
+        stft(paddle.to_tensor(xc), n_fft=64, onesided=True)
+
+
+def test_stft_grad_flows():
+    x = paddle.to_tensor(np.random.RandomState(3).randn(1, 256)
+                         .astype(np.float32), stop_gradient=False)
+    loss = stft(x, n_fft=64).abs().sum()
+    loss.backward()
+    assert x.grad is not None and list(x.grad.shape) == [1, 256]
+    assert float(np.abs(np.asarray(x.grad._value)).sum()) > 0
+
+
+def test_normalized_stft_scales():
+    x = paddle.to_tensor(np.random.RandomState(4).randn(1, 512)
+                         .astype(np.float32))
+    a = stft(x, n_fft=128)
+    b = stft(x, n_fft=128, normalized=True)
+    np.testing.assert_allclose(np.asarray(b._value),
+                               np.asarray(a._value) * 128 ** -0.5, rtol=1e-5)
+
+
+# --- round-2 API-parity additions -------------------------------------
+
+def test_distributed_entry_attrs():
+    import paddle_tpu.distributed as dist
+    assert dist.CountFilterEntry(10)._to_attr() == "count_filter_entry:10"
+    assert dist.ProbabilityEntry(0.25)._to_attr() == "probability_entry:0.25"
+    assert dist.ShowClickEntry("show", "click")._to_attr() \
+        == "show_click_entry:show:click"
+    with pytest.raises(ValueError):
+        dist.ProbabilityEntry(2.0)
+    assert dist.ParallelMode.DATA_PARALLEL == 0
+    assert dist.ParallelMode.SHARDING_PARALLEL == 3
+
+
+def test_ps_datasets(tmp_path):
+    import paddle_tpu.distributed as dist
+    # slot format: <n> vals... per use_var; vars: ids int64 [2], label f32 [1]
+    f = tmp_path / "part-0.txt"
+    lines = [f"2 {i} {i+1} 1 {float(i % 2)}" for i in range(7)]
+    f.write_text("\n".join(lines) + "\n")
+
+    class V:
+        def __init__(self, name, dtype, shape):
+            self.name, self.dtype, self.shape = name, dtype, shape
+
+    ds = dist.InMemoryDataset()
+    ds.init(batch_size=3,
+            use_var=[V("ids", "int64", [-1, 2]), V("label", "float32", [-1, 1])])
+    ds.set_filelist([str(f)])
+    ds.load_into_memory()
+    assert ds.get_memory_data_size() == 7
+    ds.local_shuffle()
+    batches = list(ds)
+    assert len(batches) == 3
+    assert batches[0]["ids"].shape == (3, 2)
+    assert batches[0]["ids"].dtype == np.int64
+    assert batches[0]["label"].dtype == np.float32
+    total = sum(b["ids"].shape[0] for b in batches)
+    assert total == 7
+    ds.release_memory()
+    assert ds.get_memory_data_size() == 0
+
+    qs = dist.QueueDataset()
+    qs.init(batch_size=4,
+            use_var=[V("ids", "int64", [-1, 2]), V("label", "float32", [-1, 1])])
+    qs.set_filelist([str(f)])
+    assert sum(b["ids"].shape[0] for b in qs) == 7
+
+
+def test_distributed_split_dense_parity():
+    import paddle_tpu.distributed as dist
+    paddle.seed(0)
+    x = paddle.to_tensor(np.random.RandomState(0).randn(4, 8)
+                         .astype(np.float32))
+    y = dist.split(x, (8, 12), operation="linear", axis=1, num_partitions=1)
+    assert list(y.shape) == [4, 12]
+    ids = paddle.to_tensor(np.array([[0, 3], [5, 7]], np.int64))
+    emb = dist.split(ids, (16, 6), operation="embedding", num_partitions=1)
+    assert list(emb.shape) == [2, 2, 6]
+    with pytest.raises(AssertionError):
+        dist.split(x, (8, 12), operation="conv")
+
+
+def test_launch_arg_parse(tmp_path, monkeypatch, capsys):
+    from paddle_tpu.distributed.launch import _parse, launch
+    args = _parse(["--nnodes", "1", "--master", "10.0.0.1:6170",
+                   "--rank", "0", "train.py", "--lr", "0.1"])
+    assert args.training_script == "train.py"
+    assert args.training_script_args == ["--lr", "0.1"]
+    script = tmp_path / "t.py"
+    script.write_text("import sys; print('LAUNCHED', sys.argv[1])\n")
+    launch([str(script), "ok"])
+    assert "LAUNCHED ok" in capsys.readouterr().out
+
+
+def test_device_utils_shims():
+    assert paddle.device.is_compiled_with_rocm() is False
+    assert paddle.device.is_compiled_with_ipu() is False
+    assert paddle.device.get_cudnn_version() is None
+    assert paddle.device.get_all_custom_device_type() == []
+    assert paddle.utils.require_version("0.0.1") is True
+    with pytest.raises(Exception):
+        paddle.utils.require_version("99.0.0")
+
+    @paddle.utils.deprecated(since="2.0", update_to="paddle.new_api", level=1)
+    def old_api():
+        return 42
+    with pytest.warns(DeprecationWarning):
+        assert old_api() == 42
+
+    assert paddle.vision.get_image_backend() == "pil"
+    with pytest.raises(ValueError):
+        paddle.vision.set_image_backend("nope")
+    import paddle_tpu.profiler as prof
+    assert prof.SortedKeys.GPUTotal.value == 4
+    import paddle_tpu.inference as infer
+    assert infer.get_num_bytes_of_data_type(infer.DataType.BFLOAT16) == 2

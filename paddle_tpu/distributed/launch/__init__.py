@@ -50,6 +50,10 @@ def launch(argv=None):
         if ":" in args.master:
             os.environ.setdefault("MASTER_PORT", args.master.split(":")[1])
     if nnodes > 1:
+        if not args.master:
+            raise SystemExit(
+                "launch: --master host:port is required when --nnodes > 1 "
+                "(coordinator address for jax.distributed.initialize)")
         import jax
         jax.distributed.initialize(
             coordinator_address=args.master,
@@ -57,11 +61,15 @@ def launch(argv=None):
             process_id=node_rank)
     if not args.training_script:
         raise SystemExit("launch: no training script given")
+    saved_argv = sys.argv
     sys.argv = [args.training_script] + list(args.training_script_args)
-    if args.training_script.endswith(".py"):
-        runpy.run_path(args.training_script, run_name="__main__")
-    else:  # module form: -m style target
-        runpy.run_module(args.training_script, run_name="__main__")
+    try:
+        if args.training_script.endswith(".py"):
+            runpy.run_path(args.training_script, run_name="__main__")
+        else:  # module form: -m style target
+            runpy.run_module(args.training_script, run_name="__main__")
+    finally:
+        sys.argv = saved_argv
 
 
 main = launch

@@ -70,9 +70,19 @@ class DatasetBase:
         for name, dtype, shape in self._use_vars:
             n = int(toks[i]); i += 1
             vals = toks[i:i + n]; i += n
-            np_dtype = np.int64 if "int" in dtype else np.float32
-            arr = np.asarray([np_dtype(float(t)) for t in vals],
-                             dtype=np_dtype)
+            if "int" in dtype:
+                # 64-bit hashed sparse ids must not round-trip through
+                # float (precision loss above 2**53); int() directly,
+                # falling back for '1.0'-style tokens
+                def _conv(t):
+                    try:
+                        return int(t)
+                    except ValueError:
+                        return int(float(t))
+                np_dtype = np.int64
+            else:
+                _conv, np_dtype = float, np.float32
+            arr = np.asarray([_conv(t) for t in vals], dtype=np_dtype)
             want = int(np.prod(shape))
             if arr.size < want:
                 arr = np.pad(arr, (0, want - arr.size))
@@ -97,8 +107,24 @@ class DatasetBase:
             yield self._collate(buf)
 
     def _collate(self, buf):
-        return {name: np.stack([s[j] for s in buf])
-                for j, (name, _, _) in enumerate(self._use_vars)}
+        batch = {name: np.stack([s[j] for s in buf])
+                 for j, (name, _, _) in enumerate(self._use_vars)}
+        # 64-bit hashed sparse ids survive parsing as np.int64, but with
+        # jax_enable_x64 off (the library default) the device transfer
+        # would silently truncate to int32 — fail loudly instead of
+        # corrupting embedding rows
+        import jax
+
+        if not jax.config.jax_enable_x64:
+            for name, arr in batch.items():
+                if arr.dtype == np.int64 and arr.size and \
+                        np.abs(arr).max() > np.iinfo(np.int32).max:
+                    raise ValueError(
+                        f"slot '{name}' carries ids beyond int32 range but "
+                        "jax_enable_x64 is off — enable x64 "
+                        "(jax.config.update('jax_enable_x64', True)) or "
+                        "hash ids into the embedding vocab before feeding")
+        return batch
 
 
 class QueueDataset(DatasetBase):

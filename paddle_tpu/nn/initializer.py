@@ -178,10 +178,14 @@ class Bilinear(Initializer):
         if shape[2] != shape[3]:
             raise ValueError("Bilinear initializer requires square kernels")
         k = shape[2]
-        factor = (k + 1) // 2
-        center = factor - 1.0 if k % 2 == 1 else factor - 0.5
+        # reference formula (fluid/initializer.py:823): f = ceil(k/2),
+        # c = (2f-1-f%2)/(2f), tri[x] = 1 - |x/f - c| — matches the Caffe
+        # factor/center form only for k of the form 2f - f%2, so use it
+        # verbatim for bit-parity (advisor round-2 finding)
+        f = (k + 1) // 2
+        c = (2.0 * f - 1.0 - f % 2) / (2.0 * f)
         og = np.arange(k, dtype=np.float64)
-        tri = 1.0 - np.abs(og - center) / factor        # [k]
+        tri = 1.0 - np.abs(og / f - c)                  # [k]
         kern = np.outer(tri, tri).astype(np.float32)    # [k, k]
         w = np.broadcast_to(kern, shape).copy()
         return jnp.asarray(w, dtype)

@@ -36,6 +36,11 @@ def frame(x, frame_length, hop_length, axis=-1, name=None):
     """Slice input into (overlapping) frames along `axis` (0 or -1)."""
     if frame_length <= 0 or hop_length <= 0:
         raise ValueError("frame_length and hop_length must be positive")
+    seq = x.shape[0] if axis == 0 else x.shape[-1]
+    if frame_length > seq:
+        raise ValueError(
+            f"frame_length ({frame_length}) must not exceed the input size "
+            f"along axis {axis} ({seq})")
     return apply(lambda v: _frame_val(v, frame_length, hop_length, axis), x)
 
 
@@ -129,6 +134,12 @@ def istft(x, n_fft, hop_length=None, win_length=None, window=None,
         squeeze = v.ndim == 2
         if squeeze:
             v = v[None]
+        expected_freq = n_fft // 2 + 1 if onesided else n_fft
+        if v.shape[-2] != expected_freq:
+            raise ValueError(
+                f"istft: input freq axis must be {expected_freq} "
+                f"({'onesided' if onesided else 'twosided'}, n_fft={n_fft}), "
+                f"got {v.shape[-2]}")
         n_frames = v.shape[-1]
         if normalized:
             v = v * (n_fft ** 0.5)

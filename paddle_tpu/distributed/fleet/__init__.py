@@ -22,7 +22,7 @@ from ..collective import get_rank, get_world_size, new_group
 from . import base  # noqa: F401
 from .base import DistributedStrategy  # noqa: F401
 
-__all__ = ["init", "DistributedStrategy", "distributed_model",
+__all__ = ["init", "reset", "DistributedStrategy", "distributed_model",
            "distributed_optimizer", "get_hybrid_communicate_group",
            "HybridCommunicateGroup", "worker_num", "worker_index",
            "is_first_worker", "barrier_worker", "stop_worker", "init_worker",
@@ -62,6 +62,14 @@ def init(role_maker=None, is_collective=True, strategy=None, log_level="INFO"):
 
 def get_hybrid_communicate_group():
     return _fleet_state["hcg"]
+
+
+def reset():
+    """Tear down fleet state and the installed mesh (TPU-native helper —
+    the reference leaks its communicators until process exit; tests and the
+    driver dryrun need a clean slate within one process)."""
+    _env.set_mesh(None)
+    _fleet_state.update(strategy=None, initialized=False, hcg=None)
 
 
 class HybridCommunicateGroup:

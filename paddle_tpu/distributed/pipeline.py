@@ -32,8 +32,9 @@ from jax.sharding import Mesh, PartitionSpec as P
 from ..nn.layer.layers import Layer
 from . import env as _env
 
-__all__ = ["pipeline_forward", "microbatch", "unmicrobatch", "PipelineLayer",
-           "LayerDesc", "stack_stage_params"]
+__all__ = ["pipeline_forward", "pipeline_forward_het", "microbatch",
+           "unmicrobatch", "PipelineLayer", "LayerDesc", "stack_stage_params",
+           "pack_stage_vecs", "unpack_stage_vec"]
 
 
 def microbatch(x, num_micro):
@@ -54,13 +55,29 @@ def stack_stage_params(stage_trees):
     return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *stage_trees)
 
 
+def _stage_key_scope(rng_key, t, s, n_stages):
+    """Per-(tick, stage) PRNG scope so dropout masks differ across
+    microbatches and stages (no baked trace-time constants)."""
+    import contextlib
+
+    from ..framework import random as rnd
+
+    if rng_key is None:
+        return contextlib.nullcontext()
+    return rnd.key_scope(jax.random.fold_in(rng_key, t * n_stages + s))
+
+
 def pipeline_forward(stage_fn, stacked_params, mb_inputs, mesh=None,
-                     axis="pp"):
+                     axis="pp", remat=False, rng_key=None):
     """Run the GPipe schedule: mb_inputs [M, mb, ...] through S stages.
 
     stacked_params: pytree, leading axis = S (sharded over `axis`).
     Returns [M, mb, ...] last-stage outputs (replicated).
     Differentiable; jit-compatible (call under jit for the real path).
+    remat=True checkpoints each stage application (recompute activations in
+    backward — the TPU lever for the memory headroom 1F1B buys on GPUs).
+    rng_key: traced key threading framework RNG (dropout) into the stages —
+    without it, stage dropout draws concretize at trace time.
 
     On a hybrid mesh (dp/tp axes besides pp) the shard_map is manual over
     `axis` only — GSPMD keeps auto-sharding the dp/tp dims of activations
@@ -72,6 +89,8 @@ def pipeline_forward(stage_fn, stacked_params, mb_inputs, mesh=None,
     S = mesh.shape[axis]
     M = mb_inputs.shape[0]
     manual = {axis} if len(mesh.axis_names) > 1 else frozenset()
+    if remat:
+        stage_fn = jax.checkpoint(stage_fn)
 
     def block(params, mbs):
         # params leaves: [1, ...] (this rank's stage); mbs: [M, mb, ...]
@@ -88,7 +107,8 @@ def pipeline_forward(stage_fn, stacked_params, mb_inputs, mesh=None,
                              jax.lax.dynamic_index_in_dim(
                                  mbs, mb_idx, 0, keepdims=False),
                              h_recv)
-            y = stage_fn(p_local, x_in)
+            with _stage_key_scope(rng_key, t, s, S):
+                y = stage_fn(p_local, x_in)
             # last stage writes finished microbatch m = t - (S-1)
             m = t - (S - 1)
             valid = jnp.logical_and(s == S - 1,
@@ -117,6 +137,110 @@ def pipeline_forward(stage_fn, stacked_params, mb_inputs, mesh=None,
                    out_specs=P(*([None] * mb_inputs.ndim)), check_vma=False,
                    **kw)
     return fn(stacked_params, mb_inputs)
+
+
+# --- heterogeneous trunks ---------------------------------------------------
+# Stages whose parameter structures/shapes differ cannot be stacked on a
+# leading axis. Instead each stage's params are flattened into one padded
+# f32 vector ([S, Lmax] sharded over 'pp'), and inside the SPMD program a
+# `lax.switch` on the stage index picks the branch that unflattens ITS
+# stage's structure (static per branch) and applies ITS layers. XLA compiles
+# all S branches; each device executes one. This lifts the round-2
+# homogeneous-trunk restriction with no change to the tick schedule.
+
+def pack_stage_vecs(stage_trees):
+    """Per-stage pytrees (arbitrary, differing structures) ->
+    ([S, Lmax] f32 stack, per-stage unpack specs)."""
+    specs, vecs = [], []
+    for tree in stage_trees:
+        leaves, treedef = jax.tree_util.tree_flatten(tree)
+        shapes = [tuple(int(d) for d in l.shape) for l in leaves]
+        dtypes = [l.dtype for l in leaves]
+        specs.append((treedef, shapes, dtypes))
+        if leaves:
+            vec = jnp.concatenate(
+                [jnp.asarray(l).astype(jnp.float32).reshape(-1)
+                 for l in leaves])
+        else:
+            vec = jnp.zeros((0,), jnp.float32)
+        vecs.append(vec)
+    L = max(int(v.shape[0]) for v in vecs) if vecs else 0
+    vecs = [jnp.pad(v, (0, L - v.shape[0])) for v in vecs]
+    return jnp.stack(vecs), specs
+
+
+def unpack_stage_vec(vec, spec):
+    treedef, shapes, dtypes = spec
+    leaves, off = [], 0
+    for shp, dt in zip(shapes, dtypes):
+        n = int(np.prod(shp)) if shp else 1
+        leaves.append(vec[off:off + n].reshape(shp).astype(dt))
+        off += n
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+def pipeline_forward_het(stage_fns, stage_vecs, specs, mb_inputs, mesh=None,
+                         axis="pp", remat=False, rng_key=None):
+    """GPipe schedule for heterogeneous stages.
+
+    stage_fns: list of S fns (params_tree, h) -> h (fixed activation shape).
+    stage_vecs: [S, Lmax] packed params (see pack_stage_vecs).
+    """
+    mesh = mesh or _env.get_mesh()
+    if mesh is None:
+        raise RuntimeError("pipeline_forward_het needs a mesh with a "
+                           f"'{axis}' axis")
+    S = mesh.shape[axis]
+    if len(stage_fns) != S:
+        raise ValueError(f"{len(stage_fns)} stage fns for {S}-way '{axis}'")
+    M = mb_inputs.shape[0]
+    manual = {axis} if len(mesh.axis_names) > 1 else frozenset()
+
+    branches = []
+    for i in range(S):
+        def branch(vec, h, _i=i):
+            return stage_fns[_i](unpack_stage_vec(vec, specs[_i]), h)
+        branches.append(jax.checkpoint(branch) if remat else branch)
+
+    def block(vecs, mbs):
+        vec_local = vecs[0]                       # [Lmax] this rank's stage
+        s = jax.lax.axis_index(axis)
+        h0 = jnp.zeros_like(mbs[0])
+        outs0 = jnp.zeros_like(mbs)
+
+        def tick(carry, t):
+            h_recv, outs = carry
+            mb_idx = jnp.clip(t, 0, M - 1)
+            x_in = jnp.where(s == 0,
+                             jax.lax.dynamic_index_in_dim(
+                                 mbs, mb_idx, 0, keepdims=False),
+                             h_recv)
+            with _stage_key_scope(rng_key, t, s, S):
+                y = jax.lax.switch(s, branches, vec_local, x_in)
+            m = t - (S - 1)
+            valid = jnp.logical_and(s == S - 1,
+                                    jnp.logical_and(m >= 0, m < M))
+            outs = jax.lax.cond(
+                valid,
+                lambda o: jax.lax.dynamic_update_index_in_dim(
+                    o, y, jnp.clip(m, 0, M - 1), 0),
+                lambda o: o, outs)
+            h_next = jax.lax.ppermute(
+                y, axis, [(i, i + 1) for i in range(S - 1)])
+            return (h_next, outs), None
+
+        (_, outs), _ = jax.lax.scan(tick, (h0, outs0),
+                                    jnp.arange(M + S - 1))
+        outs = jax.lax.psum(
+            jnp.where(s == S - 1, outs, jnp.zeros_like(outs)), axis)
+        return outs
+
+    in_specs = (P(axis, None), P(*([None] * mb_inputs.ndim)))
+    kw = {"axis_names": manual} if manual else {}
+    fn = shard_map(block, mesh=mesh, in_specs=in_specs,
+                   out_specs=P(*([None] * mb_inputs.ndim)), check_vma=False,
+                   **kw)
+    return fn(stage_vecs, mb_inputs)
 
 
 class LayerDesc:
@@ -154,6 +278,7 @@ class PipelineLayer(Layer):
                 "pp" in mesh.axis_names else 1
         self._num_stages = num_stages
         self._loss_fn = loss_fn
+        self._recompute_interval = recompute_interval
         from ..nn.layer.container import LayerList
 
         self.funcs = LayerList(built)
@@ -175,13 +300,29 @@ class PipelineLayer(Layer):
             x = layer(x)
         return x
 
-    # -- jitted-schedule bridge (homogeneous trunks) ----------------------
-    def _stage_param_tree(self, stage):
+    # -- jitted-schedule bridge -------------------------------------------
+    def stage_param_tensors(self, stage):
+        """{key: Tensor} for one stage — live parameter objects, so a
+        caller can put the jitted schedule on the autograd tape."""
         tree = {}
         for j, layer in enumerate(self.get_stage_layers(stage)):
             for name, p in layer.named_parameters():
-                tree[f"{j}.{name}"] = p._value
+                tree[f"{j}.{name}"] = p
         return tree
+
+    def _stage_param_tree(self, stage):
+        return {k: p._value
+                for k, p in self.stage_param_tensors(stage).items()}
+
+    def is_homogeneous(self):
+        trees = [self._stage_param_tree(s) for s in range(self._num_stages)]
+        keys = set(trees[0])
+        # dtypes must match too: jnp.stack would silently promote a
+        # mixed-precision stage (e.g. bf16 under AMP) to the common dtype
+        return all(set(t) == keys
+                   and all(t[k].shape == trees[0][k].shape
+                           and t[k].dtype == trees[0][k].dtype for k in keys)
+                   for t in trees[1:])
 
     def stacked_trunk_params(self):
         """Per-stage parameter trees stacked on a leading stage axis —
@@ -198,13 +339,10 @@ class PipelineLayer(Layer):
                     "(keep embedding/head outside the PipelineLayer)")
         return stack_stage_params(trees)
 
-    def trunk_stage_fn(self):
-        """stage_fn(params_tree, h) for pipeline_forward: applies one
-        stage's layers with parameters swapped in (stage-0 architecture,
-        any stage's weights)."""
+    def _make_stage_fn(self, stage):
         from ..core.tensor import Tensor
 
-        layers = self.get_stage_layers(0)
+        layers = self.get_stage_layers(stage)
 
         def stage_fn(params, h):
             x = Tensor(h)
@@ -217,3 +355,72 @@ class PipelineLayer(Layer):
             return x._value
 
         return stage_fn
+
+    def trunk_stage_fn(self):
+        """stage_fn(params_tree, h) for pipeline_forward: applies one
+        stage's layers with parameters swapped in (stage-0 architecture,
+        any stage's weights)."""
+        return self._make_stage_fn(0)
+
+    def het_stage_fns(self):
+        """Per-stage fns for pipeline_forward_het (each with its own
+        architecture)."""
+        return [self._make_stage_fn(s) for s in range(self._num_stages)]
+
+    def forward_pipelined(self, x, num_micro):
+        """Tape-recorded jitted pipeline over the installed mesh: picks the
+        stacked schedule for homogeneous trunks, the padded switch-branch
+        schedule otherwise. `x` is a Tensor [B, ...]; returns Tensor.
+
+        The schedule fn is wrapped in jax.jit (and cached per
+        num_micro/remat/mesh): the inner sharding annotations (dp/tp
+        constraints inside stages) are only legal in a partial-manual
+        shard_map when the surrounding trace carries the mesh context.
+        """
+        from ..core.autograd import apply
+        from ..framework import random as rnd
+
+        mesh = _env.get_mesh()
+        remat = self._recompute_interval > 0
+        trees = [self.stage_param_tensors(s)
+                 for s in range(self._num_stages)]
+        key = (num_micro, remat, mesh)
+        cache = getattr(self, "_pipe_jit_cache", None)
+        if cache is None:
+            cache = self._pipe_jit_cache = {}
+        fn = cache.get(key)
+        if fn is None:
+            if self.is_homogeneous():
+                stage_fn = self.trunk_stage_fn()
+
+                def fn(tree_list, xv, rng_key):
+                    stacked = jax.tree_util.tree_map(
+                        lambda *leaves: jnp.stack(leaves), *tree_list)
+                    y = pipeline_forward(stage_fn, stacked,
+                                         microbatch(xv, num_micro),
+                                         mesh=mesh, remat=remat,
+                                         rng_key=rng_key)
+                    return y.reshape(xv.shape)
+            else:
+                stage_fns = self.het_stage_fns()
+                specs = [  # static unpack specs from the live params
+                    pack_stage_vecs([t])[1][0]
+                    for t in (self._stage_param_tree(s)
+                              for s in range(self._num_stages))]
+
+                def fn(tree_list, xv, rng_key):
+                    vecs, _ = pack_stage_vecs(tree_list)
+                    y = pipeline_forward_het(stage_fns, vecs, specs,
+                                             microbatch(xv, num_micro),
+                                             mesh=mesh, remat=remat,
+                                             rng_key=rng_key)
+                    return y.reshape(xv.shape)
+            fn = cache[key] = jax.jit(fn)
+        # In train mode a fresh key is passed as a (traced) argument so
+        # stage dropout differs across steps even through the jit cache.
+        # In eval mode no key is drawn at all: drawing from the global
+        # store during an external jit trace would leak a tracer into it
+        # (the framework invariant is: traced draws happen under key_scope,
+        # which hapi/jit install for their train steps).
+        rng_key = rnd.next_key() if self.training else None
+        return apply(fn, trees, x, rng_key)

@@ -29,7 +29,7 @@ class GPTConfig:
     def __init__(self, vocab_size=50304, hidden_size=768, num_layers=12,
                  num_heads=12, intermediate_size=None, max_position=1024,
                  dropout=0.1, layer_norm_eps=1e-5, initializer_range=0.02,
-                 use_flash=True):
+                 use_flash=True, pp_num_micro=None, pp_recompute=False):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
         self.num_layers = num_layers
@@ -40,6 +40,11 @@ class GPTConfig:
         self.layer_norm_eps = layer_norm_eps
         self.initializer_range = initializer_range
         self.use_flash = use_flash
+        # pipeline-parallel knobs (used when built under a mesh with pp>1):
+        # number of microbatches (None = auto from batch/pp), and per-stage
+        # rematerialization (jax.checkpoint) to trade FLOPs for HBM
+        self.pp_num_micro = pp_num_micro
+        self.pp_recompute = pp_recompute
 
 
 class GPTAttention(nn.Layer):
@@ -146,11 +151,70 @@ class GPTModel(nn.Layer):
         self.wpe = nn.Embedding(c.max_position, c.hidden_size,
                                 weight_attr=_attr(init))
         self.drop = nn.Dropout(c.dropout)
-        self.h = nn.LayerList([GPTBlock(c) for _ in range(c.num_layers)])
+        # Under a mesh with pp>1 the trunk is a PipelineLayer: blocks are
+        # segmented into pp stages and the no-cache forward runs the jitted
+        # GPipe schedule (shard_map + ppermute + scan over the 'pp' axis) —
+        # fleet.init(pp_degree=k) -> GPTForCausalLM() is the whole user API.
+        # Reference: fleet meta_parallel pipeline_parallel.py:30 wraps the
+        # same trunk segmentation around its p2p scheduler.
+        pp = self._pp_degree()
+        if pp > 1:
+            if c.num_layers % pp != 0:
+                raise ValueError(
+                    f"num_layers ({c.num_layers}) must be divisible by the "
+                    f"pipeline degree ({pp}) for homogeneous stages")
+            from ..distributed.pipeline import LayerDesc, PipelineLayer
+
+            self.h = PipelineLayer(
+                layers=[LayerDesc(GPTBlock, c) for _ in range(c.num_layers)],
+                num_stages=pp,
+                recompute_interval=1 if c.pp_recompute else 0)
+        else:
+            self.h = nn.LayerList([GPTBlock(c) for _ in range(c.num_layers)])
         self.ln_f = nn.LayerNorm(c.hidden_size, c.layer_norm_eps)
+
+    @staticmethod
+    def _pp_degree():
+        from ..distributed import env as _denv
+
+        mesh = _denv.get_mesh()
+        if mesh is not None and "pp" in mesh.axis_names:
+            return int(mesh.shape["pp"])
+        return 1
+
+    def _iter_blocks(self):
+        from ..distributed.pipeline import PipelineLayer
+
+        return self.h.funcs if isinstance(self.h, PipelineLayer) else self.h
+
+    def _num_micro(self, batch):
+        """Microbatch count: config override, else the largest divisor of
+        the batch <= 2*stages (2 ticks per stage keeps the bubble fraction
+        (S-1)/(M+S-1) small without shrinking per-step MXU work too far)."""
+        from ..distributed.pipeline import PipelineLayer
+
+        S = self.h.num_stages if isinstance(self.h, PipelineLayer) else 1
+        if self.config.pp_num_micro:
+            m = self.config.pp_num_micro
+            if batch % m != 0:
+                raise ValueError(
+                    f"pp_num_micro ({m}) must divide the batch size "
+                    f"({batch})")
+            return m
+        for m in range(min(batch, 2 * S), 0, -1):
+            if batch % m == 0:
+                return m
+        return 1
+
+    def _pipeline_trunk(self, x):
+        """Run the trunk through the jitted pipeline schedule, on the tape
+        (differentiable: the whole schedule is one pure-jax fn under
+        `apply`)."""
+        return self.h.forward_pipelined(x, self._num_micro(x.shape[0]))
 
     def forward(self, input_ids, position_ids=None, caches=None):
         from .. import tensor as T
+        from ..distributed.pipeline import PipelineLayer
 
         b, s = input_ids.shape
         past = 0
@@ -164,13 +228,17 @@ class GPTModel(nn.Layer):
         x = annotate(x, "dp", None, None)
         x = self.drop(x)
         new_caches = [] if caches is not None else None
-        for i, block in enumerate(self.h):
-            if caches is not None:
-                x, nc = block(x, caches[i] if caches[i] is not None
-                              else _empty_cache(x, self.config))
-                new_caches.append(nc)
-            else:
-                x = block(x)
+        if caches is None and isinstance(self.h, PipelineLayer) and \
+                self.h.num_stages > 1:
+            x = self._pipeline_trunk(x)
+        else:
+            for i, block in enumerate(self._iter_blocks()):
+                if caches is not None:
+                    x, nc = block(x, caches[i] if caches[i] is not None
+                                  else _empty_cache(x, self.config))
+                    new_caches.append(nc)
+                else:
+                    x = block(x)
         x = self.ln_f(x)
         return (x, new_caches) if caches is not None else x
 
@@ -214,7 +282,7 @@ class GPTForCausalLM(nn.Layer):
         from ..core.autograd import no_grad
 
         with no_grad():
-            caches = [None] * len(self.gpt.h)
+            caches = [None] * len(list(self.gpt._iter_blocks()))
             ids = input_ids
             hidden, caches = self.gpt(ids, caches=caches)
             for _ in range(max_new_tokens):

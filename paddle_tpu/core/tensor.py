@@ -23,7 +23,7 @@ _tensor_count = 0
 class Tensor:
     __slots__ = (
         "_value", "stop_gradient", "_grad", "_node", "_out_idx",
-        "name", "persistable", "__weakref__",
+        "name", "persistable", "_dist_attr", "__weakref__",
     )
 
     # populated by paddle_tpu.tensor._register_methods at package import

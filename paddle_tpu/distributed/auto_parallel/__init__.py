@@ -1,0 +1,229 @@
+"""paddle.distributed.auto_parallel — semi-automatic parallelization.
+
+Reference:
+- python/paddle/distributed/auto_parallel/interface.py:34 (shard_tensor),
+  :73 (shard_op)
+- python/paddle/distributed/auto_parallel/process_mesh.py:39 (ProcessMesh)
+- python/paddle/distributed/auto_parallel/engine.py:50 (Engine)
+
+TPU-native: the reference builds a distributed context, runs partition/
+completion passes over its ProgramDesc, then lowers to per-rank programs
+with NCCL comm ops. On the XLA substrate the GSPMD partitioner IS that
+machinery: `shard_tensor` pins a NamedSharding (dims_mapping ->
+PartitionSpec), everything unannotated is *completed* by XLA's sharding
+propagation, and the collectives are inserted by the compiler. The Engine
+drives the same fully-jitted train step as hapi.Model over the installed
+mesh.
+"""
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from ...core.tensor import Tensor
+from .. import env as _env
+
+__all__ = ["ProcessMesh", "shard_tensor", "shard_op", "Engine"]
+
+
+class ProcessMesh:
+    """N-D topology of logical processes (reference process_mesh.py:39).
+    On the single-controller TPU runtime a logical process is a device;
+    the ProcessMesh materializes directly as a jax.sharding.Mesh."""
+
+    def __init__(self, mesh, dim_names=None, parent=None):
+        if not isinstance(mesh, (list, tuple, np.ndarray)):
+            raise ValueError("mesh must be a (nested) list of process ids")
+        arr = np.asarray(mesh)
+        self._topology = list(arr.shape)
+        self._processes = [int(p) for p in arr.flatten()]
+        if len(set(self._processes)) != len(self._processes):
+            raise ValueError("mesh must not contain duplicate process ids")
+        self._dim_names = list(dim_names) if dim_names else [
+            f"d{i}" for i in range(arr.ndim)]
+        devices = {d.id: d for d in jax.devices()}
+        try:
+            dev_arr = np.vectorize(lambda p: devices[p])(arr)
+        except KeyError as e:  # pragma: no cover - config error
+            raise ValueError(f"process id {e} is not a visible device")
+        self._jax_mesh = Mesh(dev_arr, tuple(self._dim_names))
+
+    @property
+    def topology(self):
+        return self._topology
+
+    @property
+    def shape(self):
+        return self._topology
+
+    @property
+    def processes(self):
+        return self._processes
+
+    @property
+    def process_ids(self):
+        return self._processes
+
+    @property
+    def dim_names(self):
+        return self._dim_names
+
+    @property
+    def ndim(self):
+        return len(self._topology)
+
+    @property
+    def jax_mesh(self):
+        return self._jax_mesh
+
+    def __enter__(self):
+        self._prev = _env.get_mesh()
+        _env.set_mesh(self._jax_mesh)
+        return self
+
+    def __exit__(self, *exc):
+        _env.set_mesh(self._prev)
+
+    def __eq__(self, other):
+        return (isinstance(other, ProcessMesh)
+                and self._topology == other._topology
+                and self._processes == other._processes)
+
+    def __repr__(self):
+        return (f"ProcessMesh(shape={self._topology}, "
+                f"process_ids={self._processes})")
+
+
+def _spec_from_dims_mapping(names, dims_mapping, ndim):
+    spec = []
+    for i in range(ndim):
+        j = dims_mapping[i] if i < len(dims_mapping) else -1
+        if j in (-1, None):
+            spec.append(None)
+        elif not 0 <= j < len(names):
+            raise ValueError(
+                f"dims_mapping[{i}]={j} is out of range for a mesh with "
+                f"{len(names)} dims")
+        else:
+            spec.append(names[j])
+    return PartitionSpec(*spec)
+
+
+def _as_process_mesh(pm):
+    if isinstance(pm, ProcessMesh):
+        return pm
+    return ProcessMesh(pm)
+
+
+def shard_tensor(x, dist_attr=None, **kw):
+    """Annotate a tensor with a mesh placement (reference interface.py:34).
+
+    dist_attr: {"process_mesh": ProcessMesh | nested list,
+                "dims_mapping": [tensor-dim -> mesh-dim | -1]}
+    Concrete tensors are device_put with the NamedSharding; traced values
+    get a with_sharding_constraint. Unannotated dims/tensors are completed
+    by GSPMD propagation.
+    """
+    dist_attr = dict(dist_attr or {}, **kw)
+    pm = dist_attr.get("process_mesh")
+    pm = _as_process_mesh(pm) if pm is not None else None
+    mesh = pm.jax_mesh if pm is not None else _env.get_mesh()
+    if mesh is None:
+        raise RuntimeError("shard_tensor needs a process_mesh (none given "
+                           "and no global mesh installed)")
+    ndim = len(x.shape)
+    dims_mapping = dist_attr.get("dims_mapping") or [-1] * ndim
+    spec = _spec_from_dims_mapping(list(mesh.axis_names), dims_mapping, ndim)
+    sharding = NamedSharding(mesh, spec)
+
+    def _place(v):
+        if isinstance(v, jax.core.Tracer):
+            return jax.lax.with_sharding_constraint(v, sharding)
+        return jax.device_put(v, sharding)
+
+    if isinstance(x, Tensor):
+        x._value = _place(x._value)
+        x._dist_attr = {"process_mesh": pm, "dims_mapping": dims_mapping}
+        return x
+    return _place(x)
+
+
+def shard_op(op_fn, dist_attr=None):
+    """Run `op_fn` and annotate its outputs (reference interface.py:73).
+    Returns a wrapped callable (call it with the op inputs)."""
+    dist_attr = dist_attr or {}
+
+    def _wrapped(*args, **kwargs):
+        out = op_fn(*args, **kwargs)
+        out_attrs = dist_attr.get("out") or []
+        pm = dist_attr.get("process_mesh")
+
+        def _annot(t, attr):
+            if t is None or not hasattr(t, "shape"):
+                return t
+            a = dict(attr or {})
+            if pm is not None and "process_mesh" not in a:
+                a["process_mesh"] = pm
+            if not a:
+                return t
+            return shard_tensor(t, a)
+
+        if isinstance(out, (list, tuple)):
+            outs = [_annot(t, out_attrs[i] if i < len(out_attrs) else None)
+                    for i, t in enumerate(out)]
+            return type(out)(outs) if isinstance(out, tuple) else outs
+        return _annot(out, out_attrs[0] if out_attrs else None)
+
+    return _wrapped
+
+
+class Engine:
+    """Reference engine.py:50, re-based on the hapi jitted train step: the
+    serial model + annotations compile to ONE SPMD program per mode, GSPMD
+    doing the planner/partitioner work."""
+
+    def __init__(self, model=None, inputs_spec=None, labels_spec=None,
+                 cluster=None, strategy=None):
+        self.model = model
+        self.inputs_spec = inputs_spec
+        self.labels_spec = labels_spec
+        self.cluster = cluster
+        self.strategy = strategy
+        self._hapi = None
+
+    def prepare(self, optimizer=None, loss=None, metrics=None,
+                mode="train", all_ranks=False, gradient_scale=True):
+        from ... import hapi, metric as metric_mod
+
+        if _env.get_mesh() is None:
+            # default data-parallel mesh over every device (reference
+            # default: one process per device, dp over the world)
+            devs = np.array(jax.devices())
+            _env.set_mesh(Mesh(devs, ("dp",)))
+        self._hapi = hapi.Model(self.model)
+        self._hapi.prepare(optimizer, loss, metrics)
+        return self
+
+    def fit(self, train_data=None, valid_data=None, batch_size=1,
+            epochs=1, fetches=None, steps_per_epoch=None, valid_freq=1,
+            collate_fn=None, callbacks=None, verbose=0):
+        if self._hapi is None:
+            raise RuntimeError("call Engine.prepare() before fit()")
+        return self._hapi.fit(train_data, valid_data, epochs=epochs,
+                              batch_size=batch_size, verbose=verbose,
+                              callbacks=callbacks)
+
+    def evaluate(self, eval_data, batch_size=1, fetches=None, verbose=0):
+        return self._hapi.evaluate(eval_data, batch_size=batch_size,
+                                   verbose=verbose)
+
+    def predict(self, test_data, batch_size=1, fetches=None, verbose=0):
+        return self._hapi.predict(test_data, batch_size=batch_size,
+                                  verbose=verbose)
+
+    def save(self, path, training=True, mode=None):
+        return self._hapi.save(path, training=training)
+
+    def load(self, path, strict=True, load_optimizer=True, mode=None):
+        return self._hapi.load(path)

@@ -1,0 +1,253 @@
+"""paddle.quantization — PTQ + QAT for the TPU int8 path.
+
+Reference:
+- python/paddle/fluid/contrib/slim/quantization/post_training_quantization.py:97
+  (PostTrainingQuantization: calibrate over a data loader, pick scales by
+  abs_max/hist/KL, rewrite matmul/conv to int8)
+- python/paddle/fluid/contrib/slim/quantization/imperative/ptq.py:40
+  (ImperativePTQ.quantize / save_quantized_model)
+- python/paddle/fluid/contrib/slim/quantization/imperative/qat.py:42
+  (ImperativeQuantAware — fake-quant QAT wrappers)
+
+TPU-native design: the reference mutates its static ProgramDesc graph with
+quantize/dequantize ops; here quantization happens at the LAYER level before
+XLA tracing — calibration observers ride a jitted eval sweep, then
+quantizable layers are swapped for int8 layers whose dot/conv lower to XLA
+integer dot_general (MXU int8). The XLA graph itself is never mutated; the
+rewritten model re-traces to an int8 HLO program.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..nn.layer.layers import Layer
+from .layers import (  # noqa: F401
+    QATConv2D, QATLinear, QuantizedConv2D, QuantizedLinear, fake_quant,
+    quantize_weight,
+)
+from .observers import (  # noqa: F401
+    AbsmaxObserver, HistObserver, MovingAverageAbsmaxObserver,
+    PerChannelAbsmaxObserver, build_observer,
+)
+
+__all__ = ["QuantConfig", "ImperativePTQ", "ImperativeQuantAware",
+           "PostTrainingQuantization", "QuantizedLinear", "QuantizedConv2D",
+           "QATLinear", "QATConv2D", "fake_quant", "quantize_weight",
+           "AbsmaxObserver", "MovingAverageAbsmaxObserver",
+           "PerChannelAbsmaxObserver", "HistObserver", "build_observer"]
+
+
+class QuantConfig:
+    """Reference imperative/ptq_config.py PTQConfig — which observers and
+    bit widths to use."""
+
+    def __init__(self, activation_quantize_type="abs_max",
+                 weight_quantize_type="channel_wise_abs_max",
+                 activation_bits=8, weight_bits=8, moving_rate=0.9,
+                 hist_percent=0.99999):
+        self.activation_quantize_type = activation_quantize_type
+        self.weight_quantize_type = weight_quantize_type
+        self.activation_bits = activation_bits
+        self.weight_bits = weight_bits
+        self.moving_rate = moving_rate
+        self.hist_percent = hist_percent
+
+
+def _quantizable(layer):
+    from .. import nn
+
+    if isinstance(layer, nn.Linear):
+        return "linear"
+    if isinstance(layer, nn.Conv2D):
+        return "conv2d"
+    return None
+
+
+def _walk_replace(root, fn):
+    """Replace children for which fn(child) returns a new layer."""
+    for parent in root.sublayers(include_self=True):
+        for k, child in list(parent._sub_layers.items()):
+            new = fn(child)
+            if new is not None and new is not child:
+                parent._sub_layers[k] = new
+
+
+class _Observation:
+    def __init__(self, observer):
+        self.observer = observer
+
+
+class ImperativePTQ:
+    """Post-training quantization for dygraph models.
+
+    ptq = ImperativePTQ(QuantConfig()); ptq.quantize(model)
+    ... run calibration forwards (jitted eval sweep) ...
+    ptq.convert(model)  ->  int8 layers in place
+    """
+
+    def __init__(self, quant_config=None):
+        self.cfg = quant_config or QuantConfig()
+        self._hooks = []
+
+    def quantize(self, model, inplace=True):
+        cfg = self.cfg
+        for name, layer in model.named_sublayers(include_self=True):
+            kind = _quantizable(layer)
+            if kind is None:
+                continue
+            obs = build_observer(cfg.activation_quantize_type,
+                                 cfg.activation_bits,
+                                 moving_rate=cfg.moving_rate,
+                                 hist_percent=cfg.hist_percent)
+            layer._ptq_observation = _Observation(obs)
+            # observe the layer INPUT (the activation that will be
+            # quantized at inference): forward pre hook
+            handle = layer.register_forward_pre_hook(
+                lambda l, inp, _o=obs: _o.update(inp[0]._value))
+            self._hooks.append(handle)
+        return model
+
+    def convert(self, model, inplace=True):
+        cfg = self.cfg
+        for h in self._hooks:
+            h.remove()
+        self._hooks = []
+
+        def _swap(child):
+            obs = getattr(child, "_ptq_observation", None)
+            if obs is None:
+                return None
+            kind = _quantizable(child)
+            scale = obs.observer.scale()
+            scale = float(np.max(scale))  # activation scale is per-tensor
+            if kind == "linear":
+                return QuantizedLinear(child, scale, cfg.weight_bits,
+                                       cfg.activation_bits)
+            if kind == "conv2d":
+                return QuantizedConv2D(child, scale, cfg.weight_bits,
+                                       cfg.activation_bits)
+            return None
+
+        _walk_replace(model, _swap)
+        return model
+
+    def save_quantized_model(self, model, path, input_spec=None):
+        from .. import jit
+
+        self.convert(model)
+        return jit.save(model, path, input_spec=input_spec)
+
+
+class ImperativeQuantAware:
+    """Quantization-aware training (reference imperative/qat.py:42).
+
+    imperative_qat.quantize(model): swaps Linear/Conv2D for fake-quant
+    wrappers (straight-through estimator). After training,
+    convert(model) produces real int8 layers using the QAT-observed
+    activation scales.
+    """
+
+    def __init__(self, quantizable_layer_type=("Conv2D", "Linear"),
+                 weight_quantize_type="abs_max",
+                 activation_quantize_type="moving_average_abs_max",
+                 weight_bits=8, activation_bits=8, moving_rate=0.9,
+                 **unused):
+        self.types = set(quantizable_layer_type)
+        self.weight_bits = weight_bits
+        self.activation_bits = activation_bits
+        self.moving_rate = moving_rate
+
+    def quantize(self, model):
+        def _swap(child):
+            kind = _quantizable(child)
+            if kind == "linear" and "Linear" in self.types:
+                return QATLinear(child, self.weight_bits,
+                                 self.activation_bits, self.moving_rate)
+            if kind == "conv2d" and "Conv2D" in self.types:
+                return QATConv2D(child, self.weight_bits,
+                                 self.activation_bits, self.moving_rate)
+            return None
+
+        _walk_replace(model, _swap)
+        return model
+
+    def convert(self, model):
+        def _swap(child):
+            if isinstance(child, QATLinear):
+                return QuantizedLinear(child.inner,
+                                       child.observed_act_scale(),
+                                       self.weight_bits,
+                                       self.activation_bits)
+            if isinstance(child, QATConv2D):
+                return QuantizedConv2D(child.inner,
+                                       child.observed_act_scale(),
+                                       self.weight_bits,
+                                       self.activation_bits)
+            return None
+
+        _walk_replace(model, _swap)
+        return model
+
+    def save_quantized_model(self, layer, path, input_spec=None):
+        from .. import jit
+
+        self.convert(layer)
+        return jit.save(layer, path, input_spec=input_spec)
+
+
+class PostTrainingQuantization:
+    """Reference post_training_quantization.py:97, reshaped for the layer
+    world: feed a dygraph model + data loader instead of a saved static
+    program (the XLA graph cannot be mutated post-hoc; the rewritten model
+    re-traces to int8 HLO). algo: abs_max | avg | hist | KL | mse.
+    """
+
+    def __init__(self, executor=None, model=None, data_loader=None,
+                 sample_generator=None, batch_generator=None, scope=None,
+                 model_dir=None, model_filename=None, params_filename=None,
+                 batch_size=10, batch_nums=None, algo="hist",
+                 hist_percent=0.99999,
+                 quantizable_op_type=("conv2d", "mul", "matmul"),
+                 is_full_quantize=False, activation_bits=8, weight_bits=8,
+                 activation_quantize_type=None,
+                 weight_quantize_type="channel_wise_abs_max",
+                 onnx_format=False, **unused):
+        if model is None:
+            raise ValueError(
+                "PostTrainingQuantization on paddle_tpu takes the dygraph "
+                "`model=` directly (static program mutation does not exist "
+                "on the XLA path; see module docstring)")
+        if data_loader is None:
+            raise ValueError("data_loader is required for calibration")
+        algo = {"kl": "hist", "avg": "moving_average_abs_max",
+                "abs_max": "abs_max", "hist": "hist",
+                "mse": "hist"}.get(str(algo).lower(), "abs_max")
+        self.model = model
+        self.loader = data_loader
+        self.batch_nums = batch_nums
+        self.cfg = QuantConfig(
+            activation_quantize_type=activation_quantize_type or algo,
+            weight_quantize_type=weight_quantize_type,
+            activation_bits=activation_bits, weight_bits=weight_bits,
+            hist_percent=hist_percent)
+        self._ptq = ImperativePTQ(self.cfg)
+
+    def quantize(self):
+        from ..core.autograd import no_grad
+
+        self._ptq.quantize(self.model)
+        self.model.eval()
+        with no_grad():
+            for i, batch in enumerate(self.loader):
+                xs = batch[0] if isinstance(batch, (list, tuple)) else batch
+                self.model(xs)
+                if self.batch_nums and i + 1 >= self.batch_nums:
+                    break
+        self._ptq.convert(self.model)
+        return self.model
+
+    def save_quantized_model(self, save_model_path, model_filename=None,
+                             params_filename=None):
+        from .. import jit
+
+        return jit.save(self.model, save_model_path)

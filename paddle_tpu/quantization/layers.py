@@ -1,0 +1,192 @@
+"""Quantized / fake-quantized layers.
+
+Reference: python/paddle/fluid/contrib/slim/quantization/imperative/qat.py:1
+(QuantizedConv2D, QuantizedLinear with FakeQuantAbsMax wrappers) and
+quantization_pass.py:1 (quantize_dequantize op rewrites).
+
+TPU-native: real int8 execution maps onto XLA's integer dot_general /
+convolution with `preferred_element_type=int32` — the MXU's native int8
+path on TPU (the reference instead relies on cuDNN/MKLDNN int8 kernels).
+Fake-quant (QAT) uses the straight-through estimator expressed as
+`x + stop_gradient(qdq(x) - x)`, which XLA fuses into the surrounding
+computation.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.autograd import apply
+from ..nn.layer.layers import Layer
+
+__all__ = ["QuantizedLinear", "QuantizedConv2D", "QATLinear", "QATConv2D",
+           "quantize_weight", "fake_quant"]
+
+
+def _qmax(bits):
+    return float(2 ** (bits - 1) - 1)
+
+
+def quantize_weight(w, bits=8, channel_axis=None):
+    """float weight -> (int8 array, float scale). Per-channel when
+    channel_axis is given (reference channel_wise_abs_max)."""
+    w = np.asarray(jax.device_get(w), np.float32)
+    qm = _qmax(bits)
+    if channel_axis is None:
+        scale = max(float(np.abs(w).max()), 1e-8) / qm
+    else:
+        red = tuple(i for i in range(w.ndim) if i != channel_axis % w.ndim)
+        scale = np.maximum(np.abs(w).max(axis=red), 1e-8) / qm
+        shape = [1] * w.ndim
+        shape[channel_axis % w.ndim] = -1
+        scale = scale.reshape(shape)
+    q = np.clip(np.round(w / scale), -qm - 1, qm).astype(np.int8)
+    return q, np.asarray(scale, np.float32)
+
+
+def fake_quant(x, scale, bits=8):
+    """Quantize-dequantize with straight-through gradients (tape op)."""
+    qm = _qmax(bits)
+
+    def _qdq(v, s):
+        s = jnp.maximum(s, 1e-8) / qm
+        qdq = jnp.clip(jnp.round(v / s), -qm - 1, qm) * s
+        return v + jax.lax.stop_gradient(qdq - v)
+
+    return apply(_qdq, x, scale)
+
+
+def _int8_matmul(xv, w_q, w_scale, a_scale, bits):
+    """[.., in] @ int8[in, out] with int32 accumulation on the MXU."""
+    qm = _qmax(bits)
+    inv = qm / jnp.maximum(a_scale, 1e-8)
+    x_q = jnp.clip(jnp.round(xv.astype(jnp.float32) * inv),
+                   -qm - 1, qm).astype(jnp.int8)
+    acc = jax.lax.dot_general(
+        x_q, w_q, (((x_q.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32)
+    out_scale = (a_scale / qm) * w_scale.reshape(-1)  # [out]
+    return acc.astype(jnp.float32) * out_scale
+
+
+class QuantizedLinear(Layer):
+    """Int8 inference Linear (weight int8 per-out-channel, activation scale
+    from calibration). Reference imperative/qat.py QuantizedLinear."""
+
+    def __init__(self, linear, act_scale, weight_bits=8, act_bits=8):
+        super().__init__()
+        self.bits = weight_bits
+        self.act_bits = act_bits
+        w_q, w_scale = quantize_weight(linear.weight._value, weight_bits,
+                                       channel_axis=1)  # [in, out]
+        self._w_q = jnp.asarray(w_q)
+        self._w_scale = jnp.asarray(w_scale)
+        self._a_scale = jnp.float32(float(np.asarray(act_scale)))
+        self.bias = getattr(linear, "bias", None)
+        self.name = getattr(linear, "name", None)
+
+    def forward(self, x):
+        out = apply(lambda v: _int8_matmul(v, self._w_q, self._w_scale,
+                                           self._a_scale, self.act_bits), x)
+        if self.bias is not None:
+            out = out + self.bias
+        return out
+
+
+class QuantizedConv2D(Layer):
+    """Int8 inference Conv2D: integer convolution, int32 accumulation.
+    Reference imperative/qat.py QuantizedConv2D."""
+
+    def __init__(self, conv, act_scale, weight_bits=8, act_bits=8):
+        super().__init__()
+        self.act_bits = act_bits
+        w_q, w_scale = quantize_weight(conv.weight._value, weight_bits,
+                                       channel_axis=0)  # [out, in, kh, kw]
+        self._w_q = jnp.asarray(w_q)
+        self._w_scale = jnp.asarray(w_scale)  # [out,1,1,1]
+        self._a_scale = jnp.float32(float(np.asarray(act_scale)))
+        self.bias = getattr(conv, "bias", None)
+        self._stride = conv._stride
+        self._padding = conv._padding
+        self._dilation = conv._dilation
+        self._groups = conv._groups
+        self._data_format = conv._data_format
+
+    def forward(self, x):
+        from ..nn.functional.conv import _norm_padding, _norm_tuple
+
+        qm = _qmax(self.act_bits)
+        stride = _norm_tuple(self._stride, 2)
+        dilation = _norm_tuple(self._dilation, 2)
+        pad = _norm_padding(self._padding, 2)
+        groups = self._groups
+        dn = jax.lax.conv_dimension_numbers(
+            (1, 1, 1, 1), (1, 1, 1, 1), ("NCHW", "OIHW", "NCHW"))
+
+        def _q_conv(v):
+            inv = qm / jnp.maximum(self._a_scale, 1e-8)
+            x_q = jnp.clip(jnp.round(v.astype(jnp.float32) * inv),
+                           -qm - 1, qm).astype(jnp.int8)
+            acc = jax.lax.conv_general_dilated(
+                x_q, self._w_q, window_strides=stride, padding=pad,
+                rhs_dilation=dilation, dimension_numbers=dn,
+                feature_group_count=groups,
+                preferred_element_type=jnp.int32)
+            scale = (self._a_scale / qm) * \
+                self._w_scale.reshape(1, -1, 1, 1)
+            return acc.astype(jnp.float32) * scale
+
+        out = apply(_q_conv, x)
+        if self.bias is not None:
+            out = out + self.bias.reshape([1, -1, 1, 1])
+        return out
+
+
+class _QATBase(Layer):
+    """Fake-quant training wrapper: weight abs-max fake-quant + activation
+    EMA fake-quant, straight-through gradients (reference qat.py
+    FakeQuantAbsMax/FakeQuantMovingAverageAbsMax)."""
+
+    def __init__(self, layer, weight_bits=8, act_bits=8, moving_rate=0.9):
+        super().__init__()
+        self.inner = layer
+        self.weight_bits = weight_bits
+        self.act_bits = act_bits
+        self.rate = moving_rate
+        self._act_state = None  # python float EMA, updated eagerly
+
+    def _act_scale(self, x):
+        if self.training and not isinstance(x._value, jax.core.Tracer):
+            m = float(jnp.max(jnp.abs(x._value.astype(jnp.float32))))
+            self._act_state = m if self._act_state is None else \
+                self.rate * self._act_state + (1 - self.rate) * m
+        return jnp.float32(max(self._act_state or 1.0, 1e-8))
+
+    def observed_act_scale(self):
+        return np.float32(max(self._act_state or 1.0, 1e-8))
+
+
+class QATLinear(_QATBase):
+    def forward(self, x):
+        w = fake_quant(self.inner.weight,
+                       jnp.max(jnp.abs(self.inner.weight._value)),
+                       self.weight_bits)
+        x = fake_quant(x, self._act_scale(x), self.act_bits)
+        out = x @ w
+        if self.inner.bias is not None:
+            out = out + self.inner.bias
+        return out
+
+
+class QATConv2D(_QATBase):
+    def forward(self, x):
+        from ..nn import functional as F
+
+        w = fake_quant(self.inner.weight,
+                       jnp.max(jnp.abs(self.inner.weight._value)),
+                       self.weight_bits)
+        x = fake_quant(x, self._act_scale(x), self.act_bits)
+        c = self.inner
+        return F.conv2d(x, w, c.bias, c._stride, c._padding, c._dilation,
+                        c._groups, c._data_format)

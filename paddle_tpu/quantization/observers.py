@@ -1,0 +1,138 @@
+"""Calibration observers — collect activation/weight ranges for PTQ/QAT.
+
+Reference: python/paddle/fluid/contrib/slim/quantization/imperative/
+ptq_quantizer.py:1 (AbsmaxQuantizer, HistQuantizer, KLQuantizer,
+PerChannelAbsmaxQuantizer) and quantization_pass.py:1 (abs_max /
+moving_average_abs_max / channel_wise_abs_max strategies).
+
+TPU-native: the stat reduction (max|x|, histogram) runs on-device as a
+jit-cached XLA reduction during the calibration sweep; only the scalar
+result crosses to the host. Scales are plain numpy on the host — they are
+compile-time constants of the quantized program.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["AbsmaxObserver", "MovingAverageAbsmaxObserver",
+           "PerChannelAbsmaxObserver", "HistObserver", "build_observer"]
+
+
+@jax.jit
+def _absmax(x):
+    return jnp.max(jnp.abs(x.astype(jnp.float32)))
+
+
+def _absmax_axis(x, axis):
+    red = tuple(i for i in range(x.ndim) if i != axis)
+    return jnp.max(jnp.abs(x.astype(jnp.float32)), axis=red)
+
+
+class AbsmaxObserver:
+    """scale = max |x| over every calibration batch."""
+
+    def __init__(self, bits=8):
+        self.bits = bits
+        self._max = 0.0
+
+    def update(self, value):
+        self._max = max(self._max, float(_absmax(value)))
+
+    def scale(self):
+        return np.float32(max(self._max, 1e-8))
+
+
+class MovingAverageAbsmaxObserver:
+    """scale = EMA of per-batch max |x| (reference moving_average_abs_max,
+    quantization_pass.py:1 — state update folded into the eval sweep)."""
+
+    def __init__(self, bits=8, moving_rate=0.9):
+        self.bits = bits
+        self.rate = moving_rate
+        self._state = None
+
+    def update(self, value):
+        m = float(_absmax(value))
+        self._state = m if self._state is None else \
+            self.rate * self._state + (1.0 - self.rate) * m
+
+    def scale(self):
+        return np.float32(max(self._state or 0.0, 1e-8))
+
+
+class PerChannelAbsmaxObserver:
+    """Per-output-channel |w|max (reference channel_wise_abs_max)."""
+
+    def __init__(self, bits=8, axis=-1):
+        self.bits = bits
+        self.axis = axis
+        self._max = None
+
+    def update(self, value):
+        m = np.asarray(_absmax_axis(jnp.asarray(value),
+                                    self.axis % value.ndim))
+        self._max = m if self._max is None else np.maximum(self._max, m)
+
+    def scale(self):
+        return np.maximum(self._max, 1e-8).astype(np.float32)
+
+
+class HistObserver:
+    """Percentile-of-histogram scale (reference HistQuantizer /
+    hist_percent; the KL algo of post_training_quantization.py:115 selects
+    a threshold from the same histogram — `algo="KL"` maps here with the
+    percentile criterion, documented TPU-native simplification)."""
+
+    def __init__(self, bits=8, bins=2048, percent=0.99999):
+        self.bits = bits
+        self.bins = bins
+        self.percent = percent
+        self._hist = None
+        self._edge = None
+
+    def update(self, value):
+        v = np.abs(np.asarray(jax.device_get(value), np.float32)).ravel()
+        top = float(v.max()) if v.size else 0.0
+        if top <= 0.0:
+            return
+        if self._hist is None:
+            self._edge = max(top, 1e-8)
+            self._hist, _ = np.histogram(v, bins=self.bins,
+                                         range=(0.0, self._edge))
+            return
+        if top > self._edge:  # re-bin the old histogram onto a wider range
+            ratio = top / self._edge
+            idx = np.minimum(
+                (np.arange(self.bins) * (1.0 / ratio)).astype(np.int64),
+                self.bins - 1)
+            wide = np.zeros(self.bins, np.int64)
+            np.add.at(wide, idx, 0)  # keep dtype
+            new = np.zeros(self.bins, np.int64)
+            np.add.at(new, idx, self._hist)
+            self._hist = new + wide
+            self._edge = top
+        h, _ = np.histogram(v, bins=self.bins, range=(0.0, self._edge))
+        self._hist = self._hist + h
+
+    def scale(self):
+        if self._hist is None:
+            return np.float32(1e-8)
+        cdf = np.cumsum(self._hist) / max(self._hist.sum(), 1)
+        k = int(np.searchsorted(cdf, self.percent))
+        k = min(k, self.bins - 1)
+        return np.float32(max((k + 1) * self._edge / self.bins, 1e-8))
+
+
+def build_observer(kind, bits=8, **kw):
+    kind = (kind or "abs_max").lower()
+    if kind in ("abs_max", "absmax", "range_abs_max"):
+        return AbsmaxObserver(bits)
+    if kind in ("moving_average_abs_max", "ema"):
+        return MovingAverageAbsmaxObserver(bits, kw.get("moving_rate", 0.9))
+    if kind in ("channel_wise_abs_max", "per_channel"):
+        return PerChannelAbsmaxObserver(bits, kw.get("axis", -1))
+    if kind in ("hist", "kl", "hist_percent"):
+        return HistObserver(bits, percent=kw.get("hist_percent", 0.99999))
+    raise ValueError(f"unknown observer kind {kind!r}")

@@ -366,3 +366,9 @@ class IpuStrategy:
 class IpuCompiledProgram:
     def __init__(self, *a, **kw):
         raise NotImplementedError("IPU backend is not part of this build")
+
+
+# paddle.static.quantization namespace (reference exposes the slim
+# quantization passes under paddle.static in 2.4+; the 2.3 tree keeps them
+# in fluid/contrib/slim/quantization — same classes either way)
+from .. import quantization as quantization  # noqa: E402,F401

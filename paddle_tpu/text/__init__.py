@@ -1,127 +1,16 @@
-"""paddle.text (reference: python/paddle/text): datasets with synthetic
-fallback (zero-egress image)."""
+"""paddle.text (reference: python/paddle/text): dataset parsers (real
+reference file formats — see datasets.py) + viterbi decode."""
 from __future__ import annotations
 
 import numpy as np
 
-from ..io import Dataset
+from .datasets import (  # noqa: F401
+    Conll05st, Imdb, Imikolov, Movielens, UCIHousing, WMT14, WMT16,
+)
+from . import datasets  # noqa: F401
 
-__all__ = ["Conll05st", "Imdb", "Imikolov", "Movielens", "UCIHousing", "WMT14", "WMT16",
-           "ViterbiDecoder", "viterbi_decode"]
-
-
-class _SyntheticTextDataset(Dataset):
-    N = 512
-    VOCAB = 1000
-    SEQ = 64
-
-    def __init__(self, mode="train", **kw):
-        self.mode = mode
-        self._seed = {"train": 0, "test": 99}.get(mode, 0)
-
-    def __len__(self):
-        return self.N if self.mode == "train" else self.N // 4
-
-    def __getitem__(self, idx):
-        rng = np.random.RandomState(self._seed + idx)
-        seq = rng.randint(1, self.VOCAB, self.SEQ).astype(np.int64)
-        label = np.asarray(int(seq.sum()) % 2, np.int64)
-        return seq, label
-
-
-class Imdb(_SyntheticTextDataset):
-    def __init__(self, data_file=None, mode="train", cutoff=150,
-                 download=True):
-        super().__init__(mode)
-
-
-class Imikolov(_SyntheticTextDataset):
-    SEQ = 5
-
-    def __init__(self, data_file=None, data_type="NGRAM", window_size=5,
-                 mode="train", min_word_freq=50, download=True):
-        super().__init__(mode)
-        self.SEQ = window_size
-
-
-class Movielens(_SyntheticTextDataset):
-    def __init__(self, data_file=None, mode="train", test_ratio=0.1,
-                 rand_seed=0, download=True):
-        super().__init__(mode)
-
-    def __getitem__(self, idx):
-        rng = np.random.RandomState(self._seed + idx)
-        user = rng.randint(0, 6040, 1).astype(np.int64)
-        movie = rng.randint(0, 3952, 1).astype(np.int64)
-        rating = np.asarray([float(rng.randint(1, 6))], np.float32)
-        return user, movie, rating
-
-
-class UCIHousing(Dataset):
-    def __init__(self, data_file=None, mode="train", download=True):
-        rng = np.random.RandomState(0 if mode == "train" else 1)
-        n = 404 if mode == "train" else 102
-        self.x = rng.rand(n, 13).astype(np.float32)
-        w = rng.rand(13, 1).astype(np.float32)
-        self.y = (self.x @ w + 0.1 * rng.randn(n, 1)).astype(np.float32)
-
-    def __len__(self):
-        return len(self.x)
-
-    def __getitem__(self, idx):
-        return self.x[idx], self.y[idx]
-
-
-class Conll05st(_SyntheticTextDataset):
-    """CoNLL-2005 SRL dataset (reference: text/datasets/conll05.py).
-    Synthetic fallback: returns the reference's 9-field sample layout
-    (word_ids, 6 predicate-context slots, mark_ids, label_ids)."""
-    VOCAB = 4000
-    SEQ = 30
-    N_LABELS = 67
-
-    def __init__(self, data_file=None, word_dict_file=None,
-                 verb_dict_file=None, target_dict_file=None, emb_file=None,
-                 mode="train", download=True):
-        super().__init__(mode)
-
-    def __getitem__(self, idx):
-        rng = np.random.RandomState(self._seed + idx)
-        words = rng.randint(1, self.VOCAB, self.SEQ).astype(np.int64)
-        ctxs = [rng.randint(1, self.VOCAB, self.SEQ).astype(np.int64)
-                for _ in range(6)]
-        mark = (rng.rand(self.SEQ) < 0.1).astype(np.int64)
-        labels = rng.randint(0, self.N_LABELS, self.SEQ).astype(np.int64)
-        return (words, *ctxs, mark, labels)
-
-    def get_dict(self):
-        word = {f"w{i}": i for i in range(self.VOCAB)}
-        verb = {f"v{i}": i for i in range(50)}
-        label = {f"l{i}": i for i in range(self.N_LABELS)}
-        return word, verb, label
-
-    def get_embedding(self):
-        return np.random.RandomState(7).rand(self.VOCAB, 32).astype(
-            np.float32)
-
-
-class WMT14(_SyntheticTextDataset):
-    def __init__(self, data_file=None, mode="train", dict_size=30000,
-                 download=True):
-        super().__init__(mode)
-        self.VOCAB = dict_size
-
-    def __getitem__(self, idx):
-        rng = np.random.RandomState(self._seed + idx)
-        src = rng.randint(1, self.VOCAB, 20).astype(np.int64)
-        tgt = rng.randint(1, self.VOCAB, 20).astype(np.int64)
-        return src, tgt[:-1], tgt[1:]
-
-
-class WMT16(WMT14):
-    def __init__(self, data_file=None, mode="train", src_dict_size=30000,
-                 trg_dict_size=30000, lang="en", download=True):
-        super().__init__(data_file, mode, src_dict_size, download)
+__all__ = ["Conll05st", "Imdb", "Imikolov", "Movielens", "UCIHousing",
+           "WMT14", "WMT16", "ViterbiDecoder", "viterbi_decode"]
 
 
 def viterbi_decode(potentials, transition_params, lengths=None,

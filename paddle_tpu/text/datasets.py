@@ -1,0 +1,812 @@
+"""paddle.text.datasets — real file-format parsers.
+
+Reference: python/paddle/text/datasets/{imdb,imikolov,movielens,
+uci_housing,conll05,wmt14,wmt16}.py — each class here parses the SAME
+archive layouts (tar/zip/column formats) with the same dictionary-building
+and id-mapping rules.
+
+Zero-egress environment: when `data_file` is None the reference would
+download; here a deterministic synthetic corpus is written in the exact
+reference archive format to a cache dir and parsed through the SAME parser
+code path — so the parsers are always exercised, and a user with the real
+files gets the real datasets.
+"""
+from __future__ import annotations
+
+import collections
+import gzip
+import io
+import os
+import re
+import string
+import tarfile
+import tempfile
+import zipfile
+
+import numpy as np
+
+from ..io import Dataset
+
+__all__ = ["Imdb", "Imikolov", "Movielens", "UCIHousing", "Conll05st",
+           "WMT14", "WMT16"]
+
+_CACHE = None
+
+
+def _cache_dir():
+    global _CACHE
+    if _CACHE is None:
+        _CACHE = tempfile.mkdtemp(prefix="paddle_tpu_text_")
+    return _CACHE
+
+
+def _synth_words(rng, vocab, n):
+    return " ".join(f"w{rng.randint(0, vocab)}" for _ in range(n))
+
+
+# --------------------------------------------------------------------------
+# Imdb — aclImdb tar layout (reference imdb.py:40)
+# --------------------------------------------------------------------------
+
+def _synth_imdb_tar():
+    path = os.path.join(_cache_dir(), "aclImdb_synth.tar.gz")
+    if os.path.exists(path):
+        return path
+    rng = np.random.RandomState(0)
+    with tarfile.open(path, "w:gz") as tf:
+        for split in ("train", "test"):
+            n = 40 if split == "train" else 10
+            for cls, marker in (("pos", "good"), ("neg", "bad")):
+                for i in range(n):
+                    text = (f"{marker} movie " +
+                            _synth_words(rng, 8, 40)).encode()
+                    info = tarfile.TarInfo(
+                        f"aclImdb/{split}/{cls}/{i}.txt")
+                    info.size = len(text)
+                    tf.addfile(info, io.BytesIO(text))
+    return path
+
+
+class Imdb(Dataset):
+    """IMDB sentiment (reference imdb.py:40): tar of
+    aclImdb/{train,test}/{pos,neg}/*.txt; word dict built over the whole
+    corpus with `cutoff` frequency, docs mapped to ids; pos=0, neg=1."""
+
+    def __init__(self, data_file=None, mode="train", cutoff=150,
+                 download=True):
+        assert mode.lower() in ("train", "test"), mode
+        self.mode = mode.lower()
+        if data_file is None:
+            data_file = _synth_imdb_tar()
+            cutoff = min(cutoff, 20)  # tiny synthetic corpus
+        self.data_file = data_file
+        self.word_idx = self._build_work_dict(cutoff)
+        self._load_anno()
+
+    def _tokenize(self, pattern):
+        data = []
+        table = {ord(c): None for c in string.punctuation}
+        with tarfile.open(self.data_file) as tarf:
+            tf = tarf.next()
+            while tf is not None:
+                if bool(pattern.match(tf.name)):
+                    text = tarf.extractfile(tf).read().decode(
+                        "utf-8", "ignore").rstrip("\n\r")
+                    data.append(text.translate(table).lower().split())
+                tf = tarf.next()
+        return data
+
+    def _build_work_dict(self, cutoff):
+        word_freq = collections.defaultdict(int)
+        pattern = re.compile(r"aclImdb/((train)|(test))/((pos)|(neg))/.*\.txt$")
+        for doc in self._tokenize(pattern):
+            for word in doc:
+                word_freq[word] += 1
+        word_freq = [x for x in word_freq.items() if x[1] > cutoff]
+        dictionary = sorted(word_freq, key=lambda x: (-x[1], x[0]))
+        words = [w for w, _ in dictionary]
+        word_idx = dict(zip(words, range(len(words))))
+        word_idx["<unk>"] = len(words)
+        return word_idx
+
+    def _load_anno(self):
+        pos = re.compile(r"aclImdb/{}/pos/.*\.txt$".format(self.mode))
+        neg = re.compile(r"aclImdb/{}/neg/.*\.txt$".format(self.mode))
+        unk = self.word_idx["<unk>"]
+        self.docs, self.labels = [], []
+        for doc in self._tokenize(pos):
+            self.docs.append([self.word_idx.get(w, unk) for w in doc])
+            self.labels.append(0)
+        for doc in self._tokenize(neg):
+            self.docs.append([self.word_idx.get(w, unk) for w in doc])
+            self.labels.append(1)
+
+    def __getitem__(self, idx):
+        return (np.array(self.docs[idx]), np.array([self.labels[idx]]))
+
+    def __len__(self):
+        return len(self.docs)
+
+
+# --------------------------------------------------------------------------
+# Imikolov — PTB tar layout (reference imikolov.py:75)
+# --------------------------------------------------------------------------
+
+def _synth_ptb_tar():
+    path = os.path.join(_cache_dir(), "ptb_synth.tar.gz")
+    if os.path.exists(path):
+        return path
+    rng = np.random.RandomState(1)
+    with tarfile.open(path, "w:gz") as tf:
+        for split, n in (("train", 120), ("valid", 30), ("test", 30)):
+            lines = "\n".join(_synth_words(rng, 12, rng.randint(4, 12))
+                              for _ in range(n)).encode()
+            info = tarfile.TarInfo(
+                f"./simple-examples/data/ptb.{split}.txt")
+            info.size = len(lines)
+            tf.addfile(info, io.BytesIO(lines))
+    return path
+
+
+class Imikolov(Dataset):
+    """PTB n-gram / seq dataset (reference imikolov.py:75): dict from
+    ptb.train+ptb.valid with min_word_freq, data from ptb.{mode}.txt as
+    window_size-grams (NGRAM) or <s>/<e>-wrapped seq pairs (SEQ)."""
+
+    def __init__(self, data_file=None, data_type="NGRAM", window_size=-1,
+                 mode="train", min_word_freq=50, download=True):
+        assert data_type.upper() in ("NGRAM", "SEQ"), data_type
+        assert mode.lower() in ("train", "test"), mode
+        self.data_type = data_type.upper()
+        self.mode = mode.lower()
+        self.window_size = window_size
+        if data_file is None:
+            data_file = _synth_ptb_tar()
+            min_word_freq = min(min_word_freq, 5)
+        self.data_file = data_file
+        self.min_word_freq = min_word_freq
+        self.word_idx = self._build_work_dict(self.min_word_freq)
+        self._load_anno()
+
+    @staticmethod
+    def _word_count(f, word_freq=None):
+        if word_freq is None:
+            word_freq = collections.defaultdict(int)
+        for line in f:
+            for w in line.decode("utf-8", "ignore").strip().split():
+                word_freq[w] += 1
+            word_freq["<s>"] += 1
+            word_freq["<e>"] += 1
+        return word_freq
+
+    def _build_work_dict(self, cutoff):
+        with tarfile.open(self.data_file) as tf:
+            trainf = tf.extractfile("./simple-examples/data/ptb.train.txt")
+            testf = tf.extractfile("./simple-examples/data/ptb.valid.txt")
+            word_freq = self._word_count(testf, self._word_count(trainf))
+            word_freq.pop("<unk>", None)
+            word_freq = [x for x in word_freq.items() if x[1] > cutoff]
+            word_freq = sorted(word_freq, key=lambda x: (-x[1], x[0]))
+            words = [w for w, _ in word_freq]
+            word_idx = dict(zip(words, range(len(words))))
+            word_idx["<unk>"] = len(words)
+        return word_idx
+
+    def _load_anno(self):
+        self.data = []
+        unk = self.word_idx["<unk>"]
+        with tarfile.open(self.data_file) as tf:
+            f = tf.extractfile(
+                f"./simple-examples/data/ptb.{self.mode}.txt")
+            for line in f:
+                line = line.decode("utf-8", "ignore")
+                if self.data_type == "NGRAM":
+                    assert self.window_size > 0, "Invalid gram length"
+                    toks = ["<s>"] + line.strip().split() + ["<e>"]
+                    if len(toks) >= self.window_size:
+                        ids = [self.word_idx.get(w, unk) for w in toks]
+                        for i in range(self.window_size, len(ids) + 1):
+                            self.data.append(
+                                tuple(ids[i - self.window_size:i]))
+                else:
+                    toks = line.strip().split()
+                    ids = [self.word_idx.get(w, unk) for w in toks]
+                    src = [self.word_idx["<s>"]] + ids
+                    trg = ids + [self.word_idx["<e>"]]
+                    if self.window_size > 0 and len(src) > self.window_size:
+                        continue
+                    self.data.append((src, trg))
+
+    def __getitem__(self, idx):
+        return tuple(np.array(d) for d in self.data[idx])
+
+    def __len__(self):
+        return len(self.data)
+
+
+# --------------------------------------------------------------------------
+# Movielens — ml-1m zip layout (reference movielens.py:110)
+# --------------------------------------------------------------------------
+
+age_table = [1, 18, 25, 35, 45, 50, 56]
+
+
+class MovieInfo:
+    def __init__(self, index, categories, title):
+        self.index = int(index)
+        self.categories = categories
+        self.title = title
+
+    def value(self, categories_dict, movie_title_dict):
+        return [[self.index],
+                [categories_dict[c] for c in self.categories],
+                [movie_title_dict[w.lower()] for w in self.title.split()]]
+
+
+class UserInfo:
+    def __init__(self, index, gender, age, job_id):
+        self.index = int(index)
+        self.is_male = gender == "M"
+        self.age = age_table.index(int(age))
+        self.job_id = int(job_id)
+
+    def value(self):
+        return [[self.index], [0 if self.is_male else 1], [self.age],
+                [self.job_id]]
+
+
+def _synth_ml1m_zip():
+    path = os.path.join(_cache_dir(), "ml1m_synth.zip")
+    if os.path.exists(path):
+        return path
+    rng = np.random.RandomState(2)
+    cats = ["Action", "Comedy", "Drama"]
+    movies, users, ratings = [], [], []
+    for mid in range(1, 31):
+        c = "|".join(sorted({cats[rng.randint(3)], cats[rng.randint(3)]}))
+        movies.append(f"{mid}::Title {mid} (1999)::{c}")
+    for uid in range(1, 21):
+        users.append(f"{uid}::{'MF'[rng.randint(2)]}::"
+                     f"{age_table[rng.randint(len(age_table))]}::"
+                     f"{rng.randint(0, 21)}::00000")
+    for _ in range(300):
+        ratings.append(f"{rng.randint(1, 21)}::{rng.randint(1, 31)}::"
+                       f"{rng.randint(1, 6)}::978300760")
+    with zipfile.ZipFile(path, "w") as z:
+        z.writestr("ml-1m/movies.dat", "\n".join(movies) + "\n")
+        z.writestr("ml-1m/users.dat", "\n".join(users) + "\n")
+        z.writestr("ml-1m/ratings.dat", "\n".join(ratings) + "\n")
+    return path
+
+
+class Movielens(Dataset):
+    """ML-1M (reference movielens.py:110): '::'-separated movies/users/
+    ratings .dat inside a zip; sample = user fields + movie fields +
+    scaled rating."""
+
+    def __init__(self, data_file=None, mode="train", test_ratio=0.1,
+                 rand_seed=0, download=True):
+        assert mode.lower() in ("train", "test"), mode
+        self.mode = mode.lower()
+        self.data_file = data_file or _synth_ml1m_zip()
+        self.test_ratio = test_ratio
+        self.rand_seed = rand_seed
+        np.random.seed(rand_seed)
+        self._load_meta_info()
+        self._load_data()
+
+    def _load_meta_info(self):
+        pattern = re.compile(r"^(.*)\((\d+)\)$")
+        self.movie_info, self.user_info = {}, {}
+        self.movie_title_dict, self.categories_dict = {}, {}
+        title_words, cat_set = set(), set()
+        with zipfile.ZipFile(self.data_file) as package:
+            with package.open("ml-1m/movies.dat") as f:
+                for line in f:
+                    line = line.decode("latin")
+                    mid, title, cats = line.strip().split("::")
+                    cats = cats.split("|")
+                    cat_set.update(cats)
+                    title = pattern.match(title).group(1).strip()
+                    self.movie_info[int(mid)] = MovieInfo(mid, cats, title)
+                    title_words.update(w.lower() for w in title.split())
+            for i, w in enumerate(sorted(title_words)):
+                self.movie_title_dict[w] = i
+            for i, c in enumerate(sorted(cat_set)):
+                self.categories_dict[c] = i
+            with package.open("ml-1m/users.dat") as f:
+                for line in f:
+                    line = line.decode("latin")
+                    uid, gender, age, job, _ = line.strip().split("::")
+                    self.user_info[int(uid)] = UserInfo(uid, gender, age,
+                                                        job)
+
+    def _load_data(self):
+        self.data = []
+        is_test = self.mode == "test"
+        with zipfile.ZipFile(self.data_file) as package:
+            with package.open("ml-1m/ratings.dat") as f:
+                for line in f:
+                    line = line.decode("latin")
+                    if (np.random.random() < self.test_ratio) != is_test:
+                        continue
+                    uid, mid, rating, _ = line.strip().split("::")
+                    mov = self.movie_info[int(mid)]
+                    usr = self.user_info[int(uid)]
+                    self.data.append(
+                        usr.value()
+                        + mov.value(self.categories_dict,
+                                    self.movie_title_dict)
+                        + [[float(rating) * 2 - 5.0]])
+
+    def __getitem__(self, idx):
+        return tuple(np.array(d) for d in self.data[idx])
+
+    def __len__(self):
+        return len(self.data)
+
+
+# --------------------------------------------------------------------------
+# UCIHousing — whitespace floats, 14 columns (reference uci_housing.py:80)
+# --------------------------------------------------------------------------
+
+def _synth_housing_file():
+    path = os.path.join(_cache_dir(), "housing_synth.data")
+    if os.path.exists(path):
+        return path
+    rng = np.random.RandomState(3)
+    x = rng.rand(506, 13)
+    w = rng.rand(13, 1)
+    y = x @ w + 0.05 * rng.randn(506, 1)
+    data = np.concatenate([x, y], axis=1)
+    with open(path, "w") as f:
+        for row in data:
+            f.write(" ".join(f"{v:.6f}" for v in row) + "\n")
+    return path
+
+
+class UCIHousing(Dataset):
+    """Boston housing (reference uci_housing.py:80): 14 whitespace floats
+    per sample, feature-wise (x-avg)/(max-min) normalization, 80/20
+    train/test split."""
+
+    def __init__(self, data_file=None, mode="train", download=True):
+        assert mode.lower() in ("train", "test"), mode
+        self.mode = mode.lower()
+        self.data_file = data_file or _synth_housing_file()
+        self._load_data()
+        from ..core.dtype import get_default_dtype
+
+        self.dtype = get_default_dtype()
+
+    def _load_data(self, feature_num=14, ratio=0.8):
+        data = np.fromfile(self.data_file, sep=" ")
+        data = data.reshape(data.shape[0] // feature_num, feature_num)
+        maximums = data.max(axis=0)
+        minimums = data.min(axis=0)
+        avgs = data.sum(axis=0) / data.shape[0]
+        for i in range(feature_num - 1):
+            data[:, i] = (data[:, i] - avgs[i]) / (maximums[i] - minimums[i])
+        offset = int(data.shape[0] * ratio)
+        self.data = data[:offset] if self.mode == "train" else data[offset:]
+
+    def __getitem__(self, idx):
+        row = self.data[idx]
+        return (np.array(row[:-1]).astype(self.dtype),
+                np.array(row[-1:]).astype(self.dtype))
+
+    def __len__(self):
+        return len(self.data)
+
+
+# --------------------------------------------------------------------------
+# Conll05st — SRL props format (reference conll05.py:110)
+# --------------------------------------------------------------------------
+
+def _synth_conll_files():
+    base = _cache_dir()
+    tar_path = os.path.join(base, "conll05_synth.tar")
+    wdict = os.path.join(base, "conll05_words.dict")
+    vdict = os.path.join(base, "conll05_verbs.dict")
+    tdict = os.path.join(base, "conll05_targets.dict")
+    emb = os.path.join(base, "conll05_emb")
+    if os.path.exists(tar_path):
+        return tar_path, wdict, vdict, tdict, emb
+    rng = np.random.RandomState(4)
+    nouns = [f"n{i}" for i in range(20)]
+    verbs = [f"v{i}" for i in range(6)]
+    words_lines, props_lines = [], []
+    for _ in range(25):
+        ln = rng.randint(4, 8)
+        verb_pos = rng.randint(1, ln - 1)
+        verb = verbs[rng.randint(len(verbs))]
+        sent = [nouns[rng.randint(len(nouns))] for _ in range(ln)]
+        sent[verb_pos] = verb
+        for i in range(ln):
+            props = verb if i == verb_pos else "-"
+            if i == 0:
+                tag = "(A0*" if verb_pos > 1 else "(A0*)"
+            elif i < verb_pos - 1:
+                tag = "*"
+            elif i == verb_pos - 1 and verb_pos > 1:
+                tag = "*)"
+            elif i == verb_pos:
+                tag = "(V*)"
+            elif i == verb_pos + 1:
+                tag = "(A1*)" if i == ln - 1 else "(A1*"
+            elif i == ln - 1:
+                tag = "*)"
+            else:
+                tag = "*"
+            words_lines.append(sent[i])
+            props_lines.append(f"{props} {tag}")
+        words_lines.append("")
+        props_lines.append("")
+    wgz = io.BytesIO()
+    with gzip.GzipFile(fileobj=wgz, mode="w") as g:
+        g.write(("\n".join(words_lines) + "\n").encode())
+    pgz = io.BytesIO()
+    with gzip.GzipFile(fileobj=pgz, mode="w") as g:
+        g.write(("\n".join(props_lines) + "\n").encode())
+    with tarfile.open(tar_path, "w") as tf:
+        for name, buf in (
+                ("conll05st-release/test.wsj/words/test.wsj.words.gz", wgz),
+                ("conll05st-release/test.wsj/props/test.wsj.props.gz", pgz)):
+            data = buf.getvalue()
+            info = tarfile.TarInfo(name)
+            info.size = len(data)
+            tf.addfile(info, io.BytesIO(data))
+    with open(wdict, "w") as f:
+        f.write("\n".join(["bos", "eos"] + nouns + verbs) + "\n")
+    with open(vdict, "w") as f:
+        f.write("\n".join(verbs) + "\n")
+    with open(tdict, "w") as f:
+        tags = []
+        for t in ("A0", "A1", "V"):
+            tags += [f"B-{t}", f"I-{t}"]
+        f.write("\n".join(tags + ["O"]) + "\n")
+    n_words = 2 + len(nouns) + len(verbs)
+    np.random.RandomState(5).rand(n_words, 32).astype(np.float32).tofile(emb)
+    return tar_path, wdict, vdict, tdict, emb
+
+
+class Conll05st(Dataset):
+    """CoNLL-2005 SRL (reference conll05.py:110): gzip'd words/props
+    columns in a tar + word/verb/target dict files; samples are the
+    9-field (words, 5 ctx windows, predicate, mark, labels) layout."""
+
+    UNK_IDX = 0
+
+    def __init__(self, data_file=None, word_dict_file=None,
+                 verb_dict_file=None, target_dict_file=None, emb_file=None,
+                 mode="train", download=True):
+        if data_file is None:
+            (data_file, _w, _v, _t, _e) = _synth_conll_files()
+            word_dict_file = word_dict_file or _w
+            verb_dict_file = verb_dict_file or _v
+            target_dict_file = target_dict_file or _t
+            emb_file = emb_file or _e
+        self.data_file = data_file
+        self.word_dict_file = word_dict_file
+        self.verb_dict_file = verb_dict_file
+        self.target_dict_file = target_dict_file
+        self.emb_file = emb_file
+        self.word_dict = self._load_dict(self.word_dict_file)
+        self.predicate_dict = self._load_dict(self.verb_dict_file)
+        self.label_dict = self._load_label_dict(self.target_dict_file)
+        self._load_anno()
+
+    @staticmethod
+    def _load_dict(filename):
+        d = {}
+        with open(filename) as f:
+            for i, line in enumerate(f):
+                d[line.strip()] = i
+        return d
+
+    @staticmethod
+    def _load_label_dict(filename):
+        d, tag_set = {}, set()
+        with open(filename) as f:
+            for line in f:
+                line = line.strip()
+                if line.startswith(("B-", "I-")):
+                    tag_set.add(line[2:])
+        index = 0
+        for tag in sorted(tag_set):
+            d["B-" + tag] = index
+            index += 1
+            d["I-" + tag] = index
+            index += 1
+        d["O"] = index
+        return d
+
+    def _load_anno(self):
+        self.sentences, self.predicates, self.labels = [], [], []
+        with tarfile.open(self.data_file) as tf:
+            wf = tf.extractfile(
+                "conll05st-release/test.wsj/words/test.wsj.words.gz")
+            pf = tf.extractfile(
+                "conll05st-release/test.wsj/props/test.wsj.props.gz")
+            with gzip.GzipFile(fileobj=wf) as words_file, \
+                    gzip.GzipFile(fileobj=pf) as props_file:
+                sentences, labels, one_seg = [], [], []
+                for word, label in zip(words_file, props_file):
+                    word = word.decode().strip()
+                    label = label.decode().strip().split()
+                    if len(label) == 0:  # end of sentence
+                        self._flush_sentence(sentences, one_seg)
+                        sentences, labels, one_seg = [], [], []
+                    else:
+                        sentences.append(word)
+                        one_seg.append(label)
+
+    def _flush_sentence(self, sentences, one_seg):
+        if not one_seg:
+            return
+        labels = [[x[i] for x in one_seg] for i in range(len(one_seg[0]))]
+        verb_list = [x for x in labels[0] if x != "-"]
+        for i, lbl in enumerate(labels[1:]):
+            cur_tag, in_bracket, lbl_seq = "O", False, []
+            for l in lbl:
+                if l == "*" and not in_bracket:
+                    lbl_seq.append("O")
+                elif l == "*" and in_bracket:
+                    lbl_seq.append("I-" + cur_tag)
+                elif l == "*)":
+                    lbl_seq.append("I-" + cur_tag)
+                    in_bracket = False
+                elif "(" in l and ")" in l:
+                    cur_tag = l[1:l.find("*")]
+                    lbl_seq.append("B-" + cur_tag)
+                    in_bracket = False
+                elif "(" in l:
+                    cur_tag = l[1:l.find("*")]
+                    lbl_seq.append("B-" + cur_tag)
+                    in_bracket = True
+                else:
+                    raise RuntimeError(f"Unexpected label: {l}")
+            self.sentences.append(list(sentences))
+            self.predicates.append(verb_list[i])
+            self.labels.append(lbl_seq)
+
+    def __getitem__(self, idx):
+        sentence = self.sentences[idx]
+        predicate = self.predicates[idx]
+        labels = self.labels[idx]
+        sen_len = len(sentence)
+        verb_index = labels.index("B-V")
+        mark = [0] * len(labels)
+
+        def ctx(offset, default):
+            j = verb_index + offset
+            if 0 <= j < len(labels):
+                mark[j] = 1
+                return sentence[j]
+            return default
+
+        ctx_n2 = ctx(-2, "bos")
+        ctx_n1 = ctx(-1, "bos")
+        ctx_0 = ctx(0, sentence[verb_index])
+        ctx_p1 = ctx(1, "eos")
+        ctx_p2 = ctx(2, "eos")
+        wd = self.word_dict
+        word_idx = [wd.get(w, self.UNK_IDX) for w in sentence]
+        ctx_idx = [[wd.get(c, self.UNK_IDX)] * sen_len
+                   for c in (ctx_n2, ctx_n1, ctx_0, ctx_p1, ctx_p2)]
+        pred_idx = [self.predicate_dict.get(predicate)] * sen_len
+        label_idx = [self.label_dict.get(w) for w in labels]
+        return (np.array(word_idx), *(np.array(c) for c in ctx_idx),
+                np.array(pred_idx), np.array(mark), np.array(label_idx))
+
+    def __len__(self):
+        return len(self.sentences)
+
+    def get_dict(self):
+        return self.word_dict, self.predicate_dict, self.label_dict
+
+    def get_embedding(self):
+        return self.emb_file
+
+
+# --------------------------------------------------------------------------
+# WMT14 / WMT16 — parallel corpora (reference wmt14.py:105, wmt16.py:130)
+# --------------------------------------------------------------------------
+
+START = "<s>"
+END = "<e>"
+UNK = "<unk>"
+UNK_IDX = 2
+
+
+def _synth_parallel_lines(rng, n, vocab):
+    lines = []
+    for _ in range(n):
+        ln = rng.randint(3, 9)
+        src = " ".join(f"s{rng.randint(0, vocab)}" for _ in range(ln))
+        trg = " ".join(f"t{rng.randint(0, vocab)}" for _ in range(ln))
+        lines.append(f"{src}\t{trg}")
+    return lines
+
+
+def _synth_wmt14_tar():
+    path = os.path.join(_cache_dir(), "wmt14_synth.tar.gz")
+    if os.path.exists(path):
+        return path
+    rng = np.random.RandomState(6)
+    src_dict = "\n".join([START, END, UNK] +
+                         [f"s{i}" for i in range(30)]) + "\n"
+    trg_dict = "\n".join([START, END, UNK] +
+                         [f"t{i}" for i in range(30)]) + "\n"
+    with tarfile.open(path, "w:gz") as tf:
+        def _add(name, text):
+            data = text.encode()
+            info = tarfile.TarInfo(name)
+            info.size = len(data)
+            tf.addfile(info, io.BytesIO(data))
+        _add("wmt14/src.dict", src_dict)
+        _add("wmt14/trg.dict", trg_dict)
+        _add("train/train",
+             "\n".join(_synth_parallel_lines(rng, 80, 30)) + "\n")
+        _add("test/test",
+             "\n".join(_synth_parallel_lines(rng, 20, 30)) + "\n")
+    return path
+
+
+class WMT14(Dataset):
+    """WMT14 en-fr (reference wmt14.py:105): tar with src.dict/trg.dict +
+    {mode}/{mode} tab-separated parallel lines; samples are
+    (src_ids, trg_ids, trg_ids_next) with <s>/<e> wrapping and the
+    80-token cutoff."""
+
+    def __init__(self, data_file=None, mode="train", dict_size=-1,
+                 download=True):
+        assert mode.lower() in ("train", "test", "gen"), mode
+        self.mode = mode.lower()
+        if data_file is None:
+            data_file = _synth_wmt14_tar()
+            if dict_size <= 0:
+                dict_size = 33
+        assert dict_size > 0, "dict_size should be set as positive number"
+        self.data_file = data_file
+        self.dict_size = dict_size
+        self._load_data()
+
+    def _load_data(self):
+        def to_dict(fd, size):
+            out = {}
+            for i, line in enumerate(fd):
+                if i >= size:
+                    break
+                out[line.decode("utf-8", "ignore").strip()] = i
+            return out
+
+        self.src_ids, self.trg_ids, self.trg_ids_next = [], [], []
+        with tarfile.open(self.data_file) as f:
+            names = [m.name for m in f if m.name.endswith("src.dict")]
+            assert len(names) == 1
+            self.src_dict = to_dict(f.extractfile(names[0]), self.dict_size)
+            names = [m.name for m in f if m.name.endswith("trg.dict")]
+            assert len(names) == 1
+            self.trg_dict = to_dict(f.extractfile(names[0]), self.dict_size)
+            file_name = f"{self.mode}/{self.mode}"
+            names = [m.name for m in f if m.name.endswith(file_name)]
+            for name in names:
+                for line in f.extractfile(name):
+                    parts = line.decode("utf-8", "ignore").strip().split("\t")
+                    if len(parts) != 2:
+                        continue
+                    src_words = parts[0].split()
+                    src_ids = [self.src_dict.get(w, UNK_IDX)
+                               for w in [START] + src_words + [END]]
+                    trg_words = parts[1].split()
+                    trg_ids = [self.trg_dict.get(w, UNK_IDX)
+                               for w in trg_words]
+                    if len(src_ids) > 80 or len(trg_ids) > 80:
+                        continue
+                    self.src_ids.append(src_ids)
+                    self.trg_ids.append([self.trg_dict[START]] + trg_ids)
+                    self.trg_ids_next.append(trg_ids + [self.trg_dict[END]])
+
+    def __getitem__(self, idx):
+        return (np.array(self.src_ids[idx]), np.array(self.trg_ids[idx]),
+                np.array(self.trg_ids_next[idx]))
+
+    def __len__(self):
+        return len(self.src_ids)
+
+    def get_dict(self, reverse=False):
+        src = self.src_dict
+        trg = self.trg_dict
+        if reverse:
+            src = {v: k for k, v in src.items()}
+            trg = {v: k for k, v in trg.items()}
+        return src, trg
+
+
+def _synth_wmt16_tar():
+    path = os.path.join(_cache_dir(), "wmt16_synth.tar.gz")
+    if os.path.exists(path):
+        return path
+    rng = np.random.RandomState(7)
+    with tarfile.open(path, "w:gz") as tf:
+        for split, n in (("train", 80), ("val", 20), ("test", 20)):
+            text = "\n".join(_synth_parallel_lines(rng, n, 25)) + "\n"
+            data = text.encode()
+            info = tarfile.TarInfo(f"wmt16/{split}")
+            info.size = len(data)
+            tf.addfile(info, io.BytesIO(data))
+    return path
+
+
+class WMT16(Dataset):
+    """WMT16 en-de (reference wmt16.py:130): tar with wmt16/{train,val,
+    test}; dictionaries BUILT from the train split by frequency (3 marks +
+    top words), ids with <s>/<e>/<unk> = 0/1/2."""
+
+    def __init__(self, data_file=None, mode="train", src_dict_size=-1,
+                 trg_dict_size=-1, lang="en", download=True):
+        assert mode.lower() in ("train", "test", "val"), mode
+        self.mode = mode.lower()
+        self.lang = lang
+        self.data_file = data_file or _synth_wmt16_tar()
+        if src_dict_size <= 0:
+            src_dict_size = 28
+        if trg_dict_size <= 0:
+            trg_dict_size = 28
+        self.src_dict_size = src_dict_size
+        self.trg_dict_size = trg_dict_size
+        self.src_dict = self._build_dict(src_dict_size, lang)
+        self.trg_dict = self._build_dict(trg_dict_size,
+                                         "de" if lang == "en" else "en")
+        self._load_data()
+
+    def _build_dict(self, dict_size, lang):
+        word_freq = collections.defaultdict(int)
+        col = 0 if lang == self.lang else 1
+        with tarfile.open(self.data_file) as f:
+            for line in f.extractfile("wmt16/train"):
+                parts = line.decode("utf-8", "ignore").strip().split("\t")
+                if len(parts) != 2:
+                    continue
+                for w in parts[col].split():
+                    word_freq[w] += 1
+        d = {START: 0, END: 1, UNK: 2}
+        for idx, (w, _) in enumerate(
+                sorted(word_freq.items(), key=lambda x: x[1], reverse=True)):
+            if idx + 3 == dict_size:
+                break
+            d[w] = idx + 3
+        return d
+
+    def _load_data(self):
+        start_id = self.src_dict[START]
+        end_id = self.src_dict[END]
+        unk_id = self.src_dict[UNK]
+        src_col = 0 if self.lang == "en" else 1
+        trg_col = 1 - src_col
+        self.src_ids, self.trg_ids, self.trg_ids_next = [], [], []
+        with tarfile.open(self.data_file) as f:
+            for line in f.extractfile(f"wmt16/{self.mode}"):
+                parts = line.decode("utf-8", "ignore").strip().split("\t")
+                if len(parts) != 2:
+                    continue
+                src_ids = [start_id] + [self.src_dict.get(w, unk_id)
+                                        for w in parts[src_col].split()] \
+                    + [end_id]
+                trg_ids = [self.trg_dict.get(w, unk_id)
+                           for w in parts[trg_col].split()]
+                self.src_ids.append(src_ids)
+                self.trg_ids.append([start_id] + trg_ids)
+                self.trg_ids_next.append(trg_ids + [end_id])
+
+    def __getitem__(self, idx):
+        return (np.array(self.src_ids[idx]), np.array(self.trg_ids[idx]),
+                np.array(self.trg_ids_next[idx]))
+
+    def __len__(self):
+        return len(self.src_ids)
+
+    def get_dict(self, lang, reverse=False):
+        d = self.src_dict if lang == self.lang else self.trg_dict
+        return {v: k for k, v in d.items()} if reverse else dict(d)

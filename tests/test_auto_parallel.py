@@ -1,0 +1,95 @@
+"""auto_parallel API (reference: distributed/auto_parallel/interface.py,
+process_mesh.py, engine.py — see module docstring for the GSPMD mapping)."""
+import jax
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+from paddle_tpu import nn
+from paddle_tpu.distributed.auto_parallel import Engine, ProcessMesh
+
+
+@pytest.fixture(autouse=True)
+def _clean_mesh():
+    dist.set_mesh(None)
+    yield
+    dist.set_mesh(None)
+
+
+def test_process_mesh_topology():
+    mesh = ProcessMesh([[2, 4, 5], [0, 1, 3]])
+    assert mesh.topology == [2, 3]
+    assert mesh.processes == [2, 4, 5, 0, 1, 3]
+    assert mesh.ndim == 2
+    assert mesh.jax_mesh.shape == {"d0": 2, "d1": 3}
+
+
+def test_process_mesh_named_dims_and_context():
+    mesh = ProcessMesh([[0, 1], [2, 3]], dim_names=["x", "y"])
+    with mesh:
+        assert dist.get_mesh() is mesh.jax_mesh
+    assert dist.get_mesh() is None
+
+
+def test_shard_tensor_concrete():
+    pm = ProcessMesh([[0, 1], [2, 3]], dim_names=["x", "y"])
+    x = paddle.ones([4, 6])
+    dist.shard_tensor(x, dist_attr={"process_mesh": pm,
+                                    "dims_mapping": [0, -1]})
+    shards = {s.data.shape for s in x._value.addressable_shards}
+    assert shards == {(2, 6)}, shards
+    assert x._dist_attr["dims_mapping"] == [0, -1]
+
+
+def test_shard_tensor_in_jit():
+    pm = ProcessMesh(list(range(8)), dim_names=["dp"])
+    with pm:
+        def fn(v):
+            from paddle_tpu.core.tensor import Tensor
+
+            t = dist.shard_tensor(Tensor(v),
+                                  dist_attr={"dims_mapping": [0, -1]})
+            return (t * 2)._value
+
+        out = jax.jit(fn)(np.ones((8, 4), np.float32))
+        np.testing.assert_allclose(np.asarray(out), 2.0)
+
+
+def test_shard_op_annotates_outputs():
+    pm = ProcessMesh(list(range(4)), dim_names=["mp"])
+    x = paddle.ones([4, 8])
+    matmul = dist.shard_op(
+        lambda a: a @ paddle.ones([8, 8]),
+        dist_attr={"process_mesh": pm, "out": [{"dims_mapping": [-1, 0]}]})
+    y = matmul(x)
+    shards = {s.data.shape for s in y._value.addressable_shards}
+    assert shards == {(4, 2)}, shards
+
+
+def test_engine_fit_evaluate():
+    paddle.seed(0)
+    rng = np.random.RandomState(0)
+    xs = rng.randn(64, 8).astype(np.float32)
+    w = rng.randn(8, 1).astype(np.float32)
+    ys = (xs @ w).astype(np.float32)
+
+    class DS(paddle.io.Dataset):
+        def __len__(self):
+            return 64
+
+        def __getitem__(self, i):
+            return xs[i], ys[i]
+
+    model = nn.Linear(8, 1)
+    engine = Engine(model=model)
+    engine.prepare(
+        optimizer=paddle.optimizer.Adam(learning_rate=0.05,
+                                        parameters=model.parameters()),
+        loss=nn.MSELoss())
+    # default dp mesh over all 8 devices was installed
+    assert dist.get_mesh() is not None
+    assert dist.get_mesh().shape == {"dp": 8}
+    engine.fit(DS(), batch_size=16, epochs=8)
+    res = engine.evaluate(DS(), batch_size=16)
+    assert res["loss"] < 0.5, res

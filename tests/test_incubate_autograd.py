@@ -44,3 +44,71 @@ def test_hessian():
     H = Hessian(lambda v: (v ** 3).sum(), x)
     assert H.shape == [2, 2]
     np.testing.assert_allclose(H[:].numpy(), np.diag(6 * x.numpy()))
+
+
+class TestASP:
+    """incubate.asp 2:4 structured sparsity (reference
+    fluid/contrib/sparsity/asp.py — see paddle_tpu/incubate/asp.py)."""
+
+    def _teardown(self):
+        from paddle_tpu.incubate import asp
+
+        asp.ASPHelper.reset()
+
+    def test_mask_1d_pattern(self):
+        from paddle_tpu.incubate import asp
+
+        rng = np.random.RandomState(0)
+        w = rng.randn(8, 16).astype(np.float32)
+        mask = asp.get_mask_1d(w, 2, 4)
+        assert asp.check_mask_1d(mask * w, 2, 4)
+        assert abs(asp.calculate_density(mask) - 0.5) < 1e-6
+        # keeps the largest-|.| entries of each group of 4
+        g = (np.abs(w) * mask).reshape(-1, 4)
+        gfull = np.abs(w).reshape(-1, 4)
+        kept = np.sort(g, axis=1)[:, -2:]
+        best = np.sort(gfull, axis=1)[:, -2:]
+        np.testing.assert_allclose(kept, best)
+
+    def test_mask_2d_pattern(self):
+        from paddle_tpu.incubate import asp
+
+        rng = np.random.RandomState(1)
+        w = rng.randn(8, 8).astype(np.float32)
+        mask = asp.get_mask_2d_greedy(w, 2, 4)
+        assert asp.check_mask_2d(mask, 2, 4)
+
+    def test_prune_train_keeps_pattern(self):
+        from paddle_tpu import nn
+        from paddle_tpu.incubate import asp
+
+        paddle.seed(0)
+        model = nn.Sequential(nn.Linear(16, 32), nn.ReLU(),
+                              nn.Linear(32, 8))
+        asp.set_excluded_layers(param_names=["2."])  # exclude the head
+        try:
+            masks = asp.prune_model(model, n=2, m=4)
+            assert any(k.startswith("0.") for k in masks)
+            assert not any(k.startswith("2.") for k in masks)
+            assert asp.check_sparsity(np.asarray(model[0].weight.numpy()),
+                                      asp.CheckMethod.CHECK_1D, 2, 4)
+            opt = asp.decorate(paddle.optimizer.Momentum(
+                learning_rate=0.1, momentum=0.9,
+                parameters=model.parameters()))
+            rng = np.random.RandomState(0)
+            x = paddle.to_tensor(rng.randn(8, 16).astype(np.float32))
+            y = paddle.to_tensor(rng.randn(8, 8).astype(np.float32))
+            for _ in range(5):
+                loss = ((model(x) - y) ** 2).mean()
+                loss.backward()
+                opt.step()
+                opt.clear_grad()
+            w = np.asarray(model[0].weight.numpy())
+            assert asp.check_sparsity(w, asp.CheckMethod.CHECK_1D, 2, 4)
+            assert abs(asp.calculate_density(w) - 0.5) < 0.02
+            # the excluded head stays dense
+            dens = asp.calculate_density(np.asarray(model[2].weight.numpy()))
+            assert dens > 0.9
+        finally:
+            self._teardown()
+            asp.reset_excluded_layers()

@@ -1,0 +1,141 @@
+"""PTQ/QAT (reference: fluid/contrib/slim/quantization — see
+paddle_tpu/quantization docstrings for per-class mapping)."""
+import jax
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.quantization import (
+    ImperativePTQ, ImperativeQuantAware, PostTrainingQuantization,
+    QuantConfig, QuantizedConv2D, QuantizedLinear, fake_quant,
+    quantize_weight,
+)
+
+
+def _mlp():
+    return nn.Sequential(nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, 8))
+
+
+class TestPrimitives:
+    def test_quantize_weight_per_channel(self):
+        w = np.random.RandomState(0).randn(6, 4).astype(np.float32)
+        q, s = quantize_weight(w, channel_axis=1)
+        assert q.dtype == np.int8 and s.shape == (1, 4)
+        np.testing.assert_allclose(q * s, w, atol=float(s.max()))
+
+    def test_fake_quant_ste_grad(self):
+        x = paddle.to_tensor(np.linspace(-1, 1, 8, dtype=np.float32))
+        x.stop_gradient = False
+        y = fake_quant(x, 1.0)
+        (y ** 2).sum().backward()
+        # STE: dy/dx == 2*qdq(x) (identity through the rounding)
+        np.testing.assert_allclose(np.asarray(x.grad.numpy()),
+                                   2 * np.asarray(y.numpy()), rtol=1e-5)
+
+
+class TestPTQ:
+    def test_ptq_linear_accuracy(self):
+        paddle.seed(0)
+        model = _mlp()
+        rng = np.random.RandomState(0)
+        calib = [paddle.to_tensor(rng.randn(8, 16).astype(np.float32))
+                 for _ in range(4)]
+        ref_out = np.asarray(model(calib[0]).numpy())
+
+        ptq = ImperativePTQ(QuantConfig())
+        ptq.quantize(model)
+        for x in calib:
+            model(x)
+        ptq.convert(model)
+        assert isinstance(model[0], QuantizedLinear)
+        out = np.asarray(model(calib[0]).numpy())
+        # int8 tolerance: ~1% of dynamic range
+        err = np.abs(out - ref_out).max() / (np.abs(ref_out).max() + 1e-8)
+        assert err < 0.05, err
+
+    def test_int8_ops_in_hlo(self):
+        paddle.seed(1)
+        model = _mlp()
+        ptq = ImperativePTQ()
+        ptq.quantize(model)
+        x = paddle.randn([4, 16])
+        model(x)
+        ptq.convert(model)
+
+        def fwd(xv):
+            from paddle_tpu.core.tensor import Tensor
+
+            return model(Tensor(xv))._value
+
+        hlo = jax.jit(fwd).lower(x._value).as_text()
+        assert "i8" in hlo or "s8" in hlo, "no int8 types in lowered HLO"
+
+    def test_ptq_conv_lenet_accuracy(self):
+        from paddle_tpu.vision.models import LeNet
+
+        paddle.seed(2)
+        model = LeNet()
+        model.eval()
+        rng = np.random.RandomState(2)
+        xs = [paddle.to_tensor(rng.randn(4, 1, 28, 28).astype(np.float32))
+              for _ in range(3)]
+        ref = np.asarray(model(xs[0]).numpy())
+        ptq = ImperativePTQ(QuantConfig(activation_quantize_type="hist"))
+        ptq.quantize(model)
+        for x in xs:
+            model(x)
+        ptq.convert(model)
+        quant_types = [type(l).__name__ for _, l in model.named_sublayers()]
+        assert "QuantizedConv2D" in quant_types
+        assert "QuantizedLinear" in quant_types
+        out = np.asarray(model(xs[0]).numpy())
+        # logits shift but argmax ranking should broadly hold on random net
+        err = np.abs(out - ref).max() / (np.abs(ref).max() + 1e-8)
+        assert err < 0.2, err
+
+    def test_post_training_quantization_api(self):
+        paddle.seed(3)
+        model = _mlp()
+        rng = np.random.RandomState(3)
+
+        class DS(paddle.io.Dataset):
+            def __len__(self):
+                return 16
+
+            def __getitem__(self, i):
+                return rng.randn(16).astype(np.float32)
+
+        loader = paddle.io.DataLoader(DS(), batch_size=4)
+        ptq = PostTrainingQuantization(model=model, data_loader=loader,
+                                       algo="KL", batch_nums=2)
+        qmodel = ptq.quantize()
+        assert isinstance(qmodel[0], QuantizedLinear)
+
+
+class TestQAT:
+    def test_qat_train_then_convert(self):
+        paddle.seed(4)
+        model = _mlp()
+        qat = ImperativeQuantAware()
+        qat.quantize(model)
+        opt = paddle.optimizer.Adam(learning_rate=1e-2,
+                                    parameters=model.parameters())
+        rng = np.random.RandomState(4)
+        x = paddle.to_tensor(rng.randn(16, 16).astype(np.float32))
+        y = paddle.to_tensor(rng.randn(16, 8).astype(np.float32))
+        losses = []
+        for _ in range(15):
+            loss = ((model(x) - y) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss))
+        assert losses[-1] < losses[0], losses
+        model.eval()
+        ref = np.asarray(model(x).numpy())
+        qat.convert(model)
+        assert isinstance(model[0], QuantizedLinear)
+        out = np.asarray(model(x).numpy())
+        err = np.abs(out - ref).max() / (np.abs(ref).max() + 1e-8)
+        assert err < 0.1, err

@@ -1,0 +1,197 @@
+"""Text dataset parsers against synthetic files in the reference formats
+(reference: python/paddle/text/datasets/*.py)."""
+import gzip
+import io
+import os
+import tarfile
+import zipfile
+
+import numpy as np
+import pytest
+
+from paddle_tpu.text import (Conll05st, Imdb, Imikolov, Movielens,
+                             UCIHousing, WMT14, WMT16)
+
+
+class TestImdb:
+    def _make_tar(self, tmp_path):
+        path = str(tmp_path / "imdb.tar.gz")
+        docs = {
+            ("train", "pos", 0): b"great great fun fun fun, movie!",
+            ("train", "pos", 1): b"great movie fun",
+            ("train", "neg", 0): b"bad bad awful movie",
+            ("train", "neg", 1): b"awful movie bad fun",
+            ("test", "pos", 0): b"great fun",
+            ("test", "neg", 0): b"bad awful",
+        }
+        with tarfile.open(path, "w:gz") as tf:
+            for (split, cls, i), text in docs.items():
+                info = tarfile.TarInfo(f"aclImdb/{split}/{cls}/{i}.txt")
+                info.size = len(text)
+                tf.addfile(info, io.BytesIO(text))
+        return path
+
+    def test_parse_and_dict(self, tmp_path):
+        ds = Imdb(data_file=self._make_tar(tmp_path), mode="train",
+                  cutoff=1)
+        # words with freq > 1 survive; sorted by (-freq, word)
+        assert "movie" in ds.word_idx and "<unk>" in ds.word_idx
+        # punctuation stripped, lowercased
+        assert "movie!" not in ds.word_idx
+        assert len(ds) == 4
+        doc, label = ds[0]
+        assert doc.dtype == np.int64 or doc.dtype == np.int32 or \
+            doc.dtype.kind == "i"
+        assert label.shape == (1,)
+        labels = sorted(int(ds[i][1][0]) for i in range(len(ds)))
+        assert labels == [0, 0, 1, 1]  # pos=0 neg=1
+
+    def test_cutoff_respected(self, tmp_path):
+        path = self._make_tar(tmp_path)
+        small = Imdb(data_file=path, mode="train", cutoff=1)
+        big = Imdb(data_file=path, mode="train", cutoff=100)
+        assert len(big.word_idx) < len(small.word_idx)
+        assert list(big.word_idx) == ["<unk>"]
+
+    def test_synthetic_fallback(self):
+        ds = Imdb(mode="test")
+        assert len(ds) > 0 and len(ds.word_idx) > 1
+
+
+class TestImikolov:
+    def _make_tar(self, tmp_path):
+        path = str(tmp_path / "ptb.tar.gz")
+        corpus = {"train": "a b c a b\na b\n", "valid": "a c\n",
+                  "test": "b c a\n"}
+        with tarfile.open(path, "w:gz") as tf:
+            for split, text in corpus.items():
+                data = text.encode()
+                info = tarfile.TarInfo(
+                    f"./simple-examples/data/ptb.{split}.txt")
+                info.size = len(data)
+                tf.addfile(info, io.BytesIO(data))
+        return path
+
+    def test_ngram(self, tmp_path):
+        ds = Imikolov(data_file=self._make_tar(tmp_path), data_type="NGRAM",
+                      window_size=2, mode="train", min_word_freq=0)
+        # "a b c a b" + <s>/<e> -> 6 bigrams; "a b" -> 3 bigrams
+        assert len(ds) == 9
+        assert all(len(s) == 2 for s in (ds[i] for i in range(3)))
+
+    def test_seq_mode_and_min_freq(self, tmp_path):
+        path = self._make_tar(tmp_path)
+        ds = Imikolov(data_file=path, data_type="SEQ", window_size=-1,
+                      mode="test", min_word_freq=0)
+        src, trg = ds[0]
+        assert src[0] == ds.word_idx["<s>"]
+        assert trg[-1] == ds.word_idx["<e>"]
+        # min_word_freq prunes words into <unk>
+        pruned = Imikolov(data_file=path, data_type="NGRAM", window_size=2,
+                          mode="train", min_word_freq=3)
+        assert "c" not in pruned.word_idx  # freq 2 <= 3 in train+valid
+        assert "a" in pruned.word_idx      # freq 4 > 3
+
+
+class TestMovielens:
+    def _make_zip(self, tmp_path):
+        path = str(tmp_path / "ml.zip")
+        with zipfile.ZipFile(path, "w") as z:
+            z.writestr("ml-1m/movies.dat",
+                       "1::Toy Story (1995)::Animation|Comedy\n"
+                       "2::Heat (1995)::Action\n")
+            z.writestr("ml-1m/users.dat",
+                       "1::F::1::10::48067\n2::M::56::16::70072\n")
+            z.writestr("ml-1m/ratings.dat",
+                       "1::1::5::978300760\n2::2::1::978302109\n"
+                       "1::2::4::978301968\n")
+        return path
+
+    def test_parse(self, tmp_path):
+        ds = Movielens(data_file=self._make_zip(tmp_path), mode="train",
+                       test_ratio=0.0)
+        assert len(ds) == 3
+        sample = ds[0]
+        # usr(4) + movie(3) + rating = 8 fields
+        assert len(sample) == 8
+        uid, gender, age, job = sample[:4]
+        assert gender[0] in (0, 1)
+        rating = sample[-1]
+        assert -5.0 <= float(rating[0]) <= 5.0
+        # title word dict strips year suffix and lowercases
+        assert "toy" in ds.movie_title_dict
+        assert "(1995)" not in ds.movie_title_dict
+
+
+class TestUCIHousing:
+    def test_parse_normalize_split(self, tmp_path):
+        rng = np.random.RandomState(0)
+        data = rng.rand(10, 14)
+        path = str(tmp_path / "housing.data")
+        with open(path, "w") as f:
+            for row in data:
+                f.write(" ".join(map(str, row)) + "\n")
+        tr = UCIHousing(data_file=path, mode="train")
+        te = UCIHousing(data_file=path, mode="test")
+        assert len(tr) == 8 and len(te) == 2
+        x, y = tr[0]
+        assert x.shape == (13,) and y.shape == (1,)
+        # feature normalization: (x - avg) / (max - min)
+        avg = data[:, 0].mean()
+        rngspan = data[:, 0].max() - data[:, 0].min()
+        np.testing.assert_allclose(float(x[0]),
+                                   (data[0, 0] - avg) / rngspan, rtol=1e-5)
+        # label column is untouched
+        np.testing.assert_allclose(float(y[0]), data[0, -1], rtol=1e-5)
+
+
+class TestConll05:
+    def test_parse_props_format(self):
+        ds = Conll05st()
+        assert len(ds) > 0
+        sample = ds[0]
+        assert len(sample) == 9
+        words, c_n2, c_n1, c_0, c_p1, c_p2, pred, mark, labels = sample
+        n = len(words)
+        assert all(len(x) == n for x in sample)
+        # the predicate mark column has the verb window flagged
+        assert mark.sum() >= 1
+        # labels include the verb tag
+        word_d, verb_d, label_d = ds.get_dict()
+        assert label_d["B-V"] in labels
+        assert os.path.exists(ds.get_embedding())
+
+
+class TestWMT:
+    def test_wmt14(self):
+        ds = WMT14(mode="train")
+        src, trg, trg_next = ds[0]
+        sd, td = ds.get_dict()
+        assert src[0] == sd["<s>"] and src[-1] == sd["<e>"]
+        assert trg[0] == td["<s>"]
+        assert trg_next[-1] == td["<e>"]
+        # shifted-by-one relation
+        np.testing.assert_array_equal(trg[1:], trg_next[:-1])
+
+    def test_wmt14_dict_size(self, tmp_path):
+        # over-length sequences (>80 tokens) are dropped per the reference
+        path = str(tmp_path / "wmt14.tar.gz")
+        long_src = " ".join(["s0"] * 100)
+        with tarfile.open(path, "w:gz") as tf:
+            def add(name, text):
+                data = text.encode()
+                info = tarfile.TarInfo(name)
+                info.size = len(data)
+                tf.addfile(info, io.BytesIO(data))
+            add("d/src.dict", "<s>\n<e>\n<unk>\ns0\n")
+            add("d/trg.dict", "<s>\n<e>\n<unk>\nt0\n")
+            add("train/train", f"{long_src}\tt0\ns0 s0\tt0 t0\n")
+        ds = WMT14(data_file=path, mode="train", dict_size=4)
+        assert len(ds) == 1  # the 100-token line was dropped
+
+    def test_wmt16_builds_dict_from_train(self):
+        ds = WMT16(mode="val", src_dict_size=10, trg_dict_size=10)
+        assert ds.src_dict["<s>"] == 0 and ds.src_dict["<unk>"] == 2
+        assert len(ds.src_dict) <= 10
+        src, trg, trg_next = ds[0]
+        np.testing.assert_array_equal(trg[1:], trg_next[:-1])

@@ -75,9 +75,12 @@ def check_mask_1d(mat, n, m):
 
 
 def get_mask_2d_greedy(mat, n, m):
-    """n:m on m x m blocks, greedy row+col balance (reference
-    utils.py:314, simplified to per-row-within-block selection that also
-    satisfies the 1-D pattern both ways)."""
+    """n:m on m x m blocks (reference utils.py:314, same algorithm): scan
+    each block's entries in descending |value| order, keeping an entry
+    while its row AND column still have fewer than n kept. Like the
+    reference, this guarantees AT MOST n kept per row/column (>= n zeros,
+    the 2-D n:m pattern); the greedy order usually but not always fills
+    every row to exactly n."""
     mat = np.asarray(mat)
     rows, cols = mat.shape
     rpad = (m - rows % m) % m
@@ -88,14 +91,15 @@ def get_mask_2d_greedy(mat, n, m):
         for c0 in range(0, padded.shape[1], m):
             block = np.abs(padded[r0:r0 + m, c0:c0 + m])
             sub = np.zeros_like(block)
-            # greedy: pick the n largest per row AND cap n per column
+            row_counts = np.zeros(m, np.int64)
             col_counts = np.zeros(m, np.int64)
-            for i in np.argsort(block.max(axis=1))[::-1]:
-                picks = [j for j in np.argsort(block[i])[::-1]
-                         if col_counts[j] < n][:n]
-                sub[i, picks] = 1.0
-                for j in picks:
-                    col_counts[j] += 1
+            for flat in np.argsort(block, axis=None)[::-1]:
+                i, j = divmod(int(flat), m)
+                if row_counts[i] == n or col_counts[j] == n:
+                    continue
+                sub[i, j] = 1.0
+                row_counts[i] += 1
+                col_counts[j] += 1
             mask[r0:r0 + m, c0:c0 + m] = sub
     return mask[:rows, :cols].astype(mat.dtype)
 
@@ -138,7 +142,9 @@ class ASPHelper:
 
     MASK_APPENDDED_NAME = "asp_mask"
     _excluded = set()
-    _masks = {}  # id(param) -> np mask
+    # id(param) -> (weakref(param), mask): weakrefs so pruned models can be
+    # garbage-collected; dead entries are swept on each decorated step
+    _masks = {}
 
     @classmethod
     def _is_supported_layer(cls, param_name, param):
@@ -167,9 +173,27 @@ class ASPHelper:
             mask = create_mask(np.asarray(p._value), mask_algo, n, m)
             p._value = p._value * jnp.asarray(mask, p._value.dtype)
             if with_mask:
-                cls._masks[id(p)] = (p, jnp.asarray(mask))
+                import weakref
+
+                cls._masks[id(p)] = (weakref.ref(p), jnp.asarray(mask))
                 masks[name] = mask
         return masks
+
+    @classmethod
+    def _live_masks(cls, restrict_ids=None):
+        """(param, mask) pairs still alive; sweeps dead weakrefs. When
+        restrict_ids is given, only those params are re-masked (a decorated
+        optimizer touches its own parameter list, not other models')."""
+        out, dead = [], []
+        for pid, (ref, mask) in cls._masks.items():
+            p = ref()
+            if p is None:
+                dead.append(pid)
+            elif restrict_ids is None or pid in restrict_ids:
+                out.append((p, mask))
+        for pid in dead:
+            del cls._masks[pid]
+        return out
 
     @classmethod
     def decorate(cls, optimizer):
@@ -193,18 +217,24 @@ class OptimizerWithSparsityGuarantee:
     def __getattr__(self, item):
         return getattr(self._optimizer, item)
 
+    def _own_param_ids(self):
+        params = getattr(self._optimizer, "_parameter_list", None)
+        return None if params is None else {id(p) for p in params}
+
+    def _apply_masks(self):
+        for p, mask in ASPHelper._live_masks(self._own_param_ids()):
+            p._value = p._value * mask.astype(p._value.dtype)
+
     def step(self):
         out = self._optimizer.step()
-        for p, mask in ASPHelper._masks.values():
-            p._value = p._value * mask.astype(p._value.dtype)
+        self._apply_masks()
         return out
 
     def minimize(self, loss, startup_program=None, parameters=None,
                  no_grad_set=None):
         out = self._optimizer.minimize(loss, startup_program, parameters,
                                        no_grad_set)
-        for p, mask in ASPHelper._masks.values():
-            p._value = p._value * mask.astype(p._value.dtype)
+        self._apply_masks()
         return out
 
 

@@ -131,8 +131,14 @@ def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):  # n
             # per-dim pairs in dim order for this case
             pairs = [(pad[2 * i], pad[2 * i + 1]) for i in range(nd)]
         else:
-            # spatial-only, reversed order (last dim first), NCHW-family
+            # spatial-only, reversed order (last dim first), NCHW-family;
+            # the reference documents this form for 3/4/5-D inputs only
             n_spatial = len(pad) // 2
+            if nd != n_spatial + 2:
+                raise ValueError(
+                    f"pad of length {len(pad)} needs a {n_spatial + 2}-D "
+                    f"input (or a full-rank pad of length {2 * nd}), got "
+                    f"{nd}-D")
             pairs = [(0, 0)] * nd
             channel_last = data_format in ("NHWC", "NLC", "NDHWC")
             spatial_start = 1 if channel_last else 2

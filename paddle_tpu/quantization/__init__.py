@@ -108,6 +108,10 @@ class ImperativePTQ:
         return model
 
     def convert(self, model, inplace=True):
+        """Swap calibrated layers for int8 layers. Returns the converted
+        model — when `model` ITSELF is a quantizable leaf (bare nn.Linear)
+        the returned object is the replacement, so always use the return
+        value."""
         cfg = self.cfg
         for h in self._hooks:
             h.remove()
@@ -128,6 +132,9 @@ class ImperativePTQ:
                                        cfg.activation_bits)
             return None
 
+        root = _swap(model)
+        if root is not None:
+            return root
         _walk_replace(model, _swap)
         return model
 
@@ -158,6 +165,9 @@ class ImperativeQuantAware:
         self.moving_rate = moving_rate
 
     def quantize(self, model):
+        """Swap quantizable layers for fake-quant wrappers. Returns the
+        wrapped model — when `model` itself is a bare Linear/Conv2D the
+        wrapper is the return value, so always use it."""
         def _swap(child):
             kind = _quantizable(child)
             if kind == "linear" and "Linear" in self.types:
@@ -168,6 +178,9 @@ class ImperativeQuantAware:
                                  self.activation_bits, self.moving_rate)
             return None
 
+        root = _swap(model)
+        if root is not None:
+            return root
         _walk_replace(model, _swap)
         return model
 
@@ -185,6 +198,9 @@ class ImperativeQuantAware:
                                        self.activation_bits)
             return None
 
+        root = _swap(model)
+        if root is not None:
+            return root
         _walk_replace(model, _swap)
         return model
 
@@ -243,7 +259,7 @@ class PostTrainingQuantization:
                 self.model(xs)
                 if self.batch_nums and i + 1 >= self.batch_nums:
                     break
-        self._ptq.convert(self.model)
+        self.model = self._ptq.convert(self.model)
         return self.model
 
     def save_quantized_model(self, save_model_path, model_filename=None,

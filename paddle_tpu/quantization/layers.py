@@ -121,8 +121,11 @@ class QuantizedConv2D(Layer):
         dilation = _norm_tuple(self._dilation, 2)
         pad = _norm_padding(self._padding, 2)
         groups = self._groups
+        channel_last = self._data_format == "NHWC"
+        lhs_spec = "NHWC" if channel_last else "NCHW"
         dn = jax.lax.conv_dimension_numbers(
-            (1, 1, 1, 1), (1, 1, 1, 1), ("NCHW", "OIHW", "NCHW"))
+            (1, 1, 1, 1), (1, 1, 1, 1), (lhs_spec, "OIHW", lhs_spec))
+        ch_shape = (1, 1, 1, -1) if channel_last else (1, -1, 1, 1)
 
         def _q_conv(v):
             inv = qm / jnp.maximum(self._a_scale, 1e-8)
@@ -133,13 +136,12 @@ class QuantizedConv2D(Layer):
                 rhs_dilation=dilation, dimension_numbers=dn,
                 feature_group_count=groups,
                 preferred_element_type=jnp.int32)
-            scale = (self._a_scale / qm) * \
-                self._w_scale.reshape(1, -1, 1, 1)
+            scale = (self._a_scale / qm) * self._w_scale.reshape(ch_shape)
             return acc.astype(jnp.float32) * scale
 
         out = apply(_q_conv, x)
         if self.bias is not None:
-            out = out + self.bias.reshape([1, -1, 1, 1])
+            out = out + self.bias.reshape(list(ch_shape))
         return out
 
 

@@ -107,11 +107,9 @@ class HistObserver:
             idx = np.minimum(
                 (np.arange(self.bins) * (1.0 / ratio)).astype(np.int64),
                 self.bins - 1)
-            wide = np.zeros(self.bins, np.int64)
-            np.add.at(wide, idx, 0)  # keep dtype
             new = np.zeros(self.bins, np.int64)
             np.add.at(new, idx, self._hist)
-            self._hist = new + wide
+            self._hist = new
             self._edge = top
         h, _ = np.histogram(v, bins=self.bins, range=(0.0, self._edge))
         self._hist = self._hist + h

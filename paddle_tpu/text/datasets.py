@@ -540,6 +540,9 @@ class Conll05st(Dataset):
                     else:
                         sentences.append(word)
                         one_seg.append(label)
+                # files without a trailing blank separator still carry a
+                # final sentence
+                self._flush_sentence(sentences, one_seg)
 
     def _flush_sentence(self, sentences, one_seg):
         if not one_seg:
@@ -594,7 +597,8 @@ class Conll05st(Dataset):
         word_idx = [wd.get(w, self.UNK_IDX) for w in sentence]
         ctx_idx = [[wd.get(c, self.UNK_IDX)] * sen_len
                    for c in (ctx_n2, ctx_n1, ctx_0, ctx_p1, ctx_p2)]
-        pred_idx = [self.predicate_dict.get(predicate)] * sen_len
+        pred_idx = [self.predicate_dict.get(predicate,
+                                            self.UNK_IDX)] * sen_len
         label_idx = [self.label_dict.get(w) for w in labels]
         return (np.array(word_idx), *(np.array(c) for c in ctx_idx),
                 np.array(pred_idx), np.array(mark), np.array(label_idx))

@@ -1,0 +1,554 @@
+"""OpTest-style numeric sweep (reference:
+python/paddle/fluid/tests/unittests/op_test.py — fwd vs numpy reference +
+grad vs numeric differentiation, one table entry per op config).
+
+Forward: paddle op output == numpy reference (f64 under the test x64 opt-in).
+Grad: for a random fixed cotangent c, loss = sum(op(x)*c); the tape gradient
+g must satisfy the directional-derivative identity
+    g . d  ==  (loss(x + eps d) - loss(x - eps d)) / (2 eps)
+for a random direction d — the same check op_test.py's get_numeric_gradient
+performs elementwise, collapsed to one dot product per input.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+
+
+class Case:
+    def __init__(self, name, fn, ref, inputs, kwargs=None, rtol=1e-5,
+                 atol=1e-7, grad=None, grad_tol=5e-3, eps=1e-5):
+        self.name = name
+        self.fn = fn
+        self.ref = ref
+        self.inputs = inputs          # list of np arrays (f64 for grad acc)
+        self.kwargs = kwargs or {}
+        self.rtol = rtol
+        self.atol = atol
+        # default: grad-check every float-input float-output op
+        self.grad = grad if grad is not None else all(
+            a.dtype.kind == "f" for a in inputs)
+        self.grad_tol = grad_tol
+        self.eps = eps
+
+    def __repr__(self):
+        return self.name
+
+
+R = np.random.RandomState
+
+
+def _arr(seed, *shape, lo=-1.0, hi=1.0, dtype=np.float64):
+    a = R(seed).uniform(lo, hi, shape).astype(dtype)
+    return a
+
+
+def _pos(seed, *shape, lo=0.2, hi=2.0):
+    return _arr(seed, *shape, lo=lo, hi=hi)
+
+
+def _ints(seed, *shape, lo=0, hi=10):
+    return R(seed).randint(lo, hi, shape).astype(np.int64)
+
+
+def _P(name):
+    return getattr(paddle, name)
+
+
+def _F(name):
+    return getattr(nn.functional, name)
+
+
+def _erf(x):
+    from scipy import special
+
+    return special.erf(x)
+
+
+def _softmax(x, axis=-1):
+    e = np.exp(x - x.max(axis=axis, keepdims=True))
+    return e / e.sum(axis=axis, keepdims=True)
+
+
+def _sigmoid(x):
+    return 1.0 / (1.0 + np.exp(-x))
+
+
+CASES = []
+
+
+def C(*a, **kw):
+    CASES.append(Case(*a, **kw))
+
+
+# ---- unary math ----------------------------------------------------------
+_X = _arr(0, 3, 4)
+for name, ref, inp in [
+    ("abs", np.abs, _arr(1, 3, 4, lo=0.1, hi=2.0)),
+    ("neg", np.negative, _X),
+    ("exp", np.exp, _X),
+    ("expm1", np.expm1, _X),
+    ("log", np.log, _pos(2, 3, 4)),
+    ("log2", np.log2, _pos(3, 3, 4)),
+    ("log10", np.log10, _pos(4, 3, 4)),
+    ("log1p", np.log1p, _pos(5, 3, 4)),
+    ("sqrt", np.sqrt, _pos(6, 3, 4)),
+    ("rsqrt", lambda x: 1 / np.sqrt(x), _pos(7, 3, 4)),
+    ("square", np.square, _X),
+    ("sin", np.sin, _X),
+    ("cos", np.cos, _X),
+    ("tan", np.tan, _arr(8, 3, 4, lo=-1.0, hi=1.0)),
+    ("asin", np.arcsin, _arr(9, 3, 4, lo=-0.9, hi=0.9)),
+    ("acos", np.arccos, _arr(10, 3, 4, lo=-0.9, hi=0.9)),
+    ("atan", np.arctan, _X),
+    ("sinh", np.sinh, _X),
+    ("cosh", np.cosh, _X),
+    ("tanh", np.tanh, _X),
+    ("asinh", np.arcsinh, _X),
+    ("acosh", np.arccosh, _pos(11, 3, 4, lo=1.2, hi=3.0)),
+    ("atanh", np.arctanh, _arr(12, 3, 4, lo=-0.9, hi=0.9)),
+    ("ceil", np.ceil, _arr(13, 3, 4, lo=0.6, hi=3.4)),
+    ("floor", np.floor, _arr(14, 3, 4, lo=0.6, hi=3.4)),
+    ("round", np.round, _arr(15, 3, 4, lo=0.6, hi=3.4)),
+    ("trunc", np.trunc, _arr(16, 3, 4, lo=0.6, hi=3.4)),
+    ("sign", np.sign, _arr(17, 3, 4, lo=0.2, hi=2.0)),
+    ("reciprocal", np.reciprocal, _pos(18, 3, 4)),
+    ("erf", _erf, _X),
+    ("digamma", None, None),  # placeholder removed below
+]:
+    if ref is None:
+        continue
+    grad = name not in ("ceil", "floor", "round", "trunc", "sign")
+    C(name, _P(name), ref, [inp], grad=grad)
+
+C("logit", lambda x: np.log(x / (1 - x)), _P("logit"),
+  [_arr(19, 3, 4, lo=0.2, hi=0.8)])
+CASES[-1].fn, CASES[-1].ref = _P("logit"), lambda x: np.log(x / (1 - x))
+
+# ---- binary math ---------------------------------------------------------
+_A, _B = _arr(20, 3, 4), _arr(21, 3, 4, lo=0.3, hi=1.5)
+for name, ref, a, b in [
+    ("add", np.add, _A, _B),
+    ("subtract", np.subtract, _A, _B),
+    ("multiply", np.multiply, _A, _B),
+    ("divide", np.divide, _A, _B),
+    ("maximum", np.maximum, _A, _B),
+    ("minimum", np.minimum, _A, _B),
+    ("pow", np.power, _pos(22, 3, 4), _arr(23, 3, 4, lo=0.5, hi=2.0)),
+    ("atan2", np.arctan2, _A, _B),
+    ("fmax", np.fmax, _A, _B),
+    ("fmin", np.fmin, _A, _B),
+    ("hypot", np.hypot, _pos(24, 3, 4), _pos(25, 3, 4)),
+    ("logaddexp", np.logaddexp, _A, _B),
+    ("nextafter", np.nextafter, _A, _B),
+    ("copysign", np.copysign, _A, _B),
+    ("heaviside", np.heaviside, _arr(26, 3, 4, lo=0.1), _B),
+]:
+    grad = name not in ("nextafter", "copysign", "heaviside")
+    C(name, _P(name), ref, [a, b], grad=grad)
+
+C("mod_float", _P("mod"), np.mod, [_pos(27, 3, 4), _pos(28, 3, 4)],
+  grad=False)
+C("floor_divide", _P("floor_divide"), np.floor_divide,
+  [_ints(29, 3, 4, lo=1, hi=20), _ints(30, 3, 4, lo=1, hi=5)])
+C("remainder_int", _P("remainder"), np.remainder,
+  [_ints(31, 3, 4, lo=0, hi=20), _ints(32, 3, 4, lo=1, hi=5)])
+C("broadcast_add", _P("add"), np.add, [_arr(33, 3, 1), _arr(34, 1, 4)])
+
+# ---- reductions ----------------------------------------------------------
+_RX = _arr(40, 3, 4, 5)
+for name, ref in [
+    ("sum", np.sum), ("mean", np.mean), ("max", np.max), ("min", np.min),
+    ("prod", np.prod),
+]:
+    grad = name in ("sum", "mean")
+    C(f"{name}_all", _P(name), ref, [_RX], grad=grad)
+    C(f"{name}_axis", _P(name), lambda x, _r=ref: _r(x, axis=1), [_RX],
+      kwargs={"axis": 1}, grad=grad)
+    C(f"{name}_keepdim", _P(name),
+      lambda x, _r=ref: _r(x, axis=2, keepdims=True), [_RX],
+      kwargs={"axis": 2, "keepdim": True}, grad=grad)
+C("logsumexp", _P("logsumexp"),
+  lambda x: np.log(np.exp(x).sum(-1)), [_arr(41, 3, 4)],
+  kwargs={"axis": -1})
+C("amax", _P("amax"), lambda x: np.max(x, axis=0), [_RX],
+  kwargs={"axis": 0}, grad=False)
+C("amin", _P("amin"), lambda x: np.min(x, axis=0), [_RX],
+  kwargs={"axis": 0}, grad=False)
+C("all", _P("all"), lambda x: np.all(x, axis=1),
+  [R(42).rand(3, 4) > 0.3], kwargs={"axis": 1})
+C("any", _P("any"), lambda x: np.any(x, axis=1),
+  [R(43).rand(3, 4) > 0.7], kwargs={"axis": 1})
+C("count_nonzero", _P("count_nonzero"),
+  lambda x: np.count_nonzero(x), [np.asarray(R(44).rand(3, 4) > 0.5,
+                                             np.float64)], grad=False)
+
+# ---- stat ---------------------------------------------------------------
+C("std", _P("std"), lambda x: np.std(x, ddof=1), [_RX])
+C("var", _P("var"), lambda x: np.var(x, ddof=1), [_RX])
+C("median", _P("median"), np.median, [_arr(45, 3, 5)], grad=False)
+C("nanmean", _P("nanmean"), np.nanmean, [_arr(46, 3, 4)])
+C("nansum", _P("nansum"), np.nansum, [_arr(47, 3, 4)])
+C("quantile", _P("quantile"), lambda x: np.quantile(x, 0.25),
+  [_arr(48, 20)], kwargs={"q": 0.25}, grad=False)
+C("kthvalue", _P("kthvalue"),
+  lambda x: np.partition(x, 2, axis=-1)[..., 2], [_arr(49, 3, 7)],
+  kwargs={"k": 3}, grad=False)
+
+# ---- logic / compare -----------------------------------------------------
+for name, ref in [
+    ("equal", np.equal), ("not_equal", np.not_equal),
+    ("greater_than", np.greater), ("greater_equal", np.greater_equal),
+    ("less_than", np.less), ("less_equal", np.less_equal),
+]:
+    C(name, _P(name), ref, [_ints(50, 3, 4, hi=3), _ints(51, 3, 4, hi=3)])
+C("logical_and", _P("logical_and"), np.logical_and,
+  [R(52).rand(3, 4) > 0.5, R(53).rand(3, 4) > 0.5])
+C("logical_or", _P("logical_or"), np.logical_or,
+  [R(54).rand(3, 4) > 0.5, R(55).rand(3, 4) > 0.5])
+C("logical_xor", _P("logical_xor"), np.logical_xor,
+  [R(56).rand(3, 4) > 0.5, R(57).rand(3, 4) > 0.5])
+C("logical_not", _P("logical_not"), np.logical_not,
+  [R(58).rand(3, 4) > 0.5])
+C("isnan", _P("isnan"), np.isnan,
+  [np.array([1.0, np.nan, np.inf, -1.0])], grad=False)
+C("isinf", _P("isinf"), np.isinf,
+  [np.array([1.0, np.nan, np.inf, -1.0])], grad=False)
+C("isfinite", _P("isfinite"), np.isfinite,
+  [np.array([1.0, np.nan, np.inf, -1.0])], grad=False)
+C("isclose", _P("isclose"), np.isclose,
+  [np.array([1.0, 2.0, 3.0]), np.array([1.0, 2.0001, 4.0])], grad=False)
+
+# ---- bitwise -------------------------------------------------------------
+C("bitwise_and", _P("bitwise_and"), np.bitwise_and,
+  [_ints(60, 3, 4, hi=16), _ints(61, 3, 4, hi=16)])
+C("bitwise_or", _P("bitwise_or"), np.bitwise_or,
+  [_ints(62, 3, 4, hi=16), _ints(63, 3, 4, hi=16)])
+C("bitwise_xor", _P("bitwise_xor"), np.bitwise_xor,
+  [_ints(64, 3, 4, hi=16), _ints(65, 3, 4, hi=16)])
+C("bitwise_not", _P("bitwise_not"), np.bitwise_not,
+  [_ints(66, 3, 4, hi=16)])
+
+# ---- manipulation --------------------------------------------------------
+_M = _arr(70, 2, 3, 4)
+C("reshape", _P("reshape"), lambda x: x.reshape(3, 8), [_M],
+  kwargs={"shape": [3, 8]})
+C("reshape_infer", _P("reshape"), lambda x: x.reshape(4, -1), [_M],
+  kwargs={"shape": [4, -1]})
+C("transpose", _P("transpose"), lambda x: x.transpose(2, 0, 1), [_M],
+  kwargs={"perm": [2, 0, 1]})
+C("squeeze", _P("squeeze"), lambda x: x.squeeze(1), [_arr(71, 3, 1, 4)],
+  kwargs={"axis": 1})
+C("unsqueeze", _P("unsqueeze"), lambda x: x[:, None], [_arr(72, 3, 4)],
+  kwargs={"axis": 1})
+C("flatten", _P("flatten"), lambda x: x.reshape(2, -1), [_M],
+  kwargs={"start_axis": 1, "stop_axis": 2})
+C("concat", lambda a, b: paddle.concat([a, b], axis=1),
+  lambda a, b: np.concatenate([a, b], axis=1),
+  [_arr(73, 2, 3), _arr(74, 2, 5)])
+C("stack", lambda a, b: paddle.stack([a, b], axis=0),
+  lambda a, b: np.stack([a, b]), [_arr(75, 3, 4), _arr(76, 3, 4)])
+C("split", lambda x: paddle.split(x, 2, axis=1)[1],
+  lambda x: np.split(x, 2, axis=1)[1], [_arr(77, 3, 8)])
+C("chunk", lambda x: paddle.chunk(x, 4, axis=0)[2],
+  lambda x: np.split(x, 4, axis=0)[2], [_arr(78, 8, 3)])
+C("tile", _P("tile"), lambda x: np.tile(x, (2, 3)), [_arr(79, 2, 3)],
+  kwargs={"repeat_times": [2, 3]})
+C("expand", _P("expand"), lambda x: np.broadcast_to(x, (4, 3, 2)),
+  [_arr(80, 3, 2)], kwargs={"shape": [4, 3, 2]})
+C("flip", _P("flip"), lambda x: np.flip(x, 1), [_M], kwargs={"axis": 1})
+C("roll", _P("roll"), lambda x: np.roll(x, 2, 1), [_arr(81, 3, 5)],
+  kwargs={"shifts": 2, "axis": 1})
+C("repeat_interleave", _P("repeat_interleave"),
+  lambda x: np.repeat(x, 3, axis=1), [_arr(82, 2, 3)],
+  kwargs={"repeats": 3, "axis": 1})
+C("broadcast_to", _P("broadcast_to"),
+  lambda x: np.broadcast_to(x, (5, 2, 3)), [_arr(83, 2, 3)],
+  kwargs={"shape": [5, 2, 3]})
+C("rot90", _P("rot90"), lambda x: np.rot90(x, 1, (0, 1)), [_arr(84, 3, 4)])
+C("moveaxis", _P("moveaxis"), lambda x: np.moveaxis(x, 0, 2), [_M],
+  kwargs={"source": 0, "destination": 2})
+C("as_strided_T", _P("t"), lambda x: x.T, [_arr(85, 3, 5)])
+C("pad_spatial", lambda x: paddle.nn.functional.pad(
+    x, [1, 2], value=0.5, data_format="NCL"),
+  lambda x: np.pad(x, [(0, 0), (0, 0), (1, 2)], constant_values=0.5),
+  [_arr(86, 2, 3, 4)])
+C("pad_fullrank", lambda x: paddle.nn.functional.pad(x, [1, 0, 0, 2]),
+  lambda x: np.pad(x, [(1, 0), (0, 2)]), [_arr(86, 2, 3)])
+C("gather", lambda x: paddle.gather(x, paddle.to_tensor(
+    np.array([2, 0, 1])), axis=0),
+  lambda x: x[[2, 0, 1]], [_arr(87, 4, 3)])
+C("index_select", lambda x: paddle.index_select(
+    x, paddle.to_tensor(np.array([0, 2])), axis=1),
+  lambda x: x[:, [0, 2]], [_arr(88, 3, 4)])
+C("masked_select", lambda x: paddle.masked_select(
+    x, paddle.to_tensor(np.asarray(
+        [[True, False, True], [False, True, False]]))),
+  lambda x: x[np.array([[True, False, True], [False, True, False]])],
+  [_arr(89, 2, 3)], grad=False)
+C("take_along_axis", lambda x: paddle.take_along_axis(
+    x, paddle.to_tensor(_ints(90, 3, 2, hi=4)), axis=1),
+  lambda x: np.take_along_axis(x, _ints(90, 3, 2, hi=4), axis=1),
+  [_arr(91, 3, 4)])
+C("diag", _P("diag"), np.diag, [_arr(92, 4)])
+C("diagonal", _P("diagonal"), lambda x: np.diagonal(x, 0, 0, 1),
+  [_arr(93, 3, 3)])
+C("tril", _P("tril"), np.tril, [_arr(94, 4, 4)])
+C("triu", _P("triu"), np.triu, [_arr(95, 4, 4)])
+C("unbind", lambda x: paddle.unbind(x, axis=0)[1], lambda x: x[1],
+  [_arr(96, 3, 4)])
+C("where", lambda c, a, b: paddle.where(c, a, b), np.where,
+  [R(97).rand(3, 4) > 0.5, _arr(98, 3, 4), _arr(99, 3, 4)])
+C("clip", _P("clip"), lambda x: np.clip(x, -0.5, 0.5), [_X],
+  kwargs={"min": -0.5, "max": 0.5})
+C("cumsum", _P("cumsum"), lambda x: np.cumsum(x, 1), [_arr(100, 3, 4)],
+  kwargs={"axis": 1})
+C("cumprod", _P("cumprod"), lambda x: np.cumprod(x, 0),
+  [_pos(101, 3, 4)], kwargs={"dim": 0})
+C("cummax", _P("cummax"),
+  lambda x: np.maximum.accumulate(x, 1), [_arr(102, 3, 4)],
+  kwargs={"axis": 1}, grad=False)
+C("cummin", _P("cummin"),
+  lambda x: np.minimum.accumulate(x, 1), [_arr(103, 3, 4)],
+  kwargs={"axis": 1}, grad=False)
+
+# ---- search / sort -------------------------------------------------------
+C("argmax", _P("argmax"), lambda x: np.argmax(x, 1), [_arr(110, 3, 5)],
+  kwargs={"axis": 1}, grad=False)
+C("argmin", _P("argmin"), lambda x: np.argmin(x, 1), [_arr(111, 3, 5)],
+  kwargs={"axis": 1}, grad=False)
+C("argsort", _P("argsort"), lambda x: np.argsort(x, 1, kind="stable"),
+  [_arr(112, 3, 5)], kwargs={"axis": 1}, grad=False)
+C("sort", _P("sort"), lambda x: np.sort(x, 1), [_arr(113, 3, 5)],
+  kwargs={"axis": 1})
+C("topk", lambda x: paddle.topk(x, 3)[0],
+  lambda x: np.sort(x, -1)[..., ::-1][..., :3], [_arr(114, 2, 6)])
+C("searchsorted", lambda s, v: paddle.searchsorted(s, v),
+  lambda s, v: np.searchsorted(s, v),
+  [np.sort(_arr(115, 8)), _arr(116, 5)], grad=False)
+C("nonzero", lambda x: paddle.nonzero(x),
+  lambda x: np.stack(np.nonzero(x), -1),
+  [np.asarray(R(117).rand(3, 4) > 0.5, np.float64)], grad=False)
+C("unique_sorted", lambda x: paddle.unique(x),
+  lambda x: np.unique(x), [_ints(118, 12, hi=5)], grad=False)
+C("index_sample", lambda x: paddle.index_sample(
+    x, paddle.to_tensor(_ints(119, 3, 2, hi=4))),
+  lambda x: np.take_along_axis(x, _ints(119, 3, 2, hi=4), axis=1),
+  [_arr(120, 3, 4)])
+
+# ---- linalg --------------------------------------------------------------
+C("matmul", _P("matmul"), np.matmul, [_arr(130, 3, 4), _arr(131, 4, 5)])
+C("matmul_bcast", _P("matmul"), np.matmul,
+  [_arr(132, 2, 3, 4), _arr(133, 4, 5)])
+C("dot", _P("dot"), lambda a, b: (a * b).sum(-1),
+  [_arr(134, 5), _arr(135, 5)])
+C("inner", _P("inner"), np.inner, [_arr(136, 3, 4), _arr(137, 2, 4)])
+C("outer", _P("outer"), np.outer, [_arr(138, 3), _arr(139, 4)])
+C("norm_fro", _P("norm"), lambda x: np.linalg.norm(x), [_arr(140, 3, 4)])
+C("norm_1", lambda x: paddle.norm(x, p=1, axis=1),
+  lambda x: np.abs(x).sum(1), [_arr(141, 3, 4, lo=0.2, hi=1.0)])
+C("trace", _P("trace"), np.trace, [_arr(142, 4, 4)])
+C("cholesky", _P("cholesky"),
+  lambda x: np.linalg.cholesky(x),
+  [np.eye(3) * 2 + 0.3 * (_arr(143, 3, 3) + _arr(143, 3, 3).T)],
+  grad=False)
+C("inverse", _P("inverse"), np.linalg.inv,
+  [np.eye(3) * 2 + 0.1 * _arr(144, 3, 3)], grad=False)
+C("matrix_power", lambda x: paddle.linalg.matrix_power(x, 3),
+  lambda x: np.linalg.matrix_power(x, 3), [_arr(145, 3, 3)], grad=False)
+C("solve", _P("linalg").solve if hasattr(_P("linalg"), "solve")
+  else None, np.linalg.solve,
+  [np.eye(3) * 2 + 0.1 * _arr(146, 3, 3), _arr(147, 3, 2)], grad=False)
+C("cross", _P("cross"), lambda a, b: np.cross(a, b),
+  [_arr(148, 4, 3), _arr(149, 4, 3)])
+C("bmm", _P("bmm"), np.matmul, [_arr(150, 2, 3, 4), _arr(151, 2, 4, 5)])
+C("mv", _P("mv"), np.matmul, [_arr(152, 3, 4), _arr(153, 4)])
+C("kron", _P("kron"), np.kron, [_arr(154, 2, 2), _arr(155, 3, 2)])
+C("einsum_ij", lambda a, b: paddle.einsum("ij,jk->ik", a, b),
+  lambda a, b: a @ b, [_arr(156, 3, 4), _arr(157, 4, 2)])
+
+# ---- creation ------------------------------------------------------------
+C("zeros", lambda: paddle.zeros([3, 4]), lambda: np.zeros((3, 4)), [],
+  grad=False)
+C("ones", lambda: paddle.ones([2, 5]), lambda: np.ones((2, 5)), [],
+  grad=False)
+C("full", lambda: paddle.full([2, 3], 7.5),
+  lambda: np.full((2, 3), 7.5), [], grad=False)
+C("arange", lambda: paddle.arange(2, 14, 3),
+  lambda: np.arange(2, 14, 3), [], grad=False)
+C("linspace", lambda: paddle.linspace(0, 1, 7),
+  lambda: np.linspace(0, 1, 7), [], grad=False)
+C("eye", lambda: paddle.eye(4, 3), lambda: np.eye(4, 3), [], grad=False)
+C("full_like", _P("full_like"), lambda x: np.full_like(x, 2.0),
+  [_arr(160, 2, 3)], kwargs={"fill_value": 2.0}, grad=False)
+C("zeros_like", _P("zeros_like"), np.zeros_like, [_arr(161, 2, 3)],
+  grad=False)
+C("ones_like", _P("ones_like"), np.ones_like, [_arr(162, 2, 3)],
+  grad=False)
+C("tril_indices", lambda: paddle.tril_indices(3, 3, 0),
+  lambda: np.stack(np.tril_indices(3, 0, 3)), [], grad=False)
+C("meshgrid", lambda a, b: paddle.meshgrid(a, b)[0],
+  lambda a, b: np.meshgrid(a, b, indexing="ij")[0],
+  [_arr(163, 3), _arr(164, 4)], grad=False)
+C("diagflat", _P("diagflat"), np.diagflat, [_arr(165, 3)], grad=False)
+
+# ---- activations (nn.functional) ----------------------------------------
+_AX = _arr(170, 3, 5)
+C("relu", _F("relu"), lambda x: np.maximum(x, 0),
+  [_arr(171, 3, 5, lo=0.05, hi=1.0) * np.where(
+      R(172).rand(3, 5) > 0.5, 1, -1)])
+C("relu6", _F("relu6"), lambda x: np.clip(x, 0, 6), [_AX * 4])
+C("leaky_relu", _F("leaky_relu"),
+  lambda x: np.where(x > 0, x, 0.01 * x), [_AX])
+C("elu", _F("elu"), lambda x: np.where(x > 0, x, np.expm1(x)), [_AX])
+C("selu", _F("selu"),
+  lambda x: 1.0507009873554805 * np.where(
+      x > 0, x, 1.6732632423543772 * np.expm1(x)), [_AX])
+C("celu", _F("celu"), lambda x: np.where(x > 0, x, np.expm1(x)), [_AX])
+C("gelu_exact", _F("gelu"), lambda x: 0.5 * x * (1 + _erf(x / np.sqrt(2))),
+  [_AX])
+C("gelu_tanh", lambda x: nn.functional.gelu(x, approximate=True),
+  lambda x: 0.5 * x * (1 + np.tanh(
+      np.sqrt(2 / np.pi) * (x + 0.044715 * x ** 3))), [_AX])
+C("sigmoid", _F("sigmoid"), _sigmoid, [_AX])
+C("hardsigmoid", _F("hardsigmoid"),
+  lambda x: np.clip(x / 6 + 0.5, 0, 1), [_AX * 4])
+C("hardswish", _F("hardswish"),
+  lambda x: x * np.clip(x + 3, 0, 6) / 6, [_AX * 2])
+C("hardtanh", _F("hardtanh"), lambda x: np.clip(x, -1, 1), [_AX * 2])
+C("softplus", _F("softplus"), lambda x: np.log1p(np.exp(x)), [_AX])
+C("softsign", _F("softsign"), lambda x: x / (1 + np.abs(x)), [_AX])
+C("silu", _F("silu"), lambda x: x * _sigmoid(x), [_AX])
+C("mish", _F("mish"),
+  lambda x: x * np.tanh(np.log1p(np.exp(x))), [_AX])
+C("swish", _F("swish"), lambda x: x * _sigmoid(x), [_AX])
+C("tanhshrink", _F("tanhshrink"), lambda x: x - np.tanh(x), [_AX])
+C("softshrink", _F("softshrink"),
+  lambda x: np.where(x > 0.5, x - 0.5, np.where(x < -0.5, x + 0.5, 0)),
+  [_AX * 2])
+C("hardshrink", _F("hardshrink"),
+  lambda x: np.where(np.abs(x) > 0.5, x, 0), [_AX * 2])
+C("softmax", _F("softmax"), _softmax, [_AX])
+C("log_softmax", _F("log_softmax"),
+  lambda x: np.log(_softmax(x)), [_AX])
+C("log_sigmoid", _F("log_sigmoid"),
+  lambda x: -np.log1p(np.exp(-x)), [_AX])
+C("thresholded_relu", _F("thresholded_relu"),
+  lambda x: np.where(x > 1.0, x, 0), [_AX * 3])
+C("prelu", lambda x: nn.functional.prelu(
+    x, paddle.to_tensor(np.asarray([0.2]))),
+  lambda x: np.where(x > 0, x, 0.2 * x), [_AX])
+
+# ---- losses vs hand formulas ---------------------------------------------
+_LOGITS = _arr(180, 4, 6)
+_ONEHOT = np.eye(6)[_ints(181, 4, hi=6)]
+C("mse_loss", lambda a, b: nn.functional.mse_loss(a, b),
+  lambda a, b: np.mean((a - b) ** 2), [_arr(182, 4, 3), _arr(183, 4, 3)])
+C("l1_loss", lambda a, b: nn.functional.l1_loss(a, b),
+  lambda a, b: np.mean(np.abs(a - b)),
+  [_arr(184, 4, 3), _arr(184, 4, 3) + _pos(185, 4, 3)])
+C("smooth_l1", lambda a, b: nn.functional.smooth_l1_loss(a, b),
+  lambda a, b: np.mean(np.where(np.abs(a - b) < 1,
+                                0.5 * (a - b) ** 2,
+                                np.abs(a - b) - 0.5)),
+  [_arr(186, 4, 3) * 3, _arr(187, 4, 3)])
+C("bce_with_logits",
+  lambda x, y: nn.functional.binary_cross_entropy_with_logits(x, y),
+  lambda x, y: np.mean(np.maximum(x, 0) - x * y + np.log1p(
+      np.exp(-np.abs(x)))),
+  [_LOGITS, np.asarray(R(188).rand(4, 6) > 0.5, np.float64)])
+C("kl_div", lambda x, y: nn.functional.kl_div(x, y, reduction="mean"),
+  lambda x, y: np.mean(y * (np.log(y) - x)),
+  [np.log(_softmax(_arr(189, 4, 6))), _softmax(_arr(190, 4, 6))])
+C("cross_entropy_soft",
+  lambda x, y: nn.functional.cross_entropy(x, y, soft_label=True),
+  lambda x, y: np.mean(-(y * np.log(_softmax(x))).sum(-1)),
+  [_LOGITS, _ONEHOT])
+
+# ---- misc tensor methods -------------------------------------------------
+C("lerp", _P("lerp"), lambda a, b, w: a + w * (b - a),
+  [_arr(191, 3, 4), _arr(192, 3, 4), _pos(193, 3, 4, lo=0.1, hi=0.9)])
+C("addmm", lambda i, a, b: paddle.addmm(i, a, b, beta=0.5, alpha=2.0),
+  lambda i, a, b: 0.5 * i + 2.0 * (a @ b),
+  [_arr(194, 3, 5), _arr(195, 3, 4), _arr(196, 4, 5)])
+C("diff", _P("diff"), lambda x: np.diff(x, axis=-1), [_arr(198, 3, 5)])
+C("sgn_float", _P("sgn"), np.sign, [_arr(199, 3, 4, lo=0.2, hi=1.0)],
+  grad=False)
+C("frac", _P("frac"), lambda x: x - np.trunc(x), [_arr(200, 3, 4) * 3])
+C("nan_to_num", _P("nan_to_num"), np.nan_to_num,
+  [np.array([1.0, np.nan, np.inf, -np.inf])], grad=False)
+C("angle_real", _P("angle"), np.angle,
+  [_arr(201, 3, 4, lo=0.2, hi=1.0)], grad=False)
+C("conj_real", _P("conj"), np.conj, [_arr(202, 3, 4)])
+C("real_of_complex", _P("real"), np.real,
+  [_arr(203, 3, 4) + 1j * _arr(204, 3, 4)], grad=False)
+C("imag_of_complex", _P("imag"), np.imag,
+  [_arr(205, 3, 4) + 1j * _arr(206, 3, 4)], grad=False)
+
+CASES = [c for c in CASES if c.fn is not None]
+
+
+def _np(x):
+    if hasattr(x, "numpy"):
+        return np.asarray(x.numpy())
+    return np.asarray(x)
+
+
+def _first(out):
+    if isinstance(out, (list, tuple)):
+        return out[0]
+    return out
+
+
+@pytest.mark.parametrize("case", CASES, ids=repr)
+def test_forward(case):
+    args = [paddle.to_tensor(a) for a in case.inputs]
+    got = _np(_first(case.fn(*args, **case.kwargs)))
+    want = np.asarray(case.ref(*case.inputs))
+    assert got.shape == tuple(want.shape), (got.shape, want.shape)
+    if want.dtype.kind in "fc":
+        np.testing.assert_allclose(got, want, rtol=case.rtol,
+                                   atol=case.atol, err_msg=case.name)
+    else:
+        np.testing.assert_array_equal(got, want, err_msg=case.name)
+
+
+GRAD_CASES = [c for c in CASES if c.grad and c.inputs]
+
+
+@pytest.mark.parametrize("case", GRAD_CASES, ids=repr)
+def test_grad(case):
+    rng = R(1234)
+    out0 = case.ref(*case.inputs)
+    cot = rng.randn(*np.asarray(out0).shape)
+
+    def loss_np(*arrays):
+        return float((np.asarray(case.ref(*arrays)) * cot).sum())
+
+    # analytic grads via the tape
+    ts = [paddle.to_tensor(a) for a in case.inputs]
+    for t in ts:
+        t.stop_gradient = False
+    out = _first(case.fn(*ts, **case.kwargs))
+    loss = (out * paddle.to_tensor(cot)).sum()
+    loss.backward()
+
+    # directional derivative check per differentiable input
+    eps = case.eps
+    for i, a in enumerate(case.inputs):
+        if a.dtype.kind != "f":
+            continue
+        d = rng.randn(*a.shape)
+        plus = [x.copy() for x in case.inputs]
+        minus = [x.copy() for x in case.inputs]
+        plus[i] = a + eps * d
+        minus[i] = a - eps * d
+        numeric = (loss_np(*plus) - loss_np(*minus)) / (2 * eps)
+        g = ts[i].grad
+        assert g is not None, f"{case.name}: input {i} got no grad"
+        analytic = float((_np(g) * d).sum())
+        denom = max(abs(numeric), abs(analytic), 1.0)
+        assert abs(numeric - analytic) / denom < case.grad_tol, (
+            f"{case.name} input {i}: analytic {analytic} vs numeric "
+            f"{numeric}")

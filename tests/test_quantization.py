@@ -139,3 +139,52 @@ class TestQAT:
         out = np.asarray(model(x).numpy())
         err = np.abs(out - ref).max() / (np.abs(ref).max() + 1e-8)
         assert err < 0.1, err
+
+
+class TestReviewRegressions:
+    def test_ptq_bare_linear_root(self):
+        """A quantizable ROOT layer must be converted (returned), not
+        silently left float."""
+        paddle.seed(7)
+        lin = nn.Linear(8, 4)
+        ptq = ImperativePTQ()
+        ptq.quantize(lin)
+        lin(paddle.randn([4, 8]))
+        out = ptq.convert(lin)
+        assert isinstance(out, QuantizedLinear)
+
+    def test_ptq_conv_nhwc(self):
+        paddle.seed(8)
+        conv = nn.Conv2D(3, 5, 3, data_format="NHWC")
+        conv.eval()
+        x = paddle.randn([2, 8, 8, 3])
+        ref = np.asarray(conv(x).numpy())
+        ptq = ImperativePTQ()
+        ptq.quantize(conv)
+        conv(x)
+        q = ptq.convert(conv)
+        assert isinstance(q, QuantizedConv2D)
+        out = np.asarray(q(x).numpy())
+        assert out.shape == ref.shape
+        err = np.abs(out - ref).max() / (np.abs(ref).max() + 1e-8)
+        assert err < 0.1, err
+
+
+def test_asp_2d_pattern_and_density():
+    """The 2-D greedy must satisfy the n:m cap on BOTH axes (reference
+    guarantee) and keep density near n/m (the reference greedy fills most
+    rows to exactly n; the global descending scan matches it)."""
+    from paddle_tpu.incubate import asp
+
+    rng = np.random.RandomState(3)
+    dens = []
+    for _ in range(10):
+        w = rng.randn(8, 8)
+        mask = asp.get_mask_2d_greedy(w, 2, 4)
+        for r0 in range(0, 8, 4):
+            for c0 in range(0, 8, 4):
+                block = mask[r0:r0 + 4, c0:c0 + 4]
+                assert (block.sum(1) <= 2).all()
+                assert (block.sum(0) <= 2).all()
+        dens.append(mask.mean())
+    assert np.mean(dens) > 0.45, np.mean(dens)

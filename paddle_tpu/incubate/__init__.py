@@ -15,3 +15,14 @@ __all__ = [
     "graph_reindex", "graph_khop_sampler", "segment_sum", "segment_mean",
     "segment_max", "segment_min",
 ]
+
+
+def softmax_cross_entropy_blockwise(hidden, weight, labels, block=8192):
+    """TPU-native fused CE over a tied projection without materializing
+    [N, V] logits (see ops/blockwise_ce.py; capability reference:
+    phi/kernels/gpu/cross_entropy_kernel.cu:1 fused softmax+CE)."""
+    from ..core.autograd import apply
+    from ..ops.blockwise_ce import blockwise_softmax_ce
+
+    return apply(lambda h, w, l: blockwise_softmax_ce(h, w, l, block),
+                 hidden, weight, labels)

@@ -209,15 +209,19 @@ class TranslatedLayer(Layer):
     """Inference layer rebuilt from serialized StableHLO + params
     (reference: fluid/dygraph/io.py TranslatedLayer)."""
 
-    def __init__(self, exported, params):
+    def __init__(self, exported, params, call=None):
         super().__init__()
         self._exported = exported
         self._params = params
+        # one jitted entry per loaded artifact: all TranslatedLayers (and
+        # therefore all inference Predictors) of the same model share one
+        # executable cache — no recompilation across instances
+        self._call = call if call is not None else jax.jit(exported.call)
 
     def forward(self, *args):
         arg_vals = [a._value if isinstance(a, Tensor)
                     else jnp.asarray(np.asarray(a)) for a in args]
-        outs = self._exported.call(self._params, *arg_vals)
+        outs = self._call(self._params, *arg_vals)
         return jax.tree_util.tree_map(Tensor, outs)
 
     def eval(self):
@@ -227,13 +231,32 @@ class TranslatedLayer(Layer):
         raise RuntimeError("TranslatedLayer is inference-only")
 
 
+# (abspath, pdmodel mtime, pdiparams mtime) -> (Exported, params, jitted
+# call). Bounded: the cache exists to share one executable across Predictor
+# instances of the SAME live model, not to pin every model ever loaded.
+_load_cache = {}
+_LOAD_CACHE_MAX = 8
+
+
 def load(path, **configs):
+    import os as _os
+
     from jax import export as jexport
 
-    with open(path + ".pdmodel", "rb") as f:
-        exported = jexport.deserialize(bytearray(f.read()))
-    params = {k: v._value for k, v in _pload(path + ".pdiparams").items()}
-    return TranslatedLayer(exported, params)
+    key = (_os.path.abspath(path),
+           _os.path.getmtime(path + ".pdmodel"),
+           _os.path.getmtime(path + ".pdiparams"))
+    ent = _load_cache.get(key)
+    if ent is None:
+        with open(path + ".pdmodel", "rb") as f:
+            exported = jexport.deserialize(bytearray(f.read()))
+        params = {k: v._value
+                  for k, v in _pload(path + ".pdiparams").items()}
+        if len(_load_cache) >= _LOAD_CACHE_MAX:
+            _load_cache.pop(next(iter(_load_cache)))
+        ent = _load_cache[key] = (exported, params,
+                                  jax.jit(exported.call))
+    return TranslatedLayer(*ent)
 
 
 def set_verbosity(level=0, also_to_stdout=False):

@@ -29,7 +29,8 @@ class GPTConfig:
     def __init__(self, vocab_size=50304, hidden_size=768, num_layers=12,
                  num_heads=12, intermediate_size=None, max_position=1024,
                  dropout=0.1, layer_norm_eps=1e-5, initializer_range=0.02,
-                 use_flash=True, pp_num_micro=None, pp_recompute=False):
+                 use_flash=True, pp_num_micro=None, pp_recompute=False,
+                 fused_loss=None):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
         self.num_layers = num_layers
@@ -45,6 +46,11 @@ class GPTConfig:
         # rematerialization (jax.checkpoint) to trade FLOPs for HBM
         self.pp_num_micro = pp_num_micro
         self.pp_recompute = pp_recompute
+        # blockwise fused softmax-CE over the tied head (never materializes
+        # [B*S, V] logits); auto-on for big vocabs where that buffer is the
+        # HBM peak (None -> vocab >= 16384)
+        self.fused_loss = (vocab_size >= 16384 if fused_loss is None
+                           else fused_loss)
 
 
 class GPTAttention(nn.Layer):
@@ -267,6 +273,15 @@ class GPTForCausalLM(nn.Layer):
         from .. import tensor as T
 
         hidden = self.gpt(input_ids, position_ids)
+        if labels is not None and self.config.fused_loss:
+            from ..core.autograd import apply
+            from ..ops.blockwise_ce import blockwise_softmax_ce
+
+            h = self.config.hidden_size
+            return apply(
+                lambda hv, wv, lv: blockwise_softmax_ce(
+                    hv.reshape(-1, h), wv, lv.reshape(-1)),
+                hidden, self.gpt.wte.weight, labels)
         logits = T.matmul(hidden, self.gpt.wte.weight, transpose_y=True)
         if labels is not None:
             loss = nn.functional.cross_entropy(

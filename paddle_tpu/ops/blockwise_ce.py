@@ -1,0 +1,122 @@
+"""Blockwise fused softmax cross-entropy over a tied projection.
+
+Capability reference: paddle/fluid/operators/fused/fused_softmax_mask_op.cu:1
+and phi/kernels/gpu/cross_entropy_kernel.cu:1 — the reference fuses softmax
++ CE on GPU but still materializes the [N, V] logits.
+
+TPU-native design: for a tied LM head, loss_i = logsumexp_v(h_i.w_v) -
+h_i.w_{y_i}. Materializing logits costs N*V*4 bytes of HBM (GPT-2: ~800MB
+per step at batch 8 x seq 512 x vocab 50k) and is pure HBM-bandwidth
+waste. This op scans the vocab in blocks with an online logsumexp (flash-
+attention's trick applied to the classifier): peak activation memory drops
+from O(N*V) to O(N*block). The custom VJP recomputes each block's logits
+in the backward pass (dlogits = softmax - onehot, accumulated blockwise),
+so nothing [N, V]-shaped is ever resident.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["blockwise_softmax_ce"]
+
+
+def _pad_vocab(weight, block):
+    v = weight.shape[0]
+    pad = (-v) % block
+    if pad:
+        weight = jnp.pad(weight, ((0, pad), (0, 0)))
+    return weight, v, v + pad
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def blockwise_softmax_ce(hidden, weight, labels, block=8192,
+                         ignore_index=-100):
+    """Mean CE of softmax(hidden @ weight.T) against integer labels.
+
+    hidden: [N, H]; weight: [V, H] (tied embedding); labels: [N] int.
+    Equivalent to cross_entropy(hidden @ weight.T, labels) without the
+    [N, V] intermediate; labels == ignore_index are excluded from the mean
+    and receive zero gradient (cross_entropy parity).
+    """
+    loss, _ = _forward(hidden, weight, labels, block, ignore_index)
+    return loss
+
+
+def _forward(hidden, weight, labels, block, ignore_index):
+    n, h = hidden.shape
+    wpad, v, vp = _pad_vocab(weight, block)
+    hidden_f = hidden.astype(jnp.float32)
+    n_blocks = vp // block
+    w_blocks = wpad.reshape(n_blocks, block, h)
+
+    def tick(carry, wb_i):
+        m, s, lab_logit = carry
+        wb, i = wb_i
+        logits = hidden_f @ wb.astype(jnp.float32).T        # [N, block]
+        # vocab-padding rows must not contribute to the logsumexp
+        valid = (i * block + jnp.arange(block)) < v
+        logits = jnp.where(valid[None, :], logits, -jnp.inf)
+        bm = logits.max(-1)
+        new_m = jnp.maximum(m, bm)
+        s = s * jnp.exp(m - new_m) + (
+            jnp.exp(logits - new_m[:, None]).sum(-1))
+        # gather the label logit if it lives in this block
+        local = labels - i * block
+        in_blk = (local >= 0) & (local < block)
+        picked = jnp.take_along_axis(
+            logits, jnp.clip(local, 0, block - 1)[:, None], axis=1)[:, 0]
+        lab_logit = jnp.where(in_blk, picked, lab_logit)
+        return (new_m, s, lab_logit), None
+
+    init = (jnp.full((n,), -jnp.inf, jnp.float32),
+            jnp.zeros((n,), jnp.float32),
+            jnp.zeros((n,), jnp.float32))
+    (m, s, lab_logit), _ = jax.lax.scan(
+        tick, init, (w_blocks, jnp.arange(n_blocks)))
+    lse = m + jnp.log(s)
+    keep = (labels != ignore_index)
+    n_valid = jnp.maximum(keep.sum(), 1)
+    loss = jnp.where(keep, lse - lab_logit, 0.0).sum() / n_valid
+    return loss, (hidden, weight, labels, lse, keep, n_valid)
+
+
+def _fwd(hidden, weight, labels, block, ignore_index):
+    loss, res = _forward(hidden, weight, labels, block, ignore_index)
+    return loss, res
+
+
+def _bwd(block, ignore_index, res, g):
+    hidden, weight, labels, lse, keep, n_valid = res
+    n, h = hidden.shape
+    wpad, v, vp = _pad_vocab(weight, block)
+    hidden_f = hidden.astype(jnp.float32)
+    n_blocks = vp // block
+    w_blocks = wpad.reshape(n_blocks, block, h)
+    # per-row cotangent: g/n_valid for kept rows, 0 for ignored rows
+    scale = jnp.where(keep, g / n_valid, 0.0)[:, None]
+
+    def tick(dh, wb_i):
+        wb, i = wb_i
+        wbf = wb.astype(jnp.float32)
+        logits = hidden_f @ wbf.T                            # recompute
+        valid = (i * block + jnp.arange(block)) < v
+        logits = jnp.where(valid[None, :], logits, -jnp.inf)
+        p = jnp.exp(logits - lse[:, None])                   # softmax block
+        local = labels - i * block
+        onehot = (local[:, None] ==
+                  jnp.arange(block)[None, :]).astype(jnp.float32)
+        dlogits = (p - onehot) * scale                       # [N, block]
+        dh = dh + dlogits @ wbf                              # [N, H]
+        dwb = dlogits.T @ hidden_f                           # [block, H]
+        return dh, dwb
+
+    dh, dwbs = jax.lax.scan(tick, jnp.zeros((n, h), jnp.float32),
+                            (w_blocks, jnp.arange(n_blocks)))
+    dw = dwbs.reshape(vp, h)[:v]
+    return (dh.astype(hidden.dtype), dw.astype(weight.dtype), None)
+
+
+blockwise_softmax_ce.defvjp(_fwd, _bwd)

@@ -372,3 +372,8 @@ class IpuCompiledProgram:
 # quantization passes under paddle.static in 2.4+; the 2.3 tree keeps them
 # in fluid/contrib/slim/quantization — same classes either way)
 from .. import quantization as quantization  # noqa: E402,F401
+
+
+# paddle.static.sparsity (reference: python/paddle/static/sparsity —
+# re-exports the ASP helpers)
+from ..incubate import asp as sparsity  # noqa: E402,F401

@@ -85,3 +85,29 @@ def test_batch_and_flags():
     paddle.disable_signal_handler()
     assert isinstance(paddle.DataParallel, type)
     assert paddle.NPUPlace(0) is not None
+
+
+def test_round3_namespace_exports():
+    """Round-3 namespaces: quantization/auto_parallel/sparsity/text match
+    the reference surfaces they mirror."""
+    from paddle_tpu import text
+    from paddle_tpu.distributed import auto_parallel
+
+    # paddle.distributed re-exports shard_tensor/shard_op (reference
+    # distributed/__init__.py:45)
+    assert hasattr(paddle.distributed, "shard_tensor")
+    assert hasattr(paddle.distributed, "shard_op")
+    assert hasattr(auto_parallel, "ProcessMesh")
+    assert hasattr(auto_parallel, "Engine")
+    # paddle.static.sparsity (reference static/sparsity/__init__.py)
+    for n in ("calculate_density", "decorate", "prune_model",
+              "set_excluded_layers", "reset_excluded_layers"):
+        assert hasattr(paddle.static.sparsity, n), n
+    # slim quantization classes
+    for n in ("PostTrainingQuantization", "ImperativeQuantAware",
+              "QuantConfig"):
+        assert hasattr(paddle.static.quantization, n), n
+    # text datasets (reference text/__init__.py exports)
+    for n in ("Imdb", "Imikolov", "Movielens", "UCIHousing", "Conll05st",
+              "WMT14", "WMT16", "ViterbiDecoder", "viterbi_decode"):
+        assert hasattr(text, n), n

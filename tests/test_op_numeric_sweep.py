@@ -122,9 +122,8 @@ for name, ref, inp in [
     grad = name not in ("ceil", "floor", "round", "trunc", "sign")
     C(name, _P(name), ref, [inp], grad=grad)
 
-C("logit", lambda x: np.log(x / (1 - x)), _P("logit"),
+C("logit", _P("logit"), lambda x: np.log(x / (1 - x)),
   [_arr(19, 3, 4, lo=0.2, hi=0.8)])
-CASES[-1].fn, CASES[-1].ref = _P("logit"), lambda x: np.log(x / (1 - x))
 
 # ---- binary math ---------------------------------------------------------
 _A, _B = _arr(20, 3, 4), _arr(21, 3, 4, lo=0.3, hi=1.5)

@@ -20,6 +20,8 @@ from jax.sharding import Mesh
 from .. import env as _env
 from ..collective import get_rank, get_world_size, new_group
 from . import base  # noqa: F401
+from . import utils  # noqa: F401
+from .utils import recompute  # noqa: F401
 from .base import DistributedStrategy  # noqa: F401
 
 __all__ = ["init", "reset", "DistributedStrategy", "distributed_model",

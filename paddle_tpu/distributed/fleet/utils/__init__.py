@@ -1,0 +1,92 @@
+"""fleet.utils — activation recompute + filesystem shims.
+
+Reference: python/paddle/distributed/fleet/utils/recompute.py:331
+(recompute: re-run the forward inside backward to trade FLOPs for
+activation memory, with CUDA RNG state preservation) and fs.py
+(LocalFS/HDFSClient).
+
+TPU-native: recompute IS `jax.checkpoint` — the XLA scheduler rematerializes
+the wrapped segment during the backward pass. RNG correctness comes from
+the functional PRNG (keys are values, not device state), so no state
+save/restore dance is needed.
+"""
+from __future__ import annotations
+
+import jax
+
+from ....core.tensor import Tensor
+from ....nn.layer.layers import Layer
+
+__all__ = ["recompute", "LocalFS"]
+
+
+def _wrap_out(out):
+    return jax.tree_util.tree_map(
+        lambda t: t._value if isinstance(t, Tensor) else t, out,
+        is_leaf=lambda t: isinstance(t, Tensor))
+
+
+def recompute(function, *args, **kwargs):
+    """Run `function(*args)` without keeping its internal activations for
+    backward; they are recomputed during the gradient pass
+    (reference recompute.py:331 — same contract, compiler-scheduled).
+
+    `function` may be a Layer (its parameters still receive gradients) or
+    a plain callable over Tensors.
+    """
+    from ....core.autograd import apply
+
+    kwargs.pop("preserve_rng_state", None)  # functional PRNG: always true
+
+    if isinstance(function, Layer):
+        layer = function
+
+        def fn(pvals, *avals):
+            out, _ = layer.functional_call(
+                {k: Tensor(v) for k, v in pvals.items()},
+                *[Tensor(a) for a in avals], **kwargs)
+            return _wrap_out(out)
+
+        params = dict(layer.named_parameters())
+        return apply(jax.checkpoint(fn), params, *args)
+
+    def fn(*avals):
+        out = function(*[Tensor(a) for a in avals], **kwargs)
+        return _wrap_out(out)
+
+    return apply(jax.checkpoint(fn), *args)
+
+
+class LocalFS:
+    """Reference fleet/utils/fs.py LocalFS — the subset used by
+    checkpointing helpers."""
+
+    def ls_dir(self, path):
+        import os
+
+        if not os.path.exists(path):
+            return [], []
+        dirs, files = [], []
+        for n in os.listdir(path):
+            (dirs if os.path.isdir(os.path.join(path, n))
+             else files).append(n)
+        return dirs, files
+
+    def is_exist(self, path):
+        import os
+
+        return os.path.exists(path)
+
+    def mkdirs(self, path):
+        import os
+
+        os.makedirs(path, exist_ok=True)
+
+    def delete(self, path):
+        import os
+        import shutil
+
+        if os.path.isdir(path):
+            shutil.rmtree(path, ignore_errors=True)
+        elif os.path.exists(path):
+            os.remove(path)

@@ -1,0 +1,69 @@
+"""paddle.hub — load entry points from a hubconf.py.
+
+Reference: python/paddle/hub.py (list/help/load over a github/gitee repo or
+local dir's hubconf.py). Zero-egress build: the local-dir source works
+fully; github/gitee sources raise with a clear message instead of
+attempting a download.
+"""
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+
+__all__ = ["list", "help", "load"]
+
+_HUBCONF = "hubconf.py"
+
+
+def _load_hubconf(repo_dir):
+    path = os.path.join(repo_dir, _HUBCONF)
+    if not os.path.exists(path):
+        raise FileNotFoundError(f"no {_HUBCONF} in {repo_dir}")
+    spec = importlib.util.spec_from_file_location("paddle_tpu_hubconf", path)
+    module = importlib.util.module_from_spec(spec)
+    sys.path.insert(0, repo_dir)
+    try:
+        spec.loader.exec_module(module)
+    finally:
+        sys.path.remove(repo_dir)
+    return module
+
+
+def _resolve(repo_dir, source):
+    source = (source or "local").lower()
+    if source not in ("github", "gitee", "local"):
+        raise ValueError(
+            f"unknown source {source!r}: should be 'github', 'gitee' or "
+            "'local'")
+    if source in ("github", "gitee"):
+        raise RuntimeError(
+            "paddle.hub remote sources need network access, which this "
+            "build does not have; clone the repo and use source='local'")
+    return _load_hubconf(repo_dir)
+
+
+def list(repo_dir, source="local", force_reload=False):  # noqa: A001
+    """Entry-point names exported by the repo's hubconf (reference
+    hub.py::list)."""
+    module = _resolve(repo_dir, source)
+    return [name for name, v in vars(module).items()
+            if callable(v) and not name.startswith("_")]
+
+
+def help(repo_dir, model, source="local", force_reload=False):  # noqa: A001
+    """Docstring of one entry point (reference hub.py::help)."""
+    module = _resolve(repo_dir, source)
+    fn = getattr(module, model, None)
+    if fn is None or not callable(fn):
+        raise RuntimeError(f"no entry point named {model!r} in hubconf")
+    return fn.__doc__
+
+
+def load(repo_dir, model, source="local", force_reload=False, **kwargs):
+    """Call one entry point (reference hub.py::load)."""
+    module = _resolve(repo_dir, source)
+    fn = getattr(module, model, None)
+    if fn is None or not callable(fn):
+        raise RuntimeError(f"no entry point named {model!r} in hubconf")
+    return fn(**kwargs)

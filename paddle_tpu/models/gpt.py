@@ -291,8 +291,22 @@ class GPTForCausalLM(nn.Layer):
         return logits
 
     def generate(self, input_ids, max_new_tokens=20, temperature=1.0,
-                 top_k=None):
-        """Greedy/top-k sampling with KV cache."""
+                 top_k=None, use_jit=True):
+        """Greedy/top-k sampling with KV cache.
+
+        use_jit=True (default) runs the TPU-native decode: caches are
+        PREALLOCATED to max_position and updated in place with
+        dynamic_update_slice, so prefill compiles once per prompt length
+        and every decode step reuses ONE cached XLA executable with
+        static shapes (the eager path re-traces per growing cache length
+        — the reference's dynamic-shape decode has no XLA equivalent).
+        """
+        if use_jit and max_new_tokens > 0 and not (
+                self.training and self.config.dropout > 0):
+            # (train-mode dropout decode falls back to the eager path,
+            # which draws per-op masks exactly as before)
+            return self._generate_jit(input_ids, max_new_tokens,
+                                      temperature, top_k)
         from .. import tensor as T
         from ..core.autograd import no_grad
 
@@ -316,6 +330,153 @@ class GPTForCausalLM(nn.Layer):
                 ids = T.concat([ids, nxt], axis=1)
                 hidden, caches = self.gpt(nxt, caches=caches)
             return ids
+
+    # ---- jitted static-shape decode -------------------------------------
+    def _stacked_block_params(self):
+        import jax
+
+        trees = []
+        for block in self.gpt._iter_blocks():
+            trees.append({k: p._value for k, p in block.named_parameters()})
+        # stacking copies every layer weight; cache per identity of the
+        # underlying arrays so repeated generate() calls don't re-stack
+        key = tuple(id(v) for t in trees for v in t.values())
+        cached = getattr(self, "_stacked_cache", None)
+        if cached is not None and cached[0] == key:
+            return cached[1]
+        stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *trees)
+        self._stacked_cache = (key, stacked)
+        return stacked
+
+    def _generate_jit(self, input_ids, max_new_tokens, temperature, top_k):
+        import jax
+        import numpy as np
+
+        from ..core.tensor import Tensor
+        from ..framework import random as rnd
+
+        c = self.config
+        nh, hd = c.num_heads, c.hidden_size // c.num_heads
+        S = c.max_position
+        ids0 = input_ids._value if isinstance(input_ids, Tensor) \
+            else jnp.asarray(input_ids)
+        ids0 = ids0.astype(jnp.int32)
+        B, T0 = ids0.shape
+        if T0 + max_new_tokens > S:
+            raise ValueError(
+                f"prompt {T0} + max_new_tokens {max_new_tokens} exceeds "
+                f"max_position {S}")
+        params = {
+            "wte": self.gpt.wte.weight._value,
+            "wpe": self.gpt.wpe.weight._value,
+            "lnf_w": self.gpt.ln_f.weight._value,
+            "lnf_b": self.gpt.ln_f.bias._value,
+            "blocks": self._stacked_block_params(),
+        }
+        eps = c.layer_norm_eps
+
+        def ln(x, w, b):
+            mu = x.mean(-1, keepdims=True)
+            var = ((x - mu) ** 2).mean(-1, keepdims=True)
+            return (x - mu) * jax.lax.rsqrt(var + eps) * w + b
+
+        def block_math(bp, x, ck, cv, pos, prefill_len):
+            """x: [B, T, H]; ck/cv: [B, nh, S, hd]; writes keys at
+            [pos, pos+T) and attends to positions <= current."""
+            Bq, T, H = x.shape
+            h = ln(x, bp["ln_1.weight"], bp["ln_1.bias"])
+            qkv = h @ bp["attn.qkv_proj.weight"] + bp["attn.qkv_proj.bias"]
+            qkv = qkv.reshape(Bq, T, 3, nh, hd).transpose(2, 0, 3, 1, 4)
+            q, k, v = qkv[0], qkv[1], qkv[2]          # [B, nh, T, hd]
+            pos_t = jnp.asarray(pos)
+            z = jnp.zeros((), pos_t.dtype)   # index dtypes must all match
+            ck = jax.lax.dynamic_update_slice(ck, k.astype(ck.dtype),
+                                              (z, z, pos_t, z))
+            cv = jax.lax.dynamic_update_slice(cv, v.astype(cv.dtype),
+                                              (z, z, pos_t, z))
+            scale = 1.0 / float(np.sqrt(hd))
+            scores = jnp.einsum("bhtd,bhsd->bhts", q, ck) * scale
+            key_pos = jnp.arange(S)[None, :]            # [1, S]
+            q_pos = pos + jnp.arange(T)[:, None]        # [T, 1]
+            mask = key_pos <= q_pos                     # causal vs cache
+            scores = jnp.where(mask[None, None], scores, -1e30)
+            probs = jax.nn.softmax(scores.astype(jnp.float32),
+                                   axis=-1).astype(x.dtype)
+            out = jnp.einsum("bhts,bhsd->bhtd", probs, cv)
+            out = out.transpose(0, 2, 1, 3).reshape(Bq, T, H)
+            x = x + (out @ bp["attn.out_proj.weight"]
+                     + bp["attn.out_proj.bias"])
+            h2 = ln(x, bp["ln_2.weight"], bp["ln_2.bias"])
+            h2 = jax.nn.gelu(h2 @ bp["mlp.fc_in.weight"]
+                             + bp["mlp.fc_in.bias"], approximate=True)
+            x = x + (h2 @ bp["mlp.fc_out.weight"] + bp["mlp.fc_out.bias"])
+            return x, ck, cv
+
+        def trunk(p, x, cks, cvs, pos):
+            def tick(carry, layer_in):
+                xc = carry
+                bp, ck, cv = layer_in
+                xc, ck, cv = block_math(bp, xc, ck, cv, pos, None)
+                return xc, (ck, cv)
+
+            x, (cks, cvs) = jax.lax.scan(tick, x, (p["blocks"], cks, cvs))
+            return x, cks, cvs
+
+        def logits_of(p, x_last):
+            h = ln(x_last, p["lnf_w"], p["lnf_b"])
+            return h @ p["wte"].T                       # [B, V]
+
+        def sample(logits, key):
+            if temperature != 1.0:
+                logits = logits / temperature
+            if top_k:
+                vals, _ = jax.lax.top_k(logits, top_k)
+                logits = jnp.where(logits < vals[:, -1:], -1e30, logits)
+                return jax.random.categorical(key, logits, axis=-1)
+            return jnp.argmax(logits, -1)
+
+        L = c.num_layers
+
+        def prefill(p, ids, key):
+            x = p["wte"][ids] + p["wpe"][jnp.arange(ids.shape[1])[None]]
+            cks = jnp.zeros((L, B, nh, S, hd), x.dtype)
+            cvs = jnp.zeros((L, B, nh, S, hd), x.dtype)
+            x, cks, cvs = trunk(p, x, cks, cvs, 0)
+            nxt = sample(logits_of(p, x[:, -1]), key)
+            return nxt.astype(jnp.int32), cks, cvs
+
+        def decode(p, cks, cvs, cur, pos, key):
+            x = p["wte"][cur][:, None] + p["wpe"][pos][None, None]
+            x, cks, cvs = trunk(p, x, cks, cvs, pos)
+            nxt = sample(logits_of(p, x[:, 0]), key)
+            return nxt.astype(jnp.int32), cks, cvs
+
+        cache = getattr(self, "_gen_jit_cache", None)
+        if cache is None:
+            cache = self._gen_jit_cache = {}
+        kp = ("prefill", B, T0, temperature, top_k)
+        kd = ("decode", B, temperature, top_k)
+        if kp not in cache:
+            cache[kp] = jax.jit(prefill)
+        if kd not in cache:
+            cache[kd] = jax.jit(decode, donate_argnums=(1, 2))
+        # greedy decoding is deterministic: do not consume global PRNG
+        # keys (parity with the eager path's RNG stream)
+        needs_key = bool(top_k)
+        dummy = jnp.zeros((2,), jnp.uint32)
+
+        def draw():
+            return rnd.next_key() if needs_key else dummy
+
+        nxt, cks, cvs = cache[kp](params, ids0, draw())
+        out = [ids0, nxt[:, None]]
+        pos = T0
+        for step in range(1, max_new_tokens):
+            nxt, cks, cvs = cache[kd](params, cks, cvs, nxt,
+                                      jnp.int32(pos), draw())
+            out.append(nxt[:, None])
+            pos += 1
+        return Tensor(jnp.concatenate(out, axis=1))
 
 
 def gpt2_small(**kw):

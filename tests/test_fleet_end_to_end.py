@@ -152,3 +152,31 @@ def test_fleet_pipeline_forward_parity():
     model_seq = GPTForCausalLM(cfg)
     loss_seq = float(model_seq(ids, labels=labels))
     np.testing.assert_allclose(loss_pp, loss_seq, rtol=2e-5)
+
+
+def test_fleet_utils_recompute():
+    """fleet.utils.recompute: same values/grads as the plain forward
+    (reference fleet/utils/recompute.py:331; here jax.checkpoint)."""
+    from paddle_tpu import nn
+
+    paddle.seed(9)
+    block = nn.Sequential(nn.Linear(8, 32), nn.GELU(), nn.Linear(32, 8))
+    x = paddle.randn([4, 8])
+    x.stop_gradient = False
+
+    out_rc = fleet.recompute(block, x)
+    out = block(x)
+    np.testing.assert_allclose(np.asarray(out_rc.numpy()),
+                               np.asarray(out.numpy()), rtol=1e-6)
+
+    (out_rc ** 2).mean().backward()
+    g_x_rc = np.asarray(x.grad.numpy())
+    g_w_rc = np.asarray(block[0].weight.grad.numpy())
+    x.clear_grad()
+    block[0].weight.clear_grad()
+    (block(x) ** 2).mean().backward()
+    np.testing.assert_allclose(g_x_rc, np.asarray(x.grad.numpy()),
+                               rtol=1e-5)
+    np.testing.assert_allclose(g_w_rc,
+                               np.asarray(block[0].weight.grad.numpy()),
+                               rtol=1e-5)

@@ -93,3 +93,24 @@ def test_set_printoptions_and_check_numerics():
 def test_linalg_namespace():
     x = paddle.to_tensor(np.eye(3, dtype=np.float32) * 2)
     assert float(paddle.linalg.det(x)._value) == pytest.approx(8.0)
+
+
+def test_hub_local_source(tmp_path):
+    """paddle.hub list/help/load over a local hubconf.py (reference
+    python/paddle/hub.py)."""
+    (tmp_path / "hubconf.py").write_text(
+        "def tiny_model(width=4):\n"
+        "    '''A tiny model entry.'''\n"
+        "    import paddle_tpu as paddle\n"
+        "    return paddle.nn.Linear(width, width)\n")
+    import paddle_tpu as paddle
+
+    names = paddle.hub.list(str(tmp_path))
+    assert "tiny_model" in names
+    assert "tiny model" in paddle.hub.help(str(tmp_path), "tiny_model")
+    layer = paddle.hub.load(str(tmp_path), "tiny_model", width=6)
+    assert layer.weight.shape == [6, 6]
+    import pytest as _pytest
+
+    with _pytest.raises(RuntimeError):
+        paddle.hub.load(str(tmp_path), "tiny_model", source="github")

@@ -551,3 +551,76 @@ def test_grad(case):
         assert abs(numeric - analytic) / denom < case.grad_tol, (
             f"{case.name} input {i}: analytic {analytic} vs numeric "
             f"{numeric}")
+
+
+# ---- pooling / norm / interpolate (appended batch 2) ---------------------
+def _maxpool2d_np(x, k, s):
+    n, c, h, w = x.shape
+    oh, ow = (h - k) // s + 1, (w - k) // s + 1
+    out = np.full((n, c, oh, ow), -np.inf)
+    for i in range(oh):
+        for j in range(ow):
+            out[:, :, i, j] = x[:, :, i * s:i * s + k,
+                                j * s:j * s + k].max((2, 3))
+    return out
+
+
+def _avgpool2d_np(x, k, s):
+    n, c, h, w = x.shape
+    oh, ow = (h - k) // s + 1, (w - k) // s + 1
+    out = np.zeros((n, c, oh, ow))
+    for i in range(oh):
+        for j in range(ow):
+            out[:, :, i, j] = x[:, :, i * s:i * s + k,
+                                j * s:j * s + k].mean((2, 3))
+    return out
+
+
+def _layer_norm_np(x, w, b, eps=1e-5):
+    mu = x.mean(-1, keepdims=True)
+    var = x.var(-1, keepdims=True)
+    return (x - mu) / np.sqrt(var + eps) * w + b
+
+
+_P2 = _arr(210, 2, 3, 8, 8)
+C("max_pool2d", lambda x: nn.functional.max_pool2d(x, 2, 2),
+  lambda x: _maxpool2d_np(x, 2, 2), [_P2])
+C("avg_pool2d", lambda x: nn.functional.avg_pool2d(x, 2, 2),
+  lambda x: _avgpool2d_np(x, 2, 2), [_P2])
+C("max_pool2d_k3s1", lambda x: nn.functional.max_pool2d(x, 3, 1),
+  lambda x: _maxpool2d_np(x, 3, 1), [_arr(211, 2, 2, 6, 6)])
+C("adaptive_avg_pool2d_1",
+  lambda x: nn.functional.adaptive_avg_pool2d(x, 1),
+  lambda x: x.mean((2, 3), keepdims=True), [_P2])
+C("layer_norm",
+  lambda x, w, b: nn.functional.layer_norm(x, 6, w, b),
+  _layer_norm_np, [_arr(212, 4, 6), _pos(213, 6), _arr(214, 6)],
+  rtol=1e-4, atol=1e-6)
+C("normalize_l2", lambda x: nn.functional.normalize(x),
+  lambda x: x / np.sqrt((x ** 2).sum(-1, keepdims=True)).clip(1e-12),
+  [_arr(215, 3, 5)])
+C("interp_nearest_2x",
+  lambda x: nn.functional.interpolate(x, scale_factor=2, mode="nearest"),
+  lambda x: x.repeat(2, axis=2).repeat(2, axis=3), [_arr(216, 2, 2, 3, 3)])
+C("pixel_shuffle", lambda x: nn.functional.pixel_shuffle(x, 2),
+  lambda x: x.reshape(1, 2, 2, 2, 3, 3).transpose(0, 1, 4, 2, 5, 3)
+  .reshape(1, 2, 6, 6), [_arr(217, 1, 8, 3, 3)])
+C("one_hot", lambda x: nn.functional.one_hot(x, 5),
+  lambda x: np.eye(5)[x], [_ints(218, 6, hi=5)], grad=False)
+C("embedding", lambda ids: nn.functional.embedding(
+    ids, paddle.to_tensor(_arr(219, 10, 4))),
+  lambda ids: _arr(219, 10, 4)[ids], [_ints(220, 3, 5, hi=10)],
+  grad=False)
+C("cosine_similarity",
+  lambda a, b: nn.functional.cosine_similarity(a, b),
+  lambda a, b: (a * b).sum(-1) / (
+      np.sqrt((a ** 2).sum(-1)) * np.sqrt((b ** 2).sum(-1))),
+  [_arr(221, 3, 6), _arr(222, 3, 6)])
+C("pairwise_distance",
+  lambda a, b: nn.PairwiseDistance()(a, b),
+  lambda a, b: np.sqrt(((a - b) ** 2).sum(-1)),
+  [_arr(223, 3, 6), _arr(224, 3, 6)])
+C("glu", lambda x: nn.functional.glu(x),
+  lambda x: x[..., :3] * _sigmoid(x[..., 3:]), [_arr(225, 4, 6)])
+C("dropout_eval", lambda x: nn.functional.dropout(x, 0.5, training=False),
+  lambda x: x, [_arr(226, 3, 4)])

@@ -158,3 +158,76 @@ def test_flash_attention_matches_xla():
     for causal in (False, True):
         out = flash_attention_raw(q, k, v, causal)
         assert float(jnp.abs(out - ref(q, k, v, causal)).max()) < 2e-2
+
+
+class TestJitGenerate:
+    """Jitted static-shape decode vs the eager KV-cache path."""
+
+    def _model(self):
+        from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+
+        paddle.seed(21)
+        m = GPTForCausalLM(GPTConfig(
+            vocab_size=97, hidden_size=32, num_layers=3, num_heads=4,
+            max_position=48, dropout=0.0, use_flash=False))
+        m.eval()
+        return m
+
+    def test_greedy_parity_with_eager(self):
+        m = self._model()
+        rng = np.random.RandomState(0)
+        ids = paddle.to_tensor(rng.randint(0, 97, (2, 7)))
+        out_jit = m.generate(ids, max_new_tokens=9, use_jit=True)
+        out_eager = m.generate(ids, max_new_tokens=9, use_jit=False)
+        np.testing.assert_array_equal(np.asarray(out_jit.numpy()),
+                                      np.asarray(out_eager.numpy()))
+
+    def test_decode_executable_reused(self):
+        import jax
+
+        m = self._model()
+        rng = np.random.RandomState(1)
+        ids = paddle.to_tensor(rng.randint(0, 97, (2, 5)))
+        m.generate(ids, max_new_tokens=4, use_jit=True)
+        decode_jits = [v for k, v in m._gen_jit_cache.items()
+                       if k[0] == "decode"]
+        assert len(decode_jits) == 1
+        # one prefill trace + one decode trace total
+        assert decode_jits[0]._cache_size() == 1
+        # a longer continuation hits the same decode executable
+        m.generate(ids, max_new_tokens=8, use_jit=True)
+        assert decode_jits[0]._cache_size() == 1
+
+    def test_topk_sampling_shapes(self):
+        m = self._model()
+        rng = np.random.RandomState(2)
+        ids = paddle.to_tensor(rng.randint(0, 97, (1, 4)))
+        out = m.generate(ids, max_new_tokens=6, temperature=0.8, top_k=5,
+                         use_jit=True)
+        assert out.shape == [1, 10]
+        arr = np.asarray(out.numpy())
+        assert ((arr >= 0) & (arr < 97)).all()
+
+
+def test_jit_generate_review_regressions():
+    """max_new_tokens=0 returns the prompt; greedy decode leaves the
+    global RNG stream untouched."""
+    from paddle_tpu.framework import random as rnd
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+
+    paddle.seed(31)
+    m = GPTForCausalLM(GPTConfig(vocab_size=50, hidden_size=16,
+                                 num_layers=2, num_heads=2,
+                                 max_position=32, dropout=0.0,
+                                 use_flash=False))
+    m.eval()
+    ids = paddle.to_tensor(np.random.RandomState(0).randint(0, 50, (1, 6)))
+    out0 = m.generate(ids, max_new_tokens=0)
+    assert out0.shape == [1, 6]
+
+    paddle.seed(77)
+    m.generate(ids, max_new_tokens=3)  # greedy: must not draw keys
+    a = np.asarray(paddle.randn([4]).numpy())
+    paddle.seed(77)
+    b = np.asarray(paddle.randn([4]).numpy())
+    np.testing.assert_array_equal(a, b)

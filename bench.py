@@ -286,6 +286,28 @@ def bench_lenet(batch=256, steps=30, warmup=3):
     return {"lenet_imgs_per_sec": steps * batch / dt}
 
 
+def bench_generate(batch=8, prompt=32, new_tokens=96):
+    """Jitted static-shape decode throughput (GPT-2 small, greedy)."""
+    import paddle_tpu as paddle
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+
+    paddle.seed(0)
+    model = GPTForCausalLM(GPTConfig(max_position=prompt + new_tokens,
+                                     dropout=0.0))
+    paddle.amp.decorate(model, level="O2")
+    model.eval()
+    rng = np.random.RandomState(0)
+    ids = paddle.to_tensor(rng.randint(0, 50304, (batch, prompt)))
+    out = model.generate(ids, max_new_tokens=new_tokens)  # compile
+    _sync(out._value)
+    t0 = time.perf_counter()
+    out = model.generate(ids, max_new_tokens=new_tokens)
+    _sync(out._value)
+    dt = time.perf_counter() - t0
+    return {"decode_tokens_per_sec": batch * new_tokens / dt,
+            "decode_ms_per_token": dt / new_tokens * 1e3}
+
+
 def bench_flash_attention(batch=4, heads=12, seq=512, dim=64, iters=50):
     """Pallas flash attention vs XLA softmax attention, fwd+bwd."""
     import jax
@@ -373,7 +395,7 @@ def main():
         return
     details.update(backend_info)
     for bench in (bench_bert, bench_resnet50, bench_lenet, bench_gpt,
-                  bench_flash_attention, bench_dataloader):
+                  bench_generate, bench_flash_attention, bench_dataloader):
         try:
             details.update(bench())
         except Exception as e:  # noqa: BLE001

@@ -72,7 +72,10 @@ from . import sparse  # noqa: F401
 from . import utils  # noqa: F401
 from . import incubate  # noqa: F401
 from . import onnx  # noqa: F401
+from . import cost_model  # noqa: F401
+from . import dataset  # noqa: F401
 from . import hub  # noqa: F401
+from . import reader  # noqa: F401
 from . import profiler  # noqa: F401
 from . import quantization  # noqa: F401
 from . import signal  # noqa: F401

@@ -1,0 +1,233 @@
+"""paddle.dataset — legacy reader-style dataset modules.
+
+Reference: python/paddle/dataset/{mnist,cifar,imdb,imikolov,uci_housing,
+movielens,wmt14,wmt16,conll05,flowers,voc2012}.py — each exposes
+train()/test() creator functions returning sample generators.
+
+TPU build: thin reader adapters over the map-style datasets in
+paddle.vision.datasets / paddle.text (which parse the reference file
+formats); `common` keeps the md5/download helper signatures with download
+disabled (zero-egress image).
+"""
+from __future__ import annotations
+
+import sys
+import types
+
+__all__ = ["mnist", "cifar", "imdb", "imikolov", "uci_housing", "movielens",
+           "wmt14", "wmt16", "conll05", "flowers", "voc2012", "common",
+           "image"]
+
+
+def _reader_of(dataset_factory):
+    def reader_creator(*args, **kwargs):
+        def reader():
+            ds = dataset_factory(*args, **kwargs)
+            for i in range(len(ds)):
+                yield tuple(ds[i]) if isinstance(ds[i], (tuple, list)) \
+                    else (ds[i],)
+
+        return reader
+
+    return reader_creator
+
+
+def _module(name, **attrs):
+    mod = types.ModuleType(f"{__name__}.{name}")
+    for k, v in attrs.items():
+        setattr(mod, k, v)
+    sys.modules[mod.__name__] = mod
+    return mod
+
+
+def _vision(name):
+    from .. import vision
+
+    return getattr(vision.datasets, name)
+
+
+def _cycled(reader_creator, cycle):
+    if not cycle:
+        return reader_creator()
+
+    base = reader_creator()
+
+    def forever():
+        while True:
+            yield from base()
+
+    return forever
+
+
+def _check_word_idx(word_idx, internal):
+    """The class datasets own their dictionaries; a DIFFERENT external
+    dict cannot be honored — fail loudly rather than silently encoding
+    with other ids (legacy reference readers encoded with the caller's
+    dict)."""
+    if word_idx is not None and word_idx != internal:
+        raise NotImplementedError(
+            "paddle.dataset shims encode with the dataset's own word "
+            "dict; pass word_idx=None (or the dict returned by "
+            "word_dict()/build_dict())")
+
+
+def _mnist_train():
+    return _reader_of(lambda: _vision("MNIST")(mode="train"))()
+
+
+def _mnist_test():
+    return _reader_of(lambda: _vision("MNIST")(mode="test"))()
+
+
+mnist = _module("mnist", train=lambda: _mnist_train(),
+                test=lambda: _mnist_test())
+
+cifar = _module(
+    "cifar",
+    train10=lambda cycle=False: _cycled(_reader_of(
+        lambda: _vision("Cifar10")(mode="train")), cycle),
+    test10=lambda cycle=False: _cycled(_reader_of(
+        lambda: _vision("Cifar10")(mode="test")), cycle),
+    train100=lambda: _reader_of(
+        lambda: _vision("Cifar100")(mode="train"))(),
+    test100=lambda: _reader_of(
+        lambda: _vision("Cifar100")(mode="test"))(),
+)
+
+
+def _text(name):
+    from .. import text
+
+    return getattr(text, name)
+
+
+def _imdb_reader(mode, word_idx):
+    ds = _text("Imdb")(mode=mode)
+    _check_word_idx(word_idx, ds.word_idx)
+
+    def reader():
+        for i in range(len(ds)):
+            yield tuple(ds[i])
+
+    return reader
+
+
+imdb = _module(
+    "imdb",
+    train=lambda word_idx=None: _imdb_reader("train", word_idx),
+    test=lambda word_idx=None: _imdb_reader("test", word_idx),
+    word_dict=lambda: _text("Imdb")(mode="train").word_idx,
+)
+
+
+def _imikolov_reader(mode, word_idx, n):
+    ds = _text("Imikolov")(data_type="NGRAM", window_size=n, mode=mode,
+                           min_word_freq=0)
+    _check_word_idx(word_idx, ds.word_idx)
+
+    def reader():
+        for i in range(len(ds)):
+            yield tuple(ds[i])
+
+    return reader
+
+
+imikolov = _module(
+    "imikolov",
+    train=lambda word_idx=None, n=5: _imikolov_reader("train", word_idx, n),
+    test=lambda word_idx=None, n=5: _imikolov_reader("test", word_idx, n),
+    build_dict=lambda min_word_freq=50: _text("Imikolov")(
+        data_type="NGRAM", window_size=5,
+        min_word_freq=min_word_freq).word_idx,
+)
+
+uci_housing = _module(
+    "uci_housing",
+    train=lambda: _reader_of(
+        lambda: _text("UCIHousing")(mode="train"))(),
+    test=lambda: _reader_of(
+        lambda: _text("UCIHousing")(mode="test"))(),
+    feature_range=lambda maximums, minimums: None,
+)
+
+movielens = _module(
+    "movielens",
+    train=lambda: _reader_of(
+        lambda: _text("Movielens")(mode="train"))(),
+    test=lambda: _reader_of(
+        lambda: _text("Movielens")(mode="test"))(),
+    max_movie_id=lambda: max(
+        _text("Movielens")(mode="train").movie_info),
+    max_user_id=lambda: max(
+        _text("Movielens")(mode="train").user_info),
+)
+
+wmt14 = _module(
+    "wmt14",
+    train=lambda dict_size=-1: _reader_of(
+        lambda: _text("WMT14")(mode="train", dict_size=dict_size))(),
+    test=lambda dict_size=-1: _reader_of(
+        lambda: _text("WMT14")(mode="test", dict_size=dict_size))(),
+)
+
+wmt16 = _module(
+    "wmt16",
+    train=lambda src_dict_size=-1, trg_dict_size=-1, src_lang="en":
+        _reader_of(lambda: _text("WMT16")(
+            mode="train", src_dict_size=src_dict_size,
+            trg_dict_size=trg_dict_size, lang=src_lang))(),
+    test=lambda src_dict_size=-1, trg_dict_size=-1, src_lang="en":
+        _reader_of(lambda: _text("WMT16")(
+            mode="test", src_dict_size=src_dict_size,
+            trg_dict_size=trg_dict_size, lang=src_lang))(),
+)
+
+conll05 = _module(
+    "conll05",
+    test=lambda: _reader_of(lambda: _text("Conll05st")())(),
+    get_dict=lambda: _text("Conll05st")().get_dict(),
+    get_embedding=lambda: _text("Conll05st")().get_embedding(),
+)
+
+flowers = _module(
+    "flowers",
+    train=lambda: _reader_of(
+        lambda: _vision("Flowers")(mode="train"))(),
+    test=lambda: _reader_of(
+        lambda: _vision("Flowers")(mode="test"))(),
+    valid=lambda: _reader_of(
+        lambda: _vision("Flowers")(mode="valid"))(),
+)
+
+voc2012 = _module(
+    "voc2012",
+    train=lambda: _reader_of(
+        lambda: _vision("VOC2012")(mode="train"))(),
+    test=lambda: _reader_of(
+        lambda: _vision("VOC2012")(mode="test"))(),
+    val=lambda: _reader_of(
+        lambda: _vision("VOC2012")(mode="valid"))(),
+)
+
+
+def _md5file(fname):
+    import hashlib
+
+    h = hashlib.md5()
+    with open(fname, "rb") as f:
+        for chunk in iter(lambda: f.read(4096), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def _download(url, module_name, md5sum, save_name=None):
+    raise RuntimeError(
+        "paddle.dataset downloads need network access, which this build "
+        "does not have; pass local data files to the paddle.text / "
+        "paddle.vision dataset classes instead")
+
+
+common = _module("common", md5file=_md5file, download=_download,
+                 DATA_HOME="/tmp/paddle_tpu_data")
+
+image = _module("image")

@@ -338,14 +338,16 @@ class GPTForCausalLM(nn.Layer):
         trees = []
         for block in self.gpt._iter_blocks():
             trees.append({k: p._value for k, p in block.named_parameters()})
-        # stacking copies every layer weight; cache per identity of the
-        # underlying arrays so repeated generate() calls don't re-stack
-        key = tuple(id(v) for t in trees for v in t.values())
+        # stacking copies every layer weight; cache while the SAME array
+        # objects are still installed (held refs, compared by identity —
+        # raw id()s could be reused after the old arrays are collected)
+        leaves = tuple(v for t in trees for v in t.values())
         cached = getattr(self, "_stacked_cache", None)
-        if cached is not None and cached[0] == key:
+        if cached is not None and len(cached[0]) == len(leaves) and \
+                all(a is b for a, b in zip(cached[0], leaves)):
             return cached[1]
         stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *trees)
-        self._stacked_cache = (key, stacked)
+        self._stacked_cache = (leaves, stacked)
         return stacked
 
     def _generate_jit(self, input_ids, max_new_tokens, temperature, top_k):
@@ -413,11 +415,13 @@ class GPTForCausalLM(nn.Layer):
             return x, ck, cv
 
         def trunk(p, x, cks, cvs, pos):
+            carry_dt = x.dtype  # AMP keeps norm params f32; pin the carry
+
             def tick(carry, layer_in):
                 xc = carry
                 bp, ck, cv = layer_in
                 xc, ck, cv = block_math(bp, xc, ck, cv, pos, None)
-                return xc, (ck, cv)
+                return xc.astype(carry_dt), (ck, cv)
 
             x, (cks, cvs) = jax.lax.scan(tick, x, (p["blocks"], cks, cvs))
             return x, cks, cvs

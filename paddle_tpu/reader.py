@@ -1,0 +1,180 @@
+"""paddle.reader — legacy reader decorators.
+
+Reference: python/paddle/reader/decorator.py (map_readers, shuffle,
+xmap_readers, firstn, buffered, cache, chain, compose,
+multiprocess_reader). Pure-python iterator combinators; the TPU build keeps
+them verbatim in behavior (threads for xmap/buffered; multiprocess_reader
+degrades to threads — single-controller runtime).
+"""
+from __future__ import annotations
+
+import itertools
+import queue
+import random as _random
+import threading
+
+__all__ = ["map_readers", "shuffle", "xmap_readers", "firstn", "buffered",
+           "cache", "chain", "compose", "multiprocess_reader",
+           "ComposeNotAligned"]
+
+
+def map_readers(func, *readers):
+    def reader():
+        rs = [r() for r in readers]
+        for vals in zip(*rs):
+            yield func(*vals)
+
+    return reader
+
+
+def shuffle(reader, buf_size):
+    def shuffled():
+        buf = []
+        for e in reader():
+            buf.append(e)
+            if len(buf) >= buf_size:
+                _random.shuffle(buf)
+                yield from buf
+                buf = []
+        if buf:
+            _random.shuffle(buf)
+            yield from buf
+
+    return shuffled
+
+
+def firstn(reader, n):
+    def reader_n():
+        for i, item in enumerate(reader()):
+            if i >= n:
+                break
+            yield item
+
+    return reader_n
+
+
+def buffered(reader, size):
+    class _End:
+        pass
+
+    def buffered_reader():
+        q = queue.Queue(maxsize=size)
+
+        def fill():
+            for item in reader():
+                q.put(item)
+            q.put(_End)
+
+        t = threading.Thread(target=fill, daemon=True)
+        t.start()
+        while True:
+            item = q.get()
+            if item is _End:
+                break
+            yield item
+
+    return buffered_reader
+
+
+def cache(reader):
+    all_data = None
+
+    def cached():
+        nonlocal all_data
+        if all_data is None:
+            all_data = list(reader())
+        yield from all_data
+
+    return cached
+
+
+def chain(*readers):
+    def reader():
+        yield from itertools.chain(*[r() for r in readers])
+
+    return reader
+
+
+class ComposeNotAligned(ValueError):
+    pass
+
+
+def compose(*readers, **kwargs):
+    """check_alignment=True (default): misaligned reader lengths RAISE
+    ComposeNotAligned; False: silently truncate to the shortest (reference
+    decorator.py:293)."""
+    check_alignment = kwargs.pop("check_alignment", True)
+
+    def make_tuple(x):
+        return x if isinstance(x, tuple) else (x,)
+
+    def reader():
+        rs = [r() for r in readers]
+        if not check_alignment:
+            for outputs in zip(*rs):
+                yield sum((make_tuple(o) for o in outputs), ())
+        else:
+            for outputs in itertools.zip_longest(*rs):
+                if any(o is None for o in outputs):
+                    raise ComposeNotAligned(
+                        "outputs of readers are not aligned")
+                yield sum((make_tuple(o) for o in outputs), ())
+
+    return reader
+
+
+def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
+    """Parallel map over a reader with `process_num` worker threads
+    (reference uses threads too, despite the name)."""
+    end_token = object()
+
+    def xreader():
+        in_q = queue.Queue(buffer_size)
+        out_q = queue.Queue(buffer_size)
+
+        def feed():
+            for i, sample in enumerate(reader()):
+                in_q.put((i, sample))
+            for _ in range(process_num):
+                in_q.put(end_token)
+
+        def work():
+            while True:
+                item = in_q.get()
+                if item is end_token:
+                    out_q.put(end_token)
+                    break
+                i, sample = item
+                out_q.put((i, mapper(sample)))
+
+        threading.Thread(target=feed, daemon=True).start()
+        workers = [threading.Thread(target=work, daemon=True)
+                   for _ in range(process_num)]
+        for w in workers:
+            w.start()
+        finished = 0
+        pending = {}
+        next_i = 0
+        while finished < process_num:
+            item = out_q.get()
+            if item is end_token:
+                finished += 1
+                continue
+            if not order:
+                yield item[1]
+                continue
+            pending[item[0]] = item[1]
+            while next_i in pending:
+                yield pending.pop(next_i)
+                next_i += 1
+        if order:
+            for i in sorted(pending):
+                yield pending[i]
+
+    return xreader
+
+
+def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
+    """Reference spawns processes + pipes; on the single-controller TPU
+    runtime thread-chaining gives the same stream without fork hazards."""
+    return chain(*readers)

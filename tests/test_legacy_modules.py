@@ -1,0 +1,112 @@
+"""Legacy compat modules: paddle.reader decorators, paddle.dataset
+reader creators, paddle.cost_model (reference: python/paddle/reader/,
+dataset/, cost_model/)."""
+import numpy as np
+
+import paddle_tpu as paddle
+
+
+class TestReader:
+    def test_map_shuffle_firstn_buffered_cache(self):
+        r = lambda: iter(range(10))
+        doubled = paddle.reader.map_readers(lambda x: x * 2, r)
+        assert list(doubled()) == [x * 2 for x in range(10)]
+        assert sorted(paddle.reader.shuffle(r, 4)()) == list(range(10))
+        assert list(paddle.reader.firstn(r, 3)()) == [0, 1, 2]
+        assert list(paddle.reader.buffered(r, 2)()) == list(range(10))
+        c = paddle.reader.cache(r)
+        assert list(c()) == list(range(10)) == list(c())
+
+    def test_chain_compose(self):
+        a = lambda: iter([1, 2])
+        b = lambda: iter([3, 4])
+        assert list(paddle.reader.chain(a, b)()) == [1, 2, 3, 4]
+        assert list(paddle.reader.compose(a, b)()) == [(1, 3), (2, 4)]
+
+    def test_xmap_ordered(self):
+        r = lambda: iter(range(20))
+        out = list(paddle.reader.xmap_readers(
+            lambda x: x + 100, r, process_num=4, buffer_size=8,
+            order=True)())
+        assert out == [x + 100 for x in range(20)]
+
+    def test_xmap_unordered_complete(self):
+        r = lambda: iter(range(20))
+        out = sorted(paddle.reader.xmap_readers(
+            lambda x: x * 3, r, process_num=3, buffer_size=4)())
+        assert out == [x * 3 for x in range(20)]
+
+
+class TestDataset:
+    def test_uci_housing_reader(self):
+        reader = paddle.dataset.uci_housing.train()
+        x, y = next(reader())
+        assert x.shape == (13,) and y.shape == (1,)
+
+    def test_imdb_reader_and_dict(self):
+        d = paddle.dataset.imdb.word_dict()
+        assert "<unk>" in d
+        doc, label = next(paddle.dataset.imdb.train(d)())
+        assert label.shape == (1,)
+
+    def test_mnist_reader(self):
+        img, label = next(paddle.dataset.mnist.train()())
+        assert np.prod(np.asarray(img).shape) in (784, 28 * 28)
+
+    def test_download_disabled(self):
+        import pytest
+
+        with pytest.raises(RuntimeError):
+            paddle.dataset.common.download("http://x", "m", "0")
+
+
+class TestCostModel:
+    def test_static_op_time_and_profile(self):
+        cm = paddle.cost_model.CostModel()
+        try:
+            t = cm.get_static_op_time("matmul")
+            assert t["op_time"] > 0 and "config" in t
+            # memoized
+            assert cm.get_static_op_time("matmul") == t
+            # no measurement recipe -> empty dict (reference contract)
+            assert cm.get_static_op_time("no_such_op") == {}
+            data = cm.static_cost_data()
+            relu = next(d for d in data if d["op"] == "relu")
+            assert relu["paddle_gpu_time"] > 0
+            startup, main = cm.build_program()
+            cost = cm.profile_measure(startup, main)
+            assert cost["time"] > 0
+        finally:
+            # build_program flips global static mode (reference does too)
+            paddle.disable_static()
+
+
+def test_compose_alignment_contract():
+    """check_alignment=True raises on misaligned readers; False silently
+    truncates (reference decorator.py:293)."""
+    import pytest
+
+    a = lambda: iter([1, 2, 3])
+    b = lambda: iter([4, 5])
+    with pytest.raises(paddle.reader.ComposeNotAligned):
+        list(paddle.reader.compose(a, b)())
+    assert list(paddle.reader.compose(a, b, check_alignment=False)()) == \
+        [(1, 4), (2, 5)]
+
+
+def test_dataset_args_respected():
+    import pytest
+
+    # n -> window size
+    sample = next(paddle.dataset.imikolov.train(None, n=3)())
+    assert len(sample) == 3
+    # foreign dict -> loud error, not silent divergence
+    with pytest.raises(NotImplementedError):
+        next(paddle.dataset.imdb.train({"bogus": 0})())
+    # cycle=True wraps around
+    import itertools
+
+    r = paddle.dataset.cifar.train10(cycle=True)
+    n_base = sum(1 for _ in paddle.dataset.cifar.train10()())
+    got = list(itertools.islice(r(), n_base + 3))
+    assert len(got) == n_base + 3

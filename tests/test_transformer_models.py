@@ -231,3 +231,20 @@ def test_jit_generate_review_regressions():
     paddle.seed(77)
     b = np.asarray(paddle.randn([4]).numpy())
     np.testing.assert_array_equal(a, b)
+
+
+def test_jit_generate_amp_bf16():
+    """Jit decode under amp.decorate O2 (bf16 weights, f32 norms) — the
+    scan carry must stay one dtype."""
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+
+    paddle.seed(41)
+    m = GPTForCausalLM(GPTConfig(vocab_size=64, hidden_size=32,
+                                 num_layers=2, num_heads=4,
+                                 max_position=32, dropout=0.0,
+                                 use_flash=False))
+    paddle.amp.decorate(m, level="O2")
+    m.eval()
+    ids = paddle.to_tensor(np.random.RandomState(0).randint(0, 64, (2, 6)))
+    out = m.generate(ids, max_new_tokens=5)
+    assert out.shape == [2, 11]

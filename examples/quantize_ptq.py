@@ -1,0 +1,32 @@
+"""Post-training int8 quantization.
+
+Run: python examples/quantize_ptq.py
+Calibration observers ride the normal (jitted) eval forwards; convert()
+swaps Linear/Conv2D for int8 layers whose matmuls lower to the MXU's
+integer dot_general.
+"""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.quantization import ImperativePTQ, QuantConfig
+
+
+def main():
+    paddle.seed(0)
+    model = paddle.vision.models.LeNet()
+    model.eval()
+
+    ptq = ImperativePTQ(QuantConfig(activation_quantize_type="hist"))
+    ptq.quantize(model)
+    rng = np.random.RandomState(0)
+    for _ in range(8):  # calibration sweep
+        model(paddle.to_tensor(
+            rng.randn(16, 1, 28, 28).astype(np.float32)))
+    model = ptq.convert(model)
+
+    x = paddle.to_tensor(rng.randn(4, 1, 28, 28).astype(np.float32))
+    print("int8 logits:", np.asarray(model(x).numpy())[0, :4])
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,44 @@
+"""Hybrid-parallel GPT training through the fleet API.
+
+Run (single host, 8 virtual devices):
+  XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+      python examples/train_gpt_hybrid_parallel.py
+
+fleet.init turns the strategy into a (dp, pp, tp) device mesh; the model's
+sharding annotations resolve against it (megatron tp layout), the trunk
+becomes a PipelineLayer running a jitted GPipe schedule, and XLA inserts
+the collectives.
+"""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed import fleet
+from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+
+
+def main():
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 2, "mp_degree": 2,
+                               "pp_degree": 2}
+    fleet.init(is_collective=True, strategy=strategy)
+
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=1024, hidden_size=128, num_layers=4,
+                    num_heads=8, max_position=128, dropout=0.0)
+    model = fleet.distributed_model(GPTForCausalLM(cfg))
+    opt = fleet.distributed_optimizer(paddle.optimizer.AdamW(
+        learning_rate=3e-4, parameters=model.parameters()))
+
+    rng = np.random.RandomState(0)
+    for step in range(10):
+        ids = paddle.to_tensor(rng.randint(0, 1024, (8, 64)))
+        labels = paddle.to_tensor(rng.randint(0, 1024, (8, 64)))
+        loss = model(ids, labels=labels)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        print(f"step {step}: loss {float(loss):.4f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,24 @@
+"""Single-chip training with the high-level API (paddle.Model.fit).
+
+Run: python examples/train_lenet_mnist.py
+Everything compiles into ONE XLA program per step (forward, loss,
+backward, optimizer update) with donated buffers.
+"""
+import paddle_tpu as paddle
+from paddle_tpu import nn
+
+
+def main():
+    paddle.seed(0)
+    train = paddle.vision.datasets.MNIST(mode="train")
+    test = paddle.vision.datasets.MNIST(mode="test")
+
+    model = paddle.Model(paddle.vision.models.LeNet())
+    model.prepare(paddle.optimizer.Adam(parameters=model.parameters()),
+                  nn.CrossEntropyLoss(), paddle.metric.Accuracy())
+    model.fit(train, epochs=1, batch_size=128, verbose=1)
+    print(model.evaluate(test, batch_size=128, verbose=0))
+
+
+if __name__ == "__main__":
+    main()

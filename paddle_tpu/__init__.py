@@ -90,7 +90,8 @@ from .device import (  # noqa: F401
 from .distributed.parallel import DataParallel  # noqa: F401
 from .static.program import InputSpec  # noqa: F401
 
-__version__ = "0.1.0"
+from . import version  # noqa: F401
+from .version import full_version as __version__  # noqa: F401
 
 _FLAGS = {}
 

@@ -34,25 +34,28 @@ class CostModel:
             data = paddle.static.data(name="X", shape=[10, 1],
                                       dtype="float32")
             hidden = paddle.static.nn.fc(data, 10)
-            paddle.mean(hidden)
+            self._loss = paddle.mean(hidden)
         return startup_program, main_program
 
     def profile_measure(self, startup_program, main_program, device="tpu",
                         fetch_cost_list=("time",)):
-        """Compile + run the program; returns {"time": wall ms,
-        "flops": XLA cost-analysis FLOPs, "bytes accessed": ...}."""
+        """Compile + run the program; returns {"time": steady-state wall
+        ms, "flops": XLA cost-analysis FLOPs, "bytes accessed": ...}."""
         import paddle_tpu as paddle
 
         exe = paddle.static.Executor()
         exe.run(startup_program)
         feed = {"X": paddle.to_tensor(
             np.random.random((10, 1)).astype(np.float32))}
+        fetch = [self._loss] if getattr(self, "_loss", None) is not None \
+            else []
+        exe.run(main_program, feed=feed, fetch_list=fetch)  # warmup/compile
         t0 = time.perf_counter()
-        exe.run(main_program, feed=feed, fetch_list=[])
+        out = exe.run(main_program, feed=feed, fetch_list=fetch)
+        if out:  # fetched values are np arrays: the run is synced
+            np.asarray(out[0])
         cost = {"time": (time.perf_counter() - t0) * 1e3}
-        analysis = getattr(exe, "last_cost_analysis", None)
-        if callable(analysis):
-            cost.update(analysis() or {})
+        cost.update(exe.last_cost_analysis() or {})
         return cost
 
     _MEASURABLE = ("matmul", "relu", "softmax", "elementwise_add", "mean")

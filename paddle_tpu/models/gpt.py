@@ -338,16 +338,23 @@ class GPTForCausalLM(nn.Layer):
         trees = []
         for block in self.gpt._iter_blocks():
             trees.append({k: p._value for k, p in block.named_parameters()})
-        # stacking copies every layer weight; cache while the SAME array
-        # objects are still installed (held refs, compared by identity —
-        # raw id()s could be reused after the old arrays are collected)
+        # stacking copies every layer weight; cache keyed by WEAK refs to
+        # the source arrays: identity-safe (refs pin nothing, a dead ref
+        # invalidates the entry) and no stale model copy is retained in
+        # HBM after a weight update
+        import weakref
+
         leaves = tuple(v for t in trees for v in t.values())
         cached = getattr(self, "_stacked_cache", None)
         if cached is not None and len(cached[0]) == len(leaves) and \
-                all(a is b for a, b in zip(cached[0], leaves)):
+                all(r() is v for r, v in zip(cached[0], leaves)):
             return cached[1]
         stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *trees)
-        self._stacked_cache = (leaves, stacked)
+        try:
+            refs = tuple(weakref.ref(v) for v in leaves)
+            self._stacked_cache = (refs, stacked)
+        except TypeError:  # value type without weakref support
+            self._stacked_cache = None
         return stacked
 
     def _generate_jit(self, input_ids, max_new_tokens, temperature, top_k):

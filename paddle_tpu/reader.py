@@ -59,11 +59,16 @@ def buffered(reader, size):
 
     def buffered_reader():
         q = queue.Queue(maxsize=size)
+        err = []
 
         def fill():
-            for item in reader():
-                q.put(item)
-            q.put(_End)
+            try:
+                for item in reader():
+                    q.put(item)
+            except BaseException as e:  # noqa: BLE001 — re-raised below
+                err.append(e)
+            finally:
+                q.put(_End)  # ALWAYS unblock the consumer
 
         t = threading.Thread(target=fill, daemon=True)
         t.start()
@@ -72,6 +77,8 @@ def buffered(reader, size):
             if item is _End:
                 break
             yield item
+        if err:
+            raise err[0]
 
     return buffered_reader
 
@@ -131,21 +138,30 @@ def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
     def xreader():
         in_q = queue.Queue(buffer_size)
         out_q = queue.Queue(buffer_size)
+        errors = []
 
         def feed():
-            for i, sample in enumerate(reader()):
-                in_q.put((i, sample))
-            for _ in range(process_num):
-                in_q.put(end_token)
+            try:
+                for i, sample in enumerate(reader()):
+                    in_q.put((i, sample))
+            except BaseException as e:  # noqa: BLE001 — re-raised below
+                errors.append(e)
+            finally:
+                for _ in range(process_num):
+                    in_q.put(end_token)
 
         def work():
-            while True:
-                item = in_q.get()
-                if item is end_token:
-                    out_q.put(end_token)
-                    break
-                i, sample = item
-                out_q.put((i, mapper(sample)))
+            try:
+                while True:
+                    item = in_q.get()
+                    if item is end_token:
+                        break
+                    i, sample = item
+                    out_q.put((i, mapper(sample)))
+            except BaseException as e:  # noqa: BLE001 — re-raised below
+                errors.append(e)
+            finally:
+                out_q.put(end_token)  # ALWAYS unblock the consumer
 
         threading.Thread(target=feed, daemon=True).start()
         workers = [threading.Thread(target=work, daemon=True)
@@ -167,9 +183,11 @@ def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
             while next_i in pending:
                 yield pending.pop(next_i)
                 next_i += 1
-        if order:
-            for i in sorted(pending):
-                yield pending[i]
+        # FIFO + per-worker sentinel ordering guarantees pending drains
+        # before the last end_token; anything left means a worker died
+        if errors:
+            raise errors[0]
+        assert not pending, "xmap_readers lost ordered items"
 
     return xreader
 

@@ -132,12 +132,20 @@ class Executor:
             self._cache[sig] = entry
 
         param_vals = {p.name: p._value for p in program.param_ids.values()}
+
+        def _avals(*trees):
+            return jax.tree_util.tree_map(
+                lambda v: jax.ShapeDtypeStruct(jnp.shape(v),
+                                               jnp.asarray(v).dtype), trees)
+
         if train:
             optimizer, _ = program.minimize_records[0]
             states = self._opt_states.get(id(program))
             if states is None:
                 states = optimizer.functional_init_states(param_vals)
             lr = jnp.asarray(optimizer.get_lr(), jnp.float32)
+            self._last_lowerable = (entry, _avals(param_vals, feed_vals,
+                                                  states, lr))
             fetches, new_params, new_states = entry(param_vals, feed_vals,
                                                     states, lr)
             self._opt_states[id(program)] = new_states
@@ -145,10 +153,28 @@ class Executor:
                 p._value = new_params[p.name]
             optimizer._global_step += 1
         else:
+            self._last_lowerable = (entry, _avals(param_vals, feed_vals))
             fetches, _, _ = entry(param_vals, feed_vals)
         if return_numpy:
             return [np.asarray(f) for f in fetches]
         return [Tensor(f) for f in fetches]
+
+    def last_cost_analysis(self):
+        """XLA cost analysis (flops, bytes accessed, ...) of the program
+        most recently run — exposed for paddle.cost_model. Lowers from the
+        recorded abstract shapes; the executable comes from XLA's
+        compilation cache, so no duplicate device compile."""
+        entry_and_avals = getattr(self, "_last_lowerable", None)
+        if entry_and_avals is None:
+            return {}
+        entry, avals = entry_and_avals
+        try:
+            cost = entry.lower(*avals).compile().cost_analysis()
+            if isinstance(cost, (list, tuple)):
+                cost = cost[0]
+            return dict(cost) if cost else {}
+        except Exception:  # noqa: BLE001 — diagnostic API, never fatal
+            return {}
 
     def close(self):
         self._cache.clear()

@@ -1,0 +1,34 @@
+"""paddle.version (reference: generated python/paddle/version.py —
+full_version/major/minor/patch/rc/commit/show)."""
+from __future__ import annotations
+
+full_version = "0.1.0"
+major = "0"
+minor = "1"
+patch = "0"
+rc = "0"
+commit = "unknown"
+istaged = False
+with_mkl = "OFF"
+cuda_version = "False"
+cudnn_version = "False"
+
+__all__ = ["full_version", "major", "minor", "patch", "rc", "commit",
+           "show", "cuda", "cudnn"]
+
+
+def show():
+    print("commit:", commit)
+    print("full_version:", full_version)
+    print("major:", major)
+    print("minor:", minor)
+    print("patch:", patch)
+    print("rc:", rc)
+
+
+def cuda():
+    return cuda_version
+
+
+def cudnn():
+    return cudnn_version

@@ -110,3 +110,34 @@ def test_dataset_args_respected():
     n_base = sum(1 for _ in paddle.dataset.cifar.train10()())
     got = list(itertools.islice(r(), n_base + 3))
     assert len(got) == n_base + 3
+
+
+def test_reader_exceptions_propagate():
+    """A raising mapper/reader must not deadlock the consumer (round-3
+    review regression)."""
+    import pytest
+
+    def bad_reader():
+        yield 1
+        raise ValueError("source died")
+
+    with pytest.raises(ValueError, match="source died"):
+        list(paddle.reader.buffered(bad_reader, 2)())
+
+    def bad_mapper(x):
+        raise RuntimeError("mapper died")
+
+    with pytest.raises(RuntimeError, match="mapper died"):
+        list(paddle.reader.xmap_readers(bad_mapper, lambda: iter(range(4)),
+                                        2, 2)())
+
+
+def test_profile_measure_has_flops():
+    cm = paddle.cost_model.CostModel()
+    try:
+        startup, main = cm.build_program()
+        cost = cm.profile_measure(startup, main)
+        assert cost["time"] > 0
+        assert cost.get("flops", 0) > 0, cost
+    finally:
+        paddle.disable_static()

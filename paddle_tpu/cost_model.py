@@ -35,6 +35,7 @@ class CostModel:
                                       dtype="float32")
             hidden = paddle.static.nn.fc(data, 10)
             self._loss = paddle.mean(hidden)
+            self._built_main = main_program
         return startup_program, main_program
 
     def profile_measure(self, startup_program, main_program, device="tpu",
@@ -47,8 +48,10 @@ class CostModel:
         exe.run(startup_program)
         feed = {"X": paddle.to_tensor(
             np.random.random((10, 1)).astype(np.float32))}
-        fetch = [self._loss] if getattr(self, "_loss", None) is not None \
-            else []
+        # only fetch the loss var for OUR toy program — arbitrary caller
+        # programs don't contain it
+        fetch = [self._loss] if main_program is getattr(
+            self, "_built_main", None) else []
         exe.run(main_program, feed=feed, fetch_list=fetch)  # warmup/compile
         t0 = time.perf_counter()
         out = exe.run(main_program, feed=feed, fetch_list=fetch)

@@ -42,6 +42,8 @@ from . import sequence_parallel  # noqa: F401
 from .sequence_parallel import ring_attention, split_sequence  # noqa: F401
 from . import elastic  # noqa: F401
 from . import auto_parallel  # noqa: F401
+from . import models  # noqa: F401
+from . import utils  # noqa: F401
 from .auto_parallel import ProcessMesh, shard_op, shard_tensor  # noqa: F401
 
 

@@ -1,0 +1,100 @@
+"""paddle.distributed.models.moe — MoE routing helper ops.
+
+Reference: python/paddle/distributed/models/moe/utils.py (number_count,
+assign_pos, random_routing, limit_by_capacity, prune_gate_by_capacity —
+CUDA helper kernels behind the reference MoE layer).
+
+TPU-native: pure-jnp equivalents (segment sums / sorts the MXU-adjacent
+way); the actual expert dispatch lives in distributed/moe.py (GShard
+all_to_all over the ep axis).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ....core.autograd import apply
+
+__all__ = ["_number_count", "_assign_pos", "_random_routing",
+           "_limit_by_capacity", "_prune_gate_by_capacity"]
+
+
+def _unwrap(x):
+    return x._value if hasattr(x, "_value") else jnp.asarray(x)
+
+
+def _number_count(numbers, upper_range):
+    """Histogram of expert ids: out[i] = #(numbers == i)."""
+    def f(n):
+        return jnp.bincount(n.reshape(-1).astype(jnp.int32),
+                            length=upper_range)
+    return apply(f, numbers)
+
+
+def _assign_pos(x, cum_count):
+    """Token positions laid out per the (possibly capacity-clipped)
+    cumulative counts: output[cum[e-1]:cum[e]] holds the first allowed
+    tokens routed to expert e; overflow tokens are dropped (reference
+    assign_pos kernel). Output length = cum_count[-1] — data-dependent,
+    so this runs eagerly (as the reference kernel does)."""
+    def f(xv, cc):
+        flat = xv.reshape(-1).astype(jnp.int32)
+        n_expert = cc.shape[0]
+        order = jnp.argsort(flat, stable=True)
+        sorted_e = flat[order]
+        full_counts = jnp.bincount(sorted_e, length=n_expert)
+        full_start = jnp.concatenate(
+            [jnp.zeros(1, full_counts.dtype),
+             jnp.cumsum(full_counts)[:-1]])
+        rank = jnp.arange(flat.shape[0]) - full_start[sorted_e]
+        starts = jnp.concatenate([jnp.zeros(1, cc.dtype), cc[:-1]])
+        allowed = cc - starts
+        keep = rank < allowed[sorted_e]
+        total = int(cc[-1])
+        dest = jnp.where(keep, starts[sorted_e] + rank, total)
+        out = jnp.zeros((total,), cc.dtype)
+        return out.at[dest].set(order.astype(cc.dtype), mode="drop")
+    return apply(f, x, cum_count)
+
+
+def _random_routing(topk_idx, topk_value, prob, topk=2):
+    """Second-expert dropout: keep expert 1 only where 2*value > prob
+    (reference random_routing)."""
+    if topk != 2:
+        raise ValueError("only topk=2 is supported")
+
+    def f(idx, val, p):
+        keep = (2.0 * val[:, 1] + 1e-9) > p
+        new_col1 = jnp.where(keep, idx[:, 1], -1)
+        return jnp.stack([idx[:, 0], new_col1], axis=1)
+    return apply(f, topk_idx, topk_value, prob)
+
+
+def _limit_by_capacity(expert_count, capacity, n_worker):
+    """Clip per-(worker, expert) counts by each expert's capacity
+    (reference limit_by_capacity)."""
+    def f(ec, cap):
+        ec2 = ec.reshape(n_worker, -1)
+        capf = cap.astype(ec2.dtype)
+        out = jnp.zeros_like(ec2)
+        def body(carry, row):
+            remaining = carry
+            take = jnp.minimum(row, remaining)
+            return remaining - take, take
+        _, taken = jax.lax.scan(body, capf, ec2)
+        return taken.reshape(-1)
+    return apply(f, expert_count, capacity)
+
+
+def _prune_gate_by_capacity(gate_idx, expert_count, n_expert, n_worker):
+    """Set gate ids beyond expert capacity to -1 (reference
+    prune_gate_by_capacity)."""
+    def f(g, ec):
+        flat = g.reshape(-1)
+        one_hot = jax.nn.one_hot(flat, n_expert * n_worker,
+                                 dtype=jnp.int64)
+        rank_in_expert = jnp.cumsum(one_hot, axis=0) * one_hot
+        pos = (rank_in_expert.max(axis=1) - 1).astype(jnp.int64)
+        cap = ec.reshape(-1)[flat]
+        return jnp.where(pos < cap, flat, -1).reshape(g.shape)
+    return apply(f, gate_idx, expert_count)

@@ -1,0 +1,321 @@
+"""paddle.distributed.utils — cluster/trainer topology + local launch.
+
+Reference: python/paddle/distributed/utils.py:36 (Cluster/Pod/Trainer/
+JobServer/Hdfs descriptors, get_cluster, find_free_ports,
+start/watch_local_trainers, terminate_local_procs).
+
+TPU-native: the descriptors are kept verbatim in surface (launch tooling
+and cloud role makers read them); `selected_gpus` slots carry accelerator
+ordinals (TPU chips here). start_local_trainers spawns real
+subprocesses — on a single-controller TPU runtime this is used for
+CPU-host multi-process tests and utilities, not for the SPMD compute path
+(the mesh owns that).
+"""
+from __future__ import annotations
+
+import copy
+import logging
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+__all__ = ["get_host_name_ip", "Trainer", "get_cluster",
+           "start_local_trainers", "watch_local_trainers",
+           "find_free_ports", "JobServer", "Cluster", "Pod", "Hdfs",
+           "add_arguments", "terminate_local_procs", "TrainerProc",
+           "get_logger", "pull_worker_log"]
+
+logger = logging.getLogger("root")
+
+
+class Hdfs:
+    def __init__(self):
+        self.hdfs_ugi = None
+        self.hdfs_name = None
+        self.hdfs_path = None
+
+    def is_valid(self):
+        return None not in (self.hdfs_ugi, self.hdfs_name, self.hdfs_path)
+
+    def __str__(self):
+        return (f"hdfs_ugi:{self.hdfs_ugi} hdfs_name:{self.hdfs_name} "
+                f"hdfs_path{self.hdfs_path}")
+
+    def __eq__(self, other):
+        return (self.hdfs_ugi == other.hdfs_ugi
+                and self.hdfs_name == other.hdfs_name
+                and self.hdfs_path == other.hdfs_path)
+
+    def __ne__(self, other):
+        return not self == other
+
+
+class JobServer:
+    def __init__(self):
+        self.endpoint = None
+
+    def __str__(self):
+        return str(self.endpoint)
+
+    def __eq__(self, other):
+        return self.endpoint == other.endpoint
+
+    def __ne__(self, other):
+        return not self == other
+
+
+class Trainer:
+    def __init__(self):
+        self.gpus = []  # accelerator ordinals (TPU chips on this runtime)
+        self.endpoint = None
+        self.rank = None
+
+    def __str__(self):
+        return f"gpu:{self.gpus} endpoint:{self.endpoint} rank:{self.rank}"
+
+    def __eq__(self, other):
+        return (self.gpus == other.gpus
+                and self.endpoint == other.endpoint
+                and self.rank == other.rank)
+
+    def __ne__(self, other):
+        return not self == other
+
+    def get_rank(self):
+        return self.rank
+
+
+class Pod:
+    def __init__(self):
+        self.rank = None
+        self.id = None
+        self.addr = None
+        self.port = None
+        self.trainers = []
+        self.gpus = []
+
+    def __str__(self):
+        return (f"rank:{self.rank} id:{self.id} addr:{self.addr} "
+                f"port:{self.port} visible_gpu:{self.gpus} "
+                f"trainers:{[str(t) for t in self.trainers]}")
+
+    def __eq__(self, other):
+        return (self.rank == other.rank and self.id == other.id
+                and self.addr == other.addr and self.port == other.port
+                and self.trainers == other.trainers)
+
+    def __ne__(self, other):
+        return not self == other
+
+    def parse_response(self, res_pods):
+        pass
+
+    def get_visible_gpus(self):
+        assert self.gpus, f"this pod {self} can't see any gpus"
+        return ",".join(str(g) for g in self.gpus)
+
+
+class Cluster:
+    def __init__(self, hdfs=None):
+        self.job_server = None
+        self.pods = []
+        self.hdfs = None
+        self.job_stage_flag = None
+
+    def __str__(self):
+        return (f"job_server:{self.job_server} "
+                f"pods:{[str(p) for p in self.pods]} "
+                f"job_stage_flag:{self.job_stage_flag} hdfs:{self.hdfs}")
+
+    def __eq__(self, other):
+        return (len(self.pods) == len(other.pods)
+                and all(a == b for a, b in zip(self.pods, other.pods))
+                and self.job_stage_flag == other.job_stage_flag)
+
+    def __ne__(self, other):
+        return not self == other
+
+    def update_pods(self, cluster):
+        self.pods = copy.copy(cluster.pods)
+
+    def trainers_nranks(self):
+        return len(self.trainers_endpoints())
+
+    def pods_nranks(self):
+        return len(self.pods)
+
+    def trainers_endpoints(self):
+        return [t.endpoint for pod in self.pods for t in pod.trainers]
+
+    def pods_endpoints(self):
+        eps = []
+        for pod in self.pods:
+            assert pod.port is not None and pod.addr is not None, \
+                f"{pod.addr}:{pod.port} not a valid endpoint"
+            eps.append(f"{pod.addr}:{pod.port}")
+        return eps
+
+    def get_pod_by_id(self, pod_id):
+        for pod in self.pods:
+            if str(pod_id) == str(pod.id):
+                return pod
+        return None
+
+
+class TrainerProc:
+    def __init__(self):
+        self.proc = None
+        self.log_fn = None
+        self.log_offset = None
+        self.rank = None
+        self.local_rank = None
+        self.cmd = None
+
+
+def get_host_name_ip():
+    try:
+        host_name = socket.gethostname()
+        host_ip = socket.gethostbyname(host_name)
+        return host_name, host_ip
+    except OSError:
+        return None
+
+
+def find_free_ports(num):
+    """num distinct free TCP ports on this host."""
+    ports = set()
+    attempts = 0
+    while len(ports) < num and attempts < 1000:
+        attempts += 1
+        with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+            s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            s.bind(("", 0))
+            ports.add(s.getsockname()[1])
+    return ports if len(ports) == num else None
+
+
+def get_cluster(node_ips, node_ip, trainer_endpoints, selected_gpus):
+    """Build the Cluster/Pod/Trainer topology (reference utils.py:562)."""
+    assert isinstance(trainer_endpoints, list)
+    cluster = Cluster(hdfs=None)
+    trainer_rank = 0
+    for node_rank, ip in enumerate(node_ips):
+        pod = Pod()
+        pod.rank = node_rank
+        pod.addr = ip
+        pod.id = node_rank
+        cur_eps = trainer_endpoints[node_rank]
+        assert len(cur_eps) >= len(selected_gpus), \
+            "current trainer_endpoints size should >= selected_gpus size"
+        for i, gpu in enumerate(selected_gpus):
+            trainer = Trainer()
+            trainer.gpus = [gpu]
+            trainer.endpoint = cur_eps[i]
+            trainer.rank = trainer_rank
+            trainer_rank += 1
+            pod.trainers.append(trainer)
+        cluster.pods.append(pod)
+    pod_rank = node_ips.index(node_ip)
+    return cluster, cluster.pods[pod_rank]
+
+
+def add_arguments(argname, type, default, help, argparser, **kwargs):
+    """argparse helper (reference utils.py — same distutils-bool trick)."""
+    if type == bool:
+        def type(v):  # noqa: A001
+            return str(v).lower() in ("true", "1", "yes")
+    argparser.add_argument(
+        "--" + argname, default=default, type=type,
+        help=help + f" Default: %(default)s.", **kwargs)
+
+
+def get_logger(log_level, name="root"):
+    lg = logging.getLogger(name)
+    if not lg.handlers:
+        lg.setLevel(log_level)
+        handler = logging.StreamHandler()
+        handler.setFormatter(logging.Formatter(
+            "%(levelname)s %(asctime)s %(filename)s:%(lineno)d] "
+            "%(message)s"))
+        lg.addHandler(handler)
+    return lg
+
+
+def terminate_local_procs(procs):
+    for p in procs:
+        if p.proc is not None and p.proc.poll() is None:
+            p.proc.terminate()
+            if p.log_fn:
+                p.log_fn.close()
+    time.sleep(1)
+    for p in procs:
+        if p.proc is not None and p.proc.poll() is None:
+            try:
+                os.kill(p.proc.pid, signal.SIGKILL)
+            except OSError:
+                pass
+
+
+def start_local_trainers(cluster, pod, training_script,
+                         training_script_args, log_dir=None, envs=None):
+    """Spawn one subprocess per trainer of this pod (reference
+    utils.py:718). Each child sees the PADDLE_* env contract."""
+    current_env = dict(os.environ, **(envs or {}))
+    procs = []
+    for idx, t in enumerate(pod.trainers):
+        proc_env = {
+            "FLAGS_selected_gpus": ",".join(str(g) for g in t.gpus),
+            "PADDLE_TRAINER_ID": str(t.rank),
+            "PADDLE_CURRENT_ENDPOINT": str(t.endpoint),
+            "PADDLE_TRAINERS_NUM": str(cluster.trainers_nranks()),
+            "PADDLE_TRAINER_ENDPOINTS":
+                ",".join(cluster.trainers_endpoints()),
+        }
+        env = dict(current_env, **proc_env)
+        cmd = [sys.executable, "-u", training_script] + \
+            list(training_script_args)
+        log_fn = None
+        if log_dir is not None:
+            os.makedirs(log_dir, exist_ok=True)
+            log_fn = open(os.path.join(log_dir,
+                                       f"workerlog.{idx}"), "w")
+        proc = subprocess.Popen(cmd, env=env, stdout=log_fn or None,
+                                stderr=subprocess.STDOUT
+                                if log_fn else None)
+        tp = TrainerProc()
+        tp.proc = proc
+        tp.rank = t.rank
+        tp.local_rank = idx
+        tp.log_fn = log_fn
+        tp.log_offset = 0
+        tp.cmd = cmd
+        procs.append(tp)
+    return procs
+
+
+def pull_worker_log(tp):
+    if tp.log_fn is None:
+        return
+    with open(tp.log_fn.name) as fin:
+        fin.seek(tp.log_offset, 0)
+        for line in fin:
+            sys.stdout.write(line)
+        tp.log_offset = fin.tell()
+
+
+def watch_local_trainers(procs, nranks):
+    """Poll trainer processes; returns the list still alive, raising if
+    any exited abnormally (reference utils.py:760)."""
+    alive = []
+    for tp in procs:
+        pull_worker_log(tp)
+        ret = tp.proc.poll()
+        if ret is None:
+            alive.append(tp)
+        elif ret != 0:
+            terminate_local_procs(procs)
+            raise subprocess.CalledProcessError(ret, tp.cmd)
+    return alive

@@ -90,6 +90,7 @@ class Executor:
         self.place = place
         self._cache = {}      # (prog id, shape sig, fetch sig, train) -> fn
         self._opt_states = {}  # prog id -> functional opt states
+        self._aval_cache = {}  # sig -> abstract arg shapes (diagnostics)
         self._ran_startup = False
 
     def run(self, program=None, feed=None, fetch_list=None, feed_var_name="feed",
@@ -133,10 +134,15 @@ class Executor:
 
         param_vals = {p.name: p._value for p in program.param_ids.values()}
 
-        def _avals(*trees):
-            return jax.tree_util.tree_map(
-                lambda v: jax.ShapeDtypeStruct(jnp.shape(v),
-                                               jnp.asarray(v).dtype), trees)
+        def _remember_avals(*trees):
+            # once per cache signature (diagnostic support for
+            # last_cost_analysis — must not tax the training hot path)
+            if sig not in self._aval_cache:
+                self._aval_cache[sig] = jax.tree_util.tree_map(
+                    lambda v: jax.ShapeDtypeStruct(jnp.shape(v),
+                                                   jnp.result_type(v)),
+                    trees)
+            self._last_lowerable = (entry, self._aval_cache[sig])
 
         if train:
             optimizer, _ = program.minimize_records[0]
@@ -144,8 +150,7 @@ class Executor:
             if states is None:
                 states = optimizer.functional_init_states(param_vals)
             lr = jnp.asarray(optimizer.get_lr(), jnp.float32)
-            self._last_lowerable = (entry, _avals(param_vals, feed_vals,
-                                                  states, lr))
+            _remember_avals(param_vals, feed_vals, states, lr)
             fetches, new_params, new_states = entry(param_vals, feed_vals,
                                                     states, lr)
             self._opt_states[id(program)] = new_states
@@ -153,7 +158,7 @@ class Executor:
                 p._value = new_params[p.name]
             optimizer._global_step += 1
         else:
-            self._last_lowerable = (entry, _avals(param_vals, feed_vals))
+            _remember_avals(param_vals, feed_vals)
             fetches, _, _ = entry(param_vals, feed_vals)
         if return_numpy:
             return [np.asarray(f) for f in fetches]

@@ -141,3 +141,91 @@ def test_profile_measure_has_flops():
         assert cost.get("flops", 0) > 0, cost
     finally:
         paddle.disable_static()
+
+
+class TestDistributedUtils:
+    def test_cluster_topology(self):
+        from paddle_tpu.distributed import utils as dutils
+
+        eps = [["127.0.0.1:6170", "127.0.0.1:6171"],
+               ["10.0.0.2:6170", "10.0.0.2:6171"]]
+        cluster, pod = dutils.get_cluster(
+            ["127.0.0.1", "10.0.0.2"], "127.0.0.1", eps, [0, 1])
+        assert cluster.trainers_nranks() == 4
+        assert pod.rank == 0 and len(pod.trainers) == 2
+        assert cluster.trainers_endpoints()[2] == "10.0.0.2:6170"
+        assert pod.trainers[0].gpus == [0]
+        assert cluster.get_pod_by_id(1).addr == "10.0.0.2"
+        ports = dutils.find_free_ports(3)
+        assert len(ports) == 3
+
+    def test_start_and_watch_local_trainers(self, tmp_path):
+        from paddle_tpu.distributed import utils as dutils
+
+        script = tmp_path / "worker.py"
+        script.write_text(
+            "import os\n"
+            "print('rank', os.environ['PADDLE_TRAINER_ID'])\n")
+        cluster, pod = dutils.get_cluster(
+            ["127.0.0.1"], "127.0.0.1",
+            [["127.0.0.1:6180", "127.0.0.1:6181"]], [0, 1])
+        procs = dutils.start_local_trainers(
+            cluster, pod, str(script), [], log_dir=str(tmp_path / "logs"))
+        import time
+
+        deadline = time.time() + 30
+        alive = procs
+        while alive and time.time() < deadline:
+            alive = dutils.watch_local_trainers(procs, 2)
+            time.sleep(0.2)
+        assert not alive
+        logs = sorted((tmp_path / "logs").glob("workerlog.*"))
+        assert len(logs) == 2
+
+
+class TestMoEHelpers:
+    def test_number_count_and_assign_pos(self):
+        import paddle_tpu.distributed.models.moe as moe_utils
+
+        ids = paddle.to_tensor(np.array([1, 0, 2, 1, 1], np.int64))
+        counts = moe_utils._number_count(ids, 4)
+        np.testing.assert_array_equal(np.asarray(counts.numpy()),
+                                      [1, 3, 1, 0])
+        cum = paddle.to_tensor(np.cumsum(np.asarray(counts.numpy())))
+        pos = moe_utils._assign_pos(ids, cum)
+        sorted_ids = np.asarray(ids.numpy())[np.asarray(pos.numpy())]
+        assert (np.diff(sorted_ids) >= 0).all()
+        # capacity-clipped layout: only cum[-1] slots survive, overflow
+        # tokens of each expert dropped
+        clipped = np.array([1, 2, 1, 0])  # expert 1 capped at 2 (was 3)
+        cum_c = paddle.to_tensor(np.cumsum(clipped))
+        pos_c = np.asarray(moe_utils._assign_pos(ids, cum_c).numpy())
+        assert pos_c.shape == (4,)
+        ids_np = np.asarray(ids.numpy())
+        assert (ids_np[pos_c] == np.array([1, 0, 1, 2])[
+            np.argsort(np.array([1, 0, 1, 2]), kind="stable")]).all() or             sorted(ids_np[pos_c].tolist()) == [0, 1, 1, 2]
+
+    def test_limit_and_prune(self):
+        import paddle_tpu.distributed.models.moe as moe_utils
+
+        ec = paddle.to_tensor(np.array([3, 5, 2, 0], np.int64))  # 2 workers x 2 experts
+        cap = paddle.to_tensor(np.array([4, 4], np.int64))
+        out = np.asarray(moe_utils._limit_by_capacity(ec, cap, 2).numpy())
+        assert out.sum() <= 8
+        assert (out <= np.array([3, 4, 2, 0])).all()
+
+        gates = paddle.to_tensor(np.array([0, 0, 0, 1], np.int64))
+        ec2 = paddle.to_tensor(np.array([2, 2], np.int64))
+        pruned = np.asarray(moe_utils._prune_gate_by_capacity(
+            gates, ec2, 2, 1).numpy())
+        np.testing.assert_array_equal(pruned, [0, 0, -1, 1])
+
+    def test_random_routing(self):
+        import paddle_tpu.distributed.models.moe as moe_utils
+
+        idx = paddle.to_tensor(np.array([[0, 1], [2, 3]], np.int64))
+        val = paddle.to_tensor(np.array([[0.9, 0.6], [0.8, 0.1]],
+                                        np.float32))
+        prob = paddle.to_tensor(np.array([0.5, 0.9], np.float32))
+        out = np.asarray(moe_utils._random_routing(idx, val, prob).numpy())
+        np.testing.assert_array_equal(out, [[0, 1], [2, -1]])

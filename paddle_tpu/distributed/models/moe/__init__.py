@@ -19,10 +19,6 @@ __all__ = ["_number_count", "_assign_pos", "_random_routing",
            "_limit_by_capacity", "_prune_gate_by_capacity"]
 
 
-def _unwrap(x):
-    return x._value if hasattr(x, "_value") else jnp.asarray(x)
-
-
 def _number_count(numbers, upper_range):
     """Histogram of expert ids: out[i] = #(numbers == i)."""
     def f(n):
@@ -88,13 +84,18 @@ def _limit_by_capacity(expert_count, capacity, n_worker):
 
 def _prune_gate_by_capacity(gate_idx, expert_count, n_expert, n_worker):
     """Set gate ids beyond expert capacity to -1 (reference
-    prune_gate_by_capacity)."""
+    prune_gate_by_capacity). Rank-within-expert via stable argsort —
+    O(N log N), no [N, E] one-hot."""
     def f(g, ec):
-        flat = g.reshape(-1)
-        one_hot = jax.nn.one_hot(flat, n_expert * n_worker,
-                                 dtype=jnp.int64)
-        rank_in_expert = jnp.cumsum(one_hot, axis=0) * one_hot
-        pos = (rank_in_expert.max(axis=1) - 1).astype(jnp.int64)
+        flat = g.reshape(-1).astype(jnp.int32)
+        n = flat.shape[0]
+        order = jnp.argsort(flat, stable=True)
+        sorted_e = flat[order]
+        counts = jnp.bincount(sorted_e, length=n_expert * n_worker)
+        starts = jnp.concatenate(
+            [jnp.zeros(1, counts.dtype), jnp.cumsum(counts)[:-1]])
+        rank_sorted = jnp.arange(n) - starts[sorted_e]
+        rank = jnp.zeros((n,), rank_sorted.dtype).at[order].set(rank_sorted)
         cap = ec.reshape(-1)[flat]
-        return jnp.where(pos < cap, flat, -1).reshape(g.shape)
+        return jnp.where(rank < cap, flat, -1).reshape(g.shape)
     return apply(f, gate_idx, expert_count)

@@ -122,7 +122,7 @@ class Cluster:
     def __init__(self, hdfs=None):
         self.job_server = None
         self.pods = []
-        self.hdfs = None
+        self.hdfs = hdfs
         self.job_stage_flag = None
 
     def __str__(self):

@@ -1,5 +1,6 @@
 """paddle.incubate (reference: python/paddle/incubate/__init__.py)."""
 from . import asp  # noqa: F401
+from . import distributed  # noqa: F401
 from . import autograd  # noqa: F401
 from . import nn  # noqa: F401
 from .operators import (  # noqa: F401

@@ -1,0 +1,12 @@
+"""paddle.incubate.distributed.models.moe (reference:
+python/paddle/incubate/distributed/models/moe) — MoELayer + gates.
+Aliases the mesh-native implementation in paddle.distributed.moe
+(GShard top-k dispatch via all_to_all on the ep axis) and the routing
+helper ops."""
+from paddle_tpu.distributed.models.moe import (  # noqa: F401
+    _assign_pos, _limit_by_capacity, _number_count,
+    _prune_gate_by_capacity, _random_routing,
+)
+from paddle_tpu.distributed.moe import MoELayer  # noqa: F401
+
+__all__ = ["MoELayer"]

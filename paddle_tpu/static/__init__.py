@@ -1,5 +1,6 @@
 """paddle.static (reference: python/paddle/static/__init__.py)."""
 from . import nn  # noqa: F401
+from . import amp  # noqa: F401
 from .executor import (  # noqa: F401
     BuildStrategy, CompiledProgram, ExecutionStrategy, Executor, global_scope,
     scope_guard,

@@ -1,0 +1,75 @@
+"""paddle.static.amp — mixed precision for the static-graph path.
+
+Reference: python/paddle/static/amp (re-exports
+fluid/contrib/mixed_precision: decorate, AutoMixedPrecisionLists,
+fp16_guard, cast_model_to_fp16/parameters_to_fp16, bf16 submodule).
+
+TPU-native: static programs trace through the same eager ops as dygraph,
+so the dygraph AMP machinery (auto_cast policy + decorate) IS the static
+policy; fp16 requests map to bf16 on TPU. cast_model_to_fp16 /
+cast_parameters_to_fp16 operate on a Program's parameters directly.
+"""
+from __future__ import annotations
+
+import types
+
+from ..amp import GradScaler, amp_guard, auto_cast, decorate  # noqa: F401
+
+__all__ = ["decorate", "CustomOpLists", "AutoMixedPrecisionLists",
+           "fp16_guard", "cast_model_to_fp16", "cast_parameters_to_fp16",
+           "bf16", "auto_cast", "amp_guard", "GradScaler"]
+
+
+class AutoMixedPrecisionLists:
+    """White/black op lists (reference fp16_lists.py)."""
+
+    def __init__(self, custom_white_list=None, custom_black_list=None,
+                 custom_black_varnames=None):
+        self.white_list = set(custom_white_list or [])
+        self.black_list = set(custom_black_list or [])
+        self.black_varnames = set(custom_black_varnames or [])
+
+
+CustomOpLists = AutoMixedPrecisionLists
+
+
+def fp16_guard():
+    """Context marking a region for fp16 (-> bf16 on TPU) execution."""
+    return auto_cast(enable=True, level="O2")
+
+
+def _cast_params(program, dtype):
+    import jax.numpy as jnp
+
+    n = 0
+    for p in getattr(program, "param_ids", {}).values():
+        if jnp.issubdtype(p._value.dtype, jnp.floating):
+            p._value = p._value.astype(dtype)
+            n += 1
+    return n
+
+
+def cast_model_to_fp16(program, amp_lists=None, use_fp16_guard=True):
+    """Cast a Program's float parameters to bf16 (TPU's fp16-class type)."""
+    import jax.numpy as jnp
+
+    _cast_params(program, jnp.bfloat16)
+    return program
+
+
+def cast_parameters_to_fp16(place, program, scope=None, to_fp16_var_names=None):
+    import jax.numpy as jnp
+
+    _cast_params(program, jnp.bfloat16)
+
+
+# bf16 submodule (reference static/amp/bf16): on TPU bf16 IS the amp dtype
+bf16 = types.ModuleType(__name__ + ".bf16")
+bf16.auto_cast = auto_cast
+bf16.decorate_bf16 = decorate
+bf16.AutoMixedPrecisionListsBF16 = AutoMixedPrecisionLists
+bf16.cast_model_to_bf16 = cast_model_to_fp16
+bf16.cast_parameters_to_bf16 = cast_parameters_to_fp16
+import sys as _sys
+
+_sys.modules[bf16.__name__] = bf16

@@ -483,3 +483,17 @@ def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
         backward=backward_func)
     xs = x if isinstance(x, (list, tuple)) else (x,)
     return op(*xs)
+
+
+# legacy sequence / misc ops (see static/sequence_ops.py for the padded-
+# dense + lengths design; reference fluid/layers/sequence_lod.py)
+from .sequence_ops import (  # noqa: E402,F401
+    crf_decoding, multi_box_head, nce, sequence_concat, sequence_conv,
+    sequence_enumerate, sequence_expand, sequence_expand_as,
+    sequence_first_step, sequence_last_step, sequence_pad, sequence_pool,
+    sequence_reshape, sequence_reverse, sequence_scatter, sequence_slice,
+    prior_box, sequence_softmax, sequence_unpad, sparse_embedding,
+)
+from .sequence_ops import __all__ as _seq_all
+
+__all__ = list(__all__) + list(_seq_all)

@@ -1,0 +1,386 @@
+"""Legacy sequence ops (reference: fluid/layers/sequence_lod.py).
+
+The reference operates on LoDTensors (ragged sequences carried as a flat
+tensor + level-of-detail offsets). This runtime has no LoD: the TPU-native
+carrier for ragged batches is a PADDED dense tensor [batch, max_len, ...]
+plus an explicit `lengths` vector — the layout XLA can tile (static
+shapes; masks instead of offsets). Every op below takes that pair; with
+lengths=None the batch is treated as fully dense. sequence_pad/unpad
+convert between the two worlds exactly like the reference pair does.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.autograd import apply
+
+__all__ = ["sequence_conv", "sequence_pool", "sequence_concat",
+           "sequence_first_step", "sequence_last_step", "sequence_slice",
+           "sequence_expand", "sequence_expand_as", "sequence_pad",
+           "sequence_unpad", "sequence_reshape", "sequence_scatter",
+           "sequence_enumerate", "sequence_softmax", "sequence_reverse",
+           "crf_decoding", "nce", "sparse_embedding", "multi_box_head",
+           "prior_box"]
+
+
+def _len_mask(lengths, max_len):
+    return jnp.arange(max_len)[None, :] < lengths[:, None]
+
+
+def _unwrap(x):
+    return x._value if hasattr(x, "_value") else jnp.asarray(x)
+
+
+def sequence_pad(x, pad_value, maxlen=None, name=None):
+    """List-of-rows + lengths world entry point: here x is already
+    [batch, time, ...]; returns (x padded to maxlen, lengths). Reference
+    sequence_lod.py::sequence_pad emits the same (Out, Length) pair."""
+    def f(v, pv):
+        t = v.shape[1]
+        tgt = t if maxlen is None else maxlen
+        if tgt < t:
+            raise ValueError(
+                f"sequence_pad: maxlen ({tgt}) must be >= the input time "
+                f"dimension ({t}) — the reference errors here too")
+        if tgt > t:
+            pad = [(0, 0), (0, tgt - t)] + [(0, 0)] * (v.ndim - 2)
+            v = jnp.pad(v, pad, constant_values=pv)
+        lengths = jnp.full((v.shape[0],), t, jnp.int64)
+        return v, lengths
+
+    return apply(f, x, pad_value)
+
+
+def sequence_unpad(x, length, name=None):
+    """[batch, max_len, ...] + lengths -> flat [sum(len), ...] (the
+    reference's LoD-flat layout; data-dependent shape => eager)."""
+    def f(v, ln):
+        rows = [v[i, :int(l)] for i, l in enumerate(ln)]
+        return jnp.concatenate(rows, axis=0)
+
+    return apply(f, x, length)
+
+
+def sequence_pool(input, pool_type, is_test=False, pad_value=0.0,
+                  lengths=None, name=None):
+    pool_type = pool_type.lower()
+
+    def f(v, ln):
+        t = v.shape[1]
+        ln_ = ln if ln is not None else jnp.full((v.shape[0],), t)
+        mask = _len_mask(ln_, t)
+        mshape = mask.shape + (1,) * (v.ndim - 2)
+        m = mask.reshape(mshape)
+        n = jnp.maximum(ln_, 1).reshape((-1,) + (1,) * (v.ndim - 2))
+        if pool_type == "sum":
+            return jnp.where(m, v, 0).sum(1)
+        if pool_type in ("average", "avg"):
+            return jnp.where(m, v, 0).sum(1) / n
+        if pool_type == "sqrt":
+            return jnp.where(m, v, 0).sum(1) / jnp.sqrt(
+                n.astype(jnp.float32))
+        if pool_type == "max":
+            return jnp.where(m, v, -jnp.inf).max(1)
+        if pool_type == "first":
+            return v[:, 0]
+        if pool_type == "last":
+            idx = jnp.maximum(ln_ - 1, 0)
+            return jnp.take_along_axis(
+                v, idx.reshape((-1, 1) + (1,) * (v.ndim - 2)), 1)[:, 0]
+        raise ValueError(f"unknown pool_type {pool_type!r}")
+
+    return apply(f, input, lengths)
+
+
+def sequence_first_step(input, lengths=None):
+    return sequence_pool(input, "first", lengths=lengths)
+
+
+def sequence_last_step(input, lengths=None):
+    return sequence_pool(input, "last", lengths=lengths)
+
+
+def sequence_softmax(input, use_cudnn=False, name=None, lengths=None):
+    def f(v, ln):
+        t = v.shape[1]
+        ln_ = ln if ln is not None else jnp.full((v.shape[0],), t)
+        mask = _len_mask(ln_, t).reshape(
+            (v.shape[0], t) + (1,) * (v.ndim - 2))
+        logits = jnp.where(mask, v, -jnp.inf)
+        return jnp.where(mask, jax.nn.softmax(logits, axis=1), 0.0)
+
+    return apply(f, input, lengths)
+
+
+def sequence_reverse(x, name=None, lengths=None):
+    def f(v, ln):
+        t = v.shape[1]
+        ln_ = ln if ln is not None else jnp.full((v.shape[0],), t)
+        idx = ln_[:, None] - 1 - jnp.arange(t)[None, :]
+        idx = jnp.where(idx >= 0, idx, jnp.arange(t)[None, :])
+        return jnp.take_along_axis(
+            v, idx.reshape(idx.shape + (1,) * (v.ndim - 2)), 1)
+
+    return apply(f, x, lengths)
+
+
+def sequence_concat(input, name=None):
+    """Concatenate along time (reference concats per-sequence LoD rows;
+    the padded equivalent concatenates the time axis)."""
+    def f(*vs):
+        return jnp.concatenate(vs, axis=1)
+
+    return apply(f, *input)
+
+
+def sequence_slice(input, offset, length, name=None):
+    def f(v, off, ln):
+        t = v.shape[1]
+        idx = off.reshape(-1, 1) + jnp.arange(t)[None, :]
+        idx = jnp.clip(idx, 0, t - 1)
+        g = jnp.take_along_axis(
+            v, idx.reshape(idx.shape + (1,) * (v.ndim - 2)), 1)
+        mask = jnp.arange(t)[None, :] < ln.reshape(-1, 1)
+        return jnp.where(mask.reshape(mask.shape + (1,) * (v.ndim - 2)),
+                         g, 0)
+
+    return apply(f, input, offset, length)
+
+
+def sequence_expand(x, y, ref_level=-1, name=None, repeats=None):
+    """Repeat each batch row per `repeats` (reference expands rows per
+    y's LoD; padded world: explicit repeat counts; data-dependent shape
+    => eager)."""
+    def f(v, rep):
+        return jnp.repeat(v, rep, axis=0, total_repeat_length=int(
+            jnp.sum(rep)))
+
+    if repeats is None:
+        repeats = y
+    return apply(f, x, repeats)
+
+
+def sequence_expand_as(x, y, name=None):
+    def f(v, w):
+        reps = w.shape[0] // v.shape[0]
+        return jnp.repeat(v, reps, axis=0)
+
+    return apply(f, x, y)
+
+
+def sequence_reshape(input, new_dim):
+    def f(v):
+        return v.reshape(v.shape[0], -1, new_dim)
+
+    return apply(f, input)
+
+
+def sequence_scatter(input, index, updates, name=None):
+    def f(v, idx, upd):
+        return v.at[jnp.arange(v.shape[0])[:, None], idx].add(upd)
+
+    return apply(f, input, index, updates)
+
+
+def sequence_enumerate(input, win_size, pad_value=0, name=None):
+    def f(v):
+        t = v.shape[1]
+        base = jnp.arange(t)[:, None] + jnp.arange(win_size)[None, :]
+        gathered = jnp.where(base < t, v[:, jnp.clip(base, 0, t - 1)],
+                             pad_value)
+        return gathered
+
+    return apply(f, input)
+
+
+def sequence_conv(input, num_filters, filter_size=3, filter_stride=1,
+                  padding=True, padding_start=None, bias_attr=None,
+                  param_attr=None, act=None, name=None):
+    """Sliding-window 1-D conv over time (reference sequence_conv):
+    implemented as a Conv1D over the padded layout."""
+    from .. import nn
+
+    conv = nn.Conv1D(int(input.shape[-1]), num_filters, filter_size,
+                     stride=filter_stride,
+                     padding=(filter_size - 1) // 2 if padding else 0,
+                     weight_attr=param_attr, bias_attr=bias_attr,
+                     data_format="NLC")
+    out = conv(input)
+    if act == "relu":
+        out = nn.functional.relu(out)
+    elif act == "tanh":
+        out = nn.functional.tanh(out)
+    return out
+
+
+def crf_decoding(input, param_attr=None, label=None, length=None,
+                 transition=None):
+    """Viterbi decode (reference crf_decoding over linear_chain_crf
+    transitions). transition: [num_tags + 2, num_tags] or
+    [num_tags, num_tags]; the +2 start/stop rows of the reference CRF are
+    folded into the emissions when present."""
+    from ..text import viterbi_decode
+
+    if transition is None:
+        raise ValueError("crf_decoding needs the CRF `transition` tensor "
+                         "(the reference reads it from param_attr's "
+                         "learned variable)")
+    t = _unwrap(transition)
+    n_tags = int(input.shape[-1])
+    if t.shape[0] == n_tags + 2:
+        t = t[2:]
+    _, path = viterbi_decode(input, t, lengths=length,
+                             include_bos_eos_tag=False)
+    return path
+
+
+def sparse_embedding(input, size, padding_idx=None, is_test=False,
+                     entry=None, param_attr=None, dtype="float32"):
+    """Reference sparse_embedding stores rows on parameter servers (PS
+    waiver — SURVEY §2); the mesh-native equivalent is a dense (or
+    vocab-sharded, via mp_layers.VocabParallelEmbedding) embedding."""
+    from .. import nn
+
+    emb = nn.Embedding(size[0], size[1], padding_idx=padding_idx,
+                       weight_attr=param_attr)
+    return emb(input)
+
+
+def nce(input, label, num_total_classes, sample_weight=None,
+        param_attr=None, bias_attr=None, num_neg_samples=10, name=None,
+        sampler="uniform", custom_dist=None, seed=0, is_sparse=False,
+        weight=None, bias=None):
+    """Noise-contrastive estimation loss (reference nce op): logistic
+    discrimination of the true class against `num_neg_samples` uniform
+    negatives. Pass `weight` [num_classes, dim] (and optional `bias`)
+    explicitly — the functional world has no hidden ParamAttr store."""
+    if weight is None:
+        raise ValueError("nce needs the class `weight` matrix (the "
+                         "reference creates it from param_attr)")
+
+    def f(h, y, w, b, key):
+        n, d = h.shape
+        neg = jax.random.randint(key, (n, num_neg_samples), 0,
+                                 num_total_classes)
+        pos_w = w[y.reshape(-1)]                        # [n, d]
+        pos_logit = (h * pos_w).sum(-1)
+        if b is not None:
+            pos_logit = pos_logit + b[y.reshape(-1)]
+        neg_w = w[neg]                                  # [n, k, d]
+        neg_logit = jnp.einsum("nd,nkd->nk", h, neg_w)
+        if b is not None:
+            neg_logit = neg_logit + b[neg]
+        loss = -jax.nn.log_sigmoid(pos_logit) \
+            - jax.nn.log_sigmoid(-neg_logit).sum(-1)
+        return loss.reshape(-1, 1)
+
+    from ..framework import random as rnd
+
+    return apply(f, input, label, weight, bias, rnd.next_key())
+
+
+def _prior_whs(min_sizes, max_sizes, aspect_ratios, flip, iw, ih):
+    """(w, h) of every prior a cell generates — the SINGLE source of truth
+    for the prior count, shared by prior_box and multi_box_head."""
+    ratios = list(aspect_ratios)
+    if flip:
+        ratios = ratios + [1.0 / r for r in ratios if r != 1.0]
+    whs = []
+    for ms in min_sizes:
+        for r in ratios:
+            whs.append((ms * (r ** 0.5) / iw, ms / (r ** 0.5) / ih))
+    if max_sizes:
+        for ms, mx in zip(min_sizes, max_sizes):
+            s = (ms * mx) ** 0.5
+            whs.append((s / iw, s / ih))
+    return whs
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.,),
+              variance=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0.0, 0.0), offset=0.5, name=None,
+              min_max_aspect_ratios_order=False):
+    """SSD prior boxes (reference fluid/layers/detection.py::prior_box):
+    per feature-map cell, one box per (min_size x aspect ratio) plus one
+    per (min,max) geometric mean, corner coords normalized by image size."""
+    def f(fmap, img):
+        fh, fw = fmap.shape[2], fmap.shape[3]
+        ih, iw = img.shape[2], img.shape[3]
+        step_w = steps[0] or iw / fw
+        step_h = steps[1] or ih / fh
+        cx = (jnp.arange(fw) + offset) * step_w / iw   # [fw]
+        cy = (jnp.arange(fh) + offset) * step_h / ih   # [fh]
+        wh = jnp.asarray(_prior_whs(min_sizes, max_sizes, aspect_ratios,
+                                    flip, iw, ih))     # [P, 2]
+        cxg, cyg = jnp.meshgrid(cx, cy)                # [fh, fw]
+        centers = jnp.stack([cxg, cyg], -1)[:, :, None, :]  # [fh,fw,1,2]
+        half = wh[None, None, :, :] / 2
+        boxes = jnp.concatenate([centers - half, centers + half], -1)
+        if clip:
+            boxes = jnp.clip(boxes, 0.0, 1.0)
+        var = jnp.broadcast_to(jnp.asarray(variance), boxes.shape)
+        return boxes, var
+
+    return apply(f, input, image)
+
+
+def multi_box_head(inputs, image, base_size, num_classes, aspect_ratios,
+                   min_ratio=None, max_ratio=None, min_sizes=None,
+                   max_sizes=None, steps=None, step_w=None, step_h=None,
+                   offset=0.5, variance=(0.1, 0.1, 0.2, 0.2), flip=True,
+                   clip=False, kernel_size=1, pad=0, stride=1, name=None,
+                   min_max_aspect_ratios_order=False):
+    """SSD prior-box head (reference multi_box_head): conv loc/conf
+    predictions + prior boxes for each feature map. Per-map min/max sizes
+    derive from min_ratio..max_ratio when not given (reference formula);
+    the conv channel counts come from the SAME _prior_whs the boxes do,
+    so locs and boxes always align."""
+    from .. import nn
+
+    n_maps = len(inputs)
+    if min_sizes is None:
+        # reference: interpolate ratios across feature maps; the first map
+        # uses base_size * 10% / 20%
+        assert min_ratio is not None and max_ratio is not None, \
+            "give min_sizes/max_sizes or min_ratio/max_ratio"
+        min_sizes, max_sizes = [], []
+        if n_maps > 2:
+            step = int((max_ratio - min_ratio) / (n_maps - 2))
+            for ratio in range(min_ratio, max_ratio + 1, step):
+                min_sizes.append(base_size * ratio / 100.0)
+                max_sizes.append(base_size * (ratio + step) / 100.0)
+        min_sizes = [base_size * 0.1] + min_sizes
+        max_sizes = [base_size * 0.2] + max_sizes
+
+    def _per_map(lst, i):
+        if lst is None:
+            return None
+        e = lst[i] if isinstance(lst, (list, tuple)) and \
+            i < len(lst) else lst[-1] if isinstance(lst, (list, tuple)) \
+            else lst
+        return e if isinstance(e, (list, tuple)) else [e]
+
+    locs, confs, boxes, variances = [], [], [], []
+    ih, iw = int(image.shape[2]), int(image.shape[3])
+    for i, x in enumerate(inputs):
+        c = int(x.shape[1])
+        ms = _per_map(min_sizes, i)
+        mx = _per_map(max_sizes, i)
+        ar = _per_map(aspect_ratios, i) or [1.0]
+        n_priors = len(_prior_whs(ms, mx, ar, flip, iw, ih))
+        loc = nn.Conv2D(c, n_priors * 4, kernel_size, padding=pad,
+                        stride=stride)(x)
+        conf = nn.Conv2D(c, n_priors * num_classes, kernel_size,
+                         padding=pad, stride=stride)(x)
+        box, var = prior_box(x, image, min_sizes=ms, max_sizes=mx,
+                             aspect_ratios=ar, variance=list(variance),
+                             flip=flip, clip=clip)
+        locs.append(loc)
+        confs.append(conf)
+        boxes.append(box.reshape([-1, 4]))
+        variances.append(var.reshape([-1, 4]))
+    from .. import tensor as T
+
+    return (locs, confs, T.concat(boxes, axis=0),
+            T.concat(variances, axis=0))

@@ -10,7 +10,7 @@ from __future__ import annotations
 from ... import nn
 
 __all__ = ["ResNet", "resnet18", "resnet34", "resnet50", "resnet101",
-           "resnet152", "resnext50_32x4d", "resnext50_64x4d",
+           "resnet152", "ResNeXt", "resnext50_32x4d", "resnext50_64x4d",
            "resnext101_32x4d", "resnext101_64x4d", "resnext152_32x4d",
            "resnext152_64x4d", "wide_resnet50_2", "wide_resnet101_2"]
 
@@ -197,3 +197,16 @@ def wide_resnet50_2(pretrained=False, **kwargs):
 
 def wide_resnet101_2(pretrained=False, **kwargs):
     return _resnet(BottleneckBlock, 101, pretrained, width=128, **kwargs)
+
+
+class ResNeXt(ResNet):
+    """Reference: python/paddle/vision/models/resnext.py:129 — the class
+    form of the resnextNN_Kx4d ctors (depth + cardinality, 4d width)."""
+
+    def __init__(self, depth=50, cardinality=32, num_classes=1000,
+                 with_pool=True):
+        super().__init__(BottleneckBlock, depth, width=4,
+                         num_classes=num_classes, with_pool=with_pool,
+                         groups=cardinality)
+        self.depth = depth
+        self.cardinality = cardinality

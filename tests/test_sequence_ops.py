@@ -1,0 +1,111 @@
+"""Legacy sequence ops over the padded-dense + lengths carrier
+(reference: fluid/layers/sequence_lod.py — see static/sequence_ops.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.static import nn as snn
+
+
+@pytest.fixture
+def seq():
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.randn(3, 5, 4).astype(np.float32))
+    lengths = paddle.to_tensor(np.array([5, 3, 1], np.int64))
+    return x, lengths, rng
+
+
+class TestSequencePool:
+    def test_pool_types(self, seq):
+        x, ln, _ = seq
+        xn = np.asarray(x.numpy())
+        lnn = np.asarray(ln.numpy())
+        out_sum = np.asarray(snn.sequence_pool(x, "sum", lengths=ln).numpy())
+        for i, l in enumerate(lnn):
+            np.testing.assert_allclose(out_sum[i], xn[i, :l].sum(0),
+                                       rtol=1e-5)
+        out_avg = np.asarray(snn.sequence_pool(x, "average",
+                                               lengths=ln).numpy())
+        np.testing.assert_allclose(out_avg[1], xn[1, :3].mean(0), rtol=1e-5)
+        out_max = np.asarray(snn.sequence_pool(x, "max", lengths=ln).numpy())
+        np.testing.assert_allclose(out_max[2], xn[2, :1].max(0), rtol=1e-5)
+        out_last = np.asarray(snn.sequence_last_step(x, lengths=ln).numpy())
+        np.testing.assert_allclose(out_last[1], xn[1, 2], rtol=1e-6)
+        out_first = np.asarray(snn.sequence_first_step(x).numpy())
+        np.testing.assert_allclose(out_first, xn[:, 0], rtol=1e-6)
+
+    def test_softmax_masks_padding(self, seq):
+        x, ln, _ = seq
+        out = np.asarray(snn.sequence_softmax(x, lengths=ln).numpy())
+        np.testing.assert_allclose(out.sum(1), np.ones((3, 4)), rtol=1e-5)
+        assert (out[2, 1:] == 0).all()  # beyond length -> zero prob
+
+    def test_reverse_respects_lengths(self, seq):
+        x, ln, _ = seq
+        xn = np.asarray(x.numpy())
+        out = np.asarray(snn.sequence_reverse(x, lengths=ln).numpy())
+        np.testing.assert_allclose(out[1, :3], xn[1, :3][::-1], rtol=1e-6)
+        np.testing.assert_allclose(out[1, 3:], xn[1, 3:], rtol=1e-6)
+
+
+class TestPadUnpad:
+    def test_round_trip(self):
+        rng = np.random.RandomState(1)
+        x = paddle.to_tensor(rng.randn(2, 4, 3).astype(np.float32))
+        padded, lengths = snn.sequence_pad(x, 0.0, maxlen=6)
+        assert padded.shape == [2, 6, 3]
+        flat = snn.sequence_unpad(padded,
+                                  paddle.to_tensor(np.array([4, 2])))
+        assert flat.shape == [6, 3]
+        np.testing.assert_allclose(np.asarray(flat.numpy())[:4],
+                                   np.asarray(x.numpy())[0], rtol=1e-6)
+
+
+class TestMiscOps:
+    def test_sequence_conv_shape(self, seq):
+        x, _, _ = seq
+        paddle.seed(0)
+        out = snn.sequence_conv(x, num_filters=8, filter_size=3)
+        assert out.shape == [3, 5, 8]
+
+    def test_crf_decoding(self):
+        rng = np.random.RandomState(2)
+        emis = paddle.to_tensor(rng.randn(2, 6, 4).astype(np.float32))
+        trans = paddle.to_tensor(rng.randn(4, 4).astype(np.float32))
+        path = snn.crf_decoding(emis, transition=trans)
+        arr = np.asarray(path.numpy())
+        assert arr.shape == (2, 6)
+        assert ((arr >= 0) & (arr < 4)).all()
+
+    def test_nce_loss(self):
+        rng = np.random.RandomState(3)
+        h = paddle.to_tensor(rng.randn(8, 16).astype(np.float32))
+        y = paddle.to_tensor(rng.randint(0, 50, (8, 1)))
+        w = paddle.to_tensor(rng.randn(50, 16).astype(np.float32) * 0.1)
+        h.stop_gradient = False
+        w.stop_gradient = False
+        loss = snn.nce(h, y, 50, num_neg_samples=5, weight=w)
+        assert loss.shape == [8, 1]
+        loss.sum().backward()
+        assert h.grad is not None and w.grad is not None
+
+    def test_sparse_embedding(self):
+        paddle.seed(0)
+        ids = paddle.to_tensor(np.array([[1, 2], [3, 0]], np.int64))
+        out = snn.sparse_embedding(ids, size=[10, 6])
+        assert out.shape == [2, 2, 6]
+
+    def test_prior_box(self):
+        fmap = paddle.randn([1, 8, 4, 4])
+        img = paddle.randn([1, 3, 64, 64])
+        boxes, var = snn.prior_box(fmap, img, min_sizes=[16.0],
+                                   aspect_ratios=[1.0, 2.0], flip=True,
+                                   clip=True)
+        assert boxes.shape == [4, 4, 3, 4]
+        b = np.asarray(boxes.numpy())
+        assert (b >= 0).all() and (b <= 1).all()
+
+    def test_sequence_enumerate(self):
+        x = paddle.to_tensor(np.arange(10).reshape(2, 5))
+        out = np.asarray(snn.sequence_enumerate(x, 2).numpy())
+        assert out.shape[0] == 2

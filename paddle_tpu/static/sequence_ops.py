@@ -29,7 +29,11 @@ def _len_mask(lengths, max_len):
 
 
 def _unwrap(x):
-    return x._value if hasattr(x, "_value") else jnp.asarray(x)
+    # shared unwrapping lives in core.autograd._raw; asarray covers plain
+    # numpy/python inputs
+    from ..core.autograd import _raw
+
+    return jnp.asarray(_raw(x))
 
 
 def sequence_pad(x, pad_value, maxlen=None, name=None):
@@ -73,21 +77,27 @@ def sequence_pool(input, pool_type, is_test=False, pad_value=0.0,
         mshape = mask.shape + (1,) * (v.ndim - 2)
         m = mask.reshape(mshape)
         n = jnp.maximum(ln_, 1).reshape((-1,) + (1,) * (v.ndim - 2))
+        empty = (ln_ == 0).reshape((-1,) + (1,) * (v.ndim - 2))
+
+        def _fill(out):
+            # zero-length sequences pool to pad_value (reference contract)
+            return jnp.where(empty, jnp.asarray(pad_value, out.dtype), out)
+
         if pool_type == "sum":
-            return jnp.where(m, v, 0).sum(1)
+            return _fill(jnp.where(m, v, 0).sum(1))
         if pool_type in ("average", "avg"):
-            return jnp.where(m, v, 0).sum(1) / n
+            return _fill(jnp.where(m, v, 0).sum(1) / n)
         if pool_type == "sqrt":
-            return jnp.where(m, v, 0).sum(1) / jnp.sqrt(
-                n.astype(jnp.float32))
+            return _fill(jnp.where(m, v, 0).sum(1) / jnp.sqrt(
+                n.astype(jnp.float32)))
         if pool_type == "max":
-            return jnp.where(m, v, -jnp.inf).max(1)
+            return _fill(jnp.where(m, v, -jnp.inf).max(1))
         if pool_type == "first":
-            return v[:, 0]
+            return _fill(v[:, 0])
         if pool_type == "last":
             idx = jnp.maximum(ln_ - 1, 0)
-            return jnp.take_along_axis(
-                v, idx.reshape((-1, 1) + (1,) * (v.ndim - 2)), 1)[:, 0]
+            return _fill(jnp.take_along_axis(
+                v, idx.reshape((-1, 1) + (1,) * (v.ndim - 2)), 1)[:, 0])
         raise ValueError(f"unknown pool_type {pool_type!r}")
 
     return apply(f, input, lengths)
@@ -219,7 +229,8 @@ def crf_decoding(input, param_attr=None, label=None, length=None,
     """Viterbi decode (reference crf_decoding over linear_chain_crf
     transitions). transition: [num_tags + 2, num_tags] or
     [num_tags, num_tags]; the +2 start/stop rows of the reference CRF are
-    folded into the emissions when present."""
+    folded into the first/last emissions (same decoded path)."""
+    from ..core.tensor import Tensor
     from ..text import viterbi_decode
 
     if transition is None:
@@ -228,9 +239,17 @@ def crf_decoding(input, param_attr=None, label=None, length=None,
                          "learned variable)")
     t = _unwrap(transition)
     n_tags = int(input.shape[-1])
+    emis = _unwrap(input)
     if t.shape[0] == n_tags + 2:
-        t = t[2:]
-    _, path = viterbi_decode(input, t, lengths=length,
+        start, stop, t = t[0], t[1], t[2:]
+        emis = emis.at[:, 0, :].add(start)
+        if length is not None:
+            ln = _unwrap(length).astype(jnp.int64)
+            last = jnp.clip(ln - 1, 0, emis.shape[1] - 1)
+            emis = emis.at[jnp.arange(emis.shape[0]), last, :].add(stop)
+        else:
+            emis = emis.at[:, -1, :].add(stop)
+    _, path = viterbi_decode(Tensor(emis), t, lengths=length,
                              include_bos_eos_tag=False)
     return path
 
@@ -252,17 +271,34 @@ def nce(input, label, num_total_classes, sample_weight=None,
         sampler="uniform", custom_dist=None, seed=0, is_sparse=False,
         weight=None, bias=None):
     """Noise-contrastive estimation loss (reference nce op): logistic
-    discrimination of the true class against `num_neg_samples` uniform
-    negatives. Pass `weight` [num_classes, dim] (and optional `bias`)
-    explicitly — the functional world has no hidden ParamAttr store."""
+    discrimination of the true class against `num_neg_samples` negatives.
+    sampler: 'uniform' | 'log_uniform' | 'custom_dist' (with custom_dist
+    = per-class probabilities); `seed` gives reproducible negatives. Pass
+    `weight` [num_classes, dim] (and optional `bias`) explicitly — the
+    functional world has no hidden ParamAttr store."""
     if weight is None:
         raise ValueError("nce needs the class `weight` matrix (the "
                          "reference creates it from param_attr)")
+    if sampler not in ("uniform", "log_uniform", "custom_dist"):
+        raise ValueError(f"unknown sampler {sampler!r}")
+    if sampler == "custom_dist" and custom_dist is None:
+        raise ValueError("sampler='custom_dist' needs custom_dist")
 
-    def f(h, y, w, b, key):
+    def f(h, y, w, b, sw, key):
         n, d = h.shape
-        neg = jax.random.randint(key, (n, num_neg_samples), 0,
-                                 num_total_classes)
+        if sampler == "uniform":
+            neg = jax.random.randint(key, (n, num_neg_samples), 0,
+                                     num_total_classes)
+        elif sampler == "log_uniform":
+            # P(k) ∝ log(k+2)-log(k+1) — the Zipfian sampler
+            u = jax.random.uniform(key, (n, num_neg_samples))
+            neg = (jnp.exp(u * jnp.log(num_total_classes + 1.0))
+                   - 1.0).astype(jnp.int32)
+            neg = jnp.clip(neg, 0, num_total_classes - 1)
+        else:
+            logits = jnp.log(jnp.asarray(custom_dist) + 1e-20)
+            neg = jax.random.categorical(
+                key, logits[None, :], shape=(n, num_neg_samples))
         pos_w = w[y.reshape(-1)]                        # [n, d]
         pos_logit = (h * pos_w).sum(-1)
         if b is not None:
@@ -273,11 +309,14 @@ def nce(input, label, num_total_classes, sample_weight=None,
             neg_logit = neg_logit + b[neg]
         loss = -jax.nn.log_sigmoid(pos_logit) \
             - jax.nn.log_sigmoid(-neg_logit).sum(-1)
+        if sw is not None:
+            loss = loss * sw.reshape(-1)
         return loss.reshape(-1, 1)
 
     from ..framework import random as rnd
 
-    return apply(f, input, label, weight, bias, rnd.next_key())
+    key = jax.random.PRNGKey(seed) if seed else rnd.next_key()
+    return apply(f, input, label, weight, bias, sample_weight, key)
 
 
 def _prior_whs(min_sizes, max_sizes, aspect_ratios, flip, iw, ih):
@@ -363,24 +402,32 @@ def multi_box_head(inputs, image, base_size, num_classes, aspect_ratios,
 
     locs, confs, boxes, variances = [], [], [], []
     ih, iw = int(image.shape[2]), int(image.shape[3])
+    from .. import tensor as T
+
     for i, x in enumerate(inputs):
         c = int(x.shape[1])
         ms = _per_map(min_sizes, i)
         mx = _per_map(max_sizes, i)
         ar = _per_map(aspect_ratios, i) or [1.0]
         n_priors = len(_prior_whs(ms, mx, ar, flip, iw, ih))
+        # per-map step: explicit steps list > step_w/step_h > auto
+        st = _per_map(steps, i) if steps else None
+        sw = st[0] if st else (step_w or 0.0)
+        sh = st[-1] if st else (step_h or 0.0)
         loc = nn.Conv2D(c, n_priors * 4, kernel_size, padding=pad,
                         stride=stride)(x)
         conf = nn.Conv2D(c, n_priors * num_classes, kernel_size,
                          padding=pad, stride=stride)(x)
+        n = int(loc.shape[0])
+        # NCHW conv maps -> [N, priors_of_map, 4|C] (reference layout)
+        locs.append(T.reshape(T.transpose(loc, [0, 2, 3, 1]), [n, -1, 4]))
+        confs.append(T.reshape(T.transpose(conf, [0, 2, 3, 1]),
+                               [n, -1, num_classes]))
         box, var = prior_box(x, image, min_sizes=ms, max_sizes=mx,
                              aspect_ratios=ar, variance=list(variance),
-                             flip=flip, clip=clip)
-        locs.append(loc)
-        confs.append(conf)
+                             flip=flip, clip=clip, steps=(sw, sh),
+                             offset=offset)
         boxes.append(box.reshape([-1, 4]))
         variances.append(var.reshape([-1, 4]))
-    from .. import tensor as T
-
-    return (locs, confs, T.concat(boxes, axis=0),
-            T.concat(variances, axis=0))
+    return (T.concat(locs, axis=1), T.concat(confs, axis=1),
+            T.concat(boxes, axis=0), T.concat(variances, axis=0))

@@ -180,3 +180,32 @@ def test_fleet_utils_recompute():
     np.testing.assert_allclose(g_w_rc,
                                np.asarray(block[0].weight.grad.numpy()),
                                rtol=1e-5)
+
+
+def test_fleet_deep_pipeline_pp4():
+    """pp=4 x dp=2 through the public API (deeper pipeline than the 2-stage
+    case; exercises multi-hop ppermute rotation)."""
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 2, "mp_degree": 1,
+                               "pp_degree": 4}
+    fleet.init(is_collective=True, strategy=strategy)
+    paddle.seed(13)
+    cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=4,
+                    num_heads=4, max_position=16, dropout=0.0,
+                    use_flash=False)
+    model = fleet.distributed_model(GPTForCausalLM(cfg))
+    inner = getattr(model, "_layers", model)
+    assert inner.gpt.h.num_stages == 4
+    opt = fleet.distributed_optimizer(paddle.optimizer.AdamW(
+        learning_rate=2e-3, parameters=model.parameters()))
+    rng = np.random.RandomState(13)
+    ids = paddle.to_tensor(rng.randint(0, 64, (8, 12)))
+    labels = paddle.to_tensor(rng.randint(0, 64, (8, 12)))
+    losses = []
+    for _ in range(5):
+        loss = model(ids, labels=labels)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss))
+    assert all(np.isfinite(losses)) and losses[-1] < losses[0], losses

@@ -625,3 +625,43 @@ C("glu", lambda x: nn.functional.glu(x),
   lambda x: x[..., :3] * _sigmoid(x[..., 3:]), [_arr(225, 4, 6)])
 C("dropout_eval", lambda x: nn.functional.dropout(x, 0.5, training=False),
   lambda x: x, [_arr(226, 3, 4)])
+
+
+# ---- bf16 smoke: the dtype the MXU actually runs ------------------------
+_BF16_OPS = ["exp", "log", "sqrt", "tanh", "sigmoid", "erf", "sin", "cos",
+             "abs", "square", "rsqrt", "log1p"]
+
+
+@pytest.mark.parametrize("name", _BF16_OPS)
+def test_bf16_forward(name):
+    """Key unary ops stay finite and near-f32 in bf16 (TPU hot dtype)."""
+    import jax.numpy as jnp
+
+    x32 = _pos(777, 4, 8, lo=0.3, hi=1.7).astype(np.float32)
+    fn = _P(name) if hasattr(paddle, name) else _F(name)
+    t_bf16 = paddle.to_tensor(jnp.asarray(x32, jnp.bfloat16))
+    t_f32 = paddle.to_tensor(x32)
+    out_bf = np.asarray(fn(t_bf16).numpy(), np.float32)
+    out_f32 = np.asarray(fn(t_f32).numpy(), np.float32)
+    assert np.isfinite(out_bf).all()
+    np.testing.assert_allclose(out_bf, out_f32, rtol=2e-2, atol=2e-2)
+
+
+def test_bf16_matmul_accumulates_f32():
+    """bf16 matmul with preferred f32 accumulation keeps large-K sums
+    accurate (MXU behavior contract)."""
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(5)
+    a = rng.randn(8, 2048).astype(np.float32)
+    b = rng.randn(2048, 8).astype(np.float32)
+    a_bf = jnp.asarray(a, jnp.bfloat16)
+    b_bf = jnp.asarray(b, jnp.bfloat16)
+    got = np.asarray(
+        (paddle.to_tensor(a_bf) @ paddle.to_tensor(b_bf)).numpy(),
+        np.float32)
+    # reference: the SAME rounded inputs accumulated exactly — isolates
+    # accumulation error from the unavoidable bf16 input rounding
+    want = np.asarray(a_bf, np.float64) @ np.asarray(b_bf, np.float64)
+    rel = np.abs(got - want) / (np.abs(want) + 1.0)
+    assert rel.max() < 0.02, rel.max()

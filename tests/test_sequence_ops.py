@@ -109,3 +109,50 @@ class TestMiscOps:
         x = paddle.to_tensor(np.arange(10).reshape(2, 5))
         out = np.asarray(snn.sequence_enumerate(x, 2).numpy())
         assert out.shape[0] == 2
+
+
+class TestReviewRegressions:
+    def test_pool_zero_length_pad_value(self):
+        x = paddle.to_tensor(np.ones((2, 3, 2), np.float32))
+        ln = paddle.to_tensor(np.array([3, 0], np.int64))
+        out = np.asarray(snn.sequence_pool(x, "max", pad_value=-1.0,
+                                           lengths=ln).numpy())
+        assert np.isfinite(out).all()
+        np.testing.assert_allclose(out[1], -1.0)
+
+    def test_crf_start_stop_folded(self):
+        rng = np.random.RandomState(5)
+        emis = paddle.to_tensor(rng.randn(1, 4, 3).astype(np.float32))
+        base = rng.randn(3, 3).astype(np.float32)
+        # huge start weight for tag 2 must force the first tag
+        full = np.concatenate([np.array([[-10, -10, 50]], np.float32),
+                               np.zeros((1, 3), np.float32), base])
+        path = np.asarray(snn.crf_decoding(
+            emis, transition=paddle.to_tensor(full)).numpy())
+        assert path[0, 0] == 2
+
+    def test_multi_box_head_layout(self):
+        paddle.seed(0)
+        fmaps = [paddle.randn([2, 8, 4, 4]), paddle.randn([2, 8, 2, 2])]
+        img = paddle.randn([2, 3, 64, 64])
+        locs, confs, boxes, var = snn.multi_box_head(
+            fmaps, img, base_size=64, num_classes=5,
+            aspect_ratios=[[1.0, 2.0], [1.0, 2.0]],
+            min_sizes=[[16.0], [32.0]], flip=True, steps=[[16], [32]])
+        n_total = boxes.shape[0]
+        assert locs.shape == [2, n_total, 4]
+        assert confs.shape == [2, n_total, 5]
+        assert var.shape == [n_total, 4]
+
+    def test_nce_seeded_reproducible(self):
+        rng = np.random.RandomState(6)
+        h = paddle.to_tensor(rng.randn(4, 8).astype(np.float32))
+        y = paddle.to_tensor(rng.randint(0, 20, (4, 1)))
+        w = paddle.to_tensor(rng.randn(20, 8).astype(np.float32))
+        l1 = np.asarray(snn.nce(h, y, 20, weight=w, seed=7).numpy())
+        l2 = np.asarray(snn.nce(h, y, 20, weight=w, seed=7).numpy())
+        np.testing.assert_array_equal(l1, l2)
+        dist = np.ones(20) / 20
+        l3 = snn.nce(h, y, 20, weight=w, sampler="custom_dist",
+                     custom_dist=dist, seed=7)
+        assert l3.shape == [4, 1]

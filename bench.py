@@ -397,8 +397,14 @@ def _error_payload(msg):
 
 def main():
     details = {}
-    _arm_watchdog(details)
+    # backend init is the observed hang point (jax.devices() can block
+    # forever on a dead tunnel, never raising): give it a short fuse,
+    # then re-arm the long whole-run deadline once a backend exists
+    init_watchdog = _arm_watchdog(details, deadline_s=float(
+        os.environ.get("BENCH_INIT_DEADLINE_S", 600)))
     backend_info, backend_err = _init_backend_with_retry()
+    init_watchdog.cancel()
+    _arm_watchdog(details)
     if backend_info is None:
         _emit(_error_payload(
             f"backend init failed after retries: {backend_err}"))

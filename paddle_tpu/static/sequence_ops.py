@@ -315,8 +315,19 @@ def nce(input, label, num_total_classes, sample_weight=None,
 
     from ..framework import random as rnd
 
-    key = jax.random.PRNGKey(seed) if seed else rnd.next_key()
+    if seed:
+        # seeded STREAM: fresh negatives each call, reproducible across
+        # runs (seed=0 = "use the global stream", the reference op's
+        # convention for its default)
+        counter = _nce_counters.get(seed, 0)
+        _nce_counters[seed] = counter + 1
+        key = jax.random.fold_in(jax.random.PRNGKey(seed), counter)
+    else:
+        key = rnd.next_key()
     return apply(f, input, label, weight, bias, sample_weight, key)
+
+
+_nce_counters = {}
 
 
 def _prior_whs(min_sizes, max_sizes, aspect_ratios, flip, iw, ih):

@@ -16,26 +16,36 @@ __all__ = ["Conll05st", "Imdb", "Imikolov", "Movielens", "UCIHousing",
 def viterbi_decode(potentials, transition_params, lengths=None,
                    include_bos_eos_tag=True, name=None):
     """CRF viterbi decode (reference: python/paddle/text/viterbi_decode.py).
-    potentials: [B, T, N] emission scores."""
+    potentials: [B, T, N] emission scores; lengths masks padded timesteps
+    (scores freeze and backpointers become identity past each sequence
+    end, so padding cannot change the decoded prefix)."""
     import jax
     import jax.numpy as jnp
 
     from ..core.autograd import apply
 
-    def _f(emis, trans):
+    def _f(emis, trans, ln):
         b, t, n = emis.shape
+        ln_ = (jnp.full((b,), t) if ln is None
+               else ln.reshape(-1).astype(jnp.int64))
+        ident = jnp.broadcast_to(jnp.arange(n)[None, :], (b, n))
 
-        def step(carry, e_t):
+        def step(carry, e_ti):
             score, _ = carry
+            e_t, ti = e_ti
             # score: [B, N]; trans: [N, N]
             cand = score[:, :, None] + trans[None]
             best = jnp.max(cand, axis=1) + e_t
             idx = jnp.argmax(cand, axis=1)
+            active = (ti < ln_)[:, None]                 # [B, 1]
+            best = jnp.where(active, best, score)        # freeze past end
+            idx = jnp.where(active, idx, ident)          # identity backptr
             return (best, idx), idx
 
         init = (emis[:, 0], jnp.zeros((b, n), jnp.int64))
         (final, _), backptrs = jax.lax.scan(
-            step, init, jnp.swapaxes(emis[:, 1:], 0, 1))
+            step, init, (jnp.swapaxes(emis[:, 1:], 0, 1),
+                         jnp.arange(1, t)))
         last = jnp.argmax(final, -1)
         score = jnp.max(final, -1)
 
@@ -47,7 +57,7 @@ def viterbi_decode(potentials, transition_params, lengths=None,
         _, path_rev = jax.lax.scan(back, last, backptrs, reverse=True)
         path = jnp.concatenate([path_rev, last[None]], 0)
         return score, jnp.swapaxes(path, 0, 1).astype(jnp.int64)
-    return apply(_f, potentials, transition_params)
+    return apply(_f, potentials, transition_params, lengths)
 
 
 class ViterbiDecoder:

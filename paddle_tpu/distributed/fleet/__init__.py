@@ -45,17 +45,18 @@ def init(role_maker=None, is_collective=True, strategy=None, log_level="INFO"):
     n = jax.device_count()
     mp = int(hc.get("mp_degree", 1))
     pp = int(hc.get("pp_degree", 1))
+    sp = int(hc.get("sp_degree", hc.get("sep_degree", 1)))
     sharding = int(hc.get("sharding_degree", 1))
     dp = int(hc.get("dp_degree", -1))
     if dp in (-1, 0):
-        dp = max(1, n // (mp * pp))
-    used = dp * mp * pp
+        dp = max(1, n // (mp * pp * sp))
+    used = dp * pp * sp * mp
     if used > n:
         raise ValueError(
-            f"hybrid degrees dp={dp} x mp={mp} x pp={pp} = {used} exceed "
-            f"device count {n}")
-    devices = np.array(jax.devices()[:used]).reshape(dp, pp, mp)
-    mesh = Mesh(devices, ("dp", "pp", "tp"))
+            f"hybrid degrees dp={dp} x pp={pp} x sp={sp} x mp={mp} = "
+            f"{used} exceed device count {n}")
+    devices = np.array(jax.devices()[:used]).reshape(dp, pp, sp, mp)
+    mesh = Mesh(devices, ("dp", "pp", "sp", "tp"))
     _env.set_mesh(mesh)
     _fleet_state.update(strategy=strategy, initialized=True,
                         hcg=HybridCommunicateGroup(mesh, sharding))
@@ -86,14 +87,18 @@ class HybridCommunicateGroup:
         # collectives reduce over exactly that axis
         from ..collective import ProcessGroup
 
-        devs = mesh.devices  # ndarray (dp, pp, tp)
+        devs = mesh.devices  # ndarray (dp, pp, sp, tp) or (dp, pp, tp)
+        if devs.ndim == 3:  # meshes installed outside fleet.init
+            devs = devs[:, :, None, :]
         self._groups = {
-            "dp": ProcessGroup(list(devs[:, 0, 0]), axes="dp",
-                               ranks=[d.id for d in devs[:, 0, 0]]),
-            "pp": ProcessGroup(list(devs[0, :, 0]), axes="pp",
-                               ranks=[d.id for d in devs[0, :, 0]]),
-            "tp": ProcessGroup(list(devs[0, 0, :]), axes="tp",
-                               ranks=[d.id for d in devs[0, 0, :]]),
+            "dp": ProcessGroup(list(devs[:, 0, 0, 0]), axes="dp",
+                               ranks=[d.id for d in devs[:, 0, 0, 0]]),
+            "pp": ProcessGroup(list(devs[0, :, 0, 0]), axes="pp",
+                               ranks=[d.id for d in devs[0, :, 0, 0]]),
+            "sp": ProcessGroup(list(devs[0, 0, :, 0]), axes="sp",
+                               ranks=[d.id for d in devs[0, 0, :, 0]]),
+            "tp": ProcessGroup(list(devs[0, 0, 0, :]), axes="tp",
+                               ranks=[d.id for d in devs[0, 0, 0, :]]),
         }
 
     @property
@@ -125,6 +130,9 @@ class HybridCommunicateGroup:
 
     def get_sharding_parallel_world_size(self):
         return self._sharding_degree
+
+    def get_sep_parallel_world_size(self):
+        return dict(self._mesh.shape).get("sp", 1)
 
     def get_data_parallel_group(self):
         return self._groups["dp"]

@@ -58,7 +58,13 @@ def ring_attention(q, k, v, mesh=None, axis=None, causal=False,
     if mesh is None:
         raise RuntimeError("ring_attention needs a mesh with an sp/tp axis")
     ax = axis or _sp_axis(mesh)
-    spec = P(None, None, ax, None)
+    names = mesh.axis_names
+    # keep batch dp-sharded and heads tp-sharded through the ring — a
+    # None spec there would all-gather and redundantly compute per group
+    dp_ax = "dp" if "dp" in names and "dp" != ax else None
+    head_ax = next((a for a in ("tp", "mp") if a in names and a != ax),
+                   None)
+    spec = P(dp_ax, head_ax, ax, None)
 
     def _ring(qv, kv, vv):
         fn = shard_map(

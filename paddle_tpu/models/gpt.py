@@ -72,6 +72,24 @@ class GPTAttention(nn.Layer):
         self.dropout = config.dropout
         self.use_flash = config.use_flash
 
+    @staticmethod
+    def _ring_degree(seq_len):
+        """sp ring size for auto-dispatch, or 1 when the ring cannot be
+        used: seq not divisible by sp, or a pp>1 mesh (the pipeline trunk
+        is already a manual-'pp' shard_map; a nested full-mesh shard_map
+        is rejected — dense attention under GSPMD handles sp there)."""
+        from ..distributed import env as _denv
+
+        mesh = _denv.get_mesh()
+        if mesh is None or "sp" not in mesh.axis_names:
+            return 1
+        sp = int(mesh.shape["sp"])
+        if sp <= 1 or seq_len % sp != 0:
+            return 1
+        if "pp" in mesh.axis_names and int(mesh.shape["pp"]) > 1:
+            return 1
+        return sp
+
     def forward(self, x, cache=None):
         from .. import tensor as T
 
@@ -90,8 +108,16 @@ class GPTAttention(nn.Layer):
             new_cache = None
             causal = True
         drop = self.dropout if self.training else 0.0
-        out, _ = _attention_core(q, k, v, None, drop, is_causal=causal,
-                                 training=self.training)
+        if causal and not drop and self._ring_degree(s) > 1:
+            # long-context: sequence sharded over the 'sp' ring — exact
+            # ring attention rotates k/v over ICI (SURVEY §2 #38); engaged
+            # automatically under a fleet mesh with sp_degree > 1
+            from ..distributed.sequence_parallel import ring_attention
+
+            out = ring_attention(q, k, v, axis="sp", causal=True)
+        else:
+            out, _ = _attention_core(q, k, v, None, drop, is_causal=causal,
+                                     training=self.training)
         out = T.reshape(T.transpose(out, [0, 2, 1, 3]), [b, s, h])
         out = self.out_proj(out)                     # tp row -> psum by XLA
         out = annotate(out, "dp", None, None)
@@ -231,7 +257,7 @@ class GPTModel(nn.Layer):
                 T.unsqueeze(T.arange(past, past + s, dtype="int64"), 0),
                 [b, s])
         x = self.wte(input_ids) + self.wpe(position_ids)
-        x = annotate(x, "dp", None, None)
+        x = annotate(x, "dp", "sp", None)  # sp degrades to None w/o axis
         x = self.drop(x)
         new_caches = [] if caches is not None else None
         if caches is None and isinstance(self.h, PipelineLayer) and \

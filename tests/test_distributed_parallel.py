@@ -197,7 +197,7 @@ def test_fleet_init_builds_hybrid_mesh():
                                "pp_degree": 2, "sharding_degree": 1}
     fleet.init(is_collective=True, strategy=strategy)
     mesh = dist.get_mesh()
-    assert dict(mesh.shape) == {"dp": 2, "pp": 2, "tp": 2}
+    assert dict(mesh.shape) == {"dp": 2, "pp": 2, "sp": 1, "tp": 2}
     hcg = fleet.get_hybrid_communicate_group()
     assert hcg.get_model_parallel_world_size() == 2
     assert hcg.get_data_parallel_world_size() == 2

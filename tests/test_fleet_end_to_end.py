@@ -209,3 +209,74 @@ def test_fleet_deep_pipeline_pp4():
         opt.clear_grad()
         losses.append(float(loss))
     assert all(np.isfinite(losses)) and losses[-1] < losses[0], losses
+
+
+def test_fleet_sequence_parallel_gpt():
+    """sp_degree>1 through the public API: GPT attention rides the ring
+    (exact parity vs the meshless model) and training steps work."""
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 2, "mp_degree": 1,
+                               "pp_degree": 1, "sp_degree": 4}
+    fleet.init(is_collective=True, strategy=strategy)
+    hcg = fleet.get_hybrid_communicate_group()
+    assert hcg.get_sep_parallel_world_size() == 4
+    cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                    num_heads=4, max_position=32, dropout=0.0,
+                    use_flash=False)
+    paddle.seed(17)
+    model_sp = GPTForCausalLM(cfg)
+    rng = np.random.RandomState(17)
+    ids = paddle.to_tensor(rng.randint(0, 64, (4, 16)))
+    labels = paddle.to_tensor(rng.randint(0, 64, (4, 16)))
+    loss_sp = float(model_sp(ids, labels=labels))
+
+    fleet.reset()
+    paddle.seed(17)
+    model_ref = GPTForCausalLM(cfg)
+    loss_ref = float(model_ref(ids, labels=labels))
+    np.testing.assert_allclose(loss_sp, loss_ref, rtol=2e-5)
+
+    # and a training step under the sp mesh
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 2, "mp_degree": 1,
+                               "pp_degree": 1, "sp_degree": 4}
+    fleet.init(is_collective=True, strategy=strategy)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                 parameters=model_sp.parameters())
+    for _ in range(3):
+        loss = model_sp(ids, labels=labels)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    assert float(loss) < loss_sp
+
+
+def test_fleet_sp_edge_cases():
+    """sp ring falls back cleanly: indivisible seq lens and pp>1 meshes
+    run the dense path instead of crashing (round-3 review regression)."""
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 2, "mp_degree": 1,
+                               "pp_degree": 1, "sp_degree": 4}
+    fleet.init(is_collective=True, strategy=strategy)
+    cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                    num_heads=4, max_position=32, dropout=0.0,
+                    use_flash=False)
+    paddle.seed(19)
+    model = GPTForCausalLM(cfg)
+    rng = np.random.RandomState(19)
+    ids = paddle.to_tensor(rng.randint(0, 64, (4, 10)))  # 10 % 4 != 0
+    loss = model(ids, labels=paddle.to_tensor(
+        rng.randint(0, 64, (4, 10))))
+    assert np.isfinite(float(loss))
+
+    fleet.reset()
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 1, "mp_degree": 1,
+                               "pp_degree": 2, "sp_degree": 2}
+    fleet.init(is_collective=True, strategy=strategy)
+    paddle.seed(19)
+    model2 = GPTForCausalLM(cfg)
+    ids2 = paddle.to_tensor(rng.randint(0, 64, (4, 16)))
+    loss2 = model2(ids2, labels=paddle.to_tensor(
+        rng.randint(0, 64, (4, 16))))
+    assert np.isfinite(float(loss2))

@@ -410,10 +410,21 @@ def main():
             f"backend init failed after retries: {backend_err}"))
         return
     details.update(backend_info)
-    for bench in (bench_bert, bench_resnet50, bench_lenet, bench_gpt,
-                  bench_generate, bench_flash_attention, bench_dataloader):
+    small = os.environ.get("BENCH_SMALL", "0").lower() in ("1", "true",
+                                                           "yes")
+    benches = [
+        (bench_bert, {"batch": 2, "seq": 32, "steps": 2, "warmup": 1}),
+        (bench_resnet50, {"batch": 2, "steps": 2, "warmup": 1}),
+        (bench_lenet, {"batch": 8, "steps": 2, "warmup": 1}),
+        (bench_gpt, {"batch": 1, "seq": 32, "steps": 1, "warmup": 1}),
+        (bench_generate, {"batch": 1, "prompt": 4, "new_tokens": 4}),
+        (bench_flash_attention, {"batch": 1, "heads": 2, "seq": 128,
+                                 "iters": 2}),
+        (bench_dataloader, {"n": 32, "batch": 8, "epochs": 1}),
+    ]
+    for bench, small_kw in benches:
         try:
-            details.update(bench())
+            details.update(bench(**small_kw) if small else bench())
         except Exception as e:  # noqa: BLE001
             details[bench.__name__ + "_error"] = str(e)[:300]
 

@@ -665,3 +665,77 @@ def test_bf16_matmul_accumulates_f32():
     want = np.asarray(a_bf, np.float64) @ np.asarray(b_bf, np.float64)
     rel = np.abs(got - want) / (np.abs(want) + 1.0)
     assert rel.max() < 0.02, rel.max()
+
+
+# ---- fft family vs numpy -------------------------------------------------
+_CX = _arr(300, 3, 8) + 1j * _arr(301, 3, 8)
+_RX2 = _arr(302, 3, 8)
+
+
+def _fft_cases():
+    cases = [
+        ("fft", lambda x: paddle.fft.fft(x), np.fft.fft, _CX),
+        ("ifft", lambda x: paddle.fft.ifft(x), np.fft.ifft, _CX),
+        ("rfft", lambda x: paddle.fft.rfft(x), np.fft.rfft, _RX2),
+        ("irfft", lambda x: paddle.fft.irfft(x),
+         lambda x: np.fft.irfft(x), _CX[:, :5]),
+        ("fft2", lambda x: paddle.fft.fft2(x), np.fft.fft2,
+         _arr(303, 4, 4) + 1j * _arr(304, 4, 4)),
+        ("fftshift", lambda x: paddle.fft.fftshift(x), np.fft.fftshift,
+         _RX2),
+        ("ifftshift", lambda x: paddle.fft.ifftshift(x), np.fft.ifftshift,
+         _RX2),
+        ("hfft", lambda x: paddle.fft.hfft(x), np.fft.hfft, _CX[:, :5]),
+        ("fftfreq", lambda: paddle.fft.fftfreq(8, 0.5),
+         lambda: np.fft.fftfreq(8, 0.5), None),
+        ("rfftfreq", lambda: paddle.fft.rfftfreq(8, 0.5),
+         lambda: np.fft.rfftfreq(8, 0.5), None),
+    ]
+    return cases
+
+
+@pytest.mark.parametrize("case", _fft_cases(), ids=lambda c: c[0])
+def test_fft_forward(case):
+    name, fn, ref, inp = case
+    if inp is None:
+        got = np.asarray(fn().numpy())
+        want = ref()
+    else:
+        got = np.asarray(fn(paddle.to_tensor(inp)).numpy())
+        want = ref(inp)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-8,
+                               err_msg=name)
+
+
+# ---- linalg decompositions vs numpy -------------------------------------
+def test_linalg_svd_qr_eigh():
+    rng = np.random.RandomState(310)
+    a = rng.randn(4, 3)
+    u, s, vh = (np.asarray(t.numpy()) for t in
+                paddle.linalg.svd(paddle.to_tensor(a), full_matrices=False))
+    np.testing.assert_allclose(u @ np.diag(s) @ vh, a, atol=1e-8)
+    q, r = (np.asarray(t.numpy()) for t in
+            paddle.linalg.qr(paddle.to_tensor(a)))
+    np.testing.assert_allclose(q @ r, a, atol=1e-8)
+    sym = a.T @ a
+    w, v = (np.asarray(t.numpy()) for t in
+            paddle.linalg.eigh(paddle.to_tensor(sym)))
+    np.testing.assert_allclose(v @ np.diag(w) @ v.T, sym, atol=1e-7)
+
+
+def test_linalg_lstsq_det_slogdet():
+    rng = np.random.RandomState(311)
+    a = rng.randn(5, 3)
+    b = rng.randn(5, 2)
+    sol = paddle.linalg.lstsq(paddle.to_tensor(a), paddle.to_tensor(b))
+    x = np.asarray(sol[0].numpy())
+    np.testing.assert_allclose(x, np.linalg.lstsq(a, b, rcond=None)[0],
+                               atol=1e-7)
+    m = np.eye(3) * 2 + 0.1 * rng.randn(3, 3)
+    det = float(paddle.linalg.det(paddle.to_tensor(m)).numpy())
+    np.testing.assert_allclose(det, np.linalg.det(m), rtol=1e-6)
+    sign, logd = np.linalg.slogdet(m)
+    sarr = np.asarray(
+        paddle.linalg.slogdet(paddle.to_tensor(m)).numpy()).reshape(-1)
+    np.testing.assert_allclose(sarr[0], sign, rtol=1e-6)
+    np.testing.assert_allclose(sarr[1], logd, rtol=1e-6)

@@ -76,6 +76,7 @@ from . import cost_model  # noqa: F401
 from . import dataset  # noqa: F401
 from . import hub  # noqa: F401
 from . import reader  # noqa: F401
+from . import sysconfig  # noqa: F401
 from . import profiler  # noqa: F401
 from . import quantization  # noqa: F401
 from . import signal  # noqa: F401

@@ -1,0 +1,15 @@
+"""paddle.sysconfig (reference: python/paddle/sysconfig.py —
+get_include/get_lib for building custom ops against the install)."""
+from __future__ import annotations
+
+import os
+
+__all__ = ["get_include", "get_lib"]
+
+
+def get_include():
+    return os.path.join(os.path.dirname(__file__), "include")
+
+
+def get_lib():
+    return os.path.join(os.path.dirname(__file__), "libs")

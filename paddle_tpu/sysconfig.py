@@ -1,5 +1,7 @@
 """paddle.sysconfig (reference: python/paddle/sysconfig.py —
-get_include/get_lib for building custom ops against the install)."""
+get_include/get_lib for building custom ops against the install).
+The include dir carries the csrc headers; shared objects are built into
+the cpp_extension cache (libs/ anchors reference-style -L flags)."""
 from __future__ import annotations
 
 import os

@@ -1,0 +1,36 @@
+// C API of the pinned-host staging ring (csrc/staging_pool.cpp) — the
+// input-pipeline buffer pool paddle_tpu's DataLoader uses to overlap
+// host collate with device transfer. Link against the cpp_extension-built
+// shared object; see paddle_tpu/utils/cpp_extension.py for the loader.
+#pragma once
+
+#include <stddef.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+// Create a ring of n_slots aligned host buffers of slot_bytes each.
+// Returns an opaque pool handle, or NULL on invalid arguments.
+void* sp_create(int n_slots, size_t slot_bytes);
+void sp_destroy(void* pool);
+
+size_t sp_slot_bytes(void* pool);
+int sp_num_slots(void* pool);
+
+// Producer side: acquire a writable slot (-1 on timeout), fill it with
+// sp_copy_in (GIL-free parallel memcpy) at byte offsets, then commit.
+int sp_acquire_write(void* pool, int timeout_ms);
+void* sp_slot_ptr(void* pool, int slot);
+int sp_copy_in(void* pool, int slot, size_t offset, const void* src,
+               size_t nbytes);
+void sp_commit(void* pool, int slot);
+
+// Consumer side: acquire the oldest committed slot (-1 on timeout),
+// read through sp_slot_ptr, then release it back to the ring.
+int sp_acquire_read(void* pool, int timeout_ms);
+void sp_release(void* pool, int slot);
+
+#ifdef __cplusplus
+}  // extern "C"
+#endif

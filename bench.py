@@ -4,34 +4,45 @@ Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...details}
 
 Headline metric: BERT-base MLM tokens/sec/chip (AMP O2 bf16, whole-step
-jit with donated buffers). Details carry ResNet50 static-Executor
-imgs/sec, LeNet Model.fit imgs/sec, and the flash-attention A/B.
-vs_baseline is the ratio against BASELINE.json's published numbers when
-present (1.0 otherwise — round 1 published none).
+jit with donated buffers); falls back to ResNet50 imgs/sec then LeNet
+imgs/sec if the headline config never produced a number.
+
+Process architecture (the round-3 failure was `jax.default_backend()`
+HANGING — not raising — on a wedged axon tunnel, so no in-process retry
+or watchdog could save the run):
+  * the ORCHESTRATOR (plain `python bench.py`) never imports jax at all;
+  * backend init is probed in a SUBPROCESS with a kill-timeout and
+    retried across fresh processes (a hung PJRT client dies with its
+    process — nothing in-process can unwedge it);
+  * each bench config runs in its OWN subprocess with a per-config
+    deadline, cheapest-first, so one hang costs one config, not the run;
+  * a config that times out at full size is retried once at small size;
+  * the orchestrator exits NONZERO when no headline number was measured,
+    so a failed bench is failure-shaped to the driver.
+
+Child modes: `bench.py --probe --out F` / `bench.py --config NAME --out F
+[--small]` write their JSON dict to F (stdout is full of jax warnings and
+not parseable).
 """
 from __future__ import annotations
 
+import argparse
 import json
 import os
+import subprocess
+import sys
 import time
 
-import numpy as np
+REPO = os.path.dirname(os.path.abspath(__file__)) or "."
 
-import jax  # noqa: E402
-
-# persistent XLA compile cache: BERT-base/ResNet50 compiles are minutes on
-# the tunneled chip; cache them across bench runs/rounds. sitecustomize
-# imports jax before this module, so the env var would be ignored — set it
-# through the live config instead.
-jax.config.update("jax_compilation_cache_dir",
-                  os.path.join(os.path.dirname(__file__) or ".",
-                               ".jax_cache"))
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+import numpy as np  # stdlib-adjacent; safe in the orchestrator
 
 
 def _sync(x):
     """Force materialization: np.asarray round-trips through the host, the
     only sync the axon tunnel honors (block_until_ready returns early)."""
+    import jax
+
     return np.asarray(jax.tree_util.tree_leaves(x)[0])
 
 
@@ -49,6 +60,8 @@ _PEAK_BF16 = [
 def _chip_peak_flops():
     """Peak bf16 FLOP/s of the attached chip, or None when the device kind
     is not a known TPU (an 'MFU' against a guessed peak is noise)."""
+    import jax
+
     try:
         kind = jax.devices()[0].device_kind.lower()
     except Exception:  # noqa: BLE001
@@ -59,31 +72,9 @@ def _chip_peak_flops():
     return 197e12 if "tpu" in kind else None  # v5e = BASELINE north star
 
 
-def _init_backend_with_retry(attempts=3, backoff_s=30.0):
-    """Round 2 died because one tunnel flake at jax.default_backend()
-    crashed the whole bench (BENCH_r02 rc=1). Retry backend init with
-    backoff; on final failure return an error string instead of raising so
-    main() still prints its one JSON line."""
-    last = None
-    for i in range(attempts):
-        try:
-            return {"backend": jax.default_backend(),
-                    "device_count": jax.device_count(),
-                    "device_kind": jax.devices()[0].device_kind}, None
-        except Exception as e:  # noqa: BLE001
-            last = str(e)[:300]
-            if i + 1 < attempts:
-                time.sleep(backoff_s * (i + 1))
-                try:
-                    # jax caches backend-init FAILURE too; without this the
-                    # retry would re-raise the cached error instantly
-                    import jax.extend.backend
-
-                    jax.extend.backend.clear_backends()
-                except Exception:  # noqa: BLE001
-                    pass
-    return None, last
-
+# --------------------------------------------------------------------------
+# bench configs (run in child processes only — all jax imports are local)
+# --------------------------------------------------------------------------
 
 def bench_bert(batch=16, seq=128, steps=30, warmup=5):
     """BERT-base MLM, AMP O2 (bf16 weights, f32 norms), fused jitted step."""
@@ -112,7 +103,7 @@ def bench_bert(batch=16, seq=128, steps=30, warmup=5):
         def loss_of(p):
             # tape off: jax.value_and_grad is the single AD level (the
             # eager tape nesting inside it would second-differentiate the
-            # Pallas custom_vjp forward — same pattern as hapi/model.py:64)
+            # Pallas custom_vjp forward — same pattern as hapi/model.py)
             with paddle.no_grad():
                 out, _ = model.functional_call(
                     {k: Tensor(v) for k, v in p.items()},
@@ -286,8 +277,12 @@ def bench_lenet(batch=256, steps=30, warmup=3):
     return {"lenet_imgs_per_sec": steps * batch / dt}
 
 
-def bench_generate(batch=8, prompt=32, new_tokens=96):
-    """Jitted static-shape decode throughput (GPT-2 small, greedy)."""
+def bench_generate(batch=8, prompt=32, new_tokens=96, eager_tokens=8):
+    """Jitted static-KV decode throughput (GPT-2 small, greedy) vs a naive
+    eager re-forward decode — the A/B that justifies the prefill/decode
+    executables (models/gpt.py)."""
+    import jax.numpy as jnp
+
     import paddle_tpu as paddle
     from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
 
@@ -304,11 +299,33 @@ def bench_generate(batch=8, prompt=32, new_tokens=96):
     out = model.generate(ids, max_new_tokens=new_tokens)
     _sync(out._value)
     dt = time.perf_counter() - t0
-    return {"decode_tokens_per_sec": batch * new_tokens / dt,
-            "decode_ms_per_token": dt / new_tokens * 1e3}
+    res = {"decode_tokens_per_sec": batch * new_tokens / dt,
+           "decode_ms_per_token": dt / new_tokens * 1e3}
+
+    # eager baseline: full re-forward per token, no KV cache, argmax on
+    # host — what generate() would cost without the static-KV design.
+    # Kept to a few tokens; per-token cost is flat enough to compare.
+    try:
+        cur = ids
+        with paddle.no_grad():
+            logits = model(cur)  # warm the [batch, prompt] forward
+            _sync(logits._value)
+            t0 = time.perf_counter()
+            for _ in range(eager_tokens):
+                logits = model(cur)
+                nxt = jnp.argmax(logits._value[:, -1, :], axis=-1)
+                cur = paddle.concat(
+                    [cur, paddle.to_tensor(np.asarray(nxt))[:, None]],
+                    axis=1)
+            _sync(cur._value)
+        res["decode_eager_ms_per_token"] = (
+            (time.perf_counter() - t0) / eager_tokens * 1e3)
+    except Exception as e:  # noqa: BLE001 — the A/B arm must not kill decode
+        res["decode_eager_error"] = str(e)[:200]
+    return res
 
 
-def bench_flash_attention(batch=4, heads=12, seq=512, dim=64, iters=50):
+def bench_flash_attention(batch=4, heads=12, seq=1024, dim=64, iters=50):
     """Pallas flash attention vs XLA softmax attention, fwd+bwd."""
     import jax
     import jax.numpy as jnp
@@ -342,6 +359,44 @@ def bench_flash_attention(batch=4, heads=12, seq=512, dim=64, iters=50):
         except Exception as e:  # noqa: BLE001
             res[f"attn_{name}_ms"] = None
             res[f"attn_{name}_error"] = str(e)[:200]
+    return res
+
+
+def bench_blockwise_ce(n=4096, hidden=768, vocab=50304, iters=20):
+    """Blockwise fused LM-head CE vs materialized-logits CE, fwd+bwd —
+    the HBM-bandwidth lever behind ops/blockwise_ce.py."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.ops.blockwise_ce import blockwise_softmax_ce
+
+    rng = np.random.RandomState(0)
+    h = jnp.asarray(rng.randn(n, hidden).astype(np.float32) * 0.02)
+    w = jnp.asarray(rng.randn(vocab, hidden).astype(np.float32) * 0.02)
+    y = jnp.asarray(rng.randint(0, vocab, n))
+
+    def naive(h, w):
+        logits = h @ w.T
+        logz = jax.scipy.special.logsumexp(logits, axis=-1)
+        return (logz - jnp.take_along_axis(logits, y[:, None],
+                                           axis=-1)[:, 0]).mean()
+
+    def fused(h, w):
+        return blockwise_softmax_ce(h, w, y)
+
+    res = {}
+    for name, fn in [("naive", naive), ("blockwise", fused)]:
+        try:
+            g = jax.jit(jax.grad(fn, argnums=(0, 1)))
+            _sync(g(h, w))
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                out = g(h, w)
+            _sync(out)
+            res[f"ce_{name}_ms"] = (time.perf_counter() - t0) / iters * 1e3
+        except Exception as e:  # noqa: BLE001
+            res[f"ce_{name}_ms"] = None
+            res[f"ce_{name}_error"] = str(e)[:200]
     return res
 
 
@@ -380,13 +435,137 @@ def bench_dataloader(n=512, batch=64, shape=(3, 224, 224), epochs=3):
     return res
 
 
+# name -> (fn, small_kwargs, full_deadline_s). Order is the RUN order:
+# cheapest-first so a mid-run hang still leaves measured configs behind
+# (round-3 verdict: BERT-first meant a single hang starved everything).
+CONFIGS = {
+    "lenet": (bench_lenet, {"batch": 8, "steps": 2, "warmup": 1}, 420),
+    "flash_attention": (bench_flash_attention,
+                        {"batch": 1, "heads": 2, "seq": 128, "iters": 2},
+                        600),
+    "blockwise_ce": (bench_blockwise_ce,
+                     {"n": 64, "hidden": 32, "vocab": 512, "iters": 2}, 480),
+    "dataloader": (bench_dataloader, {"n": 32, "batch": 8, "epochs": 1}, 420),
+    "resnet50": (bench_resnet50, {"batch": 2, "steps": 2, "warmup": 1}, 900),
+    "bert": (bench_bert, {"batch": 2, "seq": 32, "steps": 2, "warmup": 1},
+             900),
+    "gpt": (bench_gpt, {"batch": 1, "seq": 32, "steps": 1, "warmup": 1},
+            900),
+    "generate": (bench_generate,
+                 {"batch": 1, "prompt": 4, "new_tokens": 4,
+                  "eager_tokens": 2}, 600),
+}
+
 _HEADLINE_CANDIDATES = [
-    ("bert_tokens_per_sec",
+    ("bert", "bert_tokens_per_sec",
      "BERT-base MLM tokens/sec/chip (AMP O2 bf16)", "tokens/sec"),
-    ("resnet50_imgs_per_sec",
+    ("resnet50", "resnet50_imgs_per_sec",
      "ResNet50 train imgs/sec/chip (static Executor, fp32)", "imgs/sec"),
-    ("lenet_imgs_per_sec", "LeNet Model.fit imgs/sec/chip", "imgs/sec"),
+    ("lenet", "lenet_imgs_per_sec", "LeNet Model.fit imgs/sec/chip",
+     "imgs/sec"),
 ]
+
+
+# --------------------------------------------------------------------------
+# child entry points
+# --------------------------------------------------------------------------
+
+def _child_setup_jax():
+    """Compile-cache + platform config for a child process. Must run via
+    jax.config.update, not env vars: the image's sitecustomize calls
+    axon.register() at interpreter start, which force-sets
+    jax_platforms="axon,cpu" (axon/register/ifrt.py), overriding
+    JAX_PLATFORMS from the environment. BENCH_FORCE_CPU exists so the
+    whole bench pipeline can be smoke-tested without a TPU."""
+    import jax
+
+    if os.environ.get("BENCH_FORCE_CPU") == "1":
+        jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_compilation_cache_dir",
+                      os.path.join(REPO, ".jax_cache"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+
+def _write_out(out_path, payload):
+    tmp = out_path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(payload, f)
+    os.replace(tmp, out_path)
+
+
+def _run_probe(out_path):
+    """Backend liveness: init PJRT AND run a real op — jax.devices() can
+    succeed while the first execution hangs; only a round-tripped matmul
+    proves the tunnel works."""
+    _child_setup_jax()
+    import jax
+    import jax.numpy as jnp
+
+    info = {"backend": jax.default_backend(),
+            "device_count": jax.device_count(),
+            "device_kind": jax.devices()[0].device_kind}
+    x = jnp.ones((256, 256), jnp.bfloat16)
+    info["probe_matmul"] = float(np.asarray((x @ x).sum(), dtype=np.float32))
+    _write_out(out_path, info)
+
+
+def _run_config(name, out_path, small):
+    _child_setup_jax()
+    fn, small_kw, _ = CONFIGS[name]
+    res = fn(**small_kw) if small else fn()
+    _write_out(out_path, res)
+
+
+# --------------------------------------------------------------------------
+# orchestrator (never imports jax)
+# --------------------------------------------------------------------------
+
+def _spawn(args, timeout_s, out_path):
+    """Run a child bench process; return (dict-or-None, error-or-None)."""
+    if os.path.exists(out_path):
+        os.remove(out_path)
+    env = dict(os.environ)
+    env.setdefault("JAX_COMPILATION_CACHE_DIR",
+                   os.path.join(REPO, ".jax_cache"))
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)] + args,
+            timeout=timeout_s, env=env, cwd=REPO,
+            stdout=subprocess.DEVNULL, stderr=subprocess.PIPE)
+        err = None if proc.returncode == 0 else (
+            f"rc={proc.returncode}: "
+            + proc.stderr.decode("utf-8", "replace")[-400:])
+    except subprocess.TimeoutExpired:
+        err = f"timeout after {timeout_s:.0f}s (killed)"
+    try:
+        with open(out_path) as f:
+            return json.load(f), err
+    except (OSError, ValueError):
+        return None, err or "child wrote no output"
+
+
+def _probe_backend(details):
+    """Fresh-process backend probes with kill-timeouts. A hang (the r02/r03
+    killer: make_c_api_client blocking forever on the axon relay) dies
+    with its subprocess; each retry gets a brand-new PJRT client. The
+    schedule escalates — two quick probes catch a transient flake, the
+    long final ones cover a relay that takes minutes to grant a chip."""
+    sched = os.environ.get("BENCH_PROBE_TIMEOUTS_S", "120,180,420,600")
+    timeouts = [float(x) for x in sched.split(",") if x.strip()]
+    last = None
+    for i, timeout_s in enumerate(timeouts):
+        out = os.path.join(REPO, f".bench_probe_{i}.json")
+        info, err = _spawn(["--probe", "--out", out], timeout_s, out)
+        if info is not None:
+            details.update(info)
+            details["probe_attempts"] = i + 1
+            return True
+        last = err
+        if i + 1 < len(timeouts):
+            time.sleep(15.0)
+    details["probe_attempts"] = len(timeouts)
+    details["probe_error"] = (last or "unknown")[:300]
+    return False
 
 
 def _error_payload(msg):
@@ -395,65 +574,31 @@ def _error_payload(msg):
             "error": msg[:300]}
 
 
-def main():
-    details = {}
-    # backend init is the observed hang point (jax.devices() can block
-    # forever on a dead tunnel, never raising): give it a short fuse,
-    # then re-arm the long whole-run deadline once a backend exists
-    init_watchdog = _arm_watchdog(details, deadline_s=float(
-        os.environ.get("BENCH_INIT_DEADLINE_S", 600)))
-    backend_info, backend_err = _init_backend_with_retry()
-    init_watchdog.cancel()
-    _arm_watchdog(details)
-    if backend_info is None:
-        _emit(_error_payload(
-            f"backend init failed after retries: {backend_err}"))
-        return
-    details.update(backend_info)
-    small = os.environ.get("BENCH_SMALL", "0").lower() in ("1", "true",
-                                                           "yes")
-    benches = [
-        (bench_bert, {"batch": 2, "seq": 32, "steps": 2, "warmup": 1}),
-        (bench_resnet50, {"batch": 2, "steps": 2, "warmup": 1}),
-        (bench_lenet, {"batch": 8, "steps": 2, "warmup": 1}),
-        (bench_gpt, {"batch": 1, "seq": 32, "steps": 1, "warmup": 1}),
-        (bench_generate, {"batch": 1, "prompt": 4, "new_tokens": 4}),
-        (bench_flash_attention, {"batch": 1, "heads": 2, "seq": 128,
-                                 "iters": 2}),
-        (bench_dataloader, {"n": 32, "batch": 8, "epochs": 1}),
-    ]
-    for bench, small_kw in benches:
-        try:
-            details.update(bench(**small_kw) if small else bench())
-        except Exception as e:  # noqa: BLE001
-            details[bench.__name__ + "_error"] = str(e)[:300]
+def _emit(payload):
+    print(json.dumps(payload), flush=True)
 
-    # headline = BERT; fall back to the next real number on tunnel flakes.
-    # If nothing measured, keep the documented BERT label with value null.
-    candidates = _HEADLINE_CANDIDATES
-    ref_key, metric, unit = candidates[0]
-    value = None
-    for key, m, u in candidates:
-        if details.get(key):
-            ref_key, metric, unit = key, m, u
-            value = details[key]
-            break
+
+def _publish_baseline(details, cfg_name, ref_key, value):
+    """First full real-chip run publishes its numbers as the baseline so
+    later rounds report a real vs_baseline ratio. Small-size numbers are
+    never published and never compared against a full-size baseline —
+    either direction poisons the ratio permanently."""
+    any_small = any(k.endswith("_small") and v for k, v in details.items())
+    headline_small = bool(details.get(cfg_name + "_small"))
     baseline = 1.0
-    baseline_path = os.path.join(os.path.dirname(__file__) or ".",
-                                 "BASELINE.json")
+    baseline_path = os.path.join(REPO, "BASELINE.json")
     try:
         with open(baseline_path) as f:
             baseline_doc = json.load(f)
         published = baseline_doc.get("published", {})
         ref = published.get(ref_key)
         if value and ref:
-            baseline = value / ref
-        elif (value and not published and details.get("backend") == "tpu"
+            baseline = value / ref if not headline_small else None
+        elif (value and not published and not any_small
+              and os.environ.get("BENCH_SMALL", "0").lower() not in
+              ("1", "true", "yes")
+              and str(details.get("backend", "")).lower() in ("tpu", "axon")
               and details.get("bert_tokens_per_sec")):
-            # first real-chip run WITH the headline metric: publish the
-            # measured numbers so later rounds report a real vs_baseline
-            # ratio (a partial run must not lock in a baseline missing
-            # the headline — vs_baseline would then read 1.0 forever)
             pub = {k: round(v, 2) for k, v in details.items()
                    if isinstance(v, float) and (
                        k.endswith("_per_sec") or k.endswith("_ms")
@@ -464,55 +609,95 @@ def main():
                 json.dump(baseline_doc, f, indent=2)
     except (OSError, ValueError):
         pass
+    return baseline
+
+
+def main():
+    t_start = time.monotonic()
+    budget_s = float(os.environ.get("BENCH_DEADLINE_S", 3300))
+
+    def remaining():
+        return budget_s - (time.monotonic() - t_start)
+
+    details = {}
+    if not _probe_backend(details):
+        _emit(_error_payload(
+            "backend init failed after "
+            f"{details.get('probe_attempts')} fresh-process probes: "
+            f"{details.get('probe_error')}"))
+        raise SystemExit(1)
+
+    small_all = os.environ.get("BENCH_SMALL", "0").lower() in ("1", "true",
+                                                               "yes")
+    for name, (fn, small_kw, deadline) in CONFIGS.items():
+        # keep a reserve so later (cheaper-per-second headline fallback)
+        # configs aren't starved by one expensive config overrunning
+        budget = min(deadline, max(0.0, remaining() - 90.0))
+        if budget < 60.0:
+            details[name + "_skipped"] = "out of time budget"
+            continue
+        out = os.path.join(REPO, f".bench_{name}.json")
+        args = ["--config", name, "--out", out]
+        res, err = _spawn(args + (["--small"] if small_all else []),
+                          budget, out)
+        if res is None and not small_all:
+            # full size hung or crashed: one retry at small size so the
+            # config still contributes a measured (if modest) number
+            details[name + "_full_error"] = (err or "")[:300]
+            budget = min(deadline / 2, max(0.0, remaining() - 60.0))
+            if budget >= 60.0:
+                res, err = _spawn(args + ["--small"], budget, out)
+                if res is not None:
+                    res["%s_small" % name] = True
+        if res is not None:
+            details.update(res)
+        else:
+            details[name + "_error"] = (err or "unknown")[:300]
+
+    # headline = BERT; fall back to the next real number on tunnel flakes.
+    # If nothing measured, keep the documented BERT label with value null.
+    # A number from a small-size retry is reported but LABELED as such so
+    # no cross-round comparison mistakes it for the full config.
+    cfg_name, ref_key, metric, unit = _HEADLINE_CANDIDATES[0]
+    value = None
+    for cn, key, m, u in _HEADLINE_CANDIDATES:
+        if details.get(key):
+            cfg_name, ref_key, metric, unit = cn, key, m, u
+            value = details[key]
+            break
+    if value and (details.get(cfg_name + "_small") or small_all):
+        metric += " [small-config fallback]"
+    baseline = _publish_baseline(details, cfg_name, ref_key, value)
 
     _emit({
         "metric": metric,
         "value": round(value, 1) if value else None,
         "unit": unit,
-        "vs_baseline": round(baseline, 3),
+        "vs_baseline": round(baseline, 3) if (value and baseline is not None)
+        else None,
         **{k: (round(v, 4) if isinstance(v, float) else v)
            for k, v in details.items()},
     })
-
-
-def _emit(payload):
-    print(json.dumps(payload), flush=True)
-
-
-def _arm_watchdog(details, deadline_s=None):
-    """A tunnel hang mid-bench (device sync blocking forever) would leave
-    the driver with NO JSON line; after the deadline, emit whatever was
-    measured and hard-exit. Hard-exit is required: a wedged device thread
-    ignores normal interpreter shutdown."""
-    import threading
-
-    if deadline_s is None:
-        deadline_s = float(os.environ.get("BENCH_DEADLINE_S", 2400))
-
-    def fire():
-        snap = dict(details)  # main thread may still be mutating
-        payload = _error_payload(
-            f"watchdog: bench exceeded {deadline_s:.0f}s (device hang?); "
-            "emitting partial results")
-        payload.update({k: (round(v, 4) if isinstance(v, float) else v)
-                        for k, v in snap.items()})
-        for key, metric, unit in _HEADLINE_CANDIDATES:
-            if snap.get(key):
-                payload.update(metric=metric, unit=unit,
-                               value=round(snap[key], 1))
-                break
-        _emit(payload)
-        os._exit(0)
-
-    t = threading.Timer(deadline_s, fire)
-    t.daemon = True
-    t.start()
-    return t
+    if value is None:
+        raise SystemExit(1)  # a numberless bench must look like failure
 
 
 if __name__ == "__main__":
-    try:
-        main()
-    except BaseException as e:  # noqa: BLE001 — the JSON line must ALWAYS print
-        _emit(_error_payload(f"{type(e).__name__}: {e}"))
-        raise SystemExit(0)
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--probe", action="store_true")
+    ap.add_argument("--config", choices=list(CONFIGS))
+    ap.add_argument("--out")
+    ap.add_argument("--small", action="store_true")
+    cli = ap.parse_args()
+    if cli.probe:
+        _run_probe(cli.out)
+    elif cli.config:
+        _run_config(cli.config, cli.out, cli.small)
+    else:
+        try:
+            main()
+        except SystemExit:
+            raise
+        except BaseException as e:  # noqa: BLE001 — the JSON line must print
+            _emit(_error_payload(f"{type(e).__name__}: {e}"))
+            raise SystemExit(1)

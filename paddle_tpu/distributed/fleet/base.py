@@ -34,7 +34,12 @@ class DistributedStrategy:
         self.tensor_parallel = False
         self.tensor_parallel_configs = {"tensor_parallel_degree": 1}
         self.lamb = False
+        self.lamb_configs = {"lamb_weight_decay": 0.01,
+                             "exclude_from_weight_decay": []}
         self.lars = False
+        self.lars_configs = {"lars_coeff": 0.001,
+                             "lars_weight_decay": 0.0005, "epsilon": 0.0,
+                             "exclude_from_weight_decay": []}
         self.dgc = False
         self.localsgd = False
         self.fp16_allreduce = False

@@ -226,11 +226,25 @@ class GPTModel(nn.Layer):
         from ..distributed.pipeline import PipelineLayer
 
         S = self.h.num_stages if isinstance(self.h, PipelineLayer) else 1
-        if self.config.pp_num_micro:
-            m = self.config.pp_num_micro
+        m = self.config.pp_num_micro
+        if not m:
+            # fleet strategy.pipeline_configs: accumulate_steps IS the
+            # microbatch count in a GPipe schedule (reference pipeline
+            # meta-optimizer splits the batch into accumulate_steps
+            # micro-steps and merges grads)
+            from ..distributed import fleet as _fleet
+
+            strategy = _fleet.get_strategy()
+            if strategy is not None and strategy.pipeline:
+                # the shipped default accumulate_steps=1 means "unset":
+                # honoring it literally would silently disable pipelining
+                acc = int(strategy.pipeline_configs.get(
+                    "accumulate_steps", 0))
+                m = acc if acc > 1 else None
+        if m:
             if batch % m != 0:
                 raise ValueError(
-                    f"pp_num_micro ({m}) must divide the batch size "
+                    f"microbatch count ({m}) must divide the batch size "
                     f"({batch})")
             return m
         for m in range(min(batch, 2 * S), 0, -1):

@@ -13,7 +13,7 @@ from .lr import LRScheduler  # noqa: F401
 from .optimizer import Optimizer
 
 __all__ = ["Optimizer", "SGD", "Momentum", "Adagrad", "Adadelta", "RMSProp",
-           "Adam", "AdamW", "Adamax", "Lamb", "LBFGS", "lr"]
+           "Adam", "AdamW", "Adamax", "Lamb", "Lars", "LBFGS", "lr"]
 
 
 class SGD(Optimizer):
@@ -221,8 +221,15 @@ class Lamb(Optimizer):
                 "beta1_pow": jnp.ones((), jnp.float32),
                 "beta2_pow": jnp.ones((), jnp.float32)}
 
+    def _param_static(self, p):
+        if self._exclude_fn is None:
+            return None
+        return {"decay_on": not self._exclude_fn(getattr(p, "name", "")
+                                                 or "")}
+
     def _update_rule(self, v, g, s, lr, m, static=None):
-        b1, b2, eps, wd = self._beta1, self._beta2, self._epsilon, self._lamb_wd
+        b1, b2, eps = self._beta1, self._beta2, self._epsilon
+        wd = self._lamb_wd if (static or {}).get("decay_on", True) else 0.0
         b1p = s["beta1_pow"] * b1
         b2p = s["beta2_pow"] * b2
         m1 = b1 * s["moment1"] + (1 - b1) * g
@@ -236,6 +243,46 @@ class Lamb(Optimizer):
         new_v = v - (lr * m * ratio).astype(v.dtype) * r
         return new_v, {"moment1": m1, "moment2": m2, "beta1_pow": b1p,
                        "beta2_pow": b2p}
+
+
+class Lars(Optimizer):
+    """LARS momentum (reference: incubate LarsMomentumOptimizer /
+    fleet meta_optimizers/lars_optimizer.py): layer-wise adaptive rate —
+    local_lr = lr * coeff * ||w|| / (||g|| + wd * ||w|| + eps), then a
+    plain momentum update on (g + wd * w)."""
+
+    def __init__(self, learning_rate=0.001, momentum=0.9, lars_coeff=0.001,
+                 lars_weight_decay=0.0005, parameters=None, grad_clip=None,
+                 epsilon=0.0, exclude_from_weight_decay=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, name)
+        self._momentum = momentum
+        self._coeff = lars_coeff
+        self._lars_wd = lars_weight_decay
+        self._eps = epsilon
+        self._exclude = list(exclude_from_weight_decay or [])
+
+    def _init_state(self, p):
+        return {"velocity": jnp.zeros_like(p._value)}
+
+    def _param_static(self, p):
+        # excluded params (by name substring) keep the adaptive ratio but
+        # drop weight decay — the reference kernel always applies the
+        # ratio and only zeroes _lars_weight_decay for excluded params
+        name = getattr(p, "name", "") or ""
+        excluded = any(tok in name for tok in self._exclude)
+        return {"decay_on": not excluded}
+
+    def _update_rule(self, v, g, s, lr, m, static=None):
+        wd = self._lars_wd if (static or {}).get("decay_on", True) else 0.0
+        w_norm = jnp.sqrt(jnp.sum(v.astype(jnp.float32) ** 2))
+        g_norm = jnp.sqrt(jnp.sum(g.astype(jnp.float32) ** 2))
+        ratio = jnp.where(
+            (w_norm > 0) & (g_norm > 0),
+            self._coeff * w_norm / (g_norm + wd * w_norm + self._eps),
+            1.0)
+        vel = self._momentum * s["velocity"] + (lr * m * ratio) * (g + wd * v)
+        return v - vel.astype(v.dtype), {"velocity": vel}
 
 
 class LBFGS(Optimizer):

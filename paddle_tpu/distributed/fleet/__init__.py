@@ -304,8 +304,20 @@ class _DistributedOptimizer:
         if self._gm_count < self._k_steps:
             return
         scale = 1.0 / self._k_steps if self._gm_avg else 1.0
-        for p in params:
-            p._grad._value = self._gm_acc[id(p)] * scale
+        # apply over EVERYTHING accumulated across the window, not just
+        # params that happen to have a grad on the boundary micro-step
+        # (conditionally-used branches/experts would lose their window)
+        from ...core.tensor import Tensor as _T
+
+        for p in self._inner._param_list:
+            acc = self._gm_acc.get(id(p))
+            if acc is None:
+                continue
+            merged = acc * scale
+            if p._grad is None:
+                p._grad = _T(merged)
+            else:
+                p._grad._value = merged
         self._inner.step()
         self._gm_acc.clear()
         self._gm_count = 0

@@ -30,7 +30,7 @@ class GPTConfig:
                  num_heads=12, intermediate_size=None, max_position=1024,
                  dropout=0.1, layer_norm_eps=1e-5, initializer_range=0.02,
                  use_flash=True, pp_num_micro=None, pp_recompute=False,
-                 fused_loss=None):
+                 pp_num_virtual=None, fused_loss=None):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
         self.num_layers = num_layers
@@ -46,6 +46,7 @@ class GPTConfig:
         # rematerialization (jax.checkpoint) to trade FLOPs for HBM
         self.pp_num_micro = pp_num_micro
         self.pp_recompute = pp_recompute
+        self.pp_num_virtual = pp_num_virtual  # interleaved virtual stages
         # blockwise fused softmax-CE over the tied head (never materializes
         # [B*S, V] logits); auto-on for big vocabs where that buffer is the
         # HBM peak (None -> vocab >= 16384)
@@ -191,16 +192,19 @@ class GPTModel(nn.Layer):
         # same trunk segmentation around its p2p scheduler.
         pp = self._pp_degree()
         if pp > 1:
-            if c.num_layers % pp != 0:
+            vp = int(c.pp_num_virtual or 1)
+            if c.num_layers % (pp * vp) != 0:
                 raise ValueError(
-                    f"num_layers ({c.num_layers}) must be divisible by the "
-                    f"pipeline degree ({pp}) for homogeneous stages")
+                    f"num_layers ({c.num_layers}) must be divisible by "
+                    f"pp_degree x pp_num_virtual ({pp} x {vp}) for "
+                    "homogeneous chunks")
             from ..distributed.pipeline import LayerDesc, PipelineLayer
 
             self.h = PipelineLayer(
                 layers=[LayerDesc(GPTBlock, c) for _ in range(c.num_layers)],
                 num_stages=pp,
-                recompute_interval=1 if c.pp_recompute else 0)
+                recompute_interval=1 if c.pp_recompute else 0,
+                num_virtual_pipeline_stages=vp)
         else:
             self.h = nn.LayerList([GPTBlock(c) for _ in range(c.num_layers)])
         self.ln_f = nn.LayerNorm(c.hidden_size, c.layer_norm_eps)

@@ -399,3 +399,30 @@ def test_distributed_optimizer_minimize_contract():
     out, params_grads = opt.minimize(loss)
     assert out is None
     assert len(params_grads) == len(list(model.parameters()))
+
+
+def test_gradient_merge_keeps_accumulation_for_gradless_boundary_param():
+    """A param that received grads mid-window but has none on the boundary
+    micro-step must still get its merged update (conditional branches)."""
+    s = _strategy(gradient_merge=True)
+    s.gradient_merge_configs = {"k_steps": 2, "avg": True}
+    fleet.init(strategy=s)
+    paddle.seed(0)
+    model = _mlp()
+    opt = fleet.distributed_optimizer(
+        paddle.optimizer.SGD(learning_rate=0.1,
+                             parameters=model.parameters()))
+    x = paddle.randn([8, 16])
+    loss = (model(x) ** 2).mean()
+    loss.backward()
+    g1 = np.asarray(model[0].weight.grad.numpy()).copy()
+    w0 = np.asarray(model[0].weight.numpy()).copy()
+    opt.step()  # accumulate 1/2
+    opt.clear_grad()
+    loss = (model(x) ** 2).mean()
+    loss.backward()
+    model[0].weight._grad = None  # boundary step: this param has no grad
+    opt.step()  # boundary: must still apply the window's accumulation
+    w2 = np.asarray(model[0].weight.numpy())
+    # one contribution averaged over k=2 -> w2 = w0 - 0.1 * g1/2
+    np.testing.assert_allclose(w2, w0 - 0.1 * g1 / 2, rtol=2e-5, atol=2e-6)

@@ -1,0 +1,174 @@
+"""Interleaved virtual pipeline stages (round-3 verdict #3).
+
+Reference: python/paddle/distributed/fleet/meta_parallel/
+pipeline_parallel.py:170 (interleaved 1F1B) + pp_layers' virtual-stage
+segmentation — rank s owns layer chunks {s, S+s, 2S+s, ...}.
+"""
+import jax
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.distributed import env as denv
+from paddle_tpu.distributed.pipeline import (
+    LayerDesc, PipelineLayer, microbatch, pipeline_forward,
+    pipeline_num_ticks,
+)
+
+
+@pytest.fixture
+def pp2_mesh():
+    from jax.sharding import Mesh
+
+    devs = np.array(jax.devices()[:8]).reshape(4, 2)
+    mesh = Mesh(devs, ("dp", "pp"))
+    denv.set_mesh(mesh)
+    yield mesh
+    denv.set_mesh(None)
+
+
+def _scan_lengths(jaxpr):
+    """All lax.scan lengths in a jaxpr, recursively."""
+    out = []
+
+    def walk(jx):
+        if hasattr(jx, "jaxpr"):              # ClosedJaxpr -> Jaxpr
+            jx = jx.jaxpr
+        for eqn in jx.eqns:
+            if eqn.primitive.name == "scan":
+                out.append(int(eqn.params["length"]))
+            for v in eqn.params.values():
+                for w in (v if isinstance(v, (list, tuple)) else [v]):
+                    if hasattr(w, "eqns") or hasattr(w, "jaxpr"):
+                        walk(w)
+
+    walk(jaxpr.jaxpr)
+    return out
+
+
+def test_virtual_stage_segmentation(pp2_mesh):
+    pl = PipelineLayer(layers=[LayerDesc(nn.Linear, 8, 8)
+                               for _ in range(4)],
+                       num_stages=2, num_virtual_pipeline_stages=2)
+    assert pl.num_stages == 2
+    assert pl.num_virtual_stages == 2
+    # rank s owns chunks {s, S+s}: rank 0 -> layers 0,2; rank 1 -> 1,3
+    assert pl.get_stage_layers(0) == [pl.funcs[0], pl.funcs[2]]
+    assert pl.get_stage_layers(1) == [pl.funcs[1], pl.funcs[3]]
+
+
+def test_indivisible_virtual_chunks_raise(pp2_mesh):
+    with pytest.raises(ValueError, match="equal chunks"):
+        PipelineLayer(layers=[LayerDesc(nn.Linear, 8, 8)
+                              for _ in range(6)],
+                      num_stages=2, num_virtual_pipeline_stages=4)
+
+
+def test_virtual_parity_vs_sequential(pp2_mesh):
+    """pp=2, V=2: the interleaved schedule computes exactly the
+    sequential composition of the 4 layers."""
+    paddle.seed(0)
+    pl = PipelineLayer(layers=[LayerDesc(nn.Linear, 16, 16)
+                               for _ in range(4)],
+                       num_stages=2, num_virtual_pipeline_stages=2)
+    x = paddle.randn([8, 16])
+    seq = pl(x)  # plain sequential forward
+    out = pl.forward_pipelined(x, num_micro=4)
+    np.testing.assert_allclose(np.asarray(out._value),
+                               np.asarray(seq._value), rtol=2e-5,
+                               atol=1e-5)
+
+
+def test_virtual_parity_deep_trunk(pp2_mesh):
+    """8 layers, V=2 (chunks of 2 layers) exercises multi-layer chunks."""
+    paddle.seed(1)
+    pl = PipelineLayer(layers=[LayerDesc(nn.Linear, 8, 8)
+                               for _ in range(8)],
+                       num_stages=2, num_virtual_pipeline_stages=2)
+    x = paddle.randn([4, 8])
+    np.testing.assert_allclose(
+        np.asarray(pl.forward_pipelined(x, num_micro=2)._value),
+        np.asarray(pl(x)._value), rtol=2e-5, atol=1e-5)
+
+
+def test_virtual_gradients_flow(pp2_mesh):
+    paddle.seed(2)
+    pl = PipelineLayer(layers=[LayerDesc(nn.Linear, 8, 8)
+                               for _ in range(4)],
+                       num_stages=2, num_virtual_pipeline_stages=2)
+    x = paddle.randn([4, 8])
+    loss = (pl.forward_pipelined(x, num_micro=2) ** 2).mean()
+    loss.backward()
+    # every chunk's params (both virtual stages of both ranks) get grads
+    for p in pl.parameters():
+        assert p.grad is not None
+        assert np.isfinite(np.asarray(p.grad.numpy())).all()
+
+
+def test_tick_count_is_m_plus_sv_minus_1(pp2_mesh):
+    """The schedule runs exactly M + S*V - 1 ticks (the verdict's
+    interleaved-1F1B tick budget), visible as the scan length."""
+    paddle.seed(3)
+    pl = PipelineLayer(layers=[LayerDesc(nn.Linear, 8, 8)
+                               for _ in range(4)],
+                       num_stages=2, num_virtual_pipeline_stages=2)
+    stage_fn = pl.trunk_stage_fn()
+    stacked = pl.stacked_trunk_params()
+    M, S, V = 4, 2, 2
+    x = np.random.RandomState(0).randn(M, 2, 8).astype(np.float32)
+
+    jaxpr = jax.make_jaxpr(
+        lambda sp, xv: pipeline_forward(stage_fn, sp, xv, num_virtual=V))(
+            stacked, x)
+    lengths = _scan_lengths(jaxpr)
+    assert pipeline_num_ticks(M, S, V) == M + S * V - 1 == 7
+    assert lengths == [7], lengths
+
+
+def test_het_trunk_rejects_virtual(pp2_mesh):
+    pl = PipelineLayer(layers=[nn.Linear(8, 8), nn.Linear(8, 8),
+                               nn.Linear(8, 8), nn.Linear(8, 8)],
+                       num_stages=2, num_virtual_pipeline_stages=2)
+    with pytest.raises(ValueError, match="homogeneous"):
+        pl.het_stage_fns()
+
+
+def test_gpt_virtual_pipeline_end_to_end(pp2_mesh):
+    """GPTConfig.pp_num_virtual routes through the public model path and
+    trains."""
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+
+    paddle.seed(4)
+    cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=4,
+                    num_heads=4, max_position=16, dropout=0.0,
+                    use_flash=False, pp_num_virtual=2)
+    model = GPTForCausalLM(cfg)
+    assert model.gpt.h.num_virtual_stages == 2
+    opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                 parameters=model.parameters())
+    rng = np.random.RandomState(4)
+    ids = paddle.to_tensor(rng.randint(0, 64, (8, 12)))
+    labels = paddle.to_tensor(rng.randint(0, 64, (8, 12)))
+    losses = []
+    for _ in range(6):
+        loss = model(ids, labels=labels)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0], losses
+    assert all(np.isfinite(losses))
+
+
+def test_v1_unchanged_parity(pp2_mesh):
+    """num_virtual default (1) keeps the original schedule semantics."""
+    paddle.seed(5)
+    pl = PipelineLayer(layers=[LayerDesc(nn.Linear, 8, 8)
+                               for _ in range(4)],
+                       num_stages=2)
+    assert pl.num_virtual_stages == 1
+    x = paddle.randn([4, 8])
+    np.testing.assert_allclose(
+        np.asarray(pl.forward_pipelined(x, num_micro=2)._value),
+        np.asarray(pl(x)._value), rtol=2e-5, atol=1e-5)

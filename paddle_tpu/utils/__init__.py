@@ -1,8 +1,23 @@
 """paddle.utils (reference: python/paddle/utils/__init__.py)."""
 from . import cpp_extension  # noqa: F401
+from . import dlpack  # noqa: F401
+from . import download  # noqa: F401
+from . import image_util  # noqa: F401
+from . import op_version  # noqa: F401
+from . import unique_name  # noqa: F401
 from .custom_op import CustomOp, register_custom_op  # noqa: F401
+from .install_check import run_check  # noqa: F401
+from .op_version import OpLastCheckpointChecker  # noqa: F401
+from .profiler import Profiler, ProfilerOptions, get_profiler  # noqa: F401
 
-__all__ = ["cpp_extension", "try_import", "register_custom_op", "CustomOp"]
+try:  # reference re-exports a vendored gast; the real one is in the image
+    import gast  # noqa: F401
+except ImportError:  # pragma: no cover
+    gast = None
+
+__all__ = ["cpp_extension", "try_import", "register_custom_op", "CustomOp",
+           "deprecated", "run_check", "require_version", "unique_name",
+           "download", "dlpack", "op_version", "image_util"]
 
 
 def try_import(module_name, err_msg=None):
@@ -40,8 +55,13 @@ def deprecated(update_to="", since="", reason="", level=0):
 
 
 def require_version(min_version, max_version=None):
-    """Check the installed framework version is inside [min, max]."""
+    """Check the installed framework version is inside [min, max]
+    (reference: fluid/framework.require_version)."""
     from .. import __version__
+
+    if not isinstance(min_version, str) or (
+            max_version is not None and not isinstance(max_version, str)):
+        raise TypeError("version bounds must be str")
 
     def key(v):
         return [int(x) for x in str(v).replace("-", ".").split(".")
@@ -57,17 +77,5 @@ def require_version(min_version, max_version=None):
     return True
 
 
-def run_check():
-    """Smoke-test the install: run one fused matmul on the attached device
-    (reference utils/install_check.py trains a tiny net)."""
-    import jax
-    import jax.numpy as jnp
-    x = jnp.ones((8, 8), jnp.float32)
-    y = jax.jit(lambda a: (a @ a).sum())(x)
-    assert float(y) == 8.0 * 8.0 * 8.0
-    plat = jax.devices()[0].platform
-    print(f"PaddleTPU works well on 1 {plat} device.")
-    return True
-
-
-__all__ += ["deprecated", "require_version", "run_check"]
+# run_check comes from install_check (dygraph + static smoke-train, the
+# reference install_check.py:220 contract)

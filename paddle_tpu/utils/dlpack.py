@@ -1,0 +1,48 @@
+"""DLPack interop (reference: python/paddle/utils/dlpack.py:26,62).
+
+TPU-native: jax arrays already speak the DLPack protocol, so to_dlpack
+hands out the underlying buffer's capsule (zero-copy on CPU; device
+buffers export their device view) and from_dlpack accepts either a
+capsule or any __dlpack__-capable producer (torch, numpy, cupy, jax).
+"""
+from __future__ import annotations
+
+__all__ = ["to_dlpack", "from_dlpack"]
+
+
+def to_dlpack(x):
+    """Tensor -> DLPack capsule (reference dlpack.py:26)."""
+    from ..core.tensor import Tensor
+
+    v = x._value if isinstance(x, Tensor) else x
+    return v.__dlpack__()
+
+
+class _CapsuleProducer:
+    """Adapter: a bare DLPack capsule (the reference's to_dlpack output)
+    presented through the modern producer protocol jnp.from_dlpack
+    expects. A capsule carries no device info, so it is presented as
+    host-resident (kDLCPU) — which is what a capsule that crossed a
+    framework boundary is."""
+
+    def __init__(self, capsule):
+        self._capsule = capsule
+
+    def __dlpack__(self, stream=None):
+        return self._capsule
+
+    def __dlpack_device__(self):
+        return (1, 0)  # (kDLCPU, device 0)
+
+
+def from_dlpack(dlpack):
+    """DLPack capsule or __dlpack__-capable object -> Tensor
+    (reference dlpack.py:62; also accepts producers directly, the
+    modern protocol form torch/numpy/jax use)."""
+    import jax.numpy as jnp
+
+    from ..core.tensor import Tensor
+
+    if not hasattr(dlpack, "__dlpack__"):  # bare capsule
+        dlpack = _CapsuleProducer(dlpack)
+    return Tensor(jnp.from_dlpack(dlpack))

@@ -1,0 +1,108 @@
+"""Weight/file cache resolution (reference: python/paddle/utils/
+download.py:75 get_weights_path_from_url, :121 get_path_from_url).
+
+This build runs zero-egress: http(s) URLs resolve ONLY against the local
+cache (a pre-populated ~/.cache/paddle/hapi/weights) and raise a loud
+RuntimeError on a miss instead of downloading. file:// URLs and plain
+paths are copied/decompressed into the cache, which keeps the decompress/
+md5 pipeline of the reference exercised and lets users sideload weights.
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+import os.path as osp
+import shutil
+import tarfile
+import zipfile
+
+__all__ = ["get_weights_path_from_url"]
+
+WEIGHTS_HOME = osp.expanduser("~/.cache/paddle/hapi/weights")
+DOWNLOAD_RETRY_LIMIT = 3
+
+
+def is_url(path):
+    """Reference download.py:66 contract."""
+    return path.startswith("http://") or path.startswith("https://") \
+        or path.startswith("file://")
+
+
+def _map_path(url, root_dir):
+    fname = osp.split(url)[-1]
+    return osp.join(root_dir, fname)
+
+
+def _md5check(fullname, md5sum=None):
+    if md5sum is None:
+        return True
+    md5 = hashlib.md5()
+    with open(fullname, "rb") as f:
+        for chunk in iter(lambda: f.read(4096), b""):
+            md5.update(chunk)
+    return md5.hexdigest() == md5sum
+
+
+def _decompress(fname):
+    """Unpack zip/tar next to the archive; return the extraction root.
+    Already-extracted archives (root dir present) are not re-extracted —
+    hot-path resolutions must not rewrite files another reader may hold
+    open (reference download.py:283 has the same check-then-extract)."""
+    dirname = osp.dirname(fname)
+    if zipfile.is_zipfile(fname):
+        with zipfile.ZipFile(fname) as z:
+            names = z.namelist()
+            root = _single_root(names, dirname)
+            if root is None or not osp.exists(root):
+                z.extractall(dirname)
+    elif tarfile.is_tarfile(fname):
+        with tarfile.open(fname) as t:
+            names = t.getnames()
+            root = _single_root(names, dirname)
+            if root is None or not osp.exists(root):
+                t.extractall(dirname, filter="data")
+    else:
+        return fname
+    return root if root is not None else dirname
+
+
+def _single_root(names, dirname):
+    roots = {n.split("/")[0] for n in names if n.strip("/")}
+    return osp.join(dirname, roots.pop()) if len(roots) == 1 else None
+
+
+def get_path_from_url(url, root_dir=WEIGHTS_HOME, md5sum=None,
+                      check_exist=True, decompress=True):
+    """Resolve `url` to a local path under root_dir (reference
+    download.py:121), without network egress."""
+    os.makedirs(root_dir, exist_ok=True)
+    if url.startswith("file://"):
+        src = url[len("file://"):]
+    elif not is_url(url):
+        src = url  # plain local path
+    else:
+        src = None  # http(s): cache-only
+    fullname = _map_path(url, root_dir)
+    if osp.exists(fullname) and check_exist and _md5check(fullname, md5sum):
+        pass  # cache hit
+    elif src is not None:
+        if not osp.exists(src):
+            raise FileNotFoundError(f"{url}: local source {src} not found")
+        shutil.copy(src, fullname)
+        if not _md5check(fullname, md5sum):
+            raise OSError(f"{fullname} md5 mismatch (expected {md5sum})")
+    else:
+        raise RuntimeError(
+            f"cannot fetch {url}: this build runs with zero network "
+            f"egress. Pre-place the file at {fullname} (or pass a "
+            "file:// URL) — pretrained-weight downloads are not "
+            "available on this deployment.")
+    if decompress and (zipfile.is_zipfile(fullname)
+                       or tarfile.is_tarfile(fullname)):
+        return _decompress(fullname)
+    return fullname
+
+
+def get_weights_path_from_url(url, md5sum=None):
+    """Reference download.py:75: resolve into the weights cache."""
+    return get_path_from_url(url, WEIGHTS_HOME, md5sum)

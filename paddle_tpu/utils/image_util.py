@@ -1,0 +1,98 @@
+"""Legacy image helpers (reference: python/paddle/utils/image_util.py —
+PIL/numpy preprocessing used by the v1-era tutorials).
+
+numpy-only re-implementation (bilinear resize via index interpolation);
+decode_jpeg gates on Pillow if a real JPEG byte-string arrives.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = []
+
+
+def resize_image(img, target_size):
+    """[C, H, W] (or [H, W]) -> shorter side == target_size, bilinear."""
+    arr = np.asarray(img)
+    chw = arr.ndim == 3
+    if chw:
+        c, h, w = arr.shape
+    else:
+        h, w = arr.shape
+    scale = target_size / min(h, w)
+    nh, nw = max(1, int(round(h * scale))), max(1, int(round(w * scale)))
+    ys = np.clip(np.linspace(0, h - 1, nh), 0, h - 1)
+    xs = np.clip(np.linspace(0, w - 1, nw), 0, w - 1)
+    y0, x0 = np.floor(ys).astype(int), np.floor(xs).astype(int)
+    y1, x1 = np.minimum(y0 + 1, h - 1), np.minimum(x0 + 1, w - 1)
+    wy, wx = (ys - y0)[:, None], (xs - x0)[None, :]
+
+    def _interp(a):
+        top = a[y0][:, x0] * (1 - wx) + a[y0][:, x1] * wx
+        bot = a[y1][:, x0] * (1 - wx) + a[y1][:, x1] * wx
+        return top * (1 - wy) + bot * wy
+
+    if chw:
+        return np.stack([_interp(arr[i]) for i in range(c)])
+    return _interp(arr)
+
+
+def flip(im):
+    """Horizontal flip, [C, H, W] or [H, W] (reference image_util.py:35)."""
+    im = np.asarray(im)
+    return im[:, :, ::-1] if im.ndim == 3 else im[:, ::-1]
+
+
+def crop_img(im, inner_size, color=True, test=True):
+    """Center (test) or random crop to inner_size square
+    (reference image_util.py:47)."""
+    im = np.asarray(im)
+    if color and im.ndim == 3:
+        _, h, w = im.shape
+    else:
+        h, w = im.shape[-2:]
+    if test:
+        top, left = (h - inner_size) // 2, (w - inner_size) // 2
+    else:
+        top = np.random.randint(0, max(1, h - inner_size + 1))
+        left = np.random.randint(0, max(1, w - inner_size + 1))
+    sl = (slice(top, top + inner_size), slice(left, left + inner_size))
+    return im[(slice(None),) + sl] if im.ndim == 3 else im[sl]
+
+
+def decode_jpeg(jpeg_string):
+    """JPEG bytes -> [C, H, W] float array (needs Pillow)."""
+    import io
+
+    try:
+        from PIL import Image
+    except ImportError as e:  # loud gate: no image codec in this image
+        raise ImportError(
+            "decode_jpeg needs Pillow, which is not installed in this "
+            "deployment; decode outside or install Pillow") from e
+    img = np.asarray(Image.open(io.BytesIO(jpeg_string)).convert("RGB"))
+    return img.transpose(2, 0, 1).astype(np.float32)
+
+
+def preprocess_img(im, img_mean, crop_size, is_train, color=True):
+    """resize->crop->mean-subtract pipeline (reference image_util.py:98)."""
+    im = crop_img(np.asarray(im, dtype=np.float32), crop_size, color,
+                  test=not is_train)
+    mean = np.asarray(img_mean, dtype=np.float32).reshape(im.shape)
+    return (im - mean).flatten()
+
+
+def load_meta(meta_path, mean_img_size, crop_size, color=True):
+    """Load a pickled mean image and center-crop it to crop_size."""
+    import pickle
+
+    with open(meta_path, "rb") as f:
+        mean = pickle.load(f, encoding="latin1")["mean"]
+    border = (mean_img_size - crop_size) // 2
+    if color:
+        mean = mean.reshape(3, mean_img_size, mean_img_size)
+        return mean[:, border:border + crop_size,
+                    border:border + crop_size].flatten()
+    mean = mean.reshape(mean_img_size, mean_img_size)
+    return mean[border:border + crop_size,
+                border:border + crop_size].flatten()

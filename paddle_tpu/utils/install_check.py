@@ -1,0 +1,91 @@
+"""Installation self-check (reference: python/paddle/utils/
+install_check.py:220 run_check — trains a tiny network in dygraph and
+static mode and reports whether the install works).
+
+TPU-native: the same two smoke flows on whatever backend jax resolved
+(TPU chip under axon, CPU otherwise), plus a device report.
+"""
+from __future__ import annotations
+
+__all__ = []
+
+
+def _simple_network():
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+
+    class Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(16, 10)
+
+        def forward(self, x):
+            return self.fc(x)
+
+    return Net()
+
+
+def _run_dygraph_single():
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+
+    paddle.seed(0)
+    model = _simple_network()
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=model.parameters())
+    x = paddle.to_tensor(
+        np.random.RandomState(0).randn(4, 16).astype("float32"))
+    y = paddle.to_tensor(np.array([[0], [1], [2], [3]], dtype="int64"))
+    loss = nn.functional.cross_entropy(model(x), y)
+    loss.backward()
+    opt.step()
+    return float(loss)
+
+
+def _run_static_single():
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+
+    was_dynamic = paddle.in_dynamic_mode()
+    paddle.enable_static()
+    try:
+        main = paddle.static.Program()
+        with paddle.static.program_guard(main):
+            x = paddle.static.data("x", [4, 16], "float32")
+            y = paddle.static.data("y", [4, 1], "int64")
+            logits = nn.Linear(16, 10)(x)
+            loss = nn.functional.cross_entropy(logits, y)
+        exe = paddle.static.Executor()
+        rng = np.random.RandomState(0)
+        (lv,) = exe.run(main,
+                        feed={"x": rng.randn(4, 16).astype("float32"),
+                              "y": np.array([[0], [1], [2], [3]],
+                                            dtype="int64")},
+                        fetch_list=[loss])
+        return float(lv)
+    finally:
+        # restore the caller's mode — a user already in static mode must
+        # not come back from a smoke check in dygraph mode
+        if was_dynamic:
+            paddle.disable_static()
+
+
+def run_check():
+    """Smoke-train in both execution modes and report (reference
+    install_check.py:220)."""
+    import jax
+
+    backend = jax.default_backend()
+    n = jax.device_count()
+    print(f"Running verify PaddlePaddle(TPU) program ... "
+          f"[backend={backend}, devices={n}]")
+    dy = _run_dygraph_single()
+    st = _run_static_single()
+    assert dy == dy and st == st, "non-finite smoke losses"
+    print("PaddlePaddle(TPU) works well on 1 device.")
+    print("PaddlePaddle(TPU) is installed successfully! Let's start deep "
+          "learning with PaddlePaddle(TPU) now.")

@@ -1,0 +1,56 @@
+"""Op version checkpoints (reference: python/paddle/utils/op_version.py:50
+OpLastCheckpointChecker over the C++ op-version registry).
+
+TPU-native: there is no PHI op registry — kernels are jax/XLA programs
+versioned with the package. The checker keeps the reference's query API
+over a python-side registry so tooling that inspects op compatibility
+(model converters, save/load version gates) keeps working; entries can be
+registered by ops that need migration notes.
+"""
+from __future__ import annotations
+
+__all__ = []
+
+_op_version_registry = {}  # op_name -> list of (note, version_id, type)
+
+
+def register_op_version(op_name, note, version_id, update_type=None):
+    _op_version_registry.setdefault(op_name, []).append(
+        (note, version_id, update_type))
+
+
+def Singleton(cls):
+    insts = {}
+
+    def get(*a, **kw):
+        if cls not in insts:
+            insts[cls] = cls(*a, **kw)
+        return insts[cls]
+    return get
+
+
+class OpUpdateInfoHelper:
+    def __init__(self, info):
+        self._info = info
+
+    def verify_key_value(self, name=""):
+        return name == "" or name in str(self._info)
+
+
+@Singleton
+class OpLastCheckpointChecker:
+    """Query the latest version checkpoint of an op (reference
+    op_version.py:50)."""
+
+    def __init__(self):
+        self.checker = _op_version_registry
+
+    def filter_updates(self, op_name, type=None, key=""):  # noqa: A002
+        updates = []
+        for note, _vid, utype in self.checker.get(op_name, []):
+            if type is not None and utype != type:
+                continue
+            helper = OpUpdateInfoHelper(note)
+            if helper.verify_key_value(key):
+                updates.append(note)
+        return updates

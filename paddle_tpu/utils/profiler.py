@@ -1,0 +1,80 @@
+"""Legacy profiler facade (reference: python/paddle/utils/profiler.py:39
+ProfilerOptions / :76 Profiler / get_profiler) — thin options-bag plus a
+start/stop context delegating to the modern paddle.profiler engine."""
+from __future__ import annotations
+
+__all__ = ["ProfilerOptions", "Profiler", "get_profiler"]
+
+
+class ProfilerOptions:
+    _default = {
+        "state": "All", "sorted_key": "default", "tracer_level": "Default",
+        "batch_range": [0, 100], "output_thread_detail": False,
+        "profile_path": "none", "timeline_path": "none",
+        "op_summary_path": "none",
+    }
+
+    def __init__(self, options=None):
+        self.options = dict(self._default)
+        if options is not None:
+            self.options.update(options)
+
+    def with_state(self, state):
+        new = ProfilerOptions(self.options)
+        new.options["state"] = state
+        return new
+
+    def __getitem__(self, name):
+        if name not in self.options:
+            raise ValueError(f"ProfilerOptions does not have option {name}")
+        return self.options[name]
+
+
+class Profiler:
+    def __init__(self, enabled=True, options=None):
+        from ..profiler import Profiler as _Modern
+
+        self._options = options if isinstance(options, ProfilerOptions) \
+            else ProfilerOptions(options)
+        self._enabled = enabled
+        self._inner = _Modern() if enabled else None
+        self._running = False
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self.stop()
+
+    def start(self):
+        if self._enabled and not self._running:
+            self._inner.start()
+            self._running = True
+
+    def stop(self):
+        if self._enabled and self._running:
+            self._inner.stop()
+            self._running = False
+
+    def reset(self):
+        if self._running:
+            self.stop()
+        if self._enabled:
+            from ..profiler import Profiler as _Modern
+
+            self._inner = _Modern()
+
+    def record_step(self, change_profiler_status=True):
+        if self._enabled and self._running:
+            self._inner.step()
+
+
+_profiler = None
+
+
+def get_profiler():
+    global _profiler
+    if _profiler is None:
+        _profiler = Profiler()
+    return _profiler

@@ -1,0 +1,51 @@
+"""Unique name generation (reference: python/paddle/fluid/unique_name.py,
+re-exported as paddle.utils.unique_name).
+
+Same contract: a process-wide generator keyed by prefix, switchable and
+guardable for isolated name scopes (program capture, tests).
+"""
+from __future__ import annotations
+
+import contextlib
+
+__all__ = ["generate", "switch", "guard"]
+
+
+class UniqueNameGenerator:
+    def __init__(self, prefix=None):
+        self._ids = {}
+        self._prefix = prefix or ""
+
+    def __call__(self, key):
+        i = self._ids.get(key, 0)
+        self._ids[key] = i + 1
+        return "_".join([self._prefix + key, str(i)]) if self._prefix \
+            else f"{key}_{i}"
+
+
+_generator = UniqueNameGenerator()
+
+
+def generate(key):
+    """`key` -> "key_N" with a process-unique N per key."""
+    return _generator(key)
+
+
+def switch(new_generator=None):
+    """Replace the active generator; returns the previous one."""
+    global _generator
+    old = _generator
+    _generator = new_generator or UniqueNameGenerator()
+    return old
+
+
+@contextlib.contextmanager
+def guard(new_generator=None):
+    """Scope with a fresh (or given) generator; restores on exit."""
+    if isinstance(new_generator, str):
+        new_generator = UniqueNameGenerator(new_generator)
+    old = switch(new_generator)
+    try:
+        yield
+    finally:
+        switch(old)

@@ -47,9 +47,10 @@ def test_reference_top_level_exports_present():
     (paddle.sparse, "/root/reference/python/paddle/sparse/__init__.py"),
     (paddle.incubate,
      "/root/reference/python/paddle/incubate/__init__.py"),
+    (paddle.utils, "/root/reference/python/paddle/utils/__init__.py"),
 ], ids=["nn", "nn.functional", "tensor", "io", "vision.datasets",
         "vision.transforms", "metric", "jit", "optimizer", "static",
-        "linalg", "fft", "distribution", "sparse", "incubate"])
+        "linalg", "fft", "distribution", "sparse", "incubate", "utils"])
 def test_submodule_exports_present(mod, path):
     ref = _ref_exports(path)
     missing = sorted(n for n in ref if not hasattr(mod, n))

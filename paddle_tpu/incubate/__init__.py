@@ -1,8 +1,14 @@
 """paddle.incubate (reference: python/paddle/incubate/__init__.py)."""
 from . import asp  # noqa: F401
+from . import checkpoint  # noqa: F401
 from . import distributed  # noqa: F401
 from . import autograd  # noqa: F401
 from . import nn  # noqa: F401
+
+# NOT imported eagerly (matching the reference): importing
+# incubate.multiprocessing registers Tensor reductions with the GLOBAL
+# multiprocessing pickler — that side effect must stay opt-in via an
+# explicit `import paddle.incubate.multiprocessing`.
 from .operators import (  # noqa: F401
     graph_khop_sampler, graph_reindex, graph_sample_neighbors,
     graph_send_recv, segment_max, segment_mean, segment_min, segment_sum,

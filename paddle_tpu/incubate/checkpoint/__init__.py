@@ -1,0 +1,6 @@
+"""paddle.incubate.checkpoint (reference:
+python/paddle/incubate/checkpoint/__init__.py:15 — re-exports the
+auto_checkpoint module)."""
+from . import auto_checkpoint  # noqa: F401
+
+__all__ = []

@@ -1,0 +1,14 @@
+"""paddle.incubate.multiprocessing (reference:
+python/paddle/incubate/multiprocessing/__init__.py — the stdlib
+multiprocessing namespace with Tensor reductions pre-registered, so
+Tensors cross Process/Queue boundaries via shared memory)."""
+import multiprocessing
+
+from multiprocessing import *  # noqa: F401,F403
+
+from .reductions import init_reductions  # noqa: E402
+
+__all__ = []
+__all__ += multiprocessing.__all__  # type: ignore[attr-defined]
+
+init_reductions()

@@ -1,0 +1,96 @@
+"""Cross-process Tensor pickling over shared memory (reference:
+python/paddle/incubate/multiprocessing/reductions.py — LoDTensor
+reductions through the file_system shm strategy).
+
+TPU-native: device buffers are host-reachable numpy views, so the
+reduction writes the array once into a POSIX shared-memory block and the
+consumer maps it zero-copy, rebuilds a Tensor, and unlinks the block
+(single-consumer contract, matching the reference's file_system
+strategy where the segment dies with its consumer). Only host-resident
+(CPU/unsharded) tensors are shareable — a sharded device array must be
+gathered first, which is the honest semantic on a TPU slice.
+"""
+from __future__ import annotations
+
+import sys
+from multiprocessing import shared_memory
+from multiprocessing.reduction import ForkingPickler
+
+import numpy as np
+
+__all__ = ["init_reductions"]
+
+
+def _supported_check():
+    if sys.platform != "linux":
+        return False  # reference: linux-only, file_system strategy
+    return True
+
+
+def _rebuild_tensor_shm(shm_name, shape, dtype_str):
+    from ...core.tensor import Tensor
+
+    try:
+        shm = shared_memory.SharedMemory(name=shm_name)
+    except FileNotFoundError:
+        raise RuntimeError(
+            f"shared-memory tensor segment {shm_name} is gone — each "
+            "pickled Tensor payload is SINGLE-CONSUMER (the first "
+            "deserialization frees the segment); deserializing the same "
+            "bytes twice is not supported") from None
+    try:
+        view = np.ndarray(shape, dtype=np.dtype(dtype_str),
+                          buffer=shm.buf)
+        arr = np.array(view)  # own copy; the block is freed below
+    finally:
+        shm.close()
+        try:
+            shm.unlink()  # single-consumer: the segment dies here
+        except FileNotFoundError:
+            pass
+    return Tensor(arr)
+
+
+def _rebuild_tensor_inline(arr):
+    from ...core.tensor import Tensor
+
+    return Tensor(arr)
+
+
+# below this size the shm round trip costs more than inline pickle bytes
+_INLINE_LIMIT = 4096
+
+
+def reduce_tensor(t):
+    """ForkingPickler reduction for Tensor (reference reductions.py:104)."""
+    arr = np.asarray(t._value)
+    if not _supported_check() or arr.nbytes <= _INLINE_LIMIT:
+        return (_rebuild_tensor_inline, (arr,))
+    shm = shared_memory.SharedMemory(create=True, size=arr.nbytes)
+    try:
+        view = np.ndarray(arr.shape, dtype=arr.dtype, buffer=shm.buf)
+        view[...] = arr
+        name = shm.name
+        # hand ownership to the consumer: without this, the producer's
+        # resource_tracker unlinks the segment when the producer exits —
+        # racing a consumer that hasn't mapped it yet (dataloader workers
+        # exit right after queueing their last batch). The cost is a
+        # leaked segment if the payload is never deserialized; that is
+        # the same lifetime contract as the reference's file_system
+        # strategy.
+        from multiprocessing import resource_tracker
+
+        resource_tracker.unregister(shm._name, "shared_memory")
+    finally:
+        shm.close()  # producer unmaps; consumer unlinks
+    return (_rebuild_tensor_shm, (name, arr.shape, arr.dtype.str))
+
+
+def init_reductions():
+    """Register the Tensor reduction with multiprocessing's pickler
+    (reference reductions.py:182)."""
+    if not _supported_check():
+        return
+    from ...core.tensor import Tensor
+
+    ForkingPickler.register(Tensor, reduce_tensor)

@@ -11,10 +11,21 @@ __all__ = ["to_dlpack", "from_dlpack"]
 
 
 def to_dlpack(x):
-    """Tensor -> DLPack capsule (reference dlpack.py:26)."""
+    """Tensor -> DLPack capsule (reference dlpack.py:26).
+
+    A bare capsule carries no device tag, so the export is ALWAYS
+    host-resident: device (TPU) buffers are copied to host first. The
+    capsule consumers in scope (torch-cpu, numpy, a fresh jax array)
+    are host-side; zero-copy device export goes through the array
+    protocol (`jnp.from_dlpack(tensor._value)`), not the capsule."""
+    import numpy as np
+
     from ..core.tensor import Tensor
 
     v = x._value if isinstance(x, Tensor) else x
+    if getattr(getattr(v, "sharding", None), "device_set", None) and any(
+            d.platform != "cpu" for d in v.sharding.device_set):
+        v = np.asarray(v)  # device -> host copy
     return v.__dlpack__()
 
 

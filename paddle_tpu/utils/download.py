@@ -45,30 +45,37 @@ def _md5check(fullname, md5sum=None):
 
 def _decompress(fname):
     """Unpack zip/tar next to the archive; return the extraction root.
-    Already-extracted archives (root dir present) are not re-extracted —
+    Already-extracted archives (root present) are not re-extracted —
     hot-path resolutions must not rewrite files another reader may hold
-    open (reference download.py:283 has the same check-then-extract)."""
+    open (reference download.py:283 has the same check-then-extract).
+    Multi-root archives extract into their own '<archive-stem>_unpacked'
+    dir so the shared cache root never collects loose files."""
     dirname = osp.dirname(fname)
     if zipfile.is_zipfile(fname):
         with zipfile.ZipFile(fname) as z:
             names = z.namelist()
-            root = _single_root(names, dirname)
-            if root is None or not osp.exists(root):
-                z.extractall(dirname)
+            root, dest = _roots(names, fname, dirname)
+            if not osp.exists(root):
+                z.extractall(dest)
     elif tarfile.is_tarfile(fname):
         with tarfile.open(fname) as t:
             names = t.getnames()
-            root = _single_root(names, dirname)
-            if root is None or not osp.exists(root):
-                t.extractall(dirname, filter="data")
+            root, dest = _roots(names, fname, dirname)
+            if not osp.exists(root):
+                t.extractall(dest, filter="data")
     else:
         return fname
-    return root if root is not None else dirname
+    return root
 
 
-def _single_root(names, dirname):
-    roots = {n.split("/")[0] for n in names if n.strip("/")}
-    return osp.join(dirname, roots.pop()) if len(roots) == 1 else None
+def _roots(names, fname, dirname):
+    """(extraction root to return/check, extractall destination)."""
+    tops = {n.split("/")[0] for n in names if n.strip("/")}
+    if len(tops) == 1:
+        return osp.join(dirname, tops.pop()), dirname
+    stem = osp.splitext(osp.basename(fname))[0] + "_unpacked"
+    dest = osp.join(dirname, stem)
+    return dest, dest
 
 
 def get_path_from_url(url, root_dir=WEIGHTS_HOME, md5sum=None,

@@ -57,7 +57,10 @@ def crop_img(im, inner_size, color=True, test=True):
         top = np.random.randint(0, max(1, h - inner_size + 1))
         left = np.random.randint(0, max(1, w - inner_size + 1))
     sl = (slice(top, top + inner_size), slice(left, left + inner_size))
-    return im[(slice(None),) + sl] if im.ndim == 3 else im[sl]
+    out = im[(slice(None),) + sl] if im.ndim == 3 else im[sl]
+    if not test and np.random.randint(2):
+        out = flip(out)  # reference: train mode random-flips the crop
+    return out
 
 
 def decode_jpeg(jpeg_string):

@@ -15,7 +15,10 @@ class ProfilerOptions:
     }
 
     def __init__(self, options=None):
-        self.options = dict(self._default)
+        import copy
+
+        self.options = copy.deepcopy(self._default)  # batch_range is a
+        # mutable list; a shallow copy would alias it across instances
         if options is not None:
             self.options.update(options)
 

@@ -1,0 +1,15 @@
+"""Spawn-target helpers for test_incubate_checkpoint_mp (a spawned child
+re-imports the target function's module, so it must live in a real file,
+not the pytest module namespace)."""
+import numpy as np
+
+
+def child_echo(q_in, q_out):
+    # a spawned child re-runs sitecustomize, which force-registers the
+    # axon TPU plugin; first device use would hang on the tunnel unless
+    # the child pins the platform the way conftest does for the parent
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    t = q_in.get(timeout=30)
+    q_out.put(float(np.asarray(t.numpy()).sum()))

@@ -184,3 +184,5 @@ def check_shape(shape):
             raise ValueError(
                 "All elements in ``shape`` must be positive when it's a "
                 "list or tuple")
+
+from . import fluid  # noqa: F401,E402  (reference-era compat namespace)

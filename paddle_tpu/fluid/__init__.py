@@ -1,0 +1,80 @@
+"""paddle.fluid compatibility namespace (reference:
+python/paddle/fluid/__init__.py — the 1.x-era API surface that ~2.3-era
+user scripts still import directly).
+
+Thin delegation onto the modern modules: the capabilities all exist
+under paddle_tpu.static / nn / optimizer; this package only restores the
+reference-era names and calling conventions (fluid.layers.data's
+implicit batch dim, post-softmax cross_entropy, parameter_list= kwarg,
+dygraph.guard/to_variable) so reference-era scripts run unmodified.
+"""
+from __future__ import annotations
+
+from .. import ParamAttr  # noqa: F401
+from ..static import (  # noqa: F401
+    Executor, Program, default_main_program, default_startup_program,
+    program_guard,
+)
+from ..static import gradients  # noqa: F401
+from .. import CPUPlace, CUDAPlace, CUDAPinnedPlace  # noqa: F401
+from ..framework.mode import in_dynamic_mode as in_dygraph_mode  # noqa: F401
+from .. import enable_static, disable_static  # noqa: F401
+
+from . import core  # noqa: F401
+from . import dygraph  # noqa: F401
+from . import initializer  # noqa: F401
+from . import io  # noqa: F401
+from . import layers  # noqa: F401
+from . import optimizer  # noqa: F401
+from . import regularizer  # noqa: F401
+from . import backward  # noqa: F401
+
+__all__ = ["layers", "dygraph", "optimizer", "initializer", "regularizer",
+           "io", "core", "backward", "Executor", "Program",
+           "default_main_program", "default_startup_program",
+           "program_guard", "ParamAttr", "CPUPlace", "CUDAPlace",
+           "CUDAPinnedPlace", "enable_static", "disable_static",
+           "in_dygraph_mode", "scope_guard", "global_scope"]
+
+
+class _Scope:
+    """fluid.global_scope() compatibility: variables resolve against the
+    default main program (the executor owns real state)."""
+
+    def find_var(self, name):
+        prog = default_main_program()
+        var = prog.var_lookup.get(name) if hasattr(prog, "var_lookup") \
+            else None
+        if var is None:
+            for v in getattr(prog, "all_parameters", lambda: [])():
+                if getattr(v, "name", None) == name:
+                    var = v
+                    break
+        if var is None:
+            return None
+
+        class _VarView:
+            def __init__(self, t):
+                self._t = t
+
+            def get_tensor(self):
+                import numpy as np
+
+                return np.asarray(self._t._value)
+        return _VarView(var)
+
+
+_scope = _Scope()
+
+
+def global_scope():
+    return _scope
+
+
+def scope_guard(scope):
+    import contextlib
+
+    @contextlib.contextmanager
+    def _noop():
+        yield scope
+    return _noop()

@@ -1,0 +1,21 @@
+"""fluid.backward (reference: python/paddle/fluid/backward.py —
+append_backward/gradients over the static program)."""
+from __future__ import annotations
+
+from ..static import gradients  # noqa: F401
+
+__all__ = ["gradients", "append_backward"]
+
+
+def append_backward(loss, parameter_list=None, no_grad_set=None,
+                    callbacks=None):
+    """Era API: register the backward in the program; the modern Executor
+    derives gradients at run time, so this records intent and returns the
+    (param, grad-placeholder) pairs the era API promised."""
+    from ..static.program import default_main_program
+
+    prog = default_main_program()
+    params = parameter_list or prog.all_parameters()
+    prog.backward_records = getattr(prog, "backward_records", [])
+    prog.backward_records.append((loss, [p for p in params]))
+    return [(p, None) for p in params]

@@ -1,0 +1,26 @@
+"""fluid.core shim (reference: the pybind C++ module paddle.fluid.core).
+Only the symbols reference-era python scripts actually touch: places and
+device counts. Everything else of core lives behind the modern API."""
+from __future__ import annotations
+
+from .. import CPUPlace, CUDAPlace, CUDAPinnedPlace  # noqa: F401
+
+__all__ = ["CPUPlace", "CUDAPlace", "CUDAPinnedPlace",
+           "get_cuda_device_count", "is_compiled_with_cuda"]
+
+
+def is_compiled_with_cuda():
+    return False  # TPU build
+
+
+def get_cuda_device_count():
+    return 0
+
+
+def get_tpu_device_count():
+    import jax
+
+    try:
+        return jax.device_count()
+    except Exception:  # noqa: BLE001 — no backend reachable
+        return 0

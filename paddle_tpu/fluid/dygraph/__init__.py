@@ -1,0 +1,156 @@
+"""fluid.dygraph — the 1.x eager API (reference:
+python/paddle/fluid/dygraph/: guard/to_variable + the era's layer
+classes whose constructors take explicit input dims)."""
+from __future__ import annotations
+
+import contextlib
+
+import numpy as np
+
+from ... import nn as _nn
+from ...core.tensor import Tensor
+from ...framework import mode as _mode
+
+__all__ = ["guard", "to_variable", "no_grad", "Layer", "Linear",
+           "Conv2D", "Pool2D", "BatchNorm", "Embedding", "LayerList",
+           "Sequential", "save_dygraph", "load_dygraph"]
+
+Layer = _nn.Layer
+LayerList = _nn.LayerList
+Sequential = _nn.Sequential
+
+
+@contextlib.contextmanager
+def guard(place=None):
+    """Run a block in dygraph mode (reference dygraph/base.py guard)."""
+    was_static = not _mode.in_dynamic_mode()
+    if was_static:
+        from ... import disable_static
+
+        disable_static()
+    try:
+        yield
+    finally:
+        if was_static:
+            from ... import enable_static
+
+            enable_static()
+
+
+def to_variable(value, name=None, zero_copy=None, dtype=None):
+    """ndarray -> Tensor (reference dygraph/base.py to_variable)."""
+    if isinstance(value, Tensor):
+        return value
+    arr = np.asarray(value)
+    t = Tensor(arr if dtype is None else arr.astype(dtype))
+    t.stop_gradient = True
+    return t
+
+
+def no_grad(fn=None):
+    from ... import no_grad as _ng
+
+    return _ng() if fn is None else _ng()(fn)
+
+
+class Linear(_nn.Linear):
+    """Era signature: Linear(input_dim, output_dim, param_attr=,
+    bias_attr=, act=) (reference dygraph/nn.py)."""
+
+    def __init__(self, input_dim, output_dim, param_attr=None,
+                 bias_attr=None, act=None, dtype="float32"):
+        super().__init__(input_dim, output_dim, weight_attr=param_attr,
+                         bias_attr=bias_attr)
+        self._act = act
+
+    def forward(self, x):
+        out = super().forward(x)
+        return getattr(_nn.functional, self._act)(out) if self._act else out
+
+
+class Conv2D(_nn.Conv2D):
+    """Era signature: Conv2D(num_channels, num_filters, filter_size, ...)"""
+
+    def __init__(self, num_channels, num_filters, filter_size, stride=1,
+                 padding=0, dilation=1, groups=1, param_attr=None,
+                 bias_attr=None, act=None, dtype="float32"):
+        super().__init__(num_channels, num_filters, filter_size,
+                         stride=stride, padding=padding, dilation=dilation,
+                         groups=groups, weight_attr=param_attr,
+                         bias_attr=bias_attr)
+        self._act = act
+
+    def forward(self, x):
+        out = super().forward(x)
+        return getattr(_nn.functional, self._act)(out) if self._act else out
+
+
+class Pool2D(_nn.Layer):
+    """Era pooling layer (reference dygraph/nn.py Pool2D)."""
+
+    def __init__(self, pool_size=-1, pool_type="max", pool_stride=1,
+                 pool_padding=0, global_pooling=False, ceil_mode=False):
+        super().__init__()
+        self._size = pool_size
+        self._type = pool_type
+        self._stride = pool_stride
+        self._padding = pool_padding
+        self._global = global_pooling
+        self._ceil = ceil_mode
+
+    def forward(self, x):
+        import paddle_tpu.nn.functional as F
+
+        if self._global:
+            return (F.adaptive_max_pool2d if self._type == "max"
+                    else F.adaptive_avg_pool2d)(x, 1)
+        fn = F.max_pool2d if self._type == "max" else F.avg_pool2d
+        return fn(x, self._size, stride=self._stride,
+                  padding=self._padding, ceil_mode=self._ceil)
+
+
+class BatchNorm(_nn.BatchNorm2D):
+    """Era signature: BatchNorm(num_channels, act=None, ...)"""
+
+    def __init__(self, num_channels, act=None, momentum=0.9,
+                 epsilon=1e-5, param_attr=None, bias_attr=None,
+                 dtype="float32", data_layout="NCHW", is_test=False):
+        super().__init__(num_channels, momentum=momentum, epsilon=epsilon,
+                         weight_attr=param_attr, bias_attr=bias_attr)
+        self._act = act
+
+    def forward(self, x):
+        out = super().forward(x)
+        return getattr(_nn.functional, self._act)(out) if self._act else out
+
+
+class Embedding(_nn.Embedding):
+    """Era signature: Embedding(size=[vocab, dim], ...)"""
+
+    def __init__(self, size, is_sparse=False, is_distributed=False,
+                 padding_idx=None, param_attr=None, dtype="float32"):
+        super().__init__(size[0], size[1], padding_idx=padding_idx,
+                         sparse=is_sparse, weight_attr=param_attr)
+
+
+def save_dygraph(state_dict, model_path):
+    """reference dygraph/checkpoint.py: appends .pdparams/.pdopt."""
+    from ...framework.io import save
+
+    suffix = ".pdopt" if any(
+        not hasattr(v, "ndim") for v in state_dict.values()) and \
+        "global_step" in state_dict else ".pdparams"
+    save(state_dict, model_path + suffix)
+
+
+def load_dygraph(model_path):
+    """-> (param_dict or None, opt_dict or None)."""
+    import os
+
+    from ...framework.io import load
+
+    params = load(model_path + ".pdparams") \
+        if os.path.exists(model_path + ".pdparams") else None
+    opt = load(model_path + ".pdopt") \
+        if os.path.exists(model_path + ".pdopt") else None
+    return params, opt

@@ -1,0 +1,20 @@
+"""fluid.initializer — era aliases (reference:
+python/paddle/fluid/initializer.py: *Initializer names for what modern
+code calls nn.initializer.*)."""
+from __future__ import annotations
+
+from ..nn import initializer as _init
+
+__all__ = ["Constant", "ConstantInitializer", "Normal",
+           "NormalInitializer", "TruncatedNormal",
+           "TruncatedNormalInitializer", "Uniform", "UniformInitializer",
+           "Xavier", "XavierInitializer", "MSRA", "MSRAInitializer",
+           "set_global_initializer"]
+
+Constant = ConstantInitializer = _init.Constant
+Normal = NormalInitializer = _init.Normal
+TruncatedNormal = TruncatedNormalInitializer = _init.TruncatedNormal
+Uniform = UniformInitializer = _init.Uniform
+Xavier = XavierInitializer = _init.XavierNormal
+MSRA = MSRAInitializer = _init.KaimingNormal
+set_global_initializer = _init.set_global_initializer

@@ -1,0 +1,39 @@
+"""fluid.io — era parameter persistence (reference:
+python/paddle/fluid/io.py save_params/load_params: per-program parameter
+snapshots an Executor can reload)."""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+__all__ = ["save_params", "load_params", "save_persistables",
+           "load_persistables"]
+
+
+def _prog(main_program):
+    from ..static.program import default_main_program
+
+    return main_program or default_main_program()
+
+
+def save_params(executor, dirname, main_program=None, filename=None):
+    prog = _prog(main_program)
+    os.makedirs(dirname, exist_ok=True)
+    blob = {p.name: np.asarray(p._value) for p in prog.all_parameters()}
+    np.savez(os.path.join(dirname, filename or "params.npz"), **blob)
+
+
+def load_params(executor, dirname, main_program=None, filename=None):
+    import jax.numpy as jnp
+
+    prog = _prog(main_program)
+    path = os.path.join(dirname, filename or "params.npz")
+    blob = np.load(path)
+    for p in prog.all_parameters():
+        if p.name in blob:
+            p._value = jnp.asarray(blob[p.name]).astype(p._value.dtype)
+
+
+save_persistables = save_params
+load_persistables = load_params

@@ -1,0 +1,236 @@
+"""fluid.layers — the 1.x workhorse op namespace (reference:
+python/paddle/fluid/layers/nn.py, 15k lines of ops; this shim restores
+the ~40 entry points reference-era scripts actually call, delegating to
+the modern static.nn / nn.functional / tensor implementations).
+
+Era conventions preserved:
+  * `data(shape=[...])` prepends the implicit batch dim (-1) unless
+    append_batch_size=False;
+  * `cross_entropy(input, label)` takes POST-SOFTMAX probabilities
+    (pair it with fc(act='softmax'), as the era's MNIST does);
+  * ops accept `act=` and apply the activation inline.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ... import nn as _nn
+from ... import tensor as _T
+from ...static import nn as _snn
+from ...static.program import data as _static_data
+from ...static.nn import (  # noqa: F401
+    batch_norm, conv2d, conv2d_transpose, conv3d, embedding, fc,
+    layer_norm, cond, while_loop, case, switch_case, py_func,
+)
+
+__all__ = ["data", "fc", "conv2d", "pool2d", "batch_norm", "embedding",
+           "cross_entropy", "softmax_with_cross_entropy", "mean",
+           "accuracy", "relu", "softmax", "sigmoid", "tanh", "dropout",
+           "concat", "reshape", "transpose", "matmul", "elementwise_add",
+           "elementwise_sub", "elementwise_mul", "elementwise_div",
+           "reduce_mean", "reduce_sum", "reduce_max", "fill_constant",
+           "cast", "create_parameter", "create_global_var", "scale",
+           "flatten", "squeeze", "unsqueeze", "topk", "argmax", "assign",
+           "zeros", "ones", "cond", "while_loop", "case", "switch_case"]
+
+
+def data(name, shape, append_batch_size=True, dtype="float32",
+         lod_level=0, **kw):
+    """fluid.layers.data (reference fluid/layers/io.py): unlike
+    static.data, the batch dim is implicit."""
+    shape = list(shape)
+    if append_batch_size and (not shape or shape[0] != -1):
+        shape = [-1] + shape
+    return _static_data(name, shape, dtype)
+
+
+def pool2d(input, pool_size=-1, pool_type="max", pool_stride=1,  # noqa: A002
+           pool_padding=0, global_pooling=False, ceil_mode=False,
+           name=None, data_format="NCHW"):
+    import paddle_tpu.nn.functional as F
+
+    if global_pooling:
+        return (F.adaptive_max_pool2d if pool_type == "max"
+                else F.adaptive_avg_pool2d)(input, 1)
+    fn = F.max_pool2d if pool_type == "max" else F.avg_pool2d
+    return fn(input, pool_size, stride=pool_stride, padding=pool_padding,
+              ceil_mode=ceil_mode)
+
+
+def cross_entropy(input, label, soft_label=False, ignore_index=-100):  # noqa: A002
+    """Era contract: `input` is post-softmax probabilities
+    (reference fluid/layers/loss.py cross_entropy)."""
+    import paddle_tpu.nn.functional as F
+
+    logp = _T.log(_T.clip(input, 1e-12, 1.0))
+    return F.nll_loss(logp, _T.squeeze(label, -1) if label.ndim ==
+                      input.ndim else label, reduction="none")
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False,
+                               ignore_index=-100, axis=-1,
+                               return_softmax=False):
+    import paddle_tpu.nn.functional as F
+
+    loss = F.cross_entropy(logits, label, soft_label=soft_label,
+                           reduction="none")
+    loss = _T.unsqueeze(loss, -1) if loss.ndim < label.ndim else loss
+    if return_softmax:
+        return loss, F.softmax(logits, axis=axis)
+    return loss
+
+
+def mean(x, name=None):
+    return _T.mean(x)
+
+
+def accuracy(input, label, k=1, **kw):  # noqa: A002
+    from ...metric import accuracy as _acc
+
+    return _acc(input, label, k=k)
+
+
+def relu(x, name=None):
+    return _nn.functional.relu(x)
+
+
+def softmax(x, axis=-1, name=None):
+    return _nn.functional.softmax(x, axis=axis)
+
+
+def sigmoid(x, name=None):
+    return _nn.functional.sigmoid(x)
+
+
+def tanh(x, name=None):
+    return _T.tanh(x)
+
+
+def dropout(x, dropout_prob=0.5, is_test=False, name=None, **kw):
+    return _nn.functional.dropout(x, p=dropout_prob, training=not is_test)
+
+
+def concat(input, axis=0, name=None):  # noqa: A002
+    return _T.concat(input, axis=axis)
+
+
+def reshape(x, shape, name=None, **kw):
+    return _T.reshape(x, shape)
+
+
+def transpose(x, perm, name=None):
+    return _T.transpose(x, perm)
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, alpha=1.0,
+           name=None):
+    out = _T.matmul(x, y, transpose_x=transpose_x,
+                    transpose_y=transpose_y)
+    return out if alpha == 1.0 else out * alpha
+
+
+def elementwise_add(x, y, axis=-1, act=None, name=None):
+    return _maybe_act(x + y, act)
+
+
+def elementwise_sub(x, y, axis=-1, act=None, name=None):
+    return _maybe_act(x - y, act)
+
+
+def elementwise_mul(x, y, axis=-1, act=None, name=None):
+    return _maybe_act(x * y, act)
+
+
+def elementwise_div(x, y, axis=-1, act=None, name=None):
+    return _maybe_act(x / y, act)
+
+
+def _maybe_act(out, act):
+    return getattr(_nn.functional, act)(out) if act else out
+
+
+def reduce_mean(input, dim=None, keep_dim=False, name=None):  # noqa: A002
+    return _T.mean(input, axis=dim, keepdim=keep_dim)
+
+
+def reduce_sum(input, dim=None, keep_dim=False, name=None):  # noqa: A002
+    return _T.sum(input, axis=dim, keepdim=keep_dim)
+
+
+def reduce_max(input, dim=None, keep_dim=False, name=None):  # noqa: A002
+    return _T.max(input, axis=dim, keepdim=keep_dim)
+
+
+def fill_constant(shape, dtype, value, name=None, out=None):
+    return _T.full(shape, value, dtype=dtype)
+
+
+def cast(x, dtype):
+    return _T.cast(x, dtype)
+
+
+def create_parameter(shape, dtype, name=None, attr=None,
+                     is_bias=False, default_initializer=None):
+    from ... import create_parameter as _cp
+
+    return _cp(shape, dtype, name=name, attr=attr, is_bias=is_bias,
+               default_initializer=default_initializer)
+
+
+def create_global_var(shape, value, dtype, persistable=False,
+                      force_cpu=False, name=None):
+    v = _T.full(shape, value, dtype=dtype)
+    v.persistable = persistable
+    return v
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None,  # noqa: A002
+          name=None):
+    out = x * scale + bias if bias_after_scale else (x + bias) * scale
+    return _maybe_act(out, act)
+
+
+def flatten(x, axis=1, name=None):
+    b = 1
+    for s in x.shape[:axis]:
+        b *= s if s > 0 else 1
+    return _T.reshape(x, [b if b > 0 else -1, -1]) if axis else \
+        _T.reshape(x, [1, -1])
+
+
+def squeeze(input, axes=None, name=None):  # noqa: A002
+    return _T.squeeze(input, axes)
+
+
+def unsqueeze(input, axes, name=None):  # noqa: A002
+    axes = axes if isinstance(axes, (list, tuple)) else [axes]
+    out = input
+    for a in axes:
+        out = _T.unsqueeze(out, a)
+    return out
+
+
+def topk(input, k, name=None):  # noqa: A002
+    return _T.topk(input, k)
+
+
+def argmax(x, axis=0, name=None):
+    return _T.argmax(x, axis=axis)
+
+
+def assign(input, output=None):  # noqa: A002
+    from ...core.tensor import Tensor
+
+    val = input if isinstance(input, Tensor) else Tensor(np.asarray(input))
+    if output is not None:
+        output._value = val._value
+        return output
+    return _T.clone(val)
+
+
+def zeros(shape, dtype="float32", name=None):
+    return _T.zeros(shape, dtype=dtype)
+
+
+def ones(shape, dtype="float32", name=None):
+    return _T.ones(shape, dtype=dtype)

@@ -1,0 +1,79 @@
+"""fluid.optimizer — era names and kwargs (reference:
+python/paddle/fluid/optimizer.py: *Optimizer classes taking
+parameter_list= and regularization=)."""
+from __future__ import annotations
+
+from .. import optimizer as _opt
+
+__all__ = ["SGD", "SGDOptimizer", "Momentum", "MomentumOptimizer",
+           "Adam", "AdamOptimizer", "Adagrad", "AdagradOptimizer",
+           "Lamb", "LarsMomentum", "LarsMomentumOptimizer"]
+
+
+def _modernize(kw):
+    if "parameter_list" in kw:
+        kw["parameters"] = kw.pop("parameter_list")
+    if "regularization" in kw:
+        kw["weight_decay"] = kw.pop("regularization")
+    return kw
+
+
+class _FluidMinimize:
+    """Era dygraph idiom: `loss.backward(); opt.minimize(loss)` —
+    minimize COLLECTS the already-computed grads and applies them
+    (reference fluid/optimizer.py dygraph branch does not re-run
+    autodiff). The modern minimize re-runs backward, which would hit
+    the freed graph. Static mode keeps the modern program-recording
+    path."""
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        from ..framework.mode import in_dynamic_mode
+
+        if not in_dynamic_mode():
+            return super().minimize(loss, startup_program, parameters,
+                                    no_grad_set)
+        if all(p._grad is None for p in self._param_list):
+            loss.backward()  # era scripts that skip explicit backward
+        self.step()
+        return None, [(p, p.grad) for p in self._param_list]
+
+
+class SGDOptimizer(_FluidMinimize, _opt.SGD):
+    def __init__(self, learning_rate=0.001, **kw):
+        super().__init__(learning_rate=learning_rate, **_modernize(kw))
+
+
+class MomentumOptimizer(_FluidMinimize, _opt.Momentum):
+    def __init__(self, learning_rate=0.001, momentum=0.9, **kw):
+        super().__init__(learning_rate=learning_rate, momentum=momentum,
+                         **_modernize(kw))
+
+
+class AdamOptimizer(_FluidMinimize, _opt.Adam):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, **kw):
+        super().__init__(learning_rate=learning_rate, beta1=beta1,
+                         beta2=beta2, epsilon=epsilon, **_modernize(kw))
+
+
+class AdagradOptimizer(_FluidMinimize, _opt.Adagrad):
+    def __init__(self, learning_rate, epsilon=1e-6, **kw):
+        super().__init__(learning_rate, epsilon=epsilon, **_modernize(kw))
+
+
+class LarsMomentumOptimizer(_FluidMinimize, _opt.Lars):
+    def __init__(self, learning_rate=0.001, momentum=0.9,
+                 lars_coeff=0.001, lars_weight_decay=0.0005, **kw):
+        super().__init__(learning_rate=learning_rate, momentum=momentum,
+                         lars_coeff=lars_coeff,
+                         lars_weight_decay=lars_weight_decay,
+                         **_modernize(kw))
+
+
+SGD = SGDOptimizer
+Momentum = MomentumOptimizer
+Adam = AdamOptimizer
+Adagrad = AdagradOptimizer
+Lamb = _opt.Lamb
+LarsMomentum = LarsMomentumOptimizer

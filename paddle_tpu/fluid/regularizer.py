@@ -1,0 +1,11 @@
+"""fluid.regularizer — era aliases (reference:
+python/paddle/fluid/regularizer.py)."""
+from __future__ import annotations
+
+from ..regularizer import L1Decay, L2Decay  # noqa: F401
+
+__all__ = ["L1Decay", "L2Decay", "L1DecayRegularizer",
+           "L2DecayRegularizer"]
+
+L1DecayRegularizer = L1Decay
+L2DecayRegularizer = L2Decay

@@ -99,16 +99,20 @@ class _TrainEpochRange:
         saved_opts = sorted(
             f for f in os.listdir(state_dir) if f.endswith(".pdopt")) \
             if os.path.isdir(state_dir) else []
-        if (saved_models and not _attached["models"]) or \
-                (saved_opts and not _attached["optimizers"]):
-            # skipping epochs while leaving fresh-init weights in place
-            # would silently train garbage — refuse instead
+        if (saved_models and len(saved_models) != len(_attached["models"]))\
+                or (saved_opts
+                    and len(saved_opts) != len(_attached["optimizers"])):
+            # skipping epochs while leaving ANY fresh-init state in place
+            # would silently train garbage — refuse on count mismatch,
+            # not just on nothing-attached
             raise RuntimeError(
                 f"checkpoint at {state_dir} holds "
                 f"{len(saved_models)} model / {len(saved_opts)} optimizer "
-                "states but nothing is attached to restore them into; "
-                "call incubate.checkpoint.auto_checkpoint.attach(models=, "
-                "optimizers=) BEFORE train_epoch_range")
+                f"states but {len(_attached['models'])} model / "
+                f"{len(_attached['optimizers'])} optimizer objects are "
+                "attached; call incubate.checkpoint.auto_checkpoint."
+                "attach(models=, optimizers=) with the same objects as "
+                "the run that saved, BEFORE train_epoch_range")
         self._next_epoch = int(meta.get("epoch_done", -1)) + 1
         for i, m in enumerate(_attached["models"]):
             p = os.path.join(state_dir, f"model_{i}.pdparams")

@@ -27,7 +27,7 @@ def _supported_check():
     return True
 
 
-def _rebuild_tensor_shm(shm_name, shape, dtype_str):
+def _rebuild_tensor_shm(shm_name, shape, dtype):
     from ...core.tensor import Tensor
 
     try:
@@ -39,8 +39,7 @@ def _rebuild_tensor_shm(shm_name, shape, dtype_str):
             "deserialization frees the segment); deserializing the same "
             "bytes twice is not supported") from None
     try:
-        view = np.ndarray(shape, dtype=np.dtype(dtype_str),
-                          buffer=shm.buf)
+        view = np.ndarray(shape, dtype=dtype, buffer=shm.buf)
         arr = np.array(view)  # own copy; the block is freed below
     finally:
         shm.close()
@@ -83,14 +82,20 @@ def reduce_tensor(t):
         resource_tracker.unregister(shm._name, "shared_memory")
     finally:
         shm.close()  # producer unmaps; consumer unlinks
-    return (_rebuild_tensor_shm, (name, arr.shape, arr.dtype.str))
+    # ship the dtype OBJECT: .str is lossy for extension dtypes ('<V2'
+    # for bfloat16 — the primary dtype on this platform)
+    return (_rebuild_tensor_shm, (name, arr.shape, arr.dtype))
 
 
 def init_reductions():
     """Register the Tensor reduction with multiprocessing's pickler
-    (reference reductions.py:182)."""
+    (reference reductions.py:182). Pickle reducer dispatch is
+    exact-type, so every Tensor subclass that crosses process
+    boundaries (Parameter — the common large payload) registers too."""
     if not _supported_check():
         return
     from ...core.tensor import Tensor
+    from ...nn.layer.layers import Parameter
 
     ForkingPickler.register(Tensor, reduce_tensor)
+    ForkingPickler.register(Parameter, reduce_tensor)

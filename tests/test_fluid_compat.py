@@ -1,0 +1,170 @@
+"""paddle.fluid compat shim: reference-era scripts run unmodified
+(round-3 verdict #7).
+
+The two tests below are written in the idiom of the reference's own
+book/tutorial MNIST scripts (fluid/__init__.py era): fluid.layers.* +
+Executor for static, fluid.dygraph.guard/to_variable for eager.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.fluid as fluid
+
+
+def _mnist_batch(rng, n=32):
+    img = rng.randn(n, 1, 28, 28).astype("float32")
+    label = rng.randint(0, 10, (n, 1)).astype("int64")
+    return img, label
+
+
+def test_fluid_static_mnist_script():
+    """The era's static MNIST: layers.data -> fc(softmax) ->
+    cross_entropy -> SGD.minimize -> Executor.run feed/fetch loop."""
+    paddle.enable_static()
+    try:
+        main = fluid.Program()
+        startup = fluid.Program()
+        with fluid.program_guard(main, startup):
+            img = fluid.layers.data(name="img", shape=[1, 28, 28],
+                                    dtype="float32")
+            label = fluid.layers.data(name="label", shape=[1],
+                                      dtype="int64")
+            hidden = fluid.layers.fc(img, size=64, activation="relu")
+            prediction = fluid.layers.fc(hidden, size=10,
+                                         activation="softmax")
+            loss = fluid.layers.mean(
+                fluid.layers.cross_entropy(input=prediction, label=label))
+            acc = fluid.layers.accuracy(input=prediction, label=label)
+            fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+
+        exe = fluid.Executor(fluid.CPUPlace())
+        rng = np.random.RandomState(0)
+        x, y = _mnist_batch(rng)  # one batch: loss must drop on it
+        losses = []
+        for _ in range(8):
+            lv, av = exe.run(main, feed={"img": x, "label": y},
+                             fetch_list=[loss, acc])
+            losses.append(float(lv))
+        assert losses[-1] < losses[0], losses
+        assert np.isfinite(losses).all()
+    finally:
+        paddle.disable_static()
+
+
+def test_fluid_static_save_load_params(tmp_path):
+    paddle.enable_static()
+    try:
+        main = fluid.Program()
+        with fluid.program_guard(main):
+            x = fluid.layers.data(name="x", shape=[8], dtype="float32")
+            y = fluid.layers.fc(x, size=4)
+            loss = fluid.layers.reduce_mean(y * y)
+            fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+        exe = fluid.Executor(fluid.CPUPlace())
+        rng = np.random.RandomState(0)
+        exe.run(main, feed={"x": rng.randn(4, 8).astype("float32")},
+                fetch_list=[loss])
+        fluid.io.save_params(exe, str(tmp_path), main_program=main)
+        before = {p.name: np.asarray(p._value).copy()
+                  for p in main.all_parameters()}
+        for p in main.all_parameters():  # clobber
+            p._value = p._value * 0.0
+        fluid.io.load_params(exe, str(tmp_path), main_program=main)
+        for p in main.all_parameters():
+            np.testing.assert_array_equal(np.asarray(p._value),
+                                          before[p.name])
+    finally:
+        paddle.disable_static()
+
+
+def test_fluid_dygraph_mnist_script():
+    """The era's dygraph MNIST: guard + to_variable + dygraph layer
+    classes (explicit input dims) + AdamOptimizer(parameter_list=)."""
+    with fluid.dygraph.guard():
+        paddle.seed(0)
+
+        class MNIST(fluid.dygraph.Layer):
+            def __init__(self):
+                super().__init__()
+                self.conv = fluid.dygraph.Conv2D(1, 8, 3, padding=1,
+                                                 act="relu")
+                self.pool = fluid.dygraph.Pool2D(2, "max", 2)
+                self.fc = fluid.dygraph.Linear(8 * 14 * 14, 10,
+                                               act="softmax")
+
+            def forward(self, x):
+                x = self.pool(self.conv(x))
+                return self.fc(fluid.layers.reshape(x, [x.shape[0], -1]))
+
+        model = MNIST()
+        opt = fluid.optimizer.AdamOptimizer(
+            learning_rate=1e-3, parameter_list=model.parameters())
+        rng = np.random.RandomState(1)
+        x, y = _mnist_batch(rng)  # one batch: loss must drop on it
+        losses = []
+        for _ in range(6):
+            img = fluid.dygraph.to_variable(x)
+            label = fluid.dygraph.to_variable(y)
+            prediction = model(img)
+            loss = fluid.layers.mean(
+                fluid.layers.cross_entropy(prediction, label))
+            loss.backward()
+            opt.minimize(loss)
+            model.clear_gradients()
+            losses.append(float(loss))
+        assert losses[-1] < losses[0], losses
+
+
+def test_fluid_dygraph_save_load(tmp_path):
+    with fluid.dygraph.guard():
+        paddle.seed(0)
+        lin = fluid.dygraph.Linear(4, 4)
+        path = str(tmp_path / "model")
+        fluid.dygraph.save_dygraph(lin.state_dict(), path)
+        params, opt_state = fluid.dygraph.load_dygraph(path)
+        assert params is not None and opt_state is None
+        lin2 = fluid.dygraph.Linear(4, 4)
+        lin2.set_state_dict(params)
+        np.testing.assert_array_equal(lin2.weight.numpy(),
+                                      lin.weight.numpy())
+
+
+def test_fluid_layers_misc_ops():
+    with fluid.dygraph.guard():
+        a = fluid.layers.ones([2, 3])
+        b = fluid.layers.fill_constant([2, 3], "float32", 2.0)
+        c = fluid.layers.elementwise_add(a, b, act="relu")
+        np.testing.assert_array_equal(c.numpy(), np.full((2, 3), 3.0))
+        m = fluid.layers.matmul(a, fluid.layers.transpose(b, [1, 0]))
+        assert m.shape == [2, 2]
+        s = fluid.layers.reduce_sum(m)
+        assert float(s) == 2.0 * 3 * 2 * 2
+        lo = fluid.layers.softmax_with_cross_entropy(
+            fluid.layers.ones([4, 10]),
+            fluid.dygraph.to_variable(np.zeros((4, 1), np.int64)))
+        assert lo.shape[0] == 4
+        v, idx = fluid.layers.topk(b, 2)
+        assert v.shape == [2, 2]
+        z = fluid.layers.cast(fluid.layers.zeros([2]), "int64")
+        assert "int" in str(z.dtype)
+
+
+def test_fluid_dygraph_guard_restores_static():
+    paddle.enable_static()
+    try:
+        with fluid.dygraph.guard():
+            assert paddle.in_dynamic_mode()
+            t = fluid.dygraph.to_variable(np.ones(3, np.float32))
+            assert float(fluid.layers.reduce_sum(t)) == 3.0
+        assert not paddle.in_dynamic_mode()  # guard restored static
+    finally:
+        paddle.disable_static()
+
+
+def test_fluid_core_and_places():
+    assert not fluid.core.is_compiled_with_cuda()
+    assert fluid.core.get_cuda_device_count() == 0
+    assert fluid.CPUPlace is not None
+    assert fluid.initializer.Xavier is not None
+    assert fluid.regularizer.L2Decay(1e-4).coeff == pytest.approx(1e-4)

@@ -203,3 +203,46 @@ def test_incubate_multiprocessing_is_opt_in():
     out = subprocess.run([sys.executable, "-c", code], timeout=180,
                          capture_output=True, text=True)
     assert "OPT-IN-OK" in out.stdout, out.stderr[-500:]
+
+
+def test_mp_bf16_and_parameter_round_trip():
+    """bf16 (extension dtype) and Parameter (Tensor subclass) payloads
+    survive the shm reduction."""
+    import pickle
+
+    from multiprocessing.reduction import ForkingPickler
+
+    import paddle_tpu.incubate.multiprocessing  # noqa: F401
+    from paddle_tpu import nn
+
+    bf = paddle.cast(paddle.to_tensor(
+        np.random.RandomState(0).randn(64, 64).astype(np.float32)),
+        "bfloat16")
+    out = pickle.loads(ForkingPickler.dumps(bf))
+    assert "bfloat16" in str(out.dtype)
+    np.testing.assert_array_equal(
+        np.asarray(out.numpy(), np.float32),
+        np.asarray(bf.numpy(), np.float32))
+    paddle.seed(0)
+    w = nn.Linear(64, 64).weight  # Parameter subclass, >4KB
+    out2 = pickle.loads(ForkingPickler.dumps(w))
+    np.testing.assert_array_equal(out2.numpy(), w.numpy())
+
+
+def test_restore_refuses_count_mismatch(tmp_path, monkeypatch):
+    monkeypatch.setenv("PADDLE_CHECKPOINT_DIR", str(tmp_path))
+    paddle.seed(0)
+    m1, m2 = nn.Linear(4, 4), nn.Linear(4, 4)
+    opt = paddle.optimizer.SGD(
+        learning_rate=0.1,
+        parameters=list(m1.parameters()) + list(m2.parameters()))
+    acp.attach(models=[m1, m2], optimizers=opt)
+    x = paddle.randn([2, 4])
+    for epoch in acp.train_epoch_range(3, name="pair"):
+        loss = (m2(m1(x)) ** 2).mean()
+        loss.backward(); opt.step(); opt.clear_grad()
+        if epoch == 1:
+            break
+    acp.attach(models=[m1], optimizers=opt)  # partial re-attach
+    with pytest.raises(RuntimeError, match="attach"):
+        acp.train_epoch_range(3, name="pair")

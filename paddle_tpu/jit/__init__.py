@@ -72,19 +72,18 @@ class StaticFunction:
         def fn(param_vals, buf_vals, key, *arg_vals):
             with rnd.key_scope(key), _ag.no_grad():
                 if layer is not None:
-                    prev = [l.training for l in
-                            layer.sublayers(include_self=True)]
-                    for l in layer.sublayers(include_self=True):
-                        l.training = training
-                    try:
+                    # scoped override, not live flag mutation: this fn is
+                    # traced under jax.jit, where a re-entrant trace would
+                    # observe half-restored flags (same fix as hapi's
+                    # _forward_loss)
+                    from ..nn.layer.layers import training_mode
+
+                    with training_mode(training,
+                                       layer.sublayers(include_self=True)):
                         out, new_bufs = layer.functional_call(
                             {k: Tensor(v) for k, v in
                              {**param_vals, **buf_vals}.items()},
                             *[Tensor(a) for a in arg_vals])
-                    finally:
-                        for l, t in zip(layer.sublayers(include_self=True),
-                                        prev):
-                            l.training = t
                 else:
                     out = target(*[Tensor(a) for a in arg_vals])
                     new_bufs = {}

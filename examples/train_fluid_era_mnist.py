@@ -1,0 +1,79 @@
+"""A reference-era (1.x) fluid script running unmodified on paddle_tpu.
+
+Demonstrates the compat namespace: static Program + Executor and the
+dygraph guard/to_variable idiom, both through `paddle_tpu.fluid`.
+Run: python examples/train_fluid_era_mnist.py
+"""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.fluid as fluid
+
+
+def synth_mnist(n=256, seed=0):
+    rng = np.random.RandomState(seed)
+    return (rng.randn(n, 1, 28, 28).astype("float32"),
+            rng.randint(0, 10, (n, 1)).astype("int64"))
+
+
+def static_mnist():
+    paddle.enable_static()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data(name="img", shape=[1, 28, 28],
+                                dtype="float32")
+        label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+        hidden = fluid.layers.fc(img, size=128, activation="relu")
+        pred = fluid.layers.fc(hidden, size=10, activation="softmax")
+        loss = fluid.layers.mean(fluid.layers.cross_entropy(pred, label))
+        acc = fluid.layers.accuracy(input=pred, label=label)
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    x, y = synth_mnist()
+    for step in range(10):
+        lv, av = exe.run(main, feed={"img": x, "label": y},
+                         fetch_list=[loss, acc])
+        if step % 3 == 0:
+            print(f"[static] step {step} loss {float(lv):.4f} "
+                  f"acc {float(np.asarray(av).ravel()[0]):.3f}")
+    paddle.disable_static()
+
+
+def dygraph_mnist():
+    with fluid.dygraph.guard():
+        paddle.seed(0)
+
+        class MNIST(fluid.dygraph.Layer):
+            def __init__(self):
+                super().__init__()
+                self.conv = fluid.dygraph.Conv2D(1, 16, 3, padding=1,
+                                                 act="relu")
+                self.pool = fluid.dygraph.Pool2D(2, "max", 2)
+                self.fc = fluid.dygraph.Linear(16 * 14 * 14, 10,
+                                               act="softmax")
+
+            def forward(self, x):
+                x = self.pool(self.conv(x))
+                return self.fc(fluid.layers.reshape(x, [x.shape[0], -1]))
+
+        model = MNIST()
+        opt = fluid.optimizer.AdamOptimizer(
+            learning_rate=1e-3, parameter_list=model.parameters())
+        x, y = synth_mnist(seed=1)
+        for step in range(10):
+            img = fluid.dygraph.to_variable(x)
+            label = fluid.dygraph.to_variable(y)
+            loss = fluid.layers.mean(
+                fluid.layers.cross_entropy(model(img), label))
+            loss.backward()
+            opt.minimize(loss)
+            model.clear_gradients()
+            if step % 3 == 0:
+                print(f"[dygraph] step {step} loss {float(loss):.4f}")
+
+
+if __name__ == "__main__":
+    static_mnist()
+    dygraph_mnist()
+    print("fluid-era script ran end-to-end on paddle_tpu")

@@ -1,0 +1,48 @@
+"""paddle._C_ops (reference: python/paddle/_C_ops.py — re-exports the
+eager C++ op table; ecosystem code calls `_C_ops.relu(x)` etc. directly).
+
+TPU-native: there is no C op table — ops ARE the python functions that
+trace to XLA. Attribute access resolves the op name against the tensor /
+nn.functional / top-level namespaces (in that order) and returns the
+callable; `final_state_<op>` aliases resolve to `<op>` (the reference's
+dual-registration naming). Ops whose reference form takes C-style
+trailing attr pairs won't match exactly — this shim covers the
+tensor-in/tensor-out calls that python code actually makes.
+"""
+from __future__ import annotations
+
+__all__ = []
+
+_NAMESPACES = None
+
+
+def _namespaces():
+    global _NAMESPACES
+    if _NAMESPACES is None:
+        import paddle_tpu as paddle
+
+        _NAMESPACES = (paddle.tensor, paddle.nn.functional, paddle)
+    return _NAMESPACES
+
+
+def __getattr__(name):
+    if name.startswith("__"):
+        raise AttributeError(name)
+    target = name
+    if name.startswith("final_state_"):
+        target = name[len("final_state_"):]
+    for ns in _namespaces():
+        fn = getattr(ns, target, None)
+        if callable(fn):
+            globals()[name] = fn  # cache: next access skips __getattr__
+            return fn
+    # common C-table suffixes: <op>_ (inplace), <op>_grad (not exposed)
+    if target.endswith("_") and not target.endswith("__"):
+        for ns in _namespaces():
+            fn = getattr(ns, target[:-1], None)
+            if callable(fn):
+                globals()[name] = fn
+                return fn
+    raise AttributeError(
+        f"_C_ops.{name}: no matching op in paddle_tpu namespaces (the "
+        "XLA build has no C op table; use the public API)")

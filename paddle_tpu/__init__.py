@@ -186,3 +186,5 @@ def check_shape(shape):
                 "list or tuple")
 
 from . import fluid  # noqa: F401,E402  (reference-era compat namespace)
+from . import compat  # noqa: F401,E402
+from . import _C_ops  # noqa: F401,E402

@@ -1,0 +1,77 @@
+"""paddle.compat (reference: python/paddle/compat.py — py2/3 string and
+arithmetic helpers that ecosystem code still imports)."""
+from __future__ import annotations
+
+import math
+
+__all__ = []
+
+
+def to_text(obj, encoding="utf-8", inplace=False):
+    """bytes (and containers of bytes) -> str (reference compat.py:25).
+    Non-string scalars (bool/float/None) pass through unchanged, as in
+    the reference — coercing them would turn `False` into a truthy
+    \"False\"."""
+    if obj is None:
+        return obj
+    if isinstance(obj, (list, set)):
+        if inplace:
+            items = [_to_text(o, encoding) for o in obj]
+            if isinstance(obj, set):
+                obj.clear()
+                obj.update(items)
+            else:
+                obj[:] = items
+            return obj
+        return type(obj)(_to_text(o, encoding) for o in obj)
+    return _to_text(obj, encoding)
+
+
+def _to_text(obj, encoding):
+    if isinstance(obj, (bytes, bytearray)):
+        return bytes(obj).decode(encoding)
+    return obj
+
+
+def to_bytes(obj, encoding="utf-8", inplace=False):
+    """str (and containers of str) -> bytes (reference compat.py:121)."""
+    if obj is None:
+        return obj
+    if isinstance(obj, (list, set)):
+        if inplace:
+            items = [_to_bytes(o, encoding) for o in obj]
+            if isinstance(obj, set):
+                obj.clear()
+                obj.update(items)
+            else:
+                obj[:] = items
+            return obj
+        return type(obj)(_to_bytes(o, encoding) for o in obj)
+    return _to_bytes(obj, encoding)
+
+
+def _to_bytes(obj, encoding):
+    if isinstance(obj, str):
+        return obj.encode(encoding)
+    return obj
+
+
+def round(x, d=0):  # noqa: A001
+    """Banker's-rounding-free round (reference compat.py:206: py2
+    semantics — halves away from zero)."""
+    p = 10 ** d
+    if x > 0:
+        return float(math.floor((x * p) + math.copysign(0.5, x))) / p
+    if x < 0:
+        return float(math.ceil((x * p) + math.copysign(0.5, x))) / p
+    return math.copysign(0.0, x)
+
+
+def floor_division(x, y):
+    """py2 integer-division semantics (reference compat.py:232)."""
+    return x // y
+
+
+def get_exception_message(exc):
+    """reference compat.py:249."""
+    return str(exc)

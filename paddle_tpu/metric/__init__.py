@@ -164,10 +164,15 @@ class Auc(Metric):
 
 
 def accuracy(input, label, k=1, correct=None, total=None, name=None):  # noqa: A002
-    p = _np(input)
-    l = _np(label)
-    if l.ndim == 2 and l.shape[-1] == 1:
-        l = l[:, 0]
-    topi = np.argsort(-p, axis=-1)[:, :k]
-    c = (topi == l[:, None]).any(-1).mean()
-    return Tensor(np.asarray(c, np.float32))
+    """Top-k accuracy as a TRACED op: numpy here would concretize at
+    static-program build time and bake the dummy-feed result into the
+    replayed computation (it fetched garbage; caught by the fluid-era
+    example)."""
+    from .. import tensor as T
+
+    lab = label
+    if lab.ndim < input.ndim:
+        lab = T.unsqueeze(lab, -1)
+    _, topi = T.topk(input, k, axis=-1)
+    hit = T.equal(T.cast(topi, "int64"), T.cast(lab, "int64"))
+    return T.mean(T.cast(T.any(hit, axis=-1), "float32"))

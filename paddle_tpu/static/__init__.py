@@ -152,18 +152,10 @@ def append_backward(loss, parameter_list=None, no_grad_set=None,
 
 
 def accuracy(input, label, k=1, correct=None, total=None):  # noqa: A002
-    import jax.numpy as jnp
+    # one public op, one behavior: delegate to the traced metric.accuracy
+    from ..metric import accuracy as _acc
 
-    from ..core.autograd import apply
-
-    def _f(pred, lab):
-        topk = jnp.argsort(-pred, axis=-1)[..., :k]
-        lab2 = lab.reshape(lab.shape[0], -1)
-        hit = (topk == lab2).any(-1)
-        return hit.mean(dtype=jnp.float32)
-
-    _f.__name__ = "accuracy"
-    return apply(_f, input, label)
+    return _acc(input, label, k=k, correct=correct, total=total)
 
 
 def auc(input, label, curve="ROC", num_thresholds=4095, topk=1,  # noqa: A002

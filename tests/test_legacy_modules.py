@@ -229,3 +229,32 @@ class TestMoEHelpers:
         prob = paddle.to_tensor(np.array([0.5, 0.9], np.float32))
         out = np.asarray(moe_utils._random_routing(idx, val, prob).numpy())
         np.testing.assert_array_equal(out, [[0, 1], [2, -1]])
+
+
+def test_paddle_compat():
+    from paddle_tpu import compat
+
+    assert compat.to_text(b"abc") == "abc"
+    assert compat.to_text(["a", b"b", True]) == ["a", "b", True]
+    assert compat.to_bytes("abc") == b"abc"
+    assert compat.round(2.5) == 3.0
+    assert compat.round(-2.5) == -3.0
+    assert compat.floor_division(7, 2) == 3
+    assert compat.get_exception_message(ValueError("boom")) == "boom"
+
+
+def test_c_ops_dispatch():
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu import _C_ops
+
+    x = paddle.to_tensor(np.array([-1.0, 2.0], np.float32))
+    np.testing.assert_array_equal(_C_ops.relu(x).numpy(), [0.0, 2.0])
+    np.testing.assert_allclose(_C_ops.final_state_tanh(x).numpy(),
+                               np.tanh([-1.0, 2.0]), rtol=1e-6)
+    assert float(_C_ops.mean(x)) == 0.5
+    import pytest
+
+    with pytest.raises(AttributeError, match="no matching op"):
+        _C_ops.definitely_not_an_op

@@ -124,3 +124,32 @@ def test_static_save_load(tmp_path):
     p.set_value(np.zeros_like(orig))
     paddle.static.load(main, path)
     np.testing.assert_allclose(np.asarray(p._value), orig)
+
+
+def test_static_accuracy_is_traced_not_baked():
+    """metric.accuracy must be a traced op: the numpy version baked the
+    dummy-feed result into the static program (fetched garbage)."""
+    import paddle_tpu.fluid as fluid
+
+    paddle.enable_static()
+    try:
+        main = paddle.static.Program()
+        with paddle.static.program_guard(main):
+            img = paddle.static.data("img", [16, 8], "float32")
+            label = paddle.static.data("label", [16, 1], "int64")
+            pred = fluid.layers.fc(img, size=4, activation="softmax")
+            acc = fluid.layers.accuracy(input=pred, label=label)
+        exe = paddle.static.Executor()
+        rng = np.random.RandomState(0)
+        x = rng.randn(16, 8).astype("float32")
+        y = rng.randint(0, 4, (16, 1)).astype("int64")
+        pv, av = exe.run(main, feed={"img": x, "label": y},
+                         fetch_list=[pred, acc])
+        manual = (np.argmax(np.asarray(pv), -1) == y[:, 0]).mean()
+        assert float(np.asarray(av).ravel()[0]) == manual
+        y2 = np.argmax(np.asarray(pv), -1)[:, None].astype("int64")
+        _, av2 = exe.run(main, feed={"img": x, "label": y2},
+                         fetch_list=[pred, acc])
+        assert float(np.asarray(av2).ravel()[0]) == 1.0
+    finally:
+        paddle.disable_static()

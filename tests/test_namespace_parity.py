@@ -48,9 +48,26 @@ def test_reference_top_level_exports_present():
     (paddle.incubate,
      "/root/reference/python/paddle/incubate/__init__.py"),
     (paddle.utils, "/root/reference/python/paddle/utils/__init__.py"),
+    (paddle.distributed,
+     "/root/reference/python/paddle/distributed/__init__.py"),
+    (paddle.distributed.fleet,
+     "/root/reference/python/paddle/distributed/fleet/__init__.py"),
+    (paddle.amp, "/root/reference/python/paddle/amp/__init__.py"),
+    (paddle.autograd,
+     "/root/reference/python/paddle/autograd/__init__.py"),
+    (paddle.device, "/root/reference/python/paddle/device/__init__.py"),
+    (paddle.text, "/root/reference/python/paddle/text/__init__.py"),
+    (paddle.vision.ops, "/root/reference/python/paddle/vision/ops.py"),
+    (paddle.signal, "/root/reference/python/paddle/signal.py"),
+    (paddle.profiler,
+     "/root/reference/python/paddle/profiler/__init__.py"),
+    (paddle.static.nn,
+     "/root/reference/python/paddle/static/nn/__init__.py"),
 ], ids=["nn", "nn.functional", "tensor", "io", "vision.datasets",
         "vision.transforms", "metric", "jit", "optimizer", "static",
-        "linalg", "fft", "distribution", "sparse", "incubate", "utils"])
+        "linalg", "fft", "distribution", "sparse", "incubate", "utils",
+        "distributed", "fleet", "amp", "autograd", "device", "text",
+        "vision.ops", "signal", "profiler", "static.nn"])
 def test_submodule_exports_present(mod, path):
     ref = _ref_exports(path)
     missing = sorted(n for n in ref if not hasattr(mod, n))

@@ -515,7 +515,9 @@ import sys as _sys
 
 fleet = _sys.modules[__name__]
 
-from .. import mp_layers  # noqa: F401,E402  (fleet.meta_parallel surface)
+from .. import mp_layers  # noqa: F401,E402
+from . import meta_optimizers  # noqa: E402,F401
+from . import meta_parallel  # noqa: E402,F401
 from ..mp_layers import (  # noqa: F401,E402
     ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding,
 )

@@ -1,0 +1,194 @@
+"""fleet.meta_parallel — the reference's user-importable parallel-layer
+namespace (reference: python/paddle/distributed/fleet/meta_parallel/
+__init__.py re-exporting parallel_layers + the mode wrapper classes).
+
+The layer classes live in distributed/{mp_layers,pipeline}.py; this
+module restores the reference import path and adds the pieces that only
+exist here: SharedLayerDesc (cross-stage weight tying), the RNG state
+tracker (functional keys, not device states), and the MetaParallelBase
+wrappers (no-ops on a mesh — GSPMD already shards by annotation — kept
+so reference training scripts run).
+"""
+from __future__ import annotations
+
+from ..mp_layers import (  # noqa: F401
+    ColumnParallelLinear, ParallelCrossEntropy, RowParallelLinear,
+    VocabParallelEmbedding,
+)
+from ..pipeline import LayerDesc, PipelineLayer  # noqa: F401
+from ...nn.layer.layers import Layer
+
+__all__ = ["VocabParallelEmbedding", "ColumnParallelLinear",
+           "RowParallelLinear", "ParallelCrossEntropy", "LayerDesc",
+           "SharedLayerDesc", "PipelineLayer", "RNGStatesTracker",
+           "model_parallel_random_seed", "get_rng_state_tracker",
+           "TensorParallel", "PipelineParallel", "ShardingParallel"]
+
+
+class SharedLayerDesc(LayerDesc):
+    """Deferred layer whose named weight is TIED to every other layer
+    built from a SharedLayerDesc with the same key WITHIN ONE
+    PipelineLayer construction (reference pp_layers.py: embedding shared
+    between first and last pipeline stage). On this substrate tying
+    means the same Parameter object — the tape accumulates both stages'
+    gradients into it. forward_func(layer, x), when given, replaces the
+    layer's forward (the reference's tied-LM-head pattern: logits via
+    the transposed embedding weight)."""
+
+    def __init__(self, key, layer_cls, *args, forward_func=None,
+                 shared_weight_attr="weight", **kwargs):
+        super().__init__(layer_cls, *args, **kwargs)
+        self.layer_name = key
+        self.shared_weight_attr = shared_weight_attr
+        self.forward_func = forward_func
+
+    def build_layer(self, shared_registry=None):
+        """shared_registry: per-construction {key: (layer, attr)} scope
+        (PipelineLayer passes one per __init__) — a process-global
+        registry would tie unrelated models built with the same key and
+        pin dead layers forever. A bare build_layer() shares nothing."""
+        layer = super().build_layer()
+        if shared_registry is not None:
+            first = shared_registry.get(self.layer_name)
+            if first is None:
+                shared_registry[self.layer_name] = (
+                    layer, self.shared_weight_attr)
+            else:
+                owner, attr = first
+                setattr(layer, self.shared_weight_attr,
+                        getattr(owner, attr))
+        if self.forward_func is not None:
+            fwd, lyr = self.forward_func, layer
+            layer.forward = lambda *a, **kw: fwd(lyr, *a, **kw)
+        return layer
+
+
+class RNGStatesTracker:
+    """Named RNG streams for model-parallel determinism (reference
+    parallel_layers/random.py). Functional substrate: a "state" is a
+    PRNG key; rng_state(name) scopes the framework RNG to that stream,
+    advancing it per entry so repeated scopes draw fresh numbers."""
+
+    def __init__(self):
+        self._seeds = {}
+        self._counters = {}
+
+    def reset(self):
+        self._seeds.clear()
+        self._counters.clear()
+
+    def add(self, name, seed):
+        if name in self._seeds:
+            raise ValueError(f"rng state {name} already exists")
+        if seed in self._seeds.values():
+            raise ValueError(f"seed {seed} already used for another state")
+        self._seeds[name] = int(seed)
+        self._counters[name] = 0
+
+    def rng_state(self, name="model-parallel-rng"):
+        import contextlib
+
+        import jax
+
+        from ...framework import random as rnd
+
+        if name not in self._seeds:
+            raise ValueError(f"rng state {name} was not added")
+
+        @contextlib.contextmanager
+        def _scope():
+            self._counters[name] += 1
+            key = jax.random.fold_in(
+                jax.random.PRNGKey(self._seeds[name]),
+                self._counters[name])
+            with rnd.key_scope(key):
+                yield
+        return _scope()
+
+
+_tracker = RNGStatesTracker()
+
+
+def get_rng_state_tracker():
+    return _tracker
+
+
+def model_parallel_random_seed(seed=None):
+    """Install the reference's three streams (global / mp-local /
+    data-parallel) derived from one seed."""
+    import random as _pyrandom
+
+    from ... import seed as _paddle_seed
+
+    seed = _pyrandom.randint(0, 2 ** 31 - 1) if seed is None else int(seed)
+    _tracker.reset()
+    _tracker.add("global_seed", seed)
+    _tracker.add("model-parallel-rng", seed + 1)
+    _tracker.add("data-parallel-rng", seed + 2)
+    _paddle_seed(seed)
+
+
+class MetaParallelBase(Layer):
+    """Reference meta_parallel/meta_parallel_base.py: wraps the model for
+    a parallel mode and prepares its communicators. On a mesh the
+    preparation is the sharding annotations the layers already carry, so
+    the wrapper only delegates — kept because reference scripts do
+    `model = TensorParallel(model, hcg, strategy=...)`."""
+
+    def __init__(self, layers, hcg=None, strategy=None, **kwargs):
+        super().__init__()
+        self._layers = layers
+        self._hcg = hcg
+        self._strategy = strategy
+
+    def forward(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, *args, **kwargs):
+        return self._layers.set_state_dict(*args, **kwargs)
+
+    def parameters(self, include_sublayers=True):
+        return self._layers.parameters(include_sublayers)
+
+
+class TensorParallel(MetaParallelBase):
+    pass
+
+
+class ShardingParallel(MetaParallelBase):
+    pass
+
+
+class PipelineParallel(MetaParallelBase):
+    """Reference pipeline_parallel.py drives the hand-written 1F1B
+    schedule via train_batch; here the jitted schedule lives inside the
+    PipelineLayer itself, so the wrapper adds only the train_batch
+    convenience."""
+
+    def __init__(self, layers, hcg=None, strategy=None, **kwargs):
+        super().__init__(layers, hcg, strategy, **kwargs)
+        self._loss_fn = getattr(layers, "_loss_fn", None)
+
+    def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
+        if self._loss_fn is None:
+            raise ValueError(
+                "PipelineParallel.train_batch needs a loss: build the "
+                "PipelineLayer with loss_fn= (training toward a "
+                "fabricated objective would silently be wrong)")
+        inputs, labels = data
+        out = self._layers(inputs)
+        loss = self._loss_fn(out, labels)
+        if scaler is not None:
+            scaler.scale(loss).backward()
+            scaler.step(optimizer)   # keeps the non-finite-step skip
+            scaler.update()
+        else:
+            loss.backward()
+            optimizer.step()
+        optimizer.clear_grad()
+        if lr_scheduler is not None:
+            lr_scheduler.step()
+        return loss

@@ -307,3 +307,94 @@ def test_pipeline_dropout_varies_across_steps():
     e1 = np.asarray(pl.forward_pipelined(x, num_micro=2).numpy())
     e2 = np.asarray(pl.forward_pipelined(x, num_micro=2).numpy())
     np.testing.assert_allclose(e1, e2)
+
+
+class TestMetaParallelNamespace:
+    """fleet.meta_parallel import path (reference meta_parallel/__init__)."""
+
+    def test_imports_and_wrappers(self):
+        from paddle_tpu.distributed.fleet import meta_parallel as mp
+
+        for n in ("VocabParallelEmbedding", "ColumnParallelLinear",
+                  "RowParallelLinear", "ParallelCrossEntropy",
+                  "LayerDesc", "SharedLayerDesc", "PipelineLayer",
+                  "TensorParallel", "PipelineParallel",
+                  "ShardingParallel", "get_rng_state_tracker"):
+            assert hasattr(mp, n), n
+        import numpy as np
+
+        import paddle_tpu as paddle
+        from paddle_tpu import nn
+
+        paddle.seed(0)
+        wrapped = mp.TensorParallel(nn.Linear(4, 4), hcg=None,
+                                    strategy=None)
+        x = paddle.randn([2, 4])
+        assert wrapped(x).shape == [2, 4]
+        assert len(list(wrapped.parameters())) == 2
+
+    def test_shared_layer_desc_ties_weights(self):
+        import paddle_tpu as paddle
+        from paddle_tpu import nn
+        from paddle_tpu.distributed.fleet import meta_parallel as mp
+
+        paddle.seed(0)
+        reg = {}
+        d1 = mp.SharedLayerDesc("embed", nn.Embedding, 16, 8)
+        d2 = mp.SharedLayerDesc(
+            "embed", nn.Embedding, 16, 8,
+            forward_func=lambda l, x: x @ paddle.transpose(l.weight,
+                                                           [1, 0]))
+        a = d1.build_layer(shared_registry=reg)
+        b = d2.build_layer(shared_registry=reg)
+        assert a.weight is b.weight  # tied: one Parameter object
+        out = b(paddle.randn([2, 8]))  # forward_func: tied LM head
+        assert out.shape == [2, 16]
+        # a separate construction scope shares nothing
+        c = d1.build_layer(shared_registry={})
+        assert c.weight is not a.weight
+
+    def test_rng_state_tracker(self):
+        import numpy as np
+
+        import paddle_tpu as paddle
+        from paddle_tpu.distributed.fleet import meta_parallel as mp
+
+        t = mp.RNGStatesTracker()
+        t.add("mp", 1234)
+        with t.rng_state("mp"):
+            a = paddle.randn([4]).numpy()
+        with t.rng_state("mp"):
+            b = paddle.randn([4]).numpy()
+        assert not np.array_equal(a, b)  # stream advances per scope
+        import pytest
+
+        with pytest.raises(ValueError):
+            t.add("mp", 99)
+        with pytest.raises(ValueError):
+            t.rng_state("missing").__enter__()
+
+    def test_meta_optimizers(self):
+        import numpy as np
+
+        import paddle_tpu as paddle
+        import pytest
+        from paddle_tpu import nn
+        from paddle_tpu.distributed.fleet import meta_optimizers as mo
+
+        paddle.seed(0)
+        lin = nn.Linear(8, 8)
+        opt = mo.GradientMergeOptimizer(
+            paddle.optimizer.SGD(learning_rate=0.1,
+                                 parameters=lin.parameters()),
+            k_steps=2)
+        w0 = np.asarray(lin.weight.numpy()).copy()
+        x = paddle.randn([4, 8])
+        loss = (lin(x) ** 2).mean()
+        loss.backward()
+        opt.step()
+        np.testing.assert_array_equal(lin.weight.numpy(), w0)  # merged
+        assert mo.LambOptimizer is paddle.optimizer.Lamb
+        with pytest.raises(AttributeError, match="strategy.recompute"):
+            mo.RecomputeOptimizer
+        assert not hasattr(mo, "AMPOptimizer")  # probes degrade

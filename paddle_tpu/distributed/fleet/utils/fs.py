@@ -1,0 +1,18 @@
+"""fleet.utils.fs (reference: python/paddle/distributed/fleet/utils/
+fs.py — LocalFS + HDFSClient used by checkpoint helpers)."""
+from __future__ import annotations
+
+from . import LocalFS  # noqa: F401
+
+__all__ = ["LocalFS", "HDFSClient"]
+
+
+class HDFSClient:
+    """Loud gate: this deployment has no Hadoop runtime and zero network
+    egress; persistent storage is the mounted filesystem (use LocalFS —
+    on a TPU slice the NFS/GCS-fuse mount IS the job-shared store)."""
+
+    def __init__(self, hadoop_home=None, configs=None, *a, **kw):
+        raise NotImplementedError(
+            "HDFSClient: no Hadoop runtime in the TPU deployment; mount "
+            "the store (NFS/GCS-fuse) and use fleet.utils.LocalFS")

@@ -1,0 +1,62 @@
+"""fleet.utils.hybrid_parallel_util (reference: python/paddle/
+distributed/fleet/utils/hybrid_parallel_util.py — the helpers reference
+hybrid-parallel training scripts call between backward and step).
+
+Single-controller semantics: there are no per-rank gradient replicas to
+sum — when the batch is dp-sharded, XLA already inserted the gradient
+all-reduce during the jitted backward, and eager grads are global
+values. The entry points therefore VALIDATE and (where meaningful)
+re-constrain sharding rather than re-implementing NCCL calls; scripts
+written for the reference keep their call sites and their semantics.
+"""
+from __future__ import annotations
+
+__all__ = ["fused_allreduce_gradients", "broadcast_input_data",
+           "broadcast_mp_parameters", "broadcast_dp_parameters",
+           "broadcast_sharding_parameters"]
+
+
+def fused_allreduce_gradients(parameter_list, hcg):
+    """Reference: flatten+allreduce all dp-replica grads in one NCCL
+    call. Here gradients of a dp-sharded-batch backward are already the
+    global sum (GSPMD inserted the all-reduce); a grad left SHARDED over
+    the mesh (e.g. produced inside a shard_map) is re-materialized
+    replicated so the following optimizer step sees the same layout the
+    reference guarantees."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from .. import get_hybrid_communicate_group
+    from ... import env as _env
+
+    hcg = hcg or get_hybrid_communicate_group()
+    mesh = hcg.mesh if hcg is not None else _env.get_mesh()
+    if mesh is None:
+        return  # single-device: nothing to reduce
+    replicated = NamedSharding(mesh, P())
+    for p in parameter_list:
+        g = getattr(p, "_grad", None)
+        if g is None:
+            continue
+        sh = getattr(g._value, "sharding", None)
+        if sh is not None and not sh.is_fully_replicated:
+            g._value = jax.device_put(g._value, replicated)
+
+
+def broadcast_input_data(hcg, *inputs, **kwargs):
+    """Reference: mp rank-0 broadcasts the batch to its group; always
+    returns (inputs, kwargs) — the reference contract scripts unpack.
+    Global arrays are already visible to every device, so the data
+    passes through unchanged."""
+    return list(inputs), kwargs
+
+
+def _noop_broadcast(model, hcg):
+    # parameters are global arrays — every mesh device reads the same
+    # value; the reference's broadcast exists to sync per-process copies
+    return None
+
+
+broadcast_mp_parameters = _noop_broadcast
+broadcast_dp_parameters = _noop_broadcast
+broadcast_sharding_parameters = _noop_broadcast

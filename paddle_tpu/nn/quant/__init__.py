@@ -1,0 +1,120 @@
+"""paddle.nn.quant (reference: python/paddle/nn/quant/quant_layers.py —
+the fake-quant layers the QAT/PTQ passes insert, importable directly).
+
+The quantize-dequantize core with straight-through gradients lives in
+quantization/layers.py (`fake_quant`); these classes add the reference's
+scale-estimation policies (abs-max, moving-average, channel-wise) as
+layers with the reference constructor signatures.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.tensor import Tensor
+from ...quantization.layers import (  # noqa: F401
+    QuantizedConv2D, QuantizedLinear, fake_quant,
+)
+from ..layer.layers import Layer
+
+__all__ = ["FakeQuantAbsMax", "FakeQuantMovingAverageAbsMax",
+           "FakeQuantChannelWiseAbsMax", "MovingAverageAbsMaxScale",
+           "QuantizedLinear", "QuantizedConv2D"]
+
+
+class FakeQuantAbsMax(Layer):
+    """Per-tensor abs-max fake quantization (reference
+    quant_layers.py:46)."""
+
+    def __init__(self, name=None, quant_bits=8, dtype="float32",
+                 quant_on_weight=False):
+        super().__init__()
+        self._quant_bits = quant_bits
+
+    def forward(self, x):
+        from ... import tensor as T
+
+        scale = T.max(T.abs(x))
+        return fake_quant(x, scale, bits=self._quant_bits)
+
+
+def _ema_scale(old, cur, rate):
+    """One EMA-of-absmax policy for the traced layers (the host-side
+    calibration twin is quantization/observers.py
+    MovingAverageAbsmaxObserver). old == 0 is the 'unseeded' sentinel:
+    the first observation seeds the scale directly. Pure jnp so the
+    update traces under jit/to_static/functional_call — buffer mutation
+    is then captured as a new buffer value, the same mechanism BN
+    running stats use."""
+    return jnp.where(old == 0.0, cur, rate * old + (1.0 - rate) * cur)
+
+
+def _quant_or_identity(x, scale_t, bits):
+    """Fake-quant by the tracked scale; an unseeded scale (0) passes the
+    input through — quantizing by a floored zero scale would silently
+    zero every activation (eval before any training step, or a loaded
+    state_dict with an untrained observer)."""
+    from ... import tensor as T
+
+    q = fake_quant(x, scale_t, bits=bits)
+    unseeded = T.equal(scale_t, Tensor(jnp.zeros((), jnp.float32)))
+    return T.where(unseeded, x, q)
+
+
+class FakeQuantMovingAverageAbsMax(Layer):
+    """Moving-average abs-max fake quantization (reference
+    quant_layers.py:128): training updates the tracked scale, eval
+    quantizes with the frozen one."""
+
+    def __init__(self, name=None, moving_rate=0.9, quant_bits=8,
+                 dtype="float32"):
+        super().__init__()
+        self._rate = moving_rate
+        self._quant_bits = quant_bits
+        self.register_buffer("scale", Tensor(jnp.zeros((), jnp.float32)))
+
+    def forward(self, x):
+        from ... import tensor as T
+
+        if self.training:
+            cur = T.max(T.abs(x))._value.astype(jnp.float32)
+            self.scale._value = _ema_scale(self.scale._value, cur,
+                                           self._rate)
+        return _quant_or_identity(x, self.scale, self._quant_bits)
+
+
+class FakeQuantChannelWiseAbsMax(Layer):
+    """Per-channel abs-max fake quantization (reference
+    quant_layers.py:226) — the weight-quant policy for conv/linear."""
+
+    def __init__(self, name=None, channel_num=None, quant_bits=8,
+                 quant_axis=0, dtype="float32", quant_on_weight=True):
+        super().__init__()
+        self._quant_bits = quant_bits
+        self._axis = quant_axis
+
+    def forward(self, x):
+        from ... import tensor as T
+
+        red = [i for i in range(x.ndim) if i != self._axis % x.ndim]
+        scale = T.max(T.abs(x), axis=red, keepdim=True)
+        return fake_quant(x, scale, bits=self._quant_bits)
+
+
+class MovingAverageAbsMaxScale(Layer):
+    """Output-scale observer (reference quant_layers.py:309): tracks the
+    moving-average abs-max but passes the input through unchanged."""
+
+    def __init__(self, name=None, moving_rate=0.9, dtype="float32"):
+        super().__init__()
+        self._rate = moving_rate
+        self.register_buffer("scale", Tensor(jnp.zeros((), jnp.float32)))
+
+    def forward(self, x):
+        from ... import tensor as T
+
+        if self.training:
+            cur = T.max(T.abs(x))._value.astype(jnp.float32)
+            self.scale._value = _ema_scale(self.scale._value, cur,
+                                           self._rate)
+        return x

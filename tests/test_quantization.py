@@ -188,3 +188,88 @@ def test_asp_2d_pattern_and_density():
                 assert (block.sum(0) <= 2).all()
         dens.append(mask.mean())
     assert np.mean(dens) > 0.45, np.mean(dens)
+
+
+class TestNNQuantNamespace:
+    """paddle.nn.quant — the fake-quant layers the passes insert
+    (reference nn/quant/quant_layers.py)."""
+
+    def test_fake_quant_abs_max(self):
+        import paddle_tpu.nn.quant as q
+
+        x = paddle.to_tensor(np.linspace(-2, 2, 16, dtype=np.float32))
+        y = q.FakeQuantAbsMax(quant_bits=8)(x)
+        # qdq error bounded by one quantization step
+        step = 2.0 / 127
+        assert np.abs(y.numpy() - x.numpy()).max() <= step
+        x.stop_gradient = False
+        loss = (q.FakeQuantAbsMax()(x) ** 2).mean()
+        loss.backward()
+        assert x.grad is not None  # straight-through estimator
+
+    def test_fake_quant_moving_average_tracks_and_freezes(self):
+        import paddle_tpu.nn.quant as q
+
+        fq = q.FakeQuantMovingAverageAbsMax(moving_rate=0.5)
+        a = paddle.to_tensor(np.full(8, 4.0, np.float32))
+        b = paddle.to_tensor(np.full(8, 2.0, np.float32))
+        fq(a)
+        s1 = float(fq.scale.numpy())
+        assert s1 == 4.0  # first observation seeds the scale
+        fq(b)
+        assert float(fq.scale.numpy()) == 0.5 * 4.0 + 0.5 * 2.0
+        fq.eval()
+        frozen = float(fq.scale.numpy())
+        fq(paddle.to_tensor(np.full(8, 100.0, np.float32)))
+        assert float(fq.scale.numpy()) == frozen  # eval: no update
+
+    def test_channel_wise_and_output_scale(self):
+        import paddle_tpu.nn.quant as q
+
+        w = paddle.to_tensor(
+            np.stack([np.full(4, 0.1), np.full(4, 10.0)]).astype(
+                np.float32))
+        y = q.FakeQuantChannelWiseAbsMax(quant_axis=0)(w)
+        # per-channel scales: the small channel keeps fine resolution
+        assert np.abs(y.numpy()[0] - w.numpy()[0]).max() < 1e-3
+        obs = q.MovingAverageAbsMaxScale()
+        out = obs(w)
+        np.testing.assert_array_equal(out.numpy(), w.numpy())
+        assert float(obs.scale.numpy()) == 10.0
+
+
+def test_fleet_utils_fs_and_hybrid_util():
+    from paddle_tpu.distributed.fleet.utils import fs
+    from paddle_tpu.distributed.fleet.utils import hybrid_parallel_util \
+        as hpu
+    from paddle_tpu import nn
+    import pytest
+
+    assert fs.LocalFS().is_exist("/")
+    with pytest.raises(NotImplementedError, match="LocalFS"):
+        fs.HDFSClient()
+    lin = nn.Linear(4, 4)
+    loss = (lin(paddle.randn([2, 4])) ** 2).mean()
+    loss.backward()
+    hpu.fused_allreduce_gradients(list(lin.parameters()), None)
+    assert lin.weight.grad is not None
+    assert hpu.broadcast_dp_parameters(lin, None) is None
+
+
+def test_nn_quant_unseeded_scale_is_identity_and_traces():
+    """Eval with an untrained scale passes through (quantizing by a
+    floored zero scale would zero activations); the EMA update traces
+    under to_static (buffer capture, the BN mechanism)."""
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.quant as q
+
+    fq = q.FakeQuantMovingAverageAbsMax()
+    fq.eval()
+    x = paddle.to_tensor(np.linspace(-2, 2, 8, dtype=np.float32))
+    np.testing.assert_array_equal(fq(x).numpy(), x.numpy())  # identity
+
+    fq2 = q.FakeQuantMovingAverageAbsMax(moving_rate=0.5)
+    traced = paddle.jit.to_static(fq2)
+    y = traced(x)  # must NOT TracerArrayConversionError
+    assert float(fq2.scale.numpy()) == 2.0  # buffer update captured
+    assert np.abs(y.numpy() - x.numpy()).max() <= 2.0 / 127

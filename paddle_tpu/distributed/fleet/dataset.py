@@ -1,0 +1,9 @@
+"""fleet.dataset (reference: python/paddle/distributed/fleet/dataset/
+dataset.py — InMemoryDataset/QueueDataset import path). The
+implementations live in distributed/ps_dataset.py (the PS data-feed
+format parsers, kept even though PS mode itself is waived on TPU)."""
+from __future__ import annotations
+
+from ..ps_dataset import DatasetBase, InMemoryDataset, QueueDataset  # noqa: F401
+
+__all__ = ["DatasetBase", "InMemoryDataset", "QueueDataset"]

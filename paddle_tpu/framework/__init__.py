@@ -7,6 +7,9 @@ from .mode import (  # noqa: F401
     in_static_mode,
 )
 from .random import seed, get_rng_state, set_rng_state  # noqa: F401
+from ..core.dtype import (  # noqa: F401  (reference paddle.framework re-exports)
+    get_default_dtype, set_default_dtype,
+)
 from .debug import (  # noqa: F401
     check_numerics, disable_check_nan_inf, enable_check_nan_inf,
     set_printoptions,

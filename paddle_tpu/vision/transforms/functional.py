@@ -1,0 +1,48 @@
+"""paddle.vision.transforms.functional (reference: python/paddle/vision/
+transforms/functional.py — the functional forms user pipelines import as
+`import paddle.vision.transforms.functional as F`).
+
+The implementations live in the transforms package; this module restores
+the reference import path and the two functional forms that only had
+class equivalents (to_tensor with an explicit data_format arg,
+adjust_saturation with a deterministic factor).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from . import _chw
+from . import (  # noqa: F401
+    adjust_brightness, adjust_contrast, adjust_hue, center_crop, crop,
+    hflip, normalize, pad, resize, rotate, to_grayscale, vflip,
+)
+
+__all__ = ["to_tensor", "resize", "pad", "crop", "center_crop", "hflip",
+           "vflip", "adjust_brightness", "adjust_contrast",
+           "adjust_saturation", "adjust_hue", "rotate", "to_grayscale",
+           "normalize"]
+
+
+def to_tensor(pic, data_format="CHW"):
+    """PIL/ndarray HWC uint8 -> float CHW ndarray in [0, 1] (reference
+    functional.py:47)."""
+    img = np.asarray(pic)
+    if img.dtype == np.uint8:
+        img = img.astype(np.float32) / 255.0
+    img = _chw(img)
+    if data_format == "HWC":
+        img = img.transpose(1, 2, 0)
+    return img
+
+
+def adjust_saturation(img, saturation_factor):
+    """Blend with the grayscale image by a FIXED factor (reference
+    functional.py:443 — the class transform draws the factor randomly,
+    the functional form takes it)."""
+    img = np.asarray(img, dtype=np.float32)
+    chw = _chw(img)
+    gray = chw.mean(0, keepdims=True)
+    out = np.clip((chw - gray) * saturation_factor + gray, 0,
+                  255.0 if img.max() > 1.0 else 1.0)
+    return out if img.ndim == 3 and img.shape[0] in (1, 3) else \
+        out.transpose(1, 2, 0)

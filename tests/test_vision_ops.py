@@ -176,3 +176,33 @@ def test_read_file_decode_jpeg(tmp_path):
     assert raw._value.dtype == np.uint8
     decoded = ops.decode_jpeg(raw)
     assert decoded.shape == [3, 10, 12]
+
+
+def test_transforms_functional_namespace():
+    """paddle.vision.transforms.functional import path (reference
+    functional.py) — the form pipelines import as F."""
+    import paddle_tpu.vision.transforms.functional as F
+
+    img = (np.random.RandomState(0).rand(12, 10, 3) * 255).astype(np.uint8)
+    t = F.to_tensor(img)
+    assert t.shape == (3, 12, 10) and 0.0 <= t.min() and t.max() <= 1.0
+    assert F.to_tensor(img, data_format="HWC").shape == (12, 10, 3)
+    np.testing.assert_array_equal(F.hflip(t), t[:, :, ::-1])
+    assert min(np.asarray(F.resize(t, 6)).shape[1:]) == 6
+    c = F.crop(t, 2, 2, 4, 4)
+    assert np.asarray(c).shape[-2:] == (4, 4)
+    s = F.adjust_saturation(t, 0.0)  # factor 0 -> pure grayscale
+    g = np.asarray(s)
+    np.testing.assert_allclose(g[0], g[1], atol=1e-6)
+    n = F.normalize(t, [0.5, 0.5, 0.5], [0.5, 0.5, 0.5])
+    assert np.asarray(n).min() < 0
+
+
+def test_fleet_dataset_and_framework_dtype_paths():
+    from paddle_tpu.distributed.fleet import dataset as fds
+    from paddle_tpu.framework import get_default_dtype, set_default_dtype
+
+    assert hasattr(fds, "InMemoryDataset")
+    assert hasattr(fds, "QueueDataset")
+    assert get_default_dtype() == "float32"
+    set_default_dtype("float32")

@@ -1,0 +1,33 @@
+"""fleet.elastic (reference: python/paddle/distributed/fleet/elastic/
+__init__.py — elastic training entry points over ElasticManager).
+
+The manager (heartbeat stall detection + checkpoint auto-resume) lives
+in distributed/elastic.py; this module restores the fleet import path
+and the reference's enable/launch helpers. On a single-controller TPU
+slice, "elastic" means surviving preemption via checkpoint-resume — the
+ETCD-based worker re-negotiation of the reference has no equivalent
+(the slice is re-provisioned whole by the platform scheduler)."""
+from __future__ import annotations
+
+from ..elastic import ElasticManager, heartbeat, latest_checkpoint  # noqa: F401
+
+__all__ = ["ElasticManager", "enable_elastic", "launch_elastic"]
+
+
+def enable_elastic(args, distribute_mode=None):
+    """Reference gates on ETCD env vars; here elastic = checkpoint-resume,
+    enabled whenever a checkpoint dir is configured."""
+    import os
+
+    return bool(getattr(args, "elastic_server", None)
+                or os.environ.get("PADDLE_ELASTIC_SERVER")
+                or os.environ.get("PADDLE_CHECKPOINT_DIR"))
+
+
+def launch_elastic(args, distribute_mode=None):
+    raise NotImplementedError(
+        "launch_elastic: ETCD-negotiated worker membership does not "
+        "exist on a TPU slice — the platform scheduler replaces the "
+        "whole slice. Use ElasticManager (heartbeat + auto-resume) "
+        "inside the training script, or incubate.checkpoint."
+        "auto_checkpoint.train_epoch_range for epoch-level resume.")

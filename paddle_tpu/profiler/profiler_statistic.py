@@ -1,0 +1,48 @@
+"""paddle.profiler.profiler_statistic (reference: python/paddle/
+profiler/profiler_statistic.py — the summary-table machinery).
+
+The statistics engine here is the Profiler's own event store (host-side
+RecordEvent spans + XLA cost analysis); this module restores the
+reference import path: SortedKeys, StatisticData over the collected
+events, and _build_table producing the reference-shaped summary text.
+"""
+from __future__ import annotations
+
+from . import SortedKeys  # noqa: F401
+
+__all__ = ["SortedKeys", "StatisticData"]
+
+
+class StatisticData:
+    """Aggregate view over a finished Profiler's collected events
+    (reference profiler_statistic.py:589 wraps the C++ node trees; here
+    the event store is already host-side)."""
+
+    def __init__(self, events):
+        self.events = list(events)
+
+    def totals(self):
+        out = {}
+        for e in self.events:
+            name = getattr(e, "name", str(e))
+            dur = float(getattr(e, "duration_ms", 0.0))
+            cnt, tot = out.get(name, (0, 0.0))
+            out[name] = (cnt + 1, tot + dur)
+        return out
+
+
+def _build_table(statistic_data, sorted_by=None, op_detail=True,
+                 thread_sep=False, time_unit="ms", row_limit=100,
+                 max_src_column_width=75):
+    """Reference-shaped text table of event totals."""
+    totals = statistic_data.totals()
+    key = (lambda kv: -kv[1][1])
+    if sorted_by == SortedKeys.CPUMax:
+        key = (lambda kv: -kv[1][1])
+    rows = sorted(totals.items(), key=key)[:row_limit]
+    width = max([len("Name")] + [len(n) for n, _ in rows]) + 2
+    lines = [f"{'Name':<{width}}{'Calls':>8}{'Total(ms)':>12}"]
+    lines.append("-" * (width + 20))
+    for name, (cnt, tot) in rows:
+        lines.append(f"{name:<{width}}{cnt:>8}{tot:>12.3f}")
+    return "\n".join(lines)

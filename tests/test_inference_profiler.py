@@ -147,3 +147,37 @@ def test_predictor_shares_compile_across_instances(tmp_path):
     assert n_compiles_second == 0, (
         f"second Predictor recompiled ({n_compiles_second} compiles; "
         f"first did {n_compiles_first})")
+
+
+def test_profiler_statistic_path():
+    from paddle_tpu.profiler import profiler_statistic as ps
+
+    class E:
+        def __init__(self, name, dur):
+            self.name = name
+            self.duration_ms = dur
+
+    sd = ps.StatisticData([E("matmul", 1.5), E("matmul", 0.5),
+                           E("conv", 2.0)])
+    assert sd.totals()["matmul"] == (2, 2.0)
+    table = ps._build_table(sd)
+    assert "matmul" in table and "conv" in table
+    assert ps.SortedKeys is not None
+
+
+def test_fleet_elastic_path(monkeypatch):
+    import types
+
+    import pytest
+
+    from paddle_tpu.distributed.fleet import elastic as fe
+
+    assert fe.ElasticManager is not None
+    args = types.SimpleNamespace(elastic_server=None)
+    monkeypatch.delenv("PADDLE_ELASTIC_SERVER", raising=False)
+    monkeypatch.delenv("PADDLE_CHECKPOINT_DIR", raising=False)
+    assert not fe.enable_elastic(args)
+    monkeypatch.setenv("PADDLE_CHECKPOINT_DIR", "/tmp/x")
+    assert fe.enable_elastic(args)
+    with pytest.raises(NotImplementedError, match="ElasticManager"):
+        fe.launch_elastic(args)

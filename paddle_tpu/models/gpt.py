@@ -335,8 +335,9 @@ class GPTForCausalLM(nn.Layer):
         return logits
 
     def generate(self, input_ids, max_new_tokens=20, temperature=1.0,
-                 top_k=None, use_jit=True):
-        """Greedy/top-k sampling with KV cache.
+                 top_k=None, top_p=None, num_beams=1, length_penalty=1.0,
+                 eos_token_id=None, use_jit=True):
+        """Greedy / top-k / top-p sampling or beam search with KV cache.
 
         use_jit=True (default) runs the TPU-native decode: caches are
         PREALLOCATED to max_position and updated in place with
@@ -344,13 +345,32 @@ class GPTForCausalLM(nn.Layer):
         and every decode step reuses ONE cached XLA executable with
         static shapes (the eager path re-traces per growing cache length
         — the reference's dynamic-shape decode has no XLA equivalent).
+        num_beams > 1 selects jitted beam search (mutually exclusive
+        with sampling knobs); eos_token_id freezes finished beams and
+        length_penalty follows the reference's scoring.
         """
+        if num_beams and num_beams > 1:
+            if top_k or top_p is not None:
+                raise ValueError(
+                    "beam search and top-k/top-p sampling are mutually "
+                    "exclusive (reference generate contract)")
+            if not use_jit:
+                raise ValueError(
+                    "beam search has no eager fallback (jit-only on the "
+                    "static-KV substrate); drop use_jit=False")
+            if self.training and self.config.dropout > 0:
+                raise RuntimeError(
+                    "beam search under train-mode dropout is undefined "
+                    "(scores would be stochastic); call model.eval()")
+            return self._beam_search_jit(input_ids, max_new_tokens,
+                                         num_beams, length_penalty,
+                                         eos_token_id, temperature)
         if use_jit and max_new_tokens > 0 and not (
                 self.training and self.config.dropout > 0):
             # (train-mode dropout decode falls back to the eager path,
             # which draws per-op masks exactly as before)
             return self._generate_jit(input_ids, max_new_tokens,
-                                      temperature, top_k)
+                                      temperature, top_k, top_p)
         from .. import tensor as T
         from ..core.autograd import no_grad
 
@@ -367,6 +387,19 @@ class GPTForCausalLM(nn.Layer):
                     vals, _ = T.topk(logits, top_k)
                     logits = T.where(logits < vals[:, -1:],
                                      T.full_like(logits, -1e30), logits)
+                if top_p is not None:
+                    # nucleus mask, mirroring the jitted sampler
+                    p_eff = max(float(top_p), 1e-12)
+                    srt = T.flip(T.sort(logits, axis=-1), axis=[-1])
+                    probs_s = nn.functional.softmax(srt, -1)
+                    cum = T.cumsum(probs_s, axis=-1)
+                    keep = (cum - probs_s) < p_eff
+                    cutoff = T.min(T.where(
+                        keep, srt, T.full_like(srt, float("inf"))),
+                        axis=-1, keepdim=True)
+                    logits = T.where(logits < cutoff,
+                                     T.full_like(logits, -1e30), logits)
+                if top_k or top_p is not None:
                     probs = nn.functional.softmax(logits, -1)
                     nxt = T.multinomial(probs, 1)
                 else:
@@ -401,24 +434,19 @@ class GPTForCausalLM(nn.Layer):
             self._stacked_cache = None
         return stacked
 
-    def _generate_jit(self, input_ids, max_new_tokens, temperature, top_k):
+    def _decode_core(self):
+        """Pure decode math shared by the sampling and beam-search
+        strategies: (params, prefill_f, decode_f) where
+        prefill_f(p, ids) -> (logits [B, V], cks, cvs) and
+        decode_f(p, cks, cvs, cur [B], pos) -> (logits [B, V], cks, cvs).
+        Logits stay on device; each strategy jits its own sampling on
+        top so no [B, V] buffer ever crosses the host boundary."""
         import jax
         import numpy as np
-
-        from ..core.tensor import Tensor
-        from ..framework import random as rnd
 
         c = self.config
         nh, hd = c.num_heads, c.hidden_size // c.num_heads
         S = c.max_position
-        ids0 = input_ids._value if isinstance(input_ids, Tensor) \
-            else jnp.asarray(input_ids)
-        ids0 = ids0.astype(jnp.int32)
-        B, T0 = ids0.shape
-        if T0 + max_new_tokens > S:
-            raise ValueError(
-                f"prompt {T0} + max_new_tokens {max_new_tokens} exceeds "
-                f"max_position {S}")
         params = {
             "wte": self.gpt.wte.weight._value,
             "wpe": self.gpt.wpe.weight._value,
@@ -481,43 +509,90 @@ class GPTForCausalLM(nn.Layer):
             h = ln(x_last, p["lnf_w"], p["lnf_b"])
             return h @ p["wte"].T                       # [B, V]
 
+        L = c.num_layers
+
+        def prefill_f(p, ids):
+            B = ids.shape[0]
+            x = p["wte"][ids] + p["wpe"][jnp.arange(ids.shape[1])[None]]
+            cks = jnp.zeros((L, B, nh, S, hd), x.dtype)
+            cvs = jnp.zeros((L, B, nh, S, hd), x.dtype)
+            x, cks, cvs = trunk(p, x, cks, cvs, 0)
+            return logits_of(p, x[:, -1]), cks, cvs
+
+        def decode_f(p, cks, cvs, cur, pos):
+            x = p["wte"][cur][:, None] + p["wpe"][pos][None, None]
+            x, cks, cvs = trunk(p, x, cks, cvs, pos)
+            return logits_of(p, x[:, 0]), cks, cvs
+
+        return params, prefill_f, decode_f
+
+    def _prep_ids(self, input_ids, max_new_tokens):
+        from ..core.tensor import Tensor
+
+        ids0 = input_ids._value if isinstance(input_ids, Tensor) \
+            else jnp.asarray(input_ids)
+        ids0 = ids0.astype(jnp.int32)
+        if ids0.shape[1] + max_new_tokens > self.config.max_position:
+            raise ValueError(
+                f"prompt {ids0.shape[1]} + max_new_tokens "
+                f"{max_new_tokens} exceeds max_position "
+                f"{self.config.max_position}")
+        return ids0
+
+    def _generate_jit(self, input_ids, max_new_tokens, temperature, top_k,
+                      top_p=None):
+        import jax
+
+        from ..core.tensor import Tensor
+        from ..framework import random as rnd
+
+        ids0 = self._prep_ids(input_ids, max_new_tokens)
+        B, T0 = ids0.shape
+        params, prefill_f, decode_f = self._decode_core()
+
         def sample(logits, key):
             if temperature != 1.0:
                 logits = logits / temperature
             if top_k:
                 vals, _ = jax.lax.top_k(logits, top_k)
                 logits = jnp.where(logits < vals[:, -1:], -1e30, logits)
+            if top_p is not None:
+                # nucleus: keep the smallest prefix of the sorted probs
+                # with cumulative mass >= top_p (always at least top-1:
+                # the clamp keeps `cum - p < eps` true for the argmax
+                # even at top_p=0, which would otherwise mask EVERYTHING
+                # and sample uniform noise)
+                p_eff = max(float(top_p), 1e-12)
+                srt = jnp.sort(logits, axis=-1)[:, ::-1]
+                probs = jax.nn.softmax(srt, axis=-1)
+                cum = jnp.cumsum(probs, axis=-1)
+                keep = cum - probs < p_eff
+                cutoff = jnp.where(keep, srt, jnp.inf).min(-1, keepdims=True)
+                logits = jnp.where(logits < cutoff, -1e30, logits)
+            if top_k or top_p is not None:
                 return jax.random.categorical(key, logits, axis=-1)
             return jnp.argmax(logits, -1)
 
-        L = c.num_layers
-
         def prefill(p, ids, key):
-            x = p["wte"][ids] + p["wpe"][jnp.arange(ids.shape[1])[None]]
-            cks = jnp.zeros((L, B, nh, S, hd), x.dtype)
-            cvs = jnp.zeros((L, B, nh, S, hd), x.dtype)
-            x, cks, cvs = trunk(p, x, cks, cvs, 0)
-            nxt = sample(logits_of(p, x[:, -1]), key)
-            return nxt.astype(jnp.int32), cks, cvs
+            logits, cks, cvs = prefill_f(p, ids)
+            return sample(logits, key).astype(jnp.int32), cks, cvs
 
         def decode(p, cks, cvs, cur, pos, key):
-            x = p["wte"][cur][:, None] + p["wpe"][pos][None, None]
-            x, cks, cvs = trunk(p, x, cks, cvs, pos)
-            nxt = sample(logits_of(p, x[:, 0]), key)
-            return nxt.astype(jnp.int32), cks, cvs
+            logits, cks, cvs = decode_f(p, cks, cvs, cur, pos)
+            return sample(logits, key).astype(jnp.int32), cks, cvs
 
         cache = getattr(self, "_gen_jit_cache", None)
         if cache is None:
             cache = self._gen_jit_cache = {}
-        kp = ("prefill", B, T0, temperature, top_k)
-        kd = ("decode", B, temperature, top_k)
+        kp = ("prefill", B, T0, temperature, top_k, top_p)
+        kd = ("decode", B, temperature, top_k, top_p)
         if kp not in cache:
             cache[kp] = jax.jit(prefill)
         if kd not in cache:
             cache[kd] = jax.jit(decode, donate_argnums=(1, 2))
         # greedy decoding is deterministic: do not consume global PRNG
         # keys (parity with the eager path's RNG stream)
-        needs_key = bool(top_k)
+        needs_key = bool(top_k) or top_p is not None
         dummy = jnp.zeros((2,), jnp.uint32)
 
         def draw():
@@ -532,6 +607,103 @@ class GPTForCausalLM(nn.Layer):
             out.append(nxt[:, None])
             pos += 1
         return Tensor(jnp.concatenate(out, axis=1))
+
+    def _beam_search_jit(self, input_ids, max_new_tokens, num_beams,
+                         length_penalty=1.0, eos_token_id=None,
+                         temperature=1.0):
+        """Jitted fixed-shape beam search on the static-KV substrate
+        (capability reference: the dygraph beam-search decode loops of
+        the reference's generation utilities — here every step is ONE
+        cached executable; caches are gathered by parent beam with a
+        device-side take, never materialized on host)."""
+        import jax
+
+        from ..core.tensor import Tensor
+
+        K = int(num_beams)
+        ids0 = self._prep_ids(input_ids, max_new_tokens)
+        B, T0 = ids0.shape
+        V = self.config.vocab_size
+        params, prefill_f, decode_f = self._decode_core()
+        NEG = jnp.float32(-1e30)
+
+        def _logp(logits):
+            logits = logits.astype(jnp.float32)
+            if temperature != 1.0:
+                logits = logits / temperature
+            return jax.nn.log_softmax(logits, -1)
+
+        def prefill(p, ids):
+            logits, cks, cvs = prefill_f(p, ids)        # [B, V]
+            logp = _logp(logits)
+            scores, toks = jax.lax.top_k(logp, K)       # [B, K]
+            # beams share the prompt: replicate caches to [L, B*K, ...]
+            cks = jnp.repeat(cks, K, axis=1)
+            cvs = jnp.repeat(cvs, K, axis=1)
+            return toks.astype(jnp.int32), scores, cks, cvs
+
+        def step(p, cks, cvs, hist, scores, fin, pos, t):
+            # t is TRACED (indexed reads/scatters take traced indices):
+            # one executable serves every decode step
+            cur = jnp.take_along_axis(
+                hist, (t - 1)[None, None, None], axis=2)[:, :, 0]
+            cur = cur.reshape(B * K)
+            logits, cks, cvs = decode_f(p, cks, cvs, cur, pos)
+            logp = _logp(logits).reshape(B, K, V)
+            if eos_token_id is not None:
+                # a finished beam only extends with eos, at zero cost —
+                # its score is frozen while it stays comparable
+                eos_row = jnp.full((V,), NEG).at[eos_token_id].set(0.0)
+                logp = jnp.where(fin[:, :, None], eos_row[None, None],
+                                 logp)
+            total = scores[:, :, None] + logp           # [B, K, V]
+            new_scores, flat = jax.lax.top_k(total.reshape(B, K * V), K)
+            parent = flat // V                          # [B, K]
+            token = (flat % V).astype(jnp.int32)
+            # reorder histories and caches by parent beam; the write at
+            # traced t is a dynamic scatter (one executable, all steps)
+            hist = jnp.take_along_axis(hist, parent[:, :, None], axis=1)
+            hist = jax.vmap(jax.vmap(
+                lambda row, tok: jax.lax.dynamic_update_index_in_dim(
+                    row, tok, t, 0)))(hist, token)
+            gidx = (jnp.arange(B)[:, None] * K + parent).reshape(-1)
+            cks = jnp.take(cks, gidx, axis=1)
+            cvs = jnp.take(cvs, gidx, axis=1)
+            if eos_token_id is not None:
+                fin = jnp.take_along_axis(fin, parent, axis=1) | \
+                    (token == eos_token_id)
+            return hist, new_scores, fin, cks, cvs
+
+        cache = getattr(self, "_gen_jit_cache", None)
+        if cache is None:
+            cache = self._gen_jit_cache = {}
+        kp = ("beam_prefill", B, T0, K)
+        kd = ("beam_step", B, K, max_new_tokens, eos_token_id,
+              temperature)
+        if kp not in cache:
+            cache[kp] = jax.jit(prefill)
+        if kd not in cache:
+            cache[kd] = jax.jit(step, donate_argnums=(1, 2))
+        toks, scores, cks, cvs = cache[kp](params, ids0)
+        hist = jnp.zeros((B, K, max_new_tokens), jnp.int32)
+        hist = hist.at[:, :, 0].set(toks)
+        fin = (toks == eos_token_id) if eos_token_id is not None \
+            else jnp.zeros((B, K), bool)
+        for t in range(1, max_new_tokens):
+            hist, scores, fin, cks, cvs = cache[kd](
+                params, cks, cvs, hist, scores, fin,
+                jnp.int32(T0 + t - 1), jnp.int32(t))
+        # pick the best beam under the reference's length penalty
+        lengths = jnp.full((B, K), max_new_tokens, jnp.float32)
+        if eos_token_id is not None:
+            is_eos = hist == eos_token_id
+            first = jnp.argmax(is_eos, axis=-1)
+            has = is_eos.any(-1)
+            lengths = jnp.where(has, first + 1.0, lengths)
+        best = jnp.argmax(scores / (lengths ** length_penalty), axis=-1)
+        seq = jnp.take_along_axis(hist, best[:, None, None],
+                                  axis=1)[:, 0]        # [B, max_new]
+        return Tensor(jnp.concatenate([ids0, seq], axis=1))
 
 
 def gpt2_small(**kw):

@@ -22,27 +22,37 @@ class StatisticData:
         self.events = list(events)
 
     def totals(self):
+        """name -> (calls, total_ms, max_ms, min_ms)."""
         out = {}
         for e in self.events:
             name = getattr(e, "name", str(e))
             dur = float(getattr(e, "duration_ms", 0.0))
-            cnt, tot = out.get(name, (0, 0.0))
-            out[name] = (cnt + 1, tot + dur)
+            cnt, tot, mx, mn = out.get(name, (0, 0.0, 0.0, float("inf")))
+            out[name] = (cnt + 1, tot + dur, max(mx, dur), min(mn, dur))
         return out
 
 
 def _build_table(statistic_data, sorted_by=None, op_detail=True,
                  thread_sep=False, time_unit="ms", row_limit=100,
                  max_src_column_width=75):
-    """Reference-shaped text table of event totals."""
+    """Reference-shaped text table of event totals, sorted per
+    SortedKeys (total / avg / max / min — the CPU-side keys; there is
+    no separate GPU timeline on this substrate)."""
     totals = statistic_data.totals()
-    key = (lambda kv: -kv[1][1])
-    if sorted_by == SortedKeys.CPUMax:
+    name_of = getattr(sorted_by, "name", "") or ""
+    if "Max" in name_of:
+        key = (lambda kv: -kv[1][2])
+    elif "Min" in name_of:
+        key = (lambda kv: kv[1][3])
+    elif "Avg" in name_of:
+        key = (lambda kv: -(kv[1][1] / max(kv[1][0], 1)))
+    else:  # total time (the reference default)
         key = (lambda kv: -kv[1][1])
     rows = sorted(totals.items(), key=key)[:row_limit]
     width = max([len("Name")] + [len(n) for n, _ in rows]) + 2
-    lines = [f"{'Name':<{width}}{'Calls':>8}{'Total(ms)':>12}"]
-    lines.append("-" * (width + 20))
-    for name, (cnt, tot) in rows:
-        lines.append(f"{name:<{width}}{cnt:>8}{tot:>12.3f}")
+    lines = [f"{'Name':<{width}}{'Calls':>8}{'Total(ms)':>12}"
+             f"{'Max(ms)':>10}"]
+    lines.append("-" * (width + 30))
+    for name, (cnt, tot, mx, _mn) in rows:
+        lines.append(f"{name:<{width}}{cnt:>8}{tot:>12.3f}{mx:>10.3f}")
     return "\n".join(lines)

@@ -159,7 +159,7 @@ def test_profiler_statistic_path():
 
     sd = ps.StatisticData([E("matmul", 1.5), E("matmul", 0.5),
                            E("conv", 2.0)])
-    assert sd.totals()["matmul"] == (2, 2.0)
+    assert sd.totals()["matmul"][:2] == (2, 2.0)
     table = ps._build_table(sd)
     assert "matmul" in table and "conv" in table
     assert ps.SortedKeys is not None

@@ -20,7 +20,12 @@ def main():
         np.random.RandomState(0).randint(0, 50304, (4, 16)))
     out = model.generate(prompt, max_new_tokens=32, top_k=40,
                          temperature=0.9)
-    print("generated ids:", np.asarray(out.numpy())[0, -8:])
+    print("top-k ids:", np.asarray(out.numpy())[0, -8:])
+    out = model.generate(prompt, max_new_tokens=32, top_p=0.9)
+    print("top-p ids:", np.asarray(out.numpy())[0, -8:])
+    out = model.generate(prompt, max_new_tokens=32, num_beams=4,
+                         length_penalty=0.8)
+    print("beam-4 ids:", np.asarray(out.numpy())[0, -8:])
 
 
 if __name__ == "__main__":

@@ -3,6 +3,9 @@ from .bert import (  # noqa: F401
     BertConfig, BertForMaskedLM, BertForPretraining,
     BertForSequenceClassification, BertModel, bert_base, bert_large,
 )
+from .transformer import (  # noqa: F401
+    TransformerConfig, TransformerModel, transformer_base, transformer_big,
+)
 from .gpt import (  # noqa: F401
     GPTConfig, GPTForCausalLM, GPTModel, gpt2_345m, gpt2_large, gpt2_medium,
     gpt2_small,

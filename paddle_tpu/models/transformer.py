@@ -1,0 +1,188 @@
+"""Seq2seq Transformer flagship — the reference's machine-translation
+benchmark family (capability reference: the WMT transformer the
+reference ships datasets for — text/datasets wmt14/wmt16 — trained with
+nn.Transformer per python/paddle/nn/layer/transformer.py; the fluid-era
+transformer benchmark is the same architecture).
+
+TPU-native: teacher-forcing training is one traced program (sinusoidal
+positions precomputed, causal mask static); greedy/sampled decode rides
+the nn.TransformerDecoder incremental Cache machinery (cross-attention
+K/V computed once as a StaticCache, self-attention caches grow
+incrementally).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from .. import nn
+from ..core.tensor import Tensor
+from ..nn.layer.layers import Layer
+
+__all__ = ["TransformerConfig", "TransformerModel", "transformer_base",
+           "transformer_big"]
+
+
+class TransformerConfig:
+    def __init__(self, src_vocab_size=32000, tgt_vocab_size=32000,
+                 d_model=512, nhead=8, num_encoder_layers=6,
+                 num_decoder_layers=6, dim_feedforward=2048, dropout=0.1,
+                 max_length=256, bos_id=0, eos_id=1, pad_id=0,
+                 share_embedding=False):
+        self.src_vocab_size = src_vocab_size
+        self.tgt_vocab_size = tgt_vocab_size
+        self.d_model = d_model
+        self.nhead = nhead
+        self.num_encoder_layers = num_encoder_layers
+        self.num_decoder_layers = num_decoder_layers
+        self.dim_feedforward = dim_feedforward
+        self.dropout = dropout
+        self.max_length = max_length
+        self.bos_id = bos_id
+        self.eos_id = eos_id
+        self.pad_id = pad_id
+        self.share_embedding = share_embedding
+        if share_embedding and src_vocab_size != tgt_vocab_size:
+            raise ValueError(
+                f"share_embedding requires src_vocab_size "
+                f"({src_vocab_size}) == tgt_vocab_size ({tgt_vocab_size})"
+                " — the tied table serves both sides")
+
+
+def _sinusoid_table(max_len, d_model):
+    pos = np.arange(max_len)[:, None]
+    i = np.arange(d_model)[None, :]
+    angle = pos / np.power(10000.0, 2 * (i // 2) / d_model)
+    table = np.zeros((max_len, d_model), np.float32)
+    table[:, 0::2] = np.sin(angle[:, 0::2])
+    table[:, 1::2] = np.cos(angle[:, 1::2])
+    return table
+
+
+class TransformerModel(Layer):
+    """Encoder-decoder translation model over nn.Transformer."""
+
+    def __init__(self, config: TransformerConfig):
+        super().__init__()
+        c = self.config = config
+        init = nn.initializer.Normal(0.0, c.d_model ** -0.5)
+        from ..framework.param_attr import ParamAttr
+
+        self.src_embed = nn.Embedding(
+            c.src_vocab_size, c.d_model,
+            weight_attr=ParamAttr(initializer=init))
+        self.tgt_embed = self.src_embed if c.share_embedding else \
+            nn.Embedding(c.tgt_vocab_size, c.d_model,
+                         weight_attr=ParamAttr(initializer=init))
+        self.transformer = nn.Transformer(
+            d_model=c.d_model, nhead=c.nhead,
+            num_encoder_layers=c.num_encoder_layers,
+            num_decoder_layers=c.num_decoder_layers,
+            dim_feedforward=c.dim_feedforward, dropout=c.dropout)
+        self.dropout = nn.Dropout(c.dropout)
+        self._pos = jnp.asarray(_sinusoid_table(c.max_length, c.d_model))
+        self._scale = float(np.sqrt(c.d_model))
+
+    def _embed(self, table, ids):
+        s = ids.shape[1]
+        if s > self.config.max_length:
+            raise ValueError(
+                f"sequence length {s} exceeds config.max_length "
+                f"{self.config.max_length} (the sinusoid table size)")
+        x = table(ids) * self._scale
+        return self.dropout(x + Tensor(self._pos[:s][None]))
+
+    def _masks(self, src_ids, tgt_len):
+        from .. import tensor as T
+
+        c = self.config
+        # src padding mask [B, 1, 1, S]: pad positions get -inf scores
+        pad = T.cast(T.equal(src_ids, T.full_like(src_ids, c.pad_id)),
+                     "float32") * -1e9
+        src_mask = T.unsqueeze(pad, [1, 2])
+        causal = np.triu(np.full((tgt_len, tgt_len), -1e9, np.float32), 1)
+        tgt_mask = Tensor(jnp.asarray(causal)[None, None])
+        return src_mask, tgt_mask
+
+    def forward(self, src_ids, tgt_ids, labels=None):
+        """Teacher forcing: tgt_ids are decoder inputs (bos-shifted);
+        labels, when given, return the mean CE over non-pad positions."""
+        from .. import tensor as T
+
+        src_mask, tgt_mask = self._masks(src_ids, tgt_ids.shape[1])
+        mem = self.transformer.encoder(self._embed(self.src_embed,
+                                                   src_ids), src_mask)
+        out = self.transformer.decoder(self._embed(self.tgt_embed,
+                                                   tgt_ids), mem,
+                                       tgt_mask, src_mask)
+        # generator head tied to the target embedding (standard WMT
+        # recipe: logits against the transposed table)
+        logits = T.matmul(out, self.tgt_embed.weight, transpose_y=True)
+        if labels is None:
+            return logits
+        c = self.config
+        flat = T.reshape(logits, [-1, c.tgt_vocab_size])
+        lab = T.reshape(labels, [-1])
+        loss = nn.functional.cross_entropy(flat, lab, reduction="none")
+        keep = T.cast(T.not_equal(lab, T.full_like(lab, c.pad_id)),
+                      "float32")
+        return T.sum(loss * keep) / T.clip(T.sum(keep), 1.0, None)
+
+    def generate(self, src_ids, max_length=None, bos_id=None, eos_id=None):
+        """Greedy incremental decode over the Cache machinery: the
+        cross-attention K/V are computed ONCE from the encoder memory
+        (StaticCache); each step feeds one token."""
+        from .. import tensor as T
+        from ..core.autograd import no_grad
+
+        c = self.config
+        max_length = max_length or c.max_length
+        if max_length > c.max_length:
+            raise ValueError(
+                f"max_length {max_length} exceeds config.max_length "
+                f"{c.max_length} (positions past the sinusoid table "
+                "would silently clamp)")
+        bos = c.bos_id if bos_id is None else bos_id
+        eos = c.eos_id if eos_id is None else eos_id
+        with no_grad():
+            B = src_ids.shape[0]
+            src_mask, _ = self._masks(src_ids, 1)
+            mem = self.transformer.encoder(
+                self._embed(self.src_embed, src_ids), src_mask)
+            caches = self.transformer.decoder.gen_cache(mem)
+            ids = T.full([B, 1], bos, dtype="int64")
+            cur = ids
+            done = np.zeros(B, bool)
+            for t in range(max_length - 1):
+                x = self.tgt_embed(cur) * self._scale + \
+                    Tensor(self._pos[t][None, None])
+                out, caches = self.transformer.decoder(
+                    x, mem, None, src_mask, cache=caches)
+                logits = T.matmul(out[:, -1], self.tgt_embed.weight,
+                                  transpose_y=True)
+                nxt = T.unsqueeze(T.argmax(logits, -1), -1)
+                nxt = T.cast(nxt, "int64")
+                # rows past their eos are FROZEN to pad (consumers mask
+                # on pad_id; a live tail would read as real tokens)
+                if done.any():
+                    frozen = Tensor(jnp.asarray(done)[:, None])
+                    nxt = T.where(frozen, T.full_like(nxt, c.pad_id), nxt)
+                ids = T.concat([ids, nxt], axis=1)
+                cur = nxt
+                done |= np.asarray(nxt.numpy())[:, 0] == eos
+                if done.all():
+                    break
+            return ids
+
+
+def transformer_base(**kw):
+    """The WMT base config (d512, 6+6, ffn 2048)."""
+    return TransformerModel(TransformerConfig(**kw))
+
+
+def transformer_big(**kw):
+    kw.setdefault("d_model", 1024)
+    kw.setdefault("nhead", 16)
+    kw.setdefault("dim_feedforward", 4096)
+    return TransformerModel(TransformerConfig(**kw))

@@ -398,3 +398,42 @@ class TestMetaParallelNamespace:
         with pytest.raises(AttributeError, match="strategy.recompute"):
             mo.RecomputeOptimizer
         assert not hasattr(mo, "AMPOptimizer")  # probes degrade
+
+
+class TestRingAttentionLongContext:
+    """Long-context is first-class: exact parity and finite grads at a
+    sequence length where ring attention actually earns its keep
+    (seq 2048 over the full 8-way sp ring; per-device shard 256)."""
+
+    def test_parity_seq_2048(self):
+        mesh = Mesh(np.array(jax.devices()), ("sp",))
+        rng = np.random.RandomState(0)
+        b, h, s, d = 1, 2, 2048, 16
+        q, k, v = (rng.randn(b, h, s, d).astype(np.float32)
+                   for _ in range(3))
+        out = np.asarray(dist.ring_attention(
+            jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), mesh=mesh,
+            causal=True))
+        sc = 1.0 / np.sqrt(d)
+        logits = np.einsum("bhqd,bhkd->bhqk", q, k) * sc
+        logits = np.where(np.tril(np.ones((s, s), bool)), logits, -1e30)
+        p = np.exp(logits - logits.max(-1, keepdims=True))
+        p /= p.sum(-1, keepdims=True)
+        ref = np.einsum("bhqk,bhkd->bhqd", p, v)
+        np.testing.assert_allclose(out, ref, rtol=3e-4, atol=3e-5)
+
+    def test_grads_finite_seq_2048(self):
+        mesh = Mesh(np.array(jax.devices()), ("sp",))
+        dist.set_mesh(mesh)
+        try:
+            paddle.seed(0)
+            q = paddle.randn([1, 2, 2048, 16])
+            q.stop_gradient = False
+            k = paddle.randn([1, 2, 2048, 16])
+            v = paddle.randn([1, 2, 2048, 16])
+            out = dist.ring_attention(q, k, v, causal=True)
+            out.mean().backward()
+            g = np.asarray(q.grad._value)
+            assert np.isfinite(g).all() and np.abs(g).max() > 0
+        finally:
+            dist.set_mesh(None)

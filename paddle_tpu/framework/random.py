@@ -20,9 +20,25 @@ default_seed = 0
 
 
 class _KeyStore(threading.local):
+    """The root key is created LAZILY: building a PRNGKey is a device
+    computation, and doing it at `import paddle_tpu` time would
+    initialize the jax backend as an import side effect (on a wedged
+    TPU tunnel, the import itself hangs; everywhere else it front-loads
+    seconds of backend init into the import)."""
+
     def __init__(self):
-        self.key = jax.random.PRNGKey(default_seed)
+        self._key = None
         self.scopes = []  # stack of [key] single-element lists (mutable cells)
+
+    @property
+    def key(self):
+        if self._key is None:
+            self._key = jax.random.PRNGKey(default_seed)
+        return self._key
+
+    @key.setter
+    def key(self, value):
+        self._key = value
 
 
 _store = _KeyStore()

@@ -114,3 +114,42 @@ def test_hub_local_source(tmp_path):
 
     with _pytest.raises(RuntimeError):
         paddle.hub.load(str(tmp_path), "tiny_model", source="github")
+
+
+def test_bare_import_does_not_init_backend():
+    """import paddle_tpu must not touch a device (a PRNGKey built at
+    import time used to initialize the backend — hanging the import
+    whenever the device was unreachable)."""
+    import subprocess
+    import sys
+
+    code = (
+        "import jax\n"
+        "import paddle_tpu\n"
+        "from jax._src import xla_bridge as xb\n"
+        "assert not xb._backends, f'backends inited: {list(xb._backends)}'\n"
+        "print('LAZY-OK')\n")
+    out = subprocess.run([sys.executable, "-c", code], timeout=180,
+                         capture_output=True, text=True)
+    assert "LAZY-OK" in out.stdout, (out.stdout[-300:], out.stderr[-300:])
+
+
+def test_distributed_launch_cli(tmp_path):
+    """python -m paddle_tpu.distributed.launch script.py runs the script
+    with the trainer env exported (reference launch contract)."""
+    import subprocess
+    import sys
+
+    script = tmp_path / "worker.py"
+    script.write_text(
+        "import os\n"
+        "import jax; jax.config.update('jax_platforms', 'cpu')\n"
+        "import paddle_tpu as paddle\n"
+        "assert os.environ['PADDLE_TRAINER_ID'] == '0'\n"
+        "print('WORKER-OK', paddle.distributed.get_rank())\n")
+    out = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         str(script)], timeout=240, capture_output=True, text=True,
+        cwd="/root/repo")
+    assert "WORKER-OK 0" in out.stdout, (out.stdout[-300:],
+                                         out.stderr[-300:])

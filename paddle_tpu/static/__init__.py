@@ -160,19 +160,37 @@ def accuracy(input, label, k=1, correct=None, total=None):  # noqa: A002
 
 def auc(input, label, curve="ROC", num_thresholds=4095, topk=1,  # noqa: A002
         slide_steps=1):
-    import numpy as np
-
-    from ..core.tensor import Tensor
-    from ..metric import Auc
-
-    m = Auc(num_thresholds=num_thresholds)
-    pred = np.asarray(input._value)
-    if pred.ndim == 1 or pred.shape[-1] == 1:
-        pred = np.stack([1 - pred.ravel(), pred.ravel()], -1)
-    m.update(pred, np.asarray(label._value))
+    """Binned ROC-AUC as a TRACED op (the numpy version concretized at
+    static-program build time and baked the dummy-feed result — the
+    same failure the accuracy op had). Same histogram binning as
+    metric.Auc: predictions bucketed into num_thresholds bins,
+    trapezoid over the cumulative TPR/FPR curve."""
     import jax.numpy as jnp
 
-    val = Tensor(jnp.float32(m.accumulate()))
+    from ..core.autograd import apply
+
+    T = int(num_thresholds)
+
+    def _f(pred, lab):
+        # column 1 = positive-class probability, matching metric.Auc
+        # (two-class contract; [N] and [N,1] inputs are raw scores)
+        p = pred[:, 1] if pred.ndim == 2 and pred.shape[-1] > 1 \
+            else pred.reshape(-1)
+        y = lab.reshape(-1).astype(jnp.float32)
+        idx = jnp.clip((p * T).astype(jnp.int32), 0, T)
+        pos = jnp.zeros(T + 1, jnp.float32).at[idx].add(y)
+        neg = jnp.zeros(T + 1, jnp.float32).at[idx].add(1.0 - y)
+        # sweep threshold from high to low: cumulative TP/FP counts
+        tp = jnp.cumsum(pos[::-1])
+        fp = jnp.cumsum(neg[::-1])
+        tpr = tp / jnp.maximum(tp[-1], 1e-12)
+        fpr = fp / jnp.maximum(fp[-1], 1e-12)
+        tpr = jnp.concatenate([jnp.zeros(1), tpr])
+        fpr = jnp.concatenate([jnp.zeros(1), fpr])
+        return (jnp.diff(fpr) * (tpr[1:] + tpr[:-1]) * 0.5).sum()
+
+    _f.__name__ = "auc"
+    val = apply(_f, input, label)
     return val, val, val
 
 

@@ -153,3 +153,41 @@ def test_static_accuracy_is_traced_not_baked():
         assert float(np.asarray(av2).ravel()[0]) == 1.0
     finally:
         paddle.disable_static()
+
+
+def _ref_auc(scores, labels):
+    order = np.argsort(-scores)
+    y = labels[order]
+    tp = np.cumsum(y); fp = np.cumsum(1 - y)
+    tpr = np.concatenate([[0], tp / max(tp[-1], 1e-12)])
+    fpr = np.concatenate([[0], fp / max(fp[-1], 1e-12)])
+    trap = getattr(np, "trapezoid", None) or np.trapz
+    return float(trap(tpr, fpr))
+
+
+def test_static_auc_is_traced_not_baked():
+    """static.auc must be a traced op (same bug class as accuracy: the
+    numpy version baked the dummy-feed AUC into the program)."""
+    paddle.enable_static()
+    try:
+        main = paddle.static.Program()
+        with paddle.static.program_guard(main):
+            p = paddle.static.data("p", [64, 2], "float32")
+            y = paddle.static.data("y", [64, 1], "int64")
+            a, _, _ = paddle.static.auc(p, y, num_thresholds=8191)
+        exe = paddle.static.Executor()
+        rng = np.random.RandomState(0)
+        scores = rng.rand(64).astype("float32")
+        labels = (scores + rng.randn(64) * 0.3 > 0.5).astype("int64")
+        pred = np.stack([1 - scores, scores], -1)
+        (av,) = exe.run(main, feed={"p": pred, "y": labels[:, None]},
+                        fetch_list=[a])
+        ref = _ref_auc(scores, labels.astype(np.float64))
+        np.testing.assert_allclose(float(np.asarray(av)), ref, atol=5e-3)
+        # different feed MUST change the result (nothing baked)
+        labels2 = 1 - labels
+        (av2,) = exe.run(main, feed={"p": pred, "y": labels2[:, None]},
+                         fetch_list=[a])
+        assert abs(float(np.asarray(av2)) - float(np.asarray(av))) > 0.1
+    finally:
+        paddle.disable_static()

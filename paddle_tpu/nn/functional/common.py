@@ -364,6 +364,15 @@ def sequence_mask(x, maxlen=None, dtype="int64", name=None):
     if isinstance(ml, Tensor):
         ml = int(ml._value)
     if ml is None:
+        from ...framework.mode import in_static_mode
+
+        if in_static_mode():
+            # the data-derived max would be read off the BUILD-TIME dummy
+            # feed and baked into the program (the accuracy/auc bug class)
+            raise ValueError(
+                "sequence_mask(maxlen=None) cannot derive the length "
+                "inside a static program (output shape would bake from "
+                "the dummy feed); pass maxlen explicitly")
         ml = int(np.asarray(x._value).max())
 
     def _f(v):
@@ -384,6 +393,13 @@ def bilinear(x1, x2, weight, bias=None, name=None):
 
 def class_center_sample(label, num_classes, num_samples, group=None):
     # simplified: returns remapped labels + sampled class centers
+    from ...framework.mode import in_static_mode
+
+    if in_static_mode():
+        raise ValueError(
+            "class_center_sample is data-dependent (unique label count "
+            "drives the output) and cannot be recorded into a static "
+            "program; call it in dygraph mode")
     lab = np.asarray(label._value)
     pos = np.unique(lab)
     extra = num_samples - len(pos)

@@ -241,3 +241,23 @@ def test_inplace_tensor_ops():
     m = paddle.to_tensor(np.array([[4.0, 0.0], [0.0, 2.0]], np.float32))
     np.testing.assert_allclose(paddle.inverse(m).numpy(),
                                [[0.25, 0], [0, 0.5]], rtol=1e-6)
+
+
+def test_data_dependent_ops_refuse_static_baking():
+    """sequence_mask(maxlen=None) / class_center_sample read data off
+    the build-time dummy feed under static mode — they must refuse
+    instead of baking (the accuracy/auc bug class)."""
+    paddle.enable_static()
+    try:
+        main = paddle.static.Program()
+        with paddle.static.program_guard(main):
+            x = paddle.static.data("x", [4], "int64")
+            with pytest.raises(ValueError, match="maxlen"):
+                nn.functional.sequence_mask(x)
+            # explicit maxlen stays fine
+            m = nn.functional.sequence_mask(x, maxlen=8)
+            assert m.shape[-1] == 8
+            with pytest.raises(ValueError, match="dygraph"):
+                nn.functional.class_center_sample(x, 10, 4)
+    finally:
+        paddle.disable_static()

@@ -204,6 +204,14 @@ def bench_gpt(batch=8, seq=512, steps=20, warmup=3):
     rng = np.random.RandomState(0)
     ids = jnp.asarray(rng.randint(0, cfg.vocab_size, (batch, seq)))
     labels = jnp.asarray(rng.randint(0, cfg.vocab_size, (batch, seq)))
+    compiled = jit_step.lower(params, states, ids, labels).compile()
+    try:
+        cost = compiled.cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0]
+        step_flops = float(cost.get("flops", 0)) if cost else 0.0
+    except Exception:  # noqa: BLE001
+        step_flops = 0.0
     for _ in range(warmup):
         params, states, loss = jit_step(params, states, ids, labels)
     _sync(loss)
@@ -212,9 +220,13 @@ def bench_gpt(batch=8, seq=512, steps=20, warmup=3):
         params, states, loss = jit_step(params, states, ids, labels)
     _sync(loss)
     dt = time.perf_counter() - t0
-    return {"gpt_tokens_per_sec": steps * batch * seq / dt,
-            "gpt_step_ms": dt / steps * 1e3,
-            "gpt_loss": float(loss)}
+    out = {"gpt_tokens_per_sec": steps * batch * seq / dt,
+           "gpt_step_ms": dt / steps * 1e3,
+           "gpt_loss": float(loss)}
+    peak = _chip_peak_flops()
+    if step_flops > 0 and peak:
+        out["gpt_mfu"] = (step_flops / (dt / steps)) / peak
+    return out
 
 
 def bench_resnet50(batch=64, steps=20, warmup=3):

@@ -1,0 +1,95 @@
+"""Pretrained-weight plumbing for the vision model zoo (reference:
+python/paddle/vision/models/*.py model_urls + hapi download).
+
+Zero-egress deployment: `pretrained=True` resolves the OFFICIAL weight
+URL against the local cache (~/.cache/paddle/hapi/weights) via
+utils.download — a pre-placed or file://-sideloaded .pdparams loads
+exactly like the reference; a cache miss raises the loud zero-egress
+error naming the path to pre-place, which beats the old flat
+NotImplementedError because it makes sideloading actually work.
+"""
+from __future__ import annotations
+
+__all__ = ["load_pretrained", "WEIGHT_URLS"]
+
+# (url, md5) pairs exactly as published by the reference model zoo
+# (reference vision/models/{resnet,vgg,mobilenetv1,mobilenetv2,densenet,
+# resnext,squeezenet}.py model_urls)
+_HAPI = "https://paddle-hapi.bj.bcebos.com/models/"
+_IMN = ("https://paddle-imagenet-models-name.bj.bcebos.com/dygraph/")
+WEIGHT_URLS = {
+    "resnet18": (_HAPI + "resnet18.pdparams",
+                 "cf548f46534aa3560945be4b95cd11c4"),
+    "resnet34": (_HAPI + "resnet34.pdparams",
+                 "8d2275cf8706028345f78ac0e1d31969"),
+    "resnet50": (_HAPI + "resnet50.pdparams",
+                 "ca6f485ee1ab0492d38f323885b0ad80"),
+    "resnet101": (_HAPI + "resnet101.pdparams",
+                  "02f35f034ca3858e1e54d4036443c92d"),
+    "resnet152": (_HAPI + "resnet152.pdparams",
+                  "7ad16a2f1e7333859ff986138630fd7a"),
+    "wide_resnet50_2": (_HAPI + "wide_resnet50_2.pdparams",
+                        "0282f804d73debdab289bd9fea3fa6dc"),
+    "wide_resnet101_2": (_HAPI + "wide_resnet101_2.pdparams",
+                         "d4360a2d23657f059216f5d5a1a9ac93"),
+    "vgg16": (_HAPI + "vgg16.pdparams",
+              "89bbffc0f87d260be9b8cdc169c991c4"),
+    "vgg19": (_HAPI + "vgg19.pdparams",
+              "23b18bb13d8894f60f54e642be79a0dd"),
+    "mobilenetv1_1.0": (_HAPI + "mobilenet_v1_x1.0.pdparams",
+                        "42a154c2f26f86e7457d6daded114e8c"),
+    "mobilenetv2_1.0": (_HAPI + "mobilenet_v2_x1.0.pdparams",
+                        "0340af0a901346c8d46f4529882fb63d"),
+    "densenet121": (_IMN + "DenseNet121_pretrained.pdparams",
+                    "db1b239ed80a905290fd8b01d3af08e4"),
+    "densenet161": (_IMN + "DenseNet161_pretrained.pdparams",
+                    "62158869cb315098bd25ddbfd308a853"),
+    "densenet169": (_IMN + "DenseNet169_pretrained.pdparams",
+                    "82cc7c635c3f19098c748850efb2d796"),
+    "densenet201": (_IMN + "DenseNet201_pretrained.pdparams",
+                    "16ca29565a7712329cf9e36e02caaf58"),
+    "densenet264": (_IMN + "DenseNet264_pretrained.pdparams",
+                    "3270ce516b85370bba88cfdd9f60bff4"),
+    "resnext50_32x4d": (_IMN + "ResNeXt50_32x4d_pretrained.pdparams",
+                        "bf04add2f7fd22efcbe91511bcd1eebe"),
+    "resnext50_64x4d": (_IMN + "ResNeXt50_64x4d_pretrained.pdparams",
+                        "46307df0e2d6d41d3b1c1d22b00abc69"),
+    "resnext101_32x4d": (_IMN + "ResNeXt101_32x4d_pretrained.pdparams",
+                         "078ca145b3bea964ba0544303a43c36d"),
+    "resnext101_64x4d": (_IMN + "ResNeXt101_64x4d_pretrained.pdparams",
+                         "4edc0eb32d3cc5d80eff7cab32cd5c64"),
+    "resnext152_32x4d": (_IMN + "ResNeXt152_32x4d_pretrained.pdparams",
+                         "7971cc994d459af167c502366f866378"),
+    "resnext152_64x4d": (_IMN + "ResNeXt152_64x4d_pretrained.pdparams",
+                         "836943f03709efec364d486c57d132de"),
+    "squeezenet1_0": (_IMN + "SqueezeNet1_0_pretrained.pdparams",
+                      "30b95af60a2178f03cf9b66cd77e1db1"),
+    "squeezenet1_1": (_IMN + "SqueezeNet1_1_pretrained.pdparams",
+                      "a11250d3a1f91d7131fd095ebbf09eee"),
+}
+
+
+def load_pretrained(model, arch):
+    """Resolve arch's official weights through the local cache and load
+    them into `model` (md5-checked)."""
+    if arch not in WEIGHT_URLS:
+        raise NotImplementedError(
+            f"no published weights for '{arch}'; load a state_dict with "
+            "model.set_state_dict instead")
+    url, md5 = WEIGHT_URLS[arch]
+    from ...framework.io import load
+    from ...utils.download import get_weights_path_from_url
+
+    path = get_weights_path_from_url(url, md5)
+    result = model.set_state_dict(load(path))
+    if isinstance(result, tuple):
+        missing, unexpected = result
+        if missing or unexpected:
+            # a silently-partial load would claim "pretrained" on random
+            # init; refuse with the key diff
+            raise ValueError(
+                f"pretrained weights for '{arch}' do not match the "
+                f"model: {len(missing)} missing keys "
+                f"(e.g. {missing[:3]}), {len(unexpected)} unexpected "
+                f"(e.g. {unexpected[:3]})")
+    return model

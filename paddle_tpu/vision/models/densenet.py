@@ -78,7 +78,10 @@ class DenseNet(nn.Layer):
 
 def _densenet(layers, pretrained=False, **kwargs):
     if pretrained:
-        raise NotImplementedError("pretrained weights unavailable offline")
+        from ._pretrained import load_pretrained
+
+        model = DenseNet(layers, **kwargs)
+        return load_pretrained(model, f"densenet{layers}")
     return DenseNet(layers=layers, **kwargs)
 
 

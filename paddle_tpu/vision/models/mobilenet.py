@@ -238,26 +238,29 @@ class MobileNetV3Small(_MobileNetV3):
         super().__init__(_V3_SMALL, 1024, scale, num_classes, with_pool)
 
 
-def _no_pretrained(pretrained):
+def _maybe_pretrained(model, pretrained, arch):
     if pretrained:
-        raise NotImplementedError("pretrained weights unavailable offline")
+        from ._pretrained import load_pretrained
+
+        load_pretrained(model, arch)
+    return model
 
 
 def mobilenet_v1(pretrained=False, scale=1.0, **kwargs):
-    _no_pretrained(pretrained)
-    return MobileNetV1(scale=scale, **kwargs)
+    return _maybe_pretrained(MobileNetV1(scale=scale, **kwargs),
+                             pretrained, f"mobilenetv1_{float(scale)}")
 
 
 def mobilenet_v2(pretrained=False, scale=1.0, **kwargs):
-    _no_pretrained(pretrained)
-    return MobileNetV2(scale=scale, **kwargs)
+    return _maybe_pretrained(MobileNetV2(scale=scale, **kwargs),
+                             pretrained, f"mobilenetv2_{float(scale)}")
 
 
 def mobilenet_v3_small(pretrained=False, scale=1.0, **kwargs):
-    _no_pretrained(pretrained)
-    return MobileNetV3Small(scale=scale, **kwargs)
+    return _maybe_pretrained(MobileNetV3Small(scale=scale, **kwargs),
+                             pretrained, f"mobilenetv3_small_{float(scale)}")
 
 
 def mobilenet_v3_large(pretrained=False, scale=1.0, **kwargs):
-    _no_pretrained(pretrained)
-    return MobileNetV3Large(scale=scale, **kwargs)
+    return _maybe_pretrained(MobileNetV3Large(scale=scale, **kwargs),
+                             pretrained, f"mobilenetv3_large_{float(scale)}")

@@ -132,12 +132,12 @@ class ResNet(nn.Layer):
         return x
 
 
-def _resnet(block, depth, pretrained=False, **kwargs):
+def _resnet(block, depth, pretrained=False, arch=None, **kwargs):
     model = ResNet(block, depth, **kwargs)
     if pretrained:
-        raise NotImplementedError(
-            "pretrained weights unavailable in this offline build; "
-            "load a state_dict with model.set_state_dict instead")
+        from ._pretrained import load_pretrained
+
+        load_pretrained(model, arch or f"resnet{depth}")
     return model
 
 
@@ -162,41 +162,41 @@ def resnet152(pretrained=False, **kwargs):
 
 
 def resnext50_32x4d(pretrained=False, **kwargs):
-    return _resnet(BottleneckBlock, 50, pretrained, groups=32, width=4,
+    return _resnet(BottleneckBlock, 50, pretrained, arch="resnext50_32x4d", groups=32, width=4,
                    **kwargs)
 
 
 def resnext50_64x4d(pretrained=False, **kwargs):
-    return _resnet(BottleneckBlock, 50, pretrained, groups=64, width=4,
+    return _resnet(BottleneckBlock, 50, pretrained, arch="resnext50_64x4d", groups=64, width=4,
                    **kwargs)
 
 
 def resnext101_32x4d(pretrained=False, **kwargs):
-    return _resnet(BottleneckBlock, 101, pretrained, groups=32, width=4,
+    return _resnet(BottleneckBlock, 101, pretrained, arch="resnext101_32x4d", groups=32, width=4,
                    **kwargs)
 
 
 def resnext101_64x4d(pretrained=False, **kwargs):
-    return _resnet(BottleneckBlock, 101, pretrained, groups=64, width=4,
+    return _resnet(BottleneckBlock, 101, pretrained, arch="resnext101_64x4d", groups=64, width=4,
                    **kwargs)
 
 
 def resnext152_32x4d(pretrained=False, **kwargs):
-    return _resnet(BottleneckBlock, 152, pretrained, groups=32, width=4,
+    return _resnet(BottleneckBlock, 152, pretrained, arch="resnext152_32x4d", groups=32, width=4,
                    **kwargs)
 
 
 def resnext152_64x4d(pretrained=False, **kwargs):
-    return _resnet(BottleneckBlock, 152, pretrained, groups=64, width=4,
+    return _resnet(BottleneckBlock, 152, pretrained, arch="resnext152_64x4d", groups=64, width=4,
                    **kwargs)
 
 
 def wide_resnet50_2(pretrained=False, **kwargs):
-    return _resnet(BottleneckBlock, 50, pretrained, width=128, **kwargs)
+    return _resnet(BottleneckBlock, 50, pretrained, arch="wide_resnet50_2", width=128, **kwargs)
 
 
 def wide_resnet101_2(pretrained=False, **kwargs):
-    return _resnet(BottleneckBlock, 101, pretrained, width=128, **kwargs)
+    return _resnet(BottleneckBlock, 101, pretrained, arch="wide_resnet101_2", width=128, **kwargs)
 
 
 class ResNeXt(ResNet):

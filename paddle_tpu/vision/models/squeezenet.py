@@ -68,13 +68,19 @@ class SqueezeNet(nn.Layer):
 
 def squeezenet1_0(pretrained=False, **kwargs):
     if pretrained:
-        raise NotImplementedError("pretrained weights unavailable offline")
+        from ._pretrained import load_pretrained
+
+        return load_pretrained(SqueezeNet("1.0", **kwargs),
+                               "squeezenet1_0")
     return SqueezeNet("1.0", **kwargs)
 
 
 def squeezenet1_1(pretrained=False, **kwargs):
     if pretrained:
-        raise NotImplementedError("pretrained weights unavailable offline")
+        from ._pretrained import load_pretrained
+
+        return load_pretrained(SqueezeNet("1.1", **kwargs),
+                               "squeezenet1_1")
     return SqueezeNet("1.1", **kwargs)
 
 

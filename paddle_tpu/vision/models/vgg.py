@@ -58,9 +58,17 @@ class VGG(nn.Layer):
 
 
 def _vgg(cfg, batch_norm, pretrained, **kwargs):
+    model = VGG(_make_layers(_CFGS[cfg], batch_norm), **kwargs)
     if pretrained:
-        raise NotImplementedError("pretrained weights unavailable offline")
-    return VGG(_make_layers(_CFGS[cfg], batch_norm), **kwargs)
+        if batch_norm:
+            raise NotImplementedError(
+                "no published weights for the batch_norm VGG variants")
+        from ._pretrained import load_pretrained
+
+        arch = {"A": "vgg11", "B": "vgg13", "D": "vgg16",
+                "E": "vgg19"}[cfg]
+        load_pretrained(model, arch)
+    return model
 
 
 def vgg11(pretrained=False, batch_norm=False, **kwargs):

@@ -63,12 +63,64 @@ def test_shufflenet_forward():
     assert out.shape == [1, 4]
 
 
-def test_model_ctors_exist():
+def test_model_ctors_exist(tmp_path, monkeypatch):
     for name in ["resnet34", "resnet101", "resnet152", "resnext50_32x4d",
                  "wide_resnet50_2", "vgg13", "vgg16", "vgg19", "densenet161",
                  "densenet169", "densenet201", "densenet264",
                  "mobilenet_v1", "mobilenet_v3_large", "shufflenet_v2_x1_5",
                  "squeezenet1_0", "inception_v3", "googlenet", "alexnet"]:
         assert callable(getattr(M, name))
-    with pytest.raises(NotImplementedError):
+    # pretrained=True now resolves against the local cache: a miss is
+    # the loud zero-egress error (probed under an ISOLATED cache so a
+    # host with legitimately sideloaded weights doesn't fail the suite)
+    import paddle_tpu.utils.download as dl
+
+    monkeypatch.setattr(dl, "WEIGHTS_HOME", str(tmp_path / "empty"))
+    with pytest.raises(RuntimeError, match="zero network egress"):
         M.resnet18(pretrained=True)
+
+
+def test_pretrained_sideload_via_cache(tmp_path, monkeypatch):
+    """pretrained=True loads from the local weight cache (zero-egress
+    sideloading): pre-place the official-named .pdparams and the ctor
+    restores it; a cache miss raises the loud zero-egress error naming
+    the path to pre-place."""
+    import hashlib
+    import os
+
+    import paddle_tpu.utils.download as dl
+    from paddle_tpu.framework.io import save
+    from paddle_tpu.vision.models import _pretrained, resnet18
+
+    cache = tmp_path / "weights"
+    monkeypatch.setattr(dl, "WEIGHTS_HOME", str(cache))
+    paddle.seed(7)
+    donor = resnet18(num_classes=10)
+    os.makedirs(cache, exist_ok=True)
+    path = cache / "resnet18.pdparams"
+    save(donor.state_dict(), str(path))
+    md5 = hashlib.md5(open(path, "rb").read()).hexdigest()
+    monkeypatch.setitem(_pretrained.WEIGHT_URLS, "resnet18",
+                        (_pretrained.WEIGHT_URLS["resnet18"][0], md5))
+    paddle.seed(99)  # different init; restore must overwrite it
+    model = resnet18(pretrained=True, num_classes=10)
+    np.testing.assert_array_equal(model.conv1.weight.numpy(),
+                                  donor.conv1.weight.numpy())
+    # cache miss -> loud zero-egress error
+    import pytest
+
+    from paddle_tpu.vision.models import vgg16
+
+    monkeypatch.setattr(dl, "WEIGHTS_HOME", str(tmp_path / "empty2"))
+    with pytest.raises(RuntimeError, match="zero network egress"):
+        vgg16(pretrained=True)
+    # mismatched weights refuse loudly instead of silently partial-loading
+    donor_small = resnet18(num_classes=3)
+    p2 = cache / "vgg16.pdparams"
+    save(donor_small.state_dict(), str(p2))
+    md5b = hashlib.md5(open(p2, "rb").read()).hexdigest()
+    monkeypatch.setattr(dl, "WEIGHTS_HOME", str(cache))
+    monkeypatch.setitem(_pretrained.WEIGHT_URLS, "vgg16",
+                        (_pretrained.WEIGHT_URLS["vgg16"][0], md5b))
+    with pytest.raises(ValueError, match="do not match"):
+        vgg16(pretrained=True)

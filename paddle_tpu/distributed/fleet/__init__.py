@@ -46,17 +46,23 @@ def init(role_maker=None, is_collective=True, strategy=None, log_level="INFO"):
     mp = int(hc.get("mp_degree", 1))
     pp = int(hc.get("pp_degree", 1))
     sp = int(hc.get("sp_degree", hc.get("sep_degree", 1)))
+    ep = int(hc.get("ep_degree", 1))
     sharding = int(hc.get("sharding_degree", 1))
     dp = int(hc.get("dp_degree", -1))
     if dp in (-1, 0):
-        dp = max(1, n // (mp * pp * sp))
-    used = dp * pp * sp * mp
+        dp = max(1, n // (mp * pp * sp * ep))
+    used = dp * pp * sp * ep * mp
     if used > n:
         raise ValueError(
-            f"hybrid degrees dp={dp} x pp={pp} x sp={sp} x mp={mp} = "
-            f"{used} exceed device count {n}")
-    devices = np.array(jax.devices()[:used]).reshape(dp, pp, sp, mp)
-    mesh = Mesh(devices, ("dp", "pp", "sp", "tp"))
+            f"hybrid degrees dp={dp} x pp={pp} x sp={sp} x ep={ep} x "
+            f"mp={mp} = {used} exceed device count {n}")
+    # expert parallelism gets its own axis only when requested: a
+    # permanent size-1 'ep' axis would change every existing mesh
+    # shape/spec downstream for nothing (reference: the MoE layer's
+    # expert group is carved out of the data-parallel ranks)
+    dims = (dp, pp, sp) + ((ep,) if ep > 1 else ()) + (mp,)
+    axes = ("dp", "pp", "sp") + (("ep",) if ep > 1 else ()) + ("tp",)
+    mesh = Mesh(np.array(jax.devices()[:used]).reshape(dims), axes)
     _env.set_mesh(mesh)
     _fleet_state.update(strategy=strategy, initialized=True,
                         hcg=HybridCommunicateGroup(mesh, sharding))
@@ -87,18 +93,27 @@ class HybridCommunicateGroup:
         # collectives reduce over exactly that axis
         from ..collective import ProcessGroup
 
-        devs = mesh.devices  # ndarray (dp, pp, sp, tp) or (dp, pp, tp)
+        devs = mesh.devices  # (dp, pp, sp[, ep], tp) or (dp, pp, tp)
         if devs.ndim == 3:  # meshes installed outside fleet.init
             devs = devs[:, :, None, :]
+        if devs.ndim == 4:  # no expert axis
+            devs = devs[:, :, :, None, :]
         self._groups = {
-            "dp": ProcessGroup(list(devs[:, 0, 0, 0]), axes="dp",
-                               ranks=[d.id for d in devs[:, 0, 0, 0]]),
-            "pp": ProcessGroup(list(devs[0, :, 0, 0]), axes="pp",
-                               ranks=[d.id for d in devs[0, :, 0, 0]]),
-            "sp": ProcessGroup(list(devs[0, 0, :, 0]), axes="sp",
-                               ranks=[d.id for d in devs[0, 0, :, 0]]),
-            "tp": ProcessGroup(list(devs[0, 0, 0, :]), axes="tp",
-                               ranks=[d.id for d in devs[0, 0, 0, :]]),
+            "dp": ProcessGroup(list(devs[:, 0, 0, 0, 0]), axes="dp",
+                               ranks=[d.id for d in devs[:, 0, 0, 0, 0]]),
+            "pp": ProcessGroup(list(devs[0, :, 0, 0, 0]), axes="pp",
+                               ranks=[d.id for d in devs[0, :, 0, 0, 0]]),
+            "sp": ProcessGroup(list(devs[0, 0, :, 0, 0]), axes="sp",
+                               ranks=[d.id for d in devs[0, 0, :, 0, 0]]),
+            # axes only when the mesh really has 'ep': a size-1 group
+            # hard-bound to an unbound axis name would crash traced
+            # collectives that should no-op
+            "ep": ProcessGroup(
+                list(devs[0, 0, 0, :, 0]),
+                axes="ep" if "ep" in mesh.axis_names else None,
+                ranks=[d.id for d in devs[0, 0, 0, :, 0]]),
+            "tp": ProcessGroup(list(devs[0, 0, 0, 0, :]), axes="tp",
+                               ranks=[d.id for d in devs[0, 0, 0, 0, :]]),
         }
 
     @property
@@ -133,6 +148,12 @@ class HybridCommunicateGroup:
 
     def get_sep_parallel_world_size(self):
         return dict(self._mesh.shape).get("sp", 1)
+
+    def get_expert_parallel_world_size(self):
+        return dict(self._mesh.shape).get("ep", 1)
+
+    def get_expert_parallel_group(self):
+        return self._groups["ep"]
 
     def get_data_parallel_group(self):
         return self._groups["dp"]

@@ -426,3 +426,39 @@ def test_gradient_merge_keeps_accumulation_for_gradless_boundary_param():
     w2 = np.asarray(model[0].weight.numpy())
     # one contribution averaged over k=2 -> w2 = w0 - 0.1 * g1/2
     np.testing.assert_allclose(w2, w0 - 0.1 * g1 / 2, rtol=2e-5, atol=2e-6)
+
+
+def test_ep_degree_builds_expert_axis_and_shards_experts():
+    """hybrid_configs.ep_degree carves an 'ep' mesh axis; MoELayer's
+    expert stacks shard over it (reference: MoE expert-parallel groups
+    out of the dp ranks)."""
+    import numpy as np
+
+    from paddle_tpu.distributed.moe import MoELayer
+
+    s = _strategy()
+    s.hybrid_configs = {"dp_degree": 2, "mp_degree": 2, "pp_degree": 1,
+                        "ep_degree": 2}
+    fleet.init(strategy=s)
+    hcg = fleet.get_hybrid_communicate_group()
+    assert hcg.get_expert_parallel_world_size() == 2
+    assert hcg.get_data_parallel_world_size() == 2
+    assert len(hcg.get_expert_parallel_group().ranks) == 2
+    paddle.seed(0)
+    moe = MoELayer(16, 32, num_experts=8, top_k=2)
+    assert moe._ep_axis == "ep"
+    shards = {sh.data.shape for sh in moe.w1._value.addressable_shards}
+    assert shards == {(4, 16, 32)}
+    x = paddle.randn([4, 4, 16])
+    loss = (moe(x) ** 2).mean() + moe.aux_loss
+    loss.backward()
+    assert np.isfinite(float(loss))
+
+
+def test_ep_degree_default_keeps_four_axis_mesh():
+    s = _strategy()
+    s.hybrid_configs = {"dp_degree": 4, "mp_degree": 2, "pp_degree": 1}
+    fleet.init(strategy=s)
+    hcg = fleet.get_hybrid_communicate_group()
+    assert "ep" not in hcg.mesh.axis_names  # unchanged default shape
+    assert hcg.get_expert_parallel_world_size() == 1

@@ -362,13 +362,19 @@ def bench_flash_attention(batch=4, heads=12, seq=1024, dim=64, iters=50):
         return (flash_attention_raw(q, k, v, True) ** 2).mean()
 
     res = {}
-    for name, fn in [("xla", xla_loss), ("flash", flash_loss)]:
+    arms = [("xla", xla_loss, jnp.float32),
+            ("flash", flash_loss, jnp.float32),
+            # bf16 arms: the dtype real training runs in on the MXU
+            ("xla_bf16", xla_loss, jnp.bfloat16),
+            ("flash_bf16", flash_loss, jnp.bfloat16)]
+    for name, fn, dt in arms:
         try:
+            qq, kk, vv = (x.astype(dt) for x in (q, k, v))
             g = jax.jit(jax.grad(fn, argnums=(0, 1, 2)))
-            _sync(g(q, k, v))
+            _sync(g(qq, kk, vv))
             t0 = time.perf_counter()
             for _ in range(iters):
-                out = g(q, k, v)
+                out = g(qq, kk, vv)
             _sync(out)
             res[f"attn_{name}_ms"] = (time.perf_counter() - t0) / iters * 1e3
         except Exception as e:  # noqa: BLE001

@@ -66,6 +66,34 @@ WEIGHT_URLS = {
                       "30b95af60a2178f03cf9b66cd77e1db1"),
     "squeezenet1_1": (_IMN + "SqueezeNet1_1_pretrained.pdparams",
                       "a11250d3a1f91d7131fd095ebbf09eee"),
+    "googlenet": (_IMN + "GoogLeNet_pretrained.pdparams",
+                  "80c06f038e905c53ab32c40eca6e26ae"),
+    "inception_v3": (_IMN + "legendary_models/"
+                     "InceptionV3_pretrained.pdparams",
+                     "e4d0905a818f6bb7946e881777a8a935"),
+    "alexnet": (_IMN + "AlexNet_pretrained.pdparams",
+                "7f0f9f737132e02732d75a1459d98a43"),
+    "shufflenet_v2_x0_25": (_IMN + "ShuffleNetV2_x0_25_pretrained"
+                            ".pdparams",
+                            "e753404cbd95027759c5f56ecd6c9c4b"),
+    "shufflenet_v2_x0_33": (_IMN + "ShuffleNetV2_x0_33_pretrained"
+                            ".pdparams",
+                            "776e3cf9a4923abdfce789c45b8fe1f2"),
+    "shufflenet_v2_x0_5": (_IMN + "ShuffleNetV2_x0_5_pretrained"
+                           ".pdparams",
+                           "e3649cf531566917e2969487d2bc6b60"),
+    "shufflenet_v2_x1_0": (_IMN + "ShuffleNetV2_x1_0_pretrained"
+                           ".pdparams",
+                           "7821c348ea34e58847c43a08a4ac0bdf"),
+    "shufflenet_v2_x1_5": (_IMN + "ShuffleNetV2_x1_5_pretrained"
+                           ".pdparams",
+                           "93a07fa557ab2d8803550f39e5b6c391"),
+    "shufflenet_v2_x2_0": (_IMN + "ShuffleNetV2_x2_0_pretrained"
+                           ".pdparams",
+                           "4ab1f622fd0d341e0f84b4e057797563"),
+    "shufflenet_v2_swish": (_IMN + "ShuffleNetV2_swish_pretrained"
+                            ".pdparams",
+                            "daff38b3df1b3748fccbb13cfdf02519"),
 }
 
 

@@ -175,9 +175,12 @@ class GoogLeNet(nn.Layer):
 
 
 def googlenet(pretrained=False, **kwargs):
+    model = GoogLeNet(**kwargs)
     if pretrained:
-        raise NotImplementedError("pretrained weights unavailable offline")
-    return GoogLeNet(**kwargs)
+        from ._pretrained import load_pretrained
+
+        load_pretrained(model, "googlenet")
+    return model
 
 
 class _InceptionA(nn.Layer):
@@ -239,6 +242,9 @@ class InceptionV3(nn.Layer):
 
 
 def inception_v3(pretrained=False, **kwargs):
+    model = InceptionV3(**kwargs)
     if pretrained:
-        raise NotImplementedError("pretrained weights unavailable offline")
-    return InceptionV3(**kwargs)
+        from ._pretrained import load_pretrained
+
+        load_pretrained(model, "inception_v3")
+    return model

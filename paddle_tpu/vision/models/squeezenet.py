@@ -172,9 +172,16 @@ class ShuffleNetV2(nn.Layer):
 
 
 def _shufflenet(scale, act="relu", pretrained=False, **kwargs):
+    model = ShuffleNetV2(scale=scale, act=act, **kwargs)
     if pretrained:
-        raise NotImplementedError("pretrained weights unavailable offline")
-    return ShuffleNetV2(scale=scale, act=act, **kwargs)
+        from ._pretrained import load_pretrained
+
+        arch = ("shufflenet_v2_swish" if act == "swish" else
+                "shufflenet_v2_x" + {0.25: "0_25", 0.33: "0_33",
+                                     0.5: "0_5", 1.0: "1_0",
+                                     1.5: "1_5", 2.0: "2_0"}[scale])
+        load_pretrained(model, arch)
+    return model
 
 
 def shufflenet_v2_x0_25(pretrained=False, **kwargs):

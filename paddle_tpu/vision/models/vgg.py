@@ -117,6 +117,9 @@ class AlexNet(nn.Layer):
 
 
 def alexnet(pretrained=False, **kwargs):
+    model = AlexNet(**kwargs)
     if pretrained:
-        raise NotImplementedError("pretrained weights unavailable offline")
-    return AlexNet(**kwargs)
+        from ._pretrained import load_pretrained
+
+        load_pretrained(model, "alexnet")
+    return model

@@ -458,3 +458,45 @@ def test_seq2seq_guards_and_eos_freeze():
         if (row == 2).any():
             first = int(np.argmax(row == 2))
             assert (row[first + 1:] == 0).all()
+
+
+def test_seq2seq_through_hapi_model_multi_input():
+    """Model.train_batch with TWO inputs (src, tgt_in) — the reference's
+    transformer-under-paddle.Model pattern exercises hapi's multi-input
+    jitted step."""
+    from paddle_tpu.models.transformer import (
+        TransformerConfig, TransformerModel,
+    )
+
+    paddle.seed(0)
+    cfg = TransformerConfig(src_vocab_size=48, tgt_vocab_size=48,
+                            d_model=32, nhead=4, num_encoder_layers=1,
+                            num_decoder_layers=1, dim_feedforward=64,
+                            dropout=0.0, max_length=16)
+
+    class WithLoss(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.m = TransformerModel(cfg)
+
+        def forward(self, src, tgt_in):
+            return self.m(src, tgt_in)
+
+    class TokenCE(nn.Layer):
+        def forward(self, logits, labels):
+            import paddle_tpu.tensor as T
+
+            return nn.functional.cross_entropy(
+                T.reshape(logits, [-1, 48]), T.reshape(labels, [-1]))
+
+    model = paddle.Model(WithLoss())
+    model.prepare(paddle.optimizer.Adam(1e-3,
+                                        parameters=model.parameters()),
+                  TokenCE())
+    rng = np.random.RandomState(0)
+    src = paddle.to_tensor(rng.randint(3, 48, (16, 8)))
+    tgt = paddle.to_tensor(rng.randint(3, 48, (16, 6)))
+    lab = paddle.to_tensor(rng.randint(3, 48, (16, 6)))
+    l1 = model.train_batch([src, tgt], [lab])[0]
+    l2 = model.train_batch([src, tgt], [lab])[0]
+    assert float(l2) < float(l1)

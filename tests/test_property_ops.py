@@ -1,0 +1,114 @@
+"""Property-based op semantics vs numpy (hypothesis; derandomized so CI
+is deterministic). Complements the table-driven numeric sweep with
+randomized shapes/broadcasting/axis combinations — the input space where
+hand-picked cases miss edge geometry.
+"""
+import numpy as np
+from hypothesis import given, settings, strategies as st
+
+import paddle_tpu as paddle
+
+_SET = settings(max_examples=40, deadline=None, derandomize=True)
+
+
+def _shapes_broadcastable(draw):
+    """A pair of shapes that numpy-broadcast together."""
+    base = draw(st.lists(st.integers(1, 4), min_size=1, max_size=3))
+    a, b = list(base), list(base)
+    for i in range(len(base)):
+        which = draw(st.integers(0, 2))
+        if which == 0:
+            a[i] = 1
+        elif which == 1:
+            b[i] = 1
+    cut = draw(st.integers(0, len(base) - 1))
+    return tuple(a), tuple(b[cut:]) if draw(st.booleans()) else tuple(b)
+
+
+shapes_pair = st.composite(_shapes_broadcastable)()
+
+
+@_SET
+@given(shapes_pair, st.sampled_from(["add", "subtract", "multiply",
+                                     "maximum", "minimum"]))
+def test_binary_broadcast_matches_numpy(shapes, opname):
+    sa, sb = shapes
+    rng = np.random.RandomState(hash((sa, sb, opname)) % (2 ** 31))
+    a = rng.randn(*sa).astype(np.float32)
+    b = rng.randn(*sb).astype(np.float32)
+    got = getattr(paddle, opname)(paddle.to_tensor(a),
+                                  paddle.to_tensor(b)).numpy()
+    want = getattr(np, opname)(a, b)
+    np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-6)
+    assert got.shape == want.shape
+
+
+@_SET
+@given(st.lists(st.integers(1, 5), min_size=1, max_size=4),
+       st.sampled_from(["sum", "mean", "max", "min", "prod"]),
+       st.booleans(), st.data())
+def test_reductions_match_numpy(shape, red, keepdim, data):
+    rng = np.random.RandomState(hash((tuple(shape), red)) % (2 ** 31))
+    a = rng.randn(*shape).astype(np.float32)
+    axis = data.draw(st.one_of(
+        st.none(), st.integers(-len(shape), len(shape) - 1)))
+    got = getattr(paddle, red)(paddle.to_tensor(a), axis=axis,
+                               keepdim=keepdim).numpy()
+    want = getattr(np, red if red != "prod" else "prod")(
+        a, axis=axis, keepdims=keepdim)
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+
+
+@_SET
+@given(st.lists(st.integers(1, 6), min_size=2, max_size=4), st.data())
+def test_reshape_transpose_roundtrip(shape, data):
+    rng = np.random.RandomState(hash(tuple(shape)) % (2 ** 31))
+    a = rng.randn(*shape).astype(np.float32)
+    perm = data.draw(st.permutations(range(len(shape))))
+    t = paddle.transpose(paddle.to_tensor(a), list(perm))
+    np.testing.assert_array_equal(t.numpy(), np.transpose(a, perm))
+    # inverse permutation restores the original
+    inv = [0] * len(perm)
+    for i, p in enumerate(perm):
+        inv[p] = i
+    back = paddle.transpose(t, inv)
+    np.testing.assert_array_equal(back.numpy(), a)
+    flat = paddle.reshape(back, [-1])
+    np.testing.assert_array_equal(flat.numpy(), a.reshape(-1))
+
+
+@_SET
+@given(st.integers(1, 4), st.integers(1, 5), st.integers(1, 5),
+       st.integers(1, 5))
+def test_matmul_matches_numpy(b, m, k, n):
+    rng = np.random.RandomState(hash((b, m, k, n)) % (2 ** 31))
+    x = rng.randn(b, m, k).astype(np.float32)
+    y = rng.randn(b, k, n).astype(np.float32)
+    got = paddle.matmul(paddle.to_tensor(x), paddle.to_tensor(y)).numpy()
+    np.testing.assert_allclose(got, x @ y, rtol=1e-5, atol=1e-5)
+
+
+@_SET
+@given(st.lists(st.integers(1, 5), min_size=1, max_size=3), st.data())
+def test_concat_split_roundtrip(shape, data):
+    axis = data.draw(st.integers(0, len(shape) - 1))
+    parts = data.draw(st.integers(1, 3))
+    rng = np.random.RandomState(hash((tuple(shape), axis, parts))
+                                % (2 ** 31))
+    arrs = [rng.randn(*shape).astype(np.float32) for _ in range(parts)]
+    cat = paddle.concat([paddle.to_tensor(a) for a in arrs], axis=axis)
+    np.testing.assert_array_equal(cat.numpy(),
+                                  np.concatenate(arrs, axis=axis))
+    back = paddle.split(cat, parts, axis=axis)
+    for got, want in zip(back, arrs):
+        np.testing.assert_array_equal(got.numpy(), want)
+
+
+@_SET
+@given(st.lists(st.integers(1, 5), min_size=1, max_size=3))
+def test_grad_of_sum_is_ones(shape):
+    rng = np.random.RandomState(hash(tuple(shape)) % (2 ** 31))
+    x = paddle.to_tensor(rng.randn(*shape).astype(np.float32))
+    x.stop_gradient = False
+    (x * x).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), 2 * x.numpy(), rtol=1e-6)

@@ -1,0 +1,116 @@
+"""Numerics vs the torch CPU oracle — an independent reference
+implementation (the numeric sweep's numpy formulas share our own
+derivations; torch does not).
+
+Covers the activation/loss/norm functions with subtle definitional
+corners (approximate vs exact gelu, label smoothing, eps placement).
+"""
+import numpy as np
+import pytest
+import torch
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+
+def _t(a):
+    return paddle.to_tensor(a)
+
+
+def _cmp(got, want, rtol=1e-5, atol=1e-6):
+    np.testing.assert_allclose(np.asarray(got.numpy(), np.float32),
+                               want.detach().numpy(), rtol=rtol,
+                               atol=atol)
+
+
+_X = np.random.RandomState(0).randn(64).astype(np.float32) * 3
+
+
+@pytest.mark.parametrize("ours,theirs,kw", [
+    (F.relu, torch.nn.functional.relu, {}),
+    (F.relu6, torch.nn.functional.relu6, {}),
+    (F.silu, torch.nn.functional.silu, {}),
+    (F.mish, torch.nn.functional.mish, {}),
+    (F.softplus, torch.nn.functional.softplus, {}),
+    (F.softsign, torch.nn.functional.softsign, {}),
+    (F.tanhshrink, torch.nn.functional.tanhshrink, {}),
+    (F.hardsigmoid, torch.nn.functional.hardsigmoid, {}),
+    (F.hardswish, torch.nn.functional.hardswish, {}),
+    (F.elu, torch.nn.functional.elu, {}),
+    (F.celu, torch.nn.functional.celu, {}),
+    (F.selu, torch.nn.functional.selu, {}),
+    (F.log_sigmoid, torch.nn.functional.logsigmoid, {}),
+], ids=lambda f: getattr(f, "__name__", str(f)))
+def test_activations_vs_torch(ours, theirs, kw):
+    _cmp(ours(_t(_X), **kw), theirs(torch.from_numpy(_X), **kw))
+
+
+def test_gelu_both_modes_vs_torch():
+    x = torch.from_numpy(_X)
+    _cmp(F.gelu(_t(_X)), torch.nn.functional.gelu(x))
+    _cmp(F.gelu(_t(_X), approximate=True),
+         torch.nn.functional.gelu(x, approximate="tanh"))
+
+
+def test_softmax_logsoftmax_vs_torch():
+    a = np.random.RandomState(1).randn(8, 16).astype(np.float32)
+    _cmp(F.softmax(_t(a), axis=-1),
+         torch.softmax(torch.from_numpy(a), -1))
+    _cmp(F.log_softmax(_t(a), axis=0),
+         torch.log_softmax(torch.from_numpy(a), 0))
+
+
+def test_cross_entropy_label_smoothing_vs_torch():
+    rng = np.random.RandomState(2)
+    logits = rng.randn(16, 10).astype(np.float32)
+    labels = rng.randint(0, 10, 16)
+    got = F.cross_entropy(_t(logits), _t(labels.astype(np.int64)),
+                          label_smoothing=0.1)
+    want = torch.nn.functional.cross_entropy(
+        torch.from_numpy(logits), torch.from_numpy(labels),
+        label_smoothing=0.1)
+    np.testing.assert_allclose(float(got), float(want), rtol=1e-5)
+
+
+def test_kl_bce_huber_vs_torch():
+    rng = np.random.RandomState(3)
+    p = rng.rand(32).astype(np.float32) * 0.98 + 0.01
+    q = rng.rand(32).astype(np.float32) * 0.98 + 0.01
+    got = F.kl_div(_t(np.log(p)), _t(q), reduction="mean")
+    want = torch.nn.functional.kl_div(
+        torch.from_numpy(np.log(p)), torch.from_numpy(q),
+        reduction="mean")
+    np.testing.assert_allclose(float(got), float(want), rtol=1e-5)
+    got = F.binary_cross_entropy(_t(p), _t((q > 0.5).astype(np.float32)))
+    want = torch.nn.functional.binary_cross_entropy(
+        torch.from_numpy(p), torch.from_numpy((q > 0.5).astype(np.float32)))
+    np.testing.assert_allclose(float(got), float(want), rtol=1e-5)
+    x = rng.randn(32).astype(np.float32)
+    y = rng.randn(32).astype(np.float32)
+    got = F.smooth_l1_loss(_t(x), _t(y))
+    want = torch.nn.functional.smooth_l1_loss(torch.from_numpy(x),
+                                              torch.from_numpy(y))
+    np.testing.assert_allclose(float(got), float(want), rtol=1e-5)
+
+
+def test_layer_group_norm_vs_torch():
+    rng = np.random.RandomState(4)
+    x = rng.randn(4, 8, 6).astype(np.float32)
+    _cmp(F.layer_norm(_t(x), (6,)),
+         torch.nn.functional.layer_norm(torch.from_numpy(x), (6,)),
+         rtol=1e-4, atol=1e-5)
+    x4 = rng.randn(2, 8, 5, 5).astype(np.float32)
+    got = paddle.nn.GroupNorm(4, 8)(_t(x4))
+    want = torch.nn.functional.group_norm(torch.from_numpy(x4), 4)
+    _cmp(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_conv2d_vs_torch():
+    rng = np.random.RandomState(5)
+    x = rng.randn(2, 3, 9, 9).astype(np.float32)
+    w = rng.randn(6, 3, 3, 3).astype(np.float32)
+    got = F.conv2d(_t(x), _t(w), stride=2, padding=1)
+    want = torch.nn.functional.conv2d(torch.from_numpy(x),
+                                      torch.from_numpy(w), stride=2,
+                                      padding=1)
+    _cmp(got, want, rtol=1e-4, atol=1e-4)

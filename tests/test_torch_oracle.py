@@ -114,3 +114,41 @@ def test_conv2d_vs_torch():
                                       torch.from_numpy(w), stride=2,
                                       padding=1)
     _cmp(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_multihead_attention_vs_torch():
+    """Full MHA forward parity with torch (weights mapped from torch's
+    packed in_proj into the separate q/k/v projections; paddle stores
+    [in, out], torch [out, in])."""
+    E, H, B, S = 16, 4, 2, 6
+    torch.manual_seed(0)
+    t_mha = torch.nn.MultiheadAttention(E, H, batch_first=True)
+    p_mha = paddle.nn.MultiHeadAttention(E, H)
+
+    w = t_mha.in_proj_weight.detach().numpy()    # [3E, E]
+    b = t_mha.in_proj_bias.detach().numpy()      # [3E]
+    for i, name in enumerate(["q_proj", "k_proj", "v_proj"]):
+        lin = getattr(p_mha, name)
+        lin.weight.set_value(w[i * E:(i + 1) * E].T.copy())
+        lin.bias.set_value(b[i * E:(i + 1) * E].copy())
+    p_mha.out_proj.weight.set_value(
+        t_mha.out_proj.weight.detach().numpy().T.copy())
+    p_mha.out_proj.bias.set_value(t_mha.out_proj.bias.detach().numpy())
+
+    rng = np.random.RandomState(6)
+    q = rng.randn(B, S, E).astype(np.float32)
+    kv = rng.randn(B, S, E).astype(np.float32)
+    want, _ = t_mha(torch.from_numpy(q), torch.from_numpy(kv),
+                    torch.from_numpy(kv), need_weights=False)
+    got = p_mha(_t(q), _t(kv), _t(kv))
+    _cmp(got, want, rtol=1e-4, atol=1e-5)
+
+    # causal mask parity
+    causal = torch.triu(torch.full((S, S), float("-inf")), 1)
+    want2, _ = t_mha(torch.from_numpy(q), torch.from_numpy(kv),
+                     torch.from_numpy(kv), attn_mask=causal,
+                     need_weights=False)
+    mask = np.triu(np.full((S, S), -np.inf, np.float32), 1)
+    got2 = p_mha(_t(q), _t(kv), _t(kv),
+                 attn_mask=_t(mask[None, None]))
+    _cmp(got2, want2, rtol=1e-4, atol=1e-5)

@@ -152,3 +152,37 @@ def test_multihead_attention_vs_torch():
     got2 = p_mha(_t(q), _t(kv), _t(kv),
                  attn_mask=_t(mask[None, None]))
     _cmp(got2, want2, rtol=1e-4, atol=1e-5)
+
+
+def test_lstm_gru_cells_vs_torch():
+    """Cell-level recurrence parity with torch (identical [4H,E] i,f,g,o
+    and [3H,E] r,z,n layouts; GRU's reset gate applied inside the
+    hidden-side term)."""
+    E, H, B = 8, 12, 4
+    rng = np.random.RandomState(7)
+
+    torch.manual_seed(1)
+    t_cell = torch.nn.LSTMCell(E, H)
+    p_cell = paddle.nn.LSTMCell(E, H)
+    p_cell.weight_ih.set_value(t_cell.weight_ih.detach().numpy())
+    p_cell.weight_hh.set_value(t_cell.weight_hh.detach().numpy())
+    p_cell.bias_ih.set_value(t_cell.bias_ih.detach().numpy())
+    p_cell.bias_hh.set_value(t_cell.bias_hh.detach().numpy())
+    x = rng.randn(B, E).astype(np.float32)
+    h0 = rng.randn(B, H).astype(np.float32)
+    c0 = rng.randn(B, H).astype(np.float32)
+    th, tc = t_cell(torch.from_numpy(x),
+                    (torch.from_numpy(h0), torch.from_numpy(c0)))
+    _, (ph, pc) = p_cell(_t(x), (_t(h0), _t(c0)))
+    _cmp(ph, th, rtol=1e-5, atol=1e-6)
+    _cmp(pc, tc, rtol=1e-5, atol=1e-6)
+
+    t_gru = torch.nn.GRUCell(E, H)
+    p_gru = paddle.nn.GRUCell(E, H)
+    p_gru.weight_ih.set_value(t_gru.weight_ih.detach().numpy())
+    p_gru.weight_hh.set_value(t_gru.weight_hh.detach().numpy())
+    p_gru.bias_ih.set_value(t_gru.bias_ih.detach().numpy())
+    p_gru.bias_hh.set_value(t_gru.bias_hh.detach().numpy())
+    tg = t_gru(torch.from_numpy(x), torch.from_numpy(h0))
+    pg, _ = p_gru(_t(x), _t(h0))
+    _cmp(pg, tg, rtol=1e-5, atol=1e-6)

@@ -199,16 +199,24 @@ def interpolate(x, size=None, scale_factor=None, mode="nearest",
             new_shape = list(v.shape)
             for i, ax in enumerate(sp_axes):
                 new_shape[ax] = out_spatial[i]
-            if align_corners:
-                # emulate align_corners with explicit gather-based linear interp
+            if align_corners or (align_mode == 1 and method == "linear"):
+                # explicit gather-based 2-tap interp: align_corners maps
+                # dst over [0, s_in-1]; align_mode=1 (paddle's
+                # "asymmetric" mode, no torch equivalent) maps
+                # src = dst * (s_in / o) with no half-pixel offset
                 out = v
                 for i, ax in enumerate(sp_axes):
                     o = out_spatial[i]
                     s_in = in_spatial[i]
                     if o == 1 or s_in == 1:
                         idx = jnp.zeros((o,), jnp.float32)
-                    else:
-                        idx = jnp.arange(o, dtype=jnp.float32) * (s_in - 1) / (o - 1)
+                    elif align_corners:
+                        idx = jnp.arange(o, dtype=jnp.float32) * \
+                            (s_in - 1) / (o - 1)
+                    else:  # align_mode=1 asymmetric
+                        idx = jnp.clip(
+                            jnp.arange(o, dtype=jnp.float32) * (s_in / o),
+                            0, s_in - 1)
                     lo = jnp.floor(idx).astype(jnp.int32)
                     hi = jnp.minimum(lo + 1, s_in - 1)
                     w_hi = (idx - lo).astype(v.dtype)
@@ -218,7 +226,13 @@ def interpolate(x, size=None, scale_factor=None, mode="nearest",
                     shape[ax] = -1
                     out = a * (1 - w_hi.reshape(shape)) + b * w_hi.reshape(shape)
                 return out
-            return jax.image.resize(v, tuple(new_shape), method=method)
+            # antialias=False: the reference kernel is a plain 2-tap
+            # interpolation in BOTH directions — jax.image.resize would
+            # otherwise widen the kernel when downscaling (an
+            # antialiased result the reference never produces; caught by
+            # the torch-oracle downsample test)
+            return jax.image.resize(v, tuple(new_shape), method=method,
+                                    antialias=False)
         if mode == "area":
             out = v
             for i, ax in enumerate(sp_axes):

@@ -325,3 +325,77 @@ def test_rmsprop_matches_reference_formula_not_torch():
         ms = 0.95 * ms + 0.05 * g * g
         ref_w = ref_w - 0.01 * g / np.sqrt(ms + 1e-6)
     np.testing.assert_allclose(pw.numpy(), ref_w, rtol=2e-5, atol=2e-6)
+
+
+def test_interpolate_modes_vs_torch():
+    """bilinear/nearest up+downsampling incl. align_corners — the
+    half-pixel vs corner-aligned grids are a classic divergence spot."""
+    rng = np.random.RandomState(12)
+    x = rng.randn(2, 3, 5, 7).astype(np.float32)
+    tx = torch.from_numpy(x)
+    for size, mode, ac in [((10, 14), "bilinear", False),
+                           ((10, 14), "bilinear", True),
+                           ((3, 4), "bilinear", False),
+                           ((10, 14), "nearest", None)]:
+        kw = {} if ac is None else {"align_corners": ac}
+        got = F.interpolate(_t(x), size=size, mode=mode, **kw)
+        want = torch.nn.functional.interpolate(tx, size=size, mode=mode,
+                                               **kw)
+        _cmp(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_pixel_shuffle_unshuffle_vs_torch():
+    rng = np.random.RandomState(13)
+    x = rng.randn(2, 8, 3, 3).astype(np.float32)
+    _cmp(F.pixel_shuffle(_t(x), 2),
+         torch.nn.functional.pixel_shuffle(torch.from_numpy(x), 2))
+    y = rng.randn(2, 2, 6, 6).astype(np.float32)
+    _cmp(F.pixel_unshuffle(_t(y), 2),
+         torch.nn.functional.pixel_unshuffle(torch.from_numpy(y), 2))
+
+
+def test_grid_sample_vs_torch():
+    rng = np.random.RandomState(14)
+    x = rng.randn(2, 3, 6, 6).astype(np.float32)
+    grid = (rng.rand(2, 4, 4, 2).astype(np.float32) * 2 - 1)
+    got = F.grid_sample(_t(x), _t(grid), mode="bilinear",
+                        padding_mode="zeros", align_corners=True)
+    want = torch.nn.functional.grid_sample(
+        torch.from_numpy(x), torch.from_numpy(grid), mode="bilinear",
+        padding_mode="zeros", align_corners=True)
+    _cmp(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_interpolate_align_mode_1_asymmetric():
+    """paddle's align_mode=1 (src = dst * scale, no half-pixel shift) —
+    no torch equivalent; pinned against the hand-rolled formula
+    (reference interpolate docs / bilinear_interp kernel)."""
+    rng = np.random.RandomState(15)
+    x = rng.randn(1, 1, 4, 6).astype(np.float32)
+    o_h, o_w = 7, 9
+    got = np.asarray(F.interpolate(_t(x), size=(o_h, o_w),
+                                   mode="bilinear", align_corners=False,
+                                   align_mode=1).numpy())[0, 0]
+
+    def axis_interp(a, o, axis):
+        s_in = a.shape[axis]
+        idx = np.clip(np.arange(o) * (s_in / o), 0, s_in - 1)
+        lo = np.floor(idx).astype(int)
+        hi = np.minimum(lo + 1, s_in - 1)
+        w = (idx - lo).astype(np.float32)
+        sl = [slice(None)] * a.ndim
+        sl_lo, sl_hi = list(sl), list(sl)
+        sl_lo[axis] = lo
+        sl_hi[axis] = hi
+        shape = [1] * a.ndim
+        shape[axis] = -1
+        return a[tuple(sl_lo)] * (1 - w.reshape(shape)) + \
+            a[tuple(sl_hi)] * w.reshape(shape)
+
+    want = axis_interp(axis_interp(x[0, 0], o_h, 0), o_w, 1)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+    # differs from the half-pixel (align_mode=0) result
+    got0 = np.asarray(F.interpolate(_t(x), size=(o_h, o_w),
+                                    mode="bilinear", align_corners=False,
+                                    align_mode=0).numpy())[0, 0]
+    assert np.abs(got - got0).max() > 1e-3

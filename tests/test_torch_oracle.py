@@ -399,3 +399,42 @@ def test_interpolate_align_mode_1_asymmetric():
                                     mode="bilinear", align_corners=False,
                                     align_mode=0).numpy())[0, 0]
     assert np.abs(got - got0).max() > 1e-3
+
+
+def test_pad_modes_vs_torch():
+    rng = np.random.RandomState(16)
+    x = rng.randn(2, 3, 5, 6).astype(np.float32)
+    tx = torch.from_numpy(x)
+    for mode, tmode in [("reflect", "reflect"), ("replicate", "replicate"),
+                        ("circular", "circular")]:
+        got = F.pad(_t(x), [1, 2, 2, 1], mode=mode)
+        want = torch.nn.functional.pad(tx, (1, 2, 2, 1), mode=tmode)
+        _cmp(got, want)
+    got = F.pad(_t(x), [1, 1, 1, 1], mode="constant", value=3.5)
+    want = torch.nn.functional.pad(tx, (1, 1, 1, 1), value=3.5)
+    _cmp(got, want)
+
+
+def test_dropout_modes_reference_semantics():
+    """paddle's two dropout modes: upscale_in_train (default, inverted
+    dropout — eval is identity) and downscale_in_infer (train keeps
+    values unscaled, eval multiplies by (1-p))."""
+    paddle.seed(0)
+    x = np.full((512,), 2.0, np.float32)
+    t = _t(x)
+    # train, upscale: surviving values are x / (1 - p)
+    out = F.dropout(t, p=0.25, training=True)
+    vals = np.unique(np.round(np.asarray(out.numpy()), 5))
+    assert set(vals.tolist()) <= {0.0, np.float32(2.0 / 0.75).round(5)}, vals
+    # eval, upscale: identity
+    np.testing.assert_array_equal(
+        F.dropout(t, p=0.25, training=False).numpy(), x)
+    # train, downscale: surviving values stay x
+    out = F.dropout(t, p=0.25, training=True, mode="downscale_in_infer")
+    vals = np.unique(np.asarray(out.numpy()))
+    assert set(np.round(vals, 5).tolist()) <= {0.0, 2.0}, vals
+    # eval, downscale: x * (1 - p)
+    np.testing.assert_allclose(
+        F.dropout(t, p=0.25, training=False,
+                  mode="downscale_in_infer").numpy(),
+        x * 0.75, rtol=1e-6)

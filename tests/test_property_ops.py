@@ -112,3 +112,62 @@ def test_grad_of_sum_is_ones(shape):
     x.stop_gradient = False
     (x * x).sum().backward()
     np.testing.assert_allclose(x.grad.numpy(), 2 * x.numpy(), rtol=1e-6)
+
+
+@_SET
+@given(st.sampled_from(["ij,jk->ik", "bij,bjk->bik", "ij->ji", "ii->i",
+                        "ij,ij->", "bij->b", "ij,kj->ik",
+                        "abc,cd,de->abe"]))
+def test_einsum_matches_numpy(eq):
+    rng = np.random.RandomState(hash(eq) % (2 ** 31))
+    ins = eq.split("->")[0].split(",")
+    dims = {}
+    arrs = []
+    for term in ins:
+        shape = []
+        for ch in term:
+            dims.setdefault(ch, rng.randint(2, 5))
+            shape.append(dims[ch])
+        arrs.append(rng.randn(*shape).astype(np.float32))
+    got = paddle.einsum(eq, *[paddle.to_tensor(a) for a in arrs]).numpy()
+    np.testing.assert_allclose(got, np.einsum(eq, *arrs), rtol=1e-5,
+                               atol=1e-5)
+
+
+@_SET
+@given(st.lists(st.integers(1, 6), min_size=1, max_size=3), st.data())
+def test_sort_argsort_topk_consistency(shape, data):
+    axis = data.draw(st.integers(-len(shape), len(shape) - 1))
+    desc = data.draw(st.booleans())
+    rng = np.random.RandomState(hash((tuple(shape), axis, desc))
+                                % (2 ** 31))
+    a = rng.randn(*shape).astype(np.float32)
+    t = paddle.to_tensor(a)
+    s = paddle.sort(t, axis=axis, descending=desc).numpy()
+    idx = paddle.argsort(t, axis=axis, descending=desc).numpy()
+    ref = np.sort(a, axis=axis)
+    if desc:
+        ref = np.flip(ref, axis=axis)
+    np.testing.assert_array_equal(s, ref)
+    # argsort gathers back to the sorted values
+    np.testing.assert_array_equal(
+        np.take_along_axis(a, idx.astype(np.int64), axis=axis), s)
+    k = data.draw(st.integers(1, shape[axis]))
+    vals, vidx = paddle.topk(t, k, axis=axis)
+    np.testing.assert_array_equal(
+        np.take_along_axis(a, vidx.numpy().astype(np.int64), axis=axis),
+        vals.numpy())
+
+
+@_SET
+@given(st.lists(st.integers(1, 5), min_size=1, max_size=3), st.data())
+def test_cumsum_cumprod_match_numpy(shape, data):
+    axis = data.draw(st.integers(0, len(shape) - 1))
+    rng = np.random.RandomState(hash(tuple(shape)) % (2 ** 31))
+    a = rng.randn(*shape).astype(np.float32)
+    np.testing.assert_allclose(
+        paddle.cumsum(paddle.to_tensor(a), axis=axis).numpy(),
+        np.cumsum(a, axis=axis), rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(
+        paddle.cumprod(paddle.to_tensor(a), dim=axis).numpy(),
+        np.cumprod(a, axis=axis), rtol=1e-4, atol=1e-5)

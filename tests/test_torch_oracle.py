@@ -438,3 +438,49 @@ def test_dropout_modes_reference_semantics():
         F.dropout(t, p=0.25, training=False,
                   mode="downscale_in_infer").numpy(),
         x * 0.75, rtol=1e-6)
+
+
+def test_index_ops_vs_references():
+    rng = np.random.RandomState(17)
+    a = rng.randn(5, 4).astype(np.float32)
+    t = _t(a)
+    idx = np.array([3, 0, 3], np.int64)
+    # index_select == numpy take
+    np.testing.assert_array_equal(
+        paddle.index_select(t, _t(idx), axis=0).numpy(), a[idx])
+    # gather (paddle's axis-0 gather) == take
+    np.testing.assert_array_equal(paddle.gather(t, _t(idx)).numpy(),
+                                  a[idx])
+    # masked_select flattens in row-major order like torch
+    mask = a > 0
+    np.testing.assert_array_equal(
+        paddle.masked_select(t, _t(mask)).numpy(),
+        torch.masked_select(torch.from_numpy(a),
+                            torch.from_numpy(mask)).numpy())
+    # take_along_axis == numpy
+    tidx = rng.randint(0, 5, (2, 4))
+    np.testing.assert_array_equal(
+        paddle.take_along_axis(t, _t(tidx.astype(np.int64)), 0).numpy(),
+        np.take_along_axis(a, tidx, 0))
+
+
+def test_scatter_overwrite_and_add_semantics():
+    """paddle.scatter(overwrite=True) keeps the LAST write per duplicate
+    index (reference kernel order); overwrite=False accumulates."""
+    x = _t(np.zeros((4, 2), np.float32))
+    idx = _t(np.array([1, 1, 3], np.int64))
+    upd = _t(np.array([[1, 1], [2, 2], [5, 5]], np.float32))
+    got = paddle.scatter(x, idx, upd, overwrite=True).numpy()
+    np.testing.assert_array_equal(got[1], [2, 2])   # last write wins
+    np.testing.assert_array_equal(got[3], [5, 5])
+    got2 = paddle.scatter(x, idx, upd, overwrite=False).numpy()
+    np.testing.assert_array_equal(got2[1], [3, 3])  # accumulated
+    # put_along_axis add-reduce matches torch scatter_add
+    base = np.zeros((3, 3), np.float32)
+    pidx = np.array([[0, 1, 2], [0, 1, 2]])
+    vals = np.ones((2, 3), np.float32)
+    got3 = paddle.put_along_axis(_t(base), _t(pidx.astype(np.int64)),
+                                 _t(vals), 0, reduce="add").numpy()
+    want3 = torch.zeros(3, 3).scatter_add(
+        0, torch.from_numpy(pidx), torch.from_numpy(vals)).numpy()
+    np.testing.assert_array_equal(got3, want3)

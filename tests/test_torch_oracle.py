@@ -484,3 +484,25 @@ def test_scatter_overwrite_and_add_semantics():
     want3 = torch.zeros(3, 3).scatter_add(
         0, torch.from_numpy(pidx), torch.from_numpy(vals)).numpy()
     np.testing.assert_array_equal(got3, want3)
+
+
+def test_stft_istft_vs_torch():
+    """STFT frame/window/center semantics vs torch, and the
+    istft(stft(x)) round trip."""
+    rng = np.random.RandomState(18)
+    x = rng.randn(2, 512).astype(np.float32)
+    win = np.hanning(128).astype(np.float32)
+    got = paddle.signal.stft(_t(x), n_fft=128, hop_length=64,
+                             window=_t(win), center=True).numpy()
+    want = torch.stft(torch.from_numpy(x), n_fft=128, hop_length=64,
+                      window=torch.from_numpy(win), center=True,
+                      return_complex=True).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+    back = paddle.signal.istft(_t(got), n_fft=128, hop_length=64,
+                               window=_t(win), center=True).numpy()
+    tback = torch.istft(torch.from_numpy(want), n_fft=128, hop_length=64,
+                        window=torch.from_numpy(win), center=True).numpy()
+    np.testing.assert_allclose(back, tback, rtol=1e-4, atol=1e-4)
+    # the round trip reconstructs the interior of the signal
+    np.testing.assert_allclose(back[:, 64:-64], x[:, 64:back.shape[1]-64],
+                               rtol=1e-3, atol=1e-3)

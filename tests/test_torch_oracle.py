@@ -506,3 +506,38 @@ def test_stft_istft_vs_torch():
     # the round trip reconstructs the interior of the signal
     np.testing.assert_allclose(back[:, 64:-64], x[:, 64:back.shape[1]-64],
                                rtol=1e-3, atol=1e-3)
+
+
+def test_distributions_vs_torch():
+    """log_prob/entropy/kl parity against torch.distributions."""
+    import paddle_tpu.distribution as D
+    import torch.distributions as TD
+
+    n1 = D.Normal(loc=1.5, scale=2.0)
+    t1 = TD.Normal(1.5, 2.0)
+    xs = np.linspace(-3, 5, 9, dtype=np.float32)
+    np.testing.assert_allclose(
+        np.asarray(n1.log_prob(_t(xs)).numpy()),
+        t1.log_prob(torch.from_numpy(xs)).numpy(), rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(float(np.asarray(n1.entropy().numpy())
+                                     .ravel()[0]),
+                               float(t1.entropy()), rtol=1e-5)
+    n2, t2 = D.Normal(loc=0.0, scale=1.0), TD.Normal(0.0, 1.0)
+    np.testing.assert_allclose(
+        float(np.asarray(D.kl_divergence(n1, n2).numpy()).ravel()[0]),
+        float(TD.kl_divergence(t1, t2)), rtol=1e-5)
+
+    probs = np.array([0.2, 0.5, 0.3], np.float32)
+    c = D.Categorical(_t(probs))
+    tc = TD.Categorical(probs=torch.from_numpy(probs))
+    k = np.array([0, 1, 2])
+    np.testing.assert_allclose(
+        np.asarray(c.log_prob(_t(k.astype(np.int64))).numpy()),
+        tc.log_prob(torch.from_numpy(k)).numpy(), rtol=1e-5, atol=1e-6)
+
+    b = D.Beta(_t(np.float32(2.0)), _t(np.float32(3.0)))
+    tb = TD.Beta(2.0, 3.0)
+    xb = np.array([0.1, 0.5, 0.9], np.float32)
+    np.testing.assert_allclose(
+        np.asarray(b.log_prob(_t(xb)).numpy()).ravel(),
+        tb.log_prob(torch.from_numpy(xb)).numpy(), rtol=1e-5, atol=1e-5)

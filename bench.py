@@ -7,22 +7,34 @@ Headline metric: BERT-base MLM tokens/sec/chip (AMP O2 bf16, whole-step
 jit with donated buffers); falls back to ResNet50 imgs/sec then LeNet
 imgs/sec if the headline config never produced a number.
 
-Process architecture (the round-3 failure was `jax.default_backend()`
-HANGING — not raising — on a wedged axon tunnel, so no in-process retry
-or watchdog could save the run):
-  * the ORCHESTRATOR (plain `python bench.py`) never imports jax at all;
-  * backend init is probed in a SUBPROCESS with a kill-timeout and
-    retried across fresh processes (a hung PJRT client dies with its
-    process — nothing in-process can unwedge it);
-  * each bench config runs in its OWN subprocess with a per-config
-    deadline, cheapest-first, so one hang costs one config, not the run;
-  * a config that times out at full size is retried once at small size;
-  * the orchestrator exits NONZERO when no headline number was measured,
-    so a failed bench is failure-shaped to the driver.
+Process architecture — ONE patient client (the round-4 finding):
+the axon pool grants the chip to ONE client session at a time, and a
+client killed while waiting leaves an unclaimed grant that must time
+out upstream ("grant unclaimed past timeout — client lost") before the
+next waiter is served. Round 3's per-config-subprocess design — and
+round 4's first attempt — therefore POISONED THE QUEUE: every
+kill-and-retry enqueued another dead claimer, and no live client ever
+reached the front (the r03 7h wedge was self-inflicted client churn).
+So:
+  * the ORCHESTRATOR (plain `python bench.py`) never imports jax;
+  * it spawns ONE runner subprocess that probes the backend and runs
+    ALL configs in-process — one session, one grant, warm compile
+    cache shared across configs;
+  * the runner writes each config's result to disk AS IT FINISHES
+    (plus a heartbeat file), so partial progress survives anything;
+  * the orchestrator NEVER kills a waiting runner early — killing
+    cannot produce a grant sooner, it only poisons the queue for the
+    successor — it kills only at the global deadline
+    (BENCH_DEADLINE_S, default 3300s), then merges what was measured;
+  * a runner that CRASHES (clean nonzero exit — its session closed
+    with the process) is respawned with the remaining configs;
+  * the orchestrator exits NONZERO when no headline number was
+    measured, so a failed bench is failure-shaped to the driver.
 
-Child modes: `bench.py --probe --out F` / `bench.py --config NAME --out F
-[--small]` write their JSON dict to F (stdout is full of jax warnings and
-not parseable).
+Child modes: `bench.py --runner --out-dir D` (the one patient client),
+`bench.py --probe --out F` / `bench.py --config NAME --out F [--small]`
+(manual single-shot debugging; each is a fresh session — avoid while
+another client is waiting).
 """
 from __future__ import annotations
 
@@ -537,56 +549,69 @@ def _run_config(name, out_path, small):
     _write_out(out_path, res)
 
 
+def _heartbeat(out_dir, state):
+    _write_out(os.path.join(out_dir, "heartbeat.json"),
+               {"t": time.time(), **state})
+
+
+def _run_runner(out_dir, config_names, deadline_ts, small_all=False):
+    """The ONE patient client: probe, then every config, in THIS process.
+
+    Results land in <out_dir>/<name>.json as each config finishes; the
+    heartbeat file says what is currently running. Exceptions inside a
+    config are recorded and the runner moves on — only a wedged tunnel
+    call can stall it, and that stall is visible in the heartbeat."""
+    os.makedirs(out_dir, exist_ok=True)
+    _heartbeat(out_dir, {"phase": "probe"})
+    _run_probe(os.path.join(out_dir, "probe.json"))  # patient: no timeout
+
+    for name in config_names:
+        fn, small_kw, full_cost_s = CONFIGS[name]
+        remaining = deadline_ts - time.time()
+        if remaining < 90.0:
+            _write_out(os.path.join(out_dir, name + ".json"),
+                       {name + "_skipped": "out of time budget"})
+            continue
+        small = small_all or remaining < full_cost_s + 120.0
+        _heartbeat(out_dir, {"phase": name, "small": small})
+        try:
+            res = fn(**small_kw) if small else fn()
+            if small:
+                res[name + "_small"] = True
+        except Exception as e:  # noqa: BLE001 — record, keep going
+            res = {name + "_error": f"{type(e).__name__}: {e}"[:300]}
+            if not small and deadline_ts - time.time() > 90.0:
+                # a deterministic full-size failure (OOM, shape bug) can
+                # still contribute a measured small-size number
+                try:
+                    retry = fn(**small_kw)
+                    retry[name + "_small"] = True
+                    res.update(retry)
+                except Exception as e2:  # noqa: BLE001
+                    res[name + "_small_error"] = (
+                        f"{type(e2).__name__}: {e2}"[:300])
+        _write_out(os.path.join(out_dir, name + ".json"), res)
+    _heartbeat(out_dir, {"phase": "done"})
+
+
 # --------------------------------------------------------------------------
 # orchestrator (never imports jax)
 # --------------------------------------------------------------------------
 
-def _spawn(args, timeout_s, out_path):
-    """Run a child bench process; return (dict-or-None, error-or-None)."""
-    if os.path.exists(out_path):
-        os.remove(out_path)
-    env = dict(os.environ)
-    env.setdefault("JAX_COMPILATION_CACHE_DIR",
-                   os.path.join(REPO, ".jax_cache"))
+def _collect(out_dir, details):
+    """Merge every per-config result file written so far."""
     try:
-        proc = subprocess.run(
-            [sys.executable, os.path.abspath(__file__)] + args,
-            timeout=timeout_s, env=env, cwd=REPO,
-            stdout=subprocess.DEVNULL, stderr=subprocess.PIPE)
-        err = None if proc.returncode == 0 else (
-            f"rc={proc.returncode}: "
-            + proc.stderr.decode("utf-8", "replace")[-400:])
-    except subprocess.TimeoutExpired:
-        err = f"timeout after {timeout_s:.0f}s (killed)"
-    try:
-        with open(out_path) as f:
-            return json.load(f), err
-    except (OSError, ValueError):
-        return None, err or "child wrote no output"
-
-
-def _probe_backend(details):
-    """Fresh-process backend probes with kill-timeouts. A hang (the r02/r03
-    killer: make_c_api_client blocking forever on the axon relay) dies
-    with its subprocess; each retry gets a brand-new PJRT client. The
-    schedule escalates — two quick probes catch a transient flake, the
-    long final ones cover a relay that takes minutes to grant a chip."""
-    sched = os.environ.get("BENCH_PROBE_TIMEOUTS_S", "120,180,420,600")
-    timeouts = [float(x) for x in sched.split(",") if x.strip()]
-    last = None
-    for i, timeout_s in enumerate(timeouts):
-        out = os.path.join(REPO, f".bench_probe_{i}.json")
-        info, err = _spawn(["--probe", "--out", out], timeout_s, out)
-        if info is not None:
-            details.update(info)
-            details["probe_attempts"] = i + 1
-            return True
-        last = err
-        if i + 1 < len(timeouts):
-            time.sleep(15.0)
-    details["probe_attempts"] = len(timeouts)
-    details["probe_error"] = (last or "unknown")[:300]
-    return False
+        names = os.listdir(out_dir)
+    except OSError:
+        return
+    for fname in sorted(names):
+        if not fname.endswith(".json") or fname == "heartbeat.json":
+            continue
+        try:
+            with open(os.path.join(out_dir, fname)) as f:
+                details.update(json.load(f))
+        except (OSError, ValueError):
+            pass
 
 
 def _error_payload(msg):
@@ -636,44 +661,101 @@ def _publish_baseline(details, cfg_name, ref_key, value):
 def main():
     t_start = time.monotonic()
     budget_s = float(os.environ.get("BENCH_DEADLINE_S", 3300))
+    deadline_ts = time.time() + budget_s
+    out_dir = os.environ.get("BENCH_STATE_DIR",
+                             os.path.join(REPO, ".bench_state"))
+    # stale results from an earlier run must not masquerade as this run's
+    # (only bench artifacts — BENCH_STATE_DIR may point somewhere shared)
+    if os.path.isdir(out_dir):
+        for fname in os.listdir(out_dir):
+            known = (fname == "heartbeat.json"
+                     or fname.startswith("runner_")
+                     or fname[:-5] in CONFIGS and fname.endswith(".json")
+                     or fname == "probe.json")
+            if known:
+                try:
+                    os.remove(os.path.join(out_dir, fname))
+                except OSError:
+                    pass
 
     def remaining():
         return budget_s - (time.monotonic() - t_start)
 
-    details = {}
-    if not _probe_backend(details):
-        _emit(_error_payload(
-            "backend init failed after "
-            f"{details.get('probe_attempts')} fresh-process probes: "
-            f"{details.get('probe_error')}"))
-        raise SystemExit(1)
+    def heartbeat_phase():
+        try:
+            with open(os.path.join(out_dir, "heartbeat.json")) as f:
+                return json.load(f).get("phase")
+        except (OSError, ValueError):
+            return None
 
     small_all = os.environ.get("BENCH_SMALL", "0").lower() in ("1", "true",
                                                                "yes")
-    for name, (fn, small_kw, deadline) in CONFIGS.items():
-        # keep a reserve so later (cheaper-per-second headline fallback)
-        # configs aren't starved by one expensive config overrunning
-        budget = min(deadline, max(0.0, remaining() - 90.0))
-        if budget < 60.0:
-            details[name + "_skipped"] = "out of time budget"
-            continue
-        out = os.path.join(REPO, f".bench_{name}.json")
-        args = ["--config", name, "--out", out]
-        res, err = _spawn(args + (["--small"] if small_all else []),
-                          budget, out)
-        if res is None and not small_all:
-            # full size hung or crashed: one retry at small size so the
-            # config still contributes a measured (if modest) number
-            details[name + "_full_error"] = (err or "")[:300]
-            budget = min(deadline / 2, max(0.0, remaining() - 60.0))
-            if budget >= 60.0:
-                res, err = _spawn(args + ["--small"], budget, out)
-                if res is not None:
-                    res["%s_small" % name] = True
-        if res is not None:
-            details.update(res)
-        else:
-            details[name + "_error"] = (err or "unknown")[:300]
+    todo = list(CONFIGS)
+    details = {}
+    spawns = 0
+    while todo and remaining() > 90.0 and spawns < 3:
+        spawns += 1
+        args = ["--runner", "--out-dir", out_dir,
+                "--configs", ",".join(todo),
+                "--deadline-ts", str(deadline_ts)]
+        if small_all:
+            args.append("--small")
+        err_path = os.path.join(out_dir, f"runner_{spawns}.stderr")
+        os.makedirs(out_dir, exist_ok=True)
+        with open(err_path, "wb") as err_f:
+            proc = subprocess.Popen(
+                [sys.executable, os.path.abspath(__file__)] + args,
+                cwd=REPO, stdout=subprocess.DEVNULL, stderr=err_f)
+            # Wait for the runner: exit, or the global deadline. NEVER
+            # kill early — a killed waiter poisons the grant queue for
+            # successors.
+            try:
+                proc.wait(timeout=max(1.0, remaining()))
+            except subprocess.TimeoutExpired:
+                # SIGTERM + grace: a clean exit releases the chip grant
+                # in seconds, a SIGKILLed waiter poisons the queue for
+                # the NEXT session (the r03/r04 wedge). SIGKILL only if
+                # the grace period expires.
+                proc.terminate()
+                try:
+                    proc.wait(timeout=30.0)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+                    proc.wait()
+                details["runner_killed_at_deadline"] = True
+                inflight = heartbeat_phase()
+                if inflight in todo:
+                    details[inflight + "_error"] = (
+                        "in flight when the deadline killed the runner")
+                break
+        _collect(out_dir, details)
+        todo = [n for n in todo
+                if not os.path.exists(os.path.join(out_dir, n + ".json"))]
+        if proc.returncode == 0:
+            break
+        details["runner_crash_rc"] = proc.returncode
+        try:
+            with open(err_path, "rb") as f:
+                tail = f.read()[-400:].decode("utf-8", "replace")
+            if tail.strip():
+                details["runner_error"] = tail
+        except OSError:
+            pass
+        # a config that hard-crashes the process must not be retried at
+        # the head of every respawn, starving everything behind it
+        crashed = heartbeat_phase()
+        if crashed in todo:
+            details[crashed + "_error"] = (
+                f"runner crashed during this config (rc={proc.returncode})")
+            todo.remove(crashed)
+        time.sleep(10.0)
+    _collect(out_dir, details)
+    for name in todo:
+        # result keys are not all name-prefixed (flash_attention -> attn_*)
+        # so presence is judged by the per-config result file + markers
+        if (not os.path.exists(os.path.join(out_dir, name + ".json"))
+                and name + "_error" not in details):
+            details[name + "_skipped"] = "never attempted"
 
     # headline = BERT; fall back to the next real number on tunnel flakes.
     # If nothing measured, keep the documented BERT label with value null.
@@ -709,8 +791,18 @@ if __name__ == "__main__":
     ap.add_argument("--config", choices=list(CONFIGS))
     ap.add_argument("--out")
     ap.add_argument("--small", action="store_true")
+    ap.add_argument("--runner", action="store_true")
+    ap.add_argument("--out-dir")
+    ap.add_argument("--configs")
+    ap.add_argument("--deadline-ts", type=float)
     cli = ap.parse_args()
-    if cli.probe:
+    if cli.runner:
+        names = [n for n in (cli.configs or ",".join(CONFIGS)).split(",")
+                 if n in CONFIGS]
+        _run_runner(cli.out_dir or os.path.join(REPO, ".bench_state"),
+                    names, cli.deadline_ts or (time.time() + 3300),
+                    small_all=cli.small)
+    elif cli.probe:
         _run_probe(cli.out)
     elif cli.config:
         _run_config(cli.config, cli.out, cli.small)

@@ -194,10 +194,13 @@ def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean",  # no
 def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
              reduction="mean", norm_by_times=False):
     """CTC via the standard log-alpha forward recursion, vectorized over batch
-    with a lax.scan over time (reference: phi/kernels warpctc)."""
+    with a lax.scan over time (reference: phi/kernels warpctc).
+
+    `log_probs` is UNSCALED logits, matching the reference contract
+    (python/paddle/nn/functional/loss.py:1040 — "softmax with CTC", the
+    warpctc kernel normalizes internally); log_softmax happens here."""
     def _f(lp, lab, in_len, lab_len):
-        # lp: [T, B, C] log-softmaxed already? paddle expects logits after
-        # log_softmax; assume log-probs
+        lp = jax.nn.log_softmax(lp, axis=-1)  # warpctc-internal softmax
         T, B, C = lp.shape
         S = lab.shape[1]
         # extended label seq with blanks: length 2S+1
@@ -248,9 +251,18 @@ def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
         idx_prev = jnp.maximum(ext_len - 2, 0)
         aL = jnp.take_along_axis(alphaT, idx_last[:, None], axis=1)[:, 0]
         aP = jnp.take_along_axis(alphaT, idx_prev[:, None], axis=1)[:, 0]
+        # an empty target (lab_len==0) has only the all-blank path: the
+        # clamped idx_prev would double-count alpha[0]
+        aP = jnp.where(ext_len < 2, neg_inf, aP)
         m = jnp.maximum(aL, aP)
         ll = m + jnp.log(jnp.exp(aL - m) + jnp.exp(aP - m))
         loss = -ll
+        if norm_by_times:
+            # warpctc contract: scale the GRADIENT by 1/T per sequence,
+            # leaving the loss value itself unchanged
+            t_scale = in_len.astype(lp.dtype).clip(1)
+            scaled = loss / t_scale
+            loss = scaled + jax.lax.stop_gradient(loss - scaled)
         if reduction == "mean":
             return jnp.mean(loss / lab_len.astype(lp.dtype).clip(1))
         return _reduce(loss, reduction)

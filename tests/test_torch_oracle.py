@@ -541,3 +541,188 @@ def test_distributions_vs_torch():
     np.testing.assert_allclose(
         np.asarray(b.log_prob(_t(xb)).numpy()).ravel(),
         tb.log_prob(torch.from_numpy(xb)).numpy(), rtol=1e-5, atol=1e-5)
+
+
+def test_ctc_loss_vs_torch():
+    """ctc_loss takes UNSCALED logits (reference warpctc applies softmax
+    internally — python/paddle/nn/functional/loss.py:1040); torch's takes
+    log-probs, so the oracle feeds torch log_softmax(logits)."""
+    rng = np.random.RandomState(7)
+    T, B, C, S = 12, 3, 6, 5
+    logits = rng.randn(T, B, C).astype(np.float32)
+    labels = rng.randint(1, C, (B, S)).astype(np.int32)  # blank=0 excluded
+    in_len = np.array([12, 10, 8], np.int64)
+    lab_len = np.array([5, 3, 2], np.int64)
+
+    t_lp = torch.log_softmax(torch.from_numpy(logits), dim=-1)
+    for reduction in ("none", "mean", "sum"):
+        ours = F.ctc_loss(
+            paddle.to_tensor(logits), paddle.to_tensor(labels),
+            paddle.to_tensor(in_len), paddle.to_tensor(lab_len),
+            blank=0, reduction=reduction)
+        want = torch.nn.functional.ctc_loss(
+            t_lp, torch.from_numpy(labels.astype(np.int64)),
+            torch.from_numpy(in_len), torch.from_numpy(lab_len),
+            blank=0, reduction=reduction, zero_infinity=False)
+        np.testing.assert_allclose(
+            np.asarray(ours.numpy()).ravel(), want.numpy().ravel(),
+            rtol=1e-4, atol=1e-5, err_msg=f"reduction={reduction}")
+
+    # repeated labels exercise the same_as_prev2 transition rule
+    labels2 = np.array([[2, 2, 3, 3, 2]], np.int32)
+    logits2 = rng.randn(T, 1, C).astype(np.float32)
+    ours = F.ctc_loss(
+        paddle.to_tensor(logits2), paddle.to_tensor(labels2),
+        paddle.to_tensor(np.array([T], np.int64)),
+        paddle.to_tensor(np.array([5], np.int64)), reduction="sum")
+    want = torch.nn.functional.ctc_loss(
+        torch.log_softmax(torch.from_numpy(logits2), dim=-1),
+        torch.from_numpy(labels2.astype(np.int64)),
+        torch.tensor([T]), torch.tensor([5]), blank=0, reduction="sum")
+    np.testing.assert_allclose(float(ours), float(want), rtol=1e-4)
+
+
+def _copy_rnn_weights(p_rnn, t_rnn, num_layers, bidirectional):
+    """torch weight_ih_l{k}[_reverse] -> rnns[k].(rnn_fw|rnn_bw).cell."""
+    for k in range(num_layers):
+        wrappers = ([p_rnn.rnns[k].rnn_fw, p_rnn.rnns[k].rnn_bw]
+                    if bidirectional else [p_rnn.rnns[k]])
+        for d, wrap in enumerate(wrappers):
+            sfx = "_reverse" if d == 1 else ""
+            cell = wrap.cell
+            cell.weight_ih.set_value(
+                getattr(t_rnn, f"weight_ih_l{k}{sfx}").detach().numpy())
+            cell.weight_hh.set_value(
+                getattr(t_rnn, f"weight_hh_l{k}{sfx}").detach().numpy())
+            cell.bias_ih.set_value(
+                getattr(t_rnn, f"bias_ih_l{k}{sfx}").detach().numpy())
+            cell.bias_hh.set_value(
+                getattr(t_rnn, f"bias_hh_l{k}{sfx}").detach().numpy())
+
+
+def test_lstm_layer_stacked_bidirectional_vs_torch():
+    """Full-sequence 2-layer bidirectional LSTM: outputs and both final
+    states must match torch, including the [num_layers*num_dirs, B, H]
+    final-state packing order."""
+    E, H, B, T, L = 6, 10, 3, 7, 2
+    rng = np.random.RandomState(11)
+    torch.manual_seed(3)
+    t_rnn = torch.nn.LSTM(E, H, num_layers=L, bidirectional=True,
+                          batch_first=True)
+    p_rnn = paddle.nn.LSTM(E, H, num_layers=L, direction="bidirect")
+    _copy_rnn_weights(p_rnn, t_rnn, L, True)
+
+    x = rng.randn(B, T, E).astype(np.float32)
+    h0 = rng.randn(2 * L, B, H).astype(np.float32)
+    c0 = rng.randn(2 * L, B, H).astype(np.float32)
+    t_out, (t_h, t_c) = t_rnn(torch.from_numpy(x),
+                              (torch.from_numpy(h0), torch.from_numpy(c0)))
+    p_out, (p_h, p_c) = p_rnn(_t(x), (_t(h0), _t(c0)))
+    _cmp(p_out, t_out, rtol=1e-4, atol=1e-5)
+    _cmp(p_h, t_h, rtol=1e-4, atol=1e-5)
+    _cmp(p_c, t_c, rtol=1e-4, atol=1e-5)
+
+
+def test_gru_layer_time_major_vs_torch():
+    """GRU with time_major (torch batch_first=False) + default zero state."""
+    E, H, B, T = 5, 8, 4, 6
+    rng = np.random.RandomState(12)
+    torch.manual_seed(4)
+    t_rnn = torch.nn.GRU(E, H, num_layers=1, batch_first=False)
+    p_rnn = paddle.nn.GRU(E, H, num_layers=1, time_major=True)
+    _copy_rnn_weights(p_rnn, t_rnn, 1, False)
+    x = rng.randn(T, B, E).astype(np.float32)
+    t_out, t_h = t_rnn(torch.from_numpy(x))
+    p_out, p_h = p_rnn(_t(x))
+    _cmp(p_out, t_out, rtol=1e-4, atol=1e-5)
+    _cmp(p_h, t_h, rtol=1e-4, atol=1e-5)
+
+
+def test_lr_schedulers_vs_torch():
+    """Schedule-value parity with torch for the schedulers both frameworks
+    define with the same recurrence (Step/MultiStep/Exponential/
+    CosineAnnealing). paddle steps the scheduler explicitly; torch steps
+    an optimizer-bound one — values are compared per epoch."""
+    import paddle_tpu.optimizer.lr as plr
+
+    def torch_lrs(make, epochs):
+        p = torch.nn.Parameter(torch.zeros(1))
+        opt = torch.optim.SGD([p], lr=0.5)
+        sch = make(opt)
+        out = []
+        for _ in range(epochs):
+            out.append(opt.param_groups[0]["lr"])
+            opt.step()
+            sch.step()
+        return out
+
+    def paddle_lrs(sch, epochs):
+        out = []
+        for _ in range(epochs):
+            out.append(float(sch()))
+            sch.step()
+        return out
+
+    E = 12
+    pairs = [
+        (plr.StepDecay(0.5, step_size=3, gamma=0.4),
+         lambda o: torch.optim.lr_scheduler.StepLR(o, 3, 0.4)),
+        (plr.MultiStepDecay(0.5, milestones=[2, 5, 9], gamma=0.3),
+         lambda o: torch.optim.lr_scheduler.MultiStepLR(o, [2, 5, 9], 0.3)),
+        (plr.ExponentialDecay(0.5, gamma=0.9),
+         lambda o: torch.optim.lr_scheduler.ExponentialLR(o, 0.9)),
+        (plr.CosineAnnealingDecay(0.5, T_max=10, eta_min=0.01),
+         lambda o: torch.optim.lr_scheduler.CosineAnnealingLR(
+             o, 10, eta_min=0.01)),
+    ]
+    for p_sch, t_make in pairs:
+        np.testing.assert_allclose(
+            paddle_lrs(p_sch, E), torch_lrs(t_make, E), rtol=1e-6,
+            err_msg=type(p_sch).__name__)
+
+
+def test_ctc_loss_empty_target_and_norm_by_times():
+    """lab_len==0 leaves only the all-blank path (torch oracle); the
+    norm_by_times grad scaling divides d loss/d logits by T without
+    changing the loss value."""
+    rng = np.random.RandomState(13)
+    T, B, C = 6, 2, 4
+    logits = rng.randn(T, B, C).astype(np.float32)
+    labels = np.zeros((B, 2), np.int32)
+    in_len = np.array([6, 5], np.int64)
+    lab_len = np.array([0, 0], np.int64)
+    ours = F.ctc_loss(paddle.to_tensor(logits), paddle.to_tensor(labels),
+                      paddle.to_tensor(in_len), paddle.to_tensor(lab_len),
+                      reduction="none")
+    want = torch.nn.functional.ctc_loss(
+        torch.log_softmax(torch.from_numpy(logits), dim=-1),
+        torch.from_numpy(labels.astype(np.int64)),
+        torch.from_numpy(in_len), torch.from_numpy(lab_len),
+        blank=0, reduction="none")
+    np.testing.assert_allclose(np.asarray(ours.numpy()), want.numpy(),
+                               rtol=1e-4, atol=1e-5)
+
+    import jax
+    import jax.numpy as jnp
+    labels2 = rng.randint(1, C, (B, 3)).astype(np.int32)
+    lab_len2 = np.array([3, 2], np.int64)
+
+    def loss_sum(lg, norm):
+        return jnp.sum(F.ctc_loss(
+            paddle.to_tensor(lg), paddle.to_tensor(labels2),
+            paddle.to_tensor(in_len), paddle.to_tensor(lab_len2),
+            reduction="none", norm_by_times=norm)._value)
+
+    base = F.ctc_loss(paddle.to_tensor(logits), paddle.to_tensor(labels2),
+                      paddle.to_tensor(in_len), paddle.to_tensor(lab_len2),
+                      reduction="none", norm_by_times=False)
+    normed = F.ctc_loss(paddle.to_tensor(logits), paddle.to_tensor(labels2),
+                        paddle.to_tensor(in_len), paddle.to_tensor(lab_len2),
+                        reduction="none", norm_by_times=True)
+    np.testing.assert_allclose(np.asarray(base.numpy()),
+                               np.asarray(normed.numpy()), rtol=1e-6)
+    g_plain = jax.grad(loss_sum)(jnp.asarray(logits), False)
+    g_norm = jax.grad(loss_sum)(jnp.asarray(logits), True)
+    np.testing.assert_allclose(np.asarray(g_norm),
+                               np.asarray(g_plain) / in_len[None, :, None],
+                               rtol=1e-5, atol=1e-7)

@@ -300,7 +300,9 @@ class Transformer(Layer):
                 d_model, nhead, dim_feedforward, dropout, activation,
                 attn_dropout, act_dropout, normalize_before, weight_attr,
                 bias_attr)
-            enc_norm = LayerNorm(d_model) if normalize_before else None
+            # the reference applies a final LayerNorm unconditionally,
+            # pre- AND post-norm (nn/layer/transformer.py:1275)
+            enc_norm = LayerNorm(d_model)
             self.encoder = TransformerEncoder(enc_layer, num_encoder_layers,
                                               enc_norm)
         if custom_decoder is not None:
@@ -310,7 +312,7 @@ class Transformer(Layer):
                 d_model, nhead, dim_feedforward, dropout, activation,
                 attn_dropout, act_dropout, normalize_before, weight_attr,
                 bias_attr)
-            dec_norm = LayerNorm(d_model) if normalize_before else None
+            dec_norm = LayerNorm(d_model)
             self.decoder = TransformerDecoder(dec_layer, num_decoder_layers,
                                               dec_norm)
         self.d_model = d_model

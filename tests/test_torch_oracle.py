@@ -124,16 +124,7 @@ def test_multihead_attention_vs_torch():
     torch.manual_seed(0)
     t_mha = torch.nn.MultiheadAttention(E, H, batch_first=True)
     p_mha = paddle.nn.MultiHeadAttention(E, H)
-
-    w = t_mha.in_proj_weight.detach().numpy()    # [3E, E]
-    b = t_mha.in_proj_bias.detach().numpy()      # [3E]
-    for i, name in enumerate(["q_proj", "k_proj", "v_proj"]):
-        lin = getattr(p_mha, name)
-        lin.weight.set_value(w[i * E:(i + 1) * E].T.copy())
-        lin.bias.set_value(b[i * E:(i + 1) * E].copy())
-    p_mha.out_proj.weight.set_value(
-        t_mha.out_proj.weight.detach().numpy().T.copy())
-    p_mha.out_proj.bias.set_value(t_mha.out_proj.bias.detach().numpy())
+    _map_mha(p_mha, t_mha, E)  # helper defined below (shared mapping)
 
     rng = np.random.RandomState(6)
     q = rng.randn(B, S, E).astype(np.float32)
@@ -726,3 +717,77 @@ def test_ctc_loss_empty_target_and_norm_by_times():
     np.testing.assert_allclose(np.asarray(g_norm),
                                np.asarray(g_plain) / in_len[None, :, None],
                                rtol=1e-5, atol=1e-7)
+
+
+def _map_mha(p_mha, t_mha, E):
+    w = t_mha.in_proj_weight.detach().numpy()
+    b = t_mha.in_proj_bias.detach().numpy()
+    for i, name in enumerate(["q_proj", "k_proj", "v_proj"]):
+        lin = getattr(p_mha, name)
+        lin.weight.set_value(w[i * E:(i + 1) * E].T.copy())
+        lin.bias.set_value(b[i * E:(i + 1) * E].copy())
+    p_mha.out_proj.weight.set_value(
+        t_mha.out_proj.weight.detach().numpy().T.copy())
+    p_mha.out_proj.bias.set_value(t_mha.out_proj.bias.detach().numpy())
+
+
+def _map_linear(p_lin, t_lin):
+    p_lin.weight.set_value(t_lin.weight.detach().numpy().T.copy())
+    p_lin.bias.set_value(t_lin.bias.detach().numpy())
+
+
+def _map_norm(p_n, t_n):
+    p_n.weight.set_value(t_n.weight.detach().numpy())
+    p_n.bias.set_value(t_n.bias.detach().numpy())
+
+
+def test_transformer_encoder_decoder_vs_torch():
+    """Whole nn.Transformer stack vs torch (2+2 layers, post-norm, relu,
+    dropout 0): same residual/norm placement, same mask semantics, causal
+    target mask through the decoder's self+cross attention."""
+    E, H, FF, B, S, T = 16, 4, 32, 2, 7, 5
+    torch.manual_seed(5)
+    t_tr = torch.nn.Transformer(
+        d_model=E, nhead=H, num_encoder_layers=2, num_decoder_layers=2,
+        dim_feedforward=FF, dropout=0.0, batch_first=True,
+        norm_first=False)
+    p_tr = paddle.nn.Transformer(
+        d_model=E, nhead=H, num_encoder_layers=2, num_decoder_layers=2,
+        dim_feedforward=FF, dropout=0.0, normalize_before=False)
+
+    for p_layer, t_layer in zip(p_tr.encoder.layers, t_tr.encoder.layers):
+        _map_mha(p_layer.self_attn, t_layer.self_attn, E)
+        _map_linear(p_layer.linear1, t_layer.linear1)
+        _map_linear(p_layer.linear2, t_layer.linear2)
+        _map_norm(p_layer.norm1, t_layer.norm1)
+        _map_norm(p_layer.norm2, t_layer.norm2)
+    for p_layer, t_layer in zip(p_tr.decoder.layers, t_tr.decoder.layers):
+        _map_mha(p_layer.self_attn, t_layer.self_attn, E)
+        _map_mha(p_layer.cross_attn, t_layer.multihead_attn, E)
+        _map_linear(p_layer.linear1, t_layer.linear1)
+        _map_linear(p_layer.linear2, t_layer.linear2)
+        _map_norm(p_layer.norm1, t_layer.norm1)
+        _map_norm(p_layer.norm2, t_layer.norm2)
+        _map_norm(p_layer.norm3, t_layer.norm3)
+    # both stacks apply a final LayerNorm unconditionally (reference
+    # paddle nn/layer/transformer.py:1275 matches torch) — map the affine
+    # to a non-trivial value so the final norm is actually exercised
+    for t_norm, p_norm in ((t_tr.encoder.norm, p_tr.encoder.norm),
+                           (t_tr.decoder.norm, p_tr.decoder.norm)):
+        with torch.no_grad():
+            t_norm.weight.mul_(1.3)
+            t_norm.bias.add_(0.1)
+        _map_norm(p_norm, t_norm)
+
+    rng = np.random.RandomState(14)
+    src = rng.randn(B, S, E).astype(np.float32)
+    tgt = rng.randn(B, T, E).astype(np.float32)
+    causal = torch.triu(torch.full((T, T), float("-inf")), 1)
+    t_tr.eval()
+    with torch.no_grad():
+        want = t_tr(torch.from_numpy(src), torch.from_numpy(tgt),
+                    tgt_mask=causal)
+    p_tr.eval()
+    mask = np.triu(np.full((T, T), -np.inf, np.float32), 1)
+    got = p_tr(_t(src), _t(tgt), tgt_mask=_t(mask[None, None]))
+    _cmp(got, want, rtol=1e-4, atol=1e-5)

@@ -133,7 +133,12 @@ def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0,
         dims[ch_axis] = size
         s = jax.lax.reduce_window(padded, 0.0, jax.lax.add, tuple(dims),
                                   (1,) * v.ndim, [(0, 0)] * v.ndim)
-        return v / jnp.power(k + alpha * s, beta)
+        # the reference implementation avg-pools x^2 (i.e. divides the
+        # window sum by `size`) before scaling by alpha — matching torch
+        # at identical alpha — even though its docstring formula shows a
+        # raw sum (reference nn/functional/norm.py:444 vs its avg_pool
+        # body)
+        return v / jnp.power(k + alpha * s / size, beta)
     return apply(_f, x)
 
 

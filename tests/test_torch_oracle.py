@@ -791,3 +791,140 @@ def test_transformer_encoder_decoder_vs_torch():
     mask = np.triu(np.full((T, T), -np.inf, np.float32), 1)
     got = p_tr(_t(src), _t(tgt), tgt_mask=_t(mask[None, None]))
     _cmp(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_conv_transpose_vs_torch():
+    """conv2d_transpose across stride/padding/output_padding/dilation/
+    groups — the classic divergence corners; weight layout [Cin, Cout/g,
+    kH, kW] matches torch."""
+    rng = np.random.RandomState(15)
+    cases = [
+        dict(stride=2, padding=1, output_padding=1, dilation=1, groups=1),
+        dict(stride=3, padding=2, output_padding=0, dilation=1, groups=1),
+        dict(stride=2, padding=0, output_padding=0, dilation=2, groups=1),
+        dict(stride=2, padding=1, output_padding=1, dilation=1, groups=2),
+    ]
+    for kw in cases:
+        g = kw["groups"]
+        x = rng.randn(2, 4, 9, 9).astype(np.float32)
+        w = rng.randn(4, 6 // g, 3, 3).astype(np.float32)
+        b = rng.randn(6).astype(np.float32)
+        want = torch.nn.functional.conv_transpose2d(
+            torch.from_numpy(x), torch.from_numpy(w), torch.from_numpy(b),
+            **kw)
+        got = F.conv2d_transpose(_t(x), _t(w), _t(b), **kw)
+        np.testing.assert_allclose(
+            np.asarray(got.numpy()), want.numpy(), rtol=1e-3, atol=1e-4,
+            err_msg=str(kw))
+
+    # conv1d_transpose sanity at one non-trivial setting
+    x1 = rng.randn(2, 3, 11).astype(np.float32)
+    w1 = rng.randn(3, 5, 4).astype(np.float32)
+    want1 = torch.nn.functional.conv_transpose1d(
+        torch.from_numpy(x1), torch.from_numpy(w1), stride=2, padding=1,
+        output_padding=1)
+    got1 = F.conv1d_transpose(_t(x1), _t(w1), stride=2, padding=1,
+                              output_padding=1)
+    np.testing.assert_allclose(np.asarray(got1.numpy()), want1.numpy(),
+                               rtol=1e-3, atol=1e-4)
+
+
+def test_unfold_fold_pixelops_vs_torch():
+    """unfold/fold patch extraction (kernel/stride/padding/dilation),
+    pixel_shuffle/unshuffle, local_response_norm, glu."""
+    rng = np.random.RandomState(16)
+    x = rng.randn(2, 3, 8, 8).astype(np.float32)
+    tx = torch.from_numpy(x)
+    got = F.unfold(_t(x), kernel_sizes=3, strides=2, paddings=1,
+                   dilations=1)
+    want = torch.nn.functional.unfold(tx, 3, dilation=1, padding=1,
+                                      stride=2)
+    np.testing.assert_allclose(np.asarray(got.numpy()), want.numpy(),
+                               rtol=1e-5, atol=1e-6)
+
+    cols = rng.randn(2, 3 * 3 * 3, 16).astype(np.float32)
+    got = F.fold(_t(cols), output_sizes=(8, 8), kernel_sizes=3,
+                 strides=2, paddings=1)
+    want = torch.nn.functional.fold(torch.from_numpy(cols), (8, 8), 3,
+                                    padding=1, stride=2)
+    np.testing.assert_allclose(np.asarray(got.numpy()), want.numpy(),
+                               rtol=1e-5, atol=1e-6)
+
+    x2 = rng.randn(2, 8, 4, 4).astype(np.float32)
+    got = F.pixel_shuffle(_t(x2), 2)
+    want = torch.nn.functional.pixel_shuffle(torch.from_numpy(x2), 2)
+    np.testing.assert_allclose(np.asarray(got.numpy()), want.numpy(),
+                               rtol=1e-6)
+    x3 = rng.randn(2, 2, 8, 8).astype(np.float32)
+    got = F.pixel_unshuffle(_t(x3), 2)
+    want = torch.nn.functional.pixel_unshuffle(torch.from_numpy(x3), 2)
+    np.testing.assert_allclose(np.asarray(got.numpy()), want.numpy(),
+                               rtol=1e-6)
+
+    # the reference LRN implementation avg-pools x^2 (divides by size),
+    # identical to torch at the same alpha — its docstring formula shows
+    # a raw sum but the body does not
+    x4 = rng.randn(2, 7, 6, 6).astype(np.float32) * 2
+    got = F.local_response_norm(_t(x4), size=5, alpha=1e-3, beta=0.75, k=1.0)
+    want = torch.nn.functional.local_response_norm(
+        torch.from_numpy(x4), 5, alpha=1e-3, beta=0.75, k=1.0)
+    np.testing.assert_allclose(np.asarray(got.numpy()), want.numpy(),
+                               rtol=1e-4, atol=1e-6)
+
+    x5 = rng.randn(4, 10).astype(np.float32)
+    got = F.glu(_t(x5), axis=-1)
+    want = torch.nn.functional.glu(torch.from_numpy(x5), -1)
+    np.testing.assert_allclose(np.asarray(got.numpy()), want.numpy(),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_conv3d_and_normalize_vs_torch():
+    rng = np.random.RandomState(17)
+    x = rng.randn(2, 3, 5, 6, 6).astype(np.float32)
+    w = rng.randn(4, 3, 3, 3, 3).astype(np.float32)
+    got = F.conv3d(_t(x), _t(w), stride=(1, 2, 2), padding=1)
+    want = torch.nn.functional.conv3d(torch.from_numpy(x),
+                                      torch.from_numpy(w),
+                                      stride=(1, 2, 2), padding=1)
+    np.testing.assert_allclose(np.asarray(got.numpy()), want.numpy(),
+                               rtol=1e-3, atol=1e-4)
+
+    v = rng.randn(4, 8).astype(np.float32)
+    got = F.normalize(_t(v), p=2, axis=1)
+    want = torch.nn.functional.normalize(torch.from_numpy(v), p=2, dim=1)
+    np.testing.assert_allclose(np.asarray(got.numpy()), want.numpy(),
+                               rtol=1e-5, atol=1e-6)
+    a = rng.randn(4, 8).astype(np.float32)
+    b = rng.randn(4, 8).astype(np.float32)
+    got = F.cosine_similarity(_t(a), _t(b), axis=1)
+    want = torch.nn.functional.cosine_similarity(
+        torch.from_numpy(a), torch.from_numpy(b), dim=1)
+    np.testing.assert_allclose(np.asarray(got.numpy()), want.numpy(),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_affine_grid_channel_shuffle_unpool_vs_torch():
+    rng = np.random.RandomState(18)
+    theta = rng.randn(2, 2, 3).astype(np.float32) * 0.3
+    for align in (False, True):
+        got = F.affine_grid(_t(theta), [2, 3, 5, 7], align_corners=align)
+        want = torch.nn.functional.affine_grid(
+            torch.from_numpy(theta), [2, 3, 5, 7], align_corners=align)
+        np.testing.assert_allclose(np.asarray(got.numpy()), want.numpy(),
+                                   rtol=1e-4, atol=1e-5,
+                                   err_msg=f"align={align}")
+
+    x = rng.randn(2, 8, 4, 4).astype(np.float32)
+    got = F.channel_shuffle(_t(x), 4)
+    want = torch.nn.functional.channel_shuffle(torch.from_numpy(x), 4)
+    np.testing.assert_allclose(np.asarray(got.numpy()), want.numpy())
+
+    # max_unpool round-trips pool indices
+    xp = rng.randn(2, 3, 8, 8).astype(np.float32)
+    t_out, t_idx = torch.nn.functional.max_pool2d(
+        torch.from_numpy(xp), 2, stride=2, return_indices=True)
+    p_out, p_idx = F.max_pool2d(_t(xp), 2, stride=2, return_mask=True)
+    np.testing.assert_allclose(np.asarray(p_out.numpy()), t_out.numpy())
+    got = F.max_unpool2d(p_out, p_idx, 2, stride=2)
+    want = torch.nn.functional.max_unpool2d(t_out, t_idx, 2, stride=2)
+    np.testing.assert_allclose(np.asarray(got.numpy()), want.numpy())

@@ -1,0 +1,35 @@
+"""Shared example bootstrap.
+
+`python examples/foo.py` puts examples/ (not the repo root) on
+sys.path — repo_root() fixes the import path. CPU forcing must go
+through jax.config: plugin registration à la sitecustomize runs at
+interpreter start, so a JAX_PLATFORMS env var set here is too late.
+"""
+import os
+import sys
+
+
+def repo_root():
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if root not in sys.path:
+        sys.path.insert(0, root)
+
+
+def force_cpu(devices=1):
+    if devices > 1:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "--xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags
+                + f" --xla_force_host_platform_device_count={devices}"
+            ).strip()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+
+def maybe_force_cpu():
+    """Opt-in CPU run for the single-chip examples (smoke tests, judge
+    machines without the TPU tunnel): PADDLE_TPU_EXAMPLE_CPU=1."""
+    if os.environ.get("PADDLE_TPU_EXAMPLE_CPU") == "1":
+        force_cpu()

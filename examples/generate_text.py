@@ -4,6 +4,10 @@ Run: python examples/generate_text.py
 Prefill compiles once per prompt length; every subsequent token reuses one
 cached XLA executable (preallocated caches + dynamic_update_slice).
 """
+import _bootstrap  # noqa: examples/ is sys.path[0] for script runs
+_bootstrap.repo_root()
+_bootstrap.maybe_force_cpu()
+
 import numpy as np
 
 import paddle_tpu as paddle

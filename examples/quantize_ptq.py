@@ -5,6 +5,10 @@ Calibration observers ride the normal (jitted) eval forwards; convert()
 swaps Linear/Conv2D for int8 layers whose matmuls lower to the MXU's
 integer dot_general.
 """
+import _bootstrap  # noqa: examples/ is sys.path[0] for script runs
+_bootstrap.repo_root()
+_bootstrap.maybe_force_cpu()
+
 import numpy as np
 
 import paddle_tpu as paddle

@@ -4,6 +4,10 @@ Demonstrates the compat namespace: static Program + Executor and the
 dygraph guard/to_variable idiom, both through `paddle_tpu.fluid`.
 Run: python examples/train_fluid_era_mnist.py
 """
+import _bootstrap  # noqa: examples/ is sys.path[0] for script runs
+_bootstrap.repo_root()
+_bootstrap.maybe_force_cpu()
+
 import numpy as np
 
 import paddle_tpu as paddle

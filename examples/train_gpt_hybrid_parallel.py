@@ -1,14 +1,22 @@
 """Hybrid-parallel GPT training through the fleet API.
 
-Run (single host, 8 virtual devices):
-  XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
-      python examples/train_gpt_hybrid_parallel.py
+Run: python examples/train_gpt_hybrid_parallel.py
+(defaults to a virtual 8-device CPU mesh so it runs anywhere; set
+PADDLE_TPU_EXAMPLE_REAL=1 on a real 8-chip host)
 
 fleet.init turns the strategy into a (dp, pp, tp) device mesh; the model's
 sharding annotations resolve against it (megatron tp layout), the trunk
 becomes a PipelineLayer running a jitted GPipe schedule, and XLA inserts
 the collectives.
 """
+import os
+
+import _bootstrap  # noqa: examples/ is sys.path[0] for script runs
+
+_bootstrap.repo_root()
+if os.environ.get("PADDLE_TPU_EXAMPLE_REAL") != "1":
+    _bootstrap.force_cpu(devices=8)
+
 import numpy as np
 
 import paddle_tpu as paddle

@@ -4,6 +4,10 @@ Run: python examples/train_lenet_mnist.py
 Everything compiles into ONE XLA program per step (forward, loss,
 backward, optimizer update) with donated buffers.
 """
+import _bootstrap  # noqa: examples/ is sys.path[0] for script runs
+_bootstrap.repo_root()
+_bootstrap.maybe_force_cpu()
+
 import paddle_tpu as paddle
 from paddle_tpu import nn
 

@@ -2,6 +2,10 @@
 and translate — the reference's machine-translation benchmark flow on
 paddle_tpu. Run: python examples/train_wmt_transformer.py
 """
+import _bootstrap  # noqa: examples/ is sys.path[0] for script runs
+_bootstrap.repo_root()
+_bootstrap.maybe_force_cpu()
+
 import numpy as np
 
 import paddle_tpu as paddle

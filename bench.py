@@ -468,11 +468,15 @@ def bench_dataloader(n=512, batch=64, shape=(3, 224, 224), epochs=3):
     return res
 
 
-# name -> (fn, small_kwargs, full_deadline_s). Order is the RUN order:
-# cheapest-first so a mid-run hang still leaves measured configs behind
-# (round-3 verdict: BERT-first meant a single hang starved everything).
+# name -> (fn, small_kwargs, full_cost_estimate_s). Order is the RUN
+# order: lenet first as a cheap sanity probe of real execution, then the
+# BERT headline — with one patient runner writing results incrementally,
+# landing the headline early maximizes what survives an external kill at
+# an unknown deadline; the cheaper diagnostics follow.
 CONFIGS = {
     "lenet": (bench_lenet, {"batch": 8, "steps": 2, "warmup": 1}, 420),
+    "bert": (bench_bert, {"batch": 2, "seq": 32, "steps": 2, "warmup": 1},
+             900),
     "flash_attention": (bench_flash_attention,
                         {"batch": 1, "heads": 2, "seq": 128, "iters": 2},
                         600),
@@ -480,8 +484,6 @@ CONFIGS = {
                      {"n": 64, "hidden": 32, "vocab": 512, "iters": 2}, 480),
     "dataloader": (bench_dataloader, {"n": 32, "batch": 8, "epochs": 1}, 420),
     "resnet50": (bench_resnet50, {"batch": 2, "steps": 2, "warmup": 1}, 900),
-    "bert": (bench_bert, {"batch": 2, "seq": 32, "steps": 2, "warmup": 1},
-             900),
     "gpt": (bench_gpt, {"batch": 1, "seq": 32, "steps": 1, "warmup": 1},
             900),
     "generate": (bench_generate,
@@ -681,19 +683,26 @@ def main():
     def remaining():
         return budget_s - (time.monotonic() - t_start)
 
-    def heartbeat_phase():
+    def heartbeat_state():
+        """(phase, seconds since the heartbeat file changed) or (None, None)."""
+        path = os.path.join(out_dir, "heartbeat.json")
         try:
-            with open(os.path.join(out_dir, "heartbeat.json")) as f:
-                return json.load(f).get("phase")
+            with open(path) as f:
+                phase = json.load(f).get("phase")
+            return phase, time.time() - os.path.getmtime(path)
         except (OSError, ValueError):
-            return None
+            return None, None
+
+    def heartbeat_phase():
+        return heartbeat_state()[0]
 
     small_all = os.environ.get("BENCH_SMALL", "0").lower() in ("1", "true",
                                                                "yes")
     todo = list(CONFIGS)
     details = {}
     spawns = 0
-    while todo and remaining() > 90.0 and spawns < 3:
+    max_spawns = int(os.environ.get("BENCH_MAX_SPAWNS", 3))
+    while todo and remaining() > 90.0 and spawns < max_spawns:
         spawns += 1
         args = ["--runner", "--out-dir", out_dir,
                 "--configs", ",".join(todo),
@@ -706,27 +715,51 @@ def main():
             proc = subprocess.Popen(
                 [sys.executable, os.path.abspath(__file__)] + args,
                 cwd=REPO, stdout=subprocess.DEVNULL, stderr=err_f)
-            # Wait for the runner: exit, or the global deadline. NEVER
-            # kill early — a killed waiter poisons the grant queue for
-            # successors.
-            try:
-                proc.wait(timeout=max(1.0, remaining()))
-            except subprocess.TimeoutExpired:
-                # SIGTERM + grace: a clean exit releases the chip grant
-                # in seconds, a SIGKILLed waiter poisons the queue for
-                # the NEXT session (the r03/r04 wedge). SIGKILL only if
-                # the grace period expires.
-                proc.terminate()
+            # Wait for the runner, polling the heartbeat. Two different
+            # stall regimes:
+            #  * phase == "probe": the runner is WAITING for the chip
+            #    grant. Never kill it — a killed waiter poisons the
+            #    grant queue for successors (the r03/r04 wedge); the
+            #    upstream claim itself errors out after ~25 min and the
+            #    crash path respawns cleanly.
+            #  * phase == some config: the grant is held and a config
+            #    wedged mid-execution. Killing is safe-ish here (the
+            #    session dies with the process, releasing the chip) and
+            #    necessary — one hung config must not starve the rest
+            #    (round-3 lesson). Stale = no heartbeat movement for the
+            #    config's cost estimate + 600s of tunnel-compile slack.
+            killed_stuck = None
+            while True:
                 try:
-                    proc.wait(timeout=30.0)
+                    proc.wait(timeout=min(30.0, max(1.0, remaining())))
+                    break
                 except subprocess.TimeoutExpired:
-                    proc.kill()
-                    proc.wait()
-                details["runner_killed_at_deadline"] = True
-                inflight = heartbeat_phase()
-                if inflight in todo:
-                    details[inflight + "_error"] = (
-                        "in flight when the deadline killed the runner")
+                    pass
+                hb_phase, hb_age = heartbeat_state()
+                stuck = (hb_phase in CONFIGS and hb_age is not None
+                         and hb_age > CONFIGS[hb_phase][2] + 600.0)
+                if remaining() <= 0.0 or stuck:
+                    # SIGTERM + grace; SIGKILL only if grace expires
+                    proc.terminate()
+                    try:
+                        proc.wait(timeout=30.0)
+                    except subprocess.TimeoutExpired:
+                        proc.kill()
+                        proc.wait()
+                    if stuck and remaining() > 0.0:
+                        killed_stuck = hb_phase
+                        details[hb_phase + "_error"] = (
+                            f"hung >{int(hb_age)}s mid-config; "
+                            "runner recycled")
+                    else:
+                        details["runner_killed_at_deadline"] = True
+                        inflight = heartbeat_phase()
+                        if inflight in todo:
+                            details[inflight + "_error"] = (
+                                "in flight when the deadline killed the "
+                                "runner")
+                    break
+            if details.get("runner_killed_at_deadline"):
                 break
         _collect(out_dir, details)
         todo = [n for n in todo
@@ -741,11 +774,12 @@ def main():
                 details["runner_error"] = tail
         except OSError:
             pass
-        # a config that hard-crashes the process must not be retried at
-        # the head of every respawn, starving everything behind it
+        # a config that hard-crashes (or hangs, above) must not be
+        # retried at the head of every respawn, starving the rest
         crashed = heartbeat_phase()
         if crashed in todo:
-            details[crashed + "_error"] = (
+            details.setdefault(
+                crashed + "_error",
                 f"runner crashed during this config (rc={proc.returncode})")
             todo.remove(crashed)
         time.sleep(10.0)

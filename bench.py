@@ -728,7 +728,6 @@ def main():
             #    necessary — one hung config must not starve the rest
             #    (round-3 lesson). Stale = no heartbeat movement for the
             #    config's cost estimate + 600s of tunnel-compile slack.
-            killed_stuck = None
             while True:
                 try:
                     proc.wait(timeout=min(30.0, max(1.0, remaining())))
@@ -747,7 +746,6 @@ def main():
                         proc.kill()
                         proc.wait()
                     if stuck and remaining() > 0.0:
-                        killed_stuck = hb_phase
                         details[hb_phase + "_error"] = (
                             f"hung >{int(hb_age)}s mid-config; "
                             "runner recycled")

@@ -22,6 +22,8 @@ import functools
 import inspect
 import textwrap
 
+import numpy as np
+
 __all__ = ["convert_to_static", "convert_cond", "convert_while"]
 
 _HELPER = "__paddle_jst"
@@ -149,6 +151,68 @@ class _ControlFlowTransformer(ast.NodeTransformer):
         return [branch(tname, node.body), branch(fname, node.orelse),
                 assign]
 
+    # -- for i in range(...) ------------------------------------------------
+    def visit_For(self, node):
+        """Desugar `for i in range(...)` into a while so tensor-valued
+        bounds trace to lax.while_loop (reference dy2static converts
+        range loops the same way); every other `for` stays Python.
+
+        Escapes (break/continue/return) keep the original For: the
+        desugared body would run `continue` WITHOUT the index increment.
+        Known divergence: an empty range leaves the loop var bound to
+        `start` here, where Python leaves it unbound."""
+        self.generic_visit(node)
+        it = node.iter
+        if (node.orelse or not isinstance(node.target, ast.Name)
+                or not isinstance(it, ast.Call)
+                or not isinstance(it.func, ast.Name)
+                or it.func.id != "range" or it.keywords
+                or not 1 <= len(it.args) <= 3
+                or any(isinstance(a, ast.Starred) for a in it.args)
+                or _has_escape(node.body)):
+            return node
+        target = node.target.id
+        if target in _assigned_names(node.body):
+            # a body that reassigns the loop var relies on the iterator
+            # resetting it each pass — the carried-increment desugar
+            # would change the iteration count; keep Python semantics
+            return node
+        uid = self._uid()
+        if len(it.args) == 1:
+            start, stop = ast.Constant(value=0), it.args[0]
+            step = ast.Constant(value=1)
+        else:
+            start, stop = it.args[0], it.args[1]
+            step = it.args[2] if len(it.args) == 3 else ast.Constant(value=1)
+        stop_n, step_n = f"__jst_fstop_{uid}", f"__jst_fstep_{uid}"
+        # one validating call also keeps range()'s left-to-right argument
+        # evaluation order and its TypeError/ValueError contract
+        args_call = ast.Call(
+            func=ast.Attribute(value=ast.Name(id=_HELPER, ctx=ast.Load()),
+                               attr="range_args", ctx=ast.Load()),
+            args=[start, stop, step], keywords=[])
+        pre = [
+            ast.Assign(targets=[ast.Tuple(
+                elts=[ast.Name(id=n, ctx=ast.Store())
+                      for n in (target, stop_n, step_n)],
+                ctx=ast.Store())], value=args_call),
+        ]
+        test = ast.Call(
+            func=ast.Attribute(value=ast.Name(id=_HELPER, ctx=ast.Load()),
+                               attr="range_cond", ctx=ast.Load()),
+            args=[ast.Name(id=target, ctx=ast.Load()),
+                  ast.Name(id=stop_n, ctx=ast.Load()),
+                  ast.Name(id=step_n, ctx=ast.Load())],
+            keywords=[])
+        bump = ast.Assign(
+            targets=[ast.Name(id=target, ctx=ast.Store())],
+            value=ast.BinOp(left=ast.Name(id=target, ctx=ast.Load()),
+                            op=ast.Add(),
+                            right=ast.Name(id=step_n, ctx=ast.Load())))
+        loop = ast.While(test=test, body=node.body + [bump], orelse=[])
+        out = self.visit_While(loop)
+        return pre + (out if isinstance(out, list) else [out])
+
     # -- while --------------------------------------------------------------
     def visit_While(self, node):
         self.generic_visit(node)
@@ -268,9 +332,43 @@ def convert_while(cond_fn, body_fn, loop_vars):
     return vals
 
 
+def convert_range_args(start, stop, step):
+    """Validate desugared range() arguments with Python's own contract
+    (TypeError on non-integral, ValueError on step==0); tensors pass
+    through for traced bounds."""
+    def check(v, name):
+        if _is_tensor_pred(v):
+            return v
+        if isinstance(v, bool) or not isinstance(v, (int, np.integer)):
+            raise TypeError(
+                f"'{type(v).__name__}' object cannot be interpreted as an "
+                f"integer (range() {name})")
+        return v
+
+    start, stop, step = (check(start, "start"), check(stop, "stop"),
+                         check(step, "step"))
+    if not _is_tensor_pred(step) and step == 0:
+        raise ValueError("range() arg 3 must not be zero")
+    return start, stop, step
+
+
+def convert_range_cond(i, stop, step):
+    """`i` still inside range(start, stop, step)? Sign-aware, tensor-aware
+    (the desugared `for` uses this as its while predicate)."""
+    if not any(_is_tensor_pred(v) for v in (i, stop, step)):
+        return (step > 0 and i < stop) or (step < 0 and i > stop)
+    if not _is_tensor_pred(step):  # static step: pick the branch directly
+        return (i < stop) if step > 0 else (i > stop)
+    pos = (step > 0) & (i < stop)
+    neg = (step < 0) & (i > stop)
+    return pos | neg
+
+
 class _Helper:
     cond = staticmethod(convert_cond)
     while_loop = staticmethod(convert_while)
+    range_cond = staticmethod(convert_range_cond)
+    range_args = staticmethod(convert_range_args)
     get = staticmethod(_get)
     UNDEF = UNDEF
 

@@ -199,3 +199,97 @@ class TestFallbacks:
         conv = paddle.jit.to_static(g)
         out = conv(paddle.to_tensor(np.ones(2, np.float32)))
         np.testing.assert_allclose(out.numpy(), 3.0)
+
+
+class TestForRangeConversion:
+    def test_tensor_stop_for_range(self):
+        """for i in range(n) with tensor n traces to a while_loop."""
+        @paddle.jit.to_static
+        def f(x, n):
+            s = paddle.zeros([], x.dtype)
+            for i in range(n):
+                s = s + x * i.astype(x.dtype)
+            return s
+
+        x = paddle.to_tensor(np.float32(2.0))
+        n = paddle.to_tensor(np.int32(4))
+        assert float(f(x, n)) == 2.0 * (0 + 1 + 2 + 3)
+        assert float(f(x, paddle.to_tensor(np.int32(0)))) == 0.0
+
+    def test_python_range_same_result(self):
+        @paddle.jit.to_static
+        def f(x):
+            s = x * 0
+            for i in range(1, 6, 2):
+                s = s + i
+            return s
+
+        assert float(f(paddle.to_tensor(np.float32(0.0)))) == 1 + 3 + 5
+
+    def test_negative_step_tensor_bounds(self):
+        @paddle.jit.to_static
+        def f(x, start):
+            s = paddle.zeros([], x.dtype)
+            for i in range(start, paddle.to_tensor(np.int32(0)),
+                           paddle.to_tensor(np.int32(-1))):
+                s = s + i.astype(x.dtype)
+            return s
+
+        x = paddle.to_tensor(np.float32(0.0))
+        assert float(f(x, paddle.to_tensor(np.int32(4)))) == 4 + 3 + 2 + 1
+
+    def test_for_with_break_stays_python(self):
+        """break keeps the native for (the desugared body would skip the
+        index increment on continue/break paths)."""
+        @paddle.jit.to_static
+        def f(x):
+            s = 0
+            for i in range(10):
+                if i >= 3:
+                    break
+                s = s + 1
+            return x + s
+
+        assert float(f(paddle.to_tensor(np.float32(0.0)))) == 3.0
+
+    def test_for_over_list_stays_python(self):
+        @paddle.jit.to_static
+        def f(x):
+            s = x * 0
+            for v in [1.0, 2.0, 3.0]:
+                s = s + v
+            return s
+
+        assert float(f(paddle.to_tensor(np.float32(0.0)))) == 6.0
+
+    def test_loop_var_reassignment_keeps_python_semantics(self):
+        @paddle.jit.to_static
+        def f(x):
+            s = 0
+            for i in range(3):
+                i = i + 10
+                s = s + i
+            return x + s
+
+        assert float(f(paddle.to_tensor(np.float32(0.0)))) == 10 + 11 + 12
+
+    def test_range_argument_contract(self):
+        @paddle.jit.to_static
+        def zero_step(x):
+            s = 0
+            for i in range(5, 0, 0):
+                s = s + i
+            return x + s
+
+        with pytest.raises(ValueError):
+            zero_step(paddle.to_tensor(np.float32(0.0)))
+
+        @paddle.jit.to_static
+        def float_stop(x):
+            s = 0
+            for i in range(2.5):
+                s = s + i
+            return x + s
+
+        with pytest.raises(TypeError):
+            float_stop(paddle.to_tensor(np.float32(0.0)))

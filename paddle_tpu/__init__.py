@@ -82,7 +82,7 @@ from . import quantization  # noqa: F401
 from . import signal  # noqa: F401
 from . import device  # noqa: F401
 from .device import (  # noqa: F401
-    CPUPlace, CUDAPinnedPlace, CUDAPlace, IPUPlace, MLUPlace, NPUPlace,
+    CPUPlace, CUDAPinnedPlace, CUDAPlace, CustomPlace, IPUPlace, MLUPlace, NPUPlace,
     TPUPlace, XPUPlace, get_cudnn_version, get_device, is_compiled_with_cinn,
     is_compiled_with_cuda, is_compiled_with_ipu, is_compiled_with_mlu,
     is_compiled_with_npu, is_compiled_with_rocm, is_compiled_with_xpu,

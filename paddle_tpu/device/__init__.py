@@ -8,7 +8,7 @@ from __future__ import annotations
 
 import jax
 
-__all__ = ["IPUPlace", "MLUPlace",
+__all__ = ["IPUPlace", "MLUPlace", "CustomPlace",
            "TPUPlace", "CPUPlace", "CUDAPlace", "CUDAPinnedPlace", "XPUPlace",
            "NPUPlace",
            "set_device", "get_device", "get_all_device_type",
@@ -178,6 +178,23 @@ class IPUPlace(_Place):
 class MLUPlace(TPUPlace):
     def __init__(self, dev_id=0):
         super().__init__(dev_id)
+
+
+class CustomPlace(_Place):
+    """Custom-device place (reference fluid/core CustomPlace): named
+    device type + index; computation still lands on the active backend."""
+
+    def __init__(self, dev_type="custom", dev_id=0):
+        super().__init__(dev_id)
+        self.device_type = str(dev_type)
+
+    def __repr__(self):
+        return f"CustomPlace({self.device_type}, {self.device_id})"
+
+    def __eq__(self, other):
+        return (type(self) is type(other)
+                and self.device_id == other.device_id
+                and self.device_type == other.device_type)
 
 
 def is_compiled_with_rocm():

@@ -336,14 +336,17 @@ def convert_range_args(start, stop, step):
     """Validate desugared range() arguments with Python's own contract
     (TypeError on non-integral, ValueError on step==0); tensors pass
     through for traced bounds."""
+    import operator
+
     def check(v, name):
         if _is_tensor_pred(v):
             return v
-        if isinstance(v, bool) or not isinstance(v, (int, np.integer)):
+        try:  # Python's own contract: bools and __index__ types pass
+            return operator.index(v)
+        except TypeError:
             raise TypeError(
                 f"'{type(v).__name__}' object cannot be interpreted as an "
-                f"integer (range() {name})")
-        return v
+                f"integer (range() {name})") from None
 
     start, stop, step = (check(start, "start"), check(stop, "stop"),
                          check(step, "step"))

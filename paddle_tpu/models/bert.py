@@ -145,9 +145,11 @@ class BertModel(nn.Layer):
         from .. import tensor as T
 
         if attention_mask is not None and attention_mask.ndim == 2:
-            # [b, s] 1/0 mask -> additive [b, 1, 1, s]
-            am = T.unsqueeze(attention_mask, [1, 2])
-            attention_mask = (1.0 - T.cast(am, "float32")) * -1e30
+            # [b, s] 1/0 -> boolean [b, 1, 1, s]: the attention core
+            # recognizes boolean key padding and keeps the flash path
+            # (padded batches ride the kernel, not the XLA fallback)
+            attention_mask = T.cast(
+                T.unsqueeze(attention_mask, [1, 2]), "bool")
         x = self.embeddings(input_ids, token_type_ids, position_ids)
         x = annotate(x, "dp", None, None)
         for layer in self.encoder:

@@ -29,10 +29,27 @@ def _use_flash(q_shape, head_dim, mask, dropout):
     if jax.default_backend() != "tpu":
         return False
     # pallas kernel wants seq a multiple of the 128 block and a lane-aligned
-    # head_dim (64 covers BERT/GPT heads; Mosaic tiles minor dims of 64)
+    # head_dim (64 covers BERT/GPT heads; Mosaic tiles minor dims of 64);
+    # "padding" = boolean key-padding mask, handled in-kernel
     b, h, s, d = q_shape
     return s >= 128 and s % 128 == 0 and d % 64 == 0 and mask in (
-        None, "causal")
+        None, "causal", "padding")
+
+
+def _as_key_padding(attn_mask, batch, seq_k):
+    """A boolean [B, 1, 1, S_k] (or [B, 1, S_k] / [B, S_k]) mask is pure
+    key padding — representable inside the flash kernel. Returns the
+    [B, S_k] bool Tensor or None."""
+    if attn_mask is None or attn_mask._value.dtype != jnp.bool_:
+        return None
+    shape = tuple(attn_mask.shape)
+    if shape == (batch, 1, 1, seq_k):
+        return attn_mask[:, 0, 0, :]
+    if shape == (batch, 1, seq_k):
+        return attn_mask[:, 0, :]
+    if shape == (batch, seq_k):
+        return attn_mask
+    return None
 
 
 def _xla_attention(q, k, v, mask, dropout_p, key, is_causal, training=True):
@@ -42,7 +59,7 @@ def _xla_attention(q, k, v, mask, dropout_p, key, is_causal, training=True):
         ql, kl = logits.shape[-2], logits.shape[-1]
         causal = jnp.tril(jnp.ones((ql, kl), bool), kl - ql)
         logits = jnp.where(causal, logits, jnp.asarray(-1e30, logits.dtype))
-    elif mask is not None:
+    if mask is not None:  # composes WITH causal (e.g. padded decoder keys)
         if mask.dtype == jnp.bool_:
             logits = jnp.where(mask, logits, jnp.asarray(-1e30, logits.dtype))
         else:
@@ -59,12 +76,19 @@ def _attention_core(q, k, v, attn_mask, dropout_p, need_weights=False,
                     is_causal=False, training=True):
     """q,k,v: [batch, heads, seq, head_dim] Tensors."""
     key = rnd.next_key() if dropout_p else None
-    use_flash = _use_flash(tuple(q.shape), q.shape[-1],
-                           "causal" if is_causal else
-                           (None if attn_mask is None else "mask"),
-                           dropout_p) and not need_weights
+    # cheap gates first (backend / shapes / dropout); the mask slice in
+    # _as_key_padding runs only when the kernel is otherwise eligible
+    use_flash = not need_weights and _use_flash(
+        tuple(q.shape), q.shape[-1],
+        "padding" if attn_mask is not None else
+        ("causal" if is_causal else None), dropout_p)
+    kv_pad = None
+    if use_flash and attn_mask is not None:
+        kv_pad = _as_key_padding(attn_mask, q.shape[0], k.shape[2])
+        use_flash = kv_pad is not None  # dense masks: XLA fallback
     if use_flash:
-        out = _flash_attention_fn(q, k, v, is_causal)
+        # causal and key padding compose inside the kernel
+        out = _flash_attention_fn(q, k, v, is_causal, kv_pad)
         return out, None
 
     def _f(qv, kv, vv, mv):

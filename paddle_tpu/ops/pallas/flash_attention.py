@@ -34,8 +34,12 @@ def _interpret():
     return jax.default_backend() != "tpu"
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_q, block_k,
-                seq_k, causal, sm_scale):
+def _fwd_kernel(q_ref, k_ref, v_ref, *rest, block_q, block_k,
+                seq_k, causal, sm_scale, masked=False):
+    if masked:
+        kvm_ref, o_ref, lse_ref = rest
+    else:
+        kvm_ref, (o_ref, lse_ref) = None, rest
     q = q_ref[0].astype(jnp.float32) * sm_scale          # (bq, d)
     qi = pl.program_id(1)
     m0 = jnp.full((block_q, 1), NEG_INF, jnp.float32)
@@ -60,6 +64,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_q, block_k,
             cols = j * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
             s = jnp.where(rows >= cols, s, NEG_INF)
+        if masked:
+            mblk = kvm_ref[0, 0, pl.ds(j * block_k, block_k)]
+            s = jnp.where(mblk[None, :] > 0, s, NEG_INF)
         m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))
         p = jnp.exp(s - m_new)
         alpha = jnp.exp(m - m_new)
@@ -75,8 +82,12 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_q, block_k,
 
 
 def _bwd_kv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                   dk_ref, dv_ref, *, block_q, block_k, seq_q, causal,
-                   sm_scale):
+                   *rest, block_q, block_k, seq_q, causal,
+                   sm_scale, masked=False):
+    if masked:
+        kvm_ref, dk_ref, dv_ref = rest
+    else:
+        kvm_ref, (dk_ref, dv_ref) = None, rest
     k = k_ref[0].astype(jnp.float32)                      # (bk, d)
     v = v_ref[0].astype(jnp.float32)
     ki = pl.program_id(1)
@@ -100,6 +111,9 @@ def _bwd_kv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             cols = ki * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
             s = jnp.where(rows >= cols, s, NEG_INF)
+        if masked:
+            mblk = kvm_ref[0, 0, pl.ds(ki * block_k, block_k)]
+            s = jnp.where(mblk[None, :] > 0, s, NEG_INF)
         p = jnp.exp(s - lse)                               # (bq, bk)
         dv = dv + jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
                                       preferred_element_type=jnp.float32)
@@ -115,8 +129,12 @@ def _bwd_kv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     dv_ref[0] = dv.astype(dv_ref.dtype)
 
 
-def _bwd_q_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
-                  block_q, block_k, seq_k, causal, sm_scale):
+def _bwd_q_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
+                  block_q, block_k, seq_k, causal, sm_scale, masked=False):
+    if masked:
+        kvm_ref, dq_ref = rest
+    else:
+        kvm_ref, (dq_ref,) = None, rest
     q = q_ref[0].astype(jnp.float32)
     do = do_ref[0].astype(jnp.float32)
     qi = pl.program_id(1)
@@ -140,6 +158,9 @@ def _bwd_q_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
             cols = j * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
             s = jnp.where(rows >= cols, s, NEG_INF)
+        if masked:
+            mblk = kvm_ref[0, 0, pl.ds(j * block_k, block_k)]
+            s = jnp.where(mblk[None, :] > 0, s, NEG_INF)
         p = jnp.exp(s - lse)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
@@ -151,19 +172,31 @@ def _bwd_q_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
     dq_ref[0] = dq.astype(dq_ref.dtype)
 
 
-def _fwd(q, k, v, causal, sm_scale, block_q, block_k):
+def _mask3(kv_mask):
+    """[bh, seq_k] 0/1 mask -> [bh, 1, seq_k] f32 for a lane-aligned ref."""
+    return kv_mask.astype(jnp.float32)[:, None, :]
+
+
+def _fwd(q, k, v, kv_mask, causal, sm_scale, block_q, block_k):
     bh, seq_q, d = q.shape
     _, seq_k, _ = k.shape
+    masked = kv_mask is not None
+    in_specs = [
+        pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+        pl.BlockSpec((1, seq_k, d), lambda b, i: (b, 0, 0)),
+        pl.BlockSpec((1, seq_k, d), lambda b, i: (b, 0, 0)),
+    ]
+    args = [q, k, v]
+    if masked:
+        in_specs.append(pl.BlockSpec((1, 1, seq_k), lambda b, i: (b, 0, 0)))
+        args.append(_mask3(kv_mask))
     with jax.enable_x64(False):
         o, lse = pl.pallas_call(
             functools.partial(_fwd_kernel, block_q=block_q, block_k=block_k,
-                              seq_k=seq_k, causal=causal, sm_scale=sm_scale),
+                              seq_k=seq_k, causal=causal, sm_scale=sm_scale,
+                              masked=masked),
             grid=(bh, seq_q // block_q),
-            in_specs=[
-                pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-                pl.BlockSpec((1, seq_k, d), lambda b, i: (b, 0, 0)),
-                pl.BlockSpec((1, seq_k, d), lambda b, i: (b, 0, 0)),
-            ],
+            in_specs=in_specs,
             out_specs=[
                 pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
                 pl.BlockSpec((1, 1, seq_q), lambda b, i: (b, 0, 0)),
@@ -173,29 +206,36 @@ def _fwd(q, k, v, causal, sm_scale, block_q, block_k):
                 jax.ShapeDtypeStruct((bh, 1, seq_q), jnp.float32),
             ],
             interpret=_interpret(),
-        )(q, k, v)
+        )(*args)
     return o, lse
 
 
-def _bwd(q, k, v, o, lse, do, causal, sm_scale, block_q, block_k):
+def _bwd(q, k, v, o, lse, do, kv_mask, causal, sm_scale, block_q, block_k):
     bh, seq_q, d = q.shape
     _, seq_k, _ = k.shape
+    masked = kv_mask is not None
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
                     axis=-1)[:, None, :]
+    base_specs = [
+        pl.BlockSpec((1, seq_q, d), lambda b, i: (b, 0, 0)),
+        pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0)),
+        pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0)),
+        pl.BlockSpec((1, seq_q, d), lambda b, i: (b, 0, 0)),
+        pl.BlockSpec((1, 1, seq_q), lambda b, i: (b, 0, 0)),
+        pl.BlockSpec((1, 1, seq_q), lambda b, i: (b, 0, 0)),
+    ]
+    kv_args = [q, k, v, do, lse, delta]
+    mask_spec = pl.BlockSpec((1, 1, seq_k), lambda b, i: (b, 0, 0))
+    if masked:
+        base_specs = base_specs + [mask_spec]
+        kv_args = kv_args + [_mask3(kv_mask)]
     with jax.enable_x64(False):
         dk, dv = pl.pallas_call(
             functools.partial(_bwd_kv_kernel, block_q=block_q,
                               block_k=block_k, seq_q=seq_q, causal=causal,
-                              sm_scale=sm_scale),
+                              sm_scale=sm_scale, masked=masked),
             grid=(bh, seq_k // block_k),
-            in_specs=[
-                pl.BlockSpec((1, seq_q, d), lambda b, i: (b, 0, 0)),
-                pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0)),
-                pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0)),
-                pl.BlockSpec((1, seq_q, d), lambda b, i: (b, 0, 0)),
-                pl.BlockSpec((1, 1, seq_q), lambda b, i: (b, 0, 0)),
-                pl.BlockSpec((1, 1, seq_q), lambda b, i: (b, 0, 0)),
-            ],
+            in_specs=base_specs,
             out_specs=[
                 pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0)),
                 pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0)),
@@ -205,67 +245,81 @@ def _bwd(q, k, v, o, lse, do, causal, sm_scale, block_q, block_k):
                 jax.ShapeDtypeStruct(v.shape, v.dtype),
             ],
             interpret=_interpret(),
-        )(q, k, v, do, lse, delta)
+        )(*kv_args)
+        q_specs = [
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, seq_k, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, seq_k, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, 1, seq_q), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, 1, seq_q), lambda b, i: (b, 0, 0)),
+        ]
+        if masked:
+            q_specs = q_specs + [mask_spec]
         dq = pl.pallas_call(
             functools.partial(_bwd_q_kernel, block_q=block_q,
                               block_k=block_k, seq_k=seq_k, causal=causal,
-                              sm_scale=sm_scale),
+                              sm_scale=sm_scale, masked=masked),
             grid=(bh, seq_q // block_q),
-            in_specs=[
-                pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-                pl.BlockSpec((1, seq_k, d), lambda b, i: (b, 0, 0)),
-                pl.BlockSpec((1, seq_k, d), lambda b, i: (b, 0, 0)),
-                pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-                pl.BlockSpec((1, 1, seq_q), lambda b, i: (b, 0, 0)),
-                pl.BlockSpec((1, 1, seq_q), lambda b, i: (b, 0, 0)),
-            ],
+            in_specs=q_specs,
             out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
             out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
             interpret=_interpret(),
-        )(q, k, v, do, lse, delta)
+        )(*kv_args)
     return dq, dk, dv
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def flash_attention_raw(q, k, v, causal=False, sm_scale=None,
-                        block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K):
-    """q,k,v: [batch*heads, seq, head_dim] arrays."""
-    if sm_scale is None:
-        sm_scale = 1.0 / math.sqrt(q.shape[-1])
-    o, _ = _fwd(q, k, v, causal, sm_scale, block_q, block_k)
+def _flash_core(q, k, v, causal, sm_scale, block_q, block_k, kv_mask):
+    """q,k,v: [batch*heads, seq, head_dim]; kv_mask: None or [batch*heads,
+    seq_k] 0/1 (1 = attend). kv_mask is a differentiable-position arg
+    (arrays cannot be nondiff in custom_vjp); its cotangent is None."""
+    o, _ = _fwd(q, k, v, kv_mask, causal, sm_scale, block_q, block_k)
     return o
 
 
-def _raw_fwd(q, k, v, causal, sm_scale, block_q, block_k):
-    if sm_scale is None:
-        sm_scale = 1.0 / math.sqrt(q.shape[-1])
-    o, lse = _fwd(q, k, v, causal, sm_scale, block_q, block_k)
-    return o, (q, k, v, o, lse)
+def _raw_fwd(q, k, v, causal, sm_scale, block_q, block_k, kv_mask):
+    o, lse = _fwd(q, k, v, kv_mask, causal, sm_scale, block_q, block_k)
+    return o, (q, k, v, o, lse, kv_mask)
 
 
 def _raw_bwd(causal, sm_scale, block_q, block_k, res, do):
-    q, k, v, o, lse = res
+    q, k, v, o, lse, kv_mask = res
+    dq, dk, dv = _bwd(q, k, v, o, lse, do, kv_mask, causal, sm_scale,
+                      block_q, block_k)
+    return dq, dk, dv, None
+
+
+_flash_core.defvjp(_raw_fwd, _raw_bwd)
+
+
+def flash_attention_raw(q, k, v, causal=False, sm_scale=None,
+                        block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K,
+                        kv_mask=None):
+    """q,k,v: [batch*heads, seq, head_dim] arrays."""
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(q.shape[-1])
-    dq, dk, dv = _bwd(q, k, v, o, lse, do, causal, sm_scale, block_q, block_k)
-    return dq, dk, dv
+    return _flash_core(q, k, v, causal, sm_scale, block_q, block_k, kv_mask)
 
 
-flash_attention_raw.defvjp(_raw_fwd, _raw_bwd)
-
-
-def flash_attention(q, k, v, causal=False, sm_scale=None):
-    """Paddle-facing entry: q,k,v Tensors [batch, heads, seq, head_dim]."""
+def flash_attention(q, k, v, causal=False, sm_scale=None, kv_mask=None):
+    """Paddle-facing entry: q,k,v Tensors [batch, heads, seq, head_dim];
+    kv_mask an optional [batch, seq_k] 0/1 Tensor (key padding)."""
     from ...core.autograd import apply
 
-    def _f(qv, kv, vv):
+    def _f(qv, kv, vv, *rest):
         b, h, s, d = qv.shape
         sk = kv.shape[2]
+        km = None
+        if rest:
+            km = jnp.repeat(rest[0].astype(jnp.float32), h, axis=0)
         out = flash_attention_raw(
             qv.reshape(b * h, s, d), kv.reshape(b * h, sk, d),
-            vv.reshape(b * h, sk, d), causal, sm_scale)
+            vv.reshape(b * h, sk, d), causal, sm_scale, kv_mask=km)
         return out.reshape(b, h, s, d)
     _f.__name__ = "flash_attention"
+    if kv_mask is not None:
+        return apply(_f, q, k, v, kv_mask)
     return apply(_f, q, k, v)
 
 
@@ -273,8 +327,8 @@ def _register():
     """Install as the attention fast path (nn/functional/attention.py)."""
     from ...nn.functional import attention as A
 
-    def dispatch(q, k, v, is_causal):
-        return flash_attention(q, k, v, causal=is_causal)
+    def dispatch(q, k, v, is_causal, kv_mask=None):
+        return flash_attention(q, k, v, causal=is_causal, kv_mask=kv_mask)
 
     A._flash_attention_fn = dispatch
 

@@ -1,0 +1,94 @@
+"""Child process for the two-process multi-host test.
+
+Usage: python _multihost_child.py <process_id> <coordinator_port>
+
+Each process owns 2 virtual CPU devices; jax.distributed rendezvous
+makes a 4-device global world. The child drives the framework's own
+multi-host surface: init_distributed_env -> world_mesh -> a jitted
+data-parallel step whose gradient sync crosses the process boundary.
+"""
+import os
+import sys
+
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import functools
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from paddle_tpu.distributed import env
+
+
+def main():
+    pid = int(sys.argv[1])
+    port = sys.argv[2]
+    env.init_distributed_env(f"127.0.0.1:{port}", 2, pid)
+    assert jax.process_count() == 2, jax.process_count()
+    assert jax.device_count() == 4, jax.device_count()
+    assert env.rank() == pid
+
+    mesh = env.world_mesh("dp")
+    env.set_mesh(mesh)
+
+    # global batch sharded over all 4 devices (2 per process): each
+    # process supplies ITS addressable shards; grad sync = psum over dp
+    # crossing the process boundary
+    n, dim = 8, 4
+    full_x = np.arange(n * dim, dtype=np.float32).reshape(n, dim) / 10.0
+    full_y = np.linspace(0.0, 1.0, n, dtype=np.float32)
+    sharding = NamedSharding(mesh, P("dp", None))
+    x = jax.make_array_from_callback(
+        (n, dim), sharding, lambda idx: full_x[idx])
+    y = jax.make_array_from_callback(
+        (n,), NamedSharding(mesh, P("dp")), lambda idx: full_y[idx])
+    w = jnp.zeros((dim,), jnp.float32)  # replicated params
+
+    @functools.partial(jax.jit,
+                       out_shardings=NamedSharding(mesh, P(None)))
+    def step(w, x, y):
+        def loss(w):
+            return jnp.mean((x @ w - y) ** 2)
+        g = jax.grad(loss)(w)
+        return w - 0.1 * g
+
+    for _ in range(10):
+        w = step(w, x, y)
+    # every process must hold identical, globally-synced params
+    w_local = np.asarray(jax.device_get(w))
+
+    # reference: single-process full-batch gradient descent
+    w_ref = np.zeros((dim,), np.float32)
+    for _ in range(10):
+        g = 2.0 / n * full_x.T @ (full_x @ w_ref - full_y)
+        w_ref = w_ref - 0.1 * g
+    np.testing.assert_allclose(w_local, w_ref, rtol=1e-5, atol=1e-6)
+
+    # explicit collective over the process boundary: psum of rank+1
+    from jax import shard_map
+
+    @functools.partial(shard_map, mesh=mesh, in_specs=P("dp"),
+                       out_specs=P())  # replicated: fetchable everywhere
+    def total(v):
+        return jax.lax.psum(jnp.sum(v), "dp")
+
+    contrib = jax.make_array_from_callback(
+        (4,), NamedSharding(mesh, P("dp")),
+        lambda idx: np.arange(4, dtype=np.float32)[idx] + 1.0)
+    tot = float(jax.device_get(total(contrib)))
+    assert tot == 10.0, tot
+
+    print(f"MULTIHOST_OK pid={pid} procs={jax.process_count()} "
+          f"devices={jax.device_count()}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

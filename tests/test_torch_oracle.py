@@ -928,3 +928,63 @@ def test_affine_grid_channel_shuffle_unpool_vs_torch():
     got = F.max_unpool2d(p_out, p_idx, 2, stride=2)
     want = torch.nn.functional.max_unpool2d(t_out, t_idx, 2, stride=2)
     np.testing.assert_allclose(np.asarray(got.numpy()), want.numpy())
+
+
+def test_distribution_transforms_vs_torch():
+    """Transform forward/inverse/log_det_jacobian + TransformedDistribution
+    log_prob vs torch.distributions."""
+    import paddle_tpu.distribution as D
+    import torch.distributions as TD
+    import torch.distributions.transforms as TT
+
+    rng = np.random.RandomState(19)
+    x = rng.randn(6).astype(np.float32)
+    u = (rng.rand(6).astype(np.float32) * 0.9 + 0.05)
+
+    pairs = [
+        (D.ExpTransform(), TT.ExpTransform(), x),
+        (D.AffineTransform(_t(np.float32(1.5)), _t(np.float32(0.7))),
+         TT.AffineTransform(1.5, 0.7), x),
+        (D.SigmoidTransform(), TT.SigmoidTransform(), x),
+        (D.TanhTransform(), TT.TanhTransform(), x * 0.5),
+        (D.PowerTransform(_t(np.float32(2.0))), TT.PowerTransform(2.0),
+         np.abs(x) + 0.1),
+    ]
+    for ours, theirs, inp in pairs:
+        name = type(ours).__name__
+        ti = torch.from_numpy(inp)
+        fwd = np.asarray(ours.forward(_t(inp)).numpy())
+        np.testing.assert_allclose(fwd, theirs(ti).numpy(), rtol=1e-4,
+                                   atol=1e-5, err_msg=name)
+        inv_in = fwd
+        got_inv = np.asarray(ours.inverse(_t(inv_in)).numpy())
+        np.testing.assert_allclose(got_inv, theirs.inv(
+            torch.from_numpy(inv_in)).numpy(), rtol=1e-4, atol=1e-4,
+            err_msg=name)
+        got_ldj = np.asarray(
+            ours.forward_log_det_jacobian(_t(inp)).numpy())
+        want_ldj = theirs.log_abs_det_jacobian(
+            ti, theirs(ti)).numpy()
+        np.testing.assert_allclose(got_ldj, want_ldj, rtol=1e-4, atol=1e-5,
+                                   err_msg=name + " ldj")
+
+    # log-normal via TransformedDistribution(Normal, Exp)
+    base = D.Normal(_t(np.float32(0.3)), _t(np.float32(1.2)))
+    tbase = TD.Normal(0.3, 1.2)
+    ours_td = D.TransformedDistribution(base, [D.ExpTransform()])
+    theirs_td = TD.TransformedDistribution(tbase, [TT.ExpTransform()])
+    v = np.abs(rng.randn(5)).astype(np.float32) + 0.2
+    np.testing.assert_allclose(
+        np.asarray(ours_td.log_prob(_t(v)).numpy()),
+        theirs_td.log_prob(torch.from_numpy(v)).numpy(),
+        rtol=1e-4, atol=1e-5)
+
+    # stick-breaking: forward maps R^k -> simplex (k+1), round-trips
+    sb = D.StickBreakingTransform()
+    tsb = TT.StickBreakingTransform()
+    z = rng.randn(4).astype(np.float32)
+    got = np.asarray(sb.forward(_t(z)).numpy())
+    want = tsb(torch.from_numpy(z)).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+    back = np.asarray(sb.inverse(_t(got)).numpy())
+    np.testing.assert_allclose(back, z, rtol=1e-3, atol=1e-4)

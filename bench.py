@@ -433,6 +433,49 @@ def bench_blockwise_ce(n=4096, hidden=768, vocab=50304, iters=20):
     return res
 
 
+def bench_int8(m=4096, k=4096, n=4096, iters=30):
+    """int8 MXU vs bf16 matmul throughput (v5e: 394 int8 TOPS vs 197
+    bf16 TFLOPS) — the execution lever behind paddle.quantization's
+    int8 layers (quantization/layers.py int8 dot_general)."""
+    import jax
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(0)
+    a8 = jnp.asarray(rng.randint(-127, 127, (m, k), dtype=np.int8))
+    b8 = jnp.asarray(rng.randint(-127, 127, (k, n), dtype=np.int8))
+    abf = jnp.asarray(rng.randn(m, k).astype(np.float32), jnp.bfloat16)
+    bbf = jnp.asarray(rng.randn(k, n).astype(np.float32), jnp.bfloat16)
+
+    @jax.jit
+    def mm_int8(a, b):
+        return jax.lax.dot_general(
+            a, b, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32)
+
+    @jax.jit
+    def mm_bf16(a, b):
+        return jax.lax.dot_general(
+            a, b, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    res = {}
+    flops = 2.0 * m * k * n
+    for name, fn, x, y in [("int8", mm_int8, a8, b8),
+                           ("bf16", mm_bf16, abf, bbf)]:
+        try:
+            _sync(fn(x, y))
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                out = fn(x, y)
+            _sync(out)
+            dt = (time.perf_counter() - t0) / iters
+            res[f"matmul_{name}_tops"] = flops / dt / 1e12
+        except Exception as e:  # noqa: BLE001 — one arm must not kill the A/B
+            res[f"matmul_{name}_tops"] = None
+            res[f"matmul_{name}_error"] = str(e)[:200]
+    return res
+
+
 def bench_dataloader(n=512, batch=64, shape=(3, 224, 224), epochs=3):
     """Input pipeline A/B: thread-prefetch DataLoader vs the C++ staging
     ring (csrc/staging_pool.cpp) — imgs/sec of collate+transfer."""
@@ -482,6 +525,7 @@ CONFIGS = {
                         600),
     "blockwise_ce": (bench_blockwise_ce,
                      {"n": 64, "hidden": 32, "vocab": 512, "iters": 2}, 480),
+    "int8": (bench_int8, {"m": 256, "k": 256, "n": 256, "iters": 3}, 300),
     "dataloader": (bench_dataloader, {"n": 32, "batch": 8, "epochs": 1}, 420),
     "resnet50": (bench_resnet50, {"batch": 2, "steps": 2, "warmup": 1}, 900),
     "gpt": (bench_gpt, {"batch": 1, "seq": 32, "steps": 1, "warmup": 1},
@@ -650,7 +694,7 @@ def _publish_baseline(details, cfg_name, ref_key, value):
             pub = {k: round(v, 2) for k, v in details.items()
                    if isinstance(v, float) and (
                        k.endswith("_per_sec") or k.endswith("_ms")
-                       or k.endswith("_mfu"))}
+                       or k.endswith("_mfu") or k.endswith("_tops"))}
             pub["device_kind"] = details.get("device_kind")
             baseline_doc["published"] = pub
             with open(baseline_path, "w") as f:

@@ -1,0 +1,97 @@
+"""Keyword-signature parity vs the reference source.
+
+Namespace parity says a NAME exists; this goes one level deeper: for a
+spread of everyday APIs, every parameter name a reference-era script
+could pass BY KEYWORD must be accepted by our implementation (either a
+real parameter or **kwargs). Parameter names are parsed from the
+reference's def statements with ast — no reference import needed.
+"""
+import ast
+import inspect
+import os
+
+import pytest
+
+import paddle_tpu as paddle
+
+REF = "/root/reference/python/paddle"
+
+# (reference file, def name, our callable)
+CASES = [
+    ("tensor/math.py", "add", paddle.add),
+    ("tensor/math.py", "multiply", paddle.multiply),
+    ("tensor/math.py", "sum", paddle.sum),
+    ("tensor/math.py", "cumsum", paddle.cumsum),
+    ("tensor/math.py", "clip", paddle.clip),
+    ("tensor/search.py", "argsort", paddle.argsort),
+    ("tensor/search.py", "topk", paddle.topk),
+    ("tensor/search.py", "nonzero", paddle.nonzero),
+    ("tensor/manipulation.py", "concat", paddle.concat),
+    ("tensor/manipulation.py", "split", paddle.split),
+    ("tensor/manipulation.py", "squeeze", paddle.squeeze),
+    ("tensor/manipulation.py", "gather", paddle.gather),
+    ("tensor/manipulation.py", "scatter", paddle.scatter),
+    ("tensor/creation.py", "arange", paddle.arange),
+    ("tensor/creation.py", "full", paddle.full),
+    ("tensor/creation.py", "linspace", paddle.linspace),
+    ("tensor/linalg.py", "matmul", paddle.matmul),
+    ("tensor/linalg.py", "norm", paddle.norm),
+    ("tensor/random.py", "uniform", paddle.uniform),
+    ("tensor/random.py", "randint", paddle.randint),
+    ("nn/functional/loss.py", "cross_entropy",
+     paddle.nn.functional.cross_entropy),
+    ("nn/functional/loss.py", "mse_loss", paddle.nn.functional.mse_loss),
+    ("nn/functional/common.py", "dropout", paddle.nn.functional.dropout),
+    ("nn/functional/common.py", "linear", paddle.nn.functional.linear),
+    ("nn/functional/common.py", "interpolate",
+     paddle.nn.functional.interpolate),
+    ("nn/functional/conv.py", "conv2d", paddle.nn.functional.conv2d),
+    ("nn/functional/pooling.py", "max_pool2d",
+     paddle.nn.functional.max_pool2d),
+    ("nn/functional/pooling.py", "avg_pool2d",
+     paddle.nn.functional.avg_pool2d),
+    ("nn/functional/norm.py", "layer_norm", paddle.nn.functional.layer_norm),
+    ("nn/functional/norm.py", "batch_norm", paddle.nn.functional.batch_norm),
+    ("nn/functional/activation.py", "softmax",
+     paddle.nn.functional.softmax),
+    ("nn/functional/input.py", "embedding", paddle.nn.functional.embedding),
+    ("tensor/stat.py", "mean", paddle.mean),
+    ("tensor/stat.py", "std", paddle.std),
+    ("tensor/stat.py", "quantile", paddle.quantile),
+    ("tensor/logic.py", "equal", paddle.equal),
+    ("tensor/logic.py", "allclose", paddle.allclose),
+    ("fft.py", "fft", paddle.fft.fft),
+    ("tensor/einsum.py", "einsum", paddle.einsum),
+]
+
+
+def _ref_params(path, fn_name):
+    """Parameter names of the last `def fn_name` in the reference file."""
+    with open(os.path.join(REF, path)) as f:
+        tree = ast.parse(f.read())
+    found = None
+    for node in ast.walk(tree):
+        if isinstance(node, ast.FunctionDef) and node.name == fn_name:
+            found = node
+    if found is None:
+        return None
+    a = found.args
+    names = ([p.arg for p in a.posonlyargs] + [p.arg for p in a.args]
+             + [p.arg for p in a.kwonlyargs])
+    return [n for n in names if n != "name"]  # name= is universally dropped
+
+
+@pytest.mark.parametrize("path,fn_name,ours", CASES,
+                         ids=[c[1] for c in CASES])
+def test_keywords_accepted(path, fn_name, ours):
+    ref_names = _ref_params(path, fn_name)
+    if ref_names is None:
+        pytest.skip(f"{fn_name} not found in reference {path}")
+    sig = inspect.signature(ours)
+    has_var_kw = any(p.kind is inspect.Parameter.VAR_KEYWORD
+                     for p in sig.parameters.values())
+    ours_names = set(sig.parameters)
+    missing = [n for n in ref_names if n not in ours_names]
+    assert has_var_kw or not missing, (
+        f"{fn_name}: reference keywords {missing} not accepted "
+        f"(ours: {sorted(ours_names)})")

@@ -28,12 +28,12 @@ def _use_flash(q_shape, head_dim, mask, dropout):
         return False
     if jax.default_backend() != "tpu":
         return False
-    # pallas kernel wants seq a multiple of the 128 block and a lane-aligned
-    # head_dim (64 covers BERT/GPT heads; Mosaic tiles minor dims of 64);
+    # ragged seq pads to the 128 block (masked tail keys), ragged head_dim
+    # zero-pads to the 64 lane multiple (exact); below 128 queries the
+    # XLA path wins, above 256 head-dim the pad overhead stops paying.
     # "padding" = boolean key-padding mask, handled in-kernel
     b, h, s, d = q_shape
-    return s >= 128 and s % 128 == 0 and d % 64 == 0 and mask in (
-        None, "causal", "padding")
+    return s >= 128 and d <= 256 and mask in (None, "causal", "padding")
 
 
 def _as_key_padding(attn_mask, batch, seq_k):
@@ -77,8 +77,10 @@ def _attention_core(q, k, v, attn_mask, dropout_p, need_weights=False,
     """q,k,v: [batch, heads, seq, head_dim] Tensors."""
     key = rnd.next_key() if dropout_p else None
     # cheap gates first (backend / shapes / dropout); the mask slice in
-    # _as_key_padding runs only when the kernel is otherwise eligible
-    use_flash = not need_weights and _use_flash(
+    # _as_key_padding runs only when the kernel is otherwise eligible.
+    # causal flash assumes the aligned diagonal: self-attention only
+    use_flash = not need_weights and (
+        not is_causal or q.shape[2] == k.shape[2]) and _use_flash(
         tuple(q.shape), q.shape[-1],
         "padding" if attn_mask is not None else
         ("causal" if is_causal else None), dropout_p)

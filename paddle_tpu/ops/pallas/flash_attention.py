@@ -304,19 +304,44 @@ def flash_attention_raw(q, k, v, causal=False, sm_scale=None,
 
 def flash_attention(q, k, v, causal=False, sm_scale=None, kv_mask=None):
     """Paddle-facing entry: q,k,v Tensors [batch, heads, seq, head_dim];
-    kv_mask an optional [batch, seq_k] 0/1 Tensor (key padding)."""
+    kv_mask an optional [batch, seq_k] 0/1 Tensor (key padding).
+
+    Ragged shapes are handled by padding: head_dim pads to the 64 lane
+    multiple (EXACT — zero q/k tail dims add nothing to q.k, zero v tail
+    columns are sliced off; sm_scale still uses the true head_dim) and
+    seq pads to the 128 block multiple with the padded keys masked via
+    kv_mask (padded query rows compute garbage and are sliced off; their
+    cotangents are zero through the pad/slice AD)."""
     from ...core.autograd import apply
 
     def _f(qv, kv, vv, *rest):
         b, h, s, d = qv.shape
         sk = kv.shape[2]
-        km = None
-        if rest:
-            km = jnp.repeat(rest[0].astype(jnp.float32), h, axis=0)
+        if causal and s != sk:
+            # the kernel's diagonal is top-left aligned; cross-length
+            # causal needs the bottom-right convention (tril offset
+            # kl-ql) — refuse loudly rather than mis-mask
+            raise ValueError(
+                f"causal flash attention requires seq_q == seq_k "
+                f"(got {s} vs {sk}); use the XLA attention path")
+        scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(d)
+        km = rest[0].astype(jnp.float32) if rest else None      # [b, sk]
+        d_pad, sq_pad, sk_pad = (-d) % 64, (-s) % 128, (-sk) % 128
+        if d_pad or sq_pad or sk_pad:
+            qv = jnp.pad(qv, ((0, 0), (0, 0), (0, sq_pad), (0, d_pad)))
+            kv = jnp.pad(kv, ((0, 0), (0, 0), (0, sk_pad), (0, d_pad)))
+            vv = jnp.pad(vv, ((0, 0), (0, 0), (0, sk_pad), (0, d_pad)))
+            if sk_pad:
+                if km is None:
+                    km = jnp.ones((b, sk), jnp.float32)
+                km = jnp.pad(km, ((0, 0), (0, sk_pad)))  # zeros = masked
+        sq, skp, dp = s + sq_pad, sk + sk_pad, d + d_pad
+        if km is not None:
+            km = jnp.repeat(km, h, axis=0)
         out = flash_attention_raw(
-            qv.reshape(b * h, s, d), kv.reshape(b * h, sk, d),
-            vv.reshape(b * h, sk, d), causal, sm_scale, kv_mask=km)
-        return out.reshape(b, h, s, d)
+            qv.reshape(b * h, sq, dp), kv.reshape(b * h, skp, dp),
+            vv.reshape(b * h, skp, dp), causal, scale, kv_mask=km)
+        return out.reshape(b, h, sq, dp)[:, :, :s, :d]
     _f.__name__ = "flash_attention"
     if kv_mask is not None:
         return apply(_f, q, k, v, kv_mask)

@@ -68,8 +68,9 @@ def test_dispatch_covers_flagship_heads(monkeypatch):
     assert _use_flash((2, 12, 128, 64), 64, "causal", 0.0)   # GPT-2 block
     assert _use_flash((2, 12, 512, 64), 64, None, 0.0)       # BERT-base
     assert _use_flash((2, 16, 1024, 128), 128, "causal", 0.0)
-    assert not _use_flash((2, 12, 100, 64), 64, None, 0.0)   # ragged seq
-    assert not _use_flash((2, 12, 128, 80), 80, None, 0.0)   # odd head_dim
+    assert _use_flash((2, 12, 200, 80), 80, None, 0.0)       # ragged: pads
+    assert not _use_flash((2, 12, 100, 64), 64, None, 0.0)   # short: XLA
+    assert not _use_flash((2, 12, 128, 288), 288, None, 0.0)  # huge head_dim
     assert not _use_flash((2, 12, 128, 64), 64, "mask", 0.0)  # dense mask
     assert not _use_flash((2, 12, 128, 64), 64, None, 0.1)   # dropout
 
@@ -181,3 +182,73 @@ def test_causal_composes_with_padding_mask():
         np.testing.assert_allclose(np.asarray(out[bh, :n]),
                                    np.asarray(ref[bh, 0, :n]),
                                    rtol=1e-5, atol=2e-5)
+
+
+def test_ragged_seq_and_head_dim_pad_to_kernel():
+    """seq not a 128-multiple and head_dim not a 64-multiple route
+    through the padded kernel path and still match the XLA oracle
+    (fwd + grads), causal and not."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.nn.functional.attention import _xla_attention
+    from paddle_tpu.ops.pallas import flash_attention as FA
+
+    rng = np.random.RandomState(7)
+    B, H, S, D = 2, 2, 200, 80  # 200 -> pad 256, 80 -> pad 128
+    q, k, v = (jnp.asarray(rng.randn(B, H, S, D).astype(np.float32))
+               for _ in range(3))
+
+    from paddle_tpu.core.tensor import Tensor
+
+    for causal in (False, True):
+        def loss_flash(q_, k_, v_):
+            import paddle_tpu as paddle
+            with paddle.no_grad():
+                out = FA.flash_attention(Tensor(q_), Tensor(k_), Tensor(v_),
+                                         causal=causal)
+            return (out._value ** 2).mean()
+
+        def loss_ref(q_, k_, v_):
+            o, _ = _xla_attention(q_, k_, v_, None, 0.0, None, causal)
+            return (o ** 2).mean()
+
+        import paddle_tpu as paddle
+        with paddle.no_grad():
+            got = FA.flash_attention(Tensor(q), Tensor(k), Tensor(v),
+                                     causal=causal)._value
+        want, _ = _xla_attention(q, k, v, None, 0.0, None, causal)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-4, atol=2e-5,
+                                   err_msg=f"causal={causal}")
+        gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gf, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=2e-5,
+                                       err_msg=f"causal={causal}")
+
+
+def test_ragged_with_user_padding_mask():
+    """User key-padding combines with the internal ragged-tail padding."""
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+    from paddle_tpu.core.tensor import Tensor
+    from paddle_tpu.nn.functional.attention import _xla_attention
+    from paddle_tpu.ops.pallas import flash_attention as FA
+
+    rng = np.random.RandomState(9)
+    B, H, S, D = 2, 2, 150, 64
+    q, k, v = (jnp.asarray(rng.randn(B, H, S, D).astype(np.float32))
+               for _ in range(3))
+    valid = np.ones((B, S), np.float32)
+    valid[0, 120:] = 0
+    valid[1, 77:] = 0
+    with paddle.no_grad():
+        got = FA.flash_attention(Tensor(q), Tensor(k), Tensor(v),
+                                 kv_mask=Tensor(jnp.asarray(valid)))._value
+    mask4 = jnp.asarray(valid, bool)[:, None, None, :]
+    want, _ = _xla_attention(q, k, v, mask4, 0.0, None, False)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-4, atol=2e-5)

@@ -70,7 +70,7 @@ def _ref_params(path, fn_name):
     with open(os.path.join(REF, path)) as f:
         tree = ast.parse(f.read())
     found = None
-    for node in ast.walk(tree):
+    for node in tree.body:  # module level only, source order (last wins)
         if isinstance(node, ast.FunctionDef) and node.name == fn_name:
             found = node
     if found is None:

@@ -83,6 +83,13 @@ def _register_methods(cls=Tensor):
     cls.__ge__ = lambda s, o: logic.greater_equal(s, _coerce(o))
     cls.__hash__ = lambda s: id(s)
 
+    # the reference blanket-attaches every tensor_method_func name, even
+    # ones whose first parameter is not a tensor (broadcast_shape,
+    # scatter_nd); attach the raw functions for exact method-list parity
+    cls.is_tensor = logic.is_tensor
+    cls.broadcast_shape = math.broadcast_shape
+    cls.scatter_nd = manipulation.scatter_nd
+
     # a few paddle method spellings
     cls.mean = stat.mean
     cls.var = stat.var

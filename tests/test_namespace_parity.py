@@ -129,3 +129,22 @@ def test_round3_namespace_exports():
     for n in ("Imdb", "Imikolov", "Movielens", "UCIHousing", "Conll05st",
               "WMT14", "WMT16", "ViterbiDecoder", "viterbi_decode"):
         assert hasattr(text, n), n
+
+
+def test_tensor_method_list_parity():
+    """Every name in the reference's tensor_method_func monkey-patch list
+    must exist on our Tensor (the reference attaches all of them,
+    including a few whose first parameter is not a tensor)."""
+    import re
+
+    import numpy as np
+
+    import paddle_tpu as paddle
+
+    src = open("/root/reference/python/paddle/tensor/__init__.py").read()
+    assert "tensor_method_func" in src
+    names = re.findall(r"'(\w+)',", src.split("tensor_method_func")[1])
+    assert len(names) > 200, len(names)
+    t = paddle.to_tensor(np.ones((2, 2), np.float32))
+    missing = [n for n in names if not hasattr(t, n)]
+    assert not missing, missing

@@ -142,8 +142,10 @@ def test_tensor_method_list_parity():
     import paddle_tpu as paddle
 
     src = open("/root/reference/python/paddle/tensor/__init__.py").read()
-    assert "tensor_method_func" in src
-    names = re.findall(r"'(\w+)',", src.split("tensor_method_func")[1])
+    m = re.search(r"tensor_method_func\s*=\s*\[", src)
+    assert m, "tensor_method_func list not found in reference"
+    body = src[m.end():].split("]", 1)[0]
+    names = re.findall(r"['\"](\w+)['\"]", body)  # both quote styles
     assert len(names) > 200, len(names)
     t = paddle.to_tensor(np.ones((2, 2), np.float32))
     missing = [n for n in names if not hasattr(t, n)]

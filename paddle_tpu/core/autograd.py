@@ -3,19 +3,23 @@
 Reference design: paddle/fluid/eager/grad_node_info.* + fluid/imperative/tracer.*
 record a GradNode per traced op and walk the node graph on `loss.backward()`.
 
-TPU-native design: every eager op runs through `apply(fn, *args)`. When grad
-is required, the op's forward runs under `jax.vjp`, which both executes the
-(jit-cached) XLA computation and captures residuals; the returned pullback is
-itself an XLA-backed callable, stored on a `GradNode`. `backward()` walks the
-node DAG in reverse topological order, invoking pullbacks and accumulating
-cotangents — the exact GradNode walk of the reference, but every node is a
-compiled XLA program. For `create_graph` (higher-order grad), the node also
-keeps its pure forward closure; the vjp is re-derived *through* `apply` so
-the backward pass itself is recorded on the tape — jax.vjp composes, giving
-arbitrary-order gradients.
+TPU-native design: every eager op runs through `apply(fn, *args)`. The
+forward executes plainly; when grad is required the node stores the op's
+primals and a DEFERRED pullback served by a jit cached on (op identity,
+closures/defaults, statics, avals) — the jitted backward recomputes the
+op's forward inside the same XLA program as its transpose, so neither
+the forward nor the backward pays per-call re-linearization (eager
+`jax.vjp` per op costs ~ms of pure tracing). `backward()` walks the
+node DAG in reverse topological order, invoking pullbacks and
+accumulating cotangents — the exact GradNode walk of the reference, but
+every node is a compiled XLA program. For `create_graph` (higher-order
+grad), the node also keeps its pure forward closure; the vjp is
+re-derived *through* `apply` so the backward pass itself is recorded on
+the tape — jax.vjp composes, giving arbitrary-order gradients.
 """
 from __future__ import annotations
 
+import collections
 import contextlib
 import threading
 
@@ -99,6 +103,94 @@ def _is_tensor(x):
     return isinstance(x, Tensor)
 
 
+_BWD_CACHE_CAP = 512
+_bwd_cache = collections.OrderedDict()  # LRU: key -> jitted backward
+
+
+def _subst_call(fn, treedef, diff_pos, base_vals):
+    """g(*dvals): `fn` with the differentiated positions substituted into
+    a copy of base_vals — the single rebuild used by forward, eager vjp,
+    and the cached jitted backward."""
+    def g(*dvals):
+        vv = list(base_vals)
+        for ix, dv in zip(diff_pos, dvals):
+            vv[ix] = dv
+        a, kw = jax.tree_util.tree_unflatten(treedef, vv)
+        return fn(*a, **kw)
+
+    return g
+
+
+def _make_pullback(fn, vals, treedef, diff_pos, out_treedef):
+    """Deferred, cache-jitted vjp for one tape node.
+
+    The jitted backward re-runs the op's forward inside the same XLA
+    program as its transpose (flash-attention-style recompute) — one
+    compiled call replaces eager per-op re-linearization (~ms of pure
+    tracing per op). The cache key covers everything that shapes the
+    computation: the op's code object, closure cells AND default args
+    (run_backward's vjp_call carries its per-node state in defaults),
+    the arg treedef, which positions are differentiated, non-array
+    (static) args, and array/cotangent avals. Anything unhashable — or
+    float0 cotangents — falls back to an eager jax.vjp with identical
+    semantics."""
+    arr_pos = tuple(i for i, v in enumerate(vals)
+                    if isinstance(v, (jax.Array, np.ndarray)))
+    n_vals = len(vals)
+
+    def _eager(cot_tree):
+        g = _subst_call(fn, treedef, diff_pos, vals)
+        _, pull = jax.vjp(g, *[vals[i] for i in diff_pos])
+        return pull(cot_tree)
+
+    def pullback(cot_tree):
+        cot_leaves = jax.tree_util.tree_flatten(cot_tree)[0]
+        if any(getattr(c, "dtype", None) == jax.dtypes.float0
+               for c in cot_leaves):
+            return _eager(cot_tree)
+        cells = getattr(fn, "__closure__", None)
+        try:
+            cells = (tuple(c.cell_contents for c in cells) if cells
+                     else ())
+            statics = tuple((i, v) for i, v in enumerate(vals)
+                            if i not in arr_pos)
+            key = (getattr(fn, "__code__", fn), cells,
+                   getattr(fn, "__defaults__", None),
+                   tuple(sorted((getattr(fn, "__kwdefaults__", None)
+                                 or {}).items())),
+                   treedef, diff_pos, statics, out_treedef,
+                   tuple((vals[i].shape, str(vals[i].dtype))
+                         for i in arr_pos),
+                   tuple((c.shape, str(c.dtype)) for c in cot_leaves))
+            hash(key)
+        except (TypeError, AttributeError):
+            return _eager(cot_tree)
+        bwd = _bwd_cache.get(key)
+        if bwd is None:
+            statics_map = dict(statics)
+
+            def bwd_fn(arr_vals, cots):
+                v = [None] * n_vals
+                for i, s in statics_map.items():
+                    v[i] = s
+                for p, av in zip(arr_pos, arr_vals):
+                    v[p] = av
+                g = _subst_call(fn, treedef, diff_pos, v)
+                _, pull = jax.vjp(g, *[v[i] for i in diff_pos])
+                return pull(jax.tree_util.tree_unflatten(out_treedef,
+                                                         list(cots)))
+
+            bwd = jax.jit(bwd_fn)
+            _bwd_cache[key] = bwd
+            if len(_bwd_cache) > _BWD_CACHE_CAP:
+                _bwd_cache.popitem(last=False)
+        else:
+            _bwd_cache.move_to_end(key)
+        return bwd([vals[i] for i in arr_pos], list(cot_leaves))
+
+    return pullback
+
+
 def apply(fn, *args, **kwargs):
     """Run `fn` (a pure jnp/lax function) over args, unwrapping Tensors and
     recording a GradNode when any differentiable Tensor participates."""
@@ -133,11 +225,17 @@ def apply(fn, *args, **kwargs):
                           jax.tree_util.tree_leaves(out))
         return jax.tree_util.tree_map(lambda leaf: Tensor(leaf), out)
 
-    out, pullback = jax.vjp(closed, *[vals[i] for i in diff_pos])
+    # Forward runs plainly; the vjp is DEFERRED to backward and served by
+    # a jit cached on (op identity, closures, statics, avals) — eager
+    # jax.vjp here would re-linearize the op on EVERY call (~ms of pure
+    # tracing per op, the round-4 eager-tape profile).
+    out = closed()
     out_leaves, out_treedef = jax.tree_util.tree_flatten(out)
     if _post_op_hook is not None:
         _post_op_hook(getattr(fn, "__name__", "op"), out_leaves)
     structs = [jax.ShapeDtypeStruct(o.shape, o.dtype) for o in out_leaves]
+    pullback = _make_pullback(fn, vals, treedef, tuple(diff_pos),
+                              out_treedef)
     node = GradNode(pullback, closed, [flat[i] for i in diff_pos], out_treedef,
                     structs, getattr(fn, "__name__", "op"))
     wrapped = []

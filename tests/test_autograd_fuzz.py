@@ -89,3 +89,19 @@ def test_tape_matches_jax_grad(seed):
     for i, (g, w) in enumerate(zip(got, want)):
         np.testing.assert_allclose(g, np.asarray(w), rtol=1e-4, atol=1e-5,
                                    err_msg=f"seed={seed} leaf={i}")
+
+
+def test_create_graph_nodes_do_not_collide_in_bwd_cache():
+    """Two create_graph vjp nodes share vjp_call's code object and carry
+    their per-node state in default args — the backward cache must key on
+    defaults too, or the second node silently reuses the first node's
+    compiled vjp (sin's second-order grad where exp's is required)."""
+    x = paddle.to_tensor(np.float32(0.7))
+    x.stop_gradient = False
+    g1 = paddle.grad(paddle.sin(x), [x], create_graph=True)[0]
+    g2 = paddle.grad(paddle.exp(x), [x], create_graph=True)[0]
+    total = g1 + g2
+    total.backward()
+    # d/dx (cos x + e^x) = -sin x + e^x
+    want = -np.sin(0.7) + np.exp(0.7)
+    np.testing.assert_allclose(float(x.grad), want, rtol=1e-5)

@@ -19,9 +19,14 @@ import numpy as np
 
 from ..core.autograd import apply
 from ..core.tensor import Tensor
+from ..nn.layer.activation import ReLU as _ReLU
+from ..nn.layer.container import Sequential as _Sequential
+from ..nn.layer.conv import Conv2D as _Conv2D
 from ..nn.layer.layers import Layer
+from ..nn.layer.norm import BatchNorm2D as _BatchNorm2D
 
 __all__ = [
+    "ConvNormActivation",
     "yolo_loss", "yolo_box", "deform_conv2d", "DeformConv2D",
     "read_file", "decode_jpeg", "roi_pool", "RoIPool", "psroi_pool",
     "PSRoIPool", "roi_align", "RoIAlign", "nms",
@@ -546,3 +551,26 @@ def decode_jpeg(x, mode="unchanged", name=None):
     else:
         arr = arr.transpose(2, 0, 1)
     return Tensor(jnp.asarray(arr))
+
+
+class ConvNormActivation(_Sequential):
+    """Conv-Norm-Activation block (reference vision/ops.py
+    ConvNormActivation, itself modeled on torchvision misc.py): a
+    Sequential of Conv2D [+ norm_layer] [+ activation_layer], with the
+    reference's same-padding default and bias-iff-no-norm rule."""
+
+    def __init__(self, in_channels, out_channels, kernel_size=3, stride=1,
+                 padding=None, groups=1, norm_layer=_BatchNorm2D,
+                 activation_layer=_ReLU, dilation=1, bias=None):
+        if padding is None:
+            padding = (kernel_size - 1) // 2 * dilation
+        if bias is None:
+            bias = norm_layer is None
+        layers = [_Conv2D(in_channels, out_channels, kernel_size, stride,
+                          padding, dilation=dilation, groups=groups,
+                          bias_attr=None if bias else False)]
+        if norm_layer is not None:
+            layers.append(norm_layer(out_channels))
+        if activation_layer is not None:
+            layers.append(activation_layer())
+        super().__init__(*layers)

@@ -206,3 +206,25 @@ def test_fleet_dataset_and_framework_dtype_paths():
     assert hasattr(fds, "QueueDataset")
     assert get_default_dtype() == "float32"
     set_default_dtype("float32")
+
+
+def test_conv_norm_activation_block():
+    """Reference ConvNormActivation: same-padding default, bias only
+    when norm_layer is None, Sequential structure."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.vision.ops import ConvNormActivation
+
+    blk = ConvNormActivation(3, 8, kernel_size=5, stride=2, dilation=1)
+    x = paddle.to_tensor(np.random.RandomState(0).randn(
+        2, 3, 16, 16).astype(np.float32))
+    y = blk(x)
+    assert tuple(y.shape) == (2, 8, 8, 8)       # same-padding halves HW
+    assert blk[0].bias is None                   # norm present -> no bias
+    assert type(blk[1]).__name__ == "BatchNorm2D"
+    assert type(blk[2]).__name__ == "ReLU"
+
+    blk2 = ConvNormActivation(3, 8, norm_layer=None, activation_layer=None)
+    assert blk2[0].bias is not None
+    assert len(blk2) == 1

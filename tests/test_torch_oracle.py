@@ -988,3 +988,36 @@ def test_distribution_transforms_vs_torch():
     np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
     back = np.asarray(sb.inverse(_t(got)).numpy())
     np.testing.assert_allclose(back, z, rtol=1e-3, atol=1e-4)
+
+
+def test_instance_and_3d_norms_vs_torch():
+    rng = np.random.RandomState(21)
+    # InstanceNorm 1d/2d/3d, affine
+    for dims, shape in [(1, (2, 3, 9)), (2, (2, 3, 5, 6)),
+                        (3, (2, 3, 4, 5, 6))]:
+        x = rng.randn(*shape).astype(np.float32)
+        ours_cls = getattr(paddle.nn, f"InstanceNorm{dims}D")(3)
+        theirs_cls = getattr(torch.nn, f"InstanceNorm{dims}d")(
+            3, affine=True)
+        with torch.no_grad():
+            theirs_cls.weight.mul_(1.4).add_(0.1)
+            theirs_cls.bias.add_(0.2)
+        ours_cls.scale.set_value(theirs_cls.weight.detach().numpy())
+        ours_cls.bias.set_value(theirs_cls.bias.detach().numpy())
+        got = np.asarray(ours_cls(_t(x)).numpy())
+        want = theirs_cls(torch.from_numpy(x)).detach().numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5,
+                                   err_msg=f"InstanceNorm{dims}D")
+
+    # BatchNorm3D train-mode normalization + running stats
+    x = rng.randn(2, 3, 4, 5, 6).astype(np.float32)
+    p_bn = paddle.nn.BatchNorm3D(3, momentum=0.9)
+    t_bn = torch.nn.BatchNorm3d(3, momentum=0.1)  # torch momentum = 1-p
+    p_bn.train()
+    t_bn.train()
+    got = np.asarray(p_bn(_t(x)).numpy())
+    want = t_bn(torch.from_numpy(x)).detach().numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(
+        np.asarray(p_bn._mean.numpy()),
+        t_bn.running_mean.numpy(), rtol=1e-4, atol=1e-5)

@@ -535,6 +535,21 @@ CONFIGS = {
                   "eager_tokens": 2}, 600),
 }
 
+# test hook: BENCH_CONFIGS_MODULE names a module whose CONFIGS replaces
+# the table above (inherited by runner children via the environment), so
+# the orchestrator/runner machinery is testable with fast fake configs.
+# A broken value must not break the one-JSON-line contract — fall back
+# to the real table with a stderr note.
+if os.environ.get("BENCH_CONFIGS_MODULE"):
+    import importlib
+
+    try:
+        CONFIGS = importlib.import_module(
+            os.environ["BENCH_CONFIGS_MODULE"]).CONFIGS
+    except Exception as _e:  # noqa: BLE001
+        print(f"bench: ignoring BENCH_CONFIGS_MODULE "
+              f"({type(_e).__name__}: {_e})", file=sys.stderr)
+
 _HEADLINE_CANDIDATES = [
     ("bert", "bert_tokens_per_sec",
      "BERT-base MLM tokens/sec/chip (AMP O2 bf16)", "tokens/sec"),
@@ -711,13 +726,14 @@ def main():
     out_dir = os.environ.get("BENCH_STATE_DIR",
                              os.path.join(REPO, ".bench_state"))
     # stale results from an earlier run must not masquerade as this run's
-    # (only bench artifacts — BENCH_STATE_DIR may point somewhere shared)
+    # — _collect merges EVERY *.json in out_dir, so cleanup must cover
+    # any config name (a prior run may have used a different CONFIGS
+    # table), while still bounding the blast radius if BENCH_STATE_DIR
+    # points somewhere shared
     if os.path.isdir(out_dir):
         for fname in os.listdir(out_dir):
-            known = (fname == "heartbeat.json"
-                     or fname.startswith("runner_")
-                     or fname[:-5] in CONFIGS and fname.endswith(".json")
-                     or fname == "probe.json")
+            known = (fname.endswith(".json")
+                     or fname.startswith("runner_"))
             if known:
                 try:
                     os.remove(os.path.join(out_dir, fname))

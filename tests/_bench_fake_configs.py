@@ -1,0 +1,36 @@
+"""Fake CONFIGS for bench-orchestrator tests (BENCH_CONFIGS_MODULE).
+
+Behavior is driven by marker files in BENCH_FAKE_DIR so a config can
+crash the whole runner process on its FIRST attempt only (testing the
+orchestrator's respawn + crash-skip path) while staying deterministic.
+"""
+import os
+
+
+def _fake_lenet():
+    return {"lenet_imgs_per_sec": 111.0}
+
+
+def _fake_bert():
+    return {"bert_tokens_per_sec": 999.0, "bert_step_ms": 10.0}
+
+
+def _fake_crasher():
+    marker = os.path.join(os.environ["BENCH_FAKE_DIR"], "crashed_once")
+    if not os.path.exists(marker):
+        with open(marker, "w") as f:
+            f.write("1")
+        os._exit(3)  # hard-kill the runner process mid-config
+    return {"crasher_ok": True}
+
+
+def _fake_error():
+    raise RuntimeError("deliberate in-process failure")
+
+
+CONFIGS = {
+    "lenet": (_fake_lenet, {}, 60),
+    "crasher": (_fake_crasher, {}, 60),
+    "bert": (_fake_bert, {}, 60),
+    "error": (_fake_error, {}, 60),
+}

@@ -1,0 +1,62 @@
+"""End-to-end tests of the bench.py orchestrator/runner machinery with
+fake configs (BENCH_CONFIGS_MODULE): crash-respawn-skip, in-process
+error recording, headline selection, and the one-JSON-line contract.
+Real subprocesses, no TPU, seconds-fast.
+"""
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+HERE = os.path.join(REPO, "tests")
+
+
+def test_orchestrator_survives_crash_and_errors(tmp_path):
+    env = dict(os.environ)
+    env["BENCH_CONFIGS_MODULE"] = "_bench_fake_configs"
+    env["PYTHONPATH"] = HERE + os.pathsep + env.get("PYTHONPATH", "")
+    env["BENCH_FORCE_CPU"] = "1"
+    env["BENCH_FAKE_DIR"] = str(tmp_path)
+    env["BENCH_STATE_DIR"] = str(tmp_path / "state")
+    env["BENCH_DEADLINE_S"] = "240"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        env=env, cwd=REPO, capture_output=True, timeout=280)
+    line = proc.stdout.decode().strip().splitlines()[-1]
+    payload = json.loads(line)
+
+    # headline came from the fake bert config, after a runner crash
+    assert payload["value"] == 999.0, payload
+    assert payload["metric"].startswith("BERT-base"), payload
+    assert proc.returncode == 0, (proc.returncode, payload)
+    # configs before the crash survived into the merged payload
+    assert payload["lenet_imgs_per_sec"] == 111.0
+    # the crashing config was skipped on respawn with a recorded error
+    assert "crasher_error" in payload, payload
+    assert "crasher_ok" not in payload
+    # the in-process failure was recorded (original + small retry)
+    assert "error_error" in payload, payload
+    # one crash -> exactly one respawn
+    assert payload.get("runner_crash_rc") == 3
+
+
+def test_orchestrator_exits_nonzero_without_headline(tmp_path):
+    """If no config produces a headline number the orchestrator must be
+    failure-shaped (nonzero rc, value null)."""
+    # module with only an erroring config, no headline keys
+    (tmp_path / "fake_noheadline.py").write_text(
+        "def _boom():\n    raise RuntimeError('no numbers here')\n"
+        "CONFIGS = {'error': (_boom, {}, 60)}\n")
+    env = dict(os.environ)
+    env["BENCH_CONFIGS_MODULE"] = "fake_noheadline"
+    env["PYTHONPATH"] = str(tmp_path) + os.pathsep + env.get("PYTHONPATH", "")
+    env["BENCH_FORCE_CPU"] = "1"
+    env["BENCH_STATE_DIR"] = str(tmp_path / "state")
+    env["BENCH_DEADLINE_S"] = "180"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        env=env, cwd=REPO, capture_output=True, timeout=260)
+    payload = json.loads(proc.stdout.decode().strip().splitlines()[-1])
+    assert payload["value"] is None
+    assert proc.returncode != 0

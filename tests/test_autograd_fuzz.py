@@ -105,3 +105,33 @@ def test_create_graph_nodes_do_not_collide_in_bwd_cache():
     # d/dx (cos x + e^x) = -sin x + e^x
     want = -np.sin(0.7) + np.exp(0.7)
     np.testing.assert_allclose(float(x.grad), want, rtol=1e-5)
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_static_matches_dygraph_on_random_dags(seed):
+    """The same random op DAG must produce identical results eagerly and
+    through the static Program/Executor (deferred trace -> one XLA
+    program)."""
+    leaves, program = _build_case(seed + 100)
+
+    eager = _run(program, [paddle.to_tensor(a) for a in leaves])
+    eager_val = float(eager)
+
+    paddle.enable_static()
+    try:
+        main = paddle.static.Program()
+        with paddle.static.program_guard(main):
+            feeds = []
+            for i, a in enumerate(leaves):
+                feeds.append(paddle.static.data(
+                    f"x{i}", list(a.shape), "float32"))
+            out = _run(program, feeds)
+        exe = paddle.static.Executor()
+        (got,) = exe.run(main,
+                         feed={f"x{i}": a for i, a in enumerate(leaves)},
+                         fetch_list=[out])
+    finally:
+        paddle.disable_static()
+    np.testing.assert_allclose(float(np.asarray(got)), eager_val,
+                               rtol=1e-4, atol=1e-5,
+                               err_msg=f"seed={seed}")

@@ -88,6 +88,23 @@ def _chip_peak_flops():
 # bench configs (run in child processes only — all jax imports are local)
 # --------------------------------------------------------------------------
 
+def _step_flops(compiled, params, batch, seq):
+    """(flops_per_step, source): XLA cost analysis, or the analytic
+    transformer estimate 6*params*tokens when unavailable (the tunnel
+    backend may not expose cost analysis)."""
+    try:
+        cost = compiled.cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0]
+        flops = float(cost.get("flops", 0)) if cost else 0.0
+    except Exception:  # noqa: BLE001 — cost analysis optional per backend
+        flops = 0.0
+    if flops > 0:
+        return flops, "xla"
+    n_params = sum(int(np.prod(v.shape)) for v in params.values())
+    return 6.0 * n_params * batch * seq, "analytic"
+
+
 def bench_bert(batch=32, seq=128, steps=30, warmup=5):
     """BERT-base MLM, AMP O2 (bf16 weights, f32 norms), fused jitted step.
     batch 32 (not 16): 2048-token steps underfeed the MXU — the v5e HBM
@@ -146,20 +163,7 @@ def bench_bert(batch=32, seq=128, steps=30, warmup=5):
     # any shaped tensor (static `2x...` or dynamic `?x...`) ends in `xf64`
     f64_free = not re.search(r"tensor<[^>]*xf64>", lowered.as_text())
     compiled = lowered.compile()
-    mfu_source = "xla"
-    try:
-        cost = compiled.cost_analysis()
-        if isinstance(cost, (list, tuple)):
-            cost = cost[0]
-        step_flops = float(cost.get("flops", 0)) if cost else 0.0
-    except Exception:  # noqa: BLE001 — cost analysis optional per backend
-        step_flops = 0.0
-    if step_flops <= 0:
-        # analytic fallback (cost analysis can be unavailable through the
-        # tunnel): transformer train step ~ 6 * params * tokens
-        n_params = sum(int(np.prod(v.shape)) for v in params.values())
-        step_flops = 6.0 * n_params * batch * seq
-        mfu_source = "analytic"
+    step_flops, mfu_source = _step_flops(compiled, params, batch, seq)
 
     for _ in range(warmup):
         params, states, loss = jit_step(params, states, ids, labels)
@@ -225,18 +229,7 @@ def bench_gpt(batch=8, seq=512, steps=20, warmup=3):
     ids = jnp.asarray(rng.randint(0, cfg.vocab_size, (batch, seq)))
     labels = jnp.asarray(rng.randint(0, cfg.vocab_size, (batch, seq)))
     compiled = jit_step.lower(params, states, ids, labels).compile()
-    mfu_source = "xla"
-    try:
-        cost = compiled.cost_analysis()
-        if isinstance(cost, (list, tuple)):
-            cost = cost[0]
-        step_flops = float(cost.get("flops", 0)) if cost else 0.0
-    except Exception:  # noqa: BLE001
-        step_flops = 0.0
-    if step_flops <= 0:
-        n_params = sum(int(np.prod(v.shape)) for v in params.values())
-        step_flops = 6.0 * n_params * batch * seq
-        mfu_source = "analytic"
+    step_flops, mfu_source = _step_flops(compiled, params, batch, seq)
     for _ in range(warmup):
         params, states, loss = jit_step(params, states, ids, labels)
     _sync(loss)

@@ -108,8 +108,11 @@ amp_guard = auto_cast
 def _is_norm_layer(layer):
     from ..nn.layer import norm as _norm
 
+    # SpectralNorm included: its power-iteration buffers (weight_u/v)
+    # need f32 — bf16 iteration degrades the sigma estimate
     return isinstance(layer, (_norm._BatchNormBase, _norm.LayerNorm,
-                              _norm.GroupNorm, _norm._InstanceNormBase))
+                              _norm.GroupNorm, _norm._InstanceNormBase,
+                              _norm.SpectralNorm))
 
 
 def decorate(models, optimizers=None, level="O2", dtype="float16",

@@ -55,10 +55,15 @@ def _from_storable(obj, return_numpy=False):
 def save(obj, path, protocol=4, **configs):
     """Pickle `obj` with Tensors lowered to numpy ndarrays.
 
+    `path` is a filesystem path or a file-like object (the reference
+    supports BytesIO targets — framework/io.py save/_open_file_buffer).
     Like the reference format, trainability flags are not serialized:
-    tensors load back with default stop_gradient=True, and state dicts get
-    their flags from the receiving layer's set_state_dict.
+    tensors load back with default stop_gradient=True, and state dicts
+    get their flags from the receiving layer's set_state_dict.
     """
+    if hasattr(path, "write"):  # file-like (BytesIO et al.)
+        pickle.dump(_to_storable(obj), path, protocol=protocol)
+        return
     d = os.path.dirname(path)
     if d:
         os.makedirs(d, exist_ok=True)
@@ -67,6 +72,9 @@ def save(obj, path, protocol=4, **configs):
 
 
 def load(path, return_numpy=False, **configs):
-    with open(path, "rb") as f:
-        obj = pickle.load(f)
+    if hasattr(path, "read"):  # file-like (BytesIO et al.)
+        obj = pickle.load(path)
+    else:
+        with open(path, "rb") as f:
+            obj = pickle.load(f)
     return _from_storable(obj, return_numpy)

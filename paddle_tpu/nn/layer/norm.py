@@ -228,8 +228,12 @@ class SpectralNorm(Layer):
                 w *= s
         from ...framework import random as rnd
 
-        self.weight_u = Tensor(jax.random.normal(rnd.next_key(), (h,)))
-        self.weight_v = Tensor(jax.random.normal(rnd.next_key(), (w,)))
+        # persistent buffers, as in the reference — the power-iteration
+        # state must survive state_dict round-trips (checkpoint/resume)
+        self.register_buffer(
+            "weight_u", Tensor(jax.random.normal(rnd.next_key(), (h,))))
+        self.register_buffer(
+            "weight_v", Tensor(jax.random.normal(rnd.next_key(), (w,))))
 
     def forward(self, weight):
         dim, iters, eps = self._dim, self._power_iters, self._eps

@@ -74,3 +74,37 @@ def test_decorate_casts_model():
     m = nn.Linear(4, 4)
     paddle.amp.decorate(m, level="O2")
     assert m.weight.dtype == paddle.bfloat16
+
+
+def test_o2_bf16_forward_tracks_f32():
+    """The O2 (bf16 weights) forward must track the f32 forward within
+    bf16 tolerance on a small BERT — the TPU hot-path numeric guard."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.models.bert import BertConfig, BertForMaskedLM
+
+    paddle.seed(0)
+    cfg = BertConfig(vocab_size=128, hidden_size=64, num_layers=2,
+                     num_heads=4, intermediate_size=128, max_position=32,
+                     dropout=0.0, attention_dropout=0.0)
+    model = BertForMaskedLM(cfg)
+    model.eval()
+    rng = np.random.RandomState(0)
+    ids = paddle.to_tensor(rng.randint(0, 128, (2, 16)))
+    labels = paddle.to_tensor(rng.randint(0, 128, (2, 16)))
+
+    with paddle.no_grad():
+        out32 = model(ids, labels=labels)
+        loss32 = float(out32[0] if isinstance(out32, (list, tuple))
+                       else out32)
+
+    paddle.amp.decorate(model, level="O2")
+    with paddle.no_grad():
+        out16 = model(ids, labels=labels)
+        loss16 = float(out16[0] if isinstance(out16, (list, tuple))
+                       else out16)
+
+    # bf16 has ~3 significant decimal digits; losses are O(log vocab)
+    assert abs(loss16 - loss32) / max(abs(loss32), 1e-6) < 0.02, \
+        (loss32, loss16)

@@ -24,7 +24,10 @@ __all__ = ["launch", "main"]
 
 def _parse(argv):
     p = argparse.ArgumentParser(
-        prog="paddle.distributed.launch", add_help=False)
+        prog="paddle.distributed.launch",
+        description="Run a training script on this host's chips; "
+                    "multi-host rendezvous via --nnodes/--master/--rank "
+                    "(jax.distributed).")
     p.add_argument("--devices", "--gpus", "--xpus", "--npus", default=None)
     p.add_argument("--nnodes", type=str, default="1")
     p.add_argument("--nproc_per_node", type=int, default=None)

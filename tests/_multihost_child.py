@@ -86,8 +86,65 @@ def main():
     tot = float(jax.device_get(total(contrib)))
     assert tot == 10.0, tot
 
+    _hybrid_dp_tp(pid)
+
     print(f"MULTIHOST_OK pid={pid} procs={jax.process_count()} "
           f"devices={jax.device_count()}", flush=True)
+
+
+def _hybrid_dp_tp(pid):
+    """dp=2 (one process per dp rank) x tp=2 (local devices): a
+    megatron column+row parallel MLP under shard_map — the tp psum rides
+    'local ICI', the dp gradient sum crosses the process boundary."""
+    import jax
+    import jax.numpy as jnp
+    from jax import shard_map
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    devs = np.array(jax.devices()).reshape(2, 2)  # [dp, tp]
+    mesh = Mesh(devs, ("dp", "tp"))
+
+    B, H, F = 8, 4, 8
+    rng = np.random.RandomState(7)
+    full_x = rng.randn(B, H).astype(np.float32)
+    w1 = rng.randn(H, F).astype(np.float32)   # column-sharded over tp
+    w2 = rng.randn(F, H).astype(np.float32)   # row-sharded over tp
+
+    x = jax.make_array_from_callback(
+        (B, H), NamedSharding(mesh, P("dp", None)), lambda i: full_x[i])
+    w1s = jax.device_put(w1, NamedSharding(mesh, P(None, "tp")))
+    w2s = jax.device_put(w2, NamedSharding(mesh, P("tp", None)))
+
+    import functools
+
+    @functools.partial(shard_map, mesh=mesh,
+                       in_specs=(P("dp", None), P(None, "tp"),
+                                 P("tp", None)),
+                       out_specs=P())
+    def grad_norm(xb, w1b, w2b):
+        def loss(w1b, w2b):
+            h = jnp.tanh(xb @ w1b)          # [b_local, F/tp]
+            y = h @ w2b                      # partial sum over tp
+            y = jax.lax.psum(y, "tp")        # row-parallel reduction
+            return jnp.sum(y ** 2)
+
+        l, (g1, g2) = jax.value_and_grad(loss, argnums=(0, 1))(w1b, w2b)
+        # dp-mean of the loss and grads crosses the process boundary;
+        # the per-tp-shard grads are reduced to a replicated scalar via
+        # a tp psum so the output is provably replicated on both axes
+        l = jax.lax.pmean(l, "dp")
+        g_norm = jax.lax.psum(jnp.sum(jax.lax.pmean(g1, "dp") ** 2), "tp")
+        return l + 0.0 * g_norm
+
+    got = float(jax.device_get(grad_norm(x, w1s, w2s)))
+
+    # single-process oracle
+    h = np.tanh(full_x @ w1)
+    y = h @ w2
+    per_dp = np.array([np.sum(y[:4] ** 2), np.sum(y[4:] ** 2)])
+    want = float(per_dp.mean())
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+    print(f"HYBRID_OK pid={pid} loss={got:.4f}", flush=True)
 
 
 if __name__ == "__main__":

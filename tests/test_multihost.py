@@ -45,4 +45,5 @@ def test_two_process_world():
                 p.kill()
     for pid, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"pid {pid} rc={p.returncode}:\n{out[-2000:]}"
+        assert f"HYBRID_OK pid={pid}" in out, out[-2000:]
         assert f"MULTIHOST_OK pid={pid} procs=2 devices=4" in out, out[-2000:]

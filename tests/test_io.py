@@ -117,3 +117,26 @@ def test_save_load_roundtrip(tmp_path):
     assert back["step"] == 7
     back_np = paddle.load(p, return_numpy=True)
     assert isinstance(back_np["w"], np.ndarray)
+
+
+def test_save_load_bytesio():
+    """The reference supports BytesIO targets for paddle.save/load
+    (framework/io.py _open_file_buffer)."""
+    import io as _io
+
+    import numpy as np
+
+    import paddle_tpu as paddle
+
+    obj = {"w": paddle.to_tensor(np.arange(6, dtype=np.float32)),
+           "meta": {"epoch": 3, "name": "x"},
+           "list": [paddle.to_tensor(np.ones((2, 2), np.float32)), 7]}
+    buf = _io.BytesIO()
+    paddle.save(obj, buf)
+    buf.seek(0)
+    back = paddle.load(buf)
+    np.testing.assert_array_equal(np.asarray(back["w"].numpy()),
+                                  np.arange(6, dtype=np.float32))
+    assert back["meta"] == {"epoch": 3, "name": "x"}
+    assert float(back["list"][0].numpy().sum()) == 4.0 and \
+        back["list"][1] == 7

@@ -88,7 +88,8 @@ def enable_grad(func=None):
 
 
 class GradNode:
-    __slots__ = ("pullback", "closed", "inputs", "out_treedef", "out_structs", "name")
+    __slots__ = ("pullback", "closed", "inputs", "out_treedef",
+                 "out_structs", "name", "hooks")
 
     def __init__(self, pullback, closed, inputs, out_treedef, out_structs, name):
         self.pullback = pullback      # residual-holding pullback (first-order)
@@ -97,6 +98,7 @@ class GradNode:
         self.out_treedef = out_treedef
         self.out_structs = out_structs  # ShapeDtypeStruct per output leaf
         self.name = name
+        self.hooks = {}               # out_idx -> {key: grad hook}
 
 
 def _is_tensor(x):
@@ -298,6 +300,19 @@ def run_backward(tensors, grad_tensors=None, retain_graph=False,
     if grad_tensors is None:
         grad_tensors = [None] * len(tensors)
     cots = {}  # (id(node), out_idx) -> cotangent (raw array | Tensor if create_graph)
+    # per-pass leaf gradient sums: id(t) -> [t, summed contribution].
+    # Accumulation into .grad (and leaf-hook firing) happens once at the
+    # END of the pass, so a leaf feeding several nodes sees ONE final
+    # gradient (the reference hook contract).
+    leaf_sums = {}
+
+    def _leaf_contrib(t, g):
+        slot = leaf_sums.get(id(t))
+        if slot is None:
+            leaf_sums[id(t)] = [t, g]
+        else:
+            slot[1] = _add_cot(slot[1], g, create_graph)
+
     for t, g in zip(tensors, grad_tensors):
         if t._node is None and t.stop_gradient:
             raise RuntimeError(
@@ -313,13 +328,19 @@ def run_backward(tensors, grad_tensors=None, retain_graph=False,
         elif not create_graph:
             g = _raw(g)
         if t._node is None:
-            _accum_leaf(t, g)
+            _leaf_contrib(t, g)
         else:
             key = (id(t._node), t._out_idx)
             cots[key] = _add_cot(cots.get(key), g, create_graph)
 
     input_grads = {id(t): None for t in (inputs or [])}
     input_set = set(input_grads)
+    # requested intermediates: capture the post-hook FINAL cotangent at
+    # the producing node rather than pre-hook consumer contributions
+    want_inter = {}
+    for t in (inputs or []):
+        if t._node is not None:
+            want_inter.setdefault((id(t._node), t._out_idx), []).append(t)
 
     for node in _topo_nodes(tensors):
         keyed = [(id(node), i) for i in range(len(node.out_structs))]
@@ -330,6 +351,17 @@ def run_backward(tensors, grad_tensors=None, retain_graph=False,
             c if c is not None else _zero_cot(s)
             for c, s in zip(cot_leaves, node.out_structs)
         ]
+        if node.hooks:
+            # user grad hooks fire on the FINAL cotangent of the hooked
+            # output, before it feeds the pullback
+            cot_leaves = [
+                _run_grad_hooks(node.hooks[i], c) if i in node.hooks else c
+                for i, c in enumerate(cot_leaves)
+            ]
+        if want_inter:
+            for i, c in enumerate(cot_leaves):
+                for t in want_inter.get((id(node), i), ()):
+                    input_grads[id(t)] = c
         if node.pullback is None and node.closed is None:
             raise RuntimeError(
                 "Trying to backward through the graph a second time: "
@@ -364,18 +396,21 @@ def run_backward(tensors, grad_tensors=None, retain_graph=False,
                 key = (id(t._node), t._out_idx)
                 cots[key] = _add_cot(cots.get(key), c if create_graph else cv,
                                      create_graph)
-                if id(t) in input_set:
-                    input_grads[id(t)] = _add_cot(
-                        input_grads[id(t)], c if create_graph else cv, create_graph)
             else:
-                if id(t) in input_set:
-                    input_grads[id(t)] = _add_cot(
-                        input_grads[id(t)], c if create_graph else cv, create_graph)
-                if accumulate:
-                    _accum_leaf(t, cv)
+                _leaf_contrib(t, c if create_graph else cv)
         if not retain_graph and not create_graph:
             node.pullback = None
             node.closed = None
+
+    # pass end: fire leaf hooks once on the final per-pass gradient,
+    # then accumulate / report
+    for t, g in leaf_sums.values():
+        if getattr(t, "_leaf_hooks", None):
+            g = _run_grad_hooks(t._leaf_hooks, g)
+        if id(t) in input_set:
+            input_grads[id(t)] = g
+        if accumulate:
+            _accum_leaf(t, _raw(g))
     if inputs is not None:
         out = []
         for t in inputs:
@@ -387,6 +422,51 @@ def run_backward(tensors, grad_tensors=None, retain_graph=False,
                 g = Tensor(g)
             out.append(g)
         return out
+
+
+_hook_counter = 0
+
+
+class _HookHandle:
+    """Removable registration (reference TensorHookRemoveHelper)."""
+
+    def __init__(self, hooks, key):
+        self._hooks = hooks
+        self._key = key
+
+    def remove(self):
+        self._hooks.pop(self._key, None)
+
+
+def _run_grad_hooks(hooks, g):
+    """Run user hooks over a cotangent. Hooks see a Tensor and may
+    return a replacement (reference Tensor.register_hook contract)."""
+    for fn in list(hooks.values()):
+        res = fn(g if isinstance(g, Tensor) else Tensor(g))
+        if res is not None:
+            res = _raw(res) if not isinstance(g, Tensor) else (
+                res if isinstance(res, Tensor) else Tensor(res))
+            g = res
+    return g
+
+
+def register_grad_hook(t, hook):
+    """Implementation behind Tensor.register_hook: fires when the
+    gradient w.r.t. `t` is computed during backward; the hook may
+    replace the gradient by returning a new one."""
+    if t.stop_gradient:
+        raise RuntimeError(
+            "register_hook requires a tensor with stop_gradient=False")
+    if t._node is not None:
+        hooks = t._node.hooks.setdefault(t._out_idx, {})
+    else:
+        if t._leaf_hooks is None:
+            t._leaf_hooks = {}
+        hooks = t._leaf_hooks
+    global _hook_counter
+    _hook_counter += 1  # monotonic: removed keys are never reused
+    hooks[_hook_counter] = hook
+    return _HookHandle(hooks, _hook_counter)
 
 
 def _accum_leaf(t, g):

@@ -23,7 +23,7 @@ _tensor_count = 0
 class Tensor:
     __slots__ = (
         "_value", "stop_gradient", "_grad", "_node", "_out_idx",
-        "name", "persistable", "_dist_attr", "__weakref__",
+        "name", "persistable", "_dist_attr", "_leaf_hooks", "__weakref__",
     )
 
     # populated by paddle_tpu.tensor._register_methods at package import
@@ -40,6 +40,7 @@ class Tensor:
         self._grad = None
         self._node = None
         self._out_idx = 0
+        self._leaf_hooks = None
         if name is None:
             name = f"generated_tensor_{_tensor_count}"
             _tensor_count += 1
@@ -195,6 +196,15 @@ class Tensor:
         from .autograd import apply
 
         return apply(lambda x: x + jnp.zeros((), x.dtype), self)
+
+    def register_hook(self, hook):
+        """Register a gradient hook (reference Tensor.register_hook):
+        fires with the gradient w.r.t. this tensor during backward; a
+        non-None return replaces the gradient. Returns a removable
+        handle."""
+        from .autograd import register_grad_hook
+
+        return register_grad_hook(self, hook)
 
     def clear_grad(self):
         self._grad = None

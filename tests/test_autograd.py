@@ -175,3 +175,85 @@ def test_deep_graph():
         y = y * 1.01
     y.backward()
     assert x.grad is not None
+
+
+def test_register_hook_leaf_and_intermediate():
+    """Tensor.register_hook fires with the final gradient and can
+    replace it; handles are removable (reference Tensor.register_hook)."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+
+    x = paddle.to_tensor(np.ones(3, np.float32))
+    x.stop_gradient = False
+    seen = []
+    h = x.register_hook(lambda g: seen.append(np.asarray(g.numpy())) or
+                        g * 2.0)
+    (x * 3.0).sum().backward()
+    assert len(seen) == 1
+    np.testing.assert_allclose(seen[0], 3.0)          # pre-hook grad
+    np.testing.assert_allclose(np.asarray(x.grad.numpy()), 6.0)  # doubled
+
+    # removable
+    x.clear_grad()
+    h.remove()
+    (x * 3.0).sum().backward()
+    np.testing.assert_allclose(np.asarray(x.grad.numpy()), 3.0)
+    assert len(seen) == 1
+
+    # intermediate tensor: hook sees d loss/d y, replacement propagates
+    x.clear_grad()
+    y = x * 4.0
+    got = []
+    y.register_hook(lambda g: got.append(np.asarray(g.numpy())) or g * 0.5)
+    (y * 2.0).sum().backward()
+    np.testing.assert_allclose(got[0], 2.0)
+    # dx = d/dx (x*4) * (hooked dy) = 4 * (2*0.5) = 4
+    np.testing.assert_allclose(np.asarray(x.grad.numpy()), 4.0)
+
+    # observation-only hook (returns None) leaves the gradient alone
+    x.clear_grad()
+    z = x * 5.0
+    z.register_hook(lambda g: None)
+    z.sum().backward()
+    np.testing.assert_allclose(np.asarray(x.grad.numpy()), 5.0)
+
+
+def test_register_hook_reference_contract_corners():
+    """Leaf hooks fire ONCE on the accumulated per-pass gradient (not per
+    contribution); paddle.grad sees hooked gradients; removed handles
+    never delete later registrations."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+
+    # one firing on the pass-final sum: (x*2).sum() + (x*3).sum()
+    x = paddle.to_tensor(np.ones(2, np.float32))
+    x.stop_gradient = False
+    seen = []
+    x.register_hook(lambda g: seen.append(np.asarray(g.numpy())) or
+                    paddle.clip(g, max=2.5))
+    ((x * 2.0).sum() + (x * 3.0).sum()).backward()
+    assert len(seen) == 1, seen
+    np.testing.assert_allclose(seen[0], 5.0)   # accumulated, pre-hook
+    np.testing.assert_allclose(np.asarray(x.grad.numpy()), 2.5)  # clipped
+
+    # paddle.grad consumes hooked intermediate gradients
+    x2 = paddle.to_tensor(np.float32(1.0))
+    x2.stop_gradient = False
+    y = x2 * 4.0
+    y.register_hook(lambda g: g * 0.5)
+    (gy,) = paddle.grad((y * 2.0).sum(), [y])
+    np.testing.assert_allclose(float(gy), 1.0)  # 2.0 halved by the hook
+
+    # handle removal never affects later registrations
+    t = paddle.to_tensor(np.ones(1, np.float32))
+    t.stop_gradient = False
+    calls = []
+    h1 = t.register_hook(lambda g: calls.append("a") or None)
+    h2 = t.register_hook(lambda g: calls.append("b") or None)
+    h2.remove()
+    t.register_hook(lambda g: calls.append("c") or None)
+    h2.remove()  # idempotent; must NOT delete the "c" hook
+    (t * 1.0).sum().backward()
+    assert calls == ["a", "c"], calls

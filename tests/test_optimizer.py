@@ -297,3 +297,44 @@ def test_adam_clip_scheduler_integration_vs_numpy():
 
     np.testing.assert_allclose(np.asarray(w.numpy()), wn, rtol=1e-4,
                                atol=1e-5)
+
+
+def test_parameter_groups_scale_lr_and_weight_decay():
+    """Dict parameter groups (reference optimizer param_groups): per-group
+    learning_rate multiplies the base lr; per-group weight_decay
+    overrides the optimizer-level one."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+
+    m1 = paddle.nn.Linear(4, 4, bias_attr=False)
+    m2 = paddle.nn.Linear(4, 4, bias_attr=False)
+    opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=[
+        {"params": list(m1.parameters()), "learning_rate": 1.0},
+        {"params": list(m2.parameters()), "learning_rate": 0.1},
+    ])
+    w1b = np.asarray(m1.weight.numpy()).copy()
+    w2b = np.asarray(m2.weight.numpy()).copy()
+    x = paddle.to_tensor(np.ones((2, 4), np.float32))
+    (m1(x).sum() + m2(x).sum()).backward()
+    opt.step()
+    d1 = np.abs(np.asarray(m1.weight.numpy()) - w1b).max()
+    d2 = np.abs(np.asarray(m2.weight.numpy()) - w2b).max()
+    np.testing.assert_allclose(d1 / d2, 10.0, rtol=1e-4)
+
+    # per-group weight decay: group-2 weights shrink, group-1 don't
+    m3 = paddle.nn.Linear(3, 3, bias_attr=False)
+    m4 = paddle.nn.Linear(3, 3, bias_attr=False)
+    opt2 = paddle.optimizer.SGD(learning_rate=0.5, parameters=[
+        {"params": list(m3.parameters()), "weight_decay": 0.0},
+        {"params": list(m4.parameters()), "weight_decay": 0.1},
+    ])
+    w3b = np.asarray(m3.weight.numpy()).copy()
+    w4b = np.asarray(m4.weight.numpy()).copy()
+    z = paddle.to_tensor(np.zeros((1, 3), np.float32))
+    (m3(z).sum() + m4(z).sum()).backward()   # zero data grads
+    opt2.step()
+    np.testing.assert_allclose(np.asarray(m3.weight.numpy()), w3b,
+                               atol=1e-7)
+    np.testing.assert_allclose(np.asarray(m4.weight.numpy()),
+                               w4b * (1 - 0.5 * 0.1), rtol=1e-5)

@@ -252,3 +252,25 @@ def test_ragged_with_user_padding_mask():
     want, _ = _xla_attention(q, k, v, mask4, 0.0, None, False)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=1e-4, atol=2e-5)
+
+
+def test_nondefault_block_sizes_match():
+    """block_q/block_k are the on-hardware tuning levers — the kernel
+    must stay exact at non-default tilings (incl. block_q != block_k)."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.nn.functional.attention import _xla_attention
+    from paddle_tpu.ops.pallas.flash_attention import flash_attention_raw
+
+    rng = np.random.RandomState(11)
+    BH, S, D = 2, 256, 64
+    q, k, v = (jnp.asarray(rng.randn(BH, S, D).astype(np.float32))
+               for _ in range(3))
+    want, _ = _xla_attention(q[:, None], k[:, None], v[:, None], None,
+                             0.0, None, True)
+    for bq, bk in [(64, 128), (128, 64), (64, 64), (128, 256)]:
+        got = flash_attention_raw(q, k, v, True, None, bq, bk)
+        np.testing.assert_allclose(np.asarray(got),
+                                   np.asarray(want[:, 0]),
+                                   rtol=1e-4, atol=2e-5,
+                                   err_msg=f"bq={bq} bk={bk}")

@@ -33,7 +33,7 @@ CASES = [
     ("tensor/manipulation.py", "scatter", paddle.scatter),
     ("tensor/creation.py", "arange", paddle.arange),
     ("tensor/creation.py", "full", paddle.full),
-    ("tensor/creation.py", "linspace", paddle.linspace),
+    ("fluid/layers/tensor.py", "linspace", paddle.linspace),
     ("tensor/linalg.py", "matmul", paddle.matmul),
     ("tensor/linalg.py", "norm", paddle.norm),
     ("tensor/random.py", "uniform", paddle.uniform),

@@ -1,7 +1,12 @@
 """Real-chip throughput bench (SURVEY §6 / BASELINE.json configs).
 
-Prints ONE JSON line:
+Stdout contract — LAST JSON line wins: the orchestrator streams an
+updated snapshot line every time a result lands on disk (and on
+SIGTERM/atexit), then one final line at the natural end; the driver
+records the stdout tail and parses the last parseable line:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...details}
+A mid-run kill therefore still leaves the latest partials in the tail
+(r04 lost a successful probe to an empty tail; this is the fix).
 
 Headline metric: BERT-base MLM tokens/sec/chip (AMP O2 bf16, whole-step
 jit with donated buffers); falls back to ResNet50 imgs/sec then LeNet
@@ -39,8 +44,10 @@ another client is waiting).
 from __future__ import annotations
 
 import argparse
+import atexit
 import json
 import os
+import signal
 import subprocess
 import sys
 import time
@@ -692,14 +699,71 @@ def _emit(payload):
     print(json.dumps(payload), flush=True)
 
 
-def _publish_baseline(details, cfg_name, ref_key, value):
+# The driver records the stdout TAIL and parses the LAST JSON line, so
+# the orchestrator streams a fresh snapshot line every time a result
+# lands: a driver-side kill at ANY moment (even SIGKILL, which runs no
+# handlers) still leaves the latest partials parseable in the tail —
+# the r04 failure mode (rc=124, empty tail, a successful probe lost).
+_FINAL_DONE = [False]
+
+# main() installs its _partial_payload here so the __main__ BaseException
+# wrapper can emit merged partials (not a bare error payload that would
+# mask results already measured to disk) on kill paths other than SIGTERM
+_PARTIAL_HOOK = [None]
+
+
+def _emit_final(payload):
+    """The one authoritative line; later callers (atexit after SIGTERM,
+    the __main__ error wrapper after a natural end) must not emit a
+    second, staler final line."""
+    if _FINAL_DONE[0]:
+        return
+    _FINAL_DONE[0] = True
+    _emit(payload)
+
+
+def _headline_of(details, small_all):
+    cfg_name, ref_key, metric, unit = _HEADLINE_CANDIDATES[0]
+    value = None
+    for cn, key, m, u in _HEADLINE_CANDIDATES:
+        if details.get(key):
+            cfg_name, ref_key, metric, unit = cn, key, m, u
+            value = details[key]
+            break
+    if value and (details.get(cfg_name + "_small") or small_all):
+        metric += " [small-config fallback]"
+    return cfg_name, ref_key, metric, unit, value
+
+
+def _build_payload(details, small_all, publish):
+    """Assemble the JSON-line payload from merged details. `publish`
+    gates the BASELINE.json write: only the natural end of a run may
+    publish (a mid-run snapshot could publish a partial sweep)."""
+    cfg_name, ref_key, metric, unit, value = _headline_of(details, small_all)
+    baseline = _publish_baseline(details, cfg_name, ref_key, value,
+                                 publish=publish)
+    payload = {
+        "metric": metric,
+        "value": round(value, 1) if value else None,
+        "unit": unit,
+        "vs_baseline": round(baseline, 3)
+        if (value and baseline is not None) else None,
+        **{k: (round(v, 4) if isinstance(v, float) else v)
+           for k, v in details.items()},
+    }
+    return payload, value
+
+
+def _publish_baseline(details, cfg_name, ref_key, value, publish=True):
     """First full real-chip run publishes its numbers as the baseline so
     later rounds report a real vs_baseline ratio. Small-size numbers are
     never published and never compared against a full-size baseline —
     either direction poisons the ratio permanently."""
     any_small = any(k.endswith("_small") and v for k, v in details.items())
     headline_small = bool(details.get(cfg_name + "_small"))
-    baseline = 1.0
+    # None until a real comparison exists: a ratio of 1.0 with nothing
+    # published would read as "measured vs baseline" when it never was
+    baseline = None
     baseline_path = os.path.join(REPO, "BASELINE.json")
     try:
         with open(baseline_path) as f:
@@ -708,7 +772,7 @@ def _publish_baseline(details, cfg_name, ref_key, value):
         ref = published.get(ref_key)
         if value and ref:
             baseline = value / ref if not headline_small else None
-        elif (value and not published and not any_small
+        elif (publish and value and not published and not any_small
               and os.environ.get("BENCH_SMALL", "0").lower() not in
               ("1", "true", "yes")
               and str(details.get("backend", "")).lower() in ("tpu", "axon")
@@ -721,6 +785,7 @@ def _publish_baseline(details, cfg_name, ref_key, value):
             baseline_doc["published"] = pub
             with open(baseline_path, "w") as f:
                 json.dump(baseline_doc, f, indent=2)
+            baseline = 1.0  # this run IS the baseline it is compared to
     except (OSError, ValueError):
         pass
     return baseline
@@ -767,6 +832,74 @@ def main():
                                                                "yes")
     todo = list(CONFIGS)
     details = {}
+    state = {"proc": None}
+
+    def _partial_payload(tag):
+        d = dict(details)
+        _collect(out_dir, d)
+        payload, value = _build_payload(d, small_all, publish=False)
+        payload["partial"] = tag
+        return payload, value
+
+    def _on_sigterm(signum, frame):
+        # the driver's timeout SIGTERMs the orchestrator; everything
+        # measured so far must reach stdout before dying (r04 lost a
+        # successful probe this way), and the runner child must be
+        # terminated so its session closes and the grant releases.
+        # os.write is the only reentrancy-safe emit: the signal may have
+        # landed INSIDE a _snapshot_if_new print (print from a handler
+        # then raises "reentrant call inside BufferedWriter"), and that
+        # failure must not skip the child terminate below.
+        value = None
+        try:
+            payload, value = _partial_payload("sigterm")
+            if not _FINAL_DONE[0]:
+                _FINAL_DONE[0] = True
+                # leading \n: the signal may have interrupted a snapshot
+                # print mid-line; appending to that unterminated prefix
+                # would corrupt the last-line-wins tail
+                os.write(1, ("\n" + json.dumps(payload) + "\n").encode())
+        except Exception:  # noqa: BLE001 — cleanup must still run
+            pass
+        proc = state.get("proc")
+        if proc is not None and proc.poll() is None:
+            # phase-aware cleanup: a runner WAITING for the grant (phase
+            # "probe") must NOT be killed — a killed waiter leaves an
+            # unclaimed grant poisoning the queue for successors (the
+            # r03/r04 wedge); orphaned, it exits at its own deadline_ts.
+            # A runner HOLDING the grant (mid-config) must die so the
+            # session closes and the chip frees — with SIGKILL
+            # escalation, or a wedged tunnel call leaks the grant.
+            try:
+                if heartbeat_phase() != "probe":
+                    proc.terminate()
+                    try:
+                        proc.wait(timeout=15.0)
+                    except subprocess.TimeoutExpired:
+                        proc.kill()
+            except Exception:  # noqa: BLE001 — dying anyway
+                pass
+        os._exit(0 if value else 1)
+
+    signal.signal(signal.SIGTERM, _on_sigterm)
+    _PARTIAL_HOOK[0] = _partial_payload
+    atexit.register(lambda: None if _FINAL_DONE[0]
+                    else _emit_final(_partial_payload("atexit")[0]))
+
+    reported = set()
+
+    def _snapshot_if_new():
+        """Stream an updated JSON line whenever a new result file lands
+        (probe.json included — the early 'probe succeeded' signal)."""
+        try:
+            files = {f for f in os.listdir(out_dir)
+                     if f.endswith(".json") and f != "heartbeat.json"}
+        except OSError:
+            return
+        if files - reported:
+            reported.update(files)
+            _emit(_partial_payload("running")[0])
+
     spawns = 0
     max_spawns = int(os.environ.get("BENCH_MAX_SPAWNS", 3))
     while todo and remaining() > 90.0 and spawns < max_spawns:
@@ -782,6 +915,7 @@ def main():
             proc = subprocess.Popen(
                 [sys.executable, os.path.abspath(__file__)] + args,
                 cwd=REPO, stdout=subprocess.DEVNULL, stderr=err_f)
+            state["proc"] = proc
             # Wait for the runner, polling the heartbeat. Two different
             # stall regimes:
             #  * phase == "probe": the runner is WAITING for the chip
@@ -797,10 +931,11 @@ def main():
             #    config's cost estimate + 600s of tunnel-compile slack.
             while True:
                 try:
-                    proc.wait(timeout=min(30.0, max(1.0, remaining())))
+                    proc.wait(timeout=min(10.0, max(1.0, remaining())))
                     break
                 except subprocess.TimeoutExpired:
                     pass
+                _snapshot_if_new()
                 hb_phase, hb_age = heartbeat_state()
                 stuck = (hb_phase in CONFIGS and hb_age is not None
                          and hb_age > CONFIGS[hb_phase][2] + 600.0)
@@ -860,26 +995,8 @@ def main():
     # If nothing measured, keep the documented BERT label with value null.
     # A number from a small-size retry is reported but LABELED as such so
     # no cross-round comparison mistakes it for the full config.
-    cfg_name, ref_key, metric, unit = _HEADLINE_CANDIDATES[0]
-    value = None
-    for cn, key, m, u in _HEADLINE_CANDIDATES:
-        if details.get(key):
-            cfg_name, ref_key, metric, unit = cn, key, m, u
-            value = details[key]
-            break
-    if value and (details.get(cfg_name + "_small") or small_all):
-        metric += " [small-config fallback]"
-    baseline = _publish_baseline(details, cfg_name, ref_key, value)
-
-    _emit({
-        "metric": metric,
-        "value": round(value, 1) if value else None,
-        "unit": unit,
-        "vs_baseline": round(baseline, 3) if (value and baseline is not None)
-        else None,
-        **{k: (round(v, 4) if isinstance(v, float) else v)
-           for k, v in details.items()},
-    })
+    payload, value = _build_payload(details, small_all, publish=True)
+    _emit_final(payload)
     if value is None:
         raise SystemExit(1)  # a numberless bench must look like failure
 
@@ -911,5 +1028,12 @@ if __name__ == "__main__":
         except SystemExit:
             raise
         except BaseException as e:  # noqa: BLE001 — the JSON line must print
-            _emit(_error_payload(f"{type(e).__name__}: {e}"))
+            payload = _error_payload(f"{type(e).__name__}: {e}")
+            if _PARTIAL_HOOK[0] is not None:
+                try:  # merge whatever reached disk before the exception
+                    payload, _ = _PARTIAL_HOOK[0]("error")
+                    payload["error"] = f"{type(e).__name__}: {e}"[:300]
+                except Exception:  # noqa: BLE001
+                    payload = _error_payload(f"{type(e).__name__}: {e}")
+            _emit_final(payload)
             raise SystemExit(1)

@@ -5,8 +5,10 @@ Real subprocesses, no TPU, seconds-fast.
 """
 import json
 import os
+import signal
 import subprocess
 import sys
+import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 HERE = os.path.join(REPO, "tests")
@@ -39,6 +41,48 @@ def test_orchestrator_survives_crash_and_errors(tmp_path):
     assert "error_error" in payload, payload
     # one crash -> exactly one respawn
     assert payload.get("runner_crash_rc") == 3
+
+
+def test_sigterm_mid_run_still_prints_partial_json(tmp_path):
+    """The driver's timeout SIGTERMs bench.py mid-run (r04: rc=124 with
+    an empty tail lost a successful probe). Everything measured so far
+    must still reach stdout as a parseable JSON line."""
+    (tmp_path / "fake_sleeper.py").write_text(
+        "import time\n"
+        "def _lenet():\n    return {'lenet_imgs_per_sec': 111.0}\n"
+        "def _sleeper():\n    time.sleep(300)\n    return {'slept': True}\n"
+        "CONFIGS = {'lenet': (_lenet, {}, 60),\n"
+        "           'sleeper': (_sleeper, {}, 600)}\n")
+    env = dict(os.environ)
+    env["BENCH_CONFIGS_MODULE"] = "fake_sleeper"
+    env["PYTHONPATH"] = str(tmp_path) + os.pathsep + env.get("PYTHONPATH", "")
+    env["BENCH_FORCE_CPU"] = "1"
+    state_dir = tmp_path / "state"
+    env["BENCH_STATE_DIR"] = str(state_dir)
+    env["BENCH_DEADLINE_S"] = "600"
+    proc = subprocess.Popen(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        env=env, cwd=REPO, stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+    try:
+        deadline = time.time() + 120
+        while time.time() < deadline and not (state_dir / "lenet.json").exists():
+            time.sleep(0.5)
+        assert (state_dir / "lenet.json").exists(), "lenet never finished"
+        time.sleep(12.0)  # one poll tick: the lenet snapshot line emits
+        proc.send_signal(signal.SIGTERM)
+        out, _ = proc.communicate(timeout=60)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.communicate()
+    lines = [ln for ln in out.decode().splitlines() if ln.startswith("{")]
+    assert lines, out
+    payload = json.loads(lines[-1])
+    # the completed config survived the kill into the tail line
+    assert payload["lenet_imgs_per_sec"] == 111.0, payload
+    assert payload["partial"] == "sigterm", payload
+    # the snapshot stream also emitted an earlier line when lenet landed
+    assert len(lines) >= 2, lines
 
 
 def test_orchestrator_exits_nonzero_without_headline(tmp_path):

@@ -96,9 +96,11 @@ def _chip_peak_flops():
 # --------------------------------------------------------------------------
 
 def _step_flops(compiled, params, batch, seq):
-    """(flops_per_step, source): XLA cost analysis, or the analytic
-    transformer estimate 6*params*tokens when unavailable (the tunnel
-    backend may not expose cost analysis)."""
+    """(flops_xla or None, flops_analytic): XLA cost analysis alongside
+    the analytic transformer estimate 6*params*tokens — BOTH are
+    recorded so the fallback's error vs the real compile is measurable
+    (round-5 verdict #5); the tunnel backend may not expose cost
+    analysis, in which case only the analytic number exists."""
     try:
         cost = compiled.cost_analysis()
         if isinstance(cost, (list, tuple)):
@@ -106,10 +108,24 @@ def _step_flops(compiled, params, batch, seq):
         flops = float(cost.get("flops", 0)) if cost else 0.0
     except Exception:  # noqa: BLE001 — cost analysis optional per backend
         flops = 0.0
-    if flops > 0:
-        return flops, "xla"
     n_params = sum(int(np.prod(v.shape)) for v in params.values())
-    return 6.0 * n_params * batch * seq, "analytic"
+    return (flops if flops > 0 else None), 6.0 * n_params * batch * seq
+
+
+def _mfu_fields(prefix, flops_xla, flops_analytic, step_s):
+    """MFU from both FLOP sources + their ratio, against the chip peak."""
+    peak = _chip_peak_flops()
+    out = {}
+    if not peak or step_s <= 0:
+        return out
+    out[prefix + "_mfu_analytic"] = flops_analytic / step_s / peak
+    if flops_xla:
+        out[prefix + "_mfu_xla"] = flops_xla / step_s / peak
+        out[prefix + "_flops_xla_vs_analytic"] = flops_xla / flops_analytic
+    out[prefix + "_mfu"] = out.get(prefix + "_mfu_xla",
+                                   out[prefix + "_mfu_analytic"])
+    out[prefix + "_mfu_source"] = "xla" if flops_xla else "analytic"
+    return out
 
 
 def bench_bert(batch=32, seq=128, steps=30, warmup=5):
@@ -170,7 +186,7 @@ def bench_bert(batch=32, seq=128, steps=30, warmup=5):
     # any shaped tensor (static `2x...` or dynamic `?x...`) ends in `xf64`
     f64_free = not re.search(r"tensor<[^>]*xf64>", lowered.as_text())
     compiled = lowered.compile()
-    step_flops, mfu_source = _step_flops(compiled, params, batch, seq)
+    flops_xla, flops_analytic = _step_flops(compiled, params, batch, seq)
 
     for _ in range(warmup):
         params, states, loss = jit_step(params, states, ids, labels)
@@ -186,11 +202,7 @@ def bench_bert(batch=32, seq=128, steps=30, warmup=5):
         "bert_loss": float(loss),
         "f64_free": f64_free,
     }
-    peak = _chip_peak_flops()
-    if step_flops > 0 and peak:
-        # MFU = model FLOPs per step / step time / chip peak bf16 FLOPs
-        out["bert_mfu"] = (step_flops / (dt / steps)) / peak
-        out["bert_mfu_source"] = mfu_source
+    out.update(_mfu_fields("bert", flops_xla, flops_analytic, dt / steps))
     return out
 
 
@@ -236,7 +248,7 @@ def bench_gpt(batch=8, seq=512, steps=20, warmup=3):
     ids = jnp.asarray(rng.randint(0, cfg.vocab_size, (batch, seq)))
     labels = jnp.asarray(rng.randint(0, cfg.vocab_size, (batch, seq)))
     compiled = jit_step.lower(params, states, ids, labels).compile()
-    step_flops, mfu_source = _step_flops(compiled, params, batch, seq)
+    flops_xla, flops_analytic = _step_flops(compiled, params, batch, seq)
     for _ in range(warmup):
         params, states, loss = jit_step(params, states, ids, labels)
     _sync(loss)
@@ -248,10 +260,7 @@ def bench_gpt(batch=8, seq=512, steps=20, warmup=3):
     out = {"gpt_tokens_per_sec": steps * batch * seq / dt,
            "gpt_step_ms": dt / steps * 1e3,
            "gpt_loss": float(loss)}
-    peak = _chip_peak_flops()
-    if step_flops > 0 and peak:
-        out["gpt_mfu"] = (step_flops / (dt / steps)) / peak
-        out["gpt_mfu_source"] = mfu_source
+    out.update(_mfu_fields("gpt", flops_xla, flops_analytic, dt / steps))
     return out
 
 
@@ -318,10 +327,14 @@ def bench_lenet(batch=256, steps=30, warmup=3):
     return {"lenet_imgs_per_sec": steps * batch / dt}
 
 
-def bench_generate(batch=8, prompt=32, new_tokens=96, eager_tokens=8):
-    """Jitted static-KV decode throughput (GPT-2 small, greedy) vs a naive
-    eager re-forward decode — the A/B that justifies the prefill/decode
-    executables (models/gpt.py)."""
+def bench_generate(batches=(1, 8), prompt=32, new_tokens=96,
+                   eager_tokens=8):
+    """Jitted static-KV decode throughput (GPT-2 small, greedy) at batch
+    1 and 8 with a prefill/decode split, vs a naive eager re-forward
+    decode — the A/B that justifies the prefill/decode executables
+    (models/gpt.py). The split: a max_new_tokens=1 run times
+    prefill(+1 step); subtracting it from the full run isolates the
+    per-token decode cost."""
     import jax.numpy as jnp
 
     import paddle_tpu as paddle
@@ -333,15 +346,33 @@ def bench_generate(batch=8, prompt=32, new_tokens=96, eager_tokens=8):
     paddle.amp.decorate(model, level="O2")
     model.eval()
     rng = np.random.RandomState(0)
+    res = {}
+    batches = tuple(batches)
+    for bsz in batches:
+        ids = paddle.to_tensor(rng.randint(0, 50304, (bsz, prompt)))
+        out = model.generate(ids, max_new_tokens=new_tokens)  # compile
+        _sync(out._value)
+        o1 = model.generate(ids, max_new_tokens=1)  # compile short arm
+        _sync(o1._value)
+        t0 = time.perf_counter()
+        o1 = model.generate(ids, max_new_tokens=1)
+        _sync(o1._value)
+        t_one = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        out = model.generate(ids, max_new_tokens=new_tokens)
+        _sync(out._value)
+        t_full = time.perf_counter() - t0
+        res[f"decode_b{bsz}_prefill_ms"] = t_one * 1e3  # prefill + 1 step
+        if new_tokens > 1:
+            per_tok = max(t_full - t_one, 1e-9) / (new_tokens - 1)
+            res[f"decode_b{bsz}_ms_per_token"] = per_tok * 1e3
+            res[f"decode_b{bsz}_tokens_per_sec"] = bsz / per_tok
+    # legacy keys = the largest batch's steady-state decode rate
+    # (prefill excluded — the split keys above carry it)
+    batch = batches[-1]
     ids = paddle.to_tensor(rng.randint(0, 50304, (batch, prompt)))
-    out = model.generate(ids, max_new_tokens=new_tokens)  # compile
-    _sync(out._value)
-    t0 = time.perf_counter()
-    out = model.generate(ids, max_new_tokens=new_tokens)
-    _sync(out._value)
-    dt = time.perf_counter() - t0
-    res = {"decode_tokens_per_sec": batch * new_tokens / dt,
-           "decode_ms_per_token": dt / new_tokens * 1e3}
+    res["decode_tokens_per_sec"] = res.get(f"decode_b{batch}_tokens_per_sec")
+    res["decode_ms_per_token"] = res.get(f"decode_b{batch}_ms_per_token")
 
     # eager baseline: full re-forward per token, no KV cache, argmax on
     # host — what generate() would cost without the static-KV design.
@@ -525,6 +556,65 @@ def bench_dataloader(n=512, batch=64, shape=(3, 224, 224), epochs=3):
     return res
 
 
+def bench_tpu_correctness(**kw):
+    """On-device correctness for the perf-path kernels (flash fwd/bwd,
+    tilings, ring attention, blockwise CE, int8 MXU) vs host float64 /
+    on-device XLA oracles — the hardware evidence the CPU/interpret
+    tests cannot give (paddle_tpu/testing/tpu_checks.py; also exposed
+    as the @pytest.mark.tpu suite)."""
+    from paddle_tpu.testing.tpu_checks import run_tpu_checks
+
+    return run_tpu_checks(**kw)
+
+
+def bench_flash_tiling(batch=4, heads=12, dim=64, seqs=(512, 2048),
+                       blocks=(128, 256, 512), iters=20):
+    """Flash-attention block-tiling sweep, bf16 fwd+bwd — picks the
+    measured per-seq winner so dispatch defaults come from data, not
+    guesses (round-5 verdict #4). Exactness across these tilings is
+    already pinned by tests; this measures them."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.ops.pallas.flash_attention import flash_attention_raw
+
+    rng = np.random.RandomState(0)
+    res = {}
+    for seq in seqs:
+        q, k, v = (jnp.asarray(rng.randn(batch * heads, seq, dim)
+                               .astype(np.float32), jnp.bfloat16)
+                   for _ in range(3))
+        best = None
+        for bq in blocks:
+            for bk in blocks:
+                if seq % bq or seq % bk:
+                    continue
+
+                def loss(qq, kk, vv, bq=bq, bk=bk):
+                    o = flash_attention_raw(qq, kk, vv, True,
+                                            block_q=bq, block_k=bk)
+                    return (o.astype(jnp.float32) ** 2).mean()
+
+                key = f"tiling_s{seq}_q{bq}_k{bk}"
+                try:
+                    g = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+                    _sync(g(q, k, v))
+                    t0 = time.perf_counter()
+                    for _ in range(iters):
+                        out = g(q, k, v)
+                    _sync(out)
+                    ms = (time.perf_counter() - t0) / iters * 1e3
+                    res[key + "_ms"] = ms
+                    if best is None or ms < best[0]:
+                        best = (ms, bq, bk)
+                except Exception as e:  # noqa: BLE001 — sweep continues
+                    res[key + "_error"] = str(e)[:160]
+        if best is not None:
+            res[f"tiling_s{seq}_best"] = f"q{best[1]}_k{best[2]}"
+            res[f"tiling_s{seq}_best_ms"] = best[0]
+    return res
+
+
 # name -> (fn, small_kwargs, full_cost_estimate_s). Order is the RUN
 # order: lenet first as a cheap sanity probe of real execution, then the
 # BERT headline — with one patient runner writing results incrementally,
@@ -534,9 +624,15 @@ CONFIGS = {
     "lenet": (bench_lenet, {"batch": 8, "steps": 2, "warmup": 1}, 420),
     "bert": (bench_bert, {"batch": 2, "seq": 32, "steps": 2, "warmup": 1},
              900),
+    "tpu_correctness": (bench_tpu_correctness,
+                        {"seq": 128, "dim": 64, "bh": 2, "vocab": 512,
+                         "hidden": 64, "n": 64}, 600),
     "flash_attention": (bench_flash_attention,
                         {"batch": 1, "heads": 2, "seq": 128, "iters": 2},
                         600),
+    "flash_tiling": (bench_flash_tiling,
+                     {"batch": 1, "heads": 2, "seqs": (256,),
+                      "blocks": (128, 256), "iters": 2}, 900),
     "blockwise_ce": (bench_blockwise_ce,
                      {"n": 64, "hidden": 32, "vocab": 512, "iters": 2}, 480),
     "int8": (bench_int8, {"m": 256, "k": 256, "n": 256, "iters": 3}, 300),
@@ -545,8 +641,8 @@ CONFIGS = {
     "gpt": (bench_gpt, {"batch": 1, "seq": 32, "steps": 1, "warmup": 1},
             900),
     "generate": (bench_generate,
-                 {"batch": 1, "prompt": 4, "new_tokens": 4,
-                  "eager_tokens": 2}, 600),
+                 {"batches": (1,), "prompt": 4, "new_tokens": 4,
+                  "eager_tokens": 2}, 700),
 }
 
 # test hook: BENCH_CONFIGS_MODULE names a module whose CONFIGS replaces

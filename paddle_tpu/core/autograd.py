@@ -22,6 +22,7 @@ from __future__ import annotations
 import collections
 import contextlib
 import threading
+import types
 
 import jax
 import jax.numpy as jnp
@@ -107,6 +108,29 @@ def _is_tensor(x):
 
 _BWD_CACHE_CAP = 512
 _bwd_cache = collections.OrderedDict()  # LRU: key -> jitted backward
+# grad-enabled state is threading.local, so backward() may run on several
+# threads at once; the LRU's get/move_to_end/popitem must not race
+_bwd_cache_lock = threading.Lock()
+
+
+def _freeze_closure(fn):
+    """A copy of `fn` with its closure cells snapshotted NOW: the tape's
+    pullback re-runs the forward at backward() time, so a captured
+    variable rebound between forward and backward would silently change
+    the recomputed gradient (round-4 advisor finding). Rebinding is
+    frozen here; in-place mutation of a captured OBJECT (and globals)
+    remains the caller's purity obligation."""
+    cells = getattr(fn, "__closure__", None)
+    if not cells or not isinstance(fn, types.FunctionType):
+        return fn
+    try:
+        frozen = tuple(types.CellType(c.cell_contents) for c in cells)
+    except ValueError:  # an empty (yet-unbound) cell — leave live
+        return fn
+    g = types.FunctionType(fn.__code__, fn.__globals__, fn.__name__,
+                           fn.__defaults__, frozen)
+    g.__kwdefaults__ = fn.__kwdefaults__
+    return g
 
 
 def _subst_call(fn, treedef, diff_pos, base_vals):
@@ -167,7 +191,10 @@ def _make_pullback(fn, vals, treedef, diff_pos, out_treedef):
             hash(key)
         except (TypeError, AttributeError):
             return _eager(cot_tree)
-        bwd = _bwd_cache.get(key)
+        with _bwd_cache_lock:
+            bwd = _bwd_cache.get(key)
+            if bwd is not None:
+                _bwd_cache.move_to_end(key)
         if bwd is None:
             statics_map = dict(statics)
 
@@ -183,11 +210,10 @@ def _make_pullback(fn, vals, treedef, diff_pos, out_treedef):
                                                          list(cots)))
 
             bwd = jax.jit(bwd_fn)
-            _bwd_cache[key] = bwd
-            if len(_bwd_cache) > _BWD_CACHE_CAP:
-                _bwd_cache.popitem(last=False)
-        else:
-            _bwd_cache.move_to_end(key)
+            with _bwd_cache_lock:
+                _bwd_cache[key] = bwd
+                if len(_bwd_cache) > _BWD_CACHE_CAP:
+                    _bwd_cache.popitem(last=False)
         return bwd([vals[i] for i in arr_pos], list(cot_leaves))
 
     return pullback
@@ -195,7 +221,14 @@ def _make_pullback(fn, vals, treedef, diff_pos, out_treedef):
 
 def apply(fn, *args, **kwargs):
     """Run `fn` (a pure jnp/lax function) over args, unwrapping Tensors and
-    recording a GradNode when any differentiable Tensor participates."""
+    recording a GradNode when any differentiable Tensor participates.
+
+    `fn`'s closure cells are snapshotted HERE so both backward paths
+    (deferred pullback AND create_graph's `closed`) recompute the
+    forward the tape recorded, even if a captured variable is rebound
+    before backward(); globals and in-place mutation of captured
+    objects remain fn's purity obligation."""
+    fn = _freeze_closure(fn)
     flat, treedef = jax.tree_util.tree_flatten((args, kwargs), is_leaf=_is_tensor)
     vals = [a._value if _is_tensor(a) else a for a in flat]
     if _amp_hook is not None and _amp_hook[0]():
@@ -347,6 +380,10 @@ def run_backward(tensors, grad_tensors=None, retain_graph=False,
         if not any(k in cots for k in keyed):
             continue
         cot_leaves = [cots.pop(k, None) for k in keyed]
+        # which outputs carried a REAL cotangent (before zero-filling):
+        # a requested intermediate on a zero-filled sibling output must
+        # report unused (None), not a synthesized zeros tensor
+        cot_present = [c is not None for c in cot_leaves]
         cot_leaves = [
             c if c is not None else _zero_cot(s)
             for c, s in zip(cot_leaves, node.out_structs)
@@ -360,6 +397,8 @@ def run_backward(tensors, grad_tensors=None, retain_graph=False,
             ]
         if want_inter:
             for i, c in enumerate(cot_leaves):
+                if not cot_present[i]:
+                    continue
                 for t in want_inter.get((id(node), i), ()):
                     input_grads[id(t)] = c
         if node.pullback is None and node.closed is None:

@@ -159,8 +159,15 @@ class _ControlFlowTransformer(ast.NodeTransformer):
 
         Escapes (break/continue/return) keep the original For: the
         desugared body would run `continue` WITHOUT the index increment.
-        Known divergence: an empty range leaves the loop var bound to
-        `start` here, where Python leaves it unbound."""
+
+        An INTERNAL counter drives the loop; the target is assigned from
+        it at the top of each pass, so after a non-empty loop the target
+        holds the last yielded value (start+(n-1)*step), matching
+        Python — not one-past-the-end — and a body that reassigns the
+        loop var still iterates the full range (the counter, not the
+        target, is carried). Known divergence: an empty range leaves
+        the loop var bound to `start` here, where Python leaves it
+        unbound."""
         self.generic_visit(node)
         it = node.iter
         if (node.orelse or not isinstance(node.target, ast.Name)
@@ -172,11 +179,6 @@ class _ControlFlowTransformer(ast.NodeTransformer):
                 or _has_escape(node.body)):
             return node
         target = node.target.id
-        if target in _assigned_names(node.body):
-            # a body that reassigns the loop var relies on the iterator
-            # resetting it each pass — the carried-increment desugar
-            # would change the iteration count; keep Python semantics
-            return node
         uid = self._uid()
         if len(it.args) == 1:
             start, stop = ast.Constant(value=0), it.args[0]
@@ -184,6 +186,7 @@ class _ControlFlowTransformer(ast.NodeTransformer):
         else:
             start, stop = it.args[0], it.args[1]
             step = it.args[2] if len(it.args) == 3 else ast.Constant(value=1)
+        idx_n = f"__jst_fidx_{uid}"
         stop_n, step_n = f"__jst_fstop_{uid}", f"__jst_fstep_{uid}"
         # one validating call also keeps range()'s left-to-right argument
         # evaluation order and its TypeError/ValueError contract
@@ -194,22 +197,30 @@ class _ControlFlowTransformer(ast.NodeTransformer):
         pre = [
             ast.Assign(targets=[ast.Tuple(
                 elts=[ast.Name(id=n, ctx=ast.Store())
-                      for n in (target, stop_n, step_n)],
+                      for n in (idx_n, stop_n, step_n)],
                 ctx=ast.Store())], value=args_call),
+            # binds the target pre-loop so the while carry is well-typed
+            # (and documents the empty-range divergence: target = start)
+            ast.Assign(targets=[ast.Name(id=target, ctx=ast.Store())],
+                       value=ast.Name(id=idx_n, ctx=ast.Load())),
         ]
         test = ast.Call(
             func=ast.Attribute(value=ast.Name(id=_HELPER, ctx=ast.Load()),
                                attr="range_cond", ctx=ast.Load()),
-            args=[ast.Name(id=target, ctx=ast.Load()),
+            args=[ast.Name(id=idx_n, ctx=ast.Load()),
                   ast.Name(id=stop_n, ctx=ast.Load()),
                   ast.Name(id=step_n, ctx=ast.Load())],
             keywords=[])
-        bump = ast.Assign(
+        set_target = ast.Assign(
             targets=[ast.Name(id=target, ctx=ast.Store())],
-            value=ast.BinOp(left=ast.Name(id=target, ctx=ast.Load()),
+            value=ast.Name(id=idx_n, ctx=ast.Load()))
+        bump = ast.Assign(
+            targets=[ast.Name(id=idx_n, ctx=ast.Store())],
+            value=ast.BinOp(left=ast.Name(id=idx_n, ctx=ast.Load()),
                             op=ast.Add(),
                             right=ast.Name(id=step_n, ctx=ast.Load())))
-        loop = ast.While(test=test, body=node.body + [bump], orelse=[])
+        loop = ast.While(test=test, body=[set_target] + node.body + [bump],
+                         orelse=[])
         out = self.visit_While(loop)
         return pre + (out if isinstance(out, list) else [out])
 

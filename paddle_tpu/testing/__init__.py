@@ -1,0 +1,1 @@
+"""paddle_tpu.testing — on-device validation utilities (tpu_checks)."""

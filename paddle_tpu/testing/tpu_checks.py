@@ -1,0 +1,212 @@
+"""On-device correctness checks for the perf-path kernels.
+
+The reference's fused GPU kernels are proven on their hardware by unit
+tests (e.g. /root/reference/paddle/phi/kernels/gpu/cross_entropy_kernel.cu
+exercised through the softmax_with_cross_entropy op tests); this module is
+the TPU analogue for the kernels this framework's perf story rests on:
+Pallas flash attention (fwd + bwd), ring attention, the blockwise fused
+LM-head CE, and int8 MXU matmul. CPU/interpret-mode tests pin the math;
+these checks pin the LOWERED kernels on the live backend (non-interpret
+Mosaic), where tiling, VMEM layout, and MXU precision are real.
+
+Two consumers, one implementation:
+  * bench.py's `tpu_correctness` config runs it while the bench client
+    holds the chip grant (results land in the bench JSON);
+  * tests/test_tpu_correctness.py wraps it as a @pytest.mark.tpu suite
+    that auto-skips off-TPU.
+
+The oracle is host numpy float64 — independent of the device under test.
+f32 tolerances absorb the MXU's f32 matmul path (bf16-multiplier passes);
+kernel-vs-kernel comparisons (block tilings) are near-exact.
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+__all__ = ["run_tpu_checks"]
+
+
+def _np_attention(q, k, v, causal=False, kv_mask=None):
+    """float64 host oracle: softmax(q.k^T/sqrt(d) [+masks]).v"""
+    q, k, v = (np.asarray(x, np.float64) for x in (q, k, v))
+    s = np.einsum("bqd,bkd->bqk", q, k) / math.sqrt(q.shape[-1])
+    if causal:
+        ql, kl = s.shape[-2], s.shape[-1]
+        s = np.where(np.tril(np.ones((ql, kl), bool)), s, -1e30)
+    if kv_mask is not None:  # [b, kl] 1=keep
+        s = np.where(np.asarray(kv_mask, bool)[:, None, :], s, -1e30)
+    s = s - s.max(axis=-1, keepdims=True)
+    p = np.exp(s)
+    p = p / p.sum(axis=-1, keepdims=True)
+    return np.einsum("bqk,bkd->bqd", p, v)
+
+
+def _max_err(a, b):
+    return float(np.max(np.abs(np.asarray(a, np.float64)
+                               - np.asarray(b, np.float64))))
+
+
+def run_tpu_checks(seq=256, dim=64, bh=8, vocab=8192, hidden=256, n=512):
+    """Execute every check on the CURRENT jax backend; returns a flat
+    dict of `tpu_check_*` floats plus pass booleans and an overall
+    `tpu_checks_passed`. Never raises: a check that errors records the
+    exception and fails the overall flag (one broken kernel must not
+    hide the other kernels' evidence)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..ops.blockwise_ce import blockwise_softmax_ce
+    from ..ops.pallas.flash_attention import flash_attention_raw
+
+    out = {"tpu_checks_backend": jax.default_backend()}
+    passed = []
+
+    def check(name, fn, tol=None):
+        try:
+            err = fn()
+            out[f"tpu_check_{name}_err"] = err
+            ok = (err <= tol) if tol is not None else bool(err == 0.0)
+            out[f"tpu_check_{name}_ok"] = ok
+            passed.append(ok)
+        except Exception as e:  # noqa: BLE001 — record, keep checking
+            out[f"tpu_check_{name}_error"] = f"{type(e).__name__}: {e}"[:200]
+            passed.append(False)
+
+    rng = np.random.RandomState(0)
+    qn, kn, vn = (rng.randn(bh, seq, dim).astype(np.float32)
+                  for _ in range(3))
+    q, k, v = (jnp.asarray(x) for x in (qn, kn, vn))
+    oracle_causal = _np_attention(qn, kn, vn, causal=True)
+    oracle_plain = _np_attention(qn, kn, vn, causal=False)
+
+    # --- flash attention forward, f32 and bf16, causal and plain -------
+    # f32 tol: MXU f32 matmuls run as bf16-multiplier passes (~1e-3 rel);
+    # unit-variance inputs keep outputs O(1) so max-abs tracks rel err.
+    check("flash_f32_causal",
+          lambda: _max_err(jax.jit(flash_attention_raw,
+                                   static_argnums=3)(q, k, v, True),
+                           oracle_causal), tol=5e-3)
+    check("flash_f32_plain",
+          lambda: _max_err(jax.jit(flash_attention_raw,
+                                   static_argnums=3)(q, k, v, False),
+                           oracle_plain), tol=5e-3)
+    check("flash_bf16_causal",
+          lambda: _max_err(
+              jax.jit(flash_attention_raw, static_argnums=3)(
+                  q.astype(jnp.bfloat16), k.astype(jnp.bfloat16),
+                  v.astype(jnp.bfloat16), True).astype(jnp.float32),
+              oracle_causal), tol=6e-2)
+
+    # --- flash with key-padding mask ----------------------------------
+    kvm_n = (rng.rand(bh, seq) > 0.25).astype(np.float32)
+    kvm_n[:, 0] = 1.0  # no fully-masked rows
+    check("flash_masked",
+          lambda: _max_err(
+              flash_attention_raw(q, k, v, False,
+                                  kv_mask=jnp.asarray(kvm_n)),
+              _np_attention(qn, kn, vn, causal=False, kv_mask=kvm_n)),
+          tol=5e-3)
+
+    # --- flash backward: custom-vjp kernel vs XLA autodiff -------------
+    # grads of mean(out^2) through the Pallas split dq/dkv backward vs
+    # jax.grad through a plain XLA attention on the same device — the
+    # kernel-vs-XLA comparison, sharing the hardware's matmul precision
+    # so the tolerance isolates the kernel math itself.
+    def _xla_attn_dev(qq, kk, vv, causal):
+        s = jnp.einsum("bqd,bkd->bqk", qq, kk) / math.sqrt(qq.shape[-1])
+        if causal:
+            ql, kl = s.shape[-2], s.shape[-1]
+            s = jnp.where(jnp.tril(jnp.ones((ql, kl), bool)), s, -1e30)
+        p = jax.nn.softmax(s.astype(jnp.float32), axis=-1).astype(qq.dtype)
+        return jnp.einsum("bqk,bkd->bqd", p, vv)
+
+    def _grad_err():
+        def flash_loss(qq, kk, vv):
+            return (flash_attention_raw(qq, kk, vv, True) ** 2).mean()
+
+        def xla_loss(qq, kk, vv):
+            return (_xla_attn_dev(qq, kk, vv, True) ** 2).mean()
+
+        gf = jax.jit(jax.grad(flash_loss, argnums=(0, 1, 2)))(q, k, v)
+        gx = jax.jit(jax.grad(xla_loss, argnums=(0, 1, 2)))(q, k, v)
+        return max(_max_err(a, b) for a, b in zip(gf, gx))
+
+    check("flash_bwd_vs_xla", _grad_err, tol=5e-3)
+
+    # --- non-default block tilings: kernel vs kernel, near-exact -------
+    try:
+        base = np.asarray(jax.jit(flash_attention_raw,
+                                  static_argnums=3)(q, k, v, True))
+    except Exception as e:  # noqa: BLE001 — later checks must still run
+        out["tpu_check_flash_tiling_error"] = (
+            f"{type(e).__name__}: {e}"[:200])
+        passed.append(False)
+        base = None
+    if base is not None:
+        for bq, bk in ((128, 256), (256, 128), (256, 256)):
+            if seq % bq or seq % bk:
+                continue
+            check(f"flash_tiling_q{bq}_k{bk}",
+                  lambda bq=bq, bk=bk: _max_err(
+                      flash_attention_raw(q, k, v, True,
+                                          block_q=bq, block_k=bk), base),
+                  tol=2e-5)
+
+    # --- ring attention over a 1-chip mesh vs host oracle --------------
+    # single-chip: the ring has one hop, which still exercises the
+    # shard_map + ppermute + scan lowering on real hardware (the full
+    # multi-hop parity is pinned on the 8-device CPU mesh).
+    def _ring_err():
+        from jax.sharding import Mesh
+
+        from ..distributed.sequence_parallel import ring_attention
+
+        mesh = Mesh(np.array(jax.devices()[:1]), ("sp",))
+        o = ring_attention(jnp.asarray(qn[None]), jnp.asarray(kn[None]),
+                           jnp.asarray(vn[None]), mesh=mesh, causal=True)
+        return _max_err(np.asarray(o)[0], oracle_causal)
+
+    check("ring_causal", _ring_err, tol=5e-3)
+
+    # --- blockwise fused LM-head CE: value + grads vs naive-on-device --
+    hn = (rng.randn(n, hidden) * 0.02).astype(np.float32)
+    wn = (rng.randn(vocab, hidden) * 0.02).astype(np.float32)
+    yn = rng.randint(0, vocab, n)
+    h, w, y = jnp.asarray(hn), jnp.asarray(wn), jnp.asarray(yn)
+
+    def _naive(hh, ww):
+        logits = hh @ ww.T
+        logz = jax.scipy.special.logsumexp(logits, axis=-1)
+        picked = jnp.take_along_axis(logits, y[:, None], axis=-1)[:, 0]
+        return (logz - picked).mean()
+
+    check("blockwise_ce_value",
+          lambda: _max_err(blockwise_softmax_ce(h, w, y, block=2048),
+                           _naive(h, w)), tol=1e-4)
+
+    def _ce_grad_err():
+        gf = jax.jit(jax.grad(
+            lambda hh, ww: blockwise_softmax_ce(hh, ww, y, block=2048),
+            argnums=(0, 1)))
+        gn = jax.jit(jax.grad(_naive, argnums=(0, 1)))
+        return max(_max_err(a, b) for a, b in zip(gf(h, w), gn(h, w)))
+
+    check("blockwise_ce_grad", _ce_grad_err, tol=1e-4)
+
+    # --- int8 MXU matmul: bit-exact vs host int32 ----------------------
+    a8 = rng.randint(-127, 127, (256, 256), dtype=np.int8)
+    b8 = rng.randint(-127, 127, (256, 256), dtype=np.int8)
+    check("int8_matmul_exact",
+          lambda: float(np.max(np.abs(
+              np.asarray(jax.lax.dot_general(
+                  jnp.asarray(a8), jnp.asarray(b8),
+                  (((1,), (0,)), ((), ())),
+                  preferred_element_type=jnp.int32))
+              - a8.astype(np.int32) @ b8.astype(np.int32)))))
+
+    out["tpu_checks_passed"] = bool(passed) and all(passed)
+    out["tpu_checks_total"] = len(passed)
+    out["tpu_checks_failed"] = int(sum(1 for p in passed if not p))
+    return out

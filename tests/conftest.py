@@ -7,21 +7,35 @@ any backend initializes.
 """
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-flags = os.environ.get("XLA_FLAGS", "")
-if "--xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8").strip()
+# PADDLE_TPU_TEST_REAL_CHIP=1 leaves the live (axon TPU) backend in place
+# for the @pytest.mark.tpu suite (`-m tpu`); everything else runs on the
+# virtual 8-device CPU mesh. x64 stays off on the real chip — TPUs have
+# no f64 and the tpu-marked checks are written for 32-bit.
+_REAL_CHIP = os.environ.get("PADDLE_TPU_TEST_REAL_CHIP") == "1"
+
+if not _REAL_CHIP:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "--xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
 
 import jax  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
-# int64/float64 parity vs numpy references: tests opt in to x64 (the library
-# itself no longer enables it globally — round-2 verdict weak #3)
-jax.config.update("jax_enable_x64", True)
+if not _REAL_CHIP:
+    jax.config.update("jax_platforms", "cpu")
+    # int64/float64 parity vs numpy references: tests opt in to x64 (the
+    # library itself no longer enables it globally — round-2 verdict weak #3)
+    jax.config.update("jax_enable_x64", True)
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "tpu: needs a real TPU chip "
+        "(run with PADDLE_TPU_TEST_REAL_CHIP=1 -m tpu)")
 
 
 @pytest.fixture(autouse=True)

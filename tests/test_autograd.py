@@ -257,3 +257,49 @@ def test_register_hook_reference_contract_corners():
     h2.remove()  # idempotent; must NOT delete the "c" hook
     (t * 1.0).sum().backward()
     assert calls == ["a", "c"], calls
+
+
+def test_unused_sibling_output_reports_none():
+    """A requested intermediate on a multi-output node whose out_idx got
+    NO gradient must report unused (None / allow_unused error), not a
+    synthesized zeros tensor (round-4 advisor finding)."""
+    x = t(np.arange(6, dtype=np.float32).reshape(2, 3))
+    a, b, c = paddle.split(x, 3, axis=1)
+    loss = a.sum()  # only output 0 carries gradient
+    (g_b,) = paddle.grad(loss, [b], retain_graph=True, allow_unused=True)
+    assert g_b is None
+    with pytest.raises(RuntimeError):
+        paddle.grad(loss, [b], retain_graph=True, allow_unused=False)
+    # the used sibling still gets its real gradient
+    (g_a,) = paddle.grad(loss, [a], allow_unused=False)
+    np.testing.assert_allclose(g_a.numpy(), np.ones((2, 1), np.float32))
+
+
+def test_closure_cells_frozen_at_forward_time():
+    """The deferred pullback recomputes the forward at backward() time;
+    a captured variable rebound in between must NOT change the gradient
+    (cells are snapshotted at apply() time — round-4 advisor finding)."""
+    from paddle_tpu.core.autograd import apply
+
+    x = t(np.float32(3.0))
+    scale = 2.0
+
+    def f(v):
+        return v * scale
+
+    y = apply(f, x)
+    scale = 5.0  # rebinding after the forward must be invisible
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), 2.0)
+
+    # the create_graph path (node.closed) shares the same freeze
+    x2 = t(np.float32(3.0))
+    scale2 = 2.0
+
+    def f2(v):
+        return v * scale2
+
+    y2 = apply(f2, x2)
+    scale2 = 5.0
+    (g2,) = paddle.grad(y2, [x2], create_graph=True)
+    np.testing.assert_allclose(g2.numpy(), 2.0)

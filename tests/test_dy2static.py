@@ -273,6 +273,35 @@ class TestForRangeConversion:
 
         assert float(f(paddle.to_tensor(np.float32(0.0)))) == 10 + 11 + 12
 
+    def test_loop_var_read_after_loop(self):
+        """After a non-empty loop the target holds the LAST YIELDED value
+        (start+(n-1)*step), not one-past-the-end — the counter-driven
+        desugar matches dygraph (round-4 advisor finding)."""
+        @paddle.jit.to_static
+        def f(x):
+            for i in range(3):
+                x = x + i
+            return x + i  # python: i == 2 after the loop
+
+        assert float(f(paddle.to_tensor(np.float32(0.0)))) == 5.0
+
+        @paddle.jit.to_static
+        def g(x):
+            for i in range(1, 10, 3):  # 1, 4, 7
+                x = x + i
+            return x + i  # i == 7
+
+        assert float(g(paddle.to_tensor(np.float32(0.0)))) == 19.0
+
+        @paddle.jit.to_static
+        def h(x):
+            for i in range(3):
+                i = i * 10  # reassignment: still 3 passes; i == 20 after
+                x = x + i
+            return x + i
+
+        assert float(h(paddle.to_tensor(np.float32(0.0)))) == 50.0
+
     def test_range_argument_contract(self):
         @paddle.jit.to_static
         def zero_step(x):

@@ -1,0 +1,60 @@
+"""@pytest.mark.tpu — on-chip correctness for the perf-path kernels.
+
+Auto-skips unless the live jax backend is a real TPU. To run on the
+chip: `PADDLE_TPU_TEST_REAL_CHIP=1 python -m pytest tests/ -m tpu -q`
+(the env flag stops conftest from forcing the CPU platform; never do
+this while another TPU client — e.g. bench.py — is queued or running:
+one client session at a time, per docs/PERF.md rules of engagement).
+
+bench.py's `tpu_correctness` config executes the same checks in-process
+while it holds the chip grant, so these assertions normally get their
+hardware evidence from the bench JSON rather than from pytest.
+"""
+import jax
+import pytest
+
+pytestmark = [
+    pytest.mark.tpu,
+    pytest.mark.skipif(jax.default_backend() != "tpu",
+                       reason="needs a real TPU backend"),
+]
+
+
+@pytest.fixture(scope="module")
+def checks():
+    from paddle_tpu.testing.tpu_checks import run_tpu_checks
+
+    return run_tpu_checks()
+
+
+def _assert_group(checks, prefix):
+    keys = [k for k in checks
+            if k.startswith("tpu_check_" + prefix) and k.endswith("_ok")]
+    errors = {k: checks.get(k.replace("_ok", "_err")) for k in keys
+              if not checks[k]}
+    hard = {k: v for k, v in checks.items()
+            if k.startswith("tpu_check_" + prefix) and k.endswith("_error")}
+    assert keys and not errors and not hard, (errors, hard)
+
+
+def test_flash_attention_on_chip(checks):
+    _assert_group(checks, "flash_f32")
+    _assert_group(checks, "flash_bf16")
+    _assert_group(checks, "flash_masked")
+    _assert_group(checks, "flash_bwd")
+
+
+def test_flash_tilings_on_chip(checks):
+    _assert_group(checks, "flash_tiling")
+
+
+def test_ring_attention_on_chip(checks):
+    _assert_group(checks, "ring")
+
+
+def test_blockwise_ce_on_chip(checks):
+    _assert_group(checks, "blockwise_ce")
+
+
+def test_int8_matmul_on_chip(checks):
+    _assert_group(checks, "int8")

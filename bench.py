@@ -367,12 +367,16 @@ def bench_generate(batches=(1, 8), prompt=32, new_tokens=96,
             per_tok = max(t_full - t_one, 1e-9) / (new_tokens - 1)
             res[f"decode_b{bsz}_ms_per_token"] = per_tok * 1e3
             res[f"decode_b{bsz}_tokens_per_sec"] = bsz / per_tok
+    if not batches:
+        return res
     # legacy keys = the largest batch's steady-state decode rate
-    # (prefill excluded — the split keys above carry it)
+    # (prefill excluded — the split keys above carry it); only present
+    # when the split keys exist (new_tokens > 1)
     batch = batches[-1]
+    if f"decode_b{batch}_tokens_per_sec" in res:
+        res["decode_tokens_per_sec"] = res[f"decode_b{batch}_tokens_per_sec"]
+        res["decode_ms_per_token"] = res[f"decode_b{batch}_ms_per_token"]
     ids = paddle.to_tensor(rng.randint(0, 50304, (batch, prompt)))
-    res["decode_tokens_per_sec"] = res.get(f"decode_b{batch}_tokens_per_sec")
-    res["decode_ms_per_token"] = res.get(f"decode_b{batch}_ms_per_token")
 
     # eager baseline: full re-forward per token, no KV cache, argmax on
     # host — what generate() would cost without the static-KV design.

@@ -17,8 +17,11 @@ TPU-native design — two regimes, one API:
    leading axis = group size, one slice per rank. Eager collectives run a
    real jitted shard_map program over the group's devices, so the same XLA
    collective executes on the same interconnect — the embedding is in the
-   data layout only. Tensors whose leading dim != group size are rejected
-   with a pointer to this doc.
+   data layout only. A tensor of ANY OTHER shape is accepted as REPLICATED
+   (every rank holds this same value — the single-controller reading of the
+   reference's shape-agnostic per-process semantics): all_reduce(SUM) gives
+   n*x, all_gather stacks n copies, broadcast/MAX/MIN/AVG return x — still
+   executed through the same shard_map collectives with replicated specs.
 
 send/recv are point-to-point: traced regime uses ppermute; eager pairs them
 through an in-process mailbox (single-controller has one ambient rank).
@@ -174,15 +177,19 @@ def _group_of(group):
     return group if group is not None else _get_default_group()
 
 
-def _check_stacked(v, g, opname):
-    if v.shape and v.shape[0] == g.nranks:
-        return
-    raise ValueError(
-        f"eager {opname}: expected a rank-stacked tensor with leading axis "
-        f"== group size ({g.nranks}), got shape {tuple(v.shape)}. "
-        "Single-controller eager collectives embed per-rank values "
-        "rank-major; inside shard_map pass the rank-local block instead "
-        "(see paddle_tpu.distributed.collective docstring).")
+def _is_stacked(v, g):
+    """True when `v` uses the rank-stacked embedding (leading axis ==
+    group size: one slice per rank). Any OTHER shape is treated as
+    REPLICATED — every rank holds this same value, the natural
+    single-controller reading of the reference's per-process tensors
+    (reference all_reduce is shape-agnostic:
+    python/paddle/distributed/collective.py:580) — and the collective
+    executes on a replicated-spec shard_map over the same devices, so
+    all_reduce(SUM) of x over n ranks is n*x, all_gather stacks n
+    copies, broadcast returns x. Caveat: a replicated tensor whose
+    leading dim coincidentally equals the group size is read as
+    rank-stacked; the embedding is a layout convention, not a tag."""
+    return bool(v.shape) and v.shape[0] == g.nranks
 
 
 @functools.lru_cache(maxsize=None)
@@ -225,6 +232,11 @@ def _body_all_gather(x, *, axis, static):
     return jax.lax.all_gather(x[0], axis, axis=0)[None]  # (1, n, ...)
 
 
+def _body_all_gather_rep(x, *, axis, static):
+    # replicated input: every rank contributes its (identical) copy
+    return jax.lax.all_gather(x, axis, axis=0)  # (n, ...)
+
+
 def _body_broadcast(x, *, axis, static):
     (src,) = static
     idx = jax.lax.axis_index(axis)
@@ -255,6 +267,7 @@ def _body_alltoall(x, *, axis, static):
 _EAGER_BODIES = {
     "all_reduce": _body_all_reduce,
     "all_gather": _body_all_gather,
+    "all_gather_rep": _body_all_gather_rep,
     "broadcast": _body_broadcast,
     "reduce": _body_reduce,
     "scatter": _body_scatter,
@@ -275,8 +288,7 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True,
     if _is_traced(v):
         out = apply(lambda x: _reduce_block(x, g.axes, op), tensor)
         return out
-    _check_stacked(v, g, "all_reduce")
-    spec = P(g._axis)
+    spec = P(g._axis) if _is_stacked(v, g) else P()
     res = _run_eager(g, "all_reduce", (v,), (spec,), spec, (op,))
     if isinstance(tensor, Tensor):
         tensor._value = res
@@ -294,10 +306,12 @@ def all_gather(tensor_list, tensor, group=None, sync_op=True):
     if _is_traced(v):
         return apply(lambda x: jax.lax.all_gather(x, g.axes, axis=0,
                                                   tiled=True), tensor)
-    _check_stacked(v, g, "all_gather")
-    res = _run_eager(g, "all_gather", (v,), (P(g._axis),),
-                     P(g._axis, None))  # (n, n, ...)
-    rows = res[0]
+    if _is_stacked(v, g):
+        res = _run_eager(g, "all_gather", (v,), (P(g._axis),),
+                         P(g._axis, None))  # (n, n, ...)
+        rows = res[0]
+    else:  # replicated: n identical copies, still a real ICI gather
+        rows = _run_eager(g, "all_gather_rep", (v,), (P(),), P())
     if tensor_list is not None:
         tensor_list.extend(Tensor(rows[i]) for i in range(g.nranks))
     return Tensor(rows)
@@ -320,8 +334,7 @@ def broadcast(tensor, src=0, group=None, sync_op=True):
             masked = jnp.where(idx == src, x, jnp.zeros_like(x))
             return jax.lax.psum(masked, g.axes)
         return apply(_b, tensor)
-    _check_stacked(v, g, "broadcast")
-    spec = P(g._axis)
+    spec = P(g._axis) if _is_stacked(v, g) else P()
     res = _run_eager(g, "broadcast", (v,), (spec,), spec, (src,))
     if isinstance(tensor, Tensor):
         tensor._value = res
@@ -340,9 +353,14 @@ def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
             idx = jax.lax.axis_index(g.axes)
             return jnp.where(idx == dst, red, x)
         return apply(_r, tensor)
-    _check_stacked(v, g, "reduce")
-    spec = P(g._axis)
-    res = _run_eager(g, "reduce", (v,), (spec,), spec, (op, dst))
+    if _is_stacked(v, g):
+        spec = P(g._axis)
+        res = _run_eager(g, "reduce", (v,), (spec,), spec, (op, dst))
+    else:
+        # replicated: every rank holds x, so dst's reduced view is the
+        # plain all_reduce of the copies (non-dst views are unobservable
+        # under a single controller — there is one tensor)
+        res = _run_eager(g, "all_reduce", (v,), (P(),), P(), (op,))
     if isinstance(tensor, Tensor):
         tensor._value = res
         return tensor

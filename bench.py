@@ -184,7 +184,13 @@ def bench_bert(batch=32, seq=128, steps=30, warmup=5):
     import re
 
     # any shaped tensor (static `2x...` or dynamic `?x...`) ends in `xf64`
-    f64_free = not re.search(r"tensor<[^>]*xf64>", lowered.as_text())
+    mlir_text = lowered.as_text()
+    f64_free = not re.search(r"tensor<[^>]*xf64>", mlir_text)
+    # proof the Pallas flash kernel ENGAGES in the headline config when
+    # lowered for TPU (dispatch requires backend=="tpu"; on CPU this is
+    # expected False) — round-5 verdict #9's HLO evidence, recorded in
+    # the bench JSON whenever the chip lowers the step
+    flash_in_hlo = bool(re.search(r"tpu_custom_call|mosaic", mlir_text))
     compiled = lowered.compile()
     flops_xla, flops_analytic = _step_flops(compiled, params, batch, seq)
 
@@ -201,6 +207,7 @@ def bench_bert(batch=32, seq=128, steps=30, warmup=5):
         "bert_step_ms": dt / steps * 1e3,
         "bert_loss": float(loss),
         "f64_free": f64_free,
+        "bert_flash_in_hlo": flash_in_hlo,
     }
     out.update(_mfu_fields("bert", flops_xla, flops_analytic, dt / steps))
     return out
@@ -560,6 +567,16 @@ def bench_dataloader(n=512, batch=64, shape=(3, 224, 224), epochs=3):
     return res
 
 
+def bench_bert_b64(batch=64, seq=128, steps=30, warmup=5):
+    """Batch-scaling A/B of the headline: PERF_ESTIMATES puts b32/s128
+    at arithmetic intensity ~45 FLOP/byte (bandwidth-leaning on v5e);
+    b64 doubles compute against near-constant parameter traffic. The
+    headline stays b32 for cross-round comparability; keys here are
+    b64_-prefixed so the merge cannot overwrite the headline's."""
+    return {"b64_" + k: v for k, v in
+            bench_bert(batch, seq, steps, warmup).items()}
+
+
 def bench_tpu_correctness(**kw):
     """On-device correctness for the perf-path kernels (flash fwd/bwd,
     tilings, ring attention, blockwise CE, int8 MXU) vs host float64 /
@@ -631,12 +648,18 @@ CONFIGS = {
     "tpu_correctness": (bench_tpu_correctness,
                         {"seq": 128, "dim": 64, "bh": 2, "vocab": 512,
                          "hidden": 64, "n": 64}, 600),
-    "flash_attention": (bench_flash_attention,
-                        {"batch": 1, "heads": 2, "seq": 128, "iters": 2},
-                        600),
     "flash_tiling": (bench_flash_tiling,
                      {"batch": 1, "heads": 2, "seqs": (256,),
                       "blocks": (128, 256), "iters": 2}, 900),
+    # same model/compile as bert at ~2x per-step compute, so its cost
+    # estimate must not undercut bert's (the runner's small-fallback
+    # compares remaining budget against it); placed after the hardware-
+    # evidence configs so it cannot starve them
+    "bert_b64": (bench_bert_b64,
+                 {"batch": 4, "seq": 32, "steps": 2, "warmup": 1}, 950),
+    "flash_attention": (bench_flash_attention,
+                        {"batch": 1, "heads": 2, "seq": 128, "iters": 2},
+                        600),
     "blockwise_ce": (bench_blockwise_ce,
                      {"n": 64, "hidden": 32, "vocab": 512, "iters": 2}, 480),
     "int8": (bench_int8, {"m": 256, "k": 256, "n": 256, "iters": 3}, 300),
@@ -773,8 +796,13 @@ def _run_runner(out_dir, config_names, deadline_ts, small_all=False):
 # orchestrator (never imports jax)
 # --------------------------------------------------------------------------
 
-def _collect(out_dir, details):
-    """Merge every per-config result file written so far."""
+def _collect(out_dir, details, keymap=None):
+    """Merge every per-config result file written so far. `keymap`
+    (key -> producing config name, i.e. the result filename) is the
+    merge-time attribution used to scope small-run exclusion during
+    baseline publishing — keys are not uniformly config-prefixed
+    (flash_attention emits attn_*, generate emits decode_*), and a
+    hand-maintained prefix table would silently drift."""
     try:
         names = os.listdir(out_dir)
     except OSError:
@@ -784,9 +812,14 @@ def _collect(out_dir, details):
             continue
         try:
             with open(os.path.join(out_dir, fname)) as f:
-                details.update(json.load(f))
+                data = json.load(f)
         except (OSError, ValueError):
-            pass
+            continue
+        details.update(data)
+        if keymap is not None:
+            cfg = fname[:-len(".json")]
+            for k in data:
+                keymap[k] = cfg
 
 
 def _error_payload(msg):
@@ -835,13 +868,13 @@ def _headline_of(details, small_all):
     return cfg_name, ref_key, metric, unit, value
 
 
-def _build_payload(details, small_all, publish):
+def _build_payload(details, small_all, publish, keymap=None):
     """Assemble the JSON-line payload from merged details. `publish`
     gates the BASELINE.json write: only the natural end of a run may
     publish (a mid-run snapshot could publish a partial sweep)."""
     cfg_name, ref_key, metric, unit, value = _headline_of(details, small_all)
     baseline = _publish_baseline(details, cfg_name, ref_key, value,
-                                 publish=publish)
+                                 publish=publish, keymap=keymap)
     payload = {
         "metric": metric,
         "value": round(value, 1) if value else None,
@@ -854,12 +887,15 @@ def _build_payload(details, small_all, publish):
     return payload, value
 
 
-def _publish_baseline(details, cfg_name, ref_key, value, publish=True):
+def _publish_baseline(details, cfg_name, ref_key, value, publish=True,
+                      keymap=None):
     """First full real-chip run publishes its numbers as the baseline so
     later rounds report a real vs_baseline ratio. Small-size numbers are
     never published and never compared against a full-size baseline —
-    either direction poisons the ratio permanently."""
-    any_small = any(k.endswith("_small") and v for k, v in details.items())
+    either direction poisons the ratio permanently. Smallness is scoped
+    PER CONFIG: a late config that fell back to small (deadline
+    pressure) must not block publishing the full headline's numbers —
+    its own keys are simply excluded from the published set."""
     headline_small = bool(details.get(cfg_name + "_small"))
     # None until a real comparison exists: a ratio of 1.0 with nothing
     # published would read as "measured vs baseline" when it never was
@@ -872,15 +908,23 @@ def _publish_baseline(details, cfg_name, ref_key, value, publish=True):
         ref = published.get(ref_key)
         if value and ref:
             baseline = value / ref if not headline_small else None
-        elif (publish and value and not published and not any_small
+        elif (publish and value and not published and not headline_small
               and os.environ.get("BENCH_SMALL", "0").lower() not in
               ("1", "true", "yes")
               and str(details.get("backend", "")).lower() in ("tpu", "axon")
               and details.get("bert_tokens_per_sec")):
+            km = keymap or {}
+
+            def _from_small_cfg(k):
+                # unattributed keys (no result file, e.g. orchestrator
+                # annotations) are conservatively NOT published
+                cfg = km.get(k)
+                return cfg is None or bool(details.get(cfg + "_small"))
+
             pub = {k: round(v, 2) for k, v in details.items()
-                   if isinstance(v, float) and (
-                       k.endswith("_per_sec") or k.endswith("_ms")
-                       or k.endswith("_mfu") or k.endswith("_tops"))}
+                   if isinstance(v, float) and not _from_small_cfg(k)
+                   and (k.endswith("_per_sec") or k.endswith("_ms")
+                        or k.endswith("_mfu") or k.endswith("_tops"))}
             pub["device_kind"] = details.get("device_kind")
             baseline_doc["published"] = pub
             with open(baseline_path, "w") as f:
@@ -932,12 +976,14 @@ def main():
                                                                "yes")
     todo = list(CONFIGS)
     details = {}
+    keymap = {}  # result key -> producing config (merge-time attribution)
     state = {"proc": None}
 
     def _partial_payload(tag):
         d = dict(details)
-        _collect(out_dir, d)
-        payload, value = _build_payload(d, small_all, publish=False)
+        _collect(out_dir, d, keymap)
+        payload, value = _build_payload(d, small_all, publish=False,
+                                        keymap=keymap)
         payload["partial"] = tag
         return payload, value
 
@@ -1061,7 +1107,7 @@ def main():
                     break
             if details.get("runner_killed_at_deadline"):
                 break
-        _collect(out_dir, details)
+        _collect(out_dir, details, keymap)
         todo = [n for n in todo
                 if not os.path.exists(os.path.join(out_dir, n + ".json"))]
         if proc.returncode == 0:
@@ -1083,7 +1129,7 @@ def main():
                 f"runner crashed during this config (rc={proc.returncode})")
             todo.remove(crashed)
         time.sleep(10.0)
-    _collect(out_dir, details)
+    _collect(out_dir, details, keymap)
     for name in todo:
         # result keys are not all name-prefixed (flash_attention -> attn_*)
         # so presence is judged by the per-config result file + markers

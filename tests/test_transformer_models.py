@@ -135,6 +135,7 @@ def test_gpt_mlm_loss_decreases_under_model_fit():
     assert res["loss"] < 4.85  # below uniform-random entropy
 
 
+@pytest.mark.tpu
 @pytest.mark.skipif(
     __import__("jax").default_backend() != "tpu",
     reason="pallas flash attention runs on TPU only")

@@ -1,0 +1,276 @@
+"""Generate docs/PERF_ESTIMATES.md — compile-time + XLA cost-analysis
+tables for the BASELINE configs, measured on the CPU backend.
+
+These are ESTIMATES, not benchmark numbers (round-5 verdict #9): the
+XLA-optimized program's FLOPs/bytes are backend-sensitive, and nothing
+here times execution. Their purpose is to make the first real chip
+grant pure measurement time: model sizes, per-step work, and arithmetic
+intensity are already pinned; the chip only needs to supply seconds.
+
+Run from the repo root: `python tools/perf_estimates.py`
+"""
+import json
+import os
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+jax.config.update("jax_platforms", "cpu")
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+V5E_PEAK_BF16 = 197e12      # dense bf16 FLOP/s, public spec
+V5E_HBM_GBS = 819e9         # HBM bandwidth, public spec
+
+
+def _cost(compiled):
+    try:
+        c = compiled.cost_analysis()
+        if isinstance(c, (list, tuple)):
+            c = c[0]
+        return c or {}
+    except Exception:  # noqa: BLE001
+        return {}
+
+
+def _row(name, lower_fn):
+    t0 = time.perf_counter()
+    lowered = lower_fn()
+    t_lower = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    compiled = lowered.compile()
+    t_compile = time.perf_counter() - t0
+    c = _cost(compiled)
+    flops = float(c.get("flops", 0.0))
+    bytes_acc = float(c.get("bytes accessed", 0.0))
+    return {
+        "config": name,
+        "lower_s": round(t_lower, 1),
+        "compile_s": round(t_compile, 1),
+        "gflops_per_step": round(flops / 1e9, 1),
+        "gbytes_per_step": round(bytes_acc / 1e9, 2),
+        "arith_intensity": round(flops / bytes_acc, 1) if bytes_acc else None,
+        # time bounds on v5e at peak: compute-bound vs bandwidth-bound
+        "v5e_compute_bound_ms": round(flops / V5E_PEAK_BF16 * 1e3, 2),
+        "v5e_bw_bound_ms": round(bytes_acc / V5E_HBM_GBS * 1e3, 2),
+    }
+
+
+def bert_step(batch=32, seq=128):
+    import paddle_tpu as paddle
+    from paddle_tpu.core.tensor import Tensor
+    from paddle_tpu.models.bert import BertConfig, BertForMaskedLM
+
+    paddle.seed(0)
+    cfg = BertConfig(dropout=0.0, attention_dropout=0.0)
+    model = BertForMaskedLM(cfg)
+    paddle.amp.decorate(model, level="O2")
+    model.eval()
+    params = {k: p._value for k, p in model.named_parameters()
+              if not p.stop_gradient}
+    opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                 parameters=model.parameters())
+    meta = opt.param_meta({k: p for k, p in model.named_parameters()
+                           if not p.stop_gradient})
+    states = opt.functional_init_states(params)
+
+    def step(pv, st, ids, labels):
+        def loss_of(p):
+            with paddle.no_grad():
+                out, _ = model.functional_call(
+                    {k: Tensor(v) for k, v in p.items()},
+                    Tensor(ids), None, None, Tensor(labels))
+            loss = out[0] if isinstance(out, (list, tuple)) else out
+            return loss._value.astype(jnp.float32)
+        loss, grads = jax.value_and_grad(loss_of)(pv)
+        new_p, new_s = opt.functional_update(pv, grads, st,
+                                             jnp.float32(1e-4), meta=meta)
+        return new_p, new_s, loss
+
+    rng = np.random.RandomState(0)
+    ids = jnp.asarray(rng.randint(0, cfg.vocab_size, (batch, seq)))
+    labels = jnp.asarray(rng.randint(0, cfg.vocab_size, (batch, seq)))
+    n_params = sum(int(np.prod(v.shape)) for v in params.values())
+    return (jax.jit(step, donate_argnums=(0, 1))
+            .lower(params, states, ids, labels),
+            n_params, batch * seq)
+
+
+def gpt_step(batch=8, seq=512):
+    import paddle_tpu as paddle
+    from paddle_tpu.core.tensor import Tensor
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=50304, hidden_size=768, num_layers=12,
+                    num_heads=12, max_position=seq, dropout=0.0)
+    model = GPTForCausalLM(cfg)
+    paddle.amp.decorate(model, level="O2")
+    model.eval()
+    params = {k: p._value for k, p in model.named_parameters()
+              if not p.stop_gradient}
+    opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                 parameters=model.parameters())
+    meta = opt.param_meta({k: p for k, p in model.named_parameters()
+                           if not p.stop_gradient})
+    states = opt.functional_init_states(params)
+
+    def step(pv, st, ids, labels):
+        def loss_of(p):
+            with paddle.no_grad():
+                out = model.functional_call(
+                    {k: Tensor(v) for k, v in p.items()},
+                    Tensor(ids), None, Tensor(labels))[0]
+            loss = out[0] if isinstance(out, (list, tuple)) else out
+            return loss._value.astype(jnp.float32)
+        loss, grads = jax.value_and_grad(loss_of)(pv)
+        new_p, new_s = opt.functional_update(pv, grads, st,
+                                             jnp.float32(1e-4), meta=meta)
+        return new_p, new_s, loss
+
+    rng = np.random.RandomState(0)
+    ids = jnp.asarray(rng.randint(0, cfg.vocab_size, (batch, seq)))
+    labels = jnp.asarray(rng.randint(0, cfg.vocab_size, (batch, seq)))
+    n_params = sum(int(np.prod(v.shape)) for v in params.values())
+    return (jax.jit(step, donate_argnums=(0, 1))
+            .lower(params, states, ids, labels),
+            n_params, batch * seq)
+
+
+def resnet50_fwdbwd(batch=64):
+    import paddle_tpu as paddle
+    from paddle_tpu.core.tensor import Tensor
+    from paddle_tpu.vision.models import resnet50
+
+    paddle.seed(0)
+    model = resnet50(num_classes=100)
+    model.eval()
+    params = {k: p._value for k, p in model.named_parameters()
+              if not p.stop_gradient}
+
+    def step(pv, x, y):
+        def loss_of(p):
+            with paddle.no_grad():
+                logits, _ = model.functional_call(
+                    {k: Tensor(v) for k, v in p.items()}, Tensor(x))
+            from paddle_tpu import nn
+            return nn.functional.cross_entropy(
+                logits, Tensor(y))._value.astype(jnp.float32)
+        loss, grads = jax.value_and_grad(loss_of)(pv)
+        return grads, loss
+
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(batch, 3, 224, 224).astype(np.float32))
+    y = jnp.asarray(rng.randint(0, 100, batch).astype(np.int64))
+    n_params = sum(int(np.prod(v.shape)) for v in params.values())
+    return jax.jit(step).lower(params, x, y), n_params, batch
+
+
+def lenet_step(batch=256):
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    from paddle_tpu.core.tensor import Tensor
+    from paddle_tpu.vision.models import LeNet
+
+    paddle.seed(0)
+    model = LeNet()
+    params = {k: p._value for k, p in model.named_parameters()
+              if not p.stop_gradient}
+
+    def step(pv, x, y):
+        def loss_of(p):
+            with paddle.no_grad():
+                logits, _ = model.functional_call(
+                    {k: Tensor(v) for k, v in p.items()}, Tensor(x))
+            return nn.functional.cross_entropy(
+                logits, Tensor(y))._value.astype(jnp.float32)
+        loss, grads = jax.value_and_grad(loss_of)(pv)
+        return grads, loss
+
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(batch, 1, 28, 28).astype(np.float32))
+    y = jnp.asarray(rng.randint(0, 10, (batch,)).astype(np.int64))
+    n_params = sum(int(np.prod(v.shape)) for v in params.values())
+    return jax.jit(step).lower(params, x, y), n_params, batch
+
+
+def main():
+    rows = []
+    extras = {}
+    for name, builder, unit in [
+        ("LeNet Model.fit step (b256)", lenet_step, "imgs"),
+        ("ResNet50 fwd+bwd (b64, f32)", resnet50_fwdbwd, "imgs"),
+        ("BERT-base MLM AMP-O2 step (b32 s128)", bert_step, "tokens"),
+        ("GPT-2 small AMP-O2 step (b8 s512)", gpt_step, "tokens"),
+    ]:
+        lowered, n_params, units_per_step = (None, None, None)
+        t_build = time.perf_counter()
+        lowered, n_params, units_per_step = builder()
+        t_build = time.perf_counter() - t_build
+        row = _row(name, lambda: lowered)
+        row["lower_s"] = round(t_build, 1)  # build+trace+lower together
+        row["params_m"] = round(n_params / 1e6, 1)
+        row["units_per_step"] = units_per_step
+        row["unit"] = unit
+        # throughput the cost model implies on v5e if the step runs at
+        # the max of the two bounds (idealized; real MFU will be lower)
+        bound_ms = max(row["v5e_compute_bound_ms"], row["v5e_bw_bound_ms"])
+        if bound_ms:
+            row["v5e_roofline_per_sec"] = round(
+                units_per_step / (bound_ms / 1e3))
+        rows.append(row)
+        print(json.dumps(row), flush=True)
+
+    md = [
+        "# PERF ESTIMATES (no chip required) — round-5 contingency",
+        "",
+        "**These are NOT measured benchmark numbers.** They are XLA",
+        "cost-analysis properties of the compiled train-step programs,",
+        "generated on the CPU backend (`tools/perf_estimates.py`),",
+        "plus public v5e peak specs (197 Tbf16FLOP/s, 819 GB/s HBM).",
+        "The roofline column is the throughput implied if the step ran",
+        "exactly at the binding bound — an upper bound, not a claim.",
+        "Purpose: when the chip grant arrives, all model/work numbers",
+        "are pre-pinned and the grant is spent purely on timing",
+        "(bench.py measures; BENCH_rNN.json records).",
+        "",
+        "| config | params (M) | GFLOP/step | GB/step | FLOP:byte | "
+        "compute-bound ms | bw-bound ms | roofline/s | compile s (CPU) |",
+        "|---|---|---|---|---|---|---|---|---|",
+    ]
+    for r in rows:
+        md.append(
+            f"| {r['config']} | {r['params_m']} | {r['gflops_per_step']} | "
+            f"{r['gbytes_per_step']} | {r['arith_intensity']} | "
+            f"{r['v5e_compute_bound_ms']} | {r['v5e_bw_bound_ms']} | "
+            f"{r.get('v5e_roofline_per_sec', '-')} {r['unit']} | "
+            f"{r['compile_s']} |")
+    md += [
+        "",
+        "Notes:",
+        "- FLOPs/bytes come from `compiled.cost_analysis()` of the whole",
+        "  donated train step (fwd+bwd+optimizer) on the CPU backend; the",
+        "  TPU-optimized program may fuse differently. Cross-check against",
+        "  the analytic `6*params*tokens` estimate is recorded by bench.py",
+        "  (`*_flops_xla_vs_analytic`).",
+        "- The Pallas flash kernel cannot appear in CPU lowerings (dispatch",
+        "  requires the tpu backend); `bench_bert` records",
+        "  `bert_flash_in_hlo` from the on-chip lowering as engagement",
+        "  proof. Multi-chip collective evidence (all-reduce /",
+        "  collective-permute / all-to-all in the 8-device HLO) is pinned",
+        "  by `__graft_entry__.dryrun_multichip` (MULTICHIP_r0N.json).",
+        "- 8-chip GPT hybrid (dp*tp*pp) per-chip cost scales the GPT row",
+        "  by ~1/8 compute with collective overhead on top; the dryrun",
+        "  compiles and executes the sharded program on the virtual mesh.",
+        "",
+    ]
+    with open(os.path.join(REPO, "docs", "PERF_ESTIMATES.md"), "w") as f:
+        f.write("\n".join(md))
+    print("wrote docs/PERF_ESTIMATES.md")
+
+
+if __name__ == "__main__":
+    main()

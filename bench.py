@@ -868,10 +868,14 @@ def _headline_of(details, small_all):
     return cfg_name, ref_key, metric, unit, value
 
 
-def _build_payload(details, small_all, publish, keymap=None):
+def _build_payload(details, small_all, publish, keymap):
     """Assemble the JSON-line payload from merged details. `publish`
     gates the BASELINE.json write: only the natural end of a run may
-    publish (a mid-run snapshot could publish a partial sweep)."""
+    publish (a mid-run snapshot could publish a partial sweep).
+    `keymap` is REQUIRED (merge-time key->config attribution from
+    _collect): a call site that dropped it would publish an empty
+    baseline and permanently block republishing — pass {} only if
+    attribution is genuinely unavailable."""
     cfg_name, ref_key, metric, unit, value = _headline_of(details, small_all)
     baseline = _publish_baseline(details, cfg_name, ref_key, value,
                                  publish=publish, keymap=keymap)
@@ -926,10 +930,13 @@ def _publish_baseline(details, cfg_name, ref_key, value, publish=True,
                    and (k.endswith("_per_sec") or k.endswith("_ms")
                         or k.endswith("_mfu") or k.endswith("_tops"))}
             pub["device_kind"] = details.get("device_kind")
-            baseline_doc["published"] = pub
-            with open(baseline_path, "w") as f:
-                json.dump(baseline_doc, f, indent=2)
-            baseline = 1.0  # this run IS the baseline it is compared to
+            # a baseline without the headline key can never be compared
+            # against — writing one would permanently block republishing
+            if ref_key in pub:
+                baseline_doc["published"] = pub
+                with open(baseline_path, "w") as f:
+                    json.dump(baseline_doc, f, indent=2)
+                baseline = 1.0  # this run IS the baseline
     except (OSError, ValueError):
         pass
     return baseline
@@ -1141,7 +1148,8 @@ def main():
     # If nothing measured, keep the documented BERT label with value null.
     # A number from a small-size retry is reported but LABELED as such so
     # no cross-round comparison mistakes it for the full config.
-    payload, value = _build_payload(details, small_all, publish=True)
+    payload, value = _build_payload(details, small_all, publish=True,
+                                    keymap=keymap)
     _emit_final(payload)
     if value is None:
         raise SystemExit(1)  # a numberless bench must look like failure

@@ -14,18 +14,70 @@ wants i32 index arithmetic.
 from __future__ import annotations
 
 import functools
+import json
 import math
+import os
 
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu  # noqa: F401
 
-__all__ = ["flash_attention", "flash_attention_raw"]
+__all__ = ["flash_attention", "flash_attention_raw", "tuned_blocks"]
 
 DEFAULT_BLOCK_Q = 128
 DEFAULT_BLOCK_K = 128
 NEG_INF = -1e30
+
+# Measured per-seq block tilings — dispatch defaults from data, not
+# guesses. Written by tools/apply_flash_tuning.py from bench.py's
+# flash_tiling sweep on real hardware; absent file = 128x128 defaults.
+# Schema: {"device_kind": str, "tilings":
+#          [{"seq": 512, "block_q": 256, "block_k": 256, "ms": 1.2}]}
+_TUNING_PATH = os.path.join(os.path.dirname(__file__), "flash_tuning.json")
+_tuning_cache = None
+
+
+def tuned_blocks(seq_q, seq_k=None):
+    """(block_q, block_k) for these (padded) sequence lengths: the
+    measured winner whose sweep seq is nearest in log-scale, with each
+    block shrunk by halving until it divides its sequence (the kernel
+    grids over seq/block), floored at the 128 default."""
+    global _tuning_cache
+    if _tuning_cache is None:
+        try:
+            with open(_TUNING_PATH) as f:
+                doc = json.load(f)
+            tilings = doc.get("tilings", [])
+            # a table measured on one chip generation must not tune
+            # another: the measured winners may be slower there than
+            # the 128x128 defaults the absent-table path uses
+            table_kind = doc.get("device_kind")
+            if table_kind:
+                try:
+                    live_kind = jax.devices()[0].device_kind
+                except Exception:  # noqa: BLE001 — backend not up yet
+                    live_kind = None
+                if live_kind is not None and live_kind != table_kind:
+                    tilings = []
+            _tuning_cache = tilings
+        except (OSError, ValueError):
+            _tuning_cache = []
+    if seq_k is None:
+        seq_k = seq_q
+    best = None
+    for t in _tuning_cache:
+        dist = abs(math.log(max(int(t["seq"]), 1)) - math.log(max(seq_q, 1)))
+        if best is None or dist < best[0]:
+            best = (dist, t)
+    if best is None:
+        return DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K
+    bq, bk = int(best[1]["block_q"]), int(best[1]["block_k"])
+    while bq > DEFAULT_BLOCK_Q and seq_q % bq:
+        bq //= 2
+    while bk > DEFAULT_BLOCK_K and seq_k % bk:
+        bk //= 2
+    return max(bq, DEFAULT_BLOCK_Q), max(bk, DEFAULT_BLOCK_K)
 
 
 def _interpret():
@@ -338,9 +390,11 @@ def flash_attention(q, k, v, causal=False, sm_scale=None, kv_mask=None):
         sq, skp, dp = s + sq_pad, sk + sk_pad, d + d_pad
         if km is not None:
             km = jnp.repeat(km, h, axis=0)
+        bq, bk = tuned_blocks(sq, skp)
         out = flash_attention_raw(
             qv.reshape(b * h, sq, dp), kv.reshape(b * h, skp, dp),
-            vv.reshape(b * h, skp, dp), causal, scale, kv_mask=km)
+            vv.reshape(b * h, skp, dp), causal, scale,
+            block_q=bq, block_k=bk, kv_mask=km)
         return out.reshape(b, h, sq, dp)[:, :, :s, :d]
     _f.__name__ = "flash_attention"
     if kv_mask is not None:

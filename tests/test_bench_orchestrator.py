@@ -104,3 +104,45 @@ def test_orchestrator_exits_nonzero_without_headline(tmp_path):
     payload = json.loads(proc.stdout.decode().strip().splitlines()[-1])
     assert payload["value"] is None
     assert proc.returncode != 0
+
+
+def test_publish_baseline_scopes_small_and_requires_headline(tmp_path,
+                                                             monkeypatch):
+    """First-full-run publishing: small configs' keys are excluded (not
+    blocking), the headline key MUST land in the published set (an
+    empty publish would permanently block republishing — the keymap
+    regression), and the next run reports a real ratio."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "benchmod", os.path.join(REPO, "bench.py"))
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    monkeypatch.setattr(bench, "REPO", str(tmp_path))
+    baseline = tmp_path / "BASELINE.json"
+    baseline.write_text(json.dumps({"published": {}}))
+    details = {"backend": "tpu", "device_kind": "TPU v5 lite",
+               "bert_tokens_per_sec": 1000.0, "bert_step_ms": 4.0,
+               "gpt_tokens_per_sec": 5.0, "gpt_small": True}
+    keymap = {"bert_tokens_per_sec": "bert", "bert_step_ms": "bert",
+              "gpt_tokens_per_sec": "gpt"}
+
+    # keymap dropped (the bug): nothing must be written
+    r = bench._publish_baseline(details, "bert", "bert_tokens_per_sec",
+                                1000.0, publish=True, keymap=None)
+    assert r is None
+    assert json.loads(baseline.read_text())["published"] == {}
+
+    # proper publish: headline in, small-config keys out
+    r = bench._publish_baseline(details, "bert", "bert_tokens_per_sec",
+                                1000.0, publish=True, keymap=keymap)
+    assert r == 1.0
+    pub = json.loads(baseline.read_text())["published"]
+    assert pub["bert_tokens_per_sec"] == 1000.0
+    assert "gpt_tokens_per_sec" not in pub
+    assert pub["device_kind"] == "TPU v5 lite"
+
+    # later run compares against the published number
+    r = bench._publish_baseline(details, "bert", "bert_tokens_per_sec",
+                                1500.0, publish=True, keymap=keymap)
+    assert r == 1.5

@@ -8,6 +8,9 @@ import pytest
 from paddle_tpu.nn.functional.attention import _use_flash
 from paddle_tpu.ops.pallas.flash_attention import flash_attention_raw
 
+import os
+REPO_TOOLS = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools")
+
 
 def _ref(q, k, v, causal):
     s, d = q.shape[1], q.shape[2]
@@ -274,3 +277,74 @@ def test_nondefault_block_sizes_match():
                                    np.asarray(want[:, 0]),
                                    rtol=1e-4, atol=2e-5,
                                    err_msg=f"bq={bq} bk={bk}")
+
+
+class TestTunedBlocks:
+    """Dispatch block defaults come from the measured tuning table
+    (flash_tuning.json via tools/apply_flash_tuning.py — round-5
+    verdict #4); absent table = the 128x128 defaults."""
+
+    def _with_table(self, monkeypatch, tilings):
+        import paddle_tpu.ops.pallas.flash_attention as fa
+
+        monkeypatch.setattr(fa, "_tuning_cache", tilings)
+        return fa
+
+    def test_fallback_without_table(self, monkeypatch):
+        fa = self._with_table(monkeypatch, [])
+        assert fa.tuned_blocks(512) == (128, 128)
+
+    def test_nearest_seq_log_scale(self, monkeypatch):
+        fa = self._with_table(monkeypatch, [
+            {"seq": 512, "block_q": 256, "block_k": 512},
+            {"seq": 2048, "block_q": 512, "block_k": 256},
+        ])
+        assert fa.tuned_blocks(512) == (256, 512)
+        assert fa.tuned_blocks(640) == (128, 128)   # 640%{512,256}!=0
+        assert fa.tuned_blocks(4096) == (512, 256)  # nearest = 2048
+        # block shrinks by halving until it divides the padded seq
+        assert fa.tuned_blocks(1920) == (128, 128)  # 1920 % 512/256 != 0
+
+    def test_dispatch_stays_exact_with_tuned_table(self, monkeypatch):
+        fa = self._with_table(monkeypatch, [
+            {"seq": 256, "block_q": 256, "block_k": 128}])
+        import paddle_tpu as paddle
+        from paddle_tpu.nn.functional.attention import _xla_attention
+
+        rng = np.random.RandomState(3)
+        B, H, S, D = 2, 2, 256, 64
+        qkv = [rng.randn(B, H, S, D).astype(np.float32) for _ in range(3)]
+        want, _ = _xla_attention(*(jnp.asarray(x) for x in qkv), None,
+                                 0.0, None, True)
+        got = fa.flash_attention(*(paddle.to_tensor(x) for x in qkv),
+                                 causal=True)
+        np.testing.assert_allclose(np.asarray(got._value),
+                                   np.asarray(want), rtol=1e-4, atol=2e-5)
+
+    def test_apply_tuning_tool(self, tmp_path, monkeypatch):
+        import importlib
+        import json as _json
+        import sys as _sys
+
+        res = {"tiling_s512_q128_k128_ms": 2.0,
+               "tiling_s512_q256_k256_ms": 1.5,
+               "tiling_s2048_q512_k256_ms": 9.0}
+        p = tmp_path / "flash_tiling.json"
+        p.write_text(_json.dumps(res))
+        _sys.path.insert(0, str(REPO_TOOLS))
+        try:
+            tool = importlib.import_module("apply_flash_tuning")
+            monkeypatch.setattr(tool, "OUT",
+                                str(tmp_path / "flash_tuning.json"))
+            assert tool.main([str(p)]) == 0
+            doc = _json.loads((tmp_path / "flash_tuning.json").read_text())
+            assert doc["tilings"] == [
+                {"seq": 512, "block_q": 256, "block_k": 256, "ms": 1.5},
+                {"seq": 2048, "block_q": 512, "block_k": 256, "ms": 9.0}]
+            # small-config sweeps are refused
+            small = tmp_path / "small.json"
+            small.write_text(_json.dumps(
+                {**res, "flash_tiling_small": True}))
+            assert tool.main([str(small)]) == 1
+        finally:
+            _sys.path.remove(str(REPO_TOOLS))

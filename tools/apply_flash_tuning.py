@@ -1,0 +1,72 @@
+"""Fold bench.py flash_tiling sweep results into the kernel's tuning
+table (paddle_tpu/ops/pallas/flash_tuning.json), which the dispatch
+wrapper consults via `tuned_blocks` — round-5 verdict #4: flash block
+defaults chosen from measured data.
+
+Usage: python tools/apply_flash_tuning.py [result.json ...]
+Defaults to .bench_state*/flash_tiling.json under the repo root. Keys
+parsed: tiling_s{seq}_q{bq}_k{bk}_ms (smaller is better, per seq).
+Refuses to write from a small-config sweep (tiling measured at toy
+shapes would mis-tune real ones).
+"""
+import glob
+import json
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+OUT = os.path.join(REPO, "paddle_tpu", "ops", "pallas",
+                   "flash_tuning.json")
+KEY = re.compile(r"tiling_s(\d+)_q(\d+)_k(\d+)_ms$")
+
+
+def main(paths):
+    if not paths:
+        paths = sorted(glob.glob(os.path.join(REPO, ".bench_state*",
+                                              "flash_tiling.json")))
+    best = {}  # seq -> (ms, bq, bk)
+    device_kind = None
+    for path in paths:
+        try:
+            with open(path) as f:
+                data = json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"skip {path}: {e}", file=sys.stderr)
+            continue
+        if data.get("flash_tiling_small"):
+            print(f"skip {path}: small-config sweep (toy shapes would "
+                  "mis-tune real ones)", file=sys.stderr)
+            continue
+        probe = os.path.join(os.path.dirname(path), "probe.json")
+        try:
+            with open(probe) as f:
+                device_kind = json.load(f).get("device_kind", device_kind)
+        except (OSError, ValueError):
+            pass
+        for k, v in data.items():
+            m = KEY.match(k)
+            if not m or not isinstance(v, (int, float)):
+                continue
+            seq, bq, bk = (int(x) for x in m.groups())
+            if seq not in best or v < best[seq][0]:
+                best[seq] = (float(v), bq, bk)
+    if not best:
+        print("no full-size tiling measurements found; nothing written")
+        return 1
+    doc = {
+        "device_kind": device_kind,
+        "tilings": [{"seq": s, "block_q": b[1], "block_k": b[2],
+                     "ms": round(b[0], 3)}
+                    for s, b in sorted(best.items())],
+    }
+    tmp = OUT + ".tmp"  # atomic: a concurrent reader must never see a
+    with open(tmp, "w") as f:  # truncated table (it would cache [])
+        json.dump(doc, f, indent=2)
+    os.replace(tmp, OUT)
+    print(f"wrote {OUT}: {doc['tilings']}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))

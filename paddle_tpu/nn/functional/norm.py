@@ -15,6 +15,19 @@ from ...core.tensor import Tensor
 __all__ = ["batch_norm", "layer_norm", "instance_norm", "group_norm",
            "local_response_norm", "normalize"]
 
+_HALF_DTYPES = (jnp.bfloat16, jnp.float16)
+
+
+def _stats_dtype(v):
+    """Norm statistics run in f32 for half-precision inputs (bf16 mean/
+    var loses precision over long reductions), and the result is cast
+    back to the INPUT dtype — the reference kernel contract (output
+    dtype == x dtype, e.g. phi layer_norm). The cast-back also stops
+    f32 affine params from promoting half activations: without it, one
+    f32-kept norm under AMP O2 upcasts every downstream matmul in the
+    network to f32 (measured: all 222 dots of the BERT headline step)."""
+    return jnp.float32 if v.dtype in _HALF_DTYPES else v.dtype
+
 
 def batch_norm(x, running_mean, running_var, weight=None, bias=None,
                training=False, momentum=0.9, epsilon=1e-5,
@@ -25,30 +38,36 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
     def _f(v, rm, rv, w, b):
         ch_axis = v.ndim - 1 if channel_last else 1
         red_axes = tuple(i for i in range(v.ndim) if i != ch_axis)
+        vf = v.astype(_stats_dtype(v))
         if use_global:
             mean, var = rm, rv
         else:
-            mean = jnp.mean(v, red_axes)
-            var = jnp.var(v, red_axes)
+            mean = jnp.mean(vf, red_axes)
+            var = jnp.var(vf, red_axes)
         shape = [1] * v.ndim
         shape[ch_axis] = -1
-        out = (v - mean.reshape(shape)) * jax.lax.rsqrt(
+        out = (vf - mean.reshape(shape)) * jax.lax.rsqrt(
             var.reshape(shape) + epsilon)
         if w is not None:
             out = out * w.reshape(shape)
         if b is not None:
             out = out + b.reshape(shape)
-        return out, mean, var
+        return out.astype(v.dtype), mean, var
 
     out, batch_mean, batch_var = apply(_f, x, running_mean, running_var,
                                        weight, bias)
     if training and not use_global and running_mean is not None:
         # side-effecting buffer update; under jit tracing these writes hold
-        # tracers and are harvested by Layer.functional_call as outputs
-        running_mean._value = (momentum * running_mean._value
-                               + (1 - momentum) * batch_mean._value)
-        running_var._value = (momentum * running_var._value
-                              + (1 - momentum) * batch_var._value)
+        # tracers and are harvested by Layer.functional_call as outputs.
+        # Stats cast to the BUFFER dtype: f32 batch stats from a half
+        # input must not flip a half running buffer to f32 (a changed
+        # buffer dtype retraces the whole-step jit and breaks donation)
+        running_mean._value = (
+            momentum * running_mean._value + (1 - momentum)
+            * batch_mean._value.astype(running_mean._value.dtype))
+        running_var._value = (
+            momentum * running_var._value + (1 - momentum)
+            * batch_var._value.astype(running_var._value.dtype))
     return out
 
 
@@ -60,14 +79,15 @@ def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5,
 
     def _f(v, w, b):
         axes = tuple(range(v.ndim - n, v.ndim))
-        mean = jnp.mean(v, axes, keepdims=True)
-        var = jnp.var(v, axes, keepdims=True)
-        out = (v - mean) * jax.lax.rsqrt(var + epsilon)
+        vf = v.astype(_stats_dtype(v))
+        mean = jnp.mean(vf, axes, keepdims=True)
+        var = jnp.var(vf, axes, keepdims=True)
+        out = (vf - mean) * jax.lax.rsqrt(var + epsilon)
         if w is not None:
             out = out * w
         if b is not None:
             out = out + b
-        return out
+        return out.astype(v.dtype)
     return apply(_f, x, weight, bias)
 
 
@@ -76,16 +96,17 @@ def instance_norm(x, running_mean=None, running_var=None, weight=None,
                   data_format="NCHW", name=None):
     def _f(v, w, b):
         red_axes = tuple(range(2, v.ndim))
-        mean = jnp.mean(v, red_axes, keepdims=True)
-        var = jnp.var(v, red_axes, keepdims=True)
-        out = (v - mean) * jax.lax.rsqrt(var + eps)
+        vf = v.astype(_stats_dtype(v))
+        mean = jnp.mean(vf, red_axes, keepdims=True)
+        var = jnp.var(vf, red_axes, keepdims=True)
+        out = (vf - mean) * jax.lax.rsqrt(var + eps)
         if w is not None:
             shape = [1, -1] + [1] * (v.ndim - 2)
             out = out * w.reshape(shape)
         if b is not None:
             shape = [1, -1] + [1] * (v.ndim - 2)
             out = out + b.reshape(shape)
-        return out
+        return out.astype(v.dtype)
     return apply(_f, x, weight, bias)
 
 
@@ -96,6 +117,8 @@ def group_norm(x, num_groups, epsilon=1e-5, weight=None, bias=None,
     def _f(v, w, b):
         ch_axis = v.ndim - 1 if channel_last else 1
         c = v.shape[ch_axis]
+        in_dtype = v.dtype
+        v = v.astype(_stats_dtype(v))
         if channel_last:
             new_shape = v.shape[:-1] + (num_groups, c // num_groups)
             g = v.reshape(new_shape)
@@ -116,7 +139,7 @@ def group_norm(x, num_groups, epsilon=1e-5, weight=None, bias=None,
             out = out * w.reshape(shape)
         if b is not None:
             out = out + b.reshape(shape)
-        return out
+        return out.astype(in_dtype)
     return apply(_f, x, weight, bias)
 
 

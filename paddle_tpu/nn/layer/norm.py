@@ -100,24 +100,34 @@ class SyncBatchNorm(_BatchNormBase):
         channel_last = self._data_format in ("NHWC", "NLC", "NDHWC")
 
         def _f(v, rm, rv, w, b):
+            from ...nn.functional.norm import _stats_dtype
+
             ch_axis = v.ndim - 1 if channel_last else 1
             red = tuple(i for i in range(v.ndim) if i != ch_axis)
-            mean = jax.lax.pmean(jnp.mean(v, red), axis)
-            mean2 = jax.lax.pmean(jnp.mean(v * v, red), axis)
+            # stats in f32 for half inputs: bf16 E[x^2]-E[x]^2 suffers
+            # catastrophic cancellation (can go negative -> NaN rsqrt),
+            # and the cast-back stops the f32 affine params from
+            # promoting every downstream matmul (same contract as the
+            # functional norms)
+            vf = v.astype(_stats_dtype(v))
+            mean = jax.lax.pmean(jnp.mean(vf, red), axis)
+            mean2 = jax.lax.pmean(jnp.mean(vf * vf, red), axis)
             var = mean2 - mean * mean
             shape = [1] * v.ndim
             shape[ch_axis] = -1
-            out = (v - mean.reshape(shape)) * jax.lax.rsqrt(
+            out = (vf - mean.reshape(shape)) * jax.lax.rsqrt(
                 var.reshape(shape) + eps)
             if w is not None:
                 out = out * w.reshape(shape)
             if b is not None:
                 out = out + b.reshape(shape)
-            return out, mean, var
+            return out.astype(v.dtype), mean, var
 
         out, bm, bv = apply(_f, x, mean_t, var_t, self.weight, self.bias)
-        mean_t._value = momentum * mean_t._value + (1 - momentum) * bm._value
-        var_t._value = momentum * var_t._value + (1 - momentum) * bv._value
+        mean_t._value = (momentum * mean_t._value + (1 - momentum)
+                         * bm._value.astype(mean_t._value.dtype))
+        var_t._value = (momentum * var_t._value + (1 - momentum)
+                        * bv._value.astype(var_t._value.dtype))
         return out
 
     @classmethod

@@ -108,3 +108,39 @@ def test_o2_bf16_forward_tracks_f32():
     # bf16 has ~3 significant decimal digits; losses are O(log vocab)
     assert abs(loss16 - loss32) / max(abs(loss32), 1e-6) < 0.02, \
         (loss32, loss16)
+
+
+def test_amp_o2_norms_do_not_upcast_matmuls():
+    """A f32-kept norm under AMP O2 must not promote the rest of the
+    network: norms compute stats in f32 but return the INPUT dtype
+    (reference kernel contract), so every downstream matmul stays bf16.
+    Before the cast-back, all 222 dots of the BERT headline bench step
+    ran f32 — half the MXU's bf16 throughput left on the table."""
+    import re
+
+    import jax
+
+    model = nn.Sequential(
+        nn.Linear(64, 64), nn.LayerNorm(64), nn.Linear(64, 64),
+        nn.LayerNorm(64), nn.Linear(64, 10))
+    paddle.amp.decorate(model, level="O2")
+    model.eval()
+    params = {k: p._value for k, p in model.named_parameters()}
+    from paddle_tpu.core.tensor import Tensor
+
+    def fwd(pv, x):
+        out, _ = model.functional_call(
+            {k: Tensor(v) for k, v in pv.items()}, Tensor(x))
+        return out._value
+
+    x = np.random.RandomState(0).randn(8, 64).astype(np.float32)
+    import jax.numpy as jnp
+
+    txt = jax.jit(fwd).lower(params, jnp.asarray(x, jnp.bfloat16)).as_text()
+    dots = re.findall(r"stablehlo\.dot_general.*->\s*tensor<[^>]*x(\w+)>",
+                      txt)
+    assert dots and all(d == "bf16" for d in dots), dots
+    # and the norm itself emits the input dtype
+    ln = nn.LayerNorm(64)
+    y = ln(paddle.to_tensor(x.astype(np.float32)).astype("bfloat16"))
+    assert str(y._value.dtype) == "bfloat16"

@@ -32,7 +32,7 @@ class BertConfig:
                  num_heads=12, intermediate_size=3072, max_position=512,
                  type_vocab_size=2, dropout=0.1, attention_dropout=0.1,
                  layer_norm_eps=1e-12, initializer_range=0.02,
-                 pad_token_id=0):
+                 pad_token_id=0, fused_loss=None):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
         self.num_layers = num_layers
@@ -45,6 +45,12 @@ class BertConfig:
         self.layer_norm_eps = layer_norm_eps
         self.initializer_range = initializer_range
         self.pad_token_id = pad_token_id
+        # blockwise fused softmax-CE over the tied MLM head (no [N, V]
+        # logits buffer) — worth it at real vocab sizes
+        from ..ops.blockwise_ce import FUSED_LOSS_VOCAB_THRESHOLD
+
+        self.fused_loss = (vocab_size >= FUSED_LOSS_VOCAB_THRESHOLD
+                           if fused_loss is None else fused_loss)
 
 
 class BertEmbeddings(nn.Layer):
@@ -185,18 +191,34 @@ class BertForPretraining(nn.Layer):
         hidden, pooled = self.bert(input_ids, token_type_ids,
                                    attention_mask=attention_mask)
         h = self.transform_ln(nn.functional.gelu(self.transform(hidden)))
-        logits = T.matmul(h, self.bert.embeddings.word_embeddings.weight,
-                          transpose_y=True) + self.decoder_bias
         nsp = self.seq_relationship(pooled)
+        w = self.bert.embeddings.word_embeddings.weight
         if masked_lm_labels is not None:
-            mlm_loss = nn.functional.cross_entropy(
-                T.reshape(logits, [-1, logits.shape[-1]]),
-                T.reshape(masked_lm_labels, [-1]), ignore_index=-100)
+            if self.config.fused_loss:
+                # no [N, V] logits buffer; the decoder bias is added per
+                # vocab block inside the kernel's scan and its gradient
+                # falls out of the blockwise backward
+                from ..core.autograd import apply
+                from ..ops.blockwise_ce import blockwise_softmax_ce
+
+                hs = self.config.hidden_size
+                mlm_loss = apply(
+                    lambda hv, wv, bv, lv: blockwise_softmax_ce(
+                        hv.reshape(-1, hs), wv, lv.reshape(-1),
+                        ignore_index=-100, bias=bv),
+                    h, w, self.decoder_bias, masked_lm_labels)
+            else:
+                logits = T.matmul(h, w, transpose_y=True) \
+                    + self.decoder_bias
+                mlm_loss = nn.functional.cross_entropy(
+                    T.reshape(logits, [-1, logits.shape[-1]]),
+                    T.reshape(masked_lm_labels, [-1]), ignore_index=-100)
             loss = mlm_loss
             if next_sentence_labels is not None:
                 loss = loss + nn.functional.cross_entropy(
                     nsp, T.reshape(next_sentence_labels, [-1]))
             return loss
+        logits = T.matmul(h, w, transpose_y=True) + self.decoder_bias
         return logits, nsp
 
 

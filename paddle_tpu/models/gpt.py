@@ -49,9 +49,11 @@ class GPTConfig:
         self.pp_num_virtual = pp_num_virtual  # interleaved virtual stages
         # blockwise fused softmax-CE over the tied head (never materializes
         # [B*S, V] logits); auto-on for big vocabs where that buffer is the
-        # HBM peak (None -> vocab >= 16384)
-        self.fused_loss = (vocab_size >= 16384 if fused_loss is None
-                           else fused_loss)
+        # HBM peak
+        from ..ops.blockwise_ce import FUSED_LOSS_VOCAB_THRESHOLD
+
+        self.fused_loss = (vocab_size >= FUSED_LOSS_VOCAB_THRESHOLD
+                           if fused_loss is None else fused_loss)
 
 
 class GPTAttention(nn.Layer):

@@ -20,7 +20,12 @@ import functools
 import jax
 import jax.numpy as jnp
 
-__all__ = ["blockwise_softmax_ce"]
+__all__ = ["blockwise_softmax_ce", "FUSED_LOSS_VOCAB_THRESHOLD"]
+
+# auto-enable crossover for model configs (BertConfig/GPTConfig
+# fused_loss=None): below this vocab the [N, V] buffer is cheap enough
+# that the scan's serialization isn't worth it
+FUSED_LOSS_VOCAB_THRESHOLD = 16384
 
 
 def _pad_vocab(weight, block):
@@ -33,29 +38,41 @@ def _pad_vocab(weight, block):
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
 def blockwise_softmax_ce(hidden, weight, labels, block=8192,
-                         ignore_index=-100):
-    """Mean CE of softmax(hidden @ weight.T) against integer labels.
+                         ignore_index=-100, bias=None):
+    """Mean CE of softmax(hidden @ weight.T [+ bias]) against int labels.
 
-    hidden: [N, H]; weight: [V, H] (tied embedding); labels: [N] int.
-    Equivalent to cross_entropy(hidden @ weight.T, labels) without the
-    [N, V] intermediate; labels == ignore_index are excluded from the mean
-    and receive zero gradient (cross_entropy parity).
+    hidden: [N, H]; weight: [V, H] (tied embedding); labels: [N] int;
+    bias: optional [V] (e.g. a BERT MLM decoder bias) added per logit
+    block inside the scan — no [V, H+1] weight copy, db falls out of the
+    blockwise backward. Equivalent to cross_entropy(hidden @ weight.T
+    + bias, labels) without the [N, V] intermediate; labels ==
+    ignore_index are excluded from the mean and receive zero gradient
+    (cross_entropy parity).
     """
-    loss, _ = _forward(hidden, weight, labels, block, ignore_index)
+    loss, _ = _forward(hidden, weight, labels, block, ignore_index, bias)
     return loss
 
 
-def _forward(hidden, weight, labels, block, ignore_index):
+def _bias_blocks(bias, v, vp, block):
+    bpad = jnp.pad(bias.astype(jnp.float32), (0, vp - v))
+    return bpad.reshape(vp // block, block)
+
+
+def _forward(hidden, weight, labels, block, ignore_index, bias=None):
     n, h = hidden.shape
     wpad, v, vp = _pad_vocab(weight, block)
     hidden_f = hidden.astype(jnp.float32)
     n_blocks = vp // block
     w_blocks = wpad.reshape(n_blocks, block, h)
+    b_blocks = (None if bias is None
+                else _bias_blocks(bias, v, vp, block))
 
     def tick(carry, wb_i):
         m, s, lab_logit = carry
-        wb, i = wb_i
+        wb, bb, i = wb_i
         logits = hidden_f @ wb.astype(jnp.float32).T        # [N, block]
+        if bb is not None:
+            logits = logits + bb[None, :]
         # vocab-padding rows must not contribute to the logsumexp
         valid = (i * block + jnp.arange(block)) < v
         logits = jnp.where(valid[None, :], logits, -jnp.inf)
@@ -75,33 +92,37 @@ def _forward(hidden, weight, labels, block, ignore_index):
             jnp.zeros((n,), jnp.float32),
             jnp.zeros((n,), jnp.float32))
     (m, s, lab_logit), _ = jax.lax.scan(
-        tick, init, (w_blocks, jnp.arange(n_blocks)))
+        tick, init, (w_blocks, b_blocks, jnp.arange(n_blocks)))
     lse = m + jnp.log(s)
     keep = (labels != ignore_index)
     n_valid = jnp.maximum(keep.sum(), 1)
     loss = jnp.where(keep, lse - lab_logit, 0.0).sum() / n_valid
-    return loss, (hidden, weight, labels, lse, keep, n_valid)
+    return loss, (hidden, weight, labels, bias, lse, keep, n_valid)
 
 
-def _fwd(hidden, weight, labels, block, ignore_index):
-    loss, res = _forward(hidden, weight, labels, block, ignore_index)
+def _fwd(hidden, weight, labels, block, ignore_index, bias=None):
+    loss, res = _forward(hidden, weight, labels, block, ignore_index, bias)
     return loss, res
 
 
 def _bwd(block, ignore_index, res, g):
-    hidden, weight, labels, lse, keep, n_valid = res
+    hidden, weight, labels, bias, lse, keep, n_valid = res
     n, h = hidden.shape
     wpad, v, vp = _pad_vocab(weight, block)
     hidden_f = hidden.astype(jnp.float32)
     n_blocks = vp // block
     w_blocks = wpad.reshape(n_blocks, block, h)
+    b_blocks = (None if bias is None
+                else _bias_blocks(bias, v, vp, block))
     # per-row cotangent: g/n_valid for kept rows, 0 for ignored rows
     scale = jnp.where(keep, g / n_valid, 0.0)[:, None]
 
     def tick(dh, wb_i):
-        wb, i = wb_i
+        wb, bb, i = wb_i
         wbf = wb.astype(jnp.float32)
         logits = hidden_f @ wbf.T                            # recompute
+        if bb is not None:
+            logits = logits + bb[None, :]
         valid = (i * block + jnp.arange(block)) < v
         logits = jnp.where(valid[None, :], logits, -jnp.inf)
         p = jnp.exp(logits - lse[:, None])                   # softmax block
@@ -111,12 +132,16 @@ def _bwd(block, ignore_index, res, g):
         dlogits = (p - onehot) * scale                       # [N, block]
         dh = dh + dlogits @ wbf                              # [N, H]
         dwb = dlogits.T @ hidden_f                           # [block, H]
-        return dh, dwb
+        dbb = None if bb is None else dlogits.sum(0)         # [block]
+        return dh, (dwb, dbb)
 
-    dh, dwbs = jax.lax.scan(tick, jnp.zeros((n, h), jnp.float32),
-                            (w_blocks, jnp.arange(n_blocks)))
+    dh, (dwbs, dbbs) = jax.lax.scan(
+        tick, jnp.zeros((n, h), jnp.float32),
+        (w_blocks, b_blocks, jnp.arange(n_blocks)))
     dw = dwbs.reshape(vp, h)[:v]
-    return (dh.astype(hidden.dtype), dw.astype(weight.dtype), None)
+    db = (None if bias is None
+          else dbbs.reshape(vp)[:v].astype(bias.dtype))
+    return (dh.astype(hidden.dtype), dw.astype(weight.dtype), None, db)
 
 
 blockwise_softmax_ce.defvjp(_fwd, _bwd)

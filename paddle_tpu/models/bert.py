@@ -47,10 +47,9 @@ class BertConfig:
         self.pad_token_id = pad_token_id
         # blockwise fused softmax-CE over the tied MLM head (no [N, V]
         # logits buffer) — worth it at real vocab sizes
-        from ..ops.blockwise_ce import FUSED_LOSS_VOCAB_THRESHOLD
+        from ..ops.blockwise_ce import fused_loss_default
 
-        self.fused_loss = (vocab_size >= FUSED_LOSS_VOCAB_THRESHOLD
-                           if fused_loss is None else fused_loss)
+        self.fused_loss = fused_loss_default(vocab_size, fused_loss)
 
 
 class BertEmbeddings(nn.Layer):

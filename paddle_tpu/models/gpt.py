@@ -50,10 +50,9 @@ class GPTConfig:
         # blockwise fused softmax-CE over the tied head (never materializes
         # [B*S, V] logits); auto-on for big vocabs where that buffer is the
         # HBM peak
-        from ..ops.blockwise_ce import FUSED_LOSS_VOCAB_THRESHOLD
+        from ..ops.blockwise_ce import fused_loss_default
 
-        self.fused_loss = (vocab_size >= FUSED_LOSS_VOCAB_THRESHOLD
-                           if fused_loss is None else fused_loss)
+        self.fused_loss = fused_loss_default(vocab_size, fused_loss)
 
 
 class GPTAttention(nn.Layer):

@@ -28,6 +28,13 @@ __all__ = ["blockwise_softmax_ce", "FUSED_LOSS_VOCAB_THRESHOLD"]
 FUSED_LOSS_VOCAB_THRESHOLD = 16384
 
 
+def fused_loss_default(vocab_size, fused_loss=None):
+    """The shared auto-enable policy for model configs: explicit flag
+    wins; None means 'fuse when the vocab is big enough to matter'."""
+    return (vocab_size >= FUSED_LOSS_VOCAB_THRESHOLD
+            if fused_loss is None else fused_loss)
+
+
 def _pad_vocab(weight, block):
     v = weight.shape[0]
     pad = (-v) % block

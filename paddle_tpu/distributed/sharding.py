@@ -90,6 +90,10 @@ def group_sharded_parallel(model, optimizer, level="os_g", scaler=None,
 
     # wrap the optimizer's state factories so every state buffer lands
     # dp-sharded; the jitted step (donated args) keeps the placement
+    # kept alongside the _mp_init wrap below: base-optimizer leaves get
+    # device_put twice (idempotent — same NamedSharding), but fleet
+    # wrappers add extra functional-state leaves (gradient-merge acc/
+    # count) that only this outer tree_map sees
     orig_functional = optimizer.functional_init_states
 
     def sharded_init_states(values_tree):
@@ -102,10 +106,13 @@ def group_sharded_parallel(model, optimizer, level="os_g", scaler=None,
 
     optimizer.functional_init_states = sharded_init_states
 
-    orig_init_state = optimizer._init_state
+    # wrap _mp_init (not _init_state): the multi-precision layer adds
+    # the f32 master copy AFTER _init_state runs, and the master — the
+    # largest state buffer — must land dp-sharded like the moments
+    orig_mp_init = optimizer._mp_init
 
-    def sharded_init_state(p):
-        st = orig_init_state(p)
+    def sharded_mp_init(p):
+        st = orig_mp_init(p)
         out = {}
         for k, v in st.items():
             if hasattr(v, "shape") and getattr(v, "ndim", 0) > 0:
@@ -115,7 +122,7 @@ def group_sharded_parallel(model, optimizer, level="os_g", scaler=None,
                 out[k] = v
         return out
 
-    optimizer._init_state = sharded_init_state
+    optimizer._mp_init = sharded_mp_init
 
     if scaler is not None:
         return model, optimizer, scaler

@@ -20,7 +20,8 @@ import functools
 import jax
 import jax.numpy as jnp
 
-__all__ = ["blockwise_softmax_ce", "FUSED_LOSS_VOCAB_THRESHOLD"]
+__all__ = ["blockwise_softmax_ce", "FUSED_LOSS_VOCAB_THRESHOLD",
+           "fused_loss_default"]
 
 # auto-enable crossover for model configs (BertConfig/GPTConfig
 # fused_loss=None): below this vocab the [N, V] buffer is cheap enough

@@ -24,9 +24,10 @@ class SGD(Optimizer):
 class Momentum(Optimizer):
     def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
                  use_nesterov=False, weight_decay=None, grad_clip=None,
-                 multi_precision=False, name=None):
+                 multi_precision=None, name=None):
         super().__init__(learning_rate, parameters, weight_decay, grad_clip,
                          name)
+        self._multi_precision = multi_precision
         self._momentum = momentum
         self._use_nesterov = use_nesterov
 
@@ -119,10 +120,11 @@ class RMSProp(Optimizer):
 class Adam(Optimizer):
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
                  epsilon=1e-8, parameters=None, weight_decay=None,
-                 grad_clip=None, lazy_mode=False, multi_precision=False,
+                 grad_clip=None, lazy_mode=False, multi_precision=None,
                  use_multi_tensor=False, name=None, amsgrad=False):
         super().__init__(learning_rate, parameters, weight_decay, grad_clip,
                          name)
+        self._multi_precision = multi_precision
         self._beta1 = beta1
         self._beta2 = beta2
         self._epsilon = epsilon
@@ -152,10 +154,11 @@ class AdamW(Adam):
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
                  epsilon=1e-8, parameters=None, weight_decay=0.01,
                  lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
-                 lazy_mode=False, multi_precision=False, name=None,
+                 lazy_mode=False, multi_precision=None, name=None,
                  amsgrad=False):
         super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
                          None, grad_clip, name=name)
+        self._multi_precision = multi_precision
         self._coeff = float(weight_decay) if not hasattr(
             weight_decay, "coeff") else weight_decay.coeff
         self._apply_decay_fn = apply_decay_param_fun
@@ -207,8 +210,9 @@ class Lamb(Optimizer):
     def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01,
                  beta1=0.9, beta2=0.999, epsilon=1e-6, parameters=None,
                  grad_clip=None, exclude_from_weight_decay_fn=None,
-                 multi_precision=False, name=None):
+                 multi_precision=None, name=None):
         super().__init__(learning_rate, parameters, None, grad_clip, name)
+        self._multi_precision = multi_precision
         self._beta1 = beta1
         self._beta2 = beta2
         self._epsilon = epsilon
@@ -254,8 +258,9 @@ class Lars(Optimizer):
     def __init__(self, learning_rate=0.001, momentum=0.9, lars_coeff=0.001,
                  lars_weight_decay=0.0005, parameters=None, grad_clip=None,
                  epsilon=0.0, exclude_from_weight_decay=None,
-                 multi_precision=False, name=None):
+                 multi_precision=None, name=None):
         super().__init__(learning_rate, parameters, None, grad_clip, name)
+        self._multi_precision = multi_precision
         self._momentum = momentum
         self._coeff = lars_coeff
         self._lars_wd = lars_weight_decay

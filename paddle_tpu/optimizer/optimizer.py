@@ -25,7 +25,8 @@ class Optimizer:
 
     def __init__(self, learning_rate=0.001, parameters=None,
                  weight_decay=None, grad_clip=None, name=None,
-                 multi_precision=False, **kwargs):
+                 multi_precision=None, **kwargs):
+        self._multi_precision = multi_precision
         if parameters is not None:
             parameters = list(parameters)
             if parameters and isinstance(parameters[0], dict):
@@ -83,9 +84,46 @@ class Optimizer:
     def _states_for(self, p):
         st = self._accumulators.get(id(p))
         if st is None:
-            st = self._init_state(p)
+            st = self._mp_init(p)
             self._accumulators[id(p)] = st
         return st
+
+    _HALF_DTYPES = ("bfloat16", "float16")
+    # reference multi_precision (python/paddle/optimizer/adamw.py):
+    # None = AUTO (on for half params — the TPU-correct default: bf16
+    # moment2 underflows since (1-b2)*g^2 vanishes below ~2^-8 relative,
+    # and ~lr-magnitude updates round away against bf16 weights);
+    # explicit False disables (halves optimizer-state HBM, reference
+    # default behavior); True forces (no-op for f32 params).
+    _multi_precision = None
+
+    def _mp_init(self, p):
+        """State init with multi_precision master-weight semantics:
+        accumulators shaped like a half param are kept in f32 and an f32
+        master copy carries the true weights. The param itself stays
+        half; the master is state (sharded/checkpointed with it)."""
+        st = self._init_state(p)
+        v = p._value
+        is_half = str(v.dtype) in self._HALF_DTYPES
+        mp = self._multi_precision
+        if (is_half if mp is None else (mp and is_half)):
+            st = {k: (a.astype(jnp.float32)
+                      if hasattr(a, "dtype") and a.dtype == v.dtype else a)
+                  for k, a in st.items()}
+            st["master"] = v.astype(jnp.float32)
+        return st
+
+    def _apply_rule(self, v, g, s, lr, mult, static):
+        """Route the update through the f32 master when one exists; the
+        caller downcasts the returned value to the param dtype."""
+        master = s.get("master") if isinstance(s, dict) else None
+        if master is not None:
+            nv, ns = self._update_rule(master, g.astype(jnp.float32), s,
+                                       lr, mult, static)
+            ns = dict(ns)
+            ns["master"] = nv
+            return nv, ns
+        return self._update_rule(v, g, s, lr, mult, static)
 
     def _update_rule(self, value, grad, state, lr, lr_mult, static=None):
         """Pure: (value, grad, state dict, lr scalar) -> (new_value, new_state).
@@ -129,16 +167,21 @@ class Optimizer:
     # ---- the fused step -------------------------------------------------
     def _build_step_fn(self, n, lr_mults, wd_coeffs, l1_coeffs, clip,
                        need_clip_flags, statics):
-        rule = self._update_rule
+        rule = self._apply_rule
 
         def fused(values, states, grads, lr):
-            # fold regularization into grads
+            # fold regularization into grads — against the f32 master
+            # when one exists, not the rounded half param (wd*v on the
+            # bf16 view would re-introduce the quantization the master
+            # pipeline removes)
             gs = []
-            for g, v, wd, l1 in zip(grads, values, wd_coeffs, l1_coeffs):
+            for g, v, s, wd, l1 in zip(grads, values, states, wd_coeffs,
+                                       l1_coeffs):
+                vv = s.get("master", v) if isinstance(s, dict) else v
                 if wd:
-                    g = g + wd * v
+                    g = g + wd * vv
                 if l1:
-                    g = g + l1 * jnp.sign(v)
+                    g = g + l1 * jnp.sign(vv)
                 gs.append(g)
             if clip is not None:
                 clipped = clip.clip_values(
@@ -193,7 +236,9 @@ class Optimizer:
             self._step_fn_cache[key] = entry
         values = [p._value for p in params]
         states = [self._states_for(p) for p in params]
-        grads = [p._grad._value.astype(p._value.dtype) for p in params]
+        grads = [p._grad._value.astype(
+            jnp.float32 if "master" in s else p._value.dtype)
+            for p, s in zip(params, states)]
         lr = jnp.asarray(self.get_lr(), jnp.float32)
         new_vals, new_states = entry(values, states, grads, lr)
         for p, nv, ns in zip(params, new_vals, new_states):
@@ -278,12 +323,19 @@ class Optimizer:
             [(0.0, 0.0, 1.0, True, None)] * len(leaves_v)
         leaves_s = [states_tree[i] for i in range(len(leaves_v))]
         gs = []
-        for v, g, (wd, l1, _, _, _) in zip(leaves_v, leaves_g, metas):
-            g = g.astype(v.dtype)
+        for v, g, s, (wd, l1, _, _, _) in zip(leaves_v, leaves_g, leaves_s,
+                                              metas):
+            # with a master the rule runs in f32 — downcasting an f32
+            # grad to the half param dtype here would throw away the
+            # very mantissa the master pipeline preserves; the decay
+            # fold likewise uses the master, not the rounded half view
+            has_master = isinstance(s, dict) and "master" in s
+            g = g.astype(jnp.float32 if has_master else v.dtype)
+            vv = s["master"] if has_master else v
             if wd:
-                g = g + wd * v
+                g = g + wd * vv
             if l1:
-                g = g + l1 * jnp.sign(v)
+                g = g + l1 * jnp.sign(vv)
             gs.append(g)
         if clip is not None:
             flags = [m[3] for m in metas]
@@ -294,7 +346,7 @@ class Optimizer:
         new_v, new_s = [], []
         for v, g, s, (_, _, mult, _, static) in zip(leaves_v, gs, leaves_s,
                                                     metas):
-            nv, ns = self._update_rule(v, g, s, lr, mult, static)
+            nv, ns = self._apply_rule(v, g, s, lr, mult, static)
             new_v.append(nv.astype(v.dtype))
             new_s.append(ns)
         return jax.tree_util.tree_unflatten(treedef, new_v), \
@@ -306,4 +358,4 @@ class Optimizer:
 
     def _init_state_value(self, value):
         p = Tensor(value)
-        return self._init_state(p)
+        return self._mp_init(p)

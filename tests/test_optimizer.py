@@ -338,3 +338,84 @@ def test_parameter_groups_scale_lr_and_weight_decay():
                                atol=1e-7)
     np.testing.assert_allclose(np.asarray(m4.weight.numpy()),
                                w4b * (1 - 0.5 * 0.1), rtol=1e-5)
+
+
+def test_half_params_get_f32_master_and_states():
+    """bf16 params train through an f32 master copy with f32 moments
+    (reference multi_precision semantics, always on for half params):
+    tiny updates must not round away in bf16, and state dtypes must be
+    stable from step one (donation/retrace contract)."""
+    import jax.numpy as jnp
+
+    import paddle_tpu.nn as nn
+
+    paddle.seed(3)
+    m16 = nn.Linear(16, 1, bias_attr=False)
+    paddle.seed(3)
+    m32 = nn.Linear(16, 1, bias_attr=False)
+    paddle.amp.decorate(m16, level="O2")
+    assert str(m16.weight._value.dtype) == "bfloat16"
+    o16 = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                 parameters=m16.parameters())
+    o32 = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                 parameters=m32.parameters())
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.randn(32, 16).astype(np.float32))
+    y = paddle.to_tensor(rng.randn(32, 1).astype(np.float32))
+    for _ in range(50):
+        for mm, oo in ((m16, o16), (m32, o32)):
+            loss = ((mm(x.astype(mm.weight.dtype)) - y.astype(
+                mm.weight.dtype)) ** 2).mean()
+            loss.backward()
+            oo.step()
+            oo.clear_grad()
+    st = o16._accumulators[id(m16.weight)]
+    assert str(st["master"].dtype) == "float32"
+    assert str(st["moment1"].dtype) == "float32"
+    assert str(st["moment2"].dtype) == "float32"
+    # functional path mirrors the same policy
+    params = {"w": m16.weight._value}
+    states = o16.functional_init_states(params)
+    leaf = states[0]
+    assert str(leaf["master"].dtype) == "float32"
+    assert str(leaf["moment2"].dtype) == "float32"
+
+
+def test_bf16_param_accumulates_tiny_updates_via_master():
+    """The reason the master exists: an AdamW step is ~lr in magnitude
+    (1e-4 here), far below bf16 resolution at 1.0 (2^-8) — without the
+    f32 master every step rounds away and the param freezes at 1.0;
+    with it the accumulated drift reaches the bf16 param."""
+    import jax.numpy as jnp
+
+    w = paddle.to_tensor(np.ones(4, np.float32)).astype("bfloat16")
+    w.stop_gradient = False
+    opt = paddle.optimizer.AdamW(learning_rate=1e-4, parameters=[w],
+                                 weight_decay=0.0)
+    for _ in range(80):
+        (w.astype("float32") * 0.1).sum().backward()
+        opt.step()
+        opt.clear_grad()
+    # ~80 * 1e-4 = 0.008 accumulated: visible in bf16 (step 0.0078 at 1)
+    val = float(np.asarray(w._value.astype(jnp.float32)).mean())
+    assert val < 0.999, val
+    master = opt._accumulators[id(w)]["master"]
+    np.testing.assert_allclose(np.asarray(master), 1.0 - 80e-4, atol=1e-3)
+
+
+def test_multi_precision_false_opts_out():
+    """Explicit multi_precision=False keeps half-dtype accumulators and
+    no master (reference default behavior; halves optimizer-state HBM)."""
+    import jax.numpy as jnp
+
+    w = paddle.to_tensor(np.ones(4, np.float32)).astype("bfloat16")
+    w.stop_gradient = False
+    opt = paddle.optimizer.Adam(learning_rate=1e-3, parameters=[w],
+                                multi_precision=False)
+    (w.astype("float32") * 0.1).sum().backward()
+    opt.step()
+    st = opt._accumulators[id(w)]
+    assert "master" not in st
+    assert str(st["moment1"].dtype) == "bfloat16"
+    states = opt.functional_init_states({"w": w._value})
+    assert "master" not in states[0]

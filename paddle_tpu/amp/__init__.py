@@ -136,6 +136,15 @@ def decorate(models, optimizers=None, level="O2", dtype="float16",
                     layer._cast_to(jd, include_sublayers=False)
     if optimizers is None:
         return models
+    # master_weight routes to the optimizer's multi_precision mechanism
+    # (f32 master + f32 states for half params): None keeps the
+    # optimizer's own AUTO default; True/False force it (reference:
+    # python/paddle/amp/auto_cast.py amp_decorate master_weight)
+    if master_weight is not None:
+        opts = (optimizers if isinstance(optimizers, (list, tuple))
+                else [optimizers])
+        for opt in opts:
+            opt._multi_precision = bool(master_weight)
     return models, optimizers
 
 

@@ -144,3 +144,19 @@ def test_amp_o2_norms_do_not_upcast_matmuls():
     ln = nn.LayerNorm(64)
     y = ln(paddle.to_tensor(x.astype(np.float32)).astype("bfloat16"))
     assert str(y._value.dtype) == "bfloat16"
+
+
+def test_decorate_master_weight_routes_to_multi_precision():
+    model = nn.Linear(4, 4)
+    opt = paddle.optimizer.AdamW(parameters=model.parameters())
+    assert opt._multi_precision is None  # AUTO
+    paddle.amp.decorate(model, optimizers=opt, level="O2",
+                        master_weight=False)
+    assert opt._multi_precision is False
+    states = opt.functional_init_states(
+        {k: p._value for k, p in model.named_parameters()})
+    assert all("master" not in s for s in states.values())
+    opt2 = paddle.optimizer.AdamW(parameters=model.parameters())
+    paddle.amp.decorate(model, optimizers=opt2, level="O2",
+                        master_weight=True)
+    assert opt2._multi_precision is True

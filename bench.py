@@ -636,6 +636,24 @@ def bench_flash_tiling(batch=4, heads=12, dim=64, seqs=(512, 2048),
     return res
 
 
+def bench_tpu_trace(batch=32, seq=128, steps=3):
+    """Real on-chip profiler trace of the BERT step (perfetto/xplane
+    under profiler_log/) — on-hardware scheduling evidence for the perf
+    levers. Runs LAST: if the tunnel's profiler wedges, everything
+    already measured is safe on disk, and the persistent compile cache
+    makes the re-compile of the bert step a cache hit."""
+    import jax
+
+    if jax.default_backend() != "tpu":
+        return {"tpu_trace_skipped": "not on tpu"}
+    logdir = os.path.join(REPO, "profiler_log",
+                          time.strftime("bench_%Y%m%d_%H%M%S"))
+    with jax.profiler.trace(logdir):
+        res = bench_bert(batch, seq, steps=steps, warmup=1)
+    return {"tpu_trace_dir": logdir,
+            "tpu_trace_step_ms": res.get("bert_step_ms")}
+
+
 # name -> (fn, small_kwargs, full_cost_estimate_s). Order is the RUN
 # order: lenet first as a cheap sanity probe of real execution, then the
 # BERT headline — with one patient runner writing results incrementally,
@@ -670,6 +688,8 @@ CONFIGS = {
     "generate": (bench_generate,
                  {"batches": (1,), "prompt": 4, "new_tokens": 4,
                   "eager_tokens": 2}, 700),
+    "tpu_trace": (bench_tpu_trace,
+                  {"batch": 2, "seq": 32, "steps": 1}, 360),
 }
 
 # test hook: BENCH_CONFIGS_MODULE names a module whose CONFIGS replaces

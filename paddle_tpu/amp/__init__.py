@@ -9,6 +9,15 @@ auto_cast works by op-name interception in the eager dispatcher
 (core.autograd.apply consults _amp_state): white-list ops run in the low
 dtype, black-list ops in f32 — the same two-list design as the reference's
 fluid/dygraph/amp/auto_cast.py.
+
+Dispatch-cache interplay: apply() runs this cast BEFORE handing the op to
+the jit-cached dispatcher (core/dispatch.py), so the cast result is part
+of the cached program key via the post-cast input avals — a white-list op
+under AMP keys on bf16 avals and can never collide with its f32 entry,
+and an op whose inputs already carry the target dtype shares its entry
+with the AMP-off case because the emitted program is identical. The same
+holds for the backward pullback cache: residuals are recorded post-cast,
+so recompute inside the cached vjp matches the forward's dtypes exactly.
 """
 from __future__ import annotations
 

@@ -4,12 +4,15 @@ Reference design: paddle/fluid/eager/grad_node_info.* + fluid/imperative/tracer.
 record a GradNode per traced op and walk the node graph on `loss.backward()`.
 
 TPU-native design: every eager op runs through `apply(fn, *args)`. The
-forward executes plainly; when grad is required the node stores the op's
-primals and a DEFERRED pullback served by a jit cached on (op identity,
-closures/defaults, statics, avals) — the jitted backward recomputes the
-op's forward inside the same XLA program as its transpose, so neither
-the forward nor the backward pays per-call re-linearization (eager
-`jax.vjp` per op costs ~ms of pure tracing). `backward()` walks the
+forward executes as a jit-cached XLA program served from the shared
+dispatch cache (core/dispatch.py) — repeated calls with stable shapes
+skip Python/JAX eager op dispatch entirely; when grad is required the
+node stores the op's primals and a DEFERRED pullback served by the same
+cache infrastructure keyed on (op identity, closures/defaults, statics,
+avals) — the jitted backward recomputes the op's forward inside the
+same XLA program as its transpose, so neither the forward nor the
+backward pays per-call re-linearization (eager `jax.vjp` per op costs
+~ms of pure tracing). `backward()` walks the
 node DAG in reverse topological order, invoking pullbacks and
 accumulating cotangents — the exact GradNode walk of the reference, but
 every node is a compiled XLA program. For `create_graph` (higher-order
@@ -19,7 +22,6 @@ the tape — jax.vjp composes, giving arbitrary-order gradients.
 """
 from __future__ import annotations
 
-import collections
 import contextlib
 import threading
 import types
@@ -28,6 +30,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from . import dispatch as _dispatch
 from .tensor import Tensor
 
 __all__ = [
@@ -106,13 +109,6 @@ def _is_tensor(x):
     return isinstance(x, Tensor)
 
 
-_BWD_CACHE_CAP = 512
-_bwd_cache = collections.OrderedDict()  # LRU: key -> jitted backward
-# grad-enabled state is threading.local, so backward() may run on several
-# threads at once; the LRU's get/move_to_end/popitem must not race
-_bwd_cache_lock = threading.Lock()
-
-
 def _freeze_closure(fn):
     """A copy of `fn` with its closure cells snapshotted NOW: the tape's
     pullback re-runs the forward at backward() time, so a captured
@@ -153,13 +149,13 @@ def _make_pullback(fn, vals, treedef, diff_pos, out_treedef):
     The jitted backward re-runs the op's forward inside the same XLA
     program as its transpose (flash-attention-style recompute) — one
     compiled call replaces eager per-op re-linearization (~ms of pure
-    tracing per op). The cache key covers everything that shapes the
-    computation: the op's code object, closure cells AND default args
-    (run_backward's vjp_call carries its per-node state in defaults),
-    the arg treedef, which positions are differentiated, non-array
-    (static) args, and array/cotangent avals. Anything unhashable — or
-    float0 cotangents — falls back to an eager jax.vjp with identical
-    semantics."""
+    tracing per op). The key/cache machinery is the forward dispatch's
+    (core/dispatch.py: op_core/freeze_static/aval_of + the BACKWARD
+    JitCache), extended with what only the backward depends on: which
+    positions are differentiated, the output treedef, and cotangent
+    avals. Anything unkeyable — a closure over a live array or mutable
+    object, or float0 cotangents — falls back to an eager jax.vjp with
+    identical semantics."""
     arr_pos = tuple(i for i, v in enumerate(vals)
                     if isinstance(v, (jax.Array, np.ndarray)))
     n_vals = len(vals)
@@ -174,29 +170,19 @@ def _make_pullback(fn, vals, treedef, diff_pos, out_treedef):
         if any(getattr(c, "dtype", None) == jax.dtypes.float0
                for c in cot_leaves):
             return _eager(cot_tree)
-        cells = getattr(fn, "__closure__", None)
         try:
-            cells = (tuple(c.cell_contents for c in cells) if cells
-                     else ())
-            statics = tuple((i, v) for i, v in enumerate(vals)
-                            if i not in arr_pos)
-            key = (getattr(fn, "__code__", fn), cells,
-                   getattr(fn, "__defaults__", None),
-                   tuple(sorted((getattr(fn, "__kwdefaults__", None)
-                                 or {}).items())),
-                   treedef, diff_pos, statics, out_treedef,
-                   tuple((vals[i].shape, str(vals[i].dtype))
-                         for i in arr_pos),
-                   tuple((c.shape, str(c.dtype)) for c in cot_leaves))
+            statics = tuple((i, _dispatch.freeze_static(v))
+                            for i, v in enumerate(vals) if i not in arr_pos)
+            key = (_dispatch.op_core(fn), treedef, diff_pos, statics,
+                   out_treedef,
+                   tuple(_dispatch.aval_of(vals[i]) for i in arr_pos),
+                   tuple(_dispatch.aval_of(c) for c in cot_leaves))
             hash(key)
-        except (TypeError, AttributeError):
+        except (TypeError, ValueError, AttributeError):
             return _eager(cot_tree)
-        with _bwd_cache_lock:
-            bwd = _bwd_cache.get(key)
-            if bwd is not None:
-                _bwd_cache.move_to_end(key)
-        if bwd is None:
-            statics_map = dict(statics)
+
+        def _build():
+            statics_map = {i: vals[i] for i, _ in statics}
 
             def bwd_fn(arr_vals, cots):
                 v = [None] * n_vals
@@ -209,11 +195,9 @@ def _make_pullback(fn, vals, treedef, diff_pos, out_treedef):
                 return pull(jax.tree_util.tree_unflatten(out_treedef,
                                                          list(cots)))
 
-            bwd = jax.jit(bwd_fn)
-            with _bwd_cache_lock:
-                _bwd_cache[key] = bwd
-                if len(_bwd_cache) > _BWD_CACHE_CAP:
-                    _bwd_cache.popitem(last=False)
+            return jax.jit(bwd_fn)
+
+        bwd = _dispatch.BACKWARD.get_or_build(key, _build)
         return bwd([vals[i] for i in arr_pos], list(cot_leaves))
 
     return pullback
@@ -248,23 +232,30 @@ def apply(fn, *args, **kwargs):
         return fn(*a, **kw)
 
     if _static_recorder is not None:
+        # recorder bypass: the op must run EAGERLY on the dummy values —
+        # the Program replays op.fn itself inside the Executor's single
+        # whole-graph jit, so a per-op cache entry here would be both
+        # redundant and keyed on throwaway dummy shapes
         out = closed()
         out_t = jax.tree_util.tree_map(lambda leaf: Tensor(leaf), out)
         _static_recorder.record_op(fn, flat, treedef, out_t)
         return out_t
 
+    # Forward executes as a jit-cached XLA program (core/dispatch.py):
+    # repeated eager calls with stable (op identity, statics, avals) hit
+    # a compiled program instead of re-dispatching op-by-op. The vjp is
+    # DEFERRED to backward and served by the same cache infrastructure —
+    # eager jax.vjp here would re-linearize the op on EVERY call (~ms of
+    # pure tracing per op, the round-4 eager-tape profile).
+    out = _dispatch.run_op(fn, vals, treedef, closed,
+                           getattr(fn, "__name__", None))
+
     if not diff_pos:
-        out = closed()
         if _post_op_hook is not None:
             _post_op_hook(getattr(fn, "__name__", "op"),
                           jax.tree_util.tree_leaves(out))
         return jax.tree_util.tree_map(lambda leaf: Tensor(leaf), out)
 
-    # Forward runs plainly; the vjp is DEFERRED to backward and served by
-    # a jit cached on (op identity, closures, statics, avals) — eager
-    # jax.vjp here would re-linearize the op on EVERY call (~ms of pure
-    # tracing per op, the round-4 eager-tape profile).
-    out = closed()
     out_leaves, out_treedef = jax.tree_util.tree_flatten(out)
     if _post_op_hook is not None:
         _post_op_hook(getattr(fn, "__name__", "op"), out_leaves)
@@ -409,6 +400,10 @@ def run_backward(tensors, grad_tensors=None, retain_graph=False,
             closed = node.closed
             treedef = node.out_treedef
 
+            # explicit dispatch opt-out: the per-node `_closed` default is
+            # a fresh closure over this node's primal arrays — caching a
+            # program per node would compile-churn every backward step
+            @_dispatch.non_jittable
             def vjp_call(cot_leaves, *prims, _closed=closed, _td=treedef):
                 cot = jax.tree_util.tree_unflatten(_td, list(cot_leaves))
                 _, pull = jax.vjp(_closed, *prims)

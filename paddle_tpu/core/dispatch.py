@@ -1,0 +1,632 @@
+"""Jit-cached eager op dispatch.
+
+PAPER.md maps the runtime to "eager tape autograd over jit-cached XLA
+ops", but until this layer existed only the *backward* pullback was
+jit-cached — every eager forward ran through plain per-op dispatch,
+paying Python/JAX eager overhead on each of the thousands of op calls
+per training step. LazyTensor (arxiv 2102.13267) shows eager UX and
+compiled execution coexist by caching compiled programs per input
+signature; TVM (arxiv 1802.04799) shows the win of cached specialized
+kernels over interpreted dispatch. This module is that cache for the
+forward path, where `jit.to_static` can't reach (plain dygraph loops,
+hapi `Model.fit` eager mode).
+
+Design:
+
+* `run_op(fn, vals, treedef, fallback)` executes one eager op as a
+  `jax.jit`-compiled program served from a bounded LRU keyed on
+  (op identity + frozen-closure snapshot, args/kwargs treedef, static
+  leaf values, input avals incl. weak type). The key covers everything
+  that shapes the emitted program, so a hit is bit-equivalent to
+  retracing.
+* A warm-count gate (`PADDLE_TPU_EAGER_JIT_WARMUP`, default 2): a key
+  compiles only on its Nth sighting; colder calls run eagerly. One-shot
+  op/shape combinations (test sweeps, setup code) never pay a compile,
+  while anything on a training hot loop compiles on step 2 and hits
+  thereafter.
+* Safe bypasses: the static-graph recorder and enclosing jit traces
+  (tracer inputs) fall through to plain eager dispatch; ops whose
+  closures capture live arrays (dropout's PRNG key), mutable objects
+  (Tensors, Layers), or otherwise unkeyable values are never cached —
+  caching them would freeze randomness or bake stale weights into the
+  compiled program. Value-dependent ops can opt out explicitly with
+  `@non_jittable`. An op whose jit attempt fails while its eager run
+  succeeds (host-side control flow, dynamic output shapes) is learned
+  as non-jittable and never retried.
+* AMP interplay: `core.autograd.apply` applies the AMP cast to the op's
+  inputs *before* dispatch, so the cast result is part of the cached
+  program key via the post-cast avals — AMP on/off (or a different amp
+  dtype) can never collide with an f32 cache entry.
+* Observability: global + per-op hit/miss/retrace counters
+  (`dispatch_stats()`, also surfaced through `paddle_tpu.profiler`),
+  and a miss-streak retrace guard that warns once per op when its key
+  churns every call (dynamic shapes silently recompiling every step).
+* `PADDLE_TPU_EAGER_JIT=0` (env, read at import) or
+  `set_eager_jit(False)` disables the whole layer; `suspend()` is a
+  scoped, thread-local version for code that is already inside an
+  outer jit trace (jit.to_static, the hapi fused step).
+
+The same key/caching infrastructure serves the backward pullback cache
+(`core.autograd._make_pullback` builds its keys from `op_core`/
+`aval_of`/`freeze_static` and stores through the `BACKWARD` JitCache),
+so forward and backward share one code path.
+"""
+from __future__ import annotations
+
+import collections
+import enum
+import math
+import os
+import threading
+import types
+import warnings
+
+import jax
+import numpy as np
+
+from . import dtype as _pdtypes
+
+__all__ = [
+    "run_op", "non_jittable", "dispatch_stats", "reset_dispatch_stats",
+    "set_eager_jit", "eager_jit_enabled", "suspend", "set_warmup_count",
+    "JitCache", "FORWARD", "BACKWARD", "op_core", "freeze_static", "aval_of",
+]
+
+
+def _env_flag(name, default):
+    return os.environ.get(name, default).lower() not in ("0", "false", "no")
+
+
+_enabled = _env_flag("PADDLE_TPU_EAGER_JIT", "1")
+_warmup_count = max(1, int(os.environ.get("PADDLE_TPU_EAGER_JIT_WARMUP", "2")))
+# consecutive misses for one op identity before the retrace guard warns
+_RETRACE_WARN_STREAK = max(
+    0, int(os.environ.get("PADDLE_TPU_RETRACE_WARN", "8")))
+
+
+def set_eager_jit(mode: bool):
+    """Enable/disable forward jit-caching process-wide (the runtime
+    analogue of the PADDLE_TPU_EAGER_JIT env escape hatch)."""
+    global _enabled
+    prev = _enabled
+    _enabled = bool(mode)
+    return prev
+
+
+def eager_jit_enabled():
+    return _enabled
+
+
+def set_warmup_count(n: int):
+    """Sightings of a key before it compiles (1 = compile immediately)."""
+    global _warmup_count
+    prev = _warmup_count
+    _warmup_count = max(1, int(n))
+    return prev
+
+
+class _Local(threading.local):
+    suspended = 0
+
+
+_local = _Local()
+
+
+class _Suspend:
+    """Scoped bypass: ops dispatched inside run plain-eager. Used by code
+    that is about to be (or already is) inside an outer jax.jit trace,
+    where a nested per-op jit would only add cache entries and Python
+    overhead — the outer program compiles the ops anyway."""
+
+    def __enter__(self):
+        _local.suspended += 1
+        return self
+
+    def __exit__(self, *exc):
+        _local.suspended -= 1
+        return False
+
+
+def suspend():
+    return _Suspend()
+
+
+# hot-path bindings: resolving these through module attributes costs a
+# microsecond per lookup at ~10 lookups/op — bind once
+_Tracer = jax.core.Tracer
+_FunctionType = types.FunctionType
+
+# non-function callables that are safe to key by identity: module-level
+# singletons whose behavior is fixed at definition time. An arbitrary
+# callable OBJECT (instance with __call__, functools.partial over a
+# mutable object) is refused — its attributes can mutate while id(fn)
+# stays equal, which would serve a program with stale baked-in state.
+import jax.numpy as _jnp  # noqa: E402  (after jax; hot-path type refs)
+
+_STATELESS_CALLABLE_TYPES = (
+    _jnp.ufunc, np.ufunc, types.BuiltinFunctionType,
+    jax.custom_jvp, jax.custom_vjp,
+    # many jnp unary ops (tanh, exp, ...) are pre-jitted PjitFunction
+    # singletons in this jax version
+    type(jax.jit(lambda: None)),
+)
+
+# exact-type memo for the array check: isinstance against the jax.Array
+# ABC walks the abc registry (~7us for two operands on this host); a
+# concrete-class set membership is ~0.1us. Tracers are jax.Array
+# instances, so they are checked first and never enter this set.
+_array_types = set()
+
+
+def _fn_ident(fn):
+    """Cheap, stable identity surrogate for the op callable.
+
+    Plain functions key on their code object (stable across the closure
+    re-binding `apply()` performs; identity-hashed, fast). Known
+    stateless callables (jnp/np ufunc singletons, C builtins,
+    custom_jvp/custom_vjp wrappers like jax.nn.relu) key on id(fn) —
+    hashing the object itself can be arbitrarily slow (jax's
+    ufunc.__hash__ is Python-level, ~7us) and id() is safe here because
+    every cache entry's compiled program closes over fn, holding it
+    alive for the entry's lifetime (a recycled id can therefore never
+    alias a live entry). Everything else is refused: bound methods and
+    arbitrary callable objects carry mutable state (self/attributes)
+    the key cannot see."""
+    t = type(fn)
+    if t is _FunctionType:
+        return fn.__code__
+    if isinstance(fn, _STATELESS_CALLABLE_TYPES):
+        return id(fn)
+    raise TypeError(f"unkeyable op callable of type {t.__name__}")
+
+
+class _Key:
+    """Cache key with its hash computed once: the key tuple is hashed by
+    the lookup, the LRU move, and the warm gate — recomputing a tuple
+    hash each time costs more than the lookups themselves."""
+
+    __slots__ = ("t", "h")
+
+    def __init__(self, t):
+        self.t = t
+        self.h = hash(t)
+
+    def __hash__(self):
+        return self.h
+
+    def __eq__(self, other):
+        return self.t == other.t
+
+
+# ---- op opt-out -----------------------------------------------------------
+
+# fn identities (_fn_ident) that must never be jit-cached: populated by
+# @non_jittable and by learned jit failures. Reads are lock-free (set
+# membership is atomic under the GIL). _non_jittable_refs pins id()-keyed
+# callables so a dead id can never be recycled into a false exemption.
+_non_jittable = set()
+_non_jittable_refs = []
+
+
+def non_jittable(fn):
+    """Decorator: exempt `fn` from forward jit-caching (value-dependent
+    ops — data-dependent output shapes, host-side control flow). The
+    exemption keys on the code object, so it survives the closure
+    re-binding `apply()` performs."""
+    try:
+        ident = _fn_ident(fn)
+    except TypeError:
+        return fn  # bound methods are never cached anyway
+    if ident not in _non_jittable:
+        _non_jittable.add(ident)
+        if not isinstance(ident, types.CodeType):
+            _non_jittable_refs.append(fn)
+    return fn
+
+
+# ---- key construction -----------------------------------------------------
+
+# types that are safely *immutable and hashable by value*: anything else
+# is refused (TypeError -> eager) rather than risked. Identity-hashable
+# mutable objects (Tensor, Layer, arbitrary user objects) must never
+# land in a key: their content can change (set_value, optimizer step)
+# while the key stays equal, which would serve a program with stale
+# baked-in values.
+_ATOM_TYPES = (
+    str, bytes, type(None), type(Ellipsis),
+    type(NotImplemented), range, frozenset,
+    np.dtype, type, types.ModuleType, types.CodeType,
+    enum.Enum, _pdtypes.dtype, jax.tree_util.PyTreeDef,
+)
+# keyed with a type tag (see freeze_static): cross-type Python equality
+# (2 == 2.0 == True) must not collide cache entries
+_NUMERIC_TYPES = (bool, int, float, complex, np.generic)
+
+
+def freeze_static(v):
+    """Hashable, value-based surrogate for a static (non-array) value.
+    Raises TypeError for anything that cannot be keyed safely.
+
+    Numerics are TYPE-TAGGED: Python hashes 2, 2.0, True and np.int32(2)
+    equal and compares them equal, but the programs they bake differ
+    (`pow(x_int32, 2)` stays int32, `pow(x_int32, 2.0)` promotes to
+    float) — a bare-value key would serve the wrong program. ±0.0 also
+    hash equal while `1/v` differs, so zero floats carry their sign."""
+    if isinstance(v, _NUMERIC_TYPES):
+        if isinstance(v, (float, np.floating)) and v == 0.0:
+            return (type(v), v, math.copysign(1.0, v))
+        return (type(v), v)
+    if isinstance(v, _ATOM_TYPES):
+        return v
+    if isinstance(v, jax.core.Tracer):
+        raise TypeError("tracer in op inputs/closure")
+    if isinstance(v, (jax.Array, np.ndarray)):
+        raise TypeError("array captured by value")
+    if isinstance(v, types.FunctionType):
+        if v.__closure__:
+            # a captured function's own captures are opaque — could be
+            # arrays or mutable state; refuse rather than bake
+            raise TypeError("closure-bearing function in op key")
+        return ("f", v.__code__,
+                v.__defaults__ and
+                tuple(freeze_static(d) for d in v.__defaults__),
+                v.__kwdefaults__ and tuple(sorted(
+                    (k, freeze_static(d))
+                    for k, d in v.__kwdefaults__.items())))
+    if isinstance(v, slice):  # unhashable until py3.12
+        return ("s", freeze_static(v.start), freeze_static(v.stop),
+                freeze_static(v.step))
+    if isinstance(v, tuple):
+        return ("t",) + tuple(freeze_static(x) for x in v)
+    if isinstance(v, list):
+        return ("l",) + tuple(freeze_static(x) for x in v)
+    if isinstance(v, dict):
+        return ("d",) + tuple(sorted(
+            (k, freeze_static(x)) for k, x in v.items()))
+    raise TypeError(f"unkeyable static of type {type(v).__name__}")
+
+
+def op_core(fn):
+    """The op-identity portion of a cache key: identity surrogate
+    (_fn_ident), frozen closure cells, frozen defaults. Shared by the
+    forward dispatch and backward pullback caches — any program stored
+    under a key containing this MUST close over fn (see _fn_ident).
+    Raises TypeError/ValueError when unkeyable."""
+    ident = _fn_ident(fn)
+    cells = getattr(fn, "__closure__", None)
+    dflt = getattr(fn, "__defaults__", None)
+    kwd = getattr(fn, "__kwdefaults__", None)
+    if cells is None and dflt is None and kwd is None:
+        return ident
+    return (
+        ident,
+        tuple(freeze_static(c.cell_contents) for c in cells) if cells
+        else None,
+        tuple(freeze_static(d) for d in dflt) if dflt else None,
+        tuple(sorted((k, freeze_static(v)) for k, v in kwd.items()))
+        if kwd else None,
+    )
+
+
+def aval_of(v):
+    """(shape, dtype, weak_type) — the abstract value a jit trace
+    specializes on. weak_type matters: jnp ops promote weak scalars
+    differently, so two programs differing only in weakness are NOT
+    interchangeable."""
+    return (v.shape, v.dtype, bool(getattr(v, "weak_type", False)))
+
+
+# ---- cache ---------------------------------------------------------------
+
+class JitCache:
+    """Bounded, thread-safe LRU of compiled programs with hit/miss/
+    eviction counters. One instance for the forward dispatch, one for
+    the backward pullbacks — one code path for both directions."""
+
+    def __init__(self, name, capacity):
+        self.name = name
+        self.capacity = capacity
+        self._d = collections.OrderedDict()
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+
+    def get(self, key):
+        with self._lock:
+            v = self._d.get(key)
+            if v is not None:
+                self._d.move_to_end(key)
+                self.hits += 1
+            else:
+                self.misses += 1
+            return v
+
+    def put(self, key, val):
+        with self._lock:
+            self._d[key] = val
+            if len(self._d) > self.capacity:
+                self._d.popitem(last=False)
+                self.evictions += 1
+
+    def pop(self, key):
+        with self._lock:
+            self._d.pop(key, None)
+
+    def get_or_build(self, key, builder):
+        """Backward-path entry: one lookup (counted), build outside the
+        lock on miss (compiles must not serialize other threads)."""
+        v = self.get(key)
+        if v is None:
+            v = builder()
+            self.put(key, v)
+        return v
+
+    def __len__(self):
+        with self._lock:
+            return len(self._d)
+
+    def clear(self):
+        with self._lock:
+            self._d.clear()
+
+    def stats(self):
+        with self._lock:
+            n = len(self._d)
+        total = self.hits + self.misses
+        return {
+            "hits": self.hits, "misses": self.misses,
+            "evictions": self.evictions, "size": n,
+            "capacity": self.capacity,
+            "hit_rate": (self.hits / total) if total else None,
+        }
+
+    def reset_counters(self):
+        self.hits = self.misses = self.evictions = 0
+
+
+def _cap(env, default):
+    try:
+        return max(8, int(os.environ.get(env, default)))
+    except ValueError:
+        return default
+
+
+FORWARD = JitCache("forward", _cap("PADDLE_TPU_DISPATCH_CACHE_SIZE", 1024))
+BACKWARD = JitCache("backward", _cap("PADDLE_TPU_PULLBACK_CACHE_SIZE", 512))
+
+# full-key sighting counts for the warm gate (bounded so churning keys
+# can't grow it without limit)
+_SEEN_CAP = 8192
+_seen = collections.OrderedDict()
+_seen_lock = threading.Lock()
+
+# forward-path outcome counters not tied to a cache lookup
+_counters = {
+    "bypasses": 0,    # disabled / suspended / recorder / opted-out
+    "unkeyable": 0,   # key construction refused -> eager
+    "fallbacks": 0,   # jit failed, eager succeeded -> learned eager
+    "warming": 0,     # below warm count -> eager, no compile yet
+}
+
+# per-op-identity record: ident -> [name, hits, misses, retraces,
+#                                    miss_streak, compiled_count, warned,
+#                                    jit_failures]
+# (one dict lookup on the hot path; snapshot aggregation happens in
+# dispatch_stats, off the hot path)
+_op_stats = {}
+_op_stats_lock = threading.Lock()
+
+_HITS, _MISSES, _RETRACES, _STREAK, _COMPILED, _WARNED, _JIT_FAILS = \
+    range(1, 8)
+
+# deterministic "this can never trace" errors -> learn non-jittable on
+# first sight; anything else (transient runtime failure, OOM) only after
+# repeated failures, so one bad moment can't permanently degrade a
+# shared generic wrapper (e.g. the getitem code object behind every
+# Tensor.__getitem__) to eager for the process lifetime
+_TRACE_ERRORS = (
+    jax.errors.ConcretizationTypeError,      # includes TracerBool/Array/...
+    jax.errors.TracerArrayConversionError,
+    jax.errors.TracerBoolConversionError,
+    jax.errors.TracerIntegerConversionError,
+    jax.errors.NonConcreteBooleanIndexError,
+    jax.errors.UnexpectedTracerError,
+)
+_JIT_FAIL_LIMIT = 3
+
+
+def _note_hit(ident):
+    ent = _op_stats.get(ident)
+    if ent is not None:  # absent after a counter reset over a warm cache
+        ent[_HITS] += 1
+        ent[_STREAK] = 0
+        # a serving cache entry proves the op jits: decay the failure
+        # count so only CONSECUTIVE jit failures (the entry is popped on
+        # each, so no hit intervenes) can demote the op to eager —
+        # isolated transient failures over a long process must not
+        # accumulate into a permanent demotion
+        ent[_JIT_FAILS] = 0
+
+
+def _note_miss(name, ident):
+    ent = _op_stats.get(ident)
+    if ent is None:
+        with _op_stats_lock:
+            ent = _op_stats.setdefault(ident,
+                                       [name, 0, 0, 0, 0, 0, False, 0])
+    ent[_MISSES] += 1
+    ent[_STREAK] += 1
+    if ent[_COMPILED] > 0:
+        ent[_RETRACES] += 1  # this op identity had compiled before
+    if (_RETRACE_WARN_STREAK and not ent[_WARNED]
+            and ent[_STREAK] >= _RETRACE_WARN_STREAK):
+        ent[_WARNED] = True
+        warnings.warn(
+            f"paddle_tpu eager dispatch: op '{name}' missed the jit "
+            f"cache {ent[_STREAK]} calls in a row — its input shapes or "
+            "static arguments change on every call (dynamic shapes?), "
+            "so it recompiles (or stays eager) every step. Pad to "
+            "stable shapes, or mark the op @non_jittable to silence "
+            "this.", stacklevel=3)
+    return ent
+
+
+def dispatch_stats():
+    """Snapshot of the dispatch layer (profiler-visible)."""
+    fwd = FORWARD.stats()
+    fwd.update(_counters)
+    per_op = {}
+    for ent in list(_op_stats.values()):
+        agg = per_op.setdefault(ent[0],
+                                {"hits": 0, "misses": 0, "retraces": 0})
+        agg["hits"] += ent[_HITS]
+        agg["misses"] += ent[_MISSES]
+        agg["retraces"] += ent[_RETRACES]
+    return {
+        "enabled": _enabled,
+        "warmup_count": _warmup_count,
+        "forward": fwd,
+        "backward": BACKWARD.stats(),
+        "per_op": per_op,
+        "non_jittable_ops": len(_non_jittable),
+    }
+
+
+def reset_dispatch_stats(clear_caches=False):
+    """Zero the counters (and optionally drop the compiled programs and
+    warm-gate sightings — tests use this for a cold start)."""
+    FORWARD.reset_counters()
+    BACKWARD.reset_counters()
+    for k in _counters:
+        _counters[k] = 0
+    with _op_stats_lock:
+        _op_stats.clear()
+    if clear_caches:
+        FORWARD.clear()
+        BACKWARD.clear()
+        with _seen_lock:
+            _seen.clear()
+
+
+# ---- the dispatch ---------------------------------------------------------
+
+def _build_program(fn, treedef, statics_map, arr_pos, n_vals, name):
+    """jit-compiled program for one cache key: array leaves in, statics
+    closed over (they are part of the key, so baking them is sound).
+    `statics_map` maps leaf position -> ORIGINAL value."""
+
+    def _op(*arr_vals):
+        v = [None] * n_vals
+        for i, s in statics_map.items():
+            v[i] = s
+        for p, a in zip(arr_pos, arr_vals):
+            v[p] = a
+        a, kw = jax.tree_util.tree_unflatten(treedef, v)
+        return fn(*a, **kw)
+
+    _op.__name__ = name
+    return jax.jit(_op)
+
+
+def run_op(fn, vals, treedef, fallback, name=None):
+    """Execute one eager op through the jit cache; `fallback` is the
+    zero-arg plain-eager closure (apply()'s `closed`). Returns fn's
+    output tree, identical to `fallback()` up to jit's array-ification
+    of non-array output leaves (apply wraps every leaf in Tensor either
+    way)."""
+    if not _enabled or _local.suspended or fn is None:
+        _counters["bypasses"] += 1
+        return fallback()
+    try:
+        ident = _fn_ident(fn)
+    except TypeError:
+        _counters["unkeyable"] += 1
+        return fallback()
+    if ident in _non_jittable:
+        _counters["bypasses"] += 1
+        return fallback()
+    try:
+        arr_pos = []
+        static_pos = []
+        statics = []
+        avals = []
+        atypes = _array_types
+        for i, v in enumerate(vals):
+            if type(v) in atypes:
+                arr_pos.append(i)
+                avals.append((v.shape, v.dtype,
+                              getattr(v, "weak_type", False)))
+                continue
+            if isinstance(v, _Tracer):
+                # inside an enclosing jit/shard_map trace: the outer
+                # program will compile this op; nesting adds nothing
+                _counters["bypasses"] += 1
+                return fallback()
+            if isinstance(v, (jax.Array, np.ndarray)):
+                atypes.add(type(v))
+                arr_pos.append(i)
+                avals.append(aval_of(v))
+            else:
+                static_pos.append(i)
+                statics.append((i, freeze_static(v)))
+        key = _Key((op_core(fn), treedef, tuple(statics), tuple(avals)))
+    except (TypeError, ValueError):
+        # unkeyable (captured array/Tensor/unhashable static, unbound
+        # cell) — plain eager preserves semantics exactly (this is what
+        # keeps dropout's per-call PRNG key fresh)
+        _counters["unkeyable"] += 1
+        return fallback()
+
+    jitted = FORWARD.get(key)
+    if jitted is None:
+        if name is None:
+            name = getattr(fn, "__name__", "op")
+        guard = _note_miss(name, ident)
+        with _seen_lock:
+            n_seen = _seen.get(key, 0) + 1
+            _seen[key] = n_seen
+            _seen.move_to_end(key)
+            if len(_seen) > _SEEN_CAP:
+                _seen.popitem(last=False)
+        if n_seen < _warmup_count:
+            # cold key: eager, no compile — one-shot op/shape combos
+            # never pay XLA compile time
+            _counters["warming"] += 1
+            return fallback()
+        # the program closes over the ORIGINAL static values (the frozen
+        # surrogates in `statics` are key-only stand-ins — a slice leaf
+        # must reach fn as a slice, not as its hashable encoding)
+        jitted = _build_program(fn, treedef,
+                                {i: vals[i] for i in static_pos},
+                                tuple(arr_pos), len(vals), name)
+        FORWARD.put(key, jitted)
+        guard[_COMPILED] += 1
+    else:
+        _note_hit(ident)
+    try:
+        return jitted(*[vals[i] for i in arr_pos])
+    except Exception as e:
+        # Either the op is unjittable (data-dependent shapes, host
+        # control flow) or the call is genuinely bad. The eager rerun
+        # decides: if it also fails, that error is the canonical one
+        # and propagates; if it succeeds, the failure was jit-specific.
+        # Deterministic trace errors learn the op non-jittable at once;
+        # other errors (a transient runtime failure on a shared generic
+        # wrapper) only after repeating — the dropped entry otherwise
+        # just recompiles and recovers.
+        FORWARD.pop(key)
+        out = fallback()
+        _counters["fallbacks"] += 1
+        ent = _op_stats.get(ident)
+        if ent is None:  # failure on a hit served right after a reset
+            with _op_stats_lock:
+                ent = _op_stats.setdefault(
+                    ident,
+                    [getattr(fn, "__name__", "op"), 0, 0, 0, 0, 0, False, 0])
+        ent[_JIT_FAILS] += 1
+        if isinstance(e, _TRACE_ERRORS) or ent[_JIT_FAILS] >= _JIT_FAIL_LIMIT:
+            _non_jittable.add(ident)
+            if not isinstance(ident, types.CodeType):
+                _non_jittable_refs.append(fn)
+        return out

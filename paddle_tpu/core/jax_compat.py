@@ -1,0 +1,54 @@
+"""Version-compat shims over the installed JAX.
+
+One import site per moved symbol: JAX relocates APIs across minor
+versions (shard_map graduated from jax.experimental to the top level
+after 0.4.x), and a bare `from jax import shard_map` at module scope
+turns a version skew into an ImportError that takes down every
+transitive importer — on this repo that single line dark-ened 48/72
+test files. All paddle_tpu modules (and tests) import the symbol from
+here instead; the shim resolves the best available location once at
+import time and FEATURE-DETECTS the kwarg dialect from the resolved
+function's signature (import location and kwarg renames landed in
+different JAX versions, so inferring one from the other leaves a skew
+window).
+"""
+from __future__ import annotations
+
+import functools as _functools
+import inspect as _inspect
+
+__all__ = ["shard_map"]
+
+try:  # jax >= 0.5: top-level export
+    from jax import shard_map as _shard_map  # type: ignore[attr-defined]
+except ImportError:  # 0.4.x: experimental home
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+try:
+    _params = _inspect.signature(_shard_map).parameters
+    _HAS_VMA = "check_vma" in _params
+    _HAS_AXIS_NAMES = "axis_names" in _params
+except (TypeError, ValueError):  # unsignaturable wrapper: assume modern
+    _HAS_VMA = _HAS_AXIS_NAMES = True
+
+if _HAS_VMA and _HAS_AXIS_NAMES:
+    shard_map = _shard_map
+else:
+    @_functools.wraps(_shard_map)
+    def shard_map(f=None, *args, **kwargs):
+        # call sites target the modern kwarg names; translate what the
+        # resolved shard_map doesn't accept:
+        #   check_vma=...   -> check_rep=...
+        #   axis_names={..} -> auto=frozenset(mesh axes) - {..}
+        # (the modern API names the MANUAL axes; 0.4.x names the AUTO
+        # complement)
+        if not _HAS_VMA and "check_vma" in kwargs:
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+        if not _HAS_AXIS_NAMES and "axis_names" in kwargs:
+            manual = frozenset(kwargs.pop("axis_names"))
+            mesh = kwargs.get("mesh") or (args[0] if args else None)
+            if mesh is not None and manual:
+                kwargs["auto"] = frozenset(mesh.axis_names) - manual
+        if f is None:  # bare decorator-factory form
+            return _functools.partial(shard_map, *args, **kwargs)
+        return _shard_map(f, *args, **kwargs)

@@ -18,6 +18,8 @@ from . import dtype as dtypes
 __all__ = ["Tensor", "to_tensor"]
 
 _tensor_count = 0
+# one-shot dispatch opt-out of the scalar-row getitem code object
+_row_getitem_registered = False
 
 
 class Tensor:
@@ -195,7 +197,10 @@ class Tensor:
     def clone(self):
         from .autograd import apply
 
-        return apply(lambda x: x + jnp.zeros((), x.dtype), self)
+        def clone(x):
+            return x + jnp.zeros((), x.dtype)
+
+        return apply(clone, self)
 
     def register_hook(self, hook):
         """Register a gradient hook (reference Tensor.register_hook):
@@ -270,7 +275,35 @@ class Tensor:
         from .autograd import apply
 
         idx = _unwrap_index(idx)
-        return apply(lambda x: x[idx], self)
+
+        if isinstance(idx, (int, np.integer)):
+            # scalar row indexing is iteration-shaped (__iter__ below,
+            # dataset[i] loops): the index lives in the closure, so the
+            # dispatch cache would compile ONE program PER DISTINCT
+            # index — n compiles (and cache thrash past the LRU cap) for
+            # work that is microseconds eager. A distinct code object,
+            # opted out (once — the code object is shared by every
+            # call), keeps slice/tuple indexing cacheable.
+            def getitem_row(x):
+                return x[idx]
+
+            global _row_getitem_registered
+            if not _row_getitem_registered:
+                from .dispatch import non_jittable
+
+                non_jittable(getitem_row)
+                _row_getitem_registered = True
+            return apply(getitem_row, self)
+
+        # named (not a bare lambda) so the dispatch cache's per-op stats
+        # attribute hits/misses to "getitem"; a slice/tuple index keys the
+        # cached program by value, an array index (boolean mask — dynamic
+        # output shape) is unkeyable and runs eager, which is exactly the
+        # required bypass
+        def getitem(x):
+            return x[idx]
+
+        return apply(getitem, self)
 
     def __setitem__(self, idx, v):
         idx = _unwrap_index(idx)

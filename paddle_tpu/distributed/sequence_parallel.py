@@ -14,7 +14,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+from ..core.jax_compat import shard_map
 from jax.sharding import PartitionSpec as P
 
 from ..core.autograd import apply

@@ -61,8 +61,13 @@ class _JitStepEngine:
         # re-entrant-trace heisenbug (round-3 verdict weak #7)
         from ..nn.layer.layers import training_mode
 
+        # suspend the per-op dispatch cache: this body is traced into one
+        # fused program, so nested per-op jit entries would only add
+        # trace-time overhead and throwaway cache keys
+        from ..core import dispatch as _dispatch
+
         with training_mode(training, net.sublayers(include_self=True)), \
-                rnd.key_scope(key), _ag.no_grad():
+                rnd.key_scope(key), _ag.no_grad(), _dispatch.suspend():
             ctx = None
             if amp_level:
                 from .. import amp as amp_mod
@@ -490,8 +495,12 @@ class Model:
             jit_states = sd.pop("_jit_states", None)
             self._optimizer.set_state_dict(sd)
             if jit_states is not None:
+                # _load wraps leaf arrays in Tensor; unwrap before
+                # jnp.asarray (a Tensor is a pytree node, not an array)
                 self._engine._opt_states = {
-                    int(k): {kk: jnp.asarray(vv) for kk, vv in v.items()}
+                    int(k): {kk: jnp.asarray(
+                        vv._value if isinstance(vv, Tensor) else vv)
+                        for kk, vv in v.items()}
                     for k, v in jit_states.items()}
         return self
 

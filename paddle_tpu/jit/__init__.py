@@ -70,7 +70,14 @@ class StaticFunction:
         target = self._target
 
         def fn(param_vals, buf_vals, key, *arg_vals):
-            with rnd.key_scope(key), _ag.no_grad():
+            # the whole body is traced into ONE program here; suspend the
+            # per-op dispatch cache so ops don't each build a nested-jit
+            # cache entry keyed on this trace's intermediate avals (the
+            # tracer bypass would catch array-input ops anyway, but
+            # zero-input creation ops would slip through)
+            from ..core import dispatch as _dispatch
+
+            with rnd.key_scope(key), _ag.no_grad(), _dispatch.suspend():
                 if layer is not None:
                     # scoped override, not live flag mutation: this fn is
                     # traced under jax.jit, where a re-entrant trace would

@@ -18,9 +18,11 @@ import warnings
 
 import jax
 
+from ..core.dispatch import dispatch_stats, reset_dispatch_stats
+
 __all__ = ["Profiler", "ProfilerTarget", "ProfilerState", "RecordEvent",
            "make_scheduler", "export_chrome_tracing", "load_profiler_result",
-           "SummaryView"]
+           "SummaryView", "dispatch_stats", "reset_dispatch_stats"]
 
 
 class ProfilerTarget(enum.Enum):
@@ -195,6 +197,19 @@ class Profiler:
     def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
                 time_unit="ms", views=None):
         print(self.step_info())
+        ds = dispatch_stats()
+        fwd, bwd = ds["forward"], ds["backward"]
+        hr = fwd["hit_rate"]
+        print(f"eager dispatch cache: fwd {fwd['hits']} hits / "
+              f"{fwd['misses']} misses"
+              + (f" ({hr:.1%} hit rate)" if hr is not None else "")
+              + f", bwd {bwd['hits']}/{bwd['misses']}, "
+              f"{fwd['size']}+{bwd['size']} cached programs")
+        if op_detail and ds["per_op"]:
+            churn = {k: v for k, v in ds["per_op"].items()
+                     if v["retraces"] > 2}
+            if churn:
+                print(f"  retrace-heavy ops (dynamic shapes?): {churn}")
         if self._dir:
             print(f"trace artifacts: {self._dir}")
 

@@ -8,6 +8,12 @@ on small dummy values (dynamic dims pinned to 1) AND appends an op record —
 replays the record as ONE jit-compiled XLA function of (params, feeds), so
 the whole graph compiles into a single device program: strictly better than
 the reference's op-by-op kernel launches.
+
+Dispatch-cache interplay: while a recorder is installed, apply() takes the
+recorder branch BEFORE the jit-cached dispatch (core/dispatch.py), so
+build-time ops run plain-eager on the dummy values — the recorded `op.fn`
+is replayed inside the Executor's single whole-graph jit, where per-op
+cache entries (keyed on throwaway dummy shapes) would be pure overhead.
 """
 from __future__ import annotations
 

@@ -73,7 +73,7 @@ def main():
     np.testing.assert_allclose(w_local, w_ref, rtol=1e-5, atol=1e-6)
 
     # explicit collective over the process boundary: psum of rank+1
-    from jax import shard_map
+    from paddle_tpu.core.jax_compat import shard_map
 
     @functools.partial(shard_map, mesh=mesh, in_specs=P("dp"),
                        out_specs=P())  # replicated: fetchable everywhere
@@ -98,7 +98,7 @@ def _hybrid_dp_tp(pid):
     'local ICI', the dp gradient sum crosses the process boundary."""
     import jax
     import jax.numpy as jnp
-    from jax import shard_map
+    from paddle_tpu.core.jax_compat import shard_map
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
     devs = np.array(jax.devices()).reshape(2, 2)  # [dp, tp]

@@ -1,0 +1,424 @@
+"""Jit-cached eager op dispatch (core/dispatch.py).
+
+Covers the contract: hit/miss keying across shapes/dtypes/statics,
+frozen-closure snapshot semantics, no_grad vs grad paths, AMP-enabled
+keying, the shape-churn retrace guard, the PADDLE_TPU_EAGER_JIT=0
+bypass, and the headline acceptance: a 100-iteration small-MLP eager
+train loop serves ≥99% of op calls from the cache after warmup.
+"""
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu.core import dispatch
+from paddle_tpu.core.autograd import apply
+from paddle_tpu.core.tensor import Tensor
+
+
+@pytest.fixture(autouse=True)
+def _fresh_cache():
+    """Cold cache + compile-on-first-sighting so hits/misses are exact."""
+    prev_warm = dispatch.set_warmup_count(1)
+    prev_on = dispatch.set_eager_jit(True)
+    dispatch.reset_dispatch_stats(clear_caches=True)
+    yield
+    dispatch.set_warmup_count(prev_warm)
+    dispatch.set_eager_jit(prev_on)
+    dispatch.reset_dispatch_stats(clear_caches=True)
+
+
+def _fwd():
+    return dispatch.dispatch_stats()["forward"]
+
+
+def _t(arr, stop_gradient=True):
+    return paddle.to_tensor(np.asarray(arr), stop_gradient=stop_gradient)
+
+
+# ---- keying ---------------------------------------------------------------
+
+def test_stable_shape_hits():
+    x = _t(np.ones((4, 8), np.float32))
+    y = _t(np.ones((4, 8), np.float32))
+    for _ in range(5):
+        z = paddle.add(x, y)
+    s = _fwd()
+    assert s["misses"] == 1 and s["hits"] == 4
+    np.testing.assert_allclose(np.asarray(z._value), 2.0)
+
+
+def test_shape_and_dtype_miss():
+    a32 = _t(np.ones((4, 8), np.float32))
+    paddle.add(a32, a32)                       # miss: first sighting
+    paddle.add(a32, a32)                       # hit
+    b = _t(np.ones((2, 8), np.float32))
+    paddle.add(b, b)                           # miss: new shape
+    c = _t(np.ones((4, 8), np.float64))
+    paddle.add(c, c)                           # miss: new dtype
+    s = _fwd()
+    assert s["misses"] == 3 and s["hits"] == 1
+
+
+def test_static_args_key_by_value():
+    x = _t(np.arange(12, dtype=np.float32).reshape(3, 4))
+    r0 = paddle.sum(x, axis=0)                 # miss
+    r0b = paddle.sum(x, axis=0)                # hit (same static)
+    r1 = paddle.sum(x, axis=1)                 # miss (different static)
+    s = _fwd()
+    assert s["misses"] == 2 and s["hits"] == 1
+    np.testing.assert_allclose(np.asarray(r0._value),
+                               np.asarray(r0b._value))
+    assert list(r1.shape) == [3]
+
+
+def test_cross_type_statics_do_not_collide():
+    """Python hashes 2 == 2.0 == True, but the baked programs differ:
+    pow(int32, 2) stays int32 while pow(int32, 2.0) promotes. The key
+    must type-tag numeric statics."""
+    x = _t(np.arange(4, dtype=np.int32))
+    a = paddle.pow(x, 2.0)
+    b = paddle.pow(x, 2)
+    assert "int32" in str(b.dtype), (a.dtype, b.dtype)
+    np.testing.assert_allclose(np.asarray(b._value), [0, 1, 4, 9])
+    # ±0.0 hash equal too but 1/v differs
+    y = _t(np.float32([1.0]))
+    import jax.numpy as jnp
+
+    def scl(v, s):
+        return 1.0 / (v * s + jnp.float32(0))
+
+    pos = apply(scl, _t(np.float32([0.0])), 0.0)
+    neg = apply(scl, _t(np.float32([0.0])), -0.0)
+    assert np.asarray(pos._value)[0] > 0 > np.asarray(neg._value)[0]
+    del y
+
+
+def test_weak_type_in_key():
+    """A weak-typed scalar operand must not collide with a strong one:
+    promotion differs, so the emitted programs differ."""
+    import jax.numpy as jnp
+
+    x = _t(np.ones((4,), np.float32))
+    weak = Tensor(jnp.asarray(1.0))            # weak-typed f32 scalar
+    strong = Tensor(jnp.ones((), jnp.float32))
+    assert weak._value.weak_type and not strong._value.weak_type
+    paddle.add(x, weak)
+    paddle.add(x, strong)
+    assert _fwd()["misses"] == 2
+
+
+def test_unhashable_static_leaf_reaches_fn_intact():
+    """A slice passed as an op ARG is a tree leaf: the key stores its
+    hashable encoding, but the compiled program must close over the real
+    slice object."""
+    x = _t(np.arange(10, dtype=np.float32))
+
+    def take(v, sl):
+        return v[sl]
+
+    a = apply(take, x, slice(2, 5))            # miss (compiles)
+    b = apply(take, x, slice(2, 5))            # hit
+    c = apply(take, x, slice(1, 3))            # different static -> miss
+    np.testing.assert_allclose(np.asarray(a._value), [2, 3, 4])
+    np.testing.assert_allclose(np.asarray(b._value), [2, 3, 4])
+    np.testing.assert_allclose(np.asarray(c._value), [1, 2])
+    s = _fwd()
+    assert s["misses"] == 2 and s["hits"] == 1
+
+
+# ---- closure snapshot -----------------------------------------------------
+
+def test_closure_rebinding_frozen_snapshot():
+    x = _t(np.ones((3,), np.float32))
+    scale = 2.0
+
+    def op(v):
+        return v * scale
+
+    a = apply(op, x)
+    scale = 5.0
+    b = apply(op, x)          # new cell value -> new key, fresh program
+    c = apply(op, x)          # hit on the scale=5.0 entry
+    np.testing.assert_allclose(np.asarray(a._value), 2.0)
+    np.testing.assert_allclose(np.asarray(b._value), 5.0)
+    np.testing.assert_allclose(np.asarray(c._value), 5.0)
+    s = _fwd()
+    assert s["misses"] == 2 and s["hits"] == 1
+
+
+def test_captured_array_never_cached():
+    """A closure over a live array (dropout's PRNG key pattern) must
+    bypass the cache — caching would freeze the captured value."""
+    x = _t(np.ones((8,), np.float32))
+    import jax.numpy as jnp
+
+    seen = []
+    for i in range(3):
+        k = jnp.full((8,), float(i), jnp.float32)
+
+        def op(v):
+            return v + k
+
+        seen.append(float(np.asarray(apply(op, x)._value)[0]))
+    assert seen == [1.0, 2.0, 3.0]
+    s = _fwd()
+    assert s["hits"] == 0 and s["misses"] == 0 and s["unkeyable"] == 3
+
+
+def test_dropout_randomness_survives():
+    x = _t(np.ones((1000,), np.float32))
+    m1 = np.asarray(F.dropout(x, p=0.5)._value)
+    m2 = np.asarray(F.dropout(x, p=0.5)._value)
+    assert not np.array_equal(m1, m2)
+
+
+# ---- grad paths -----------------------------------------------------------
+
+def test_no_grad_and_grad_share_entries_and_agree():
+    xv = np.linspace(-1, 1, 12).astype(np.float32).reshape(3, 4)
+    with paddle.no_grad():
+        y_ng = paddle.tanh(_t(xv))             # miss
+    x = _t(xv, stop_gradient=False)
+    y_g = paddle.tanh(x)                       # hit: same forward program
+    s = _fwd()
+    assert s["misses"] == 1 and s["hits"] == 1
+    np.testing.assert_allclose(np.asarray(y_ng._value),
+                               np.asarray(y_g._value))
+    y_g.sum().backward()
+    np.testing.assert_allclose(np.asarray(x.grad._value),
+                               1.0 - np.tanh(xv) ** 2, rtol=1e-6)
+
+
+def test_backward_cache_shares_infrastructure():
+    x = _t(np.ones((4,), np.float32), stop_gradient=False)
+    for _ in range(3):
+        y = (x * x).sum()
+        y.backward()
+    bwd = dispatch.dispatch_stats()["backward"]
+    assert bwd["misses"] >= 1 and bwd["hits"] >= bwd["misses"]
+
+
+# ---- AMP ------------------------------------------------------------------
+
+def test_amp_cast_is_part_of_the_key():
+    a = _t(np.ones((8, 8), np.float32))
+    b = _t(np.ones((8, 8), np.float32))
+    paddle.matmul(a, b)                        # f32 program
+    with paddle.amp.auto_cast():
+        out = paddle.matmul(a, b)              # white-list -> bf16 program
+    assert "bfloat16" in str(out.dtype)
+    s = _fwd()
+    # the two matmuls cannot share an entry (different post-cast avals)
+    assert s["misses"] == 2
+    with paddle.amp.auto_cast():
+        paddle.matmul(a, b)                    # hit on the bf16 entry
+    assert _fwd()["hits"] == 1
+
+
+# ---- retrace guard --------------------------------------------------------
+
+def test_retrace_guard_warns_on_shape_churn():
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        for n in range(3, 20):                 # new shape every call
+            paddle.exp(_t(np.ones((n,), np.float32)))
+    msgs = [str(x.message) for x in w
+            if "missed the jit cache" in str(x.message)]
+    assert len(msgs) == 1                      # warns once, not per call
+    assert "exp" in msgs[0]
+    per_op = dispatch.dispatch_stats()["per_op"]["exp"]
+    assert per_op["retraces"] > 0
+
+
+def test_stable_shapes_do_not_warn():
+    x = _t(np.ones((4,), np.float32))
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        for _ in range(30):
+            paddle.exp(x)
+    assert not [x for x in w if "missed the jit cache" in str(x.message)]
+
+
+# ---- escape hatch ---------------------------------------------------------
+
+def _mlp_step(x, y, w1, b1, w2, b2):
+    h = paddle.nn.functional.relu(paddle.matmul(x, w1) + b1)
+    p = paddle.matmul(h, w2) + b2
+    loss = ((p - y) * (p - y)).mean()
+    loss.backward()
+    grads = [np.asarray(t.grad._value) for t in (w1, b1, w2, b2)]
+    for t in (w1, b1, w2, b2):
+        t.clear_grad()
+    return float(np.asarray(loss._value)), grads
+
+
+def test_eager_jit_off_bypass_equivalence():
+    rng = np.random.RandomState(0)
+    xv = rng.randn(8, 16).astype(np.float32)
+    yv = rng.randn(8, 4).astype(np.float32)
+    ws = [rng.randn(16, 32).astype(np.float32) * 0.1,
+          np.zeros(32, np.float32),
+          rng.randn(32, 4).astype(np.float32) * 0.1,
+          np.zeros(4, np.float32)]
+
+    def run():
+        params = [_t(w.copy(), stop_gradient=False) for w in ws]
+        return _mlp_step(_t(xv), _t(yv), *params)
+
+    loss_on, grads_on = run()
+    assert _fwd()["misses"] > 0               # the cache actually engaged
+
+    dispatch.set_eager_jit(False)
+    dispatch.reset_dispatch_stats()
+    loss_off, grads_off = run()
+    s = _fwd()
+    assert s["misses"] == 0 and s["hits"] == 0 and s["bypasses"] > 0
+
+    np.testing.assert_allclose(loss_on, loss_off, rtol=1e-5)
+    for g_on, g_off in zip(grads_on, grads_off):
+        np.testing.assert_allclose(g_on, g_off, rtol=1e-5, atol=1e-6)
+
+
+def test_env_escape_hatch_reaches_module_flag(monkeypatch):
+    """PADDLE_TPU_EAGER_JIT=0 must produce a disabled dispatch layer on
+    import (checked against the module's own env parser)."""
+    monkeypatch.setenv("PADDLE_TPU_EAGER_JIT", "0")
+    assert dispatch._env_flag("PADDLE_TPU_EAGER_JIT", "1") is False
+    monkeypatch.setenv("PADDLE_TPU_EAGER_JIT", "1")
+    assert dispatch._env_flag("PADDLE_TPU_EAGER_JIT", "1") is True
+
+
+# ---- non_jittable opt-out -------------------------------------------------
+
+def test_non_jittable_opt_out():
+    @dispatch.non_jittable
+    def host_op(v):
+        return v * 2.0
+
+    x = _t(np.ones((4,), np.float32))
+    for _ in range(3):
+        out = apply(host_op, x)
+    s = _fwd()
+    assert s["hits"] == 0 and s["misses"] == 0 and s["bypasses"] >= 3
+    np.testing.assert_allclose(np.asarray(out._value), 2.0)
+
+
+def test_learned_fallback_on_unjittable_op():
+    """An op that traces to a host-side conversion fails under jit,
+    succeeds eagerly, and is learned as non-jittable — no retry loop."""
+    def hostish(v):
+        return v * float(v.sum())              # float() on a tracer raises
+
+    x = _t(np.ones((4,), np.float32))
+    out1 = apply(hostish, x)
+    out2 = apply(hostish, x)
+    np.testing.assert_allclose(np.asarray(out1._value), 4.0)
+    np.testing.assert_allclose(np.asarray(out2._value), 4.0)
+    s = _fwd()
+    assert s["fallbacks"] == 1 and s["bypasses"] >= 1
+
+
+def test_row_iteration_never_compiles_per_index():
+    """Scalar-int indexing is iteration-shaped (Tensor.__iter__,
+    dataset[i]): it must bypass the cache — one compiled program per
+    distinct index would thrash the LRU every epoch."""
+    t = _t(np.arange(40, dtype=np.float32).reshape(10, 4))
+    for _ in range(3):                     # three epochs of row iteration
+        rows = [np.asarray(r._value) for r in t]
+    s = _fwd()
+    assert s["misses"] == 0 and s["size"] == 0, s
+    assert s["bypasses"] >= 30
+    np.testing.assert_allclose(rows[3], [12, 13, 14, 15])
+    t[2:5]                                 # slice indexing still caches
+    assert _fwd()["misses"] == 1
+
+
+def test_stateful_callable_object_never_cached():
+    """A callable OBJECT keys by identity while its attributes can
+    mutate — it must bypass the cache (stale-bake hazard)."""
+    class Scaler:
+        def __init__(self, s):
+            self.s = s
+
+        def __call__(self, v):
+            return v * self.s
+
+    sc = Scaler(2.0)
+    x = _t(np.ones((4,), np.float32))
+    a = apply(sc, x)
+    sc.s = 5.0
+    b = apply(sc, x)
+    np.testing.assert_allclose(np.asarray(a._value), 2.0)
+    np.testing.assert_allclose(np.asarray(b._value), 5.0)
+    s = _fwd()
+    assert s["hits"] == 0 and s["misses"] == 0 and s["unkeyable"] == 2
+
+
+def test_kwonly_defaults_distinguish_function_statics():
+    """Two same-code functions differing only in keyword-only defaults
+    must not collide when passed as static args."""
+    def make(a):
+        def act(v, *, s=a):
+            return v * s
+        return act
+
+    def op(x, actfn):
+        return actfn(x)
+
+    x = _t(np.ones((4,), np.float32))
+    r2 = apply(op, x, make(2.0))
+    r5 = apply(op, x, make(5.0))
+    np.testing.assert_allclose(np.asarray(r2._value), 2.0)
+    np.testing.assert_allclose(np.asarray(r5._value), 5.0)
+    assert _fwd()["misses"] == 2
+
+
+def test_genuine_errors_still_raise():
+    a = _t(np.ones((3, 4), np.float32))
+    b = _t(np.ones((5, 6), np.float32))
+    with pytest.raises(Exception):
+        paddle.matmul(a, b)
+
+
+# ---- acceptance: hot-loop hit rate ---------------------------------------
+
+def test_mlp_train_loop_hit_rate_after_warmup():
+    """ISSUE acceptance: ≥99% of eager op calls served from cache over a
+    100-iteration small-MLP train loop after warmup, per
+    dispatch_stats()."""
+    rng = np.random.RandomState(7)
+    x = _t(rng.randn(16, 8).astype(np.float32))
+    y = _t(rng.randn(16, 2).astype(np.float32))
+    params = [
+        _t(rng.randn(8, 16).astype(np.float32) * 0.1, stop_gradient=False),
+        _t(np.zeros(16, np.float32), stop_gradient=False),
+        _t(rng.randn(16, 2).astype(np.float32) * 0.1, stop_gradient=False),
+        _t(np.zeros(2, np.float32), stop_gradient=False),
+    ]
+    opt = paddle.optimizer.SGD(learning_rate=0.01, parameters=params)
+
+    def step():
+        h = F.relu(paddle.matmul(x, params[0]) + params[1])
+        p = paddle.matmul(h, params[2]) + params[3]
+        loss = ((p - y) * (p - y)).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    for _ in range(3):                         # warmup: compile everything
+        step()
+    dispatch.reset_dispatch_stats()            # counters only, keep cache
+    for _ in range(100):
+        loss = step()
+    s = dispatch.dispatch_stats()
+    fwd = s["forward"]
+    assert fwd["hits"] + fwd["misses"] > 0
+    assert fwd["hit_rate"] >= 0.99, f"forward stats: {fwd}"
+    assert s["backward"]["hit_rate"] >= 0.99, f"backward: {s['backward']}"
+    # nothing on the hot loop should be silently eager
+    assert fwd["unkeyable"] == 0 and fwd["fallbacks"] == 0
+    assert np.isfinite(float(np.asarray(loss._value)))

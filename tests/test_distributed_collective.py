@@ -9,6 +9,7 @@ import pytest
 from jax.sharding import PartitionSpec as P
 
 import paddle_tpu as paddle
+from paddle_tpu.core.jax_compat import shard_map
 import paddle_tpu.distributed as dist
 
 N = 8
@@ -173,21 +174,21 @@ def test_traced_collectives_in_shard_map():
     def red(x):
         return dist.all_reduce(paddle.Tensor(x))._value
 
-    y = jax.shard_map(red, mesh=mesh, in_specs=P("dp"), out_specs=P("dp"),
+    y = shard_map(red, mesh=mesh, in_specs=P("dp"), out_specs=P("dp"),
                       check_vma=False)(np.arange(N, dtype=np.float32))
     np.testing.assert_allclose(np.asarray(y), np.full(N, 28.0))
 
     def gather(x):
         return dist.all_gather(None, paddle.Tensor(x))._value
 
-    y = jax.shard_map(gather, mesh=mesh, in_specs=P("dp"), out_specs=P(None),
+    y = shard_map(gather, mesh=mesh, in_specs=P("dp"), out_specs=P(None),
                       check_vma=False)(np.arange(N, dtype=np.float32))
     np.testing.assert_allclose(np.asarray(y), np.arange(N))
 
     def a2a(x):
         return dist.alltoall(paddle.Tensor(x))._value
 
-    y = jax.shard_map(a2a, mesh=mesh, in_specs=P("dp"), out_specs=P("dp"),
+    y = shard_map(a2a, mesh=mesh, in_specs=P("dp"), out_specs=P("dp"),
                       check_vma=False)(
         np.arange(N * N, dtype=np.float32).reshape(N * N, 1))
     np.testing.assert_allclose(np.asarray(y).reshape(N, N),
@@ -198,7 +199,7 @@ def test_traced_collectives_in_shard_map():
                              [(i, (i + 1) % N) for i in range(N)])
         return t._value
 
-    y = jax.shard_map(perm, mesh=mesh, in_specs=P("dp"), out_specs=P("dp"),
+    y = shard_map(perm, mesh=mesh, in_specs=P("dp"), out_specs=P("dp"),
                       check_vma=False)(np.arange(N, dtype=np.float32))
     np.testing.assert_allclose(np.asarray(y), np.roll(np.arange(N), 1))
 
@@ -210,7 +211,7 @@ def test_traced_all_reduce_differentiable():
         def body(v):
             s = dist.all_reduce(paddle.Tensor(v))._value
             return (s ** 2).sum()
-        per = jax.shard_map(body, mesh=mesh, in_specs=P("dp"),
+        per = shard_map(body, mesh=mesh, in_specs=P("dp"),
                             out_specs=P(), check_vma=False)(x)
         return per
 

@@ -1,10 +1,17 @@
 """Top-level namespace parity vs the reference paddle __init__ exports."""
+import os
 import re
 
 import numpy as np
 import pytest
 
 import paddle_tpu as paddle
+
+# parses the reference checkout's __init__ files; skip (don't fail 28x)
+# on hosts without the read-only mount
+pytestmark = pytest.mark.skipif(
+    not os.path.isdir("/root/reference/python/paddle"),
+    reason="reference source not mounted at /root/reference")
 
 # names the reference exports that are intentionally absent here
 _WAIVED = {

@@ -4,9 +4,13 @@ randomized shapes/broadcasting/axis combinations — the input space where
 hand-picked cases miss edge geometry.
 """
 import numpy as np
-from hypothesis import given, settings, strategies as st
+import pytest
 
-import paddle_tpu as paddle
+hypothesis = pytest.importorskip(
+    "hypothesis", reason="hypothesis not installed in this image")
+from hypothesis import given, settings, strategies as st  # noqa: E402
+
+import paddle_tpu as paddle  # noqa: E402
 
 _SET = settings(max_examples=40, deadline=None, derandomize=True)
 

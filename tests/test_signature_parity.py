@@ -16,6 +16,12 @@ import paddle_tpu as paddle
 
 REF = "/root/reference/python/paddle"
 
+# this suite PARSES the reference checkout; on hosts without the
+# read-only mount it must skip, not fail 39 times on open()
+pytestmark = pytest.mark.skipif(
+    not os.path.isdir(REF),
+    reason="reference source not mounted at /root/reference")
+
 # (reference file, def name, our callable)
 CASES = [
     ("tensor/math.py", "add", paddle.add),

@@ -197,7 +197,8 @@ def _make_pullback(fn, vals, treedef, diff_pos, out_treedef):
 
             return jax.jit(bwd_fn)
 
-        bwd = _dispatch.BACKWARD.get_or_build(key, _build)
+        bwd = _dispatch.BACKWARD.get_or_build(
+            key, _build, tag=getattr(fn, "__name__", "op"))
         return bwd([vals[i] for i in arr_pos], list(cot_leaves))
 
     return pullback

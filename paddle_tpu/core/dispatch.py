@@ -201,11 +201,23 @@ class _Key:
 # ---- op opt-out -----------------------------------------------------------
 
 # fn identities (_fn_ident) that must never be jit-cached: populated by
-# @non_jittable and by learned jit failures. Reads are lock-free (set
-# membership is atomic under the GIL). _non_jittable_refs pins id()-keyed
-# callables so a dead id can never be recycled into a false exemption.
+# @non_jittable, by the static unjittable manifest (tools/tracelint),
+# and by learned jit failures. Reads are lock-free (set membership is
+# atomic under the GIL). _non_jittable_refs pins id()-keyed callables so
+# a dead id can never be recycled into a false exemption.
+# _non_jittable_src records HOW each ident got here ("decorated" |
+# "manifest" | "runtime") so dispatch_stats can tell precomputed
+# exemptions from runtime-learned ones.
 _non_jittable = set()
 _non_jittable_refs = []
+_non_jittable_src = {}
+
+
+def _mark_non_jittable(ident, fn, source):
+    _non_jittable.add(ident)
+    _non_jittable_src.setdefault(ident, source)
+    if not isinstance(ident, types.CodeType):
+        _non_jittable_refs.append(fn)
 
 
 def non_jittable(fn):
@@ -218,10 +230,39 @@ def non_jittable(fn):
     except TypeError:
         return fn  # bound methods are never cached anyway
     if ident not in _non_jittable:
-        _non_jittable.add(ident)
-        if not isinstance(ident, types.CodeType):
-            _non_jittable_refs.append(fn)
+        _mark_non_jittable(ident, fn, "decorated")
     return fn
+
+
+# ---- static unjittable manifest (generated by tools/tracelint) ------------
+
+def _load_unjittable_manifest():
+    """(path suffix, co_name, co_firstlineno) -> reason, produced by
+    `python -m tools.tracelint paddle_tpu --emit-manifest`. Ops the AST
+    analysis PROVES trace-unsafe are demoted to eager on first sighting
+    without paying the failed jax.jit compile probe the runtime-learning
+    path costs. A missing/stale manifest degrades gracefully: the op
+    just falls back to runtime learning."""
+    try:
+        from . import _unjittable_manifest as _m
+    except Exception:  # pragma: no cover — manifest not generated yet
+        return {}
+    if getattr(_m, "MANIFEST_VERSION", None) != 1:
+        return {}
+    return dict(getattr(_m, "UNJITTABLE", {}))
+
+
+_manifest = _load_unjittable_manifest()
+
+
+def _manifest_key(code):
+    """Runtime analogue of tracelint's manifest key: the co_filename
+    suffix from the `paddle_tpu/` component (basename when absent — the
+    test-fixture case), co_name, co_firstlineno."""
+    path = code.co_filename.replace(os.sep, "/")
+    i = path.rfind("paddle_tpu/")
+    suffix = path[i:] if i >= 0 else path.rsplit("/", 1)[-1]
+    return (suffix, code.co_name, code.co_firstlineno)
 
 
 # ---- key construction -----------------------------------------------------
@@ -327,6 +368,10 @@ class JitCache:
         self.name = name
         self.capacity = capacity
         self._d = collections.OrderedDict()
+        # key -> op name, for per-op cache-size accounting: which ops
+        # own how many compiled programs (a shape-churning op shows up
+        # here as a fat slice of the cache)
+        self._tags = {}
         self._lock = threading.Lock()
         self.hits = 0
         self.misses = 0
@@ -342,25 +387,34 @@ class JitCache:
                 self.misses += 1
             return v
 
-    def put(self, key, val):
+    def put(self, key, val, tag=None):
         with self._lock:
             self._d[key] = val
+            if tag is not None:
+                self._tags[key] = tag
             if len(self._d) > self.capacity:
-                self._d.popitem(last=False)
+                k, _ = self._d.popitem(last=False)
+                self._tags.pop(k, None)
                 self.evictions += 1
 
     def pop(self, key):
         with self._lock:
             self._d.pop(key, None)
+            self._tags.pop(key, None)
 
-    def get_or_build(self, key, builder):
+    def get_or_build(self, key, builder, tag=None):
         """Backward-path entry: one lookup (counted), build outside the
         lock on miss (compiles must not serialize other threads)."""
         v = self.get(key)
         if v is None:
             v = builder()
-            self.put(key, v)
+            self.put(key, v, tag=tag)
         return v
+
+    def sizes_by_tag(self):
+        """op name -> number of live cache entries it owns."""
+        with self._lock:
+            return dict(collections.Counter(self._tags.values()))
 
     def __len__(self):
         with self._lock:
@@ -369,6 +423,7 @@ class JitCache:
     def clear(self):
         with self._lock:
             self._d.clear()
+            self._tags.clear()
 
     def stats(self):
         with self._lock:
@@ -403,10 +458,12 @@ _seen_lock = threading.Lock()
 
 # forward-path outcome counters not tied to a cache lookup
 _counters = {
-    "bypasses": 0,    # disabled / suspended / recorder / opted-out
-    "unkeyable": 0,   # key construction refused -> eager
-    "fallbacks": 0,   # jit failed, eager succeeded -> learned eager
-    "warming": 0,     # below warm count -> eager, no compile yet
+    "bypasses": 0,           # disabled / suspended / recorder / opted-out
+    "unkeyable": 0,          # key construction refused -> eager
+    "fallbacks": 0,          # jit failed, eager succeeded -> learned eager
+    "warming": 0,            # below warm count -> eager, no compile yet
+    "manifest_preloads": 0,  # op demoted via the static manifest (no
+    #                          failed-compile probe paid)
 }
 
 # per-op-identity record: ident -> [name, hits, misses, retraces,
@@ -476,13 +533,24 @@ def dispatch_stats():
     """Snapshot of the dispatch layer (profiler-visible)."""
     fwd = FORWARD.stats()
     fwd.update(_counters)
+    blank = {"hits": 0, "misses": 0, "retraces": 0,
+             "cache_entries": 0, "bwd_cache_entries": 0}
     per_op = {}
     for ent in list(_op_stats.values()):
-        agg = per_op.setdefault(ent[0],
-                                {"hits": 0, "misses": 0, "retraces": 0})
+        agg = per_op.setdefault(ent[0], dict(blank))
         agg["hits"] += ent[_HITS]
         agg["misses"] += ent[_MISSES]
         agg["retraces"] += ent[_RETRACES]
+    # live compiled-program counts per op: how much of each bounded LRU
+    # an op's shape/static churn is occupying right now
+    for name, n in FORWARD.sizes_by_tag().items():
+        per_op.setdefault(name, dict(blank))["cache_entries"] = n
+    for name, n in BACKWARD.sizes_by_tag().items():
+        per_op.setdefault(name, dict(blank))["bwd_cache_entries"] = n
+    # snapshot first (list() is one atomic C-level op under the GIL, the
+    # same convention as _op_stats above): a concurrent demotion during
+    # Counter's Python-level iteration would raise RuntimeError
+    src = collections.Counter(list(_non_jittable_src.values()))
     return {
         "enabled": _enabled,
         "warmup_count": _warmup_count,
@@ -490,6 +558,16 @@ def dispatch_stats():
         "backward": BACKWARD.stats(),
         "per_op": per_op,
         "non_jittable_ops": len(_non_jittable),
+        # precomputed (tracelint manifest) vs discovered-at-runtime
+        # exemptions, reported separately: manifest hits cost nothing,
+        # every runtime-learned op paid at least one failed compile
+        "unjittable": {
+            "total": len(_non_jittable),
+            "decorated": src.get("decorated", 0),
+            "manifest_preloaded": src.get("manifest", 0),
+            "runtime_learned": src.get("runtime", 0),
+            "manifest_entries": len(_manifest),
+        },
     }
 
 
@@ -580,6 +658,15 @@ def run_op(fn, vals, treedef, fallback, name=None):
 
     jitted = FORWARD.get(key)
     if jitted is None:
+        # static unjittable manifest (tools/tracelint): ops PROVEN
+        # trace-unsafe by AST analysis are demoted here, on the cold
+        # path, before any compile probe — the hit path never pays the
+        # lookup, and subsequent calls exit early via _non_jittable
+        if _manifest and type(ident) is types.CodeType \
+                and _manifest_key(ident) in _manifest:
+            _mark_non_jittable(ident, fn, "manifest")
+            _counters["manifest_preloads"] += 1
+            return fallback()
         if name is None:
             name = getattr(fn, "__name__", "op")
         guard = _note_miss(name, ident)
@@ -600,7 +687,7 @@ def run_op(fn, vals, treedef, fallback, name=None):
         jitted = _build_program(fn, treedef,
                                 {i: vals[i] for i in static_pos},
                                 tuple(arr_pos), len(vals), name)
-        FORWARD.put(key, jitted)
+        FORWARD.put(key, jitted, tag=name)
         guard[_COMPILED] += 1
     else:
         _note_hit(ident)
@@ -626,7 +713,5 @@ def run_op(fn, vals, treedef, fallback, name=None):
                     [getattr(fn, "__name__", "op"), 0, 0, 0, 0, 0, False, 0])
         ent[_JIT_FAILS] += 1
         if isinstance(e, _TRACE_ERRORS) or ent[_JIT_FAILS] >= _JIT_FAIL_LIMIT:
-            _non_jittable.add(ident)
-            if not isinstance(ident, types.CodeType):
-                _non_jittable_refs.append(fn)
+            _mark_non_jittable(ident, fn, "runtime")
         return out

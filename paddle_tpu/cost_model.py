@@ -105,8 +105,8 @@ class CostModel:
             "mean": lambda v: v.mean(),
         }
         fn = ops[op_name]
-        target = (jax.jit(jax.grad(lambda v: fn(v).sum())) if not forward
-                  else jax.jit(fn))
+        target = (jax.jit(jax.grad(lambda v: fn(v).sum())) if not forward  # tracelint: ok[suspend-audit] raw-jnp microbench lambdas
+                  else jax.jit(fn))  # tracelint: ok[suspend-audit] raw-jnp microbench lambdas
         target(x).block_until_ready()  # compile
         t0 = time.perf_counter()
         for _ in range(10):

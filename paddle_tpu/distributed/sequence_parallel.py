@@ -67,7 +67,7 @@ def ring_attention(q, k, v, mesh=None, axis=None, causal=False,
     spec = P(dp_ax, head_ax, ax, None)
 
     def _ring(qv, kv, vv):
-        fn = shard_map(
+        fn = shard_map(  # tracelint: ok[suspend-audit] raw-jnp ring body
             lambda a, b, c: ring_attention_local(
                 a, b, c, axis=ax, causal=causal, sm_scale=sm_scale),
             mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,

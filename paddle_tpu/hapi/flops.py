@@ -163,7 +163,13 @@ def hlo_flops(fn, *example_args):
     """XLA-exact FLOPs: compile `fn` and read the HLO cost analysis."""
     import jax
 
-    compiled = jax.jit(fn).lower(*example_args).compile()
+    from ..core import dispatch as _dispatch
+
+    # `fn` is typically a layer forward: the .lower() trace dispatches
+    # its ops — keep them out of the per-op jit cache (tracelint
+    # suspend-audit)
+    with _dispatch.suspend():
+        compiled = jax.jit(fn).lower(*example_args).compile()
     cost = compiled.cost_analysis()
     if isinstance(cost, (list, tuple)):
         cost = cost[0]

@@ -86,13 +86,19 @@ class Jacobian:
 
         vals = _unwrap(xs)
         f = _pure(func)
-        out_struct = jax.eval_shape(f, *vals)
-        if isinstance(out_struct, tuple):
-            raise NotImplementedError(
-                "multi-output Jacobian is not supported; stack/concat the "
-                "outputs into one tensor")
-        out_size = math.prod(out_struct.shape)
-        jacs = jax.jacrev(f, argnums=tuple(range(len(vals))))(*vals)
+        # f re-enters user code under jax traces below: suspend the
+        # per-op dispatch cache for the whole derivation (tracelint
+        # suspend-audit)
+        from ..core import dispatch as _dispatch
+
+        with _dispatch.suspend():
+            out_struct = jax.eval_shape(f, *vals)
+            if isinstance(out_struct, tuple):
+                raise NotImplementedError(
+                    "multi-output Jacobian is not supported; stack/concat "
+                    "the outputs into one tensor")
+            out_size = math.prod(out_struct.shape)
+            jacs = jax.jacrev(f, argnums=tuple(range(len(vals))))(*vals)
         # argnums as a tuple always yields a tuple of blocks; flatten each
         # to [out_size, in_size] and stack inputs on the column axis — the
         # reference's 2-D Jacobian view

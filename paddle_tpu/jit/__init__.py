@@ -196,7 +196,7 @@ def save(layer, path, input_spec=None, **configs):
     fwd, params = sf._exportable(structs)
     param_structs = {k: jax.ShapeDtypeStruct(v.shape, v.dtype)
                      for k, v in params.items()}
-    exported = jexport.export(jax.jit(fwd))(param_structs, *structs)
+    exported = jexport.export(jax.jit(fwd))(param_structs, *structs)  # tracelint: ok[suspend-audit] _pure suspends inside the traced fn
     blob = exported.serialize()
     d = os.path.dirname(path)
     if d:
@@ -222,7 +222,7 @@ class TranslatedLayer(Layer):
         # one jitted entry per loaded artifact: all TranslatedLayers (and
         # therefore all inference Predictors) of the same model share one
         # executable cache — no recompilation across instances
-        self._call = call if call is not None else jax.jit(exported.call)
+        self._call = call if call is not None else jax.jit(exported.call)  # tracelint: ok[suspend-audit] serialized StableHLO replay
 
     def forward(self, *args):
         arg_vals = [a._value if isinstance(a, Tensor)
@@ -261,7 +261,7 @@ def load(path, **configs):
         if len(_load_cache) >= _LOAD_CACHE_MAX:
             _load_cache.pop(next(iter(_load_cache)))
         ent = _load_cache[key] = (exported, params,
-                                  jax.jit(exported.call))
+                                  jax.jit(exported.call))  # tracelint: ok[suspend-audit] serialized StableHLO replay
     return TranslatedLayer(*ent)
 
 

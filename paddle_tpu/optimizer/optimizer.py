@@ -202,7 +202,7 @@ class Optimizer:
         # second backward of a freed graph) is invalidated by step(); callers
         # holding such aliases must materialize them first (see
         # Tensor.detach docstring).
-        return jax.jit(fused, donate_argnums=(0, 1))
+        return jax.jit(fused, donate_argnums=(0, 1))  # tracelint: ok[suspend-audit] raw-jnp update rules + clip_values
 
     @property
     def _param_list(self):

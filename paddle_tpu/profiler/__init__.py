@@ -210,6 +210,23 @@ class Profiler:
                      if v["retraces"] > 2}
             if churn:
                 print(f"  retrace-heavy ops (dynamic shapes?): {churn}")
+            # per-op cache occupancy: which ops own the compiled-program
+            # budget (a top entry with many programs = shape churn)
+            fat = sorted(ds["per_op"].items(),
+                         key=lambda kv: -(kv[1]["cache_entries"]
+                                          + kv[1]["bwd_cache_entries"]))[:5]
+            fat = [(k, v["cache_entries"], v["bwd_cache_entries"])
+                   for k, v in fat
+                   if v["cache_entries"] + v["bwd_cache_entries"]]
+            if fat:
+                print("  cache occupancy (op: fwd+bwd programs): "
+                      + ", ".join(f"{k}: {f}+{b}" for k, f, b in fat))
+        uj = ds.get("unjittable")
+        if uj and uj["total"]:
+            print(f"unjittable ops: {uj['total']} "
+                  f"({uj['manifest_preloaded']} manifest-preloaded, "
+                  f"{uj['runtime_learned']} runtime-learned, "
+                  f"{uj['decorated']} decorated)")
         if self._dir:
             print(f"trace artifacts: {self._dir}")
 

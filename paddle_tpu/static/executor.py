@@ -127,9 +127,9 @@ class Executor:
         if entry is None:
             raw = program.build_fn(fetch_ids, train=train)
             if train:
-                entry = jax.jit(raw, donate_argnums=(0, 2))
+                entry = jax.jit(raw, donate_argnums=(0, 2))  # tracelint: ok[suspend-audit] build_fn replays raw op.fn
             else:
-                entry = jax.jit(raw)
+                entry = jax.jit(raw)  # tracelint: ok[suspend-audit] build_fn replays raw op.fn
             self._cache[sig] = entry
 
         param_vals = {p.name: p._value for p in program.param_ids.values()}

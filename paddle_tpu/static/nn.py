@@ -31,11 +31,16 @@ def _is_tensor(x):
 
 
 def _suspended(fn, args=()):
-    """Run a user branch callable with tape + static recorder off, returning
-    a pytree of raw jnp values. Closure Tensors are handled by the callers:
-    _closure_tensors lifts them to op inputs and _rebound swaps in the
-    traced values while the branch runs."""
+    """Run a user branch callable with tape + static recorder + per-op
+    dispatch cache off, returning a pytree of raw jnp values. Closure
+    Tensors are handled by the callers: _closure_tensors lifts them to op
+    inputs and _rebound swaps in the traced values while the branch runs.
+    The dispatch suspend matters for zero-array-input ops inside the
+    branch (creation ops): the lax.cond/switch/while trace compiles them
+    anyway, so a nested per-op jit entry would only burn cache keys on
+    this trace's throwaway avals (tracelint suspend-audit)."""
     from ..core import autograd as ag
+    from ..core import dispatch as _dispatch
     from ..nn.layer import layers as _layers
 
     old = ag._static_recorder
@@ -47,7 +52,7 @@ def _suspended(fn, args=()):
         "creating parameters inside a static.nn control-flow branch is not "
         "supported: build layers outside and call them from the branch")
     try:
-        with ag.no_grad():
+        with ag.no_grad(), _dispatch.suspend():
             out = fn(*[Tensor(a) for a in args])
     finally:
         ag._static_recorder = old

@@ -197,7 +197,7 @@ class Program:
             def loss_of(pv):
                 env = forward_env(pv, feed_vals)
                 return env[id(loss_t)].astype(jnp.float32), env
-            (loss, env), grads = jax.value_and_grad(
+            (loss, env), grads = jax.value_and_grad(  # tracelint: ok[suspend-audit] forward_env replays raw op.fn
                 loss_of, has_aux=True)(param_vals)
             meta = optimizer.param_meta(
                 {name: p for pid, p in self.param_ids.items()

@@ -85,16 +85,16 @@ def run_tpu_checks(seq=256, dim=64, bh=8, vocab=8192, hidden=256, n=512):
     # f32 tol: MXU f32 matmuls run as bf16-multiplier passes (~1e-3 rel);
     # unit-variance inputs keep outputs O(1) so max-abs tracks rel err.
     check("flash_f32_causal",
-          lambda: _max_err(jax.jit(flash_attention_raw,
+          lambda: _max_err(jax.jit(flash_attention_raw,  # tracelint: ok[suspend-audit] raw flash/XLA kernels
                                    static_argnums=3)(q, k, v, True),
                            oracle_causal), tol=5e-3)
     check("flash_f32_plain",
-          lambda: _max_err(jax.jit(flash_attention_raw,
+          lambda: _max_err(jax.jit(flash_attention_raw,  # tracelint: ok[suspend-audit] raw flash/XLA kernels
                                    static_argnums=3)(q, k, v, False),
                            oracle_plain), tol=5e-3)
     check("flash_bf16_causal",
           lambda: _max_err(
-              jax.jit(flash_attention_raw, static_argnums=3)(
+              jax.jit(flash_attention_raw, static_argnums=3)(  # tracelint: ok[suspend-audit] raw flash/XLA kernels
                   q.astype(jnp.bfloat16), k.astype(jnp.bfloat16),
                   v.astype(jnp.bfloat16), True).astype(jnp.float32),
               oracle_causal), tol=6e-2)
@@ -129,15 +129,15 @@ def run_tpu_checks(seq=256, dim=64, bh=8, vocab=8192, hidden=256, n=512):
         def xla_loss(qq, kk, vv):
             return (_xla_attn_dev(qq, kk, vv, True) ** 2).mean()
 
-        gf = jax.jit(jax.grad(flash_loss, argnums=(0, 1, 2)))(q, k, v)
-        gx = jax.jit(jax.grad(xla_loss, argnums=(0, 1, 2)))(q, k, v)
+        gf = jax.jit(jax.grad(flash_loss, argnums=(0, 1, 2)))(q, k, v)  # tracelint: ok[suspend-audit] raw flash/XLA kernels
+        gx = jax.jit(jax.grad(xla_loss, argnums=(0, 1, 2)))(q, k, v)  # tracelint: ok[suspend-audit] raw flash/XLA kernels
         return max(_max_err(a, b) for a, b in zip(gf, gx))
 
     check("flash_bwd_vs_xla", _grad_err, tol=5e-3)
 
     # --- non-default block tilings: kernel vs kernel, near-exact -------
     try:
-        base = np.asarray(jax.jit(flash_attention_raw,
+        base = np.asarray(jax.jit(flash_attention_raw,  # tracelint: ok[suspend-audit] raw flash/XLA kernels
                                   static_argnums=3)(q, k, v, True))
     except Exception as e:  # noqa: BLE001 — later checks must still run
         out["tpu_check_flash_tiling_error"] = (
@@ -187,10 +187,10 @@ def run_tpu_checks(seq=256, dim=64, bh=8, vocab=8192, hidden=256, n=512):
                            _naive(h, w)), tol=1e-4)
 
     def _ce_grad_err():
-        gf = jax.jit(jax.grad(
+        gf = jax.jit(jax.grad(  # tracelint: ok[suspend-audit] raw flash/XLA kernels
             lambda hh, ww: blockwise_softmax_ce(hh, ww, y, block=2048),
             argnums=(0, 1)))
-        gn = jax.jit(jax.grad(_naive, argnums=(0, 1)))
+        gn = jax.jit(jax.grad(_naive, argnums=(0, 1)))  # tracelint: ok[suspend-audit] raw flash/XLA kernels
         return max(_max_err(a, b) for a, b in zip(gf(h, w), gn(h, w)))
 
     check("blockwise_ce_grad", _ce_grad_err, tol=1e-4)

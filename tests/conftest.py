@@ -36,6 +36,9 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "tpu: needs a real TPU chip "
         "(run with PADDLE_TPU_TEST_REAL_CHIP=1 -m tpu)")
+    config.addinivalue_line(
+        "markers", "slow: heavyweight file excluded from the tier-1 "
+        "`-m 'not slow'` budget run (run explicitly with -m slow)")
 
 
 @pytest.fixture(autouse=True)
@@ -45,3 +48,63 @@ def _seeded():
     paddle.seed(102)
     np.random.seed(102)
     yield
+
+
+# ---------------------------------------------------------------------------
+# capability probes
+
+import functools  # noqa: E402
+
+
+@functools.lru_cache(maxsize=1)
+def _partial_auto_spmd_error():
+    """None when this host's XLA can compile the partial-auto shard_map
+    lowering the pipeline schedule uses (real TPU, or a jax/XLA with
+    SPMD PartitionId support); else the error string. XLA CPU SPMD
+    cannot compile the PartitionId instruction the partial-auto lowering
+    emits, which hard-fails the test_pipeline_virtual /
+    test_dist_dryrun cluster on CPU hosts — the probe converts those to
+    skips-with-reason. Runs the smallest real failing computation (a
+    2-chunk identity pipeline under jit) so it can never drift from
+    what the tests actually exercise."""
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+
+    from paddle_tpu.distributed.pipeline import pipeline_forward
+
+    devs = jax.devices()
+    if len(devs) < 8:
+        return None  # not the virtual-mesh config; let the tests speak
+
+    mesh = Mesh(np.array(devs[:8]).reshape(4, 2), ("dp", "pp"))
+
+    def stage_fn(p, h):
+        return h + p
+
+    try:
+        out = jax.jit(lambda sp, xv: pipeline_forward(
+            stage_fn, sp, xv, mesh=mesh))(
+                jnp.zeros((2, 1), jnp.float32),
+                jnp.ones((2, 1, 1), jnp.float32))
+        np.asarray(out)
+        return None
+    except Exception as e:  # noqa: BLE001 — filtered by signature below
+        msg = f"{type(e).__name__}: {str(e)[:200]}"
+        # only the KNOWN platform gap converts to a skip; any other
+        # probe failure (a real pipeline_forward regression) returns
+        # None so the tests run and fail loudly instead of green-skipping
+        if "PartitionId" in msg or "SPMD partitioning" in msg:
+            return msg
+        return None
+
+
+@pytest.fixture
+def require_partial_auto_spmd():
+    """Skip (with the probed reason) on hosts whose XLA can't compile
+    partial-auto shard_map programs (the PartitionId/XLA-CPU-SPMD gap,
+    ROADMAP triage item)."""
+    err = _partial_auto_spmd_error()
+    if err is not None:
+        pytest.skip("partial-auto shard_map unsupported on this host's "
+                    "XLA backend (PartitionId/SPMD gap, likely TPU-only "
+                    "until a jax upgrade): " + err)

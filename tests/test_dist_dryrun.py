@@ -5,13 +5,13 @@ import os
 sys.path.insert(0, os.path.dirname(os.path.dirname(__file__)))
 
 
-def test_dryrun_multichip_8():
+def test_dryrun_multichip_8(require_partial_auto_spmd):
     import __graft_entry__ as ge
 
     ge.dryrun_multichip(8)
 
 
-def test_entry_compiles():
+def test_entry_compiles(require_partial_auto_spmd):
     import jax
 
     import __graft_entry__ as ge

@@ -1,8 +1,14 @@
 """Model zoo tests (reference: unittests test_vision_models.py).
 Kept to a few representatives per family — eager CPU forward is compile-
-bound, full-zoo coverage happens on the real chip via bench/graft."""
+bound, full-zoo coverage happens on the real chip via bench/graft.
+
+Marked slow: ~100s of whole-network CPU compiles (PR 2 `--durations`
+profile; the tier-1 run was 150s over its 870s budget). Run with
+`-m slow`."""
 import numpy as np
 import pytest
+
+pytestmark = pytest.mark.slow
 
 import paddle_tpu as paddle
 import paddle_tpu.nn as nn

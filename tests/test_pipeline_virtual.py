@@ -65,7 +65,7 @@ def test_indivisible_virtual_chunks_raise(pp2_mesh):
                       num_stages=2, num_virtual_pipeline_stages=4)
 
 
-def test_virtual_parity_vs_sequential(pp2_mesh):
+def test_virtual_parity_vs_sequential(pp2_mesh, require_partial_auto_spmd):
     """pp=2, V=2: the interleaved schedule computes exactly the
     sequential composition of the 4 layers."""
     paddle.seed(0)
@@ -80,7 +80,7 @@ def test_virtual_parity_vs_sequential(pp2_mesh):
                                atol=1e-5)
 
 
-def test_virtual_parity_deep_trunk(pp2_mesh):
+def test_virtual_parity_deep_trunk(pp2_mesh, require_partial_auto_spmd):
     """8 layers, V=2 (chunks of 2 layers) exercises multi-layer chunks."""
     paddle.seed(1)
     pl = PipelineLayer(layers=[LayerDesc(nn.Linear, 8, 8)
@@ -92,7 +92,7 @@ def test_virtual_parity_deep_trunk(pp2_mesh):
         np.asarray(pl(x)._value), rtol=2e-5, atol=1e-5)
 
 
-def test_virtual_gradients_flow(pp2_mesh):
+def test_virtual_gradients_flow(pp2_mesh, require_partial_auto_spmd):
     paddle.seed(2)
     pl = PipelineLayer(layers=[LayerDesc(nn.Linear, 8, 8)
                                for _ in range(4)],
@@ -134,7 +134,7 @@ def test_het_trunk_rejects_virtual(pp2_mesh):
         pl.het_stage_fns()
 
 
-def test_gpt_virtual_pipeline_end_to_end(pp2_mesh):
+def test_gpt_virtual_pipeline_end_to_end(pp2_mesh, require_partial_auto_spmd):
     """GPTConfig.pp_num_virtual routes through the public model path and
     trains."""
     from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
@@ -161,7 +161,7 @@ def test_gpt_virtual_pipeline_end_to_end(pp2_mesh):
     assert all(np.isfinite(losses))
 
 
-def test_v1_unchanged_parity(pp2_mesh):
+def test_v1_unchanged_parity(pp2_mesh, require_partial_auto_spmd):
     """num_virtual default (1) keeps the original schedule semantics."""
     paddle.seed(5)
     pl = PipelineLayer(layers=[LayerDesc(nn.Linear, 8, 8)

@@ -1,0 +1,450 @@
+"""tracelint: AST jit-safety analyzer + static unjittable manifest.
+
+Locks the ISSUE-2 acceptance surface:
+  * `python -m tools.tracelint paddle_tpu` exits 0 on the baselined tree
+    and non-zero once a synthetic violation is introduced;
+  * >= 6 distinct rule detections on fixture code (plus the precision
+    controls that must NOT fire);
+  * the generated manifest is loaded by core/dispatch.py at import and
+    dispatch_stats() splits manifest-preloaded from runtime-learned
+    unjittable ops.
+"""
+import os
+import shutil
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+from tools.tracelint import analyzer, baseline, manifest  # noqa: E402
+
+
+# ---------------------------------------------------------------------------
+# fixture code exercising every rule
+
+FIXTURE = textwrap.dedent('''
+    import time
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.core.autograd import apply
+    from paddle_tpu.core.dispatch import non_jittable
+
+
+    def host_float_op(x):
+        def f(v):
+            return jnp.asarray(float(v.sum()))
+        return apply(f, x)
+
+
+    def host_numpy_method_op(x):
+        return apply(lambda v: jnp.asarray(v.numpy() * 2), x)
+
+
+    def impure_time_op(x):
+        def f(v):
+            return v * time.time()
+        return apply(f, x)
+
+
+    def impure_np_random_op(x):
+        def f(v):
+            return v + np.random.rand()
+        return apply(f, x)
+
+
+    def closure_capture_op(x):
+        key = jax.random.PRNGKey(0)
+
+        def f(v):
+            return v * jax.random.uniform(key, v.shape)
+        return apply(f, x)
+
+
+    _BUF = []
+
+
+    def mutation_op(x):
+        def f(v):
+            global _SEEN
+            _SEEN = 1
+            _BUF.append(1)
+            return v
+        return apply(f, x)
+
+
+    def branchy_op(x):
+        def f(v):
+            s = jnp.sum(v)
+            if s > 0:
+                return v
+            return -v
+        return apply(f, x)
+
+
+    @non_jittable
+    def clean_marked_op(v):
+        return v * 2
+
+
+    def trace_site(fn, x):
+        return jax.jit(fn)(x)
+
+
+    def waived_trace_site(fn, x):
+        return jax.jit(fn)(x)  # tracelint: ok[suspend-audit] fixture
+
+
+    _MODULE_LEVEL_JIT = jax.jit(lambda v: v + 1)
+
+
+    def id_waived_trace_site(fn, x):
+        return jax.jit(fn)(x)  # tracelint: ok[TL007] id-form waiver
+
+
+    def wrong_id_waiver_site(fn, x):
+        return jax.jit(fn)(x)  # tracelint: ok[TL001] other rule only
+
+
+    # ---- precision controls: none of these may produce findings ----
+
+    def clean_none_branch(x, w=None):
+        def f(v, wv=w):
+            if wv is None:
+                return v
+            return v * wv
+        return apply(f, x)
+
+
+    def clean_shape_branch(x):
+        def f(v):
+            if v.shape[0] > 1 and v.ndim == 2:
+                return v.sum()
+            return v
+        return apply(f, x)
+
+
+    def clean_dtype_branch(x, y):
+        def f(a, b):
+            if jnp.issubdtype(jnp.result_type(a, b), jnp.integer):
+                return a // b
+            return a / b
+        return apply(f, x, y)
+
+
+    def clean_vararg_truthiness(x, *rest):
+        def f(v, *more):
+            if more:
+                return v + more[0]
+            return v
+        return apply(f, x, *rest)
+
+
+    def clean_static_capture(x):
+        axes = (0, 1)
+
+        def f(v):
+            return jnp.sum(v, axis=axes)
+        return apply(f, x)
+''')
+
+
+@pytest.fixture(scope="module")
+def fixture_findings(tmp_path_factory):
+    d = tmp_path_factory.mktemp("tracelint_fixture")
+    p = d / "fixture_ops.py"
+    p.write_text(FIXTURE)
+    findings, errors = analyzer.analyze_paths([str(p)])
+    assert not errors
+    return findings
+
+
+def _rules_in(findings, func_prefix):
+    return {f.rule for f in findings
+            if f.func.startswith(func_prefix) and not f.suppressed}
+
+
+def test_at_least_six_distinct_rules(fixture_findings):
+    rules = {f.rule for f in fixture_findings if not f.suppressed}
+    assert len(rules) >= 6, rules
+    assert {"host-materialize", "impure-call", "closure-capture",
+            "state-mutation", "data-dependent-control-flow",
+            "stale-non-jittable", "suspend-audit"} <= rules
+
+
+def test_host_materialize_detections(fixture_findings):
+    assert "host-materialize" in _rules_in(fixture_findings, "host_float_op")
+    assert "host-materialize" in _rules_in(fixture_findings,
+                                           "host_numpy_method_op")
+
+
+def test_impure_call_detections(fixture_findings):
+    assert _rules_in(fixture_findings, "impure_time_op") == {"impure-call"}
+    assert _rules_in(fixture_findings,
+                     "impure_np_random_op") == {"impure-call"}
+
+
+def test_closure_capture_detection(fixture_findings):
+    hits = [f for f in fixture_findings
+            if f.rule == "closure-capture" and "closure_capture_op" in f.func]
+    assert hits and "key" in hits[0].symbol
+
+
+def test_state_mutation_detections(fixture_findings):
+    symbols = {f.symbol for f in fixture_findings
+               if f.rule == "state-mutation" and "mutation_op" in f.func}
+    assert any(s.startswith("global:") for s in symbols), symbols
+    assert "_BUF.append" in symbols
+
+
+def test_data_dependent_branch_detection(fixture_findings):
+    hits = [f for f in fixture_findings
+            if f.rule == "data-dependent-control-flow"
+            and "branchy_op" in f.func]
+    assert hits and hits[0].symbol == "if:s"
+
+
+def test_stale_non_jittable_detection(fixture_findings):
+    hits = [f for f in fixture_findings if f.rule == "stale-non-jittable"]
+    assert hits and hits[0].func == "clean_marked_op"
+    assert hits[0].severity == "info"
+
+
+def test_suspend_audit_and_inline_waiver(fixture_findings):
+    flagged = [f for f in fixture_findings if f.rule == "suspend-audit"]
+    by_func = {f.func: f.suppressed for f in flagged}
+    assert by_func["trace_site"] is False
+    assert by_func["waived_trace_site"] is True
+    # module-level trace entries must report as <module>, not crash the
+    # analyzer (regression: qualname() on a non-scope node)
+    assert by_func.get("<module>") is False
+    # rule-ID waiver form is honored, and scoped: a waiver naming a
+    # DIFFERENT rule must not suppress this one (regression: the old
+    # regex rejected uppercase IDs and degraded to a blanket waiver)
+    assert by_func["id_waived_trace_site"] is True
+    assert by_func["wrong_id_waiver_site"] is False
+
+
+def test_precision_controls_are_clean(fixture_findings):
+    for prefix in ("clean_none_branch", "clean_shape_branch",
+                   "clean_dtype_branch", "clean_vararg_truthiness",
+                   "clean_static_capture"):
+        assert _rules_in(fixture_findings, prefix) == set(), prefix
+
+
+def test_fingerprints_are_line_free(tmp_path):
+    src = ("import time\n"
+           "from paddle_tpu.core.autograd import apply\n"
+           "def op(x):\n"
+           "    def f(v):\n"
+           "        return v * time.time()\n"
+           "    return apply(f, x)\n")
+    a = tmp_path / "a.py"
+    a.write_text(src)
+    f1, _ = analyzer.analyze_paths([str(a)])
+    a.write_text("# pushed down\n# two lines\n" + src)
+    f2, _ = analyzer.analyze_paths([str(a)])
+    assert [x.fingerprint() for x in f1] == [x.fingerprint() for x in f2]
+    assert f1[0].line != f2[0].line
+
+
+def test_baseline_partition_and_staleness(fixture_findings):
+    base = {}
+    new, baselined, suppressed, info, stale = baseline.partition(
+        fixture_findings, base)
+    assert baselined == [] and stale == []
+    assert all(f.severity != "info" for f in new)
+    # baseline everything -> nothing new; plus one stale entry
+    counts = {}
+    for f in new:
+        counts[f.fingerprint()] = counts.get(f.fingerprint(), 0) + 1
+    counts["impure-call|gone.py|dead_op|time.time"] = 1
+    new2, baselined2, _, _, stale2 = baseline.partition(fixture_findings,
+                                                        counts)
+    assert new2 == [] and len(baselined2) == len(new)
+    assert stale2 == ["impure-call|gone.py|dead_op|time.time"]
+
+
+def test_manifest_entries_definite_only(fixture_findings):
+    entries = manifest.manifest_entries(fixture_findings)
+    names = {k[1] for k in entries}
+    # impure/time ops are manifest-grade; the suspend-audit trace site
+    # and closure captures are not
+    assert "f" in names
+    for (path, name, line), reason in entries.items():
+        assert path.endswith("fixture_ops.py")
+        assert "TL00" in reason
+
+
+# ---------------------------------------------------------------------------
+# CLI acceptance: exit codes on the real tree
+
+def _run_cli(args, cwd=REPO_ROOT):
+    return subprocess.run([sys.executable, "-m", "tools.tracelint", *args],
+                          cwd=cwd, capture_output=True, text=True,
+                          timeout=300)
+
+
+def test_cli_clean_tree_exits_zero():
+    r = _run_cli(["paddle_tpu"])
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "0 new" in r.stdout
+
+
+def test_cli_checked_in_manifest_is_fresh():
+    r = _run_cli(["paddle_tpu", "--check-manifest"])
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+def test_cli_synthetic_violation_fails(tmp_path):
+    # copy the real tree, introduce one bad op, run with the SAME
+    # checked-in baseline: the new finding must gate
+    dst = tmp_path / "paddle_tpu"
+    shutil.copytree(
+        os.path.join(REPO_ROOT, "paddle_tpu"), dst,
+        ignore=shutil.ignore_patterns("__pycache__", "libs", "include"))
+    bad = dst / "tensor" / "_tl_synthetic.py"
+    bad.write_text(textwrap.dedent('''
+        import time
+        from ..core.autograd import apply
+
+        def leaky_op(x):
+            def f(v):
+                return v * time.time()
+            return apply(f, x)
+    '''))
+    r = _run_cli([str(dst), "--baseline",
+                  os.path.join(REPO_ROOT, "tools", "tracelint",
+                               "baseline.json")])
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "_tl_synthetic.py" in r.stdout
+    assert "impure-call" in r.stdout
+    # and the same copy WITHOUT the violation is clean
+    bad.unlink()
+    r2 = _run_cli([str(dst), "--baseline",
+                   os.path.join(REPO_ROOT, "tools", "tracelint",
+                                "baseline.json")])
+    assert r2.returncode == 0, r2.stdout + r2.stderr
+
+
+# ---------------------------------------------------------------------------
+# dispatch integration: manifest preload vs runtime learning
+
+def test_dispatch_loads_checked_in_manifest():
+    from paddle_tpu.core import dispatch as D
+
+    assert D._manifest, "manifest not loaded at import"
+    gen = D._load_unjittable_manifest()
+    assert set(gen) >= set(D._manifest) or gen == D._manifest
+
+
+def test_manifest_preload_skips_compile_probe():
+    import paddle_tpu as paddle
+    from paddle_tpu.core import dispatch as D
+    from paddle_tpu.core.autograd import apply
+
+    def synthetic_bad(v):
+        import time
+        return v * time.time()
+
+    key = D._manifest_key(synthetic_bad.__code__)
+    prev_warm = D.set_warmup_count(1)
+    D._manifest[key] = "TL004 impure-call: synthetic"
+    D.reset_dispatch_stats()
+    try:
+        x = paddle.to_tensor(np.ones(4, np.float32))
+        before = D.dispatch_stats()["unjittable"]
+        for _ in range(3):
+            apply(synthetic_bad, x)
+        s = D.dispatch_stats()
+        # demoted via the manifest on first sighting: no failed-compile
+        # probe (fallbacks counter untouched), source attributed
+        assert s["forward"]["manifest_preloads"] == 1
+        assert s["forward"]["fallbacks"] == 0
+        uj = s["unjittable"]
+        assert uj["manifest_preloaded"] == before["manifest_preloaded"] + 1
+        # later calls exit via the non_jittable fast path
+        assert s["forward"]["bypasses"] >= 2
+    finally:
+        D._manifest.pop(key, None)
+        D.set_warmup_count(prev_warm)
+
+
+def test_runtime_learning_still_attributed():
+    import paddle_tpu as paddle
+    from paddle_tpu.core import dispatch as D
+    from paddle_tpu.core.autograd import apply
+
+    def runtime_bad(v):
+        if float(v.sum()) > 0:  # concretization error under trace
+            return v
+        return -v
+
+    prev_warm = D.set_warmup_count(1)
+    D.reset_dispatch_stats()
+    try:
+        x = paddle.to_tensor(np.ones(4, np.float32))
+        before = D.dispatch_stats()["unjittable"]["runtime_learned"]
+        apply(runtime_bad, x)
+        s = D.dispatch_stats()
+        assert s["unjittable"]["runtime_learned"] == before + 1
+        assert s["forward"]["fallbacks"] == 1  # the probe was paid
+    finally:
+        D.set_warmup_count(prev_warm)
+
+
+def test_real_manifest_entry_blocks_moe_probe():
+    """End-to-end: the checked-in manifest row for the moe assign-pos op
+    matches the op's real code object at runtime."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.core import dispatch as D
+    from paddle_tpu.distributed.models.moe import _assign_pos
+    import paddle_tpu as paddle
+
+    prev_warm = D.set_warmup_count(1)
+    D.reset_dispatch_stats()
+    try:
+        x = paddle.to_tensor(np.array([0, 1, 0, 1], np.int32))
+        cum = paddle.to_tensor(np.array([2, 4], np.int32))
+        out = _assign_pos(x, cum)
+        s = D.dispatch_stats()
+        # demoted via the manifest — either just now (cold path) or by an
+        # earlier test in the session (demotions persist across stat
+        # resets; this call then exits via the non_jittable bypass).
+        # Either way the op never pays a failed-compile probe.
+        assert s["unjittable"]["manifest_preloaded"] >= 1, s["unjittable"]
+        assert s["forward"]["fallbacks"] == 0, s["forward"]
+        assert s["forward"]["manifest_preloads"] \
+            + s["forward"]["bypasses"] >= 1, s["forward"]
+        assert np.asarray(out._value).shape == (4,)
+    finally:
+        D.set_warmup_count(prev_warm)
+
+
+def test_per_op_cache_size_accounting():
+    import paddle_tpu as paddle
+    from paddle_tpu.core import dispatch as D
+
+    D.reset_dispatch_stats()
+    prev_warm = D.set_warmup_count(1)
+    try:
+        x = paddle.to_tensor(np.ones((2, 2), np.float32))
+        y = paddle.to_tensor(np.ones((3, 3), np.float32))
+        for t in (x, y, x, y):
+            paddle.tanh(t)
+        per = D.dispatch_stats()["per_op"]["tanh"]
+        assert per["cache_entries"] >= 2  # one program per shape
+        # profiler surfaces the same snapshot
+        import paddle_tpu.profiler as prof
+
+        assert prof.dispatch_stats()["per_op"]["tanh"]["cache_entries"] \
+            == per["cache_entries"]
+    finally:
+        D.set_warmup_count(prev_warm)

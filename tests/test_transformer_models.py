@@ -1,6 +1,13 @@
-"""Flagship BERT/GPT tests (reference: fleet GPT unit tests pattern)."""
+"""Flagship BERT/GPT tests (reference: fleet GPT unit tests pattern).
+
+Marked slow: ~240s of CPU compile-bound generate/training loops — the
+single largest tier-1 time sink (PR 2 `--durations` profile, which
+measured the suite 150s OVER the 870s budget). Run with `-m slow`.
+"""
 import numpy as np
 import pytest
+
+pytestmark = pytest.mark.slow
 
 import paddle_tpu as paddle
 import paddle_tpu.nn as nn

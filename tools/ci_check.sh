@@ -1,0 +1,23 @@
+#!/usr/bin/env bash
+# One-command static gate: tracelint + manifest freshness + import
+# health. Fast (no test suite, ~seconds) — run it locally before
+# pushing; CI runs the same line.
+#
+#   ./tools/ci_check.sh
+#
+# Exit non-zero on: new (non-baselined) tracelint findings, a stale
+# checked-in unjittable manifest, or any paddle_tpu submodule that
+# fails to import on CPU.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== tracelint (jit-safety static analysis + manifest freshness) =="
+# one invocation does both: reports/gates on new findings AND fails if
+# the checked-in unjittable manifest is stale
+JAX_PLATFORMS=cpu python -m tools.tracelint paddle_tpu --check-manifest
+
+echo "== import health (every submodule imports on CPU) =="
+JAX_PLATFORMS=cpu python -m pytest tests/test_import_health.py -q \
+    -p no:cacheprovider -p no:xdist -p no:randomly
+
+echo "ci_check: OK"

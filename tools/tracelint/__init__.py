@@ -1,0 +1,43 @@
+"""tracelint — AST jit-safety analysis for the paddle_tpu eager
+dispatch layer.
+
+PR 1's dispatch cache discovers trace hazards at RUNTIME: a closure
+over a live array silently bypasses the cache on every call, and each
+genuinely unjittable op pays one failed `jax.jit` compile before the
+blacklist learns it.  tracelint moves those discoveries to lint time:
+a stdlib-`ast` pass walks every op body reachable through
+`core.autograd.apply` / `core.dispatch.run_op`, classifies
+trace-hygiene hazards (rules.py), and emits
+
+  * a human report (file:line, gate for CI via tools/ci_check.sh),
+  * a machine-readable JSON report (--json),
+  * the static unjittable manifest
+    `paddle_tpu/core/_unjittable_manifest.py` (--emit-manifest) that
+    dispatch preloads at import so proven-unsafe ops never pay a
+    failed-compile probe.
+
+Usage:
+    python -m tools.tracelint paddle_tpu
+    python -m tools.tracelint paddle_tpu --emit-manifest
+    python -m tools.tracelint paddle_tpu --json /tmp/tracelint.json
+    python -m tools.tracelint paddle_tpu --write-baseline
+
+See docs/TRACELINT.md for the rule catalog and workflows.
+"""
+from .analyzer import Finding, analyze_file, analyze_paths
+from .baseline import load_baseline, partition, write_baseline
+from .manifest import manifest_entries, manifest_key_path, write_manifest
+from .rules import RULES
+
+__all__ = [
+    "Finding", "analyze_file", "analyze_paths", "load_baseline",
+    "partition", "write_baseline", "manifest_entries", "manifest_key_path",
+    "write_manifest", "RULES", "main",
+]
+
+__version__ = "1.0"
+
+
+def main(argv=None):
+    from .__main__ import main as _main
+    return _main(argv)

@@ -1,0 +1,121 @@
+"""CLI: python -m tools.tracelint <roots...> [options].
+
+Exit codes: 0 clean (or baselined-only), 1 new findings or parse
+errors, 2 usage error.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from .analyzer import analyze_paths
+from .baseline import DEFAULT_BASELINE, load_baseline, partition, \
+    write_baseline
+from .manifest import MANIFEST_BASENAME, manifest_entries, write_manifest
+from .report import human_report, json_report, write_json
+
+
+def _default_manifest_path(roots):
+    """paddle_tpu/core/_unjittable_manifest.py under the analyzed
+    package when one of the roots IS the package; else error."""
+    for r in roots:
+        cand = os.path.join(r, "core", MANIFEST_BASENAME)
+        if os.path.isdir(os.path.join(r, "core")):
+            return cand
+    return None
+
+
+def build_parser():
+    p = argparse.ArgumentParser(
+        prog="python -m tools.tracelint",
+        description="AST jit-safety analyzer for the paddle_tpu eager "
+                    "dispatch layer (see docs/TRACELINT.md)")
+    p.add_argument("roots", nargs="+",
+                   help="package dirs or files to analyze (paddle_tpu)")
+    p.add_argument("--baseline", default=DEFAULT_BASELINE,
+                   help=f"baseline file (default {DEFAULT_BASELINE})")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="report every finding as new (ignore baseline)")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="rewrite the baseline from current findings "
+                        "and exit 0")
+    p.add_argument("--json", metavar="PATH",
+                   help="also write the machine-readable report here")
+    p.add_argument("--emit-manifest", action="store_true",
+                   help="regenerate the static unjittable manifest")
+    p.add_argument("--manifest-path", default=None,
+                   help="manifest output (default: <root>/core/"
+                        f"{MANIFEST_BASENAME})")
+    p.add_argument("--no-audit-suspend", action="store_true",
+                   help="skip the whole-program suspend() audit rule")
+    p.add_argument("--check-manifest", action="store_true",
+                   help="fail if the checked-in manifest differs from "
+                        "what the analysis would generate")
+    p.add_argument("-v", "--verbose", action="store_true",
+                   help="itemize baselined/waived/info findings too")
+    return p
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
+    for r in args.roots:
+        if not os.path.exists(r):
+            print(f"tracelint: no such path: {r}", file=sys.stderr)
+            return 2
+
+    findings, errors = analyze_paths(
+        args.roots, audit_suspend=not args.no_audit_suspend)
+
+    if args.write_baseline:
+        if errors:
+            # a baseline written while files are unparseable silently
+            # drops their debt; the next clean run would gate on it
+            for p, m in errors:
+                print(f"{p}: PARSE ERROR — {m}", file=sys.stderr)
+            print("tracelint: refusing to write a baseline while files "
+                  "fail to parse", file=sys.stderr)
+            return 1
+        counts = write_baseline(args.baseline, findings)
+        print(f"tracelint: baseline written to {args.baseline} "
+              f"({sum(counts.values())} findings, "
+              f"{len(counts)} distinct fingerprints)")
+        return 0
+
+    baseline = {} if args.no_baseline else load_baseline(args.baseline)
+    new, baselined, suppressed, info, stale = partition(findings, baseline)
+
+    entries = manifest_entries(findings)
+    manifest_changed = False
+    mpath = args.manifest_path or _default_manifest_path(args.roots)
+    if args.emit_manifest or args.check_manifest:
+        if mpath is None:
+            print("tracelint: cannot infer --manifest-path from roots",
+                  file=sys.stderr)
+            return 2
+        if args.emit_manifest:
+            entries, manifest_changed = write_manifest(findings, mpath)
+            print(f"tracelint: manifest {'rewritten' if manifest_changed else 'unchanged'}: "
+                  f"{mpath} ({len(entries)} entries)")
+        else:  # --check-manifest: compare without writing
+            from .manifest import render_manifest
+            want = render_manifest(entries)
+            have = ""
+            if os.path.exists(mpath):
+                with open(mpath, "r", encoding="utf-8") as f:
+                    have = f.read()
+            if want != have:
+                print(f"tracelint: manifest STALE: {mpath} — regenerate "
+                      "with --emit-manifest", file=sys.stderr)
+                errors = errors + [(mpath, "stale manifest")]
+
+    print(human_report(new, baselined, suppressed, info, stale, errors,
+                       verbose=args.verbose))
+    if args.json:
+        write_json(args.json, json_report(new, baselined, suppressed, info,
+                                          stale, errors, entries))
+    return 1 if (new or errors) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,791 @@
+"""AST jit-safety analysis over the paddle_tpu op surface.
+
+What counts as an "op body": any function object that can reach
+`jax.jit` through the eager dispatch layer —
+
+  * the first argument of a call to ``apply(...)`` / ``_apply(...)``
+    (core.autograd.apply) or ``run_op(...)`` when it is a lambda or a
+    name that resolves to a def/lambda in lexical scope;
+  * any function marked ``@non_jittable`` (decorator or direct
+    ``non_jittable(fn)`` call) — analyzed both for hazards and for
+    staleness of the marking.
+
+Within an op body the analysis runs a conservative name-level taint
+pass: positional parameters without defaults are assumed traced
+(arrays); parameters with defaults and closure statics are assumed
+static.  Shape/dtype/ndim reads, ``len()``, ``isinstance()`` etc.
+sanitize taint (they are static under trace).  Hazard visitors then
+classify findings per rules.py; a finding is *definite* (manifest
+grade) only when the hazard holds regardless of which argument is
+traced (``.numpy()``, ``time.time()``, host randomness) or when it
+touches a name the body itself treats as an array (passed to
+jnp/lax/jax calls).
+
+The pass is intentionally file-local and approximate: it must never
+import the code it inspects (analysis of a broken tree is exactly when
+lint is most useful), and false positives are absorbed by the checked
+baseline rather than by weakening detection.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import re
+
+from .rules import RULES
+
+__all__ = ["Finding", "analyze_file", "analyze_paths", "iter_py_files"]
+
+
+# ---------------------------------------------------------------------------
+# model
+
+@dataclasses.dataclass
+class Finding:
+    rule: str           # rules.py slug
+    path: str           # posix path relative to the analysis root's parent
+    line: int
+    col: int
+    func: str           # dotted qualname of the op body ("" for module)
+    func_name: str      # runtime co_name ("<lambda>" for lambdas)
+    func_line: int      # runtime co_firstlineno of the op body
+    message: str
+    symbol: str         # short stable token for fingerprinting
+    severity: str
+    confidence: str     # "definite" | "possible"
+    context: str        # "op-body" | "non-jittable" | "trace-site"
+    suppressed: bool = False
+
+    @property
+    def rule_id(self):
+        return RULES[self.rule].id
+
+    def fingerprint(self):
+        """Line-number-free identity: survives unrelated edits above the
+        finding, so the baseline doesn't churn with the file."""
+        return f"{self.rule}|{self.path}|{self.func}|{self.symbol}"
+
+    def to_dict(self):
+        d = dataclasses.asdict(self)
+        d["rule_id"] = self.rule_id
+        d["fingerprint"] = self.fingerprint()
+        return d
+
+
+# ---------------------------------------------------------------------------
+# shared AST helpers
+
+DISPATCH_NAMES = {"apply", "_apply", "run_op"}
+NON_JITTABLE_NAMES = {"non_jittable"}
+
+# attribute reads that are static under a jax trace — they sanitize taint
+STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "weak_type", "name",
+                "itemsize", "nbytes"}
+# calls whose result is host-static regardless of argument taint
+# (shape/dtype queries are resolved at trace time, not run time)
+SANITIZER_CALLS = {"len", "isinstance", "issubclass", "type", "id",
+                   "repr", "str", "format", "hasattr", "callable",
+                   "result_type", "issubdtype", "can_cast",
+                   "promote_types", "iscomplexobj", "isrealobj",
+                   "ndim", "shape", "finfo", "iinfo"}
+# scalar coercions: hazardous only on a traced operand
+COERCIONS = {"float", "int", "bool", "complex"}
+HOST_METHODS = {"numpy", "item", "tolist"}
+NP_HOST_FUNCS = {"asarray", "array", "asanyarray", "ascontiguousarray",
+                 "frombuffer", "copyto", "save", "savez"}
+IMPURE_MODULE_HEADS = {"time", "random", "secrets", "uuid", "datetime"}
+MUTATING_METHODS = {"append", "extend", "insert", "remove", "pop", "clear",
+                    "update", "setdefault", "add", "discard", "popitem",
+                    "write", "writelines", "sort", "reverse"}
+ARRAY_METHODS = {"astype", "reshape", "sum", "mean", "transpose", "ravel",
+                 "squeeze", "flatten", "min", "max", "at", "dot", "take",
+                 "cumsum", "prod", "conj", "real", "imag", "round", "clip",
+                 "numpy", "item", "tolist"}
+ARRAY_CALL_HEADS = {"jnp", "jax", "lax", "_jnp", "jsp"}
+MODULE_HEADS = ARRAY_CALL_HEADS | {"np", "numpy", "math", "os", "sys",
+                                   "warnings", "collections", "itertools"}
+KEYISH_NAME = re.compile(r"(^|_)(key|keys|rng|rngs|seed|prng)(_|$)|"
+                         r"(^|_)(rand|noise)(_|$)")
+ARRAY_PRODUCER_FUNCS = {"Tensor", "to_tensor", "asarray", "next_key",
+                        "PRNGKey", "key", "split", "fold_in", "randn",
+                        "rand", "uniform", "normal", "zeros", "ones",
+                        "arange", "full", "empty"}
+
+# whole-program trace entry points for the suspend audit
+TRACE_ENTRY_DOTTED = {
+    ("jax", "jit"), ("jax", "value_and_grad"), ("jax", "make_jaxpr"),
+    ("jax", "eval_shape"), ("jax", "linearize"),
+    ("lax", "cond"), ("lax", "switch"), ("lax", "while_loop"),
+    ("jax", "lax", "cond"), ("jax", "lax", "switch"),
+    ("jax", "lax", "while_loop"),
+    ("jexport", "export"), ("export", "export"),
+}
+TRACE_ENTRY_BARE = {"shard_map"}
+
+
+def dotted(node):
+    """('jax','jit') for jax.jit, ('x',) for x; None for anything else."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return tuple(reversed(parts))
+    return None
+
+
+def runtime_first_line(node):
+    """co_firstlineno of the code object this def/lambda compiles to:
+    for decorated defs that is the FIRST DECORATOR line, not the `def`
+    line (CPython 3.8+ ast puts .lineno on the def)."""
+    decs = getattr(node, "decorator_list", None)
+    if decs:
+        return min([d.lineno for d in decs] + [node.lineno])
+    return node.lineno
+
+
+def func_params(node):
+    """(all param names, names assumed TRACED). Params with defaults are
+    assumed static — the codebase idiom rides statics in via defaults
+    (`lambda x, axis=axis: ...`) and arrays positionally."""
+    a = node.args
+    names, traced = [], set()
+    pos = list(a.posonlyargs) + list(a.args)
+    n_def = len(a.defaults)
+    for i, p in enumerate(pos):
+        names.append(p.arg)
+        if i < len(pos) - n_def:
+            traced.add(p.arg)
+    if a.vararg:
+        names.append(a.vararg.arg)
+        traced.add(a.vararg.arg)
+    for p, d in zip(a.kwonlyargs, a.kw_defaults):
+        names.append(p.arg)
+        if d is None:
+            traced.add(p.arg)
+    if a.kwarg:
+        names.append(a.kwarg.arg)
+    return names, traced
+
+
+class _ScopeIndex:
+    """Parent links + lexical scope chains for one module AST."""
+
+    def __init__(self, tree):
+        self.parent = {}
+        for node in ast.walk(tree):
+            for child in ast.iter_child_nodes(node):
+                self.parent[child] = node
+        self.tree = tree
+
+    def scope_chain(self, node):
+        """Enclosing FunctionDef/AsyncFunctionDef/Lambda/ClassDef nodes,
+        innermost first (the node itself excluded)."""
+        out = []
+        cur = self.parent.get(node)
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Lambda, ast.ClassDef)):
+                out.append(cur)
+            cur = self.parent.get(cur)
+        return out
+
+    def qualname(self, node):
+        parts = []
+        for s in [node] + self.scope_chain(node):
+            if isinstance(s, ast.Lambda):
+                parts.append("<lambda>")
+            else:
+                parts.append(s.name)
+        return ".".join(reversed(parts))
+
+    def resolve_function(self, name, from_node):
+        """Find the def/lambda a bare name refers to at `from_node`,
+        searching enclosing function scopes innermost-out, then module
+        level. Returns the AST node or None."""
+        scopes = [s for s in self.scope_chain(from_node)
+                  if not isinstance(s, ast.ClassDef)]
+        scopes.append(self.tree)
+        for scope in scopes:
+            body = scope.body if not isinstance(scope, ast.Lambda) else []
+            hit = None
+            for stmt in body:
+                if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                        and stmt.name == name:
+                    hit = stmt
+                elif isinstance(stmt, ast.Assign):
+                    for t in stmt.targets:
+                        if isinstance(t, ast.Name) and t.id == name \
+                                and isinstance(stmt.value, ast.Lambda):
+                            hit = stmt.value
+            if hit is not None:
+                return hit
+        return None
+
+
+# ---------------------------------------------------------------------------
+# per-op-body hazard analysis
+
+class _OpBodyChecker:
+    def __init__(self, fnode, scopes, relpath, lines, findings, context):
+        self.fnode = fnode
+        self.scopes = scopes
+        self.relpath = relpath
+        self.lines = lines
+        self.findings = findings
+        self.context = context
+        self.qual = scopes.qualname(fnode)
+        self.func_name = (fnode.name
+                          if isinstance(fnode, (ast.FunctionDef,
+                                                ast.AsyncFunctionDef))
+                          else "<lambda>")
+        self.func_line = runtime_first_line(fnode)
+        self.n_found = 0
+
+        self.params, self.tainted = func_params(fnode)
+        self.vararg = fnode.args.vararg.arg if fnode.args.vararg else None
+        self.locals = set(self.params)
+        self._collect_locals()
+        self.array_evidence = self._collect_array_evidence()
+        self._propagate_taint()
+
+    # -- scope bookkeeping --------------------------------------------------
+    def _body_nodes(self):
+        if isinstance(self.fnode, ast.Lambda):
+            yield from ast.walk(self.fnode.body)
+        else:
+            for stmt in self.fnode.body:
+                yield from ast.walk(stmt)
+
+    def _collect_locals(self):
+        for n in self._body_nodes():
+            if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Store):
+                self.locals.add(n.id)
+            elif isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.locals.add(n.name)
+            elif isinstance(n, ast.comprehension):
+                for t in ast.walk(n.target):
+                    if isinstance(t, ast.Name):
+                        self.locals.add(t.id)
+
+    def _collect_array_evidence(self):
+        """Names the body itself treats as arrays: fed to jnp/lax/jax
+        calls or used with array methods."""
+        ev = set()
+        for n in self._body_nodes():
+            if isinstance(n, ast.Call):
+                d = dotted(n.func)
+                if d and d[0] in ARRAY_CALL_HEADS:
+                    for a in list(n.args) + [kw.value for kw in n.keywords]:
+                        for nm in ast.walk(a):
+                            if isinstance(nm, ast.Name):
+                                ev.add(nm.id)
+            if isinstance(n, ast.Attribute) and n.attr in ARRAY_METHODS \
+                    and isinstance(n.value, ast.Name):
+                ev.add(n.value.id)
+            if isinstance(n, ast.BinOp):
+                for side in (n.left, n.right):
+                    if isinstance(side, ast.Name):
+                        ev.add(side.id)
+        return ev
+
+    def _propagate_taint(self):
+        """Name-level forward taint, iterated to a small fixpoint."""
+        for _ in range(3):
+            changed = False
+            for n in self._body_nodes():
+                tgts = None
+                if isinstance(n, ast.Assign):
+                    tgts, val = n.targets, n.value
+                elif isinstance(n, ast.AugAssign):
+                    tgts, val = [n.target], n.value
+                elif isinstance(n, ast.AnnAssign) and n.value is not None:
+                    tgts, val = [n.target], n.value
+                elif isinstance(n, ast.NamedExpr):
+                    tgts, val = [n.target], n.value
+                if not tgts or not self.expr_tainted(val):
+                    continue
+                for t in tgts:
+                    for nm in ast.walk(t):
+                        if isinstance(nm, ast.Name) \
+                                and nm.id not in self.tainted:
+                            self.tainted.add(nm.id)
+                            changed = True
+            if not changed:
+                break
+
+    # -- taint query --------------------------------------------------------
+    def expr_tainted(self, node):
+        if node is None:
+            return False
+        if isinstance(node, ast.Attribute):
+            if node.attr in STATIC_ATTRS:
+                return False
+            return self.expr_tainted(node.value)
+        if isinstance(node, ast.Call):
+            d = dotted(node.func)
+            if d and (d[-1] in SANITIZER_CALLS or d[-1] in COERCIONS
+                      or d[-1] in HOST_METHODS):
+                return False  # result is host-static (the call itself
+                #               may be a hazard, reported separately)
+            for a in list(node.args) + [kw.value for kw in node.keywords]:
+                if self.expr_tainted(a):
+                    return True
+            # method call: the receiver's taint flows to the result
+            # (x.astype(...) is as traced as x)
+            if isinstance(node.func, ast.Attribute):
+                return self.expr_tainted(node.func.value)
+            return False
+        if isinstance(node, ast.Name):
+            # the *args TUPLE is a host object (its truthiness/len are
+            # trace-static); only its ELEMENTS carry taint
+            if node.id == self.vararg:
+                return False
+            return node.id in self.tainted
+        if isinstance(node, ast.Subscript) and \
+                isinstance(node.value, ast.Name) and \
+                node.value.id == self.vararg:
+            return True
+        if isinstance(node, ast.Compare) and all(
+                isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops):
+            # `x is None` is an identity test on the HOST object — a
+            # tracer is never None, so the branch is trace-static
+            return False
+        for child in ast.iter_child_nodes(node):
+            if self.expr_tainted(child):
+                return True
+        return False
+
+    def _taint_names(self, node):
+        return sorted({n.id for n in ast.walk(node)
+                       if isinstance(n, ast.Name) and n.id in self.tainted})
+
+    # -- reporting ----------------------------------------------------------
+    def report(self, rule, node, message, symbol, confidence):
+        sev = RULES[rule].severity
+        self.findings.append(Finding(
+            rule=rule, path=self.relpath, line=node.lineno,
+            col=node.col_offset, func=self.qual, func_name=self.func_name,
+            func_line=self.func_line, message=message, symbol=symbol,
+            severity=sev, confidence=confidence, context=self.context))
+        self.n_found += 1
+
+    # -- the checks ---------------------------------------------------------
+    def run(self):
+        self._check_declared_state()
+        for n in self._body_nodes():
+            if isinstance(n, ast.Call):
+                self._check_call(n)
+            elif isinstance(n, (ast.Assign, ast.AugAssign)):
+                self._check_store(n)
+            elif isinstance(n, ast.If):
+                self._check_branch(n, n.test, "if")
+            elif isinstance(n, ast.While):
+                self._check_branch(n, n.test, "while")
+            elif isinstance(n, ast.IfExp):
+                self._check_branch(n, n.test, "ternary")
+            elif isinstance(n, ast.Assert):
+                self._check_branch(n, n.test, "assert")
+            elif isinstance(n, ast.For):
+                if self.expr_tainted(n.iter):
+                    self.report(
+                        "data-dependent-control-flow", n,
+                        "for-loop iterates over a traced value "
+                        f"({', '.join(self._taint_names(n.iter))}) — the "
+                        "trace unrolls per element or fails on dynamic "
+                        "length", "for:" + ",".join(self._taint_names(n.iter)),
+                        "possible")
+        self._check_closure_capture()
+        return self.n_found
+
+    def _check_declared_state(self):
+        for n in self._body_nodes():
+            if isinstance(n, (ast.Global, ast.Nonlocal)):
+                kind = "global" if isinstance(n, ast.Global) else "nonlocal"
+                self.report(
+                    "state-mutation", n,
+                    f"`{kind} {', '.join(n.names)}` inside an op body — "
+                    "the rebind happens once at trace time, then never "
+                    "again in the compiled program",
+                    f"{kind}:{','.join(n.names)}", "definite")
+
+    def _check_call(self, n):
+        d = dotted(n.func)
+        # .numpy()/.item()/.tolist() — host sync no matter which operand
+        if isinstance(n.func, ast.Attribute) and n.func.attr in HOST_METHODS:
+            base = n.func.value
+            base_d = dotted(base)
+            if base_d and base_d[0] in IMPURE_MODULE_HEADS:
+                pass  # e.g. datetime.date.today().tolist() — TL004 below
+            else:
+                conf = ("definite"
+                        if self.expr_tainted(base)
+                        or (isinstance(base, ast.Name)
+                            and base.id in self.array_evidence)
+                        else "possible")
+                self.report(
+                    "host-materialize", n,
+                    f".{n.func.attr}() forces a host transfer inside a "
+                    "potentially-traced op body (fails on tracers, "
+                    "de-optimizes on arrays)",
+                    f".{n.func.attr}", conf)
+                return
+        # float(x)/int(x)/bool(x) on traced values
+        if d and len(d) == 1 and d[0] in COERCIONS and n.args:
+            if self.expr_tainted(n.args[0]):
+                names = self._taint_names(n.args[0])
+                in_ev = any(nm in self.array_evidence for nm in names)
+                self.report(
+                    "host-materialize", n,
+                    f"{d[0]}() on a traced value "
+                    f"({', '.join(names)}) raises "
+                    "ConcretizationTypeError under trace",
+                    f"{d[0]}:{','.join(names)}",
+                    "definite" if in_ev else "possible")
+                return
+        # np.asarray & friends on traced values
+        if d and len(d) >= 2 and d[0] in ("np", "numpy") \
+                and d[-1] in NP_HOST_FUNCS:
+            if len(d) >= 2 and d[1] == "random":
+                pass  # np.random.* handled as impurity below
+            elif any(self.expr_tainted(a) for a in n.args):
+                names = [nm for a in n.args for nm in self._taint_names(a)]
+                self.report(
+                    "host-materialize", n,
+                    f"{'.'.join(d)} materializes a traced value "
+                    f"({', '.join(names)}) on host",
+                    ".".join(d), "definite")
+                return
+        # wall clock / host randomness
+        if d and d[0] in IMPURE_MODULE_HEADS and len(d) >= 2:
+            self.report(
+                "impure-call", n,
+                f"{'.'.join(d)}() inside an op body — the value is "
+                "frozen at trace time and replayed by every cached call",
+                ".".join(d), "definite")
+            return
+        if d and len(d) >= 3 and d[0] in ("np", "numpy") and d[1] == "random":
+            self.report(
+                "impure-call", n,
+                f"{'.'.join(d)}() — numpy host randomness freezes into "
+                "the compiled program; thread a jax PRNG key instead",
+                ".".join(d), "definite")
+            return
+        if d and d[0] == "os" and d[-1] == "urandom":
+            self.report("impure-call", n, "os.urandom inside an op body",
+                        "os.urandom", "definite")
+            return
+        # mutating method on a free (captured) name — but not on a
+        # module (jnp.sort is numpy-API sort, not list mutation)
+        if isinstance(n.func, ast.Attribute) \
+                and n.func.attr in MUTATING_METHODS \
+                and isinstance(n.func.value, ast.Name) \
+                and n.func.value.id not in self.locals \
+                and n.func.value.id not in MODULE_HEADS:
+            self.report(
+                "state-mutation", n,
+                f"`{n.func.value.id}.{n.func.attr}(...)` mutates captured "
+                "state — runs once at trace time, never per compiled call",
+                f"{n.func.value.id}.{n.func.attr}", "possible")
+
+    def _check_store(self, n):
+        tgts = n.targets if isinstance(n, ast.Assign) else [n.target]
+        for t in tgts:
+            root = t
+            while isinstance(root, (ast.Attribute, ast.Subscript)):
+                root = root.value
+            if root is t:
+                continue  # plain name store: local, fine
+            if isinstance(root, ast.Name) and root.id not in self.locals:
+                kind = ("attribute"
+                        if isinstance(t, ast.Attribute) else "subscript")
+                self.report(
+                    "state-mutation", n,
+                    f"{kind} store on captured `{root.id}` inside an op "
+                    "body — the write happens at trace time only",
+                    f"store:{root.id}", "definite")
+
+    def _check_branch(self, node, test, kind):
+        if not self.expr_tainted(test):
+            return
+        names = self._taint_names(test)
+        in_ev = any(nm in self.array_evidence for nm in names)
+        self.report(
+            "data-dependent-control-flow", node,
+            f"`{kind}` on a traced value ({', '.join(names)}) — "
+            "TracerBoolConversionError under trace; use jnp.where / "
+            "lax.cond, or mark the op @non_jittable",
+            f"{kind}:{','.join(names)}",
+            "definite" if in_ev else "possible")
+
+    # -- closure capture ----------------------------------------------------
+    def _free_loads(self):
+        free = {}
+        for n in self._body_nodes():
+            if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load) \
+                    and n.id not in self.locals:
+                free.setdefault(n.id, n)
+        return free
+
+    def _enclosing_binding_is_arrayish(self, name):
+        """Best-effort: does `name` bind to an array/Tensor/PRNG key in an
+        enclosing FUNCTION scope? Module-level captures are globals, not
+        closure cells — skip them (TL003 covers mutation)."""
+        for scope in self.scopes.scope_chain(self.fnode):
+            if isinstance(scope, ast.ClassDef):
+                continue
+            if isinstance(scope, ast.Lambda):
+                params, _ = func_params(scope)
+                if name in params:
+                    return bool(KEYISH_NAME.search(name))
+                continue
+            params, _ = func_params(scope)
+            if name in params:
+                return bool(KEYISH_NAME.search(name))
+            for stmt in scope.body:
+                for sub in ast.walk(stmt):
+                    if sub is self.fnode:
+                        break  # don't read our own body
+                    if isinstance(sub, ast.Assign) and any(
+                            isinstance(t, ast.Name) and t.id == name
+                            for t in sub.targets):
+                        if self._value_is_arrayish(sub.value):
+                            return True
+            return False  # bound (or not) in nearest function scope: stop
+        return False
+
+    @staticmethod
+    def _value_is_arrayish(v):
+        """Does this binding expression produce a live array/Tensor/PRNG
+        key? Deliberately narrow — `lax.conv_dimension_numbers(...)` and
+        other static config objects captured from jnp/lax helpers are
+        keyable and fine."""
+        if isinstance(v, ast.Call):
+            d = dotted(v.func)
+            if d and (d[-1] in ARRAY_PRODUCER_FUNCS
+                      or "random" in d[:-1]
+                      or any(KEYISH_NAME.search(p) for p in d)):
+                return True
+        if isinstance(v, ast.Attribute) and v.attr in ("_value", "grad"):
+            return True
+        return False
+
+    def _check_closure_capture(self):
+        for name, node in sorted(self._free_loads().items()):
+            if self._enclosing_binding_is_arrayish(name):
+                self.report(
+                    "closure-capture", node,
+                    f"op body captures `{name}` (live array/PRNG key) "
+                    "from an enclosing scope — the dispatch cache "
+                    "refuses it, so this op pays eager dispatch every "
+                    "call; pass it as an argument instead",
+                    f"capture:{name}", "possible")
+
+
+# ---------------------------------------------------------------------------
+# per-module driver
+
+def _relpath(path, root_parent):
+    rel = os.path.relpath(path, root_parent)
+    return rel.replace(os.sep, "/")
+
+
+def _suppressed(lines, lineno, rule):
+    """Inline waiver: `# tracelint: ok` or `# tracelint: ok[slug,...]` on
+    the flagged line waives the finding after human review."""
+    if not 1 <= lineno <= len(lines):
+        return False
+    m = re.search(r"#\s*tracelint:\s*ok(\[([A-Za-z0-9_,\- ]+)\])?",
+                  lines[lineno - 1])
+    if not m:
+        return False
+    if m.group(2) is None:
+        return True
+    waived = {s.strip() for s in m.group(2).split(",")}
+    return rule in waived or RULES[rule].id in waived
+
+
+class ModuleAnalysis:
+    def __init__(self, path, root_parent, audit_suspend=True):
+        self.path = path
+        self.relpath = _relpath(path, root_parent)
+        with open(path, "r", encoding="utf-8") as f:
+            self.src = f.read()
+        self.lines = self.src.splitlines()
+        self.tree = ast.parse(self.src, filename=path)
+        self.scopes = _ScopeIndex(self.tree)
+        self.audit_suspend = audit_suspend
+        self.findings = []
+
+    # -- op-body discovery --------------------------------------------------
+    def _op_bodies(self):
+        """{id(node): (node, context)} — dispatched op bodies and
+        @non_jittable functions."""
+        found = {}
+
+        def add(node, context):
+            if node is not None and id(node) not in found:
+                found[id(node)] = (node, context)
+
+        for n in ast.walk(self.tree):
+            if isinstance(n, ast.Call):
+                d = dotted(n.func)
+                if d and len(d) == 1 and d[0] in DISPATCH_NAMES and n.args:
+                    tgt = n.args[0]
+                    if isinstance(tgt, ast.Lambda):
+                        add(tgt, "op-body")
+                    elif isinstance(tgt, ast.Name):
+                        add(self.scopes.resolve_function(tgt.id, n),
+                            "op-body")
+                # non_jittable(fn) direct-call form
+                if d and d[-1] in NON_JITTABLE_NAMES and n.args \
+                        and isinstance(n.args[0], ast.Name):
+                    add(self.scopes.resolve_function(n.args[0].id, n),
+                        "non-jittable")
+            elif isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in n.decorator_list:
+                    dd = dotted(dec)
+                    if dd and dd[-1] in NON_JITTABLE_NAMES:
+                        add(n, "non-jittable")
+        return list(found.values())
+
+    # -- suspend audit ------------------------------------------------------
+    def _suspending_helpers(self):
+        """Module-level functions whose body enters dispatch.suspend() (or
+        an already-known suspending helper): calls to them count as
+        suspension for the audit."""
+        names = set()
+        for _ in range(2):  # one level of helper-calls-helper
+            for stmt in self.tree.body:
+                if not isinstance(stmt, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                    continue
+                if stmt.name in names:
+                    continue
+                if self._subtree_suspends(stmt, names):
+                    names.add(stmt.name)
+        return names
+
+    @staticmethod
+    def _subtree_suspends(node, helper_names=()):
+        for n in ast.walk(node):
+            if isinstance(n, ast.Call):
+                d = dotted(n.func)
+                if d and (d[-1] == "suspend" or d[-1] in helper_names):
+                    return True
+        return False
+
+    def _audit_suspend_sites(self):
+        helper_names = self._suspending_helpers()
+        for n in ast.walk(self.tree):
+            if not isinstance(n, ast.Call):
+                continue
+            d = dotted(n.func)
+            if not d:
+                continue
+            is_entry = (d in TRACE_ENTRY_DOTTED
+                        or (len(d) == 1 and d[0] in TRACE_ENTRY_BARE))
+            if not is_entry:
+                continue
+            chain = self.scopes.scope_chain(n)
+            scope = chain[-1] if chain else None
+            if scope is not None:
+                if self._subtree_suspends(scope, helper_names):
+                    continue
+                qual = self.scopes.qualname(scope)
+                fname = getattr(scope, "name", "<lambda>")
+                fline = scope.lineno
+            else:
+                # module-level trace call: scan its top-level statement
+                stmt = n
+                while not isinstance(self.scopes.parent.get(stmt),
+                                     (ast.Module, type(None))):
+                    stmt = self.scopes.parent[stmt]
+                if self._subtree_suspends(stmt, helper_names):
+                    continue
+                qual, fname = "<module>", "<module>"
+                fline = getattr(stmt, "lineno", 1)
+            self.findings.append(Finding(
+                rule="suspend-audit", path=self.relpath, line=n.lineno,
+                col=n.col_offset, func=qual,
+                func_name=fname,
+                func_line=fline,
+                message=f"{'.'.join(d)} traces user code with the per-op "
+                        "dispatch cache live — wrap the traced body in "
+                        "core.dispatch.suspend() (or waive with "
+                        "`# tracelint: ok[suspend-audit]` if the traced "
+                        "fn never dispatches paddle ops)",
+                symbol="trace:" + ".".join(d),
+                severity=RULES["suspend-audit"].severity,
+                confidence="possible", context="trace-site"))
+
+    # -- run ----------------------------------------------------------------
+    def run(self):
+        bodies = self._op_bodies()
+        for node, context in bodies:
+            checker = _OpBodyChecker(node, self.scopes, self.relpath,
+                                     self.lines, self.findings, context)
+            n_found = checker.run()
+            if context == "non-jittable" and n_found == 0:
+                self.findings.append(Finding(
+                    rule="stale-non-jittable", path=self.relpath,
+                    line=node.lineno, col=node.col_offset,
+                    func=checker.qual, func_name=checker.func_name,
+                    func_line=checker.func_line,
+                    message="analysis finds no trace hazard in this "
+                            "@non_jittable op — if the marking guards a "
+                            "value-dependent shape, waive it; otherwise "
+                            "drop it and let the op jit",
+                    symbol="stale", severity="info",
+                    confidence="possible", context="non-jittable"))
+        if self.audit_suspend:
+            self._audit_suspend_sites()
+        for f in self.findings:
+            f.suppressed = _suppressed(self.lines, f.line, f.rule)
+        return self.findings
+
+
+# ---------------------------------------------------------------------------
+# tree driver
+
+SKIP_DIRS = {"__pycache__", ".git", "libs", "include"}
+# the dispatch/autograd machinery IS the cache — its jit sites are the
+# implementation, not clients; auditing them is a tautology
+AUDIT_EXEMPT_SUFFIXES = ("core/dispatch.py", "core/autograd.py",
+                         "core/jax_compat.py")
+
+
+def iter_py_files(root):
+    if os.path.isfile(root):
+        yield root
+        return
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = sorted(d for d in dirnames if d not in SKIP_DIRS)
+        for fn in sorted(filenames):
+            if fn.endswith(".py"):
+                yield os.path.join(dirpath, fn)
+
+
+def analyze_paths(roots, audit_suspend=True):
+    """Analyze every .py under each root. Returns (findings, errors):
+    errors are (path, message) for unparseable files."""
+    findings, errors = [], []
+    for root in roots:
+        root = os.path.normpath(root)
+        root_parent = os.path.dirname(os.path.abspath(root))
+        for path in iter_py_files(root):
+            rel = _relpath(path, root_parent)
+            audit = audit_suspend and not rel.endswith(AUDIT_EXEMPT_SUFFIXES)
+            try:
+                ma = ModuleAnalysis(path, root_parent, audit_suspend=audit)
+                findings.extend(ma.run())
+            except (SyntaxError, UnicodeDecodeError) as e:
+                errors.append((rel, f"{type(e).__name__}: {e}"))
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings, errors
+
+
+def analyze_file(path, audit_suspend=True):
+    return analyze_paths([path], audit_suspend=audit_suspend)

@@ -65,6 +65,8 @@ import jax
 import numpy as np
 
 from . import dtype as _pdtypes
+from ..runtime.resilience import fault_events as _fault_events
+from ..runtime.resilience import record_fault as _record_fault
 
 __all__ = [
     "run_op", "non_jittable", "dispatch_stats", "reset_dispatch_stats",
@@ -218,6 +220,12 @@ def _mark_non_jittable(ident, fn, source):
     _non_jittable_src.setdefault(ident, source)
     if not isinstance(ident, types.CodeType):
         _non_jittable_refs.append(fn)
+    if source == "runtime":
+        # a runtime-learned demotion paid a failed compile probe AND
+        # permanently degrades this op to eager — that is a resilience
+        # event (observable degradation), not just a cache statistic
+        _record_fault("eager_demotions",
+                      getattr(fn, "__name__", str(ident)))
 
 
 def non_jittable(fn):
@@ -568,6 +576,10 @@ def dispatch_stats():
             "runtime_learned": src.get("runtime", 0),
             "manifest_entries": len(_manifest),
         },
+        # degradation counters from the resilience runtime (save retries,
+        # restore fallbacks, rollbacks, stalls, eager demotions, ...) —
+        # surfaced here so one snapshot shows compute AND failure health
+        "fault_events": _fault_events(),
     }
 
 

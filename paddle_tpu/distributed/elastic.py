@@ -9,6 +9,24 @@ that detects a hung step loop, and (2) periodic sharded checkpoints
 (io/checkpoint.py) + `resume()` that restores the newest complete one.
 The kill-and-resume path is what the reference's relaunch gives you, minus
 the process manager (the TPU scheduler owns process lifecycles).
+
+Hardening (runtime/resilience.py):
+
+* The watchdog tracks its own start time, so a hang BEFORE the first
+  heartbeat ever appears is reported (reason ``no_heartbeat``) instead
+  of being `continue`d forever; it survives its own exceptions
+  (``watchdog_errors`` fault event) and distinguishes a per-step
+  deadline (heartbeat present but the step number stuck) from the
+  whole-run deadline (total wall clock exceeded).
+* `tick` is monotonicity-checked: a stale step from a confused caller
+  records a ``heartbeat_regressions`` fault event instead of silently
+  moving recorded progress backwards.
+* `latest_checkpoint` delegates to io.checkpoint's single definition of
+  a complete step (orbax tmp-dir aware) — elastic resume and checkpoint
+  retention can never disagree about "newest complete" again.
+* `guard()` wires a BadStepGuard to this manager's checkpoint dir:
+  non-finite loss rolls back to the newest complete checkpoint and the
+  loop skips forward.
 """
 from __future__ import annotations
 
@@ -16,30 +34,37 @@ import json
 import os
 import threading
 import time
+import warnings
 
-__all__ = ["ElasticManager", "heartbeat", "latest_checkpoint"]
+from ..core.dispatch import non_jittable
+from ..runtime.resilience import (
+    BadStepGuard, atomic_write_json, fault_point, record_fault,
+)
+
+__all__ = ["ElasticManager", "heartbeat", "latest_checkpoint",
+           "BadStepGuard"]
 
 
+@non_jittable  # host-side wall clock by design; must never be jit-cached
 def heartbeat(path, step, payload=None):
-    """Atomically record liveness + progress (watchdogs poll this file)."""
-    tmp = path + ".tmp"
-    with open(tmp, "w") as f:
-        json.dump({"step": int(step), "time": time.time(),
-                   **(payload or {})}, f)
-    os.replace(tmp, path)
+    """Atomically record liveness + progress (watchdogs poll this file).
+    No fsync: a heartbeat lost in a crash is moot — the process it
+    vouched for is dead — and a per-step fsync is real latency."""
+    fault_point("elastic.heartbeat", path=path, step=step)
+    atomic_write_json(path, {"step": int(step), "time": time.time(),  # tracelint: ok[impure-call,host-materialize]
+                             **(payload or {})}, fsync=False)
 
 
 def latest_checkpoint(ckpt_dir):
-    """Newest complete checkpoint step in ckpt_dir (orbax layout), or None."""
-    if not os.path.isdir(ckpt_dir):
-        return None
-    steps = []
-    for name in os.listdir(ckpt_dir):
-        p = os.path.join(ckpt_dir, name)
-        if name.isdigit() and os.path.isdir(p) and not os.path.exists(
-                os.path.join(p, ".incomplete")):
-            steps.append(int(name))
-    return max(steps) if steps else None
+    """Newest complete checkpoint step in ckpt_dir (orbax layout), or None.
+
+    Delegates to io.checkpoint.latest_complete_step — the SAME
+    tmp-dir-aware scan CheckpointManager.latest_step() uses, so resume
+    can never pick a step retention/restore would reject. (The import
+    is lazy: elastic stays importable without pulling orbax/jax.)"""
+    from ..io.checkpoint import latest_complete_step
+
+    return latest_complete_step(ckpt_dir)
 
 
 class ElasticManager:
@@ -49,51 +74,126 @@ class ElasticManager:
         em = ElasticManager(ckpt_dir, timeout=300)
         start = em.resume(restore_fn)      # restore newest ckpt, or 0
         em.start_watchdog(on_stall=...)    # background liveness monitor
+        guard = em.guard(restore_fn)       # optional bad-step sentinel
         for step in range(start, n):
-            ...train...
+            loss = ...train...
+            if not guard.check(step, loss):
+                continue                   # rolled back; skip this step
             em.tick(step)                  # heartbeat (+ periodic save)
+
+    `timeout` is the heartbeat-age stall threshold (and the grace period
+    for the FIRST heartbeat to appear). `step_deadline` fires when the
+    heartbeat stays fresh but the step number stops advancing (a loop
+    alive-but-wedged below the tick site). `run_deadline` bounds total
+    wall clock for the whole run. Each fires `on_stall(info)` once with
+    info["reason"] in {"no_heartbeat", "stalled", "step_deadline",
+    "run_deadline"}.
     """
 
     def __init__(self, ckpt_dir, timeout=300.0, save_interval=100,
-                 save_fn=None):
+                 save_fn=None, step_deadline=None, run_deadline=None):
         self.ckpt_dir = ckpt_dir
         self.timeout = timeout
         self.save_interval = save_interval
         self.save_fn = save_fn
+        self.step_deadline = step_deadline
+        self.run_deadline = run_deadline
         self._hb_path = os.path.join(ckpt_dir, "heartbeat.json")
         self._watch = None
         self._stop = threading.Event()
+        self._last_step = None
         self.stalled = False
+        self.stall_reason = None
         os.makedirs(ckpt_dir, exist_ok=True)
 
-    def tick(self, step):
-        heartbeat(self._hb_path, step)
+    def tick(self, step, payload=None):
+        """Heartbeat + periodic save. Monotonicity-checked: a step older
+        than the last recorded one is a caller bug (stale step threaded
+        through a retry/rollback path) — it records a
+        `heartbeat_regressions` fault event and leaves the recorded
+        progress untouched, returning False."""
+        step = int(step)
+        if self._last_step is not None and step < self._last_step:
+            record_fault("heartbeat_regressions",
+                         f"tick({step}) after step {self._last_step}")
+            warnings.warn(
+                f"paddle_tpu elastic: tick({step}) would move the "
+                f"heartbeat backwards (already at step {self._last_step}) "
+                "— ignoring the stale step", stacklevel=2)
+            return False
+        heartbeat(self._hb_path, step, payload)
+        self._last_step = step
         if self.save_fn is not None and self.save_interval and \
                 step > 0 and step % self.save_interval == 0:
             self.save_fn(step)
+        return True
 
     def resume(self, restore_fn):
         """Restore the newest complete checkpoint; returns the step to
-        continue from (0 when starting fresh)."""
+        continue from (0 when starting fresh). `restore_fn(step)` may
+        return the step it ACTUALLY restored (CheckpointManager.restore
+        falls back past corrupted steps) — resume continues after that
+        one."""
         step = latest_checkpoint(self.ckpt_dir)
         if step is None:
             return 0
-        restore_fn(step)
+        restored = restore_fn(step)
+        if isinstance(restored, int) and not isinstance(restored, bool):
+            step = restored
         return step + 1
 
+    def guard(self, restore_fn, max_consecutive=3, on_escalate=None):
+        """BadStepGuard wired to this manager: rollback restores the
+        newest complete checkpoint via `restore_fn` (same signature as
+        `resume`'s). A rollback with no checkpoint on disk is recorded
+        but is a no-op — there is nothing to roll back TO."""
+
+        def _rollback(bad_step):
+            last = latest_checkpoint(self.ckpt_dir)
+            if last is None:
+                warnings.warn(
+                    f"paddle_tpu elastic: bad step {bad_step} with no "
+                    "checkpoint on disk — state NOT rolled back",
+                    stacklevel=2)
+                return
+            restore_fn(last)
+
+        return BadStepGuard(_rollback, max_consecutive=max_consecutive,
+                            on_escalate=on_escalate)
+
+    # -- watchdog -----------------------------------------------------------
     def start_watchdog(self, on_stall=None, poll=5.0):
+        """Background liveness monitor. Fires `on_stall(info)` at most
+        once, then exits; every poll iteration is exception-guarded (a
+        torn heartbeat read or a failing callback must not kill the
+        monitor — `watchdog_errors` counts survivals)."""
+        started = time.time()
+        state = {"step": None, "advanced": started}
+
+        def _stall(reason, hb):
+            self.stalled = True
+            self.stall_reason = reason
+            record_fault("stall_detections", f"{reason} "
+                         f"(step {hb.get('step')})")
+            if on_stall is not None:
+                try:
+                    on_stall({**hb, "reason": reason})
+                except Exception as e:  # noqa: BLE001 — callback bug
+                    record_fault("watchdog_errors",
+                                 f"on_stall: {type(e).__name__}: {e}")
+
         def _watch():
             while not self._stop.wait(poll):
                 try:
-                    with open(self._hb_path) as f:
-                        hb = json.load(f)
-                    age = time.time() - hb.get("time", 0)
-                except (OSError, ValueError):
+                    stall = _watchdog_scan(
+                        self._hb_path, started, state, self.timeout,
+                        self.step_deadline, self.run_deadline)
+                except Exception as e:  # noqa: BLE001 — survive own bugs
+                    record_fault("watchdog_errors",
+                                 f"{type(e).__name__}: {e}")
                     continue
-                if age > self.timeout:
-                    self.stalled = True
-                    if on_stall is not None:
-                        on_stall(hb)
+                if stall is not None:
+                    _stall(*stall)
                     return
 
         self._watch = threading.Thread(target=_watch, daemon=True)
@@ -103,3 +203,43 @@ class ElasticManager:
         self._stop.set()
         if self._watch is not None:
             self._watch.join(timeout=2)
+
+
+@non_jittable  # wall-clock liveness math; must never be jit-cached
+def _watchdog_scan(hb_path=None, started=0.0, state=None, timeout=0.0,
+                   step_deadline=None, run_deadline=None):
+    """One watchdog poll: returns (reason, hb_payload) on stall, None
+    while healthy. Host-side wall clock by design (reviewed TL004
+    waiver): liveness IS a wall-clock property. Every parameter is a
+    host static (defaults mark them so for the tracelint taint pass)."""
+    now = time.time()  # tracelint: ok[impure-call]
+    if run_deadline is not None and now - started > run_deadline:
+        # the run can blow its deadline before the first heartbeat ever
+        # lands — the stall payload must still be a dict
+        return "run_deadline", _read_heartbeat(hb_path) or {"step": None}
+    hb = _read_heartbeat(hb_path)
+    if hb is None:
+        # missing/unreadable heartbeat: before the fix this was
+        # `continue`d forever — a hang before the first tick() was
+        # never reported. The watchdog's own start time bounds it.
+        if now - started > timeout:
+            return "no_heartbeat", {"step": None}
+        return None
+    if now - hb.get("time", 0) > timeout:
+        return "stalled", hb
+    step = hb.get("step")
+    if step != state["step"]:
+        state["step"] = step
+        state["advanced"] = now
+    elif step_deadline is not None and now - state["advanced"] > \
+            step_deadline:
+        return "step_deadline", hb
+    return None
+
+
+def _read_heartbeat(path):
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None

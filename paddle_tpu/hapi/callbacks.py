@@ -9,7 +9,7 @@ import numpy as np
 
 __all__ = ["Callback", "CallbackList", "ProgBarLogger", "ModelCheckpoint",
            "LRScheduler", "EarlyStopping", "VisualDL", "ReduceLROnPlateau",
-           "config_callbacks"]
+           "ResilienceCallback", "config_callbacks"]
 
 
 class Callback:
@@ -285,6 +285,189 @@ class VisualDL(Callback):
     def on_train_end(self, logs=None):
         if self._f:
             self._f.close()
+
+
+class ResilienceCallback(Callback):
+    """Fault-tolerant `Model.fit`: checkpoint-interval saves, bad-step
+    rollback, and heartbeats — the whole resilience story from the
+    high-level API.
+
+        model.fit(data, epochs=3, callbacks=[
+            ResilienceCallback("ckpts", save_interval=50,
+                               watchdog_timeout=300)])
+
+    Composes the hardened runtime pieces (io/checkpoint.py,
+    distributed/elastic.py, runtime/resilience.py):
+
+    * every `save_interval` global steps, the full train state (params,
+      buffers, optimizer slots, step) is checkpointed asynchronously
+      with integrity manifests; an initial checkpoint at train begin
+      guarantees a rollback target before the first interval;
+    * a non-finite loss rolls params/optimizer back to the newest
+      complete checkpoint and training skips forward; after
+      `max_consecutive_rollbacks` bad steps in a row the escalation
+      callback runs (default: stop training via `model.stop_training`);
+    * a heartbeat file advances per step; with `watchdog_timeout` a
+      background watchdog reports a hung loop — including one that
+      hangs before the first heartbeat — via `on_stall` (default: stop
+      training);
+    * with `resume=True` a restarted fit continues from the newest
+      complete checkpoint (kill-and-resume, the elastic contract).
+
+    Every degradation path is observable in
+    `profiler.fault_events()` / `dispatch_stats()["fault_events"]`.
+    """
+
+    def __init__(self, ckpt_dir, save_interval=100, max_to_keep=3,
+                 async_save=True, watchdog_timeout=None, step_deadline=None,
+                 run_deadline=None, watchdog_poll=5.0,
+                 max_consecutive_rollbacks=3, on_escalate=None, on_stall=None,
+                 verify_integrity=True, resume=True):
+        super().__init__()
+        self.ckpt_dir = ckpt_dir
+        self.save_interval = max(1, int(save_interval))
+        self.max_to_keep = max_to_keep
+        self.async_save = async_save
+        self.watchdog_timeout = watchdog_timeout
+        self.step_deadline = step_deadline
+        self.run_deadline = run_deadline
+        self.watchdog_poll = watchdog_poll
+        self.max_consecutive_rollbacks = max_consecutive_rollbacks
+        self.on_escalate = on_escalate
+        self.on_stall = on_stall
+        self.verify_integrity = verify_integrity
+        self.resume = resume
+        self.global_step = 0
+        self._mngr = None
+        self._em = None
+        self._guard = None
+
+    # -- state capture / write-back -----------------------------------------
+    def _state(self):
+        net = self.model.network
+        engine = self.model._engine
+        state = {
+            "params": {k: p._value for k, p in net.named_parameters()},
+            "bufs": {k: b._value for k, b in net.named_buffers()
+                     if b is not None and hasattr(b, "_value")},
+            "step": np.asarray(self.global_step, np.int64),
+        }
+        if engine._opt_states is not None:
+            # orbax trees round-trip dict keys as str
+            state["opt"] = {str(k): dict(v)
+                            for k, v in engine._opt_states.items()}
+        # orbax rejects empty tree nodes (a network with no buffers)
+        return {k: v for k, v in state.items()
+                if not (isinstance(v, dict) and not v)}
+
+    def _write_back(self, state):
+        import jax.numpy as jnp
+
+        net = self.model.network
+        engine = self.model._engine
+        params = dict(net.named_parameters())
+        for k, v in (state.get("params") or {}).items():
+            if k in params:
+                params[k]._value = jnp.asarray(v)
+        bufs = dict(net.named_buffers())
+        for k, v in (state.get("bufs") or {}).items():
+            if k in bufs and hasattr(bufs[k], "_value"):
+                bufs[k]._value = jnp.asarray(v)
+        opt = state.get("opt")
+        if opt:
+            engine._opt_states = {
+                int(k): {kk: jnp.asarray(vv) for kk, vv in v.items()}
+                for k, v in opt.items()}
+        step = state.get("step")
+        return None if step is None else int(np.asarray(step))
+
+    def _save_step(self, step):
+        self._mngr.save(step, self._state())
+
+    def _restore(self, step=None):
+        """Restore params/opt from the newest complete checkpoint at or
+        below `step`; returns the step restored, or None when nothing
+        restorable exists (the checkpoint manager already recorded the
+        fault events for any fallback it performed)."""
+        try:
+            state = self._mngr.restore(step)
+        except FileNotFoundError:
+            return None
+        restored = self._write_back(state)
+        return self._mngr.last_restored_step if restored is None else restored
+
+    # -- lifecycle -----------------------------------------------------------
+    def on_train_begin(self, logs=None):
+        from ..distributed.elastic import ElasticManager
+        from ..io.checkpoint import CheckpointManager
+        from ..runtime.resilience import BadStepGuard
+
+        self._mngr = CheckpointManager(
+            self.ckpt_dir, max_to_keep=self.max_to_keep,
+            async_save=self.async_save,
+            verify_integrity=self.verify_integrity)
+        self._em = ElasticManager(
+            self.ckpt_dir, timeout=self.watchdog_timeout or 3600.0,
+            save_interval=self.save_interval, save_fn=self._save_step,
+            step_deadline=self.step_deadline, run_deadline=self.run_deadline)
+        self.global_step = 0
+        if self.resume:
+            restored = self._restore()
+            if restored is not None:
+                self.global_step = restored + 1
+
+        def _rollback(bad_step):
+            if self._restore() is None:
+                import warnings
+
+                warnings.warn(
+                    f"paddle_tpu ResilienceCallback: bad step {bad_step} "
+                    "with no restorable checkpoint — parameters NOT rolled "
+                    "back", stacklevel=2)
+
+        def _escalate(step, n):
+            if self.on_escalate is not None:
+                self.on_escalate(step, n)
+            else:
+                self.model.stop_training = True
+
+        self._guard = BadStepGuard(
+            _rollback, max_consecutive=self.max_consecutive_rollbacks,
+            on_escalate=_escalate)
+
+        def _stall(info):
+            if self.on_stall is not None:
+                self.on_stall(info)
+            else:
+                self.model.stop_training = True
+
+        if self.watchdog_timeout is not None:
+            self._em.start_watchdog(on_stall=_stall,
+                                    poll=self.watchdog_poll)
+        # an immediate checkpoint guarantees a rollback target exists
+        # before the first save interval (a NaN on step 0 must have
+        # somewhere finite to roll back TO)
+        self._mngr.save(self.global_step, self._state(), force=True)
+
+    def on_train_batch_end(self, step, logs=None):
+        logs = logs or {}
+        loss = logs.get("loss")
+        if isinstance(loss, (list, tuple)):
+            loss = loss[0] if loss else None
+        good = True
+        if loss is not None:
+            good = self._guard.check(self.global_step, loss)
+        if good:
+            self._em.tick(self.global_step)
+        self.global_step += 1
+
+    def on_train_end(self, logs=None):
+        if self._em is not None:
+            self._em.stop()
+        if self._mngr is not None:
+            # final checkpoint so a follow-up fit resumes at the end
+            self._mngr.save(self.global_step, self._state(), force=True)
+            self._mngr.close()
 
 
 def config_callbacks(callbacks=None, model=None, batch_size=None, epochs=None,

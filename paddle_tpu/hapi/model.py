@@ -362,6 +362,11 @@ class Model:
                 it += 1
                 if num_iters is not None and it >= num_iters:
                     self.stop_training = True
+                if self.stop_training:
+                    # honored PER BATCH, not just at epoch boundaries: a
+                    # callback stopping mid-epoch (ResilienceCallback
+                    # escalation/stall) must not grind through the rest
+                    # of a long or streaming epoch
                     break
             sch = self._optimizer._learning_rate
             if hasattr(sch, "step") and not isinstance(sch, float) and \

@@ -1,4 +1,5 @@
-"""Sharded, async checkpointing (orbax-backed).
+"""Sharded, async checkpointing (orbax-backed) with integrity
+verification, bounded retry, and restore fallback.
 
 Reference capability: python/paddle/distributed/fleet/utils/fs.py +
 fleet checkpoint saving and paddle.save on sharded state
@@ -9,20 +10,55 @@ host; `async_save` overlaps serialization with the next train steps.
 Restore takes an abstract target (jax.eval_shape-style) carrying
 NamedShardings, so arrays come back resident on the right devices.
 
+Resilience contract (runtime/resilience.py):
+
+* `save`/`restore` wrap their orbax calls in bounded retry with
+  exponential backoff + jitter on transient I/O errors (`save_retries`
+  / `restore_retries` fault events).
+* A failed save — sync after retries, or an async save whose error
+  surfaces later in `wait()` — degrades to a warning + `save_failures`
+  fault event and returns False. It never kills training: the previous
+  complete checkpoint is still on disk, which is the whole point of
+  taking checkpoints.
+* At commit, a per-leaf checksum manifest (`integrity.json`, crc32 +
+  shape + dtype per leaf path) is written atomically into the step
+  directory. Async saves get their manifest flushed as soon as the
+  step directory is committed (next save / wait / latest_step /
+  close) — a process killed mid-async-save leaves only an orbax tmp
+  dir, which every reader here ignores.
+* `restore` verifies restored leaves against the manifest and, on
+  corruption (checksum mismatch OR an unreadable/torn shard), falls
+  back to the previous complete step automatically (`restore_fallbacks`
+  fault event), raising only when no complete step survives.
+
 Layout matches distributed/elastic.py's `latest_checkpoint`: one numbered
-subdirectory per step under the root.
+subdirectory per step under the root — and both sides now share ONE
+definition of "complete" (`latest_complete_step`): a bare-digit
+directory (orbax commits by atomic rename), which excludes in-flight
+`<step>.orbax-checkpoint-tmp-*` dirs by construction.
 """
 from __future__ import annotations
 
+import json
 import os
+import warnings
+import zlib
 
 import jax
 import numpy as np
 
 from ..core.tensor import Tensor
+from ..runtime.resilience import (
+    IntegrityError, fault_point, record_fault, retry_with_backoff,
+    atomic_write_json,
+)
 
 __all__ = ["CheckpointManager", "save_checkpoint", "load_checkpoint",
-           "abstract_state"]
+           "abstract_state", "leaf_checksums", "verify_checksums",
+           "complete_steps", "latest_complete_step", "IntegrityError",
+           "INTEGRITY_BASENAME"]
+
+INTEGRITY_BASENAME = "integrity.json"
 
 
 def _unwrap(tree):
@@ -51,8 +87,81 @@ def abstract_state(tree, mesh=None, spec_fn=None):
                                   is_leaf=lambda x: isinstance(x, Tensor))
 
 
+# ---------------------------------------------------------------------------
+# one shared definition of "complete step" (elastic resume + retention
+# + restore fallback all read this — they can never disagree again)
+
+def complete_steps(directory):
+    """Sorted complete (committed) checkpoint steps under `directory`.
+
+    Matches orbax's own commit semantics: a step is committed by
+    atomically renaming `<step>.orbax-checkpoint-tmp-<ts>` to
+    `<step>`, so a BARE-DIGIT directory is durably complete and an
+    in-flight/torn save never parses as one (its name carries the tmp
+    suffix). The old elastic scan keyed on a hand-rolled `.incomplete`
+    marker that orbax never writes — a torn async save looked complete
+    to resume while retention/restore disagreed."""
+    if not os.path.isdir(directory):
+        return []
+    return sorted(int(name) for name in os.listdir(directory)
+                  if name.isdigit()
+                  and os.path.isdir(os.path.join(directory, name)))
+
+
+def latest_complete_step(directory):
+    """Newest complete checkpoint step under `directory`, or None."""
+    steps = complete_steps(directory)
+    return steps[-1] if steps else None
+
+
+# ---------------------------------------------------------------------------
+# per-leaf integrity manifest
+
+def _leaf_items(tree):
+    """[(path_str, np_array)] over array-like leaves, orbax-key style."""
+    leaves, _ = jax.tree_util.tree_flatten_with_path(
+        tree, is_leaf=lambda x: isinstance(x, Tensor))
+    out = []
+    for path, leaf in leaves:
+        if isinstance(leaf, Tensor):
+            leaf = leaf._value
+        if leaf is None:
+            continue
+        out.append((jax.tree_util.keystr(path),
+                    np.ascontiguousarray(np.asarray(leaf))))
+    return out
+
+
+def leaf_checksums(tree):
+    """{leaf path -> {crc32, shape, dtype}} over the LOGICAL value of
+    each array leaf (sharded arrays checksum their full contents, so a
+    restore onto a different sharding still verifies)."""
+    out = {}
+    for path, arr in _leaf_items(tree):
+        out[path] = {"crc32": zlib.crc32(arr.tobytes()),
+                     "shape": list(arr.shape), "dtype": str(arr.dtype)}
+    return out
+
+
+def verify_checksums(tree, manifest):
+    """Leaf paths present in BOTH `tree` and `manifest` whose checksum,
+    shape or dtype disagree (empty list = verified). Paths only on one
+    side are skipped — partial restores verify their intersection."""
+    bad = []
+    for path, arr in _leaf_items(tree):
+        want = manifest.get(path)
+        if want is None:
+            continue
+        if (list(arr.shape) != list(want["shape"])
+                or str(arr.dtype) != want["dtype"]
+                or zlib.crc32(arr.tobytes()) != want["crc32"]):
+            bad.append(path)
+    return bad
+
+
 class CheckpointManager:
-    """Step-numbered async sharded checkpoints with retention.
+    """Step-numbered async sharded checkpoints with retention, retry,
+    integrity manifests, and restore fallback.
 
     Usage:
         mngr = CheckpointManager(dir, max_to_keep=3)
@@ -61,11 +170,14 @@ class CheckpointManager:
     """
 
     def __init__(self, directory, max_to_keep=5, async_save=True,
-                 save_interval_steps=1):
+                 save_interval_steps=1, verify_integrity=True,
+                 retry_attempts=4):
         import orbax.checkpoint as ocp
 
         self._ocp = ocp
         self.directory = os.path.abspath(directory)
+        self.verify_integrity = bool(verify_integrity)
+        self.retry_attempts = max(1, int(retry_attempts))
         os.makedirs(self.directory, exist_ok=True)
         self._mngr = ocp.CheckpointManager(
             self.directory,
@@ -74,38 +186,197 @@ class CheckpointManager:
                 save_interval_steps=save_interval_steps,
                 enable_async_checkpointing=async_save,
             ))
+        # step -> checksum manifest, computed at save() time and written
+        # into the step dir as soon as orbax commits it (async saves
+        # commit after save() returns)
+        self._pending_manifests = {}
+        self.last_restored_step = None
 
+    # -- integrity manifests -----------------------------------------------
+    def _step_dir(self, step):
+        return os.path.join(self.directory, str(int(step)))
+
+    def _manifest_path(self, step):
+        return os.path.join(self._step_dir(step), INTEGRITY_BASENAME)
+
+    def _flush_manifests(self):
+        """Write pending checksum manifests for every step orbax has
+        committed since; drop entries for steps that died (tmp dir of a
+        killed save) or were pruned by retention."""
+        if not self._pending_manifests:
+            return
+        committed = set(complete_steps(self.directory))
+        for step in list(self._pending_manifests):
+            if step in committed:
+                manifest = self._pending_manifests.pop(step)
+                try:
+                    fault_point("checkpoint.manifest_write", step=step,
+                                path=self._step_dir(step))
+                    atomic_write_json(self._manifest_path(step),
+                                      {"version": 1, "leaves": manifest})
+                except OSError as e:
+                    # manifest is advisory: restore treats a missing one
+                    # as complete-but-unverified rather than incomplete
+                    record_fault("save_failures",
+                                 f"manifest write step {step}: {e}")
+                    warnings.warn(
+                        f"paddle_tpu checkpoint: could not write integrity "
+                        f"manifest for step {step}: {e}", stacklevel=3)
+            elif not os.path.exists(self._step_dir(step)) and not any(
+                    n.startswith(f"{step}.") for n in (
+                        os.listdir(self.directory)
+                        if os.path.isdir(self.directory) else [])):
+                self._pending_manifests.pop(step, None)
+
+    def _read_manifest(self, step):
+        try:
+            with open(self._manifest_path(step)) as f:
+                data = json.load(f)
+        except (OSError, ValueError):
+            return None
+        return data.get("leaves") if isinstance(data, dict) else None
+
+    # -- save ---------------------------------------------------------------
     def save(self, step, state, force=False):
-        """Queue an async sharded save of `state` (pytree of Tensors/arrays).
-        Returns True if the save was accepted (interval/retention policy)."""
-        return self._mngr.save(
-            int(step), args=self._ocp.args.StandardSave(_unwrap(state)),
-            force=force)
+        """Queue an async sharded save of `state` (pytree of Tensors/
+        arrays). Transient I/O errors retry with backoff; a save that
+        still fails (or an earlier async save whose error surfaces now)
+        degrades to a warning + `save_failures` fault event and returns
+        False — it never raises into the training loop."""
+        step = int(step)
+        state = _unwrap(state)
+        self._flush_manifests()
+        manifest = leaf_checksums(state) if self.verify_integrity else None
 
-    def restore(self, step=None, target=None):
-        """Restore `step` (newest if None). With `target` (from
-        abstract_state), leaves restore sharded onto their devices."""
-        if step is None:
-            step = self.latest_step()
-        if step is None:
+        def _do_save():
+            fault_point("checkpoint.save", step=step,
+                        directory=self.directory)
+            return self._mngr.save(
+                step, args=self._ocp.args.StandardSave(state), force=force)
+
+        try:
+            accepted = retry_with_backoff(
+                _do_save, attempts=self.retry_attempts,
+                retry_on=(OSError,), counter="save_retries",
+                describe=f"checkpoint save step {step}")
+        except Exception as e:  # noqa: BLE001 — degrade, never kill training
+            record_fault("save_failures",
+                         f"step {step}: {type(e).__name__}: {e}")
+            warnings.warn(
+                f"paddle_tpu checkpoint: save of step {step} failed after "
+                f"{self.retry_attempts} attempts ({type(e).__name__}: {e}) "
+                "— training continues from the previous checkpoint",
+                stacklevel=2)
+            return False
+        if accepted and manifest is not None:
+            self._pending_manifests[step] = manifest
+        # the kill-mid-async-save injection site: at this point the save
+        # is queued/in-flight but (for async managers) not yet committed
+        fault_point("checkpoint.async_started", step=step,
+                    directory=self.directory)
+        return accepted
+
+    # -- restore ------------------------------------------------------------
+    def restore(self, step=None, target=None, strict=False):
+        """Restore `step` (newest complete if None). With `target` (from
+        abstract_state), leaves restore sharded onto their devices.
+
+        Integrity: if the step carries a checksum manifest, restored
+        leaves are verified against it. On verification failure or an
+        unreadable step, restore falls back to the previous complete
+        step (fault event `restore_fallbacks`) unless `strict=True`.
+        Raises FileNotFoundError when no complete step restores."""
+        self.wait()  # surface async errors + flush manifests first
+        steps = complete_steps(self.directory)
+        if step is not None:
+            steps = [s for s in steps if s <= int(step)]
+            if not steps or steps[-1] != int(step):
+                raise FileNotFoundError(
+                    f"no complete checkpoint for step {step} under "
+                    f"{self.directory}")
+        if not steps:
             raise FileNotFoundError(
                 f"no complete checkpoint under {self.directory}")
-        args = (self._ocp.args.StandardRestore(target)
-                if target is not None else None)
-        return self._mngr.restore(int(step), args=args)
+        # explicit StandardRestore even with no target: a manager that
+        # never saved in this process has no handler registry to infer
+        # the item type from (target=None restores as saved, host np)
+        args = self._ocp.args.StandardRestore(target)
+        first_error = None
+        for s in reversed(steps):
+            try:
+                restored = retry_with_backoff(
+                    lambda s=s: self._restore_once(s, args),
+                    attempts=self.retry_attempts,
+                    retry_on=(OSError, TimeoutError),
+                    counter="restore_retries",
+                    describe=f"checkpoint restore step {s}")
+                self.last_restored_step = s
+                return restored
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except Exception as e:  # noqa: BLE001 — corrupt/torn step
+                if first_error is None:
+                    first_error = e
+                if strict:
+                    raise
+                record_fault("restore_fallbacks",
+                             f"step {s}: {type(e).__name__}: {e}")
+                warnings.warn(
+                    f"paddle_tpu checkpoint: restore of step {s} failed "
+                    f"({type(e).__name__}: {e}) — falling back to the "
+                    "previous complete step", stacklevel=2)
+        raise FileNotFoundError(
+            f"no restorable checkpoint under {self.directory} "
+            f"(first failure: {first_error})")
 
+    def _restore_once(self, step, args):
+        fault_point("checkpoint.restore", step=step,
+                    directory=self.directory)
+        restored = self._mngr.restore(int(step), args=args)
+        if self.verify_integrity:
+            manifest = self._read_manifest(step)
+            if manifest:
+                bad = verify_checksums(restored, manifest)
+                if bad:
+                    raise IntegrityError(
+                        f"step {step}: checksum mismatch on "
+                        f"{len(bad)} leaves ({', '.join(bad[:3])}"
+                        f"{', ...' if len(bad) > 3 else ''})")
+        return restored
+
+    # -- introspection ------------------------------------------------------
     def latest_step(self):
-        return self._mngr.latest_step()
+        """Newest COMPLETE step (tmp-dir aware; shared with elastic)."""
+        self._flush_manifests()
+        return latest_complete_step(self.directory)
 
     def all_steps(self):
         return sorted(self._mngr.all_steps())
 
     def wait(self):
-        """Block until queued async saves are durable on disk."""
-        self._mngr.wait_until_finished()
+        """Block until queued async saves are durable on disk. An async
+        save that failed surfaces here: warning + fault event, not an
+        exception (the run survives; the previous checkpoint stands)."""
+        try:
+            self._mngr.wait_until_finished()
+        except Exception as e:  # noqa: BLE001 — degrade, never kill training
+            record_fault("save_failures",
+                         f"async save: {type(e).__name__}: {e}")
+            warnings.warn(
+                f"paddle_tpu checkpoint: async save failed "
+                f"({type(e).__name__}: {e}) — training continues from the "
+                "previous checkpoint", stacklevel=2)
+        self._flush_manifests()
 
     def close(self):
-        self._mngr.close()
+        self.wait()
+        try:
+            self._mngr.close()
+        except Exception as e:  # noqa: BLE001 — close surfaces async errors
+            record_fault("save_failures",
+                         f"close: {type(e).__name__}: {e}")
+            warnings.warn(f"paddle_tpu checkpoint: close failed "
+                          f"({type(e).__name__}: {e})", stacklevel=2)
 
     def __enter__(self):
         return self
@@ -114,15 +385,17 @@ class CheckpointManager:
         self.close()
 
 
-def save_checkpoint(directory, step, state, async_save=False):
+def save_checkpoint(directory, step, state, async_save=False,
+                    verify_integrity=True):
     """One-shot sharded save of `state` at `step` under `directory`."""
     with CheckpointManager(directory, max_to_keep=None,
-                           async_save=async_save) as m:
+                           async_save=async_save,
+                           verify_integrity=verify_integrity) as m:
         m.save(step, state, force=True)
         m.wait()
 
 
-def load_checkpoint(directory, step=None, target=None):
-    """One-shot restore (newest step if None)."""
+def load_checkpoint(directory, step=None, target=None, strict=False):
+    """One-shot restore (newest complete step if None)."""
     with CheckpointManager(directory) as m:
-        return m.restore(step, target=target)
+        return m.restore(step, target=target, strict=strict)

@@ -19,10 +19,12 @@ import warnings
 import jax
 
 from ..core.dispatch import dispatch_stats, reset_dispatch_stats
+from ..runtime.resilience import fault_events, fault_log, reset_fault_events
 
 __all__ = ["Profiler", "ProfilerTarget", "ProfilerState", "RecordEvent",
            "make_scheduler", "export_chrome_tracing", "load_profiler_result",
-           "SummaryView", "dispatch_stats", "reset_dispatch_stats"]
+           "SummaryView", "dispatch_stats", "reset_dispatch_stats",
+           "fault_events", "fault_log", "reset_fault_events"]
 
 
 class ProfilerTarget(enum.Enum):
@@ -227,6 +229,13 @@ class Profiler:
                   f"({uj['manifest_preloaded']} manifest-preloaded, "
                   f"{uj['runtime_learned']} runtime-learned, "
                   f"{uj['decorated']} decorated)")
+        fe = {k: v for k, v in ds.get("fault_events", {}).items() if v}
+        if fe:
+            # degradation is observable, not silent: any recovery path
+            # that fired this run (save retry, restore fallback, rollback,
+            # stall, eager demotion) shows up here
+            print("fault events: "
+                  + ", ".join(f"{k}: {v}" for k, v in sorted(fe.items())))
         if self._dir:
             print(f"trace artifacts: {self._dir}")
 

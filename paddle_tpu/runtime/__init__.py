@@ -1,0 +1,10 @@
+"""paddle_tpu.runtime — host-side runtime services around the compute
+path: staging buffers (`staging`), HBM stats (`memory`), and the
+fault-tolerance substrate (`resilience`).
+
+Only `resilience` is imported eagerly (stdlib+numpy, cheap, and
+`core.dispatch` depends on it); `memory`/`staging` stay import-on-use.
+"""
+from . import resilience  # noqa: F401
+
+__all__ = ["resilience", "memory", "staging"]

@@ -1,0 +1,467 @@
+"""Fault-tolerant training runtime: fault events, retry/backoff, fault
+injection, and the bad-step guard.
+
+A long TPU run dies from exactly the failures the happy path never
+exercises: a transient I/O error during a checkpoint write, a `kill -9`
+mid-async-save, a corrupted shard on restore, a step loop that hangs
+before the first heartbeat, a NaN loss that poisons the parameters.
+This module is the shared substrate the rest of the stack hardens
+itself with:
+
+* **Fault-event registry** — `record_fault(kind)` / `fault_events()`:
+  cheap, thread-safe counters (save_retries, restore_fallbacks,
+  rollbacks, stall_detections, eager_demotions, ...) plus a bounded log
+  of recent events. Degradation must be *observable*: every recovery
+  path in io/checkpoint.py, distributed/elastic.py and core/dispatch.py
+  bumps a counter here, and `dispatch_stats()` / `profiler.summary`
+  surface the snapshot.
+* **`retry_with_backoff`** — bounded retry with exponential backoff and
+  full jitter for transient I/O errors. Checkpoint save/restore wrap
+  their orbax calls in it.
+* **`FaultInjector` / `fault_point`** — deterministic fault injection.
+  Library code calls `fault_point("site")` at instrumented sites; an
+  active injector (context manager, or env `PADDLE_TPU_FAULT_INJECT`
+  for child processes) decides to raise on the nth call, raise
+  transiently then succeed, SIGKILL the process, delay, or corrupt a
+  file. This is how the crash-consistency suite makes "kill mid
+  async save" and "transient IOError then succeed" reproducible.
+* **`BadStepGuard`** — non-finite loss/grad sentinel: on a bad step it
+  rolls state back via the caller's `rollback_fn` and, after N
+  *consecutive* rollbacks, escalates (callback or `EscalationError`).
+
+Everything here is host-side control plane: stdlib + numpy only, no
+jax import, so `core.dispatch` can depend on it without a cycle.  None
+of these functions may ever run under a trace — the wall-clock and
+randomness they use (backoff sleeps, jitter) is exactly what tracelint
+TL004 forbids in op bodies, which is why the elastic watchdog helpers
+that ARE reachable from instrumented modules carry `@non_jittable` +
+reviewed waivers instead of silently relying on never being dispatched.
+"""
+from __future__ import annotations
+
+import collections
+import json
+import os
+import random
+import signal
+import threading
+import time
+import warnings
+
+import numpy as np
+
+__all__ = [
+    "fault_events", "fault_log", "record_fault", "reset_fault_events",
+    "retry_with_backoff", "FaultInjector", "fault_point", "InjectedFault",
+    "BadStepGuard", "EscalationError", "IntegrityError", "corrupt_file",
+    "all_finite",
+]
+
+
+# ---------------------------------------------------------------------------
+# fault-event registry
+
+# known counters, pre-zeroed so fault_events() always reports the full
+# vocabulary (an absent key would read as "this path can't happen")
+_EVENT_KINDS = (
+    "save_retries",           # transient save I/O error, retried
+    "save_failures",          # save gave up / async save surfaced an error
+    "restore_retries",        # transient restore I/O error, retried
+    "restore_fallbacks",      # a step failed verify/load; fell back to prior
+    "rollbacks",              # BadStepGuard rolled state back
+    "escalations",            # N consecutive rollbacks
+    "stall_detections",       # watchdog fired (incl. missing 1st heartbeat)
+    "watchdog_errors",        # watchdog loop survived its own exception
+    "heartbeat_regressions",  # tick() called with a step older than recorded
+    "eager_demotions",        # dispatch learned an op non-jittable at runtime
+    "injected_faults",        # FaultInjector fired (test observability)
+)
+
+_events_lock = threading.Lock()
+_events = {k: 0 for k in _EVENT_KINDS}
+_event_log = collections.deque(maxlen=256)
+
+
+def record_fault(kind, detail=None):
+    """Count one fault event; returns the new count for `kind`."""
+    with _events_lock:
+        n = _events.get(kind, 0) + 1
+        _events[kind] = n
+        _event_log.append((time.time(), kind, detail))
+    return n
+
+
+def fault_events():
+    """Snapshot of all fault counters (always the full key vocabulary)."""
+    with _events_lock:
+        out = {k: 0 for k in _EVENT_KINDS}
+        out.update(_events)
+        return out
+
+
+def fault_log(last=20):
+    """Most recent (unix_time, kind, detail) events, oldest first."""
+    with _events_lock:
+        return list(_event_log)[-last:]
+
+
+def reset_fault_events():
+    with _events_lock:
+        _events.clear()
+        _events.update({k: 0 for k in _EVENT_KINDS})
+        _event_log.clear()
+
+
+# ---------------------------------------------------------------------------
+# retry with exponential backoff + jitter
+
+def retry_with_backoff(fn, *, attempts=4, base_delay=0.05, max_delay=2.0,
+                       jitter=1.0, retry_on=(OSError,), counter=None,
+                       describe="operation", on_retry=None):
+    """Run `fn()`, retrying on `retry_on` with exponential backoff.
+
+    Delay before attempt k (k>=1) is uniform(0, min(max_delay,
+    base_delay * 2**(k-1)) * jitter_share) + deterministic share — i.e.
+    "equal jitter": half the backoff is fixed, half randomized, so
+    concurrent retriers decorrelate without ever retrying immediately.
+    `counter` names the fault-event bumped per retry; the final failure
+    re-raises the last exception (callers decide whether that degrades
+    or propagates).
+    """
+    attempts = max(1, int(attempts))
+    last = None
+    for attempt in range(attempts):
+        try:
+            return fn()
+        except retry_on as e:  # noqa: PERF203 — retry loop by design
+            last = e
+            if attempt == attempts - 1:
+                raise
+            if counter:
+                record_fault(counter, f"{describe}: {type(e).__name__}: {e}")
+            if on_retry is not None:
+                on_retry(attempt + 1, e)
+            cap = min(max_delay, base_delay * (2.0 ** attempt))
+            half = cap / 2.0
+            time.sleep(half + random.uniform(0.0, half) * jitter)
+    raise last  # pragma: no cover — loop always returns or raises
+
+
+# ---------------------------------------------------------------------------
+# fault injection
+
+class InjectedFault(IOError):
+    """Raised by the injector at a fault point (an IOError so the
+    production retry paths treat it exactly like a real transient)."""
+
+
+class _FaultSpec:
+    """One site's behavior.
+
+    kind:
+      raise      raise `exc` on the nth call (and every later call while
+                 `count` calls remain; count=0 means every call)
+      transient  raise `exc` for the first `count` calls, then succeed
+      kill       SIGKILL the process on the nth call (kill -9 semantics:
+                 no atexit, no finally — the crash-consistency hammer)
+      delay      sleep `seconds` on every call from the nth on
+      corrupt    corrupt the file/dir named by the fault point's `path`
+                 payload (or `self.path`) on the nth call
+    """
+
+    def __init__(self, kind, nth=1, count=0, exc=InjectedFault,
+                 seconds=0.05, path=None):
+        self.kind = kind
+        self.nth = max(1, int(nth))
+        self.count = int(count)
+        self.exc = exc
+        self.seconds = float(seconds)
+        self.path = path
+        self.calls = 0
+        self.fired = 0
+
+
+class FaultInjector:
+    """Deterministic fault injection, context-manager or env driven.
+
+        with FaultInjector({"checkpoint.save": ("transient", 2)}):
+            mngr.save(step, state)      # first 2 writes raise, 3rd lands
+
+    Spec values are either a `_FaultSpec`, a dict of its kwargs, or a
+    tuple `(kind, arg)` where arg is `count` for transient/raise,
+    `seconds` for delay, and `nth` otherwise.
+
+    Child processes (the `kill -9` crash tests) can't inherit a Python
+    context manager, so the env var ``PADDLE_TPU_FAULT_INJECT`` carries
+    the same specs: ``site=kind[:arg][;site=kind[:arg]...]`` — e.g.
+    ``checkpoint.async_started=kill:1``.  The env injector is parsed
+    lazily on the first fault_point() call.
+    """
+
+    _stack = []
+    _stack_lock = threading.Lock()
+    _env_injector = None
+
+    def __init__(self, specs):
+        self.specs = {site: self._coerce(spec)
+                      for site, spec in (specs or {}).items()}
+
+    @staticmethod
+    def _coerce(spec):
+        if isinstance(spec, _FaultSpec):
+            return spec
+        if isinstance(spec, dict):
+            return _FaultSpec(**spec)
+        kind, *rest = spec if isinstance(spec, (tuple, list)) else (spec,)
+        arg = rest[0] if rest else None
+        if kind == "transient":
+            return _FaultSpec(kind, count=int(arg or 1))
+        if kind == "raise":
+            return _FaultSpec(kind, nth=1, count=int(arg or 0))
+        if kind == "delay":
+            return _FaultSpec(kind, seconds=float(arg or 0.05))
+        if kind in ("kill", "corrupt"):
+            return _FaultSpec(kind, nth=int(arg or 1))
+        raise ValueError(f"unknown fault kind {kind!r}")
+
+    # -- activation ---------------------------------------------------------
+    def __enter__(self):
+        with self._stack_lock:
+            FaultInjector._stack.append(self)
+        return self
+
+    def __exit__(self, *exc):
+        with self._stack_lock:
+            FaultInjector._stack.remove(self)
+        return False
+
+    @classmethod
+    def _active(cls):
+        inj = list(cls._stack)
+        env = cls._from_env()
+        if env is not None:
+            inj.append(env)
+        return inj
+
+    @classmethod
+    def _from_env(cls):
+        raw = os.environ.get("PADDLE_TPU_FAULT_INJECT", "")
+        if not raw:
+            cls._env_injector = None
+            return None
+        if cls._env_injector is not None and \
+                cls._env_injector._env_raw == raw:
+            return cls._env_injector
+        specs = {}
+        for part in raw.split(";"):
+            part = part.strip()
+            if not part or "=" not in part:
+                continue
+            site, _, rhs = part.partition("=")
+            kind, *args = rhs.split(":")
+            specs[site.strip()] = tuple([kind.strip()] + args)
+        env = cls(specs)
+        env._env_raw = raw
+        cls._env_injector = env
+        return env
+
+    # -- firing -------------------------------------------------------------
+    def fires(self, site):
+        return site in self.specs
+
+    def fire(self, site, info):
+        spec = self.specs.get(site)
+        if spec is None:
+            return
+        spec.calls += 1
+        k = spec.kind
+        if k == "transient":
+            if spec.calls <= spec.count:
+                spec.fired += 1
+                record_fault("injected_faults", f"{site}:transient")
+                raise spec.exc(f"injected transient fault at {site} "
+                               f"(call {spec.calls}/{spec.count})")
+            return
+        if spec.calls < spec.nth:
+            return
+        if k == "raise":
+            if spec.count and spec.calls >= spec.nth + spec.count:
+                return
+            spec.fired += 1
+            record_fault("injected_faults", f"{site}:raise")
+            raise spec.exc(f"injected fault at {site} (call {spec.calls})")
+        if k == "kill":
+            if spec.calls != spec.nth:
+                return
+            record_fault("injected_faults", f"{site}:kill")
+            os.kill(os.getpid(), signal.SIGKILL)  # no return
+        if k == "delay":
+            spec.fired += 1
+            record_fault("injected_faults", f"{site}:delay")
+            time.sleep(spec.seconds)
+        if k == "corrupt":
+            if spec.calls != spec.nth:
+                return
+            path = info.get("path") or spec.path
+            if path:
+                spec.fired += 1
+                record_fault("injected_faults", f"{site}:corrupt")
+                corrupt_file(path)
+
+
+def fault_point(site, **info):
+    """Instrumentation hook: a no-op unless a FaultInjector (context
+    manager or env) has a spec for `site`. Keep these on failure-path
+    code only — the check is one dict lookup per active injector."""
+    for inj in FaultInjector._active():
+        inj.fire(site, info)
+
+
+def corrupt_file(path, magnitude=64):
+    """Scribble over the middle of `path` (a file, or the largest file
+    under a directory) — the deterministic stand-in for a torn write or
+    bit rot. Returns the file actually corrupted."""
+    target = path
+    if os.path.isdir(path):
+        best, best_size = None, -1
+        for dirpath, _, filenames in os.walk(path):
+            for fn in filenames:
+                p = os.path.join(dirpath, fn)
+                try:
+                    size = os.path.getsize(p)
+                except OSError:
+                    continue
+                if size > best_size:
+                    best, best_size = p, size
+        if best is None:
+            raise FileNotFoundError(f"no file to corrupt under {path}")
+        target = best
+    size = os.path.getsize(target)
+    with open(target, "r+b") as f:
+        f.seek(max(0, size // 2 - magnitude // 2))
+        f.write(b"\xde\xad\xbe\xef" * max(1, magnitude // 4))
+    return target
+
+
+# ---------------------------------------------------------------------------
+# bad-step guard
+
+class EscalationError(RuntimeError):
+    """N consecutive bad steps: rollback alone is not converging."""
+
+
+class IntegrityError(RuntimeError):
+    """A restored checkpoint failed checksum verification."""
+
+
+def _iter_leaves(tree):
+    if isinstance(tree, dict):
+        for v in tree.values():
+            yield from _iter_leaves(v)
+    elif isinstance(tree, (list, tuple)):
+        for v in tree:
+            yield from _iter_leaves(v)
+    elif tree is not None:
+        yield tree
+
+
+def all_finite(tree):
+    """True iff every numeric leaf of `tree` (nested dict/list/tuple of
+    scalars / numpy / jax arrays) is finite. Non-numeric leaves are
+    ignored. This is a host-side check: jax leaves sync to host."""
+    for leaf in _iter_leaves(tree):
+        try:
+            arr = np.asarray(leaf)
+        except Exception:  # noqa: BLE001 — non-numeric leaf
+            continue
+        if arr.dtype.kind not in "fc":
+            continue
+        if not np.isfinite(arr).all():
+            return False
+    return True
+
+
+class BadStepGuard:
+    """Non-finite loss/grad sentinel with rollback and escalation.
+
+        guard = BadStepGuard(rollback_fn=restore_last_ckpt)
+        for step in ...:
+            loss = train_step(...)
+            if not guard.check(step, loss):
+                continue            # state rolled back; skip this step
+            em.tick(step)
+
+    `check` returns True for a good step. On a bad one it records a
+    `rollbacks` fault event, invokes `rollback_fn(step)` and returns
+    False; after `max_consecutive` bad steps in a row it records an
+    `escalations` event and calls `on_escalate(step, n)` — or raises
+    EscalationError when no callback is given (an unbounded
+    rollback/NaN loop must not spin forever silently).
+    """
+
+    def __init__(self, rollback_fn, max_consecutive=3, on_escalate=None,
+                 check_grads=True):
+        self.rollback_fn = rollback_fn
+        self.max_consecutive = max(1, int(max_consecutive))
+        self.on_escalate = on_escalate
+        self.check_grads = check_grads
+        self.consecutive = 0
+        self.total_rollbacks = 0
+        self.last_bad_step = None
+
+    def is_bad(self, loss=None, grads=None):
+        if loss is not None and not all_finite(loss):
+            return "non-finite loss"
+        if self.check_grads and grads is not None and not all_finite(grads):
+            return "non-finite grad"
+        return None
+
+    def check(self, step, loss=None, grads=None):
+        why = self.is_bad(loss, grads)
+        if why is None:
+            self.consecutive = 0
+            return True
+        self.consecutive += 1
+        self.total_rollbacks += 1
+        self.last_bad_step = step
+        record_fault("rollbacks", f"step {step}: {why}")
+        warnings.warn(
+            f"paddle_tpu resilience: {why} at step {step} — rolling back "
+            f"to the last good checkpoint and skipping forward "
+            f"({self.consecutive} consecutive)", stacklevel=2)
+        if self.rollback_fn is not None:
+            self.rollback_fn(step)
+        if self.consecutive >= self.max_consecutive:
+            record_fault("escalations",
+                         f"step {step}: {self.consecutive} consecutive")
+            if self.on_escalate is not None:
+                self.on_escalate(step, self.consecutive)
+            else:
+                raise EscalationError(
+                    f"{self.consecutive} consecutive bad steps ending at "
+                    f"step {step} ({why}); rollback is not converging")
+        return False
+
+
+# ---------------------------------------------------------------------------
+# small shared util: atomic json write (heartbeats, integrity manifests)
+
+def atomic_write_json(path, payload, fsync=True):
+    """Write JSON then rename, so readers never observe a torn file
+    (the same contract orbax gives step directories). `fsync=True`
+    makes it durable too (integrity manifests); heartbeats skip the
+    fsync — freshness, not durability, is their contract."""
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, "w") as f:
+            json.dump(payload, f)
+            if fsync:
+                f.flush()
+                os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise

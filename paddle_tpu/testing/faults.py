@@ -1,0 +1,58 @@
+"""Fault-injection helpers for tests (and chaos drills).
+
+Thin test-facing façade over runtime/resilience.py's FaultInjector: the
+injector itself lives in the runtime (production chaos testing drives
+it via ``PADDLE_TPU_FAULT_INJECT`` too); this module adds the bits only
+tests want — env-spec rendering for child processes and checkpoint-
+shard corruption targeting.
+"""
+from __future__ import annotations
+
+import glob
+import os
+
+from ..runtime.resilience import (  # noqa: F401 — re-exported test surface
+    FaultInjector, InjectedFault, corrupt_file, fault_events, fault_log,
+    fault_point, record_fault, reset_fault_events,
+)
+
+__all__ = ["FaultInjector", "InjectedFault", "fault_point", "corrupt_file",
+           "fault_events", "fault_log", "record_fault", "reset_fault_events",
+           "faults_env", "corrupt_shard"]
+
+ENV_VAR = "PADDLE_TPU_FAULT_INJECT"
+
+
+def faults_env(specs, env=None):
+    """Render `{site: "kind[:arg]"}` (or tuple specs) into a copy of
+    `env` (default os.environ) carrying PADDLE_TPU_FAULT_INJECT — the
+    way a subprocess inherits an injection plan it cannot inherit as a
+    Python context manager (the `kill -9` crash-consistency tests)."""
+    parts = []
+    for site, spec in specs.items():
+        if isinstance(spec, (tuple, list)):
+            spec = ":".join(str(s) for s in spec)
+        parts.append(f"{site}={spec}")
+    out = dict(os.environ if env is None else env)
+    out[ENV_VAR] = ";".join(parts)
+    return out
+
+
+def corrupt_shard(ckpt_dir, step):
+    """Corrupt the largest data file inside one checkpoint step dir —
+    the deterministic 'one shard rotted' fixture. Returns the path
+    corrupted. Skips our own integrity manifest so the corruption hits
+    checkpoint DATA (the manifest then convicts it on restore)."""
+    step_dir = os.path.join(ckpt_dir, str(int(step)))
+    if not os.path.isdir(step_dir):
+        raise FileNotFoundError(f"no step dir {step_dir}")
+    best, best_size = None, -1
+    for p in glob.glob(os.path.join(step_dir, "**"), recursive=True):
+        if not os.path.isfile(p) or p.endswith("integrity.json"):
+            continue
+        size = os.path.getsize(p)
+        if size > best_size:
+            best, best_size = p, size
+    if best is None:
+        raise FileNotFoundError(f"no data file under {step_dir}")
+    return corrupt_file(best)

@@ -1,0 +1,43 @@
+"""Child process for the crash-consistency suite (test_resilience.py).
+
+Saves a complete checkpoint at step 0, then starts an ASYNC save of a
+large (incompressible) state at step 1. The parent launches us with
+PADDLE_TPU_FAULT_INJECT=checkpoint.async_started=kill:2 — the injector
+SIGKILLs this process at the step-1 fault point, while orbax's
+background thread is still writing the tmp dir. `kill -9` semantics: no
+atexit, no finally, no orbax cleanup. The parent then asserts the
+directory restores to step 0.
+
+Run without injection env, it prints SURVIVED (used to validate the
+harness itself).
+"""
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+
+import jax.numpy as jnp  # noqa: E402
+
+from paddle_tpu.io.checkpoint import CheckpointManager  # noqa: E402
+
+ckpt_dir = sys.argv[1]
+rng = np.random.RandomState(7)
+
+m = CheckpointManager(ckpt_dir, max_to_keep=None, async_save=True)
+state0 = {"w": jnp.asarray(rng.randn(256, 256).astype(np.float32)),
+          "step": jnp.int32(0)}
+m.save(0, state0, force=True)
+m.wait()
+print("STEP0_COMMITTED", flush=True)
+
+# random f32 is incompressible: the background OCDBT write of ~64MB is
+# still in flight when the injector kills us at the post-queue site
+big = {"w": jnp.asarray(rng.randn(4096, 4096).astype(np.float32)),
+       "step": jnp.int32(1)}
+m.save(1, big, force=True)
+# (unreachable under injection: fault_point("checkpoint.async_started")
+# inside save() fires kill:2 — call #1 was the step-0 save)
+m.wait()
+print("SURVIVED", flush=True)

@@ -1,0 +1,548 @@
+"""Fault-injection suite for the resilient training runtime (ISSUE 3).
+
+Deterministic faults (runtime/resilience.FaultInjector) drive every
+recovery path and the fault-event counters assert each path actually
+fired: transient-IOError-then-succeed on save, kill -9 mid-async-save,
+corrupted shard restore fallback, BadStepGuard rollback on injected
+NaN, watchdog stall on a never-appearing heartbeat, heartbeat
+monotonicity, and the hapi ResilienceCallback end-to-end.
+"""
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.core.dispatch import dispatch_stats
+from paddle_tpu.distributed.elastic import ElasticManager, latest_checkpoint
+from paddle_tpu.io.checkpoint import (
+    CheckpointManager, IntegrityError, complete_steps, latest_complete_step,
+    leaf_checksums, load_checkpoint, save_checkpoint, verify_checksums,
+    INTEGRITY_BASENAME,
+)
+from paddle_tpu.runtime.resilience import (
+    BadStepGuard, EscalationError, FaultInjector, all_finite, corrupt_file,
+    fault_events, fault_point, record_fault, reset_fault_events,
+    retry_with_backoff,
+)
+from paddle_tpu.testing.faults import corrupt_shard, faults_env
+
+
+@pytest.fixture(autouse=True)
+def _fresh_fault_counters():
+    reset_fault_events()
+    yield
+    reset_fault_events()
+
+
+def _state(step=0, seed=0, n=8):
+    rng = np.random.RandomState(seed)
+    return {"w": jnp.asarray(rng.randn(n, n).astype(np.float32)),
+            "step": jnp.int32(step)}
+
+
+# ---------------------------------------------------------------------------
+# retry / backoff / degradation
+
+def test_retry_transient_then_succeed():
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        fault_point("t.flaky")
+        return "ok"
+
+    with FaultInjector({"t.flaky": ("transient", 2)}):
+        assert retry_with_backoff(flaky, base_delay=0.001,
+                                  counter="save_retries") == "ok"
+    assert calls["n"] == 3
+    assert fault_events()["save_retries"] == 2
+    assert fault_events()["injected_faults"] == 2
+
+
+def test_retry_exhaustion_raises():
+    def always():
+        fault_point("t.always")
+
+    with FaultInjector({"t.always": ("raise", 0)}):
+        with pytest.raises(IOError):
+            retry_with_backoff(always, attempts=3, base_delay=0.001,
+                               counter="save_retries")
+    assert fault_events()["save_retries"] == 2  # attempts-1 retries
+
+
+def test_save_transient_io_error_retries_then_lands(tmp_path):
+    d = str(tmp_path / "c")
+    with CheckpointManager(d, async_save=False) as m:
+        with FaultInjector({"checkpoint.save": ("transient", 2)}):
+            assert m.save(0, _state(), force=True)
+        m.wait()
+        assert m.latest_step() == 0
+    assert fault_events()["save_retries"] == 2
+    # the landed checkpoint restores clean
+    r = load_checkpoint(d)
+    np.testing.assert_array_equal(np.asarray(r["w"]),
+                                  np.asarray(_state()["w"]))
+
+
+def test_save_hard_failure_degrades_never_raises(tmp_path):
+    d = str(tmp_path / "c")
+    with CheckpointManager(d, async_save=False, retry_attempts=2) as m:
+        assert m.save(0, _state(0), force=True)
+        with FaultInjector({"checkpoint.save": ("raise", 0)}):
+            with pytest.warns(UserWarning, match="save of step 1 failed"):
+                assert m.save(1, _state(1), force=False) is False
+        # training survived; the previous checkpoint still stands
+        assert m.latest_step() == 0
+    assert fault_events()["save_failures"] == 1
+    assert fault_events()["save_retries"] == 1
+
+
+# ---------------------------------------------------------------------------
+# integrity manifest
+
+def test_manifest_written_at_commit_and_verifies(tmp_path):
+    d = str(tmp_path / "c")
+    state = _state(3)
+    save_checkpoint(d, 3, state)
+    mpath = os.path.join(d, "3", INTEGRITY_BASENAME)
+    assert os.path.exists(mpath)
+    with open(mpath) as f:
+        manifest = json.load(f)["leaves"]
+    assert verify_checksums(state, manifest) == []
+    assert manifest == leaf_checksums(state)
+    # a clean restore passes verification silently
+    r = load_checkpoint(d)
+    assert int(r["step"]) == 3
+    assert fault_events()["restore_fallbacks"] == 0
+
+
+def test_async_manifest_flushes_after_commit(tmp_path):
+    d = str(tmp_path / "c")
+    with CheckpointManager(d, async_save=True) as m:
+        m.save(0, _state(0), force=True)
+        m.wait()
+        assert os.path.exists(os.path.join(d, "0", INTEGRITY_BASENAME))
+
+
+def test_corrupt_shard_restore_falls_back(tmp_path):
+    d = str(tmp_path / "c")
+    with CheckpointManager(d, async_save=False, max_to_keep=None) as m:
+        m.save(0, _state(0, seed=0), force=True)
+        m.save(1, _state(1, seed=1), force=True)
+        m.wait()
+    corrupt_shard(d, 1)
+    with CheckpointManager(d) as m:
+        with pytest.warns(UserWarning, match="falling back"):
+            r = m.restore()
+        assert m.last_restored_step == 0
+    np.testing.assert_array_equal(np.asarray(r["w"]),
+                                  np.asarray(_state(0, seed=0)["w"]))
+    assert fault_events()["restore_fallbacks"] >= 1
+
+
+def test_checksum_mismatch_detected_by_manifest(tmp_path):
+    """Tamper the MANIFEST: orbax reads the data fine, but our
+    verification convicts the step and falls back — the path that
+    catches silent bit rot tensorstore's codec checksums can't see."""
+    d = str(tmp_path / "c")
+    with CheckpointManager(d, async_save=False, max_to_keep=None) as m:
+        m.save(0, _state(0, seed=0), force=True)
+        m.save(1, _state(1, seed=1), force=True)
+        m.wait()
+    mpath = os.path.join(d, "1", INTEGRITY_BASENAME)
+    with open(mpath) as f:
+        manifest = json.load(f)
+    first = next(iter(manifest["leaves"]))
+    manifest["leaves"][first]["crc32"] ^= 0xFFFF
+    with open(mpath, "w") as f:
+        json.dump(manifest, f)
+    with CheckpointManager(d) as m:
+        with pytest.warns(UserWarning, match="IntegrityError"):
+            r = m.restore()
+        assert m.last_restored_step == 0
+    assert int(r["step"]) == 0
+    assert fault_events()["restore_fallbacks"] >= 1
+    # strict mode surfaces the corruption instead of falling back
+    with CheckpointManager(d) as m:
+        with pytest.raises(IntegrityError):
+            m.restore(strict=True)
+
+
+def test_all_steps_corrupt_raises(tmp_path):
+    d = str(tmp_path / "c")
+    save_checkpoint(d, 0, _state(0))
+    corrupt_shard(d, 0)
+    with pytest.warns(UserWarning, match="falling back"):
+        with pytest.raises(FileNotFoundError, match="no restorable"):
+            load_checkpoint(d)
+
+
+# ---------------------------------------------------------------------------
+# latest-step unification (elastic == checkpoint manager, tmp-dir aware)
+
+def test_latest_step_tmp_dir_aware(tmp_path):
+    d = str(tmp_path / "c")
+    for name in ["3", "4", "5.orbax-checkpoint-tmp-123", "junk"]:
+        os.makedirs(os.path.join(d, name))
+    open(os.path.join(d, "9"), "w").close()  # a stray FILE, not a step
+    # orbax commits by atomic rename: bare-digit DIRS are complete; the
+    # in-flight tmp dir for step 5 and non-step entries are not
+    assert complete_steps(d) == [3, 4]
+    assert latest_complete_step(d) == 4
+    assert latest_checkpoint(d) == 4  # elastic delegates: can't disagree
+
+
+def test_elastic_resume_skips_in_flight_tmp_dir(tmp_path):
+    d = str(tmp_path / "e")
+    save_checkpoint(d, 2, _state(2))
+    os.makedirs(os.path.join(d, "3.orbax-checkpoint-tmp-99"))
+    em = ElasticManager(d, timeout=9999)
+    seen = []
+    assert em.resume(seen.append) == 3
+    assert seen == [2]
+
+
+# ---------------------------------------------------------------------------
+# crash consistency: kill -9 mid-async-save
+
+def test_kill9_mid_async_save_restores_prior_step(tmp_path):
+    d = str(tmp_path / "crash")
+    child = os.path.join(os.path.dirname(__file__), "_resilience_child.py")
+    env = faults_env({"checkpoint.async_started": ("kill", 2)})
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = os.pathsep.join(
+        [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))]
+        + ([env["PYTHONPATH"]] if env.get("PYTHONPATH") else []))
+    proc = subprocess.run([sys.executable, child, d], env=env,
+                          capture_output=True, text=True, timeout=300)
+    # SIGKILLed mid-write, after step 0 was durably committed
+    assert proc.returncode == -signal.SIGKILL, (proc.returncode,
+                                                proc.stdout, proc.stderr)
+    assert "STEP0_COMMITTED" in proc.stdout
+    assert "SURVIVED" not in proc.stdout
+    # the torn step-1 write left only an orbax tmp dir (or nothing) —
+    # every reader agrees the directory is at step 0
+    assert latest_complete_step(d) == 0
+    assert latest_checkpoint(d) == 0
+    leftovers = [n for n in os.listdir(d) if n.startswith("1")]
+    assert all("orbax-checkpoint-tmp" in n for n in leftovers), leftovers
+    # and it RESTORES: the prior step comes back bit-exact
+    r = load_checkpoint(d)
+    rng = np.random.RandomState(7)
+    np.testing.assert_array_equal(
+        np.asarray(r["w"]), rng.randn(256, 256).astype(np.float32))
+    assert int(r["step"]) == 0
+
+
+# ---------------------------------------------------------------------------
+# bad-step guard
+
+def test_badstep_guard_rollback_and_continue():
+    snapshots = {"good": 1.0}
+    rolled = []
+
+    guard = BadStepGuard(lambda step: rolled.append(step),
+                         max_consecutive=3)
+    assert guard.check(0, 0.5)
+    assert not guard.check(1, float("nan"))
+    assert rolled == [1]
+    assert guard.check(2, 0.4)  # recovered: consecutive resets
+    assert guard.consecutive == 0
+    assert fault_events()["rollbacks"] == 1
+    assert snapshots["good"] == 1.0
+
+
+def test_badstep_guard_grads_and_arrays():
+    guard = BadStepGuard(lambda step: None, max_consecutive=10)
+    ok = {"a": jnp.ones(3), "b": [np.ones(2)]}
+    bad = {"a": jnp.ones(3), "b": [np.array([1.0, np.inf])]}
+    assert guard.check(0, 0.1, grads=ok)
+    assert not guard.check(1, 0.1, grads=bad)
+    assert all_finite(ok) and not all_finite(bad)
+
+
+def test_badstep_guard_escalates():
+    guard = BadStepGuard(lambda step: None, max_consecutive=2)
+    assert not guard.check(0, float("inf"))
+    with pytest.raises(EscalationError):
+        guard.check(1, float("nan"))
+    assert fault_events()["escalations"] == 1
+    assert fault_events()["rollbacks"] == 2
+
+    hits = []
+    guard = BadStepGuard(lambda step: None, max_consecutive=2,
+                         on_escalate=lambda step, n: hits.append((step, n)))
+    guard.check(0, float("nan"))
+    guard.check(1, float("nan"))
+    assert hits == [(1, 2)]
+
+
+def test_elastic_guard_rolls_back_to_checkpoint(tmp_path):
+    """Manual loop: injected NaN rolls w back to the last complete
+    checkpoint and training resumes to completion."""
+    d = str(tmp_path / "e")
+    m = CheckpointManager(d, async_save=False, max_to_keep=None)
+    em = ElasticManager(d, timeout=9999, save_interval=2,
+                        save_fn=lambda s: m.save(s, {"w": live["w"],
+                                                     "step": jnp.int32(s)},
+                                                 force=True))
+    live = {"w": jnp.zeros((4,), jnp.float32)}
+
+    def restore(step):
+        r = m.restore(step)
+        live["w"] = jnp.asarray(r["w"])
+        return m.last_restored_step
+
+    guard = em.guard(restore)
+    for step in range(8):
+        w = live["w"] + 1.0
+        if step == 5:
+            w = w * jnp.float32(np.nan)  # the injected bad step
+        live["w"] = w
+        if not guard.check(step, float(jnp.sum(w))):
+            continue
+        em.tick(step)
+    m.wait()
+    m.close()
+    # steps 0..4 add 1 each (ckpts at 2,4), step 5 NaN -> rollback to
+    # ckpt@4 (w=5), steps 6,7 add 1 each -> 7
+    assert fault_events()["rollbacks"] == 1
+    np.testing.assert_allclose(np.asarray(live["w"]), 7.0)
+    assert bool(np.isfinite(np.asarray(live["w"])).all())
+
+
+# ---------------------------------------------------------------------------
+# watchdog + heartbeat hardening
+
+def test_watchdog_detects_hang_before_first_heartbeat(tmp_path):
+    em = ElasticManager(str(tmp_path / "wd"), timeout=0.3)
+    stalls = []
+    em.start_watchdog(on_stall=stalls.append, poll=0.05)
+    deadline = time.time() + 5.0
+    while not em.stalled and time.time() < deadline:
+        time.sleep(0.05)
+    em.stop()
+    assert em.stalled
+    assert stalls and stalls[0]["reason"] == "no_heartbeat"
+    assert em.stall_reason == "no_heartbeat"
+    assert fault_events()["stall_detections"] == 1
+
+
+def test_watchdog_survives_bad_heartbeat_and_own_callback(tmp_path):
+    d = str(tmp_path / "wd")
+    em = ElasticManager(d, timeout=0.3)
+    with open(em._hb_path, "w") as f:
+        f.write("{not json")  # torn write: unreadable forever
+
+    def exploding(info):
+        raise RuntimeError("callback bug")
+
+    em.start_watchdog(on_stall=exploding, poll=0.05)
+    deadline = time.time() + 5.0
+    while not em.stalled and time.time() < deadline:
+        time.sleep(0.05)
+    em.stop()
+    assert em.stalled  # unreadable heartbeat still counts as a hang
+    assert fault_events()["stall_detections"] == 1
+    assert fault_events()["watchdog_errors"] >= 1  # callback survived
+
+
+def test_watchdog_step_deadline_distinct_from_timeout(tmp_path):
+    """Heartbeat stays FRESH (ticked continuously) but the step number
+    never advances: only the per-step deadline can see this."""
+    em = ElasticManager(str(tmp_path / "wd"), timeout=60.0,
+                        step_deadline=0.3)
+    stalls = []
+    em.start_watchdog(on_stall=stalls.append, poll=0.05)
+    deadline = time.time() + 5.0
+    while not em.stalled and time.time() < deadline:
+        em.tick(3)  # alive, but wedged at step 3
+        time.sleep(0.05)
+    em.stop()
+    assert em.stalled and em.stall_reason == "step_deadline"
+    assert stalls[0]["step"] == 3
+
+
+def test_watchdog_run_deadline(tmp_path):
+    em = ElasticManager(str(tmp_path / "wd"), timeout=60.0,
+                        run_deadline=0.2)
+    em.tick(0)
+    em.start_watchdog(poll=0.05)
+    deadline = time.time() + 5.0
+    while not em.stalled and time.time() < deadline:
+        time.sleep(0.05)
+    em.stop()
+    assert em.stalled and em.stall_reason == "run_deadline"
+
+
+def test_watchdog_run_deadline_before_first_heartbeat(tmp_path):
+    """run_deadline expiring with NO heartbeat file yet must still
+    deliver on_stall with a dict payload (not crash the watchdog)."""
+    em = ElasticManager(str(tmp_path / "wd"), timeout=60.0,
+                        run_deadline=0.15)
+    stalls = []
+    em.start_watchdog(on_stall=stalls.append, poll=0.05)
+    deadline = time.time() + 5.0
+    while not stalls and time.time() < deadline:
+        time.sleep(0.05)
+    em.stop()
+    assert em.stalled and em.stall_reason == "run_deadline"
+    assert stalls and stalls[0]["reason"] == "run_deadline"
+    assert stalls[0]["step"] is None
+    assert fault_events()["watchdog_errors"] == 0
+
+
+def test_tick_monotonicity_guard(tmp_path):
+    em = ElasticManager(str(tmp_path / "hb"), timeout=9999)
+    assert em.tick(5)
+    with pytest.warns(UserWarning, match="backwards"):
+        assert em.tick(3) is False  # stale step refused
+    with open(em._hb_path) as f:
+        assert json.load(f)["step"] == 5  # progress untouched
+    assert fault_events()["heartbeat_regressions"] == 1
+    assert em.tick(5)  # equal step is a legal re-tick
+    assert em.tick(6)
+
+
+# ---------------------------------------------------------------------------
+# observability: dispatch_stats / profiler surface
+
+def test_fault_events_in_dispatch_stats_and_summary(capsys):
+    record_fault("restore_fallbacks", "test")
+    ds = dispatch_stats()
+    assert ds["fault_events"]["restore_fallbacks"] == 1
+    assert set(ds["fault_events"]) >= {"save_retries", "rollbacks",
+                                       "stall_detections",
+                                       "eager_demotions"}
+    from paddle_tpu.profiler import Profiler
+
+    p = Profiler(timer_only=True)
+    p.start()
+    p.step()
+    p.summary()
+    out = capsys.readouterr().out
+    assert "fault events" in out and "restore_fallbacks: 1" in out
+
+
+def test_runtime_eager_demotion_records_fault_event():
+    import jax
+
+    from paddle_tpu.core import dispatch
+
+    def shape_from_value(x):
+        return x.reshape(int(x.sum()))  # int(traced) -> unjittable
+
+    vals, treedef = jax.tree_util.tree_flatten(((jnp.ones(4),), {}))
+    prev = dispatch.set_warmup_count(1)
+    try:
+        before = fault_events()["eager_demotions"]
+        out = dispatch.run_op(shape_from_value, vals, treedef,
+                              lambda: shape_from_value(jnp.ones(4)))
+        assert np.asarray(out).shape == (4,)
+        assert fault_events()["eager_demotions"] == before + 1
+    finally:
+        dispatch.set_warmup_count(prev)
+
+
+# ---------------------------------------------------------------------------
+# hapi integration: ResilienceCallback
+
+def _nan_fit_setup(tmp_path, nan_batch=2, n=16, batch=4):
+    paddle.seed(0)
+    x = np.random.rand(n, 4).astype(np.float32)
+    w = np.random.rand(4, 1).astype(np.float32)
+    y = (x @ w).astype(np.float32)
+    # poison exactly one batch: its loss (and the fused step's update)
+    # goes NaN, which is what the guard must roll back
+    x[nan_batch * batch] = np.nan
+    net = nn.Linear(4, 1)
+    model = paddle.Model(net)
+    model.prepare(paddle.optimizer.SGD(0.05, parameters=net.parameters()),
+                  nn.MSELoss())
+    return model, net, x, y
+
+
+def test_resilience_callback_nan_rollback_completes_training(tmp_path):
+    from paddle_tpu.hapi.callbacks import ResilienceCallback
+
+    model, net, x, y = _nan_fit_setup(tmp_path)
+    cb = ResilienceCallback(str(tmp_path / "ck"), save_interval=1,
+                            async_save=False, max_to_keep=None,
+                            max_consecutive_rollbacks=3)
+    with pytest.warns(UserWarning, match="rolling back"):
+        model.fit([x, y], epochs=2, batch_size=4, verbose=0,
+                  shuffle=False, callbacks=[cb])
+    # the NaN batch recurs each epoch: one rollback per epoch, and the
+    # run still completes with finite parameters
+    assert fault_events()["rollbacks"] == 2
+    for _, p in net.named_parameters():
+        assert bool(np.isfinite(p.numpy()).all())
+    # good steps kept checkpointing after the rollbacks
+    assert latest_complete_step(str(tmp_path / "ck")) is not None
+
+
+def test_resilience_callback_escalation_stops_training(tmp_path):
+    from paddle_tpu.hapi.callbacks import ResilienceCallback
+
+    paddle.seed(0)
+    x = np.full((16, 4), np.nan, np.float32)  # EVERY batch is bad
+    y = np.zeros((16, 1), np.float32)
+    net = nn.Linear(4, 1)
+    model = paddle.Model(net)
+    model.prepare(paddle.optimizer.SGD(0.05, parameters=net.parameters()),
+                  nn.MSELoss())
+    cb = ResilienceCallback(str(tmp_path / "ck"), save_interval=100,
+                            async_save=False,
+                            max_consecutive_rollbacks=2)
+    with pytest.warns(UserWarning, match="rolling back"):
+        model.fit([x, y], epochs=5, batch_size=4, verbose=0,
+                  shuffle=False, callbacks=[cb])
+    assert model.stop_training  # default escalation: stop, don't spin
+    assert fault_events()["escalations"] >= 1
+    # fit honors stop_training PER BATCH: exactly 2 bad steps ran
+    # (escalation on the 2nd), not 4/epoch for 5 epochs
+    assert fault_events()["rollbacks"] == 2
+
+
+def test_resilience_callback_kill_and_resume(tmp_path):
+    """Two fit() lifetimes over the same ckpt_dir: the second resumes
+    from the first's final checkpoint instead of starting over."""
+    from paddle_tpu.hapi.callbacks import ResilienceCallback
+
+    ck = str(tmp_path / "ck")
+    paddle.seed(0)
+    x = np.random.rand(16, 4).astype(np.float32)
+    w = np.random.rand(4, 1).astype(np.float32)
+    y = (x @ w).astype(np.float32)
+
+    def lifetime():
+        net = nn.Linear(4, 1)
+        model = paddle.Model(net)
+        model.prepare(paddle.optimizer.SGD(0.05,
+                                           parameters=net.parameters()),
+                      nn.MSELoss())
+        cb = ResilienceCallback(ck, save_interval=2, async_save=False,
+                                max_to_keep=None)
+        model.fit([x, y], epochs=1, batch_size=4, verbose=0, shuffle=False,
+                  callbacks=[cb])
+        return cb, net
+
+    cb1, net1 = lifetime()          # 4 steps: global steps 0..3
+    first_end = cb1.global_step
+    cb2, net2 = lifetime()          # resumes AFTER the first lifetime
+    assert cb2.global_step > first_end
+    # the resumed lifetime restored the first one's trained params
+    # before continuing (they differ from a fresh init)
+    sd1 = {k: p.numpy() for k, p in net1.named_parameters()}
+    sd2 = {k: p.numpy() for k, p in net2.named_parameters()}
+    assert set(sd1) == set(sd2)
+    for k in sd1:
+        assert bool(np.isfinite(sd2[k]).all())

@@ -20,4 +20,11 @@ echo "== import health (every submodule imports on CPU) =="
 JAX_PLATFORMS=cpu python -m pytest tests/test_import_health.py -q \
     -p no:cacheprovider -p no:xdist -p no:randomly
 
+echo "== resilience (fast fault-injection paths) =="
+# everything but the subprocess crash-consistency test (that one spawns
+# a fresh interpreter and SIGKILLs it mid-save; tier-1 runs it)
+JAX_PLATFORMS=cpu python -m pytest tests/test_resilience.py -q \
+    -k "not kill9_mid_async" \
+    -p no:cacheprovider -p no:xdist -p no:randomly
+
 echo "ci_check: OK"

@@ -811,14 +811,29 @@ def _child_setup_jax():
     axon.register() at interpreter start, which force-sets
     jax_platforms="axon,cpu" (axon/register/ifrt.py), overriding
     JAX_PLATFORMS from the environment. BENCH_FORCE_CPU exists so the
-    whole bench pipeline can be smoke-tested without a TPU."""
+    whole bench pipeline can be smoke-tested without a TPU.
+
+    The cache dir comes from PADDLE_TPU_COMPILE_CACHE_DIR (defaulted
+    here, exported by the runner so respawned children within a round
+    share ONE warm dir — a respawn after a crash re-loads, not
+    re-compiles); when a config later imports paddle_tpu, the warm-start
+    subsystem (runtime/warmup.py) re-applies the same dir with its
+    finer-grained knobs, so both layers agree."""
     import jax
 
     if os.environ.get("BENCH_FORCE_CPU") == "1":
         jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_compilation_cache_dir",
-                      os.path.join(REPO, ".jax_cache"))
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    cache_dir = os.environ.setdefault("PADDLE_TPU_COMPILE_CACHE_DIR",
+                                      os.path.join(REPO, ".jax_cache"))
+    # exported too so the warmup auto-config that runs when a config
+    # imports paddle_tpu applies the SAME threshold (its default is 0,
+    # which would flood the shared dir with sub-second executables)
+    min_s = os.environ.setdefault("PADDLE_TPU_COMPILE_CACHE_MIN_COMPILE_S",
+                                  "1.0")
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                      float(min_s))
+    jax.config.update("jax_raise_persistent_cache_errors", False)
 
 
 def _write_out(out_path, payload):
@@ -856,6 +871,36 @@ def _heartbeat(out_dir, state):
                {"t": time.time(), **state})
 
 
+def _compile_snapshot():
+    """Warm-start compile counters (runtime/warmup.py), or None when
+    paddle_tpu is not importable in this child. Import cost is paid by
+    the first config anyway; errors must never fail the bench."""
+    try:
+        from paddle_tpu.runtime import warmup
+
+        return warmup.compile_metrics()
+    except Exception:  # noqa: BLE001
+        return None
+
+
+def _compile_delta(res, name, before, after):
+    """Per-config warm-vs-cold evidence in the BENCH_*.json trajectory:
+    seconds of fresh XLA compile the config paid, how many executables
+    the shared disk cache served instead, and the time from config
+    start to its first compiled step."""
+    if not (before and after):
+        return
+    res[name + "_compile_s"] = round(
+        after["backend_compile_s"] - before["backend_compile_s"], 3)
+    res[name + "_fresh_compiles"] = (
+        after["fresh_compiles"] - before["fresh_compiles"])
+    res[name + "_disk_cache_hits"] = (
+        after["disk_cache_hits"] - before["disk_cache_hits"])
+    tts = after.get("time_to_first_step_s") or {}
+    if tts:
+        res[name + "_time_to_first_step_s"] = round(min(tts.values()), 3)
+
+
 def _run_runner(out_dir, config_names, deadline_ts, small_all=False):
     """The ONE patient client: probe, then every config, in THIS process.
 
@@ -882,6 +927,14 @@ def _run_runner(out_dir, config_names, deadline_ts, small_all=False):
             continue
         small = small_all or remaining < full_cost_s + 120.0
         _heartbeat(out_dir, {"phase": name, "small": small})
+        before = _compile_snapshot()
+        if before is not None:
+            try:  # per-config time-to-first-step epoch
+                from paddle_tpu.runtime import warmup
+
+                warmup.reset_first_step()
+            except Exception:  # noqa: BLE001
+                pass
         try:
             res = fn(**small_kw) if small else fn()
             if small:
@@ -898,6 +951,10 @@ def _run_runner(out_dir, config_names, deadline_ts, small_all=False):
                 except Exception as e2:  # noqa: BLE001
                     res[name + "_small_error"] = (
                         f"{type(e2).__name__}: {e2}"[:300])
+        try:
+            _compile_delta(res, name, before, _compile_snapshot())
+        except Exception:  # noqa: BLE001 — metrics must not fail a result
+            pass
         _write_out(os.path.join(out_dir, name + ".json"), res)
     _heartbeat(out_dir, {"phase": "done"})
 

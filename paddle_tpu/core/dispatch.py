@@ -58,6 +58,7 @@ import enum
 import math
 import os
 import threading
+import time
 import types
 import warnings
 
@@ -65,6 +66,7 @@ import jax
 import numpy as np
 
 from . import dtype as _pdtypes
+from ..runtime import warmup as _warmup
 from ..runtime.resilience import fault_events as _fault_events
 from ..runtime.resilience import record_fault as _record_fault
 
@@ -72,6 +74,7 @@ __all__ = [
     "run_op", "non_jittable", "dispatch_stats", "reset_dispatch_stats",
     "set_eager_jit", "eager_jit_enabled", "suspend", "set_warmup_count",
     "JitCache", "FORWARD", "BACKWARD", "op_core", "freeze_static", "aval_of",
+    "precompile_op",
 ]
 
 
@@ -419,6 +422,12 @@ class JitCache:
             self.put(key, v, tag=tag)
         return v
 
+    def contains(self, key):
+        """Membership without touching hit/miss counters or LRU order
+        (precompile peeks; only real dispatch traffic should count)."""
+        with self._lock:
+            return key in self._d
+
     def sizes_by_tag(self):
         """op name -> number of live cache entries it owns."""
         with self._lock:
@@ -458,6 +467,12 @@ def _cap(env, default):
 FORWARD = JitCache("forward", _cap("PADDLE_TPU_DISPATCH_CACHE_SIZE", 1024))
 BACKWARD = JitCache("backward", _cap("PADDLE_TPU_PULLBACK_CACHE_SIZE", 512))
 
+# time-to-first-step latch for the eager path: a local boolean so the
+# cache-hit fast path pays one truthiness check after the first
+# execution (warmup.reset_first_step re-arms it via the hook below)
+_first_exec = [False]
+_warmup.on_first_step_reset(lambda: _first_exec.__setitem__(0, False))
+
 # full-key sighting counts for the warm gate (bounded so churning keys
 # can't grow it without limit)
 _SEEN_CAP = 8192
@@ -476,14 +491,25 @@ _counters = {
 
 # per-op-identity record: ident -> [name, hits, misses, retraces,
 #                                    miss_streak, compiled_count, warned,
-#                                    jit_failures]
+#                                    jit_failures, compile_seconds]
 # (one dict lookup on the hot path; snapshot aggregation happens in
 # dispatch_stats, off the hot path)
 _op_stats = {}
 _op_stats_lock = threading.Lock()
 
-_HITS, _MISSES, _RETRACES, _STREAK, _COMPILED, _WARNED, _JIT_FAILS = \
-    range(1, 8)
+_HITS, _MISSES, _RETRACES, _STREAK, _COMPILED, _WARNED, _JIT_FAILS, \
+    _COMPILE_S = range(1, 9)
+
+_BLANK_OP_STATS = [None, 0, 0, 0, 0, 0, False, 0, 0.0]
+
+
+def _op_stats_entry(name, ident):
+    ent = _op_stats.get(ident)
+    if ent is None:
+        with _op_stats_lock:
+            ent = _op_stats.setdefault(
+                ident, [name] + _BLANK_OP_STATS[1:])
+    return ent
 
 # deterministic "this can never trace" errors -> learn non-jittable on
 # first sight; anything else (transient runtime failure, OOM) only after
@@ -515,11 +541,7 @@ def _note_hit(ident):
 
 
 def _note_miss(name, ident):
-    ent = _op_stats.get(ident)
-    if ent is None:
-        with _op_stats_lock:
-            ent = _op_stats.setdefault(ident,
-                                       [name, 0, 0, 0, 0, 0, False, 0])
+    ent = _op_stats_entry(name, ident)
     ent[_MISSES] += 1
     ent[_STREAK] += 1
     if ent[_COMPILED] > 0:
@@ -542,13 +564,14 @@ def dispatch_stats():
     fwd = FORWARD.stats()
     fwd.update(_counters)
     blank = {"hits": 0, "misses": 0, "retraces": 0,
-             "cache_entries": 0, "bwd_cache_entries": 0}
+             "cache_entries": 0, "bwd_cache_entries": 0, "compile_s": 0.0}
     per_op = {}
     for ent in list(_op_stats.values()):
         agg = per_op.setdefault(ent[0], dict(blank))
         agg["hits"] += ent[_HITS]
         agg["misses"] += ent[_MISSES]
         agg["retraces"] += ent[_RETRACES]
+        agg["compile_s"] += ent[_COMPILE_S]
     # live compiled-program counts per op: how much of each bounded LRU
     # an op's shape/static churn is occupying right now
     for name, n in FORWARD.sizes_by_tag().items():
@@ -559,6 +582,25 @@ def dispatch_stats():
     # same convention as _op_stats above): a concurrent demotion during
     # Counter's Python-level iteration would raise RuntimeError
     src = collections.Counter(list(_non_jittable_src.values()))
+    # names of runtime-learned demotions: each is an op tracelint's
+    # static analysis missed — tools/check_runtime_demotions.py gates on
+    # this being empty for the library's own op surface
+    learned_names = sorted({
+        ent[0] for ident, s in list(_non_jittable_src.items())
+        if s == "runtime" and (ent := _op_stats.get(ident)) is not None
+    })
+    # warm-start / compile-time observability: global counters from the
+    # jax monitoring bridge (runtime/warmup.py) + per-op compile seconds
+    # measured at fresh-build sites + whole-program compile seconds
+    compile_sec = _warmup.compile_metrics()
+    per_op_compile = {ent[0]: ent[_COMPILE_S]
+                      for ent in list(_op_stats.values()) if ent[_COMPILE_S]}
+    compile_sec.update({
+        "per_op_compile_s": per_op_compile,
+        "program_compile_s": _warmup.program_compile_seconds(),
+        "total_op_compile_s": sum(per_op_compile.values()),
+        "manifest_records": _warmup.manifest_record_count(),
+    })
     return {
         "enabled": _enabled,
         "warmup_count": _warmup_count,
@@ -574,8 +616,13 @@ def dispatch_stats():
             "decorated": src.get("decorated", 0),
             "manifest_preloaded": src.get("manifest", 0),
             "runtime_learned": src.get("runtime", 0),
+            "runtime_learned_ops": learned_names,
             "manifest_entries": len(_manifest),
         },
+        # warm-start observability: compile seconds (per-op + whole
+        # program), disk-cache hits vs fresh XLA compiles, AOT
+        # precompile counts, time-to-first-step per engine
+        "compile": compile_sec,
         # degradation counters from the resilience runtime (save retries,
         # restore fallbacks, rollbacks, stalls, eager demotions, ...) —
         # surfaced here so one snapshot shows compute AND failure health
@@ -669,6 +716,7 @@ def run_op(fn, vals, treedef, fallback, name=None):
         return fallback()
 
     jitted = FORWARD.get(key)
+    fresh = None
     if jitted is None:
         # static unjittable manifest (tools/tracelint): ops PROVEN
         # trace-unsafe by AST analysis are demoted here, on the cold
@@ -701,10 +749,28 @@ def run_op(fn, vals, treedef, fallback, name=None):
                                 tuple(arr_pos), len(vals), name)
         FORWARD.put(key, jitted, tag=name)
         guard[_COMPILED] += 1
+        fresh = guard
     else:
         _note_hit(ident)
     try:
-        return jitted(*[vals[i] for i in arr_pos])
+        if fresh is not None:
+            # first execution of a freshly built program = trace +
+            # compile (a disk-cache load when the persistent cache is
+            # warm) + run: attribute it as this op's compile cost and
+            # record the signature for the warm-start shape manifest
+            t0 = time.perf_counter()
+            out = jitted(*[vals[i] for i in arr_pos])
+            fresh[_COMPILE_S] += time.perf_counter() - t0
+            _warmup.record_op(fn, name, treedef, vals,
+                              tuple(arr_pos), tuple(avals))
+        else:
+            out = jitted(*[vals[i] for i in arr_pos])
+        if not _first_exec[0]:
+            # local flag, not a warmup call: the hit path runs thousands
+            # of times per step and must stay free after the latch
+            _first_exec[0] = True
+            _warmup.note_first_step("eager_op")
+        return out
     except Exception as e:
         # Either the op is unjittable (data-dependent shapes, host
         # control flow) or the call is genuinely bad. The eager rerun
@@ -717,13 +783,83 @@ def run_op(fn, vals, treedef, fallback, name=None):
         FORWARD.pop(key)
         out = fallback()
         _counters["fallbacks"] += 1
-        ent = _op_stats.get(ident)
-        if ent is None:  # failure on a hit served right after a reset
-            with _op_stats_lock:
-                ent = _op_stats.setdefault(
-                    ident,
-                    [getattr(fn, "__name__", "op"), 0, 0, 0, 0, 0, False, 0])
+        if isinstance(jitted, jax.stages.Compiled):
+            # a warm-start AOT executable validates device placement the
+            # cache key does not encode (a jit fn would just
+            # re-specialize); its rejection says nothing about the op's
+            # traceability — drop the entry and let the jit path rebuild
+            # on the next sighting, without feeding the demotion counter
+            return out
+        # entry may be absent when the failure hit right after a reset
+        ent = _op_stats_entry(getattr(fn, "__name__", "op"), ident)
         ent[_JIT_FAILS] += 1
         if isinstance(e, _TRACE_ERRORS) or ent[_JIT_FAILS] >= _JIT_FAIL_LIMIT:
             _mark_non_jittable(ident, fn, "runtime")
         return out
+
+
+# ---- warm-start AOT precompile (runtime/warmup.py drives this) ------------
+
+def precompile_op(fn, treedef, leaves, name=None):
+    """AOT-compile one recorded eager-op signature and install it as a
+    warm FORWARD entry.
+
+    `leaves` is the flattened (args, kwargs) leaf list the manifest
+    recorded: `jax.ShapeDtypeStruct` at array positions, real (thawed)
+    values at static positions. The cache key is built with exactly the
+    machinery `run_op` uses, so the first real call with this signature
+    is a plain hit; the stored program is the AOT `Compiled` executable,
+    so that call pays neither trace nor compile. With the persistent
+    compile cache enabled the `.compile()` here is itself a disk load.
+
+    Returns True when installed; False when the signature is unkeyable,
+    the op is (or became) non-jittable, the dispatch layer is disabled
+    (run_op would never consult the entry), or an equal entry already
+    exists. Compile/lowering errors propagate to the caller (warmup
+    counts them as stale)."""
+    if not _enabled:
+        return False
+    if len(FORWARD) >= FORWARD.capacity:
+        # installing past the LRU bound would evict earlier AOT entries
+        # — claimed warm coverage that silently doesn't exist
+        return False
+    if name is None:
+        name = getattr(fn, "__name__", "op")
+    try:
+        ident = _fn_ident(fn)
+        if ident in _non_jittable:
+            return False
+        if _manifest and type(ident) is types.CodeType \
+                and _manifest_key(ident) in _manifest:
+            return False
+        arr_pos = []
+        statics = []
+        avals = []
+        for i, v in enumerate(leaves):
+            if isinstance(v, jax.ShapeDtypeStruct):
+                arr_pos.append(i)
+                avals.append((v.shape, v.dtype,
+                              bool(getattr(v, "weak_type", False))))
+            else:
+                statics.append((i, freeze_static(v)))
+        key = _Key((op_core(fn), treedef, tuple(statics), tuple(avals)))
+    except (TypeError, ValueError):
+        return False
+    if FORWARD.contains(key):
+        return False
+    program = _build_program(fn, treedef,
+                             {i: leaves[i] for i, _ in statics},
+                             tuple(arr_pos), len(leaves), name)
+    structs = [jax.ShapeDtypeStruct(s, d, weak_type=w)
+               for (s, d, w) in avals]
+    t0 = time.perf_counter()
+    compiled = program.lower(*structs).compile()
+    ent = _op_stats_entry(name, ident)
+    ent[_COMPILE_S] += time.perf_counter() - t0
+    FORWARD.put(key, compiled, tag=name)
+    with _seen_lock:
+        _seen[key] = _warmup_count  # past the warm gate; first call hits
+        _seen.move_to_end(key)
+        if len(_seen) > _SEEN_CAP:
+            _seen.popitem(last=False)
+    return True

@@ -303,10 +303,13 @@ class ResilienceCallback(Callback):
       buffers, optimizer slots, step) is checkpointed asynchronously
       with integrity manifests; an initial checkpoint at train begin
       guarantees a rollback target before the first interval;
-    * a non-finite loss rolls params/optimizer back to the newest
-      complete checkpoint and training skips forward; after
-      `max_consecutive_rollbacks` bad steps in a row the escalation
-      callback runs (default: stop training via `model.stop_training`);
+    * a non-finite loss — or, with `grad_norm_threshold`, an
+      exploding-but-finite per-step global grad norm (exposed by the
+      fused train step as `engine.last_grad_norm`) — rolls
+      params/optimizer back to the newest complete checkpoint and
+      training skips forward; after `max_consecutive_rollbacks` bad
+      steps in a row the escalation callback runs (default: stop
+      training via `model.stop_training`);
     * a heartbeat file advances per step; with `watchdog_timeout` a
       background watchdog reports a hung loop — including one that
       hangs before the first heartbeat — via `on_stall` (default: stop
@@ -322,8 +325,10 @@ class ResilienceCallback(Callback):
                  async_save=True, watchdog_timeout=None, step_deadline=None,
                  run_deadline=None, watchdog_poll=5.0,
                  max_consecutive_rollbacks=3, on_escalate=None, on_stall=None,
-                 verify_integrity=True, resume=True):
+                 verify_integrity=True, resume=True,
+                 grad_norm_threshold=None):
         super().__init__()
+        self.grad_norm_threshold = grad_norm_threshold
         self.ckpt_dir = ckpt_dir
         self.save_interval = max(1, int(save_interval))
         self.max_to_keep = max_to_keep
@@ -402,6 +407,13 @@ class ResilienceCallback(Callback):
         from ..io.checkpoint import CheckpointManager
         from ..runtime.resilience import BadStepGuard
 
+        # ask the fused step for its per-step grad norm (opt-in: the
+        # extra all-gradients reduction is only paid under a guard);
+        # train_batch rebuilds the step fn if it was traced without it
+        engine = getattr(self.model, "_engine", None)
+        if engine is not None:
+            engine.want_grad_norm = True
+
         self._mngr = CheckpointManager(
             self.ckpt_dir, max_to_keep=self.max_to_keep,
             async_save=self.async_save,
@@ -433,7 +445,8 @@ class ResilienceCallback(Callback):
 
         self._guard = BadStepGuard(
             _rollback, max_consecutive=self.max_consecutive_rollbacks,
-            on_escalate=_escalate)
+            on_escalate=_escalate,
+            grad_norm_threshold=self.grad_norm_threshold)
 
         def _stall(info):
             if self.on_stall is not None:
@@ -454,9 +467,14 @@ class ResilienceCallback(Callback):
         loss = logs.get("loss")
         if isinstance(loss, (list, tuple)):
             loss = loss[0] if loss else None
+        # per-step global grad norm from the fused train step: lets the
+        # guard catch exploding-but-finite steps (threshold rollback),
+        # not just non-finite losses
+        gnorm = getattr(getattr(self.model, "_engine", None),
+                        "last_grad_norm", None)
         good = True
-        if loss is not None:
-            good = self._guard.check(self.global_step, loss)
+        if loss is not None or gnorm is not None:
+            good = self._guard.check(self.global_step, loss, grad_norm=gnorm)
         if good:
             self._em.tick(self.global_step)
         self.global_step += 1

@@ -39,6 +39,23 @@ def _as_tensors(batch):
     return [batch if isinstance(batch, Tensor) else Tensor(np.asarray(batch))]
 
 
+def _grad_norm(grads):
+    """Global L2 norm over a grad pytree, computed inside the fused step
+    (f32 accumulation) so BadStepGuard can flag exploding-but-finite
+    steps without a second backward."""
+    leaves = jax.tree_util.tree_leaves(grads)
+    if not leaves:
+        return jnp.float32(0.0)
+    return jnp.sqrt(sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
+                        for g in leaves))
+
+
+def _note_first_step(kind):
+    from ..runtime import warmup as _warmup
+
+    _warmup.note_first_step(kind)
+
+
 class _JitStepEngine:
     """Compiles train/eval/predict steps over the network's param pytree."""
 
@@ -50,6 +67,16 @@ class _JitStepEngine:
         self._eval_fn = None
         self._opt_states = None
         self._accum_grads = None
+        # per-step global L2 grad norm from the fused step (device array;
+        # BadStepGuard reads it host-side to catch exploding-but-finite
+        # steps). Opt-in via want_grad_norm (ResilienceCallback sets it):
+        # the norm is a full extra reduction over every gradient leaf,
+        # which users without a guard must not pay. None until the first
+        # train step with the flag on.
+        self.last_grad_norm = None
+        self.want_grad_norm = False
+        self._computes_norm = False  # what the BUILT step fns bake
+        self._recorded = set()  # program names already shape-recorded
 
     # -- pure functions ----------------------------------------------------
     def _forward_loss(self, param_vals, buf_vals, xs, ys, key, training):
@@ -99,6 +126,7 @@ class _JitStepEngine:
     def _build_train(self):
         opt = self.model._optimizer
         engine = self
+        compute_norm = self._computes_norm = self.want_grad_norm
 
         meta = opt.param_meta({k: p for k, p in
                                self.model.network.named_parameters()
@@ -112,9 +140,10 @@ class _JitStepEngine:
                 return loss, (outs, new_bufs)
             (loss, (outs, new_bufs)), grads = jax.value_and_grad(
                 loss_of, has_aux=True)(param_vals)
+            gnorm = _grad_norm(grads) if compute_norm else jnp.float32(0.0)
             new_params, new_states = opt.functional_update(
                 param_vals, grads, opt_states, lr, meta=meta, clip=clip)
-            return new_params, new_states, new_bufs, loss, outs
+            return new_params, new_states, new_bufs, loss, outs, gnorm
 
         # donate params + opt states (large, rewritten in place by XLA);
         # buf_vals must NOT be donated: it also carries non-trainable params
@@ -123,6 +152,7 @@ class _JitStepEngine:
 
     def _build_grad(self):
         engine = self
+        compute_norm = self._computes_norm = self.want_grad_norm
 
         def step(param_vals, buf_vals, xs, ys, key):
             def loss_of(pv):
@@ -131,7 +161,8 @@ class _JitStepEngine:
                 return loss, (outs, new_bufs)
             (loss, (outs, new_bufs)), grads = jax.value_and_grad(
                 loss_of, has_aux=True)(param_vals)
-            return grads, loss, outs, new_bufs
+            gnorm = _grad_norm(grads) if compute_norm else jnp.float32(0.0)
+            return grads, loss, outs, new_bufs, gnorm
 
         return jax.jit(step)
 
@@ -193,18 +224,28 @@ class _JitStepEngine:
         lr = jnp.asarray(self.model._optimizer.get_lr(), jnp.float32)
         key = rnd.next_key()
         if update and self._accum_grads is None:
-            # fast path: one fused XLA program
-            if self._train_fn is None:
+            # fast path: one fused XLA program (rebuilt if the grad-norm
+            # request changed since it was traced — the flag is baked in)
+            if self._train_fn is None or \
+                    self._computes_norm != self.want_grad_norm:
                 self._train_fn = self._build_train()
-            new_params, self._opt_states, new_bufs, loss, outs = \
+            self._record_signature("hapi.train_step",
+                                   (params, self._opt_states, bufs, xs, ys,
+                                    lr, key))
+            new_params, self._opt_states, new_bufs, loss, outs, gnorm = \
                 self._train_fn(params, self._opt_states, bufs, xs, ys, lr,
                                key)
+            self.last_grad_norm = gnorm if self._computes_norm else None
             self._write_back(new_params, new_bufs)
+            _note_first_step("hapi_step")
             return loss, outs
         # accumulation path: grads computed now, applied on the update call
-        if self._grad_fn is None:
+        if self._grad_fn is None or \
+                self._computes_norm != self.want_grad_norm:
             self._grad_fn = self._build_grad()
-        grads, loss, outs, new_bufs = self._grad_fn(params, bufs, xs, ys, key)
+        grads, loss, outs, new_bufs, gnorm = self._grad_fn(params, bufs, xs,
+                                                           ys, key)
+        self.last_grad_norm = gnorm if self._computes_norm else None
         if self._accum_grads is None:
             self._accum_grads = grads
         else:
@@ -224,9 +265,23 @@ class _JitStepEngine:
     def eval_batch(self, xs, ys):
         if self._eval_fn is None:
             self._eval_fn = self._build_eval()
-        loss, outs = self._eval_fn(self._param_dict(), self._buf_dict(), xs,
-                                   ys, rnd.next_key())
+        params = self._param_dict()
+        bufs = self._buf_dict()
+        key = rnd.next_key()
+        self._record_signature("hapi.eval_step", (params, bufs, xs, ys, key))
+        loss, outs = self._eval_fn(params, bufs, xs, ys, key)
         return loss, outs
+
+    def _record_signature(self, name, args):
+        """Record the whole-step input signature for the warm-start
+        shape manifest, once per program name (BEFORE the call: donated
+        buffers are dead afterwards)."""
+        if name in self._recorded:
+            return
+        self._recorded.add(name)
+        from ..runtime import warmup as _warmup
+
+        _warmup.record_program(name, args)
 
 
 class Model:
@@ -257,6 +312,35 @@ class Model:
             elif isinstance(amp_configs, dict):
                 self._amp_level = amp_configs.get("level", "O1")
         return self
+
+    def warm_start(self, manifest=None):
+        """AOT-precompile the fused train/eval steps from a warm-start
+        shape manifest (runtime/warmup.py), so the first `fit` batch
+        pays neither trace nor XLA compile time — with the persistent
+        compile cache enabled every compile here is a disk load.
+
+        `manifest` is a path or manifest dict (None reuses signatures
+        already loaded via ``warmup.precompile``). Signatures recorded
+        for a different model/batch shape degrade to a
+        ``stale_manifests`` fault event, never an error. Returns
+        {"train": n, "eval": n} — how many signatures compiled."""
+        from ..runtime import warmup as _warmup
+
+        if manifest is not None:
+            _warmup.precompile(manifest)
+        stats = {"train": 0, "eval": 0}
+        if self._optimizer is not None and self._loss is not None and \
+                _warmup.pending_programs().get("hapi.train_step"):
+            if self._engine._train_fn is None:
+                self._engine._train_fn = self._engine._build_train()
+            stats["train"] = _warmup.prewarm_program(
+                "hapi.train_step", self._engine._train_fn)
+        if _warmup.pending_programs().get("hapi.eval_step"):
+            if self._engine._eval_fn is None:
+                self._engine._eval_fn = self._engine._build_eval()
+            stats["eval"] = _warmup.prewarm_program(
+                "hapi.eval_step", self._engine._eval_fn)
+        return stats
 
     # ---- single-batch APIs ----------------------------------------------
     def train_batch(self, inputs, labels=None, update=True):

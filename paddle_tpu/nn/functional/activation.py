@@ -194,7 +194,7 @@ def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
     key = rnd.next_key()
 
     def _f(v):
-        g = jax.random.gumbel(key, v.shape, v.dtype)
+        g = jax.random.gumbel(key, v.shape, v.dtype)  # tracelint: ok[closure-capture] per-call PRNG key; deliberately eager
         y = jax.nn.softmax((v + g) / temperature, axis=axis)
         if hard:
             idx = jnp.argmax(y, axis=axis, keepdims=True)
@@ -219,6 +219,6 @@ def rrelu(x, lower=0.125, upper=0.333333, training=True, name=None):
     key = rnd.next_key()
 
     def _f(v):
-        a = jax.random.uniform(key, v.shape, v.dtype, lower, upper)
+        a = jax.random.uniform(key, v.shape, v.dtype, lower, upper)  # tracelint: ok[closure-capture] per-call PRNG key; deliberately eager
         return jnp.where(v >= 0, v, a * v)
     return apply(_f, x)

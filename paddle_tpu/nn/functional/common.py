@@ -66,7 +66,7 @@ def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",
             axes = [axis] if isinstance(axis, int) else list(axis)
             shape = [s if i in [a % v.ndim for a in axes] else 1
                      for i, s in enumerate(v.shape)]
-        keep = jax.random.bernoulli(key, 1.0 - p, tuple(shape))
+        keep = jax.random.bernoulli(key, 1.0 - p, tuple(shape))  # tracelint: ok[closure-capture] per-call PRNG key; deliberately eager
         if mode == "upscale_in_train":
             return jnp.where(keep, v / (1.0 - p), 0.0).astype(v.dtype)
         return jnp.where(keep, v, 0.0).astype(v.dtype)
@@ -82,7 +82,7 @@ def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
 
     def _f(v):
         shape = tuple(s if i in keep_axes else 1 for i, s in enumerate(v.shape))
-        keep = jax.random.bernoulli(key, 1.0 - p, shape)
+        keep = jax.random.bernoulli(key, 1.0 - p, shape)  # tracelint: ok[closure-capture] per-call PRNG key; deliberately eager
         return jnp.where(keep, v / (1.0 - p), 0.0).astype(v.dtype)
     return apply(_f, x)
 
@@ -96,7 +96,7 @@ def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
     def _f(v):
         shape = tuple(s if i in (0, ch_axis) else 1
                       for i, s in enumerate(v.shape))
-        keep = jax.random.bernoulli(key, 1.0 - p, shape)
+        keep = jax.random.bernoulli(key, 1.0 - p, shape)  # tracelint: ok[closure-capture] per-call PRNG key; deliberately eager
         return jnp.where(keep, v / (1.0 - p), 0.0).astype(v.dtype)
     return apply(_f, x)
 
@@ -110,7 +110,7 @@ def alpha_dropout(x, p=0.5, training=True, name=None):
     alpha_p = -alpha * scale
 
     def _f(v):
-        keep = jax.random.bernoulli(key, 1.0 - p, v.shape)
+        keep = jax.random.bernoulli(key, 1.0 - p, v.shape)  # tracelint: ok[closure-capture] per-call PRNG key; deliberately eager
         a = (1.0 / np.sqrt((alpha_p ** 2 * p + 1) * (1 - p))) if p < 1 else 0.0
         b = -a * alpha_p * p
         return (a * jnp.where(keep, v, alpha_p) + b).astype(v.dtype)

@@ -8,6 +8,7 @@ fused/multi_tensor kernels, but compiler-scheduled.
 from __future__ import annotations
 
 import functools
+import time
 
 import jax
 import jax.numpy as jnp
@@ -58,6 +59,9 @@ class Optimizer:
         self._accumulators = {}   # param id -> {slot: jnp array}
         self._global_step = 0
         self._step_fn_cache = {}
+        self._step_recorded = False  # first step() recorded its warm-start
+        #                              signature (even if warm_start built
+        #                              the entry first)
         self._name = name or type(self).__name__
 
     # ---- lr ------------------------------------------------------------
@@ -212,15 +216,13 @@ class Optimizer:
                 "use minimize(loss, parameters=...)")
         return self._parameter_list
 
-    def step(self):
-        params = [p for p in self._param_list
-                  if not p.stop_gradient and p._grad is not None
-                  and getattr(p, "trainable", True)]
-        if not params:
-            return
+    def _entry_for(self, params):
+        """The fused jitted step for this exact param list, built on
+        first sight (shared by step() and warm_start())."""
         key = tuple(id(p) for p in params)
         entry = self._step_fn_cache.get(key)
-        if entry is None:
+        built = entry is None
+        if built:
             lr_mults = tuple(
                 float(getattr(p, "optimize_attr", {}).get("learning_rate", 1.0))
                 for p in params)
@@ -230,21 +232,104 @@ class Optimizer:
             statics = tuple(self._param_static(p) for p in params)
             clip = self._grad_clip if isinstance(self._grad_clip,
                                                  ClipGradBase) else None
-            fn = self._build_step_fn(len(params), lr_mults, wd, l1, clip,
-                                     flags, statics)
-            entry = fn
+            entry = self._build_step_fn(len(params), lr_mults, wd, l1, clip,
+                                        flags, statics)
             self._step_fn_cache[key] = entry
+        return entry, built
+
+    def _program_name(self):
+        return f"optimizer.fused_step.{type(self).__name__}"
+
+    def step(self):
+        params = [p for p in self._param_list
+                  if not p.stop_gradient and p._grad is not None
+                  and getattr(p, "trainable", True)]
+        if not params:
+            return
+        entry, built = self._entry_for(params)
         values = [p._value for p in params]
         states = [self._states_for(p) for p in params]
         grads = [p._grad._value.astype(
             jnp.float32 if "master" in s else p._value.dtype)
             for p, s in zip(params, states)]
         lr = jnp.asarray(self.get_lr(), jnp.float32)
-        new_vals, new_states = entry(values, states, grads, lr)
+        # first step of a freshly built OR warm-started entry (built is
+        # False after warm_start pre-built it): trace + compile/disk
+        # load happens now — attribute the time and record the
+        # signature for the warm-start manifest BEFORE the call, since
+        # values/states are donated (dead afterwards)
+        if built or not self._step_recorded:
+            self._step_recorded = True
+            from ..runtime import warmup as _warmup
+
+            _warmup.record_program(self._program_name(),
+                                   (values, states, grads, lr))
+            t0 = time.perf_counter()
+            new_vals, new_states = entry(values, states, grads, lr)
+            _warmup.note_op_compile(self._program_name(),
+                                    time.perf_counter() - t0)
+            _warmup.note_first_step("fused_step")
+        else:
+            new_vals, new_states = entry(values, states, grads, lr)
         for p, nv, ns in zip(params, new_vals, new_states):
             p._value = nv
             self._accumulators[id(p)] = ns
         self._global_step += 1
+
+    def warm_start(self, manifest=None):
+        """AOT-precompile the fused multi-tensor step for the CURRENT
+        parameter list, plus any signatures recorded for this optimizer
+        class in a warm-start manifest (runtime/warmup.py). Grad avals
+        are synthesized from the params (f32 when a master weight
+        exists), so no backward pass is needed — with the persistent
+        compile cache enabled the XLA work is a disk load and the first
+        real step pays retrace only. Returns the number of signatures
+        compiled.
+
+        Best-effort: the entry is built for ALL trainable params (grads
+        do not exist yet), while step() keys on the grad-bearing
+        subset. If some trainable param never receives a grad (unused
+        by the loss), the first real step builds its own entry — still
+        a disk-cache load for the XLA portion when shapes coincide,
+        a plain cold compile otherwise."""
+        from ..runtime import warmup as _warmup
+
+        if manifest is not None:
+            _warmup.precompile(manifest)
+        params = [p for p in self._param_list
+                  if not p.stop_gradient and getattr(p, "trainable", True)]
+        n = 0
+        if params:
+            entry, _ = self._entry_for(params)
+            n += _warmup.prewarm_program(self._program_name(), entry)
+            if n:
+                # the recorded signature already covered this optimizer;
+                # the self-derived lowering below would trace the same
+                # program a second time (the dominant warm-start cost
+                # host-side)
+                return n
+            try:
+                values = [jax.ShapeDtypeStruct(p._value.shape,
+                                               p._value.dtype)
+                          for p in params]
+                states = [self._states_for(p) for p in params]
+                grads = [jax.ShapeDtypeStruct(
+                    p._value.shape,
+                    jnp.float32 if "master" in s else p._value.dtype)
+                    for p, s in zip(params, states)]
+                lr = jax.ShapeDtypeStruct((), jnp.float32)
+                t0 = time.perf_counter()
+                entry.lower(values, states, grads, lr).compile()
+                _warmup.note_op_compile(self._program_name(),
+                                        time.perf_counter() - t0)
+                n += 1
+            except Exception:  # noqa: BLE001 — warm-start is best-effort
+                from ..runtime.resilience import record_fault
+
+                record_fault("stale_manifests",
+                             f"{self._program_name()}: self-derived "
+                             "signature failed to lower")
+        return n
 
     def clear_grad(self, set_to_zero=True):
         for p in self._param_list:

@@ -223,6 +223,42 @@ class Profiler:
             if fat:
                 print("  cache occupancy (op: fwd+bwd programs): "
                       + ", ".join(f"{k}: {f}+{b}" for k, f, b in fat))
+        comp = ds.get("compile") or {}
+        if comp:
+            # warm-start health: how much wall time XLA compilation cost
+            # this process, how much the persistent disk cache absorbed,
+            # and how long the first compiled step took to arrive
+            line = (f"compile: {comp.get('fresh_compiles', 0)} fresh "
+                    f"({comp.get('backend_compile_s', 0.0):.2f}s XLA), "
+                    f"{comp.get('disk_cache_hits', 0)} loaded from disk "
+                    f"cache")
+            if comp.get("compile_time_saved_s"):
+                line += (f" (~{comp['compile_time_saved_s']:.2f}s compile "
+                         "saved)")
+            if comp.get("cache_dir"):
+                line += f" [{comp['cache_dir']}]"
+            print(line)
+            pre = (comp.get("precompiled_ops", 0)
+                   + comp.get("precompiled_programs", 0))
+            if pre:
+                print(f"  warm-start precompiled: "
+                      f"{comp.get('precompiled_ops', 0)} ops + "
+                      f"{comp.get('precompiled_programs', 0)} programs "
+                      f"from the shape manifest")
+            tts = comp.get("time_to_first_step_s") or {}
+            if tts:
+                print("  time-to-first-step: "
+                      + ", ".join(f"{k}: {v:.2f}s"
+                                  for k, v in sorted(tts.items())))
+            if op_detail and comp.get("per_op_compile_s"):
+                top = sorted(comp["per_op_compile_s"].items(),
+                             key=lambda kv: -kv[1])[:5]
+                print("  compile-heavy ops: "
+                      + ", ".join(f"{k}: {v:.2f}s" for k, v in top))
+            if op_detail and comp.get("program_compile_s"):
+                print("  whole-step programs: "
+                      + ", ".join(f"{k}: {v:.2f}s" for k, v in
+                                  sorted(comp["program_compile_s"].items())))
         uj = ds.get("unjittable")
         if uj and uj["total"]:
             print(f"unjittable ops: {uj['total']} "

@@ -1,10 +1,12 @@
 """paddle_tpu.runtime — host-side runtime services around the compute
-path: staging buffers (`staging`), HBM stats (`memory`), and the
-fault-tolerance substrate (`resilience`).
+path: staging buffers (`staging`), HBM stats (`memory`), the
+fault-tolerance substrate (`resilience`), and the warm-start subsystem
+(`warmup`: persistent compile cache + shape-manifest AOT precompile).
 
 Only `resilience` is imported eagerly (stdlib+numpy, cheap, and
-`core.dispatch` depends on it); `memory`/`staging` stay import-on-use.
+`core.dispatch` depends on it); `warmup` loads with the dispatch layer,
+`memory`/`staging` stay import-on-use.
 """
 from . import resilience  # noqa: F401
 
-__all__ = ["resilience", "memory", "staging"]
+__all__ = ["resilience", "warmup", "memory", "staging"]

@@ -75,6 +75,12 @@ _EVENT_KINDS = (
     "heartbeat_regressions",  # tick() called with a step older than recorded
     "eager_demotions",        # dispatch learned an op non-jittable at runtime
     "injected_faults",        # FaultInjector fired (test observability)
+    "compile_cache_errors",   # persistent compile-cache entry failed to
+    #                           read/write (corrupt file); degraded to a
+    #                           fresh compile
+    "stale_manifests",        # a warm-start shape manifest was rejected
+    #                           (version mismatch, unresolvable op) or an
+    #                           entry failed to replay; cold start instead
 )
 
 _events_lock = threading.Lock()
@@ -400,24 +406,42 @@ class BadStepGuard:
     """
 
     def __init__(self, rollback_fn, max_consecutive=3, on_escalate=None,
-                 check_grads=True):
+                 check_grads=True, grad_norm_threshold=None):
         self.rollback_fn = rollback_fn
         self.max_consecutive = max(1, int(max_consecutive))
         self.on_escalate = on_escalate
         self.check_grads = check_grads
+        # exploding-but-FINITE steps: a grad norm above this threshold is
+        # a bad step even though every value still passes isfinite (the
+        # hapi fused train step exposes its per-step global grad norm so
+        # this check sees more than the loss)
+        self.grad_norm_threshold = (
+            float(grad_norm_threshold) if grad_norm_threshold is not None
+            else None)
         self.consecutive = 0
         self.total_rollbacks = 0
         self.last_bad_step = None
 
-    def is_bad(self, loss=None, grads=None):
+    def is_bad(self, loss=None, grads=None, grad_norm=None):
         if loss is not None and not all_finite(loss):
             return "non-finite loss"
         if self.check_grads and grads is not None and not all_finite(grads):
             return "non-finite grad"
+        if grad_norm is not None:
+            try:
+                gn = float(np.asarray(grad_norm))
+            except Exception:  # noqa: BLE001 — unreadable norm: ignore
+                return None
+            if not np.isfinite(gn):
+                return "non-finite grad norm"
+            if self.grad_norm_threshold is not None and \
+                    gn > self.grad_norm_threshold:
+                return (f"grad norm {gn:.4g} exceeds threshold "
+                        f"{self.grad_norm_threshold:.4g}")
         return None
 
-    def check(self, step, loss=None, grads=None):
-        why = self.is_bad(loss, grads)
+    def check(self, step, loss=None, grads=None, grad_norm=None):
+        why = self.is_bad(loss, grads, grad_norm)
         if why is None:
             self.consecutive = 0
             return True

@@ -1,0 +1,78 @@
+"""Child process for the warm-start round trip (test_warmup.py).
+
+Modes (argv[1]):
+  record  — run the shared workload cold, save the shape manifest,
+            print compile metrics as one JSON line.
+  replay  — precompile the manifest, run the same workload, print
+            compile metrics. With a warm shared cache dir the parent
+            asserts ZERO fresh XLA compiles and disk hits > 0.
+
+Env (set by the parent): JAX_PLATFORMS=cpu,
+PADDLE_TPU_COMPILE_CACHE_DIR, PADDLE_TPU_COMPILE_CACHE_MIN_COMPILE_S=0,
+WARMUP_MANIFEST.
+"""
+import json
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu as paddle  # noqa: E402
+import paddle_tpu.nn.functional as F  # noqa: E402
+from paddle_tpu.core import dispatch  # noqa: E402
+from paddle_tpu.runtime import warmup  # noqa: E402
+
+mode = sys.argv[1]
+manifest_path = os.environ["WARMUP_MANIFEST"]
+
+
+def workload():
+    """Eager ops (incl. closure-captured statics + kwargs trees), a
+    backward pass, and a fused optimizer step — identical in both
+    processes, deterministic under paddle.seed."""
+    dispatch.set_warmup_count(1)
+    paddle.seed(0)
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.randn(8, 16).astype(np.float32))
+    w = paddle.to_tensor(rng.randn(16, 4).astype(np.float32),
+                         stop_gradient=False)
+    b = paddle.to_tensor(np.zeros(4, np.float32), stop_gradient=False)
+    outs = []
+    for _ in range(2):
+        outs.append(float(np.asarray(
+            paddle.matmul(x, w, transpose_y=False).sum()._value)))
+        outs.append(float(np.asarray(paddle.sum(x, axis=1).mean()._value)))
+        outs.append(float(np.asarray(F.softmax(x, axis=-1)[0, 0]._value)))
+    opt = paddle.optimizer.SGD(learning_rate=0.01, parameters=[w, b])
+    for _ in range(3):
+        h = F.relu(paddle.matmul(x, w) + b)
+        loss = (h * h).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        outs.append(float(np.asarray(loss._value)))
+    return outs
+
+
+pre = None
+if mode == "replay":
+    pre = warmup.precompile(manifest_path)
+outs = workload()
+if mode == "record":
+    warmup.save_manifest(manifest_path)
+
+stats = dispatch.dispatch_stats()
+comp = stats["compile"]
+print(json.dumps({
+    "outs": outs,
+    "fresh_compiles": comp["fresh_compiles"],
+    "disk_cache_hits": comp["disk_cache_hits"],
+    "forward_misses": stats["forward"]["misses"],
+    "forward_hits": stats["forward"]["hits"],
+    "manifest_records": comp["manifest_records"],
+    "time_to_first_step": comp["time_to_first_step_s"],
+    "precompile": pre,
+}), flush=True)

@@ -546,3 +546,86 @@ def test_resilience_callback_kill_and_resume(tmp_path):
     assert set(sd1) == set(sd2)
     for k in sd1:
         assert bool(np.isfinite(sd2[k]).all())
+
+
+# ---------------------------------------------------------------------------
+# grad-norm guard: exploding-but-finite steps (PR-3 follow-up)
+
+def test_badstep_guard_grad_norm_threshold():
+    """A finite loss with a grad norm above the threshold is a bad step:
+    rollback fires; below it, nothing does. Non-finite norms are bad
+    regardless of threshold."""
+    rolled = []
+    guard = BadStepGuard(lambda step: rolled.append(step),
+                         max_consecutive=10, grad_norm_threshold=100.0)
+    assert guard.check(0, 0.5, grad_norm=3.0)
+    assert not guard.check(1, 0.5, grad_norm=1e6)   # finite but exploding
+    assert rolled == [1]
+    assert guard.check(2, 0.5, grad_norm=np.float32(99.0))
+    assert not guard.check(3, 0.5, grad_norm=float("nan"))
+    assert fault_events()["rollbacks"] == 2
+
+    # without a threshold only non-finite norms are bad
+    guard2 = BadStepGuard(lambda step: None, max_consecutive=10)
+    assert guard2.check(0, 0.5, grad_norm=1e30)
+    assert not guard2.check(1, 0.5, grad_norm=float("inf"))
+
+
+def test_fused_step_exposes_grad_norm():
+    """With want_grad_norm set (ResilienceCallback does this), the hapi
+    fused train step returns the per-step global L2 grad norm
+    (engine.last_grad_norm) matching a hand computation; without it the
+    norm is not computed (no extra reduction for guard-less users)."""
+    paddle.seed(0)
+    net = nn.Linear(4, 1)
+    model = paddle.Model(net)
+    model.prepare(paddle.optimizer.SGD(0.0, parameters=net.parameters()),
+                  nn.MSELoss())
+    rng = np.random.RandomState(0)
+    x = rng.rand(8, 4).astype(np.float32)
+    y = rng.rand(8, 1).astype(np.float32)
+    model.train_batch([x], [y])
+    assert model._engine.last_grad_norm is None  # opt-in only
+    model._engine.want_grad_norm = True          # rebuilds the step fn
+    model.train_batch([x], [y])
+    gnorm = float(np.asarray(model._engine.last_grad_norm))
+    assert np.isfinite(gnorm) and gnorm > 0
+    # lr=0 froze the params: recompute the same grads by hand
+    w = {k: p.numpy() for k, p in net.named_parameters()}
+    wt = w["weight"]
+    b = w["bias"]
+    pred = x @ wt + b
+    gw = 2.0 * x.T @ (pred - y) / len(x)
+    gb = 2.0 * np.mean(pred - y, axis=0)
+    ref = float(np.sqrt((gw ** 2).sum() + (gb ** 2).sum()))
+    np.testing.assert_allclose(gnorm, ref, rtol=1e-4)
+
+
+def test_resilience_callback_grad_norm_threshold_rollback(tmp_path):
+    """End-to-end: a huge-magnitude (but finite) batch explodes the grad
+    norm; ResilienceCallback(grad_norm_threshold=...) rolls back and
+    training completes with finite params — the exploding step's update
+    never sticks."""
+    from paddle_tpu.hapi.callbacks import ResilienceCallback
+
+    paddle.seed(0)
+    x = np.random.rand(16, 4).astype(np.float32)
+    w = np.random.rand(4, 1).astype(np.float32)
+    y = (x @ w).astype(np.float32)
+    x[8] = 1e4  # finite, but the MSE grads through it explode
+    net = nn.Linear(4, 1)
+    model = paddle.Model(net)
+    model.prepare(paddle.optimizer.SGD(0.05, parameters=net.parameters()),
+                  nn.MSELoss())
+    cb = ResilienceCallback(str(tmp_path / "ck"), save_interval=1,
+                            async_save=False, max_to_keep=None,
+                            max_consecutive_rollbacks=5,
+                            grad_norm_threshold=1e3)
+    with pytest.warns(UserWarning, match="grad norm"):
+        model.fit([x, y], epochs=1, batch_size=4, verbose=0, shuffle=False,
+                  callbacks=[cb])
+    assert fault_events()["rollbacks"] >= 1
+    for _, p in net.named_parameters():
+        pv = p.numpy()
+        assert bool(np.isfinite(pv).all())
+        assert float(np.abs(pv).max()) < 1e3  # the bad update was undone

@@ -1,0 +1,338 @@
+"""Warm-start subsystem (runtime/warmup.py + dispatch/hapi/optimizer
+wiring).
+
+Covers the ISSUE acceptance: a second process with
+``PADDLE_TPU_COMPILE_CACHE_DIR`` + manifest precompile performs ZERO
+fresh XLA compiles for the recorded signatures (subprocess round trip);
+a stale manifest (version / jax / framework mismatch) falls back to a
+cold start with a ``stale_manifests`` fault event; a corrupt compile
+cache entry is tolerated (fresh compile + ``compile_cache_errors``
+event, correct numerics); and the compile observability surface —
+``dispatch_stats()["compile"]`` keys, per-op compile seconds,
+time-to-first-step, profiler.summary output.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu import nn
+from paddle_tpu.core import dispatch
+from paddle_tpu.runtime import resilience, warmup
+
+
+@pytest.fixture(autouse=True)
+def _fresh():
+    prev_warm = dispatch.set_warmup_count(1)
+    dispatch.reset_dispatch_stats(clear_caches=True)
+    warmup.reset_manifest_records()
+    resilience.reset_fault_events()
+    yield
+    dispatch.set_warmup_count(prev_warm)
+    dispatch.reset_dispatch_stats(clear_caches=True)
+    warmup.reset_manifest_records()
+    resilience.reset_fault_events()
+
+
+def _t(arr, stop_gradient=True):
+    return paddle.to_tensor(np.asarray(arr), stop_gradient=stop_gradient)
+
+
+# ---- manifest record / serialize / precompile (in-process) ---------------
+
+def test_compiled_ops_are_recorded_and_replayable():
+    x = _t(np.ones((4, 8), np.float32))
+    w = _t(np.ones((8, 4), np.float32))
+    paddle.add(x, x)
+    paddle.matmul(x, w, transpose_y=False)   # closure-captured statics
+    paddle.sum(x, axis=1)                    # kwargs treedef + int static
+    m = warmup.manifest()
+    ops = [e for e in m["entries"] if e["kind"] == "op"]
+    assert len(ops) >= 3
+    assert all(e["replayable"] for e in ops), [
+        (e["name"], e["impl"]) for e in ops if not e["replayable"]]
+    assert m["version"] == warmup.MANIFEST_VERSION
+    assert m["jax"] and m["paddle_tpu"]
+
+
+def test_precompile_installs_warm_entries_zero_misses():
+    """After a full cache reset, precompiling the recorded manifest must
+    serve every recorded signature as a first-call hit — no misses, no
+    retrace (the entries are AOT executables)."""
+    x = _t(np.ones((4, 8), np.float32))
+    w = _t(np.ones((8, 4), np.float32))
+
+    def run():
+        return [np.asarray(paddle.add(x, x)._value),
+                np.asarray(paddle.matmul(x, w)._value),
+                np.asarray(paddle.sum(x, axis=1)._value),
+                np.asarray(F.softmax(x, axis=-1)._value)]
+
+    cold = run()
+    m = warmup.manifest()
+    dispatch.reset_dispatch_stats(clear_caches=True)
+
+    stats = warmup.precompile(m)
+    assert stats["ops_precompiled"] >= 4 and not stats["stale"]
+    warm = run()
+    fwd = dispatch.dispatch_stats()["forward"]
+    assert fwd["misses"] == 0, fwd
+    assert fwd["hits"] >= 4
+    for a, b in zip(cold, warm):
+        np.testing.assert_allclose(a, b)
+
+
+def test_precompile_skips_nonjittable_and_counts_skipped():
+    m = {"version": warmup.MANIFEST_VERSION,
+         **{k: v for k, v in warmup.manifest().items()
+            if k in ("jax", "paddle_tpu")},
+         "entries": [{"kind": "op", "name": "ghost", "replayable": False,
+                      "impl": None, "tree": None, "leaves": None}]}
+    stats = warmup.precompile(m)
+    assert stats == {"ops_precompiled": 0, "ops_skipped": 1,
+                     "programs_pending": 0, "stale": False}
+
+
+def test_stale_manifest_falls_back_cold_with_fault_event(tmp_path):
+    """Version / jax-version mismatch must degrade to a cold start and
+    record a stale_manifests fault event — never raise."""
+    p = tmp_path / "manifest.json"
+    doc = warmup.manifest()
+    doc["jax"] = "0.0.0-not-this-jax"
+    p.write_text(json.dumps(doc))
+    assert warmup.load_manifest(str(p)) is None
+    stats = warmup.precompile(str(p))
+    assert stats["stale"] and stats["ops_precompiled"] == 0
+    doc2 = warmup.manifest()
+    doc2["version"] = 999
+    p.write_text(json.dumps(doc2))
+    assert warmup.load_manifest(str(p)) is None
+    # unreadable file: same contract
+    p.write_text("{ not json")
+    assert warmup.load_manifest(str(p)) is None
+    assert resilience.fault_events()["stale_manifests"] >= 3
+
+
+def test_unresolvable_op_entry_is_stale_not_fatal():
+    x = _t(np.ones((4,), np.float32))
+    paddle.exp(x)
+    m = warmup.manifest()
+    ops = [e for e in m["entries"] if e["kind"] == "op"]
+    assert ops
+    bad = json.loads(json.dumps(m))
+    for e in bad["entries"]:
+        if e.get("impl") and e["impl"].get("code"):
+            e["impl"]["code"]["line"] = 999999  # source drifted
+        elif e.get("impl"):
+            e["impl"] = {"module": "paddle_tpu", "attr": "no_such_attr"}
+    stats = warmup.precompile(bad)
+    assert stats["ops_precompiled"] == 0
+    assert resilience.fault_events()["stale_manifests"] >= 1
+
+
+# ---- corrupt compile-cache entry tolerated --------------------------------
+
+def test_corrupt_cache_entry_tolerated(tmp_path):
+    """A corrupted on-disk cache file must degrade to a fresh compile
+    with a compile_cache_errors fault event and correct numerics."""
+    import jax
+
+    cfg = warmup.configure_compile_cache(cache_dir=str(tmp_path / "cache"),
+                                         min_compile_secs=0.0)
+    assert cfg and cfg["cache_dir"] == str(tmp_path / "cache")
+    try:
+        x = _t(np.linspace(-1, 1, 32).astype(np.float32))
+        ref = np.asarray(paddle.tanh(x)._value)
+        cache_files = [f for f in os.listdir(cfg["cache_dir"])
+                       if f.endswith("-cache")]
+        assert cache_files, "no cache entries written"
+        for f in cache_files:
+            resilience.corrupt_file(os.path.join(cfg["cache_dir"], f))
+        # drop in-memory executables so the next call re-reads the disk
+        dispatch.reset_dispatch_stats(clear_caches=True)
+        jax.clear_caches()
+        out = np.asarray(paddle.tanh(x)._value)
+        np.testing.assert_allclose(out, ref, rtol=1e-6)
+        assert resilience.fault_events()["compile_cache_errors"] >= 1
+    finally:
+        jax.config.update("jax_compilation_cache_dir", None)
+
+
+# ---- whole-step programs --------------------------------------------------
+
+def _tiny_model():
+    paddle.seed(0)
+    m = paddle.Model(nn.Sequential(nn.Linear(8, 16), nn.ReLU(),
+                                   nn.Linear(16, 4)))
+    m.prepare(paddle.optimizer.Adam(parameters=m.parameters()),
+              nn.CrossEntropyLoss())
+    return m
+
+
+def test_hapi_warm_start_from_recorded_manifest():
+    rng = np.random.RandomState(0)
+    x = rng.randn(16, 8).astype(np.float32)
+    y = rng.randint(0, 4, (16, 1)).astype(np.int64)
+    m1 = _tiny_model()
+    loss1 = m1.train_batch([x], [y])
+    m1.eval_batch([x], [y])
+    doc = warmup.manifest()
+    names = {e["name"] for e in doc["entries"] if e["kind"] == "program"}
+    assert {"hapi.train_step", "hapi.eval_step"} <= names
+
+    m2 = _tiny_model()
+    stats = m2.warm_start(doc)
+    assert stats["train"] == 1 and stats["eval"] == 1
+    loss2 = m2.train_batch([x], [y])
+    np.testing.assert_allclose(loss1, loss2, rtol=1e-6)
+
+
+def test_hapi_warm_start_stale_model_degrades():
+    """A manifest recorded for a different architecture must degrade to
+    a stale_manifests fault event, not an exception."""
+    rng = np.random.RandomState(0)
+    x = rng.randn(16, 8).astype(np.float32)
+    y = rng.randint(0, 4, (16, 1)).astype(np.int64)
+    m1 = _tiny_model()
+    m1.train_batch([x], [y])
+    doc = warmup.manifest()
+
+    paddle.seed(0)
+    other = paddle.Model(nn.Sequential(nn.Linear(3, 5), nn.Linear(5, 2)))
+    other.prepare(paddle.optimizer.Adam(parameters=other.parameters()),
+                  nn.CrossEntropyLoss())
+    stats = other.warm_start(doc)
+    assert stats["train"] == 0
+    assert resilience.fault_events()["stale_manifests"] >= 1
+
+
+def test_optimizer_warm_start_self_derived():
+    """warm_start with no manifest AOT-compiles the fused step from the
+    live params; the first real step then reuses the built entry."""
+    rng = np.random.RandomState(0)
+    w = _t(rng.randn(8, 4).astype(np.float32), stop_gradient=False)
+    opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=[w])
+    assert opt.warm_start() >= 1
+    assert len(opt._step_fn_cache) == 1
+    loss = (w * w).sum()
+    loss.backward()
+    opt.step()
+    opt.clear_grad()
+    assert len(opt._step_fn_cache) == 1  # same entry, no rebuild
+
+
+# ---- observability --------------------------------------------------------
+
+def test_dispatch_stats_compile_section():
+    x = _t(np.ones((4, 8), np.float32))
+    paddle.add(x, x)
+    comp = dispatch.dispatch_stats()["compile"]
+    for k in ("fresh_compiles", "disk_cache_hits", "backend_compile_s",
+              "compile_time_saved_s", "per_op_compile_s",
+              "program_compile_s", "total_op_compile_s",
+              "time_to_first_step_s", "manifest_records",
+              "precompiled_ops", "precompiled_programs"):
+        assert k in comp, k
+    assert comp["per_op_compile_s"].get("add", 0) > 0
+    assert comp["total_op_compile_s"] > 0
+    assert "eager_op" in comp["time_to_first_step_s"]
+    per_op = dispatch.dispatch_stats()["per_op"]["add"]
+    assert per_op["compile_s"] > 0
+
+
+def test_first_step_latch_and_reset():
+    warmup.reset_first_step()
+    assert warmup.time_to_first_step() == {}
+    x = _t(np.ones((4,), np.float32))
+    paddle.exp(x)
+    t1 = warmup.time_to_first_step()["eager_op"]
+    paddle.exp(x)
+    assert warmup.time_to_first_step()["eager_op"] == t1  # latched
+
+
+def test_profiler_summary_prints_compile_line(capsys):
+    x = _t(np.ones((4, 8), np.float32))
+    paddle.add(x, x)
+    import paddle_tpu.profiler as prof
+
+    prof.Profiler().summary()
+    out = capsys.readouterr().out
+    assert "compile:" in out and "fresh" in out
+    assert "time-to-first-step" in out
+
+
+def test_precompiled_entries_survive_into_next_manifest():
+    """A warm process must carry the entries it precompiled forward into
+    its own manifest — otherwise the exit-time save would shrink the
+    manifest to only fresh compiles and warm-start would decay to cold
+    within two generations."""
+    x = _t(np.ones((4, 8), np.float32))
+    paddle.add(x, x)
+    paddle.tanh(x)
+    doc_a = warmup.manifest()
+    n_a = len(doc_a["entries"])
+    assert n_a >= 2
+
+    # simulate the next process: cold caches, empty recorder
+    dispatch.reset_dispatch_stats(clear_caches=True)
+    warmup.reset_manifest_records()
+    assert len(warmup.manifest()["entries"]) == 0
+    stats = warmup.precompile(doc_a)
+    assert stats["ops_precompiled"] == n_a
+    # the warm process re-runs the ops (all hits: no record_op fires)
+    paddle.add(x, x)
+    paddle.tanh(x)
+    doc_b = warmup.manifest()
+    assert len(doc_b["entries"]) == n_a  # nothing lost
+
+
+def test_save_load_manifest_roundtrip(tmp_path):
+    x = _t(np.ones((4,), np.float32))
+    paddle.tanh(x)
+    p = str(tmp_path / "m.json")
+    assert warmup.save_manifest(p) == p
+    doc = warmup.load_manifest(p)
+    assert doc is not None
+    assert any(e["kind"] == "op" for e in doc["entries"])
+
+
+# ---- the acceptance round trip (two fresh processes) ----------------------
+
+def test_warm_start_round_trip_zero_fresh_compiles(tmp_path):
+    """ISSUE acceptance: process A records (shape manifest + persistent
+    cache); process B precompiles the manifest and performs ZERO fresh
+    XLA compiles for the whole workload, serving every recorded per-op
+    signature without a single dispatch miss."""
+    child = os.path.join(os.path.dirname(__file__), "_warmup_child.py")
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "PADDLE_TPU_COMPILE_CACHE_DIR": str(tmp_path / "cache"),
+        "PADDLE_TPU_COMPILE_CACHE_MIN_COMPILE_S": "0",
+        "WARMUP_MANIFEST": str(tmp_path / "manifest.json"),
+    })
+
+    def run(mode):
+        proc = subprocess.run([sys.executable, child, mode], env=env,
+                              capture_output=True, text=True, timeout=240)
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        return json.loads(proc.stdout.strip().splitlines()[-1])
+
+    a = run("record")
+    assert a["fresh_compiles"] > 0          # cold: XLA actually paid
+    assert a["manifest_records"] > 0
+    assert os.path.exists(env["WARMUP_MANIFEST"])
+
+    b = run("replay")
+    assert b["precompile"]["ops_precompiled"] > 0
+    assert b["outs"] == a["outs"]           # numerically identical
+    assert b["disk_cache_hits"] > 0         # served from the disk cache
+    assert b["fresh_compiles"] == 0, b      # THE acceptance criterion
+    assert b["forward_misses"] == 0, b      # every eager op pre-warmed
+    assert b["time_to_first_step"]["eager_op"] <= \
+        a["time_to_first_step"]["eager_op"] * 5  # sanity, not a perf gate

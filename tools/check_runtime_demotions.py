@@ -1,0 +1,114 @@
+#!/usr/bin/env python
+"""CI gate (tools/ci_check.sh): no runtime-learned unjittable demotions
+on a representative eager workload.
+
+The dispatch layer demotes an op to permanent eager execution when its
+jit probe fails at runtime — paying one failed XLA compile first. Every
+such demotion in library code is a gap in tracelint's static analysis:
+the op should either be fixed, decorated ``@non_jittable``, or proven
+unsafe by a rule so the static unjittable manifest preloads it for
+free. This script sweeps the common eager op surface and fails if
+``dispatch_stats()["unjittable"]["runtime_learned"]`` is non-zero,
+naming the ops.
+
+Usage: JAX_PLATFORMS=cpu python tools/check_runtime_demotions.py
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _workload():
+    """Representative slice of the eager op surface: math, reductions,
+    shaping, indexing, activations, norm layers, a small train loop —
+    the ops a dygraph user hits, each dispatched enough times to pass
+    the warm gate and compile."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu import nn
+    from paddle_tpu.core import dispatch
+
+    dispatch.set_warmup_count(1)
+    paddle.seed(0)
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.randn(8, 16).astype(np.float32))
+    y = paddle.to_tensor(rng.randn(8, 16).astype(np.float32))
+    idx = paddle.to_tensor(np.arange(8, dtype=np.int64))
+
+    for _ in range(2):
+        paddle.add(x, y)
+        paddle.multiply(x, y)
+        paddle.matmul(x, y, transpose_y=True)
+        paddle.sum(x, axis=1)
+        paddle.mean(x)
+        paddle.max(x, axis=0)
+        paddle.reshape(x, [4, 32])
+        paddle.transpose(x, [1, 0])
+        paddle.concat([x, y], axis=0)
+        paddle.stack([x, y])
+        paddle.split(x, 2, axis=0)
+        paddle.squeeze(paddle.unsqueeze(x, 0), 0)
+        paddle.gather(x, idx)
+        x[2:5]
+        x[:, 3]
+        F.relu(x)
+        F.softmax(x, axis=-1)
+        F.gelu(x)
+        paddle.tanh(x)
+        paddle.exp(x)
+        paddle.clip(x, -1.0, 1.0)
+        F.dropout(x, p=0.5)  # bypass (PRNG capture), never a demotion
+        paddle.where(x > 0, x, y)
+        paddle.cast(x, "bfloat16")
+
+    # norm layers carry buffers + training-mode branches
+    bn = nn.BatchNorm1D(16)
+    ln = nn.LayerNorm(16)
+    for _ in range(2):
+        bn(x)
+        ln(x)
+
+    # eager train loop: backward pullbacks + fused optimizer step
+    w = paddle.to_tensor(rng.randn(16, 4).astype(np.float32),
+                         stop_gradient=False)
+    b = paddle.to_tensor(np.zeros(4, np.float32), stop_gradient=False)
+    opt = paddle.optimizer.Adam(learning_rate=1e-3, parameters=[w, b])
+    for _ in range(3):
+        out = F.relu(paddle.matmul(x, w) + b)
+        loss = (out * out).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+
+    return dispatch.dispatch_stats()
+
+
+def main():
+    stats = _workload()
+    uj = stats["unjittable"]
+    learned = uj["runtime_learned"]
+    print(f"unjittable: {uj['total']} total "
+          f"({uj['manifest_preloaded']} manifest-preloaded, "
+          f"{uj['decorated']} decorated, {learned} runtime-learned)")
+    if learned:
+        names = uj.get("runtime_learned_ops") or ["<name lost to reset>"]
+        print(
+            "check_runtime_demotions: FAIL — the dispatch layer learned "
+            f"{learned} unjittable op(s) at runtime that tracelint's "
+            f"static analysis missed: {', '.join(names)}.\n"
+            "Each paid a failed XLA compile probe. Fix the op, decorate "
+            "it @non_jittable, or extend the rule and regenerate the "
+            "static manifest:\n"
+            "    python -m tools.tracelint paddle_tpu --emit-manifest",
+            file=sys.stderr)
+        raise SystemExit(1)
+    print("check_runtime_demotions: OK (no runtime-learned demotions)")
+
+
+if __name__ == "__main__":
+    main()

@@ -27,4 +27,14 @@ JAX_PLATFORMS=cpu python -m pytest tests/test_resilience.py -q \
     -k "not kill9_mid_async" \
     -p no:cacheprovider -p no:xdist -p no:randomly
 
+echo "== runtime-learned demotions vs the static unjittable manifest =="
+# a demotion the dispatch layer learns at runtime is a tracelint rule
+# gap — fails with the op names and a manifest-regenerate hint
+JAX_PLATFORMS=cpu python tools/check_runtime_demotions.py
+
+echo "== warm-start smoke (persistent compile cache + shape manifest) =="
+# two subprocesses share a temp cache dir: the second must load from
+# disk (hits > 0) and perform ZERO fresh XLA compiles
+JAX_PLATFORMS=cpu python tools/warmstart_smoke.py
+
 echo "ci_check: OK"

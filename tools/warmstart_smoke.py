@@ -1,0 +1,136 @@
+#!/usr/bin/env python
+"""Warm-start smoke (tools/ci_check.sh): two fresh processes sharing a
+persistent compile-cache dir + shape manifest prove the round trip on
+CPU in a few seconds.
+
+Pass A (cold): runs a tiny eager workload + fused optimizer step with
+``PADDLE_TPU_COMPILE_CACHE_DIR`` set, saves the shape manifest, and
+must report fresh XLA compiles (it is doing the work).
+
+Pass B (warm): precompiles the manifest, runs the same workload, and
+must report ``disk_cache_hits > 0`` and **zero fresh XLA compiles** —
+the warm-start acceptance: every executable came from disk, every
+recorded per-op signature was served from the precompiled dispatch
+cache.
+
+Usage: python tools/warmstart_smoke.py            (orchestrates both)
+       python tools/warmstart_smoke.py --pass a|b (one child pass)
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _workload(warm=False):
+    """Deterministic eager ops + a fused SGD step; identical across
+    passes so every compiled program in B was cached by A. With
+    `warm`, the optimizer drains its recorded fused-step signature
+    through its owner warmup hook before the first real step."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.core import dispatch
+
+    dispatch.set_warmup_count(1)  # compile on first sighting
+    paddle.seed(0)
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.randn(8, 16).astype(np.float32))
+    w = paddle.to_tensor(rng.randn(16, 4).astype(np.float32),
+                         stop_gradient=False)
+    b = paddle.to_tensor(np.zeros(4, np.float32), stop_gradient=False)
+    opt = paddle.optimizer.SGD(learning_rate=0.01, parameters=[w, b])
+    prewarmed = opt.warm_start() if warm else 0
+    losses = []
+    for _ in range(3):
+        h = paddle.tanh(paddle.matmul(x, w) + b)
+        loss = (h * h).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(np.asarray(loss._value)))
+    return losses, prewarmed
+
+
+def _run_pass(which):
+    sys.path.insert(0, REPO)  # child argv[0] lives in tools/
+    from paddle_tpu.core import dispatch
+    from paddle_tpu.runtime import warmup
+
+    manifest_path = os.environ["SMOKE_MANIFEST"]
+    pre = None
+    if which == "b":
+        pre = warmup.precompile(manifest_path)
+    losses, prewarmed = _workload(warm=which == "b")
+    if which == "a":
+        warmup.save_manifest(manifest_path)
+    comp = dispatch.dispatch_stats()["compile"]
+    out = {"losses": losses,
+           "fresh_compiles": comp["fresh_compiles"],
+           "disk_cache_hits": comp["disk_cache_hits"],
+           "backend_compile_s": comp["backend_compile_s"]}
+    if which == "b":
+        out["precompile"] = pre
+        out["prewarmed_programs"] = prewarmed
+        out["forward_misses"] = dispatch.dispatch_stats()["forward"]["misses"]
+    print(json.dumps(out))
+
+
+def main():
+    tmp = tempfile.mkdtemp(prefix="warmstart_smoke_")
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "PADDLE_TPU_COMPILE_CACHE_DIR": os.path.join(tmp, "cache"),
+        "PADDLE_TPU_COMPILE_CACHE_MIN_COMPILE_S": "0",
+        "SMOKE_MANIFEST": os.path.join(tmp, "manifest.json"),
+    })
+
+    def run(which):
+        p = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--pass", which],
+            cwd=REPO, env=env, capture_output=True, text=True, timeout=300)
+        if p.returncode != 0:
+            print(p.stdout)
+            print(p.stderr, file=sys.stderr)
+            raise SystemExit(f"warmstart_smoke: pass {which} failed "
+                             f"(rc={p.returncode})")
+        return json.loads(p.stdout.strip().splitlines()[-1])
+
+    a = run("a")
+    b = run("b")
+    print(f"pass A (cold): {a['fresh_compiles']} fresh compiles "
+          f"({a['backend_compile_s']:.2f}s), "
+          f"{a['disk_cache_hits']} disk hits")
+    print(f"pass B (warm): {b['fresh_compiles']} fresh compiles, "
+          f"{b['disk_cache_hits']} disk hits, "
+          f"precompiled {b['precompile']['ops_precompiled']} ops + "
+          f"{b['prewarmed_programs']} fused-step sigs")
+    if b["prewarmed_programs"] < 1:
+        raise SystemExit("warmstart_smoke: the optimizer warm_start hook "
+                         "drained no recorded fused-step signature")
+    if a["fresh_compiles"] == 0:
+        raise SystemExit("warmstart_smoke: cold pass compiled nothing — "
+                         "the workload no longer exercises the cache")
+    if a["losses"] != b["losses"]:
+        raise SystemExit("warmstart_smoke: warm pass diverged numerically")
+    if b["disk_cache_hits"] == 0:
+        raise SystemExit("warmstart_smoke: second pass loaded nothing from "
+                         "the persistent compile cache")
+    if b["fresh_compiles"] != 0:
+        raise SystemExit(
+            f"warmstart_smoke: warm pass paid {b['fresh_compiles']} fresh "
+            "XLA compiles — the cache key or manifest replay regressed")
+    print("warmstart_smoke: OK (zero fresh compiles on the warm pass)")
+
+
+if __name__ == "__main__":
+    if len(sys.argv) > 2 and sys.argv[1] == "--pass":
+        _run_pass(sys.argv[2])
+    else:
+        main()

@@ -883,6 +883,88 @@ def _compile_snapshot():
         return None
 
 
+def _dispatch_snapshot():
+    """dispatch_stats() for per-config op-level attribution, or None
+    when paddle_tpu is not importable in this child."""
+    try:
+        from paddle_tpu.core import dispatch
+
+        return dispatch.dispatch_stats()
+    except Exception:  # noqa: BLE001
+        return None
+
+
+def _dispatch_delta(res, name, before, after):
+    """Op-level evidence per config in the BENCH_*.json trajectory:
+    forward hit/miss deltas (hit-rate regressions in the dispatch layer
+    become visible round-over-round, not just aggregate wall clock) and
+    the hottest ops with their sampled run-time attribution. A config
+    that reset the counters itself (eager_dispatch) is detected by the
+    stats generation (negative deltas alone would miss a reset whose
+    post-reset traffic exceeds the pre-reset totals) and falls back to
+    the absolute after-run numbers."""
+    if not (before and after):
+        return
+    fwd_b, fwd_a = before["forward"], after["forward"]
+    d_hits = fwd_a["hits"] - fwd_b["hits"]
+    d_miss = fwd_a["misses"] - fwd_b["misses"]
+    per_b = before.get("per_op") or {}
+    if (before.get("stats_generation") != after.get("stats_generation")
+            or d_hits < 0 or d_miss < 0):
+        d_hits, d_miss, per_b = fwd_a["hits"], fwd_a["misses"], {}
+    total = d_hits + d_miss
+
+    def _delta_traffic(kv):
+        # rank by THIS config's delta, not cumulative totals: counters
+        # accumulate across configs in one runner process, so absolute
+        # ranking would be dominated by earlier configs' traffic
+        pb = per_b.get(kv[0]) or {}
+        return (kv[1]["hits"] - pb.get("hits", 0)
+                + kv[1]["misses"] - pb.get("misses", 0))
+
+    top_ops = {}
+    for op, s in sorted((after.get("per_op") or {}).items(),
+                        key=lambda kv: -_delta_traffic(kv))[:8]:
+        pb = per_b.get(op) or {}
+        d = {"hits": s["hits"] - pb.get("hits", 0),
+             "misses": s["misses"] - pb.get("misses", 0)}
+        if d["hits"] + d["misses"] <= 0:
+            continue  # no traffic from this config: not its story
+        dr = s.get("run_samples", 0) - pb.get("run_samples", 0)
+        if dr > 0:
+            d["run_samples"] = dr
+            d["run_s"] = round(s.get("run_s", 0.0)
+                               - pb.get("run_s", 0.0), 5)
+        top_ops[op] = d
+    res[name + "_dispatch"] = {
+        "fwd_hits": d_hits, "fwd_misses": d_miss,
+        "hit_rate": round(d_hits / total, 4) if total else None,
+        "top_ops": top_ops,
+    }
+
+
+def _registry_snapshot(max_series=20):
+    """Compact telemetry-registry snapshot, taken ONCE per round (the
+    registry is cumulative over the runner process, so per-config
+    snapshots would overlap and double-count when merged — rounds, by
+    contrast, are separate processes and merge cleanly with
+    telemetry.merge_histograms). Series are capped per family so a
+    label-heavy round cannot bloat the record."""
+    try:
+        from paddle_tpu.runtime import telemetry
+
+        snap = telemetry.snapshot()
+    except Exception:  # noqa: BLE001
+        return None
+    out = {}
+    for mname, fam in snap.items():
+        compact = {"type": fam["type"], "series": fam["series"][:max_series]}
+        if "buckets" in fam:
+            compact["buckets"] = fam["buckets"]
+        out[mname] = compact
+    return out or None
+
+
 def _compile_delta(res, name, before, after):
     """Per-config warm-vs-cold evidence in the BENCH_*.json trajectory:
     seconds of fresh XLA compile the config paid, how many executables
@@ -928,6 +1010,7 @@ def _run_runner(out_dir, config_names, deadline_ts, small_all=False):
         small = small_all or remaining < full_cost_s + 120.0
         _heartbeat(out_dir, {"phase": name, "small": small})
         before = _compile_snapshot()
+        before_ds = _dispatch_snapshot()
         if before is not None:
             try:  # per-config time-to-first-step epoch
                 from paddle_tpu.runtime import warmup
@@ -955,7 +1038,23 @@ def _run_runner(out_dir, config_names, deadline_ts, small_all=False):
             _compile_delta(res, name, before, _compile_snapshot())
         except Exception:  # noqa: BLE001 — metrics must not fail a result
             pass
+        try:
+            # op-level hit rates per config: perf-trajectory rounds
+            # carry the WHY, not just the aggregate wall clock
+            _dispatch_delta(res, name, before_ds, _dispatch_snapshot())
+        except Exception:  # noqa: BLE001 — metrics must not fail a result
+            pass
         _write_out(os.path.join(out_dir, name + ".json"), res)
+    try:
+        # one whole-round registry snapshot (op-run/step-time histograms
+        # over every config this process ran; rounds are separate
+        # processes, so round records merge without double counting)
+        reg = _registry_snapshot()
+        if reg:
+            _write_out(os.path.join(out_dir, "telemetry_registry.json"),
+                       {"telemetry_registry": reg})
+    except Exception:  # noqa: BLE001
+        pass
     _heartbeat(out_dir, {"phase": "done"})
 
 

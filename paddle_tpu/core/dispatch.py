@@ -66,6 +66,7 @@ import jax
 import numpy as np
 
 from . import dtype as _pdtypes
+from ..runtime import telemetry as _telemetry
 from ..runtime import warmup as _warmup
 from ..runtime.resilience import fault_events as _fault_events
 from ..runtime.resilience import record_fault as _record_fault
@@ -74,7 +75,7 @@ __all__ = [
     "run_op", "non_jittable", "dispatch_stats", "reset_dispatch_stats",
     "set_eager_jit", "eager_jit_enabled", "suspend", "set_warmup_count",
     "JitCache", "FORWARD", "BACKWARD", "op_core", "freeze_static", "aval_of",
-    "precompile_op",
+    "precompile_op", "set_op_sample_every",
 ]
 
 
@@ -491,16 +492,68 @@ _counters = {
 
 # per-op-identity record: ident -> [name, hits, misses, retraces,
 #                                    miss_streak, compiled_count, warned,
-#                                    jit_failures, compile_seconds]
+#                                    jit_failures, compile_seconds,
+#                                    sampled_run_seconds, run_samples]
 # (one dict lookup on the hot path; snapshot aggregation happens in
 # dispatch_stats, off the hot path)
 _op_stats = {}
 _op_stats_lock = threading.Lock()
 
 _HITS, _MISSES, _RETRACES, _STREAK, _COMPILED, _WARNED, _JIT_FAILS, \
-    _COMPILE_S = range(1, 9)
+    _COMPILE_S, _RUN_S, _RUN_SAMPLES = range(1, 11)
 
-_BLANK_OP_STATS = [None, 0, 0, 0, 0, 0, False, 0, 0.0]
+_BLANK_OP_STATS = [None, 0, 0, 0, 0, 0, False, 0, 0.0, 0.0, 0]
+
+# per-op RUN-time attribution (telemetry): every Nth cache-hit execution
+# is timed through device completion and fed to the
+# `paddle_tpu_op_run_seconds` histogram + _op_stats. The per-call cost
+# on the hit path is one int truthiness check (N=0: telemetry killed)
+# plus, when armed, a decrement/compare — the telemetry-enabled check
+# and the dict lookups run only on the 1-in-N sampled call.
+_op_sample_every = _telemetry.op_sample_every()
+_op_sample_ctr = [_op_sample_every]
+# the reset stride is dithered by a small rotating offset: a training
+# loop runs a FIXED op sequence per step, so a constant stride whose
+# value divides (or shares a large factor with) the per-step op count
+# phase-locks and samples the same one op forever — the attribution
+# would claim the whole step is that op
+_op_sample_phase = [0]
+
+
+def set_op_sample_every(n):
+    """Sample every Nth cached-op execution for run-time attribution
+    (0 disables; the runtime analogue of PADDLE_TPU_TELEMETRY_OP_SAMPLE)."""
+    global _op_sample_every
+    prev = _op_sample_every
+    _op_sample_every = max(0, int(n))
+    _op_sample_ctr[0] = _op_sample_every or 1
+    _op_sample_phase[0] = 0
+    return prev
+
+
+def _observe_op_run(name, seconds):
+    """One sampled eager-op execution into the telemetry registry (not
+    cached across calls: the registry may be reset by tests; this runs
+    1-in-N, so the family lookup is off the hot path). Guarded: a
+    telemetry bug inside run_op's execution try-block would otherwise
+    be misattributed as an op failure (entry popped, demotion counted)."""
+    try:
+        _telemetry.histogram(
+            "paddle_tpu_op_run_seconds",
+            "sampled eager-op wall time through device completion",
+            ("op",)).labels(op=name).observe(seconds)
+    except Exception:  # noqa: BLE001
+        pass
+
+
+# runtime kill-switch flips re-derive the latched stride (import-time
+# latching alone would keep paying the sampled block_until_ready after
+# set_enabled(False), and could never start after a disabled import).
+# NOTE an explicit set_op_sample_every() is overridden by the next
+# toggle — the switch owns the rate.
+_telemetry.on_enabled_change(
+    lambda on: set_op_sample_every(_telemetry.op_sample_env_rate()
+                                   if on else 0))
 
 
 def _op_stats_entry(name, ident):
@@ -564,7 +617,8 @@ def dispatch_stats():
     fwd = FORWARD.stats()
     fwd.update(_counters)
     blank = {"hits": 0, "misses": 0, "retraces": 0,
-             "cache_entries": 0, "bwd_cache_entries": 0, "compile_s": 0.0}
+             "cache_entries": 0, "bwd_cache_entries": 0, "compile_s": 0.0,
+             "run_s": 0.0, "run_samples": 0}
     per_op = {}
     for ent in list(_op_stats.values()):
         agg = per_op.setdefault(ent[0], dict(blank))
@@ -572,6 +626,8 @@ def dispatch_stats():
         agg["misses"] += ent[_MISSES]
         agg["retraces"] += ent[_RETRACES]
         agg["compile_s"] += ent[_COMPILE_S]
+        agg["run_s"] += ent[_RUN_S]
+        agg["run_samples"] += ent[_RUN_SAMPLES]
     # live compiled-program counts per op: how much of each bounded LRU
     # an op's shape/static churn is occupying right now
     for name, n in FORWARD.sizes_by_tag().items():
@@ -604,6 +660,10 @@ def dispatch_stats():
     return {
         "enabled": _enabled,
         "warmup_count": _warmup_count,
+        # run-time attribution sampling rate (0 = off / telemetry killed)
+        "op_sample_every": _op_sample_every,
+        # changes iff the counters were reset since the last snapshot
+        "stats_generation": _stats_generation[0],
         "forward": fwd,
         "backward": BACKWARD.stats(),
         "per_op": per_op,
@@ -630,9 +690,16 @@ def dispatch_stats():
     }
 
 
+# bumped on every counter reset: delta-takers (bench per-config records)
+# compare generations instead of guessing a reset from negative deltas —
+# post-reset traffic can exceed the pre-reset totals and look positive
+_stats_generation = [0]
+
+
 def reset_dispatch_stats(clear_caches=False):
     """Zero the counters (and optionally drop the compiled programs and
     warm-gate sightings — tests use this for a cold start)."""
+    _stats_generation[0] += 1
     FORWARD.reset_counters()
     BACKWARD.reset_counters()
     for k in _counters:
@@ -763,7 +830,26 @@ def run_op(fn, vals, treedef, fallback, name=None):
             fresh[_COMPILE_S] += time.perf_counter() - t0
             _warmup.record_op(fn, name, treedef, vals,
                               tuple(arr_pos), tuple(avals))
+        elif _op_sample_every and _op_sample_ctr[0] <= 1:
+            # sampled execution: time through device completion (the
+            # block_until_ready is what makes the number a RUN time,
+            # not an async-dispatch time; it runs only on this 1-in-N
+            # call). A reset-orphaned ent just skips attribution.
+            if _op_sample_every > 1:  # rate 1 means EVERY call, undithered
+                _op_sample_phase[0] = (_op_sample_phase[0] + 1) % 7
+            _op_sample_ctr[0] = _op_sample_every + _op_sample_phase[0]
+            t0 = time.perf_counter()
+            out = jitted(*[vals[i] for i in arr_pos])
+            jax.block_until_ready(out)
+            dt = time.perf_counter() - t0
+            ent = _op_stats.get(ident)
+            if ent is not None and _telemetry.enabled():
+                ent[_RUN_S] += dt
+                ent[_RUN_SAMPLES] += 1
+                _observe_op_run(ent[0], dt)
         else:
+            if _op_sample_every:
+                _op_sample_ctr[0] -= 1
             out = jitted(*[vals[i] for i in arr_pos])
         if not _first_exec[0]:
             # local flag, not a warmup call: the hit path runs thousands

@@ -37,6 +37,7 @@ import time
 import warnings
 
 from ..core.dispatch import non_jittable
+from ..runtime import telemetry as _telemetry
 from ..runtime.resilience import (
     BadStepGuard, atomic_write_json, fault_point, record_fault,
 )
@@ -121,6 +122,12 @@ class ElasticManager:
                 f"heartbeat backwards (already at step {self._last_step}) "
                 "— ignoring the stale step", stacklevel=2)
             return False
+        if self._last_step is None:
+            # the liveness transition worth a structured event: the loop
+            # proved alive (per-step heartbeats would just duplicate the
+            # TelemetryCallback train_step records)
+            _telemetry.emit("heartbeat_started", step=step,
+                            path=self._hb_path)
         heartbeat(self._hb_path, step, payload)
         self._last_step = step
         if self.save_fn is not None and self.save_interval and \
@@ -175,6 +182,8 @@ class ElasticManager:
             self.stall_reason = reason
             record_fault("stall_detections", f"{reason} "
                          f"(step {hb.get('step')})")
+            _telemetry.emit("watchdog_stall", reason=reason,
+                            step=hb.get("step"), timeout=self.timeout)
             if on_stall is not None:
                 try:
                     on_stall({**hb, "reason": reason})
@@ -198,11 +207,16 @@ class ElasticManager:
 
         self._watch = threading.Thread(target=_watch, daemon=True)
         self._watch.start()
+        _telemetry.emit("watchdog_start", timeout=self.timeout, poll=poll,
+                        step_deadline=self.step_deadline,
+                        run_deadline=self.run_deadline)
 
     def stop(self):
         self._stop.set()
         if self._watch is not None:
             self._watch.join(timeout=2)
+            _telemetry.emit("watchdog_stop", last_step=self._last_step,
+                            stalled=self.stalled)
 
 
 @non_jittable  # wall-clock liveness math; must never be jit-cached

@@ -2,7 +2,7 @@
 from . import callbacks  # noqa: F401
 from .callbacks import (  # noqa: F401
     Callback, EarlyStopping, LRScheduler, ModelCheckpoint, ProgBarLogger,
-    ReduceLROnPlateau, ResilienceCallback, VisualDL,
+    ReduceLROnPlateau, ResilienceCallback, TelemetryCallback, VisualDL,
 )
 from .model import Model  # noqa: F401
 from .summary import summary  # noqa: F401

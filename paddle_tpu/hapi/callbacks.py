@@ -7,9 +7,11 @@ import time
 
 import numpy as np
 
+from ..runtime import telemetry as _telemetry
+
 __all__ = ["Callback", "CallbackList", "ProgBarLogger", "ModelCheckpoint",
            "LRScheduler", "EarlyStopping", "VisualDL", "ReduceLROnPlateau",
-           "ResilienceCallback", "config_callbacks"]
+           "ResilienceCallback", "TelemetryCallback", "config_callbacks"]
 
 
 class Callback:
@@ -258,33 +260,181 @@ class ReduceLROnPlateau(Callback):
 
 
 class VisualDL(Callback):
-    """Scalar logger (reference integrates visualdl; here: jsonl fallback
-    consumable by tensorboard importers)."""
+    """Scalar logger (reference integrates visualdl; here: jsonl
+    consumable by tensorboard importers) — a thin wrapper over the
+    telemetry scalars sink (`runtime.telemetry.ScalarsSink`), which
+    flushes PER BATCH: the old implementation buffered until
+    `on_train_end`, so a ``kill -9`` mid-run (the exact scenario the
+    resilience runtime hardens) lost the entire log."""
 
     def __init__(self, log_dir="vdl_log"):
         super().__init__()
         self.log_dir = log_dir
-        self._f = None
+        self._sink = None
         self._step = 0
 
     def on_train_begin(self, logs=None):
-        os.makedirs(self.log_dir, exist_ok=True)
-        import json  # noqa: F401
-
-        self._f = open(os.path.join(self.log_dir, "scalars.jsonl"), "a")
+        self._sink = _telemetry.ScalarsSink(self.log_dir)
 
     def on_train_batch_end(self, step, logs=None):
-        import json
-
         self._step += 1
         rec = {k: float(v) for k, v in (logs or {}).items()
                if isinstance(v, numbers.Number)}
-        rec["global_step"] = self._step
-        self._f.write(json.dumps(rec) + "\n")
+        self._sink.write(self._step, rec)
 
     def on_train_end(self, logs=None):
-        if self._f:
-            self._f.close()
+        if self._sink:
+            self._sink.close()
+
+
+class TelemetryCallback(Callback):
+    """Continuous per-step telemetry from `Model.fit`: the producer that
+    gives the metrics registry and event stream their time axis.
+
+        model.fit(data, epochs=2, callbacks=[
+            TelemetryCallback("telemetry_log", export_every=50)])
+
+    Per train batch it records step wall time, throughput
+    (samples/sec), loss, the fused step's global grad norm (when a
+    guard enabled ``engine.want_grad_norm``) and device-memory gauges —
+    into the registry (``paddle_tpu_step_seconds`` histogram,
+    ``paddle_tpu_train_steps_total``, ...), the structured event stream
+    (one ``train_step`` event per batch), and a per-step scalars file
+    (`ScalarsSink`, TensorBoard-consumable). Every `export_every` steps
+    — and at train end — it mirrors the runtime's authoritative
+    snapshots into the registry (`telemetry.sync_runtime_metrics`) and
+    rewrites the Prometheus textfile, so a scraper watching
+    ``metrics.prom`` follows the run live and the exported counters
+    reconcile exactly with ``dispatch_stats()`` / ``fault_events()``.
+
+    With the ``PADDLE_TPU_TELEMETRY=0`` kill switch the callback is
+    inert (no files, no registry traffic).
+    """
+
+    def __init__(self, log_dir=None, export_every=50, step_events=True,
+                 scalars=True, snapshot_jsonl=False):
+        super().__init__()
+        self.log_dir = log_dir
+        self.export_every = max(1, int(export_every))
+        self.step_events = step_events
+        self.scalars = scalars
+        self.snapshot_jsonl = snapshot_jsonl
+        self.global_step = 0
+        self._sink = None
+        self._active = False
+        self._t_last = None
+
+    # registry families are looked up per use (never cached across a
+    # registry reset); the lookup is a dict get under an uncontended lock
+    def _metrics(self):
+        return (
+            _telemetry.counter("paddle_tpu_train_steps_total",
+                               "train batches completed"),
+            _telemetry.histogram("paddle_tpu_step_seconds",
+                                 "train step wall time"),
+            _telemetry.gauge("paddle_tpu_loss", "last train loss"),
+            _telemetry.gauge("paddle_tpu_throughput_samples_per_sec",
+                             "samples/sec over the last step"),
+            _telemetry.gauge("paddle_tpu_grad_norm",
+                             "last global L2 grad norm (when enabled)"),
+        )
+
+    def on_train_begin(self, logs=None):
+        self._active = _telemetry.enabled()
+        if not self._active:
+            return
+        d = self.log_dir
+        try:
+            d = _telemetry.configure(self.log_dir)
+            if d is None:
+                d = _telemetry.configure(self.log_dir or "telemetry_log")
+            if self.scalars:
+                self._sink = _telemetry.ScalarsSink(d)
+        except OSError as e:
+            # telemetry must never kill the training it observes: an
+            # unwritable log dir degrades to registry-only collection
+            self._sink = None
+            import warnings
+
+            warnings.warn(f"paddle_tpu telemetry: cannot write to "
+                          f"{d!r} ({e}) — event stream and "
+                          "file exports disabled for this run", stacklevel=2)
+        self._t_last = time.perf_counter()
+        _telemetry.emit("train_begin", epochs=self.params.get("epochs"),
+                        steps=self.params.get("steps"),
+                        batch_size=self.params.get("batch_size"))
+
+    def on_train_batch_end(self, step, logs=None):
+        if not self._active:
+            return
+        now = time.perf_counter()
+        dt = now - (self._t_last if self._t_last is not None else now)
+        self._t_last = now
+        self.global_step += 1
+        logs = logs or {}
+        loss = logs.get("loss")
+        if isinstance(loss, (list, tuple)):
+            loss = loss[0] if loss else None
+        batch_size = logs.get("batch_size") or \
+            self.params.get("batch_size") or 0
+        throughput = (batch_size / dt) if dt > 0 and batch_size else None
+        gnorm = getattr(getattr(self.model, "_engine", None),
+                        "last_grad_norm", None)
+        if gnorm is not None:
+            try:
+                gnorm = float(np.asarray(gnorm))
+            except Exception:  # noqa: BLE001 — unreadable device value
+                gnorm = None
+        steps_c, step_h, loss_g, thr_g, gn_g = self._metrics()
+        steps_c.inc()
+        step_h.observe(dt)
+        if loss is not None:
+            loss_g.set(float(loss))
+        if throughput is not None:
+            thr_g.set(throughput)
+        if gnorm is not None:
+            gn_g.set(gnorm)
+        mem = _telemetry.poll_memory_gauges()
+        rec = {"step": self.global_step, "step_s": round(dt, 6)}
+        if loss is not None:
+            rec["loss"] = float(loss)
+        if throughput is not None:
+            rec["throughput"] = round(throughput, 3)
+        if gnorm is not None:
+            rec["grad_norm"] = gnorm
+        if mem and mem.get("bytes_in_use"):
+            rec["memory_bytes_in_use"] = int(mem["bytes_in_use"])
+        if self.step_events:
+            _telemetry.emit("train_step", **rec)
+        if self._sink is not None:
+            self._sink.write(self.global_step,
+                             {k: v for k, v in rec.items() if k != "step"})
+        if self.global_step % self.export_every == 0:
+            self._export()
+
+    def _export(self):
+        try:
+            _telemetry.sync_runtime_metrics()
+            _telemetry.write_prometheus()
+            if self.snapshot_jsonl:
+                _telemetry.append_snapshot_jsonl(
+                    extra={"step": self.global_step})
+        except Exception as e:  # noqa: BLE001 — a full disk mid-run must
+            # degrade (the run outranks its observability), not abort fit
+            import warnings
+
+            warnings.warn(f"paddle_tpu telemetry: export failed "
+                          f"({type(e).__name__}: {e}) — continuing",
+                          stacklevel=2)
+
+    def on_train_end(self, logs=None):
+        if not self._active:
+            return
+        self._export()
+        _telemetry.emit("train_end", steps=self.global_step)
+        if self._sink is not None:
+            self._sink.close()
+            self._sink = None
 
 
 class ResilienceCallback(Callback):

@@ -41,6 +41,7 @@ from __future__ import annotations
 
 import json
 import os
+import time
 import warnings
 import zlib
 
@@ -48,6 +49,7 @@ import jax
 import numpy as np
 
 from ..core.tensor import Tensor
+from ..runtime import telemetry as _telemetry
 from ..runtime.resilience import (
     IntegrityError, fault_point, record_fault, retry_with_backoff,
     atomic_write_json,
@@ -247,6 +249,7 @@ class CheckpointManager:
         state = _unwrap(state)
         self._flush_manifests()
         manifest = leaf_checksums(state) if self.verify_integrity else None
+        t0 = time.perf_counter()
 
         def _do_save():
             fault_point("checkpoint.save", step=step,
@@ -262,12 +265,14 @@ class CheckpointManager:
         except Exception as e:  # noqa: BLE001 — degrade, never kill training
             record_fault("save_failures",
                          f"step {step}: {type(e).__name__}: {e}")
+            self._note_save(step, time.perf_counter() - t0, accepted=False)
             warnings.warn(
                 f"paddle_tpu checkpoint: save of step {step} failed after "
                 f"{self.retry_attempts} attempts ({type(e).__name__}: {e}) "
                 "— training continues from the previous checkpoint",
                 stacklevel=2)
             return False
+        self._note_save(step, time.perf_counter() - t0, accepted=accepted)
         if accepted and manifest is not None:
             self._pending_manifests[step] = manifest
         # the kill-mid-async-save injection site: at this point the save
@@ -275,6 +280,39 @@ class CheckpointManager:
         fault_point("checkpoint.async_started", step=step,
                     directory=self.directory)
         return accepted
+
+    def _note_save(self, step, seconds, accepted):
+        """Telemetry: one save attempt's duration (enqueue time for an
+        async manager — the commit happens in the background; wait()
+        durations bound the rest) as a structured event + histogram.
+        Guarded: a telemetry error (registration clash) must never be
+        mistaken for — or turn into — a checkpoint failure."""
+        try:
+            _telemetry.emit("checkpoint_save", step=step,
+                            seconds=round(seconds, 6),
+                            accepted=bool(accepted))
+            _telemetry.histogram(
+                "paddle_tpu_checkpoint_save_seconds",
+                "checkpoint save call duration (enqueue, for async saves)"
+            ).observe(seconds)
+        except Exception:  # noqa: BLE001
+            pass
+
+    @staticmethod
+    def _note_restore(step, seconds, fell_back):
+        """Telemetry for a SUCCESSFUL restore. Guarded — and called
+        outside the per-step fallback try-block: an exception here
+        would otherwise convict the good restore it is reporting and
+        fall back to an older checkpoint."""
+        try:
+            _telemetry.emit("checkpoint_restore", step=step,
+                            seconds=round(seconds, 6), fell_back=fell_back)
+            _telemetry.histogram(
+                "paddle_tpu_checkpoint_restore_seconds",
+                "checkpoint restore duration (incl. fallbacks)"
+            ).observe(seconds)
+        except Exception:  # noqa: BLE001
+            pass
 
     # -- restore ------------------------------------------------------------
     def restore(self, step=None, target=None, strict=False):
@@ -302,6 +340,7 @@ class CheckpointManager:
         # the item type from (target=None restores as saved, host np)
         args = self._ocp.args.StandardRestore(target)
         first_error = None
+        t0 = time.perf_counter()
         for s in reversed(steps):
             try:
                 restored = retry_with_backoff(
@@ -311,6 +350,8 @@ class CheckpointManager:
                     counter="restore_retries",
                     describe=f"checkpoint restore step {s}")
                 self.last_restored_step = s
+                self._note_restore(s, time.perf_counter() - t0,
+                                   fell_back=s != steps[-1])
                 return restored
             except (KeyboardInterrupt, SystemExit):
                 raise

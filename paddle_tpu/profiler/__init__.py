@@ -272,8 +272,50 @@ class Profiler:
             # stall, eager demotion) shows up here
             print("fault events: "
                   + ", ".join(f"{k}: {v}" for k, v in sorted(fe.items())))
+        self._telemetry_summary(op_detail)
         if self._dir:
             print(f"trace artifacts: {self._dir}")
+
+    @staticmethod
+    def _telemetry_summary(op_detail):
+        """One registry-backed section: the continuous-telemetry view
+        (step-time distribution, per-op run attribution, export paths)
+        that the snapshot sections above cannot provide."""
+        from ..runtime import telemetry as _t
+
+        if not _t.enabled():
+            print("telemetry: disabled (PADDLE_TPU_TELEMETRY=0)")
+            return
+        snap = _t.snapshot()
+        stream = _t.event_stream()
+        parts = []
+        steps = snap.get("paddle_tpu_train_steps_total")
+        if steps and steps["series"]:
+            parts.append(f"{int(steps['series'][0]['value'])} steps")
+        hist = snap.get("paddle_tpu_step_seconds")
+        if hist and hist["series"]:
+            s = hist["series"][0]
+            if s["count"]:
+                parts.append(
+                    f"step avg {s['sum'] / s['count'] * 1e3:.1f}ms")
+        if stream is not None:
+            parts.append(f"{stream.emitted} events -> {stream.path}")
+        if not parts and not snap:
+            return  # nothing registered and no stream: stay quiet
+        print("telemetry: " + (", ".join(parts) if parts
+                               else f"{len(snap)} metric families"))
+        runh = snap.get("paddle_tpu_op_run_seconds")
+        if op_detail and runh and runh["series"]:
+            # sampled per-op RUN time (device-complete wall time), the
+            # attribution dimension compile_s cannot see
+            top = sorted(runh["series"],
+                         key=lambda s: -(s["sum"] / s["count"]
+                                         if s["count"] else 0.0))[:5]
+            print("  run-time-heavy ops (sampled avg): "
+                  + ", ".join(
+                      f"{s['labels'].get('op')}: "
+                      f"{s['sum'] / s['count'] * 1e3:.2f}ms"
+                      for s in top if s["count"]))
 
     def export(self, path=None, format="json"):
         """The jax trace directory holds the exported artifacts."""

@@ -1,12 +1,15 @@
 """paddle_tpu.runtime — host-side runtime services around the compute
 path: staging buffers (`staging`), HBM stats (`memory`), the
-fault-tolerance substrate (`resilience`), and the warm-start subsystem
-(`warmup`: persistent compile cache + shape-manifest AOT precompile).
+fault-tolerance substrate (`resilience`), the warm-start subsystem
+(`warmup`: persistent compile cache + shape-manifest AOT precompile),
+and the unified telemetry layer (`telemetry`: metrics registry +
+structured event stream + exporters).
 
-Only `resilience` is imported eagerly (stdlib+numpy, cheap, and
-`core.dispatch` depends on it); `warmup` loads with the dispatch layer,
-`memory`/`staging` stay import-on-use.
+Only `telemetry` and `resilience` are imported eagerly (stdlib[+numpy],
+cheap, and `core.dispatch` depends on both); `warmup` loads with the
+dispatch layer, `memory`/`staging` stay import-on-use.
 """
+from . import telemetry  # noqa: F401
 from . import resilience  # noqa: F401
 
-__all__ = ["resilience", "warmup", "memory", "staging"]
+__all__ = ["telemetry", "resilience", "warmup", "memory", "staging"]

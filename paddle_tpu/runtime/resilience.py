@@ -50,6 +50,8 @@ import warnings
 
 import numpy as np
 
+from . import telemetry as _telemetry
+
 __all__ = [
     "fault_events", "fault_log", "record_fault", "reset_fault_events",
     "retry_with_backoff", "FaultInjector", "fault_point", "InjectedFault",
@@ -89,11 +91,15 @@ _event_log = collections.deque(maxlen=256)
 
 
 def record_fault(kind, detail=None):
-    """Count one fault event; returns the new count for `kind`."""
+    """Count one fault event; returns the new count for `kind`. Each
+    fault also lands in the telemetry event stream (when configured) so
+    a degradation can be correlated, post-hoc, with the training step
+    that caused it — the counter alone has no time axis."""
     with _events_lock:
         n = _events.get(kind, 0) + 1
         _events[kind] = n
         _event_log.append((time.time(), kind, detail))
+    _telemetry.emit("fault", fault=kind, detail=detail, count=n)
     return n
 
 

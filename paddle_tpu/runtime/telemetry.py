@@ -1,0 +1,904 @@
+"""Unified telemetry: metrics registry, structured event stream, and
+exporters.
+
+Until this module existed the runtime's observability was three
+parallel *point-in-time* snapshot dicts — `dispatch_stats()`,
+`fault_events()`, and the warm-start compile metrics — readable only by
+`profiler.summary` in the live process, with no time axis, no export
+path, and no way to correlate a fault event with the step that caused
+it. A production jax_graft stack (heavy traffic, long runs, multihost)
+needs the telemetry layer TVM-style compiler stacks and the LazyTensor
+eager/compiled hybrid both lean on: continuous per-op and per-step
+measurements that survive the process and feed dashboards, so a
+regression in the dispatch or warm-start layers is caught from the
+metrics stream rather than an ad-hoc bench run.
+
+Three pieces, one kill switch (``PADDLE_TPU_TELEMETRY=0`` disables all
+ambient collection; explicitly constructed sinks keep working):
+
+* **Metrics registry** — process-wide counters, gauges and fixed-bucket
+  histograms, all label-capable and mergeable across processes
+  (`merge_histograms`). The hot path is one module-global truthiness
+  check plus one uncontended lock acquire; series materialize lazily
+  per label set. `sync_runtime_metrics()` mirrors the existing
+  authoritative snapshots (`dispatch_stats()`, `fault_events()`,
+  compile metrics, HBM stats) into the registry — the snapshots stay
+  the single source of truth, the registry is the exported view, so
+  the two reconcile *exactly* by construction.
+
+* **Structured event stream** — append-only JSONL, one object per
+  event with wall (`ts`) + monotonic (`mono`) timestamps and
+  host/pid tags, flushed per record (a ``kill -9`` loses at most the
+  line being written) and rotated at a byte bound
+  (``PADDLE_TPU_TELEMETRY_EVENTS_MAX_BYTES`` × ``_MAX_FILES``).
+  Producers across the stack emit here: fault events
+  (runtime/resilience.py), watchdog transitions
+  (distributed/elastic.py), checkpoint save/restore durations
+  (io/checkpoint.py), compile/disk-cache activity (runtime/warmup.py),
+  and per-step training records (`hapi.TelemetryCallback`).
+
+* **Exporters** — Prometheus textfile (`write_prometheus`, atomic
+  rename so a node-exporter textfile collector never reads a torn
+  file), registry-snapshot JSONL (`append_snapshot_jsonl`, one
+  snapshot object per line = a poor man's TSDB), and a
+  TensorBoard-consumable per-step scalars sink (`ScalarsSink`, the
+  format `hapi.VisualDL` has always written — that callback is now a
+  thin wrapper over this sink).
+
+`SCHEMA` names every metric and event kind the stack emits;
+tools/telemetry_smoke.py gates it against the checked-in
+tools/telemetry_schema.json so a rename is a deliberate, reviewed act
+(dashboards key on these names).
+
+Import-weight contract: stdlib only at import time (resilience and
+core/dispatch import this module eagerly; jax is only touched inside
+`sync_runtime_metrics`/`poll_memory_gauges`, lazily and guarded).
+Everything here is host-side control plane and must never run under a
+trace — the wall-clock reads are exactly what tracelint TL004 forbids
+in op bodies.
+"""
+from __future__ import annotations
+
+import collections
+import json
+import os
+import socket
+import threading
+import time
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "MetricsRegistry", "registry",
+    "counter", "gauge", "histogram", "snapshot", "reset_metrics",
+    "enabled", "set_enabled",
+    "EventStream", "configure", "event_stream", "emit", "events_path",
+    "read_events",
+    "write_prometheus", "parse_prometheus_textfile",
+    "append_snapshot_jsonl", "ScalarsSink", "merge_histograms",
+    "sync_runtime_metrics", "poll_memory_gauges",
+    "schema", "SCHEMA_VERSION", "EVENT_KINDS",
+    "DEFAULT_BUCKETS", "op_sample_every",
+]
+
+SCHEMA_VERSION = 1
+
+
+def _env_flag(name, default):
+    return os.environ.get(name, default).lower() not in ("0", "false", "no")
+
+
+_enabled = _env_flag("PADDLE_TPU_TELEMETRY", "1")
+
+
+def enabled():
+    return _enabled
+
+
+# listeners for runtime kill-switch flips: consumers that latch a value
+# derived from enabled() (dispatch's sampling stride) re-arm through
+# these rather than paying an enabled() call on their hot path
+_enabled_hooks = []
+
+
+def on_enabled_change(cb):
+    _enabled_hooks.append(cb)
+
+
+def set_enabled(mode):
+    """Runtime analogue of the ``PADDLE_TPU_TELEMETRY`` kill switch:
+    False turns every metric mutation and `emit()` into a no-op (and,
+    via the change hooks, stops the dispatch layer's sampled
+    block_until_ready syncs)."""
+    global _enabled
+    prev = _enabled
+    _enabled = bool(mode)
+    if prev != _enabled:
+        for cb in _enabled_hooks:
+            try:
+                cb(_enabled)
+            except Exception:  # noqa: BLE001 — a bad hook can't block
+                pass
+    return prev
+
+
+def op_sample_env_rate():
+    """The env-configured sampling stride, ignoring the kill switch."""
+    try:
+        return max(0, int(os.environ.get("PADDLE_TPU_TELEMETRY_OP_SAMPLE",
+                                         "64")))
+    except ValueError:
+        return 64
+
+
+def op_sample_every():
+    """Per-op run-time attribution rate for the eager dispatch hot path:
+    every Nth cached-op execution is timed (``block_until_ready`` on the
+    sampled call only). 0 disables sampling; the kill switch zeroes it
+    regardless of the env, so a disabled telemetry layer costs the
+    dispatch fast path exactly one falsy int check."""
+    return op_sample_env_rate() if _enabled else 0
+
+
+# ---------------------------------------------------------------------------
+# metrics
+
+# duration-flavored defaults (seconds): sub-ms eager ops through
+# multi-minute restores all land in a real bucket
+DEFAULT_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+                   0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0)
+
+
+class _Series:
+    """One (metric, label values) time series. The mutation hot path is
+    a module-global enabled check + one uncontended lock acquire."""
+
+    __slots__ = ("_lock", "value", "bucket_counts", "sum", "count",
+                 "_bounds")
+
+    def __init__(self, bounds=None):
+        self._lock = threading.Lock()
+        self.value = 0.0
+        self._bounds = bounds
+        if bounds is not None:
+            self.bucket_counts = [0] * (len(bounds) + 1)  # +Inf tail
+            self.sum = 0.0
+            self.count = 0
+
+    def inc(self, n=1):
+        if not _enabled:
+            return
+        with self._lock:
+            self.value += n
+
+    def dec(self, n=1):
+        self.inc(-n)
+
+    def set(self, v):
+        if not _enabled:
+            return
+        with self._lock:
+            self.value = float(v)
+
+    def observe(self, v):
+        if not _enabled:
+            return
+        v = float(v)
+        bounds = self._bounds
+        i = len(bounds)
+        for j, b in enumerate(bounds):  # len(bounds) ~ 16: linear is fine
+            if v <= b:
+                i = j
+                break
+        with self._lock:
+            self.bucket_counts[i] += 1
+            self.sum += v
+            self.count += 1
+
+
+class _Metric:
+    """A named metric family; `labels(**kv)` materializes/returns the
+    series for one label-value combination. A label-less metric IS its
+    own default series (inc/set/observe proxy to it)."""
+
+    kind = None
+
+    def __init__(self, name, help="", labelnames=(), buckets=None):
+        self.name = name
+        self.help = help
+        self.labelnames = tuple(labelnames)
+        self.buckets = tuple(buckets) if buckets is not None else None
+        self._lock = threading.Lock()
+        self._series = {}
+        if not self.labelnames:
+            self._series[()] = _Series(self.buckets)
+
+    def labels(self, *values, **kv):
+        if kv:
+            # strict: a typo'd label kwarg must raise, not silently
+            # aggregate under the value "None" (misattributed series
+            # are worse than a crash in a producer)
+            if sorted(kv) != sorted(self.labelnames):
+                raise ValueError(
+                    f"metric {self.name} takes labels {self.labelnames}, "
+                    f"got {sorted(kv)}")
+            values = tuple(kv[n] for n in self.labelnames)
+        key = tuple(str(v) for v in values)
+        if len(key) != len(self.labelnames):
+            raise ValueError(
+                f"metric {self.name} takes labels {self.labelnames}, "
+                f"got {key}")
+        s = self._series.get(key)
+        if s is None:
+            with self._lock:
+                s = self._series.setdefault(key, _Series(self.buckets))
+        return s
+
+    # label-less convenience: the metric proxies its default series
+    def inc(self, n=1):
+        self._series[()].inc(n)
+
+    def dec(self, n=1):
+        self._series[()].dec(n)
+
+    def set(self, v):
+        self._series[()].set(v)
+
+    def observe(self, v):
+        self._series[()].observe(v)
+
+    def snapshot(self):
+        out = {"type": self.kind, "help": self.help,
+               "labelnames": list(self.labelnames), "series": []}
+        if self.buckets is not None:
+            out["buckets"] = list(self.buckets)
+        with self._lock:
+            items = list(self._series.items())
+        for key, s in items:
+            with s._lock:
+                rec = {"labels": dict(zip(self.labelnames, key))}
+                if self.buckets is None:
+                    rec["value"] = s.value
+                else:
+                    rec.update(bucket_counts=list(s.bucket_counts),
+                               sum=s.sum, count=s.count)
+            out["series"].append(rec)
+        return out
+
+
+class Counter(_Metric):
+    kind = "counter"
+
+
+class Gauge(_Metric):
+    kind = "gauge"
+
+
+class Histogram(_Metric):
+    kind = "histogram"
+
+    def __init__(self, name, help="", labelnames=(),
+                 buckets=DEFAULT_BUCKETS):
+        super().__init__(name, help, labelnames,
+                         buckets=tuple(sorted(buckets)))
+
+
+class MetricsRegistry:
+    """Process-wide named metric families. Registration is idempotent
+    for an identical (name, type) pair — producers in different modules
+    can all declare the metric they feed — and a type clash raises (two
+    subsystems fighting over one name is a bug, not a merge)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics = {}
+
+    def _register(self, cls, name, help, labelnames, **kw):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is not None:
+                if type(m) is not cls:
+                    raise ValueError(
+                        f"metric {name} already registered as "
+                        f"{m.kind}, not {cls.kind}")
+                if m.labelnames != tuple(labelnames):
+                    # a mismatched re-declaration would fail far from
+                    # here (KeyError at observe time) or, for buckets,
+                    # silently misbucket — clash at the declaration site
+                    raise ValueError(
+                        f"metric {name} already registered with labels "
+                        f"{m.labelnames}, not {tuple(labelnames)}")
+                want = kw.get("buckets")
+                if want is not None and m.buckets is not None \
+                        and tuple(sorted(want)) != m.buckets:
+                    raise ValueError(
+                        f"metric {name} already registered with buckets "
+                        f"{m.buckets}")
+                return m
+            m = cls(name, help, labelnames, **kw)
+            self._metrics[name] = m
+            return m
+
+    def counter(self, name, help="", labelnames=()):
+        return self._register(Counter, name, help, labelnames)
+
+    def gauge(self, name, help="", labelnames=()):
+        return self._register(Gauge, name, help, labelnames)
+
+    def histogram(self, name, help="", labelnames=(),
+                  buckets=DEFAULT_BUCKETS):
+        return self._register(Histogram, name, help, labelnames,
+                              buckets=buckets)
+
+    def get(self, name):
+        return self._metrics.get(name)
+
+    def snapshot(self):
+        """{name: family snapshot} — values, labels, histogram buckets."""
+        with self._lock:
+            metrics = list(self._metrics.items())
+        return {name: m.snapshot() for name, m in metrics}
+
+    def reset(self):
+        """Drop every registered family (test isolation)."""
+        with self._lock:
+            self._metrics.clear()
+
+
+_REGISTRY = MetricsRegistry()
+
+
+def registry():
+    return _REGISTRY
+
+
+def counter(name, help="", labelnames=()):
+    return _REGISTRY.counter(name, help, labelnames)
+
+
+def gauge(name, help="", labelnames=()):
+    return _REGISTRY.gauge(name, help, labelnames)
+
+
+def histogram(name, help="", labelnames=(), buckets=DEFAULT_BUCKETS):
+    return _REGISTRY.histogram(name, help, labelnames, buckets)
+
+
+def snapshot():
+    return _REGISTRY.snapshot()
+
+
+def reset_metrics():
+    _REGISTRY.reset()
+
+
+def merge_histograms(snaps):
+    """Merge histogram *series snapshots* (same bucket bounds) from
+    several processes into one: element-wise bucket sums. This is why
+    the buckets are fixed at declaration — mergeability across bench
+    children and multihost ranks."""
+    out = None
+    for s in snaps:
+        if out is None:
+            out = {"bucket_counts": list(s["bucket_counts"]),
+                   "sum": float(s["sum"]), "count": int(s["count"])}
+            continue
+        if len(s["bucket_counts"]) != len(out["bucket_counts"]):
+            raise ValueError("histogram bucket layouts differ; cannot merge")
+        out["bucket_counts"] = [a + b for a, b in
+                                zip(out["bucket_counts"],
+                                    s["bucket_counts"])]
+        out["sum"] += float(s["sum"])
+        out["count"] += int(s["count"])
+    return out or {"bucket_counts": [], "sum": 0.0, "count": 0}
+
+
+# ---------------------------------------------------------------------------
+# structured event stream
+
+def _env_int(name, default):
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        return int(default)
+
+
+class EventStream:
+    """Append-only JSONL event log with bounded rotation.
+
+    Every record carries wall (`ts`, unix seconds) AND monotonic
+    (`mono`) timestamps — wall for cross-host correlation, monotonic
+    for durations that survive NTP steps — plus host/pid tags so
+    multihost runs can interleave their streams. Writes are flushed
+    per record: the PR-3 ``kill -9`` scenario loses at most the line
+    in flight, never the run's history. When the active file exceeds
+    `max_bytes` it rotates (``events.jsonl`` → ``events.jsonl.1`` →
+    ...), keeping `max_files` generations.
+    """
+
+    def __init__(self, path, max_bytes=None, max_files=None):
+        self.path = path
+        self.max_bytes = max_bytes if max_bytes is not None else _env_int(
+            "PADDLE_TPU_TELEMETRY_EVENTS_MAX_BYTES", 8 * 1024 * 1024)
+        self.max_files = max(1, max_files if max_files is not None else
+                             _env_int("PADDLE_TPU_TELEMETRY_EVENTS_MAX_FILES",
+                                      3))
+        self._lock = threading.Lock()
+        d = os.path.dirname(os.path.abspath(path))
+        os.makedirs(d, exist_ok=True)
+        self._f = open(path, "a")
+        self._host = socket.gethostname()
+        self._pid = os.getpid()
+        self.emitted = 0
+
+    def emit(self, kind, **fields):
+        """Append one event. Never raises into the caller — telemetry
+        must not be able to kill the training loop it observes."""
+        rec = {"ts": round(time.time(), 6),
+               "mono": round(time.monotonic(), 6),
+               "host": self._host, "pid": self._pid, "kind": kind}
+        rec.update(fields)
+        try:
+            line = json.dumps(rec, default=str) + "\n"
+        except (TypeError, ValueError):
+            return
+        with self._lock:
+            try:
+                self._f.write(line)
+                self._f.flush()
+                self.emitted += 1
+                if self.max_bytes and self._f.tell() >= self.max_bytes:
+                    self._rotate()
+            except (OSError, ValueError):  # closed file / full disk
+                pass
+
+    def _rotate(self):
+        self._f.close()
+        if self.max_files == 1:
+            self._f = open(self.path, "w")  # single-file bound: truncate
+            return
+        # shift generations up (os.replace clobbers, so the oldest falls
+        # off the end), then start a fresh active file
+        for i in range(self.max_files - 1, 0, -1):
+            src = self.path if i == 1 else f"{self.path}.{i - 1}"
+            try:
+                os.replace(src, f"{self.path}.{i}")
+            except OSError:
+                pass
+        self._f = open(self.path, "a")
+
+    def close(self):
+        with self._lock:
+            try:
+                self._f.close()
+            except OSError:
+                pass
+
+
+_stream = None
+_stream_lock = threading.Lock()
+_config = {"dir": None}
+
+
+def configure(directory=None, max_bytes=None, max_files=None):
+    """Point the global event stream (and default exporter paths) at
+    `directory` (default: ``PADDLE_TPU_TELEMETRY_DIR``). Returns the
+    effective directory, or None when nowhere is configured. Safe to
+    call repeatedly; reconfiguring to a new directory closes the old
+    stream."""
+    global _stream
+    directory = directory or os.environ.get("PADDLE_TPU_TELEMETRY_DIR")
+    if not directory:
+        return None
+    directory = os.path.abspath(directory)
+    with _stream_lock:
+        if _config["dir"] == directory and _stream is not None:
+            # same dir: honor newly requested rotation bounds in place
+            # (an early return that dropped them would let the stream
+            # grow far past the cap the caller just asked for)
+            if max_bytes is not None:
+                _stream.max_bytes = int(max_bytes)
+            if max_files is not None:
+                _stream.max_files = max(1, int(max_files))
+            return directory
+        # open the NEW stream before touching the old one: a failed
+        # reconfigure (unwritable dir) must leave the current stream
+        # live, not leave the process silently emitting into a closed
+        # file for the rest of the run
+        os.makedirs(directory, exist_ok=True)
+        new = EventStream(os.path.join(directory, "events.jsonl"),
+                          max_bytes=max_bytes, max_files=max_files)
+        if _stream is not None:
+            _stream.close()
+        _stream = new
+        _config["dir"] = directory
+    return directory
+
+
+def event_stream():
+    return _stream
+
+
+def telemetry_dir():
+    return _config["dir"]
+
+
+def events_path():
+    return _stream.path if _stream is not None else None
+
+
+def emit(kind, **fields):
+    """Emit one structured event to the global stream. A no-op (one
+    None/flag check) when no stream is configured or the kill switch is
+    off — producers across the stack call this unconditionally."""
+    if _stream is None or not _enabled:
+        return
+    _stream.emit(kind, **fields)
+
+
+def read_events(path=None, include_rotated=True):
+    """Parse events back (oldest first, rotated generations included).
+    Tolerates a torn final line — the kill -9 contract."""
+    path = path or events_path()
+    if path is None:
+        return []
+    paths = []
+    if include_rotated:
+        i = 1
+        while os.path.exists(f"{path}.{i}"):
+            paths.append(f"{path}.{i}")
+            i += 1
+        paths.reverse()
+    paths.append(path)
+    out = []
+    for p in paths:
+        try:
+            with open(p) as f:
+                for line in f:
+                    try:
+                        out.append(json.loads(line))
+                    except ValueError:
+                        continue  # torn tail line
+        except OSError:
+            continue
+    return out
+
+
+# ---------------------------------------------------------------------------
+# exporters
+
+def _escape_label(v):
+    return str(v).replace("\\", r"\\").replace('"', r'\"').replace(
+        "\n", r"\n")
+
+
+def _fmt_labels(labels, extra=()):
+    items = list(labels.items()) + list(extra)
+    if not items:
+        return ""
+    return ("{" + ",".join(f'{k}="{_escape_label(v)}"' for k, v in items)
+            + "}")
+
+
+def _fmt_value(v):
+    v = float(v)
+    if v != v:
+        return "NaN"  # prom exposition spelling; float("NaN") parses back
+    if v in (float("inf"), float("-inf")):
+        return "+Inf" if v > 0 else "-Inf"
+    if v == int(v) and abs(v) < 1e15:
+        return str(int(v))
+    return repr(v)
+
+
+def write_prometheus(path=None, snap=None):
+    """Render the registry in Prometheus text exposition format and
+    write it atomically (tmp + rename — the node-exporter textfile
+    collector convention, so a scraper never reads a torn file).
+    Default path: ``<telemetry dir>/metrics.prom``. Returns the path
+    written, or None when there is nowhere to write."""
+    if path is None:
+        d = _config["dir"]
+        if d is None:
+            return None
+        path = os.path.join(d, "metrics.prom")
+    snap = snap if snap is not None else _REGISTRY.snapshot()
+    lines = []
+    for name in sorted(snap):
+        fam = snap[name]
+        if fam["help"]:
+            lines.append(f"# HELP {name} {fam['help']}")
+        lines.append(f"# TYPE {name} {fam['type']}")
+        for s in fam["series"]:
+            labels = s["labels"]
+            if fam["type"] == "histogram":
+                acc = 0
+                for bound, n in zip(fam["buckets"], s["bucket_counts"]):
+                    acc += n
+                    lines.append(
+                        f"{name}_bucket"
+                        f"{_fmt_labels(labels, [('le', repr(float(bound)))])}"
+                        f" {acc}")
+                acc += s["bucket_counts"][-1]
+                lines.append(
+                    f"{name}_bucket{_fmt_labels(labels, [('le', '+Inf')])}"
+                    f" {acc}")
+                lines.append(
+                    f"{name}_sum{_fmt_labels(labels)} "
+                    f"{_fmt_value(s['sum'])}")
+                lines.append(
+                    f"{name}_count{_fmt_labels(labels)} {s['count']}")
+            else:
+                lines.append(
+                    f"{name}{_fmt_labels(labels)} {_fmt_value(s['value'])}")
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        f.write("\n".join(lines) + "\n")
+    os.replace(tmp, path)
+    return path
+
+
+_PROM_LINE = None  # compiled lazily (stdlib re, parse path only)
+
+
+def parse_prometheus_textfile(path):
+    """Parse a Prometheus textfile back into
+    ``{(name, (sorted label items)): value}`` — the round-trip check
+    tests and tools/telemetry_smoke.py reconcile against. Histogram
+    sample lines parse as their exposition names (``*_bucket`` with an
+    ``le`` label, ``*_sum``, ``*_count``)."""
+    global _PROM_LINE
+    import re
+
+    if _PROM_LINE is None:
+        _PROM_LINE = re.compile(
+            r'^([a-zA-Z_:][a-zA-Z0-9_:]*)(?:\{(.*)\})?\s+(\S+)$')
+    out = {}
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            m = _PROM_LINE.match(line)
+            if not m:
+                continue
+            name, raw_labels, val = m.groups()
+            labels = []
+            if raw_labels:
+                unesc = {'"': '"', "\\": "\\", "n": "\n"}
+                for k, v in re.findall(
+                        r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"',
+                        raw_labels):
+                    # left-to-right unescape (sequential str.replace
+                    # corrupts a literal backslash followed by 'n')
+                    labels.append((k, re.sub(
+                        r'\\(["\\n])',
+                        lambda m2: unesc[m2.group(1)], v)))
+            out[(name, tuple(sorted(labels)))] = float(val)
+    return out
+
+
+def append_snapshot_jsonl(path=None, extra=None):
+    """Append one full registry snapshot (plus wall/mono timestamps) as
+    a JSON line — a dashboardable time series of process metrics.
+    Default path: ``<telemetry dir>/metrics.jsonl``."""
+    if path is None:
+        d = _config["dir"]
+        if d is None:
+            return None
+        path = os.path.join(d, "metrics.jsonl")
+    rec = {"ts": round(time.time(), 6), "mono": round(time.monotonic(), 6),
+           "metrics": _REGISTRY.snapshot()}
+    if extra:
+        rec.update(extra)
+    with open(path, "a") as f:
+        f.write(json.dumps(rec, default=str) + "\n")
+        f.flush()
+    return path
+
+
+class ScalarsSink:
+    """Per-step scalars as JSONL — the TensorBoard-importable format
+    `hapi.VisualDL` has always produced (one object per step, float
+    values + ``global_step``), now flushed per write so a ``kill -9``
+    mid-run keeps every completed step on disk. Explicitly constructed
+    sinks write regardless of the kill switch: the user asked for this
+    file by name."""
+
+    def __init__(self, log_dir, filename="scalars.jsonl"):
+        os.makedirs(log_dir, exist_ok=True)
+        self.path = os.path.join(log_dir, filename)
+        self._f = open(self.path, "a")
+        self._lock = threading.Lock()
+
+    def write(self, step, scalars):
+        """Append one step record; non-finite/non-numeric values are the
+        caller's problem to filter (floats pass through json as-is)."""
+        rec = dict(scalars)
+        rec["global_step"] = int(step)
+        with self._lock:
+            try:
+                self._f.write(json.dumps(rec) + "\n")
+                self._f.flush()
+            except (OSError, ValueError):
+                pass
+
+    def close(self):
+        with self._lock:
+            try:
+                self._f.close()
+            except OSError:
+                pass
+
+
+# ---------------------------------------------------------------------------
+# runtime bridge: mirror the authoritative snapshots into the registry
+
+def sync_runtime_metrics():
+    """Pull the runtime's authoritative snapshot dicts into the registry
+    so every exporter sees one coherent view: dispatch cache counters
+    (global + per-op), unjittable demotions, warm-start compile
+    counters, time-to-first-step, fault events, and device memory.
+
+    Mirrors are SET to the snapshot value (not incremented): the
+    snapshots remain the single source of truth and the registry
+    reconciles with them exactly at every sync — the acceptance
+    property tools/telemetry_smoke.py asserts. Returns the
+    dispatch_stats() snapshot it mirrored (callers often want it)."""
+    from ..core.dispatch import dispatch_stats
+
+    ds = dispatch_stats()
+    c_hits = counter("paddle_tpu_dispatch_cache_hits_total",
+                     "jit-cache hits", ("cache",))
+    c_miss = counter("paddle_tpu_dispatch_cache_misses_total",
+                     "jit-cache misses", ("cache",))
+    c_evic = counter("paddle_tpu_dispatch_cache_evictions_total",
+                     "jit-cache LRU evictions", ("cache",))
+    g_size = gauge("paddle_tpu_dispatch_cache_size",
+                   "live compiled programs", ("cache",))
+    for which in ("forward", "backward"):
+        s = ds[which]
+        c_hits.labels(cache=which).set(s["hits"])
+        c_miss.labels(cache=which).set(s["misses"])
+        c_evic.labels(cache=which).set(s["evictions"])
+        g_size.labels(cache=which).set(s["size"])
+    fwd = ds["forward"]
+    for key, mname in (
+            ("bypasses", "paddle_tpu_dispatch_bypasses_total"),
+            ("unkeyable", "paddle_tpu_dispatch_unkeyable_total"),
+            ("fallbacks", "paddle_tpu_dispatch_fallbacks_total"),
+            ("warming", "paddle_tpu_dispatch_warming_total"),
+            ("manifest_preloads",
+             "paddle_tpu_dispatch_manifest_preloads_total")):
+        counter(mname, f"forward dispatch {key}").set(fwd[key])
+    op_h = counter("paddle_tpu_op_hits_total", "per-op cache hits", ("op",))
+    op_m = counter("paddle_tpu_op_misses_total", "per-op cache misses",
+                   ("op",))
+    op_r = counter("paddle_tpu_op_retraces_total", "per-op retraces",
+                   ("op",))
+    op_c = counter("paddle_tpu_op_compile_seconds_total",
+                   "per-op XLA compile seconds", ("op",))
+    for op, s in ds["per_op"].items():
+        op_h.labels(op=op).set(s["hits"])
+        op_m.labels(op=op).set(s["misses"])
+        op_r.labels(op=op).set(s["retraces"])
+        if s.get("compile_s"):
+            op_c.labels(op=op).set(s["compile_s"])
+    uj = ds.get("unjittable") or {}
+    g_uj = gauge("paddle_tpu_unjittable_ops",
+                 "ops demoted to plain eager", ("source",))
+    for src in ("decorated", "manifest_preloaded", "runtime_learned"):
+        g_uj.labels(source=src).set(uj.get(src, 0))
+    comp = ds.get("compile") or {}
+    counter("paddle_tpu_compile_fresh_total",
+            "fresh XLA compiles (disk cache missed)").set(
+        comp.get("fresh_compiles", 0))
+    counter("paddle_tpu_compile_disk_cache_hits_total",
+            "executables loaded from the persistent cache").set(
+        comp.get("disk_cache_hits", 0))
+    counter("paddle_tpu_compile_backend_seconds_total",
+            "cumulative backend compile seconds").set(
+        comp.get("backend_compile_s", 0.0))
+    g_tts = gauge("paddle_tpu_time_to_first_step_seconds",
+                  "process start to first compiled step", ("engine",))
+    for kind, v in (comp.get("time_to_first_step_s") or {}).items():
+        g_tts.labels(engine=kind).set(v)
+    c_fault = counter("paddle_tpu_fault_events_total",
+                      "resilience fault events", ("fault",))
+    for kind, n in (ds.get("fault_events") or {}).items():
+        c_fault.labels(fault=kind).set(n)
+    poll_memory_gauges()
+    return ds
+
+
+def poll_memory_gauges(device=None):
+    """Mirror device-memory stats (runtime/memory.py) into gauges —
+    called at step boundaries by `TelemetryCallback` and by every
+    `sync_runtime_metrics`. Degrades to zeros on backends without
+    memory stats (CPU)."""
+    try:
+        from . import memory as _memory
+
+        stats = _memory.memory_stats(device)
+    except Exception:  # noqa: BLE001 — no jax / no backend: stay silent
+        return None
+    g = gauge("paddle_tpu_memory_bytes", "device memory (XLA arena)",
+              ("stat",))
+    for key, stat in (("bytes_in_use", "in_use"),
+                      ("peak_bytes_in_use", "peak_in_use"),
+                      ("bytes_limit", "limit")):
+        if key in stats:
+            g.labels(stat=stat).set(int(stats[key]))
+    return stats
+
+
+# ---------------------------------------------------------------------------
+# schema (gated by tools/telemetry_smoke.py --check-schema)
+
+# every metric family the stack registers, by name. Dashboards and the
+# Prometheus textfile key on these — renaming one is a breaking change
+# and must show up as a reviewed diff of tools/telemetry_schema.json.
+METRIC_NAMES = (
+    "paddle_tpu_dispatch_cache_hits_total",
+    "paddle_tpu_dispatch_cache_misses_total",
+    "paddle_tpu_dispatch_cache_evictions_total",
+    "paddle_tpu_dispatch_cache_size",
+    "paddle_tpu_dispatch_bypasses_total",
+    "paddle_tpu_dispatch_unkeyable_total",
+    "paddle_tpu_dispatch_fallbacks_total",
+    "paddle_tpu_dispatch_warming_total",
+    "paddle_tpu_dispatch_manifest_preloads_total",
+    "paddle_tpu_op_hits_total",
+    "paddle_tpu_op_misses_total",
+    "paddle_tpu_op_retraces_total",
+    "paddle_tpu_op_compile_seconds_total",
+    "paddle_tpu_op_run_seconds",
+    "paddle_tpu_unjittable_ops",
+    "paddle_tpu_compile_fresh_total",
+    "paddle_tpu_compile_disk_cache_hits_total",
+    "paddle_tpu_compile_backend_seconds_total",
+    "paddle_tpu_time_to_first_step_seconds",
+    "paddle_tpu_fault_events_total",
+    "paddle_tpu_memory_bytes",
+    "paddle_tpu_train_steps_total",
+    "paddle_tpu_step_seconds",
+    "paddle_tpu_loss",
+    "paddle_tpu_throughput_samples_per_sec",
+    "paddle_tpu_grad_norm",
+    "paddle_tpu_checkpoint_save_seconds",
+    "paddle_tpu_checkpoint_restore_seconds",
+)
+
+# every event `kind` the stack emits into the structured stream
+EVENT_KINDS = (
+    "train_begin",        # hapi.TelemetryCallback lifecycle
+    "train_step",         # one per train batch (step time, loss, ...)
+    "train_end",
+    "fault",              # every record_fault() (runtime/resilience.py)
+    "checkpoint_save",    # io/checkpoint.py, with duration + step
+    "checkpoint_restore",
+    "watchdog_start",     # distributed/elastic.py transitions
+    "watchdog_stall",
+    "watchdog_stop",
+    "heartbeat_started",  # first tick() of an ElasticManager
+    "compile",            # runtime/warmup.py: one backend compile (or
+    #                       disk load) with its duration
+    "compile_cache_hit",  # persistent-cache disk hit
+    "precompile",         # warm-start AOT precompile summary
+)
+
+
+def schema():
+    """The frozen metric/event vocabulary, as compared against
+    tools/telemetry_schema.json by the CI freshness gate."""
+    return {"version": SCHEMA_VERSION,
+            "metrics": sorted(METRIC_NAMES),
+            "events": sorted(EVENT_KINDS)}
+
+
+# ---------------------------------------------------------------------------
+# process wiring: env-driven auto-config
+
+if os.environ.get("PADDLE_TPU_TELEMETRY_DIR"):
+    try:
+        configure()
+    except Exception:  # pragma: no cover — never break import
+        pass

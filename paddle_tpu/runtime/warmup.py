@@ -60,6 +60,7 @@ import types
 
 import numpy as np
 
+from . import telemetry as _telemetry
 from .resilience import atomic_write_json, record_fault
 
 __all__ = [
@@ -102,6 +103,7 @@ def _on_event(event, **kw):
     if event == "/jax/compilation_cache/cache_hits":
         with _lock:
             _metrics["disk_cache_hits"] += 1
+        _telemetry.emit("compile_cache_hit")
     elif event == "/jax/compilation_cache/compile_requests_use_cache":
         with _lock:
             _metrics["cache_requests"] += 1
@@ -112,6 +114,10 @@ def _on_duration(event, duration, **kw):
         with _lock:
             _metrics["compile_calls"] += 1
             _metrics["backend_compile_s"] += duration
+        # one structured event per executable request (fresh compile OR
+        # disk load — compiles are seconds-rare, so per-event cost is
+        # noise): the time axis the aggregate counters lack
+        _telemetry.emit("compile", seconds=round(duration, 6))
     elif event == "/jax/compilation_cache/compile_time_saved_sec":
         with _lock:
             _metrics["compile_time_saved_s"] += max(0.0, duration)
@@ -847,6 +853,7 @@ def precompile(manifest_doc):
             record_fault("stale_manifests",
                          f"op entry {entry.get('name')}: replay failed")
             stats["ops_skipped"] += 1
+    _telemetry.emit("precompile", **stats)
     return stats
 
 
@@ -873,6 +880,7 @@ def prewarm_program(name, jit_fn):
         except Exception as e:  # noqa: BLE001 — stale signature
             record_fault("stale_manifests",
                          f"{name}: {type(e).__name__}"[:120])
+    _telemetry.emit("precompile", program=name, compiled=n)
     return n
 
 

@@ -1,0 +1,28 @@
+"""Child process for the telemetry kill -9 crash test
+(test_telemetry.py).
+
+Configures the event stream at TELEMETRY_CHILD_DIR, then emits
+``train_step`` events in a loop with a fault point after each one; the
+parent arms ``PADDLE_TPU_FAULT_INJECT=telemetry.child=kill:N`` so the
+process dies by SIGKILL (no atexit, no flush-on-close) right after the
+Nth event. The parent then proves the stream survived: every event
+emitted before the kill is on disk, because the stream flushes per
+record — the exact property the old VisualDL buffering lacked.
+"""
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from paddle_tpu.runtime import telemetry  # noqa: E402
+from paddle_tpu.testing.faults import fault_point  # noqa: E402
+
+telemetry.configure(os.environ["TELEMETRY_CHILD_DIR"])
+step = 0
+while step < 10_000:  # bounded: a mis-armed injector must not spin forever
+    step += 1
+    telemetry.emit("train_step", step=step)
+    fault_point("telemetry.child")  # parent arms kill -9 on the Nth call
+print("child exited without being killed", file=sys.stderr)
+sys.exit(3)

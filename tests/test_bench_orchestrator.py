@@ -146,3 +146,32 @@ def test_publish_baseline_scopes_small_and_requires_headline(tmp_path,
     r = bench._publish_baseline(details, "bert", "bert_tokens_per_sec",
                                 1500.0, publish=True, keymap=keymap)
     assert r == 1.5
+
+
+def test_dispatch_delta_ranks_by_config_delta():
+    # counters accumulate across configs in one runner process: top_ops
+    # must rank by THIS config's delta, or an op hot only here is
+    # shadowed by earlier configs' cumulative traffic
+    import bench
+
+    blank = {"run_s": 0.0, "run_samples": 0}
+    before = {"forward": {"hits": 100, "misses": 10},
+              "per_op": {"old_hot": {"hits": 95, "misses": 5, **blank},
+                         "new_hot": {"hits": 0, "misses": 0, **blank}}}
+    after = {"forward": {"hits": 110, "misses": 12},
+             "per_op": {"old_hot": {"hits": 95, "misses": 5, **blank},
+                        "new_hot": {"hits": 10, "misses": 2,
+                                    "run_s": 0.001, "run_samples": 2}}}
+    res = {}
+    bench._dispatch_delta(res, "cfg", before, after)
+    rec = res["cfg_dispatch"]
+    assert list(rec["top_ops"]) == ["new_hot"]  # zero-delta ops excluded
+    assert rec["top_ops"]["new_hot"] == {
+        "hits": 10, "misses": 2, "run_samples": 2, "run_s": 0.001}
+    assert rec["fwd_hits"] == 10 and rec["fwd_misses"] == 2
+    assert rec["hit_rate"] == round(10 / 12, 4)
+
+    # a config that reset the counters itself falls back to absolutes
+    res2 = {}
+    bench._dispatch_delta(res2, "cfg", after, before)
+    assert res2["cfg_dispatch"]["fwd_hits"] == 100

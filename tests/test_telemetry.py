@@ -1,0 +1,430 @@
+"""Unified telemetry subsystem (runtime/telemetry.py): registry
+semantics, exporter round-trips, event-stream rotation and crash
+survival, per-op run-time attribution, and the TelemetryCallback's
+reconciliation with the runtime's authoritative snapshots."""
+import json
+import os
+import subprocess
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.core import dispatch
+from paddle_tpu.runtime import telemetry as T
+from paddle_tpu.runtime.resilience import fault_events, record_fault
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+@pytest.fixture
+def tdir(tmp_path):
+    """Fresh registry + event stream in a temp dir; restores the
+    process-global telemetry state afterwards (other test files rely on
+    emit() being a configured-elsewhere no-op)."""
+    T.reset_metrics()
+    prev_dir = T.telemetry_dir()
+    d = str(tmp_path / "telemetry")
+    T.configure(d)
+    yield d
+    stream = T.event_stream()
+    if stream is not None:
+        stream.close()
+    T._stream = None
+    T._config["dir"] = prev_dir
+    T.reset_metrics()
+
+
+# ---------------------------------------------------------------------------
+# registry semantics
+
+def test_counter_labels_and_values():
+    T.reset_metrics()
+    c = T.counter("t_requests_total", "reqs", ("route",))
+    c.labels(route="a").inc()
+    c.labels(route="a").inc(4)
+    c.labels(route="b").inc()
+    snap = T.snapshot()["t_requests_total"]
+    by = {s["labels"]["route"]: s["value"] for s in snap["series"]}
+    assert by == {"a": 5, "b": 1}
+    assert snap["type"] == "counter"
+
+
+def test_registration_idempotent_and_type_clash():
+    T.reset_metrics()
+    a = T.counter("t_same", "x")
+    assert T.counter("t_same") is a
+    with pytest.raises(ValueError):
+        T.gauge("t_same")
+    g = T.gauge("t_g")
+    g.set(2.0)
+    g.inc()
+    g.dec(0.5)
+    assert T.snapshot()["t_g"]["series"][0]["value"] == 2.5
+    # mismatched re-declarations clash HERE, not at observe time
+    with pytest.raises(ValueError):
+        T.counter("t_same", labelnames=("op",))
+    h = T.histogram("t_same_h", buckets=(0.1, 1.0))
+    with pytest.raises(ValueError):
+        T.histogram("t_same_h", buckets=(0.5, 2.0))
+    assert T.histogram("t_same_h", buckets=(1.0, 0.1)) is h  # order-free
+
+
+def test_histogram_buckets_and_merge():
+    T.reset_metrics()
+    h = T.histogram("t_lat_seconds", "lat", buckets=(0.01, 0.1, 1.0))
+    for v in (0.005, 0.004, 0.05, 0.5, 7.0):
+        h.observe(v)
+    s = T.snapshot()["t_lat_seconds"]["series"][0]
+    assert s["bucket_counts"] == [2, 1, 1, 1]  # last is the +Inf tail
+    assert s["count"] == 5
+    assert abs(s["sum"] - 7.559) < 1e-9
+    merged = T.merge_histograms([s, s])
+    assert merged["bucket_counts"] == [4, 2, 2, 2]
+    assert merged["count"] == 10
+    with pytest.raises(ValueError):
+        T.merge_histograms([s, {"bucket_counts": [0], "sum": 0, "count": 0}])
+
+
+def test_concurrent_increments():
+    T.reset_metrics()
+    c = T.counter("t_conc_total", "", ("k",))
+    h = T.histogram("t_conc_seconds", "")
+
+    def work():
+        for _ in range(1000):
+            c.labels(k="x").inc()
+            h.observe(0.01)
+
+    threads = [threading.Thread(target=work) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert T.snapshot()["t_conc_total"]["series"][0]["value"] == 8000
+    assert T.snapshot()["t_conc_seconds"]["series"][0]["count"] == 8000
+
+
+def test_kill_switch_makes_mutations_noop(tdir):
+    c = T.counter("t_kill_total")
+    c.inc(3)
+    prev = T.set_enabled(False)
+    try:
+        assert not T.enabled()
+        c.inc(100)
+        T.gauge("t_kill_g").set(9)
+        T.emit("train_step", step=1)
+        assert T.op_sample_every() == 0  # dispatch sampling keys off this
+    finally:
+        T.set_enabled(prev)
+    assert T.snapshot()["t_kill_total"]["series"][0]["value"] == 3
+    assert T.snapshot()["t_kill_g"]["series"][0]["value"] == 0.0
+    assert T.read_events() == []
+
+
+# ---------------------------------------------------------------------------
+# exporters
+
+def test_prometheus_round_trip(tdir):
+    c = T.counter("t_rt_total", "with help", ("op",))
+    c.labels(op='we"ird\\nm').inc(2)
+    T.gauge("t_rt_gauge").set(-1.5)
+    h = T.histogram("t_rt_seconds", "", buckets=(0.1, 1.0))
+    h.observe(0.05)
+    h.observe(0.5)
+    path = T.write_prometheus()
+    assert path == os.path.join(tdir, "metrics.prom")
+    parsed = T.parse_prometheus_textfile(path)
+    assert parsed[("t_rt_total", (("op", 'we"ird\\nm'),))] == 2.0
+    assert parsed[("t_rt_gauge", ())] == -1.5
+    # histogram exposition: cumulative buckets + sum + count
+    assert parsed[("t_rt_seconds_bucket", (("le", "0.1"),))] == 1.0
+    assert parsed[("t_rt_seconds_bucket", (("le", "1.0"),))] == 2.0
+    assert parsed[("t_rt_seconds_bucket", (("le", "+Inf"),))] == 2.0
+    assert parsed[("t_rt_seconds_count", ())] == 2.0
+    assert abs(parsed[("t_rt_seconds_sum", ())] - 0.55) < 1e-9
+
+
+def test_prometheus_nonfinite_values_export(tdir):
+    # a NaN loss is exactly the state worth exporting (the bad-step
+    # scenario): the writer must not crash on it
+    T.gauge("t_nan").set(float("nan"))
+    T.gauge("t_inf").set(float("inf"))
+    parsed = T.parse_prometheus_textfile(T.write_prometheus())
+    assert np.isnan(parsed[("t_nan", ())])
+    assert parsed[("t_inf", ())] == float("inf")
+
+
+def test_labels_typo_raises():
+    T.reset_metrics()
+    h = T.histogram("t_strict_seconds", "", ("op",))
+    with pytest.raises(ValueError):
+        h.labels(opname="matmul")  # typo must not aggregate under "None"
+    with pytest.raises(ValueError):
+        h.labels(op="x", extra="y")
+
+
+def test_kill_switch_rearms_dispatch_sampling():
+    prev_rate = dispatch.set_op_sample_every(7)
+    try:
+        T.set_enabled(False)
+        assert dispatch.dispatch_stats()["op_sample_every"] == 0
+        T.set_enabled(True)
+        assert dispatch.dispatch_stats()["op_sample_every"] == \
+            T.op_sample_env_rate()
+    finally:
+        T.set_enabled(True)
+        dispatch.set_op_sample_every(prev_rate)
+
+
+def test_export_failure_never_kills_fit(tdir, monkeypatch):
+    def boom(*a, **kw):
+        raise OSError("disk full")
+
+    monkeypatch.setattr(T, "write_prometheus", boom)
+    with pytest.warns(UserWarning, match="export failed"):
+        cb = _tiny_fit(tdir, export_every=2)
+    assert cb.global_step == 8  # the run outlived its observability
+
+
+def test_snapshot_jsonl_append(tdir):
+    T.counter("t_snap_total").inc(7)
+    p1 = T.append_snapshot_jsonl(extra={"step": 1})
+    T.counter("t_snap_total").inc()
+    T.append_snapshot_jsonl(extra={"step": 2})
+    lines = [json.loads(line) for line in open(p1)]
+    assert len(lines) == 2
+    assert lines[0]["step"] == 1 and "ts" in lines[0] and "mono" in lines[0]
+    vals = [rec["metrics"]["t_snap_total"]["series"][0]["value"]
+            for rec in lines]
+    assert vals == [7, 8]
+
+
+def test_event_stream_fields_and_rotation(tmp_path):
+    path = str(tmp_path / "ev" / "events.jsonl")
+    s = T.EventStream(path, max_bytes=300, max_files=3)
+    for i in range(60):
+        s.emit("tick", i=i)
+    s.close()
+    assert s.emitted == 60
+    # bounded: exactly max_files generations on disk
+    files = [path] + [f"{path}.{i}" for i in (1, 2)]
+    assert all(os.path.exists(f) for f in files)
+    assert not os.path.exists(f"{path}.3")
+    back = T.read_events(path)
+    idx = [e["i"] for e in back]
+    assert idx == sorted(idx) and idx[-1] == 59  # oldest-first, tail kept
+    ev = back[-1]
+    assert ev["kind"] == "tick" and "ts" in ev and "mono" in ev
+    assert ev["host"] and ev["pid"] == os.getpid()
+
+
+def test_failed_reconfigure_keeps_old_stream_live(tdir):
+    T.emit("tick", i=1)
+    with pytest.raises(OSError):
+        T.configure("/proc/definitely/unwritable/dir")
+    T.emit("tick", i=2)  # the old stream must still be the live one
+    assert T.telemetry_dir() == tdir
+    assert [e["i"] for e in T.read_events()] == [1, 2]
+
+
+def test_reconfigure_same_dir_updates_rotation_bounds(tdir):
+    T.configure(tdir, max_bytes=65536, max_files=2)
+    s = T.event_stream()
+    assert s.max_bytes == 65536 and s.max_files == 2
+
+
+def test_unwritable_log_dir_degrades_with_warning(tdir):
+    with pytest.warns(UserWarning, match="cannot write"):
+        cb = _tiny_fit("/proc/nope/telemetry", export_every=100)
+    assert cb.global_step == 8  # fit survived; registry-only collection
+
+
+def test_scalars_sink_flushes_per_write(tmp_path):
+    sink = T.ScalarsSink(str(tmp_path / "vdl"))
+    sink.write(1, {"loss": 0.5})
+    sink.write(2, {"loss": 0.25})
+    # readable BEFORE close: per-write flush is the kill -9 contract
+    lines = [json.loads(line) for line in open(sink.path)]
+    assert lines == [{"loss": 0.5, "global_step": 1},
+                     {"loss": 0.25, "global_step": 2}]
+    sink.close()
+
+
+# ---------------------------------------------------------------------------
+# runtime bridge + dispatch attribution
+
+def test_sync_runtime_metrics_reconciles(tdir):
+    record_fault("rollbacks", "test fixture")
+    ds = T.sync_runtime_metrics()
+    parsed = T.parse_prometheus_textfile(T.write_prometheus())
+    for which in ("forward", "backward"):
+        for key, mname in (("hits", "paddle_tpu_dispatch_cache_hits_total"),
+                           ("misses",
+                            "paddle_tpu_dispatch_cache_misses_total")):
+            assert parsed[(mname, (("cache", which),))] == ds[which][key]
+    for kind, n in fault_events().items():
+        assert parsed[("paddle_tpu_fault_events_total",
+                       (("fault", kind),))] == n
+    # the structured event for the fault is on the stream too
+    faults = [e for e in T.read_events() if e["kind"] == "fault"]
+    assert any(e["fault"] == "rollbacks" for e in faults)
+
+
+def test_op_run_time_sampling(tdir):
+    prev_rate = dispatch.set_op_sample_every(1)  # sample every execution
+    prev_warm = dispatch.set_warmup_count(1)
+    try:
+        x = paddle.to_tensor(np.random.rand(4, 4).astype(np.float32))
+        for _ in range(4):
+            paddle.tanh(x)
+        per = dispatch.dispatch_stats()["per_op"].get("tanh")
+        assert per and per["run_samples"] >= 3  # first call is the compile
+        assert per["run_s"] > 0.0
+        snap = T.snapshot().get("paddle_tpu_op_run_seconds")
+        assert snap is not None
+        tanh = [s for s in snap["series"] if s["labels"].get("op") == "tanh"]
+        assert tanh and tanh[0]["count"] == per["run_samples"]
+    finally:
+        dispatch.set_op_sample_every(prev_rate)
+        dispatch.set_warmup_count(prev_warm)
+
+
+def test_sampling_disabled_costs_nothing(tdir):
+    prev_rate = dispatch.set_op_sample_every(0)
+    prev_warm = dispatch.set_warmup_count(1)
+    try:
+        x = paddle.to_tensor(np.random.rand(4, 4).astype(np.float32))
+        for _ in range(4):
+            paddle.exp(x)
+        per = dispatch.dispatch_stats()["per_op"].get("exp")
+        assert per is None or per["run_samples"] == 0
+        snap = T.snapshot().get("paddle_tpu_op_run_seconds")
+        assert snap is None or not any(
+            s["labels"].get("op") == "exp" for s in snap["series"])
+    finally:
+        dispatch.set_op_sample_every(prev_rate)
+        dispatch.set_warmup_count(prev_warm)
+
+
+# ---------------------------------------------------------------------------
+# hapi integration
+
+def _tiny_fit(tdir, **cb_kw):
+    from paddle_tpu.hapi.callbacks import TelemetryCallback
+
+    paddle.seed(0)
+    x = np.random.rand(64, 4).astype(np.float32)
+    y = (x @ np.random.rand(4, 1).astype(np.float32)).astype(np.float32)
+    net = nn.Linear(4, 1)
+    model = paddle.Model(net)
+    model.prepare(paddle.optimizer.Adam(0.05, parameters=net.parameters()),
+                  nn.MSELoss())
+    cb = TelemetryCallback(log_dir=tdir, **cb_kw)
+    model.fit([x, y], epochs=2, batch_size=16, verbose=0, callbacks=[cb])
+    return cb
+
+
+def test_telemetry_callback_fit_reconciles(tdir):
+    cb = _tiny_fit(tdir, export_every=3)
+    assert cb.global_step == 8  # 2 epochs x 4 batches
+    # prometheus textfile written and reconciling EXACTLY with the
+    # authoritative snapshots — the subsystem's acceptance property
+    parsed = T.parse_prometheus_textfile(os.path.join(tdir, "metrics.prom"))
+    ds = dispatch.dispatch_stats()
+    assert parsed[("paddle_tpu_dispatch_cache_hits_total",
+                   (("cache", "forward"),))] == ds["forward"]["hits"]
+    assert parsed[("paddle_tpu_dispatch_cache_misses_total",
+                   (("cache", "forward"),))] == ds["forward"]["misses"]
+    for kind, n in fault_events().items():
+        assert parsed[("paddle_tpu_fault_events_total",
+                       (("fault", kind),))] == n
+    assert parsed[("paddle_tpu_train_steps_total", ())] == 8
+    assert parsed[("paddle_tpu_step_seconds_count", ())] == 8
+    # per-step structured events with both clocks + host tags
+    steps = [e for e in T.read_events() if e["kind"] == "train_step"]
+    assert len(steps) == 8
+    assert steps[-1]["step"] == 8 and steps[-1]["loss"] is not None
+    assert all("mono" in e and "host" in e for e in steps)
+    kinds = {e["kind"] for e in T.read_events()}
+    assert {"train_begin", "train_end"} <= kinds
+    # per-step scalars (TensorBoard-consumable), one line per batch
+    scalars = [json.loads(line)
+               for line in open(os.path.join(tdir, "scalars.jsonl"))]
+    assert [r["global_step"] for r in scalars] == list(range(1, 9))
+    assert all("loss" in r and "step_s" in r for r in scalars)
+
+
+def test_telemetry_callback_inert_when_disabled(tdir):
+    prev = T.set_enabled(False)
+    try:
+        cb = _tiny_fit(tdir, export_every=3)
+    finally:
+        T.set_enabled(prev)
+    assert not cb._active
+    assert not os.path.exists(os.path.join(tdir, "metrics.prom"))
+    assert not os.path.exists(os.path.join(tdir, "scalars.jsonl"))
+
+
+def test_visualdl_writes_per_batch(tmp_path):
+    from paddle_tpu.hapi.callbacks import VisualDL
+
+    vdl = VisualDL(log_dir=str(tmp_path / "vdl"))
+    vdl.on_train_begin()
+    vdl.on_train_batch_end(0, {"loss": 1.0, "step": 0})
+    vdl.on_train_batch_end(1, {"loss": 0.5, "step": 1, "skipme": "str"})
+    # the whole point of the fix: records are durable BEFORE
+    # on_train_end — a kill -9 mid-run keeps every completed batch
+    lines = [json.loads(line)
+             for line in open(tmp_path / "vdl" / "scalars.jsonl")]
+    assert len(lines) == 2
+    assert lines[1] == {"loss": 0.5, "step": 1, "global_step": 2}
+    vdl.on_train_end()
+
+
+# ---------------------------------------------------------------------------
+# crash survival + schema
+
+def test_kill9_child_stream_survives(tmp_path):
+    from paddle_tpu.testing.faults import faults_env
+
+    child_dir = str(tmp_path / "crash")
+    env = faults_env({"telemetry.child": ("kill", 25)})
+    env.update({"TELEMETRY_CHILD_DIR": child_dir, "JAX_PLATFORMS": "cpu"})
+    p = subprocess.run(
+        [sys.executable, os.path.join(HERE, "_telemetry_child.py")],
+        env=env, capture_output=True, text=True, timeout=120)
+    assert p.returncode == -9, (p.returncode, p.stderr)
+    events = T.read_events(os.path.join(child_dir, "events.jsonl"))
+    steps = [e["step"] for e in events if e["kind"] == "train_step"]
+    # every event emitted before the SIGKILL is durable (per-record
+    # flush); the injector fired right after the 25th
+    assert steps == list(range(1, 26))
+    # the injection itself is on the stream too (record_fault emits)
+    assert any(e["kind"] == "fault" and e["fault"] == "injected_faults"
+               for e in events)
+
+
+def test_schema_matches_checked_in_file():
+    path = os.path.join(os.path.dirname(HERE), "tools",
+                        "telemetry_schema.json")
+    with open(path) as f:
+        frozen = json.load(f)
+    live = T.schema()
+    assert live == frozen, (
+        "metric/event schema drifted from tools/telemetry_schema.json — "
+        "dashboards key on these names; if the rename is deliberate, "
+        "regenerate with `python tools/telemetry_smoke.py --emit-schema`")
+
+
+def test_schema_covers_registered_metrics(tdir):
+    # everything sync + the callback register must be IN the schema —
+    # an unlisted metric would dodge the rename gate
+    _tiny_fit(tdir, export_every=100)
+    T.sync_runtime_metrics()
+    names = set(T.schema()["metrics"])
+    unknown = set(T.snapshot()) - names
+    assert not unknown, f"metrics missing from telemetry.SCHEMA: {unknown}"

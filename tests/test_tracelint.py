@@ -196,6 +196,15 @@ def test_closure_capture_detection(fixture_findings):
     assert hits and "key" in hits[0].symbol
 
 
+def test_closure_capture_prng_key_suggests_non_jittable(fixture_findings):
+    # a PRNG-key capture is usually deliberate (dropout semantics), so
+    # the report must carry the fix — record the intent @non_jittable —
+    # not just the finding
+    hits = [f for f in fixture_findings
+            if f.rule == "closure-capture" and "closure_capture_op" in f.func]
+    assert hits and "@non_jittable" in hits[0].message
+
+
 def test_state_mutation_detections(fixture_findings):
     symbols = {f.symbol for f in fixture_findings
                if f.rule == "state-mutation" and "mutation_op" in f.func}

@@ -37,4 +37,10 @@ echo "== warm-start smoke (persistent compile cache + shape manifest) =="
 # disk (hits > 0) and perform ZERO fresh XLA compiles
 JAX_PLATFORMS=cpu python tools/warmstart_smoke.py
 
+echo "== telemetry smoke (event stream + prom export + schema gate) =="
+# a tiny fit must produce an event stream, a Prometheus textfile whose
+# counters reconcile exactly with dispatch_stats()/fault_events(), and
+# the metric/event schema must match the checked-in telemetry_schema.json
+JAX_PLATFORMS=cpu python tools/telemetry_smoke.py
+
 echo "ci_check: OK"

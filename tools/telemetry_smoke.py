@@ -1,0 +1,191 @@
+#!/usr/bin/env python
+"""Telemetry smoke + schema gate (tools/ci_check.sh).
+
+Smoke: a fresh subprocess runs a tiny `Model.fit` with
+`hapi.TelemetryCallback` under ``PADDLE_TPU_TELEMETRY_DIR`` and prints
+its authoritative snapshots; the parent then proves, from the files
+alone (the way a dashboard would):
+
+* the structured event stream exists, with per-step ``train_step``
+  events bracketed by ``train_begin``/``train_end``;
+* the Prometheus textfile exists and its counters reconcile EXACTLY
+  with the child's ``dispatch_stats()`` and ``fault_events()``;
+* the per-step scalars file carries one record per batch.
+
+Schema gate: `paddle_tpu.runtime.telemetry.schema()` must equal the
+checked-in ``tools/telemetry_schema.json`` — metric/event renames break
+dashboards, so they must show up as a reviewed diff of that file.
+
+Usage: python tools/telemetry_smoke.py                (smoke + schema)
+       python tools/telemetry_smoke.py --check-schema (schema only)
+       python tools/telemetry_smoke.py --emit-schema  (regenerate file)
+       python tools/telemetry_smoke.py --child        (internal)
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCHEMA_PATH = os.path.join(REPO, "tools", "telemetry_schema.json")
+
+
+def _child():
+    """Tiny fit with eager warm-up ops so BOTH dispatch paths (per-op
+    jit cache and the fused hapi step) feed the exported counters."""
+    sys.path.insert(0, REPO)
+    import numpy as np
+
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu.core import dispatch
+    from paddle_tpu.runtime.resilience import fault_events, record_fault
+
+    dispatch.set_warmup_count(1)
+    dispatch.set_op_sample_every(1)
+    paddle.seed(0)
+    rng = np.random.RandomState(0)
+    # a few plain eager ops: nonzero forward hit/miss traffic to reconcile
+    t = paddle.to_tensor(rng.randn(8, 8).astype(np.float32))
+    for _ in range(4):
+        paddle.tanh(paddle.matmul(t, t)).sum()
+    record_fault("rollbacks", "telemetry smoke fixture")
+    x = rng.rand(64, 4).astype(np.float32)
+    y = (x @ rng.rand(4, 1).astype(np.float32)).astype(np.float32)
+    net = nn.Linear(4, 1)
+    model = paddle.Model(net)
+    model.prepare(paddle.optimizer.Adam(0.05, parameters=net.parameters()),
+                  nn.MSELoss())
+    model.fit([x, y], epochs=2, batch_size=16, verbose=0,
+              callbacks=[paddle.callbacks.TelemetryCallback(export_every=3)])
+    ds = dispatch.dispatch_stats()
+    print(json.dumps({
+        "forward_hits": ds["forward"]["hits"],
+        "forward_misses": ds["forward"]["misses"],
+        "fault_events": fault_events(),
+        "steps": 8,
+    }))
+
+
+def run_smoke():
+    tmp = tempfile.mkdtemp(prefix="telemetry_smoke_")
+    env = dict(os.environ)
+    env.update({"JAX_PLATFORMS": "cpu", "PADDLE_TPU_TELEMETRY_DIR": tmp,
+                "PADDLE_TPU_TELEMETRY": "1"})
+    p = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--child"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=300)
+    if p.returncode != 0:
+        print(p.stdout)
+        print(p.stderr, file=sys.stderr)
+        raise SystemExit(f"telemetry_smoke: child failed rc={p.returncode}")
+    truth = json.loads(p.stdout.strip().splitlines()[-1])
+
+    sys.path.insert(0, REPO)
+    from paddle_tpu.runtime import telemetry
+
+    # -- event stream ------------------------------------------------------
+    events_path = os.path.join(tmp, "events.jsonl")
+    if not os.path.exists(events_path):
+        raise SystemExit("telemetry_smoke: no event stream written")
+    events = telemetry.read_events(events_path)
+    kinds = [e["kind"] for e in events]
+    if kinds.count("train_step") != truth["steps"]:
+        raise SystemExit(
+            f"telemetry_smoke: expected {truth['steps']} train_step events, "
+            f"got {kinds.count('train_step')}")
+    for needed in ("train_begin", "train_end", "fault"):
+        if needed not in kinds:
+            raise SystemExit(f"telemetry_smoke: no {needed!r} event emitted")
+
+    # -- prometheus textfile reconciles with the snapshots -----------------
+    prom_path = os.path.join(tmp, "metrics.prom")
+    if not os.path.exists(prom_path):
+        raise SystemExit("telemetry_smoke: no Prometheus textfile written")
+    prom = telemetry.parse_prometheus_textfile(prom_path)
+
+    def expect(name, labels, want):
+        got = prom.get((name, tuple(sorted(labels))))
+        if got != want:
+            raise SystemExit(
+                f"telemetry_smoke: {name}{dict(labels)} = {got}, but the "
+                f"authoritative snapshot says {want} — exported counters "
+                "must reconcile exactly")
+
+    expect("paddle_tpu_dispatch_cache_hits_total", [("cache", "forward")],
+           truth["forward_hits"])
+    expect("paddle_tpu_dispatch_cache_misses_total", [("cache", "forward")],
+           truth["forward_misses"])
+    for kind, n in truth["fault_events"].items():
+        expect("paddle_tpu_fault_events_total", [("fault", kind)], n)
+    expect("paddle_tpu_train_steps_total", [], truth["steps"])
+    if truth["forward_hits"] <= 0:
+        raise SystemExit("telemetry_smoke: the eager workload produced no "
+                         "dispatch-cache hits — nothing real reconciled")
+
+    # -- scalars -----------------------------------------------------------
+    scalars_path = os.path.join(tmp, "scalars.jsonl")
+    with open(scalars_path) as f:
+        n_scalars = sum(1 for _ in f)
+    if n_scalars != truth["steps"]:
+        raise SystemExit(f"telemetry_smoke: {n_scalars} scalar records for "
+                         f"{truth['steps']} steps")
+    print(f"telemetry_smoke: OK ({len(events)} events, "
+          f"{len(prom)} prom samples, {n_scalars} scalar records, "
+          "counters reconcile)")
+
+
+def check_schema():
+    sys.path.insert(0, REPO)
+    from paddle_tpu.runtime import telemetry
+
+    live = telemetry.schema()
+    try:
+        with open(SCHEMA_PATH) as f:
+            frozen = json.load(f)
+    except (OSError, ValueError):
+        raise SystemExit(
+            f"telemetry_smoke: missing/unreadable {SCHEMA_PATH} — "
+            "regenerate with `python tools/telemetry_smoke.py "
+            "--emit-schema`")
+    if live != frozen:
+        for field in ("metrics", "events"):
+            added = sorted(set(live[field]) - set(frozen.get(field, [])))
+            removed = sorted(set(frozen.get(field, [])) - set(live[field]))
+            if added:
+                print(f"  {field} added:   {', '.join(added)}")
+            if removed:
+                print(f"  {field} removed: {', '.join(removed)}")
+        raise SystemExit(
+            "telemetry_smoke: metric/event schema drifted from "
+            "tools/telemetry_schema.json. Renames break dashboards; if "
+            "deliberate, regenerate with `python tools/telemetry_smoke.py "
+            "--emit-schema` and commit the diff.")
+    print("telemetry_smoke: schema OK "
+          f"({len(live['metrics'])} metrics, {len(live['events'])} events)")
+
+
+def emit_schema():
+    sys.path.insert(0, REPO)
+    from paddle_tpu.runtime import telemetry
+
+    with open(SCHEMA_PATH, "w") as f:
+        json.dump(telemetry.schema(), f, indent=1, sort_keys=True)
+        f.write("\n")
+    print(f"wrote {SCHEMA_PATH}")
+
+
+if __name__ == "__main__":
+    arg = sys.argv[1] if len(sys.argv) > 1 else ""
+    if arg == "--child":
+        _child()
+    elif arg == "--check-schema":
+        check_schema()
+    elif arg == "--emit-schema":
+        emit_schema()
+    else:
+        check_schema()
+        run_smoke()

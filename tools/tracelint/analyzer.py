@@ -575,12 +575,26 @@ class _OpBodyChecker:
     def _check_closure_capture(self):
         for name, node in sorted(self._free_loads().items()):
             if self._enclosing_binding_is_arrayish(name):
+                # PRNG-key captures get the concrete fix in the report:
+                # a fresh key per call is usually BY DESIGN (dropout
+                # semantics — caching would freeze randomness), so the
+                # right move is recording that intent with
+                # @non_jittable, not refactoring the key into an
+                # argument. The symbol (and so the baseline
+                # fingerprint) is unchanged.
+                if KEYISH_NAME.search(name):
+                    fix = ("; if the per-call key is intentional "
+                           "(dropout-style randomness), decorate the op "
+                           "with @non_jittable so the exemption is "
+                           "explicit and the compile probe is never paid")
+                else:
+                    fix = "; pass it as an argument instead"
                 self.report(
                     "closure-capture", node,
                     f"op body captures `{name}` (live array/PRNG key) "
                     "from an enclosing scope — the dispatch cache "
                     "refuses it, so this op pays eager dispatch every "
-                    "call; pass it as an argument instead",
+                    f"call{fix}",
                     f"capture:{name}", "possible")
 
 

@@ -41,6 +41,7 @@ from .moe import MoELayer  # noqa: F401
 from . import sequence_parallel  # noqa: F401
 from .sequence_parallel import ring_attention, split_sequence  # noqa: F401
 from . import elastic  # noqa: F401
+from . import coordination  # noqa: F401
 from . import auto_parallel  # noqa: F401
 from . import models  # noqa: F401
 from . import utils  # noqa: F401

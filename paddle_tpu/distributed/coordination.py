@@ -1,0 +1,550 @@
+"""Cluster coordination: the substrate that turns per-process
+resilience (runtime/resilience.py, distributed/elastic.py) into a
+multihost story.
+
+PR 3 hardened ONE process: its watchdog, its checkpoints, its fault
+log. A multihost run has N of each and nothing connecting them — N
+watchdogs that can disagree about whether the job is stalled, N fault
+logs nobody aggregates, and no guarantee that ranks restore the same
+checkpoint step after a mid-save crash. This module is the small
+coordination layer the cross-host protocols run over:
+
+* **`CoordinationStore`** — a tiny key→JSON-document store. The shipped
+  backend is `DirectoryStore`: a shared-filesystem directory with
+  atomic-rename writes (the same contract orbax and the telemetry
+  exporters rely on), which works for multi-process CPU tests and for
+  TPU pods whose hosts mount one filesystem (GCS fuse, NFS). The
+  interface is deliberately minimal (`put`/`get`/`list`/`delete`) so a
+  jax.distributed KV-backed store can slot in later without touching
+  any protocol.
+
+* **Per-rank heartbeat publication + quorum watchdog** — each rank
+  publishes `{rank, step, wall, mono}` records (no fsync: a heartbeat
+  is freshness, not durability); `ClusterMonitor` classifies every
+  rank as fresh / stale / dead and applies QUORUM semantics: one slow
+  rank is a `peer_stale` fault event + telemetry (the job degrades,
+  it does not abort); only when a quorum of ranks is stale does the
+  stall escalate (`quorum_stalled`); a rank silent past the hard
+  `dead_after` deadline is declared down CLUSTER-WIDE (a `down/` store
+  record every peer observes, `peer_dead` fault event).
+
+* **`rendezvous(store, name, payload, timeout)`** — host-0 publishes a
+  payload under a named key; peers wait-and-read. Used by
+  runtime/warmup.py (host 0 writes the shape manifest, peers stop
+  racing it) and by coordinated restore (all ranks agree on the step).
+  A timeout records a `rendezvous_timeouts` fault event and returns
+  None — it never hangs and never raises into `fit()`.
+
+* **`ClusterContext`** — the env wiring: `PADDLE_TPU_CLUSTER_DIR`
+  names the store; rank/world come from `PADDLE_TPU_CLUSTER_RANK` /
+  `PADDLE_TPU_CLUSTER_WORLD` (plain-subprocess CPU clusters) or from
+  jax's process index/count (real multihost). `hapi.ResilienceCallback`
+  drives everything from here.
+
+Store layout (DirectoryStore root):
+
+    heartbeats/rank_<r>.json   liveness records (atomic, no fsync)
+    down/rank_<r>.json         cluster-wide dead-rank declarations
+    rendezvous/<name>.json     host-0 published payloads
+    ckpt/rank_<r>.json         per-rank verified-complete step lists
+    telemetry/rank_<r>.json    per-rank registry/fault snapshots
+    events/rank_<r>/           per-rank telemetry event streams
+    merged/                    host-0 merge outputs (cluster.prom, ...)
+
+Everything here is host-side control plane (wall clock + file I/O by
+design) and must never run under a trace — the liveness helpers carry
+`@non_jittable` exactly like the elastic watchdog's.
+"""
+from __future__ import annotations
+
+import json
+import math
+import os
+import re
+import time
+
+from ..core.dispatch import non_jittable
+from ..runtime import telemetry as _telemetry
+from ..runtime.resilience import atomic_write_json, fault_point, record_fault
+
+__all__ = [
+    "CoordinationStore", "DirectoryStore", "ClusterContext",
+    "cluster_context", "cluster_dir", "cluster_rank", "cluster_world_size",
+    "init_cluster_telemetry", "quorum_threshold",
+    "publish_heartbeat", "read_heartbeats", "ClusterMonitor", "rendezvous",
+    "HEARTBEAT_PREFIX", "DOWN_PREFIX", "RENDEZVOUS_PREFIX", "CKPT_PREFIX",
+    "TELEMETRY_PREFIX", "MERGED_DIRNAME",
+]
+
+HEARTBEAT_PREFIX = "heartbeats"
+DOWN_PREFIX = "down"
+RENDEZVOUS_PREFIX = "rendezvous"
+CKPT_PREFIX = "ckpt"
+TELEMETRY_PREFIX = "telemetry"
+MERGED_DIRNAME = "merged"
+
+_KEY_SEGMENT = re.compile(r"^[A-Za-z0-9._-]+$")
+
+
+# ---------------------------------------------------------------------------
+# store abstraction
+
+class CoordinationStore:
+    """Key → JSON-document store the coordination protocols run over.
+
+    Keys are slash-separated paths of `[A-Za-z0-9._-]` segments
+    (``heartbeats/rank_0``). The contract every protocol depends on:
+
+    * `put` is ATOMIC — a concurrent `get` sees the old document or the
+      new one, never a torn one;
+    * `get` of a missing/torn key returns None (readers poll, they
+      don't except);
+    * `list(prefix)` returns the keys under a prefix, in no particular
+      order.
+
+    `DirectoryStore` is the shared-filesystem implementation; a
+    jax.distributed KV backend only needs these four methods.
+    """
+
+    def put(self, key, payload, fsync=True):
+        raise NotImplementedError
+
+    def get(self, key):
+        raise NotImplementedError
+
+    def list(self, prefix):
+        raise NotImplementedError
+
+    def delete(self, key):
+        raise NotImplementedError
+
+
+def _check_key(key):
+    segments = key.split("/")
+    if not segments or not all(
+            _KEY_SEGMENT.match(s) and s.strip(".") for s in segments):
+        raise ValueError(f"bad coordination key {key!r} (segments must "
+                         "match [A-Za-z0-9._-]+ and cannot be dots-only)")
+    return segments
+
+
+class DirectoryStore(CoordinationStore):
+    """Shared-filesystem backend: one JSON file per key, written by
+    tmp-file + atomic rename (`atomic_write_json`), so a reader on any
+    host sees whole documents only. Works wherever the hosts share a
+    directory — multi-process CPU tests (tmpdir), NFS, GCS fuse."""
+
+    def __init__(self, root):
+        self.root = os.path.abspath(root)
+        os.makedirs(self.root, exist_ok=True)
+
+    def _path(self, key):
+        return os.path.join(self.root, *_check_key(key)) + ".json"
+
+    def put(self, key, payload, fsync=True):
+        path = self._path(key)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        fault_point("coordination.put", key=key, path=path)
+        atomic_write_json(path, payload, fsync=fsync)
+
+    def get(self, key):
+        try:
+            with open(self._path(key)) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None  # missing or torn: the poll contract
+
+    def list(self, prefix):
+        d = os.path.join(self.root, *_check_key(prefix))
+        try:
+            names = os.listdir(d)
+        except OSError:
+            return []
+        return [f"{prefix}/{n[:-5]}" for n in sorted(names)
+                if n.endswith(".json")]
+
+    def delete(self, key):
+        try:
+            os.unlink(self._path(key))
+        except OSError:
+            pass
+
+    def __repr__(self):
+        return f"DirectoryStore({self.root!r})"
+
+
+# ---------------------------------------------------------------------------
+# env wiring
+
+def cluster_dir():
+    """The shared store directory, or None (cluster mode off)."""
+    return os.environ.get("PADDLE_TPU_CLUSTER_DIR") or None
+
+
+def _env_int(name):
+    try:
+        v = os.environ.get(name)
+        return int(v) if v is not None else None
+    except ValueError:
+        return None
+
+
+def cluster_rank():
+    """This process's cluster rank: ``PADDLE_TPU_CLUSTER_RANK`` when
+    set (plain-subprocess CPU clusters), else jax's process index
+    (real multihost), else 0."""
+    r = _env_int("PADDLE_TPU_CLUSTER_RANK")
+    if r is not None:
+        return r
+    try:
+        import jax
+
+        return jax.process_index()
+    except Exception:  # noqa: BLE001 — no jax/backend yet
+        return 0
+
+
+def cluster_world_size():
+    """Number of participating processes: ``PADDLE_TPU_CLUSTER_WORLD``
+    when set, else jax's process count, else 1. NOTE this is the
+    PROCESS world (one coordination participant per host process), not
+    `distributed.get_world_size()`'s device world."""
+    w = _env_int("PADDLE_TPU_CLUSTER_WORLD")
+    if w is not None:
+        return max(1, w)
+    try:
+        import jax
+
+        return max(1, jax.process_count())
+    except Exception:  # noqa: BLE001
+        return 1
+
+
+class ClusterContext:
+    """One process's view of the cluster: the store plus its identity.
+
+    `is_leader` is rank 0 — the merge/rendezvous-publisher role (the
+    "host 0" of the protocols). Construct directly for tests, or via
+    `cluster_context()` for the env/jax wiring."""
+
+    def __init__(self, store, rank=0, world_size=1):
+        if not isinstance(store, CoordinationStore):
+            store = DirectoryStore(store)
+        self.store = store
+        self.rank = int(rank)
+        self.world_size = max(1, int(world_size))
+
+    @property
+    def is_leader(self):
+        return self.rank == 0
+
+    def ranks(self):
+        return range(self.world_size)
+
+    def __repr__(self):
+        return (f"ClusterContext(rank={self.rank}/"
+                f"{self.world_size}, store={self.store!r})")
+
+
+def init_cluster_telemetry(ctx):
+    """Rank-tag this process's telemetry and, when no telemetry dir was
+    configured anywhere else, point the event stream into the store's
+    ``events/rank_<r>/`` directory — which is exactly where
+    `telemetry.merge_cluster` looks, so the fault a dying rank flushes
+    in its final instant still reaches the host-0 merged log.
+
+    When a telemetry dir IS configured elsewhere (e.g. a local
+    ``PADDLE_TPU_TELEMETRY_DIR``), that stream is respected — but a
+    local dir on a dead host is unreachable from host 0, so the merged
+    fault log then covers each rank only up to its last
+    `publish_registry` boundary (the dying-instant fault stays in the
+    local stream). That trade-off must be visible, not silent."""
+    _telemetry.set_rank(ctx.rank)
+    if not isinstance(ctx.store, DirectoryStore):
+        return
+    if _telemetry.telemetry_dir() is None:
+        try:
+            _telemetry.configure(os.path.join(
+                ctx.store.root, "events", f"rank_{ctx.rank}"))
+        except OSError:
+            pass  # unwritable store dir: registry-only collection
+    elif not os.path.abspath(_telemetry.telemetry_dir()).startswith(
+            os.path.abspath(ctx.store.root)):
+        import warnings
+
+        warnings.warn(
+            "paddle_tpu coordination: telemetry events stream at "
+            f"{_telemetry.telemetry_dir()!r} is outside the cluster "
+            "store — the host-0 merged fault log will cover this rank "
+            "only up to its last publication boundary (a dying rank's "
+            "final flushed fault stays in the local stream). Point "
+            "PADDLE_TPU_TELEMETRY_DIR inside the shared store (or "
+            "unset it) to close the gap.", stacklevel=2)
+
+
+def cluster_context(default_dir=None):
+    """The env-derived ClusterContext, or None when this process is not
+    part of a cluster. Cluster mode is ON when ``PADDLE_TPU_CLUSTER_DIR``
+    is set, or when jax reports more than one process AND the caller
+    supplies `default_dir` (a shared directory — typically under the
+    checkpoint root, which multihost jobs already share)."""
+    d = cluster_dir()
+    world = cluster_world_size()
+    if d is None:
+        if world <= 1 or default_dir is None:
+            return None
+        d = default_dir
+    return ClusterContext(DirectoryStore(d), cluster_rank(), world)
+
+
+# ---------------------------------------------------------------------------
+# protocol 1: heartbeat publication + quorum watchdog
+
+@non_jittable  # host-side wall clock by design; must never be jit-cached
+def publish_heartbeat(store=None, rank=0, step=0, payload=None):
+    """Publish this rank's liveness + progress. No fsync, same contract
+    as the local heartbeat file: crash-freshness of a heartbeat is
+    worthless (the process it vouched for is dead) and the quorum
+    watchdog tolerates one lost tick. (Every parameter is a host
+    static; the defaults mark them so for the tracelint taint pass,
+    exactly like the elastic watchdog helpers.)"""
+    rec = {"rank": int(rank), "step": int(step),
+           "wall": time.time(), "mono": time.monotonic()}  # tracelint: ok[impure-call]
+    if payload:
+        rec.update(payload)
+    store.put(f"{HEARTBEAT_PREFIX}/rank_{int(rank)}", rec, fsync=False)
+    return rec
+
+
+def read_heartbeats(store):
+    """{rank: heartbeat record} for every published rank."""
+    out = {}
+    for key in store.list(HEARTBEAT_PREFIX):
+        rec = store.get(key)
+        if isinstance(rec, dict) and "rank" in rec:
+            out[int(rec["rank"])] = rec
+    return out
+
+
+def quorum_threshold(world_size, quorum=0.5):
+    """Number of simultaneously-stale ranks that escalates to a
+    cluster stall. Never 1 — a single slow rank must degrade, not
+    abort (that is the whole point of the quorum)."""
+    return max(2, int(math.ceil(world_size * float(quorum))))
+
+
+class ClusterMonitor:
+    """Quorum watchdog over the published heartbeats.
+
+    Each `poll()` classifies every expected rank:
+
+    * **fresh** — heartbeat younger than `stale_after`;
+    * **stale** — older than `stale_after` (or never published, once
+      the monitor's own start-grace expires — the PR-3 lesson: a rank
+      that hangs before its FIRST heartbeat must still be seen);
+    * **dead** — older than `dead_after`: declared down CLUSTER-WIDE
+      by writing a `down/rank_<r>` store record (`peer_dead` fault
+      event, every peer's monitor observes the declaration).
+
+    A minority of stale ranks records `peer_stale` (once per
+    transition) and nothing else; `quorum_stalled` turns True only
+    when at least `quorum_threshold(world, quorum)` ranks are stale or
+    worse — that is what the ElasticManager cluster watchdog escalates
+    on. Staleness is judged on the STORE's wall clock axis (each
+    record's `wall` vs this host's `time.time()`): hosts in one pod
+    are NTP-disciplined, and `stale_after` should be chosen an order
+    of magnitude above any plausible skew.
+    """
+
+    # inter-host clock-skew allowance when deciding whether a heartbeat
+    # belongs to this incarnation (wall vs the grace anchor)
+    GRACE_CLOCK_SKEW_S = 5.0
+
+    def __init__(self, store, rank=None, world_size=1, stale_after=30.0,
+                 dead_after=None, quorum=0.5):
+        self.store = store
+        self.rank = rank
+        self.world_size = max(1, int(world_size))
+        self.stale_after = float(stale_after)
+        self.dead_after = (float(dead_after) if dead_after is not None
+                           else 4.0 * self.stale_after)
+        self.quorum = quorum_threshold(self.world_size, quorum)
+        self._started = time.time()
+        self._stale_known = set()
+        self._dead_known = set()
+        self.last_scan = None
+
+    def reset_grace(self, now=None):
+        """Re-anchor the never-published grace window (the elastic
+        watchdog calls this when it actually starts polling — monitor
+        construction can precede the coordinated restore and the first
+        compile by minutes)."""
+        self._started = time.time() if now is None else now  # tracelint: ok[impure-call]
+
+    # NOTE: poll is wall-clock liveness math, host-side by design. As a
+    # bound method it is unreachable from the dispatch layer (only
+    # module-level callables can become op bodies), so it needs no
+    # @non_jittable — the same reasoning as ElasticManager.tick.
+    def poll(self, now=None):
+        """One scan. Returns a dict: fresh/stale/dead rank lists,
+        `quorum_stalled`, and `down` (every rank with a cluster-wide
+        down declaration, whoever declared it)."""
+        now = time.time() if now is None else now  # tracelint: ok[impure-call]
+        beats = read_heartbeats(self.store)
+        # heartbeats predating this monitor's grace anchor belong to a
+        # PREVIOUS incarnation (restart into a reused store dir — the
+        # normal kill-and-resume flow): those ranks are treated exactly
+        # like never-published ones, graced from the anchor, instead of
+        # classifying instantly stale/dead and quorum-stalling the
+        # restarted job before anyone reaches a first tick. The small
+        # allowance covers inter-host clock skew on a peer's genuinely
+        # fresh beat written just before this anchor.
+        live = {r: hb for r, hb in beats.items()
+                if float(hb.get("wall", 0.0))
+                >= self._started - self.GRACE_CLOCK_SKEW_S}
+        down_set = set(self.down_ranks())
+        fresh, stale, dead = [], [], []
+        for r in range(self.world_size):
+            hb = live.get(r)
+            if hb is None:
+                # never published: judged against the monitor's own
+                # start time, so a rank hung before its first heartbeat
+                # is reported instead of being invisible forever
+                age = now - self._started
+            else:
+                age = now - float(hb.get("wall", 0.0))
+            if age <= self.stale_after:
+                fresh.append(r)
+                self._stale_known.discard(r)
+                self._dead_known.discard(r)
+                if r in down_set:
+                    # recovered (or a restart into a store dir holding a
+                    # previous incarnation's declaration): clear the
+                    # cluster-wide record so peers_down() and any
+                    # supervisor keying on it stop acting on a healthy
+                    # rank. Cleared only when the rank has HEARTBEAT
+                    # SINCE the declaration — threshold-independent, so
+                    # a monitor running laxer deadlines can never erase
+                    # a stricter peer's still-valid declaration
+                    rec = self.store.get(f"{DOWN_PREFIX}/rank_{r}")
+                    declared = float((rec or {}).get("wall", 0.0))
+                    if hb is not None and \
+                            float(hb.get("wall", 0.0)) > declared:
+                        self.store.delete(f"{DOWN_PREFIX}/rank_{r}")
+                        down_set.discard(r)
+                continue
+            if age > self.dead_after:
+                dead.append(r)
+                # no peer_stale here: a rank FIRST observed already past
+                # dead_after (monitor restart against an old store) was
+                # never merely slow — peer_dead alone tells that story
+                self._stale_known.add(r)
+            else:
+                stale.append(r)
+                if r not in self._stale_known and r != self.rank:
+                    self._stale_known.add(r)
+                    record_fault("peer_stale",
+                                 f"rank {r} heartbeat {age:.1f}s old "
+                                 f"(step {hb.get('step') if hb else None})")
+        for r in dead:
+            if r not in self._dead_known and r != self.rank:
+                # declaration first, dedup latch second: a transient
+                # store error must leave the rank un-latched so the
+                # next poll retries the cluster-wide declaration
+                # instead of suppressing it forever
+                try:
+                    self.store.put(
+                        f"{DOWN_PREFIX}/rank_{r}",
+                        {"rank": r, "declared_by": self.rank, "wall": now,
+                         "last_step": (beats.get(r) or {}).get("step")})
+                except Exception as e:  # noqa: BLE001 — retry next poll
+                    record_fault("watchdog_errors",
+                                 f"down declaration rank {r}: "
+                                 f"{type(e).__name__}: {e}")
+                    continue
+                self._dead_known.add(r)
+                record_fault("peer_dead",
+                             f"rank {r} silent past {self.dead_after:.1f}s "
+                             "— declared down cluster-wide")
+                down_set.add(r)
+        down = sorted(down_set)  # ghost ranks already filtered at read
+        # a cluster where NOBODY has heartbeat THIS incarnation is
+        # cold-starting (first-step compiles can far exceed
+        # stale_after), not wedged — never-published ranks still
+        # classify stale (visible, peer events) but pure bring-up must
+        # not quorum-abort the job; each rank's LOCAL watchdog
+        # (`no_heartbeat`) guards a genuine hang before its own first
+        # tick
+        scan = {"fresh": fresh, "stale": stale, "dead": dead, "down": down,
+                "quorum_stalled": bool(live)
+                and len(stale) + len(dead) >= self.quorum,
+                "published": len(live),
+                "quorum": self.quorum, "world_size": self.world_size}
+        self.last_scan = scan
+        return scan
+
+    def down_ranks(self):
+        """Ranks with a cluster-wide down declaration (any declarer).
+        Declarations for ranks outside the current world are filtered
+        HERE — the one place every consumer (`poll()['down']`,
+        `ElasticManager.peers_down()`) reads through — because a store
+        dir reused by a smaller world can hold ghost declarations
+        nothing could ever clear (clearing needs a fresh heartbeat a
+        nonexistent rank never publishes)."""
+        out = []
+        for key in self.store.list(DOWN_PREFIX):
+            rec = self.store.get(key)
+            if isinstance(rec, dict) and "rank" in rec and \
+                    0 <= int(rec["rank"]) < self.world_size:
+                out.append(int(rec["rank"]))
+        return sorted(set(out))
+
+
+# ---------------------------------------------------------------------------
+# protocol 3: host-0 rendezvous barrier
+
+@non_jittable  # poll-wait on wall clock; never jit-cached
+def rendezvous(store=None, name=None, payload=None, timeout=60.0,
+               leader=False, poll=0.05, min_wall=None):
+    """Host-0 publish / peer wait-and-read barrier. (Parameters are
+    host statics; the defaults mark them so for the tracelint taint
+    pass.)
+
+    The leader writes `payload` under ``rendezvous/<name>`` and returns
+    it; followers poll the key until it appears and return the
+    published document. A follower that times out records a
+    `rendezvous_timeouts` fault event, emits a structured
+    ``rendezvous`` telemetry event, and returns **None** — callers
+    degrade (cold start, local fallback), they never hang and never
+    see an exception out of this function.
+
+    Rendezvous keys persist in the store, so a name reused across runs
+    (restore-step agreement after every crash) could hand a follower
+    LAST run's publication. `min_wall` is the guard: a follower ignores
+    documents whose leader-side `wall` timestamp is older — pass your
+    own bring-up time minus an NTP-skew allowance (the same pod-level
+    clock-discipline assumption the quorum watchdog already makes).
+    """
+    key = f"{RENDEZVOUS_PREFIX}/{name}"
+    if leader:
+        doc = {"payload": payload, "wall": time.time()}  # tracelint: ok[impure-call]
+        store.put(key, doc)
+        _telemetry.emit("rendezvous", name=name, role="leader",
+                        status="published")
+        return payload
+    fault_point("coordination.rendezvous", name=name)
+    deadline = time.monotonic() + float(timeout)  # tracelint: ok[impure-call]
+    while True:
+        doc = store.get(key)
+        if isinstance(doc, dict) and "payload" in doc and (
+                min_wall is None or float(doc.get("wall", 0)) >= min_wall):
+            _telemetry.emit("rendezvous", name=name, role="follower",
+                            status="ok")
+            return doc["payload"]
+        if time.monotonic() >= deadline:  # tracelint: ok[impure-call]
+            record_fault("rendezvous_timeouts",
+                         f"{name}: no publication within {timeout}s")
+            _telemetry.emit("rendezvous", name=name, role="follower",
+                            status="timeout", timeout=timeout)
+            return None
+        time.sleep(min(poll, max(0.0, deadline - time.monotonic())))  # tracelint: ok[impure-call]

@@ -41,6 +41,10 @@ from ..runtime import telemetry as _telemetry
 from ..runtime.resilience import (
     BadStepGuard, atomic_write_json, fault_point, record_fault,
 )
+# hoisted off the per-step tick() hot path (the PR-5 VisualDL lesson);
+# coordination imports nothing from elastic, so no cycle
+from .coordination import ClusterMonitor as _ClusterMonitor
+from .coordination import publish_heartbeat as _publish_heartbeat
 
 __all__ = ["ElasticManager", "heartbeat", "latest_checkpoint",
            "BadStepGuard"]
@@ -88,11 +92,22 @@ class ElasticManager:
     alive-but-wedged below the tick site). `run_deadline` bounds total
     wall clock for the whole run. Each fires `on_stall(info)` once with
     info["reason"] in {"no_heartbeat", "stalled", "step_deadline",
-    "run_deadline"}.
+    "run_deadline", "quorum_stale"}.
+
+    **Cluster mode** (`cluster` = a `coordination.ClusterContext`):
+    `tick` additionally publishes this rank's heartbeat into the shared
+    store, and the watchdog runs a `ClusterMonitor` quorum scan each
+    poll — one slow peer is a `peer_stale` fault event (degrade, keep
+    training), a peer silent past `peer_dead_after` is declared down
+    cluster-wide (`peer_dead`), and only a QUORUM of stale ranks
+    escalates to `on_stall` with reason ``quorum_stale``. N rank-local
+    watchdogs can no longer disagree about whether the job is wedged.
     """
 
     def __init__(self, ckpt_dir, timeout=300.0, save_interval=100,
-                 save_fn=None, step_deadline=None, run_deadline=None):
+                 save_fn=None, step_deadline=None, run_deadline=None,
+                 cluster=None, peer_stale_after=None, peer_dead_after=None,
+                 cluster_quorum=0.5):
         self.ckpt_dir = ckpt_dir
         self.timeout = timeout
         self.save_interval = save_interval
@@ -105,6 +120,15 @@ class ElasticManager:
         self._last_step = None
         self.stalled = False
         self.stall_reason = None
+        self.cluster = cluster
+        self._monitor = None
+        if cluster is not None:
+            self._monitor = _ClusterMonitor(
+                cluster.store, rank=cluster.rank,
+                world_size=cluster.world_size,
+                stale_after=(peer_stale_after if peer_stale_after is not None
+                             else timeout),
+                dead_after=peer_dead_after, quorum=cluster_quorum)
         os.makedirs(ckpt_dir, exist_ok=True)
 
     def tick(self, step, payload=None):
@@ -129,6 +153,20 @@ class ElasticManager:
             _telemetry.emit("heartbeat_started", step=step,
                             path=self._hb_path)
         heartbeat(self._hb_path, step, payload)
+        if self.cluster is not None:
+            # same no-fsync contract as the local file; a store that
+            # briefly errors makes this rank LOOK stale to peers, which
+            # is precisely what the fault event records
+            try:
+                _publish_heartbeat(self.cluster.store, self.cluster.rank,
+                                   step, payload)
+            except Exception as e:  # noqa: BLE001 — a pluggable (KV)
+                # store can raise more than OSError; no store error may
+                # ever propagate into the step loop
+                record_fault("watchdog_errors",
+                             f"cluster heartbeat rank "
+                             f"{self.cluster.rank}: "
+                             f"{type(e).__name__}: {e}")
         self._last_step = step
         if self.save_fn is not None and self.save_interval and \
                 step > 0 and step % self.save_interval == 0:
@@ -192,6 +230,7 @@ class ElasticManager:
                                  f"on_stall: {type(e).__name__}: {e}")
 
         def _watch():
+            monitor_armed = False
             while not self._stop.wait(poll):
                 try:
                     stall = _watchdog_scan(
@@ -201,6 +240,30 @@ class ElasticManager:
                     record_fault("watchdog_errors",
                                  f"{type(e).__name__}: {e}")
                     continue
+                if not monitor_armed and self._monitor is not None \
+                        and self._last_step is not None:
+                    # a rank starts judging its PEERS' liveness only
+                    # once it is ticking itself, with a fresh grace
+                    # window from that moment: compile-time skew across
+                    # ranks (minutes on a cold start) must read as
+                    # bring-up, not staleness. Before this rank's first
+                    # tick, its own LOCAL no_heartbeat deadline is the
+                    # only liveness judge it is entitled to.
+                    monitor_armed = True
+                    self._monitor.reset_grace()
+                if stall is None and monitor_armed:
+                    # cluster quorum scan: peer_stale/peer_dead fault
+                    # events are recorded by the monitor itself; only a
+                    # QUORUM of stale ranks escalates to the stall path
+                    try:
+                        scan = self._monitor.poll()
+                    except Exception as e:  # noqa: BLE001 — survive store
+                        record_fault("watchdog_errors",
+                                     f"cluster scan: {type(e).__name__}: {e}")
+                        scan = None
+                    if scan is not None and scan["quorum_stalled"]:
+                        stall = ("quorum_stale",
+                                 {"step": self._last_step, **scan})
                 if stall is not None:
                     _stall(*stall)
                     return
@@ -210,6 +273,12 @@ class ElasticManager:
         _telemetry.emit("watchdog_start", timeout=self.timeout, poll=poll,
                         step_deadline=self.step_deadline,
                         run_deadline=self.run_deadline)
+
+    def peers_down(self):
+        """Ranks declared down cluster-wide ([] outside cluster mode)."""
+        if self._monitor is None:
+            return []
+        return self._monitor.down_ranks()
 
     def stop(self):
         self._stop.set()

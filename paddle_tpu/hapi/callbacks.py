@@ -416,6 +416,11 @@ class TelemetryCallback(Callback):
         try:
             _telemetry.sync_runtime_metrics()
             _telemetry.write_prometheus()
+            if _telemetry.pushgateway_addr():
+                # opt-in direct push (multihost ranks without a local
+                # textfile collector); push_prometheus itself degrades
+                # a dead gateway to a warning + push_failures event
+                _telemetry.push_prometheus()
             if self.snapshot_jsonl:
                 _telemetry.append_snapshot_jsonl(
                     extra={"step": self.global_step})
@@ -465,7 +470,20 @@ class ResilienceCallback(Callback):
       hangs before the first heartbeat — via `on_stall` (default: stop
       training);
     * with `resume=True` a restarted fit continues from the newest
-      complete checkpoint (kill-and-resume, the elastic contract).
+      complete checkpoint (kill-and-resume, the elastic contract);
+    * in **cluster mode** — automatic when ``PADDLE_TPU_CLUSTER_DIR``
+      is set or jax reports more than one process, or explicit via
+      `cluster=` (a `coordination.ClusterContext` or a shared store
+      directory) — the whole story goes multihost: heartbeats publish
+      into the shared store and the watchdog (always started in
+      cluster mode — it hosts the quorum scan) escalates only on a
+      QUORUM of stale ranks (one slow peer = `peer_stale` fault event,
+      a silent one = declared down cluster-wide), resume restores the
+      newest step EVERY rank verified complete (host-0 rendezvous
+      agreement, so a rank killed mid-async-save can never make peers
+      diverge), and at every checkpoint boundary each rank publishes
+      its telemetry snapshot while host 0 merges them into ONE
+      rank-labeled Prometheus textfile + cluster-wide fault log.
 
     Every degradation path is observable in
     `profiler.fault_events()` / `dispatch_stats()["fault_events"]`.
@@ -476,7 +494,9 @@ class ResilienceCallback(Callback):
                  run_deadline=None, watchdog_poll=5.0,
                  max_consecutive_rollbacks=3, on_escalate=None, on_stall=None,
                  verify_integrity=True, resume=True,
-                 grad_norm_threshold=None):
+                 grad_norm_threshold=None, cluster=None,
+                 peer_stale_after=None, peer_dead_after=None,
+                 cluster_quorum=0.5, rendezvous_timeout=30.0):
         super().__init__()
         self.grad_norm_threshold = grad_norm_threshold
         self.ckpt_dir = ckpt_dir
@@ -492,10 +512,17 @@ class ResilienceCallback(Callback):
         self.on_stall = on_stall
         self.verify_integrity = verify_integrity
         self.resume = resume
+        self.cluster = cluster
+        self.peer_stale_after = peer_stale_after
+        self.peer_dead_after = peer_dead_after
+        self.cluster_quorum = cluster_quorum
+        self.rendezvous_timeout = rendezvous_timeout
         self.global_step = 0
         self._mngr = None
         self._em = None
         self._guard = None
+        self._cluster = None
+        self._merge_thread = None
 
     # -- state capture / write-back -----------------------------------------
     def _state(self):
@@ -538,6 +565,7 @@ class ResilienceCallback(Callback):
 
     def _save_step(self, step):
         self._mngr.save(step, self._state())
+        self._cluster_checkpoint_boundary()
 
     def _restore(self, step=None):
         """Restore params/opt from the newest complete checkpoint at or
@@ -550,6 +578,166 @@ class ResilienceCallback(Callback):
             return None
         restored = self._write_back(state)
         return self._mngr.last_restored_step if restored is None else restored
+
+    # -- cluster mode --------------------------------------------------------
+    def _cluster_setup(self):
+        from ..distributed import coordination
+
+        c = self.cluster
+        if c is None:
+            # automatic: PADDLE_TPU_CLUSTER_DIR, or >1 jax process (the
+            # checkpoint root is the shared filesystem multihost jobs
+            # already have, so the store defaults under it)
+            self._cluster = coordination.cluster_context(
+                default_dir=os.path.join(self.ckpt_dir, ".cluster"))
+        elif isinstance(c, coordination.ClusterContext):
+            self._cluster = c
+        else:  # a store / shared directory: identity from env/jax
+            self._cluster = coordination.ClusterContext(
+                c, coordination.cluster_rank(),
+                coordination.cluster_world_size())
+        if self._cluster is not None:
+            coordination.init_cluster_telemetry(self._cluster)
+        return self._cluster
+
+    # wall-clock slack between hosts when judging publication/agreement
+    # freshness: pod hosts are NTP-disciplined well under this
+    CLUSTER_CLOCK_SKEW_S = 5.0
+
+    def _cluster_resume_step(self):
+        """The step EVERY rank verified complete, agreed through the
+        host-0 rendezvous (None = fresh start). A rank killed
+        mid-async-save never published its torn step, so the agreement
+        excludes it by construction.
+
+        Freshness matters on both legs: the leader only counts
+        publications at least as new as this restart toward its
+        expected-ranks wait (a dead rank's stale list still joins the
+        final intersection — that is the conservative input the
+        protocol wants), and a follower only accepts an agreement doc
+        at least as new as its OWN publication (a back-to-back rerun
+        must never read the previous run's agreement). Every failure
+        degrades — timeout falls back to this rank's own view of the
+        published lists — rather than raising into `fit()`."""
+        from ..distributed.coordination import rendezvous
+        from ..io.checkpoint import latest_common_complete_step
+
+        ctx = self._cluster
+        published_at = time.time()
+        self._mngr.publish_complete(ctx.store, ctx.rank)
+        # the agreement key must not alias a PREVIOUS run's doc:
+        # schedulers that restart all ranks with one job incarnation id
+        # export PADDLE_TPU_CLUSTER_RUN_ID and the key is namespaced by
+        # it (exact, clock-free)
+        run_id = os.environ.get("PADDLE_TPU_CLUSTER_RUN_ID")
+        if run_id:
+            import re
+
+            run_id = re.sub(r"[^A-Za-z0-9._-]", "_", run_id)[:64]
+        rdv_name = (f"restore_step_{run_id}" if run_id else "restore_step")
+        # followers reject agreement docs older than their own
+        # publication minus (one leader wait + skew): tight enough to
+        # exclude a run that ended before this restart wave, loose
+        # enough that a follower scheduled up to rendezvous_timeout
+        # after the leader still accepts its early publication. Kept
+        # even under a run id: a SINGLE rank relaunched inside one
+        # incarnation must not read the incarnation-start agreement
+        # (there is no leader republishing for it) — it should fall
+        # back to the live publications instead
+        min_wall = (published_at - self.rendezvous_timeout
+                    - self.CLUSTER_CLOCK_SKEW_S)
+        if ctx.is_leader:
+            common = latest_common_complete_step(
+                ctx.store, expected_ranks=ctx.world_size,
+                timeout=self.rendezvous_timeout,
+                min_wall=published_at - self.CLUSTER_CLOCK_SKEW_S)
+            rendezvous(ctx.store, rdv_name, {"step": common},
+                       leader=True)
+            return common, True
+        payload = rendezvous(
+            ctx.store, rdv_name,
+            # the leader may spend a full rendezvous_timeout waiting
+            # for publications (a dead rank never republishes) BEFORE
+            # it publishes the agreement — a follower deadline equal to
+            # the leader's races it on sub-second skew and degrades to
+            # the local fallback on every such restart
+            timeout=2.0 * self.rendezvous_timeout
+            + self.CLUSTER_CLOCK_SKEW_S,
+            min_wall=min_wall)
+        if payload is None:
+            # rendezvous_timeouts already recorded: degrade to this
+            # rank's own intersection of whatever publications exist.
+            # NOT a confirmed agreement — the caller must not truncate
+            # history on it (it may be older than the true agreement)
+            return latest_common_complete_step(
+                ctx.store, expected_ranks=None, timeout=0.0,
+                world_size=ctx.world_size), False
+        return payload.get("step"), True
+
+    def _cluster_checkpoint_boundary(self, wait=False):
+        """Per-rank publications + host-0 merge at a checkpoint
+        boundary: complete-step list (coordinated restore), telemetry
+        registry snapshot, and — on the leader — the cluster-wide
+        merged Prometheus textfile + fault log. The merge re-reads
+        every rank's publication and event stream, so on the leader it
+        runs in a background thread (skipped while the previous merge
+        is still running) rather than blocking the step loop; `wait`
+        joins it (train end). Failures degrade to a warning;
+        observability must never kill the run."""
+        ctx = self._cluster
+        if ctx is None:
+            return
+        try:
+            self._mngr.publish_complete(ctx.store, ctx.rank)
+            _telemetry.sync_runtime_metrics()
+            _telemetry.publish_registry(ctx.store, ctx.rank)
+            if ctx.is_leader:
+                push = _telemetry.pushgateway_addr() is not None
+                if wait:
+                    # train end: drain any in-flight merge, then merge
+                    # synchronously so the final artifacts include the
+                    # final publications. If the in-flight merge is
+                    # STILL running after the timed join, skip the
+                    # synchronous one: both would share the same
+                    # pid-keyed tmp files and corrupt each other's
+                    # output — the in-flight merge lands near-final
+                    # data on its own
+                    drained = True
+                    if self._merge_thread is not None:
+                        self._merge_thread.join(timeout=30)
+                        drained = not self._merge_thread.is_alive()
+                        if drained:
+                            self._merge_thread = None
+                    if drained:
+                        _telemetry.merge_cluster(ctx.store, push=push)
+                    else:
+                        import warnings
+
+                        warnings.warn(
+                            "paddle_tpu ResilienceCallback: background "
+                            "cluster merge still running at train end — "
+                            "final merge skipped (the in-flight one "
+                            "will land)", stacklevel=2)
+                elif self._merge_thread is None or \
+                        not self._merge_thread.is_alive():
+                    import threading
+
+                    def _merge():
+                        try:
+                            _telemetry.merge_cluster(ctx.store, push=push)
+                        except Exception:  # noqa: BLE001 — observability
+                            pass
+
+                    self._merge_thread = threading.Thread(
+                        target=_merge, daemon=True)
+                    self._merge_thread.start()
+        except Exception as e:  # noqa: BLE001 — degrade, never raise
+            import warnings
+
+            warnings.warn(
+                f"paddle_tpu ResilienceCallback: cluster publication "
+                f"failed ({type(e).__name__}: {e}) — continuing",
+                stacklevel=2)
 
     # -- lifecycle -----------------------------------------------------------
     def on_train_begin(self, logs=None):
@@ -568,13 +756,91 @@ class ResilienceCallback(Callback):
             self.ckpt_dir, max_to_keep=self.max_to_keep,
             async_save=self.async_save,
             verify_integrity=self.verify_integrity)
+        self._cluster_setup()
+        cluster_kwargs = {}
+        if self._cluster is not None:
+            cluster_kwargs = dict(
+                cluster=self._cluster,
+                # a usable default even when no local watchdog_timeout
+                # was configured (the ElasticManager fallback of 3600s
+                # would make peer staleness invisible for an hour)
+                peer_stale_after=(
+                    self.peer_stale_after
+                    if self.peer_stale_after is not None
+                    else self.watchdog_timeout or 300.0),
+                peer_dead_after=self.peer_dead_after,
+                cluster_quorum=self.cluster_quorum)
         self._em = ElasticManager(
             self.ckpt_dir, timeout=self.watchdog_timeout or 3600.0,
             save_interval=self.save_interval, save_fn=self._save_step,
-            step_deadline=self.step_deadline, run_deadline=self.run_deadline)
+            step_deadline=self.step_deadline, run_deadline=self.run_deadline,
+            **cluster_kwargs)
         self.global_step = 0
         if self.resume:
-            restored = self._restore()
+            if self._cluster is not None:
+                coordinated = True
+                agreed = False
+                try:
+                    step, agreed = self._cluster_resume_step()
+                except Exception as e:  # noqa: BLE001 — store I/O: degrade
+                    from ..runtime.resilience import record_fault
+
+                    record_fault(
+                        "rendezvous_timeouts",
+                        f"coordinated restore degraded to local: "
+                        f"{type(e).__name__}: {e}")
+                    step = None
+                    coordinated = False
+                if coordinated:
+                    restored = (self._restore(step)
+                                if step is not None else None)
+                else:
+                    # split/unwritable store: rank-local resilience
+                    # stays fully active — restore this rank's own
+                    # newest complete checkpoint, exactly what the
+                    # recorded fault message promises
+                    restored = self._restore()
+                if coordinated and agreed and step is not None and \
+                        restored != step:
+                    # this rank's copy of the agreed step failed to
+                    # restore (corruption fallback landed below it):
+                    # peers run from `step` while this rank holds
+                    # `restored` — divergence that must be LOUD, and
+                    # this rank's copy of the agreed step must survive
+                    # for a retry, so no truncation either
+                    from ..runtime.resilience import record_fault
+
+                    record_fault(
+                        "restore_fallbacks",
+                        f"cluster divergence: restored {restored} != "
+                        f"agreed step {step}")
+                    import warnings
+
+                    warnings.warn(
+                        f"paddle_tpu ResilienceCallback: restored step "
+                        f"{restored} instead of the cluster-agreed "
+                        f"{step} (local copy failed verification) — "
+                        "this rank has DIVERGED from its peers",
+                        stacklevel=2)
+                elif coordinated and agreed and restored is not None:
+                    # coordinated-restart truncation: the cluster agreed
+                    # to resume from `restored` — this rank's steps past
+                    # it are an abandoned future (they would collide
+                    # with upcoming interval saves and mislead per-rank
+                    # rollback). GATED ON A RENDEZVOUS-CONFIRMED
+                    # agreement: a timeout-fallback step is this rank's
+                    # local guess and may be OLDER than the true
+                    # agreement — truncating on it could destroy the
+                    # very step the leader picked. A fresh-start
+                    # agreement (None) likewise deletes NOTHING.
+                    try:
+                        self._mngr.discard_after(restored)
+                        self._mngr.publish_complete(self._cluster.store,
+                                                    self._cluster.rank)
+                    except Exception:  # noqa: BLE001 — best-effort
+                        pass
+            else:
+                restored = self._restore()
             if restored is not None:
                 self.global_step = restored + 1
 
@@ -604,13 +870,36 @@ class ResilienceCallback(Callback):
             else:
                 self.model.stop_training = True
 
-        if self.watchdog_timeout is not None:
+        # cluster mode starts the watchdog UNCONDITIONALLY: the watchdog
+        # loop is where the quorum scan runs, and peers publishing
+        # heartbeats nobody reads would make protocol 1 silently inert
+        # in the documented default configuration (no watchdog_timeout)
+        if self.watchdog_timeout is not None or self._cluster is not None:
             self._em.start_watchdog(on_stall=_stall,
                                     poll=self.watchdog_poll)
         # an immediate checkpoint guarantees a rollback target exists
         # before the first save interval (a NaN on step 0 must have
-        # somewhere finite to roll back TO)
-        self._mngr.save(self.global_step, self._state(), force=True)
+        # somewhere finite to roll back TO). Skipped when this exact
+        # step is already complete on disk: orbax's force=True does not
+        # overwrite an existing step (StepAlreadyExistsError), and the
+        # rollback target already exists — reachable on a cluster
+        # fresh-start whose dir still holds a previous run's step 0
+        from ..io.checkpoint import complete_steps
+
+        if self.global_step not in complete_steps(self.ckpt_dir):
+            self._mngr.save(self.global_step, self._state(), force=True)
+        elif self.global_step == 0:
+            # a complete step 0 that this run did NOT just restore is a
+            # previous run's leftovers: it stays the rollback target
+            # (same as before — rollback restores newest-complete), but
+            # that must be loud, not silent
+            import warnings
+
+            warnings.warn(
+                "paddle_tpu ResilienceCallback: initial checkpoint "
+                "skipped — step 0 on disk predates this run, and a "
+                "rollback would restore ITS weights, not this run's "
+                "fresh initialization", stacklevel=2)
 
     def on_train_batch_end(self, step, logs=None):
         logs = logs or {}
@@ -635,6 +924,8 @@ class ResilienceCallback(Callback):
         if self._mngr is not None:
             # final checkpoint so a follow-up fit resumes at the end
             self._mngr.save(self.global_step, self._state(), force=True)
+            self._mngr.wait()
+            self._cluster_checkpoint_boundary(wait=True)
             self._mngr.close()
 
 

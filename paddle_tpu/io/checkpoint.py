@@ -58,7 +58,8 @@ from ..runtime.resilience import (
 __all__ = ["CheckpointManager", "save_checkpoint", "load_checkpoint",
            "abstract_state", "leaf_checksums", "verify_checksums",
            "complete_steps", "latest_complete_step", "IntegrityError",
-           "INTEGRITY_BASENAME"]
+           "INTEGRITY_BASENAME", "publish_complete_steps",
+           "latest_common_complete_step"]
 
 INTEGRITY_BASENAME = "integrity.json"
 
@@ -114,6 +115,91 @@ def latest_complete_step(directory):
     """Newest complete checkpoint step under `directory`, or None."""
     steps = complete_steps(directory)
     return steps[-1] if steps else None
+
+
+# ---------------------------------------------------------------------------
+# coordinated restore (multihost): the cluster-wide definition of
+# "newest step EVERYONE completed", over a coordination store
+
+_CKPT_PREFIX = "ckpt"  # mirrors distributed/coordination.py CKPT_PREFIX
+#                        (duck-typed store param keeps this module free
+#                        of a distributed/ import)
+
+
+def publish_complete_steps(store, rank, directory):
+    """Publish this rank's complete checkpoint steps into the
+    coordination store (``ckpt/rank_<r>``). Ranks publish at every save
+    commit and again at restore time; `latest_common_complete_step`
+    intersects the publications so no rank ever restores a step a peer
+    never committed. Returns the published step list."""
+    steps = complete_steps(directory)
+    store.put(f"{_CKPT_PREFIX}/rank_{int(rank)}",
+              {"rank": int(rank), "steps": steps, "wall": time.time()})
+    return steps
+
+
+def latest_common_complete_step(store, expected_ranks=None, timeout=30.0,
+                                poll=0.05, min_wall=None, world_size=None):
+    """The max step EVERY publishing rank has complete — the one step a
+    crashed multihost job can restore WITHOUT diverging when rank k
+    died mid-async-save (k's torn step never entered k's publication,
+    so the intersection excludes it).
+
+    With `expected_ranks` (an int) the scan waits up to `timeout`
+    seconds for that many rank publications before intersecting; a
+    publication that never arrives degrades — `rendezvous_timeouts`
+    fault event, intersect what IS present — rather than hanging the
+    restore. With `min_wall`, only publications at least that fresh
+    count toward the wait (each restarting rank republishes, and the
+    per-rank key makes a republication REPLACE the stale one — so
+    after the wait, live ranks are fresh and only genuinely-dead
+    ranks' records are stale). The final intersection always uses
+    every record present: a dead rank's stale list is exactly the
+    conservative input the protocol wants. Without `min_wall`, a
+    previous run's leftover publications can satisfy the wait before
+    live ranks republish — pass your own publication time minus an
+    NTP-skew allowance. Returns None when no step is common (fresh
+    start).
+    A stale publication from a dead rank stays safe by construction:
+    its step list is exactly what that rank had committed, so the
+    intersection only ever shrinks toward older, safer steps.
+
+    Retention interacts with the intersection: survivors that run far
+    past a dead rank eventually prune (`max_to_keep`) the steps the
+    dead rank still holds, and the intersection goes EMPTY — a
+    consistent outcome (every rank computes the same None) but a total
+    restart. Size `max_to_keep * save_interval` to cover the longest
+    peer outage the job should survive."""
+    if world_size is None:
+        world_size = expected_ranks
+    deadline = time.monotonic() + float(timeout)
+    while True:
+        records = [store.get(k) for k in store.list(_CKPT_PREFIX)]
+        records = [r for r in records
+                   if isinstance(r, dict) and "steps" in r
+                   # a store dir reused by a SMALLER world holds ghost
+                   # publications whose frozen lists would poison every
+                   # future intersection (same ghost-record class the
+                   # quorum monitor filters from down/)
+                   and (world_size is None
+                        or 0 <= int(r.get("rank", -1)) < int(world_size))]
+        fresh = records if min_wall is None else [
+            r for r in records if float(r.get("wall", 0.0)) >= min_wall]
+        if expected_ranks is None or len(fresh) >= int(expected_ranks):
+            break
+        if time.monotonic() >= deadline:
+            record_fault(
+                "rendezvous_timeouts",
+                f"complete-step publications: {len(fresh)}/"
+                f"{expected_ranks} fresh ranks within {timeout}s")
+            break
+        time.sleep(min(poll, max(0.0, deadline - time.monotonic())))
+    if not records:
+        return None
+    common = set(int(s) for s in records[0]["steps"])
+    for r in records[1:]:
+        common &= set(int(s) for s in r["steps"])
+    return max(common) if common else None
 
 
 # ---------------------------------------------------------------------------
@@ -390,6 +476,39 @@ class CheckpointManager:
         """Newest COMPLETE step (tmp-dir aware; shared with elastic)."""
         self._flush_manifests()
         return latest_complete_step(self.directory)
+
+    def publish_complete(self, store, rank):
+        """Flush pending integrity manifests, then publish this rank's
+        complete steps into a coordination store (the multihost
+        coordinated-restore protocol). Returns the published list."""
+        self._flush_manifests()
+        return publish_complete_steps(store, rank, self.directory)
+
+    def discard_after(self, step):
+        """Delete every complete step NEWER than `step` — the
+        coordinated-restart truncation: once the cluster agreed to
+        resume from `step`, any step a rank holds past it encodes a
+        future the cluster abandoned. Keeping those steps would (a)
+        make later interval saves collide with them (orbax never
+        overwrites an existing step) and (b) leave BadStepGuard's
+        "newest complete" pointing at divergent state. Returns the
+        steps removed."""
+        removed = []
+        for s in complete_steps(self.directory):
+            if s <= int(step):
+                continue
+            try:
+                self._mngr.delete(s)  # orbax keeps its bookkeeping
+            except Exception:  # noqa: BLE001 — fall back to the fs
+                import shutil
+
+                shutil.rmtree(self._step_dir(s), ignore_errors=True)
+            self._pending_manifests.pop(s, None)
+            removed.append(s)
+        if removed:
+            _telemetry.emit("checkpoint_discard", after=int(step),
+                            steps=removed)
+        return removed
 
     def all_steps(self):
         return sorted(self._mngr.all_steps())

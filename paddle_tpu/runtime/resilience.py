@@ -83,6 +83,15 @@ _EVENT_KINDS = (
     "stale_manifests",        # a warm-start shape manifest was rejected
     #                           (version mismatch, unresolvable op) or an
     #                           entry failed to replay; cold start instead
+    "peer_stale",             # a cluster peer's heartbeat went stale
+    #                           (single slow rank: degrade, don't abort)
+    "peer_dead",              # a peer silent past the hard deadline was
+    #                           declared down cluster-wide
+    "rendezvous_timeouts",    # a rendezvous wait expired; caller degraded
+    #                           (cold start / local fallback) instead of
+    #                           hanging
+    "push_failures",          # a pushgateway export failed; warned and
+    #                           dropped, never raised into training
 )
 
 _events_lock = threading.Lock()

@@ -71,9 +71,11 @@ __all__ = [
     "counter", "gauge", "histogram", "snapshot", "reset_metrics",
     "enabled", "set_enabled",
     "EventStream", "configure", "event_stream", "emit", "events_path",
-    "read_events",
-    "write_prometheus", "parse_prometheus_textfile",
+    "read_events", "set_rank", "get_rank",
+    "write_prometheus", "render_prometheus", "parse_prometheus_textfile",
     "append_snapshot_jsonl", "ScalarsSink", "merge_histograms",
+    "publish_registry", "merge_cluster",
+    "pushgateway_addr", "push_prometheus",
     "sync_runtime_metrics", "poll_memory_gauges",
     "schema", "SCHEMA_VERSION", "EVENT_KINDS",
     "DEFAULT_BUCKETS", "op_sample_every",
@@ -91,6 +93,28 @@ _enabled = _env_flag("PADDLE_TPU_TELEMETRY", "1")
 
 def enabled():
     return _enabled
+
+
+# cluster rank tag: when set (env, or coordination layer at cluster
+# bring-up) every event record carries it, so N interleaved multihost
+# streams stay attributable after a merge
+try:
+    _rank = int(os.environ["PADDLE_TPU_CLUSTER_RANK"])
+except (KeyError, ValueError):
+    _rank = None
+
+
+def set_rank(rank):
+    """Tag subsequent events (and default pushgateway grouping) with
+    this process's cluster rank. Returns the previous value."""
+    global _rank
+    prev = _rank
+    _rank = None if rank is None else int(rank)
+    return prev
+
+
+def get_rank():
+    return _rank
 
 
 # listeners for runtime kill-switch flips: consumers that latch a value
@@ -435,6 +459,8 @@ class EventStream:
         rec = {"ts": round(time.time(), 6),
                "mono": round(time.monotonic(), 6),
                "host": self._host, "pid": self._pid, "kind": kind}
+        if _rank is not None:
+            rec["rank"] = _rank
         rec.update(fields)
         try:
             line = json.dumps(rec, default=str) + "\n"
@@ -589,17 +615,10 @@ def _fmt_value(v):
     return repr(v)
 
 
-def write_prometheus(path=None, snap=None):
-    """Render the registry in Prometheus text exposition format and
-    write it atomically (tmp + rename — the node-exporter textfile
-    collector convention, so a scraper never reads a torn file).
-    Default path: ``<telemetry dir>/metrics.prom``. Returns the path
-    written, or None when there is nowhere to write."""
-    if path is None:
-        d = _config["dir"]
-        if d is None:
-            return None
-        path = os.path.join(d, "metrics.prom")
+def render_prometheus(snap=None):
+    """The registry (or a snapshot) as Prometheus text exposition
+    format — shared by the textfile writer and the pushgateway
+    exporter."""
     snap = snap if snap is not None else _REGISTRY.snapshot()
     lines = []
     for name in sorted(snap):
@@ -629,11 +648,277 @@ def write_prometheus(path=None, snap=None):
             else:
                 lines.append(
                     f"{name}{_fmt_labels(labels)} {_fmt_value(s['value'])}")
+    return "\n".join(lines) + "\n"
+
+
+def write_prometheus(path=None, snap=None):
+    """Render the registry in Prometheus text exposition format and
+    write it atomically (tmp + rename — the node-exporter textfile
+    collector convention, so a scraper never reads a torn file).
+    Default path: ``<telemetry dir>/metrics.prom``. Returns the path
+    written, or None when there is nowhere to write."""
+    if path is None:
+        d = _config["dir"]
+        if d is None:
+            return None
+        path = os.path.join(d, "metrics.prom")
+    text = render_prometheus(snap)
     tmp = f"{path}.tmp.{os.getpid()}"
     with open(tmp, "w") as f:
-        f.write("\n".join(lines) + "\n")
+        f.write(text)
     os.replace(tmp, path)
     return path
+
+
+# ---------------------------------------------------------------------------
+# pushgateway exporter (opt-in): multihost ranks push straight to a
+# Prometheus pushgateway instead of riding the textfile-collector hop
+
+def pushgateway_addr():
+    """``PADDLE_TPU_TELEMETRY_PUSHGATEWAY`` as ``host:port``, or None
+    (the exporter is strictly opt-in)."""
+    return os.environ.get("PADDLE_TPU_TELEMETRY_PUSHGATEWAY") or None
+
+
+def push_prometheus(addr=None, snap=None, job="paddle_tpu", instance=None,
+                    timeout=2.0):
+    """PUT the registry (or `snap`) to a Prometheus pushgateway at
+    ``http://<addr>/metrics/job/<job>/instance/<instance>``.
+
+    `instance` defaults to ``rank<r>`` in cluster mode, else
+    ``<host>:<pid>`` — each rank groups under its own instance so
+    pushes never clobber a peer's series. Returns True on an accepted
+    push. EVERY failure path (no listener, refused connection, HTTP
+    error, timeout) degrades to a warning + `push_failures` fault
+    event and returns False — a dead pushgateway must never raise into
+    the training loop that is pushing to it."""
+    addr = addr or pushgateway_addr()
+    if addr is None:
+        return False
+    if instance is None:
+        instance = (f"rank{_rank}" if _rank is not None
+                    else f"{socket.gethostname()}:{os.getpid()}")
+    try:
+        import http.client
+
+        host, _, port = addr.partition(":")
+        body = render_prometheus(snap).encode()
+        conn = http.client.HTTPConnection(host, int(port or 9091),
+                                          timeout=float(timeout))
+        try:
+            conn.request("PUT", f"/metrics/job/{job}/instance/{instance}",
+                         body=body,
+                         headers={"Content-Type": "text/plain"})
+            resp = conn.getresponse()
+            resp.read()
+            status = resp.status
+        finally:
+            conn.close()
+        if status >= 300:
+            raise OSError(f"pushgateway returned HTTP {status}")
+    except Exception as e:  # noqa: BLE001 — degrade, never raise into fit
+        from .resilience import record_fault  # lazy: no import cycle
+
+        record_fault("push_failures",
+                     f"{addr}: {type(e).__name__}: {e}")
+        import warnings
+
+        warnings.warn(
+            f"paddle_tpu telemetry: pushgateway push to {addr} failed "
+            f"({type(e).__name__}: {e}) — metrics dropped for this "
+            "interval, training continues", stacklevel=2)
+        return False
+    return True
+
+
+# ---------------------------------------------------------------------------
+# cross-host aggregation: per-rank publication + host-0 merge
+
+def publish_registry(store, rank=None, extra=None):
+    """Publish this rank's full telemetry view — registry snapshot,
+    fault-event counters, and the bounded fault log — into a
+    coordination store under ``telemetry/rank_<r>``. Ranks publish at
+    checkpoint boundaries; host 0 runs `merge_cluster` over the
+    publications."""
+    from .resilience import fault_events, fault_log  # lazy: no cycle
+
+    rank = rank if rank is not None else (_rank or 0)
+    payload = {"rank": int(rank), "wall": round(time.time(), 6),
+               "host": socket.gethostname(), "pid": os.getpid(),
+               "metrics": _REGISTRY.snapshot(),
+               "fault_events": fault_events(),
+               "fault_log": [{"ts": round(ts, 6), "fault": kind,
+                              "detail": detail}
+                             for ts, kind, detail in fault_log(last=256)]}
+    if extra:
+        payload.update(extra)
+    store.put(f"telemetry/rank_{int(rank)}", payload)
+    return payload
+
+
+def _merge_rank_snapshots(ranks_snaps):
+    """One combined registry snapshot from {rank: snapshot}: every
+    series gains a ``rank`` label, and histograms additionally get a
+    ``rank="all"`` series merged across ranks (mergeable fixed buckets
+    are why Histogram bounds are frozen at declaration)."""
+    merged = {}
+    for rank in sorted(ranks_snaps):
+        for name, fam in ranks_snaps[rank].items():
+            out = merged.get(name)
+            if out is None:
+                out = {"type": fam["type"], "help": fam.get("help", ""),
+                       "labelnames": list(fam.get("labelnames", ()))
+                       + ["rank"], "series": []}
+                if "buckets" in fam:
+                    out["buckets"] = list(fam["buckets"])
+                merged[name] = out
+            for s in fam["series"]:
+                rec = dict(s)
+                rec["labels"] = {**s["labels"], "rank": str(rank)}
+                out["series"].append(rec)
+    # histogram aggregates: group each family's series by their
+    # original (rank-less) labels and merge bucket counts
+    for name, fam in merged.items():
+        if fam["type"] != "histogram":
+            continue
+        groups = {}
+        for s in fam["series"]:
+            base = tuple(sorted((k, v) for k, v in s["labels"].items()
+                                if k != "rank"))
+            groups.setdefault(base, []).append(s)
+        for base, series in groups.items():
+            agg = merge_histograms(series)
+            agg["labels"] = {**dict(base), "rank": "all"}
+            fam["series"].append(agg)
+    return merged
+
+
+def merge_cluster(store, out_dir=None, push=False):
+    """Host-0 aggregation: read every rank's `publish_registry`
+    publication (plus, for directory stores, every per-rank event
+    stream under ``events/rank_<r>/``), and produce ONE view of the
+    whole job:
+
+    * ``<out_dir>/cluster.prom`` — a Prometheus textfile whose every
+      series carries a ``rank`` label (histograms gain a merged
+      ``rank="all"`` aggregate);
+    * ``<out_dir>/faults.jsonl`` — the cluster-wide fault log, all
+      ranks interleaved by wall time, each record rank-tagged. Fault
+      events a killed rank flushed to its event stream in its final
+      instant (the per-record-flush contract) are included even though
+      that rank never published again.
+
+    `out_dir` defaults to ``<store root>/merged``. With `push=True`
+    (or rather: whenever a pushgateway is configured and push is
+    requested) the merged snapshot is also pushed under
+    ``instance="cluster"``. Returns a summary dict; never raises into
+    the caller (a merge failure is observability lost, not a training
+    failure).
+
+    Known limitation: a fault recorded while the ``PADDLE_TPU_TELEMETRY``
+    kill switch was OFF (emit no-ops, so it exists only in the
+    publication fault_log) is indistinguishable from a stream
+    duplicate once the rank's stream has earlier records, and the
+    stream-supersedes dedup drops it — disabling telemetry accepts
+    holes in telemetry-derived artifacts."""
+    ranks_snaps, fault_recs, ranks = {}, [], []
+    for key in store.list("telemetry"):
+        pub = store.get(key)
+        if not isinstance(pub, dict) or "rank" not in pub:
+            continue
+        rank = int(pub["rank"])
+        ranks.append(rank)
+        if isinstance(pub.get("metrics"), dict):
+            ranks_snaps[rank] = pub["metrics"]
+        for f in pub.get("fault_log") or ():
+            fault_recs.append({**f, "rank": rank, "source": "publication",
+                               "pid": pub.get("pid")})
+    # per-rank event streams (directory stores): catches the fault a
+    # dying rank flushed after its last publication
+    root = getattr(store, "root", None)
+    if root:
+        events_root = os.path.join(root, "events")
+        try:
+            rank_dirs = sorted(os.listdir(events_root))
+        except OSError:
+            rank_dirs = []
+    # (rank, pid) -> earliest ts across that INCARNATION's stream
+    # events: a reused store dir holds the previous incarnation's
+    # stream too, and its earlier timestamps must not bound (and so
+    # swallow) a relaunched process's pre-stream publication faults
+    stream_start = {}
+    if root:
+        for d in rank_dirs:
+            if not d.startswith("rank_"):
+                continue
+            try:
+                rank = int(d[len("rank_"):])
+            except ValueError:
+                continue
+            for ev in read_events(os.path.join(events_root, d,
+                                               "events.jsonl")):
+                ts = ev.get("ts")
+                if isinstance(ts, (int, float)):
+                    key = (rank, ev.get("pid"))
+                    prev = stream_start.get(key)
+                    if prev is None or ts < prev:
+                        stream_start[key] = ts
+                if ev.get("kind") != "fault":
+                    continue
+                fault_recs.append(
+                    {"ts": ev.get("ts"), "fault": ev.get("fault"),
+                     "detail": ev.get("detail"),
+                     "rank": ev.get("rank", rank), "source": "events"})
+    # a fault recorded while the stream was live exists in BOTH sources
+    # (record_fault's log entry and the emit), with timestamps differing
+    # by the microseconds between the two time.time() calls — so
+    # per-record keys can never match them up. Drop a publication
+    # record only from the rank's stream start onward; faults recorded
+    # BEFORE the stream was configured (warm-start/import faults ahead
+    # of cluster bring-up) exist only in the publication and must
+    # survive. The 10ms slack covers the record-vs-emit timestamp gap
+    # of a fault that IS the rank's first stream event.
+    def _dup(r):
+        start = stream_start.get((r["rank"], r.get("pid")))
+        return (r["source"] != "events" and start is not None
+                and (r.get("ts") or 0.0) >= start - 0.01)
+
+    fault_recs = [r for r in fault_recs if not _dup(r)]
+    fault_recs.sort(key=lambda r: (r.get("ts") or 0.0, r["rank"]))
+    out = {"ranks": sorted(set(ranks)), "fault_count": len(fault_recs),
+           "prom_path": None, "faults_path": None, "snapshot": {},
+           "faults": fault_recs}
+    try:
+        # inside the guard: ranks running skewed versions can publish
+        # incompatible snapshots (histogram bucket layouts differ →
+        # merge_histograms raises), and this function promises callers
+        # a degraded summary, never an exception
+        merged = _merge_rank_snapshots(ranks_snaps)
+        out["snapshot"] = merged
+        if out_dir is None:
+            if root is None:
+                raise OSError("no out_dir and store has no root directory")
+            out_dir = os.path.join(root, "merged")
+        os.makedirs(out_dir, exist_ok=True)
+        out["prom_path"] = write_prometheus(
+            os.path.join(out_dir, "cluster.prom"), snap=merged)
+        faults_path = os.path.join(out_dir, "faults.jsonl")
+        tmp = f"{faults_path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            for r in fault_recs:
+                f.write(json.dumps(r, default=str) + "\n")
+        os.replace(tmp, faults_path)
+        out["faults_path"] = faults_path
+        if push:
+            push_prometheus(snap=merged, instance="cluster")
+        emit("cluster_merge", ranks=out["ranks"],
+             fault_count=len(fault_recs), prom_path=out["prom_path"])
+    except Exception as e:  # noqa: BLE001 — observability lost, not a crash
+        import warnings
+
+        warnings.warn(f"paddle_tpu telemetry: cluster merge write failed "
+                      f"({type(e).__name__}: {e})", stacklevel=2)
+    return out
 
 
 _PROM_LINE = None  # compiled lazily (stdlib re, parse path only)
@@ -883,6 +1168,11 @@ EVENT_KINDS = (
     #                       disk load) with its duration
     "compile_cache_hit",  # persistent-cache disk hit
     "precompile",         # warm-start AOT precompile summary
+    "rendezvous",         # distributed/coordination.py barrier outcome
+    #                       (leader published / follower ok / timeout)
+    "cluster_merge",      # host-0 cross-rank telemetry + fault-log merge
+    "checkpoint_discard",  # coordinated-restart truncation: steps newer
+    #                        than the agreed restore step were deleted
 )
 
 

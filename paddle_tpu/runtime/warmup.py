@@ -69,6 +69,7 @@ __all__ = [
     "time_to_first_step",
     "reset_first_step", "note_op_compile", "record_op", "record_program",
     "manifest", "manifest_record_count", "save_manifest", "load_manifest",
+    "rendezvous_manifest",
     "precompile", "prewarm_program", "pending_programs",
     "reset_manifest_records",
 ]
@@ -713,6 +714,24 @@ def save_manifest(path=None):
     return path
 
 
+def _validate_manifest_doc(doc, origin):
+    """None when `doc` matches this process's versions, else degrades
+    to a ``stale_manifests`` fault event and returns the reason."""
+    vers = _versions()
+    if doc.get("version") != MANIFEST_VERSION:
+        reason = (f"manifest version {doc.get('version')} != "
+                  f"{MANIFEST_VERSION}")
+    else:
+        reason = None
+        for k in ("jax", "paddle_tpu"):
+            if doc.get(k) != vers[k]:
+                reason = f"{k} {doc.get(k)} != {vers[k]}"
+                break
+    if reason is not None:
+        record_fault("stale_manifests", f"{origin}: {reason}")
+    return reason
+
+
 def load_manifest(path):
     """Load + validate a manifest. A missing/corrupt/version-mismatched
     file degrades to None (cold start) with a ``stale_manifests`` fault
@@ -725,17 +744,53 @@ def load_manifest(path):
         record_fault("stale_manifests",
                      f"{path}: unreadable ({type(e).__name__})")
         return None
-    vers = _versions()
-    if doc.get("version") != MANIFEST_VERSION:
-        record_fault("stale_manifests",
-                     f"{path}: manifest version {doc.get('version')} != "
-                     f"{MANIFEST_VERSION}")
+    if _validate_manifest_doc(doc, path) is not None:
         return None
-    for k in ("jax", "paddle_tpu"):
-        if doc.get(k) != vers[k]:
+    return doc
+
+
+def rendezvous_manifest(cluster, path=None, timeout=60.0, min_wall=None):
+    """Multihost warm start without the manifest race: host 0 saves the
+    shape manifest (when `path` or ``PADDLE_TPU_SHAPE_MANIFEST`` names
+    one) and publishes the full document through the coordination
+    store's rendezvous; every peer waits-and-reads instead of N ranks
+    racing one file (the PR-4 follow-up). Returns the manifest doc to
+    feed `precompile`, or None when the rendezvous timed out or the
+    published doc fails version validation — both degrade to a cold
+    start (`rendezvous_timeouts` / `stale_manifests` fault events),
+    never an exception at startup.
+
+    A store dir REUSED across runs still holds the previous
+    incarnation's publication; by default a follower accepts it (same
+    versions — at worst some precompiles are stale, never wrong).
+    Jobs whose shape set changes between runs should pass `min_wall`
+    (this run's launch wall time) so followers wait for the new
+    leader's document instead."""
+    from ..distributed.coordination import rendezvous
+
+    if cluster.is_leader:
+        doc = manifest()
+        try:
+            save_manifest(path)
+        except OSError as e:
             record_fault("stale_manifests",
-                         f"{path}: {k} {doc.get(k)} != {vers[k]}")
-            return None
+                         f"manifest save before rendezvous: {e}")
+        try:
+            rendezvous(cluster.store, "shape_manifest", doc,
+                       timeout=timeout, leader=True)
+        except Exception as e:  # noqa: BLE001 — split/unwritable store:
+            # the leader still warm-starts from its own doc; peers will
+            # time out and cold-start with their own fault events
+            record_fault("stale_manifests",
+                         f"manifest rendezvous publish: "
+                         f"{type(e).__name__}: {e}")
+        return doc
+    doc = rendezvous(cluster.store, "shape_manifest", timeout=timeout,
+                     min_wall=min_wall)
+    if doc is None:
+        return None  # rendezvous_timeouts already recorded: cold start
+    if _validate_manifest_doc(doc, "shape_manifest rendezvous") is not None:
+        return None
     return doc
 
 

@@ -1,0 +1,141 @@
+"""Child process for the 3-rank cluster resilience suite
+(test_cluster_resilience.py).
+
+One child = one cluster rank. Identity and store come from the
+PADDLE_TPU_CLUSTER_* env vars the parent sets; checkpoints go to a
+per-rank directory under a shared root (each rank's orbax manager owns
+its own tree — the coordination layer, not orbax, is what keeps the
+ranks agreeing).
+
+Phases (argv[1]):
+
+* ``train``  — tick cluster heartbeats under a quorum watchdog while
+  saving + publishing checkpoints each step. The parent SIGKILLs rank 1
+  mid-async-save via PADDLE_TPU_FAULT_INJECT (a big incompressible
+  state keeps the background write in flight at the kill point, same
+  trick as _resilience_child.py). Survivors keep ticking long enough to
+  observe the dead peer, then print a JSON result line: their watchdog
+  must have recorded `peer_stale`/`peer_dead` but must NOT have
+  quorum-stalled for a single dead rank.
+* ``restore`` — crash-restart: republish this rank's complete steps,
+  agree on the cluster-wide restore step (leader computes + rendezvous,
+  followers wait-and-read), restore it, and print the step + restored
+  payload for the parent's divergence check.
+"""
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from paddle_tpu.distributed import coordination  # noqa: E402
+from paddle_tpu.distributed.elastic import ElasticManager  # noqa: E402
+from paddle_tpu.io.checkpoint import (  # noqa: E402
+    CheckpointManager, latest_common_complete_step,
+)
+from paddle_tpu.runtime.resilience import fault_events  # noqa: E402
+from paddle_tpu.runtime import telemetry as _telemetry  # noqa: E402
+
+PHASE = sys.argv[1]
+CKPT_ROOT = sys.argv[2]
+STEPS = int(sys.argv[3]) if len(sys.argv) > 3 else 4
+
+ctx = coordination.cluster_context()
+assert ctx is not None, "cluster env not set"
+coordination.init_cluster_telemetry(ctx)
+rank_dir = os.path.join(CKPT_ROOT, f"rank_{ctx.rank}")
+
+
+def _state(step, big=False):
+    # random f32 is incompressible: the async OCDBT write of ~64MB is
+    # still in flight when the injector kills us at the post-queue site
+    n = 4096 if big else 8
+    rng = np.random.RandomState(step)
+    return {"w": jnp.asarray(rng.randn(n, n).astype(np.float32)),
+            "step": jnp.int32(step)}
+
+
+def train():
+    # stale threshold well above one step's worst-case save+publish wall
+    # time (orbax on CPU can take seconds on a cold manager, more under
+    # full-suite load): a healthy peer mid-save must not read as stale,
+    # or TWO busy peers would quorum-stall the job the test proves
+    # stays up
+    em = ElasticManager(rank_dir, timeout=600.0, cluster=ctx,
+                        peer_stale_after=8.0, peer_dead_after=14.0)
+    em.start_watchdog(poll=0.25)
+    mngr = CheckpointManager(rank_dir, max_to_keep=None, async_save=True)
+    kill_step = int(os.environ.get("CLUSTER_CHILD_KILL_STEP", "-1"))
+    for step in range(STEPS):
+        big = step == kill_step
+        mngr.save(step, _state(step, big=big), force=True)
+        # (unreachable past this point at the kill step: the injector
+        # SIGKILLs inside save() at checkpoint.async_started)
+        mngr.wait()
+        mngr.publish_complete(ctx.store, ctx.rank)
+        _telemetry.publish_registry(ctx.store, ctx.rank)
+        em.tick(step)
+        time.sleep(0.3)
+    # keep heartbeating past the dead peer's hard deadline so this
+    # rank's monitor observes stale -> dead; a SINGLE dead rank must
+    # degrade (peer events), never quorum-stall the survivors
+    for extra in range(120):
+        em.tick(STEPS + extra)
+        time.sleep(0.3)
+        if em.peers_down():
+            break
+    time.sleep(0.5)  # one more poll so peer_dead definitely recorded
+    em.tick(STEPS + 121)
+    em.stop()
+    mngr.close()
+    fe = fault_events()
+    print("RESULT " + json.dumps({
+        "rank": ctx.rank, "stalled": em.stalled,
+        "stall_reason": em.stall_reason, "peers_down": em.peers_down(),
+        "peer_stale": fe["peer_stale"], "peer_dead": fe["peer_dead"],
+    }), flush=True)
+
+
+def restore():
+    mngr = CheckpointManager(rank_dir, max_to_keep=None, async_save=False)
+    published_at = time.time()
+    mngr.publish_complete(ctx.store, ctx.rank)
+    if ctx.is_leader:
+        # freshness-gated wait: the dead rank never republishes, so the
+        # leader waits out the timeout (rendezvous_timeouts fault event)
+        # and then intersects ALL publications — including the dead
+        # rank's stale, conservative one
+        step = latest_common_complete_step(
+            ctx.store, expected_ranks=ctx.world_size, timeout=3.0,
+            min_wall=published_at - 5.0)
+        coordination.rendezvous(ctx.store, "restore_step", {"step": step},
+                                leader=True)
+    else:
+        payload = coordination.rendezvous(
+            ctx.store, "restore_step", timeout=15.0,
+            min_wall=published_at - 5.0)
+        step = (payload or {}).get("step")
+        if step is None:  # degraded path: local intersection
+            step = latest_common_complete_step(ctx.store, timeout=0.0,
+                                               world_size=ctx.world_size)
+    assert step is not None, "no common step to restore"
+    restored = mngr.restore(step)
+    mngr.close()
+    print("RESULT " + json.dumps({
+        "rank": ctx.rank, "step": int(step),
+        "restored_step": int(np.asarray(restored["step"])),
+        "w00": float(np.asarray(restored["w"])[0, 0]),
+    }), flush=True)
+
+
+if PHASE == "train":
+    train()
+elif PHASE == "restore":
+    restore()
+else:  # pragma: no cover
+    raise SystemExit(f"unknown phase {PHASE}")

@@ -108,3 +108,81 @@ def require_partial_auto_spmd():
         pytest.skip("partial-auto shard_map unsupported on this host's "
                     "XLA backend (PartitionId/SPMD gap, likely TPU-only "
                     "until a jax upgrade): " + err)
+
+
+# the smallest real cross-process computation: 2 processes, 1 CPU device
+# each, jax.distributed rendezvous, then one jitted reduction whose
+# input is sharded across BOTH processes — exactly the operation the
+# multihost suite needs and exactly what some jaxlib CPU backends
+# reject with "Multiprocess computations aren't implemented on the CPU
+# backend".
+_MP_PROBE_CHILD = r"""
+import os, sys
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+pid, port = int(sys.argv[1]), sys.argv[2]
+jax.distributed.initialize("127.0.0.1:" + port, 2, pid)
+mesh = Mesh(np.array(jax.devices()), ("dp",))
+x = jax.make_array_from_callback(
+    (2,), NamedSharding(mesh, P("dp")),
+    lambda idx: np.ones(1, np.float32))
+out = jax.jit(lambda v: v.sum(),
+              out_shardings=NamedSharding(mesh, P()))(x)
+print("MP_PROBE_OK", float(jax.device_get(out)))
+"""
+
+
+@functools.lru_cache(maxsize=1)
+def _cpu_multiprocess_error():
+    """None when this host's jaxlib CPU backend can run computations
+    spanning multiple processes; else the error signature. Only the
+    KNOWN backend gap converts to a skip — any other probe failure
+    returns None so the real tests run and fail loudly."""
+    import socket
+    import subprocess
+    import sys
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = str(s.getsockname()[1])
+    s.close()
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    procs = [subprocess.Popen(
+        [sys.executable, "-c", _MP_PROBE_CHILD, str(pid), port],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=env)
+        for pid in range(2)]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=120)
+            outs.append(out.decode("utf-8", "replace"))
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        return None  # a hang is not the known gap: let the tests speak
+    if all(p.returncode == 0 for p in procs):
+        return None
+    for out in outs:
+        if "Multiprocess computations aren't implemented" in out:
+            return ("jaxlib CPU backend: 'Multiprocess computations "
+                    "aren't implemented on the CPU backend'")
+    return None
+
+
+@pytest.fixture
+def require_multiprocess_cpu():
+    """Skip (with the probed reason) on hosts whose jaxlib CPU backend
+    cannot execute cross-process computations — the pre-existing
+    test_multihost failure (ROADMAP triage item). The multihost
+    COORDINATION layer (tests/test_cluster_resilience.py) does not
+    need backend collectives and still runs everywhere."""
+    err = _cpu_multiprocess_error()
+    if err is not None:
+        pytest.skip("cross-process computations unsupported on this "
+                    "host's CPU backend (TPU/multi-host only until a "
+                    "jaxlib upgrade): " + err)

@@ -23,7 +23,7 @@ def _free_port():
     return port
 
 
-def test_two_process_world():
+def test_two_process_world(require_multiprocess_cpu):
     port = _free_port()
     env = {k: v for k, v in os.environ.items()
            if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}

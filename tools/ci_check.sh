@@ -1,6 +1,7 @@
 #!/usr/bin/env bash
 # One-command static gate: tracelint + manifest freshness + import
-# health. Fast (no test suite, ~seconds) — run it locally before
+# health, plus the fast resilience/warm-start/telemetry/multihost
+# smokes and the cluster crash acceptance (~3 min total) — run before
 # pushing; CI runs the same line.
 #
 #   ./tools/ci_check.sh
@@ -36,6 +37,22 @@ echo "== warm-start smoke (persistent compile cache + shape manifest) =="
 # two subprocesses share a temp cache dir: the second must load from
 # disk (hits > 0) and perform ZERO fresh XLA compiles
 JAX_PLATFORMS=cpu python tools/warmstart_smoke.py
+
+echo "== multihost smoke (coordination store + quorum + merge) =="
+# 2-process CPU cluster over a tmpdir store: heartbeat + rendezvous
+# round trip, host-0 merged prom/fault-log carrying both rank labels,
+# and a quorum-stall watchdog that must exit NONZERO once every rank
+# goes silent
+JAX_PLATFORMS=cpu python tools/multihost_smoke.py
+
+echo "== cluster crash-consistency acceptance (3-rank SIGKILL) =="
+# the PR-6 acceptance proof (slow-marked out of the tier-1 budget run):
+# rank 1 SIGKILLed mid-async-save; survivors must not quorum-stall,
+# must restore the SAME common step, and the host-0 merge must carry
+# all three ranks' labels incl. the dying rank's final fault (~50s)
+JAX_PLATFORMS=cpu python -m pytest \
+    "tests/test_cluster_resilience.py::test_cluster_kill9_mid_async_save_survivors_agree" \
+    -q -m slow -p no:cacheprovider -p no:xdist -p no:randomly
 
 echo "== telemetry smoke (event stream + prom export + schema gate) =="
 # a tiny fit must produce an event stream, a Prometheus textfile whose

@@ -1,0 +1,155 @@
+#!/usr/bin/env python
+"""Multihost coordination smoke (tools/ci_check.sh).
+
+A 2-process CPU cluster over a tmpdir store proves the coordination
+substrate end to end, no TPU and no jax.distributed needed:
+
+* both ranks publish heartbeats and complete a host-0 **rendezvous**
+  round trip (leader publishes a token, the follower must read that
+  exact token back);
+* each rank records a fault and publishes its telemetry registry; the
+  parent then runs the **host-0 merge** and asserts the merged
+  Prometheus textfile + fault log carry BOTH ranks' labels;
+* after the ranks exit (heartbeats go stale), a **watchdog process**
+  running the cluster quorum scan must detect the quorum stall and
+  exit NONZERO — the exit code a production supervisor would key a
+  relaunch on. A watchdog that stays green while every rank is silent
+  fails the smoke.
+
+Usage: python tools/multihost_smoke.py           (run the smoke)
+       python tools/multihost_smoke.py --child   (internal: one rank)
+       python tools/multihost_smoke.py --watch   (internal: watchdog)
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+WATCH_STALL_EXIT = 3
+
+
+def _child():
+    sys.path.insert(0, REPO)
+    from paddle_tpu.distributed import coordination
+    from paddle_tpu.runtime import telemetry
+    from paddle_tpu.runtime.resilience import record_fault
+
+    ctx = coordination.cluster_context()
+    assert ctx is not None
+    coordination.init_cluster_telemetry(ctx)
+    for step in range(3):
+        coordination.publish_heartbeat(ctx.store, ctx.rank, step)
+        time.sleep(0.1)
+    if ctx.is_leader:
+        token = coordination.rendezvous(ctx.store, "smoke_token",
+                                        {"token": "tok-42"}, leader=True)
+    else:
+        token = coordination.rendezvous(ctx.store, "smoke_token",
+                                        timeout=20.0)
+    assert token == {"token": "tok-42"}, token
+    record_fault("rollbacks", f"smoke fixture rank {ctx.rank}")
+    telemetry.counter("paddle_tpu_train_steps_total", "steps").inc(
+        ctx.rank + 1)
+    telemetry.publish_registry(ctx.store, ctx.rank)
+    print(f"CHILD_OK rank={ctx.rank}", flush=True)
+
+
+def _watch():
+    sys.path.insert(0, REPO)
+    from paddle_tpu.distributed import coordination
+    from paddle_tpu.distributed.elastic import ElasticManager
+
+    ctx = coordination.cluster_context()
+    em = ElasticManager(tempfile.mkdtemp(), timeout=600.0, cluster=ctx,
+                        peer_stale_after=1.0, peer_dead_after=30.0)
+
+    def on_stall(info):
+        print(f"QUORUM_STALL reason={info.get('reason')} "
+              f"stale={info.get('stale')}", flush=True)
+        os._exit(WATCH_STALL_EXIT)
+
+    em.start_watchdog(on_stall=on_stall, poll=0.2)
+    deadline = time.monotonic() + 20.0
+    step = 0
+    while time.monotonic() < deadline:
+        # the watchdog judges peers only while its own rank is ticking
+        # (a non-participant is not entitled to call the cluster
+        # wedged) — the watcher heartbeats as its own live rank
+        em.tick(step)
+        step += 1
+        time.sleep(0.2)
+    print("WATCHDOG_NEVER_FIRED", flush=True)
+    sys.exit(0)  # green while the cluster is silent = smoke failure
+
+
+def _env(cluster_dir, rank, world):
+    env = dict(os.environ)
+    env.update({"JAX_PLATFORMS": "cpu",
+                "PADDLE_TPU_CLUSTER_DIR": cluster_dir,
+                "PADDLE_TPU_CLUSTER_RANK": str(rank),
+                "PADDLE_TPU_CLUSTER_WORLD": str(world)})
+    return env
+
+
+def main():
+    if "--child" in sys.argv:
+        _child()
+        return
+    if "--watch" in sys.argv:
+        _watch()
+        return
+
+    sys.path.insert(0, REPO)
+    cluster_dir = tempfile.mkdtemp(prefix="paddle_tpu_mh_smoke_")
+    procs = [subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--child"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        env=_env(cluster_dir, rank, 2)) for rank in range(2)]
+    for rank, p in enumerate(procs):
+        out, _ = p.communicate(timeout=180)
+        out = out.decode("utf-8", "replace")
+        assert p.returncode == 0, f"rank {rank} rc={p.returncode}:\n{out}"
+        assert f"CHILD_OK rank={rank}" in out, out
+    print("smoke: heartbeat + rendezvous round trip OK")
+
+    from paddle_tpu.distributed.coordination import DirectoryStore
+    from paddle_tpu.runtime import telemetry
+
+    store = DirectoryStore(cluster_dir)
+    merged = telemetry.merge_cluster(store)
+    assert merged["ranks"] == [0, 1], merged["ranks"]
+    parsed = telemetry.parse_prometheus_textfile(merged["prom_path"])
+    ranks = {dict(labels).get("rank") for _, labels in parsed}
+    assert {"0", "1"} <= ranks, ranks
+    fault_ranks = {f["rank"] for f in merged["faults"]
+                   if f["fault"] == "rollbacks"}
+    assert fault_ranks == {0, 1}, merged["faults"]
+    with open(merged["faults_path"]) as f:
+        assert len([json.loads(line) for line in f]) >= 2
+    print("smoke: host-0 merged prom + fault log carry both ranks OK")
+
+    # both ranks have exited: their heartbeats are stale. The quorum
+    # watchdog — running as a live THIRD rank, since a rank only judges
+    # peers while ticking itself — must fire and exit nonzero within
+    # its deadline (quorum over world 3 = 2 stale ranks).
+    watch = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--watch"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        env=_env(cluster_dir, 2, 3))
+    out, _ = watch.communicate(timeout=60)
+    out = out.decode("utf-8", "replace")
+    assert watch.returncode == WATCH_STALL_EXIT, \
+        f"watchdog rc={watch.returncode} (wanted {WATCH_STALL_EXIT}):\n{out}"
+    assert "QUORUM_STALL reason=quorum_stale" in out, out
+    print("smoke: quorum stall detected, watchdog exited nonzero OK")
+    print("multihost_smoke: OK")
+
+
+if __name__ == "__main__":
+    main()

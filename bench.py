@@ -45,6 +45,7 @@ from __future__ import annotations
 
 import argparse
 import atexit
+import itertools
 import json
 import os
 import signal
@@ -1098,6 +1099,59 @@ def _emit(payload):
     print(json.dumps(payload), flush=True)
 
 
+# measurement suffixes — the same vocabulary _publish_baseline uses to
+# decide what is publishable perf data. Probe/env keys (device_count,
+# probe_matmul) and per-config compile bookkeeping (*_compile_s,
+# *_fresh_compiles) exist even for a fully wedged round and must not
+# make it look like it measured anything.
+_DATA_POINT_SUFFIXES = ("_per_sec", "_ms", "_mfu", "_tops")
+
+
+def _count_data_points(details):
+    """Perf measurements in the merged details — the round's actual
+    yield. A round whose every config wedged must read as ZERO, not as
+    'some bookkeeping keys exist'."""
+    return sum(1 for k, v in details.items()
+               if k.endswith(_DATA_POINT_SUFFIXES)
+               and isinstance(v, (int, float))
+               and not isinstance(v, bool))
+
+
+def _result_file_path():
+    return os.environ.get("BENCH_RESULT_PATH",
+                          os.path.join(REPO, "BENCH_partial.json"))
+
+
+_RESULT_TMP_SEQ = itertools.count()
+
+
+def _write_result_file(payload):
+    """Persist the latest payload to BENCH_partial.json (atomic rename)
+    regardless of how the process exits. The stdout JSON line is the
+    driver contract, but r02–r05 showed kill paths where the tail was
+    lost — the file survives a lost tail, so a wedged config can no
+    longer zero out a round silently. Updated on every streamed
+    snapshot (not just final emit): a SIGKILL runs no handlers, and the
+    file must hold THIS round's latest partials when it lands.
+
+    The tmp name carries a per-call sequence number: the SIGTERM
+    handler calls this ON TOP of an interrupted snapshot write in the
+    same thread, and a pid-keyed tmp would let the two calls clobber
+    one inode (the handler's final payload then torn by the outer
+    frame's buffered flush). The handler os._exit()s, so its uniquely
+    named write is the last one standing; the outer frame's orphan tmp
+    is covered by the BENCH_partial.json* gitignore pattern."""
+    path = _result_file_path()
+    try:
+        tmp = f"{path}.tmp.{os.getpid()}.{next(_RESULT_TMP_SEQ)}"
+        with open(tmp, "w") as f:
+            json.dump(payload, f, indent=1)
+            f.write("\n")
+        os.replace(tmp, path)
+    except OSError:
+        pass
+
+
 # The driver records the stdout TAIL and parses the LAST JSON line, so
 # the orchestrator streams a fresh snapshot line every time a result
 # lands: a driver-side kill at ANY moment (even SIGKILL, which runs no
@@ -1114,10 +1168,12 @@ _PARTIAL_HOOK = [None]
 def _emit_final(payload):
     """The one authoritative line; later callers (atexit after SIGTERM,
     the __main__ error wrapper after a natural end) must not emit a
-    second, staler final line."""
+    second, staler final line. The same payload lands in the partial
+    results file unconditionally."""
     if _FINAL_DONE[0]:
         return
     _FINAL_DONE[0] = True
+    _write_result_file(payload)
     _emit(payload)
 
 
@@ -1154,6 +1210,7 @@ def _build_payload(details, small_all, publish, keymap):
         **{k: (round(v, 4) if isinstance(v, float) else v)
            for k, v in details.items()},
     }
+    payload["data_points"] = _count_data_points(details)
     return payload, value
 
 
@@ -1229,6 +1286,13 @@ def main():
                 except OSError:
                     pass
 
+    # a previous round's final payload must not masquerade as this
+    # round's if we are killed before the first snapshot lands
+    try:
+        os.remove(_result_file_path())
+    except OSError:
+        pass
+
     def remaining():
         return budget_s - (time.monotonic() - t_start)
 
@@ -1274,6 +1338,7 @@ def main():
             payload, value = _partial_payload("sigterm")
             if not _FINAL_DONE[0]:
                 _FINAL_DONE[0] = True
+                _write_result_file(payload)
                 # leading \n: the signal may have interrupted a snapshot
                 # print mid-line; appending to that unterminated prefix
                 # would corrupt the last-line-wins tail
@@ -1317,7 +1382,12 @@ def main():
             return
         if files - reported:
             reported.update(files)
-            _emit(_partial_payload("running")[0])
+            payload = _partial_payload("running")[0]
+            # keep the partials file as fresh as the stdout stream: a
+            # SIGKILL (no handlers) must leave THIS round's snapshot,
+            # not the previous round's final payload
+            _write_result_file(payload)
+            _emit(payload)
 
     spawns = 0
     max_spawns = int(os.environ.get("BENCH_MAX_SPAWNS", 3))
@@ -1417,8 +1487,13 @@ def main():
     payload, value = _build_payload(details, small_all, publish=True,
                                     keymap=keymap)
     _emit_final(payload)
-    if value is None:
-        raise SystemExit(1)  # a numberless bench must look like failure
+    if value is None or payload.get("data_points", 0) == 0:
+        # a numberless round must look like failure to the driver. The
+        # data_points clause states the zero-data contract explicitly:
+        # today a non-None headline implies >= 1 data point (headline
+        # keys are *_per_sec), so it only adds protection if a future
+        # headline key leaves the measurement-suffix vocabulary
+        raise SystemExit(1)
 
 
 if __name__ == "__main__":

@@ -107,7 +107,7 @@ def set_warmup_count(n: int):
     """Sightings of a key before it compiles (1 = compile immediately)."""
     global _warmup_count
     prev = _warmup_count
-    _warmup_count = max(1, int(n))
+    _warmup_count = max(1, int(n))  # threadlint: ok[CL001] GIL-atomic int publish; config-time single-writer, and the warm-gate read tolerates either value
     return prev
 
 
@@ -455,7 +455,14 @@ class JitCache:
         }
 
     def reset_counters(self):
-        self.hits = self.misses = self.evictions = 0
+        # under the lock: get()/put() increment these counters while
+        # holding it, and an unguarded reset can interleave with an
+        # in-flight `self.hits += 1` — the increment's write-back lands
+        # after the zeroing and silently resurrects pre-reset counts
+        # (threadlint CL001; a bench round resetting stats while worker
+        # threads dispatch would start from a corrupt zero)
+        with self._lock:
+            self.hits = self.misses = self.evictions = 0
 
 
 def _cap(env, default):

@@ -117,6 +117,19 @@ class ElasticManager:
         self._hb_path = os.path.join(ckpt_dir, "heartbeat.json")
         self._watch = None
         self._stop = threading.Event()
+        # guards the state shared between the step loop (tick) and the
+        # watchdog thread: _last_step, stalled, stall_reason. The
+        # monotonicity check-then-act in tick() and the watchdog's
+        # arming/stall reads must see one consistent view (threadlint
+        # CL001/CL007); the lock is held only around the state words,
+        # never across heartbeat I/O
+        self._state_lock = threading.Lock()
+        # serializes the heartbeat/store publication (and periodic
+        # save) that happens OUTSIDE the state lock: without it, two
+        # in-order concurrent ticks could publish out of order and the
+        # heartbeat file / peers' store view would regress to the older
+        # step with no heartbeat_regressions recorded
+        self._publish_lock = threading.Lock()
         self._last_step = None
         self.stalled = False
         self.stall_reason = None
@@ -138,39 +151,57 @@ class ElasticManager:
         `heartbeat_regressions` fault event and leaves the recorded
         progress untouched, returning False."""
         step = int(step)
-        if self._last_step is not None and step < self._last_step:
+        # check-and-reserve under the state lock (the lock is NOT held
+        # across the heartbeat file write below): the monotonicity test
+        # and the progress write must be one atomic step or a stale
+        # retry-path tick racing a fresh one could re-publish the old
+        # step after the check passed
+        with self._state_lock:
+            last = self._last_step
+            stale = last is not None and step < last
+            first = last is None
+            if not stale:
+                self._last_step = step
+        if stale:
             record_fault("heartbeat_regressions",
-                         f"tick({step}) after step {self._last_step}")
+                         f"tick({step}) after step {last}")
             warnings.warn(
                 f"paddle_tpu elastic: tick({step}) would move the "
-                f"heartbeat backwards (already at step {self._last_step}) "
+                f"heartbeat backwards (already at step {last}) "
                 "— ignoring the stale step", stacklevel=2)
             return False
-        if self._last_step is None:
+        if first:
             # the liveness transition worth a structured event: the loop
             # proved alive (per-step heartbeats would just duplicate the
             # TelemetryCallback train_step records)
             _telemetry.emit("heartbeat_started", step=step,
                             path=self._hb_path)
-        heartbeat(self._hb_path, step, payload)
-        if self.cluster is not None:
-            # same no-fsync contract as the local file; a store that
-            # briefly errors makes this rank LOOK stale to peers, which
-            # is precisely what the fault event records
-            try:
-                _publish_heartbeat(self.cluster.store, self.cluster.rank,
-                                   step, payload)
-            except Exception as e:  # noqa: BLE001 — a pluggable (KV)
-                # store can raise more than OSError; no store error may
-                # ever propagate into the step loop
-                record_fault("watchdog_errors",
-                             f"cluster heartbeat rank "
-                             f"{self.cluster.rank}: "
-                             f"{type(e).__name__}: {e}")
-        self._last_step = step
-        if self.save_fn is not None and self.save_interval and \
-                step > 0 and step % self.save_interval == 0:
-            self.save_fn(step)
+        with self._publish_lock:
+            # a newer tick may have reserved past us while we waited:
+            # publishing our step now would move the heartbeat file /
+            # store view BACKWARDS — drop the stale publication (the
+            # newer tick's covers us)
+            with self._state_lock:
+                if self._last_step != step:
+                    return True
+            heartbeat(self._hb_path, step, payload)
+            if self.cluster is not None:
+                # same no-fsync contract as the local file; a store that
+                # briefly errors makes this rank LOOK stale to peers,
+                # which is precisely what the fault event records
+                try:
+                    _publish_heartbeat(self.cluster.store,
+                                       self.cluster.rank, step, payload)
+                except Exception as e:  # noqa: BLE001 — a pluggable (KV)
+                    # store can raise more than OSError; no store error
+                    # may ever propagate into the step loop
+                    record_fault("watchdog_errors",
+                                 f"cluster heartbeat rank "
+                                 f"{self.cluster.rank}: "
+                                 f"{type(e).__name__}: {e}")
+            if self.save_fn is not None and self.save_interval and \
+                    step > 0 and step % self.save_interval == 0:
+                self.save_fn(step)
         return True
 
     def resume(self, restore_fn):
@@ -216,8 +247,9 @@ class ElasticManager:
         state = {"step": None, "advanced": started}
 
         def _stall(reason, hb):
-            self.stalled = True
-            self.stall_reason = reason
+            with self._state_lock:
+                self.stalled = True
+                self.stall_reason = reason
             record_fault("stall_detections", f"{reason} "
                          f"(step {hb.get('step')})")
             _telemetry.emit("watchdog_stall", reason=reason,
@@ -240,8 +272,10 @@ class ElasticManager:
                     record_fault("watchdog_errors",
                                  f"{type(e).__name__}: {e}")
                     continue
+                with self._state_lock:
+                    last_step = self._last_step
                 if not monitor_armed and self._monitor is not None \
-                        and self._last_step is not None:
+                        and last_step is not None:
                     # a rank starts judging its PEERS' liveness only
                     # once it is ticking itself, with a fresh grace
                     # window from that moment: compile-time skew across
@@ -263,7 +297,7 @@ class ElasticManager:
                         scan = None
                     if scan is not None and scan["quorum_stalled"]:
                         stall = ("quorum_stale",
-                                 {"step": self._last_step, **scan})
+                                 {"step": last_step, **scan})
                 if stall is not None:
                     _stall(*stall)
                     return
@@ -284,8 +318,10 @@ class ElasticManager:
         self._stop.set()
         if self._watch is not None:
             self._watch.join(timeout=2)
-            _telemetry.emit("watchdog_stop", last_step=self._last_step,
-                            stalled=self.stalled)
+            with self._state_lock:
+                last_step, stalled = self._last_step, self.stalled
+            _telemetry.emit("watchdog_stop", last_step=last_step,
+                            stalled=stalled)
 
 
 @non_jittable  # wall-clock liveness math; must never be jit-cached

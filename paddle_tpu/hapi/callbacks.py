@@ -698,10 +698,11 @@ class ResilienceCallback(Callback):
                     # synchronously so the final artifacts include the
                     # final publications. If the in-flight merge is
                     # STILL running after the timed join, skip the
-                    # synchronous one: both would share the same
-                    # pid-keyed tmp files and corrupt each other's
-                    # output — the in-flight merge lands near-final
-                    # data on its own
+                    # synchronous one: tmp paths are thread-keyed now
+                    # (no corruption), but two racing merges would
+                    # still publish in arbitrary order and the older
+                    # result could land last — the in-flight merge
+                    # lands near-final data on its own
                     drained = True
                     if self._merge_thread is not None:
                         self._merge_thread.join(timeout=30)

@@ -66,7 +66,7 @@ def buffered(reader, size):
                 for item in reader():
                     q.put(item)
             except BaseException as e:  # noqa: BLE001 — re-raised below
-                err.append(e)
+                err.append(e)  # threadlint: ok[CL001] GIL-atomic append; the consumer reads only after the _End sentinel lands (queue handoff = happens-before)
             finally:
                 q.put(_End)  # ALWAYS unblock the consumer
 
@@ -145,7 +145,7 @@ def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
                 for i, sample in enumerate(reader()):
                     in_q.put((i, sample))
             except BaseException as e:  # noqa: BLE001 — re-raised below
-                errors.append(e)
+                errors.append(e)  # threadlint: ok[CL001] GIL-atomic append; read only after every worker's end_token (queue handoff = happens-before)
             finally:
                 for _ in range(process_num):
                     in_q.put(end_token)
@@ -159,7 +159,7 @@ def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
                     i, sample = item
                     out_q.put((i, mapper(sample)))
             except BaseException as e:  # noqa: BLE001 — re-raised below
-                errors.append(e)
+                errors.append(e)  # threadlint: ok[CL001] GIL-atomic append; read only after every worker's end_token (queue handoff = happens-before)
             finally:
                 out_q.put(end_token)  # ALWAYS unblock the consumer
 

@@ -489,8 +489,14 @@ def atomic_write_json(path, payload, fsync=True):
     """Write JSON then rename, so readers never observe a torn file
     (the same contract orbax gives step directories). `fsync=True`
     makes it durable too (integrity manifests); heartbeats skip the
-    fsync — freshness, not durability, is their contract."""
-    tmp = f"{path}.tmp.{os.getpid()}"
+    fsync — freshness, not durability, is their contract.
+
+    The tmp name is keyed by pid AND thread: a pid-only key let two
+    threads of one process (step loop + watchdog, sync + background
+    merge) write the same path, rename each other's tmp away, and
+    crash with FileNotFoundError — the exact tmp-collision class the
+    PR-6 reviews kept hitting."""
+    tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
     try:
         with open(tmp, "w") as f:
             json.dump(payload, f)

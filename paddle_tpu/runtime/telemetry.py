@@ -468,8 +468,8 @@ class EventStream:
             return
         with self._lock:
             try:
-                self._f.write(line)
-                self._f.flush()
+                self._f.write(line)  # threadlint: ok[CL003] per-record flush under the lock IS the kill -9 durability contract; writers must serialize
+                self._f.flush()  # threadlint: ok[CL003] see above — sub-ms on a local file, and rotation depends on tell() after flush
                 self.emitted += 1
                 if self.max_bytes and self._f.tell() >= self.max_bytes:
                     self._rotate()
@@ -479,7 +479,7 @@ class EventStream:
     def _rotate(self):
         self._f.close()
         if self.max_files == 1:
-            self._f = open(self.path, "w")  # single-file bound: truncate
+            self._f = open(self.path, "w")  # single-file bound: truncate  # threadlint: ok[CL003,CL005] rotation must be atomic w.r.t. writers (caller holds the lock); readers tolerate the truncation by contract (read_events)
             return
         # shift generations up (os.replace clobbers, so the oldest falls
         # off the end), then start a fresh active file
@@ -489,7 +489,7 @@ class EventStream:
                 os.replace(src, f"{self.path}.{i}")
             except OSError:
                 pass
-        self._f = open(self.path, "a")
+        self._f = open(self.path, "a")  # threadlint: ok[CL003] rotation must swap the file atomically w.r.t. writers — the emit caller holds the lock by design
 
     def close(self):
         with self._lock:
@@ -663,7 +663,9 @@ def write_prometheus(path=None, snap=None):
             return None
         path = os.path.join(d, "metrics.prom")
     text = render_prometheus(snap)
-    tmp = f"{path}.tmp.{os.getpid()}"
+    # pid AND thread keyed: the background merge thread and a train-end
+    # synchronous writer must never share a tmp path
+    tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
     with open(tmp, "w") as f:
         f.write(text)
     os.replace(tmp, path)
@@ -733,6 +735,135 @@ def push_prometheus(addr=None, snap=None, job="paddle_tpu", instance=None,
 
 # ---------------------------------------------------------------------------
 # cross-host aggregation: per-rank publication + host-0 merge
+
+MERGE_STATE_BASENAME = "merge_state.json"
+MERGE_STATE_VERSION = 1
+# per-rank bound on accumulated stream fault records carried in the
+# merge state (the merged faults.jsonl is rebuilt from this state each
+# boundary; an unbounded run must not grow it without limit)
+_MERGE_FAULTS_CAP = 10000
+
+
+def _tail_jsonl(path, offset):
+    """Parse complete JSON lines from byte `offset` of a JSONL file.
+    Returns (records, new_offset); a torn final line (no trailing
+    newline yet) is left in place for the next tail."""
+    try:
+        with open(path, "rb") as f:
+            f.seek(offset)
+            data = f.read()
+    except OSError:
+        return [], offset
+    end = data.rfind(b"\n")
+    if end < 0:
+        return [], offset
+    records = []
+    for line in data[:end].split(b"\n"):
+        if not line.strip():
+            continue
+        try:
+            records.append(json.loads(line))
+        except ValueError:
+            continue
+    return records, offset + end + 1
+
+
+def _load_merge_state(out_dir):
+    if not out_dir:
+        return {}
+    try:
+        with open(os.path.join(out_dir, MERGE_STATE_BASENAME)) as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return {}
+    if doc.get("version") != MERGE_STATE_VERSION:
+        return {}
+    ranks = doc.get("ranks")
+    return dict(ranks) if isinstance(ranks, dict) else {}
+
+
+def _head_signature(path):
+    """Hash of the file's FIRST LINE (capped at 256 bytes) — stable
+    across appends, changed by truncation/replacement even when the new
+    file is LONGER than the old offset (size alone cannot tell a
+    fast-growing fresh incarnation from more appends). A still-torn
+    first line hashes differently once it completes; the resulting
+    one-off reset is dedup-safe."""
+    import hashlib
+
+    try:
+        with open(path, "rb") as f:
+            head = f.read(257)
+    except OSError:
+        return ""
+    if not head:
+        return ""
+    line = head.partition(b"\n")[0][:256]
+    return hashlib.sha1(line).hexdigest()
+
+
+def _tail_rank_events(path, st, rank):
+    """Advance one rank's tail state `st` ({offset, head, starts,
+    faults}) by the event-stream bytes written since the last merge —
+    O(new bytes), not O(run length). The tail resets to 0 (re-scanning
+    the ``.1`` generation, with exact duplicates deduped against the
+    accumulated state) when the incarnation changed under us: the file
+    shrank below the saved offset, OR its head signature changed — a
+    relaunched rank's fresh file can grow PAST the old offset between
+    merges, and a mid-file seek into the new incarnation would silently
+    drop its earliest fault records."""
+    try:
+        size = os.path.getsize(path)
+    except OSError:
+        size = 0
+    offset = int(st.get("offset", 0))
+    head = _head_signature(path)
+    fresh = offset == 0
+    if size < offset or (offset > 0 and head != st.get("head")):
+        offset = 0
+        fresh = True
+    st["head"] = head
+    new_records = []
+    if fresh:
+        # rotated generations are read once per (re)start of the tail;
+        # steady-state merges touch only the active file's new bytes
+        gens = []
+        i = 1
+        while os.path.exists(f"{path}.{i}"):
+            gens.append(f"{path}.{i}")
+            i += 1
+        for p in reversed(gens):
+            recs, _ = _tail_jsonl(p, 0)
+            new_records.extend(recs)
+    tail, offset = _tail_jsonl(path, offset)
+    new_records.extend(tail)
+
+    starts = st.setdefault("starts", {})
+    faults = st.setdefault("faults", [])
+    seen = {(r.get("ts"), r.get("fault"), r.get("detail"), r.get("pid"))
+            for r in faults}
+    for ev in new_records:
+        ts = ev.get("ts")
+        if isinstance(ts, (int, float)):
+            pid = str(ev.get("pid"))
+            prev = starts.get(pid)
+            if prev is None or ts < prev:
+                starts[pid] = ts
+        if ev.get("kind") != "fault":
+            continue
+        rec = {"ts": ev.get("ts"), "fault": ev.get("fault"),
+               "detail": ev.get("detail"), "rank": ev.get("rank", rank),
+               "pid": ev.get("pid"), "source": "events"}
+        key = (rec["ts"], rec["fault"], rec["detail"], rec["pid"])
+        if key in seen:
+            continue
+        seen.add(key)
+        faults.append(rec)
+    if len(faults) > _MERGE_FAULTS_CAP:
+        del faults[:len(faults) - _MERGE_FAULTS_CAP]
+    st["offset"] = offset
+    return st
+
 
 def publish_registry(store, rank=None, extra=None):
     """Publish this rank's full telemetry view — registry snapshot,
@@ -815,6 +946,16 @@ def merge_cluster(store, out_dir=None, push=False):
     the caller (a merge failure is observability lost, not a training
     failure).
 
+    Event streams are TAILED, not re-read: ``<out_dir>/merge_state.json``
+    persists, per rank, the active file's byte offset, the per-pid
+    incarnation stream starts, and the accumulated stream fault
+    records (bounded), so each checkpoint boundary costs O(new bytes)
+    instead of O(run length) per rank — the difference between a
+    per-interval merge and a stalled leader on slow shared
+    filesystems. A relaunched rank (file shorter than the saved
+    offset) resets its tail to 0 and re-scans; exact-duplicate records
+    are deduped against the accumulated state.
+
     Known limitation: a fault recorded while the ``PADDLE_TPU_TELEMETRY``
     kill switch was OFF (emit no-ops, so it exists only in the
     publication fault_log) is indistinguishable from a stream
@@ -834,8 +975,16 @@ def merge_cluster(store, out_dir=None, push=False):
             fault_recs.append({**f, "rank": rank, "source": "publication",
                                "pid": pub.get("pid")})
     # per-rank event streams (directory stores): catches the fault a
-    # dying rank flushed after its last publication
+    # dying rank flushed after its last publication. Tailed from saved
+    # byte offsets (persisted per rank in <out_dir>/merge_state.json,
+    # with per-pid incarnation stream starts) so each boundary reads
+    # O(new bytes), not the whole file again — the whole-file re-read
+    # was O(run length x ranks) per checkpoint interval on slow shared
+    # filesystems (ROADMAP PR-6 follow-up)
     root = getattr(store, "root", None)
+    if out_dir is None and root is not None:
+        out_dir = os.path.join(root, "merged")
+    state_ranks = _load_merge_state(out_dir)
     if root:
         events_root = os.path.join(root, "events")
         try:
@@ -855,20 +1004,24 @@ def merge_cluster(store, out_dir=None, push=False):
                 rank = int(d[len("rank_"):])
             except ValueError:
                 continue
-            for ev in read_events(os.path.join(events_root, d,
-                                               "events.jsonl")):
-                ts = ev.get("ts")
-                if isinstance(ts, (int, float)):
-                    key = (rank, ev.get("pid"))
-                    prev = stream_start.get(key)
-                    if prev is None or ts < prev:
-                        stream_start[key] = ts
-                if ev.get("kind") != "fault":
+            st = state_ranks.get(str(rank))
+            if not isinstance(st, dict):
+                st = {}
+            st = _tail_rank_events(
+                os.path.join(events_root, d, "events.jsonl"), st, rank)
+            state_ranks[str(rank)] = st
+            for pid, ts in st.get("starts", {}).items():
+                # starts keys are str(pid) (JSON round trip); records
+                # with no pid tag persist as "None" and must keep
+                # matching pid-less publication records
+                try:
+                    key = (rank, None if pid == "None" else int(pid))
+                except (TypeError, ValueError):
                     continue
-                fault_recs.append(
-                    {"ts": ev.get("ts"), "fault": ev.get("fault"),
-                     "detail": ev.get("detail"),
-                     "rank": ev.get("rank", rank), "source": "events"})
+                prev = stream_start.get(key)
+                if prev is None or ts < prev:
+                    stream_start[key] = ts
+            fault_recs.extend(dict(r) for r in st.get("faults", ()))
     # a fault recorded while the stream was live exists in BOTH sources
     # (record_fault's log entry and the emit), with timestamps differing
     # by the microseconds between the two time.time() calls — so
@@ -896,19 +1049,28 @@ def merge_cluster(store, out_dir=None, push=False):
         merged = _merge_rank_snapshots(ranks_snaps)
         out["snapshot"] = merged
         if out_dir is None:
-            if root is None:
-                raise OSError("no out_dir and store has no root directory")
-            out_dir = os.path.join(root, "merged")
+            raise OSError("no out_dir and store has no root directory")
         os.makedirs(out_dir, exist_ok=True)
         out["prom_path"] = write_prometheus(
             os.path.join(out_dir, "cluster.prom"), snap=merged)
         faults_path = os.path.join(out_dir, "faults.jsonl")
-        tmp = f"{faults_path}.tmp.{os.getpid()}"
+        tmp = f"{faults_path}.tmp.{os.getpid()}.{threading.get_ident()}"
         with open(tmp, "w") as f:
             for r in fault_recs:
                 f.write(json.dumps(r, default=str) + "\n")
         os.replace(tmp, faults_path)
         out["faults_path"] = faults_path
+        if root:
+            # persist the tail state AFTER the outputs landed: a merge
+            # that dies mid-write re-tails from the previous offsets
+            # next time, and the exact-duplicate dedup absorbs the
+            # overlap (never the reverse — offsets past unwritten data)
+            spath = os.path.join(out_dir, MERGE_STATE_BASENAME)
+            stmp = f"{spath}.tmp.{os.getpid()}.{threading.get_ident()}"
+            with open(stmp, "w") as f:
+                json.dump({"version": MERGE_STATE_VERSION,
+                           "ranks": state_ranks}, f)
+            os.replace(stmp, spath)
         if push:
             push_prometheus(snap=merged, instance="cluster")
         emit("cluster_merge", ranks=out["ranks"],
@@ -1001,8 +1163,8 @@ class ScalarsSink:
         rec["global_step"] = int(step)
         with self._lock:
             try:
-                self._f.write(json.dumps(rec) + "\n")
-                self._f.flush()
+                self._f.write(json.dumps(rec) + "\n")  # threadlint: ok[CL003] per-step flush under the lock is the sink's crash-durability contract
+                self._f.flush()  # threadlint: ok[CL003] see above
             except (OSError, ValueError):
                 pass
 

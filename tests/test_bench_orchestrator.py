@@ -175,3 +175,55 @@ def test_dispatch_delta_ranks_by_config_delta():
     res2 = {}
     bench._dispatch_delta(res2, "cfg", after, before)
     assert res2["cfg_dispatch"]["fwd_hits"] == 100
+
+
+def test_zero_data_point_round_fails_and_persists_partials(tmp_path):
+    """ROADMAP item 4 slice: a round where every config wedges/errors
+    must exit nonzero with data_points == 0, and the partial payload
+    must land in BENCH_partial.json even though stdout could have been
+    lost — a wedged config can no longer zero out a round silently."""
+    (tmp_path / "fake_allboom.py").write_text(
+        "def _boom():\n    raise RuntimeError('wedged')\n"
+        "CONFIGS = {'error': (_boom, {}, 60)}\n")
+    result_path = tmp_path / "BENCH_partial.json"
+    env = dict(os.environ)
+    env["BENCH_CONFIGS_MODULE"] = "fake_allboom"
+    env["PYTHONPATH"] = str(tmp_path) + os.pathsep + env.get("PYTHONPATH", "")
+    env["BENCH_FORCE_CPU"] = "1"
+    env["BENCH_STATE_DIR"] = str(tmp_path / "state")
+    env["BENCH_DEADLINE_S"] = "180"
+    env["BENCH_RESULT_PATH"] = str(result_path)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        env=env, cwd=REPO, capture_output=True, timeout=260)
+    payload = json.loads(proc.stdout.decode().strip().splitlines()[-1])
+    assert proc.returncode != 0
+    assert payload["value"] is None
+    assert payload["data_points"] == 0, payload
+    # the file is the stdout-independent copy of the same payload
+    persisted = json.loads(result_path.read_text())
+    assert persisted["data_points"] == 0
+    assert persisted["error_error"] == payload["error_error"]
+
+
+def test_successful_round_reports_data_points_and_writes_file(tmp_path):
+    """A round that measures something reports its yield and persists
+    the final payload to the results file."""
+    (tmp_path / "fake_ok.py").write_text(
+        "def _lenet():\n    return {'lenet_imgs_per_sec': 111.0}\n"
+        "CONFIGS = {'lenet': (_lenet, {}, 60)}\n")
+    result_path = tmp_path / "BENCH_partial.json"
+    env = dict(os.environ)
+    env["BENCH_CONFIGS_MODULE"] = "fake_ok"
+    env["PYTHONPATH"] = str(tmp_path) + os.pathsep + env.get("PYTHONPATH", "")
+    env["BENCH_FORCE_CPU"] = "1"
+    env["BENCH_STATE_DIR"] = str(tmp_path / "state")
+    env["BENCH_DEADLINE_S"] = "180"
+    env["BENCH_RESULT_PATH"] = str(result_path)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        env=env, cwd=REPO, capture_output=True, timeout=260)
+    payload = json.loads(proc.stdout.decode().strip().splitlines()[-1])
+    assert payload["data_points"] >= 1, payload
+    persisted = json.loads(result_path.read_text())
+    assert persisted["lenet_imgs_per_sec"] == 111.0

@@ -125,3 +125,63 @@ def test_elastic_watchdog_detects_stall(tmp_path):
     time.sleep(0.8)
     em.stop()
     assert em.stalled and stalls and stalls[0]["step"] == 0
+
+
+def test_tick_check_and_reserve_is_atomic(tmp_path):
+    """Regression (threadlint CL007/CL001): the monotonicity check and
+    the `_last_step` write are one atomic step under the manager's
+    state lock, so overlapping increasing sequences from concurrent
+    tickers can never leave the recorded progress below the global max
+    (a stale tick racing a fresh one used to be able to re-publish the
+    older step after its check passed)."""
+    import threading
+    import warnings as _warnings
+
+    em = ElasticManager(str(tmp_path / "cc"), timeout=9999)
+    n, offsets = 80, (0, 3, 7)
+
+    def run(base):
+        with _warnings.catch_warnings():
+            # regressing ticks are EXPECTED here (overlapping
+            # sequences); each returns False and warns by contract
+            _warnings.simplefilter("ignore")
+            for i in range(n):
+                em.tick(base + i)
+
+    threads = [threading.Thread(target=run, args=(k,)) for k in offsets]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert em._last_step == max(offsets) + n - 1
+    # the PUBLISHED view must not regress either: a tick superseded
+    # while waiting to publish drops its stale publication, so the
+    # heartbeat file always ends at the global max
+    import json as _json
+
+    hb = _json.load(open(em._hb_path))
+    assert hb["step"] == max(offsets) + n - 1, hb
+
+    with _warnings.catch_warnings():
+        _warnings.simplefilter("ignore")
+        assert em.tick(0) is False          # stale: state untouched
+    assert em._last_step == max(offsets) + n - 1
+
+
+def test_tick_reserves_under_the_state_lock(tmp_path):
+    """The tick fast path must consult the state lock (not a racy bare
+    read) before publishing progress."""
+    em = ElasticManager(str(tmp_path / "lk"), timeout=9999)
+    acquired = []
+
+    class _ProbeLock:
+        def __enter__(self):
+            acquired.append(True)
+
+        def __exit__(self, *exc):
+            return False
+
+    em._state_lock = _ProbeLock()
+    assert em.tick(1) is True
+    assert acquired, "tick() must check-and-reserve under _state_lock"
+    assert em._last_step == 1

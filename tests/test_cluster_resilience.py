@@ -564,3 +564,105 @@ def test_cluster_kill9_mid_async_save_survivors_agree(tmp_path):
                for r, k in by_rank_kind), sorted(by_rank_kind)
     assert any(k == "peer_dead" and r in (0, 2)
                for r, k in by_rank_kind), sorted(by_rank_kind)
+
+
+def _event_line(detail, ts=1.0, kind="fault", pid=111, fault="rollbacks"):
+    return json.dumps({"ts": ts, "kind": kind, "rank": 0, "pid": pid,
+                       "fault": fault, "detail": detail}) + "\n"
+
+
+def test_merge_cluster_tails_from_saved_offsets(tmp_path):
+    """Each boundary reads O(new bytes): after a merge, already-consumed
+    bytes are never parsed again. Proven by REWRITING a consumed line
+    (the second one — the first line is the incarnation signature and
+    changing it legitimately forces a reset) with an equally-long but
+    different valid fault line: a re-read would surface the bogus
+    fault; a tail cannot see it."""
+    s = DirectoryStore(tmp_path)
+    ev_dir = tmp_path / "events" / "rank_0"
+    os.makedirs(ev_dir)
+    path = ev_dir / "events.jsonl"
+    first = _event_line("origA")
+    second = _event_line("origB", ts=2.0)
+    with open(path, "w") as f:
+        f.write(first)
+        f.write(second)
+    out = T.merge_cluster(s)
+    assert [f["detail"] for f in out["faults"]] == ["origA", "origB"]
+    state = json.load(open(tmp_path / "merged" / "merge_state.json"))
+    assert state["ranks"]["0"]["offset"] == os.path.getsize(path)
+
+    bogus = _event_line("BOGUS", ts=2.0)  # same byte length as `second`
+    assert len(bogus) == len(second)
+    with open(path, "r+b") as f:
+        f.seek(len(first))
+        f.write(bogus.encode())           # overwrite a consumed line
+        f.seek(0, os.SEEK_END)
+        f.write(_event_line("origC", ts=3.0).encode())
+    out = T.merge_cluster(s)
+    details = [f["detail"] for f in out["faults"]]
+    assert details == ["origA", "origB", "origC"], details  # no BOGUS
+    state = json.load(open(tmp_path / "merged" / "merge_state.json"))
+    assert state["ranks"]["0"]["offset"] == os.path.getsize(path)
+
+
+def test_merge_cluster_offset_resets_on_rank_relaunch(tmp_path):
+    """A relaunched rank that starts a FRESH (shorter) event file must
+    reset the saved offset and be re-tailed from byte 0 — while the
+    previous incarnation's accumulated faults survive in the merged
+    log, without duplicates."""
+    s = DirectoryStore(tmp_path)
+    ev_dir = tmp_path / "events" / "rank_0"
+    os.makedirs(ev_dir)
+    path = ev_dir / "events.jsonl"
+    with open(path, "w") as f:
+        f.write(_event_line("inc1-a", ts=1.0, pid=111))
+        f.write(_event_line("inc1-b", ts=2.0, pid=111))
+    out = T.merge_cluster(s)
+    assert len(out["faults"]) == 2
+    old_offset = json.load(open(
+        tmp_path / "merged" / "merge_state.json"))["ranks"]["0"]["offset"]
+
+    # relaunch: a new incarnation replaces the file with a shorter one
+    with open(path, "w") as f:
+        f.write(_event_line("inc2-a", ts=3.0, pid=222))
+    assert os.path.getsize(path) < old_offset
+    out = T.merge_cluster(s)
+    details = sorted(f["detail"] for f in out["faults"])
+    assert details == ["inc1-a", "inc1-b", "inc2-a"], details
+    state = json.load(open(tmp_path / "merged" / "merge_state.json"))
+    assert state["ranks"]["0"]["offset"] == os.path.getsize(path)
+    # both incarnations' stream starts are known (per-pid)
+    assert set(state["ranks"]["0"]["starts"]) == {"111", "222"}
+
+    # idempotence: a third merge with no new bytes changes nothing
+    out = T.merge_cluster(s)
+    assert sorted(f["detail"] for f in out["faults"]) == details
+
+
+def test_merge_cluster_detects_relaunch_even_when_new_file_is_longer(
+        tmp_path):
+    """Incarnation change is detected by the head signature, not just
+    file size: a relaunched rank whose fresh file grows PAST the old
+    offset before the next merge must still be re-tailed from byte 0,
+    or its earliest faults silently vanish."""
+    s = DirectoryStore(tmp_path)
+    ev_dir = tmp_path / "events" / "rank_0"
+    os.makedirs(ev_dir)
+    path = ev_dir / "events.jsonl"
+    with open(path, "w") as f:
+        f.write(_event_line("inc1-a", ts=1.0, pid=111))
+    out = T.merge_cluster(s)
+    assert [f["detail"] for f in out["faults"]] == ["inc1-a"]
+    old_offset = json.load(open(
+        tmp_path / "merged" / "merge_state.json"))["ranks"]["0"]["offset"]
+
+    # fresh incarnation, LONGER than the consumed prefix of the old one
+    with open(path, "w") as f:
+        f.write(_event_line("inc2-a", ts=3.0, pid=222))
+        f.write(_event_line("inc2-b", ts=4.0, pid=222))
+        f.write(_event_line("inc2-c", ts=5.0, pid=222))
+    assert os.path.getsize(path) > old_offset
+    out = T.merge_cluster(s)
+    details = sorted(f["detail"] for f in out["faults"])
+    assert details == ["inc1-a", "inc2-a", "inc2-b", "inc2-c"], details

@@ -422,3 +422,25 @@ def test_mlp_train_loop_hit_rate_after_warmup():
     # nothing on the hot loop should be silently eager
     assert fwd["unkeyable"] == 0 and fwd["fallbacks"] == 0
     assert np.isfinite(float(np.asarray(loss._value)))
+
+
+def test_reset_counters_holds_the_cache_lock():
+    """Regression (threadlint CL001): get()/put() bump hits/misses under
+    the cache lock; an unlocked reset_counters could interleave with an
+    in-flight increment and resurrect pre-reset counts. The reset must
+    take the same lock."""
+    cache = dispatch.JitCache("probe", 8)
+    acquired = []
+
+    class _ProbeLock:
+        def __enter__(self):
+            acquired.append(True)
+
+        def __exit__(self, *exc):
+            return False
+
+    cache._lock = _ProbeLock()
+    cache.reset_counters()
+    assert acquired, ("JitCache.reset_counters must zero the counters "
+                      "under the cache lock")
+    assert cache.hits == cache.misses == cache.evictions == 0

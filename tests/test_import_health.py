@@ -53,3 +53,26 @@ def test_jax_compat_exports(symbol):
     """The compat shim must resolve its symbols on the installed JAX."""
     compat = importlib.import_module("paddle_tpu.core.jax_compat")
     assert callable(getattr(compat, symbol))
+
+
+@pytest.mark.parametrize("name", [
+    "tools.staticlib",
+    "tools.staticlib.astnav",
+    "tools.staticlib.baseline",
+    "tools.staticlib.callgraph",
+    "tools.staticlib.findings",
+    "tools.staticlib.report",
+    "tools.staticlib.rules",
+    "tools.staticlib.taint",
+    "tools.staticlib.waivers",
+    "tools.threadlint",
+    "tools.threadlint.analyzer",
+    "tools.threadlint.rules",
+    "tools.tracelint",
+    "tools.tracelint.analyzer",
+])
+def test_analysis_tooling_imports(name):
+    """The static-analysis stack (shared staticlib core + both
+    analyzers) must import cleanly — CI's lint gates run through these
+    modules, so an import break here silently disables the gates."""
+    importlib.import_module(name)

@@ -17,6 +17,12 @@ echo "== tracelint (jit-safety static analysis + manifest freshness) =="
 # the checked-in unjittable manifest is stale
 JAX_PLATFORMS=cpu python -m tools.tracelint paddle_tpu --check-manifest
 
+echo "== threadlint (static concurrency analysis + baseline freshness) =="
+# gates on new concurrency findings AND (--fail-stale) on fixed debt
+# still sitting in the checked-in baseline — both directions must stay
+# fresh, exactly like the tracelint/manifest pair above
+JAX_PLATFORMS=cpu python -m tools.threadlint paddle_tpu --fail-stale
+
 echo "== import health (every submodule imports on CPU) =="
 JAX_PLATFORMS=cpu python -m pytest tests/test_import_health.py -q \
     -p no:cacheprovider -p no:xdist -p no:randomly

@@ -1,0 +1,48 @@
+"""staticlib — the shared core every repo static analyzer is built on.
+
+Extracted from tracelint (PR 2) when threadlint arrived: two analyzers
+were about to carry two copies of the same harness — AST navigation +
+lexical scope resolution, a name-level taint pass with a pluggable
+sanitizer vocabulary, a module-local call-graph walker, line-number-free
+fingerprint baselines, inline `# <tool>: ok[rule]` waivers, and the
+human/JSON report + CI exit-code contract. All of that lives here, so a
+new analyzer (a sharding-spec checker, an API-deprecation scanner) is a
+RULE CATALOG plus a detection visitor, not a new harness.
+
+Layout:
+
+  astnav     dotted-name/scope/param helpers, ScopeIndex, file iteration
+  callgraph  module-local call graph (defs, methods, nested defs) with
+             call-site records and reachability closure
+  taint      name-level forward taint with configurable sanitizer sets
+  rules      Rule dataclass + ruleset() registry helper
+  findings   Finding dataclass: fingerprinting + JSON encoding
+  baseline   fingerprint-multiset baseline: load / write / partition
+  waivers    inline `# <tool>: ok[rule,...]` suppression comments
+  report     human + machine-readable reports, parameterized by tool
+
+Consumers: tools/tracelint (jit-safety), tools/threadlint (concurrency).
+Everything is stdlib-only and must never import the code it analyzes.
+"""
+from .astnav import (  # noqa: F401
+    DEFAULT_SKIP_DIRS, ScopeIndex, dotted, func_params, iter_py_files,
+    relpath, runtime_first_line,
+)
+from .baseline import (  # noqa: F401
+    BASELINE_VERSION, load_baseline, partition, write_baseline,
+)
+from .callgraph import CallGraph  # noqa: F401
+from .findings import Finding  # noqa: F401
+from .rules import Rule, ruleset  # noqa: F401
+from .taint import NameTaint, body_nodes  # noqa: F401
+from .waivers import suppressed  # noqa: F401
+
+__all__ = [
+    "DEFAULT_SKIP_DIRS", "ScopeIndex", "dotted", "func_params",
+    "iter_py_files", "relpath", "runtime_first_line",
+    "BASELINE_VERSION", "load_baseline", "partition", "write_baseline",
+    "CallGraph", "Finding", "Rule", "ruleset", "NameTaint", "body_nodes",
+    "suppressed",
+]
+
+__version__ = "1.0"

@@ -1,0 +1,139 @@
+"""AST navigation shared by every analyzer: dotted-name resolution,
+runtime line accounting, parameter classification, lexical scope
+chains, and tree iteration. Extracted verbatim from tracelint's
+analyzer so both tools (and every future one) agree on what a
+qualname, a traced parameter, or a resolvable local function IS."""
+from __future__ import annotations
+
+import ast
+import os
+
+__all__ = ["dotted", "runtime_first_line", "func_params", "ScopeIndex",
+           "iter_py_files", "relpath", "DEFAULT_SKIP_DIRS"]
+
+
+def dotted(node):
+    """('jax','jit') for jax.jit, ('x',) for x; None for anything else."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return tuple(reversed(parts))
+    return None
+
+
+def runtime_first_line(node):
+    """co_firstlineno of the code object this def/lambda compiles to:
+    for decorated defs that is the FIRST DECORATOR line, not the `def`
+    line (CPython 3.8+ ast puts .lineno on the def)."""
+    decs = getattr(node, "decorator_list", None)
+    if decs:
+        return min([d.lineno for d in decs] + [node.lineno])
+    return node.lineno
+
+
+def func_params(node):
+    """(all param names, names assumed TRACED). Params with defaults are
+    assumed static — the codebase idiom rides statics in via defaults
+    (`lambda x, axis=axis: ...`) and arrays positionally."""
+    a = node.args
+    names, traced = [], set()
+    pos = list(a.posonlyargs) + list(a.args)
+    n_def = len(a.defaults)
+    for i, p in enumerate(pos):
+        names.append(p.arg)
+        if i < len(pos) - n_def:
+            traced.add(p.arg)
+    if a.vararg:
+        names.append(a.vararg.arg)
+        traced.add(a.vararg.arg)
+    for p, d in zip(a.kwonlyargs, a.kw_defaults):
+        names.append(p.arg)
+        if d is None:
+            traced.add(p.arg)
+    if a.kwarg:
+        names.append(a.kwarg.arg)
+    return names, traced
+
+
+class ScopeIndex:
+    """Parent links + lexical scope chains for one module AST."""
+
+    def __init__(self, tree):
+        self.parent = {}
+        for node in ast.walk(tree):
+            for child in ast.iter_child_nodes(node):
+                self.parent[child] = node
+        self.tree = tree
+
+    def scope_chain(self, node):
+        """Enclosing FunctionDef/AsyncFunctionDef/Lambda/ClassDef nodes,
+        innermost first (the node itself excluded)."""
+        out = []
+        cur = self.parent.get(node)
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Lambda, ast.ClassDef)):
+                out.append(cur)
+            cur = self.parent.get(cur)
+        return out
+
+    def qualname(self, node):
+        parts = []
+        for s in [node] + self.scope_chain(node):
+            if isinstance(s, ast.Lambda):
+                parts.append("<lambda>")
+            else:
+                parts.append(s.name)
+        return ".".join(reversed(parts))
+
+    def enclosing_class(self, node):
+        """Nearest enclosing ClassDef, or None."""
+        for s in self.scope_chain(node):
+            if isinstance(s, ast.ClassDef):
+                return s
+        return None
+
+    def resolve_function(self, name, from_node):
+        """Find the def/lambda a bare name refers to at `from_node`,
+        searching enclosing function scopes innermost-out, then module
+        level. Returns the AST node or None."""
+        scopes = [s for s in self.scope_chain(from_node)
+                  if not isinstance(s, ast.ClassDef)]
+        scopes.append(self.tree)
+        for scope in scopes:
+            body = scope.body if not isinstance(scope, ast.Lambda) else []
+            hit = None
+            for stmt in body:
+                if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                        and stmt.name == name:
+                    hit = stmt
+                elif isinstance(stmt, ast.Assign):
+                    for t in stmt.targets:
+                        if isinstance(t, ast.Name) and t.id == name \
+                                and isinstance(stmt.value, ast.Lambda):
+                            hit = stmt.value
+            if hit is not None:
+                return hit
+        return None
+
+
+DEFAULT_SKIP_DIRS = frozenset({"__pycache__", ".git", "libs", "include"})
+
+
+def iter_py_files(root, skip_dirs=DEFAULT_SKIP_DIRS):
+    if os.path.isfile(root):
+        yield root
+        return
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = sorted(d for d in dirnames if d not in skip_dirs)
+        for fn in sorted(filenames):
+            if fn.endswith(".py"):
+                yield os.path.join(dirpath, fn)
+
+
+def relpath(path, root_parent):
+    rel = os.path.relpath(path, root_parent)
+    return rel.replace(os.sep, "/")

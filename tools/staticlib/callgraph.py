@@ -1,0 +1,130 @@
+"""Module-local call graph: who calls whom, resolvable file-locally.
+
+Both analyzers need the same walk: tracelint to decide whether a trace
+site can reach a `dispatch.suspend()` helper, threadlint to decide
+which functions run on a thread-entry path and which locks are held at
+a call site. The graph is deliberately file-local and approximate —
+the same contract as the analyzers themselves: it must never import
+the code it inspects, and unresolvable calls (cross-module, dynamic)
+simply contribute no edge.
+
+Resolution covers:
+  * bare names — lexical scope search via ScopeIndex.resolve_function
+    (module-level defs, nested defs, lambdas assigned to names);
+  * ``self.m(...)`` / ``cls.m(...)`` — methods of the nearest enclosing
+    class;
+  * ``ClassName.m(...)`` — methods of a module-level class.
+"""
+from __future__ import annotations
+
+import ast
+
+__all__ = ["CallGraph"]
+
+_FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+
+class CallGraph:
+    def __init__(self, tree, scopes):
+        self.tree = tree
+        self.scopes = scopes
+        # qualname -> def node (lambdas keyed by their scope qualname)
+        self.functions = {}
+        self.node_qual = {}
+        # class name -> {method name -> node}
+        self.classes = {}
+        for node in ast.walk(tree):
+            if isinstance(node, _FUNC_NODES):
+                qual = scopes.qualname(node)
+                # first binding wins (redefinitions are rare and the
+                # graph is approximate anyway)
+                self.functions.setdefault(qual, node)
+                self.node_qual[id(node)] = qual
+            elif isinstance(node, ast.ClassDef):
+                methods = {}
+                for stmt in node.body:
+                    if isinstance(stmt, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                        methods[stmt.name] = stmt
+                self.classes.setdefault(node.name, methods)
+        # edges: caller qual -> [(call node, callee qual)]
+        self.edges = {q: [] for q in self.functions}
+        self._callers = {q: [] for q in self.functions}
+        for qual, fnode in self.functions.items():
+            for n in self.body_nodes(fnode):
+                if not isinstance(n, ast.Call):
+                    continue
+                callee = self.resolve_call(n)
+                if callee is not None:
+                    self.edges[qual].append((n, callee))
+                    self._callers[callee].append((qual, n))
+
+    # -- iteration ----------------------------------------------------------
+    @staticmethod
+    def body_nodes(fnode):
+        """Every node in `fnode`'s own body, NOT descending into nested
+        def/lambda bodies (those are separate graph nodes)."""
+        if isinstance(fnode, ast.Lambda):
+            roots = [fnode.body]
+        else:
+            roots = list(fnode.body)
+        stack = list(roots)
+        while stack:
+            n = stack.pop()
+            yield n
+            if isinstance(n, _FUNC_NODES):
+                continue  # the def itself is visible, its body is not
+            for child in ast.iter_child_nodes(n):
+                stack.append(child)
+
+    # -- resolution ---------------------------------------------------------
+    def resolve_target(self, expr, from_node):
+        """Resolve a callable EXPRESSION (a call's func, or a callback
+        argument like a Thread target) to a function qualname, or None."""
+        if isinstance(expr, ast.Lambda):
+            return self.node_qual.get(id(expr))
+        if isinstance(expr, ast.Name):
+            node = self.scopes.resolve_function(expr.id, from_node)
+            if node is not None:
+                return self.node_qual.get(id(node))
+            return None
+        if isinstance(expr, ast.Attribute) and \
+                isinstance(expr.value, ast.Name):
+            recv = expr.value.id
+            if recv in ("self", "cls"):
+                cdef = self.scopes.enclosing_class(from_node)
+                if cdef is not None:
+                    m = self.classes.get(cdef.name, {}).get(expr.attr)
+                    if m is not None:
+                        return self.node_qual.get(id(m))
+                return None
+            m = self.classes.get(recv, {}).get(expr.attr)
+            if m is not None:
+                return self.node_qual.get(id(m))
+        return None
+
+    def resolve_call(self, call):
+        return self.resolve_target(call.func, call)
+
+    def callers(self, qual):
+        """[(caller qual, call node)] for locally-resolved call sites."""
+        return self._callers.get(qual, [])
+
+    def callees(self, qual):
+        return self.edges.get(qual, [])
+
+    # -- reachability -------------------------------------------------------
+    def reachable(self, seeds):
+        """Transitive closure of callees from `seeds` (qualnames),
+        seeds included."""
+        seen = set()
+        stack = [s for s in seeds if s in self.functions]
+        while stack:
+            q = stack.pop()
+            if q in seen:
+                continue
+            seen.add(q)
+            for _, callee in self.edges.get(q, ()):
+                if callee not in seen:
+                    stack.append(callee)
+        return seen

@@ -1,0 +1,51 @@
+"""The Finding record every analyzer emits.
+
+Subclass per tool, binding the tool's rule catalog:
+
+    class Finding(staticlib.Finding):
+        RULES = RULES      # slug -> Rule (for rule_id lookup)
+
+The fingerprint is deliberately line-number-free
+(``rule|path|qualname|symbol``) so baselines survive unrelated edits
+above a finding — the contract tracelint's baseline established and
+every tool inherits.
+"""
+from __future__ import annotations
+
+import dataclasses
+
+__all__ = ["Finding"]
+
+
+@dataclasses.dataclass
+class Finding:
+    rule: str           # rule slug from the tool's catalog
+    path: str           # posix path relative to the analysis root's parent
+    line: int
+    col: int
+    func: str           # dotted qualname of the enclosing scope ("" = module)
+    func_name: str      # runtime co_name ("<lambda>" for lambdas)
+    func_line: int      # runtime co_firstlineno of the enclosing scope
+    message: str
+    symbol: str         # short stable token for fingerprinting
+    severity: str
+    confidence: str     # "definite" | "possible"
+    context: str        # tool-specific context tag
+    suppressed: bool = False
+
+    RULES = {}  # class-level: each tool's subclass binds its catalog
+
+    @property
+    def rule_id(self):
+        return type(self).RULES[self.rule].id
+
+    def fingerprint(self):
+        """Line-number-free identity: survives unrelated edits above the
+        finding, so the baseline doesn't churn with the file."""
+        return f"{self.rule}|{self.path}|{self.func}|{self.symbol}"
+
+    def to_dict(self):
+        d = dataclasses.asdict(self)
+        d["rule_id"] = self.rule_id
+        d["fingerprint"] = self.fingerprint()
+        return d

@@ -1,0 +1,93 @@
+"""Human + machine-readable reporting, shared across analyzers.
+
+Every function takes the TOOL name and its RULES catalog so the text a
+developer reads names the right command and waiver syntax, while the
+structure (what gates, what collapses to counts) is identical across
+tools — one report grammar to learn, N analyzers.
+"""
+from __future__ import annotations
+
+import collections
+import json
+
+REPORT_VERSION = 1
+
+
+def format_finding(f, tag=""):
+    tag = f" [{tag}]" if tag else ""
+    where = f"{f.path}:{f.line}:{f.col + 1}"
+    func = f" in `{f.func}`" if f.func else ""
+    return (f"{where}: {f.rule_id} {f.rule} ({f.severity}/"
+            f"{f.confidence}){tag}{func}\n    {f.message}")
+
+
+def human_report(new, baselined, suppressed, info, stale, errors,
+                 tool, rules, verbose=False):
+    """Report text. `new` findings are always itemized (they gate);
+    baselined/suppressed/info collapse to counts unless verbose."""
+    out = []
+    for f in new:
+        out.append(format_finding(f, "NEW"))
+    if verbose:
+        for f in baselined:
+            out.append(format_finding(f, "baselined"))
+        for f in suppressed:
+            out.append(format_finding(f, "waived"))
+        for f in info:
+            out.append(format_finding(f, "info"))
+    for path, msg in errors:
+        out.append(f"{path}: PARSE ERROR — {msg}")
+    if stale:
+        out.append(f"stale baseline entries ({len(stale)}) — fixed debt; "
+                   "shrink the file with --write-baseline:")
+        for fp in stale[:20]:
+            out.append(f"    {fp}")
+        if len(stale) > 20:
+            out.append(f"    ... and {len(stale) - 20} more")
+
+    by_rule = collections.Counter(f.rule for f in new + baselined)
+    summary = (f"{tool}: {len(new)} new, {len(baselined)} baselined, "
+               f"{len(suppressed)} waived inline, {len(info)} info, "
+               f"{len(errors)} parse errors")
+    if by_rule:
+        summary += " | " + ", ".join(
+            f"{rules[r].id} {r}: {n}" for r, n in sorted(by_rule.items()))
+    out.append(summary)
+    if new:
+        out.append("FAIL: new findings above — fix them, waive with "
+                   f"`# {tool}: ok[rule]` after review, or (for "
+                   "accepted debt) refresh the baseline with "
+                   "--write-baseline.")
+    return "\n".join(out)
+
+
+def json_report(new, baselined, suppressed, info, stale, errors, rules,
+                extra=None):
+    payload = {
+        "version": REPORT_VERSION,
+        "summary": {
+            "new": len(new), "baselined": len(baselined),
+            "suppressed": len(suppressed), "info": len(info),
+            "parse_errors": len(errors), "stale_baseline": len(stale),
+        },
+        "rules": {slug: {"id": r.id, "severity": r.severity,
+                         "manifest": r.manifest, "summary": r.summary}
+                  for slug, r in sorted(rules.items())},
+        "findings": {
+            "new": [f.to_dict() for f in new],
+            "baselined": [f.to_dict() for f in baselined],
+            "suppressed": [f.to_dict() for f in suppressed],
+            "info": [f.to_dict() for f in info],
+        },
+        "stale_baseline": stale,
+        "parse_errors": [{"path": p, "message": m} for p, m in errors],
+    }
+    if extra:
+        payload.update(extra)
+    return payload
+
+
+def write_json(path, payload):
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(payload, f, indent=1)
+        f.write("\n")

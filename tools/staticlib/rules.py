@@ -1,0 +1,37 @@
+"""Rule catalog machinery shared by every analyzer.
+
+A catalog is data, not behavior — detection lives in each tool's
+analyzer — so docs, reports and baselines speak one vocabulary per
+tool. Severity vocabulary (shared so CI gating is uniform):
+
+  error    — proven hazard.
+  warning  — likely hazard; depends on runtime context.
+  info     — hygiene note; never gates CI.
+"""
+from __future__ import annotations
+
+import dataclasses
+
+__all__ = ["Rule", "ruleset"]
+
+
+@dataclasses.dataclass(frozen=True)
+class Rule:
+    id: str            # short numeric handle, e.g. "TL001" / "CL001"
+    slug: str          # stable kebab-case name used in reports/baseline
+    severity: str      # "error" | "warning" | "info"
+    manifest: bool = False  # tool-specific: definite findings feed a
+    #                         generated artifact (tracelint's unjittable
+    #                         manifest); False for tools without one
+    summary: str = ""
+
+
+def ruleset(rules):
+    """(RULES by slug, BY_ID, get) for a list of Rule objects."""
+    by_slug = {r.slug: r for r in rules}
+    by_id = {r.id: r for r in rules}
+
+    def get(slug_or_id):
+        return by_slug.get(slug_or_id) or by_id[slug_or_id]
+
+    return by_slug, by_id, get

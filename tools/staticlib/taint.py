@@ -1,0 +1,146 @@
+"""Name-level forward taint with a pluggable sanitizer vocabulary.
+
+Extracted from tracelint's op-body checker: positional parameters
+without defaults are assumed tainted (for tracelint: traced arrays);
+parameters with defaults and closure statics are assumed clean. A
+configurable sanitizer vocabulary (attribute reads, call heads,
+coercions) launders taint — for tracelint these are the reads that are
+static under a jax trace (``.shape``, ``len()``, ``isinstance()``);
+another tool can bind its own vocabulary without touching the
+propagation machinery.
+
+The pass is iterated to a small fixpoint over simple assignments; it
+is deliberately approximate (no aliasing, no containers) — precision
+comes from each tool's confidence grading and checked baseline, not
+from a heavier analysis.
+"""
+from __future__ import annotations
+
+import ast
+
+from .astnav import dotted, func_params
+
+__all__ = ["NameTaint", "body_nodes"]
+
+
+def body_nodes(fnode):
+    """Every node under `fnode`'s body, nested defs INCLUDED (the
+    tracelint contract: a nested helper's hazards belong to the op
+    body that defines it)."""
+    if isinstance(fnode, ast.Lambda):
+        yield from ast.walk(fnode.body)
+    else:
+        for stmt in fnode.body:
+            yield from ast.walk(stmt)
+
+
+class NameTaint:
+    """Per-function name-level taint state + queries.
+
+    `static_attrs` — attribute reads that launder taint;
+    `sanitizer_calls` — call heads whose result is clean regardless of
+    argument taint; `coercions`/`host_methods` — calls whose RESULT is
+    clean (the call itself may be a hazard, reported separately by the
+    tool's own visitors).
+    """
+
+    def __init__(self, fnode, static_attrs=frozenset(),
+                 sanitizer_calls=frozenset(), coercions=frozenset(),
+                 host_methods=frozenset()):
+        self.fnode = fnode
+        self.static_attrs = static_attrs
+        self.sanitizer_calls = sanitizer_calls
+        self.coercions = coercions
+        self.host_methods = host_methods
+
+        self.params, self.tainted = func_params(fnode)
+        self.vararg = fnode.args.vararg.arg if fnode.args.vararg else None
+        self.locals = set(self.params)
+        self._collect_locals()
+        self.propagate()
+
+    def _body_nodes(self):
+        yield from body_nodes(self.fnode)
+
+    def _collect_locals(self):
+        for n in self._body_nodes():
+            if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Store):
+                self.locals.add(n.id)
+            elif isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.locals.add(n.name)
+            elif isinstance(n, ast.comprehension):
+                for t in ast.walk(n.target):
+                    if isinstance(t, ast.Name):
+                        self.locals.add(t.id)
+
+    def propagate(self):
+        """Name-level forward taint, iterated to a small fixpoint."""
+        for _ in range(3):
+            changed = False
+            for n in self._body_nodes():
+                tgts = None
+                if isinstance(n, ast.Assign):
+                    tgts, val = n.targets, n.value
+                elif isinstance(n, ast.AugAssign):
+                    tgts, val = [n.target], n.value
+                elif isinstance(n, ast.AnnAssign) and n.value is not None:
+                    tgts, val = [n.target], n.value
+                elif isinstance(n, ast.NamedExpr):
+                    tgts, val = [n.target], n.value
+                if not tgts or not self.expr_tainted(val):
+                    continue
+                for t in tgts:
+                    for nm in ast.walk(t):
+                        if isinstance(nm, ast.Name) \
+                                and nm.id not in self.tainted:
+                            self.tainted.add(nm.id)
+                            changed = True
+            if not changed:
+                break
+
+    # -- queries ------------------------------------------------------------
+    def expr_tainted(self, node):
+        if node is None:
+            return False
+        if isinstance(node, ast.Attribute):
+            if node.attr in self.static_attrs:
+                return False
+            return self.expr_tainted(node.value)
+        if isinstance(node, ast.Call):
+            d = dotted(node.func)
+            if d and (d[-1] in self.sanitizer_calls
+                      or d[-1] in self.coercions
+                      or d[-1] in self.host_methods):
+                return False  # result is clean (the call itself may be
+                #               a hazard, reported separately)
+            for a in list(node.args) + [kw.value for kw in node.keywords]:
+                if self.expr_tainted(a):
+                    return True
+            # method call: the receiver's taint flows to the result
+            # (x.astype(...) is as tainted as x)
+            if isinstance(node.func, ast.Attribute):
+                return self.expr_tainted(node.func.value)
+            return False
+        if isinstance(node, ast.Name):
+            # the *args TUPLE is a host object (its truthiness/len are
+            # clean); only its ELEMENTS carry taint
+            if node.id == self.vararg:
+                return False
+            return node.id in self.tainted
+        if isinstance(node, ast.Subscript) and \
+                isinstance(node.value, ast.Name) and \
+                node.value.id == self.vararg:
+            return True
+        if isinstance(node, ast.Compare) and all(
+                isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops):
+            # `x is None` is an identity test on the HOST object — a
+            # tainted value is never None, so the test is clean
+            return False
+        for child in ast.iter_child_nodes(node):
+            if self.expr_tainted(child):
+                return True
+        return False
+
+    def taint_names(self, node):
+        return sorted({n.id for n in ast.walk(node)
+                       if isinstance(n, ast.Name) and n.id in self.tainted})

@@ -1,0 +1,36 @@
+"""Inline waiver comments: `# <tool>: ok` / `# <tool>: ok[rule,...]`.
+
+A waiver on the flagged line records a human review AT THE SITE (vs the
+baseline, which records accepted debt in a side file). Rules can be
+named by slug or id; a bare `ok` waives every rule on that line.
+"""
+from __future__ import annotations
+
+import re
+
+__all__ = ["suppressed"]
+
+_CACHE = {}
+
+
+def _pattern(tool):
+    pat = _CACHE.get(tool)
+    if pat is None:
+        pat = re.compile(
+            rf"#\s*{re.escape(tool)}:\s*ok(\[([A-Za-z0-9_,\- ]+)\])?")
+        _CACHE[tool] = pat
+    return pat
+
+
+def suppressed(lines, lineno, rule, tool, rules):
+    """True when source line `lineno` carries a waiver for `rule`.
+    `rules` is the tool's slug->Rule catalog (for id aliasing)."""
+    if not 1 <= lineno <= len(lines):
+        return False
+    m = _pattern(tool).search(lines[lineno - 1])
+    if not m:
+        return False
+    if m.group(2) is None:
+        return True
+    waived = {s.strip() for s in m.group(2).split(",")}
+    return rule in waived or rules[rule].id in waived

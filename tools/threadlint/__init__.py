@@ -1,0 +1,42 @@
+"""threadlint — static concurrency/race analysis for the paddle_tpu
+threaded runtime.
+
+The runtime spine is concurrent on the host side: elastic watchdog
+threads, background cluster merges, orbax async checkpoint commits,
+data-pipeline workers, atexit manifest saves. The dominant live-bug
+class across the PR-6 review rounds was unguarded shared state,
+check-then-act races, and background-vs-synchronous path collisions.
+threadlint moves that class to lint time: a stdlib-`ast` pass
+(on the shared `tools/staticlib/` analysis core tracelint also runs
+on) discovers every thread entry point itself, walks the module-local
+call graph, models held locks, and classifies hazards per rules.py:
+
+  CL001 unguarded-shared-mutation   CL005 non-atomic-shared-write
+  CL002 lock-order-inversion        CL006 shutdown-ordering
+  CL003 blocking-under-lock         CL007 check-then-act
+  CL004 thread-before-fork
+
+Usage:
+    python -m tools.threadlint paddle_tpu
+    python -m tools.threadlint paddle_tpu -v
+    python -m tools.threadlint paddle_tpu --json /tmp/threadlint.json
+    python -m tools.threadlint paddle_tpu --write-baseline
+
+CI gates via tools/ci_check.sh exactly like tracelint: exit 0 on the
+baselined tree, nonzero on any new finding (and, with --fail-stale,
+on fixed-but-unpruned baseline debt). Reviewed-safe sites carry inline
+`# threadlint: ok[rule] reason` waivers. See docs/THREADLINT.md.
+"""
+from ..staticlib.baseline import load_baseline, partition  # noqa: F401
+from .analyzer import Finding, analyze_file, analyze_paths  # noqa: F401
+from .rules import RULES  # noqa: F401
+
+__all__ = ["Finding", "analyze_file", "analyze_paths", "load_baseline",
+           "partition", "RULES", "main"]
+
+__version__ = "1.0"
+
+
+def main(argv=None):
+    from .__main__ import main as _main
+    return _main(argv)

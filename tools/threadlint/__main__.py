@@ -1,0 +1,95 @@
+"""CLI: python -m tools.threadlint <roots...> [options].
+
+Exit codes: 0 clean (or baselined-only), 1 new findings, parse errors,
+or (with --fail-stale) stale baseline entries, 2 usage error.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from ..staticlib.baseline import load_baseline, partition, write_baseline
+from ..staticlib.report import human_report, json_report, write_json
+from .analyzer import analyze_paths
+from .rules import RULES
+
+DEFAULT_BASELINE = os.path.join(os.path.dirname(__file__), "baseline.json")
+
+_COMMENT = ("threadlint suppression baseline — regenerate with "
+            "`python -m tools.threadlint paddle_tpu "
+            "--write-baseline` after reviewing that every new "
+            "finding is intended debt, not a regression.")
+
+
+def build_parser():
+    p = argparse.ArgumentParser(
+        prog="python -m tools.threadlint",
+        description="static concurrency/race analyzer for the "
+                    "paddle_tpu threaded runtime "
+                    "(see docs/THREADLINT.md)")
+    p.add_argument("roots", nargs="+",
+                   help="package dirs or files to analyze (paddle_tpu)")
+    p.add_argument("--baseline", default=DEFAULT_BASELINE,
+                   help=f"baseline file (default {DEFAULT_BASELINE})")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="report every finding as new (ignore baseline)")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="rewrite the baseline from current findings "
+                        "and exit 0")
+    p.add_argument("--json", metavar="PATH",
+                   help="also write the machine-readable report here")
+    p.add_argument("--fail-stale", action="store_true",
+                   help="exit nonzero on stale baseline entries too "
+                        "(CI freshness gate: fixed debt must be pruned "
+                        "with --write-baseline)")
+    p.add_argument("-v", "--verbose", action="store_true",
+                   help="itemize baselined/waived/info findings too")
+    return p
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
+    for r in args.roots:
+        if not os.path.exists(r):
+            print(f"threadlint: no such path: {r}", file=sys.stderr)
+            return 2
+
+    findings, errors = analyze_paths(args.roots)
+
+    if args.write_baseline:
+        if errors:
+            # a baseline written while files are unparseable silently
+            # drops their debt; the next clean run would gate on it
+            for p, m in errors:
+                print(f"{p}: PARSE ERROR — {m}", file=sys.stderr)
+            print("threadlint: refusing to write a baseline while files "
+                  "fail to parse", file=sys.stderr)
+            return 1
+        counts = write_baseline(args.baseline, findings, _COMMENT)
+        print(f"threadlint: baseline written to {args.baseline} "
+              f"({sum(counts.values())} findings, "
+              f"{len(counts)} distinct fingerprints)")
+        return 0
+
+    baseline = {} if args.no_baseline else load_baseline(args.baseline)
+    new, baselined, suppressed, info, stale = partition(findings, baseline)
+
+    print(human_report(new, baselined, suppressed, info, stale, errors,
+                       tool="threadlint", rules=RULES,
+                       verbose=args.verbose))
+    if args.json:
+        write_json(args.json, json_report(new, baselined, suppressed, info,
+                                          stale, errors, rules=RULES))
+    if new or errors:
+        return 1
+    if args.fail_stale and stale:
+        print("threadlint: stale baseline entries above — the debt was "
+              "fixed; shrink the baseline with --write-baseline",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

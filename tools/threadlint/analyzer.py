@@ -1,0 +1,1076 @@
+"""Static concurrency analysis over the paddle_tpu threaded runtime.
+
+The runtime is genuinely concurrent on the host side: elastic watchdog
+threads, background cluster merges, async checkpoint commits, data
+pipeline workers, atexit manifest saves. The live-bug class the PR-6
+review rounds kept finding is exactly unguarded shared state,
+check-then-act races, and background-vs-synchronous path collisions —
+so, like tracelint did for trace hygiene, this pass moves those
+discoveries to lint time.
+
+**Thread-entry discovery** is automatic: ``threading.Thread(target=f)``
+(and ``Timer``, ``multiprocessing.Process``), ``executor.submit(f)``,
+``_thread.start_new_thread(f)``, plus registered ``atexit`` and
+``signal`` handlers — each resolved target is the root of a *context*.
+Everything reachable from an entry (module-local call graph,
+tools/staticlib/callgraph.py) runs on that context; everything
+reachable from functions nothing local calls (public API) runs on the
+implicit *sync* context.
+
+**Shared state** is a module global, a class/instance attribute
+(``self.x`` / ``cls.x``), or a closure cell shared between a function
+and a nested thread target, that is either
+
+  * accessed from two or more distinct contexts (at least one of them
+    a thread-entry context), or
+  * accessed under a held lock somewhere (the guard itself is the
+    author's declaration that the state is shared).
+
+**Lock modeling**: ``threading.Lock/RLock/Condition/Semaphore`` objects
+bound to module globals, class attributes, or function locals are
+tracked through ``with`` blocks and ``.acquire()``/``.release()``
+pairs; a private function whose every local call site holds lock L is
+treated as executing under L (caller-held fixpoint), so a helper
+factored out of a locked region does not false-positive.
+
+The pass is file-local and approximate, exactly like tracelint: it
+never imports the code it inspects, and residual false positives are
+absorbed by reviewed inline waivers (`# threadlint: ok[rule]`) and the
+checked fingerprint baseline rather than by weakening detection.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+
+from ..staticlib import findings as _findings
+from ..staticlib.astnav import (
+    ScopeIndex, dotted, iter_py_files as _iter_py_files,
+    relpath as _relpath, runtime_first_line,
+)
+from ..staticlib.callgraph import CallGraph
+from ..staticlib.waivers import suppressed as _waiver_suppressed
+from .rules import RULES
+
+__all__ = ["Finding", "analyze_file", "analyze_paths", "iter_py_files"]
+
+SKIP_DIRS = {"__pycache__", ".git", "libs", "include"}
+TOOL = "threadlint"
+
+_FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+LOCK_FACTORIES = {"Lock", "RLock", "Condition", "Semaphore",
+                  "BoundedSemaphore"}
+REENTRANT_FACTORIES = {"RLock"}
+# list/dict/set-style in-place mutation (threading.Event.set / queue.put
+# are deliberately absent: those primitives are internally synchronized)
+MUTATING_METHODS = {"append", "extend", "insert", "remove", "pop", "clear",
+                    "update", "setdefault", "add", "discard", "popitem",
+                    "sort", "reverse"}
+SPAWN_CALLS = {
+    ("subprocess", "Popen"), ("subprocess", "run"), ("subprocess", "call"),
+    ("subprocess", "check_call"), ("subprocess", "check_output"),
+    ("os", "fork"), ("os", "forkpty"), ("os", "posix_spawn"),
+    ("os", "spawnv"), ("os", "spawnl"), ("os", "system"),
+    ("multiprocessing", "Process"),
+}
+BLOCKING_NET_HEADS = {"requests", "urllib", "socket"}
+FILE_IO_METHODS = {"read", "readline", "readlines", "write", "writelines",
+                   "flush"}
+QUEUEISH_NAME = re.compile(r"(^|_)(q|queue|jobs?|tasks?|work|in_q|out_q)"
+                           r"(_|$)", re.IGNORECASE)
+SHARED_PATH_HINT = re.compile(
+    r"store|heartbeat|telemetr|merged|cluster|ckpt|checkpoint|manifest"
+    r"|\.prom|events|baseline", re.IGNORECASE)
+DUNDER_INIT = {"__init__", "__new__", "__del__", "__init_subclass__",
+               "__set_name__"}
+
+
+# ---------------------------------------------------------------------------
+# model
+
+class Finding(_findings.Finding):
+    """threadlint finding: the shared record bound to the CL catalog."""
+
+    RULES = RULES
+
+
+class Entry:
+    """One discovered thread-entry point."""
+
+    __slots__ = ("kind", "target", "node", "daemon", "label")
+
+    def __init__(self, kind, target, node, daemon=False):
+        self.kind = kind        # thread|timer|submit|atexit|signal|...
+        self.target = target    # resolved qualname or None
+        self.node = node        # the registering/constructing Call node
+        self.daemon = daemon
+        self.label = f"{kind}:{target or '?'}"
+
+
+def _const_str(node):
+    return node.value if isinstance(node, ast.Constant) and \
+        isinstance(node.value, str) else None
+
+
+def _kwarg(call, name):
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+def _has_timeout(call):
+    if _kwarg(call, "timeout") is not None:
+        return True
+    return bool(call.args)
+
+
+# ---------------------------------------------------------------------------
+# per-module analysis
+
+class ModuleConcurrencyAnalysis:
+    def __init__(self, path, root_parent):
+        self.path = path
+        self.relpath = _relpath(path, root_parent)
+        with open(path, "r", encoding="utf-8") as f:
+            self.src = f.read()
+        self.lines = self.src.splitlines()
+        self.tree = ast.parse(self.src, filename=path)
+        self.scopes = ScopeIndex(self.tree)
+        self.graph = CallGraph(self.tree, self.scopes)
+        self.findings = []
+
+        self._collect_locks()
+        self._collect_function_locals()
+        self._discover_entries()
+        self._compute_contexts()
+        self._walk_held()
+        self._effective_held_fixpoint()
+        self._collect_accesses()
+
+    # -- reporting ----------------------------------------------------------
+    def report(self, rule, node, func_qual, message, symbol, confidence,
+               context):
+        fnode = self.graph.functions.get(func_qual)
+        if fnode is not None:
+            func_name = getattr(fnode, "name", "<lambda>")
+            func_line = runtime_first_line(fnode)
+        else:
+            func_name, func_line = "<module>", 1
+        self.findings.append(Finding(
+            rule=rule, path=self.relpath, line=node.lineno,
+            col=node.col_offset, func=func_qual or "<module>",
+            func_name=func_name, func_line=func_line, message=message,
+            symbol=symbol, severity=RULES[rule].severity,
+            confidence=confidence, context=context))
+
+    # -- locks --------------------------------------------------------------
+    def _is_lock_factory(self, call):
+        d = dotted(call.func) if isinstance(call, ast.Call) else None
+        return d is not None and d[-1] in LOCK_FACTORIES
+
+    def _collect_locks(self):
+        """Lock objects bound to module globals, class attributes, or
+        function locals. Also records which are reentrant."""
+        self.lock_globals = {}      # name -> lock id
+        self.lock_attrs = {}        # (class, attr) -> lock id
+        self.lock_attr_names = {}   # attr -> set of classes defining it
+        self.local_locks = {}       # (func qual, name) -> lock id
+        self.reentrant = set()      # lock ids from RLock()
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.Assign) or \
+                    not self._is_lock_factory(node.value):
+                continue
+            d = dotted(node.value.func)
+            rlock = d[-1] in REENTRANT_FACTORIES
+            for t in node.targets:
+                lid = None
+                if isinstance(t, ast.Name):
+                    chain = self.scopes.scope_chain(node)
+                    fns = [s for s in chain if isinstance(s, _FUNC_NODES)]
+                    cls = self.scopes.enclosing_class(node)
+                    if fns and not (cls is not None
+                                    and chain and chain[0] is cls):
+                        # a function-local lock (dataloader's `cond`)
+                        q = self.scopes.qualname(fns[0])
+                        lid = f"l:{q}.{t.id}"
+                        self.local_locks[(q, t.id)] = lid
+                    elif cls is not None and chain and chain[0] is cls:
+                        # class-body assignment: a class-level lock
+                        lid = f"a:{cls.name}.{t.id}"
+                        self.lock_attrs[(cls.name, t.id)] = lid
+                        self.lock_attr_names.setdefault(
+                            t.id, set()).add(cls.name)
+                    else:
+                        lid = f"g:{t.id}"
+                        self.lock_globals[t.id] = lid
+                elif isinstance(t, ast.Attribute) and \
+                        isinstance(t.value, ast.Name) and \
+                        t.value.id in ("self", "cls"):
+                    cls = self.scopes.enclosing_class(node)
+                    cname = cls.name if cls is not None else "?"
+                    lid = f"a:{cname}.{t.attr}"
+                    self.lock_attrs[(cname, t.attr)] = lid
+                    self.lock_attr_names.setdefault(t.attr, set()).add(cname)
+                if lid and rlock:
+                    self.reentrant.add(lid)
+
+    def _resolve_lock(self, expr, from_node):
+        """Lock id for an expression used in `with`/`.acquire()`, or
+        None when it isn't a recognizable lock."""
+        if isinstance(expr, ast.Name):
+            fid = self._enclosing_fn_quals(from_node)
+            for q in fid:
+                lid = self.local_locks.get((q, expr.id))
+                if lid:
+                    return lid
+            return self.lock_globals.get(expr.id)
+        if isinstance(expr, ast.Attribute):
+            attr = expr.attr
+            classes = self.lock_attr_names.get(attr)
+            if not classes:
+                return None
+            if isinstance(expr.value, ast.Name):
+                recv = expr.value.id
+                if recv in ("self", "cls"):
+                    cls = self.scopes.enclosing_class(from_node)
+                    if cls is not None and cls.name in classes:
+                        return f"a:{cls.name}.{attr}"
+                elif recv in classes:
+                    return f"a:{recv}.{attr}"
+            if len(classes) == 1:
+                return f"a:{next(iter(classes))}.{attr}"
+            return f"a:*.{attr}"
+        return None
+
+    def _enclosing_fn_quals(self, node):
+        return [self.scopes.qualname(s)
+                for s in self.scopes.scope_chain(node)
+                if isinstance(s, _FUNC_NODES)]
+
+    # -- function locals ----------------------------------------------------
+    def _collect_function_locals(self):
+        self.fn_locals = {}     # qual -> set of local names
+        self.fn_globals = {}    # qual -> names declared `global`
+        self.fn_nonlocals = {}  # qual -> names declared `nonlocal`
+        for qual, fnode in self.graph.functions.items():
+            loc, gl, nl = set(), set(), set()
+            if not isinstance(fnode, ast.Lambda):
+                for a in (list(fnode.args.posonlyargs) +
+                          list(fnode.args.args) + list(fnode.args.kwonlyargs)):
+                    loc.add(a.arg)
+                if fnode.args.vararg:
+                    loc.add(fnode.args.vararg.arg)
+                if fnode.args.kwarg:
+                    loc.add(fnode.args.kwarg.arg)
+            for n in CallGraph.body_nodes(fnode):
+                if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Store):
+                    loc.add(n.id)
+                elif isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    loc.add(n.name)
+                elif isinstance(n, ast.comprehension):
+                    for t in ast.walk(n.target):
+                        if isinstance(t, ast.Name):
+                            loc.add(t.id)
+                elif isinstance(n, ast.Global):
+                    gl.update(n.names)
+                elif isinstance(n, ast.Nonlocal):
+                    nl.update(n.names)
+            loc -= gl
+            loc -= nl
+            self.fn_locals[qual] = loc
+            self.fn_globals[qual] = gl
+            self.fn_nonlocals[qual] = nl
+        # mutable module globals: module-level Assign targets + anything
+        # declared `global` somewhere (imports/classes/defs excluded)
+        self.module_globals = set()
+        for stmt in self.tree.body:
+            if isinstance(stmt, ast.Assign):
+                for t in stmt.targets:
+                    if isinstance(t, ast.Name):
+                        self.module_globals.add(t.id)
+            elif isinstance(stmt, (ast.AnnAssign, ast.AugAssign)) and \
+                    isinstance(stmt.target, ast.Name):
+                self.module_globals.add(stmt.target.id)
+        for gl in self.fn_globals.values():
+            self.module_globals.update(gl)
+
+    # -- thread-entry discovery ---------------------------------------------
+    def _discover_entries(self):
+        self.entries = []
+        for n in ast.walk(self.tree):
+            if not isinstance(n, ast.Call):
+                continue
+            d = dotted(n.func)
+            target = kind = None
+            if d and d[-1] in ("Thread", "Process") and \
+                    (len(d) == 1 or d[0] in ("threading",
+                                             "multiprocessing")):
+                kind = "thread"
+                target = _kwarg(n, "target") or (
+                    n.args[1] if len(n.args) > 1 else None)
+            elif d and d[-1] == "Timer" and \
+                    (len(d) == 1 or d[0] == "threading"):
+                kind = "timer"
+                target = _kwarg(n, "function") or (
+                    n.args[1] if len(n.args) > 1 else None)
+            elif isinstance(n.func, ast.Attribute) and \
+                    n.func.attr == "submit" and n.args:
+                kind = "submit"
+                target = n.args[0]
+            elif d == ("atexit", "register") and n.args:
+                kind = "atexit"
+                target = n.args[0]
+            elif d == ("signal", "signal") and len(n.args) > 1:
+                kind = "signal"
+                target = n.args[1]
+            elif d and d[-1] == "start_new_thread" and n.args:
+                kind = "thread"
+                target = n.args[0]
+            if kind is None or target is None:
+                continue
+            daemon_kw = _kwarg(n, "daemon")
+            daemon = isinstance(daemon_kw, ast.Constant) and \
+                daemon_kw.value is True
+            qual = self.graph.resolve_target(target, n)
+            self.entries.append(Entry(kind, qual, n, daemon))
+
+    # -- contexts -----------------------------------------------------------
+    def _compute_contexts(self):
+        """contexts[qual] = set of context labels the function can run
+        on. Thread contexts come from entry reachability; the implicit
+        "sync" context flows from functions nothing local calls (the
+        public API) that are not themselves entry targets."""
+        entry_targets = {e.target for e in self.entries if e.target}
+        self.entry_reach = {}
+        for e in self.entries:
+            if e.target:
+                self.entry_reach.setdefault(
+                    e.label, self.graph.reachable([e.target]))
+        sync_seeds = [q for q in self.graph.functions
+                      if not self.graph.callers(q)
+                      and q not in entry_targets]
+        self.sync_reach = self.graph.reachable(sync_seeds)
+        self.contexts = {}
+        for q in self.graph.functions:
+            ctxs = {label for label, reach in self.entry_reach.items()
+                    if q in reach}
+            if q in self.sync_reach:
+                ctxs.add("sync")
+            self.contexts[q] = ctxs
+
+    # -- held-lock walk -----------------------------------------------------
+    def _walk_held(self):
+        """held[qual] = {id(node): (held lock tuple)} for every node of
+        the function's own body, plus direct lock-order edges."""
+        self.held = {}
+        self.order_edges = []   # (A, B, site node, func qual)
+        self.acquires = {}      # qual -> set of lock ids acquired directly
+        for qual, fnode in self.graph.functions.items():
+            table = {}
+            acq = set()
+
+            def mark(node, held):
+                if id(node) in table:
+                    return
+                table[id(node)] = held
+                for ch in ast.iter_child_nodes(node):
+                    if isinstance(ch, _FUNC_NODES):
+                        table[id(ch)] = held
+                        continue
+                    mark(ch, held)
+
+            def enter(lid, held, site):
+                if lid in held and lid not in self.reentrant:
+                    self.report(
+                        "lock-order-inversion", site, qual,
+                        f"lock `{lid}` re-acquired while already held — "
+                        "a non-reentrant Lock self-deadlocks here",
+                        f"reacquire:{lid}", "definite", "lock-order")
+                for a in held:
+                    if a != lid:
+                        self.order_edges.append((a, lid, site, qual))
+                acq.add(lid)
+
+            def do_stmts(body, held):
+                held = list(held)
+                for st in body:
+                    do_stmt(st, held)
+
+            def do_stmt(st, held):
+                hf = tuple(held)
+                if isinstance(st, (ast.With, ast.AsyncWith)):
+                    inner = list(held)
+                    for item in st.items:
+                        mark(item.context_expr, hf)
+                        if item.optional_vars is not None:
+                            mark(item.optional_vars, hf)
+                        lid = self._resolve_lock(item.context_expr, st)
+                        if lid is not None:
+                            enter(lid, inner, st)
+                            inner.append(lid)
+                    table[id(st)] = hf
+                    do_stmts(st.body, inner)
+                    return
+                if isinstance(st, (ast.If, ast.While)):
+                    mark(st.test, hf)
+                    table[id(st)] = hf
+                    do_stmts(st.body, held)
+                    do_stmts(st.orelse, held)
+                    return
+                if isinstance(st, (ast.For, ast.AsyncFor)):
+                    mark(st.target, hf)
+                    mark(st.iter, hf)
+                    table[id(st)] = hf
+                    do_stmts(st.body, held)
+                    do_stmts(st.orelse, held)
+                    return
+                if isinstance(st, ast.Try):
+                    table[id(st)] = hf
+                    do_stmts(st.body, held)
+                    for h in st.handlers:
+                        table[id(h)] = hf
+                        if h.type is not None:
+                            mark(h.type, hf)
+                        do_stmts(h.body, held)
+                    do_stmts(st.orelse, held)
+                    do_stmts(st.finalbody, held)
+                    return
+                # manual acquire()/release() at statement granularity:
+                # held for the REMAINDER of this block
+                if isinstance(st, ast.Expr) and \
+                        isinstance(st.value, ast.Call) and \
+                        isinstance(st.value.func, ast.Attribute):
+                    call = st.value
+                    if call.func.attr == "acquire":
+                        lid = self._resolve_lock(call.func.value, st)
+                        if lid is not None:
+                            mark(st, hf)
+                            enter(lid, held, st)
+                            held.append(lid)
+                            return
+                    elif call.func.attr == "release":
+                        lid = self._resolve_lock(call.func.value, st)
+                        mark(st, hf)
+                        if lid is not None and lid in held:
+                            held.remove(lid)
+                        return
+                mark(st, hf)
+
+            if isinstance(fnode, ast.Lambda):
+                mark(fnode.body, ())
+            else:
+                do_stmts(fnode.body, ())
+            self.held[qual] = table
+            self.acquires[qual] = acq
+
+    def _effective_held_fixpoint(self):
+        """Locks a function provably ALWAYS runs under: the intersection
+        over its local call sites of (site-held ∪ caller's effective
+        held). Only private-named helpers inherit — a public function
+        is callable from outside the module with nothing held."""
+        self.eff = {q: frozenset() for q in self.graph.functions}
+        entry_targets = {e.target for e in self.entries if e.target}
+
+        def inheritable(q):
+            last = q.rsplit(".", 1)[-1]
+            return (last.startswith("_") and last not in DUNDER_INIT
+                    and q not in entry_targets
+                    and self.graph.callers(q))
+
+        for _ in range(4):
+            changed = False
+            for q in self.graph.functions:
+                if not inheritable(q):
+                    continue
+                sets = []
+                for caller, call_node in self.graph.callers(q):
+                    site = self.held.get(caller, {}).get(
+                        id(call_node), ())
+                    sets.append(frozenset(site) | self.eff.get(
+                        caller, frozenset()))
+                new = frozenset.intersection(*sets) if sets else frozenset()
+                if new != self.eff[q]:
+                    self.eff[q] = new
+                    changed = True
+            if not changed:
+                break
+
+    def _held_at(self, qual, node):
+        return frozenset(self.held.get(qual, {}).get(id(node), ())) | \
+            self.eff.get(qual, frozenset())
+
+    # -- shared-state access table -------------------------------------------
+    def _owner_of_free_name(self, qual, name):
+        """The qualname of the nearest enclosing function that binds
+        `name` as a local (closure cell owner), or None."""
+        fnode = self.graph.functions.get(qual)
+        if fnode is None:
+            return None
+        for s in self.scopes.scope_chain(fnode):
+            if isinstance(s, _FUNC_NODES):
+                oq = self.scopes.qualname(s)
+                if name in self.fn_locals.get(oq, ()):
+                    return oq
+        return None
+
+    def _var_for_name(self, qual, name):
+        """Shared-var key for a bare name access in `qual`, or None for
+        plain locals."""
+        if name in self.fn_locals.get(qual, ()):
+            # the OWNER's accesses to a local that a nested function
+            # captures are the sync side of a closure-shared cell
+            if (qual, name) in self.escaping:
+                return ("c", qual, name)
+            return None
+        if name in self.fn_globals.get(qual, ()) or (
+                name in self.module_globals):
+            return ("g", name)
+        owner = self._owner_of_free_name(qual, name)
+        if owner is not None:
+            return ("c", owner, name)
+        return None
+
+    def _class_for_receiver(self, qual, recv_name):
+        if recv_name in ("self", "cls"):
+            fnode = self.graph.functions.get(qual)
+            cls = self.scopes.enclosing_class(fnode) if fnode is not None \
+                else None
+            return cls.name if cls is not None else None
+        if recv_name in self.graph.classes:
+            return recv_name
+        return None
+
+    def _collect_accesses(self):
+        """accesses[var] = {"reads": [(qual, node, held)],
+                            "writes": [(qual, node, held)]}"""
+        # escape pre-pass: (owner qual, name) for every local some
+        # nested function references free — the closure cells that can
+        # be shared between a function and its thread targets
+        self.escaping = set()
+        for qual, fnode in self.graph.functions.items():
+            for n in CallGraph.body_nodes(fnode):
+                if isinstance(n, ast.Name) and \
+                        n.id not in self.fn_locals.get(qual, ()) and \
+                        n.id not in self.module_globals:
+                    owner = self._owner_of_free_name(qual, n.id)
+                    if owner is not None:
+                        self.escaping.add((owner, n.id))
+        self.accesses = {}
+
+        def rec(var, kind, qual, node):
+            if var is None:
+                return
+            slot = self.accesses.setdefault(
+                var, {"reads": [], "writes": []})
+            slot[kind].append((qual, node, self._held_at(qual, node)))
+
+        for qual, fnode in self.graph.functions.items():
+            for n in CallGraph.body_nodes(fnode):
+                if isinstance(n, ast.Name):
+                    if isinstance(n.ctx, ast.Store):
+                        # a bare-name store is a shared mutation only
+                        # for declared globals/nonlocals (otherwise it
+                        # just binds a local)
+                        if n.id in self.fn_globals.get(qual, ()):
+                            rec(("g", n.id), "writes", qual, n)
+                        elif n.id in self.fn_nonlocals.get(qual, ()):
+                            owner = self._owner_of_free_name(qual, n.id)
+                            if owner:
+                                rec(("c", owner, n.id), "writes", qual, n)
+                    elif isinstance(n.ctx, ast.Load):
+                        rec(self._var_for_name(qual, n.id), "reads",
+                            qual, n)
+                elif isinstance(n, ast.Attribute) and \
+                        isinstance(n.value, ast.Name):
+                    cname = self._class_for_receiver(qual, n.value.id)
+                    if cname is not None:
+                        kind = ("writes"
+                                if isinstance(n.ctx, (ast.Store, ast.Del))
+                                else "reads")
+                        rec(("a", cname, n.attr), kind, qual, n)
+                elif isinstance(n, ast.Subscript):
+                    if not isinstance(n.ctx, (ast.Store, ast.Del)):
+                        continue
+                    # container-element store: the ROOT is mutated
+                    root = n.value
+                    while isinstance(root, (ast.Attribute, ast.Subscript)):
+                        if isinstance(root, ast.Attribute) and \
+                                isinstance(root.value, ast.Name):
+                            cname = self._class_for_receiver(
+                                qual, root.value.id)
+                            if cname is not None:
+                                rec(("a", cname, root.attr), "writes",
+                                    qual, n)
+                                root = None
+                                break
+                        root = root.value
+                    if isinstance(root, ast.Name):
+                        rec(self._var_for_name(qual, root.id),
+                            "writes", qual, n)
+                elif isinstance(n, ast.Call) and \
+                        isinstance(n.func, ast.Attribute) and \
+                        n.func.attr in MUTATING_METHODS:
+                    recv = n.func.value
+                    if isinstance(recv, ast.Name):
+                        rec(self._var_for_name(qual, recv.id),
+                            "writes", qual, n)
+                    elif isinstance(recv, ast.Attribute) and \
+                            isinstance(recv.value, ast.Name):
+                        cname = self._class_for_receiver(
+                            qual, recv.value.id)
+                        if cname is not None:
+                            rec(("a", cname, recv.attr), "writes", qual, n)
+                elif isinstance(n, ast.AugAssign):
+                    t = n.target
+                    if isinstance(t, ast.Attribute) and \
+                            isinstance(t.value, ast.Name):
+                        cname = self._class_for_receiver(qual, t.value.id)
+                        if cname is not None:
+                            rec(("a", cname, t.attr), "writes", qual, n)
+                            rec(("a", cname, t.attr), "reads", qual, n)
+
+    # -- shared-ness --------------------------------------------------------
+    def _var_name(self, var):
+        if var[0] == "g":
+            return var[1]
+        if var[0] == "a":
+            return f"{var[1]}.{var[2]}"
+        return f"{var[1]}.<local {var[2]}>"
+
+    def _var_contexts(self, var):
+        slot = self.accesses[var]
+        ctxs = set()
+        for kind in ("reads", "writes"):
+            for qual, _n, _h in slot[kind]:
+                ctxs.update(self.contexts.get(qual, ()))
+        return ctxs
+
+    def _var_lock_assoc(self, var):
+        slot = self.accesses[var]
+        return any(h for kind in ("reads", "writes")
+                   for _q, _n, h in slot[kind])
+
+    def _shared_vars(self):
+        """Vars that matter: multi-context with a thread context, or
+        lock-associated. Returns {var: (contexts, lock_assoc)}."""
+        out = {}
+        for var, slot in self.accesses.items():
+            if not slot["writes"]:
+                continue
+            ctxs = self._var_contexts(var)
+            lock_assoc = self._var_lock_assoc(var)
+            multi = len(ctxs) >= 2 and any(c != "sync" for c in ctxs)
+            if multi or lock_assoc:
+                out[var] = (ctxs, lock_assoc)
+        return out
+
+    def _is_init_write(self, var, qual):
+        """Constructor writes happen before the object is visible to a
+        second thread — never a race."""
+        if var[0] != "a":
+            return qual.rsplit(".", 1)[-1] in DUNDER_INIT
+        last = qual.rsplit(".", 1)[-1]
+        return last in DUNDER_INIT and f".{var[1]}." in f".{qual}."
+
+    # -- rules --------------------------------------------------------------
+    def run(self):
+        shared = self._shared_vars()
+        claimed = self._check_check_then_act(shared)     # CL007 first
+        self._check_unguarded_mutation(shared, claimed)  # CL001 defers
+        self._check_lock_order()                         # CL002
+        self._check_blocking_under_lock()                # CL003
+        self._check_thread_before_fork()                 # CL004
+        self._check_nonatomic_shared_write()             # CL005
+        self._check_shutdown_ordering()                  # CL006
+        for f in self.findings:
+            f.suppressed = _waiver_suppressed(self.lines, f.line, f.rule,
+                                              TOOL, RULES)
+        return self.findings
+
+    # CL007 ------------------------------------------------------------------
+    def _test_reads_var(self, test, var, qual):
+        for n in ast.walk(test):
+            if var[0] == "g" and isinstance(n, ast.Name) and \
+                    isinstance(n.ctx, ast.Load) and n.id == var[1] and \
+                    self._var_for_name(qual, n.id) == var:
+                return True
+            if var[0] == "c" and isinstance(n, ast.Name) and \
+                    isinstance(n.ctx, ast.Load) and n.id == var[2] and \
+                    self._var_for_name(qual, n.id) == var:
+                return True
+            if var[0] == "a" and isinstance(n, ast.Attribute) and \
+                    n.attr == var[2] and isinstance(n.value, ast.Name) and \
+                    self._class_for_receiver(qual, n.value.id) == var[1]:
+                return True
+        return False
+
+    def _check_check_then_act(self, shared):
+        claimed = set()
+        for var, (ctxs, lock_assoc) in shared.items():
+            writes = self.accesses[var]["writes"]
+            by_fn = {}
+            for qual, node, held in writes:
+                if not held and not self._is_init_write(var, qual):
+                    by_fn.setdefault(qual, []).append(node)
+            if not by_fn:
+                continue
+            for qual, wnodes in by_fn.items():
+                fnode = self.graph.functions[qual]
+                for n in CallGraph.body_nodes(fnode):
+                    if not isinstance(n, ast.If):
+                        continue
+                    if self._held_at(qual, n):
+                        continue
+                    if not self._test_reads_var(n.test, var, qual):
+                        continue
+                    # any unguarded write AT OR AFTER the check is the
+                    # act half: lazy init writes inside the branch, a
+                    # tick-style monotonicity guard writes later in the
+                    # same function — both are the same race
+                    acts = [w for w in wnodes if w.lineno >= n.lineno]
+                    if not acts:
+                        continue
+                    name = self._var_name(var)
+                    self.report(
+                        "check-then-act", n, qual,
+                        f"`{name}` is tested here and mutated at line "
+                        f"{acts[0].lineno} with no lock held across the "
+                        "check and the act — another thread can change "
+                        "it in between (shared across: "
+                        f"{', '.join(sorted(ctxs))})",
+                        f"toctou:{name}",
+                        "definite" if lock_assoc else "possible",
+                        "check-then-act")
+                    claimed.add((qual, var))
+                    break  # one finding per (function, var)
+        return claimed
+
+    # CL001 ------------------------------------------------------------------
+    def _check_unguarded_mutation(self, shared, claimed):
+        for var, (ctxs, lock_assoc) in shared.items():
+            name = self._var_name(var)
+            seen_fns = set()
+            for qual, node, held in self.accesses[var]["writes"]:
+                if held:
+                    continue
+                if self._is_init_write(var, qual):
+                    continue
+                if (qual, var) in claimed:
+                    continue
+                if not lock_assoc and "sync" in self.contexts.get(
+                        qual, ()) and len(
+                        self.contexts.get(qual, ())) == 1 and \
+                        len(ctxs - {"sync"}) == 0:
+                    continue  # purely-sync var (shouldn't reach here)
+                if (qual, var) in seen_fns:
+                    continue  # one finding per (function, var)
+                seen_fns.add((qual, var))
+                if lock_assoc:
+                    why = ("other accesses to it hold a lock — this "
+                           "write bypasses that discipline")
+                    conf = "definite"
+                else:
+                    why = (f"it is reachable from "
+                           f"{', '.join(sorted(ctxs))} with no lock "
+                           "anywhere")
+                    conf = "possible"
+                self.report(
+                    "unguarded-shared-mutation", node, qual,
+                    f"`{name}` is shared mutable state but this write "
+                    f"holds no lock: {why}; guard it, or waive with "
+                    f"`# threadlint: ok[CL001]` if a happens-before "
+                    "edge (GIL-atomic publish, queue handoff, "
+                    "single-writer contract) makes it safe",
+                    f"mut:{name}", conf, "shared-state")
+
+    # CL002 ------------------------------------------------------------------
+    def _check_lock_order(self):
+        # direct edges + one level through the call graph: a call made
+        # while holding A into a function that acquires B
+        edges = {}
+        for a, b, site, qual in self.order_edges:
+            edges.setdefault((a, b), (site, qual))
+        acq_closure = {}
+
+        def closure(q):
+            if q in acq_closure:
+                return acq_closure[q]
+            acq_closure[q] = set()  # cycle guard
+            out = set(self.acquires.get(q, ()))
+            for _site, callee in self.graph.callees(q):
+                out |= closure(callee)
+            acq_closure[q] = out
+            return out
+
+        for qual in self.graph.functions:
+            for call_node, callee in self.graph.callees(qual):
+                held = self._held_at(qual, call_node)
+                if not held:
+                    continue
+                for b in closure(callee):
+                    for a in held:
+                        if a != b:
+                            edges.setdefault((a, b), (call_node, qual))
+        # pairwise inversion: A->B and B->A both observed
+        reported = set()
+        for (a, b), (site, qual) in sorted(
+                edges.items(), key=lambda kv: (kv[1][0].lineno, kv[0])):
+            if (b, a) in edges and (b, a) not in reported:
+                reported.add((a, b))
+                self.report(
+                    "lock-order-inversion", site, qual,
+                    f"lock `{b}` is acquired while holding `{a}` here, "
+                    f"but elsewhere `{a}` is acquired while holding "
+                    f"`{b}` — two threads taking the two paths "
+                    "deadlock (ABBA)",
+                    f"order:{a}->{b}", "definite", "lock-order")
+
+    # CL003 ------------------------------------------------------------------
+    def _blocking_call(self, n, held, qual):
+        """(label, confidence) when call `n` can block, else None."""
+        d = dotted(n.func)
+        if d == ("time", "sleep"):
+            return "time.sleep", "definite"
+        if d and d[0] == "subprocess" and d[-1] in (
+                "run", "call", "check_call", "check_output"):
+            return ".".join(d), "definite"
+        if d and d[0] == "os" and d[-1] in ("waitpid", "system"):
+            return ".".join(d), "definite"
+        if d and d[0] in BLOCKING_NET_HEADS and len(d) > 1:
+            return ".".join(d), "definite"
+        if isinstance(n.func, ast.Attribute):
+            attr = n.func.attr
+            recv = n.func.value
+            if attr in ("join", "communicate") and not _has_timeout(n):
+                return f".{attr}", "definite"
+            if attr == "wait" and not _has_timeout(n):
+                # Condition.wait on the HELD condition releases it —
+                # that is the idiom, not a hazard
+                lid = self._resolve_lock(recv, n)
+                if lid is None or lid not in held:
+                    return ".wait", "definite"
+                return None
+            if attr == "get" and not n.args and not n.keywords:
+                return ".get", "definite"
+            if attr == "put" and isinstance(recv, ast.Name) and \
+                    QUEUEISH_NAME.search(recv.id) and not _has_timeout(n):
+                return ".put", "definite"
+            if attr in FILE_IO_METHODS:
+                return f".{attr}", "possible"
+        if d and len(d) == 1 and d[0] == "open":
+            return "open", "possible"
+        if d and d[0] == "json" and d[-1] in ("dump", "load"):
+            return ".".join(d), "possible"
+        if d and d[0] == "os" and d[-1] == "fsync":
+            return "os.fsync", "possible"
+        return None
+
+    def _check_blocking_under_lock(self):
+        for qual, fnode in self.graph.functions.items():
+            seen = set()
+            for n in CallGraph.body_nodes(fnode):
+                if not isinstance(n, ast.Call):
+                    continue
+                held = self._held_at(qual, n)
+                if not held:
+                    continue
+                hit = self._blocking_call(n, held, qual)
+                if hit is None:
+                    continue
+                label, conf = hit
+                if (label,) in seen:
+                    continue  # one finding per call shape per function
+                seen.add((label,))
+                locks = ", ".join(sorted(held))
+                self.report(
+                    "blocking-under-lock", n, qual,
+                    f"{label} while holding `{locks}` — every thread "
+                    "contending on the lock stalls for the duration; "
+                    "move the blocking work outside the critical "
+                    "section or waive if the serialization is the "
+                    "contract",
+                    f"block:{label}", conf, "blocking")
+
+    # CL004 ------------------------------------------------------------------
+    def _check_thread_before_fork(self):
+        thread_ctor_lines = {}
+        for e in self.entries:
+            if e.kind in ("thread", "timer"):
+                chain = self._enclosing_fn_quals(e.node)
+                q = chain[0] if chain else "<module>"
+                thread_ctor_lines.setdefault(q, []).append(e.node.lineno)
+        for qual, starts in thread_ctor_lines.items():
+            fnode = self.graph.functions.get(qual)
+            nodes = (CallGraph.body_nodes(fnode) if fnode is not None
+                     else ast.walk(self.tree))
+            first = min(starts)
+            for n in nodes:
+                if not isinstance(n, ast.Call) or n.lineno <= first:
+                    continue
+                d = dotted(n.func)
+                if d in SPAWN_CALLS or (
+                        d and d[-1] == "fork" and d[0] == "os"):
+                    self.report(
+                        "thread-before-fork", n, qual,
+                        f"{'.'.join(d)} after a thread was started at "
+                        f"line {first} on the same path — the forked "
+                        "child inherits locked locks and torn state "
+                        "from threads that do not survive the fork",
+                        f"spawn:{'.'.join(d)}", "possible", "fork")
+
+    # CL005 ------------------------------------------------------------------
+    def _module_has_atomic_helpers(self):
+        return ("atomic_write_json" in self.src
+                or "os.replace" in self.src)
+
+    def _fn_has_atomic_pattern(self, qual):
+        fnode = self.graph.functions.get(qual)
+        if fnode is None:
+            return False
+        for n in CallGraph.body_nodes(fnode):
+            if isinstance(n, ast.Call):
+                d = dotted(n.func)
+                if d and ((d[0] == "os" and d[-1] in ("replace", "rename"))
+                          or d[-1] == "atomic_write_json"):
+                    return True
+        return False
+
+    def _check_nonatomic_shared_write(self):
+        participates = self._module_has_atomic_helpers()
+        for qual, fnode in self.graph.functions.items():
+            for n in CallGraph.body_nodes(fnode):
+                if not (isinstance(n, ast.Call) and
+                        dotted(n.func) == ("open",)):
+                    continue
+                mode = _const_str(_kwarg(n, "mode")) or (
+                    _const_str(n.args[1]) if len(n.args) > 1 else None)
+                if mode is None or not any(c in mode for c in "wx"):
+                    continue
+                try:
+                    path_src = ast.get_source_segment(
+                        self.src, n.args[0]) or ""
+                except Exception:  # pragma: no cover — degenerate node
+                    path_src = ""
+                hinted = bool(SHARED_PATH_HINT.search(path_src))
+                if not (participates or hinted):
+                    continue
+                if self._fn_has_atomic_pattern(qual):
+                    continue  # tmp-file + os.replace: the atomic idiom
+                self.report(
+                    "non-atomic-shared-write", n, qual,
+                    f"open({path_src or '...'}, {mode!r}) truncates in "
+                    "place — a concurrent reader (another rank, a "
+                    "scraper, the merge thread) sees an empty or torn "
+                    "file; write to a tmp path and os.replace(), or "
+                    "use atomic_write_json",
+                    "open-w", "definite" if hinted and participates
+                    else "possible", "shared-path")
+
+    # CL006 ------------------------------------------------------------------
+    def _reach_does_file_io(self, target):
+        """WRITE I/O only: a daemon thread reading a file at exit is
+        harmless; a torn write is the hazard."""
+        for q in self.graph.reachable([target]):
+            fnode = self.graph.functions.get(q)
+            if fnode is None:
+                continue
+            for n in CallGraph.body_nodes(fnode):
+                if isinstance(n, ast.Call):
+                    d = dotted(n.func)
+                    if d == ("open",):
+                        mode = _const_str(_kwarg(n, "mode")) or (
+                            _const_str(n.args[1])
+                            if len(n.args) > 1 else None)
+                        if mode and any(c in mode for c in "wax+"):
+                            return True
+                        continue
+                    if (d and d[0] == "json" and d[-1] == "dump") or (
+                            d and d[0] == "os"
+                            and d[-1] in ("replace", "rename")):
+                        return True
+                    if isinstance(n.func, ast.Attribute) and \
+                            n.func.attr in ("write", "writelines"):
+                        return True
+        return False
+
+    def _check_shutdown_ordering(self):
+        daemon_lock_use = set()
+        for e in self.entries:
+            if e.daemon and e.target:
+                for q in self.graph.reachable([e.target]):
+                    daemon_lock_use |= self.acquires.get(q, set())
+                    daemon_lock_use |= self.eff.get(q, frozenset())
+        for e in self.entries:
+            if e.daemon and e.target and self._reach_does_file_io(e.target):
+                chain = self._enclosing_fn_quals(e.node)
+                q = chain[0] if chain else "<module>"
+                self.report(
+                    "shutdown-ordering", e.node, q,
+                    f"daemon thread `{e.target}` performs file I/O — "
+                    "at interpreter exit daemon threads are killed "
+                    "abruptly, tearing in-flight writes; join it on "
+                    "shutdown or make every write atomic "
+                    "(tmp + os.replace)",
+                    f"daemon-io:{e.target}", "possible", "shutdown")
+            if e.kind == "atexit" and e.target:
+                hazards = []
+                for q in self.graph.reachable([e.target]):
+                    fnode = self.graph.functions.get(q)
+                    if fnode is None:
+                        continue
+                    for n in CallGraph.body_nodes(fnode):
+                        if isinstance(n, ast.Call) and \
+                                isinstance(n.func, ast.Attribute) and \
+                                n.func.attr == "join" and \
+                                not _has_timeout(n):
+                            hazards.append("joins a thread with no "
+                                           "timeout")
+                    overlap = (self.acquires.get(q, set())
+                               & daemon_lock_use)
+                    if overlap:
+                        hazards.append(
+                            "takes lock(s) "
+                            f"{', '.join(sorted(overlap))} that daemon "
+                            "threads also hold")
+                if hazards:
+                    chain = self._enclosing_fn_quals(e.node)
+                    q = chain[0] if chain else "<module>"
+                    self.report(
+                        "shutdown-ordering", e.node, q,
+                        f"atexit handler `{e.target}` "
+                        f"{'; '.join(sorted(set(hazards)))} — at exit "
+                        "daemon threads may be frozen mid-hold, so "
+                        "this handler can deadlock shutdown",
+                        f"atexit:{e.target}", "possible", "shutdown")
+
+
+# ---------------------------------------------------------------------------
+# tree driver
+
+def iter_py_files(root):
+    yield from _iter_py_files(root, skip_dirs=SKIP_DIRS)
+
+
+def analyze_paths(roots):
+    """Analyze every .py under each root. Returns (findings, errors):
+    errors are (path, message) for unparseable files."""
+    findings, errors = [], []
+    for root in roots:
+        root = os.path.normpath(root)
+        root_parent = os.path.dirname(os.path.abspath(root))
+        for path in iter_py_files(root):
+            rel = _relpath(path, root_parent)
+            try:
+                ma = ModuleConcurrencyAnalysis(path, root_parent)
+                findings.extend(ma.run())
+            except (SyntaxError, UnicodeDecodeError) as e:
+                errors.append((rel, f"{type(e).__name__}: {e}"))
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings, errors
+
+
+def analyze_file(path):
+    return analyze_paths([path])

@@ -1,0 +1,57 @@
+"""Rule catalog for threadlint.
+
+Each rule names one class of host-side concurrency hazard in the
+threaded runtime (elastic watchdogs, background merges, async
+checkpoint saves, data-pipeline workers, atexit/signal handlers). The
+catalog is data, not behavior — detection lives in analyzer.py — and
+the Rule dataclass/severity vocabulary is shared with tracelint via
+tools/staticlib.
+
+Severity:
+  error    — a proven race/deadlock shape; fix or waive with a review.
+  warning  — likely hazard; depends on which paths actually run
+             concurrently.
+  info     — hygiene note; never gates CI.
+"""
+from __future__ import annotations
+
+from ..staticlib.rules import Rule, ruleset
+
+RULES, BY_ID, get = ruleset([
+    Rule("CL001", "unguarded-shared-mutation", "error", False,
+         "shared mutable state (module global / instance attribute "
+         "reachable from two or more thread-entry call paths, or "
+         "guarded by a lock elsewhere) mutated without holding its "
+         "guarding lock"),
+    Rule("CL002", "lock-order-inversion", "error", False,
+         "two named locks acquired in opposite orders on different "
+         "paths (or a non-reentrant lock re-acquired while held) — "
+         "the classic ABBA deadlock"),
+    Rule("CL003", "blocking-under-lock", "warning", False,
+         "blocking call while holding a lock (time.sleep, join()/"
+         "wait() without timeout, queue put/get, subprocess waits, "
+         "network, file I/O) — every other thread contending on the "
+         "lock stalls behind it"),
+    Rule("CL004", "thread-before-fork", "warning", False,
+         "a thread is started before a fork/subprocess spawn on the "
+         "same code path — the child inherits locked locks and "
+         "half-initialized state from threads that do not survive "
+         "the fork"),
+    Rule("CL005", "non-atomic-shared-write", "warning", False,
+         "open(path, 'w')-style truncating write to a coordination-"
+         "store/telemetry shared path — concurrent readers see torn "
+         "files; route through the atomic-rename helpers "
+         "(atomic_write_json / tmp + os.replace)"),
+    Rule("CL006", "shutdown-ordering", "warning", False,
+         "daemon-thread/atexit shutdown-ordering hazard: a daemon "
+         "thread doing file I/O is killed mid-write at interpreter "
+         "exit, and an atexit handler that joins threads or takes a "
+         "lock a daemon thread may hold can deadlock shutdown"),
+    Rule("CL007", "check-then-act", "warning", False,
+         "check-then-act (TOCTOU) on shared state: a flag/attribute "
+         "is tested and then mutated without a lock held across both "
+         "halves — the state can change between the check and the "
+         "act"),
+])
+
+__all__ = ["Rule", "RULES", "BY_ID", "get"]

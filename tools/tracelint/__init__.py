@@ -16,6 +16,12 @@ trace-hygiene hazards (rules.py), and emits
     dispatch preloads at import so proven-unsafe ops never pay a
     failed-compile probe.
 
+The analysis harness (scope/taint machinery, fingerprint baseline,
+inline waivers, report grammar) is the shared `tools/staticlib/` core;
+this package carries only the jit-specific rule catalog, visitors and
+the unjittable-manifest emitter. threadlint (docs/THREADLINT.md) is
+the same harness bound to a concurrency catalog.
+
 Usage:
     python -m tools.tracelint paddle_tpu
     python -m tools.tracelint paddle_tpu --emit-manifest

@@ -11,7 +11,8 @@ What counts as an "op body": any function object that can reach
     staleness of the marking.
 
 Within an op body the analysis runs a conservative name-level taint
-pass: positional parameters without defaults are assumed traced
+pass (tools/staticlib/taint.py, bound to the jit sanitizer vocabulary
+below): positional parameters without defaults are assumed traced
 (arrays); parameters with defaults and closure statics are assumed
 static.  Shape/dtype/ndim reads, ``len()``, ``isinstance()`` etc.
 sanitize taint (they are static under trace).  Hazard visitors then
@@ -24,15 +25,24 @@ jnp/lax/jax calls).
 The pass is intentionally file-local and approximate: it must never
 import the code it inspects (analysis of a broken tree is exactly when
 lint is most useful), and false positives are absorbed by the checked
-baseline rather than by weakening detection.
+baseline rather than by weakening detection. The harness — scope
+index, taint engine, fingerprints, waivers — is the shared
+tools/staticlib core; only the jit-specific vocabulary and visitors
+live here.
 """
 from __future__ import annotations
 
 import ast
-import dataclasses
 import os
 import re
 
+from ..staticlib import findings as _findings
+from ..staticlib.astnav import (
+    ScopeIndex as _ScopeIndex, dotted, func_params, iter_py_files as
+    _iter_py_files, relpath as _do_relpath, runtime_first_line,
+)
+from ..staticlib.taint import NameTaint, body_nodes
+from ..staticlib.waivers import suppressed as _waiver_suppressed
 from .rules import RULES
 
 __all__ = ["Finding", "analyze_file", "analyze_paths", "iter_py_files"]
@@ -41,36 +51,10 @@ __all__ = ["Finding", "analyze_file", "analyze_paths", "iter_py_files"]
 # ---------------------------------------------------------------------------
 # model
 
-@dataclasses.dataclass
-class Finding:
-    rule: str           # rules.py slug
-    path: str           # posix path relative to the analysis root's parent
-    line: int
-    col: int
-    func: str           # dotted qualname of the op body ("" for module)
-    func_name: str      # runtime co_name ("<lambda>" for lambdas)
-    func_line: int      # runtime co_firstlineno of the op body
-    message: str
-    symbol: str         # short stable token for fingerprinting
-    severity: str
-    confidence: str     # "definite" | "possible"
-    context: str        # "op-body" | "non-jittable" | "trace-site"
-    suppressed: bool = False
+class Finding(_findings.Finding):
+    """tracelint finding: the shared record bound to the TL catalog."""
 
-    @property
-    def rule_id(self):
-        return RULES[self.rule].id
-
-    def fingerprint(self):
-        """Line-number-free identity: survives unrelated edits above the
-        finding, so the baseline doesn't churn with the file."""
-        return f"{self.rule}|{self.path}|{self.func}|{self.symbol}"
-
-    def to_dict(self):
-        d = dataclasses.asdict(self)
-        d["rule_id"] = self.rule_id
-        d["fingerprint"] = self.fingerprint()
-        return d
+    RULES = RULES
 
 
 # ---------------------------------------------------------------------------
@@ -124,107 +108,6 @@ TRACE_ENTRY_DOTTED = {
 TRACE_ENTRY_BARE = {"shard_map"}
 
 
-def dotted(node):
-    """('jax','jit') for jax.jit, ('x',) for x; None for anything else."""
-    parts = []
-    while isinstance(node, ast.Attribute):
-        parts.append(node.attr)
-        node = node.value
-    if isinstance(node, ast.Name):
-        parts.append(node.id)
-        return tuple(reversed(parts))
-    return None
-
-
-def runtime_first_line(node):
-    """co_firstlineno of the code object this def/lambda compiles to:
-    for decorated defs that is the FIRST DECORATOR line, not the `def`
-    line (CPython 3.8+ ast puts .lineno on the def)."""
-    decs = getattr(node, "decorator_list", None)
-    if decs:
-        return min([d.lineno for d in decs] + [node.lineno])
-    return node.lineno
-
-
-def func_params(node):
-    """(all param names, names assumed TRACED). Params with defaults are
-    assumed static — the codebase idiom rides statics in via defaults
-    (`lambda x, axis=axis: ...`) and arrays positionally."""
-    a = node.args
-    names, traced = [], set()
-    pos = list(a.posonlyargs) + list(a.args)
-    n_def = len(a.defaults)
-    for i, p in enumerate(pos):
-        names.append(p.arg)
-        if i < len(pos) - n_def:
-            traced.add(p.arg)
-    if a.vararg:
-        names.append(a.vararg.arg)
-        traced.add(a.vararg.arg)
-    for p, d in zip(a.kwonlyargs, a.kw_defaults):
-        names.append(p.arg)
-        if d is None:
-            traced.add(p.arg)
-    if a.kwarg:
-        names.append(a.kwarg.arg)
-    return names, traced
-
-
-class _ScopeIndex:
-    """Parent links + lexical scope chains for one module AST."""
-
-    def __init__(self, tree):
-        self.parent = {}
-        for node in ast.walk(tree):
-            for child in ast.iter_child_nodes(node):
-                self.parent[child] = node
-        self.tree = tree
-
-    def scope_chain(self, node):
-        """Enclosing FunctionDef/AsyncFunctionDef/Lambda/ClassDef nodes,
-        innermost first (the node itself excluded)."""
-        out = []
-        cur = self.parent.get(node)
-        while cur is not None:
-            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
-                                ast.Lambda, ast.ClassDef)):
-                out.append(cur)
-            cur = self.parent.get(cur)
-        return out
-
-    def qualname(self, node):
-        parts = []
-        for s in [node] + self.scope_chain(node):
-            if isinstance(s, ast.Lambda):
-                parts.append("<lambda>")
-            else:
-                parts.append(s.name)
-        return ".".join(reversed(parts))
-
-    def resolve_function(self, name, from_node):
-        """Find the def/lambda a bare name refers to at `from_node`,
-        searching enclosing function scopes innermost-out, then module
-        level. Returns the AST node or None."""
-        scopes = [s for s in self.scope_chain(from_node)
-                  if not isinstance(s, ast.ClassDef)]
-        scopes.append(self.tree)
-        for scope in scopes:
-            body = scope.body if not isinstance(scope, ast.Lambda) else []
-            hit = None
-            for stmt in body:
-                if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)) \
-                        and stmt.name == name:
-                    hit = stmt
-                elif isinstance(stmt, ast.Assign):
-                    for t in stmt.targets:
-                        if isinstance(t, ast.Name) and t.id == name \
-                                and isinstance(stmt.value, ast.Lambda):
-                            hit = stmt.value
-            if hit is not None:
-                return hit
-        return None
-
-
 # ---------------------------------------------------------------------------
 # per-op-body hazard analysis
 
@@ -244,31 +127,20 @@ class _OpBodyChecker:
         self.func_line = runtime_first_line(fnode)
         self.n_found = 0
 
-        self.params, self.tainted = func_params(fnode)
-        self.vararg = fnode.args.vararg.arg if fnode.args.vararg else None
-        self.locals = set(self.params)
-        self._collect_locals()
+        # shared taint engine, bound to the jit sanitizer vocabulary
+        self.taint = NameTaint(fnode, static_attrs=STATIC_ATTRS,
+                               sanitizer_calls=SANITIZER_CALLS,
+                               coercions=COERCIONS,
+                               host_methods=HOST_METHODS)
+        self.params = self.taint.params
+        self.tainted = self.taint.tainted
+        self.vararg = self.taint.vararg
+        self.locals = self.taint.locals
         self.array_evidence = self._collect_array_evidence()
-        self._propagate_taint()
 
     # -- scope bookkeeping --------------------------------------------------
     def _body_nodes(self):
-        if isinstance(self.fnode, ast.Lambda):
-            yield from ast.walk(self.fnode.body)
-        else:
-            for stmt in self.fnode.body:
-                yield from ast.walk(stmt)
-
-    def _collect_locals(self):
-        for n in self._body_nodes():
-            if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Store):
-                self.locals.add(n.id)
-            elif isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                self.locals.add(n.name)
-            elif isinstance(n, ast.comprehension):
-                for t in ast.walk(n.target):
-                    if isinstance(t, ast.Name):
-                        self.locals.add(t.id)
+        yield from body_nodes(self.fnode)
 
     def _collect_array_evidence(self):
         """Names the body itself treats as arrays: fed to jnp/lax/jax
@@ -291,76 +163,12 @@ class _OpBodyChecker:
                         ev.add(side.id)
         return ev
 
-    def _propagate_taint(self):
-        """Name-level forward taint, iterated to a small fixpoint."""
-        for _ in range(3):
-            changed = False
-            for n in self._body_nodes():
-                tgts = None
-                if isinstance(n, ast.Assign):
-                    tgts, val = n.targets, n.value
-                elif isinstance(n, ast.AugAssign):
-                    tgts, val = [n.target], n.value
-                elif isinstance(n, ast.AnnAssign) and n.value is not None:
-                    tgts, val = [n.target], n.value
-                elif isinstance(n, ast.NamedExpr):
-                    tgts, val = [n.target], n.value
-                if not tgts or not self.expr_tainted(val):
-                    continue
-                for t in tgts:
-                    for nm in ast.walk(t):
-                        if isinstance(nm, ast.Name) \
-                                and nm.id not in self.tainted:
-                            self.tainted.add(nm.id)
-                            changed = True
-            if not changed:
-                break
-
     # -- taint query --------------------------------------------------------
     def expr_tainted(self, node):
-        if node is None:
-            return False
-        if isinstance(node, ast.Attribute):
-            if node.attr in STATIC_ATTRS:
-                return False
-            return self.expr_tainted(node.value)
-        if isinstance(node, ast.Call):
-            d = dotted(node.func)
-            if d and (d[-1] in SANITIZER_CALLS or d[-1] in COERCIONS
-                      or d[-1] in HOST_METHODS):
-                return False  # result is host-static (the call itself
-                #               may be a hazard, reported separately)
-            for a in list(node.args) + [kw.value for kw in node.keywords]:
-                if self.expr_tainted(a):
-                    return True
-            # method call: the receiver's taint flows to the result
-            # (x.astype(...) is as traced as x)
-            if isinstance(node.func, ast.Attribute):
-                return self.expr_tainted(node.func.value)
-            return False
-        if isinstance(node, ast.Name):
-            # the *args TUPLE is a host object (its truthiness/len are
-            # trace-static); only its ELEMENTS carry taint
-            if node.id == self.vararg:
-                return False
-            return node.id in self.tainted
-        if isinstance(node, ast.Subscript) and \
-                isinstance(node.value, ast.Name) and \
-                node.value.id == self.vararg:
-            return True
-        if isinstance(node, ast.Compare) and all(
-                isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops):
-            # `x is None` is an identity test on the HOST object — a
-            # tracer is never None, so the branch is trace-static
-            return False
-        for child in ast.iter_child_nodes(node):
-            if self.expr_tainted(child):
-                return True
-        return False
+        return self.taint.expr_tainted(node)
 
     def _taint_names(self, node):
-        return sorted({n.id for n in ast.walk(node)
-                       if isinstance(n, ast.Name) and n.id in self.tainted})
+        return self.taint.taint_names(node)
 
     # -- reporting ----------------------------------------------------------
     def report(self, rule, node, message, symbol, confidence):
@@ -602,23 +410,14 @@ class _OpBodyChecker:
 # per-module driver
 
 def _relpath(path, root_parent):
-    rel = os.path.relpath(path, root_parent)
-    return rel.replace(os.sep, "/")
+    return _do_relpath(path, root_parent)
 
 
 def _suppressed(lines, lineno, rule):
     """Inline waiver: `# tracelint: ok` or `# tracelint: ok[slug,...]` on
-    the flagged line waives the finding after human review."""
-    if not 1 <= lineno <= len(lines):
-        return False
-    m = re.search(r"#\s*tracelint:\s*ok(\[([A-Za-z0-9_,\- ]+)\])?",
-                  lines[lineno - 1])
-    if not m:
-        return False
-    if m.group(2) is None:
-        return True
-    waived = {s.strip() for s in m.group(2).split(",")}
-    return rule in waived or RULES[rule].id in waived
+    the flagged line waives the finding after human review (shared
+    machinery: tools/staticlib/waivers.py)."""
+    return _waiver_suppressed(lines, lineno, rule, "tracelint", RULES)
 
 
 class ModuleAnalysis:
@@ -772,14 +571,7 @@ AUDIT_EXEMPT_SUFFIXES = ("core/dispatch.py", "core/autograd.py",
 
 
 def iter_py_files(root):
-    if os.path.isfile(root):
-        yield root
-        return
-    for dirpath, dirnames, filenames in os.walk(root):
-        dirnames[:] = sorted(d for d in dirnames if d not in SKIP_DIRS)
-        for fn in sorted(filenames):
-            if fn.endswith(".py"):
-                yield os.path.join(dirpath, fn)
+    yield from _iter_py_files(root, skip_dirs=SKIP_DIRS)
 
 
 def analyze_paths(roots, audit_suspend=True):

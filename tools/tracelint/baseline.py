@@ -1,75 +1,28 @@
-"""Baseline (suppression) file handling.
+"""Baseline (suppression) file handling for tracelint.
 
-The baseline is a checked-in multiset of finding fingerprints —
-`rule|path|qualname|symbol`, deliberately line-number-free so edits
-above a finding don't churn it.  CI fails only on findings whose
-fingerprint count EXCEEDS the baselined count: pre-existing debt is
-visible (reported as "baselined") but non-blocking, while any new
-hazard, or a second instance of an old one, gates.
-
-Fixing a baselined finding leaves a dangling fingerprint; the report
-lists those as "stale baseline entries" so `--write-baseline` runs
-shrink the file monotonically toward zero.
+The mechanics — fingerprint multiset, EXCEEDS-count gating, stale-entry
+reporting — are the shared tools/staticlib/baseline.py core (see its
+docstring for the contract); this module binds tracelint's default path
+and regenerate hint.
 """
 from __future__ import annotations
 
-import collections
-import json
 import os
 
-BASELINE_VERSION = 1
+from ..staticlib.baseline import (  # noqa: F401 — re-exported API
+    BASELINE_VERSION, load_baseline, partition,
+)
+from ..staticlib.baseline import write_baseline as _write_baseline
+
 DEFAULT_BASELINE = os.path.join(os.path.dirname(__file__), "baseline.json")
 
-
-def load_baseline(path):
-    """fingerprint -> allowed count. Missing file = empty baseline."""
-    if not path or not os.path.exists(path):
-        return {}
-    with open(path, "r", encoding="utf-8") as f:
-        data = json.load(f)
-    if data.get("version") != BASELINE_VERSION:
-        raise ValueError(
-            f"baseline {path}: unsupported version {data.get('version')!r}")
-    return dict(data.get("fingerprints", {}))
+_COMMENT = ("tracelint suppression baseline — regenerate with "
+            "`python -m tools.tracelint paddle_tpu "
+            "--write-baseline` after reviewing that every new "
+            "finding is intended debt, not a regression.")
 
 
 def write_baseline(path, findings):
     """Snapshot current non-suppressed, non-info findings as the new
     baseline (info findings never gate, so baselining them is noise)."""
-    counts = collections.Counter(
-        f.fingerprint() for f in findings
-        if not f.suppressed and f.severity != "info")
-    data = {
-        "version": BASELINE_VERSION,
-        "comment": "tracelint suppression baseline — regenerate with "
-                   "`python -m tools.tracelint paddle_tpu "
-                   "--write-baseline` after reviewing that every new "
-                   "finding is intended debt, not a regression.",
-        "fingerprints": dict(sorted(counts.items())),
-    }
-    with open(path, "w", encoding="utf-8") as f:
-        json.dump(data, f, indent=1, sort_keys=False)
-        f.write("\n")
-    return counts
-
-
-def partition(findings, baseline):
-    """Split findings into (new, baselined, suppressed, info) and compute
-    stale baseline fingerprints. `new` is what should gate CI."""
-    new, baselined, suppressed, info = [], [], [], []
-    budget = dict(baseline)
-    for f in findings:
-        if f.suppressed:
-            suppressed.append(f)
-            continue
-        if f.severity == "info":
-            info.append(f)
-            continue
-        fp = f.fingerprint()
-        if budget.get(fp, 0) > 0:
-            budget[fp] -= 1
-            baselined.append(f)
-        else:
-            new.append(f)
-    stale = sorted(fp for fp, n in budget.items() if n > 0)
-    return new, baselined, suppressed, info, stale
+    return _write_baseline(path, findings, _COMMENT)

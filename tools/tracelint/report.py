@@ -1,90 +1,30 @@
-"""Human + machine-readable reporting for tracelint findings."""
+"""Human + machine-readable reporting for tracelint findings.
+
+The report grammar is the shared tools/staticlib/report.py core; this
+module binds the tool name (command/waiver syntax in the text) and the
+manifest section tracelint's JSON report carries.
+"""
 from __future__ import annotations
 
-import collections
-import json
-
+from ..staticlib.report import (  # noqa: F401 — re-exported API
+    REPORT_VERSION, format_finding, write_json,
+)
+from ..staticlib.report import human_report as _human_report
+from ..staticlib.report import json_report as _json_report
 from .rules import RULES
-
-REPORT_VERSION = 1
-
-
-def format_finding(f, tag=""):
-    tag = f" [{tag}]" if tag else ""
-    where = f"{f.path}:{f.line}:{f.col + 1}"
-    func = f" in `{f.func}`" if f.func else ""
-    return (f"{where}: {f.rule_id} {f.rule} ({f.severity}/"
-            f"{f.confidence}){tag}{func}\n    {f.message}")
 
 
 def human_report(new, baselined, suppressed, info, stale, errors,
                  verbose=False):
-    """Report text. `new` findings are always itemized (they gate);
-    baselined/suppressed/info collapse to counts unless verbose."""
-    out = []
-    for f in new:
-        out.append(format_finding(f, "NEW"))
-    if verbose:
-        for f in baselined:
-            out.append(format_finding(f, "baselined"))
-        for f in suppressed:
-            out.append(format_finding(f, "waived"))
-        for f in info:
-            out.append(format_finding(f, "info"))
-    for path, msg in errors:
-        out.append(f"{path}: PARSE ERROR — {msg}")
-    if stale:
-        out.append(f"stale baseline entries ({len(stale)}) — fixed debt; "
-                   "shrink the file with --write-baseline:")
-        for fp in stale[:20]:
-            out.append(f"    {fp}")
-        if len(stale) > 20:
-            out.append(f"    ... and {len(stale) - 20} more")
-
-    by_rule = collections.Counter(f.rule for f in new + baselined)
-    summary = (f"tracelint: {len(new)} new, {len(baselined)} baselined, "
-               f"{len(suppressed)} waived inline, {len(info)} info, "
-               f"{len(errors)} parse errors")
-    if by_rule:
-        summary += " | " + ", ".join(
-            f"{RULES[r].id} {r}: {n}" for r, n in sorted(by_rule.items()))
-    out.append(summary)
-    if new:
-        out.append("FAIL: new findings above — fix them, waive with "
-                   "`# tracelint: ok[rule]` after review, or (for "
-                   "accepted debt) refresh the baseline with "
-                   "--write-baseline.")
-    return "\n".join(out)
+    return _human_report(new, baselined, suppressed, info, stale, errors,
+                         tool="tracelint", rules=RULES, verbose=verbose)
 
 
 def json_report(new, baselined, suppressed, info, stale, errors,
                 manifest_entries=None):
-    return {
-        "version": REPORT_VERSION,
-        "summary": {
-            "new": len(new), "baselined": len(baselined),
-            "suppressed": len(suppressed), "info": len(info),
-            "parse_errors": len(errors), "stale_baseline": len(stale),
-        },
-        "rules": {slug: {"id": r.id, "severity": r.severity,
-                         "manifest": r.manifest, "summary": r.summary}
-                  for slug, r in sorted(RULES.items())},
-        "findings": {
-            "new": [f.to_dict() for f in new],
-            "baselined": [f.to_dict() for f in baselined],
-            "suppressed": [f.to_dict() for f in suppressed],
-            "info": [f.to_dict() for f in info],
-        },
-        "stale_baseline": stale,
-        "parse_errors": [{"path": p, "message": m} for p, m in errors],
-        "manifest": (
+    return _json_report(
+        new, baselined, suppressed, info, stale, errors, rules=RULES,
+        extra={"manifest": (
             {"|".join(map(str, k)): v
              for k, v in sorted(manifest_entries.items())}
-            if manifest_entries is not None else None),
-    }
-
-
-def write_json(path, payload):
-    with open(path, "w", encoding="utf-8") as f:
-        json.dump(payload, f, indent=1)
-        f.write("\n")
+            if manifest_entries is not None else None)})

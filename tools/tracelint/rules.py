@@ -3,12 +3,9 @@
 Each rule names one class of trace-hygiene hazard in code the eager
 dispatch layer (paddle_tpu/core/dispatch.py) may hand to `jax.jit`.
 The catalog is data, not behavior — detection lives in analyzer.py —
-so docs, reports and the baseline speak one vocabulary.
-
-Severity:
-  error    — proven hazard; jitting this body fails or silently lies.
-  warning  — likely hazard; depends on which inputs are traced.
-  info     — hygiene note; never gates CI.
+so docs, reports and the baseline speak one vocabulary. The Rule
+dataclass and severity vocabulary are shared with every other analyzer
+via tools/staticlib.
 
 `manifest` marks rules whose *definite* findings feed the generated
 static unjittable manifest (paddle_tpu/core/_unjittable_manifest.py):
@@ -17,56 +14,40 @@ traced may pre-demote an op to eager for the process lifetime.
 """
 from __future__ import annotations
 
-import dataclasses
+from ..staticlib.rules import Rule, ruleset
 
+RULES, BY_ID, get = ruleset([
+    Rule("TL001", "host-materialize", "error", True,
+         "host materialization inside a potentially-traced op body "
+         "(.numpy()/.item()/.tolist(), float()/int()/bool() on a "
+         "traced value, np.asarray on a traced value)"),
+    Rule("TL002", "closure-capture", "warning", False,
+         "op body captures a live array / Tensor / PRNG key from an "
+         "enclosing scope — the dispatch cache refuses such ops, so "
+         "every call pays eager dispatch (and a frozen capture would "
+         "bake stale state)"),
+    Rule("TL003", "state-mutation", "error", False,
+         "op body mutates nonlocal/global/module state — under "
+         "jax.jit the side effect runs once at trace time, then "
+         "never again"),
+    Rule("TL004", "impure-call", "error", True,
+         "wall-clock / host randomness inside a potentially-traced "
+         "op body (time.*, random.*, np.random.*, uuid/secrets) — "
+         "the value freezes into the compiled program"),
+    Rule("TL005", "data-dependent-control-flow", "warning", False,
+         "Python if/while/for branches on a traced value — trace "
+         "raises TracerBoolConversionError (one failed compile "
+         "probe) or, for shape-dependent code, silently "
+         "specializes"),
+    Rule("TL006", "stale-non-jittable", "info", False,
+         "@non_jittable decoration on an op the analysis finds no "
+         "hazard in — possibly stale, costing jit caching for "
+         "nothing"),
+    Rule("TL007", "suspend-audit", "warning", False,
+         "whole-program trace site (jax.jit / shard_map / lax "
+         "control flow over user callables) without a "
+         "dispatch.suspend() in reach — per-op dispatch inside the "
+         "trace burns cache keys on throwaway tracer avals"),
+])
 
-@dataclasses.dataclass(frozen=True)
-class Rule:
-    id: str          # short numeric handle, e.g. "TL001"
-    slug: str        # stable kebab-case name used in reports/baseline
-    severity: str    # "error" | "warning" | "info"
-    manifest: bool   # definite findings feed the unjittable manifest
-    summary: str
-
-
-RULES = {
-    r.slug: r for r in [
-        Rule("TL001", "host-materialize", "error", True,
-             "host materialization inside a potentially-traced op body "
-             "(.numpy()/.item()/.tolist(), float()/int()/bool() on a "
-             "traced value, np.asarray on a traced value)"),
-        Rule("TL002", "closure-capture", "warning", False,
-             "op body captures a live array / Tensor / PRNG key from an "
-             "enclosing scope — the dispatch cache refuses such ops, so "
-             "every call pays eager dispatch (and a frozen capture would "
-             "bake stale state)"),
-        Rule("TL003", "state-mutation", "error", False,
-             "op body mutates nonlocal/global/module state — under "
-             "jax.jit the side effect runs once at trace time, then "
-             "never again"),
-        Rule("TL004", "impure-call", "error", True,
-             "wall-clock / host randomness inside a potentially-traced "
-             "op body (time.*, random.*, np.random.*, uuid/secrets) — "
-             "the value freezes into the compiled program"),
-        Rule("TL005", "data-dependent-control-flow", "warning", False,
-             "Python if/while/for branches on a traced value — trace "
-             "raises TracerBoolConversionError (one failed compile "
-             "probe) or, for shape-dependent code, silently "
-             "specializes"),
-        Rule("TL006", "stale-non-jittable", "info", False,
-             "@non_jittable decoration on an op the analysis finds no "
-             "hazard in — possibly stale, costing jit caching for "
-             "nothing"),
-        Rule("TL007", "suspend-audit", "warning", False,
-             "whole-program trace site (jax.jit / shard_map / lax "
-             "control flow over user callables) without a "
-             "dispatch.suspend() in reach — per-op dispatch inside the "
-             "trace burns cache keys on throwaway tracer avals"),
-    ]
-}
-
-BY_ID = {r.id: r for r in RULES.values()}
-
-
-def get(slug_or_id: str) -> Rule:
-    return RULES.get(slug_or_id) or BY_ID[slug_or_id]
+__all__ = ["Rule", "RULES", "BY_ID", "get"]

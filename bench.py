@@ -12,31 +12,36 @@ Headline metric: BERT-base MLM tokens/sec/chip (AMP O2 bf16, whole-step
 jit with donated buffers); falls back to ResNet50 imgs/sec then LeNet
 imgs/sec if the headline config never produced a number.
 
-Process architecture — ONE patient client (the round-4 finding):
-the axon pool grants the chip to ONE client session at a time, and a
-client killed while waiting leaves an unclaimed grant that must time
-out upstream ("grant unclaimed past timeout — client lost") before the
-next waiter is served. Round 3's per-config-subprocess design — and
-round 4's first attempt — therefore POISONED THE QUEUE: every
-kill-and-retry enqueued another dead claimer, and no live client ever
-reached the front (the r03 7h wedge was self-inflicted client churn).
-So:
+Process architecture — a CAMPAIGN of per-config children behind one
+patient probe (ROADMAP item 4; supersedes the single-runner design
+once the round-4 grant-queue rules were folded in). The axon pool
+grants the chip to ONE client session at a time, and a client killed
+while WAITING leaves an unclaimed grant that must time out upstream
+before the next waiter is served — so kill-safety is decided by where
+a child is in its lifecycle, not by impatience:
   * the ORCHESTRATOR (plain `python bench.py`) never imports jax;
-  * it spawns ONE runner subprocess that probes the backend and runs
-    ALL configs in-process — one session, one grant, warm compile
-    cache shared across configs;
-  * the runner writes each config's result to disk AS IT FINISHES
-    (plus a heartbeat file), so partial progress survives anything;
-  * the orchestrator NEVER kills a waiting runner early — killing
-    cannot produce a grant sooner, it only poisons the queue for the
-    successor — it kills only at the global deadline
-    (BENCH_DEADLINE_S, default 3300s), then merges what was measured;
-  * a runner that CRASHES (clean nonzero exit — its session closed
-    with the process) is respawned with the remaining configs;
+  * it first spawns ONE patient PROBE child (backend liveness); the
+    probe is NEVER killed early — orphaned at the global deadline at
+    worst (killing a grant-waiter poisons the queue, the r03/r04
+    wedge);
+  * then every config runs in its OWN child process, CHEAPEST FIRST,
+    with a per-config deadline (cost estimate + 600s compile slack)
+    that starts counting only when the child writes its `.started`
+    marker — the moment its backend answered, i.e. the grant is held.
+    A started child that overruns is killed safely (its session dies
+    with it, freeing the chip); an unstarted child on a TPU backend is
+    never killed (it is a grant-waiter), while off-TPU a child that
+    cannot init its backend in 600s is wedged and killed. One hung or
+    crashing config can no longer zero out a round;
+  * children share the compile-cache dir, so later configs load the
+    executables earlier ones compiled; each child writes its result
+    file as it finishes, and a crashing child (nonzero exit) is
+    recorded and never retried;
   * the orchestrator exits NONZERO when no headline number was
     measured, so a failed bench is failure-shaped to the driver.
 
-Child modes: `bench.py --runner --out-dir D` (the one patient client),
+Child modes: `bench.py --campaign-config NAME --out-dir D` (one
+campaign unit: started-marker, error capture, compile/dispatch deltas),
 `bench.py --probe --out F` / `bench.py --config NAME --out F [--small]`
 (manual single-shot debugging; each is a fresh session — avoid while
 another client is waiting).
@@ -381,6 +386,91 @@ def bench_eager_dispatch(iters=100, batch=32, in_dim=64, hidden=128,
     res["eager_dispatch_ops_per_sec"] = (n_ops / dt_on) if n_ops else None
     res["eager_dispatch_bypassed_ops"] = (
         stats_off["forward"]["bypasses"])
+    return res
+
+
+def bench_eager_fusion(iters=100, batch=32, in_dim=64, hidden=128,
+                       out_dim=8, warmup=5):
+    """Trace-fusion microbench (CPU-runnable): the SAME small-MLP eager
+    train step as `eager_dispatch`, with trace fusion (core/fusion.py)
+    ON vs OFF. OFF is today's per-op jit-cached dispatch, so the A/B
+    isolates exactly what deferred execution buys: op-boundary dispatch
+    overhead removed and XLA fusing across the whole fwd+bwd run,
+    flushed as one program per step at the optimizer boundary."""
+    import jax
+
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as PF
+    from paddle_tpu.core import dispatch, fusion
+    from paddle_tpu.core.tensor import Tensor as _T
+
+    rng = np.random.RandomState(0)
+    res = {}
+    with jax.default_device(jax.devices("cpu")[0]):
+        x = _T(rng.randn(batch, in_dim).astype(np.float32))
+        y = _T(rng.randn(batch, out_dim).astype(np.float32))
+
+        def make_params():
+            # a FRESH stream per arm: both arms must start from
+            # identical params — the A/B also asserts numerical parity
+            prng = np.random.RandomState(1)
+            return [
+                _T(prng.randn(in_dim, hidden).astype(np.float32) * 0.1,
+                   stop_gradient=False),
+                _T(np.zeros(hidden, np.float32), stop_gradient=False),
+                _T(prng.randn(hidden, out_dim).astype(np.float32) * 0.1,
+                   stop_gradient=False),
+                _T(np.zeros(out_dim, np.float32), stop_gradient=False),
+            ]
+
+        def run_loop(n, params, opt):
+            for _ in range(n):
+                h = PF.relu(paddle.matmul(x, params[0]) + params[1])
+                p = paddle.matmul(h, params[2]) + params[3]
+                loss = ((p - y) * (p - y)).mean()
+                loss.backward()
+                opt.step()
+                opt.clear_grad()
+            _sync(loss._value)  # np round trip = fusion flush point
+            return loss
+
+        def one_rep(flag):
+            prev = fusion.set_fusion(flag)
+            try:
+                params = make_params()
+                opt = paddle.optimizer.SGD(learning_rate=0.01,
+                                           parameters=params)
+                run_loop(warmup, params, opt)
+                t0 = time.perf_counter()
+                loss = run_loop(iters, params, opt)
+                return time.perf_counter() - t0, float(loss._value)
+            finally:
+                fusion.set_fusion(prev)
+
+        # interleaved best-of-2 per arm: host-load drift on a shared
+        # CPU box otherwise biases whichever arm happens to run last
+        one_rep(False), one_rep(True)  # shared warm pass (compiles)
+        dispatch.reset_dispatch_stats()
+        dt_off, loss_off = one_rep(False)
+        dt_on, loss_on = one_rep(True)
+        stats_on = dispatch.dispatch_stats()
+        d2_off, _ = one_rep(False)
+        d2_on, _ = one_rep(True)
+        dt_off, dt_on = min(dt_off, d2_off), min(dt_on, d2_on)
+
+    fus = stats_on["fusion"]
+    n_flush = sum(fus["flushes"].values())
+    res["eager_fusion_steps_per_sec"] = iters / dt_on
+    res["eager_fusion_baseline_steps_per_sec"] = iters / dt_off
+    res["eager_fusion_speedup"] = dt_off / dt_on
+    res["eager_fusion_flushes"] = n_flush
+    res["eager_fusion_avg_trace_len"] = fus["avg_trace_len"]
+    res["eager_fusion_fused_hit_rate"] = fus["fused"]["hit_rate"]
+    res["eager_fusion_fallbacks"] = fus["fallbacks"]
+    # numerics must match the per-op path to allclose tolerance — a
+    # fused win with wrong math is not a win
+    res["eager_fusion_loss_matches"] = bool(
+        np.allclose(loss_on, loss_off, rtol=1e-5, atol=1e-6))
     return res
 
 
@@ -740,6 +830,11 @@ CONFIGS = {
     "eager_dispatch": (bench_eager_dispatch,
                        {"iters": 60, "batch": 16, "hidden": 64,
                         "warmup": 5}, 180),
+    # the trace-fusion A/B over the same train step (fusion on vs
+    # per-op jit): also CPU-pinned, also survives a dead tunnel
+    "eager_fusion": (bench_eager_fusion,
+                     {"iters": 60, "batch": 16, "hidden": 64,
+                      "warmup": 5}, 180),
     "lenet": (bench_lenet, {"batch": 8, "steps": 2, "warmup": 1}, 420),
     "bert": (bench_bert, {"batch": 2, "seq": 32, "steps": 2, "warmup": 1},
              900),
@@ -867,11 +962,6 @@ def _run_config(name, out_path, small):
     _write_out(out_path, res)
 
 
-def _heartbeat(out_dir, state):
-    _write_out(os.path.join(out_dir, "heartbeat.json"),
-               {"t": time.time(), **state})
-
-
 def _compile_snapshot():
     """Warm-start compile counters (runtime/warmup.py), or None when
     paddle_tpu is not importable in this child. Import cost is paid by
@@ -984,79 +1074,133 @@ def _compile_delta(res, name, before, after):
         res[name + "_time_to_first_step_s"] = round(min(tts.values()), 3)
 
 
-def _run_runner(out_dir, config_names, deadline_ts, small_all=False):
-    """The ONE patient client: probe, then every config, in THIS process.
+def _run_campaign_config(name, out_dir, small, deadline_ts):
+    """ONE config in ONE child process (the campaign runner's unit of
+    isolation): backend init, a `<name>.started` marker the moment the
+    backend answered (the orchestrator's per-config deadline countdown
+    anchors here — time spent WAITING for the chip grant is never
+    charged to the config, and a child without the marker is never
+    killed on a TPU backend, so the grant queue cannot be poisoned),
+    then the config with in-process error capture + small-size retry.
+    Exits 0 even on a recorded error — a nonzero exit means this child
+    CRASHED, and the orchestrator records it as such."""
+    out_path = os.path.join(out_dir, name + ".json")
+    _child_setup_jax()
+    import jax
 
-    Results land in <out_dir>/<name>.json as each config finishes; the
-    heartbeat file says what is currently running. Exceptions inside a
-    config are recorded and the runner moves on — only a wedged tunnel
-    call can stall it, and that stall is visible in the heartbeat."""
-    os.makedirs(out_dir, exist_ok=True)
-    _heartbeat(out_dir, {"phase": "probe"})
-    try:
-        _run_probe(os.path.join(out_dir, "probe.json"))  # patient: no timeout
-    except Exception as e:  # noqa: BLE001 — a dead backend must not kill
-        # the runner: the CPU-pinned configs (eager_dispatch) still
-        # produce numbers, and per-config errors are recorded per file
-        _write_out(os.path.join(out_dir, "probe.json"),
-                   {"probe_error": f"{type(e).__name__}: {e}"[:300]})
+    jax.devices()  # backend up = grant held (on a TPU backend)
+    if time.time() > deadline_ts:
+        # self-deadline BEFORE the marker: an orphaned grant-waiter
+        # served after its round ended must exit silently — writing the
+        # .started marker or any result file into the shared state dir
+        # would be ingested by the NEXT round (its orchestrator would
+        # misread the stale marker as its own child holding the grant
+        # and kill a pure grant-waiter — the r03/r04 poisoning)
+        print(f"campaign config {name}: round deadline passed before the "
+              "backend was granted; exiting without results",
+              file=sys.stderr)
+        return
+    with open(os.path.join(out_dir, name + ".started"), "w") as f:
+        f.write(str(time.time()))
+    fn, small_kw, _ = CONFIGS[name]
+    before = _compile_snapshot()
+    before_ds = _dispatch_snapshot()
+    if before is not None:
+        try:  # per-config time-to-first-step epoch
+            from paddle_tpu.runtime import warmup
 
-    for name in config_names:
-        fn, small_kw, full_cost_s = CONFIGS[name]
-        remaining = deadline_ts - time.time()
-        if remaining < 90.0:
-            _write_out(os.path.join(out_dir, name + ".json"),
-                       {name + "_skipped": "out of time budget"})
-            continue
-        small = small_all or remaining < full_cost_s + 120.0
-        _heartbeat(out_dir, {"phase": name, "small": small})
-        before = _compile_snapshot()
-        before_ds = _dispatch_snapshot()
-        if before is not None:
-            try:  # per-config time-to-first-step epoch
-                from paddle_tpu.runtime import warmup
-
-                warmup.reset_first_step()
-            except Exception:  # noqa: BLE001
-                pass
-        try:
-            res = fn(**small_kw) if small else fn()
-            if small:
-                res[name + "_small"] = True
-        except Exception as e:  # noqa: BLE001 — record, keep going
-            res = {name + "_error": f"{type(e).__name__}: {e}"[:300]}
-            if not small and deadline_ts - time.time() > 90.0:
-                # a deterministic full-size failure (OOM, shape bug) can
-                # still contribute a measured small-size number
-                try:
-                    retry = fn(**small_kw)
-                    retry[name + "_small"] = True
-                    res.update(retry)
-                except Exception as e2:  # noqa: BLE001
-                    res[name + "_small_error"] = (
-                        f"{type(e2).__name__}: {e2}"[:300])
-        try:
-            _compile_delta(res, name, before, _compile_snapshot())
-        except Exception:  # noqa: BLE001 — metrics must not fail a result
+            warmup.reset_first_step()
+        except Exception:  # noqa: BLE001
             pass
-        try:
-            # op-level hit rates per config: perf-trajectory rounds
-            # carry the WHY, not just the aggregate wall clock
-            _dispatch_delta(res, name, before_ds, _dispatch_snapshot())
-        except Exception:  # noqa: BLE001 — metrics must not fail a result
-            pass
-        _write_out(os.path.join(out_dir, name + ".json"), res)
     try:
-        # one whole-round registry snapshot (op-run/step-time histograms
-        # over every config this process ran; rounds are separate
-        # processes, so round records merge without double counting)
+        res = fn(**small_kw) if small else fn()
+        if small:
+            res[name + "_small"] = True
+    except Exception as e:  # noqa: BLE001 — record, keep going
+        res = {name + "_error": f"{type(e).__name__}: {e}"[:300]}
+        if not small and deadline_ts - time.time() > 90.0:
+            # a deterministic full-size failure (OOM, shape bug) can
+            # still contribute a measured small-size number
+            try:
+                retry = fn(**small_kw)
+                retry[name + "_small"] = True
+                res.update(retry)
+            except Exception as e2:  # noqa: BLE001
+                res[name + "_small_error"] = (
+                    f"{type(e2).__name__}: {e2}"[:300])
+    try:
+        _compile_delta(res, name, before, _compile_snapshot())
+    except Exception:  # noqa: BLE001 — metrics must not fail a result
+        pass
+    try:
+        # op-level hit rates per config: perf-trajectory rounds
+        # carry the WHY, not just the aggregate wall clock
+        _dispatch_delta(res, name, before_ds, _dispatch_snapshot())
+    except Exception:  # noqa: BLE001 — metrics must not fail a result
+        pass
+    try:
+        # per-child registry snapshot into a SUBDIR (never merged into
+        # the details dict — the orchestrator folds these into one
+        # round-level telemetry_registry with _merge_registries)
         reg = _registry_snapshot()
         if reg:
-            _write_out(os.path.join(out_dir, "telemetry_registry.json"),
-                       {"telemetry_registry": reg})
+            rdir = os.path.join(out_dir, "registry")
+            os.makedirs(rdir, exist_ok=True)
+            _write_out(os.path.join(rdir, name + ".json"), reg)
     except Exception:  # noqa: BLE001
         pass
-    _heartbeat(out_dir, {"phase": "done"})
+    _write_out(out_path, res)
+
+
+def _merge_registries(out_dir, max_series=20):
+    """Fold the per-child registry snapshots into one round-level view
+    (children are separate processes, so counter/histogram sums across
+    them are real totals; gauges keep the last child's value). Plain
+    dict math — the orchestrator never imports jax/paddle_tpu."""
+    rdir = os.path.join(out_dir, "registry")
+    try:
+        names = sorted(os.listdir(rdir))
+    except OSError:
+        return None
+    merged = {}
+    for fname in names:
+        if not fname.endswith(".json"):
+            continue
+        try:
+            with open(os.path.join(rdir, fname)) as f:
+                snap = json.load(f)
+        except (OSError, ValueError):
+            continue
+        for mname, fam in snap.items():
+            dst = merged.setdefault(
+                mname, {"type": fam.get("type"), "series": {}})
+            if "buckets" in fam and "buckets" not in dst:
+                dst["buckets"] = fam["buckets"]
+            for s in fam.get("series", []):
+                key = json.dumps(s.get("labels", {}), sort_keys=True)
+                prev = dst["series"].get(key)
+                if prev is None:
+                    dst["series"][key] = dict(s)
+                elif "bucket_counts" in s and "bucket_counts" in prev \
+                        and len(s["bucket_counts"]) == \
+                        len(prev["bucket_counts"]):
+                    prev["bucket_counts"] = [
+                        a + b for a, b in zip(prev["bucket_counts"],
+                                              s["bucket_counts"])]
+                    prev["sum"] = prev.get("sum", 0) + s.get("sum", 0)
+                    prev["count"] = prev.get("count", 0) + s.get("count", 0)
+                elif dst["type"] == "counter":
+                    prev["value"] = prev.get("value", 0) + s.get("value", 0)
+                else:  # gauge: last child wins
+                    prev["value"] = s.get("value", prev.get("value"))
+    out = {}
+    for mname, fam in merged.items():
+        compact = {"type": fam["type"],
+                   "series": list(fam["series"].values())[:max_series]}
+        if "buckets" in fam:
+            compact["buckets"] = fam["buckets"]
+        out[mname] = compact
+    return out or None
 
 
 # --------------------------------------------------------------------------
@@ -1075,7 +1219,7 @@ def _collect(out_dir, details, keymap=None):
     except OSError:
         return
     for fname in sorted(names):
-        if not fname.endswith(".json") or fname == "heartbeat.json":
+        if not fname.endswith(".json"):
             continue
         try:
             with open(os.path.join(out_dir, fname)) as f:
@@ -1278,11 +1422,18 @@ def main():
     # points somewhere shared
     if os.path.isdir(out_dir):
         for fname in os.listdir(out_dir):
-            known = (fname.endswith(".json")
+            known = (fname.endswith((".json", ".started", ".stderr"))
                      or fname.startswith("runner_"))
             if known:
                 try:
                     os.remove(os.path.join(out_dir, fname))
+                except OSError:
+                    pass
+        rdir = os.path.join(out_dir, "registry")
+        if os.path.isdir(rdir):
+            for fname in os.listdir(rdir):
+                try:
+                    os.remove(os.path.join(rdir, fname))
                 except OSError:
                     pass
 
@@ -1296,25 +1447,36 @@ def main():
     def remaining():
         return budget_s - (time.monotonic() - t_start)
 
-    def heartbeat_state():
-        """(phase, seconds since the heartbeat file changed) or (None, None)."""
-        path = os.path.join(out_dir, "heartbeat.json")
-        try:
-            with open(path) as f:
-                phase = json.load(f).get("phase")
-            return phase, time.time() - os.path.getmtime(path)
-        except (OSError, ValueError):
-            return None, None
-
-    def heartbeat_phase():
-        return heartbeat_state()[0]
-
     small_all = os.environ.get("BENCH_SMALL", "0").lower() in ("1", "true",
                                                                "yes")
-    todo = list(CONFIGS)
+    force_cpu = os.environ.get("BENCH_FORCE_CPU") == "1"
+    # cheapest-first (SNIPPETS campaign-runner order): with per-config
+    # child isolation no hang can starve the rest, so the cheap probes
+    # bank their numbers before the expensive headline configs run
+    todo = sorted(CONFIGS, key=lambda n: CONFIGS[n][2])
     details = {}
     keymap = {}  # result key -> producing config (merge-time attribution)
-    state = {"proc": None}
+    # state["proc"]/["name"] = the active config child (None between
+    # spawns); state["probe"] marks the patient probe child, which is
+    # NEVER killed early — a killed grant-waiter poisons the queue
+    state = {"proc": None, "name": None, "probe": False}
+
+    def _started_marker(name):
+        return os.path.join(out_dir, name + ".started")
+
+    def _child_started(name):
+        return os.path.exists(_started_marker(name))
+
+    def _killable(name):
+        # a config child holding the backend (marker written) dies
+        # safely — its session closes with the process and the grant
+        # frees. Unstarted children are killable only off-TPU (no
+        # grant queue to poison).
+        return name is not None and (_child_started(name) or force_cpu
+                                     or _backend_is_cpu())
+
+    def _backend_is_cpu():
+        return str(details.get("backend", "")).lower() in ("cpu",)
 
     def _partial_payload(tag):
         d = dict(details)
@@ -1347,15 +1509,14 @@ def main():
             pass
         proc = state.get("proc")
         if proc is not None and proc.poll() is None:
-            # phase-aware cleanup: a runner WAITING for the grant (phase
-            # "probe") must NOT be killed — a killed waiter leaves an
+            # kill-safety: the probe child and an unstarted TPU config
+            # child are grant-queue WAITERS — killing one leaves an
             # unclaimed grant poisoning the queue for successors (the
-            # r03/r04 wedge); orphaned, it exits at its own deadline_ts.
-            # A runner HOLDING the grant (mid-config) must die so the
-            # session closes and the chip frees — with SIGKILL
-            # escalation, or a wedged tunnel call leaks the grant.
+            # r03/r04 wedge); orphaned, they die on their own. A child
+            # that wrote its .started marker holds the grant and must
+            # die so the session closes and the chip frees.
             try:
-                if heartbeat_phase() != "probe":
+                if not state.get("probe") and _killable(state.get("name")):
                     proc.terminate()
                     try:
                         proc.wait(timeout=15.0)
@@ -1377,7 +1538,7 @@ def main():
         (probe.json included — the early 'probe succeeded' signal)."""
         try:
             files = {f for f in os.listdir(out_dir)
-                     if f.endswith(".json") and f != "heartbeat.json"}
+                     if f.endswith(".json")}
         except OSError:
             return
         if files - reported:
@@ -1389,90 +1550,140 @@ def main():
             _write_result_file(payload)
             _emit(payload)
 
-    spawns = 0
-    max_spawns = int(os.environ.get("BENCH_MAX_SPAWNS", 3))
-    while todo and remaining() > 90.0 and spawns < max_spawns:
-        spawns += 1
-        args = ["--runner", "--out-dir", out_dir,
-                "--configs", ",".join(todo),
-                "--deadline-ts", str(deadline_ts)]
-        if small_all:
-            args.append("--small")
-        err_path = os.path.join(out_dir, f"runner_{spawns}.stderr")
-        os.makedirs(out_dir, exist_ok=True)
+    os.makedirs(out_dir, exist_ok=True)
+
+    def _wait_child(proc, name, cost_s):
+        """Poll one config child to completion. Deadlines: the GLOBAL
+        budget always applies; the PER-CONFIG deadline (cost estimate
+        + 600s tunnel-compile slack) starts counting only once the
+        child wrote its .started marker — grant-queue wait is free.
+        Returns 'done' | 'killed' | 'orphaned'."""
+        started_at = None
+        spawned_at = time.monotonic()
+        while True:
+            try:
+                proc.wait(timeout=min(5.0, max(1.0, remaining())))
+                return "done"
+            except subprocess.TimeoutExpired:
+                pass
+            _snapshot_if_new()
+            if started_at is None and _child_started(name):
+                started_at = time.monotonic()
+            over_config = (started_at is not None
+                           and time.monotonic() - started_at
+                           > cost_s + 600.0)
+            # off-TPU there is no grant to claim: a child that never
+            # starts is wedged in import/init, not patiently waiting
+            over_start = ((force_cpu or _backend_is_cpu())
+                          and started_at is None
+                          and time.monotonic() - spawned_at > 600.0)
+            if remaining() <= 0.0 or over_config or over_start:
+                if not _killable(name):
+                    # TPU grant-waiter at the global deadline: orphan
+                    # it (it exits on its own); killing would poison
+                    # the grant queue for the next round
+                    return "orphaned"
+                proc.terminate()
+                try:
+                    proc.wait(timeout=30.0)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+                    proc.wait()
+                if over_config:
+                    details[name + "_error"] = (
+                        f"hung >{int(cost_s + 600)}s mid-config; killed")
+                elif over_start:
+                    details[name + "_error"] = (
+                        "backend init wedged; killed")
+                else:
+                    details["runner_killed_at_deadline"] = True
+                    details.setdefault(
+                        name + "_error",
+                        "in flight when the deadline killed it")
+                return "killed"
+
+    # the patient probe child: backend liveness, never killed early
+    # (see _on_sigterm). Its failure is recorded, not fatal — the
+    # CPU-pinned configs still produce numbers on a dead tunnel.
+    if remaining() > 90.0:
+        err_path = os.path.join(out_dir, "runner_probe.stderr")
         with open(err_path, "wb") as err_f:
             proc = subprocess.Popen(
-                [sys.executable, os.path.abspath(__file__)] + args,
+                [sys.executable, os.path.abspath(__file__), "--probe",
+                 "--out", os.path.join(out_dir, "probe.json")],
                 cwd=REPO, stdout=subprocess.DEVNULL, stderr=err_f)
-            state["proc"] = proc
-            # Wait for the runner, polling the heartbeat. Two different
-            # stall regimes:
-            #  * phase == "probe": the runner is WAITING for the chip
-            #    grant. Never kill it — a killed waiter poisons the
-            #    grant queue for successors (the r03/r04 wedge); the
-            #    upstream claim itself errors out after ~25 min and the
-            #    crash path respawns cleanly.
-            #  * phase == some config: the grant is held and a config
-            #    wedged mid-execution. Killing is safe-ish here (the
-            #    session dies with the process, releasing the chip) and
-            #    necessary — one hung config must not starve the rest
-            #    (round-3 lesson). Stale = no heartbeat movement for the
-            #    config's cost estimate + 600s of tunnel-compile slack.
+            state.update(proc=proc, name=None, probe=True)
             while True:
                 try:
                     proc.wait(timeout=min(10.0, max(1.0, remaining())))
                     break
                 except subprocess.TimeoutExpired:
-                    pass
-                _snapshot_if_new()
-                hb_phase, hb_age = heartbeat_state()
-                stuck = (hb_phase in CONFIGS and hb_age is not None
-                         and hb_age > CONFIGS[hb_phase][2] + 600.0)
-                if remaining() <= 0.0 or stuck:
-                    # SIGTERM + grace; SIGKILL only if grace expires
-                    proc.terminate()
-                    try:
-                        proc.wait(timeout=30.0)
-                    except subprocess.TimeoutExpired:
-                        proc.kill()
-                        proc.wait()
-                    if stuck and remaining() > 0.0:
-                        details[hb_phase + "_error"] = (
-                            f"hung >{int(hb_age)}s mid-config; "
-                            "runner recycled")
-                    else:
-                        details["runner_killed_at_deadline"] = True
-                        inflight = heartbeat_phase()
-                        if inflight in todo:
-                            details[inflight + "_error"] = (
-                                "in flight when the deadline killed the "
-                                "runner")
-                    break
-            if details.get("runner_killed_at_deadline"):
-                break
+                    if remaining() <= 0.0:
+                        break  # orphaned: exits on its own
+        state.update(proc=None, probe=False)
+        if proc.poll() is not None and proc.returncode != 0:
+            try:
+                with open(err_path, "rb") as f:
+                    tail = f.read()[-300:].decode("utf-8", "replace")
+            except OSError:
+                tail = ""
+            _write_out(os.path.join(out_dir, "probe.json"),
+                       {"probe_error":
+                        f"probe child rc={proc.returncode}: {tail}"[:300]})
         _collect(out_dir, details, keymap)
-        todo = [n for n in todo
-                if not os.path.exists(os.path.join(out_dir, n + ".json"))]
-        if proc.returncode == 0:
+        _snapshot_if_new()
+
+    # the campaign: every config in its OWN child process, cheapest
+    # first — one hung or crashing config can no longer zero out the
+    # round (ROADMAP item 4). Children share the compile-cache dir
+    # (exported by _child_setup_jax), so later children load what
+    # earlier ones compiled.
+    for name in todo:
+        if details.get("runner_killed_at_deadline"):
             break
-        details["runner_crash_rc"] = proc.returncode
-        try:
-            with open(err_path, "rb") as f:
-                tail = f.read()[-400:].decode("utf-8", "replace")
-            if tail.strip():
-                details["runner_error"] = tail
-        except OSError:
-            pass
-        # a config that hard-crashes (or hangs, above) must not be
-        # retried at the head of every respawn, starving the rest
-        crashed = heartbeat_phase()
-        if crashed in todo:
+        fn, small_kw, full_cost_s = CONFIGS[name]
+        if remaining() < 90.0:
+            _write_out(os.path.join(out_dir, name + ".json"),
+                       {name + "_skipped": "out of time budget"})
+            continue
+        small = small_all or remaining() < full_cost_s + 120.0
+        args = ["--campaign-config", name,
+                "--out-dir", out_dir,
+                "--deadline-ts", str(deadline_ts)]
+        if small:
+            args.append("--small")
+        err_path = os.path.join(out_dir, f"runner_{name}.stderr")
+        with open(err_path, "wb") as err_f:
+            proc = subprocess.Popen(
+                [sys.executable, os.path.abspath(__file__)] + args,
+                cwd=REPO, stdout=subprocess.DEVNULL, stderr=err_f)
+            state.update(proc=proc, name=name, probe=False)
+            outcome = _wait_child(proc, name, full_cost_s)
+        state.update(proc=None, name=None)
+        if outcome == "done" and proc.returncode != 0:
+            # a hard CRASH (our in-child error capture exits 0):
+            # record rc + stderr tail; no retry — a deterministic
+            # crasher must not starve the rest
+            details["runner_crash_rc"] = proc.returncode
             details.setdefault(
-                crashed + "_error",
-                f"runner crashed during this config (rc={proc.returncode})")
-            todo.remove(crashed)
-        time.sleep(10.0)
+                name + "_error",
+                f"child crashed during this config (rc={proc.returncode})")
+            try:
+                with open(err_path, "rb") as f:
+                    tail = f.read()[-400:].decode("utf-8", "replace")
+                if tail.strip():
+                    details["runner_error"] = tail
+            except OSError:
+                pass
+        _collect(out_dir, details, keymap)
+        _snapshot_if_new()
     _collect(out_dir, details, keymap)
+    try:
+        reg = _merge_registries(out_dir)
+        if reg:
+            details["telemetry_registry"] = reg
+    except Exception:  # noqa: BLE001 — observability must not fail a round
+        pass
     for name in todo:
         # result keys are not all name-prefixed (flash_attention -> attn_*)
         # so presence is judged by the per-config result file + markers
@@ -1502,17 +1713,16 @@ if __name__ == "__main__":
     ap.add_argument("--config", choices=list(CONFIGS))
     ap.add_argument("--out")
     ap.add_argument("--small", action="store_true")
-    ap.add_argument("--runner", action="store_true")
+    ap.add_argument("--campaign-config", choices=list(CONFIGS),
+                    help="internal: one config as a campaign child")
     ap.add_argument("--out-dir")
-    ap.add_argument("--configs")
     ap.add_argument("--deadline-ts", type=float)
     cli = ap.parse_args()
-    if cli.runner:
-        names = [n for n in (cli.configs or ",".join(CONFIGS)).split(",")
-                 if n in CONFIGS]
-        _run_runner(cli.out_dir or os.path.join(REPO, ".bench_state"),
-                    names, cli.deadline_ts or (time.time() + 3300),
-                    small_all=cli.small)
+    if cli.campaign_config:
+        _run_campaign_config(
+            cli.campaign_config,
+            cli.out_dir or os.path.join(REPO, ".bench_state"),
+            cli.small, cli.deadline_ts or (time.time() + 3300))
     elif cli.probe:
         _run_probe(cli.out)
     elif cli.config:

@@ -22,6 +22,7 @@ the tape — jax.vjp composes, giving arbitrary-order gradients.
 """
 from __future__ import annotations
 
+import collections
 import contextlib
 import threading
 import types
@@ -31,6 +32,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from . import dispatch as _dispatch
+from . import fusion as _fusion
 from .tensor import Tensor
 
 __all__ = [
@@ -143,6 +145,107 @@ def _subst_call(fn, treedef, diff_pos, base_vals):
     return g
 
 
+def _pullback_key(fn, treedef, diff_pos, statics, out_treedef,
+                  primal_avals, cot_avals):
+    """The BACKWARD cache key for one pullback signature — factored so
+    the live path and the warm-start fused-trace replay
+    (`_replay_pullback_node`) build byte-identical keys."""
+    return (_dispatch.op_core(fn), treedef, diff_pos, statics,
+            out_treedef, primal_avals, cot_avals)
+
+
+def _pullback_flat_call(fn, treedef, statics_map, arr_pos, diff_pos,
+                        out_treedef, n_vals, n_arr):
+    """Flat pure form of one pullback for the fusion trace: inputs are
+    the primal arrays (at `arr_pos`) followed by the cotangent leaves;
+    outputs are the flat cotangents per differentiated input. Shared by
+    live recording and manifest replay."""
+
+    def call(*ins):
+        arr_vals, cots = ins[:n_arr], ins[n_arr:]
+        v = [None] * n_vals
+        for i, s in statics_map.items():
+            v[i] = s
+        for p, av in zip(arr_pos, arr_vals):
+            v[p] = av
+        g = _subst_call(fn, treedef, diff_pos, v)
+        _, pull = jax.vjp(g, *[v[i] for i in diff_pos])
+        out = pull(jax.tree_util.tree_unflatten(out_treedef, list(cots)))
+        return tuple(jax.tree_util.tree_flatten(out)[0])
+
+    return call
+
+
+def _pullback_spec(fn, treedef, statics_items, arr_pos, diff_pos,
+                   out_treedef, n_vals):
+    """Zero-arg manifest encoder for a fused backward node (or None —
+    the trace entry then records non-replayable)."""
+
+    def spec():
+        from ..runtime import warmup as _w
+
+        try:
+            impl = _w._encode_impl(fn)
+            if impl is None:
+                return None
+            return {"b": {
+                "impl": impl,
+                "tree": _w._encode_treedef(treedef, n_vals),
+                "statics": [[i, _w._encode_static(v)]
+                            for i, v in statics_items],
+                "arr_pos": list(arr_pos),
+                "diff_pos": list(diff_pos),
+                "out_tree": _w._encode_treedef(out_treedef,
+                                               out_treedef.num_leaves),
+                "n": n_vals,
+                "name": getattr(fn, "__name__", "op"),
+            }}
+        except TypeError:
+            return None
+
+    return spec
+
+
+def _replay_pullback_node(enc, in_avals):
+    """Rebuild (key, call, out_avals, name) for an encoded backward
+    node — the fusion warm-start replay's half of the bargain (the
+    forward half lives in fusion._replay_fwd_node). Raises on source
+    drift; the caller counts the entry stale."""
+    from ..runtime import warmup as _w
+
+    b = enc["b"]
+    fn = _w._rebuild_fn({"impl": b["impl"]})
+    if fn is None:
+        raise TypeError("unresolvable op")
+    treedef, n = _w._decode_treedef(b["tree"])
+    if n != b["n"]:
+        raise TypeError("leaf count mismatch")
+    out_treedef, _n_cot = _w._decode_treedef(b["out_tree"])
+    arr_pos = tuple(b["arr_pos"])
+    diff_pos = tuple(b["diff_pos"])
+    statics_items = [(i, _w._decode_static(e)) for i, e in b["statics"]]
+    n_arr = len(arr_pos)
+    primal_avals = tuple(in_avals[:n_arr])
+    cot_avals = tuple(in_avals[n_arr:])
+    statics = tuple((i, _dispatch.freeze_static(v))
+                    for i, v in statics_items)
+    key = _pullback_key(fn, treedef, diff_pos, statics, out_treedef,
+                        primal_avals, cot_avals)
+    call = _pullback_flat_call(fn, treedef, dict(statics_items), arr_pos,
+                               diff_pos, out_treedef, n, n_arr)
+    pos_of = {p: j for j, p in enumerate(arr_pos)}
+    out_avals = tuple(primal_avals[pos_of[i]] for i in diff_pos)
+    return key, call, out_avals, b.get("name", "op")
+
+
+# per-signature memo for the fusion record path (call closure, output
+# avals, manifest spec): recomputing them on every backward step costs
+# more than the record itself. Keyed by the pullback key; bounded.
+_BWD_RECORD_CAP = 1024
+_bwd_record_cache = collections.OrderedDict()
+_bwd_record_lock = threading.Lock()
+
+
 def _make_pullback(fn, vals, treedef, diff_pos, out_treedef):
     """Deferred, cache-jitted vjp for one tape node.
 
@@ -155,15 +258,23 @@ def _make_pullback(fn, vals, treedef, diff_pos, out_treedef):
     positions are differentiated, the output treedef, and cotangent
     avals. Anything unkeyable — a closure over a live array or mutable
     object, or float0 cotangents — falls back to an eager jax.vjp with
-    identical semantics."""
+    identical semantics.
+
+    Under trace fusion the pullback is RECORDED instead of executed:
+    the same key becomes the fused node's identity, the primal inputs
+    are wired from the forward's placeholders still in the trace, and
+    forward+backward flush as one program — forward activations
+    consumed only by the backward never materialize."""
     arr_pos = tuple(i for i, v in enumerate(vals)
-                    if isinstance(v, (jax.Array, np.ndarray)))
+                    if type(v) is _fusion.LazyArray
+                    or isinstance(v, (jax.Array, np.ndarray)))
     n_vals = len(vals)
 
     def _eager(cot_tree):
-        g = _subst_call(fn, treedef, diff_pos, vals)
-        _, pull = jax.vjp(g, *[vals[i] for i in diff_pos])
-        return pull(cot_tree)
+        vc = [_fusion.concrete(v) for v in vals]
+        g = _subst_call(fn, treedef, diff_pos, vc)
+        _, pull = jax.vjp(g, *[vc[i] for i in diff_pos])
+        return pull(jax.tree_util.tree_map(_fusion.concrete, cot_tree))
 
     def pullback(cot_tree):
         cot_leaves = jax.tree_util.tree_flatten(cot_tree)[0]
@@ -173,13 +284,47 @@ def _make_pullback(fn, vals, treedef, diff_pos, out_treedef):
         try:
             statics = tuple((i, _dispatch.freeze_static(v))
                             for i, v in enumerate(vals) if i not in arr_pos)
-            key = (_dispatch.op_core(fn), treedef, diff_pos, statics,
-                   out_treedef,
-                   tuple(_dispatch.aval_of(vals[i]) for i in arr_pos),
-                   tuple(_dispatch.aval_of(c) for c in cot_leaves))
+            key = _pullback_key(
+                fn, treedef, diff_pos, statics, out_treedef,
+                tuple(_dispatch.aval_of(vals[i]) for i in arr_pos),
+                tuple(_dispatch.aval_of(c) for c in cot_leaves))
             hash(key)
         except (TypeError, ValueError, AttributeError):
             return _eager(cot_tree)
+
+        if _fusion._ON[0]:
+            # the flat call / out avals / manifest spec depend only on
+            # the key — build them once per signature, not per step
+            with _bwd_record_lock:
+                cached = _bwd_record_cache.get(key)
+                if cached is not None:
+                    # refresh recency: without this the memo is FIFO
+                    # and churn evicts exactly the hot steady-loop
+                    # signatures first
+                    _bwd_record_cache.move_to_end(key)
+            if cached is None:
+                statics_map = {i: vals[i] for i, _ in statics}
+                call = _pullback_flat_call(fn, treedef, statics_map,
+                                           arr_pos, diff_pos, out_treedef,
+                                           n_vals, len(arr_pos))
+                pos_of = {p: j for j, p in enumerate(arr_pos)}
+                primal_avals = key[5]
+                out_avals = [primal_avals[pos_of[i]] for i in diff_pos]
+                spec = _pullback_spec(fn, treedef,
+                                      list(statics_map.items()), arr_pos,
+                                      diff_pos, out_treedef, n_vals)
+                cached = (call, out_avals, spec,
+                          "bwd_" + getattr(fn, "__name__", "op"))
+                with _bwd_record_lock:
+                    _bwd_record_cache[key] = cached  # insert = newest
+                    if len(_bwd_record_cache) > _BWD_RECORD_CAP:
+                        _bwd_record_cache.popitem(last=False)
+            call, out_avals, spec, nm = cached
+            lazy = _fusion.record_call(
+                key, call, [vals[i] for i in arr_pos] + list(cot_leaves),
+                out_avals, nm, spec=spec)
+            if lazy is not None:
+                return lazy
 
         def _build():
             statics_map = {i: vals[i] for i, _ in statics}
@@ -199,7 +344,8 @@ def _make_pullback(fn, vals, treedef, diff_pos, out_treedef):
 
         bwd = _dispatch.BACKWARD.get_or_build(
             key, _build, tag=getattr(fn, "__name__", "op"))
-        return bwd([vals[i] for i in arr_pos], list(cot_leaves))
+        return bwd([_fusion.concrete(vals[i]) for i in arr_pos],
+                   [_fusion.concrete(c) for c in cot_leaves])
 
     return pullback
 
@@ -311,7 +457,10 @@ def _add_cot(prev, new, create_graph):
         return new
     if create_graph:
         return apply(jnp.add, prev, new)
-    return prev + new
+    # lazy_add keeps the accumulation in the fusion trace when either
+    # side is pending (a concrete + lazy `+` would flush mid-backward);
+    # with fusion off and both concrete it is exactly `prev + new`
+    return _fusion.lazy_add(prev, new)
 
 
 def run_backward(tensors, grad_tensors=None, retain_graph=False,
@@ -511,7 +660,7 @@ def _accum_leaf(t, g):
     if t._grad is None:
         t._grad = Tensor(g)
     else:
-        t._grad = Tensor(_raw(t._grad) + g)
+        t._grad = Tensor(_fusion.lazy_add(_raw(t._grad), g))
 
 
 def grad(outputs, inputs, grad_outputs=None, retain_graph=None,

@@ -125,11 +125,20 @@ class _Suspend:
     overhead — the outer program compiles the ops anyway."""
 
     def __enter__(self):
+        # a pending fusion trace must land before the suspended region
+        # runs: code inside (a whole-step jit trace, flops counting)
+        # expects prior eager ops to have executed. Fusion's own
+        # suspend counter is bumped too — run_op checks _local.suspended
+        # but the backward record path (record_call) checks only
+        # fusion's, and a backward inside this region must not defer
+        _fusion._flush_pending("suspend")
+        _fusion._tl.suspended += 1
         _local.suspended += 1
         return self
 
     def __exit__(self, *exc):
         _local.suspended -= 1
+        _fusion._tl.suspended -= 1
         return False
 
 
@@ -201,7 +210,10 @@ class _Key:
         return self.h
 
     def __eq__(self, other):
-        return self.t == other.t
+        # keys nest (fusion fingerprints hold _Keys inside tuples), so
+        # a hash collision can compare a _Key against a plain tuple at
+        # some depth — that must be inequality, not an AttributeError
+        return type(other) is _Key and self.t == other.t
 
 
 # ---- op opt-out -----------------------------------------------------------
@@ -686,6 +698,9 @@ def dispatch_stats():
             "runtime_learned_ops": learned_names,
             "manifest_entries": len(_manifest),
         },
+        # trace-fusion mode (core/fusion.py): recorded ops, flushes by
+        # reason, fused-program cache, trace lengths, demotions
+        "fusion": _fusion.fusion_stats(),
         # warm-start observability: compile seconds (per-op + whole
         # program), disk-cache hits vs fresh XLA compiles, AOT
         # precompile counts, time-to-first-step per engine
@@ -709,6 +724,7 @@ def reset_dispatch_stats(clear_caches=False):
     _stats_generation[0] += 1
     FORWARD.reset_counters()
     BACKWARD.reset_counters()
+    _fusion.reset_fusion_stats(clear_caches=clear_caches)
     for k in _counters:
         _counters[k] = 0
     with _op_stats_lock:
@@ -749,6 +765,14 @@ def run_op(fn, vals, treedef, fallback, name=None):
     if not _enabled or _local.suspended or fn is None:
         _counters["bypasses"] += 1
         return fallback()
+    if _fusion_on[0]:
+        # trace-fusion mode (core/fusion.py): defer the op into the
+        # lazy trace instead of executing its per-op program; a False
+        # return means the op is a forced flush point or otherwise
+        # unrecordable and takes the per-op path below
+        handled, out = _fusion.record(fn, vals, treedef, name)
+        if handled:
+            return out
     try:
         ident = _fn_ident(fn)
     except TypeError:
@@ -956,3 +980,12 @@ def precompile_op(fn, treedef, leaves, name=None):
         if len(_seen) > _SEEN_CAP:
             _seen.popitem(last=False)
     return True
+
+
+# trace-fusion mode lives in its own module but is part of this layer:
+# imported LAST so fusion can bind everything above (key machinery,
+# JitCache, the unjittable registry) without a cycle. run_op reads the
+# shared _ON flag as one list-index check when fusion is off.
+from . import fusion as _fusion  # noqa: E402
+
+_fusion_on = _fusion._ON

@@ -14,6 +14,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from . import dtype as dtypes
+from .fusion import LazyArray as _LazyArray
 
 __all__ = ["Tensor", "to_tensor"]
 
@@ -35,7 +36,9 @@ class Tensor:
         global _tensor_count
         if isinstance(value, Tensor):
             value = value._value
-        if not isinstance(value, jax.Array):
+        if not isinstance(value, jax.Array) and type(value) is not _LazyArray:
+            # a LazyArray passes through undisturbed: wrapping must not
+            # force the pending fusion trace (core/fusion.py)
             value = jnp.asarray(value)
         self._value = value
         self.stop_gradient = stop_gradient

@@ -90,7 +90,10 @@ class _JitStepEngine:
 
         # suspend the per-op dispatch cache: this body is traced into one
         # fused program, so nested per-op jit entries would only add
-        # trace-time overhead and throwaway cache keys
+        # trace-time overhead and throwaway cache keys. dispatch.suspend
+        # also flushes + suspends eager trace fusion (core/fusion.py) —
+        # deferring ops inside an outer whole-step trace would record
+        # tracers, and the outer program fuses everything anyway
         from ..core import dispatch as _dispatch
 
         with training_mode(training, net.sublayers(include_self=True)), \

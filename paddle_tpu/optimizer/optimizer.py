@@ -14,6 +14,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..core.fusion import concrete as _concrete
 from ..core.tensor import Tensor
 from ..nn.clip import ClipGradBase
 from .lr import LRScheduler
@@ -252,6 +253,15 @@ class Optimizer:
         grads = [p._grad._value.astype(
             jnp.float32 if "master" in s else p._value.dtype)
             for p, s in zip(params, states)]
+        # the fused multi-tensor step is the train step's natural
+        # trace-fusion flush boundary: the casts above were RECORDED
+        # (not executed) when fusion is on, so the first _concrete
+        # lands the whole deferred fwd+bwd+casts as ONE fused program
+        # and the rest are lookups. Handing still-lazy leaves to the
+        # jitted entry instead would defeat pjit's C++ arg cache and
+        # retrace the optimizer step every call.
+        values = [_concrete(v) for v in values]
+        grads = [_concrete(g) for g in grads]
         lr = jnp.asarray(self.get_lr(), jnp.float32)
         # first step of a freshly built OR warm-started entry (built is
         # False after warm_start pre-built it): trace + compile/disk

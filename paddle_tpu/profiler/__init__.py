@@ -223,6 +223,28 @@ class Profiler:
             if fat:
                 print("  cache occupancy (op: fwd+bwd programs): "
                       + ", ".join(f"{k}: {f}+{b}" for k, f, b in fat))
+        fus = ds.get("fusion") or {}
+        if fus and (fus.get("recorded_ops") or fus.get("enabled")):
+            # trace-fusion health: how many eager ops were deferred,
+            # how often (and why) traces flushed, and whether steady
+            # state replays cached fused programs
+            n_flush = sum((fus.get("flushes") or {}).values())
+            line = (f"trace fusion: {fus.get('recorded_ops', 0)} ops "
+                    f"recorded, {n_flush} flushes")
+            if fus.get("avg_trace_len"):
+                line += f" (avg {fus['avg_trace_len']:.1f} ops/trace)"
+            fc = fus.get("fused") or {}
+            if fc.get("hit_rate") is not None:
+                line += f", fused cache {fc['hit_rate']:.1%} hit rate"
+            print(line)
+            if fus.get("flushes"):
+                print("  flush reasons: "
+                      + ", ".join(f"{k}: {v}" for k, v in
+                                  sorted(fus["flushes"].items())))
+            if fus.get("fallbacks") or fus.get("demotions"):
+                print(f"  degraded: {fus.get('fallbacks', 0)} fused "
+                      f"fallbacks, {fus.get('demotions', 0)} ops learned "
+                      "fusion-unsafe")
         comp = ds.get("compile") or {}
         if comp:
             # warm-start health: how much wall time XLA compilation cost

@@ -1201,12 +1201,28 @@ def sync_runtime_metrics():
                      "jit-cache LRU evictions", ("cache",))
     g_size = gauge("paddle_tpu_dispatch_cache_size",
                    "live compiled programs", ("cache",))
-    for which in ("forward", "backward"):
-        s = ds[which]
+    fus = ds.get("fusion") or {}
+    for which in ("forward", "backward", "fused"):
+        # "fused" = the trace-fusion program cache (core/fusion.py),
+        # exported as a third label value of the same cache families
+        s = fus.get("fused") if which == "fused" else ds[which]
+        if not s:
+            continue
         c_hits.labels(cache=which).set(s["hits"])
         c_miss.labels(cache=which).set(s["misses"])
         c_evic.labels(cache=which).set(s["evictions"])
         g_size.labels(cache=which).set(s["size"])
+    if fus:
+        c_fl = counter("paddle_tpu_fusion_flushes_total",
+                       "fusion trace flushes", ("reason",))
+        for reason, n in (fus.get("flushes") or {}).items():
+            c_fl.labels(reason=reason).set(n)
+        counter("paddle_tpu_fusion_recorded_ops_total",
+                "eager ops deferred into fusion traces").set(
+            fus.get("recorded_ops", 0))
+        counter("paddle_tpu_fusion_flushed_ops_total",
+                "deferred ops that reached a flush").set(
+            fus.get("flushed_ops", 0))
     fwd = ds["forward"]
     for key, mname in (
             ("bypasses", "paddle_tpu_dispatch_bypasses_total"),
@@ -1293,6 +1309,9 @@ METRIC_NAMES = (
     "paddle_tpu_dispatch_fallbacks_total",
     "paddle_tpu_dispatch_warming_total",
     "paddle_tpu_dispatch_manifest_preloads_total",
+    "paddle_tpu_fusion_flushes_total",
+    "paddle_tpu_fusion_recorded_ops_total",
+    "paddle_tpu_fusion_flushed_ops_total",
     "paddle_tpu_op_hits_total",
     "paddle_tpu_op_misses_total",
     "paddle_tpu_op_retraces_total",

@@ -68,6 +68,7 @@ __all__ = [
     "reset_compile_metrics", "note_first_step", "on_first_step_reset",
     "time_to_first_step",
     "reset_first_step", "note_op_compile", "record_op", "record_program",
+    "record_trace",
     "manifest", "manifest_record_count", "save_manifest", "load_manifest",
     "rendezvous_manifest",
     "precompile", "prewarm_program", "pending_programs",
@@ -91,6 +92,7 @@ _metrics = {
     "compile_time_saved_s": 0.0,  # jax's estimate of seconds disk hits saved
     "precompiled_ops": 0,       # manifest op entries installed into FORWARD
     "precompiled_programs": 0,  # whole-step signatures AOT-compiled
+    "precompiled_traces": 0,    # fused-trace entries installed (fusion)
 }
 _first_step = {}  # engine kind -> seconds from _T0 to first compiled step
 
@@ -646,6 +648,22 @@ def record_op(fn, name, treedef, vals, arr_pos, avals):
         pass
 
 
+def record_trace(entry):
+    """Record one fused-trace entry (built by core/fusion.py at a fresh
+    fused build: per-node op encodings + dataflow wiring + external
+    avals + live-output mask). Stored alongside per-op entries so
+    `save_manifest` persists it and `precompile` replays it through
+    `fusion.precompile_trace`. Never raises."""
+    try:
+        if len(_records) >= _RECORD_CAP:
+            return
+        fp = json.dumps(entry, sort_keys=True, default=str)
+        with _lock:
+            _records.setdefault(fp, entry)
+    except Exception:  # noqa: BLE001 — recording must never break a flush
+        pass
+
+
 def record_program(name, args):
     """Record a whole-step jit program's input signature (pytree of
     arrays/statics) under `name` ('hapi.train_step',
@@ -857,7 +875,7 @@ def precompile(manifest_doc):
     if isinstance(manifest_doc, str):
         manifest_doc = load_manifest(manifest_doc)
     stats = {"ops_precompiled": 0, "ops_skipped": 0, "programs_pending": 0,
-             "stale": manifest_doc is None}
+             "traces_precompiled": 0, "stale": manifest_doc is None}
     if manifest_doc is None:
         return stats
     from ..core import dispatch as _dispatch
@@ -865,6 +883,27 @@ def precompile(manifest_doc):
     for entry in manifest_doc.get("entries", ()):
         if not entry.get("replayable"):
             stats["ops_skipped"] += 1
+            continue
+        if entry.get("kind") == "trace":
+            # fused eager trace (core/fusion.py): fully AOT-replayable
+            # without any live model — rebuild the node chain, compile
+            # the fused program (a disk load with the persistent
+            # cache), install it under the reconstructed fingerprint
+            try:
+                from ..core import fusion as _fusion
+
+                if _fusion.precompile_trace(entry):
+                    stats["traces_precompiled"] += 1
+                    _remember(entry)
+                    with _lock:
+                        _metrics["precompiled_traces"] += 1
+                else:
+                    stats["ops_skipped"] += 1
+            except Exception:  # noqa: BLE001 — drift must not abort
+                record_fault("stale_manifests",
+                             f"trace entry {entry.get('name')}: "
+                             "replay failed")
+                stats["ops_skipped"] += 1
             continue
         if entry.get("kind") == "program":
             try:

@@ -42,17 +42,26 @@ def corrupt_shard(ckpt_dir, step):
     """Corrupt the largest data file inside one checkpoint step dir —
     the deterministic 'one shard rotted' fixture. Returns the path
     corrupted. Skips our own integrity manifest so the corruption hits
-    checkpoint DATA (the manifest then convicts it on restore)."""
+    checkpoint DATA (the manifest then convicts it on restore).
+
+    EVERY file tied for the largest size is corrupted: orbax's ocdbt
+    layout stores the same shard bytes under both `d/` and
+    `ocdbt.process_0/d/`, and glob's scandir order is
+    filesystem-dependent — corrupting only whichever copy enumerates
+    first can hit the redundant one, which orbax restores around,
+    silently turning the fixture into a no-op (observed as a
+    host-dependent test flake)."""
     step_dir = os.path.join(ckpt_dir, str(int(step)))
     if not os.path.isdir(step_dir):
         raise FileNotFoundError(f"no step dir {step_dir}")
-    best, best_size = None, -1
-    for p in glob.glob(os.path.join(step_dir, "**"), recursive=True):
-        if not os.path.isfile(p) or p.endswith("integrity.json"):
-            continue
-        size = os.path.getsize(p)
-        if size > best_size:
-            best, best_size = p, size
-    if best is None:
+    files = sorted(
+        p for p in glob.glob(os.path.join(step_dir, "**"), recursive=True)
+        if os.path.isfile(p) and not p.endswith("integrity.json"))
+    if not files:
         raise FileNotFoundError(f"no data file under {step_dir}")
-    return corrupt_file(best)
+    best_size = max(os.path.getsize(p) for p in files)
+    best = None
+    for p in files:
+        if os.path.getsize(p) == best_size:
+            best = corrupt_file(p)
+    return best

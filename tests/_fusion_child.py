@@ -1,0 +1,81 @@
+"""Child process for the trace-fusion warm-start round trip
+(test_fusion.py / tools/fusion_smoke.py).
+
+Modes (argv[1]):
+  record — run the shared fused workload cold, save the shape manifest
+           (which now carries fused-trace entries), print one JSON line
+           of compile + fusion metrics.
+  replay — precompile the manifest (installing the fused traces AOT),
+           run the same workload, print metrics. With a warm shared
+           compile-cache dir the parent asserts ZERO fresh XLA compiles
+           and fused-cache misses == 0 — the first flush of every trace
+           shape is a plain cache hit.
+
+Env (set by the parent): JAX_PLATFORMS=cpu,
+PADDLE_TPU_COMPILE_CACHE_DIR, PADDLE_TPU_COMPILE_CACHE_MIN_COMPILE_S=0,
+FUSION_MANIFEST.
+"""
+import json
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu as paddle  # noqa: E402
+import paddle_tpu.nn.functional as F  # noqa: E402
+from paddle_tpu.core import dispatch, fusion  # noqa: E402
+from paddle_tpu.runtime import warmup  # noqa: E402
+
+mode = sys.argv[1]
+manifest_path = os.environ["FUSION_MANIFEST"]
+
+
+def workload():
+    """A deterministic fused train loop: fwd + backward + cotangent
+    accumulation + SGD step, identical in both processes."""
+    dispatch.set_warmup_count(1)
+    fusion.set_fusion(True)
+    paddle.seed(0)
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.randn(8, 16).astype(np.float32))
+    y = paddle.to_tensor(rng.randn(8, 4).astype(np.float32))
+    w = paddle.to_tensor(rng.randn(16, 4).astype(np.float32),
+                         stop_gradient=False)
+    b = paddle.to_tensor(np.zeros(4, np.float32), stop_gradient=False)
+    opt = paddle.optimizer.SGD(learning_rate=0.01, parameters=[w, b])
+    losses = []
+    for _ in range(3):
+        h = F.relu(paddle.matmul(x, w) + b)
+        loss = ((h - y) * (h - y)).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(np.asarray(loss._value)))
+    return losses
+
+
+pre = None
+if mode == "replay":
+    pre = warmup.precompile(manifest_path)
+losses = workload()
+if mode == "record":
+    warmup.save_manifest(manifest_path)
+ds = dispatch.dispatch_stats()
+comp = ds["compile"]
+fus = ds["fusion"]
+out = {
+    "losses": losses,
+    "fresh_compiles": comp["fresh_compiles"],
+    "disk_cache_hits": comp["disk_cache_hits"],
+    "fused_hits": fus["fused"]["hits"],
+    "fused_misses": fus["fused"]["misses"],
+    "recorded_ops": fus["recorded_ops"],
+    "flushes": fus["flushes"],
+    "eager_replays": fus["eager_replays"],
+}
+if pre is not None:
+    out["precompile"] = pre
+print(json.dumps(out))

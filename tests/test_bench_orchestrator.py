@@ -177,6 +177,33 @@ def test_dispatch_delta_ranks_by_config_delta():
     assert res2["cfg_dispatch"]["fwd_hits"] == 100
 
 
+def test_orphaned_campaign_child_past_deadline_writes_nothing(tmp_path):
+    """A campaign child whose round deadline passed before its backend
+    was granted (the orphaned prior-round grant-waiter) must exit
+    WITHOUT writing its .started marker or any result file — either
+    would poison the NEXT round's state dir (stale results ingested,
+    or the next orchestrator misreading the marker and killing its own
+    grant-waiting child)."""
+    (tmp_path / "fake_one.py").write_text(
+        "def _lenet():\n    return {'lenet_imgs_per_sec': 111.0}\n"
+        "CONFIGS = {'lenet': (_lenet, {}, 60)}\n")
+    state_dir = tmp_path / "state"
+    state_dir.mkdir()
+    env = dict(os.environ)
+    env["BENCH_CONFIGS_MODULE"] = "fake_one"
+    env["PYTHONPATH"] = str(tmp_path) + os.pathsep + env.get("PYTHONPATH", "")
+    env["BENCH_FORCE_CPU"] = "1"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"),
+         "--campaign-config", "lenet", "--out-dir", str(state_dir),
+         "--deadline-ts", "1.0"],  # long expired
+        env=env, cwd=REPO, capture_output=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr.decode()[-500:]
+    assert not (state_dir / "lenet.json").exists()
+    assert not (state_dir / "lenet.started").exists()
+    assert b"deadline passed" in proc.stderr
+
+
 def test_zero_data_point_round_fails_and_persists_partials(tmp_path):
     """ROADMAP item 4 slice: a round where every config wedges/errors
     must exit nonzero with data_points == 0, and the partial payload

@@ -1,0 +1,531 @@
+"""Trace-fusion for eager dispatch (core/fusion.py): deferred op
+recording, single fused-program flushes, fingerprint caching, flush
+reasons, kill-switch equivalence, and the warm-start fused-trace round
+trip."""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu.core import dispatch, fusion
+from paddle_tpu.core.autograd import apply
+from paddle_tpu.core.fusion import LazyArray
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _fusion_isolation():
+    """Every test starts fusion-off with clean fusion caches/stats and
+    leaves the process the same way (other test files must see today's
+    per-op path untouched)."""
+    fusion.set_fusion(False)
+    prev_warm = dispatch.set_warmup_count(1)
+    dispatch.reset_dispatch_stats(clear_caches=True)
+    yield
+    fusion.flush()
+    fusion.set_fusion(False)
+    dispatch.set_warmup_count(prev_warm)
+    dispatch.reset_dispatch_stats(clear_caches=True)
+
+
+def _mlp_step(x, y, params, opt):
+    h = F.relu(paddle.matmul(x, params[0]) + params[1])
+    p = paddle.matmul(h, params[2]) + params[3]
+    loss = ((p - y) * (p - y)).mean()
+    loss.backward()
+    opt.step()
+    opt.clear_grad()
+    return loss
+
+
+def _make_fixture():
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.randn(8, 16).astype(np.float32))
+    y = paddle.to_tensor(rng.randn(8, 4).astype(np.float32))
+    prng = np.random.RandomState(1)
+    params = [
+        paddle.to_tensor(prng.randn(16, 32).astype(np.float32) * 0.1,
+                         stop_gradient=False),
+        paddle.to_tensor(np.zeros(32, np.float32), stop_gradient=False),
+        paddle.to_tensor(prng.randn(32, 4).astype(np.float32) * 0.1,
+                         stop_gradient=False),
+        paddle.to_tensor(np.zeros(4, np.float32), stop_gradient=False),
+    ]
+    opt = paddle.optimizer.SGD(learning_rate=0.01, parameters=params)
+    return x, y, params, opt
+
+
+# ---------------------------------------------------------------------------
+# numerical parity
+
+def test_forward_parity_eager_vs_fused():
+    rng = np.random.RandomState(0)
+    xv = rng.randn(4, 8).astype(np.float32)
+
+    def chain():
+        t = paddle.to_tensor(xv)
+        u = paddle.tanh(paddle.matmul(t, t.T))
+        v = F.softmax(u + 0.5, axis=-1)
+        return np.asarray((v * v).sum()._value)
+
+    eager = chain()
+    fusion.set_fusion(True)
+    fused = chain()
+    np.testing.assert_allclose(eager, fused, rtol=1e-6)
+
+
+def test_grad_parity_paddle_grad():
+    rng = np.random.RandomState(0)
+    xv = rng.randn(4, 8).astype(np.float32)
+    wv = rng.randn(8, 3).astype(np.float32)
+
+    def run():
+        xt = paddle.to_tensor(xv, stop_gradient=False)
+        wt = paddle.to_tensor(wv, stop_gradient=False)
+        h = paddle.tanh(paddle.matmul(xt, wt))
+        loss = (h * h).mean()
+        gs = paddle.grad(loss, [xt, wt])
+        return [np.asarray(g._value) for g in gs] + [np.asarray(loss._value)]
+
+    eager = run()
+    fusion.set_fusion(True)
+    fused = run()
+    for a, b in zip(eager, fused):
+        np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-7)
+
+
+def test_train_loop_parity_backward_and_optimizer():
+    """Full fwd+bwd+SGD trajectory: fused numerics must match per-op to
+    allclose tolerance over several steps (accumulated divergence would
+    show here)."""
+
+    def run(steps=5):
+        x, y, params, opt = _make_fixture()
+        losses = []
+        for _ in range(steps):
+            loss = _mlp_step(x, y, params, opt)
+            losses.append(float(np.asarray(loss._value)))
+        return losses, [np.asarray(p._value) for p in params]
+
+    eager_losses, eager_params = run()
+    fusion.set_fusion(True)
+    fused_losses, fused_params = run()
+    np.testing.assert_allclose(eager_losses, fused_losses, rtol=1e-5)
+    for a, b in zip(eager_params, fused_params):
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# laziness + materialization points
+
+def test_ops_are_deferred_and_materialize_on_host_access():
+    fusion.set_fusion(True)
+    t = paddle.to_tensor(np.ones((3, 3), np.float32))
+    u = paddle.tanh(t)
+    assert type(u._value) is LazyArray
+    assert u._value._concrete is None  # nothing executed yet
+    # shape/dtype queries stay eager — no flush
+    assert u.shape == [3, 3]
+    assert u._value._concrete is None
+    # host access flushes
+    val = float(u.sum())
+    assert abs(val - 9 * np.tanh(1.0)) < 1e-5
+    fs = dispatch.dispatch_stats()["fusion"]
+    assert fs["flushes"].get("materialize") == 1
+    assert fs["recorded_ops"] >= 2
+
+
+def test_lazy_raw_array_surface():
+    """Library code touches `Tensor._value` with the raw jax.Array API
+    (`.at[...]`, slicing, device_put, attribute probes) — every one of
+    those must be a materialization point, not an AttributeError
+    (review finding: __setitem__'s no-grad path crashed on `.at`)."""
+    import jax
+
+    fusion.set_fusion(True)
+    t = paddle.to_tensor(np.zeros((3, 3), np.float32))
+    u = paddle.tanh(t + 1.0)
+    assert isinstance(u._value, LazyArray)
+    # Tensor.__setitem__ (no-grad path) -> lazy.at[idx].set(v)
+    u[0] = 7.0
+    got = np.asarray(u._value)
+    want = np.full((3, 3), np.tanh(1.0), np.float32)
+    want[0] = 7.0
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+    # raw slicing of a pending value
+    v = paddle.tanh(t + 2.0)
+    np.testing.assert_allclose(np.asarray(v._value[1:, :2]),
+                               np.full((2, 2), np.tanh(2.0)), rtol=1e-6)
+    # device_put + raw operators on a pending value
+    w = paddle.tanh(t + 3.0)
+    moved = jax.device_put(w._value)
+    np.testing.assert_allclose(np.asarray(moved),
+                               np.full((3, 3), np.tanh(3.0)), rtol=1e-6)
+    x = paddle.tanh(t + 1.0)
+    np.testing.assert_allclose(np.asarray(x._value * 2 - 1.0),
+                               want * 0 + 2 * np.tanh(1.0) - 1.0, rtol=1e-6)
+    # the full numeric operator protocol materializes (floordiv, mod,
+    # abs, bitwise on ints) — eager-valid expressions must not raise
+    z = paddle.to_tensor(np.full((2, 2), 7, np.int32)) + 0
+    assert isinstance(z._value, LazyArray)
+    np.testing.assert_array_equal(np.asarray(z._value // 2), 3)
+    np2 = paddle.to_tensor(np.full((2, 2), 7, np.int32)) + 0
+    np.testing.assert_array_equal(np.asarray(np2._value % 4), 3)
+    neg = paddle.to_tensor(np.full((2, 2), -3.0, np.float32)) + 0.0
+    np.testing.assert_allclose(np.asarray(abs(neg._value)), 3.0)
+    msk = paddle.to_tensor(np.full((2, 2), 6, np.int32)) + 0
+    np.testing.assert_array_equal(np.asarray(msk._value & 4), 4)
+
+
+def test_lazy_comparisons_are_elementwise():
+    """Default identity __eq__ silently returned False for equal-valued
+    pending arrays (paddle.equal_all goes through `x._value ==
+    y._value`); comparisons must materialize like every other raw-array
+    protocol."""
+    fusion.set_fusion(True)
+    a = paddle.to_tensor(np.ones((2, 3), np.float32))
+    x = paddle.tanh(a)
+    y = paddle.tanh(a + 0.0)
+    assert bool(paddle.equal_all(x, y))
+    assert isinstance(x._value, LazyArray) or x._value is not None
+    lt = paddle.tanh(a)._value < paddle.tanh(a + 1.0)._value
+    assert bool(np.asarray(lt).all())
+
+
+def test_user_shape_error_does_not_demote_op():
+    """An ordinary shape mismatch must raise to the caller WITHOUT
+    permanently demoting a shared op (matmul) from fusion."""
+    fusion.set_fusion(True)
+    a = paddle.to_tensor(np.ones((2, 3), np.float32))
+    b = paddle.to_tensor(np.ones((4, 5), np.float32))
+    with pytest.raises(Exception):
+        float(paddle.matmul(a, b).sum())
+    fs = dispatch.dispatch_stats()["fusion"]
+    assert fs["demotions"] == 0, fs
+    # a well-shaped matmul afterwards still fuses
+    c = paddle.matmul(a, paddle.to_tensor(np.ones((3, 2), np.float32)))
+    assert isinstance(c._value, LazyArray)
+    np.testing.assert_allclose(np.asarray(c._value), np.full((2, 2), 3.0))
+
+
+def test_cross_thread_materialization():
+    """A placeholder recorded on one thread and materialized on another
+    must flush safely (flush_trace is the cross-thread entry point) —
+    the reader sees the patched value, never a spurious RuntimeError."""
+    import threading
+
+    fusion.set_fusion(True)
+    t = paddle.to_tensor(np.ones((4, 4), np.float32))
+    outs = [paddle.tanh(t + i) for i in range(8)]
+    results = {}
+
+    def reader(i):
+        results[i] = float(np.asarray(outs[i]._value).sum())
+
+    threads = [threading.Thread(target=reader, args=(i,)) for i in range(8)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    for i in range(8):
+        assert abs(results[i] - 16 * np.tanh(1.0 + i)) < 1e-4, (i, results)
+
+
+def test_lazy_array_protocols():
+    fusion.set_fusion(True)
+    t = paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3))
+    u = paddle.tanh(t)
+    assert len(u) == 2
+    assert u.ndim == 2 and u.size == 6
+    np.testing.assert_allclose(np.asarray(u._value),
+                               np.tanh(np.arange(6).reshape(2, 3)),
+                               rtol=1e-6)
+    s = (paddle.to_tensor(np.float32(2.0)) * 3).sum()
+    assert int(s) == 6 and bool(s)
+
+
+# ---------------------------------------------------------------------------
+# flush-reason classes
+
+def test_flush_reason_unjittable_forced():
+    fusion.set_fusion(True)
+
+    @dispatch.non_jittable
+    def host_side(v):
+        return v * 2  # raw python operator: needs concrete inputs
+
+    t = paddle.to_tensor(np.ones((2, 2), np.float32))
+    u = paddle.tanh(t)
+    r = apply(host_side, u)
+    fs = dispatch.dispatch_stats()["fusion"]
+    assert fs["flushes"].get("unjittable") == 1, fs["flushes"]
+    np.testing.assert_allclose(np.asarray(r._value), np.tanh(1.0) * 2,
+                               rtol=1e-6)
+
+
+def test_flush_reason_suspend_both_layers():
+    fusion.set_fusion(True)
+    t = paddle.to_tensor(np.ones((2, 2), np.float32))
+    u = paddle.tanh(t)
+    with dispatch.suspend():  # the hapi whole-step path
+        # BOTH layers are suspended: a backward inside the region must
+        # not defer either (record_call checks fusion's counter only)
+        w = paddle.to_tensor(np.ones((2, 2), np.float32),
+                             stop_gradient=False)
+        loss = (paddle.tanh(w) * paddle.tanh(w)).mean()
+        loss.backward()
+        assert not isinstance(w._grad._value, LazyArray)
+        w.clear_grad()
+    assert u._value._concrete is not None
+    v = paddle.tanh(t)
+    with fusion.suspend():
+        w = paddle.tanh(t)  # recorded nowhere: per-op path
+        assert not isinstance(w._value, LazyArray)
+    assert v._value._concrete is not None
+    fs = dispatch.dispatch_stats()["fusion"]
+    assert fs["flushes"].get("suspend") == 2, fs["flushes"]
+
+
+def test_flush_reason_max_len_safety_valve(monkeypatch):
+    monkeypatch.setattr(fusion, "_max_ops", 4)
+    fusion.set_fusion(True)
+    t = paddle.to_tensor(np.ones((2, 2), np.float32))
+    acc = paddle.tanh(t)
+    for _ in range(9):
+        acc = paddle.tanh(acc)
+    fs = dispatch.dispatch_stats()["fusion"]
+    assert fs["flushes"].get("max_len", 0) >= 2, fs["flushes"]
+    assert fs["max_trace_len"] <= 4
+    # values still correct through the splits
+    expect = np.ones((2, 2))
+    for _ in range(10):
+        expect = np.tanh(expect)
+    np.testing.assert_allclose(np.asarray(acc._value), expect, rtol=1e-6)
+
+
+def test_runtime_demotion_learns_unsafe_op():
+    """An op whose abstract evaluation fails (host materialization)
+    is learned fusion-unsafe with a fault event, runs eagerly with
+    correct values, and future sightings are flush points."""
+    from paddle_tpu.runtime.resilience import fault_events
+
+    fusion.set_fusion(True)
+
+    def host_materializing(v):
+        return v * int(v.sum())  # int() on a tracer: eval_shape raises
+
+    t = paddle.to_tensor(np.ones((2, 2), np.float32))
+    before = fault_events().get("fusion_demotions", 0)
+    r = apply(host_materializing, t)
+    np.testing.assert_allclose(np.asarray(r._value), np.ones((2, 2)) * 4)
+    fs = dispatch.dispatch_stats()["fusion"]
+    assert fs["unsafe_ops"] >= 1
+    assert fault_events().get("fusion_demotions", 0) == before + 1
+    # second sighting: already-known unsafe -> forced flush, no re-probe
+    u = paddle.tanh(t)
+    apply(host_materializing, t)
+    assert u._value._concrete is not None
+
+
+# ---------------------------------------------------------------------------
+# fingerprint cache
+
+def test_steady_loop_fingerprint_hit_rate():
+    """A steady training loop must replay cached fused executables:
+    >= 99% fused-cache hit rate (the acceptance bar)."""
+    fusion.set_fusion(True)
+    x, y, params, opt = _make_fixture()
+    for _ in range(150):
+        _mlp_step(x, y, params, opt)
+    fs = dispatch.dispatch_stats()["fusion"]
+    fc = fs["fused"]
+    assert fc["hits"] + fc["misses"] >= 150
+    assert fc["hit_rate"] >= 0.99, fc
+    # one flush per step, at the optimizer's materialization boundary
+    assert fs["flushes"].get("materialize", 0) >= 150
+    assert fs["avg_trace_len"] > 5
+
+
+def test_eager_replay_below_warm_gate():
+    """Below the warm-count gate a trace replays op-by-op eagerly —
+    correct values, no fused compile."""
+    dispatch.set_warmup_count(3)
+    fusion.set_fusion(True)
+    t = paddle.to_tensor(np.ones((2, 2), np.float32))
+    v1 = float(paddle.tanh(t).sum())
+    v2 = float(paddle.tanh(t).sum())
+    fs = dispatch.dispatch_stats()["fusion"]
+    assert fs["eager_replays"] == 2
+    assert fs["fused"]["size"] == 0  # nothing compiled yet
+    v3 = float(paddle.tanh(t).sum())  # third sighting compiles
+    fs = dispatch.dispatch_stats()["fusion"]
+    assert fs["fused"]["size"] == 1
+    assert v1 == v2 == v3
+
+
+def test_mid_replay_failure_preserves_computed_prefix(monkeypatch):
+    """When the op-by-op fallback replay fails at node k, the real
+    error raises at the materialization point AND values computed by
+    nodes before k survive — eager mode would have produced them."""
+
+    def broken_build(nodes, alive):
+        def boom(*ext):
+            raise RuntimeError("synthetic fused failure")
+        return boom
+
+    monkeypatch.setattr(fusion, "_build_fused", broken_build)
+    fusion.set_fusion(True)
+    t = paddle.to_tensor(np.ones((2, 2), np.float32))
+    ok = paddle.tanh(t)       # node 0: fine
+    bad = paddle.tanh(ok)     # node 1: sabotaged below
+    tr = bad._value._trace
+
+    def sabotage(*ins):
+        raise RuntimeError("synthetic node failure")
+
+    tr.nodes[1].call = sabotage
+    with pytest.raises(RuntimeError, match="synthetic node failure"):
+        float(bad.sum())
+    # node 0 executed before the failure: its value must be available
+    np.testing.assert_allclose(np.asarray(ok._value),
+                               np.tanh(np.ones((2, 2))), rtol=1e-6)
+    # re-touching the never-computed tensor names the ORIGINAL cause,
+    # not an opaque internal invariant
+    with pytest.raises(RuntimeError, match="synthetic node failure"):
+        float(bad.sum())
+
+
+def test_fused_failure_falls_back_to_eager_replay(monkeypatch):
+    """A fused program that fails at execution degrades to op-by-op
+    replay with correct values and a fusion_fallbacks fault event."""
+    from paddle_tpu.runtime.resilience import fault_events
+
+    def broken_build(nodes, alive):
+        def boom(*ext):
+            raise RuntimeError("synthetic fused failure")
+        return boom
+
+    monkeypatch.setattr(fusion, "_build_fused", broken_build)
+    fusion.set_fusion(True)
+    before = fault_events().get("fusion_fallbacks", 0)
+    t = paddle.to_tensor(np.ones((2, 2), np.float32))
+    val = float(paddle.tanh(t).sum())
+    assert abs(val - 4 * np.tanh(1.0)) < 1e-5
+    fs = dispatch.dispatch_stats()["fusion"]
+    assert fs["fallbacks"] == 1
+    assert fault_events().get("fusion_fallbacks", 0) == before + 1
+
+
+# ---------------------------------------------------------------------------
+# kill switch
+
+def test_kill_switch_reproduces_per_op_path_exactly():
+    """With fusion off (the PADDLE_TPU_EAGER_FUSION=0 default), the
+    per-op path must be byte-identical to today's: same dispatch_stats
+    traffic, zero fusion activity."""
+
+    def run():
+        dispatch.reset_dispatch_stats(clear_caches=True)
+        x, y, params, opt = _make_fixture()
+        for _ in range(3):
+            _mlp_step(x, y, params, opt)
+        ds = dispatch.dispatch_stats()
+        fwd, bwd, fus = ds["forward"], ds["backward"], ds["fusion"]
+        per_op = {k: (v["hits"], v["misses"], v["retraces"])
+                  for k, v in ds["per_op"].items()}
+        return ({k: fwd[k] for k in ("hits", "misses", "bypasses",
+                                     "unkeyable", "warming", "fallbacks")},
+                {k: bwd[k] for k in ("hits", "misses")}, per_op,
+                fus["recorded_ops"], sum(fus["flushes"].values()))
+
+    baseline = run()          # plain per-op path
+    prev = fusion.set_fusion(False)  # kill switch explicitly off
+    killed = run()
+    fusion.set_fusion(prev)
+    assert baseline == killed
+    assert killed[3] == 0 and killed[4] == 0  # fusion never engaged
+
+
+def test_fusion_defaults_off():
+    # the env default ships fusion off: importing paddle_tpu must not
+    # change eager behavior until someone opts in
+    assert not fusion.fusion_enabled()
+
+
+# ---------------------------------------------------------------------------
+# warm start
+
+def test_trace_manifest_round_trip_in_process():
+    """A fresh fused build records a replayable trace entry; after a
+    cache wipe, precompile() reinstalls it and the first flush is a
+    pure cache hit."""
+    from paddle_tpu.runtime import warmup
+
+    warmup.reset_manifest_records()
+    fusion.set_fusion(True)
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.randn(8, 16).astype(np.float32))
+    w = paddle.to_tensor(rng.randn(16, 4).astype(np.float32),
+                         stop_gradient=False)
+
+    def work():
+        h = paddle.tanh(paddle.matmul(x, w))
+        loss = (h * h).mean()
+        loss.backward()
+        g = np.asarray(w._grad._value)
+        w.clear_grad()
+        return float(np.asarray(loss._value)), g
+
+    l0, g0 = work()
+    doc = warmup.manifest()
+    traces = [e for e in doc["entries"] if e.get("kind") == "trace"]
+    assert traces and all(t["replayable"] for t in traces), traces
+
+    dispatch.reset_dispatch_stats(clear_caches=True)
+    stats = warmup.precompile(doc)
+    assert stats["traces_precompiled"] >= 1, stats
+    l1, g1 = work()
+    fc = dispatch.dispatch_stats()["fusion"]["fused"]
+    assert fc["hits"] >= 1 and fc["misses"] == 0, fc
+    assert abs(l0 - l1) < 1e-6
+    np.testing.assert_allclose(g0, g1, rtol=1e-6)
+    warmup.reset_manifest_records()
+
+
+def test_warm_start_round_trip_subprocess(tmp_path):
+    """The acceptance proof: a SECOND PROCESS with the shared compile
+    cache + shape manifest replays the recorded fused traces with zero
+    fresh XLA compiles and zero fused-cache misses."""
+    env = dict(os.environ)
+    env.update(
+        JAX_PLATFORMS="cpu",
+        PADDLE_TPU_COMPILE_CACHE_DIR=str(tmp_path / "cache"),
+        PADDLE_TPU_COMPILE_CACHE_MIN_COMPILE_S="0",
+        FUSION_MANIFEST=str(tmp_path / "manifest.json"),
+    )
+    env.pop("PADDLE_TPU_SHAPE_MANIFEST", None)
+
+    def run(mode):
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tests",
+                                          "_fusion_child.py"), mode],
+            env=env, cwd=REPO, capture_output=True, timeout=240)
+        assert proc.returncode == 0, proc.stderr.decode()[-2000:]
+        return json.loads(proc.stdout.decode().strip().splitlines()[-1])
+
+    cold = run("record")
+    assert cold["recorded_ops"] > 0
+    assert cold["fused_misses"] >= 1  # it did the compiles
+    warm = run("replay")
+    assert warm["precompile"]["traces_precompiled"] >= 1, warm
+    assert warm["fused_misses"] == 0, warm
+    assert warm["fused_hits"] >= 3, warm
+    assert warm["fresh_compiles"] == 0, warm
+    assert warm["disk_cache_hits"] > 0, warm
+    np.testing.assert_allclose(cold["losses"], warm["losses"], rtol=1e-6)

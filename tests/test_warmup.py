@@ -95,7 +95,8 @@ def test_precompile_skips_nonjittable_and_counts_skipped():
                       "impl": None, "tree": None, "leaves": None}]}
     stats = warmup.precompile(m)
     assert stats == {"ops_precompiled": 0, "ops_skipped": 1,
-                     "programs_pending": 0, "stale": False}
+                     "programs_pending": 0, "traces_precompiled": 0,
+                     "stale": False}
 
 
 def test_stale_manifest_falls_back_cold_with_fault_event(tmp_path):

@@ -44,6 +44,12 @@ echo "== warm-start smoke (persistent compile cache + shape manifest) =="
 # disk (hits > 0) and perform ZERO fresh XLA compiles
 JAX_PLATFORMS=cpu python tools/warmstart_smoke.py
 
+echo "== fusion smoke (trace-fusion warm-start round trip) =="
+# two subprocesses share a compile cache + shape manifest: the second
+# must AOT-replay the recorded fused traces (fused-cache misses == 0)
+# with ZERO fresh XLA compiles and disk hits > 0
+JAX_PLATFORMS=cpu python tools/fusion_smoke.py
+
 echo "== multihost smoke (coordination store + quorum + merge) =="
 # 2-process CPU cluster over a tmpdir store: heartbeat + rendezvous
 # round trip, host-0 merged prom/fault-log carrying both rank labels,

@@ -1,0 +1,91 @@
+#!/usr/bin/env python
+"""Trace-fusion warm-start smoke (tools/ci_check.sh): two fresh
+processes sharing a persistent compile-cache dir + shape manifest
+prove the fused-trace round trip on CPU in a few seconds.
+
+Pass A (record): runs a small fused train loop (fwd + backward +
+cotangent accumulation + SGD) with ``PADDLE_TPU_EAGER_FUSION`` live,
+flushing one fused XLA program per step; saves the shape manifest,
+which now carries replayable fused-trace entries.
+
+Pass B (replay): precompiles the manifest — `fusion.precompile_trace`
+AOT-rebuilds each trace's node chain and installs the compiled fused
+program under its reconstructed fingerprint — then runs the same
+workload and must report:
+
+* ``traces_precompiled >= 1`` (the manifest carried the traces),
+* ``fused_misses == 0``       (every flush was a cache hit),
+* ``fresh_compiles == 0``     (every XLA executable came from disk),
+* ``disk_cache_hits > 0``     (the disk cache actually served them),
+* losses identical to pass A  (deferred execution changed nothing).
+
+The child workload lives in tests/_fusion_child.py (shared with
+tests/test_fusion.py's acceptance test).
+
+Usage: python tools/fusion_smoke.py
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CHILD = os.path.join(REPO, "tests", "_fusion_child.py")
+
+
+def _run_pass(mode, env):
+    proc = subprocess.run([sys.executable, CHILD, mode], env=env, cwd=REPO,
+                          capture_output=True, timeout=240)
+    if proc.returncode != 0:
+        print(proc.stderr.decode()[-2000:], file=sys.stderr)
+        raise SystemExit(f"fusion_smoke: {mode} child failed "
+                         f"(rc={proc.returncode})")
+    return json.loads(proc.stdout.decode().strip().splitlines()[-1])
+
+
+def main():
+    with tempfile.TemporaryDirectory(prefix="fusion_smoke_") as td:
+        env = dict(os.environ)
+        env.update(
+            JAX_PLATFORMS="cpu",
+            PADDLE_TPU_COMPILE_CACHE_DIR=os.path.join(td, "cache"),
+            PADDLE_TPU_COMPILE_CACHE_MIN_COMPILE_S="0",
+            FUSION_MANIFEST=os.path.join(td, "manifest.json"),
+        )
+        env.pop("PADDLE_TPU_SHAPE_MANIFEST", None)
+        cold = _run_pass("record", env)
+        warm = _run_pass("replay", env)
+
+    problems = []
+    if cold["recorded_ops"] <= 0:
+        problems.append(f"pass A recorded no ops: {cold}")
+    if warm.get("precompile", {}).get("traces_precompiled", 0) < 1:
+        problems.append(f"pass B precompiled no traces: "
+                        f"{warm.get('precompile')}")
+    if warm["fused_misses"] != 0:
+        problems.append(f"pass B fused-cache misses: "
+                        f"{warm['fused_misses']} (want 0)")
+    if warm["fresh_compiles"] != 0:
+        problems.append(f"pass B fresh XLA compiles: "
+                        f"{warm['fresh_compiles']} (want 0)")
+    if warm["disk_cache_hits"] <= 0:
+        problems.append("pass B loaded nothing from the disk cache")
+    if any(abs(a - b) > 1e-6 for a, b in zip(cold["losses"],
+                                             warm["losses"])):
+        problems.append(f"losses diverged: {cold['losses']} vs "
+                        f"{warm['losses']}")
+    if problems:
+        for p in problems:
+            print(f"fusion_smoke: FAIL: {p}", file=sys.stderr)
+        raise SystemExit(1)
+    print(f"fusion_smoke: OK (pass A: {cold['recorded_ops']} ops recorded, "
+          f"{cold['fused_misses']} fused compiles; pass B: "
+          f"{warm['fused_hits']} fused-cache hits, 0 misses, 0 fresh "
+          f"compiles, {warm['disk_cache_hits']} disk loads)")
+
+
+if __name__ == "__main__":
+    main()

@@ -157,6 +157,17 @@ def decorate(models, optimizers=None, level="O2", dtype="float16",
     return models, optimizers
 
 
+# module-level pure ops for the scaler's lazy routes: fusion.record
+# keys on the code object, so these must be stable defs (a lambda per
+# call would defeat the trace-fingerprint cache)
+def _notfinite_op(g):
+    return jnp.any(~jnp.isfinite(g))
+
+
+def _or_op(a, b):
+    return a | b
+
+
 class GradScaler:
     """Dynamic loss scaling (reference: python/paddle/amp/grad_scaler.py)."""
 
@@ -182,15 +193,27 @@ class GradScaler:
     def unscale_(self, optimizer):
         if not self._enable:
             return
+        from ..core import fusion as _fusion
+
         inv = 1.0 / self._scale
         bad = None  # device-side flag; ONE host sync at the end
         for p in optimizer._param_list:
             if p._grad is not None:
-                g = p._grad._value * inv
+                # lazy routes: under trace fusion the unscale and the
+                # finite probe RECORD into the pending trace (a raw
+                # jnp call on a deferred grad would materialize it via
+                # __jax_array__, flushing the fused fwd+bwd mid-step —
+                # fuselint FL006); with fusion off these are plain
+                # eager calls on concrete arrays, bit-identical to the
+                # raw expressions they replace
+                g = _fusion.lazy_mul(p._grad._value, inv)
                 p._grad._value = g
-                nf = jnp.any(~jnp.isfinite(g))
-                bad = nf if bad is None else (bad | nf)
-        self._found_inf = bool(bad) if bad is not None else False
+                nf = _fusion.lazy_apply(_notfinite_op, g)
+                bad = nf if bad is None else _fusion.lazy_apply(
+                    _or_op, bad, nf)
+        # the ONE intentional host sync of the unscale: everything
+        # above stays in the fused program up to this read
+        self._found_inf = bool(bad) if bad is not None else False  # fuselint: ok[FL002] the scaler's single reviewed sync point
         self._unscaled = True
 
     def step(self, optimizer):

@@ -271,7 +271,7 @@ def _make_pullback(fn, vals, treedef, diff_pos, out_treedef):
     n_vals = len(vals)
 
     def _eager(cot_tree):
-        vc = [_fusion.concrete(v) for v in vals]
+        vc = [_fusion.concrete(v) for v in vals]  # fuselint: ok[FL001] the eager-vjp fallback IS the concretize route (float0 cotangents, unkeyable pullbacks)
         g = _subst_call(fn, treedef, diff_pos, vc)
         _, pull = jax.vjp(g, *[vc[i] for i in diff_pos])
         return pull(jax.tree_util.tree_map(_fusion.concrete, cot_tree))
@@ -344,8 +344,8 @@ def _make_pullback(fn, vals, treedef, diff_pos, out_treedef):
 
         bwd = _dispatch.BACKWARD.get_or_build(
             key, _build, tag=getattr(fn, "__name__", "op"))
-        return bwd([_fusion.concrete(vals[i]) for i in arr_pos],
-                   [_fusion.concrete(c) for c in cot_leaves])
+        return bwd([_fusion.concrete(vals[i]) for i in arr_pos],  # fuselint: ok[FL001] non-fusion backward: the cached jitted pullback needs concrete operands
+                   [_fusion.concrete(c) for c in cot_leaves])  # fuselint: ok[FL001] see above — same deliberate boundary
 
     return pullback
 
@@ -554,7 +554,7 @@ def run_backward(tensors, grad_tensors=None, retain_graph=False,
             # a fresh closure over this node's primal arrays — caching a
             # program per node would compile-churn every backward step
             @_dispatch.non_jittable
-            def vjp_call(cot_leaves, *prims, _closed=closed, _td=treedef):
+            def vjp_call(cot_leaves, *prims, _closed=closed, _td=treedef):  # fuselint: ok[FL003] per-node closure over live primals: caching would churn, eager is the design
                 cot = jax.tree_util.tree_unflatten(_td, list(cot_leaves))
                 _, pull = jax.vjp(_closed, *prims)
                 return pull(cot)

@@ -55,6 +55,7 @@ from __future__ import annotations
 
 import collections
 import os
+import sys
 import threading
 import types
 import weakref
@@ -96,7 +97,7 @@ from . import dispatch as _dispatch  # noqa: E402
 __all__ = [
     "LazyArray", "record", "record_call", "flush", "fusion_stats",
     "set_fusion", "fusion_enabled", "suspend", "concrete", "lazy_add",
-    "precompile_trace", "reset_fusion_stats",
+    "lazy_mul", "lazy_apply", "precompile_trace", "reset_fusion_stats",
 ]
 
 
@@ -272,10 +273,10 @@ class LazyArray:
         return getattr(self._materialize(), name)
 
     def __mul__(self, other):
-        return self._materialize() * concrete(other)
+        return lazy_mul(self, other)
 
     def __rmul__(self, other):
-        return concrete(other) * self._materialize()
+        return lazy_mul(other, self)
 
     def __sub__(self, other):
         return self._materialize() - concrete(other)
@@ -473,6 +474,13 @@ def _blank_stats():
         "recorded_ops": 0,     # ops deferred into traces
         "flushed_ops": 0,      # ops that reached a flush
         "flushes": {},         # reason -> count
+        "flush_sites": {},     # reason -> {"file:line": count} — WHERE
+        #                        each flush was forced (the first stack
+        #                        frame outside the deferred-execution
+        #                        machinery); bounded per reason, overflow
+        #                        folds into "<other>". fuselint's
+        #                        --verify-runtime cross-references this
+        #                        table against its static findings.
         "eager_replays": 0,    # flushes below the warm gate (no compile)
         "fallbacks": 0,        # fused program failed -> op-by-op replay
         "demotions": 0,        # ops learned fusion-unsafe at runtime
@@ -496,7 +504,9 @@ def _bump(key, n=1):
 def fusion_stats():
     """Snapshot for dispatch_stats()["fusion"] / profiler.summary."""
     with _stats_lock:
-        out = {k: (dict(v) if isinstance(v, dict) else v)
+        out = {k: ({r: dict(s) for r, s in v.items()}
+                   if k == "flush_sites"
+                   else dict(v) if isinstance(v, dict) else v)
                for k, v in _stats.items()}
     out["enabled"] = _ON[0]
     out["max_trace_ops"] = _max_ops
@@ -562,12 +572,64 @@ def _mark_unsafe(ident, fn, name):
     _record_fault("fusion_demotions", name or getattr(fn, "__name__", "op"))
 
 
-def _note_flush(reason, n_ops):
+# flush-site attribution: the first stack frame OUTSIDE these files is
+# the code that forced the flush. tensor.py is machinery-adjacent — its
+# dunders mechanically forward the LazyArray protocol, so attributing
+# to them would hide every real site behind Tensor.__float__.
+_MACHINERY_FILES = (os.sep + "core" + os.sep + "fusion.py",
+                    os.sep + "core" + os.sep + "dispatch.py",
+                    os.sep + "core" + os.sep + "tensor.py")
+# per-reason bound on distinct attributed sites: a shape-churning loop
+# must not grow the table without limit; the overflow bucket keeps the
+# per-reason totals reconciling with _stats["flushes"] exactly
+_SITE_CAP = 64
+
+
+# repo root (fusion.py -> core -> paddle_tpu -> root): sites under it
+# are repo-relative. Anchoring on this, not a bare "paddle_tpu/"
+# substring, keeps a checkout DIRECTORY named paddle_tpu from making
+# driver/test sites look like library code (phantom --verify-runtime
+# recall gaps).
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))).replace(os.sep, "/") + "/"
+
+
+def _short_site(filename, lineno):
+    path = os.path.abspath(filename).replace(os.sep, "/")
+    if path.startswith(_REPO_ROOT):
+        return f"{path[len(_REPO_ROOT):]}:{lineno}"
+    i = path.rfind("/paddle_tpu/")
+    if i >= 0:  # an out-of-repo install of the package
+        return f"{path[i + 1:]}:{lineno}"
+    return f"{path.rsplit('/', 1)[-1]}:{lineno}"
+
+
+def _flush_site():
+    """file:line of the frame that forced this flush — paddle_tpu/-
+    anchored for library code (the form fuselint findings use),
+    basename for user scripts."""
+    try:
+        f = sys._getframe(2)
+    except ValueError:  # pragma: no cover — shallow stack
+        return "<unknown>"
+    while f is not None:
+        fn = f.f_code.co_filename
+        if not fn.endswith(_MACHINERY_FILES):
+            return _short_site(fn, f.f_lineno)
+        f = f.f_back
+    return "<unknown>"
+
+
+def _note_flush(reason, n_ops, site):
     with _stats_lock:
         _stats["flushes"][reason] = _stats["flushes"].get(reason, 0) + 1
         _stats["flushed_ops"] += n_ops
         if n_ops > _stats["max_trace_len"]:
             _stats["max_trace_len"] = n_ops
+        sites = _stats["flush_sites"].setdefault(reason, {})
+        if site not in sites and len(sites) >= _SITE_CAP:
+            site = "<other>"
+        sites[site] = sites.get(site, 0) + 1
 
 
 def _concretize_vals(vals):
@@ -779,7 +841,7 @@ def _append_node(core, call, ins, out_avals, name, spec):
         return placeholders
 
 
-# -- the two raw-array helper ops (see LazyArray.astype/__add__) ----------
+# -- the raw-array helper ops (see LazyArray.astype/__add__/__mul__) -------
 
 def _astype_op(x, dt):
     return x.astype(dt)
@@ -787,6 +849,10 @@ def _astype_op(x, dt):
 
 def _add_op(a, b):
     return a + b
+
+
+def _mul_op(a, b):
+    return a * b
 
 
 _PAIR_TREE = jax.tree_util.tree_flatten(((0, 0), {}))[1]
@@ -819,6 +885,38 @@ def lazy_add(a, b):
         if out is not None:
             return out
     return concrete(a) + concrete(b)
+
+
+def lazy_mul(a, b):
+    """Multiplication that stays in the trace when either side is
+    pending — cotangent/gradient scaling (AMP unscale's ``g * inv``,
+    loss scaling) would otherwise flush mid-step through
+    ``__jax_array__``; plain `*` otherwise."""
+    if type(a) is LazyArray or type(b) is LazyArray:
+        out = _record_helper(_mul_op, [a, b], "mul")
+        if out is not None:
+            return out
+    return concrete(a) * concrete(b)
+
+
+def lazy_apply(fn, *vals, name=None):
+    """Record one raw-array op into this thread's trace when fusion is
+    recording; plain eager call on concretized values otherwise.
+
+    The escape hatch for library code operating BELOW the dispatch
+    layer (AMP unscale's finite check, clip norms): a raw jnp call on a
+    pending value materializes it through ``__jax_array__``, flushing
+    the fused program mid-step — routing through here keeps the op in
+    the trace. `fn` must be a keyable pure function over array leaves
+    (a module-level def; record() declines anything else and the call
+    degrades to eager, never to an error)."""
+    if _ON[0] and not _tl.suspended and _dispatch.eager_jit_enabled():
+        flat, treedef = jax.tree_util.tree_flatten((tuple(vals), {}))
+        ok, out = record(fn, list(flat), treedef,
+                         name or getattr(fn, "__name__", "op"))
+        if ok:
+            return out
+    return fn(*[concrete(v) for v in vals])
 
 
 # ---------------------------------------------------------------------------
@@ -911,7 +1009,7 @@ def flush_trace(trace, reason):
             _tl.trace = None
         if not trace.nodes:
             return
-        _note_flush(reason, len(trace.nodes))
+        _note_flush(reason, len(trace.nodes), _flush_site())
         _execute(trace)
 
 

@@ -168,7 +168,7 @@ def hlo_flops(fn, *example_args):
     # `fn` is typically a layer forward: the .lower() trace dispatches
     # its ops — keep them out of the per-op jit cache (tracelint
     # suspend-audit)
-    with _dispatch.suspend():
+    with _dispatch.suspend():  # fuselint: ok[FL004] flops counting lowers the model once, off the step loop
         compiled = jax.jit(fn).lower(*example_args).compile()
     cost = compiled.cost_analysis()
     if isinstance(cost, (list, tuple)):

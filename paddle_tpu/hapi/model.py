@@ -97,7 +97,7 @@ class _JitStepEngine:
         from ..core import dispatch as _dispatch
 
         with training_mode(training, net.sublayers(include_self=True)), \
-                rnd.key_scope(key), _ag.no_grad(), _dispatch.suspend():
+                rnd.key_scope(key), _ag.no_grad(), _dispatch.suspend():  # fuselint: ok[FL004] the whole-step jit trace owns fusion's job here (one program already)
             ctx = None
             if amp_level:
                 from .. import amp as amp_mod

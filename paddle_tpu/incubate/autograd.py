@@ -91,7 +91,7 @@ class Jacobian:
         # suspend-audit)
         from ..core import dispatch as _dispatch
 
-        with _dispatch.suspend():
+        with _dispatch.suspend():  # fuselint: ok[FL004] Jacobian traces fn whole; a deferred op inside would leak tracers
             out_struct = jax.eval_shape(f, *vals)
             if isinstance(out_struct, tuple):
                 raise NotImplementedError(

@@ -77,7 +77,7 @@ class StaticFunction:
             # zero-input creation ops would slip through)
             from ..core import dispatch as _dispatch
 
-            with rnd.key_scope(key), _ag.no_grad(), _dispatch.suspend():
+            with rnd.key_scope(key), _ag.no_grad(), _dispatch.suspend():  # fuselint: ok[FL004] to_static compiles the whole program; fusion has nothing to add inside
                 if layer is not None:
                     # scoped override, not live flag mutation: this fn is
                     # traced under jax.jit, where a re-entrant trace would

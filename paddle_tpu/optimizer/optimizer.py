@@ -8,18 +8,48 @@ fused/multi_tensor kernels, but compiler-scheduled.
 from __future__ import annotations
 
 import functools
+import itertools
+import os
 import time
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..core import fusion as _fusion
 from ..core.fusion import concrete as _concrete
 from ..core.tensor import Tensor
 from ..nn.clip import ClipGradBase
 from .lr import LRScheduler
 
-__all__ = ["Optimizer"]
+__all__ = ["Optimizer", "set_fused_step_recording"]
+
+# Opt-in (PADDLE_TPU_FUSION_OPT_STEP=1): with trace fusion on, step()
+# RECORDS the fused multi-tensor update into the lazy trace instead of
+# concretizing at its boundary — the whole train step (fwd + bwd +
+# optimizer) then flushes as ONE program at the caller's first host
+# read (ROADMAP item 2's one-flush-per-step goal). Off by default: a
+# loop that never reads a host value would otherwise accumulate ops
+# across steps until the max_len valve, changing today's deterministic
+# one-flush-per-step fingerprint pattern.
+_fuse_step = [os.environ.get("PADDLE_TPU_FUSION_OPT_STEP", "0").lower()
+              not in ("0", "false", "no")]
+# monotonic serial per recorded step entry: the record_call key must
+# uniquely name the emitted program, and a serial can never be recycled
+# into aliasing a dead optimizer's cached fused program — unlike id(),
+# which would otherwise force pinning the state-laden raw closure (and
+# with it the whole optimizer's params/master weights) for the process
+# lifetime. itertools.count.__next__ is one C-level call — atomic under
+# the GIL, so two optimizers minting serials concurrently never collide.
+_step_serial = itertools.count(1)
+
+
+def set_fused_step_recording(mode):
+    """Runtime analogue of ``PADDLE_TPU_FUSION_OPT_STEP``. Returns the
+    previous mode."""
+    prev = _fuse_step[0]
+    _fuse_step[0] = bool(mode)
+    return prev
 
 
 class Optimizer:
@@ -60,6 +90,9 @@ class Optimizer:
         self._accumulators = {}   # param id -> {slot: jnp array}
         self._global_step = 0
         self._step_fn_cache = {}
+        self._record_sigs = {}    # id(raw) -> ((treedef, avals), call,
+        #                            out_avals, out_treedef) memo for the
+        #                            trace-fusion record path
         self._step_recorded = False  # first step() recorded its warm-start
         #                              signature (even if warm_start built
         #                              the entry first)
@@ -206,8 +239,11 @@ class Optimizer:
         # arrays (Tensor.detach() taken earlier, retained residuals for a
         # second backward of a freed graph) is invalidated by step(); callers
         # holding such aliases must materialize them first (see
-        # Tensor.detach docstring).
-        return jax.jit(fused, donate_argnums=(0, 1))  # tracelint: ok[suspend-audit] raw-jnp update rules + clip_values
+        # Tensor.detach docstring). The RAW fn rides along for the
+        # trace-fusion record path (a node call must not be a donating
+        # jit — inside the fused program donation is meaningless and
+        # jax warns).
+        return jax.jit(fused, donate_argnums=(0, 1)), fused  # tracelint: ok[suspend-audit] raw-jnp update rules + clip_values
 
     @property
     def _param_list(self):
@@ -241,18 +277,86 @@ class Optimizer:
     def _program_name(self):
         return f"optimizer.fused_step.{type(self).__name__}"
 
+    def _record_step(self, raw, values, states, grads, lr):
+        """Defer the fused multi-tensor update into the trace-fusion
+        lazy trace (PADDLE_TPU_FUSION_OPT_STEP): the step becomes one
+        trace node consuming the deferred fwd/bwd placeholders, so the
+        whole train step flushes as ONE program at the caller's first
+        host read instead of concretizing here. Returns (new_vals,
+        new_states) of LazyArrays, or None when fusion is not recording
+        (the caller runs the jitted entry on concrete values)."""
+        flat_in, in_treedef = jax.tree_util.tree_flatten(
+            (values, states, grads, lr))
+        sig = self._record_sigs.get(id(raw))
+        avals = []
+        try:
+            for v in flat_in:
+                avals.append((tuple(v.shape), np.dtype(v.dtype),
+                              bool(getattr(v, "weak_type", False))))
+        except (TypeError, AttributeError):
+            return None  # a non-array leaf slipped in: concrete path
+        avals = tuple(avals)
+        if sig is None or sig[0] != (in_treedef, avals):
+            structs = [jax.ShapeDtypeStruct(s, d, weak_type=w)
+                       for (s, d, w) in avals]
+
+            def natural(*leaves, _raw=raw, _td=in_treedef):
+                v, s, g, l = jax.tree_util.tree_unflatten(_td, list(leaves))
+                return _raw(v, s, g, l)
+
+            def call(*leaves):
+                return tuple(jax.tree_util.tree_flatten(
+                    natural(*leaves))[0])
+
+            try:
+                out_struct = jax.eval_shape(natural, *structs)  # tracelint: ok[suspend-audit] raw fused update is pure jnp (same contract as _build_step_fn)
+            except Exception:  # noqa: BLE001 — any abstract-eval issue
+                # (exotic state leaf, shape error): decline, never break
+                # the step; the concrete path raises the genuine error
+                return None
+            out_leaves, out_td = jax.tree_util.tree_flatten(out_struct)
+            out_avals = tuple(
+                (tuple(o.shape), np.dtype(o.dtype),
+                 bool(getattr(o, "weak_type", False)))
+                for o in out_leaves)
+            sig = ((in_treedef, avals), call, out_avals, out_td,
+                   next(_step_serial))
+            self._record_sigs[id(raw)] = sig
+        _, call, out_avals, out_td, serial = sig
+        key = ("opt.fused_step", type(self).__name__, serial, in_treedef)
+        lazy = _fusion.record_call(key, call, flat_in, out_avals,
+                                   f"opt.{type(self).__name__}")
+        if lazy is None:
+            return None
+        return jax.tree_util.tree_unflatten(out_td, lazy)
+
     def step(self):
         params = [p for p in self._param_list
                   if not p.stop_gradient and p._grad is not None
                   and getattr(p, "trainable", True)]
         if not params:
             return
-        entry, built = self._entry_for(params)
+        (entry, raw), built = self._entry_for(params)
         values = [p._value for p in params]
         states = [self._states_for(p) for p in params]
         grads = [p._grad._value.astype(
             jnp.float32 if "master" in s else p._value.dtype)
             for p, s in zip(params, states)]
+        lr = jnp.asarray(self.get_lr(), jnp.float32)
+        # PADDLE_TPU_FUSION_OPT_STEP: defer the update into the lazy
+        # trace (one flush per step, at the caller's host read). The
+        # first step of a fresh entry still takes the concrete path —
+        # it must record the warm-start signature on real arrays.
+        if _fuse_step[0] and _fusion.fusion_enabled() and \
+                not built and self._step_recorded:
+            out = self._record_step(raw, values, states, grads, lr)
+            if out is not None:
+                new_vals, new_states = out
+                for p, nv, ns in zip(params, new_vals, new_states):
+                    p._value = nv
+                    self._accumulators[id(p)] = ns
+                self._global_step += 1
+                return
         # the fused multi-tensor step is the train step's natural
         # trace-fusion flush boundary: the casts above were RECORDED
         # (not executed) when fusion is on, so the first _concrete
@@ -260,9 +364,8 @@ class Optimizer:
         # and the rest are lookups. Handing still-lazy leaves to the
         # jitted entry instead would defeat pjit's C++ arg cache and
         # retrace the optimizer step every call.
-        values = [_concrete(v) for v in values]
-        grads = [_concrete(g) for g in grads]
-        lr = jnp.asarray(self.get_lr(), jnp.float32)
+        values = [_concrete(v) for v in values]  # fuselint: ok[FL001] the reviewed per-step flush boundary (PADDLE_TPU_FUSION_OPT_STEP defers it)
+        grads = [_concrete(g) for g in grads]  # fuselint: ok[FL001] see above — one intentional materialize per step
         # first step of a freshly built OR warm-started entry (built is
         # False after warm_start pre-built it): trace + compile/disk
         # load happens now — attribute the time and record the
@@ -310,7 +413,7 @@ class Optimizer:
                   if not p.stop_gradient and getattr(p, "trainable", True)]
         n = 0
         if params:
-            entry, _ = self._entry_for(params)
+            (entry, _raw), _ = self._entry_for(params)
             n += _warmup.prewarm_program(self._program_name(), entry)
             if n:
                 # the recorded signature already covered this optimizer;

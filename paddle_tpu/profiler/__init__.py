@@ -241,6 +241,18 @@ class Profiler:
                 print("  flush reasons: "
                       + ", ".join(f"{k}: {v}" for k, v in
                                   sorted(fus["flushes"].items())))
+            sites = fus.get("flush_sites") or {}
+            if sites:
+                # WHERE the fused program keeps being cut: the top
+                # forcing sites across reasons (fuselint's runtime
+                # cross-reference reads the same table)
+                flat = sorted(
+                    ((n, f"{site} ({reason})")
+                     for reason, ss in sites.items()
+                     for site, n in ss.items()),
+                    reverse=True)[:5]
+                print("  top flush sites: "
+                      + ", ".join(f"{lbl}: {n}" for n, lbl in flat))
             if fus.get("fallbacks") or fus.get("demotions"):
                 print(f"  degraded: {fus.get('fallbacks', 0)} fused "
                       f"fallbacks, {fus.get('demotions', 0)} ops learned "

@@ -1217,6 +1217,17 @@ def sync_runtime_metrics():
                        "fusion trace flushes", ("reason",))
         for reason, n in (fus.get("flushes") or {}).items():
             c_fl.labels(reason=reason).set(n)
+        # flush-site attribution (fuselint --verify-runtime's runtime
+        # half): per (reason, forcing code site) counts; the per-reason
+        # sums reconcile exactly with paddle_tpu_fusion_flushes_total
+        # by construction (core/fusion.py bounds sites per reason and
+        # folds overflow into "<other>")
+        c_site = counter("paddle_tpu_fusion_flush_reason_total",
+                         "fusion flushes attributed to the code site "
+                         "that forced them", ("reason", "site"))
+        for reason, sites in (fus.get("flush_sites") or {}).items():
+            for site, n in sites.items():
+                c_site.labels(reason=reason, site=site).set(n)
         counter("paddle_tpu_fusion_recorded_ops_total",
                 "eager ops deferred into fusion traces").set(
             fus.get("recorded_ops", 0))
@@ -1310,6 +1321,7 @@ METRIC_NAMES = (
     "paddle_tpu_dispatch_warming_total",
     "paddle_tpu_dispatch_manifest_preloads_total",
     "paddle_tpu_fusion_flushes_total",
+    "paddle_tpu_fusion_flush_reason_total",
     "paddle_tpu_fusion_recorded_ops_total",
     "paddle_tpu_fusion_flushed_ops_total",
     "paddle_tpu_op_hits_total",

@@ -52,7 +52,7 @@ def _suspended(fn, args=()):
         "creating parameters inside a static.nn control-flow branch is not "
         "supported: build layers outside and call them from the branch")
     try:
-        with ag.no_grad(), _dispatch.suspend():
+        with ag.no_grad(), _dispatch.suspend():  # fuselint: ok[FL004] static-graph recording runs eagerly on dummy values by contract
             out = fn(*[Tensor(a) for a in args])
     finally:
         ag._static_recorder = old

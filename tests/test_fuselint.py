@@ -1,0 +1,585 @@
+"""fuselint: static fusion-barrier analyzer on the shared staticlib
+core, plus the runtime cross-reference machinery.
+
+Locks the ISSUE-11 acceptance surface:
+  * fixture detections for all 7 rules (FL001–FL007);
+  * precision controls that must NOT fire (shape/dtype/ndim reads —
+    LazyArray serves them eagerly, host-container truthiness, the
+    sanctioned fusion.lazy_* routes, eager-only non-loop code, waived
+    sites);
+  * the CLI exit-code contract and baseline freshness of the shipped
+    tree;
+  * SARIF output round-trips for all three linters;
+  * the unified tools/staticcheck.py entry point;
+  * the --verify-runtime cross-reference (unit-level, no subprocess);
+  * the staticlib-growth regression: tracelint AND threadlint still
+    analyze the tree to BYTE-IDENTICAL baselines.
+"""
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+from tools.fuselint import analyzer  # noqa: E402
+from tools.staticlib import baseline as slib_baseline  # noqa: E402
+
+
+# ---------------------------------------------------------------------------
+# fixture code exercising every rule
+
+FIXTURE = textwrap.dedent('''
+    import numpy as np
+    import jax.numpy as jnp
+    import logging
+    import paddle
+    from paddle import tensor as T
+    from paddle_tpu.core.fusion import lazy_add
+    from paddle_tpu.core import dispatch
+
+    log = logging.getLogger(__name__)
+
+
+    def train_loop(data, model):
+        for batch in data:
+            loss = paddle.mean(model(batch))
+            loss.backward()
+            host = float(loss)             # FL001: per-step flush
+            arr = loss.numpy()             # FL001: per-step flush
+            print(loss)                    # FL005: per-step print
+            log.info("loss %s", loss)      # FL005: per-step log
+            msg = f"step loss {loss}"      # FL005: per-step f-string
+            if loss > 0:                   # FL002: bool() on a tensor
+                pass
+            # precision controls: LazyArray serves these eagerly
+            n = loss.shape
+            d = loss.dtype
+            k = loss.ndim
+            if len(loss.shape) > 1:        # control: sanitized, clean
+                pass
+            waived = float(loss)  # fuselint: ok[FL001] reviewed sync
+        return host, arr, msg, n, d, k, waived
+
+
+    def eager_only(x):
+        y = paddle.tanh(x)
+        return float(y)                    # control: not in a loop
+
+
+    def host_counter_loop(items):
+        total = 0
+        for it in items:
+            total += 1                     # control: host ints only
+            if total > 3:                  # control: host branch
+                break
+        return total
+
+
+    @dispatch.non_jittable
+    def value_dependent_op(v):             # FL003: declared barrier
+        return v
+
+
+    def traced_region(fn, x):
+        with dispatch.suspend():           # FL004: mandatory flush
+            return fn(x)
+
+
+    def waived_region(fn, x):
+        with dispatch.suspend():  # fuselint: ok[FL004] reviewed boundary
+            return fn(x)
+
+
+    def run_backward(nodes, cot):
+        for node in nodes:
+            cot = jnp.maximum(cot, 0)      # FL006: raw jnp on cotangent
+            cot = cot + 1                  # FL006: bare add escape
+            cot = lazy_add(cot, 1)         # control: sanctioned route
+        return cot
+
+
+    def huge_unrolled(x):
+        for i in range(300):               # FL007: 300 ops >= max cap
+            x = paddle.tanh(x)
+        return x
+
+
+    def short_loop(x):
+        for i in range(4):                 # control: tiny trace
+            x = paddle.tanh(x)
+        return x
+''')
+
+MANIFEST_FIXTURE = textwrap.dedent('''
+    MANIFEST_VERSION = 1
+    UNJITTABLE = {
+        ("fixture_fuse.py", "value_dependent_op", 999):
+            "TL001 host-materialize",
+    }
+''')
+
+
+@pytest.fixture(scope="module")
+def fixture_findings(tmp_path_factory):
+    d = tmp_path_factory.mktemp("fuselint_fixture")
+    p = d / "fixture_fuse.py"
+    p.write_text(FIXTURE)
+    mp = d / "_manifest_fixture.py"
+    mp.write_text(MANIFEST_FIXTURE)
+    findings, errors = analyzer.analyze_paths([str(p)],
+                                              manifest_path=str(mp))
+    assert not errors
+    return findings
+
+
+def _hits(findings, rule, where=""):
+    return [f for f in findings
+            if f.rule == rule and where in f.func and not f.suppressed]
+
+
+# -- detections (all 7 rules) -------------------------------------------------
+
+def test_all_seven_rules_detect_on_fixture(fixture_findings):
+    rules = {f.rule for f in fixture_findings if not f.suppressed}
+    assert {"host-materialize-in-loop", "data-dependent-branch",
+            "known-demotion-barrier", "suspend-region-entry",
+            "per-step-side-effect", "backward-path-escape",
+            "trace-length-hazard"} <= rules, rules
+
+
+def test_fl001_host_materialize_in_loop(fixture_findings):
+    hits = _hits(fixture_findings, "host-materialize-in-loop",
+                 "train_loop")
+    syms = {f.symbol for f in hits}
+    assert "float:loss" in syms and ".numpy" in syms, syms
+    assert all(f.severity == "error" for f in hits)
+
+
+def test_fl002_data_dependent_branch(fixture_findings):
+    hits = _hits(fixture_findings, "data-dependent-branch", "train_loop")
+    assert hits and hits[0].symbol == "if:loss"
+
+
+def test_fl003_known_demotion_barrier(fixture_findings):
+    hits = _hits(fixture_findings, "known-demotion-barrier")
+    syms = {f.symbol for f in hits}
+    # both halves: the @non_jittable decoration AND the manifest entry
+    assert "non_jittable:value_dependent_op" in syms, syms
+    assert "manifest:value_dependent_op" in syms, syms
+
+
+def test_fl004_suspend_region_entry(fixture_findings):
+    hits = _hits(fixture_findings, "suspend-region-entry",
+                 "traced_region")
+    assert hits and hits[0].symbol.startswith("suspend:")
+
+
+def test_fl005_per_step_side_effect(fixture_findings):
+    hits = _hits(fixture_findings, "per-step-side-effect", "train_loop")
+    syms = {f.symbol for f in hits}
+    assert "print:loss" in syms, syms
+    assert "log:loss" in syms, syms
+    assert "fstr:loss" in syms, syms
+
+
+def test_fl006_backward_path_escape(fixture_findings):
+    hits = _hits(fixture_findings, "backward-path-escape",
+                 "run_backward")
+    syms = {f.symbol for f in hits}
+    assert "escape:jnp.maximum" in syms, syms
+    assert "add:cot" in syms, syms
+
+
+def test_fl007_trace_length_hazard(fixture_findings):
+    hits = _hits(fixture_findings, "trace-length-hazard",
+                 "huge_unrolled")
+    assert hits and hits[0].symbol == "ops~300"
+    assert hits[0].confidence == "definite"
+
+
+# -- precision controls -------------------------------------------------------
+
+def test_shape_dtype_ndim_reads_are_clean(fixture_findings):
+    """The FL002 precision contract: LazyArray serves shape/dtype/ndim
+    (and len() over them) from memoized avals with no flush — none of
+    those reads may produce a finding."""
+    for f in fixture_findings:
+        assert not any(tok in f.symbol for tok in ("n", "d", "k")
+                       if f.symbol in (f"if:{tok}",)), f.symbol
+    branch_hits = _hits(fixture_findings, "data-dependent-branch",
+                        "train_loop")
+    assert {f.symbol for f in branch_hits} == {"if:loss"}
+
+
+def test_eager_only_non_loop_code_is_clean(fixture_findings):
+    assert not [f for f in fixture_findings
+                if "eager_only" in f.func and not f.suppressed]
+
+
+def test_host_only_loop_is_clean(fixture_findings):
+    assert not [f for f in fixture_findings
+                if "host_counter_loop" in f.func and not f.suppressed]
+
+
+def test_sanctioned_lazy_route_is_clean(fixture_findings):
+    assert not [f for f in fixture_findings
+                if f.rule == "backward-path-escape"
+                and "lazy_add" in f.symbol]
+
+
+def test_short_loop_below_cap_is_clean(fixture_findings):
+    assert not [f for f in fixture_findings
+                if "short_loop" in f.func and not f.suppressed]
+
+
+def test_waived_sites_are_suppressed_not_new(fixture_findings):
+    waived = [f for f in fixture_findings
+              if "waived_region" in f.func or (
+                  "train_loop" in f.func and f.line and f.suppressed)]
+    assert any(f.rule == "suspend-region-entry" and f.suppressed
+               for f in fixture_findings if "waived_region" in f.func)
+    assert any(f.rule == "host-materialize-in-loop" and f.suppressed
+               for f in fixture_findings if "train_loop" in f.func)
+    assert waived
+
+
+def test_fingerprints_are_line_number_free(tmp_path):
+    (tmp_path / "a.py").write_text(FIXTURE)
+    (tmp_path / "b.py").write_text("# unrelated leading comment\n"
+                                   + FIXTURE)
+    fa, _ = analyzer.analyze_paths([str(tmp_path / "a.py")])
+    fb, _ = analyzer.analyze_paths([str(tmp_path / "b.py")])
+    fp_a = sorted(f.fingerprint().split("|", 2)[2] for f in fa)
+    fp_b = sorted(f.fingerprint().split("|", 2)[2] for f in fb)
+    assert fp_a == fp_b
+
+
+def test_machinery_modules_are_exempt():
+    """core/fusion.py and core/dispatch.py ARE the flush protocol; their
+    internal concrete()/materialize calls must never self-flag."""
+    for suffix in ("fusion.py", "dispatch.py"):
+        path = os.path.join(REPO_ROOT, "paddle_tpu", "core", suffix)
+        findings, _ = analyzer.analyze_paths([path])
+        assert not findings, [(f.rule, f.line) for f in findings]
+
+
+# -- the shipped tree ---------------------------------------------------------
+
+def test_shipped_baseline_is_fresh():
+    """The checked-in baseline matches what the analyzer produces today
+    (no stale entries, no unbaselined findings)."""
+    findings, errors = analyzer.analyze_paths(
+        [os.path.join(REPO_ROOT, "paddle_tpu")])
+    assert not errors
+    bl = slib_baseline.load_baseline(
+        os.path.join(REPO_ROOT, "tools", "fuselint", "baseline.json"))
+    new, baselined, _sup, _info, stale = slib_baseline.partition(
+        findings, bl)
+    assert not new, [(f.path, f.rule, f.symbol) for f in new]
+    assert not stale, stale
+
+
+def test_step_path_barriers_are_reviewed():
+    """The ISSUE-11 triage contract: every barrier in the default
+    train-step path (optimizer concretize boundary, eager-backward
+    fallback, the hapi suspend) carries a reviewed inline waiver."""
+    opt = os.path.join(REPO_ROOT, "paddle_tpu", "optimizer",
+                       "optimizer.py")
+    findings, _ = analyzer.analyze_paths([opt])
+    step = [f for f in findings if f.func == "Optimizer.step"
+            and f.rule == "host-materialize-in-loop"]
+    assert step and all(f.suppressed for f in step), [
+        (f.line, f.suppressed) for f in step]
+    ag = os.path.join(REPO_ROOT, "paddle_tpu", "core", "autograd.py")
+    findings, _ = analyzer.analyze_paths([ag])
+    assert all(f.suppressed for f in findings
+               if f.rule in ("host-materialize-in-loop",
+                             "known-demotion-barrier")), [
+        (f.line, f.rule) for f in findings if not f.suppressed]
+
+
+# -- CLI contract -------------------------------------------------------------
+
+def _run_cli(*args):
+    return subprocess.run(
+        [sys.executable, "-m", "tools.fuselint", *args],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=300)
+
+
+def test_cli_clean_tree_exits_zero():
+    r = _run_cli("paddle_tpu", "--fail-stale")
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+def test_cli_synthetic_violation_fails(tmp_path):
+    pkg = tmp_path / "synthpkg"
+    pkg.mkdir()
+    (pkg / "hot.py").write_text(textwrap.dedent('''
+        import paddle
+
+
+        def loop(data, model):
+            for batch in data:
+                loss = paddle.mean(model(batch))
+                print(float(loss))
+    '''))
+    r = _run_cli(str(pkg))
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "FL001" in r.stdout
+
+
+def test_cli_write_baseline_then_clean(tmp_path):
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "hot.py").write_text(textwrap.dedent('''
+        import paddle
+
+
+        def loop(data, model):
+            for batch in data:
+                loss = paddle.mean(model(batch))
+                print(float(loss))
+    '''))
+    bl = tmp_path / "baseline.json"
+    assert _run_cli(str(pkg), "--baseline", str(bl)).returncode == 1
+    assert _run_cli(str(pkg), "--baseline", str(bl),
+                    "--write-baseline").returncode == 0
+    r = _run_cli(str(pkg), "--baseline", str(bl))
+    assert r.returncode == 0, r.stdout
+    assert "baselined" in r.stdout
+    # fixing the debt leaves a stale entry: --fail-stale gates on it
+    (pkg / "hot.py").write_text("def loop():\n    return 0\n")
+    assert _run_cli(str(pkg), "--baseline", str(bl)).returncode == 0
+    r = _run_cli(str(pkg), "--baseline", str(bl), "--fail-stale")
+    assert r.returncode == 1
+    assert "stale" in r.stdout
+
+
+# -- SARIF (shared staticlib exporter, all three linters) ---------------------
+
+def _assert_sarif_shape(doc, tool, want_rules):
+    assert doc["version"] == "2.1.0"
+    run = doc["runs"][0]
+    assert run["tool"]["driver"]["name"] == tool
+    rule_ids = {r["id"] for r in run["tool"]["driver"]["rules"]}
+    assert want_rules <= rule_ids, rule_ids
+    for res in run["results"]:
+        assert res["ruleId"] in rule_ids
+        loc = res["locations"][0]["physicalLocation"]
+        assert loc["artifactLocation"]["uri"]
+        assert loc["region"]["startLine"] >= 1
+        assert res["partialFingerprints"]["staticlibFingerprint/v1"]
+    return run
+
+
+def test_sarif_round_trip_fuselint(tmp_path, fixture_findings):
+    """The SARIF report reproduces the analyzer's findings: every
+    non-info finding appears once, waived findings carry inSource
+    suppressions, and fingerprints survive the round trip."""
+    d = tmp_path / "fx"
+    d.mkdir()
+    (d / "fixture_fuse.py").write_text(FIXTURE)
+    out = tmp_path / "fuselint.sarif"
+    r = _run_cli(str(d), "--no-baseline", "--sarif", str(out))
+    assert r.returncode == 1  # new findings on the fixture
+    doc = json.loads(out.read_text())
+    run = _assert_sarif_shape(doc, "fuselint",
+                              {"FL001", "FL002", "FL005"})
+    sarif_fps = {res["partialFingerprints"]["staticlibFingerprint/v1"]
+                 for res in run["results"]}
+    live, _ = analyzer.analyze_paths([str(d)])  # same root as the CLI
+    assert {f.fingerprint() for f in live} == sarif_fps
+    suppressed = [res for res in run["results"]
+                  if res.get("suppressions")]
+    assert suppressed and all(
+        s["suppressions"][0]["kind"] == "inSource" for s in suppressed)
+
+
+def test_sarif_output_tracelint_and_threadlint(tmp_path):
+    for tool, rule in (("tracelint", "TL001"), ("threadlint", "CL001")):
+        out = tmp_path / f"{tool}.sarif"
+        r = subprocess.run(
+            [sys.executable, "-m", f"tools.{tool}", "paddle_tpu",
+             "--sarif", str(out)],
+            cwd=REPO_ROOT, capture_output=True, text=True, timeout=300)
+        assert r.returncode == 0, r.stdout + r.stderr
+        doc = json.loads(out.read_text())
+        _assert_sarif_shape(doc, tool, {rule})
+
+
+# -- staticcheck unified entry point ------------------------------------------
+
+def test_staticcheck_runs_all_three_clean(tmp_path):
+    out = tmp_path / "combined.json"
+    r = subprocess.run(
+        [sys.executable, "tools/staticcheck.py", "paddle_tpu",
+         "--json", str(out)],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=600)
+    assert r.returncode == 0, r.stdout + r.stderr
+    doc = json.loads(out.read_text())
+    assert doc["staticcheck"]["clean"] is True
+    assert set(doc["staticcheck"]["ran"]) == {
+        "tracelint", "threadlint", "fuselint"}
+    for tool in ("tracelint", "threadlint", "fuselint"):
+        assert doc["tools"][tool]["summary"]["new"] == 0
+        assert doc["tools"][tool]["exit_code"] == 0
+
+
+def test_staticcheck_fails_on_violation(tmp_path):
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "bad.py").write_text(textwrap.dedent('''
+        import threading
+        import paddle
+
+        _state = {"n": 0}
+
+
+        def _worker():
+            _state["n"] += 1
+
+
+        def read():
+            return _state["n"]
+
+
+        def launch():
+            threading.Thread(target=_worker).start()
+
+
+        def loop(data, model):
+            for batch in data:
+                print(float(paddle.mean(model(batch))))
+    '''))
+    r = subprocess.run(
+        [sys.executable, "tools/staticcheck.py", str(pkg)],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=600)
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "threadlint" in r.stderr and "fuselint" in r.stderr
+
+
+# -- verify-runtime cross-reference (unit level) ------------------------------
+
+def test_cross_reference_confirms_and_reports_gaps(fixture_findings):
+    from tools.fuselint.verify import cross_reference
+
+    f = next(f for f in fixture_findings
+             if f.rule == "host-materialize-in-loop"
+             and f.symbol == "float:loss")
+    flush_sites = {
+        "materialize": {
+            # exactly at the static finding's line: the closest match
+            f"{f.path}:{f.line}": 5,
+            # an in-tree site far from every finding: a recall gap
+            f"{f.path}:9999": 2,
+            # a driver-script site: external, never a gap
+            "my_train.py:33": 1,
+        },
+    }
+    rep = cross_reference(fixture_findings, flush_sites,
+                          roots=(f.path,))
+    confirmed_fps = {c["fingerprint"] for c in rep["confirmed"]}
+    assert f.fingerprint() in confirmed_fps
+    assert len(rep["runtime_only"]) == 1
+    assert rep["runtime_only"][0]["site"].endswith(":9999")
+    assert len(rep["external_sites"]) == 1
+    assert rep["external_sites"][0]["site"] == "my_train.py:33"
+    # static_only counts FINDINGS whose fingerprint was not confirmed
+    # (the float:loss fingerprint covers both the live and the waived
+    # occurrence, so count by fingerprint membership, not by entry)
+    assert rep["static_only"] == sum(
+        1 for x in fixture_findings
+        if x.fingerprint() not in confirmed_fps)
+
+
+# -- staticlib growth regressions ---------------------------------------------
+
+def test_tracelint_baseline_byte_identical():
+    from tools.tracelint import analyzer as t_analyzer
+    from tools.tracelint import baseline as t_baseline
+
+    findings, errors = t_analyzer.analyze_paths(
+        [os.path.join(REPO_ROOT, "paddle_tpu")])
+    assert not errors
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as td:
+        out = os.path.join(td, "baseline.json")
+        t_baseline.write_baseline(out, findings)
+        with open(out, "rb") as f1, open(
+                os.path.join(REPO_ROOT, "tools", "tracelint",
+                             "baseline.json"), "rb") as f2:
+            assert f1.read() == f2.read()
+
+
+def test_threadlint_baseline_byte_identical():
+    from tools.threadlint import analyzer as c_analyzer
+    from tools.threadlint.__main__ import _COMMENT
+
+    findings, errors = c_analyzer.analyze_paths(
+        [os.path.join(REPO_ROOT, "paddle_tpu")])
+    assert not errors
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as td:
+        out = os.path.join(td, "baseline.json")
+        slib_baseline.write_baseline(out, findings, _COMMENT)
+        with open(out, "rb") as f1, open(
+                os.path.join(REPO_ROOT, "tools", "threadlint",
+                             "baseline.json"), "rb") as f2:
+            assert f1.read() == f2.read()
+
+
+def test_all_three_tools_share_the_staticlib_finding_record():
+    from tools.fuselint.analyzer import Finding as FlFinding
+    from tools.staticlib.findings import Finding as Base
+    from tools.threadlint.analyzer import Finding as ClFinding
+    from tools.tracelint.analyzer import Finding as TlFinding
+
+    for cls in (TlFinding, ClFinding, FlFinding):
+        assert issubclass(cls, Base)
+    assert len({id(TlFinding.RULES), id(ClFinding.RULES),
+                id(FlFinding.RULES)}) == 3
+
+
+def test_loop_context_tracking():
+    """The staticlib growth this PR shipped: ScopeIndex.enclosing_loops
+    and const_range."""
+    import ast
+
+    from tools.staticlib.astnav import ScopeIndex, const_range
+
+    tree = ast.parse(textwrap.dedent('''
+        def f(xs):
+            a = 1
+            for x in xs:
+                b = 2
+                while True:
+                    c = 3
+            d = [y for y in xs]
+
+            def nested():
+                e = 4
+    '''))
+    scopes = ScopeIndex(tree)
+    by_name = {}
+    for n in ast.walk(tree):
+        if isinstance(n, ast.Assign) and isinstance(
+                n.targets[0], ast.Name):
+            by_name[n.targets[0].id] = n
+    assert scopes.loop_depth(by_name["a"]) == 0
+    assert scopes.loop_depth(by_name["b"]) == 1
+    assert scopes.loop_depth(by_name["c"]) == 2
+    # a nested def's body is NOT in its definer's loops
+    assert scopes.loop_depth(by_name["e"]) == 0
+    rng = ast.parse("range(300)", mode="eval").body
+    assert const_range(rng) == 300
+    assert const_range(ast.parse("range(2, 12)", mode="eval").body) == 10
+    assert const_range(ast.parse("range(0, 10, 3)",
+                                 mode="eval").body) == 4
+    assert const_range(ast.parse("range(n)", mode="eval").body) is None

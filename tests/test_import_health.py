@@ -70,9 +70,15 @@ def test_jax_compat_exports(symbol):
     "tools.threadlint.rules",
     "tools.tracelint",
     "tools.tracelint.analyzer",
+    "tools.fuselint",
+    "tools.fuselint.analyzer",
+    "tools.fuselint.rules",
+    "tools.fuselint.verify",
+    "tools.staticcheck",
 ])
 def test_analysis_tooling_imports(name):
-    """The static-analysis stack (shared staticlib core + both
-    analyzers) must import cleanly — CI's lint gates run through these
-    modules, so an import break here silently disables the gates."""
+    """The static-analysis stack (shared staticlib core + all three
+    analyzers + the unified staticcheck entry) must import cleanly —
+    CI's lint gates run through these modules, so an import break here
+    silently disables the gates."""
     importlib.import_module(name)

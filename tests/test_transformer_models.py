@@ -181,14 +181,62 @@ class TestJitGenerate:
         m.eval()
         return m
 
+    # Cross-implementation numeric tolerance for the tie-aware parity
+    # check below. Measured drift between the two decode programs on
+    # this fixture is ~3e-2 in the logits (see the test docstring).
+    _XIMPL_TOL = 0.05
+
     def test_greedy_parity_with_eager(self):
+        """Tie-aware greedy parity: the jit decode's token choices must
+        be consistent with the eager path's logits up to documented
+        cross-implementation float drift.
+
+        Token-EXACT equality between the two decode implementations is
+        not well-defined (the pre-PR-11 form of this test): the
+        static-KV jitted decode and the eager growing-cache decode are
+        mathematically equivalent but compile to DIFFERENT XLA
+        programs (padded S=48 attention + lax.scan over stacked layers
+        vs exact-length attention + a Python layer loop), so f32
+        reduction orders differ; on this tiny random-init model the
+        pre-LN normalizations divide near-zero-variance activations,
+        amplifying that rounding noise to ~3e-2 in the logits, and
+        greedy argmax turns any near-tie into full token divergence
+        from that step on. Under the default env the per-op jit cache
+        happens to round like the jit decode and exact equality held;
+        under PADDLE_TPU_EAGER_JIT=0 plain eager rounds differently
+        and it reproducibly failed (ROADMAP pre-existing cluster).
+
+        So: teacher-force the jit path's output through ONE eager
+        forward and assert, per generated token, that (a) the chosen
+        token's eager logit is within tolerance of the eager argmax,
+        and (b) wherever eager's top-2 gap is decisive (> 2x the
+        tolerance) the tokens agree exactly."""
+        from paddle_tpu import tensor as T
+
         m = self._model()
         rng = np.random.RandomState(0)
-        ids = paddle.to_tensor(rng.randint(0, 97, (2, 7)))
-        out_jit = m.generate(ids, max_new_tokens=9, use_jit=True)
-        out_eager = m.generate(ids, max_new_tokens=9, use_jit=False)
-        np.testing.assert_array_equal(np.asarray(out_jit.numpy()),
-                                      np.asarray(out_eager.numpy()))
+        n_new, t0 = 9, 7
+        ids = paddle.to_tensor(rng.randint(0, 97, (2, t0)))
+        out_jit = m.generate(ids, max_new_tokens=n_new, use_jit=True)
+        toks = np.asarray(out_jit.numpy())
+        assert toks.shape == (2, t0 + n_new)
+        np.testing.assert_array_equal(toks[:, :t0],
+                                      np.asarray(ids.numpy()))
+        with paddle.no_grad():
+            hidden = m.gpt(paddle.to_tensor(toks[:, :-1]))
+            logits = T.matmul(hidden, m.gpt.wte.weight,
+                              transpose_y=True)
+        lg = np.asarray(logits._value)  # position p predicts token p+1
+        tol = self._XIMPL_TOL
+        for b in range(toks.shape[0]):
+            for step in range(n_new):
+                row = lg[b, t0 - 1 + step]
+                tok = toks[b, t0 + step]
+                top2 = np.sort(row)[-2:]
+                assert row[tok] >= row.max() - tol, (
+                    b, step, tok, float(row.max() - row[tok]))
+                if top2[1] - top2[0] > 2 * tol:
+                    assert tok == int(row.argmax()), (b, step)
 
     def test_decode_executable_reused(self):
         import jax

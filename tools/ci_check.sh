@@ -1,8 +1,9 @@
 #!/usr/bin/env bash
-# One-command static gate: tracelint + manifest freshness + import
-# health, plus the fast resilience/warm-start/telemetry/multihost
-# smokes and the cluster crash acceptance (~3 min total) — run before
-# pushing; CI runs the same line.
+# One-command static gate: staticcheck (tracelint + threadlint +
+# fuselint with their freshness gates) + fuselint runtime
+# cross-reference + import health, plus the fast resilience/warm-start/
+# fusion-parity/telemetry/multihost smokes and the cluster crash
+# acceptance (~4 min total) — run before pushing; CI runs the same line.
 #
 #   ./tools/ci_check.sh
 #
@@ -12,16 +13,16 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== tracelint (jit-safety static analysis + manifest freshness) =="
-# one invocation does both: reports/gates on new findings AND fails if
-# the checked-in unjittable manifest is stale
-JAX_PLATFORMS=cpu python -m tools.tracelint paddle_tpu --check-manifest
-
-echo "== threadlint (static concurrency analysis + baseline freshness) =="
-# gates on new concurrency findings AND (--fail-stale) on fixed debt
-# still sitting in the checked-in baseline — both directions must stay
-# fresh, exactly like the tracelint/manifest pair above
-JAX_PLATFORMS=cpu python -m tools.threadlint paddle_tpu --fail-stale
+echo "== staticcheck (tracelint + threadlint + fuselint + runtime anchor) =="
+# one command runs every static analyzer with its freshness gate:
+# tracelint (jit-safety + stale-manifest check), threadlint
+# (concurrency + stale-baseline check), fuselint (fusion barriers +
+# stale-baseline check) — new findings, parse errors, or stale debt in
+# any tool fail here. --verify-runtime rides on fuselint's SINGLE pass:
+# a child runs the bench MLP train step under fusion and the static
+# findings must cross-reference the runtime flush-site attribution
+# (>= 1 confirmed, no uncovered in-tree sites)
+JAX_PLATFORMS=cpu python tools/staticcheck.py paddle_tpu --verify-runtime
 
 echo "== import health (every submodule imports on CPU) =="
 JAX_PLATFORMS=cpu python -m pytest tests/test_import_health.py -q \
@@ -65,6 +66,17 @@ echo "== cluster crash-consistency acceptance (3-rank SIGKILL) =="
 JAX_PLATFORMS=cpu python -m pytest \
     "tests/test_cluster_resilience.py::test_cluster_kill9_mid_async_save_survivors_agree" \
     -q -m slow -p no:cacheprovider -p no:xdist -p no:randomly
+
+echo "== fusion parity slice (model families under PADDLE_TPU_EAGER_FUSION=1) =="
+# ROADMAP item 2's flip-the-default gate grows here: a representative
+# eager-path slice (transformer/gpt generate + autograd + op math +
+# fusion + amp) must pass with deferred execution ON. Parity gaps get
+# a skip-with-reason in the test and an entry in ROADMAP — never a
+# silent drop from this list.
+JAX_PLATFORMS=cpu PADDLE_TPU_EAGER_FUSION=1 python -m pytest \
+    tests/test_transformer_models.py tests/test_autograd.py \
+    tests/test_ops_math.py tests/test_fusion.py tests/test_amp.py \
+    -q -m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly
 
 echo "== telemetry smoke (event stream + prom export + schema gate) =="
 # a tiny fit must produce an event stream, a Prometheus textfile whose

@@ -1,0 +1,17 @@
+"""fuselint — static fusion-barrier analysis for the paddle_tpu
+deferred-execution (trace-fusion) engine.
+
+Third analyzer on the shared tools/staticlib core (after tracelint's
+jit-safety pass and threadlint's concurrency pass). Where tracelint
+audits what happens INSIDE an op body handed to jax.jit, fuselint
+audits the EAGER CALLER code around the dispatch layer: every host
+materialization, data-dependent Python branch, unjittable op sighting,
+suspend() region, per-step side effect, and trace-length hazard is a
+FUSION BARRIER — a point where the lazy trace core/fusion.py is
+accumulating must flush, shrinking the fused program back toward
+per-op dispatch. Making deferred execution THE execution engine
+(ROADMAP item 2) is gated on knowing where and why traces break;
+fuselint moves that discovery to lint time, and its --verify-runtime
+mode closes the loop against the flush-site attribution the runtime
+records (dispatch_stats()["fusion"]["flush_sites"]).
+"""

@@ -1,7 +1,8 @@
-"""CLI: python -m tools.threadlint <roots...> [options].
+"""CLI: python -m tools.fuselint <roots...> [options].
 
 Exit codes: 0 clean (or baselined-only), 1 new findings, parse errors,
-or (with --fail-stale) stale baseline entries, 2 usage error.
+(with --fail-stale) stale baseline entries, or a failed
+--verify-runtime cross-reference, 2 usage error.
 """
 from __future__ import annotations
 
@@ -18,19 +19,20 @@ from .rules import RULES
 
 DEFAULT_BASELINE = os.path.join(os.path.dirname(__file__), "baseline.json")
 
-_COMMENT = ("threadlint suppression baseline — regenerate with "
-            "`python -m tools.threadlint paddle_tpu "
+_COMMENT = ("fuselint suppression baseline — regenerate with "
+            "`python -m tools.fuselint paddle_tpu "
             "--write-baseline` after reviewing that every new "
-            "finding is intended debt, not a regression.")
+            "finding is an intended fusion boundary, not a "
+            "regression.")
 
 
 def build_parser():
     p = argparse.ArgumentParser(
-        prog="python -m tools.threadlint",
-        description="static concurrency/race analyzer for the "
-                    "paddle_tpu threaded runtime "
-                    "(see docs/THREADLINT.md)")
-    p.add_argument("roots", nargs="+",
+        prog="python -m tools.fuselint",
+        description="static fusion-barrier analyzer for the paddle_tpu "
+                    "deferred-execution engine "
+                    "(see docs/FUSELINT.md)")
+    p.add_argument("roots", nargs="*", default=["paddle_tpu"],
                    help="package dirs or files to analyze (paddle_tpu)")
     p.add_argument("--baseline", default=DEFAULT_BASELINE,
                    help=f"baseline file (default {DEFAULT_BASELINE})")
@@ -44,10 +46,22 @@ def build_parser():
     p.add_argument("--sarif", metavar="PATH",
                    help="also write a SARIF 2.1.0 report here (CI "
                         "code-scanning annotations)")
+    p.add_argument("--manifest-path", default=None,
+                   help="unjittable manifest for FL003 (default: "
+                        "<root>/core/_unjittable_manifest.py)")
     p.add_argument("--fail-stale", action="store_true",
                    help="exit nonzero on stale baseline entries too "
-                        "(CI freshness gate: fixed debt must be pruned "
-                        "with --write-baseline)")
+                        "(CI freshness gate)")
+    p.add_argument("--verify-runtime", action="store_true",
+                   help="additionally run a small fusion train step in "
+                        "a child process and cross-reference the "
+                        "static findings against the runtime's "
+                        "flush-site attribution "
+                        "(dispatch_stats()['fusion']['flush_sites'])")
+    p.add_argument("--verify-json", metavar="PATH",
+                   help="write the --verify-runtime report here")
+    p.add_argument("--verify-child", action="store_true",
+                   help=argparse.SUPPRESS)  # internal: the workload
     p.add_argument("-v", "--verbose", action="store_true",
                    help="itemize baselined/waived/info findings too")
     return p
@@ -55,12 +69,18 @@ def build_parser():
 
 def main(argv=None):
     args = build_parser().parse_args(argv)
+    if args.verify_child:
+        from .verify import run_child
+
+        run_child()
+        return 0
     for r in args.roots:
         if not os.path.exists(r):
-            print(f"threadlint: no such path: {r}", file=sys.stderr)
+            print(f"fuselint: no such path: {r}", file=sys.stderr)
             return 2
 
-    findings, errors = analyze_paths(args.roots)
+    findings, errors = analyze_paths(args.roots,
+                                     manifest_path=args.manifest_path)
 
     if args.write_baseline:
         if errors:
@@ -68,11 +88,11 @@ def main(argv=None):
             # drops their debt; the next clean run would gate on it
             for p, m in errors:
                 print(f"{p}: PARSE ERROR — {m}", file=sys.stderr)
-            print("threadlint: refusing to write a baseline while files "
+            print("fuselint: refusing to write a baseline while files "
                   "fail to parse", file=sys.stderr)
             return 1
         counts = write_baseline(args.baseline, findings, _COMMENT)
-        print(f"threadlint: baseline written to {args.baseline} "
+        print(f"fuselint: baseline written to {args.baseline} "
               f"({sum(counts.values())} findings, "
               f"{len(counts)} distinct fingerprints)")
         return 0
@@ -81,22 +101,34 @@ def main(argv=None):
     new, baselined, suppressed, info, stale = partition(findings, baseline)
 
     print(human_report(new, baselined, suppressed, info, stale, errors,
-                       tool="threadlint", rules=RULES,
+                       tool="fuselint", rules=RULES,
                        verbose=args.verbose))
     if args.json:
         write_json(args.json, json_report(new, baselined, suppressed, info,
                                           stale, errors, rules=RULES))
     if args.sarif:
         write_sarif(args.sarif, new, baselined, suppressed, info, errors,
-                    tool="threadlint", rules=RULES)
+                    tool="fuselint", rules=RULES)
+    rc = 0
     if new or errors:
-        return 1
-    if args.fail_stale and stale:
-        print("threadlint: stale baseline entries above — the debt was "
+        rc = 1
+    elif args.fail_stale and stale:
+        print("fuselint: stale baseline entries above — the debt was "
               "fixed; shrink the baseline with --write-baseline",
               file=sys.stderr)
-        return 1
-    return 0
+        rc = 1
+    if args.verify_runtime:
+        from .verify import run_verify
+
+        # findings carry paths relative to each root's PARENT — pass
+        # the same normalized names so in-tree/external classification
+        # matches the analysis
+        roots = [os.path.basename(os.path.normpath(r))
+                 for r in args.roots]
+        vrc = run_verify(findings, json_path=args.verify_json,
+                         roots=roots)
+        rc = rc or vrc
+    return rc
 
 
 if __name__ == "__main__":

@@ -1,0 +1,698 @@
+"""AST fusion-barrier analysis over the paddle_tpu eager caller surface.
+
+Where tracelint audits what happens INSIDE an op body that may reach
+`jax.jit`, fuselint audits the code AROUND the dispatch layer — the
+eager caller paths (train loops, optimizer steps, the backward tape,
+metric/callback plumbing) that consume tensor values while the
+trace-fusion engine (core/fusion.py) is trying to accumulate them into
+one fused program. Every host materialization, data-dependent branch,
+unjittable-op sighting, suspend() region, per-step side effect, and
+trace-length hazard is a **fusion barrier**: the pending trace flushes
+there, and the fused program shrinks back toward per-op dispatch.
+
+**Potential laziness** is a name-level taint (tools/staticlib/taint.py
+bound to the fusion sanitizer vocabulary): positional parameters
+without defaults are assumed potentially lazy, plus names assigned
+from tensor-producing calls (`paddle.*`/`T.*`/`F.*`, `apply()`,
+`to_tensor`, `._value`/`.grad` reads). Shape/dtype/ndim/len reads
+sanitize — LazyArray serves them from memoized avals without a flush,
+so they must never flag (the FL002 precision contract).
+
+**Evidence grading** keeps precision: a finding fires only when the
+function itself treats the value as a tensor (fed to paddle/T/F ops,
+`._value`/`.grad` access, `.backward()`/array-method calls, or
+assigned from a tensor producer). Residual false positives are
+absorbed by reviewed inline waivers (`# fuselint: ok[rule]`) and the
+checked fingerprint baseline, exactly like the two sibling analyzers —
+never by weakening detection.
+
+The pass is file-local and approximate and must never import the code
+it analyzes. The one cross-file input is the CHECKED-IN static
+unjittable manifest (core/_unjittable_manifest.py), read as AST data
+(ast.literal_eval, no import): ops tracelint proved trace-unsafe are
+reported as FL003 barriers at their definition site, where they will
+bite fusion — not rediscovered per-process at runtime.
+"""
+from __future__ import annotations
+
+import ast
+import os
+
+from ..staticlib import findings as _findings
+from ..staticlib.astnav import (
+    ScopeIndex, const_range, dotted, func_params,
+    iter_py_files as _iter_py_files, relpath as _relpath,
+    runtime_first_line,
+)
+from ..staticlib.callgraph import CallGraph
+from ..staticlib.taint import NameTaint
+from ..staticlib.waivers import suppressed as _waiver_suppressed
+from .rules import RULES
+
+__all__ = ["Finding", "analyze_file", "analyze_paths", "iter_py_files",
+           "load_unjittable_manifest", "DEFAULT_MAX_OPS"]
+
+SKIP_DIRS = {"__pycache__", ".git", "libs", "include"}
+TOOL = "fuselint"
+
+# the deferred-execution machinery itself: its concrete()/materialize
+# calls ARE the implementation of the flush protocol, not clients of it
+# (matched against the ABSOLUTE path so single-file analysis of
+# core/fusion.py is exempt too, while a fixture named fusion.py is not)
+MACHINERY_SUFFIXES = ("paddle_tpu/core/fusion.py",
+                      "paddle_tpu/core/dispatch.py")
+
+_FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+# ---------------------------------------------------------------------------
+# fusion sanitizer vocabulary
+
+# attribute reads LazyArray serves eagerly from its memoized aval —
+# these stay eager under fusion by construction and must NEVER flag
+STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "weak_type", "name",
+                "itemsize", "nbytes", "stop_gradient", "trainable",
+                "place", "is_leaf", "persistable", "type"}
+# calls whose result is host-static (no flush to compute)
+SANITIZER_CALLS = {"len", "isinstance", "issubclass", "type", "id",
+                   "hasattr", "callable", "getattr", "issubdtype",
+                   "result_type", "finfo", "iinfo", "aval_of",
+                   "enumerate", "zip", "range", "sorted", "reversed",
+                   # host container constructors: membership/truthiness
+                   # on their result is host work, never a flush
+                   "set", "frozenset", "dict",
+                   # the sanctioned deferred/concretize routes: their
+                   # RESULT is handled; routing through them is the fix
+                   # fuselint recommends, so it must not re-flag
+                   "lazy_add", "lazy_astype", "record_call", "concrete",
+                   "_concrete", "_raw",
+                   # pytree structure work is host-side bookkeeping
+                   "tree_flatten", "tree_unflatten", "tree_map",
+                   "tree_leaves", "tree_structure", "flatten_up_to"}
+# scalar coercions: each is a materialize (flush) on a lazy operand
+COERCIONS = {"float", "int", "bool", "complex"}
+HOST_METHODS = {"numpy", "item", "tolist"}
+NP_HOST_FUNCS = {"asarray", "array", "asanyarray", "ascontiguousarray"}
+EXPLICIT_CONCRETIZE = {"concrete", "_concrete"}
+
+# tensor-producing surfaces: a name bound from one of these is
+# potentially lazy even when no tainted value flowed in
+TENSOR_HEADS = {"paddle", "T", "F"}
+TENSOR_PRODUCERS = {"to_tensor", "Tensor", "apply", "_apply", "run_op"}
+TENSOR_ATTRS = {"_value", "grad", "_grad"}
+ARRAY_METHODS = {"astype", "reshape", "sum", "mean", "transpose", "ravel",
+                 "squeeze", "flatten", "min", "max", "dot", "backward",
+                 "clip", "detach", "cast", "numpy", "item", "tolist",
+                 "clear_grad", "cumsum", "prod", "abs", "norm"}
+
+# FL005 side-effect surfaces
+LOG_HEADS = {"logging", "logger", "log", "warnings"}
+LOG_METHODS = {"debug", "info", "warning", "warn", "error", "critical",
+               "exception"}
+STRINGIFY = {"str", "format", "repr"}
+
+# FL006 backward-path entry names (reachability seeds + name patterns)
+BACKWARD_SEEDS = {"run_backward", "backward", "grad"}
+BACKWARD_NAME_HINTS = ("pullback", "_add_cot", "_accum_leaf", "_eager",
+                      "bwd_fn", "vjp_call")
+RAW_ARRAY_HEADS = {"jnp", "np", "numpy", "jax", "lax"}
+
+DEFAULT_MAX_OPS = 256
+
+
+def _max_ops_threshold():
+    try:
+        return max(2, int(os.environ.get("PADDLE_TPU_FUSION_MAX_OPS",
+                                         str(DEFAULT_MAX_OPS))))
+    except ValueError:
+        return DEFAULT_MAX_OPS
+
+
+# ---------------------------------------------------------------------------
+# model
+
+class Finding(_findings.Finding):
+    """fuselint finding: the shared record bound to the FL catalog."""
+
+    RULES = RULES
+
+
+# ---------------------------------------------------------------------------
+# checked-in unjittable manifest, read as data (never imported)
+
+def load_unjittable_manifest(path):
+    """(path suffix, co_name, co_firstlineno) -> reason from the
+    generated manifest module, parsed as AST data. Missing/stale file
+    degrades to {} — FL003's manifest half just goes silent."""
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            tree = ast.parse(f.read())
+    except (OSError, SyntaxError, UnicodeDecodeError):
+        return {}
+    version = None
+    table = {}
+    for stmt in tree.body:
+        if not isinstance(stmt, ast.Assign):
+            continue
+        for t in stmt.targets:
+            if not isinstance(t, ast.Name):
+                continue
+            try:
+                if t.id == "MANIFEST_VERSION":
+                    version = ast.literal_eval(stmt.value)
+                elif t.id == "UNJITTABLE":
+                    table = ast.literal_eval(stmt.value)
+            except (ValueError, SyntaxError):
+                return {}
+    if version != 1 or not isinstance(table, dict):
+        return {}
+    return table
+
+
+# ---------------------------------------------------------------------------
+# per-function barrier analysis
+
+class _FnChecker:
+    def __init__(self, module, fnode):
+        self.m = module
+        self.fnode = fnode
+        self.scopes = module.scopes
+        self.qual = module.scopes.qualname(fnode)
+        self.func_name = (fnode.name if not isinstance(fnode, ast.Lambda)
+                          else "<lambda>")
+        self.func_line = runtime_first_line(fnode)
+
+        self.taint = NameTaint(fnode, static_attrs=STATIC_ATTRS,
+                               sanitizer_calls=SANITIZER_CALLS,
+                               coercions=COERCIONS,
+                               host_methods=HOST_METHODS)
+        # re-seed from scratch: the receiver objects (self/cls) are
+        # never themselves lazy arrays, and the constructor's propagate
+        # already spread their taint (`x = self._table.get(k)`) — reset
+        # to the param seeds minus self/cls, add names bound from
+        # tensor-producing expressions, and re-propagate once
+        seeds = set(func_params(fnode)[1]) - {"self", "cls"}
+        for n in self._body():
+            if isinstance(n, ast.Assign) and self._produces_tensor(n.value):
+                for t in n.targets:
+                    seeds.update(self._target_roots(t))
+        self.taint.tainted = seeds
+        self.taint.propagate()
+        self.taint.tainted -= {"self", "cls"}
+        self.evidence = self._collect_evidence()
+
+    @staticmethod
+    def _target_roots(t):
+        """The name(s) an assignment target BINDS — plain names and
+        tuple/list element names; for container-element stores
+        (`d[k] = v`, `obj.a = v`) the ROOT container only, never the
+        subscript-index names (`k` is not made a tensor by being a key
+        under one)."""
+        if isinstance(t, ast.Name):
+            return [t.id]
+        if isinstance(t, (ast.Tuple, ast.List)):
+            out = []
+            for e in t.elts:
+                out.extend(_FnChecker._target_roots(e))
+            return out
+        root = t
+        while isinstance(root, (ast.Attribute, ast.Subscript,
+                                ast.Starred)):
+            root = root.value
+        return [root.id] if isinstance(root, ast.Name) else []
+
+    def _body(self):
+        """Own-body nodes only: nested defs/lambdas are separate graph
+        functions and get their own checker — scanning them here too
+        would double-report every finding (taint propagation still sees
+        the full body via NameTaint's own iteration)."""
+        yield from CallGraph.body_nodes(self.fnode)
+
+    # -- tensor-ness --------------------------------------------------------
+    def _produces_tensor(self, expr):
+        if isinstance(expr, ast.Call):
+            d = dotted(expr.func)
+            if d and (d[0] in TENSOR_HEADS or d[-1] in TENSOR_PRODUCERS):
+                return True
+        if isinstance(expr, ast.Attribute) and expr.attr in TENSOR_ATTRS:
+            return True
+        return False
+
+    def _collect_evidence(self):
+        """Names this function itself treats as tensors."""
+        ev = set()
+        for n in self._body():
+            if isinstance(n, ast.Attribute) and isinstance(n.value, ast.Name):
+                if n.attr in TENSOR_ATTRS or n.attr in ARRAY_METHODS:
+                    ev.add(n.value.id)
+            elif isinstance(n, ast.Call):
+                d = dotted(n.func)
+                if d and (d[0] in TENSOR_HEADS
+                          or d[-1] in TENSOR_PRODUCERS):
+                    for a in list(n.args) + [kw.value for kw in n.keywords]:
+                        for nm in ast.walk(a):
+                            if isinstance(nm, ast.Name):
+                                ev.add(nm.id)
+            elif isinstance(n, ast.Assign) and \
+                    self._produces_tensor(n.value):
+                for t in n.targets:
+                    ev.update(self._target_roots(t))
+        return ev
+
+    def _hot(self, expr):
+        """Taint + evidence: the bar a finding must clear."""
+        if not self.taint.expr_tainted(expr):
+            return None
+        names = self.taint.taint_names(expr)
+        if any(nm in self.evidence for nm in names):
+            return names
+        # expression-level evidence without a named carrier
+        # (float(x.sum()) — the receiver method IS the evidence)
+        for n in ast.walk(expr):
+            if isinstance(n, ast.Attribute) and (
+                    n.attr in TENSOR_ATTRS or n.attr in ARRAY_METHODS):
+                return names or ["<expr>"]
+            if isinstance(n, ast.Call):
+                d = dotted(n.func)
+                if d and (d[0] in TENSOR_HEADS or d[-1] in TENSOR_PRODUCERS):
+                    return names or ["<expr>"]
+        return None
+
+    def _in_loop(self, node):
+        return bool(self.scopes.enclosing_loops(node))
+
+    # -- reporting ----------------------------------------------------------
+    def report(self, rule, node, message, symbol, confidence,
+               context="step-path"):
+        self.m.findings.append(Finding(
+            rule=rule, path=self.m.relpath, line=node.lineno,
+            col=node.col_offset, func=self.qual, func_name=self.func_name,
+            func_line=self.func_line, message=message, symbol=symbol,
+            severity=RULES[rule].severity, confidence=confidence,
+            context=context))
+
+    # -- FL001 / FL005 (loop-scoped) + FL002 --------------------------------
+    def run(self):
+        for n in self._body():
+            if isinstance(n, ast.Call):
+                self._check_call(n)
+            elif isinstance(n, ast.If):
+                self._check_branch(n, n.test, "if")
+            elif isinstance(n, ast.While):
+                self._check_branch(n, n.test, "while")
+            elif isinstance(n, ast.IfExp):
+                self._check_branch(n, n.test, "ternary")
+            elif isinstance(n, ast.Assert):
+                self._check_branch(n, n.test, "assert")
+            elif isinstance(n, ast.JoinedStr):
+                self._check_fstring(n)
+
+    def _check_call(self, n):
+        d = dotted(n.func)
+        in_loop = self._in_loop(n)
+        # FL001: scalar coercions on a lazy value, per iteration
+        if d and len(d) == 1 and d[0] in COERCIONS and n.args and in_loop:
+            names = self._hot(n.args[0])
+            if names:
+                self.report(
+                    "host-materialize-in-loop", n,
+                    f"{d[0]}() on a potentially-lazy tensor value "
+                    f"({', '.join(names)}) inside a loop — every "
+                    "iteration flushes the pending fused trace here; "
+                    "hoist the read out of the loop, batch it, or "
+                    "waive if the per-step sync is the contract "
+                    "(loss logging)",
+                    f"{d[0]}:{','.join(names)}", "definite")
+                return
+        # FL001: .numpy()/.item()/.tolist() per iteration
+        if isinstance(n.func, ast.Attribute) and \
+                n.func.attr in HOST_METHODS and in_loop:
+            base = n.func.value
+            if self._hot(base) or (
+                    isinstance(base, ast.Attribute)
+                    and base.attr in TENSOR_ATTRS):
+                self.report(
+                    "host-materialize-in-loop", n,
+                    f".{n.func.attr}() inside a loop forces a host "
+                    "transfer — a per-iteration flush point for the "
+                    "fused trace",
+                    f".{n.func.attr}", "definite")
+                return
+        # FL001: np.asarray & friends on a lazy value, per iteration
+        if d and len(d) >= 2 and d[0] in ("np", "numpy") and \
+                d[-1] in NP_HOST_FUNCS and in_loop:
+            hot = [nm for a in n.args for nm in (self._hot(a) or ())]
+            if hot:
+                self.report(
+                    "host-materialize-in-loop", n,
+                    f"{'.'.join(d)} materializes a potentially-lazy "
+                    f"value ({', '.join(hot)}) on host every iteration",
+                    ".".join(d), "definite")
+                return
+        # FL001: explicit concretize route — deliberate by definition,
+        # but each site is a flush boundary the audit must see (and the
+        # anchor --verify-runtime cross-references against)
+        if d and d[-1] in EXPLICIT_CONCRETIZE and n.args and in_loop:
+            self.report(
+                "host-materialize-in-loop", n,
+                f"{'.'.join(d)}() — explicit concretize inside a loop: "
+                "a deliberate flush boundary; keep it reviewed (waive "
+                "or baseline) so the fused-program extent stays an "
+                "intentional choice",
+                "concrete", "possible", context="explicit-materialize")
+            return
+        # FL004: suspend-region entry (machinery modules never reach
+        # here — run() gates the whole per-function pass)
+        if d and d[-1] == "suspend":
+            head = d[-2] if len(d) > 1 else ""
+            self.report(
+                "suspend-region-entry", n,
+                f"{'.'.join(d)}() — entering a suspend region flushes "
+                "the pending trace and records nothing until exit (a "
+                "mandatory fusion boundary); confirm it is intentional "
+                "with `# fuselint: ok[FL004]` after review",
+                f"suspend:{head or 'suspend'}".rstrip(":"),
+                "definite", context="suspend")
+            return
+        # FL005: side effects on tensor values per iteration
+        if in_loop:
+            self._check_side_effect(n, d)
+
+    def _check_side_effect(self, n, d):
+        is_print = d == ("print",)
+        is_log = bool(d) and (d[0] in LOG_HEADS
+                              or (len(d) > 1 and d[-1] in LOG_METHODS))
+        is_str = bool(d) and len(d) == 1 and d[0] in STRINGIFY
+        if not (is_print or is_log or is_str):
+            return
+        hot = []
+        for a in list(n.args) + [kw.value for kw in n.keywords]:
+            hot.extend(self._hot(a) or ())
+        if not hot:
+            return
+        kind = "print" if is_print else ("log" if is_log else d[0])
+        self.report(
+            "per-step-side-effect", n,
+            f"{kind}() of a potentially-lazy tensor value "
+            f"({', '.join(sorted(set(hot)))}) inside a loop — "
+            "stringification materializes, flushing the fused trace "
+            "every iteration; log a host scalar captured outside the "
+            "loop, throttle to every-N steps, or waive",
+            f"{kind}:{','.join(sorted(set(hot)))}", "definite")
+
+    def _check_fstring(self, n):
+        if not self._in_loop(n):
+            return
+        hot = []
+        for v in n.values:
+            if isinstance(v, ast.FormattedValue):
+                hot.extend(self._hot(v.value) or ())
+        if hot:
+            self.report(
+                "per-step-side-effect", n,
+                "f-string interpolates a potentially-lazy tensor value "
+                f"({', '.join(sorted(set(hot)))}) inside a loop — each "
+                "format materializes and flushes the fused trace",
+                f"fstr:{','.join(sorted(set(hot)))}", "definite")
+
+    def _check_branch(self, node, test, kind):
+        names = self._hot(test)
+        if not names:
+            return
+        self.report(
+            "data-dependent-branch", node,
+            f"`{kind}` on a potentially-lazy tensor value "
+            f"({', '.join(names)}) — __bool__ concretizes, flushing "
+            "the pending trace; compare host scalars, use jnp.where, "
+            "or waive if the branch is a deliberate sync point",
+            f"{kind}:{','.join(names)}", "definite",
+            context="control-flow")
+
+
+# ---------------------------------------------------------------------------
+# per-module driver
+
+class ModuleFusionAnalysis:
+    def __init__(self, path, root_parent, manifest=None):
+        self.path = path
+        self.relpath = _relpath(path, root_parent)
+        self.is_machinery = os.path.abspath(path).replace(
+            os.sep, "/").endswith(MACHINERY_SUFFIXES)
+        with open(path, "r", encoding="utf-8") as f:
+            self.src = f.read()
+        self.lines = self.src.splitlines()
+        self.tree = ast.parse(self.src, filename=path)
+        self.scopes = ScopeIndex(self.tree)
+        self.graph = CallGraph(self.tree, self.scopes)
+        self.manifest = manifest or {}
+        self.findings = []
+
+    def run(self):
+        if not self.is_machinery:
+            for qual, fnode in self.graph.functions.items():
+                _FnChecker(self, fnode).run()
+            self._check_manifest_barriers()        # FL003 (manifest half)
+            self._check_non_jittable_barriers()    # FL003 (decorator half)
+            self._check_backward_escapes()         # FL006
+            self._check_trace_length()             # FL007
+        for f in self.findings:
+            f.suppressed = _waiver_suppressed(self.lines, f.line, f.rule,
+                                              TOOL, RULES)
+        self.findings.sort(key=lambda f: (f.line, f.col, f.rule))
+        return self.findings
+
+    # -- FL003 --------------------------------------------------------------
+    def _check_manifest_barriers(self):
+        if not self.manifest:
+            return
+        rp = self.relpath
+        # manifest keys are paddle_tpu/-anchored suffixes; relpath is
+        # root_parent-relative, so a direct suffix match is exact
+        for (suffix, co_name, lineno), reason in sorted(
+                self.manifest.items()):
+            if not rp.endswith(suffix):
+                continue
+            self.findings.append(Finding(
+                rule="known-demotion-barrier", path=rp, line=lineno,
+                col=0, func=co_name, func_name=co_name, func_line=lineno,
+                message=f"`{co_name}` is in the static unjittable "
+                        f"manifest ({reason}) — under fusion every "
+                        "sighting is a forced flush point; make the op "
+                        "trace-safe to lift the barrier, or accept it "
+                        "(baseline) as a known fusion boundary",
+                symbol=f"manifest:{co_name}",
+                severity=RULES["known-demotion-barrier"].severity,
+                confidence="definite", context="manifest"))
+
+    def _check_non_jittable_barriers(self):
+        for n in ast.walk(self.tree):
+            if not isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            for dec in n.decorator_list:
+                dd = dotted(dec)
+                if dd and dd[-1] == "non_jittable":
+                    qual = self.scopes.qualname(n)
+                    self.findings.append(Finding(
+                        rule="known-demotion-barrier", path=self.relpath,
+                        line=n.lineno, col=n.col_offset, func=qual,
+                        func_name=n.name,
+                        func_line=runtime_first_line(n),
+                        message=f"@non_jittable op `{n.name}` — a "
+                                "declared trace-unsafe op is a forced "
+                                "flush point under fusion; every call "
+                                "site on a step path cuts the fused "
+                                "program here",
+                        symbol=f"non_jittable:{n.name}",
+                        severity=RULES["known-demotion-barrier"].severity,
+                        confidence="definite", context="non-jittable"))
+
+    # -- FL006 --------------------------------------------------------------
+    def _backward_quals(self):
+        seeds = [q for q in self.graph.functions
+                 if q.rsplit(".", 1)[-1] in BACKWARD_SEEDS]
+        reach = self.graph.reachable(seeds)
+        for q in self.graph.functions:
+            last = q.rsplit(".", 1)[-1]
+            if any(h in last for h in BACKWARD_NAME_HINTS) or \
+                    any(h in q for h in ("pullback",)):
+                reach.add(q)
+        return reach
+
+    def _check_backward_escapes(self):
+        # only modules that participate in the lazy protocol carry the
+        # backward tape (importing/naming fusion.lazy_* is the marker);
+        # elsewhere a jnp call in a `backward` helper is ordinary eager
+        if "lazy_" not in self.src and "record_call" not in self.src:
+            return
+        for qual in sorted(self._backward_quals()):
+            fnode = self.graph.functions.get(qual)
+            if fnode is None:
+                continue
+            checker = _FnChecker(self, fnode)
+            for n in CallGraph.body_nodes(fnode):
+                if isinstance(n, ast.Call):
+                    d = dotted(n.func)
+                    if not d or d[0] not in RAW_ARRAY_HEADS:
+                        continue
+                    if d[-1] in SANITIZER_CALLS or len(d) > 1 and \
+                            d[1] == "tree_util":
+                        continue
+                    # taint alone suffices here: backward-path
+                    # functions are pre-qualified by reachability, and
+                    # cotangents are raw arrays (no Tensor-evidence
+                    # surface to observe)
+                    hot = [nm for a in n.args
+                           if checker.taint.expr_tainted(a)
+                           for nm in (checker.taint.taint_names(a)
+                                      or ["<expr>"])]
+                    if not hot:
+                        continue
+                    checker.report(
+                        "backward-path-escape", n,
+                        f"{'.'.join(d)} on a potentially-lazy cotangent "
+                        f"({', '.join(sorted(set(hot)))}) inside the "
+                        "backward tape path — __jax_array__ "
+                        "materializes it, flushing the fused "
+                        "fwd+bwd program mid-backward; route through "
+                        "fusion.lazy_*/record_call, or concrete() "
+                        "deliberately",
+                        f"escape:{'.'.join(d)}", "definite",
+                        context="backward")
+                elif isinstance(n, ast.BinOp) and \
+                        isinstance(n.op, ast.Add):
+                    t = checker.taint
+                    lhot = t.taint_names(n.left) \
+                        if t.expr_tainted(n.left) else []
+                    rhot = t.taint_names(n.right) \
+                        if t.expr_tainted(n.right) else []
+                    if not (lhot or rhot):
+                        continue
+                    names = sorted(set(lhot + rhot))
+                    checker.report(
+                        "backward-path-escape", n,
+                        "bare `+` on a potentially-lazy cotangent "
+                        f"({', '.join(names)}) in the backward tape "
+                        "path — a concrete-left + lazy-right add "
+                        "materializes the lazy side and flushes "
+                        "mid-backward; use fusion.lazy_add",
+                        f"add:{','.join(names)}", "possible",
+                        context="backward")
+
+    # -- FL007 --------------------------------------------------------------
+    def _loop_op_estimate(self, loop, checker):
+        """Per-iteration recorded-op estimate of a loop body: tensor-op
+        calls + tainted binops, nested statically-known ranges
+        multiplied in."""
+        def est_stmts(stmts):
+            total = 0
+            for st in stmts:
+                total += est_node(st)
+            return total
+
+        def est_node(node):
+            if isinstance(node, (ast.For, ast.AsyncFor)):
+                inner = est_stmts(node.body) + est_stmts(node.orelse)
+                trip = const_range(node.iter)
+                return inner * (trip if trip is not None else 1)
+            if isinstance(node, ast.While):
+                return est_stmts(node.body) + est_stmts(node.orelse)
+            if isinstance(node, _FUNC_NODES):
+                return 0
+            total = 0
+            if isinstance(node, ast.Call):
+                d = dotted(node.func)
+                if d and (d[0] in TENSOR_HEADS
+                          or d[-1] in TENSOR_PRODUCERS):
+                    total += 1
+                elif isinstance(node.func, ast.Attribute) and \
+                        node.func.attr in ARRAY_METHODS and \
+                        checker._hot(node.func.value):
+                    total += 1
+            elif isinstance(node, ast.BinOp):
+                if checker._hot(node.left) or checker._hot(node.right):
+                    total += 1
+            for ch in ast.iter_child_nodes(node):
+                total += est_node(ch)
+            return total
+
+        return est_stmts(loop.body) + est_stmts(loop.orelse)
+
+    def _check_trace_length(self):
+        threshold = _max_ops_threshold()
+        for qual, fnode in self.graph.functions.items():
+            checker = None
+            for n in CallGraph.body_nodes(fnode):
+                if not isinstance(n, (ast.For, ast.While)):
+                    continue
+                if self.scopes.enclosing_loops(n):
+                    continue  # count outermost loops once (nested are
+                    #           folded into the parent's estimate)
+                if checker is None:
+                    checker = _FnChecker(self, fnode)
+                per_iter = self._loop_op_estimate(n, checker)
+                if per_iter == 0:
+                    continue
+                trip = const_range(n.iter) if isinstance(n, ast.For) \
+                    else None
+                total = per_iter * trip if trip is not None else per_iter
+                if total < threshold:
+                    continue
+                via = (f"{per_iter} ops/iter x {trip} iterations"
+                       if trip is not None and trip > 1
+                       else f"{per_iter} ops in one iteration")
+                checker.report(
+                    "trace-length-hazard", n,
+                    f"static estimate ~{total} recorded ops for this "
+                    f"loop ({via}) reaches PADDLE_TPU_FUSION_MAX_OPS "
+                    f"({threshold}) — the trace will hit the max_len "
+                    "safety valve and flush at an arbitrary op "
+                    "boundary mid-loop; add an explicit flush/"
+                    "materialize point per step, or raise the cap",
+                    f"ops~{total}",
+                    "definite" if trip is not None else "possible",
+                    context="trace-length")
+
+
+# ---------------------------------------------------------------------------
+# tree driver
+
+def iter_py_files(root):
+    yield from _iter_py_files(root, skip_dirs=SKIP_DIRS)
+
+
+def _find_manifest(roots):
+    for root in roots:
+        cand = os.path.join(root, "core", "_unjittable_manifest.py")
+        if os.path.isfile(cand):
+            return cand
+    return None
+
+
+def analyze_paths(roots, manifest_path=None):
+    """Analyze every .py under each root. Returns (findings, errors):
+    errors are (path, message) for unparseable files. The unjittable
+    manifest is auto-discovered at <root>/core/_unjittable_manifest.py
+    unless an explicit path is given."""
+    if manifest_path is None:
+        manifest_path = _find_manifest(roots)
+    manifest = load_unjittable_manifest(manifest_path) \
+        if manifest_path else {}
+    findings, errors = [], []
+    for root in roots:
+        root = os.path.normpath(root)
+        root_parent = os.path.dirname(os.path.abspath(root))
+        for path in iter_py_files(root):
+            rel = _relpath(path, root_parent)
+            if rel.endswith("core/_unjittable_manifest.py"):
+                continue  # generated data, not analyzed code
+            try:
+                ma = ModuleFusionAnalysis(path, root_parent,
+                                          manifest=manifest)
+                findings.extend(ma.run())
+            except (SyntaxError, UnicodeDecodeError) as e:
+                errors.append((rel, f"{type(e).__name__}: {e}"))
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings, errors
+
+
+def analyze_file(path, manifest_path=None):
+    return analyze_paths([path], manifest_path=manifest_path)

@@ -1,0 +1,60 @@
+"""Rule catalog for fuselint.
+
+Each rule names one class of fusion barrier — a code shape that forces
+the deferred-execution trace (core/fusion.py) to flush, cutting the
+fused program short. The catalog is data, not behavior — detection
+lives in analyzer.py — and the Rule dataclass/severity vocabulary is
+shared with tracelint and threadlint via tools/staticlib.
+
+Severity:
+  error    — a proven per-step flush on a hot path; fix or waive.
+  warning  — likely barrier; depends on which paths run under fusion.
+  info     — hygiene note / intentional-boundary audit; never gates CI
+             by severity alone (FL004 gates via the baseline like any
+             warning — see RULES below).
+"""
+from __future__ import annotations
+
+from ..staticlib.rules import Rule, ruleset
+
+RULES, BY_ID, get = ruleset([
+    Rule("FL001", "host-materialize-in-loop", "error", False,
+         "host materialization of a potentially-lazy tensor value "
+         "inside a loop body (float()/int()/bool(), .numpy()/.item()/"
+         ".tolist(), np.asarray) — a per-step flush that caps the "
+         "fused program at the loop granularity"),
+    Rule("FL002", "data-dependent-branch", "warning", False,
+         "Python if/while/assert on a tensor value in eager caller "
+         "code — __bool__ concretizes, flushing the pending trace "
+         "(shape/dtype/ndim reads stay eager via LazyArray's memoized "
+         "avals and never flag)"),
+    Rule("FL003", "known-demotion-barrier", "warning", False,
+         "op statically known to demote at runtime (the tracelint "
+         "unjittable manifest or an explicit @non_jittable marking) — "
+         "every sighting under fusion is a forced flush point; "
+         "reported at the op's definition so the barrier is visible "
+         "where it will bite, not rediscovered per-process"),
+    Rule("FL004", "suspend-region-entry", "warning", False,
+         "dispatch.suspend()/fusion.suspend() region entry — a "
+         "mandatory flush boundary by contract; every entry must be "
+         "intentional and carry a reviewed inline waiver "
+         "(`# fuselint: ok[FL004]`) or live in the baseline"),
+    Rule("FL005", "per-step-side-effect", "warning", False,
+         "Python side effect on a tensor value inside a loop body "
+         "(print/logging/str-format of a traced value) — each "
+         "stringification materializes and flushes per step"),
+    Rule("FL006", "backward-path-escape", "error", False,
+         "flush-forcing call inside the backward tape path: a raw "
+         "jnp/np/jax call (or bare `+`) on a potentially-lazy "
+         "cotangent that escapes the fusion.lazy_*/record_call/"
+         "concrete() protocol — a mid-backward flush cuts the fused "
+         "fwd+bwd program in half"),
+    Rule("FL007", "trace-length-hazard", "warning", False,
+         "static op-count estimate of a loop body (times any "
+         "statically-known trip count) reaches "
+         "PADDLE_TPU_FUSION_MAX_OPS — the trace will hit the max_len "
+         "safety valve and flush mid-loop at a nondeterministic "
+         "boundary; raise the cap or add an explicit flush point"),
+])
+
+__all__ = ["Rule", "RULES", "BY_ID", "get"]

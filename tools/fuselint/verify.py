@@ -1,0 +1,217 @@
+"""--verify-runtime: close the loop between fuselint's static findings
+and the flush-site attribution the runtime records.
+
+A child process (fresh interpreter, ``PADDLE_TPU_EAGER_FUSION=1``) runs
+the bench MLP train step — the same small fwd+bwd+SGD loop bench.py's
+``eager_fusion`` config measures — and prints
+``dispatch_stats()["fusion"]`` including the ``flush_sites`` table
+(reason -> {file:line -> count}). The parent then cross-references:
+
+* **confirmed** — static findings whose site a runtime flush actually
+  attributed to (same file, within a small line window): the static
+  pass is predicting real barriers.
+* **static-only** — findings never observed flushing in this workload:
+  precision feedback (most are simply paths the tiny MLP never runs;
+  a static-only finding ON the exercised step path is a likely false
+  positive).
+* **runtime-only** — flush sites inside the analyzed roots with no
+  static finding nearby: recall feedback — a barrier shape the rule
+  catalog misses. Sites outside the roots (the driver script itself)
+  are reported separately, not counted as gaps.
+
+Exit contract: 0 when at least one static finding cross-references a
+runtime flush site AND there are no recall gaps; 1 otherwise — CI can
+gate on the static pass staying anchored to runtime truth.
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+# line slack when matching a static finding to a runtime site: the
+# runtime attributes to the statement that touched the FIRST pending
+# placeholder (often one line below the statement the finding anchors)
+MATCH_WINDOW = 5
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def run_child():
+    """The bench-MLP train step under fusion (executed in a fresh
+    interpreter via --verify-child). Prints one JSON line: the fusion
+    stats snapshot after a short training loop whose per-step loss
+    read is the only HOST sync the driver itself performs."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.core import dispatch, fusion
+
+    dispatch.set_warmup_count(1)
+    if not fusion.fusion_enabled():
+        fusion.set_fusion(True)
+    rng = np.random.RandomState(0)
+    prng = np.random.RandomState(1)
+    x = paddle.to_tensor(rng.randn(32, 64).astype(np.float32))
+    y = paddle.to_tensor(rng.randn(32, 8).astype(np.float32))
+    params = [
+        paddle.to_tensor(prng.randn(64, 128).astype(np.float32) * 0.1,
+                         stop_gradient=False),
+        paddle.to_tensor(np.zeros(128, np.float32), stop_gradient=False),
+        paddle.to_tensor(prng.randn(128, 8).astype(np.float32) * 0.1,
+                         stop_gradient=False),
+        paddle.to_tensor(np.zeros(8, np.float32), stop_gradient=False),
+    ]
+    opt = paddle.optimizer.SGD(learning_rate=0.01, parameters=params)
+    losses = []
+    for _ in range(8):
+        h = F.relu(paddle.matmul(x, params[0]) + params[1])
+        p = paddle.matmul(h, params[2]) + params[3]
+        loss = ((p - y) * (p - y)).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(np.asarray(loss._value)))
+    fs = dispatch.dispatch_stats()["fusion"]
+    print(json.dumps({
+        "flushes": fs["flushes"],
+        "flush_sites": fs["flush_sites"],
+        "recorded_ops": fs["recorded_ops"],
+        "losses": losses,
+    }))
+
+
+def _spawn_child(timeout=300):
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env["PADDLE_TPU_EAGER_FUSION"] = "1"
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.fuselint", "--verify-child"],
+        cwd=REPO, env=env, capture_output=True, text=True,
+        timeout=timeout)
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"fuselint --verify-runtime: child failed rc="
+            f"{proc.returncode}\n{proc.stderr[-2000:]}")
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
+def _parse_site(site):
+    """('paddle_tpu/x/y.py', 123) or None for unknown/overflow keys."""
+    path, _, line = site.rpartition(":")
+    if not path or not line.isdigit():
+        return None
+    return path, int(line)
+
+
+def cross_reference(findings, flush_sites, roots=("paddle_tpu",)):
+    """Correlate static findings with runtime-attributed flush sites.
+    Returns a report dict (see module docstring for the categories).
+    ALL findings participate — a waived or baselined finding is still
+    an intentional barrier the runtime should be observed hitting.
+
+    Path frames differ by construction — finding paths are relative to
+    each analyzed root's PARENT, runtime sites are repo-relative — so a
+    site is "in tree" when a root name appears as one of its path
+    components, and a site file matches a finding file by SUFFIX (the
+    longer of the two ends with the other)."""
+    by_path = {}
+    for f in findings:
+        by_path.setdefault(f.path, []).append(f)
+    root_parts = {r.rstrip("/").rsplit("/", 1)[-1] for r in roots}
+
+    def _same_file(find_path, site_path):
+        return site_path.endswith("/" + find_path) or \
+            find_path.endswith("/" + site_path) or find_path == site_path
+
+    confirmed = {}        # fingerprint -> (finding, [site records])
+    runtime_only = []
+    external = []
+    for reason, sites in (flush_sites or {}).items():
+        for site, count in sites.items():
+            parsed = _parse_site(site)
+            rec = {"reason": reason, "site": site, "count": count}
+            if parsed is None:
+                external.append(rec)
+                continue
+            path, line = parsed
+            if not root_parts & set(path.split("/")[:-1] + [path]):
+                external.append(rec)
+                continue
+            near = [f for fp, fs in by_path.items()
+                    if _same_file(fp, path)
+                    for f in fs if abs(f.line - line) <= MATCH_WINDOW]
+            if near:
+                best = min(near, key=lambda f: abs(f.line - line))
+                confirmed.setdefault(
+                    best.fingerprint(), [best, []])[1].append(rec)
+            else:
+                runtime_only.append(rec)
+    confirmed_fps = set(confirmed)
+    static_only = [f for f in findings
+                   if f.fingerprint() not in confirmed_fps]
+    return {
+        "confirmed": [
+            {"fingerprint": fp, "path": f.path, "line": f.line,
+             "rule": f.rule, "rule_id": f.rule_id, "func": f.func,
+             "sites": recs}
+            for fp, (f, recs) in sorted(confirmed.items())],
+        "static_only": len(static_only),
+        "static_only_fingerprints": sorted(
+            f.fingerprint() for f in static_only),
+        "runtime_only": runtime_only,
+        "external_sites": external,
+    }
+
+
+def run_verify(findings, json_path=None, roots=("paddle_tpu",)):
+    """Drive the child, cross-reference, print the report. Returns the
+    process exit code (0 = anchored: >= 1 confirmed finding and no
+    recall gaps). `roots` must be the roots the findings were analyzed
+    over — sites outside them are external, not recall gaps."""
+    stats = _spawn_child()
+    report = cross_reference(findings, stats.get("flush_sites"),
+                             roots=tuple(roots))
+    report["child"] = {"flushes": stats["flushes"],
+                       "recorded_ops": stats["recorded_ops"]}
+    n_conf = len(report["confirmed"])
+    print(f"fuselint --verify-runtime: {n_conf} static finding(s) "
+          "confirmed by runtime flush attribution")
+    for c in report["confirmed"]:
+        sites = ", ".join(f"{r['site']} ({r['reason']} x{r['count']})"
+                          for r in c["sites"])
+        print(f"  {c['rule_id']} {c['path']}:{c['line']} in "
+              f"`{c['func']}` <- {sites}")
+    print(f"  precision: {report['static_only']} finding(s) not "
+          "observed flushing in this workload (unexercised paths "
+          "expected for the small MLP)")
+    if report["runtime_only"]:
+        print(f"  RECALL GAP: {len(report['runtime_only'])} runtime "
+              "flush site(s) in the analyzed tree with no static "
+              "finding nearby:")
+        for r in report["runtime_only"]:
+            print(f"    {r['site']} ({r['reason']} x{r['count']})")
+    if report["external_sites"]:
+        ext = ", ".join(f"{r['site']} ({r['reason']})"
+                        for r in report["external_sites"])
+        print(f"  external (driver-script) sites: {ext}")
+    if json_path:
+        from ..staticlib.report import write_json
+
+        write_json(json_path, report)
+    if n_conf == 0:
+        print("fuselint --verify-runtime: FAIL — no static finding "
+              "cross-references a runtime flush site; the static pass "
+              "has come unanchored from the runtime's attribution",
+              file=sys.stderr)
+        return 1
+    if report["runtime_only"]:
+        print("fuselint --verify-runtime: FAIL — runtime flush sites "
+              "above have no static coverage (a rule-catalog recall "
+              "gap); extend the rules or attribute the site",
+              file=sys.stderr)
+        return 1
+    return 0

@@ -1,0 +1,144 @@
+#!/usr/bin/env python
+"""One entry point for every static analyzer: tracelint + threadlint +
+fuselint in one command — one report grammar, one combined JSON, one
+exit code, with every tool's CI freshness gate engaged.
+
+    python tools/staticcheck.py [roots...] [options]
+
+Runs, in order:
+
+* **tracelint**  — jit-safety over the op surface, WITH the manifest
+  freshness gate (``--check-manifest``: a stale checked-in unjittable
+  manifest fails);
+* **threadlint** — concurrency/race analysis, with the baseline
+  freshness gate (``--fail-stale``);
+* **fuselint**   — fusion-barrier analysis, same freshness gate.
+
+Each tool prints its usual human report under a banner; the combined
+JSON report (``--json``) nests each tool's machine-readable report
+under its name plus a ``staticcheck`` summary block. ``--sarif-dir``
+writes one SARIF file per tool (<dir>/<tool>.sarif) for code-scanning
+upload.
+
+Exit grammar (the strictest of the three, uniformly): 0 — every tool
+clean (baselined-only); 1 — any new finding, parse error, stale
+baseline entry, or stale manifest; 2 — usage error.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from tools.fuselint import __main__ as fuselint_main  # noqa: E402
+from tools.threadlint import __main__ as threadlint_main  # noqa: E402
+from tools.tracelint import __main__ as tracelint_main  # noqa: E402
+
+TOOLS = ("tracelint", "threadlint", "fuselint")
+
+
+def build_parser():
+    p = argparse.ArgumentParser(
+        prog="python tools/staticcheck.py",
+        description="run all static analyzers (tracelint + threadlint "
+                    "+ fuselint) with their CI freshness gates")
+    p.add_argument("roots", nargs="*", default=["paddle_tpu"],
+                   help="package dirs to analyze (default: paddle_tpu)")
+    p.add_argument("--json", metavar="PATH",
+                   help="write the combined machine-readable report")
+    p.add_argument("--sarif-dir", metavar="DIR",
+                   help="write one SARIF report per tool here")
+    p.add_argument("--skip", action="append", default=[],
+                   choices=list(TOOLS), metavar="TOOL",
+                   help="skip one tool (repeatable)")
+    p.add_argument("--verify-runtime", action="store_true",
+                   help="also run fuselint's runtime flush-site "
+                        "cross-reference (one fuselint pass does both "
+                        "the gate and the verify)")
+    p.add_argument("-v", "--verbose", action="store_true",
+                   help="itemize baselined/waived findings too")
+    return p
+
+
+def _tool_argv(tool, args, json_path):
+    argv = list(args.roots) + ["--json", json_path]
+    if tool == "tracelint":
+        # manifest freshness IS tracelint's staleness gate; the
+        # baseline gate is implicit in its exit code. Roots without a
+        # core/ dir (fixture trees) have no manifest to check.
+        if any(os.path.isdir(os.path.join(r, "core"))
+               for r in args.roots):
+            argv.append("--check-manifest")
+    else:
+        argv.append("--fail-stale")
+    if tool == "fuselint" and args.verify_runtime:
+        argv.append("--verify-runtime")
+    if args.sarif_dir:
+        argv += ["--sarif", os.path.join(args.sarif_dir, f"{tool}.sarif")]
+    if args.verbose:
+        argv.append("-v")
+    return argv
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
+    for r in args.roots:
+        if not os.path.exists(r):
+            print(f"staticcheck: no such path: {r}", file=sys.stderr)
+            return 2
+    if args.sarif_dir:
+        os.makedirs(args.sarif_dir, exist_ok=True)
+    mains = {"tracelint": tracelint_main.main,
+             "threadlint": threadlint_main.main,
+             "fuselint": fuselint_main.main}
+    combined = {"version": 1, "tools": {}, "staticcheck": {}}
+    failed = []
+    for tool in TOOLS:
+        if tool in args.skip:
+            continue
+        print(f"== staticcheck: {tool} ==")
+        fd, json_path = tempfile.mkstemp(prefix=f"staticcheck_{tool}_",
+                                         suffix=".json")
+        os.close(fd)
+        try:
+            rc = mains[tool](_tool_argv(tool, args, json_path))
+            try:
+                with open(json_path, "r", encoding="utf-8") as f:
+                    combined["tools"][tool] = json.load(f)
+            except (OSError, ValueError):
+                combined["tools"][tool] = None
+        finally:
+            os.unlink(json_path)
+        combined["tools"].setdefault(tool, None)
+        if combined["tools"][tool] is not None:
+            combined["tools"][tool]["exit_code"] = rc
+        if rc == 2:
+            return 2
+        if rc != 0:
+            failed.append(tool)
+        print()
+    combined["staticcheck"] = {
+        "ran": [t for t in TOOLS if t not in args.skip],
+        "failed": failed,
+        "clean": not failed,
+    }
+    if args.json:
+        with open(args.json, "w", encoding="utf-8") as f:
+            json.dump(combined, f, indent=1)
+            f.write("\n")
+    if failed:
+        print(f"staticcheck: FAIL ({', '.join(failed)})",
+              file=sys.stderr)
+        return 1
+    print("staticcheck: OK (" +
+          ", ".join(t for t in TOOLS if t not in args.skip) + ")")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -25,8 +25,8 @@ Consumers: tools/tracelint (jit-safety), tools/threadlint (concurrency).
 Everything is stdlib-only and must never import the code it analyzes.
 """
 from .astnav import (  # noqa: F401
-    DEFAULT_SKIP_DIRS, ScopeIndex, dotted, func_params, iter_py_files,
-    relpath, runtime_first_line,
+    DEFAULT_SKIP_DIRS, ScopeIndex, const_range, dotted, func_params,
+    iter_py_files, relpath, runtime_first_line,
 )
 from .baseline import (  # noqa: F401
     BASELINE_VERSION, load_baseline, partition, write_baseline,
@@ -38,8 +38,8 @@ from .taint import NameTaint, body_nodes  # noqa: F401
 from .waivers import suppressed  # noqa: F401
 
 __all__ = [
-    "DEFAULT_SKIP_DIRS", "ScopeIndex", "dotted", "func_params",
-    "iter_py_files", "relpath", "runtime_first_line",
+    "DEFAULT_SKIP_DIRS", "ScopeIndex", "const_range", "dotted",
+    "func_params", "iter_py_files", "relpath", "runtime_first_line",
     "BASELINE_VERSION", "load_baseline", "partition", "write_baseline",
     "CallGraph", "Finding", "Rule", "ruleset", "NameTaint", "body_nodes",
     "suppressed",

@@ -9,7 +9,7 @@ import ast
 import os
 
 __all__ = ["dotted", "runtime_first_line", "func_params", "ScopeIndex",
-           "iter_py_files", "relpath", "DEFAULT_SKIP_DIRS"]
+           "iter_py_files", "relpath", "DEFAULT_SKIP_DIRS", "const_range"]
 
 
 def dotted(node):
@@ -96,6 +96,43 @@ class ScopeIndex:
                 return s
         return None
 
+    def enclosing_loops(self, node):
+        """Enclosing For/While statements (and comprehension generators)
+        within the SAME function scope, innermost first. A node inside a
+        loop body runs once per iteration — the loop-context query
+        fuselint's per-step rules are built on. Stops at the nearest
+        def/lambda boundary: an inner function's body is not "in" its
+        definer's loop (it runs when called, not per iteration)."""
+        out = []
+        cur = self.parent.get(node)
+        child = node
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Lambda)):
+                break
+            if isinstance(cur, (ast.For, ast.AsyncFor, ast.While)):
+                # the iter/test expression itself evaluates once (For)
+                # or per-iteration (While) — count only BODY membership
+                # for For so `for x in expensive()` isn't "in" the loop
+                if not (isinstance(cur, (ast.For, ast.AsyncFor))
+                        and child is cur.iter):
+                    out.append(cur)
+            elif isinstance(cur, (ast.ListComp, ast.SetComp, ast.DictComp,
+                                  ast.GeneratorExp)):
+                # the FIRST generator's iter evaluates once, in the
+                # enclosing scope — same exemption as For.iter above
+                # (ancestry check: the chain to a nested node passes
+                # through the `comprehension` node, not `iter` itself)
+                it0 = cur.generators[0].iter
+                if not any(sub is node for sub in ast.walk(it0)):
+                    out.append(cur)
+            child = cur
+            cur = self.parent.get(cur)
+        return out
+
+    def loop_depth(self, node):
+        return len(self.enclosing_loops(node))
+
     def resolve_function(self, name, from_node):
         """Find the def/lambda a bare name refers to at `from_node`,
         searching enclosing function scopes innermost-out, then module
@@ -118,6 +155,28 @@ class ScopeIndex:
             if hit is not None:
                 return hit
         return None
+
+
+def const_range(call):
+    """The statically-known trip count of a `range(...)` call, or None.
+    Only constant int arguments resolve — `range(n)` is dynamic."""
+    if not (isinstance(call, ast.Call) and dotted(call.func) == ("range",)):
+        return None
+    vals = []
+    for a in call.args:
+        if isinstance(a, ast.Constant) and isinstance(a.value, int):
+            vals.append(a.value)
+        else:
+            return None
+    if len(vals) == 1:
+        return max(0, vals[0])
+    if len(vals) == 2:
+        return max(0, vals[1] - vals[0])
+    if len(vals) == 3 and vals[2] != 0:
+        span = vals[1] - vals[0]
+        step = vals[2]
+        return max(0, (span + (step - (1 if step > 0 else -1))) // step)
+    return None
 
 
 DEFAULT_SKIP_DIRS = frozenset({"__pycache__", ".git", "libs", "include"})

@@ -91,3 +91,87 @@ def write_json(path, payload):
     with open(path, "w", encoding="utf-8") as f:
         json.dump(payload, f, indent=1)
         f.write("\n")
+
+
+# ---------------------------------------------------------------------------
+# SARIF 2.1.0 — the code-scanning interchange format CI annotates PRs
+# from. One exporter for every analyzer: the tool name/rules bind per
+# call, the structure is identical, so tracelint/threadlint/fuselint
+# findings all surface as inline annotations through one pipeline.
+
+SARIF_VERSION = "2.1.0"
+SARIF_SCHEMA = ("https://raw.githubusercontent.com/oasis-tcs/sarif-spec/"
+                "master/Schemata/sarif-schema-2.1.0.json")
+
+_SARIF_LEVEL = {"error": "error", "warning": "warning", "info": "note"}
+
+
+def sarif_report(new, baselined, suppressed, info, errors, tool, rules,
+                 tool_version="1.0"):
+    """Findings as one SARIF run. Gating semantics ride along:
+    baselined/waived findings are emitted with SARIF suppressions (so
+    code scanning shows them resolved, not new), `new` findings are
+    unsuppressed, and parse errors become tool execution
+    notifications."""
+    def result(f, suppression=None):
+        r = {
+            "ruleId": f.rule_id,
+            "level": _SARIF_LEVEL.get(f.severity, "warning"),
+            "message": {"text": f.message},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {"uri": f.path},
+                    "region": {"startLine": f.line,
+                               "startColumn": f.col + 1},
+                },
+                "logicalLocations": [{"fullyQualifiedName": f.func}],
+            }],
+            "partialFingerprints": {"staticlibFingerprint/v1":
+                                    f.fingerprint()},
+        }
+        if suppression is not None:
+            r["suppressions"] = [{"kind": suppression[0],
+                                  "justification": suppression[1]}]
+        return r
+
+    results = [result(f) for f in new]
+    results += [result(f, ("external", "accepted debt in the checked "
+                           "baseline")) for f in baselined]
+    results += [result(f, ("inSource", f"reviewed inline `# {tool}: "
+                           "ok[...]` waiver")) for f in suppressed]
+    results += [result(f) for f in info]
+    return {
+        "$schema": SARIF_SCHEMA,
+        "version": SARIF_VERSION,
+        "runs": [{
+            "tool": {"driver": {
+                "name": tool,
+                "version": tool_version,
+                "informationUri":
+                    f"docs/{tool.upper()}.md",
+                "rules": [{
+                    "id": r.id,
+                    "name": slug,
+                    "shortDescription": {"text": slug},
+                    "fullDescription": {"text": r.summary},
+                    "defaultConfiguration": {
+                        "level": _SARIF_LEVEL.get(r.severity, "warning")},
+                } for slug, r in sorted(rules.items())],
+            }},
+            "invocations": [{
+                "executionSuccessful": not errors,
+                "toolExecutionNotifications": [{
+                    "level": "error",
+                    "message": {"text": f"{p}: PARSE ERROR — {m}"},
+                } for p, m in errors],
+            }],
+            "results": results,
+        }],
+    }
+
+
+def write_sarif(path, new, baselined, suppressed, info, errors, tool,
+                rules, tool_version="1.0"):
+    write_json(path, sarif_report(new, baselined, suppressed, info,
+                                  errors, tool, rules,
+                                  tool_version=tool_version))

@@ -52,6 +52,14 @@ def _child():
     t = paddle.to_tensor(rng.randn(8, 8).astype(np.float32))
     for _ in range(4):
         paddle.tanh(paddle.matmul(t, t)).sum()
+    # a short trace-fusion window: the flush-reason/site attribution
+    # counter must carry real traffic to reconcile against
+    from paddle_tpu.core import fusion
+
+    fusion.set_fusion(True)
+    for _ in range(3):
+        float(paddle.tanh(paddle.matmul(t, t)).sum())
+    fusion.set_fusion(False)
     record_fault("rollbacks", "telemetry smoke fixture")
     x = rng.rand(64, 4).astype(np.float32)
     y = (x @ rng.rand(4, 1).astype(np.float32)).astype(np.float32)
@@ -66,6 +74,8 @@ def _child():
         "forward_hits": ds["forward"]["hits"],
         "forward_misses": ds["forward"]["misses"],
         "fault_events": fault_events(),
+        "fusion_flushes": ds["fusion"]["flushes"],
+        "fusion_flush_sites": ds["fusion"]["flush_sites"],
         "steps": 8,
     }))
 
@@ -125,6 +135,23 @@ def run_smoke():
     if truth["forward_hits"] <= 0:
         raise SystemExit("telemetry_smoke: the eager workload produced no "
                          "dispatch-cache hits — nothing real reconciled")
+
+    # -- fusion flush-site attribution reconciles with flush totals --------
+    n_sites = 0
+    for reason, sites in truth["fusion_flush_sites"].items():
+        for site, n in sites.items():
+            expect("paddle_tpu_fusion_flush_reason_total",
+                   [("reason", reason), ("site", site)], n)
+            n_sites += 1
+        if sum(sites.values()) != truth["fusion_flushes"].get(reason):
+            raise SystemExit(
+                f"telemetry_smoke: flush_sites[{reason}] sums to "
+                f"{sum(sites.values())} but flushes[{reason}] is "
+                f"{truth['fusion_flushes'].get(reason)} — the site "
+                "table must reconcile exactly with the flush totals")
+    if n_sites <= 0:
+        raise SystemExit("telemetry_smoke: the fusion window produced no "
+                         "attributed flush sites — nothing reconciled")
 
     # -- scalars -----------------------------------------------------------
     scalars_path = os.path.join(tmp, "scalars.jsonl")

@@ -42,6 +42,9 @@ def build_parser():
                         "and exit 0")
     p.add_argument("--json", metavar="PATH",
                    help="also write the machine-readable report here")
+    p.add_argument("--sarif", metavar="PATH",
+                   help="also write a SARIF 2.1.0 report here (CI "
+                        "code-scanning annotations)")
     p.add_argument("--emit-manifest", action="store_true",
                    help="regenerate the static unjittable manifest")
     p.add_argument("--manifest-path", default=None,
@@ -114,6 +117,12 @@ def main(argv=None):
     if args.json:
         write_json(args.json, json_report(new, baselined, suppressed, info,
                                           stale, errors, entries))
+    if args.sarif:
+        from ..staticlib.report import write_sarif
+        from .rules import RULES
+
+        write_sarif(args.sarif, new, baselined, suppressed, info, errors,
+                    tool="tracelint", rules=RULES)
     return 1 if (new or errors) else 0
 
 

@@ -316,6 +316,42 @@ def bench_resnet50(batch=64, steps=20, warmup=3):
             "resnet50_step_ms": dt / steps * 1e3}
 
 
+def _span_phases(tracing_mod, fn):
+    """Per-phase wall-clock decomposition of one extra UNTIMED pass of
+    `fn` under the span tracer (runtime/tracing.py): tracing adds
+    overhead, so it must never touch the A/B numbers — the timed arms
+    run with the tracer off, then this pass runs the same loop traced
+    and reads the phase totals. Keys are the perf-trajectory contract:
+    data/forward/backward/optimizer/flush seconds."""
+    import tempfile
+
+    # respect an operator-configured tracer (PADDLE_TPU_TRACE): reuse
+    # it rather than hijacking the process-wide trace file mid-run; a
+    # throwaway dir (and the post-pass disable) only when bench itself
+    # turned tracing on
+    already = tracing_mod.enabled()
+    if not already:
+        tracing_mod.configure(tempfile.mkdtemp(prefix="bench_trace_"))
+    tracing_mod.reset_span_stats()
+    try:
+        fn()
+    finally:
+        if not already:
+            tracing_mod.set_enabled(False)
+    ph = tracing_mod.phase_totals()
+    return {
+        # "data" = the fit-level data_wait span, which already covers
+        # the loader's io spans (queue wait / unstage) in full — adding
+        # the io cat would double count; it is only the fallback for
+        # workloads that drive the loader without Model.fit
+        "data": round(ph.get("data", 0.0) or ph.get("io", 0.0), 6),
+        "forward": round(ph.get("forward", 0.0), 6),
+        "backward": round(ph.get("backward", 0.0), 6),
+        "optimizer": round(ph.get("optimizer", 0.0), 6),
+        "flush": round(ph.get("fusion", 0.0), 6),
+    }
+
+
 def bench_eager_dispatch(iters=100, batch=32, in_dim=64, hidden=128,
                          out_dim=8, warmup=5):
     """Eager-op dispatch microbench (CPU-runnable): a small-MLP eager
@@ -331,6 +367,7 @@ def bench_eager_dispatch(iters=100, batch=32, in_dim=64, hidden=128,
     import paddle_tpu.nn.functional as PF
     from paddle_tpu.core import dispatch
     from paddle_tpu.core.tensor import Tensor as _T
+    from paddle_tpu.runtime import tracing as _tracing
 
     rng = np.random.RandomState(0)
     res = {}
@@ -350,9 +387,13 @@ def bench_eager_dispatch(iters=100, batch=32, in_dim=64, hidden=128,
 
         def run_loop(n, params, opt):
             for _ in range(n):
-                h = PF.relu(paddle.matmul(x, params[0]) + params[1])
-                p = paddle.matmul(h, params[2]) + params[3]
-                loss = ((p - y) * (p - y)).mean()
+                # the forward span (library spans cover backward /
+                # optimizer / flush) — a shared no-op object while
+                # tracing is off, so the timed arms pay ~nothing
+                with _tracing.span("forward", "forward"):
+                    h = PF.relu(paddle.matmul(x, params[0]) + params[1])
+                    p = paddle.matmul(h, params[2]) + params[3]
+                    loss = ((p - y) * (p - y)).mean()
                 loss.backward()
                 opt.step()
                 opt.clear_grad()
@@ -376,6 +417,14 @@ def bench_eager_dispatch(iters=100, batch=32, in_dim=64, hidden=128,
 
         dt_on, stats_on = timed(True)
         dt_off, stats_off = timed(False)
+
+        def _phase_pass():
+            params = make_params()
+            opt = paddle.optimizer.SGD(learning_rate=0.01,
+                                       parameters=params)
+            run_loop(min(iters, 20), params, opt)
+
+        res["eager_dispatch_phase_s"] = _span_phases(_tracing, _phase_pass)
 
     fwd = stats_on["forward"]
     n_ops = fwd["hits"] + fwd["misses"]
@@ -403,6 +452,7 @@ def bench_eager_fusion(iters=100, batch=32, in_dim=64, hidden=128,
     import paddle_tpu.nn.functional as PF
     from paddle_tpu.core import dispatch, fusion
     from paddle_tpu.core.tensor import Tensor as _T
+    from paddle_tpu.runtime import tracing as _tracing
 
     rng = np.random.RandomState(0)
     res = {}
@@ -425,9 +475,10 @@ def bench_eager_fusion(iters=100, batch=32, in_dim=64, hidden=128,
 
         def run_loop(n, params, opt):
             for _ in range(n):
-                h = PF.relu(paddle.matmul(x, params[0]) + params[1])
-                p = paddle.matmul(h, params[2]) + params[3]
-                loss = ((p - y) * (p - y)).mean()
+                with _tracing.span("forward", "forward"):
+                    h = PF.relu(paddle.matmul(x, params[0]) + params[1])
+                    p = paddle.matmul(h, params[2]) + params[3]
+                    loss = ((p - y) * (p - y)).mean()
                 loss.backward()
                 opt.step()
                 opt.clear_grad()
@@ -457,6 +508,22 @@ def bench_eager_fusion(iters=100, batch=32, in_dim=64, hidden=128,
         d2_off, _ = one_rep(False)
         d2_on, _ = one_rep(True)
         dt_off, dt_on = min(dt_off, d2_off), min(dt_on, d2_on)
+
+        def _phase_pass():
+            prev = fusion.set_fusion(True)
+            try:
+                params = make_params()
+                opt = paddle.optimizer.SGD(learning_rate=0.01,
+                                           parameters=params)
+                run_loop(min(iters, 20), params, opt)
+            finally:
+                fusion.set_fusion(prev)
+
+        # per-phase step-time decomposition UNDER FUSION: forward/
+        # backward here are recording time; "flush" is where the fused
+        # program actually executes — exactly the split the timeline
+        # exists to show
+        res["eager_fusion_phase_s"] = _span_phases(_tracing, _phase_pass)
 
     fus = stats_on["fusion"]
     n_flush = sum(fus["flushes"].values())

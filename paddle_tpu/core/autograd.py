@@ -33,6 +33,7 @@ import numpy as np
 
 from . import dispatch as _dispatch
 from . import fusion as _fusion
+from ..runtime import tracing as _tracing
 from .tensor import Tensor
 
 __all__ = [
@@ -466,6 +467,22 @@ def _add_cot(prev, new, create_graph):
 def run_backward(tensors, grad_tensors=None, retain_graph=False,
                  create_graph=False, inputs=None, accumulate=True,
                  allow_unused=True):
+    """Engine shared by Tensor.backward and paddle.grad (span-traced as
+    one "backward" phase when PADDLE_TPU_TRACE is on; higher-order
+    backward nests)."""
+    if not _tracing._on[0]:
+        return _run_backward_impl(tensors, grad_tensors, retain_graph,
+                                  create_graph, inputs, accumulate,
+                                  allow_unused)
+    with _tracing.span("backward", "backward", outputs=len(tensors)):
+        return _run_backward_impl(tensors, grad_tensors, retain_graph,
+                                  create_graph, inputs, accumulate,
+                                  allow_unused)
+
+
+def _run_backward_impl(tensors, grad_tensors=None, retain_graph=False,
+                       create_graph=False, inputs=None, accumulate=True,
+                       allow_unused=True):
     """Engine shared by Tensor.backward and paddle.grad.
 
     In create_graph mode every cotangent is a live Tensor and pullbacks are

@@ -67,6 +67,7 @@ import numpy as np
 
 from . import dtype as _pdtypes
 from ..runtime import telemetry as _telemetry
+from ..runtime import tracing as _tracing
 from ..runtime import warmup as _warmup
 from ..runtime.resilience import fault_events as _fault_events
 from ..runtime.resilience import record_fault as _record_fault
@@ -150,6 +151,10 @@ def suspend():
 # microsecond per lookup at ~10 lookups/op — bind once
 _Tracer = jax.core.Tracer
 _FunctionType = types.FunctionType
+# span-tracer switch (runtime/tracing.py): spans are emitted only from
+# the cold compile branch and the 1-in-N sampled-run branch, each
+# behind this one list-index check — the cached hit path never sees it
+_trace_on = _tracing._on
 
 # non-function callables that are safe to key by identity: module-level
 # singletons whose behavior is fixed at definition time. An arbitrary
@@ -858,7 +863,11 @@ def run_op(fn, vals, treedef, fallback, name=None):
             # record the signature for the warm-start shape manifest
             t0 = time.perf_counter()
             out = jitted(*[vals[i] for i in arr_pos])
-            fresh[_COMPILE_S] += time.perf_counter() - t0
+            dt = time.perf_counter() - t0
+            fresh[_COMPILE_S] += dt
+            if _trace_on[0]:
+                _tracing.emit_span(f"compile:{name}", "dispatch",
+                                   time.time() - dt, dt, op=name)
             _warmup.record_op(fn, name, treedef, vals,
                               tuple(arr_pos), tuple(avals))
         elif _op_sample_every and _op_sample_ctr[0] <= 1:
@@ -878,6 +887,12 @@ def run_op(fn, vals, treedef, fallback, name=None):
                 ent[_RUN_S] += dt
                 ent[_RUN_SAMPLES] += 1
                 _observe_op_run(ent[0], dt)
+                if _trace_on[0]:
+                    # emitted from the SAME dt that fed run_s, so the
+                    # span sum reconciles exactly with per_op run_s
+                    # (tracing.reconcile_with_metrics)
+                    _tracing.emit_span(f"run:{ent[0]}", "dispatch",
+                                       time.time() - dt, dt, op=ent[0])
         else:
             if _op_sample_every:
                 _op_sample_ctr[0] -= 1
@@ -972,7 +987,11 @@ def precompile_op(fn, treedef, leaves, name=None):
     t0 = time.perf_counter()
     compiled = program.lower(*structs).compile()
     ent = _op_stats_entry(name, ident)
-    ent[_COMPILE_S] += time.perf_counter() - t0
+    dt = time.perf_counter() - t0
+    ent[_COMPILE_S] += dt
+    if _trace_on[0]:
+        _tracing.emit_span(f"compile:{name}", "dispatch",
+                           time.time() - dt, dt, op=name, aot=True)
     FORWARD.put(key, compiled, tag=name)
     with _seen_lock:
         _seen[key] = _warmup_count  # past the warm gate; first call hits

@@ -57,6 +57,7 @@ import collections
 import os
 import sys
 import threading
+import time
 import types
 import weakref
 
@@ -90,6 +91,7 @@ import jax.numpy as jnp  # noqa: E402
 import numpy as np  # noqa: E402
 
 from ..runtime import telemetry as _telemetry  # noqa: E402,F401
+from ..runtime import tracing as _tracing  # noqa: E402
 from ..runtime import warmup as _warmup  # noqa: E402
 from ..runtime.resilience import record_fault as _record_fault  # noqa: E402
 from . import dispatch as _dispatch  # noqa: E402
@@ -415,7 +417,7 @@ class _Node:
 
 class _Trace:
     __slots__ = ("nodes", "externals", "_ext_ids", "out_refs", "lock",
-                 "flushed", "error")
+                 "flushed", "error", "wall0")
 
     def __init__(self):
         self.nodes = []
@@ -427,6 +429,10 @@ class _Trace:
         self.error = None  # the exception a failed replay raised, kept
         #                    so later materializations of this trace's
         #                    unpatched placeholders name the real cause
+        # record-region anchor for the span timeline: when the tracer is
+        # on, the window from first recorded op to flush becomes a
+        # "fusion.record" span (one wall read per trace, not per op)
+        self.wall0 = time.time() if _tracing._on[0] else None
 
     def ext_index(self, v):
         # identity dedup is sound because `externals` holds the value
@@ -1009,11 +1015,29 @@ def flush_trace(trace, reason):
             _tl.trace = None
         if not trace.nodes:
             return
-        _note_flush(reason, len(trace.nodes), _flush_site())
-        _execute(trace)
+        site = _flush_site()
+        _note_flush(reason, len(trace.nodes), site)
+        _execute(trace, reason, site)
 
 
-def _execute(trace):
+def _execute(trace, reason="manual", site="<unknown>"):
+    if not _tracing._on[0]:
+        return _execute_impl(trace, None)
+    # flush span, tagged with the PR-11 reason+site attribution and the
+    # executed mode (fused compile vs cached replay vs eager): a REAL
+    # nested span, so an enclosing optimizer/backward span's self time
+    # excludes the flush instead of double counting it
+    if trace.wall0 is not None:
+        _tracing.emit_span("record", "fusion.record", trace.wall0,
+                           max(0.0, time.time() - trace.wall0),
+                           ops=len(trace.nodes))
+    sp = _tracing.span("flush", "fusion", reason=reason, site=site,
+                       ops=len(trace.nodes))
+    with sp:
+        return _execute_impl(trace, sp)
+
+
+def _execute_impl(trace, sp):
     # the liveness mask is part of the fingerprint: it determines the
     # fused program's output signature (computed once, used for build,
     # execute and patch — placeholders dying between here and the patch
@@ -1034,6 +1058,7 @@ def _execute(trace):
             # cold trace pattern: op-by-op eager, no fused compile —
             # the exact analogue of the per-op warm-count gate
             _bump("eager_replays")
+            _tracing.set_span_arg(sp, "mode", "eager_replay")
             _replay_and_note(trace)
             return
         prog = jax.jit(_build_fused(trace.nodes, alive))  # tracelint: ok[suspend-audit] node.calls are raw jnp op bodies; nested dispatch sees tracers and bypasses
@@ -1044,15 +1069,15 @@ def _execute(trace):
             # first execution = trace + XLA compile (a disk load when
             # the persistent cache is warm); record the signature so
             # warm-start can AOT-replay it in the next process
-            import time as _time
-
-            t0 = _time.perf_counter()
+            _tracing.set_span_arg(sp, "mode", "fused_fresh")
+            t0 = time.perf_counter()
             flat = prog(*trace.externals)
-            dt = _time.perf_counter() - t0
+            dt = time.perf_counter() - t0
             _bump("compile_s", dt)
             _warmup.note_op_compile("fusion.trace", dt)
             _record_trace_entry(trace, alive)
         else:
+            _tracing.set_span_arg(sp, "mode", "fused")
             flat = prog(*trace.externals)
     except Exception:  # noqa: BLE001 — fused must never break eager
         # semantics: drop the program, replay op-by-op (an op error
@@ -1060,6 +1085,7 @@ def _execute(trace):
         # execution defers errors, it must not swallow them)
         FUSED.pop(fp)
         _bump("fallbacks")
+        _tracing.set_span_arg(sp, "mode", "fallback")
         _record_fault("fusion_fallbacks",
                       f"fused[{len(trace.nodes)}] -> eager replay")
         _replay_and_note(trace)
@@ -1197,12 +1223,10 @@ def precompile_trace(entry):
         return False  # installing past the bound would evict AOT entries
     structs = [jax.ShapeDtypeStruct(s, d, weak_type=w)
                for (s, d, w) in ext_avals]
-    import time as _time
-
     program = jax.jit(_build_fused(nodes, alive))  # tracelint: ok[suspend-audit] node.calls are manifest-rebuilt raw jnp op bodies
-    t0 = _time.perf_counter()
+    t0 = time.perf_counter()
     compiled = program.lower(*structs).compile()
-    _warmup.note_op_compile("fusion.trace", _time.perf_counter() - t0)
+    _warmup.note_op_compile("fusion.trace", time.perf_counter() - t0)
     FUSED.put(fp, compiled, tag=f"trace[{len(nodes)}]")
     with _seen_lock:
         _seen[fp] = _dispatch._warmup_count  # past the gate: first flush hits

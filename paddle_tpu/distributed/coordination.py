@@ -65,6 +65,7 @@ import time
 
 from ..core.dispatch import non_jittable
 from ..runtime import telemetry as _telemetry
+from ..runtime import tracing as _tracing
 from ..runtime.resilience import atomic_write_json, fault_point, record_fault
 
 __all__ = [
@@ -262,14 +263,36 @@ def init_cluster_telemetry(ctx):
     _telemetry.set_rank(ctx.rank)
     if not isinstance(ctx.store, DirectoryStore):
         return
+    # span tracing (runtime/tracing.py): the rank tag set above makes
+    # every subsequent trace event lane on this rank. The cluster
+    # default for PADDLE_TPU_TRACE is a SHARED dir under the store
+    # (e.g. <store>/traces) — per-process file names never collide and
+    # host 0's merge tails them into one cluster timeline. A local
+    # trace dir keeps working but is invisible to the merge once this
+    # host dies, same trade-off as the event stream below.
+    if _tracing.enabled():
+        tdir = _tracing.trace_dir()
+        # separator-anchored containment: /data/store-local must NOT
+        # count as inside /data/store
+        if tdir and not (os.path.abspath(tdir) + os.sep).startswith(
+                os.path.abspath(ctx.store.root) + os.sep):
+            import warnings
+
+            warnings.warn(
+                f"paddle_tpu coordination: span traces at {tdir!r} are "
+                "outside the cluster store — the host-0 merged cluster "
+                "timeline will only cover ranks whose trace dir it can "
+                "read. Point PADDLE_TPU_TRACE at a shared dir under the "
+                "store (e.g. <store>/traces) to close the gap.",
+                stacklevel=2)
     if _telemetry.telemetry_dir() is None:
         try:
             _telemetry.configure(os.path.join(
                 ctx.store.root, "events", f"rank_{ctx.rank}"))
         except OSError:
             pass  # unwritable store dir: registry-only collection
-    elif not os.path.abspath(_telemetry.telemetry_dir()).startswith(
-            os.path.abspath(ctx.store.root)):
+    elif not (os.path.abspath(_telemetry.telemetry_dir()) + os.sep).startswith(
+            os.path.abspath(ctx.store.root) + os.sep):
         import warnings
 
         warnings.warn(
@@ -526,11 +549,24 @@ def rendezvous(store=None, name=None, payload=None, timeout=60.0,
     clock-discipline assumption the quorum watchdog already makes).
     """
     key = f"{RENDEZVOUS_PREFIX}/{name}"
+    # the barrier's wait IS the interesting duration on the timeline: a
+    # follower stuck here is a rank waiting on host 0, visible as one
+    # long coord span instead of an unexplained step gap
+    w0 = time.time()  # tracelint: ok[impure-call]
+    p0 = time.monotonic()  # tracelint: ok[impure-call]
+
+    def _span(role, status):
+        if _tracing._on[0]:
+            _tracing.emit_span("rendezvous", "coord", w0,
+                               time.monotonic() - p0, name=name,  # tracelint: ok[impure-call] host-side span duration; same wall-clock-by-design contract as the barrier itself
+                               role=role, status=status)
+
     if leader:
         doc = {"payload": payload, "wall": time.time()}  # tracelint: ok[impure-call]
         store.put(key, doc)
         _telemetry.emit("rendezvous", name=name, role="leader",
                         status="published")
+        _span("leader", "published")
         return payload
     fault_point("coordination.rendezvous", name=name)
     deadline = time.monotonic() + float(timeout)  # tracelint: ok[impure-call]
@@ -540,11 +576,13 @@ def rendezvous(store=None, name=None, payload=None, timeout=60.0,
                 min_wall is None or float(doc.get("wall", 0)) >= min_wall):
             _telemetry.emit("rendezvous", name=name, role="follower",
                             status="ok")
+            _span("follower", "ok")
             return doc["payload"]
         if time.monotonic() >= deadline:  # tracelint: ok[impure-call]
             record_fault("rendezvous_timeouts",
                          f"{name}: no publication within {timeout}s")
             _telemetry.emit("rendezvous", name=name, role="follower",
                             status="timeout", timeout=timeout)
+            _span("follower", "timeout")
             return None
         time.sleep(min(poll, max(0.0, deadline - time.monotonic())))  # tracelint: ok[impure-call]

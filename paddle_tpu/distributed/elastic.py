@@ -38,6 +38,7 @@ import warnings
 
 from ..core.dispatch import non_jittable
 from ..runtime import telemetry as _telemetry
+from ..runtime import tracing as _tracing
 from ..runtime.resilience import (
     BadStepGuard, atomic_write_json, fault_point, record_fault,
 )
@@ -184,21 +185,27 @@ class ElasticManager:
             with self._state_lock:
                 if self._last_step != step:
                     return True
-            heartbeat(self._hb_path, step, payload)
-            if self.cluster is not None:
-                # same no-fsync contract as the local file; a store that
-                # briefly errors makes this rank LOOK stale to peers,
-                # which is precisely what the fault event records
-                try:
-                    _publish_heartbeat(self.cluster.store,
-                                       self.cluster.rank, step, payload)
-                except Exception as e:  # noqa: BLE001 — a pluggable (KV)
-                    # store can raise more than OSError; no store error
-                    # may ever propagate into the step loop
-                    record_fault("watchdog_errors",
-                                 f"cluster heartbeat rank "
-                                 f"{self.cluster.rank}: "
-                                 f"{type(e).__name__}: {e}")
+            # heartbeat publication span (local file + cluster store):
+            # a slow shared filesystem shows up as a fat coord lane on
+            # the timeline instead of a mystery step-time tax
+            with _tracing.span("heartbeat", "coord", step=step):
+                heartbeat(self._hb_path, step, payload)
+                if self.cluster is not None:
+                    # same no-fsync contract as the local file; a store
+                    # that briefly errors makes this rank LOOK stale to
+                    # peers, which is precisely what the fault event
+                    # records
+                    try:
+                        _publish_heartbeat(self.cluster.store,
+                                           self.cluster.rank, step, payload)
+                    except Exception as e:  # noqa: BLE001 — a pluggable
+                        # (KV) store can raise more than OSError; no
+                        # store error may ever propagate into the step
+                        # loop
+                        record_fault("watchdog_errors",
+                                     f"cluster heartbeat rank "
+                                     f"{self.cluster.rank}: "
+                                     f"{type(e).__name__}: {e}")
             if self.save_fn is not None and self.save_interval and \
                     step > 0 and step % self.save_interval == 0:
                 self.save_fn(step)
@@ -254,6 +261,8 @@ class ElasticManager:
                          f"(step {hb.get('step')})")
             _telemetry.emit("watchdog_stall", reason=reason,
                             step=hb.get("step"), timeout=self.timeout)
+            _tracing.instant("watchdog_stall", "coord", reason=reason,
+                             step=hb.get("step"))
             if on_stall is not None:
                 try:
                     on_stall({**hb, "reason": reason})
@@ -264,43 +273,50 @@ class ElasticManager:
         def _watch():
             monitor_armed = False
             while not self._stop.wait(poll):
-                try:
-                    stall = _watchdog_scan(
-                        self._hb_path, started, state, self.timeout,
-                        self.step_deadline, self.run_deadline)
-                except Exception as e:  # noqa: BLE001 — survive own bugs
-                    record_fault("watchdog_errors",
-                                 f"{type(e).__name__}: {e}")
-                    continue
-                with self._state_lock:
-                    last_step = self._last_step
-                if not monitor_armed and self._monitor is not None \
-                        and last_step is not None:
-                    # a rank starts judging its PEERS' liveness only
-                    # once it is ticking itself, with a fresh grace
-                    # window from that moment: compile-time skew across
-                    # ranks (minutes on a cold start) must read as
-                    # bring-up, not staleness. Before this rank's first
-                    # tick, its own LOCAL no_heartbeat deadline is the
-                    # only liveness judge it is entitled to.
-                    monitor_armed = True
-                    self._monitor.reset_grace()
-                if stall is None and monitor_armed:
-                    # cluster quorum scan: peer_stale/peer_dead fault
-                    # events are recorded by the monitor itself; only a
-                    # QUORUM of stale ranks escalates to the stall path
+                # one span per poll iteration: local heartbeat scan +
+                # (cluster mode) the quorum scan — the watchdog's cost
+                # and its verdicts both land on the timeline
+                with _tracing.span("watchdog_scan", "coord"):
                     try:
-                        scan = self._monitor.poll()
-                    except Exception as e:  # noqa: BLE001 — survive store
+                        stall = _watchdog_scan(
+                            self._hb_path, started, state, self.timeout,
+                            self.step_deadline, self.run_deadline)
+                    except Exception as e:  # noqa: BLE001 — own bugs
                         record_fault("watchdog_errors",
-                                     f"cluster scan: {type(e).__name__}: {e}")
-                        scan = None
-                    if scan is not None and scan["quorum_stalled"]:
-                        stall = ("quorum_stale",
-                                 {"step": last_step, **scan})
-                if stall is not None:
-                    _stall(*stall)
-                    return
+                                     f"{type(e).__name__}: {e}")
+                        continue
+                    with self._state_lock:
+                        last_step = self._last_step
+                    if not monitor_armed and self._monitor is not None \
+                            and last_step is not None:
+                        # a rank starts judging its PEERS' liveness only
+                        # once it is ticking itself, with a fresh grace
+                        # window from that moment: compile-time skew
+                        # across ranks (minutes on a cold start) must
+                        # read as bring-up, not staleness. Before this
+                        # rank's first tick, its own LOCAL no_heartbeat
+                        # deadline is the only liveness judge it is
+                        # entitled to.
+                        monitor_armed = True
+                        self._monitor.reset_grace()
+                    if stall is None and monitor_armed:
+                        # cluster quorum scan: peer_stale/peer_dead
+                        # fault events are recorded by the monitor
+                        # itself; only a QUORUM of stale ranks escalates
+                        # to the stall path
+                        try:
+                            scan = self._monitor.poll()
+                        except Exception as e:  # noqa: BLE001 — store
+                            record_fault(
+                                "watchdog_errors",
+                                f"cluster scan: {type(e).__name__}: {e}")
+                            scan = None
+                        if scan is not None and scan["quorum_stalled"]:
+                            stall = ("quorum_stale",
+                                     {"step": last_step, **scan})
+                    if stall is not None:
+                        _stall(*stall)
+                        return
 
         self._watch = threading.Thread(target=_watch, daemon=True)
         self._watch.start()

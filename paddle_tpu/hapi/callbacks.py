@@ -8,6 +8,7 @@ import time
 import numpy as np
 
 from ..runtime import telemetry as _telemetry
+from ..runtime import tracing as _tracing
 
 __all__ = ["Callback", "CallbackList", "ProgBarLogger", "ModelCheckpoint",
            "LRScheduler", "EarlyStopping", "VisualDL", "ReduceLROnPlateau",
@@ -388,6 +389,11 @@ class TelemetryCallback(Callback):
         steps_c, step_h, loss_g, thr_g, gn_g = self._metrics()
         steps_c.inc()
         step_h.observe(dt)
+        # whole-step span from the SAME dt the histogram observed: the
+        # timeline's step lane reconciles exactly with
+        # paddle_tpu_step_seconds (tracing.reconcile_with_metrics)
+        _tracing.emit_span("train_step", "step", time.time() - dt, dt,
+                           step=self.global_step)
         if loss is not None:
             loss_g.set(float(loss))
         if throughput is not None:
@@ -421,6 +427,13 @@ class TelemetryCallback(Callback):
                 # textfile collector); push_prometheus itself degrades
                 # a dead gateway to a warning + push_failures event
                 _telemetry.push_prometheus()
+            if _telemetry.otlp_endpoint():
+                # opt-in OTLP/HTTP export to an OpenTelemetry
+                # collector; same degrade-to-warning contract
+                _telemetry.push_otlp()
+            # keep the span timeline as durable as the metrics at every
+            # export boundary (the unflushed tail is all a crash loses)
+            _tracing.flush()
             if self.snapshot_jsonl:
                 _telemetry.append_snapshot_jsonl(
                     extra={"step": self.global_step})
@@ -688,6 +701,10 @@ class ResilienceCallback(Callback):
         if ctx is None:
             return
         try:
+            # flush this rank's span buffer BEFORE the leader merges:
+            # the cluster timeline covers every rank up to its latest
+            # checkpoint boundary, not its latest buffer overflow
+            _tracing.flush()
             self._mngr.publish_complete(ctx.store, ctx.rank)
             _telemetry.sync_runtime_metrics()
             _telemetry.publish_registry(ctx.store, ctx.rank)

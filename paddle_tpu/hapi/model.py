@@ -9,6 +9,7 @@ TPU parity/win over the reference's op-by-op dygraph step (SURVEY §3).
 from __future__ import annotations
 
 import os
+import time
 
 import jax
 import jax.numpy as jnp
@@ -21,6 +22,8 @@ from ..framework.io import load as _load
 from ..framework.io import save as _save
 from ..io import DataLoader, Dataset
 from ..metric import Metric
+from ..runtime import telemetry as _telemetry
+from ..runtime import tracing as _tracing
 from .callbacks import CallbackList, config_callbacks
 
 __all__ = ["Model"]
@@ -439,14 +442,30 @@ class Model:
             cbks.on_epoch_begin(epoch)
             self._reset_metrics()
             logs = {}
-            for step, batch in enumerate(loader):
+            # manual iteration so the loader's next() is measurable:
+            # "step time waiting on data" is the input-pipeline gauge
+            # ROADMAP item 4 needs before async staging can prove a win
+            data_iter = iter(loader)
+            step = 0
+            while True:
+                w0 = time.time()
+                t0 = time.perf_counter()
+                try:
+                    batch = next(data_iter)
+                except StopIteration:
+                    break
+                self._note_data_wait(time.perf_counter() - t0, w0)
                 cbks.on_batch_begin("train", step, logs)
                 xs, ys = self._split_batch(batch)
-                res = self.train_batch(xs, ys,
-                                       update=(step + 1) % acc_k == 0)
+                with _tracing.span("train_batch", "compute",
+                                   epoch=epoch, step=step):
+                    res = self.train_batch(xs, ys,
+                                           update=(step + 1) % acc_k == 0)
                 logs = self._res_to_logs(res, step, batch_size)
-                cbks.on_batch_end("train", step, logs)
+                with _tracing.span("callbacks", "callback"):
+                    cbks.on_batch_end("train", step, logs)
                 it += 1
+                step += 1
                 if num_iters is not None and it >= num_iters:
                     self.stop_training = True
                 if self.stop_training:
@@ -468,6 +487,24 @@ class Model:
             cbks.on_epoch_end(epoch, logs)
         cbks.on_end("train", logs)
         return self
+
+    def _note_data_wait(self, seconds, wall_start):
+        """Input-pipeline visibility: per-batch loader wait as a
+        histogram + last-value gauge (printed by profiler.summary) and
+        a timeline span emitted from the SAME measurement — so
+        `tracing.reconcile_with_metrics` can hold the two accountable
+        to each other."""
+        try:
+            _telemetry.histogram(
+                "paddle_tpu_data_wait_seconds",
+                "train step time spent waiting on the input pipeline"
+            ).observe(seconds)
+            _telemetry.gauge(
+                "paddle_tpu_data_wait_seconds_last",
+                "last train batch's input-pipeline wait").set(seconds)
+        except Exception:  # noqa: BLE001 — telemetry must never kill fit
+            pass
+        _tracing.emit_span("data_wait", "data", wall_start, seconds)
 
     def _run_eval(self, loader, cbks, batch_size):
         self._reset_metrics()

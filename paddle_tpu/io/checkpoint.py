@@ -50,6 +50,7 @@ import numpy as np
 
 from ..core.tensor import Tensor
 from ..runtime import telemetry as _telemetry
+from ..runtime import tracing as _tracing
 from ..runtime.resilience import (
     IntegrityError, fault_point, record_fault, retry_with_backoff,
     atomic_write_json,
@@ -381,6 +382,10 @@ class CheckpointManager:
                 "paddle_tpu_checkpoint_save_seconds",
                 "checkpoint save call duration (enqueue, for async saves)"
             ).observe(seconds)
+            # span from the SAME measured duration as the histogram
+            # observation — the reconciliation contract
+            _tracing.emit_span("save", "checkpoint", time.time() - seconds,
+                               seconds, step=step, accepted=bool(accepted))
         except Exception:  # noqa: BLE001
             pass
 
@@ -397,6 +402,9 @@ class CheckpointManager:
                 "paddle_tpu_checkpoint_restore_seconds",
                 "checkpoint restore duration (incl. fallbacks)"
             ).observe(seconds)
+            _tracing.emit_span("restore", "checkpoint",
+                               time.time() - seconds, seconds, step=step,
+                               fell_back=fell_back)
         except Exception:  # noqa: BLE001
             pass
 
@@ -494,17 +502,18 @@ class CheckpointManager:
         "newest complete" pointing at divergent state. Returns the
         steps removed."""
         removed = []
-        for s in complete_steps(self.directory):
-            if s <= int(step):
-                continue
-            try:
-                self._mngr.delete(s)  # orbax keeps its bookkeeping
-            except Exception:  # noqa: BLE001 — fall back to the fs
-                import shutil
+        with _tracing.span("discard_after", "checkpoint", after=int(step)):
+            for s in complete_steps(self.directory):
+                if s <= int(step):
+                    continue
+                try:
+                    self._mngr.delete(s)  # orbax keeps its bookkeeping
+                except Exception:  # noqa: BLE001 — fall back to the fs
+                    import shutil
 
-                shutil.rmtree(self._step_dir(s), ignore_errors=True)
-            self._pending_manifests.pop(s, None)
-            removed.append(s)
+                    shutil.rmtree(self._step_dir(s), ignore_errors=True)
+                self._pending_manifests.pop(s, None)
+                removed.append(s)
         if removed:
             _telemetry.emit("checkpoint_discard", after=int(step),
                             steps=removed)
@@ -516,17 +525,21 @@ class CheckpointManager:
     def wait(self):
         """Block until queued async saves are durable on disk. An async
         save that failed surfaces here: warning + fault event, not an
-        exception (the run survives; the previous checkpoint stands)."""
-        try:
-            self._mngr.wait_until_finished()
-        except Exception as e:  # noqa: BLE001 — degrade, never kill training
-            record_fault("save_failures",
-                         f"async save: {type(e).__name__}: {e}")
-            warnings.warn(
-                f"paddle_tpu checkpoint: async save failed "
-                f"({type(e).__name__}: {e}) — training continues from the "
-                "previous checkpoint", stacklevel=2)
-        self._flush_manifests()
+        exception (the run survives; the previous checkpoint stands).
+        Span-traced ("checkpoint/async_wait"): the async-commit stall
+        is exactly the kind of step-time sink the timeline exists to
+        expose."""
+        with _tracing.span("async_wait", "checkpoint"):
+            try:
+                self._mngr.wait_until_finished()
+            except Exception as e:  # noqa: BLE001 — degrade, never kill
+                record_fault("save_failures",
+                             f"async save: {type(e).__name__}: {e}")
+                warnings.warn(
+                    f"paddle_tpu checkpoint: async save failed "
+                    f"({type(e).__name__}: {e}) — training continues from "
+                    "the previous checkpoint", stacklevel=2)
+            self._flush_manifests()
 
     def close(self):
         self.wait()

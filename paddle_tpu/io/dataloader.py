@@ -12,6 +12,7 @@ import threading
 import numpy as np
 
 from ..core.tensor import Tensor
+from ..runtime import tracing as _tracing
 from .dataset import IterableDataset
 from .sampler import BatchSampler
 
@@ -174,9 +175,15 @@ class DataLoader:
             return self._pool
 
     def _unstage(self, staged):
-        """Device-put the slot's views, then recycle the slot."""
+        """Device-put the slot's views, then recycle the slot.
+        Span-traced ("io/unstage"): the staging-ring consume cost is
+        part of the data-wait story the timeline decomposes."""
         import jax
 
+        with _tracing.span("unstage", "io", slot=staged.slot):
+            return self._unstage_impl(jax, staged)
+
+    def _unstage_impl(self, jax, staged):
         views = self._pool.view_arrays(staged.slot, staged.meta)
         # synchronous host copy before releasing: the CPU backend zero-copy
         # ALIASES aligned buffers, and block_until_ready can return early on
@@ -267,7 +274,11 @@ class DataLoader:
         deadline = None
         try:
             for i in range(total):
-                with cond:
+                # the consumer-side queue wait: when workers can't keep
+                # up, this span (not the collation itself) is where the
+                # data-wait time lives on the timeline
+                with _tracing.span("data_queue_wait", "io", batch=i), \
+                        cond:
                     if self.timeout:
                         deadline = _time.time() + self.timeout
                     while i not in out:

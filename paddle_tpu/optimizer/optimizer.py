@@ -20,6 +20,7 @@ from ..core import fusion as _fusion
 from ..core.fusion import concrete as _concrete
 from ..core.tensor import Tensor
 from ..nn.clip import ClipGradBase
+from ..runtime import tracing as _tracing
 from .lr import LRScheduler
 
 __all__ = ["Optimizer", "set_fused_step_recording"]
@@ -331,6 +332,16 @@ class Optimizer:
         return jax.tree_util.tree_unflatten(out_td, lazy)
 
     def step(self):
+        # span-tracer phase boundary: the optimizer update (and, under
+        # fusion, the flush its _concrete boundary forces — a nested
+        # span, so it is not double counted) as one "optimizer" span
+        if not _tracing._on[0]:
+            return self._step_impl()
+        with _tracing.span("opt_step", "optimizer",
+                           opt=type(self).__name__):
+            return self._step_impl()
+
+    def _step_impl(self):
         params = [p for p in self._param_list
                   if not p.stop_gradient and p._grad is not None
                   and getattr(p, "trainable", True)]

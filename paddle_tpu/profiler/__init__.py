@@ -307,6 +307,7 @@ class Profiler:
             print("fault events: "
                   + ", ".join(f"{k}: {v}" for k, v in sorted(fe.items())))
         self._telemetry_summary(op_detail)
+        self._tracing_summary()
         if self._dir:
             print(f"trace artifacts: {self._dir}")
 
@@ -350,6 +351,24 @@ class Profiler:
                       f"{s['labels'].get('op')}: "
                       f"{s['sum'] / s['count'] * 1e3:.2f}ms"
                       for s in top if s["count"]))
+        dw = snap.get("paddle_tpu_data_wait_seconds")
+        if dw and dw["series"] and dw["series"][0]["count"]:
+            # input-pipeline stall time (Model.fit times the loader's
+            # next() per batch) — the visibility prerequisite for the
+            # async-staging ROADMAP item
+            s = dw["series"][0]
+            print(f"  data wait: {s['sum']:.3f}s over {s['count']} "
+                  f"batches (avg {s['sum'] / s['count'] * 1e3:.2f}ms)")
+
+    @staticmethod
+    def _tracing_summary():
+        """Span-timeline section (runtime/tracing.py): per-phase totals
+        and the top spans by self time, plus the trace file Perfetto
+        loads. Silent when tracing never recorded anything."""
+        from ..runtime import tracing as _tr
+
+        for line in _tr.summary_lines():
+            print(line)
 
     def export(self, path=None, format="json"):
         """The jax trace directory holds the exported artifacts."""

@@ -76,6 +76,7 @@ __all__ = [
     "append_snapshot_jsonl", "ScalarsSink", "merge_histograms",
     "publish_registry", "merge_cluster",
     "pushgateway_addr", "push_prometheus",
+    "otlp_endpoint", "push_otlp",
     "sync_runtime_metrics", "poll_memory_gauges",
     "schema", "SCHEMA_VERSION", "EVENT_KINDS",
     "DEFAULT_BUCKETS", "op_sample_every",
@@ -734,6 +735,137 @@ def push_prometheus(addr=None, snap=None, job="paddle_tpu", instance=None,
 
 
 # ---------------------------------------------------------------------------
+# OTLP exporter (opt-in): OTLP/HTTP JSON to any OpenTelemetry collector,
+# stdlib only — the carried ROADMAP follow-up next to the pushgateway
+
+def otlp_endpoint():
+    """``PADDLE_TPU_TELEMETRY_OTLP`` as a collector base URL (e.g.
+    ``http://collector:4318`` or ``collector:4318``), or None (the
+    exporter is strictly opt-in)."""
+    return os.environ.get("PADDLE_TPU_TELEMETRY_OTLP") or None
+
+
+def _otlp_attrs(labels):
+    return [{"key": k, "value": {"stringValue": str(v)}}
+            for k, v in labels.items()]
+
+
+# cumulative-series start timestamp: collectors use it for reset
+# detection across process restarts (a restarted trainer's counters
+# drop to ~0; without a start time a rate pipeline misreads that as a
+# negative delta). Process start is the registry's effective epoch.
+_OTLP_START_NS = int(time.time() * 1e9)
+
+
+def _otlp_payload(snap, now_ns=None):
+    """An ExportMetricsServiceRequest (OTLP/HTTP JSON encoding) from a
+    registry snapshot: counters -> monotonic cumulative sums, gauges ->
+    gauges, histograms -> cumulative explicit-bounds histograms. Int64
+    fields are strings per the OTLP JSON mapping."""
+    now_ns = now_ns if now_ns is not None else int(time.time() * 1e9)
+    metrics = []
+    for name in sorted(snap):
+        fam = snap[name]
+        if fam["type"] == "histogram":
+            dps = []
+            for s in fam["series"]:
+                dps.append({
+                    "startTimeUnixNano": str(_OTLP_START_NS),
+                    "timeUnixNano": str(now_ns),
+                    "count": str(int(s["count"])),
+                    "sum": float(s["sum"]),
+                    "bucketCounts": [str(int(c))
+                                     for c in s["bucket_counts"]],
+                    "explicitBounds": [float(b) for b in fam["buckets"]],
+                    "attributes": _otlp_attrs(s["labels"]),
+                })
+            metrics.append({"name": name,
+                            "description": fam.get("help", ""),
+                            "histogram": {"dataPoints": dps,
+                                          "aggregationTemporality": 2}})
+            continue
+        dps = [{"timeUnixNano": str(now_ns),
+                "asDouble": float(s["value"]),
+                "attributes": _otlp_attrs(s["labels"])}
+               for s in fam["series"]]
+        if fam["type"] == "counter":
+            for dp in dps:
+                dp["startTimeUnixNano"] = str(_OTLP_START_NS)
+            metrics.append({"name": name,
+                            "description": fam.get("help", ""),
+                            "sum": {"dataPoints": dps,
+                                    "aggregationTemporality": 2,
+                                    "isMonotonic": True}})
+        else:
+            metrics.append({"name": name,
+                            "description": fam.get("help", ""),
+                            "gauge": {"dataPoints": dps}})
+    resource = [{"key": "service.name",
+                 "value": {"stringValue": "paddle_tpu"}},
+                {"key": "host.name",
+                 "value": {"stringValue": socket.gethostname()}}]
+    if _rank is not None:
+        resource.append({"key": "paddle_tpu.rank",
+                         "value": {"stringValue": str(_rank)}})
+    return {"resourceMetrics": [{
+        "resource": {"attributes": resource},
+        "scopeMetrics": [{"scope": {"name": "paddle_tpu.telemetry"},
+                          "metrics": metrics}]}]}
+
+
+def push_otlp(endpoint=None, snap=None, timeout=2.0):
+    """POST the registry (or `snap`) to an OTLP/HTTP collector at
+    ``<endpoint>/v1/metrics`` as OTLP JSON. Returns True on an
+    accepted export. EVERY failure path (no listener, HTTP error,
+    timeout, bad endpoint) degrades to a warning + `push_failures`
+    fault event and returns False — a dead collector must never raise
+    into the training loop, the same contract as the pushgateway."""
+    endpoint = endpoint or otlp_endpoint()
+    if endpoint is None:
+        return False
+    try:
+        import http.client
+        import urllib.parse
+
+        if "//" not in endpoint:
+            endpoint = "http://" + endpoint
+        u = urllib.parse.urlsplit(endpoint)
+        path = u.path.rstrip("/")
+        if not path.endswith("/v1/metrics"):
+            path += "/v1/metrics"
+        body = json.dumps(_otlp_payload(
+            snap if snap is not None else _REGISTRY.snapshot())).encode()
+        cls = (http.client.HTTPSConnection if u.scheme == "https"
+               else http.client.HTTPConnection)
+        conn = cls(u.hostname,
+                   u.port or (443 if u.scheme == "https" else 4318),
+                   timeout=float(timeout))
+        try:
+            conn.request("POST", path, body=body,
+                         headers={"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            resp.read()
+            status = resp.status
+        finally:
+            conn.close()
+        if status >= 300:
+            raise OSError(f"OTLP collector returned HTTP {status}")
+    except Exception as e:  # noqa: BLE001 — degrade, never raise into fit
+        from .resilience import record_fault  # lazy: no import cycle
+
+        record_fault("push_failures",
+                     f"otlp {endpoint}: {type(e).__name__}: {e}")
+        import warnings
+
+        warnings.warn(
+            f"paddle_tpu telemetry: OTLP export to {endpoint} failed "
+            f"({type(e).__name__}: {e}) — metrics dropped for this "
+            "interval, training continues", stacklevel=2)
+        return False
+    return True
+
+
+# ---------------------------------------------------------------------------
 # cross-host aggregation: per-rank publication + host-0 merge
 
 MERGE_STATE_BASENAME = "merge_state.json"
@@ -768,7 +900,7 @@ def _tail_jsonl(path, offset):
     return records, offset + end + 1
 
 
-def _load_merge_state(out_dir):
+def _load_merge_state(out_dir, key="ranks"):
     if not out_dir:
         return {}
     try:
@@ -778,8 +910,8 @@ def _load_merge_state(out_dir):
         return {}
     if doc.get("version") != MERGE_STATE_VERSION:
         return {}
-    ranks = doc.get("ranks")
-    return dict(ranks) if isinstance(ranks, dict) else {}
+    sub = doc.get(key)
+    return dict(sub) if isinstance(sub, dict) else {}
 
 
 def _head_signature(path):
@@ -863,6 +995,123 @@ def _tail_rank_events(path, st, rank):
         del faults[:len(faults) - _MERGE_FAULTS_CAP]
     st["offset"] = offset
     return st
+
+
+def _trace_sources(root):
+    """Per-process Chrome trace files to merge: every ``trace-*.json``
+    under ``<store root>/traces/`` (the cluster default — ranks point
+    ``PADDLE_TPU_TRACE`` at a shared dir under the store), plus — best
+    effort — this host's own configured trace dir wherever it lives
+    (it may be a store subdir other than ``traces/``, or a local dir
+    in a single-host multi-process cluster). A rank tracing to a local
+    dir on a DEAD host is unreachable from host 0; the merged timeline
+    then covers that rank only up to what it wrote into the store, the
+    same visibility trade-off init_cluster_telemetry warns about for
+    the event stream."""
+    roots = []
+    if root:
+        roots.append(os.path.join(root, "traces"))
+    from . import tracing as _tracing  # lazy: tracing imports telemetry
+
+    td = _tracing.trace_dir()
+    if td:
+        roots.append(td)
+    out, seen = [], set()
+    for r in roots:
+        for dirpath, _dirs, files in os.walk(r):
+            for fn in sorted(files):
+                if fn.startswith(_tracing.TRACE_BASENAME_PREFIX) and \
+                        fn.endswith(".json"):
+                    p = os.path.abspath(os.path.join(dirpath, fn))
+                    if p not in seen:
+                        seen.add(p)
+                        out.append(p)
+    return out
+
+
+def _trace_head_signature(path):
+    """Incarnation signature for a Chrome trace file. The first line of
+    EVERY trace file is the identical ``[`` array opener, so (unlike
+    the event streams) the first-line hash cannot tell two files apart
+    — hash the SECOND line instead: the first buffered record, the
+    process metadata whose os_pid differs per incarnation. Returns ""
+    until that line is complete — which is also before any record line
+    exists, so an empty->nonempty transition can only reset a tail
+    that had consumed nothing but the opener."""
+    import hashlib
+
+    try:
+        with open(path, "rb") as f:
+            head = f.read(1024)
+    except OSError:
+        return ""
+    rest = head.partition(b"\n")[2]
+    line, nl, _ = rest.partition(b"\n")
+    if not nl:
+        return ""
+    return hashlib.sha1(line[:512]).hexdigest()
+
+
+def _merge_trace_files(sources, out_path, state):
+    """Tail each per-process trace file from its persisted byte offset
+    (the PR-8 event-stream pattern: O(new bytes) per boundary, offset
+    reset on relaunch/truncation via the head signature) and append the
+    complete events to ONE merged Chrome trace at `out_path`. Every
+    event already carries its rank as ``pid`` (the tracer lanes on the
+    cluster rank), so the merged file IS the cluster timeline. Returns
+    the number of events appended."""
+    lines_out = []
+    for path in sources:
+        key = "/".join(path.replace(os.sep, "/").rsplit("/", 2)[-2:])
+        st = state.get(key)
+        if not isinstance(st, dict):
+            st = {}
+        try:
+            size = os.path.getsize(path)
+        except OSError:
+            continue
+        offset = int(st.get("offset", 0))
+        head = _trace_head_signature(path)
+        if size < offset or (offset > 0 and head != st.get("head")):
+            # relaunched incarnation writes a NEW file name (pid-keyed),
+            # so a reset here means the same path was truncated/replaced
+            # (pid recycling) — re-tail from 0; span events are
+            # append-only so the worst case is a duplicated prefix in
+            # the merged view
+            offset = 0
+        try:
+            with open(path, "rb") as f:
+                f.seek(offset)
+                data = f.read()
+        except OSError:
+            continue
+        end = data.rfind(b"\n")
+        if end < 0:
+            continue
+        for raw in data[:end].split(b"\n"):
+            s = raw.strip().rstrip(b",")
+            if not s or s in (b"[", b"]"):
+                continue
+            if s.endswith(b"]"):  # the "{}]"-style terminator line
+                s = s[:-1].rstrip().rstrip(b",")
+                if not s:
+                    continue
+            try:
+                ev = json.loads(s)
+            except ValueError:
+                continue
+            if ev:  # drop the {} comma pad
+                lines_out.append(json.dumps(ev, default=str) + ",\n")
+        state[key] = {"offset": offset + end + 1, "head": head}
+    if not lines_out and os.path.exists(out_path):
+        return 0
+    fresh = not os.path.exists(out_path) or os.path.getsize(out_path) == 0
+    with open(out_path, "a") as f:
+        if fresh:
+            f.write("[\n")
+        f.write("".join(lines_out))
+        f.flush()
+    return len(lines_out)
 
 
 def publish_registry(store, rank=None, extra=None):
@@ -985,6 +1234,7 @@ def merge_cluster(store, out_dir=None, push=False):
     if out_dir is None and root is not None:
         out_dir = os.path.join(root, "merged")
     state_ranks = _load_merge_state(out_dir)
+    trace_state = _load_merge_state(out_dir, "traces")
     if root:
         events_root = os.path.join(root, "events")
         try:
@@ -1040,7 +1290,7 @@ def merge_cluster(store, out_dir=None, push=False):
     fault_recs.sort(key=lambda r: (r.get("ts") or 0.0, r["rank"]))
     out = {"ranks": sorted(set(ranks)), "fault_count": len(fault_recs),
            "prom_path": None, "faults_path": None, "snapshot": {},
-           "faults": fault_recs}
+           "faults": fault_recs, "trace_path": None, "trace_events": 0}
     try:
         # inside the guard: ranks running skewed versions can publish
         # incompatible snapshots (histogram bucket layouts differ →
@@ -1060,16 +1310,36 @@ def merge_cluster(store, out_dir=None, push=False):
                 f.write(json.dumps(r, default=str) + "\n")
         os.replace(tmp, faults_path)
         out["faults_path"] = faults_path
-        if root:
+        # span-trace merge: ONE Perfetto-loadable cluster timeline from
+        # the per-process trace files (byte-offset tailed like the
+        # event streams — O(new bytes) per checkpoint boundary). Every
+        # event lanes on its rank (the tracer writes pid=rank), so a
+        # multihost stall reads as overlapping spans, not counters.
+        from . import tracing as _tracing  # lazy: tracing imports us
+
+        _tracing.flush()  # host-0's own unflushed spans must be tailable
+        trace_sources = _trace_sources(root)
+        if trace_sources:
+            tpath = os.path.join(out_dir, "cluster_trace.json")
+            n_tr = _merge_trace_files(trace_sources, tpath, trace_state)
+            out["trace_path"] = tpath
+            out["trace_events"] = n_tr
+            emit("trace_merge", files=len(trace_sources), events=n_tr)
+        if root or trace_state:
             # persist the tail state AFTER the outputs landed: a merge
             # that dies mid-write re-tails from the previous offsets
-            # next time, and the exact-duplicate dedup absorbs the
-            # overlap (never the reverse — offsets past unwritten data)
+            # next time. For FAULT records the exact-duplicate dedup
+            # absorbs the overlap; the append-only trace merge has no
+            # dedup, so a crashed merge can duplicate spans in the
+            # cluster timeline — identical spans overlay invisibly in
+            # Perfetto, a far better failure than the reverse ordering
+            # (offsets past unwritten data = spans silently LOST)
             spath = os.path.join(out_dir, MERGE_STATE_BASENAME)
             stmp = f"{spath}.tmp.{os.getpid()}.{threading.get_ident()}"
             with open(stmp, "w") as f:
                 json.dump({"version": MERGE_STATE_VERSION,
-                           "ranks": state_ranks}, f)
+                           "ranks": state_ranks,
+                           "traces": trace_state}, f)
             os.replace(stmp, spath)
         if push:
             push_prometheus(snap=merged, instance="cluster")
@@ -1343,6 +1613,11 @@ METRIC_NAMES = (
     "paddle_tpu_grad_norm",
     "paddle_tpu_checkpoint_save_seconds",
     "paddle_tpu_checkpoint_restore_seconds",
+    # input-pipeline visibility (ROADMAP item 4's prerequisite): per-
+    # batch "step time waiting on data", recorded by Model.fit around
+    # the loader's next() and reconciled against the data_wait spans
+    "paddle_tpu_data_wait_seconds",
+    "paddle_tpu_data_wait_seconds_last",
 )
 
 # every event `kind` the stack emits into the structured stream
@@ -1366,6 +1641,8 @@ EVENT_KINDS = (
     "cluster_merge",      # host-0 cross-rank telemetry + fault-log merge
     "checkpoint_discard",  # coordinated-restart truncation: steps newer
     #                        than the agreed restore step were deleted
+    "trace_merge",        # host-0 span-trace merge into the cluster
+    #                       timeline (runtime/tracing.py)
 )
 
 

@@ -1,0 +1,681 @@
+"""Structured span tracing: a per-step timeline from dispatch to
+cluster, Perfetto-loadable, reconciled with telemetry.
+
+The metrics registry (PR 5) and the flush-site attribution (PR 11) can
+count nearly everything the runtime does, but counters have no time
+axis: nothing answers "where did step k's wall time go — data wait,
+trace recording, fused compile, flush execution, checkpoint, or a
+stalled peer?" LazyTensor-style deferred-execution systems live or die
+by understanding their trace/flush boundaries *in time*, and the
+TVM-style autotuning loop ROADMAP item 5 plans presupposes exactly
+this per-phase measurement. This module is that instrument: a
+process-wide span tracer emitting Chrome Trace Event Format JSON that
+loads directly in Perfetto / ``chrome://tracing``.
+
+Design, mirroring the telemetry layer's contracts:
+
+* **Opt-in + kill switch.** ``PADDLE_TPU_TRACE=<dir>`` (or
+  `configure(dir)`) turns tracing on; every producer across the stack
+  guards with one falsy check (``_on[0]``), so a disabled tracer costs
+  hot paths exactly one list-index truthiness test and dispatch stats
+  stay byte-identical to an untraced run (the kill-switch parity test
+  in tests/test_tracing.py locks this).
+* **Append-only, bounded buffers.** Spans buffer in memory (bounded by
+  ``PADDLE_TPU_TRACE_FLUSH_EVERY``, default 64) and flush as complete
+  JSON lines appended to the trace file — a ``kill -9`` loses at most
+  the unflushed tail, never the run's history (the PR-5 event-stream
+  durability contract). A per-process event cap
+  (``PADDLE_TPU_TRACE_MAX_EVENTS``) bounds the file; overflow drops
+  spans and counts them rather than growing without limit.
+* **Chrome Trace Event Format.** The file is a JSON array of complete
+  ("ph":"X") events — ``[`` then one object per line with a trailing
+  comma, terminated with ``]`` on clean close. Chrome's own tracers
+  emit exactly this shape and Perfetto accepts the unterminated form,
+  so a killed process's trace still loads. ``ts`` is wall-clock epoch
+  microseconds (cross-rank alignment in a merged timeline); durations
+  come from ``perf_counter`` so they survive NTP steps.
+* **Rank/pid/incarnation tags.** Every event's ``pid`` is the cluster
+  rank when one is set (telemetry.set_rank / PADDLE_TPU_CLUSTER_RANK),
+  else the OS pid; the per-process metadata record carries host, OS
+  pid (the incarnation — a relaunched rank is a new pid) and
+  ``PADDLE_TPU_CLUSTER_RUN_ID`` when exported. Per-process files are
+  named ``trace-<host>-<pid>.json`` so ranks sharing one directory
+  (the cluster default) never collide, and `telemetry.merge_cluster`
+  tails them by byte offset into ONE cluster timeline.
+* **Reconciliation.** Producers that already time an operation for the
+  metrics registry (checkpoint save/restore, sampled op runs, the
+  per-step histogram, data wait) emit their span from the SAME
+  measured duration, so `reconcile_with_metrics()` can assert the
+  per-phase span sums agree with ``dispatch_stats()`` / the telemetry
+  histograms — the timeline and the counters can never tell different
+  stories. tools/trace_smoke.py gates this in CI.
+
+Import-weight contract: stdlib only (core/dispatch.py imports this
+eagerly). Everything here is host-side control plane — wall-clock
+reads exactly like the telemetry layer, never run under a trace.
+"""
+from __future__ import annotations
+
+import atexit
+import itertools
+import json
+import os
+import socket
+import threading
+import time
+
+from . import telemetry as _telemetry
+
+__all__ = [
+    "configure", "enabled", "set_enabled", "trace_dir", "trace_path",
+    "tracer", "span", "emit_span", "instant", "set_span_arg",
+    "flush", "close",
+    "span_stats", "phase_totals", "reset_span_stats", "summary_lines",
+    "reconcile_with_metrics", "read_trace", "validate_trace",
+    "TRACE_BASENAME_PREFIX",
+]
+
+TRACE_BASENAME_PREFIX = "trace-"
+
+
+def _env_int(name, default):
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        return int(default)
+
+
+# the producer-side switch: ONE list-index truthiness check on every
+# hot path (the same idiom as fusion._ON). True only while a tracer is
+# configured AND the kill switch is on.
+_on = [False]
+
+_lock = threading.Lock()          # guards _tracer/_config swaps
+_tracer = None
+_config = {"dir": None}
+_killed = [False]                 # set_enabled(False) latch
+
+
+class _TLocal(threading.local):
+    stack = None  # list of live _Span frames (nesting/self-time)
+    tids = None   # {tracer token: small Chrome tid}, assigned lazily
+
+
+_tl = _TLocal()
+
+_next_tracer_token = itertools.count(1).__next__
+
+
+class SpanTracer:
+    """One process's trace file: buffered, append-only, thread-safe.
+
+    The buffer bound IS the durability bound: everything older than
+    ``flush_every`` spans is on disk, so a SIGKILL loses at most the
+    tail still in memory (tests/test_tracing.py proves it with a
+    killed child)."""
+
+    def __init__(self, path, flush_every=None, max_events=None):
+        self.path = path
+        self.flush_every = max(1, flush_every if flush_every is not None
+                               else _env_int("PADDLE_TPU_TRACE_FLUSH_EVERY",
+                                             64))
+        self.max_events = max(1, max_events if max_events is not None
+                              else _env_int("PADDLE_TPU_TRACE_MAX_EVENTS",
+                                            1_000_000))
+        self._lock = threading.Lock()
+        self._buf = []
+        self._meta_pid = None  # pid lane the last metadata record named
+        self._closed = False
+        self._host = socket.gethostname()
+        self._os_pid = os.getpid()
+        self._next_tid = 1
+        # never-recycled tracer token: the per-thread tid cache keys on
+        # it, so a reconfigured tracer re-assigns tids (and re-emits
+        # thread_name metadata) instead of inheriting stale ones
+        self._token = _next_tracer_token()
+        self.emitted = 0
+        self.dropped = 0
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        # "a": re-opening an existing path appends (a reconfigure to the
+        # same dir in one process must not truncate history); the "["
+        # array opener is written only for a fresh file. A previous
+        # CLEAN close terminated the array with "{}]" — strip it first,
+        # or every append would land past the "]" and the file would
+        # fail both validate_trace and a strict-JSON load forever.
+        fresh = not os.path.exists(path) or os.path.getsize(path) == 0
+        if not fresh:
+            self._strip_terminator(path)
+        self._f = open(path, "a")
+        if fresh:
+            self._f.write("[\n")
+            self._f.flush()
+
+    @staticmethod
+    def _strip_terminator(path):
+        """Remove the exact ``{}]`` close-terminator (plus trailing
+        whitespace) from an existing trace file so appends keep it
+        parseable; foreign/unterminated files are left untouched."""
+        try:
+            with open(path, "rb+") as f:
+                f.seek(0, os.SEEK_END)
+                size = f.tell()
+                back = min(size, 16)
+                f.seek(size - back)
+                tail = f.read(back)
+                stripped = tail.rstrip()
+                if stripped.endswith(b"{}]"):
+                    f.truncate(size - back + len(stripped) - 3)
+        except OSError:
+            pass
+
+    # -- identity ----------------------------------------------------------
+    def _pid(self):
+        # the Chrome "pid" lane: cluster rank when one is set (so a
+        # merged timeline shows one process track per rank), else the
+        # OS pid. Read per emit — the rank is set AFTER import in
+        # cluster bring-up (coordination.init_cluster_telemetry).
+        r = _telemetry.get_rank()
+        return self._os_pid if r is None else int(r)
+
+    def _tid(self):
+        m = _tl.tids
+        if m is None:
+            m = _tl.tids = {}
+        t = m.get(self._token)
+        if t is None:
+            with self._lock:
+                t = self._next_tid
+                self._next_tid += 1
+            m[self._token] = t
+            th = threading.current_thread()
+            # pid lane stamped at flush time, like every buffered record
+            self._push({"ph": "M", "name": "thread_name",
+                        "tid": t, "ts": 0,
+                        "args": {"name": th.name}})
+        return t
+
+    def _metadata(self, pid):
+        """The per-process metadata record (rank/pid/incarnation tags)
+        for one pid lane — emitted at flush time, and re-emitted when
+        the lane changes (rank assigned at cluster bring-up), so both
+        the pre-rank and rank lanes are named in Perfetto."""
+        r = _telemetry.get_rank()
+        name = (f"rank{r} " if r is not None else "") + \
+            f"{self._host}:{self._os_pid}"
+        args = {"name": name, "host": self._host, "os_pid": self._os_pid,
+                "incarnation": self._os_pid}
+        if r is not None:
+            args["rank"] = int(r)
+        run_id = os.environ.get("PADDLE_TPU_CLUSTER_RUN_ID")
+        if run_id:
+            args["run_id"] = run_id
+        return {"ph": "M", "name": "process_name", "pid": pid, "tid": 0,
+                "ts": 0, "args": args}
+
+    # -- emission ----------------------------------------------------------
+    def _push(self, rec):
+        # caller holds no lock; buffer append + bounded flush under ours
+        with self._lock:
+            if self._closed:
+                return
+            if self.emitted + len(self._buf) >= self.max_events:
+                self.dropped += 1  # bounded file: drop, count, never grow
+                return
+            self._buf.append(rec)
+            if len(self._buf) >= self.flush_every:
+                self._flush_locked()
+
+    def emit_complete(self, name, cat, wall_start, dur_s, args=None,
+                      tid=None):
+        """One complete ("X") span: `wall_start` epoch seconds,
+        `dur_s` a perf_counter-derived duration. The pid LANE is
+        stamped at flush time, not here — a span emitted before the
+        cluster rank was assigned but flushed after still lands on the
+        rank lane of a merged timeline."""
+        rec = {"name": name, "cat": cat, "ph": "X",
+               "ts": int(wall_start * 1e6),
+               "dur": max(0, int(dur_s * 1e6)),
+               "tid": self._tid() if tid is None else tid}
+        if args:
+            rec["args"] = args
+        self._push(rec)
+
+    def emit_instant(self, name, cat, args=None):
+        rec = {"name": name, "cat": cat, "ph": "i", "s": "p",
+               "ts": int(time.time() * 1e6),
+               "tid": self._tid()}
+        if args:
+            rec["args"] = args
+        self._push(rec)
+
+    def _flush_locked(self):
+        if not self._buf:
+            return
+        pid = self._pid()
+        if pid != self._meta_pid:
+            # name this lane (first flush, or the rank was assigned
+            # since — the old lane keeps its metadata, both stay named)
+            self._meta_pid = pid
+            self._buf.insert(0, self._metadata(pid))
+        lines = []
+        for rec in self._buf:
+            rec.setdefault("pid", pid)
+            try:
+                lines.append(json.dumps(rec, default=str) + ",\n")
+            except (TypeError, ValueError):
+                continue
+        self._buf = []
+        try:
+            self._f.write("".join(lines))  # threadlint: ok[CL003] append-only bounded-buffer flush under the lock IS the durability contract (same discipline as telemetry.EventStream)
+            self._f.flush()  # threadlint: ok[CL003] see above — everything older than flush_every spans must be on disk
+            self.emitted += len(lines)
+        except (OSError, ValueError):
+            pass  # closed file / full disk: drop, never raise into a step
+
+    def flush(self):
+        with self._lock:
+            self._flush_locked()
+
+    def close(self, terminate=True):
+        """Flush and (by default) terminate the JSON array — the file
+        parses as strict JSON after a clean close; a killed process
+        leaves the unterminated form Perfetto still accepts."""
+        with self._lock:
+            if self._closed:
+                return
+            self._flush_locked()
+            self._closed = True
+            try:
+                if terminate:
+                    self._f.write("{}]\n")  # trailing {} absorbs the comma  # threadlint: ok[CL003] the terminator must serialize with in-flight flushes — close IS the last write
+                self._f.close()
+            except (OSError, ValueError):
+                pass
+
+
+# ---------------------------------------------------------------------------
+# span aggregation (profiler.summary + reconciliation)
+
+_stats_lock = threading.Lock()
+# (cat, name) -> [count, total_s, self_s]
+_stats = {}
+
+
+def _note(cat, name, dur_s, self_s):
+    with _stats_lock:
+        ent = _stats.get((cat, name))
+        if ent is None:
+            _stats[(cat, name)] = [1, dur_s, self_s]
+        else:
+            ent[0] += 1
+            ent[1] += dur_s
+            ent[2] += self_s
+
+
+def span_stats():
+    """{(cat, name): {count, total_s, self_s}} — in-process aggregate
+    of every span recorded since configure/reset (kill switch off =
+    nothing accumulates)."""
+    with _stats_lock:
+        return {k: {"count": v[0], "total_s": v[1], "self_s": v[2]}
+                for k, v in _stats.items()}
+
+
+def phase_totals():
+    """{cat: total wall seconds} over recorded spans — the per-phase
+    decomposition bench.py persists as ``*_phase_s``."""
+    out = {}
+    with _stats_lock:
+        for (cat, _name), v in _stats.items():
+            out[cat] = out.get(cat, 0.0) + v[2]  # self time: no double count
+    return out
+
+
+def reset_span_stats():
+    with _stats_lock:
+        _stats.clear()
+
+
+def summary_lines(top=5):
+    """Human lines for profiler.summary: top spans by SELF time (the
+    time a phase spent in its own code, children excluded)."""
+    st = span_stats()
+    if not st:
+        return []
+    rows = sorted(st.items(), key=lambda kv: -kv[1]["self_s"])[:top]
+    lines = ["span timeline: " + ", ".join(
+        f"{cat}: {tot:.3f}s" for cat, tot in
+        sorted(phase_totals().items(), key=lambda kv: -kv[1])[:6])]
+    lines.append("  top spans (self time): " + ", ".join(
+        f"{cat}/{name}: {v['self_s']:.3f}s x{v['count']}"
+        for (cat, name), v in rows))
+    t = _tracer
+    if t is not None:
+        n = t.emitted + len(t._buf)  # + the not-yet-flushed tail
+        lines.append(f"  trace file: {t.path} ({n} events"
+                     + (f", {t.dropped} dropped" if t.dropped else "") + ")")
+    return lines
+
+
+# ---------------------------------------------------------------------------
+# the producer API
+
+class _NullSpan:
+    """Shared zero-cost context manager returned while tracing is off."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL = _NullSpan()
+
+
+class _Span:
+    __slots__ = ("name", "cat", "args", "_w0", "_t0", "_child")
+
+    def __init__(self, name, cat, args):
+        self.name = name
+        self.cat = cat
+        self.args = args
+        self._child = 0.0
+
+    def __enter__(self):
+        st = _tl.stack
+        if st is None:
+            st = _tl.stack = []
+        st.append(self)
+        self._w0 = time.time()
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        dur = time.perf_counter() - self._t0
+        st = _tl.stack
+        if st and st[-1] is self:
+            st.pop()
+        if st:
+            st[-1]._child += dur
+        t = _tracer
+        if t is not None and _on[0]:
+            t.emit_complete(self.name, self.cat, self._w0, dur, self.args)
+            _note(self.cat, self.name, dur, max(0.0, dur - self._child))
+        return False
+
+
+def span(name, cat="runtime", /, **args):
+    """Context manager recording one complete span (nested spans
+    subtract from the parent's self time). Returns a shared no-op when
+    tracing is off — producers may call this unconditionally on warm
+    paths; truly hot paths should guard with ``tracing._on[0]``."""
+    if not _on[0]:
+        return _NULL
+    return _Span(name, cat, args or None)
+
+
+def set_span_arg(sp, key, value):
+    """Attach/overwrite one arg on a live span returned by `span()`
+    (no-op for the disabled null span) — for attributes only known by
+    the time the region ends, like a flush's executed mode."""
+    if isinstance(sp, _Span):
+        if sp.args is None:
+            sp.args = {}
+        sp.args[key] = value
+
+
+def emit_span(name, cat, wall_start, dur_s, /, **args):
+    """Record a span measured EXTERNALLY (the producer already timed
+    the operation for a metrics counter/histogram — emitting from the
+    same numbers is what makes span/metric reconciliation exact). No
+    nesting bookkeeping: self time == total time."""
+    if not _on[0]:
+        return
+    t = _tracer
+    if t is None:
+        return
+    t.emit_complete(name, cat, wall_start, dur_s, args or None)
+    _note(cat, name, dur_s, dur_s)
+
+
+def instant(name, cat="runtime", /, **args):
+    """One instant event (a point on the timeline: a stall detection, a
+    demotion) — no duration, not part of span stats."""
+    if not _on[0]:
+        return
+    t = _tracer
+    if t is not None:
+        t.emit_instant(name, cat, args or None)
+
+
+# ---------------------------------------------------------------------------
+# configuration
+
+def configure(directory=None, flush_every=None, max_events=None):
+    """Point the tracer at `directory` (default: ``PADDLE_TPU_TRACE``).
+    Returns the effective directory, or None when tracing stays off.
+    The per-process file is ``trace-<host>-<pid>.json`` so multiple
+    ranks sharing one directory never collide. Reconfiguring to a new
+    directory closes (and terminates) the old file."""
+    global _tracer
+    directory = directory or os.environ.get("PADDLE_TPU_TRACE")
+    if not directory or directory.lower() in ("0", "false", "no"):
+        return None
+    directory = os.path.abspath(directory)
+    # hostname/pid resolved BEFORE the config lock (gethostname can
+    # block on a slow resolver; nothing under the lock should)
+    path = os.path.join(
+        directory,
+        f"{TRACE_BASENAME_PREFIX}{socket.gethostname()}-"
+        f"{os.getpid()}.json")
+    with _lock:
+        # an explicit configure IS an opt-in: it overrides a previous
+        # set_enabled(False) kill (tests and bench rely on this)
+        _killed[0] = False
+        if _config["dir"] == directory and _tracer is not None:
+            # same dir: honor newly requested bounds in place (an early
+            # return that dropped them would leave a caller believing
+            # in per-span durability the buffer doesn't provide)
+            if flush_every is not None:
+                _tracer.flush_every = max(1, int(flush_every))
+            if max_events is not None:
+                _tracer.max_events = max(1, int(max_events))
+            _on[0] = True
+            return directory
+        new = SpanTracer(path, flush_every=flush_every,
+                         max_events=max_events)
+        old = _tracer
+        _tracer = new
+        _config["dir"] = directory
+        _on[0] = True
+    if old is not None:
+        old.close()
+    return directory
+
+
+def enabled():
+    return _on[0]
+
+
+def set_enabled(mode):
+    """Runtime kill switch: False stops every producer at its one falsy
+    check (the buffer is flushed so nothing recorded is lost); True
+    re-arms a configured tracer. Returns the previous state."""
+    prev = _on[0]
+    _killed[0] = not mode  # threadlint: ok[CL001] GIL-atomic flag publish; config-time single-writer, readers tolerate either value (same contract as dispatch.set_warmup_count)
+    if mode:
+        _on[0] = _tracer is not None  # threadlint: ok[CL001] see above
+    else:
+        _on[0] = False  # threadlint: ok[CL001] see above
+        t = _tracer
+        if t is not None:
+            t.flush()
+    return prev
+
+
+def trace_dir():
+    return _config["dir"]
+
+
+def trace_path():
+    t = _tracer
+    return t.path if t is not None else None
+
+
+def tracer():
+    return _tracer
+
+
+def flush():
+    t = _tracer
+    if t is not None:
+        t.flush()
+
+
+def close():
+    """Flush + terminate the trace file (registered atexit; a killed
+    process skips this and leaves the Perfetto-tolerated open array)."""
+    t = _tracer
+    if t is not None:
+        t.close()
+
+
+atexit.register(close)
+
+
+# ---------------------------------------------------------------------------
+# reading / validation (tests, smoke, merge)
+
+def read_trace(path, strict=False):
+    """Parse a trace file back into its event list. Tolerates the
+    kill -9 shape: missing ``]`` terminator and a torn final line.
+    With `strict`, any malformed NON-final line raises ValueError —
+    the Chrome-format validity check the tests gate on."""
+    with open(path) as f:
+        raw = f.read()
+    stripped = raw.strip()
+    if not stripped.startswith("["):
+        raise ValueError(f"{path}: not a Chrome trace array")
+    if stripped.endswith("]"):
+        return [e for e in json.loads(stripped) if e]  # drop the {} pad
+    events = []
+    lines = stripped[1:].splitlines()
+    for i, line in enumerate(lines):
+        line = line.strip().rstrip(",")
+        if not line:
+            continue
+        try:
+            events.append(json.loads(line))
+        except ValueError:
+            if strict and i < len(lines) - 1:
+                raise ValueError(f"{path}: malformed trace line {i + 2}")
+            continue  # torn tail line (the kill -9 contract)
+    return events
+
+
+def validate_trace(path):
+    """Chrome Trace Event Format validity: every event parses and
+    carries the required keys for its phase. Returns the events;
+    raises ValueError on a violation."""
+    events = read_trace(path, strict=True)
+    for e in events:
+        ph = e.get("ph")
+        if ph not in ("X", "M", "i", "B", "E"):
+            raise ValueError(f"{path}: unknown phase {ph!r} in {e}")
+        for k in ("name", "pid", "tid"):
+            if k not in e:
+                raise ValueError(f"{path}: event missing {k!r}: {e}")
+        if ph == "X":
+            if not isinstance(e.get("ts"), int) or \
+                    not isinstance(e.get("dur"), int) or e["dur"] < 0:
+                raise ValueError(f"{path}: bad X event timing: {e}")
+    return events
+
+
+# ---------------------------------------------------------------------------
+# reconciliation: the timeline and the counters must agree
+
+def reconcile_with_metrics(tolerance=0.02, abs_slack=2e-3):
+    """Assert the span sums agree with the authoritative counters.
+    Producers emit these spans from the SAME measured duration that
+    feeds the metric, so agreement is exact up to float accumulation —
+    `tolerance` (relative) and `abs_slack` (seconds) absorb only that.
+
+    Checked pairs (each skipped when neither side saw traffic):
+
+    * ``dispatch/run:*`` spans      vs ``dispatch_stats()["per_op"][*]["run_s"]``
+    * ``step/train_step`` spans     vs ``paddle_tpu_step_seconds`` histogram
+    * ``data/data_wait`` spans      vs ``paddle_tpu_data_wait_seconds`` histogram
+    * ``checkpoint/save`` spans     vs ``paddle_tpu_checkpoint_save_seconds``
+    * ``checkpoint/restore`` spans  vs ``paddle_tpu_checkpoint_restore_seconds``
+
+    Returns (ok, report) where report maps check name ->
+    {span_s, metric_s, span_n, metric_n, ok, skipped}."""
+    st = span_stats()
+    snap = _telemetry.snapshot()
+
+    def spans(cat, name=None, prefix=None):
+        tot = n = 0.0
+        for (c, nm), v in st.items():
+            if c != cat:
+                continue
+            if name is not None and nm != name:
+                continue
+            if prefix is not None and not nm.startswith(prefix):
+                continue
+            tot += v["total_s"]
+            n += v["count"]
+        return tot, int(n)
+
+    def hist(name):
+        fam = snap.get(name)
+        if not fam or not fam.get("series"):
+            return 0.0, 0
+        s = fam["series"][0]
+        return float(s.get("sum", 0.0)), int(s.get("count", 0))
+
+    report = {}
+
+    def check(key, span_pair, metric_pair, count_exact=True):
+        (ss, sn), (ms, mn) = span_pair, metric_pair
+        skipped = sn == 0 and mn == 0
+        ok = skipped or (
+            (not count_exact or sn == mn)
+            and abs(ss - ms) <= max(abs_slack, tolerance * max(ss, ms)))
+        report[key] = {"span_s": ss, "metric_s": ms, "span_n": sn,
+                       "metric_n": mn, "ok": ok, "skipped": skipped}
+
+    try:
+        from ..core.dispatch import dispatch_stats
+
+        ds = dispatch_stats()
+        run_s = sum(o.get("run_s", 0.0) for o in ds["per_op"].values())
+        run_n = sum(o.get("run_samples", 0) for o in ds["per_op"].values())
+        check("dispatch_run", spans("dispatch", prefix="run:"),
+              (run_s, run_n))
+    except Exception:  # pragma: no cover — jax-less context
+        pass
+    check("step", spans("step", name="train_step"),
+          hist("paddle_tpu_step_seconds"))
+    check("data_wait", spans("data", name="data_wait"),
+          hist("paddle_tpu_data_wait_seconds"))
+    check("checkpoint_save", spans("checkpoint", name="save"),
+          hist("paddle_tpu_checkpoint_save_seconds"))
+    check("checkpoint_restore", spans("checkpoint", name="restore"),
+          hist("paddle_tpu_checkpoint_restore_seconds"))
+    ok = all(v["ok"] for v in report.values())
+    return ok, report
+
+
+# ---------------------------------------------------------------------------
+# process wiring: env-driven auto-config (the zero-user-code promise —
+# a plain Model.fit under PADDLE_TPU_TRACE produces a complete timeline)
+
+if os.environ.get("PADDLE_TPU_TRACE"):
+    try:
+        configure()
+    except Exception:  # pragma: no cover — never break import
+        pass

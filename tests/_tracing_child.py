@@ -1,0 +1,63 @@
+"""Child process for the tracing crash + cluster-merge tests
+(test_tracing.py).
+
+``kill`` mode: configures the tracer at TRACING_CHILD_DIR with a small
+flush bound, emits numbered spans with a fault point after each one;
+the parent arms ``PADDLE_TPU_FAULT_INJECT=tracing.child=kill:N`` so the
+process dies by SIGKILL (no atexit, no terminator) right after the Nth
+span. The parent then proves the trace survived minus at most the
+unflushed tail — the bounded-buffer durability contract.
+
+``rank`` mode: one cluster rank — tags telemetry with its rank, traces
+into the shared ``<store>/traces`` dir, emits spans across several
+categories (compute, checkpoint, coord via a rendezvous round trip),
+publishes its registry, and flushes. The parent runs the host-0 merge
+and asserts the merged cluster timeline carries both ranks' spans.
+"""
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+mode = sys.argv[1] if len(sys.argv) > 1 else "kill"
+
+if mode == "kill":
+    os.environ["PADDLE_TPU_TRACE_FLUSH_EVERY"] = "4"
+    from paddle_tpu.runtime import tracing  # noqa: E402
+    from paddle_tpu.testing.faults import fault_point  # noqa: E402
+
+    tracing.configure(os.environ["TRACING_CHILD_DIR"])
+    i = 0
+    while i < 10_000:  # bounded: a mis-armed injector must not spin forever
+        i += 1
+        tracing.emit_span(f"work{i}", "test", time.time(), 0.001, i=i)
+        fault_point("tracing.child")  # parent arms kill -9 on the Nth call
+    print("child exited without being killed", file=sys.stderr)
+    sys.exit(3)
+
+elif mode == "rank":
+    from paddle_tpu.distributed import coordination  # noqa: E402
+    from paddle_tpu.runtime import telemetry, tracing  # noqa: E402
+
+    ctx = coordination.cluster_context()
+    assert ctx is not None, "cluster env not set"
+    coordination.init_cluster_telemetry(ctx)
+    tracing.configure(os.path.join(ctx.store.root, "traces"))
+    with tracing.span("work", "compute", rank=ctx.rank):
+        time.sleep(0.01)
+    tracing.emit_span("save", "checkpoint", time.time() - 0.002, 0.002,
+                      step=1)
+    if ctx.is_leader:
+        coordination.rendezvous(ctx.store, "trace_tok", {"t": 1},
+                                leader=True)
+    else:
+        tok = coordination.rendezvous(ctx.store, "trace_tok", timeout=30.0)
+        assert tok == {"t": 1}, tok
+    telemetry.publish_registry(ctx.store, ctx.rank)
+    tracing.flush()
+    print(f"RANK_OK {ctx.rank}", flush=True)
+
+else:
+    raise SystemExit(f"unknown mode {mode!r}")

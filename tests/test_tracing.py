@@ -1,0 +1,496 @@
+"""Structured span tracing (runtime/tracing.py): span nesting and
+threading, Chrome Trace Event Format validity, the kill-switch parity
+contract (no trace dir => byte-identical dispatch stats), span<->counter
+reconciliation, crash durability of the bounded buffer, and the
+2-subprocess cluster trace merge. Plus the satellites that ride the
+same PR: the OTLP exporter and the data-wait gauge."""
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.core import dispatch
+from paddle_tpu.runtime import telemetry as T
+from paddle_tpu.runtime import tracing
+from paddle_tpu.runtime.resilience import fault_events
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+@pytest.fixture(autouse=True)
+def _trace_hygiene():
+    """Every test leaves the process with tracing OFF and span stats
+    empty — other test files must keep seeing the untraced fast path."""
+    yield
+    tracing.set_enabled(False)
+    tracing.reset_span_stats()
+
+
+@pytest.fixture
+def tdir(tmp_path):
+    d = str(tmp_path / "trace")
+    tracing.configure(d)
+    tracing.reset_span_stats()
+    return d
+
+
+# ---------------------------------------------------------------------------
+# span semantics
+
+def test_span_nesting_and_self_time(tdir):
+    with tracing.span("outer", "phase_a"):
+        time.sleep(0.02)
+        with tracing.span("inner", "phase_b"):
+            time.sleep(0.01)
+    st = tracing.span_stats()
+    outer = st[("phase_a", "outer")]
+    inner = st[("phase_b", "inner")]
+    assert outer["count"] == 1 and inner["count"] == 1
+    assert inner["total_s"] <= outer["total_s"]
+    # the child's time is subtracted from the parent's SELF time
+    assert outer["self_s"] == pytest.approx(
+        outer["total_s"] - inner["total_s"], abs=1e-6)
+    # phase totals aggregate self time per category (no double count)
+    ph = tracing.phase_totals()
+    assert ph["phase_a"] == pytest.approx(outer["self_s"])
+    assert ph["phase_b"] == pytest.approx(inner["total_s"])
+
+
+def test_threaded_spans_carry_distinct_tids(tdir):
+    def work(name):
+        with tracing.span(name, "threaded"):
+            time.sleep(0.005)
+
+    threads = [threading.Thread(target=work, args=(f"w{i}",), name=f"w{i}")
+               for i in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    with tracing.span("main_thread", "threaded"):
+        pass
+    tracing.flush()
+    events = tracing.read_trace(tracing.trace_path())
+    xs = [e for e in events if e.get("ph") == "X"
+          and e.get("cat") == "threaded"]
+    assert len(xs) == 3
+    assert len({e["tid"] for e in xs}) == 3  # one lane per thread
+    names = {e["args"]["name"] for e in events
+             if e.get("ph") == "M" and e.get("name") == "thread_name"}
+    assert {"w0", "w1"} <= names
+
+
+def test_chrome_trace_format_validity(tdir):
+    with tracing.span("op", "cat", detail="x"):
+        pass
+    tracing.instant("marker", "cat")
+    tracing.flush()
+    # unterminated (crash-shaped) file: tolerant reader + validator
+    events = tracing.validate_trace(tracing.trace_path())
+    assert any(e["ph"] == "X" and e["name"] == "op" for e in events)
+    assert any(e["ph"] == "M" and e["name"] == "process_name"
+               for e in events)
+    # clean close terminates the array: the file is then STRICT JSON
+    tracing.close()
+    with open(tracing.trace_path()) as f:
+        parsed = json.loads(f.read())
+    assert isinstance(parsed, list) and len(parsed) >= len(events)
+    x = next(e for e in parsed if e.get("ph") == "X")
+    assert isinstance(x["ts"], int) and isinstance(x["dur"], int)
+    assert x["dur"] >= 0 and {"name", "cat", "pid", "tid"} <= set(x)
+
+
+def test_trace_file_event_cap_drops_not_grows(tmp_path):
+    d = str(tmp_path / "capped")
+    tracing.configure(d, max_events=10)
+    for i in range(50):
+        tracing.emit_span(f"s{i}", "cap", time.time(), 0.001)
+    tracing.flush()
+    tr = tracing.tracer()
+    # +1: the process metadata record is inserted at flush, outside the
+    # buffered-event cap
+    assert tr.emitted <= 11
+    assert tr.dropped >= 40
+
+
+def test_bounded_buffer_flushes_at_threshold(tmp_path):
+    d = str(tmp_path / "buf")
+    tracing.configure(d, flush_every=10)
+    for i in range(3):
+        tracing.emit_span(f"s{i}", "buf", time.time(), 0.001)
+    # below the bound: nothing but the array opener on disk yet
+    assert len(tracing.read_trace(tracing.trace_path())) == 0
+    tracing.flush()
+    assert len([e for e in tracing.read_trace(tracing.trace_path())
+                if e.get("ph") == "X"]) == 3
+
+
+# ---------------------------------------------------------------------------
+# kill switch: no trace dir => byte-identical dispatch behavior
+
+def _dispatch_workload():
+    dispatch.reset_dispatch_stats(clear_caches=True)
+    t = paddle.to_tensor(
+        np.random.RandomState(0).randn(8, 8).astype(np.float32))
+    for _ in range(4):
+        paddle.tanh(paddle.matmul(t, t)).sum()
+    ds = dispatch.dispatch_stats()
+    return (
+        {k: ds["forward"][k] for k in ("hits", "misses", "bypasses",
+                                       "unkeyable", "warming", "fallbacks")},
+        {k: (v["hits"], v["misses"], v["retraces"])
+         for k, v in ds["per_op"].items()},
+    )
+
+
+def test_kill_switch_parity_dispatch_stats(tmp_path):
+    assert not tracing.enabled()  # no trace dir configured => off
+    baseline = _dispatch_workload()
+    tracing.configure(str(tmp_path / "trace"))
+    tracing.reset_span_stats()
+    traced = _dispatch_workload()
+    # the tracer observed real dispatch activity...
+    assert any(c == "dispatch" for c, _ in tracing.span_stats())
+    tracing.set_enabled(False)
+    killed = _dispatch_workload()
+    # ...and neither tracing nor the kill switch changed ONE counter
+    assert baseline == traced == killed
+
+
+def test_set_enabled_and_configure_rearm(tmp_path):
+    tracing.configure(str(tmp_path / "t"))
+    assert tracing.enabled()
+    assert tracing.set_enabled(False) is True
+    assert not tracing.enabled()
+    assert tracing.span("x", "y") is tracing._NULL  # one falsy check path
+    tracing.set_enabled(True)
+    assert tracing.enabled()
+    tracing.set_enabled(False)
+    tracing.configure(str(tmp_path / "t"))  # explicit configure re-arms
+    assert tracing.enabled()
+
+
+# ---------------------------------------------------------------------------
+# reconciliation: the timeline and the counters cannot disagree
+
+def test_reconcile_exact_pair_and_mismatch_detection(tmp_path):
+    T.reset_metrics()
+    dispatch.reset_dispatch_stats()
+    tracing.configure(str(tmp_path / "trace"))
+    tracing.reset_span_stats()
+    h = T.histogram("paddle_tpu_step_seconds", "train step wall time")
+    for dt in (0.25, 0.125):
+        h.observe(dt)
+        tracing.emit_span("train_step", "step", time.time() - dt, dt)
+    ok, rep = tracing.reconcile_with_metrics()
+    assert rep["step"]["ok"] and not rep["step"]["skipped"]
+    assert rep["step"]["span_s"] == pytest.approx(0.375)
+    # an extra span the histogram never saw must be CAUGHT
+    tracing.emit_span("train_step", "step", time.time(), 0.5)
+    ok2, rep2 = tracing.reconcile_with_metrics()
+    assert not rep2["step"]["ok"]
+    T.reset_metrics()
+
+
+def test_fit_reconciles_spans_with_metrics(tmp_path):
+    """A real (tiny) fit: dispatch run spans, step spans and data-wait
+    spans must all reconcile with dispatch_stats()/the histograms."""
+    import paddle_tpu.nn as nn
+
+    T.reset_metrics()
+    # clear_caches so the warm-up ops re-enter through the miss path
+    # (hit-path sampling only attributes ops with a stats entry)
+    dispatch.reset_dispatch_stats(clear_caches=True)
+    prev_sample = dispatch.set_op_sample_every(1)
+    prev_warm = dispatch.set_warmup_count(1)
+    tracing.configure(str(tmp_path / "trace"))
+    tracing.reset_span_stats()
+    try:
+        rng = np.random.RandomState(0)
+        t = paddle.to_tensor(rng.randn(8, 8).astype(np.float32))
+        for _ in range(4):
+            paddle.tanh(paddle.matmul(t, t)).sum()
+        x = rng.rand(32, 4).astype(np.float32)
+        y = (x @ rng.rand(4, 1).astype(np.float32)).astype(np.float32)
+        net = nn.Linear(4, 1)
+        model = paddle.Model(net)
+        model.prepare(
+            paddle.optimizer.Adam(0.05, parameters=net.parameters()),
+            nn.MSELoss())
+        model.fit([x, y], epochs=1, batch_size=16, verbose=0,
+                  callbacks=[paddle.callbacks.TelemetryCallback(
+                      str(tmp_path / "tel"), export_every=100,
+                      scalars=False)])
+        ok, rep = tracing.reconcile_with_metrics()
+        assert ok, rep
+        for key in ("dispatch_run", "step", "data_wait"):
+            assert not rep[key]["skipped"], rep
+            assert rep[key]["span_n"] > 0
+    finally:
+        dispatch.set_op_sample_every(prev_sample)
+        dispatch.set_warmup_count(prev_warm)
+        T.reset_metrics()
+        # drop this test's sampled per-op stats too: a later test (any
+        # file order) asserting registry<->per_op agreement must not
+        # inherit half of this test's traffic
+        dispatch.reset_dispatch_stats()
+
+
+def test_data_wait_gauge_and_span(tmp_path):
+    import paddle_tpu.nn as nn
+
+    T.reset_metrics()
+    tracing.configure(str(tmp_path / "trace"))
+    tracing.reset_span_stats()
+    model = paddle.Model(nn.Linear(2, 2))
+    model._note_data_wait(0.033, time.time() - 0.033)
+    snap = T.snapshot()
+    hist = snap["paddle_tpu_data_wait_seconds"]["series"][0]
+    assert hist["count"] == 1 and hist["sum"] == pytest.approx(0.033)
+    gauge = snap["paddle_tpu_data_wait_seconds_last"]["series"][0]
+    assert gauge["value"] == pytest.approx(0.033)
+    assert tracing.span_stats()[("data", "data_wait")]["count"] == 1
+    T.reset_metrics()
+
+
+# ---------------------------------------------------------------------------
+# crash durability: kill -9 loses at most the unflushed tail
+
+def test_kill9_child_loses_at_most_unflushed_tail(tmp_path):
+    from paddle_tpu.testing.faults import faults_env
+
+    child_dir = str(tmp_path / "crash")
+    kill_after = 25
+    env = faults_env({"tracing.child": ("kill", kill_after)})
+    env.update({"TRACING_CHILD_DIR": child_dir, "JAX_PLATFORMS": "cpu"})
+    p = subprocess.run(
+        [sys.executable, os.path.join(HERE, "_tracing_child.py"), "kill"],
+        env=env, capture_output=True, text=True, timeout=120)
+    assert p.returncode == -9, (p.returncode, p.stderr)
+    files = [f for f in os.listdir(child_dir)
+             if f.startswith(tracing.TRACE_BASENAME_PREFIX)]
+    assert len(files) == 1
+    path = os.path.join(child_dir, files[0])
+    # the unterminated file still parses (Perfetto's tolerance)
+    events = tracing.read_trace(path)
+    idx = sorted(e["args"]["i"] for e in events
+                 if e.get("ph") == "X" and e.get("cat") == "test")
+    # a contiguous prefix survived, missing at most the buffered tail
+    # (flush_every=4 in the child, plus the metadata records sharing
+    # the buffer)
+    assert idx == list(range(1, len(idx) + 1))
+    assert len(idx) >= kill_after - 8
+    assert len(idx) <= kill_after
+
+
+# ---------------------------------------------------------------------------
+# cluster merge: two ranks, one timeline
+
+@pytest.mark.filterwarnings("ignore::UserWarning")
+def test_cluster_merge_carries_both_ranks_spans(tmp_path):
+    store = str(tmp_path / "store")
+    os.makedirs(store, exist_ok=True)
+    procs = []
+    for rank in range(2):
+        env = dict(os.environ)
+        env.update({"JAX_PLATFORMS": "cpu",
+                    "PADDLE_TPU_CLUSTER_DIR": store,
+                    "PADDLE_TPU_CLUSTER_RANK": str(rank),
+                    "PADDLE_TPU_CLUSTER_WORLD": "2"})
+        env.pop("PADDLE_TPU_TRACE", None)  # the child configures itself
+        procs.append(subprocess.Popen(
+            [sys.executable, os.path.join(HERE, "_tracing_child.py"),
+             "rank"],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True))
+    for rank, p in enumerate(procs):
+        out, err = p.communicate(timeout=300)
+        assert p.returncode == 0, (rank, out, err)
+    from paddle_tpu.distributed.coordination import DirectoryStore
+
+    out = T.merge_cluster(DirectoryStore(store))
+    assert out["trace_path"] and os.path.exists(out["trace_path"])
+    assert out["trace_events"] > 0
+    events = tracing.read_trace(out["trace_path"], strict=True)
+    by_rank = {}
+    for e in events:
+        if e.get("ph") == "X":
+            by_rank.setdefault(e["pid"], set()).add(e.get("cat"))
+    assert 0 in by_rank and 1 in by_rank
+    for rank in (0, 1):
+        assert {"compute", "checkpoint", "coord"} <= by_rank[rank], by_rank
+    # the tail is byte-offset persisted: a second merge with no new
+    # writes appends NOTHING (the PR-8 O(new bytes) contract)
+    out2 = T.merge_cluster(DirectoryStore(store))
+    assert out2["trace_events"] == 0
+    state = json.load(open(os.path.join(store, "merged",
+                                        "merge_state.json")))
+    assert state["traces"]  # per-file offsets persisted
+
+
+def test_configure_same_dir_updates_flush_bound(tmp_path):
+    """Re-configuring the SAME dir must honor a newly requested buffer
+    bound — a caller asking for flush_every=1 believes in per-span
+    durability (review finding: the early return silently kept 64)."""
+    d = str(tmp_path / "t")
+    tracing.configure(d, flush_every=50)
+    tracing.configure(d, flush_every=1)
+    tracing.emit_span("s", "c", time.time(), 0.001)
+    assert len([e for e in tracing.read_trace(tracing.trace_path())
+                if e.get("ph") == "X"]) == 1  # on disk without flush()
+
+
+def test_rank_assigned_before_flush_lanes_spans(tmp_path):
+    """The pid lane is stamped at FLUSH time: spans emitted before the
+    cluster rank was assigned but flushed after (the real-multihost
+    bring-up order, where set_rank happens at fit start) must land on
+    the rank lane, with the lane named by process metadata."""
+    prev = T.set_rank(None)
+    try:
+        tracing.configure(str(tmp_path / "t"))
+        tracing.emit_span("early", "c", time.time(), 0.001)  # buffered
+        T.set_rank(5)
+        tracing.flush()
+        evs = tracing.read_trace(tracing.trace_path())
+        assert next(e for e in evs if e.get("name") == "early")["pid"] == 5
+        meta = [e for e in evs if e.get("name") == "process_name"]
+        assert meta and meta[-1]["args"]["rank"] == 5
+    finally:
+        T.set_rank(prev)
+
+
+def test_reopen_after_clean_close_stays_valid(tmp_path):
+    """Re-opening a cleanly terminated trace file must strip the '{}]'
+    terminator before appending — otherwise every later span lands
+    past the ']' and the file fails validation forever (review
+    finding)."""
+    a, b = str(tmp_path / "a"), str(tmp_path / "b")
+    tracing.configure(a)
+    tracing.emit_span("one", "c", time.time(), 0.001)
+    path = tracing.trace_path()
+    tracing.configure(b)   # closes + terminates a's file
+    tracing.configure(a)   # same host+pid => same path, reopened
+    assert tracing.trace_path() == path
+    tracing.emit_span("two", "c", time.time(), 0.001)
+    tracing.flush()
+    names = [e["name"] for e in tracing.validate_trace(path)
+             if e.get("ph") == "X"]
+    assert "one" in names and "two" in names
+    tracing.close()
+    with open(path) as f:
+        json.loads(f.read())  # strict JSON again after the re-close
+
+
+def test_trace_merge_detects_replaced_file(tmp_path):
+    """Every trace file's first line is the identical '[' opener, so
+    the incarnation signature must key on the SECOND line (process
+    metadata): a recycled-pid relaunch that rewrites the same path
+    LONGER than the old offset must re-tail from 0, not silently skip
+    the new incarnation's earliest spans."""
+    d = tmp_path / "traces"
+    d.mkdir()
+    p = str(d / "trace-h-1.json")
+
+    def write(pid, n):
+        with open(p, "w") as f:
+            f.write("[\n")
+            f.write(json.dumps({"ph": "M", "name": "process_name",
+                                "pid": pid, "tid": 0, "ts": 0,
+                                "args": {"os_pid": pid}}) + ",\n")
+            for i in range(n):
+                f.write(json.dumps({"ph": "X", "name": f"s{pid}-{i}",
+                                    "cat": "t", "ts": i, "dur": 1,
+                                    "pid": pid, "tid": 1}) + ",\n")
+
+    write(100, 2)
+    out = str(tmp_path / "merged.json")
+    state = {}
+    assert T._merge_trace_files([p], out, state) == 3
+    write(200, 6)  # new incarnation, same path, GROWS past the offset
+    T._merge_trace_files([p], out, state)
+    evs = tracing.read_trace(out)
+    assert any(e.get("name") == "s200-0" for e in evs), \
+        "earliest span of the replaced incarnation was dropped"
+
+
+# ---------------------------------------------------------------------------
+# OTLP exporter (satellite)
+
+def test_otlp_push_roundtrip():
+    from http.server import BaseHTTPRequestHandler, HTTPServer
+
+    class Handler(BaseHTTPRequestHandler):
+        body = None
+        seen_path = None
+
+        def do_POST(self):
+            n = int(self.headers.get("Content-Length", 0))
+            type(self).body = self.rfile.read(n)
+            type(self).seen_path = self.path
+            self.send_response(200)
+            self.end_headers()
+
+        def log_message(self, *a):  # noqa: A002
+            pass
+
+    T.reset_metrics()
+    T.counter("paddle_tpu_train_steps_total", "steps").inc(7)
+    T.histogram("paddle_tpu_step_seconds", "steps").observe(0.05)
+    T.gauge("paddle_tpu_loss", "loss").set(1.5)
+    srv = HTTPServer(("127.0.0.1", 0), Handler)
+    th = threading.Thread(target=srv.serve_forever, daemon=True)
+    th.start()
+    try:
+        ok = T.push_otlp(f"http://127.0.0.1:{srv.server_port}")
+    finally:
+        srv.shutdown()
+    assert ok
+    assert Handler.seen_path == "/v1/metrics"
+    payload = json.loads(Handler.body)
+    metrics = {m["name"]: m for m in payload["resourceMetrics"][0]
+               ["scopeMetrics"][0]["metrics"]}
+    c = metrics["paddle_tpu_train_steps_total"]["sum"]
+    assert c["isMonotonic"] and c["aggregationTemporality"] == 2
+    assert c["dataPoints"][0]["asDouble"] == 7.0
+    # cumulative series carry a start timestamp (collectors need it
+    # for reset detection across process restarts)
+    assert int(c["dataPoints"][0]["startTimeUnixNano"]) <= \
+        int(c["dataPoints"][0]["timeUnixNano"])
+    h = metrics["paddle_tpu_step_seconds"]["histogram"]["dataPoints"][0]
+    assert h["count"] == "1" and float(h["sum"]) == pytest.approx(0.05)
+    assert "startTimeUnixNano" in h
+    assert len(h["bucketCounts"]) == len(h["explicitBounds"]) + 1
+    g = metrics["paddle_tpu_loss"]["gauge"]["dataPoints"][0]
+    assert g["asDouble"] == 1.5
+    T.reset_metrics()
+
+
+def test_otlp_failure_degrades_to_fault_event():
+    before = fault_events().get("push_failures", 0)
+    with pytest.warns(UserWarning, match="OTLP export"):
+        ok = T.push_otlp("http://127.0.0.1:9")  # discard port: refused
+    assert ok is False
+    assert fault_events().get("push_failures", 0) == before + 1
+
+
+def test_otlp_opt_in_only():
+    assert T.otlp_endpoint() is None or "PADDLE_TPU_TELEMETRY_OTLP" in \
+        os.environ
+    assert T.push_otlp(None) in (False,) if T.otlp_endpoint() is None \
+        else True
+
+
+# ---------------------------------------------------------------------------
+# schema: the new vocabulary is frozen
+
+def test_new_names_in_schema():
+    s = T.schema()
+    assert "paddle_tpu_data_wait_seconds" in s["metrics"]
+    assert "paddle_tpu_data_wait_seconds_last" in s["metrics"]
+    assert "trace_merge" in s["events"]

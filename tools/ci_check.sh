@@ -84,4 +84,12 @@ echo "== telemetry smoke (event stream + prom export + schema gate) =="
 # the metric/event schema must match the checked-in telemetry_schema.json
 JAX_PLATFORMS=cpu python tools/telemetry_smoke.py
 
+echo "== trace smoke (span timeline + reconciliation + cluster merge) =="
+# a tiny fit under PADDLE_TPU_TRACE must emit a Perfetto-loadable
+# Chrome trace whose per-phase span sums reconcile with
+# dispatch_stats()/the telemetry histograms, and a 2-process cluster
+# fit must merge into ONE cluster timeline carrying dispatch/fusion/
+# checkpoint/coordination spans from BOTH ranks
+JAX_PLATFORMS=cpu python tools/trace_smoke.py
+
 echo "ci_check: OK"

@@ -1,0 +1,254 @@
+#!/usr/bin/env python
+"""Span-tracing smoke (tools/ci_check.sh).
+
+Two stages, both over fresh subprocesses the way an operator would run
+them:
+
+**Single-process fit.** A tiny `Model.fit` under ``PADDLE_TPU_TRACE``
+(plus TelemetryCallback + ResilienceCallback) must produce a trace
+file that
+
+* validates as Chrome Trace Event Format JSON (loads in Perfetto);
+* carries spans from every instrumented storey — dispatch (compile +
+  sampled runs), fusion flushes (tagged with the PR-11 reason+site),
+  step/data/compute phases, checkpoint saves;
+* RECONCILES with the metrics: per-phase span sums must agree with
+  ``dispatch_stats()`` / the telemetry histograms
+  (`tracing.reconcile_with_metrics`), asserted inside the child where
+  the authoritative snapshots live.
+
+**2-process cluster fit.** Two ranks run `Model.fit` with
+ResilienceCallback in cluster mode over a tmpdir store, tracing into
+the shared ``<store>/traces`` dir. The host-0 merge
+(`telemetry.merge_cluster`, driven by the leader's train end) must
+produce ONE merged cluster timeline carrying spans from BOTH ranks —
+dispatch, fusion flush (reason+site), checkpoint, and coordination
+lanes — which is the acceptance criterion for the span-tracing PR.
+
+Usage: python tools/trace_smoke.py            (run both stages)
+       python tools/trace_smoke.py --child    (internal: single fit)
+       python tools/trace_smoke.py --rank N   (internal: cluster rank)
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _tiny_fit(ckpt_dir, cluster=False):
+    """The shared workload: eager warm-up ops (dispatch compile + run
+    spans), a fusion window (flush spans with reason+site), then a
+    small fit with telemetry + resilience callbacks (step/data/
+    checkpoint/coord spans)."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu.core import dispatch, fusion
+
+    dispatch.set_warmup_count(1)
+    dispatch.set_op_sample_every(1)
+    paddle.seed(0)
+    rng = np.random.RandomState(0)
+    t = paddle.to_tensor(rng.randn(8, 8).astype(np.float32))
+    for _ in range(4):
+        paddle.tanh(paddle.matmul(t, t)).sum()
+    fusion.set_fusion(True)
+    for _ in range(3):
+        float(paddle.tanh(paddle.matmul(t, t)).sum())
+    fusion.set_fusion(False)
+    x = rng.rand(64, 4).astype(np.float32)
+    y = (x @ rng.rand(4, 1).astype(np.float32)).astype(np.float32)
+    net = nn.Linear(4, 1)
+    model = paddle.Model(net)
+    model.prepare(paddle.optimizer.Adam(0.05, parameters=net.parameters()),
+                  nn.MSELoss())
+    cbs = [paddle.callbacks.TelemetryCallback(export_every=3),
+           paddle.callbacks.ResilienceCallback(
+               ckpt_dir, save_interval=4, async_save=False,
+               rendezvous_timeout=20.0 if cluster else 5.0)]
+    model.fit([x, y], epochs=2, batch_size=16, verbose=0, callbacks=cbs)
+    return 8  # train steps
+
+
+def _child():
+    sys.path.insert(0, REPO)
+    from paddle_tpu.runtime import tracing
+
+    steps = _tiny_fit(os.path.join(os.environ["TRACE_SMOKE_DIR"], "ckpt"))
+    tracing.flush()
+    ok, report = tracing.reconcile_with_metrics()
+    print(json.dumps({
+        "trace_path": tracing.trace_path(),
+        "steps": steps,
+        "reconcile_ok": ok,
+        "reconcile": report,
+    }))
+    if not ok:
+        raise SystemExit(f"trace_smoke: span/metric reconciliation failed: "
+                         f"{report}")
+
+
+def _cluster_rank():
+    sys.path.insert(0, REPO)
+    from paddle_tpu.distributed import coordination
+    from paddle_tpu.runtime import tracing
+
+    ctx = coordination.cluster_context()
+    assert ctx is not None, "cluster env not set"
+    ckpt = os.path.join(os.environ["TRACE_SMOKE_DIR"], f"ckpt_{ctx.rank}")
+    _tiny_fit(ckpt, cluster=True)
+    tracing.flush()
+    print(f"RANK_OK rank={ctx.rank} trace={tracing.trace_path()}",
+          flush=True)
+
+
+def _required_cats(events, where):
+    cats = {e.get("cat") for e in events if e.get("ph") == "X"}
+    for need in ("dispatch", "fusion", "step", "data", "checkpoint"):
+        if need not in cats:
+            raise SystemExit(
+                f"trace_smoke: no {need!r} spans in {where} (cats: "
+                f"{sorted(c for c in cats if c)})")
+    flushes = [e for e in events
+               if e.get("cat") == "fusion" and e.get("name") == "flush"]
+    if not flushes:
+        raise SystemExit(f"trace_smoke: no fusion flush spans in {where}")
+    for f in flushes:
+        args = f.get("args") or {}
+        if "reason" not in args or "site" not in args:
+            raise SystemExit(
+                f"trace_smoke: flush span missing reason/site tags: {f}")
+
+
+def run_single():
+    tmp = tempfile.mkdtemp(prefix="trace_smoke_")
+    trace_dir = os.path.join(tmp, "trace")
+    env = dict(os.environ)
+    env.update({"JAX_PLATFORMS": "cpu", "PADDLE_TPU_TRACE": trace_dir,
+                "PADDLE_TPU_TELEMETRY_DIR": os.path.join(tmp, "telemetry"),
+                "PADDLE_TPU_TELEMETRY": "1", "TRACE_SMOKE_DIR": tmp})
+    p = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--child"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=600)
+    if p.returncode != 0:
+        print(p.stdout)
+        print(p.stderr, file=sys.stderr)
+        raise SystemExit(f"trace_smoke: child failed rc={p.returncode}")
+    truth = json.loads(p.stdout.strip().splitlines()[-1])
+
+    sys.path.insert(0, REPO)
+    from paddle_tpu.runtime import tracing
+
+    events = tracing.validate_trace(truth["trace_path"])
+    _required_cats(events, "the single-process trace")
+    n_steps = sum(1 for e in events
+                  if e.get("cat") == "step" and e.get("name") == "train_step")
+    if n_steps != truth["steps"]:
+        raise SystemExit(f"trace_smoke: {n_steps} train_step spans for "
+                         f"{truth['steps']} steps")
+    if not truth["reconcile_ok"]:
+        raise SystemExit("trace_smoke: child reported reconciliation "
+                         f"failure: {truth['reconcile']}")
+    checked = [k for k, v in truth["reconcile"].items() if not v["skipped"]]
+    for need in ("dispatch_run", "step", "data_wait", "checkpoint_save"):
+        if need not in checked:
+            raise SystemExit(
+                f"trace_smoke: reconciliation never exercised {need!r} "
+                f"(checked: {checked}) — nothing real reconciled")
+    print(f"trace_smoke: single-process OK ({len(events)} events, "
+          f"{n_steps} step spans, reconciled: {', '.join(checked)})")
+
+
+def run_cluster():
+    tmp = tempfile.mkdtemp(prefix="trace_smoke_cluster_")
+    store = os.path.join(tmp, "store")
+    trace_dir = os.path.join(store, "traces")
+    procs = []
+    for rank in range(2):
+        env = dict(os.environ)
+        env.update({
+            "JAX_PLATFORMS": "cpu",
+            "PADDLE_TPU_TRACE": trace_dir,
+            "PADDLE_TPU_CLUSTER_DIR": store,
+            "PADDLE_TPU_CLUSTER_RANK": str(rank),
+            "PADDLE_TPU_CLUSTER_WORLD": "2",
+            "PADDLE_TPU_TELEMETRY": "1",
+            "TRACE_SMOKE_DIR": tmp,
+            # coordination needs no collectives; one device keeps the
+            # children light (the PR-6 budget lesson)
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+        })
+        procs.append(subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "--rank", str(rank)],
+            cwd=REPO, env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE, text=True))
+    outs = []
+    for rank, p in enumerate(procs):
+        try:
+            out, err = p.communicate(timeout=600)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            raise SystemExit(f"trace_smoke: cluster rank {rank} timed out")
+        outs.append((out, err))
+        if p.returncode != 0:
+            print(out)
+            print(err, file=sys.stderr)
+            raise SystemExit(
+                f"trace_smoke: cluster rank {rank} failed rc={p.returncode}")
+
+    sys.path.insert(0, REPO)
+    from paddle_tpu.runtime import tracing
+
+    # per-rank trace files exist (distinct, pid-keyed names)
+    files = [fn for fn in sorted(os.listdir(trace_dir))
+             if fn.startswith(tracing.TRACE_BASENAME_PREFIX)]
+    if len(files) < 2:
+        raise SystemExit(f"trace_smoke: expected 2 per-rank trace files, "
+                         f"found {files}")
+    # ONE merged cluster timeline, produced by the leader's train-end
+    # merge, carrying both ranks' spans
+    merged = os.path.join(store, "merged", "cluster_trace.json")
+    if not os.path.exists(merged):
+        raise SystemExit("trace_smoke: no merged cluster timeline at "
+                         f"{merged}")
+    events = tracing.read_trace(merged, strict=True)
+    _required_cats(events, "the merged cluster timeline")
+    for need_cat in ("coord",):
+        if not any(e.get("cat") == need_cat for e in events):
+            raise SystemExit(
+                f"trace_smoke: merged timeline has no {need_cat!r} spans")
+    by_rank = {}
+    for e in events:
+        if e.get("ph") != "X":
+            continue
+        by_rank.setdefault(e.get("pid"), set()).add(e.get("cat"))
+    for rank in (0, 1):
+        if rank not in by_rank:
+            raise SystemExit(
+                f"trace_smoke: merged timeline carries no spans from rank "
+                f"{rank} (pids: {sorted(by_rank)})")
+        for need in ("dispatch", "fusion", "checkpoint", "coord"):
+            if need not in by_rank[rank]:
+                raise SystemExit(
+                    f"trace_smoke: rank {rank} contributed no {need!r} "
+                    f"spans to the merged timeline ({sorted(by_rank[rank])})")
+    print(f"trace_smoke: cluster OK ({len(files)} rank files, "
+          f"{len(events)} merged events, ranks {sorted(by_rank)})")
+
+
+if __name__ == "__main__":
+    args = sys.argv[1:]
+    if args[:1] == ["--child"]:
+        _child()
+    elif args[:1] == ["--rank"]:
+        _cluster_rank()
+    else:
+        run_single()
+        run_cluster()
+        print("trace_smoke: OK")

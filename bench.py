@@ -316,13 +316,15 @@ def bench_resnet50(batch=64, steps=20, warmup=3):
             "resnet50_step_ms": dt / steps * 1e3}
 
 
-def _span_phases(tracing_mod, fn):
+def _span_phases(tracing_mod, fn, keys=None):
     """Per-phase wall-clock decomposition of one extra UNTIMED pass of
     `fn` under the span tracer (runtime/tracing.py): tracing adds
     overhead, so it must never touch the A/B numbers — the timed arms
     run with the tracer off, then this pass runs the same loop traced
-    and reads the phase totals. Keys are the perf-trajectory contract:
-    data/forward/backward/optimizer/flush seconds."""
+    and reads the phase totals. Default keys are the train-step
+    perf-trajectory contract (data/forward/backward/optimizer/flush
+    seconds); `keys` maps output key -> span category for workloads
+    that decompose differently (the serve bench)."""
     import tempfile
 
     # respect an operator-configured tracer (PADDLE_TPU_TRACE): reuse
@@ -339,6 +341,8 @@ def _span_phases(tracing_mod, fn):
         if not already:
             tracing_mod.set_enabled(False)
     ph = tracing_mod.phase_totals()
+    if keys is not None:
+        return {k: round(ph.get(cat, 0.0), 6) for k, cat in keys.items()}
     return {
         # "data" = the fit-level data_wait span, which already covers
         # the loader's io spans (queue wait / unstage) in full — adding
@@ -885,6 +889,68 @@ def bench_tpu_trace(batch=32, seq=128, steps=3):
             "tpu_trace_step_ms": res.get("bert_step_ms")}
 
 
+def bench_serve_decode(requests=8, prompt=8, new_tokens=16, max_running=4,
+                       token_budget=8):
+    """Serving-engine decode-loop bench (CPU-runnable): N concurrent
+    requests through the continuous-batching engine (paged KV cache +
+    ragged attention, paddle_tpu/inference/). Reports generated
+    tokens/sec plus per-request latency percentiles — the serving
+    sibling of the eager_dispatch/eager_fusion train-step numbers — and
+    a `*_phase_s` span decomposition (serve step loop / dispatched op
+    runtime / fusion flush) from an extra untimed traced pass."""
+    import jax
+
+    from paddle_tpu.inference import (ServeConfig, ServingEngine,
+                                      TinyServeModel)
+    from paddle_tpu.runtime import tracing as _tracing
+
+    def mk():
+        model = TinyServeModel(vocab=128, dim=32, layers=2, heads=4,
+                               ffn=64, seed=0)
+        return ServingEngine(model, ServeConfig(
+            max_running=max_running, token_budget=token_budget,
+            block_size=8, num_blocks=128, max_blocks_per_seq=16))
+
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(1, 128, size=prompt).tolist()
+               for _ in range(requests)]
+    res = {}
+    with jax.default_device(jax.devices("cpu")[0]):
+        mk().generate(prompts[:2], max_new_tokens=2)  # warm compiles
+        eng = mk()
+        for p in prompts:
+            eng.submit(p, max_new_tokens=new_tokens)
+        t0 = time.perf_counter()
+        eng.run()
+        dt = time.perf_counter() - t0
+        st = eng.stats()
+        lat = sorted(r.t_done - r.t_submit
+                     for r in eng.scheduler.finished)
+        res["serve_decode_tokens_per_sec"] = st["tokens_out"] / dt
+        res["serve_decode_steps_per_sec"] = st["steps"] / dt
+        res["serve_decode_requests"] = len(lat)
+        res["serve_decode_p50_ms"] = (
+            float(np.percentile(lat, 50)) * 1e3 if lat else None)
+        res["serve_decode_p99_ms"] = (
+            float(np.percentile(lat, 99)) * 1e3 if lat else None)
+        res["serve_decode_kv_highwater_blocks"] = st["kv"]["highwater"]
+
+        def _phase_pass():
+            e = mk()
+            for p in prompts:
+                e.submit(p, max_new_tokens=min(new_tokens, 8))
+            e.run()
+
+        # a forward-only workload decomposes differently from a train
+        # step: serve = the decode loop end to end, forward = sampled
+        # dispatched op runtime inside it, flush = fusion flush time
+        res["serve_decode_phase_s"] = _span_phases(
+            _tracing, _phase_pass,
+            keys={"serve": "serve", "forward": "dispatch",
+                  "flush": "fusion"})
+    return res
+
+
 # name -> (fn, small_kwargs, full_cost_estimate_s). Order is the RUN
 # order: lenet first as a cheap sanity probe of real execution, then the
 # BERT headline — with one patient runner writing results incrementally,
@@ -902,6 +968,11 @@ CONFIGS = {
     "eager_fusion": (bench_eager_fusion,
                      {"iters": 60, "batch": 16, "hidden": 64,
                       "warmup": 5}, 180),
+    # the serving tier's tokens/sec + p50/p99 trajectory (paged KV
+    # cache + continuous batching): CPU-pinned like the two above
+    "serve_decode": (bench_serve_decode,
+                     {"requests": 4, "prompt": 4, "new_tokens": 4,
+                      "token_budget": 8}, 240),
     "lenet": (bench_lenet, {"batch": 8, "steps": 2, "warmup": 1}, 420),
     "bert": (bench_bert, {"batch": 2, "seq": 32, "steps": 2, "warmup": 1},
              900),

@@ -1,0 +1,217 @@
+"""Block-allocated paged KV cache (ROADMAP item 1; PAPERS.md "Ragged
+Paged Attention").
+
+The cache owns two device pools per transformer layer, each shaped
+``[num_blocks, block_size, num_heads, head_dim]``. A request's context
+lives in a *block table* — an ordered list of block ids — so logically
+contiguous token positions map to physically scattered fixed-size
+blocks; admitting a request allocates blocks lazily as its context
+grows, evicting frees them all at once. No slab is ever resized or
+copied: continuous batching admits/evicts per decode iteration and the
+only allocator work is list ops on integer block ids.
+
+Pools are functional jax state: the ragged attention op returns updated
+pools and the engine rebinds them via ``set_layer`` — so the cache
+composes with jit-cached dispatch and trace-fusion like every other
+tensor in the runtime (no in-place device mutation to invalidate a
+trace).
+
+Block ids are allocated lowest-id-first, which makes allocation
+deterministic: a batched run and a sequential replay of the same
+admission order produce identical block tables. Nothing downstream
+depends on that (attention gathers through the table), but determinism
+keeps the token-exactness acceptance test honest about what it proves.
+"""
+from __future__ import annotations
+
+import heapq
+import threading
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..runtime import telemetry as _telemetry
+
+__all__ = ["KVCacheConfig", "PagedKVCache"]
+
+
+class KVCacheConfig:
+    """Static geometry of the paged cache.
+
+    ``max_blocks_per_seq`` bounds one request's context at
+    ``max_blocks_per_seq * block_size`` tokens and fixes the block-table
+    width (ragged tables pad to it so every step keeps one stable shape
+    for the jit cache)."""
+
+    def __init__(self, num_layers, num_heads, head_dim, block_size=16,
+                 num_blocks=64, max_blocks_per_seq=None, dtype="float32"):
+        if block_size <= 0 or num_blocks <= 0:
+            raise ValueError("block_size and num_blocks must be positive")
+        self.num_layers = int(num_layers)
+        self.num_heads = int(num_heads)
+        self.head_dim = int(head_dim)
+        self.block_size = int(block_size)
+        self.num_blocks = int(num_blocks)
+        self.max_blocks_per_seq = int(max_blocks_per_seq or num_blocks)
+        self.dtype = dtype
+
+    @property
+    def max_context(self):
+        return self.max_blocks_per_seq * self.block_size
+
+    def __repr__(self):
+        return (f"KVCacheConfig(layers={self.num_layers}, "
+                f"heads={self.num_heads}, head_dim={self.head_dim}, "
+                f"block={self.block_size}x{self.num_blocks})")
+
+
+class PagedKVCache:
+    """Fixed-size-block KV store + per-request block tables.
+
+    Allocator state is host-side (plain ints under a lock — the
+    scheduler calls from the step loop only, but gauges are read from
+    exporter threads); tensor pools are device-side and purely
+    functional."""
+
+    def __init__(self, config: KVCacheConfig):
+        import jax.numpy as jnp
+
+        from ..core import dtype as dtypes
+
+        self.config = config
+        jdt = dtypes.to_jax_dtype(config.dtype)
+        shape = (config.num_blocks, config.block_size,
+                 config.num_heads, config.head_dim)
+        zeros = jnp.zeros(shape, jdt)
+        self._k = [Tensor(zeros) for _ in range(config.num_layers)]
+        self._v = [Tensor(zeros) for _ in range(config.num_layers)]
+        self._lock = threading.Lock()
+        self._free = list(range(config.num_blocks))  # kept a heap
+        heapq.heapify(self._free)
+        self._tables = {}        # request id -> [block ids]
+        self._highwater = 0
+        self._alloc_total = 0
+        self._free_total = 0
+        self._gauge = _telemetry.gauge(
+            "paddle_tpu_serve_kv_blocks", "paged KV cache blocks",
+            ("state",))
+        self._publish()
+
+    # -- allocator ----------------------------------------------------------
+
+    def _publish(self):
+        used = self.config.num_blocks - len(self._free)
+        self._gauge.labels(state="in_use").set(used)
+        self._gauge.labels(state="free").set(len(self._free))
+        self._gauge.labels(state="highwater").set(self._highwater)
+
+    def blocks_free(self):
+        with self._lock:
+            return len(self._free)
+
+    def blocks_in_use(self):
+        with self._lock:
+            return self.config.num_blocks - len(self._free)
+
+    def utilization(self):
+        with self._lock:
+            used = self.config.num_blocks - len(self._free)
+        return used / float(self.config.num_blocks)
+
+    def blocks_for(self, num_tokens):
+        """Blocks needed to hold `num_tokens` context positions."""
+        bs = self.config.block_size
+        return (int(num_tokens) + bs - 1) // bs
+
+    def ensure_capacity(self, request_id, num_tokens):
+        """Grow `request_id`'s block table to cover `num_tokens` context
+        positions. Returns True on success; False (allocating nothing)
+        when the pool cannot supply the missing blocks or the request
+        would exceed ``max_blocks_per_seq`` — the scheduler's cue to
+        defer or preempt."""
+        need = self.blocks_for(num_tokens)
+        if need > self.config.max_blocks_per_seq:
+            return False
+        with self._lock:
+            table = self._tables.setdefault(request_id, [])
+            missing = need - len(table)
+            if missing <= 0:
+                return True
+            if missing > len(self._free):
+                return False
+            for _ in range(missing):
+                table.append(heapq.heappop(self._free))
+            self._alloc_total += missing
+            used = self.config.num_blocks - len(self._free)
+            self._highwater = max(self._highwater, used)
+            self._publish()
+        return True
+
+    def release(self, request_id):
+        """Free every block the request holds (evict/finish). Unknown
+        ids are a no-op so double-release cannot corrupt the free list.
+        Returns the number of blocks freed."""
+        with self._lock:
+            table = self._tables.pop(request_id, None)
+            if not table:
+                return 0
+            for b in table:
+                heapq.heappush(self._free, b)
+            self._free_total += len(table)
+            self._publish()
+            return len(table)
+
+    def block_table(self, request_id):
+        with self._lock:
+            return list(self._tables.get(request_id, ()))
+
+    def num_requests(self):
+        with self._lock:
+            return len(self._tables)
+
+    def padded_tables(self, request_ids):
+        """i32 ``[len(request_ids), max_blocks_per_seq]`` block-table
+        matrix, one row per running slot, unused entries 0 (never read:
+        the attention op masks context positions past each row's token
+        position, which the allocator guarantees are covered by real
+        table entries)."""
+        out = np.zeros((len(request_ids), self.config.max_blocks_per_seq),
+                       np.int32)
+        with self._lock:
+            for i, rid in enumerate(request_ids):
+                table = self._tables.get(rid, ())
+                out[i, :len(table)] = table
+        return out
+
+    def stats(self):
+        with self._lock:
+            used = self.config.num_blocks - len(self._free)
+            return {"num_blocks": self.config.num_blocks,
+                    "block_size": self.config.block_size,
+                    "blocks_in_use": used,
+                    "blocks_free": len(self._free),
+                    "utilization": used / float(self.config.num_blocks),
+                    "highwater": self._highwater,
+                    "requests": len(self._tables),
+                    "allocs_total": self._alloc_total,
+                    "frees_total": self._free_total}
+
+    # -- device pools -------------------------------------------------------
+
+    def layer(self, i):
+        """(k_pool, v_pool) Tensors for layer `i`."""
+        return self._k[i], self._v[i]
+
+    def set_layer(self, i, k_pool, v_pool):
+        """Rebind layer `i`'s pools to the op-returned updated tensors."""
+        self._k[i] = k_pool
+        self._v[i] = v_pool
+
+    def reset_pools(self):
+        """Zero the device pools (tests); allocator state is untouched."""
+        import jax.numpy as jnp
+
+        for i in range(self.config.num_layers):
+            z = jnp.zeros_like(self._k[i]._value)
+            self._k[i] = Tensor(z)
+            self._v[i] = Tensor(z)

@@ -1,0 +1,157 @@
+"""Decoder model for the serving engine.
+
+`TinyServeModel` is a small pre-LN causal transformer LM whose
+attention reads/writes the paged KV cache through the ragged op
+(nn/functional/attention.py `ragged_paged_attention`). Every tensor op
+goes through `core.autograd.apply`, so the decode step rides the whole
+runtime spine for free: jit-cached per-op dispatch, trace-fusion
+(`PADDLE_TPU_EAGER_FUSION=1` records the many tiny decode ops and
+flushes ONE fused XLA program per step), warm-start manifest entries at
+every fresh build (the op callables are module-level, so entries replay
+in a fresh process), and sampled per-op runtime attribution.
+
+The forward is padding-free: it consumes the scheduler's ragged rows
+(`[T]` tokens with per-row request slot + position) directly, so a step
+mixing a 7-token prefill chunk with three decode tokens costs T=10 rows
+plus the fixed token-budget tail — never a [batch, max_seq] rectangle.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from .kv_cache import KVCacheConfig
+
+__all__ = ["TinyServeModel"]
+
+
+def _t(arr):
+    import jax.numpy as jnp
+
+    return Tensor(jnp.asarray(arr))
+
+
+def _embed(tok, pos, ew, pw):
+    import jax.numpy as jnp
+
+    safe = jnp.clip(pos, 0, pw.shape[0] - 1)
+    return jnp.take(ew, tok, axis=0) + jnp.take(pw, safe, axis=0)
+
+
+_embed.__name__ = "serve_embed"
+
+
+def _ln(v, w, b):
+    import jax.numpy as jnp
+
+    mu = v.mean(-1, keepdims=True)
+    var = ((v - mu) ** 2).mean(-1, keepdims=True)
+    return (v - mu) / jnp.sqrt(var + 1e-5) * w + b
+
+
+_ln.__name__ = "serve_layer_norm"
+
+
+def _qkv_proj(v, w):
+    import jax.numpy as jnp
+
+    return jnp.split(v @ w, 3, axis=-1)
+
+
+_qkv_proj.__name__ = "serve_qkv_proj"
+
+
+def _proj(v, w):
+    return v @ w
+
+
+_proj.__name__ = "serve_proj"
+
+
+def _mlp(v, w1, b1, w2, b2):
+    import jax.numpy as jnp
+
+    return jnp.tanh(v @ w1 + b1) @ w2 + b2
+
+
+_mlp.__name__ = "serve_mlp"
+
+
+def _add(a, b):
+    return a + b
+
+
+_add.__name__ = "serve_residual"
+
+
+class TinyServeModel:
+    """Deterministically initialized causal LM for serving tests,
+    smokes, and benches (the engine itself is model-agnostic: anything
+    exposing `kv_config()` + `forward(...)` with this contract serves).
+
+    Geometry: `dim` must divide by `heads`; KV heads == query heads
+    (MQA/GQA is out of scope for the CPU-correctness tier)."""
+
+    def __init__(self, vocab=64, dim=16, layers=2, heads=2, ffn=32,
+                 max_pos=256, seed=0):
+        if dim % heads:
+            raise ValueError("dim must be divisible by heads")
+        self.vocab, self.dim, self.layers = int(vocab), int(dim), int(layers)
+        self.heads, self.ffn, self.max_pos = int(heads), int(ffn), int(max_pos)
+        self.head_dim = self.dim // self.heads
+        rng = np.random.RandomState(seed)
+
+        def w(*shape, scale=0.05):
+            return _t((rng.randn(*shape) * scale).astype(np.float32))
+
+        self.params = {"embed": w(vocab, dim, scale=0.1),
+                       "pos": w(max_pos, dim, scale=0.02),
+                       "lnf_w": _t(np.ones(dim, np.float32)),
+                       "lnf_b": _t(np.zeros(dim, np.float32)),
+                       "head": w(dim, vocab, scale=0.1)}
+        for i in range(self.layers):
+            self.params.update({
+                f"l{i}_ln1_w": _t(np.ones(dim, np.float32)),
+                f"l{i}_ln1_b": _t(np.zeros(dim, np.float32)),
+                f"l{i}_wqkv": w(dim, 3 * dim),
+                f"l{i}_wo": w(dim, dim),
+                f"l{i}_ln2_w": _t(np.ones(dim, np.float32)),
+                f"l{i}_ln2_b": _t(np.zeros(dim, np.float32)),
+                f"l{i}_w1": w(dim, ffn),
+                f"l{i}_b1": _t(np.zeros(ffn, np.float32)),
+                f"l{i}_w2": w(ffn, dim),
+                f"l{i}_b2": _t(np.zeros(dim, np.float32))})
+
+    def kv_config(self, block_size=16, num_blocks=64,
+                  max_blocks_per_seq=None):
+        return KVCacheConfig(num_layers=self.layers, num_heads=self.heads,
+                             head_dim=self.head_dim, block_size=block_size,
+                             num_blocks=num_blocks,
+                             max_blocks_per_seq=max_blocks_per_seq)
+
+    def forward(self, token_ids, row_req, row_pos, cache, tables,
+                decode_only=False):
+        """One ragged step. `token_ids`/`row_req`/`row_pos`: i32 Tensors
+        `[T]` (padding rows: token 0, pos -1); `cache`: PagedKVCache
+        (pools are read AND rebound — the KV write is part of the op);
+        `tables`: i32 Tensor `[R, max_blocks_per_seq]`. Returns logits
+        Tensor `[T, vocab]`."""
+        from ..core.autograd import apply
+        from ..nn.functional.attention import ragged_paged_attention
+
+        p = self.params
+        x = apply(_embed, token_ids, row_pos, p["embed"], p["pos"])
+        for i in range(self.layers):
+            h = apply(_ln, x, p[f"l{i}_ln1_w"], p[f"l{i}_ln1_b"])
+            q, k, v = apply(_qkv_proj, h, p[f"l{i}_wqkv"])
+            kp, vp = cache.layer(i)
+            attn, kp2, vp2 = ragged_paged_attention(
+                q, k, v, kp, vp, tables, row_req, row_pos,
+                num_heads=self.heads, decode_only=decode_only)
+            cache.set_layer(i, kp2, vp2)
+            x = apply(_add, x, apply(_proj, attn, p[f"l{i}_wo"]))
+            h2 = apply(_ln, x, p[f"l{i}_ln2_w"], p[f"l{i}_ln2_b"])
+            x = apply(_add, x, apply(_mlp, h2, p[f"l{i}_w1"], p[f"l{i}_b1"],
+                                     p[f"l{i}_w2"], p[f"l{i}_b2"]))
+        x = apply(_ln, x, p["lnf_w"], p["lnf_b"])
+        return apply(_proj, x, p["head"])

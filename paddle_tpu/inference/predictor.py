@@ -1,0 +1,240 @@
+"""Paddle Inference predictor API (Config/Predictor/Tensor handles).
+
+Reference: paddle/fluid/inference + python/paddle/inference/__init__.py —
+Config (model paths, memory/threads, optimization switches),
+create_predictor, Predictor with named zero-copy input/output handles.
+
+TPU-native: a Predictor wraps a jit.save artifact (StableHLO + params):
+the program is AOT-compiled once per input signature (XLA compile cache),
+inputs bind as device arrays without host copies ("zero-copy" = the
+jax.Array handle IS the binding), outputs stay on device until copy_to_cpu.
+Config's GPU/MKLDNN toggles are accepted for parity and ignored.
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["Config", "Predictor", "Tensor", "create_predictor",
+           "PrecisionType", "PlaceType", "get_version"]
+
+
+class PrecisionType:
+    Float32 = 0
+    Half = 1
+    Bfloat16 = 2
+    Int8 = 3
+
+
+class PlaceType:
+    CPU = 0
+    GPU = 1
+    TPU = 4
+    XPU = 2
+
+
+class Config:
+    """Reference: paddle_infer.Config — model location + engine knobs."""
+
+    def __init__(self, prog_file=None, params_file=None):
+        # paddle convention: Config("path/model") or
+        # Config("m.pdmodel", "m.pdiparams")
+        if prog_file is not None and prog_file.endswith(".pdmodel"):
+            self._prefix = prog_file[:-len(".pdmodel")]
+        else:
+            self._prefix = prog_file
+        self._params_file = params_file
+        self._precision = PrecisionType.Float32
+        self._memory_pool_mb = 0
+        self._threads = 1
+        self._enable_ir = True
+        self._profile = False
+
+    def set_prog_file(self, path):
+        self._prefix = path[:-len(".pdmodel")] if path.endswith(".pdmodel") \
+            else path
+
+    def prog_file(self):
+        return (self._prefix or "") + ".pdmodel"
+
+    def set_params_file(self, path):
+        self._params_file = path
+
+    def params_file(self):
+        return self._params_file or (self._prefix or "") + ".pdiparams"
+
+    # engine knobs (accepted for parity; XLA owns memory/threads on TPU)
+    def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0):
+        self._memory_pool_mb = memory_pool_init_size_mb
+
+    def disable_gpu(self):
+        pass
+
+    def use_gpu(self):
+        return False
+
+    def enable_memory_optim(self):
+        pass
+
+    def set_cpu_math_library_num_threads(self, n):
+        self._threads = n
+
+    def switch_ir_optim(self, flag=True):
+        self._enable_ir = flag
+
+    def enable_profile(self):
+        self._profile = True
+
+    def enable_tensorrt_engine(self, *a, **kw):
+        pass  # TensorRT has no TPU meaning; XLA is the optimizing compiler
+
+    def summary(self):
+        return (f"Config(prog={self.prog_file()}, "
+                f"params={self.params_file()}, threads={self._threads})")
+
+
+class Tensor:
+    """Named zero-copy binding handle (reference: paddle_infer.Tensor)."""
+
+    def __init__(self, predictor, name, is_input):
+        self._p = predictor
+        self._name = name
+        self._is_input = is_input
+
+    def copy_from_cpu(self, arr):
+        self._p._inputs[self._name] = jnp.asarray(np.asarray(arr))
+
+    def share_external_data(self, arr):
+        # jax.Array binds directly — the handle is the device buffer
+        self._p._inputs[self._name] = arr._value if hasattr(arr, "_value") \
+            else jnp.asarray(arr)
+
+    def copy_to_cpu(self):
+        return np.asarray(self._p._outputs[self._name])
+
+    def to_dlpack(self):
+        return jax.dlpack.to_dlpack(self._p._outputs[self._name])
+
+    def shape(self):
+        src = self._p._inputs if self._is_input else self._p._outputs
+        v = src.get(self._name)
+        return list(v.shape) if v is not None else None
+
+    def reshape(self, shape):
+        pass  # shapes derive from the bound array
+
+
+class Predictor:
+    def __init__(self, config):
+        from ..jit import load as jit_load
+
+        self._config = config
+        prefix = config._prefix
+        if not os.path.exists(prefix + ".pdmodel"):
+            raise FileNotFoundError(prefix + ".pdmodel")
+        self._layer = jit_load(prefix)
+        meta = self._load_meta(prefix)
+        n_in = len(meta["in_shapes"]) if meta else 1
+        self._in_names = [f"x{i}" for i in range(n_in)]
+        self._out_names = []
+        self._inputs = {}
+        self._outputs = {}
+
+    @staticmethod
+    def _load_meta(prefix):
+        import pickle
+
+        try:
+            with open(prefix + ".pdmodel.meta", "rb") as f:
+                return pickle.load(f)
+        except OSError:
+            return None
+
+    def get_input_names(self):
+        return list(self._in_names)
+
+    def get_input_handle(self, name):
+        return Tensor(self, name, True)
+
+    def get_output_names(self):
+        return list(self._out_names)
+
+    def get_output_handle(self, name):
+        return Tensor(self, name, False)
+
+    def run(self, inputs=None):
+        """Execute the AOT-compiled program. `inputs` (optional list of
+        arrays) is the convenience form; otherwise bound input handles."""
+        if inputs is not None:
+            args = [jnp.asarray(np.asarray(a)) for a in inputs]
+        else:
+            args = [self._inputs[n] for n in self._in_names]
+        outs = self._layer(*args)
+        outs = outs if isinstance(outs, (list, tuple)) else [outs]
+        self._out_names = [f"out{i}" for i in range(len(outs))]
+        self._outputs = {n: o._value for n, o in zip(self._out_names, outs)}
+        if inputs is not None:
+            return [np.asarray(v) for v in self._outputs.values()]
+        return True
+
+    def clear_intermediate_tensor(self):
+        self._outputs = {}
+
+    def try_shrink_memory(self):
+        pass
+
+
+def create_predictor(config):
+    return Predictor(config)
+
+
+def get_version():
+    import paddle_tpu
+
+    return paddle_tpu.__version__
+
+
+class DataType:
+    """Predictor tensor dtypes (reference paddle_infer_declare.h)."""
+    FLOAT32 = 0
+    INT64 = 1
+    INT32 = 2
+    UINT8 = 3
+    INT8 = 4
+    FLOAT16 = 5
+    BFLOAT16 = 6
+
+
+def get_num_bytes_of_data_type(dtype):
+    return {DataType.FLOAT32: 4, DataType.INT64: 8, DataType.INT32: 4,
+            DataType.UINT8: 1, DataType.INT8: 1, DataType.FLOAT16: 2,
+            DataType.BFLOAT16: 2}[dtype]
+
+
+class PredictorPool:
+    """A pool of Predictors sharing one compiled executable (reference
+    paddle_inference_api.h PredictorPool). XLA executables are reentrant, so
+    the clones share the AOT artifact and differ only in binding state."""
+
+    def __init__(self, config, size=1):
+        self._preds = [create_predictor(config) for _ in range(max(1, size))]
+
+    def retrive(self, idx):  # reference spells it 'retrive'
+        return self._preds[idx]
+
+    retrieve = retrive
+
+
+def get_trt_compile_version():
+    return (0, 0, 0)  # no TensorRT tier on TPU; XLA AOT serves this role
+
+
+def get_trt_runtime_version():
+    return (0, 0, 0)
+
+
+__all__ += ["DataType", "PredictorPool", "get_num_bytes_of_data_type",
+            "get_trt_compile_version", "get_trt_runtime_version"]

@@ -1,0 +1,341 @@
+"""Continuous-batching scheduler (ROADMAP item 1).
+
+Per decode iteration the scheduler assembles one ragged batch under a
+fixed token budget: every RUNNING request past its prefill contributes
+exactly one decode row; leftover budget is fed to admitted requests'
+unfed prompt tokens as chunked prefill. Requests are admitted the
+moment a running slot AND at least one KV block are free, and evicted
+the moment they finish, exhaust their deadline, or must be preempted to
+un-wedge a decode that cannot grow its context (preemption returns the
+youngest prefilling request to the queue and frees its blocks — the
+victim restarts from scratch later; a decode-phase request is never
+preempted for a prefill one).
+
+Deadlines ride the resilience substrate: an expired request records a
+``request_deadline`` fault event and is evicted AT the deadline check
+of the next step — the batch loop keeps serving everyone else (the
+FaultInjector acceptance test wedges a step with an injected delay and
+proves the loop degrades per-request instead of globally).
+
+All array outputs are fixed-shape (token budget T, slot count R, table
+width Bmax) so the jit cache sees ONE step signature regardless of the
+ragged mix — the padding-free property is about never paying a
+[batch, max_seq] rectangle, not about varying T.
+"""
+from __future__ import annotations
+
+import collections
+import itertools
+import time
+
+import numpy as np
+
+from ..runtime.resilience import record_fault
+
+__all__ = ["RequestState", "ServeRequest", "StepPlan",
+           "ContinuousBatchingScheduler"]
+
+
+class RequestState:
+    WAITING = "waiting"
+    RUNNING = "running"
+    FINISHED = "finished"
+    EVICTED = "evicted"
+
+
+_ids = itertools.count()
+
+
+class ServeRequest:
+    """One generation request. `deadline_s` is a wall-clock budget from
+    submit; None = no deadline. `prompt` must be non-empty."""
+
+    __slots__ = ("request_id", "prompt", "max_new_tokens", "deadline_s",
+                 "eos_id", "state", "generated", "slot", "n_fed",
+                 "n_cached", "t_submit", "t_submit_wall", "t_first_token",
+                 "t_done", "preemptions", "evict_reason")
+
+    def __init__(self, prompt, max_new_tokens=16, deadline_s=None,
+                 eos_id=None, request_id=None):
+        prompt = [int(t) for t in prompt]
+        if not prompt:
+            raise ValueError("empty prompt")
+        self.request_id = (request_id if request_id is not None
+                           else f"req-{next(_ids)}")
+        self.prompt = prompt
+        self.max_new_tokens = int(max_new_tokens)
+        self.deadline_s = deadline_s
+        self.eos_id = eos_id
+        self.state = RequestState.WAITING
+        self.generated = []
+        self.slot = None          # running-slot index while RUNNING
+        self.n_fed = 0            # prompt tokens scheduled into batches
+        self.n_cached = 0         # context positions present in the cache
+        self.t_submit = time.perf_counter()
+        self.t_submit_wall = time.time()
+        self.t_first_token = None
+        self.t_done = None
+        self.preemptions = 0
+        self.evict_reason = None
+
+    @property
+    def context_len(self):
+        """Positions the NEXT scheduled token would extend to."""
+        return self.n_cached
+
+    def expired(self, now):
+        return (self.deadline_s is not None
+                and now - self.t_submit > self.deadline_s)
+
+    def __repr__(self):
+        return (f"ServeRequest({self.request_id}, {self.state}, "
+                f"fed={self.n_fed}/{len(self.prompt)}, "
+                f"gen={len(self.generated)}/{self.max_new_tokens})")
+
+
+class StepPlan:
+    """One ragged batch: fixed-shape i32 arrays + the emit map."""
+
+    __slots__ = ("token_ids", "row_req", "row_pos", "emit", "n_rows",
+                 "decode_rows", "prefill_rows", "scheduled")
+
+    def __init__(self, token_budget):
+        self.token_ids = np.zeros(token_budget, np.int32)
+        self.row_req = np.zeros(token_budget, np.int32)
+        self.row_pos = np.full(token_budget, -1, np.int32)
+        self.emit = []            # (row index, ServeRequest)
+        self.n_rows = 0
+        self.decode_rows = 0
+        self.prefill_rows = 0
+        self.scheduled = []
+
+    @property
+    def decode_only(self):
+        return self.n_rows > 0 and self.prefill_rows == 0
+
+    def add_row(self, token, slot, pos, request, emits):
+        i = self.n_rows
+        self.token_ids[i] = token
+        self.row_req[i] = slot
+        self.row_pos[i] = pos
+        if emits:
+            self.emit.append((i, request))
+        self.n_rows += 1
+
+
+class ContinuousBatchingScheduler:
+    """Admission queue + running set over a PagedKVCache."""
+
+    def __init__(self, cache, max_running=4, token_budget=16,
+                 default_deadline_s=None, history_limit=1024):
+        if token_budget < 1 or max_running < 1:
+            raise ValueError("token_budget and max_running must be >= 1")
+        self.cache = cache
+        self.max_running = int(max_running)
+        self.token_budget = int(token_budget)
+        self.default_deadline_s = default_deadline_s
+        self.queue = collections.deque()
+        self.running = {}         # slot -> ServeRequest
+        # bounded retrospection only — a long-running server must not
+        # retain every request ever served; totals keep counting
+        self.finished = collections.deque(maxlen=int(history_limit))
+        self.evicted = collections.deque(maxlen=int(history_limit))
+        self.finished_total = 0
+        self.evicted_total = 0
+        self._admit_order = itertools.count()
+        self._admitted_at = {}    # request_id -> admit sequence number
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def submit(self, request):
+        if request.deadline_s is None:
+            request.deadline_s = self.default_deadline_s
+        self.queue.append(request)
+        return request.request_id
+
+    def has_work(self):
+        return bool(self.queue or self.running)
+
+    def _free_slot(self):
+        for s in range(self.max_running):
+            if s not in self.running:
+                return s
+        return None
+
+    def _evict(self, req, reason, fault=None):
+        """Remove `req` from the running set and free its blocks."""
+        self.cache.release(req.request_id)
+        if req.slot is not None:
+            self.running.pop(req.slot, None)
+        req.slot = None
+        req.state = RequestState.EVICTED
+        req.evict_reason = reason
+        self.evicted.append(req)
+        self.evicted_total += 1
+        self._admitted_at.pop(req.request_id, None)
+        if fault:
+            record_fault(fault, f"{req.request_id}: {reason}")
+
+    def _preempt_for_blocks(self, needy):
+        """Free blocks for a decode request by returning the YOUNGEST
+        still-prefilling request to the queue (it restarts later).
+        Returns True if anything was preempted."""
+        victims = [r for r in self.running.values()
+                   if r is not needy and r.n_fed < len(r.prompt)]
+        if not victims:
+            return False
+        victim = max(victims,
+                     key=lambda r: self._admitted_at.get(r.request_id, 0))
+        self.cache.release(victim.request_id)
+        self.running.pop(victim.slot, None)
+        victim.slot = None
+        victim.state = RequestState.WAITING
+        victim.n_fed = 0
+        victim.n_cached = 0
+        victim.preemptions += 1
+        self.queue.appendleft(victim)
+        record_fault("kv_preemptions",
+                     f"{victim.request_id} preempted for "
+                     f"{needy.request_id}")
+        return True
+
+    # -- the per-iteration planner -----------------------------------------
+
+    def plan(self, now=None):
+        """Build the next ragged batch. Returns a StepPlan (possibly
+        empty: nothing runnable this iteration)."""
+        now = time.perf_counter() if now is None else now
+        # 1. deadlines: expired requests leave the batch loop HERE, so a
+        # slow request can never wedge the others past its budget
+        for req in list(self.running.values()):
+            if req.expired(now):
+                self._evict(req, "deadline", fault="request_deadline")
+        for req in list(self.queue):
+            if req.expired(now):
+                self.queue.remove(req)
+                self._evict(req, "deadline_queued",
+                            fault="request_deadline")
+        # 2. admission: slot free + at least one block to start on. A
+        # prompt that cannot fit the per-request context bound even
+        # with every generated token still to come is rejected HERE —
+        # admitted, it would starve in the prefill loop forever
+        while self.queue and self.cache.blocks_free() > 0:
+            slot = self._free_slot()
+            if slot is None:
+                break
+            req = self.queue.popleft()
+            if len(req.prompt) + 1 > self.cache.config.max_context:
+                self._evict(req, "prompt_too_long", fault="kv_evictions")
+                continue
+            req.slot = slot
+            req.state = RequestState.RUNNING
+            self.running[slot] = req
+            self._admitted_at[req.request_id] = next(self._admit_order)
+        plan = StepPlan(self.token_budget)
+        budget = self.token_budget
+        # 3. decode rows first: one token per request in decode phase.
+        # Slot order keeps the batch layout deterministic.
+        for slot in sorted(self.running):
+            if budget <= 0:
+                break
+            req = self.running.get(slot)
+            # a preemption for an earlier slot's decode may have removed
+            # this one from the snapshot sorted() took
+            if (req is None or req.n_fed < len(req.prompt)
+                    or self._done(req)):
+                continue
+            if req.n_cached + 1 > self.cache.config.max_context:
+                # the per-request block bound can NEVER be satisfied by
+                # freeing peers' blocks — evict directly instead of
+                # running a futile preemption cascade that would restart
+                # every prefilling request for nothing
+                self._evict(req, "context_exhausted", fault="kv_evictions")
+                continue
+            while not self.cache.ensure_capacity(req.request_id,
+                                                 req.n_cached + 1):
+                if not self._preempt_for_blocks(req):
+                    break
+            else:
+                token = (req.generated[-1] if req.generated
+                         else req.prompt[-1])
+                plan.add_row(token, slot, req.n_cached, req, emits=True)
+                plan.decode_rows += 1
+                plan.scheduled.append(req)
+                req.n_cached += 1
+                budget -= 1
+                continue
+            # capacity unobtainable even after preemption: the request
+            # hit max_blocks_per_seq or the pool is truly exhausted
+            self._evict(req, "kv_exhausted", fault="kv_evictions")
+        # 4. prefill chunks fill the remaining budget, oldest admission
+        # first (FIFO fairness; chunking keeps one request's long prompt
+        # from starving the batch forever)
+        for slot in sorted(
+                self.running,
+                key=lambda s: self._admitted_at.get(
+                    self.running[s].request_id, 0)):
+            if budget <= 0:
+                break
+            req = self.running.get(slot)
+            if req is None or req.n_fed >= len(req.prompt):
+                continue
+            chunk = min(budget, len(req.prompt) - req.n_fed)
+            while chunk > 0 and not self.cache.ensure_capacity(
+                    req.request_id, req.n_fed + chunk):
+                # shrink to what the pool (and the per-request block
+                # bound) can hold before resorting to waiting; always
+                # strictly shrinks, so the loop terminates
+                fit = min((self.cache.blocks_free()
+                           + self.cache.blocks_for(req.n_cached))
+                          * self.cache.config.block_size,
+                          self.cache.config.max_context) - req.n_fed
+                chunk = min(chunk - 1, max(0, fit))
+            if chunk <= 0:
+                continue
+            last = len(req.prompt) - 1
+            for j in range(chunk):
+                pos = req.n_fed + j
+                plan.add_row(req.prompt[pos], slot, pos, req,
+                             emits=pos == last)
+            plan.prefill_rows += chunk
+            plan.scheduled.append(req)
+            req.n_fed += chunk
+            req.n_cached = req.n_fed
+            budget -= chunk
+        return plan
+
+    def _done(self, req):
+        if req.max_new_tokens and len(req.generated) >= req.max_new_tokens:
+            return True
+        return (req.eos_id is not None and req.generated
+                and req.generated[-1] == req.eos_id)
+
+    def complete_step(self, plan, tokens, now=None):
+        """Apply one step's sampled tokens (host ints, indexed by
+        plan.emit rows). Returns the requests that finished this step."""
+        now = time.perf_counter() if now is None else now
+        done = []
+        for row, req in plan.emit:
+            if req.state != RequestState.RUNNING:
+                continue  # evicted mid-step (deadline raced the batch)
+            req.generated.append(int(tokens[row]))
+            if req.t_first_token is None:
+                req.t_first_token = now
+            if self._done(req):
+                req.t_done = now
+                req.state = RequestState.FINISHED
+                self.cache.release(req.request_id)
+                self.running.pop(req.slot, None)
+                req.slot = None
+                self.finished.append(req)
+                self.finished_total += 1
+                self._admitted_at.pop(req.request_id, None)
+                done.append(req)
+        return done
+
+    def stats(self):
+        return {"queued": len(self.queue),
+                "running": len(self.running),
+                "finished": self.finished_total,
+                "evicted": self.evicted_total,
+                "kv": self.cache.stats()}

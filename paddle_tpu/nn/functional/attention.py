@@ -17,10 +17,15 @@ from ...core.autograd import apply
 from ...core.tensor import Tensor
 from ...framework import random as rnd
 
-__all__ = ["scaled_dot_product_attention", "_attention_core"]
+__all__ = ["scaled_dot_product_attention", "_attention_core",
+           "ragged_paged_attention"]
 
 # populated by ops.pallas.flash_attention at import (avoids hard dep)
 _flash_attention_fn = None
+
+# populated by ops.pallas.ragged_paged_attention at import: the decode-
+# shaped paged-attention kernel (one query token per ragged row)
+_paged_decode_fn = None
 
 
 def _use_flash(q_shape, head_dim, mask, dropout):
@@ -101,6 +106,142 @@ def _attention_core(q, k, v, attn_mask, dropout_p, need_weights=False,
     if need_weights:
         return res[0], res[1]
     return res, None
+
+
+def _use_paged_kernel(head_dim, decode_only):
+    """Gate for the Pallas ragged/paged decode kernel — the same
+    capability probe flash attention uses (TPU backend + head_dim small
+    enough that lane padding pays), plus the kernel's own shape
+    precondition: every ragged row is a single decode query. Prefill
+    chunks and CPU runs take the dense path, which is the correctness
+    reference the kernel is parity-tested against. Under trace-fusion
+    the dense path is used too: a fused trace defers execution, so a
+    Mosaic lowering failure would surface at the flush site where the
+    kernel's degrade-to-dense guard can no longer catch it (and the
+    fused program already removes the per-op dispatch tax the kernel
+    path would otherwise dodge)."""
+    if _paged_decode_fn is None or not decode_only:
+        return False
+    if jax.default_backend() != "tpu":
+        return False
+    from ...core import fusion as _fusion
+
+    if _fusion.fusion_enabled():
+        return False
+    return head_dim <= 256
+
+
+def _scatter_paged_kv(kf, vf, kp, vp, tables, row_req, row_pos,
+                      block_size):
+    """Shared slot arithmetic + KV scatter (traced inside both the
+    dense op and the kernel path's write op — ONE definition, so the
+    write the kernel reads back is bit-identical to the dense
+    reference's). Row t's new K/V lands at the slot its block table
+    maps position `row_pos[t]` to; padding rows (row_pos = -1) scatter
+    to slot nb*bs, out of range -> dropped."""
+    nb, bs, h, d = kp.shape
+    t = kf.shape[0]
+    k3 = kf.reshape(t, h, d).astype(kp.dtype)
+    v3 = vf.reshape(t, h, d).astype(vp.dtype)
+    valid = row_pos >= 0
+    safe_req = jnp.where(valid, row_req, 0)
+    safe_pos = jnp.where(valid, row_pos, 0)
+    blk = tables[safe_req, safe_pos // block_size]
+    slot = jnp.where(valid, blk * block_size + safe_pos % block_size,
+                     nb * bs)
+    kp2 = kp.reshape(nb * bs, h, d).at[slot].set(
+        k3, mode="drop").reshape(nb, bs, h, d)
+    vp2 = vp.reshape(nb * bs, h, d).at[slot].set(
+        v3, mode="drop").reshape(nb, bs, h, d)
+    return kp2, vp2, valid, safe_req, safe_pos
+
+
+def _ragged_paged_dense(block_size, sm_scale):
+    """Dense CPU-correct ragged/paged attention over a block-paged KV
+    pool. Returns the op callable `apply` dispatches; statics are closed
+    over (encodable ints/floats, so warm-start manifest entries replay).
+
+    Per ragged row t (one token of some request's prefill chunk, or one
+    decode token): write the row's new K/V into the pool at the slot its
+    block table maps position `row_pos[t]` to, then attend over every
+    pooled position of ITS OWN request at positions <= row_pos[t]
+    (causal within the request, zero cross-request leakage). Padding
+    rows carry row_pos = -1: their writes drop (out-of-range scatter
+    slot) and their outputs are zeros. Masked positions contribute an
+    EXACT zero (post-softmax where), so a request's output depends only
+    on its own context — the bit-level independence the batched-vs-
+    sequential token-exactness acceptance rides on."""
+    def ragged_paged_attention(qf, kf, vf, kp, vp, tables, row_req,
+                               row_pos):
+        nb, bs, h, d = kp.shape
+        t = qf.shape[0]
+        bmax = tables.shape[1]
+        q3 = qf.reshape(t, h, d)
+        kp2, vp2, valid, safe_req, safe_pos = _scatter_paged_kv(
+            kf, vf, kp, vp, tables, row_req, row_pos, block_size)
+        row_tables = tables[safe_req]                       # [t, bmax]
+        k_ctx = kp2[row_tables].reshape(t, bmax * bs, h, d)
+        v_ctx = vp2[row_tables].reshape(t, bmax * bs, h, d)
+        # table entry j holds positions j*bs .. j*bs+bs-1, so the
+        # flattened gather is position-ordered: context index == position
+        ctx_pos = jnp.arange(bmax * bs, dtype=row_pos.dtype)
+        allowed = (ctx_pos[None, :] <= safe_pos[:, None]) & valid[:, None]
+        s = jnp.einsum("thd,tchd->thc", q3.astype(jnp.float32),
+                       k_ctx.astype(jnp.float32)) * sm_scale
+        s = jnp.where(allowed[:, None, :], s, -1e30)
+        m = jnp.max(s, axis=-1, keepdims=True)
+        p = jnp.exp(s - m)
+        p = jnp.where(allowed[:, None, :], p, 0.0)  # EXACT zero off-mask
+        l = jnp.sum(p, axis=-1, keepdims=True)
+        l = jnp.where(l == 0.0, 1.0, l)
+        out = jnp.einsum("thc,tchd->thd", p / l,
+                         v_ctx.astype(jnp.float32))
+        return out.reshape(t, h * d).astype(qf.dtype), kp2, vp2
+    return ragged_paged_attention
+
+
+def _paged_kv_write(block_size):
+    """Standalone paged KV scatter (the write half of the dense op) —
+    the Pallas decode path runs this via XLA, then reads through the
+    kernel. Same slot arithmetic as `_ragged_paged_dense`."""
+    def paged_kv_write(kf, vf, kp, vp, tables, row_req, row_pos):
+        kp2, vp2, _, _, _ = _scatter_paged_kv(
+            kf, vf, kp, vp, tables, row_req, row_pos, block_size)
+        return kp2, vp2
+    return paged_kv_write
+
+
+def ragged_paged_attention(q, k, v, k_pool, v_pool, block_tables,
+                           row_req, row_pos, *, num_heads,
+                           sm_scale=None, decode_only=False):
+    """Ragged/paged attention op (PAPERS.md "Ragged Paged Attention").
+
+    ``q``/``k``/``v``: ``[T, num_heads*head_dim]`` Tensors — one row per
+    ragged token (prefill chunks and decode tokens mixed, padding-free
+    up to the step's token-budget tail). ``k_pool``/``v_pool``: one
+    layer's paged pools ``[num_blocks, block_size, num_heads,
+    head_dim]``. ``block_tables``: i32 ``[R, max_blocks_per_seq]``;
+    ``row_req``: i32 ``[T]`` running-slot index per row; ``row_pos``:
+    i32 ``[T]`` token position within its request (-1 = padding row).
+
+    Returns ``(out [T, num_heads*head_dim], k_pool', v_pool')`` — the
+    new token KV is written into the returned pools.
+
+    Dispatch: dense XLA path everywhere (the correctness reference);
+    on TPU, pure-decode steps (``decode_only=True``) route the attention
+    read through the Pallas paged decode kernel, with the KV write kept
+    on the dense scatter path — both behind the flash-style capability
+    probe and parity-tested block-by-block against the dense path."""
+    head_dim = k_pool.shape[-1]
+    block_size = k_pool.shape[1]
+    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(head_dim)
+    if _use_paged_kernel(head_dim, decode_only):
+        return _paged_decode_fn(q, k, v, k_pool, v_pool, block_tables,
+                                row_req, row_pos, num_heads, block_size,
+                                scale)
+    fn = _ragged_paged_dense(block_size, scale)
+    return apply(fn, q, k, v, k_pool, v_pool, block_tables, row_req,
+                 row_pos)
 
 
 def scaled_dot_product_attention(query, key, value, attn_mask=None,

@@ -1,3 +1,4 @@
 """Pallas TPU kernels (reference: handwritten CUDA kernels in
 phi/kernels/gpu + fluid/operators/fused)."""
 from . import flash_attention  # noqa: F401  (registers attention fast path)
+from . import ragged_paged_attention  # noqa: F401  (registers paged decode)

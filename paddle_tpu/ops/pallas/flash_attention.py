@@ -7,12 +7,14 @@ keeps the (seq x seq) score matrix out of HBM, with a flash backward pass.
 
 Layout: [batch*heads, seq, head_dim]; fp32 accumulation on the MXU
 (preferred_element_type), bf16-friendly inputs. Causal masking skips whole
-k-blocks past the diagonal. Kernels trace under jax.enable_x64(False):
-the framework enables x64 globally for dtype parity, but Mosaic lowering
-wants i32 index arithmetic.
+k-blocks past the diagonal. On TPU the kernels trace under an
+x64-disabled scope (the framework enables x64 globally for dtype parity,
+but Mosaic lowering wants i32 index arithmetic); interpret mode traces
+under the ambient config.
 """
 from __future__ import annotations
 
+import contextlib
 import functools
 import json
 import math
@@ -20,6 +22,7 @@ import os
 
 import jax
 import jax.numpy as jnp
+from jax.experimental import disable_x64 as _disable_x64
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu  # noqa: F401
 
@@ -84,6 +87,15 @@ def _interpret():
     """Pallas interpret mode off-TPU: the same kernel logic executes via
     XLA ops, so CPU tests exercise fwd+bwd numerics every round."""
     return jax.default_backend() != "tpu"
+
+
+def _trace_ctx():
+    """Mosaic lowering wants i32 index arithmetic, so on TPU the kernels
+    trace under an x64-disabled scope. In interpret mode the kernel is
+    plain XLA ops where i64 indices are fine — and the scope is actively
+    harmful there: a vjp traced under ambient x64 re-types the fori_loop
+    counter i64 against the scope's i32 bound (mixed-type while cond)."""
+    return contextlib.nullcontext() if _interpret() else _disable_x64()
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, *rest, block_q, block_k,
@@ -242,7 +254,7 @@ def _fwd(q, k, v, kv_mask, causal, sm_scale, block_q, block_k):
     if masked:
         in_specs.append(pl.BlockSpec((1, 1, seq_k), lambda b, i: (b, 0, 0)))
         args.append(_mask3(kv_mask))
-    with jax.enable_x64(False):
+    with _trace_ctx():
         o, lse = pl.pallas_call(
             functools.partial(_fwd_kernel, block_q=block_q, block_k=block_k,
                               seq_k=seq_k, causal=causal, sm_scale=sm_scale,
@@ -281,7 +293,7 @@ def _bwd(q, k, v, o, lse, do, kv_mask, causal, sm_scale, block_q, block_k):
     if masked:
         base_specs = base_specs + [mask_spec]
         kv_args = kv_args + [_mask3(kv_mask)]
-    with jax.enable_x64(False):
+    with _trace_ctx():
         dk, dv = pl.pallas_call(
             functools.partial(_bwd_kv_kernel, block_q=block_q,
                               block_k=block_k, seq_q=seq_q, causal=causal,

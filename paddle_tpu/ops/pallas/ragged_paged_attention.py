@@ -1,0 +1,142 @@
+"""Ragged/paged attention decode kernel for TPU (Pallas).
+
+Reference capability: PAPERS.md "Ragged Paged Attention: A High-
+Performance and Flexible LLM Inference Kernel for TPU" — the serving-
+side sibling of ops/pallas/flash_attention.py. One ragged row = one
+decode query token; its KV context lives scattered across fixed-size
+blocks of a paged pool (inference/kv_cache.py), reached through a
+per-row block table. The kernel grids over rows and streams the row's
+blocks through an online-softmax accumulator, so the gather never
+materializes a [rows, max_context] score matrix and padding rows cost
+one masked block sweep.
+
+The dense path in nn/functional/attention.py is the correctness
+reference; this kernel is parity-tested block-by-block against it and
+dispatched behind the same capability probe flash attention uses
+(interpret mode off-TPU, so CPU tests exercise the kernel logic every
+round).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from ...runtime.resilience import record_fault
+from .flash_attention import _interpret, _trace_ctx
+
+__all__ = ["paged_attention_decode_raw"]
+
+NEG_INF = -1e30
+
+
+def _decode_kernel(q_ref, kp_ref, vp_ref, tbl_ref, len_ref, o_ref, *,
+                   block_size, max_blocks, sm_scale):
+    q = q_ref[0].astype(jnp.float32) * sm_scale            # [H, D]
+    ctx_len = len_ref[0, 0]                                # i32 scalar
+    h, d = q.shape
+    m0 = jnp.full((h, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((h, 1), jnp.float32)
+    acc0 = jnp.zeros((h, d), jnp.float32)
+
+    def body(j, carry):
+        m, l, acc = carry
+        blk = tbl_ref[0, j]
+        k = pl.load(kp_ref, (pl.ds(blk, 1), slice(None), slice(None),
+                             slice(None)))[0].astype(jnp.float32)
+        v = pl.load(vp_ref, (pl.ds(blk, 1), slice(None), slice(None),
+                             slice(None)))[0].astype(jnp.float32)
+        s = jnp.einsum("hd,shd->hs", q, k)                 # [H, BS]
+        pos = (j * block_size
+               + jax.lax.iota(jnp.int32, block_size))      # [BS]
+        live = pos < ctx_len
+        s = jnp.where(live[None, :], s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        p = jnp.where(live[None, :], p, 0.0)  # exact zero off-context
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=1, keepdims=True)
+        acc_new = acc * alpha + jnp.einsum("hs,shd->hd", p, v)
+        return m_new, l_new, acc_new
+
+    m, l, acc = jax.lax.fori_loop(0, max_blocks, body, (m0, l0, acc0))
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    o_ref[0] = (acc / l_safe).astype(o_ref.dtype)
+
+
+def paged_attention_decode_raw(q, k_pool, v_pool, row_tables, ctx_lens,
+                               sm_scale):
+    """q: [T, H, D] — one decode query per ragged row; k_pool/v_pool:
+    [NB, BS, H, D] paged pools ALREADY holding the new tokens' KV;
+    row_tables: i32 [T, Bmax] per-row block tables; ctx_lens: i32 [T]
+    valid context length per row (0 for padding rows -> zero output).
+    Returns [T, H, D]."""
+    t, h, d = q.shape
+    nb, bs, _, _ = k_pool.shape
+    bmax = row_tables.shape[1]
+    # weak-typed scale: an np.float64 scalar would promote the f32
+    # accumulators to f64 under the framework's global x64 config
+    sm_scale = float(sm_scale)
+    lens2 = ctx_lens.astype(jnp.int32).reshape(t, 1)
+    with _trace_ctx():
+        return pl.pallas_call(
+            functools.partial(_decode_kernel, block_size=bs,
+                              max_blocks=bmax, sm_scale=sm_scale),
+            grid=(t,),
+            in_specs=[
+                pl.BlockSpec((1, h, d), lambda i: (i, 0, 0)),
+                pl.BlockSpec((nb, bs, h, d), lambda i: (0, 0, 0, 0)),
+                pl.BlockSpec((nb, bs, h, d), lambda i: (0, 0, 0, 0)),
+                pl.BlockSpec((1, bmax), lambda i: (i, 0)),
+                pl.BlockSpec((1, 1), lambda i: (i, 0)),
+            ],
+            out_specs=pl.BlockSpec((1, h, d), lambda i: (i, 0, 0)),
+            out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+            interpret=_interpret(),
+        )(q, k_pool, v_pool, row_tables.astype(jnp.int32), lens2)
+
+
+def _register():
+    """Install as nn/functional/attention.py's paged decode fast path."""
+    from ...nn.functional import attention as A
+
+    def dispatch(q, k, v, k_pool, v_pool, block_tables, row_req, row_pos,
+                 num_heads, block_size, scale):
+        from ...core.autograd import apply
+
+        # KV write stays on the dense scatter path (XLA fuses it); the
+        # kernel serves the attention read over the updated pools
+        write = A._paged_kv_write(block_size)
+
+        def _paged_decode(qf, kp, vp, tables, rreq, rpos):
+            tcount = qf.shape[0]
+            q3 = qf.reshape(tcount, num_heads, -1)
+            valid = rpos >= 0
+            safe_req = jnp.where(valid, rreq, 0)
+            row_tables = tables[safe_req]
+            lens = jnp.where(valid, rpos + 1, 0)
+            out = paged_attention_decode_raw(q3, kp, vp, row_tables,
+                                             lens, scale)
+            return out.reshape(tcount, -1).astype(qf.dtype)
+        kp2, vp2 = apply(write, k, v, k_pool, v_pool, block_tables,
+                         row_req, row_pos)
+        try:
+            out = apply(_paged_decode, q, kp2, vp2, block_tables,
+                        row_req, row_pos)
+        except Exception as e:  # noqa: BLE001 — a Mosaic lowering gap on
+            # this chip generation must degrade to the dense reference,
+            # never crash the serving loop (pools are already written,
+            # so the dense op's rewrite of the same slots is idempotent)
+            record_fault("paged_kernel_fallbacks",
+                         f"{type(e).__name__}"[:120])
+            dense = A._ragged_paged_dense(block_size, scale)
+            out, kp2, vp2 = apply(dense, q, k, v, k_pool, v_pool,
+                                  block_tables, row_req, row_pos)
+        return out, kp2, vp2
+
+    A._paged_decode_fn = dispatch
+
+
+_register()

@@ -33,7 +33,11 @@ def ring_attention_local(q, k, v, axis="sp", causal=False, sm_scale=None):
     Returns [b, h, s_local, d] attention output for the local queries
     against the GLOBAL key/value sequence.
     """
-    n = jax.lax.axis_size(axis)
+    # static axis size (the ring permutation list needs a concrete n);
+    # jax.lax.axis_size is not present on this jax — read the axis env
+    from jax._src.core import get_axis_env
+
+    n = int(get_axis_env().axis_sizes[axis])
     rank = jax.lax.axis_index(axis)
     sl = q.shape[2]
     scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(q.shape[-1])

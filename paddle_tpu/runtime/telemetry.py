@@ -1618,6 +1618,18 @@ METRIC_NAMES = (
     # the loader's next() and reconciled against the data_wait spans
     "paddle_tpu_data_wait_seconds",
     "paddle_tpu_data_wait_seconds_last",
+    # serving engine (paddle_tpu/inference/engine.py + kv_cache.py):
+    # per-request latency histograms (the "millions of users" p50/p99
+    # metric), throughput counters, and paged-KV occupancy gauges —
+    # request/ttft histograms are fed from the SAME measurement as
+    # their serve/ spans (tracing.reconcile_with_metrics checks)
+    "paddle_tpu_serve_request_seconds",
+    "paddle_tpu_serve_ttft_seconds",
+    "paddle_tpu_serve_requests_total",
+    "paddle_tpu_serve_tokens_total",
+    "paddle_tpu_serve_steps_total",
+    "paddle_tpu_serve_tokens_per_sec",
+    "paddle_tpu_serve_kv_blocks",
 )
 
 # every event `kind` the stack emits into the structured stream

@@ -611,6 +611,8 @@ def reconcile_with_metrics(tolerance=0.02, abs_slack=2e-3):
     * ``data/data_wait`` spans      vs ``paddle_tpu_data_wait_seconds`` histogram
     * ``checkpoint/save`` spans     vs ``paddle_tpu_checkpoint_save_seconds``
     * ``checkpoint/restore`` spans  vs ``paddle_tpu_checkpoint_restore_seconds``
+    * ``serve/request`` spans       vs ``paddle_tpu_serve_request_seconds``
+    * ``serve/ttft`` spans          vs ``paddle_tpu_serve_ttft_seconds``
 
     Returns (ok, report) where report maps check name ->
     {span_s, metric_s, span_n, metric_n, ok, skipped}."""
@@ -666,6 +668,10 @@ def reconcile_with_metrics(tolerance=0.02, abs_slack=2e-3):
           hist("paddle_tpu_checkpoint_save_seconds"))
     check("checkpoint_restore", spans("checkpoint", name="restore"),
           hist("paddle_tpu_checkpoint_restore_seconds"))
+    check("serve_request", spans("serve", name="request"),
+          hist("paddle_tpu_serve_request_seconds"))
+    check("serve_ttft", spans("serve", name="ttft"),
+          hist("paddle_tpu_serve_ttft_seconds"))
     ok = all(v["ok"] for v in report.values())
     return ok, report
 

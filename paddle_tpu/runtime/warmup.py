@@ -57,6 +57,7 @@ import os
 import threading
 import time
 import types
+import warnings
 
 import numpy as np
 
@@ -93,6 +94,10 @@ _metrics = {
     "precompiled_ops": 0,       # manifest op entries installed into FORWARD
     "precompiled_programs": 0,  # whole-step signatures AOT-compiled
     "precompiled_traces": 0,    # fused-trace entries installed (fusion)
+    "manifest_unreplayable": 0,  # replayable:false entries skipped by
+    #                              precompile (unencodable statics /
+    #                              unresolvable impls — coverage gaps a
+    #                              warm start cannot absorb)
 }
 _first_step = {}  # engine kind -> seconds from _T0 to first compiled step
 
@@ -880,9 +885,11 @@ def precompile(manifest_doc):
         return stats
     from ..core import dispatch as _dispatch
 
+    unreplayable = []
     for entry in manifest_doc.get("entries", ()):
         if not entry.get("replayable"):
             stats["ops_skipped"] += 1
+            unreplayable.append(str(entry.get("name") or "<unnamed>"))
             continue
         if entry.get("kind") == "trace":
             # fused eager trace (core/fusion.py): fully AOT-replayable
@@ -947,8 +954,40 @@ def precompile(manifest_doc):
             record_fault("stale_manifests",
                          f"op entry {entry.get('name')}: replay failed")
             stats["ops_skipped"] += 1
+    if unreplayable:
+        stats["ops_unreplayable"] = len(unreplayable)
+        with _lock:
+            _metrics["manifest_unreplayable"] += len(unreplayable)
+        _warn_unreplayable(unreplayable)
     _telemetry.emit("precompile", **stats)
     return stats
+
+
+_warned_unreplayable = False
+
+
+def _warn_unreplayable(names):
+    """Log ONCE per process which manifest entries a warm start cannot
+    replay (``replayable: false`` — statics/impls with no faithful JSON
+    encoding). Their compiles stay cold on every restart; the count is
+    surfaced in ``dispatch_stats()["compile"]["manifest_unreplayable"]``
+    so the coverage gap is visible without log archaeology."""
+    global _warned_unreplayable
+    if _warned_unreplayable:
+        return
+    _warned_unreplayable = True
+    counts = {}
+    for n in names:
+        counts[n] = counts.get(n, 0) + 1
+    shown = sorted(counts)[:8]
+    more = "" if len(counts) <= 8 else f" (+{len(counts) - 8} more ops)"
+    warnings.warn(
+        "paddle_tpu warm start: skipped "
+        f"{len(names)} non-replayable manifest entr"
+        f"{'y' if len(names) == 1 else 'ies'} during precompile — these "
+        "ops will compile fresh on every restart. Ops: "
+        + ", ".join(f"{n} x{counts[n]}" for n in shown) + more,
+        stacklevel=3)
 
 
 def prewarm_program(name, jit_fn):

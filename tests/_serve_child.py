@@ -1,0 +1,112 @@
+"""Child process for the serving-engine acceptance round trip
+(tests/test_serving.py / tools/serve_smoke.py).
+
+Modes (argv[1]):
+  record — cold server: N concurrent requests through
+           admit -> prefill -> decode -> finish under continuous
+           batching, then the SAME prompts sequentially (one-request
+           engines) for the token-exactness check; saves the shape
+           manifest; prints one JSON line of tokens + compile metrics +
+           the histogram<->span reconciliation.
+  replay — warm server: precompiles the manifest, runs the same
+           concurrent workload, prints metrics. The parent asserts
+           ZERO fresh XLA compiles (a server restart that recompiles
+           is an outage).
+
+Env (set by the parent): JAX_PLATFORMS=cpu,
+PADDLE_TPU_COMPILE_CACHE_DIR, PADDLE_TPU_COMPILE_CACHE_MIN_COMPILE_S=0,
+SERVE_MANIFEST, SERVE_TRACE_DIR (optional: enables span tracing +
+reconciliation fields), PADDLE_TPU_EAGER_FUSION (optional).
+"""
+import json
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_enable_x64", True)
+
+from paddle_tpu.core import dispatch  # noqa: E402
+from paddle_tpu.inference import (  # noqa: E402
+    ServeConfig,
+    ServingEngine,
+    TinyServeModel,
+)
+from paddle_tpu.runtime import telemetry, tracing, warmup  # noqa: E402
+
+mode = sys.argv[1]
+manifest_path = os.environ["SERVE_MANIFEST"]
+trace_dir = os.environ.get("SERVE_TRACE_DIR")
+if trace_dir:
+    tracing.configure(trace_dir)
+
+PROMPTS = [[1, 2, 3, 4, 5], [7, 8], [3, 1, 4, 1, 5, 9], [11, 13]]
+NEW_TOKENS = 4
+
+
+def _mk_engine():
+    model = TinyServeModel(vocab=32, dim=8, layers=2, heads=2, ffn=16,
+                           seed=0)
+    cfg = ServeConfig(max_running=3, token_budget=8, block_size=4,
+                      num_blocks=16, max_blocks_per_seq=4)
+    return ServingEngine(model, cfg)
+
+
+pre = None
+if mode == "replay":
+    pre = warmup.precompile(manifest_path)
+
+dispatch.set_warmup_count(1)
+engine = _mk_engine()
+batched = engine.generate(PROMPTS, max_new_tokens=NEW_TOKENS)
+
+sequential = None
+if mode == "record":
+    sequential = []
+    for p in PROMPTS:
+        e = _mk_engine()
+        sequential.append(e.generate([p], max_new_tokens=NEW_TOKENS)[0])
+    warmup.save_manifest(manifest_path)
+
+ds = dispatch.dispatch_stats()
+comp = ds["compile"]
+out = {
+    "batched": batched,
+    "sequential": sequential,
+    "steps": engine.steps,
+    "fresh_compiles": comp["fresh_compiles"],
+    "disk_cache_hits": comp["disk_cache_hits"],
+    "fused_misses": ds["fusion"]["fused"]["misses"],
+    "recorded_ops": ds["fusion"]["recorded_ops"],
+}
+if pre is not None:
+    out["precompile"] = pre
+if trace_dir:
+    st = tracing.span_stats()
+    snap = telemetry.snapshot()
+
+    def _hist(name):
+        fam = snap.get(name) or {}
+        series = fam.get("series") or [{}]
+        return (float(series[0].get("sum", 0.0)),
+                int(series[0].get("count", 0)))
+
+    def _spans(name):
+        v = st.get(("serve", name)) or {"total_s": 0.0, "count": 0}
+        return float(v["total_s"]), int(v["count"])
+
+    ok, report = tracing.reconcile_with_metrics()
+    out["reconcile_ok"] = ok
+    out["reconcile"] = {
+        "request_span": _spans("request"),
+        "request_hist": _hist("paddle_tpu_serve_request_seconds"),
+        "ttft_span": _spans("ttft"),
+        "ttft_hist": _hist("paddle_tpu_serve_ttft_seconds"),
+        "serve_checks": {k: v for k, v in report.items()
+                         if k.startswith("serve")},
+    }
+    tracing.close()
+print(json.dumps(out))

@@ -96,7 +96,7 @@ def test_fleet_mp_layers_under_fleet_mesh():
     assert col.weight.grad is not None and row.weight.grad is not None
 
 
-def test_fleet_pipeline_gpt_training_loop():
+def test_fleet_pipeline_gpt_training_loop(require_partial_auto_spmd):
     """pp_degree>1 through the PUBLIC API: fleet.init -> GPTForCausalLM
     builds a PipelineLayer trunk -> train loop (round-2 verdict weak #4)."""
     strategy = fleet.DistributedStrategy()
@@ -130,7 +130,7 @@ def test_fleet_pipeline_gpt_training_loop():
     assert losses[-1] < losses[0], losses
 
 
-def test_fleet_pipeline_forward_parity():
+def test_fleet_pipeline_forward_parity(require_partial_auto_spmd):
     """The jitted pipeline trunk computes the same loss as the sequential
     model with identical weights."""
     strategy = fleet.DistributedStrategy()
@@ -182,7 +182,7 @@ def test_fleet_utils_recompute():
                                rtol=1e-5)
 
 
-def test_fleet_deep_pipeline_pp4():
+def test_fleet_deep_pipeline_pp4(require_partial_auto_spmd):
     """pp=4 x dp=2 through the public API (deeper pipeline than the 2-stage
     case; exercises multi-hop ppermute rotation)."""
     strategy = fleet.DistributedStrategy()
@@ -251,7 +251,7 @@ def test_fleet_sequence_parallel_gpt():
     assert float(loss) < loss_sp
 
 
-def test_fleet_sp_edge_cases():
+def test_fleet_sp_edge_cases(require_partial_auto_spmd):
     """sp ring falls back cleanly: indivisible seq lens and pp>1 meshes
     run the dense path instead of crashing (round-3 review regression)."""
     strategy = fleet.DistributedStrategy()
@@ -282,7 +282,7 @@ def test_fleet_sp_edge_cases():
     assert np.isfinite(float(loss2))
 
 
-def test_fleet_all_knobs_combined_training_loop():
+def test_fleet_all_knobs_combined_training_loop(require_partial_auto_spmd):
     """Every DistributedStrategy knob ON at once — hybrid dp2 x tp2 x
     pp2 mesh with amp O1, recompute over the trunk, gradient_merge
     k=2, and sharding stage 2 — driving the public fleet train loop.

@@ -96,7 +96,7 @@ def test_precompile_skips_nonjittable_and_counts_skipped():
     stats = warmup.precompile(m)
     assert stats == {"ops_precompiled": 0, "ops_skipped": 1,
                      "programs_pending": 0, "traces_precompiled": 0,
-                     "stale": False}
+                     "stale": False, "ops_unreplayable": 1}
 
 
 def test_stale_manifest_falls_back_cold_with_fault_event(tmp_path):

@@ -51,6 +51,15 @@ echo "== fusion smoke (trace-fusion warm-start round trip) =="
 # with ZERO fresh XLA compiles and disk hits > 0
 JAX_PLATFORMS=cpu python tools/fusion_smoke.py
 
+echo "== serve smoke (continuous batching + warm restart + reconciliation) =="
+# two subprocesses prove the ISSUE-13 serving acceptance: pass A runs
+# 4 concurrent requests under continuous batching over the paged KV
+# cache and must be TOKEN-EXACT vs sequential one-request-at-a-time
+# generation with serve/ span sums equal to the request/ttft latency
+# histograms; pass B warm-starts from pass A's manifest and must
+# perform ZERO fresh XLA compiles
+JAX_PLATFORMS=cpu python tools/serve_smoke.py
+
 echo "== multihost smoke (coordination store + quorum + merge) =="
 # 2-process CPU cluster over a tmpdir store: heartbeat + rendezvous
 # round trip, host-0 merged prom/fault-log carrying both rank labels,

@@ -1,0 +1,106 @@
+#!/usr/bin/env python
+"""Serving-engine acceptance smoke (tools/ci_check.sh): two fresh
+processes prove the ISSUE-13 end-to-end criteria on CPU in seconds.
+
+Pass A (record, cold server): 4 concurrent requests flow through
+admit -> prefill -> decode -> finish under continuous batching over the
+paged KV cache, then the SAME prompts run sequentially through
+one-request engines. Asserts:
+
+* token-exact outputs: batched continuous-batching generation ==
+  sequential one-request-at-a-time generation, request by request;
+* exact histogram<->span reconciliation: the serve/request and
+  serve/ttft span sums equal the
+  ``paddle_tpu_serve_request_seconds`` / ``_ttft_seconds`` histogram
+  sums (same-measurement emission), via
+  ``tracing.reconcile_with_metrics``.
+
+Pass B (replay, warm server): a second process precompiles the shape
+manifest pass A saved and serves the same workload. Asserts:
+
+* ``fresh_compiles == 0`` — a server restart performs ZERO fresh XLA
+  compiles (every executable comes from the persistent disk cache);
+* ``disk_cache_hits > 0`` — the cache actually served them;
+* tokens identical to pass A.
+
+The child workload lives in tests/_serve_child.py (shared with
+tests/test_serving.py).
+
+Usage: python tools/serve_smoke.py
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CHILD = os.path.join(REPO, "tests", "_serve_child.py")
+
+
+def _run_pass(mode, env):
+    proc = subprocess.run([sys.executable, CHILD, mode], env=env, cwd=REPO,
+                          capture_output=True, timeout=300)
+    if proc.returncode != 0:
+        print(proc.stderr.decode()[-2000:], file=sys.stderr)
+        raise SystemExit(f"serve_smoke: {mode} child failed "
+                         f"(rc={proc.returncode})")
+    return json.loads(proc.stdout.decode().strip().splitlines()[-1])
+
+
+def main():
+    with tempfile.TemporaryDirectory(prefix="serve_smoke_") as td:
+        env = dict(os.environ)
+        env.update(
+            JAX_PLATFORMS="cpu",
+            PADDLE_TPU_COMPILE_CACHE_DIR=os.path.join(td, "cache"),
+            PADDLE_TPU_COMPILE_CACHE_MIN_COMPILE_S="0",
+            SERVE_MANIFEST=os.path.join(td, "manifest.json"),
+            SERVE_TRACE_DIR=os.path.join(td, "trace"),
+        )
+        env.pop("PADDLE_TPU_SHAPE_MANIFEST", None)
+        cold = _run_pass("record", env)
+        warm = _run_pass("replay", env)
+
+    problems = []
+    if cold["batched"] != cold["sequential"]:
+        problems.append(
+            "continuous batching is not token-exact vs sequential: "
+            f"{cold['batched']} vs {cold['sequential']}")
+    if not cold.get("reconcile_ok"):
+        problems.append(
+            f"span<->metric reconciliation failed: {cold.get('reconcile')}")
+    rec = cold.get("reconcile") or {}
+    for which in ("request", "ttft"):
+        sp, hi = rec.get(f"{which}_span"), rec.get(f"{which}_hist")
+        if not sp or not hi or sp[1] == 0:
+            problems.append(f"no serve/{which} spans were recorded")
+        elif sp[1] != hi[1] or abs(sp[0] - hi[0]) > 1e-6:
+            problems.append(
+                f"serve/{which} spans != histogram: {sp} vs {hi}")
+    if warm.get("precompile", {}).get("ops_precompiled", 0) < 1:
+        problems.append(f"pass B precompiled no ops: "
+                        f"{warm.get('precompile')}")
+    if warm["fresh_compiles"] != 0:
+        problems.append(f"pass B fresh XLA compiles: "
+                        f"{warm['fresh_compiles']} (want 0)")
+    if warm["disk_cache_hits"] <= 0:
+        problems.append("pass B loaded nothing from the disk cache")
+    if warm["batched"] != cold["batched"]:
+        problems.append(f"warm tokens diverged: {warm['batched']} vs "
+                        f"{cold['batched']}")
+    if problems:
+        for p in problems:
+            print(f"serve_smoke: FAIL: {p}", file=sys.stderr)
+        raise SystemExit(1)
+    print(f"serve_smoke: OK (pass A: {len(cold['batched'])} concurrent "
+          f"requests token-exact vs sequential in {cold['steps']} steps, "
+          "spans==histograms; pass B: 0 fresh compiles, "
+          f"{warm['disk_cache_hits']} disk loads, "
+          f"{warm['precompile']['ops_precompiled']} ops precompiled)")
+
+
+if __name__ == "__main__":
+    main()

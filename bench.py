@@ -1371,6 +1371,58 @@ def _collect(out_dir, details, keymap=None):
                 keymap[k] = cfg
 
 
+def _collect_child_diagnostics(diag_dir, name, details, tail_n=15):
+    """Evidence from a dead/killed config child: the newest postmortem
+    bundle path (written by the child's SIGTERM handler or stall dump)
+    and the final records of its flight-recorder spill (append-only, so
+    even a SIGKILL leaves them). Plain file reads — the orchestrator
+    never imports paddle_tpu. A dead child used to leave only a
+    truncated `runner_error` stderr string."""
+    def _mtime(p):
+        try:
+            return os.path.getmtime(p)
+        except OSError:
+            return 0.0
+
+    try:
+        # newest by mtime, not filename: a config that spawned helper
+        # subprocesses leaves bundles from several pids in this dir,
+        # and the lexicographic order would rank by pid, not recency
+        names = sorted((n for n in os.listdir(diag_dir)
+                        if n.startswith("postmortem-")
+                        and n.endswith(".json")),
+                       key=lambda n: _mtime(os.path.join(diag_dir, n)))
+    except OSError:
+        return
+    if names:
+        details[name + "_bundle_path"] = os.path.join(diag_dir, names[-1])
+    tail = []
+    # oldest-written spill first, newest last: with several pids in one
+    # dir (a config that spawned helpers), tail[-n] must come from the
+    # most recently active process, not whichever filename sorts last
+    spill_names = sorted(
+        (n for n in os.listdir(diag_dir)
+         if n.startswith("flight-") and n.endswith(".jsonl")),
+        key=lambda n: _mtime(os.path.join(diag_dir, n)))
+    for fname in spill_names:
+        base = os.path.join(diag_dir, fname)
+        # rotated generation first (a child killed right after a spill
+        # rotation holds its recent history in the .1 file)
+        for p in (base + ".1", base):
+            try:
+                with open(p) as f:
+                    lines = f.readlines()
+            except OSError:
+                continue
+            for line in lines:
+                try:
+                    tail.append(json.loads(line))
+                except ValueError:
+                    continue  # torn tail line (the kill -9 contract)
+    if tail:
+        details[name + "_flight_tail"] = tail[-tail_n:]
+
+
 def _error_payload(msg):
     return {"metric": "BERT-base MLM tokens/sec/chip (AMP O2 bf16)",
             "value": None, "unit": "tokens/sec", "vs_baseline": None,
@@ -1574,6 +1626,14 @@ def main():
                     os.remove(os.path.join(rdir, fname))
                 except OSError:
                     pass
+        # per-config diagnostics (postmortem bundles + flight spills)
+        # from a previous round: a stale bundle must not be attributed
+        # to THIS round's kill
+        ddir = os.path.join(out_dir, "diagnostics")
+        if os.path.isdir(ddir):
+            import shutil
+
+            shutil.rmtree(ddir, ignore_errors=True)
 
     # a previous round's final payload must not masquerade as this
     # round's if we are killed before the first snapshot lands
@@ -1791,13 +1851,25 @@ def main():
         if small:
             args.append("--small")
         err_path = os.path.join(out_dir, f"runner_{name}.stderr")
+        # every child gets its own diagnostics dir: a deadline SIGTERM
+        # makes it dump a postmortem bundle (all-thread stacks, dispatch
+        # + fusion stats, flight-recorder tail) and even a SIGKILLed
+        # child leaves its append-only flight spill — evidence instead
+        # of a bare rc=124
+        diag_dir = os.path.join(out_dir, "diagnostics", name)
+        child_env = dict(os.environ,
+                         PADDLE_TPU_DIAGNOSTICS_DIR=diag_dir)
         with open(err_path, "wb") as err_f:
             proc = subprocess.Popen(
                 [sys.executable, os.path.abspath(__file__)] + args,
-                cwd=REPO, stdout=subprocess.DEVNULL, stderr=err_f)
+                cwd=REPO, stdout=subprocess.DEVNULL, stderr=err_f,
+                env=child_env)
             state.update(proc=proc, name=name, probe=False)
             outcome = _wait_child(proc, name, full_cost_s)
         state.update(proc=None, name=None)
+        if outcome == "killed" or (outcome == "done"
+                                   and proc.returncode != 0):
+            _collect_child_diagnostics(diag_dir, name, details)
         if outcome == "done" and proc.returncode != 0:
             # a hard CRASH (our in-child error capture exits 0):
             # record rc + stderr tail; no retry — a deterministic

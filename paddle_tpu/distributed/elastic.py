@@ -37,6 +37,7 @@ import time
 import warnings
 
 from ..core.dispatch import non_jittable
+from ..runtime import diagnostics as _diagnostics
 from ..runtime import telemetry as _telemetry
 from ..runtime import tracing as _tracing
 from ..runtime.resilience import (
@@ -263,6 +264,16 @@ class ElasticManager:
                             step=hb.get("step"), timeout=self.timeout)
             _tracing.instant("watchdog_stall", "coord", reason=reason,
                              step=hb.get("step"))
+            # a stall is exactly the moment the process state is worth
+            # freezing: all-thread stacks (WHERE the loop is wedged),
+            # dispatch/fusion stats, and the flight-recorder tail go
+            # into a postmortem bundle (no-op unless a diagnostics dir
+            # is configured; never raises)
+            _diagnostics.maybe_dump(
+                f"watchdog_stall_{reason}",
+                extra={"reason": reason, "step": hb.get("step"),
+                       "timeout": self.timeout,
+                       "ckpt_dir": self.ckpt_dir})
             if on_stall is not None:
                 try:
                     on_stall({**hb, "reason": reason})

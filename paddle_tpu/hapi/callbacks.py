@@ -7,6 +7,7 @@ import time
 
 import numpy as np
 
+from ..runtime import diagnostics as _diagnostics
 from ..runtime import telemetry as _telemetry
 from ..runtime import tracing as _tracing
 
@@ -770,6 +771,13 @@ class ResilienceCallback(Callback):
         if engine is not None:
             engine.want_grad_norm = True
 
+        # arm the crash-and-hang layer for this run: bundles (and the
+        # flight-recorder's on-disk spill) default under the checkpoint
+        # dir unless PADDLE_TPU_DIAGNOSTICS_DIR already points
+        # elsewhere; fatal-signal/excepthook handlers + the opt-in
+        # statusz server ride along. Never raises into fit().
+        _diagnostics.ensure_installed(
+            default_dir=os.path.join(self.ckpt_dir, "diagnostics"))
         self._mngr = CheckpointManager(
             self.ckpt_dir, max_to_keep=self.max_to_keep,
             async_save=self.async_save,
@@ -872,6 +880,11 @@ class ResilienceCallback(Callback):
                     "back", stacklevel=2)
 
         def _escalate(step, n):
+            # N consecutive bad steps is a terminal diagnosis moment:
+            # freeze the evidence before the default stop
+            _diagnostics.maybe_dump(
+                "rollback_escalation",
+                extra={"step": step, "consecutive_rollbacks": n})
             if self.on_escalate is not None:
                 self.on_escalate(step, n)
             else:

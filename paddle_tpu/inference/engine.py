@@ -36,6 +36,7 @@ import time
 
 import numpy as np
 
+from ..runtime import diagnostics as _diagnostics
 from ..runtime import telemetry as _telemetry
 from ..runtime import tracing as _tracing
 from ..runtime.resilience import fault_point
@@ -103,6 +104,13 @@ class ServingEngine:
         self._g_tps = _telemetry.gauge(
             "paddle_tpu_serve_tokens_per_sec",
             "generated tokens per busy second (cumulative)")
+        # crash-and-hang observability: the /serving statusz route and
+        # postmortem bundles report this engine's scheduler + KV-pool
+        # state (weak registration — the engine's lifetime is its own),
+        # and a server process with PADDLE_TPU_DIAGNOSTICS_DIR set arms
+        # bundles-on-fatal-signal for its decode loop
+        _diagnostics.register_serving_engine(self)
+        _diagnostics.ensure_installed()
 
     # -- request API --------------------------------------------------------
 
@@ -244,6 +252,35 @@ class ServingEngine:
                  tokens_per_sec=(self._tokens_out / self._busy_s
                                  if self._busy_s else 0.0))
         return s
+
+    def diagnostics_snapshot(self):
+        """Engine + scheduler + KV-pool state for the diagnostics layer
+        (the /serving statusz route and postmortem bundles): live
+        request ids with their progress, pool occupancy, and the
+        engine-level throughput counters — enough to see WHAT a wedged
+        or dying server was doing, without touching device state."""
+        # called from the statusz/watchdog threads while the engine
+        # thread mutates scheduler state: copy the dict FIRST (a C-level
+        # atomic) so iteration can never race an admit/evict resize
+        running = dict(self.scheduler.running)
+        return {
+            "config": {"max_running": self.config.max_running,
+                       "token_budget": self.config.token_budget,
+                       "block_size": self.config.block_size,
+                       "num_blocks": self.config.num_blocks},
+            "stats": self.stats(),
+            "kv": {"blocks_free": self.cache.blocks_free(),
+                   "blocks_in_use": self.cache.blocks_in_use(),
+                   "utilization": self.cache.utilization()},
+            "running": [
+                {"request_id": req.request_id, "slot": slot,
+                 "prompt_len": len(req.prompt),
+                 "generated": len(req.generated),
+                 "max_new_tokens": req.max_new_tokens}
+                for slot, req in sorted(running.items())],
+            "queued": len(self.scheduler.queue),
+            "undrained_results": len(self._results),
+        }
 
 
 def _greedy_sample(lg):

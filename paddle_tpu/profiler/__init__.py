@@ -23,7 +23,8 @@ from ..runtime.resilience import fault_events, fault_log, reset_fault_events
 
 __all__ = ["Profiler", "ProfilerTarget", "ProfilerState", "RecordEvent",
            "make_scheduler", "export_chrome_tracing", "load_profiler_result",
-           "SummaryView", "dispatch_stats", "reset_dispatch_stats",
+           "SummaryView", "summary_dict",
+           "dispatch_stats", "reset_dispatch_stats",
            "fault_events", "fault_log", "reset_fault_events"]
 
 
@@ -102,6 +103,100 @@ class RecordEvent:
     def __exit__(self, *exc):
         self.end()
         return False
+
+
+def summary_dict(op_detail=True, top=5):
+    """Machine-readable twin of `Profiler.summary()`: the same runtime
+    sections (dispatch cache, trace fusion incl. flush reasons+sites,
+    warm-start compile, unjittable ops, fault events, telemetry,
+    span timeline) as ONE json-serializable dict. This is what the
+    diagnostics `/statusz` route serves and what
+    ``python -m paddle_tpu.profiler --json`` prints — external tooling
+    reads this instead of scraping the printed text."""
+    from ..runtime import telemetry as _t
+    from ..runtime import tracing as _tr
+
+    ds = dispatch_stats()
+    fwd, bwd = ds["forward"], ds["backward"]
+    out = {
+        "summary_version": 1,
+        "dispatch": {
+            "forward": dict(fwd),
+            "backward": dict(bwd),
+        },
+        "fusion": None,
+        "compile": None,
+        "unjittable": ds.get("unjittable"),
+        "fault_events": {k: v for k, v in
+                         ds.get("fault_events", {}).items() if v},
+        "telemetry": None,
+        "spans": None,
+    }
+    per_op = ds.get("per_op") or {}
+    if op_detail and per_op:
+        out["dispatch"]["retrace_heavy_ops"] = {
+            k: v["retraces"] for k, v in per_op.items()
+            if v["retraces"] > 2}
+        occ = sorted(per_op.items(),
+                     key=lambda kv: -(kv[1]["cache_entries"]
+                                      + kv[1]["bwd_cache_entries"]))[:top]
+        out["dispatch"]["cache_occupancy"] = [
+            {"op": k, "fwd_programs": v["cache_entries"],
+             "bwd_programs": v["bwd_cache_entries"]}
+            for k, v in occ
+            if v["cache_entries"] + v["bwd_cache_entries"]]
+        run = sorted(
+            ((k, v) for k, v in per_op.items() if v.get("run_samples")),
+            key=lambda kv: -(kv[1]["run_s"] / kv[1]["run_samples"]))[:top]
+        out["dispatch"]["run_time_heavy_ops"] = [
+            {"op": k, "avg_run_ms": v["run_s"] / v["run_samples"] * 1e3,
+             "samples": v["run_samples"]} for k, v in run]
+    fus = ds.get("fusion") or {}
+    if fus and (fus.get("recorded_ops") or fus.get("enabled")):
+        out["fusion"] = dict(fus)
+    comp = ds.get("compile") or {}
+    if comp:
+        comp = dict(comp)
+        if op_detail and comp.get("per_op_compile_s"):
+            comp["per_op_compile_s"] = dict(sorted(
+                comp["per_op_compile_s"].items(),
+                key=lambda kv: -kv[1])[:max(top, 10)])
+        out["compile"] = comp
+    if _t.enabled():
+        snap = _t.snapshot()
+        stream = _t.event_stream()
+        tel = {}
+        steps = snap.get("paddle_tpu_train_steps_total")
+        if steps and steps["series"]:
+            tel["train_steps"] = int(steps["series"][0]["value"])
+        hist = snap.get("paddle_tpu_step_seconds")
+        if hist and hist["series"] and hist["series"][0]["count"]:
+            s = hist["series"][0]
+            tel["step_avg_ms"] = s["sum"] / s["count"] * 1e3
+            tel["step_count"] = int(s["count"])
+        dw = snap.get("paddle_tpu_data_wait_seconds")
+        if dw and dw["series"] and dw["series"][0]["count"]:
+            s = dw["series"][0]
+            tel["data_wait_s"] = s["sum"]
+            tel["data_wait_batches"] = int(s["count"])
+        if stream is not None:
+            tel["events_emitted"] = stream.emitted
+            tel["events_path"] = stream.path
+        tel["metric_families"] = len(snap)
+        out["telemetry"] = tel
+    else:
+        out["telemetry"] = {"enabled": False}
+    st = _tr.span_stats()
+    if st:
+        rows = sorted(st.items(), key=lambda kv: -kv[1]["self_s"])[:top]
+        out["spans"] = {
+            "phase_totals_s": _tr.phase_totals(),
+            "top_self": [{"span": f"{cat}/{name}",
+                          "self_s": v["self_s"], "count": v["count"]}
+                         for (cat, name), v in rows],
+            "trace_path": _tr.trace_path(),
+        }
+    return out
 
 
 class Profiler:
@@ -195,6 +290,19 @@ class Profiler:
         avg = sum(self._step_times) / len(self._step_times)
         return (f"step {self._step}: avg {avg * 1e3:.2f} ms "
                 f"({1.0 / avg:.2f} steps/s)")
+
+    def summary_dict(self, op_detail=True, top=5):
+        """The module-level `summary_dict()` plus this profiler's own
+        step timing — the machine-readable twin of `summary()`."""
+        out = summary_dict(op_detail=op_detail, top=top)
+        step = {"steps": self._step}
+        if self._step_times:
+            avg = sum(self._step_times) / len(self._step_times)
+            step.update(avg_ms=avg * 1e3, steps_per_sec=1.0 / avg)
+        out["step"] = step
+        if self._dir:
+            out["trace_artifacts"] = self._dir
+        return out
 
     def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
                 time_unit="ms", views=None):

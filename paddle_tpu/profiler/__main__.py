@@ -1,0 +1,57 @@
+"""``python -m paddle_tpu.profiler`` — the runtime summary as data.
+
+Two modes:
+
+* ``--json`` (default): print this process's `profiler.summary_dict()`
+  as JSON — the machine-readable twin of `Profiler.summary()`. Useful
+  at the end of a driver script (``import`` + run + ``-m`` in one
+  interpreter via ``python -c``), or as the canonical schema sample
+  for tooling.
+* ``--statusz HOST:PORT [--route /statusz]``: fetch a route from a
+  LIVE process's diagnostics introspection server
+  (``PADDLE_TPU_STATUSZ=<port>``) and print it — external tooling's
+  path to a running trainer/server without scraping printed text.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(prog="python -m paddle_tpu.profiler")
+    ap.add_argument("--json", action="store_true", default=True,
+                    help="print summary_dict() as JSON (default)")
+    ap.add_argument("--indent", type=int, default=1)
+    ap.add_argument("--statusz", metavar="HOST:PORT",
+                    help="fetch from a live /statusz server instead of "
+                         "summarizing this (fresh) process")
+    ap.add_argument("--route", default="/statusz",
+                    help="route to fetch with --statusz "
+                         "(/statusz /metrics /stacks /flightrecorder "
+                         "/serving)")
+    args = ap.parse_args(argv)
+
+    if args.statusz:
+        import urllib.request
+
+        url = f"http://{args.statusz}{args.route}"
+        with urllib.request.urlopen(url, timeout=5) as r:
+            body = r.read().decode("utf-8", "replace")
+        try:
+            # re-serialize so --indent applies uniformly
+            print(json.dumps(json.loads(body), indent=args.indent,
+                             default=str))
+        except ValueError:  # text routes (/metrics, /healthz): as-is
+            sys.stdout.write(body)
+        return 0
+
+    from . import summary_dict
+
+    print(json.dumps(summary_dict(), indent=args.indent, default=str))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

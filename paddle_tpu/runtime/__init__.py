@@ -2,14 +2,21 @@
 path: staging buffers (`staging`), HBM stats (`memory`), the
 fault-tolerance substrate (`resilience`), the warm-start subsystem
 (`warmup`: persistent compile cache + shape-manifest AOT precompile),
-and the unified telemetry layer (`telemetry`: metrics registry +
-structured event stream + exporters).
+the unified telemetry layer (`telemetry`: metrics registry +
+structured event stream + exporters), span tracing (`tracing`), and
+the crash-and-hang layer (`diagnostics`: flight recorder, postmortem
+bundles, /statusz).
 
-Only `telemetry` and `resilience` are imported eagerly (stdlib[+numpy],
-cheap, and `core.dispatch` depends on both); `warmup` loads with the
-dispatch layer, `memory`/`staging` stay import-on-use.
+`telemetry`, `resilience`, `tracing` and `diagnostics` are imported
+eagerly (stdlib[+numpy], cheap; `core.dispatch` depends on the first
+three, and diagnostics must arm its flight-recorder taps before any
+producer runs); `warmup` loads with the dispatch layer,
+`memory`/`staging` stay import-on-use.
 """
 from . import telemetry  # noqa: F401
 from . import resilience  # noqa: F401
+from . import tracing  # noqa: F401
+from . import diagnostics  # noqa: F401
 
-__all__ = ["telemetry", "resilience", "warmup", "memory", "staging"]
+__all__ = ["telemetry", "resilience", "tracing", "diagnostics",
+           "warmup", "memory", "staging"]

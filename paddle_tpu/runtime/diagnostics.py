@@ -1,0 +1,999 @@
+"""Crash-and-hang observability: flight recorder, postmortem bundles,
+and the live /statusz introspection server.
+
+The runtime can *count* (runtime/telemetry.py) and *time*
+(runtime/tracing.py) nearly everything it does — but all of it lives
+in the process, and when the process dies or wedges the evidence dies
+with it: five bench rounds in a row produced zero TPU data (rc=124 /
+backend-init crashes) and left nothing but a stderr tail, and a
+watchdog stall reports a heartbeat age with no stacks and no runtime
+state. Deferred/fused runtimes (LazyTensor) make this worse by design:
+a failure surfaces at a flush site far from its cause, so the runtime
+itself must carry its recent history to the grave. Three pieces:
+
+* **Flight recorder** — an always-on, bounded, lock-cheap in-memory
+  ring of the most recent spans/instants/events/faults, fed from the
+  SAME emission points tracing and telemetry already own (a tap
+  registered into ``tracing.set_flight_tap`` /
+  ``telemetry.set_flight_tap``), active even when ``PADDLE_TPU_TRACE``
+  is off. Kill switch = ``PADDLE_TPU_DIAGNOSTICS=0`` (or
+  `set_enabled(False)`): disabled, hot paths pay exactly one falsy
+  check — the same contract as tracing, locked by the parity test in
+  tests/test_diagnostics.py. When a diagnostics directory is
+  configured the ring additionally *spills* append-only to
+  ``flight-<host>-<pid>.jsonl`` (bounded rotation, buffered flush
+  every few records) so even a ``kill -9`` leaves a contiguous prefix
+  of the run's recent history on disk.
+
+* **Postmortem bundles** — `dump(reason)` writes ONE atomic,
+  bounded-size JSON bundle: all-thread stacks, ``dispatch_stats()``
+  (incl. fusion flush sites), the fault-event counters + recent fault
+  log, a bounded telemetry registry snapshot, span phase totals, the
+  flight-recorder tail, registered serving-engine state, and an
+  env/config/version fingerprint. `install()` arms it on fatal
+  signals (SIGTERM/SIGABRT, chaining to any previous handler),
+  unhandled-exception exit (sys.excepthook), and hard crashes
+  (``faulthandler`` into a sidecar file); the elastic watchdog dumps
+  on stall and bench campaign children dump when their per-config
+  deadline kills them — a deadline-killed config finally leaves
+  evidence instead of ``rc=124``.
+
+* **/statusz server** — an opt-in (``PADDLE_TPU_STATUSZ=<port>``),
+  loopback-only-by-default stdlib HTTP server for live introspection:
+  ``/statusz`` (the machine-readable `profiler.summary_dict()` runtime
+  summary), ``/metrics`` (the existing Prometheus renderer),
+  ``/stacks`` (all-thread stacks), ``/flightrecorder`` (the ring
+  tail), ``/serving`` (engine + scheduler + KV-pool state). Port 0
+  binds an ephemeral port; `statusz_address()` reports it and the
+  bound port is also written to ``statusz-<pid>.port`` in the
+  diagnostics dir so tooling can find a child's server.
+
+Import-weight contract: stdlib only (runtime/__init__ imports this
+eagerly so the recorder taps arm at import). jax / dispatch state is
+only read through ``sys.modules`` guards — a dying or jax-less process
+must still be able to write a bundle.
+"""
+from __future__ import annotations
+
+import atexit
+import collections
+import faulthandler
+import itertools
+import json
+import os
+import signal
+import socket
+import sys
+import threading
+import time
+import traceback
+import warnings
+import weakref
+
+from . import telemetry as _telemetry
+from . import tracing as _tracing
+
+__all__ = [
+    "enabled", "set_enabled", "configure", "diagnostics_dir",
+    "recorder", "flight_tail", "flight_stats", "flight_spill_path",
+    "read_flight_spill",
+    "dump", "maybe_dump", "last_bundle_path", "read_bundle",
+    "install", "installed", "ensure_installed",
+    "start_statusz", "stop_statusz", "statusz_address",
+    "register_serving_engine", "serving_snapshot",
+    "thread_stacks", "runtime_fingerprint",
+    "BUNDLE_PREFIX", "FLIGHT_PREFIX",
+]
+
+BUNDLE_PREFIX = "postmortem-"
+FLIGHT_PREFIX = "flight-"
+
+
+def _env_int(name, default):
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        return int(default)
+
+
+def _env_flag(name, default):
+    return os.environ.get(name, default).lower() not in ("0", "false", "no")
+
+
+# the one falsy check hot paths pay when diagnostics is killed (same
+# idiom as tracing._on / fusion._ON)
+_on = [_env_flag("PADDLE_TPU_DIAGNOSTICS", "1")]
+
+_lock = threading.Lock()              # config / install / server swaps
+_config = {"dir": None}
+_installed = {"signals": False, "excepthook": False, "faulthandler": False}
+_prev_handlers = {}
+_prev_excepthook = None
+_last_bundle = [None]
+_bundle_seq = itertools.count(1).__next__
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+
+class FlightRecorder:
+    """Bounded ring of recent diagnostic records.
+
+    Recording costs a dict build + one uncontended lock around the
+    seq-allocate/append pair (the "lock-cheap" contract — the lock is
+    what makes ``seq`` order and append order the SAME order, which is
+    the contiguity guarantee the bundles/spill assert; disabled, the
+    tap's one falsy check is the whole cost). Every record carries a
+    process-monotonic ``seq``: the tail is always a contiguous suffix
+    of everything recorded, and the on-disk spill (when a diagnostics
+    dir is configured) is a contiguous PREFIX-of-recent — a
+    ``kill -9`` loses at most the spill buffer still in memory
+    (``flush_every`` records)."""
+
+    def __init__(self, capacity=None):
+        self.capacity = max(16, capacity if capacity is not None else
+                            _env_int("PADDLE_TPU_FLIGHT_CAPACITY", 4096))
+        self._ring = collections.deque(maxlen=self.capacity)
+        self._seq = itertools.count(1).__next__
+        self._lock = threading.Lock()
+        self.recorded = 0
+        self._spill = None
+
+    # -- recording (the hot path) ------------------------------------------
+    def record(self, kind, **fields):
+        rec = {"ts": round(time.time(), 6), "kind": kind}
+        rec.update(fields)
+        # seq allocation and append must be ONE atomic step: two
+        # producers interleaving them would land out-of-seq records in
+        # the ring/spill and break the asserted contiguity
+        with self._lock:
+            rec["seq"] = self._seq()
+            self._ring.append(rec)
+            self.recorded += 1
+            sp = self._spill
+            if sp is not None:
+                sp.write(rec)  # threadlint: ok[CL003] buffered append (flushes 1-in-flush_every); ordering into the spill must match seq order, which requires writing under this lock
+
+    # -- reading -----------------------------------------------------------
+    def tail(self, n=None):
+        """The most recent `n` records (all retained when n is None),
+        oldest first. Snapshot-consistent enough for diagnostics: the
+        ring may rotate under us, so copy first."""
+        recs = list(self._ring)
+        if n is not None:
+            recs = recs[-int(n):]
+        return recs
+
+    def stats(self):
+        held = len(self._ring)
+        out = {"capacity": self.capacity, "recorded": self.recorded,
+               "held": held,
+               "overwritten": max(0, self.recorded - held)}
+        sp = self._spill
+        if sp is not None:
+            # a spill whose rotation reopen failed is BROKEN — the
+            # degradation must be visible wherever stats land
+            # (/statusz, every bundle), never silent
+            out["spill"] = {"path": sp.path, "ok": sp._f is not None}
+        return out
+
+    # -- spill (on-disk shadow, armed by configure()) ----------------------
+    def set_spill(self, path, flush_every=None, max_bytes=None):
+        new = None if path is None else _FlightSpill(
+            path, flush_every=flush_every, max_bytes=max_bytes)
+        with self._lock:  # record() reads _spill under this lock
+            old, self._spill = self._spill, new
+        if old is not None:
+            old.close()
+        return new
+
+    def spill(self):
+        return self._spill
+
+    def flush_spill(self):
+        sp = self._spill
+        if sp is not None:
+            sp.flush()
+
+
+class _FlightSpill:
+    """Append-only JSONL shadow of the ring: buffered (flushed every
+    `flush_every` records — the kill -9 durability bound), rotated at
+    `max_bytes` keeping one previous generation, and it NEVER raises
+    into the recording path (full disk degrades to dropping)."""
+
+    def __init__(self, path, flush_every=None, max_bytes=None):
+        self.path = path
+        self.flush_every = max(1, flush_every if flush_every is not None
+                               else _env_int("PADDLE_TPU_FLIGHT_FLUSH_EVERY",
+                                             16))
+        self.max_bytes = max_bytes if max_bytes is not None else _env_int(
+            "PADDLE_TPU_FLIGHT_MAX_BYTES", 4 * 1024 * 1024)
+        self._lock = threading.Lock()
+        self._pending = 0
+        try:
+            os.makedirs(os.path.dirname(os.path.abspath(path)),
+                        exist_ok=True)
+            self._f = open(path, "a")
+        except OSError:
+            self._f = None
+
+    def write(self, rec):
+        if self._f is None:
+            return
+        try:
+            line = json.dumps(rec, default=str) + "\n"
+        except (TypeError, ValueError):
+            return
+        with self._lock:
+            if self._f is None:  # closed while we waited for the lock
+                return
+            try:
+                self._f.write(line)  # threadlint: ok[CL003] bounded buffered append under the lock IS the durability contract (EventStream precedent)
+                self._pending += 1
+                if self._pending >= self.flush_every:
+                    self._f.flush()  # threadlint: ok[CL003] see above
+                    self._pending = 0
+                    if self.max_bytes and self._f.tell() >= self.max_bytes:
+                        self._rotate()
+            except (OSError, ValueError):
+                pass  # full disk / closed file: drop, never raise
+
+    def _rotate(self):
+        try:
+            self._f.close()
+        except (OSError, ValueError):
+            pass
+        try:
+            os.replace(self.path, self.path + ".1")
+        except OSError:
+            pass  # replace failed: reopen appends to the same file
+        try:
+            self._f = open(self.path, "a")  # threadlint: ok[CL003] rotation swaps the file atomically w.r.t. writers — the write caller holds the lock
+        except OSError:
+            # reopen failed (fd exhaustion, ENOSPC): mark the spill
+            # BROKEN instead of leaving a closed file that swallows
+            # every future write. No fault event from here — the
+            # recorder lock is held and record_fault would re-enter it
+            # through the telemetry tap; flight_stats() surfaces the
+            # breakage in /statusz and every bundle instead.
+            self._f = None
+
+    def flush(self):
+        if self._f is None:
+            return
+        with self._lock:
+            try:
+                self._f.flush()  # threadlint: ok[CL003] flush must serialize with writers — the durability contract (EventStream precedent)
+                self._pending = 0
+            except (OSError, ValueError):
+                pass
+
+    def close(self):
+        if self._f is None:
+            return
+        with self._lock:
+            try:
+                self._f.flush()  # threadlint: ok[CL003] close is the last write; must serialize with in-flight records
+                self._f.close()
+            except (OSError, ValueError):
+                pass
+            self._f = None
+
+
+_recorder = FlightRecorder()
+
+
+def recorder():
+    return _recorder
+
+
+def flight_tail(n=None):
+    """The flight recorder's most recent records, oldest first."""
+    return _recorder.tail(n)
+
+
+def flight_stats():
+    return _recorder.stats()
+
+
+def flight_spill_path():
+    sp = _recorder.spill()
+    return sp.path if sp is not None else None
+
+
+def read_flight_spill(path, include_rotated=True):
+    """Parse a flight spill file back (rotated generation first).
+    Tolerates the kill -9 torn final line."""
+    paths = ([path + ".1"] if include_rotated
+             and os.path.exists(path + ".1") else []) + [path]
+    out = []
+    for p in paths:
+        try:
+            with open(p) as f:
+                for line in f:
+                    try:
+                        out.append(json.loads(line))
+                    except ValueError:
+                        continue  # torn tail line
+        except OSError:
+            continue
+    return out
+
+
+# -- the taps (registered into tracing/telemetry at import) -----------------
+
+def _tap_span(kind, cat, name, wall_ts, dur_s, args):
+    # `kind` in {"span", "instant"} — one falsy check when killed
+    if not _on[0]:
+        return
+    if kind == "span":
+        _recorder.record("span", cat=cat, name=name,
+                         ts=round(wall_ts, 6), dur_s=round(dur_s, 6),
+                         args=args)
+    else:
+        _recorder.record("instant", cat=cat, name=name, args=args)
+
+
+def _tap_event(kind, fields):
+    if not _on[0]:
+        return
+    # faults keep their own kind so a bundle/statusz reader can filter
+    # degradations without string-matching inside fields
+    if kind == "fault":
+        _recorder.record("fault", fault=fields.get("fault"),
+                         detail=fields.get("detail"),
+                         count=fields.get("count"))
+    else:
+        _recorder.record("event", event=kind, fields=dict(fields))
+
+
+def enabled():
+    return _on[0]
+
+
+def set_enabled(mode):
+    """Runtime kill switch for the whole diagnostics layer: False
+    disarms BOTH taps (killed, a hot path pays exactly the tap-slot
+    falsy check; tracing's producer gate is re-derived so a process
+    with tracing ALSO off goes back to one check per span site).
+    Returns the previous state."""
+    prev = _on[0]
+    _on[0] = bool(mode)  # threadlint: ok[CL001] GIL-atomic flag publish; readers tolerate either value (set_warmup_count contract)
+    # arm/disarm the taps symmetrically: span objects are not even
+    # constructed when diagnostics was the only consumer, and a killed
+    # layer costs telemetry.emit its one None check rather than a call
+    _tracing.set_flight_tap(_tap_span if _on[0] else None)
+    _telemetry.set_flight_tap(_tap_event if _on[0] else None)
+    return prev
+
+
+# arm the taps at import: the flight recorder is ALWAYS on (that is the
+# point — the evidence must exist before anyone knew to ask for it)
+_tracing.set_flight_tap(_tap_span if _on[0] else None)
+_telemetry.set_flight_tap(_tap_event if _on[0] else None)
+
+
+# ---------------------------------------------------------------------------
+# configuration
+
+def configure(directory=None):
+    """Point diagnostics at `directory` (default:
+    ``PADDLE_TPU_DIAGNOSTICS_DIR``): postmortem bundles land here and
+    the flight recorder starts spilling its on-disk shadow. Returns
+    the effective directory, or None when nowhere is configured."""
+    directory = directory or os.environ.get("PADDLE_TPU_DIAGNOSTICS_DIR")
+    if not directory:
+        return None
+    directory = os.path.abspath(directory)
+    host = socket.gethostname()
+    with _lock:
+        if _config["dir"] == directory:
+            return directory
+        try:
+            os.makedirs(directory, exist_ok=True)
+        except OSError:
+            # a failed reconfigure must leave any previously working
+            # configuration (dir + spill) intact — silently losing the
+            # bundle destination would disarm crash evidence while the
+            # layer still LOOKS alive
+            return None
+        _config["dir"] = directory
+        _recorder.set_spill(os.path.join(
+            directory, f"{FLIGHT_PREFIX}{host}-{os.getpid()}.jsonl"))
+    return directory
+
+
+def diagnostics_dir():
+    return _config["dir"]
+
+
+# ---------------------------------------------------------------------------
+# bundle capture
+
+def thread_stacks():
+    """All-thread stacks as {thread_label: [frame lines]} — the live
+    equivalent of faulthandler's output, JSON-shaped."""
+    names = {t.ident: t.name for t in threading.enumerate()}
+    out = {}
+    for ident, frame in sys._current_frames().items():
+        label = f"{names.get(ident, 'unknown')}-{ident}"
+        out[label] = [ln.rstrip() for ln in
+                      traceback.format_stack(frame)]
+    return out
+
+
+_ENV_PREFIXES = ("PADDLE_TPU_", "JAX_", "XLA_")
+
+
+def runtime_fingerprint():
+    """Env/config/version identity of this process: enough to tell two
+    bundles apart (which jax, which knobs, which incarnation) without
+    importing anything heavy — versions are read from ``sys.modules``
+    so a jax-less or dying process still fingerprints."""
+    fp = {"python": sys.version.split()[0],
+          "platform": sys.platform,
+          "host": socket.gethostname(),
+          "pid": os.getpid(),
+          "argv": sys.argv[:8],
+          "env": {k: v for k, v in sorted(os.environ.items())
+                  if k.startswith(_ENV_PREFIXES)}}
+    for mod, key in (("jax", "jax"), ("jaxlib", "jaxlib"),
+                     ("paddle_tpu", "paddle_tpu")):
+        m = sys.modules.get(mod)
+        v = getattr(m, "__version__", None) if m is not None else None
+        fp[key] = v
+    return fp
+
+
+def _dispatch_snapshot():
+    """dispatch_stats() (incl. fusion flush sites), read only when the
+    dispatch layer is already imported — a bundle writer must never be
+    the thing that first imports jax."""
+    if "paddle_tpu.core.dispatch" not in sys.modules:
+        return None
+    try:
+        return sys.modules["paddle_tpu.core.dispatch"].dispatch_stats()
+    except Exception:  # noqa: BLE001 — evidence is best-effort
+        return None
+
+
+def _registry_snapshot(max_series=40):
+    """Bounded telemetry registry snapshot: families keep at most
+    `max_series` label series so one high-cardinality per-op family
+    cannot blow the bundle size bound."""
+    try:
+        snap = _telemetry.snapshot()
+    except Exception:  # noqa: BLE001
+        return None
+    out = {}
+    for name, fam in snap.items():
+        fam = dict(fam)
+        series = fam.get("series") or []
+        if len(series) > max_series:
+            fam["series"] = series[:max_series]
+            fam["series_dropped"] = len(series) - max_series
+        out[name] = fam
+    return out
+
+
+def _span_snapshot():
+    try:
+        return {"phase_totals": _tracing.phase_totals(),
+                "top_self_s": sorted(
+                    ((f"{c}/{n}", round(v["self_s"], 6))
+                     for (c, n), v in _tracing.span_stats().items()),
+                    key=lambda kv: -kv[1])[:20]}
+    except Exception:  # noqa: BLE001
+        return None
+
+
+def _fault_snapshot():
+    try:
+        from . import resilience as _res
+
+        return {"counters": {k: v for k, v in _res.fault_events().items()
+                             if v},
+                "recent": [{"ts": round(ts, 6), "kind": k,
+                            "detail": str(d)[:300] if d else None}
+                           for ts, k, d in _res.fault_log(40)]}
+    except Exception:  # noqa: BLE001
+        return None
+
+
+def _build_bundle(reason, extra, flight_n):
+    bundle = {
+        "bundle_version": 1,
+        "reason": reason,
+        "ts": round(time.time(), 6),
+        "uptime_s": round(time.monotonic(), 3),
+        "fingerprint": runtime_fingerprint(),
+        "stacks": thread_stacks(),
+        "dispatch": _dispatch_snapshot(),
+        "faults": _fault_snapshot(),
+        "telemetry": _registry_snapshot(),
+        "spans": _span_snapshot(),
+        "flight_recorder": {"stats": flight_stats(),
+                            "tail": flight_tail(flight_n)},
+        "serving": serving_snapshot(),
+    }
+    if extra:
+        bundle["extra"] = extra
+    return bundle
+
+
+def dump(reason="manual", extra=None, directory=None):
+    """Write one postmortem bundle; returns its path (None when no
+    directory is configured, diagnostics is killed, or every write
+    path failed — a dump may be the last thing a dying process does,
+    so it NEVER raises). The bundle is bounded
+    (``PADDLE_TPU_BUNDLE_MAX_BYTES``, default 1 MiB): oversize content
+    sheds in evidence-value order (telemetry series first, then the
+    flight tail, then stack depth) until it fits."""
+    if not _on[0]:
+        return None
+    directory = directory or _config["dir"] or configure()
+    if directory is None:
+        return None
+    try:
+        max_bytes = max(16 * 1024,
+                        _env_int("PADDLE_TPU_BUNDLE_MAX_BYTES", 1024 * 1024))
+        bundle = _build_bundle(reason, extra,
+                               _env_int("PADDLE_TPU_BUNDLE_FLIGHT_TAIL",
+                                        400))
+        blob = json.dumps(bundle, default=str)
+        if len(blob) > max_bytes:
+            bundle["telemetry"] = {"dropped": "bundle size bound"}
+            blob = json.dumps(bundle, default=str)
+        shrink = 200
+        while len(blob) > max_bytes and shrink >= 1:
+            bundle["flight_recorder"]["tail"] = \
+                bundle["flight_recorder"]["tail"][-shrink:]
+            bundle["flight_recorder"]["truncated"] = True
+            blob = json.dumps(bundle, default=str)
+            shrink //= 2
+        if len(blob) > max_bytes:
+            bundle["stacks"] = {k: v[-4:] for k, v in
+                                bundle["stacks"].items()}
+            blob = json.dumps(bundle, default=str)
+        if len(blob) > max_bytes:
+            # last resort: shed every heavy section but KEEP valid JSON
+            # (a truncated byte cut would make the bundle unreadable —
+            # worse than a thin one)
+            for key in ("flight_recorder", "spans", "serving",
+                        "dispatch", "faults"):
+                bundle[key] = {"dropped": "bundle size bound"}
+            blob = json.dumps(bundle, default=str)
+        host = socket.gethostname()
+        safe = "".join(c if c.isalnum() or c in "._-" else "_"
+                       for c in str(reason))[:48] or "manual"
+        path = os.path.join(
+            directory,
+            f"{BUNDLE_PREFIX}{host}-{os.getpid()}-"
+            f"{_bundle_seq():04d}-{safe}.json")
+        tmp = f"{path}.{os.getpid()}.{threading.get_ident()}.tmp"
+        with open(tmp, "w") as f:
+            f.write(blob)
+        os.replace(tmp, path)
+        _last_bundle[0] = path  # threadlint: ok[CL001] GIL-atomic single-slot publish; readers tolerate either value
+        _prune_bundles(directory)
+        # the spill should cover everything up to the dump (the bundle
+        # and the spill must agree about the final instants)
+        _recorder.flush_spill()
+        _telemetry.emit("postmortem_dump", reason=reason, path=path)
+        return path
+    except Exception as e:  # noqa: BLE001 — never raise out of a dump
+        try:
+            from .resilience import record_fault
+
+            record_fault("postmortem_failures",
+                         f"{reason}: {type(e).__name__}: {e}")
+        except Exception:  # noqa: BLE001
+            pass
+        return None
+
+
+def maybe_dump(reason, extra=None):
+    """`dump`, but only when a diagnostics directory is already
+    configured (env or explicit) — the form producers wire into
+    failure paths so an unconfigured process pays nothing."""
+    if not _on[0]:
+        return None
+    if _config["dir"] is None and \
+            not os.environ.get("PADDLE_TPU_DIAGNOSTICS_DIR"):
+        return None
+    return dump(reason, extra=extra)
+
+
+def _prune_bundles(directory, keep=None):
+    keep = keep if keep is not None else _env_int(
+        "PADDLE_TPU_BUNDLE_MAX_COUNT", 16)
+    if keep <= 0:  # 0 = unbounded, like its sibling byte/rotation knobs
+        return
+    # oldest by mtime, not filename: bundle names start with pid + a
+    # per-process counter, so a lexicographic order across processes
+    # sharing a dir would prune by pid, not by age
+    try:
+        names = sorted(
+            (n for n in os.listdir(directory)
+             if n.startswith(BUNDLE_PREFIX) and n.endswith(".json")),
+            key=lambda n: _mtime_or_zero(os.path.join(directory, n)))
+    except OSError:
+        return
+    for n in names[:-keep]:
+        try:
+            os.remove(os.path.join(directory, n))
+        except OSError:
+            pass
+
+
+def _mtime_or_zero(path):
+    try:
+        return os.path.getmtime(path)
+    except OSError:
+        return 0.0
+
+
+def last_bundle_path():
+    return _last_bundle[0]
+
+
+def read_bundle(path):
+    with open(path) as f:
+        return json.load(f)
+
+
+# ---------------------------------------------------------------------------
+# fatal-path installation
+
+def _on_fatal_signal(signum, frame):
+    try:
+        name = signal.Signals(signum).name
+    except ValueError:  # pragma: no cover
+        name = str(signum)
+    # the handler runs on the main thread BETWEEN bytecodes — the
+    # interrupted frame may be holding a telemetry/spill lock (they are
+    # non-reentrant), so dumping inline could deadlock the dying
+    # process. Dump from a helper thread and give it a bounded join:
+    # if the main thread holds a lock the dump needs, the join expires
+    # and the process still dies with the expected exit status (a
+    # missing bundle beats a hang that turns the SIGTERM grace period
+    # into a SIGKILL with no evidence at all).
+    th = threading.Thread(target=dump, args=(f"signal_{name}",),  # threadlint: ok[CL006] bundle writes are atomic (pid+tid tmp -> os.replace) and the bounded join below IS the shutdown ordering; a teardown-torn tmp never shadows a bundle
+                          daemon=True)
+    th.start()
+    th.join(timeout=10.0)
+    try:
+        _tracing.flush()
+    except Exception:  # noqa: BLE001
+        pass
+    prev = _prev_handlers.get(signum)
+    if callable(prev):
+        prev(signum, frame)
+        return
+    if prev == signal.SIG_IGN:
+        return
+    # default disposition: restore it and re-raise so the exit status
+    # (e.g. rc = -SIGTERM) is exactly what the parent expects
+    signal.signal(signum, signal.SIG_DFL)
+    os.kill(os.getpid(), signum)
+
+
+def _on_unhandled(exc_type, exc, tb):
+    dump("unhandled_exception",
+         extra={"exception": "".join(
+             traceback.format_exception(exc_type, exc, tb))[-4000:]})
+    hook = _prev_excepthook or sys.__excepthook__
+    hook(exc_type, exc, tb)
+
+
+def install(catch_signals=(signal.SIGTERM, signal.SIGABRT)):
+    """Arm the fatal paths: signal handlers (chained — a previous
+    handler still runs, a default disposition is re-raised so exit
+    codes survive), sys.excepthook, and faulthandler into a sidecar
+    text file in the diagnostics dir (hard crashes — SIGSEGV et al. —
+    cannot run Python, so their all-thread stacks go there). Signal
+    handlers can only be installed from the main thread; elsewhere
+    they are skipped (excepthook/faulthandler still arm). Idempotent;
+    no-op while the kill switch is off or nowhere is configured."""
+    global _prev_excepthook
+    if not _on[0]:
+        return False
+    directory = _config["dir"] or configure()
+    if directory is None:
+        return False
+    # hostname resolved BEFORE the lock (can block on a slow resolver —
+    # the tracing.configure precedent)
+    host = socket.gethostname()
+    with _lock:
+        if not _installed["faulthandler"]:
+            try:
+                fh = open(os.path.join(  # threadlint: ok[CL003,CL005] config-time once-per-process; the file is pid-keyed and owned by faulthandler (truncation IS the fresh-file contract)
+                    directory,
+                    f"faulthandler-{host}-{os.getpid()}.txt"), "w")
+                faulthandler.enable(file=fh, all_threads=True)
+                _installed["faulthandler"] = True
+            except (OSError, ValueError):
+                pass
+        if not _installed["excepthook"]:
+            _prev_excepthook = sys.excepthook
+            sys.excepthook = _on_unhandled
+            _installed["excepthook"] = True
+        if threading.current_thread() is threading.main_thread():
+            # per-signal idempotence: a signal already chained must
+            # NEVER be re-installed — signal.signal would return OUR
+            # handler as "previous" and the chain would recurse into
+            # itself on delivery
+            for sig in catch_signals:
+                if sig in _prev_handlers:
+                    continue
+                try:
+                    _prev_handlers[sig] = signal.signal(
+                        sig, _on_fatal_signal)
+                except (OSError, ValueError, RuntimeError):
+                    pass
+            _installed["signals"] = bool(_prev_handlers)
+    return True
+
+
+def installed():
+    return dict(_installed)
+
+
+def ensure_installed(default_dir=None):
+    """The producer-side wiring hook (ResilienceCallback,
+    ServingEngine, bench children): configure from the env — or
+    `default_dir` when nothing else is configured — and arm the fatal
+    paths + statusz if requested. Never raises."""
+    try:
+        d = _config["dir"] or configure()
+        if d is None and default_dir is not None:
+            d = configure(default_dir)
+        if d is not None:
+            install()
+        if os.environ.get("PADDLE_TPU_STATUSZ") is not None:
+            start_statusz()
+        return d
+    except Exception:  # noqa: BLE001 — observability must never raise
+        return None
+
+
+# ---------------------------------------------------------------------------
+# serving registration (/serving route + bundle section)
+
+_engines = []
+_engines_lock = threading.Lock()
+
+
+def register_serving_engine(engine):
+    """Track a ServingEngine (weakly) so /serving and bundles can report
+    engine + scheduler + KV-pool state."""
+    with _engines_lock:
+        _engines.append(weakref.ref(engine))
+        if len(_engines) > 16:  # bound: drop dead refs, then oldest
+            _engines[:] = [r for r in _engines if r() is not None][-16:]
+
+
+def serving_snapshot():
+    """State of every live registered engine (None when none)."""
+    out = []
+    for ref in list(_engines):
+        eng = ref()
+        if eng is None:
+            continue
+        try:
+            out.append(eng.diagnostics_snapshot())
+        except Exception as e:  # noqa: BLE001 — a wedged engine must
+            # not take the route down with it
+            out.append({"error": f"{type(e).__name__}: {e}"})
+    return out or None
+
+
+# ---------------------------------------------------------------------------
+# /statusz server
+
+_server = [None]          # (httpd, thread, host, port)
+
+
+def _statusz_payload():
+    """The /statusz body: the machine-readable profiler summary when
+    the profiler (and therefore jax) is already imported, else the
+    light sections this module can produce alone."""
+    try:
+        # the profiler package imports jax at module top — only serve
+        # the full summary when the dispatch layer (and therefore jax)
+        # is already loaded, so a scrape is never the first jax import
+        if "paddle_tpu.profiler" in sys.modules or \
+                "paddle_tpu.core.dispatch" in sys.modules:
+            from .. import profiler as _profiler
+
+            summary = _profiler.summary_dict()
+        else:
+            summary = None
+    except Exception:  # noqa: BLE001
+        summary = None
+    return {
+        "ts": round(time.time(), 6),
+        "fingerprint": runtime_fingerprint(),
+        "summary": summary,
+        "faults": _fault_snapshot(),
+        "flight_recorder": flight_stats(),
+        "diagnostics_dir": _config["dir"],
+        "last_bundle": _last_bundle[0],
+        "threads": sorted(t.name for t in threading.enumerate()),
+    }
+
+
+def _metrics_text():
+    # sync only when the dispatch layer is already loaded — a scrape
+    # must never be the thing that first imports jax into a process
+    if "paddle_tpu.core.dispatch" in sys.modules:
+        try:
+            _telemetry.sync_runtime_metrics()
+        except Exception:  # noqa: BLE001 — no dispatch traffic yet
+            pass
+    return _telemetry.render_prometheus()
+
+
+def _make_handler():
+    from http.server import BaseHTTPRequestHandler
+
+    class Handler(BaseHTTPRequestHandler):
+        server_version = "paddle_tpu_statusz/1"
+
+        def _send(self, body, ctype="application/json"):
+            data = body.encode() if isinstance(body, str) else body
+            self.send_response(200)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def _json(self, obj):
+            self._send(json.dumps(obj, default=str, indent=1))
+
+        def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler API
+            path, _, query = self.path.partition("?")
+            try:
+                if path in ("/", "/statusz"):
+                    self._json(_statusz_payload())
+                elif path == "/metrics":
+                    self._send(_metrics_text(),
+                               "text/plain; version=0.0.4")
+                elif path == "/stacks":
+                    self._json({"ts": round(time.time(), 6),
+                                "stacks": thread_stacks()})
+                elif path == "/flightrecorder":
+                    n = 200
+                    for part in query.split("&"):
+                        if part.startswith("n="):
+                            try:
+                                n = max(1, int(part[2:]))
+                            except ValueError:
+                                pass
+                    self._json({"stats": flight_stats(),
+                                "tail": flight_tail(n)})
+                elif path == "/serving":
+                    self._json({"engines": serving_snapshot() or []})
+                elif path == "/healthz":
+                    self._send("ok\n", "text/plain")
+                else:
+                    self.send_error(404, "unknown route")
+            except BrokenPipeError:  # client went away mid-write
+                pass
+            except Exception as e:  # noqa: BLE001 — a route bug must
+                # not kill the server thread
+                try:
+                    self.send_error(500, f"{type(e).__name__}: {e}")
+                except Exception:  # noqa: BLE001
+                    pass
+                try:
+                    from .resilience import record_fault
+
+                    record_fault("statusz_errors",
+                                 f"{path}: {type(e).__name__}: {e}")
+                except Exception:  # noqa: BLE001
+                    pass
+
+        def log_message(self, fmt, *args):  # quiet by default
+            pass
+
+    return Handler
+
+
+def start_statusz(port=None, host=None):
+    """Start the introspection server (idempotent; returns (host,
+    port), or None when no port is configured or the bind failed).
+    Loopback-only by default — /stacks and env fingerprints are not
+    for the open network; ``PADDLE_TPU_STATUSZ_HOST`` (or `host=`)
+    overrides for operators who front it themselves. Port 0 binds
+    ephemeral; the effective port lands in `statusz_address()`, the
+    ``statusz_start`` telemetry event, and ``statusz-<pid>.port`` in
+    the diagnostics dir (when configured) so external tooling can
+    find a child's server."""
+    if not _on[0]:
+        return None
+    if port is None:
+        raw = os.environ.get("PADDLE_TPU_STATUSZ")
+        if raw is None or raw == "" or raw.lower() in ("false", "no"):
+            return None
+        try:
+            port = int(raw)
+        except ValueError:
+            return None
+    host = host or os.environ.get("PADDLE_TPU_STATUSZ_HOST", "127.0.0.1")
+    with _lock:
+        if _server[0] is not None:
+            return _server[0][2], _server[0][3]
+        try:
+            from http.server import ThreadingHTTPServer
+
+            httpd = ThreadingHTTPServer((host, int(port)), _make_handler())
+        except OSError as e:
+            try:
+                from .resilience import record_fault
+
+                record_fault("statusz_errors",
+                             f"bind {host}:{port}: {e}")
+            except Exception:  # noqa: BLE001
+                pass
+            return None
+        httpd.daemon_threads = True
+        bound = httpd.server_address[1]
+        th = threading.Thread(target=httpd.serve_forever,
+                              name="paddle_tpu-statusz", daemon=True)
+        th.start()
+        _server[0] = (httpd, th, host, bound)
+    _telemetry.emit("statusz_start", host=host, port=bound)
+    d = _config["dir"]
+    if d is not None:
+        # atomic publish: a poller must never read a torn/empty file
+        p = os.path.join(d, f"statusz-{os.getpid()}.port")
+        tmp = f"{p}.{threading.get_ident()}.tmp"
+        try:
+            with open(tmp, "w") as f:
+                f.write(f"{host}:{bound}\n")
+            os.replace(tmp, p)
+        except OSError:
+            pass
+    return host, bound
+
+
+def stop_statusz():
+    with _lock:
+        ent, _server[0] = _server[0], None
+    if ent is None:
+        return
+    httpd = ent[0]
+    try:
+        httpd.shutdown()
+        httpd.server_close()
+    except Exception:  # noqa: BLE001
+        pass
+
+
+def statusz_address():
+    ent = _server[0]
+    return (ent[2], ent[3]) if ent is not None else None
+
+
+# a clean exit leaves the spill complete (a kill -9 still loses at most
+# the buffered tail — the durability bound the spill documents)
+atexit.register(lambda: _recorder.flush_spill())
+
+
+# ---------------------------------------------------------------------------
+# process wiring: env-driven auto-config (same zero-user-code promise
+# as tracing — a child with the env vars set needs no code changes)
+
+if os.environ.get("PADDLE_TPU_DIAGNOSTICS_DIR"):
+    try:
+        configure()
+        install()
+    except Exception:  # pragma: no cover — never break import
+        pass
+if os.environ.get("PADDLE_TPU_STATUSZ") is not None:
+    try:
+        start_statusz()
+    except Exception:  # pragma: no cover — never break import
+        pass

@@ -92,6 +92,11 @@ _EVENT_KINDS = (
     #                           hanging
     "push_failures",          # a pushgateway export failed; warned and
     #                           dropped, never raised into training
+    "postmortem_failures",    # a diagnostics bundle dump failed (full
+    #                           disk, serialization bug); the dying
+    #                           process degraded to no evidence
+    "statusz_errors",         # the /statusz server failed to bind or a
+    #                           route handler raised; served degraded
 )
 
 _events_lock = threading.Lock()

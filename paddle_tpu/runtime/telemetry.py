@@ -71,7 +71,7 @@ __all__ = [
     "counter", "gauge", "histogram", "snapshot", "reset_metrics",
     "enabled", "set_enabled",
     "EventStream", "configure", "event_stream", "emit", "events_path",
-    "read_events", "set_rank", "get_rank",
+    "read_events", "set_rank", "get_rank", "set_flight_tap",
     "write_prometheus", "render_prometheus", "parse_prometheus_textfile",
     "append_snapshot_jsonl", "ScalarsSink", "merge_histograms",
     "publish_registry", "merge_cluster",
@@ -552,10 +552,31 @@ def events_path():
     return _stream.path if _stream is not None else None
 
 
+# the flight-recorder tap (runtime/diagnostics.py): fn(kind, fields),
+# fed from EVERY emit regardless of whether a stream is configured —
+# the crash ring must hold recent events even in a process that never
+# opted into an event stream. None (one falsy check) when diagnostics
+# is absent or killed.
+_flight = [None]
+
+
+def set_flight_tap(fn):
+    """Register (or, with None, disarm) the flight-recorder event tap.
+    Returns the previous tap."""
+    prev = _flight[0]
+    _flight[0] = fn  # threadlint: ok[CL001] GIL-atomic publish; config-time single-writer (set_warmup_count contract)
+    return prev
+
+
 def emit(kind, **fields):
     """Emit one structured event to the global stream. A no-op (one
     None/flag check) when no stream is configured or the kill switch is
-    off — producers across the stack call this unconditionally."""
+    off — producers across the stack call this unconditionally. The
+    flight-recorder tap (when armed) sees every event first, stream or
+    no stream."""
+    tap = _flight[0]
+    if tap is not None:
+        tap(kind, fields)
     if _stream is None or not _enabled:
         return
     _stream.emit(kind, **fields)
@@ -1655,6 +1676,10 @@ EVENT_KINDS = (
     #                        than the agreed restore step were deleted
     "trace_merge",        # host-0 span-trace merge into the cluster
     #                       timeline (runtime/tracing.py)
+    "postmortem_dump",    # runtime/diagnostics.py wrote a bundle
+    #                       (reason + path)
+    "statusz_start",      # the /statusz introspection server bound
+    #                       its port
 )
 
 

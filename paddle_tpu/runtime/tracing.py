@@ -69,7 +69,7 @@ from . import telemetry as _telemetry
 __all__ = [
     "configure", "enabled", "set_enabled", "trace_dir", "trace_path",
     "tracer", "span", "emit_span", "instant", "set_span_arg",
-    "flush", "close",
+    "set_flight_tap", "flush", "close",
     "span_stats", "phase_totals", "reset_span_stats", "summary_lines",
     "reconcile_with_metrics", "read_trace", "validate_trace",
     "TRACE_BASENAME_PREFIX",
@@ -86,14 +86,39 @@ def _env_int(name, default):
 
 
 # the producer-side switch: ONE list-index truthiness check on every
-# hot path (the same idiom as fusion._ON). True only while a tracer is
-# configured AND the kill switch is on.
+# hot path (the same idiom as fusion._ON). True while ANY consumer is
+# live: a configured tracer with the kill switch on, OR the flight
+# recorder's tap (runtime/diagnostics.py — always-on by default, so
+# spans keep feeding the crash ring even when PADDLE_TPU_TRACE is off).
 _on = [False]
+# file tracing specifically (tracer configured AND its kill switch on):
+# gates writes to the trace file and the span-stats aggregate, so the
+# reconciliation/summary surfaces still cover exactly what the trace
+# file covers
+_live = [False]
+# the flight-recorder tap: fn(kind, cat, name, wall_ts, dur_s, args)
+# with kind in {"span", "instant"}, or None when diagnostics is off
+_fr = [None]
 
 _lock = threading.Lock()          # guards _tracer/_config swaps
 _tracer = None
 _config = {"dir": None}
 _killed = [False]                 # set_enabled(False) latch
+
+
+def _recompute_on():
+    _on[0] = _live[0] or _fr[0] is not None
+
+
+def set_flight_tap(fn):
+    """Register (or, with None, disarm) the flight-recorder tap. Every
+    span/instant emission point feeds it regardless of whether file
+    tracing is on — diagnostics owns the ring, tracing owns the
+    emission points. Returns the previous tap."""
+    prev = _fr[0]
+    _fr[0] = fn  # threadlint: ok[CL001] GIL-atomic publish; config-time single-writer (set_warmup_count contract)
+    _recompute_on()
+    return prev
 
 
 class _TLocal(threading.local):
@@ -401,9 +426,12 @@ class _Span:
         if st:
             st[-1]._child += dur
         t = _tracer
-        if t is not None and _on[0]:
+        if t is not None and _live[0]:
             t.emit_complete(self.name, self.cat, self._w0, dur, self.args)
             _note(self.cat, self.name, dur, max(0.0, dur - self._child))
+        fr = _fr[0]
+        if fr is not None:
+            fr("span", self.cat, self.name, self._w0, dur, self.args)
         return False
 
 
@@ -435,10 +463,12 @@ def emit_span(name, cat, wall_start, dur_s, /, **args):
     if not _on[0]:
         return
     t = _tracer
-    if t is None:
-        return
-    t.emit_complete(name, cat, wall_start, dur_s, args or None)
-    _note(cat, name, dur_s, dur_s)
+    if t is not None and _live[0]:
+        t.emit_complete(name, cat, wall_start, dur_s, args or None)
+        _note(cat, name, dur_s, dur_s)
+    fr = _fr[0]
+    if fr is not None:
+        fr("span", cat, name, wall_start, dur_s, args or None)
 
 
 def instant(name, cat="runtime", /, **args):
@@ -447,8 +477,11 @@ def instant(name, cat="runtime", /, **args):
     if not _on[0]:
         return
     t = _tracer
-    if t is not None:
+    if t is not None and _live[0]:
         t.emit_instant(name, cat, args or None)
+    fr = _fr[0]
+    if fr is not None:
+        fr("instant", cat, name, 0.0, 0.0, args or None)
 
 
 # ---------------------------------------------------------------------------
@@ -483,36 +516,44 @@ def configure(directory=None, flush_every=None, max_events=None):
                 _tracer.flush_every = max(1, int(flush_every))
             if max_events is not None:
                 _tracer.max_events = max(1, int(max_events))
-            _on[0] = True
+            _live[0] = True
+            _recompute_on()
             return directory
         new = SpanTracer(path, flush_every=flush_every,
                          max_events=max_events)
         old = _tracer
         _tracer = new
         _config["dir"] = directory
-        _on[0] = True
+        _live[0] = True
+        _recompute_on()
     if old is not None:
         old.close()
     return directory
 
 
 def enabled():
-    return _on[0]
+    """True while FILE tracing is live (a tracer is configured and the
+    kill switch is on) — the flight-recorder tap does not count; see
+    diagnostics.enabled() for that layer's switch."""
+    return _live[0]
 
 
 def set_enabled(mode):
-    """Runtime kill switch: False stops every producer at its one falsy
-    check (the buffer is flushed so nothing recorded is lost); True
-    re-arms a configured tracer. Returns the previous state."""
-    prev = _on[0]
+    """Runtime kill switch for file tracing: False stops trace-file
+    writes and span-stats accumulation (the buffer is flushed so
+    nothing recorded is lost); True re-arms a configured tracer. The
+    flight-recorder tap (diagnostics) is governed by its own switch.
+    Returns the previous state."""
+    prev = _live[0]
     _killed[0] = not mode  # threadlint: ok[CL001] GIL-atomic flag publish; config-time single-writer, readers tolerate either value (same contract as dispatch.set_warmup_count)
     if mode:
-        _on[0] = _tracer is not None  # threadlint: ok[CL001] see above
+        _live[0] = _tracer is not None  # threadlint: ok[CL001] see above
     else:
-        _on[0] = False  # threadlint: ok[CL001] see above
+        _live[0] = False  # threadlint: ok[CL001] see above
         t = _tracer
         if t is not None:
             t.flush()
+    _recompute_on()
     return prev
 
 

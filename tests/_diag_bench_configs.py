@@ -1,0 +1,22 @@
+"""Fake CONFIGS for the diagnostics deadline-kill acceptance
+(BENCH_CONFIGS_MODULE): one config that produces real dispatch traffic
+and then wedges forever — the shape of every rc=124 bench round the
+flight recorder exists for. The campaign child running it must be
+SIGTERMable at any point and leave a postmortem bundle."""
+import time
+
+
+def _hang():
+    import numpy as np
+
+    import paddle_tpu as paddle
+
+    t = paddle.to_tensor(np.ones((8, 8), "float32"))
+    for _ in range(4):
+        float(paddle.tanh(paddle.matmul(t, t)).sum())
+    while True:  # the wedge a per-config deadline exists to kill
+        paddle.tanh(paddle.matmul(t, t)).sum()
+        time.sleep(0.05)
+
+
+CONFIGS = {"hang": (_hang, {}, 60)}

@@ -1,0 +1,77 @@
+"""Subprocess child for tests/test_diagnostics.py and
+tools/diagnostics_smoke.py.
+
+The parent exports ``PADDLE_TPU_DIAGNOSTICS_DIR`` (diagnostics arms
+itself at import — the zero-user-code promise) and usually
+``PADDLE_TPU_FLIGHT_FLUSH_EVERY=1`` so the spill is per-record durable
+for deterministic kill tests. Modes:
+
+* ``sigterm`` — real dispatch traffic fills the flight ring, a
+  ``ready`` file lands in the diagnostics dir, then the child spins
+  until the parent SIGTERMs it (the installed handler must dump a
+  postmortem bundle and die with rc = -SIGTERM).
+* ``kill9``   — same, plus one explicit `dump()` before ready: a
+  SIGKILL runs no handlers, so the pre-kill bundle and the append-only
+  flight spill ARE the evidence.
+* ``raise``   — raises after ready; the chained sys.excepthook must
+  dump an ``unhandled_exception`` bundle and the process still exits
+  nonzero.
+* ``stall``   — an ElasticManager watchdog with a sub-second timeout
+  and no ticks: the no-heartbeat stall must dump a bundle, then the
+  child exits 0 on its own.
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
+    __file__))))
+
+
+def main():
+    mode = sys.argv[1] if len(sys.argv) > 1 else "sigterm"
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.runtime import diagnostics
+
+    d = diagnostics.diagnostics_dir()
+    assert d, "PADDLE_TPU_DIAGNOSTICS_DIR must arm diagnostics at import"
+    # real dispatch + fusion-layer traffic so the bundle's
+    # dispatch_stats() section and the flight ring carry live data
+    t = paddle.to_tensor(
+        np.random.RandomState(0).randn(8, 8).astype(np.float32))
+    for _ in range(6):
+        float(paddle.tanh(paddle.matmul(t, t)).sum())
+
+    if mode == "stall":
+        from paddle_tpu.distributed.elastic import ElasticManager
+
+        stalled = []
+        em = ElasticManager(os.path.join(d, "ckpt"), timeout=0.4)
+        em.start_watchdog(on_stall=stalled.append, poll=0.1)
+        deadline = time.time() + 30
+        while not stalled and time.time() < deadline:
+            time.sleep(0.05)
+        em.stop()
+        assert stalled, "watchdog never fired"
+        with open(os.path.join(d, "ready"), "w") as f:
+            f.write("stalled")
+        return 0
+
+    if mode == "kill9":
+        diagnostics.dump("pre_kill_milestone")
+    diagnostics.recorder().flush_spill()
+    with open(os.path.join(d, "ready"), "w") as f:
+        f.write(str(os.getpid()))
+
+    if mode == "raise":
+        raise RuntimeError("deliberate unhandled failure")
+
+    while True:  # sigterm / kill9: keep producing until killed
+        paddle.tanh(paddle.matmul(t, t)).sum()
+        time.sleep(0.05)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

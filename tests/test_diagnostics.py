@@ -1,0 +1,454 @@
+"""Crash-and-hang observability (runtime/diagnostics.py): the flight
+recorder ring + taps, the kill-switch parity contract (diagnostics
+on/off/killed => identical dispatch stats), postmortem bundle capture
+(explicit, SIGTERM, kill -9, unhandled exception — each subprocess
+child must leave a valid bounded-size bundle and a contiguous
+flight-recorder prefix on disk), and the /statusz introspection server
+(live well-formed JSON under concurrent scrapes)."""
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.core import dispatch
+from paddle_tpu.runtime import diagnostics, telemetry, tracing
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+
+
+@pytest.fixture(autouse=True)
+def _diag_hygiene():
+    """Leave the process with diagnostics armed (the default) but
+    pointed nowhere, and the statusz server down."""
+    yield
+    diagnostics.stop_statusz()
+    diagnostics.set_enabled(True)
+    diagnostics._config["dir"] = None
+    diagnostics._recorder.set_spill(None)
+    tracing.set_enabled(False)
+    tracing.reset_span_stats()
+
+
+def _workload(n=4):
+    t = paddle.to_tensor(
+        np.random.RandomState(0).randn(8, 8).astype(np.float32))
+    for _ in range(n):
+        paddle.tanh(paddle.matmul(t, t)).sum()
+
+
+# ---------------------------------------------------------------------------
+# flight recorder: ring semantics + taps
+
+def test_ring_bounded_and_tail_contiguous():
+    r = diagnostics.FlightRecorder(capacity=32)
+    for i in range(100):
+        r.record("event", event="e", fields={"i": i})
+    st = r.stats()
+    assert st["held"] == 32 and st["recorded"] == 100
+    assert st["overwritten"] == 68
+    seqs = [rec["seq"] for rec in r.tail()]
+    # the tail is a CONTIGUOUS suffix of everything recorded
+    assert seqs == list(range(seqs[0], seqs[0] + len(seqs)))
+    assert r.tail(5) == r.tail()[-5:]
+
+
+def test_taps_feed_ring_without_trace_dir():
+    assert not tracing.enabled()  # no PADDLE_TPU_TRACE in this process
+    before = diagnostics.flight_stats()["recorded"]
+    with tracing.span("unit_span", "diagtest"):
+        pass
+    tracing.instant("unit_instant", "diagtest")
+    telemetry.emit("postmortem_dump", reason="tap-test")  # event tap
+    tail = diagnostics.flight_tail(50)
+    assert diagnostics.flight_stats()["recorded"] >= before + 3
+    kinds = {(r["kind"], r.get("name") or r.get("event")) for r in tail}
+    assert ("span", "unit_span") in kinds
+    assert ("instant", "unit_instant") in kinds
+    assert ("event", "postmortem_dump") in kinds
+
+
+def test_fault_records_keep_their_own_kind():
+    from paddle_tpu.runtime.resilience import record_fault
+
+    record_fault("injected_faults", "diag tap unit test")
+    rec = [r for r in diagnostics.flight_tail(20) if r["kind"] == "fault"]
+    assert rec and rec[-1]["fault"] == "injected_faults"
+
+
+def test_kill_switch_stops_taps_and_restores():
+    prev = diagnostics.set_enabled(False)
+    assert prev is True
+    try:
+        before = diagnostics.flight_stats()["recorded"]
+        with tracing.span("dead_span", "diagtest"):
+            pass
+        telemetry.emit("postmortem_dump", reason="dead")
+        assert diagnostics.flight_stats()["recorded"] == before
+        # with tracing ALSO off, producers collapse to the null span
+        assert tracing.span("x", "y") is tracing._NULL
+    finally:
+        diagnostics.set_enabled(True)
+    with tracing.span("live_again", "diagtest"):
+        pass
+    assert any(r.get("name") == "live_again"
+               for r in diagnostics.flight_tail(10))
+
+
+def test_kill_switch_parity_dispatch_stats():
+    """diagnostics on / off / killed => IDENTICAL dispatch stats (the
+    acceptance contract: the whole layer disabled costs hot paths one
+    falsy check and changes nothing observable)."""
+
+    def stats():
+        dispatch.reset_dispatch_stats(clear_caches=True)
+        _workload()
+        ds = dispatch.dispatch_stats()
+        return (
+            {k: ds["forward"][k] for k in
+             ("hits", "misses", "bypasses", "unkeyable", "warming",
+              "fallbacks")},
+            {k: (v["hits"], v["misses"], v["retraces"])
+             for k, v in ds["per_op"].items()},
+        )
+
+    on = stats()
+    diagnostics.set_enabled(False)
+    off = stats()
+    diagnostics.set_enabled(True)
+    rearmed = stats()
+    assert on == off == rearmed
+
+
+# ---------------------------------------------------------------------------
+# bundles: explicit dump
+
+def test_dump_bundle_contents(tmp_path):
+    d = str(tmp_path / "diag")
+    diagnostics.configure(d)
+    _workload()
+    path = diagnostics.dump("unit_test", extra={"marker": 42})
+    assert path and os.path.dirname(path) == d
+    assert diagnostics.last_bundle_path() == path
+    b = diagnostics.read_bundle(path)
+    assert b["reason"] == "unit_test" and b["extra"]["marker"] == 42
+    # all-thread stacks include this one, frames and all
+    assert any("MainThread" in k for k in b["stacks"])
+    assert any("test_dump_bundle_contents" in ln
+               for frames in b["stacks"].values() for ln in frames)
+    # dispatch stats incl. the fusion section (flush sites live there)
+    assert b["dispatch"]["forward"]["hits"] >= 1
+    assert "fusion" in b["dispatch"]
+    # fingerprint: env + versions
+    assert b["fingerprint"]["python"] and "env" in b["fingerprint"]
+    assert b["fingerprint"]["jax"]  # jax is imported in this process
+    # flight tail rides along, contiguous
+    tail = b["flight_recorder"]["tail"]
+    assert tail
+    seqs = [r["seq"] for r in tail]
+    assert seqs == list(range(seqs[0], seqs[0] + len(seqs)))
+
+
+def test_dump_size_bound(tmp_path, monkeypatch):
+    d = str(tmp_path / "diag")
+    monkeypatch.setenv("PADDLE_TPU_BUNDLE_MAX_BYTES", str(32 * 1024))
+    diagnostics.configure(d)
+    for i in range(500):  # a fat ring the bound must shed
+        diagnostics.recorder().record(
+            "event", event="fill", fields={"pad": "x" * 200, "i": i})
+    path = diagnostics.dump("bounded")
+    assert path
+    assert os.path.getsize(path) <= 32 * 1024
+    b = diagnostics.read_bundle(path)  # still VALID json
+    assert b["reason"] == "bounded"
+    assert b["flight_recorder"].get("truncated") or \
+        b["telemetry"] == {"dropped": "bundle size bound"}
+
+
+def test_dump_without_dir_is_none_and_never_raises():
+    assert diagnostics.diagnostics_dir() is None
+    assert diagnostics.maybe_dump("nowhere") is None
+
+
+def test_bundle_pruning(tmp_path, monkeypatch):
+    d = str(tmp_path / "diag")
+    monkeypatch.setenv("PADDLE_TPU_BUNDLE_MAX_COUNT", "3")
+    diagnostics.configure(d)
+    for i in range(6):
+        diagnostics.dump(f"n{i}")
+    kept = [n for n in os.listdir(d)
+            if n.startswith(diagnostics.BUNDLE_PREFIX)]
+    assert len(kept) == 3
+    assert all(f"n{i}" in " ".join(kept) for i in (3, 4, 5))
+
+
+# ---------------------------------------------------------------------------
+# subprocess children: the evidence must survive the process
+
+def _spawn_child(mode, diag_dir, extra_env=None):
+    env = dict(os.environ,
+               PADDLE_TPU_DIAGNOSTICS_DIR=diag_dir,
+               PADDLE_TPU_FLIGHT_FLUSH_EVERY="1",
+               JAX_PLATFORMS="cpu")
+    env.update(extra_env or {})
+    return subprocess.Popen(
+        [sys.executable, os.path.join(HERE, "_diagnostics_child.py"),
+         mode],
+        env=env, cwd=REPO,
+        stdout=subprocess.DEVNULL, stderr=subprocess.PIPE)
+
+
+def _wait_ready(proc, diag_dir, timeout=120):
+    ready = os.path.join(diag_dir, "ready")
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if os.path.exists(ready):
+            return
+        if proc.poll() is not None:
+            raise AssertionError(
+                "child died before ready: "
+                + proc.stderr.read().decode("utf-8", "replace")[-2000:])
+        time.sleep(0.1)
+    proc.kill()
+    raise AssertionError("child never became ready")
+
+
+def _bundles(diag_dir):
+    return sorted(
+        os.path.join(diag_dir, n) for n in os.listdir(diag_dir)
+        if n.startswith(diagnostics.BUNDLE_PREFIX) and n.endswith(".json"))
+
+
+def _spill_paths(diag_dir):
+    return [os.path.join(diag_dir, n) for n in os.listdir(diag_dir)
+            if n.startswith(diagnostics.FLIGHT_PREFIX)
+            and n.endswith(".jsonl")]
+
+
+def _assert_valid_bundle(path, reason_contains):
+    assert os.path.getsize(path) <= 1024 * 1024  # the default bound
+    b = diagnostics.read_bundle(path)  # strict json.load
+    assert reason_contains in b["reason"]
+    assert b["stacks"]  # all-thread stacks
+    assert b["dispatch"] and b["dispatch"]["forward"]["hits"] >= 1
+    assert "fusion" in b["dispatch"]
+    tail = b["flight_recorder"]["tail"]
+    assert tail
+    seqs = [r["seq"] for r in tail]
+    assert seqs == list(range(seqs[0], seqs[0] + len(seqs)))
+    return b
+
+
+def _assert_contiguous_spill(diag_dir):
+    spills = _spill_paths(diag_dir)
+    assert spills, "flight spill missing"
+    recs = diagnostics.read_flight_spill(spills[0])
+    assert recs, "flight spill empty"
+    seqs = [r["seq"] for r in recs]
+    # a contiguous PREFIX of the run's records: per-record flush in the
+    # children, so nothing in the middle can be missing
+    assert seqs == list(range(seqs[0], seqs[0] + len(seqs)))
+    return recs
+
+
+@pytest.mark.parametrize("sig", [signal.SIGTERM])
+def test_sigterm_child_leaves_bundle(tmp_path, sig):
+    d = str(tmp_path / "diag")
+    proc = _spawn_child("sigterm", d)
+    try:
+        _wait_ready(proc, d)
+        proc.send_signal(sig)
+        proc.wait(timeout=60)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+        proc.stderr.close()
+    assert proc.returncode == -sig  # default disposition preserved
+    paths = _bundles(d)
+    assert paths, "SIGTERM handler left no bundle"
+    _assert_valid_bundle(paths[-1], "signal_SIGTERM")
+    _assert_contiguous_spill(d)
+
+
+def test_kill9_child_leaves_spill_and_prior_bundle(tmp_path):
+    d = str(tmp_path / "diag")
+    proc = _spawn_child("kill9", d)
+    try:
+        _wait_ready(proc, d)
+        time.sleep(0.3)  # a few post-ready records into the spill
+        proc.kill()
+        proc.wait(timeout=60)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+        proc.stderr.close()
+    assert proc.returncode == -signal.SIGKILL
+    # no handler ran — the evidence is the pre-kill bundle + the
+    # append-only spill, both still valid and contiguous
+    paths = _bundles(d)
+    assert paths, "pre-kill bundle missing"
+    _assert_valid_bundle(paths[-1], "pre_kill_milestone")
+    recs = _assert_contiguous_spill(d)
+    # the spill kept growing after the bundle was written (evidence
+    # newer than the newest bundle survives the SIGKILL)
+    bundle_top = diagnostics.read_bundle(
+        paths[-1])["flight_recorder"]["tail"][-1]["seq"]
+    assert recs[-1]["seq"] > bundle_top
+
+
+def test_unhandled_exception_child_dumps(tmp_path):
+    d = str(tmp_path / "diag")
+    proc = _spawn_child("raise", d)
+    try:
+        _, err = proc.communicate(timeout=120)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    assert proc.returncode != 0
+    assert b"deliberate unhandled failure" in err  # traceback printed
+    paths = _bundles(d)
+    assert paths
+    b = _assert_valid_bundle(paths[-1], "unhandled_exception")
+    assert "deliberate unhandled failure" in b["extra"]["exception"]
+
+
+@pytest.mark.slow
+def test_watchdog_stall_child_dumps(tmp_path):
+    d = str(tmp_path / "diag")
+    proc = _spawn_child("stall", d)
+    try:
+        _, err = proc.communicate(timeout=120)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    assert proc.returncode == 0, err.decode("utf-8", "replace")[-2000:]
+    paths = _bundles(d)
+    assert paths, "stall dump missing"
+    b = _assert_valid_bundle(paths[-1], "watchdog_stall")
+    assert b["extra"]["reason"] == "no_heartbeat"
+
+
+# ---------------------------------------------------------------------------
+# /statusz
+
+def _get(addr, route):
+    with urllib.request.urlopen(
+            f"http://{addr[0]}:{addr[1]}{route}", timeout=10) as r:
+        return r.status, r.read()
+
+
+def test_statusz_routes_and_concurrent_scrapes(tmp_path):
+    diagnostics.configure(str(tmp_path / "diag"))
+    addr = diagnostics.start_statusz(0)  # ephemeral port
+    assert addr and addr[0] == "127.0.0.1"  # loopback-only default
+    assert diagnostics.statusz_address() == addr
+    # the bound port is discoverable from the diagnostics dir
+    port_file = os.path.join(str(tmp_path / "diag"),
+                             f"statusz-{os.getpid()}.port")
+    assert open(port_file).read().strip() == f"{addr[0]}:{addr[1]}"
+
+    errors = []
+    stop = threading.Event()
+
+    def scrape(route):
+        while not stop.is_set():
+            try:
+                status, body = _get(addr, route)
+                assert status == 200
+                if route != "/metrics":
+                    json.loads(body)  # well-formed JSON, every time
+            except Exception as e:  # noqa: BLE001
+                errors.append((route, repr(e)))
+                return
+
+    threads = [threading.Thread(target=scrape, args=(r,), daemon=True)
+               for r in ("/statusz", "/flightrecorder?n=20", "/stacks",
+                         "/metrics")]
+    for th in threads:
+        th.start()
+    for _ in range(6):  # live dispatch traffic DURING the scrapes
+        _workload(2)
+    time.sleep(0.3)
+    stop.set()
+    for th in threads:
+        th.join(timeout=10)
+    assert not errors, errors
+
+    status, body = _get(addr, "/statusz")
+    doc = json.loads(body)
+    # live data: the machine-readable profiler summary with real hits
+    assert doc["summary"]["dispatch"]["forward"]["hits"] >= 1
+    assert doc["flight_recorder"]["recorded"] >= 1
+    status, body = _get(addr, "/metrics")
+    assert b"paddle_tpu_dispatch_cache_hits_total" in body
+    status, body = _get(addr, "/flightrecorder?n=7")
+    doc = json.loads(body)
+    assert 1 <= len(doc["tail"]) <= 7
+    # unknown route: a clean 404, not a dead server
+    with pytest.raises(urllib.error.HTTPError):
+        _get(addr, "/bogus")
+    status, _ = _get(addr, "/healthz")
+    assert status == 200
+
+
+def test_statusz_serving_route(tmp_path):
+    from paddle_tpu.inference import ServeConfig, ServingEngine
+    from paddle_tpu.inference.model import TinyServeModel
+
+    model = TinyServeModel(vocab=32, dim=8, layers=1, heads=2, ffn=16)
+    eng = ServingEngine(model, ServeConfig(
+        max_running=2, token_budget=4, block_size=4, num_blocks=8))
+    eng.generate([[1, 2, 3]], max_new_tokens=2)
+    snap = diagnostics.serving_snapshot()
+    assert snap and snap[-1]["stats"]["steps"] >= 1
+    assert snap[-1]["kv"]["blocks_free"] >= 0
+    addr = diagnostics.start_statusz(0)
+    _, body = _get(addr, "/serving")
+    doc = json.loads(body)
+    assert doc["engines"] and doc["engines"][-1]["config"]["num_blocks"] == 8
+
+
+def test_statusz_kill_switch(monkeypatch):
+    diagnostics.set_enabled(False)
+    try:
+        assert diagnostics.start_statusz(0) is None
+    finally:
+        diagnostics.set_enabled(True)
+
+
+# ---------------------------------------------------------------------------
+# bench ingestion (the orchestrator-side half of the satellite)
+
+def test_bench_collect_child_diagnostics(tmp_path):
+    sys.path.insert(0, REPO)
+    try:
+        import bench
+    finally:
+        sys.path.pop(0)
+    diag_dir = str(tmp_path / "diagnostics" / "cfg")
+    os.makedirs(diag_dir)
+    with open(os.path.join(diag_dir, "postmortem-h-1-0001-x.json"),
+              "w") as f:
+        json.dump({"reason": "x"}, f)
+    with open(os.path.join(diag_dir, "flight-h-1.jsonl"), "w") as f:
+        for i in range(30):
+            f.write(json.dumps({"seq": i + 1, "kind": "event"}) + "\n")
+        f.write('{"seq": 31, "kind": "ev')  # torn tail (kill -9)
+    details = {}
+    bench._collect_child_diagnostics(diag_dir, "cfg", details)
+    assert details["cfg_bundle_path"].endswith("postmortem-h-1-0001-x.json")
+    tail = details["cfg_flight_tail"]
+    assert len(tail) == 15 and tail[-1]["seq"] == 30  # torn line dropped
+    # a missing dir contributes nothing (and does not raise)
+    details2 = {}
+    bench._collect_child_diagnostics(str(tmp_path / "nope"), "cfg",
+                                     details2)
+    assert details2 == {}

@@ -164,11 +164,21 @@ def test_kill_switch_parity_dispatch_stats(tmp_path):
 
 
 def test_set_enabled_and_configure_rearm(tmp_path):
+    from paddle_tpu.runtime import diagnostics
+
     tracing.configure(str(tmp_path / "t"))
     assert tracing.enabled()
     assert tracing.set_enabled(False) is True
     assert not tracing.enabled()
-    assert tracing.span("x", "y") is tracing._NULL  # one falsy check path
+    # file tracing off, but the flight-recorder tap (diagnostics, on by
+    # default) still consumes spans — only with BOTH layers off does
+    # span() collapse to the shared null span (the one-falsy-check path)
+    prev_diag = diagnostics.set_enabled(False)
+    try:
+        assert tracing.span("x", "y") is tracing._NULL
+    finally:
+        diagnostics.set_enabled(prev_diag)
+    assert tracing.span("x", "y") is not tracing._NULL  # tap re-armed
     tracing.set_enabled(True)
     assert tracing.enabled()
     tracing.set_enabled(False)

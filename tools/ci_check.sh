@@ -77,14 +77,17 @@ JAX_PLATFORMS=cpu python -m pytest \
     -q -m slow -p no:cacheprovider -p no:xdist -p no:randomly
 
 echo "== fusion parity slice (model families under PADDLE_TPU_EAGER_FUSION=1) =="
-# ROADMAP item 2's flip-the-default gate grows here: a representative
-# eager-path slice (transformer/gpt generate + autograd + op math +
-# fusion + amp) must pass with deferred execution ON. Parity gaps get
-# a skip-with-reason in the test and an entry in ROADMAP — never a
-# silent drop from this list.
+# ROADMAP item 2's flip-the-default gate grows here: the eager-path
+# slice now covers EVERY model family — transformer/gpt generate +
+# autograd + op math + fusion + amp (the original slice) plus vision
+# ops, rnn/layer sweeps, and quantization — and must pass with
+# deferred execution ON. Parity gaps get a skip-with-reason in the
+# test and an entry in ROADMAP — never a silent drop from this list.
 JAX_PLATFORMS=cpu PADDLE_TPU_EAGER_FUSION=1 python -m pytest \
     tests/test_transformer_models.py tests/test_autograd.py \
     tests/test_ops_math.py tests/test_fusion.py tests/test_amp.py \
+    tests/test_vision_ops.py tests/test_nn_layers.py \
+    tests/test_layer_sweep.py tests/test_quantization.py \
     -q -m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly
 
 echo "== telemetry smoke (event stream + prom export + schema gate) =="
@@ -92,6 +95,15 @@ echo "== telemetry smoke (event stream + prom export + schema gate) =="
 # counters reconcile exactly with dispatch_stats()/fault_events(), and
 # the metric/event schema must match the checked-in telemetry_schema.json
 JAX_PLATFORMS=cpu python tools/telemetry_smoke.py
+
+echo "== diagnostics smoke (flight recorder + bundles + statusz) =="
+# the crash-and-hang layer: a watchdog stall must dump a postmortem
+# bundle (all-thread stacks + dispatch/fusion stats + contiguous
+# flight-recorder tail), /statusz + /metrics must serve well-formed
+# live data DURING a real fit, and a bench campaign child killed at
+# its per-config deadline must leave a bundle the orchestrator
+# ingests into the round payload (evidence instead of rc=124)
+JAX_PLATFORMS=cpu python tools/diagnostics_smoke.py
 
 echo "== trace smoke (span timeline + reconciliation + cluster merge) =="
 # a tiny fit under PADDLE_TPU_TRACE must emit a Perfetto-loadable

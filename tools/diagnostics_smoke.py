@@ -1,0 +1,297 @@
+#!/usr/bin/env python
+"""Diagnostics smoke (tools/ci_check.sh): the crash-and-hang layer
+proven over fresh subprocesses, the way a dying bench child or a
+wedged trainer would actually exercise it.
+
+Three stages:
+
+**Stall-triggered dump.** A child arms an ElasticManager watchdog with
+a sub-second timeout and never ticks; the ``no_heartbeat`` stall must
+write a postmortem bundle containing all-thread stacks, live
+``dispatch_stats()`` (incl. the fusion section), and a contiguous
+flight-recorder tail.
+
+**Statusz round trip.** A child runs a real ``Model.fit`` with
+``PADDLE_TPU_STATUSZ=0`` (ephemeral port, loopback); the parent
+discovers the bound port from the diagnostics dir and scrapes
+``/statusz`` + ``/metrics`` + ``/flightrecorder`` DURING the fit —
+every response must be well-formed and must eventually show live data
+(dispatch hits, step histogram counts).
+
+**Deadline-kill acceptance.** A bench campaign-runner child
+(``bench.py --campaign-config``, fake CONFIGS with a config that
+wedges after real dispatch traffic) is SIGTERMed exactly the way the
+orchestrator's per-config deadline kills it. The child must die with
+rc = -SIGTERM *and leave a bundle* whose stacks/dispatch+fusion
+stats/flight tail are all present, and the orchestrator-side
+ingestion (`bench._collect_child_diagnostics`) must surface
+``<name>_bundle_path`` + ``<name>_flight_tail`` into the round
+payload — the ISSUE-14 acceptance criterion: a deadline-killed config
+leaves evidence instead of ``rc=124``.
+
+Usage: python tools/diagnostics_smoke.py           (run all stages)
+       python tools/diagnostics_smoke.py --fit-child  (internal)
+"""
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TESTS = os.path.join(REPO, "tests")
+
+
+def _fail(msg):
+    print(f"diagnostics_smoke: FAIL — {msg}", file=sys.stderr)
+    sys.exit(1)
+
+
+def _read_bundle(diag_dir, reason_contains):
+    names = sorted(n for n in os.listdir(diag_dir)
+                   if n.startswith("postmortem-") and n.endswith(".json"))
+    if not names:
+        _fail(f"no bundle in {diag_dir}")
+    path = os.path.join(diag_dir, names[-1])
+    if os.path.getsize(path) > 1024 * 1024:
+        _fail(f"bundle over the default size bound: {path}")
+    with open(path) as f:
+        b = json.load(f)
+    if reason_contains not in b.get("reason", ""):
+        _fail(f"bundle reason {b.get('reason')!r} lacks "
+              f"{reason_contains!r}")
+    if not b.get("stacks"):
+        _fail("bundle has no all-thread stacks")
+    ds = b.get("dispatch")
+    if not ds or ds["forward"]["hits"] < 1:
+        _fail("bundle dispatch_stats missing or has no traffic")
+    if "fusion" not in ds:
+        _fail("bundle dispatch_stats lacks the fusion section")
+    tail = (b.get("flight_recorder") or {}).get("tail") or []
+    if not tail:
+        _fail("bundle has no flight-recorder tail")
+    seqs = [r["seq"] for r in tail]
+    if seqs != list(range(seqs[0], seqs[0] + len(seqs))):
+        _fail(f"flight tail not contiguous: {seqs[:10]}...")
+    return path, b
+
+
+# ---------------------------------------------------------------------------
+# stage 1: stall-triggered dump
+
+def stage_stall(tmp):
+    diag = os.path.join(tmp, "stall")
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PADDLE_TPU_DIAGNOSTICS_DIR=diag,
+               PADDLE_TPU_FLIGHT_FLUSH_EVERY="1")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(TESTS, "_diagnostics_child.py"),
+         "stall"],
+        env=env, cwd=REPO, capture_output=True, timeout=180)
+    if proc.returncode != 0:
+        _fail("stall child rc="
+              f"{proc.returncode}: {proc.stderr.decode()[-800:]}")
+    path, b = _read_bundle(diag, "watchdog_stall")
+    if b["extra"]["reason"] != "no_heartbeat":
+        _fail(f"unexpected stall reason {b['extra']}")
+    print(f"  stall dump OK: {os.path.basename(path)} "
+          f"({len(b['stacks'])} threads, "
+          f"{len(b['flight_recorder']['tail'])} flight records)")
+
+
+# ---------------------------------------------------------------------------
+# stage 2: statusz round trip during a real fit
+
+def _fit_child():
+    sys.path.insert(0, REPO)
+    import numpy as np
+
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu.runtime import diagnostics
+
+    assert diagnostics.statusz_address() is not None, \
+        "PADDLE_TPU_STATUSZ must have started the server at import"
+    rng = np.random.RandomState(0)
+    x = rng.rand(256, 4).astype(np.float32)
+    y = (x @ rng.rand(4, 1).astype(np.float32)).astype(np.float32)
+    net = nn.Linear(4, 1)
+    model = paddle.Model(net)
+    model.prepare(paddle.optimizer.Adam(0.05, parameters=net.parameters()),
+                  nn.MSELoss())
+    cbs = [paddle.callbacks.TelemetryCallback(export_every=4)]
+    model.fit([x, y], epochs=6, batch_size=16, verbose=0, callbacks=cbs)
+    # hold the server up until the parent finishes scraping
+    stop = os.path.join(diagnostics.diagnostics_dir(), "stop")
+    with open(os.path.join(diagnostics.diagnostics_dir(), "fit_done"),
+              "w") as f:
+        f.write("1")
+    deadline = time.time() + 60
+    while not os.path.exists(stop) and time.time() < deadline:
+        time.sleep(0.1)
+    return 0
+
+
+def _get(addr, route, timeout=5):
+    with urllib.request.urlopen(f"http://{addr}{route}",
+                                timeout=timeout) as r:
+        return r.read()
+
+
+def stage_statusz(tmp):
+    diag = os.path.join(tmp, "statusz")
+    os.makedirs(diag, exist_ok=True)
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PADDLE_TPU_DIAGNOSTICS_DIR=diag,
+               PADDLE_TPU_STATUSZ="0")
+    proc = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--fit-child"],
+        env=env, cwd=REPO, stdout=subprocess.DEVNULL,
+        stderr=subprocess.PIPE)
+    addr = None
+    try:
+        deadline = time.time() + 120
+        while addr is None and time.time() < deadline:
+            ports = [n for n in (os.listdir(diag) if os.path.isdir(diag)
+                                 else [])
+                     if n.startswith("statusz-") and n.endswith(".port")]
+            if ports:
+                with open(os.path.join(diag, ports[0])) as f:
+                    addr = f.read().strip()
+                break
+            if proc.poll() is not None:
+                _fail("fit child died before statusz: "
+                      + proc.stderr.read().decode()[-800:])
+            time.sleep(0.1)
+        if addr is None:
+            _fail("statusz port file never appeared")
+        # scrape DURING the fit until live data shows. Every response
+        # that ARRIVES must be well-formed JSON (a half-updated
+        # registry must never produce a torn document — json.loads
+        # raising fails the smoke); connection-level noise while the
+        # child is inside a first-step XLA compile is retried, bounded
+        live = False
+        scrapes = 0
+        conn_errors = 0
+        while time.time() < deadline:
+            try:
+                doc = json.loads(_get(addr, "/statusz"))
+                json.loads(_get(addr, "/flightrecorder?n=10"))
+                metrics = _get(addr, "/metrics").decode()
+            except (ConnectionError, OSError):
+                conn_errors += 1
+                if conn_errors > 20:
+                    _fail("statusz unreachable 20 times in a row")
+                time.sleep(0.3)
+                continue
+            conn_errors = 0
+            scrapes += 1
+            tel = ((doc.get("summary") or {}).get("telemetry") or {})
+            if tel.get("step_count", 0) >= 1 and \
+                    "paddle_tpu_step_seconds" in metrics and \
+                    doc["flight_recorder"]["recorded"] >= 1:
+                live = True
+                if os.path.exists(os.path.join(diag, "fit_done")):
+                    break
+            if proc.poll() is not None and \
+                    os.path.exists(os.path.join(diag, "fit_done")):
+                break
+            time.sleep(0.2)
+        if not live:
+            _fail("statusz never served live fit data")
+        stacks = json.loads(_get(addr, "/stacks"))
+        if not stacks.get("stacks"):
+            _fail("/stacks empty")
+    finally:
+        with open(os.path.join(diag, "stop"), "w") as f:
+            f.write("1")
+        try:
+            proc.wait(timeout=60)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+        proc.stderr.close()
+    if proc.returncode != 0:
+        _fail(f"fit child rc={proc.returncode}")
+    print(f"  statusz OK: {scrapes} live scrapes of "
+          f"/statusz+/metrics+/flightrecorder at {addr}")
+
+
+# ---------------------------------------------------------------------------
+# stage 3: deadline-killed campaign child leaves evidence
+
+def stage_deadline_kill(tmp):
+    out_dir = os.path.join(tmp, "bench_state")
+    os.makedirs(out_dir, exist_ok=True)
+    diag = os.path.join(out_dir, "diagnostics", "hang")
+    env = dict(os.environ, JAX_PLATFORMS="cpu", BENCH_FORCE_CPU="1",
+               BENCH_CONFIGS_MODULE="_diag_bench_configs",
+               PYTHONPATH=TESTS + os.pathsep
+               + os.environ.get("PYTHONPATH", ""),
+               PADDLE_TPU_DIAGNOSTICS_DIR=diag,  # as the orchestrator sets
+               PADDLE_TPU_FLIGHT_FLUSH_EVERY="1")
+    proc = subprocess.Popen(
+        [sys.executable, os.path.join(REPO, "bench.py"),
+         "--campaign-config", "hang", "--out-dir", out_dir,
+         "--deadline-ts", str(time.time() + 600)],
+        env=env, cwd=REPO, stdout=subprocess.DEVNULL,
+        stderr=subprocess.PIPE)
+    try:
+        marker = os.path.join(out_dir, "hang.started")
+        deadline = time.time() + 120
+        while not os.path.exists(marker):
+            if proc.poll() is not None:
+                _fail("campaign child died before .started: "
+                      + proc.stderr.read().decode()[-800:])
+            if time.time() > deadline:
+                _fail("campaign child never wrote .started")
+            time.sleep(0.1)
+        time.sleep(1.0)  # let the wedge loop record a few flight rows
+        # the orchestrator's per-config deadline action, verbatim
+        proc.terminate()
+        proc.wait(timeout=60)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+        proc.stderr.close()
+    if proc.returncode != -signal.SIGTERM:
+        _fail(f"expected rc={-signal.SIGTERM}, got {proc.returncode}")
+    path, b = _read_bundle(diag, "signal_SIGTERM")
+    # the orchestrator-side ingestion: payload keys for the round
+    sys.path.insert(0, REPO)
+    try:
+        import bench
+    finally:
+        sys.path.pop(0)
+    details = {}
+    bench._collect_child_diagnostics(diag, "hang", details)
+    if details.get("hang_bundle_path") != path:
+        _fail(f"ingestion missed the bundle: {details}")
+    if not details.get("hang_flight_tail"):
+        _fail("ingestion missed the flight tail")
+    print(f"  deadline kill OK: bundle {os.path.basename(path)} "
+          f"+ {len(details['hang_flight_tail'])}-record flight tail "
+          "ingested into the round payload")
+
+
+def main():
+    import tempfile
+
+    with tempfile.TemporaryDirectory(prefix="diag_smoke_") as tmp:
+        print("diagnostics_smoke: stage 1 — stall-triggered dump")
+        stage_stall(tmp)
+        print("diagnostics_smoke: stage 2 — statusz round trip")
+        stage_statusz(tmp)
+        print("diagnostics_smoke: stage 3 — deadline-killed campaign "
+              "child")
+        stage_deadline_kill(tmp)
+    print("diagnostics_smoke: OK")
+
+
+if __name__ == "__main__":
+    if "--fit-child" in sys.argv:
+        sys.exit(_fit_child())
+    main()

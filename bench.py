@@ -802,6 +802,129 @@ def bench_dataloader(n=512, batch=64, shape=(3, 224, 224), epochs=3):
     return res
 
 
+def bench_input_pipeline(n=256, batch=16, feat=64, hidden=768,
+                         delay_ms=3.0, reps=2):
+    """Input-pipeline A/B on a DATA-BOUND workload (CPU-runnable): a
+    throttled synthetic dataset (a fixed per-batch host delay models
+    decode/augment/IO cost) driven through `Model.fit`, synchronous
+    `next()` vs the `DevicePrefetcher` double-buffered device staging
+    (io/prefetch.py). Arms run interleaved best-of-N so ambient noise
+    hits both equally. Persists the data-wait SHARE of step time per
+    arm (the `paddle_tpu_data_wait_seconds` histogram the win was
+    instrumented for), the h2d/overlap counters, loss-trajectory
+    bit-equality, and a `*_phase_s` span decomposition of the prefetch
+    arm. Pinned to the CPU backend (the contended resource here is the
+    HOST, and the number must land even on a dead TPU tunnel)."""
+    import jax
+
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu.io import prefetch as _prefetch
+    from paddle_tpu.runtime import telemetry as _telemetry
+    from paddle_tpu.runtime import tracing as _tracing
+
+    per_item = delay_ms * 1e-3 / batch
+
+    class Throttled(paddle.io.Dataset):
+        rng = np.random.RandomState(0)
+        xs = rng.rand(n, feat).astype(np.float32)
+        ys = rng.rand(n, 1).astype(np.float32)
+
+        def __len__(self):
+            return n
+
+        def __getitem__(self, i):
+            time.sleep(per_item)  # the modeled host-side per-item cost
+            return self.xs[i], self.ys[i]
+
+    def _mk_model():
+        paddle.seed(0)
+        net = nn.Sequential(nn.Linear(feat, hidden), nn.Tanh(),
+                            nn.Linear(hidden, hidden), nn.Tanh(),
+                            nn.Linear(hidden, 1))
+        model = paddle.Model(net)
+        model.prepare(paddle.optimizer.SGD(0.01,
+                                           parameters=net.parameters()),
+                      nn.MSELoss())
+        return model
+
+    def _hist_sum(name):
+        fam = _telemetry.snapshot().get(name) or {}
+        series = fam.get("series") or [{}]
+        return float(series[0].get("sum", 0.0))
+
+    ds = Throttled()
+
+    def run_arm(prefetch_on):
+        model = _mk_model()
+        losses = []
+
+        class _Rec(paddle.callbacks.Callback):
+            def on_train_batch_end(self, step, logs=None):
+                losses.append(logs["loss"])
+
+        dw0 = _hist_sum("paddle_tpu_data_wait_seconds")
+        h0 = _hist_sum("paddle_tpu_h2d_seconds")
+        t0 = time.perf_counter()
+        model.fit(ds, epochs=1, batch_size=batch, shuffle=False,
+                  verbose=0, prefetch=prefetch_on, callbacks=[_Rec()])
+        dt = time.perf_counter() - t0
+        return {"wall_s": dt,
+                "data_wait_s": _hist_sum(
+                    "paddle_tpu_data_wait_seconds") - dw0,
+                "h2d_s": _hist_sum("paddle_tpu_h2d_seconds") - h0,
+                "losses": losses}
+
+    best = {}
+    loss_traces = {}
+    # PROCESS-wide CPU pin (jax.config, not the thread-local
+    # jax.default_device context): the DevicePrefetcher commits batches
+    # on its own producer thread, which a with-block would never cover —
+    # on a live-TPU host that thread would otherwise commit to TPU
+    # against CPU-resident params
+    prev_dev = jax.config.jax_default_device
+    jax.config.update("jax_default_device", jax.devices("cpu")[0])
+    try:
+        run_arm(False)  # warm: compile the fused step, outside the A/B
+        for _rep in range(max(1, reps)):
+            for arm, flag in (("sync", False), ("prefetch", True)):
+                r = run_arm(flag)
+                loss_traces.setdefault(arm, r["losses"])
+                if arm not in best or r["wall_s"] < best[arm]["wall_s"]:
+                    best[arm] = r
+
+        def _phase_pass():
+            run_arm(True)
+
+        phase_s = _span_phases(_tracing, _phase_pass)
+    finally:
+        jax.config.update("jax_default_device", prev_dev)
+
+    steps = (n + batch - 1) // batch
+    res = {}
+    for arm in ("sync", "prefetch"):
+        b = best[arm]
+        res[f"input_pipeline_{arm}_steps_per_sec"] = steps / b["wall_s"]
+        res[f"input_pipeline_{arm}_data_wait_s"] = round(
+            b["data_wait_s"], 6)
+        res[f"input_pipeline_{arm}_data_wait_share"] = round(
+            b["data_wait_s"] / b["wall_s"], 6)
+        res[f"input_pipeline_{arm}_h2d_s"] = round(b["h2d_s"], 6)
+    res["input_pipeline_speedup"] = (best["sync"]["wall_s"]
+                                     / best["prefetch"]["wall_s"])
+    sync_share = res["input_pipeline_sync_data_wait_share"]
+    pf_share = res["input_pipeline_prefetch_data_wait_share"]
+    res["input_pipeline_data_wait_cut"] = (
+        sync_share / pf_share if pf_share > 0 else None)
+    res["input_pipeline_loss_bit_exact"] = (
+        loss_traces["sync"] == loss_traces["prefetch"])
+    st = _prefetch.prefetch_stats()
+    res["input_pipeline_overlap_ratio"] = st["overlap_ratio"]
+    res["input_pipeline_prefetch_stalls"] = st["stalls"]
+    res["input_pipeline_phase_s"] = phase_s
+    return res
+
+
 def bench_bert_b64(batch=64, seq=128, steps=30, warmup=5):
     """Batch-scaling A/B of the headline: PERF_ESTIMATES puts b32/s128
     at arithmetic intensity ~45 FLOP/byte (bandwidth-leaning on v5e);
@@ -973,6 +1096,15 @@ CONFIGS = {
     "serve_decode": (bench_serve_decode,
                      {"requests": 4, "prompt": 4, "new_tokens": 4,
                       "token_budget": 8}, 240),
+    # the async-input-pipeline A/B (sync next() vs double-buffered
+    # device staging) on a deliberately data-bound workload: also
+    # CPU-pinned-cheap, survives a dead tunnel
+    # sized (like tools/data_smoke.py) so one step's compute covers one
+    # batch's host cost — the regime where double buffering can hide
+    # the input pipeline entirely
+    "input_pipeline": (bench_input_pipeline,
+                       {"n": 64, "batch": 8, "hidden": 512,
+                        "delay_ms": 1.0, "reps": 1}, 240),
     "lenet": (bench_lenet, {"batch": 8, "steps": 2, "warmup": 1}, 420),
     "bert": (bench_bert, {"batch": 2, "seq": 32, "steps": 2, "warmup": 1},
              900),

@@ -410,10 +410,62 @@ class Model:
         return DataLoader(ds, batch_size=batch_size, shuffle=shuffle,
                           num_workers=num_workers, drop_last=drop_last)
 
+    def _wrap_prefetch(self, loader, prefetch):
+        """Wrap `iter(loader)` in a `DevicePrefetcher` (io/prefetch.py)
+        so batches are committed to device on a background thread while
+        the current step computes — the async input pipeline ROADMAP
+        item 4 plans. `prefetch=None` defers to the
+        ``PADDLE_TPU_DATA_PREFETCH`` env switch (default on; the
+        data_smoke CI gate holds the path loss-bit-exact vs sync).
+        Returns (iterator, prefetcher-or-None) — the caller owns
+        close(). A `DistributedBatchSampler`-driven loader under a
+        'dp' mesh gets the sharded tier: each host commits only its
+        local rows, assembled into NamedSharding global arrays."""
+        from ..io import prefetch as _prefetch
+        from ..io.sampler import DistributedBatchSampler
+
+        on = prefetch if prefetch is not None else \
+            _prefetch.prefetch_enabled()
+        if not on:
+            return iter(loader), None
+        sharding = None
+        wrap = False
+        src = loader
+        if isinstance(loader, DataLoader) and \
+                isinstance(getattr(loader, "batch_sampler", None),
+                           DistributedBatchSampler):
+            from ..distributed import env as _env
+
+            mesh = _env.get_mesh()
+            if mesh is not None and "dp" in mesh.axis_names and \
+                    mesh.shape["dp"] > 1:
+                sharding = "dp"
+                from ..io.dataloader import (
+                    default_collate_fn, numpy_collate_or_default,
+                )
+
+                if loader.collate_fn is default_collate_fn:
+                    # collate to RAW numpy for the sharded tier: the
+                    # default collate's eager Tensor construction would
+                    # commit each leaf to the local device only for the
+                    # global assembly to haul it back — numpy in, ONE
+                    # host→device commit per leaf out
+                    src = DataLoader(
+                        loader.dataset,
+                        batch_sampler=loader.batch_sampler,
+                        num_workers=loader.num_workers,
+                        collate_fn=numpy_collate_or_default,
+                        timeout=loader.timeout)
+                    wrap = True
+        pf = _prefetch.DevicePrefetcher(
+            iter(src), timeout=getattr(loader, "timeout", 0) or None,
+            sharding=sharding, wrap_tensors=wrap)
+        return iter(pf), pf
+
     def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
             eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
             drop_last=False, shuffle=True, num_workers=0, callbacks=None,
-            accumulate_grad_batches=1, num_iters=None):
+            accumulate_grad_batches=1, num_iters=None, prefetch=None):
         loader = self._make_loader(train_data, batch_size, shuffle,
                                    num_workers, drop_last)
         eval_loader = self._make_loader(eval_data, batch_size, False,
@@ -444,36 +496,43 @@ class Model:
             logs = {}
             # manual iteration so the loader's next() is measurable:
             # "step time waiting on data" is the input-pipeline gauge
-            # ROADMAP item 4 needs before async staging can prove a win
-            data_iter = iter(loader)
+            # the async staging below must drive toward zero. With the
+            # prefetcher on, next() pops an already-device-committed
+            # batch staged while the PREVIOUS step computed.
+            data_iter, pf = self._wrap_prefetch(loader, prefetch)
             step = 0
-            while True:
-                w0 = time.time()
-                t0 = time.perf_counter()
-                try:
-                    batch = next(data_iter)
-                except StopIteration:
-                    break
-                self._note_data_wait(time.perf_counter() - t0, w0)
-                cbks.on_batch_begin("train", step, logs)
-                xs, ys = self._split_batch(batch)
-                with _tracing.span("train_batch", "compute",
-                                   epoch=epoch, step=step):
-                    res = self.train_batch(xs, ys,
-                                           update=(step + 1) % acc_k == 0)
-                logs = self._res_to_logs(res, step, batch_size)
-                with _tracing.span("callbacks", "callback"):
-                    cbks.on_batch_end("train", step, logs)
-                it += 1
-                step += 1
-                if num_iters is not None and it >= num_iters:
-                    self.stop_training = True
-                if self.stop_training:
-                    # honored PER BATCH, not just at epoch boundaries: a
-                    # callback stopping mid-epoch (ResilienceCallback
-                    # escalation/stall) must not grind through the rest
-                    # of a long or streaming epoch
-                    break
+            try:
+                while True:
+                    w0 = time.time()
+                    t0 = time.perf_counter()
+                    try:
+                        batch = next(data_iter)
+                    except StopIteration:
+                        break
+                    self._note_data_wait(time.perf_counter() - t0, w0)
+                    cbks.on_batch_begin("train", step, logs)
+                    xs, ys = self._split_batch(batch)
+                    with _tracing.span("train_batch", "compute",
+                                       epoch=epoch, step=step):
+                        res = self.train_batch(xs, ys,
+                                               update=(step + 1) % acc_k == 0)
+                    logs = self._res_to_logs(res, step, batch_size)
+                    with _tracing.span("callbacks", "callback"):
+                        cbks.on_batch_end("train", step, logs)
+                    it += 1
+                    step += 1
+                    if num_iters is not None and it >= num_iters:
+                        self.stop_training = True
+                    if self.stop_training:
+                        # honored PER BATCH, not just at epoch
+                        # boundaries: a callback stopping mid-epoch
+                        # (ResilienceCallback escalation/stall) must not
+                        # grind through the rest of a long or streaming
+                        # epoch
+                        break
+            finally:
+                if pf is not None:
+                    pf.close()
             sch = self._optimizer._learning_rate
             if hasattr(sch, "step") and not isinstance(sch, float) and \
                     not user_steps_lr:
@@ -482,7 +541,8 @@ class Model:
                 if not isinstance(sch, ReduceOnPlateau):
                     sch.step()
             if eval_loader is not None and (epoch + 1) % eval_freq == 0:
-                eval_logs = self._run_eval(eval_loader, cbks, batch_size)
+                eval_logs = self._run_eval(eval_loader, cbks, batch_size,
+                                           prefetch=prefetch)
                 logs.update({"eval_" + k: v for k, v in eval_logs.items()})
             cbks.on_epoch_end(epoch, logs)
         cbks.on_end("train", logs)
@@ -506,21 +566,26 @@ class Model:
             pass
         _tracing.emit_span("data_wait", "data", wall_start, seconds)
 
-    def _run_eval(self, loader, cbks, batch_size):
+    def _run_eval(self, loader, cbks, batch_size, prefetch=None):
         self._reset_metrics()
         cbks.on_begin("eval")
         logs = {}
-        for step, batch in enumerate(loader):
-            cbks.on_batch_begin("eval", step, logs)
-            xs, ys = self._split_batch(batch)
-            res = self.eval_batch(xs, ys)
-            logs = self._res_to_logs(res, step, batch_size)
-            cbks.on_batch_end("eval", step, logs)
+        data_iter, pf = self._wrap_prefetch(loader, prefetch)
+        try:
+            for step, batch in enumerate(data_iter):
+                cbks.on_batch_begin("eval", step, logs)
+                xs, ys = self._split_batch(batch)
+                res = self.eval_batch(xs, ys)
+                logs = self._res_to_logs(res, step, batch_size)
+                cbks.on_batch_end("eval", step, logs)
+        finally:
+            if pf is not None:
+                pf.close()
         cbks.on_end("eval", logs)
         return logs
 
     def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
-                 num_workers=0, callbacks=None):
+                 num_workers=0, callbacks=None, prefetch=None):
         loader = self._make_loader(eval_data, batch_size, False, num_workers,
                                    False)
         try:
@@ -531,7 +596,7 @@ class Model:
                                 steps=steps, log_freq=log_freq,
                                 verbose=verbose,
                                 metrics=self._metrics_name())
-        logs = self._run_eval(loader, cbks, batch_size)
+        logs = self._run_eval(loader, cbks, batch_size, prefetch=prefetch)
         out = {}
         if "loss" in logs:
             out["loss"] = logs["loss"]

@@ -92,6 +92,10 @@ class PagedKVCache:
         self._highwater = 0
         self._alloc_total = 0
         self._free_total = 0
+        # bumped on every table mutation (alloc/release): the engine
+        # keys its device-resident padded-tables cache on this, so
+        # steady-state decode steps skip the redundant H2D transfer
+        self._alloc_version = 0
         self._gauge = _telemetry.gauge(
             "paddle_tpu_serve_kv_blocks", "paged KV cache blocks",
             ("state",))
@@ -142,6 +146,7 @@ class PagedKVCache:
             for _ in range(missing):
                 table.append(heapq.heappop(self._free))
             self._alloc_total += missing
+            self._alloc_version += 1
             used = self.config.num_blocks - len(self._free)
             self._highwater = max(self._highwater, used)
             self._publish()
@@ -158,8 +163,14 @@ class PagedKVCache:
             for b in table:
                 heapq.heappush(self._free, b)
             self._free_total += len(table)
+            self._alloc_version += 1
             self._publish()
             return len(table)
+
+    def alloc_version(self):
+        """Monotonic table-mutation counter (see __init__ note)."""
+        with self._lock:
+            return self._alloc_version
 
     def block_table(self, request_id):
         with self._lock:

@@ -3,6 +3,7 @@ from .checkpoint import (  # noqa: F401
     CheckpointManager, abstract_state, load_checkpoint, save_checkpoint,
 )
 from .dataloader import DataLoader, default_collate_fn, get_worker_info  # noqa: F401
+from .prefetch import DevicePrefetcher, prefetch_stats  # noqa: F401
 from .dataset import (  # noqa: F401
     ChainDataset, ComposeDataset, ConcatDataset, Dataset, IterableDataset,
     Subset, TensorDataset, random_split,

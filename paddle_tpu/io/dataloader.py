@@ -13,6 +13,7 @@ import numpy as np
 
 from ..core.tensor import Tensor
 from ..runtime import tracing as _tracing
+from ..runtime.resilience import fault_point, record_fault
 from .dataset import IterableDataset
 from .sampler import BatchSampler
 
@@ -48,6 +49,21 @@ def _numpy_collate(batch):
     if isinstance(sample, dict):
         return {k: _numpy_collate([b[k] for b in batch]) for k in sample}
     return None  # not stageable (Tensors / arbitrary objects)
+
+
+def numpy_collate_or_default(batch):
+    """`_numpy_collate` when every leaf is numpy-able, else the normal
+    `default_collate_fn`. The sharded prefetch tier collates through
+    this so stageable batches stay HOST-side (one commit: local rows →
+    global array) while exotic samples keep today's semantics."""
+    import jax
+
+    out = _numpy_collate(batch)
+    leaves = jax.tree_util.tree_flatten(
+        out, is_leaf=lambda x: x is None)[0]
+    if out is None or not all(isinstance(a, np.ndarray) for a in leaves):
+        return default_collate_fn(batch)
+    return out
 
 
 class WorkerInfo:
@@ -185,32 +201,80 @@ class DataLoader:
 
     def _unstage_impl(self, jax, staged):
         views = self._pool.view_arrays(staged.slot, staged.meta)
-        # synchronous host copy before releasing: the CPU backend zero-copy
-        # ALIASES aligned buffers, and block_until_ready can return early on
-        # the axon tunnel — np.array is the only release barrier that holds
-        # on every backend. The copy runs at memcpy speed on slot-aligned
-        # memory and is what the device transfer consumes asynchronously.
-        tensors = [Tensor(np.array(v)) for v in views]
+        from . import prefetch as _prefetch
+
+        if _prefetch.staging_direct_ok():
+            # ONE copy, ring → device, barriered before the slot is
+            # recycled — opt-in per backend (see staging_direct_ok: the
+            # operator asserts block_until_ready is a real barrier
+            # there; the aliasing probe vetoes backends where the slot
+            # would alias live device memory). Shares the measured-h2d
+            # contract with every other commit site.
+            tensors = [Tensor(d) for d in _prefetch.commit_arrays(
+                views, kind="unstage_direct")]
+        else:
+            # synchronous host copy before releasing: the CPU backend
+            # zero-copy ALIASES aligned buffers, and block_until_ready can
+            # return early on the axon tunnel — np.array is the only release
+            # barrier that holds on every backend. The copy runs at memcpy
+            # speed on slot-aligned memory and is what the device transfer
+            # consumes asynchronously.
+            tensors = [Tensor(np.array(v)) for v in views]
         self._pool.release(staged.slot)
         return jax.tree_util.tree_unflatten(staged.treedef, tensors)
 
+    def _check_timeout(self, t0, batch):
+        """`timeout=` on the workerless path: a synchronous fetch
+        cannot be preempted, but one that overran the budget still
+        raises cleanly (with the fault event) instead of the timeout
+        being silently ignored without workers."""
+        if not self.timeout:
+            return
+        import time as _time
+
+        elapsed = _time.perf_counter() - t0
+        if elapsed > self.timeout:
+            record_fault("data_worker_timeout",
+                         f"single-process fetch of batch {batch} took "
+                         f"{elapsed:.3f}s (timeout {self.timeout}s)")
+            raise TimeoutError(
+                f"DataLoader fetch of batch {batch} exceeded "
+                f"timeout={self.timeout}s ({elapsed:.3f}s)")
+
     def _iter_single(self):
+        import time as _time
+
         if self._iterable:
             batch = []
+            n = 0
+            t0 = _time.perf_counter()
             for item in self.dataset:
                 batch.append(item)
                 if len(batch) == self.batch_size:
-                    yield self.collate_fn(batch)
+                    out = self.collate_fn(batch)
+                    self._check_timeout(t0, n)
+                    yield out
                     batch = []
+                    n += 1
+                    t0 = _time.perf_counter()
             if batch and not self.drop_last:
-                yield self.collate_fn(batch)
+                out = self.collate_fn(batch)
+                self._check_timeout(t0, n)
+                yield out
             return
         if self.batch_sampler is None:  # no auto-batching
             for i in range(len(self.dataset)):
-                yield self.dataset[i]
+                t0 = _time.perf_counter()
+                item = self.dataset[i]
+                self._check_timeout(t0, i)
+                yield item
             return
-        for indices in self.batch_sampler:
-            yield self._fetch(indices)
+        for n, indices in enumerate(self.batch_sampler):
+            t0 = _time.perf_counter()
+            fault_point("data.fetch", batch=n)
+            batch = self._fetch(indices)
+            self._check_timeout(t0, n)
+            yield batch
 
     def _iter_workers(self):
         """Thread pool keeps `num_workers * prefetch_factor` batches staged."""
@@ -244,12 +308,16 @@ class DataLoader:
                 except queue.Empty:
                     return
                 with cond:
+                    # plain wait, no poll: the consumer notify_all()s on
+                    # every yield and on teardown, so a 20 Hz wakeup per
+                    # idle worker bought nothing but scheduler noise
                     while i - next_to_yield[0] >= max_ahead and \
                             not stop.is_set():
-                        cond.wait(0.05)
+                        cond.wait()
                 if stop.is_set():
                     return
                 try:
+                    fault_point("data.worker_fetch", batch=i, worker=wid)
                     batch = (self._fetch_staged(indices)
                              if self.use_staging_pool
                              else self._fetch(indices))
@@ -284,8 +352,20 @@ class DataLoader:
                     while i not in out:
                         if init_err[0] is not None:
                             raise init_err[0]
-                        cond.wait(0.1)
-                        if deadline is not None and _time.time() > deadline:
+                        # producers notify_all() on every stored batch,
+                        # so an untimed wait needs no poll; with a
+                        # timeout, sleep exactly the remaining budget
+                        if deadline is None:
+                            cond.wait()
+                            continue
+                        remaining = deadline - _time.time()
+                        if remaining > 0:
+                            cond.wait(remaining)
+                        if i not in out and _time.time() > deadline:
+                            record_fault(
+                                "data_worker_timeout",
+                                f"batch {i} not produced within "
+                                f"{self.timeout}s")
                             raise TimeoutError(
                                 f"DataLoader worker timed out after "
                                 f"{self.timeout}s waiting for batch {i}")

@@ -1,0 +1,607 @@
+"""Async input pipeline: double-buffered device staging (ROADMAP item 4).
+
+`Model.fit` gained the `paddle_tpu_data_wait_seconds` histogram and
+`data/data_wait` spans in PR 12 precisely so this module's win would be
+measurable before it was built: until now the loader's `next()` ran
+synchronously inside the step loop — host-side fetch/collate AND the
+device commit serialized after step k-1's compute instead of hiding
+under it. `DevicePrefetcher` is the record-now-execute-later principle
+applied to input (the same bet trace fusion makes for ops): a
+background thread pulls batches from any iterator, commits every leaf
+to device memory (async `device_put` + a transfer barrier ON the
+producer thread), and parks a bounded window of device-resident
+batches — depth 2 = classic double buffering — so the consumer's
+`next()` is a queue pop, not a pipeline.
+
+Three tiers, composing:
+
+* **Thread prefetch + device commit** (`DevicePrefetcher`): works over
+  any batch iterator (a `DataLoader`, a generator, a list). H2D time is
+  measured per batch into the ``paddle_tpu_h2d_seconds`` histogram and
+  an ``io/h2d`` span from the SAME measurement (the PR-12
+  reconciliation contract — `tracing.reconcile_with_metrics` holds the
+  pair to exact agreement).
+* **Staging-ring direct consume** (`staging_direct_ok`): the csrc/
+  staging ring's slot views can feed `jax.device_put` directly — one
+  copy, ring → device — behind an EXPLICIT per-backend opt-in
+  (``PADDLE_TPU_STAGING_DIRECT=1``): the operator asserts
+  `block_until_ready` truly barriers transfers on that backend (no
+  cheap probe can — it returns early on the axon tunnel). A one-shot
+  aliasing probe (device_put an aligned buffer, scribble on it, read
+  the device value back) VETOES opt-ins on backends that zero-copy
+  alias aligned host memory (XLA CPU). Default: today's `np.array`
+  release barrier, which holds everywhere.
+* **DP-sharded global assembly** (`sharding="dp"`): with a device mesh
+  installed, each host loads only its `DistributedBatchSampler` rows
+  and the commit step assembles the GLOBAL batch via
+  `jax.make_array_from_process_local_data` — process-local data in, a
+  NamedSharding-annotated global array out, so no host ever
+  materializes (or transfers) the world-size-redundant global batch.
+
+Degrade matrix (observable, never wedging — the PR-3 contract):
+
+* producer thread dies without a word (crash, injected kill) →
+  consumer notices via thread liveness, records a
+  ``data_producer_died`` fault event, and degrades to synchronous
+  pulls on its own thread (at most the one in-flight batch is lost);
+* producer raises → the exception surfaces at the consumer's `next()`
+  exactly as it would have synchronously;
+* `timeout=` exceeded waiting on a stalled producer →
+  ``data_worker_timeout`` fault event + `TimeoutError`;
+* thread creation impossible / sharded assembly rejects a batch →
+  synchronous / replicated fallback, counted in `prefetch_stats()`.
+
+Import-weight contract: numpy + stdlib at import; jax only inside
+methods (the io package must import on hosts without a backend).
+"""
+from __future__ import annotations
+
+import os
+import queue
+import threading
+import time
+import weakref
+
+import numpy as np
+
+from ..runtime import telemetry as _telemetry
+from ..runtime import tracing as _tracing
+from ..runtime.resilience import fault_point, record_fault
+
+__all__ = [
+    "DevicePrefetcher", "prefetch_stats", "reset_prefetch_stats",
+    "commit_arrays", "staging_direct_ok", "prefetch_enabled",
+    "prefetch_depth", "note_h2d",
+]
+
+# fine buckets: H2D commits are sub-millisecond for small batches but
+# the tail (global-batch assembly, first-touch allocation) matters
+_H2D_BUCKETS = (1e-5, 5e-5, 1e-4, 2.5e-4, 5e-4, 1e-3, 2.5e-3, 5e-3,
+                1e-2, 2.5e-2, 5e-2, 0.1, 0.25, 0.5, 1.0, 2.5)
+
+_FALSY = ("0", "false", "no", "off")
+
+
+def prefetch_enabled(default=True):
+    """The `PADDLE_TPU_DATA_PREFETCH` switch (default ON: the parity
+    gate — tools/data_smoke.py — holds the prefetch path loss-bit-exact
+    vs synchronous consumption, so there is no correctness reason to
+    leave the overlap on the table)."""
+    raw = os.environ.get("PADDLE_TPU_DATA_PREFETCH", "").strip().lower()
+    if not raw:
+        return default
+    return raw not in _FALSY
+
+
+def prefetch_depth(default=2):
+    """`PADDLE_TPU_DATA_PREFETCH_DEPTH` (default 2 — double buffering:
+    one batch feeding step k, one committing for step k+1)."""
+    try:
+        return max(1, int(os.environ.get("PADDLE_TPU_DATA_PREFETCH_DEPTH",
+                                         default)))
+    except ValueError:
+        return default
+
+
+# ---------------------------------------------------------------------------
+# h2d measurement (histogram + span from the SAME numbers)
+
+def note_h2d(seconds, wall_start, nbytes=0, kind="prefetch"):
+    """One batch's host→device commit: `paddle_tpu_h2d_seconds`
+    histogram + an ``io/h2d`` span emitted from the same measured
+    duration, so the span sum and the histogram sum can never tell
+    different stories (`tracing.reconcile_with_metrics` checks)."""
+    try:
+        _telemetry.histogram(
+            "paddle_tpu_h2d_seconds",
+            "per-batch host-to-device commit time (device_put + "
+            "transfer barrier)", buckets=_H2D_BUCKETS).observe(seconds)
+    except Exception:  # noqa: BLE001 — telemetry must never kill input
+        pass
+    _tracing.emit_span("h2d", "io", wall_start, seconds,
+                       bytes=int(nbytes), kind=kind)
+
+
+def commit_arrays(arrays, kind="step_inputs"):
+    """Device-commit a list of host ndarrays (pass-through for values
+    already on device), blocking until the transfer lands, with the
+    h2d measurement. The serving engine stages its per-step ragged
+    inputs through this so training and serving share ONE h2d lane."""
+    import jax
+
+    w0 = time.time()
+    t0 = time.perf_counter()
+    out, nbytes = [], 0
+    for a in arrays:
+        if isinstance(a, jax.Array):
+            out.append(a)
+        else:
+            a = np.asarray(a)
+            nbytes += a.nbytes
+            out.append(jax.device_put(a))
+    jax.block_until_ready(out)
+    note_h2d(time.perf_counter() - t0, w0, nbytes, kind=kind)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# staging-ring direct consume: is device_put a real copy here?
+
+_direct = [None]  # None = unprobed; probed once per process
+
+
+def _device_put_aliases_host():
+    """Probe whether `jax.device_put` of a 64-byte-aligned host buffer
+    (exactly the shape of a staging-ring slot view) ALIASES the source
+    instead of copying. XLA's CPU client zero-copies aligned numpy
+    memory — on such a backend the staging slot must be host-copied
+    before release or the ring would scribble over live device data."""
+    import ctypes
+
+    import jax
+
+    try:
+        raw = ctypes.create_string_buffer(256 + 64)
+        addr = ctypes.addressof(raw)
+        off = (-addr) % 64
+        view = np.frombuffer(
+            (ctypes.c_char * 256).from_address(addr + off),
+            dtype=np.float32)
+        view[:] = 1.0
+        dev = jax.device_put(view)
+        jax.block_until_ready(dev)
+        view[:] = 2.0
+        return bool(np.asarray(dev)[0] == 2.0)
+    except Exception:  # noqa: BLE001 — unprobeable backend
+        return True  # assume the worst: keep the copy release barrier
+
+
+def staging_direct_ok():
+    """True when the staging ring's slot views may feed `device_put`
+    directly (one copy, ring → device) and be released after a
+    `block_until_ready` barrier.
+
+    EXPLICIT opt-in only (`PADDLE_TPU_STAGING_DIRECT=1`): the aliasing
+    probe can prove `device_put` copies, but it cannot prove
+    `block_until_ready` is a real transfer barrier — on the axon
+    tunnel it is known to return early, and a 256-byte probe transfer
+    completes before any scribble could catch that. So the operator
+    asserts the barrier (per backend, validated on real hardware — the
+    ROADMAP item-4 TPU tail), and the probe only VETOES an opt-in that
+    would corrupt data outright (aliasing backends: the slot would be
+    recycled under live device memory). Default, or =0: the `np.array`
+    host-copy release barrier, which holds everywhere."""
+    if _direct[0] is None:
+        raw = os.environ.get("PADDLE_TPU_STAGING_DIRECT", "").strip().lower()
+        want = bool(raw) and raw not in _FALSY
+        _direct[0] = want and not _device_put_aliases_host()  # threadlint: ok[CL007] idempotent one-shot probe: a racing duplicate computes the same value
+    return _direct[0]
+
+
+# ---------------------------------------------------------------------------
+# process-wide prefetcher accounting (profiler.summary + /statusz)
+
+_stats_lock = threading.Lock()
+
+
+def _zero_totals():
+    return {
+        "prefetchers": 0,     # DevicePrefetchers ever created
+        "active": 0,          # currently open
+        "depth": 0,           # most recent configured depth
+        "batches": 0,         # batches delivered to consumers
+        "stalls": 0,          # consumer waits > 1ms on an empty queue
+        "stall_s": 0.0,       # total consumer wait
+        "src_s": 0.0,         # producer time pulling from the source
+        "h2d_s": 0.0,         # producer time committing to device
+        "h2d_bytes": 0,
+        "sharded_batches": 0,  # committed as global (NamedSharding) arrays
+        "shard_fallbacks": 0,  # global assembly rejected → replicated put
+        "producer_deaths": 0,  # silent producer death, degraded to sync
+        "sync_fallbacks": 0,   # batches served by the degraded sync path
+    }
+
+
+_TOTALS = _zero_totals()
+
+
+def prefetch_stats():
+    """Process-wide prefetcher counters (depth, stalls, overlap ratio)
+    — the `dispatch_stats()`-style snapshot `profiler.summary` and the
+    /statusz route surface. ``overlap_ratio`` is the share of input-
+    pipeline work (source pulls + device commits) hidden from the
+    consumer: 1.0 = the step loop never waited, 0.0 = fully serial."""
+    with _stats_lock:
+        out = dict(_TOTALS)
+    busy = out["src_s"] + out["h2d_s"]
+    out["overlap_ratio"] = (max(0.0, min(1.0, 1.0 - out["stall_s"] / busy))
+                            if busy > 0 else None)
+    return out
+
+
+def reset_prefetch_stats():
+    with _stats_lock:
+        _TOTALS.clear()
+        _TOTALS.update(_zero_totals())
+
+
+def _bump(**kv):
+    with _stats_lock:
+        for k, v in kv.items():
+            _TOTALS[k] = _TOTALS.get(k, 0) + v
+
+
+def _publish_gauges():
+    """Mirror the aggregate into the metrics registry (dashboards); the
+    authoritative numbers stay in `prefetch_stats()`."""
+    try:
+        st = prefetch_stats()
+        _telemetry.gauge("paddle_tpu_prefetch_depth",
+                         "configured device-prefetch depth").set(st["depth"])
+        if st["overlap_ratio"] is not None:
+            _telemetry.gauge(
+                "paddle_tpu_prefetch_overlap_ratio",
+                "share of input-pipeline work hidden from the step loop"
+            ).set(st["overlap_ratio"])
+    except Exception:  # noqa: BLE001
+        pass
+
+
+# ---------------------------------------------------------------------------
+# the prefetcher
+
+class _ProducerError:
+    __slots__ = ("exc",)
+
+    def __init__(self, exc):
+        self.exc = exc
+
+
+_DONE = object()          # producer exhausted the source cleanly
+_STALL_EPS = 1e-3         # consumer waits above this count as stalls
+
+
+class DevicePrefetcher:
+    """Wrap `source` (any batch iterator/iterable) so a background
+    thread keeps up to `depth` batches already committed to device.
+
+    Batches flow through `jax.tree_util` — `Tensor` leaves (a
+    registered pytree) have their payloads transfer-barriered, numpy
+    leaves are `device_put` (or, with `sharding`, assembled into
+    global arrays from process-local rows), and anything else —
+    notably `LazyArray` fusion placeholders — passes through untouched
+    so the producer thread can never force a fusion flush (the
+    zero-new-flush-sites invariant tools/data_smoke.py gates).
+
+    `sharding="dp"` (an axis name) enables the DP-mesh tier: leaves
+    are committed with ``NamedSharding(mesh, P(axis, None, ...))`` via
+    `jax.make_array_from_process_local_data`, so each host transfers
+    only its shard. Pass `mesh=` explicitly or let it resolve from
+    `distributed.env.get_mesh()`.
+
+    Iterate it (`for batch in DevicePrefetcher(loader): ...`) and
+    `close()` when abandoning it early; `with` works too.
+    """
+
+    def __init__(self, source, depth=None, timeout=None, sharding=None,
+                 mesh=None, wrap_tensors=False):
+        self.depth = max(1, int(depth) if depth is not None
+                         else prefetch_depth())
+        self.timeout = timeout
+        self._src = iter(source)
+        self._axis = sharding
+        self._mesh = mesh
+        # wrap committed leaves in Tensor (for sources that collate to
+        # RAW numpy trees — the sharded fit path, where an eager Tensor
+        # collate would commit locally only to be re-homed globally)
+        self._wrap = bool(wrap_tensors)
+        self._shardings = {}       # ndim -> NamedSharding (producer-only)
+        self._q: queue.Queue = queue.Queue(maxsize=self.depth)
+        self._stop = threading.Event()
+        self._closed = False
+        self._exhausted = False
+        self._sync = False         # degraded: consumer pulls the source
+        self.batches = 0
+        if self._axis is not None and self._mesh is None:
+            from ..distributed import env as _env
+
+            self._mesh = _env.get_mesh()
+            if self._mesh is None or \
+                    self._axis not in self._mesh.axis_names:
+                raise ValueError(
+                    f"sharding axis {self._axis!r} needs an installed "
+                    f"mesh carrying it (distributed.env.set_mesh)")
+        _bump(prefetchers=1, active=1)
+        with _stats_lock:
+            _TOTALS["depth"] = self.depth
+        # the thread holds a WEAK ref to this prefetcher (strong refs
+        # only per-batch, dropped before the blocking put): a consumer
+        # that abandons the iterator without close() lets GC collect
+        # it, and the producer notices within one put cycle instead of
+        # busy-waiting on the full queue forever
+        self._thread = threading.Thread(
+            target=_producer_loop,
+            args=(weakref.ref(self), self._stop, self._q, self._src),
+            name="paddle-tpu-prefetch", daemon=True)
+        try:
+            self._thread.start()
+        except (RuntimeError, MemoryError) as e:  # can't spawn: stay sync
+            self._sync = True
+            self._thread = None
+            record_fault("data_producer_died",
+                         f"prefetch thread failed to start: {e}")
+        _publish_gauges()
+
+    # -- producer side (module-level loop: see the Thread note above) -------
+
+    def _commit(self, batch):
+        """Commit every host leaf of `batch` to device and barrier the
+        transfers — on THIS thread, which is the whole point: the wait
+        overlaps the consumer's compute."""
+        import jax
+
+        from ..core.fusion import LazyArray
+
+        w0 = time.time()
+        t0 = time.perf_counter()
+        leaves, treedef = jax.tree_util.tree_flatten(batch)
+        out, wait, nbytes, sharded = [], [], 0, False
+        for x in leaves:
+            if isinstance(x, jax.Array):
+                target = (self._sharding_for(jax, x.ndim)
+                          if self._mesh is not None else None)
+                if target is not None and x.sharding != target:
+                    # the collate step already committed this leaf to
+                    # the LOCAL device (Tensor construction is eager
+                    # jnp.asarray); the sharded tier re-homes it as a
+                    # process-local shard of the GLOBAL array
+                    a = np.asarray(x)
+                    nbytes += a.nbytes
+                    d, was_sharded = self._device_put(jax, a)
+                    sharded = sharded or was_sharded
+                    out.append(d)
+                    wait.append(d)
+                else:
+                    out.append(x)
+                    wait.append(x)
+            elif type(x) is LazyArray:
+                out.append(x)  # never force a fusion flush from here
+            elif isinstance(x, (np.ndarray, np.generic)):
+                a = np.asarray(x)
+                nbytes += a.nbytes
+                d, was_sharded = self._device_put(jax, a)
+                sharded = sharded or was_sharded
+                out.append(d)
+                wait.append(d)
+            else:
+                out.append(x)
+        if wait:
+            jax.block_until_ready(wait)
+        dt = time.perf_counter() - t0
+        note_h2d(dt, w0, nbytes)
+        _bump(h2d_s=dt, h2d_bytes=nbytes,
+              **({"sharded_batches": 1} if sharded else {}))
+        if self._wrap:
+            from ..core.tensor import Tensor
+
+            out = [Tensor(x) if isinstance(x, jax.Array) else x
+                   for x in out]
+        return jax.tree_util.tree_unflatten(treedef, out)
+
+    def _device_put(self, jax, a):
+        """One leaf to device: plain `device_put`, or — on the sharded
+        tier — global-array assembly from this process's local rows.
+        Returns (array, used_sharding)."""
+        if self._mesh is None:
+            return jax.device_put(a), False
+        sh = self._sharding_for(jax, a.ndim)
+        if sh is None:
+            return jax.device_put(a), False
+        try:
+            return jax.make_array_from_process_local_data(sh, a), True
+        except Exception:  # indivisible batch, API gap: replicate
+            _bump(shard_fallbacks=1)
+            return jax.device_put(a), False
+
+    def _sharding_for(self, jax, ndim):
+        sh = self._shardings.get(ndim)
+        if sh is None:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            if ndim == 0:
+                return None  # scalars replicate via plain device_put
+            spec = PartitionSpec(self._axis, *([None] * (ndim - 1)))
+            sh = self._shardings[ndim] = NamedSharding(self._mesh, spec)  # threadlint: ok[CL001] producer-thread-only memo (only _commit, which runs solely on the producer thread, reaches this); a racing duplicate would compute the identical value anyway
+        return sh
+
+    # -- consumer side -------------------------------------------------------
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._exhausted:
+            raise StopIteration
+        if self._sync:
+            return self._next_sync()
+        deadline = (time.perf_counter() + self.timeout
+                    if self.timeout else None)
+        t0 = time.perf_counter()
+        while True:
+            try:
+                item = self._q.get(timeout=0.05)
+                break
+            except queue.Empty:
+                if self._thread is None or not self._thread.is_alive():
+                    try:  # it may have enqueued right before exiting
+                        item = self._q.get_nowait()
+                        break
+                    except queue.Empty:
+                        pass
+                    self._degrade("producer thread died")
+                    return self._next_sync()
+                if deadline is not None and time.perf_counter() > deadline:
+                    record_fault(
+                        "data_worker_timeout",
+                        f"prefetcher waited {self.timeout}s for a batch")
+                    raise TimeoutError(
+                        f"DevicePrefetcher timed out after {self.timeout}s "
+                        f"waiting for the producer")
+        wait_dt = time.perf_counter() - t0
+        _bump(stall_s=wait_dt,
+              **({"stalls": 1} if wait_dt >= _STALL_EPS else {}))
+        if wait_dt >= _STALL_EPS:
+            try:
+                _telemetry.counter(
+                    "paddle_tpu_prefetch_stalls_total",
+                    "consumer waits on an empty prefetch queue").inc()
+            except Exception:  # noqa: BLE001
+                pass
+        if item is _DONE:
+            self._exhausted = True
+            raise StopIteration
+        if isinstance(item, _ProducerError):
+            self._exhausted = True
+            raise item.exc
+        self.batches += 1
+        _bump(batches=1)
+        if self.batches % 16 == 1:
+            _publish_gauges()
+        return item
+
+    def _degrade(self, why):
+        """Silent producer death: fault event (postmortem-visible via
+        the fault log / flight recorder) + synchronous fallback. The
+        batch the producer was carrying is lost — a degrade, not a
+        wedge, and the fault event says so."""
+        self._sync = True  # threadlint: ok[CL001] consumer-thread-only flag (the producer that also reads it is dead by definition here)
+        record_fault("data_producer_died",
+                     f"{why}; degrading to synchronous input")
+        _bump(producer_deaths=1)
+
+    def _next_sync(self):
+        _bump(sync_fallbacks=1)
+        try:
+            item = next(self._src)
+        except StopIteration:
+            self._exhausted = True
+            raise
+        self.batches += 1
+        _bump(batches=1)
+        return item
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def stats(self):
+        """This instance's view (process totals: `prefetch_stats`)."""
+        return {"depth": self.depth, "batches": self.batches,
+                "queued": self._q.qsize(), "sync": self._sync,
+                "alive": bool(self._thread and self._thread.is_alive())}
+
+    def close(self):
+        """Stop the producer and drain staged batches. Idempotent;
+        safe mid-iteration (early break / stop_training)."""
+        if self._closed:
+            return
+        self._closed = True
+        self._stop.set()
+        self._drain()
+        t = self._thread
+        if t is not None and t.is_alive():
+            # the producer exits on its next stop check; a source
+            # blocked in a slow fetch finishes that item first (its
+            # put aborts). Daemon thread: a pathological source can't
+            # hold the step loop hostage past this bounded join.
+            t.join(timeout=5.0)
+        self._drain()
+        _bump(active=-1)
+
+    def _drain(self):
+        while True:
+            try:
+                self._q.get_nowait()
+            except queue.Empty:
+                return
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+def _producer_loop(ref, stop, q, src):
+    """The prefetch thread body. `ref` is a weakref to the owning
+    DevicePrefetcher: a strong ref is taken per batch (to run
+    `_commit`) and DROPPED before the blocking put, so an abandoned
+    prefetcher (consumer gone, no close()) is collectable — the loop
+    then exits within one put cycle instead of leaking a thread that
+    pins `depth` device-resident batches forever."""
+    n = 0
+    while not stop.is_set():
+        pf = ref()
+        if pf is None:
+            return
+        try:
+            # OUTSIDE the error capture on purpose: an injected raise
+            # here kills the producer without a sentinel — the
+            # deterministic stand-in for an abrupt thread death the
+            # consumer must survive on its own
+            fault_point("prefetch.producer", batch=n)
+        except BaseException:  # noqa: BLE001
+            return
+        t0 = time.perf_counter()
+        try:
+            item = next(src)
+        except StopIteration:
+            item = _DONE
+        except BaseException as e:  # surfaces at the consumer
+            item = _ProducerError(e)
+        src_dt = time.perf_counter() - t0
+        if not isinstance(item, _ProducerError) and item is not _DONE:
+            try:
+                item = pf._commit(item)
+            except BaseException as e:
+                item = _ProducerError(e)
+            _bump(src_s=src_dt)
+        pf = None  # noqa: F841 — drop the strong ref before blocking
+        if not _producer_put(ref, stop, q, item):
+            return  # closing/abandoned: the in-flight batch is dropped
+        if isinstance(item, _ProducerError) or item is _DONE:
+            return
+        n += 1
+
+
+def _producer_put(ref, stop, q, item):
+    """Bounded put that aborts when the prefetcher closes OR was
+    garbage-collected (no consumer will ever drain the queue)."""
+    while not stop.is_set():
+        if ref() is None:
+            return False
+        try:
+            q.put(item, timeout=0.1)
+            return True
+        except queue.Full:
+            continue
+    return False

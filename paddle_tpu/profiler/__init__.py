@@ -105,6 +105,23 @@ class RecordEvent:
         return False
 
 
+def _input_pipeline_stats():
+    """(prefetch_stats-or-None, h2d-hist-summary-or-None) for the
+    summary surfaces; both None when the pipeline never ran."""
+    from ..io import prefetch as _pf
+    from ..runtime import telemetry as _t
+
+    pf = _pf.prefetch_stats()
+    if not pf["prefetchers"]:
+        pf = None
+    h2d = None
+    fam = _t.snapshot().get("paddle_tpu_h2d_seconds")
+    if fam and fam.get("series") and fam["series"][0].get("count"):
+        s = fam["series"][0]
+        h2d = {"sum_s": float(s["sum"]), "count": int(s["count"])}
+    return pf, h2d
+
+
 def summary_dict(op_detail=True, top=5):
     """Machine-readable twin of `Profiler.summary()`: the same runtime
     sections (dispatch cache, trace fusion incl. flush reasons+sites,
@@ -130,8 +147,12 @@ def summary_dict(op_detail=True, top=5):
         "fault_events": {k: v for k, v in
                          ds.get("fault_events", {}).items() if v},
         "telemetry": None,
+        "input_pipeline": None,
         "spans": None,
     }
+    pf, h2d = _input_pipeline_stats()
+    if pf is not None or h2d is not None:
+        out["input_pipeline"] = {"prefetch": pf, "h2d": h2d}
     per_op = ds.get("per_op") or {}
     if op_detail and per_op:
         out["dispatch"]["retrace_heavy_ops"] = {
@@ -415,6 +436,7 @@ class Profiler:
             print("fault events: "
                   + ", ".join(f"{k}: {v}" for k, v in sorted(fe.items())))
         self._telemetry_summary(op_detail)
+        self._input_pipeline_summary()
         self._tracing_summary()
         if self._dir:
             print(f"trace artifacts: {self._dir}")
@@ -467,6 +489,34 @@ class Profiler:
             s = dw["series"][0]
             print(f"  data wait: {s['sum']:.3f}s over {s['count']} "
                   f"batches (avg {s['sum'] / s['count'] * 1e3:.2f}ms)")
+
+    @staticmethod
+    def _input_pipeline_summary():
+        """Async input pipeline (io/prefetch.py): prefetcher depth /
+        stall / overlap counters plus the h2d histogram — the view
+        that says whether the data path still costs step time."""
+        pf, h2d = _input_pipeline_stats()
+        if pf is None and h2d is None:
+            return
+        parts = []
+        if pf is not None:
+            parts.append(f"{pf['batches']} batches prefetched "
+                         f"(depth {pf['depth']})")
+            if pf["overlap_ratio"] is not None:
+                parts.append(f"overlap {pf['overlap_ratio']:.1%}")
+            if pf["stalls"]:
+                parts.append(f"{pf['stalls']} stalls "
+                             f"({pf['stall_s']:.3f}s)")
+            for k, label in (("producer_deaths", "producer deaths"),
+                             ("shard_fallbacks", "shard fallbacks")):
+                if pf[k]:
+                    parts.append(f"{pf[k]} {label}")
+        if h2d is not None and h2d["count"]:
+            parts.append(f"h2d {h2d['sum_s']:.3f}s over {h2d['count']} "
+                         f"commits (avg "
+                         f"{h2d['sum_s'] / h2d['count'] * 1e3:.2f}ms)")
+        if parts:
+            print("input pipeline: " + ", ".join(parts))
 
     @staticmethod
     def _tracing_summary():

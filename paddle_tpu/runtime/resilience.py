@@ -97,6 +97,12 @@ _EVENT_KINDS = (
     #                           process degraded to no evidence
     "statusz_errors",         # the /statusz server failed to bind or a
     #                           route handler raised; served degraded
+    "data_worker_timeout",    # a DataLoader worker / prefetch producer
+    #                           blew past timeout=; raised cleanly with
+    #                           staged ring slots recycled
+    "data_producer_died",     # a DevicePrefetcher's producer thread
+    #                           died silently; the consumer degraded to
+    #                           synchronous input instead of wedging fit
 )
 
 _events_lock = threading.Lock()

@@ -1639,6 +1639,14 @@ METRIC_NAMES = (
     # the loader's next() and reconciled against the data_wait spans
     "paddle_tpu_data_wait_seconds",
     "paddle_tpu_data_wait_seconds_last",
+    # async input pipeline (io/prefetch.py): per-batch host→device
+    # commit time — histogram fed from the SAME measurement as the
+    # io/h2d span (tracing.reconcile_with_metrics holds the pair
+    # exact) — plus the prefetcher's overlap/stall/depth view
+    "paddle_tpu_h2d_seconds",
+    "paddle_tpu_prefetch_depth",
+    "paddle_tpu_prefetch_overlap_ratio",
+    "paddle_tpu_prefetch_stalls_total",
     # serving engine (paddle_tpu/inference/engine.py + kv_cache.py):
     # per-request latency histograms (the "millions of users" p50/p99
     # metric), throughput counters, and paged-KV occupancy gauges —

@@ -650,6 +650,7 @@ def reconcile_with_metrics(tolerance=0.02, abs_slack=2e-3):
     * ``dispatch/run:*`` spans      vs ``dispatch_stats()["per_op"][*]["run_s"]``
     * ``step/train_step`` spans     vs ``paddle_tpu_step_seconds`` histogram
     * ``data/data_wait`` spans      vs ``paddle_tpu_data_wait_seconds`` histogram
+    * ``io/h2d`` spans              vs ``paddle_tpu_h2d_seconds`` histogram
     * ``checkpoint/save`` spans     vs ``paddle_tpu_checkpoint_save_seconds``
     * ``checkpoint/restore`` spans  vs ``paddle_tpu_checkpoint_restore_seconds``
     * ``serve/request`` spans       vs ``paddle_tpu_serve_request_seconds``
@@ -705,6 +706,8 @@ def reconcile_with_metrics(tolerance=0.02, abs_slack=2e-3):
           hist("paddle_tpu_step_seconds"))
     check("data_wait", spans("data", name="data_wait"),
           hist("paddle_tpu_data_wait_seconds"))
+    check("h2d", spans("io", name="h2d"),
+          hist("paddle_tpu_h2d_seconds"))
     check("checkpoint_save", spans("checkpoint", name="save"),
           hist("paddle_tpu_checkpoint_save_seconds"))
     check("checkpoint_restore", spans("checkpoint", name="restore"),

@@ -1,0 +1,106 @@
+"""Child for the sharded-input-pipeline tests (tests/test_prefetch.py).
+
+Modes (argv[1]):
+
+* ``shard`` — one DP "host": loads ONLY its `DistributedBatchSampler`
+  rows (env PF_RANK / PF_NRANKS) through a `DevicePrefetcher` and
+  prints per-batch, per-row sha1 digests. The parent interleaves the
+  ranks' rows back into the global batch stream and compares it,
+  digest for digest, against single-host loading — the ISSUE-15
+  2-process acceptance: per-host sharded loading + prefetch yields the
+  SAME global batch stream (order and values).
+* ``mesh`` — single process forced to 2 CPU devices
+  (XLA_FLAGS=--xla_force_host_platform_device_count=2): a
+  ``sharding="dp"`` prefetcher must yield GLOBAL arrays carrying the
+  dp NamedSharding with values identical to the host batch
+  (process-local data -> global array assembly).
+"""
+import hashlib
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu as paddle  # noqa: E402
+from paddle_tpu.io import DataLoader, DevicePrefetcher  # noqa: E402
+from paddle_tpu.io.sampler import DistributedBatchSampler  # noqa: E402
+
+N = 16
+LOCAL_BATCH = 4
+
+
+class _Det(paddle.io.Dataset):
+    """Deterministic rows: value is a pure function of the index."""
+
+    def __len__(self):
+        return N
+
+    def __getitem__(self, i):
+        x = np.asarray([i, 2.0 * i, i * i], np.float32)
+        y = np.int64(i)
+        return x, y
+
+
+def row_digest(x_row, y_row):
+    h = hashlib.sha1()
+    h.update(np.ascontiguousarray(x_row, np.float32).tobytes())
+    h.update(np.asarray(y_row, np.int64).tobytes())
+    return h.hexdigest()
+
+
+def run_shard():
+    rank = int(os.environ["PF_RANK"])
+    nranks = int(os.environ["PF_NRANKS"])
+    sampler = DistributedBatchSampler(
+        _Det(), batch_size=LOCAL_BATCH, num_replicas=nranks, rank=rank,
+        shuffle=True)
+    sampler.set_epoch(1)
+    loader = DataLoader(_Det(), batch_sampler=sampler)
+    out = []
+    with DevicePrefetcher(iter(loader), depth=2) as pf:
+        for x, y in pf:
+            xv = np.asarray(x.numpy())
+            yv = np.asarray(y.numpy())
+            out.append([row_digest(xv[j], yv[j]) for j in range(len(yv))])
+    print(json.dumps({"rank": rank, "batches": out}))
+
+
+def run_mesh():
+    import jax
+    from jax.sharding import NamedSharding
+
+    from paddle_tpu.distributed import env as _env
+
+    assert jax.device_count() >= 2, jax.devices()
+    _env.set_mesh(jax.sharding.Mesh(np.array(jax.devices()[:2]), ("dp",)))
+    loader = DataLoader(_Det(), batch_size=4, shuffle=False)
+    with DevicePrefetcher(iter(loader), depth=2, sharding="dp") as pf:
+        got = list(pf)
+    assert len(got) == 4, len(got)
+    ref = list(DataLoader(_Det(), batch_size=4, shuffle=False))
+    sharded_leaves = 0
+    for (x, y), (rx, ry) in zip(got, ref):
+        np.testing.assert_array_equal(np.asarray(x.numpy()),
+                                      np.asarray(rx.numpy()))
+        np.testing.assert_array_equal(np.asarray(y.numpy()),
+                                      np.asarray(ry.numpy()))
+        for leaf in (x._value, y._value):
+            sh = getattr(leaf, "sharding", None)
+            if isinstance(sh, NamedSharding) and "dp" in sh.spec:
+                sharded_leaves += 1
+    # every batch leaf has a leading dim divisible by 2 here, so ALL
+    # of them must have taken the global-assembly path
+    assert sharded_leaves == 8, sharded_leaves
+    print(json.dumps({"ok": True, "sharded_leaves": sharded_leaves}))
+
+
+if __name__ == "__main__":
+    if sys.argv[1] == "shard":
+        run_shard()
+    elif sys.argv[1] == "mesh":
+        run_mesh()
+    else:
+        raise SystemExit(f"unknown mode {sys.argv[1]}")

@@ -105,6 +105,15 @@ echo "== diagnostics smoke (flight recorder + bundles + statusz) =="
 # ingests into the round payload (evidence instead of rc=124)
 JAX_PLATFORMS=cpu python tools/diagnostics_smoke.py
 
+echo "== data smoke (async input pipeline: parity + 2x data-wait cut) =="
+# three subprocesses over one compile cache prove the ISSUE-15 gates:
+# the DevicePrefetcher path is loss-BIT-exact vs synchronous input,
+# cuts measured data-wait >= 2x on a data-bound fit, reconciles the
+# new io/h2d spans with paddle_tpu_h2d_seconds exactly, introduces
+# ZERO new fusion flush sites, and the warm second process still
+# performs zero fresh XLA compiles with the prefetcher on
+JAX_PLATFORMS=cpu python tools/data_smoke.py
+
 echo "== trace smoke (span timeline + reconciliation + cluster merge) =="
 # a tiny fit under PADDLE_TPU_TRACE must emit a Perfetto-loadable
 # Chrome trace whose per-phase span sums reconcile with

@@ -1,0 +1,250 @@
+#!/usr/bin/env python
+"""Async-input-pipeline smoke (tools/ci_check.sh): the ISSUE-15
+acceptance gates, over fresh subprocesses the way an operator would
+run them. Three passes share one compile-cache dir; every pass runs
+the SAME seeded workload (an eager trace-fusion window + a small
+`Model.fit` over a throttled, data-bound synthetic dataset) under
+``PADDLE_TPU_EAGER_FUSION=1`` + ``PADDLE_TPU_TRACE``:
+
+**sync**    — `PADDLE_TPU_DATA_PREFETCH=0`: the serial baseline.
+**record**  — prefetch ON (the `DevicePrefetcher` double-buffered
+              device staging), saves the warm-start shape manifest.
+**replay**  — prefetch ON, precompiles the manifest: the warm second
+              process.
+
+Gates (any failure exits nonzero):
+
+* the prefetch loss trajectory is BIT-EXACT vs sync (and vs replay);
+* prefetch cuts the measured data-wait seconds by >= 2x on the
+  data-bound workload (the `paddle_tpu_data_wait_seconds` histogram
+  PR 12 landed so this win would be provable);
+* span/metric reconciliation holds in every pass — including the new
+  ``io/h2d`` spans vs the `paddle_tpu_h2d_seconds` histogram pair,
+  which must be EXERCISED (not skipped) in the prefetch passes;
+* fusion flush-site attribution shows ZERO flush sites in the
+  prefetch pass that the sync pass didn't have — the staged path may
+  never force a flush (device commits bypass dispatch entirely);
+* the warm replay pass performs ZERO fresh XLA compiles with the
+  prefetcher on (the warm-start contract survives the new thread).
+
+Usage: python tools/data_smoke.py              (orchestrates all)
+       python tools/data_smoke.py --pass sync|record|replay
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+STEPS = 16
+BATCH = 16
+DELAY_MS = 3.0
+# sized so one step's COMPUTE (~14ms on a CPU host) comfortably covers
+# one batch's host-side data cost (~4ms: the injected delay + fetch/
+# collate overhead) — the regime where double buffering can hide the
+# input pipeline entirely, making the >= 2x data-wait gate stable
+HIDDEN = 1024
+
+
+def _workload(warm=False):
+    """Seeded, shuffle-free: identical batch values and order in every
+    pass, so the loss comparison is exact equality, not tolerance."""
+    import time
+
+    import numpy as np
+
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu.core import dispatch
+    from paddle_tpu.runtime import warmup
+
+    dispatch.set_warmup_count(1)
+    paddle.seed(0)
+    rng = np.random.RandomState(0)
+
+    # the eager fusion window: real flush sites in the attribution
+    # table (identical source lines in every pass — the zero-new-sites
+    # comparison needs a non-empty baseline to be meaningful)
+    t = paddle.to_tensor(rng.randn(8, 8).astype(np.float32))
+    for _ in range(3):
+        float(paddle.tanh(paddle.matmul(t, t)).sum())
+
+    n = STEPS * BATCH
+    per_item = DELAY_MS * 1e-3 / BATCH
+    xs = rng.rand(n, 16).astype(np.float32)
+    ys = (xs @ rng.rand(16, 1).astype(np.float32)).astype(np.float32)
+
+    class Throttled(paddle.io.Dataset):
+        def __len__(self):
+            return n
+
+        def __getitem__(self, i):
+            time.sleep(per_item)  # the modeled host-side decode cost
+            return xs[i], ys[i]
+
+    net = nn.Sequential(nn.Linear(16, HIDDEN), nn.Tanh(),
+                        nn.Linear(HIDDEN, HIDDEN), nn.Tanh(),
+                        nn.Linear(HIDDEN, 1))
+    model = paddle.Model(net)
+    model.prepare(paddle.optimizer.SGD(0.05, parameters=net.parameters()),
+                  nn.MSELoss())
+    prewarmed = None
+    if warm:
+        prewarmed = model.warm_start()
+    losses = []
+
+    class _Rec(paddle.callbacks.Callback):
+        def on_train_batch_end(self, step, logs=None):
+            losses.append(logs["loss"])
+
+    model.fit(Throttled(), epochs=1, batch_size=BATCH, shuffle=False,
+              verbose=0, callbacks=[_Rec()])
+    if not warm:
+        warmup.save_manifest(os.environ["DATA_SMOKE_MANIFEST"])
+    return losses, prewarmed
+
+
+def _run_pass(which):
+    sys.path.insert(0, REPO)
+    from paddle_tpu.core import dispatch
+    from paddle_tpu.io import prefetch
+    from paddle_tpu.runtime import telemetry, tracing, warmup
+
+    pre = None
+    if which == "replay":
+        pre = warmup.precompile(os.environ["DATA_SMOKE_MANIFEST"])
+    losses, prewarmed = _workload(warm=which == "replay")
+    tracing.flush()
+    ok, report = tracing.reconcile_with_metrics()
+    ds = dispatch.dispatch_stats()
+
+    def _hist(name):
+        fam = telemetry.snapshot().get(name) or {}
+        series = fam.get("series") or [{}]
+        return (float(series[0].get("sum", 0.0)),
+                int(series[0].get("count", 0)))
+
+    sites = sorted({site
+                    for per_reason in (ds["fusion"]["flush_sites"]
+                                       or {}).values()
+                    for site in per_reason})
+    out = {
+        "losses": losses,
+        "data_wait_s": _hist("paddle_tpu_data_wait_seconds")[0],
+        "h2d": _hist("paddle_tpu_h2d_seconds"),
+        "reconcile_ok": ok,
+        "reconcile": report,
+        "flush_sites": sites,
+        "fresh_compiles": ds["compile"]["fresh_compiles"],
+        "disk_cache_hits": ds["compile"]["disk_cache_hits"],
+        "prefetch": prefetch.prefetch_stats(),
+    }
+    if pre is not None:
+        out["precompile"] = pre
+        out["prewarmed"] = prewarmed
+    print(json.dumps(out))
+
+
+def main():
+    tmp = tempfile.mkdtemp(prefix="data_smoke_")
+    base = dict(os.environ)
+    base.update({
+        "JAX_PLATFORMS": "cpu",
+        "PADDLE_TPU_EAGER_FUSION": "1",
+        "PADDLE_TPU_COMPILE_CACHE_DIR": os.path.join(tmp, "cache"),
+        "PADDLE_TPU_COMPILE_CACHE_MIN_COMPILE_S": "0",
+        "DATA_SMOKE_MANIFEST": os.path.join(tmp, "manifest.json"),
+    })
+    base.pop("PADDLE_TPU_SHAPE_MANIFEST", None)
+
+    def run(which, prefetch_on):
+        env = dict(base)
+        env["PADDLE_TPU_DATA_PREFETCH"] = "1" if prefetch_on else "0"
+        env["PADDLE_TPU_TRACE"] = os.path.join(tmp, f"trace_{which}")
+        p = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--pass", which],
+            cwd=REPO, env=env, capture_output=True, text=True, timeout=600)
+        if p.returncode != 0:
+            print(p.stdout)
+            print(p.stderr, file=sys.stderr)
+            raise SystemExit(f"data_smoke: pass {which} failed "
+                             f"(rc={p.returncode})")
+        return json.loads(p.stdout.strip().splitlines()[-1])
+
+    sync = run("sync", prefetch_on=False)
+    rec = run("record", prefetch_on=True)
+    warm = run("replay", prefetch_on=True)
+
+    problems = []
+    if rec["losses"] != sync["losses"]:
+        problems.append(
+            f"prefetch losses diverged from sync: {rec['losses'][:3]}... "
+            f"vs {sync['losses'][:3]}...")
+    if warm["losses"] != sync["losses"]:
+        problems.append("warm replay losses diverged from sync")
+    if not sync["losses"] or len(sync["losses"]) != STEPS:
+        problems.append(f"expected {STEPS} steps, got "
+                        f"{len(sync['losses'])}")
+    # the measurable win: the data-bound workload's wait must collapse
+    if rec["data_wait_s"] * 2.0 > sync["data_wait_s"]:
+        problems.append(
+            f"prefetch did not cut data wait 2x: sync "
+            f"{sync['data_wait_s']:.4f}s vs prefetch "
+            f"{rec['data_wait_s']:.4f}s")
+    for which, r in (("sync", sync), ("record", rec), ("replay", warm)):
+        if not r["reconcile_ok"]:
+            problems.append(f"{which}: span/metric reconciliation "
+                            f"failed: {r['reconcile']}")
+    for which, r in (("record", rec), ("replay", warm)):
+        h = r["reconcile"].get("h2d") or {}
+        if h.get("skipped", True):
+            problems.append(f"{which}: the io/h2d <-> "
+                            f"paddle_tpu_h2d_seconds pair was never "
+                            f"exercised")
+        if r["h2d"][1] == 0:
+            problems.append(f"{which}: no h2d commits recorded")
+        if not r["prefetch"]["batches"]:
+            problems.append(f"{which}: the prefetcher served no batches")
+        if r["prefetch"]["producer_deaths"] or \
+                r["prefetch"]["sync_fallbacks"]:
+            problems.append(f"{which}: prefetcher degraded unexpectedly: "
+                            f"{r['prefetch']}")
+    if not sync["flush_sites"]:
+        problems.append("sync pass recorded no fusion flush sites — the "
+                        "zero-new-sites comparison lost its baseline")
+    new_sites = [s for s in rec["flush_sites"]
+                 if s not in sync["flush_sites"]]
+    if new_sites:
+        problems.append(f"the staged path introduced NEW fusion flush "
+                        f"sites: {new_sites}")
+    if sync["fresh_compiles"] == 0:
+        problems.append("sync pass compiled nothing — the workload no "
+                        "longer exercises the compile path")
+    if warm["fresh_compiles"] != 0:
+        problems.append(f"warm replay paid {warm['fresh_compiles']} fresh "
+                        f"XLA compiles with the prefetcher on (want 0)")
+    if warm["disk_cache_hits"] <= 0:
+        problems.append("warm replay loaded nothing from the persistent "
+                        "compile cache")
+    if problems:
+        for p in problems:
+            print(f"data_smoke: FAIL: {p}", file=sys.stderr)
+        raise SystemExit(1)
+    print(f"data_smoke: OK ({STEPS} steps loss-bit-exact across "
+          f"sync/prefetch/warm; data wait "
+          f"{sync['data_wait_s']:.3f}s -> {rec['data_wait_s']:.3f}s "
+          f"({sync['data_wait_s'] / max(rec['data_wait_s'], 1e-9):.1f}x "
+          f"cut), h2d reconciled over {rec['h2d'][1]} commits, "
+          f"overlap {rec['prefetch']['overlap_ratio']:.1%}, "
+          f"0 new flush sites, warm replay 0 fresh compiles)")
+
+
+if __name__ == "__main__":
+    if len(sys.argv) > 2 and sys.argv[1] == "--pass":
+        _run_pass(sys.argv[2])
+    else:
+        main()

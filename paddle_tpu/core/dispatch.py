@@ -66,6 +66,7 @@ import jax
 import numpy as np
 
 from . import dtype as _pdtypes
+from ..runtime import collective_schedule as _csched
 from ..runtime import telemetry as _telemetry
 from ..runtime import tracing as _tracing
 from ..runtime import warmup as _warmup
@@ -706,6 +707,10 @@ def dispatch_stats():
         # trace-fusion mode (core/fusion.py): recorded ops, flushes by
         # reason, fused-program cache, trace lengths, demotions
         "fusion": _fusion.fusion_stats(),
+        # per-rank collective schedule (runtime/collective_schedule.py):
+        # seq, rolling fingerprint, window marks, recent tail, sites —
+        # the runtime witness of the SPMD same-schedule contract
+        "collectives": _csched.schedule_stats(),
         # warm-start observability: compile seconds (per-op + whole
         # program), disk-cache hits vs fresh XLA compiles, AOT
         # precompile counts, time-to-first-step per engine
@@ -730,6 +735,7 @@ def reset_dispatch_stats(clear_caches=False):
     FORWARD.reset_counters()
     BACKWARD.reset_counters()
     _fusion.reset_fusion_stats(clear_caches=clear_caches)
+    _csched.reset()
     for k in _counters:
         _counters[k] = 0
     with _op_stats_lock:

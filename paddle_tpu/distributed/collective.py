@@ -38,6 +38,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from ..core.autograd import apply
 from ..core.tensor import Tensor
+from ..runtime import collective_schedule as _csched
 from . import env as _env
 
 __all__ = [
@@ -279,12 +280,29 @@ _EAGER_BODIES = {
 # public collectives
 # ---------------------------------------------------------------------------
 
+def _note(op, g, v=None):
+    """Record the collective on the per-rank schedule
+    (runtime/collective_schedule.py). Reads only memoized avals
+    (shape/dtype) — never a flush or device sync."""
+    if not _csched.enabled():
+        return
+    ax = g.axes
+    if isinstance(ax, (tuple, list)):
+        ax = ",".join(str(a) for a in ax)
+    shape = getattr(v, "shape", None)
+    dtype = getattr(v, "dtype", None)
+    _csched.note(op, axis=str(ax),
+                 shape=None if shape is None else tuple(shape),
+                 dtype=None if dtype is None else str(dtype))
+
+
 def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True,
                use_calc_stream=False):
     """In-place across-rank reduction. Returns the tensor (reference
     returns None eagerly but the tensor is mutated; we do both)."""
     g = _group_of(group)
     v = tensor._value if isinstance(tensor, Tensor) else tensor
+    _note("all_reduce", g, v)
     if _is_traced(v):
         out = apply(lambda x: _reduce_block(x, g.axes, op), tensor)
         return out
@@ -303,6 +321,7 @@ def all_gather(tensor_list, tensor, group=None, sync_op=True):
     """
     g = _group_of(group)
     v = tensor._value if isinstance(tensor, Tensor) else tensor
+    _note("all_gather", g, v)
     if _is_traced(v):
         return apply(lambda x: jax.lax.all_gather(x, g.axes, axis=0,
                                                   tiled=True), tensor)
@@ -320,12 +339,14 @@ def all_gather(tensor_list, tensor, group=None, sync_op=True):
 def all_gather_object(object_list, obj, group=None):
     """Gather picklable objects (single-controller: every rank holds obj)."""
     g = _group_of(group)
+    _note("all_gather_object", g)
     object_list.extend([obj] * g.nranks)
 
 
 def broadcast(tensor, src=0, group=None, sync_op=True):
     g = _group_of(group)
     v = tensor._value if isinstance(tensor, Tensor) else tensor
+    _note("broadcast", g, v)
     src = g._require_member(src, "broadcast src") if group is not None \
         else src
     if _is_traced(v):
@@ -345,6 +366,7 @@ def broadcast(tensor, src=0, group=None, sync_op=True):
 def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
     g = _group_of(group)
     v = tensor._value if isinstance(tensor, Tensor) else tensor
+    _note("reduce", g, v)
     dst = g._require_member(dst, "reduce dst") if group is not None else dst
     if _is_traced(v):
         # every rank computes the reduction; non-dst ranks keep theirs
@@ -372,6 +394,7 @@ def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
     rank-stacked result; traced: block receives its slice of the stacked
     src tensor."""
     g = _group_of(group)
+    _note("scatter", g, tensor if tensor_list is None else None)
     source = None  # keep the caller's Tensor so the tape stays connected
     if tensor_list is not None:
         first = tensor_list[0]
@@ -411,6 +434,7 @@ def alltoall(in_tensor_list, out_tensor_list=None, group=None, sync_op=True):
     stacked (n, n, ...) tensor or a list of n per-rank tensors each (n, ...).
     """
     g = _group_of(group)
+    _note("alltoall", g)
     if isinstance(in_tensor_list, (list, tuple)):
         first = in_tensor_list[0]
         fv = first._value if isinstance(first, Tensor) else first
@@ -446,6 +470,7 @@ def alltoall_single(in_tensor, out_tensor=None, in_split_sizes=None,
                     out_split_sizes=None, group=None, sync_op=True):
     g = _group_of(group)
     v = in_tensor._value if isinstance(in_tensor, Tensor) else in_tensor
+    _note("alltoall_single", g, v)
     if in_split_sizes is not None or out_split_sizes is not None:
         raise NotImplementedError(
             "uneven alltoall splits are not supported (XLA all_to_all is "
@@ -475,6 +500,7 @@ def send(tensor, dst=0, group=None, sync_op=True):
     in-process mailbox (ambient rank is 0 under single-controller)."""
     g = _group_of(group)
     v = tensor._value if isinstance(tensor, Tensor) else tensor
+    _note("send", g, v)
     if _is_traced(v):
         raise RuntimeError(
             "send() inside a trace: use p2p_permute(x, perm) / the pipeline "
@@ -485,6 +511,7 @@ def send(tensor, dst=0, group=None, sync_op=True):
 
 def recv(tensor, src=0, group=None, sync_op=True):
     g = _group_of(group)
+    _note("recv", g, tensor)
     box = _mailbox.get((g.id, src, get_rank()))
     if not box:
         raise RuntimeError(
@@ -523,6 +550,7 @@ def p2p_permute(x, perm, group=None):
     """Traced-regime point-to-point: lax.ppermute over the group axis.
     perm: list of (src_rank, dst_rank) pairs."""
     g = _group_of(group)
+    _note("p2p_permute", g, x)
     if isinstance(x, Tensor):
         return apply(lambda v: jax.lax.ppermute(v, g.axes, perm), x)
     return jax.lax.ppermute(x, g.axes, perm)
@@ -531,6 +559,7 @@ def p2p_permute(x, perm, group=None):
 def barrier(group=None):
     """Synchronize: a tiny psum over the group, blocked on host."""
     g = _group_of(group)
+    _note("barrier", g)
     one = jnp.ones((g.nranks,), jnp.int32)
     res = _run_eager(g, "all_reduce", (one,), (P(g._axis),), P(g._axis),
                      (ReduceOp.SUM,))

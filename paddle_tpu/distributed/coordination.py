@@ -64,6 +64,7 @@ import re
 import time
 
 from ..core.dispatch import non_jittable
+from ..runtime import diagnostics as _diagnostics
 from ..runtime import telemetry as _telemetry
 from ..runtime import tracing as _tracing
 from ..runtime.resilience import atomic_write_json, fault_point, record_fault
@@ -395,6 +396,7 @@ class ClusterMonitor:
         self._started = time.time()
         self._stale_known = set()
         self._dead_known = set()
+        self._div_known = set()
         self.last_scan = None
 
     def reset_grace(self, now=None):
@@ -426,6 +428,7 @@ class ClusterMonitor:
                 if float(hb.get("wall", 0.0))
                 >= self._started - self.GRACE_CLOCK_SKEW_S}
         down_set = set(self.down_ranks())
+        diverged = self._scan_schedules(live)
         fresh, stale, dead = [], [], []
         for r in range(self.world_size):
             hb = live.get(r)
@@ -502,9 +505,72 @@ class ClusterMonitor:
                 "quorum_stalled": bool(live)
                 and len(stale) + len(dead) >= self.quorum,
                 "published": len(live),
+                "schedule_divergence": diverged,
                 "quorum": self.quorum, "world_size": self.world_size}
         self.last_scan = scan
         return scan
+
+    @staticmethod
+    def _sched_points(csched):
+        """{seq: digest} comparison points from a published ``csched``
+        payload: the window marks plus the live (seq, fp) head. Marks
+        are seq-POSITIONAL (every MARK_WINDOWth collective), so two
+        ranks heartbeating at different rates still share points."""
+        pts = {}
+        for m in csched.get("marks") or []:
+            try:
+                pts[int(m[0])] = str(m[1])
+            except (TypeError, ValueError, IndexError):
+                continue
+        try:
+            pts[int(csched["seq"])] = str(csched["fp"])
+        except (KeyError, TypeError, ValueError):
+            pass
+        return pts
+
+    def _scan_schedules(self, live):
+        """Cross-rank collective-schedule reconciliation — the runtime
+        half of tools/distlint. Every heartbeat can carry a ``csched``
+        record (runtime/collective_schedule.py via ElasticManager.tick);
+        any seq both ranks have marked with DIFFERENT digests means the
+        ranks issued different collective sequences — the run is headed
+        for a deadlock or silent corruption, and this names it seconds
+        after the fork instead of minutes after the dead-peer deadline.
+        Returns [[rank_a, rank_b, first_divergent_seq], ...]; each pair
+        records a `collective_divergence` fault (both schedule tails in
+        the detail, so the merged cluster fault log carries the diff)
+        and triggers a postmortem bundle, once per pair."""
+        scheds = {r: hb["csched"] for r, hb in live.items()
+                  if isinstance(hb.get("csched"), dict)}
+        diverged = []
+        ranks = sorted(scheds)
+        for i, a in enumerate(ranks):
+            for b in ranks[i + 1:]:
+                pa = self._sched_points(scheds[a])
+                pb = self._sched_points(scheds[b])
+                forks = sorted(s for s in pa.keys() & pb.keys()
+                               if pa[s] != pb[s])
+                if not forks:
+                    continue
+                diverged.append([a, b, forks[0]])
+                if (a, b) in self._div_known:
+                    continue
+                self._div_known.add((a, b))
+                diff = {"ranks": [a, b], "first_divergent_seq": forks[0],
+                        "seq": {str(a): scheds[a].get("seq"),
+                                str(b): scheds[b].get("seq")},
+                        "fp": {str(a): scheds[a].get("fp"),
+                               str(b): scheds[b].get("fp")},
+                        "tail": {str(a): scheds[a].get("tail"),
+                                 str(b): scheds[b].get("tail")}}
+                record_fault(
+                    "collective_divergence",
+                    f"ranks {a} and {b} issued different collective "
+                    f"schedules (first divergent seq {forks[0]}): "
+                    + json.dumps(diff, sort_keys=True))
+                _diagnostics.maybe_dump("collective_divergence",
+                                        extra={"collective_divergence": diff})
+        return diverged
 
     def down_ranks(self):
         """Ranks with a cluster-wide down declaration (any declarer).

@@ -37,6 +37,7 @@ import time
 import warnings
 
 from ..core.dispatch import non_jittable
+from ..runtime import collective_schedule as _csched
 from ..runtime import diagnostics as _diagnostics
 from ..runtime import telemetry as _telemetry
 from ..runtime import tracing as _tracing
@@ -72,6 +73,75 @@ def latest_checkpoint(ckpt_dir):
     from ..io.checkpoint import latest_complete_step
 
     return latest_complete_step(ckpt_dir)
+
+
+def agreed_rollback_step(cluster, ckpt_dir, bad_step,
+                         rendezvous_timeout=10.0, clock_skew=5.0):
+    """Cluster-agreed rollback target for a bad-step (NaN) rollback.
+
+    Rank-local rollback in cluster mode is a divergence bug: each rank
+    restores its OWN newest complete step, and retention drift (one
+    rank's failed save, one rank pruning ahead) leaves ranks running
+    from different steps with no error until schedules skew. This
+    mirrors the coordinated-resume agreement: every rank publishes its
+    complete-step list, host 0 intersects the publications
+    (`latest_common_complete_step`) and publishes the result under a
+    bad-step-keyed rendezvous, and followers wait for it — degrading
+    to their own intersection (`rendezvous_timeouts` fault recorded by
+    the wait) rather than hanging the rollback.
+
+    SPMD makes a bad step deterministic: every rank computes the same
+    non-finite loss at the same step and arrives here with the same
+    `bad_step`, so the per-step key cannot alias another rollback's
+    agreement (PADDLE_TPU_CLUSTER_RUN_ID additionally namespaces it
+    across job incarnations, like the resume agreement). Returns the
+    agreed step, or None when no step is common to every publication.
+    """
+    return _agreed_step(cluster, ckpt_dir, f"rollback_step_{int(bad_step)}",
+                        rendezvous_timeout=rendezvous_timeout,
+                        clock_skew=clock_skew)
+
+
+def _agreed_step(cluster, ckpt_dir, name, rendezvous_timeout=10.0,
+                 clock_skew=5.0):
+    """The publish → host-0 intersect → rendezvous agreement shared by
+    rollback and resume. `name` keys the rendezvous (additionally
+    namespaced by PADDLE_TPU_CLUSTER_RUN_ID across job incarnations);
+    a follower whose wait expires degrades to its own intersection of
+    whatever publications exist (`rendezvous_timeouts` fault already
+    recorded by the wait) rather than hanging."""
+    from ..io.checkpoint import (
+        latest_common_complete_step, publish_complete_steps,
+    )
+    from .coordination import rendezvous
+
+    published_at = time.time()
+    publish_complete_steps(cluster.store, cluster.rank, ckpt_dir)
+    run_id = os.environ.get("PADDLE_TPU_CLUSTER_RUN_ID")
+    if run_id:
+        import re
+
+        run_id = re.sub(r"[^A-Za-z0-9._-]", "_", run_id)[:64]
+        name = f"{name}_{run_id}"
+    if cluster.is_leader:
+        common = latest_common_complete_step(
+            cluster.store, expected_ranks=cluster.world_size,
+            timeout=rendezvous_timeout,
+            min_wall=published_at - clock_skew)
+        rendezvous(cluster.store, name, {"step": common}, leader=True)
+        return common
+    payload = rendezvous(
+        cluster.store, name,
+        # the leader may spend a full wait collecting publications
+        # before it publishes — a follower deadline equal to the
+        # leader's races it (same sizing as the resume agreement)
+        timeout=2.0 * rendezvous_timeout + clock_skew,
+        min_wall=published_at - rendezvous_timeout - clock_skew)
+    if payload is None:
+        return latest_common_complete_step(
+            cluster.store, expected_ranks=None, timeout=0.0,
+            world_size=cluster.world_size)
+    return payload.get("step")
 
 
 class ElasticManager:
@@ -197,8 +267,17 @@ class ElasticManager:
                     # peers, which is precisely what the fault event
                     # records
                     try:
+                        # ride the collective-schedule fingerprint on
+                        # the heartbeat record: peers' monitors compare
+                        # marks and name a schedule divergence in
+                        # seconds instead of a dead-peer timeout
+                        # (pure host bookkeeping — no flush, and {} when
+                        # PADDLE_TPU_COLLECTIVE_SCHEDULE=0 kills it)
+                        sched = _csched.heartbeat_payload()
                         _publish_heartbeat(self.cluster.store,
-                                           self.cluster.rank, step, payload)
+                                           self.cluster.rank, step,
+                                           {**(payload or {}), **sched}
+                                           if sched else payload)
                     except Exception as e:  # noqa: BLE001 — a pluggable
                         # (KV) store can raise more than OSError; no
                         # store error may ever propagate into the step
@@ -217,11 +296,29 @@ class ElasticManager:
         continue from (0 when starting fresh). `restore_fn(step)` may
         return the step it ACTUALLY restored (CheckpointManager.restore
         falls back past corrupted steps) — resume continues after that
-        one."""
-        step = latest_checkpoint(self.ckpt_dir)
+        one.
+
+        In cluster mode the resume TARGET is agreed cluster-wide first
+        (publish → host-0 intersect → rendezvous, same protocol as the
+        rollback agreement): each rank's own newest step can differ
+        under retention drift, and resuming from it silently forks the
+        ranks before the first collective."""
+        if self.cluster is not None:
+            try:
+                step = _agreed_step(self.cluster, self.ckpt_dir,
+                                    "resume_step")
+            except Exception as e:  # noqa: BLE001 — store errors must
+                # degrade (loudly) to the rank-local target, not kill
+                # the resume
+                record_fault("restore_fallbacks",
+                             "resume agreement failed: "
+                             f"{type(e).__name__}: {e}")
+                step = latest_checkpoint(self.ckpt_dir)  # distlint: ok[DL003] — reviewed degrade path: store down, rank-local newest beats refusing to resume
+        else:
+            step = latest_checkpoint(self.ckpt_dir)  # distlint: ok[DL003] — single-process mode: rank-local newest IS the contract
         if step is None:
             return 0
-        restored = restore_fn(step)
+        restored = restore_fn(step)  # distlint: ok[DL003] — target is the cluster agreement in cluster mode; local paths carry reviewed waivers above
         if isinstance(restored, int) and not isinstance(restored, bool):
             step = restored
         return step + 1
@@ -229,18 +326,36 @@ class ElasticManager:
     def guard(self, restore_fn, max_consecutive=3, on_escalate=None):
         """BadStepGuard wired to this manager: rollback restores the
         newest complete checkpoint via `restore_fn` (same signature as
-        `resume`'s). A rollback with no checkpoint on disk is recorded
-        but is a no-op — there is nothing to roll back TO."""
+        `resume`'s). In cluster mode the rollback TARGET is agreed
+        cluster-wide first (`agreed_rollback_step`): each rank's own
+        newest step can differ under retention drift, and restoring it
+        silently diverges the ranks. A rollback with no checkpoint on
+        disk (or no common step) is recorded but is a no-op — there is
+        nothing safe to roll back TO."""
 
         def _rollback(bad_step):
-            last = latest_checkpoint(self.ckpt_dir)
+            if self.cluster is not None:
+                try:
+                    last = agreed_rollback_step(self.cluster,
+                                                self.ckpt_dir, bad_step)
+                except Exception as e:  # noqa: BLE001 — store errors
+                    # must degrade (loudly) to the rank-local target,
+                    # not kill the rollback
+                    record_fault("restore_fallbacks",
+                                 "rollback agreement failed: "
+                                 f"{type(e).__name__}: {e}")
+                    last = latest_checkpoint(self.ckpt_dir)  # distlint: ok[DL003] — reviewed degrade path: store down, rank-local newest beats no rollback at all
+            else:
+                last = latest_checkpoint(self.ckpt_dir)  # distlint: ok[DL003] — single-process mode: rank-local newest IS the contract
             if last is None:
                 warnings.warn(
                     f"paddle_tpu elastic: bad step {bad_step} with no "
-                    "checkpoint on disk — state NOT rolled back",
-                    stacklevel=2)
+                    "restorable checkpoint"
+                    + (" common to every rank"
+                       if self.cluster is not None else " on disk")
+                    + " — state NOT rolled back", stacklevel=2)
                 return
-            restore_fn(last)
+            restore_fn(last)  # distlint: ok[DL003] — target is the cluster agreement in cluster mode; local paths carry reviewed waivers above
 
         return BadStepGuard(_rollback, max_consecutive=max_consecutive,
                             on_escalate=on_escalate)

@@ -871,13 +871,49 @@ class ResilienceCallback(Callback):
                 self.global_step = restored + 1
 
         def _rollback(bad_step):
-            if self._restore() is None:
+            # ROADMAP item 3 gap: a NaN loss is deterministic across
+            # SPMD ranks, so every rank's guard trips on the same step —
+            # but each rank restoring its OWN newest complete checkpoint
+            # can land on different steps (one rank's newest save failed
+            # verification and fell back further), silently forking the
+            # cluster. Route the rollback target through the same host-0
+            # agreement as coordinated restore; only when the agreement
+            # itself is unreachable does a rank degrade to its local
+            # newest — loudly, via the recorded fault.
+            step = None
+            if self._cluster is not None:
+                from ..distributed.elastic import agreed_rollback_step
+                from ..runtime.resilience import record_fault
+
+                try:
+                    step = agreed_rollback_step(
+                        self._cluster, self.ckpt_dir, bad_step,
+                        rendezvous_timeout=self.rendezvous_timeout,
+                        clock_skew=self.CLUSTER_CLOCK_SKEW_S)
+                except Exception as e:  # noqa: BLE001 — store errors
+                    record_fault("restore_fallbacks",
+                                 "rollback agreement failed: "
+                                 f"{type(e).__name__}: {e}")
+                    step = None
+            restored = (self._restore(step) if step is not None
+                        else self._restore())
+            if self._cluster is not None and step is not None and \
+                    restored != step:
+                from ..runtime.resilience import record_fault
+
+                record_fault(
+                    "restore_fallbacks",
+                    f"rollback divergence: restored {restored} != "
+                    f"agreed step {step}")
+            if restored is None:
                 import warnings
 
                 warnings.warn(
                     f"paddle_tpu ResilienceCallback: bad step {bad_step} "
-                    "with no restorable checkpoint — parameters NOT rolled "
-                    "back", stacklevel=2)
+                    "with no restorable checkpoint"
+                    + (" common to every rank" if self._cluster is not None
+                       else "")
+                    + " — parameters NOT rolled back", stacklevel=2)
 
         def _escalate(step, n):
             # N consecutive bad steps is a terminal diagnosis moment:

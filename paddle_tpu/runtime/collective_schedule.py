@@ -1,0 +1,169 @@
+"""Per-rank collective-schedule recorder.
+
+The SPMD contract says every rank issues the same collectives in the
+same order. When a rank breaks it, the only runtime symptom today is a
+wedge: the conforming ranks sit inside a collective until the cluster
+watchdog's dead-peer deadline names the wrong thing ("peer dead") for
+the wrong reason. This module gives the contract a runtime witness:
+the collective layer calls `note()` per issued collective, and the
+recorder keeps
+
+* a monotonically increasing sequence number and a rolling digest
+  chained over (op, axis, aval) — two ranks with the same schedule
+  have the same digest at the same seq;
+* **window marks** — every MARK_WINDOW entries the (seq, digest) pair
+  is latched. Marks are positional, so ranks heartbeating at
+  different rates still share comparable points: any common seq with
+  different digests is a divergence, and the FIRST such seq brackets
+  where the schedules forked;
+* a bounded tail of recent entries and a bounded per-site counter for
+  the postmortem diff and --verify-runtime cross-referencing.
+
+Publication rides the existing heartbeat path (ElasticManager.tick
+merges `heartbeat_payload()` into the cluster heartbeat record);
+ClusterMonitor compares peers' marks and raises a
+`collective_divergence` fault with both schedules — seconds after the
+fork, not minutes after the deadline.
+
+Pure host bookkeeping: `note()` reads only `.shape`/`.dtype` (served
+from memoized avals — never a flush or device sync) and costs a lock
+plus one hash. `PADDLE_TPU_COLLECTIVE_SCHEDULE=0` kills it entirely.
+"""
+from __future__ import annotations
+
+import collections
+import hashlib
+import os
+import sys
+import threading
+
+__all__ = [
+    "enabled", "note", "schedule_stats", "heartbeat_payload", "reset",
+    "MARK_WINDOW",
+]
+
+MARK_WINDOW = 16      # entries per digest mark
+_MAX_MARKS = 8        # marks kept (covers the last 128 collectives)
+_MAX_RECENT = 8       # tail entries kept for diffs
+_MAX_SITES = 64       # distinct call sites tracked
+
+_lock = threading.Lock()
+_seq = 0
+_digest = ""
+_marks = collections.deque(maxlen=_MAX_MARKS)    # (seq, digest)
+_recent = collections.deque(maxlen=_MAX_RECENT)  # (seq, op, axis, aval, site)
+_per_op = {}
+_sites = {}
+
+
+def enabled():
+    return os.environ.get(
+        "PADDLE_TPU_COLLECTIVE_SCHEDULE", "1").lower() not in (
+        "0", "false", "off")
+
+
+def _aval(shape, dtype):
+    if shape is None and dtype is None:
+        return "?"
+    dims = "x".join(str(d) for d in (shape or ()))
+    return f"{dtype or '?'}[{dims}]"
+
+
+def _call_site():
+    """`paddle_tpu/...:line` of the innermost in-tree caller — skipping
+    the recorder and the collective layer itself. A driver script
+    calling collectives directly has no in-tree caller frame; the
+    collective-layer frame is the fallback, so the site always lands
+    inside the tree --verify-runtime analyzes."""
+    try:
+        frame = sys._getframe(2)
+    except ValueError:  # pragma: no cover
+        return "?"
+    fallback = None
+    depth = 0
+    while frame is not None and depth < 16:
+        fname = frame.f_code.co_filename
+        norm = fname.replace(os.sep, "/")
+        if norm.endswith("collective_schedule.py"):
+            frame = frame.f_back
+            depth += 1
+            continue
+        idx = norm.rfind("paddle_tpu/")
+        if idx >= 0:
+            rel = norm[idx:]
+            site = f"{rel}:{frame.f_lineno}"
+            if rel.endswith("distributed/collective.py"):
+                # keep overwriting: the OUTERMOST collective-layer frame
+                # is the public op the external caller invoked (inner
+                # frames are private helpers)
+                fallback = site
+            else:
+                return site
+        frame = frame.f_back
+        depth += 1
+    return fallback or "?"
+
+
+def note(op, axis="", shape=None, dtype=None):
+    """Record one issued collective. Cheap, lock-guarded, allocation-
+    light; a no-op when the recorder is killed."""
+    if not enabled():
+        return
+    aval = _aval(shape, dtype)
+    site = _call_site()
+    entry = f"{op}:{axis}:{aval}"
+    global _seq, _digest
+    with _lock:
+        _seq += 1
+        _digest = hashlib.sha1(
+            (_digest + "|" + entry).encode()).hexdigest()[:12]
+        _recent.append((_seq, op, axis, aval, site))
+        _per_op[op] = _per_op.get(op, 0) + 1
+        if len(_sites) < _MAX_SITES or site in _sites:
+            _sites[site] = _sites.get(site, 0) + 1
+        else:
+            _sites["<overflow>"] = _sites.get("<overflow>", 0) + 1
+        if _seq % MARK_WINDOW == 0:
+            _marks.append((_seq, _digest))
+
+
+def schedule_stats():
+    """The dispatch_stats()["collectives"] view."""
+    with _lock:
+        return {
+            "enabled": enabled(),
+            "seq": _seq,
+            "fingerprint": _digest,
+            "per_op": dict(sorted(_per_op.items())),
+            "marks": [list(m) for m in _marks],
+            "recent": [list(r) for r in _recent],
+            "sites": dict(sorted(_sites.items())),
+        }
+
+
+def heartbeat_payload():
+    """Compact per-heartbeat publication: current (seq, fp), the
+    window marks, and a short schedule tail for the divergence diff.
+    Empty when killed or before the first collective."""
+    if not enabled():
+        return {}
+    with _lock:
+        if _seq == 0:
+            return {}
+        return {"csched": {
+            "seq": _seq,
+            "fp": _digest,
+            "marks": [list(m) for m in _marks],
+            "tail": [list(r) for r in _recent],
+        }}
+
+
+def reset():
+    global _seq, _digest
+    with _lock:
+        _seq = 0
+        _digest = ""
+        _marks.clear()
+        _recent.clear()
+        _per_op.clear()
+        _sites.clear()

@@ -70,6 +70,7 @@ import traceback
 import warnings
 import weakref
 
+from . import collective_schedule as _csched
 from . import telemetry as _telemetry
 from . import tracing as _tracing
 
@@ -817,6 +818,7 @@ def _statusz_payload():
         "fingerprint": runtime_fingerprint(),
         "summary": summary,
         "faults": _fault_snapshot(),
+        "collectives": _csched.schedule_stats(),
         "flight_recorder": flight_stats(),
         "diagnostics_dir": _config["dir"],
         "last_bundle": _last_bundle[0],

@@ -80,6 +80,12 @@ _EVENT_KINDS = (
     "compile_cache_errors",   # persistent compile-cache entry failed to
     #                           read/write (corrupt file); degraded to a
     #                           fresh compile
+    "fusion_demotions",       # an op raised under the fused trace and
+    #                           was learned fusion-unsafe (flush-then-
+    #                           eager from then on) — the fusion
+    #                           engine's eager_demotions analogue
+    "fusion_fallbacks",       # a fused program failed to compile/run
+    #                           and the trace was replayed eagerly
     "stale_manifests",        # a warm-start shape manifest was rejected
     #                           (version mismatch, unresolvable op) or an
     #                           entry failed to replay; cold start instead
@@ -103,6 +109,19 @@ _EVENT_KINDS = (
     "data_producer_died",     # a DevicePrefetcher's producer thread
     #                           died silently; the consumer degraded to
     #                           synchronous input instead of wedging fit
+    "kv_preemptions",         # the serving scheduler evicted a running
+    #                           sequence to free KV blocks (it re-queues
+    #                           and recomputes; visible degradation)
+    "paged_kernel_fallbacks",  # the ragged paged-attention kernel was
+    #                           unavailable/failed and decode fell back
+    #                           to the dense gather path
+    "collective_divergence",  # two live ranks published collective-
+    #                           schedule fingerprints that disagree at a
+    #                           common sequence point — the SPMD
+    #                           contract broke (ClusterMonitor, with
+    #                           both ranks' schedule tails in the
+    #                           detail; tools/distlint is the static
+    #                           half of the same check)
 )
 
 _events_lock = threading.Lock()

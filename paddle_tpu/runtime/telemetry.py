@@ -1692,11 +1692,18 @@ EVENT_KINDS = (
 
 
 def schema():
-    """The frozen metric/event vocabulary, as compared against
-    tools/telemetry_schema.json by the CI freshness gate."""
+    """The frozen metric/event/fault vocabulary, as compared against
+    tools/telemetry_schema.json by the CI freshness gate (and cross-
+    checked against in-tree record_fault()/emit() literals by
+    tools/staticcheck.py's schema-consistency pass)."""
+    # lazy: resilience imports fine without jax, but telemetry must not
+    # couple its import to another runtime module at module top
+    from . import resilience as _resilience
+
     return {"version": SCHEMA_VERSION,
             "metrics": sorted(METRIC_NAMES),
-            "events": sorted(EVENT_KINDS)}
+            "events": sorted(EVENT_KINDS),
+            "fault_kinds": sorted(_resilience._EVENT_KINDS)}
 
 
 # ---------------------------------------------------------------------------

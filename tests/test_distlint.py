@@ -1,0 +1,807 @@
+"""distlint: static cross-rank divergence analyzer on the shared
+staticlib core, plus the runtime collective-schedule reconciliation.
+
+Locks the ISSUE-17 acceptance surface:
+  * fixture detections for all 7 rules (DL001–DL007);
+  * precision controls that must NOT fire (rank-gated branches with a
+    MATCHING collective on both sides, mesh-bound axis names, seeded
+    generators, broadcast-of-host-local — the sanctioned replication
+    route, a barrier completing the collective before a store wait);
+  * inline waivers, line-free fingerprints, the machinery exemption
+    (distributed/collective.py IS the protocol);
+  * the CLI exit-code contract and the freshness of the shipped
+    (empty) baseline;
+  * SARIF round-trip and the distlint baseline regenerating
+    byte-identically;
+  * the --verify-runtime cross-reference over the SITE INVENTORY
+    (unit-level, no subprocess);
+  * the runtime half: the collective-schedule recorder (digest,
+    positional window marks, kill switch, dispatch-stats parity),
+    heartbeat publication through ElasticManager.tick, and
+    ClusterMonitor's divergence scan (fault + latch + bundle);
+  * the rollback/resume divergence fix: cluster mode routes BOTH
+    through the host-0 common-step agreement;
+  * staticcheck's telemetry schema-consistency pass.
+"""
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+from tools.distlint import analyzer  # noqa: E402
+from tools.staticlib import baseline as slib_baseline  # noqa: E402
+
+
+# ---------------------------------------------------------------------------
+# fixture code exercising every rule
+
+FIXTURE = textwrap.dedent('''
+    import time
+    import numpy as np
+    from jax import lax
+    from jax.sharding import Mesh
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.core import fusion
+    from paddle_tpu.distributed import coordination
+    from paddle_tpu.distributed.elastic import latest_checkpoint
+    from paddle_tpu.runtime import telemetry
+
+    MESH = Mesh((), ("dp",))
+
+
+    def gated_sync(x):
+        rank = dist.get_rank()
+        if rank == 0:
+            dist.all_reduce(x)             # DL001: only rank 0 enters
+        return x
+
+
+    def paired_sync(x, rank):
+        if rank == 0:
+            dist.all_reduce(x)             # control: matched on both
+        else:
+            dist.all_reduce(x)             # branches -> no deadlock
+        return x
+
+
+    def waived_gate(x):
+        if dist.get_rank() == 0:
+            dist.all_reduce(x)  # distlint: ok[DL001] fixture-reviewed
+        return x
+
+
+    def staged_sync(x, t0):
+        if time.time() - t0 > 5:           # DL002: host-tainted test,
+            dist.all_reduce(x)             # different sequences
+        else:
+            dist.broadcast(x, src=0)
+        return x
+
+
+    def noisy_sync(x):
+        noise = np.random.rand(4)
+        dist.all_reduce(noise)             # DL003: unseeded operand
+        return noise
+
+
+    def local_resume(restore_fn, ckpt_dir):
+        step = latest_checkpoint(ckpt_dir)
+        return restore_fn(step)            # DL003: rank-local restore
+
+
+    def seeded_sync(x):
+        rng = np.random.default_rng(1234)
+        vals = rng.normal(size=4)
+        dist.all_reduce(vals)              # control: seeded = replicated
+        return vals
+
+
+    def replicate_seed(x):
+        seed = np.random.rand(1)
+        dist.broadcast(seed, src=0)        # control: broadcast IS the fix
+        return seed
+
+
+    def bound_axis_reduce(x):
+        return lax.psum(x, "dp")           # control: bound by MESH
+
+
+    def unbound_axis_reduce(x):
+        return lax.psum(x, "model")        # DL004: no binding anywhere
+
+
+    def sync_then_wait(store, x):
+        dist.all_reduce(x)
+        store.rendezvous("agree")          # DL005: wait under in-flight
+        return x
+
+
+    def sync_complete_then_wait(store, x):
+        dist.all_reduce(x)
+        dist.barrier()
+        store.rendezvous("agree")          # control: collective done
+        return x
+
+
+    def publish(store):
+        telemetry.merge_cluster(store)     # DL006: no rank gate
+
+
+    def publish_gated(store, rank):
+        if rank == 0:
+            telemetry.merge_cluster(store)  # control: gated
+
+
+    def publish_guard(store, rank):
+        if rank != 0:
+            return
+        telemetry.merge_cluster(store)     # control: guard clause
+
+
+    def elect(store):
+        coordination.rendezvous(store, "k", {"v": 1}, leader=True)  # DL006
+
+
+    def fused_region(x):
+        with fusion.suspend():
+            dist.all_reduce(x)             # DL007: schedule skew
+        return x
+''')
+
+
+@pytest.fixture(scope="module")
+def fixture_result(tmp_path_factory):
+    d = tmp_path_factory.mktemp("distlint_fixture")
+    p = d / "fixture_dist.py"
+    p.write_text(FIXTURE)
+    sites = []
+    findings, errors = analyzer.analyze_paths([str(p)], sites=sites)
+    assert not errors
+    return findings, sites
+
+
+@pytest.fixture(scope="module")
+def fixture_findings(fixture_result):
+    return fixture_result[0]
+
+
+def _hits(findings, rule, where=""):
+    return [f for f in findings
+            if f.rule == rule and where in f.func and not f.suppressed]
+
+
+# -- detections (all 7 rules) -------------------------------------------------
+
+def test_all_seven_rules_detect_on_fixture(fixture_findings):
+    rules = {f.rule for f in fixture_findings if not f.suppressed}
+    assert {"rank-conditional-collective", "divergent-collective-schedule",
+            "host-local-value-divergence", "unbound-axis-name",
+            "coordination-wait-under-collective", "ungated-leader-write",
+            "collective-in-suspend-region"} <= rules, rules
+
+
+def test_dl001_rank_conditional_collective(fixture_findings):
+    hits = _hits(fixture_findings, "rank-conditional-collective",
+                 "gated_sync")
+    assert hits and hits[0].symbol == "gated:all_reduce"
+    assert hits[0].severity == "error"
+    assert hits[0].confidence == "definite"
+
+
+def test_dl002_divergent_schedule(fixture_findings):
+    hits = _hits(fixture_findings, "divergent-collective-schedule",
+                 "staged_sync")
+    assert hits and hits[0].symbol == "schedule:all_reduce!=broadcast"
+    assert "time.time" in hits[0].message
+
+
+def test_dl003_host_local_divergence(fixture_findings):
+    syms = {f.symbol for f in _hits(fixture_findings,
+                                    "host-local-value-divergence")}
+    # both sink families: the collective operand AND the restore decision
+    assert "hostlocal:all_reduce:noise" in syms, syms
+    assert "hostlocal:restore_fn:step" in syms, syms
+
+
+def test_dl004_unbound_axis_name(fixture_findings):
+    hits = _hits(fixture_findings, "unbound-axis-name")
+    assert {f.symbol for f in hits} == {"axis:model"}, hits
+
+
+def test_dl005_coordination_wait_under_collective(fixture_findings):
+    hits = _hits(fixture_findings, "coordination-wait-under-collective",
+                 "sync_then_wait")
+    assert hits and hits[0].symbol == "coordwait:rendezvous<-all_reduce"
+    assert hits[0].severity == "error"
+
+
+def test_dl006_ungated_leader_write(fixture_findings):
+    syms = {f.symbol for f in _hits(fixture_findings,
+                                    "ungated-leader-write")}
+    # both shapes: the merge-artifact write AND the leader rendezvous
+    assert "leaderwrite:merge_cluster" in syms, syms
+    assert "leaderwrite:rendezvous" in syms, syms
+
+
+def test_dl007_collective_in_suspend_region(fixture_findings):
+    hits = _hits(fixture_findings, "collective-in-suspend-region",
+                 "fused_region")
+    assert hits and hits[0].symbol == "suspend:all_reduce"
+
+
+# -- precision controls -------------------------------------------------------
+
+def test_matched_branches_are_clean(fixture_findings):
+    assert not [f for f in fixture_findings
+                if "paired_sync" in f.func and not f.suppressed]
+
+
+def test_seeded_generator_is_clean(fixture_findings):
+    assert not [f for f in fixture_findings
+                if "seeded_sync" in f.func and not f.suppressed]
+
+
+def test_broadcast_of_host_local_is_clean(fixture_findings):
+    """broadcast/scatter are asymmetric BY DESIGN: feeding a host-local
+    value into broadcast from the source rank is the sanctioned way to
+    replicate it — the fix route must never re-flag."""
+    assert not [f for f in fixture_findings
+                if "replicate_seed" in f.func and not f.suppressed]
+
+
+def test_bound_axis_name_is_clean(fixture_findings):
+    assert not [f for f in fixture_findings
+                if f.func == "bound_axis_reduce" and not f.suppressed]
+
+
+def test_completed_collective_before_wait_is_clean(fixture_findings):
+    assert not [f for f in fixture_findings
+                if "sync_complete_then_wait" in f.func
+                and not f.suppressed]
+
+
+def test_rank_gated_leader_writes_are_clean(fixture_findings):
+    for fn in ("publish_gated", "publish_guard"):
+        assert not [f for f in fixture_findings
+                    if fn in f.func and not f.suppressed], fn
+
+
+def test_waived_site_is_suppressed_not_new(fixture_findings):
+    waived = [f for f in fixture_findings if "waived_gate" in f.func]
+    assert waived and all(f.suppressed for f in waived)
+    assert waived[0].rule == "rank-conditional-collective"
+
+
+def test_fingerprints_are_line_number_free(tmp_path):
+    (tmp_path / "a.py").write_text(FIXTURE)
+    (tmp_path / "b.py").write_text("# unrelated leading comment\n"
+                                   + FIXTURE)
+    fa, _ = analyzer.analyze_paths([str(tmp_path / "a.py")])
+    fb, _ = analyzer.analyze_paths([str(tmp_path / "b.py")])
+    fp_a = sorted(f.fingerprint().split("|", 2)[2] for f in fa)
+    fp_b = sorted(f.fingerprint().split("|", 2)[2] for f in fb)
+    assert fp_a == fp_b
+
+
+def test_site_inventory_collected(fixture_result):
+    _, sites = fixture_result
+    ops = {s["op"] for s in sites}
+    assert {"all_reduce", "broadcast", "psum", "barrier"} <= ops, ops
+    for s in sites:
+        assert s["end_line"] >= s["line"] >= 1
+        assert s["path"].endswith("fixture_dist.py")
+
+
+def test_machinery_module_is_exempt_but_inventoried():
+    """distributed/collective.py IS the protocol implementation: its
+    rank-asymmetric eager bodies must never self-flag, but its public
+    op spans must enter the site inventory (the runtime recorder's
+    fallback attribution target)."""
+    path = os.path.join(REPO_ROOT, "paddle_tpu", "distributed",
+                        "collective.py")
+    sites = []
+    findings, errors = analyzer.analyze_paths([path], sites=sites)
+    assert not errors
+    assert not findings, [(f.rule, f.line) for f in findings]
+    assert {"all_reduce", "broadcast", "all_gather"} <= {
+        s["op"] for s in sites}
+
+
+# -- the shipped tree ---------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def tree_findings():
+    """One analysis of the shipped package, shared by the tree-level
+    tests (each in-process pass costs ~1s of suite wall-clock)."""
+    findings, errors = analyzer.analyze_paths(
+        [os.path.join(REPO_ROOT, "paddle_tpu")])
+    assert not errors
+    return findings
+
+
+def test_shipped_baseline_is_fresh_and_empty(tree_findings):
+    """ISSUE-17 triage: the shipped baseline is EMPTY — the one true
+    positive (rank-local resume) was fixed, reviewed degrade paths
+    carry inline waivers — and it matches today's analyzer output."""
+    findings = tree_findings
+    bl_path = os.path.join(REPO_ROOT, "tools", "distlint",
+                           "baseline.json")
+    bl = slib_baseline.load_baseline(bl_path)
+    new, baselined, _sup, _info, stale = slib_baseline.partition(
+        findings, bl)
+    assert not new, [(f.path, f.rule, f.symbol) for f in new]
+    assert not stale, stale
+    assert not baselined  # empty baseline: nothing to be baselined BY
+    assert json.load(open(bl_path))["fingerprints"] == {}
+
+
+def test_elastic_degrade_paths_carry_reviewed_waivers(tree_findings):
+    """The resume/rollback agreement's rank-local degrade paths (store
+    down, single-process mode) are intentional — every DL003 in
+    elastic.py must be waived, none baselined."""
+    dl003 = [f for f in tree_findings
+             if f.rule == "host-local-value-divergence"
+             and f.path.endswith("distributed/elastic.py")]
+    assert dl003 and all(f.suppressed for f in dl003), [
+        (f.line, f.suppressed) for f in dl003]
+
+
+def test_distlint_baseline_byte_identical(tree_findings):
+    from tools.distlint.__main__ import _COMMENT
+
+    findings = tree_findings
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as td:
+        out = os.path.join(td, "baseline.json")
+        slib_baseline.write_baseline(out, findings, _COMMENT)
+        with open(out, "rb") as f1, open(
+                os.path.join(REPO_ROOT, "tools", "distlint",
+                             "baseline.json"), "rb") as f2:
+            assert f1.read() == f2.read()
+
+
+# -- CLI contract -------------------------------------------------------------
+
+def _run_cli(*args):
+    return subprocess.run(
+        [sys.executable, "-m", "tools.distlint", *args],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=300)
+
+
+def test_cli_clean_tree_exits_zero():
+    r = _run_cli("paddle_tpu", "--fail-stale")
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+def test_cli_synthetic_violation_fails(tmp_path):
+    pkg = tmp_path / "synthpkg"
+    pkg.mkdir()
+    (pkg / "hot.py").write_text(textwrap.dedent('''
+        import paddle_tpu.distributed as dist
+
+
+        def sync(x):
+            if dist.get_rank() == 0:
+                dist.all_reduce(x)
+    '''))
+    r = _run_cli(str(pkg))
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "DL001" in r.stdout
+
+
+def test_cli_write_baseline_then_clean(tmp_path):
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "hot.py").write_text(textwrap.dedent('''
+        import paddle_tpu.distributed as dist
+
+
+        def sync(x):
+            if dist.get_rank() == 0:
+                dist.all_reduce(x)
+    '''))
+    bl = tmp_path / "baseline.json"
+    assert _run_cli(str(pkg), "--baseline", str(bl)).returncode == 1
+    assert _run_cli(str(pkg), "--baseline", str(bl),
+                    "--write-baseline").returncode == 0
+    r = _run_cli(str(pkg), "--baseline", str(bl))
+    assert r.returncode == 0, r.stdout
+    assert "baselined" in r.stdout
+    # fixing the debt leaves a stale entry: --fail-stale gates on it
+    (pkg / "hot.py").write_text("def sync(x):\n    return x\n")
+    assert _run_cli(str(pkg), "--baseline", str(bl)).returncode == 0
+    r = _run_cli(str(pkg), "--baseline", str(bl), "--fail-stale")
+    assert r.returncode == 1
+    assert "stale" in r.stdout
+
+
+# -- SARIF --------------------------------------------------------------------
+
+def test_sarif_round_trip(tmp_path):
+    d = tmp_path / "fx"
+    d.mkdir()
+    (d / "fixture_dist.py").write_text(FIXTURE)
+    out = tmp_path / "distlint.sarif"
+    r = _run_cli(str(d), "--no-baseline", "--sarif", str(out))
+    assert r.returncode == 1  # new findings on the fixture
+    doc = json.loads(out.read_text())
+    assert doc["version"] == "2.1.0"
+    run = doc["runs"][0]
+    assert run["tool"]["driver"]["name"] == "distlint"
+    rule_ids = {rr["id"] for rr in run["tool"]["driver"]["rules"]}
+    assert {"DL001", "DL002", "DL003", "DL004", "DL005", "DL006",
+            "DL007"} <= rule_ids
+    sarif_fps = {res["partialFingerprints"]["staticlibFingerprint/v1"]
+                 for res in run["results"]}
+    live, _ = analyzer.analyze_paths([str(d)])
+    assert {f.fingerprint() for f in live} == sarif_fps
+    suppressed = [res for res in run["results"]
+                  if res.get("suppressions")]
+    assert suppressed and all(
+        s["suppressions"][0]["kind"] == "inSource" for s in suppressed)
+
+
+# -- verify-runtime cross-reference (unit level) ------------------------------
+
+def test_cross_reference_confirms_and_reports_gaps(fixture_result):
+    from tools.distlint.verify import cross_reference
+
+    _, sites = fixture_result
+    anchor = next(s for s in sites if s["op"] == "all_reduce")
+    recorded = {
+        # exactly inside the anchor's span: confirmed
+        f"{anchor['path']}:{anchor['line']}": 7,
+        # an in-tree site far from every inventory entry: a recall gap
+        f"{anchor['path']}:9999": 2,
+        # a driver-script site: external, never a gap
+        "my_train.py:33": 1,
+        # the recorder's bounded-table overflow key: external too
+        "<overflow>": 4,
+    }
+    # roots must name the fixture tree the inventory paths live under
+    root = anchor["path"].split("/")[0]
+    rep = cross_reference(sites, recorded, roots=(root,))
+    confirmed = {(c["path"], c["line"], c["op"])
+                 for c in rep["confirmed"]}
+    assert (anchor["path"], anchor["line"], "all_reduce") in confirmed
+    assert len(rep["runtime_only"]) == 1
+    assert rep["runtime_only"][0]["site"].endswith(":9999")
+    assert {r["site"] for r in rep["external_sites"]} == {
+        "my_train.py:33", "<overflow>"}
+    assert rep["static_only"] == len(sites) - len(rep["confirmed"])
+
+
+# -- the runtime half: collective-schedule recorder ---------------------------
+
+@pytest.fixture
+def recorder():
+    from paddle_tpu.runtime import collective_schedule as cs
+
+    cs.reset()
+    yield cs
+    cs.reset()
+
+
+def _replay(cs, ops):
+    cs.reset()
+    for op in ops:
+        cs.note(op, "", (4,), "float32")
+    stats = cs.schedule_stats()
+    cs.reset()
+    return stats
+
+
+def test_recorder_counts_marks_and_tail(recorder):
+    cs = recorder
+    for i in range(cs.MARK_WINDOW * 2):
+        cs.note("all_reduce", "", (8,), "float32")
+    s = cs.schedule_stats()
+    assert s["enabled"] is True
+    assert s["seq"] == 2 * cs.MARK_WINDOW
+    assert [m[0] for m in s["marks"]] == [cs.MARK_WINDOW,
+                                          2 * cs.MARK_WINDOW]
+    assert s["marks"][-1][1] == s["fingerprint"]
+    assert s["per_op"] == {"all_reduce": 2 * cs.MARK_WINDOW}
+    assert len(s["recent"]) == 8  # bounded tail
+    hb = cs.heartbeat_payload()["csched"]
+    assert hb["seq"] == s["seq"] and hb["fp"] == s["fingerprint"]
+    assert hb["marks"] == s["marks"]
+
+
+def test_recorder_digest_is_schedule_sensitive(recorder):
+    """Two ranks with the same schedule share every mark; a single
+    divergent entry forks every mark from its window on — the
+    positional-comparability property the monitor's scan rides."""
+    cs = recorder
+    w = cs.MARK_WINDOW
+    a = _replay(cs, ["all_reduce"] * (2 * w))
+    b = _replay(cs, ["all_reduce"] * w + ["broadcast"]
+                + ["all_reduce"] * (w - 1))
+    same = _replay(cs, ["all_reduce"] * (2 * w))
+    assert a["fingerprint"] == same["fingerprint"]
+    assert a["marks"] == same["marks"]
+    # identical prefix: the first mark agrees; fork at entry w+1: the
+    # second mark (and the head fingerprint) disagree
+    assert a["marks"][0] == b["marks"][0]
+    assert a["marks"][1] != b["marks"][1]
+    assert a["fingerprint"] != b["fingerprint"]
+
+
+def test_recorder_aval_and_axis_feed_the_digest(recorder):
+    cs = recorder
+    a = _replay(cs, ["all_reduce"])
+    cs.reset()
+    cs.note("all_reduce", "", (8,), "float32")
+    b = cs.schedule_stats()
+    cs.reset()
+    cs.note("all_reduce", "dp", (4,), "float32")
+    c = cs.schedule_stats()
+    assert len({a["fingerprint"], b["fingerprint"],
+                c["fingerprint"]}) == 3
+
+
+def test_recorder_kill_switch(recorder, monkeypatch):
+    cs = recorder
+    monkeypatch.setenv("PADDLE_TPU_COLLECTIVE_SCHEDULE", "0")
+    assert cs.enabled() is False
+    cs.note("all_reduce", "", (8,), "float32")
+    s = cs.schedule_stats()
+    assert s["seq"] == 0 and s["marks"] == [] and s["recent"] == []
+    assert cs.heartbeat_payload() == {}
+
+
+def test_heartbeat_payload_empty_before_first_collective(recorder):
+    assert recorder.heartbeat_payload() == {}
+
+
+def test_dispatch_stats_parity_with_recorder_killed(monkeypatch):
+    """PADDLE_TPU_COLLECTIVE_SCHEDULE=0 removes the schedule CONTENT
+    but never the dispatch-stats shape: every other key survives."""
+    from paddle_tpu.core import dispatch
+
+    base = dispatch.dispatch_stats()
+    assert "collectives" in base
+    monkeypatch.setenv("PADDLE_TPU_COLLECTIVE_SCHEDULE", "0")
+    killed = dispatch.dispatch_stats()
+    assert killed["collectives"]["enabled"] is False
+    assert set(killed) == set(base)
+
+
+def test_statusz_payload_carries_collectives(recorder):
+    from paddle_tpu.runtime import diagnostics
+
+    recorder.note("all_reduce", "", (8,), "float32")
+    payload = diagnostics._statusz_payload()
+    assert payload["collectives"]["seq"] == 1
+
+
+# -- heartbeat publication + monitor divergence scan --------------------------
+
+def test_tick_publishes_schedule_fingerprint(tmp_path, recorder):
+    from paddle_tpu.distributed.coordination import (
+        DirectoryStore, ClusterContext, read_heartbeats,
+    )
+    from paddle_tpu.distributed.elastic import ElasticManager
+
+    recorder.note("all_reduce", "", (8,), "float32")
+    store = DirectoryStore(str(tmp_path / "store"))
+    ctx = ClusterContext(store, rank=0, world_size=1)
+    em = ElasticManager(str(tmp_path / "ckpt"), timeout=9999,
+                        cluster=ctx)
+    assert em.tick(1)
+    hb = read_heartbeats(store)[0]
+    assert hb["csched"]["seq"] == 1
+    assert hb["csched"]["fp"]
+    assert hb["csched"]["tail"][0][1] == "all_reduce"
+
+
+def test_tick_without_recorder_publishes_no_csched(tmp_path, recorder,
+                                                   monkeypatch):
+    from paddle_tpu.distributed.coordination import (
+        DirectoryStore, ClusterContext, read_heartbeats,
+    )
+    from paddle_tpu.distributed.elastic import ElasticManager
+
+    monkeypatch.setenv("PADDLE_TPU_COLLECTIVE_SCHEDULE", "0")
+    store = DirectoryStore(str(tmp_path / "store"))
+    ctx = ClusterContext(store, rank=0, world_size=1)
+    em = ElasticManager(str(tmp_path / "ckpt"), timeout=9999,
+                        cluster=ctx)
+    assert em.tick(1)
+    assert "csched" not in read_heartbeats(store)[0]
+
+
+def test_monitor_sched_points_tolerates_malformed_marks():
+    from paddle_tpu.distributed.coordination import ClusterMonitor
+
+    pts = ClusterMonitor._sched_points(
+        {"seq": 20, "fp": "head",
+         "marks": [[16, "m16"], ["junk"], None, [32]]})
+    assert pts == {16: "m16", 20: "head"}
+    assert ClusterMonitor._sched_points({}) == {}
+
+
+def test_monitor_flags_schedule_divergence(tmp_path, monkeypatch,
+                                           recorder):
+    """The divergence protocol at unit level: a common marked seq with
+    differing digests raises collective_divergence ONCE per pair (the
+    scan keeps reporting the pair), with the two-sided diff in the
+    fault detail and the postmortem bundle."""
+    from paddle_tpu.distributed.coordination import (
+        ClusterMonitor, DirectoryStore,
+    )
+    from paddle_tpu.runtime import diagnostics, resilience
+
+    cs = recorder
+    w = cs.MARK_WINDOW
+    a = _replay(cs, ["all_reduce"] * (2 * w))
+    b = _replay(cs, ["all_reduce"] * w + ["broadcast"]
+                + ["all_reduce"] * (w - 1))
+
+    def csched(stats):
+        return {"seq": stats["seq"], "fp": stats["fingerprint"],
+                "marks": stats["marks"],
+                "tail": stats["recent"]}
+
+    monkeypatch.setenv("PADDLE_TPU_DIAGNOSTICS_DIR",
+                       str(tmp_path / "diag"))
+    mon = ClusterMonitor(DirectoryStore(str(tmp_path / "store")),
+                         rank=0, world_size=2, stale_after=30.0,
+                         dead_after=60.0)
+    live = {0: {"csched": csched(a)}, 1: {"csched": csched(b)}}
+    before = resilience.fault_events().get("collective_divergence", 0)
+    # identical schedules: no divergence, no fault
+    assert mon._scan_schedules(
+        {0: {"csched": csched(a)}, 1: {"csched": csched(a)}}) == []
+    # fork at entry w+1: first divergent common point is the 2nd mark
+    assert mon._scan_schedules(live) == [[0, 1, 2 * w]]
+    after = resilience.fault_events().get("collective_divergence", 0)
+    assert after == before + 1
+    # latched: the pair keeps reporting, the fault fires once
+    assert mon._scan_schedules(live) == [[0, 1, 2 * w]]
+    assert resilience.fault_events().get(
+        "collective_divergence", 0) == after
+    # the two-sided diff survives into the postmortem bundle
+    bundle = diagnostics.read_bundle(diagnostics.last_bundle_path())
+    assert bundle["reason"] == "collective_divergence"
+    diff = bundle["extra"]["collective_divergence"]
+    assert diff["ranks"] == [0, 1]
+    assert diff["first_divergent_seq"] == 2 * w
+    assert set(diff["fp"]) == {"0", "1"}
+    assert diff["fp"]["0"] != diff["fp"]["1"]
+
+
+def test_monitor_poll_scan_includes_schedule_divergence(tmp_path):
+    """poll()'s scan dict carries the (empty) schedule_divergence list
+    even with no peers — the /statusz and smoke consumers key on it."""
+    from paddle_tpu.distributed.coordination import (
+        ClusterMonitor, DirectoryStore, publish_heartbeat,
+    )
+
+    store = DirectoryStore(str(tmp_path))
+    publish_heartbeat(store, 0, 1)
+    mon = ClusterMonitor(store, rank=0, world_size=1,
+                         stale_after=30.0, dead_after=60.0)
+    scan = mon.poll()
+    assert scan["schedule_divergence"] == []
+
+
+# -- rollback/resume agreement (ROADMAP item 3 divergence gap) ----------------
+
+def _complete_steps_dir(tmp_path, steps):
+    d = str(tmp_path / "ckpt")
+    os.makedirs(d, exist_ok=True)
+    for s in steps:
+        os.makedirs(os.path.join(d, str(s)), exist_ok=True)
+    return d
+
+
+def test_cluster_resume_uses_common_step_not_local_newest(tmp_path):
+    """Rank 0 holds steps {2,3,4}, the peer publication only {2,3}:
+    cluster resume must agree on 3 — restoring the rank-local newest 4
+    is exactly the divergence distlint DL003 flags."""
+    import time as _time
+
+    from paddle_tpu.distributed.coordination import (
+        ClusterContext, DirectoryStore,
+    )
+    from paddle_tpu.distributed.elastic import ElasticManager
+
+    d = _complete_steps_dir(tmp_path, [2, 3, 4])
+    store = DirectoryStore(str(tmp_path / "store"))
+    store.put("ckpt/rank_1", {"rank": 1, "steps": [2, 3],
+                              "wall": _time.time()})
+    ctx = ClusterContext(store, rank=0, world_size=2)
+    em = ElasticManager(d, timeout=9999, cluster=ctx)
+    seen = []
+    assert em.resume(seen.append) == 4  # continue AFTER the agreed 3
+    assert seen == [3]
+
+
+def test_agreed_rollback_step_intersects_publications(tmp_path):
+    import time as _time
+
+    from paddle_tpu.distributed.coordination import (
+        ClusterContext, DirectoryStore,
+    )
+    from paddle_tpu.distributed.elastic import agreed_rollback_step
+
+    d = _complete_steps_dir(tmp_path, [2, 3, 4])
+    store = DirectoryStore(str(tmp_path / "store"))
+    store.put("ckpt/rank_1", {"rank": 1, "steps": [2, 3],
+                              "wall": _time.time()})
+    ctx = ClusterContext(store, rank=0, world_size=2)
+    assert agreed_rollback_step(ctx, d, bad_step=7,
+                                rendezvous_timeout=2.0) == 3
+
+
+def test_single_process_resume_unchanged(tmp_path):
+    """No cluster: resume keeps the rank-local contract (the reviewed
+    waiver in elastic.py documents it)."""
+    from paddle_tpu.distributed.elastic import ElasticManager
+
+    d = _complete_steps_dir(tmp_path, [2, 5])
+    em = ElasticManager(d, timeout=9999)
+    seen = []
+    assert em.resume(seen.append) == 6
+    assert seen == [5]
+
+
+# -- staticcheck: telemetry schema consistency --------------------------------
+
+def test_schema_consistency_clean_on_tree():
+    from tools.staticcheck import schema_consistency
+
+    rc, report = schema_consistency(
+        [os.path.join(REPO_ROOT, "paddle_tpu")])
+    assert rc == 0, report["problems"]
+    assert report["problems"] == []
+    assert report["declared"]["fault_kinds"] == \
+        report["used"]["fault_kinds"]
+
+
+def test_schema_consistency_flags_undeclared_kind(tmp_path):
+    from tools.staticcheck import schema_consistency
+
+    (tmp_path / "m.py").write_text(textwrap.dedent('''
+        from paddle_tpu.runtime.resilience import record_fault
+
+
+        def f():
+            record_fault("totally_new_kind", "detail")
+    '''))
+    rc, report = schema_consistency([str(tmp_path)])
+    assert rc == 1
+    assert any("totally_new_kind" in p and "not declared" in p
+               for p in report["problems"])
+
+
+def test_schema_consistency_sees_aliased_and_counter_literals(tmp_path):
+    """The scanner's two blind-spot fixes: `_record_fault` aliases and
+    `counter=` keyword literals both count as uses."""
+    from tools.staticcheck import _kind_literals
+
+    (tmp_path / "m.py").write_text(textwrap.dedent('''
+        def f(_record_fault, retry):
+            _record_fault("aliased_kind", "x")
+            retry(lambda: 0, counter="kw_kind")
+    '''))
+    faults, _events = _kind_literals([str(tmp_path)])
+    assert {"aliased_kind", "kw_kind"} <= set(faults)
+
+
+def test_declared_fault_kinds_match_schema_file():
+    from paddle_tpu.runtime.resilience import _EVENT_KINDS
+
+    with open(os.path.join(REPO_ROOT, "tools",
+                           "telemetry_schema.json")) as f:
+        schema = json.load(f)
+    assert sorted(_EVENT_KINDS) == schema["fault_kinds"]
+    assert "collective_divergence" in schema["fault_kinds"]

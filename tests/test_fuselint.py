@@ -416,7 +416,7 @@ def test_sarif_output_tracelint_and_threadlint(tmp_path):
 
 # -- staticcheck unified entry point ------------------------------------------
 
-def test_staticcheck_runs_all_three_clean(tmp_path):
+def test_staticcheck_runs_all_tools_clean(tmp_path):
     out = tmp_path / "combined.json"
     r = subprocess.run(
         [sys.executable, "tools/staticcheck.py", "paddle_tpu",
@@ -426,10 +426,11 @@ def test_staticcheck_runs_all_three_clean(tmp_path):
     doc = json.loads(out.read_text())
     assert doc["staticcheck"]["clean"] is True
     assert set(doc["staticcheck"]["ran"]) == {
-        "tracelint", "threadlint", "fuselint"}
-    for tool in ("tracelint", "threadlint", "fuselint"):
+        "tracelint", "threadlint", "fuselint", "distlint", "schema"}
+    for tool in ("tracelint", "threadlint", "fuselint", "distlint"):
         assert doc["tools"][tool]["summary"]["new"] == 0
         assert doc["tools"][tool]["exit_code"] == 0
+    assert doc["tools"]["schema"]["problems"] == []
 
 
 def test_staticcheck_fails_on_violation(tmp_path):

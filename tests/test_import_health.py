@@ -74,10 +74,14 @@ def test_jax_compat_exports(symbol):
     "tools.fuselint.analyzer",
     "tools.fuselint.rules",
     "tools.fuselint.verify",
+    "tools.distlint",
+    "tools.distlint.analyzer",
+    "tools.distlint.rules",
+    "tools.distlint.verify",
     "tools.staticcheck",
 ])
 def test_analysis_tooling_imports(name):
-    """The static-analysis stack (shared staticlib core + all three
+    """The static-analysis stack (shared staticlib core + all four
     analyzers + the unified staticcheck entry) must import cleanly —
     CI's lint gates run through these modules, so an import break here
     silently disables the gates."""

@@ -1,9 +1,11 @@
 #!/usr/bin/env bash
 # One-command static gate: staticcheck (tracelint + threadlint +
-# fuselint with their freshness gates) + fuselint runtime
-# cross-reference + import health, plus the fast resilience/warm-start/
-# fusion-parity/telemetry/multihost smokes and the cluster crash
-# acceptance (~4 min total) — run before pushing; CI runs the same line.
+# fuselint + distlint with their freshness gates, plus the telemetry
+# schema-consistency pass) + the fuselint/distlint runtime
+# cross-references + import health, plus the fast resilience/warm-start/
+# fusion-parity/telemetry/multihost/divergence smokes and the cluster
+# crash acceptance (~4 min total) — run before pushing; CI runs the
+# same line.
 #
 #   ./tools/ci_check.sh
 #
@@ -13,15 +15,20 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== staticcheck (tracelint + threadlint + fuselint + runtime anchor) =="
+echo "== staticcheck (tracelint + threadlint + fuselint + distlint + runtime anchors) =="
 # one command runs every static analyzer with its freshness gate:
 # tracelint (jit-safety + stale-manifest check), threadlint
 # (concurrency + stale-baseline check), fuselint (fusion barriers +
-# stale-baseline check) — new findings, parse errors, or stale debt in
-# any tool fail here. --verify-runtime rides on fuselint's SINGLE pass:
-# a child runs the bench MLP train step under fusion and the static
-# findings must cross-reference the runtime flush-site attribution
-# (>= 1 confirmed, no uncovered in-tree sites)
+# stale-baseline check), distlint (cross-rank divergence + stale-
+# baseline check), and the telemetry schema-consistency pass (every
+# record_fault/emit kind literal declared, every declared kind used) —
+# new findings, parse errors, or stale debt in any tool fail here.
+# --verify-runtime rides on each tool's SINGLE pass: fuselint's child
+# runs the bench MLP train step under fusion and the static findings
+# must cross-reference the runtime flush-site attribution; distlint's
+# child issues eager collectives and the static collective-site
+# inventory must cross-reference the runtime schedule recorder
+# (>= 1 confirmed, no uncovered in-tree sites, per tool)
 JAX_PLATFORMS=cpu python tools/staticcheck.py paddle_tpu --verify-runtime
 
 echo "== import health (every submodule imports on CPU) =="
@@ -66,6 +73,15 @@ echo "== multihost smoke (coordination store + quorum + merge) =="
 # and a quorum-stall watchdog that must exit NONZERO once every rank
 # goes silent
 JAX_PLATFORMS=cpu python tools/multihost_smoke.py
+
+echo "== distlint smoke (cross-rank collective-divergence detection) =="
+# 2-process CPU cluster over a tmpdir store: rank 1 carries an injected
+# rank-conditional collective (the DL001 bug shape, live); BOTH ranks'
+# monitors must flag collective_divergence well before the dead-peer
+# deadline, the merged host-0 fault log must carry both ranks' schedule
+# tails, and each rank's postmortem bundle must hold the two-sided
+# schedule diff
+JAX_PLATFORMS=cpu python tools/distlint_smoke.py
 
 echo "== cluster crash-consistency acceptance (3-rank SIGKILL) =="
 # the PR-6 acceptance proof (slow-marked out of the tier-1 budget run):

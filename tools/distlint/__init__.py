@@ -1,0 +1,25 @@
+"""distlint — static cross-rank divergence & collective-deadlock
+analysis for the paddle_tpu distributed layer.
+
+Fourth analyzer on the shared tools/staticlib core (after tracelint's
+jit-safety pass, threadlint's concurrency pass, and fuselint's
+fusion-barrier pass). Where those three audit a single process,
+distlint audits the SPMD contract ACROSS processes: every rank must
+issue the same collectives in the same order with replicated operands,
+or the job deadlocks (mismatched schedules), silently diverges
+(host-local values in replicated math), or wedges the coordination
+layer against the collective layer. The catalog covers rank-gated
+collectives, divergent per-branch collective schedules, host-local
+taint reaching collective operands, unbound mesh axis names,
+store-waits issued under an in-flight collective, ungated leader-only
+writes, and collectives inside fusion-suspend regions.
+
+The runtime half mirrors fuselint's static<->runtime loop: the
+collective layer records a bounded per-rank schedule
+(dispatch_stats()["collectives"]), each rank publishes a rolling
+schedule fingerprint over the CoordinationStore heartbeat path, and
+ClusterMonitor names a mismatch as a `collective_divergence` fault in
+seconds instead of a dead-peer timeout. --verify-runtime
+cross-references the static collective-site inventory against the
+schedule sites the runtime actually recorded.
+"""

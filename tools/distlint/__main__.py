@@ -1,0 +1,136 @@
+"""CLI: python -m tools.distlint <roots...> [options].
+
+Exit codes: 0 clean (or baselined-only), 1 new findings, parse errors,
+(with --fail-stale) stale baseline entries, or a failed
+--verify-runtime cross-reference, 2 usage error.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from ..staticlib.baseline import load_baseline, partition, write_baseline
+from ..staticlib.report import (
+    human_report, json_report, write_json, write_sarif,
+)
+from .analyzer import analyze_paths
+from .rules import RULES
+
+DEFAULT_BASELINE = os.path.join(os.path.dirname(__file__), "baseline.json")
+
+_COMMENT = ("distlint suppression baseline — regenerate with "
+            "`python -m tools.distlint paddle_tpu "
+            "--write-baseline` after reviewing that every new "
+            "finding is a rank-role divergence the protocol "
+            "intends, not a collective-schedule regression.")
+
+
+def build_parser():
+    p = argparse.ArgumentParser(
+        prog="python -m tools.distlint",
+        description="static cross-rank divergence and collective-"
+                    "deadlock analyzer for the paddle_tpu distributed "
+                    "layer (see docs/DISTLINT.md)")
+    p.add_argument("roots", nargs="*", default=["paddle_tpu"],
+                   help="package dirs or files to analyze (paddle_tpu)")
+    p.add_argument("--baseline", default=DEFAULT_BASELINE,
+                   help=f"baseline file (default {DEFAULT_BASELINE})")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="report every finding as new (ignore baseline)")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="rewrite the baseline from current findings "
+                        "and exit 0")
+    p.add_argument("--json", metavar="PATH",
+                   help="also write the machine-readable report here")
+    p.add_argument("--sarif", metavar="PATH",
+                   help="also write a SARIF 2.1.0 report here (CI "
+                        "code-scanning annotations)")
+    p.add_argument("--fail-stale", action="store_true",
+                   help="exit nonzero on stale baseline entries too "
+                        "(CI freshness gate)")
+    p.add_argument("--verify-runtime", action="store_true",
+                   help="additionally run a small eager collective "
+                        "workload in a child process and cross-"
+                        "reference the static collective-site "
+                        "inventory against the runtime schedule "
+                        "recorder's site attribution "
+                        "(dispatch_stats()['collectives']['sites'])")
+    p.add_argument("--verify-json", metavar="PATH",
+                   help="write the --verify-runtime report here")
+    p.add_argument("--verify-child", action="store_true",
+                   help=argparse.SUPPRESS)  # internal: the workload
+    p.add_argument("-v", "--verbose", action="store_true",
+                   help="itemize baselined/waived/info findings too")
+    return p
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
+    if args.verify_child:
+        from .verify import run_child
+
+        run_child()
+        return 0
+    for r in args.roots:
+        if not os.path.exists(r):
+            print(f"distlint: no such path: {r}", file=sys.stderr)
+            return 2
+
+    # the site inventory (every collective call/impl site, finding or
+    # not) feeds --verify-runtime: a CLEAN tree has zero findings but
+    # must still cross-reference its sites against the recorder
+    sites = []
+    findings, errors = analyze_paths(args.roots, sites=sites)
+
+    if args.write_baseline:
+        if errors:
+            # a baseline written while files are unparseable silently
+            # drops their debt; the next clean run would gate on it
+            for p, m in errors:
+                print(f"{p}: PARSE ERROR — {m}", file=sys.stderr)
+            print("distlint: refusing to write a baseline while files "
+                  "fail to parse", file=sys.stderr)
+            return 1
+        counts = write_baseline(args.baseline, findings, _COMMENT)
+        print(f"distlint: baseline written to {args.baseline} "
+              f"({sum(counts.values())} findings, "
+              f"{len(counts)} distinct fingerprints)")
+        return 0
+
+    baseline = {} if args.no_baseline else load_baseline(args.baseline)
+    new, baselined, suppressed, info, stale = partition(findings, baseline)
+
+    print(human_report(new, baselined, suppressed, info, stale, errors,
+                       tool="distlint", rules=RULES,
+                       verbose=args.verbose))
+    if args.json:
+        write_json(args.json, json_report(new, baselined, suppressed, info,
+                                          stale, errors, rules=RULES))
+    if args.sarif:
+        write_sarif(args.sarif, new, baselined, suppressed, info, errors,
+                    tool="distlint", rules=RULES)
+    rc = 0
+    if new or errors:
+        rc = 1
+    elif args.fail_stale and stale:
+        print("distlint: stale baseline entries above — the debt was "
+              "fixed; shrink the baseline with --write-baseline",
+              file=sys.stderr)
+        rc = 1
+    if args.verify_runtime:
+        from .verify import run_verify
+
+        # sites carry paths relative to each root's PARENT — pass the
+        # same normalized names so in-tree/external classification
+        # matches the analysis
+        roots = [os.path.basename(os.path.normpath(r))
+                 for r in args.roots]
+        vrc = run_verify(sites, json_path=args.verify_json,
+                         roots=roots)
+        rc = rc or vrc
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

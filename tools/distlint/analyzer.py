@@ -1,0 +1,831 @@
+"""AST cross-rank divergence analysis over the paddle_tpu distributed
+storey (`distributed/`, `optimizer/`, `io/checkpoint.py`).
+
+The SPMD contract the collective layer runs on is simple and brutal:
+every rank issues the SAME collectives in the SAME order with
+REPLICATED operands. The three sibling analyzers audit one process;
+distlint audits that cross-process contract, file-locally and
+approximately, without ever importing the code it inspects:
+
+* **rank taint** — a name-level "this value differs per rank" marker
+  seeded from rank/process-index reads (`get_rank()`, `.rank`,
+  `axis_index(...)`) and propagated through assignments. A collective
+  under a rank-tainted branch with no matching collective on the
+  sibling branch is the classic deadlock (DL001); two branches that
+  both issue collectives but in different sequences (compared one
+  call-graph level deep) are a schedule divergence (DL002).
+* **host-local taint** — tools/staticlib NameTaint re-seeded with a
+  host-local source vocabulary (wall-clock, pid, hostname, unseeded
+  generators, rank-local disk scans). Where it reaches a symmetric
+  collective operand, a sharded init, a restore decision, or a trace
+  fingerprint, ranks compute different values where SPMD assumes one
+  (DL003). Seeded generators and agreement/broadcast results are
+  sanitizers — the fix routes must never re-flag.
+* **schedule structure** — axis-name literals not bound by any mesh
+  declaration in the analyzed tree (DL004), coordination-store waits
+  reachable while a collective is in flight on the same source-order
+  path (DL005), leader-only artifact writes with no rank gate (DL006),
+  and collectives inside fusion-suspend regions (DL007).
+
+The analyzer also emits a **collective-site inventory** — every
+collective call site plus the public implementation spans in
+distributed/collective.py — which --verify-runtime (verify.py)
+cross-references against the schedule sites the runtime recorder
+(paddle_tpu/runtime/collective_schedule.py) actually observed.
+
+Residual false positives are absorbed by reviewed inline waivers
+(`# distlint: ok[rule]`) and the checked fingerprint baseline, exactly
+like the three sibling analyzers — never by weakening detection.
+"""
+from __future__ import annotations
+
+import ast
+import os
+
+from ..staticlib import findings as _findings
+from ..staticlib.astnav import (
+    ScopeIndex, dotted, func_params,
+    iter_py_files as _iter_py_files, relpath as _relpath,
+    runtime_first_line,
+)
+from ..staticlib.callgraph import CallGraph
+from ..staticlib.taint import NameTaint, body_nodes as _taint_body_nodes
+from ..staticlib.waivers import suppressed as _waiver_suppressed
+from .rules import RULES
+
+__all__ = ["Finding", "analyze_file", "analyze_paths", "iter_py_files",
+           "COLLECTIVE_OPS"]
+
+SKIP_DIRS = {"__pycache__", ".git", "libs", "include"}
+TOOL = "distlint"
+
+# the collective layer itself: its rank-asymmetric eager bodies and
+# dynamic axis plumbing ARE the implementation of the protocol, not
+# clients of it (absolute-path suffix so single-file analysis of
+# collective.py is exempt too, while a fixture named collective.py
+# is not)
+MACHINERY_SUFFIXES = ("paddle_tpu/distributed/collective.py",)
+
+_FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+# ---------------------------------------------------------------------------
+# collective vocabulary
+
+# paddle-style process-group collectives + jax per-axis collectives
+_PADDLE_COLLECTIVES = {
+    "all_reduce", "all_gather", "all_gather_object", "broadcast",
+    "reduce", "scatter", "reduce_scatter", "alltoall",
+    "alltoall_single", "send", "recv", "isend", "irecv", "barrier",
+    "p2p_permute",
+}
+_JAX_COLLECTIVES = {
+    "psum", "pmean", "pmax", "pmin", "ppermute", "pshuffle",
+    "all_to_all", "psum_scatter", "axis_index",
+}
+COLLECTIVE_OPS = _PADDLE_COLLECTIVES | _JAX_COLLECTIVES
+# names too generic to trust bare: require a collective-looking head
+# or a from-import out of the collective layer
+_AMBIGUOUS_OPS = {"all_gather", "broadcast", "reduce", "scatter",
+                  "send", "recv", "axis_index"}
+_COLLECTIVE_HEADS = {"dist", "distributed", "collective", "collectives",
+                     "lax", "jax"}
+_NONCOLLECTIVE_HEADS = {"np", "numpy", "jnp", "functools", "itertools",
+                        "operator", "socket", "sock", "conn", "pickle",
+                        "struct", "queue"}
+# axis_index reads a rank, it does not rendezvous — it taints (rank
+# vocabulary below) but is not itself a schedule entry
+_NON_SCHEDULE_OPS = {"axis_index"}
+
+# collectives whose SEMANTICS assume replicated operands: host-local
+# taint flowing in is silent divergence. broadcast/scatter/send are
+# asymmetric by design — feeding a host-local value into broadcast
+# from the source rank is the sanctioned way to REPLICATE it.
+_SYMMETRIC_OPS = COLLECTIVE_OPS - {
+    "broadcast", "scatter", "send", "isend", "recv", "irecv",
+    "barrier", "axis_index",
+}
+
+# ---------------------------------------------------------------------------
+# rank taint vocabulary (DL001/DL002/DL006)
+
+RANK_CALLS = {"get_rank", "process_index", "axis_index", "local_rank",
+              "node_rank", "cluster_rank", "get_world_rank", "rank",
+              "is_leader", "_is_leader"}
+RANK_ATTRS = {"rank", "local_rank", "node_rank", "process_index",
+              "is_leader", "leader"}
+RANK_PARAM_NAMES = {"rank", "local_rank", "rank_id", "process_index",
+                    "node_rank", "src_rank", "leader"}
+
+# ---------------------------------------------------------------------------
+# host-local taint vocabulary (DL002 test taint + DL003)
+
+# calls whose result differs per host/process by construction
+HOST_SOURCE_TAILS = {"time", "time_ns", "monotonic", "monotonic_ns",
+                     "perf_counter", "perf_counter_ns", "getpid",
+                     "gethostname", "getfqdn", "uname", "urandom",
+                     "uuid1", "uuid4"}
+# rank-local disk scans: each rank sees its own retention window — a
+# restore decision made from one diverges past what peers still hold
+LOCAL_DISK_TAILS = {"latest_checkpoint", "latest_complete_step"}
+# seedable generator constructors: WITH arguments the stream is
+# replicated (the seeded-generator precision contract); argless they
+# pull OS entropy and every rank gets a different stream
+_SEEDABLE_CTORS = {"RandomState", "default_rng", "Generator", "PRNGKey",
+                   "key"}
+# results that are replicated/agreed no matter what flowed in — the
+# fix routes distlint recommends, so they must never re-flag
+HOST_SANITIZERS = {"broadcast", "all_reduce", "all_gather",
+                   "rendezvous", "latest_common_complete_step",
+                   "isinstance", "hasattr", "callable", "type", "len"}
+HOST_STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "weak_type"}
+
+# DL003 non-collective sinks
+RESTORE_SINKS = {"restore", "restore_fn", "load_checkpoint",
+                 "discard_after", "set_state_dict"}
+FINGERPRINT_SINKS = {"fingerprint", "trace_fingerprint", "cache_key"}
+INIT_SINKS = {"device_put", "with_sharding_constraint", "shard"}
+
+# ---------------------------------------------------------------------------
+# DL004 vocabulary
+
+MESH_DECLS = {"Mesh", "AbstractMesh", "make_mesh", "world_mesh",
+              "create_device_mesh", "mesh_axes"}
+AXIS_USERS = {"psum", "pmean", "pmax", "pmin", "ppermute", "pshuffle",
+              "all_to_all", "psum_scatter", "axis_index", "shard_map"}
+SPEC_CTORS = {"PartitionSpec", "P", "NamedSharding"}
+AXIS_KWARGS = {"axis_name", "axis_names", "axis"}
+
+# ---------------------------------------------------------------------------
+# DL005 vocabulary
+
+COLLECTIVE_WAITS = {"wait", "block_until_ready", "synchronize"}
+COORD_WAITS = {"rendezvous", "latest_common_complete_step",
+               "wait_for_peers", "poll_until", "wait_rendezvous"}
+
+# ---------------------------------------------------------------------------
+# DL006 vocabulary
+
+LEADER_WRITES = {"merge_cluster", "merge_traces", "publish_registry",
+                 "write_manifest", "merge_telemetry"}
+
+
+# ---------------------------------------------------------------------------
+# model
+
+class Finding(_findings.Finding):
+    """distlint finding: the shared record bound to the DL catalog."""
+
+    RULES = RULES
+
+
+# ---------------------------------------------------------------------------
+# collective-call classification
+
+def _collective_op(call, imported_collectives=frozenset()):
+    """The collective op name a call issues, or None."""
+    d = dotted(call.func)
+    if not d:
+        return None
+    tail = d[-1]
+    if tail not in COLLECTIVE_OPS:
+        return None
+    if d[0] in _NONCOLLECTIVE_HEADS:
+        return None
+    if tail in _AMBIGUOUS_OPS:
+        if len(d) == 1:
+            return tail if tail in imported_collectives else None
+        if d[0] not in _COLLECTIVE_HEADS:
+            return None
+    return tail
+
+
+def _str_constants(node):
+    for n in ast.walk(node):
+        if isinstance(n, ast.Constant) and isinstance(n.value, str):
+            yield n.value
+
+
+def module_axis_bindings(tree):
+    """Axis names BOUND somewhere in a module: string literals inside a
+    mesh-declaration call, plus string defaults of axis_name(s)
+    parameters (the `def world_mesh(axis_name="dp")` shape)."""
+    bound = set()
+    for n in ast.walk(tree):
+        if isinstance(n, ast.Call):
+            d = dotted(n.func)
+            if d and d[-1] in MESH_DECLS:
+                bound.update(_str_constants(n))
+        elif isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            a = n.args
+            pos = list(a.posonlyargs) + list(a.args)
+            for p, dflt in zip(pos[len(pos) - len(a.defaults):],
+                               a.defaults):
+                if p.arg in AXIS_KWARGS:
+                    bound.update(_str_constants(dflt))
+            for p, dflt in zip(a.kwonlyargs, a.kw_defaults):
+                if p.arg in AXIS_KWARGS and dflt is not None:
+                    bound.update(_str_constants(dflt))
+    return bound
+
+
+# ---------------------------------------------------------------------------
+# per-function analysis
+
+class _FnChecker:
+    def __init__(self, module, fnode):
+        self.m = module
+        self.fnode = fnode
+        self.scopes = module.scopes
+        self.qual = module.scopes.qualname(fnode)
+        self.func_name = (fnode.name if not isinstance(fnode, ast.Lambda)
+                          else "<lambda>")
+        self.func_line = runtime_first_line(fnode)
+
+        # host-local taint: re-seed NameTaint from the source
+        # vocabulary (its default seeds — no-default params — model
+        # "traced array", the wrong property here)
+        self.host = NameTaint(fnode, static_attrs=HOST_STATIC_ATTRS,
+                              sanitizer_calls=HOST_SANITIZERS)
+        seeds = set()
+        for n in _taint_body_nodes(fnode):
+            tgts = None
+            if isinstance(n, ast.Assign):
+                tgts, val = n.targets, n.value
+            elif isinstance(n, (ast.AugAssign, ast.AnnAssign,
+                                ast.NamedExpr)):
+                tgts, val = [n.target], getattr(n, "value", None)
+            if tgts and val is not None and self._has_host_source(val):
+                for t in tgts:
+                    for nm in ast.walk(t):
+                        if isinstance(nm, ast.Name):
+                            seeds.add(nm.id)
+        self.host.tainted = seeds
+        self.host.propagate()
+
+        self.rank_names = self._collect_rank_names()
+
+    # -- host-local sources -------------------------------------------------
+    @staticmethod
+    def _is_host_source(call):
+        d = dotted(call.func)
+        if not d:
+            return False
+        tail = d[-1]
+        if tail in _SEEDABLE_CTORS:
+            # seeded = replicated stream; argless = OS entropy per rank
+            return not call.args and not call.keywords
+        if tail in HOST_SOURCE_TAILS or tail in LOCAL_DISK_TAILS:
+            return True
+        # module-level random.* / np.random.* draws share one unseeded
+        # process-global stream (a bound generator `rng.x()` has a Name
+        # head that is only tainted if its ctor was argless)
+        return "random" in d[:-1] and tail not in _SEEDABLE_CTORS
+
+    def _has_host_source(self, expr):
+        return any(isinstance(n, ast.Call) and self._is_host_source(n)
+                   for n in ast.walk(expr))
+
+    def _host_tainted(self, expr):
+        return self.host.expr_tainted(expr) or self._has_host_source(expr)
+
+    def _host_evidence(self, expr):
+        names = self.host.taint_names(expr)
+        if names:
+            return names
+        for n in ast.walk(expr):
+            if isinstance(n, ast.Call) and self._is_host_source(n):
+                d = dotted(n.func)
+                return [".".join(d)]
+        return ["<expr>"]
+
+    # -- rank taint ---------------------------------------------------------
+    def _collect_rank_names(self):
+        names = {p for p in func_params(self.fnode)[0]
+                 if p in RANK_PARAM_NAMES}
+        for _ in range(3):
+            changed = False
+            for n in _taint_body_nodes(self.fnode):
+                tgts = None
+                if isinstance(n, ast.Assign):
+                    tgts, val = n.targets, n.value
+                elif isinstance(n, (ast.AugAssign, ast.AnnAssign,
+                                    ast.NamedExpr)):
+                    tgts, val = [n.target], getattr(n, "value", None)
+                if not tgts or val is None or \
+                        not self._rank_expr(val, names):
+                    continue
+                for t in tgts:
+                    for nm in ast.walk(t):
+                        if isinstance(nm, ast.Name) \
+                                and nm.id not in names:
+                            names.add(nm.id)
+                            changed = True
+            if not changed:
+                break
+        return names
+
+    def _rank_expr(self, expr, names=None):
+        names = self.rank_names if names is None else names
+        for n in ast.walk(expr):
+            if isinstance(n, ast.Call):
+                d = dotted(n.func)
+                if d and d[-1] in RANK_CALLS:
+                    return True
+            elif isinstance(n, ast.Attribute) and n.attr in RANK_ATTRS:
+                return True
+            elif isinstance(n, ast.Name) and n.id in names:
+                return True
+        return False
+
+    def _rank_describe(self, expr):
+        for n in ast.walk(expr):
+            if isinstance(n, ast.Call):
+                d = dotted(n.func)
+                if d and d[-1] in RANK_CALLS:
+                    return ".".join(d) + "()"
+            if isinstance(n, ast.Attribute) and n.attr in RANK_ATTRS:
+                return "." + n.attr
+            if isinstance(n, ast.Name) and n.id in self.rank_names:
+                return n.id
+        return "<rank>"
+
+    # -- plumbing -----------------------------------------------------------
+    def _body(self):
+        """Own-body nodes only: nested defs/lambdas get their own
+        checker (taint propagation still sees the full body)."""
+        yield from CallGraph.body_nodes(self.fnode)
+
+    def _op(self, call):
+        return _collective_op(call, self.m.imported_collectives)
+
+    def report(self, rule, node, message, symbol, confidence,
+               context="spmd"):
+        self.m.findings.append(Finding(
+            rule=rule, path=self.m.relpath, line=node.lineno,
+            col=node.col_offset, func=self.qual,
+            func_name=self.func_name, func_line=self.func_line,
+            message=message, symbol=symbol,
+            severity=RULES[rule].severity, confidence=confidence,
+            context=context))
+
+    # -- collective sequences (DL001/DL002) ---------------------------------
+    def _branch_seq(self, stmts, depth=1):
+        """[(op, report node)] for a branch, in source order, expanding
+        locally-resolvable calls one call-graph level deep (the call
+        SITE stays the report anchor — the divergence is introduced by
+        the branch, not by the helper)."""
+        seq = []
+
+        def walk_stmt(node):
+            if isinstance(node, _FUNC_NODES):
+                return
+            if isinstance(node, ast.Call):
+                op = self._op(node)
+                if op is not None and op not in _NON_SCHEDULE_OPS:
+                    seq.append((op, node))
+                elif depth > 0:
+                    callee = self.m.graph.resolve_call(node)
+                    fn = self.m.graph.functions.get(callee) \
+                        if callee else None
+                    if fn is not None:
+                        for n2 in CallGraph.body_nodes(fn):
+                            if isinstance(n2, ast.Call):
+                                op2 = self._op(n2)
+                                if op2 is not None and \
+                                        op2 not in _NON_SCHEDULE_OPS:
+                                    seq.append((op2, node))
+            for child in ast.iter_child_nodes(node):
+                walk_stmt(child)
+
+        for st in stmts:
+            walk_stmt(st)
+        return seq
+
+    def _check_rank_branches(self):
+        for n in self._body():
+            if isinstance(n, ast.If):
+                rank_test = self._rank_expr(n.test)
+                host_test = self._host_tainted(n.test)
+                if not (rank_test or host_test):
+                    continue
+                body_seq = self._branch_seq(n.body)
+                else_seq = self._branch_seq(n.orelse)
+                if not body_seq and not else_seq:
+                    continue
+                gate = (self._rank_describe(n.test) if rank_test
+                        else ", ".join(self._host_evidence(n.test)))
+                fired = self._dl001(n, body_seq, else_seq, gate,
+                                    rank_test)
+                if not fired and body_seq and else_seq and \
+                        [op for op, _ in body_seq] != \
+                        [op for op, _ in else_seq]:
+                    self._dl002(n, body_seq, else_seq, gate)
+            elif isinstance(n, (ast.While, ast.IfExp)):
+                if not self._rank_expr(n.test):
+                    continue
+                kind = "while" if isinstance(n, ast.While) else "ternary"
+                roots = ([n.body, n.orelse] if isinstance(n, ast.While)
+                         else [[n.body], [n.orelse]])
+                for branch in roots:
+                    for op, site in self._branch_seq(branch):
+                        self.report(
+                            "rank-conditional-collective", site,
+                            f"`{op}` under a rank-dependent `{kind}` "
+                            f"({self._rank_describe(n.test)}) — ranks "
+                            "that never take this path never enter the "
+                            "collective, wedging the ranks that do "
+                            "until the dead-peer deadline",
+                            f"gated:{op}", "definite",
+                            context="deadlock")
+
+    def _dl001(self, ifnode, body_seq, else_seq, gate, rank_test):
+        """Collectives present on one branch with no matching op on the
+        sibling. Returns True when anything fired (suppresses the
+        coarser DL002 for the same If)."""
+        if not rank_test:
+            # a host-tainted (non-rank) test still diverges schedules,
+            # but the per-op pairing argument needs rank semantics;
+            # leave those to DL002's sequence comparison
+            return False
+        fired = False
+        for seq, other, where in ((body_seq, else_seq, "taken"),
+                                  (else_seq, body_seq, "else")):
+            other_ops = {op for op, _ in other}
+            for op, site in seq:
+                if op in other_ops:
+                    continue
+                peer = ("the other branch issues no collective"
+                        if not other_ops else
+                        "the other branch issues "
+                        + "/".join(sorted(other_ops)))
+                self.report(
+                    "rank-conditional-collective", site,
+                    f"`{op}` only on the {where} branch of a "
+                    f"rank-dependent condition ({gate}); {peer} — "
+                    "ranks on the other side never enter this "
+                    "collective and the job wedges until the "
+                    "dead-peer deadline; issue the collective on "
+                    "every rank (gate the PAYLOAD, not the call), "
+                    "or waive if every rank provably takes the "
+                    "same side",
+                    f"gated:{op}", "definite", context="deadlock")
+                fired = True
+        return fired
+
+    def _dl002(self, ifnode, body_seq, else_seq, gate):
+        bs = "/".join(op for op, _ in body_seq[:4])
+        es = "/".join(op for op, _ in else_seq[:4])
+        self.report(
+            "divergent-collective-schedule", ifnode,
+            f"branches of a condition tainted by a non-replicated "
+            f"value ({gate}) issue different collective sequences "
+            f"([{bs}] vs [{es}]) — ranks taking different sides post "
+            "mismatched schedules and deadlock or exchange mis-paired "
+            "tensors; make the schedule branch-invariant or decide "
+            "the branch from an agreed (broadcast/rendezvous) value",
+            f"schedule:{bs}!={es}", "possible", context="divergence")
+
+    # -- DL003 --------------------------------------------------------------
+    def _check_host_local_sinks(self):
+        for n in self._body():
+            if not isinstance(n, ast.Call):
+                continue
+            d = dotted(n.func)
+            op = self._op(n)
+            sink = None
+            if op is not None and op in _SYMMETRIC_OPS:
+                sink = f"collective `{op}`"
+            elif d and d[-1] in RESTORE_SINKS:
+                sink = f"restore decision `{d[-1]}`"
+            elif d and d[-1] in FINGERPRINT_SINKS:
+                sink = f"trace fingerprint `{d[-1]}`"
+            elif d and d[-1] in INIT_SINKS:
+                sink = f"sharded init `{d[-1]}`"
+            if sink is None:
+                continue
+            hot = []
+            for a in list(n.args) + [kw.value for kw in n.keywords]:
+                if self._host_tainted(a):
+                    hot.extend(self._host_evidence(a))
+            if not hot:
+                continue
+            hot = sorted(set(hot))
+            self.report(
+                "host-local-value-divergence", n,
+                f"host-local value ({', '.join(hot)}) flows into "
+                f"{sink} — each rank computes its own copy where SPMD "
+                "assumes a replicated one, diverging silently; seed "
+                "the generator, broadcast from one rank, or decide "
+                "from an agreed (rendezvous) value",
+                f"hostlocal:{(op or d[-1])}:{','.join(hot)[:60]}",
+                "possible", context="divergence")
+
+    # -- DL005 --------------------------------------------------------------
+    def _check_coord_wait(self):
+        calls = sorted(
+            (n for n in self._body() if isinstance(n, ast.Call)),
+            key=lambda n: (n.lineno, n.col_offset))
+        in_flight = None  # (op, node) of the pending collective
+        for n in calls:
+            d = dotted(n.func)
+            op = self._op(n)
+            if op == "barrier" or (d and d[-1] in COLLECTIVE_WAITS):
+                in_flight = None
+                continue
+            if op is not None and op not in _NON_SCHEDULE_OPS:
+                in_flight = (op, n)
+                continue
+            if d and d[-1] in COORD_WAITS and in_flight is not None:
+                pend, _site = in_flight
+                self.report(
+                    "coordination-wait-under-collective", n,
+                    f"blocking store wait `{d[-1]}` reachable while "
+                    f"`{pend}` (line {_site.lineno}) is still in "
+                    "flight on this path — the store wait holds this "
+                    "rank out of the collective its peers are blocked "
+                    "in: neither the store timeout nor the collective "
+                    "watchdog names the real cycle; complete (wait/"
+                    "barrier) the collective first, or reorder the "
+                    "store wait ahead of it",
+                    f"coordwait:{d[-1]}<-{pend}", "possible",
+                    context="coordination")
+
+    # -- DL006 --------------------------------------------------------------
+    def _rank_gated(self, node):
+        cur = self.scopes.parent.get(node)
+        while cur is not None and not isinstance(cur, _FUNC_NODES):
+            if isinstance(cur, ast.If) and self._rank_expr(cur.test):
+                return True
+            cur = self.scopes.parent.get(cur)
+        # guard-clause shape: `if rank != 0: return` earlier in the body
+        body = ([] if isinstance(self.fnode, ast.Lambda)
+                else self.fnode.body)
+        for st in body:
+            if st.lineno >= node.lineno:
+                break
+            if isinstance(st, ast.If) and self._rank_expr(st.test) and \
+                    any(isinstance(s, (ast.Return, ast.Raise))
+                        for s in st.body):
+                return True
+        return False
+
+    def _check_leader_writes(self):
+        for n in self._body():
+            if not isinstance(n, ast.Call):
+                continue
+            d = dotted(n.func)
+            is_write = bool(d) and d[-1] in LEADER_WRITES
+            is_leader_rdv = (
+                bool(d) and d[-1] == "rendezvous"
+                and any(kw.arg == "leader"
+                        and isinstance(kw.value, ast.Constant)
+                        and kw.value.value is True
+                        for kw in n.keywords))
+            if not (is_write or is_leader_rdv):
+                continue
+            if self._rank_gated(n):
+                continue
+            what = (f"`{d[-1]}`" if is_write
+                    else "`rendezvous(leader=True)`")
+            self.report(
+                "ungated-leader-write", n,
+                f"leader-only artifact write {what} with no enclosing "
+                "rank/leader gate — every rank races the same store "
+                "key and the merged artifact is corrupted (or N "
+                "leaders are elected); gate on rank 0/is_leader, or "
+                "waive if the caller guarantees single-rank entry",
+                f"leaderwrite:{d[-1]}", "possible", context="leader")
+
+    # -- DL007 --------------------------------------------------------------
+    def _check_suspend_regions(self):
+        for n in self._body():
+            if not isinstance(n, (ast.With, ast.AsyncWith)):
+                continue
+            if not any(
+                    isinstance(item.context_expr, ast.Call)
+                    and (dotted(item.context_expr.func) or ("",))[-1]
+                    == "suspend"
+                    for item in n.items):
+                continue
+            for sub in ast.walk(n):
+                if isinstance(sub, _FUNC_NODES):
+                    continue
+                if isinstance(sub, ast.Call):
+                    op = self._op(sub)
+                    if op is None or op in _NON_SCHEDULE_OPS:
+                        continue
+                    self.report(
+                        "collective-in-suspend-region", sub,
+                        f"`{op}` inside a fusion suspend()/eager-"
+                        "fallback region — peers still recording "
+                        "their fused trace reach this collective at a "
+                        "different schedule position, skewing the "
+                        "cross-rank schedule across the fusion kill "
+                        "switch; flush (barrier) before entering the "
+                        "region, or move the collective outside it",
+                        f"suspend:{op}", "possible", context="suspend")
+
+    # -- sites --------------------------------------------------------------
+    def collect_sites(self):
+        end = getattr(self.fnode, "end_lineno", self.func_line)
+        for n in self._body():
+            if isinstance(n, ast.Call):
+                op = self._op(n)
+                if op is not None and op not in _NON_SCHEDULE_OPS:
+                    self.m.sites.append({
+                        "path": self.m.relpath, "line": n.lineno,
+                        "op": op, "func": self.qual,
+                        "func_line": self.func_line, "end_line": end,
+                    })
+
+    def run(self):
+        self._check_rank_branches()     # DL001 + DL002
+        self._check_host_local_sinks()  # DL003
+        self._check_coord_wait()        # DL005
+        self._check_leader_writes()     # DL006
+        self._check_suspend_regions()   # DL007
+
+
+# ---------------------------------------------------------------------------
+# per-module driver
+
+class ModuleDistAnalysis:
+    def __init__(self, path, root_parent, bound_axes=None):
+        self.path = path
+        self.relpath = _relpath(path, root_parent)
+        self.is_machinery = os.path.abspath(path).replace(
+            os.sep, "/").endswith(MACHINERY_SUFFIXES)
+        with open(path, "r", encoding="utf-8") as f:
+            self.src = f.read()
+        self.lines = self.src.splitlines()
+        self.tree = ast.parse(self.src, filename=path)
+        self.scopes = ScopeIndex(self.tree)
+        self.graph = CallGraph(self.tree, self.scopes)
+        self.imported_collectives = self._imported_collectives()
+        # axis names bound by THIS module, or the tree-wide union the
+        # driver collected in its first pass
+        self.bound_axes = (bound_axes if bound_axes is not None
+                           else module_axis_bindings(self.tree))
+        self.findings = []
+        self.sites = []
+
+    def _imported_collectives(self):
+        out = set()
+        for n in ast.walk(self.tree):
+            if isinstance(n, ast.ImportFrom) and n.module and any(
+                    k in n.module for k in ("collective", "distributed",
+                                            "communication")):
+                for a in n.names:
+                    name = a.asname or a.name
+                    if name in COLLECTIVE_OPS:
+                        out.add(name)
+        return out
+
+    def run(self):
+        for qual, fnode in self.graph.functions.items():
+            checker = _FnChecker(self, fnode)
+            checker.collect_sites()
+            if not self.is_machinery:
+                checker.run()
+        if not self.is_machinery:
+            self._check_axis_names()  # DL004
+        else:
+            self._machinery_impl_sites()
+        for f in self.findings:
+            f.suppressed = _waiver_suppressed(self.lines, f.line, f.rule,
+                                              TOOL, RULES)
+        self.findings.sort(key=lambda f: (f.line, f.col, f.rule))
+        return self.findings
+
+    # -- DL004 --------------------------------------------------------------
+    def _check_axis_names(self):
+        seen = set()
+        for n in ast.walk(self.tree):
+            if not isinstance(n, ast.Call):
+                continue
+            d = dotted(n.func)
+            if not d:
+                continue
+            names = []
+            if d[-1] in SPEC_CTORS:
+                names = [s for s in _str_constants(n)]
+            elif d[-1] in AXIS_USERS:
+                for a in n.args[1:]:
+                    if isinstance(a, ast.Constant) and \
+                            isinstance(a.value, str):
+                        names.append(a.value)
+                for kw in n.keywords:
+                    if kw.arg in AXIS_KWARGS:
+                        names.extend(_str_constants(kw.value))
+            for name in names:
+                if name in self.bound_axes or name in seen or not name:
+                    continue
+                seen.add(name)
+                scope = self.scopes.scope_chain(n)
+                fnode = next((s for s in scope
+                              if isinstance(s, _FUNC_NODES)), None)
+                qual = self.scopes.qualname(fnode) if fnode else ""
+                fname = ("" if fnode is None else
+                         (fnode.name
+                          if not isinstance(fnode, ast.Lambda)
+                          else "<lambda>"))
+                self.findings.append(Finding(
+                    rule="unbound-axis-name", path=self.relpath,
+                    line=n.lineno, col=n.col_offset, func=qual,
+                    func_name=fname,
+                    func_line=(runtime_first_line(fnode)
+                               if fnode else n.lineno),
+                    message=f"axis name '{name}' used in "
+                            f"`{'.'.join(d)}` is not bound by any "
+                            "mesh/axis declaration in the analyzed "
+                            "tree — the name resolves only against "
+                            "the device mesh installed at run time; "
+                            "an unbound name is a latent NameError on "
+                            "the multi-host path (declare the mesh "
+                            "axis, or thread the name from one)",
+                    symbol=f"axis:{name}",
+                    severity=RULES["unbound-axis-name"].severity,
+                    confidence="possible", context="axis"))
+
+    # -- machinery implementation spans (site inventory only) ---------------
+    def _machinery_impl_sites(self):
+        """Public collective implementations in the machinery module:
+        the spans runtime schedule sites fall back to when the caller
+        is outside the tree (a driver script calling dist.all_reduce
+        directly attributes to the implementation, not the driver)."""
+        for stmt in self.tree.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and stmt.name in COLLECTIVE_OPS:
+                self.sites.append({
+                    "path": self.relpath, "line": stmt.lineno,
+                    "op": stmt.name, "func": stmt.name,
+                    "func_line": runtime_first_line(stmt),
+                    "end_line": getattr(stmt, "end_lineno", stmt.lineno),
+                })
+
+
+# ---------------------------------------------------------------------------
+# tree driver
+
+def iter_py_files(root):
+    """The analysis scope: fixture trees and single files analyze
+    everything; the real package (recognized by its distributed/ dir)
+    narrows to the distributed storey — distributed/, optimizer/, and
+    io/checkpoint.py — the surfaces where the SPMD contract lives."""
+    if os.path.isdir(os.path.join(root, "distributed")):
+        for sub in ("distributed", "optimizer"):
+            d = os.path.join(root, sub)
+            if os.path.isdir(d):
+                yield from _iter_py_files(d, skip_dirs=SKIP_DIRS)
+        ck = os.path.join(root, "io", "checkpoint.py")
+        if os.path.isfile(ck):
+            yield ck
+    else:
+        yield from _iter_py_files(root, skip_dirs=SKIP_DIRS)
+
+
+def analyze_paths(roots, sites=None):
+    """Analyze every in-scope .py under each root. Returns (findings,
+    errors); errors are (path, message) for unparseable files. Axis
+    bindings (DL004) are collected tree-wide in a first pass — a mesh
+    declared in env.py binds the axis names sharding helpers use.
+    When `sites` is a list, the collective-site inventory (for
+    --verify-runtime) is appended to it."""
+    parsed = []   # (path, root_parent, tree or None, error)
+    bound = set()
+    for root in roots:
+        root = os.path.normpath(root)
+        root_parent = os.path.dirname(os.path.abspath(root))
+        for path in iter_py_files(root):
+            rel = _relpath(path, root_parent)
+            try:
+                with open(path, "r", encoding="utf-8") as f:
+                    tree = ast.parse(f.read(), filename=path)
+            except (SyntaxError, UnicodeDecodeError, OSError) as e:
+                parsed.append((path, root_parent,
+                               (rel, f"{type(e).__name__}: {e}")))
+                continue
+            bound |= module_axis_bindings(tree)
+            parsed.append((path, root_parent, None))
+    findings, errors = [], []
+    for entry in parsed:
+        path, root_parent, err = entry
+        if err is not None:
+            errors.append(err)
+            continue
+        try:
+            ma = ModuleDistAnalysis(path, root_parent, bound_axes=bound)
+            findings.extend(ma.run())
+            if sites is not None:
+                sites.extend(ma.sites)
+        except (SyntaxError, UnicodeDecodeError) as e:
+            errors.append((_relpath(path, root_parent),
+                           f"{type(e).__name__}: {e}"))
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    if sites is not None:
+        sites.sort(key=lambda s: (s["path"], s["line"], s["op"]))
+    return findings, errors
+
+
+def analyze_file(path):
+    return analyze_paths([path])

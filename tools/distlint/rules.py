@@ -1,0 +1,64 @@
+"""Rule catalog for distlint.
+
+Each rule names one class of cross-rank hazard — a code shape that can
+make SPMD ranks disagree about which collectives to issue (deadlock),
+feed host-local values into replicated math (silent divergence), or
+wedge the coordination layer against the collective layer. The catalog
+is data, not behavior — detection lives in analyzer.py — and the Rule
+dataclass/severity vocabulary is shared with tracelint, threadlint and
+fuselint via tools/staticlib.
+
+Severity:
+  error    — a proven deadlock/divergence shape; fix or waive.
+  warning  — likely hazard; depends on which ranks run which paths.
+  info     — hygiene note / intentional-asymmetry audit; never gates
+             CI by severity alone.
+"""
+from __future__ import annotations
+
+from ..staticlib.rules import Rule, ruleset
+
+RULES, BY_ID, get = ruleset([
+    Rule("DL001", "rank-conditional-collective", "error", False,
+         "collective call under rank/process-index-dependent control "
+         "flow with no matching collective on the other branch — the "
+         "classic `if rank == 0: all_reduce(...)` shape: the gated "
+         "ranks enter the collective, the rest never do, and the job "
+         "wedges until the watchdog's dead_after deadline"),
+    Rule("DL002", "divergent-collective-schedule", "error", False,
+         "the branches of a condition tainted by a non-replicated "
+         "value issue DIFFERENT collective sequences (compared one "
+         "call-graph level deep) — ranks taking different branches "
+         "post mismatched schedules and deadlock or exchange "
+         "mis-paired tensors"),
+    Rule("DL003", "host-local-value-divergence", "warning", False,
+         "unseeded host randomness / wall-clock / pid / hostname / "
+         "rank-local disk state flowing into a collective operand, a "
+         "sharded parameter init, or a restore decision — each rank "
+         "computes a different value where SPMD assumes a replicated "
+         "one, diverging silently instead of crashing"),
+    Rule("DL004", "unbound-axis-name", "warning", False,
+         "axis-name string used in psum/shard_map/NamedSharding/"
+         "PartitionSpec with no enclosing mesh or axis binding in the "
+         "module — the name resolves (or fails) only at run time on "
+         "the device mesh actually installed; an unbound name is a "
+         "latent NameError on the multi-host path"),
+    Rule("DL005", "coordination-wait-under-collective", "error", False,
+         "blocking coordination-store wait (rendezvous / agreement "
+         "poll) reachable while a collective is still in flight on "
+         "the same path — the store wait holds the rank out of the "
+         "collective its peers are blocked in: a cross-subsystem "
+         "deadlock neither layer's timeout names correctly"),
+    Rule("DL006", "ungated-leader-write", "warning", False,
+         "host-0-only artifact write (cluster merge, agreement "
+         "publication, leader rendezvous payload) with no enclosing "
+         "rank/leader gate — every rank racing the same store key "
+         "corrupts the merged artifact or elects N leaders"),
+    Rule("DL007", "collective-in-suspend-region", "warning", False,
+         "collective issued inside a fusion.suspend()/eager-fallback "
+         "region — peers still recording their fused trace reach the "
+         "collective at a different schedule position, skewing the "
+         "cross-rank schedule across the fusion kill switch"),
+])
+
+__all__ = ["Rule", "RULES", "BY_ID", "get"]

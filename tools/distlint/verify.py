@@ -1,0 +1,214 @@
+"""--verify-runtime: close the loop between distlint's static
+collective-site inventory and the runtime schedule recorder.
+
+A child process (fresh interpreter, two forced host devices) runs a
+small eager collective workload — all_reduce / broadcast / all_gather /
+barrier over the default group — and prints
+``dispatch_stats()["collectives"]`` including the ``sites`` table
+(``file:line`` -> count) the schedule recorder attributed each issued
+collective to. The parent then cross-references against the STATIC
+SITE INVENTORY (every collective call site the analyzer classified,
+plus the machinery implementation spans in
+``distributed/collective.py``) — the inventory, not the findings,
+because a clean tree has zero findings but its collective sites must
+still be the ones the runtime observes:
+
+* **confirmed** — inventory entries a runtime-recorded collective
+  actually attributed to (same file, line within the entry's span plus
+  a small window): the static pass sees the sites the runtime runs.
+* **static-only** — inventory entries never observed in this workload:
+  precision feedback (most are simply paths the tiny workload never
+  runs).
+* **runtime-only** — recorded sites inside the analyzed roots with no
+  inventory entry covering them: recall feedback — a collective shape
+  the classifier misses. Sites outside the roots (the driver script
+  itself) are reported separately, not counted as gaps.
+
+Exit contract: 0 when at least one static site cross-references a
+runtime-recorded collective AND there are no recall gaps; 1 otherwise
+— CI can gate on the static pass staying anchored to what the
+schedule recorder attributes.
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+# line slack when matching a static site to a runtime attribution: the
+# recorder reports the caller frame's CURRENT line, which for a
+# multi-line call can sit a few lines below the expression's anchor
+MATCH_WINDOW = 5
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def run_child():
+    """The eager collective workload (executed in a fresh interpreter
+    via --verify-child). Prints one JSON line: the schedule recorder's
+    stats after a few rounds of collectives over the default group."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.core import dispatch
+
+    dist.init_process_group()
+    x = paddle.to_tensor(np.ones(8, np.float32))
+    for _ in range(3):
+        dist.all_reduce(x)
+        dist.broadcast(x, src=0)
+        gathered = []
+        dist.all_gather(gathered, x)
+        # gloo_barrier is the one IN-TREE caller this driver exercises:
+        # the recorder attributes its barrier to distributed/__init__.py,
+        # a site the analyzer's call inventory must cover
+        dist.gloo_barrier()
+    stats = dispatch.dispatch_stats()["collectives"]
+    print(json.dumps({
+        "seq": stats["seq"],
+        "fingerprint": stats["fingerprint"],
+        "per_op": stats["per_op"],
+        "sites": stats["sites"],
+    }))
+
+
+def _spawn_child(timeout=300):
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    if "--xla_force_host_platform_device_count" not in \
+            env.get("XLA_FLAGS", ""):
+        env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                            + " --xla_force_host_platform_device_count=2"
+                            ).strip()
+    env["PADDLE_TPU_COLLECTIVE_SCHEDULE"] = "1"
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.distlint", "--verify-child"],
+        cwd=REPO, env=env, capture_output=True, text=True,
+        timeout=timeout)
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"distlint --verify-runtime: child failed rc="
+            f"{proc.returncode}\n{proc.stderr[-2000:]}")
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
+def _parse_site(site):
+    """('paddle_tpu/x/y.py', 123) or None for unknown/overflow keys."""
+    path, _, line = site.rpartition(":")
+    if not path or not line.isdigit():
+        return None
+    return path, int(line)
+
+
+def cross_reference(sites, recorded, roots=("paddle_tpu",)):
+    """Correlate the static site inventory with the schedule recorder's
+    attributions. Returns a report dict (see module docstring).
+
+    Path frames differ by construction — inventory paths are relative
+    to each analyzed root's PARENT, runtime sites are repo-relative —
+    so a runtime site is "in tree" when a root name appears as one of
+    its path components, and files match by SUFFIX (the longer of the
+    two ends with the other)."""
+    by_path = {}
+    for s in sites:
+        by_path.setdefault(s["path"], []).append(s)
+    root_parts = {r.rstrip("/").rsplit("/", 1)[-1] for r in roots}
+
+    def _same_file(inv_path, site_path):
+        return site_path.endswith("/" + inv_path) or \
+            inv_path.endswith("/" + site_path) or inv_path == site_path
+
+    def _covers(entry, line):
+        return (entry["line"] - MATCH_WINDOW <= line
+                <= entry.get("end_line", entry["line"]) + MATCH_WINDOW)
+
+    def _key(entry):
+        return f"{entry['path']}:{entry['line']}:{entry['op']}"
+
+    confirmed = {}        # inventory key -> (entry, [site records])
+    runtime_only = []
+    external = []
+    for site, count in (recorded or {}).items():
+        parsed = _parse_site(site)
+        rec = {"site": site, "count": count}
+        if parsed is None:
+            external.append(rec)
+            continue
+        path, line = parsed
+        if not root_parts & set(path.split("/")[:-1] + [path]):
+            external.append(rec)
+            continue
+        near = [s for sp, ss in by_path.items()
+                if _same_file(sp, path)
+                for s in ss if _covers(s, line)]
+        if near:
+            best = min(near, key=lambda s: abs(s["line"] - line))
+            confirmed.setdefault(_key(best), [best, []])[1].append(rec)
+        else:
+            runtime_only.append(rec)
+    confirmed_keys = set(confirmed)
+    static_only = [s for s in sites if _key(s) not in confirmed_keys]
+    return {
+        "confirmed": [
+            {"path": s["path"], "line": s["line"], "op": s["op"],
+             "func": s["func"], "sites": recs}
+            for _, (s, recs) in sorted(confirmed.items())],
+        "static_only": len(static_only),
+        "static_only_sites": sorted(_key(s) for s in static_only),
+        "runtime_only": runtime_only,
+        "external_sites": external,
+    }
+
+
+def run_verify(sites, json_path=None, roots=("paddle_tpu",)):
+    """Drive the child, cross-reference, print the report. Returns the
+    process exit code (0 = anchored: >= 1 confirmed site and no recall
+    gaps). `roots` must be the roots the inventory was collected over —
+    recorded sites outside them are external, not recall gaps."""
+    stats = _spawn_child()
+    report = cross_reference(sites, stats.get("sites"),
+                             roots=tuple(roots))
+    report["child"] = {"seq": stats["seq"],
+                       "fingerprint": stats["fingerprint"],
+                       "per_op": stats["per_op"]}
+    n_conf = len(report["confirmed"])
+    print(f"distlint --verify-runtime: {n_conf} static collective "
+          "site(s) confirmed by the runtime schedule recorder")
+    for c in report["confirmed"]:
+        recs = ", ".join(f"{r['site']} (x{r['count']})"
+                         for r in c["sites"])
+        print(f"  {c['op']} {c['path']}:{c['line']} in "
+              f"`{c['func']}` <- {recs}")
+    print(f"  precision: {report['static_only']} inventory site(s) not "
+          "observed in this workload (unexercised paths expected for "
+          "the small collective loop)")
+    if report["runtime_only"]:
+        print(f"  RECALL GAP: {len(report['runtime_only'])} recorded "
+              "collective site(s) in the analyzed tree with no "
+              "inventory entry covering them:")
+        for r in report["runtime_only"]:
+            print(f"    {r['site']} (x{r['count']})")
+    if report["external_sites"]:
+        ext = ", ".join(f"{r['site']} (x{r['count']})"
+                        for r in report["external_sites"])
+        print(f"  external (driver-script) sites: {ext}")
+    if json_path:
+        from ..staticlib.report import write_json
+
+        write_json(json_path, report)
+    if n_conf == 0:
+        print("distlint --verify-runtime: FAIL — no static collective "
+              "site cross-references a runtime-recorded collective; "
+              "the static inventory has come unanchored from the "
+              "schedule recorder's attribution", file=sys.stderr)
+        return 1
+    if report["runtime_only"]:
+        print("distlint --verify-runtime: FAIL — recorded collective "
+              "sites above have no static inventory coverage (a "
+              "classifier recall gap); extend the collective "
+              "vocabulary or attribute the site", file=sys.stderr)
+        return 1
+    return 0

@@ -1,0 +1,178 @@
+#!/usr/bin/env python
+"""Cross-rank collective-divergence smoke (tools/ci_check.sh).
+
+The runtime half of tools/distlint, proven end to end on a 2-process
+CPU cluster over a tmpdir store: rank 1 carries an injected
+rank-conditional collective (``PADDLE_TPU_FAULT_INJECT`` fires an
+``InjectedFault`` at a fault point rank 0 sails past; the except arm
+issues an extra ``broadcast`` — exactly the DL001 bug shape distlint
+flags statically), so the two ranks' collective schedules fork from
+the first step. Each rank publishes its rolling schedule fingerprint
+through the heartbeat path (``ElasticManager.tick``) and polls a
+``ClusterMonitor``; the smoke asserts
+
+* the monitor on BOTH ranks flags ``collective_divergence`` well
+  before the dead-peer deadline (the whole point: name the fork in
+  seconds, not after a wedge timeout);
+* the recorded fault's detail carries BOTH ranks' schedule tails, and
+  survives into the host-0 merged cluster fault log;
+* the postmortem bundle each detecting rank dumps carries the same
+  two-sided schedule diff.
+
+Usage: python tools/distlint_smoke.py           (run the smoke)
+       python tools/distlint_smoke.py --child   (internal: one rank)
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+STALE_AFTER = 30.0
+DEAD_AFTER = 60.0
+# fixed step count, NOT stop-on-detect: a rank that stopped publishing
+# the instant IT detected would freeze its schedule before the first
+# window mark (MARK_WINDOW=16) and could deny its peer a common
+# comparison point — both ranks run the full loop so both must detect
+STEPS = 64
+
+
+def _child():
+    sys.path.insert(0, REPO)
+    import numpy as np
+
+    import paddle_tpu as paddle
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.distributed import coordination
+    from paddle_tpu.distributed.elastic import ElasticManager
+    from paddle_tpu.runtime import diagnostics, telemetry
+    from paddle_tpu.runtime.resilience import (
+        InjectedFault, fault_events, fault_point,
+    )
+
+    ctx = coordination.cluster_context()
+    assert ctx is not None
+    coordination.init_cluster_telemetry(ctx)
+    em = ElasticManager(tempfile.mkdtemp(), timeout=600.0, cluster=ctx,
+                        peer_stale_after=STALE_AFTER,
+                        peer_dead_after=DEAD_AFTER)
+    # polled deterministically in the step loop (the background
+    # watchdog would also get there, but the smoke wants exact timing)
+    monitor = coordination.ClusterMonitor(
+        ctx.store, rank=ctx.rank, world_size=ctx.world_size,
+        stale_after=STALE_AFTER, dead_after=DEAD_AFTER)
+
+    dist.init_process_group()
+    x = paddle.to_tensor(np.ones(8, np.float32))
+    extra = paddle.to_tensor(np.ones(4, np.float32))
+    t0 = time.monotonic()
+    detected = None
+    for step in range(STEPS):
+        dist.all_reduce(x)
+        try:
+            fault_point("distlint_smoke.divergence", step=step)
+        except InjectedFault:
+            # the injected rank-conditional collective: only the rank
+            # whose env carries the fault spec takes this arm — the
+            # DL001 shape, live
+            dist.broadcast(extra, src=0)
+        em.tick(step)
+        scan = monitor.poll()
+        if detected is None and scan.get("schedule_divergence"):
+            detected = (step, time.monotonic() - t0,
+                        scan["schedule_divergence"])
+        time.sleep(0.02)
+    telemetry.publish_registry(ctx.store, ctx.rank)
+    if detected is None:
+        print(f"NO_DIVERGENCE rank={ctx.rank}", flush=True)
+        sys.exit(1)
+    step, elapsed, pairs = detected
+    assert elapsed < DEAD_AFTER, \
+        f"divergence after the dead-peer deadline ({elapsed:.1f}s)"
+    assert fault_events().get("collective_divergence", 0) >= 1
+    print(f"DIVERGENCE_DETECTED rank={ctx.rank} step={step} "
+          f"elapsed={elapsed:.2f} pairs={pairs} "
+          f"bundle={diagnostics.last_bundle_path()}", flush=True)
+
+
+def _env(cluster_dir, rank, world, diag_dir, inject):
+    env = dict(os.environ)
+    env.update({"JAX_PLATFORMS": "cpu",
+                "PADDLE_TPU_CLUSTER_DIR": cluster_dir,
+                "PADDLE_TPU_CLUSTER_RANK": str(rank),
+                "PADDLE_TPU_CLUSTER_WORLD": str(world),
+                "PADDLE_TPU_DIAGNOSTICS_DIR": diag_dir,
+                "PADDLE_TPU_COLLECTIVE_SCHEDULE": "1"})
+    if inject:
+        env["PADDLE_TPU_FAULT_INJECT"] = "distlint_smoke.divergence=raise"
+    else:
+        env.pop("PADDLE_TPU_FAULT_INJECT", None)
+    return env
+
+
+def main():
+    if "--child" in sys.argv:
+        _child()
+        return
+
+    sys.path.insert(0, REPO)
+    root = tempfile.mkdtemp(prefix="paddle_tpu_distlint_smoke_")
+    cluster_dir = os.path.join(root, "store")
+    diag_dirs = [os.path.join(root, f"diag_rank{r}") for r in range(2)]
+    procs = [subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--child"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        env=_env(cluster_dir, rank, 2, diag_dirs[rank], inject=rank == 1))
+        for rank in range(2)]
+    outs = []
+    for rank, p in enumerate(procs):
+        out, _ = p.communicate(timeout=180)
+        out = out.decode("utf-8", "replace")
+        outs.append(out)
+        assert p.returncode == 0, f"rank {rank} rc={p.returncode}:\n{out}"
+        assert f"DIVERGENCE_DETECTED rank={rank}" in out, out
+    print("smoke: both ranks flagged collective_divergence before the "
+          "dead-peer deadline OK")
+
+    from paddle_tpu.distributed.coordination import DirectoryStore
+    from paddle_tpu.runtime import telemetry
+
+    store = DirectoryStore(cluster_dir)
+    merged = telemetry.merge_cluster(store)
+    div = [f for f in merged["faults"]
+           if f["fault"] == "collective_divergence"]
+    assert div, f"no collective_divergence in merged faults: " \
+        f"{[f['fault'] for f in merged['faults']]}"
+    # the detail carries the two-sided schedule diff: both ranks' tails
+    detail = div[0].get("detail") or ""
+    diff = json.loads(detail[detail.index("{"):])
+    assert set(diff["tail"]) == {"0", "1"}, diff
+    ops = {op for tail in diff["tail"].values()
+           for (_, op, _a, _v, _s) in tail}
+    assert "all_reduce" in ops, ops
+    assert "broadcast" in ops, ops  # the injected divergent branch
+    print("smoke: merged cluster fault log carries both ranks' "
+          "schedule tails OK")
+
+    for rank, out in enumerate(outs):
+        bundle_path = out.split("bundle=")[-1].strip().splitlines()[0]
+        assert bundle_path and bundle_path != "None", \
+            f"rank {rank} dumped no bundle:\n{out}"
+        with open(bundle_path) as f:
+            bundle = json.load(f)
+        assert bundle["reason"] == "collective_divergence", bundle["reason"]
+        bdiff = bundle["extra"]["collective_divergence"]
+        assert set(bdiff["tail"]) == {"0", "1"}, bdiff
+        assert bdiff["first_divergent_seq"] >= 1
+    print("smoke: postmortem bundles carry the two-sided schedule "
+          "diff OK")
+    print("distlint_smoke: OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,7 +1,7 @@
 #!/usr/bin/env python
 """One entry point for every static analyzer: tracelint + threadlint +
-fuselint in one command — one report grammar, one combined JSON, one
-exit code, with every tool's CI freshness gate engaged.
+fuselint + distlint in one command — one report grammar, one combined
+JSON, one exit code, with every tool's CI freshness gate engaged.
 
     python tools/staticcheck.py [roots...] [options]
 
@@ -12,7 +12,15 @@ Runs, in order:
   manifest fails);
 * **threadlint** — concurrency/race analysis, with the baseline
   freshness gate (``--fail-stale``);
-* **fuselint**   — fusion-barrier analysis, same freshness gate.
+* **fuselint**   — fusion-barrier analysis, same freshness gate;
+* **distlint**   — cross-rank divergence / collective-deadlock
+  analysis, same freshness gate;
+* the **telemetry schema-consistency** pass — every
+  ``record_fault("<kind>")`` / ``emit("<kind>")`` literal in the tree
+  must name a kind declared in ``tools/telemetry_schema.json``, and
+  every declared kind must be used by at least one in-tree literal
+  (both directions: an undeclared kind is invisible to dashboards, a
+  dead declaration is vocabulary nothing can produce).
 
 Each tool prints its usual human report under a banner; the combined
 JSON report (``--json``) nests each tool's machine-readable report
@@ -20,13 +28,15 @@ under its name plus a ``staticcheck`` summary block. ``--sarif-dir``
 writes one SARIF file per tool (<dir>/<tool>.sarif) for code-scanning
 upload.
 
-Exit grammar (the strictest of the three, uniformly): 0 — every tool
+Exit grammar (the strictest of all passes, uniformly): 0 — every tool
 clean (baselined-only); 1 — any new finding, parse error, stale
-baseline entry, or stale manifest; 2 — usage error.
+baseline entry, stale manifest, or schema inconsistency; 2 — usage
+error.
 """
 from __future__ import annotations
 
 import argparse
+import ast
 import json
 import os
 import sys
@@ -35,18 +45,22 @@ import tempfile
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
+from tools.distlint import __main__ as distlint_main  # noqa: E402
 from tools.fuselint import __main__ as fuselint_main  # noqa: E402
 from tools.threadlint import __main__ as threadlint_main  # noqa: E402
 from tools.tracelint import __main__ as tracelint_main  # noqa: E402
 
-TOOLS = ("tracelint", "threadlint", "fuselint")
+TOOLS = ("tracelint", "threadlint", "fuselint", "distlint")
+
+SCHEMA_PATH = os.path.join(REPO, "tools", "telemetry_schema.json")
 
 
 def build_parser():
     p = argparse.ArgumentParser(
         prog="python tools/staticcheck.py",
         description="run all static analyzers (tracelint + threadlint "
-                    "+ fuselint) with their CI freshness gates")
+                    "+ fuselint + distlint) and the telemetry schema-"
+                    "consistency pass with their CI freshness gates")
     p.add_argument("roots", nargs="*", default=["paddle_tpu"],
                    help="package dirs to analyze (default: paddle_tpu)")
     p.add_argument("--json", metavar="PATH",
@@ -54,12 +68,13 @@ def build_parser():
     p.add_argument("--sarif-dir", metavar="DIR",
                    help="write one SARIF report per tool here")
     p.add_argument("--skip", action="append", default=[],
-                   choices=list(TOOLS), metavar="TOOL",
+                   choices=list(TOOLS) + ["schema"], metavar="TOOL",
                    help="skip one tool (repeatable)")
     p.add_argument("--verify-runtime", action="store_true",
-                   help="also run fuselint's runtime flush-site "
-                        "cross-reference (one fuselint pass does both "
-                        "the gate and the verify)")
+                   help="also run fuselint's runtime flush-site and "
+                        "distlint's collective-schedule cross-"
+                        "references (one pass per tool does both the "
+                        "gate and the verify)")
     p.add_argument("-v", "--verbose", action="store_true",
                    help="itemize baselined/waived findings too")
     return p
@@ -76,13 +91,109 @@ def _tool_argv(tool, args, json_path):
             argv.append("--check-manifest")
     else:
         argv.append("--fail-stale")
-    if tool == "fuselint" and args.verify_runtime:
+    if tool in ("fuselint", "distlint") and args.verify_runtime:
         argv.append("--verify-runtime")
     if args.sarif_dir:
         argv += ["--sarif", os.path.join(args.sarif_dir, f"{tool}.sarif")]
     if args.verbose:
         argv.append("-v")
     return argv
+
+
+def _kind_literals(roots):
+    """(fault kinds, event kinds) used as literals anywhere under the
+    roots: first string argument of any ``*record_fault(...)`` call,
+    any ``counter="..."`` keyword (the checkpoint retry helpers thread
+    it into record_fault), and the first string argument of any
+    ``emit(...)`` call. Unparseable files are skipped — the lint tools
+    already gate on parse errors."""
+    faults, events = {}, {}
+    for root in roots:
+        for dirpath, dirs, files in os.walk(root):
+            dirs[:] = [d for d in dirs
+                       if d not in ("__pycache__", ".git", "node_modules")]
+            for fn in files:
+                if not fn.endswith(".py"):
+                    continue
+                path = os.path.join(dirpath, fn)
+                try:
+                    with open(path, encoding="utf-8") as f:
+                        tree = ast.parse(f.read())
+                except (OSError, SyntaxError, ValueError):
+                    continue
+                for n in ast.walk(tree):
+                    if not isinstance(n, ast.Call):
+                        continue
+                    f0 = n.func
+                    name = (f0.id if isinstance(f0, ast.Name)
+                            else f0.attr if isinstance(f0, ast.Attribute)
+                            else "")
+                    lit = (n.args[0].value if n.args
+                           and isinstance(n.args[0], ast.Constant)
+                           and isinstance(n.args[0].value, str) else None)
+                    if name.endswith("record_fault") and lit is not None:
+                        faults.setdefault(lit, set()).add(path)
+                    elif name == "emit" and lit is not None:
+                        events.setdefault(lit, set()).add(path)
+                    for kw in n.keywords:
+                        if kw.arg == "counter" and \
+                                isinstance(kw.value, ast.Constant) and \
+                                isinstance(kw.value.value, str):
+                            faults.setdefault(kw.value.value,
+                                              set()).add(path)
+    return faults, events
+
+
+def schema_consistency(roots):
+    """Both-direction vocabulary check against
+    tools/telemetry_schema.json. Returns (exit_code, report)."""
+    try:
+        with open(SCHEMA_PATH, encoding="utf-8") as f:
+            schema = json.load(f)
+    except (OSError, ValueError) as e:
+        print(f"schema-consistency: cannot read {SCHEMA_PATH}: {e}",
+              file=sys.stderr)
+        return 1, {"error": str(e)}
+    declared_faults = set(schema.get("fault_kinds") or [])
+    declared_events = set(schema.get("events") or [])
+    used_faults, used_events = _kind_literals(roots)
+    problems = []
+    for kind in sorted(set(used_faults) - declared_faults):
+        where = sorted(used_faults[kind])[0]
+        problems.append(
+            f"fault kind `{kind}` (used in {where}) is not declared — "
+            "add it to resilience._EVENT_KINDS and regenerate the "
+            "schema (tools/telemetry_smoke.py --emit-schema)")
+    for kind in sorted(declared_faults - set(used_faults)):
+        problems.append(
+            f"fault kind `{kind}` is declared but no in-tree "
+            "record_fault()/counter= literal uses it — dead vocabulary "
+            "(remove it, or the producer regressed)")
+    for kind in sorted(set(used_events) - declared_events):
+        where = sorted(used_events[kind])[0]
+        problems.append(
+            f"event kind `{kind}` (emitted in {where}) is not declared "
+            "— add it to telemetry.EVENT_KINDS and regenerate the "
+            "schema")
+    for kind in sorted(declared_events - set(used_events)):
+        problems.append(
+            f"event kind `{kind}` is declared but no in-tree emit() "
+            "literal produces it — dead vocabulary (remove it, or the "
+            "producer regressed)")
+    report = {
+        "declared": {"fault_kinds": len(declared_faults),
+                     "events": len(declared_events)},
+        "used": {"fault_kinds": len(used_faults),
+                 "events": len(used_events)},
+        "problems": problems,
+    }
+    if problems:
+        for p in problems:
+            print(f"schema-consistency: {p}", file=sys.stderr)
+        return 1, report
+    print(f"schema-consistency: OK ({len(used_faults)} fault kinds, "
+          f"{len(used_events)} event kinds, both directions)")
+    return 0, report
 
 
 def main(argv=None):
@@ -95,7 +206,8 @@ def main(argv=None):
         os.makedirs(args.sarif_dir, exist_ok=True)
     mains = {"tracelint": tracelint_main.main,
              "threadlint": threadlint_main.main,
-             "fuselint": fuselint_main.main}
+             "fuselint": fuselint_main.main,
+             "distlint": distlint_main.main}
     combined = {"version": 1, "tools": {}, "staticcheck": {}}
     failed = []
     for tool in TOOLS:
@@ -122,8 +234,18 @@ def main(argv=None):
         if rc != 0:
             failed.append(tool)
         print()
+    if "schema" not in args.skip:
+        print("== staticcheck: telemetry schema consistency ==")
+        src, sreport = schema_consistency(args.roots)
+        combined["tools"]["schema"] = sreport
+        if src != 0:
+            failed.append("schema")
+        print()
+    ran = [t for t in TOOLS if t not in args.skip]
+    if "schema" not in args.skip:
+        ran.append("schema")
     combined["staticcheck"] = {
-        "ran": [t for t in TOOLS if t not in args.skip],
+        "ran": ran,
         "failed": failed,
         "clean": not failed,
     }
@@ -135,8 +257,7 @@ def main(argv=None):
         print(f"staticcheck: FAIL ({', '.join(failed)})",
               file=sys.stderr)
         return 1
-    print("staticcheck: OK (" +
-          ", ".join(t for t in TOOLS if t not in args.skip) + ")")
+    print("staticcheck: OK (" + ", ".join(ran) + ")")
     return 0
 
 

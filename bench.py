@@ -1058,6 +1058,27 @@ def bench_serve_decode(requests=8, prompt=8, new_tokens=16, max_running=4,
             float(np.percentile(lat, 99)) * 1e3 if lat else None)
         res["serve_decode_kv_highwater_blocks"] = st["kv"]["highwater"]
 
+        # SLO keys from the ISSUE-18 open-loop harness: a short
+        # Poisson-arrival pass on a fresh engine gives the serving
+        # numbers the chaos gate tracks — TTFT percentiles, shed rate
+        # under the admission bounds, and goodput (completed-request
+        # tokens/sec, distinct from the raw decode tokens/sec above)
+        from tools.loadgen import run_load
+        lg = run_load(mk(), rate_rps=2.0 * max(res.get(
+            "serve_decode_requests", requests), 1),
+            duration_s=1.0, prompt_lens=(prompt,),
+            new_tokens=(min(new_tokens, 4),), seed=0,
+            hard_wall_s=120.0)
+        res["serve_decode_ttft_p50_ms"] = (
+            lg["ttft_p50_s"] * 1e3 if lg["ttft_p50_s"] is not None
+            else None)
+        res["serve_decode_ttft_p99_ms"] = (
+            lg["ttft_p99_s"] * 1e3 if lg["ttft_p99_s"] is not None
+            else None)
+        res["serve_decode_shed_rate"] = lg["shed_rate"]
+        res["serve_decode_goodput_tokens_per_sec"] = (
+            lg["goodput_tokens_per_sec"])
+
         def _phase_pass():
             e = mk()
             for p in prompts:

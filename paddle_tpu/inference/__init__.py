@@ -15,6 +15,7 @@ Two tiers live here:
 from __future__ import annotations
 
 from .engine import ServeConfig, ServingEngine  # noqa: F401
+from .journal import RequestJournal, read_journal  # noqa: F401
 from .kv_cache import KVCacheConfig, PagedKVCache  # noqa: F401
 from .model import TinyServeModel  # noqa: F401
 from .predictor import (  # noqa: F401
@@ -33,6 +34,7 @@ from .predictor import (  # noqa: F401
 )
 from .scheduler import (  # noqa: F401
     ContinuousBatchingScheduler,
+    OverloadedError,
     RequestState,
     ServeRequest,
     StepPlan,
@@ -44,4 +46,5 @@ __all__ = ["Config", "Predictor", "Tensor", "create_predictor",
            "get_trt_compile_version", "get_trt_runtime_version",
            "ServingEngine", "ServeConfig", "PagedKVCache", "KVCacheConfig",
            "ContinuousBatchingScheduler", "ServeRequest", "RequestState",
-           "StepPlan", "TinyServeModel"]
+           "StepPlan", "TinyServeModel", "OverloadedError",
+           "RequestJournal", "read_journal"]

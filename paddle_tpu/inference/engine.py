@@ -29,9 +29,28 @@ Runtime-spine reuse:
   loop (`step_deadline`) exactly as it does for training; a
   ``serve.step`` fault-point lets FaultInjector wedge the loop in
   tests.
+
+Robustness layer (ISSUE 18):
+
+* **overload** — `submit()` raises `OverloadedError` (outcome counter
+  label ``overloaded``, ``serve_sheds`` fault) when the scheduler's
+  bounded queue refuses admission; the engine never grows memory with
+  arrival rate and never wedges (see docs/SERVING.md failure matrix).
+* **lifecycle** — `cancel(id)` frees a request's KV blocks NOW;
+  `drain(deadline_s)` stops admission, finishes accepted work, and
+  evicts the stragglers at the deadline; `install_signal_drain()` wires
+  drain into the PR-14 SIGTERM path (drain, postmortem bundle, then
+  the default termination semantics — rc is still ``-SIGTERM``).
+* **crash recovery** — an optional `RequestJournal` records every
+  admitted request and emitted token; `recover()` re-admits a crashed
+  process's unfinished tail with the already-generated tokens as
+  context, so greedy determinism + `warm_start()` resume token-exact
+  with zero fresh compiles (tools/serve_chaos_smoke.py gates this).
 """
 from __future__ import annotations
 
+import os
+import signal as _signal
 import time
 
 import numpy as np
@@ -41,10 +60,12 @@ from ..runtime import diagnostics as _diagnostics
 from ..runtime import telemetry as _telemetry
 from ..runtime import tracing as _tracing
 from ..runtime.resilience import fault_point
+from .journal import RequestJournal, read_journal
 from .kv_cache import PagedKVCache
-from .scheduler import ContinuousBatchingScheduler, ServeRequest
+from .scheduler import (ContinuousBatchingScheduler, OverloadedError,
+                        RequestState, ServeRequest)
 
-__all__ = ["ServeConfig", "ServingEngine"]
+__all__ = ["ServeConfig", "ServingEngine", "OverloadedError"]
 
 _LATENCY_BUCKETS = (0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
                     1.0, 2.5, 5.0, 10.0, 30.0, 60.0)
@@ -57,7 +78,10 @@ class ServeConfig:
 
     def __init__(self, max_running=4, token_budget=16, block_size=16,
                  num_blocks=64, max_blocks_per_seq=None,
-                 default_deadline_s=None, max_steps=10000):
+                 default_deadline_s=None, max_steps=10000,
+                 max_queued=256, max_queued_tokens=None,
+                 max_queued_blocks=None, max_queue_wait_s=None,
+                 drain_deadline_s=30.0, journal_max_bytes=4 << 20):
         self.max_running = int(max_running)
         self.token_budget = int(token_budget)
         self.block_size = int(block_size)
@@ -65,10 +89,18 @@ class ServeConfig:
         self.max_blocks_per_seq = max_blocks_per_seq
         self.default_deadline_s = default_deadline_s
         self.max_steps = int(max_steps)
+        # admission bounds (scheduler.py documents the semantics; None
+        # scales the token/block bounds to the engine's capacity)
+        self.max_queued = int(max_queued)
+        self.max_queued_tokens = max_queued_tokens
+        self.max_queued_blocks = max_queued_blocks
+        self.max_queue_wait_s = max_queue_wait_s
+        self.drain_deadline_s = float(drain_deadline_s)
+        self.journal_max_bytes = int(journal_max_bytes)
 
 
 class ServingEngine:
-    def __init__(self, model, config=None, elastic=None):
+    def __init__(self, model, config=None, elastic=None, journal=None):
         self.model = model
         self.config = config or ServeConfig()
         self.cache = PagedKVCache(model.kv_config(
@@ -78,8 +110,26 @@ class ServingEngine:
         self.scheduler = ContinuousBatchingScheduler(
             self.cache, max_running=self.config.max_running,
             token_budget=self.config.token_budget,
-            default_deadline_s=self.config.default_deadline_s)
+            default_deadline_s=self.config.default_deadline_s,
+            max_queued=self.config.max_queued,
+            max_queued_tokens=self.config.max_queued_tokens,
+            max_queued_blocks=self.config.max_queued_blocks,
+            max_queue_wait_s=self.config.max_queue_wait_s)
         self.elastic = elastic          # optional watchdog/heartbeat
+        # crash-recovery journal: a RequestJournal, a path, or the
+        # PADDLE_TPU_SERVE_JOURNAL env (None = journal-less serving)
+        journal = journal or os.environ.get("PADDLE_TPU_SERVE_JOURNAL")
+        if journal is not None and not isinstance(journal, RequestJournal):
+            journal = RequestJournal(
+                journal, max_bytes=self.config.journal_max_bytes)
+        self.journal = journal
+        # graceful-shutdown state: the signal handler only flips the
+        # flag; the decode loop performs the drain at a step boundary
+        self._drain_signal = []         # appended by _on_drain_signal
+        self._drain_signal_deadline = None
+        self._prev_handlers = {}
+        self._drain_state = "serving"   # serving | draining | drained
+        self._drain_report = None
         self.steps = 0
         self._busy_s = 0.0
         self._tokens_out = 0
@@ -123,13 +173,39 @@ class ServingEngine:
     # -- request API --------------------------------------------------------
 
     def submit(self, prompt, max_new_tokens=16, deadline_s=None,
-               eos_id=None, request_id=None):
-        """Queue one request; returns its id."""
+               eos_id=None, request_id=None, _resume=None):
+        """Queue one request; returns its id. Raises `OverloadedError`
+        (after counting an ``overloaded`` outcome) when admission
+        control sheds it — the caller owns retry/backoff. Thread-safe:
+        any thread may submit while the decode loop runs."""
         req = ServeRequest(prompt, max_new_tokens=max_new_tokens,
                            deadline_s=deadline_s, eos_id=eos_id,
                            request_id=request_id)
-        self.scheduler.submit(req)
+        if _resume:
+            # recovery re-admission: `prompt` already carries the
+            # previous life's tokens; remember the split so results
+            # and the journal reconstruct the original request
+            req.resume_prefix = [int(t) for t in _resume]
+        try:
+            self.scheduler.submit(req)
+        except OverloadedError:
+            self._c_req.labels(outcome="overloaded").inc()
+            raise
+        if self.journal is not None:
+            self.journal.record_submit(req)
         return req.request_id
+
+    def cancel(self, request_id):
+        """Abort a queued or running request NOW — its KV blocks are
+        freed immediately and the outcome counter records
+        ``cancelled``. Returns False for unknown/finished ids."""
+        ok = self.scheduler.cancel(request_id)
+        if ok:
+            # the scheduler parked it in the evicted history; account
+            # it (outcome label, latency histogram, journal fin) now
+            # instead of waiting for the next step
+            self._account_evicted()
+        return ok
 
     def step(self):
         """One decode-loop iteration. Returns False when no work ran
@@ -167,6 +243,13 @@ class ServingEngine:
             tokens = np.asarray(sampled._value)  # fuselint: ok[FL001] the decode loop's one intended per-step sync
         now = time.perf_counter()
         finished = self.scheduler.complete_step(plan, tokens, now=now)
+        if self.journal is not None:
+            # exactly the tokens complete_step appended (an eviction
+            # that raced the batch contributes no journal token)
+            self.journal.record_step(
+                [(req.request_id, req.generated[-1])
+                 for _row, req in plan.emit
+                 if req.state != RequestState.EVICTED and req.generated])
         self.steps += 1
         self._busy_s += now - t0
         self._tokens_out += len(plan.emit)
@@ -186,10 +269,16 @@ class ServingEngine:
                                request=req.request_id,
                                tokens=len(req.generated))
             self._c_req.labels(outcome="completed").inc()
+            # full output = tokens from a previous process life (journal
+            # recovery) + this life's generation
+            out = req.resume_prefix + req.generated
+            if self.journal is not None:
+                self.journal.record_finish(req.request_id, "completed",
+                                           tokens=out)
             # results parked until the next run() drains them (bounded
             # like the scheduler history — a step()-loop caller that
             # never drains must not grow memory per request served)
-            self._results[req.request_id] = list(req.generated)
+            self._results[req.request_id] = out
             while len(self._results) > self._results_limit:
                 self._results.pop(next(iter(self._results)))
         self._account_evicted()
@@ -229,14 +318,23 @@ class ServingEngine:
             return
         self._evicted_seen = self.scheduler.evicted_total
         for req in list(self.scheduler.evicted)[-new:]:
-            self._c_req.labels(outcome="evicted").inc()
+            # reason -> outcome: a caller-initiated cancel and a queued-
+            # too-long shed are not degradation-"evicted"; everything
+            # else (deadline, kv_exhausted, prompt_too_long, drain) is
+            outcome = {"cancelled": "cancelled",
+                       "queue_timeout": "overloaded"}.get(
+                           req.evict_reason, "evicted")
+            self._c_req.labels(outcome=outcome).inc()
+            if self.journal is not None:
+                self.journal.record_finish(req.request_id, outcome)
             # an evicted request still closes its latency span — the
             # operator's histogram covers every request that LEFT, not
             # only the happy path (outcome label tells them apart)
             dt = time.perf_counter() - req.t_submit
             self._h_request.observe(dt)
             _tracing.emit_span("request", "serve", req.t_submit_wall, dt,
-                               request=req.request_id, evicted=True)
+                               request=req.request_id, evicted=True,
+                               reason=req.evict_reason)
 
     def run(self, max_steps=None):
         """Drive `step()` until the queue drains (or `max_steps`).
@@ -244,13 +342,96 @@ class ServingEngine:
         that finished since the previous `run()` call drained them."""
         limit = max_steps if max_steps is not None else self.config.max_steps
         steps = 0
+        idle = 0
         while self.scheduler.has_work() and steps < limit:
+            if self._drain_signal:
+                self._handle_signal_drain()
+                break
             if not self.step():
                 if not self.scheduler.has_work():
                     break
+                # work is queued but nothing was runnable (KV starved,
+                # chaos-injected allocator failures, ...): return
+                # promptly instead of spinning empty plans to max_steps
+                idle += 1
+                if idle >= 2:
+                    break
+            else:
+                idle = 0
             steps += 1
         out, self._results = self._results, {}
         return out
+
+    # -- graceful shutdown --------------------------------------------------
+
+    def drain(self, deadline_s=None):
+        """Stop admission, finish accepted work, evict stragglers at
+        the deadline. Returns a report dict whose ``results`` carries
+        everything that finished (including work completed by earlier
+        steps but not yet drained by `run()`). Safe to call twice —
+        the second call just sweeps what is left."""
+        deadline = (self.config.drain_deadline_s
+                    if deadline_s is None else float(deadline_s))
+        t0 = time.perf_counter()
+        st = self.scheduler.stats()
+        self._drain_state = "draining"
+        _telemetry.emit("serve_drain", state="begin", queued=st["queued"],
+                        running=st["running"], deadline_s=deadline)
+        self.scheduler.begin_drain()
+        idle = 0
+        while (self.scheduler.has_work()
+               and time.perf_counter() - t0 < deadline):
+            if not self.step():
+                idle += 1
+                if idle >= 2:
+                    break  # leftovers are not runnable; evict them below
+            else:
+                idle = 0
+        shed = 0
+        if self.scheduler.has_work():
+            shed = self.scheduler.shed_remaining("drain_deadline")
+            self._account_evicted()
+        results, self._results = self._results, {}
+        dt = time.perf_counter() - t0
+        self._drain_state = "drained"
+        report = {"duration_s": dt, "completed": len(results),
+                  "shed": shed, "results": results}
+        self._drain_report = {"duration_s": round(dt, 6),
+                              "completed": len(results), "shed": shed}
+        _telemetry.emit("serve_drain", state="end",
+                        completed=len(results), shed=shed,
+                        duration_s=round(dt, 6))
+        return report
+
+    def install_signal_drain(self, signum=_signal.SIGTERM,
+                             deadline_s=None):
+        """Arm graceful drain on `signum` (main thread only — Python's
+        signal contract). The handler only flips a flag; `run()` drains
+        at the next step boundary, dumps a PR-14 postmortem bundle with
+        the drain report, then re-delivers the signal through the
+        previously-installed handler chain — a supervisor still sees
+        the default termination semantics (rc ``-SIGTERM``) AND the
+        diagnostics bundle still lands."""
+        self._drain_signal_deadline = deadline_s
+        self._prev_handlers[signum] = _signal.signal(
+            signum, self._on_drain_signal)
+
+    def _on_drain_signal(self, signum, frame):
+        # flag only: a signal handler must not run the decode loop
+        self._drain_signal.append(signum)
+
+    def _handle_signal_drain(self):
+        signum = self._drain_signal[-1]
+        self.drain(self._drain_signal_deadline)
+        _diagnostics.maybe_dump("sigterm_drain",
+                                extra={"drain": self._drain_report})
+        # chain: restore whatever was installed before us and
+        # re-deliver, so the PR-14 fatal-signal bundle path and the
+        # default rc=-signum semantics both still hold
+        prev = self._prev_handlers.get(signum)
+        _signal.signal(signum,
+                       prev if callable(prev) else _signal.SIG_DFL)
+        os.kill(os.getpid(), signum)
 
     def generate(self, prompts, max_new_tokens=16, **kw):
         """Convenience: submit `prompts` (list of token lists), run to
@@ -271,6 +452,61 @@ class ServingEngine:
 
         doc = _warmup.load_manifest(manifest_path)
         return _warmup.precompile(doc)
+
+    # -- crash recovery -----------------------------------------------------
+
+    def recover(self, journal_path=None):
+        """Re-admit a crashed process's unfinished journaled requests.
+
+        Each unfinished request is resubmitted under its ORIGINAL id
+        with the already-emitted tokens appended to its prompt (added
+        context) and its token budget reduced by what was already
+        generated — greedy sampling plus per-row ragged-batch
+        independence make the resumed completion token-exact vs an
+        uninterrupted run. A request whose journaled tokens already
+        satisfy its stopping rule is returned as completed without
+        re-admission. Deadlines restart at recovery (pre-crash queue
+        time is not billed to the request).
+
+        Returns ``{"resumed": [ids], "completed": {id: tokens},
+        "skipped": [ids]}`` — ``completed`` holds pre-crash finishes
+        plus already-done resumes; ``skipped`` holds requests shed by
+        admission control on re-admission."""
+        path = journal_path or (self.journal.path
+                                if self.journal is not None else None)
+        if path is None:
+            raise ValueError("recover() needs a journal (engine journal "
+                             "or explicit journal_path)")
+        doc = read_journal(path)
+        completed = dict(doc["completed"])
+        resumed, skipped = [], []
+        for spec in doc["unfinished"]:
+            gen = spec["gen"]
+            max_new = spec["max_new_tokens"]
+            eos = spec["eos_id"]
+            if ((max_new and len(gen) >= max_new)
+                    or (eos is not None and gen and gen[-1] == eos)):
+                # the crash lost only the fin record, not tokens
+                completed[spec["id"]] = list(gen)
+                if self.journal is not None:
+                    self.journal.record_finish(spec["id"], "completed",
+                                               tokens=gen)
+                continue
+            try:
+                self.submit(spec["prompt"] + gen,
+                            max_new_tokens=(max_new - len(gen)
+                                            if max_new else 0),
+                            deadline_s=spec["deadline_s"],
+                            eos_id=eos, request_id=spec["id"],
+                            _resume=gen)
+                resumed.append(spec["id"])
+            except OverloadedError:
+                skipped.append(spec["id"])
+        _telemetry.emit("serve_recover", path=path,
+                        resumed=len(resumed), completed=len(completed),
+                        skipped=len(skipped))
+        return {"resumed": resumed, "completed": completed,
+                "skipped": skipped}
 
     def stats(self):
         s = self.scheduler.stats()
@@ -306,6 +542,18 @@ class ServingEngine:
                  "max_new_tokens": req.max_new_tokens}
                 for slot, req in sorted(running.items())],
             "queued": len(self.scheduler.queue),
+            "queue": {"depth": len(self.scheduler.queue),
+                      "max_queued": self.scheduler.max_queued,
+                      "queued_blocks": self.scheduler.queued_blocks(),
+                      "max_queued_blocks": self.scheduler.max_queued_blocks,
+                      "max_queued_tokens": self.scheduler.max_queued_tokens,
+                      "max_queue_wait_s": self.scheduler.max_queue_wait_s},
+            "shed": {"total": self.scheduler.shed_total,
+                     "by_reason": dict(self.scheduler.shed_by_reason)},
+            "drain": {"state": self._drain_state,
+                      "report": self._drain_report},
+            "journal": (self.journal.stats()
+                        if self.journal is not None else None),
             "undrained_results": len(self._results),
         }
 

@@ -1,0 +1,250 @@
+"""Append-only serving request journal (ISSUE 18 crash recovery).
+
+Every admitted request writes a ``sub`` record (original prompt,
+sampling/stopping params), every decode step appends one ``tok`` record
+carrying the step's emitted (request_id, token) pairs, and every
+request that leaves the engine writes a ``fin`` record with its outcome
+(completed fins carry the full token list). A process killed mid-decode
+therefore leaves enough on disk to reconstruct, per request: what was
+asked, and every token already emitted. `read_journal()` folds the file
+back into that state; `ServingEngine.recover()` re-admits the
+unfinished tail with the already-generated tokens as added context —
+greedy sampling plus per-row batch independence make the resumed
+completion token-exact vs an uninterrupted run.
+
+Durability + liveness contract (the PR-14 spill idiom):
+
+* appends are buffered line writes under a private lock, flushed per
+  record — a SIGKILL loses at most the final partially-written line,
+  which `read_journal` tolerates as a torn tail;
+* when the file outgrows ``max_bytes`` it is COMPACTED, not rotated
+  away: live (unfinished) requests are rewritten as fresh ``sub``
+  records carrying their generated-so-far tokens into a tmp file that
+  atomically `os.replace`s the journal — readers see the old file or
+  the new one, never half of either. Finished records are dropped by
+  compaction (results were already delivered at finish time);
+* a write failure NEVER raises into the decode loop: the record is
+  dropped, a ``journal_errors`` fault is counted, and serving
+  continues journal-less-degraded. The ``serve.journal_write`` fault
+  point makes that path testable.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+
+from ..runtime.resilience import fault_point, record_fault
+
+__all__ = ["RequestJournal", "read_journal"]
+
+
+class RequestJournal:
+    """Append-only JSONL journal for one ServingEngine."""
+
+    def __init__(self, path, max_bytes=4 << 20, fsync=False):
+        self.path = os.path.abspath(str(path))
+        self.max_bytes = int(max_bytes)
+        self.fsync = bool(fsync)
+        self._lock = threading.Lock()
+        self._fh = None
+        self._bytes = 0
+        self._records = 0
+        self._compactions = 0
+        self.errors = 0
+        # id -> {"prompt","max_new_tokens","eos_id","deadline_s","gen"}:
+        # the live (unfinished) set, exactly what compaction rewrites.
+        # Bounded by the scheduler's admission bounds, not by traffic.
+        self._live = {}
+        parent = os.path.dirname(self.path)
+        if parent:
+            os.makedirs(parent, exist_ok=True)
+        try:
+            self._fh = open(self.path, "a", encoding="utf-8")
+            self._bytes = self._fh.tell()
+        except OSError as e:
+            self._note_error(e)
+
+    # -- record producers (called from the engine) --------------------------
+
+    def record_submit(self, req):
+        """One admitted request. For a recovery re-admission the
+        scheduling prompt carries the previous life's tokens — the
+        record stores the ORIGINAL prompt plus those tokens as ``gen``
+        so a second crash still reconstructs the original request."""
+        prefix = list(req.resume_prefix)
+        orig = (req.prompt[:len(req.prompt) - len(prefix)]
+                if prefix else req.prompt)
+        rec = {"k": "sub", "id": req.request_id, "prompt": list(orig),
+               "max_new_tokens": int(req.max_new_tokens),
+               "eos_id": req.eos_id, "deadline_s": req.deadline_s}
+        if prefix:
+            rec["gen"] = prefix
+        with self._lock:
+            self._live[req.request_id] = {
+                "prompt": list(orig),
+                "max_new_tokens": int(req.max_new_tokens),
+                "eos_id": req.eos_id, "deadline_s": req.deadline_s,
+                "gen": list(prefix)}
+        self._append(rec)
+
+    def record_step(self, pairs):
+        """One decode step's emitted (request_id, token) pairs."""
+        if not pairs:
+            return
+        toks = [[rid, int(t)] for rid, t in pairs]
+        with self._lock:
+            for rid, t in toks:
+                entry = self._live.get(rid)
+                if entry is not None:
+                    entry["gen"].append(t)
+        self._append({"k": "tok", "toks": toks})
+
+    def record_finish(self, request_id, outcome, tokens=None):
+        """The request left the engine. ``tokens`` (full output,
+        resume prefix included) rides along for completed requests so
+        recovery can return pre-crash results without replaying."""
+        rec = {"k": "fin", "id": request_id, "outcome": outcome}
+        if tokens is not None:
+            rec["toks"] = [int(t) for t in tokens]
+        with self._lock:
+            self._live.pop(request_id, None)
+        self._append(rec)
+
+    # -- the append path ----------------------------------------------------
+
+    def _append(self, rec):
+        if self._fh is None:
+            return
+        try:
+            # chaos hook — BEFORE the lock, so an injected delay stalls
+            # only this producer, and an injected raise exercises the
+            # drop-and-degrade path below
+            fault_point("serve.journal_write", record=rec.get("k"))
+            line = json.dumps(rec, separators=(",", ":")) + "\n"
+            with self._lock:
+                self._fh.write(line)  # threadlint: ok[CL003] serialized appends ARE the journal's ordering contract (the _FlightSpill idiom): one buffered line write + flush per record, and record producers are the decode thread + submitters only
+                self._fh.flush()  # threadlint: ok[CL003] see above — per-record flush bounds SIGKILL loss to one torn line
+                if self.fsync:
+                    os.fsync(self._fh.fileno())  # threadlint: ok[CL003] opt-in durability mode; callers choosing fsync chose the stall
+                self._bytes += len(line)
+                self._records += 1
+        except Exception as e:  # noqa: BLE001 — the journal must never
+            # kill the serving loop it protects; drop + count + continue
+            self._note_error(e)
+            return
+        if self._bytes > self.max_bytes:
+            self._compact()
+
+    def _note_error(self, err):
+        self.errors += 1
+        record_fault("journal_errors", f"{type(err).__name__}: {err}")
+
+    def _compact(self):
+        """Rewrite the journal as one fresh ``sub`` record per live
+        request (generated-so-far folded in as ``gen``), via tmp +
+        atomic rename. Finished history is dropped — its results were
+        delivered when they finished."""
+        tmp = f"{self.path}.tmp.{os.getpid()}.{threading.get_ident()}"
+        try:
+            with self._lock:
+                # the whole rewrite runs under the lock: appends racing
+                # a half-compacted file would lose records — atomicity
+                # here IS the durability contract, stall accepted
+                with open(tmp, "w", encoding="utf-8") as fh:  # threadlint: ok[CL003] see above
+                    for rid, e in self._live.items():
+                        rec = {"k": "sub", "id": rid,
+                               "prompt": list(e["prompt"]),
+                               "max_new_tokens": e["max_new_tokens"],
+                               "eos_id": e["eos_id"],
+                               "deadline_s": e["deadline_s"]}
+                        if e["gen"]:
+                            rec["gen"] = list(e["gen"])
+                        fh.write(json.dumps(rec, separators=(",", ":"))  # threadlint: ok[CL003] see above
+                                 + "\n")
+                    fh.flush()  # threadlint: ok[CL003] see above
+                    os.fsync(fh.fileno())  # threadlint: ok[CL003] see above
+                self._fh.close()
+                os.replace(tmp, self.path)
+                self._fh = open(self.path, "a", encoding="utf-8")  # threadlint: ok[CL003] see above
+                self._bytes = self._fh.tell()
+                self._compactions += 1
+        except Exception as e:  # noqa: BLE001 — same contract as appends
+            self._note_error(e)
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+            with self._lock:
+                if self._fh is None or self._fh.closed:
+                    try:
+                        self._fh = open(self.path, "a", encoding="utf-8")  # threadlint: ok[CL003] failure-path reopen; one-off by construction
+                        self._bytes = self._fh.tell()
+                    except OSError:
+                        self._fh = None  # journal-less degraded from here
+
+    def stats(self):
+        return {"path": self.path, "records": self._records,
+                "bytes": self._bytes, "live": len(self._live),
+                "compactions": self._compactions, "errors": self.errors,
+                "ok": self._fh is not None}
+
+    def close(self):
+        with self._lock:
+            if self._fh is not None:
+                try:
+                    self._fh.flush()  # threadlint: ok[CL003] shutdown path; no producer left to stall
+                    self._fh.close()
+                except OSError:
+                    pass
+                self._fh = None
+
+
+def read_journal(path):
+    """Fold a journal back into recovery state.
+
+    Returns ``{"unfinished": [spec...], "completed": {id: tokens},
+    "outcomes": {id: outcome}}`` where each unfinished spec carries the
+    original prompt, stopping params, and ``gen`` (every token emitted
+    before the crash, resume prefixes folded in). A torn final line
+    (the record a SIGKILL interrupted mid-write) is skipped, as is any
+    line that fails to parse — recovery prefers a lost record to a
+    wedged restart."""
+    entries = {}
+    completed = {}
+    outcomes = {}
+    if not os.path.exists(path):
+        return {"unfinished": [], "completed": completed,
+                "outcomes": outcomes}
+    with open(path, encoding="utf-8") as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue  # torn tail from the crash — expected
+            k = rec.get("k")
+            if k == "sub":
+                entries[rec["id"]] = {
+                    "id": rec["id"], "prompt": list(rec.get("prompt", [])),
+                    "max_new_tokens": int(rec.get("max_new_tokens", 0)),
+                    "eos_id": rec.get("eos_id"),
+                    "deadline_s": rec.get("deadline_s"),
+                    "gen": [int(t) for t in rec.get("gen", [])]}
+            elif k == "tok":
+                for rid, t in rec.get("toks", []):
+                    e = entries.get(rid)
+                    if e is not None:
+                        e["gen"].append(int(t))
+            elif k == "fin":
+                e = entries.pop(rec.get("id"), None)
+                outcomes[rec.get("id")] = rec.get("outcome")
+                if rec.get("outcome") == "completed":
+                    toks = rec.get("toks")
+                    if toks is None:
+                        toks = e["gen"] if e else []
+                    completed[rec["id"]] = [int(t) for t in toks]
+    return {"unfinished": list(entries.values()),
+            "completed": completed, "outcomes": outcomes}

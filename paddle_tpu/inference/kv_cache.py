@@ -31,6 +31,7 @@ import numpy as np
 
 from ..core.tensor import Tensor
 from ..runtime import telemetry as _telemetry
+from ..runtime.resilience import fault_point
 
 __all__ = ["KVCacheConfig", "PagedKVCache"]
 
@@ -133,6 +134,11 @@ class PagedKVCache:
         when the pool cannot supply the missing blocks or the request
         would exceed ``max_blocks_per_seq`` — the scheduler's cue to
         defer or preempt."""
+        # chaos hook (BEFORE the lock — an injected delay must not
+        # serialize readers): an injected raise here looks to the
+        # scheduler exactly like pool exhaustion
+        fault_point("serve.kv_alloc", request=str(request_id),
+                    tokens=int(num_tokens))
         need = self.blocks_for(num_tokens)
         if need > self.config.max_blocks_per_seq:
             return False

@@ -21,11 +21,28 @@ All array outputs are fixed-shape (token budget T, slot count R, table
 width Bmax) so the jit cache sees ONE step signature regardless of the
 ragged mix — the padding-free property is about never paying a
 [batch, max_seq] rectangle, not about varying T.
+
+Overload contract (ISSUE 18): the waiting queue is BOUNDED — by count
+(`max_queued`), by queued prompt tokens (`max_queued_tokens`, measured
+in steps of token-budget backlog), and by the KV blocks the queued work
+will need at full context (`max_queued_blocks`). `submit()` refuses
+over-bound work with `OverloadedError` (the request is never queued, so
+memory cannot grow with arrival rate), and a queued request that waits
+past `max_queue_wait_s` is shed at the next `plan()` — both paths count
+``serve_sheds`` fault events. `begin_drain()` flips admission off for a
+graceful shutdown while accepted work finishes.
+
+Thread contract: one RLock guards queue/running/accounting state, so
+`submit()`/`cancel()` from any caller thread may race the decode
+thread's `plan()`/`complete_step()`. Fault events observed under the
+lock are DEFERRED and recorded after release — telemetry reaches the
+event stream (file I/O) and must never run under the planner lock.
 """
 from __future__ import annotations
 
 import collections
 import itertools
+import threading
 import time
 
 import numpy as np
@@ -33,7 +50,20 @@ import numpy as np
 from ..runtime.resilience import record_fault
 
 __all__ = ["RequestState", "ServeRequest", "StepPlan",
-           "ContinuousBatchingScheduler"]
+           "ContinuousBatchingScheduler", "OverloadedError"]
+
+
+class OverloadedError(RuntimeError):
+    """`submit()` refused a request: the engine is shedding load.
+
+    `reason` is one of ``queue_full`` / ``token_backlog`` /
+    ``kv_backlog`` / ``draining``. The request was never queued — the
+    caller owns retry/backoff policy."""
+
+    def __init__(self, request_id, reason):
+        super().__init__(f"{request_id} shed: {reason}")
+        self.request_id = request_id
+        self.reason = reason
 
 
 class RequestState:
@@ -53,7 +83,7 @@ class ServeRequest:
     __slots__ = ("request_id", "prompt", "max_new_tokens", "deadline_s",
                  "eos_id", "state", "generated", "slot", "n_fed",
                  "n_cached", "t_submit", "t_submit_wall", "t_first_token",
-                 "t_done", "preemptions", "evict_reason")
+                 "t_done", "preemptions", "evict_reason", "resume_prefix")
 
     def __init__(self, prompt, max_new_tokens=16, deadline_s=None,
                  eos_id=None, request_id=None):
@@ -77,6 +107,10 @@ class ServeRequest:
         self.t_done = None
         self.preemptions = 0
         self.evict_reason = None
+        # journal recovery: tokens this request already generated in a
+        # previous process life (its scheduling `prompt` then carries
+        # them as context; final output = resume_prefix + generated)
+        self.resume_prefix = []
 
     @property
     def context_len(self):
@@ -127,7 +161,9 @@ class ContinuousBatchingScheduler:
     """Admission queue + running set over a PagedKVCache."""
 
     def __init__(self, cache, max_running=4, token_budget=16,
-                 default_deadline_s=None, history_limit=1024):
+                 default_deadline_s=None, history_limit=1024,
+                 max_queued=256, max_queued_tokens=None,
+                 max_queued_blocks=None, max_queue_wait_s=None):
         if token_budget < 1 or max_running < 1:
             raise ValueError("token_budget and max_running must be >= 1")
         self.cache = cache
@@ -144,14 +180,113 @@ class ContinuousBatchingScheduler:
         self.evicted_total = 0
         self._admit_order = itertools.count()
         self._admitted_at = {}    # request_id -> admit sequence number
+        # -- admission bounds (None picks a default scaled to the
+        # engine's actual capacity, so defaults degrade sanely when the
+        # pool/budget shrink) --
+        self.max_queued = int(max_queued)
+        self.max_queued_tokens = (int(max_queued_tokens)
+                                  if max_queued_tokens is not None
+                                  else 64 * self.token_budget)
+        self.max_queued_blocks = (int(max_queued_blocks)
+                                  if max_queued_blocks is not None
+                                  else 4 * cache.config.num_blocks)
+        self.max_queue_wait_s = max_queue_wait_s
+        self.draining = False
+        self.shed_total = 0
+        self.shed_by_reason = {}
+        # one lock for queue/running/accounting; fault events observed
+        # under it are parked here and recorded after release
+        self._lock = threading.RLock()
+        self._deferred = collections.deque()
 
     # -- lifecycle ----------------------------------------------------------
 
     def submit(self, request):
-        if request.deadline_s is None:
-            request.deadline_s = self.default_deadline_s
-        self.queue.append(request)
+        """Admit `request` to the bounded waiting queue, or shed it
+        with `OverloadedError` (never queued; memory cannot grow with
+        arrival rate). Thread-safe against the decode thread's
+        `plan()`/`complete_step()`."""
+        with self._lock:
+            if request.deadline_s is None:
+                request.deadline_s = self.default_deadline_s
+            reason = self._shed_reason(request)
+            if reason is None:
+                self.queue.append(request)
+            else:
+                request.state = RequestState.EVICTED
+                request.evict_reason = reason
+                self._count_shed(reason)
+        if reason is not None:
+            # outside the lock: fault recording reaches the telemetry
+            # event stream (file I/O must not serialize the planner)
+            record_fault("serve_sheds", f"{request.request_id}: {reason}")
+            raise OverloadedError(request.request_id, reason)
         return request.request_id
+
+    def _shed_reason(self, request):
+        """First violated admission bound, or None to admit."""
+        if self.draining:
+            return "draining"
+        if len(self.queue) >= self.max_queued:
+            return "queue_full"
+        if (sum(len(r.prompt) for r in self.queue) + len(request.prompt)
+                > self.max_queued_tokens):
+            return "token_backlog"
+        if (self.queued_blocks() + self._blocks_needed(request)
+                > self.max_queued_blocks):
+            return "kv_backlog"
+        return None
+
+    def _count_shed(self, reason):
+        self.shed_total += 1
+        self.shed_by_reason[reason] = self.shed_by_reason.get(reason, 0) + 1
+
+    def _blocks_needed(self, req):
+        """KV blocks `req` will need at its full context horizon."""
+        horizon = min(len(req.prompt) + req.max_new_tokens + 1,
+                      self.cache.config.max_context)
+        return self.cache.blocks_for(horizon)
+
+    def queued_blocks(self):
+        """Blocks the whole waiting queue will eventually claim."""
+        with self._lock:
+            return sum(self._blocks_needed(r) for r in self.queue)
+
+    def cancel(self, request_id):
+        """Remove a queued or running request NOW, freeing its KV
+        blocks immediately. Returns False for unknown/finished ids.
+        No fault event — cancellation is caller intent, not
+        degradation (the engine labels the outcome counter)."""
+        with self._lock:
+            for req in list(self.queue):
+                if req.request_id == request_id:
+                    self.queue.remove(req)
+                    self._evict(req, "cancelled")
+                    return True
+            for req in list(self.running.values()):
+                if req.request_id == request_id:
+                    self._evict(req, "cancelled")
+                    return True
+        return False
+
+    def begin_drain(self):
+        """Stop admission (submit sheds with reason ``draining``);
+        already-accepted work keeps running to completion."""
+        with self._lock:
+            self.draining = True
+
+    def shed_remaining(self, reason="drain_deadline"):
+        """Evict every queued and running request (the drain deadline
+        expired). Returns how many were evicted."""
+        n = 0
+        with self._lock:
+            while self.queue:
+                self._evict(self.queue.popleft(), reason)
+                n += 1
+            for req in list(self.running.values()):
+                self._evict(req, reason)
+                n += 1
+        return n
 
     def has_work(self):
         return bool(self.queue or self.running)
@@ -163,7 +298,9 @@ class ContinuousBatchingScheduler:
         return None
 
     def _evict(self, req, reason, fault=None):
-        """Remove `req` from the running set and free its blocks."""
+        """Remove `req` from the running set and free its blocks.
+        Caller holds the lock; the fault event (if any) is deferred to
+        the next unlocked `_flush_faults()`."""
         self.cache.release(req.request_id)
         if req.slot is not None:
             self.running.pop(req.slot, None)
@@ -174,7 +311,28 @@ class ContinuousBatchingScheduler:
         self.evicted_total += 1
         self._admitted_at.pop(req.request_id, None)
         if fault:
-            record_fault(fault, f"{req.request_id}: {reason}")
+            detail = f"{req.request_id}: {reason}"
+            self._deferred.append(lambda: record_fault(fault, detail))
+
+    def _flush_faults(self):
+        """Record the fault events the locked sections deferred. Always
+        called with the lock RELEASED (deque ops are atomic)."""
+        while self._deferred:
+            try:
+                fn = self._deferred.popleft()
+            except IndexError:
+                return
+            fn()
+
+    def _ensure(self, request_id, num_tokens):
+        """`cache.ensure_capacity` through the ``serve.kv_alloc`` fault
+        point: an injected allocator failure degrades to "no capacity"
+        (preempt / evict / wait — the decode loop's normal exhaustion
+        paths) instead of crashing the loop."""
+        try:
+            return self.cache.ensure_capacity(request_id, num_tokens)
+        except OSError:
+            return False
 
     def _preempt_for_blocks(self, needy):
         """Free blocks for a decode request by returning the YOUNGEST
@@ -194,9 +352,9 @@ class ContinuousBatchingScheduler:
         victim.n_cached = 0
         victim.preemptions += 1
         self.queue.appendleft(victim)
-        record_fault("kv_preemptions",
-                     f"{victim.request_id} preempted for "
-                     f"{needy.request_id}")
+        detail = (f"{victim.request_id} preempted for "
+                  f"{needy.request_id}")
+        self._deferred.append(lambda: record_fault("kv_preemptions", detail))
         return True
 
     # -- the per-iteration planner -----------------------------------------
@@ -205,8 +363,17 @@ class ContinuousBatchingScheduler:
         """Build the next ragged batch. Returns a StepPlan (possibly
         empty: nothing runnable this iteration)."""
         now = time.perf_counter() if now is None else now
+        with self._lock:
+            plan = self._plan_locked(now)
+        self._flush_faults()
+        return plan
+
+    def _plan_locked(self, now):
         # 1. deadlines: expired requests leave the batch loop HERE, so a
-        # slow request can never wedge the others past its budget
+        # slow request can never wedge the others past its budget; a
+        # queued request past the max queue wait is shed the same way
+        # (admitting work that already waited too long only burns KV on
+        # a request whose caller has likely given up)
         for req in list(self.running.values()):
             if req.expired(now):
                 self._evict(req, "deadline", fault="request_deadline")
@@ -215,6 +382,11 @@ class ContinuousBatchingScheduler:
                 self.queue.remove(req)
                 self._evict(req, "deadline_queued",
                             fault="request_deadline")
+            elif (self.max_queue_wait_s is not None
+                    and now - req.t_submit > self.max_queue_wait_s):
+                self.queue.remove(req)
+                self._count_shed("queue_timeout")
+                self._evict(req, "queue_timeout", fault="serve_sheds")
         # 2. admission: slot free + at least one block to start on. A
         # prompt that cannot fit the per-request context bound even
         # with every generated token still to come is rejected HERE —
@@ -251,8 +423,7 @@ class ContinuousBatchingScheduler:
                 # every prefilling request for nothing
                 self._evict(req, "context_exhausted", fault="kv_evictions")
                 continue
-            while not self.cache.ensure_capacity(req.request_id,
-                                                 req.n_cached + 1):
+            while not self._ensure(req.request_id, req.n_cached + 1):
                 if not self._preempt_for_blocks(req):
                     break
             else:
@@ -280,7 +451,7 @@ class ContinuousBatchingScheduler:
             if req is None or req.n_fed >= len(req.prompt):
                 continue
             chunk = min(budget, len(req.prompt) - req.n_fed)
-            while chunk > 0 and not self.cache.ensure_capacity(
+            while chunk > 0 and not self._ensure(
                     req.request_id, req.n_fed + chunk):
                 # shrink to what the pool (and the per-request block
                 # bound) can hold before resorting to waiting; always
@@ -315,27 +486,33 @@ class ContinuousBatchingScheduler:
         plan.emit rows). Returns the requests that finished this step."""
         now = time.perf_counter() if now is None else now
         done = []
-        for row, req in plan.emit:
-            if req.state != RequestState.RUNNING:
-                continue  # evicted mid-step (deadline raced the batch)
-            req.generated.append(int(tokens[row]))
-            if req.t_first_token is None:
-                req.t_first_token = now
-            if self._done(req):
-                req.t_done = now
-                req.state = RequestState.FINISHED
-                self.cache.release(req.request_id)
-                self.running.pop(req.slot, None)
-                req.slot = None
-                self.finished.append(req)
-                self.finished_total += 1
-                self._admitted_at.pop(req.request_id, None)
-                done.append(req)
+        with self._lock:
+            for row, req in plan.emit:
+                if req.state != RequestState.RUNNING:
+                    continue  # evicted mid-step (deadline raced the batch)
+                req.generated.append(int(tokens[row]))
+                if req.t_first_token is None:
+                    req.t_first_token = now
+                if self._done(req):
+                    req.t_done = now
+                    req.state = RequestState.FINISHED
+                    self.cache.release(req.request_id)
+                    self.running.pop(req.slot, None)
+                    req.slot = None
+                    self.finished.append(req)
+                    self.finished_total += 1
+                    self._admitted_at.pop(req.request_id, None)
+                    done.append(req)
         return done
 
     def stats(self):
-        return {"queued": len(self.queue),
-                "running": len(self.running),
-                "finished": self.finished_total,
-                "evicted": self.evicted_total,
-                "kv": self.cache.stats()}
+        with self._lock:
+            return {"queued": len(self.queue),
+                    "running": len(self.running),
+                    "finished": self.finished_total,
+                    "evicted": self.evicted_total,
+                    "shed": self.shed_total,
+                    "shed_by_reason": dict(self.shed_by_reason),
+                    "draining": self.draining,
+                    "queued_blocks": self.queued_blocks(),
+                    "kv": self.cache.stats()}

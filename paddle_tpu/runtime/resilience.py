@@ -115,6 +115,15 @@ _EVENT_KINDS = (
     "paged_kernel_fallbacks",  # the ragged paged-attention kernel was
     #                           unavailable/failed and decode fell back
     #                           to the dense gather path
+    "serve_sheds",            # admission control refused (or a queued
+    #                           request out-waited max_queue_wait_s and
+    #                           was dropped by) the serving engine —
+    #                           the caller saw OverloadedError / an
+    #                           `overloaded` outcome, never silence
+    "journal_errors",         # a serving request-journal append or
+    #                           compaction failed; the record was
+    #                           dropped and serving continued (crash
+    #                           recovery degrades, the engine does not)
     "collective_divergence",  # two live ranks published collective-
     #                           schedule fingerprints that disagree at a
     #                           common sequence point — the SPMD

@@ -1688,6 +1688,11 @@ EVENT_KINDS = (
     #                       (reason + path)
     "statusz_start",      # the /statusz introspection server bound
     #                       its port
+    "serve_drain",        # inference/engine.py graceful drain began /
+    #                       ended (queued+running counts, shed count)
+    "serve_recover",      # a restarted engine re-admitted unfinished
+    #                       journaled requests (resumed/completed
+    #                       counts)
 )
 
 

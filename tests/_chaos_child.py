@@ -1,0 +1,196 @@
+"""Child process for the serving robustness acceptance
+(tools/serve_chaos_smoke.py).
+
+Modes (argv[1]):
+  overload — warm the engine, measure its sustainable service rate,
+             then offer 4x that rate through tools/loadgen.py with a
+             tiny admission queue: the engine must SHED (OverloadedError
+             + `overloaded` outcomes + serve_sheds faults), keep queue
+             depth bounded, keep admitted-request TTFT bounded, and
+             exit clean.
+  chaos    — degradation contracts under injected faults: a
+             serve.step delay must evict ONLY deadline-burdened
+             requests; serve.kv_alloc failures must starve (not crash)
+             the loop, and the engine must serve normally once the
+             injector lifts.
+  drain    — a serving loop with install_signal_drain(); prints READY,
+             keeps serving until the parent SIGTERMs it. Expected exit:
+             rc=-SIGTERM with a `sigterm_drain` postmortem bundle whose
+             extra carries the drain report.
+  baseline — fixed workload, uninterrupted; saves the shape manifest;
+             prints outputs (the token-exactness reference).
+  kill     — same workload + request journal; the parent's
+             PADDLE_TPU_FAULT_INJECT=serve.step=kill:N SIGKILLs the
+             process mid-decode (rc=-9; nothing printed).
+  recover  — warm-starts from the manifest, recovers the kill pass's
+             journal, finishes the workload; prints recovered/resumed
+             outputs + compile metrics (parent asserts token-exact vs
+             baseline with ZERO fresh compiles).
+
+Env (set by the parent): JAX_PLATFORMS=cpu,
+PADDLE_TPU_COMPILE_CACHE_DIR, PADDLE_TPU_COMPILE_CACHE_MIN_COMPILE_S=0,
+SERVE_MANIFEST, CHAOS_JOURNAL; drain mode also gets
+PADDLE_TPU_DIAGNOSTICS_DIR; kill mode PADDLE_TPU_FAULT_INJECT.
+"""
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import jax  # noqa: E402
+
+jax.config.update("jax_enable_x64", True)
+
+from paddle_tpu.core import dispatch  # noqa: E402
+from paddle_tpu.inference import (  # noqa: E402
+    OverloadedError,
+    ServeConfig,
+    ServingEngine,
+    TinyServeModel,
+)
+from paddle_tpu.runtime import telemetry, warmup  # noqa: E402
+from paddle_tpu.runtime.resilience import (  # noqa: E402
+    FaultInjector,
+    fault_events,
+)
+
+mode = sys.argv[1]
+
+PROMPTS = [[1, 2, 3, 4, 5], [7, 8], [3, 1, 4, 1, 5, 9], [11, 13],
+           [2, 4, 6], [9, 9, 1]]
+NEW_TOKENS = 6
+
+
+def _mk(max_queued=64, max_queue_wait_s=None, journal=None):
+    model = TinyServeModel(vocab=32, dim=8, layers=2, heads=2, ffn=16,
+                           seed=0)
+    cfg = ServeConfig(max_running=3, token_budget=8, block_size=4,
+                      num_blocks=16, max_blocks_per_seq=4,
+                      max_queued=max_queued,
+                      max_queue_wait_s=max_queue_wait_s)
+    return ServingEngine(model, cfg, journal=journal)
+
+
+def _outcomes():
+    fam = telemetry.snapshot().get("paddle_tpu_serve_requests_total") or {}
+    return {tuple(s["labels"].values())[0]: s["value"]
+            for s in fam.get("series", [])}
+
+
+def _emit(out):
+    print(json.dumps(out))
+
+
+if mode == "overload":
+    from tools.loadgen import run_load
+
+    dispatch.set_warmup_count(1)
+    eng = _mk(max_queued=8, max_queue_wait_s=2.0)
+    eng.generate(PROMPTS[:3], max_new_tokens=3)  # compile warmup
+    t0 = time.perf_counter()
+    eng.generate(PROMPTS, max_new_tokens=3)
+    sustainable_rps = len(PROMPTS) / (time.perf_counter() - t0)
+    rate = 4.0 * sustainable_rps
+    report = run_load(eng, rate_rps=rate, duration_s=2.0,
+                      prompt_lens=(2, 4), new_tokens=(2, 4), seed=1,
+                      hard_wall_s=90.0)
+    _emit({"report": report, "outcomes": _outcomes(),
+           "serve_sheds": fault_events().get("serve_sheds", 0),
+           "rate_rps": rate, "sustainable_rps": sustainable_rps,
+           "max_queued": 8})
+
+elif mode == "chaos":
+    dispatch.set_warmup_count(1)
+    # phase 1: a wedged-slow step must evict ONLY the deadline-burdened
+    # requests; patient ones finish
+    eng = _mk()
+    eng.generate(PROMPTS[:2], max_new_tokens=2)  # compile warmup
+    patient, impatient = [], []
+    with FaultInjector({"serve.step": ("delay", 0.05)}):
+        for i, p in enumerate(PROMPTS):
+            rid = eng.submit(p, max_new_tokens=3,
+                             deadline_s=0.02 if i % 2 else 30.0)
+            (impatient if i % 2 else patient).append(rid)
+        done1 = eng.run(max_steps=300)
+    stats1 = eng.scheduler.stats()
+    # phase 2: every KV allocation fails — the loop must starve
+    # WITHOUT crashing or spinning, then serve normally post-injector
+    eng2 = _mk(max_queued=4, max_queue_wait_s=None)
+    eng2.generate(PROMPTS[:1], max_new_tokens=2)
+    shed2 = 0
+    with FaultInjector({"serve.kv_alloc": ("raise", 0)}):
+        for p in PROMPTS:
+            try:
+                eng2.submit(p, max_new_tokens=3)
+            except OverloadedError:
+                shed2 += 1
+        t0 = time.perf_counter()
+        starved = eng2.run(max_steps=300)
+        starve_wall = time.perf_counter() - t0
+    done2 = eng2.run(max_steps=300)
+    post = eng2.generate([[5, 6, 7]], max_new_tokens=3)[0]
+    _emit({"phase1": {"completed": sorted(done1),
+                      "patient": patient, "impatient": impatient,
+                      "deadline_faults":
+                          fault_events().get("request_deadline", 0),
+                      "stats": stats1},
+           "phase2": {"starved_completed": len(starved),
+                      "starve_wall_s": starve_wall, "shed": shed2,
+                      "completed": len(done2),
+                      "stats": eng2.scheduler.stats()},
+           "post_recovery_tokens": post})
+
+elif mode == "drain":
+    dispatch.set_warmup_count(1)
+    eng = _mk(journal=os.environ.get("CHAOS_JOURNAL"))
+    eng.install_signal_drain(deadline_s=30.0)
+    eng.generate(PROMPTS[:2], max_new_tokens=2)  # compile warmup
+    print("READY", flush=True)
+    while True:  # a real server: keep work flowing until told to stop
+        if not eng.scheduler.has_work():
+            for p in PROMPTS:
+                try:
+                    eng.submit(p, max_new_tokens=8)
+                except OverloadedError:
+                    break
+        eng.run(max_steps=50)  # drains + exits in here on SIGTERM
+        time.sleep(0.005)
+
+elif mode == "baseline":
+    dispatch.set_warmup_count(1)
+    eng = _mk()
+    for p in PROMPTS:
+        eng.submit(p, max_new_tokens=NEW_TOKENS)
+    out = eng.run(max_steps=500)
+    warmup.save_manifest(os.environ["SERVE_MANIFEST"])
+    _emit({"outputs": out, "steps": eng.steps})
+
+elif mode == "kill":
+    # PADDLE_TPU_FAULT_INJECT=serve.step=kill:N (parent) SIGKILLs the
+    # process mid-decode; everything after run() is unreachable
+    dispatch.set_warmup_count(1)
+    eng = _mk(journal=os.environ["CHAOS_JOURNAL"])
+    for p in PROMPTS:
+        eng.submit(p, max_new_tokens=NEW_TOKENS)
+    out = eng.run(max_steps=500)
+    _emit({"outputs": out, "survived": True})
+
+elif mode == "recover":
+    pre = warmup.precompile(os.environ["SERVE_MANIFEST"])
+    dispatch.set_warmup_count(1)
+    eng = _mk(journal=os.environ["CHAOS_JOURNAL"])
+    rec = eng.recover()
+    post = eng.run(max_steps=500)
+    comp = dispatch.dispatch_stats()["compile"]
+    _emit({"recovered_completed": rec["completed"],
+           "resumed": rec["resumed"], "skipped": rec["skipped"],
+           "post_outputs": post, "precompile": pre,
+           "fresh_compiles": comp["fresh_compiles"],
+           "disk_cache_hits": comp["disk_cache_hits"]})
+
+else:
+    raise SystemExit(f"unknown mode {mode!r}")

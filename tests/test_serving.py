@@ -631,3 +631,283 @@ def test_serve_warm_start_round_trip(tmp_path):
     assert warm["fresh_compiles"] == 0
     assert warm["disk_cache_hits"] > 0
     assert warm["batched"] == cold["batched"]
+
+
+# ---------------------------------------------------------------------------
+# robustness: admission control / cancel / drain / journal (ISSUE 18)
+
+
+def _outcome_counts():
+    from paddle_tpu.runtime import telemetry
+
+    fam = telemetry.snapshot().get("paddle_tpu_serve_requests_total") or {}
+    return {tuple(s["labels"].values())[0]: s["value"]
+            for s in fam.get("series", [])}
+
+
+class TestAdmissionControl:
+    def test_queue_full_sheds_with_counter_and_fault(self):
+        from paddle_tpu.inference import OverloadedError
+
+        reset_fault_events()
+        before = _outcome_counts().get("overloaded", 0)
+        eng = _engine(max_running=1, max_queued=1)
+        eng.submit([1, 2], max_new_tokens=2)          # fills the queue
+        with pytest.raises(OverloadedError) as exc:
+            eng.submit([3, 4], max_new_tokens=2)
+        assert exc.value.reason == "queue_full"
+        assert _outcome_counts().get("overloaded", 0) == before + 1
+        assert fault_events().get("serve_sheds", 0) >= 1
+        # the shed request was never queued: memory cannot grow
+        assert eng.scheduler.stats()["queued"] == 1
+        assert eng.scheduler.shed_by_reason == {"queue_full": 1}
+        # accepted work is unharmed
+        out = eng.run(max_steps=50)
+        assert len(out) == 1
+
+    def test_token_backlog_sheds(self):
+        from paddle_tpu.inference import OverloadedError
+
+        eng = _engine(max_queued_tokens=4)
+        eng.submit([1, 2, 3], max_new_tokens=2)
+        with pytest.raises(OverloadedError) as exc:
+            eng.submit([4, 5, 6], max_new_tokens=2)   # 3+3 > 4
+        assert exc.value.reason == "token_backlog"
+
+    def test_kv_backlog_sheds_on_full_context_horizon(self):
+        from paddle_tpu.inference import OverloadedError
+
+        # block_size=4: a 5-token prompt + 4 new needs 3 blocks > bound
+        eng = _engine(max_queued_blocks=2)
+        with pytest.raises(OverloadedError) as exc:
+            eng.submit([1, 2, 3, 4, 5], max_new_tokens=4)
+        assert exc.value.reason == "kv_backlog"
+        eng.submit([1, 2], max_new_tokens=2)          # 2 blocks: fits
+        assert len(eng.run(max_steps=50)) == 1
+
+    def test_queue_wait_shed_at_plan_time(self):
+        reset_fault_events()
+        s = _sched(max_running=1, token_budget=4, max_queue_wait_s=0.5)
+        a = ServeRequest([1, 2, 3], max_new_tokens=8)
+        b = ServeRequest([4, 5], max_new_tokens=2)
+        s.submit(a)
+        s.plan()                                      # a occupies the slot
+        s.submit(b)
+        s.plan(now=b.t_submit + 0.1)                  # within the wait cap
+        assert b.state == RequestState.WAITING
+        s.plan(now=b.t_submit + 1.0)                  # past it: shed
+        assert b.state == RequestState.EVICTED
+        assert b.evict_reason == "queue_timeout"
+        assert s.shed_by_reason.get("queue_timeout") == 1
+        assert fault_events().get("serve_sheds", 0) >= 1
+
+    def test_queue_timeout_counts_as_overloaded_outcome(self):
+        before = _outcome_counts().get("overloaded", 0)
+        eng = _engine(max_running=1, max_queue_wait_s=0.0,
+                      max_queued=8)
+        eng.submit([1, 2, 3], max_new_tokens=6)       # takes the slot
+        eng.submit([4, 5], max_new_tokens=2)          # will wait > 0.0s
+        time.sleep(0.002)
+        eng.run(max_steps=60)
+        assert _outcome_counts().get("overloaded", 0) >= before + 1
+
+
+class TestCancel:
+    def test_cancel_queued_and_running_free_blocks_now(self):
+        before = _outcome_counts().get("cancelled", 0)
+        eng = _engine(max_running=1)
+        run_id = eng.submit([1, 2, 3], max_new_tokens=8)
+        q_id = eng.submit([4, 5], max_new_tokens=8)
+        eng.step()
+        assert eng.cache.blocks_in_use() > 0
+        assert eng.cancel(run_id)                     # running
+        assert eng.cache.blocks_in_use() == 0         # freed immediately
+        assert eng.cancel(q_id)                       # still queued
+        assert not eng.cancel("nope")                 # unknown id
+        assert not eng.cancel(run_id)                 # already gone
+        assert not eng.scheduler.has_work()
+        assert _outcome_counts().get("cancelled", 0) == before + 2
+        # cancellation is caller intent, not degradation: no shed count
+        assert eng.scheduler.shed_total == 0
+
+    def test_cancelled_request_not_in_results(self):
+        eng = _engine()
+        keep = eng.submit([1, 2], max_new_tokens=2)
+        drop = eng.submit([3, 4], max_new_tokens=2)
+        eng.cancel(drop)
+        out = eng.run(max_steps=50)
+        assert keep in out and drop not in out
+
+
+class TestDrain:
+    def test_drain_finishes_inflight_then_refuses_admission(self):
+        from paddle_tpu.inference import OverloadedError
+
+        eng = _engine()
+        ids = [eng.submit(p, max_new_tokens=3) for p in PROMPTS]
+        report = eng.drain(deadline_s=60.0)
+        assert report["shed"] == 0
+        assert sorted(report["results"]) == sorted(ids)
+        assert all(len(t) == 3 for t in report["results"].values())
+        with pytest.raises(OverloadedError) as exc:
+            eng.submit([9, 9], max_new_tokens=1)
+        assert exc.value.reason == "draining"
+        assert eng.diagnostics_snapshot()["drain"]["state"] == "drained"
+
+    def test_drain_deadline_sheds_stragglers(self):
+        eng = _engine()
+        eng.submit([1, 2, 3], max_new_tokens=50)
+        with FaultInjector({"serve.step": ("delay", 0.05)}):
+            report = eng.drain(deadline_s=0.12)
+        assert report["shed"] >= 1
+        assert not eng.scheduler.has_work()
+        assert eng.cache.blocks_in_use() == 0
+        ev = {r.request_id: r.evict_reason
+              for r in eng.scheduler.evicted}
+        assert "drain_deadline" in ev.values()
+
+
+class TestJournal:
+    def test_round_trip_completed_and_unfinished(self, tmp_path):
+        from paddle_tpu.inference import RequestJournal, read_journal
+
+        path = tmp_path / "j.jsonl"
+        eng = _engine()
+        eng.journal = RequestJournal(str(path))
+        done_id = eng.submit([1, 2, 3], max_new_tokens=3)
+        out = eng.run(max_steps=50)
+        # leave one request mid-flight: submit + a single step only
+        live_id = eng.submit([4, 5, 6], max_new_tokens=8)
+        eng.step()
+        state = read_journal(str(path))
+        assert state["completed"] == {done_id: out[done_id]}
+        assert state["outcomes"][done_id] == "completed"
+        unfinished = {s["id"]: s for s in state["unfinished"]}
+        assert set(unfinished) == {live_id}
+        spec = unfinished[live_id]
+        assert spec["prompt"] == [4, 5, 6]
+        assert spec["max_new_tokens"] == 8
+        assert len(spec["gen"]) >= 1                  # the stepped token
+
+    def test_compaction_drops_finished_keeps_live_with_gen(self,
+                                                           tmp_path):
+        from paddle_tpu.inference import RequestJournal, read_journal
+
+        path = tmp_path / "j.jsonl"
+        j = RequestJournal(str(path), max_bytes=400)
+        fin = ServeRequest([1, 2], max_new_tokens=2, request_id="fin")
+        live = ServeRequest([3, 4], max_new_tokens=9, request_id="live")
+        j.record_submit(fin)
+        j.record_submit(live)
+        j.record_finish("fin", "completed", tokens=[7, 8])
+        for t in range(40):                           # overflow max_bytes
+            j.record_step([("live", t)])
+        assert j.stats()["compactions"] >= 1
+        state = read_journal(str(path))
+        unfinished = {s["id"]: s for s in state["unfinished"]}
+        assert set(unfinished) == {"live"}
+        assert unfinished["live"]["gen"] == list(range(40))
+        # finished history was dropped by the rewrite, but the pre-
+        # compaction fin is irrelevant to recovery: live set is right
+        j.close()
+
+    def test_write_failure_degrades_never_raises(self, tmp_path):
+        from paddle_tpu.inference import RequestJournal
+
+        reset_fault_events()
+        eng = _engine()
+        eng.journal = RequestJournal(str(tmp_path / "j.jsonl"))
+        with FaultInjector({"serve.journal_write": ("raise", 0)}):
+            eng.submit([1, 2, 3], max_new_tokens=3)
+            out = eng.run(max_steps=50)               # must not raise
+        assert len(out) == 1                          # serving unharmed
+        assert eng.journal.errors > 0
+        assert fault_events().get("journal_errors", 0) >= 1
+
+    def test_torn_tail_and_garbage_lines_skipped(self, tmp_path):
+        from paddle_tpu.inference import read_journal
+
+        path = tmp_path / "j.jsonl"
+        path.write_text(
+            '{"k":"sub","id":"a","prompt":[1],"max_new_tokens":2,'
+            '"eos_id":null,"deadline_s":null}\n'
+            'not json at all\n'
+            '{"k":"tok","toks":[["a",5]]}\n'
+            '{"k":"sub","id":"b","pro')                # torn by SIGKILL
+        state = read_journal(str(path))
+        assert [s["id"] for s in state["unfinished"]] == ["a"]
+        assert state["unfinished"][0]["gen"] == [5]
+
+    def test_recover_reads_env_journal_and_resumes_token_exact(
+            self, tmp_path):
+        from paddle_tpu.inference import RequestJournal
+
+        path = str(tmp_path / "j.jsonl")
+        want = _engine().generate([PROMPTS[0]], max_new_tokens=5)[0]
+        # simulate the crashed life: journal a submit + 2 emitted tokens
+        crashed = ServeRequest(PROMPTS[0], max_new_tokens=5,
+                               request_id="r0")
+        j = RequestJournal(path)
+        j.record_submit(crashed)
+        j.record_step([("r0", want[0])])
+        j.record_step([("r0", want[1])])
+        j.close()
+        eng = _engine(journal_max_bytes=4 << 20)
+        eng.journal = RequestJournal(path)
+        rec = eng.recover()
+        assert rec["resumed"] == ["r0"]
+        out = eng.run(max_steps=60)
+        assert out["r0"] == want                      # token-exact
+
+
+def test_deadline_eviction_races_concurrent_submit():
+    """A second thread submits continuously while the decode thread
+    evicts deadline-expired requests under an injected per-step delay:
+    no exception may leak from either side, and the block pool must be
+    fully conserved afterwards (the CL001/CL007 sites the scheduler
+    lock closes)."""
+    import threading
+
+    eng = _engine(max_running=2, max_queued=4)
+    errors = []
+
+    def _submitter():
+        from paddle_tpu.inference import OverloadedError
+
+        for i in range(60):
+            try:
+                eng.submit([1 + i % 7, 2], max_new_tokens=2,
+                           deadline_s=0.01 if i % 3 else 5.0)
+            except OverloadedError:
+                pass
+            except Exception as e:  # noqa: BLE001 — the assertion
+                errors.append(e)
+            time.sleep(0.001)
+
+    t = threading.Thread(target=_submitter)
+    t.start()
+    with FaultInjector({"serve.step": ("delay", 0.005)}):
+        while t.is_alive():
+            eng.run(max_steps=5)
+    t.join()
+    eng.run(max_steps=400)
+    assert not errors
+    assert not eng.scheduler.has_work()
+    assert eng.cache.blocks_in_use() == 0
+    assert eng.cache.blocks_free() == eng.cache.config.num_blocks
+
+
+def test_run_returns_promptly_when_nothing_runnable():
+    """Queued work that cannot be planned (every KV allocation failing)
+    must make run() yield promptly — not spin to max_steps."""
+    eng = _engine()
+    eng.submit([1, 2, 3], max_new_tokens=3)
+    with FaultInjector({"serve.kv_alloc": ("raise", 0)}):
+        t0 = time.perf_counter()
+        out = eng.run(max_steps=10_000)
+        dt = time.perf_counter() - t0
+    assert out == {}
+    assert dt < 5.0                                   # yielded, no spin
+    assert eng.scheduler.has_work()                   # work survives
+    out = eng.run(max_steps=60)                       # injector lifted
+    assert len(out) == 1

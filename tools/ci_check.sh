@@ -67,6 +67,18 @@ echo "== serve smoke (continuous batching + warm restart + reconciliation) =="
 # perform ZERO fresh XLA compiles
 JAX_PLATFORMS=cpu python tools/serve_smoke.py
 
+echo "== serve chaos smoke (overload shed + fault chaos + drain + crash recovery) =="
+# four fresh-subprocess stages prove the ISSUE-18 robustness
+# acceptance: 4x-sustainable open-loop traffic must SHED (overloaded
+# outcomes + serve_sheds faults) with bounded queue depth and TTFT and
+# no wedge; injected serve.step delay / serve.kv_alloc failures must
+# degrade per contract (deadline evictions only / prompt starvation
+# then full recovery); SIGTERM must drain gracefully (rc=-15 + a
+# sigterm_drain postmortem bundle carrying the drain report); and a
+# SIGKILL mid-decode must journal-recover TOKEN-EXACT vs an
+# uninterrupted run with ZERO fresh XLA compiles
+JAX_PLATFORMS=cpu python tools/serve_chaos_smoke.py
+
 echo "== multihost smoke (coordination store + quorum + merge) =="
 # 2-process CPU cluster over a tmpdir store: heartbeat + rendezvous
 # round trip, host-0 merged prom/fault-log carrying both rank labels,

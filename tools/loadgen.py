@@ -1,0 +1,341 @@
+#!/usr/bin/env python
+"""Open-loop chaos traffic harness for the serving engine (ISSUE 18).
+
+Drives a `ServingEngine` with OPEN-LOOP arrivals — a Poisson process
+(exponential inter-arrivals) plus optional back-to-back bursts — from a
+second thread, exactly the regime the scheduler lock contract exists
+for. Arrivals do not wait for completions, so an under-provisioned
+engine sees unbounded offered load and must SHED (OverloadedError /
+``overloaded`` outcome), never wedge: the driver enforces a hard wall
+and reports ``wedged`` if the loop fails that contract.
+
+Mixed prompt lengths, token budgets, and per-request deadlines come
+from a seeded RNG (deterministic per seed). The report carries the SLO
+surface: TTFT p50/p99 and request-latency p50/p99 over ADMITTED
+requests, shed rate, goodput tokens/sec, and the max queue depth the
+driver (and, optionally, a live ``/statusz`` scraper) observed.
+`check_slo()` turns thresholds into violations; the CLI exits 1 on any.
+
+Chaos mode rides the existing FaultInjector sites:
+
+    --chaos delay   ``serve.step`` delay — slow steps; deadlines evict
+    --chaos kv      ``serve.kv_alloc`` raise — KV exhaustion degradation
+
+CLI::
+
+    python tools/loadgen.py --rate 50 --duration 3 --max-queued 16 \\
+        --slo-ttft-p99 2.0 --slo-max-shed-rate 0.9
+
+tools/serve_chaos_smoke.py wires this into CI; bench.py's serve_decode
+payload reports a short run's SLO keys.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import sys
+import threading
+import time
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+__all__ = ["build_arrivals", "run_load", "check_slo", "percentile"]
+
+
+def percentile(values, q):
+    """Nearest-rank percentile (q in [0, 100]); None for no samples."""
+    if not values:
+        return None
+    xs = sorted(values)
+    idx = min(len(xs) - 1, max(0, int(round(q / 100.0 * (len(xs) - 1)))))
+    return xs[idx]
+
+
+def build_arrivals(rate_rps, duration_s, rng, burst_every_s=None,
+                   burst_size=0):
+    """Open-loop arrival offsets (seconds from start): a Poisson
+    process at `rate_rps` over `duration_s`, plus `burst_size`
+    back-to-back arrivals every `burst_every_s` (the burst row of the
+    failure matrix)."""
+    out, t = [], 0.0
+    while True:
+        t += rng.expovariate(rate_rps)
+        if t >= duration_s:
+            break
+        out.append(t)
+    if burst_every_s and burst_size:
+        b = burst_every_s
+        while b < duration_s:
+            out.extend([b] * int(burst_size))
+            b += burst_every_s
+    out.sort()
+    return out
+
+
+def _pick(rng, value):
+    """A scalar stays itself; a sequence is sampled per request."""
+    if isinstance(value, (list, tuple)):
+        return rng.choice(list(value)) if value else None
+    return value
+
+
+def _scraper(stop, samples, interval_s=0.2):
+    """Poll the live /statusz /serving route (third thread — the
+    external observer's view of queue depth while the engine is under
+    fire)."""
+    from paddle_tpu.runtime import diagnostics as _diagnostics
+
+    addr = _diagnostics.statusz_address()
+    if addr is None:
+        return
+    url = f"http://{addr[0]}:{addr[1]}/serving"
+    while not stop.wait(interval_s):
+        try:
+            with urllib.request.urlopen(url, timeout=2) as resp:
+                doc = json.loads(resp.read().decode("utf-8"))
+            for eng in doc.get("engines") or []:
+                q = (eng.get("queue") or {}).get("depth")
+                if q is not None:
+                    samples.append(int(q))
+        except Exception:  # noqa: BLE001 — a scrape miss is data, not a crash
+            continue
+
+
+def run_load(engine, *, rate_rps, duration_s, prompt_lens=(2, 4, 8),
+             new_tokens=(2, 4, 8), deadline_s=None, burst_every_s=None,
+             burst_size=0, seed=0, vocab=None, scrape_statusz=False,
+             hard_wall_s=None):
+    """Drive `engine` with open-loop traffic; returns the report dict.
+
+    The submitter runs on a SECOND thread (racing the decode thread's
+    plan/evict paths through the scheduler lock); the calling thread
+    drives `engine.step()` until the schedule is exhausted and accepted
+    work finishes — or the hard wall trips (``wedged: True``)."""
+    from paddle_tpu.inference import OverloadedError
+
+    rng = random.Random(seed)
+    vocab = vocab or getattr(engine.model, "vocab", 32)
+    specs = [(t,
+              [rng.randrange(1, vocab)
+               for _ in range(_pick(rng, prompt_lens))],
+              _pick(rng, new_tokens),
+              _pick(rng, deadline_s))
+             for t in build_arrivals(rate_rps, duration_s, rng,
+                                     burst_every_s=burst_every_s,
+                                     burst_size=burst_size)]
+    ids = set()
+    state = {"shed": 0, "done": False, "errors": 0}
+    lock = threading.Lock()
+
+    def submitter():
+        t0 = time.perf_counter()
+        for t_arr, prompt, n_new, ddl in specs:
+            dt = t0 + t_arr - time.perf_counter()
+            if dt > 0:
+                time.sleep(dt)
+            try:
+                rid = engine.submit(prompt, max_new_tokens=n_new,
+                                    deadline_s=ddl)
+                with lock:
+                    ids.add(rid)
+            except OverloadedError:
+                with lock:
+                    state["shed"] += 1
+            except Exception:  # noqa: BLE001 — keep offering load; the
+                # report surfaces the count
+                with lock:
+                    state["errors"] += 1
+        state["done"] = True
+
+    th = threading.Thread(target=submitter, name="loadgen-submit",
+                          daemon=True)
+    stop_scrape = threading.Event()
+    scraped = []
+    scraper = None
+    if scrape_statusz:
+        scraper = threading.Thread(target=_scraper,
+                                   args=(stop_scrape, scraped),
+                                   name="loadgen-scrape", daemon=True)
+        scraper.start()
+    hard = (hard_wall_s if hard_wall_s is not None
+            else duration_s * 5.0 + 30.0)
+    steps0 = engine.steps
+    max_depth = 0
+    wedged = False
+    t_start = time.perf_counter()
+    th.start()
+    while not state["done"] or engine.scheduler.has_work():
+        if time.perf_counter() - t_start > hard:
+            wedged = True
+            break
+        if not engine.step():
+            time.sleep(0.001)  # waiting on arrivals, not spinning
+        max_depth = max(max_depth, len(engine.scheduler.queue))
+    th.join(timeout=10.0)
+    stop_scrape.set()
+    if scraper is not None:
+        scraper.join(timeout=5.0)
+    wall = time.perf_counter() - t_start
+
+    fin = [r for r in list(engine.scheduler.finished)
+           if r.request_id in ids]
+    ev = [r for r in list(engine.scheduler.evicted)
+          if r.request_id in ids]
+    ttfts = [r.t_first_token - r.t_submit for r in fin
+             if r.t_first_token is not None]
+    lats = [r.t_done - r.t_submit for r in fin if r.t_done is not None]
+    submitted = len(ids) + state["shed"]
+    goodput_tokens = sum(len(r.generated) for r in fin)
+    return {
+        "offered": len(specs),
+        "submitted": submitted,
+        "admitted": len(ids),
+        "shed": state["shed"],
+        "shed_rate": state["shed"] / submitted if submitted else 0.0,
+        "completed": len(fin),
+        "evicted": len(ev),
+        "evicted_by_reason": _count_by(ev),
+        "submit_errors": state["errors"],
+        "ttft_p50_s": percentile(ttfts, 50),
+        "ttft_p99_s": percentile(ttfts, 99),
+        "latency_p50_s": percentile(lats, 50),
+        "latency_p99_s": percentile(lats, 99),
+        "goodput_tokens": goodput_tokens,
+        "goodput_tokens_per_sec": goodput_tokens / wall if wall else 0.0,
+        "max_queue_depth": max_depth,
+        "statusz_samples": len(scraped),
+        "statusz_max_queue_depth": max(scraped) if scraped else None,
+        "steps": engine.steps - steps0,
+        "wall_s": wall,
+        "wedged": wedged,
+    }
+
+
+def _count_by(reqs):
+    out = {}
+    for r in reqs:
+        out[r.evict_reason] = out.get(r.evict_reason, 0) + 1
+    return out
+
+
+def check_slo(report, ttft_p99_s=None, min_goodput_tps=None,
+              max_shed_rate=None, max_queue_depth=None,
+              min_completed=None):
+    """Gate a run's report against SLO thresholds; returns the list of
+    violation strings (empty = all gates pass). A wedged run violates
+    unconditionally."""
+    v = []
+    if report.get("wedged"):
+        v.append("wedged: hard wall tripped before the queue drained")
+    if ttft_p99_s is not None:
+        got = report.get("ttft_p99_s")
+        if got is None:
+            v.append("ttft_p99: no admitted request produced a token")
+        elif got > ttft_p99_s:
+            v.append(f"ttft_p99 {got:.3f}s > {ttft_p99_s:.3f}s")
+    if (min_goodput_tps is not None
+            and report.get("goodput_tokens_per_sec", 0.0) < min_goodput_tps):
+        v.append(f"goodput {report['goodput_tokens_per_sec']:.1f} tok/s"
+                 f" < {min_goodput_tps:.1f}")
+    if (max_shed_rate is not None
+            and report.get("shed_rate", 0.0) > max_shed_rate):
+        v.append(f"shed_rate {report['shed_rate']:.3f}"
+                 f" > {max_shed_rate:.3f}")
+    if (max_queue_depth is not None
+            and report.get("max_queue_depth", 0) > max_queue_depth):
+        v.append(f"max_queue_depth {report['max_queue_depth']}"
+                 f" > {max_queue_depth}")
+    if (min_completed is not None
+            and report.get("completed", 0) < min_completed):
+        v.append(f"completed {report['completed']} < {min_completed}")
+    return v
+
+
+def _build_engine(args):
+    from paddle_tpu.inference import ServeConfig, ServingEngine, TinyServeModel
+
+    model = TinyServeModel(seed=args.seed)
+    cfg = ServeConfig(max_running=args.max_running,
+                      token_budget=args.token_budget,
+                      num_blocks=args.num_blocks,
+                      block_size=args.block_size,
+                      max_queued=args.max_queued,
+                      max_queue_wait_s=args.max_queue_wait)
+    return ServingEngine(model, cfg, journal=args.journal)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--rate", type=float, default=20.0,
+                   help="offered arrival rate, requests/sec")
+    p.add_argument("--duration", type=float, default=2.0)
+    p.add_argument("--burst-every", type=float, default=None)
+    p.add_argument("--burst-size", type=int, default=0)
+    p.add_argument("--prompt-lens", type=int, nargs="+",
+                   default=[2, 4, 8])
+    p.add_argument("--new-tokens", type=int, nargs="+", default=[2, 4, 8])
+    p.add_argument("--deadline", type=float, nargs="*", default=None,
+                   help="per-request deadline(s), sampled when several")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--max-running", type=int, default=4)
+    p.add_argument("--token-budget", type=int, default=16)
+    p.add_argument("--num-blocks", type=int, default=64)
+    p.add_argument("--block-size", type=int, default=16)
+    p.add_argument("--max-queued", type=int, default=64)
+    p.add_argument("--max-queue-wait", type=float, default=None)
+    p.add_argument("--journal", default=None)
+    p.add_argument("--statusz", action="store_true",
+                   help="start /statusz and scrape /serving live")
+    p.add_argument("--chaos", choices=["none", "delay", "kv"],
+                   default="none")
+    p.add_argument("--chaos-arg", type=float, default=None)
+    p.add_argument("--slo-ttft-p99", type=float, default=None)
+    p.add_argument("--slo-min-goodput", type=float, default=None)
+    p.add_argument("--slo-max-shed-rate", type=float, default=None)
+    p.add_argument("--slo-max-queue-depth", type=int, default=None)
+    p.add_argument("--slo-min-completed", type=int, default=None)
+    args = p.parse_args(argv)
+
+    from paddle_tpu.runtime import diagnostics as _diagnostics
+    from paddle_tpu.runtime.resilience import FaultInjector
+
+    engine = _build_engine(args)
+    if args.statusz:
+        _diagnostics.start_statusz()
+    specs = {}
+    if args.chaos == "delay":
+        specs["serve.step"] = ("delay", args.chaos_arg or 0.05)
+    elif args.chaos == "kv":
+        # count=0 -> raise on EVERY allocation attempt
+        specs["serve.kv_alloc"] = ("raise", int(args.chaos_arg or 0))
+    kwargs = dict(rate_rps=args.rate, duration_s=args.duration,
+                  prompt_lens=args.prompt_lens,
+                  new_tokens=args.new_tokens,
+                  deadline_s=args.deadline, burst_every_s=args.burst_every,
+                  burst_size=args.burst_size, seed=args.seed,
+                  scrape_statusz=args.statusz)
+    if specs:
+        with FaultInjector(specs):
+            report = run_load(engine, **kwargs)
+    else:
+        report = run_load(engine, **kwargs)
+    violations = check_slo(
+        report, ttft_p99_s=args.slo_ttft_p99,
+        min_goodput_tps=args.slo_min_goodput,
+        max_shed_rate=args.slo_max_shed_rate,
+        max_queue_depth=args.slo_max_queue_depth,
+        min_completed=args.slo_min_completed)
+    report["slo_violations"] = violations
+    print(json.dumps(report, indent=1, sort_keys=True))
+    if report.get("wedged"):
+        return 2
+    return 1 if violations else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

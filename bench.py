@@ -1078,6 +1078,16 @@ def bench_serve_decode(requests=8, prompt=8, new_tokens=16, max_running=4,
         res["serve_decode_shed_rate"] = lg["shed_rate"]
         res["serve_decode_goodput_tokens_per_sec"] = (
             lg["goodput_tokens_per_sec"])
+        # windowed quantiles (ISSUE 20): the rolling last-1m view the
+        # engine's /statusz gauges publish, vs the lifetime aggregates
+        # above — on a short bench pass they track each other, but the
+        # key names match the SLO surface operators actually watch
+        res["serve_decode_ttft_p99_ms_1m"] = (
+            lg["ttft_p99_s_1m"] * 1e3
+            if lg.get("ttft_p99_s_1m") is not None else None)
+        res["serve_decode_goodput_tokens_per_sec_1m"] = (
+            lg.get("goodput_tokens_per_sec_1m"))
+        res["serve_decode_shed_rate_1m"] = lg.get("shed_rate_1m")
 
         def _phase_pass():
             e = mk()

@@ -14,6 +14,7 @@ Two tiers live here:
 """
 from __future__ import annotations
 
+from .access_log import AccessLog, read_access_log, tail_sampled  # noqa: F401
 from .engine import ServeConfig, ServingEngine  # noqa: F401
 from .journal import RequestJournal, read_journal  # noqa: F401
 from .kv_cache import KVCacheConfig, PagedKVCache  # noqa: F401
@@ -47,4 +48,5 @@ __all__ = ["Config", "Predictor", "Tensor", "create_predictor",
            "ServingEngine", "ServeConfig", "PagedKVCache", "KVCacheConfig",
            "ContinuousBatchingScheduler", "ServeRequest", "RequestState",
            "StepPlan", "TinyServeModel", "OverloadedError",
-           "RequestJournal", "read_journal"]
+           "RequestJournal", "read_journal",
+           "AccessLog", "read_access_log", "tail_sampled"]

@@ -1,0 +1,238 @@
+"""Per-request access log for the serving engine (ISSUE 20).
+
+Every request that LEAVES the engine — completed, evicted, cancelled,
+or shed by admission control — produces exactly one structured access
+record: its phase timeline (queue wait, prefill chunks, preemptions,
+first token, per-token decode aggregates), token accounting, and
+outcome. Three consumers share the record:
+
+* **durable JSONL file** (when a path is configured): the journal's
+  durability contract — one buffered line write + flush per record
+  under a private lock, bounded rotation (``access.jsonl`` →
+  ``access.jsonl.1`` → ...), a torn final line tolerated on read, and
+  a write failure that NEVER raises into the decode loop (dropped +
+  ``access_log_errors`` fault + serving continues). The file doubles
+  as the replay format for ``tools/loadgen.py --replay``.
+* **bounded in-memory ring**: the `/requestz` statusz route's "recent
+  requests" table, available with or without a file.
+* **process-wide aggregates**: outcome counts and latency/TTFT sums
+  built from the SAME measured values the engine feeds into
+  ``paddle_tpu_serve_requests_total`` / ``_request_seconds`` /
+  ``_ttft_seconds`` — `tracing.reconcile_with_metrics()` checks the
+  two surfaces agree EXACTLY (the repo's standing same-measurement
+  invariant, extended from spans to access records).
+
+Tail-based trace sampling lives here as one pure, deterministic
+predicate: `tail_sampled(outcome, latency_s, slow_s)`. Requests on the
+unhappy path (any non-``completed`` outcome) or over the latency
+threshold keep full nested ``serve/request/*`` span detail and a
+``serve_access`` event in the structured stream; happy-path requests
+emit only the summary record, so trace volume stays bounded under
+heavy traffic while every slow/shed/evicted request stays explainable.
+"""
+from __future__ import annotations
+
+import collections
+import json
+import os
+import threading
+
+from ..runtime import telemetry as _telemetry
+from ..runtime import tracing as _tracing
+from ..runtime.resilience import fault_point, record_fault
+from .journal import iter_jsonl
+
+__all__ = ["AccessLog", "read_access_log", "tail_sampled",
+           "aggregates", "reset_aggregates"]
+
+
+def tail_sampled(outcome, latency_s, slow_s):
+    """The tail-sampling decision — pure and deterministic: the same
+    (outcome, latency, threshold) always samples the same way, so a
+    record's ``sampled`` flag fully explains why its trace detail
+    exists (or doesn't). Unhappy-path outcomes always sample; completed
+    requests sample only past the slow threshold (None disables the
+    slow path, sampling errors/sheds only)."""
+    if outcome != "completed":
+        return True
+    if slow_s is None or latency_s is None:
+        return False
+    return float(latency_s) >= float(slow_s)
+
+
+class _Aggregates:
+    """Process-wide access-record aggregates, mirrored 1:1 against the
+    outcome counter and latency/TTFT histograms for exact
+    reconciliation. `latency_s`/`ttft_s` must be the value the caller
+    fed the matching histogram, or None when that exit path does not
+    observe the histogram (a submit-time shed increments the outcome
+    counter but never entered `paddle_tpu_serve_request_seconds`)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.outcomes = {}
+        self.latency_sum = 0.0
+        self.latency_count = 0
+        self.ttft_sum = 0.0
+        self.ttft_count = 0
+
+    def add(self, outcome, latency_s=None, ttft_s=None):
+        with self._lock:
+            self.outcomes[outcome] = self.outcomes.get(outcome, 0) + 1
+            if latency_s is not None:
+                self.latency_sum += float(latency_s)
+                self.latency_count += 1
+            if ttft_s is not None:
+                self.ttft_sum += float(ttft_s)
+                self.ttft_count += 1
+
+    def snapshot(self):
+        with self._lock:
+            return {"outcomes": dict(self.outcomes),
+                    "latency_sum": self.latency_sum,
+                    "latency_count": self.latency_count,
+                    "ttft_sum": self.ttft_sum,
+                    "ttft_count": self.ttft_count}
+
+    def reset(self):
+        with self._lock:
+            self.outcomes = {}
+            self.latency_sum = 0.0
+            self.latency_count = 0
+            self.ttft_sum = 0.0
+            self.ttft_count = 0
+
+
+_AGG = _Aggregates()
+
+
+def aggregates():
+    """The process-wide access aggregates (reconciliation probe)."""
+    return _AGG.snapshot()
+
+
+def reset_aggregates():
+    _AGG.reset()
+
+
+# reconciliation wiring: tracing compares these aggregates against the
+# registry counters without importing the inference package (layering:
+# inference -> runtime only). reset_metrics() clears both sides, so the
+# exactness invariant survives test isolation.
+_tracing.set_serve_access_probe(aggregates)
+_telemetry.on_reset(reset_aggregates)
+
+
+class AccessLog:
+    """One engine's access-record sink: aggregates + ring always; a
+    durable JSONL file when `path` is configured."""
+
+    def __init__(self, path=None, max_bytes=4 << 20, max_files=3,
+                 ring=256):
+        self.path = os.path.abspath(str(path)) if path else None
+        self.max_bytes = int(max_bytes)
+        self.max_files = max(1, int(max_files))
+        self.ring = collections.deque(maxlen=int(ring))
+        self._lock = threading.Lock()
+        self._fh = None
+        self.records = 0
+        self.rotations = 0
+        self.errors = 0
+        if self.path:
+            parent = os.path.dirname(self.path)
+            if parent:
+                os.makedirs(parent, exist_ok=True)
+            try:
+                self._fh = open(self.path, "a", encoding="utf-8")
+            except OSError as e:
+                self._note_error(e)
+
+    def record(self, rec, latency_s=None, ttft_s=None):
+        """Ingest one exit record. `latency_s`/`ttft_s` are the exact
+        values the engine fed the matching histograms (None = that
+        histogram was not observed on this exit path); the record dict
+        itself is what lands in the ring and the file."""
+        _AGG.add(rec.get("outcome", "unknown"),
+                 latency_s=latency_s, ttft_s=ttft_s)
+        self.ring.append(rec)  # deque append: GIL-atomic, bounded
+        self._append(rec)
+
+    def _append(self, rec):
+        if self._fh is None:
+            return
+        try:
+            # chaos hook — BEFORE the lock (the journal idiom): an
+            # injected delay stalls only this producer, an injected
+            # raise exercises drop-and-degrade
+            fault_point("serve.access_write",
+                        outcome=rec.get("outcome"))
+            line = json.dumps(rec, separators=(",", ":"),
+                              default=str) + "\n"
+            with self._lock:
+                self._fh.write(line)  # threadlint: ok[CL003] the journal's durability idiom: one buffered line write + flush per record under the private lock; producers are the decode thread + submitters only
+                self._fh.flush()  # threadlint: ok[CL003] see above — per-record flush bounds SIGKILL loss to one torn line
+                self.records += 1
+                if self.max_bytes and self._fh.tell() >= self.max_bytes:
+                    self._rotate()
+        except Exception as e:  # noqa: BLE001 — observability must
+            # never kill the serving loop it observes
+            self._note_error(e)
+
+    def _rotate(self):
+        """EventStream-style generation shift (caller holds the lock):
+        readers see whole generations or nothing, never half a file."""
+        self._fh.close()
+        if self.max_files == 1:
+            self._fh = open(self.path, "w", encoding="utf-8")  # threadlint: ok[CL003] single-file bound: truncation under the writer lock IS the rotation contract; read_access_log tolerates it
+            self.rotations += 1
+            return
+        for i in range(self.max_files - 1, 0, -1):
+            src = self.path if i == 1 else f"{self.path}.{i - 1}"
+            try:
+                os.replace(src, f"{self.path}.{i}")
+            except OSError:
+                pass
+        self._fh = open(self.path, "a", encoding="utf-8")  # threadlint: ok[CL003] rotation must swap the file atomically w.r.t. writers — the append caller holds the lock by design
+        self.rotations += 1
+
+    def _note_error(self, err):
+        self.errors += 1
+        record_fault("access_log_errors", f"{type(err).__name__}: {err}")
+
+    def recent(self, n=50):
+        """Newest-last slice of the in-memory ring."""
+        return list(self.ring)[-int(n):]
+
+    def stats(self):
+        return {"path": self.path, "records": self.records,
+                "ring": len(self.ring), "rotations": self.rotations,
+                "errors": self.errors,
+                "ok": self._fh is not None or self.path is None}
+
+    def close(self):
+        with self._lock:
+            if self._fh is not None:
+                try:
+                    self._fh.flush()  # threadlint: ok[CL003] shutdown path; no producer left to stall
+                    self._fh.close()
+                except OSError:
+                    pass
+                self._fh = None
+
+
+def read_access_log(path, include_rotated=True):
+    """Parse access records back, oldest first, rotated generations
+    included. Tolerates a torn final line (the SIGKILL contract) and
+    skips any line that fails to parse."""
+    paths = []
+    if include_rotated:
+        i = 1
+        while os.path.exists(f"{path}.{i}"):
+            paths.append(f"{path}.{i}")
+            i += 1
+        paths.reverse()
+    paths.append(path)
+    out = []
+    for p in paths:
+        out.extend(iter_jsonl(p))
+    return out

@@ -60,6 +60,8 @@ from ..runtime import diagnostics as _diagnostics
 from ..runtime import telemetry as _telemetry
 from ..runtime import tracing as _tracing
 from ..runtime.resilience import fault_point
+from ..runtime.windows import ServingWindows, SLOMonitor
+from .access_log import AccessLog, tail_sampled
 from .journal import RequestJournal, read_journal
 from .kv_cache import PagedKVCache
 from .scheduler import (ContinuousBatchingScheduler, OverloadedError,
@@ -69,6 +71,17 @@ __all__ = ["ServeConfig", "ServingEngine", "OverloadedError"]
 
 _LATENCY_BUCKETS = (0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
                     1.0, 2.5, 5.0, 10.0, 30.0, 60.0)
+# inter-token decode gaps live well below request latencies
+_TPOT_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+                 0.25, 0.5, 1.0, 2.5)
+
+
+def _env_float(name, default):
+    try:
+        raw = os.environ.get(name)
+        return default if raw is None else float(raw)
+    except ValueError:
+        return default
 
 
 class ServeConfig:
@@ -81,7 +94,10 @@ class ServeConfig:
                  default_deadline_s=None, max_steps=10000,
                  max_queued=256, max_queued_tokens=None,
                  max_queued_blocks=None, max_queue_wait_s=None,
-                 drain_deadline_s=30.0, journal_max_bytes=4 << 20):
+                 drain_deadline_s=30.0, journal_max_bytes=4 << 20,
+                 access_log=None, access_log_max_bytes=4 << 20,
+                 trace_slow_s=None, slo_ttft_s=None,
+                 slo_objective=0.99):
         self.max_running = int(max_running)
         self.token_budget = int(token_budget)
         self.block_size = int(block_size)
@@ -97,6 +113,15 @@ class ServeConfig:
         self.max_queue_wait_s = max_queue_wait_s
         self.drain_deadline_s = float(drain_deadline_s)
         self.journal_max_bytes = int(journal_max_bytes)
+        # request-scoped observability (ISSUE 20): access-log path (or
+        # the PADDLE_TPU_SERVE_ACCESS_LOG env; None = ring+aggregates
+        # only), the tail-sampling slow threshold, and the TTFT SLO the
+        # burn-rate monitor evaluates
+        self.access_log = access_log
+        self.access_log_max_bytes = int(access_log_max_bytes)
+        self.trace_slow_s = trace_slow_s
+        self.slo_ttft_s = slo_ttft_s
+        self.slo_objective = float(slo_objective)
 
 
 class ServingEngine:
@@ -162,6 +187,32 @@ class ServingEngine:
         self._g_tps = _telemetry.gauge(
             "paddle_tpu_serve_tokens_per_sec",
             "generated tokens per busy second (cumulative)")
+        self._h_tpot = _telemetry.histogram(
+            "paddle_tpu_serve_tpot_seconds",
+            "inter-token decode gap (time-per-output-token)",
+            buckets=_TPOT_BUCKETS)
+        self._g_oldest = _telemetry.gauge(
+            "paddle_tpu_serve_oldest_queued_age_seconds",
+            "age of the oldest still-queued request (wedge signal)")
+        # per-request lifecycle records: every exit path writes ONE
+        # access record carrying the SAME measured latency/TTFT floats
+        # the histograms observed, so tracing.reconcile_with_metrics
+        # can check access-log aggregates against counters exactly
+        self.access = AccessLog(
+            self.config.access_log
+            or os.environ.get("PADDLE_TPU_SERVE_ACCESS_LOG"),
+            max_bytes=self.config.access_log_max_bytes)
+        self.windows = ServingWindows()
+        self._trace_slow_s = (
+            self.config.trace_slow_s if self.config.trace_slow_s is not None
+            else _env_float("PADDLE_TPU_SERVE_TRACE_SLOW_S", 2.0))
+        self._slo_ttft_s = (
+            self.config.slo_ttft_s if self.config.slo_ttft_s is not None
+            else _env_float("PADDLE_TPU_SERVE_SLO_TTFT_S", 1.0))
+        self._slo = SLOMonitor("serve_ttft",
+                               objective=self.config.slo_objective)
+        self._publish_every_s = 0.25
+        self._last_publish_t = 0.0
         # crash-and-hang observability: the /serving statusz route and
         # postmortem bundles report this engine's scheduler + KV-pool
         # state (weak registration — the engine's lifetime is its own),
@@ -186,10 +237,18 @@ class ServingEngine:
             # previous life's tokens; remember the split so results
             # and the journal reconstruct the original request
             req.resume_prefix = [int(t) for t in _resume]
+        self.windows.count_submitted()
         try:
             self.scheduler.submit(req)
-        except OverloadedError:
+        except OverloadedError as exc:
             self._c_req.labels(outcome="overloaded").inc()
+            self.windows.count_shed()
+            self._slo.observe(False)
+            req.evict_reason = getattr(exc, "reason", None)
+            # shed at the door: outcome counter incremented but the
+            # request never entered paddle_tpu_serve_request_seconds,
+            # so the access aggregate must not claim a latency either
+            self._finish_request(req, "overloaded", None)
             raise
         if self.journal is not None:
             self.journal.record_submit(req)
@@ -256,10 +315,16 @@ class ServingEngine:
         self._c_tok.inc(len(plan.emit))
         self._c_steps.labels(
             kind="decode" if plan.decode_only else "mixed").inc()
+        # inter-token gaps measured ONCE by complete_step (same floats
+        # feed the per-request aggregates in the access record); the
+        # engine observes them back-to-back on the same decode thread
+        for gap in self.scheduler.last_step_tpots:
+            self._h_tpot.observe(gap)
         for _row, req in plan.emit:
             if req.t_first_token is not None and len(req.generated) == 1:
                 dt = req.t_first_token - req.t_submit
                 self._h_ttft.observe(dt)
+                self.windows.observe_ttft(dt)
                 _tracing.emit_span("ttft", "serve", req.t_submit_wall,
                                    dt, request=req.request_id)
         for req in finished:
@@ -269,6 +334,11 @@ class ServingEngine:
                                request=req.request_id,
                                tokens=len(req.generated))
             self._c_req.labels(outcome="completed").inc()
+            self.windows.count_tokens(len(req.generated))
+            ttft = (req.t_first_token - req.t_submit
+                    if req.t_first_token is not None else None)
+            self._slo.observe(ttft is None or ttft <= self._slo_ttft_s)
+            self._finish_request(req, "completed", dt)
             # full output = tokens from a previous process life (journal
             # recovery) + this life's generation
             out = req.resume_prefix + req.generated
@@ -282,6 +352,8 @@ class ServingEngine:
             while len(self._results) > self._results_limit:
                 self._results.pop(next(iter(self._results)))
         self._account_evicted()
+        self.windows.observe_queue_depth(len(self.scheduler.queue))
+        self._publish_windows()
         if self._busy_s > 0:
             self._g_tps.set(self._tokens_out / self._busy_s)
         if self.elastic is not None:
@@ -325,6 +397,9 @@ class ServingEngine:
                        "queue_timeout": "overloaded"}.get(
                            req.evict_reason, "evicted")
             self._c_req.labels(outcome=outcome).inc()
+            if outcome == "overloaded":
+                self.windows.count_shed()
+            self._slo.observe(False)
             if self.journal is not None:
                 self.journal.record_finish(req.request_id, outcome)
             # an evicted request still closes its latency span — the
@@ -335,6 +410,133 @@ class ServingEngine:
             _tracing.emit_span("request", "serve", req.t_submit_wall, dt,
                                request=req.request_id, evicted=True,
                                reason=req.evict_reason)
+            self._finish_request(req, outcome, dt)
+
+    # -- request-scoped observability (ISSUE 20) ----------------------------
+
+    def _finish_request(self, req, outcome, latency_s):
+        """Write the request's access record at exit. `latency_s` is the
+        SAME float the request-latency histogram observed (None for a
+        submit-time shed, which never entered that histogram), so
+        access-log aggregates reconcile exactly with the metrics.
+        Tail sampling: non-completed or slow requests additionally emit
+        nested `serve/request/*` detail spans and a ``serve_access``
+        event; the happy path keeps only the summary record."""
+        ttft = (req.t_first_token - req.t_submit
+                if req.t_first_token is not None else None)
+        sampled = tail_sampled(outcome, latency_s, self._trace_slow_s)
+        rec = {"kind": "serve_access",
+               "request_id": req.request_id,
+               "ts": round(time.time(), 6),
+               "t_submit_wall": round(req.t_submit_wall, 6),
+               "outcome": outcome,
+               "latency_s": (round(latency_s, 6)
+                             if latency_s is not None else None),
+               "ttft_s": round(ttft, 6) if ttft is not None else None,
+               "queue_wait_s": (round(req.t_scheduled - req.t_submit, 6)
+                                if req.t_scheduled is not None else None),
+               "prompt_len": len(req.prompt),
+               "tokens_out": len(req.generated),
+               "max_new_tokens": req.max_new_tokens,
+               "deadline_s": req.deadline_s,
+               "prefill_chunks": len(req.prefill_marks),
+               "preemptions": req.preemptions,
+               "tpot_count": req.tpot_count,
+               "tpot_mean_s": (round(req.tpot_sum / req.tpot_count, 6)
+                               if req.tpot_count else None),
+               "tpot_max_s": (round(req.tpot_max, 6)
+                              if req.tpot_count else None),
+               "evict_reason": req.evict_reason,
+               "sampled": sampled}
+        if sampled:
+            rec["prefill_marks"] = list(req.prefill_marks)
+            rec["preempt_marks"] = list(req.preempt_marks)
+            self._emit_detail_spans(req, outcome, latency_s, ttft)
+            _telemetry.emit("serve_access",
+                            **{k: v for k, v in rec.items()
+                               if k != "kind"})
+        self.access.record(rec, latency_s=latency_s, ttft_s=ttft)
+
+    def _emit_detail_spans(self, req, outcome, latency_s, ttft):
+        # nested timeline for sampled requests only; names are
+        # "request/<phase>" so reconcile's EXACT-name span matching
+        # keeps them out of the per-request `serve/request` checks
+        total = latency_s if latency_s is not None else 0.0
+        base = req.t_submit_wall
+        q_end = (req.t_scheduled - req.t_submit
+                 if req.t_scheduled is not None else total)
+        q_end = max(0.0, min(q_end, total))
+        _tracing.emit_span("request/queue", "serve", base, q_end,
+                           request=req.request_id, outcome=outcome)
+        if req.t_scheduled is not None:
+            pf_end = ttft if ttft is not None else total
+            pf_end = max(q_end, min(pf_end, total))
+            _tracing.emit_span("request/prefill", "serve", base + q_end,
+                               pf_end - q_end, request=req.request_id,
+                               chunks=len(req.prefill_marks),
+                               preemptions=req.preemptions)
+        if ttft is not None:
+            _tracing.emit_span("request/decode", "serve", base + ttft,
+                               max(0.0, total - ttft),
+                               request=req.request_id,
+                               tokens=len(req.generated),
+                               tpot_count=req.tpot_count)
+
+    def _publish_windows(self, force=False):
+        """Throttled export of the rolling windows: windowed gauges,
+        the oldest-queued-age wedge gauge, and the SLO burn-rate
+        evaluation (which emits ``slo_burn`` events when both windows
+        burn). Called per step; cheap no-op inside the throttle."""
+        nowm = time.monotonic()
+        if not force and nowm - self._last_publish_t < self._publish_every_s:
+            return None
+        self._last_publish_t = nowm
+        snap = self.windows.publish()
+        oldest = self.scheduler.oldest_queued_age()
+        self._g_oldest.set(oldest)
+        panel = self._slo.evaluate()
+        return {"windows": snap, "slo": panel,
+                "oldest_queued_age_s": round(oldest, 6)}
+
+    def slo_panel(self):
+        """Fresh windows + SLO + oldest-queued-age panel (statusz)."""
+        return self._publish_windows(force=True)
+
+    def requestz_snapshot(self, recent=50):
+        """The /requestz payload: every in-flight request with its age
+        and phase, the ring of recent access records, and the windowed
+        SLO panel. Safe from any thread: scheduler containers are
+        copied first (C-level atomics), requests are read-only here."""
+        now = time.perf_counter()
+        queued = list(self.scheduler.queue)
+        running = dict(self.scheduler.running)
+        in_flight = []
+        for req in queued:
+            in_flight.append({
+                "request_id": req.request_id, "phase": "queued",
+                "age_s": round(now - req.t_submit, 6),
+                "prompt_len": len(req.prompt),
+                "max_new_tokens": req.max_new_tokens,
+                "preemptions": req.preemptions})
+        for slot, req in sorted(running.items()):
+            in_flight.append({
+                "request_id": req.request_id,
+                "phase": ("prefill" if req.n_fed < len(req.prompt)
+                          else "decode"),
+                "slot": slot,
+                "age_s": round(now - req.t_submit, 6),
+                "prompt_len": len(req.prompt),
+                "n_fed": req.n_fed,
+                "generated": len(req.generated),
+                "max_new_tokens": req.max_new_tokens,
+                "preemptions": req.preemptions})
+        panel = self.slo_panel()
+        return {"in_flight": in_flight,
+                "recent": self.access.recent(recent),
+                "windows": panel["windows"],
+                "slo": panel["slo"],
+                "oldest_queued_age_s": panel["oldest_queued_age_s"],
+                "access": self.access.stats()}
 
     def run(self, max_steps=None):
         """Drive `step()` until the queue drains (or `max_steps`).
@@ -555,6 +757,9 @@ class ServingEngine:
             "journal": (self.journal.stats()
                         if self.journal is not None else None),
             "undrained_results": len(self._results),
+            "observability": {"windows": self.windows.snapshot(),
+                              "slo": self._slo.evaluate(),
+                              "access": self.access.stats()},
         }
 
 

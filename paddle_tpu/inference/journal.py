@@ -36,7 +36,26 @@ import threading
 
 from ..runtime.resilience import fault_point, record_fault
 
-__all__ = ["RequestJournal", "read_journal"]
+__all__ = ["RequestJournal", "read_journal", "iter_jsonl"]
+
+
+def iter_jsonl(path):
+    """Yield parsed records from one JSONL file, skipping blank and
+    unparseable lines — the torn-tail contract shared by the journal,
+    the access log (access_log.py), and the telemetry event stream: a
+    SIGKILL mid-write loses at most the line in flight, and a reader
+    prefers a lost record to a wedged restart."""
+    if not os.path.exists(path):
+        return
+    with open(path, encoding="utf-8") as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                yield json.loads(line)
+            except ValueError:
+                continue  # torn tail from a crash — expected
 
 
 class RequestJournal:
@@ -213,38 +232,27 @@ def read_journal(path):
     entries = {}
     completed = {}
     outcomes = {}
-    if not os.path.exists(path):
-        return {"unfinished": [], "completed": completed,
-                "outcomes": outcomes}
-    with open(path, encoding="utf-8") as fh:
-        for line in fh:
-            line = line.strip()
-            if not line:
-                continue
-            try:
-                rec = json.loads(line)
-            except ValueError:
-                continue  # torn tail from the crash — expected
-            k = rec.get("k")
-            if k == "sub":
-                entries[rec["id"]] = {
-                    "id": rec["id"], "prompt": list(rec.get("prompt", [])),
-                    "max_new_tokens": int(rec.get("max_new_tokens", 0)),
-                    "eos_id": rec.get("eos_id"),
-                    "deadline_s": rec.get("deadline_s"),
-                    "gen": [int(t) for t in rec.get("gen", [])]}
-            elif k == "tok":
-                for rid, t in rec.get("toks", []):
-                    e = entries.get(rid)
-                    if e is not None:
-                        e["gen"].append(int(t))
-            elif k == "fin":
-                e = entries.pop(rec.get("id"), None)
-                outcomes[rec.get("id")] = rec.get("outcome")
-                if rec.get("outcome") == "completed":
-                    toks = rec.get("toks")
-                    if toks is None:
-                        toks = e["gen"] if e else []
-                    completed[rec["id"]] = [int(t) for t in toks]
+    for rec in iter_jsonl(path):
+        k = rec.get("k")
+        if k == "sub":
+            entries[rec["id"]] = {
+                "id": rec["id"], "prompt": list(rec.get("prompt", [])),
+                "max_new_tokens": int(rec.get("max_new_tokens", 0)),
+                "eos_id": rec.get("eos_id"),
+                "deadline_s": rec.get("deadline_s"),
+                "gen": [int(t) for t in rec.get("gen", [])]}
+        elif k == "tok":
+            for rid, t in rec.get("toks", []):
+                e = entries.get(rid)
+                if e is not None:
+                    e["gen"].append(int(t))
+        elif k == "fin":
+            e = entries.pop(rec.get("id"), None)
+            outcomes[rec.get("id")] = rec.get("outcome")
+            if rec.get("outcome") == "completed":
+                toks = rec.get("toks")
+                if toks is None:
+                    toks = e["gen"] if e else []
+                completed[rec["id"]] = [int(t) for t in toks]
     return {"unfinished": list(entries.values()),
             "completed": completed, "outcomes": outcomes}

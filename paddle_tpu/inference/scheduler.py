@@ -75,6 +75,11 @@ class RequestState:
 
 _ids = itertools.count()
 
+# phase-mark lists are bounded: a pathological request (thousands of
+# prefill chunks / preemptions) must not grow memory per event — counts
+# keep counting, the timeline keeps its head
+_MARK_LIMIT = 64
+
 
 class ServeRequest:
     """One generation request. `deadline_s` is a wall-clock budget from
@@ -83,7 +88,9 @@ class ServeRequest:
     __slots__ = ("request_id", "prompt", "max_new_tokens", "deadline_s",
                  "eos_id", "state", "generated", "slot", "n_fed",
                  "n_cached", "t_submit", "t_submit_wall", "t_first_token",
-                 "t_done", "preemptions", "evict_reason", "resume_prefix")
+                 "t_done", "preemptions", "evict_reason", "resume_prefix",
+                 "t_scheduled", "prefill_marks", "preempt_marks",
+                 "t_last_token", "tpot_sum", "tpot_max", "tpot_count")
 
     def __init__(self, prompt, max_new_tokens=16, deadline_s=None,
                  eos_id=None, request_id=None):
@@ -111,6 +118,17 @@ class ServeRequest:
         # previous process life (its scheduling `prompt` then carries
         # them as context; final output = resume_prefix + generated)
         self.resume_prefix = []
+        # phase timeline (ISSUE 20): first time this request entered a
+        # batch, bounded (offset_s, chunk) prefill marks, bounded
+        # preemption offsets, and per-token decode (TPOT) aggregates —
+        # the engine folds these into the request's access record
+        self.t_scheduled = None
+        self.prefill_marks = []
+        self.preempt_marks = []
+        self.t_last_token = None
+        self.tpot_sum = 0.0
+        self.tpot_max = 0.0
+        self.tpot_count = 0
 
     @property
     def context_len(self):
@@ -198,6 +216,9 @@ class ContinuousBatchingScheduler:
         # under it are parked here and recorded after release
         self._lock = threading.RLock()
         self._deferred = collections.deque()
+        # the most recent complete_step's inter-token gaps (decode
+        # thread writes, engine reads back-to-back on the same thread)
+        self.last_step_tpots = []
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -351,6 +372,9 @@ class ContinuousBatchingScheduler:
         victim.n_fed = 0
         victim.n_cached = 0
         victim.preemptions += 1
+        if len(victim.preempt_marks) < _MARK_LIMIT:
+            victim.preempt_marks.append(
+                round(time.perf_counter() - victim.t_submit, 6))
         self.queue.appendleft(victim)
         detail = (f"{victim.request_id} preempted for "
                   f"{needy.request_id}")
@@ -429,6 +453,8 @@ class ContinuousBatchingScheduler:
             else:
                 token = (req.generated[-1] if req.generated
                          else req.prompt[-1])
+                if req.t_scheduled is None:
+                    req.t_scheduled = now
                 plan.add_row(token, slot, req.n_cached, req, emits=True)
                 plan.decode_rows += 1
                 plan.scheduled.append(req)
@@ -463,6 +489,11 @@ class ContinuousBatchingScheduler:
                 chunk = min(chunk - 1, max(0, fit))
             if chunk <= 0:
                 continue
+            if req.t_scheduled is None:
+                req.t_scheduled = now
+            if len(req.prefill_marks) < _MARK_LIMIT:
+                req.prefill_marks.append(
+                    (round(now - req.t_submit, 6), chunk))
             last = len(req.prompt) - 1
             for j in range(chunk):
                 pos = req.n_fed + j
@@ -486,6 +517,7 @@ class ContinuousBatchingScheduler:
         plan.emit rows). Returns the requests that finished this step."""
         now = time.perf_counter() if now is None else now
         done = []
+        tpots = []
         with self._lock:
             for row, req in plan.emit:
                 if req.state != RequestState.RUNNING:
@@ -493,6 +525,21 @@ class ContinuousBatchingScheduler:
                 req.generated.append(int(tokens[row]))
                 if req.t_first_token is None:
                     req.t_first_token = now
+                else:
+                    # inter-token (decode) gap — the TPOT sample. The
+                    # request-level aggregates and the engine's TPOT
+                    # histogram are fed from this SAME gap value, so
+                    # access records reconcile with the histogram.
+                    prev = (req.t_last_token
+                            if req.t_last_token is not None
+                            else req.t_first_token)
+                    gap = max(0.0, now - prev)
+                    req.tpot_sum += gap
+                    req.tpot_count += 1
+                    if gap > req.tpot_max:
+                        req.tpot_max = gap
+                    tpots.append(gap)
+                req.t_last_token = now
                 if self._done(req):
                     req.t_done = now
                     req.state = RequestState.FINISHED
@@ -503,7 +550,24 @@ class ContinuousBatchingScheduler:
                     self.finished_total += 1
                     self._admitted_at.pop(req.request_id, None)
                     done.append(req)
+        # single-writer handoff: only the decode thread calls
+        # complete_step, and the engine reads this immediately after —
+        # the list is replaced wholesale, never mutated in place
+        self.last_step_tpots = tpots
         return done
+
+    def oldest_queued_age(self, now=None):
+        """Seconds the longest-waiting QUEUED request has been waiting
+        (0.0 when the queue is empty). This is the server-published
+        wedge signal: a live engine drains its queue, so a growing
+        oldest age — not wall-clock elapsed — is what distinguishes a
+        wedged loop from a merely long run (tools/loadgen.py keys its
+        ``wedged`` verdict on this instead of client-side inference)."""
+        now = time.perf_counter() if now is None else now
+        with self._lock:
+            if not self.queue:
+                return 0.0
+            return max(0.0, now - min(r.t_submit for r in self.queue))
 
     def stats(self):
         with self._lock:
@@ -515,4 +579,5 @@ class ContinuousBatchingScheduler:
                     "shed_by_reason": dict(self.shed_by_reason),
                     "draining": self.draining,
                     "queued_blocks": self.queued_blocks(),
+                    "oldest_queued_age_s": self.oldest_queued_age(),
                     "kv": self.cache.stats()}

@@ -43,7 +43,9 @@ itself must carry its recent history to the grave. Three pieces:
   ``/statusz`` (the machine-readable `profiler.summary_dict()` runtime
   summary), ``/metrics`` (the existing Prometheus renderer),
   ``/stacks`` (all-thread stacks), ``/flightrecorder`` (the ring
-  tail), ``/serving`` (engine + scheduler + KV-pool state). Port 0
+  tail), ``/serving`` (engine + scheduler + KV-pool state),
+  ``/requestz`` (per-request serving timelines: in-flight table,
+  recent access records, windowed SLO panel). Port 0
   binds an ephemeral port; `statusz_address()` reports it and the
   bound port is also written to ``statusz-<pid>.port`` in the
   diagnostics dir so tooling can find a child's server.
@@ -790,6 +792,44 @@ def serving_snapshot():
     return out or None
 
 
+def requestz_snapshot():
+    """Per-engine /requestz payloads (ISSUE 20): in-flight request
+    table, recent access records, windowed SLO panel. None when no
+    live engine is registered (or none exposes the snapshot)."""
+    out = []
+    for ref in list(_engines):
+        eng = ref()
+        if eng is None:
+            continue
+        snap_fn = getattr(eng, "requestz_snapshot", None)
+        if snap_fn is None:
+            continue
+        try:
+            out.append(snap_fn())
+        except Exception as e:  # noqa: BLE001 — a wedged engine must
+            # not take the route down with it
+            out.append({"error": f"{type(e).__name__}: {e}"})
+    return out or None
+
+
+def _serving_slo():
+    """Compact windowed-SLO panels for the /statusz body (the full
+    request table lives on /requestz)."""
+    out = []
+    for ref in list(_engines):
+        eng = ref()
+        if eng is None:
+            continue
+        panel_fn = getattr(eng, "slo_panel", None)
+        if panel_fn is None:
+            continue
+        try:
+            out.append(panel_fn())
+        except Exception as e:  # noqa: BLE001
+            out.append({"error": f"{type(e).__name__}: {e}"})
+    return out or None
+
+
 # ---------------------------------------------------------------------------
 # /statusz server
 
@@ -823,6 +863,7 @@ def _statusz_payload():
         "diagnostics_dir": _config["dir"],
         "last_bundle": _last_bundle[0],
         "threads": sorted(t.name for t in threading.enumerate()),
+        "serving_slo": _serving_slo(),
     }
 
 
@@ -877,6 +918,8 @@ def _make_handler():
                                 "tail": flight_tail(n)})
                 elif path == "/serving":
                     self._json({"engines": serving_snapshot() or []})
+                elif path == "/requestz":
+                    self._json({"engines": requestz_snapshot() or []})
                 elif path == "/healthz":
                     self._send("ok\n", "text/plain")
                 else:

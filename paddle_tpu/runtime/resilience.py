@@ -124,6 +124,11 @@ _EVENT_KINDS = (
     #                           compaction failed; the record was
     #                           dropped and serving continued (crash
     #                           recovery degrades, the engine does not)
+    "access_log_errors",      # a serving access-log append/rotation
+    #                           failed; the record was dropped (ring +
+    #                           aggregates still updated) and serving
+    #                           continued — same never-raise contract
+    #                           as the journal
     "collective_divergence",  # two live ranks published collective-
     #                           schedule fingerprints that disagree at a
     #                           common sequence point — the SPMD

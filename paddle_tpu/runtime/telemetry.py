@@ -391,8 +391,23 @@ def snapshot():
     return _REGISTRY.snapshot()
 
 
+# consumers that keep shadow aggregates mirrored against registry
+# metrics (the access log's reconciliation surface) register here so a
+# test-isolation reset clears BOTH sides of the exactness invariant
+_reset_hooks = []
+
+
+def on_reset(cb):
+    _reset_hooks.append(cb)
+
+
 def reset_metrics():
     _REGISTRY.reset()
+    for cb in list(_reset_hooks):
+        try:
+            cb()
+        except Exception:  # noqa: BLE001 — a bad hook can't block reset
+            pass
 
 
 def merge_histograms(snaps):
@@ -1659,6 +1674,17 @@ METRIC_NAMES = (
     "paddle_tpu_serve_steps_total",
     "paddle_tpu_serve_tokens_per_sec",
     "paddle_tpu_serve_kv_blocks",
+    # request-scoped observability (ISSUE 20): per-token decode latency
+    # (TPOT) histogram, the rolling-window SLO surface published by
+    # runtime/windows.ServingWindows as {window="1m"|"5m"}-labelled
+    # gauges, and the server-published oldest-queued-age gauge that
+    # replaced loadgen's client-side wedge inference
+    "paddle_tpu_serve_tpot_seconds",
+    "paddle_tpu_serve_ttft_p99_seconds",
+    "paddle_tpu_serve_goodput_tokens_per_sec",
+    "paddle_tpu_serve_shed_ratio",
+    "paddle_tpu_serve_queue_depth_highwater",
+    "paddle_tpu_serve_oldest_queued_age_seconds",
 )
 
 # every event `kind` the stack emits into the structured stream
@@ -1693,6 +1719,14 @@ EVENT_KINDS = (
     "serve_recover",      # a restarted engine re-admitted unfinished
     #                       journaled requests (resumed/completed
     #                       counts)
+    "serve_access",       # one tail-sampled request left the engine
+    #                       (inference/access_log.py): the access
+    #                       record's summary fields for slow/shed/
+    #                       evicted requests — happy-path requests
+    #                       stay out of the stream by design
+    "slo_burn",           # runtime/windows.SLOMonitor: both the fast
+    #                       and slow windows burned error budget past
+    #                       threshold (cooldown-limited)
 )
 
 

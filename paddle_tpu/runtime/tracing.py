@@ -639,6 +639,21 @@ def validate_trace(path):
 # ---------------------------------------------------------------------------
 # reconciliation: the timeline and the counters must agree
 
+# the serving access log registers its aggregate snapshot here at
+# import (inference/access_log.py) — a probe function, not an import,
+# so the runtime layer never depends on the inference package
+_serve_access_probe = [None]
+
+
+def set_serve_access_probe(fn):
+    """Register (or clear, with None) the access-log aggregate probe
+    `reconcile_with_metrics` compares against the serve counters.
+    Returns the previous probe."""
+    prev = _serve_access_probe[0]
+    _serve_access_probe[0] = fn  # threadlint: ok[CL001] GIL-atomic publish; import-time single-writer
+    return prev
+
+
 def reconcile_with_metrics(tolerance=0.02, abs_slack=2e-3):
     """Assert the span sums agree with the authoritative counters.
     Producers emit these spans from the SAME measured duration that
@@ -655,6 +670,13 @@ def reconcile_with_metrics(tolerance=0.02, abs_slack=2e-3):
     * ``checkpoint/restore`` spans  vs ``paddle_tpu_checkpoint_restore_seconds``
     * ``serve/request`` spans       vs ``paddle_tpu_serve_request_seconds``
     * ``serve/ttft`` spans          vs ``paddle_tpu_serve_ttft_seconds``
+
+    Access-log checks (when inference/access_log.py has registered its
+    probe): per-outcome record counts must equal the
+    ``paddle_tpu_serve_requests_total`` series EXACTLY, and the
+    record-aggregated latency/TTFT sums must match the serve
+    histograms — records are built from the same measured values, so
+    only float accumulation order separates the two surfaces.
 
     Returns (ok, report) where report maps check name ->
     {span_s, metric_s, span_n, metric_n, ok, skipped}."""
@@ -716,6 +738,37 @@ def reconcile_with_metrics(tolerance=0.02, abs_slack=2e-3):
           hist("paddle_tpu_serve_request_seconds"))
     check("serve_ttft", spans("serve", name="ttft"),
           hist("paddle_tpu_serve_ttft_seconds"))
+    probe = _serve_access_probe[0]
+    acc = None
+    if probe is not None:
+        try:
+            acc = probe()
+        except Exception:  # noqa: BLE001 — a broken probe skips, not fails
+            acc = None
+    if acc is not None:
+        fam = snap.get("paddle_tpu_serve_requests_total") or {}
+        counts = {}
+        for s in fam.get("series", []):
+            key = s.get("labels", {}).get("outcome")
+            counts[key] = counts.get(key, 0) + int(s.get("value", 0))
+        a_out = {k: int(v) for k, v in acc.get("outcomes", {}).items()}
+        n_acc = sum(a_out.values())
+        n_met = sum(counts.values())
+        skipped = n_acc == 0 and n_met == 0
+        per_outcome_ok = all(
+            counts.get(k, 0) == a_out.get(k, 0)
+            for k in set(counts) | set(a_out))
+        report["serve_access_outcomes"] = {
+            "span_s": 0.0, "metric_s": 0.0, "span_n": n_acc,
+            "metric_n": n_met, "ok": skipped or per_outcome_ok,
+            "skipped": skipped}
+        check("serve_access_latency",
+              (acc.get("latency_sum", 0.0),
+               int(acc.get("latency_count", 0))),
+              hist("paddle_tpu_serve_request_seconds"))
+        check("serve_access_ttft",
+              (acc.get("ttft_sum", 0.0), int(acc.get("ttft_count", 0))),
+              hist("paddle_tpu_serve_ttft_seconds"))
     ok = all(v["ok"] for v in report.values())
     return ok, report
 

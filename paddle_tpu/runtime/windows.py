@@ -1,0 +1,387 @@
+"""Rolling-window metric aggregation + SLO burn-rate monitoring
+(ISSUE 20).
+
+The registry's counters and histograms (telemetry.py) are
+lifetime-cumulative — right for "how much happened ever", useless for
+"what is TTFT p99 *right now*". This module adds the windowed view as a
+ring of epoch-tagged subwindows: each observation lands in the
+subwindow slot for `int(now / width) % n`, a slot whose stored epoch is
+stale is reset-then-written IN THE SAME critical section, and reads
+merge every slot whose epoch still falls inside the window. One lock
+per windowed metric makes the reset-vs-increment race at a rotation
+boundary impossible by construction: an increment either lands in the
+old epoch's slot before the reset (and ages out with it) or in the
+fresh epoch after it — never in the void between
+(tests/test_request_observability.py hammers this with concurrent
+producers across hundreds of rotations).
+
+Quantiles come from the same fixed-bucket histogram shape the registry
+uses (mergeability was the reason buckets are fixed at declaration;
+windowed interpolation is the payoff), so a windowed TTFT p99 and the
+lifetime `paddle_tpu_serve_ttft_seconds` histogram describe the same
+measurements on two time horizons.
+
+`ServingWindows` bundles the serving engine's windowed surface (TTFT
+p99, goodput tok/s, shed ratio, queue-depth highwater over 1m/5m) and
+publishes it as `{window="1m"|"5m"}`-labelled registry gauges so
+Prometheus//statusz scrape it like any other metric. `SLOMonitor`
+implements the standard fast/slow multi-window burn-rate alert: when
+BOTH the fast and the slow window burn error budget faster than their
+thresholds, it emits one (cooldown-limited) ``slo_burn`` event into the
+structured stream.
+
+Everything here is pure host-side bookkeeping: no file I/O under any
+lock, observation cost is one lock acquire + O(1) arithmetic, and every
+method takes an optional ``now`` so tests drive time deterministically.
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+from . import telemetry as _telemetry
+
+__all__ = ["WindowedCounter", "WindowedMax", "WindowedHistogram",
+           "quantile_from_buckets", "ServingWindows", "SLOMonitor"]
+
+
+def _now_or(now):
+    return time.monotonic() if now is None else float(now)
+
+
+class WindowedCounter:
+    """A counter over the trailing `window_s` seconds, resolved into
+    `subwindows` ring slots. `total()` is exact to one subwindow width
+    of horizon fuzz (the standard rolling-window tradeoff)."""
+
+    __slots__ = ("window_s", "n", "width", "_lock", "_slots")
+
+    def __init__(self, window_s=60.0, subwindows=12):
+        if window_s <= 0 or subwindows < 1:
+            raise ValueError("window_s and subwindows must be positive")
+        self.window_s = float(window_s)
+        self.n = int(subwindows)
+        self.width = self.window_s / self.n
+        self._lock = threading.Lock()
+        self._slots = [[-1, 0.0] for _ in range(self.n)]  # [epoch, value]
+
+    def inc(self, n=1, now=None):
+        epoch = int(_now_or(now) / self.width)
+        slot = self._slots[epoch % self.n]
+        with self._lock:
+            # stale-slot reset and the increment share ONE critical
+            # section: a producer racing the rotation boundary can
+            # never have its increment wiped by a concurrent reset
+            if slot[0] != epoch:
+                slot[0] = epoch
+                slot[1] = 0.0
+            slot[1] += n
+
+    def total(self, now=None):
+        epoch = int(_now_or(now) / self.width)
+        lo = epoch - self.n + 1
+        with self._lock:
+            return float(sum(v for e, v in self._slots if lo <= e <= epoch))
+
+    def rate(self, now=None):
+        """Per-second rate over the window."""
+        return self.total(now) / self.window_s
+
+
+class WindowedMax:
+    """High-watermark over the trailing window (queue-depth peaks)."""
+
+    __slots__ = ("window_s", "n", "width", "_lock", "_slots")
+
+    def __init__(self, window_s=60.0, subwindows=12):
+        if window_s <= 0 or subwindows < 1:
+            raise ValueError("window_s and subwindows must be positive")
+        self.window_s = float(window_s)
+        self.n = int(subwindows)
+        self.width = self.window_s / self.n
+        self._lock = threading.Lock()
+        self._slots = [[-1, None] for _ in range(self.n)]  # [epoch, max]
+
+    def observe(self, v, now=None):
+        v = float(v)
+        epoch = int(_now_or(now) / self.width)
+        slot = self._slots[epoch % self.n]
+        with self._lock:
+            if slot[0] != epoch:
+                slot[0] = epoch
+                slot[1] = v
+            elif slot[1] is None or v > slot[1]:
+                slot[1] = v
+
+    def value(self, now=None):
+        """Max over the window, or None when nothing was observed."""
+        epoch = int(_now_or(now) / self.width)
+        lo = epoch - self.n + 1
+        with self._lock:
+            vals = [v for e, v in self._slots
+                    if lo <= e <= epoch and v is not None]
+        return max(vals) if vals else None
+
+
+def quantile_from_buckets(bounds, bucket_counts, count, q):
+    """Interpolated quantile (q in [0, 100]) from fixed-bucket
+    histogram counts (`bucket_counts` has len(bounds)+1 entries, the
+    last being the +Inf tail). Returns None with no samples; the +Inf
+    tail clamps to the last finite bound (the Prometheus
+    `histogram_quantile` convention)."""
+    if count <= 0:
+        return None
+    rank = max(1.0, q / 100.0 * count)
+    cum = 0.0
+    lower = 0.0
+    for i, b in enumerate(bounds):
+        c = bucket_counts[i]
+        if c > 0 and cum + c >= rank:
+            frac = (rank - cum) / c
+            return lower + (b - lower) * min(1.0, max(0.0, frac))
+        cum += c
+        lower = b
+    return float(bounds[-1])
+
+
+class WindowedHistogram:
+    """Fixed-bucket histogram over the trailing window: same bucket
+    bounds as the lifetime registry histogram it shadows, so the two
+    describe identical measurements on different horizons."""
+
+    __slots__ = ("window_s", "n", "width", "bounds", "_lock", "_slots")
+
+    def __init__(self, buckets, window_s=60.0, subwindows=12):
+        if window_s <= 0 or subwindows < 1:
+            raise ValueError("window_s and subwindows must be positive")
+        self.window_s = float(window_s)
+        self.n = int(subwindows)
+        self.width = self.window_s / self.n
+        self.bounds = tuple(sorted(buckets))
+        self._lock = threading.Lock()
+        # [epoch, bucket_counts, sum, count] per slot
+        self._slots = [[-1, [0] * (len(self.bounds) + 1), 0.0, 0]
+                       for _ in range(self.n)]
+
+    def observe(self, v, now=None):
+        v = float(v)
+        bounds = self.bounds
+        i = len(bounds)
+        for j, b in enumerate(bounds):  # ~16 bounds: linear is fine
+            if v <= b:
+                i = j
+                break
+        epoch = int(_now_or(now) / self.width)
+        slot = self._slots[epoch % self.n]
+        with self._lock:
+            if slot[0] != epoch:
+                slot[0] = epoch
+                slot[1] = [0] * (len(bounds) + 1)
+                slot[2] = 0.0
+                slot[3] = 0
+            slot[1][i] += 1
+            slot[2] += v
+            slot[3] += 1
+
+    def merged(self, now=None):
+        """(bucket_counts, sum, count) merged over the live window."""
+        epoch = int(_now_or(now) / self.width)
+        lo = epoch - self.n + 1
+        counts = [0] * (len(self.bounds) + 1)
+        total = 0.0
+        n = 0
+        with self._lock:
+            for e, bc, s, c in self._slots:
+                if lo <= e <= epoch:
+                    for i, v in enumerate(bc):
+                        counts[i] += v
+                    total += s
+                    n += c
+        return counts, total, n
+
+    def quantile(self, q, now=None):
+        counts, _total, n = self.merged(now)
+        return quantile_from_buckets(self.bounds, counts, n, q)
+
+    def count(self, now=None):
+        return self.merged(now)[2]
+
+
+# default serving windows: last minute at 5s resolution, last five
+# minutes at 15s resolution — the fast/slow pair SLO burn rates expect
+DEFAULT_WINDOWS = (("1m", 60.0, 12), ("5m", 300.0, 20))
+
+
+class ServingWindows:
+    """The serving engine's windowed SLO surface, published as
+    `{window=...}`-labelled registry gauges (Prometheus//statusz pick
+    them up like any lifetime metric). One instance per engine; the
+    gauge families are shared process-wide (registry idempotence), so
+    the last publisher wins — same contract as every engine-level
+    gauge."""
+
+    def __init__(self, windows=DEFAULT_WINDOWS, ttft_buckets=None):
+        if ttft_buckets is None:
+            ttft_buckets = _telemetry.DEFAULT_BUCKETS
+        self.windows = tuple((str(w), float(s), int(n))
+                             for w, s, n in windows)
+        self._ttft = {}
+        self._tokens = {}
+        self._shed = {}
+        self._submitted = {}
+        self._qhw = {}
+        for w, s, n in self.windows:
+            self._ttft[w] = WindowedHistogram(ttft_buckets, s, n)
+            self._tokens[w] = WindowedCounter(s, n)
+            self._shed[w] = WindowedCounter(s, n)
+            self._submitted[w] = WindowedCounter(s, n)
+            self._qhw[w] = WindowedMax(s, n)
+        self._g_ttft = _telemetry.gauge(
+            "paddle_tpu_serve_ttft_p99_seconds",
+            "TTFT p99 over the trailing window (0 = no samples)",
+            ("window",))
+        self._g_goodput = _telemetry.gauge(
+            "paddle_tpu_serve_goodput_tokens_per_sec",
+            "completed-request tokens per second over the trailing window",
+            ("window",))
+        self._g_shed = _telemetry.gauge(
+            "paddle_tpu_serve_shed_ratio",
+            "shed / submitted over the trailing window", ("window",))
+        self._g_qhw = _telemetry.gauge(
+            "paddle_tpu_serve_queue_depth_highwater",
+            "max observed queue depth over the trailing window",
+            ("window",))
+
+    # -- producers (engine decode thread + submitters) ----------------------
+
+    def observe_ttft(self, dt, now=None):
+        for w, _, _ in self.windows:
+            self._ttft[w].observe(dt, now)
+
+    def count_submitted(self, now=None):
+        for w, _, _ in self.windows:
+            self._submitted[w].inc(1, now)
+
+    def count_shed(self, now=None):
+        for w, _, _ in self.windows:
+            self._shed[w].inc(1, now)
+
+    def count_tokens(self, n, now=None):
+        for w, _, _ in self.windows:
+            self._tokens[w].inc(n, now)
+
+    def observe_queue_depth(self, depth, now=None):
+        for w, _, _ in self.windows:
+            self._qhw[w].observe(depth, now)
+
+    # -- consumers (statusz / reports / gauges) -----------------------------
+
+    def snapshot(self, now=None):
+        """{window: panel} — quantiles, rates, ratios, highwater."""
+        now = _now_or(now)
+        out = {}
+        for w, _s, _n in self.windows:
+            counts, total, cnt = self._ttft[w].merged(now)
+            sub = self._submitted[w].total(now)
+            shed = self._shed[w].total(now)
+            out[w] = {
+                "ttft_p50_s": quantile_from_buckets(
+                    self._ttft[w].bounds, counts, cnt, 50),
+                "ttft_p99_s": quantile_from_buckets(
+                    self._ttft[w].bounds, counts, cnt, 99),
+                "ttft_count": cnt,
+                "ttft_sum_s": total,
+                "goodput_tokens_per_sec": self._tokens[w].rate(now),
+                "submitted": sub,
+                "shed": shed,
+                "shed_ratio": (shed / sub) if sub else 0.0,
+                "queue_depth_highwater": self._qhw[w].value(now),
+            }
+        return out
+
+    def publish(self, now=None):
+        """Refresh the windowed gauges; returns the snapshot. A None
+        quantile publishes as 0.0 (gauges cannot carry None — the
+        snapshot keeps the distinction)."""
+        snap = self.snapshot(now)
+        for w, panel in snap.items():
+            self._g_ttft.labels(window=w).set(panel["ttft_p99_s"] or 0.0)
+            self._g_goodput.labels(window=w).set(
+                panel["goodput_tokens_per_sec"])
+            self._g_shed.labels(window=w).set(panel["shed_ratio"])
+            self._g_qhw.labels(window=w).set(
+                panel["queue_depth_highwater"] or 0)
+        return snap
+
+
+class SLOMonitor:
+    """Fast/slow multi-window burn-rate evaluation.
+
+    `observe(good)` counts one request against the objective (e.g.
+    "completed with TTFT under threshold"). `evaluate()` computes each
+    window's bad-fraction / error-budget burn rate; when the FAST
+    window burns >= `fast_burn` x budget AND the SLOW window burns >=
+    `slow_burn` x budget (both with enough samples), it emits one
+    ``slo_burn`` event — the cooldown keeps a sustained violation from
+    flooding the stream. The two-window AND is the standard guard: the
+    fast window gives detection latency, the slow window keeps a brief
+    blip from paging anyone."""
+
+    def __init__(self, name, objective=0.99,
+                 fast=("1m", 60.0, 12), slow=("5m", 300.0, 20),
+                 fast_burn=6.0, slow_burn=3.0, cooldown_s=30.0,
+                 min_samples=10):
+        if not 0.0 < objective < 1.0:
+            raise ValueError("objective must be in (0, 1)")
+        self.name = str(name)
+        self.objective = float(objective)
+        self.budget = 1.0 - self.objective
+        self.fast_burn = float(fast_burn)
+        self.slow_burn = float(slow_burn)
+        self.cooldown_s = float(cooldown_s)
+        self.min_samples = int(min_samples)
+        self._windows = {"fast": fast, "slow": slow}
+        self._good = {k: WindowedCounter(s, n)
+                      for k, (_w, s, n) in self._windows.items()}
+        self._bad = {k: WindowedCounter(s, n)
+                     for k, (_w, s, n) in self._windows.items()}
+        self._last_burn = None
+        self.burns_emitted = 0
+
+    def observe(self, good, now=None):
+        for k in self._windows:
+            (self._good if good else self._bad)[k].inc(1, now)
+
+    def evaluate(self, now=None):
+        """Returns the panel dict (per-window bad ratio / burn rate /
+        sample count, plus `burning`); emits ``slo_burn`` when both
+        windows burn past threshold and the cooldown allows."""
+        now = _now_or(now)
+        panel = {"slo": self.name, "objective": self.objective,
+                 "windows": {}}
+        burns = {}
+        for k, (label, _s, _n) in self._windows.items():
+            good = self._good[k].total(now)
+            bad = self._bad[k].total(now)
+            total = good + bad
+            ratio = (bad / total) if total else 0.0
+            burns[k] = {"n": total, "burn": ratio / self.budget}
+            panel["windows"][label] = {
+                "samples": int(total), "bad_ratio": ratio,
+                "burn_rate": burns[k]["burn"]}
+        burning = (burns["fast"]["n"] >= self.min_samples
+                   and burns["slow"]["n"] >= self.min_samples
+                   and burns["fast"]["burn"] >= self.fast_burn
+                   and burns["slow"]["burn"] >= self.slow_burn)
+        panel["burning"] = burning
+        if burning and (self._last_burn is None
+                        or now - self._last_burn >= self.cooldown_s):
+            self._last_burn = now
+            self.burns_emitted += 1
+            _telemetry.emit(
+                "slo_burn", slo=self.name, objective=self.objective,
+                fast_burn=round(burns["fast"]["burn"], 3),
+                slow_burn=round(burns["slow"]["burn"], 3),
+                fast_samples=int(burns["fast"]["n"]),
+                slow_samples=int(burns["slow"]["n"]))
+        return panel

@@ -7,7 +7,14 @@ Modes (argv[1]):
              tiny admission queue: the engine must SHED (OverloadedError
              + `overloaded` outcomes + serve_sheds faults), keep queue
              depth bounded, keep admitted-request TTFT bounded, and
-             exit clean.
+             exit clean. ISSUE 20 additions: /requestz must parse
+             under scrape WHILE the storm runs, the last-1m TTFT
+             window must move, shed requests must carry full sampled
+             traces (access records + `serve/request/*` detail
+             spans), and access-log aggregates must reconcile exactly
+             with the outcome counters and latency histograms
+             (tracing.reconcile_with_metrics; the parent sets
+             PADDLE_TPU_TRACE so span stats are live).
   chaos    — degradation contracts under injected faults: a
              serve.step delay must evict ONLY deadline-burdened
              requests; serve.kv_alloc failures must starve (not crash)
@@ -86,7 +93,11 @@ def _emit(out):
 
 
 if mode == "overload":
+    import threading
+    import urllib.request
+
     from tools.loadgen import run_load
+    from paddle_tpu.runtime import diagnostics, tracing
 
     dispatch.set_warmup_count(1)
     eng = _mk(max_queued=8, max_queue_wait_s=2.0)
@@ -95,13 +106,60 @@ if mode == "overload":
     eng.generate(PROMPTS, max_new_tokens=3)
     sustainable_rps = len(PROMPTS) / (time.perf_counter() - t0)
     rate = 4.0 * sustainable_rps
+    # ISSUE 20: statusz live during the storm; a third thread scrapes
+    # /requestz under fire, and the last-1m window is snapshotted
+    # before/after so the rolling view provably MOVES
+    diagnostics.start_statusz(0)
+    addr = diagnostics.statusz_address()
+    w1_before = eng.windows.snapshot()["1m"]
+    requestz = {"scrapes": 0, "parsed": 0, "in_flight_max": 0}
+    stop = threading.Event()
+
+    def _scrape_requestz():
+        url = f"http://{addr[0]}:{addr[1]}/requestz"
+        while not stop.wait(0.1):
+            requestz["scrapes"] += 1
+            try:
+                with urllib.request.urlopen(url, timeout=2) as resp:
+                    doc = json.loads(resp.read().decode("utf-8"))
+                requestz["parsed"] += 1
+                for e in doc.get("engines") or []:
+                    requestz["in_flight_max"] = max(
+                        requestz["in_flight_max"],
+                        len(e.get("in_flight") or []))
+            except Exception:  # noqa: BLE001 — a missed scrape is data
+                pass
+
+    th = threading.Thread(target=_scrape_requestz, daemon=True)
+    if addr is not None:
+        th.start()
     report = run_load(eng, rate_rps=rate, duration_s=2.0,
                       prompt_lens=(2, 4), new_tokens=(2, 4), seed=1,
                       hard_wall_s=90.0)
+    stop.set()
+    if addr is not None:
+        th.join(timeout=5.0)
+    w1_after = eng.windows.snapshot()["1m"]
+    rec_ok, rec_report = tracing.reconcile_with_metrics()
+    shed_recs = [r for r in eng.access.recent(256)
+                 if r.get("outcome") == "overloaded"]
+    detail_spans = {k[1]: int(v["count"])
+                    for k, v in tracing.span_stats().items()
+                    if k[1].startswith("request/")}
+    report.pop("records", None)  # bounded child JSON
     _emit({"report": report, "outcomes": _outcomes(),
            "serve_sheds": fault_events().get("serve_sheds", 0),
            "rate_rps": rate, "sustainable_rps": sustainable_rps,
-           "max_queued": 8})
+           "max_queued": 8,
+           "requestz": requestz,
+           "w1_before": w1_before, "w1_after": w1_after,
+           "reconcile_ok": rec_ok,
+           "reconcile_bad": {k: v for k, v in rec_report.items()
+                             if not v.get("ok", True)},
+           "shed_records": len(shed_recs),
+           "shed_records_sampled": sum(
+               1 for r in shed_recs if r.get("sampled")),
+           "detail_spans": detail_spans})
 
 elif mode == "chaos":
     dispatch.set_warmup_count(1)

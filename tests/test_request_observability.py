@@ -1,0 +1,450 @@
+"""Request-scoped serving observability (ISSUE 20): rolling-window
+aggregation (concurrent rotation safety, quantile interpolation, SLO
+burn-rate), deterministic tail-based trace sampling, access-log <->
+counter EXACT reconciliation, and the access log's torn-tail/rotation
+durability contract."""
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from paddle_tpu.inference import (
+    OverloadedError,
+    ServeConfig,
+    ServingEngine,
+    TinyServeModel,
+    read_access_log,
+    tail_sampled,
+)
+from paddle_tpu.inference.access_log import AccessLog, aggregates
+from paddle_tpu.runtime import telemetry, tracing
+from paddle_tpu.runtime.resilience import (
+    FaultInjector,
+    fault_events,
+    reset_fault_events,
+)
+from paddle_tpu.runtime.windows import (
+    SLOMonitor,
+    ServingWindows,
+    WindowedCounter,
+    WindowedHistogram,
+    WindowedMax,
+    quantile_from_buckets,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# windowed primitives
+
+
+class TestWindowedCounter:
+    def test_deterministic_expiry(self):
+        c = WindowedCounter(window_s=10.0, subwindows=5)  # width 2s
+        c.inc(3, now=0.0)
+        c.inc(2, now=1.0)                    # same epoch
+        assert c.total(now=1.0) == 5.0
+        assert c.total(now=9.9) == 5.0       # still inside the window
+        assert c.total(now=10.1) == 0.0      # epoch 0 aged out
+        assert c.rate(now=5.0) == 0.5
+
+    def test_slot_reuse_resets_stale_epoch(self):
+        c = WindowedCounter(window_s=10.0, subwindows=5)
+        c.inc(7, now=0.0)                    # epoch 0 -> slot 0
+        c.inc(1, now=10.0)                   # epoch 5 -> slot 0 again
+        # the stale epoch-0 value must have been wiped, not summed
+        assert c.total(now=10.0) == 1.0
+
+    def test_concurrent_rotation_no_lost_increments(self):
+        """The tentpole race: producers hammering a counter across
+        hundreds of live rotation boundaries must lose NOTHING — the
+        stale-slot reset and the increment share one critical section,
+        so an increment can never land in the void between them."""
+        c = WindowedCounter(window_s=30.0, subwindows=30000)  # 1ms width
+        n_threads, per_thread = 4, 20000
+        start = threading.Barrier(n_threads)
+
+        def worker():
+            start.wait()
+            for i in range(per_thread):
+                c.inc()
+                if i % 2000 == 1999:
+                    time.sleep(0.001)  # stretch across more epochs
+
+        threads = [threading.Thread(target=worker) for _ in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        # the run spans way less than the 30s window: every increment
+        # must still be visible
+        assert c.total() == float(n_threads * per_thread)
+
+    def test_rejects_bad_geometry(self):
+        with pytest.raises(ValueError):
+            WindowedCounter(window_s=0)
+        with pytest.raises(ValueError):
+            WindowedCounter(subwindows=0)
+
+
+class TestWindowedMax:
+    def test_max_and_expiry(self):
+        m = WindowedMax(window_s=10.0, subwindows=5)
+        assert m.value(now=0.0) is None
+        m.observe(3, now=0.0)
+        m.observe(9, now=1.0)
+        m.observe(5, now=4.0)
+        assert m.value(now=4.0) == 9.0
+        assert m.value(now=11.5) == 5.0      # the 9 aged out with epoch 0
+        assert m.value(now=30.0) is None
+
+
+class TestWindowedHistogram:
+    def test_quantiles_track_observations(self):
+        h = WindowedHistogram((0.1, 0.5, 1.0, 5.0), window_s=60.0,
+                              subwindows=6)
+        for v in (0.05, 0.05, 0.3, 0.3, 0.3, 0.7, 0.7, 0.9, 2.0, 4.0):
+            h.observe(v, now=1.0)
+        counts, total, n = h.merged(now=1.0)
+        assert n == 10 and counts == [2, 3, 3, 2, 0]
+        assert abs(total - 9.3) < 1e-9
+        p50 = h.quantile(50, now=1.0)
+        assert 0.1 < p50 <= 0.5              # rank 5 is in the 0.5 bucket
+        p99 = h.quantile(99, now=1.0)
+        assert 1.0 < p99 <= 5.0
+        assert h.quantile(50, now=120.0) is None   # window rolled over
+
+    def test_quantile_from_buckets_edges(self):
+        assert quantile_from_buckets((1.0,), [0, 0], 0, 99) is None
+        # everything in the +Inf tail clamps to the last finite bound
+        assert quantile_from_buckets((1.0, 2.0), [0, 0, 5], 5, 99) == 2.0
+        # single bucket interpolates from the lower edge
+        got = quantile_from_buckets((1.0, 2.0), [4, 0, 0], 4, 50)
+        assert 0.0 < got <= 1.0
+
+
+class TestServingWindows:
+    def test_snapshot_and_gauge_publish(self):
+        telemetry.reset_metrics()
+        w = ServingWindows(windows=(("1m", 60.0, 12),))
+        w.observe_ttft(0.2, now=1.0)
+        w.observe_ttft(0.4, now=2.0)
+        w.count_submitted(now=1.0)
+        w.count_submitted(now=2.0)
+        w.count_shed(now=2.0)
+        w.count_tokens(30, now=2.0)
+        w.observe_queue_depth(7, now=2.0)
+        snap = w.publish(now=3.0)
+        panel = snap["1m"]
+        assert panel["ttft_count"] == 2
+        assert abs(panel["ttft_sum_s"] - 0.6) < 1e-9
+        assert panel["submitted"] == 2.0 and panel["shed"] == 1.0
+        assert panel["shed_ratio"] == 0.5
+        assert panel["goodput_tokens_per_sec"] == 30 / 60.0
+        assert panel["queue_depth_highwater"] == 7.0
+        snap2 = telemetry.snapshot()
+        by_label = {tuple(s["labels"].values())[0]: s["value"]
+                    for s in snap2["paddle_tpu_serve_shed_ratio"]["series"]}
+        assert by_label["1m"] == 0.5
+
+
+class TestSLOMonitor:
+    def _mon(self, **kw):
+        base = dict(objective=0.9, fast=("1m", 60.0, 12),
+                    slow=("5m", 300.0, 20), fast_burn=6.0, slow_burn=3.0,
+                    cooldown_s=10.0, min_samples=5)
+        base.update(kw)
+        return SLOMonitor("test_slo", **base)
+
+    def test_no_burn_without_min_samples(self):
+        m = self._mon()
+        for _ in range(4):
+            m.observe(False, now=100.0)
+        panel = m.evaluate(now=100.0)
+        assert not panel["burning"] and m.burns_emitted == 0
+
+    def test_burn_requires_both_windows(self):
+        m = self._mon()
+        # 10 bad at t=100: both windows see them -> burning
+        for _ in range(10):
+            m.observe(False, now=100.0)
+        panel = m.evaluate(now=100.0)
+        assert panel["burning"] and m.burns_emitted == 1
+        assert panel["windows"]["1m"]["burn_rate"] >= 6.0
+        # at t=200 the fast (1m) window has rolled clean but the slow
+        # (5m) still carries the badness: NOT burning (the two-window
+        # AND is the whole point)
+        panel2 = m.evaluate(now=200.0)
+        assert not panel2["burning"]
+        assert panel2["windows"]["1m"]["samples"] == 0
+        assert panel2["windows"]["5m"]["samples"] == 10
+
+    def test_burn_event_cooldown(self):
+        m = self._mon()
+        for _ in range(10):
+            m.observe(False, now=100.0)
+        assert m.evaluate(now=100.0)["burning"]
+        assert m.evaluate(now=105.0)["burning"]  # inside cooldown
+        assert m.burns_emitted == 1
+        assert m.evaluate(now=112.0)["burning"]  # cooldown passed
+        assert m.burns_emitted == 2
+
+    def test_good_traffic_never_burns(self):
+        m = self._mon()
+        for _ in range(100):
+            m.observe(True, now=50.0)
+        panel = m.evaluate(now=50.0)
+        assert not panel["burning"]
+        assert panel["windows"]["1m"]["bad_ratio"] == 0.0
+
+
+# ---------------------------------------------------------------------------
+# tail sampling
+
+
+class TestTailSampling:
+    def test_unhappy_outcomes_always_sample(self):
+        for outcome in ("overloaded", "evicted", "cancelled", "error"):
+            assert tail_sampled(outcome, None, 2.0)
+            assert tail_sampled(outcome, 0.001, None)
+
+    def test_completed_samples_only_past_threshold(self):
+        assert not tail_sampled("completed", 0.5, 2.0)
+        assert tail_sampled("completed", 2.0, 2.0)
+        assert tail_sampled("completed", 9.9, 2.0)
+
+    def test_completed_without_threshold_or_latency_not_sampled(self):
+        assert not tail_sampled("completed", 5.0, None)
+        assert not tail_sampled("completed", None, 2.0)
+
+    def test_deterministic(self):
+        args = ("completed", 1.999999, 2.0)
+        assert all(tail_sampled(*args) == tail_sampled(*args)
+                   for _ in range(100))
+
+
+# ---------------------------------------------------------------------------
+# access log durability (no engine needed)
+
+
+class TestAccessLogDurability:
+    def _rec(self, i, outcome="completed"):
+        return {"kind": "serve_access", "request_id": f"r{i}",
+                "outcome": outcome, "latency_s": 0.1 * i,
+                "prompt_len": 4, "max_new_tokens": 2}
+
+    def test_ring_and_file_and_aggregates(self, tmp_path):
+        telemetry.reset_metrics()     # clears the aggregates too
+        log = AccessLog(str(tmp_path / "access.jsonl"), ring=4)
+        for i in range(6):
+            log.record(self._rec(i), latency_s=0.1 * i, ttft_s=None)
+        log.close()
+        assert [r["request_id"] for r in log.recent()] == \
+            ["r2", "r3", "r4", "r5"]          # ring bounded at 4
+        recs = read_access_log(str(tmp_path / "access.jsonl"))
+        assert [r["request_id"] for r in recs] == [f"r{i}" for i in range(6)]
+        agg = aggregates()
+        assert agg["outcomes"] == {"completed": 6}
+        assert agg["latency_count"] == 6 and agg["ttft_count"] == 0
+
+    def test_torn_tail_tolerated(self, tmp_path):
+        path = str(tmp_path / "access.jsonl")
+        log = AccessLog(path)
+        for i in range(3):
+            log.record(self._rec(i))
+        log.close()
+        with open(path, "a") as f:            # SIGKILL mid-write
+            f.write('{"kind":"serve_access","request_id":"torn","outc')
+        recs = read_access_log(path)
+        assert [r["request_id"] for r in recs] == ["r0", "r1", "r2"]
+
+    def test_rotation_generations_read_oldest_first(self, tmp_path):
+        path = str(tmp_path / "access.jsonl")
+        log = AccessLog(path, max_bytes=200, max_files=3)
+        for i in range(20):
+            log.record(self._rec(i))
+        log.close()
+        assert log.rotations >= 2
+        assert os.path.exists(path + ".1")
+        recs = read_access_log(path)
+        got = [int(r["request_id"][1:]) for r in recs]
+        assert got == sorted(got)             # oldest generation first
+        assert got[-1] == 19                  # newest record survives
+        # the rotation bound holds: at most max_files generations
+        assert not os.path.exists(path + ".3")
+
+    def test_write_failure_degrades_never_raises(self, tmp_path):
+        reset_fault_events()
+        telemetry.reset_metrics()
+        log = AccessLog(str(tmp_path / "access.jsonl"))
+        with FaultInjector({"serve.access_write": ("raise", 0)}):
+            log.record(self._rec(0))          # must not raise
+        assert log.errors == 1
+        assert fault_events().get("access_log_errors", 0) >= 1
+        # ring + aggregates still saw the record (only the file write
+        # was dropped)
+        assert len(log.ring) == 1
+        assert aggregates()["outcomes"] == {"completed": 1}
+        log.close()
+
+    def test_no_path_means_ring_only(self):
+        telemetry.reset_metrics()
+        log = AccessLog(None)
+        log.record(self._rec(0))
+        assert log.stats()["ok"] and log.stats()["path"] is None
+        assert len(log.recent()) == 1
+
+
+# ---------------------------------------------------------------------------
+# engine integration: exact reconciliation + requestz + TPOT
+
+
+def _engine(**cfg):
+    model = TinyServeModel(vocab=32, dim=8, layers=2, heads=2, ffn=16,
+                           seed=0)
+    base = dict(max_running=3, token_budget=8, block_size=4,
+                num_blocks=16, max_blocks_per_seq=4)
+    base.update(cfg)
+    return ServingEngine(model, ServeConfig(**base))
+
+
+PROMPTS = [[1, 2, 3, 4, 5], [7, 8], [3, 1, 4, 1, 5, 9]]
+
+
+class TestEngineObservability:
+    def test_access_aggregates_reconcile_exactly(self, tmp_path):
+        from paddle_tpu.core.dispatch import reset_dispatch_stats
+
+        telemetry.reset_metrics()
+        reset_dispatch_stats()
+        tracing.configure(str(tmp_path / "trace"))
+        tracing.reset_span_stats()
+        try:
+            eng = _engine(max_queued=2,
+                          access_log=str(tmp_path / "access.jsonl"))
+            shed = 0
+            for i in range(6):
+                try:
+                    eng.submit([1 + i, 2, 3], max_new_tokens=3)
+                except OverloadedError:
+                    shed += 1
+            out = eng.run()
+            assert shed > 0 and len(out) > 0
+            ok, rep = tracing.reconcile_with_metrics()
+            assert ok, rep
+            acc = rep["serve_access_outcomes"]
+            assert not acc["skipped"] and acc["ok"]
+            assert acc["span_n"] == len(out) + shed  # one record per exit
+            assert rep["serve_access_latency"]["ok"]
+            assert not rep["serve_access_latency"]["skipped"]
+            assert rep["serve_access_ttft"]["ok"]
+            # the per-outcome counts agree with the counter series
+            agg = aggregates()
+            fam = telemetry.snapshot()["paddle_tpu_serve_requests_total"]
+            counter = {tuple(s["labels"].values())[0]: int(s["value"])
+                       for s in fam["series"]}
+            assert agg["outcomes"] == counter
+            # submit-time sheds never entered the latency histogram —
+            # the aggregate must not claim them either
+            assert agg["latency_count"] == len(out)
+        finally:
+            tracing.set_enabled(False)
+
+    def test_access_records_written_for_every_exit(self, tmp_path):
+        telemetry.reset_metrics()
+        path = str(tmp_path / "access.jsonl")
+        eng = _engine(max_queued=2, access_log=path)
+        shed = 0
+        for i in range(5):
+            try:
+                eng.submit([1 + i, 2], max_new_tokens=2)
+            except OverloadedError:
+                shed += 1
+        out = eng.run()
+        recs = read_access_log(path)
+        assert len(recs) == len(out) + shed
+        by_outcome = {}
+        for r in recs:
+            by_outcome[r["outcome"]] = by_outcome.get(r["outcome"], 0) + 1
+        assert by_outcome.get("overloaded", 0) == shed
+        assert by_outcome.get("completed", 0) == len(out)
+        for r in recs:
+            if r["outcome"] == "overloaded":
+                assert r["sampled"] and r["latency_s"] is None
+            else:
+                assert r["latency_s"] is not None
+                assert r["ttft_s"] is not None
+                assert r["tokens_out"] == 2
+
+    def test_tpot_aggregates_and_histogram(self, tmp_path):
+        telemetry.reset_metrics()
+        path = str(tmp_path / "access.jsonl")
+        eng = _engine(access_log=path)
+        out = eng.generate(PROMPTS, max_new_tokens=4)
+        assert all(len(t) == 4 for t in out)
+        recs = [r for r in read_access_log(path)
+                if r["outcome"] == "completed"]
+        assert len(recs) == len(PROMPTS)
+        for r in recs:
+            # 4 tokens -> 3 inter-token gaps, mean/max present
+            assert r["tpot_count"] == 3
+            assert r["tpot_mean_s"] is not None
+            assert r["tpot_max_s"] >= r["tpot_mean_s"] - 1e-9
+        fam = telemetry.snapshot()["paddle_tpu_serve_tpot_seconds"]
+        assert fam["series"][0]["count"] == 3 * len(PROMPTS)
+
+    def test_happy_path_not_sampled_above_threshold(self, tmp_path):
+        telemetry.reset_metrics()
+        path = str(tmp_path / "access.jsonl")
+        eng = _engine(access_log=path, trace_slow_s=1e9)
+        eng.generate(PROMPTS[:2], max_new_tokens=2)
+        recs = read_access_log(path)
+        assert recs and all(not r["sampled"] for r in recs)
+
+    def test_requestz_snapshot_shape(self, tmp_path):
+        telemetry.reset_metrics()
+        eng = _engine(access_log=str(tmp_path / "access.jsonl"))
+        eng.generate(PROMPTS[:2], max_new_tokens=2)
+        snap = eng.requestz_snapshot()
+        assert snap["in_flight"] == []        # drained
+        assert len(snap["recent"]) == 2
+        assert set(snap["windows"]) == {"1m", "5m"}
+        assert snap["windows"]["1m"]["ttft_count"] == 2
+        assert "burning" in snap["slo"]
+        assert snap["oldest_queued_age_s"] == 0.0
+        assert snap["access"]["records"] == 2
+        # a queued request shows up with its age and phase
+        eng.scheduler.begin_drain()           # block admission to plan
+        json.dumps(snap, default=str)         # statusz-serializable
+
+    def test_oldest_queued_age_in_stats_and_gauge(self, tmp_path):
+        telemetry.reset_metrics()
+        eng = _engine()
+        assert eng.stats()["oldest_queued_age_s"] == 0.0
+        eng.submit(PROMPTS[0], max_new_tokens=2)
+        time.sleep(0.01)
+        age = eng.scheduler.oldest_queued_age()
+        assert age >= 0.01
+        assert eng.stats()["oldest_queued_age_s"] >= 0.01
+        eng.run()
+        assert eng.scheduler.oldest_queued_age() == 0.0
+
+    def test_windowed_gauges_move_while_lifetime_only_grows(
+            self, tmp_path):
+        """The windowed view's reason to exist: drive traffic at two
+        deterministic 'times' through the engine's ServingWindows and
+        watch the 1m panel ROLL (old samples leave), which the lifetime
+        histogram cannot do."""
+        telemetry.reset_metrics()
+        eng = _engine()
+        eng.windows.observe_ttft(5.0, now=10.0)     # slow sample at t=10
+        p99_early = eng.windows.snapshot(now=11.0)["1m"]["ttft_p99_s"]
+        assert p99_early is not None and p99_early > 2.0
+        eng.windows.observe_ttft(0.01, now=100.0)   # fast sample at t=100
+        panel_late = eng.windows.snapshot(now=101.0)["1m"]
+        # the slow sample aged out of the 1m window: p99 moved DOWN
+        assert panel_late["ttft_count"] == 1
+        assert panel_late["ttft_p99_s"] < p99_early

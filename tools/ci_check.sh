@@ -79,6 +79,27 @@ echo "== serve chaos smoke (overload shed + fault chaos + drain + crash recovery
 # uninterrupted run with ZERO fresh XLA compiles
 JAX_PLATFORMS=cpu python tools/serve_chaos_smoke.py
 
+echo "== loadgen record/replay round trip (trace-driven replay fidelity) =="
+# ISSUE 20: a short recorded run's serve_access log replayed through
+# --replay must reproduce the recorded arrival offsets and request mix
+# EXACTLY (--verify-replay fails the run otherwise)
+REPLAY_DIR=$(mktemp -d)
+trap 'rm -rf "$REPLAY_DIR"' EXIT
+JAX_PLATFORMS=cpu python tools/loadgen.py --rate 30 --duration 1 \
+    --seed 3 --max-queued 16 --record "$REPLAY_DIR/rec.jsonl" \
+    > "$REPLAY_DIR/record_report.json"
+JAX_PLATFORMS=cpu python tools/loadgen.py --replay "$REPLAY_DIR/rec.jsonl" \
+    --verify-replay --seed 3 --max-queued 16 \
+    > "$REPLAY_DIR/replay_report.json"
+python - "$REPLAY_DIR" <<'PYEOF'
+import json, sys
+d = json.load(open(sys.argv[1] + "/replay_report.json"))
+assert d["replay"]["fidelity_ok"], d["replay"]
+assert d["replay"]["count"] == d["offered"], (d["replay"], d["offered"])
+print("record/replay: %d requests, fidelity ok, skew max %ss"
+      % (d["replay"]["count"], d["replay"]["arrival_skew_max_s"]))
+PYEOF
+
 echo "== multihost smoke (coordination store + quorum + merge) =="
 # 2-process CPU cluster over a tmpdir store: heartbeat + rendezvous
 # round trip, host-0 merged prom/fault-log carrying both rank labels,

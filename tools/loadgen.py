@@ -21,6 +21,20 @@ Chaos mode rides the existing FaultInjector sites:
     --chaos delay   ``serve.step`` delay — slow steps; deadlines evict
     --chaos kv      ``serve.kv_alloc`` raise — KV exhaustion degradation
 
+Wedge detection is SERVER-side (ISSUE 20): the drive loop polls the
+scheduler's oldest-queued-age (also exported as the
+``paddle_tpu_serve_oldest_queued_age_seconds`` gauge and in
+``stats()``/(/serving)) and declares ``wedged`` when one request has
+sat unserved past ``--wedge-age``; the old client-side hard wall
+remains only as a backstop (``wedged_by`` says which tripped).
+
+Record/replay (ISSUE 20): ``--record PATH`` writes the run's offered
+schedule + outcomes as ``serve_access``-schema JSONL; ``--replay
+PATH`` re-drives those arrival offsets, prompt lengths, budgets, and
+deadlines (the loader also accepts a raw engine access log, deriving
+offsets from ``t_submit_wall`` deltas). ``--verify-replay`` gates the
+run on schedule fidelity.
+
 CLI::
 
     python tools/loadgen.py --rate 50 --duration 3 --max-queued 16 \\
@@ -44,7 +58,8 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if REPO not in sys.path:
     sys.path.insert(0, REPO)
 
-__all__ = ["build_arrivals", "run_load", "check_slo", "percentile"]
+__all__ = ["build_arrivals", "run_load", "check_slo", "percentile",
+           "load_replay_schedule"]
 
 
 def percentile(values, q):
@@ -106,29 +121,76 @@ def _scraper(stop, samples, interval_s=0.2):
             continue
 
 
+def load_replay_schedule(path):
+    """Parse a replay schedule from ``--record`` output or a raw engine
+    access log (both speak the ``serve_access`` record schema).
+    Arrival offsets come from ``arrival_offset_s`` when the record has
+    one (loadgen recordings do), else from ``t_submit_wall`` deltas
+    against the first record (engine access logs)."""
+    from paddle_tpu.inference.journal import iter_jsonl
+
+    recs = [r for r in iter_jsonl(path)
+            if r.get("kind", "serve_access") == "serve_access"]
+    if not recs:
+        raise ValueError(f"no serve_access records in {path}")
+    base = None
+    out = []
+    for r in recs:
+        if r.get("arrival_offset_s") is not None:
+            off = float(r["arrival_offset_s"])
+        else:
+            w = float(r.get("t_submit_wall", 0.0))
+            if base is None:
+                base = w
+            off = max(0.0, w - base)
+        out.append({"arrival_offset_s": off,
+                    "prompt_len": int(r.get("prompt_len") or 1),
+                    "max_new_tokens": int(r.get("max_new_tokens") or 1),
+                    "deadline_s": r.get("deadline_s")})
+    out.sort(key=lambda s: s["arrival_offset_s"])
+    return out
+
+
 def run_load(engine, *, rate_rps, duration_s, prompt_lens=(2, 4, 8),
              new_tokens=(2, 4, 8), deadline_s=None, burst_every_s=None,
              burst_size=0, seed=0, vocab=None, scrape_statusz=False,
-             hard_wall_s=None):
+             hard_wall_s=None, arrivals=None, wedge_age_s=None):
     """Drive `engine` with open-loop traffic; returns the report dict.
 
     The submitter runs on a SECOND thread (racing the decode thread's
     plan/evict paths through the scheduler lock); the calling thread
     drives `engine.step()` until the schedule is exhausted and accepted
-    work finishes — or the hard wall trips (``wedged: True``)."""
+    work finishes — or a wedge trips (``wedged: True``). Wedge is
+    decided by the SERVER's oldest-queued-age (one request unserved
+    past `wedge_age_s`); the hard wall is only a backstop.
+
+    `arrivals` replays an explicit schedule (dicts with
+    ``arrival_offset_s`` / ``prompt_len`` / ``max_new_tokens`` /
+    ``deadline_s``, see `load_replay_schedule`) instead of sampling
+    one; token VALUES still come from the seeded RNG."""
     from paddle_tpu.inference import OverloadedError
 
     rng = random.Random(seed)
     vocab = vocab or getattr(engine.model, "vocab", 32)
-    specs = [(t,
+    if arrivals is not None:
+        specs = sorted(
+            ((float(a["arrival_offset_s"]),
               [rng.randrange(1, vocab)
-               for _ in range(_pick(rng, prompt_lens))],
-              _pick(rng, new_tokens),
-              _pick(rng, deadline_s))
-             for t in build_arrivals(rate_rps, duration_s, rng,
-                                     burst_every_s=burst_every_s,
-                                     burst_size=burst_size)]
+               for _ in range(int(a["prompt_len"]))],
+              int(a["max_new_tokens"]),
+              a.get("deadline_s"))
+             for a in arrivals), key=lambda s: s[0])
+    else:
+        specs = [(t,
+                  [rng.randrange(1, vocab)
+                   for _ in range(_pick(rng, prompt_lens))],
+                  _pick(rng, new_tokens),
+                  _pick(rng, deadline_s))
+                 for t in build_arrivals(rate_rps, duration_s, rng,
+                                         burst_every_s=burst_every_s,
+                                         burst_size=burst_size)]
     ids = set()
+    client = []               # per-offered-request client observations
     state = {"shed": 0, "done": False, "errors": 0}
     lock = threading.Lock()
 
@@ -138,14 +200,24 @@ def run_load(engine, *, rate_rps, duration_s, prompt_lens=(2, 4, 8),
             dt = t0 + t_arr - time.perf_counter()
             if dt > 0:
                 time.sleep(dt)
+            t_sub = time.perf_counter()
+            obs = {"arrival_offset_s": t_arr, "t_sub": t_sub,
+                   "skew_s": t_sub - (t0 + t_arr),
+                   "prompt_len": len(prompt), "max_new_tokens": n_new,
+                   "deadline_s": ddl, "request_id": None, "shed": False}
             try:
                 rid = engine.submit(prompt, max_new_tokens=n_new,
                                     deadline_s=ddl)
+                obs["request_id"] = rid
                 with lock:
                     ids.add(rid)
-            except OverloadedError:
+                    client.append(obs)
+            except OverloadedError as e:
+                obs["shed"] = True
+                obs["request_id"] = e.request_id
                 with lock:
                     state["shed"] += 1
+                    client.append(obs)
             except Exception:  # noqa: BLE001 — keep offering load; the
                 # report surfaces the count
                 with lock:
@@ -162,17 +234,33 @@ def run_load(engine, *, rate_rps, duration_s, prompt_lens=(2, 4, 8),
                                    args=(stop_scrape, scraped),
                                    name="loadgen-scrape", daemon=True)
         scraper.start()
+    sched_span = specs[-1][0] if specs else duration_s
     hard = (hard_wall_s if hard_wall_s is not None
-            else duration_s * 5.0 + 30.0)
+            else max(duration_s, sched_span) * 5.0 + 30.0)
+    wedge_age = (wedge_age_s if wedge_age_s is not None
+                 else max(duration_s, sched_span) * 3.0 + 15.0)
     steps0 = engine.steps
     max_depth = 0
+    oldest_max = 0.0
+    last_age_check = 0.0
     wedged = False
+    wedged_by = None
     t_start = time.perf_counter()
     th.start()
     while not state["done"] or engine.scheduler.has_work():
-        if time.perf_counter() - t_start > hard:
-            wedged = True
+        now = time.perf_counter()
+        if now - t_start > hard:
+            wedged, wedged_by = True, "hard_wall"
             break
+        if now - last_age_check >= 0.1:
+            # the server-published wedge signal: one request sitting
+            # unserved this long means the loop is not making progress
+            last_age_check = now
+            age = engine.scheduler.oldest_queued_age(now=now)
+            oldest_max = max(oldest_max, age)
+            if age > wedge_age:
+                wedged, wedged_by = True, "oldest_queued_age"
+                break
         if not engine.step():
             time.sleep(0.001)  # waiting on arrivals, not spinning
         max_depth = max(max_depth, len(engine.scheduler.queue))
@@ -191,6 +279,48 @@ def run_load(engine, *, rate_rps, duration_s, prompt_lens=(2, 4, 8),
     lats = [r.t_done - r.t_submit for r in fin if r.t_done is not None]
     submitted = len(ids) + state["shed"]
     goodput_tokens = sum(len(r.generated) for r in fin)
+
+    # client-measured vs server-recorded TTFT: the client clock starts
+    # at the submit() call, the server clock inside ServeRequest — the
+    # delta is the submission overhead and must stay tiny
+    fin_by = {r.request_id: r for r in fin}
+    ev_by = {r.request_id: r for r in ev}
+    deltas = []
+    records = []
+    for o in client:
+        r = fin_by.get(o["request_id"]) or ev_by.get(o["request_id"])
+        if o["shed"]:
+            outcome = "overloaded"
+        elif r is None:
+            outcome = "in_flight"
+        elif r.request_id in fin_by:
+            outcome = "completed"
+        else:
+            outcome = {"cancelled": "cancelled",
+                       "queue_timeout": "overloaded"}.get(
+                           r.evict_reason, "evicted")
+        ttft_srv = client_ttft = None
+        if r is not None and r.t_first_token is not None:
+            ttft_srv = r.t_first_token - r.t_submit
+            client_ttft = r.t_first_token - o["t_sub"]
+            deltas.append(client_ttft - ttft_srv)
+        records.append({
+            "kind": "serve_access",
+            "request_id": o["request_id"],
+            "arrival_offset_s": round(o["arrival_offset_s"], 6),
+            "prompt_len": o["prompt_len"],
+            "max_new_tokens": o["max_new_tokens"],
+            "deadline_s": o["deadline_s"],
+            "outcome": outcome,
+            "ttft_s": round(ttft_srv, 6) if ttft_srv is not None else None,
+            "client_ttft_s": (round(client_ttft, 6)
+                              if client_ttft is not None else None),
+        })
+
+    # windowed SLO surface straight off the engine (same numbers the
+    # /statusz gauges and /requestz panel publish)
+    panel = (engine.slo_panel() if hasattr(engine, "slo_panel") else None)
+    w1 = (panel or {}).get("windows", {}).get("1m", {})
     return {
         "offered": len(specs),
         "submitted": submitted,
@@ -213,6 +343,20 @@ def run_load(engine, *, rate_rps, duration_s, prompt_lens=(2, 4, 8),
         "steps": engine.steps - steps0,
         "wall_s": wall,
         "wedged": wedged,
+        "wedged_by": wedged_by,
+        "oldest_queued_age_max_s": round(oldest_max, 6),
+        "arrival_skew_max_s": (round(max(o["skew_s"] for o in client), 6)
+                               if client else None),
+        "ttft_reconcile_max_delta_s": (round(max(deltas), 6)
+                                       if deltas else None),
+        "ttft_p50_s_1m": w1.get("ttft_p50_s"),
+        "ttft_p99_s_1m": w1.get("ttft_p99_s"),
+        "goodput_tokens_per_sec_1m": w1.get("goodput_tokens_per_sec"),
+        "shed_rate_1m": w1.get("shed_ratio"),
+        "queue_depth_highwater_1m": w1.get("queue_depth_highwater"),
+        "windows": (panel or {}).get("windows"),
+        "slo": (panel or {}).get("slo"),
+        "records": records,
     }
 
 
@@ -225,13 +369,16 @@ def _count_by(reqs):
 
 def check_slo(report, ttft_p99_s=None, min_goodput_tps=None,
               max_shed_rate=None, max_queue_depth=None,
-              min_completed=None):
+              min_completed=None, ttft_p99_1m_s=None,
+              min_goodput_1m_tps=None, max_shed_rate_1m=None):
     """Gate a run's report against SLO thresholds; returns the list of
     violation strings (empty = all gates pass). A wedged run violates
-    unconditionally."""
+    unconditionally. The ``*_1m`` gates read the engine's rolling
+    last-1m window instead of the run-lifetime aggregate."""
     v = []
     if report.get("wedged"):
-        v.append("wedged: hard wall tripped before the queue drained")
+        v.append("wedged: %s tripped before the queue drained"
+                 % (report.get("wedged_by") or "hard wall"))
     if ttft_p99_s is not None:
         got = report.get("ttft_p99_s")
         if got is None:
@@ -253,6 +400,21 @@ def check_slo(report, ttft_p99_s=None, min_goodput_tps=None,
     if (min_completed is not None
             and report.get("completed", 0) < min_completed):
         v.append(f"completed {report['completed']} < {min_completed}")
+    if ttft_p99_1m_s is not None:
+        got = report.get("ttft_p99_s_1m")
+        if got is None:
+            v.append("ttft_p99_1m: no windowed TTFT samples")
+        elif got > ttft_p99_1m_s:
+            v.append(f"ttft_p99_1m {got:.3f}s > {ttft_p99_1m_s:.3f}s")
+    if min_goodput_1m_tps is not None:
+        got = report.get("goodput_tokens_per_sec_1m") or 0.0
+        if got < min_goodput_1m_tps:
+            v.append(f"goodput_1m {got:.1f} tok/s"
+                     f" < {min_goodput_1m_tps:.1f}")
+    if max_shed_rate_1m is not None:
+        got = report.get("shed_rate_1m") or 0.0
+        if got > max_shed_rate_1m:
+            v.append(f"shed_rate_1m {got:.3f} > {max_shed_rate_1m:.3f}")
     return v
 
 
@@ -299,6 +461,21 @@ def main(argv=None):
     p.add_argument("--slo-max-shed-rate", type=float, default=None)
     p.add_argument("--slo-max-queue-depth", type=int, default=None)
     p.add_argument("--slo-min-completed", type=int, default=None)
+    p.add_argument("--slo-ttft-p99-1m", type=float, default=None,
+                   help="gate on the engine's rolling last-1m TTFT p99")
+    p.add_argument("--slo-min-goodput-1m", type=float, default=None)
+    p.add_argument("--slo-max-shed-rate-1m", type=float, default=None)
+    p.add_argument("--wedge-age", type=float, default=None,
+                   help="oldest-queued-age (s) that declares a wedge")
+    p.add_argument("--record", default=None, metavar="PATH",
+                   help="write the offered schedule + outcomes as "
+                        "serve_access JSONL (the replay format)")
+    p.add_argument("--replay", default=None, metavar="PATH",
+                   help="replay the arrival schedule recorded at PATH "
+                        "(also accepts a raw engine access log)")
+    p.add_argument("--verify-replay", action="store_true",
+                   help="with --replay: fail unless the offered "
+                        "schedule reproduced the recording exactly")
     args = p.parse_args(argv)
 
     from paddle_tpu.runtime import diagnostics as _diagnostics
@@ -313,12 +490,19 @@ def main(argv=None):
     elif args.chaos == "kv":
         # count=0 -> raise on EVERY allocation attempt
         specs["serve.kv_alloc"] = ("raise", int(args.chaos_arg or 0))
-    kwargs = dict(rate_rps=args.rate, duration_s=args.duration,
+    schedule = None
+    duration = args.duration
+    if args.replay:
+        schedule = load_replay_schedule(args.replay)
+        duration = (schedule[-1]["arrival_offset_s"] + 0.5
+                    if schedule else args.duration)
+    kwargs = dict(rate_rps=args.rate, duration_s=duration,
                   prompt_lens=args.prompt_lens,
                   new_tokens=args.new_tokens,
                   deadline_s=args.deadline, burst_every_s=args.burst_every,
                   burst_size=args.burst_size, seed=args.seed,
-                  scrape_statusz=args.statusz)
+                  scrape_statusz=args.statusz, arrivals=schedule,
+                  wedge_age_s=args.wedge_age)
     if specs:
         with FaultInjector(specs):
             report = run_load(engine, **kwargs)
@@ -329,8 +513,35 @@ def main(argv=None):
         min_goodput_tps=args.slo_min_goodput,
         max_shed_rate=args.slo_max_shed_rate,
         max_queue_depth=args.slo_max_queue_depth,
-        min_completed=args.slo_min_completed)
+        min_completed=args.slo_min_completed,
+        ttft_p99_1m_s=args.slo_ttft_p99_1m,
+        min_goodput_1m_tps=args.slo_min_goodput_1m,
+        max_shed_rate_1m=args.slo_max_shed_rate_1m)
+    if args.record:
+        with open(args.record, "w") as f:
+            for rec in report["records"]:
+                f.write(json.dumps(rec, separators=(",", ":")) + "\n")
+    if args.replay:
+        want = [(round(s["arrival_offset_s"], 6), s["prompt_len"],
+                 s["max_new_tokens"], s["deadline_s"]) for s in schedule]
+        got = [(r["arrival_offset_s"], r["prompt_len"],
+                r["max_new_tokens"], r["deadline_s"])
+               for r in report["records"]]
+        fidelity_ok = got == want
+        report["replay"] = {
+            "source": args.replay,
+            "count": len(schedule),
+            "fidelity_ok": fidelity_ok,
+            "arrival_skew_max_s": report["arrival_skew_max_s"],
+        }
+        if args.verify_replay and not fidelity_ok:
+            violations.append(
+                f"replay: offered schedule diverged from {args.replay} "
+                f"({len(got)}/{len(want)} offered)")
     report["slo_violations"] = violations
+    # per-request records go to --record, not stdout (they scale with
+    # offered load; the printed report stays scannable)
+    report["records_count"] = len(report.pop("records"))
     print(json.dumps(report, indent=1, sort_keys=True))
     if report.get("wedged"):
         return 2

@@ -10,7 +10,15 @@ Stage 1 — overload (4x sustainable arrival rate through tools/loadgen):
     bounded at ANY arrival rate);
   * admitted-request TTFT p99 stays bounded (queue-wait cap + service,
     with CPU slack);
-  * the run exits clean — no wedge (loadgen's hard wall never trips).
+  * the run exits clean — no wedge (the server-published
+    oldest-queued-age stays below the wedge threshold);
+  * ISSUE 20: /requestz parses under scrape WHILE the storm runs; the
+    last-1m TTFT window moves during the storm (count grows, p99
+    shifts — the lifetime histogram alone could not show this); every
+    shed request carries a full sampled trace (access record with
+    ``sampled`` + `serve/request/*` detail spans); access-log
+    aggregates reconcile EXACTLY with the outcome counters and
+    latency/TTFT histograms (tracing.reconcile_with_metrics).
 
 Stage 2 — chaos degradation contracts (FaultInjector):
   * serve.step delay: deadline-burdened requests evict
@@ -75,13 +83,17 @@ def _base_env(td):
     )
     for k in ("PADDLE_TPU_SHAPE_MANIFEST", "PADDLE_TPU_FAULT_INJECT",
               "PADDLE_TPU_DIAGNOSTICS_DIR", "PADDLE_TPU_SERVE_JOURNAL",
-              "CHAOS_JOURNAL"):
+              "CHAOS_JOURNAL", "PADDLE_TPU_TRACE", "PADDLE_TPU_STATUSZ",
+              "PADDLE_TPU_SERVE_ACCESS_LOG"):
         env.pop(k, None)
     return env
 
 
 def _stage_overload(td, problems):
-    doc = _run("overload", _base_env(td))
+    env = _base_env(td)
+    # tracer live so the child's sampled detail spans + reconcile run
+    env["PADDLE_TPU_TRACE"] = os.path.join(td, "trace")
+    doc = _run("overload", env)
     rep, outcomes = doc["report"], doc["outcomes"]
     if rep["wedged"]:
         problems.append(f"overload: engine WEDGED at 4x rate: {rep}")
@@ -102,11 +114,36 @@ def _stage_overload(td, problems):
                         "is unbounded-looking (> 20s)")
     if rep["completed"] <= 0:
         problems.append("overload: nothing completed under overload")
+    # -- ISSUE 20: request-scoped observability under fire ----------------
+    rz = doc["requestz"]
+    if rz["parsed"] <= 0:
+        problems.append(f"overload: /requestz never parsed under scrape "
+                        f"during the storm: {rz}")
+    w0, w1 = doc["w1_before"], doc["w1_after"]
+    if w1["ttft_count"] <= w0["ttft_count"]:
+        problems.append(f"overload: last-1m TTFT window did not move "
+                        f"during the storm: {w0} -> {w1}")
+    if not doc["reconcile_ok"]:
+        problems.append(f"overload: access-log aggregates failed to "
+                        f"reconcile with metrics: {doc['reconcile_bad']}")
+    if doc["shed_records"] <= 0:
+        problems.append("overload: no shed access records in the ring")
+    elif doc["shed_records_sampled"] != doc["shed_records"]:
+        problems.append(f"overload: {doc['shed_records_sampled']} of "
+                        f"{doc['shed_records']} shed records tail-"
+                        "sampled (want ALL)")
+    if doc["detail_spans"].get("request/queue", 0) <= 0:
+        problems.append(f"overload: sampled requests emitted no "
+                        f"request/* detail spans: {doc['detail_spans']}")
     return (f"shed {rep['shed']}+{rep['evicted_by_reason'].get('queue_timeout', 0)} "
             f"of {rep['submitted']} at {doc['rate_rps']:.0f} rps "
             f"(~4x {doc['sustainable_rps']:.0f}), depth<="
             f"{rep['max_queue_depth']}, ttft_p99="
-            f"{0 if rep['ttft_p99_s'] is None else rep['ttft_p99_s']:.2f}s")
+            f"{0 if rep['ttft_p99_s'] is None else rep['ttft_p99_s']:.2f}s; "
+            f"requestz {rz['parsed']}/{rz['scrapes']} scrapes parsed, "
+            f"1m ttft_count {w0['ttft_count']}->{w1['ttft_count']}, "
+            f"reconcile ok, {doc['shed_records']} shed records all "
+            f"sampled")
 
 
 def _stage_chaos(td, problems):
